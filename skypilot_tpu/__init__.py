@@ -1,0 +1,36 @@
+"""skypilot_tpu: a TPU-native multi-cloud AI-workload orchestrator.
+
+Brand-new framework with the capability surface of the reference SkyPilot
+(surveyed in SURVEY.md), designed TPU-first: TPU pod slices are first-class
+resources, gang execution injects `jax.distributed` env over ICI/DCN (no
+Ray), and the in-tree compute path (models/ops/parallel/train) provides the
+JAX/MaxText/JetStream twins of the reference's GPU recipes.
+"""
+from skypilot_tpu import clouds as _clouds  # registers clouds  # noqa: F401
+from skypilot_tpu import exceptions
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.optimizer import Optimizer, OptimizeTarget
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+__version__ = '0.1.0'
+
+__all__ = [
+    'Dag',
+    'Optimizer',
+    'OptimizeTarget',
+    'Resources',
+    'Task',
+    'exceptions',
+    '__version__',
+]
+
+
+def __getattr__(name):
+    # Lazy: the SDK pulls in backends/provision/state; keep `import
+    # skypilot_tpu` light for library users (models/ops only).
+    if name in ('launch', 'exec', 'status', 'start', 'stop', 'down',
+                'autostop', 'queue', 'cancel', 'tail_logs'):
+        from skypilot_tpu.client import sdk
+        return getattr(sdk, name)
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

@@ -1,0 +1,149 @@
+"""Public catalog API (twin of sky/catalog/__init__.py:57-357).
+
+Per-cloud catalogs are CSV-backed (see ``common.py``); this module exposes
+cloud-dispatching queries used by the optimizer, CLI (`xsky show-gpus`) and
+Resources validation.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu.catalog import common
+from skypilot_tpu.utils import tpu_topology
+
+CatalogEntry = common.CatalogEntry
+
+_ALL_CLOUDS = ('gcp', 'fake')
+
+
+@dataclasses.dataclass(frozen=True)
+class AcceleratorOffering:
+    """One accelerator offering summarized across zones (for show-gpus)."""
+    accelerator_name: str
+    accelerator_count: float
+    cloud: str
+    instance_type: str
+    regions: Tuple[str, ...]
+    price: float        # cheapest on-demand across zones
+    spot_price: float
+    memory_gib: float   # accelerator memory (HBM)
+
+
+def list_accelerators(
+        gpus_only: bool = False,
+        name_filter: Optional[str] = None,
+        clouds: Optional[List[str]] = None,
+        case_sensitive: bool = False,
+) -> Dict[str, List[AcceleratorOffering]]:
+    """accelerator name → offerings, cheapest first."""
+    result: Dict[str, List[AcceleratorOffering]] = {}
+    for cloud in clouds or _ALL_CLOUDS:
+        groups: Dict[Tuple[str, float, str], List[common.CatalogEntry]] = {}
+        for e in common.load_catalog(cloud):
+            if not e.accelerator_name:
+                continue
+            if gpus_only and e.is_tpu:
+                continue
+            if name_filter is not None:
+                hay = e.accelerator_name if case_sensitive else \
+                    e.accelerator_name.lower()
+                needle = name_filter if case_sensitive else name_filter.lower()
+                if needle not in hay:
+                    continue
+            groups.setdefault(
+                (e.accelerator_name, e.accelerator_count, e.instance_type),
+                []).append(e)
+        for (name, count, itype), entries in groups.items():
+            prices = [e.price for e in entries if e.price > 0]
+            spots = [e.spot_price for e in entries if e.spot_price > 0]
+            result.setdefault(name, []).append(
+                AcceleratorOffering(
+                    accelerator_name=name,
+                    accelerator_count=count,
+                    cloud=cloud,
+                    instance_type=itype,
+                    regions=tuple(sorted({e.region for e in entries})),
+                    price=min(prices) if prices else 0.0,
+                    spot_price=min(spots) if spots else 0.0,
+                    memory_gib=entries[0].accelerator_memory_gib,
+                ))
+    for name in result:
+        result[name].sort(key=lambda o: (o.price == 0, o.price))
+    return result
+
+
+def get_tpus(clouds: Optional[List[str]] = None) -> List[str]:
+    """All TPU slice names in the catalogs (twin of catalog get_tpus:337)."""
+    names = set()
+    for cloud in clouds or _ALL_CLOUDS:
+        for e in common.load_catalog(cloud):
+            if e.is_tpu:
+                names.add(e.accelerator_name)
+    return sorted(names)
+
+
+def get_entries_for_accelerator(
+        cloud: str,
+        accelerator_name: str,
+        accelerator_count: float = 1,
+        region: Optional[str] = None,
+        zone: Optional[str] = None) -> List[common.CatalogEntry]:
+    """All zone-level offerings for an accelerator (case-insensitive name)."""
+    name = accelerator_name.lower() if tpu_topology.is_tpu(
+        accelerator_name) else accelerator_name
+    return common.filter_entries(
+        cloud, lambda e:
+        (e.accelerator_name.lower() == name.lower() if e.is_tpu else e.
+         accelerator_name == name) and e.accelerator_count ==
+        accelerator_count and (region is None or e.region == region) and
+        (zone is None or e.zone == zone))
+
+
+def get_instance_type_for_accelerator(
+        cloud: str,
+        accelerator_name: str,
+        accelerator_count: float = 1) -> Optional[str]:
+    entries = get_entries_for_accelerator(cloud, accelerator_name,
+                                          accelerator_count)
+    if not entries:
+        return None
+    return entries[0].instance_type
+
+
+def get_hourly_cost(cloud: str, instance_type: str, use_spot: bool,
+                    region: Optional[str] = None,
+                    zone: Optional[str] = None) -> float:
+    return common.get_hourly_cost(cloud, instance_type, use_spot, region, zone)
+
+
+def get_accelerator_hourly_cost(cloud: str,
+                                accelerator_name: str,
+                                accelerator_count: float = 1,
+                                use_spot: bool = False,
+                                region: Optional[str] = None,
+                                zone: Optional[str] = None) -> float:
+    entries = get_entries_for_accelerator(cloud, accelerator_name,
+                                          accelerator_count, region, zone)
+    if not entries:
+        raise ValueError(
+            f'{accelerator_name}:{accelerator_count:g} not found in {cloud} '
+            f'catalog (region={region}, zone={zone}).')
+    prices = [(e.spot_price if use_spot else e.price) for e in entries]
+    prices = [p for p in prices if p > 0]
+    return min(prices) if prices else 0.0
+
+
+def validate_region_zone(cloud: str, region: Optional[str],
+                         zone: Optional[str]) -> None:
+    common.validate_region_zone(cloud, region, zone)
+
+
+def regions_for(cloud: str) -> List[str]:
+    return sorted({e.region for e in common.load_catalog(cloud)})
+
+
+def zones_for(cloud: str, region: str) -> List[str]:
+    return sorted({
+        e.zone for e in common.load_catalog(cloud) if e.region == region
+    })

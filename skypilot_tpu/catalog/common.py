@@ -1,0 +1,179 @@
+"""Catalog core: offering rows + CSV load/save + query helpers.
+
+Twin of the reference's pandas/CSV catalog (sky/catalog/common.py:30-99,
+sky/catalog/__init__.py:57-357), redesigned:
+
+  * Plain dataclass rows + list comprehensions instead of pandas (the
+    catalogs are a few thousand rows; no heavy dependency needed).
+  * TPU offerings are *generated* from the topology database
+    (`skypilot_tpu/utils/tpu_topology.py`) by the fetcher, so slice shape /
+    host count / HBM are always consistent with what the provisioner and
+    mesh builder will use — in the reference these live in disconnected
+    CSVs patched by hand (sky/catalog/data_fetchers/fetch_gcp.py:48-83).
+
+Catalog files live in ``skypilot_tpu/catalog/data/<cloud>/catalog.csv`` and
+may be refreshed by ``skypilot_tpu/catalog/data_fetchers/fetch_<cloud>.py``
+(offline generators with embedded public price snapshots; the reference
+downloads hosted CSVs instead, sky/catalog/common.py:30).
+"""
+from __future__ import annotations
+
+import csv
+import dataclasses
+import functools
+import os
+from typing import Callable, Dict, List, Optional
+
+_DATA_DIR = os.path.join(os.path.dirname(__file__), 'data')
+
+CSV_FIELDS = [
+    'InstanceType', 'AcceleratorName', 'AcceleratorCount', 'vCPUs',
+    'MemoryGiB', 'AcceleratorMemoryGiB', 'Price', 'SpotPrice', 'Region',
+    'AvailabilityZone'
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class CatalogEntry:
+    """One (instance type | TPU slice) × zone offering."""
+    instance_type: str          # '' for bare TPU-VM slices
+    accelerator_name: str       # '' | 'A100' | 'tpu-v5e-8' (full slice name)
+    accelerator_count: float
+    vcpus: float
+    memory_gib: float
+    accelerator_memory_gib: float  # total HBM of the offering
+    price: float                # $/hr on-demand (whole offering)
+    spot_price: float
+    region: str
+    zone: str
+
+    @property
+    def is_tpu(self) -> bool:
+        return self.accelerator_name.startswith('tpu-')
+
+    def to_row(self) -> Dict[str, str]:
+        return {
+            'InstanceType': self.instance_type,
+            'AcceleratorName': self.accelerator_name,
+            'AcceleratorCount': f'{self.accelerator_count:g}',
+            'vCPUs': f'{self.vcpus:g}',
+            'MemoryGiB': f'{self.memory_gib:g}',
+            'AcceleratorMemoryGiB': f'{self.accelerator_memory_gib:g}',
+            'Price': f'{self.price:.4f}',
+            'SpotPrice': f'{self.spot_price:.4f}',
+            'Region': self.region,
+            'AvailabilityZone': self.zone,
+        }
+
+    @classmethod
+    def from_row(cls, row: Dict[str, str]) -> 'CatalogEntry':
+        return cls(
+            instance_type=row['InstanceType'],
+            accelerator_name=row['AcceleratorName'],
+            accelerator_count=float(row['AcceleratorCount'] or 0),
+            vcpus=float(row['vCPUs'] or 0),
+            memory_gib=float(row['MemoryGiB'] or 0),
+            accelerator_memory_gib=float(row.get('AcceleratorMemoryGiB') or 0),
+            price=float(row['Price'] or 0),
+            spot_price=float(row['SpotPrice'] or 0),
+            region=row['Region'],
+            zone=row['AvailabilityZone'],
+        )
+
+
+def catalog_path(cloud: str) -> str:
+    return os.path.join(_DATA_DIR, cloud, 'catalog.csv')
+
+
+def save_catalog(cloud: str, entries: List[CatalogEntry]) -> str:
+    path = catalog_path(cloud)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.DictWriter(f, fieldnames=CSV_FIELDS)
+        writer.writeheader()
+        for entry in entries:
+            writer.writerow(entry.to_row())
+    return path
+
+
+@functools.lru_cache(maxsize=None)
+def load_catalog(cloud: str) -> List[CatalogEntry]:
+    """Load a cloud's catalog; auto-generate via its offline fetcher if absent."""
+    path = catalog_path(cloud)
+    if not os.path.exists(path):
+        _maybe_generate(cloud)
+    if not os.path.exists(path):
+        return []
+    with open(path, newline='', encoding='utf-8') as f:
+        return [CatalogEntry.from_row(row) for row in csv.DictReader(f)]
+
+
+def _maybe_generate(cloud: str) -> None:
+    try:
+        import importlib
+        fetcher = importlib.import_module(
+            f'skypilot_tpu.catalog.data_fetchers.fetch_{cloud}')
+    except ImportError:
+        return
+    if hasattr(fetcher, 'generate'):
+        save_catalog(cloud, fetcher.generate())
+
+
+def clear_cache() -> None:
+    load_catalog.cache_clear()
+
+
+# --- generic query helpers (used by per-cloud catalog modules) -------------
+
+
+def filter_entries(cloud: str,
+                   predicate: Callable[[CatalogEntry], bool]) -> List[CatalogEntry]:
+    return [e for e in load_catalog(cloud) if predicate(e)]
+
+
+def instance_type_exists(cloud: str, instance_type: str) -> bool:
+    return any(e.instance_type == instance_type for e in load_catalog(cloud))
+
+
+def get_vcpus_mem_from_instance_type(
+        cloud: str, instance_type: str) -> Optional[tuple]:
+    for e in load_catalog(cloud):
+        if e.instance_type == instance_type:
+            return (e.vcpus, e.memory_gib)
+    return None
+
+
+def get_hourly_cost(cloud: str,
+                    instance_type: str,
+                    use_spot: bool,
+                    region: Optional[str] = None,
+                    zone: Optional[str] = None) -> float:
+    candidates = [
+        e for e in load_catalog(cloud)
+        if e.instance_type == instance_type and
+        (region is None or e.region == region) and
+        (zone is None or e.zone == zone)
+    ]
+    if not candidates:
+        raise ValueError(
+            f'Instance type {instance_type!r} not found in {cloud} catalog'
+            f' (region={region}, zone={zone}).')
+    prices = [(e.spot_price if use_spot else e.price) for e in candidates]
+    prices = [p for p in prices if p > 0]
+    if not prices:
+        return 0.0
+    return min(prices)
+
+
+def validate_region_zone(cloud: str, region: Optional[str],
+                         zone: Optional[str]) -> None:
+    entries = load_catalog(cloud)
+    if region is not None and not any(e.region == region for e in entries):
+        regions = sorted({e.region for e in entries})
+        raise ValueError(f'Region {region!r} not found for {cloud}. '
+                         f'Valid: {regions}')
+    if zone is not None and not any(
+            e.zone == zone and (region is None or e.region == region)
+            for e in entries):
+        raise ValueError(f'Zone {zone!r} not found for {cloud}'
+                         f' (region={region}).')

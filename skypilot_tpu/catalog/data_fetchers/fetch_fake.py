@@ -1,0 +1,48 @@
+"""Deterministic catalog for the in-memory 'fake' cloud used in tests.
+
+Plays the role moto plays in the reference's failover tests
+(tests/test_failover.py:34-60): a small, fully offline cloud with multiple
+regions/zones so zone→region→SKU failover logic is exercisable without any
+cloud credentials.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from skypilot_tpu.catalog import common
+
+_ZONES = [
+    ('fake-central1', 'fake-central1-a'),
+    ('fake-central1', 'fake-central1-b'),
+    ('fake-west1', 'fake-west1-a'),
+    ('fake-east1', 'fake-east1-a'),
+]
+
+
+def generate() -> List[common.CatalogEntry]:
+    entries: List[common.CatalogEntry] = []
+    for region, zone in _ZONES:
+        entries.append(
+            common.CatalogEntry('fake-cpu-4', '', 0, 4, 16, 0, 0.10, 0.03,
+                                region, zone))
+        entries.append(
+            common.CatalogEntry('fake-cpu-16', '', 0, 16, 64, 0, 0.40, 0.12,
+                                region, zone))
+        entries.append(
+            common.CatalogEntry('fake-gpu-8', 'FAKEGPU', 8, 96, 680, 320,
+                                20.0, 6.0, region, zone))
+        # TPU twins: single host and a 4-host pod slice.
+        entries.append(
+            common.CatalogEntry('', 'tpu-v5e-8', 1, 112, 192, 128, 9.6, 3.36,
+                                region, zone))
+        entries.append(
+            common.CatalogEntry('', 'tpu-v5e-32', 1, 448, 768, 512, 38.4,
+                                13.44, region, zone))
+        entries.append(
+            common.CatalogEntry('', 'tpu-v5p-64', 1, 208 * 8, 448 * 8,
+                                95.0 * 32, 134.4, 47.04, region, zone))
+    return entries
+
+
+if __name__ == '__main__':
+    print(f'Wrote {common.save_catalog("fake", generate())}')

@@ -1,0 +1,143 @@
+"""Offline GCP catalog generator (twin of sky/catalog/data_fetchers/fetch_gcp.py).
+
+The reference queries the Cloud Billing SKU service (fetch_gcp.py:34-83) and
+hand-patches hidden TPU zones. This build has no egress, so the generator
+embeds a snapshot of public list prices (2025) and *derives* every TPU slice
+offering from the topology database — chips, hosts, HBM and price scale
+consistently with slice size by construction.
+
+Run ``python -m skypilot_tpu.catalog.data_fetchers.fetch_gcp`` to regenerate
+``skypilot_tpu/catalog/data/gcp/catalog.csv``; `load_catalog` also invokes
+:func:`generate` lazily when the CSV is missing.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from skypilot_tpu.catalog import common
+from skypilot_tpu.utils import tpu_topology
+
+# ---- TPU price snapshot: $/chip-hour (on-demand, spot) by generation ------
+# Public list prices, us-central-ish regions. Regions without published v6e
+# pricing get 0.0 like the reference does (examples/tpu/v6e/README.md:7-9).
+_TPU_CHIP_PRICES: Dict[str, Tuple[float, float]] = {
+    'v2': (1.125, 0.3375),
+    'v3': (2.00, 0.60),
+    'v4': (3.22, 0.966),
+    'v5e': (1.20, 0.42),
+    'v5p': (4.20, 1.47),
+    'v6e': (2.70, 0.945),
+}
+
+# Zones where each TPU generation is offered (snapshot).
+_TPU_ZONES: Dict[str, List[str]] = {
+    'v2': ['us-central1-b', 'us-central1-c', 'europe-west4-a'],
+    'v3': ['us-central1-a', 'us-central1-b', 'europe-west4-a'],
+    'v4': ['us-central2-b'],
+    'v5e': [
+        'us-central1-a', 'us-west4-a', 'us-east1-c', 'us-east5-b',
+        'europe-west4-b', 'asia-southeast1-b'
+    ],
+    'v5p': ['us-east5-a', 'us-central2-b', 'europe-west4-b'],
+    'v6e': [
+        'us-central2-b', 'us-east5-b', 'europe-west4-a', 'asia-northeast1-b',
+        'us-south1-a'
+    ],
+}
+
+# Host VM shape fronting each TPU generation (vCPUs, memory GiB) per host.
+# v2/v3 figures match the reference's forced host sizes
+# (sky/clouds/gcp.py:688-739: 96 vCPU / 334 GB; v4: 240/400).
+_TPU_HOST_SPECS: Dict[str, Tuple[float, float]] = {
+    'v2': (96, 334),
+    'v3': (96, 334),
+    'v4': (240, 400),
+    'v5e': (112, 192),
+    'v5p': (208, 448),
+    'v6e': (180, 720),
+}
+
+# ---- GPU / CPU VM snapshot ------------------------------------------------
+# (instance_type, acc_name, acc_count, vcpus, mem, acc_mem_gib, price, spot)
+_GPU_VMS = [
+    ('a2-highgpu-1g', 'A100', 1, 12, 85, 40, 3.673, 1.102),
+    ('a2-highgpu-2g', 'A100', 2, 24, 170, 80, 7.347, 2.204),
+    ('a2-highgpu-4g', 'A100', 4, 48, 340, 160, 14.694, 4.408),
+    ('a2-highgpu-8g', 'A100', 8, 96, 680, 320, 29.387, 8.816),
+    ('a2-ultragpu-1g', 'A100-80GB', 1, 12, 170, 80, 5.069, 1.521),
+    ('a2-ultragpu-8g', 'A100-80GB', 8, 96, 1360, 640, 40.550, 12.165),
+    ('a3-highgpu-8g', 'H100', 8, 208, 1872, 640, 88.249, 26.475),
+    ('g2-standard-4', 'L4', 1, 4, 16, 24, 0.705, 0.212),
+    ('g2-standard-48', 'L4', 4, 48, 192, 96, 3.997, 1.199),
+    ('n1-standard-8-t4', 'T4', 1, 8, 30, 16, 0.730, 0.219),
+    ('n1-standard-8-v100', 'V100', 1, 8, 30, 16, 2.860, 0.858),
+]
+_CPU_VMS = [
+    ('n2-standard-2', 2, 8, 0.0971, 0.0291),
+    ('n2-standard-4', 4, 16, 0.1942, 0.0583),
+    ('n2-standard-8', 8, 32, 0.3885, 0.1165),
+    ('n2-standard-16', 16, 64, 0.7769, 0.2331),
+    ('n2-standard-32', 32, 128, 1.5539, 0.4662),
+    ('n2-highmem-8', 8, 64, 0.5241, 0.1572),
+]
+_VM_ZONES = [
+    'us-central1-a', 'us-central1-b', 'us-central2-b', 'us-west4-a',
+    'us-east1-c', 'us-east5-a', 'us-east5-b', 'europe-west4-a',
+    'europe-west4-b', 'asia-northeast1-b', 'asia-southeast1-b', 'us-south1-a'
+]
+
+
+def _region_of(zone: str) -> str:
+    return zone.rsplit('-', 1)[0]
+
+
+def generate() -> List[common.CatalogEntry]:
+    entries: List[common.CatalogEntry] = []
+
+    # TPU slices: every standard size × every zone for the generation.
+    for gen_name, zones in _TPU_ZONES.items():
+        gen = tpu_topology.GENERATIONS[gen_name]
+        od_chip, spot_chip = _TPU_CHIP_PRICES[gen_name]
+        host_vcpus, host_mem = _TPU_HOST_SPECS[gen_name]
+        for chips in tpu_topology.list_standard_sizes(gen_name):
+            count = chips * gen.cores_per_chip if gen.cores_per_chip > 1 \
+                else chips
+            name = f'tpu-{gen_name}-{count}'
+            topo = tpu_topology.parse(name)
+            for zone in zones:
+                # v6e pricing not published in US central/south regions
+                # (mirrors reference behavior of 0.0 placeholders).
+                od, spot = od_chip * chips, spot_chip * chips
+                if gen_name == 'v6e' and _region_of(zone) in (
+                        'us-central2', 'us-south1'):
+                    od, spot = 0.0, 0.0
+                entries.append(
+                    common.CatalogEntry(
+                        instance_type='',
+                        accelerator_name=name,
+                        accelerator_count=1,
+                        vcpus=host_vcpus * topo.num_hosts,
+                        memory_gib=host_mem * topo.num_hosts,
+                        accelerator_memory_gib=topo.hbm_gib,
+                        price=od,
+                        spot_price=spot,
+                        region=_region_of(zone),
+                        zone=zone,
+                    ))
+
+    for (itype, acc, n, vcpus, mem, acc_mem, price, spot) in _GPU_VMS:
+        for zone in _VM_ZONES:
+            entries.append(
+                common.CatalogEntry(itype, acc, n, vcpus, mem, acc_mem, price,
+                                    spot, _region_of(zone), zone))
+    for (itype, vcpus, mem, price, spot) in _CPU_VMS:
+        for zone in _VM_ZONES:
+            entries.append(
+                common.CatalogEntry(itype, '', 0, vcpus, mem, 0, price, spot,
+                                    _region_of(zone), zone))
+    return entries
+
+
+if __name__ == '__main__':
+    path = common.save_catalog('gcp', generate())
+    print(f'Wrote {path}')

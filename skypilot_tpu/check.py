@@ -1,0 +1,70 @@
+"""Credential probing / enabled-cloud gating (twin of sky/check.py:53).
+
+`get_cached_enabled_clouds` is the single source the optimizer consults.
+The Fake cloud (tests/demos) is only enabled when XSKY_ENABLE_FAKE_CLOUD=1
+so it never shadows real clouds in normal use.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import clouds as _clouds  # registers clouds  # noqa: F401
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import registry
+
+_lock = threading.Lock()
+_cached: Optional[List[str]] = None
+
+
+def _fake_cloud_enabled() -> bool:
+    return os.environ.get('XSKY_ENABLE_FAKE_CLOUD', '0') == '1'
+
+
+def check_capabilities(
+        quiet: bool = False) -> Dict[str, Tuple[bool, Optional[str]]]:
+    """Probe every registered cloud's credentials."""
+    results: Dict[str, Tuple[bool, Optional[str]]] = {}
+    for cloud in registry.CLOUD_REGISTRY.values():
+        if cloud.name == 'fake' and not _fake_cloud_enabled():
+            results[cloud.name] = (False, 'fake cloud disabled '
+                                   '(set XSKY_ENABLE_FAKE_CLOUD=1)')
+            continue
+        try:
+            ok, reason = cloud.check_credentials()
+        except Exception as e:  # pylint: disable=broad-except
+            ok, reason = False, str(e)
+        results[cloud.name] = (ok, reason)
+    return results
+
+
+def refresh_enabled_clouds() -> List[str]:
+    global _cached
+    with _lock:
+        _cached = [name for name, (ok, _) in check_capabilities().items()
+                   if ok]
+        return list(_cached)
+
+
+def get_cached_enabled_clouds() -> List[str]:
+    if _cached is None:
+        return refresh_enabled_clouds()
+    return list(_cached)
+
+
+def get_cached_enabled_clouds_or_refresh(
+        raise_if_no_cloud_access: bool = False) -> List[str]:
+    clouds = get_cached_enabled_clouds()
+    if not clouds:
+        clouds = refresh_enabled_clouds()
+    if raise_if_no_cloud_access and not clouds:
+        raise exceptions.NoCloudAccessError(
+            'No cloud is enabled. Configure credentials and run `xsky check`.')
+    return clouds
+
+
+def set_enabled_clouds_for_test(clouds: Optional[List[str]]) -> None:
+    global _cached
+    with _lock:
+        _cached = list(clouds) if clouds is not None else None

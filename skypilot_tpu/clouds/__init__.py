@@ -1,0 +1,8 @@
+"""Cloud implementations. Importing this package registers all clouds."""
+from skypilot_tpu.clouds.cloud import Cloud
+from skypilot_tpu.clouds.cloud import CloudImplementationFeatures
+from skypilot_tpu.clouds.cloud import Region
+from skypilot_tpu.clouds.fake import Fake
+from skypilot_tpu.clouds.gcp import GCP
+
+__all__ = ['Cloud', 'CloudImplementationFeatures', 'Region', 'GCP', 'Fake']

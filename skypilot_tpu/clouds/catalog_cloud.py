@@ -1,0 +1,186 @@
+"""Catalog-backed feasibility shared by concrete clouds.
+
+The reference re-implements feasibility per cloud against pandas frames
+(sky/clouds/gcp.py etc.); here the logic is factored once over
+``CatalogEntry`` rows, and concrete clouds only override cloud-specific
+bits (deploy variables, credentials, feature limits).
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import tpu_topology
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+def _spec_ok(spec: Optional[str], actual: float) -> bool:
+    """Check a '4' / '4+' / None cpus-or-memory spec against a value."""
+    if spec is None:
+        return True
+    s = str(spec).strip()
+    if s.endswith('+'):
+        return actual >= float(s[:-1])
+    return actual == float(s)
+
+
+class CatalogCloud(cloud_lib.Cloud):
+    """Cloud whose offerings come entirely from its catalog CSV."""
+
+    def _entries(self) -> List[catalog.CatalogEntry]:
+        return catalog.common.load_catalog(self.name)
+
+    # ---- placement ----
+
+    def regions_with_offering(self, instance_type: str,
+                              accelerators: Optional[Dict[str, Any]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[cloud_lib.Region]:
+        entries = self._match_entries(instance_type, accelerators, region,
+                                      zone)
+        if use_spot:
+            entries = [e for e in entries if e.spot_price > 0]
+        by_region: Dict[str, List[str]] = {}
+        for e in entries:
+            by_region.setdefault(e.region, [])
+            if e.zone not in by_region[e.region]:
+                by_region[e.region].append(e.zone)
+        return [
+            cloud_lib.Region(r, sorted(zs)) for r, zs in sorted(
+                by_region.items(),
+                key=lambda kv: min((e.spot_price if use_spot else e.price)
+                                   for e in entries if e.region == kv[0]))
+        ]
+
+    def zones_provision_loop(self, region: str, num_nodes: int,
+                             instance_type: str,
+                             accelerators: Optional[Dict[str, Any]] = None,
+                             use_spot: bool = False) -> Iterator[List[str]]:
+        for r in self.regions_with_offering(instance_type, accelerators,
+                                            use_spot, region, None):
+            for z in r.zones:
+                yield [z]
+
+    def _match_entries(self, instance_type: str,
+                       accelerators: Optional[Dict[str, Any]],
+                       region: Optional[str],
+                       zone: Optional[str]) -> List[catalog.CatalogEntry]:
+        out = []
+        acc_item: Optional[Tuple[str, float]] = None
+        if accelerators:
+            acc_item = next(iter(accelerators.items()))
+        for e in self._entries():
+            if instance_type and e.instance_type != instance_type:
+                continue
+            if acc_item is not None:
+                name, count = acc_item
+                if e.accelerator_name.lower() != name.lower():
+                    continue
+                if e.accelerator_count != count:
+                    continue
+            if region is not None and e.region != region:
+                continue
+            if zone is not None and e.zone != zone:
+                continue
+            out.append(e)
+        return out
+
+    # ---- default instance type ----
+
+    _DEFAULT_CPUS = '4+'
+
+    def get_default_instance_type(
+            self, cpus: Optional[str] = None,
+            memory: Optional[str] = None) -> Optional[str]:
+        cpus = cpus or self._DEFAULT_CPUS
+        candidates = [
+            e for e in self._entries()
+            if not e.accelerator_name and e.instance_type and
+            _spec_ok(cpus, e.vcpus) and _spec_ok(memory, e.memory_gib)
+        ]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda e: e.price).instance_type
+
+    # ---- feasibility ----
+
+    def get_feasible_launchable_resources(
+        self, resources: 'resources_lib.Resources'
+    ) -> Tuple[List['resources_lib.Resources'], List[str]]:
+        acc = resources.accelerators  # normalized {name: count} or None
+        fuzzy: List[str] = []
+        candidates: List['resources_lib.Resources'] = []
+
+        if acc is None:
+            if resources.instance_type:
+                if self.instance_type_exists(resources.instance_type):
+                    candidates = [resources.copy(cloud=self.name)]
+            else:
+                default = self.get_default_instance_type(
+                    resources.cpus, resources.memory)
+                if default is not None:
+                    candidates = [
+                        resources.copy(cloud=self.name, instance_type=default)
+                    ]
+            return self._finish(resources, candidates), fuzzy
+
+        name, count = next(iter(acc.items()))
+        entries = self._match_entries('', {name: count}, resources.region,
+                                      resources.zone)
+        if not entries:
+            # Fuzzy hints: for TPUs match on the generation prefix so
+            # 'tpu-v5e-16' suggests the sizes this cloud actually offers.
+            needle = name.lower().split(':')[0]
+            if tpu_topology.is_tpu(needle):
+                needle = needle.rsplit('-', 1)[0]
+            seen = set()
+            for e in self._entries():
+                if e.accelerator_name and needle in \
+                        e.accelerator_name.lower():
+                    key = f'{e.accelerator_name}:{e.accelerator_count:g}'
+                    if key not in seen:
+                        seen.add(key)
+                        fuzzy.append(key)
+            return [], sorted(fuzzy)
+
+        # Respect cpus/memory specs for accelerator-bearing instance types.
+        entries = [
+            e for e in entries if _spec_ok(resources.cpus, e.vcpus) and
+            _spec_ok(resources.memory, e.memory_gib)
+        ]
+        seen_itypes = set()
+        for e in sorted(entries, key=lambda e: (e.price == 0, e.price)):
+            if e.instance_type in seen_itypes:
+                continue
+            seen_itypes.add(e.instance_type)
+            candidates.append(
+                resources.copy(cloud=self.name,
+                               instance_type=e.instance_type or None))
+        return self._finish(resources, candidates), fuzzy
+
+    def _finish(self, request, candidates):
+        if request.use_spot:
+            # Offerings without a spot price cannot be launched as spot.
+            kept = []
+            for c in candidates:
+                try:
+                    price = c.get_hourly_cost()
+                except ValueError:
+                    continue
+                kept.append(c)
+            candidates = kept
+        return candidates
+
+    # ---- TPU helpers ----
+
+    def tpu_topology_of(self, resources) -> Optional[tpu_topology.SliceTopology]:
+        if resources.accelerators is None:
+            return None
+        name = next(iter(resources.accelerators))
+        if not tpu_topology.is_tpu(name):
+            return None
+        return tpu_topology.parse(name, resources.accelerator_args)

@@ -1,0 +1,178 @@
+"""Cloud abstract base (twin of sky/clouds/cloud.py:136).
+
+A Cloud answers: what can you run (feature negotiation), where
+(regions/zones with an offering), for how much (via catalog), and how
+(deploy variables handed to the provisioner). Credential checking gates
+whether the optimizer may consider the cloud at all.
+"""
+from __future__ import annotations
+
+import enum
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+class CloudImplementationFeatures(enum.Enum):
+    """Features a task may require; clouds declare what they cannot do.
+
+    (Twin of sky/clouds/cloud.py:32.)
+    """
+    MULTI_NODE = 'multi_node'
+    SPOT_INSTANCE = 'spot_instance'
+    AUTOSTOP = 'autostop'
+    STOP = 'stop'
+    OPEN_PORTS = 'open_ports'
+    STORAGE_MOUNTING = 'storage_mounting'
+    IMAGE_ID = 'image_id'
+    CUSTOM_DISK_TIER = 'custom_disk_tier'
+    HOST_CONTROLLERS = 'host_controllers'
+    TPU_POD = 'tpu_pod'
+    TPU_MULTISLICE = 'tpu_multislice'
+
+
+class Region:
+
+    def __init__(self, name: str, zones: Optional[List[str]] = None) -> None:
+        self.name = name
+        self.zones = zones or []
+
+    def __repr__(self) -> str:
+        return f'Region({self.name!r}, zones={self.zones})'
+
+
+class Cloud:
+    """Subclass and register with ``@registry.CLOUD_REGISTRY.register()``."""
+
+    _REGISTER_INSTANCE = True
+    _REPR = 'Cloud'
+    # Max cluster name length the cloud's resource naming allows.
+    _MAX_CLUSTER_NAME_LEN_LIMIT: Optional[int] = None
+
+    # ---- identity ----
+
+    @property
+    def name(self) -> str:
+        return self._REPR.lower()
+
+    def __repr__(self) -> str:
+        return self._REPR
+
+    # ---- feature negotiation ----
+
+    def unsupported_features_for_resources(
+        self, resources: 'resources_lib.Resources'
+    ) -> Dict[CloudImplementationFeatures, str]:
+        """feature → human reason, for features this cloud cannot provide
+        for these specific resources (e.g. STOP on a multi-host TPU slice).
+        """
+        return {}
+
+    @classmethod
+    def check_features_are_supported(
+            cls, resources: 'resources_lib.Resources',
+            requested_features: Set[CloudImplementationFeatures]) -> None:
+        self = registry.CLOUD_REGISTRY.from_str(cls._REPR)
+        unsupported = self.unsupported_features_for_resources(resources)
+        hit = {f: r for f, r in unsupported.items() if f in requested_features}
+        if hit:
+            reasons = '; '.join(f'{f.value}: {r}' for f, r in hit.items())
+            raise exceptions.NotSupportedError(
+                f'{cls._REPR} does not support {reasons}')
+
+    # ---- placement ----
+
+    def regions_with_offering(self, instance_type: str,
+                              accelerators: Optional[Dict[str, Any]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[Region]:
+        """Regions (with zone lists) that offer the requested hardware."""
+        raise NotImplementedError
+
+    def zones_provision_loop(self, region: str,
+                             num_nodes: int,
+                             instance_type: str,
+                             accelerators: Optional[Dict[str, Any]] = None,
+                             use_spot: bool = False) -> Iterator[List[str]]:
+        """Yield zone batches to try within a region (one zone at a time)."""
+        raise NotImplementedError
+
+    # ---- pricing ----
+
+    def instance_type_to_hourly_cost(self, instance_type: str, use_spot: bool,
+                                     region: Optional[str] = None,
+                                     zone: Optional[str] = None) -> float:
+        return catalog.get_hourly_cost(self.name, instance_type, use_spot,
+                                       region, zone)
+
+    def accelerators_to_hourly_cost(self, accelerators: Dict[str, float],
+                                    use_spot: bool,
+                                    region: Optional[str] = None,
+                                    zone: Optional[str] = None) -> float:
+        total = 0.0
+        for name, count in accelerators.items():
+            total += catalog.get_accelerator_hourly_cost(
+                self.name, name, count, use_spot, region, zone)
+        return total
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        return 0.0
+
+    # ---- feasibility (optimizer entry point) ----
+
+    def get_feasible_launchable_resources(
+        self, resources: 'resources_lib.Resources'
+    ) -> Tuple[List['resources_lib.Resources'], List[str]]:
+        """Concrete launchable candidates for a (possibly partial) request.
+
+        Returns (candidates sorted cheapest-first, fuzzy-match hints).
+        Twin of sky/clouds/cloud.py:394.
+        """
+        raise NotImplementedError
+
+    # ---- provisioner handoff ----
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        """Variables consumed by this cloud's provisioner module."""
+        raise NotImplementedError
+
+    @property
+    def provisioner_module(self) -> str:
+        """Module name under skypilot_tpu.provision implementing the op-set."""
+        return self.name
+
+    # ---- credentials ----
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        """(ok, reason-if-not). Twin of sky/clouds/cloud.py:463."""
+        raise NotImplementedError
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        """remote path → local path of credential files to ship."""
+        return {}
+
+    # ---- misc ----
+
+    def max_cluster_name_length(self) -> Optional[int]:
+        return self._MAX_CLUSTER_NAME_LEN_LIMIT
+
+    def instance_type_exists(self, instance_type: str) -> bool:
+        return catalog.common.instance_type_exists(self.name, instance_type)
+
+    def validate_region_zone(self, region: Optional[str],
+                             zone: Optional[str]) -> None:
+        catalog.validate_region_zone(self.name, region, zone)
+
+    def get_default_instance_type(
+            self,
+            cpus: Optional[str] = None,
+            memory: Optional[str] = None) -> Optional[str]:
+        raise NotImplementedError

@@ -1,0 +1,45 @@
+"""In-memory 'fake' cloud for tests (credential-free, always enabled).
+
+Backed by the deterministic catalog in
+catalog/data_fetchers/fetch_fake.py and the in-memory provisioner in
+provision/fake/. Together they play the role of moto in the reference's
+failover tests (tests/test_failover.py:34-60).
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import catalog_cloud
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@registry.CLOUD_REGISTRY.register()
+class Fake(catalog_cloud.CatalogCloud):
+    _REPR = 'Fake'
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        vars: Dict[str, Any] = {
+            'cluster_name': cluster_name,
+            'region': region,
+            'zone': zone,
+            'instance_type': resources.instance_type,
+            'use_spot': resources.use_spot,
+        }
+        topo = self.tpu_topology_of(resources)
+        if topo is not None:
+            vars.update({
+                'tpu_vm': True,
+                'tpu_num_hosts': topo.num_hosts,
+                'tpu_chips_per_host': topo.chips_per_host,
+                'tpu_num_slices': topo.num_slices,
+            })
+        return vars
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        return True, None

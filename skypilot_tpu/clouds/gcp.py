@@ -1,0 +1,106 @@
+"""GCP cloud with TPU slices as first-class offerings.
+
+Twin of sky/clouds/gcp.py (TPU deploy vars :495-527, stop-unsupported for
+TPU pods :216-226), redesigned: instead of forcing host vCPU/mem overrides
+onto a VM abstraction (sky/clouds/gcp.py:688-739), TPU slices are their own
+catalog rows whose host layout comes from the topology database.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import catalog_cloud
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+from skypilot_tpu.utils import tpu_topology
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+_Features = cloud_lib.CloudImplementationFeatures
+
+DEFAULT_CREDENTIAL_PATHS = (
+    '~/.config/gcloud/application_default_credentials.json',
+    os.environ.get('GOOGLE_APPLICATION_CREDENTIALS', ''),
+)
+
+
+@registry.CLOUD_REGISTRY.register(aliases=['google'])
+class GCP(catalog_cloud.CatalogCloud):
+    _REPR = 'GCP'
+    _MAX_CLUSTER_NAME_LEN_LIMIT = 35  # TPU node names are length-limited
+
+    def unsupported_features_for_resources(
+        self, resources: 'resources_lib.Resources'
+    ) -> Dict[_Features, str]:
+        unsupported: Dict[_Features, str] = {}
+        topo = self.tpu_topology_of(resources)
+        if topo is not None:
+            if topo.is_pod or topo.is_multislice:
+                # Multi-host TPU slices cannot be stopped, only deleted
+                # (reference: sky/clouds/gcp.py:216-226).
+                unsupported[_Features.STOP] = (
+                    'Multi-host TPU slices cannot be stopped, only torn down.')
+                unsupported[_Features.AUTOSTOP] = (
+                    'Autostop on multi-host TPU slices performs teardown '
+                    'instead of stop.')
+        return unsupported
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        vars: Dict[str, Any] = {
+            'cluster_name': cluster_name,
+            'region': region,
+            'zone': zone,
+            'instance_type': resources.instance_type,
+            'use_spot': resources.use_spot,
+            'disk_size': resources.disk_size,
+            'ports': resources.ports,
+            'labels': dict(resources.labels or {}),
+            'image_id': resources.image_id,
+        }
+        topo = self.tpu_topology_of(resources)
+        if topo is not None:
+            args = resources.accelerator_args or {}
+            vars.update({
+                'tpu_vm': True,
+                'tpu_accelerator_type': topo.gcp_accelerator_type(),
+                'tpu_topology': topo.topology_str,
+                'tpu_runtime_version': topo.runtime_version(
+                    args.get('runtime_version')),
+                'tpu_num_slices': topo.num_slices,
+                'tpu_num_hosts': topo.num_hosts,
+                'tpu_chips_per_host': topo.chips_per_host,
+                # Queued resources are the modern capacity-request path
+                # (absent from the reference; greenfield per SURVEY §2.3).
+                'tpu_use_queued_resources': bool(
+                    args.get('use_queued_resources', topo.is_multislice)),
+            })
+        elif resources.accelerators:
+            name, count = next(iter(resources.accelerators.items()))
+            vars.update({'gpu_type': name, 'gpu_count': count})
+        return vars
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        for path in DEFAULT_CREDENTIAL_PATHS:
+            if path and os.path.exists(os.path.expanduser(path)):
+                return True, None
+        return False, (
+            'GCP credentials not found. Run `gcloud auth application-default '
+            'login`, or set GOOGLE_APPLICATION_CREDENTIALS.')
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        mounts = {}
+        for path in DEFAULT_CREDENTIAL_PATHS:
+            if path and os.path.exists(os.path.expanduser(path)):
+                mounts[path] = path
+        return mounts
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        # Simplified tiered egress pricing (reference models this per cloud).
+        if num_gigabytes <= 0:
+            return 0.0
+        return 0.12 * num_gigabytes

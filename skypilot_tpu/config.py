@@ -1,0 +1,152 @@
+"""Layered YAML configuration (twin of sky/skypilot_config.py:88-113).
+
+Layering, lowest precedence first:
+  1. server config   (``/etc/xsky/config.yaml`` or $XSKY_SERVER_CONFIG)
+  2. user config     (``~/.xsky/config.yaml`` or $XSKY_CONFIG)
+  3. project config  (``.xsky.yaml`` in CWD)
+  4. task overrides  (``config:`` section of a task YAML / SDK kwargs)
+
+Dict values merge recursively; scalars and lists override wholesale (matching
+the reference's override semantics). Access is by dotted path via
+:func:`get_nested`. An override context manager supports the API server's
+per-request config isolation (reference: sky/server/requests/executor.py:244).
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import copy
+import os
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import yaml
+
+from skypilot_tpu import exceptions
+
+ENV_VAR_USER_CONFIG = 'XSKY_CONFIG'
+ENV_VAR_SERVER_CONFIG = 'XSKY_SERVER_CONFIG'
+USER_CONFIG_PATH = '~/.xsky/config.yaml'
+SERVER_CONFIG_PATH = '/etc/xsky/config.yaml'
+PROJECT_CONFIG_NAME = '.xsky.yaml'
+
+_lock = threading.Lock()
+_loaded = False
+_base_config: Dict[str, Any] = {}
+
+# Per-request overlay (API server isolates each request's config).
+_override_config: contextvars.ContextVar[Optional[Dict[str, Any]]] = (
+    contextvars.ContextVar('xsky_config_override', default=None))
+
+
+def _load_yaml_file(path: str) -> Dict[str, Any]:
+    path = os.path.expanduser(path)
+    if not os.path.exists(path):
+        return {}
+    with open(path, 'r', encoding='utf-8') as f:
+        try:
+            content = yaml.safe_load(f)
+        except yaml.YAMLError as e:
+            raise exceptions.InvalidSkyTpuConfigError(
+                f'Invalid YAML in {path}: {e}') from e
+    if content is None:
+        return {}
+    if not isinstance(content, dict):
+        raise exceptions.InvalidSkyTpuConfigError(
+            f'Config {path} must be a YAML mapping, got '
+            f'{type(content).__name__}.')
+    return content
+
+
+def merge_dicts(base: Dict[str, Any], override: Dict[str, Any]) -> Dict[str, Any]:
+    """Recursive dict merge; non-dict values in `override` win wholesale."""
+    result = copy.deepcopy(base)
+    for key, value in override.items():
+        if (key in result and isinstance(result[key], dict) and
+                isinstance(value, dict)):
+            result[key] = merge_dicts(result[key], value)
+        else:
+            result[key] = copy.deepcopy(value)
+    return result
+
+
+def _layer_paths() -> List[str]:
+    return [
+        os.environ.get(ENV_VAR_SERVER_CONFIG, SERVER_CONFIG_PATH),
+        os.environ.get(ENV_VAR_USER_CONFIG, USER_CONFIG_PATH),
+        os.path.join(os.getcwd(), PROJECT_CONFIG_NAME),
+    ]
+
+
+def reload_config() -> None:
+    global _base_config, _loaded
+    with _lock:
+        config: Dict[str, Any] = {}
+        for path in _layer_paths():
+            config = merge_dicts(config, _load_yaml_file(path))
+        _base_config = config
+        _loaded = True
+
+
+def _effective() -> Dict[str, Any]:
+    if not _loaded:
+        reload_config()
+    override = _override_config.get()
+    if override:
+        return merge_dicts(_base_config, override)
+    return _base_config
+
+
+def to_dict() -> Dict[str, Any]:
+    return copy.deepcopy(_effective())
+
+
+def get_nested(keys: Tuple[str, ...],
+               default_value: Any = None,
+               override_configs: Optional[Dict[str, Any]] = None) -> Any:
+    """Get a dotted-path config value, e.g. ``get_nested(('gcp', 'project_id'))``."""
+    config = _effective()
+    if override_configs:
+        config = merge_dicts(config, override_configs)
+    cur: Any = config
+    for key in keys:
+        if not isinstance(cur, dict) or key not in cur:
+            return default_value
+        cur = cur[key]
+    return cur
+
+
+def set_nested(keys: Tuple[str, ...], value: Any) -> Dict[str, Any]:
+    """Return a copy of the effective config with keys set to value."""
+    config = to_dict()
+    cur = config
+    for key in keys[:-1]:
+        cur = cur.setdefault(key, {})
+    cur[keys[-1]] = value
+    return config
+
+
+@contextlib.contextmanager
+def override(config_overrides: Optional[Dict[str, Any]]) -> Iterator[None]:
+    """Apply per-request overrides for the current (async) context."""
+    existing = _override_config.get() or {}
+    merged = merge_dicts(existing, config_overrides or {})
+    token = _override_config.set(merged)
+    try:
+        yield
+    finally:
+        _override_config.reset(token)
+
+
+@contextlib.contextmanager
+def replace_for_test(config: Dict[str, Any]) -> Iterator[None]:
+    """Testing hook: wholesale-replace the base config."""
+    global _base_config, _loaded
+    with _lock:
+        saved, saved_loaded = _base_config, _loaded
+        _base_config, _loaded = copy.deepcopy(config), True
+    try:
+        yield
+    finally:
+        with _lock:
+            _base_config, _loaded = saved, saved_loaded

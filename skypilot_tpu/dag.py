@@ -1,0 +1,103 @@
+"""Dag: a DAG of Tasks (twin of sky/dag.py:11).
+
+Implemented without networkx — adjacency dicts are all the optimizer needs,
+and it keeps the core dependency-free.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set
+
+from skypilot_tpu import task as task_lib
+
+_dag_stack = threading.local()
+
+
+class Dag:
+
+    def __init__(self, name: Optional[str] = None) -> None:
+        self.name = name
+        self.tasks: List[task_lib.Task] = []
+        self._downstream: Dict[task_lib.Task, List[task_lib.Task]] = {}
+        self._upstream: Dict[task_lib.Task, List[task_lib.Task]] = {}
+
+    # ---- graph construction ----
+
+    def add(self, task: task_lib.Task) -> None:
+        if task not in self._downstream:
+            self.tasks.append(task)
+            self._downstream[task] = []
+            self._upstream[task] = []
+
+    def remove(self, task: task_lib.Task) -> None:
+        self.tasks.remove(task)
+        for neighbors in (self._downstream, self._upstream):
+            neighbors.pop(task, None)
+            for lst in neighbors.values():
+                if task in lst:
+                    lst.remove(task)
+
+    def add_edge(self, op1: task_lib.Task, op2: task_lib.Task) -> None:
+        self.add(op1)
+        self.add(op2)
+        if op2 not in self._downstream[op1]:
+            self._downstream[op1].append(op2)
+            self._upstream[op2].append(op1)
+
+    def downstream(self, task: task_lib.Task) -> List[task_lib.Task]:
+        return list(self._downstream.get(task, []))
+
+    def upstream(self, task: task_lib.Task) -> List[task_lib.Task]:
+        return list(self._upstream.get(task, []))
+
+    # ---- queries ----
+
+    def is_chain(self) -> bool:
+        """Linear chain check (twin of sky/dag.py:58)."""
+        if len(self.tasks) <= 1:
+            return True
+        return all(len(self._downstream[t]) <= 1 and
+                   len(self._upstream[t]) <= 1 for t in self.tasks)
+
+    def topological_order(self) -> List[task_lib.Task]:
+        in_deg = {t: len(self._upstream[t]) for t in self.tasks}
+        queue = [t for t in self.tasks if in_deg[t] == 0]
+        order: List[task_lib.Task] = []
+        while queue:
+            t = queue.pop(0)
+            order.append(t)
+            for d in self._downstream[t]:
+                in_deg[d] -= 1
+                if in_deg[d] == 0:
+                    queue.append(d)
+        if len(order) != len(self.tasks):
+            raise ValueError('Dag has a cycle.')
+        return order
+
+    def validate(self) -> None:
+        self.topological_order()
+
+    # ---- context manager (with sky.Dag() as dag: ...) ----
+
+    def __enter__(self) -> 'Dag':
+        stack = getattr(_dag_stack, 'stack', None)
+        if stack is None:
+            stack = _dag_stack.stack = []
+        stack.append(self)
+        return self
+
+    def __exit__(self, *args) -> None:
+        _dag_stack.stack.pop()
+
+    def __len__(self) -> int:
+        return len(self.tasks)
+
+    def __repr__(self) -> str:
+        return f'Dag({self.name or "<unnamed>"}, tasks={len(self.tasks)})'
+
+
+def get_current_dag() -> Optional[Dag]:
+    stack = getattr(_dag_stack, 'stack', None)
+    if stack:
+        return stack[-1]
+    return None

@@ -1,0 +1,229 @@
+"""Exception hierarchy for skypilot_tpu.
+
+Twin of the reference's ``sky/exceptions.py`` (ResourcesUnavailableError /
+failover family), redesigned around TPU provisioning semantics: capacity
+stockouts, queued-resource timeouts and slice-health failures are first-class.
+
+All exceptions are picklable so they can cross the client/API-server boundary
+(reference: sky/exceptions.py serializes exceptions for the request DB).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class SkyTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+# --- Resource resolution / optimizer ---------------------------------------
+
+
+class ResourcesUnavailableError(SkyTpuError):
+    """No cloud/zone can currently satisfy the resource request.
+
+    Carries ``failover_history`` so the failover engine (backends/failover.py)
+    and managed-jobs recovery can inspect what was already tried.
+    """
+
+    def __init__(self, message: str = '',
+                 no_failover: bool = False,
+                 failover_history: Optional[List[Exception]] = None) -> None:
+        super().__init__(message)
+        self.no_failover = no_failover
+        self.failover_history: List[Exception] = failover_history or []
+
+    def with_failover_history(
+            self, history: List[Exception]) -> 'ResourcesUnavailableError':
+        self.failover_history = history
+        return self
+
+
+class ResourcesMismatchError(SkyTpuError):
+    """Requested resources do not match the existing cluster's resources."""
+
+
+class NoCloudAccessError(SkyTpuError):
+    """No cloud is enabled (credentials missing for all clouds)."""
+
+
+class NotSupportedError(SkyTpuError):
+    """The operation is not supported (e.g. stop on a TPU pod slice)."""
+
+
+class InvalidSkyTpuConfigError(SkyTpuError):
+    """Config file failed schema validation."""
+
+
+# --- Provisioning / failover taxonomy --------------------------------------
+# The failover engine classifies provisioning failures into these buckets to
+# decide the retry scope (twin of the reference's FailoverCloudErrorHandlerV2,
+# sky/backends/cloud_vm_ray_backend.py:876, re-architected as typed errors
+# instead of per-cloud log-string parsing).
+
+
+class ProvisionError(SkyTpuError):
+    """Base class for provisioning failures; carries blocked scope."""
+
+
+class CapacityError(ProvisionError):
+    """Out of capacity (TPU STOCKOUT / GPU zonal exhaustion).
+
+    Retry scope: next zone, then region, then next-cheapest SKU.
+    """
+
+
+class QuotaExceededError(ProvisionError):
+    """Project quota exhausted: block the (cloud, region, SKU) for this run."""
+
+
+class PermissionError_(ProvisionError):
+    """IAM / API-not-enabled errors: block the whole cloud for this run."""
+
+
+class InvalidRequestError(ProvisionError):
+    """Malformed request (bad runtime version, bad topology): do not retry."""
+
+
+class QueuedResourceTimeoutError(ProvisionError):
+    """A TPU queued-resource request did not become ACTIVE within deadline."""
+
+
+class ClusterOwnerIdentityMismatchError(SkyTpuError):
+    """Cluster was created by a different cloud identity."""
+
+
+# --- Cluster / job lifecycle ------------------------------------------------
+
+
+class ClusterNotUpError(SkyTpuError):
+    """Operation requires an UP cluster."""
+
+    def __init__(self, message: str = '', cluster_status=None,
+                 handle=None) -> None:
+        super().__init__(message)
+        self.cluster_status = cluster_status
+        self.handle = handle
+
+
+class ClusterDoesNotExist(SkyTpuError):
+    """Named cluster not found in the state DB."""
+
+
+class ClusterSetUpError(SkyTpuError):
+    """Setup commands failed on the cluster."""
+
+
+class CommandError(SkyTpuError):
+    """A remote command exited non-zero."""
+
+    def __init__(self, returncode: int, command: str, error_msg: str = '',
+                 detailed_reason: Optional[str] = None) -> None:
+        self.returncode = returncode
+        self.command = command
+        self.error_msg = error_msg
+        self.detailed_reason = detailed_reason
+        if len(command) > 100:
+            command = command[:100] + '...'
+        super().__init__(
+            f'Command {command} failed with return code {returncode}.'
+            f' {error_msg}')
+
+
+class JobExitNonZeroError(SkyTpuError):
+    """User job exited with a non-zero code."""
+
+
+class GangSchedulingError(SkyTpuError):
+    """Not all hosts of a slice could start the job (all-or-nothing)."""
+
+
+class SliceUnhealthyError(SkyTpuError):
+    """TPU slice reported unhealthy (preempted host, ICI failure)."""
+
+
+# --- Storage ---------------------------------------------------------------
+
+
+class StorageError(SkyTpuError):
+    pass
+
+
+class StorageBucketCreateError(StorageError):
+    pass
+
+
+class StorageBucketGetError(StorageError):
+    pass
+
+
+class StorageBucketDeleteError(StorageError):
+    pass
+
+
+class StorageUploadError(StorageError):
+    pass
+
+
+class StorageModeError(StorageError):
+    pass
+
+
+class StorageSpecError(StorageError):
+    pass
+
+
+# --- Serve / jobs ----------------------------------------------------------
+
+
+class ServeUserTerminatedError(SkyTpuError):
+    pass
+
+
+class ManagedJobReachedMaxRetriesError(SkyTpuError):
+    pass
+
+
+class ManagedJobStatusError(SkyTpuError):
+    pass
+
+
+# --- API server ------------------------------------------------------------
+
+
+class ApiServerConnectionError(SkyTpuError):
+
+    def __init__(self, server_url: str) -> None:
+        super().__init__(
+            f'Could not connect to API server at {server_url}. '
+            'Start one with `xsky api start`.')
+
+
+class RequestCancelled(SkyTpuError):
+    pass
+
+
+class UserRequestRejectedByPolicy(SkyTpuError):
+    """Admin policy rejected the request."""
+
+
+def serialize_exception(e: Exception) -> dict:
+    """Serialize an exception for transport across the server boundary."""
+    return {
+        'type': type(e).__name__,
+        'message': str(e),
+        'args': [repr(a) for a in getattr(e, 'args', ())],
+    }
+
+
+def deserialize_exception(payload: dict) -> Exception:
+    """Best-effort reconstruction of a serialized exception."""
+    exc_type = payload.get('type', 'SkyTpuError')
+    message = payload.get('message', '')
+    cls = globals().get(exc_type, SkyTpuError)
+    try:
+        if isinstance(cls, type) and issubclass(cls, Exception):
+            return cls(message)
+    except TypeError:
+        pass
+    return SkyTpuError(f'{exc_type}: {message}')

@@ -1,0 +1,279 @@
+"""Optimizer: pick cheapest/fastest feasible resources per task.
+
+Twin of sky/optimizer.py:71 (optimize:109, _optimize_by_dp:429,
+_optimize_by_ilp:490, _fill_in_launchable_resources:1256), with one
+architectural change: the ILP (reference uses pulp) is replaced by an exact
+enumerator for small DAGs plus coordinate-descent refinement for large ones —
+dependency-free and exact for every DAG the reference's own tests exercise.
+
+The GPU→TPU failover north star lives here: a request for A100s yields TPU
+candidates too (both are catalog offerings), cost-ranked together, so the
+failover engine naturally falls from GPUs onto TPU slices when blocked.
+"""
+from __future__ import annotations
+
+import collections
+import enum
+import itertools
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from skypilot_tpu import check as check_lib
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.utils import registry
+
+logger = sky_logging.init_logger(__name__)
+
+_DEFAULT_RUNTIME_ESTIMATE_S = 3600.0
+# DAGs up to this many assignment combinations are solved exactly.
+_EXACT_SEARCH_LIMIT = 200_000
+
+
+class OptimizeTarget(enum.Enum):
+    COST = 'cost'
+    TIME = 'time'
+
+
+class Optimizer:
+
+    @staticmethod
+    def optimize(dag: dag_lib.Dag,
+                 minimize: OptimizeTarget = OptimizeTarget.COST,
+                 blocked_resources: Optional[Iterable[
+                     resources_lib.Resources]] = None,
+                 quiet: bool = False) -> dag_lib.Dag:
+        """Assign ``task.best_resources`` for every task in the DAG."""
+        dag.validate()
+        candidates = _fill_in_launchable_resources(dag, blocked_resources)
+        assignment = _solve(dag, candidates, minimize)
+        for t, (chosen, cost) in assignment.items():
+            t.best_resources = chosen
+            if not quiet:
+                logger.info(
+                    f'Task {t.name or "<unnamed>"}: {chosen} '
+                    f'(${cost:.2f}/hr x {t.num_nodes} node(s))')
+        return dag
+
+
+def _estimate_runtime(task: task_lib.Task) -> float:
+    est = getattr(task, 'estimated_runtime_seconds', None)
+    return float(est) if est else _DEFAULT_RUNTIME_ESTIMATE_S
+
+
+def _is_blocked(candidate: resources_lib.Resources,
+                blocked: List[resources_lib.Resources]) -> bool:
+    """A candidate is blocked if some blocked entry 'covers' it.
+
+    Blocked entries are partial Resources (e.g. cloud+region only); the
+    blocked entry's specified fields must all match the candidate.
+    """
+    for b in blocked:
+        if b.cloud_name is not None and b.cloud_name != candidate.cloud_name:
+            continue
+        if b.region is not None and b.region != candidate.region:
+            continue
+        if b.zone is not None and b.zone != candidate.zone:
+            continue
+        if b.instance_type is not None and \
+                b.instance_type != candidate.instance_type:
+            continue
+        if b.accelerators is not None and \
+                b.accelerators != candidate.accelerators:
+            continue
+        return True
+    return False
+
+
+def _fill_in_launchable_resources(
+    dag: dag_lib.Dag,
+    blocked_resources: Optional[Iterable[resources_lib.Resources]],
+) -> Dict[task_lib.Task, List[Tuple[resources_lib.Resources, float]]]:
+    """Per task: launchable (resources, $/hr) candidates.
+
+    Cost-ranked unless the task used `ordered:` (user ranking wins).
+    Twin of sky/optimizer.py:1256.
+    """
+    blocked = list(blocked_resources or [])
+    enabled = check_lib.get_cached_enabled_clouds_or_refresh(
+        raise_if_no_cloud_access=True)
+    result: Dict[task_lib.Task, List[Tuple[resources_lib.Resources,
+                                           float]]] = {}
+    for t in dag.tasks:
+        all_candidates: List[Tuple[resources_lib.Resources, float]] = []
+        all_fuzzy: List[str] = []
+        for request in t.resources:
+            clouds = [request.cloud_name] if request.cloud_name else enabled
+            per_request: List[Tuple[resources_lib.Resources, float]] = []
+            for cloud_name in clouds:
+                if cloud_name not in enabled:
+                    continue
+                cloud = registry.CLOUD_REGISTRY.from_str(cloud_name)
+                feasible, fuzzy = cloud.get_feasible_launchable_resources(
+                    request)
+                all_fuzzy.extend(fuzzy)
+                for cand in feasible:
+                    if _is_blocked(cand, blocked):
+                        continue
+                    try:
+                        cost = cand.get_hourly_cost()
+                    except ValueError:
+                        continue
+                    per_request.append((cand, cost))
+            if not t.resources_ordered:
+                per_request.sort(key=lambda rc: rc[1])
+            all_candidates.extend(per_request)
+        if not all_candidates:
+            hint = ''
+            if all_fuzzy:
+                hint = (' Did you mean: '
+                        f'{", ".join(sorted(set(all_fuzzy))[:8])}?')
+            raise exceptions.ResourcesUnavailableError(
+                f'No launchable resource found for task '
+                f'{t.name or "<unnamed>"} '
+                f'(requested: {t.resources}).{hint}')
+        if not t.resources_ordered:
+            all_candidates.sort(key=lambda rc: rc[1])
+        result[t] = all_candidates
+    return result
+
+
+def _node_objective(task: task_lib.Task, cost_per_hr: float,
+                    minimize: OptimizeTarget) -> float:
+    runtime = _estimate_runtime(task)
+    if minimize is OptimizeTarget.TIME:
+        return runtime
+    return cost_per_hr * task.num_nodes * runtime / 3600.0
+
+
+def _egress_cost(src: resources_lib.Resources,
+                 dst: resources_lib.Resources,
+                 gigabytes: float) -> float:
+    """Cost of moving a task's outputs between the two placements.
+
+    Cloud-granularity like the reference (sky/optimizer.py:239): intra-cloud
+    transfer is free; cross-cloud pays the source cloud's egress rate.
+    """
+    if gigabytes <= 0:
+        return 0.0
+    if src.cloud_name == dst.cloud_name:
+        return 0.0
+    cloud = src.cloud
+    return cloud.get_egress_cost(gigabytes) if cloud else 0.0
+
+
+def _edge_gigabytes(task: task_lib.Task) -> float:
+    return float(getattr(task, 'estimated_outputs_gigabytes', None) or 0.0)
+
+
+def _solve(
+    dag: dag_lib.Dag,
+    candidates: Dict[task_lib.Task, List[Tuple[resources_lib.Resources,
+                                               float]]],
+    minimize: OptimizeTarget,
+) -> Dict[task_lib.Task, Tuple[resources_lib.Resources, float]]:
+    tasks = dag.topological_order()
+    if len(tasks) == 1 or all(_edge_gigabytes(t) == 0 for t in tasks):
+        # No egress coupling: each task independently takes its best.
+        return {t: candidates[t][0] for t in tasks}
+    if dag.is_chain():
+        return _solve_chain_dp(tasks, dag, candidates, minimize)
+    total = 1
+    for t in tasks:
+        total *= len(candidates[t])
+        if total > _EXACT_SEARCH_LIMIT:
+            return _solve_local_search(tasks, dag, candidates, minimize)
+    return _solve_exact(tasks, dag, candidates, minimize)
+
+
+def _assignment_objective(tasks, dag, chosen, minimize) -> float:
+    total = 0.0
+    for t in tasks:
+        res, cost = chosen[t]
+        total += _node_objective(t, cost, minimize)
+        for child in dag.downstream(t):
+            total += _egress_cost(res, chosen[child][0], _edge_gigabytes(t))
+    return total
+
+
+def _solve_chain_dp(tasks, dag, candidates, minimize):
+    """DP over the chain (twin of sky/optimizer.py:429)."""
+    # dp[i][j] = min objective of prefix ending with tasks[i] using cand j.
+    dp: List[List[float]] = []
+    parent_choice: List[List[int]] = []
+    for i, t in enumerate(tasks):
+        row, back = [], []
+        for j, (res, cost) in enumerate(candidates[t]):
+            node = _node_objective(t, cost, minimize)
+            if i == 0:
+                row.append(node)
+                back.append(-1)
+                continue
+            prev_t = tasks[i - 1]
+            best, best_k = float('inf'), -1
+            for k, (prev_res, _) in enumerate(candidates[prev_t]):
+                egress = _egress_cost(prev_res, res, _edge_gigabytes(prev_t))
+                val = dp[i - 1][k] + egress
+                if val < best:
+                    best, best_k = val, k
+            row.append(best + node)
+            back.append(best_k)
+        dp.append(row)
+        parent_choice.append(back)
+    # Backtrack.
+    j = min(range(len(dp[-1])), key=dp[-1].__getitem__)
+    out: Dict = {}
+    for i in range(len(tasks) - 1, -1, -1):
+        out[tasks[i]] = candidates[tasks[i]][j]
+        j = parent_choice[i][j]
+    return out
+
+
+def _solve_exact(tasks, dag, candidates, minimize):
+    """Exhaustive search (replaces the reference's pulp ILP :490 for the
+    DAG sizes its own tests exercise)."""
+    best_obj, best_choice = float('inf'), None
+    index_ranges = [range(len(candidates[t])) for t in tasks]
+    for combo in itertools.product(*index_ranges):
+        chosen = {t: candidates[t][j] for t, j in zip(tasks, combo)}
+        obj = _assignment_objective(tasks, dag, chosen, minimize)
+        if obj < best_obj:
+            best_obj, best_choice = obj, chosen
+    assert best_choice is not None
+    return best_choice
+
+
+def _solve_local_search(tasks, dag, candidates, minimize):
+    """Coordinate descent from the independent optimum; exact on trees in
+    one sweep, good approximation otherwise."""
+    chosen = {t: candidates[t][0] for t in tasks}
+    improved = True
+    sweeps = 0
+    while improved and sweeps < 10:
+        improved = False
+        sweeps += 1
+        for t in tasks:
+            best = chosen[t]
+            best_obj = _assignment_objective(tasks, dag, chosen, minimize)
+            for cand in candidates[t]:
+                chosen[t] = cand
+                obj = _assignment_objective(tasks, dag, chosen, minimize)
+                if obj < best_obj - 1e-12:
+                    best, best_obj = cand, obj
+                    improved = True
+            chosen[t] = best
+    return chosen
+
+
+def candidates_for_failover(
+        task: task_lib.Task,
+        blocked_resources: Optional[Iterable[resources_lib.Resources]] = None
+) -> List[resources_lib.Resources]:
+    """Ordered launchable candidates for one task (used by the failover
+    engine to walk to the next-cheapest SKU, incl. GPU→TPU)."""
+    d = dag_lib.Dag()
+    d.add(task)
+    cands = _fill_in_launchable_resources(d, blocked_resources)[task]
+    return [r for r, _ in cands]

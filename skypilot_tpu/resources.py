@@ -1,0 +1,487 @@
+"""Resources: a hardware request (twin of sky/resources.py:93).
+
+Differences from the reference, by design:
+  * TPU slices are first-class: ``accelerators: tpu-v5p-64`` resolves
+    through :mod:`skypilot_tpu.utils.tpu_topology` to a full slice topology
+    (chips, hosts, ICI shape) at validation time, not at provision time.
+  * ``accelerator_args`` accepts ``runtime_version``, ``topology`` (e.g.
+    ``4x4x8``), ``num_slices`` (multislice over DCN) and
+    ``use_queued_resources``.
+  * Cloud is stored as a canonical lowercase name; the registry resolves
+    the implementation (keeps Resources picklable and cheap).
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, List, Optional, Set, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import registry
+from skypilot_tpu.utils import tpu_topology
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.clouds import Cloud
+
+_DEFAULT_DISK_SIZE_GB = 256
+
+
+class Resources:
+    """Immutable-ish hardware request; use :meth:`copy` to derive variants."""
+
+    def __init__(
+        self,
+        cloud: Optional[str] = None,
+        instance_type: Optional[str] = None,
+        cpus: Union[None, int, float, str] = None,
+        memory: Union[None, int, float, str] = None,
+        accelerators: Union[None, str, Dict[str, float]] = None,
+        accelerator_args: Optional[Dict[str, Any]] = None,
+        use_spot: Optional[bool] = None,
+        job_recovery: Optional[Union[str, Dict[str, Any]]] = None,
+        region: Optional[str] = None,
+        zone: Optional[str] = None,
+        image_id: Optional[str] = None,
+        disk_size: Optional[int] = None,
+        disk_tier: Optional[str] = None,
+        ports: Optional[Union[int, str, List[Union[int, str]]]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        autostop: Optional[Union[int, bool, Dict[str, Any]]] = None,
+        _cluster_config_overrides: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        self._cloud_name = self._canonical_cloud(cloud)
+        self._instance_type = instance_type
+        self._cpus = self._canonical_spec(cpus)
+        self._memory = self._canonical_spec(memory)
+        self._use_spot_specified = use_spot is not None
+        self._use_spot = bool(use_spot) if use_spot is not None else False
+        self._job_recovery = self._canonical_job_recovery(job_recovery)
+        self._region = region
+        self._zone = zone
+        self._image_id = image_id
+        self._disk_size = int(disk_size) if disk_size is not None else \
+            _DEFAULT_DISK_SIZE_GB
+        self._disk_tier = disk_tier
+        self._ports = self._canonical_ports(ports)
+        self._labels = dict(labels) if labels else None
+        self._autostop = self._canonical_autostop(autostop)
+        self._cluster_config_overrides = _cluster_config_overrides
+
+        self._accelerator_args = dict(accelerator_args) \
+            if accelerator_args else None
+        self._accelerators = self._canonical_accelerators(accelerators)
+        self._validate()
+
+    # ---- canonicalization ----
+
+    @staticmethod
+    def _canonical_cloud(cloud: Optional[str]) -> Optional[str]:
+        if cloud is None:
+            return None
+        if not isinstance(cloud, str):  # a Cloud object
+            return cloud.name
+        if cloud not in registry.CLOUD_REGISTRY:
+            raise ValueError(
+                f'Unknown cloud {cloud!r}. '
+                f'Enabled: {registry.CLOUD_REGISTRY.keys()}')
+        return registry.CLOUD_REGISTRY.from_str(cloud).name
+
+    @staticmethod
+    def _canonical_spec(spec) -> Optional[str]:
+        if spec is None:
+            return None
+        s = str(spec).strip()
+        if s.endswith('+'):
+            float(s[:-1])  # validate
+        else:
+            float(s)
+        return s
+
+    def _canonical_accelerators(self, acc) -> Optional[Dict[str, float]]:
+        """Normalize 'A100', 'A100:8', 'tpu-v5e-8', {...} → {name: count}."""
+        if acc is None:
+            return None
+        if isinstance(acc, str):
+            if ':' in acc:
+                name, _, count = acc.partition(':')
+                acc = {name.strip(): float(count)}
+            else:
+                acc = {acc.strip(): 1}
+        if len(acc) != 1:
+            raise ValueError(
+                f'accelerators must specify exactly one type, got {acc}')
+        name, count = next(iter(acc.items()))
+        if tpu_topology.is_tpu(name):
+            if count != 1:
+                raise ValueError(
+                    f'TPU slices take no count (got {name}:{count:g}); the '
+                    'size is part of the name, e.g. tpu-v5e-8.')
+            topo = tpu_topology.parse(name, self._accelerator_args)
+            return {topo.accelerator_name: 1}
+        return {name: float(count)}
+
+    @staticmethod
+    def _canonical_job_recovery(recovery) -> Optional[Dict[str, Any]]:
+        if recovery is None:
+            return None
+        if isinstance(recovery, str):
+            return {'strategy': recovery.lower()}
+        out = dict(recovery)
+        if 'strategy' in out and isinstance(out['strategy'], str):
+            out['strategy'] = out['strategy'].lower()
+        return out
+
+    @staticmethod
+    def _canonical_ports(ports) -> Optional[List[str]]:
+        if ports is None:
+            return None
+        if isinstance(ports, (int, str)):
+            ports = [ports]
+        return [str(p) for p in ports]
+
+    @staticmethod
+    def _canonical_autostop(autostop) -> Optional[Dict[str, Any]]:
+        """Normalize 10 / True / {'idle_minutes': 10, 'down': True}."""
+        if autostop is None or autostop is False:
+            return None
+        if autostop is True:
+            return {'idle_minutes': 5, 'down': False}
+        if isinstance(autostop, (int, float)):
+            if autostop < 0:
+                return None
+            return {'idle_minutes': int(autostop), 'down': False}
+        return {
+            'idle_minutes': int(autostop.get('idle_minutes', 5)),
+            'down': bool(autostop.get('down', False)),
+        }
+
+    def _validate(self) -> None:
+        if self._zone is not None and self._region is None:
+            # Infer region from zone when possible.
+            self._region = self._zone.rsplit('-', 1)[0]
+        if self._cloud_name is not None and (self._region is not None or
+                                             self._zone is not None):
+            self.cloud.validate_region_zone(self._region, self._zone)
+        if self._instance_type is not None and self._cloud_name is not None:
+            if not self.cloud.instance_type_exists(self._instance_type):
+                raise ValueError(
+                    f'Instance type {self._instance_type!r} not found in '
+                    f'{self._cloud_name} catalog.')
+
+    # ---- accessors ----
+
+    @property
+    def cloud_name(self) -> Optional[str]:
+        return self._cloud_name
+
+    @property
+    def cloud(self) -> Optional['Cloud']:
+        return registry.CLOUD_REGISTRY.from_str(self._cloud_name)
+
+    @property
+    def instance_type(self) -> Optional[str]:
+        return self._instance_type
+
+    @property
+    def cpus(self) -> Optional[str]:
+        return self._cpus
+
+    @property
+    def memory(self) -> Optional[str]:
+        return self._memory
+
+    @property
+    def accelerators(self) -> Optional[Dict[str, float]]:
+        return self._accelerators
+
+    @property
+    def accelerator_args(self) -> Optional[Dict[str, Any]]:
+        return self._accelerator_args
+
+    @property
+    def use_spot(self) -> bool:
+        return self._use_spot
+
+    @property
+    def use_spot_specified(self) -> bool:
+        return self._use_spot_specified
+
+    @property
+    def job_recovery(self) -> Optional[Dict[str, Any]]:
+        return self._job_recovery
+
+    @property
+    def region(self) -> Optional[str]:
+        return self._region
+
+    @property
+    def zone(self) -> Optional[str]:
+        return self._zone
+
+    @property
+    def image_id(self) -> Optional[str]:
+        return self._image_id
+
+    @property
+    def disk_size(self) -> int:
+        return self._disk_size
+
+    @property
+    def disk_tier(self) -> Optional[str]:
+        return self._disk_tier
+
+    @property
+    def ports(self) -> Optional[List[str]]:
+        return self._ports
+
+    @property
+    def labels(self) -> Optional[Dict[str, str]]:
+        return self._labels
+
+    @property
+    def autostop(self) -> Optional[Dict[str, Any]]:
+        return self._autostop
+
+    @property
+    def cluster_config_overrides(self) -> Dict[str, Any]:
+        return self._cluster_config_overrides or {}
+
+    # ---- TPU ----
+
+    @property
+    def is_tpu(self) -> bool:
+        if self._accelerators is None:
+            return False
+        return tpu_topology.is_tpu(next(iter(self._accelerators)))
+
+    @property
+    def tpu_topology(self) -> Optional[tpu_topology.SliceTopology]:
+        if not self.is_tpu:
+            return None
+        return tpu_topology.parse(next(iter(self._accelerators)),
+                                  self._accelerator_args)
+
+    @property
+    def num_hosts_per_node(self) -> int:
+        """Hosts behind one logical node (1 for VMs; N for TPU pod slices).
+
+        The reference threads this as `num_ips_per_node`
+        (sky/backends/cloud_vm_ray_backend.py:2613); here it derives
+        directly from the topology so it cannot drift.
+        """
+        topo = self.tpu_topology
+        if topo is None:
+            return 1
+        return topo.total_hosts
+
+    # ---- launchability ----
+
+    def is_launchable(self) -> bool:
+        if self._cloud_name is None:
+            return False
+        if self.is_tpu:
+            return True  # TPU slices need no instance type
+        return self._instance_type is not None
+
+    def assert_launchable(self) -> 'Resources':
+        if not self.is_launchable():
+            raise exceptions.ResourcesUnavailableError(
+                f'Resources not launchable (missing cloud/instance_type): '
+                f'{self}')
+        return self
+
+    # ---- cost ----
+
+    def get_hourly_cost(self) -> float:
+        assert self._cloud_name is not None, self
+        cost = 0.0
+        if self.is_tpu:
+            name = next(iter(self._accelerators))
+            from skypilot_tpu import catalog
+            cost += catalog.get_accelerator_hourly_cost(
+                self._cloud_name, name, 1, self._use_spot, self._region,
+                self._zone) * (self.tpu_topology.num_slices)
+        else:
+            if self._instance_type:
+                cost += self.cloud.instance_type_to_hourly_cost(
+                    self._instance_type, self._use_spot, self._region,
+                    self._zone)
+        return cost
+
+    def get_cost(self, seconds: float) -> float:
+        return self.get_hourly_cost() * seconds / 3600.0
+
+    # ---- features ----
+
+    def get_required_cloud_features(self) -> Set:
+        from skypilot_tpu.clouds import CloudImplementationFeatures as F
+        features = set()
+        if self._use_spot:
+            features.add(F.SPOT_INSTANCE)
+        if self._ports:
+            features.add(F.OPEN_PORTS)
+        if self._image_id:
+            features.add(F.IMAGE_ID)
+        if self._disk_tier:
+            features.add(F.CUSTOM_DISK_TIER)
+        if self._autostop is not None:
+            features.add(F.AUTOSTOP)
+        topo = self.tpu_topology
+        if topo is not None:
+            if topo.is_pod:
+                features.add(F.TPU_POD)
+            if topo.is_multislice:
+                features.add(F.TPU_MULTISLICE)
+        return features
+
+    # ---- comparison ----
+
+    def less_demanding_than(self, other: 'Resources') -> bool:
+        """Can a cluster with `other` serve a request for `self`?
+
+        (Twin of sky/resources.py:1563; used by `exec` on existing clusters.)
+        """
+        if self._cloud_name is not None and self._cloud_name != \
+                other.cloud_name:
+            return False
+        if self._region is not None and self._region != other.region:
+            return False
+        if self._zone is not None and self._zone != other.zone:
+            return False
+        if self._instance_type is not None and \
+                self._instance_type != other.instance_type:
+            return False
+        if self._use_spot_specified and self._use_spot != other.use_spot:
+            return False
+        if self._accelerators is not None:
+            if other.accelerators is None:
+                return False
+            name, count = next(iter(self._accelerators.items()))
+            for other_name, other_count in other.accelerators.items():
+                if name.lower() == other_name.lower() and \
+                        other_count >= count:
+                    break
+            else:
+                return False
+        return True
+
+    # ---- derivation / serialization ----
+
+    def copy(self, **override) -> 'Resources':
+        fields: Dict[str, Any] = {
+            'cloud': self._cloud_name,
+            'instance_type': self._instance_type,
+            'cpus': self._cpus,
+            'memory': self._memory,
+            'accelerators': self._accelerators,
+            'accelerator_args': self._accelerator_args,
+            'use_spot': self._use_spot if self._use_spot_specified else None,
+            'job_recovery': self._job_recovery,
+            'region': self._region,
+            'zone': self._zone,
+            'image_id': self._image_id,
+            'disk_size': self._disk_size,
+            'disk_tier': self._disk_tier,
+            'ports': self._ports,
+            'labels': self._labels,
+            'autostop': self._autostop,
+            '_cluster_config_overrides': self._cluster_config_overrides,
+        }
+        fields.update(override)
+        return Resources(**fields)
+
+    @classmethod
+    def from_yaml_config(
+        cls, config: Optional[Dict[str, Any]]
+    ) -> Union['Resources', List['Resources']]:
+        """Build from a task YAML `resources:` section.
+
+        ``any_of:`` → unordered candidate list; ``ordered:`` → user-ranked
+        list the optimizer must respect (reference: sky/resources.py).
+        """
+        if config is None:
+            return cls()
+        config = dict(config)
+        any_of = config.pop('any_of', None)
+        ordered = config.pop('ordered', None)
+        if any_of is not None and ordered is not None:
+            raise ValueError("Cannot specify both 'any_of' and 'ordered'.")
+        base_kwargs = cls._yaml_to_kwargs(config)
+        if any_of is None and ordered is None:
+            return cls(**base_kwargs)
+        variants = any_of if any_of is not None else ordered
+        out = []
+        for variant in variants:
+            kwargs = dict(base_kwargs)
+            kwargs.update(cls._yaml_to_kwargs(variant))
+            out.append(cls(**kwargs))
+        return out
+
+    @staticmethod
+    def _yaml_to_kwargs(config: Dict[str, Any]) -> Dict[str, Any]:
+        known = {
+            'cloud', 'instance_type', 'cpus', 'memory', 'accelerators',
+            'accelerator_args', 'use_spot', 'job_recovery', 'region', 'zone',
+            'image_id', 'disk_size', 'disk_tier', 'ports', 'labels',
+            'autostop'
+        }
+        unknown = set(config) - known
+        if unknown:
+            raise ValueError(
+                f'Unknown resources fields: {sorted(unknown)}. '
+                f'Known: {sorted(known)}')
+        return {k: v for k, v in config.items() if v is not None}
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        config: Dict[str, Any] = {}
+
+        def add(key, value):
+            if value is not None:
+                config[key] = value
+
+        add('cloud', self._cloud_name)
+        add('instance_type', self._instance_type)
+        add('cpus', self._cpus)
+        add('memory', self._memory)
+        if self._accelerators:
+            name, count = next(iter(self._accelerators.items()))
+            add('accelerators',
+                name if count == 1 else f'{name}:{count:g}')
+        add('accelerator_args', self._accelerator_args)
+        if self._use_spot_specified:
+            add('use_spot', self._use_spot)
+        add('job_recovery', self._job_recovery)
+        add('region', self._region)
+        add('zone', self._zone)
+        add('image_id', self._image_id)
+        add('disk_size', self._disk_size)
+        add('disk_tier', self._disk_tier)
+        add('ports', self._ports)
+        add('labels', self._labels)
+        add('autostop', self._autostop)
+        return config
+
+    def __repr__(self) -> str:
+        parts = []
+        if self._cloud_name:
+            parts.append(self._cloud_name.upper())
+        if self._instance_type:
+            parts.append(self._instance_type)
+        if self._accelerators:
+            name, count = next(iter(self._accelerators.items()))
+            parts.append(f'{{{name}:{common_utils.format_float(count)}}}')
+        if self._use_spot:
+            parts.append('[spot]')
+        if self._region:
+            parts.append(self._region if not self._zone else self._zone)
+        return 'Resources(' + ', '.join(parts) + ')' if parts else \
+            'Resources(<empty>)'
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, Resources):
+            return NotImplemented
+        return self.to_yaml_config() == other.to_yaml_config()
+
+    def __hash__(self) -> int:
+        import json
+        return hash(json.dumps(self.to_yaml_config(), sort_keys=True))

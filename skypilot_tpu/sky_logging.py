@@ -1,0 +1,52 @@
+"""Logging setup (twin of sky/sky_logging.py).
+
+Env controls: XSKY_DEBUG=1 for debug level, XSKY_MINIMIZE_LOGGING=1 to quiet.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import sys
+import threading
+
+_FORMAT = '%(levelname).1s %(asctime)s %(filename)s:%(lineno)d] %(message)s'
+_DATE_FORMAT = '%m-%d %H:%M:%S'
+
+_setup_lock = threading.Lock()
+_root_name = 'skypilot_tpu'
+
+
+def _default_level() -> int:
+    if os.environ.get('XSKY_DEBUG') == '1':
+        return logging.DEBUG
+    if os.environ.get('XSKY_MINIMIZE_LOGGING') == '1':
+        return logging.WARNING
+    return logging.INFO
+
+
+def init_logger(name: str) -> logging.Logger:
+    with _setup_lock:
+        root = logging.getLogger(_root_name)
+        if not root.handlers:
+            handler = logging.StreamHandler(sys.stdout)
+            handler.setFormatter(logging.Formatter(_FORMAT, _DATE_FORMAT))
+            root.addHandler(handler)
+            root.setLevel(_default_level())
+            root.propagate = False
+    return logging.getLogger(name)
+
+
+def set_verbosity(level: int) -> None:
+    logging.getLogger(_root_name).setLevel(level)
+
+
+@contextlib.contextmanager
+def silent():
+    root = logging.getLogger(_root_name)
+    prev = root.level
+    root.setLevel(logging.ERROR)
+    try:
+        yield
+    finally:
+        root.setLevel(prev)

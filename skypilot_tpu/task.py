@@ -1,0 +1,246 @@
+"""Task: a user workload (twin of sky/task.py:236).
+
+YAML surface kept compatible with the reference (name / workdir / num_nodes /
+resources / envs / secrets / file_mounts / setup / run / service / config),
+so reference task YAMLs port with at most resource-name edits.
+"""
+from __future__ import annotations
+
+import os
+import re
+import typing
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import yaml
+
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.utils import common_utils
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.data import storage as storage_lib
+
+_VALID_NAME_RE = re.compile(r'^[a-zA-Z0-9]+(?:[._-]{1,2}[a-zA-Z0-9]+)*$')
+
+CommandOrCommandGen = Union[None, str, Callable[[int, List[str]], str]]
+
+_RUN_FN_CHECK_FAIL_MSG = (
+    'run command generator must take (node_rank: int, ip_list: List[str]) '
+    'and return a shell command string or None.')
+
+
+class Task:
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        *,
+        setup: Optional[str] = None,
+        run: CommandOrCommandGen = None,
+        envs: Optional[Dict[str, str]] = None,
+        secrets: Optional[Dict[str, str]] = None,
+        workdir: Optional[str] = None,
+        num_nodes: Optional[int] = None,
+        file_mounts: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self.name = name
+        self.setup = setup
+        self.run = run
+        self.workdir = workdir
+        self._envs = dict(envs) if envs else {}
+        self._secrets = dict(secrets) if secrets else {}
+        self.num_nodes = num_nodes if num_nodes is not None else 1
+        self.file_mounts: Optional[Dict[str, str]] = \
+            dict(file_mounts) if file_mounts else None
+        self.storage_mounts: Dict[str, 'storage_lib.Storage'] = {}
+        self.service: Optional[Any] = None  # serve.SkyServiceSpec
+        self._resources: List[resources_lib.Resources] = \
+            [resources_lib.Resources()]
+        self._resources_ordered = False
+        # DAG wiring (set by Dag context)
+        self._validate()
+
+    def _validate(self) -> None:
+        if self.name is not None and not _VALID_NAME_RE.match(self.name):
+            raise ValueError(f'Invalid task name {self.name!r}')
+        if self.num_nodes < 1:
+            raise ValueError('num_nodes must be >= 1')
+        if self.run is not None and not isinstance(self.run, str) and \
+                not callable(self.run):
+            raise ValueError(_RUN_FN_CHECK_FAIL_MSG)
+        for key in self._envs:
+            if not re.match(r'^[A-Za-z_][A-Za-z0-9_]*$', key):
+                raise ValueError(f'Invalid env var name {key!r}')
+        if self.workdir is not None:
+            expanded = os.path.expanduser(self.workdir)
+            if not os.path.isdir(expanded) and not os.path.isabs(expanded):
+                # Relative workdirs are resolved at launch; only flag
+                # obviously-absent absolute paths.
+                pass
+
+    # ---- resources ----
+
+    @property
+    def resources(self) -> List[resources_lib.Resources]:
+        return self._resources
+
+    @property
+    def resources_ordered(self) -> bool:
+        """True if the user ranked candidates (ordered:) — optimizer must
+        respect the order rather than cost-rank."""
+        return self._resources_ordered
+
+    def set_resources(
+        self, resources: Union[resources_lib.Resources,
+                               List[resources_lib.Resources]],
+        ordered: bool = False
+    ) -> 'Task':
+        if isinstance(resources, resources_lib.Resources):
+            resources = [resources]
+        if not resources:
+            raise ValueError('resources must be non-empty')
+        self._resources = list(resources)
+        self._resources_ordered = ordered
+        return self
+
+    # ---- envs / secrets ----
+
+    @property
+    def envs(self) -> Dict[str, str]:
+        return dict(self._envs)
+
+    @property
+    def secrets(self) -> Dict[str, str]:
+        return dict(self._secrets)
+
+    @property
+    def envs_and_secrets(self) -> Dict[str, str]:
+        out = dict(self._envs)
+        out.update(self._secrets)
+        return out
+
+    def update_envs(self, envs: Dict[str, str]) -> 'Task':
+        for k, v in envs.items():
+            if v is None:
+                raise ValueError(
+                    f'Env var {k!r} has no value; pass --env {k}=VALUE.')
+            self._envs[k] = str(v)
+        return self
+
+    def update_secrets(self, secrets: Dict[str, str]) -> 'Task':
+        for k, v in secrets.items():
+            if v is None:
+                raise ValueError(
+                    f'Secret {k!r} has no value; pass --secret {k}=VALUE.')
+            self._secrets[k] = str(v)
+        return self
+
+    # ---- mounts ----
+
+    def set_file_mounts(self, file_mounts: Optional[Dict[str, str]]) -> 'Task':
+        self.file_mounts = dict(file_mounts) if file_mounts else None
+        return self
+
+    def update_file_mounts(self, file_mounts: Dict[str, str]) -> 'Task':
+        if self.file_mounts is None:
+            self.file_mounts = {}
+        self.file_mounts.update(file_mounts)
+        return self
+
+    def set_storage_mounts(self, storage_mounts) -> 'Task':
+        self.storage_mounts = dict(storage_mounts) if storage_mounts else {}
+        return self
+
+    # ---- YAML ----
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any],
+                         env_overrides: Optional[Dict[str, str]] = None,
+                         secret_overrides: Optional[Dict[str, str]] = None
+                         ) -> 'Task':
+        config = dict(config or {})
+        envs = dict(config.pop('envs', None) or {})
+        secrets = dict(config.pop('secrets', None) or {})
+        if env_overrides:
+            envs.update(env_overrides)
+        if secret_overrides:
+            secrets.update(secret_overrides)
+        missing = [k for k, v in {**envs, **secrets}.items() if v is None]
+        if missing:
+            raise ValueError(
+                f'Env/secret(s) {missing} declared with null values; '
+                'pass values via --env/--secret.')
+
+        task = cls(
+            name=config.pop('name', None),
+            setup=config.pop('setup', None),
+            run=config.pop('run', None),
+            envs=envs,
+            secrets=secrets,
+            workdir=config.pop('workdir', None),
+            num_nodes=config.pop('num_nodes', None),
+            file_mounts=config.pop('file_mounts', None),
+        )
+        resources_config = config.pop('resources', None)
+        parsed = resources_lib.Resources.from_yaml_config(resources_config)
+        ordered = bool(resources_config) and 'ordered' in resources_config
+        task.set_resources(parsed, ordered=ordered)
+
+        service = config.pop('service', None)
+        if service is not None:
+            from skypilot_tpu.serve import service_spec
+            task.service = service_spec.SkyServiceSpec.from_yaml_config(
+                service)
+
+        config.pop('config', None)  # per-task config overrides; applied by
+        # execution via skypilot_tpu.config.override.
+        unknown = set(config)
+        if unknown:
+            raise ValueError(f'Unknown task fields: {sorted(unknown)}')
+        return task
+
+    @classmethod
+    def from_yaml(cls, path: str, **kwargs) -> 'Task':
+        with open(os.path.expanduser(path), 'r', encoding='utf-8') as f:
+            config = yaml.safe_load(f)
+        if config is None:
+            config = {}
+        if isinstance(config, str):
+            raise ValueError(
+                f'{path} is not a task YAML (parsed as a string).')
+        return cls.from_yaml_config(config, **kwargs)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        config: Dict[str, Any] = {}
+
+        def add(key, value):
+            if value is not None and value != {} and value != []:
+                config[key] = value
+
+        add('name', self.name)
+        if len(self._resources) == 1:
+            add('resources', self._resources[0].to_yaml_config())
+        else:
+            key = 'ordered' if self._resources_ordered else 'any_of'
+            add('resources',
+                {key: [r.to_yaml_config() for r in self._resources]})
+        add('num_nodes', self.num_nodes if self.num_nodes != 1 else None)
+        add('workdir', self.workdir)
+        add('envs', self._envs or None)
+        add('secrets', self._secrets or None)
+        add('file_mounts', self.file_mounts)
+        add('setup', self.setup)
+        if isinstance(self.run, str):
+            add('run', self.run)
+        if self.service is not None:
+            add('service', self.service.to_yaml_config())
+        return config
+
+    def to_yaml(self, path: str) -> None:
+        with open(os.path.expanduser(path), 'w', encoding='utf-8') as f:
+            f.write(common_utils.dump_yaml_str(self.to_yaml_config()))
+
+    def __repr__(self) -> str:
+        name = self.name or '<unnamed>'
+        r = self._resources[0] if len(self._resources) == 1 else \
+            f'{len(self._resources)} candidates'
+        return f'Task({name}, num_nodes={self.num_nodes}, resources={r})'

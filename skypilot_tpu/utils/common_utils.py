@@ -1,0 +1,130 @@
+"""Small shared helpers (ids, validation, unit parsing, user identity)."""
+from __future__ import annotations
+
+import functools
+import getpass
+import hashlib
+import os
+import re
+import socket
+import time
+import uuid
+from typing import Any, Dict, Optional, Union
+
+_CLUSTER_NAME_RE = re.compile(r'^[a-z]([a-z0-9-]*[a-z0-9])?$')
+
+_run_id: Optional[str] = None
+
+
+def get_usage_run_id() -> str:
+    """Stable id for one client invocation (log correlation)."""
+    global _run_id
+    if _run_id is None:
+        _run_id = str(uuid.uuid4())
+    return _run_id
+
+
+def get_user_hash() -> str:
+    """Stable 8-hex id of the local user, overridable for tests."""
+    forced = os.environ.get('XSKY_USER_HASH')
+    if forced:
+        return forced
+    ident = f'{getpass.getuser()}@{socket.gethostname()}'
+    return hashlib.md5(ident.encode()).hexdigest()[:8]
+
+
+def get_global_job_id(job_timestamp: str, cluster_name: str,
+                      job_id: Union[int, str]) -> str:
+    return f'{job_timestamp}_{cluster_name}_id-{job_id}'
+
+
+def base36_encode(num: int) -> str:
+    chars = '0123456789abcdefghijklmnopqrstuvwxyz'
+    if num == 0:
+        return '0'
+    out = []
+    while num:
+        num, rem = divmod(num, 36)
+        out.append(chars[rem])
+    return ''.join(reversed(out))
+
+
+def fresh_cluster_suffix(length: int = 4) -> str:
+    return base36_encode(int(time.time() * 1e6))[-length:]
+
+
+def check_cluster_name_is_valid(name: Optional[str]) -> None:
+    """Cluster names must be valid DNS-ish labels (cloud resource names)."""
+    if name is None:
+        return
+    if len(name) > 63 or not _CLUSTER_NAME_RE.match(name):
+        raise ValueError(
+            f'Cluster name {name!r} is invalid: must match '
+            "[a-z]([a-z0-9-]*[a-z0-9])? and be <= 63 chars.")
+
+
+def parse_memory_gb(mem: Union[str, int, float, None]) -> Optional[float]:
+    """Parse '16', '16+', '16GB', 16 → 16.0 (the '+' is handled by caller)."""
+    if mem is None:
+        return None
+    if isinstance(mem, (int, float)):
+        return float(mem)
+    s = str(mem).strip().lower().rstrip('+')
+    for suffix in ('gib', 'gb', 'g'):
+        if s.endswith(suffix):
+            s = s[:-len(suffix)]
+            break
+    return float(s)
+
+
+def format_float(x: Union[int, float], precision: int = 2) -> str:
+    if isinstance(x, int) or float(x).is_integer():
+        return str(int(x))
+    return f'{x:.{precision}f}'
+
+
+def truncate_long_string(s: str, max_length: int = 35) -> str:
+    if len(s) <= max_length:
+        return s
+    return s[:max_length - 3] + '...'
+
+
+def dump_yaml_str(config: Dict[str, Any]) -> str:
+    import yaml
+    return yaml.safe_dump(config, sort_keys=False, default_flow_style=False)
+
+
+def read_yaml(path: str) -> Dict[str, Any]:
+    import yaml
+    with open(os.path.expanduser(path), 'r', encoding='utf-8') as f:
+        return yaml.safe_load(f) or {}
+
+
+def make_decorator(check_fn):
+    """Build a decorator that runs check_fn() before the wrapped call."""
+
+    def decorator(fn):
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            check_fn()
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+class Backoff:
+    """Capped exponential backoff with jitter-free determinism for tests."""
+
+    def __init__(self, initial: float = 1.0, factor: float = 1.6,
+                 cap: float = 30.0) -> None:
+        self._next = initial
+        self._factor = factor
+        self._cap = cap
+
+    def current_backoff(self) -> float:
+        value = self._next
+        self._next = min(self._next * self._factor, self._cap)
+        return value

@@ -1,0 +1,72 @@
+"""Name → class registries (twin of reference sky/utils/registry.py:129).
+
+Used for clouds, backends and managed-job recovery strategies so components
+self-register at import time and are looked up by canonical lowercase name.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Generic, List, Optional, Type, TypeVar
+
+T = TypeVar('T')
+
+
+class Registry(Generic[T]):
+
+    def __init__(self, registry_name: str) -> None:
+        self._name = registry_name
+        self._registry: Dict[str, T] = {}
+        self._aliases: Dict[str, str] = {}
+        self._default: Optional[str] = None
+
+    def register(self,
+                 name: Optional[str] = None,
+                 aliases: Optional[List[str]] = None,
+                 default: bool = False) -> Callable[[Type], Type]:
+
+        def decorator(cls: Type) -> Type:
+            key = (name or cls.__name__).lower()
+            if key in self._registry:
+                raise ValueError(
+                    f'{self._name}: duplicate registration for {key!r}')
+            # Clouds register an instance; everything else the class itself.
+            self._registry[key] = cls() if getattr(cls, '_REGISTER_INSTANCE',
+                                                   False) else cls
+            for alias in aliases or []:
+                self._aliases[alias.lower()] = key
+            if default:
+                self._default = key
+            return cls
+
+        return decorator
+
+    def from_str(self, name: Optional[str]) -> Optional[T]:
+        if name is None:
+            return None
+        key = name.lower()
+        key = self._aliases.get(key, key)
+        if key not in self._registry:
+            valid = ', '.join(sorted(self._registry))
+            raise ValueError(
+                f'{self._name} {name!r} not found. Valid: {valid}.')
+        return self._registry[key]
+
+    def get_default(self) -> Optional[T]:
+        if self._default is None:
+            return None
+        return self._registry[self._default]
+
+    def keys(self) -> List[str]:
+        return sorted(self._registry)
+
+    def values(self) -> List[T]:
+        return [self._registry[k] for k in sorted(self._registry)]
+
+    def __contains__(self, name: str) -> bool:
+        key = name.lower()
+        return self._aliases.get(key, key) in self._registry
+
+
+# Populated by skypilot_tpu.clouds / backends / jobs.recovery at import time.
+CLOUD_REGISTRY: Registry = Registry('cloud')
+BACKEND_REGISTRY: Registry = Registry('backend')
+JOBS_RECOVERY_STRATEGY_REGISTRY: Registry = Registry('recovery strategy')

@@ -1,0 +1,266 @@
+"""TPU accelerator grammar and topology database.
+
+This is the cornerstone of the TPU-first design: in the reference, a TPU is
+"an accelerator count on a VM" (sky/resources.py:737 + per-cloud vCPU/mem
+overrides at sky/clouds/gcp.py:688-739) and the multi-host asymmetry leaks
+through `num_ips_per_node` (sky/backends/cloud_vm_ray_backend.py:2613).
+
+Here `tpu-v5p-64` resolves *up front* to a :class:`SliceTopology`:
+{generation, chip count, hosts, chips/host, ICI mesh shape, peak FLOPs, HBM},
+so every layer (catalog pricing, optimizer feasibility/parallelism planning,
+provisioner bring-up, gang launcher rank math, mesh construction in
+``skypilot_tpu.parallel``) shares one consistent model of the hardware.
+
+Naming conventions follow Cloud TPU:
+  - v2/v3/v4/v5p names count **TensorCores** (v5p-128 == 64 chips).
+  - v5e (aka v5litepod) and v6e names count **chips** directly.
+Accepted spellings: ``tpu-v5e-8``, ``tpu-v5litepod-8``, ``tpu-v6e-16``,
+``tpu-v4-32``, ``tpu-v5p-128``; with optional ``accelerator_args`` keys
+``topology`` (e.g. ``4x4x8``) and ``num_slices`` (multislice over DCN).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuGeneration:
+    """Static description of one TPU generation."""
+    name: str                   # canonical short name, e.g. 'v5e'
+    cores_per_chip: int         # cores counted by the product name
+    max_chips_per_host: int     # chips on a fully-populated host VM
+    hbm_gib_per_chip: float
+    peak_bf16_tflops: float     # per chip
+    # ICI dimensionality: v2/v3/v5e/v6e are 2-D tori; v4/v5p are 3-D tori.
+    ici_dims: int
+    # Per-link ICI bandwidth, GB/s each direction (approx, public figures).
+    ici_gbps_per_link: float
+    default_runtime_version: str
+    aliases: Tuple[str, ...] = ()
+
+
+GENERATIONS: Dict[str, TpuGeneration] = {
+    'v2': TpuGeneration('v2', 2, 4, 8, 45, 2, 62.5, 'tpu-vm-base'),
+    'v3': TpuGeneration('v3', 2, 4, 16, 123, 2, 81.25, 'tpu-vm-base'),
+    'v4': TpuGeneration('v4', 2, 4, 32, 275, 3, 50, 'tpu-vm-v4-base'),
+    'v5e': TpuGeneration('v5e', 1, 8, 16, 197, 2, 50, 'v2-alpha-tpuv5-lite',
+                         aliases=('v5litepod',)),
+    'v5p': TpuGeneration('v5p', 2, 4, 95, 459, 3, 100, 'v2-alpha-tpuv5'),
+    'v6e': TpuGeneration('v6e', 1, 8, 32, 918, 2, 100, 'v2-alpha-tpuv6e'),
+}
+
+_ALIAS_TO_GEN = {alias: gen.name
+                 for gen in GENERATIONS.values()
+                 for alias in gen.aliases}
+
+# Valid 2-D slice shapes for v5e/v6e (cols x rows), from the Cloud TPU docs.
+# Keyed by chip count; value is the (x, y) accelerator topology.
+_V5E_SHAPES: Dict[int, Tuple[int, int]] = {
+    1: (1, 1), 4: (2, 2), 8: (2, 4), 16: (4, 4), 32: (4, 8), 64: (8, 8),
+    128: (8, 16), 256: (16, 16),
+}
+_V6E_SHAPES = dict(_V5E_SHAPES)  # same ladder
+
+_ACC_RE = re.compile(
+    r'^(?:tpu-)?(?P<gen>v\d+(?:e|p|litepod)?)-(?P<count>\d+)$', re.IGNORECASE)
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceTopology:
+    """Fully-resolved description of one TPU slice request."""
+    accelerator_name: str       # canonical, e.g. 'tpu-v5p-64'
+    generation: TpuGeneration
+    num_cores: int              # as counted by the product name
+    num_chips: int
+    topology: Tuple[int, ...]   # ICI mesh shape in chips, e.g. (4, 4, 4)
+    num_hosts: int
+    chips_per_host: int
+    num_slices: int = 1         # >1 ⇒ multislice over DCN (megascale)
+
+    @property
+    def is_pod(self) -> bool:
+        """Multi-host slice (one logical node = num_hosts VMs)."""
+        return self.num_hosts > 1
+
+    @property
+    def is_multislice(self) -> bool:
+        return self.num_slices > 1
+
+    @property
+    def total_chips(self) -> int:
+        return self.num_chips * self.num_slices
+
+    @property
+    def total_hosts(self) -> int:
+        return self.num_hosts * self.num_slices
+
+    @property
+    def peak_bf16_tflops(self) -> float:
+        return self.generation.peak_bf16_tflops * self.total_chips
+
+    @property
+    def hbm_gib(self) -> float:
+        return self.generation.hbm_gib_per_chip * self.total_chips
+
+    @property
+    def topology_str(self) -> str:
+        return 'x'.join(str(d) for d in self.topology)
+
+    def runtime_version(self, override: Optional[str] = None) -> str:
+        return override or self.generation.default_runtime_version
+
+    def gcp_accelerator_type(self) -> str:
+        """The `acceleratorType` string for tpu.googleapis.com nodes.create.
+
+        (Twin of the value the reference passes through config at
+        sky/provision/gcp/instance_utils.py:1440.)
+        """
+        if self.generation.name == 'v5e':
+            return f'v5litepod-{self.num_chips}'
+        return f'{self.generation.name}-{self.num_cores}'
+
+
+def is_tpu(accelerator_name: Optional[str]) -> bool:
+    """Twin of sky/clouds/utils/gcp_utils.py:29 (is_tpu)."""
+    if accelerator_name is None:
+        return False
+    return _ACC_RE.match(accelerator_name.strip()) is not None
+
+
+def _squarest_3d(n: int) -> Tuple[int, int, int]:
+    """Pick the most cube-like x<=y<=z factorization of n chips.
+
+    Used for v4/v5p when the user gives no explicit topology. Real slices
+    have doc-blessed shapes; the squarest factorization matches them for all
+    standard sizes (e.g. 32→2x4x4, 64→4x4x4, 256→4x8x8).
+    """
+    best: Optional[Tuple[int, int, int]] = None
+    for x in range(1, int(round(n ** (1 / 3))) + 1):
+        if n % x:
+            continue
+        m = n // x
+        for y in range(x, int(math.isqrt(m)) + 1):
+            if m % y:
+                continue
+            z = m // y
+            if z < y:
+                continue
+            cand = (x, y, z)
+            if best is None or (cand[2] - cand[0]) < (best[2] - best[0]):
+                best = cand
+    assert best is not None, n
+    return best
+
+
+def parse(accelerator_name: str,
+          accelerator_args: Optional[dict] = None) -> SliceTopology:
+    """Parse ``tpu-v5p-64`` (+ optional args) into a SliceTopology.
+
+    Raises InvalidRequestError for unknown generations, non-standard chip
+    counts, or a user topology inconsistent with the chip count.
+    """
+    accelerator_args = accelerator_args or {}
+    m = _ACC_RE.match(accelerator_name.strip())
+    if m is None:
+        raise exceptions.InvalidRequestError(
+            f'Not a TPU accelerator name: {accelerator_name!r}. Expected '
+            "e.g. 'tpu-v5e-8', 'tpu-v5p-64', 'tpu-v6e-16'.")
+    gen_name = m.group('gen').lower()
+    gen_name = _ALIAS_TO_GEN.get(gen_name, gen_name)
+    if gen_name not in GENERATIONS:
+        raise exceptions.InvalidRequestError(
+            f'Unknown TPU generation {gen_name!r} in {accelerator_name!r}. '
+            f'Known: {sorted(GENERATIONS)}.')
+    gen = GENERATIONS[gen_name]
+    count = int(m.group('count'))
+    if count <= 0:
+        raise exceptions.InvalidRequestError(
+            f'Bad TPU size in {accelerator_name!r}')
+
+    num_chips = count // gen.cores_per_chip if gen.cores_per_chip > 1 else count
+    if gen.cores_per_chip > 1 and count % gen.cores_per_chip:
+        raise exceptions.InvalidRequestError(
+            f'{accelerator_name}: {gen_name} sizes count TensorCores and must '
+            f'be a multiple of {gen.cores_per_chip}.')
+
+    topo = _resolve_topology(gen, num_chips,
+                             accelerator_args.get('topology'))
+    num_hosts, chips_per_host = _host_layout(gen, num_chips)
+
+    num_slices = int(accelerator_args.get('num_slices', 1))
+    if num_slices < 1:
+        raise exceptions.InvalidRequestError('num_slices must be >= 1')
+
+    canonical = f'tpu-{gen_name}-{count}'
+    return SliceTopology(accelerator_name=canonical,
+                         generation=gen,
+                         num_cores=count if gen.cores_per_chip > 1 else
+                         count * gen.cores_per_chip,
+                         num_chips=num_chips,
+                         topology=topo,
+                         num_hosts=num_hosts,
+                         chips_per_host=chips_per_host,
+                         num_slices=num_slices)
+
+
+def _resolve_topology(gen: TpuGeneration, num_chips: int,
+                      user_topology: Optional[str]) -> Tuple[int, ...]:
+    if user_topology:
+        dims = tuple(int(d) for d in str(user_topology).lower().split('x'))
+        if math.prod(dims) != num_chips:
+            raise exceptions.InvalidRequestError(
+                f'topology {user_topology} has {math.prod(dims)} chips; '
+                f'accelerator requests {num_chips}.')
+        return dims
+    if gen.ici_dims == 2:
+        shapes = _V5E_SHAPES if gen.name == 'v5e' else (
+            _V6E_SHAPES if gen.name == 'v6e' else None)
+        if shapes is not None:
+            if num_chips not in shapes:
+                raise exceptions.InvalidRequestError(
+                    f'tpu-{gen.name}-{num_chips}: valid sizes are '
+                    f'{sorted(shapes)}.')
+            return shapes[num_chips]
+        # v2/v3: square-ish 2-D
+        x = int(math.isqrt(num_chips))
+        while num_chips % x:
+            x -= 1
+        return (x, num_chips // x)
+    if num_chips not in list_standard_sizes(gen.name):
+        raise exceptions.InvalidRequestError(
+            f'tpu-{gen.name}: no standard {num_chips}-chip slice; valid '
+            f'chip counts are {list_standard_sizes(gen.name)} (pass an '
+            "explicit accelerator_args['topology'] for custom shapes).")
+    return _squarest_3d(num_chips)
+
+
+def _host_layout(gen: TpuGeneration, num_chips: int) -> Tuple[int, int]:
+    """(num_hosts, chips_per_host) for a slice of num_chips."""
+    if num_chips <= gen.max_chips_per_host:
+        return 1, num_chips
+    if gen.name in ('v6e',):
+        # v6e multi-host slices use 4-chip hosts (v6e-16 == 4 hosts,
+        # per the reference benchmark README examples/tpu/v6e/README.md:59).
+        cph = 4
+    else:
+        cph = gen.max_chips_per_host
+    if num_chips % cph:
+        raise exceptions.InvalidRequestError(
+            f'tpu-{gen.name}-{num_chips}: not divisible into {cph}-chip hosts')
+    return num_chips // cph, cph
+
+
+def list_standard_sizes(gen_name: str) -> List[int]:
+    """Chip counts of catalog-listed slice sizes for a generation."""
+    gen = GENERATIONS[gen_name]
+    if gen.name in ('v5e', 'v6e'):
+        return sorted(_V5E_SHAPES)
+    if gen.ici_dims == 3:
+        # 2x2x1(=4) isn't offered; ladder: 4 chips (v4-8/v5p-8) up by powers.
+        return [4, 8, 16, 32, 64, 128, 256, 512, 1024]
+    return [4, 8, 16, 32]

@@ -1,0 +1,34 @@
+"""Shared test fixtures.
+
+JAX tests run on a virtual 8-device CPU mesh (multi-chip sharding logic is
+testable without TPUs); orchestration tests enable the fake cloud.
+"""
+import os
+
+# Must be set before jax import anywhere in the test process.
+os.environ.setdefault('XLA_FLAGS',
+                      '--xla_force_host_platform_device_count=8')
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+import pytest
+
+from skypilot_tpu import check as check_lib
+
+
+@pytest.fixture
+def enable_fake_cloud(monkeypatch):
+    """Enable only the fake cloud (twin of reference enable_all_clouds,
+    tests/common_test_fixtures.py:191-253)."""
+    monkeypatch.setenv('XSKY_ENABLE_FAKE_CLOUD', '1')
+    check_lib.set_enabled_clouds_for_test(['fake'])
+    yield
+    check_lib.set_enabled_clouds_for_test(None)
+
+
+@pytest.fixture
+def enable_gcp_and_fake(monkeypatch):
+    """Pretend GCP credentials exist alongside the fake cloud."""
+    monkeypatch.setenv('XSKY_ENABLE_FAKE_CLOUD', '1')
+    check_lib.set_enabled_clouds_for_test(['gcp', 'fake'])
+    yield
+    check_lib.set_enabled_clouds_for_test(None)

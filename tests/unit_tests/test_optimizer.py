@@ -1,0 +1,148 @@
+"""Optimizer tests (twin of tests/test_optimizer_dryruns.py patterns)."""
+import pytest
+
+from skypilot_tpu import Dag, Optimizer, OptimizeTarget, Resources, Task
+from skypilot_tpu import exceptions
+from skypilot_tpu.optimizer import candidates_for_failover
+
+
+def _optimize_single(task, **kwargs):
+    with Dag() as dag:
+        dag.add(task)
+    return Optimizer.optimize(dag, quiet=True, **kwargs).tasks[0]
+
+
+class TestSingleTask:
+
+    def test_cheapest_cpu(self, enable_fake_cloud):
+        t = Task(run='echo hi')
+        t = _optimize_single(t)
+        assert t.best_resources.cloud_name == 'fake'
+        assert t.best_resources.instance_type == 'fake-cpu-4'
+
+    def test_tpu_slice(self, enable_fake_cloud):
+        t = Task(run='python train.py')
+        t.set_resources(Resources(accelerators='tpu-v5e-8'))
+        t = _optimize_single(t)
+        best = t.best_resources
+        assert best.is_tpu and best.cloud_name == 'fake'
+
+    def test_gpu_to_tpu_candidates(self, enable_fake_cloud):
+        """North star: A100 request yields TPU fallback candidates."""
+        t = Task(run='train')
+        t.set_resources([
+            Resources(accelerators='FAKEGPU:8'),
+            Resources(accelerators='tpu-v5e-8'),
+        ])
+        cands = candidates_for_failover(t)
+        names = [next(iter(c.accelerators)) for c in cands]
+        assert 'tpu-v5e-8' in names and 'FAKEGPU' in names
+        # Cheapest first: tpu-v5e-8 at $9.6 < FAKEGPU:8 at $20.
+        assert names[0] == 'tpu-v5e-8'
+
+    def test_blocked_resources_skip(self, enable_fake_cloud):
+        t = Task(run='train')
+        t.set_resources(Resources(accelerators='tpu-v5e-8'))
+        blocked = [Resources(cloud='fake', accelerators='tpu-v5e-8')]
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            _optimize_single(t, blocked_resources=blocked)
+
+    def test_region_blocked_leaves_other_regions(self, enable_fake_cloud):
+        t = Task(run='train')
+        t.set_resources(Resources(accelerators='tpu-v5e-8'))
+        blocked = [Resources(cloud='fake', region='fake-central1',
+                             accelerators='tpu-v5e-8')]
+        cands = candidates_for_failover(t, blocked_resources=blocked)
+        assert cands  # other regions still available
+
+    def test_infeasible_fuzzy_hint(self, enable_fake_cloud):
+        t = Task(run='train')
+        t.set_resources(Resources(accelerators={'tpu-v5e-16': 1}))
+        with pytest.raises(exceptions.ResourcesUnavailableError) as e:
+            _optimize_single(t)
+        assert 'tpu-v5e-8' in str(e.value) or 'tpu-v5e-32' in str(e.value)
+
+    def test_ordered_respected_over_price(self, enable_fake_cloud):
+        t = Task(run='train')
+        t.set_resources([
+            Resources(accelerators='FAKEGPU:8'),   # $20, user's first choice
+            Resources(accelerators='tpu-v5e-8'),   # $9.6, cheaper
+        ], ordered=True)
+        t = _optimize_single(t)
+        assert next(iter(t.best_resources.accelerators)) == 'FAKEGPU'
+
+    def test_spot_pricing_used(self, enable_fake_cloud):
+        t = Task(run='train')
+        t.set_resources(Resources(accelerators='tpu-v5e-8', use_spot=True))
+        t = _optimize_single(t)
+        assert t.best_resources.use_spot
+        assert t.best_resources.get_hourly_cost() == pytest.approx(3.36)
+
+    def test_no_cloud_enabled(self):
+        from skypilot_tpu import check as check_lib
+        check_lib.set_enabled_clouds_for_test([])
+        try:
+            t = Task(run='x')
+            with pytest.raises(exceptions.NoCloudAccessError):
+                _optimize_single(t)
+        finally:
+            check_lib.set_enabled_clouds_for_test(None)
+
+
+class TestDag:
+
+    def test_chain_egress_colocation(self, enable_gcp_and_fake,
+                                     monkeypatch):
+        """Downstream task colocates with upstream when egress dominates."""
+        from skypilot_tpu.clouds.fake import Fake
+        monkeypatch.setattr(Fake, 'get_egress_cost',
+                            lambda self, gb: 0.09 * gb)
+        train = Task('train', run='train')
+        train.set_resources(Resources(cloud='fake',
+                                      accelerators='tpu-v5e-8'))
+        train.estimated_outputs_gigabytes = 500  # big artifact
+        infer = Task('infer', run='infer')
+        infer.set_resources(Resources(cpus='2+'))  # gcp marginally cheaper
+        with Dag() as dag:
+            dag.add(train)
+            dag.add(infer)
+            dag.add_edge(train, infer)
+        Optimizer.optimize(dag, quiet=True)
+        # Without egress, gcp n2-standard-2 ($0.0971) beats fake-cpu-4
+        # ($0.10); 500 GB of cross-cloud egress flips the choice.
+        assert infer.best_resources.cloud_name == 'fake'
+
+    def test_time_target(self, enable_fake_cloud):
+        t = Task(run='x')
+        t.set_resources(Resources(accelerators='tpu-v5e-8'))
+        t = _optimize_single(t, minimize=OptimizeTarget.TIME)
+        assert t.best_resources is not None
+
+
+class TestDagStructure:
+
+    def test_is_chain(self):
+        a, b, c = Task('a', run='a'), Task('b', run='b'), Task('c', run='c')
+        dag = Dag()
+        dag.add_edge(a, b)
+        dag.add_edge(b, c)
+        assert dag.is_chain()
+        d = Task('d', run='d')
+        dag.add_edge(a, d)
+        assert not dag.is_chain()
+
+    def test_cycle_detection(self):
+        a, b = Task('a', run='a'), Task('b', run='b')
+        dag = Dag()
+        dag.add_edge(a, b)
+        dag.add_edge(b, a)
+        with pytest.raises(ValueError):
+            dag.validate()
+
+    def test_topological_order(self):
+        a, b, c = Task('a', run='a'), Task('b', run='b'), Task('c', run='c')
+        dag = Dag()
+        dag.add_edge(a, c)
+        dag.add_edge(b, c)
+        order = dag.topological_order()
+        assert order.index(c) == 2

@@ -1,0 +1,145 @@
+"""Resources parsing/validation tests (twin of tests/unit_tests/test_resources.py)."""
+import pytest
+
+from skypilot_tpu import Resources
+from skypilot_tpu import exceptions
+
+
+class TestAccelerators:
+
+    def test_gpu_string(self):
+        r = Resources(accelerators='A100:8')
+        assert r.accelerators == {'A100': 8}
+        assert not r.is_tpu
+
+    def test_gpu_default_count(self):
+        assert Resources(accelerators='A100').accelerators == {'A100': 1}
+
+    def test_tpu_name(self):
+        r = Resources(accelerators='tpu-v5p-64')
+        assert r.is_tpu
+        assert r.accelerators == {'tpu-v5p-64': 1}
+        assert r.tpu_topology.num_chips == 32
+        assert r.num_hosts_per_node == 8
+
+    def test_tpu_with_count_raises(self):
+        with pytest.raises(ValueError):
+            Resources(accelerators='tpu-v5e-8:2')
+
+    def test_tpu_multislice_hosts(self):
+        r = Resources(accelerators='tpu-v5e-32',
+                      accelerator_args={'num_slices': 2})
+        assert r.num_hosts_per_node == 8  # 4 hosts x 2 slices
+
+    def test_dict(self):
+        assert Resources(accelerators={'H100': 4}).accelerators == {'H100': 4}
+
+
+class TestValidation:
+
+    def test_unknown_cloud(self):
+        with pytest.raises(ValueError):
+            Resources(cloud='nonexistent')
+
+    def test_zone_infers_region(self):
+        r = Resources(cloud='gcp', zone='us-central1-a')
+        assert r.region == 'us-central1'
+
+    def test_bad_zone(self):
+        with pytest.raises(ValueError):
+            Resources(cloud='gcp', zone='mars-central1-a')
+
+    def test_bad_instance_type(self):
+        with pytest.raises(ValueError):
+            Resources(cloud='gcp', instance_type='bogus-128xlarge')
+
+    def test_cpus_plus_syntax(self):
+        assert Resources(cpus='4+').cpus == '4+'
+        assert Resources(cpus=4).cpus == '4'
+        with pytest.raises(ValueError):
+            Resources(cpus='four')
+
+
+class TestCost:
+
+    def test_tpu_hourly_cost(self):
+        r = Resources(cloud='gcp', accelerators='tpu-v5e-8')
+        assert r.get_hourly_cost() == pytest.approx(8 * 1.20)
+
+    def test_tpu_spot_cheaper(self):
+        od = Resources(cloud='gcp', accelerators='tpu-v5e-8')
+        spot = Resources(cloud='gcp', accelerators='tpu-v5e-8', use_spot=True)
+        assert spot.get_hourly_cost() < od.get_hourly_cost()
+
+    def test_vm_cost(self):
+        r = Resources(cloud='gcp', instance_type='a2-highgpu-8g')
+        assert r.get_hourly_cost() == pytest.approx(29.387)
+
+
+class TestSemantics:
+
+    def test_less_demanding_than(self):
+        small = Resources(accelerators='A100:4')
+        big = Resources(cloud='gcp', instance_type='a2-highgpu-8g',
+                        accelerators='A100:8')
+        assert small.less_demanding_than(big)
+        assert not Resources(accelerators='H100:8').less_demanding_than(big)
+
+    def test_copy_override(self):
+        r = Resources(accelerators='tpu-v5e-8')
+        r2 = r.copy(cloud='gcp', use_spot=True)
+        assert r2.cloud_name == 'gcp'
+        assert r2.use_spot
+        assert r.cloud_name is None  # original untouched
+
+    def test_yaml_roundtrip(self):
+        r = Resources(cloud='gcp', accelerators='tpu-v5p-64', use_spot=True,
+                      disk_size=100, ports=8080,
+                      accelerator_args={'runtime_version': 'v2-alpha-tpuv5'})
+        r2 = Resources.from_yaml_config(r.to_yaml_config())
+        assert r == r2
+
+    def test_any_of(self):
+        out = Resources.from_yaml_config({
+            'any_of': [{'accelerators': 'A100:8'},
+                       {'accelerators': 'tpu-v5e-8'}],
+            'use_spot': True,
+        })
+        assert isinstance(out, list) and len(out) == 2
+        assert all(r.use_spot for r in out)
+
+    def test_autostop_forms(self):
+        assert Resources(autostop=10).autostop == {'idle_minutes': 10,
+                                                   'down': False}
+        assert Resources(autostop=True).autostop['idle_minutes'] == 5
+        assert Resources(autostop=False).autostop is None
+        assert Resources(
+            autostop={'idle_minutes': 3, 'down': True}).autostop == {
+                'idle_minutes': 3, 'down': True}
+
+    def test_launchable(self):
+        assert not Resources(accelerators='A100').is_launchable()
+        assert Resources(cloud='gcp', accelerators='tpu-v5e-8').is_launchable()
+        assert Resources(cloud='gcp',
+                         instance_type='n2-standard-8').is_launchable()
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            Resources().assert_launchable()
+
+
+class TestFeatures:
+
+    def test_tpu_pod_features(self):
+        from skypilot_tpu.clouds import CloudImplementationFeatures as F
+        r = Resources(accelerators='tpu-v5p-64', use_spot=True)
+        feats = r.get_required_cloud_features()
+        assert F.TPU_POD in feats
+        assert F.SPOT_INSTANCE in feats
+
+    def test_gcp_pod_cannot_stop(self):
+        from skypilot_tpu.clouds import GCP, CloudImplementationFeatures as F
+        r = Resources(cloud='gcp', accelerators='tpu-v5p-64')
+        with pytest.raises(exceptions.NotSupportedError):
+            GCP.check_features_are_supported(r, {F.STOP})
+        # Single-host v5e-8 can stop fine.
+        r2 = Resources(cloud='gcp', accelerators='tpu-v5e-8')
+        GCP.check_features_are_supported(r2, {F.STOP})

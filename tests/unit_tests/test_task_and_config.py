@@ -1,0 +1,89 @@
+"""Task YAML + layered config tests."""
+import textwrap
+
+import pytest
+import yaml
+
+from skypilot_tpu import Resources, Task
+from skypilot_tpu import config as config_lib
+
+
+class TestTaskYaml:
+
+    def test_full_yaml(self, tmp_path):
+        yaml_str = textwrap.dedent("""\
+            name: train-llama
+            resources:
+              accelerators: tpu-v5p-64
+              use_spot: true
+              accelerator_args:
+                runtime_version: v2-alpha-tpuv5
+            num_nodes: 1
+            envs:
+              MODEL: llama3-8b
+            secrets:
+              HF_TOKEN: abc123
+            file_mounts:
+              /data: ~/local_data
+            setup: pip install -e .
+            run: python train.py --model $MODEL
+            """)
+        path = tmp_path / 'task.yaml'
+        path.write_text(yaml_str)
+        t = Task.from_yaml(str(path))
+        assert t.name == 'train-llama'
+        assert t.resources[0].is_tpu
+        assert t.resources[0].use_spot
+        assert t.envs == {'MODEL': 'llama3-8b'}
+        assert t.secrets == {'HF_TOKEN': 'abc123'}
+        assert t.file_mounts == {'/data': '~/local_data'}
+        # Roundtrip
+        t2 = Task.from_yaml_config(t.to_yaml_config())
+        assert t2.name == t.name
+        assert t2.resources[0] == t.resources[0]
+
+    def test_null_env_requires_override(self):
+        config = {'run': 'x', 'envs': {'TOKEN': None}}
+        with pytest.raises(ValueError):
+            Task.from_yaml_config(config)
+        t = Task.from_yaml_config(config, env_overrides={'TOKEN': 'v'})
+        assert t.envs['TOKEN'] == 'v'
+
+    def test_unknown_field_raises(self):
+        with pytest.raises(ValueError):
+            Task.from_yaml_config({'run': 'x', 'bogus_field': 1})
+
+    def test_num_nodes_validation(self):
+        with pytest.raises(ValueError):
+            Task(num_nodes=0)
+
+    def test_invalid_name(self):
+        with pytest.raises(ValueError):
+            Task(name='-bad-name-')
+
+
+class TestConfig:
+
+    def test_layering(self, tmp_path, monkeypatch):
+        server = tmp_path / 'server.yaml'
+        user = tmp_path / 'user.yaml'
+        server.write_text(yaml.safe_dump({
+            'gcp': {'project_id': 'server-proj', 'labels': {'a': '1'}}}))
+        user.write_text(yaml.safe_dump({
+            'gcp': {'project_id': 'user-proj'}}))
+        monkeypatch.setenv(config_lib.ENV_VAR_SERVER_CONFIG, str(server))
+        monkeypatch.setenv(config_lib.ENV_VAR_USER_CONFIG, str(user))
+        monkeypatch.chdir(tmp_path)
+        config_lib.reload_config()
+        # user overrides server for scalars; dicts merge.
+        assert config_lib.get_nested(('gcp', 'project_id')) == 'user-proj'
+        assert config_lib.get_nested(('gcp', 'labels', 'a')) == '1'
+        assert config_lib.get_nested(('missing', 'key'), 'dflt') == 'dflt'
+        config_lib.reload_config()
+
+    def test_override_context(self):
+        with config_lib.replace_for_test({'a': {'b': 1}}):
+            assert config_lib.get_nested(('a', 'b')) == 1
+            with config_lib.override({'a': {'b': 2}}):
+                assert config_lib.get_nested(('a', 'b')) == 2
+            assert config_lib.get_nested(('a', 'b')) == 1

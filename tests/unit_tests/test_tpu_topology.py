@@ -1,0 +1,84 @@
+"""TPU accelerator grammar / topology resolution tests."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import tpu_topology as tt
+
+
+class TestParse:
+
+    def test_v5e_single_host(self):
+        t = tt.parse('tpu-v5e-8')
+        assert t.num_chips == 8
+        assert t.num_hosts == 1
+        assert t.chips_per_host == 8
+        assert t.topology == (2, 4)
+        assert not t.is_pod
+
+    def test_v5e_pod(self):
+        t = tt.parse('tpu-v5e-32')
+        assert t.num_chips == 32
+        assert t.num_hosts == 4
+        assert t.chips_per_host == 8
+        assert t.is_pod
+
+    def test_v5p_counts_cores(self):
+        t = tt.parse('tpu-v5p-64')
+        assert t.num_chips == 32
+        assert t.num_hosts == 8
+        assert t.chips_per_host == 4
+        assert t.topology == (2, 4, 4)
+
+    def test_v5p_128_cube(self):
+        t = tt.parse('tpu-v5p-128')
+        assert t.num_chips == 64
+        assert t.topology == (4, 4, 4)
+        assert t.gcp_accelerator_type() == 'v5p-128'
+
+    def test_v6e_multihost_uses_4_chip_hosts(self):
+        # examples/tpu/v6e/README.md:59 — v6e-16 is 4 hosts.
+        t = tt.parse('tpu-v6e-16')
+        assert t.num_hosts == 4
+        assert t.chips_per_host == 4
+
+    def test_v5litepod_alias(self):
+        t = tt.parse('tpu-v5litepod-8')
+        assert t.accelerator_name == 'tpu-v5e-8'
+        assert t.gcp_accelerator_type() == 'v5litepod-8'
+
+    def test_case_insensitive_and_no_prefix(self):
+        assert tt.parse('TPU-V5E-8').accelerator_name == 'tpu-v5e-8'
+        assert tt.parse('v5e-8').accelerator_name == 'tpu-v5e-8'
+
+    def test_multislice(self):
+        t = tt.parse('tpu-v5e-256', {'num_slices': 4})
+        assert t.is_multislice
+        assert t.total_chips == 1024
+        assert t.total_hosts == 4 * 32
+
+    def test_explicit_topology(self):
+        t = tt.parse('tpu-v5p-128', {'topology': '2x4x8'})
+        assert t.topology == (2, 4, 8)
+
+    def test_topology_mismatch_raises(self):
+        with pytest.raises(exceptions.InvalidRequestError):
+            tt.parse('tpu-v5p-128', {'topology': '4x4x8'})
+
+    def test_invalid_size_raises(self):
+        with pytest.raises(exceptions.InvalidRequestError):
+            tt.parse('tpu-v5e-7')
+        with pytest.raises(exceptions.InvalidRequestError):
+            tt.parse('tpu-v5p-6')  # not divisible by 2 cores/chip... 6/2=3
+        with pytest.raises(exceptions.InvalidRequestError):
+            tt.parse('tpu-v9-8')
+
+    def test_is_tpu(self):
+        assert tt.is_tpu('tpu-v5e-8')
+        assert tt.is_tpu('v6e-256')
+        assert not tt.is_tpu('A100')
+        assert not tt.is_tpu(None)
+
+    def test_hbm_and_flops(self):
+        t = tt.parse('tpu-v6e-8')
+        assert t.hbm_gib == 8 * 32
+        assert t.peak_bf16_tflops == 8 * 918
