@@ -1,0 +1,101 @@
+"""Benchmark: Llama training throughput on the attached accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+Baseline (BASELINE.md): the reference trains Llama-3-8B (PyTorch/XLA FSDP,
+seq 8192, bs 16) at 0.476 samples/s on a v6e-8 —
+  0.476 * 8192 / 8 chips = 487 tok/s/chip
+  * 6 * 8.03e9 FLOPs/tok  = 23.5 model-TFLOP/s per chip.
+We report achieved model-TFLOP/s per chip on the same metric, so the
+comparison is hardware-normalized (per chip) and model-normalized (FLOPs,
+not samples). vs_baseline > 1.0 means more useful FLOPs per chip than the
+reference's published run.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+
+_BASELINE_MODEL_TFLOPS_PER_CHIP = 23.5  # see module docstring
+
+_PEAK_BF16_TFLOPS = {
+    'TPU v2': 45, 'TPU v3': 123, 'TPU v4': 275, 'TPU v5 lite': 197,
+    'TPU v5': 459, 'TPU v6 lite': 918, 'TPU v6e': 918, 'cpu': 1,
+}
+
+
+def _device_peak_tflops(device) -> float:
+    kind = getattr(device, 'device_kind', 'cpu')
+    for prefix, peak in _PEAK_BF16_TFLOPS.items():
+        if kind.startswith(prefix):
+            return float(peak)
+    return 100.0
+
+
+def _pick_config(platform: str, hbm_gib: float):
+    """Choose the largest train config that fits the chip."""
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.train import trainer as trainer_lib
+
+    if platform == 'cpu':
+        return trainer_lib.TrainConfig(
+            model=llama.LLAMA_TINY, global_batch_size=4, seq_len=128,
+            optimizer='adafactor', mesh_plan=mesh_lib.MeshPlan())
+
+    # ~1.2B-param Llama (same architecture family as the 8B baseline),
+    # adafactor like the reference run, bf16 params.
+    model = dataclasses.replace(llama.LLAMA3_1B, max_seq_len=2048)
+    batch = 8 if hbm_gib >= 24 else 4
+    return trainer_lib.TrainConfig(
+        model=model,
+        global_batch_size=batch,
+        seq_len=2048,
+        optimizer='adafactor',
+        mesh_plan=mesh_lib.MeshPlan())
+
+
+def main() -> None:
+    import jax
+
+    from skypilot_tpu.train import trainer as trainer_lib
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    hbm_gib = 16.0
+    try:
+        stats = devices[0].memory_stats()
+        hbm_gib = stats.get('bytes_limit', 16 << 30) / (1 << 30)
+    except Exception:  # pylint: disable=broad-except
+        pass
+
+    config = _pick_config(platform, hbm_gib)
+    trainer = trainer_lib.Trainer(config)
+    num_steps = 10 if platform != 'cpu' else 3
+    metrics = trainer_lib.measure_throughput(trainer, num_steps=num_steps,
+                                             warmup=2)
+
+    value = metrics['model_tflops_per_sec_per_chip']
+    peak = _device_peak_tflops(devices[0])
+    result = {
+        'metric': 'llama_train_model_tflops_per_chip',
+        'value': round(value, 2),
+        'unit': 'TFLOP/s/chip',
+        'vs_baseline': round(value / _BASELINE_MODEL_TFLOPS_PER_CHIP, 3),
+        'tokens_per_sec_per_chip': round(
+            metrics['tokens_per_sec_per_chip'], 1),
+        'mfu': round(value / peak, 4),
+        'step_time_s': round(metrics['step_time_s'], 4),
+        'device': getattr(devices[0], 'device_kind', platform),
+        'num_devices': metrics['num_devices'],
+        'model_params': trainer.config.model.num_params(),
+        'seq_len': trainer.config.seq_len,
+        'global_batch_size': trainer.config.global_batch_size,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -90,13 +90,11 @@ def get_entries_for_accelerator(
         region: Optional[str] = None,
         zone: Optional[str] = None) -> List[common.CatalogEntry]:
     """All zone-level offerings for an accelerator (case-insensitive name)."""
-    name = accelerator_name.lower() if tpu_topology.is_tpu(
-        accelerator_name) else accelerator_name
+    name = accelerator_name.lower()
     return common.filter_entries(
-        cloud, lambda e:
-        (e.accelerator_name.lower() == name.lower() if e.is_tpu else e.
-         accelerator_name == name) and e.accelerator_count ==
-        accelerator_count and (region is None or e.region == region) and
+        cloud, lambda e: e.accelerator_name.lower() == name and e.
+        accelerator_count == accelerator_count and
+        (region is None or e.region == region) and
         (zone is None or e.zone == zone))
 
 

@@ -163,16 +163,10 @@ class CatalogCloud(cloud_lib.Cloud):
         return self._finish(resources, candidates), fuzzy
 
     def _finish(self, request, candidates):
-        if request.use_spot:
-            # Offerings without a spot price cannot be launched as spot.
-            kept = []
-            for c in candidates:
-                try:
-                    price = c.get_hourly_cost()
-                except ValueError:
-                    continue
-                kept.append(c)
-            candidates = kept
+        # Note: 0.0-priced offerings (unpublished pricing, e.g. v6e in some
+        # regions — see fetch_gcp) stay launchable for both spot and
+        # on-demand; the optimizer ranks them after all known prices.
+        del request
         return candidates
 
     # ---- TPU helpers ----

@@ -1,0 +1,91 @@
+"""Attention ops: XLA reference implementation + dispatch to Pallas flash.
+
+The XLA path is the correctness reference (and the CPU-test path); on TPU
+the Pallas flash kernel (`skypilot_tpu.ops.flash_attention`) is used for
+long sequences where materializing the S×S score matrix would blow HBM.
+
+Shapes follow the framework convention: q [B, S, H, D], k/v [B, S, Hkv, D]
+(Hkv <= H, grouped-query attention).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_FLASH_MIN_SEQ = 1024  # below this XLA's fused softmax is already fine
+
+
+def _repeat_kv(k: jax.Array, num_groups: int) -> jax.Array:
+    if num_groups == 1:
+        return k
+    b, s, h_kv, d = k.shape
+    k = jnp.broadcast_to(k[:, :, :, None, :], (b, s, h_kv, num_groups, d))
+    return k.reshape(b, s, h_kv * num_groups, d)
+
+
+def xla_attention(q: jax.Array,
+                  k: jax.Array,
+                  v: jax.Array,
+                  causal: bool = True,
+                  segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """Reference attention in pure XLA (fp32 softmax)."""
+    b, s_q, h, d = q.shape
+    s_kv = k.shape[1]
+    groups = h // k.shape[2]
+    k = _repeat_kv(k, groups)
+    v = _repeat_kv(v, groups)
+    scale = d ** -0.5
+    logits = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = jnp.arange(s_q)[:, None] + (s_kv - s_q)
+        kv_pos = jnp.arange(s_kv)[None, :]
+        mask = q_pos >= kv_pos
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        logits = jnp.where(seg_mask[:, None], logits, -1e30)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum('bhqk,bkhd->bqhd', probs.astype(v.dtype), v)
+
+
+def xla_attention_with_mask(q: jax.Array, k: jax.Array, v: jax.Array,
+                            mask: jax.Array) -> jax.Array:
+    """Attention with an explicit boolean mask [B, 1|H, S_q|1, S_kv].
+
+    Used by the decode path (KV-cache validity mask).
+    """
+    b, s_q, h, d = q.shape
+    groups = h // k.shape[2]
+    k = _repeat_kv(k, groups)
+    v = _repeat_kv(v, groups)
+    scale = d ** -0.5
+    logits = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                        preferred_element_type=jnp.float32) * scale
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum('bhqk,bkhd->bqhd', probs.astype(v.dtype), v)
+
+
+def dot_product_attention(q: jax.Array,
+                          k: jax.Array,
+                          v: jax.Array,
+                          causal: bool = True,
+                          segment_ids: Optional[jax.Array] = None,
+                          implementation: str = 'auto') -> jax.Array:
+    """Dispatching attention entry point used by the models.
+
+    implementation: 'auto' | 'xla' | 'flash'.
+    """
+    if implementation == 'auto':
+        on_tpu = any(d.platform == 'tpu' for d in jax.devices())
+        use_flash = (on_tpu and q.shape[1] >= _FLASH_MIN_SEQ and
+                     segment_ids is None and causal)
+        implementation = 'flash' if use_flash else 'xla'
+    if implementation == 'flash':
+        from skypilot_tpu.ops import flash_attention
+        return flash_attention.flash_attention(q, k, v, causal=causal)
+    return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)

@@ -1,0 +1,221 @@
+"""Flash attention for TPU in Pallas (forward) + chunked backward.
+
+Forward is a Pallas kernel: online-softmax over KV blocks, accumulator in
+VMEM, causal blocks skipped on the MXU (FlashAttention-2 schedule adapted to
+the TPU grid model: the KV dimension is the innermost grid axis and running
+stats live in VMEM scratch that persists across grid steps).
+
+Backward is blockwise XLA (`lax.scan` over Q blocks, recomputing P from the
+saved LSE): O(S·block) memory like flash backward, while letting XLA fuse
+the matmuls — measured faster than a naive Pallas port on v5e because the
+dq/dk/dv contractions are pure MXU work XLA already schedules well.
+
+Layout convention: q [B, S, H, D], k/v [B, S, Hkv, D] (GQA supported by
+logical head replication, resolved without materialization).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_KV = 512
+_NEG_INF = -1e30
+_LANES = 128  # row-stat scratch minor dim (TPU lane width)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale: float, causal: bool,
+                block_q: int, block_kv: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_kv = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = qi * block_q
+    kv_start = ki * block_kv
+
+    # Whole block above the diagonal → nothing to do.
+    run = True
+    if causal:
+        run = q_start + block_q - 1 >= kv_start
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]                                   # [bq, d]
+        k = k_ref[0]                                   # [bkv, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bkv]
+        if causal:
+            # Mask only needed on diagonal-crossing blocks.
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            kv_pos = kv_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+
+        m_prev = m_ref[:, 0:1]                         # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)      # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)                # [bq, 1]
+        p = jnp.exp(s - m_new)                         # [bq, bkv]
+        l_new = l_ref[:, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0]                                   # [bkv, d]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, d]
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == num_kv - 1)
+    def _finalize():
+        l = l_ref[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:] + jnp.log(l_safe)).astype(jnp.float32)
+
+
+def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
+               block_q: int, block_kv: int
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Returns (out [B,H,S,D], lse [B,H,S,LANES])... internally BHSD."""
+    b, h, s, d = q.shape
+    s_kv = k.shape[2]
+    block_q = min(block_q, s)
+    block_kv = min(block_kv, s_kv)
+    assert s % block_q == 0 and s_kv % block_kv == 0, (s, s_kv, block_q,
+                                                      block_kv)
+    grid = (b * h, s // block_q, s_kv // block_kv)
+    scale = d ** -0.5
+
+    qr = q.reshape(b * h, s, d)
+    kr = k.reshape(b * h, s_kv, d)
+    vr = v.reshape(b * h, s_kv, d)
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_kv=block_kv)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=_should_interpret(),
+    )(qr, kr, vr)
+    return (out.reshape(b, h, s, d), lse[:, :, 0].reshape(b, h, s))
+
+
+def _should_interpret() -> bool:
+    return jax.default_backend() != 'tpu'
+
+
+def _bwd_chunked(residuals, dout, *, causal: bool, block_q: int):
+    """Blockwise XLA backward from saved LSE (flash-style memory)."""
+    q, k, v, out, lse = residuals  # q/out [B,H,S,D]; k/v [B,H,Skv,D]
+    b, h, s, d = q.shape
+    s_kv = k.shape[2]
+    scale = d ** -0.5
+    block_q = min(block_q, s)
+    num_blocks = s // block_q
+
+    kv_pos = jnp.arange(s_kv)
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)  # [B,H,S]
+
+    def one_block(carry, idx):
+        dk_acc, dv_acc = carry
+        sl = idx * block_q
+        qb = jax.lax.dynamic_slice_in_dim(q, sl, block_q, axis=2)
+        dob = jax.lax.dynamic_slice_in_dim(dout, sl, block_q, axis=2)
+        lseb = jax.lax.dynamic_slice_in_dim(lse, sl, block_q, axis=2)
+        deltab = jax.lax.dynamic_slice_in_dim(delta, sl, block_q, axis=2)
+        sb = jnp.einsum('bhqd,bhkd->bhqk', qb, k,
+                        preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = sl + jnp.arange(block_q)
+            mask = q_pos[:, None] >= kv_pos[None, :]
+            sb = jnp.where(mask[None, None], sb, _NEG_INF)
+        p = jnp.exp(sb - lseb[..., None])                    # [B,H,bq,Skv]
+        dv = jnp.einsum('bhqk,bhqd->bhkd', p, dob.astype(jnp.float32))
+        dp = jnp.einsum('bhqd,bhkd->bhqk', dob.astype(jnp.float32),
+                        v.astype(jnp.float32))
+        ds = p * (dp - deltab[..., None]) * scale
+        dqb = jnp.einsum('bhqk,bhkd->bhqd', ds, k.astype(jnp.float32))
+        dk = jnp.einsum('bhqk,bhqd->bhkd', ds, qb.astype(jnp.float32))
+        return (dk_acc + dk, dv_acc + dv), dqb.astype(q.dtype)
+
+    init = (jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32))
+    (dk, dv), dq_blocks = jax.lax.scan(one_block, init,
+                                       jnp.arange(num_blocks))
+    # dq_blocks: [num_blocks, B, H, block_q, D] → [B,H,S,D]
+    dq = jnp.moveaxis(dq_blocks, 0, 2).reshape(b, h, s, d)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_bhsd(q, k, v, causal, block_q, block_kv):
+    out, _ = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                        block_kv=block_kv)
+    return out
+
+
+def _flash_bhsd_fwd(q, k, v, causal, block_q, block_kv):
+    out, lse = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                          block_kv=block_kv)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bhsd_bwd(causal, block_q, block_kv, residuals, dout):
+    del block_kv
+    return _bwd_chunked(residuals, dout, causal=causal, block_q=block_q)
+
+
+_flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_kv: int = DEFAULT_BLOCK_KV) -> jax.Array:
+    """Flash attention; q [B,S,H,D], k/v [B,S,Hkv,D] (GQA) → [B,S,H,D]."""
+    b, s, h, d = q.shape
+    h_kv = k.shape[2]
+    groups = h // h_kv
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    if groups > 1:
+        # Fold the group into the batch of the kernel grid by repeating KV
+        # head *indices* (gather, not materialized broadcast, under jit).
+        kt = jnp.repeat(kt, groups, axis=1)
+        vt = jnp.repeat(vt, groups, axis=1)
+    out = _flash_bhsd(qt, kt, vt, causal, block_q, block_kv)
+    return jnp.transpose(out, (0, 2, 1, 3))

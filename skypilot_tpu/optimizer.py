@@ -123,7 +123,9 @@ def _fill_in_launchable_resources(
                         continue
                     per_request.append((cand, cost))
             if not t.resources_ordered:
-                per_request.sort(key=lambda rc: rc[1])
+                # 0.0 means 'price unpublished' (e.g. v6e in some
+                # regions): launchable, but ranked after known prices.
+                per_request.sort(key=lambda rc: (rc[1] == 0, rc[1]))
             all_candidates.extend(per_request)
         if not all_candidates:
             hint = ''
@@ -135,7 +137,7 @@ def _fill_in_launchable_resources(
                 f'{t.name or "<unnamed>"} '
                 f'(requested: {t.resources}).{hint}')
         if not t.resources_ordered:
-            all_candidates.sort(key=lambda rc: rc[1])
+            all_candidates.sort(key=lambda rc: (rc[1] == 0, rc[1]))
         result[t] = all_candidates
     return result
 
@@ -212,9 +214,14 @@ def _solve_chain_dp(tasks, dag, candidates, minimize):
                 back.append(-1)
                 continue
             prev_t = tasks[i - 1]
+            # is_chain() also admits disconnected forests; only charge
+            # egress when an actual edge links the consecutive tasks.
+            has_edge = t in dag.downstream(prev_t)
             best, best_k = float('inf'), -1
             for k, (prev_res, _) in enumerate(candidates[prev_t]):
-                egress = _egress_cost(prev_res, res, _edge_gigabytes(prev_t))
+                egress = _egress_cost(prev_res, res,
+                                      _edge_gigabytes(prev_t)) \
+                    if has_edge else 0.0
                 val = dp[i - 1][k] + egress
                 if val < best:
                     best, best_k = val, k

@@ -1,0 +1,166 @@
+"""Device mesh construction + logical-axis sharding rules.
+
+The scaling-book recipe, in code: pick a mesh (dp × fsdp × tp × sp × ep ×
+stage over ICI, an outer dcn axis across slices), annotate arrays with
+*logical* axis names, map logical → physical via rules, and let XLA insert
+the collectives. All parallelism strategies the reference orchestrates via
+recipes (SURVEY §2.12: DP/TP/PP/EP/SP/FSDP) are expressible as MeshPlans.
+
+Reference parity note: the reference injects env for torchrun+NCCL
+(sky/backends/cloud_vm_ray_backend.py:606-670); here the same role is played
+by `skypilot_tpu.parallel.distributed` which derives
+`jax.distributed.initialize` args from gang-launcher env, and this module
+which shapes the devices into a mesh.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Canonical mesh axis order: outermost (slowest, DCN-friendly) first.
+# data/stage tolerate DCN latency (gradient reduce / p2p activations);
+# fsdp/sequence/expert/tensor need ICI bandwidth.
+MESH_AXES = ('data', 'stage', 'fsdp', 'sequence', 'expert', 'tensor')
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """Degree of each parallelism axis. -1 on `data` means 'absorb rest'."""
+    data: int = -1
+    stage: int = 1      # pipeline stages
+    fsdp: int = 1       # param/grad/optimizer sharding (ZeRO-3 twin)
+    sequence: int = 1   # context parallelism (ring attention axis)
+    expert: int = 1     # MoE expert parallelism
+    tensor: int = 1     # megatron-style tensor parallelism
+
+    def resolve(self, num_devices: int) -> 'MeshPlan':
+        sizes = dataclasses.asdict(self)
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        free = [k for k, v in sizes.items() if v == -1]
+        if len(free) > 1:
+            raise ValueError('At most one mesh axis may be -1.')
+        if free:
+            if num_devices % fixed:
+                raise ValueError(
+                    f'{num_devices} devices not divisible by fixed axes '
+                    f'product {fixed} ({sizes}).')
+            sizes[free[0]] = num_devices // fixed
+        elif fixed != num_devices:
+            raise ValueError(
+                f'Mesh plan {sizes} needs {fixed} devices, got '
+                f'{num_devices}.')
+        return MeshPlan(**sizes)
+
+    def axis_sizes(self) -> Tuple[int, ...]:
+        d = dataclasses.asdict(self)
+        return tuple(d[a] for a in MESH_AXES)
+
+
+def build_mesh(plan: Optional[MeshPlan] = None,
+               devices: Optional[Sequence[jax.Device]] = None,
+               num_slices: int = 1) -> Mesh:
+    """Build a Mesh over devices.
+
+    Within one slice, `mesh_utils.create_device_mesh` arranges devices so
+    adjacent mesh coordinates are ICI neighbors. With num_slices > 1, the
+    'data' axis is laid out across slices first so only gradient reduction
+    rides DCN (megascale).
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    plan = (plan or MeshPlan()).resolve(len(devices))
+    shape = plan.axis_sizes()
+    if num_slices > 1:
+        if plan.data % num_slices:
+            raise ValueError(
+                f'data axis ({plan.data}) must be a multiple of num_slices '
+                f'({num_slices}) for multislice layout.')
+        from jax.experimental import mesh_utils
+        per_slice = len(devices) // num_slices
+        dcn_shape = (num_slices, 1, 1, 1, 1, 1)
+        ici_shape = (plan.data // num_slices,) + shape[1:]
+        device_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices)
+    else:
+        try:
+            from jax.experimental import mesh_utils
+            device_array = mesh_utils.create_device_mesh(
+                shape, devices=devices)
+        except (ValueError, AssertionError):
+            device_array = np.asarray(devices).reshape(shape)
+    return Mesh(device_array, MESH_AXES)
+
+
+# ---- logical axis rules ---------------------------------------------------
+# Arrays are annotated with logical axis names; these rules map them onto
+# mesh axes (first matching rule wins). MaxText-style layout.
+
+LogicalRules = Tuple[Tuple[str, Any], ...]
+
+DEFAULT_RULES: LogicalRules = (
+    ('batch', ('data', 'fsdp')),          # activations: batch over dp+fsdp
+    ('activation_length', 'sequence'),    # context parallelism
+    ('activation_embed', None),
+    ('activation_heads', 'tensor'),
+    ('activation_kv', None),
+    ('activation_mlp', 'tensor'),
+    ('embed', 'fsdp'),                    # params: embed dim over fsdp
+    ('heads', 'tensor'),
+    ('kv', None),
+    ('mlp', 'tensor'),
+    ('vocab', 'tensor'),
+    ('expert', 'expert'),
+    ('layers', None),                     # scanned-layer leading axis
+    ('stage', 'stage'),
+)
+
+
+def logical_to_spec(logical_axes: Sequence[Optional[str]],
+                    rules: LogicalRules = DEFAULT_RULES) -> PartitionSpec:
+    rule_map = dict(rules)
+    spec: List[Any] = []
+    used: set = set()
+    for name in logical_axes:
+        target = rule_map.get(name) if name is not None else None
+        if target is None:
+            spec.append(None)
+            continue
+        targets = target if isinstance(target, tuple) else (target,)
+        # A mesh axis may shard at most one array dimension.
+        targets = tuple(t for t in targets if t not in used)
+        used.update(targets)
+        if not targets:
+            spec.append(None)
+        elif len(targets) == 1:
+            spec.append(targets[0])
+        else:
+            spec.append(targets)
+    return PartitionSpec(*spec)
+
+
+def named_sharding(mesh: Mesh, logical_axes: Sequence[Optional[str]],
+                   rules: LogicalRules = DEFAULT_RULES) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_spec(logical_axes, rules))
+
+
+def shard_logical(x, mesh: Mesh, logical_axes: Sequence[Optional[str]],
+                  rules: LogicalRules = DEFAULT_RULES):
+    """with_sharding_constraint by logical axis names (inside jit)."""
+    return jax.lax.with_sharding_constraint(
+        x, named_sharding(mesh, logical_axes, rules))
+
+
+def tree_shardings(mesh: Mesh, logical_tree,
+                   rules: LogicalRules = DEFAULT_RULES):
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda axes: named_sharding(mesh, axes, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x))

@@ -1,0 +1,69 @@
+"""Provisioner dispatch (twin of sky/provision/__init__.py:41-211).
+
+Each cloud implements a module ``skypilot_tpu.provision.<name>.instance``
+exporting the op-set below; calls route by cloud name. All ops are
+idempotent with respect to cluster_name tags.
+"""
+from __future__ import annotations
+
+import importlib
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common
+
+
+def _impl(provider_name: str):
+    return importlib.import_module(
+        f'skypilot_tpu.provision.{provider_name}.instance')
+
+
+def run_instances(provider_name: str, region: str, zone: Optional[str],
+                  cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    return _impl(provider_name).run_instances(region, zone, cluster_name,
+                                              config)
+
+
+def stop_instances(provider_name: str, cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    _impl(provider_name).stop_instances(cluster_name, provider_config)
+
+
+def terminate_instances(provider_name: str, cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    _impl(provider_name).terminate_instances(cluster_name, provider_config)
+
+
+def query_instances(provider_name: str, cluster_name: str,
+                    provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    """instance_id → status (None if terminated)."""
+    return _impl(provider_name).query_instances(cluster_name,
+                                                provider_config)
+
+
+def wait_instances(provider_name: str, region: str, cluster_name: str,
+                   state: str) -> None:
+    _impl(provider_name).wait_instances(region, cluster_name, state)
+
+
+def get_cluster_info(provider_name: str, region: str,
+                     cluster_name: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    return _impl(provider_name).get_cluster_info(region, cluster_name,
+                                                 provider_config or {})
+
+
+def open_ports(provider_name: str, cluster_name: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    impl = _impl(provider_name)
+    if hasattr(impl, 'open_ports'):
+        impl.open_ports(cluster_name, ports, provider_config)
+
+
+def cleanup_ports(provider_name: str, cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    impl = _impl(provider_name)
+    if hasattr(impl, 'cleanup_ports'):
+        impl.cleanup_ports(cluster_name, provider_config)

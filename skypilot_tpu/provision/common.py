@@ -1,0 +1,94 @@
+"""Provisioner data model (twin of sky/provision/common.py:305).
+
+TPU-first change: an *instance* here is always one **host**. A multi-host
+TPU slice surfaces as N InstanceInfos sharing a `slice_id`, so higher
+layers (gang launcher, rsync fan-out, rank math) iterate hosts uniformly —
+the reference instead threads `num_ips_per_node` through the backend
+(sky/backends/cloud_vm_ray_backend.py:2613) as a special case.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class InstanceInfo:
+    instance_id: str
+    internal_ip: str
+    external_ip: Optional[str]
+    status: str                      # PENDING | RUNNING | STOPPED | ...
+    tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+    slice_id: Optional[str] = None   # TPU slice this host belongs to
+    host_index: int = 0              # index within its slice
+    ssh_port: int = 22
+
+    def get_feasible_ip(self) -> str:
+        return self.external_ip or self.internal_ip
+
+
+@dataclasses.dataclass
+class ProvisionConfig:
+    """Input to run_instances for one cluster."""
+    provider_config: Dict[str, Any]    # cloud-specific (project, etc.)
+    node_config: Dict[str, Any]        # deploy vars from the Cloud
+    count: int                         # logical nodes
+    tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+    resume_stopped_nodes: bool = True
+    ports_to_open_on_launch: Optional[List[str]] = None
+
+
+@dataclasses.dataclass
+class ProvisionRecord:
+    """Output of a successful run_instances."""
+    provider_name: str
+    cluster_name: str
+    region: str
+    zone: Optional[str]
+    resumed_instance_ids: List[str]
+    created_instance_ids: List[str]
+    head_instance_id: Optional[str] = None
+
+    def is_instance_just_booted(self, instance_id: str) -> bool:
+        return (instance_id in self.created_instance_ids or
+                instance_id in self.resumed_instance_ids)
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """Full host inventory of a cluster (possibly multiple TPU slices)."""
+    instances: Dict[str, InstanceInfo]
+    head_instance_id: Optional[str]
+    provider_name: str
+    provider_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    ssh_user: str = 'root'
+    custom_ray_options: Optional[Dict[str, Any]] = None  # unused (no Ray)
+
+    def get_head_instance(self) -> Optional[InstanceInfo]:
+        if self.head_instance_id is None:
+            return None
+        return self.instances.get(self.head_instance_id)
+
+    def sorted_instances(self) -> List[InstanceInfo]:
+        """Stable host order: head first, then by (slice_id, host_index).
+
+        This ordering defines global host ranks for gang launch.
+        """
+        infos = list(self.instances.values())
+
+        def key(i: InstanceInfo):
+            is_head = (i.instance_id == self.head_instance_id)
+            return (not is_head, i.slice_id or '', i.host_index,
+                    i.instance_id)
+
+        return sorted(infos, key=key)
+
+    def get_feasible_ips(self, internal: bool = False) -> List[str]:
+        return [
+            i.internal_ip if internal else i.get_feasible_ip()
+            for i in self.sorted_instances()
+        ]
+
+    @property
+    def num_instances(self) -> int:
+        return len(self.instances)

@@ -1,0 +1,180 @@
+"""In-memory provisioner for the fake cloud — the failover test harness.
+
+Plays moto's role from the reference's tests (tests/test_failover.py:34-60):
+clusters live in a module-level store; capacity/quota errors are scripted
+per zone via :class:`FailureInjector`; preemption is simulated by calling
+:func:`preempt_cluster` out-of-band (the reference smoke tests terminate
+instances manually, smoke_tests_utils.py:33-36).
+
+TPU semantics modeled faithfully:
+  * a TPU node_config (tpu_vm=True) creates `tpu_num_hosts × num_slices`
+    host InstanceInfos sharing slice ids;
+  * multi-host slices refuse stop_instances (NotSupportedError), like
+    the real TPU API.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+
+_lock = threading.RLock()
+# cluster_name → {'zone': str, 'region': str, 'instances': {id: InstanceInfo},
+#                 'head_id': str, 'node_config': dict}
+_clusters: Dict[str, Dict[str, Any]] = {}
+_ip_counter = [10]
+
+
+class FailureInjector:
+    """Scripted provisioning failures, keyed by zone (or '*')."""
+
+    def __init__(self) -> None:
+        self._errors: Dict[str, List[Exception]] = {}
+        self.attempts: List[str] = []   # zones tried, in order
+
+    def fail_zone(self, zone: str, error: Exception,
+                  times: int = 10**9) -> None:
+        self._errors.setdefault(zone, []).extend([error] * min(times, 1000))
+
+    def check(self, zone: str) -> None:
+        self.attempts.append(zone)
+        for key in (zone, '*'):
+            queue = self._errors.get(key)
+            if queue:
+                raise queue.pop(0)
+
+    def reset(self) -> None:
+        self._errors.clear()
+        self.attempts.clear()
+
+
+injector = FailureInjector()
+
+
+def reset() -> None:
+    with _lock:
+        _clusters.clear()
+        injector.reset()
+
+
+def _next_ip() -> str:
+    with _lock:
+        _ip_counter[0] += 1
+        n = _ip_counter[0]
+    return f'10.0.{n // 256}.{n % 256}'
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    zone = zone or f'{region}-a'
+    with _lock:
+        injector.check(zone)
+        existing = _clusters.get(cluster_name)
+        if existing is not None:
+            resumed = []
+            for info in existing['instances'].values():
+                if info.status == 'STOPPED':
+                    info.status = 'RUNNING'
+                    resumed.append(info.instance_id)
+            return common.ProvisionRecord(
+                provider_name='fake', cluster_name=cluster_name,
+                region=existing['region'], zone=existing['zone'],
+                resumed_instance_ids=resumed, created_instance_ids=[],
+                head_instance_id=existing['head_id'])
+
+        node_cfg = config.node_config
+        is_tpu = node_cfg.get('tpu_vm', False)
+        hosts_per_slice = node_cfg.get('tpu_num_hosts', 1) if is_tpu else 1
+        num_slices = node_cfg.get('tpu_num_slices', 1) if is_tpu else 1
+        instances: Dict[str, common.InstanceInfo] = {}
+        head_id = None
+        for node in range(config.count):
+            for s in range(num_slices):
+                slice_id = (f'{cluster_name}-n{node}-slice{s}'
+                            if is_tpu else None)
+                for h in range(hosts_per_slice):
+                    iid = f'fake-{uuid.uuid4().hex[:8]}'
+                    ip = _next_ip()
+                    instances[iid] = common.InstanceInfo(
+                        instance_id=iid, internal_ip=ip, external_ip=ip,
+                        status='RUNNING',
+                        tags={'cluster_name': cluster_name,
+                              'node_index': str(node)},
+                        slice_id=slice_id,
+                        host_index=s * hosts_per_slice + h)
+                    if head_id is None:
+                        head_id = iid
+        _clusters[cluster_name] = {
+            'region': region, 'zone': zone, 'instances': instances,
+            'head_id': head_id, 'node_config': dict(node_cfg),
+        }
+        return common.ProvisionRecord(
+            provider_name='fake', cluster_name=cluster_name, region=region,
+            zone=zone, resumed_instance_ids=[],
+            created_instance_ids=list(instances), head_instance_id=head_id)
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    with _lock:
+        cluster = _clusters.get(cluster_name)
+        if cluster is None:
+            return
+        if cluster['node_config'].get('tpu_vm') and \
+                cluster['node_config'].get('tpu_num_hosts', 1) > 1:
+            raise exceptions.NotSupportedError(
+                'Multi-host TPU slices cannot be stopped.')
+        for info in cluster['instances'].values():
+            info.status = 'STOPPED'
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    with _lock:
+        _clusters.pop(cluster_name, None)
+
+
+def query_instances(cluster_name: str, provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    with _lock:
+        cluster = _clusters.get(cluster_name)
+        if cluster is None:
+            return {}
+        return {iid: info.status
+                for iid, info in cluster['instances'].items()}
+
+
+def wait_instances(region: str, cluster_name: str, state: str) -> None:
+    return  # fake instances transition instantly
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
+    with _lock:
+        cluster = _clusters.get(cluster_name)
+        if cluster is None:
+            raise exceptions.ClusterDoesNotExist(cluster_name)
+        return common.ClusterInfo(
+            instances={k: dataclasses.replace(v)
+                       for k, v in cluster['instances'].items()},
+            head_instance_id=cluster['head_id'],
+            provider_name='fake',
+            provider_config=dict(provider_config or {}),
+            ssh_user='fake-user')
+
+
+# ---- test helpers ----------------------------------------------------------
+
+
+def preempt_cluster(cluster_name: str) -> None:
+    """Simulate a spot preemption: instances vanish out-of-band."""
+    terminate_instances(cluster_name, {})
+
+
+def cluster_exists(cluster_name: str) -> bool:
+    with _lock:
+        return cluster_name in _clusters

@@ -88,14 +88,14 @@ class Resources:
 
     @staticmethod
     def _canonical_spec(spec) -> Optional[str]:
+        """Normalize '4' / '4+' / '16GB' / '16GB+' / 16 → '4' / '4+' / ..."""
         if spec is None:
             return None
         s = str(spec).strip()
-        if s.endswith('+'):
-            float(s[:-1])  # validate
-        else:
-            float(s)
-        return s
+        plus = s.endswith('+')
+        value = common_utils.parse_memory_gb(s)  # also strips GB/GiB/G
+        text = common_utils.format_float(value)
+        return f'{text}+' if plus else text
 
     def _canonical_accelerators(self, acc) -> Optional[Dict[str, float]]:
         """Normalize 'A100', 'A100:8', 'tpu-v5e-8', {...} → {name: count}."""

@@ -72,10 +72,10 @@ class Task:
                 raise ValueError(f'Invalid env var name {key!r}')
         if self.workdir is not None:
             expanded = os.path.expanduser(self.workdir)
-            if not os.path.isdir(expanded) and not os.path.isabs(expanded):
-                # Relative workdirs are resolved at launch; only flag
-                # obviously-absent absolute paths.
-                pass
+            if os.path.isabs(expanded) and not os.path.isdir(expanded):
+                raise ValueError(
+                    f'workdir {self.workdir!r} does not exist or is not a '
+                    'directory. (Relative workdirs resolve at launch.)')
 
     # ---- resources ----
 

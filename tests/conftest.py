@@ -6,9 +6,18 @@ testable without TPUs); orchestration tests enable the fake cloud.
 import os
 
 # Must be set before jax import anywhere in the test process.
-os.environ.setdefault('XLA_FLAGS',
-                      '--xla_force_host_platform_device_count=8')
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+_xla_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _xla_flags:
+    os.environ['XLA_FLAGS'] = (
+        _xla_flags + ' --xla_force_host_platform_device_count=8').strip()
+# Tests always run on the virtual CPU mesh, even when a TPU is attached
+# (the real chip is for bench.py). The axon sitecustomize force-registers
+# the TPU backend and overrides JAX_PLATFORMS, so the env var alone is not
+# enough — set the config knob before any jax computation.
+os.environ['JAX_PLATFORMS'] = 'cpu'
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
 
 import pytest
 
