@@ -1,0 +1,47 @@
+"""Pluggable admin policy hook (twin of sky/admin_policy.py:246).
+
+Config key ``admin_policy`` names a class path; the class implements
+``apply(dag) -> dag`` to mutate/validate every request centrally, or
+raises to reject (UserRequestRejectedByPolicy).
+"""
+from __future__ import annotations
+
+import importlib
+from typing import Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+
+
+class AdminPolicy:
+    """Subclass and point config `admin_policy` at it."""
+
+    def apply(self, dag: dag_lib.Dag) -> dag_lib.Dag:
+        return dag
+
+
+def _load_policy() -> Optional[AdminPolicy]:
+    path = config_lib.get_nested(('admin_policy',))
+    if not path:
+        return None
+    module_name, _, class_name = path.rpartition('.')
+    try:
+        cls = getattr(importlib.import_module(module_name), class_name)
+        return cls()
+    except (ImportError, AttributeError) as e:
+        raise exceptions.InvalidSkyTpuConfigError(
+            f'admin_policy {path!r} could not be loaded: {e}') from e
+
+
+def apply(dag: dag_lib.Dag) -> dag_lib.Dag:
+    policy = _load_policy()
+    if policy is None:
+        return dag
+    try:
+        return policy.apply(dag)
+    except exceptions.UserRequestRejectedByPolicy:
+        raise
+    except Exception as e:
+        raise exceptions.UserRequestRejectedByPolicy(
+            f'Admin policy rejected the request: {e}') from e

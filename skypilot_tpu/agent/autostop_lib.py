@@ -1,0 +1,58 @@
+"""Autostop config + idle tracking on the head (twin of
+sky/skylet/autostop_lib.py)."""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from skypilot_tpu.agent import job_lib
+
+_CONFIG_FILE = 'autostop.json'
+
+
+def _path(root: Optional[str] = None) -> str:
+    return os.path.join(root or job_lib.cluster_root(), _CONFIG_FILE)
+
+
+def set_autostop(idle_minutes: int, down: bool,
+                 root: Optional[str] = None) -> None:
+    os.makedirs(root or job_lib.cluster_root(), exist_ok=True)
+    with open(_path(root), 'w', encoding='utf-8') as f:
+        json.dump({'idle_minutes': idle_minutes, 'down': down,
+                   'set_at': time.time()}, f)
+
+
+def get_autostop(root: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_path(root), encoding='utf-8') as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def clear_autostop(root: Optional[str] = None) -> None:
+    try:
+        os.remove(_path(root))
+    except FileNotFoundError:
+        pass
+
+
+def set_last_active_time_to_now(root: Optional[str] = None) -> None:
+    config = get_autostop(root)
+    if config is not None:
+        config['set_at'] = time.time()
+        with open(_path(root), 'w', encoding='utf-8') as f:
+            json.dump(config, f)
+
+
+def should_autostop(root: Optional[str] = None) -> bool:
+    """True when the idle deadline passed with no active/pending jobs."""
+    config = get_autostop(root)
+    if config is None or config['idle_minutes'] < 0:
+        return False
+    if not job_lib.is_cluster_idle(root):
+        return False
+    last_active = max(job_lib.last_activity_time(root), config['set_at'])
+    return time.time() - last_active >= config['idle_minutes'] * 60

@@ -1,0 +1,63 @@
+"""Head-node agent daemon (twin of sky/skylet/skylet.py:17-35 + events.py).
+
+Periodic loop on the cluster head: schedule queued jobs, enforce autostop,
+touch a heartbeat. Started detached by the backend after provisioning
+(twin of start_skylet_on_head_node, sky/provision/instance_setup.py:471).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from skypilot_tpu.agent import autostop_lib
+from skypilot_tpu.agent import job_lib
+
+EVENT_INTERVAL_S = 20
+
+
+def _tick_scheduler(root: str) -> None:
+    job_lib.claim_and_spawn(root)
+
+
+def _tick_autostop(root: str) -> None:
+    if not autostop_lib.should_autostop(root):
+        return
+    config = autostop_lib.get_autostop(root) or {}
+    # Self-teardown: signal via a marker file the control plane polls
+    # (on real clouds the agent calls the provisioner API directly with
+    # the cluster's identity; the fake cloud has no on-host credentials).
+    marker = os.path.join(root, 'autostop_triggered.json')
+    with open(marker, 'w', encoding='utf-8') as f:
+        json.dump({'down': config.get('down', False),
+                   'triggered_at': time.time()}, f)
+    autostop_lib.clear_autostop(root)
+
+
+def _heartbeat(root: str) -> None:
+    with open(os.path.join(root, 'agent_heartbeat'), 'w',
+              encoding='utf-8') as f:
+        f.write(str(time.time()))
+
+
+def run_forever(root: str = None, interval_s: float = EVENT_INTERVAL_S,
+                max_ticks: int = None) -> None:
+    root = root or job_lib.cluster_root()
+    os.makedirs(root, exist_ok=True)
+    ticks = 0
+    while True:
+        for event in (_tick_scheduler, _tick_autostop, _heartbeat):
+            try:
+                event(root)
+            except Exception as e:  # pylint: disable=broad-except
+                print(f'agent event {event.__name__} failed: {e}',
+                      file=sys.stderr)
+        ticks += 1
+        if max_ticks is not None and ticks >= max_ticks:
+            return
+        time.sleep(interval_s)
+
+
+if __name__ == '__main__':
+    run_forever()

@@ -1,0 +1,192 @@
+"""Gang launcher: all-or-nothing job start across every TPU host.
+
+Replaces the reference's RayCodeGen + STRICT_SPREAD placement group
+(sky/backends/cloud_vm_ray_backend.py:394-538) with a direct per-host
+launcher driven from the head:
+
+  * one process per TPU host (a "node" that is a pod slice contributes
+    all its hosts — `InstanceInfo.slice_id`/`host_index`);
+  * rank env injection (XSKY_* twins of SKYPILOT_NODE_RANK/... from
+    cloud_vm_ray_backend.py:606-670 and constants.py:350-353), plus the
+    JAX/libtpu coordinator env (`jax.distributed` over ICI, megascale
+    vars across slices) the reference leaves to user recipes;
+  * gang semantics: if any host fails to start or exits non-zero, every
+    other host's process is killed (twin of the placement-group barrier +
+    Ray task failure propagation).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import subprocess
+import time
+from typing import Dict, List, Optional, Sequence
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.utils import command_runner as runner_lib
+
+logger = sky_logging.init_logger(__name__)
+
+COORDINATOR_PORT = 8476
+MEGASCALE_PORT = 8477
+
+
+@dataclasses.dataclass
+class HostSpec:
+    """One gang participant."""
+    runner: runner_lib.CommandRunner
+    env: Dict[str, str]
+    host_rank: int
+
+
+def build_host_envs(
+        cluster_info: provision_common.ClusterInfo,
+        job_envs: Optional[Dict[str, str]] = None) -> List[Dict[str, str]]:
+    """Per-host environment for gang launch, in rank order.
+
+    Derives node ranks, host ranks, and the JAX/libtpu coordinator wiring
+    from the host inventory alone.
+    """
+    hosts = cluster_info.sorted_instances()
+    num_hosts = len(hosts)
+
+    # Logical nodes (for XSKY_NODE_RANK): group by node_index tag.
+    node_ids: List[str] = []
+    node_of_host: List[int] = []
+    node_head_ip: Dict[int, str] = {}
+    for h in hosts:
+        node_key = h.tags.get('node_index', '0')
+        if node_key not in node_ids:
+            node_ids.append(node_key)
+        node_idx = node_ids.index(node_key)
+        node_of_host.append(node_idx)
+        node_head_ip.setdefault(node_idx, h.internal_ip)
+
+    # Slices (for megascale): group by slice_id.
+    slice_ids: List[Optional[str]] = []
+    for h in hosts:
+        if h.slice_id not in slice_ids:
+            slice_ids.append(h.slice_id)
+    num_slices = len([s for s in slice_ids if s is not None]) or 1
+    slice_hosts: Dict[Optional[str], List[provision_common.InstanceInfo]] = {}
+    for h in hosts:
+        slice_hosts.setdefault(h.slice_id, []).append(h)
+
+    coordinator_ip = hosts[0].internal_ip
+    envs: List[Dict[str, str]] = []
+    for rank, h in enumerate(hosts):
+        env = dict(job_envs or {})
+        env.update({
+            'XSKY_NODE_RANK': str(node_of_host[rank]),
+            'XSKY_NUM_NODES': str(len(node_ids)),
+            'XSKY_NODE_IPS': '\n'.join(
+                node_head_ip[i] for i in range(len(node_ids))),
+            'XSKY_HOST_RANK': str(rank),
+            'XSKY_NUM_HOSTS': str(num_hosts),
+            'XSKY_COORDINATOR_ADDRESS':
+                f'{coordinator_ip}:{COORDINATOR_PORT}',
+        })
+        if h.slice_id is not None:
+            peers = slice_hosts[h.slice_id]
+            env.update({
+                'TPU_WORKER_ID': str(h.host_index),
+                'TPU_WORKER_HOSTNAMES': ','.join(
+                    p.internal_ip for p in peers),
+            })
+            if num_slices > 1:
+                slice_index = [s for s in slice_ids
+                               if s is not None].index(h.slice_id)
+                env.update({
+                    'MEGASCALE_COORDINATOR_ADDRESS':
+                        f'{coordinator_ip}:{MEGASCALE_PORT}',
+                    'MEGASCALE_NUM_SLICES': str(num_slices),
+                    'MEGASCALE_SLICE_ID': str(slice_index),
+                })
+        envs.append(env)
+    return envs
+
+
+@dataclasses.dataclass
+class GangResult:
+    returncodes: List[int]
+
+    @property
+    def success(self) -> bool:
+        return all(rc == 0 for rc in self.returncodes)
+
+    @property
+    def first_failure_rank(self) -> Optional[int]:
+        for i, rc in enumerate(self.returncodes):
+            if rc != 0:
+                return i
+        return None
+
+
+def gang_launch(runners: Sequence[runner_lib.CommandRunner],
+                host_envs: Sequence[Dict[str, str]],
+                command: str,
+                log_dir: str,
+                poll_interval_s: float = 0.2,
+                timeout_s: Optional[float] = None,
+                cwd: Optional[str] = None) -> GangResult:
+    """Start `command` on all hosts; kill everyone on first failure.
+
+    Logs go to ``{log_dir}/host-{rank}.log`` (rank 0 additionally to
+    ``run.log`` for `tail_logs` compatibility).
+    """
+    assert len(runners) == len(host_envs)
+    os.makedirs(log_dir, exist_ok=True)
+    procs: List[subprocess.Popen] = []
+    try:
+        for rank, (runner, env) in enumerate(zip(runners, host_envs)):
+            log_path = os.path.join(log_dir, f'host-{rank}.log')
+            procs.append(
+                runner.run_async(command, env=env, log_path=log_path,
+                                 cwd=cwd))
+    except Exception:
+        for p in procs:
+            p.kill()
+        raise
+
+    deadline = time.time() + timeout_s if timeout_s else None
+    returncodes: List[Optional[int]] = [None] * len(procs)
+    while True:
+        for i, p in enumerate(procs):
+            if returncodes[i] is None:
+                returncodes[i] = p.poll()
+        failed = [rc for rc in returncodes if rc not in (None, 0)]
+        if failed:
+            # Gang semantics: one non-zero exit kills the whole job.
+            for i, p in enumerate(procs):
+                if returncodes[i] is None:
+                    p.terminate()
+            for i, p in enumerate(procs):
+                if returncodes[i] is None:
+                    try:
+                        returncodes[i] = p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                        returncodes[i] = -9
+            break
+        if all(rc is not None for rc in returncodes):
+            break
+        if deadline and time.time() > deadline:
+            for p in procs:
+                p.kill()
+            returncodes = [rc if rc is not None else -15
+                           for rc in returncodes]
+            break
+        time.sleep(poll_interval_s)
+
+    # Symlink rank-0 log as run.log for the default log tail.
+    rank0 = os.path.join(log_dir, 'host-0.log')
+    run_log = os.path.join(log_dir, 'run.log')
+    if os.path.exists(rank0) and not os.path.exists(run_log):
+        try:
+            os.symlink('host-0.log', run_log)
+        except OSError:
+            pass
+    return GangResult([rc if rc is not None else -1
+                       for rc in returncodes])

@@ -1,0 +1,74 @@
+"""Tiny CLI the backend invokes on the cluster head (one code path for
+local/fake and SSH clusters). Twin of the reference's codegen-over-SSH
+pattern (sky/skylet/job_lib.py codegen + sky/jobs/utils.py ManagedJobCodeGen).
+
+Commands: add | status | queue | cancel | tail | run-detached.
+Spec payloads travel base64(json) to survive shell quoting.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import os
+import subprocess
+import sys
+
+from skypilot_tpu.agent import job_lib
+
+
+def _decode_spec(b64: str) -> dict:
+    return json.loads(base64.b64decode(b64).decode())
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    cmd = argv[0]
+    root = job_lib.cluster_root()
+
+    if cmd == 'add':
+        name, user, spec_b64 = argv[1], argv[2], argv[3]
+        job_id = job_lib.add_job(None if name == '-' else name, user,
+                                 _decode_spec(spec_b64), root)
+        print(job_id)
+        return 0
+
+    if cmd == 'run-detached':
+        job_id = int(argv[1])
+        # Atomic claim: only starts if the FIFO scheduler agrees it is
+        # this job's turn, and no other scheduler claimed it first.
+        claimed = job_lib.claim_and_spawn(root, job_id)
+        print('started' if claimed == job_id else 'queued')
+        return 0
+
+    if cmd == 'status':
+        job = job_lib.get_job(int(argv[1]), root)
+        print(job['status'].value if job else 'NOT_FOUND')
+        return 0
+
+    if cmd == 'queue':
+        jobs = job_lib.get_jobs(root)
+        for j in jobs:
+            j['status'] = j['status'].value
+        print(json.dumps(jobs))
+        return 0
+
+    if cmd == 'cancel':
+        ok = job_lib.cancel_job(int(argv[1]), root)
+        print('cancelled' if ok else 'noop')
+        return 0
+
+    if cmd == 'tail':
+        job_id = int(argv[1])
+        log_path = os.path.join(job_lib.log_dir_for(job_id, root),
+                                'run.log')
+        if os.path.exists(log_path):
+            with open(log_path, encoding='utf-8', errors='replace') as f:
+                sys.stdout.write(f.read())
+        return 0
+
+    print(f'unknown command {cmd}', file=sys.stderr)
+    return 2
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,249 @@
+"""Per-cluster job queue (sqlite), FIFO-scheduled on the head host.
+
+Twin of sky/skylet/job_lib.py (JobStatus:147, JobScheduler:230,
+FIFOScheduler:309). The cluster runtime dir (``~/.xsky`` on the head; an
+arbitrary root for fake clusters, via XSKY_CLUSTER_ROOT) holds jobs.db,
+cluster_info.json and logs/.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+
+class JobStatus(enum.Enum):
+    INIT = 'INIT'
+    PENDING = 'PENDING'
+    SETTING_UP = 'SETTING_UP'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (JobStatus.SUCCEEDED, JobStatus.FAILED,
+                        JobStatus.FAILED_SETUP, JobStatus.CANCELLED)
+
+
+TERMINAL_STATUSES = [s.value for s in JobStatus if s.is_terminal()]
+
+
+def cluster_root() -> str:
+    return os.path.expanduser(
+        os.environ.get('XSKY_CLUSTER_ROOT', '~/.xsky'))
+
+
+def _db(root: Optional[str] = None) -> sqlite3.Connection:
+    root = root or cluster_root()
+    os.makedirs(root, exist_ok=True)
+    conn = sqlite3.connect(os.path.join(root, 'jobs.db'), timeout=30,
+                           check_same_thread=False)
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS jobs (
+            job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            name TEXT,
+            username TEXT,
+            submitted_at REAL,
+            started_at REAL,
+            ended_at REAL,
+            status TEXT,
+            spec TEXT,
+            pid INTEGER
+        )""")
+    conn.commit()
+    return conn
+
+
+def add_job(name: Optional[str], username: str, spec: Dict[str, Any],
+            root: Optional[str] = None) -> int:
+    conn = _db(root)
+    cur = conn.execute(
+        'INSERT INTO jobs (name, username, submitted_at, status, spec) '
+        'VALUES (?, ?, ?, ?, ?)',
+        (name, username, time.time(), JobStatus.PENDING.value,
+         json.dumps(spec)))
+    conn.commit()
+    job_id = cur.lastrowid
+    conn.close()
+    return job_id
+
+
+def set_status(job_id: int, status: JobStatus,
+               root: Optional[str] = None) -> None:
+    conn = _db(root)
+    now = time.time()
+    if status == JobStatus.RUNNING:
+        conn.execute('UPDATE jobs SET status=?, started_at=? '
+                     'WHERE job_id=?', (status.value, now, job_id))
+    elif status.is_terminal():
+        conn.execute('UPDATE jobs SET status=?, ended_at=? WHERE job_id=?',
+                     (status.value, now, job_id))
+    else:
+        conn.execute('UPDATE jobs SET status=? WHERE job_id=?',
+                     (status.value, job_id))
+    conn.commit()
+    conn.close()
+
+
+def set_pid(job_id: int, pid: int, root: Optional[str] = None) -> None:
+    conn = _db(root)
+    conn.execute('UPDATE jobs SET pid=? WHERE job_id=?', (pid, job_id))
+    conn.commit()
+    conn.close()
+
+
+def get_job(job_id: int, root: Optional[str] = None
+            ) -> Optional[Dict[str, Any]]:
+    conn = _db(root)
+    row = conn.execute('SELECT * FROM jobs WHERE job_id=?',
+                       (job_id,)).fetchone()
+    conn.close()
+    return _row_to_dict(row) if row else None
+
+
+def get_jobs(root: Optional[str] = None) -> List[Dict[str, Any]]:
+    conn = _db(root)
+    rows = conn.execute(
+        'SELECT * FROM jobs ORDER BY job_id DESC').fetchall()
+    conn.close()
+    return [_row_to_dict(r) for r in rows]
+
+
+def _row_to_dict(row) -> Dict[str, Any]:
+    (job_id, name, username, submitted_at, started_at, ended_at, status,
+     spec, pid) = row
+    return {
+        'job_id': job_id,
+        'job_name': name,
+        'username': username,
+        'submitted_at': submitted_at,
+        'started_at': started_at,
+        'ended_at': ended_at,
+        'status': JobStatus(status),
+        'spec': json.loads(spec or '{}'),
+        'pid': pid,
+    }
+
+
+def next_job_to_run(root: Optional[str] = None) -> Optional[int]:
+    """FIFO: earliest PENDING job, but only if nothing is active.
+
+    Read-only peek; use :func:`claim_next_job` to actually take it.
+    """
+    conn = _db(root)
+    active = conn.execute(
+        "SELECT COUNT(*) FROM jobs WHERE status IN "
+        "('SETTING_UP', 'RUNNING', 'INIT')").fetchone()[0]
+    if active:
+        conn.close()
+        return None
+    row = conn.execute(
+        "SELECT job_id FROM jobs WHERE status='PENDING' "
+        'ORDER BY job_id LIMIT 1').fetchone()
+    conn.close()
+    return row[0] if row else None
+
+
+def claim_next_job(root: Optional[str] = None,
+                   job_id: Optional[int] = None) -> Optional[int]:
+    """Atomically claim the next runnable job (PENDING → INIT).
+
+    Multiple schedulers race here (daemon tick, run-detached, the
+    post-job tick); BEGIN IMMEDIATE serializes them so a job is spawned
+    exactly once. With `job_id`, claim only that specific job.
+    """
+    conn = _db(root)
+    try:
+        conn.execute('BEGIN IMMEDIATE')
+        active = conn.execute(
+            "SELECT COUNT(*) FROM jobs WHERE status IN "
+            "('SETTING_UP', 'RUNNING', 'INIT')").fetchone()[0]
+        if active:
+            conn.execute('ROLLBACK')
+            return None
+        row = conn.execute(
+            "SELECT job_id FROM jobs WHERE status='PENDING' "
+            'ORDER BY job_id LIMIT 1').fetchone()
+        if row is None or (job_id is not None and row[0] != job_id):
+            conn.execute('ROLLBACK')
+            return None
+        job_id = row[0]
+        cur = conn.execute(
+            "UPDATE jobs SET status='INIT' WHERE job_id=? AND "
+            "status='PENDING'", (job_id,))
+        if cur.rowcount != 1:
+            conn.execute('ROLLBACK')
+            return None
+        conn.execute('COMMIT')
+        return job_id
+    finally:
+        conn.close()
+
+
+def claim_and_spawn(root: Optional[str] = None,
+                    job_id: Optional[int] = None) -> Optional[int]:
+    """Claim the next runnable job and spawn a detached job_runner for it.
+
+    The single spawn path shared by the daemon tick, `job_cli
+    run-detached` and the post-job scheduler tick.
+    """
+    import subprocess
+    import sys
+    root = root or cluster_root()
+    claimed = claim_next_job(root, job_id)
+    if claimed is None:
+        return None
+    env = dict(os.environ, XSKY_CLUSTER_ROOT=root)
+    subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.agent.job_runner',
+         str(claimed)],
+        env=env, start_new_session=True,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    return claimed
+
+
+def cancel_job(job_id: int, root: Optional[str] = None) -> bool:
+    job = get_job(job_id, root)
+    if job is None or job['status'].is_terminal():
+        return False
+    if job['pid']:
+        try:
+            os.killpg(os.getpgid(job['pid']), 15)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                os.kill(job['pid'], 15)
+            except (ProcessLookupError, PermissionError, OSError):
+                pass
+    set_status(job_id, JobStatus.CANCELLED, root)
+    return True
+
+
+def is_cluster_idle(root: Optional[str] = None) -> bool:
+    """No pending or active job (twin of job_lib.is_cluster_idle:817)."""
+    conn = _db(root)
+    active = conn.execute(
+        "SELECT COUNT(*) FROM jobs WHERE status NOT IN (%s)" %
+        ','.join('?' * len(TERMINAL_STATUSES)),
+        TERMINAL_STATUSES).fetchone()[0]
+    conn.close()
+    return active == 0
+
+
+def last_activity_time(root: Optional[str] = None) -> float:
+    conn = _db(root)
+    row = conn.execute(
+        'SELECT MAX(COALESCE(ended_at, started_at, submitted_at)) '
+        'FROM jobs').fetchone()
+    conn.close()
+    return row[0] or 0.0
+
+
+def log_dir_for(job_id: int, root: Optional[str] = None) -> str:
+    root = root or cluster_root()
+    return os.path.join(root, 'logs', f'job-{job_id}')

@@ -1,0 +1,95 @@
+"""Executes one queued job: gang launch across all hosts, from the head.
+
+Run as ``python -m skypilot_tpu.agent.job_runner <job_id>`` inside the
+cluster runtime dir (XSKY_CLUSTER_ROOT). This is the twin of the generated
+Ray driver program the reference submits per job
+(sky/backends/cloud_vm_ray_backend.py:232-731), as a permanent module
+instead of codegen.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from skypilot_tpu.agent import gang
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.utils import command_runner as runner_lib
+
+
+def _load_cluster_info(root: str) -> provision_common.ClusterInfo:
+    with open(os.path.join(root, 'cluster_info.json'),
+              encoding='utf-8') as f:
+        return provision_common.ClusterInfo.from_json(json.load(f))
+
+
+def _build_runners(info: provision_common.ClusterInfo):
+    # Head→worker traffic stays on the VPC: use internal IPs.
+    return runner_lib.runners_from_cluster_info(
+        info, info.provider_config.get('ssh_private_key',
+                                       '~/.ssh/xsky-key'),
+        internal_ips=True)
+
+
+def run_job(job_id: int, root: str = None) -> int:
+    root = root or job_lib.cluster_root()
+    job = job_lib.get_job(job_id, root)
+    if job is None:
+        print(f'Job {job_id} not found', file=sys.stderr)
+        return 1
+    spec = job['spec']
+    info = _load_cluster_info(root)
+    runners = _build_runners(info)
+    log_dir = job_lib.log_dir_for(job_id, root)
+
+    try:
+        host_envs = gang.build_host_envs(info, spec.get('envs') or {})
+        for env in host_envs:
+            env['XSKY_JOB_ID'] = str(job_id)
+
+        cwd = spec.get('cwd')  # same dir for setup and run
+        setup_cmd = spec.get('setup')
+        if setup_cmd:
+            job_lib.set_status(job_id, job_lib.JobStatus.SETTING_UP, root)
+            result = gang.gang_launch(runners, host_envs, setup_cmd,
+                                      os.path.join(log_dir, 'setup'),
+                                      cwd=cwd)
+            if not result.success:
+                job_lib.set_status(job_id, job_lib.JobStatus.FAILED_SETUP,
+                                   root)
+                return 1
+
+        run_cmd = spec.get('run')
+        if not run_cmd:
+            job_lib.set_status(job_id, job_lib.JobStatus.SUCCEEDED, root)
+            return 0
+        job_lib.set_status(job_id, job_lib.JobStatus.RUNNING, root)
+        result = gang.gang_launch(runners, host_envs, run_cmd, log_dir,
+                                  timeout_s=spec.get('timeout_s'),
+                                  cwd=cwd)
+        status = (job_lib.JobStatus.SUCCEEDED
+                  if result.success else job_lib.JobStatus.FAILED)
+        job_lib.set_status(job_id, status, root)
+        return 0 if result.success else 1
+    except BaseException:
+        job_lib.set_status(job_id, job_lib.JobStatus.FAILED, root)
+        raise
+    finally:
+        _schedule_next(root)
+
+
+def _schedule_next(root: str) -> None:
+    """Event-driven FIFO tick (twin of JobSchedulerEvent)."""
+    job_lib.claim_and_spawn(root)
+
+
+def main() -> int:
+    job_id = int(sys.argv[1])
+    root = job_lib.cluster_root()
+    job_lib.set_pid(job_id, os.getpid(), root)
+    return run_job(job_id, root)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,69 @@
+"""Backend ABC + ResourceHandle (twin of sky/backends/backend.py)."""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, Generic, Optional, TypeVar
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+
+
+class ResourceHandle:
+    """Pickled into the cluster table; identifies a live cluster."""
+
+    def get_cluster_name(self) -> str:
+        raise NotImplementedError
+
+
+_HandleT = TypeVar('_HandleT', bound=ResourceHandle)
+
+
+class Backend(Generic[_HandleT]):
+    """Cluster lifecycle + job execution contract."""
+
+    NAME = 'backend'
+
+    # ---- lifecycle ----
+
+    def provision(self,
+                  task: 'task_lib.Task',
+                  to_provision: Optional[Any],
+                  dryrun: bool = False,
+                  stream_logs: bool = True,
+                  cluster_name: Optional[str] = None,
+                  retry_until_up: bool = False) -> Optional[_HandleT]:
+        raise NotImplementedError
+
+    def sync_workdir(self, handle: _HandleT, workdir: str) -> None:
+        raise NotImplementedError
+
+    def sync_file_mounts(self, handle: _HandleT,
+                         all_file_mounts: Optional[Dict[str, str]],
+                         storage_mounts: Optional[Dict[str, Any]]) -> None:
+        raise NotImplementedError
+
+    def setup(self, handle: _HandleT, task: 'task_lib.Task',
+              detach_setup: bool = False) -> None:
+        raise NotImplementedError
+
+    def execute(self, handle: _HandleT, task: 'task_lib.Task',
+                detach_run: bool = False,
+                dryrun: bool = False) -> Optional[int]:
+        """Submit the task as a job; returns job id."""
+        raise NotImplementedError
+
+    def teardown(self, handle: _HandleT, terminate: bool,
+                 purge: bool = False) -> None:
+        raise NotImplementedError
+
+    # ---- job ops ----
+
+    def cancel_jobs(self, handle: _HandleT, job_ids) -> None:
+        raise NotImplementedError
+
+    def get_job_status(self, handle: _HandleT, job_id: int):
+        raise NotImplementedError
+
+    def tail_logs(self, handle: _HandleT, job_id: Optional[int],
+                  follow: bool = True) -> str:
+        raise NotImplementedError

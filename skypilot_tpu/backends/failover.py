@@ -1,0 +1,208 @@
+"""Failover engine: zone → region → next-SKU retry with typed errors.
+
+Twin of RetryingVmProvisioner (sky/backends/cloud_vm_ray_backend.py:1143:
+_yield_zones:1189, _retry_zones:1317, provision_with_retries:2001) and the
+FailoverCloudErrorHandlers (:749,876) — re-architected: provisioners raise
+*typed* ProvisionErrors (skypilot_tpu/exceptions.py) instead of the engine
+parsing per-cloud log strings, and the blocklist is expressed as partial
+Resources fed back to the optimizer, which naturally yields GPU→TPU
+fallback (the north-star scenario) because TPU slices are ordinary
+candidates.
+
+Block scopes per error type:
+  CapacityError               → (cloud, zone, accelerator)
+  QueuedResourceTimeoutError  → (cloud, zone, accelerator)
+  QuotaExceededError          → (cloud, region, accelerator)
+  PermissionError_            → (cloud,)
+  InvalidRequestError         → no failover; re-raise
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.provision import common as provision_common
+
+logger = sky_logging.init_logger(__name__)
+
+
+@dataclasses.dataclass
+class ProvisionResult:
+    """Successful bring-up of a cluster's instances."""
+    resources: resources_lib.Resources      # concrete, with region/zone
+    record: provision_common.ProvisionRecord
+    cluster_info: provision_common.ClusterInfo
+    num_nodes: int
+
+
+class RetryingProvisioner:
+
+    def __init__(self,
+                 requested_task: task_lib.Task,
+                 cluster_name: str,
+                 num_nodes: int,
+                 provider_config: Optional[Dict[str, Any]] = None,
+                 max_sku_retries: int = 20) -> None:
+        self._task = requested_task
+        self._cluster_name = cluster_name
+        self._num_nodes = num_nodes
+        self._provider_config = provider_config or {}
+        self._max_sku_retries = max_sku_retries
+        self.blocked: List[resources_lib.Resources] = []
+        self.failover_history: List[Exception] = []
+
+    # ---- public ----
+
+    def provision_with_retries(self) -> ProvisionResult:
+        """Walk optimizer candidates until one provisions."""
+        for _ in range(self._max_sku_retries):
+            try:
+                candidates = optimizer_lib.candidates_for_failover(
+                    self._task, self.blocked)
+            except exceptions.ResourcesUnavailableError as e:
+                raise e.with_failover_history(self.failover_history)
+            resources = candidates[0]
+            result = self._try_resources(resources)
+            if result is not None:
+                return result
+            # Every (region, zone) of this SKU is exhausted: block the SKU
+            # itself so the optimizer moves to the next-cheapest candidate
+            # (incl. GPU→TPU / TPU→GPU jumps).
+            self.blocked.append(
+                resources_lib.Resources(
+                    cloud=resources.cloud_name,
+                    accelerators=resources.accelerators,
+                    instance_type=None if resources.is_tpu
+                    else resources.instance_type))
+        raise exceptions.ResourcesUnavailableError(
+            'Exhausted provisioning retries for '
+            f'{self._cluster_name}.').with_failover_history(
+                self.failover_history)
+
+    # ---- internals ----
+
+    def _block(self, resources: resources_lib.Resources,
+               zone: Optional[str], region: Optional[str],
+               whole_cloud: bool = False) -> None:
+        blocked = resources_lib.Resources(
+            cloud=resources.cloud_name,
+            accelerators=None if whole_cloud else resources.accelerators,
+            instance_type=None if (whole_cloud or resources.is_tpu)
+            else resources.instance_type,
+            region=None if whole_cloud else region,
+            zone=None if whole_cloud else zone,
+        )
+        self.blocked.append(blocked)
+
+    def _try_resources(
+            self,
+            resources: resources_lib.Resources
+    ) -> Optional[ProvisionResult]:
+        """Try every (region, zone) for one concrete SKU. None ⇒ move to
+        the optimizer's next candidate (blocklist updated)."""
+        cloud = resources.cloud
+        regions = cloud.regions_with_offering(
+            resources.instance_type or '', resources.accelerators,
+            resources.use_spot, resources.region, resources.zone)
+        for region in regions:
+            zones = [resources.zone] if resources.zone else region.zones
+            for zone in zones:
+                if self._is_scope_blocked(resources, region.name, zone):
+                    continue
+                outcome = self._try_zone(resources, region.name, zone)
+                if outcome is not None:
+                    return outcome
+                if self._gave_up_on(resources):
+                    return None
+        return None
+
+    def _is_scope_blocked(self, resources: resources_lib.Resources,
+                          region: str, zone: Optional[str]) -> bool:
+        """Does the blocklist already cover (resources, region, zone)?"""
+        probe = resources.copy(region=region, zone=zone)
+        return optimizer_lib._is_blocked(probe, self.blocked)  # pylint: disable=protected-access
+
+    def _gave_up_on(self, resources: resources_lib.Resources) -> bool:
+        """True if the whole SKU or cloud got blocked mid-loop."""
+        for b in self.blocked:
+            if b.cloud_name == resources.cloud_name and \
+                    b.accelerators is None and b.region is None:
+                return True
+        return False
+
+    def _try_zone(self, resources: resources_lib.Resources, region: str,
+                  zone: Optional[str]) -> Optional[ProvisionResult]:
+        cloud = resources.cloud
+        node_config = cloud.make_deploy_resources_variables(
+            resources, self._cluster_name, region, zone)
+        config = provision_common.ProvisionConfig(
+            provider_config=dict(self._provider_config),
+            node_config=node_config,
+            count=self._num_nodes,
+            tags={'cluster_name': self._cluster_name},
+        )
+        provider = cloud.provisioner_module
+        try:
+            logger.info(f'Provisioning {self._cluster_name!r} '
+                        f'({resources}) in {zone or region}...')
+            record = provision_lib.run_instances(provider, region, zone,
+                                                 self._cluster_name, config)
+            provision_lib.wait_instances(provider, region,
+                                         self._cluster_name, 'RUNNING')
+            info = provision_lib.get_cluster_info(provider, record.region,
+                                                  self._cluster_name,
+                                                  config.provider_config)
+            concrete = resources.copy(region=record.region,
+                                      zone=record.zone)
+            return ProvisionResult(concrete, record, info, self._num_nodes)
+        except exceptions.InvalidRequestError as e:
+            self.failover_history.append(e)
+            raise exceptions.ResourcesUnavailableError(
+                f'Invalid request for {resources}: {e}',
+                no_failover=True,
+                failover_history=self.failover_history) from e
+        except (exceptions.CapacityError,
+                exceptions.QueuedResourceTimeoutError) as e:
+            self.failover_history.append(e)
+            logger.info(f'  Capacity error in {zone}: {e}')
+            self._block(resources, zone=zone, region=None)
+        except exceptions.QuotaExceededError as e:
+            self.failover_history.append(e)
+            logger.info(f'  Quota exceeded in {region}: {e}')
+            self._block(resources, zone=None, region=region)
+        except exceptions.PermissionError_ as e:
+            self.failover_history.append(e)
+            logger.info(f'  Permission error on {cloud}: {e}')
+            self._block(resources, zone=None, region=None, whole_cloud=True)
+        except exceptions.ProvisionError as e:
+            # Unclassified provisioning failure: treat as capacity-scoped.
+            self.failover_history.append(e)
+            self._block(resources, zone=zone, region=None)
+        return None
+
+
+def provision_with_retry_until_up(
+        provisioner: RetryingProvisioner,
+        retry_until_up: bool = False,
+        retry_interval_s: float = 30.0,
+        max_total_retries: int = 10**6) -> ProvisionResult:
+    """Optionally loop forever (jobs-controller recovery uses this)."""
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return provisioner.provision_with_retries()
+        except exceptions.ResourcesUnavailableError:
+            if not retry_until_up or attempt >= max_total_retries:
+                raise
+            logger.info(f'Retrying in {retry_interval_s}s '
+                        f'(attempt {attempt})...')
+            provisioner.blocked.clear()
+            time.sleep(retry_interval_s)

@@ -1,0 +1,367 @@
+"""TpuGangBackend: the cluster-lifecycle + job-execution backend.
+
+Twin of CloudVmRayBackend (sky/backends/cloud_vm_ray_backend.py:2715) with
+the Ray substrate removed: jobs are queued in the head agent's sqlite and
+gang-launched one-process-per-TPU-host with `jax.distributed`/libtpu env
+(see skypilot_tpu/agent/gang.py). The handle is pickled into the state DB
+(twin of CloudVmRayResourceHandle :2189) — but hosts are first-class here,
+so there is no `num_ips_per_node` special-casing.
+"""
+from __future__ import annotations
+
+import base64
+import getpass
+import json
+import os
+import shlex
+import tempfile
+import time
+import typing
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu import state
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.backends import backend as backend_lib
+from skypilot_tpu.backends import failover
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.utils import command_runner as runner_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+
+logger = sky_logging.init_logger(__name__)
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class ClusterHandle(backend_lib.ResourceHandle):
+    """Everything needed to reconnect to a cluster."""
+
+    def __init__(self, cluster_name: str,
+                 launched_resources: 'resources_lib.Resources',
+                 num_nodes: int,
+                 cluster_info: provision_common.ClusterInfo) -> None:
+        self.cluster_name = cluster_name
+        self.launched_resources = launched_resources
+        self.num_nodes = num_nodes
+        self.cluster_info = cluster_info
+
+    def get_cluster_name(self) -> str:
+        return self.cluster_name
+
+    @property
+    def provider_name(self) -> str:
+        return self.cluster_info.provider_name
+
+    @property
+    def is_local_provider(self) -> bool:
+        return self.provider_name in ('fake', 'local')
+
+    @property
+    def head_runtime_root(self) -> str:
+        """The cluster runtime dir on the head host."""
+        if self.is_local_provider:
+            head = self.cluster_info.get_head_instance()
+            return os.path.join(head.tags['host_root'], '.xsky')
+        return '~/.xsky'
+
+    @property
+    def head_ip(self) -> Optional[str]:
+        head = self.cluster_info.get_head_instance()
+        return head.get_feasible_ip() if head else None
+
+    def get_command_runners(self) -> List[runner_lib.CommandRunner]:
+        key = self.cluster_info.provider_config.get(
+            'ssh_private_key', '~/.ssh/xsky-key')
+        return runner_lib.runners_from_cluster_info(self.cluster_info, key)
+
+    def head_runner(self) -> runner_lib.CommandRunner:
+        return self.get_command_runners()[0]
+
+    def __repr__(self) -> str:
+        return (f'ClusterHandle({self.cluster_name}, '
+                f'{self.launched_resources}, hosts='
+                f'{self.cluster_info.num_instances})')
+
+
+@registry.BACKEND_REGISTRY.register(name='tpu_gang', default=True)
+class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
+
+    NAME = 'tpu_gang'
+
+    # ---- provision ----
+
+    def provision(self, task: 'task_lib.Task',
+                  to_provision: Optional['resources_lib.Resources'],
+                  dryrun: bool = False, stream_logs: bool = True,
+                  cluster_name: Optional[str] = None,
+                  retry_until_up: bool = False) -> Optional[ClusterHandle]:
+        assert cluster_name is not None
+        if dryrun:
+            logger.info(f'Dryrun: would provision {cluster_name} with '
+                        f'{to_provision or task.resources}')
+            return None
+        if to_provision is not None:
+            task = _pin_task(task, to_provision)
+        provisioner = failover.RetryingProvisioner(
+            task, cluster_name, task.num_nodes)
+        result = failover.provision_with_retry_until_up(
+            provisioner, retry_until_up=retry_until_up)
+        handle = ClusterHandle(cluster_name, result.resources,
+                               result.num_nodes, result.cluster_info)
+        state.add_or_update_cluster(cluster_name, handle,
+                                    requested_resources=task.resources,
+                                    ready=False)
+        self._setup_runtime(handle)
+        state.add_or_update_cluster(cluster_name, handle, ready=True,
+                                    is_launch=False)
+        return handle
+
+    def _agent_env(self, handle: ClusterHandle) -> Dict[str, str]:
+        env = {'XSKY_CLUSTER_ROOT': handle.head_runtime_root}
+        if handle.is_local_provider:
+            env['PYTHONPATH'] = _REPO_ROOT
+        return env
+
+    def _setup_runtime(self, handle: ClusterHandle) -> None:
+        """Ship cluster_info.json to the head; start the agent daemon.
+
+        (Twin of post_provision_runtime_setup,
+        sky/provision/provisioner.py:671 — minus Ray cluster start.)
+        """
+        head = handle.head_runner()
+        root = handle.head_runtime_root
+        info_json = json.dumps(handle.cluster_info.to_json())
+        payload = base64.b64encode(info_json.encode()).decode()
+        rc, _, stderr = head.run(
+            f'mkdir -p {root}/logs && echo {payload} | base64 -d > '
+            f'{root}/cluster_info.json',
+            env=self._agent_env(handle), require_outputs=True)
+        if rc != 0:
+            raise exceptions.ClusterSetUpError(
+                f'Failed to initialize cluster runtime: {stderr}')
+        if not handle.is_local_provider:
+            head.run_async(
+                'python -m skypilot_tpu.agent.daemon',
+                env=self._agent_env(handle),
+                log_path=None)
+
+    # ---- sync ----
+
+    def sync_workdir(self, handle: ClusterHandle, workdir: str) -> None:
+        runners = handle.get_command_runners()
+        for runner in runners:
+            runner.rsync(os.path.join(os.path.expanduser(workdir), ''),
+                         'sky_workdir/', up=True,
+                         excludes=['.git'])
+
+    def sync_file_mounts(self, handle: ClusterHandle,
+                         all_file_mounts: Optional[Dict[str, str]],
+                         storage_mounts: Optional[Dict[str, Any]]) -> None:
+        for target, source in (all_file_mounts or {}).items():
+            source = os.path.expanduser(source)
+            if not os.path.exists(source):
+                raise FileNotFoundError(
+                    f'file_mount source {source} not found')
+            for runner in handle.get_command_runners():
+                if os.path.isdir(source):
+                    runner.rsync(os.path.join(source, ''),
+                                 target.rstrip('/') + '/', up=True)
+                else:
+                    runner.rsync(source, target, up=True)
+        if storage_mounts:
+            from skypilot_tpu.data import storage_mounting
+            storage_mounting.mount_storage_on_cluster(
+                handle, storage_mounts)
+
+    # ---- setup / execute ----
+
+    @staticmethod
+    def _job_cwd(handle: ClusterHandle,
+                 task: 'task_lib.Task') -> Optional[str]:
+        """Working dir for setup AND run (must match: setup artifacts like
+        venvs must be visible to the run command)."""
+        if handle.is_local_provider:
+            return None  # local hosts run inside their host_root already
+        return 'sky_workdir' if task.workdir else None
+
+    def setup(self, handle: ClusterHandle, task: 'task_lib.Task',
+              detach_setup: bool = False) -> None:
+        if task.setup is None:
+            return
+        runners = handle.get_command_runners()
+        env = dict(task.envs_and_secrets)
+        cwd = self._job_cwd(handle, task)
+        for rank, runner in enumerate(runners):
+            rc, out, err = runner.run(task.setup, env=env, cwd=cwd,
+                                      require_outputs=True)
+            if rc != 0:
+                raise exceptions.ClusterSetUpError(
+                    f'Setup failed on host {rank} (rc={rc}): '
+                    f'{err or out}')
+
+    def execute(self, handle: ClusterHandle, task: 'task_lib.Task',
+                detach_run: bool = False,
+                dryrun: bool = False) -> Optional[int]:
+        if dryrun:
+            return None
+        run_cmd = task.run
+        if callable(run_cmd):
+            # Command generators get (node_rank, node_ips); materialize
+            # per-node commands into a dispatch script.
+            ips = handle.cluster_info.get_feasible_ips(internal=True)
+            cmds = {r: run_cmd(r, ips) for r in range(task.num_nodes)}
+            run_cmd = _dispatch_script(cmds)
+        spec = {
+            'run': run_cmd,
+            'envs': task.envs_and_secrets,
+            'num_nodes': task.num_nodes,
+            'cwd': self._job_cwd(handle, task),
+        }
+        job_id = self._submit_job(handle, task.name, spec)
+        state.update_last_use(handle.cluster_name)
+        if not detach_run:
+            self._wait_job(handle, job_id)
+        return job_id
+
+    def _submit_job(self, handle: ClusterHandle, name: Optional[str],
+                    spec: Dict[str, Any]) -> int:
+        head = handle.head_runner()
+        env = self._agent_env(handle)
+        spec_b64 = base64.b64encode(json.dumps(spec).encode()).decode()
+        user = getpass.getuser()
+        rc, out, err = head.run(
+            f'python -m skypilot_tpu.agent.job_cli add '
+            f'{shlex.quote(name or "-")} {user} {spec_b64}',
+            env=env, require_outputs=True)
+        if rc != 0:
+            raise exceptions.CommandError(rc, 'job_cli add', err)
+        job_id = int(out.strip().splitlines()[-1])
+        rc, out, err = head.run(
+            f'python -m skypilot_tpu.agent.job_cli run-detached {job_id}',
+            env=env, require_outputs=True)
+        if rc != 0:
+            raise exceptions.CommandError(rc, 'job_cli run-detached', err)
+        return job_id
+
+    def _wait_job(self, handle: ClusterHandle, job_id: int,
+                  timeout_s: float = 3600.0,
+                  poll_s: float = 0.3) -> job_lib.JobStatus:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            status = self.get_job_status(handle, job_id)
+            if status is not None and status.is_terminal():
+                if status != job_lib.JobStatus.SUCCEEDED:
+                    raise exceptions.JobExitNonZeroError(
+                        f'Job {job_id} finished with {status.value}. '
+                        f'Logs:\n{self.tail_logs(handle, job_id, False)}')
+                return status
+            time.sleep(poll_s)
+        raise TimeoutError(f'Job {job_id} did not finish in {timeout_s}s')
+
+    # ---- job ops ----
+
+    def get_job_status(self, handle: ClusterHandle,
+                       job_id: int) -> Optional[job_lib.JobStatus]:
+        head = handle.head_runner()
+        rc, out, _ = head.run(
+            f'python -m skypilot_tpu.agent.job_cli status {job_id}',
+            env=self._agent_env(handle), require_outputs=True)
+        if rc != 0:
+            return None
+        value = out.strip().splitlines()[-1]
+        if value == 'NOT_FOUND':
+            return None
+        return job_lib.JobStatus(value)
+
+    def get_job_queue(self, handle: ClusterHandle) -> List[Dict[str, Any]]:
+        head = handle.head_runner()
+        rc, out, err = head.run(
+            'python -m skypilot_tpu.agent.job_cli queue',
+            env=self._agent_env(handle), require_outputs=True)
+        if rc != 0:
+            raise exceptions.CommandError(rc, 'job_cli queue', err)
+        return json.loads(out.strip().splitlines()[-1])
+
+    def cancel_jobs(self, handle: ClusterHandle, job_ids) -> None:
+        head = handle.head_runner()
+        for job_id in job_ids:
+            head.run(f'python -m skypilot_tpu.agent.job_cli cancel '
+                     f'{job_id}', env=self._agent_env(handle))
+
+    def tail_logs(self, handle: ClusterHandle, job_id: Optional[int],
+                  follow: bool = True) -> str:
+        if job_id is None:
+            jobs = self.get_job_queue(handle)
+            if not jobs:
+                return ''
+            job_id = jobs[0]['job_id']
+        head = handle.head_runner()
+        rc, out, _ = head.run(
+            f'python -m skypilot_tpu.agent.job_cli tail {job_id}',
+            env=self._agent_env(handle), require_outputs=True)
+        return out
+
+    # ---- teardown / autostop ----
+
+    def teardown(self, handle: ClusterHandle, terminate: bool,
+                 purge: bool = False) -> None:
+        cloud = handle.launched_resources.cloud
+        provider = cloud.provisioner_module
+        try:
+            if terminate:
+                provision_lib.terminate_instances(
+                    provider, handle.cluster_name,
+                    handle.cluster_info.provider_config)
+            else:
+                provision_lib.stop_instances(
+                    provider, handle.cluster_name,
+                    handle.cluster_info.provider_config)
+        except exceptions.NotSupportedError:
+            raise
+        except Exception:
+            if not purge:
+                raise
+        state.remove_cluster(handle.cluster_name, terminate=terminate)
+
+    def set_autostop(self, handle: ClusterHandle, idle_minutes: int,
+                     down: bool = False) -> None:
+        head = handle.head_runner()
+        if idle_minutes < 0:
+            cmd = ('python -c "from skypilot_tpu.agent import '
+                   'autostop_lib; autostop_lib.clear_autostop()"')
+        else:
+            cmd = (f'python -c "from skypilot_tpu.agent import '
+                   f'autostop_lib; autostop_lib.set_autostop('
+                   f'{idle_minutes}, {down})"')
+        rc, _, err = head.run(cmd, env=self._agent_env(handle),
+                              require_outputs=True)
+        if rc != 0:
+            raise exceptions.CommandError(rc, 'set_autostop', err)
+        state.set_cluster_autostop(handle.cluster_name, idle_minutes, down)
+
+
+def _pin_task(task: 'task_lib.Task',
+              resources: 'resources_lib.Resources') -> 'task_lib.Task':
+    """Return a shallow task copy pinned to one concrete Resources."""
+    import copy
+    pinned = copy.copy(task)
+    pinned.set_resources(resources)
+    return pinned
+
+
+def _dispatch_script(cmds: Dict[int, Optional[str]]) -> str:
+    """Bash that runs the right per-node command based on XSKY_NODE_RANK."""
+    lines = ['case "$XSKY_NODE_RANK" in']
+    for rank, cmd in cmds.items():
+        body = cmd if cmd else 'true'
+        lines.append(f'{rank}) {body} ;;')
+    lines.append('*) true ;;')
+    lines.append('esac')
+    return '\n'.join(lines)

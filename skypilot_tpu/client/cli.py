@@ -1,0 +1,399 @@
+"""`xsky` CLI (twin of sky/client/cli/command.py click groups).
+
+Verbs: launch, exec, status, start, stop, down, autostop, queue, logs,
+cancel, check, show-gpus, cost-report, jobs (launch/queue/cancel/logs),
+serve (up/status/down), storage (ls/delete), api (start/stop).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+import click
+
+from skypilot_tpu import task as task_lib
+
+
+def _parse_kv(items: Tuple[str, ...], what: str) -> dict:
+    out = {}
+    for item in items:
+        if '=' in item:
+            k, _, v = item.partition('=')
+        else:
+            k, v = item, os.environ.get(item)
+            if v is None:
+                raise click.UsageError(
+                    f'{what} {item!r} has no value and is not set in the '
+                    'local environment.')
+        out[k] = v
+    return out
+
+
+def _load_task(entrypoint: str, envs, secrets, name, num_nodes,
+               accelerators=None, cloud=None, use_spot=None) -> task_lib.Task:
+    if os.path.exists(entrypoint) and entrypoint.endswith(
+            ('.yaml', '.yml')):
+        t = task_lib.Task.from_yaml(entrypoint,
+                                    env_overrides=_parse_kv(envs, 'env'),
+                                    secret_overrides=_parse_kv(
+                                        secrets, 'secret'))
+    else:
+        t = task_lib.Task(run=entrypoint, envs=_parse_kv(envs, 'env'),
+                          secrets=_parse_kv(secrets, 'secret'))
+    if name:
+        t.name = name
+    if num_nodes:
+        t.num_nodes = num_nodes
+    overrides = {}
+    if accelerators:
+        overrides['accelerators'] = accelerators
+    if cloud:
+        overrides['cloud'] = cloud
+    if use_spot is not None:
+        overrides['use_spot'] = use_spot
+    if overrides:
+        t.set_resources([r.copy(**overrides) for r in t.resources],
+                        ordered=t.resources_ordered)
+    return t
+
+
+@click.group()
+@click.version_option(package_name=None, version='0.1.0',
+                      prog_name='xsky')
+def cli():
+    """xsky: TPU-native multi-cloud AI workload orchestrator."""
+
+
+_task_options = [
+    click.option('--env', 'envs', multiple=True,
+                 help='Env override KEY=VALUE (or KEY to inherit).'),
+    click.option('--secret', 'secrets', multiple=True,
+                 help='Secret override KEY=VALUE.'),
+    click.option('--name', '-n', default=None, help='Task name.'),
+    click.option('--num-nodes', type=int, default=None),
+    click.option('--gpus', '--accelerators', 'accelerators', default=None,
+                 help="Accelerator spec, e.g. 'tpu-v5e-8' or 'A100:8'."),
+    click.option('--cloud', default=None),
+    click.option('--use-spot/--no-use-spot', 'use_spot', default=None),
+]
+
+
+def _apply(options):
+    def wrap(fn):
+        for option in reversed(options):
+            fn = option(fn)
+        return fn
+    return wrap
+
+
+@cli.command()
+@click.argument('entrypoint')
+@_apply(_task_options)
+@click.option('--cluster', '-c', default=None, help='Cluster name.')
+@click.option('--retry-until-up', is_flag=True, default=False)
+@click.option('--idle-minutes-to-autostop', '-i', type=int, default=None)
+@click.option('--down', is_flag=True, default=False,
+              help='Tear down (not stop) on idle autostop.')
+@click.option('--dryrun', is_flag=True, default=False)
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def launch(entrypoint, envs, secrets, name, num_nodes, accelerators, cloud,
+           use_spot, cluster, retry_until_up, idle_minutes_to_autostop,
+           down, dryrun, detach_run, yes):
+    """Launch a task (provision a cluster if needed)."""
+    from skypilot_tpu.client import sdk
+    t = _load_task(entrypoint, envs, secrets, name, num_nodes,
+                   accelerators, cloud, use_spot)
+    if not yes and not dryrun:
+        click.confirm(f'Launching task on cluster {cluster or "<new>"}. '
+                      'Proceed?', default=True, abort=True)
+    job_id, handle = sdk.launch(
+        t, cluster_name=cluster, retry_until_up=retry_until_up,
+        idle_minutes_to_autostop=idle_minutes_to_autostop, down=down,
+        dryrun=dryrun, detach_run=detach_run)
+    if dryrun:
+        click.echo('Dryrun complete.')
+        return
+    click.echo(f'Job {job_id} on cluster '
+               f'{handle.get_cluster_name()}: submitted.')
+
+
+@cli.command(name='exec')
+@click.argument('cluster')
+@click.argument('entrypoint')
+@_apply(_task_options)
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+def exec_cmd(cluster, entrypoint, envs, secrets, name, num_nodes,
+             accelerators, cloud, use_spot, detach_run):
+    """Run a task on an existing cluster (no provisioning)."""
+    from skypilot_tpu.client import sdk
+    t = _load_task(entrypoint, envs, secrets, name, num_nodes,
+                   accelerators, cloud, use_spot)
+    job_id, _ = sdk.exec(t, cluster, detach_run=detach_run)
+    click.echo(f'Job {job_id} on cluster {cluster}: submitted.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1)
+@click.option('--refresh', '-r', is_flag=True, default=False)
+def status(clusters, refresh):
+    """Show clusters."""
+    from skypilot_tpu.client import sdk
+    records = sdk.status(list(clusters) or None, refresh=refresh)
+    if not records:
+        click.echo('No existing clusters.')
+        return
+    fmt = '{:<18} {:<28} {:<9} {:<10}'
+    click.echo(fmt.format('NAME', 'RESOURCES', 'STATUS', 'AUTOSTOP'))
+    for r in records:
+        handle = r['handle']
+        resources = str(handle.launched_resources) if handle else '-'
+        autostop_s = (f'{r["autostop"]}m' +
+                      ('(down)' if r['to_down'] else '')
+                      if r['autostop'] >= 0 else '-')
+        click.echo(fmt.format(r['name'], resources[:28],
+                              r['status'].value, autostop_s))
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--idle-minutes-to-autostop', '-i', type=int, default=None)
+@click.option('--down', is_flag=True, default=False)
+def start(cluster, idle_minutes_to_autostop, down):
+    """Restart a stopped cluster."""
+    from skypilot_tpu.client import sdk
+    sdk.start(cluster, idle_minutes_to_autostop=idle_minutes_to_autostop,
+              down=down)
+    click.echo(f'Cluster {cluster} started.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def stop(clusters, yes):
+    """Stop cluster(s) (preserves disk; not supported for TPU pods)."""
+    from skypilot_tpu.client import sdk
+    for c in clusters:
+        if not yes:
+            click.confirm(f'Stop cluster {c}?', default=True, abort=True)
+        sdk.stop(c)
+        click.echo(f'Cluster {c} stopped.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+@click.option('--purge', is_flag=True, default=False)
+def down(clusters, yes, purge):
+    """Tear down cluster(s)."""
+    from skypilot_tpu.client import sdk
+    for c in clusters:
+        if not yes:
+            click.confirm(f'Tear down cluster {c}?', default=True,
+                          abort=True)
+        sdk.down(c, purge=purge)
+        click.echo(f'Cluster {c} terminated.')
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--idle-minutes', '-i', type=int, required=True,
+              help='Idle minutes before autostop; -1 cancels.')
+@click.option('--down', is_flag=True, default=False)
+def autostop(cluster, idle_minutes, down):
+    """Schedule autostop/autodown for a cluster."""
+    from skypilot_tpu.client import sdk
+    sdk.autostop(cluster, idle_minutes, down=down)
+    click.echo(f'Autostop set on {cluster}: {idle_minutes}m'
+               f'{" (down)" if down else ""}.')
+
+
+@cli.command()
+@click.argument('cluster')
+def queue(cluster):
+    """Show a cluster's job queue."""
+    from skypilot_tpu.client import sdk
+    jobs = sdk.queue(cluster)
+    fmt = '{:<6} {:<16} {:<12} {:<10}'
+    click.echo(fmt.format('ID', 'NAME', 'STATUS', 'USER'))
+    for j in jobs:
+        click.echo(fmt.format(j['job_id'], str(j['job_name'])[:16],
+                              j['status'], j['username']))
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_id', type=int, required=False)
+def logs(cluster, job_id):
+    """Print a job's logs."""
+    from skypilot_tpu.client import sdk
+    click.echo(sdk.tail_logs(cluster, job_id), nl=False)
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_ids', nargs=-1, type=int)
+@click.option('--all', '-a', 'all_jobs', is_flag=True, default=False)
+def cancel(cluster, job_ids, all_jobs):
+    """Cancel job(s)."""
+    from skypilot_tpu.client import sdk
+    sdk.cancel(cluster, list(job_ids) or None, all_jobs=all_jobs)
+    click.echo('Cancelled.')
+
+
+@cli.command()
+def check():
+    """Probe cloud credentials and enable clouds."""
+    from skypilot_tpu.client import sdk
+    results = sdk.check()
+    for name, info in sorted(results.items()):
+        mark = 'enabled' if info['enabled'] else \
+            f"disabled ({info['reason']})"
+        click.echo(f'  {name}: {mark}')
+
+
+@cli.command(name='show-gpus')
+@click.argument('accelerator_filter', required=False)
+@click.option('--all', '-a', 'show_all', is_flag=True, default=False)
+def show_gpus(accelerator_filter, show_all):
+    """List accelerators (GPUs and TPU slices) with prices."""
+    from skypilot_tpu import catalog
+    accs = catalog.list_accelerators(name_filter=accelerator_filter)
+    fmt = '{:<16} {:<8} {:<7} {:<11} {:<11} {:<10}'
+    click.echo(fmt.format('ACCELERATOR', 'COUNT', 'CLOUD', '$/HR',
+                          'SPOT $/HR', 'MEM(GB)'))
+    for name in sorted(accs):
+        for o in accs[name][:None if show_all else 1]:
+            click.echo(fmt.format(
+                name, f'{o.accelerator_count:g}', o.cloud,
+                f'{o.price:.2f}' if o.price else '-',
+                f'{o.spot_price:.2f}' if o.spot_price else '-',
+                f'{o.memory_gib:g}'))
+
+
+@cli.command(name='cost-report')
+def cost_report():
+    """Estimated costs of live clusters."""
+    from skypilot_tpu.client import sdk
+    rows = sdk.cost_report()
+    fmt = '{:<18} {:<28} {:>8} {:>10}'
+    click.echo(fmt.format('NAME', 'RESOURCES', '$/HR', 'TOTAL $'))
+    for r in rows:
+        click.echo(fmt.format(r['name'], r['resources'][:28],
+                              f"{r['hourly_cost']:.2f}",
+                              f"{r['total_cost']:.2f}"))
+
+
+# ---- jobs / serve / storage / api groups (wired as they land) -------------
+
+
+@cli.group()
+def jobs():
+    """Managed jobs with auto-recovery."""
+
+
+@jobs.command(name='launch')
+@click.argument('entrypoint')
+@_apply(_task_options)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def jobs_launch(entrypoint, envs, secrets, name, num_nodes, accelerators,
+                cloud, use_spot, yes):
+    """Launch a managed job (controller recovers preemptions)."""
+    from skypilot_tpu.jobs import core as jobs_core
+    t = _load_task(entrypoint, envs, secrets, name, num_nodes,
+                   accelerators, cloud, use_spot)
+    job_id = jobs_core.launch(t)
+    click.echo(f'Managed job {job_id} submitted.')
+
+
+@jobs.command(name='queue')
+def jobs_queue():
+    from skypilot_tpu.jobs import core as jobs_core
+    rows = jobs_core.queue()
+    fmt = '{:<6} {:<16} {:<14} {:<8}'
+    click.echo(fmt.format('ID', 'NAME', 'STATUS', 'RECOVERIES'))
+    for r in rows:
+        click.echo(fmt.format(r['job_id'], str(r['name'])[:16],
+                              r['status'], r.get('recovery_count', 0)))
+
+
+@jobs.command(name='cancel')
+@click.argument('job_ids', nargs=-1, type=int, required=True)
+def jobs_cancel(job_ids):
+    from skypilot_tpu.jobs import core as jobs_core
+    for jid in job_ids:
+        jobs_core.cancel(jid)
+    click.echo('Cancelled.')
+
+
+@jobs.command(name='logs')
+@click.argument('job_id', type=int)
+def jobs_logs(job_id):
+    from skypilot_tpu.jobs import core as jobs_core
+    click.echo(jobs_core.tail_logs(job_id), nl=False)
+
+
+@cli.group()
+def serve():
+    """SkyServe-style autoscaled serving."""
+
+
+@serve.command(name='up')
+@click.argument('entrypoint')
+@click.option('--service-name', '-n', default=None)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_up(entrypoint, service_name, yes):
+    from skypilot_tpu.serve import core as serve_core
+    t = task_lib.Task.from_yaml(entrypoint)
+    name = serve_core.up(t, service_name)
+    click.echo(f'Service {name} is up.')
+
+
+@serve.command(name='status')
+@click.argument('service_names', nargs=-1)
+def serve_status(service_names):
+    from skypilot_tpu.serve import core as serve_core
+    for record in serve_core.status(list(service_names) or None):
+        click.echo(json.dumps(record, default=str))
+
+
+@serve.command(name='down')
+@click.argument('service_names', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_down(service_names, yes):
+    from skypilot_tpu.serve import core as serve_core
+    for name in service_names:
+        serve_core.down(name)
+        click.echo(f'Service {name} torn down.')
+
+
+@cli.group()
+def api():
+    """API server management."""
+
+
+@api.command(name='start')
+@click.option('--host', default='127.0.0.1')
+@click.option('--port', type=int, default=46580)
+@click.option('--foreground', is_flag=True, default=False)
+def api_start(host, port, foreground):
+    from skypilot_tpu.server import app as server_app
+    if foreground:
+        server_app.run(host=host, port=port)
+    else:
+        import subprocess
+        subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.server.app',
+             '--host', host, '--port', str(port)],
+            start_new_session=True)
+        click.echo(f'API server starting at http://{host}:{port}')
+
+
+def main() -> None:
+    cli()
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,125 @@
+"""HTTP transport for the SDK (twin of the reference's requests-to-server
+path, sky/client/sdk.py + sky/server/common.py).
+
+Implemented against the API server in ``skypilot_tpu.server``; every verb
+posts a request, receives a request id, and polls ``/api/get`` until the
+request completes (the reference's async request-id model).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+
+class RemoteClient:
+
+    def __init__(self, endpoint: str, poll_interval_s: float = 0.2,
+                 timeout_s: float = 3600.0) -> None:
+        self.endpoint = endpoint.rstrip('/')
+        self.poll_interval_s = poll_interval_s
+        self.timeout_s = timeout_s
+        try:
+            import httpx
+            self._client = httpx.Client(base_url=self.endpoint, timeout=30)
+        except ImportError as e:
+            raise exceptions.ApiServerConnectionError(endpoint) from e
+
+    # ---- request plumbing ----
+
+    def _submit(self, verb: str, body: Dict[str, Any]) -> str:
+        try:
+            resp = self._client.post(f'/api/{verb}', json=body)
+        except Exception as e:
+            raise exceptions.ApiServerConnectionError(self.endpoint) from e
+        resp.raise_for_status()
+        return resp.json()['request_id']
+
+    def _get(self, request_id: str) -> Any:
+        deadline = time.time() + self.timeout_s
+        while time.time() < deadline:
+            resp = self._client.get('/api/get',
+                                    params={'request_id': request_id})
+            resp.raise_for_status()
+            payload = resp.json()
+            if payload['status'] in ('PENDING', 'RUNNING'):
+                time.sleep(self.poll_interval_s)
+                continue
+            if payload['status'] == 'FAILED':
+                raise exceptions.deserialize_exception(payload['error'])
+            if payload['status'] == 'CANCELLED':
+                raise exceptions.RequestCancelled(request_id)
+            return payload['result']
+        raise TimeoutError(f'Request {request_id} timed out')
+
+    def _call(self, verb: str, body: Dict[str, Any]) -> Any:
+        return self._get(self._submit(verb, body))
+
+    # ---- verbs ----
+
+    def launch(self, task, **kwargs) -> Any:
+        body = {'task': task.to_yaml_config(), **_clean(kwargs)}
+        result = self._call('launch', body)
+        return result['job_id'], _HandleProxy(result['cluster_name'])
+
+    def exec(self, task, cluster_name: str, **kwargs) -> Any:
+        body = {'task': task.to_yaml_config(),
+                'cluster_name': cluster_name, **_clean(kwargs)}
+        result = self._call('exec', body)
+        return result['job_id'], _HandleProxy(result['cluster_name'])
+
+    def status(self, cluster_names=None, refresh=False):
+        return self._call('status', {'cluster_names': cluster_names,
+                                     'refresh': refresh})
+
+    def start(self, cluster_name, idle_minutes_to_autostop=None,
+              down=False):
+        return self._call('start', {
+            'cluster_name': cluster_name,
+            'idle_minutes_to_autostop': idle_minutes_to_autostop,
+            'down': down})
+
+    def stop(self, cluster_name):
+        return self._call('stop', {'cluster_name': cluster_name})
+
+    def down(self, cluster_name, purge=False):
+        return self._call('down', {'cluster_name': cluster_name,
+                                   'purge': purge})
+
+    def autostop(self, cluster_name, idle_minutes, down_on_idle=False):
+        return self._call('autostop', {'cluster_name': cluster_name,
+                                       'idle_minutes': idle_minutes,
+                                       'down': down_on_idle})
+
+    def queue(self, cluster_name):
+        return self._call('queue', {'cluster_name': cluster_name})
+
+    def cancel(self, cluster_name, job_ids=None, all_jobs=False):
+        return self._call('cancel', {'cluster_name': cluster_name,
+                                     'job_ids': job_ids,
+                                     'all_jobs': all_jobs})
+
+    def tail_logs(self, cluster_name, job_id=None, follow=False):
+        return self._call('logs', {'cluster_name': cluster_name,
+                                   'job_id': job_id})
+
+    def check(self, quiet=False):
+        return self._call('check', {})
+
+    def cost_report(self):
+        return self._call('cost_report', {})
+
+
+class _HandleProxy:
+    """Client-side stand-in for a ClusterHandle (server keeps the real one)."""
+
+    def __init__(self, cluster_name: str) -> None:
+        self.cluster_name = cluster_name
+
+    def get_cluster_name(self) -> str:
+        return self.cluster_name
+
+
+def _clean(kwargs: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: v for k, v in kwargs.items() if v is not None}

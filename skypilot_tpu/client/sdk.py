@@ -1,0 +1,130 @@
+"""Python SDK (twin of sky/client/sdk.py).
+
+Two transports:
+  * local (default): calls the engine in-process;
+  * remote: posts to an API server (``XSKY_API_SERVER`` env or config key
+    ``api_server.endpoint``) and polls the request until done — the
+    async request-id model of the reference (sky/client/sdk.py:360,1689).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import task as task_lib
+
+
+def api_server_endpoint() -> Optional[str]:
+    return os.environ.get('XSKY_API_SERVER') or config_lib.get_nested(
+        ('api_server', 'endpoint'))
+
+
+def _remote():
+    endpoint = api_server_endpoint()
+    if endpoint is None:
+        return None
+    from skypilot_tpu.client import remote_client
+    return remote_client.RemoteClient(endpoint)
+
+
+# ---- verbs ----------------------------------------------------------------
+
+
+def launch(task: Union[task_lib.Task, dag_lib.Dag],
+           cluster_name: Optional[str] = None,
+           retry_until_up: bool = False,
+           idle_minutes_to_autostop: Optional[int] = None,
+           down: bool = False,
+           dryrun: bool = False,
+           detach_run: bool = False,
+           no_setup: bool = False) -> Tuple[Optional[int], Optional[Any]]:
+    remote = _remote()
+    if remote is not None:
+        return remote.launch(task, cluster_name=cluster_name,
+                             retry_until_up=retry_until_up,
+                             idle_minutes_to_autostop=(
+                                 idle_minutes_to_autostop),
+                             down=down, dryrun=dryrun,
+                             detach_run=detach_run, no_setup=no_setup)
+    from skypilot_tpu import execution
+    return execution.launch(task, cluster_name=cluster_name,
+                            retry_until_up=retry_until_up,
+                            idle_minutes_to_autostop=(
+                                idle_minutes_to_autostop),
+                            down=down, dryrun=dryrun,
+                            detach_run=detach_run, no_setup=no_setup)
+
+
+def exec(task: task_lib.Task,  # pylint: disable=redefined-builtin
+         cluster_name: str,
+         detach_run: bool = False,
+         dryrun: bool = False) -> Tuple[Optional[int], Optional[Any]]:
+    remote = _remote()
+    if remote is not None:
+        return remote.exec(task, cluster_name, detach_run=detach_run,
+                           dryrun=dryrun)
+    from skypilot_tpu import execution
+    return execution.exec(task, cluster_name, detach_run=detach_run,
+                          dryrun=dryrun)
+
+
+def _local_or_remote(name: str, *args, **kwargs):
+    remote = _remote()
+    if remote is not None:
+        return getattr(remote, name)(*args, **kwargs)
+    from skypilot_tpu import core
+    return getattr(core, name)(*args, **kwargs)
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    return _local_or_remote('status', cluster_names=cluster_names,
+                            refresh=refresh)
+
+
+def start(cluster_name: str,
+          idle_minutes_to_autostop: Optional[int] = None,
+          down: bool = False) -> None:
+    return _local_or_remote('start', cluster_name,
+                            idle_minutes_to_autostop=(
+                                idle_minutes_to_autostop), down=down)
+
+
+def stop(cluster_name: str) -> None:
+    return _local_or_remote('stop', cluster_name)
+
+
+def down(cluster_name: str, purge: bool = False) -> None:
+    return _local_or_remote('down', cluster_name, purge=purge)
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down: bool = False) -> None:  # noqa: A002
+    return _local_or_remote('autostop', cluster_name, idle_minutes,
+                            down_on_idle=down)
+
+
+def queue(cluster_name: str) -> List[Dict[str, Any]]:
+    return _local_or_remote('queue', cluster_name)
+
+
+def cancel(cluster_name: str, job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> None:
+    return _local_or_remote('cancel', cluster_name, job_ids=job_ids,
+                            all_jobs=all_jobs)
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              follow: bool = False) -> str:
+    return _local_or_remote('tail_logs', cluster_name, job_id=job_id,
+                            follow=follow)
+
+
+def check(quiet: bool = False) -> Dict[str, Any]:
+    return _local_or_remote('check', quiet=quiet)
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    return _local_or_remote('cost_report')

@@ -1,0 +1,194 @@
+"""Server-side implementations of cluster ops (twin of sky/core.py).
+
+status / start / stop / down / autostop / queue / cancel / tail_logs —
+thin orchestration over the state DB + backend + provisioner, with status
+reconciliation against cloud truth (twin of
+backend_utils.refresh_cluster_status_handle, SURVEY §3.5).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import check as check_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu import state
+from skypilot_tpu.backends import tpu_gang_backend
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _backend() -> tpu_gang_backend.TpuGangBackend:
+    return tpu_gang_backend.TpuGangBackend()
+
+
+def _get_handle(cluster_name: str):
+    record = state.get_cluster_from_name(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    return record
+
+
+def refresh_cluster_status(cluster_name: str) -> Optional[Dict[str, Any]]:
+    """Reconcile one cluster's DB status against cloud truth.
+
+    Detects externally-terminated / preempted / stopped clusters, like the
+    reference's refresh path (sky/backends/backend_utils.py, §3.5).
+    """
+    record = state.get_cluster_from_name(cluster_name)
+    if record is None:
+        return None
+    handle = record['handle']
+    if handle is None:
+        return record
+    cloud = handle.launched_resources.cloud
+    try:
+        statuses = provision_lib.query_instances(
+            cloud.provisioner_module, cluster_name,
+            handle.cluster_info.provider_config)
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning(f'Status refresh for {cluster_name} failed: {e}')
+        return record
+    if not statuses:
+        # Cloud says gone: preempted or externally deleted.
+        state.remove_cluster(cluster_name, terminate=True)
+        return None
+    if all(s == 'STOPPED' for s in statuses.values()):
+        state.update_cluster_status(cluster_name,
+                                    state.ClusterStatus.STOPPED)
+    elif any(s != 'RUNNING' for s in statuses.values()):
+        state.update_cluster_status(cluster_name, state.ClusterStatus.INIT)
+    return state.get_cluster_from_name(cluster_name)
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    records = state.get_clusters()
+    if cluster_names:
+        records = [r for r in records if r['name'] in cluster_names]
+    if refresh:
+        refreshed = []
+        for r in records:
+            nr = refresh_cluster_status(r['name'])
+            if nr is not None:
+                refreshed.append(nr)
+        records = refreshed
+    return records
+
+
+def start(cluster_name: str,
+          idle_minutes_to_autostop: Optional[int] = None,
+          down: bool = False) -> None:
+    record = _get_handle(cluster_name)
+    if record['status'] == state.ClusterStatus.UP:
+        return
+    handle = record['handle']
+    cloud = handle.launched_resources.cloud
+    # Restart stopped instances through the provisioner.
+    from skypilot_tpu.provision import common as provision_common
+    config = provision_common.ProvisionConfig(
+        provider_config=handle.cluster_info.provider_config,
+        node_config=cloud.make_deploy_resources_variables(
+            handle.launched_resources, cluster_name,
+            handle.launched_resources.region,
+            handle.launched_resources.zone),
+        count=handle.num_nodes)
+    record2 = provision_lib.run_instances(
+        cloud.provisioner_module, handle.launched_resources.region,
+        handle.launched_resources.zone, cluster_name, config)
+    # Re-run runtime setup: restarted VMs may have new IPs, and the head
+    # agent daemon died with the stop — refresh the handle's inventory
+    # and bring the runtime back up before marking UP.
+    handle.cluster_info = provision_lib.get_cluster_info(
+        cloud.provisioner_module, record2.region, cluster_name,
+        handle.cluster_info.provider_config)
+    backend = _backend()
+    backend._setup_runtime(handle)  # pylint: disable=protected-access
+    state.add_or_update_cluster(cluster_name, handle, ready=True,
+                                is_launch=False)
+    if idle_minutes_to_autostop is not None:
+        autostop(cluster_name, idle_minutes_to_autostop, down)
+
+
+def stop(cluster_name: str) -> None:
+    record = _get_handle(cluster_name)
+    handle = record['handle']
+    # Feature-check before touching the cloud (TPU pods cannot stop).
+    from skypilot_tpu.clouds import CloudImplementationFeatures as F
+    resources = handle.launched_resources
+    type(resources.cloud).check_features_are_supported(
+        resources, {F.STOP})
+    _backend().teardown(handle, terminate=False)
+
+
+def down(cluster_name: str, purge: bool = False) -> None:
+    record = _get_handle(cluster_name)
+    handle = record['handle']
+    if handle is None:
+        state.remove_cluster(cluster_name, terminate=True)
+        return
+    _backend().teardown(handle, terminate=True, purge=purge)
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down_on_idle: bool = False) -> None:  # noqa: A002
+    record = _get_handle(cluster_name)
+    _backend().set_autostop(record['handle'], idle_minutes, down_on_idle)
+
+
+def queue(cluster_name: str) -> List[Dict[str, Any]]:
+    record = _get_handle(cluster_name)
+    return _backend().get_job_queue(record['handle'])
+
+
+def cancel(cluster_name: str, job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> None:
+    record = _get_handle(cluster_name)
+    backend = _backend()
+    if all_jobs:
+        job_ids = [j['job_id'] for j in backend.get_job_queue(
+            record['handle'])
+            if j['status'] in ('PENDING', 'SETTING_UP', 'RUNNING')]
+    backend.cancel_jobs(record['handle'], job_ids or [])
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              follow: bool = False) -> str:
+    record = _get_handle(cluster_name)
+    return _backend().tail_logs(record['handle'], job_id, follow=follow)
+
+
+def check(quiet: bool = False) -> Dict[str, Any]:
+    """Probe credentials; persist enabled clouds (twin of sky check)."""
+    results = check_lib.check_capabilities(quiet=quiet)
+    enabled = [name for name, (ok, _) in results.items() if ok]
+    state.set_enabled_clouds(enabled)
+    check_lib.set_enabled_clouds_for_test(enabled)
+    return {name: {'enabled': ok, 'reason': reason}
+            for name, (ok, reason) in results.items()}
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    """Per-cluster cost estimate from catalog prices."""
+    import time
+    out = []
+    for record in state.get_clusters():
+        handle = record['handle']
+        if handle is None:
+            continue
+        resources = handle.launched_resources
+        hours = (time.time() - record['launched_at']) / 3600.0
+        try:
+            rate = resources.get_hourly_cost()
+        except ValueError:
+            rate = 0.0
+        out.append({
+            'name': record['name'],
+            'resources': str(resources),
+            'hourly_cost': rate,
+            'uptime_hours': hours,
+            'total_cost': rate * hours,
+        })
+    return out

@@ -1,0 +1,17 @@
+"""Storage-mount bridge between the backend and the data layer.
+
+Placeholder until the storage subsystem lands (SURVEY §2.9 twin): raises a
+clear error instead of ModuleNotFoundError mid-launch.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from skypilot_tpu import exceptions
+
+
+def mount_storage_on_cluster(handle: Any,
+                             storage_mounts: Dict[str, Any]) -> None:
+    raise exceptions.NotSupportedError(
+        'storage_mounts are not wired into the backend yet; use '
+        'file_mounts, or track skypilot_tpu.data.storage.')

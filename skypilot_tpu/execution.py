@@ -1,0 +1,185 @@
+"""Execution stage machine (twin of sky/execution.py:99,217,474,664).
+
+Stages: OPTIMIZE → PROVISION → SYNC_WORKDIR → SYNC_FILE_MOUNTS → SETUP →
+EXEC → (DOWN). `launch` runs all stages; `exec` skips provisioning and
+reuses an UP cluster (twin of the reference's fast path, execution.py:664).
+"""
+from __future__ import annotations
+
+import enum
+import uuid
+from typing import Any, List, Optional, Tuple
+
+from skypilot_tpu import admin_policy as admin_policy_lib
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu import state
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backends import tpu_gang_backend
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+
+class Stage(enum.Enum):
+    OPTIMIZE = enum.auto()
+    PROVISION = enum.auto()
+    SYNC_WORKDIR = enum.auto()
+    SYNC_FILE_MOUNTS = enum.auto()
+    SETUP = enum.auto()
+    EXEC = enum.auto()
+    DOWN = enum.auto()
+
+
+ALL_STAGES = list(Stage)
+
+
+def _to_dag(entrypoint) -> dag_lib.Dag:
+    if isinstance(entrypoint, dag_lib.Dag):
+        return entrypoint
+    assert isinstance(entrypoint, task_lib.Task), entrypoint
+    d = dag_lib.Dag()
+    d.add(entrypoint)
+    return d
+
+
+def generate_cluster_name() -> str:
+    return f'xsky-{common_utils.fresh_cluster_suffix()}'
+
+
+def launch(entrypoint,
+           cluster_name: Optional[str] = None,
+           retry_until_up: bool = False,
+           idle_minutes_to_autostop: Optional[int] = None,
+           down: bool = False,
+           dryrun: bool = False,
+           detach_run: bool = False,
+           stream_logs: bool = True,
+           backend: Optional[Any] = None,
+           no_setup: bool = False) -> Tuple[Optional[int], Optional[Any]]:
+    """Provision (if needed) and run. Returns (job_id, handle)."""
+    dag = _to_dag(entrypoint)
+    dag = admin_policy_lib.apply(dag)
+    if cluster_name is None:
+        cluster_name = generate_cluster_name()
+    common_utils.check_cluster_name_is_valid(cluster_name)
+    # `down` modifies autostop semantics (teardown-on-idle), it does not
+    # add a DOWN stage; Stage.DOWN exists for jobs-controller cleanup.
+    stages = [s for s in ALL_STAGES if s != Stage.DOWN]
+    if no_setup:
+        stages.remove(Stage.SETUP)
+    return _execute_dag(dag, cluster_name, stages, dryrun=dryrun,
+                        retry_until_up=retry_until_up,
+                        idle_minutes_to_autostop=idle_minutes_to_autostop,
+                        down=down, detach_run=detach_run,
+                        backend=backend)
+
+
+def exec(entrypoint,  # pylint: disable=redefined-builtin
+         cluster_name: str,
+         detach_run: bool = False,
+         dryrun: bool = False) -> Tuple[Optional[int], Optional[Any]]:
+    """Run on an existing cluster: SYNC_WORKDIR + EXEC only."""
+    dag = _to_dag(entrypoint)
+    if len(dag.tasks) != 1:
+        raise ValueError('exec supports exactly one task.')
+    task = dag.tasks[0]
+    record = state.get_cluster_from_name(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} not found. Use launch instead.')
+    if record['status'] != state.ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is {record["status"].value}.',
+            cluster_status=record['status'])
+    handle = record['handle']
+    # Validate the request fits what was launched.
+    for request in task.resources:
+        if request.less_demanding_than(handle.launched_resources):
+            break
+    else:
+        raise exceptions.ResourcesMismatchError(
+            f'Task resources {task.resources} do not fit cluster '
+            f'{cluster_name} ({handle.launched_resources}).')
+    backend = tpu_gang_backend.TpuGangBackend()
+    if task.workdir:
+        backend.sync_workdir(handle, task.workdir)
+    job_id = backend.execute(handle, task, detach_run=detach_run,
+                             dryrun=dryrun)
+    return job_id, handle
+
+
+def _execute_dag(dag: dag_lib.Dag,
+                 cluster_name: str,
+                 stages: List[Stage],
+                 dryrun: bool,
+                 retry_until_up: bool,
+                 idle_minutes_to_autostop: Optional[int],
+                 down: bool,
+                 detach_run: bool,
+                 backend: Optional[Any]) -> Tuple[Optional[int],
+                                                  Optional[Any]]:
+    if len(dag.tasks) != 1:
+        raise ValueError(
+            'launch executes single-task DAGs; use jobs.launch for '
+            'multi-task pipelines.')
+    task = dag.tasks[0]
+    backend = backend or tpu_gang_backend.TpuGangBackend()
+
+    handle = None
+    existing = state.get_cluster_from_name(cluster_name)
+    if existing is not None and existing['status'] == state.ClusterStatus.UP:
+        handle = existing['handle']
+
+    if Stage.OPTIMIZE in stages and handle is None:
+        best = None
+        for request in task.resources:
+            if request.is_launchable():
+                best = request
+                break
+        if best is None:
+            optimizer_lib.Optimizer.optimize(dag)
+            best = task.best_resources
+    else:
+        best = handle.launched_resources if handle else None
+
+    if Stage.PROVISION in stages and handle is None:
+        handle = backend.provision(task, best, dryrun=dryrun,
+                                   cluster_name=cluster_name,
+                                   retry_until_up=retry_until_up)
+        if dryrun:
+            return None, None
+
+    assert handle is not None
+
+    if Stage.SYNC_WORKDIR in stages and task.workdir:
+        backend.sync_workdir(handle, task.workdir)
+    if Stage.SYNC_FILE_MOUNTS in stages and (task.file_mounts or
+                                             task.storage_mounts):
+        backend.sync_file_mounts(handle, task.file_mounts,
+                                 task.storage_mounts)
+    if Stage.SETUP in stages:
+        backend.setup(handle, task)
+
+    # Autostop before EXEC so failures still get reaped.
+    autostop = task.resources[0].autostop
+    if idle_minutes_to_autostop is not None:
+        autostop = {'idle_minutes': idle_minutes_to_autostop, 'down': down}
+    if autostop is not None:
+        try:
+            backend.set_autostop(handle, autostop['idle_minutes'],
+                                 autostop.get('down', False))
+        except exceptions.NotSupportedError as e:
+            logger.warning(f'Autostop not set: {e}')
+
+    job_id = None
+    if Stage.EXEC in stages and task.run is not None:
+        job_id = backend.execute(handle, task, detach_run=detach_run,
+                                 dryrun=dryrun)
+
+    if Stage.DOWN in stages:
+        backend.teardown(handle, terminate=True)
+
+    return job_id, handle

@@ -92,3 +92,26 @@ class ClusterInfo:
     @property
     def num_instances(self) -> int:
         return len(self.instances)
+
+    # JSON (the head agent reads cluster_info.json to build worker runners)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            'instances': {k: dataclasses.asdict(v)
+                          for k, v in self.instances.items()},
+            'head_instance_id': self.head_instance_id,
+            'provider_name': self.provider_name,
+            'provider_config': self.provider_config,
+            'ssh_user': self.ssh_user,
+        }
+
+    @classmethod
+    def from_json(cls, data: Dict[str, Any]) -> 'ClusterInfo':
+        return cls(
+            instances={k: InstanceInfo(**v)
+                       for k, v in data['instances'].items()},
+            head_instance_id=data.get('head_instance_id'),
+            provider_name=data['provider_name'],
+            provider_config=data.get('provider_config', {}),
+            ssh_user=data.get('ssh_user', 'root'),
+        )

@@ -1,10 +1,14 @@
-"""In-memory provisioner for the fake cloud — the failover test harness.
+"""Disk-backed provisioner for the fake cloud — the failover test harness.
 
 Plays moto's role from the reference's tests (tests/test_failover.py:34-60):
-clusters live in a module-level store; capacity/quota errors are scripted
-per zone via :class:`FailureInjector`; preemption is simulated by calling
-:func:`preempt_cluster` out-of-band (the reference smoke tests terminate
-instances manually, smoke_tests_utils.py:33-36).
+capacity/quota errors are scripted per zone via :class:`FailureInjector`;
+preemption is simulated by calling :func:`preempt_cluster` out-of-band (the
+reference smoke tests terminate instances manually,
+smoke_tests_utils.py:33-36).
+
+The cluster store persists to JSON under ``$XSKY_FAKE_CLOUD_DIR`` (default
+``~/.xsky/fake_cloud``) guarded by a file lock, so separate CLI processes
+see one consistent "cloud" — like a real provider API would behave.
 
 TPU semantics modeled faithfully:
   * a TPU node_config (tpu_vm=True) creates `tpu_num_hosts × num_slices`
@@ -14,23 +18,72 @@ TPU semantics modeled faithfully:
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import json
+import os
+import shutil
+import tempfile
 import threading
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterator, List, Optional
+
+import filelock
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.provision import common
 
-_lock = threading.RLock()
-# cluster_name → {'zone': str, 'region': str, 'instances': {id: InstanceInfo},
-#                 'head_id': str, 'node_config': dict}
-_clusters: Dict[str, Dict[str, Any]] = {}
-_ip_counter = [10]
+_local = threading.RLock()
+
+
+def _store_dir() -> str:
+    return os.path.expanduser(
+        os.environ.get('XSKY_FAKE_CLOUD_DIR', '~/.xsky/fake_cloud'))
+
+
+def _store_path() -> str:
+    return os.path.join(_store_dir(), 'clusters.json')
+
+
+@contextlib.contextmanager
+def _store() -> Iterator[Dict[str, Any]]:
+    """Load → yield (mutable) → save, under process + thread locks."""
+    os.makedirs(_store_dir(), exist_ok=True)
+    lock = filelock.FileLock(os.path.join(_store_dir(), '.lock'))
+    with _local, lock:
+        try:
+            with open(_store_path(), encoding='utf-8') as f:
+                data = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            data = {'clusters': {}, 'ip_counter': 10}
+        yield data
+        tmp = _store_path() + '.tmp'
+        with open(tmp, 'w', encoding='utf-8') as f:
+            json.dump(data, f)
+        os.replace(tmp, _store_path())
+
+
+def _load() -> Dict[str, Any]:
+    """Read-only snapshot (no lock, no rewrite): os.replace makes the
+    store file atomically consistent for readers."""
+    try:
+        with open(_store_path(), encoding='utf-8') as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return {'clusters': {}, 'ip_counter': 10}
+
+
+def _infos_from(cluster: Dict[str, Any]) -> Dict[str, common.InstanceInfo]:
+    return {k: common.InstanceInfo(**v)
+            for k, v in cluster['instances'].items()}
 
 
 class FailureInjector:
-    """Scripted provisioning failures, keyed by zone (or '*')."""
+    """Scripted provisioning failures, keyed by zone (or '*').
+
+    In-process only (tests script failures and provision in-process); the
+    persisted store is for cross-process cluster visibility.
+    """
 
     def __init__(self) -> None:
         self._errors: Dict[str, List[Exception]] = {}
@@ -56,30 +109,28 @@ injector = FailureInjector()
 
 
 def reset() -> None:
-    with _lock:
-        _clusters.clear()
-        injector.reset()
-
-
-def _next_ip() -> str:
-    with _lock:
-        _ip_counter[0] += 1
-        n = _ip_counter[0]
-    return f'10.0.{n // 256}.{n % 256}'
+    with _store() as data:
+        for cluster in data['clusters'].values():
+            for info in cluster['instances'].values():
+                root = info.get('tags', {}).get('host_root')
+                if root:
+                    shutil.rmtree(root, ignore_errors=True)
+        data['clusters'] = {}
+    injector.reset()
 
 
 def run_instances(region: str, zone: Optional[str], cluster_name: str,
                   config: common.ProvisionConfig) -> common.ProvisionRecord:
     zone = zone or f'{region}-a'
-    with _lock:
+    with _store() as data:
         injector.check(zone)
-        existing = _clusters.get(cluster_name)
+        existing = data['clusters'].get(cluster_name)
         if existing is not None:
             resumed = []
             for info in existing['instances'].values():
-                if info.status == 'STOPPED':
-                    info.status = 'RUNNING'
-                    resumed.append(info.instance_id)
+                if info['status'] == 'STOPPED':
+                    info['status'] = 'RUNNING'
+                    resumed.append(info['instance_id'])
             return common.ProvisionRecord(
                 provider_name='fake', cluster_name=cluster_name,
                 region=existing['region'], zone=existing['zone'],
@@ -90,7 +141,7 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
         is_tpu = node_cfg.get('tpu_vm', False)
         hosts_per_slice = node_cfg.get('tpu_num_hosts', 1) if is_tpu else 1
         num_slices = node_cfg.get('tpu_num_slices', 1) if is_tpu else 1
-        instances: Dict[str, common.InstanceInfo] = {}
+        instances: Dict[str, Dict[str, Any]] = {}
         head_id = None
         for node in range(config.count):
             for s in range(num_slices):
@@ -98,17 +149,24 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
                             if is_tpu else None)
                 for h in range(hosts_per_slice):
                     iid = f'fake-{uuid.uuid4().hex[:8]}'
-                    ip = _next_ip()
-                    instances[iid] = common.InstanceInfo(
-                        instance_id=iid, internal_ip=ip, external_ip=ip,
-                        status='RUNNING',
-                        tags={'cluster_name': cluster_name,
-                              'node_index': str(node)},
-                        slice_id=slice_id,
-                        host_index=s * hosts_per_slice + h)
+                    data['ip_counter'] += 1
+                    n = data['ip_counter']
+                    ip = f'10.0.{n // 256}.{n % 256}'
+                    # Each fake host gets a scratch dir standing in for
+                    # its filesystem (used by LocalProcessCommandRunner).
+                    host_root = tempfile.mkdtemp(prefix=f'xsky-{iid}-')
+                    instances[iid] = dataclasses.asdict(
+                        common.InstanceInfo(
+                            instance_id=iid, internal_ip=ip,
+                            external_ip=ip, status='RUNNING',
+                            tags={'cluster_name': cluster_name,
+                                  'node_index': str(node),
+                                  'host_root': host_root},
+                            slice_id=slice_id,
+                            host_index=s * hosts_per_slice + h))
                     if head_id is None:
                         head_id = iid
-        _clusters[cluster_name] = {
+        data['clusters'][cluster_name] = {
             'region': region, 'zone': zone, 'instances': instances,
             'head_id': head_id, 'node_config': dict(node_cfg),
         }
@@ -120,8 +178,8 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
 
 def stop_instances(cluster_name: str,
                    provider_config: Dict[str, Any]) -> None:
-    with _lock:
-        cluster = _clusters.get(cluster_name)
+    with _store() as data:
+        cluster = data['clusters'].get(cluster_name)
         if cluster is None:
             return
         if cluster['node_config'].get('tpu_vm') and \
@@ -129,23 +187,27 @@ def stop_instances(cluster_name: str,
             raise exceptions.NotSupportedError(
                 'Multi-host TPU slices cannot be stopped.')
         for info in cluster['instances'].values():
-            info.status = 'STOPPED'
+            info['status'] = 'STOPPED'
 
 
 def terminate_instances(cluster_name: str,
                         provider_config: Dict[str, Any]) -> None:
-    with _lock:
-        _clusters.pop(cluster_name, None)
+    with _store() as data:
+        cluster = data['clusters'].pop(cluster_name, None)
+    if cluster:
+        for info in cluster['instances'].values():
+            root = info.get('tags', {}).get('host_root')
+            if root:
+                shutil.rmtree(root, ignore_errors=True)
 
 
 def query_instances(cluster_name: str, provider_config: Dict[str, Any]
                     ) -> Dict[str, Optional[str]]:
-    with _lock:
-        cluster = _clusters.get(cluster_name)
-        if cluster is None:
-            return {}
-        return {iid: info.status
-                for iid, info in cluster['instances'].items()}
+    cluster = _load()['clusters'].get(cluster_name)
+    if cluster is None:
+        return {}
+    return {iid: info['status']
+            for iid, info in cluster['instances'].items()}
 
 
 def wait_instances(region: str, cluster_name: str, state: str) -> None:
@@ -154,17 +216,15 @@ def wait_instances(region: str, cluster_name: str, state: str) -> None:
 
 def get_cluster_info(region: str, cluster_name: str,
                      provider_config: Dict[str, Any]) -> common.ClusterInfo:
-    with _lock:
-        cluster = _clusters.get(cluster_name)
-        if cluster is None:
-            raise exceptions.ClusterDoesNotExist(cluster_name)
-        return common.ClusterInfo(
-            instances={k: dataclasses.replace(v)
-                       for k, v in cluster['instances'].items()},
-            head_instance_id=cluster['head_id'],
-            provider_name='fake',
-            provider_config=dict(provider_config or {}),
-            ssh_user='fake-user')
+    cluster = _load()['clusters'].get(cluster_name)
+    if cluster is None:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    return common.ClusterInfo(
+        instances=_infos_from(cluster),
+        head_instance_id=cluster['head_id'],
+        provider_name='fake',
+        provider_config=dict(provider_config or {}),
+        ssh_user='fake-user')
 
 
 # ---- test helpers ----------------------------------------------------------
@@ -176,5 +236,4 @@ def preempt_cluster(cluster_name: str) -> None:
 
 
 def cluster_exists(cluster_name: str) -> bool:
-    with _lock:
-        return cluster_name in _clusters
+    return cluster_name in _load()['clusters']

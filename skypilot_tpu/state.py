@@ -1,0 +1,262 @@
+"""Global user state: cluster/storage/request records in sqlite.
+
+Twin of sky/global_user_state.py (sqlalchemy, 1,535 LoC); rebuilt on plain
+sqlite3 with WAL — the tables are small and the simpler layer keeps the
+server process dependency-free. DB path: ``~/.xsky/state.db`` (override with
+XSKY_STATE_DB for tests).
+"""
+from __future__ import annotations
+
+import enum
+import os
+import pickle
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_lock = threading.RLock()
+_conn: Optional[sqlite3.Connection] = None
+_conn_path: Optional[str] = None
+
+
+class ClusterStatus(enum.Enum):
+    INIT = 'INIT'
+    UP = 'UP'
+    STOPPED = 'STOPPED'
+
+
+class StorageStatus(enum.Enum):
+    INIT = 'INIT'
+    UPLOAD_FAILED = 'UPLOAD_FAILED'
+    READY = 'READY'
+
+
+def _db_path() -> str:
+    return os.path.expanduser(
+        os.environ.get('XSKY_STATE_DB', '~/.xsky/state.db'))
+
+
+def _get_conn() -> sqlite3.Connection:
+    global _conn, _conn_path
+    path = _db_path()
+    with _lock:
+        if _conn is None or _conn_path != path:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            _conn = sqlite3.connect(path, check_same_thread=False)
+            _conn.execute('PRAGMA journal_mode=WAL')
+            _create_tables(_conn)
+            _conn_path = path
+        return _conn
+
+
+def _create_tables(conn: sqlite3.Connection) -> None:
+    conn.executescript("""
+        CREATE TABLE IF NOT EXISTS clusters (
+            name TEXT PRIMARY KEY,
+            launched_at INTEGER,
+            handle BLOB,
+            last_use TEXT,
+            status TEXT,
+            autostop INTEGER DEFAULT -1,
+            to_down INTEGER DEFAULT 0,
+            requested_resources BLOB
+        );
+        CREATE TABLE IF NOT EXISTS storage (
+            name TEXT PRIMARY KEY,
+            launched_at INTEGER,
+            handle BLOB,
+            last_use TEXT,
+            status TEXT
+        );
+        CREATE TABLE IF NOT EXISTS enabled_clouds (
+            cloud TEXT PRIMARY KEY
+        );
+        CREATE TABLE IF NOT EXISTS config (
+            key TEXT PRIMARY KEY,
+            value TEXT
+        );
+    """)
+    conn.commit()
+
+
+def reset_for_test() -> None:
+    global _conn, _conn_path
+    with _lock:
+        if _conn is not None:
+            _conn.close()
+        _conn = None
+        _conn_path = None
+
+
+# ---- clusters -------------------------------------------------------------
+
+
+def add_or_update_cluster(cluster_name: str,
+                          cluster_handle: Any,
+                          requested_resources: Optional[Any] = None,
+                          ready: bool = False,
+                          is_launch: bool = True) -> None:
+    status = ClusterStatus.UP if ready else ClusterStatus.INIT
+    conn = _get_conn()
+    with _lock:
+        now = int(time.time())
+        requested = pickle.dumps(requested_resources) \
+            if requested_resources is not None else None
+        conn.execute(
+            """INSERT INTO clusters
+               (name, launched_at, handle, last_use, status,
+                requested_resources)
+               VALUES (?, ?, ?, ?, ?, ?)
+               ON CONFLICT(name) DO UPDATE SET
+                 handle=excluded.handle,
+                 status=excluded.status,
+                 last_use=excluded.last_use,
+                 requested_resources=COALESCE(
+                     excluded.requested_resources,
+                     clusters.requested_resources)""" +
+            (', launched_at=excluded.launched_at' if is_launch else ''),
+            (cluster_name, now, pickle.dumps(cluster_handle),
+             str(now), status.value, requested))
+        conn.commit()
+
+
+def update_cluster_status(cluster_name: str,
+                          status: ClusterStatus) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute('UPDATE clusters SET status=? WHERE name=?',
+                     (status.value, cluster_name))
+        conn.commit()
+
+
+def set_cluster_autostop(cluster_name: str, idle_minutes: int,
+                         to_down: bool) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'UPDATE clusters SET autostop=?, to_down=? WHERE name=?',
+            (idle_minutes, int(to_down), cluster_name))
+        conn.commit()
+
+
+def remove_cluster(cluster_name: str, terminate: bool) -> None:
+    conn = _get_conn()
+    with _lock:
+        if terminate:
+            conn.execute('DELETE FROM clusters WHERE name=?',
+                         (cluster_name,))
+        else:
+            conn.execute('UPDATE clusters SET status=? WHERE name=?',
+                         (ClusterStatus.STOPPED.value, cluster_name))
+        conn.commit()
+
+
+def _row_to_record(row) -> Dict[str, Any]:
+    (name, launched_at, handle, last_use, status, autostop, to_down,
+     requested) = row
+    return {
+        'name': name,
+        'launched_at': launched_at,
+        'handle': pickle.loads(handle) if handle else None,
+        'last_use': last_use,
+        'status': ClusterStatus(status),
+        'autostop': autostop,
+        'to_down': bool(to_down),
+        'requested_resources': pickle.loads(requested)
+                               if requested else None,
+    }
+
+
+def get_cluster_from_name(
+        cluster_name: str) -> Optional[Dict[str, Any]]:
+    conn = _get_conn()
+    with _lock:
+        row = conn.execute('SELECT * FROM clusters WHERE name=?',
+                           (cluster_name,)).fetchone()
+    return _row_to_record(row) if row else None
+
+
+def get_clusters() -> List[Dict[str, Any]]:
+    conn = _get_conn()
+    with _lock:
+        rows = conn.execute(
+            'SELECT * FROM clusters ORDER BY launched_at DESC').fetchall()
+    return [_row_to_record(r) for r in rows]
+
+
+def get_handle_from_cluster_name(cluster_name: str) -> Optional[Any]:
+    record = get_cluster_from_name(cluster_name)
+    return record['handle'] if record else None
+
+
+def update_last_use(cluster_name: str) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute('UPDATE clusters SET last_use=? WHERE name=?',
+                     (str(int(time.time())), cluster_name))
+        conn.commit()
+
+
+# ---- storage --------------------------------------------------------------
+
+
+def add_or_update_storage(storage_name: str, storage_handle: Any,
+                          storage_status: StorageStatus) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            """INSERT INTO storage (name, launched_at, handle, last_use,
+                                    status)
+               VALUES (?, ?, ?, ?, ?)
+               ON CONFLICT(name) DO UPDATE SET handle=excluded.handle,
+                 status=excluded.status, last_use=excluded.last_use""",
+            (storage_name, int(time.time()), pickle.dumps(storage_handle),
+             str(int(time.time())), storage_status.value))
+        conn.commit()
+
+
+def remove_storage(storage_name: str) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute('DELETE FROM storage WHERE name=?', (storage_name,))
+        conn.commit()
+
+
+def get_storage() -> List[Dict[str, Any]]:
+    conn = _get_conn()
+    with _lock:
+        rows = conn.execute('SELECT * FROM storage').fetchall()
+    return [{
+        'name': r[0],
+        'launched_at': r[1],
+        'handle': pickle.loads(r[2]) if r[2] else None,
+        'last_use': r[3],
+        'status': StorageStatus(r[4]),
+    } for r in rows]
+
+
+def get_storage_from_name(storage_name: str) -> Optional[Dict[str, Any]]:
+    for record in get_storage():
+        if record['name'] == storage_name:
+            return record
+    return None
+
+
+# ---- enabled clouds cache -------------------------------------------------
+
+
+def set_enabled_clouds(clouds: List[str]) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute('DELETE FROM enabled_clouds')
+        conn.executemany('INSERT INTO enabled_clouds VALUES (?)',
+                         [(c,) for c in clouds])
+        conn.commit()
+
+
+def get_enabled_clouds() -> List[str]:
+    conn = _get_conn()
+    with _lock:
+        rows = conn.execute('SELECT cloud FROM enabled_clouds').fetchall()
+    return [r[0] for r in rows]

@@ -1,0 +1,251 @@
+"""Command runners: run commands / sync files on cluster hosts.
+
+Twin of sky/utils/command_runner.py:169,455,732,985 (SSHCommandRunner,
+KubernetesCommandRunner, LocalProcessCommandRunner). The gang launcher
+drives one runner per TPU host; in tests LocalProcessCommandRunner stands
+in for SSH so multi-host logic runs hermetically.
+"""
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import tempfile
+from typing import Dict, List, Optional, Tuple, Union
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+SSH_COMMON_OPTS = [
+    '-o', 'StrictHostKeyChecking=no',
+    '-o', 'UserKnownHostsFile=/dev/null',
+    '-o', 'IdentitiesOnly=yes',
+    '-o', 'ConnectTimeout=30',
+    '-o', 'ServerAliveInterval=20',
+    '-o', 'ServerAliveCountMax=3',
+    '-o', 'LogLevel=ERROR',
+]
+
+
+def _local_sync(source: str, target: str, excludes: List[str]) -> None:
+    """rsync-like local copy (trailing-slash dir semantics, excludes).
+
+    Pure Python: the test image has no rsync binary, and local "hosts"
+    only need content sync, not delta transfer.
+    """
+    import fnmatch
+    import shutil
+
+    def excluded(name: str) -> bool:
+        return any(fnmatch.fnmatch(name, pat) for pat in excludes)
+
+    if os.path.isdir(source):
+        src_root = source.rstrip('/')
+        dst_root = target.rstrip('/')
+        if not source.endswith('/'):
+            dst_root = os.path.join(dst_root, os.path.basename(src_root))
+        os.makedirs(dst_root, exist_ok=True)
+        for dirpath, dirnames, filenames in os.walk(src_root):
+            dirnames[:] = [d for d in dirnames if not excluded(d)]
+            rel = os.path.relpath(dirpath, src_root)
+            out_dir = os.path.join(dst_root, rel) if rel != '.' else dst_root
+            os.makedirs(out_dir, exist_ok=True)
+            for fname in filenames:
+                if excluded(fname):
+                    continue
+                shutil.copy2(os.path.join(dirpath, fname),
+                             os.path.join(out_dir, fname))
+    else:
+        if target.endswith('/'):
+            os.makedirs(target, exist_ok=True)
+            target = os.path.join(target, os.path.basename(source))
+        else:
+            os.makedirs(os.path.dirname(target) or '/', exist_ok=True)
+        shutil.copy2(source, target)
+
+
+def _make_env_prefix(env: Optional[Dict[str, str]]) -> str:
+    if not env:
+        return ''
+    parts = [f'export {k}={shlex.quote(str(v))};' for k, v in env.items()]
+    return ' '.join(parts) + ' '
+
+
+class CommandRunner:
+    """Abstract runner bound to one host."""
+
+    def __init__(self, node_id: str) -> None:
+        self.node_id = node_id
+
+    def run(self,
+            cmd: Union[str, List[str]],
+            *,
+            env: Optional[Dict[str, str]] = None,
+            cwd: Optional[str] = None,
+            stream_logs: bool = False,
+            log_path: Optional[str] = None,
+            require_outputs: bool = False,
+            timeout: Optional[float] = None
+            ) -> Union[int, Tuple[int, str, str]]:
+        raise NotImplementedError
+
+    def run_async(self, cmd: str, *, env: Optional[Dict[str, str]] = None,
+                  log_path: Optional[str] = None,
+                  cwd: Optional[str] = None) -> subprocess.Popen:
+        """Start a long-running command; returns the local process handle
+        (for SSH runners the local ssh client process)."""
+        raise NotImplementedError
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              excludes: Optional[List[str]] = None) -> None:
+        raise NotImplementedError
+
+
+class LocalProcessCommandRunner(CommandRunner):
+    """Runs on this machine (tests + the 'local' fake cloud).
+
+    Each fake host gets a scratch dir standing in for its filesystem, so
+    rsync/workdir logic is exercised for real.
+    """
+
+    def __init__(self, node_id: str = 'local',
+                 host_root: Optional[str] = None) -> None:
+        super().__init__(node_id)
+        self.host_root = host_root or tempfile.mkdtemp(
+            prefix=f'xsky-host-{node_id}-')
+
+    def _wrap(self, cmd: Union[str, List[str]],
+              env: Optional[Dict[str, str]], cwd: Optional[str]) -> str:
+        if isinstance(cmd, list):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        prefix = _make_env_prefix(env)
+        workdir = cwd or self.host_root
+        return f'cd {shlex.quote(workdir)} && {prefix}{cmd}'
+
+    def run(self, cmd, *, env=None, cwd=None, stream_logs=False,
+            log_path=None, require_outputs=False, timeout=None):
+        full = self._wrap(cmd, env, cwd)
+        proc = subprocess.run(['bash', '-c', full], capture_output=True,
+                              text=True, timeout=timeout, check=False)
+        if log_path:
+            with open(log_path, 'a', encoding='utf-8') as f:
+                f.write(proc.stdout)
+                f.write(proc.stderr)
+        if stream_logs and proc.stdout:
+            print(proc.stdout, end='')
+        if require_outputs:
+            return proc.returncode, proc.stdout, proc.stderr
+        return proc.returncode
+
+    def run_async(self, cmd, *, env=None, log_path=None, cwd=None):
+        full = self._wrap(cmd, env, cwd)
+        out = open(log_path, 'ab') if log_path else subprocess.DEVNULL
+        return subprocess.Popen(['bash', '-c', full], stdout=out,
+                                stderr=subprocess.STDOUT)
+
+    def rsync(self, source: str, target: str, *, up: bool, excludes=None):
+        source = os.path.expanduser(source)
+        if up:
+            target = os.path.join(self.host_root, target.lstrip('/'))
+        else:
+            source = os.path.join(self.host_root, source.lstrip('/'))
+        os.makedirs(os.path.dirname(target.rstrip('/')) or '/', exist_ok=True)
+        _local_sync(source, target, excludes or [])
+
+
+class SSHCommandRunner(CommandRunner):
+    """SSH + rsync to one host (ControlMaster multiplexing, proxy jump)."""
+
+    def __init__(self, ip: str, ssh_user: str, ssh_private_key: str,
+                 port: int = 22,
+                 ssh_proxy_command: Optional[str] = None) -> None:
+        super().__init__(ip)
+        self.ip = ip
+        self.ssh_user = ssh_user
+        self.ssh_private_key = os.path.expanduser(ssh_private_key)
+        self.port = port
+        self.ssh_proxy_command = ssh_proxy_command
+        self._control_path = os.path.join(
+            tempfile.gettempdir(),
+            f'xsky-ssh-{ssh_user}-{ip}-{port}')
+
+    def _ssh_base(self) -> List[str]:
+        args = ['ssh'] + SSH_COMMON_OPTS + [
+            '-i', self.ssh_private_key,
+            '-p', str(self.port),
+            '-o', f'ControlPath={self._control_path}',
+            '-o', 'ControlMaster=auto',
+            '-o', 'ControlPersist=120s',
+        ]
+        if self.ssh_proxy_command:
+            args += ['-o', f'ProxyCommand={self.ssh_proxy_command}']
+        return args + [f'{self.ssh_user}@{self.ip}']
+
+    def run(self, cmd, *, env=None, cwd=None, stream_logs=False,
+            log_path=None, require_outputs=False, timeout=None):
+        if isinstance(cmd, list):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        prefix = _make_env_prefix(env)
+        if cwd:
+            cmd = f'cd {shlex.quote(cwd)} && {cmd}'
+        remote = f'bash --login -c {shlex.quote(prefix + cmd)}'
+        full = self._ssh_base() + [remote]
+        proc = subprocess.run(full, capture_output=True, text=True,
+                              timeout=timeout, check=False)
+        if log_path:
+            with open(log_path, 'a', encoding='utf-8') as f:
+                f.write(proc.stdout)
+                f.write(proc.stderr)
+        if stream_logs and proc.stdout:
+            print(proc.stdout, end='')
+        if require_outputs:
+            return proc.returncode, proc.stdout, proc.stderr
+        return proc.returncode
+
+    def run_async(self, cmd, *, env=None, log_path=None, cwd=None):
+        prefix = _make_env_prefix(env)
+        if cwd:
+            cmd = f'cd {shlex.quote(cwd)} && {cmd}'
+        remote = f'bash --login -c {shlex.quote(prefix + cmd)}'
+        out = open(log_path, 'ab') if log_path else subprocess.DEVNULL
+        return subprocess.Popen(self._ssh_base() + [remote], stdout=out,
+                                stderr=subprocess.STDOUT)
+
+    def rsync(self, source: str, target: str, *, up: bool, excludes=None):
+        ssh_cmd = ' '.join(
+            ['ssh'] + SSH_COMMON_OPTS +
+            ['-i', self.ssh_private_key, '-p', str(self.port)])
+        args = ['rsync', '-az', '--delete', '-e', ssh_cmd]
+        for e in excludes or []:
+            args += ['--exclude', e]
+        remote = f'{self.ssh_user}@{self.ip}:{target}'
+        if up:
+            args += [os.path.expanduser(source), remote]
+        else:
+            args += [remote, os.path.expanduser(source)]
+        subprocess.run(args, check=True, capture_output=True)
+
+
+def runners_from_cluster_info(cluster_info, ssh_private_key: str,
+                              use_local: bool = False,
+                              internal_ips: bool = False
+                              ) -> List[CommandRunner]:
+    """One runner per host, in gang rank order.
+
+    internal_ips=True keeps traffic on the VPC (head→worker fan-out).
+    """
+    runners: List[CommandRunner] = []
+    for info in cluster_info.sorted_instances():
+        if use_local or cluster_info.provider_name in ('fake', 'local'):
+            runners.append(
+                LocalProcessCommandRunner(
+                    info.instance_id,
+                    host_root=info.tags.get('host_root')))
+        else:
+            ip = info.internal_ip if internal_ips else \
+                info.get_feasible_ip()
+            runners.append(
+                SSHCommandRunner(ip, cluster_info.ssh_user,
+                                 ssh_private_key, port=info.ssh_port))
+    return runners

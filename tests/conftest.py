@@ -35,6 +35,27 @@ def enable_fake_cloud(monkeypatch):
 
 
 @pytest.fixture
+def fake_cluster_env(monkeypatch, tmp_path):
+    """Fake cloud + isolated state DB + clean fake provisioner store.
+
+    The full launch-stack harness: twin of the reference's _mock_db_conn +
+    moto pattern (tests/test_failover.py:21-60).
+    """
+    from skypilot_tpu import state
+    from skypilot_tpu.provision.fake import instance as fake_instance
+    monkeypatch.setenv('XSKY_ENABLE_FAKE_CLOUD', '1')
+    monkeypatch.setenv('XSKY_STATE_DB', str(tmp_path / 'state.db'))
+    monkeypatch.setenv('XSKY_FAKE_CLOUD_DIR', str(tmp_path / 'fake_cloud'))
+    check_lib.set_enabled_clouds_for_test(['fake'])
+    state.reset_for_test()
+    fake_instance.reset()
+    yield fake_instance
+    check_lib.set_enabled_clouds_for_test(None)
+    fake_instance.reset()
+    state.reset_for_test()
+
+
+@pytest.fixture
 def enable_gcp_and_fake(monkeypatch):
     """Pretend GCP credentials exist alongside the fake cloud."""
     monkeypatch.setenv('XSKY_ENABLE_FAKE_CLOUD', '1')

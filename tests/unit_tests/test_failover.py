@@ -1,0 +1,94 @@
+"""Failover engine tests (twin of tests/test_failover.py with moto)."""
+import pytest
+
+from skypilot_tpu import Resources, Task
+from skypilot_tpu import exceptions
+from skypilot_tpu.backends import failover
+
+
+def _tpu_task():
+    t = Task(run='python train.py')
+    t.set_resources(Resources(accelerators='tpu-v5e-8'))
+    return t
+
+
+class TestZoneFailover:
+
+    def test_capacity_error_fails_over_to_next_zone(self, fake_cluster_env):
+        fake = fake_cluster_env
+        fake.injector.fail_zone('fake-central1-a',
+                                exceptions.CapacityError('stockout'))
+        provisioner = failover.RetryingProvisioner(_tpu_task(), 'c1', 1)
+        result = provisioner.provision_with_retries()
+        assert result.record.zone != 'fake-central1-a'
+        assert 'fake-central1-a' in fake.injector.attempts
+
+    def test_quota_error_blocks_whole_region(self, fake_cluster_env):
+        fake = fake_cluster_env
+        fake.injector.fail_zone('fake-central1-a',
+                                exceptions.QuotaExceededError('quota'))
+        provisioner = failover.RetryingProvisioner(_tpu_task(), 'c1', 1)
+        result = provisioner.provision_with_retries()
+        # Region fake-central1 has zone -b too; quota must skip it.
+        assert not result.record.zone.startswith('fake-central1')
+
+    def test_all_zones_blocked_raises(self, fake_cluster_env):
+        fake = fake_cluster_env
+        fake.injector.fail_zone('*', exceptions.CapacityError('stockout'))
+        provisioner = failover.RetryingProvisioner(_tpu_task(), 'c1', 1)
+        with pytest.raises(exceptions.ResourcesUnavailableError) as e:
+            provisioner.provision_with_retries()
+        assert e.value.failover_history  # carries what was tried
+
+    def test_invalid_request_no_failover(self, fake_cluster_env):
+        fake = fake_cluster_env
+        fake.injector.fail_zone(
+            'fake-central1-a',
+            exceptions.InvalidRequestError('bad runtime version'))
+        provisioner = failover.RetryingProvisioner(_tpu_task(), 'c1', 1)
+        with pytest.raises(exceptions.ResourcesUnavailableError) as e:
+            provisioner.provision_with_retries()
+        assert e.value.no_failover
+
+    def test_gpu_to_tpu_sku_failover(self, fake_cluster_env):
+        """North star: GPU blocked everywhere → lands on a TPU slice."""
+        fake = fake_cluster_env
+        task = Task(run='train')
+        task.set_resources([
+            Resources(accelerators='tpu-v5e-8'),
+            Resources(accelerators='FAKEGPU:8'),
+        ], ordered=True)
+        # TPU (user's first choice) is stocked out once per zone; after
+        # the TPU SKU exhausts all 4 zones, the GPU attempt in the first
+        # zone succeeds (its one scripted error was already consumed).
+        for zone in ['fake-central1-a', 'fake-central1-b', 'fake-west1-a',
+                     'fake-east1-a']:
+            fake.injector.fail_zone(zone,
+                                    exceptions.CapacityError('tpu out'),
+                                    times=1)
+        provisioner = failover.RetryingProvisioner(task, 'c1', 1)
+        result = provisioner.provision_with_retries()
+        assert result.resources.accelerators == {'FAKEGPU': 8}
+        assert len(provisioner.failover_history) == 4
+
+    def test_tpu_pod_creates_hosts(self, fake_cluster_env):
+        task = Task(run='train')
+        task.set_resources(Resources(accelerators='tpu-v5e-32'))
+        provisioner = failover.RetryingProvisioner(task, 'pod', 1)
+        result = provisioner.provision_with_retries()
+        # v5e-32 = 4 hosts of 8 chips.
+        assert result.cluster_info.num_instances == 4
+        head = result.cluster_info.get_head_instance()
+        assert head is not None
+
+    def test_multislice_hosts(self, fake_cluster_env):
+        task = Task(run='train')
+        task.set_resources(
+            Resources(accelerators='tpu-v5e-32',
+                      accelerator_args={'num_slices': 2}))
+        provisioner = failover.RetryingProvisioner(task, 'ms', 1)
+        result = provisioner.provision_with_retries()
+        assert result.cluster_info.num_instances == 8
+        slices = {i.slice_id
+                  for i in result.cluster_info.instances.values()}
+        assert len(slices) == 2

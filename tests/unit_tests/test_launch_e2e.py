@@ -1,0 +1,191 @@
+"""End-to-end launch → gang execute → logs → exec → teardown on the fake
+cloud. This is the harness the reference lacks (SURVEY §4.5: no fake
+multi-node backend) — every host is a real local process.
+"""
+import json
+import os
+import time
+
+import pytest
+
+from skypilot_tpu import Resources, Task
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu import state
+from skypilot_tpu.agent import job_lib
+
+
+def _wait_status(backend, handle, job_id, timeout=20):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        status = backend.get_job_status(handle, job_id)
+        if status is not None and status.is_terminal():
+            return status
+        time.sleep(0.2)
+    raise TimeoutError
+
+
+class TestLaunch:
+
+    def test_launch_single_host(self, fake_cluster_env):
+        task = Task('hello', run='echo "hello from $XSKY_HOST_RANK"')
+        task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        job_id, handle = execution.launch(task, cluster_name='t1')
+        assert job_id == 1
+        record = state.get_cluster_from_name('t1')
+        assert record['status'] == state.ClusterStatus.UP
+        from skypilot_tpu.backends import tpu_gang_backend
+        backend = tpu_gang_backend.TpuGangBackend()
+        logs = backend.tail_logs(handle, job_id, follow=False)
+        assert 'hello from 0' in logs
+
+    def test_gang_env_on_pod(self, fake_cluster_env):
+        """All 4 hosts of a v5e-32 slice run, each with correct rank env."""
+        task = Task(
+            'envdump',
+            run='echo RANK=$XSKY_HOST_RANK/$XSKY_NUM_HOSTS '
+                'TPU_ID=$TPU_WORKER_ID NODES=$XSKY_NUM_NODES '
+                'COORD=$XSKY_COORDINATOR_ADDRESS')
+        task.set_resources(Resources(accelerators='tpu-v5e-32'))
+        job_id, handle = execution.launch(task, cluster_name='pod1')
+        root = handle.head_runtime_root
+        log_dir = os.path.join(root, 'logs', f'job-{job_id}')
+        contents = {}
+        for rank in range(4):
+            with open(os.path.join(log_dir, f'host-{rank}.log')) as f:
+                contents[rank] = f.read()
+        for rank in range(4):
+            assert f'RANK={rank}/4' in contents[rank]
+            assert f'TPU_ID={rank}' in contents[rank]
+        # Same coordinator everywhere.
+        coords = {c.split('COORD=')[1].strip()
+                  for c in contents.values()}
+        assert len(coords) == 1
+
+    def test_gang_failure_kills_all(self, fake_cluster_env):
+        """One host exiting non-zero fails the job (all-or-nothing)."""
+        task = Task(
+            'failing',
+            run='if [ "$XSKY_HOST_RANK" = "1" ]; then exit 3; fi; '
+                'sleep 30')
+        task.set_resources(Resources(accelerators='tpu-v5e-32'))
+        t0 = time.time()
+        with pytest.raises(exceptions.JobExitNonZeroError):
+            execution.launch(task, cluster_name='failpod')
+        # Must not wait out the sleep 30 on the healthy hosts.
+        assert time.time() - t0 < 25
+
+    def test_exec_on_existing_cluster(self, fake_cluster_env):
+        task = Task('first', run='echo one')
+        task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        job1, handle = execution.launch(task, cluster_name='reuse')
+        task2 = Task('second', run='echo two')
+        task2.set_resources(Resources(accelerators='tpu-v5e-8'))
+        job2, _ = execution.exec(task2, cluster_name='reuse')
+        assert job2 == job1 + 1
+
+    def test_exec_mismatched_resources(self, fake_cluster_env):
+        task = Task('first', run='echo one')
+        task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        execution.launch(task, cluster_name='small')
+        big = Task('big', run='echo big')
+        big.set_resources(Resources(accelerators='tpu-v5p-64'))
+        with pytest.raises(exceptions.ResourcesMismatchError):
+            execution.exec(big, cluster_name='small')
+
+    def test_exec_on_missing_cluster(self, fake_cluster_env):
+        t = Task(run='echo x')
+        with pytest.raises(exceptions.ClusterDoesNotExist):
+            execution.exec(t, cluster_name='ghost')
+
+    def test_setup_failure_raises(self, fake_cluster_env):
+        task = Task('badsetup', setup='exit 7', run='echo never')
+        task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        with pytest.raises(exceptions.ClusterSetUpError):
+            execution.launch(task, cluster_name='badsetup')
+
+    def test_workdir_sync(self, fake_cluster_env, tmp_path):
+        workdir = tmp_path / 'proj'
+        workdir.mkdir()
+        (workdir / 'data.txt').write_text('payload42')
+        task = Task('wd', run='cat sky_workdir/data.txt',
+                    workdir=str(workdir))
+        task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        job_id, handle = execution.launch(task, cluster_name='wd1')
+        from skypilot_tpu.backends import tpu_gang_backend
+        backend = tpu_gang_backend.TpuGangBackend()
+        assert 'payload42' in backend.tail_logs(handle, job_id, False)
+
+    def test_teardown_removes_cluster(self, fake_cluster_env):
+        fake = fake_cluster_env
+        task = Task('gone', run='echo bye')
+        task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        _, handle = execution.launch(task, cluster_name='gone')
+        from skypilot_tpu.backends import tpu_gang_backend
+        backend = tpu_gang_backend.TpuGangBackend()
+        backend.teardown(handle, terminate=True)
+        assert state.get_cluster_from_name('gone') is None
+        assert not fake.cluster_exists('gone')
+
+    def test_stop_multihost_tpu_refused(self, fake_cluster_env):
+        task = Task('pod', run='echo hi')
+        task.set_resources(Resources(accelerators='tpu-v5e-32'))
+        _, handle = execution.launch(task, cluster_name='pod2')
+        from skypilot_tpu.backends import tpu_gang_backend
+        backend = tpu_gang_backend.TpuGangBackend()
+        with pytest.raises(exceptions.NotSupportedError):
+            backend.teardown(handle, terminate=False)
+
+    def test_fifo_queue_order(self, fake_cluster_env):
+        """Second job queues while the first runs; runs after it."""
+        task = Task('slow', run='sleep 1.2; echo done1')
+        task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        job1, handle = execution.launch(task, cluster_name='q1',
+                                        detach_run=True)
+        fast = Task('fast', run='echo done2')
+        fast.set_resources(Resources(accelerators='tpu-v5e-8'))
+        job2, _ = execution.exec(fast, cluster_name='q1', detach_run=True)
+        from skypilot_tpu.backends import tpu_gang_backend
+        backend = tpu_gang_backend.TpuGangBackend()
+        s1 = _wait_status(backend, handle, job1)
+        s2 = _wait_status(backend, handle, job2)
+        assert s1 == job_lib.JobStatus.SUCCEEDED
+        assert s2 == job_lib.JobStatus.SUCCEEDED
+        queue = backend.get_job_queue(handle)
+        j1 = next(j for j in queue if j['job_id'] == job1)
+        j2 = next(j for j in queue if j['job_id'] == job2)
+        assert j2['started_at'] >= j1['ended_at']
+
+    def test_cancel_running_job(self, fake_cluster_env):
+        task = Task('cancelme', run='sleep 60')
+        task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        job_id, handle = execution.launch(task, cluster_name='c2',
+                                          detach_run=True)
+        from skypilot_tpu.backends import tpu_gang_backend
+        backend = tpu_gang_backend.TpuGangBackend()
+        # Wait for RUNNING, then cancel.
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if backend.get_job_status(handle, job_id) == \
+                    job_lib.JobStatus.RUNNING:
+                break
+            time.sleep(0.2)
+        backend.cancel_jobs(handle, [job_id])
+        status = _wait_status(backend, handle, job_id)
+        assert status == job_lib.JobStatus.CANCELLED
+
+    def test_autostop_lifecycle(self, fake_cluster_env):
+        from skypilot_tpu.agent import autostop_lib, daemon
+        task = Task('idle', run='echo done')
+        task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        _, handle = execution.launch(
+            task, cluster_name='a1', idle_minutes_to_autostop=0, down=True)
+        root = handle.head_runtime_root
+        record = state.get_cluster_from_name('a1')
+        assert record['autostop'] == 0
+        # Tick the agent: idle 0-minute deadline passed → marker written.
+        daemon.run_forever(root=root, interval_s=0, max_ticks=1)
+        marker = os.path.join(root, 'autostop_triggered.json')
+        assert os.path.exists(marker)
+        with open(marker) as f:
+            assert json.load(f)['down'] is True
