@@ -199,7 +199,16 @@ def provision_with_retry_until_up(
         attempt += 1
         try:
             return provisioner.provision_with_retries()
-        except exceptions.ResourcesUnavailableError:
+        except exceptions.ResourcesUnavailableError as e:
+            if e.no_failover:
+                # Permanently invalid request (bad topology/runtime
+                # version): waiting will not help.
+                raise
+            if not e.failover_history and not provisioner.blocked:
+                # Nothing was ever tried: the request is infeasible
+                # (no catalog offering), not a capacity problem — waiting
+                # will not help.
+                raise
             if not retry_until_up or attempt >= max_total_retries:
                 raise
             logger.info(f'Retrying in {retry_interval_s}s '

@@ -330,6 +330,28 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                 raise
         state.remove_cluster(handle.cluster_name, terminate=terminate)
 
+    def check_autostop_trigger(
+            self, handle: ClusterHandle) -> Optional[Dict[str, Any]]:
+        """Read-and-clear the agent's autostop marker, if present.
+
+        The head agent cannot call the cloud API itself (no credentials
+        on-host); the control plane polls this during status refresh and
+        performs the stop/teardown (pull model; the reference pushes from
+        the skylet with per-cloud creds, sky/skylet/events.py:102).
+        """
+        head = handle.head_runner()
+        root = handle.head_runtime_root
+        marker = f'{root}/autostop_triggered.json'
+        rc, out, _ = head.run(
+            f'cat {marker} 2>/dev/null && rm -f {marker}',
+            env=self._agent_env(handle), require_outputs=True)
+        if rc != 0 or not out.strip():
+            return None
+        try:
+            return json.loads(out.strip().splitlines()[-1])
+        except (json.JSONDecodeError, IndexError):
+            return None
+
     def set_autostop(self, handle: ClusterHandle, idle_minutes: int,
                      down: bool = False) -> None:
         head = handle.head_runner()

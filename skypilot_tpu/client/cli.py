@@ -145,16 +145,23 @@ def status(clusters, refresh):
     if not records:
         click.echo('No existing clusters.')
         return
-    fmt = '{:<18} {:<28} {:<9} {:<10}'
+    fmt = '{:<18} {:<40} {:<9} {:<10}'
     click.echo(fmt.format('NAME', 'RESOURCES', 'STATUS', 'AUTOSTOP'))
     for r in records:
-        handle = r['handle']
-        resources = str(handle.launched_resources) if handle else '-'
+        # Records may be local (enums/handles) or jsonified (remote API).
+        handle = r.get('handle')
+        if isinstance(handle, dict):
+            resources = handle.get('resources') or '-'
+        elif handle is not None:
+            resources = str(handle.launched_resources)
+        else:
+            resources = '-'
+        status_v = getattr(r['status'], 'value', r['status'])
         autostop_s = (f'{r["autostop"]}m' +
                       ('(down)' if r['to_down'] else '')
                       if r['autostop'] >= 0 else '-')
-        click.echo(fmt.format(r['name'], resources[:28],
-                              r['status'].value, autostop_s))
+        click.echo(fmt.format(r['name'], resources[:40], status_v,
+                              autostop_s))
 
 
 @cli.command()
@@ -301,17 +308,17 @@ def jobs():
 def jobs_launch(entrypoint, envs, secrets, name, num_nodes, accelerators,
                 cloud, use_spot, yes):
     """Launch a managed job (controller recovers preemptions)."""
-    from skypilot_tpu.jobs import core as jobs_core
+    from skypilot_tpu.client import sdk
     t = _load_task(entrypoint, envs, secrets, name, num_nodes,
                    accelerators, cloud, use_spot)
-    job_id = jobs_core.launch(t)
+    job_id = sdk.jobs_launch(t)
     click.echo(f'Managed job {job_id} submitted.')
 
 
 @jobs.command(name='queue')
 def jobs_queue():
-    from skypilot_tpu.jobs import core as jobs_core
-    rows = jobs_core.queue()
+    from skypilot_tpu.client import sdk
+    rows = sdk.jobs_queue()
     fmt = '{:<6} {:<16} {:<14} {:<8}'
     click.echo(fmt.format('ID', 'NAME', 'STATUS', 'RECOVERIES'))
     for r in rows:
@@ -322,17 +329,17 @@ def jobs_queue():
 @jobs.command(name='cancel')
 @click.argument('job_ids', nargs=-1, type=int, required=True)
 def jobs_cancel(job_ids):
-    from skypilot_tpu.jobs import core as jobs_core
+    from skypilot_tpu.client import sdk
     for jid in job_ids:
-        jobs_core.cancel(jid)
+        sdk.jobs_cancel(jid)
     click.echo('Cancelled.')
 
 
 @jobs.command(name='logs')
 @click.argument('job_id', type=int)
 def jobs_logs(job_id):
-    from skypilot_tpu.jobs import core as jobs_core
-    click.echo(jobs_core.tail_logs(job_id), nl=False)
+    from skypilot_tpu.client import sdk
+    click.echo(sdk.jobs_logs(job_id), nl=False)
 
 
 @cli.group()
@@ -345,17 +352,17 @@ def serve():
 @click.option('--service-name', '-n', default=None)
 @click.option('--yes', '-y', is_flag=True, default=False)
 def serve_up(entrypoint, service_name, yes):
-    from skypilot_tpu.serve import core as serve_core
+    from skypilot_tpu.client import sdk
     t = task_lib.Task.from_yaml(entrypoint)
-    name = serve_core.up(t, service_name)
+    name = sdk.serve_up(t, service_name)
     click.echo(f'Service {name} is up.')
 
 
 @serve.command(name='status')
 @click.argument('service_names', nargs=-1)
 def serve_status(service_names):
-    from skypilot_tpu.serve import core as serve_core
-    for record in serve_core.status(list(service_names) or None):
+    from skypilot_tpu.client import sdk
+    for record in sdk.serve_status(list(service_names) or None):
         click.echo(json.dumps(record, default=str))
 
 
@@ -363,9 +370,9 @@ def serve_status(service_names):
 @click.argument('service_names', nargs=-1, required=True)
 @click.option('--yes', '-y', is_flag=True, default=False)
 def serve_down(service_names, yes):
-    from skypilot_tpu.serve import core as serve_core
+    from skypilot_tpu.client import sdk
     for name in service_names:
-        serve_core.down(name)
+        sdk.serve_down(name)
         click.echo(f'Service {name} torn down.')
 
 

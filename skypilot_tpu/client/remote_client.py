@@ -110,6 +110,37 @@ class RemoteClient:
     def cost_report(self):
         return self._call('cost_report', {})
 
+    # ---- managed jobs ----
+
+    def jobs_launch(self, task, name=None):
+        result = self._call('jobs.launch',
+                            {'task': task.to_yaml_config(), 'name': name})
+        return result['job_id']
+
+    def jobs_queue(self):
+        return self._call('jobs.queue', {})
+
+    def jobs_cancel(self, job_id):
+        return self._call('jobs.cancel', {'job_id': job_id})
+
+    def jobs_logs(self, job_id):
+        return self._call('jobs.logs', {'job_id': job_id})
+
+    # ---- serve ----
+
+    def serve_up(self, task, service_name=None):
+        result = self._call('serve.up',
+                            {'task': task.to_yaml_config(),
+                             'service_name': service_name})
+        return result['service_name']
+
+    def serve_status(self, service_names=None):
+        return self._call('serve.status',
+                          {'service_names': service_names})
+
+    def serve_down(self, service_name):
+        return self._call('serve.down', {'service_name': service_name})
+
 
 class _HandleProxy:
     """Client-side stand-in for a ClusterHandle (server keeps the real one)."""

@@ -128,3 +128,67 @@ def check(quiet: bool = False) -> Dict[str, Any]:
 
 def cost_report() -> List[Dict[str, Any]]:
     return _local_or_remote('cost_report')
+
+
+# ---- managed jobs ----------------------------------------------------------
+
+
+def jobs_launch(task: task_lib.Task, name: Optional[str] = None) -> int:
+    remote = _remote()
+    if remote is not None:
+        return remote.jobs_launch(task, name=name)
+    from skypilot_tpu.jobs import core as jobs_core
+    return jobs_core.launch(task, name=name)
+
+
+def jobs_queue() -> List[Dict[str, Any]]:
+    remote = _remote()
+    if remote is not None:
+        return remote.jobs_queue()
+    from skypilot_tpu.jobs import core as jobs_core
+    return jobs_core.queue()
+
+
+def jobs_cancel(job_id: int) -> None:
+    remote = _remote()
+    if remote is not None:
+        return remote.jobs_cancel(job_id)
+    from skypilot_tpu.jobs import core as jobs_core
+    return jobs_core.cancel(job_id)
+
+
+def jobs_logs(job_id: int) -> str:
+    remote = _remote()
+    if remote is not None:
+        return remote.jobs_logs(job_id)
+    from skypilot_tpu.jobs import core as jobs_core
+    return jobs_core.tail_logs(job_id)
+
+
+# ---- serve -----------------------------------------------------------------
+
+
+def serve_up(task: task_lib.Task,
+             service_name: Optional[str] = None) -> str:
+    remote = _remote()
+    if remote is not None:
+        return remote.serve_up(task, service_name=service_name)
+    from skypilot_tpu.serve import core as serve_core
+    return serve_core.up(task, service_name)
+
+
+def serve_status(service_names: Optional[List[str]] = None
+                 ) -> List[Dict[str, Any]]:
+    remote = _remote()
+    if remote is not None:
+        return remote.serve_status(service_names)
+    from skypilot_tpu.serve import core as serve_core
+    return serve_core.status(service_names)
+
+
+def serve_down(service_name: str) -> None:
+    remote = _remote()
+    if remote is not None:
+        return remote.serve_down(service_name)
+    from skypilot_tpu.serve import core as serve_core
+    return serve_core.down(service_name)

@@ -60,6 +60,25 @@ def refresh_cluster_status(cluster_name: str) -> Optional[Dict[str, Any]]:
                                     state.ClusterStatus.STOPPED)
     elif any(s != 'RUNNING' for s in statuses.values()):
         state.update_cluster_status(cluster_name, state.ClusterStatus.INIT)
+    # Enforce agent-triggered autostop (pull model; see
+    # TpuGangBackend.check_autostop_trigger).
+    if record['status'] == state.ClusterStatus.UP:
+        backend = _backend()
+        try:
+            trigger = backend.check_autostop_trigger(handle)
+        except Exception:  # pylint: disable=broad-except
+            trigger = None
+        if trigger is not None:
+            logger.info(f'Cluster {cluster_name}: autostop triggered '
+                        f'(down={trigger.get("down", False)}).')
+            try:
+                backend.teardown(handle,
+                                 terminate=bool(trigger.get('down')))
+            except exceptions.NotSupportedError:
+                # Stop unsupported (TPU pod): fall back to teardown,
+                # matching the documented autostop semantics for pods.
+                backend.teardown(handle, terminate=True)
+            return state.get_cluster_from_name(cluster_name)
     return state.get_cluster_from_name(cluster_name)
 
 

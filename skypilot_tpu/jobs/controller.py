@@ -1,0 +1,176 @@
+"""Managed-job controller (twin of sky/jobs/controller.py:53).
+
+One controller process per managed job: launches the task cluster,
+watches the job, detects cluster loss (spot preemption / failure) via
+status probes against cloud truth, triggers the recovery strategy, and
+cleans up on terminal states.
+
+Run as ``python -m skypilot_tpu.jobs.controller <job_id>``.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Any, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.agent import job_lib as cluster_job_lib
+from skypilot_tpu.jobs import recovery as recovery_lib
+from skypilot_tpu.jobs import state as jobs_state
+
+logger = sky_logging.init_logger(__name__)
+
+POLL_INTERVAL_S = float(os.environ.get('XSKY_JOBS_POLL_INTERVAL', '2.0'))
+# Consecutive failed probes (with the cloud still reporting the cluster
+# alive) tolerated before declaring the cluster lost.
+_MAX_PROBE_FAILURES = 3
+
+
+class JobsController:
+
+    def __init__(self, job_id: int) -> None:
+        self.job_id = job_id
+        record = jobs_state.get_job(job_id)
+        assert record is not None, job_id
+        self.task = task_lib.Task.from_yaml_config(record['task_config'])
+        self.cluster_name = f'xsky-jobs-{job_id}'
+        self.strategy = recovery_lib.StrategyExecutor.make(
+            self.task, self.cluster_name)
+
+    # ---- helpers ----
+
+    def _cluster_alive(self) -> bool:
+        """Probe cloud truth for the task cluster (preemption detector)."""
+        from skypilot_tpu import core
+        record = core.refresh_cluster_status(self.cluster_name)
+        return record is not None and \
+            record['status'].value == 'UP'
+
+    def _job_status(self, handle: Any,
+                    job_id: int) -> Optional[cluster_job_lib.JobStatus]:
+        try:
+            return self.strategy.backend.get_job_status(handle, job_id)
+        except Exception:  # pylint: disable=broad-except
+            return None
+
+    # ---- main loop ----
+
+    def run(self) -> None:
+        jobs_state.set_status(self.job_id,
+                              jobs_state.ManagedJobStatus.STARTING)
+        jobs_state.set_cluster_name(self.job_id, self.cluster_name)
+        try:
+            handle, cluster_job_id = self.strategy.launch()
+        except exceptions.ResourcesUnavailableError as e:
+            jobs_state.set_status(
+                self.job_id, jobs_state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                failure_reason=str(e))
+            return
+        jobs_state.set_status(self.job_id,
+                              jobs_state.ManagedJobStatus.RUNNING)
+
+        probe_failures = 0
+        while True:
+            time.sleep(POLL_INTERVAL_S)
+            status = self._job_status(handle, cluster_job_id)
+
+            if status is not None and status.is_terminal():
+                if status == cluster_job_lib.JobStatus.SUCCEEDED:
+                    jobs_state.set_status(
+                        self.job_id, jobs_state.ManagedJobStatus.SUCCEEDED)
+                    break
+                if status == cluster_job_lib.JobStatus.CANCELLED:
+                    jobs_state.set_status(
+                        self.job_id, jobs_state.ManagedJobStatus.CANCELLED)
+                    break
+                # User-code failure (not preemption): restart budget.
+                if self.strategy.should_restart_on_failure():
+                    logger.info(f'Job failed ({status}); restarting '
+                                f'({self.strategy.restart_count_on_errors}'
+                                f'/{self.strategy.max_restarts_on_errors})')
+                    handle, cluster_job_id = self._recover()
+                    if handle is None:
+                        return
+                    continue
+                jobs_state.set_status(
+                    self.job_id, jobs_state.ManagedJobStatus.FAILED,
+                    failure_reason=f'cluster job status {status.value}')
+                break
+
+            if status is not None:
+                probe_failures = 0
+                continue
+
+            # Status probe failed: could be transient (SSH hiccup, busy
+            # sqlite). Tolerate a few consecutive failures while the
+            # cloud still reports the cluster alive (twin of the
+            # reference's retry loop, recovery_strategy.py:174).
+            probe_failures += 1
+            if probe_failures < _MAX_PROBE_FAILURES and \
+                    self._cluster_alive():
+                continue
+
+            # Cluster unreachable or gone from cloud: preemption.
+            logger.info(f'Cluster {self.cluster_name} lost; '
+                        'recovering...')
+            probe_failures = 0
+            jobs_state.set_status(
+                self.job_id, jobs_state.ManagedJobStatus.RECOVERING)
+            jobs_state.bump_recovery_count(self.job_id)
+            handle, cluster_job_id = self._recover()
+            if handle is None:
+                return
+            jobs_state.set_status(
+                self.job_id, jobs_state.ManagedJobStatus.RUNNING)
+
+        self._cleanup()
+
+    def _recover(self):
+        try:
+            handle, cluster_job_id = self.strategy.recover(
+                self._current_handle())
+            return handle, cluster_job_id
+        except exceptions.ResourcesUnavailableError as e:
+            jobs_state.set_status(
+                self.job_id,
+                jobs_state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                failure_reason=str(e))
+            return None, None
+
+    def _current_handle(self):
+        from skypilot_tpu import state as state_lib
+        record = state_lib.get_cluster_from_name(self.cluster_name)
+        return record['handle'] if record else None
+
+    def _cleanup(self) -> None:
+        """Tear down the task cluster after terminal states
+        (twin of controller.py:573)."""
+        from skypilot_tpu import state as state_lib
+        record = state_lib.get_cluster_from_name(self.cluster_name)
+        if record is not None and record['handle'] is not None:
+            try:
+                self.strategy.backend.teardown(record['handle'],
+                                               terminate=True, purge=True)
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(f'Cleanup teardown failed: {e}')
+
+
+def main() -> int:
+    job_id = int(sys.argv[1])
+    jobs_state.set_controller_pid(job_id, os.getpid())
+    try:
+        JobsController(job_id).run()
+        return 0
+    except Exception as e:  # pylint: disable=broad-except
+        logger.error(f'Controller for job {job_id} crashed: {e}')
+        jobs_state.set_status(
+            job_id, jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
+            failure_reason=str(e))
+        return 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

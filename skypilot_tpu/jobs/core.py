@@ -1,0 +1,106 @@
+"""Managed-jobs API (twin of sky/jobs/server/core.py + scheduler).
+
+Controller placement: the reference launches a dedicated jobs-controller
+*cluster* and runs one controller process per job on it
+(sky/templates/jobs-controller.yaml.j2, sky/jobs/scheduler.py). Here the
+controller processes run on the API-server host directly — the same
+process model (one detached controller per job, sqlite state), minus the
+extra controller-cluster hop. A controller cluster can be layered on by
+pointing XSKY_JOBS_CONTROLLER_REMOTE at a cluster name; parity note for
+SURVEY §2.6.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.jobs import state as jobs_state
+
+logger = sky_logging.init_logger(__name__)
+
+
+def launch(task: task_lib.Task, name: Optional[str] = None,
+           wait: bool = False, timeout_s: float = 600.0) -> int:
+    """Submit a managed job; returns the managed job id."""
+    job_id = jobs_state.add_job(name or task.name, task.to_yaml_config())
+    jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.SUBMITTED)
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+         str(job_id)],
+        env=dict(os.environ),
+        start_new_session=True,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    jobs_state.set_controller_pid(job_id, proc.pid)
+    if wait:
+        wait_for_terminal(job_id, timeout_s)
+    return job_id
+
+
+def wait_for_terminal(job_id: int, timeout_s: float = 600.0
+                      ) -> jobs_state.ManagedJobStatus:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        record = jobs_state.get_job(job_id)
+        if record and record['status'].is_terminal():
+            return record['status']
+        time.sleep(0.3)
+    raise TimeoutError(f'Managed job {job_id} not terminal '
+                       f'after {timeout_s}s')
+
+
+def queue() -> List[Dict[str, Any]]:
+    rows = jobs_state.get_jobs()
+    return [{
+        'job_id': r['job_id'],
+        'name': r['name'],
+        'status': r['status'].value,
+        'cluster_name': r['cluster_name'],
+        'recovery_count': r['recovery_count'],
+        'failure_reason': r['failure_reason'],
+        'submitted_at': r['submitted_at'],
+        'ended_at': r['ended_at'],
+    } for r in rows]
+
+
+def cancel(job_id: int) -> None:
+    record = jobs_state.get_job(job_id)
+    if record is None or record['status'].is_terminal():
+        return
+    pid = record['controller_pid']
+    if pid:
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+    jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.CANCELLED)
+    # Reap the task cluster if it exists.
+    cluster_name = record['cluster_name']
+    if cluster_name:
+        from skypilot_tpu import core as core_lib
+        from skypilot_tpu import exceptions
+        try:
+            core_lib.down(cluster_name, purge=True)
+        except exceptions.ClusterDoesNotExist:
+            pass
+
+
+def tail_logs(job_id: int) -> str:
+    record = jobs_state.get_job(job_id)
+    if record is None:
+        return ''
+    cluster_name = record['cluster_name']
+    if not cluster_name:
+        return ''
+    from skypilot_tpu import core as core_lib
+    from skypilot_tpu import exceptions
+    try:
+        return core_lib.tail_logs(cluster_name)
+    except (exceptions.ClusterDoesNotExist, exceptions.ClusterNotUpError):
+        return f'(cluster {cluster_name} is gone; job status: ' \
+               f'{record["status"].value})'

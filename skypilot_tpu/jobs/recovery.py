@@ -1,0 +1,136 @@
+"""Recovery strategies for managed jobs.
+
+Twin of sky/jobs/recovery_strategy.py (StrategyExecutor:46,
+FailoverStrategyExecutor:425, EagerFailoverStrategyExecutor:513),
+registered in JOBS_RECOVERY_STRATEGY_REGISTRY (sky/utils/registry.py).
+
+  * ``failover`` (default): relaunch in the same region first (capacity
+    often returns where the preemption happened), then fail over.
+  * ``eager_next_region``: immediately blocklist the preempted region and
+    go elsewhere — preempted zones tend to preempt again soon.
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backends import failover as failover_lib
+from skypilot_tpu.backends import tpu_gang_backend
+from skypilot_tpu.utils import registry
+
+logger = sky_logging.init_logger(__name__)
+
+RECOVERY_REGISTRY = registry.JOBS_RECOVERY_STRATEGY_REGISTRY
+DEFAULT_RECOVERY_STRATEGY = 'failover'
+MAX_JOB_CHECKING_RETRY = 10
+
+
+class StrategyExecutor:
+    """Launch + recover one managed job's task cluster."""
+
+    def __init__(self, task: task_lib.Task, cluster_name: str,
+                 max_restarts_on_errors: int = 0) -> None:
+        self.task = task
+        self.cluster_name = cluster_name
+        self.max_restarts_on_errors = max_restarts_on_errors
+        self.backend = tpu_gang_backend.TpuGangBackend()
+        self.restart_count_on_errors = 0
+
+    @classmethod
+    def make(cls, task: task_lib.Task,
+             cluster_name: str) -> 'StrategyExecutor':
+        recovery = task.resources[0].job_recovery or {}
+        name = recovery.get('strategy') or DEFAULT_RECOVERY_STRATEGY
+        strategy_cls = RECOVERY_REGISTRY.from_str(name)
+        return strategy_cls(
+            task, cluster_name,
+            max_restarts_on_errors=int(
+                recovery.get('max_restarts_on_errors', 0)))
+
+    # ---- launch ----
+
+    def launch(self, retry_until_up: bool = True) -> Any:
+        """Provision the task cluster + submit the job. Returns handle."""
+        from skypilot_tpu import execution
+        job_id, handle = execution.launch(
+            self.task, cluster_name=self.cluster_name,
+            retry_until_up=retry_until_up, detach_run=True)
+        return handle, job_id
+
+    # ---- recovery ----
+
+    def recover(self, handle: Any) -> Any:
+        """Cluster died (preempted/failed): bring the job back up."""
+        raise NotImplementedError
+
+    def _relaunch(self,
+                  blocked: Optional[List[resources_lib.Resources]] = None
+                  ) -> Any:
+        """Teardown leftovers + relaunch, optionally avoiding regions."""
+        from skypilot_tpu import execution
+        from skypilot_tpu import state as state_lib
+        # Clean any half-dead cluster record.
+        record = state_lib.get_cluster_from_name(self.cluster_name)
+        if record is not None and record['handle'] is not None:
+            try:
+                self.backend.teardown(record['handle'], terminate=True,
+                                      purge=True)
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(f'Teardown before recovery failed: {e}')
+                state_lib.remove_cluster(self.cluster_name, terminate=True)
+        task = self.task
+        if blocked:
+            # Pin candidates away from blocked regions by wrapping the
+            # provisioner blocklist through a one-off launch.
+            provisioner = failover_lib.RetryingProvisioner(
+                task, self.cluster_name, task.num_nodes)
+            provisioner.blocked.extend(blocked)
+            result = failover_lib.provision_with_retry_until_up(
+                provisioner, retry_until_up=True, retry_interval_s=1.0)
+            handle = tpu_gang_backend.ClusterHandle(
+                self.cluster_name, result.resources, result.num_nodes,
+                result.cluster_info)
+            state_lib.add_or_update_cluster(self.cluster_name, handle,
+                                            ready=False)
+            self.backend._setup_runtime(handle)  # pylint: disable=protected-access
+            state_lib.add_or_update_cluster(self.cluster_name, handle,
+                                            ready=True, is_launch=False)
+            if task.workdir:
+                self.backend.sync_workdir(handle, task.workdir)
+            self.backend.setup(handle, task)
+            job_id = self.backend.execute(handle, task, detach_run=True)
+            return handle, job_id
+        return self.launch(retry_until_up=True)
+
+    def should_restart_on_failure(self) -> bool:
+        """User-code failure budget (max_restarts_on_errors, reference
+        recovery_strategy.py:411)."""
+        self.restart_count_on_errors += 1
+        return self.restart_count_on_errors <= self.max_restarts_on_errors
+
+
+@RECOVERY_REGISTRY.register(name='failover', default=True)
+class FailoverStrategyExecutor(StrategyExecutor):
+    """Retry the same region first, then let failover walk elsewhere."""
+
+    def recover(self, handle: Any) -> Any:
+        return self._relaunch(blocked=None)
+
+
+@RECOVERY_REGISTRY.register(name='eager_next_region')
+class EagerFailoverStrategyExecutor(StrategyExecutor):
+    """Skip the preempted region immediately."""
+
+    def recover(self, handle: Any) -> Any:
+        blocked = []
+        if handle is not None:
+            launched = handle.launched_resources
+            if launched.region is not None:
+                blocked.append(
+                    resources_lib.Resources(cloud=launched.cloud_name,
+                                            region=launched.region))
+        return self._relaunch(blocked=blocked)

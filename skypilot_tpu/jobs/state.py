@@ -1,0 +1,175 @@
+"""Managed-job state (twin of sky/jobs/state.py: ManagedJobStatus:243).
+
+DB: ``~/.xsky/managed_jobs.db`` (XSKY_JOBS_DB overrides for tests). Lives
+on the jobs-controller host (here: the API-server/CLI host — see
+jobs/core.py for the controller placement note).
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_lock = threading.RLock()
+
+
+class ManagedJobStatus(enum.Enum):
+    PENDING = 'PENDING'
+    SUBMITTED = 'SUBMITTED'
+    STARTING = 'STARTING'
+    RUNNING = 'RUNNING'
+    RECOVERING = 'RECOVERING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_NO_RESOURCE = 'FAILED_NO_RESOURCE'
+    FAILED_CONTROLLER = 'FAILED_CONTROLLER'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (ManagedJobStatus.SUCCEEDED,
+                        ManagedJobStatus.FAILED,
+                        ManagedJobStatus.FAILED_SETUP,
+                        ManagedJobStatus.FAILED_NO_RESOURCE,
+                        ManagedJobStatus.FAILED_CONTROLLER,
+                        ManagedJobStatus.CANCELLED)
+
+
+def _db_path() -> str:
+    return os.path.expanduser(
+        os.environ.get('XSKY_JOBS_DB', '~/.xsky/managed_jobs.db'))
+
+
+def _db() -> sqlite3.Connection:
+    path = _db_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    conn = sqlite3.connect(path, timeout=30, check_same_thread=False)
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS managed_jobs (
+            job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            name TEXT,
+            task_config TEXT,
+            status TEXT,
+            cluster_name TEXT,
+            recovery_count INTEGER DEFAULT 0,
+            failure_reason TEXT,
+            controller_pid INTEGER,
+            submitted_at REAL,
+            started_at REAL,
+            ended_at REAL
+        )""")
+    conn.commit()
+    return conn
+
+
+def add_job(name: Optional[str], task_config: Dict[str, Any]) -> int:
+    with _lock:
+        conn = _db()
+        cur = conn.execute(
+            'INSERT INTO managed_jobs (name, task_config, status, '
+            'submitted_at) VALUES (?, ?, ?, ?)',
+            (name, json.dumps(task_config),
+             ManagedJobStatus.PENDING.value, time.time()))
+        conn.commit()
+        job_id = cur.lastrowid
+        conn.close()
+        return job_id
+
+
+def set_status(job_id: int, status: ManagedJobStatus,
+               failure_reason: Optional[str] = None) -> None:
+    with _lock:
+        conn = _db()
+        if status == ManagedJobStatus.RUNNING:
+            conn.execute(
+                'UPDATE managed_jobs SET status=?, started_at='
+                'COALESCE(started_at, ?) WHERE job_id=?',
+                (status.value, time.time(), job_id))
+        elif status.is_terminal():
+            conn.execute(
+                'UPDATE managed_jobs SET status=?, ended_at=?, '
+                'failure_reason=COALESCE(?, failure_reason) '
+                'WHERE job_id=?',
+                (status.value, time.time(), failure_reason, job_id))
+        else:
+            conn.execute('UPDATE managed_jobs SET status=? WHERE job_id=?',
+                         (status.value, job_id))
+        conn.commit()
+        conn.close()
+
+
+def set_cluster_name(job_id: int, cluster_name: str) -> None:
+    with _lock:
+        conn = _db()
+        conn.execute(
+            'UPDATE managed_jobs SET cluster_name=? WHERE job_id=?',
+            (cluster_name, job_id))
+        conn.commit()
+        conn.close()
+
+
+def set_controller_pid(job_id: int, pid: int) -> None:
+    with _lock:
+        conn = _db()
+        conn.execute(
+            'UPDATE managed_jobs SET controller_pid=? WHERE job_id=?',
+            (pid, job_id))
+        conn.commit()
+        conn.close()
+
+
+def bump_recovery_count(job_id: int) -> int:
+    with _lock:
+        conn = _db()
+        conn.execute(
+            'UPDATE managed_jobs SET recovery_count=recovery_count+1 '
+            'WHERE job_id=?', (job_id,))
+        conn.commit()
+        count = conn.execute(
+            'SELECT recovery_count FROM managed_jobs WHERE job_id=?',
+            (job_id,)).fetchone()[0]
+        conn.close()
+        return count
+
+
+def get_job(job_id: int) -> Optional[Dict[str, Any]]:
+    with _lock:
+        conn = _db()
+        row = conn.execute(
+            'SELECT * FROM managed_jobs WHERE job_id=?',
+            (job_id,)).fetchone()
+        conn.close()
+    return _to_dict(row) if row else None
+
+
+def get_jobs() -> List[Dict[str, Any]]:
+    with _lock:
+        conn = _db()
+        rows = conn.execute(
+            'SELECT * FROM managed_jobs ORDER BY job_id DESC').fetchall()
+        conn.close()
+    return [_to_dict(r) for r in rows]
+
+
+def _to_dict(row) -> Dict[str, Any]:
+    (job_id, name, task_config, status, cluster_name, recovery_count,
+     failure_reason, controller_pid, submitted_at, started_at,
+     ended_at) = row
+    return {
+        'job_id': job_id,
+        'name': name,
+        'task_config': json.loads(task_config or '{}'),
+        'status': ManagedJobStatus(status),
+        'cluster_name': cluster_name,
+        'recovery_count': recovery_count,
+        'failure_reason': failure_reason,
+        'controller_pid': controller_pid,
+        'submitted_at': submitted_at,
+        'started_at': started_at,
+        'ended_at': ended_at,
+    }

@@ -1,0 +1,98 @@
+"""Autoscalers (twin of sky/serve/autoscalers.py: Autoscaler:116,
+RequestRateAutoscaler:441, hysteresis :357)."""
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import List, Optional
+
+from skypilot_tpu.serve import service_spec as spec_lib
+
+
+@dataclasses.dataclass
+class AutoscalerDecision:
+    target_num_replicas: int
+
+
+class Autoscaler:
+
+    def __init__(self, spec: spec_lib.SkyServiceSpec) -> None:
+        self.spec = spec
+        self.target_num_replicas = spec.min_replicas
+
+    def collect_request_information(self, num_requests: int,
+                                    window_seconds: float) -> None:
+        pass
+
+    def evaluate(self, num_ready_replicas: int) -> AutoscalerDecision:
+        return AutoscalerDecision(self.spec.min_replicas)
+
+
+class FixedReplicaAutoscaler(Autoscaler):
+    """No autoscaling: hold min_replicas."""
+
+
+class RequestRateAutoscaler(Autoscaler):
+    """QPS-based scaling with upscale/downscale hysteresis delays.
+
+    Target count = ceil(qps / target_qps_per_replica), clamped to
+    [min, max]. A scale decision only takes effect after the respective
+    delay has continuously elapsed — preventing flapping (twin of the
+    reference's upscale/downscale counters).
+    """
+
+    QPS_WINDOW_SECONDS = 60.0
+
+    def __init__(self, spec: spec_lib.SkyServiceSpec) -> None:
+        super().__init__(spec)
+        self._request_timestamps: List[float] = []
+        self._upscale_since: Optional[float] = None
+        self._downscale_since: Optional[float] = None
+
+    def collect_request_information(self, num_requests: int,
+                                    window_seconds: float = 0.0) -> None:
+        now = time.time()
+        self._request_timestamps.extend([now] * num_requests)
+        cutoff = now - self.QPS_WINDOW_SECONDS
+        self._request_timestamps = [
+            t for t in self._request_timestamps if t >= cutoff
+        ]
+
+    def current_qps(self) -> float:
+        self.collect_request_information(0)
+        return len(self._request_timestamps) / self.QPS_WINDOW_SECONDS
+
+    def evaluate(self, num_ready_replicas: int) -> AutoscalerDecision:
+        spec = self.spec
+        qps = self.current_qps()
+        desired = math.ceil(qps / spec.target_qps_per_replica) \
+            if spec.target_qps_per_replica else spec.min_replicas
+        desired = max(spec.min_replicas,
+                      min(desired, spec.max_replicas or desired))
+        now = time.time()
+
+        if desired > self.target_num_replicas:
+            self._downscale_since = None
+            if self._upscale_since is None:
+                self._upscale_since = now
+            if now - self._upscale_since >= spec.upscale_delay_seconds:
+                self.target_num_replicas = desired
+                self._upscale_since = None
+        elif desired < self.target_num_replicas:
+            self._upscale_since = None
+            if self._downscale_since is None:
+                self._downscale_since = now
+            if now - self._downscale_since >= spec.downscale_delay_seconds:
+                self.target_num_replicas = desired
+                self._downscale_since = None
+        else:
+            self._upscale_since = None
+            self._downscale_since = None
+        return AutoscalerDecision(self.target_num_replicas)
+
+
+def make_autoscaler(spec: spec_lib.SkyServiceSpec) -> Autoscaler:
+    if spec.autoscaling_enabled:
+        return RequestRateAutoscaler(spec)
+    return FixedReplicaAutoscaler(spec)

@@ -1,0 +1,109 @@
+"""Serve controller process: autoscaler loop + LB in one process.
+
+Twin of sky/serve/service.py:155 (_start forks controller + LB) and
+sky/serve/controller.py:36 (autoscaler loop :65). Run as
+``python -m skypilot_tpu.serve.controller <service_name>``.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import autoscalers as autoscalers_lib
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import replica_managers
+from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.serve import state as serve_state
+
+logger = sky_logging.init_logger(__name__)
+
+CONTROLLER_INTERVAL_S = float(
+    os.environ.get('XSKY_SERVE_INTERVAL', '2.0'))
+
+
+class SkyServeController:
+
+    def __init__(self, service_name: str) -> None:
+        record = serve_state.get_service(service_name)
+        assert record is not None, service_name
+        self.service_name = service_name
+        task_config = record['task_config']
+        self.spec = spec_lib.SkyServiceSpec.from_yaml_config(
+            task_config.get('service', {}))
+        self.replica_manager = replica_managers.ReplicaManager(
+            service_name, task_config, self.spec)
+        self.autoscaler = autoscalers_lib.make_autoscaler(self.spec)
+        self.load_balancer = lb_lib.SkyServeLoadBalancer(
+            on_request=lambda: self.autoscaler
+            .collect_request_information(1, 0.0))
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        lb_port = serve_state.get_service(self.service_name)['lb_port']
+        actual_port = self.load_balancer.run_in_thread(port=lb_port)
+        logger.info(f'Service {self.service_name}: LB on :{actual_port}')
+        serve_state.set_service_status(
+            self.service_name, serve_state.ServiceStatus.REPLICA_INIT)
+        self.replica_manager.scale_to(self.spec.min_replicas)
+
+        while not self._stop.is_set():
+            try:
+                self._tick()
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(f'controller tick failed: {e}')
+            self._stop.wait(CONTROLLER_INTERVAL_S)
+
+    def _tick(self) -> None:
+        manager = self.replica_manager
+        ready = manager.probe_all()
+        if ready == 0 and \
+                manager.launch_failures >= manager.max_launch_failures:
+            # Launch budget exhausted with nothing serving: the service
+            # is broken (infeasible resources / bad run cmd). Stop
+            # burning launches.
+            logger.warning(
+                f'Service {self.service_name}: '
+                f'{manager.launch_failures} consecutive replica launch '
+                'failures; marking FAILED.')
+            serve_state.set_service_status(
+                self.service_name, serve_state.ServiceStatus.FAILED)
+            self._stop.set()
+            return
+        manager.recover_preempted()
+        decision = self.autoscaler.evaluate(ready)
+        manager.scale_to(decision.target_num_replicas)
+        self.load_balancer.set_ready_replicas(manager.ready_endpoints())
+        if ready > 0:
+            serve_state.set_service_status(
+                self.service_name, serve_state.ServiceStatus.READY)
+        else:
+            current = serve_state.get_service(self.service_name)
+            if current and current['status'] == \
+                    serve_state.ServiceStatus.READY:
+                serve_state.set_service_status(
+                    self.service_name,
+                    serve_state.ServiceStatus.NO_REPLICA)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.load_balancer.shutdown()
+
+
+def main() -> int:
+    service_name = sys.argv[1]
+    serve_state.set_service_controller_pid(service_name, os.getpid())
+    controller = SkyServeController(service_name)
+    try:
+        controller.run()
+        return 0
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        controller.stop()
+
+
+if __name__ == '__main__':
+    sys.exit(main())

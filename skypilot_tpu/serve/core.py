@@ -1,0 +1,95 @@
+"""Serve API: up / status / down (twin of sky/serve/server/core.py).
+
+Controller placement note: as with managed jobs (jobs/core.py), the
+controller+LB process runs on the API-server host; replicas are ordinary
+clusters launched through the engine.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import state as serve_state
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def up(task: task_lib.Task, service_name: Optional[str] = None,
+       wait_ready: bool = True, timeout_s: float = 120.0) -> str:
+    if task.service is None:
+        raise ValueError("Task has no 'service:' section.")
+    name = service_name or task.name or 'service'
+    if serve_state.get_service(name) is not None:
+        raise ValueError(f'Service {name!r} already exists.')
+    lb_port = _free_port()
+    serve_state.add_service(name, task.to_yaml_config(), lb_port)
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.serve.controller', name],
+        env=dict(os.environ), start_new_session=True,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    serve_state.set_service_controller_pid(name, proc.pid)
+    if wait_ready:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            record = serve_state.get_service(name)
+            if record['status'] == serve_state.ServiceStatus.READY:
+                return name
+            if record['status'] == serve_state.ServiceStatus.FAILED:
+                raise exceptions.SkyTpuError(f'Service {name} failed.')
+            time.sleep(0.3)
+        raise TimeoutError(f'Service {name} not ready in {timeout_s}s')
+    return name
+
+
+def status(service_names: Optional[List[str]] = None
+           ) -> List[Dict[str, Any]]:
+    records = serve_state.get_services()
+    if service_names:
+        records = [r for r in records if r['name'] in service_names]
+    out = []
+    for r in records:
+        replicas = serve_state.get_replicas(r['name'])
+        out.append({
+            'name': r['name'],
+            'status': r['status'].value,
+            'endpoint': f"127.0.0.1:{r['lb_port']}",
+            'replicas': [{
+                'replica_id': rep['replica_id'],
+                'status': rep['status'].value,
+                'endpoint': rep['endpoint'],
+            } for rep in replicas],
+        })
+    return out
+
+
+def down(service_name: str) -> None:
+    record = serve_state.get_service(service_name)
+    if record is None:
+        raise ValueError(f'Service {service_name!r} not found.')
+    serve_state.set_service_status(service_name,
+                                   serve_state.ServiceStatus.SHUTTING_DOWN)
+    pid = record['controller_pid']
+    if pid:
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+    # Reap replica clusters.
+    from skypilot_tpu import core as core_lib
+    for rep in serve_state.get_replicas(service_name):
+        try:
+            core_lib.down(rep['cluster_name'], purge=True)
+        except exceptions.ClusterDoesNotExist:
+            pass
+    serve_state.remove_service(service_name)

@@ -1,0 +1,115 @@
+"""Load balancer: HTTP proxy → ready replicas (twin of
+sky/serve/load_balancer.py:23), stdlib-only like the API server.
+
+Counts requests for the autoscaler (shared via a callback), retries the
+next replica on connection failure.
+"""
+from __future__ import annotations
+
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, List, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import load_balancing_policies as lb_policies
+
+logger = sky_logging.init_logger(__name__)
+
+_HOP_HEADERS = {'connection', 'keep-alive', 'transfer-encoding',
+                'upgrade', 'proxy-authenticate', 'te', 'trailers',
+                'host', 'content-length'}
+
+
+class SkyServeLoadBalancer:
+
+    def __init__(self, policy: Optional[
+            lb_policies.LoadBalancingPolicy] = None,
+            on_request: Optional[Callable[[], None]] = None) -> None:
+        self.policy = policy or lb_policies.RoundRobinPolicy()
+        self.on_request = on_request or (lambda: None)
+        self._server: Optional[ThreadingHTTPServer] = None
+
+    def set_ready_replicas(self, endpoints: List[str]) -> None:
+        self.policy.set_ready_replicas(endpoints)
+
+    def _proxy(self, method: str, path: str, body: bytes,
+               headers) -> Tuple[int, bytes, List[Tuple[str, str]]]:
+        self.on_request()
+        tried = 0
+        max_tries = 3
+        while tried < max_tries:
+            tried += 1
+            replica = self.policy.select_replica()
+            if replica is None:
+                return 503, b'{"error": "no ready replicas"}', []
+            url = f'http://{replica}{path}'
+            req = urllib.request.Request(url, data=body or None,
+                                         method=method)
+            for k, v in headers.items():
+                if k.lower() not in _HOP_HEADERS:
+                    req.add_header(k, v)
+            try:
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    out_headers = [
+                        (k, v) for k, v in resp.headers.items()
+                        if k.lower() not in _HOP_HEADERS
+                    ]
+                    data = resp.read()
+                    self.policy.request_done(replica)
+                    return resp.status, data, out_headers
+            except urllib.error.HTTPError as e:
+                self.policy.request_done(replica)
+                return e.code, e.read(), []
+            except (urllib.error.URLError, OSError, TimeoutError):
+                self.policy.request_done(replica)
+                continue  # replica unreachable: try another
+        return 502, b'{"error": "all replicas unreachable"}', []
+
+    def make_server(self, host: str = '0.0.0.0',
+                    port: int = 0) -> ThreadingHTTPServer:
+        lb = self
+
+        class _Handler(BaseHTTPRequestHandler):
+
+            def log_message(self, *args):
+                pass
+
+            def _handle(self, method: str):
+                length = int(self.headers.get('Content-Length') or 0)
+                body = self.rfile.read(length) if length else b''
+                status, data, out_headers = lb._proxy(
+                    method, self.path, body, self.headers)
+                self.send_response(status)
+                for k, v in out_headers:
+                    self.send_header(k, v)
+                self.send_header('Content-Length', str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802
+                self._handle('GET')
+
+            def do_POST(self):  # noqa: N802
+                self._handle('POST')
+
+            def do_PUT(self):  # noqa: N802
+                self._handle('PUT')
+
+            def do_DELETE(self):  # noqa: N802
+                self._handle('DELETE')
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        return self._server
+
+    def run_in_thread(self, host: str = '127.0.0.1',
+                      port: int = 0) -> int:
+        server = self.make_server(host, port)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        return server.server_address[1]
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()

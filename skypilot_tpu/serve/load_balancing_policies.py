@@ -1,0 +1,78 @@
+"""LB policies (twin of sky/serve/load_balancing_policies.py)."""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, List, Optional
+
+
+class LoadBalancingPolicy:
+
+    def set_ready_replicas(self, replicas: List[str]) -> None:
+        raise NotImplementedError
+
+    def select_replica(self) -> Optional[str]:
+        raise NotImplementedError
+
+    def request_done(self, replica: str) -> None:
+        pass
+
+
+class RoundRobinPolicy(LoadBalancingPolicy):
+
+    def __init__(self) -> None:
+        self._replicas: List[str] = []
+        self._index = 0
+        self._lock = threading.Lock()
+
+    def set_ready_replicas(self, replicas: List[str]) -> None:
+        with self._lock:
+            if replicas != self._replicas:
+                self._replicas = list(replicas)
+                self._index = 0
+
+    def select_replica(self) -> Optional[str]:
+        with self._lock:
+            if not self._replicas:
+                return None
+            replica = self._replicas[self._index % len(self._replicas)]
+            self._index += 1
+            return replica
+
+
+class LeastLoadPolicy(LoadBalancingPolicy):
+    """Pick the replica with fewest in-flight requests."""
+
+    def __init__(self) -> None:
+        self._replicas: List[str] = []
+        self._load: Dict[str, int] = collections.defaultdict(int)
+        self._lock = threading.Lock()
+
+    def set_ready_replicas(self, replicas: List[str]) -> None:
+        with self._lock:
+            self._replicas = list(replicas)
+            for gone in set(self._load) - set(replicas):
+                del self._load[gone]
+
+    def select_replica(self) -> Optional[str]:
+        with self._lock:
+            if not self._replicas:
+                return None
+            replica = min(self._replicas, key=lambda r: self._load[r])
+            self._load[replica] += 1
+            return replica
+
+    def request_done(self, replica: str) -> None:
+        with self._lock:
+            if self._load.get(replica, 0) > 0:
+                self._load[replica] -= 1
+
+
+POLICIES = {
+    'round_robin': RoundRobinPolicy,
+    'least_load': LeastLoadPolicy,
+}
+
+
+def make_policy(name: str = 'round_robin') -> LoadBalancingPolicy:
+    return POLICIES[name]()

@@ -1,0 +1,209 @@
+"""Replica manager (twin of sky/serve/replica_managers.py:60,388).
+
+Launches/terminates replica clusters through the ordinary launch stack
+(recursive execution.launch, like the reference), probes readiness over
+HTTP, and detects preempted replicas via cloud-truth status refresh.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import socket
+import threading
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.serve import state as serve_state
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+class ReplicaManager:
+
+    def __init__(self, service_name: str, task_config: Dict[str, Any],
+                 spec: spec_lib.SkyServiceSpec) -> None:
+        self.service_name = service_name
+        self.task_config = dict(task_config)
+        self.task_config.pop('service', None)
+        self.spec = spec
+        self._next_replica_id = 1
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix=f'replica-{service_name}')
+        self._launching: Dict[int, concurrent.futures.Future] = {}
+        self._lock = threading.Lock()
+        # Consecutive launch failures (service declared FAILED past this).
+        self.launch_failures = 0
+        self.max_launch_failures = 3
+        # Spot zone tracking (twin of sky/serve/spot_placer.py:254):
+        # learns zones as replicas come up; preempted zones are avoided
+        # and trigger on-demand fallback when the spec allows.
+        from skypilot_tpu.serve import spot_placer as spot_placer_lib
+        self.spot_placer = spot_placer_lib.DynamicFallbackSpotPlacer([])
+        self._replica_zone: Dict[int, str] = {}
+
+    # ---- scaling ----
+
+    def replicas(self) -> List[Dict[str, Any]]:
+        return serve_state.get_replicas(self.service_name)
+
+    def active_count(self) -> int:
+        active = [
+            r for r in self.replicas()
+            if r['status'] not in (serve_state.ReplicaStatus.FAILED,
+                                   serve_state.ReplicaStatus.PREEMPTED,
+                                   serve_state.ReplicaStatus.SHUTTING_DOWN)
+        ]
+        return len(active)
+
+    def scale_to(self, target: int) -> None:
+        with self._lock:
+            current = self.active_count()
+            for _ in range(max(0, target - current)):
+                self._start_replica()
+            if current > target:
+                # Terminate youngest non-ready first, then youngest ready.
+                candidates = sorted(
+                    [r for r in self.replicas() if r['status'] not in
+                     (serve_state.ReplicaStatus.SHUTTING_DOWN,)],
+                    key=lambda r: (
+                        r['status'] == serve_state.ReplicaStatus.READY,
+                        -r['replica_id']))
+                for r in candidates[:current - target]:
+                    self.terminate_replica(r['replica_id'])
+
+    def _start_replica(self) -> int:
+        replica_id = self._next_replica_id
+        self._next_replica_id += 1
+        cluster_name = f'xsky-serve-{self.service_name}-{replica_id}'
+        serve_state.upsert_replica(self.service_name, replica_id,
+                                   cluster_name,
+                                   serve_state.ReplicaStatus.PROVISIONING)
+        future = self._pool.submit(self._launch_replica, replica_id,
+                                   cluster_name)
+        self._launching[replica_id] = future
+        return replica_id
+
+    def _launch_replica(self, replica_id: int, cluster_name: str) -> None:
+        try:
+            from skypilot_tpu import execution
+            task = task_lib.Task.from_yaml_config(self.task_config)
+            if (self.spec.use_ondemand_fallback and
+                    task.resources[0].use_spot and
+                    self.spot_placer.should_fallback_to_ondemand() and
+                    self.spot_placer.preemptive_zones):
+                logger.info(f'Replica {replica_id}: all spot zones '
+                            'preempted recently; falling back to '
+                            'on-demand.')
+                task.set_resources(
+                    [r.copy(use_spot=False) for r in task.resources])
+            port = self.spec.replica_port or _free_port()
+            # Local/fake replicas share one loopback: give each its own
+            # port via $PORT (real clouds use the spec port on the
+            # replica's IP, like GKE service port mapping).
+            task.update_envs({'PORT': str(port)})
+            _, handle = execution.launch(task, cluster_name=cluster_name,
+                                         detach_run=True)
+            local = handle.is_local_provider
+            host = '127.0.0.1' if local else handle.head_ip
+            zone = handle.launched_resources.zone
+            if zone:
+                self._replica_zone[replica_id] = zone
+                self.spot_placer.handle_active(zone)
+            self.launch_failures = 0
+            serve_state.upsert_replica(
+                self.service_name, replica_id, cluster_name,
+                serve_state.ReplicaStatus.STARTING,
+                endpoint=f'{host}:{port}')
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Replica {replica_id} launch failed: {e}')
+            self.launch_failures += 1
+            serve_state.upsert_replica(self.service_name, replica_id,
+                                       cluster_name,
+                                       serve_state.ReplicaStatus.FAILED)
+
+    def terminate_replica(self, replica_id: int) -> None:
+        record = next((r for r in self.replicas()
+                       if r['replica_id'] == replica_id), None)
+        if record is None:
+            return
+        serve_state.upsert_replica(self.service_name, replica_id,
+                                   record['cluster_name'],
+                                   serve_state.ReplicaStatus.SHUTTING_DOWN)
+        from skypilot_tpu import core as core_lib
+        try:
+            core_lib.down(record['cluster_name'], purge=True)
+        except exceptions.ClusterDoesNotExist:
+            pass
+        serve_state.remove_replica(self.service_name, replica_id)
+
+    def terminate_all(self) -> None:
+        for r in self.replicas():
+            self.terminate_replica(r['replica_id'])
+
+    # ---- probing ----
+
+    def probe_all(self) -> int:
+        """Probe readiness; mark preempted replicas; return ready count."""
+        ready = 0
+        for r in self.replicas():
+            status = r['status']
+            if status in (serve_state.ReplicaStatus.PROVISIONING,
+                          serve_state.ReplicaStatus.SHUTTING_DOWN,
+                          serve_state.ReplicaStatus.FAILED):
+                continue
+            if not self._cluster_alive(r['cluster_name']):
+                zone = self._replica_zone.get(r['replica_id'])
+                if zone:
+                    self.spot_placer.handle_preemption(zone)
+                serve_state.upsert_replica(
+                    self.service_name, r['replica_id'],
+                    r['cluster_name'],
+                    serve_state.ReplicaStatus.PREEMPTED)
+                continue
+            if r['endpoint'] and self._probe(r['endpoint']):
+                serve_state.upsert_replica(self.service_name,
+                                           r['replica_id'],
+                                           r['cluster_name'],
+                                           serve_state.ReplicaStatus.READY)
+                ready += 1
+            elif status == serve_state.ReplicaStatus.READY:
+                serve_state.upsert_replica(
+                    self.service_name, r['replica_id'], r['cluster_name'],
+                    serve_state.ReplicaStatus.NOT_READY)
+        return ready
+
+    def _probe(self, endpoint: str) -> bool:
+        url = f'http://{endpoint}{self.spec.readiness_path}'
+        try:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                return 200 <= resp.status < 400
+        except Exception:  # pylint: disable=broad-except
+            return False
+
+    def _cluster_alive(self, cluster_name: str) -> bool:
+        from skypilot_tpu import core as core_lib
+        record = core_lib.refresh_cluster_status(cluster_name)
+        return record is not None
+
+    def ready_endpoints(self) -> List[str]:
+        return [r['endpoint'] for r in self.replicas()
+                if r['status'] == serve_state.ReplicaStatus.READY and
+                r['endpoint']]
+
+    def recover_preempted(self) -> None:
+        """Replace PREEMPTED replicas (spot recovery for serving)."""
+        with self._lock:
+            for r in self.replicas():
+                if r['status'] == serve_state.ReplicaStatus.PREEMPTED:
+                    serve_state.remove_replica(self.service_name,
+                                               r['replica_id'])
+                    self._start_replica()

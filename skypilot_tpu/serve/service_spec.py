@@ -1,0 +1,96 @@
+"""`service:` YAML section (twin of sky/serve/service_spec.py:422)."""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class SkyServiceSpec:
+
+    def __init__(self,
+                 readiness_path: str = '/',
+                 initial_delay_seconds: float = 60.0,
+                 min_replicas: int = 1,
+                 max_replicas: Optional[int] = None,
+                 target_qps_per_replica: Optional[float] = None,
+                 upscale_delay_seconds: float = 300.0,
+                 downscale_delay_seconds: float = 1200.0,
+                 replica_port: Optional[int] = None,
+                 use_ondemand_fallback: bool = False) -> None:
+        if max_replicas is not None and max_replicas < min_replicas:
+            raise ValueError('max_replicas must be >= min_replicas')
+        if target_qps_per_replica is not None and max_replicas is None:
+            raise ValueError(
+                'autoscaling (target_qps_per_replica) requires '
+                'max_replicas')
+        self.readiness_path = readiness_path
+        self.initial_delay_seconds = initial_delay_seconds
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.target_qps_per_replica = target_qps_per_replica
+        self.upscale_delay_seconds = upscale_delay_seconds
+        self.downscale_delay_seconds = downscale_delay_seconds
+        self.replica_port = replica_port
+        self.use_ondemand_fallback = use_ondemand_fallback
+
+    @property
+    def autoscaling_enabled(self) -> bool:
+        return self.target_qps_per_replica is not None
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'SkyServiceSpec':
+        config = dict(config or {})
+        readiness = config.pop('readiness_probe', '/')
+        if isinstance(readiness, str):
+            readiness_path, initial_delay = readiness, 60.0
+        else:
+            readiness_path = readiness.get('path', '/')
+            initial_delay = float(
+                readiness.get('initial_delay_seconds', 60))
+        policy = config.pop('replica_policy', None)
+        if policy is None:
+            replicas = config.pop('replicas', 1)
+            policy = {'min_replicas': replicas, 'max_replicas': None}
+        port = config.pop('port', None)
+        unknown = set(config)
+        if unknown:
+            raise ValueError(f'Unknown service fields: {sorted(unknown)}')
+        return cls(
+            readiness_path=readiness_path,
+            initial_delay_seconds=initial_delay,
+            min_replicas=int(policy.get('min_replicas', 1)),
+            max_replicas=(int(policy['max_replicas'])
+                          if policy.get('max_replicas') is not None
+                          else None),
+            target_qps_per_replica=policy.get('target_qps_per_replica'),
+            upscale_delay_seconds=float(
+                policy.get('upscale_delay_seconds', 300)),
+            downscale_delay_seconds=float(
+                policy.get('downscale_delay_seconds', 1200)),
+            replica_port=int(port) if port is not None else None,
+            use_ondemand_fallback=bool(
+                policy.get('use_ondemand_fallback', False)),
+        )
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        config: Dict[str, Any] = {
+            'readiness_probe': {
+                'path': self.readiness_path,
+                'initial_delay_seconds': self.initial_delay_seconds,
+            },
+            'replica_policy': {
+                'min_replicas': self.min_replicas,
+            },
+        }
+        policy = config['replica_policy']
+        if self.max_replicas is not None:
+            policy['max_replicas'] = self.max_replicas
+        if self.target_qps_per_replica is not None:
+            policy['target_qps_per_replica'] = self.target_qps_per_replica
+            policy['upscale_delay_seconds'] = self.upscale_delay_seconds
+            policy['downscale_delay_seconds'] = \
+                self.downscale_delay_seconds
+        if self.use_ondemand_fallback:
+            policy['use_ondemand_fallback'] = True
+        if self.replica_port is not None:
+            config['port'] = self.replica_port
+        return config

@@ -1,0 +1,46 @@
+"""Spot placer: active/preemptive zone sets for spot replicas.
+
+Twin of sky/serve/spot_placer.py:170 (SpotPlacer,
+DynamicFallbackSpotPlacer:254): zones where a spot replica was preempted
+move to the 'preemptive' set and are avoided until every zone is
+preemptive (then the sets reset — better to try somewhere than nowhere).
+"""
+from __future__ import annotations
+
+import random
+from typing import List, Optional, Set
+
+
+class SpotPlacer:
+
+    def __init__(self, zones: List[str]) -> None:
+        self.active_zones: Set[str] = set(zones)
+        self.preemptive_zones: Set[str] = set()
+
+    def select_zone(self) -> Optional[str]:
+        if not self.active_zones:
+            self._reset()
+        if not self.active_zones:
+            return None
+        return random.choice(sorted(self.active_zones))
+
+    def handle_preemption(self, zone: str) -> None:
+        self.active_zones.discard(zone)
+        self.preemptive_zones.add(zone)
+
+    def handle_active(self, zone: str) -> None:
+        self.preemptive_zones.discard(zone)
+        self.active_zones.add(zone)
+
+    def _reset(self) -> None:
+        self.active_zones |= self.preemptive_zones
+        self.preemptive_zones.clear()
+
+
+class DynamicFallbackSpotPlacer(SpotPlacer):
+    """Same sets, but select prefers zones with no recent preemption and
+    falls back to on-demand when everything is preemptive (used with
+    service specs that set use_ondemand_fallback)."""
+
+    def should_fallback_to_ondemand(self) -> bool:
+        return not self.active_zones

@@ -1,0 +1,179 @@
+"""Serve state: services + replicas (twin of sky/serve/serve_state.py)."""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_lock = threading.RLock()
+
+
+class ServiceStatus(enum.Enum):
+    CONTROLLER_INIT = 'CONTROLLER_INIT'
+    REPLICA_INIT = 'REPLICA_INIT'
+    READY = 'READY'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    FAILED = 'FAILED'
+    NO_REPLICA = 'NO_REPLICA'
+
+
+class ReplicaStatus(enum.Enum):
+    PENDING = 'PENDING'
+    PROVISIONING = 'PROVISIONING'
+    STARTING = 'STARTING'
+    READY = 'READY'
+    NOT_READY = 'NOT_READY'
+    FAILED = 'FAILED'
+    PREEMPTED = 'PREEMPTED'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+
+
+def _db() -> sqlite3.Connection:
+    path = os.path.expanduser(
+        os.environ.get('XSKY_SERVE_DB', '~/.xsky/serve.db'))
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    conn = sqlite3.connect(path, timeout=30, check_same_thread=False)
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.executescript("""
+        CREATE TABLE IF NOT EXISTS services (
+            name TEXT PRIMARY KEY,
+            task_config TEXT,
+            status TEXT,
+            controller_pid INTEGER,
+            lb_port INTEGER,
+            created_at REAL
+        );
+        CREATE TABLE IF NOT EXISTS replicas (
+            service_name TEXT,
+            replica_id INTEGER,
+            cluster_name TEXT,
+            status TEXT,
+            endpoint TEXT,
+            launched_at REAL,
+            PRIMARY KEY (service_name, replica_id)
+        )""")
+    conn.commit()
+    return conn
+
+
+# ---- services ----
+
+
+def add_service(name: str, task_config: Dict[str, Any],
+                lb_port: int) -> None:
+    with _lock:
+        conn = _db()
+        conn.execute(
+            'INSERT OR REPLACE INTO services (name, task_config, status, '
+            'lb_port, created_at) VALUES (?, ?, ?, ?, ?)',
+            (name, json.dumps(task_config),
+             ServiceStatus.CONTROLLER_INIT.value, lb_port, time.time()))
+        conn.commit()
+        conn.close()
+
+
+def set_service_status(name: str, status: ServiceStatus) -> None:
+    with _lock:
+        conn = _db()
+        conn.execute('UPDATE services SET status=? WHERE name=?',
+                     (status.value, name))
+        conn.commit()
+        conn.close()
+
+
+def set_service_controller_pid(name: str, pid: int) -> None:
+    with _lock:
+        conn = _db()
+        conn.execute('UPDATE services SET controller_pid=? WHERE name=?',
+                     (pid, name))
+        conn.commit()
+        conn.close()
+
+
+def get_service(name: str) -> Optional[Dict[str, Any]]:
+    with _lock:
+        conn = _db()
+        row = conn.execute('SELECT * FROM services WHERE name=?',
+                           (name,)).fetchone()
+        conn.close()
+    return _service_dict(row) if row else None
+
+
+def get_services() -> List[Dict[str, Any]]:
+    with _lock:
+        conn = _db()
+        rows = conn.execute('SELECT * FROM services').fetchall()
+        conn.close()
+    return [_service_dict(r) for r in rows]
+
+
+def remove_service(name: str) -> None:
+    with _lock:
+        conn = _db()
+        conn.execute('DELETE FROM services WHERE name=?', (name,))
+        conn.execute('DELETE FROM replicas WHERE service_name=?', (name,))
+        conn.commit()
+        conn.close()
+
+
+def _service_dict(row) -> Dict[str, Any]:
+    name, task_config, status, pid, lb_port, created_at = row
+    return {
+        'name': name,
+        'task_config': json.loads(task_config or '{}'),
+        'status': ServiceStatus(status),
+        'controller_pid': pid,
+        'lb_port': lb_port,
+        'created_at': created_at,
+    }
+
+
+# ---- replicas ----
+
+
+def upsert_replica(service_name: str, replica_id: int, cluster_name: str,
+                   status: ReplicaStatus,
+                   endpoint: Optional[str] = None) -> None:
+    with _lock:
+        conn = _db()
+        conn.execute(
+            'INSERT INTO replicas (service_name, replica_id, cluster_name,'
+            ' status, endpoint, launched_at) VALUES (?, ?, ?, ?, ?, ?) '
+            'ON CONFLICT(service_name, replica_id) DO UPDATE SET '
+            'status=excluded.status, '
+            'endpoint=COALESCE(excluded.endpoint, replicas.endpoint)',
+            (service_name, replica_id, cluster_name, status.value,
+             endpoint, time.time()))
+        conn.commit()
+        conn.close()
+
+
+def remove_replica(service_name: str, replica_id: int) -> None:
+    with _lock:
+        conn = _db()
+        conn.execute(
+            'DELETE FROM replicas WHERE service_name=? AND replica_id=?',
+            (service_name, replica_id))
+        conn.commit()
+        conn.close()
+
+
+def get_replicas(service_name: str) -> List[Dict[str, Any]]:
+    with _lock:
+        conn = _db()
+        rows = conn.execute(
+            'SELECT * FROM replicas WHERE service_name=? '
+            'ORDER BY replica_id', (service_name,)).fetchall()
+        conn.close()
+    return [{
+        'service_name': r[0],
+        'replica_id': r[1],
+        'cluster_name': r[2],
+        'status': ReplicaStatus(r[3]),
+        'endpoint': r[4],
+        'launched_at': r[5],
+    } for r in rows]

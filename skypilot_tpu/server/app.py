@@ -1,0 +1,147 @@
+"""API server: stdlib HTTP + JSON routing (twin of sky/server/server.py).
+
+The reference uses FastAPI; this image bakes no web framework, so the
+server is a ThreadingHTTPServer with a small router — zero dependencies,
+same wire contract as ``client/remote_client.py``:
+
+  POST /api/<verb>            → {"request_id": ...}
+  GET  /api/get?request_id=X  → {"status", "result"|"error"}
+  GET  /api/requests          → request list (sky api logs twin)
+  POST /api/requests/cancel   → cancel a queued/running request
+  GET  /health                → {"status": "healthy", "version": ...}
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.server import executor
+from skypilot_tpu.server import payloads
+from skypilot_tpu.server import requests_db
+
+logger = sky_logging.init_logger(__name__)
+
+API_VERSION = 1
+
+
+# ---- route table -----------------------------------------------------------
+
+
+def _submit_verb(verb: str, body: Dict[str, Any]) -> Dict[str, Any]:
+    func, kwargs = payloads.resolve(verb, body)
+    request_id = executor.schedule_request(verb, body.get('user', 'anon'),
+                                           body, func, kwargs)
+    return {'request_id': request_id}
+
+
+def _get_request(params: Dict[str, str]) -> Tuple[int, Dict[str, Any]]:
+    record = requests_db.get(params.get('request_id', ''))
+    if record is None:
+        return 404, {'error': 'request not found'}
+    payload: Dict[str, Any] = {
+        'request_id': record['request_id'],
+        'name': record['name'],
+        'status': record['status'].value,
+    }
+    if record['status'] == requests_db.RequestStatus.SUCCEEDED:
+        payload['result'] = payloads.jsonify(record['result'])
+    elif record['status'] == requests_db.RequestStatus.FAILED:
+        payload['error'] = record['error']
+    return 200, payload
+
+
+def _cancel_request(body: Dict[str, Any]) -> Dict[str, Any]:
+    ok = requests_db.mark_cancelled(body.get('request_id', ''))
+    return {'cancelled': ok}
+
+
+# ---- HTTP plumbing ---------------------------------------------------------
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = 'xsky-api'
+
+    def log_message(self, fmt, *args):  # quiet default access log
+        logger.debug('%s - %s' % (self.address_string(), fmt % args))
+
+    def _send(self, code: int, payload: Dict[str, Any]) -> None:
+        data = json.dumps(payload, default=str).encode()
+        self.send_response(code)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _read_body(self) -> Dict[str, Any]:
+        length = int(self.headers.get('Content-Length') or 0)
+        if length == 0:
+            return {}
+        try:
+            return json.loads(self.rfile.read(length))
+        except json.JSONDecodeError:
+            return {}
+
+    def do_GET(self) -> None:  # noqa: N802
+        parsed = urllib.parse.urlparse(self.path)
+        params = dict(urllib.parse.parse_qsl(parsed.query))
+        if parsed.path == '/health':
+            self._send(200, {'status': 'healthy',
+                             'api_version': API_VERSION})
+        elif parsed.path == '/api/get':
+            code, payload = _get_request(params)
+            self._send(code, payload)
+        elif parsed.path == '/api/requests':
+            self._send(200, {'requests': requests_db.list_requests()})
+        else:
+            self._send(404, {'error': f'no route {parsed.path}'})
+
+    def do_POST(self) -> None:  # noqa: N802
+        parsed = urllib.parse.urlparse(self.path)
+        body = self._read_body()
+        if parsed.path == '/api/requests/cancel':
+            self._send(200, _cancel_request(body))
+            return
+        if parsed.path.startswith('/api/'):
+            verb = parsed.path[len('/api/'):]
+            if not payloads.known_verb(verb):
+                self._send(404, {'error': f'unknown verb {verb}'})
+                return
+            try:
+                self._send(200, _submit_verb(verb, body))
+            except payloads.BadRequest as e:
+                self._send(400, {'error': str(e)})
+            return
+        self._send(404, {'error': f'no route {parsed.path}'})
+
+
+def make_server(host: str = '127.0.0.1',
+                port: int = 46580) -> ThreadingHTTPServer:
+    return ThreadingHTTPServer((host, port), _Handler)
+
+
+def run(host: str = '127.0.0.1', port: int = 46580) -> None:
+    server = make_server(host, port)
+    logger.info(f'xsky API server listening on http://{host}:{port}')
+    server.serve_forever()
+
+
+def run_in_thread(host: str = '127.0.0.1',
+                  port: int = 0) -> Tuple[ThreadingHTTPServer, int]:
+    """Start in a daemon thread (tests + `xsky api start` child)."""
+    server = make_server(host, port)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, server.server_address[1]
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--host', default='127.0.0.1')
+    parser.add_argument('--port', type=int, default=46580)
+    args = parser.parse_args()
+    run(args.host, args.port)

@@ -1,0 +1,76 @@
+"""Request executor: long/short worker pools (twin of
+sky/server/requests/executor.py:1-19,131,496).
+
+Long pool: launch/exec/start/down/stop — operations that can block for
+minutes and recursively drive the engine. Short pool: status/queue/logs —
+fast reads. Thread pools (not processes): the engine is I/O-bound
+(cloud REST + SSH), and threads share the sqlite state cleanly.
+
+`synchronous` mode executes inline — the TestClient harness twin of the
+reference's mock_client_requests (tests/common_test_fixtures.py:52-135).
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.server import requests_db
+
+logger = sky_logging.init_logger(__name__)
+
+LONG_REQUESTS = {'launch', 'exec', 'start', 'stop', 'down', 'jobs.launch',
+                 'serve.up', 'serve.down'}
+
+_pools_lock = threading.Lock()
+_long_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+_short_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+_synchronous = False
+
+
+def set_synchronous_for_test(value: bool) -> None:
+    global _synchronous
+    _synchronous = value
+
+
+def _pools():
+    global _long_pool, _short_pool
+    with _pools_lock:
+        if _long_pool is None:
+            _long_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix='xsky-long')
+            _short_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=16, thread_name_prefix='xsky-short')
+    return _long_pool, _short_pool
+
+
+def _run_request(request_id: str, func: Callable[..., Any],
+                 kwargs: Dict[str, Any]) -> None:
+    record = requests_db.get(request_id)
+    if record is None or record['status'].is_terminal():
+        return  # cancelled before start
+    requests_db.set_status(request_id, requests_db.RequestStatus.RUNNING)
+    try:
+        result = func(**kwargs)
+        requests_db.finish(request_id, result=result)
+    except Exception as e:  # pylint: disable=broad-except
+        logger.info(f'Request {record["name"]} failed: {e}\n'
+                    f'{traceback.format_exc()}')
+        requests_db.finish(request_id,
+                           error=exceptions.serialize_exception(e))
+
+
+def schedule_request(name: str, user: str, body: Dict[str, Any],
+                     func: Callable[..., Any],
+                     kwargs: Dict[str, Any]) -> str:
+    request_id = requests_db.create(name, user, body)
+    if _synchronous:
+        _run_request(request_id, func, kwargs)
+        return request_id
+    long_pool, short_pool = _pools()
+    pool = long_pool if name in LONG_REQUESTS else short_pool
+    pool.submit(_run_request, request_id, func, kwargs)
+    return request_id

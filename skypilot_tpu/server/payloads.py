@@ -1,0 +1,180 @@
+"""Verb → engine-function resolution + body validation (twin of
+sky/server/requests/payloads.py, sans pydantic).
+
+Each verb maps to a resolver that turns the JSON body into (func, kwargs)
+for the executor. Task payloads travel as task-YAML config dicts.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+
+class BadRequest(Exception):
+    pass
+
+
+def _task_from_body(body: Dict[str, Any]):
+    from skypilot_tpu import task as task_lib
+    config = body.get('task')
+    if not isinstance(config, dict):
+        raise BadRequest("body must include a 'task' config object")
+    try:
+        return task_lib.Task.from_yaml_config(config)
+    except (ValueError, KeyError) as e:
+        raise BadRequest(f'invalid task: {e}') from e
+
+
+def _require(body: Dict[str, Any], key: str) -> Any:
+    if key not in body or body[key] is None:
+        raise BadRequest(f"missing required field '{key}'")
+    return body[key]
+
+
+def _launch(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
+    from skypilot_tpu import execution
+    task = _task_from_body(body)
+
+    def run_launch(**kwargs):
+        job_id, handle = execution.launch(task, **kwargs)
+        return {'job_id': job_id,
+                'cluster_name': handle.get_cluster_name()
+                if handle else None}
+
+    kwargs = {
+        'cluster_name': body.get('cluster_name'),
+        'retry_until_up': bool(body.get('retry_until_up', False)),
+        'idle_minutes_to_autostop': body.get('idle_minutes_to_autostop'),
+        'down': bool(body.get('down', False)),
+        'dryrun': bool(body.get('dryrun', False)),
+        'detach_run': bool(body.get('detach_run', False)),
+        'no_setup': bool(body.get('no_setup', False)),
+    }
+    return run_launch, kwargs
+
+
+def _exec(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
+    from skypilot_tpu import execution
+    task = _task_from_body(body)
+    cluster_name = _require(body, 'cluster_name')
+
+    def run_exec(**kwargs):
+        job_id, handle = execution.exec(task, cluster_name, **kwargs)
+        return {'job_id': job_id,
+                'cluster_name': handle.get_cluster_name()}
+
+    return run_exec, {'detach_run': bool(body.get('detach_run', False)),
+                      'dryrun': bool(body.get('dryrun', False))}
+
+
+def _core_verb(fn_name: str, *fields, **defaults):
+    def resolver(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
+        from skypilot_tpu import core
+        kwargs = {}
+        for field in fields:
+            kwargs[field] = _require(body, field)
+        for key, default in defaults.items():
+            kwargs[key] = body.get(key, default)
+        return getattr(core, fn_name), kwargs
+    return resolver
+
+
+_VERBS: Dict[str, Callable[[Dict[str, Any]],
+                           Tuple[Callable, Dict[str, Any]]]] = {
+    'launch': _launch,
+    'exec': _exec,
+    'status': _core_verb('status', cluster_names=None, refresh=False),
+    'start': _core_verb('start', 'cluster_name',
+                        idle_minutes_to_autostop=None, down=False),
+    'stop': _core_verb('stop', 'cluster_name'),
+    'down': _core_verb('down', 'cluster_name', purge=False),
+    'autostop': _core_verb('autostop', 'cluster_name', 'idle_minutes',
+                           down_on_idle=False),
+    'queue': _core_verb('queue', 'cluster_name'),
+    'cancel': _core_verb('cancel', 'cluster_name', job_ids=None,
+                         all_jobs=False),
+    'logs': _core_verb('tail_logs', 'cluster_name', job_id=None),
+    'check': _core_verb('check', quiet=True),
+    'cost_report': _core_verb('cost_report'),
+}
+
+
+def _jobs_launch(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
+    from skypilot_tpu.jobs import core as jobs_core
+    task = _task_from_body(body)
+
+    def run(**kwargs):
+        return {'job_id': jobs_core.launch(task, **kwargs)}
+
+    return run, {'name': body.get('name')}
+
+
+def _jobs_verb(fn_name: str, *fields):
+    def resolver(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
+        from skypilot_tpu.jobs import core as jobs_core
+        kwargs = {f: _require(body, f) for f in fields}
+        return getattr(jobs_core, fn_name), kwargs
+    return resolver
+
+
+def _serve_up(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
+    from skypilot_tpu.serve import core as serve_core
+    task = _task_from_body(body)
+
+    def run(**kwargs):
+        return {'service_name': serve_core.up(task, **kwargs)}
+
+    return run, {'service_name': body.get('service_name')}
+
+
+def _serve_verb(fn_name: str, *fields):
+    def resolver(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
+        from skypilot_tpu.serve import core as serve_core
+        kwargs = {f: _require(body, f) for f in fields}
+        return getattr(serve_core, fn_name), kwargs
+    return resolver
+
+
+_VERBS.update({
+    'jobs.launch': _jobs_launch,
+    'jobs.queue': _jobs_verb('queue'),
+    'jobs.cancel': _jobs_verb('cancel', 'job_id'),
+    'jobs.logs': _jobs_verb('tail_logs', 'job_id'),
+    'serve.up': _serve_up,
+    'serve.status': lambda body: (
+        __import__('skypilot_tpu.serve.core', fromlist=['status']).status,
+        {'service_names': body.get('service_names')}),
+    'serve.down': _serve_verb('down', 'service_name'),
+})
+
+
+def known_verb(verb: str) -> bool:
+    return verb in _VERBS
+
+
+def resolve(verb: str, body: Dict[str, Any]
+            ) -> Tuple[Callable, Dict[str, Any]]:
+    # `autostop` maps the wire field 'down' onto core's down_on_idle.
+    if verb == 'autostop' and 'down' in body:
+        body = dict(body)
+        body['down_on_idle'] = body.pop('down')
+    return _VERBS[verb](body)
+
+
+def jsonify(obj: Any) -> Any:
+    """Make engine results JSON-safe (enums → value, handles → summary)."""
+    import enum
+    if isinstance(obj, dict):
+        return {k: jsonify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [jsonify(v) for v in obj]
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if hasattr(obj, 'get_cluster_name'):   # ResourceHandle
+        return {'cluster_name': obj.get_cluster_name(),
+                'resources': str(getattr(obj, 'launched_resources', '')),
+                'num_hosts': getattr(
+                    getattr(obj, 'cluster_info', None), 'num_instances',
+                    None)}
+    if obj is None or isinstance(obj, (str, int, float, bool)):
+        return obj
+    return str(obj)

@@ -1,0 +1,156 @@
+"""API-server request table (twin of sky/server/requests/requests.py).
+
+Every API call becomes a persisted request row; clients poll by id.
+DB: ``~/.xsky/server/requests.db`` (XSKY_SERVER_DB overrides for tests).
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import pickle
+import sqlite3
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+_lock = threading.RLock()
+_conn: Optional[sqlite3.Connection] = None
+_conn_path: Optional[str] = None
+
+
+class RequestStatus(enum.Enum):
+    PENDING = 'PENDING'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (RequestStatus.SUCCEEDED, RequestStatus.FAILED,
+                        RequestStatus.CANCELLED)
+
+
+def _db_path() -> str:
+    return os.path.expanduser(
+        os.environ.get('XSKY_SERVER_DB', '~/.xsky/server/requests.db'))
+
+
+def _get_conn() -> sqlite3.Connection:
+    global _conn, _conn_path
+    path = _db_path()
+    with _lock:
+        if _conn is None or _conn_path != path:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            _conn = sqlite3.connect(path, check_same_thread=False)
+            _conn.execute('PRAGMA journal_mode=WAL')
+            _conn.execute("""
+                CREATE TABLE IF NOT EXISTS requests (
+                    request_id TEXT PRIMARY KEY,
+                    name TEXT,
+                    user TEXT,
+                    status TEXT,
+                    body TEXT,
+                    result BLOB,
+                    error TEXT,
+                    created_at REAL,
+                    finished_at REAL
+                )""")
+            _conn.commit()
+            _conn_path = path
+        return _conn
+
+
+def reset_for_test() -> None:
+    global _conn, _conn_path
+    with _lock:
+        if _conn is not None:
+            _conn.close()
+        _conn = None
+        _conn_path = None
+
+
+def create(name: str, user: str, body: Dict[str, Any]) -> str:
+    request_id = uuid.uuid4().hex
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'INSERT INTO requests (request_id, name, user, status, body, '
+            'created_at) VALUES (?, ?, ?, ?, ?, ?)',
+            (request_id, name, user, RequestStatus.PENDING.value,
+             json.dumps(body, default=str), time.time()))
+        conn.commit()
+    return request_id
+
+
+def set_status(request_id: str, status: RequestStatus) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute('UPDATE requests SET status=? WHERE request_id=?',
+                     (status.value, request_id))
+        conn.commit()
+
+
+def finish(request_id: str, result: Any = None,
+           error: Optional[Dict[str, Any]] = None) -> None:
+    conn = _get_conn()
+    status = RequestStatus.FAILED if error else RequestStatus.SUCCEEDED
+    with _lock:
+        # Guard: a concurrent cancel must not be overwritten (the work
+        # may have completed anyway, but CANCELLED is the user-visible
+        # truth about what they asked for).
+        conn.execute(
+            'UPDATE requests SET status=?, result=?, error=?, '
+            "finished_at=? WHERE request_id=? AND status IN "
+            "('PENDING', 'RUNNING')",
+            (status.value, pickle.dumps(result),
+             json.dumps(error) if error else None, time.time(),
+             request_id))
+        conn.commit()
+
+
+def get(request_id: str) -> Optional[Dict[str, Any]]:
+    conn = _get_conn()
+    with _lock:
+        row = conn.execute(
+            'SELECT request_id, name, user, status, body, result, error, '
+            'created_at, finished_at FROM requests WHERE request_id=?',
+            (request_id,)).fetchone()
+    if row is None:
+        return None
+    return {
+        'request_id': row[0],
+        'name': row[1],
+        'user': row[2],
+        'status': RequestStatus(row[3]),
+        'body': json.loads(row[4] or '{}'),
+        'result': pickle.loads(row[5]) if row[5] else None,
+        'error': json.loads(row[6]) if row[6] else None,
+        'created_at': row[7],
+        'finished_at': row[8],
+    }
+
+
+def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
+    conn = _get_conn()
+    with _lock:
+        rows = conn.execute(
+            'SELECT request_id, name, user, status, created_at, '
+            'finished_at FROM requests ORDER BY created_at DESC LIMIT ?',
+            (limit,)).fetchall()
+    return [{
+        'request_id': r[0], 'name': r[1], 'user': r[2], 'status': r[3],
+        'created_at': r[4], 'finished_at': r[5],
+    } for r in rows]
+
+
+def mark_cancelled(request_id: str) -> bool:
+    conn = _get_conn()
+    with _lock:
+        cur = conn.execute(
+            "UPDATE requests SET status='CANCELLED', finished_at=? "
+            "WHERE request_id=? AND status IN ('PENDING', 'RUNNING')",
+            (time.time(), request_id))
+        conn.commit()
+        return cur.rowcount == 1

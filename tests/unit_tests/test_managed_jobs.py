@@ -1,0 +1,107 @@
+"""Managed-job recovery tests: real controller subprocesses + fake cloud.
+
+Preemption is simulated by terminating the task cluster out-of-band,
+exactly like the reference smoke tests do with real instances
+(tests/smoke_tests/test_managed_job.py; smoke_tests_utils.py:33-36) —
+but hermetic.
+"""
+import time
+
+import pytest
+
+from skypilot_tpu import Resources, Task
+from skypilot_tpu.jobs import core as jobs_core
+from skypilot_tpu.jobs import state as jobs_state
+
+
+@pytest.fixture
+def jobs_env(fake_cluster_env, monkeypatch, tmp_path):
+    monkeypatch.setenv('XSKY_JOBS_DB', str(tmp_path / 'managed_jobs.db'))
+    monkeypatch.setenv('XSKY_JOBS_POLL_INTERVAL', '0.3')
+    yield fake_cluster_env
+
+
+def _wait_for(job_id, statuses, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        record = jobs_state.get_job(job_id)
+        if record and record['status'] in statuses:
+            return record
+        time.sleep(0.2)
+    record = jobs_state.get_job(job_id)
+    raise TimeoutError(
+        f'job {job_id} stuck at '
+        f'{record["status"] if record else None}')
+
+
+def _tpu_task(run, **recovery):
+    t = Task('mjob', run=run)
+    r = Resources(accelerators='tpu-v5e-8', use_spot=True,
+                  job_recovery=recovery or None)
+    t.set_resources(r)
+    return t
+
+
+class TestManagedJobs:
+
+    def test_job_succeeds(self, jobs_env):
+        job_id = jobs_core.launch(_tpu_task('echo managed-ok'))
+        record = _wait_for(
+            job_id, [jobs_state.ManagedJobStatus.SUCCEEDED])
+        assert record['recovery_count'] == 0
+        # Task cluster cleaned up after success.
+        assert not jobs_env.cluster_exists(record['cluster_name'])
+
+    def test_preemption_recovery(self, jobs_env):
+        """THE spot story: preempt mid-run → recover → complete."""
+        job_id = jobs_core.launch(
+            _tpu_task('sleep 4; echo survived'))
+        record = _wait_for(job_id,
+                           [jobs_state.ManagedJobStatus.RUNNING])
+        cluster = record['cluster_name']
+        # Let the job actually start, then preempt out-of-band.
+        time.sleep(1.0)
+        jobs_env.preempt_cluster(cluster)
+        record = _wait_for(
+            job_id, [jobs_state.ManagedJobStatus.SUCCEEDED], timeout=90)
+        assert record['recovery_count'] >= 1
+
+    def test_user_failure_restart_budget(self, jobs_env):
+        """exit 1 with max_restarts_on_errors=1: restart once, then FAILED."""
+        job_id = jobs_core.launch(
+            _tpu_task('exit 1', strategy='failover',
+                      max_restarts_on_errors=1))
+        record = _wait_for(job_id,
+                           [jobs_state.ManagedJobStatus.FAILED],
+                           timeout=90)
+        assert 'FAILED' in record['status'].value
+
+    def test_infeasible_fails_fast(self, jobs_env):
+        task = Task('ghost', run='echo x')
+        task.set_resources(Resources(accelerators={'H999': 8}))
+        job_id = jobs_core.launch(task)
+        record = _wait_for(
+            job_id, [jobs_state.ManagedJobStatus.FAILED_NO_RESOURCE],
+            timeout=60)
+        assert record['failure_reason']
+
+    def test_cancel_running(self, jobs_env):
+        job_id = jobs_core.launch(_tpu_task('sleep 120'))
+        record = _wait_for(job_id,
+                           [jobs_state.ManagedJobStatus.RUNNING])
+        jobs_core.cancel(job_id)
+        record = jobs_state.get_job(job_id)
+        assert record['status'] == jobs_state.ManagedJobStatus.CANCELLED
+        # Cluster reaped.
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                jobs_env.cluster_exists(record['cluster_name']):
+            time.sleep(0.2)
+        assert not jobs_env.cluster_exists(record['cluster_name'])
+
+    def test_queue_listing(self, jobs_env):
+        job_id = jobs_core.launch(_tpu_task('echo q'))
+        _wait_for(job_id, [jobs_state.ManagedJobStatus.SUCCEEDED])
+        rows = jobs_core.queue()
+        assert rows[0]['job_id'] == job_id
+        assert rows[0]['status'] == 'SUCCEEDED'

@@ -1,0 +1,198 @@
+"""Serve tests: real controller process, HTTP replicas, LB, autoscaler."""
+import textwrap
+import time
+import urllib.request
+
+import pytest
+
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import autoscalers as autoscalers_lib
+from skypilot_tpu.serve import core as serve_core
+from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.serve import spot_placer as spot_placer_lib
+from skypilot_tpu.serve import state as serve_state
+
+SERVICE_YAML = textwrap.dedent("""\
+    name: echo
+    resources:
+      accelerators: tpu-v5e-8
+    service:
+      readiness_probe: /
+      replica_policy:
+        min_replicas: {min_replicas}
+        max_replicas: {max_replicas}
+    run: |
+      python -c "
+      import http.server, os, json
+      class H(http.server.BaseHTTPRequestHandler):
+          def do_GET(self):
+              body = json.dumps({{'rank': os.environ.get('XSKY_HOST_RANK'),
+                                  'port': os.environ['PORT']}}).encode()
+              self.send_response(200)
+              self.send_header('Content-Length', str(len(body)))
+              self.end_headers()
+              self.wfile.write(body)
+          def log_message(self, *a): pass
+      http.server.HTTPServer(('127.0.0.1', int(os.environ['PORT'])),
+                             H).serve_forever()"
+    """)
+
+
+@pytest.fixture
+def serve_env(fake_cluster_env, monkeypatch, tmp_path):
+    monkeypatch.setenv('XSKY_SERVE_DB', str(tmp_path / 'serve.db'))
+    monkeypatch.setenv('XSKY_SERVE_INTERVAL', '0.5')
+    yield fake_cluster_env
+
+
+def _service_task(min_replicas=1, max_replicas=2):
+    import io
+    import yaml
+    config = yaml.safe_load(io.StringIO(
+        SERVICE_YAML.format(min_replicas=min_replicas,
+                            max_replicas=max_replicas)))
+    return task_lib.Task.from_yaml_config(config)
+
+
+class TestServeE2E:
+
+    def test_up_serve_traffic_down(self, serve_env):
+        task = _service_task(min_replicas=2)
+        name = serve_core.up(task, 'echo1', timeout_s=90)
+        record = serve_core.status(['echo1'])[0]
+        assert record['status'] == 'READY'
+        # Wait for both replicas READY (min_replicas=2).
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            record = serve_core.status(['echo1'])[0]
+            ready = [r for r in record['replicas']
+                     if r['status'] == 'READY']
+            if len(ready) == 2:
+                break
+            time.sleep(0.5)
+        assert len(ready) == 2
+        # Traffic through the LB round-robins across replica ports.
+        endpoint = record['endpoint']
+        seen_ports = set()
+        for _ in range(6):
+            with urllib.request.urlopen(f'http://{endpoint}/',
+                                        timeout=10) as resp:
+                import json
+                seen_ports.add(json.loads(resp.read())['port'])
+        assert len(seen_ports) == 2
+        serve_core.down('echo1')
+        assert serve_core.status(['echo1']) == []
+
+    def test_replica_preemption_recovery(self, serve_env):
+        task = _service_task(min_replicas=1)
+        serve_core.up(task, 'echo2', timeout_s=90)
+        replicas = serve_state.get_replicas('echo2')
+        cluster = replicas[0]['cluster_name']
+        serve_env.preempt_cluster(cluster)
+        # Controller must detect and replace the replica.
+        deadline = time.time() + 60
+        recovered = False
+        while time.time() < deadline:
+            reps = serve_state.get_replicas('echo2')
+            if reps and all(
+                    r['cluster_name'] != cluster for r in reps) and any(
+                    r['status'] == serve_state.ReplicaStatus.READY
+                    for r in reps):
+                recovered = True
+                break
+            time.sleep(0.5)
+        serve_core.down('echo2')
+        assert recovered
+
+    def test_duplicate_service_rejected(self, serve_env):
+        task = _service_task()
+        serve_core.up(task, 'dup', timeout_s=90)
+        with pytest.raises(ValueError):
+            serve_core.up(task, 'dup')
+        serve_core.down('dup')
+
+
+class TestAutoscaler:
+
+    def _spec(self, **kwargs):
+        defaults = dict(min_replicas=1, max_replicas=4,
+                        target_qps_per_replica=1.0,
+                        upscale_delay_seconds=0.0,
+                        downscale_delay_seconds=0.0)
+        defaults.update(kwargs)
+        return spec_lib.SkyServiceSpec(**defaults)
+
+    def test_scales_with_qps(self):
+        scaler = autoscalers_lib.RequestRateAutoscaler(self._spec())
+        # 180 requests in the 60s window → 3 qps → 3 replicas.
+        scaler.collect_request_information(180, 0)
+        assert scaler.evaluate(1).target_num_replicas == 3
+
+    def test_clamped_to_max(self):
+        scaler = autoscalers_lib.RequestRateAutoscaler(self._spec())
+        scaler.collect_request_information(6000, 0)
+        assert scaler.evaluate(1).target_num_replicas == 4
+
+    def test_upscale_hysteresis(self):
+        scaler = autoscalers_lib.RequestRateAutoscaler(
+            self._spec(upscale_delay_seconds=3600))
+        scaler.collect_request_information(600, 0)
+        # Desired is 10 but the delay hasn't elapsed: stay at 1.
+        assert scaler.evaluate(1).target_num_replicas == 1
+
+    def test_downscale_hysteresis(self):
+        scaler = autoscalers_lib.RequestRateAutoscaler(
+            self._spec(downscale_delay_seconds=3600))
+        scaler.collect_request_information(240, 0)
+        assert scaler.evaluate(1).target_num_replicas == 4
+        # QPS drops to 0; downscale delayed → stays 4.
+        scaler._request_timestamps.clear()
+        assert scaler.evaluate(4).target_num_replicas == 4
+
+    def test_fixed_when_no_target_qps(self):
+        spec = spec_lib.SkyServiceSpec(min_replicas=2)
+        scaler = autoscalers_lib.make_autoscaler(spec)
+        assert isinstance(scaler, autoscalers_lib.FixedReplicaAutoscaler)
+        assert scaler.evaluate(2).target_num_replicas == 2
+
+    def test_autoscaling_requires_max(self):
+        with pytest.raises(ValueError):
+            spec_lib.SkyServiceSpec(target_qps_per_replica=1.0)
+
+
+class TestLbPolicies:
+
+    def test_round_robin(self):
+        p = lb_policies.RoundRobinPolicy()
+        p.set_ready_replicas(['a', 'b'])
+        assert [p.select_replica() for _ in range(4)] == \
+            ['a', 'b', 'a', 'b']
+
+    def test_least_load(self):
+        p = lb_policies.LeastLoadPolicy()
+        p.set_ready_replicas(['a', 'b'])
+        r1 = p.select_replica()
+        r2 = p.select_replica()
+        assert {r1, r2} == {'a', 'b'}
+        p.request_done(r1)
+        assert p.select_replica() == r1
+
+    def test_empty(self):
+        p = lb_policies.RoundRobinPolicy()
+        p.set_ready_replicas([])
+        assert p.select_replica() is None
+
+
+class TestSpotPlacer:
+
+    def test_preemptive_zone_avoided(self):
+        placer = spot_placer_lib.SpotPlacer(['z1', 'z2'])
+        placer.handle_preemption('z1')
+        for _ in range(10):
+            assert placer.select_zone() == 'z2'
+
+    def test_reset_when_all_preemptive(self):
+        placer = spot_placer_lib.SpotPlacer(['z1'])
+        placer.handle_preemption('z1')
+        assert placer.select_zone() == 'z1'  # sets reset
