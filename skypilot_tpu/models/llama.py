@@ -187,6 +187,13 @@ def _layer(config: LlamaConfig, mesh: Optional[mesh_lib.Mesh],
         valid = kv_pos <= (cache_index + s - 1)
         attn = attention_ops.xla_attention_with_mask(q, ck, cv,
                                                      valid[:, None, None, :])
+    elif c.attention_impl in ('ring', 'ulysses') and mesh is not None:
+        # Context parallelism: sequence stays sharded through attention
+        # (K/V ring over ICI neighbors or all-to-all head scatter).
+        from skypilot_tpu.ops import ring_attention as ring_ops
+        new_cache = None
+        attn = ring_ops.sequence_parallel_attention(
+            q, k, v, mesh, implementation=c.attention_impl, causal=True)
     else:
         new_cache = None
         attn = attention_ops.dot_product_attention(
