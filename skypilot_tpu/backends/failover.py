@@ -142,8 +142,12 @@ class RetryingProvisioner:
         cloud = resources.cloud
         node_config = cloud.make_deploy_resources_variables(
             resources, self._cluster_name, region, zone)
+        # Zonal clouds (GCP) need the chosen placement for later lifecycle
+        # ops (stop/terminate/query read zone from provider_config).
+        provider_config = dict(self._provider_config)
+        provider_config.update({'region': region, 'zone': zone})
         config = provision_common.ProvisionConfig(
-            provider_config=dict(self._provider_config),
+            provider_config=provider_config,
             node_config=node_config,
             count=self._num_nodes,
             tags={'cluster_name': self._cluster_name},

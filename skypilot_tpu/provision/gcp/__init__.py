@@ -1,0 +1,7 @@
+"""GCP provisioner: TPU-VM slices (TPU v2 API) + Compute VMs.
+
+Twin of sky/provision/gcp/ (instance_utils.py:1205-1670 for the TPU path),
+rebuilt TPU-first: queued resources and multislice are first-class (the
+reference has neither), and every multi-host slice surfaces as per-host
+InstanceInfos sharing a slice_id.
+"""

@@ -1,0 +1,150 @@
+"""Compute Engine v1 REST client — CPU/GPU VMs for controllers & failover.
+
+Twin of GCPComputeInstance (sky/provision/gcp/instance_utils.py:313-1670's
+compute half). Controllers (jobs/serve) and GPU failover targets run on
+plain VMs; TPU slices go through tpu_api instead.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision.gcp import rest
+from skypilot_tpu.provision.gcp.tpu_api import CLUSTER_LABEL, HEAD_LABEL
+
+logger = sky_logging.init_logger(__name__)
+
+BASE = 'https://compute.googleapis.com/compute/v1'
+
+PENDING_STATES = ('PROVISIONING', 'STAGING', 'REPAIRING')
+RUNNING_STATE = 'RUNNING'
+STOPPING_STATES = ('STOPPING', 'SUSPENDING')
+STOPPED_STATES = ('TERMINATED', 'SUSPENDED', 'STOPPED')
+
+DEFAULT_IMAGE = ('projects/ubuntu-os-cloud/global/images/family/'
+                 'ubuntu-2204-lts')
+
+
+class ComputeClient:
+
+    def __init__(self, project: str, zone: str,
+                 transport: Optional[rest.Transport] = None) -> None:
+        self.project = project
+        self.zone = zone
+        self.t = transport or rest.Transport()
+        self.prefix = f'{BASE}/projects/{project}/zones/{zone}'
+
+    def insert(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        return self.t.request('POST', f'{self.prefix}/instances', body=body)
+
+    def get(self, name: str) -> Dict[str, Any]:
+        return self.t.request('GET', f'{self.prefix}/instances/{name}')
+
+    def list_cluster(self, cluster_name: str) -> List[Dict[str, Any]]:
+        items: List[Dict[str, Any]] = []
+        page: Optional[str] = None
+        while True:
+            params = {'filter': f'labels.{CLUSTER_LABEL}={cluster_name}'}
+            if page:
+                params['pageToken'] = page
+            resp = self.t.request('GET', f'{self.prefix}/instances',
+                                  params=params)
+            items.extend(resp.get('items', []))
+            page = resp.get('nextPageToken')
+            if not page:
+                break
+        return items
+
+    def delete(self, name: str) -> Dict[str, Any]:
+        return self.t.request('DELETE', f'{self.prefix}/instances/{name}')
+
+    def stop(self, name: str) -> Dict[str, Any]:
+        return self.t.request('POST',
+                              f'{self.prefix}/instances/{name}/stop')
+
+    def start(self, name: str) -> Dict[str, Any]:
+        return self.t.request('POST',
+                              f'{self.prefix}/instances/{name}/start')
+
+    def wait_operation(self, op: Dict[str, Any],
+                       timeout: float = 900.0,
+                       poll_interval: float = 3.0) -> Dict[str, Any]:
+        name = op.get('name')
+        if not name:
+            return op
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            cur = self.t.request(
+                'POST', f'{self.prefix}/operations/{name}/wait')
+            if cur.get('status') == 'DONE':
+                errors = cur.get('error', {}).get('errors', [])
+                if errors:
+                    e = errors[0]
+                    api_err = rest.GcpApiError(
+                        409, e.get('code', ''), e.get('message', ''))
+                    raise rest.classify_error(api_err, self.zone)
+                return cur
+            time.sleep(poll_interval)
+        raise exceptions.ProvisionError(
+            f'Timed out waiting for compute operation {name}')
+
+
+def vm_body(node_config: Dict[str, Any], cluster_name: str, vm_name: str,
+            zone: str, is_head: bool, node_index: int) -> Dict[str, Any]:
+    labels = dict(node_config.get('labels', {}))
+    labels[CLUSTER_LABEL] = cluster_name
+    labels[HEAD_LABEL] = 'true' if is_head else 'false'
+    labels['xsky-node-index'] = str(node_index)
+    machine_type = node_config.get('instance_type', 'n2-standard-8')
+    body: Dict[str, Any] = {
+        'name': vm_name,
+        'machineType': f'zones/{zone}/machineTypes/{machine_type}',
+        'labels': labels,
+        'disks': [{
+            'boot': True,
+            'autoDelete': True,
+            'initializeParams': {
+                'sourceImage': node_config.get('image_id', DEFAULT_IMAGE),
+                'diskSizeGb': str(node_config.get('disk_size', 256)),
+            },
+        }],
+        'networkInterfaces': [{
+            'network': node_config.get('network', 'global/networks/default'),
+            'accessConfigs': [{'name': 'External NAT',
+                               'type': 'ONE_TO_ONE_NAT'}],
+        }],
+        'tags': {'items': ['xsky']},
+        'metadata': {'items': [
+            {'key': k, 'value': v}
+            for k, v in node_config.get('metadata', {}).items()
+        ]},
+    }
+    if node_config.get('gpu_type'):
+        body['guestAccelerators'] = [{
+            'acceleratorType': (f'zones/{zone}/acceleratorTypes/'
+                                f'{node_config["gpu_type"]}'),
+            'acceleratorCount': int(node_config.get('gpu_count', 1)),
+        }]
+        body['scheduling'] = {'onHostMaintenance': 'TERMINATE'}
+    if node_config.get('use_spot'):
+        body.setdefault('scheduling', {}).update({
+            'provisioningModel': 'SPOT',
+            'instanceTerminationAction': 'DELETE',
+        })
+    return body
+
+
+def vm_instance_info(inst: Dict[str, Any]) -> Dict[str, Any]:
+    nic = (inst.get('networkInterfaces') or [{}])[0]
+    access = (nic.get('accessConfigs') or [{}])[0]
+    return {
+        'instance_id': inst['name'],
+        'internal_ip': nic.get('networkIP', ''),
+        'external_ip': access.get('natIP'),
+        'status': inst.get('status', 'UNKNOWN'),
+        'tags': dict(inst.get('labels', {})),
+        'slice_id': None,
+        'host_index': 0,
+    }

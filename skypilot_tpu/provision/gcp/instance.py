@@ -1,0 +1,347 @@
+"""GCP provisioner op-set: TPU slices + Compute VMs behind one interface.
+
+Dispatched by provider name 'gcp' (skypilot_tpu/provision/__init__.py).
+Node kind is decided by deploy vars: ``tpu_vm: True`` → TPU v2 API path
+(direct nodes.create, or queued resources when requested / multislice);
+otherwise a Compute Engine VM (controllers, GPU failover targets).
+
+Behavioral twin of sky/provision/gcp/instance.py + instance_utils.py —
+with queued-resources/multislice support the reference lacks.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import compute_api
+from skypilot_tpu.provision.gcp import rest
+from skypilot_tpu.provision.gcp import tpu_api
+
+logger = sky_logging.init_logger(__name__)
+
+# Pluggable transport for tests (scripted fake API).
+_transport_factory = rest.Transport
+
+
+def set_transport_factory(factory) -> None:
+    global _transport_factory
+    _transport_factory = factory
+
+
+def _project(provider_config: Dict[str, Any]) -> str:
+    project = provider_config.get('project_id')
+    if not project:
+        raise exceptions.InvalidSkyTpuConfigError(
+            'GCP provider_config requires project_id.')
+    return project
+
+
+def _clients(provider_config: Dict[str, Any], zone: str):
+    project = _project(provider_config)
+    t = _transport_factory()
+    return (tpu_api.TpuClient(project, zone, t),
+            compute_api.ComputeClient(project, zone, t))
+
+
+def _normalize(state: str) -> str:
+    if state in tpu_api.PENDING_STATES or state in \
+            compute_api.PENDING_STATES:
+        return 'PENDING'
+    if state in (tpu_api.RUNNING_STATE, compute_api.RUNNING_STATE):
+        return 'RUNNING'
+    if state in tpu_api.STOPPED_STATES or state in \
+            compute_api.STOPPED_STATES:
+        return 'STOPPED'
+    if state in tpu_api.STOPPING_STATES or state in \
+            compute_api.STOPPING_STATES:
+        return 'STOPPING'
+    return state
+
+
+# ---- run_instances ---------------------------------------------------------
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    if zone is None:
+        raise exceptions.InvalidSkyTpuConfigError(
+            'GCP provisioning requires an explicit zone.')
+    node_cfg = config.node_config
+    try:
+        if node_cfg.get('tpu_vm'):
+            created, resumed, head = _run_tpu(zone, cluster_name, config)
+        else:
+            created, resumed, head = _run_vms(zone, cluster_name, config)
+    except rest.GcpApiError as e:
+        raise rest.classify_error(e, zone) from e
+    return common.ProvisionRecord(
+        provider_name='gcp', cluster_name=cluster_name, region=region,
+        zone=zone, resumed_instance_ids=resumed,
+        created_instance_ids=created, head_instance_id=head)
+
+
+def _node_name(cluster_name: str, node_index: int) -> str:
+    return f'{cluster_name}-{node_index}'
+
+
+def _run_tpu(zone: str, cluster_name: str, config: common.ProvisionConfig):
+    tpu, _ = _clients(config.provider_config, zone)
+    node_cfg = config.node_config
+    num_slices = int(node_cfg.get('tpu_num_slices', 1))
+    use_qr = bool(node_cfg.get('tpu_use_queued_resources')) or num_slices > 1
+
+    existing = tpu.list_nodes(cluster_name)
+    by_id = {n['name'].split('/')[-1]: n for n in existing}
+    created: List[str] = []
+    resumed: List[str] = []
+
+    # Resume any stopped single-host nodes (multi-host cannot stop;
+    # reference: sky/clouds/gcp.py:216-226).
+    if config.resume_stopped_nodes:
+        for node_id, node in by_id.items():
+            if node.get('state') in tpu_api.STOPPED_STATES:
+                tpu.wait_operation(tpu.start_node(node_id))
+                resumed.append(node_id)
+
+    want = config.count * num_slices
+    missing = want - len(by_id)
+    if missing > 0:
+        if use_qr:
+            _create_via_queued_resources(tpu, cluster_name, node_cfg,
+                                         config.count, num_slices,
+                                         existing_ids=set(by_id),
+                                         created=created)
+        else:
+            ops = []
+            for node in range(config.count):
+                node_id = _node_name(cluster_name, node)
+                if node_id in by_id:
+                    continue
+                body = tpu_api.node_body(node_cfg, cluster_name,
+                                         is_head=(node == 0),
+                                         node_index=node)
+                ops.append((node_id, tpu.create_node(node_id, body)))
+                created.append(node_id)
+            for node_id, op in ops:
+                try:
+                    tpu.wait_operation(op)
+                except Exception:
+                    # All-or-nothing for the *new* gang members: roll back
+                    # only the nodes this attempt created, leaving any
+                    # pre-existing/resumed nodes intact.
+                    for nid in created:
+                        try:
+                            tpu.delete_node(nid)
+                        except rest.GcpApiError as e:
+                            if e.status != 404:
+                                logger.warning(
+                                    f'Rollback of {nid} failed: {e}')
+                    raise
+
+    head = _tpu_head_id(tpu, cluster_name)
+    return created, resumed, head
+
+
+def _create_via_queued_resources(tpu: tpu_api.TpuClient, cluster_name: str,
+                                 node_cfg: Dict[str, Any], count: int,
+                                 num_slices: int, existing_ids: set,
+                                 created: List[str]) -> None:
+    """Capacity via queued resources; blocks until ACTIVE or timeout."""
+    if count != 1:
+        raise exceptions.NotSupportedError(
+            'Queued resources provision one (multi-slice) TPU node set '
+            'per cluster; use tpu_num_slices for scale-out.')
+    qr_id = cluster_name
+    timeout = float(node_cfg.get('provision_timeout_s', 900))
+    poll = float(node_cfg.get('qr_poll_interval_s',
+                              min(10.0, max(1.0, timeout / 60))))
+    # Re-provision after a partial failure may find the QR already there;
+    # resume polling it instead of colliding on create (409).
+    if not tpu.list_queued_resources(cluster_name):
+        body = tpu_api.queued_resource_body(node_cfg, cluster_name, qr_id,
+                                            0, num_slices)
+        tpu.create_queued_resource(qr_id, body)
+    deadline = time.time() + timeout
+    while True:
+        qr = tpu.get_queued_resource(qr_id)
+        state = qr.get('state', {}).get('state', 'UNKNOWN')
+        if state == tpu_api.QR_ACTIVE:
+            break
+        if state in tpu_api.QR_TERMINAL_BAD:
+            tpu.delete_queued_resource(qr_id)
+            raise exceptions.CapacityError(
+                f'Queued resource {qr_id} entered {state} in {tpu.zone}.')
+        if time.time() > deadline:
+            tpu.delete_queued_resource(qr_id)
+            raise exceptions.QueuedResourceTimeoutError(
+                f'Queued resource {qr_id} not ACTIVE within {timeout}s '
+                f'in {tpu.zone} (last state: {state}).')
+        time.sleep(poll)
+    for node in tpu.list_nodes(cluster_name):
+        node_id = node['name'].split('/')[-1]
+        if node_id not in existing_ids:
+            created.append(node_id)
+
+
+def _run_vms(zone: str, cluster_name: str, config: common.ProvisionConfig):
+    _, gce = _clients(config.provider_config, zone)
+    existing = gce.list_cluster(cluster_name)
+    by_name = {i['name']: i for i in existing}
+    created: List[str] = []
+    resumed: List[str] = []
+
+    if config.resume_stopped_nodes:
+        for name, inst in by_name.items():
+            if inst.get('status') in compute_api.STOPPED_STATES:
+                gce.wait_operation(gce.start(name))
+                resumed.append(name)
+
+    ops = []
+    for node in range(config.count):
+        vm_name = _node_name(cluster_name, node)
+        if vm_name in by_name:
+            continue
+        body = compute_api.vm_body(config.node_config, cluster_name,
+                                   vm_name, zone, is_head=(node == 0),
+                                   node_index=node)
+        ops.append(gce.insert(body))
+        created.append(vm_name)
+    for op in ops:
+        gce.wait_operation(op)
+
+    head = None
+    for inst in gce.list_cluster(cluster_name):
+        if inst.get('labels', {}).get(tpu_api.HEAD_LABEL) == 'true':
+            head = inst['name']
+    if head is None and created:
+        head = sorted(created)[0]
+    return created, resumed, head
+
+
+def _tpu_head_id(tpu: tpu_api.TpuClient, cluster_name: str
+                 ) -> Optional[str]:
+    nodes = sorted(tpu.list_nodes(cluster_name),
+                   key=lambda n: n.get('name', ''))
+    for node in nodes:
+        if node.get('labels', {}).get(tpu_api.HEAD_LABEL) == 'true':
+            return node['name'].split('/')[-1] + '-host0'
+    if nodes:
+        return nodes[0]['name'].split('/')[-1] + '-host0'
+    return None
+
+
+# ---- lifecycle -------------------------------------------------------------
+
+
+def _zone_of(provider_config: Dict[str, Any]) -> str:
+    zone = provider_config.get('zone')
+    if not zone:
+        raise exceptions.InvalidSkyTpuConfigError(
+            'provider_config requires zone for lifecycle ops.')
+    return zone
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    zone = _zone_of(provider_config)
+    tpu, gce = _clients(provider_config, zone)
+    for node in tpu.list_nodes(cluster_name):
+        if len(node.get('networkEndpoints') or []) > 1:
+            raise exceptions.NotSupportedError(
+                'Multi-host TPU slices cannot be stopped, only torn down.')
+        if node.get('state') in (tpu_api.STOPPED_STATES +
+                                 tpu_api.STOPPING_STATES):
+            continue
+        tpu.wait_operation(tpu.stop_node(node['name'].split('/')[-1]))
+    for inst in gce.list_cluster(cluster_name):
+        if inst.get('status') in compute_api.STOPPED_STATES:
+            continue
+        gce.wait_operation(gce.stop(inst['name']))
+
+
+def _teardown_tpu(tpu: tpu_api.TpuClient, cluster_name: str) -> None:
+    for qr in tpu.list_queued_resources(cluster_name):
+        try:
+            tpu.delete_queued_resource(qr['name'].split('/')[-1])
+        except rest.GcpApiError as e:
+            if e.status != 404:
+                raise
+    for node in tpu.list_nodes(cluster_name):
+        try:
+            tpu.wait_operation(
+                tpu.delete_node(node['name'].split('/')[-1]))
+        except rest.GcpApiError as e:
+            if e.status != 404:
+                raise
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    zone = _zone_of(provider_config)
+    tpu, gce = _clients(provider_config, zone)
+    _teardown_tpu(tpu, cluster_name)
+    ops = []
+    for inst in gce.list_cluster(cluster_name):
+        try:
+            ops.append(gce.delete(inst['name']))
+        except rest.GcpApiError as e:
+            if e.status != 404:
+                raise
+    for op in ops:
+        gce.wait_operation(op)
+
+
+def query_instances(cluster_name: str, provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    zone = _zone_of(provider_config)
+    tpu, gce = _clients(provider_config, zone)
+    out: Dict[str, Optional[str]] = {}
+    for node in tpu.list_nodes(cluster_name):
+        for info in tpu_api.node_instance_infos(node):
+            out[info['instance_id']] = _normalize(info['status'])
+    for inst in gce.list_cluster(cluster_name):
+        out[inst['name']] = _normalize(inst.get('status', 'UNKNOWN'))
+    return out
+
+
+def wait_instances(region: str, cluster_name: str, state: str) -> None:
+    # run_instances already waits on creation operations; nothing to poll.
+    del region, cluster_name, state
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
+    del region
+    zone = _zone_of(provider_config)
+    tpu, gce = _clients(provider_config, zone)
+    instances: Dict[str, common.InstanceInfo] = {}
+    head_id: Optional[str] = None
+    for node in sorted(tpu.list_nodes(cluster_name),
+                       key=lambda n: n.get('name', '')):
+        is_head_node = node.get('labels', {}).get(
+            tpu_api.HEAD_LABEL) == 'true'
+        for info_dict in tpu_api.node_instance_infos(node):
+            info = common.InstanceInfo(**info_dict)
+            info.status = _normalize(info.status)
+            instances[info.instance_id] = info
+            if is_head_node and info.host_index == 0 and head_id is None:
+                head_id = info.instance_id
+    for inst in gce.list_cluster(cluster_name):
+        info = common.InstanceInfo(**compute_api.vm_instance_info(inst))
+        info.status = _normalize(info.status)
+        instances[info.instance_id] = info
+        if inst.get('labels', {}).get(tpu_api.HEAD_LABEL) == 'true' and \
+                head_id is None:
+            head_id = info.instance_id
+    if not instances:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    if head_id is None:
+        head_id = sorted(instances)[0]
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head_id,
+        provider_name='gcp',
+        provider_config=dict(provider_config or {}),
+        ssh_user=provider_config.get('ssh_user', 'xsky'))

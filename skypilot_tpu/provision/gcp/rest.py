@@ -1,0 +1,169 @@
+"""Minimal GCP REST transport with pluggable auth — no cloud SDK needed.
+
+The reference wraps googleapiclient behind a lazy adaptor
+(sky/adaptors/gcp.py:104). Here the surface we need (TPU v2 + Compute v1)
+is small enough that a hand-rolled urllib client is simpler, fully
+testable (inject a fake transport), and dependency-free.
+
+Token sources, in order:
+  1. ``GCP_ACCESS_TOKEN`` env (tests / CI);
+  2. GCE/TPU-VM metadata server (when running inside GCP);
+  3. ``gcloud auth print-access-token`` subprocess (developer laptops).
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_METADATA_TOKEN_URL = ('http://metadata.google.internal/computeMetadata/v1/'
+                       'instance/service-accounts/default/token')
+
+_RETRYABLE_STATUS = (429, 500, 502, 503, 504)
+
+
+class GcpApiError(exceptions.ProvisionError):
+    """HTTP-level error from a GCP API, with parsed status/reason."""
+
+    def __init__(self, status: int, reason: str, message: str,
+                 body: Optional[Dict[str, Any]] = None) -> None:
+        super().__init__(f'GCP API error {status} ({reason}): {message}')
+        self.status = status
+        self.reason = reason
+        self.message = message
+        self.body = body or {}
+
+
+class TokenProvider:
+    """Caches an OAuth2 access token from the first working source."""
+
+    def __init__(self) -> None:
+        self._token: Optional[str] = None
+        self._expiry: float = 0.0
+
+    def token(self) -> str:
+        import os
+        env = os.environ.get('GCP_ACCESS_TOKEN')
+        if env:
+            return env
+        now = time.time()
+        if self._token and now < self._expiry - 60:
+            return self._token
+        tok, ttl = self._fetch()
+        self._token, self._expiry = tok, now + ttl
+        return tok
+
+    def _fetch(self) -> tuple:
+        try:
+            req = urllib.request.Request(
+                _METADATA_TOKEN_URL, headers={'Metadata-Flavor': 'Google'})
+            with urllib.request.urlopen(req, timeout=2) as resp:
+                data = json.loads(resp.read())
+                return data['access_token'], data.get('expires_in', 300)
+        except (urllib.error.URLError, OSError, KeyError, ValueError):
+            pass
+        try:
+            out = subprocess.run(['gcloud', 'auth', 'print-access-token'],
+                                 capture_output=True, text=True, timeout=30)
+            if out.returncode == 0 and out.stdout.strip():
+                return out.stdout.strip(), 300
+        except (OSError, subprocess.SubprocessError):
+            pass
+        raise exceptions.NoCloudAccessError(
+            'No GCP credentials: set GCP_ACCESS_TOKEN, run on GCE, or '
+            'install gcloud and run `gcloud auth login`.')
+
+
+class Transport:
+    """JSON-over-HTTP with auth header, retries, and error parsing.
+
+    Tests subclass/replace this with a scripted fake (see
+    tests/unit_tests/test_gcp_provisioner.py).
+    """
+
+    def __init__(self, token_provider: Optional[TokenProvider] = None,
+                 max_retries: int = 4) -> None:
+        self._tokens = token_provider or TokenProvider()
+        self._max_retries = max_retries
+
+    def request(self, method: str, url: str,
+                params: Optional[Dict[str, str]] = None,
+                body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        if params:
+            url = url + '?' + urllib.parse.urlencode(params)
+        payload = json.dumps(body).encode() if body is not None else None
+        last_err: Optional[Exception] = None
+        for attempt in range(self._max_retries + 1):
+            req = urllib.request.Request(
+                url, data=payload, method=method,
+                headers={
+                    'Authorization': f'Bearer {self._tokens.token()}',
+                    'Content-Type': 'application/json',
+                })
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    raw = resp.read()
+                    return json.loads(raw) if raw else {}
+            except urllib.error.HTTPError as e:
+                err = _parse_http_error(e)
+                if err.status in _RETRYABLE_STATUS and attempt < \
+                        self._max_retries:
+                    last_err = err
+                    time.sleep(min(2 ** attempt, 16))
+                    continue
+                raise err from None
+            except urllib.error.URLError as e:
+                last_err = e
+                if attempt < self._max_retries:
+                    time.sleep(min(2 ** attempt, 16))
+                    continue
+                raise exceptions.ProvisionError(
+                    f'GCP API unreachable: {e}') from e
+        raise exceptions.ProvisionError(f'GCP API retries exhausted: '
+                                        f'{last_err}')
+
+
+def _parse_http_error(e: 'urllib.error.HTTPError') -> GcpApiError:
+    try:
+        body = json.loads(e.read())
+        err = body.get('error', {})
+        reason = err.get('status', '') or str(err.get('code', e.code))
+        message = err.get('message', str(e))
+    except (ValueError, AttributeError):
+        body, reason, message = {}, str(e.code), str(e)
+    return GcpApiError(e.code, reason, message, body)
+
+
+def classify_error(err: GcpApiError, zone: str) -> Exception:
+    """Map a GCP API error onto the failover taxonomy.
+
+    Twin of FailoverCloudErrorHandlerV2._gcp_handler
+    (sky/backends/cloud_vm_ray_backend.py:908) — but classification lives
+    next to the API client instead of string-matching in the backend.
+    """
+    msg = err.message.lower()
+    if err.status == 429 or 'resource_exhausted' in err.reason.lower() or \
+            'no more capacity' in msg or 'stockout' in msg or \
+            'resources required' in msg and 'unavailable' in msg or \
+            'not enough resources' in msg:
+        return exceptions.CapacityError(
+            f'Out of capacity in {zone}: {err.message}')
+    if 'quota' in msg or err.reason == 'QUOTA_EXCEEDED':
+        return exceptions.QuotaExceededError(
+            f'Quota exceeded in {zone}: {err.message}')
+    if err.status in (401, 403):
+        return exceptions.PermissionError_(
+            f'Permission denied in {zone}: {err.message}')
+    if err.status == 400 or err.status == 404:
+        return exceptions.InvalidRequestError(
+            f'Invalid request in {zone}: {err.message}')
+    return exceptions.ProvisionError(f'{zone}: {err.message}')

@@ -1,0 +1,277 @@
+"""GCP provisioner tests against an in-memory fake of the GCP REST APIs.
+
+Plays the role moto plays in the reference's failover tests
+(tests/test_failover.py:34-60): scripted capacity errors, no network.
+"""
+from __future__ import annotations
+
+import re
+import urllib.parse
+from typing import Any, Dict, Optional
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import instance as gcp_instance
+from skypilot_tpu.provision.gcp import rest
+from skypilot_tpu.provision.gcp import tpu_api
+
+
+class FakeGcp:
+    """Minimal in-memory TPU v2 + Compute v1 API."""
+
+    def __init__(self) -> None:
+        self.tpu_nodes: Dict[str, Dict[str, Any]] = {}
+        self.vms: Dict[str, Dict[str, Any]] = {}
+        self.queued: Dict[str, Dict[str, Any]] = {}
+        self.fail_create: Optional[rest.GcpApiError] = None
+        self.qr_states: list = []     # scripted QR state sequence
+        self.num_hosts = 1
+
+    # Transport interface ---------------------------------------------------
+
+    def request(self, method: str, url: str,
+                params: Optional[Dict[str, str]] = None,
+                body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        path = urllib.parse.urlparse(url).path
+        if 'tpu.googleapis.com' in url:
+            return self._tpu(method, path, params or {}, body)
+        return self._compute(method, path, params or {}, body)
+
+    # TPU -------------------------------------------------------------------
+
+    def _tpu(self, method, path, params, body):
+        m = re.search(r'/nodes/([^/:]+):(\w+)$', path)
+        if m:
+            node = self.tpu_nodes[m.group(1)]
+            node['state'] = 'READY' if m.group(2) == 'start' else 'STOPPED'
+            return {'name': 'operations/op-x', 'done': True}
+        m = re.search(r'/nodes/([^/]+)$', path)
+        if m and method == 'GET':
+            return self.tpu_nodes[m.group(1)]
+        if m and method == 'DELETE':
+            self.tpu_nodes.pop(m.group(1), None)
+            return {'name': 'operations/op-del', 'done': True}
+        if path.endswith('/nodes') and method == 'GET':
+            return {'nodes': list(self.tpu_nodes.values())}
+        if path.endswith('/nodes') and method == 'POST':
+            if self.fail_create is not None:
+                err, self.fail_create = self.fail_create, None
+                raise err
+            node_id = params['nodeId']
+            self._make_node(node_id, body)
+            return {'name': f'operations/create-{node_id}', 'done': True}
+        m = re.search(r'/queuedResources/([^/]+)$', path)
+        if m and method == 'GET':
+            qr = self.queued[m.group(1)]
+            if self.qr_states:
+                qr['state'] = {'state': self.qr_states.pop(0)}
+                if qr['state']['state'] == 'ACTIVE':
+                    self._materialize_qr(m.group(1), qr)
+            return qr
+        if m and method == 'DELETE':
+            self.queued.pop(m.group(1), None)
+            return {'name': 'operations/qr-del', 'done': True}
+        if path.endswith('/queuedResources') and method == 'GET':
+            return {'queuedResources': list(self.queued.values())}
+        if path.endswith('/queuedResources') and method == 'POST':
+            if self.fail_create is not None:
+                err, self.fail_create = self.fail_create, None
+                raise err
+            qr_id = params['queuedResourceId']
+            self.queued[qr_id] = dict(
+                body, name=f'projects/p/locations/z/queuedResources/{qr_id}',
+                state={'state': 'ACCEPTED'})
+            return {'name': f'operations/qr-{qr_id}', 'done': True}
+        if '/operations/' in path:
+            return {'name': path.split('/v2/')[-1], 'done': True}
+        raise AssertionError(f'unhandled TPU call {method} {path}')
+
+    def _make_node(self, node_id: str, body: Dict[str, Any]) -> None:
+        endpoints = []
+        for h in range(self.num_hosts):
+            endpoints.append({
+                'ipAddress': f'10.1.0.{len(self.tpu_nodes) * 8 + h + 1}',
+                'accessConfig': {
+                    'externalIp': f'34.1.0.{len(self.tpu_nodes) * 8 + h + 1}'
+                },
+            })
+        self.tpu_nodes[node_id] = {
+            'name': f'projects/p/locations/z/nodes/{node_id}',
+            'state': 'READY',
+            'labels': dict(body.get('labels', {})),
+            'networkEndpoints': endpoints,
+        }
+
+    def _materialize_qr(self, qr_id: str, qr: Dict[str, Any]) -> None:
+        spec = qr['tpu']['nodeSpec'][0]
+        multi = spec.get('multiNodeParams')
+        labels = spec['node'].get('labels', {})
+        count = multi['nodeCount'] if multi else 1
+        for i in range(count):
+            node_id = f'{qr_id}-{i}' if multi else spec['nodeId']
+            if node_id not in self.tpu_nodes:
+                self._make_node(node_id, {'labels': labels})
+
+    # Compute ---------------------------------------------------------------
+
+    def _compute(self, method, path, params, body):
+        if path.endswith('/instances') and method == 'POST':
+            if self.fail_create is not None:
+                err, self.fail_create = self.fail_create, None
+                raise err
+            name = body['name']
+            self.vms[name] = {
+                'name': name,
+                'status': 'RUNNING',
+                'labels': dict(body.get('labels', {})),
+                'networkInterfaces': [{
+                    'networkIP': f'10.2.0.{len(self.vms) + 1}',
+                    'accessConfigs': [{'natIP':
+                                       f'35.2.0.{len(self.vms) + 1}'}],
+                }],
+            }
+            return {'name': f'insert-{name}'}
+        if path.endswith('/instances') and method == 'GET':
+            flt = params.get('filter', '')
+            m = re.search(r'labels\.(\S+)=(\S+)', flt)
+            items = list(self.vms.values())
+            if m:
+                items = [i for i in items
+                         if i['labels'].get(m.group(1)) == m.group(2)]
+            return {'items': items}
+        m = re.search(r'/instances/([^/]+)/(stop|start)$', path)
+        if m:
+            self.vms[m.group(1)]['status'] = (
+                'TERMINATED' if m.group(2) == 'stop' else 'RUNNING')
+            return {'name': f'{m.group(2)}-{m.group(1)}'}
+        m = re.search(r'/instances/([^/]+)$', path)
+        if m and method == 'DELETE':
+            self.vms.pop(m.group(1), None)
+            return {'name': f'del-{m.group(1)}'}
+        if '/operations/' in path:
+            return {'status': 'DONE'}
+        raise AssertionError(f'unhandled compute call {method} {path}')
+
+
+@pytest.fixture()
+def fake_gcp(monkeypatch):
+    fake = FakeGcp()
+    monkeypatch.setattr(gcp_instance, '_transport_factory', lambda: fake)
+    yield fake
+
+
+PROVIDER = {'project_id': 'p', 'zone': 'us-central2-b'}
+
+
+def _tpu_config(num_hosts=1, num_slices=1, use_qr=False, count=1):
+    return common.ProvisionConfig(
+        provider_config=dict(PROVIDER),
+        node_config={
+            'tpu_vm': True,
+            'tpu_accelerator_type': 'v5p-8',
+            'tpu_runtime_version': 'v2-alpha-tpuv5',
+            'tpu_num_slices': num_slices,
+            'tpu_use_queued_resources': use_qr,
+            'provision_timeout_s': 1,
+            'qr_poll_interval_s': 0.01,
+        },
+        count=count)
+
+
+def test_tpu_create_multihost(fake_gcp):
+    fake_gcp.num_hosts = 4
+    record = gcp_instance.run_instances('us-central2', 'us-central2-b',
+                                        'c1', _tpu_config())
+    assert record.created_instance_ids == ['c1-0']
+    info = gcp_instance.get_cluster_info('us-central2', 'c1', PROVIDER)
+    assert info.num_instances == 4
+    hosts = info.sorted_instances()
+    assert info.head_instance_id == 'c1-0-host0'
+    assert [h.host_index for h in hosts] == [0, 1, 2, 3]
+    assert all(h.slice_id == 'c1-0' for h in hosts)
+    assert all(h.status == 'RUNNING' for h in hosts)
+    statuses = gcp_instance.query_instances('c1', PROVIDER)
+    assert set(statuses.values()) == {'RUNNING'}
+
+
+def test_tpu_capacity_error_classified(fake_gcp):
+    fake_gcp.fail_create = rest.GcpApiError(
+        429, 'RESOURCE_EXHAUSTED', 'There is no more capacity in the zone')
+    with pytest.raises(exceptions.CapacityError):
+        gcp_instance.run_instances('us-central2', 'us-central2-b', 'c2',
+                                   _tpu_config())
+
+
+def test_tpu_quota_error_classified(fake_gcp):
+    fake_gcp.fail_create = rest.GcpApiError(
+        403, 'PERMISSION_DENIED', 'Quota limit TPUV5sPodPerProjectPerZone')
+    with pytest.raises(exceptions.QuotaExceededError):
+        gcp_instance.run_instances('us-central2', 'us-central2-b', 'c3',
+                                   _tpu_config())
+
+
+def test_queued_resource_multislice(fake_gcp):
+    fake_gcp.num_hosts = 2
+    fake_gcp.qr_states = ['ACCEPTED', 'PROVISIONING', 'ACTIVE']
+    record = gcp_instance.run_instances(
+        'us-central2', 'us-central2-b', 'ms',
+        _tpu_config(num_slices=2, use_qr=True))
+    assert sorted(record.created_instance_ids) == ['ms-0', 'ms-1']
+    info = gcp_instance.get_cluster_info('us-central2', 'ms', PROVIDER)
+    # 2 slices × 2 hosts.
+    assert info.num_instances == 4
+    slices = {h.slice_id for h in info.sorted_instances()}
+    assert slices == {'ms-0', 'ms-1'}
+
+
+def test_queued_resource_timeout(fake_gcp):
+    fake_gcp.qr_states = ['ACCEPTED'] * 1000
+    with pytest.raises(exceptions.QueuedResourceTimeoutError):
+        gcp_instance.run_instances('us-central2', 'us-central2-b', 'qt',
+                                   _tpu_config(use_qr=True))
+    assert not fake_gcp.queued  # rolled back
+
+
+def test_queued_resource_failed_is_capacity(fake_gcp):
+    fake_gcp.qr_states = ['ACCEPTED', 'FAILED']
+    with pytest.raises(exceptions.CapacityError):
+        gcp_instance.run_instances('us-central2', 'us-central2-b', 'qf',
+                                   _tpu_config(use_qr=True))
+
+
+def test_vm_lifecycle(fake_gcp):
+    cfg = common.ProvisionConfig(
+        provider_config=dict(PROVIDER),
+        node_config={'instance_type': 'n2-standard-8'}, count=2)
+    record = gcp_instance.run_instances('us-central2', 'us-central2-b',
+                                        'ctrl', cfg)
+    assert sorted(record.created_instance_ids) == ['ctrl-0', 'ctrl-1']
+    assert record.head_instance_id == 'ctrl-0'
+    gcp_instance.stop_instances('ctrl', PROVIDER)
+    statuses = gcp_instance.query_instances('ctrl', PROVIDER)
+    assert set(statuses.values()) == {'STOPPED'}
+    # resume
+    record2 = gcp_instance.run_instances('us-central2', 'us-central2-b',
+                                         'ctrl', cfg)
+    assert sorted(record2.resumed_instance_ids) == ['ctrl-0', 'ctrl-1']
+    gcp_instance.terminate_instances('ctrl', PROVIDER)
+    assert gcp_instance.query_instances('ctrl', PROVIDER) == {}
+
+
+def test_multihost_tpu_stop_rejected(fake_gcp):
+    fake_gcp.num_hosts = 2
+    gcp_instance.run_instances('us-central2', 'us-central2-b', 'pod',
+                               _tpu_config())
+    with pytest.raises(exceptions.NotSupportedError):
+        gcp_instance.stop_instances('pod', PROVIDER)
+
+
+def test_tpu_terminate_idempotent(fake_gcp):
+    gcp_instance.run_instances('us-central2', 'us-central2-b', 'gone',
+                               _tpu_config())
+    gcp_instance.terminate_instances('gone', PROVIDER)
+    gcp_instance.terminate_instances('gone', PROVIDER)  # no raise
+    with pytest.raises(exceptions.ClusterDoesNotExist):
+        gcp_instance.get_cluster_info('us-central2', 'gone', PROVIDER)
