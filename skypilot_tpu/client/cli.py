@@ -398,6 +398,43 @@ def api_start(host, port, foreground):
         click.echo(f'API server starting at http://{host}:{port}')
 
 
+@cli.group()
+def storage():
+    """Object-storage management (twin of `sky storage`)."""
+
+
+@storage.command(name='ls')
+def storage_ls():
+    from skypilot_tpu.client import sdk
+    records = sdk.storage_ls()
+    if not records:
+        click.echo('No storage.')
+        return
+    click.echo(f'{"NAME":<28}{"STATUS":<16}{"STORES":<20}')
+    for r in records:
+        stores = ','.join(r['stores']) or '-'
+        click.echo(f'{r["name"]:<28}{r["status"]:<16}{stores:<20}')
+
+
+@storage.command(name='delete')
+@click.argument('names', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def storage_delete(names, yes):
+    from skypilot_tpu import exceptions as exc
+    from skypilot_tpu.client import sdk
+    for name in names:
+        if not yes and not click.confirm(
+                f'Delete storage {name!r} and its managed bucket(s)?'):
+            click.echo(f'Skipped {name}.')
+            continue
+        try:
+            sdk.storage_delete(name)
+        except exc.StorageError as e:
+            click.echo(str(e))
+            continue
+        click.echo(f'Storage {name} deleted.')
+
+
 def main() -> None:
     cli()
 

@@ -107,6 +107,13 @@ class RemoteClient:
     def check(self, quiet=False):
         return self._call('check', {})
 
+    def storage_ls(self):
+        return self._call('storage.ls', {})
+
+    def storage_delete(self, storage_name):
+        return self._call('storage.delete',
+                          {'storage_name': storage_name})
+
     def cost_report(self):
         return self._call('cost_report', {})
 

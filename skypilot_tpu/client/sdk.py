@@ -130,6 +130,14 @@ def cost_report() -> List[Dict[str, Any]]:
     return _local_or_remote('cost_report')
 
 
+def storage_ls() -> List[Dict[str, Any]]:
+    return _local_or_remote('storage_ls')
+
+
+def storage_delete(storage_name: str) -> None:
+    return _local_or_remote('storage_delete', storage_name)
+
+
 # ---- managed jobs ----------------------------------------------------------
 
 

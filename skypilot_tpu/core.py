@@ -211,3 +211,26 @@ def cost_report() -> List[Dict[str, Any]]:
             'total_cost': rate * hours,
         })
     return out
+
+
+def storage_ls() -> List[Dict[str, Any]]:
+    """Twin of sky storage ls (server-side)."""
+    out = []
+    for record in state.get_storage():
+        handle = record['handle'] or {}
+        out.append({
+            'name': record['name'],
+            'status': record['status'].value,
+            'stores': sorted((handle.get('stores') or {}).keys()),
+            'source': handle.get('source'),
+        })
+    return out
+
+
+def storage_delete(storage_name: str) -> None:
+    """Delete one storage (managed buckets removed; external kept)."""
+    from skypilot_tpu.data import storage as storage_lib
+    record = state.get_storage_from_name(storage_name)
+    if record is None:
+        raise exceptions.StorageError(f'Storage {storage_name!r} not found.')
+    storage_lib.Storage.from_handle(record['handle']).delete()

@@ -1,0 +1,103 @@
+"""FUSE mount command builders (twin of sky/data/mounting_utils.py).
+
+Each builder returns a shell command that installs the FUSE tool if absent
+and mounts a bucket at a path. MOUNT_CACHED uses rclone vfs-cache like the
+reference; plain MOUNT uses the bucket-native FUSE adapter (gcsfuse for
+GCS, goofys for S3-compatible). On GKE, unprivileged pods route fusermount
+through the fuse-proxy (addons/fuse_proxy, C++ twin of the reference's Go
+shim).
+"""
+from __future__ import annotations
+
+import shlex
+
+GCSFUSE_VERSION = '2.4.0'
+GOOFYS_VERSION = '0.24.0'
+RCLONE_VERSION = '1.68.1'
+
+_INSTALL_DIR = '~/.xsky/bin'
+
+
+def _install_gcsfuse() -> str:
+    return (f'mkdir -p {_INSTALL_DIR} && '
+            f'command -v gcsfuse >/dev/null || '
+            f'(ARCH=$(uname -m | sed "s/x86_64/amd64/;s/aarch64/arm64/"); '
+            f'curl -fsSL -o /tmp/gcsfuse.deb '
+            f'https://github.com/GoogleCloudPlatform/gcsfuse/releases/'
+            f'download/v{GCSFUSE_VERSION}/gcsfuse_{GCSFUSE_VERSION}_'
+            f'$ARCH.deb && sudo dpkg -i /tmp/gcsfuse.deb)')
+
+
+def _install_goofys() -> str:
+    return (f'mkdir -p {_INSTALL_DIR} && '
+            f'command -v goofys >/dev/null || '
+            f'(curl -fsSL -o {_INSTALL_DIR}/goofys '
+            f'https://github.com/kahing/goofys/releases/download/'
+            f'v{GOOFYS_VERSION}/goofys && chmod +x {_INSTALL_DIR}/goofys '
+            f'&& sudo ln -sf {_INSTALL_DIR}/goofys /usr/local/bin/goofys)')
+
+
+def _install_rclone() -> str:
+    return ('command -v rclone >/dev/null || '
+            '(curl -fsSL https://rclone.org/install.sh | sudo bash)')
+
+
+def _premount(mount_path: str) -> str:
+    q = shlex.quote(mount_path)
+    return (f'sudo mkdir -p {q} && sudo chown $(id -u):$(id -g) {q} && '
+            f'(mountpoint -q {q} && sudo umount -l {q} || true)')
+
+
+def gcs_mount_command(bucket: str, mount_path: str,
+                      sub_path: str = '') -> str:
+    only_dir = f' --only-dir {shlex.quote(sub_path)}' if sub_path else ''
+    return (f'{_install_gcsfuse()} && {_premount(mount_path)} && '
+            f'gcsfuse --implicit-dirs{only_dir} '
+            f'{shlex.quote(bucket)} {shlex.quote(mount_path)}')
+
+
+def s3_mount_command(bucket: str, mount_path: str,
+                     endpoint_url: str = '') -> str:
+    endpoint = f' --endpoint {shlex.quote(endpoint_url)}' if endpoint_url \
+        else ''
+    return (f'{_install_goofys()} && {_premount(mount_path)} && '
+            f'goofys{endpoint} {shlex.quote(bucket)} '
+            f'{shlex.quote(mount_path)}')
+
+
+def _rclone_remote_config(remote: str, endpoint_url: str = '') -> str:
+    """Idempotently create the named rclone remote on the host."""
+    if remote == 'xsky-gcs':
+        return (f'rclone config create {remote} '
+                f'"google cloud storage" env_auth true >/dev/null')
+    args = f'rclone config create {remote} s3 env_auth true'
+    if endpoint_url:
+        args += f' endpoint {shlex.quote(endpoint_url)}'
+    return f'{args} >/dev/null'
+
+
+def rclone_mount_cached_command(remote: str, bucket: str, mount_path: str,
+                                endpoint_url: str = '') -> str:
+    """MOUNT_CACHED: rclone VFS full-cache (writes buffered locally)."""
+    cache = '~/.xsky/rclone-cache'
+    return (f'{_install_rclone()} && '
+            f'{_rclone_remote_config(remote, endpoint_url)} && '
+            f'{_premount(mount_path)} && '
+            f'mkdir -p {cache} && '
+            f'rclone mount {remote}:{shlex.quote(bucket)} '
+            f'{shlex.quote(mount_path)} --daemon --vfs-cache-mode full '
+            f'--cache-dir {cache} --allow-other --dir-cache-time 10s')
+
+
+def local_mount_command(source_dir: str, mount_path: str) -> str:
+    """Fake-cloud 'mount': symlink a host directory (tests / local dev)."""
+    src = shlex.quote(source_dir)
+    tgt = shlex.quote(mount_path)
+    return (f'mkdir -p {src} && mkdir -p $(dirname {tgt}) && '
+            f'rm -rf {tgt} && ln -s {src} {tgt}')
+
+
+def umount_command(mount_path: str) -> str:
+    q = shlex.quote(mount_path)
+    return (f'(mountpoint -q {q} && sudo umount -l {q}) || '
+            f'(test -L {q} && rm {q}) || true')

@@ -1,0 +1,437 @@
+"""Object-storage orchestration (twin of sky/data/storage.py, 5,111 LoC).
+
+Redesign notes vs the reference:
+  * Stores share one small ABC; bucket IO goes through each store's CLI
+    (gcloud storage / aws s3) rather than SDKs, so no cloud SDK is a hard
+    dependency (the reference mixes SDK + CLI).
+  * A ``LocalStore`` ("file://" scheme, a plain directory) is first-class —
+    it lets COPY/MOUNT be exercised end-to-end against the fake cloud with
+    zero network, the harness the reference lacks (SURVEY §4.5).
+
+Modes (reference: sky/data/storage.py:266):
+  COPY          — bucket contents copied onto cluster disk at mount path.
+  MOUNT         — FUSE mount; writes stream back to the bucket.
+  MOUNT_CACHED  — rclone VFS cache; fast local writes, async upload.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import re
+import shlex
+import subprocess
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import state
+from skypilot_tpu.data import mounting_utils
+
+logger = sky_logging.init_logger(__name__)
+
+
+class StorageMode(enum.Enum):
+    COPY = 'COPY'
+    MOUNT = 'MOUNT'
+    MOUNT_CACHED = 'MOUNT_CACHED'
+
+
+class StoreType(enum.Enum):
+    GCS = 'GCS'
+    S3 = 'S3'
+    R2 = 'R2'
+    LOCAL = 'LOCAL'
+
+    @classmethod
+    def from_url(cls, url: str) -> Tuple['StoreType', str]:
+        """('gs://b/path') → (GCS, 'b/path')."""
+        for scheme, st in (('gs://', cls.GCS), ('s3://', cls.S3),
+                           ('r2://', cls.R2), ('file://', cls.LOCAL)):
+            if url.startswith(scheme):
+                return st, url[len(scheme):]
+        raise exceptions.StorageSpecError(
+            f'Unknown storage URL scheme: {url!r} (expected gs://, s3://, '
+            f'r2://, or file://).')
+
+    def url(self, bucket: str) -> str:
+        scheme = {StoreType.GCS: 'gs', StoreType.S3: 's3',
+                  StoreType.R2: 'r2', StoreType.LOCAL: 'file'}[self]
+        return f'{scheme}://{bucket}'
+
+
+_BUCKET_NAME_RE = re.compile(r'^[a-z0-9][a-z0-9._-]{1,253}[a-z0-9]$')
+
+
+def _run(cmd: str) -> None:
+    proc = subprocess.run(cmd, shell=True, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise exceptions.StorageUploadError(
+            f'Command failed ({proc.returncode}): {cmd}\n{proc.stderr}')
+
+
+class AbstractStore:
+    """One bucket in one object store."""
+
+    store_type: StoreType
+
+    def __init__(self, name: str, source: Optional[str] = None,
+                 region: Optional[str] = None) -> None:
+        if self.store_type != StoreType.LOCAL and \
+                not _BUCKET_NAME_RE.match(name.split('/')[0]):
+            raise exceptions.StorageNameError(
+                f'Invalid bucket name: {name!r}')
+        self.name = name
+        self.source = source
+        self.region = region
+
+    # lifecycle
+    def exists(self) -> bool:
+        raise NotImplementedError
+
+    def create(self) -> None:
+        raise NotImplementedError
+
+    def upload(self) -> None:
+        """Sync self.source (a local dir/file) into the bucket."""
+        raise NotImplementedError
+
+    def delete(self) -> None:
+        raise NotImplementedError
+
+    # cluster-side commands
+    def mount_command(self, mount_path: str) -> str:
+        raise NotImplementedError
+
+    def copy_download_command(self, dest_path: str) -> str:
+        """Shell command run ON THE CLUSTER to copy bucket → dest."""
+        raise NotImplementedError
+
+    def url(self) -> str:
+        return self.store_type.url(self.name)
+
+
+class GcsStore(AbstractStore):
+    """GCS via `gcloud storage` CLI; mounts via gcsfuse."""
+    store_type = StoreType.GCS
+
+    def exists(self) -> bool:
+        return subprocess.run(
+            f'gcloud storage buckets describe gs://{self.name}',
+            shell=True, capture_output=True).returncode == 0
+
+    def create(self) -> None:
+        loc = f' --location={self.region}' if self.region else ''
+        _run(f'gcloud storage buckets create gs://{self.name}{loc}')
+
+    def upload(self) -> None:
+        src = shlex.quote(os.path.expanduser(self.source or '.'))
+        _run(f'gcloud storage rsync -r {src} gs://{self.name}')
+
+    def delete(self) -> None:
+        _run(f'gcloud storage rm -r gs://{self.name}')
+
+    def mount_command(self, mount_path: str) -> str:
+        bucket, _, sub = self.name.partition('/')
+        return mounting_utils.gcs_mount_command(bucket, mount_path, sub)
+
+    def copy_download_command(self, dest_path: str) -> str:
+        q = shlex.quote(dest_path)
+        return (f'mkdir -p {q} && gcloud storage rsync -r '
+                f'gs://{self.name} {q}')
+
+
+class S3Store(AbstractStore):
+    """S3 via aws CLI; mounts via goofys."""
+    store_type = StoreType.S3
+    endpoint_url = ''
+
+    def _ep(self) -> str:
+        return (f' --endpoint-url {self.endpoint_url}'
+                if self.endpoint_url else '')
+
+    def exists(self) -> bool:
+        return subprocess.run(
+            f'aws s3api head-bucket --bucket {self.name}{self._ep()}',
+            shell=True, capture_output=True).returncode == 0
+
+    def create(self) -> None:
+        region = f' --region {self.region}' if self.region else ''
+        _run(f'aws s3 mb s3://{self.name}{region}{self._ep()}')
+
+    def upload(self) -> None:
+        src = shlex.quote(os.path.expanduser(self.source or '.'))
+        _run(f'aws s3 sync {src} s3://{self.name}{self._ep()}')
+
+    def delete(self) -> None:
+        _run(f'aws s3 rb s3://{self.name} --force{self._ep()}')
+
+    def mount_command(self, mount_path: str) -> str:
+        return mounting_utils.s3_mount_command(self.name, mount_path,
+                                               self.endpoint_url)
+
+    def copy_download_command(self, dest_path: str) -> str:
+        q = shlex.quote(dest_path)
+        return f'mkdir -p {q} && aws s3 sync s3://{self.name} {q}{self._ep()}'
+
+
+class R2Store(S3Store):
+    """Cloudflare R2: S3 API against the R2 endpoint."""
+    store_type = StoreType.R2
+
+    def __init__(self, name: str, source: Optional[str] = None,
+                 region: Optional[str] = None) -> None:
+        super().__init__(name, source, region)
+        account = os.environ.get('R2_ACCOUNT_ID', '')
+        self.endpoint_url = (
+            f'https://{account}.r2.cloudflarestorage.com' if account else '')
+
+
+class LocalStore(AbstractStore):
+    """A directory standing in for a bucket (file:// scheme).
+
+    Backs fake-cloud end-to-end tests of COPY/MOUNT and doubles as a
+    shared-filesystem store for BYO clusters.
+    """
+    store_type = StoreType.LOCAL
+
+    def _root(self) -> str:
+        base = os.path.expanduser(
+            os.environ.get('XSKY_LOCAL_STORE_DIR', '~/.xsky/local_store'))
+        return os.path.join(base, self.name)
+
+    def exists(self) -> bool:
+        return os.path.isdir(self._root())
+
+    def create(self) -> None:
+        os.makedirs(self._root(), exist_ok=True)
+
+    def upload(self) -> None:
+        self.create()
+        src = os.path.expanduser(self.source or '.')
+        if os.path.isdir(src):
+            src = os.path.join(src, '.')
+        _run(f'cp -a {shlex.quote(src)} {shlex.quote(self._root())}/')
+
+    def delete(self) -> None:
+        _run(f'rm -rf {shlex.quote(self._root())}')
+
+    def mount_command(self, mount_path: str) -> str:
+        return mounting_utils.local_mount_command(self._root(), mount_path)
+
+    def copy_download_command(self, dest_path: str) -> str:
+        q = shlex.quote(dest_path)
+        return (f'mkdir -p {q} && cp -a '
+                f'{shlex.quote(self._root())}/. {q}/')
+
+
+_STORE_CLASSES = {
+    StoreType.GCS: GcsStore,
+    StoreType.S3: S3Store,
+    StoreType.R2: R2Store,
+    StoreType.LOCAL: LocalStore,
+}
+
+
+class Storage:
+    """User-facing storage object: a named dataset in ≥1 stores.
+
+    YAML form (twin of reference file_mounts storage entries,
+    sky/data/storage.py:520):
+
+        file_mounts:
+          /data:
+            name: my-dataset
+            source: ~/datasets/imagenet     # local path or gs://bucket
+            store: gcs                      # optional; inferred from source
+            mode: MOUNT                     # COPY | MOUNT | MOUNT_CACHED
+            persistent: true
+    """
+
+    def __init__(self, name: Optional[str] = None,
+                 source: Optional[str] = None,
+                 mode: StorageMode = StorageMode.MOUNT,
+                 persistent: bool = True) -> None:
+        if not name and not source:
+            raise exceptions.StorageSpecError(
+                'Storage needs a name or a source.')
+        self.source = source
+        self.mode = mode
+        self.persistent = persistent
+        self.stores: Dict[StoreType, AbstractStore] = {}
+        # Buckets this Storage actually created (vs pre-existing/external
+        # buckets, which delete() must never destroy — reference
+        # distinguishes sky-managed from external stores the same way).
+        self.created_buckets: set = set()
+
+        self._source_is_bucket = False
+        if source and '://' in source:
+            st, bucket = StoreType.from_url(source)
+            self._source_is_bucket = True
+            self.name = name or bucket.split('/')[0]
+            self.add_store(st, bucket_name=bucket)
+        else:
+            if source is not None:
+                expanded = os.path.expanduser(source)
+                if not os.path.isabs(expanded) and not \
+                        os.path.exists(expanded):
+                    raise exceptions.StorageSpecError(
+                        f'Storage source {source!r} not found locally and '
+                        'not a bucket URL.')
+            self.name = name or (os.path.basename(
+                os.path.abspath(os.path.expanduser(source))).lower()
+                if source else None)
+
+    # ---- stores ----
+
+    def add_store(self, store_type: StoreType,
+                  bucket_name: Optional[str] = None,
+                  region: Optional[str] = None) -> AbstractStore:
+        if isinstance(store_type, str):
+            store_type = StoreType[store_type.upper()]
+        if store_type in self.stores:
+            return self.stores[store_type]
+        cls = _STORE_CLASSES[store_type]
+        store = cls(bucket_name or self.name,
+                    source=None if self._source_is_bucket else self.source,
+                    region=region)
+        self.stores[store_type] = store
+        return store
+
+    def sync_all_stores(self) -> None:
+        """Create buckets and upload the local source (if any)."""
+        if not self.stores and self.source is not None:
+            self.add_store(_default_store_type())
+        for store in self.stores.values():
+            if not store.exists():
+                store.create()
+                self.created_buckets.add(store.store_type.value)
+            if store.source and not self._source_is_bucket:
+                logger.info(f'Uploading {store.source} → {store.url()}')
+                store.upload()
+        state.add_or_update_storage(self.name, self.handle(),
+                                    state.StorageStatus.READY)
+
+    def delete(self) -> None:
+        """Delete managed buckets; leave external (pre-existing) ones.
+
+        A bucket is deleted only if this Storage created it; buckets the
+        user pointed at (gs:// source, or pre-existing names) are only
+        deregistered.
+        """
+        for store in self.stores.values():
+            if store.store_type.value in self.created_buckets:
+                store.delete()
+            else:
+                logger.info(
+                    f'Skipping deletion of external bucket {store.url()} '
+                    '(not created by this tool); deregistering only.')
+        state.remove_storage(self.name)
+
+    # ---- cluster-side ----
+
+    def primary_store(self) -> AbstractStore:
+        if not self.stores:
+            raise exceptions.StorageSpecError(
+                f'Storage {self.name} has no stores; call add_store().')
+        return next(iter(self.stores.values()))
+
+    def cluster_command(self, mount_path: str) -> str:
+        """The command each host runs to realize this mount."""
+        store = self.primary_store()
+        if self.mode == StorageMode.COPY:
+            return store.copy_download_command(mount_path)
+        if self.mode == StorageMode.MOUNT_CACHED:
+            if store.store_type == StoreType.LOCAL:
+                return store.mount_command(mount_path)
+            remote = {'GCS': 'xsky-gcs', 'S3': 'xsky-s3',
+                      'R2': 'xsky-r2'}[store.store_type.value]
+            endpoint = getattr(store, 'endpoint_url', '')
+            return mounting_utils.rclone_mount_cached_command(
+                remote, store.name, mount_path, endpoint)
+        return store.mount_command(mount_path)
+
+    # ---- (de)serialization ----
+
+    def handle(self) -> Dict[str, Any]:
+        return {
+            'name': self.name,
+            'source': self.source,
+            'mode': self.mode.value,
+            'persistent': self.persistent,
+            'stores': {st.value: s.name for st, s in self.stores.items()},
+            'created_buckets': sorted(self.created_buckets),
+        }
+
+    @classmethod
+    def from_handle(cls, handle: Dict[str, Any]) -> 'Storage':
+        storage = cls(name=handle['name'], source=handle.get('source'),
+                      mode=StorageMode(handle.get('mode', 'MOUNT')),
+                      persistent=handle.get('persistent', True))
+        for st_name, bucket in handle.get('stores', {}).items():
+            storage.add_store(StoreType[st_name], bucket_name=bucket)
+        storage.created_buckets = set(handle.get('created_buckets', []))
+        return storage
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'Storage':
+        config = dict(config)
+        mode_str = str(config.pop('mode', 'MOUNT')).upper()
+        try:
+            mode = StorageMode[mode_str]
+        except KeyError:
+            raise exceptions.StorageModeError(
+                f'Invalid storage mode {mode_str!r}; expected one of '
+                f'{[m.name for m in StorageMode]}.') from None
+        storage = cls(name=config.pop('name', None),
+                      source=config.pop('source', None),
+                      mode=mode,
+                      persistent=config.pop('persistent', True))
+        store = config.pop('store', None)
+        if store is not None:
+            storage.add_store(StoreType[str(store).upper()])
+        elif not storage.stores and storage.source is not None:
+            storage.add_store(_default_store_type())
+        if config:
+            raise exceptions.StorageSpecError(
+                f'Unknown storage fields: {list(config)}')
+        return storage
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {'name': self.name}
+        if self.source:
+            out['source'] = self.source
+        out['mode'] = self.mode.value
+        if self.stores:
+            out['store'] = self.primary_store().store_type.value.lower()
+        return out
+
+
+def _default_store_type() -> StoreType:
+    if os.environ.get('XSKY_ENABLE_FAKE_CLOUD'):
+        return StoreType.LOCAL
+    return StoreType.GCS
+
+
+def storage_mounts_from_file_mounts(
+        file_mounts: Optional[Dict[str, Any]]
+) -> Tuple[Dict[str, str], Dict[str, Storage]]:
+    """Split task file_mounts into plain (str→str) and storage entries.
+
+    Reference behavior: Task.set_file_mounts accepts str targets only;
+    dict-valued entries become Storage mounts
+    (sky/task.py:994,1200).
+    """
+    plain: Dict[str, str] = {}
+    storages: Dict[str, Storage] = {}
+    for target, value in (file_mounts or {}).items():
+        if isinstance(value, str) and '://' in value:
+            storages[target] = Storage(source=value, mode=StorageMode.COPY)
+        elif isinstance(value, str):
+            plain[target] = value
+        elif isinstance(value, dict):
+            storages[target] = Storage.from_yaml_config(value)
+        else:
+            raise exceptions.StorageSpecError(
+                f'file_mounts[{target!r}] must be a path, URL, or '
+                f'storage spec dict; got {type(value).__name__}')
+    return plain, storages

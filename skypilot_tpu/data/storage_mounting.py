@@ -1,17 +1,35 @@
-"""Storage-mount bridge between the backend and the data layer.
+"""Realize storage mounts on every host of a cluster.
 
-Placeholder until the storage subsystem lands (SURVEY §2.9 twin): raises a
-clear error instead of ModuleNotFoundError mid-launch.
+Bridge between the backend's sync_file_mounts stage and the data layer
+(reference equivalent: CloudVmRayBackend file-mount handling at
+sky/backends/cloud_vm_ray_backend.py:3289 + sky/data/mounting_utils.py
+command execution).
 """
 from __future__ import annotations
 
 from typing import Any, Dict
 
 from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.data import storage as storage_lib
+
+logger = sky_logging.init_logger(__name__)
 
 
 def mount_storage_on_cluster(handle: Any,
                              storage_mounts: Dict[str, Any]) -> None:
-    raise exceptions.NotSupportedError(
-        'storage_mounts are not wired into the backend yet; use '
-        'file_mounts, or track skypilot_tpu.data.storage.')
+    """Run each storage mount's realize command on all hosts."""
+    runners = handle.get_command_runners()
+    for mount_path, storage in storage_mounts.items():
+        if not isinstance(storage, storage_lib.Storage):
+            storage = storage_lib.Storage.from_yaml_config(dict(storage))
+        cmd = storage.cluster_command(mount_path)
+        logger.info(f'Mounting {storage.name} at {mount_path} '
+                    f'({storage.mode.value}) on {len(runners)} host(s)')
+        for runner in runners:
+            result = runner.run(cmd, require_outputs=True)
+            rc, _, stderr = result
+            if rc != 0:
+                raise exceptions.StorageError(
+                    f'Mounting {storage.name} at {mount_path} failed '
+                    f'(rc={rc}): {stderr}')

@@ -169,6 +169,10 @@ class StorageModeError(StorageError):
     pass
 
 
+class StorageNameError(StorageError):
+    pass
+
+
 class StorageSpecError(StorageError):
     pass
 

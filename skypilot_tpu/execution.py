@@ -158,6 +158,8 @@ def _execute_dag(dag: dag_lib.Dag,
         backend.sync_workdir(handle, task.workdir)
     if Stage.SYNC_FILE_MOUNTS in stages and (task.file_mounts or
                                              task.storage_mounts):
+        if task.storage_mounts:
+            task.sync_storage_mounts()
         backend.sync_file_mounts(handle, task.file_mounts,
                                  task.storage_mounts)
     if Stage.SETUP in stages:

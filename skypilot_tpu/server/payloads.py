@@ -95,6 +95,8 @@ _VERBS: Dict[str, Callable[[Dict[str, Any]],
     'logs': _core_verb('tail_logs', 'cluster_name', job_id=None),
     'check': _core_verb('check', quiet=True),
     'cost_report': _core_verb('cost_report'),
+    'storage.ls': _core_verb('storage_ls'),
+    'storage.delete': _core_verb('storage_delete', 'storage_name'),
 }
 
 

@@ -147,7 +147,23 @@ class Task:
         return self
 
     def set_storage_mounts(self, storage_mounts) -> 'Task':
-        self.storage_mounts = dict(storage_mounts) if storage_mounts else {}
+        from skypilot_tpu.data import storage as storage_lib2
+        converted = {}
+        for target, value in (storage_mounts or {}).items():
+            if isinstance(value, dict):
+                value = storage_lib2.Storage.from_yaml_config(value)
+            converted[target] = value
+        self.storage_mounts = converted
+        return self
+
+    def sync_storage_mounts(self) -> 'Task':
+        """Create buckets + upload local sources for all storage mounts.
+
+        Twin of sky/task.py:1200 — runs client/server-side before the
+        cluster-side mount stage.
+        """
+        for storage in self.storage_mounts.values():
+            storage.sync_all_stores()
         return self
 
     # ---- YAML ----
@@ -170,6 +186,13 @@ class Task:
                 f'Env/secret(s) {missing} declared with null values; '
                 'pass values via --env/--secret.')
 
+        raw_mounts = config.pop('file_mounts', None)
+        plain_mounts: Optional[Dict[str, str]] = None
+        storage_mounts: Dict[str, Any] = {}
+        if raw_mounts:
+            from skypilot_tpu.data import storage as storage_lib2
+            plain_mounts, storage_mounts = (
+                storage_lib2.storage_mounts_from_file_mounts(raw_mounts))
         task = cls(
             name=config.pop('name', None),
             setup=config.pop('setup', None),
@@ -178,8 +201,10 @@ class Task:
             secrets=secrets,
             workdir=config.pop('workdir', None),
             num_nodes=config.pop('num_nodes', None),
-            file_mounts=config.pop('file_mounts', None),
+            file_mounts=plain_mounts,
         )
+        if storage_mounts:
+            task.set_storage_mounts(storage_mounts)
         resources_config = config.pop('resources', None)
         parsed = resources_lib.Resources.from_yaml_config(resources_config)
         ordered = bool(resources_config) and 'ordered' in resources_config
@@ -227,7 +252,10 @@ class Task:
         add('workdir', self.workdir)
         add('envs', self._envs or None)
         add('secrets', self._secrets or None)
-        add('file_mounts', self.file_mounts)
+        all_mounts: Dict[str, Any] = dict(self.file_mounts or {})
+        for target, storage in (self.storage_mounts or {}).items():
+            all_mounts[target] = storage.to_yaml_config()
+        add('file_mounts', all_mounts or None)
         add('setup', self.setup)
         if isinstance(self.run, str):
             add('run', self.run)

@@ -1,0 +1,207 @@
+"""Storage subsystem tests.
+
+LocalStore (file:// scheme) exercises the full COPY/MOUNT path end-to-end
+against the fake cloud with zero network — the harness the reference lacks
+(its storage tests need real buckets, SURVEY §4.6).
+"""
+from __future__ import annotations
+
+import os
+import pathlib
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import state
+from skypilot_tpu.data import mounting_utils
+from skypilot_tpu.data import storage as storage_lib
+from skypilot_tpu.task import Task
+
+
+@pytest.fixture()
+def local_store_dir(tmp_path, monkeypatch):
+    store_dir = tmp_path / 'buckets'
+    monkeypatch.setenv('XSKY_LOCAL_STORE_DIR', str(store_dir))
+    monkeypatch.setenv('XSKY_ENABLE_FAKE_CLOUD', '1')
+    monkeypatch.setenv('XSKY_STATE_DB', str(tmp_path / 'state.db'))
+    state.reset_for_test()
+    yield store_dir
+    state.reset_for_test()
+
+
+def _make_source(tmp_path) -> pathlib.Path:
+    src = tmp_path / 'dataset'
+    src.mkdir()
+    (src / 'a.txt').write_text('alpha')
+    (src / 'sub').mkdir()
+    (src / 'sub' / 'b.txt').write_text('beta')
+    return src
+
+
+def test_store_type_from_url():
+    st, bucket = storage_lib.StoreType.from_url('gs://my-bucket/sub/dir')
+    assert st == storage_lib.StoreType.GCS and bucket == 'my-bucket/sub/dir'
+    st, bucket = storage_lib.StoreType.from_url('s3://b2')
+    assert st == storage_lib.StoreType.S3 and bucket == 'b2'
+    with pytest.raises(exceptions.StorageSpecError):
+        storage_lib.StoreType.from_url('ftp://nope')
+
+
+def test_local_store_upload_and_copy(tmp_path, local_store_dir):
+    src = _make_source(tmp_path)
+    storage = storage_lib.Storage(name='ds', source=str(src),
+                                  mode=storage_lib.StorageMode.COPY)
+    storage.add_store(storage_lib.StoreType.LOCAL)
+    storage.sync_all_stores()
+    assert (local_store_dir / 'ds' / 'a.txt').read_text() == 'alpha'
+    assert (local_store_dir / 'ds' / 'sub' / 'b.txt').read_text() == 'beta'
+    # state recorded
+    rec = state.get_storage_from_name('ds')
+    assert rec is not None and rec['status'] == state.StorageStatus.READY
+    # cluster-side COPY command works locally
+    dest = tmp_path / 'on-cluster'
+    cmd = storage.cluster_command(str(dest))
+    assert os.system(cmd) == 0
+    assert (dest / 'a.txt').read_text() == 'alpha'
+    storage.delete()
+    assert not (local_store_dir / 'ds').exists()
+    assert state.get_storage_from_name('ds') is None
+
+
+def test_local_store_mount_symlink(tmp_path, local_store_dir):
+    src = _make_source(tmp_path)
+    storage = storage_lib.Storage(name='m1', source=str(src),
+                                  mode=storage_lib.StorageMode.MOUNT)
+    storage.add_store(storage_lib.StoreType.LOCAL)
+    storage.sync_all_stores()
+    mnt = tmp_path / 'mnt' / 'data'
+    assert os.system(storage.cluster_command(str(mnt))) == 0
+    assert (mnt / 'sub' / 'b.txt').read_text() == 'beta'
+    # MOUNT is read-write into the "bucket"
+    (mnt / 'new.txt').write_text('gamma')
+    assert (local_store_dir / 'm1' / 'new.txt').read_text() == 'gamma'
+
+
+def test_mount_command_builders():
+    cmd = mounting_utils.gcs_mount_command('bkt', '/data', 'sub/dir')
+    assert 'gcsfuse' in cmd and '--only-dir' in cmd and 'bkt' in cmd
+    cmd = mounting_utils.s3_mount_command('bkt2', '/data')
+    assert 'goofys' in cmd
+    cmd = mounting_utils.rclone_mount_cached_command('xsky-gcs', 'bkt',
+                                                     '/data')
+    assert 'vfs-cache-mode full' in cmd
+
+
+def test_storage_from_yaml_and_modes():
+    cfg = {'name': 'n1', 'source': 'gs://bucket-x', 'mode': 'mount_cached'}
+    storage = storage_lib.Storage.from_yaml_config(cfg)
+    assert storage.mode == storage_lib.StorageMode.MOUNT_CACHED
+    assert storage_lib.StoreType.GCS in storage.stores
+    cmd = storage.cluster_command('/data')
+    assert 'rclone mount' in cmd
+    with pytest.raises(exceptions.StorageModeError):
+        storage_lib.Storage.from_yaml_config({'name': 'x', 'mode': 'BAD'})
+    with pytest.raises(exceptions.StorageSpecError):
+        storage_lib.Storage.from_yaml_config({'name': 'x', 'bogus': 1})
+
+
+def test_task_splits_file_mounts(tmp_path, local_store_dir):
+    src = _make_source(tmp_path)
+    config = {
+        'name': 'with-storage',
+        'run': 'ls /data',
+        'file_mounts': {
+            '/plain': str(src),
+            '/bucket-copy': 'gs://public-ds/path',
+            '/data': {
+                'name': 'yds',
+                'source': str(src),
+                'store': 'local',
+                'mode': 'MOUNT',
+            },
+        },
+    }
+    task = Task.from_yaml_config(config)
+    assert task.file_mounts == {'/plain': str(src)}
+    assert set(task.storage_mounts) == {'/bucket-copy', '/data'}
+    assert task.storage_mounts['/data'].mode == storage_lib.StorageMode.MOUNT
+    assert (task.storage_mounts['/bucket-copy'].mode ==
+            storage_lib.StorageMode.COPY)
+    # round-trip keeps storage mounts
+    round_trip = task.to_yaml_config()
+    assert '/data' in round_trip['file_mounts']
+    assert round_trip['file_mounts']['/data']['mode'] == 'MOUNT'
+
+
+def test_bucket_name_validation():
+    with pytest.raises(exceptions.StorageNameError):
+        storage_lib.GcsStore('Invalid_NAME')
+
+
+def test_launch_with_storage_mount_e2e(tmp_path, monkeypatch,
+                                       fake_cluster_env, local_store_dir):
+    """Full launch with a MOUNT storage: upload → provision → mount → run."""
+    from skypilot_tpu import Resources, execution
+    from skypilot_tpu.backends import tpu_gang_backend
+
+    src = _make_source(tmp_path)
+    task = Task.from_yaml_config({
+        'name': 'stor-e2e',
+        'run': 'cat data_mount/a.txt',
+        'file_mounts': {
+            # Relative target: lands inside each fake host's host_root.
+            'data_mount': {
+                'name': 'e2e-ds',
+                'source': str(src),
+                'store': 'local',
+                'mode': 'MOUNT',
+            },
+        },
+    })
+    task.set_resources(Resources(accelerators='tpu-v5e-8'))
+    job_id, handle = execution.launch(task, cluster_name='st1')
+    backend = tpu_gang_backend.TpuGangBackend()
+    deadline = __import__('time').time() + 20
+    while __import__('time').time() < deadline:
+        status = backend.get_job_status(handle, job_id)
+        if status is not None and status.is_terminal():
+            break
+        __import__('time').sleep(0.2)
+    logs = backend.tail_logs(handle, job_id, follow=False)
+    assert 'alpha' in logs
+
+
+def test_delete_keeps_external_bucket(tmp_path, local_store_dir):
+    """A pre-existing bucket the user pointed at must survive delete()."""
+    pre = local_store_dir / 'preexisting'
+    pre.mkdir(parents=True)
+    (pre / 'keep.txt').write_text('precious')
+    storage = storage_lib.Storage(source='file://preexisting')
+    storage.sync_all_stores()
+    storage.delete()
+    # External bucket untouched; state deregistered.
+    assert (pre / 'keep.txt').read_text() == 'precious'
+    assert state.get_storage_from_name('preexisting') is None
+    # Managed bucket (created by us) IS deleted.
+    src = _make_source(tmp_path)
+    managed = storage_lib.Storage(name='mine', source=str(src))
+    managed.add_store(storage_lib.StoreType.LOCAL)
+    managed.sync_all_stores()
+    assert (local_store_dir / 'mine').exists()
+    managed.delete()
+    assert not (local_store_dir / 'mine').exists()
+
+
+def test_storage_verbs_via_api_server(tmp_path, local_store_dir):
+    from skypilot_tpu import core
+    src = _make_source(tmp_path)
+    storage = storage_lib.Storage(name='apids', source=str(src))
+    storage.add_store(storage_lib.StoreType.LOCAL)
+    storage.sync_all_stores()
+    records = core.storage_ls()
+    assert any(r['name'] == 'apids' and r['status'] == 'READY'
+               for r in records)
+    core.storage_delete('apids')
+    assert not any(r['name'] == 'apids' for r in core.storage_ls())
+    with pytest.raises(exceptions.StorageError):
+        core.storage_delete('apids')
