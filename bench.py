@@ -57,6 +57,63 @@ def _pick_config(platform: str, hbm_gib: float):
         mesh_plan=mesh_lib.MeshPlan())
 
 
+def serve_main() -> None:
+    """`python bench.py serve`: JetStream-twin serving benchmark.
+
+    Baseline (BASELINE.md): JetStream Llama-2-7B on a v6e host (8 chips) —
+    11.42 req/s, 2147.98 output tok/s. The headline value and vs_baseline
+    are per-chip so chip counts don't skew the comparison.
+    """
+    import jax
+
+    from skypilot_tpu.infer import engine as engine_lib
+    from skypilot_tpu.infer import orchestrator as orch_lib
+    from skypilot_tpu.models import llama
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    if platform == 'cpu':
+        model, slots, max_len, n_req, prompt_len, new_tok = (
+            llama.LLAMA_TINY, 4, 64, 8, 16, 8)
+        buckets = (16,)
+    else:
+        model, slots, max_len, n_req, prompt_len, new_tok = (
+            llama.LLAMA3_1B, 16, 2048, 64, 512, 128)
+        buckets = (512,)
+    config = engine_lib.EngineConfig(
+        model=model, max_slots=slots, max_target_len=max_len,
+        prefill_buckets=buckets)
+    params = llama.init(model, jax.random.PRNGKey(0))
+    engine = engine_lib.InferenceEngine(config, params)
+    orch = orch_lib.Orchestrator(engine)
+    prompts = [[(i * 7 + j) % model.vocab_size
+                for j in range(prompt_len)] for i in range(n_req)]
+    orch.benchmark(prompts[:2], max_new_tokens=2)   # warmup compiles
+    orch = orch_lib.Orchestrator(engine)
+    metrics = orch.benchmark(prompts, max_new_tokens=new_tok)
+    n_chips = len(devices)
+    out_tps = metrics['output_token_throughput_tps']
+    out_tps_chip = out_tps / n_chips
+    # Baseline 2147.98 out tok/s was a single v6e host serving run
+    # (8 chips, examples/tpu/v6e/README.md:92-121) → 268.5 tok/s/chip.
+    result = {
+        'metric': 'llama_serve_output_tok_per_sec_per_chip',
+        'value': round(out_tps_chip, 2),
+        'unit': 'tok/s/chip',
+        'vs_baseline': round(out_tps_chip / (2147.98 / 8), 3),
+        'output_token_throughput_tps': round(out_tps, 2),
+        'request_throughput_rps': round(
+            metrics['request_throughput_rps'], 3),
+        'input_token_throughput_tps': round(
+            metrics['input_token_throughput_tps'], 1),
+        'mean_ttft_s': round(metrics['mean_ttft_s'], 4),
+        'device': getattr(devices[0], 'device_kind', platform),
+        'num_requests': n_req,
+        'max_slots': slots,
+    }
+    print(json.dumps(result))
+
+
 def main() -> None:
     import jax
 
@@ -98,4 +155,6 @@ def main() -> None:
 
 
 if __name__ == '__main__':
+    if len(sys.argv) > 1 and sys.argv[1] == 'serve':
+        sys.exit(serve_main())
     sys.exit(main())

@@ -1,0 +1,12 @@
+"""TPU-native LLM inference engine (JetStream twin).
+
+The reference serves LLMs by orchestrating external engines (vLLM/SGLang
+recipes; JetStream on TPU, examples/tpu/v6e/README.md:92-121 — the
+BASELINE serving numbers). Here the engine is in-tree and TPU-first:
+prefill/decode split, slot-based continuous batching, jitted decode step
+over a sharded KV cache.
+"""
+from skypilot_tpu.infer.engine import InferenceEngine, EngineConfig
+from skypilot_tpu.infer.orchestrator import Orchestrator, Request
+
+__all__ = ['InferenceEngine', 'EngineConfig', 'Orchestrator', 'Request']

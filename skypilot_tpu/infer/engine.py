@@ -1,0 +1,189 @@
+"""Slot-based inference engine: jitted prefill / insert / decode.
+
+Architecture (JetStream-style, TPU-first):
+  * A fixed pool of ``max_slots`` decode slots shares one KV cache
+    [L, slots, max_len, KVH, HD] — static shapes, so the decode step
+    compiles once and every iteration hits the cache.
+  * Prefill runs per-request at a padded bucket length (few compiles),
+    returns the prefix KV, which `insert` writes into a free slot.
+  * Decode advances ALL slots one token per step; inactive slots decode
+    garbage that is masked out host-side — branch-free on device.
+  * Sharding: KV heads ride the 'tensor' mesh axis, slots ride
+    ('data','fsdp') — the same rules as training, so one mesh serves both.
+
+Reference parity: the serving BASELINE is JetStream on v6e
+(examples/tpu/v6e/README.md:119-121 — 11.42 req/s, 2147.98 out tok/s).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.parallel import mesh as mesh_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    model: llama.LlamaConfig = dataclasses.field(
+        default_factory=lambda: llama.LLAMA3_8B)
+    max_slots: int = 8               # concurrent decode sequences
+    max_target_len: int = 2048       # prompt + generation budget per slot
+    prefill_buckets: Tuple[int, ...] = (128, 256, 512, 1024)
+    kv_dtype: Any = jnp.bfloat16
+
+    @property
+    def max_prompt_len(self) -> int:
+        return self.prefill_buckets[-1]
+
+
+class InferenceEngine:
+    """Owns params + KV cache; exposes prefill/insert/decode."""
+
+    def __init__(self, config: EngineConfig,
+                 params: llama.Params,
+                 mesh: Optional[mesh_lib.Mesh] = None) -> None:
+        self.config = config
+        self.params = params
+        self.mesh = mesh
+        c = config.model
+        self._kv_shape = (c.n_layers, config.max_slots,
+                          config.max_target_len, c.n_kv_heads, c.head_dim)
+        if mesh is not None:
+            self._kv_sharding = NamedSharding(
+                mesh, PartitionSpec(None, ('data', 'fsdp'), None, 'tensor',
+                                    None))
+            self._rep = NamedSharding(mesh, PartitionSpec())
+        else:
+            self._kv_sharding = None
+            self._rep = None
+
+    # ---- state ----
+
+    def init_decode_state(self) -> Dict[str, jax.Array]:
+        cfg = self.config
+        kv_kwargs = {}
+        if self._kv_sharding is not None:
+            kv_kwargs['device'] = self._kv_sharding
+        state = {
+            'kv_k': jnp.zeros(self._kv_shape, cfg.kv_dtype, **kv_kwargs),
+            'kv_v': jnp.zeros(self._kv_shape, cfg.kv_dtype, **kv_kwargs),
+            # per-slot: index the NEXT token will be written at
+            'lengths': jnp.zeros((cfg.max_slots,), jnp.int32),
+            'tokens': jnp.zeros((cfg.max_slots,), jnp.int32),
+            'active': jnp.zeros((cfg.max_slots,), jnp.bool_),
+        }
+        return state
+
+    # ---- prefill ----
+
+    def bucket_for(self, length: int) -> int:
+        for b in self.config.prefill_buckets:
+            if length <= b:
+                return b
+        raise ValueError(
+            f'Prompt length {length} exceeds max prefill bucket '
+            f'{self.config.prefill_buckets[-1]}.')
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _prefill(self, params, tokens, true_len):
+        """tokens [1, bucket] padded; returns (first_token, kv-prefix)."""
+        c = self.config.model
+        logits, kv = llama.prefill_forward(c, params, tokens,
+                                           mesh=self.mesh)
+        last = logits[0, true_len - 1]
+        first_token = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        return first_token, kv
+
+    def prefill(self, prompt_tokens) -> Tuple[jax.Array, Any, int]:
+        """Run prefill on one prompt → (first_token, kv, true_len)."""
+        true_len = len(prompt_tokens)
+        bucket = self.bucket_for(true_len)
+        padded = jnp.zeros((1, bucket), jnp.int32)
+        padded = padded.at[0, :true_len].set(
+            jnp.asarray(prompt_tokens, jnp.int32))
+        first_token, kv = self._prefill(self.params, padded,
+                                        jnp.int32(true_len))
+        return first_token, kv, true_len
+
+    # ---- insert ----
+
+    @functools.partial(jax.jit, static_argnums=(0,),
+                       donate_argnums=(1,))
+    def _insert(self, state, kv, first_token, true_len, slot):
+        """Write a prefill prefix into decode slot `slot`."""
+        cfg = self.config
+        # kv arrays: [L, 1, bucket, KVH, HD] → pad/crop to max_target_len.
+        bucket = kv['k'].shape[2]
+        pad = cfg.max_target_len - bucket
+        k = jnp.pad(kv['k'][:, 0], ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(kv['v'][:, 0], ((0, 0), (0, pad), (0, 0), (0, 0)))
+        state['kv_k'] = state['kv_k'].at[:, slot].set(
+            k.astype(cfg.kv_dtype))
+        state['kv_v'] = state['kv_v'].at[:, slot].set(
+            v.astype(cfg.kv_dtype))
+        state['lengths'] = state['lengths'].at[slot].set(true_len)
+        state['tokens'] = state['tokens'].at[slot].set(first_token)
+        state['active'] = state['active'].at[slot].set(True)
+        return state
+
+    def insert(self, state, kv, first_token, true_len: int, slot: int):
+        return self._insert(state, kv, first_token,
+                            jnp.int32(true_len), jnp.int32(slot))
+
+    def release_slot(self, state, slot: int):
+        state = dict(state)
+        state['active'] = state['active'].at[slot].set(False)
+        return state
+
+    # ---- decode ----
+
+    @functools.partial(jax.jit, static_argnums=(0,),
+                       donate_argnums=(2,))
+    def _decode_step(self, params, state, temperatures, key):
+        """temperatures [slots] (0 → greedy for that slot); key traced —
+        no value-dependent recompiles mid-serving. params is a traced
+        argument: closing over self.params would bake 2+ GB of weights
+        into the lowered program as constants."""
+        c = self.config.model
+        kv = {'k': state['kv_k'], 'v': state['kv_v']}
+        logits, new_kv = llama.decode_forward(
+            c, params, state['tokens'], state['lengths'], kv,
+            mesh=self.mesh)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        safe_t = jnp.maximum(temperatures, 1e-6)[:, None]
+        sampled = jax.random.categorical(
+            key, logits / safe_t, axis=-1).astype(jnp.int32)
+        next_tokens = jnp.where(temperatures > 0.0, sampled, greedy)
+        # Inactive slots hold position (their garbage writes are confined
+        # to their own slot rows and overwritten on insert).
+        new_lengths = jnp.where(state['active'], state['lengths'] + 1,
+                                state['lengths'])
+        state = {
+            'kv_k': new_kv['k'], 'kv_v': new_kv['v'],
+            'lengths': new_lengths,
+            'tokens': jnp.where(state['active'], next_tokens,
+                                state['tokens']),
+            'active': state['active'],
+        }
+        return state, next_tokens
+
+    def decode_step(self, state, temperatures=None,
+                    key: Optional[jax.Array] = None):
+        """Advance every slot one token. Returns (state, tokens [slots]).
+
+        temperatures: per-slot array [max_slots] (0 = greedy) or None for
+        all-greedy. Mixed greedy/sampled batches are correct per slot.
+        """
+        if temperatures is None:
+            temperatures = jnp.zeros((self.config.max_slots,), jnp.float32)
+        else:
+            temperatures = jnp.asarray(temperatures, jnp.float32)
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        return self._decode_step(self.params, state, temperatures, key)

@@ -156,8 +156,15 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 def _layer(config: LlamaConfig, mesh: Optional[mesh_lib.Mesh],
            x: jax.Array, layer_params: Params, positions: jax.Array,
            kv_cache: Optional[Tuple[jax.Array, jax.Array]] = None,
-           cache_index: Optional[jax.Array] = None):
-    """One transformer block. Returns (x, new_kv_cache)."""
+           cache_index: Optional[jax.Array] = None,
+           cache_positions: Optional[jax.Array] = None,
+           return_kv: bool = False):
+    """One transformer block. Returns (x, new_kv_cache).
+
+    Decode: with kv_cache set, the new K/V (s==1) is written either at a
+    shared ``cache_index`` (scalar) or per-slot ``cache_positions`` [B]
+    (continuous batching: every slot sits at its own length).
+    """
     c = config
     hd = c.head_dim
     b, s, _ = x.shape
@@ -177,25 +184,33 @@ def _layer(config: LlamaConfig, mesh: Optional[mesh_lib.Mesh],
     k = _rope(k, positions, c.rope_theta)
 
     if kv_cache is not None:
-        # Decode path: append k/v at cache_index, attend over full cache.
+        # Decode path: append k/v, attend over the full cache.
         ck, cv = kv_cache
-        ck = jax.lax.dynamic_update_slice_in_dim(ck, k, cache_index, axis=1)
-        cv = jax.lax.dynamic_update_slice_in_dim(cv, v, cache_index, axis=1)
+        if cache_positions is not None:
+            slots = jnp.arange(b)
+            ck = ck.at[slots, cache_positions].set(k[:, 0])
+            cv = cv.at[slots, cache_positions].set(v[:, 0])
+            last = cache_positions[:, None]
+        else:
+            ck = jax.lax.dynamic_update_slice_in_dim(ck, k, cache_index,
+                                                     axis=1)
+            cv = jax.lax.dynamic_update_slice_in_dim(cv, v, cache_index,
+                                                     axis=1)
+            last = cache_index + s - 1
         new_cache = (ck, cv)
-        kv_len = ck.shape[1]
-        kv_pos = jnp.arange(kv_len)[None, :]
-        valid = kv_pos <= (cache_index + s - 1)
+        kv_pos = jnp.arange(ck.shape[1])[None, :]
+        valid = kv_pos <= last
         attn = attention_ops.xla_attention_with_mask(q, ck, cv,
                                                      valid[:, None, None, :])
     elif c.attention_impl in ('ring', 'ulysses') and mesh is not None:
         # Context parallelism: sequence stays sharded through attention
         # (K/V ring over ICI neighbors or all-to-all head scatter).
         from skypilot_tpu.ops import ring_attention as ring_ops
-        new_cache = None
+        new_cache = (k, v) if return_kv else None
         attn = ring_ops.sequence_parallel_attention(
             q, k, v, mesh, implementation=c.attention_impl, causal=True)
     else:
-        new_cache = None
+        new_cache = (k, v) if return_kv else None
         attn = attention_ops.dot_product_attention(
             q, k, v, causal=True, implementation=c.attention_impl)
 
@@ -217,8 +232,14 @@ def forward(config: LlamaConfig,
             params: Params,
             tokens: jax.Array,
             mesh: Optional[mesh_lib.Mesh] = None,
-            positions: Optional[jax.Array] = None) -> jax.Array:
-    """Training/prefill forward pass → logits [B, S, vocab] (fp32)."""
+            positions: Optional[jax.Array] = None,
+            return_kv: bool = False):
+    """Training/prefill forward pass → logits [B, S, vocab] (fp32).
+
+    With return_kv=True also returns per-layer K/V for the decode cache
+    ({'k','v': [L,B,S,KVH,HD]}) — the serving prefill stage (JetStream
+    twin; BASELINE: examples/tpu/v6e/README.md:119-121).
+    """
     c = config
     if positions is None:
         positions = jnp.broadcast_to(
@@ -228,17 +249,63 @@ def forward(config: LlamaConfig,
         x = mesh_lib.shard_logical(
             x, mesh, ('batch', 'activation_length', 'activation_embed'))
 
-    layer_fn = lambda x, lp: (_layer(c, mesh, x, lp, positions)[0], None)
-    if c.remat:
+    def layer_fn(x, lp):
+        x, kv = _layer(c, mesh, x, lp, positions, return_kv=return_kv)
+        return x, ({'k': kv[0], 'v': kv[1]} if return_kv else None)
+
+    if c.remat and not return_kv:
         layer_fn = jax.checkpoint(
             layer_fn,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
-    x, _ = jax.lax.scan(layer_fn, x, params['layers'])
+    x, kv = jax.lax.scan(layer_fn, x, params['layers'])
 
     x = _rms_norm(x, params['final_norm'], c.norm_eps)
     logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'],
                         preferred_element_type=jnp.float32)
-    return logits
+    return (logits, kv) if return_kv else logits
+
+
+def prefill_forward(config: LlamaConfig,
+                    params: Params,
+                    tokens: jax.Array,
+                    mesh: Optional[mesh_lib.Mesh] = None
+                    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Prefill: forward() with the per-layer K/V collected for the cache."""
+    return forward(config, params, tokens, mesh=mesh, return_kv=True)
+
+
+def decode_forward(config: LlamaConfig,
+                   params: Params,
+                   last_tokens: jax.Array,
+                   positions: jax.Array,
+                   kv: Dict[str, jax.Array],
+                   mesh: Optional[mesh_lib.Mesh] = None
+                   ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One decode step for a batch of slots.
+
+    last_tokens [B], positions [B] (index each new token lands at),
+    kv {'k','v': [L,B,MAX_LEN,KVH,HD]}. Returns (logits [B,V], new kv).
+    The layer scan carries x and threads each layer's cache through as
+    scan xs/ys — one compiled layer body, O(1) compile time in depth.
+    """
+    c = config
+    x = params['embed'][last_tokens[:, None]].astype(c.dtype)  # [B,1,D]
+    pos = positions[:, None]                                    # [B,1]
+
+    def layer_fn(x, scanned):
+        lp, ck, cv = scanned
+        x, new_cache = _layer(c, mesh, x, lp, pos,
+                              kv_cache=(ck, cv),
+                              cache_index=None,
+                              cache_positions=positions)
+        return x, {'k': new_cache[0], 'v': new_cache[1]}
+
+    x, new_kv = jax.lax.scan(layer_fn, x, (params['layers'],
+                                           kv['k'], kv['v']))
+    x = _rms_norm(x, params['final_norm'], c.norm_eps)
+    logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'],
+                        preferred_element_type=jnp.float32)
+    return logits[:, 0], new_kv
 
 
 def loss_fn(config: LlamaConfig,
