@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
+from skypilot_tpu.infer import sampling
 from skypilot_tpu.models import llama
 from skypilot_tpu.parallel import mesh as mesh_lib
 
@@ -48,9 +49,15 @@ class InferenceEngine:
     def __init__(self, config: EngineConfig,
                  params: llama.Params,
                  mesh: Optional[mesh_lib.Mesh] = None) -> None:
+        from skypilot_tpu.models import moe
+        if isinstance(config.model, moe.MoEConfig):
+            raise NotImplementedError(
+                'MoE serving is not wired into the slot engine yet; '
+                'the decode path is Llama-only (dense MLP KV layout).')
         self.config = config
         self.params = params
         self.mesh = mesh
+        self._key = jax.random.PRNGKey(0)
         c = config.model
         self._kv_shape = (c.n_layers, config.max_slots,
                           config.max_target_len, c.n_kv_heads, c.head_dim)
@@ -92,12 +99,18 @@ class InferenceEngine:
 
     @functools.partial(jax.jit, static_argnums=(0,))
     def _prefill(self, params, tokens, true_len):
-        """tokens [1, bucket] padded; returns (first_token, kv-prefix)."""
+        """tokens [1, bucket] padded; returns (first_token, kv-prefix).
+
+        Only the hidden state at true_len-1 goes through the lm_head:
+        projecting the whole padded bucket would burn bucket×vocab matmul
+        FLOPs + fp32 HBM on the TTFT-critical path for one useful row.
+        """
         c = self.config.model
-        logits, kv = llama.prefill_forward(c, params, tokens,
-                                           mesh=self.mesh)
-        last = logits[0, true_len - 1]
-        first_token = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        last_hidden, kv = llama.prefill_hidden(c, params, tokens,
+                                               true_len, mesh=self.mesh)
+        logits = jnp.einsum('bd,dv->bv', last_hidden, params['lm_head'],
+                            preferred_element_type=jnp.float32)
+        first_token = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
         return first_token, kv
 
     def prefill(self, prompt_tokens) -> Tuple[jax.Array, Any, int]:
@@ -119,10 +132,13 @@ class InferenceEngine:
         """Write a prefill prefix into decode slot `slot`."""
         cfg = self.config
         # kv arrays: [L, 1, bucket, KVH, HD] → pad/crop to max_target_len.
-        bucket = kv['k'].shape[2]
-        pad = cfg.max_target_len - bucket
-        k = jnp.pad(kv['k'][:, 0], ((0, 0), (0, pad), (0, 0), (0, 0)))
-        v = jnp.pad(kv['v'][:, 0], ((0, 0), (0, pad), (0, 0), (0, 0)))
+        # (bucket is a static shape; crop first so a bucket larger than the
+        # KV budget can never produce a negative pad width.)
+        k = kv['k'][:, 0, :cfg.max_target_len]
+        v = kv['v'][:, 0, :cfg.max_target_len]
+        pad = cfg.max_target_len - k.shape[1]
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
         state['kv_k'] = state['kv_k'].at[:, slot].set(
             k.astype(cfg.kv_dtype))
         state['kv_v'] = state['kv_v'].at[:, slot].set(
@@ -145,21 +161,19 @@ class InferenceEngine:
 
     @functools.partial(jax.jit, static_argnums=(0,),
                        donate_argnums=(2,))
-    def _decode_step(self, params, state, temperatures, key):
-        """temperatures [slots] (0 → greedy for that slot); key traced —
-        no value-dependent recompiles mid-serving. params is a traced
-        argument: closing over self.params would bake 2+ GB of weights
-        into the lowered program as constants."""
+    def _decode_step(self, params, state, temperatures, top_k, top_p, key):
+        """Per-slot sampling params [slots] (temp 0 → greedy, top_k 0 /
+        top_p 1 → filter off); all traced — no value-dependent recompiles
+        mid-serving. params is a traced argument: closing over self.params
+        would bake 2+ GB of weights into the lowered program as
+        constants."""
         c = self.config.model
         kv = {'k': state['kv_k'], 'v': state['kv_v']}
         logits, new_kv = llama.decode_forward(
             c, params, state['tokens'], state['lengths'], kv,
             mesh=self.mesh)
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        safe_t = jnp.maximum(temperatures, 1e-6)[:, None]
-        sampled = jax.random.categorical(
-            key, logits / safe_t, axis=-1).astype(jnp.int32)
-        next_tokens = jnp.where(temperatures > 0.0, sampled, greedy)
+        next_tokens = sampling.sample_batched(logits, key, temperatures,
+                                              top_k, top_p)
         # Inactive slots hold position (their garbage writes are confined
         # to their own slot rows and overwritten on insert).
         new_lengths = jnp.where(state['active'], state['lengths'] + 1,
@@ -173,17 +187,35 @@ class InferenceEngine:
         }
         return state, next_tokens
 
-    def decode_step(self, state, temperatures=None,
-                    key: Optional[jax.Array] = None):
+    def decode_step(self, state, temperatures=None, top_k=None,
+                    top_p=None, key: Optional[jax.Array] = None):
         """Advance every slot one token. Returns (state, tokens [slots]).
 
-        temperatures: per-slot array [max_slots] (0 = greedy) or None for
-        all-greedy. Mixed greedy/sampled batches are correct per slot.
+        Per-slot arrays [max_slots]: temperatures (0 = greedy), top_k
+        (0 = off), top_p (1 = off); None means disabled for all slots.
+        Mixed greedy/sampled batches are correct per slot. If `key` is
+        omitted, an engine-owned key is split per call so repeated steps
+        never reuse PRNG state.
         """
+        import numpy as np
+        slots = self.config.max_slots
         if temperatures is None:
-            temperatures = jnp.zeros((self.config.max_slots,), jnp.float32)
+            temperatures = jnp.zeros((slots,), jnp.float32)
         else:
             temperatures = jnp.asarray(temperatures, jnp.float32)
+        # Disabled filters become None (a distinct, cheaper compiled
+        # variant): sample_batched then skips its [slots, vocab] sorts —
+        # the all-greedy serving hot path pays only argmax+categorical.
+        # At most 4 compiled variants; values stay traced so per-slot
+        # changes never recompile.
+        if top_k is not None:
+            tk = np.asarray(top_k)
+            top_k = None if (tk <= 0).all() else jnp.asarray(tk, jnp.int32)
+        if top_p is not None:
+            tp = np.asarray(top_p)
+            top_p = None if (tp >= 1.0).all() else jnp.asarray(
+                tp, jnp.float32)
         if key is None:
-            key = jax.random.PRNGKey(0)
-        return self._decode_step(self.params, state, temperatures, key)
+            self._key, key = jax.random.split(self._key)
+        return self._decode_step(self.params, state, temperatures, top_k,
+                                 top_p, key)

@@ -28,6 +28,8 @@ class Request:
     max_new_tokens: int = 128
     eos_token_id: Optional[int] = None
     temperature: float = 0.0
+    top_k: int = 0               # 0 → disabled
+    top_p: float = 1.0           # 1 → disabled
     # filled by the orchestrator:
     request_id: int = -1
     output_tokens: List[int] = dataclasses.field(default_factory=list)
@@ -73,13 +75,15 @@ class Orchestrator:
         except queue.Empty:
             return False
         prompt_len = len(request.prompt_tokens)
-        if prompt_len == 0 or \
-                prompt_len > self.engine.config.max_prompt_len:
+        # The prompt must fit the prefill buckets AND leave room for at
+        # least one generated token in the per-slot KV budget.
+        limit = min(self.engine.config.max_prompt_len,
+                    self.engine.config.max_target_len - 1)
+        if prompt_len == 0 or prompt_len > limit:
             # Reject rather than crash the serving loop (the slot has not
             # been claimed yet, so capacity is unaffected).
             request.error = (
-                f'Prompt length {prompt_len} outside (0, '
-                f'{self.engine.config.max_prompt_len}].')
+                f'Prompt length {prompt_len} outside (0, {limit}].')
             request.done = True
             request.finished_at = time.perf_counter()
             logger.warning(f'Rejected request {request.request_id}: '
@@ -120,12 +124,18 @@ class Orchestrator:
             pass
         if not self._slot_req:
             return
-        temps = np.zeros((self.engine.config.max_slots,), np.float32)
+        slots = self.engine.config.max_slots
+        temps = np.zeros((slots,), np.float32)
+        top_k = np.zeros((slots,), np.int32)
+        top_p = np.ones((slots,), np.float32)
         for slot, request in self._slot_req.items():
             temps[slot] = request.temperature
+            top_k[slot] = request.top_k
+            top_p[slot] = request.top_p
         self._key, step_key = jax.random.split(self._key)
         self.state, tokens = self.engine.decode_step(
-            self.state, temperatures=temps, key=step_key)
+            self.state, temperatures=temps, top_k=top_k, top_p=top_p,
+            key=step_key)
         tokens = np.asarray(jax.device_get(tokens))
         for slot in list(self._slot_req):
             request = self._slot_req[slot]
@@ -138,6 +148,30 @@ class Orchestrator:
                 steps < max_steps:
             self.step()
             steps += 1
+        if self._slot_req or not self._pending.empty():
+            # Never hand back silently-truncated outputs: mark every
+            # unfinished request — active in a slot OR still queued — so
+            # callers can see incompleteness, and leave no stale queue
+            # behind to leak into a later batch.
+            logger.warning(f'run_until_drained hit max_steps={max_steps} '
+                           f'with {len(self._slot_req)} active and '
+                           f'~{self._pending.qsize()} pending requests.')
+            error = f'Truncated at max_steps={max_steps}.'
+            for slot in list(self._slot_req):
+                request = self._slot_req.pop(slot)
+                request.error = error
+                request.done = True
+                request.finished_at = time.perf_counter()
+                self.state = self.engine.release_slot(self.state, slot)
+                self._free_slots.append(slot)
+            while True:
+                try:
+                    request = self._pending.get_nowait()
+                except queue.Empty:
+                    break
+                request.error = error
+                request.done = True
+                request.finished_at = time.perf_counter()
 
     # ---- convenience ----
 

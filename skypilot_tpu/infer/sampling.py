@@ -1,4 +1,10 @@
-"""Token sampling: greedy / temperature / top-k / top-p, jit-friendly."""
+"""Token sampling: greedy / temperature / top-k / top-p, jit-friendly.
+
+`sample_batched` is the single implementation; it handles per-row
+parameters so the engine's one compiled decode step can serve a mixed
+batch of greedy/sampled slots. `sample` is the scalar-params convenience
+wrapper used for single requests.
+"""
 from __future__ import annotations
 
 import dataclasses
@@ -15,23 +21,57 @@ class SamplingParams:
     top_p: float = 1.0           # 1 → disabled
 
 
+def sample_batched(logits: jax.Array,
+                   key: jax.Array,
+                   temperature: jax.Array,
+                   top_k: Optional[jax.Array] = None,
+                   top_p: Optional[jax.Array] = None) -> jax.Array:
+    """Per-row sampling. logits [B, V]; temperature/top_k/top_p [B].
+
+    Rows with temperature <= 0 are greedy; top_k == 0 / top_p >= 1 disable
+    the respective filter for that row. Branch-free: safe inside jit with
+    traced parameter arrays.
+    """
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe_t = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / safe_t
+    v = logits.shape[-1]
+
+    if top_k is not None:
+        top_k = jnp.asarray(top_k, jnp.int32)
+        sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+        k_idx = jnp.clip(top_k - 1, 0, v - 1)[:, None]
+        kth = jnp.take_along_axis(sorted_desc, k_idx, axis=-1)
+        mask = (top_k[:, None] > 0) & (scaled < kth)
+        scaled = jnp.where(mask, -jnp.inf, scaled)
+
+    if top_p is not None:
+        top_p = jnp.asarray(top_p, jnp.float32)
+        # Sort after the top-k mask (-inf rows sort last, prob 0) so the
+        # nucleus is taken from the already-filtered distribution.
+        sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Smallest prefix with cumulative prob >= top_p (first always kept).
+        cutoff_idx = jnp.sum(cum < top_p[:, None], axis=-1)
+        cutoff_logit = jnp.take_along_axis(sorted_desc,
+                                           cutoff_idx[:, None], axis=-1)
+        active = top_p[:, None] < 1.0
+        scaled = jnp.where(active & (scaled < cutoff_logit), -jnp.inf,
+                           scaled)
+
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
 def sample(logits: jax.Array, key: Optional[jax.Array],
            params: SamplingParams) -> jax.Array:
-    """logits [B, V] → token ids [B]."""
+    """logits [B, V] → token ids [B] (one SamplingParams for all rows)."""
+    b = logits.shape[0]
     if params.temperature <= 0.0:
-        return jnp.argmax(logits, axis=-1)
-    logits = logits / params.temperature
-    if params.top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[:, -params.top_k][:, None]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if params.top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # Keep the smallest prefix of tokens with cumulative prob >= top_p
-        # (always keep the first).
-        cutoff_idx = jnp.sum(cum < params.top_p, axis=-1)
-        cutoff_logit = jnp.take_along_axis(sorted_logits,
-                                           cutoff_idx[:, None], axis=-1)
-        logits = jnp.where(logits < cutoff_logit, -jnp.inf, logits)
-    return jax.random.categorical(key, logits, axis=-1)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return sample_batched(
+        logits, key,
+        jnp.full((b,), params.temperature, jnp.float32),
+        jnp.full((b,), params.top_k, jnp.int32),
+        jnp.full((b,), params.top_p, jnp.float32))

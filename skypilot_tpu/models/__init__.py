@@ -1,0 +1,32 @@
+"""In-tree model families (compute-path twins of the reference's recipes).
+
+Each model module exposes the same functional surface:
+  CONFIGS, logical_axes(config), init(config, key),
+  forward(config, params, tokens, mesh=...), loss_fn(config, params, ...)
+so the trainer/inference engine dispatch on the config type alone.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+
+def module_for(config: Any):
+    """Return the model module (llama/moe) that owns `config`."""
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.models import moe
+    if isinstance(config, moe.MoEConfig):
+        return moe
+    if isinstance(config, llama.LlamaConfig):
+        return llama
+    raise TypeError(f'Unknown model config type: {type(config)!r}')
+
+
+def get_config(name: str):
+    """Look up a named config across all model families."""
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.models import moe
+    for mod in (llama, moe):
+        if name in mod.CONFIGS:
+            return mod.CONFIGS[name]
+    known = sorted(set(llama.CONFIGS) | set(moe.CONFIGS))
+    raise KeyError(f'Unknown model {name!r}; known: {known}')

@@ -228,18 +228,13 @@ def _layer(config: LlamaConfig, mesh: Optional[mesh_lib.Mesh],
     return x, new_cache
 
 
-def forward(config: LlamaConfig,
-            params: Params,
-            tokens: jax.Array,
-            mesh: Optional[mesh_lib.Mesh] = None,
-            positions: Optional[jax.Array] = None,
-            return_kv: bool = False):
-    """Training/prefill forward pass → logits [B, S, vocab] (fp32).
-
-    With return_kv=True also returns per-layer K/V for the decode cache
-    ({'k','v': [L,B,S,KVH,HD]}) — the serving prefill stage (JetStream
-    twin; BASELINE: examples/tpu/v6e/README.md:119-121).
-    """
+def _trunk(config: LlamaConfig,
+           params: Params,
+           tokens: jax.Array,
+           positions: Optional[jax.Array],
+           mesh: Optional[mesh_lib.Mesh],
+           return_kv: bool):
+    """Embed → scanned layers → final RMSNorm. Returns (x [B,S,D], kv)."""
     c = config
     if positions is None:
         positions = jnp.broadcast_to(
@@ -258,20 +253,43 @@ def forward(config: LlamaConfig,
             layer_fn,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
     x, kv = jax.lax.scan(layer_fn, x, params['layers'])
+    return _rms_norm(x, params['final_norm'], c.norm_eps), kv
 
-    x = _rms_norm(x, params['final_norm'], c.norm_eps)
+
+def forward(config: LlamaConfig,
+            params: Params,
+            tokens: jax.Array,
+            mesh: Optional[mesh_lib.Mesh] = None,
+            positions: Optional[jax.Array] = None,
+            return_kv: bool = False):
+    """Training/prefill forward pass → logits [B, S, vocab] (fp32).
+
+    With return_kv=True also returns per-layer K/V for the decode cache
+    ({'k','v': [L,B,S,KVH,HD]}) — the serving prefill stage (JetStream
+    twin; BASELINE: examples/tpu/v6e/README.md:119-121).
+    """
+    x, kv = _trunk(config, params, tokens, positions, mesh, return_kv)
     logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'],
                         preferred_element_type=jnp.float32)
     return (logits, kv) if return_kv else logits
 
 
-def prefill_forward(config: LlamaConfig,
-                    params: Params,
-                    tokens: jax.Array,
-                    mesh: Optional[mesh_lib.Mesh] = None
-                    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """Prefill: forward() with the per-layer K/V collected for the cache."""
-    return forward(config, params, tokens, mesh=mesh, return_kv=True)
+def prefill_hidden(config: LlamaConfig,
+                   params: Params,
+                   tokens: jax.Array,
+                   true_len: jax.Array,
+                   mesh: Optional[mesh_lib.Mesh] = None
+                   ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Prefill trunk returning only the hidden state at true_len-1.
+
+    → (last_hidden [B, D] in model dtype, per-layer KV). The caller does
+    the single-row lm_head projection — avoids materializing fp32 logits
+    for the whole padded prefill bucket.
+    """
+    x, kv = _trunk(config, params, tokens, None, mesh, return_kv=True)
+    last = jax.lax.dynamic_index_in_dim(x, true_len - 1, axis=1,
+                                        keepdims=False)
+    return last, kv
 
 
 def decode_forward(config: LlamaConfig,

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec
 
+from skypilot_tpu import models
 from skypilot_tpu.models import llama
 from skypilot_tpu.parallel import mesh as mesh_lib
 
@@ -58,8 +59,9 @@ class Trainer:
         self.mesh = mesh if mesh is not None else mesh_lib.build_mesh(
             config.mesh_plan)
         self.optimizer = make_optimizer(config)
+        self._model_lib = models.module_for(config.model)
         self._param_shardings = mesh_lib.tree_shardings(
-            self.mesh, llama.logical_axes(config.model))
+            self.mesh, self._model_lib.logical_axes(config.model))
         self._batch_sharding = NamedSharding(
             self.mesh, PartitionSpec(('data', 'fsdp'), None))
         self._compiled_step = None
@@ -70,7 +72,7 @@ class Trainer:
         c = self.config
 
         def _init():
-            params = llama.init(c.model, jax.random.PRNGKey(c.seed))
+            params = self._model_lib.init(c.model, jax.random.PRNGKey(c.seed))
             opt_state = self.optimizer.init(params)
             return {'params': params, 'opt_state': opt_state,
                     'step': jnp.zeros((), jnp.int32)}
@@ -82,7 +84,7 @@ class Trainer:
         """Shardings pytree for the full train state."""
         c = self.config
         params_shape = jax.eval_shape(
-            lambda: llama.init(c.model, jax.random.PRNGKey(0)))
+            lambda: self._model_lib.init(c.model, jax.random.PRNGKey(0)))
         opt_shape = jax.eval_shape(
             lambda: self.optimizer.init(
                 jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
@@ -116,9 +118,9 @@ class Trainer:
         c = self.config
 
         def loss_of(params):
-            return llama.loss_fn(c.model, params, batch['tokens'],
-                                 batch['targets'], mesh=self.mesh,
-                                 loss_mask=batch.get('mask'))
+            return self._model_lib.loss_fn(c.model, params, batch['tokens'],
+                                           batch['targets'], mesh=self.mesh,
+                                           loss_mask=batch.get('mask'))
 
         loss, grads = jax.value_and_grad(loss_of)(state['params'])
         updates, new_opt = self.optimizer.update(grads, state['opt_state'],

@@ -145,3 +145,53 @@ def test_oversized_prompt_rejected_not_crashing(tiny_engine):
     assert good.done and good.error is None and len(good.output_tokens) == 4
     # All slots back in the pool.
     assert len(orch._free_slots) == tiny_engine.config.max_slots
+
+
+def test_prompt_exceeding_kv_budget_rejected():
+    """Prompt fits a prefill bucket but not max_target_len → rejected."""
+    config = engine_lib.EngineConfig(
+        model=llama.LLAMA_TINY, max_slots=2, max_target_len=16,
+        prefill_buckets=(8, 32))
+    params = llama.init(llama.LLAMA_TINY, jax.random.PRNGKey(0))
+    engine = engine_lib.InferenceEngine(config, params)
+    orch = orch_lib.Orchestrator(engine)
+    bad = orch.submit(orch_lib.Request(prompt_tokens=[1] * 20,
+                                       max_new_tokens=4))
+    orch.run_until_drained()
+    assert bad.done and bad.error is not None
+    assert len(orch._free_slots) == config.max_slots
+
+
+def test_default_decode_key_advances(tiny_engine):
+    """decode_step without an explicit key must not reuse PRNG state."""
+    k0 = tiny_engine._key
+    state = tiny_engine.init_decode_state()
+    state, _ = tiny_engine.decode_step(state)
+    assert not bool(jnp.all(tiny_engine._key == k0))
+
+
+def test_batched_topk_per_row():
+    logits = jnp.asarray([[1.0, 2.0, 3.0, 4.0],
+                          [4.0, 3.0, 2.0, 1.0]])
+    temps = jnp.asarray([1.0, 0.0])
+    top_k = jnp.asarray([1, 0])
+    toks = sampling_lib.sample_batched(logits, jax.random.PRNGKey(0),
+                                       temps, top_k=top_k)
+    assert int(toks[0]) == 3  # top_k=1 → argmax despite temperature
+    assert int(toks[1]) == 0  # greedy row
+
+
+def test_moe_config_rejected_by_engine():
+    from skypilot_tpu.models import moe
+    config = engine_lib.EngineConfig(model=moe.MOE_TINY)
+    with pytest.raises(NotImplementedError):
+        engine_lib.InferenceEngine(config, params={})
+
+
+def test_run_until_drained_marks_truncated(tiny_engine):
+    orch = orch_lib.Orchestrator(tiny_engine)
+    req = orch.submit(orch_lib.Request(prompt_tokens=[1, 2, 3],
+                                       max_new_tokens=50))
+    orch.run_until_drained(max_steps=2)
+    assert req.done and req.error is not None
+    assert len(orch._free_slots) == tiny_engine.config.max_slots
