@@ -98,30 +98,45 @@ class InferenceEngine:
             f'{self.config.prefill_buckets[-1]}.')
 
     @functools.partial(jax.jit, static_argnums=(0,))
-    def _prefill(self, params, tokens, true_len):
+    def _prefill(self, params, tokens, true_len, temperature, top_k,
+                 top_p, key):
         """tokens [1, bucket] padded; returns (first_token, kv-prefix).
 
         Only the hidden state at true_len-1 goes through the lm_head:
         projecting the whole padded bucket would burn bucket×vocab matmul
         FLOPs + fp32 HBM on the TTFT-critical path for one useful row.
+        The first token obeys the request's sampling params, same as every
+        decode step (temperature 0 → greedy).
         """
         c = self.config.model
         last_hidden, kv = llama.prefill_hidden(c, params, tokens,
                                                true_len, mesh=self.mesh)
         logits = jnp.einsum('bd,dv->bv', last_hidden, params['lm_head'],
                             preferred_element_type=jnp.float32)
-        first_token = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
+        first_token = sampling.sample_batched(logits, key, temperature,
+                                              top_k, top_p)[0]
         return first_token, kv
 
-    def prefill(self, prompt_tokens) -> Tuple[jax.Array, Any, int]:
+    def prefill(self, prompt_tokens,
+                sampling_params: Optional[sampling.SamplingParams] = None,
+                key: Optional[jax.Array] = None
+                ) -> Tuple[jax.Array, Any, int]:
         """Run prefill on one prompt → (first_token, kv, true_len)."""
+        sp = sampling_params or sampling.SamplingParams()
         true_len = len(prompt_tokens)
         bucket = self.bucket_for(true_len)
         padded = jnp.zeros((1, bucket), jnp.int32)
         padded = padded.at[0, :true_len].set(
             jnp.asarray(prompt_tokens, jnp.int32))
-        first_token, kv = self._prefill(self.params, padded,
-                                        jnp.int32(true_len))
+        if key is None:
+            self._key, key = jax.random.split(self._key)
+        first_token, kv = self._prefill(
+            self.params, padded, jnp.int32(true_len),
+            jnp.full((1,), sp.temperature, jnp.float32),
+            jnp.full((1,), sp.top_k, jnp.int32) if sp.top_k > 0 else None,
+            jnp.full((1,), sp.top_p, jnp.float32) if sp.top_p < 1.0
+            else None,
+            key)
         return first_token, kv, true_len
 
     # ---- insert ----

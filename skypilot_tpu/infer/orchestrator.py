@@ -94,8 +94,14 @@ class Orchestrator:
             request.max_new_tokens = (self.engine.config.max_target_len -
                                       prompt_len)
         slot = self._free_slots.pop()
+        from skypilot_tpu.infer import sampling as sampling_lib
+        self._key, prefill_key = jax.random.split(self._key)
         first_token, kv, true_len = self.engine.prefill(
-            request.prompt_tokens)
+            request.prompt_tokens,
+            sampling_params=sampling_lib.SamplingParams(
+                temperature=request.temperature, top_k=request.top_k,
+                top_p=request.top_p),
+            key=prefill_key)
         self.state = self.engine.insert(self.state, kv, first_token,
                                         true_len, slot)
         request.output_tokens.append(int(first_token))

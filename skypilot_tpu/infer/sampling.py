@@ -70,8 +70,11 @@ def sample(logits: jax.Array, key: Optional[jax.Array],
     b = logits.shape[0]
     if params.temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # Disabled filters pass None so their full-vocab sorts are skipped.
     return sample_batched(
         logits, key,
         jnp.full((b,), params.temperature, jnp.float32),
-        jnp.full((b,), params.top_k, jnp.int32),
-        jnp.full((b,), params.top_p, jnp.float32))
+        jnp.full((b,), params.top_k, jnp.int32) if params.top_k > 0
+        else None,
+        jnp.full((b,), params.top_p, jnp.float32) if params.top_p < 1.0
+        else None)

@@ -326,6 +326,45 @@ def decode_forward(config: LlamaConfig,
     return logits[:, 0], new_kv
 
 
+def pipelined_loss_fn(config: LlamaConfig,
+                      params: Params,
+                      tokens: jax.Array,
+                      targets: jax.Array,
+                      mesh: mesh_lib.Mesh,
+                      n_microbatches: int,
+                      loss_mask: Optional[jax.Array] = None) -> jax.Array:
+    """loss_fn with the layer stack pipelined over the 'stage' mesh axis.
+
+    Embed / final-norm / lm_head / CE run as ordinary SPMD outside the
+    pipeline region; only the scanned layer block runs under the GPipe
+    schedule (parallel.pipeline). Params must be sharded with
+    mesh.PIPELINE_RULES ('layers' → 'stage').
+    """
+    from skypilot_tpu.parallel import pipeline as pipeline_lib
+    c = config
+    x = params['embed'][tokens].astype(c.dtype)
+
+    def one_layer(x_mb: jax.Array, lp: Params) -> jax.Array:
+        b, s, _ = x_mb.shape
+        pos = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        # mesh=None: inside the stage-manual region sharding hints are
+        # owned by the auto axes; XLA keeps batch/tensor layouts.
+        y, _ = _layer(c, None, x_mb, lp, pos)
+        return y
+
+    x = pipeline_lib.pipeline_apply(one_layer, params['layers'], x, mesh,
+                                    n_microbatches, remat=c.remat)
+    x = _rms_norm(x, params['final_norm'], c.norm_eps)
+    logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'],
+                        preferred_element_type=jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if loss_mask is not None:
+        return jnp.sum(nll * loss_mask) / jnp.maximum(
+            jnp.sum(loss_mask), 1.0)
+    return jnp.mean(nll)
+
+
 def loss_fn(config: LlamaConfig,
             params: Params,
             tokens: jax.Array,

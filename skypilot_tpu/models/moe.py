@@ -149,14 +149,20 @@ def expert_capacity(config: MoEConfig, num_tokens: int) -> int:
     return max(4, cap)
 
 
-def route(config: MoEConfig, router_w: jax.Array,
-          x: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+def route(config: MoEConfig, router_w: jax.Array, x: jax.Array,
+          token_mask: Optional[jax.Array] = None
+          ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Top-k routing → (dispatch [T,E,C], combine [T,E,C], aux_loss).
 
     Dispatch/combine are the GShard one-hot tensors: static [T, E, C]
     shapes regardless of routing, so the expert compute is three einsums
     that XLA tiles onto the MXU and (with 'expert' sharded) turns into an
     all-to-all over ICI.
+
+    token_mask [T] (1 real / 0 pad): masked tokens are excluded from
+    routing entirely — they consume no expert capacity and do not enter
+    the load-balance statistics, so heavy padding can neither starve real
+    tokens of capacity nor skew the balance objective.
     """
     c = config
     t = x.shape[0]
@@ -171,6 +177,8 @@ def route(config: MoEConfig, router_w: jax.Array,
     # cumulative count of prior assignments to the same expert. Choices are
     # processed k-major so a token's first choice wins buffer slots.
     onehot = jax.nn.one_hot(gate_idx, c.n_experts, dtype=jnp.float32)
+    if token_mask is not None:
+        onehot = onehot * token_mask[:, None, None]
     # [k, T, E] → flatten priority order (choice 0 of all tokens first).
     flat = onehot.transpose(1, 0, 2).reshape(-1, c.n_experts)
     pos_flat = jnp.cumsum(flat, axis=0) - flat           # [k*T, E]
@@ -185,21 +193,34 @@ def route(config: MoEConfig, router_w: jax.Array,
     combine = jnp.einsum('tke,tkc,tk->tec', sel, pos_onehot, gate_vals)
 
     # Switch-Transformer load-balance loss: E * Σ_e f_e · p_e  (≥ 1 at
-    # perfect balance; minimized when routing is uniform).
-    frac_tokens = jnp.mean(jnp.sum(onehot, axis=1), axis=0)  # [E]
-    mean_probs = jnp.mean(probs, axis=0)                     # [E]
+    # perfect balance; minimized when routing is uniform). Statistics are
+    # over real tokens only when a mask is given.
+    if token_mask is None:
+        n_real = jnp.float32(t)
+        frac_tokens = jnp.sum(onehot, axis=(0, 1)) / n_real      # [E]
+        mean_probs = jnp.mean(probs, axis=0)                     # [E]
+    else:
+        n_real = jnp.maximum(jnp.sum(token_mask), 1.0)
+        frac_tokens = jnp.sum(onehot, axis=(0, 1)) / n_real
+        mean_probs = jnp.sum(probs * token_mask[:, None],
+                             axis=0) / n_real
     aux = c.n_experts * jnp.sum(frac_tokens * mean_probs) / \
         c.experts_per_token
     return dispatch, combine, aux
 
 
 def _moe_mlp(config: MoEConfig, mesh: Optional[mesh_lib.Mesh],
-             h: jax.Array, lp: Params) -> Tuple[jax.Array, jax.Array]:
+             h: jax.Array, lp: Params,
+             token_mask: Optional[jax.Array] = None
+             ) -> Tuple[jax.Array, jax.Array]:
     """Routed expert MLP. h [B,S,D] → (out [B,S,D], aux_loss)."""
     c = config
     b, s, d = h.shape
     x = h.reshape(b * s, d)
-    dispatch, combine, aux = route(c, lp['router'], x)
+    flat_mask = (token_mask.reshape(b * s)
+                 if token_mask is not None else None)
+    dispatch, combine, aux = route(c, lp['router'], x,
+                                   token_mask=flat_mask)
 
     def shard(arr, axes):
         if mesh is None:
@@ -223,7 +244,9 @@ def _moe_mlp(config: MoEConfig, mesh: Optional[mesh_lib.Mesh],
 
 
 def _layer(config: MoEConfig, mesh: Optional[mesh_lib.Mesh], x: jax.Array,
-           lp: Params, positions: jax.Array) -> Tuple[jax.Array, jax.Array]:
+           lp: Params, positions: jax.Array,
+           token_mask: Optional[jax.Array] = None
+           ) -> Tuple[jax.Array, jax.Array]:
     """One Mixtral block: Llama attention + routed MoE MLP."""
     c = config
     hd = c.head_dim
@@ -254,7 +277,7 @@ def _layer(config: MoEConfig, mesh: Optional[mesh_lib.Mesh], x: jax.Array,
                   ('batch', 'activation_length', 'activation_embed'))
 
     h = llama._rms_norm(x, lp['mlp_norm'], c.norm_eps)
-    moe_out, aux = _moe_mlp(c, mesh, h, lp)
+    moe_out, aux = _moe_mlp(c, mesh, h, lp, token_mask=token_mask)
     x = x + shard(moe_out, ('batch', 'activation_length',
                             'activation_embed'))
     return x, aux
@@ -265,8 +288,13 @@ def forward(config: MoEConfig,
             tokens: jax.Array,
             mesh: Optional[mesh_lib.Mesh] = None,
             positions: Optional[jax.Array] = None,
-            return_aux: bool = False):
-    """Forward pass → logits [B, S, vocab] (fp32), optionally (+ aux loss)."""
+            return_aux: bool = False,
+            token_mask: Optional[jax.Array] = None):
+    """Forward pass → logits [B, S, vocab] (fp32), optionally (+ aux loss).
+
+    token_mask [B, S]: pad positions are excluded from expert routing and
+    the load-balance statistics (they would otherwise hog capacity).
+    """
     c = config
     if positions is None:
         positions = jnp.broadcast_to(
@@ -277,7 +305,7 @@ def forward(config: MoEConfig,
             x, mesh, ('batch', 'activation_length', 'activation_embed'))
 
     def layer_fn(x, lp):
-        return _layer(c, mesh, x, lp, positions)
+        return _layer(c, mesh, x, lp, positions, token_mask=token_mask)
 
     if c.remat:
         layer_fn = jax.checkpoint(
@@ -301,7 +329,7 @@ def loss_fn(config: MoEConfig,
             loss_mask: Optional[jax.Array] = None) -> jax.Array:
     """Next-token cross-entropy + router load-balance auxiliary loss."""
     logits, aux = forward(config, params, tokens, mesh=mesh,
-                          return_aux=True)
+                          return_aux=True, token_mask=loss_mask)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     if loss_mask is not None:

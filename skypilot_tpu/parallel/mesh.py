@@ -120,6 +120,13 @@ DEFAULT_RULES: LogicalRules = (
     ('stage', 'stage'),
 )
 
+# Pipeline-parallel layout: the stacked layer axis is sharded over the
+# 'stage' mesh axis so each pipeline stage holds (and updates) only its
+# own block of layers. Everything else is unchanged.
+PIPELINE_RULES: LogicalRules = tuple(
+    ('layers', 'stage') if name == 'layers' else (name, target)
+    for name, target in DEFAULT_RULES)
+
 
 def logical_to_spec(logical_axes: Sequence[Optional[str]],
                     rules: LogicalRules = DEFAULT_RULES) -> PartitionSpec:

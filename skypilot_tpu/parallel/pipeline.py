@@ -1,0 +1,119 @@
+"""GPipe-style pipeline parallelism over the mesh's 'stage' axis.
+
+Capability twin of the reference's pipeline-parallel recipes (SURVEY
+§2.12: DeepSpeed PP via examples/deepspeed-multinode/sky.yaml), built the
+TPU way as a pure SPMD "shift-register" pipeline (the MaxText approach):
+
+  * Layer params are viewed as [P, L/P, ...] with the leading stage dim
+    sharded over the 'stage' mesh axis — each stage's devices hold only
+    their own block of layers.
+  * A state buffer [P, mb, ...] (stage-sharded) holds the activation
+    currently *at* each stage. Every tick, a vmap over the stage dim
+    applies each stage's layer block to its lane — pure data parallelism
+    over 'stage', no manual collectives.
+  * `jnp.roll(state, 1, axis=0)` hands each stage's output to its
+    successor; XLA lowers the roll of a stage-sharded array to a
+    collective-permute over ICI/DCN neighbors.
+  * Everything is ordinary jnp under jit: AD, remat, and the other mesh
+    axes (data/fsdp/tensor/...) compose with no special cases.
+
+Schedule: classic GPipe fill-drain. For M microbatches and P stages the
+loop runs M + P - 1 ticks; bubble fraction is (P-1)/(M+P-1). Reverse-mode
+AD through the scan + roll yields the backward sweep automatically.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def pipeline_apply(layer_fn: Callable[[jax.Array, Any], jax.Array],
+                   stacked_params: Any,
+                   x: jax.Array,
+                   mesh: Mesh,
+                   n_microbatches: int,
+                   stage_axis: str = 'stage',
+                   remat: bool = False) -> jax.Array:
+    """Apply L stacked layers to x, pipelined over the stage axis.
+
+    Args:
+      layer_fn: (x_mb [mb, ...], one_layer_params) -> x_mb — one layer.
+      stacked_params: pytree whose leaves have leading dim L (the layer
+        axis), sharded over `stage_axis` (use mesh.PIPELINE_RULES so
+        'layers' maps to 'stage').
+      x: [B, ...] activations; B % n_microbatches == 0.
+      mesh: mesh containing `stage_axis`.
+      n_microbatches: GPipe microbatch count M (bubble = (P-1)/(M+P-1)).
+      remat: checkpoint each stage block (recompute in backward).
+
+    Returns [B, ...], replicated over the stage axis (ordinary SPMD
+    downstream).
+    """
+    n_stages = int(mesh.shape[stage_axis])
+    if x.shape[0] % n_microbatches:
+        raise ValueError(f'Batch {x.shape[0]} not divisible by '
+                         f'n_microbatches={n_microbatches}.')
+
+    def stage_block(params_block, x_in):
+        def one(x, lp):
+            return layer_fn(x, lp), None
+        y, _ = jax.lax.scan(one, x_in, params_block)
+        return y
+
+    if remat:
+        stage_block = jax.checkpoint(
+            stage_block,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+
+    if n_stages == 1:
+        return stage_block(stacked_params, x)
+
+    n_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if n_layers % n_stages:
+        raise ValueError(f'{n_layers} layers not divisible by '
+                         f'{n_stages} pipeline stages.')
+
+    m = n_microbatches
+    mb = x.shape[0] // m
+    xs = x.reshape((m, mb) + x.shape[1:])
+
+    # [P, L/P, ...] with the stage dim pinned to the stage mesh axis.
+    staged_spec = P(stage_axis)
+    params_staged = jax.tree.map(
+        lambda a: jax.lax.with_sharding_constraint(
+            a.reshape((n_stages, n_layers // n_stages) + a.shape[1:]),
+            NamedSharding(mesh, staged_spec)),
+        stacked_params)
+
+    state_sharding = NamedSharding(mesh, P(stage_axis))
+
+    def constrain(s):
+        return jax.lax.with_sharding_constraint(s, state_sharding)
+
+    state0 = constrain(jnp.zeros((n_stages,) + xs.shape[1:], x.dtype))
+    out0 = jnp.zeros_like(xs)
+
+    def tick(carry, t):
+        state, out = carry
+        # Inject the next microbatch into the stage-0 lane.
+        mb_t = xs[jnp.clip(t, 0, m - 1)].astype(x.dtype)
+        state = state.at[0].set(mb_t)
+        # Each stage advances its lane by its own layer block (vmap over
+        # the stage-sharded dim → per-stage compute, zero communication).
+        state = constrain(jax.vmap(stage_block)(params_staged, state))
+        # The last lane just finished microbatch t-(P-1): emit it.
+        y = state[n_stages - 1]
+        oidx = jnp.clip(t - (n_stages - 1), 0, m - 1)
+        write = t >= n_stages - 1
+        out = jax.lax.dynamic_update_index_in_dim(
+            out, jnp.where(write, y, out[oidx]), oidx, 0)
+        # Hand each lane to its successor (collective-permute over ICI).
+        state = constrain(jnp.roll(state, 1, axis=0))
+        return (state, out), None
+
+    (_, out), _ = jax.lax.scan(tick, (state0, out0),
+                               jnp.arange(m + n_stages - 1))
+    return out.reshape(x.shape)

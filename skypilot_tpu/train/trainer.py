@@ -35,6 +35,7 @@ class TrainConfig:
     weight_decay: float = 0.1
     grad_clip_norm: float = 1.0
     optimizer: str = 'adamw'   # 'adamw' | 'adafactor'
+    n_microbatches: int = 4    # GPipe microbatches when mesh stage > 1
     seed: int = 0
 
 
@@ -60,8 +61,16 @@ class Trainer:
             config.mesh_plan)
         self.optimizer = make_optimizer(config)
         self._model_lib = models.module_for(config.model)
+        self._n_stages = int(self.mesh.shape.get('stage', 1))
+        if self._n_stages > 1 and self._model_lib is not llama:
+            raise NotImplementedError(
+                'Pipeline parallelism is wired for the dense Llama stack '
+                'only (MoE layers are not pipelined yet).')
+        self._rules = (mesh_lib.PIPELINE_RULES if self._n_stages > 1
+                       else mesh_lib.DEFAULT_RULES)
         self._param_shardings = mesh_lib.tree_shardings(
-            self.mesh, self._model_lib.logical_axes(config.model))
+            self.mesh, self._model_lib.logical_axes(config.model),
+            rules=self._rules)
         self._batch_sharding = NamedSharding(
             self.mesh, PartitionSpec(('data', 'fsdp'), None))
         self._compiled_step = None
@@ -118,6 +127,11 @@ class Trainer:
         c = self.config
 
         def loss_of(params):
+            if self._n_stages > 1:
+                return llama.pipelined_loss_fn(
+                    c.model, params, batch['tokens'], batch['targets'],
+                    mesh=self.mesh, n_microbatches=c.n_microbatches,
+                    loss_mask=batch.get('mask'))
             return self._model_lib.loss_fn(c.model, params, batch['tokens'],
                                            batch['targets'], mesh=self.mesh,
                                            loss_mask=batch.get('mask'))

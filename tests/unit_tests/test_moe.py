@@ -128,3 +128,28 @@ class TestExpertParallel:
         for i in range(3):
             state, metrics = trainer.step(state, batch)
         assert float(metrics['loss']) < loss0
+
+
+class TestPaddingMask:
+
+    def test_masked_tokens_excluded_from_routing(self, tiny):
+        t, d = 32, tiny.d_model
+        x = jax.random.normal(jax.random.PRNGKey(1), (t, d))
+        router_w = jax.random.normal(
+            jax.random.PRNGKey(2), (d, tiny.n_experts)) * 0.02
+        mask = jnp.concatenate([jnp.ones(8), jnp.zeros(24)])
+        dispatch, combine, _ = moe.route(tiny, router_w, x,
+                                         token_mask=mask)
+        # Pad tokens get no dispatch/combine mass at all.
+        assert float(jnp.sum(dispatch[8:])) == 0.0
+        assert float(jnp.sum(combine[8:])) == 0.0
+        assert float(jnp.sum(dispatch[:8])) > 0
+
+    def test_masked_loss_runs(self, tiny, tiny_params):
+        tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0,
+                                    tiny.vocab_size, dtype=jnp.int32)
+        targets = jnp.roll(tokens, -1, axis=1)
+        mask = jnp.ones((2, 16)).at[:, 8:].set(0.0)
+        loss = moe.loss_fn(tiny, tiny_params, tokens, targets,
+                           loss_mask=mask)
+        assert bool(jnp.isfinite(loss))
