@@ -4,5 +4,7 @@ from skypilot_tpu.clouds.cloud import CloudImplementationFeatures
 from skypilot_tpu.clouds.cloud import Region
 from skypilot_tpu.clouds.fake import Fake
 from skypilot_tpu.clouds.gcp import GCP
+from skypilot_tpu.clouds.kubernetes import Kubernetes
 
-__all__ = ['Cloud', 'CloudImplementationFeatures', 'Region', 'GCP', 'Fake']
+__all__ = ['Cloud', 'CloudImplementationFeatures', 'Region', 'GCP', 'Fake',
+           'Kubernetes']

@@ -1,0 +1,223 @@
+"""Kubernetes cloud: pods as hosts, GKE TPU podslices as first-class.
+
+Twin of sky/clouds/kubernetes.py (990 LoC) + the GKE TPU labeling logic in
+sky/provision/kubernetes/utils.py:78,399-423 (`google.com/tpu` resource,
+`cloud.google.com/gke-tpu-accelerator` / `gke-tpu-topology` selectors).
+Redesigned for the TPU-first model: a TPU podslice request resolves through
+the same SliceTopology database as the TPU-VM path, so `tpu-v6e-16` means
+the identical slice shape on GKE as on plain TPU VMs — one grammar, two
+provisioners.
+
+Kubernetes has no price catalog: costs are 0 (on-prem/committed capacity),
+so the optimizer prefers it whenever it is enabled and feasible — matching
+the reference's treatment.
+"""
+from __future__ import annotations
+
+import shutil
+import subprocess
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+from skypilot_tpu.utils import tpu_topology
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+_Features = cloud_lib.CloudImplementationFeatures
+
+# TPU generation → GKE node-pool accelerator label value
+# (sky/provision/kubernetes/utils.py:116,423; cloud.google.com/tpu docs).
+GKE_TPU_ACCELERATOR_LABELS = {
+    'v4': 'tpu-v4-podslice',
+    'v5e': 'tpu-v5-lite-podslice',
+    'v5p': 'tpu-v5p-slice',
+    'v6e': 'tpu-v6e-slice',
+}
+TPU_RESOURCE_KEY = 'google.com/tpu'
+GKE_TPU_ACCELERATOR_LABEL_KEY = 'cloud.google.com/gke-tpu-accelerator'
+GKE_TPU_TOPOLOGY_LABEL_KEY = 'cloud.google.com/gke-tpu-topology'
+
+_DEFAULT_CPUS = 2
+_DEFAULT_MEMORY_GIB = 8
+
+
+def _parse_spec(spec: Optional[str], default: float) -> float:
+    if spec is None:
+        return default
+    s = str(spec).strip()
+    if s.endswith('+'):
+        return float(s[:-1])
+    return float(s)
+
+
+@registry.CLOUD_REGISTRY.register(aliases=['k8s'])
+class Kubernetes(cloud_lib.Cloud):
+    _REPR = 'Kubernetes'
+    _MAX_CLUSTER_NAME_LEN_LIMIT = 40  # pod-name suffix room within 63
+
+    def unsupported_features_for_resources(
+        self, resources: 'resources_lib.Resources'
+    ) -> Dict[_Features, str]:
+        del resources
+        return {
+            # Pods have no stopped state: autostop tears down instead.
+            _Features.STOP: 'Pods cannot be stopped, only deleted.',
+            _Features.AUTOSTOP:
+                'Autostop on Kubernetes performs teardown instead of stop.',
+            _Features.SPOT_INSTANCE:
+                'Use spot/preemptible node pools instead of the spot flag.',
+            _Features.CUSTOM_DISK_TIER: 'No disk tiers for pods.',
+        }
+
+    # ---- placement: contexts play the role of regions ----
+
+    def _contexts(self) -> List[str]:
+        try:
+            proc = subprocess.run(
+                ['kubectl', 'config', 'get-contexts', '-o', 'name'],
+                capture_output=True, text=True, timeout=15, check=False)
+        except (OSError, subprocess.TimeoutExpired):
+            return []
+        if proc.returncode != 0:
+            return []
+        return [c for c in proc.stdout.split() if c]
+
+    def regions_with_offering(self, instance_type: str,
+                              accelerators: Optional[Dict[str, Any]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[cloud_lib.Region]:
+        del instance_type, accelerators, use_spot, zone
+        contexts = self._contexts() or ['in-cluster']
+        if region is not None:
+            contexts = [c for c in contexts if c == region]
+        return [cloud_lib.Region(c, [c]) for c in contexts]
+
+    def zones_provision_loop(self, region: str, num_nodes: int,
+                             instance_type: str,
+                             accelerators: Optional[Dict[str, Any]] = None,
+                             use_spot: bool = False) -> Iterator[List[str]]:
+        del num_nodes, instance_type, accelerators, use_spot
+        yield [region]
+
+    # ---- pricing: free (on-prem / pre-committed) ----
+
+    def instance_type_to_hourly_cost(self, instance_type: str, use_spot: bool,
+                                     region: Optional[str] = None,
+                                     zone: Optional[str] = None) -> float:
+        return 0.0
+
+    def accelerators_to_hourly_cost(self, accelerators: Dict[str, float],
+                                    use_spot: bool,
+                                    region: Optional[str] = None,
+                                    zone: Optional[str] = None) -> float:
+        return 0.0
+
+    # ---- feasibility ----
+
+    def instance_type_exists(self, instance_type: str) -> bool:
+        return self._parse_instance_type(instance_type) is not None
+
+    def validate_region_zone(self, region: Optional[str],
+                             zone: Optional[str]) -> None:
+        pass  # contexts are validated at provision time
+
+    @staticmethod
+    def make_instance_type(cpus: float, memory_gib: float) -> str:
+        return f'{cpus:g}CPU--{memory_gib:g}GB'
+
+    @staticmethod
+    def _parse_instance_type(
+            instance_type: str) -> Optional[Tuple[float, float]]:
+        try:
+            cpu_part, mem_part = instance_type.split('--')
+            return float(cpu_part[:-3]), float(mem_part[:-2])
+        except (ValueError, AttributeError):
+            return None
+
+    def get_default_instance_type(
+            self, cpus: Optional[str] = None,
+            memory: Optional[str] = None) -> Optional[str]:
+        return self.make_instance_type(
+            _parse_spec(cpus, _DEFAULT_CPUS),
+            _parse_spec(memory, _DEFAULT_MEMORY_GIB))
+
+    def get_feasible_launchable_resources(
+        self, resources: 'resources_lib.Resources'
+    ) -> Tuple[List['resources_lib.Resources'], List[str]]:
+        acc = resources.accelerators
+        if acc is not None:
+            name = next(iter(acc))
+            if tpu_topology.is_tpu(name):
+                topo = tpu_topology.parse(name, resources.accelerator_args)
+                if topo.generation.name not in GKE_TPU_ACCELERATOR_LABELS:
+                    return [], sorted(GKE_TPU_ACCELERATOR_LABELS)
+        instance_type = resources.instance_type or \
+            self.get_default_instance_type(resources.cpus, resources.memory)
+        if instance_type and self._parse_instance_type(instance_type) is None:
+            return [], []
+        return [resources.copy(cloud=self.name,
+                               instance_type=instance_type)], []
+
+    # ---- provisioner handoff ----
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        parsed = self._parse_instance_type(resources.instance_type or '')
+        cpus, memory = parsed if parsed else (_DEFAULT_CPUS,
+                                              _DEFAULT_MEMORY_GIB)
+        vars: Dict[str, Any] = {
+            'cluster_name': cluster_name,
+            'context': None if region == 'in-cluster' else region,
+            'namespace': (resources.labels or {}).get(
+                'kubernetes/namespace', 'default'),
+            'cpus': cpus,
+            'memory_gib': memory,
+            'image_id': resources.image_id or
+                        'python:3.11-slim',
+            'labels': dict(resources.labels or {}),
+            'ports': resources.ports,
+        }
+        acc = resources.accelerators
+        if acc:
+            name, count = next(iter(acc.items()))
+            if tpu_topology.is_tpu(name):
+                topo = tpu_topology.parse(name, resources.accelerator_args)
+                vars.update({
+                    'tpu_podslice': True,
+                    'tpu_gke_accelerator': GKE_TPU_ACCELERATOR_LABELS[
+                        topo.generation.name],
+                    'tpu_gke_topology': topo.topology_str,
+                    'tpu_num_hosts': topo.num_hosts,
+                    'tpu_chips_per_host': topo.chips_per_host,
+                    'tpu_num_slices': topo.num_slices,
+                })
+            else:
+                vars.update({'gpu_type': name, 'gpu_count': count})
+        return vars
+
+    # ---- credentials ----
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        if shutil.which('kubectl') is None:
+            return False, 'kubectl not found on PATH.'
+        try:
+            proc = subprocess.run(
+                ['kubectl', 'config', 'current-context'],
+                capture_output=True, text=True, timeout=15, check=False)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            return False, f'kubectl not usable: {e}'
+        if proc.returncode != 0:
+            return False, ('No current kubectl context; run '
+                           '`kubectl config use-context <ctx>`.')
+        return True, None
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        import os
+        path = os.path.expanduser('~/.kube/config')
+        if os.path.exists(path):
+            return {'~/.kube/config': '~/.kube/config'}
+        return {}

@@ -18,6 +18,7 @@ import numpy as np
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.infer import engine as engine_lib
+from skypilot_tpu.infer import sampling as sampling_lib
 
 logger = sky_logging.init_logger(__name__)
 
@@ -94,14 +95,12 @@ class Orchestrator:
             request.max_new_tokens = (self.engine.config.max_target_len -
                                       prompt_len)
         slot = self._free_slots.pop()
-        from skypilot_tpu.infer import sampling as sampling_lib
-        self._key, prefill_key = jax.random.split(self._key)
+        # Key omitted: the engine owns sampling-key state (split per call).
         first_token, kv, true_len = self.engine.prefill(
             request.prompt_tokens,
             sampling_params=sampling_lib.SamplingParams(
                 temperature=request.temperature, top_k=request.top_k,
-                top_p=request.top_p),
-            key=prefill_key)
+                top_p=request.top_p))
         self.state = self.engine.insert(self.state, kv, first_token,
                                         true_len, slot)
         request.output_tokens.append(int(first_token))

@@ -326,10 +326,18 @@ def loss_fn(config: MoEConfig,
             tokens: jax.Array,
             targets: jax.Array,
             mesh: Optional[mesh_lib.Mesh] = None,
-            loss_mask: Optional[jax.Array] = None) -> jax.Array:
-    """Next-token cross-entropy + router load-balance auxiliary loss."""
+            loss_mask: Optional[jax.Array] = None,
+            token_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Next-token cross-entropy + router load-balance auxiliary loss.
+
+    loss_mask [B,S] selects which TARGETS count in the CE term (e.g. SFT
+    masks prompt positions). token_mask [B,S] marks which INPUT positions
+    are real (pads excluded from expert routing). They are distinct: a
+    prompt token contributes no loss but must still flow through its
+    experts, so loss_mask is never used for routing.
+    """
     logits, aux = forward(config, params, tokens, mesh=mesh,
-                          return_aux=True, token_mask=loss_mask)
+                          return_aux=True, token_mask=token_mask)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     if loss_mask is not None:

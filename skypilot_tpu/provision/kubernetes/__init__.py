@@ -1,0 +1,1 @@
+"""Kubernetes (incl. GKE TPU podslice) provisioner."""

@@ -132,9 +132,15 @@ class Trainer:
                     c.model, params, batch['tokens'], batch['targets'],
                     mesh=self.mesh, n_microbatches=c.n_microbatches,
                     loss_mask=batch.get('mask'))
+            kwargs = {}
+            if self._model_lib is not llama:
+                # MoE: pads are excluded from routing; the loss mask (which
+                # targets count) is a separate concern.
+                kwargs['token_mask'] = batch.get('token_mask')
             return self._model_lib.loss_fn(c.model, params, batch['tokens'],
                                            batch['targets'], mesh=self.mesh,
-                                           loss_mask=batch.get('mask'))
+                                           loss_mask=batch.get('mask'),
+                                           **kwargs)
 
         loss, grads = jax.value_and_grad(loss_of)(state['params'])
         updates, new_opt = self.optimizer.update(grads, state['opt_state'],

@@ -73,10 +73,28 @@ def _make_env_prefix(env: Optional[Dict[str, str]]) -> str:
 
 
 class CommandRunner:
-    """Abstract runner bound to one host."""
+    """Abstract runner bound to one host.
+
+    rsync convention (all runners): `source` is always the LOCAL path and
+    `target` is always the REMOTE path, for both directions; `up` only
+    selects which way bytes flow.
+    """
 
     def __init__(self, node_id: str) -> None:
         self.node_id = node_id
+
+    @staticmethod
+    def _finish(proc, log_path, stream_logs, require_outputs):
+        """Shared post-processing for a completed subprocess."""
+        if log_path:
+            with open(log_path, 'a', encoding='utf-8') as f:
+                f.write(proc.stdout)
+                f.write(proc.stderr)
+        if stream_logs and proc.stdout:
+            print(proc.stdout, end='')
+        if require_outputs:
+            return proc.returncode, proc.stdout, proc.stderr
+        return proc.returncode
 
     def run(self,
             cmd: Union[str, List[str]],
@@ -128,15 +146,7 @@ class LocalProcessCommandRunner(CommandRunner):
         full = self._wrap(cmd, env, cwd)
         proc = subprocess.run(['bash', '-c', full], capture_output=True,
                               text=True, timeout=timeout, check=False)
-        if log_path:
-            with open(log_path, 'a', encoding='utf-8') as f:
-                f.write(proc.stdout)
-                f.write(proc.stderr)
-        if stream_logs and proc.stdout:
-            print(proc.stdout, end='')
-        if require_outputs:
-            return proc.returncode, proc.stdout, proc.stderr
-        return proc.returncode
+        return self._finish(proc, log_path, stream_logs, require_outputs)
 
     def run_async(self, cmd, *, env=None, log_path=None, cwd=None):
         full = self._wrap(cmd, env, cwd)
@@ -193,15 +203,7 @@ class SSHCommandRunner(CommandRunner):
         full = self._ssh_base() + [remote]
         proc = subprocess.run(full, capture_output=True, text=True,
                               timeout=timeout, check=False)
-        if log_path:
-            with open(log_path, 'a', encoding='utf-8') as f:
-                f.write(proc.stdout)
-                f.write(proc.stderr)
-        if stream_logs and proc.stdout:
-            print(proc.stdout, end='')
-        if require_outputs:
-            return proc.returncode, proc.stdout, proc.stderr
-        return proc.returncode
+        return self._finish(proc, log_path, stream_logs, require_outputs)
 
     def run_async(self, cmd, *, env=None, log_path=None, cwd=None):
         prefix = _make_env_prefix(env)
@@ -227,6 +229,81 @@ class SSHCommandRunner(CommandRunner):
         subprocess.run(args, check=True, capture_output=True)
 
 
+class KubernetesCommandRunner(CommandRunner):
+    """kubectl-exec runner bound to one pod (twin of
+    sky/utils/command_runner.py:732)."""
+
+    def __init__(self, pod_name: str, namespace: str = 'default',
+                 context: Optional[str] = None,
+                 container: str = 'xsky') -> None:
+        super().__init__(pod_name)
+        self.pod_name = pod_name
+        self.namespace = namespace
+        self.context = context
+        self.container = container
+
+    def _kubectl_base(self) -> List[str]:
+        cmd = ['kubectl']
+        if self.context:
+            cmd += ['--context', self.context]
+        return cmd + ['-n', self.namespace]
+
+    def _exec_base(self) -> List[str]:
+        return self._kubectl_base() + [
+            'exec', '-i', self.pod_name, '-c', self.container, '--']
+
+    def run(self, cmd, *, env=None, cwd=None, stream_logs=False,
+            log_path=None, require_outputs=False, timeout=None):
+        if isinstance(cmd, list):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        prefix = _make_env_prefix(env)
+        if cwd:
+            cmd = f'cd {shlex.quote(cwd)} && {cmd}'
+        full = self._exec_base() + ['bash', '-c', prefix + cmd]
+        proc = subprocess.run(full, capture_output=True, text=True,
+                              timeout=timeout, check=False)
+        return self._finish(proc, log_path, stream_logs, require_outputs)
+
+    def run_async(self, cmd, *, env=None, log_path=None, cwd=None):
+        prefix = _make_env_prefix(env)
+        if cwd:
+            cmd = f'cd {shlex.quote(cwd)} && {cmd}'
+        full = self._exec_base() + ['bash', '-c', prefix + cmd]
+        out = open(log_path, 'ab') if log_path else subprocess.DEVNULL
+        return subprocess.Popen(full, stdout=out, stderr=subprocess.STDOUT)
+
+    def rsync(self, source: str, target: str, *, up: bool, excludes=None):
+        """File sync via `kubectl cp` (tar under the hood).
+
+        Same convention as every runner: `source` local, `target` remote.
+        Excludes are applied by staging a filtered copy locally first —
+        kubectl cp has no exclude support.
+        """
+        import shutil
+        source = os.path.expanduser(source)
+        remote = f'{self.namespace}/{self.pod_name}:{target}'
+        if up:
+            staged = source
+            stage_dir = None
+            if excludes and os.path.isdir(source):
+                stage_dir = tempfile.mkdtemp(prefix='xsky-kcp-')
+                _local_sync(source.rstrip('/') + '/', stage_dir, excludes)
+                staged = stage_dir
+            try:
+                self.run('mkdir -p '
+                         f'{shlex.quote(os.path.dirname(target) or "/")}')
+                subprocess.run(self._kubectl_base() +
+                               ['cp', '-c', self.container, staged, remote],
+                               check=True, capture_output=True)
+            finally:
+                if stage_dir is not None:
+                    shutil.rmtree(stage_dir, ignore_errors=True)
+        else:
+            subprocess.run(self._kubectl_base() +
+                           ['cp', '-c', self.container, remote, source],
+                           check=True, capture_output=True)
+
+
 def runners_from_cluster_info(cluster_info, ssh_private_key: str,
                               use_local: bool = False,
                               internal_ips: bool = False
@@ -242,6 +319,13 @@ def runners_from_cluster_info(cluster_info, ssh_private_key: str,
                 LocalProcessCommandRunner(
                     info.instance_id,
                     host_root=info.tags.get('host_root')))
+        elif cluster_info.provider_name == 'kubernetes':
+            cfg = cluster_info.provider_config or {}
+            runners.append(
+                KubernetesCommandRunner(
+                    info.instance_id,
+                    namespace=cfg.get('namespace', 'default'),
+                    context=cfg.get('context')))
         else:
             ip = info.internal_ip if internal_ips else \
                 info.get_feasible_ip()

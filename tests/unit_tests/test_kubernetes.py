@@ -1,0 +1,222 @@
+"""Kubernetes cloud + provisioner tests (in-memory kubectl fake).
+
+The fake kubectl plays moto's role (reference tests/test_failover.py):
+every provisioner op goes through instance._run_kubectl, which we replace
+with a dict-backed implementation.
+"""
+import json
+
+import pytest
+
+from skypilot_tpu.clouds import kubernetes as k8s_cloud
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.kubernetes import instance as k8s_instance
+from skypilot_tpu.utils import command_runner
+
+
+class FakeKubectl:
+    """Dict-backed kubectl: supports the verbs the provisioner uses."""
+
+    def __init__(self):
+        self.pods = {}       # name -> manifest (with injected status)
+        self.services = {}
+
+    def __call__(self, args, context=None, namespace=None, input_data=None,
+                 timeout=60.0):
+        verb = args[0]
+        if verb == 'apply':
+            items = json.loads(input_data)
+            if items.get('kind') == 'List':
+                items = items['items']
+            else:
+                items = [items]
+            for m in items:
+                name = m['metadata']['name']
+                if m['kind'] == 'Pod':
+                    m.setdefault('status',
+                                 {'phase': 'Running', 'podIP':
+                                  f'10.0.0.{len(self.pods) + 1}'})
+                    self.pods[name] = m
+                else:
+                    self.services[name] = m
+            return ''
+        if verb == 'get':
+            selector = args[args.index('-l') + 1]
+            key, value = selector.split('=')
+            items = [
+                p for p in self.pods.values()
+                if p['metadata'].get('labels', {}).get(key) == value
+            ]
+            return json.dumps({'items': items})
+        if verb == 'delete':
+            if args[1] == 'pods,services':
+                selector = args[args.index('-l') + 1]
+                key, value = selector.split('=')
+                self.pods = {
+                    n: p for n, p in self.pods.items()
+                    if p['metadata'].get('labels', {}).get(key) != value
+                }
+                self.services = {
+                    n: s for n, s in self.services.items()
+                    if s['metadata'].get('labels', {}).get(key) != value
+                }
+                return ''
+            if args[1] == 'service':
+                self.services.pop(args[2], None)
+                return ''
+        raise AssertionError(f'FakeKubectl: unhandled {args}')
+
+
+@pytest.fixture
+def fake_kubectl(monkeypatch):
+    fake = FakeKubectl()
+    monkeypatch.setattr(k8s_instance, '_run_kubectl', fake)
+    return fake
+
+
+def _tpu_config(count=1):
+    cloud = k8s_cloud.Kubernetes()
+    from skypilot_tpu import resources as resources_lib
+    res = resources_lib.Resources(cloud='kubernetes',
+                                  accelerators='tpu-v6e-16')
+    node_config = cloud.make_deploy_resources_variables(
+        res, 'mycluster', 'in-cluster', None)
+    return common.ProvisionConfig(provider_config={
+        'context': None, 'namespace': 'default'},
+        node_config=node_config, count=count)
+
+
+class TestKubernetesCloud:
+
+    def test_tpu_deploy_variables(self):
+        config = _tpu_config()
+        node = config.node_config
+        assert node['tpu_podslice'] is True
+        assert node['tpu_gke_accelerator'] == 'tpu-v6e-slice'
+        assert node['tpu_num_hosts'] == 4       # v6e-16 = 4 hosts x 4 chips
+        assert node['tpu_chips_per_host'] == 4
+        assert node['tpu_gke_topology'] == '4x4'
+
+    def test_instance_type_roundtrip(self):
+        cloud = k8s_cloud.Kubernetes()
+        itype = cloud.get_default_instance_type(cpus='8', memory='32')
+        assert itype == '8CPU--32GB'
+        assert cloud.instance_type_exists(itype)
+        assert cloud._parse_instance_type(itype) == (8.0, 32.0)
+
+    def test_feasible_resources_keep_tpu(self):
+        from skypilot_tpu import resources as resources_lib
+        cloud = k8s_cloud.Kubernetes()
+        res = resources_lib.Resources(cloud='kubernetes',
+                                      accelerators='tpu-v5e-8')
+        candidates, fuzzy = cloud.get_feasible_launchable_resources(res)
+        assert len(candidates) == 1
+        assert not fuzzy
+        assert candidates[0].accelerators == {'tpu-v5e-8': 1}
+
+    def test_zero_cost(self):
+        cloud = k8s_cloud.Kubernetes()
+        assert cloud.instance_type_to_hourly_cost('8CPU--32GB', False) == 0
+        assert cloud.accelerators_to_hourly_cost({'tpu-v6e-16': 1},
+                                                 False) == 0
+
+
+class TestKubernetesProvisioner:
+
+    def test_tpu_podslice_creates_one_pod_per_host(self, fake_kubectl):
+        config = _tpu_config()
+        record = k8s_instance.run_instances('in-cluster', None, 'mycluster',
+                                            config)
+        assert len(record.created_instance_ids) == 4
+        assert record.head_instance_id == 'mycluster-0'
+        # Pods carry GKE TPU selectors + google.com/tpu limits.
+        pod = fake_kubectl.pods['mycluster-0']
+        sel = pod['spec']['nodeSelector']
+        assert sel['cloud.google.com/gke-tpu-accelerator'] == 'tpu-v6e-slice'
+        assert sel['cloud.google.com/gke-tpu-topology'] == '4x4'
+        limits = pod['spec']['containers'][0]['resources']['limits']
+        assert limits['google.com/tpu'] == '4'
+        # Headless service for gang DNS.
+        assert 'mycluster' in fake_kubectl.services
+        assert fake_kubectl.services['mycluster']['spec']['clusterIP'] == \
+            'None'
+
+    def test_idempotent_run_instances(self, fake_kubectl):
+        config = _tpu_config()
+        k8s_instance.run_instances('in-cluster', None, 'mycluster', config)
+        record2 = k8s_instance.run_instances('in-cluster', None, 'mycluster',
+                                             config)
+        assert record2.created_instance_ids == []
+        assert len(fake_kubectl.pods) == 4
+
+    def test_query_and_cluster_info(self, fake_kubectl):
+        config = _tpu_config()
+        k8s_instance.run_instances('in-cluster', None, 'mycluster', config)
+        statuses = k8s_instance.query_instances('mycluster', {})
+        assert set(statuses.values()) == {'RUNNING'}
+        info = k8s_instance.get_cluster_info('in-cluster', 'mycluster', {})
+        assert len(info.instances) == 4
+        assert info.head_instance_id == 'mycluster-0'
+        hosts = info.sorted_instances()
+        assert [h.host_index for h in hosts] == [0, 1, 2, 3]
+        assert all(h.internal_ip for h in hosts)
+        # All four hosts share one slice id (one v6e-16 slice).
+        assert len({h.slice_id for h in hosts}) == 1
+
+    def test_stop_unsupported_terminate_works(self, fake_kubectl):
+        config = _tpu_config()
+        k8s_instance.run_instances('in-cluster', None, 'mycluster', config)
+        from skypilot_tpu import exceptions
+        with pytest.raises(exceptions.NotSupportedError):
+            k8s_instance.stop_instances('mycluster', {})
+        k8s_instance.terminate_instances('mycluster', {})
+        assert fake_kubectl.pods == {}
+        assert k8s_instance.query_instances('mycluster', {}) == {}
+
+    def test_open_and_cleanup_ports(self, fake_kubectl):
+        config = _tpu_config()
+        k8s_instance.run_instances('in-cluster', None, 'mycluster', config)
+        k8s_instance.open_ports('mycluster', ['8080'], {})
+        svc = fake_kubectl.services['mycluster-ports']
+        assert svc['spec']['type'] == 'NodePort'
+        assert svc['spec']['ports'][0]['port'] == 8080
+        k8s_instance.cleanup_ports('mycluster', {})
+        assert 'mycluster-ports' not in fake_kubectl.services
+
+
+class TestKubernetesCommandRunner:
+
+    def test_exec_command_construction(self, monkeypatch):
+        captured = {}
+
+        def fake_run(cmd, **kwargs):
+            captured['cmd'] = cmd
+            import subprocess as sp
+            return sp.CompletedProcess(cmd, 0, stdout='hi', stderr='')
+
+        import subprocess
+        monkeypatch.setattr(subprocess, 'run', fake_run)
+        runner = command_runner.KubernetesCommandRunner(
+            'mycluster-0', namespace='ns1', context='ctx1')
+        code, out, _ = runner.run('echo hi', require_outputs=True,
+                                  env={'A': '1'})
+        assert code == 0 and out == 'hi'
+        cmd = captured['cmd']
+        assert cmd[:7] == ['kubectl', '--context', 'ctx1', '-n', 'ns1',
+                           'exec', '-i']
+        assert 'mycluster-0' in cmd
+        assert cmd[-1].startswith('export A=1; ')
+
+    def test_runners_from_cluster_info(self, fake_kubectl):
+        config = _tpu_config()
+        k8s_instance.run_instances('in-cluster', None, 'mycluster', config)
+        info = k8s_instance.get_cluster_info(
+            'in-cluster', 'mycluster',
+            {'namespace': 'ns2', 'context': 'ctx2'})
+        runners = command_runner.runners_from_cluster_info(info, 'unused')
+        assert len(runners) == 4
+        assert all(isinstance(r, command_runner.KubernetesCommandRunner)
+                   for r in runners)
+        assert runners[0].pod_name == 'mycluster-0'
+        assert runners[0].namespace == 'ns2'
+        assert runners[0].context == 'ctx2'
