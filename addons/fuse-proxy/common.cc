@@ -1,0 +1,182 @@
+#include "common.hpp"
+
+#include <cerrno>
+#include <cstring>
+
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+namespace fuseproxy {
+
+bool WriteAll(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::write(fd, p, n);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool ReadAll(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    if (r == 0) return false;  // EOF mid-message
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteU32(int fd, uint32_t v) { return WriteAll(fd, &v, sizeof(v)); }
+
+bool ReadU32(int fd, uint32_t* v) { return ReadAll(fd, v, sizeof(*v)); }
+
+bool WriteString(int fd, const std::string& s) {
+  return WriteU32(fd, static_cast<uint32_t>(s.size())) &&
+         (s.empty() || WriteAll(fd, s.data(), s.size()));
+}
+
+bool ReadString(int fd, std::string* s, uint32_t max_len) {
+  uint32_t len = 0;
+  if (!ReadU32(fd, &len) || len > max_len) return false;
+  s->resize(len);
+  return len == 0 || ReadAll(fd, &(*s)[0], len);
+}
+
+bool SendFd(int sock, int fd) {
+  char marker = 'F';
+  struct iovec iov { &marker, 1 };
+  char cbuf[CMSG_SPACE(sizeof(int))] = {};
+  struct msghdr msg = {};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  msg.msg_control = cbuf;
+  msg.msg_controllen = sizeof(cbuf);
+  struct cmsghdr* cmsg = CMSG_FIRSTHDR(&msg);
+  cmsg->cmsg_level = SOL_SOCKET;
+  cmsg->cmsg_type = SCM_RIGHTS;
+  cmsg->cmsg_len = CMSG_LEN(sizeof(int));
+  std::memcpy(CMSG_DATA(cmsg), &fd, sizeof(int));
+  return ::sendmsg(sock, &msg, 0) == 1;
+}
+
+int RecvFd(int sock) {
+  char marker = 0;
+  struct iovec iov { &marker, 1 };
+  char cbuf[CMSG_SPACE(sizeof(int))] = {};
+  struct msghdr msg = {};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  msg.msg_control = cbuf;
+  msg.msg_controllen = sizeof(cbuf);
+  if (::recvmsg(sock, &msg, 0) != 1) return -1;
+  for (struct cmsghdr* cmsg = CMSG_FIRSTHDR(&msg); cmsg != nullptr;
+       cmsg = CMSG_NXTHDR(&msg, cmsg)) {
+    if (cmsg->cmsg_level == SOL_SOCKET && cmsg->cmsg_type == SCM_RIGHTS) {
+      int fd = -1;
+      std::memcpy(&fd, CMSG_DATA(cmsg), sizeof(int));
+      return fd;
+    }
+  }
+  return -1;
+}
+
+bool SendRequest(int sock, const Request& req) {
+  if (!WriteU32(sock, kMagic) || !WriteU32(sock, req.mode) ||
+      !WriteU32(sock, req.want_fd ? 1 : 0) ||
+      !WriteU32(sock, static_cast<uint32_t>(req.args.size()))) {
+    return false;
+  }
+  for (const auto& a : req.args) {
+    if (!WriteString(sock, a)) return false;
+  }
+  return true;
+}
+
+bool RecvRequest(int sock, Request* req) {
+  uint32_t magic = 0, want_fd = 0, argc = 0;
+  if (!ReadU32(sock, &magic) || magic != kMagic) return false;
+  if (!ReadU32(sock, &req->mode) || !ReadU32(sock, &want_fd) ||
+      !ReadU32(sock, &argc) || argc > 256) {
+    return false;
+  }
+  req->want_fd = want_fd != 0;
+  req->args.clear();
+  for (uint32_t i = 0; i < argc; ++i) {
+    std::string a;
+    if (!ReadString(sock, &a)) return false;
+    req->args.push_back(std::move(a));
+  }
+  return true;
+}
+
+bool SendResponse(int sock, const Response& resp) {
+  if (!WriteU32(sock, static_cast<uint32_t>(resp.code)) ||
+      !WriteString(sock, resp.message)) {
+    return false;
+  }
+  if (resp.fd >= 0) return SendFd(sock, resp.fd);
+  char marker = 'N';
+  return WriteAll(sock, &marker, 1);
+}
+
+bool RecvResponse(int sock, Response* resp) {
+  uint32_t code = 0;
+  if (!ReadU32(sock, &code) || !ReadString(sock, &resp->message)) {
+    return false;
+  }
+  resp->code = static_cast<int32_t>(code);
+  // Peek the marker: 'F' means an SCM_RIGHTS fd rides along.
+  char marker = 0;
+  struct iovec iov { &marker, 1 };
+  char cbuf[CMSG_SPACE(sizeof(int))] = {};
+  struct msghdr msg = {};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  msg.msg_control = cbuf;
+  msg.msg_controllen = sizeof(cbuf);
+  if (::recvmsg(sock, &msg, 0) != 1) return false;
+  resp->fd = -1;
+  if (marker == 'F') {
+    for (struct cmsghdr* cmsg = CMSG_FIRSTHDR(&msg); cmsg != nullptr;
+         cmsg = CMSG_NXTHDR(&msg, cmsg)) {
+      if (cmsg->cmsg_level == SOL_SOCKET &&
+          cmsg->cmsg_type == SCM_RIGHTS) {
+        std::memcpy(&resp->fd, CMSG_DATA(cmsg), sizeof(int));
+      }
+    }
+    if (resp->fd < 0) return false;
+  }
+  return true;
+}
+
+int ConnectTo(const std::string& path) {
+  int sock = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (sock < 0) return -1;
+  struct sockaddr_un addr = {};
+  addr.sun_family = AF_UNIX;
+  if (path.size() >= sizeof(addr.sun_path)) {
+    ::close(sock);
+    return -1;
+  }
+  std::strncpy(addr.sun_path, path.c_str(), sizeof(addr.sun_path) - 1);
+  if (::connect(sock, reinterpret_cast<struct sockaddr*>(&addr),
+                sizeof(addr)) != 0) {
+    ::close(sock);
+    return -1;
+  }
+  return sock;
+}
+
+}  // namespace fuseproxy

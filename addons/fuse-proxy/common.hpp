@@ -1,0 +1,66 @@
+// fuse-proxy wire protocol + unix-socket helpers.
+//
+// C++ twin of the reference's Go fuse-proxy (addons/fuse-proxy/pkg/
+// common/common.go): a shim/wrapper client talks to a privileged server
+// over an AF_UNIX socket; FUSE device file descriptors travel back via
+// SCM_RIGHTS.
+//
+// Framing (all integers little-endian u32):
+//   request :=  MAGIC  mode(u32: 's' | 'm')  want_fd(u32)  argc(u32)
+//               { len(u32) bytes }*argc
+//   response := code(i32 as u32)  msg_len(u32)  msg bytes
+//               fd_marker(u32: 'F' | 'N')   -- 'F' carries one SCM_RIGHTS fd
+#pragma once
+
+#include <cstdint>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+#include <unistd.h>
+
+namespace fuseproxy {
+
+constexpr uint32_t kMagic = 0x46505258;  // "FPRX"
+constexpr uint32_t kModeShim = 's';      // forward fusermount argv
+constexpr uint32_t kModeMount = 'm';     // mount + return fuse fd (wrapper)
+
+inline const char* DefaultSocketPath() {
+  const char* p = ::getenv("FUSE_PROXY_SOCKET");
+  return p && *p ? p : "/var/run/fusermount/server.sock";
+}
+
+// All return false on error (errno left set / message in *err).
+
+bool WriteAll(int fd, const void* buf, size_t n);
+bool ReadAll(int fd, void* buf, size_t n);
+bool WriteU32(int fd, uint32_t v);
+bool ReadU32(int fd, uint32_t* v);
+bool WriteString(int fd, const std::string& s);
+bool ReadString(int fd, std::string* s, uint32_t max_len = 1u << 20);
+
+// SCM_RIGHTS: send/receive one fd alongside a single marker byte.
+bool SendFd(int sock, int fd);
+int RecvFd(int sock);  // returns fd or -1
+
+struct Request {
+  uint32_t mode = kModeShim;
+  bool want_fd = false;
+  std::vector<std::string> args;
+};
+
+struct Response {
+  int32_t code = -1;
+  std::string message;
+  int fd = -1;  // valid when >= 0
+};
+
+bool SendRequest(int sock, const Request& req);
+bool RecvRequest(int sock, Request* req);
+bool SendResponse(int sock, const Response& resp);
+bool RecvResponse(int sock, Response* resp);
+
+// Connect to the server socket; -1 on failure.
+int ConnectTo(const std::string& path);
+
+}  // namespace fuseproxy

@@ -1,0 +1,231 @@
+// fusermount-server: privileged daemon executing validated fusermount
+// operations on behalf of unprivileged containers.
+//
+// C++ twin of addons/fuse-proxy/cmd/fusermount-server/main.go +
+// pkg/server/server.go (reference). Runs as a privileged DaemonSet on
+// each node, listening on a host-shared unix socket. For every request:
+//   1. identify the calling process via SO_PEERCRED (never trust a pid
+//      claimed in the payload);
+//   2. validate the fusermount argv against a strict allow-list;
+//   3. nsenter the caller's mount namespace and exec the real
+//      `fusermount-original` found in PATH there;
+//   4. if the caller expects the mounted /dev/fuse fd (_FUSE_COMMFD
+//      protocol), capture it over a socketpair and relay it back with
+//      SCM_RIGHTS.
+//
+// XSKY_FUSE_NO_NSENTER=1 skips nsenter (tests / same-namespace use).
+#include <cerrno>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include "common.hpp"
+
+namespace fp = fuseproxy;
+
+namespace {
+
+bool ValidateShimArgs(const std::vector<std::string>& args,
+                      std::string* err) {
+  // fusermount surface we allow: -u (unmount), -z (lazy), -q (quiet),
+  // -o <opts>, and mountpoint paths. Anything else is rejected.
+  for (size_t i = 0; i < args.size(); ++i) {
+    const std::string& a = args[i];
+    if (a == "-u" || a == "-z" || a == "-q" || a == "--") continue;
+    if (a == "-o") {
+      if (i + 1 >= args.size()) {
+        *err = "-o requires an argument";
+        return false;
+      }
+      ++i;  // opts string; fusermount itself validates allowed opts
+      continue;
+    }
+    if (!a.empty() && a[0] == '-') {
+      *err = "disallowed fusermount flag: " + a;
+      return false;
+    }
+    // Mountpoint: require an absolute path with no '..' component
+    // anywhere (checked component-wise so '/x/..' and '/..' are caught,
+    // not just the '/../' infix).
+    bool bad = a.empty() || a[0] != '/';
+    size_t start = 0;
+    while (!bad && start <= a.size()) {
+      size_t end = a.find('/', start);
+      if (end == std::string::npos) end = a.size();
+      if (a.compare(start, end - start, "..") == 0) bad = true;
+      start = end + 1;
+    }
+    if (bad) {
+      *err = "mountpoint must be an absolute path without '..': " + a;
+      return false;
+    }
+  }
+  return true;
+}
+
+pid_t PeerPid(int sock) {
+  struct ucred cred = {};
+  socklen_t len = sizeof(cred);
+  if (::getsockopt(sock, SOL_SOCKET, SO_PEERCRED, &cred, &len) != 0) {
+    return -1;
+  }
+  return cred.pid;
+}
+
+// Run fusermount (via nsenter into `pid`'s mount ns unless disabled).
+// If fd_out != nullptr, set up the _FUSE_COMMFD socketpair and receive
+// the mounted fd into *fd_out.
+int RunFusermount(pid_t caller_pid, const std::vector<std::string>& args,
+                  int* fd_out, std::string* err) {
+  int sp[2] = {-1, -1};
+  if (fd_out != nullptr &&
+      ::socketpair(AF_UNIX, SOCK_STREAM, 0, sp) != 0) {
+    *err = "socketpair failed";
+    return 1;
+  }
+  bool no_nsenter = []() {
+    const char* v = ::getenv("XSKY_FUSE_NO_NSENTER");
+    return v != nullptr && *v == '1';
+  }();
+
+  std::vector<std::string> argv_s;
+  if (!no_nsenter) {
+    argv_s = {"nsenter", "-t", std::to_string(caller_pid), "-m", "--"};
+  }
+  argv_s.push_back("fusermount-original");
+  for (const auto& a : args) argv_s.push_back(a);
+
+  pid_t child = ::fork();
+  if (child < 0) {
+    *err = "fork failed";
+    return 1;
+  }
+  if (child == 0) {
+    if (fd_out != nullptr) {
+      ::close(sp[0]);
+      char buf[16];
+      std::snprintf(buf, sizeof(buf), "%d", sp[1]);
+      ::setenv("_FUSE_COMMFD", buf, 1);
+    } else {
+      ::unsetenv("_FUSE_COMMFD");
+    }
+    std::vector<char*> argv_c;
+    for (auto& s : argv_s) argv_c.push_back(&s[0]);
+    argv_c.push_back(nullptr);
+    ::execvp(argv_c[0], argv_c.data());
+    std::fprintf(stderr, "exec %s failed: %s\n", argv_c[0],
+                 std::strerror(errno));
+    ::_exit(127);
+  }
+  if (fd_out != nullptr) {
+    ::close(sp[1]);
+    *fd_out = fp::RecvFd(sp[0]);  // blocks until fusermount sends it
+    ::close(sp[0]);
+  }
+  int status = 0;
+  while (::waitpid(child, &status, 0) < 0 && errno == EINTR) {
+  }
+  if (WIFEXITED(status)) return WEXITSTATUS(status);
+  *err = "fusermount terminated by signal";
+  return 1;
+}
+
+void HandleConnection(int conn) {
+  // The server handles one connection at a time; bound all socket I/O so
+  // a half-open client cannot wedge every mount on the node.
+  struct timeval tv = {30, 0};
+  ::setsockopt(conn, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  ::setsockopt(conn, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  fp::Request req;
+  fp::Response resp;
+  if (!fp::RecvRequest(conn, &req)) {
+    ::close(conn);
+    return;
+  }
+  pid_t caller = PeerPid(conn);
+  std::string err;
+  if (caller <= 0) {
+    resp.code = 1;
+    resp.message = "cannot identify caller (SO_PEERCRED)";
+  } else if (req.mode == fp::kModeShim) {
+    if (!ValidateShimArgs(req.args, &err)) {
+      resp.code = 1;
+      resp.message = "rejected: " + err;
+    } else {
+      int fd = -1;
+      resp.code = RunFusermount(caller, req.args,
+                                req.want_fd ? &fd : nullptr, &err);
+      resp.message = err;
+      resp.fd = fd;
+    }
+  } else if (req.mode == fp::kModeMount) {
+    // Wrapper mode: args = [mountpoint, options].
+    if (req.args.size() != 2 ||
+        !ValidateShimArgs({req.args[0]}, &err)) {
+      resp.code = 1;
+      resp.message = "rejected: " + (err.empty() ? "bad args" : err);
+    } else {
+      std::vector<std::string> fm_args;
+      if (!req.args[1].empty()) {
+        fm_args = {"-o", req.args[1]};
+      }
+      fm_args.push_back(req.args[0]);
+      int fd = -1;
+      resp.code = RunFusermount(caller, fm_args, &fd, &err);
+      resp.message = err;
+      resp.fd = fd;
+    }
+  } else {
+    resp.code = 1;
+    resp.message = "unknown mode";
+  }
+  fp::SendResponse(conn, resp);
+  if (resp.fd >= 0) ::close(resp.fd);
+  ::close(conn);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* path = argc > 1 ? argv[1] : fp::DefaultSocketPath();
+  ::signal(SIGPIPE, SIG_IGN);
+  ::signal(SIGCHLD, SIG_DFL);
+
+  int sock = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (sock < 0) {
+    std::perror("socket");
+    return 1;
+  }
+  ::unlink(path);
+  struct sockaddr_un addr = {};
+  addr.sun_family = AF_UNIX;
+  std::strncpy(addr.sun_path, path, sizeof(addr.sun_path) - 1);
+  if (::bind(sock, reinterpret_cast<struct sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(sock, 16) != 0) {
+    std::perror("bind/listen");
+    return 1;
+  }
+  ::chmod(path, 0666);  // any container sharing the dir may connect
+  std::fprintf(stderr, "fusermount-server listening on %s\n", path);
+  for (;;) {
+    int conn = ::accept(sock, nullptr, nullptr);
+    if (conn < 0) {
+      if (errno == EINTR) continue;
+      std::perror("accept");
+      return 1;
+    }
+    // Serve serially: mounts are rare, short-lived operations, and a
+    // single-threaded loop keeps the privileged surface simple.
+    HandleConnection(conn);
+  }
+}

@@ -1,0 +1,61 @@
+// fusermount-shim: masks `fusermount` in unprivileged containers.
+//
+// C++ twin of addons/fuse-proxy/cmd/fusermount-shim/main.go (reference).
+// A FUSE adapter (gcsfuse, goofys, ...) execs this in place of the real
+// fusermount; we forward argv to the privileged fusermount-server over a
+// unix socket. If the adapter expects the mounted /dev/fuse fd back via
+// the _FUSE_COMMFD protocol, the server relays that fd to us with
+// SCM_RIGHTS and we pass it on to our parent the same way the real
+// fusermount would.
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include "common.hpp"
+
+namespace fp = fuseproxy;
+
+int main(int argc, char** argv) {
+  fp::Request req;
+  req.mode = fp::kModeShim;
+  for (int i = 1; i < argc; ++i) req.args.emplace_back(argv[i]);
+
+  // libfuse sets _FUSE_COMMFD to a socket over which fusermount must
+  // send the mounted fd.
+  const char* commfd_env = ::getenv("_FUSE_COMMFD");
+  req.want_fd = commfd_env != nullptr;
+
+  int sock = fp::ConnectTo(fp::DefaultSocketPath());
+  if (sock < 0) {
+    std::fprintf(stderr,
+                 "fusermount-shim: cannot connect to %s: %s\n",
+                 fp::DefaultSocketPath(), std::strerror(errno));
+    return 1;
+  }
+  if (!fp::SendRequest(sock, req)) {
+    std::fprintf(stderr, "fusermount-shim: send failed\n");
+    return 1;
+  }
+  fp::Response resp;
+  if (!fp::RecvResponse(sock, &resp)) {
+    std::fprintf(stderr, "fusermount-shim: bad response\n");
+    return 1;
+  }
+  if (!resp.message.empty()) {
+    std::fprintf(stderr, "%s\n", resp.message.c_str());
+  }
+  if (resp.fd >= 0 && commfd_env != nullptr) {
+    int commfd = std::atoi(commfd_env);
+    if (!fp::SendFd(commfd, resp.fd)) {
+      std::fprintf(stderr, "fusermount-shim: fd relay failed\n");
+      return 1;
+    }
+  }
+  ::close(sock);
+  return resp.code;
+}

@@ -1,0 +1,92 @@
+// fusermount-wrapper: pre-mount /dev/fuse for libfuse-direct adapters.
+//
+// C++ twin of addons/fuse-proxy/cmd/fusermount-wrapper/main.go
+// (reference). Adapters that mount the FUSE device themselves (e.g.
+// blobfuse2, rclone) never fall back to fusermount, but opening
+// /dev/fuse needs privilege. The wrapper asks fusermount-server to do
+// the mount and hands the resulting fd to the adapter as /dev/fd/N —
+// libfuse detects an already-mounted fd at that path and uses it as-is.
+//
+// Usage:
+//   fusermount-wrapper <mountpoint> [-o opts] -- <adapter> [args...]
+// Every literal "{}" in the adapter args is replaced by the mountpoint
+// argument (/dev/fd/N).
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <unistd.h>
+
+#include "common.hpp"
+
+namespace fp = fuseproxy;
+
+int main(int argc, char** argv) {
+  std::string mountpoint;
+  std::string options;
+  int i = 1;
+  std::vector<char*> adapter;
+  for (; i < argc; ++i) {
+    if (std::strcmp(argv[i], "-o") == 0 && i + 1 < argc) {
+      options = argv[++i];
+    } else if (std::strcmp(argv[i], "--") == 0) {
+      ++i;
+      break;
+    } else if (mountpoint.empty()) {
+      mountpoint = argv[i];
+    } else {
+      std::fprintf(stderr, "fusermount-wrapper: unexpected arg %s\n",
+                   argv[i]);
+      return 2;
+    }
+  }
+  for (; i < argc; ++i) adapter.push_back(argv[i]);
+  if (mountpoint.empty() || adapter.empty()) {
+    std::fprintf(stderr,
+                 "usage: fusermount-wrapper <mountpoint> [-o opts] -- "
+                 "<adapter> [args...]\n");
+    return 2;
+  }
+
+  fp::Request req;
+  req.mode = fp::kModeMount;
+  req.want_fd = true;
+  req.args = {mountpoint, options};
+
+  int sock = fp::ConnectTo(fp::DefaultSocketPath());
+  if (sock < 0) {
+    std::fprintf(stderr, "fusermount-wrapper: cannot connect to %s\n",
+                 fp::DefaultSocketPath());
+    return 1;
+  }
+  if (!fp::SendRequest(sock, req)) {
+    std::fprintf(stderr, "fusermount-wrapper: send failed\n");
+    return 1;
+  }
+  fp::Response resp;
+  if (!fp::RecvResponse(sock, &resp) || resp.code != 0 || resp.fd < 0) {
+    std::fprintf(stderr, "fusermount-wrapper: mount failed: %s\n",
+                 resp.message.c_str());
+    return resp.code ? resp.code : 1;
+  }
+  // Keep the fd open across exec; clear CLOEXEC.
+  // (SCM_RIGHTS fds arrive without CLOEXEC by default, but be explicit.)
+  char devfd[32];
+  std::snprintf(devfd, sizeof(devfd), "/dev/fd/%d", resp.fd);
+
+  std::vector<std::string> final_args;
+  for (char* a : adapter) {
+    std::string s(a);
+    if (s == "{}") s = devfd;
+    final_args.push_back(std::move(s));
+  }
+  std::vector<char*> exec_argv;
+  for (auto& s : final_args) exec_argv.push_back(&s[0]);
+  exec_argv.push_back(nullptr);
+  ::execvp(exec_argv[0], exec_argv.data());
+  std::fprintf(stderr, "fusermount-wrapper: exec %s failed: %s\n",
+               exec_argv[0], std::strerror(errno));
+  return 127;
+}

@@ -31,7 +31,8 @@ from skypilot_tpu.provision import common
 logger = sky_logging.init_logger(__name__)
 
 CLUSTER_LABEL = 'xsky-cluster'
-HOST_INDEX_LABEL = 'xsky-host-index'
+HOST_INDEX_LABEL = 'xsky-host-index'    # per-slice (TPU_WORKER_ID)
+GLOBAL_INDEX_LABEL = 'xsky-global-index'
 SLICE_LABEL = 'xsky-slice'
 
 _WAIT_TIMEOUT_S = 600.0
@@ -98,7 +99,10 @@ def _build_pod_manifest(cluster_name: str, index: int, slice_index: int,
             'name': _pod_name(cluster_name, index),
             'labels': {
                 CLUSTER_LABEL: cluster_name,
-                HOST_INDEX_LABEL: str(index),
+                # Per-slice host index (InstanceInfo.host_index contract;
+                # TPU_WORKER_ID must restart at 0 on every slice).
+                HOST_INDEX_LABEL: str(host_index),
+                GLOBAL_INDEX_LABEL: str(index),
                 SLICE_LABEL: f'{cluster_name}-slice-{slice_index}',
                 **{str(k): str(v)
                    for k, v in (node_config.get('labels') or {}).items()
@@ -251,9 +255,20 @@ def wait_instances(region: str, cluster_name: str, state: str,
     while True:
         pods = _list_pods(cluster_name, context, namespace)
         phases = [p.get('status', {}).get('phase') for p in pods.values()]
-        if state == 'RUNNING' and pods and all(
-                ph == 'Running' for ph in phases):
-            return
+        if state == 'RUNNING':
+            if pods and all(ph == 'Running' for ph in phases):
+                return
+            # restartPolicy=Never: a Failed/Succeeded pod can never reach
+            # Running again — fail fast so failover proceeds immediately.
+            terminal = [
+                name for name, p in pods.items()
+                if p.get('status', {}).get('phase') in ('Failed',
+                                                        'Succeeded')
+            ]
+            if terminal:
+                raise exceptions.ProvisionError(
+                    f'Pods terminally failed while waiting for RUNNING: '
+                    f'{terminal}')
         if state == 'TERMINATED' and not pods:
             return
         if time.time() > deadline:
@@ -299,9 +314,14 @@ def open_ports(cluster_name: str, ports: List[str],
     namespace = provider_config.get('namespace', 'default')
     port_specs = []
     for p in ports:
-        port = int(str(p).split('-')[0])
-        port_specs.append({'name': f'port-{port}', 'port': port,
-                           'targetPort': port})
+        spec = str(p)
+        if '-' in spec:
+            lo, hi = (int(x) for x in spec.split('-', 1))
+        else:
+            lo = hi = int(spec)
+        for port in range(lo, hi + 1):
+            port_specs.append({'name': f'port-{port}', 'port': port,
+                               'targetPort': port})
     if not port_specs:
         return
     manifest = {
@@ -314,7 +334,7 @@ def open_ports(cluster_name: str, ports: List[str],
         'spec': {
             'type': 'NodePort',
             'selector': {CLUSTER_LABEL: cluster_name,
-                         HOST_INDEX_LABEL: '0'},
+                         GLOBAL_INDEX_LABEL: '0'},
             'ports': port_specs,
         },
     }

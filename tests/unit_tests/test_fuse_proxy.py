@@ -1,0 +1,158 @@
+"""End-to-end tests for the C++ fuse-proxy (shim/wrapper/server).
+
+Runs the real binaries: a fake `fusermount-original` (Python script using
+the genuine _FUSE_COMMFD SCM_RIGHTS protocol) stands in for the system
+fusermount, and XSKY_FUSE_NO_NSENTER=1 keeps everything in one mount
+namespace. This exercises the full wire protocol including fd passing.
+"""
+import array
+import os
+import shutil
+import socket
+import subprocess
+import time
+
+import pytest
+
+ADDON_DIR = os.path.join(os.path.dirname(__file__), '..', '..', 'addons',
+                         'fuse-proxy')
+
+FAKE_FUSERMOUNT = r'''#!/usr/bin/env python3
+import array, os, socket, sys
+
+log = os.environ['FAKE_FUSERMOUNT_LOG']
+with open(log, 'a') as f:
+    f.write(' '.join(sys.argv[1:]) + '\n')
+
+commfd = os.environ.get('_FUSE_COMMFD')
+if commfd is not None:
+    # Real fusermount sends the mounted /dev/fuse fd over this socket.
+    sock = socket.socket(fileno=int(commfd))
+    payload = os.open('/dev/null', os.O_RDONLY)
+    sock.sendmsg([b'F'], [(socket.SOL_SOCKET, socket.SCM_RIGHTS,
+                           array.array('i', [payload]))])
+    sock.close()
+
+if '--fail' in sys.argv:
+    sys.exit(3)
+'''
+
+
+@pytest.fixture(scope='module')
+def binaries():
+    if shutil.which('g++') is None or shutil.which('make') is None:
+        pytest.skip('no C++ toolchain')
+    proc = subprocess.run(['make', '-C', ADDON_DIR], capture_output=True,
+                          text=True)
+    assert proc.returncode == 0, proc.stderr
+    bindir = os.path.join(ADDON_DIR, 'bin')
+    return {
+        'shim': os.path.join(bindir, 'fusermount-shim'),
+        'wrapper': os.path.join(bindir, 'fusermount-wrapper'),
+        'server': os.path.join(bindir, 'fusermount-server'),
+    }
+
+
+@pytest.fixture
+def proxy_env(binaries, tmp_path):
+    """Start fusermount-server with a fake fusermount-original in PATH."""
+    fake_dir = tmp_path / 'fakebin'
+    fake_dir.mkdir()
+    fake = fake_dir / 'fusermount-original'
+    fake.write_text(FAKE_FUSERMOUNT)
+    fake.chmod(0o755)
+    log = tmp_path / 'fusermount.log'
+    log.write_text('')
+    sock_path = str(tmp_path / 'server.sock')
+    env = dict(os.environ)
+    env.update({
+        'FUSE_PROXY_SOCKET': sock_path,
+        'XSKY_FUSE_NO_NSENTER': '1',
+        'FAKE_FUSERMOUNT_LOG': str(log),
+        'PATH': f'{fake_dir}:{env["PATH"]}',
+    })
+    server = subprocess.Popen([binaries['server'], sock_path], env=env,
+                              stderr=subprocess.PIPE)
+    deadline = time.time() + 10
+    while not os.path.exists(sock_path):
+        assert time.time() < deadline, 'server did not start'
+        assert server.poll() is None, server.stderr.read()
+        time.sleep(0.05)
+    yield {'env': env, 'log': log, 'binaries': binaries}
+    server.terminate()
+    server.wait(timeout=10)
+
+
+def test_shim_forwards_unmount(proxy_env):
+    env, log = proxy_env['env'], proxy_env['log']
+    shim = proxy_env['binaries']['shim']
+    proc = subprocess.run([shim, '-u', '-z', '/tmp/mnt'], env=env,
+                          capture_output=True, text=True, timeout=30)
+    assert proc.returncode == 0, proc.stderr
+    assert '-u -z /tmp/mnt' in log.read_text()
+
+
+def test_shim_propagates_exit_code(proxy_env):
+    env = proxy_env['env']
+    shim = proxy_env['binaries']['shim']
+    proc = subprocess.run([shim, '-u', '/tmp/mnt', '--fail'], env=env,
+                          capture_output=True, text=True, timeout=30)
+    # --fail is not on the allow-list → rejected by the server (exit 1).
+    assert proc.returncode == 1
+    assert 'rejected' in proc.stderr or 'disallowed' in proc.stderr
+
+
+def test_shim_rejects_relative_mountpoint(proxy_env):
+    env, log = proxy_env['env'], proxy_env['log']
+    shim = proxy_env['binaries']['shim']
+    proc = subprocess.run([shim, '-u', '../etc'], env=env,
+                          capture_output=True, text=True, timeout=30)
+    assert proc.returncode == 1
+    assert '../etc' not in log.read_text()
+
+
+def test_shim_relays_fuse_fd(proxy_env):
+    """The _FUSE_COMMFD fd-passing path: server → shim → parent."""
+    env = dict(proxy_env['env'])
+    shim = proxy_env['binaries']['shim']
+    parent, child = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+    env['_FUSE_COMMFD'] = str(child.fileno())
+    proc = subprocess.Popen([shim, '-o', 'rw,nosuid', '/tmp/mnt2'],
+                            env=env, pass_fds=(child.fileno(),),
+                            stderr=subprocess.PIPE)
+    msg, ancdata, _, _ = parent.recvmsg(1, socket.CMSG_SPACE(4))
+    assert msg == b'F'
+    fds = array.array('i')
+    for level, type_, data in ancdata:
+        if level == socket.SOL_SOCKET and type_ == socket.SCM_RIGHTS:
+            fds.frombytes(data[:4])
+    assert len(fds) == 1 and fds[0] > 0
+    os.close(fds[0])
+    assert proc.wait(timeout=30) == 0
+    parent.close()
+    child.close()
+
+
+def test_wrapper_premounts_and_execs(proxy_env, tmp_path):
+    env = proxy_env['env']
+    wrapper = proxy_env['binaries']['wrapper']
+    out = tmp_path / 'wrapper_out.txt'
+    proc = subprocess.run(
+        [wrapper, '/tmp/mnt3', '-o', 'rw', '--', '/bin/sh', '-c',
+         f'echo mounted-at {{}} > {out}'],
+        env=env, capture_output=True, text=True, timeout=30)
+    assert proc.returncode == 0, proc.stderr
+    text = out.read_text()
+    assert 'mounted-at' in text
+    # The mountpoint log shows the server ran the mount with options.
+    assert '-o rw /tmp/mnt3' in proxy_env['log'].read_text()
+
+
+def test_shim_rejects_trailing_dotdot(proxy_env):
+    env, log = proxy_env['env'], proxy_env['log']
+    shim = proxy_env['binaries']['shim']
+    for bad in ('/tmp/mnt/..', '/..'):
+        proc = subprocess.run([shim, '-u', bad], env=env,
+                              capture_output=True, text=True, timeout=30)
+        assert proc.returncode == 1, bad
+    assert '..' not in log.read_text()

@@ -220,3 +220,28 @@ class TestKubernetesCommandRunner:
         assert runners[0].pod_name == 'mycluster-0'
         assert runners[0].namespace == 'ns2'
         assert runners[0].context == 'ctx2'
+
+
+def test_multislice_per_slice_host_index(fake_kubectl):
+    """2 slices of tpu-v6e-16: TPU_WORKER_ID restarts at 0 per slice."""
+    from skypilot_tpu import resources as resources_lib
+    cloud = k8s_cloud.Kubernetes()
+    res = resources_lib.Resources(
+        cloud='kubernetes', accelerators='tpu-v6e-16',
+        accelerator_args={'num_slices': 2})
+    node_config = cloud.make_deploy_resources_variables(
+        res, 'ms', 'in-cluster', None)
+    config = common.ProvisionConfig(
+        provider_config={'namespace': 'default', 'context': None},
+        node_config=node_config, count=1)
+    record = k8s_instance.run_instances('in-cluster', None, 'ms', config)
+    assert len(record.created_instance_ids) == 8
+    info = k8s_instance.get_cluster_info('in-cluster', 'ms', {})
+    hosts = info.sorted_instances()
+    assert sorted(h.host_index for h in hosts) == [0, 0, 1, 1, 2, 2, 3, 3]
+    assert len({h.slice_id for h in hosts}) == 2
+    # Env TPU_WORKER_ID matches the per-slice index.
+    for i in range(8):
+        pod = fake_kubectl.pods[f'ms-{i}']
+        env = pod['spec']['containers'][0]['env']
+        assert env[0]['value'] == str(i % 4)
