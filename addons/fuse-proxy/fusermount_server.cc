@@ -16,6 +16,7 @@
 // XSKY_FUSE_NO_NSENTER=1 skips nsenter (tests / same-namespace use).
 #include <cerrno>
 #include <csignal>
+#include <ctime>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -34,6 +35,54 @@ namespace fp = fuseproxy;
 
 namespace {
 
+bool ValidateMountOptions(const std::string& opts, std::string* err) {
+  // The server runs fusermount as root, where fusermount skips its
+  // non-root option filtering — so WE are the filter. Allow-list only;
+  // `dev`/`suid` (or anything unknown) from an unprivileged container
+  // would be a straight host escalation.
+  static const char* kAllowed[] = {
+      "rw", "ro", "nosuid", "nodev", "noexec", "noatime", "nodiratime",
+      "allow_other", "allow_root", "default_permissions", "auto_unmount",
+      "nonempty", "sync", "async", "dirsync",
+  };
+  static const char* kAllowedKeys[] = {
+      "user_id", "group_id", "fsname", "subtype", "max_read", "blksize",
+      "rootmode",
+  };
+  size_t start = 0;
+  while (start <= opts.size()) {
+    size_t end = opts.find(',', start);
+    if (end == std::string::npos) end = opts.size();
+    std::string tok = opts.substr(start, end - start);
+    start = end + 1;
+    if (tok.empty()) continue;
+    bool ok = false;
+    for (const char* a : kAllowed) {
+      if (tok == a) { ok = true; break; }
+    }
+    if (!ok) {
+      size_t eq = tok.find('=');
+      if (eq != std::string::npos) {
+        std::string key = tok.substr(0, eq);
+        std::string val = tok.substr(eq + 1);
+        for (const char* k : kAllowedKeys) {
+          if (key == k) { ok = true; break; }
+        }
+        // Values must not smuggle further options/shell.
+        if (ok && val.find_first_of(",;`$()|&<>\\\"' ") !=
+                      std::string::npos) {
+          ok = false;
+        }
+      }
+    }
+    if (!ok) {
+      *err = "disallowed mount option: " + tok;
+      return false;
+    }
+  }
+  return true;
+}
+
 bool ValidateShimArgs(const std::vector<std::string>& args,
                       std::string* err) {
   // fusermount surface we allow: -u (unmount), -z (lazy), -q (quiet),
@@ -46,7 +95,8 @@ bool ValidateShimArgs(const std::vector<std::string>& args,
         *err = "-o requires an argument";
         return false;
       }
-      ++i;  // opts string; fusermount itself validates allowed opts
+      if (!ValidateMountOptions(args[i + 1], err)) return false;
+      ++i;
       continue;
     }
     if (!a.empty() && a[0] == '-') {
@@ -126,13 +176,29 @@ int RunFusermount(pid_t caller_pid, const std::vector<std::string>& args,
                  std::strerror(errno));
     ::_exit(127);
   }
+  // Bound everything: a hostile/hung mountpoint must not wedge the
+  // single-threaded server (and with it every mount on the node).
+  constexpr int kTimeoutSec = 60;
   if (fd_out != nullptr) {
     ::close(sp[1]);
-    *fd_out = fp::RecvFd(sp[0]);  // blocks until fusermount sends it
+    struct timeval tv = {kTimeoutSec, 0};
+    ::setsockopt(sp[0], SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    *fd_out = fp::RecvFd(sp[0]);  // -1 on timeout/err; child killed below
     ::close(sp[0]);
   }
   int status = 0;
-  while (::waitpid(child, &status, 0) < 0 && errno == EINTR) {
+  time_t deadline = ::time(nullptr) + kTimeoutSec;
+  for (;;) {
+    pid_t r = ::waitpid(child, &status, WNOHANG);
+    if (r == child) break;
+    if (r < 0 && errno != EINTR) break;
+    if (::time(nullptr) > deadline) {
+      ::kill(child, SIGKILL);
+      ::waitpid(child, &status, 0);
+      *err = "fusermount timed out";
+      return 1;
+    }
+    ::usleep(50 * 1000);
   }
   if (WIFEXITED(status)) return WEXITSTATUS(status);
   *err = "fusermount terminated by signal";

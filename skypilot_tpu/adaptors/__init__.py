@@ -1,0 +1,1 @@
+"""Lazy cloud-SDK adaptors (twin of sky/adaptors/)."""

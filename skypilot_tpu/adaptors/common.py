@@ -1,0 +1,62 @@
+"""LazyImport: cloud SDKs as optional, import-on-first-use dependencies.
+
+Twin of sky/adaptors/common.py (80 LoC). No cloud SDK is a hard install
+requirement; importing an adaptor module is free, and the underlying SDK
+is imported only when an attribute is first touched — with a clear
+install hint if it is missing.
+"""
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Any, Optional, Tuple
+
+
+class LazyImport:
+    """Proxy that imports `module_name` on first attribute access."""
+
+    def __init__(self, module_name: str,
+                 import_error_message: Optional[str] = None) -> None:
+        self._module_name = module_name
+        self._module: Any = None
+        self._error = import_error_message
+        self._lock = threading.RLock()
+
+    def load_module(self) -> Any:
+        with self._lock:
+            if self._module is None:
+                try:
+                    self._module = importlib.import_module(
+                        self._module_name)
+                except ImportError as e:
+                    msg = self._error or (
+                        f'Failed to import {self._module_name!r}: {e}')
+                    raise ImportError(msg) from e
+        return self._module
+
+    def installed(self) -> bool:
+        try:
+            self.load_module()
+            return True
+        except ImportError:
+            return False
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith('_'):
+            raise AttributeError(name)
+        return getattr(self.load_module(), name)
+
+
+def load_lazy_modules(modules: Tuple[LazyImport, ...]):
+    """Decorator: touch all lazy modules before running the function."""
+
+    def decorator(fn):
+        def wrapper(*args, **kwargs):
+            for m in modules:
+                m.load_module()
+            return fn(*args, **kwargs)
+
+        wrapper.__name__ = getattr(fn, '__name__', 'wrapped')
+        return wrapper
+
+    return decorator

@@ -1,0 +1,24 @@
+"""GCP SDK adaptor (twin of sky/adaptors/gcp.py:104).
+
+The provisioner's hot path uses the in-tree REST client
+(provision/gcp/rest.py) with zero SDK dependency; this adaptor exists for
+optional SDK-backed extras (BigQuery catalogs, Storage Transfer helpers)
+and mirrors the reference's lazy-import surface.
+"""
+from __future__ import annotations
+
+from skypilot_tpu.adaptors import common
+
+_IMPORT_ERROR = (
+    'Failed to import GCP SDK modules. Install them with: '
+    'pip install google-api-python-client google-cloud-storage')
+
+googleapiclient = common.LazyImport('googleapiclient.discovery',
+                                    _IMPORT_ERROR)
+google_auth = common.LazyImport('google.auth', _IMPORT_ERROR)
+storage = common.LazyImport('google.cloud.storage', _IMPORT_ERROR)
+
+
+def build(service: str, version: str, **kwargs):
+    """googleapiclient.discovery.build with lazy import."""
+    return googleapiclient.build(service, version, **kwargs)

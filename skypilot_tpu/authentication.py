@@ -1,0 +1,98 @@
+"""SSH keypair management + per-cloud public-key injection.
+
+Twin of sky/authentication.py (587 LoC): one framework-owned keypair
+(~/.xsky/ssh/xsky-key[.pub]) generated on first use; clouds consume the
+public key through their deploy variables (GCP: instance metadata
+`ssh-keys`; Kubernetes pods use kubectl exec, no key needed).
+
+Pure-Python Ed25519 via the `cryptography` package when available;
+otherwise shells out to ssh-keygen (present wherever ssh is).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Tuple
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_KEY_DIR = '~/.xsky/ssh'
+PRIVATE_KEY_PATH = f'{_KEY_DIR}/xsky-key'
+PUBLIC_KEY_PATH = f'{_KEY_DIR}/xsky-key.pub'
+DEFAULT_SSH_USER = 'xsky'
+
+
+def _keygen_cryptography(path: str) -> None:
+    """Ed25519 keypair in OpenSSH format via the cryptography package
+    (preferred: works in images without the openssh client)."""
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ed25519
+    key = ed25519.Ed25519PrivateKey.generate()
+    private_bytes = key.private_bytes(
+        encoding=serialization.Encoding.PEM,
+        format=serialization.PrivateFormat.OpenSSH,
+        encryption_algorithm=serialization.NoEncryption())
+    public_bytes = key.public_key().public_bytes(
+        encoding=serialization.Encoding.OpenSSH,
+        format=serialization.PublicFormat.OpenSSH)
+    with open(path, 'wb') as f:
+        f.write(private_bytes)
+    with open(path + '.pub', 'wb') as f:
+        f.write(public_bytes + b' xsky\n')
+
+
+def get_or_generate_keys() -> Tuple[str, str]:
+    """Return (private_key_path, public_key_path), generating if needed.
+
+    Generation is atomic-ish: written under a temp name then renamed, so
+    concurrent launches race benignly.
+    """
+    private = os.path.expanduser(PRIVATE_KEY_PATH)
+    public = os.path.expanduser(PUBLIC_KEY_PATH)
+    if os.path.exists(private) and os.path.exists(public):
+        return private, public
+    os.makedirs(os.path.dirname(private), mode=0o700, exist_ok=True)
+    tmp = private + '.tmp'
+    for p in (tmp, tmp + '.pub'):
+        if os.path.exists(p):
+            os.remove(p)
+    try:
+        _keygen_cryptography(tmp)
+    except ImportError:
+        proc = subprocess.run(
+            ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q', '-f', tmp,
+             '-C', 'xsky'],
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f'ssh-keygen failed: {proc.stderr}') from None
+    os.chmod(tmp, 0o600)
+    # Rename pub first: a reader seeing the private key may assume the
+    # pub exists.
+    os.replace(tmp + '.pub', public)
+    os.replace(tmp, private)
+    logger.info(f'Generated SSH keypair at {private}')
+    return private, public
+
+
+def public_key_content() -> str:
+    _, public = get_or_generate_keys()
+    with open(public, encoding='utf-8') as f:
+        return f.read().strip()
+
+
+def gcp_ssh_keys_metadata(ssh_user: str = DEFAULT_SSH_USER) -> str:
+    """Value for the GCP `ssh-keys` instance/TPU metadata entry."""
+    return f'{ssh_user}:{public_key_content()}'
+
+
+def authorized_keys_setup_command(ssh_user: str = DEFAULT_SSH_USER) -> str:
+    """Shell to append our public key on a host we can already reach
+    (SSH node pools / BYO machines)."""
+    key = public_key_content()
+    return ('mkdir -p ~/.ssh && chmod 700 ~/.ssh && '
+            f'grep -qF "{key}" ~/.ssh/authorized_keys 2>/dev/null || '
+            f'echo "{key}" >> ~/.ssh/authorized_keys && '
+            'chmod 600 ~/.ssh/authorized_keys')

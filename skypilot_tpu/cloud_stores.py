@@ -1,0 +1,118 @@
+"""CloudStorage CLI command builders for bucket→cluster file transfer.
+
+Twin of sky/cloud_stores.py (626 LoC): given a bucket URL, produce the
+shell commands a cluster host runs to fetch a directory or file. Used by
+file_mounts whose source is a bucket URL (COPY semantics without a
+Storage object) and by `xsky storage` verbs.
+"""
+from __future__ import annotations
+
+import shlex
+from typing import Dict, Type
+
+from skypilot_tpu.data import storage as storage_lib
+
+
+class CloudStorage:
+    """Download-command builders for one URL scheme."""
+
+    def is_directory(self, url: str) -> bool:
+        """Heuristic: URLs without an extension are treated as dirs."""
+        tail = url.rstrip('/').rsplit('/', 1)[-1]
+        return '.' not in tail or url.endswith('/')
+
+    def make_sync_dir_command(self, source: str, destination: str) -> str:
+        raise NotImplementedError
+
+    def make_sync_file_command(self, source: str, destination: str) -> str:
+        raise NotImplementedError
+
+
+class GcsCloudStorage(CloudStorage):
+
+    def make_sync_dir_command(self, source: str, destination: str) -> str:
+        d = shlex.quote(destination)
+        return (f'mkdir -p {d} && gcloud storage rsync -r '
+                f'{shlex.quote(source)} {d}')
+
+    def make_sync_file_command(self, source: str, destination: str) -> str:
+        d = shlex.quote(destination)
+        return (f'mkdir -p $(dirname {d}) && gcloud storage cp '
+                f'{shlex.quote(source)} {d}')
+
+
+class S3CloudStorage(CloudStorage):
+    _endpoint_flag = ''
+
+    def make_sync_dir_command(self, source: str, destination: str) -> str:
+        d = shlex.quote(destination)
+        return (f'mkdir -p {d} && aws s3 sync {shlex.quote(source)} {d}'
+                f'{self._endpoint_flag}')
+
+    def make_sync_file_command(self, source: str, destination: str) -> str:
+        d = shlex.quote(destination)
+        return (f'mkdir -p $(dirname {d}) && aws s3 cp '
+                f'{shlex.quote(source)} {d}{self._endpoint_flag}')
+
+
+class AzureBlobCloudStorage(CloudStorage):
+
+    def make_sync_dir_command(self, source: str, destination: str) -> str:
+        # azure://container/path → az storage blob download-batch
+        _, rest = storage_lib.StoreType.from_url(source)
+        container, _, prefix = rest.partition('/')
+        d = shlex.quote(destination)
+        pattern = f' --pattern {shlex.quote(prefix + "/*")}' if prefix \
+            else ''
+        return (f'mkdir -p {d} && az storage blob download-batch '
+                f'-s {shlex.quote(container)} -d {d}{pattern}')
+
+    def make_sync_file_command(self, source: str, destination: str) -> str:
+        _, rest = storage_lib.StoreType.from_url(source)
+        container, _, blob = rest.partition('/')
+        d = shlex.quote(destination)
+        return (f'mkdir -p $(dirname {d}) && az storage blob download '
+                f'-c {shlex.quote(container)} -n {shlex.quote(blob)} '
+                f'-f {d}')
+
+
+class FileCloudStorage(CloudStorage):
+    """file:// — plain cp (fake cloud / shared filesystems)."""
+
+    def make_sync_dir_command(self, source: str, destination: str) -> str:
+        _, path = storage_lib.StoreType.from_url(source)
+        import os
+        base = os.path.expanduser(
+            os.environ.get('XSKY_LOCAL_STORE_DIR', '~/.xsky/local_store'))
+        d = shlex.quote(destination)
+        return (f'mkdir -p {d} && cp -a '
+                f'{shlex.quote(os.path.join(base, path))}/. {d}/')
+
+    def make_sync_file_command(self, source: str, destination: str) -> str:
+        _, path = storage_lib.StoreType.from_url(source)
+        import os
+        base = os.path.expanduser(
+            os.environ.get('XSKY_LOCAL_STORE_DIR', '~/.xsky/local_store'))
+        d = shlex.quote(destination)
+        return (f'mkdir -p $(dirname {d}) && cp '
+                f'{shlex.quote(os.path.join(base, path))} {d}')
+
+
+_REGISTRY: Dict[str, Type[CloudStorage]] = {
+    'gs': GcsCloudStorage,
+    's3': S3CloudStorage,
+    'r2': S3CloudStorage,
+    'cos': S3CloudStorage,
+    'oci': S3CloudStorage,
+    'nebius': S3CloudStorage,
+    'azure': AzureBlobCloudStorage,
+    'file': FileCloudStorage,
+}
+
+
+def get_storage_from_url(url: str) -> CloudStorage:
+    scheme = url.split('://', 1)[0]
+    if scheme not in _REGISTRY:
+        raise ValueError(f'No CloudStorage for scheme {scheme!r} '
+                         f'(known: {sorted(_REGISTRY)})')
+    return _REGISTRY[scheme]()

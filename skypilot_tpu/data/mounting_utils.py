@@ -51,7 +51,8 @@ def _premount(mount_path: str) -> str:
 def gcs_mount_command(bucket: str, mount_path: str,
                       sub_path: str = '') -> str:
     only_dir = f' --only-dir {shlex.quote(sub_path)}' if sub_path else ''
-    return (f'{_install_gcsfuse()} && {_premount(mount_path)} && '
+    return (f'{_install_gcsfuse()} && {fuse_proxy_mask_command()} && '
+            f'{_premount(mount_path)} && '
             f'gcsfuse --implicit-dirs{only_dir} '
             f'{shlex.quote(bucket)} {shlex.quote(mount_path)}')
 
@@ -60,7 +61,8 @@ def s3_mount_command(bucket: str, mount_path: str,
                      endpoint_url: str = '') -> str:
     endpoint = f' --endpoint {shlex.quote(endpoint_url)}' if endpoint_url \
         else ''
-    return (f'{_install_goofys()} && {_premount(mount_path)} && '
+    return (f'{_install_goofys()} && {fuse_proxy_mask_command()} && '
+            f'{_premount(mount_path)} && '
             f'goofys{endpoint} {shlex.quote(bucket)} '
             f'{shlex.quote(mount_path)}')
 
@@ -80,13 +82,75 @@ def rclone_mount_cached_command(remote: str, bucket: str, mount_path: str,
                                 endpoint_url: str = '') -> str:
     """MOUNT_CACHED: rclone VFS full-cache (writes buffered locally)."""
     cache = '~/.xsky/rclone-cache'
-    return (f'{_install_rclone()} && '
+    return (f'{_install_rclone()} && {fuse_proxy_mask_command()} && '
             f'{_rclone_remote_config(remote, endpoint_url)} && '
             f'{_premount(mount_path)} && '
             f'mkdir -p {cache} && '
             f'rclone mount {remote}:{shlex.quote(bucket)} '
             f'{shlex.quote(mount_path)} --daemon --vfs-cache-mode full '
             f'--cache-dir {cache} --allow-other --dir-cache-time 10s')
+
+
+BLOBFUSE2_VERSION = '2.3.2'
+
+# Host-shared dir provided by the fuse-proxy DaemonSet
+# (addons/fuse-proxy) on unprivileged Kubernetes pods.
+FUSE_PROXY_DIR = '/var/run/fusermount'
+
+
+def fuse_proxy_mask_command() -> str:
+    """Mask fusermount with the fuse-proxy shim when the DaemonSet's
+    shared dir is present (no-op elsewhere). Prepended to every FUSE
+    mount command so gcsfuse/goofys/rclone work in unprivileged pods."""
+    shim = f'{FUSE_PROXY_DIR}/fusermount-shim'
+    return (f'if [ -x {shim} ]; then '
+            'for FM in fusermount fusermount3; do '
+            'FM_PATH=$(command -v $FM || true); '
+            'if [ -n "$FM_PATH" ] && [ ! -e "$FM_PATH-original" ]; then '
+            'sudo cp -p "$FM_PATH" "$FM_PATH-original" && '
+            f'sudo ln -sf {shim} "$FM_PATH"; fi; done; fi')
+
+
+def _install_blobfuse2() -> str:
+    return ('command -v blobfuse2 >/dev/null || '
+            '(sudo apt-get update -qq && '
+            'sudo apt-get install -y -qq libfuse3-dev fuse3 blobfuse2) || '
+            f'(sudo curl -fsSL -o /usr/local/bin/blobfuse2 '
+            f'https://github.com/Azure/azure-storage-fuse/releases/'
+            f'download/blobfuse2-{BLOBFUSE2_VERSION}/blobfuse2 && '
+            f'sudo chmod +x /usr/local/bin/blobfuse2)')
+
+
+def azure_mount_command(container: str, storage_account: str,
+                        mount_path: str) -> str:
+    """Azure Blob via blobfuse2 (reference: mounting_utils blobfuse2 path).
+
+    blobfuse2 mounts the FUSE device via libfuse directly (never calls
+    fusermount), so on unprivileged pods it runs under the fuse-proxy's
+    fusermount-wrapper when present; elsewhere it runs directly.
+    """
+    wrapper = f'{FUSE_PROXY_DIR}/fusermount-wrapper'
+    mp = shlex.quote(mount_path)
+    blob_cmd = (f'AZURE_STORAGE_ACCOUNT={shlex.quote(storage_account)} '
+                f'blobfuse2 mount {mp} '
+                f'--container-name={shlex.quote(container)} '
+                f'--use-adls=false -o allow_other')
+    wrapped = (f'if [ -x {wrapper} ]; then {wrapper} {mp} '
+               f'-o allow_other -- {blob_cmd}; else {blob_cmd}; fi')
+    return (f'{_install_blobfuse2()} && {_premount(mount_path)} && '
+            f'{wrapped}')
+
+
+def rclone_mount_command(remote: str, bucket: str, mount_path: str,
+                         endpoint_url: str = '') -> str:
+    """Plain (uncached) rclone mount for stores without a native adapter
+    (IBM COS, OCI)."""
+    return (f'{_install_rclone()} && {fuse_proxy_mask_command()} && '
+            f'{_rclone_remote_config(remote, endpoint_url)} && '
+            f'{_premount(mount_path)} && '
+            f'rclone mount {remote}:{shlex.quote(bucket)} '
+            f'{shlex.quote(mount_path)} --daemon --allow-other '
+            f'--dir-cache-time 10s')
 
 
 def local_mount_command(source_dir: str, mount_path: str) -> str:

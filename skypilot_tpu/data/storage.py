@@ -40,22 +40,36 @@ class StoreType(enum.Enum):
     GCS = 'GCS'
     S3 = 'S3'
     R2 = 'R2'
+    AZURE = 'AZURE'
+    IBM = 'IBM'
+    OCI = 'OCI'
+    NEBIUS = 'NEBIUS'
     LOCAL = 'LOCAL'
+
+    @classmethod
+    def _scheme_map(cls):
+        return (('gs://', cls.GCS), ('s3://', cls.S3), ('r2://', cls.R2),
+                ('azure://', cls.AZURE), ('cos://', cls.IBM),
+                ('oci://', cls.OCI), ('nebius://', cls.NEBIUS),
+                ('file://', cls.LOCAL))
 
     @classmethod
     def from_url(cls, url: str) -> Tuple['StoreType', str]:
         """('gs://b/path') → (GCS, 'b/path')."""
-        for scheme, st in (('gs://', cls.GCS), ('s3://', cls.S3),
-                           ('r2://', cls.R2), ('file://', cls.LOCAL)):
+        for scheme, st in cls._scheme_map():
             if url.startswith(scheme):
                 return st, url[len(scheme):]
+        schemes = ', '.join(s for s, _ in cls._scheme_map())
         raise exceptions.StorageSpecError(
-            f'Unknown storage URL scheme: {url!r} (expected gs://, s3://, '
-            f'r2://, or file://).')
+            f'Unknown storage URL scheme: {url!r} (expected one of '
+            f'{schemes}).')
 
     def url(self, bucket: str) -> str:
         scheme = {StoreType.GCS: 'gs', StoreType.S3: 's3',
-                  StoreType.R2: 'r2', StoreType.LOCAL: 'file'}[self]
+                  StoreType.R2: 'r2', StoreType.AZURE: 'azure',
+                  StoreType.IBM: 'cos', StoreType.OCI: 'oci',
+                  StoreType.NEBIUS: 'nebius',
+                  StoreType.LOCAL: 'file'}[self]
         return f'{scheme}://{bucket}'
 
 
@@ -224,10 +238,117 @@ class LocalStore(AbstractStore):
                 f'{shlex.quote(self._root())}/. {q}/')
 
 
+class AzureBlobStore(AbstractStore):
+    """Azure Blob Storage via `az storage` CLI; mounts via blobfuse2.
+
+    Twin of sky/data/storage.py:2414 (AzureBlobStore). The storage
+    account comes from $AZURE_STORAGE_ACCOUNT (set by `az login` flows);
+    bucket name = container name.
+    """
+    store_type = StoreType.AZURE
+
+    @property
+    def account(self) -> str:
+        return os.environ.get('AZURE_STORAGE_ACCOUNT', '')
+
+    @property
+    def container(self) -> str:
+        """Container name (self.name may carry a /sub-path suffix)."""
+        return self.name.partition('/')[0]
+
+    @property
+    def sub_path(self) -> str:
+        return self.name.partition('/')[2]
+
+    def _acct(self) -> str:
+        return (f' --account-name {self.account}' if self.account else '')
+
+    def exists(self) -> bool:
+        return subprocess.run(
+            f'az storage container exists --name {self.container}'
+            f'{self._acct()} --query exists -o tsv | grep -q true',
+            shell=True, capture_output=True).returncode == 0
+
+    def create(self) -> None:
+        _run(f'az storage container create --name {self.container}'
+             f'{self._acct()}')
+
+    def upload(self) -> None:
+        src = shlex.quote(os.path.expanduser(self.source or '.'))
+        dest = f' --destination-path {self.sub_path}' if self.sub_path \
+            else ''
+        _run(f'az storage blob upload-batch -d {self.container} -s {src}'
+             f'{dest}{self._acct()}')
+
+    def delete(self) -> None:
+        _run(f'az storage container delete --name {self.container}'
+             f'{self._acct()}')
+
+    def mount_command(self, mount_path: str) -> str:
+        return mounting_utils.azure_mount_command(self.container,
+                                                  self.account, mount_path)
+
+    def copy_download_command(self, dest_path: str) -> str:
+        q = shlex.quote(dest_path)
+        pattern = (f' --pattern {shlex.quote(self.sub_path + "/*")}'
+                   if self.sub_path else '')
+        return (f'mkdir -p {q} && az storage blob download-batch '
+                f'-s {self.container} -d {q}{pattern}{self._acct()}')
+
+
+class _S3CompatibleStore(S3Store):
+    """Shared base for S3-API object stores behind custom endpoints
+    (IBM COS, OCI, Nebius — reference classes at sky/data/storage.py:
+    3763, 4227, 4689). Mounts via rclone (no native FUSE adapter)."""
+
+    _ENDPOINT_ENV = ''       # env var holding the endpoint URL
+    _RCLONE_REMOTE = ''
+
+    def __init__(self, name: str, source: Optional[str] = None,
+                 region: Optional[str] = None) -> None:
+        super().__init__(name, source, region)
+        self.endpoint_url = os.environ.get(self._ENDPOINT_ENV, '')
+
+    def mount_command(self, mount_path: str) -> str:
+        return mounting_utils.rclone_mount_command(
+            self._RCLONE_REMOTE, self.name, mount_path, self.endpoint_url)
+
+
+class IBMCosStore(_S3CompatibleStore):
+    """IBM Cloud Object Storage ($IBM_COS_ENDPOINT)."""
+    store_type = StoreType.IBM
+    _ENDPOINT_ENV = 'IBM_COS_ENDPOINT'
+    _RCLONE_REMOTE = 'xsky-ibm'
+
+
+class OciStore(_S3CompatibleStore):
+    """OCI Object Storage, S3-compat API ($OCI_S3_ENDPOINT)."""
+    store_type = StoreType.OCI
+    _ENDPOINT_ENV = 'OCI_S3_ENDPOINT'
+    _RCLONE_REMOTE = 'xsky-oci'
+
+
+class NebiusStore(_S3CompatibleStore):
+    """Nebius Object Storage ($NEBIUS_S3_ENDPOINT, default public EP)."""
+    store_type = StoreType.NEBIUS
+    _ENDPOINT_ENV = 'NEBIUS_S3_ENDPOINT'
+    _RCLONE_REMOTE = 'xsky-nebius'
+
+    def __init__(self, name: str, source: Optional[str] = None,
+                 region: Optional[str] = None) -> None:
+        super().__init__(name, source, region)
+        if not self.endpoint_url:
+            self.endpoint_url = 'https://storage.eu-north1.nebius.cloud'
+
+
 _STORE_CLASSES = {
     StoreType.GCS: GcsStore,
     StoreType.S3: S3Store,
     StoreType.R2: R2Store,
+    StoreType.AZURE: AzureBlobStore,
+    StoreType.IBM: IBMCosStore,
+    StoreType.OCI: OciStore,
+    StoreType.NEBIUS: NebiusStore,
     StoreType.LOCAL: LocalStore,
 }
 
@@ -341,10 +462,14 @@ class Storage:
         if self.mode == StorageMode.COPY:
             return store.copy_download_command(mount_path)
         if self.mode == StorageMode.MOUNT_CACHED:
-            if store.store_type == StoreType.LOCAL:
+            if store.store_type in (StoreType.LOCAL, StoreType.AZURE):
+                # Azure: blobfuse2's own file cache plays this role.
                 return store.mount_command(mount_path)
-            remote = {'GCS': 'xsky-gcs', 'S3': 'xsky-s3',
-                      'R2': 'xsky-r2'}[store.store_type.value]
+            # Stores declare their rclone remote name; GCS/S3/R2 use the
+            # scheme-derived default.
+            remote = getattr(
+                store, '_RCLONE_REMOTE',
+                f'xsky-{store.store_type.value.lower()}')
             endpoint = getattr(store, 'endpoint_url', '')
             return mounting_utils.rclone_mount_cached_command(
                 remote, store.name, mount_path, endpoint)

@@ -1,0 +1,89 @@
+"""Tests for adaptors / authentication / cloud_stores."""
+import os
+
+import pytest
+
+from skypilot_tpu import authentication
+from skypilot_tpu import cloud_stores
+from skypilot_tpu.adaptors import common as adaptors_common
+
+
+class TestLazyImport:
+
+    def test_defers_until_attribute_access(self):
+        lazy = adaptors_common.LazyImport('json')
+        assert lazy._module is None
+        assert lazy.dumps({'a': 1}) == '{"a": 1}'
+        assert lazy._module is not None
+
+    def test_missing_module_reports_hint(self):
+        lazy = adaptors_common.LazyImport('definitely_not_a_module_xyz',
+                                          'pip install xyz')
+        assert not lazy.installed()
+        with pytest.raises(ImportError, match='pip install xyz'):
+            lazy.load_module()
+
+    def test_load_lazy_modules_decorator(self):
+        lazy = adaptors_common.LazyImport('json')
+
+        @adaptors_common.load_lazy_modules((lazy,))
+        def fn():
+            return 42
+
+        assert fn() == 42
+        assert lazy._module is not None
+
+
+class TestAuthentication:
+
+    def test_generate_and_reuse(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(authentication, 'PRIVATE_KEY_PATH',
+                            str(tmp_path / 'k'))
+        monkeypatch.setattr(authentication, 'PUBLIC_KEY_PATH',
+                            str(tmp_path / 'k.pub'))
+        priv, pub = authentication.get_or_generate_keys()
+        assert os.path.exists(priv) and os.path.exists(pub)
+        assert (os.stat(priv).st_mode & 0o777) == 0o600
+        content = authentication.public_key_content()
+        assert content.startswith('ssh-ed25519 ')
+        # Second call reuses.
+        priv2, _ = authentication.get_or_generate_keys()
+        assert priv2 == priv
+        meta = authentication.gcp_ssh_keys_metadata('bob')
+        assert meta.startswith('bob:ssh-ed25519')
+        cmd = authentication.authorized_keys_setup_command()
+        assert 'authorized_keys' in cmd and 'ssh-ed25519' in cmd
+
+
+class TestCloudStores:
+
+    def test_scheme_dispatch(self):
+        assert isinstance(cloud_stores.get_storage_from_url('gs://b'),
+                          cloud_stores.GcsCloudStorage)
+        assert isinstance(cloud_stores.get_storage_from_url('s3://b'),
+                          cloud_stores.S3CloudStorage)
+        assert isinstance(cloud_stores.get_storage_from_url('azure://c'),
+                          cloud_stores.AzureBlobCloudStorage)
+        with pytest.raises(ValueError):
+            cloud_stores.get_storage_from_url('ftp://x')
+
+    def test_gcs_commands(self):
+        cs = cloud_stores.get_storage_from_url('gs://bkt/dir')
+        assert cs.is_directory('gs://bkt/dir')
+        assert not cs.is_directory('gs://bkt/file.txt')
+        cmd = cs.make_sync_dir_command('gs://bkt/dir', '/data')
+        assert 'gcloud storage rsync -r' in cmd
+        cmd = cs.make_sync_file_command('gs://bkt/f.txt', '/data/f.txt')
+        assert 'gcloud storage cp' in cmd
+
+    def test_azure_commands(self):
+        cs = cloud_stores.get_storage_from_url('azure://cont/prefix')
+        cmd = cs.make_sync_dir_command('azure://cont/prefix', '/data')
+        assert 'download-batch' in cmd and '-s cont' in cmd
+        assert 'prefix/*' in cmd
+
+    def test_file_commands(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('XSKY_LOCAL_STORE_DIR', str(tmp_path))
+        cs = cloud_stores.get_storage_from_url('file://bkt/sub')
+        cmd = cs.make_sync_dir_command('file://bkt', '/data')
+        assert f'cp -a {tmp_path}/bkt/.' in cmd

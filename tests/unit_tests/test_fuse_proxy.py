@@ -33,7 +33,7 @@ if commfd is not None:
                            array.array('i', [payload]))])
     sock.close()
 
-if '--fail' in sys.argv:
+if '/tmp/failmnt' in sys.argv:
     sys.exit(3)
 '''
 
@@ -95,11 +95,31 @@ def test_shim_forwards_unmount(proxy_env):
 def test_shim_propagates_exit_code(proxy_env):
     env = proxy_env['env']
     shim = proxy_env['binaries']['shim']
+    # The fake fusermount exits 3 for this mountpoint: the shim must
+    # propagate the real exit code end-to-end.
+    proc = subprocess.run([shim, '-u', '/tmp/failmnt'], env=env,
+                          capture_output=True, text=True, timeout=30)
+    assert proc.returncode == 3
+
+
+def test_shim_rejects_disallowed_flag(proxy_env):
+    env = proxy_env['env']
+    shim = proxy_env['binaries']['shim']
     proc = subprocess.run([shim, '-u', '/tmp/mnt', '--fail'], env=env,
                           capture_output=True, text=True, timeout=30)
-    # --fail is not on the allow-list → rejected by the server (exit 1).
     assert proc.returncode == 1
     assert 'rejected' in proc.stderr or 'disallowed' in proc.stderr
+
+
+def test_server_rejects_dangerous_mount_options(proxy_env):
+    env, log = proxy_env['env'], proxy_env['log']
+    shim = proxy_env['binaries']['shim']
+    for opts in ('dev', 'suid', 'rw,dev', 'fsname=a,suid'):
+        proc = subprocess.run([shim, '-o', opts, '/tmp/mnt'], env=env,
+                              capture_output=True, text=True, timeout=30)
+        assert proc.returncode == 1, opts
+        assert 'disallowed mount option' in proc.stderr, opts
+    assert 'dev' not in log.read_text()
 
 
 def test_shim_rejects_relative_mountpoint(proxy_env):

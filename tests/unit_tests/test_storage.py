@@ -205,3 +205,64 @@ def test_storage_verbs_via_api_server(tmp_path, local_store_dir):
     assert not any(r['name'] == 'apids' for r in core.storage_ls())
     with pytest.raises(exceptions.StorageError):
         core.storage_delete('apids')
+
+
+def test_new_store_schemes():
+    for url, st in [('azure://cont/sub', storage_lib.StoreType.AZURE),
+                    ('cos://bkt', storage_lib.StoreType.IBM),
+                    ('oci://bkt', storage_lib.StoreType.OCI),
+                    ('nebius://bkt', storage_lib.StoreType.NEBIUS)]:
+        parsed, bucket = storage_lib.StoreType.from_url(url)
+        assert parsed is st
+        assert bucket.startswith(('cont', 'bkt'))
+        assert st.url(bucket) == url
+
+
+def test_new_store_commands(monkeypatch):
+    monkeypatch.setenv('AZURE_STORAGE_ACCOUNT', 'myacct')
+    az = storage_lib.AzureBlobStore('cont1')
+    assert '--account-name myacct' in az.copy_download_command('/data')
+    assert 'blobfuse2' in az.mount_command('/data')
+    assert 'myacct' in az.mount_command('/data')
+
+    monkeypatch.setenv('IBM_COS_ENDPOINT',
+                       'https://s3.eu-de.cloud-object-storage.appdomain.cloud')
+    ibm = storage_lib.IBMCosStore('bkt1')
+    assert 'appdomain.cloud' in ibm.copy_download_command('/data')
+    assert 'rclone mount xsky-ibm:bkt1' in ibm.mount_command('/data')
+
+    neb = storage_lib.NebiusStore('bkt2')
+    assert 'nebius.cloud' in neb.mount_command('/data')
+
+
+def test_storage_yaml_with_new_stores():
+    s = storage_lib.Storage.from_yaml_config({
+        'name': 'dataset1', 'store': 'azure'})
+    assert s.primary_store().store_type is storage_lib.StoreType.AZURE
+    s2 = storage_lib.Storage(source='oci://mybucket/path')
+    assert s2.primary_store().store_type is storage_lib.StoreType.OCI
+
+
+def test_transfer_cli_relay(tmp_path, local_store_dir):
+    from skypilot_tpu.data import data_transfer
+    src_dir = tmp_path / 'srcdata'
+    src_dir.mkdir()
+    (src_dir / 'a.txt').write_text('hello')
+    src = storage_lib.LocalStore('srcbkt', source=str(src_dir))
+    src.create()
+    src.upload()
+    dst = storage_lib.LocalStore('dstbkt')
+    data_transfer.transfer(src, dst, scratch_dir=str(tmp_path / 'scratch'))
+    assert (tmp_path / 'scratch').exists()
+    import os
+    dst_root = dst._root()
+    assert os.path.exists(os.path.join(dst_root, 'a.txt'))
+
+
+def test_sts_transfer_job_body():
+    from skypilot_tpu.data import data_transfer
+    body = data_transfer.s3_to_gcs_transfer_job(
+        'proj', 'sbkt', 'gbkt', 'AKIA', 'SECRET')
+    assert body['transferSpec']['awsS3DataSource']['bucketName'] == 'sbkt'
+    assert body['transferSpec']['gcsDataSink']['bucketName'] == 'gbkt'
+    assert body['projectId'] == 'proj'
