@@ -115,12 +115,14 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             provisioner, retry_until_up=retry_until_up)
         handle = ClusterHandle(cluster_name, result.resources,
                                result.num_nodes, result.cluster_info)
+        from skypilot_tpu.workspaces import context as ws_context
+        workspace = ws_context.get_active()
         state.add_or_update_cluster(cluster_name, handle,
                                     requested_resources=task.resources,
-                                    ready=False)
+                                    ready=False, workspace=workspace)
         self._setup_runtime(handle)
         state.add_or_update_cluster(cluster_name, handle, ready=True,
-                                    is_launch=False)
+                                    is_launch=False, workspace=workspace)
         return handle
 
     def _agent_env(self, handle: ClusterHandle) -> Dict[str, str]:

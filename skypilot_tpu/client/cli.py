@@ -435,6 +435,79 @@ def storage_delete(names, yes):
         click.echo(f'Storage {name} deleted.')
 
 
+@cli.group()
+def users():
+    """User management (twin of `sky users`; admin-only on auth servers)."""
+
+
+@users.command(name='ls')
+def users_ls():
+    from skypilot_tpu.client import sdk
+    records = sdk.users_list()
+    if not records:
+        click.echo('No users.')
+        return
+    click.echo(f'{"NAME":<24}{"ROLE":<10}')
+    for r in records:
+        click.echo(f'{r["name"]:<24}{r["role"]:<10}')
+
+
+@users.command(name='create')
+@click.argument('name')
+@click.argument('password')
+@click.option('--role', default='user', type=click.Choice(
+    ['admin', 'user']))
+def users_create(name, password, role):
+    from skypilot_tpu.client import sdk
+    sdk.users_create(name, password, role)
+    click.echo(f'User {name} ({role}) created.')
+
+
+@users.command(name='delete')
+@click.argument('name')
+def users_delete(name):
+    from skypilot_tpu.client import sdk
+    sdk.users_delete(name)
+    click.echo(f'User {name} deleted.')
+
+
+@users.command(name='set-role')
+@click.argument('name')
+@click.argument('role', type=click.Choice(['admin', 'user']))
+def users_set_role(name, role):
+    from skypilot_tpu.client import sdk
+    sdk.users_set_role(name, role)
+    click.echo(f'User {name} role set to {role}.')
+
+
+@cli.group()
+def workspaces():
+    """Workspace management (multi-tenant cluster namespaces)."""
+
+
+@workspaces.command(name='ls')
+def workspaces_ls():
+    from skypilot_tpu.client import sdk
+    for name in sdk.workspaces_list():
+        click.echo(name)
+
+
+@workspaces.command(name='create')
+@click.argument('name')
+def workspaces_create(name):
+    from skypilot_tpu.client import sdk
+    sdk.workspaces_create(name)
+    click.echo(f'Workspace {name} created.')
+
+
+@workspaces.command(name='delete')
+@click.argument('name')
+def workspaces_delete(name):
+    from skypilot_tpu.client import sdk
+    sdk.workspaces_delete(name)
+    click.echo(f'Workspace {name} deleted.')
+
+
 def main() -> None:
     cli()
 

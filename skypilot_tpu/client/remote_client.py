@@ -145,6 +145,31 @@ class RemoteClient:
         return self._call('serve.status',
                           {'service_names': service_names})
 
+    # ---- users / workspaces ----
+
+    def users_list(self):
+        return self._call('users.list', {})
+
+    def users_create(self, name, password, role='user'):
+        return self._call('users.create',
+                          {'name': name, 'password': password,
+                           'role': role})
+
+    def users_delete(self, name):
+        return self._call('users.delete', {'name': name})
+
+    def users_set_role(self, name, role):
+        return self._call('users.set_role', {'name': name, 'role': role})
+
+    def workspaces_list(self):
+        return self._call('workspaces.list', {})
+
+    def workspaces_create(self, name):
+        return self._call('workspaces.create', {'name': name})
+
+    def workspaces_delete(self, name):
+        return self._call('workspaces.delete', {'name': name})
+
     def serve_down(self, service_name):
         return self._call('serve.down', {'service_name': service_name})
 

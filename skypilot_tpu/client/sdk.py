@@ -200,3 +200,54 @@ def serve_down(service_name: str) -> None:
         return remote.serve_down(service_name)
     from skypilot_tpu.serve import core as serve_core
     return serve_core.down(service_name)
+
+
+# ---- users / workspaces ----------------------------------------------------
+
+
+def _module_local_or_remote(module_path: str, fn: str, remote_method: str,
+                            *args, **kwargs):
+    remote = _remote()
+    if remote is not None:
+        return getattr(remote, remote_method)(*args, **kwargs)
+    import importlib
+    mod = importlib.import_module(module_path)
+    return getattr(mod, fn)(*args, **kwargs)
+
+
+def users_list() -> List[Dict[str, Any]]:
+    return _module_local_or_remote('skypilot_tpu.users.core', 'list_users',
+                                   'users_list')
+
+
+def users_create(name: str, password: str, role: str = 'user'):
+    return _module_local_or_remote('skypilot_tpu.users.core',
+                                   'create_user', 'users_create', name,
+                                   password, role)
+
+
+def users_delete(name: str):
+    return _module_local_or_remote('skypilot_tpu.users.core',
+                                   'delete_user', 'users_delete', name)
+
+
+def users_set_role(name: str, role: str):
+    return _module_local_or_remote('skypilot_tpu.users.core', 'set_role',
+                                   'users_set_role', name, role)
+
+
+def workspaces_list() -> List[str]:
+    return _module_local_or_remote('skypilot_tpu.workspaces.core',
+                                   'get_workspaces', 'workspaces_list')
+
+
+def workspaces_create(name: str):
+    return _module_local_or_remote('skypilot_tpu.workspaces.core',
+                                   'create_workspace', 'workspaces_create',
+                                   name)
+
+
+def workspaces_delete(name: str):
+    return _module_local_or_remote('skypilot_tpu.workspaces.core',
+                                   'delete_workspace', 'workspaces_delete',
+                                   name)

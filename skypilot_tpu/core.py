@@ -83,8 +83,14 @@ def refresh_cluster_status(cluster_name: str) -> Optional[Dict[str, Any]]:
 
 
 def status(cluster_names: Optional[List[str]] = None,
-           refresh: bool = False) -> List[Dict[str, Any]]:
-    records = state.get_clusters()
+           refresh: bool = False,
+           workspace: Optional[str] = None) -> List[Dict[str, Any]]:
+    if workspace is None:
+        # Honor a pinned workspace (XSKY_WORKSPACE); with no pin, show
+        # everything — the admin-friendly default.
+        import os
+        workspace = os.environ.get('XSKY_WORKSPACE') or None
+    records = state.get_clusters(workspace=workspace)
     if cluster_names:
         records = [r for r in records if r['name'] in cluster_names]
     if refresh:

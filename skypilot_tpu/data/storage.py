@@ -275,8 +275,8 @@ class AzureBlobStore(AbstractStore):
 
     def upload(self) -> None:
         src = shlex.quote(os.path.expanduser(self.source or '.'))
-        dest = f' --destination-path {self.sub_path}' if self.sub_path \
-            else ''
+        dest = (f' --destination-path {shlex.quote(self.sub_path)}'
+                if self.sub_path else '')
         _run(f'az storage blob upload-batch -d {self.container} -s {src}'
              f'{dest}{self._acct()}')
 

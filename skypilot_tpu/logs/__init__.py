@@ -1,0 +1,23 @@
+"""Cluster log shipping agents (twin of sky/logs/).
+
+An agent renders the setup command that installs a log shipper on every
+cluster host; selection via config key `logs.store` (only 'gcp' today,
+matching the reference's fluentbit→Cloud Logging path).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from skypilot_tpu.logs.agent import LoggingAgent
+from skypilot_tpu.logs.gcp import GcpLoggingAgent
+
+_AGENTS = {
+    'gcp': GcpLoggingAgent,
+}
+
+
+def get_logging_agent(store: str, config: Dict[str, Any]) -> LoggingAgent:
+    if store not in _AGENTS:
+        raise ValueError(f'Unknown log store {store!r}; known: '
+                         f'{sorted(_AGENTS)}')
+    return _AGENTS[store](config)

@@ -1,0 +1,18 @@
+"""Logging agent ABC (twin of sky/logs/agent.py)."""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class LoggingAgent:
+    """Renders per-host setup for shipping ~/.xsky/logs to a store."""
+
+    def __init__(self, config: Dict[str, Any]) -> None:
+        self.config = config
+
+    def get_setup_command(self, cluster_name: str) -> str:
+        """Shell run on every host to install + start the shipper."""
+        raise NotImplementedError
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        return {}

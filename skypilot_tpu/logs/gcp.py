@@ -1,0 +1,61 @@
+"""Fluent Bit → GCP Cloud Logging agent (twin of sky/logs/gcp.py)."""
+from __future__ import annotations
+
+import shlex
+from typing import Any, Dict
+
+from skypilot_tpu.logs.agent import LoggingAgent
+
+_FLUENTBIT_INSTALL = (
+    'command -v fluent-bit >/dev/null || '
+    '(curl -fsSL https://raw.githubusercontent.com/fluent/fluent-bit/'
+    'master/install.sh | sudo sh)')
+
+_CONFIG_TEMPLATE = """\
+[SERVICE]
+    flush        5
+    daemon       On
+
+[INPUT]
+    name         tail
+    path         {log_glob}
+    tag          xsky.{cluster_name}
+
+[OUTPUT]
+    name         stackdriver
+    match        *
+    resource     global
+    labels       cluster={cluster_name}{extra_labels}
+"""
+
+# fluent-bit does not expand '~' in tail paths; the glob must be
+# absolute. __HOME__ is substituted with $HOME on the host at setup time.
+_DEFAULT_LOG_GLOB = '__HOME__/.xsky/logs/*/*.log'
+
+
+class GcpLoggingAgent(LoggingAgent):
+    """Ships job logs to Cloud Logging via fluent-bit's stackdriver
+    output (uses the host's application-default credentials)."""
+
+    def get_setup_command(self, cluster_name: str) -> str:
+        extra = ''
+        for key, value in (self.config.get('labels') or {}).items():
+            extra += f',{key}={value}'
+        config = _CONFIG_TEMPLATE.format(
+            log_glob=self.config.get('log_glob', _DEFAULT_LOG_GLOB),
+            cluster_name=cluster_name,
+            extra_labels=extra)
+        return (f'{_FLUENTBIT_INSTALL} && '
+                f'mkdir -p ~/.xsky && '
+                f'printf %s {shlex.quote(config)} | '
+                f'sed "s|__HOME__|$HOME|" > ~/.xsky/fluentbit.conf && '
+                f'nohup fluent-bit -c ~/.xsky/fluentbit.conf '
+                f'>/dev/null 2>&1 &')
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        path = ('~/.config/gcloud/'
+                'application_default_credentials.json')
+        import os
+        if os.path.exists(os.path.expanduser(path)):
+            return {path: path}
+        return {}

@@ -93,23 +93,66 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, {'status': 'healthy',
                              'api_version': API_VERSION})
         elif parsed.path == '/api/get':
+            if not self._authenticated():
+                self._send(401, {'error': 'authentication required'})
+                return
             code, payload = _get_request(params)
             self._send(code, payload)
         elif parsed.path == '/api/requests':
+            if not self._authenticated():
+                self._send(401, {'error': 'authentication required'})
+                return
             self._send(200, {'requests': requests_db.list_requests()})
         else:
             self._send(404, {'error': f'no route {parsed.path}'})
+
+    def _authorize(self, verb: str,
+                   body: Dict[str, Any]) -> Optional[Tuple[int, str]]:
+        """Auth + RBAC (when XSKY_REQUIRE_AUTH=1). Returns (code, error)
+        on rejection, None when allowed; fills body['user']/['role']."""
+        from skypilot_tpu.users import core as users_core
+        from skypilot_tpu.users import rbac
+        if not users_core.auth_required():
+            # Local single-user mode: admin-equivalent, no credentials.
+            body.setdefault('user', 'anon')
+            return None
+        user = users_core.authenticate_basic(
+            self.headers.get('Authorization'))
+        if user is None:
+            return 401, 'authentication required (Basic auth)'
+        if not rbac.check_permission(user['role'], verb):
+            return 403, (f'role {user["role"]!r} may not call {verb!r}')
+        # Attribution only. Never write the caller's role into the body:
+        # verbs like users.set_role read a 'role' FIELD from it.
+        body['user'] = user['name']
+        return None
+
+    def _authenticated(self) -> bool:
+        """Plain authentication gate for request-introspection routes."""
+        from skypilot_tpu.users import core as users_core
+        if not users_core.auth_required():
+            return True
+        return users_core.authenticate_basic(
+            self.headers.get('Authorization')) is not None
 
     def do_POST(self) -> None:  # noqa: N802
         parsed = urllib.parse.urlparse(self.path)
         body = self._read_body()
         if parsed.path == '/api/requests/cancel':
+            if not self._authenticated():
+                self._send(401, {'error': 'authentication required'})
+                return
             self._send(200, _cancel_request(body))
             return
         if parsed.path.startswith('/api/'):
             verb = parsed.path[len('/api/'):]
             if not payloads.known_verb(verb):
                 self._send(404, {'error': f'unknown verb {verb}'})
+                return
+            rejected = self._authorize(verb, body)
+            if rejected is not None:
+                code, error = rejected
+                self._send(code, {'error': error})
                 return
             try:
                 self._send(200, _submit_verb(verb, body))
@@ -125,6 +168,9 @@ def make_server(host: str = '127.0.0.1',
 
 
 def run(host: str = '127.0.0.1', port: int = 46580) -> None:
+    from skypilot_tpu.users import core as users_core
+    if users_core.auth_required():
+        users_core.bootstrap_admin_if_empty()
     server = make_server(host, port)
     logger.info(f'xsky API server listening on http://{host}:{port}')
     server.serve_forever()

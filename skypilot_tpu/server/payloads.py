@@ -32,10 +32,16 @@ def _require(body: Dict[str, Any], key: str) -> Any:
 
 def _launch(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
     from skypilot_tpu import execution
+    from skypilot_tpu.workspaces import context as ws_context
     task = _task_from_body(body)
+    workspace = body.get('workspace')
 
     def run_launch(**kwargs):
-        job_id, handle = execution.launch(task, **kwargs)
+        if workspace is not None:
+            from skypilot_tpu.workspaces import core as workspaces_core
+            workspaces_core.validate_exists(workspace)
+        with ws_context.active(workspace):
+            job_id, handle = execution.launch(task, **kwargs)
         return {'job_id': job_id,
                 'cluster_name': handle.get_cluster_name()
                 if handle else None}
@@ -82,7 +88,8 @@ _VERBS: Dict[str, Callable[[Dict[str, Any]],
                            Tuple[Callable, Dict[str, Any]]]] = {
     'launch': _launch,
     'exec': _exec,
-    'status': _core_verb('status', cluster_names=None, refresh=False),
+    'status': _core_verb('status', cluster_names=None, refresh=False,
+                         workspace=None),
     'start': _core_verb('start', 'cluster_name',
                         idle_minutes_to_autostop=None, down=False),
     'stop': _core_verb('stop', 'cluster_name'),
@@ -136,6 +143,20 @@ def _serve_verb(fn_name: str, *fields):
     return resolver
 
 
+def _module_verb(module_path: str, fn_name: str, *fields, **defaults):
+    def resolver(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
+        import importlib
+        mod = importlib.import_module(module_path)
+        kwargs = {f: _require(body, f) for f in fields}
+        for key, default in defaults.items():
+            kwargs[key] = body.get(key, default)
+        return getattr(mod, fn_name), kwargs
+    return resolver
+
+
+_USERS = 'skypilot_tpu.users.core'
+_WORKSPACES = 'skypilot_tpu.workspaces.core'
+
 _VERBS.update({
     'jobs.launch': _jobs_launch,
     'jobs.queue': _jobs_verb('queue'),
@@ -146,6 +167,18 @@ _VERBS.update({
         __import__('skypilot_tpu.serve.core', fromlist=['status']).status,
         {'service_names': body.get('service_names')}),
     'serve.down': _serve_verb('down', 'service_name'),
+    # User management (admin-only via users.rbac).
+    'users.list': _module_verb(_USERS, 'list_users'),
+    'users.create': _module_verb(_USERS, 'create_user', 'name', 'password',
+                                 role='user'),
+    'users.delete': _module_verb(_USERS, 'delete_user', 'name'),
+    'users.set_role': _module_verb(_USERS, 'set_role', 'name', 'role'),
+    # Workspaces.
+    'workspaces.list': _module_verb(_WORKSPACES, 'get_workspaces'),
+    'workspaces.create': _module_verb(_WORKSPACES, 'create_workspace',
+                                      'name'),
+    'workspaces.delete': _module_verb(_WORKSPACES, 'delete_workspace',
+                                      'name'),
 })
 
 

@@ -76,7 +76,26 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             key TEXT PRIMARY KEY,
             value TEXT
         );
+        CREATE TABLE IF NOT EXISTS users (
+            name TEXT PRIMARY KEY,
+            password_hash TEXT,
+            salt TEXT,
+            role TEXT DEFAULT 'user',
+            created_at INTEGER
+        );
+        CREATE TABLE IF NOT EXISTS workspaces (
+            name TEXT PRIMARY KEY,
+            created_at INTEGER
+        );
     """)
+    # Migration for pre-workspace DBs: clusters gain a workspace column.
+    try:
+        conn.execute("ALTER TABLE clusters ADD COLUMN workspace TEXT "
+                     "DEFAULT 'default'")
+    except sqlite3.OperationalError:
+        pass  # column already exists
+    conn.execute("INSERT OR IGNORE INTO workspaces (name, created_at) "
+                 "VALUES ('default', strftime('%s','now'))")
     conn.commit()
 
 
@@ -96,7 +115,8 @@ def add_or_update_cluster(cluster_name: str,
                           cluster_handle: Any,
                           requested_resources: Optional[Any] = None,
                           ready: bool = False,
-                          is_launch: bool = True) -> None:
+                          is_launch: bool = True,
+                          workspace: str = 'default') -> None:
     status = ClusterStatus.UP if ready else ClusterStatus.INIT
     conn = _get_conn()
     with _lock:
@@ -106,18 +126,19 @@ def add_or_update_cluster(cluster_name: str,
         conn.execute(
             """INSERT INTO clusters
                (name, launched_at, handle, last_use, status,
-                requested_resources)
-               VALUES (?, ?, ?, ?, ?, ?)
+                requested_resources, workspace)
+               VALUES (?, ?, ?, ?, ?, ?, ?)
                ON CONFLICT(name) DO UPDATE SET
                  handle=excluded.handle,
                  status=excluded.status,
                  last_use=excluded.last_use,
+                 workspace=excluded.workspace,
                  requested_resources=COALESCE(
                      excluded.requested_resources,
                      clusters.requested_resources)""" +
             (', launched_at=excluded.launched_at' if is_launch else ''),
             (cluster_name, now, pickle.dumps(cluster_handle),
-             str(now), status.value, requested))
+             str(now), status.value, requested, workspace))
         conn.commit()
 
 
@@ -152,9 +173,13 @@ def remove_cluster(cluster_name: str, terminate: bool) -> None:
         conn.commit()
 
 
+_CLUSTER_COLS = ('name, launched_at, handle, last_use, status, autostop, '
+                 'to_down, requested_resources, workspace')
+
+
 def _row_to_record(row) -> Dict[str, Any]:
     (name, launched_at, handle, last_use, status, autostop, to_down,
-     requested) = row
+     requested, workspace) = row
     return {
         'name': name,
         'launched_at': launched_at,
@@ -165,6 +190,7 @@ def _row_to_record(row) -> Dict[str, Any]:
         'to_down': bool(to_down),
         'requested_resources': pickle.loads(requested)
                                if requested else None,
+        'workspace': workspace or 'default',
     }
 
 
@@ -172,16 +198,23 @@ def get_cluster_from_name(
         cluster_name: str) -> Optional[Dict[str, Any]]:
     conn = _get_conn()
     with _lock:
-        row = conn.execute('SELECT * FROM clusters WHERE name=?',
-                           (cluster_name,)).fetchone()
+        row = conn.execute(
+            f'SELECT {_CLUSTER_COLS} FROM clusters WHERE name=?',
+            (cluster_name,)).fetchone()
     return _row_to_record(row) if row else None
 
 
-def get_clusters() -> List[Dict[str, Any]]:
+def get_clusters(workspace: Optional[str] = None) -> List[Dict[str, Any]]:
     conn = _get_conn()
     with _lock:
-        rows = conn.execute(
-            'SELECT * FROM clusters ORDER BY launched_at DESC').fetchall()
+        if workspace is None:
+            rows = conn.execute(
+                f'SELECT {_CLUSTER_COLS} FROM clusters '
+                'ORDER BY launched_at DESC').fetchall()
+        else:
+            rows = conn.execute(
+                f'SELECT {_CLUSTER_COLS} FROM clusters WHERE workspace=? '
+                'ORDER BY launched_at DESC', (workspace,)).fetchall()
     return [_row_to_record(r) for r in rows]
 
 
@@ -260,3 +293,86 @@ def get_enabled_clouds() -> List[str]:
     with _lock:
         rows = conn.execute('SELECT cloud FROM enabled_clouds').fetchall()
     return [r[0] for r in rows]
+
+
+# ---- users (twin of sky/users tables) -------------------------------------
+
+
+def add_user(name: str, password_hash: str, salt: str,
+             role: str = 'user') -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'INSERT INTO users (name, password_hash, salt, role, '
+            'created_at) VALUES (?, ?, ?, ?, ?) '
+            'ON CONFLICT(name) DO UPDATE SET password_hash='
+            'excluded.password_hash, salt=excluded.salt, '
+            'role=excluded.role',
+            (name, password_hash, salt, role, int(time.time())))
+        conn.commit()
+
+
+def get_user(name: str) -> Optional[Dict[str, Any]]:
+    conn = _get_conn()
+    with _lock:
+        row = conn.execute(
+            'SELECT name, password_hash, salt, role, created_at '
+            'FROM users WHERE name=?', (name,)).fetchone()
+    if row is None:
+        return None
+    return {'name': row[0], 'password_hash': row[1], 'salt': row[2],
+            'role': row[3], 'created_at': row[4]}
+
+
+def list_users() -> List[Dict[str, Any]]:
+    conn = _get_conn()
+    with _lock:
+        rows = conn.execute(
+            'SELECT name, role, created_at FROM users '
+            'ORDER BY name').fetchall()
+    return [{'name': r[0], 'role': r[1], 'created_at': r[2]} for r in rows]
+
+
+def delete_user(name: str) -> bool:
+    conn = _get_conn()
+    with _lock:
+        cur = conn.execute('DELETE FROM users WHERE name=?', (name,))
+        conn.commit()
+    return cur.rowcount > 0
+
+
+def set_user_role(name: str, role: str) -> bool:
+    conn = _get_conn()
+    with _lock:
+        cur = conn.execute('UPDATE users SET role=? WHERE name=?',
+                           (role, name))
+        conn.commit()
+    return cur.rowcount > 0
+
+
+# ---- workspaces -----------------------------------------------------------
+
+
+def add_workspace(name: str) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'INSERT OR IGNORE INTO workspaces (name, created_at) '
+            'VALUES (?, ?)', (name, int(time.time())))
+        conn.commit()
+
+
+def list_workspaces() -> List[str]:
+    conn = _get_conn()
+    with _lock:
+        rows = conn.execute(
+            'SELECT name FROM workspaces ORDER BY name').fetchall()
+    return [r[0] for r in rows]
+
+
+def delete_workspace(name: str) -> bool:
+    conn = _get_conn()
+    with _lock:
+        cur = conn.execute('DELETE FROM workspaces WHERE name=?', (name,))
+        conn.commit()
+    return cur.rowcount > 0

@@ -1,0 +1,1 @@
+"""Usage telemetry (twin of sky/usage/)."""

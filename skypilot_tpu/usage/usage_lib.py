@@ -1,0 +1,107 @@
+"""Anonymized usage telemetry (twin of sky/usage/usage_lib.py, 589 LoC).
+
+Collects per-invocation messages (command, resources shape, timings,
+outcome) keyed by a random installation id. OFF by default and fully
+disabled unless XSKY_USAGE_ENDPOINT is set (the reference posts to a Loki
+endpoint; we make the endpoint explicit opt-in — privacy default flipped).
+Messages are also appended to a local JSONL for user inspection.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_INSTALL_ID_PATH = '~/.xsky/usage_id'
+_LOCAL_LOG_PATH = '~/.xsky/usage.jsonl'
+
+
+def disabled() -> bool:
+    return os.environ.get('XSKY_DISABLE_USAGE_COLLECTION', '') == '1'
+
+
+def endpoint() -> Optional[str]:
+    return os.environ.get('XSKY_USAGE_ENDPOINT') or None
+
+
+def install_id() -> str:
+    path = os.path.expanduser(_INSTALL_ID_PATH)
+    try:
+        with open(path, encoding='utf-8') as f:
+            return f.read().strip()
+    except FileNotFoundError:
+        new_id = str(uuid.uuid4())
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, 'w', encoding='utf-8') as f:
+            f.write(new_id)
+        return new_id
+
+
+class UsageMessage:
+    """One invocation's anonymized record."""
+
+    def __init__(self, command: str) -> None:
+        self.payload: Dict[str, Any] = {
+            'schema_version': 1,
+            'install_id': install_id() if not disabled() else 'disabled',
+            'command': command,
+            'start_ts': time.time(),
+        }
+
+    def set(self, key: str, value: Any) -> 'UsageMessage':
+        self.payload[key] = value
+        return self
+
+    def set_resources_shape(self, resources: Any) -> 'UsageMessage':
+        """Record only the SHAPE of the request (no names/paths)."""
+        try:
+            self.payload['resources'] = {
+                'cloud': str(getattr(resources, 'cloud', None)),
+                'accelerators': getattr(resources, 'accelerators', None),
+                'use_spot': getattr(resources, 'use_spot', False),
+            }
+        except Exception:  # pylint: disable=broad-except
+            pass
+        return self
+
+    def finish(self, outcome: str = 'ok',
+               error: Optional[str] = None) -> None:
+        if disabled():
+            return
+        self.payload['outcome'] = outcome
+        if error:
+            self.payload['error_type'] = error
+        self.payload['duration_s'] = round(
+            time.time() - self.payload['start_ts'], 3)
+        _append_local(self.payload)
+        _maybe_post(self.payload)
+
+
+def _append_local(payload: Dict[str, Any]) -> None:
+    path = os.path.expanduser(_LOCAL_LOG_PATH)
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write(json.dumps(payload) + '\n')
+    except OSError:
+        pass
+
+
+def _maybe_post(payload: Dict[str, Any]) -> None:
+    url = endpoint()
+    if not url:
+        return
+    try:
+        import urllib.request
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={'Content-Type': 'application/json'}, method='POST')
+        urllib.request.urlopen(req, timeout=3)
+    except Exception as e:  # pylint: disable=broad-except
+        logger.debug(f'usage post failed (ignored): {e}')

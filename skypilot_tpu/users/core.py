@@ -1,0 +1,94 @@
+"""User management + authentication (twin of sky/users/{server,permission}).
+
+Passwords are stored as PBKDF2-HMAC-SHA256 (100k rounds, per-user salt).
+Authentication is opt-in: the API server enforces it only when
+XSKY_REQUIRE_AUTH=1 (local single-user deployments stay frictionless,
+like the reference's local API server).
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import secrets
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import state
+from skypilot_tpu.users import rbac
+
+_PBKDF2_ROUNDS = 100_000
+
+
+def _hash_password(password: str, salt: str) -> str:
+    digest = hashlib.pbkdf2_hmac('sha256', password.encode(),
+                                 bytes.fromhex(salt), _PBKDF2_ROUNDS)
+    return digest.hex()
+
+
+def create_user(name: str, password: str,
+                role: str = rbac.USER_ROLE) -> Dict[str, Any]:
+    if role not in rbac.ROLES:
+        raise ValueError(f'Unknown role {role!r}; expected one of '
+                         f'{rbac.ROLES}.')
+    if not name or '\n' in name or ':' in name:
+        raise ValueError(f'Invalid user name {name!r}.')
+    salt = secrets.token_hex(16)
+    state.add_user(name, _hash_password(password, salt), salt, role)
+    return {'name': name, 'role': role}
+
+
+def delete_user(name: str) -> Dict[str, Any]:
+    return {'deleted': state.delete_user(name)}
+
+
+def list_users() -> List[Dict[str, Any]]:
+    return state.list_users()
+
+
+def set_role(name: str, role: str) -> Dict[str, Any]:
+    if role not in rbac.ROLES:
+        raise ValueError(f'Unknown role {role!r}.')
+    return {'updated': state.set_user_role(name, role)}
+
+
+def verify_password(name: str, password: str) -> Optional[Dict[str, Any]]:
+    """→ user record if the password matches, else None (constant-time
+    compare)."""
+    user = state.get_user(name)
+    if user is None or not user.get('salt'):
+        return None
+    expected = user['password_hash']
+    actual = _hash_password(password, user['salt'])
+    if hmac.compare_digest(expected, actual):
+        return user
+    return None
+
+
+def auth_required() -> bool:
+    return os.environ.get('XSKY_REQUIRE_AUTH', '') == '1'
+
+
+def authenticate_basic(header: Optional[str]) -> Optional[Dict[str, Any]]:
+    """Parse an `Authorization: Basic ...` header → user record or None."""
+    if not header or not header.startswith('Basic '):
+        return None
+    try:
+        decoded = base64.b64decode(header[len('Basic '):]).decode()
+        name, _, password = decoded.partition(':')
+    except Exception:  # pylint: disable=broad-except
+        return None
+    return verify_password(name, password)
+
+
+def bootstrap_admin_if_empty() -> None:
+    """First boot with auth on: create admin with a generated password
+    printed once to the server log (reference seeds an admin similarly)."""
+    if state.list_users():
+        return
+    password = secrets.token_urlsafe(12)
+    create_user('admin', password, role=rbac.ADMIN_ROLE)
+    from skypilot_tpu import sky_logging
+    sky_logging.init_logger(__name__).warning(
+        f'Bootstrapped admin user with password: {password} '
+        '(change it with `xsky users create admin <newpass>`)')

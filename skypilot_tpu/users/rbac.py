@@ -1,0 +1,36 @@
+"""Role-based access control rules (twin of sky/users/rbac.py).
+
+Two roles, as in the reference: 'admin' (everything) and 'user'
+(everything except user/workspace administration). The reference encodes
+this with casbin policies + endpoint blocklists (sky/users/rbac.py:1-121);
+here the policy is a plain verb blocklist — same observable behavior,
+no policy-engine dependency.
+"""
+from __future__ import annotations
+
+from typing import List
+
+ADMIN_ROLE = 'admin'
+USER_ROLE = 'user'
+ROLES = (ADMIN_ROLE, USER_ROLE)
+
+# Verbs only admins may call (the reference blocks the matching
+# endpoints for non-admins).
+_ADMIN_ONLY_VERBS = frozenset({
+    'users.create',
+    'users.delete',
+    'users.set_role',
+    'workspaces.create',
+    'workspaces.delete',
+})
+
+
+def get_supported_roles() -> List[str]:
+    return list(ROLES)
+
+
+def check_permission(role: str, verb: str) -> bool:
+    """May `role` invoke `verb`?"""
+    if role == ADMIN_ROLE:
+        return True
+    return verb not in _ADMIN_ONLY_VERBS

@@ -1,0 +1,120 @@
+"""Chrome-trace-format event profiling (twin of sky/utils/timeline.py).
+
+`@timeline.event('name')` (or `with timeline.Event('name'):`) records
+begin/end pairs; `FileLockEvent` wraps a filelock acquire so lock
+contention shows up on the trace. Events are buffered in-process and
+flushed as Chrome trace JSON (chrome://tracing, Perfetto) to the path in
+$XSKY_TIMELINE_FILE — tracing is a no-op when the env var is unset, so
+instrumented code pays one dict lookup in production.
+"""
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+_events: List[Dict[str, Any]] = []
+_lock = threading.Lock()
+_flush_registered = False
+
+
+def enabled() -> bool:
+    return bool(os.environ.get('XSKY_TIMELINE_FILE'))
+
+
+def _record(name: str, phase: str, ts_us: float,
+            args: Optional[Dict[str, Any]] = None) -> None:
+    global _flush_registered
+    evt = {
+        'name': name,
+        'ph': phase,                      # 'B' begin / 'E' end
+        'ts': ts_us,
+        'pid': os.getpid(),
+        'tid': threading.get_ident() % 100_000,
+    }
+    if args:
+        evt['args'] = args
+    with _lock:
+        _events.append(evt)
+        if not _flush_registered:
+            atexit.register(save)
+            _flush_registered = True
+
+
+class Event:
+    """Context manager emitting a begin/end pair."""
+
+    def __init__(self, name: str,
+                 args: Optional[Dict[str, Any]] = None) -> None:
+        self._name = name
+        self._args = args
+
+    def __enter__(self) -> 'Event':
+        if enabled():
+            _record(self._name, 'B', time.time() * 1e6, self._args)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if enabled():
+            _record(self._name, 'E', time.time() * 1e6)
+
+
+class FileLockEvent:
+    """Wrap a filelock so time-to-acquire is visible on the trace."""
+
+    def __init__(self, lockfile: str, timeout: float = -1) -> None:
+        import filelock
+        self._lock = filelock.FileLock(lockfile, timeout=timeout)
+        self._event = Event(f'filelock:{os.path.basename(lockfile)}')
+
+    def __enter__(self):
+        self._event.__enter__()
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._lock.release()
+        self._event.__exit__(*exc)
+
+
+def event(name_or_fn=None, name: Optional[str] = None):
+    """Decorator: trace the wrapped function as one event."""
+
+    def decorate(fn: Callable, event_name: str) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not enabled():
+                return fn(*args, **kwargs)
+            with Event(event_name):
+                return fn(*args, **kwargs)
+        return wrapper
+
+    if callable(name_or_fn):
+        return decorate(name_or_fn,
+                        name or getattr(name_or_fn, '__qualname__', 'fn'))
+    return lambda fn: decorate(fn, name_or_fn or name or
+                               getattr(fn, '__qualname__', 'fn'))
+
+
+def save(path: Optional[str] = None) -> Optional[str]:
+    """Flush buffered events as Chrome trace JSON. Returns the path."""
+    path = path or os.environ.get('XSKY_TIMELINE_FILE')
+    if not path:
+        return None
+    with _lock:
+        events = list(_events)
+    payload = {'traceEvents': events, 'displayTimeUnit': 'ms'}
+    path = os.path.expanduser(path)
+    os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(payload, f)
+    return path
+
+
+def reset_for_test() -> None:
+    with _lock:
+        _events.clear()

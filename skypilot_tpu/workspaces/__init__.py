@@ -1,0 +1,1 @@
+"""Workspaces: multi-tenant isolation of clusters (twin of sky/workspaces/)."""

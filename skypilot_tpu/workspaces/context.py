@@ -1,0 +1,36 @@
+"""Active-workspace context for the executing request.
+
+Thread-local (the executor runs each request in a worker thread) with an
+env fallback so CLI/local SDK use can pin a workspace via XSKY_WORKSPACE.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Iterator, Optional
+
+DEFAULT_WORKSPACE = 'default'
+
+_local = threading.local()
+
+
+def get_active() -> str:
+    ws = getattr(_local, 'workspace', None)
+    if ws:
+        return ws
+    return os.environ.get('XSKY_WORKSPACE', DEFAULT_WORKSPACE)
+
+
+def set_active(workspace: Optional[str]) -> None:
+    _local.workspace = workspace
+
+
+@contextlib.contextmanager
+def active(workspace: Optional[str]) -> Iterator[None]:
+    prev = getattr(_local, 'workspace', None)
+    _local.workspace = workspace
+    try:
+        yield
+    finally:
+        _local.workspace = prev

@@ -1,0 +1,49 @@
+"""Workspace operations (twin of sky/workspaces/core.py, 679 LoC).
+
+A workspace is a namespace over clusters: every cluster record carries a
+workspace tag; status filters by workspace when one is pinned (request
+body or XSKY_WORKSPACE) and shows all otherwise, and a workspace cannot
+be deleted while it still owns clusters. The reference additionally
+scopes config overlays per workspace; here the task `config:` overlay
+plays that role.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List
+
+from skypilot_tpu import state
+
+_NAME_RE = re.compile(r'^[a-z0-9][a-z0-9-]{0,48}$')
+DEFAULT_WORKSPACE = 'default'
+
+
+def get_workspaces() -> List[str]:
+    return state.list_workspaces()
+
+
+def create_workspace(name: str) -> Dict[str, Any]:
+    if not _NAME_RE.match(name):
+        raise ValueError(
+            f'Invalid workspace name {name!r} (lowercase alphanumeric + '
+            'dashes, max 49 chars).')
+    state.add_workspace(name)
+    return {'name': name}
+
+
+def delete_workspace(name: str) -> Dict[str, Any]:
+    if name == DEFAULT_WORKSPACE:
+        raise ValueError('The default workspace cannot be deleted.')
+    clusters = state.get_clusters(workspace=name)
+    if clusters:
+        raise ValueError(
+            f'Workspace {name!r} still has {len(clusters)} cluster(s): '
+            f'{[c["name"] for c in clusters]}. Tear them down first.')
+    return {'deleted': state.delete_workspace(name)}
+
+
+def validate_exists(name: str) -> str:
+    if name not in state.list_workspaces():
+        raise ValueError(f'Workspace {name!r} does not exist; create it '
+                         'with `xsky workspaces create`.')
+    return name

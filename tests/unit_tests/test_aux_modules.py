@@ -87,3 +87,89 @@ class TestCloudStores:
         cs = cloud_stores.get_storage_from_url('file://bkt/sub')
         cmd = cs.make_sync_dir_command('file://bkt', '/data')
         assert f'cp -a {tmp_path}/bkt/.' in cmd
+
+
+class TestTimeline:
+
+    def test_noop_when_disabled(self, monkeypatch):
+        from skypilot_tpu.utils import timeline
+        monkeypatch.delenv('XSKY_TIMELINE_FILE', raising=False)
+        timeline.reset_for_test()
+
+        @timeline.event('my-op')
+        def work():
+            return 7
+
+        assert work() == 7
+        assert timeline.save() is None
+
+    def test_records_and_saves_chrome_trace(self, tmp_path, monkeypatch):
+        import json as json_lib
+        from skypilot_tpu.utils import timeline
+        trace = tmp_path / 'trace.json'
+        monkeypatch.setenv('XSKY_TIMELINE_FILE', str(trace))
+        timeline.reset_for_test()
+
+        @timeline.event('op-a')
+        def work():
+            with timeline.Event('op-b', args={'k': 1}):
+                pass
+
+        work()
+        path = timeline.save()
+        data = json_lib.loads(open(path).read())
+        names = [e['name'] for e in data['traceEvents']]
+        assert names.count('op-a') == 2       # begin + end
+        assert names.count('op-b') == 2
+        phases = {e['ph'] for e in data['traceEvents']}
+        assert phases == {'B', 'E'}
+
+    def test_filelock_event(self, tmp_path, monkeypatch):
+        from skypilot_tpu.utils import timeline
+        monkeypatch.setenv('XSKY_TIMELINE_FILE',
+                           str(tmp_path / 't.json'))
+        timeline.reset_for_test()
+        with timeline.FileLockEvent(str(tmp_path / 'l.lock')):
+            pass
+        import json as json_lib
+        data = json_lib.loads(open(timeline.save()).read())
+        assert any(e['name'].startswith('filelock:')
+                   for e in data['traceEvents'])
+
+
+class TestUsage:
+
+    def test_local_jsonl_and_disable(self, tmp_path, monkeypatch):
+        import json as json_lib
+        from skypilot_tpu.usage import usage_lib
+        monkeypatch.setattr(usage_lib, '_INSTALL_ID_PATH',
+                            str(tmp_path / 'id'))
+        monkeypatch.setattr(usage_lib, '_LOCAL_LOG_PATH',
+                            str(tmp_path / 'usage.jsonl'))
+        monkeypatch.delenv('XSKY_DISABLE_USAGE_COLLECTION', raising=False)
+        monkeypatch.delenv('XSKY_USAGE_ENDPOINT', raising=False)
+        msg = usage_lib.UsageMessage('launch')
+        msg.set('num_nodes', 4).finish('ok')
+        lines = open(tmp_path / 'usage.jsonl').read().splitlines()
+        rec = json_lib.loads(lines[-1])
+        assert rec['command'] == 'launch' and rec['outcome'] == 'ok'
+        assert rec['install_id'] == usage_lib.install_id()
+        # Disabled: nothing written.
+        monkeypatch.setenv('XSKY_DISABLE_USAGE_COLLECTION', '1')
+        usage_lib.UsageMessage('status').finish('ok')
+        assert len(open(tmp_path / 'usage.jsonl').read().splitlines()) == \
+            len(lines)
+
+
+class TestLogsAgents:
+
+    def test_gcp_agent_setup_command(self):
+        from skypilot_tpu import logs as logs_lib
+        agent = logs_lib.get_logging_agent(
+            'gcp', {'labels': {'env': 'prod'}})
+        cmd = agent.get_setup_command('mycluster')
+        assert 'fluent-bit' in cmd
+        assert 'cluster=mycluster' in cmd
+        assert 'env=prod' in cmd
+        with pytest.raises(ValueError):
+            logs_lib.get_logging_agent('splunk', {})

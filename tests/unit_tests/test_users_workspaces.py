@@ -1,0 +1,205 @@
+"""Users / RBAC / workspaces tests (state + live API server)."""
+import base64
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from skypilot_tpu import state
+from skypilot_tpu.server import app as server_app
+from skypilot_tpu.server import requests_db
+from skypilot_tpu.users import core as users_core
+from skypilot_tpu.users import rbac
+from skypilot_tpu.workspaces import core as workspaces_core
+
+
+@pytest.fixture
+def clean_state(tmp_path, monkeypatch):
+    monkeypatch.setenv('XSKY_STATE_DB', str(tmp_path / 'state.db'))
+    state.reset_for_test()
+    yield
+    state.reset_for_test()
+
+
+class TestUsers:
+
+    def test_create_verify_roundtrip(self, clean_state):
+        users_core.create_user('alice', 'hunter2', role='admin')
+        assert users_core.verify_password('alice', 'hunter2') is not None
+        assert users_core.verify_password('alice', 'wrong') is None
+        assert users_core.verify_password('bob', 'hunter2') is None
+        users = users_core.list_users()
+        assert [u['name'] for u in users] == ['alice']
+        assert users[0]['role'] == 'admin'
+        # Password hash is salted PBKDF2, not the raw password.
+        raw = state.get_user('alice')
+        assert 'hunter2' not in raw['password_hash']
+
+    def test_role_management(self, clean_state):
+        users_core.create_user('bob', 'pw')
+        assert users_core.set_role('bob', 'admin')['updated']
+        assert state.get_user('bob')['role'] == 'admin'
+        with pytest.raises(ValueError):
+            users_core.set_role('bob', 'superroot')
+        assert users_core.delete_user('bob')['deleted']
+        assert users_core.list_users() == []
+
+    def test_basic_auth_parsing(self, clean_state):
+        users_core.create_user('carol', 's3cret')
+        header = 'Basic ' + base64.b64encode(b'carol:s3cret').decode()
+        assert users_core.authenticate_basic(header)['name'] == 'carol'
+        assert users_core.authenticate_basic('Basic !!!') is None
+        assert users_core.authenticate_basic(None) is None
+
+    def test_rbac_rules(self):
+        assert rbac.check_permission('admin', 'users.create')
+        assert not rbac.check_permission('user', 'users.create')
+        assert not rbac.check_permission('user', 'workspaces.delete')
+        assert rbac.check_permission('user', 'launch')
+        assert rbac.check_permission('user', 'status')
+
+
+class TestWorkspaces:
+
+    def test_create_list_delete(self, clean_state):
+        assert workspaces_core.get_workspaces() == ['default']
+        workspaces_core.create_workspace('team-a')
+        assert 'team-a' in workspaces_core.get_workspaces()
+        with pytest.raises(ValueError):
+            workspaces_core.create_workspace('Bad Name!')
+        with pytest.raises(ValueError):
+            workspaces_core.delete_workspace('default')
+        assert workspaces_core.delete_workspace('team-a')['deleted']
+
+    def test_delete_refuses_with_clusters(self, clean_state):
+        workspaces_core.create_workspace('team-b')
+        state.add_or_update_cluster('c1', {'h': 1}, workspace='team-b')
+        with pytest.raises(ValueError, match='cluster'):
+            workspaces_core.delete_workspace('team-b')
+        state.remove_cluster('c1', terminate=True)
+        assert workspaces_core.delete_workspace('team-b')['deleted']
+
+    def test_cluster_workspace_filter(self, clean_state):
+        state.add_or_update_cluster('c1', {'h': 1}, workspace='default')
+        state.add_or_update_cluster('c2', {'h': 2}, workspace='ws2')
+        assert len(state.get_clusters()) == 2
+        assert [c['name'] for c in state.get_clusters('ws2')] == ['c2']
+        assert state.get_cluster_from_name('c2')['workspace'] == 'ws2'
+
+
+@pytest.fixture
+def auth_server(clean_state, monkeypatch, tmp_path):
+    monkeypatch.setenv('XSKY_SERVER_DB', str(tmp_path / 'requests.db'))
+    monkeypatch.setenv('XSKY_REQUIRE_AUTH', '1')
+    requests_db.reset_for_test()
+    users_core.create_user('root', 'rootpw', role='admin')
+    users_core.create_user('dev', 'devpw', role='user')
+    server, port = server_app.run_in_thread()
+    yield f'http://127.0.0.1:{port}'
+    server.shutdown()
+    requests_db.reset_for_test()
+
+
+def _post(url, verb, body=None, user=None, password=None):
+    data = json.dumps(body or {}).encode()
+    req = urllib.request.Request(f'{url}/api/{verb}', data=data,
+                                 method='POST')
+    if user is not None:
+        token = base64.b64encode(f'{user}:{password}'.encode()).decode()
+        req.add_header('Authorization', f'Basic {token}')
+    with urllib.request.urlopen(req) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+class TestServerAuth:
+
+    def test_unauthenticated_rejected(self, auth_server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(auth_server, 'status')
+        assert e.value.code == 401
+
+    def test_wrong_password_rejected(self, auth_server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(auth_server, 'status', user='dev', password='nope')
+        assert e.value.code == 401
+
+    def test_user_role_blocked_from_admin_verbs(self, auth_server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(auth_server, 'users.create',
+                  {'name': 'x', 'password': 'y'},
+                  user='dev', password='devpw')
+        assert e.value.code == 403
+
+    def test_admin_can_manage_users_and_workspaces(self, auth_server):
+        code, payload = _post(auth_server, 'users.create',
+                              {'name': 'newbie', 'password': 'pw'},
+                              user='root', password='rootpw')
+        assert code == 200 and 'request_id' in payload
+        code, payload = _post(auth_server, 'workspaces.create',
+                              {'name': 'team-x'},
+                              user='root', password='rootpw')
+        assert code == 200
+
+    def test_user_can_run_normal_verbs(self, auth_server):
+        code, payload = _post(auth_server, 'status', user='dev',
+                              password='devpw')
+        assert code == 200 and 'request_id' in payload
+
+
+class TestServerAuthRegressions:
+
+    def test_introspection_routes_require_auth(self, auth_server):
+        # /api/requests and /api/get must not leak without credentials.
+        for path in ('/api/requests', '/api/get?request_id=x'):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(f'{auth_server}{path}')
+            assert e.value.code == 401, path
+        req = urllib.request.Request(
+            f'{auth_server}/api/requests/cancel',
+            data=b'{"request_id": "x"}', method='POST')
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 401
+
+    def test_set_role_not_clobbered_by_caller_role(self, auth_server,
+                                                   clean_state):
+        """Admin demoting a user must not be overridden by the admin's
+        own role leaking into the body."""
+        import time
+        users_core.create_user('eve', 'pw', role='admin')
+        code, payload = _post(auth_server, 'users.set_role',
+                              {'name': 'eve', 'role': 'user'},
+                              user='root', password='rootpw')
+        assert code == 200
+        # Wait for the async request to finish.
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if state.get_user('eve')['role'] == 'user':
+                break
+            time.sleep(0.1)
+        assert state.get_user('eve')['role'] == 'user'
+
+
+class TestWorkspaceRegressions:
+
+    def test_create_user_upsert_updates_role(self, clean_state):
+        users_core.create_user('sam', 'pw1', role='user')
+        users_core.create_user('sam', 'pw2', role='admin')
+        assert state.get_user('sam')['role'] == 'admin'
+        assert users_core.verify_password('sam', 'pw2') is not None
+
+    def test_relaunch_moves_workspace(self, clean_state):
+        state.add_or_update_cluster('c1', {'h': 1}, workspace='a')
+        state.add_or_update_cluster('c1', {'h': 1}, workspace='b')
+        assert state.get_cluster_from_name('c1')['workspace'] == 'b'
+
+    def test_status_honors_pinned_workspace(self, clean_state,
+                                            monkeypatch):
+        from skypilot_tpu import core
+        state.add_or_update_cluster('c1', {'h': 1}, workspace='default')
+        state.add_or_update_cluster('c2', {'h': 2}, workspace='ws9')
+        monkeypatch.setenv('XSKY_WORKSPACE', 'ws9')
+        assert [c['name'] for c in core.status()] == ['c2']
+        monkeypatch.delenv('XSKY_WORKSPACE')
+        assert len(core.status()) == 2
