@@ -5,6 +5,7 @@ from skypilot_tpu.clouds.cloud import Region
 from skypilot_tpu.clouds.fake import Fake
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.kubernetes import Kubernetes
+from skypilot_tpu.clouds.ssh import SSH
 
 __all__ = ['Cloud', 'CloudImplementationFeatures', 'Region', 'GCP', 'Fake',
-           'Kubernetes']
+           'Kubernetes', 'SSH']

@@ -1,0 +1,148 @@
+"""SSH cloud: BYO machine pools (twin of sky/clouds/ssh.py + provision/ssh).
+
+Pools are declared in ``~/.xsky/ssh_node_pools.yaml``:
+
+    my-pool:
+      user: ubuntu                  # pool-wide defaults
+      identity_file: ~/.ssh/id_rsa
+      hosts:
+        - ip: 10.0.0.1
+        - ip: 10.0.0.2
+          user: other               # per-host override
+
+A pool is a "region"; provisioning allocates hosts from the pool (no
+cloud API — reachability is the only health check). Cost is 0, like
+Kubernetes: the optimizer prefers BYO capacity when it fits.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import yaml
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+_Features = cloud_lib.CloudImplementationFeatures
+
+POOLS_PATH = '~/.xsky/ssh_node_pools.yaml'
+
+
+def load_pools(path: Optional[str] = None) -> Dict[str, Dict[str, Any]]:
+    path = os.path.expanduser(path or
+                              os.environ.get('XSKY_SSH_NODE_POOLS',
+                                             POOLS_PATH))
+    try:
+        with open(path, encoding='utf-8') as f:
+            data = yaml.safe_load(f) or {}
+    except FileNotFoundError:
+        return {}
+    pools: Dict[str, Dict[str, Any]] = {}
+    for name, spec in data.items():
+        spec = dict(spec or {})
+        hosts = []
+        for h in spec.get('hosts', []):
+            if isinstance(h, str):
+                h = {'ip': h}
+            hosts.append({
+                'ip': h['ip'],
+                'user': h.get('user', spec.get('user', 'root')),
+                'identity_file': os.path.expanduser(
+                    h.get('identity_file',
+                          spec.get('identity_file', '~/.ssh/id_rsa'))),
+                'ssh_port': int(h.get('ssh_port', spec.get('ssh_port',
+                                                           22))),
+            })
+        pools[name] = {'hosts': hosts}
+    return pools
+
+
+@registry.CLOUD_REGISTRY.register()
+class SSH(cloud_lib.Cloud):
+    _REPR = 'SSH'
+
+    def unsupported_features_for_resources(
+        self, resources: 'resources_lib.Resources'
+    ) -> Dict[_Features, str]:
+        del resources
+        return {
+            _Features.STOP: 'BYO machines are never stopped by us.',
+            _Features.AUTOSTOP: 'Autostop releases the hosts instead.',
+            _Features.SPOT_INSTANCE: 'No spot market for BYO machines.',
+            _Features.OPEN_PORTS: 'Manage firewalls on your own hosts.',
+            _Features.CUSTOM_DISK_TIER: 'BYO disks.',
+        }
+
+    # ---- placement: pools are regions ----
+
+    def regions_with_offering(self, instance_type: str,
+                              accelerators: Optional[Dict[str, Any]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[cloud_lib.Region]:
+        del instance_type, accelerators, zone
+        if use_spot:
+            return []
+        pools = load_pools()
+        names = [region] if region else sorted(pools)
+        return [cloud_lib.Region(n, [n]) for n in names if n in pools]
+
+    def zones_provision_loop(self, region: str, num_nodes: int,
+                             instance_type: str,
+                             accelerators: Optional[Dict[str, Any]] = None,
+                             use_spot: bool = False) -> Iterator[List[str]]:
+        del num_nodes, instance_type, accelerators, use_spot
+        yield [region]
+
+    # ---- pricing ----
+
+    def instance_type_to_hourly_cost(self, instance_type, use_spot,
+                                     region=None, zone=None) -> float:
+        return 0.0
+
+    def accelerators_to_hourly_cost(self, accelerators, use_spot,
+                                    region=None, zone=None) -> float:
+        return 0.0
+
+    # ---- feasibility ----
+
+    def instance_type_exists(self, instance_type: str) -> bool:
+        return True  # free-form: hosts are whatever the user racked
+
+    def validate_region_zone(self, region, zone) -> None:
+        if region is not None and region not in load_pools():
+            raise ValueError(f'Unknown SSH pool {region!r}; known: '
+                             f'{sorted(load_pools())}')
+
+    def get_default_instance_type(self, cpus=None, memory=None):
+        return 'byo'
+
+    def get_feasible_launchable_resources(
+        self, resources: 'resources_lib.Resources'
+    ) -> Tuple[List['resources_lib.Resources'], List[str]]:
+        if resources.use_spot or not load_pools():
+            return [], []
+        return [resources.copy(cloud=self.name,
+                               instance_type=resources.instance_type or
+                               'byo')], []
+
+    # ---- provisioner handoff ----
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        return {
+            'cluster_name': cluster_name,
+            'pool': region,
+            'num_hosts_per_node': 1,
+        }
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        pools = load_pools()
+        if not pools:
+            return False, (f'No SSH node pools defined in {POOLS_PATH}.')
+        return True, None

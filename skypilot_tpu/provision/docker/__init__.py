@@ -1,0 +1,1 @@
+"""Local-docker provisioner (dev backend)."""

@@ -1,0 +1,132 @@
+"""Local Docker provisioner: containers as cluster hosts (dev backend).
+
+Twin of sky/backends/local_docker_backend.py (412 LoC), reshaped to the
+provisioner op-set so the normal backend/gang-launcher path drives it —
+no special backend class. Each host is one container named
+``xsky-<cluster>-<i>`` running `sleep infinity`; commands run via
+`docker exec` (utils/command_runner.DockerCommandRunner). All docker CLI
+access goes through :func:`_run_docker`, mockable in tests.
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+
+_LABEL = 'xsky-cluster'
+
+
+def _run_docker(args: List[str], input_data: Optional[str] = None,
+                timeout: float = 120.0) -> str:
+    try:
+        proc = subprocess.run(['docker'] + args, capture_output=True,
+                              text=True, input=input_data,
+                              timeout=timeout, check=False)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise exceptions.ProvisionError(f'docker failed: {e}') from e
+    if proc.returncode != 0:
+        raise exceptions.ProvisionError(
+            f'docker {" ".join(args[:2])}... failed: '
+            f'{proc.stderr.strip()[:500]}')
+    return proc.stdout
+
+
+def _container_name(cluster_name: str, index: int) -> str:
+    return f'xsky-{cluster_name}-{index}'
+
+
+def _list_containers(cluster_name: str) -> Dict[str, Dict[str, Any]]:
+    out = _run_docker(['ps', '-a', '--filter',
+                       f'label={_LABEL}={cluster_name}',
+                       '--format', '{{json .}}'])
+    containers = {}
+    for line in out.splitlines():
+        if not line.strip():
+            continue
+        c = json.loads(line)
+        containers[c['Names']] = c
+    return containers
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    del zone
+    image = config.node_config.get('image_id') or 'python:3.11-slim'
+    existing = _list_containers(cluster_name)
+    created: List[str] = []
+    for i in range(config.count):
+        name = _container_name(cluster_name, i)
+        if name in existing:
+            if 'Up' not in existing[name].get('Status', ''):
+                _run_docker(['start', name])
+            continue
+        _run_docker(['run', '-d', '--name', name,
+                     '--label', f'{_LABEL}={cluster_name}',
+                     '--label', f'xsky-host-index={i}',
+                     image, 'sleep', 'infinity'])
+        created.append(name)
+    return common.ProvisionRecord(
+        provider_name='docker',
+        cluster_name=cluster_name,
+        region=region,
+        zone=None,
+        resumed_instance_ids=[],
+        created_instance_ids=created,
+        head_instance_id=_container_name(cluster_name, 0),
+    )
+
+
+def query_instances(cluster_name: str,
+                    provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    out = {}
+    for name, c in _list_containers(cluster_name).items():
+        status = c.get('Status', '')
+        out[name] = 'RUNNING' if status.startswith('Up') else 'STOPPED'
+    return out
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    for name in _list_containers(cluster_name):
+        _run_docker(['stop', name])
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    for name in _list_containers(cluster_name):
+        _run_docker(['rm', '-f', name])
+
+
+def wait_instances(region: str, cluster_name: str, state: str) -> None:
+    del region, cluster_name, state  # docker run/stop are synchronous
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
+    del region
+    instances: Dict[str, common.InstanceInfo] = {}
+    for name, c in sorted(_list_containers(cluster_name).items()):
+        inspect = json.loads(_run_docker(['inspect', name]))[0]
+        ip = inspect.get('NetworkSettings', {}).get('IPAddress', '')
+        idx = int(inspect.get('Config', {}).get('Labels', {}).get(
+            'xsky-host-index', 0))
+        instances[name] = common.InstanceInfo(
+            instance_id=name,
+            internal_ip=ip,
+            external_ip=None,
+            status='RUNNING' if inspect.get('State', {}).get('Running')
+            else 'STOPPED',
+            host_index=idx,
+        )
+    head = _container_name(cluster_name, 0)
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=head if head in instances else None,
+        provider_name='docker',
+        provider_config=provider_config,
+        ssh_user='root',
+    )

@@ -1,0 +1,1 @@
+"""SSH node-pool provisioner."""

@@ -1,0 +1,141 @@
+"""SSH node-pool provisioner: allocate BYO hosts to clusters.
+
+Twin of sky/provision/ssh (~400 LoC). "Provisioning" here is pure
+bookkeeping: hosts come from ~/.xsky/ssh_node_pools.yaml; an allocation
+file (JSON under ~/.xsky/ssh_allocations.json, file-locked) maps
+cluster → host ips so concurrent launches don't double-book a machine.
+Termination releases the hosts; nothing is ever created or destroyed.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from typing import Any, Dict, Iterator, List, Optional
+
+import filelock
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds import ssh as ssh_cloud
+from skypilot_tpu.provision import common
+
+
+def _alloc_path() -> str:
+    return os.path.expanduser(
+        os.environ.get('XSKY_SSH_ALLOCATIONS',
+                       '~/.xsky/ssh_allocations.json'))
+
+
+@contextlib.contextmanager
+def _allocations() -> Iterator[Dict[str, Any]]:
+    path = _alloc_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with filelock.FileLock(path + '.lock'):
+        try:
+            with open(path, encoding='utf-8') as f:
+                data = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            data = {}
+        yield data
+        tmp = path + '.tmp'
+        with open(tmp, 'w', encoding='utf-8') as f:
+            json.dump(data, f, indent=1)
+        os.replace(tmp, path)
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    del zone
+    pool_name = config.node_config.get('pool', region)
+    pools = ssh_cloud.load_pools()
+    if pool_name not in pools:
+        raise exceptions.ProvisionError(f'Unknown SSH pool {pool_name!r}.')
+    hosts = pools[pool_name]['hosts']
+    need = config.count
+    with _allocations() as alloc:
+        taken = {ip for cl, info in alloc.items()
+                 if cl != cluster_name for ip in info.get('ips', [])}
+        mine = alloc.get(cluster_name, {}).get('ips', [])
+        free = [h for h in hosts
+                if h['ip'] not in taken and h['ip'] not in mine]
+        n_free = len(free)
+        while len(mine) < need and free:
+            mine.append(free.pop(0)['ip'])
+        if len(mine) < need:
+            raise exceptions.CapacityError(
+                f'SSH pool {pool_name!r}: need {need} host(s) but only '
+                f'{n_free} free (+{len(mine) - n_free if mine else 0} '
+                f'already held by {cluster_name!r}).')
+        alloc[cluster_name] = {'pool': pool_name, 'ips': mine[:need]}
+    return common.ProvisionRecord(
+        provider_name='ssh',
+        cluster_name=cluster_name,
+        region=pool_name,
+        zone=None,
+        resumed_instance_ids=[],
+        created_instance_ids=list(mine[:need]),
+        head_instance_id=mine[0],
+    )
+
+
+def query_instances(cluster_name: str,
+                    provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    with _allocations() as alloc:
+        info = alloc.get(cluster_name)
+    if not info:
+        return {}
+    return {ip: 'RUNNING' for ip in info['ips']}
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    raise exceptions.NotSupportedError(
+        'BYO SSH hosts cannot be stopped; tear down to release them.')
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    with _allocations() as alloc:
+        alloc.pop(cluster_name, None)
+
+
+def wait_instances(region: str, cluster_name: str, state: str) -> None:
+    pass  # hosts are always "up"; reachability is checked by SSH wait
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
+    with _allocations() as alloc:
+        info = alloc.get(cluster_name)
+    if not info:
+        return common.ClusterInfo(instances={}, head_instance_id=None,
+                                  provider_name='ssh',
+                                  provider_config=provider_config)
+    pools = ssh_cloud.load_pools()
+    by_ip = {h['ip']: h for h in pools.get(info['pool'],
+                                           {'hosts': []})['hosts']}
+    instances: Dict[str, common.InstanceInfo] = {}
+    for idx, ip in enumerate(info['ips']):
+        host = by_ip.get(ip, {'user': 'root', 'ssh_port': 22,
+                              'identity_file': '~/.ssh/id_rsa'})
+        instances[ip] = common.InstanceInfo(
+            instance_id=ip,
+            internal_ip=ip,
+            external_ip=ip,
+            status='RUNNING',
+            # Per-host credentials travel in tags (hosts in one pool may
+            # have different users/keys); runners read them from here.
+            tags={'identity_file': host['identity_file'],
+                  'ssh_user': host['user']},
+            host_index=idx,
+            ssh_port=host['ssh_port'],
+        )
+    head_host = by_ip.get(info['ips'][0], {'user': 'root'})
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=info['ips'][0],
+        provider_name='ssh',
+        provider_config=provider_config,
+        ssh_user=head_host['user'],
+    )

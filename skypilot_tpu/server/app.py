@@ -162,6 +162,80 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(404, {'error': f'no route {parsed.path}'})
 
 
+    @staticmethod
+    def _tunnel_target_allowed(host: str) -> bool:
+        """Only cluster hosts may be tunneled to — the CONNECT endpoint
+        must not be an open relay into the server's network. Override
+        with XSKY_TUNNEL_ALLOW_ANY=1 (trusted networks only)."""
+        import os
+        if os.environ.get('XSKY_TUNNEL_ALLOW_ANY') == '1':
+            return True
+        from skypilot_tpu import state
+        try:
+            for record in state.get_clusters():
+                handle = record.get('handle')
+                info = getattr(handle, 'cluster_info', None)
+                for inst in getattr(info, 'instances', {}).values():
+                    if host in (inst.internal_ip, inst.external_ip):
+                        return True
+        except Exception:  # pylint: disable=broad-except
+            return False
+        return False
+
+    def do_CONNECT(self) -> None:  # noqa: N802
+        """TCP tunnel to a cluster host (ssh-over-API-server; twin of the
+        reference's websocket proxy, sky/templates/websocket_proxy.py)."""
+        import socket
+        if not self._authenticated():
+            self._send(401, {'error': 'authentication required'})
+            return
+        host, _, port_s = self.path.partition(':')
+        if not self._tunnel_target_allowed(host):
+            self._send(403, {'error': f'{host} is not a cluster host'})
+            return
+        try:
+            upstream = socket.create_connection(
+                (host, int(port_s or 22)), timeout=30)
+        except (OSError, ValueError) as e:
+            self._send(502, {'error': f'cannot reach {self.path}: {e}'})
+            return
+        self.send_response(200, 'Connection established')
+        self.end_headers()
+        try:
+            import select
+            # Splice any client bytes the handler's buffered reader read
+            # past the CONNECT headers (pipelined first payload).
+            self.connection.setblocking(False)
+            try:
+                pending = self.rfile.read1(65536)
+            except (BlockingIOError, ValueError, OSError):
+                pending = b''
+            self.connection.setblocking(True)
+            if pending:
+                upstream.sendall(pending)
+            conns = [self.connection, upstream]
+            while True:
+                # Long idle timeout: interactive sessions idle legitimately;
+                # dead peers are reaped by TCP resets on the next select.
+                readable, _, _ = select.select(conns, [], [], 14400)
+                if not readable:
+                    break
+                done = False
+                for src in readable:
+                    dst = upstream if src is self.connection else \
+                        self.connection
+                    data = src.recv(65536)
+                    if not data:
+                        done = True
+                        break
+                    dst.sendall(data)
+                if done:
+                    break
+        finally:
+            upstream.close()
+        self.close_connection = True
+
+
 def make_server(host: str = '127.0.0.1',
                 port: int = 46580) -> ThreadingHTTPServer:
     return ThreadingHTTPServer((host, port), _Handler)

@@ -116,7 +116,10 @@ def add_or_update_cluster(cluster_name: str,
                           requested_resources: Optional[Any] = None,
                           ready: bool = False,
                           is_launch: bool = True,
-                          workspace: str = 'default') -> None:
+                          workspace: Optional[str] = None) -> None:
+    """workspace=None means "leave unchanged" on update ('default' for a
+    new row) — restart/recovery paths must not move a cluster out of its
+    workspace by omitting the argument."""
     status = ClusterStatus.UP if ready else ClusterStatus.INIT
     conn = _get_conn()
     with _lock:
@@ -127,18 +130,18 @@ def add_or_update_cluster(cluster_name: str,
             """INSERT INTO clusters
                (name, launched_at, handle, last_use, status,
                 requested_resources, workspace)
-               VALUES (?, ?, ?, ?, ?, ?, ?)
+               VALUES (?, ?, ?, ?, ?, ?, COALESCE(?, 'default'))
                ON CONFLICT(name) DO UPDATE SET
                  handle=excluded.handle,
                  status=excluded.status,
                  last_use=excluded.last_use,
-                 workspace=excluded.workspace,
+                 workspace=COALESCE(?, clusters.workspace),
                  requested_resources=COALESCE(
                      excluded.requested_resources,
                      clusters.requested_resources)""" +
             (', launched_at=excluded.launched_at' if is_launch else ''),
             (cluster_name, now, pickle.dumps(cluster_handle),
-             str(now), status.value, requested, workspace))
+             str(now), status.value, requested, workspace, workspace))
         conn.commit()
 
 

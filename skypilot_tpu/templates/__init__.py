@@ -1,0 +1,1 @@
+"""Deploy-time templates and helper scripts (twin of sky/templates/)."""

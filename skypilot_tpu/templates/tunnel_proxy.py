@@ -1,0 +1,95 @@
+"""stdio ↔ API-server TCP tunnel, for `ssh` ProxyCommand use.
+
+Twin of the reference's sky/templates/websocket_proxy.py (`sky ssh` over
+the API server's websocket); rebuilt on plain HTTP CONNECT so neither
+side needs a websocket library. The API server (server/app.py) accepts
+CONNECT <host>:<port> from authenticated clients and splices bytes to
+the cluster host.
+
+Usage (as ssh ProxyCommand):
+
+    ssh -o ProxyCommand='python -m skypilot_tpu.templates.tunnel_proxy \
+        %h %p --server http://api-server:46580' user@<internal-ip>
+"""
+from __future__ import annotations
+
+import argparse
+import base64
+import os
+import select
+import socket
+import sys
+import urllib.parse
+
+
+def open_tunnel(server: str, host: str, port: int,
+                auth: str = ''):
+    """Returns (socket, leftover_bytes). leftover is any upstream data
+    (e.g. the sshd banner) that arrived coalesced with the 200 response
+    — the caller must forward it before pumping."""
+    parsed = urllib.parse.urlparse(server)
+    sock = socket.create_connection((parsed.hostname,
+                                     parsed.port or 46580), timeout=30)
+    headers = f'CONNECT {host}:{port} HTTP/1.1\r\nHost: {host}\r\n'
+    if auth:
+        token = base64.b64encode(auth.encode()).decode()
+        headers += f'Authorization: Basic {token}\r\n'
+    sock.sendall((headers + '\r\n').encode())
+    # Read the status line + headers.
+    buf = b''
+    while b'\r\n\r\n' not in buf:
+        chunk = sock.recv(4096)
+        if not chunk:
+            raise ConnectionError('tunnel closed during handshake')
+        buf += chunk
+    status = buf.split(b'\r\n', 1)[0].decode()
+    if ' 200' not in status:
+        raise ConnectionError(f'tunnel refused: {status}')
+    leftover = buf.split(b'\r\n\r\n', 1)[1]
+    return sock, leftover
+
+
+def pump_stdio(sock: socket.socket) -> None:
+    """Bidirectional copy stdio ↔ socket until either side closes."""
+    stdin_fd = sys.stdin.buffer.fileno()
+    stdout = sys.stdout.buffer
+    while True:
+        readable, _, _ = select.select([stdin_fd, sock], [], [])
+        if stdin_fd in readable:
+            data = os.read(stdin_fd, 65536)
+            if not data:
+                break
+            sock.sendall(data)
+        if sock in readable:
+            data = sock.recv(65536)
+            if not data:
+                break
+            stdout.write(data)
+            stdout.flush()
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('host')
+    parser.add_argument('port', type=int)
+    parser.add_argument('--server',
+                        default=os.environ.get('XSKY_API_SERVER',
+                                               'http://127.0.0.1:46580'))
+    parser.add_argument('--auth',
+                        default=os.environ.get('XSKY_AUTH', ''),
+                        help='user:password for Basic auth')
+    args = parser.parse_args()
+    sock, leftover = open_tunnel(args.server, args.host, args.port,
+                                 args.auth)
+    try:
+        if leftover:
+            sys.stdout.buffer.write(leftover)
+            sys.stdout.buffer.flush()
+        pump_stdio(sock)
+    finally:
+        sock.close()
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -304,6 +304,64 @@ class KubernetesCommandRunner(CommandRunner):
                            check=True, capture_output=True)
 
 
+class DockerCommandRunner(CommandRunner):
+    """docker-exec runner bound to one local container (dev backend)."""
+
+    def __init__(self, container: str) -> None:
+        super().__init__(container)
+        self.container = container
+
+    def _exec_base(self) -> List[str]:
+        return ['docker', 'exec', '-i', self.container]
+
+    def run(self, cmd, *, env=None, cwd=None, stream_logs=False,
+            log_path=None, require_outputs=False, timeout=None):
+        if isinstance(cmd, list):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        prefix = _make_env_prefix(env)
+        if cwd:
+            cmd = f'cd {shlex.quote(cwd)} && {cmd}'
+        full = self._exec_base() + ['bash', '-c', prefix + cmd]
+        proc = subprocess.run(full, capture_output=True, text=True,
+                              timeout=timeout, check=False)
+        return self._finish(proc, log_path, stream_logs, require_outputs)
+
+    def run_async(self, cmd, *, env=None, log_path=None, cwd=None):
+        prefix = _make_env_prefix(env)
+        if cwd:
+            cmd = f'cd {shlex.quote(cwd)} && {cmd}'
+        full = self._exec_base() + ['bash', '-c', prefix + cmd]
+        out = open(log_path, 'ab') if log_path else subprocess.DEVNULL
+        return subprocess.Popen(full, stdout=out, stderr=subprocess.STDOUT)
+
+    def rsync(self, source: str, target: str, *, up: bool, excludes=None):
+        import shutil
+        source = os.path.expanduser(source)
+        if up:
+            staged = source
+            stage_dir = None
+            if excludes and os.path.isdir(source):
+                stage_dir = tempfile.mkdtemp(prefix='xsky-dcp-')
+                _local_sync(source.rstrip('/') + '/', stage_dir, excludes)
+                staged = stage_dir
+            try:
+                self.run(f'mkdir -p {shlex.quote(target)}')
+                # '/.' source suffix: copy CONTENTS onto target even when
+                # it already exists (plain dir source would nest inside).
+                src = staged.rstrip('/') + '/.' if os.path.isdir(staged) \
+                    else staged
+                subprocess.run(['docker', 'cp', src,
+                                f'{self.container}:{target}'],
+                               check=True, capture_output=True)
+            finally:
+                if stage_dir is not None:
+                    shutil.rmtree(stage_dir, ignore_errors=True)
+        else:
+            subprocess.run(['docker', 'cp',
+                            f'{self.container}:{target}', source],
+                           check=True, capture_output=True)
+
+
 def runners_from_cluster_info(cluster_info, ssh_private_key: str,
                               use_local: bool = False,
                               internal_ips: bool = False
@@ -326,10 +384,14 @@ def runners_from_cluster_info(cluster_info, ssh_private_key: str,
                     info.instance_id,
                     namespace=cfg.get('namespace', 'default'),
                     context=cfg.get('context')))
+        elif cluster_info.provider_name == 'docker':
+            runners.append(DockerCommandRunner(info.instance_id))
         else:
             ip = info.internal_ip if internal_ips else \
                 info.get_feasible_ip()
+            # BYO SSH hosts carry their own identity file and user.
+            key = info.tags.get('identity_file', ssh_private_key)
+            user = info.tags.get('ssh_user', cluster_info.ssh_user)
             runners.append(
-                SSHCommandRunner(ip, cluster_info.ssh_user,
-                                 ssh_private_key, port=info.ssh_port))
+                SSHCommandRunner(ip, user, key, port=info.ssh_port))
     return runners

@@ -1,0 +1,226 @@
+"""SSH node pools, docker provisioner (mocked CLI), and the CONNECT
+tunnel through the live API server."""
+import json
+import socket
+import threading
+
+import pytest
+import yaml
+
+from skypilot_tpu.clouds import ssh as ssh_cloud
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.docker import instance as docker_instance
+from skypilot_tpu.provision.ssh import instance as ssh_instance
+from skypilot_tpu.utils import command_runner
+
+
+@pytest.fixture
+def ssh_pool(tmp_path, monkeypatch):
+    pools = {
+        'rack1': {
+            'user': 'ubuntu',
+            'identity_file': '~/.ssh/rack1_key',
+            'hosts': [{'ip': '10.0.0.1'}, {'ip': '10.0.0.2'},
+                      {'ip': '10.0.0.3', 'user': 'admin'}],
+        }
+    }
+    path = tmp_path / 'pools.yaml'
+    path.write_text(yaml.dump(pools))
+    monkeypatch.setenv('XSKY_SSH_NODE_POOLS', str(path))
+    monkeypatch.setenv('XSKY_SSH_ALLOCATIONS',
+                       str(tmp_path / 'alloc.json'))
+    return pools
+
+
+class TestSshPool:
+
+    def test_load_pools_defaults_and_overrides(self, ssh_pool):
+        pools = ssh_cloud.load_pools()
+        hosts = pools['rack1']['hosts']
+        assert hosts[0]['user'] == 'ubuntu'
+        assert hosts[2]['user'] == 'admin'
+        assert hosts[0]['identity_file'].endswith('.ssh/rack1_key')
+
+    def test_allocate_and_release(self, ssh_pool):
+        config = common.ProvisionConfig(provider_config={},
+                                        node_config={'pool': 'rack1'},
+                                        count=2)
+        record = ssh_instance.run_instances('rack1', None, 'c1', config)
+        assert record.created_instance_ids == ['10.0.0.1', '10.0.0.2']
+        # Second cluster gets the remaining host; a third is capacity-out.
+        config1 = common.ProvisionConfig(provider_config={},
+                                         node_config={'pool': 'rack1'},
+                                         count=1)
+        ssh_instance.run_instances('rack1', None, 'c2', config1)
+        from skypilot_tpu import exceptions
+        with pytest.raises(exceptions.CapacityError):
+            ssh_instance.run_instances('rack1', None, 'c3', config1)
+        ssh_instance.terminate_instances('c1', {})
+        record3 = ssh_instance.run_instances('rack1', None, 'c3', config1)
+        assert len(record3.created_instance_ids) == 1
+
+    def test_cluster_info_and_runners(self, ssh_pool):
+        config = common.ProvisionConfig(provider_config={},
+                                        node_config={'pool': 'rack1'},
+                                        count=2)
+        ssh_instance.run_instances('rack1', None, 'c1', config)
+        info = ssh_instance.get_cluster_info('rack1', 'c1', {})
+        assert len(info.instances) == 2
+        assert info.head_instance_id == '10.0.0.1'
+        runners = command_runner.runners_from_cluster_info(info, 'fallback')
+        assert all(isinstance(r, command_runner.SSHCommandRunner)
+                   for r in runners)
+        assert runners[0].ssh_private_key.endswith('rack1_key')
+
+    def test_cloud_feasibility(self, ssh_pool):
+        from skypilot_tpu import resources as resources_lib
+        cloud = ssh_cloud.SSH()
+        ok, _ = cloud.check_credentials()
+        assert ok
+        res = resources_lib.Resources(cloud='ssh')
+        candidates, _ = cloud.get_feasible_launchable_resources(res)
+        assert len(candidates) == 1
+        assert cloud.instance_type_to_hourly_cost('byo', False) == 0
+        regions = cloud.regions_with_offering('', None, False, None, None)
+        assert [r.name for r in regions] == ['rack1']
+
+
+class FakeDocker:
+    def __init__(self):
+        self.containers = {}
+
+    def __call__(self, args, input_data=None, timeout=120.0):
+        verb = args[0]
+        if verb == 'run':
+            name = args[args.index('--name') + 1]
+            labels = dict(a.split('=', 1) for a in args
+                          if '=' in a and not a.startswith('-'))
+            self.containers[name] = {
+                'Names': name, 'Status': 'Up 1 second',
+                'labels': labels,
+            }
+            return ''
+        if verb == 'ps':
+            flt = [a for a in args if a.startswith('label=')]
+            key, value = flt[0][len('label='):].split('=')
+            return '\n'.join(
+                json.dumps(c) for c in self.containers.values()
+                if c['labels'].get(key) == value)
+        if verb == 'inspect':
+            c = self.containers[args[1]]
+            return json.dumps([{
+                'NetworkSettings': {'IPAddress': '172.17.0.5'},
+                'Config': {'Labels': c['labels']},
+                'State': {'Running': c['Status'].startswith('Up')},
+            }])
+        if verb == 'stop':
+            self.containers[args[1]]['Status'] = 'Exited'
+            return ''
+        if verb == 'start':
+            self.containers[args[1]]['Status'] = 'Up 1 second'
+            return ''
+        if verb == 'rm':
+            self.containers.pop(args[-1], None)
+            return ''
+        raise AssertionError(f'FakeDocker: unhandled {args}')
+
+
+@pytest.fixture
+def fake_docker(monkeypatch):
+    fake = FakeDocker()
+    monkeypatch.setattr(docker_instance, '_run_docker', fake)
+    return fake
+
+
+class TestDockerProvisioner:
+
+    def test_lifecycle(self, fake_docker):
+        config = common.ProvisionConfig(provider_config={},
+                                        node_config={}, count=2)
+        record = docker_instance.run_instances('local', None, 'dev',
+                                               config)
+        assert len(record.created_instance_ids) == 2
+        statuses = docker_instance.query_instances('dev', {})
+        assert set(statuses.values()) == {'RUNNING'}
+        info = docker_instance.get_cluster_info('local', 'dev', {})
+        assert info.head_instance_id == 'xsky-dev-0'
+        assert info.instances['xsky-dev-0'].internal_ip == '172.17.0.5'
+        runners = command_runner.runners_from_cluster_info(info, 'k')
+        assert all(isinstance(r, command_runner.DockerCommandRunner)
+                   for r in runners)
+        docker_instance.stop_instances('dev', {})
+        assert set(docker_instance.query_instances('dev', {}).values()) \
+            == {'STOPPED'}
+        docker_instance.run_instances('local', None, 'dev', config)
+        assert set(docker_instance.query_instances('dev', {}).values()) \
+            == {'RUNNING'}
+        docker_instance.terminate_instances('dev', {})
+        assert docker_instance.query_instances('dev', {}) == {}
+
+
+class TestConnectTunnel:
+
+    def test_tunnel_roundtrip(self, tmp_path, monkeypatch):
+        """CONNECT through the live API server to a local echo server."""
+        from skypilot_tpu import state
+        from skypilot_tpu.server import app as server_app
+        from skypilot_tpu.server import requests_db
+        from skypilot_tpu.templates import tunnel_proxy
+        monkeypatch.setenv('XSKY_STATE_DB', str(tmp_path / 's.db'))
+        monkeypatch.setenv('XSKY_SERVER_DB', str(tmp_path / 'r.db'))
+        monkeypatch.delenv('XSKY_REQUIRE_AUTH', raising=False)
+        monkeypatch.setenv('XSKY_TUNNEL_ALLOW_ANY', '1')
+        state.reset_for_test()
+        requests_db.reset_for_test()
+
+        # Echo server standing in for a cluster host's sshd.
+        echo = socket.socket()
+        echo.bind(('127.0.0.1', 0))
+        echo.listen(1)
+        echo_port = echo.getsockname()[1]
+
+        def echo_loop():
+            conn, _ = echo.accept()
+            while True:
+                data = conn.recv(4096)
+                if not data:
+                    break
+                conn.sendall(data.upper())
+            conn.close()
+
+        threading.Thread(target=echo_loop, daemon=True).start()
+        server, port = server_app.run_in_thread()
+        try:
+            sock, leftover = tunnel_proxy.open_tunnel(
+                f'http://127.0.0.1:{port}', '127.0.0.1', echo_port)
+            assert leftover == b''
+            sock.sendall(b'hello tunnel')
+            out = sock.recv(4096)
+            assert out == b'HELLO TUNNEL'
+            sock.close()
+        finally:
+            server.shutdown()
+            echo.close()
+            state.reset_for_test()
+            requests_db.reset_for_test()
+
+
+    def test_tunnel_rejects_non_cluster_host(self, tmp_path, monkeypatch):
+        from skypilot_tpu import state
+        from skypilot_tpu.server import app as server_app
+        from skypilot_tpu.server import requests_db
+        from skypilot_tpu.templates import tunnel_proxy
+        monkeypatch.setenv('XSKY_STATE_DB', str(tmp_path / 's.db'))
+        monkeypatch.setenv('XSKY_SERVER_DB', str(tmp_path / 'r.db'))
+        monkeypatch.delenv('XSKY_TUNNEL_ALLOW_ANY', raising=False)
+        state.reset_for_test()
+        requests_db.reset_for_test()
+        server, port = server_app.run_in_thread()
+        try:
+            with pytest.raises(ConnectionError, match='refused'):
+                tunnel_proxy.open_tunnel(f'http://127.0.0.1:{port}',
+                                         '169.254.169.254', 80)
+        finally:
+            server.shutdown()
+            state.reset_for_test()
+            requests_db.reset_for_test()
