@@ -1,0 +1,9 @@
+"""Web dashboard (minimal static twin of sky/dashboard's Next.js app)."""
+import os
+
+STATIC_DIR = os.path.dirname(__file__)
+
+
+def index_html() -> bytes:
+    with open(os.path.join(STATIC_DIR, 'index.html'), 'rb') as f:
+        return f.read()

@@ -1,0 +1,47 @@
+"""jax.distributed bring-up from gang-launcher env.
+
+The gang launcher (agent/gang.py) exports XSKY_HOST_RANK /
+XSKY_NUM_HOSTS / XSKY_COORDINATOR_ADDRESS on every TPU host — the role
+torchrun env plays in the reference's recipes
+(sky/backends/cloud_vm_ray_backend.py:606-670). This module turns those
+into `jax.distributed.initialize` arguments; libtpu then discovers the
+ICI torus itself, and megascale env (set by the launcher for multislice)
+routes cross-slice collectives over DCN.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+def is_multihost() -> bool:
+    return int(os.environ.get('XSKY_NUM_HOSTS', '1')) > 1
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Initialize jax.distributed from env (no-op single-host)."""
+    import jax
+    coordinator_address = coordinator_address or os.environ.get(
+        'XSKY_COORDINATOR_ADDRESS')
+    num_processes = num_processes if num_processes is not None else int(
+        os.environ.get('XSKY_NUM_HOSTS', '1'))
+    process_id = process_id if process_id is not None else int(
+        os.environ.get('XSKY_HOST_RANK', '0'))
+    if num_processes <= 1 or not coordinator_address:
+        logger.debug('Single-host run; skipping jax.distributed.')
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    logger.info(
+        f'jax.distributed up: process {process_id}/{num_processes} '
+        f'(coordinator {coordinator_address}); '
+        f'{jax.local_device_count()} local / {jax.device_count()} global '
+        'devices.')

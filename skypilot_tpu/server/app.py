@@ -92,6 +92,14 @@ class _Handler(BaseHTTPRequestHandler):
         if parsed.path == '/health':
             self._send(200, {'status': 'healthy',
                              'api_version': API_VERSION})
+        elif parsed.path in ('/', '/dashboard', '/dashboard/'):
+            from skypilot_tpu import dashboard
+            data = dashboard.index_html()
+            self.send_response(200)
+            self.send_header('Content-Type', 'text/html; charset=utf-8')
+            self.send_header('Content-Length', str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
         elif parsed.path == '/api/get':
             if not self._authenticated():
                 self._send(401, {'error': 'authentication required'})

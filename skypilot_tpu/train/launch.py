@@ -1,0 +1,141 @@
+"""Training entrypoint for task `run:` sections.
+
+    python -m skypilot_tpu.train.launch \
+        --model llama3-8b --mesh data=1,fsdp=-1,tensor=4 \
+        --global-batch-size 64 --seq-len 8192 --steps 5000 \
+        --checkpoint-dir /ckpt --resume auto
+
+Brings up jax.distributed from gang-launcher env, builds the sharded
+trainer over the requested MeshPlan, checkpoints via orbax so preemption
+recovery (`xsky jobs launch`) resumes from the bucket mount, and prints
+throughput in BASELINE terms.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import time
+
+from skypilot_tpu import models
+from skypilot_tpu import sky_logging
+from skypilot_tpu.parallel import distributed
+from skypilot_tpu.parallel import mesh as mesh_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+def parse_mesh(spec: str) -> mesh_lib.MeshPlan:
+    """'data=2,fsdp=-1,tensor=4' → MeshPlan."""
+    kwargs = {}
+    for part in (spec or '').split(','):
+        if not part:
+            continue
+        key, _, value = part.partition('=')
+        key = key.strip()
+        if key not in mesh_lib.MESH_AXES:
+            raise ValueError(f'Unknown mesh axis {key!r}; expected one of '
+                             f'{mesh_lib.MESH_AXES}')
+        kwargs[key] = int(value)
+    return mesh_lib.MeshPlan(**kwargs)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='llama3-8b')
+    parser.add_argument('--mesh', default='data=-1')
+    parser.add_argument('--attention', default=None,
+                        choices=[None, 'auto', 'ring', 'ulysses', 'flash'])
+    parser.add_argument('--num-slices', type=int, default=1)
+    parser.add_argument('--global-batch-size', type=int, default=8)
+    parser.add_argument('--seq-len', type=int, default=2048)
+    parser.add_argument('--steps', type=int, default=100)
+    parser.add_argument('--n-microbatches', type=int, default=4)
+    parser.add_argument('--optimizer', default='adamw')
+    parser.add_argument('--learning-rate', type=float, default=3e-4)
+    parser.add_argument('--checkpoint-dir', default=None)
+    parser.add_argument('--checkpoint-every', type=int, default=500)
+    parser.add_argument('--resume', default='none',
+                        choices=['none', 'auto'])
+    parser.add_argument('--log-every', type=int, default=10)
+    args = parser.parse_args()
+
+    distributed.initialize()
+    import jax  # after distributed init
+
+    from skypilot_tpu.train import trainer as trainer_lib
+
+    model = models.get_config(args.model)
+    model = dataclasses.replace(model, max_seq_len=max(
+        model.max_seq_len, args.seq_len))
+    if args.attention:
+        model = dataclasses.replace(model, attention_impl=args.attention)
+    plan = parse_mesh(args.mesh)
+    config = trainer_lib.TrainConfig(
+        model=model,
+        mesh_plan=plan,
+        global_batch_size=args.global_batch_size,
+        seq_len=args.seq_len,
+        optimizer=args.optimizer,
+        learning_rate=args.learning_rate,
+        n_microbatches=args.n_microbatches,
+    )
+    mesh = mesh_lib.build_mesh(
+        plan.resolve(jax.device_count()), num_slices=args.num_slices)
+    trainer = trainer_lib.Trainer(config, mesh=mesh)
+
+    manager = None
+    start_step = 0
+    state = None
+    if args.checkpoint_dir:
+        import orbax.checkpoint as ocp
+        manager = ocp.CheckpointManager(
+            args.checkpoint_dir,
+            options=ocp.CheckpointManagerOptions(max_to_keep=3))
+        if args.resume == 'auto' and manager.latest_step() is not None:
+            start_step = manager.latest_step()
+            # eval_shape gives shapes/dtypes; attach the trainer's
+            # shardings so orbax restores directly onto the mesh.
+            abstract = jax.tree.map(
+                lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                                   sharding=sh),
+                jax.eval_shape(trainer.init_state),
+                trainer.state_shardings())
+            state = manager.restore(
+                start_step, args=ocp.args.StandardRestore(abstract))
+            logger.info(f'Resumed from checkpoint step {start_step}.')
+    if state is None:
+        state = trainer.init_state()
+
+    tokens_per_step = args.global_batch_size * args.seq_len
+    flops_per_token = dataclasses.replace(
+        model, max_seq_len=args.seq_len).train_flops_per_token()
+    t0 = time.perf_counter()
+    window_t0, window_steps = t0, 0
+    for step in range(start_step, args.steps):
+        batch = trainer.synthetic_batch(step)
+        state, metrics = trainer.step(state, batch)
+        window_steps += 1
+        if (step + 1) % args.log_every == 0:
+            loss = float(metrics['loss'])  # forces device sync
+            dt = time.perf_counter() - window_t0
+            tps = window_steps * tokens_per_step / dt
+            tflops = tps * flops_per_token / jax.device_count() / 1e12
+            logger.info(
+                f'step {step + 1}/{args.steps} loss={loss:.4f} '
+                f'{tps:,.0f} tok/s '
+                f'({tflops:.1f} model-TFLOP/s/chip)')
+            window_t0, window_steps = time.perf_counter(), 0
+        if manager is not None and (step + 1) % args.checkpoint_every == 0:
+            import orbax.checkpoint as ocp
+            manager.save(step + 1, args=ocp.args.StandardSave(state))
+    if manager is not None:
+        import orbax.checkpoint as ocp
+        manager.save(args.steps, args=ocp.args.StandardSave(state))
+        manager.wait_until_finished()
+    total = time.perf_counter() - t0
+    logger.info(f'Done: {args.steps - start_step} steps in {total:.1f}s.')
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())
