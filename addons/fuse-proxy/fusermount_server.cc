@@ -234,9 +234,12 @@ void HandleConnection(int conn) {
       resp.fd = fd;
     }
   } else if (req.mode == fp::kModeMount) {
-    // Wrapper mode: args = [mountpoint, options].
+    // Wrapper mode: args = [mountpoint, options]. Options go through the
+    // same allow-list as shim '-o' — an unvalidated string here would let
+    // any container on the shared socket mount with suid/dev.
     if (req.args.size() != 2 ||
-        !ValidateShimArgs({req.args[0]}, &err)) {
+        !ValidateShimArgs({req.args[0]}, &err) ||
+        !ValidateMountOptions(req.args[1], &err)) {
       resp.code = 1;
       resp.message = "rejected: " + (err.empty() ? "bad args" : err);
     } else {

@@ -143,9 +143,11 @@ class RetryingProvisioner:
         node_config = cloud.make_deploy_resources_variables(
             resources, self._cluster_name, region, zone)
         # Zonal clouds (GCP) need the chosen placement for later lifecycle
-        # ops (stop/terminate/query read zone from provider_config).
+        # ops (stop/terminate/query read zone from provider_config); other
+        # clouds contribute their own keys (k8s: context/namespace).
         provider_config = dict(self._provider_config)
         provider_config.update({'region': region, 'zone': zone})
+        provider_config.update(cloud.provider_config_overrides(node_config))
         config = provision_common.ProvisionConfig(
             provider_config=provider_config,
             node_config=node_config,
@@ -159,7 +161,8 @@ class RetryingProvisioner:
             record = provision_lib.run_instances(provider, region, zone,
                                                  self._cluster_name, config)
             provision_lib.wait_instances(provider, region,
-                                         self._cluster_name, 'RUNNING')
+                                         self._cluster_name, 'RUNNING',
+                                         provider_config=provider_config)
             info = provision_lib.get_cluster_info(provider, record.region,
                                                   self._cluster_name,
                                                   config.provider_config)

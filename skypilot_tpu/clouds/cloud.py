@@ -149,6 +149,16 @@ class Cloud:
         """Module name under skypilot_tpu.provision implementing the op-set."""
         return self.name
 
+    def provider_config_overrides(
+            self, node_config: Dict[str, Any]) -> Dict[str, Any]:
+        """Keys the provisioner needs in provider_config for *every*
+        lifecycle op (wait/query/terminate/get_cluster_info), not just
+        run_instances — e.g. the kubectl context/namespace. Merged into
+        provider_config by the failover engine so the stored handle and
+        all later ops agree with what run_instances used."""
+        del node_config
+        return {}
+
     # ---- credentials ----
 
     def check_credentials(self) -> Tuple[bool, Optional[str]]:

@@ -199,6 +199,16 @@ class Kubernetes(cloud_lib.Cloud):
                 vars.update({'gpu_type': name, 'gpu_count': count})
         return vars
 
+    def provider_config_overrides(
+            self, node_config: Dict[str, Any]) -> Dict[str, Any]:
+        # Contexts are this cloud's "regions": lifecycle ops must target
+        # the same kubectl context/namespace run_instances used, or
+        # wait/terminate look at the wrong cluster entirely.
+        return {
+            'context': node_config.get('context'),
+            'namespace': node_config.get('namespace', 'default'),
+        }
+
     # ---- credentials ----
 
     def check_credentials(self) -> Tuple[bool, Optional[str]]:

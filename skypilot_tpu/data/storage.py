@@ -261,27 +261,31 @@ class AzureBlobStore(AbstractStore):
         return self.name.partition('/')[2]
 
     def _acct(self) -> str:
-        return (f' --account-name {self.account}' if self.account else '')
+        return (f' --account-name {shlex.quote(self.account)}'
+                if self.account else '')
 
     def exists(self) -> bool:
         return subprocess.run(
-            f'az storage container exists --name {self.container}'
+            f'az storage container exists --name {shlex.quote(self.container)}'
             f'{self._acct()} --query exists -o tsv | grep -q true',
             shell=True, capture_output=True).returncode == 0
 
     def create(self) -> None:
-        _run(f'az storage container create --name {self.container}'
+        _run(f'az storage container create '
+             f'--name {shlex.quote(self.container)}'
              f'{self._acct()}')
 
     def upload(self) -> None:
         src = shlex.quote(os.path.expanduser(self.source or '.'))
         dest = (f' --destination-path {shlex.quote(self.sub_path)}'
                 if self.sub_path else '')
-        _run(f'az storage blob upload-batch -d {self.container} -s {src}'
+        _run(f'az storage blob upload-batch '
+             f'-d {shlex.quote(self.container)} -s {src}'
              f'{dest}{self._acct()}')
 
     def delete(self) -> None:
-        _run(f'az storage container delete --name {self.container}'
+        _run(f'az storage container delete '
+             f'--name {shlex.quote(self.container)}'
              f'{self._acct()}')
 
     def mount_command(self, mount_path: str) -> str:
@@ -293,7 +297,8 @@ class AzureBlobStore(AbstractStore):
         pattern = (f' --pattern {shlex.quote(self.sub_path + "/*")}'
                    if self.sub_path else '')
         return (f'mkdir -p {q} && az storage blob download-batch '
-                f'-s {self.container} -d {q}{pattern}{self._acct()}')
+                f'-s {shlex.quote(self.container)} -d {q}'
+                f'{pattern}{self._acct()}')
 
 
 class _S3CompatibleStore(S3Store):

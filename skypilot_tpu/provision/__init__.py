@@ -43,8 +43,10 @@ def query_instances(provider_name: str, cluster_name: str,
 
 
 def wait_instances(provider_name: str, region: str, cluster_name: str,
-                   state: str) -> None:
-    _impl(provider_name).wait_instances(region, cluster_name, state)
+                   state: str,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    _impl(provider_name).wait_instances(region, cluster_name, state,
+                                        provider_config=provider_config)
 
 
 def get_cluster_info(provider_name: str, region: str,

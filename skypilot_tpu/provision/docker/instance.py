@@ -101,8 +101,9 @@ def terminate_instances(cluster_name: str,
         _run_docker(['rm', '-f', name])
 
 
-def wait_instances(region: str, cluster_name: str, state: str) -> None:
-    del region, cluster_name, state  # docker run/stop are synchronous
+def wait_instances(region: str, cluster_name: str, state: str,
+                   provider_config=None) -> None:
+    del region, cluster_name, state, provider_config  # docker ops are synchronous
 
 
 def get_cluster_info(region: str, cluster_name: str,

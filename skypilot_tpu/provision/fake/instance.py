@@ -210,7 +210,8 @@ def query_instances(cluster_name: str, provider_config: Dict[str, Any]
             for iid, info in cluster['instances'].items()}
 
 
-def wait_instances(region: str, cluster_name: str, state: str) -> None:
+def wait_instances(region: str, cluster_name: str, state: str,
+                   provider_config=None) -> None:
     return  # fake instances transition instantly
 
 

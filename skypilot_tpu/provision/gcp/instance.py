@@ -307,9 +307,10 @@ def query_instances(cluster_name: str, provider_config: Dict[str, Any]
     return out
 
 
-def wait_instances(region: str, cluster_name: str, state: str) -> None:
+def wait_instances(region: str, cluster_name: str, state: str,
+                   provider_config=None) -> None:
     # run_instances already waits on creation operations; nothing to poll.
-    del region, cluster_name, state
+    del region, cluster_name, state, provider_config
 
 
 def get_cluster_info(region: str, cluster_name: str,

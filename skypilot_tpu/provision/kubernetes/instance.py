@@ -247,9 +247,12 @@ def terminate_instances(cluster_name: str,
 def wait_instances(region: str, cluster_name: str, state: str,
                    provider_config: Optional[Dict[str, Any]] = None,
                    timeout: float = _WAIT_TIMEOUT_S) -> None:
-    del region
     provider_config = provider_config or {}
-    context = provider_config.get('context')
+    # Contexts are this cloud's regions: fall back to the region argument
+    # so a caller that lost provider_config still targets the right
+    # cluster ('in-cluster' means "use the ambient service account").
+    context = provider_config.get('context') or (
+        None if region in (None, '', 'in-cluster') else region)
     namespace = provider_config.get('namespace', 'default')
     deadline = time.time() + timeout
     while True:

@@ -56,6 +56,7 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
         taken = {ip for cl, info in alloc.items()
                  if cl != cluster_name for ip in info.get('ips', [])}
         mine = alloc.get(cluster_name, {}).get('ips', [])
+        n_held = len(mine)
         free = [h for h in hosts
                 if h['ip'] not in taken and h['ip'] not in mine]
         n_free = len(free)
@@ -64,8 +65,8 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
         if len(mine) < need:
             raise exceptions.CapacityError(
                 f'SSH pool {pool_name!r}: need {need} host(s) but only '
-                f'{n_free} free (+{len(mine) - n_free if mine else 0} '
-                f'already held by {cluster_name!r}).')
+                f'{n_free} free (+{n_held} already held by '
+                f'{cluster_name!r}).')
         alloc[cluster_name] = {'pool': pool_name, 'ips': mine[:need]}
     return common.ProvisionRecord(
         provider_name='ssh',
@@ -100,7 +101,8 @@ def terminate_instances(cluster_name: str,
         alloc.pop(cluster_name, None)
 
 
-def wait_instances(region: str, cluster_name: str, state: str) -> None:
+def wait_instances(region: str, cluster_name: str, state: str,
+                   provider_config=None) -> None:
     pass  # hosts are always "up"; reachability is checked by SSH wait
 
 

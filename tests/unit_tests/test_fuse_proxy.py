@@ -168,6 +168,22 @@ def test_wrapper_premounts_and_execs(proxy_env, tmp_path):
     assert '-o rw /tmp/mnt3' in proxy_env['log'].read_text()
 
 
+def test_wrapper_rejects_dangerous_mount_options(proxy_env, tmp_path):
+    """Wrapper (kModeMount) options must pass the same allow-list as shim
+    '-o' — previously only the shim path was validated."""
+    env = proxy_env['env']
+    wrapper = proxy_env['binaries']['wrapper']
+    out = tmp_path / 'wrapper_bad.txt'
+    for opts in ('suid', 'dev', 'rw,suid', 'fsname=a,dev'):
+        proc = subprocess.run(
+            [wrapper, '/tmp/mnt4', '-o', opts, '--', '/bin/sh', '-c',
+             f'echo ran > {out}'],
+            env=env, capture_output=True, text=True, timeout=30)
+        assert proc.returncode != 0, opts
+        assert not out.exists(), opts
+    assert 'suid' not in proxy_env['log'].read_text()
+
+
 def test_shim_rejects_trailing_dotdot(proxy_env):
     env, log = proxy_env['env'], proxy_env['log']
     shim = proxy_env['binaries']['shim']

@@ -20,10 +20,12 @@ class FakeKubectl:
     def __init__(self):
         self.pods = {}       # name -> manifest (with injected status)
         self.services = {}
+        self.calls = []      # (verb, context, namespace)
 
     def __call__(self, args, context=None, namespace=None, input_data=None,
                  timeout=60.0):
         verb = args[0]
+        self.calls.append((verb, context, namespace))
         if verb == 'apply':
             items = json.loads(input_data)
             if items.get('kind') == 'List':
@@ -220,6 +222,49 @@ class TestKubernetesCommandRunner:
         assert runners[0].pod_name == 'mycluster-0'
         assert runners[0].namespace == 'ns2'
         assert runners[0].context == 'ctx2'
+
+
+def test_lifecycle_ops_agree_on_context_and_namespace(fake_kubectl):
+    """Every lifecycle op must target the context/namespace that
+    run_instances used — contexts are this cloud's regions, so a
+    mismatch silently operates on the wrong cluster."""
+    from skypilot_tpu import resources as resources_lib
+    cloud = k8s_cloud.Kubernetes()
+    res = resources_lib.Resources(
+        cloud='kubernetes', instance_type='2CPU--8GB',
+        labels={'kubernetes/namespace': 'ns-a'})
+    node_config = cloud.make_deploy_resources_variables(
+        res, 'ctxtest', 'gke-prod', None)
+    # The cloud exposes the keys the failover engine merges into
+    # provider_config for all later lifecycle ops.
+    overrides = cloud.provider_config_overrides(node_config)
+    assert overrides == {'context': 'gke-prod', 'namespace': 'ns-a'}
+    provider_config = {'region': 'gke-prod', 'zone': None, **overrides}
+    config = common.ProvisionConfig(provider_config=provider_config,
+                                    node_config=node_config, count=1)
+    k8s_instance.run_instances('gke-prod', None, 'ctxtest', config)
+    k8s_instance.wait_instances('gke-prod', 'ctxtest', 'RUNNING',
+                                provider_config=provider_config)
+    k8s_instance.query_instances('ctxtest', provider_config)
+    k8s_instance.get_cluster_info('gke-prod', 'ctxtest', provider_config)
+    k8s_instance.terminate_instances('ctxtest', provider_config)
+    assert fake_kubectl.calls, 'no kubectl calls recorded'
+    for verb, context, namespace in fake_kubectl.calls:
+        assert context == 'gke-prod', (verb, context)
+        assert namespace == 'ns-a', (verb, namespace)
+
+
+def test_wait_instances_derives_context_from_region(fake_kubectl):
+    """A caller that lost provider_config still targets the right
+    cluster: region doubles as the kubectl context."""
+    config = _tpu_config()
+    k8s_instance.run_instances('in-cluster', None, 'mycluster', config)
+    fake_kubectl.calls.clear()
+    k8s_instance.wait_instances('gke-other', 'mycluster', 'RUNNING')
+    assert fake_kubectl.calls[0][1] == 'gke-other'
+    fake_kubectl.calls.clear()
+    k8s_instance.wait_instances('in-cluster', 'mycluster', 'RUNNING')
+    assert fake_kubectl.calls[0][1] is None
 
 
 def test_multislice_per_slice_host_index(fake_kubectl):
