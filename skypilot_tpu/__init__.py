@@ -13,7 +13,7 @@ from skypilot_tpu.optimizer import Optimizer, OptimizeTarget
 from skypilot_tpu.resources import Resources
 from skypilot_tpu.task import Task
 
-__version__ = '0.1.0'
+from skypilot_tpu.version import __version__
 
 __all__ = [
     'Dag',

@@ -26,6 +26,7 @@ from skypilot_tpu import state
 from skypilot_tpu.agent import job_lib
 from skypilot_tpu.backends import backend as backend_lib
 from skypilot_tpu.backends import failover
+from skypilot_tpu.backends import wheel_utils
 from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu.utils import command_runner as runner_lib
 from skypilot_tpu.utils import registry
@@ -125,19 +126,64 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                                     is_launch=False, workspace=workspace)
         return handle
 
+    @staticmethod
+    def _bootstrap_local_enabled() -> bool:
+        """Local/fake hosts normally run straight off the repo checkout
+        (fast tests); setting XSKY_BOOTSTRAP_LOCAL=1 makes them go through
+        the full wheel-install path like real hosts do."""
+        return os.environ.get('XSKY_BOOTSTRAP_LOCAL', '0') == '1'
+
+    def _bootstraps(self, handle: ClusterHandle) -> bool:
+        return (not handle.is_local_provider or
+                self._bootstrap_local_enabled())
+
+    def _host_runtime_root(self, handle: ClusterHandle,
+                           runner: runner_lib.CommandRunner) -> str:
+        if handle.is_local_provider:
+            return os.path.join(runner.host_root, '.xsky')
+        if handle.provider_name in ('kubernetes', 'docker'):
+            return '/root/.xsky'  # pods/containers run as root
+        return '~/.xsky'
+
+    def _head_python(self, handle: ClusterHandle) -> str:
+        """Python invocation for agent/job commands on the head host.
+
+        Resolved remotely at run time: clusters launched before the
+        bootstrap era have no venv yet, so fall back to the host python
+        rather than failing every status/logs/cancel against them.
+        """
+        if not self._bootstraps(handle):
+            return 'python'  # repo on PYTHONPATH (see _agent_env)
+        root = self._host_runtime_root(handle, handle.head_runner())
+        venv_py = f'{root}/venv/bin/python'
+        return f'$([ -x {venv_py} ] && echo {venv_py} || echo python)'
+
     def _agent_env(self, handle: ClusterHandle) -> Dict[str, str]:
         env = {'XSKY_CLUSTER_ROOT': handle.head_runtime_root}
-        if handle.is_local_provider:
+        if handle.is_local_provider and not self._bootstraps(handle):
             env['PYTHONPATH'] = _REPO_ROOT
         return env
 
     def _setup_runtime(self, handle: ClusterHandle) -> None:
-        """Ship cluster_info.json to the head; start the agent daemon.
+        """Install the runtime on every host; start the head agent daemon.
 
         (Twin of post_provision_runtime_setup,
-        sky/provision/provisioner.py:671 — minus Ray cluster start.)
+        sky/provision/provisioner.py:671 — minus Ray cluster start. The
+        wheel ship+install matches internal_file_mounts + runtime setup,
+        sky/provision/instance_setup.py:540.)
         """
-        head = handle.head_runner()
+        runners = handle.get_command_runners()
+        if self._bootstraps(handle):
+            wheel_path, content_hash = wheel_utils.build_wheel()
+            for rank, runner in enumerate(runners):
+                try:
+                    self._bootstrap_host(handle, runner, wheel_path,
+                                         content_hash)
+                except exceptions.ClusterSetUpError as e:
+                    raise exceptions.ClusterSetUpError(
+                        f'Runtime bootstrap failed on host {rank}: '
+                        f'{e}') from e
+        head = runners[0]
         root = handle.head_runtime_root
         info_json = json.dumps(handle.cluster_info.to_json())
         payload = base64.b64encode(info_json.encode()).decode()
@@ -150,9 +196,60 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                 f'Failed to initialize cluster runtime: {stderr}')
         if not handle.is_local_provider:
             head.run_async(
-                'python -m skypilot_tpu.agent.daemon',
+                f'{self._head_python(handle)} -m skypilot_tpu.agent.daemon',
                 env=self._agent_env(handle),
                 log_path=None)
+
+    def _bootstrap_host(self, handle: ClusterHandle,
+                        runner: runner_lib.CommandRunner,
+                        wheel_path, content_hash: str) -> None:
+        """Ship the wheel and install it into {root}/venv on one host.
+
+        Fully offline: venv + `pip install --no-index` of a dependency-free
+        wheel; third-party deps (jax, yaml, ...) come from the host image
+        via --system-site-packages plus a .pth pointing at the *invoking*
+        python's site dir (needed when python3 is itself a venv, as on dev
+        images — --system-site-packages alone would skip its packages).
+        Idempotent: skips the install when {root}/wheel_hash matches.
+        """
+        root = self._host_runtime_root(handle, runner)
+        wheel_name = os.path.basename(str(wheel_path))
+        wheel_dst = f'{root}/wheels/{content_hash}'
+        rc, _, err = runner.run(f'mkdir -p {wheel_dst}',
+                                require_outputs=True)
+        if rc != 0:
+            raise exceptions.ClusterSetUpError(
+                f'mkdir {wheel_dst} failed: {err}')
+        if handle.is_local_provider:
+            rsync_target = f'.xsky/wheels/{content_hash}/{wheel_name}'
+        elif handle.provider_name in ('kubernetes', 'docker'):
+            rsync_target = f'{wheel_dst}/{wheel_name}'
+        else:
+            # SSH: path relative to the remote home.
+            rsync_target = f'.xsky/wheels/{content_hash}/{wheel_name}'
+        runner.rsync(str(wheel_path), rsync_target, up=True)
+        venv_py = f'{root}/venv/bin/python'
+        script = (
+            f'set -e; '
+            f'if [ ! -x {venv_py} ]; then '
+            f'python3 -m venv --system-site-packages {root}/venv; fi; '
+            # .pth written unconditionally: a failure after venv creation
+            # must be repairable by re-running this (idempotent) script.
+            f'SITE=$({venv_py} -c "import sysconfig; '
+            f'print(sysconfig.get_paths()[\'purelib\'])"); '
+            f'python3 -c "import site; '
+            f'print(chr(10).join(site.getsitepackages()))" '
+            f'> "$SITE/_xsky_parent.pth"; '
+            f'if [ "$(cat {root}/wheel_hash 2>/dev/null)" '
+            f'!= "{content_hash}" ]; then '
+            f'{venv_py} -m pip install --quiet --no-deps --no-index '
+            f'--force-reinstall {wheel_dst}/{wheel_name}; '
+            f'echo {content_hash} > {root}/wheel_hash; fi; '
+            f'{venv_py} -c "import skypilot_tpu"')
+        rc, out, err = runner.run(script, require_outputs=True)
+        if rc != 0:
+            raise exceptions.ClusterSetUpError(
+                f'wheel install failed (rc={rc}): {err or out}')
 
     # ---- sync ----
 
@@ -239,14 +336,15 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
         spec_b64 = base64.b64encode(json.dumps(spec).encode()).decode()
         user = getpass.getuser()
         rc, out, err = head.run(
-            f'python -m skypilot_tpu.agent.job_cli add '
-            f'{shlex.quote(name or "-")} {user} {spec_b64}',
+            f'{self._head_python(handle)} -m skypilot_tpu.agent.job_cli '
+            f'add {shlex.quote(name or "-")} {user} {spec_b64}',
             env=env, require_outputs=True)
         if rc != 0:
             raise exceptions.CommandError(rc, 'job_cli add', err)
         job_id = int(out.strip().splitlines()[-1])
         rc, out, err = head.run(
-            f'python -m skypilot_tpu.agent.job_cli run-detached {job_id}',
+            f'{self._head_python(handle)} -m skypilot_tpu.agent.job_cli '
+            f'run-detached {job_id}',
             env=env, require_outputs=True)
         if rc != 0:
             raise exceptions.CommandError(rc, 'job_cli run-detached', err)
@@ -273,7 +371,8 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                        job_id: int) -> Optional[job_lib.JobStatus]:
         head = handle.head_runner()
         rc, out, _ = head.run(
-            f'python -m skypilot_tpu.agent.job_cli status {job_id}',
+            f'{self._head_python(handle)} -m skypilot_tpu.agent.job_cli '
+            f'status {job_id}',
             env=self._agent_env(handle), require_outputs=True)
         if rc != 0:
             return None
@@ -285,7 +384,8 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
     def get_job_queue(self, handle: ClusterHandle) -> List[Dict[str, Any]]:
         head = handle.head_runner()
         rc, out, err = head.run(
-            'python -m skypilot_tpu.agent.job_cli queue',
+            f'{self._head_python(handle)} -m skypilot_tpu.agent.job_cli '
+            f'queue',
             env=self._agent_env(handle), require_outputs=True)
         if rc != 0:
             raise exceptions.CommandError(rc, 'job_cli queue', err)
@@ -294,7 +394,8 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
     def cancel_jobs(self, handle: ClusterHandle, job_ids) -> None:
         head = handle.head_runner()
         for job_id in job_ids:
-            head.run(f'python -m skypilot_tpu.agent.job_cli cancel '
+            head.run(f'{self._head_python(handle)} -m '
+                     f'skypilot_tpu.agent.job_cli cancel '
                      f'{job_id}', env=self._agent_env(handle))
 
     def tail_logs(self, handle: ClusterHandle, job_id: Optional[int],
@@ -306,7 +407,8 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             job_id = jobs[0]['job_id']
         head = handle.head_runner()
         rc, out, _ = head.run(
-            f'python -m skypilot_tpu.agent.job_cli tail {job_id}',
+            f'{self._head_python(handle)} -m skypilot_tpu.agent.job_cli '
+            f'tail {job_id}',
             env=self._agent_env(handle), require_outputs=True)
         return out
 
@@ -357,11 +459,12 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
     def set_autostop(self, handle: ClusterHandle, idle_minutes: int,
                      down: bool = False) -> None:
         head = handle.head_runner()
+        py = self._head_python(handle)
         if idle_minutes < 0:
-            cmd = ('python -c "from skypilot_tpu.agent import '
-                   'autostop_lib; autostop_lib.clear_autostop()"')
+            cmd = (f'{py} -c "from skypilot_tpu.agent import '
+                   f'autostop_lib; autostop_lib.clear_autostop()"')
         else:
-            cmd = (f'python -c "from skypilot_tpu.agent import '
+            cmd = (f'{py} -c "from skypilot_tpu.agent import '
                    f'autostop_lib; autostop_lib.set_autostop('
                    f'{idle_minutes}, {down})"')
         rc, _, err = head.run(cmd, env=self._agent_env(handle),

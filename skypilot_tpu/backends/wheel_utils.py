@@ -1,0 +1,110 @@
+"""Build the skypilot_tpu wheel shipped to cluster hosts (self-bootstrap).
+
+Twin of sky/backends/wheel_utils.py:1 — the control plane builds a wheel
+of itself at launch time and ships it to every host, so a fresh TPU-VM /
+pod / BYO machine needs nothing preinstalled beyond python3. The wheel is
+cached under ~/.xsky/wheels/<content-hash>/ and rebuilt only when any
+package source file changes.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Tuple
+
+import filelock
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.version import __version__
+
+logger = sky_logging.init_logger(__name__)
+
+_PACKAGE_DIR = pathlib.Path(__file__).resolve().parent.parent
+_REPO_ROOT = _PACKAGE_DIR.parent
+WHEEL_DIR = pathlib.Path(
+    os.environ.get('XSKY_WHEEL_DIR',
+                   os.path.expanduser('~/.xsky/wheels')))
+_WHEEL_LOCK = WHEEL_DIR / '.build.lock'
+
+WHEEL_NAME = f'skypilot_tpu-{__version__}-py3-none-any.whl'
+
+
+def _source_hash() -> str:
+    """Content hash over every file that ends up in the wheel."""
+    h = hashlib.sha256()
+    names = []
+    for path in sorted(_PACKAGE_DIR.rglob('*')):
+        if path.is_dir() or '__pycache__' in path.parts:
+            continue
+        if path.suffix in ('.pyc', '.pyo'):
+            continue
+        names.append(path)
+    for path in names:
+        h.update(str(path.relative_to(_PACKAGE_DIR)).encode())
+        h.update(path.read_bytes())
+    pyproject = _REPO_ROOT / 'pyproject.toml'
+    if pyproject.exists():
+        h.update(pyproject.read_bytes())
+    return h.hexdigest()[:16]
+
+
+def build_wheel() -> Tuple[pathlib.Path, str]:
+    """Build (or reuse) the wheel; returns (wheel_path, content_hash).
+
+    Uses `pip wheel --no-build-isolation` so it works offline with the
+    baked-in setuptools (no PyPI round-trip for build requirements).
+    """
+    WHEEL_DIR.mkdir(parents=True, exist_ok=True)
+    with filelock.FileLock(str(_WHEEL_LOCK)):
+        content_hash = _source_hash()
+        out_dir = WHEEL_DIR / content_hash
+        wheel_path = out_dir / WHEEL_NAME
+        if wheel_path.exists():
+            return wheel_path, content_hash
+
+        # Stage a minimal source tree: pyproject + package only. Building
+        # from the live repo would vacuum tests/ and scratch files into
+        # sdist discovery and invalidate the cache on unrelated edits.
+        stage = pathlib.Path(tempfile.mkdtemp(prefix='xsky-wheel-'))
+        try:
+            shutil.copy2(_REPO_ROOT / 'pyproject.toml',
+                         stage / 'pyproject.toml')
+            readme = _REPO_ROOT / 'README.md'
+            if readme.exists():
+                shutil.copy2(readme, stage / 'README.md')
+            shutil.copytree(
+                _PACKAGE_DIR, stage / 'skypilot_tpu',
+                ignore=shutil.ignore_patterns('__pycache__', '*.pyc'))
+            build_dir = stage / 'dist'
+            proc = subprocess.run(
+                [sys.executable, '-m', 'pip', 'wheel', '--no-deps',
+                 '--no-build-isolation', '--wheel-dir', str(build_dir),
+                 str(stage)],
+                capture_output=True, text=True, check=False)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f'wheel build failed:\n{proc.stderr[-2000:]}')
+            wheels = list(build_dir.glob('skypilot_tpu-*.whl'))
+            if len(wheels) != 1:
+                raise RuntimeError(
+                    f'expected exactly one wheel, got {wheels}')
+            out_dir.mkdir(parents=True, exist_ok=True)
+            shutil.move(str(wheels[0]), wheel_path)
+        finally:
+            shutil.rmtree(stage, ignore_errors=True)
+
+        # Prune stale hash dirs, but only ones untouched for an hour: a
+        # concurrent launch may still be rsyncing a just-superseded wheel.
+        cutoff = time.time() - 3600
+        for old in WHEEL_DIR.iterdir():
+            if (old.is_dir() and old.name != content_hash and
+                    old.stat().st_mtime < cutoff):
+                shutil.rmtree(old, ignore_errors=True)
+        logger.info(f'Built runtime wheel {wheel_path}')
+        return wheel_path, content_hash

@@ -1,0 +1,4 @@
+"""Single source of the package version (read by setuptools via AST at
+build time, so it must stay a plain literal with no imports)."""
+
+__version__ = '0.1.0'

@@ -189,3 +189,58 @@ class TestLaunch:
         assert os.path.exists(marker)
         with open(marker) as f:
             assert json.load(f)['down'] is True
+
+
+class TestBootstrap:
+    """Remote-runtime self-bootstrap: a fresh host has nothing
+    preinstalled — the backend must ship its own wheel and install it
+    (twin of sky/backends/wheel_utils.py + instance_setup.py:540)."""
+
+    def test_launch_bootstraps_host_without_repo_pythonpath(
+            self, fake_cluster_env, monkeypatch):
+        import subprocess
+        monkeypatch.setenv('XSKY_BOOTSTRAP_LOCAL', '1')
+        task = Task('boot', run='echo bootstrapped-ok')
+        task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        job_id, handle = execution.launch(task, cluster_name='boot1')
+        from skypilot_tpu.backends import tpu_gang_backend
+        backend = tpu_gang_backend.TpuGangBackend()
+        assert 'bootstrapped-ok' in backend.tail_logs(handle, job_id, False)
+        # Agent commands must not lean on the control plane's checkout.
+        assert 'PYTHONPATH' not in backend._agent_env(handle)
+        assert '/venv/bin/python' in backend._head_python(handle)
+        # The host venv imports the package from its own site-packages
+        # even with no repo PYTHONPATH in the environment.
+        venv_py = os.path.join(handle.head_runtime_root, 'venv', 'bin',
+                               'python')
+        assert os.path.exists(venv_py)
+        clean_env = {k: v for k, v in os.environ.items()
+                     if k != 'PYTHONPATH'}
+        proc = subprocess.run(
+            [venv_py, '-c', 'import skypilot_tpu; '
+             'print(skypilot_tpu.__file__)'],
+            capture_output=True, text=True, env=clean_env, check=False,
+            cwd='/')  # neutral cwd: `-c` puts cwd on sys.path
+        assert proc.returncode == 0, proc.stderr
+        assert 'site-packages' in proc.stdout
+        from skypilot_tpu.backends import tpu_gang_backend as tgb
+        assert tgb._REPO_ROOT not in proc.stdout
+
+    def test_bootstrap_is_idempotent(self, fake_cluster_env, monkeypatch):
+        monkeypatch.setenv('XSKY_BOOTSTRAP_LOCAL', '1')
+        task = Task('boot2', run='echo ok')
+        task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        _, handle = execution.launch(task, cluster_name='boot2')
+        from skypilot_tpu.backends import tpu_gang_backend
+        backend = tpu_gang_backend.TpuGangBackend()
+        root = handle.head_runtime_root
+        marker = os.path.join(root, 'wheel_hash')
+        with open(marker) as f:
+            first_hash = f.read().strip()
+        venv_py = os.path.join(root, 'venv', 'bin', 'python')
+        mtime = os.path.getmtime(venv_py)
+        # Re-running setup must skip both venv creation and pip install.
+        backend._setup_runtime(handle)
+        with open(marker) as f:
+            assert f.read().strip() == first_hash
+        assert os.path.getmtime(venv_py) == mtime
