@@ -45,14 +45,18 @@ def _pick_config(platform: str, hbm_gib: float):
             model=llama.LLAMA_TINY, global_batch_size=4, seq_len=128,
             optimizer='adafactor', mesh_plan=mesh_lib.MeshPlan())
 
-    # ~1.2B-param Llama (same architecture family as the 8B baseline),
-    # adafactor like the reference run, bf16 params.
-    model = dataclasses.replace(llama.LLAMA3_1B, max_seq_len=2048)
-    batch = 8 if hbm_gib >= 24 else 4
+    # ~1.2B-param Llama (same architecture family as the 8B baseline) at
+    # the baseline's seq 8192, adafactor like the reference run, bf16
+    # params. Batch sized so fp32 logits [B, 8192, 32768] + per-layer
+    # remat checkpoints fit HBM.
+    model = dataclasses.replace(llama.LLAMA3_1B, max_seq_len=8192,
+                                remat_policy='qkvo_up')
+    per_chip_batch = 4 if hbm_gib >= 24 else 2
+    import jax
     return trainer_lib.TrainConfig(
         model=model,
-        global_batch_size=batch,
-        seq_len=2048,
+        global_batch_size=per_chip_batch * jax.device_count(),
+        seq_len=8192,
         optimizer='adafactor',
         mesh_plan=mesh_lib.MeshPlan())
 

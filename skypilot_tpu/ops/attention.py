@@ -81,7 +81,13 @@ def dot_product_attention(q: jax.Array,
     implementation: 'auto' | 'xla' | 'flash'.
     """
     if implementation == 'auto':
-        on_tpu = any(d.platform == 'tpu' for d in jax.devices())
+        # device_kind, not platform: TPU chips reached through a remote
+        # PJRT plugin (e.g. an 'axon' tunnel) report platform != 'tpu'
+        # but still run Pallas TPU kernels.
+        on_tpu = any(
+            d.platform == 'tpu' or
+            getattr(d, 'device_kind', '').startswith('TPU')
+            for d in jax.devices())
         use_flash = (on_tpu and q.shape[1] >= _FLASH_MIN_SEQ and
                      segment_ids is None and causal)
         implementation = 'flash' if use_flash else 'xla'
