@@ -2,7 +2,7 @@
 from __future__ import annotations
 
 import typing
-from typing import Any, Dict, Generic, Optional, TypeVar
+from typing import Any, Dict, Generic, List, Optional, TypeVar
 
 if typing.TYPE_CHECKING:
     from skypilot_tpu import task as task_lib
@@ -31,7 +31,9 @@ class Backend(Generic[_HandleT]):
                   dryrun: bool = False,
                   stream_logs: bool = True,
                   cluster_name: Optional[str] = None,
-                  retry_until_up: bool = False) -> Optional[_HandleT]:
+                  retry_until_up: bool = False,
+                  blocked_resources: Optional[List[Any]] = None
+                  ) -> Optional[_HandleT]:
         raise NotImplementedError
 
     def sync_workdir(self, handle: _HandleT, workdir: str) -> None:

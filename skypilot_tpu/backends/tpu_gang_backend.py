@@ -17,7 +17,7 @@ import shlex
 import tempfile
 import time
 import typing
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import provision as provision_lib
@@ -102,7 +102,10 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                   to_provision: Optional['resources_lib.Resources'],
                   dryrun: bool = False, stream_logs: bool = True,
                   cluster_name: Optional[str] = None,
-                  retry_until_up: bool = False) -> Optional[ClusterHandle]:
+                  retry_until_up: bool = False,
+                  blocked_resources: Optional[List[
+                      'resources_lib.Resources']] = None
+                  ) -> Optional[ClusterHandle]:
         assert cluster_name is not None
         if dryrun:
             logger.info(f'Dryrun: would provision {cluster_name} with '
@@ -112,6 +115,10 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             task = _pin_task(task, to_provision)
         provisioner = failover.RetryingProvisioner(
             task, cluster_name, task.num_nodes)
+        if blocked_resources:
+            # Pre-seeded blocklist (jobs recovery: eager_next_region
+            # skips the preempted region without a failed attempt).
+            provisioner.blocked.extend(blocked_resources)
         result = failover.provision_with_retry_until_up(
             provisioner, retry_until_up=retry_until_up)
         handle = ClusterHandle(cluster_name, result.resources,
@@ -252,6 +259,25 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                 f'wheel install failed (rc={rc}): {err or out}')
 
     # ---- sync ----
+
+    def run_module_on_head(self, handle: ClusterHandle, module: str,
+                           *args: str,
+                           extra_env: Optional[Dict[str, str]] = None
+                           ) -> Tuple[int, str, str]:
+        """Run ``python -m <module> <args...>`` on the cluster head.
+
+        Public entry for controllers/recovery that need to execute
+        framework code on a cluster (e.g. the remote jobs-controller
+        relay) without reaching into backend privates. Uses the
+        bootstrapped venv python when the host was wheel-installed.
+        """
+        cmd = ' '.join([self._head_python(handle), '-m', module] +
+                       [shlex.quote(a) for a in args])
+        env = self._agent_env(handle)
+        if extra_env:
+            env.update(extra_env)
+        return handle.head_runner().run(cmd, env=env,
+                                        require_outputs=True)
 
     def sync_workdir(self, handle: ClusterHandle, workdir: str) -> None:
         runners = handle.get_command_runners()

@@ -58,8 +58,14 @@ def launch(entrypoint,
            detach_run: bool = False,
            stream_logs: bool = True,
            backend: Optional[Any] = None,
-           no_setup: bool = False) -> Tuple[Optional[int], Optional[Any]]:
-    """Provision (if needed) and run. Returns (job_id, handle)."""
+           no_setup: bool = False,
+           blocked_resources: Optional[List[Any]] = None
+           ) -> Tuple[Optional[int], Optional[Any]]:
+    """Provision (if needed) and run. Returns (job_id, handle).
+
+    blocked_resources pre-seeds the failover blocklist (used by jobs
+    recovery to avoid a just-preempted region).
+    """
     dag = _to_dag(entrypoint)
     dag = admin_policy_lib.apply(dag)
     if cluster_name is None:
@@ -74,7 +80,8 @@ def launch(entrypoint,
                         retry_until_up=retry_until_up,
                         idle_minutes_to_autostop=idle_minutes_to_autostop,
                         down=down, detach_run=detach_run,
-                        backend=backend)
+                        backend=backend,
+                        blocked_resources=blocked_resources)
 
 
 def exec(entrypoint,  # pylint: disable=redefined-builtin
@@ -119,8 +126,9 @@ def _execute_dag(dag: dag_lib.Dag,
                  idle_minutes_to_autostop: Optional[int],
                  down: bool,
                  detach_run: bool,
-                 backend: Optional[Any]) -> Tuple[Optional[int],
-                                                  Optional[Any]]:
+                 backend: Optional[Any],
+                 blocked_resources: Optional[List[Any]] = None
+                 ) -> Tuple[Optional[int], Optional[Any]]:
     if len(dag.tasks) != 1:
         raise ValueError(
             'launch executes single-task DAGs; use jobs.launch for '
@@ -148,7 +156,8 @@ def _execute_dag(dag: dag_lib.Dag,
     if Stage.PROVISION in stages and handle is None:
         handle = backend.provision(task, best, dryrun=dryrun,
                                    cluster_name=cluster_name,
-                                   retry_until_up=retry_until_up)
+                                   retry_until_up=retry_until_up,
+                                   blocked_resources=blocked_resources)
         if dryrun:
             return None, None
 

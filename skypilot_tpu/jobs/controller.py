@@ -19,6 +19,7 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.agent import job_lib as cluster_job_lib
 from skypilot_tpu.jobs import recovery as recovery_lib
+from skypilot_tpu.jobs import scheduler
 from skypilot_tpu.jobs import state as jobs_state
 
 logger = sky_logging.init_logger(__name__)
@@ -59,6 +60,13 @@ class JobsController:
     # ---- main loop ----
 
     def run(self) -> None:
+        record = jobs_state.get_job(self.job_id)
+        if record is not None and record['status'].is_terminal():
+            # Cancelled (or otherwise finished) between the scheduler's
+            # claim and this process starting — do not resurrect it.
+            logger.info(f'Job {self.job_id} already '
+                        f'{record["status"].value}; exiting.')
+            return
         jobs_state.set_status(self.job_id,
                               jobs_state.ManagedJobStatus.STARTING)
         jobs_state.set_cluster_name(self.job_id, self.cluster_name)
@@ -69,6 +77,10 @@ class JobsController:
                 self.job_id, jobs_state.ManagedJobStatus.FAILED_NO_RESOURCE,
                 failure_reason=str(e))
             return
+        finally:
+            # Free the launch slot whether or not provisioning worked —
+            # the scheduler can start the next queued controller.
+            scheduler.launch_done(self.job_id)
         jobs_state.set_status(self.job_id,
                               jobs_state.ManagedJobStatus.RUNNING)
 
@@ -129,6 +141,9 @@ class JobsController:
         self._cleanup()
 
     def _recover(self):
+        # Relaunches queue behind fresh launches (preemption storms must
+        # not stampede the provisioner) — reacquire a launch slot first.
+        scheduler.acquire_launch_slot(self.job_id)
         try:
             handle, cluster_job_id = self.strategy.recover(
                 self._current_handle())
@@ -139,6 +154,8 @@ class JobsController:
                 jobs_state.ManagedJobStatus.FAILED_NO_RESOURCE,
                 failure_reason=str(e))
             return None, None
+        finally:
+            scheduler.launch_done(self.job_id)
 
     def _current_handle(self):
         from skypilot_tpu import state as state_lib
@@ -170,6 +187,8 @@ def main() -> int:
             job_id, jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
             failure_reason=str(e))
         return 1
+    finally:
+        scheduler.job_done(job_id)
 
 
 if __name__ == '__main__':

@@ -1,42 +1,45 @@
 """Managed-jobs API (twin of sky/jobs/server/core.py + scheduler).
 
-Controller placement: the reference launches a dedicated jobs-controller
-*cluster* and runs one controller process per job on it
-(sky/templates/jobs-controller.yaml.j2, sky/jobs/scheduler.py). Here the
-controller processes run on the API-server host directly — the same
-process model (one detached controller per job, sqlite state), minus the
-extra controller-cluster hop. A controller cluster can be layered on by
-pointing XSKY_JOBS_CONTROLLER_REMOTE at a cluster name; parity note for
-SURVEY §2.6.
+Controller placement: two modes, matching the reference
+(sky/templates/jobs-controller.yaml.j2, sky/jobs/scheduler.py):
+
+  * local (default) — controller processes run on the API-server host,
+    scheduled by jobs.scheduler under launching/alive parallelism caps.
+  * remote — XSKY_JOBS_CONTROLLER_REMOTE=1 provisions a dedicated
+    controller cluster and every jobs verb (launch/queue/cancel/logs)
+    is forwarded to it over the backend command runner (jobs.remote),
+    like the reference's ManagedJobCodeGen-over-SSH.
 """
 from __future__ import annotations
 
 import os
 import signal
-import subprocess
-import sys
 import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
+from skypilot_tpu.jobs import scheduler as jobs_scheduler
 from skypilot_tpu.jobs import state as jobs_state
 
 logger = sky_logging.init_logger(__name__)
 
 
+def _remote_mode() -> bool:
+    return os.environ.get('XSKY_JOBS_CONTROLLER_REMOTE', '') not in (
+        '', '0')
+
+
 def launch(task: task_lib.Task, name: Optional[str] = None,
            wait: bool = False, timeout_s: float = 600.0) -> int:
     """Submit a managed job; returns the managed job id."""
+    if _remote_mode():
+        from skypilot_tpu.jobs import remote as jobs_remote
+        return jobs_remote.launch(task, name=name, wait=wait,
+                                  timeout_s=timeout_s)
     job_id = jobs_state.add_job(name or task.name, task.to_yaml_config())
     jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.SUBMITTED)
-    proc = subprocess.Popen(
-        [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
-         str(job_id)],
-        env=dict(os.environ),
-        start_new_session=True,
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-    jobs_state.set_controller_pid(job_id, proc.pid)
+    jobs_scheduler.submit_job(job_id)
     if wait:
         wait_for_terminal(job_id, timeout_s)
     return job_id
@@ -55,11 +58,15 @@ def wait_for_terminal(job_id: int, timeout_s: float = 600.0
 
 
 def queue() -> List[Dict[str, Any]]:
+    if _remote_mode():
+        from skypilot_tpu.jobs import remote as jobs_remote
+        return jobs_remote.queue()
     rows = jobs_state.get_jobs()
     return [{
         'job_id': r['job_id'],
         'name': r['name'],
         'status': r['status'].value,
+        'schedule_state': r['schedule_state'].value,
         'cluster_name': r['cluster_name'],
         'recovery_count': r['recovery_count'],
         'failure_reason': r['failure_reason'],
@@ -69,16 +76,30 @@ def queue() -> List[Dict[str, Any]]:
 
 
 def cancel(job_id: int) -> None:
-    record = jobs_state.get_job(job_id)
-    if record is None or record['status'].is_terminal():
+    if _remote_mode():
+        from skypilot_tpu.jobs import remote as jobs_remote
+        jobs_remote.cancel(job_id)
         return
-    pid = record['controller_pid']
-    if pid:
-        try:
-            os.kill(pid, signal.SIGTERM)
-        except (ProcessLookupError, PermissionError):
-            pass
-    jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.CANCELLED)
+    # Under the scheduler lock so the cancel cannot interleave with a
+    # concurrent WAITING→LAUNCHING claim (which would spawn a controller
+    # for an already-cancelled job).
+    with jobs_scheduler.schedule_lock():
+        record = jobs_state.get_job(job_id)
+        if record is None or record['status'].is_terminal():
+            return
+        pid = record['controller_pid']
+        if pid:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+        jobs_state.set_status(job_id,
+                              jobs_state.ManagedJobStatus.CANCELLED)
+        jobs_state.set_schedule_state(job_id,
+                                      jobs_state.ScheduleState.DONE)
+    # Outside the lock: wake the queue (SIGTERM'd controllers cannot
+    # report job_done themselves).
+    jobs_scheduler.maybe_schedule_next_jobs()
     # Reap the task cluster if it exists.
     cluster_name = record['cluster_name']
     if cluster_name:
@@ -91,6 +112,9 @@ def cancel(job_id: int) -> None:
 
 
 def tail_logs(job_id: int) -> str:
+    if _remote_mode():
+        from skypilot_tpu.jobs import remote as jobs_remote
+        return jobs_remote.tail_logs(job_id)
     record = jobs_state.get_job(job_id)
     if record is None:
         return ''

@@ -18,7 +18,6 @@ from skypilot_tpu import exceptions
 from skypilot_tpu import resources as resources_lib
 from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
-from skypilot_tpu.backends import failover as failover_lib
 from skypilot_tpu.backends import tpu_gang_backend
 from skypilot_tpu.utils import registry
 
@@ -39,6 +38,10 @@ class StrategyExecutor:
         self.max_restarts_on_errors = max_restarts_on_errors
         self.backend = tpu_gang_backend.TpuGangBackend()
         self.restart_count_on_errors = 0
+        # Last successfully launched resources — kept here because the
+        # cluster's state record (and its handle) may already be reaped
+        # by status reconciliation when recover() runs.
+        self.last_launched: Optional[resources_lib.Resources] = None
 
     @classmethod
     def make(cls, task: task_lib.Task,
@@ -53,12 +56,17 @@ class StrategyExecutor:
 
     # ---- launch ----
 
-    def launch(self, retry_until_up: bool = True) -> Any:
+    def launch(self, retry_until_up: bool = True,
+               blocked: Optional[List[resources_lib.Resources]] = None
+               ) -> Any:
         """Provision the task cluster + submit the job. Returns handle."""
         from skypilot_tpu import execution
         job_id, handle = execution.launch(
             self.task, cluster_name=self.cluster_name,
-            retry_until_up=retry_until_up, detach_run=True)
+            retry_until_up=retry_until_up, detach_run=True,
+            blocked_resources=blocked)
+        if handle is not None:
+            self.last_launched = handle.launched_resources
         return handle, job_id
 
     # ---- recovery ----
@@ -70,8 +78,12 @@ class StrategyExecutor:
     def _relaunch(self,
                   blocked: Optional[List[resources_lib.Resources]] = None
                   ) -> Any:
-        """Teardown leftovers + relaunch, optionally avoiding regions."""
-        from skypilot_tpu import execution
+        """Teardown leftovers + relaunch, optionally avoiding regions.
+
+        The relaunch goes through execution.launch end-to-end — the same
+        stage machine as the initial launch — with the preempted region
+        pre-seeded into the failover blocklist when a strategy asks.
+        """
         from skypilot_tpu import state as state_lib
         # Clean any half-dead cluster record.
         record = state_lib.get_cluster_from_name(self.cluster_name)
@@ -82,29 +94,7 @@ class StrategyExecutor:
             except Exception as e:  # pylint: disable=broad-except
                 logger.warning(f'Teardown before recovery failed: {e}')
                 state_lib.remove_cluster(self.cluster_name, terminate=True)
-        task = self.task
-        if blocked:
-            # Pin candidates away from blocked regions by wrapping the
-            # provisioner blocklist through a one-off launch.
-            provisioner = failover_lib.RetryingProvisioner(
-                task, self.cluster_name, task.num_nodes)
-            provisioner.blocked.extend(blocked)
-            result = failover_lib.provision_with_retry_until_up(
-                provisioner, retry_until_up=True, retry_interval_s=1.0)
-            handle = tpu_gang_backend.ClusterHandle(
-                self.cluster_name, result.resources, result.num_nodes,
-                result.cluster_info)
-            state_lib.add_or_update_cluster(self.cluster_name, handle,
-                                            ready=False)
-            self.backend._setup_runtime(handle)  # pylint: disable=protected-access
-            state_lib.add_or_update_cluster(self.cluster_name, handle,
-                                            ready=True, is_launch=False)
-            if task.workdir:
-                self.backend.sync_workdir(handle, task.workdir)
-            self.backend.setup(handle, task)
-            job_id = self.backend.execute(handle, task, detach_run=True)
-            return handle, job_id
-        return self.launch(retry_until_up=True)
+        return self.launch(retry_until_up=True, blocked=blocked)
 
     def should_restart_on_failure(self) -> bool:
         """User-code failure budget (max_restarts_on_errors, reference
@@ -127,10 +117,10 @@ class EagerFailoverStrategyExecutor(StrategyExecutor):
 
     def recover(self, handle: Any) -> Any:
         blocked = []
-        if handle is not None:
-            launched = handle.launched_resources
-            if launched.region is not None:
-                blocked.append(
-                    resources_lib.Resources(cloud=launched.cloud_name,
-                                            region=launched.region))
+        launched = (handle.launched_resources if handle is not None
+                    else self.last_launched)
+        if launched is not None and launched.region is not None:
+            blocked.append(
+                resources_lib.Resources(cloud=launched.cloud_name,
+                                        region=launched.region))
         return self._relaunch(blocked=blocked)

@@ -1,0 +1,139 @@
+"""Remote jobs-controller mode: controllers on a provisioned cluster.
+
+Twin of the reference's jobs-controller-as-a-cluster
+(sky/templates/jobs-controller.yaml.j2:1-30 + sky/jobs/utils.py
+ManagedJobCodeGen): the API server provisions a dedicated controller
+cluster once, then forwards every jobs verb to it by running
+``python -m skypilot_tpu.jobs.remote_exec <verb>`` on the controller
+head over the backend command runner. The managed-jobs DB, the
+scheduler, and all controller processes live on that cluster; the local
+host only relays requests.
+
+Enabled with XSKY_JOBS_CONTROLLER_REMOTE=1 (or =<cluster-name>).
+Controller sizing comes from config key jobs.controller.resources.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+
+logger = sky_logging.init_logger(__name__)
+
+_DEFAULT_CLUSTER = 'xsky-jobs-controller'
+
+
+def cluster_name() -> str:
+    value = os.environ.get('XSKY_JOBS_CONTROLLER_REMOTE', '')
+    if value in ('', '0', '1'):
+        return _DEFAULT_CLUSTER
+    return value
+
+
+def _controller_task() -> task_lib.Task:
+    from skypilot_tpu import resources as resources_lib
+    overrides = config_lib.get_nested(
+        ('jobs', 'controller', 'resources'), {}) or {}
+    t = task_lib.Task('jobs-controller')
+    t.set_resources(resources_lib.Resources.from_yaml_config(overrides))
+    return t
+
+
+def ensure_controller_cluster(provision: bool = True) -> Any:
+    """Return the controller cluster's handle.
+
+    provision=True (mutating verbs: launch) brings the cluster up if
+    needed; read verbs pass False and get ClusterNotUpError instead of
+    provisioning infrastructure as a side effect.
+    """
+    from skypilot_tpu import execution
+    from skypilot_tpu import state as state_lib
+    name = cluster_name()
+    record = state_lib.get_cluster_from_name(name)
+    if record is not None and record['status'] == state_lib.ClusterStatus.UP:
+        return record['handle']
+    if not provision:
+        raise exceptions.ClusterNotUpError(
+            f'Jobs controller cluster {name!r} is not UP; launch a '
+            'managed job first.',
+            cluster_status=record['status'] if record else None)
+    _, handle = execution.launch(_controller_task(), cluster_name=name)
+    return handle
+
+
+def _backend_and_handle(provision: bool):
+    from skypilot_tpu.backends import tpu_gang_backend
+    handle = ensure_controller_cluster(provision)
+    return tpu_gang_backend.TpuGangBackend(), handle
+
+
+def _call(verb: str, *args: str,
+          payload_file: Optional[str] = None,
+          provision: bool = False) -> Any:
+    """Run remote_exec on the controller head, parse its JSON reply."""
+    backend, handle = _backend_and_handle(provision)
+    remote_args = list(args)
+    if payload_file is not None:
+        # Home-relative so every runner flavor (local host-root, ssh
+        # $HOME, k8s /root) resolves it consistently for both the rsync
+        # and the remote open().
+        remote_path = (f'.xsky/managed_tasks/'
+                       f'{os.path.basename(payload_file)}')
+        runner = handle.head_runner()
+        runner.run(f'mkdir -p {shlex.quote(os.path.dirname(remote_path))}')
+        runner.rsync(payload_file, remote_path, up=True)
+        remote_args.append(remote_path)
+    rc, stdout, stderr = backend.run_module_on_head(
+        handle, 'skypilot_tpu.jobs.remote_exec', verb, *remote_args)
+    if rc != 0:
+        raise exceptions.CommandError(
+            rc, f'jobs.remote_exec {verb}',
+            f'remote jobs controller failed: {stderr.strip()}')
+    # remote_exec prints exactly one JSON line last.
+    line = stdout.strip().splitlines()[-1]
+    return json.loads(line)
+
+
+def launch(task: task_lib.Task, name: Optional[str] = None,
+           wait: bool = False, timeout_s: float = 600.0) -> int:
+    with tempfile.NamedTemporaryFile(
+            'w', suffix='.yaml', prefix='xsky-mjob-',
+            delete=False) as f:
+        f.write(json.dumps(task.to_yaml_config()))
+        local_path = f.name
+    try:
+        reply = _call('submit', *(['--name', name] if name else []),
+                      payload_file=local_path, provision=True)
+    finally:
+        os.unlink(local_path)
+    job_id = int(reply['job_id'])
+    if wait:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            row = _call('get', str(job_id))
+            if row and row.get('terminal'):
+                return job_id
+            time.sleep(1.0)
+        raise TimeoutError(f'Managed job {job_id} not terminal '
+                           f'after {timeout_s}s')
+    return job_id
+
+
+def queue() -> List[Dict[str, Any]]:
+    return _call('queue')
+
+
+def cancel(job_id: int) -> None:
+    _call('cancel', str(job_id))
+
+
+def tail_logs(job_id: int) -> str:
+    return _call('logs', str(job_id))['logs']

@@ -1,0 +1,63 @@
+"""CLI executed ON the jobs-controller cluster head (remote mode).
+
+The local-host relay (jobs.remote) invokes
+``python -m skypilot_tpu.jobs.remote_exec <verb> [args]`` over the
+backend command runner; each verb performs the local-mode jobs operation
+on the controller host and prints ONE JSON line. (Role of the
+reference's ManagedJobCodeGen snippets run over SSH,
+sky/jobs/utils.py.)
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any
+
+
+def _print(obj: Any) -> None:
+    print(json.dumps(obj))
+
+
+def main(argv) -> int:
+    # This host IS the controller; never recurse into remote mode.
+    os.environ['XSKY_JOBS_CONTROLLER_REMOTE'] = ''
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.jobs import core as jobs_core
+    from skypilot_tpu.jobs import state as jobs_state
+
+    verb, args = argv[0], argv[1:]
+    if verb == 'submit':
+        name = None
+        if args and args[0] == '--name':
+            name, args = args[1], args[2:]
+        with open(args[0], encoding='utf-8') as f:
+            config = json.load(f)
+        task = task_lib.Task.from_yaml_config(config)
+        job_id = jobs_core.launch(task, name=name)
+        _print({'job_id': job_id})
+    elif verb == 'get':
+        row = jobs_state.get_job(int(args[0]))
+        if row is None:
+            _print(None)
+        else:
+            _print({'job_id': row['job_id'],
+                    'status': row['status'].value,
+                    'terminal': row['status'].is_terminal(),
+                    'failure_reason': row['failure_reason']})
+    elif verb == 'queue':
+        _print(jobs_core.queue())
+    elif verb == 'cancel':
+        jobs_core.cancel(int(args[0]))
+        _print({'ok': True})
+    elif verb == 'logs':
+        _print({'logs': jobs_core.tail_logs(int(args[0]))})
+    else:
+        print(json.dumps({'error': f'unknown verb {verb}'}),
+              file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))

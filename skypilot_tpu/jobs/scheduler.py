@@ -1,0 +1,206 @@
+"""Managed-jobs scheduler: bounded controller parallelism + queueing.
+
+Twin of the reference's event-driven scheduler
+(sky/jobs/scheduler.py:114 `maybe_schedule_next_jobs`, caps at :295-315).
+Design, as there:
+
+  * Scheduling is event-driven, not a daemon: `maybe_schedule_next_jobs`
+    runs on every state transition that could free or fill a slot (job
+    submit, launch finished, controller exit) and is a no-op otherwise.
+  * Two separate caps:
+      - LAUNCHING cap — how many controllers may be provisioning task
+        clusters at once (launches are CPU/network heavy on the
+        controller host).
+      - ALIVE cap — how many controller processes may exist at all
+        (each is a Python process; bounded by host memory).
+  * All transitions happen under one inter-process file lock, so any
+    number of API-server workers / exiting controllers can race on the
+    schedule safely. A job's schedule_state walks
+    WAITING → LAUNCHING → ALIVE → DONE; recovery relaunches re-acquire a
+    launch slot via ALIVE → LAUNCHING → ALIVE.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Optional
+
+import filelock
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.jobs import state as jobs_state
+
+logger = sky_logging.init_logger(__name__)
+
+# Reference sizing: one launch ≈ 1 CPU + a controller ≈ 350 MB
+# (sky/jobs/scheduler.py:295-315 computes caps from host cpu/mem).
+_CONTROLLER_MEM_MB = 350
+
+
+def max_launching() -> int:
+    env = os.environ.get('XSKY_JOBS_MAX_LAUNCHING')
+    if env:
+        return max(1, int(env))
+    return max(1, min(8, os.cpu_count() or 4))
+
+
+def max_alive() -> int:
+    env = os.environ.get('XSKY_JOBS_MAX_PARALLEL')
+    if env:
+        return max(1, int(env))
+    try:
+        pages = os.sysconf('SC_PHYS_PAGES')
+        page_size = os.sysconf('SC_PAGE_SIZE')
+        mem_mb = pages * page_size / (1024 * 1024)
+        return max(4, int(mem_mb / _CONTROLLER_MEM_MB / 2))
+    except (ValueError, OSError):
+        return 16
+
+
+def _lock() -> filelock.FileLock:
+    path = os.path.join(
+        os.path.dirname(jobs_state.db_path()), 'jobs_scheduler.lock')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    return filelock.FileLock(path, timeout=30)
+
+
+def schedule_lock() -> filelock.FileLock:
+    """The scheduler's inter-process lock, for operations that must not
+    interleave with a WAITING→LAUNCHING claim (e.g. cancel)."""
+    return _lock()
+
+
+def _spawn_controller(job_id: int) -> None:
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+         str(job_id)],
+        env=dict(os.environ),
+        start_new_session=True,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    jobs_state.set_controller_pid(job_id, proc.pid)
+
+
+def _pid_alive(pid: Optional[int]) -> bool:
+    if not pid:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def _reconcile_dead_controllers() -> None:
+    """Release slots held by controllers that died without cleanup.
+
+    A SIGKILL/OOM-killed controller never runs its job_done() finally;
+    its LAUNCHING/ALIVE row would otherwise count against the caps
+    forever and wedge the queue. Caller must hold the scheduler lock.
+    """
+    for row in jobs_state.get_jobs():
+        if row['schedule_state'] not in (jobs_state.ScheduleState.LAUNCHING,
+                                         jobs_state.ScheduleState.ALIVE):
+            continue
+        if _pid_alive(row['controller_pid']):
+            continue
+        logger.warning(
+            f'Managed job {row["job_id"]} controller '
+            f'(pid {row["controller_pid"]}) died without cleanup; '
+            'releasing its scheduler slot.')
+        if not row['status'].is_terminal():
+            jobs_state.set_status(
+                row['job_id'], jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
+                failure_reason='controller process died')
+        jobs_state.set_schedule_state(row['job_id'],
+                                      jobs_state.ScheduleState.DONE)
+
+
+def submit_job(job_id: int) -> None:
+    """Queue a freshly added job and kick the schedule."""
+    jobs_state.set_schedule_state(job_id,
+                                  jobs_state.ScheduleState.WAITING)
+    maybe_schedule_next_jobs()
+
+
+def maybe_schedule_next_jobs() -> None:
+    """Start controllers for WAITING jobs while slots are free.
+
+    Safe to call from anywhere at any time; does nothing when no slots
+    or no waiting jobs. (Twin of sky/jobs/scheduler.py:114.)
+    """
+    try:
+        with _lock():
+            _reconcile_dead_controllers()
+            while True:
+                counts = jobs_state.schedule_state_counts()
+                launching = counts.get(jobs_state.ScheduleState.LAUNCHING,
+                                       0)
+                alive = counts.get(jobs_state.ScheduleState.ALIVE, 0)
+                if launching >= max_launching():
+                    return
+                if launching + alive >= max_alive():
+                    return
+                job_id = jobs_state.claim_next_waiting()
+                if job_id is None:
+                    return
+                logger.info(f'Scheduling managed job {job_id} '
+                            f'(launching={launching + 1}, '
+                            f'alive={alive})')
+                try:
+                    _spawn_controller(job_id)
+                except OSError as e:
+                    jobs_state.set_status(
+                        job_id,
+                        jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
+                        failure_reason=f'controller spawn failed: {e}')
+                    jobs_state.set_schedule_state(
+                        job_id, jobs_state.ScheduleState.DONE)
+    except filelock.Timeout:
+        # Another process owns the schedule; it will pick the jobs up.
+        logger.debug('Scheduler lock busy; skipping tick.')
+
+
+def launch_done(job_id: int) -> None:
+    """Controller finished provisioning: free the launch slot."""
+    with _lock():
+        jobs_state.set_schedule_state(job_id,
+                                      jobs_state.ScheduleState.ALIVE)
+    maybe_schedule_next_jobs()
+
+
+def acquire_launch_slot(job_id: int,
+                        poll_interval_s: float = 0.5,
+                        timeout_s: Optional[float] = None) -> None:
+    """Block until a launch slot is free, then take it (recovery path).
+
+    An ALIVE controller that needs to relaunch its cluster must wait its
+    turn behind fresh launches so a preemption storm cannot stampede the
+    provisioner (reference: schedule_state WAITING→LAUNCHING round-trip
+    in sky/jobs/scheduler.py).
+    """
+    deadline = (time.time() + timeout_s) if timeout_s else None
+    while True:
+        with _lock():
+            _reconcile_dead_controllers()
+            counts = jobs_state.schedule_state_counts()
+            if counts.get(jobs_state.ScheduleState.LAUNCHING,
+                          0) < max_launching():
+                jobs_state.set_schedule_state(
+                    job_id, jobs_state.ScheduleState.LAUNCHING)
+                return
+        if deadline and time.time() > deadline:
+            raise TimeoutError(
+                f'No launch slot for job {job_id} after {timeout_s}s')
+        time.sleep(poll_interval_s)
+
+
+def job_done(job_id: int) -> None:
+    """Controller exited: free all slots and wake the queue."""
+    with _lock():
+        jobs_state.set_schedule_state(job_id,
+                                      jobs_state.ScheduleState.DONE)
+    maybe_schedule_next_jobs()

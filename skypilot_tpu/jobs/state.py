@@ -39,13 +39,23 @@ class ManagedJobStatus(enum.Enum):
                         ManagedJobStatus.CANCELLED)
 
 
-def _db_path() -> str:
+class ScheduleState(enum.Enum):
+    """Scheduler lifecycle, orthogonal to ManagedJobStatus (twin of
+    sky/jobs/state.py ManagedJobScheduleState:385)."""
+    INACTIVE = 'INACTIVE'     # pre-scheduler rows (legacy) / not queued
+    WAITING = 'WAITING'       # queued, no controller yet
+    LAUNCHING = 'LAUNCHING'   # controller holds a launch slot
+    ALIVE = 'ALIVE'           # controller running, cluster launched
+    DONE = 'DONE'             # controller exited
+
+
+def db_path() -> str:
     return os.path.expanduser(
         os.environ.get('XSKY_JOBS_DB', '~/.xsky/managed_jobs.db'))
 
 
 def _db() -> sqlite3.Connection:
-    path = _db_path()
+    path = db_path()
     os.makedirs(os.path.dirname(path), exist_ok=True)
     conn = sqlite3.connect(path, timeout=30, check_same_thread=False)
     conn.execute('PRAGMA journal_mode=WAL')
@@ -61,8 +71,14 @@ def _db() -> sqlite3.Connection:
             controller_pid INTEGER,
             submitted_at REAL,
             started_at REAL,
-            ended_at REAL
+            ended_at REAL,
+            schedule_state TEXT DEFAULT 'INACTIVE'
         )""")
+    try:
+        conn.execute("ALTER TABLE managed_jobs ADD COLUMN "
+                     "schedule_state TEXT DEFAULT 'INACTIVE'")
+    except sqlite3.OperationalError:
+        pass  # column exists
     conn.commit()
     return conn
 
@@ -101,6 +117,45 @@ def set_status(job_id: int, status: ManagedJobStatus,
                          (status.value, job_id))
         conn.commit()
         conn.close()
+
+
+def set_schedule_state(job_id: int, sched: ScheduleState) -> None:
+    with _lock:
+        conn = _db()
+        conn.execute(
+            'UPDATE managed_jobs SET schedule_state=? WHERE job_id=?',
+            (sched.value, job_id))
+        conn.commit()
+        conn.close()
+
+
+def schedule_state_counts() -> Dict[ScheduleState, int]:
+    with _lock:
+        conn = _db()
+        rows = conn.execute(
+            'SELECT schedule_state, COUNT(*) FROM managed_jobs '
+            'GROUP BY schedule_state').fetchall()
+        conn.close()
+    return {ScheduleState(s or 'INACTIVE'): n for s, n in rows}
+
+
+def claim_next_waiting() -> Optional[int]:
+    """Atomically move the oldest WAITING job to LAUNCHING."""
+    with _lock:
+        conn = _db()
+        row = conn.execute(
+            'SELECT job_id FROM managed_jobs WHERE schedule_state=? '
+            'ORDER BY job_id LIMIT 1',
+            (ScheduleState.WAITING.value,)).fetchone()
+        if row is None:
+            conn.close()
+            return None
+        conn.execute(
+            'UPDATE managed_jobs SET schedule_state=? WHERE job_id=?',
+            (ScheduleState.LAUNCHING.value, row[0]))
+        conn.commit()
+        conn.close()
+        return row[0]
 
 
 def set_cluster_name(job_id: int, cluster_name: str) -> None:
@@ -159,8 +214,9 @@ def get_jobs() -> List[Dict[str, Any]]:
 def _to_dict(row) -> Dict[str, Any]:
     (job_id, name, task_config, status, cluster_name, recovery_count,
      failure_reason, controller_pid, submitted_at, started_at,
-     ended_at) = row
+     ended_at, schedule_state) = row
     return {
+        'schedule_state': ScheduleState(schedule_state or 'INACTIVE'),
         'job_id': job_id,
         'name': name,
         'task_config': json.loads(task_config or '{}'),

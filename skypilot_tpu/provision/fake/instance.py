@@ -116,6 +116,7 @@ def reset() -> None:
                 if root:
                     shutil.rmtree(root, ignore_errors=True)
         data['clusters'] = {}
+        data['provision_regions'] = {}
     injector.reset()
 
 
@@ -123,6 +124,8 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
                   config: common.ProvisionConfig) -> common.ProvisionRecord:
     zone = zone or f'{region}-a'
     with _store() as data:
+        data.setdefault('provision_regions', {}).setdefault(
+            cluster_name, []).append(region)
         injector.check(zone)
         existing = data['clusters'].get(cluster_name)
         if existing is not None:
@@ -229,6 +232,14 @@ def get_cluster_info(region: str, cluster_name: str,
 
 
 # ---- test helpers ----------------------------------------------------------
+
+
+def provision_regions(cluster_name: str) -> List[str]:
+    """Regions of every run_instances call for a cluster, in order
+    (test observability: where did launches/relaunches land)."""
+    with _store() as data:
+        return list(data.get('provision_regions', {}).get(
+            cluster_name, []))
 
 
 def preempt_cluster(cluster_name: str) -> None:

@@ -105,3 +105,107 @@ class TestManagedJobs:
         rows = jobs_core.queue()
         assert rows[0]['job_id'] == job_id
         assert rows[0]['status'] == 'SUCCEEDED'
+
+
+class TestJobsScheduler:
+    """Bounded controller parallelism (twin of sky/jobs/scheduler.py
+    caps, :295-315)."""
+
+    def test_parallelism_cap_honored(self, jobs_env, monkeypatch):
+        """20 jobs, launching cap 4: never >4 launching at once, all
+        complete."""
+        monkeypatch.setenv('XSKY_JOBS_MAX_LAUNCHING', '4')
+        monkeypatch.setenv('XSKY_JOBS_MAX_PARALLEL', '64')
+        job_ids = [jobs_core.launch(_tpu_task('echo n')) for _ in range(20)]
+
+        max_launching = 0
+        deadline = time.time() + 240
+        pending = set(job_ids)
+        while pending and time.time() < deadline:
+            counts = jobs_state.schedule_state_counts()
+            max_launching = max(
+                max_launching,
+                counts.get(jobs_state.ScheduleState.LAUNCHING, 0))
+            for jid in list(pending):
+                record = jobs_state.get_job(jid)
+                if record and record['status'].is_terminal():
+                    pending.discard(jid)
+            time.sleep(0.1)
+        assert not pending, f'jobs never finished: {sorted(pending)}'
+        assert max_launching <= 4, max_launching
+        assert max_launching >= 2, 'no parallelism observed'
+        for jid in job_ids:
+            record = jobs_state.get_job(jid)
+            assert record['status'] == \
+                jobs_state.ManagedJobStatus.SUCCEEDED, record
+            assert record['schedule_state'] == \
+                jobs_state.ScheduleState.DONE
+
+    def test_waiting_jobs_queue_behind_cap(self, jobs_env, monkeypatch):
+        """With cap 1, the second job stays WAITING until the first
+        controller frees the slot."""
+        monkeypatch.setenv('XSKY_JOBS_MAX_LAUNCHING', '1')
+        monkeypatch.setenv('XSKY_JOBS_MAX_PARALLEL', '1')
+        first = jobs_core.launch(_tpu_task('sleep 3'))
+        second = jobs_core.launch(_tpu_task('echo late'))
+        record = jobs_state.get_job(second)
+        assert record['schedule_state'] == jobs_state.ScheduleState.WAITING
+        _wait_for(first, [jobs_state.ManagedJobStatus.SUCCEEDED],
+                  timeout=90)
+        _wait_for(second, [jobs_state.ManagedJobStatus.SUCCEEDED],
+                  timeout=90)
+
+    def test_cancel_waiting_job_frees_nothing_but_terminates(
+            self, jobs_env, monkeypatch):
+        monkeypatch.setenv('XSKY_JOBS_MAX_LAUNCHING', '1')
+        monkeypatch.setenv('XSKY_JOBS_MAX_PARALLEL', '1')
+        first = jobs_core.launch(_tpu_task('sleep 5'))
+        second = jobs_core.launch(_tpu_task('echo never'))
+        jobs_core.cancel(second)
+        record = jobs_state.get_job(second)
+        assert record['status'] == jobs_state.ManagedJobStatus.CANCELLED
+        _wait_for(first, [jobs_state.ManagedJobStatus.SUCCEEDED],
+                  timeout=90)
+
+
+class TestRemoteController:
+    """Controller-as-cluster mode (twin of jobs-controller.yaml.j2)."""
+
+    def test_launch_via_remote_controller(self, jobs_env, monkeypatch):
+        monkeypatch.setenv('XSKY_JOBS_CONTROLLER_REMOTE', '1')
+        job_id = jobs_core.launch(_tpu_task('echo remote-ok'), wait=True,
+                                  timeout_s=120)
+        # The controller cluster itself was provisioned.
+        from skypilot_tpu import state as state_lib
+        record = state_lib.get_cluster_from_name('xsky-jobs-controller')
+        assert record is not None
+        assert record['status'] == state_lib.ClusterStatus.UP
+        # Verbs round-trip through the remote relay.
+        rows = jobs_core.queue()
+        row = [r for r in rows if r['job_id'] == job_id][0]
+        assert row['status'] == 'SUCCEEDED'
+
+
+class TestEagerNextRegion:
+
+    def test_recovery_avoids_preempted_region(self, jobs_env):
+        """eager_next_region seeds the preempted region into the
+        failover blocklist through execution.launch (no backend-private
+        calls)."""
+        job_id = jobs_core.launch(
+            _tpu_task('sleep 6', strategy='eager_next_region'))
+        record = _wait_for(job_id,
+                           [jobs_state.ManagedJobStatus.RUNNING])
+        cluster = record['cluster_name']
+        from skypilot_tpu import state as state_lib
+        first_region = state_lib.get_cluster_from_name(
+            cluster)['handle'].launched_resources.region
+        time.sleep(1.0)
+        jobs_env.preempt_cluster(cluster)
+        record = _wait_for(
+            job_id, [jobs_state.ManagedJobStatus.SUCCEEDED], timeout=90)
+        assert record['recovery_count'] >= 1
+        # The relaunch must have landed outside the preempted region.
+        events = jobs_env.provision_regions(cluster)
+        assert events and events[0] == first_region, events
+        assert any(r != first_region for r in events[1:]), events
