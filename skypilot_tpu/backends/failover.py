@@ -49,7 +49,8 @@ class RetryingProvisioner:
                  cluster_name: str,
                  num_nodes: int,
                  provider_config: Optional[Dict[str, Any]] = None,
-                 max_sku_retries: int = 20) -> None:
+                 max_sku_retries: int = 20,
+                 attempt_observer: Optional[Any] = None) -> None:
         self._task = requested_task
         self._cluster_name = cluster_name
         self._num_nodes = num_nodes
@@ -57,6 +58,11 @@ class RetryingProvisioner:
         self._max_sku_retries = max_sku_retries
         self.blocked: List[resources_lib.Resources] = []
         self.failover_history: List[Exception] = []
+        # Called with (concrete_resources, provision_config) right before
+        # each cloud attempt — lets the backend record a provisional
+        # cluster handle so a kill/crash mid-provision still leaves
+        # enough state to terminate whatever the attempt created.
+        self.attempt_observer = attempt_observer
 
     # ---- public ----
 
@@ -158,6 +164,9 @@ class RetryingProvisioner:
         try:
             logger.info(f'Provisioning {self._cluster_name!r} '
                         f'({resources}) in {zone or region}...')
+            if self.attempt_observer is not None:
+                self.attempt_observer(
+                    resources.copy(region=region, zone=zone), config)
             record = provision_lib.run_instances(provider, region, zone,
                                                  self._cluster_name, config)
             provision_lib.wait_instances(provider, region,

@@ -113,18 +113,45 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             return None
         if to_provision is not None:
             task = _pin_task(task, to_provision)
+        from skypilot_tpu.workspaces import context as ws_context
+        workspace = ws_context.get_active()
+        had_record = state.get_cluster_from_name(cluster_name) is not None
+
+        def record_attempt(resources: 'resources_lib.Resources',
+                           config: provision_common.ProvisionConfig
+                           ) -> None:
+            # Provisional handle per attempt: if this process dies
+            # mid-provision (job cancel SIGTERM, OOM), teardown can
+            # still terminate-by-tag in the attempted region.
+            provisional = ClusterHandle(
+                cluster_name, resources, task.num_nodes,
+                provision_common.ClusterInfo(
+                    instances={}, head_instance_id=None,
+                    provider_name=resources.cloud.provisioner_module,
+                    provider_config=dict(config.provider_config)))
+            state.add_or_update_cluster(
+                cluster_name, provisional,
+                requested_resources=task.resources, ready=False,
+                workspace=workspace)
+
         provisioner = failover.RetryingProvisioner(
-            task, cluster_name, task.num_nodes)
+            task, cluster_name, task.num_nodes,
+            attempt_observer=record_attempt)
         if blocked_resources:
             # Pre-seeded blocklist (jobs recovery: eager_next_region
             # skips the preempted region without a failed attempt).
             provisioner.blocked.extend(blocked_resources)
-        result = failover.provision_with_retry_until_up(
-            provisioner, retry_until_up=retry_until_up)
+        try:
+            result = failover.provision_with_retry_until_up(
+                provisioner, retry_until_up=retry_until_up)
+        except Exception:
+            # Nothing launched: drop the provisional record unless it
+            # predates this call (e.g. restarting a stopped cluster).
+            if not had_record:
+                state.remove_cluster(cluster_name, terminate=True)
+            raise
         handle = ClusterHandle(cluster_name, result.resources,
                                result.num_nodes, result.cluster_info)
-        from skypilot_tpu.workspaces import context as ws_context
-        workspace = ws_context.get_active()
         state.add_or_update_cluster(cluster_name, handle,
                                     requested_resources=task.resources,
                                     ready=False, workspace=workspace)

@@ -358,6 +358,18 @@ def serve_up(entrypoint, service_name, yes):
     click.echo(f'Service {name} is up.')
 
 
+@serve.command(name='update')
+@click.argument('service_name')
+@click.argument('entrypoint')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_update(service_name, entrypoint, yes):
+    """Rolling update: new replicas launch, old ones drain when ready."""
+    from skypilot_tpu.client import sdk
+    t = task_lib.Task.from_yaml(entrypoint)
+    version = sdk.serve_update(t, service_name)
+    click.echo(f'Service {service_name} updating to v{version}.')
+
+
 @serve.command(name='status')
 @click.argument('service_names', nargs=-1)
 def serve_status(service_names):

@@ -141,6 +141,12 @@ class RemoteClient:
                              'service_name': service_name})
         return result['service_name']
 
+    def serve_update(self, task, service_name):
+        result = self._call('serve.update',
+                            {'task': task.to_yaml_config(),
+                             'service_name': service_name})
+        return result['version']
+
     def serve_status(self, service_names=None):
         return self._call('serve.status',
                           {'service_names': service_names})

@@ -185,6 +185,15 @@ def serve_up(task: task_lib.Task,
     return serve_core.up(task, service_name)
 
 
+def serve_update(task: task_lib.Task, service_name: str) -> int:
+    """Rolling update of a live service; returns the new version."""
+    remote = _remote()
+    if remote is not None:
+        return remote.serve_update(task, service_name)
+    from skypilot_tpu.serve import core as serve_core
+    return serve_core.update(task, service_name)
+
+
 def serve_status(service_names: Optional[List[str]] = None
                  ) -> List[Dict[str, Any]]:
     remote = _remote()

@@ -94,13 +94,16 @@ def _pid_alive(pid: Optional[int]) -> bool:
         return True
 
 
-def _reconcile_dead_controllers() -> None:
+def _reconcile_dead_controllers() -> List[str]:
     """Release slots held by controllers that died without cleanup.
 
     A SIGKILL/OOM-killed controller never runs its job_done() finally;
     its LAUNCHING/ALIVE row would otherwise count against the caps
     forever and wedge the queue. Caller must hold the scheduler lock.
+    Returns the dead jobs' task-cluster names so the caller can reap
+    them *after* releasing the lock (teardown is slow).
     """
+    orphaned: List[str] = []
     for row in jobs_state.get_jobs():
         if row['schedule_state'] not in (jobs_state.ScheduleState.LAUNCHING,
                                          jobs_state.ScheduleState.ALIVE):
@@ -117,6 +120,24 @@ def _reconcile_dead_controllers() -> None:
                 failure_reason='controller process died')
         jobs_state.set_schedule_state(row['job_id'],
                                       jobs_state.ScheduleState.DONE)
+        if row['cluster_name']:
+            orphaned.append(row['cluster_name'])
+    return orphaned
+
+
+def _reap_clusters(cluster_names: List[str]) -> None:
+    """Best-effort teardown of task clusters orphaned by dead
+    controllers (nothing else will ever down them)."""
+    from skypilot_tpu import core as core_lib
+    from skypilot_tpu import exceptions
+    for name in cluster_names:
+        try:
+            core_lib.down(name, purge=True)
+        except exceptions.ClusterDoesNotExist:
+            pass
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Failed to reap orphaned cluster '
+                           f'{name!r}: {e}')
 
 
 def submit_job(job_id: int) -> None:
@@ -132,21 +153,22 @@ def maybe_schedule_next_jobs() -> None:
     Safe to call from anywhere at any time; does nothing when no slots
     or no waiting jobs. (Twin of sky/jobs/scheduler.py:114.)
     """
+    orphaned: List[str] = []
     try:
         with _lock():
-            _reconcile_dead_controllers()
+            orphaned = _reconcile_dead_controllers()
             while True:
                 counts = jobs_state.schedule_state_counts()
                 launching = counts.get(jobs_state.ScheduleState.LAUNCHING,
                                        0)
                 alive = counts.get(jobs_state.ScheduleState.ALIVE, 0)
                 if launching >= max_launching():
-                    return
+                    break
                 if launching + alive >= max_alive():
-                    return
+                    break
                 job_id = jobs_state.claim_next_waiting()
                 if job_id is None:
-                    return
+                    break
                 logger.info(f'Scheduling managed job {job_id} '
                             f'(launching={launching + 1}, '
                             f'alive={alive})')
@@ -162,6 +184,8 @@ def maybe_schedule_next_jobs() -> None:
     except filelock.Timeout:
         # Another process owns the schedule; it will pick the jobs up.
         logger.debug('Scheduler lock busy; skipping tick.')
+    # Outside the lock: teardown is slow and must not block scheduling.
+    _reap_clusters(orphaned)
 
 
 def launch_done(job_id: int) -> None:
@@ -184,14 +208,18 @@ def acquire_launch_slot(job_id: int,
     """
     deadline = (time.time() + timeout_s) if timeout_s else None
     while True:
+        acquired = False
         with _lock():
-            _reconcile_dead_controllers()
+            orphaned = _reconcile_dead_controllers()
             counts = jobs_state.schedule_state_counts()
             if counts.get(jobs_state.ScheduleState.LAUNCHING,
                           0) < max_launching():
                 jobs_state.set_schedule_state(
                     job_id, jobs_state.ScheduleState.LAUNCHING)
-                return
+                acquired = True
+        _reap_clusters(orphaned)
+        if acquired:
+            return
         if deadline and time.time() > deadline:
             raise TimeoutError(
                 f'No launch slot for job {job_id} after {timeout_s}s')

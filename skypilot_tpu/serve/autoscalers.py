@@ -28,6 +28,20 @@ class Autoscaler:
     def evaluate(self, num_ready_replicas: int) -> AutoscalerDecision:
         return AutoscalerDecision(self.spec.min_replicas)
 
+    def inherit_state(self, old: 'Autoscaler') -> None:
+        """Carry scaling state across a rolling update.
+
+        A `serve update` must not collapse a scaled-up service back to
+        min_replicas: the new autoscaler adopts the old target (clamped
+        to the new spec's bounds) and, when both sides track QPS, the
+        request window — so reconcile_versions drains the old fleet
+        only after a same-sized new fleet is ready.
+        """
+        target = max(self.spec.min_replicas, old.target_num_replicas)
+        if self.spec.max_replicas is not None:
+            target = min(target, self.spec.max_replicas)
+        self.target_num_replicas = target
+
 
 class FixedReplicaAutoscaler(Autoscaler):
     """No autoscaling: hold min_replicas."""
@@ -58,6 +72,11 @@ class RequestRateAutoscaler(Autoscaler):
         self._request_timestamps = [
             t for t in self._request_timestamps if t >= cutoff
         ]
+
+    def inherit_state(self, old: 'Autoscaler') -> None:
+        super().inherit_state(old)
+        if isinstance(old, RequestRateAutoscaler):
+            self._request_timestamps = list(old._request_timestamps)
 
     def current_qps(self) -> float:
         self.collect_request_information(0)

@@ -30,11 +30,13 @@ class SkyServeController:
         record = serve_state.get_service(service_name)
         assert record is not None, service_name
         self.service_name = service_name
+        self.version = record['version']
         task_config = record['task_config']
         self.spec = spec_lib.SkyServiceSpec.from_yaml_config(
             task_config.get('service', {}))
         self.replica_manager = replica_managers.ReplicaManager(
-            service_name, task_config, self.spec)
+            service_name, task_config, self.spec,
+            version=self.version)
         self.autoscaler = autoscalers_lib.make_autoscaler(self.spec)
         self.load_balancer = lb_lib.SkyServeLoadBalancer(
             on_request=lambda: self.autoscaler
@@ -56,7 +58,30 @@ class SkyServeController:
                 logger.warning(f'controller tick failed: {e}')
             self._stop.wait(CONTROLLER_INTERVAL_S)
 
+    def _maybe_adopt_new_version(self) -> None:
+        """Pick up `serve update`: reload spec + task at the new version.
+
+        The rolling semantics live in the replica manager — new-version
+        replicas launch alongside the old fleet, which drains only after
+        the new one passes readiness (reconcile_versions in _tick).
+        """
+        record = serve_state.get_service(self.service_name)
+        if record is None or record['version'] == self.version:
+            return
+        self.version = record['version']
+        task_config = record['task_config']
+        self.spec = spec_lib.SkyServiceSpec.from_yaml_config(
+            task_config.get('service', {}))
+        new_autoscaler = autoscalers_lib.make_autoscaler(self.spec)
+        new_autoscaler.inherit_state(self.autoscaler)
+        self.autoscaler = new_autoscaler
+        self.replica_manager.apply_update(task_config, self.spec,
+                                          self.version)
+        logger.info(f'Service {self.service_name}: rolling update to '
+                    f'v{self.version}.')
+
     def _tick(self) -> None:
+        self._maybe_adopt_new_version()
         manager = self.replica_manager
         ready = manager.probe_all()
         if ready == 0 and \
@@ -75,6 +100,7 @@ class SkyServeController:
         manager.recover_preempted()
         decision = self.autoscaler.evaluate(ready)
         manager.scale_to(decision.target_num_replicas)
+        manager.reconcile_versions(decision.target_num_replicas)
         self.load_balancer.set_ready_replicas(manager.ready_endpoints())
         if ready > 0:
             serve_state.set_service_status(

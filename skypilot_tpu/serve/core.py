@@ -52,6 +52,44 @@ def up(task: task_lib.Task, service_name: Optional[str] = None,
     return name
 
 
+def update(task: task_lib.Task, service_name: str,
+           wait_done: bool = False, timeout_s: float = 120.0) -> int:
+    """Rolling update to a new task version (twin of `sky serve update`).
+
+    New-version replicas launch alongside the old fleet; old replicas
+    keep serving and drain only after >= target new replicas are READY
+    — traffic never drops. Returns the new version.
+
+    Async by default (like the reference): the version bump is durable
+    once this returns and the controller rolls in the background; pass
+    wait_done=True to block until the old fleet has drained (replica
+    provisioning on real clouds routinely exceeds small timeouts).
+    """
+    if task.service is None:
+        raise ValueError("Task has no 'service:' section.")
+    record = serve_state.get_service(service_name)
+    if record is None:
+        raise ValueError(f'Service {service_name!r} not found.')
+    new_version = serve_state.bump_service_version(service_name,
+                                                   task.to_yaml_config())
+    if wait_done:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            replicas = serve_state.get_replicas(service_name)
+            ready_new = [r for r in replicas
+                         if r['version'] == new_version and
+                         r['status'] == serve_state.ReplicaStatus.READY]
+            old_left = [r for r in replicas
+                        if r['version'] < new_version]
+            if ready_new and not old_left:
+                return new_version
+            time.sleep(0.3)
+        raise TimeoutError(
+            f'Update of {service_name} to v{new_version} not complete '
+            f'in {timeout_s}s')
+    return new_version
+
+
 def status(service_names: Optional[List[str]] = None
            ) -> List[Dict[str, Any]]:
     records = serve_state.get_services()
@@ -63,11 +101,13 @@ def status(service_names: Optional[List[str]] = None
         out.append({
             'name': r['name'],
             'status': r['status'].value,
+            'version': r['version'],
             'endpoint': f"127.0.0.1:{r['lb_port']}",
             'replicas': [{
                 'replica_id': rep['replica_id'],
                 'status': rep['status'].value,
                 'endpoint': rep['endpoint'],
+                'version': rep['version'],
             } for rep in replicas],
         })
     return out

@@ -30,12 +30,21 @@ def _free_port() -> int:
 class ReplicaManager:
 
     def __init__(self, service_name: str, task_config: Dict[str, Any],
-                 spec: spec_lib.SkyServiceSpec) -> None:
+                 spec: spec_lib.SkyServiceSpec,
+                 version: int = 1) -> None:
         self.service_name = service_name
         self.task_config = dict(task_config)
         self.task_config.pop('service', None)
         self.spec = spec
-        self._next_replica_id = 1
+        # Rolling-update state: replicas are stamped with the version
+        # they were launched at (twin of ReplicaInfo.version,
+        # sky/serve/replica_managers.py:388); scale decisions apply to
+        # the current version, old versions drain after the new fleet
+        # is ready.
+        self.version = version
+        existing = serve_state.get_replicas(service_name)
+        self._next_replica_id = 1 + max(
+            [r['replica_id'] for r in existing], default=0)
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=4, thread_name_prefix=f'replica-{service_name}')
         self._launching: Dict[int, concurrent.futures.Future] = {}
@@ -55,29 +64,72 @@ class ReplicaManager:
     def replicas(self) -> List[Dict[str, Any]]:
         return serve_state.get_replicas(self.service_name)
 
-    def active_count(self) -> int:
-        active = [
-            r for r in self.replicas()
-            if r['status'] not in (serve_state.ReplicaStatus.FAILED,
+    def apply_update(self, task_config: Dict[str, Any],
+                     spec: spec_lib.SkyServiceSpec, version: int) -> None:
+        """Adopt a new service version (rolling update entry point)."""
+        self.task_config = dict(task_config)
+        self.task_config.pop('service', None)
+        self.spec = spec
+        self.version = version
+        self.launch_failures = 0
+
+    def _is_active(self, r: Dict[str, Any]) -> bool:
+        return r['status'] not in (serve_state.ReplicaStatus.FAILED,
                                    serve_state.ReplicaStatus.PREEMPTED,
                                    serve_state.ReplicaStatus.SHUTTING_DOWN)
-        ]
-        return len(active)
+
+    def active_count(self, version: Optional[int] = None) -> int:
+        return len([
+            r for r in self.replicas() if self._is_active(r) and
+            (version is None or r['version'] == version)
+        ])
 
     def scale_to(self, target: int) -> None:
+        """Launch/terminate current-version replicas toward target.
+
+        Old-version replicas are untouched here — they keep serving
+        until reconcile_versions() drains them, so an update never drops
+        below the pre-update capacity.
+        """
         with self._lock:
-            current = self.active_count()
+            current = self.active_count(version=self.version)
             for _ in range(max(0, target - current)):
                 self._start_replica()
             if current > target:
                 # Terminate youngest non-ready first, then youngest ready.
                 candidates = sorted(
-                    [r for r in self.replicas() if r['status'] not in
+                    [r for r in self.replicas()
+                     if r['version'] == self.version and r['status'] not in
                      (serve_state.ReplicaStatus.SHUTTING_DOWN,)],
                     key=lambda r: (
                         r['status'] == serve_state.ReplicaStatus.READY,
                         -r['replica_id']))
                 for r in candidates[:current - target]:
+                    self.terminate_replica(r['replica_id'])
+
+    def reconcile_versions(self, target: int) -> None:
+        """Drain old-version replicas once the new fleet is ready.
+
+        (Twin of the reference's rolling update: old replicas terminate
+        only after >= target new-version replicas pass readiness.)
+        """
+        old = [r for r in self.replicas()
+               if r['version'] < self.version and
+               r['status'] != serve_state.ReplicaStatus.SHUTTING_DOWN]
+        if not old:
+            return
+        ready_new = len([
+            r for r in self.replicas()
+            if r['version'] == self.version and
+            r['status'] == serve_state.ReplicaStatus.READY
+        ])
+        if ready_new >= max(1, target):
+            with self._lock:
+                for r in old:
+                    logger.info(
+                        f'Rolling update: draining replica '
+                        f'{r["replica_id"]} (v{r["version"]} -> '
+                        f'v{self.version}).')
                     self.terminate_replica(r['replica_id'])
 
     def _start_replica(self) -> int:
@@ -86,13 +138,15 @@ class ReplicaManager:
         cluster_name = f'xsky-serve-{self.service_name}-{replica_id}'
         serve_state.upsert_replica(self.service_name, replica_id,
                                    cluster_name,
-                                   serve_state.ReplicaStatus.PROVISIONING)
+                                   serve_state.ReplicaStatus.PROVISIONING,
+                                   version=self.version)
         future = self._pool.submit(self._launch_replica, replica_id,
-                                   cluster_name)
+                                   cluster_name, self.version)
         self._launching[replica_id] = future
         return replica_id
 
-    def _launch_replica(self, replica_id: int, cluster_name: str) -> None:
+    def _launch_replica(self, replica_id: int, cluster_name: str,
+                        version: int) -> None:
         try:
             from skypilot_tpu import execution
             task = task_lib.Task.from_yaml_config(self.task_config)
@@ -122,13 +176,14 @@ class ReplicaManager:
             serve_state.upsert_replica(
                 self.service_name, replica_id, cluster_name,
                 serve_state.ReplicaStatus.STARTING,
-                endpoint=f'{host}:{port}')
+                endpoint=f'{host}:{port}', version=version)
         except Exception as e:  # pylint: disable=broad-except
             logger.warning(f'Replica {replica_id} launch failed: {e}')
             self.launch_failures += 1
             serve_state.upsert_replica(self.service_name, replica_id,
                                        cluster_name,
-                                       serve_state.ReplicaStatus.FAILED)
+                                       serve_state.ReplicaStatus.FAILED,
+                                       version=version)
 
     def terminate_replica(self, replica_id: int) -> None:
         record = next((r for r in self.replicas()

@@ -45,7 +45,8 @@ def _db() -> sqlite3.Connection:
             status TEXT,
             controller_pid INTEGER,
             lb_port INTEGER,
-            created_at REAL
+            created_at REAL,
+            version INTEGER DEFAULT 1
         );
         CREATE TABLE IF NOT EXISTS replicas (
             service_name TEXT,
@@ -54,8 +55,15 @@ def _db() -> sqlite3.Connection:
             status TEXT,
             endpoint TEXT,
             launched_at REAL,
+            version INTEGER DEFAULT 1,
             PRIMARY KEY (service_name, replica_id)
         )""")
+    for table in ('services', 'replicas'):
+        try:
+            conn.execute(f'ALTER TABLE {table} ADD COLUMN '
+                         'version INTEGER DEFAULT 1')
+        except sqlite3.OperationalError:
+            pass  # column exists
     conn.commit()
     return conn
 
@@ -74,6 +82,24 @@ def add_service(name: str, task_config: Dict[str, Any],
              ServiceStatus.CONTROLLER_INIT.value, lb_port, time.time()))
         conn.commit()
         conn.close()
+
+
+def bump_service_version(name: str, task_config: Dict[str, Any]) -> int:
+    """Install a new task config as the service's next version
+    (twin of sky/serve update: ReplicaInfo.version,
+    sky/serve/replica_managers.py:388). Returns the new version."""
+    with _lock:
+        conn = _db()
+        conn.execute(
+            'UPDATE services SET task_config=?, version=version+1 '
+            'WHERE name=?', (json.dumps(task_config), name))
+        conn.commit()
+        row = conn.execute('SELECT version FROM services WHERE name=?',
+                           (name,)).fetchone()
+        conn.close()
+    if row is None:
+        raise ValueError(f'Service {name!r} not found.')
+    return row[0]
 
 
 def set_service_status(name: str, status: ServiceStatus) -> None:
@@ -121,7 +147,7 @@ def remove_service(name: str) -> None:
 
 
 def _service_dict(row) -> Dict[str, Any]:
-    name, task_config, status, pid, lb_port, created_at = row
+    name, task_config, status, pid, lb_port, created_at, version = row
     return {
         'name': name,
         'task_config': json.loads(task_config or '{}'),
@@ -129,6 +155,7 @@ def _service_dict(row) -> Dict[str, Any]:
         'controller_pid': pid,
         'lb_port': lb_port,
         'created_at': created_at,
+        'version': version or 1,
     }
 
 
@@ -137,17 +164,19 @@ def _service_dict(row) -> Dict[str, Any]:
 
 def upsert_replica(service_name: str, replica_id: int, cluster_name: str,
                    status: ReplicaStatus,
-                   endpoint: Optional[str] = None) -> None:
+                   endpoint: Optional[str] = None,
+                   version: int = 1) -> None:
     with _lock:
         conn = _db()
         conn.execute(
             'INSERT INTO replicas (service_name, replica_id, cluster_name,'
-            ' status, endpoint, launched_at) VALUES (?, ?, ?, ?, ?, ?) '
+            ' status, endpoint, launched_at, version) '
+            'VALUES (?, ?, ?, ?, ?, ?, ?) '
             'ON CONFLICT(service_name, replica_id) DO UPDATE SET '
             'status=excluded.status, '
             'endpoint=COALESCE(excluded.endpoint, replicas.endpoint)',
             (service_name, replica_id, cluster_name, status.value,
-             endpoint, time.time()))
+             endpoint, time.time(), version))
         conn.commit()
         conn.close()
 
@@ -176,4 +205,5 @@ def get_replicas(service_name: str) -> List[Dict[str, Any]]:
         'status': ReplicaStatus(r[3]),
         'endpoint': r[4],
         'launched_at': r[5],
+        'version': r[6] or 1,
     } for r in rows]

@@ -23,7 +23,7 @@ from skypilot_tpu.server import requests_db
 logger = sky_logging.init_logger(__name__)
 
 LONG_REQUESTS = {'launch', 'exec', 'start', 'stop', 'down', 'jobs.launch',
-                 'serve.up', 'serve.down'}
+                 'serve.up', 'serve.update', 'serve.down'}
 
 _pools_lock = threading.Lock()
 _long_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None

@@ -135,6 +135,16 @@ def _serve_up(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
     return run, {'service_name': body.get('service_name')}
 
 
+def _serve_update(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
+    from skypilot_tpu.serve import core as serve_core
+    task = _task_from_body(body)
+
+    def run(**kwargs):
+        return {'version': serve_core.update(task, **kwargs)}
+
+    return run, {'service_name': _require(body, 'service_name')}
+
+
 def _serve_verb(fn_name: str, *fields):
     def resolver(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
         from skypilot_tpu.serve import core as serve_core
@@ -163,6 +173,7 @@ _VERBS.update({
     'jobs.cancel': _jobs_verb('cancel', 'job_id'),
     'jobs.logs': _jobs_verb('tail_logs', 'job_id'),
     'serve.up': _serve_up,
+    'serve.update': _serve_update,
     'serve.status': lambda body: (
         __import__('skypilot_tpu.serve.core', fromlist=['status']).status,
         {'service_names': body.get('service_names')}),

