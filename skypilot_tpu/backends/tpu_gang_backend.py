@@ -145,10 +145,26 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             result = failover.provision_with_retry_until_up(
                 provisioner, retry_until_up=retry_until_up)
         except Exception:
-            # Nothing launched: drop the provisional record unless it
-            # predates this call (e.g. restarting a stopped cluster).
+            # The last attempt may have created instances before dying
+            # (e.g. wait_instances timeout): terminate-by-tag via the
+            # provisional handle before dropping the record, so nothing
+            # keeps billing with no record pointing at it. Records that
+            # predate this call (restarting a stopped cluster) are kept.
             if not had_record:
-                state.remove_cluster(cluster_name, terminate=True)
+                leftover = state.get_cluster_from_name(cluster_name)
+                if leftover is not None and \
+                        leftover['handle'] is not None:
+                    try:
+                        self.teardown(leftover['handle'], terminate=True,
+                                      purge=True)
+                    except Exception as cleanup_err:  # pylint: disable=broad-except
+                        logger.warning(
+                            f'Cleanup after failed provision of '
+                            f'{cluster_name!r} failed: {cleanup_err}')
+                        state.remove_cluster(cluster_name,
+                                             terminate=True)
+                else:
+                    state.remove_cluster(cluster_name, terminate=True)
             raise
         handle = ClusterHandle(cluster_name, result.resources,
                                result.num_nodes, result.cluster_info)
@@ -464,6 +480,32 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             f'tail {job_id}',
             env=self._agent_env(handle), require_outputs=True)
         return out
+
+    def sync_down_logs(self, handle: ClusterHandle,
+                       job_id: Optional[int] = None,
+                       local_dir: Optional[str] = None) -> str:
+        """Copy job log directories from the head host to local disk.
+
+        Twin of `sky logs --sync-down`
+        (sky/backends/cloud_vm_ray_backend.py:3856). Pulls
+        ``<runtime_root>/logs/job-<id>`` (or every job dir when job_id
+        is None) into ``<local_dir>/<cluster>/``; returns the local
+        path.
+        """
+        local_dir = os.path.expanduser(
+            local_dir or f'~/.xsky/sync_down_logs/{handle.cluster_name}')
+        os.makedirs(local_dir, exist_ok=True)
+        head = handle.head_runner()
+        # Home-relative remote path: consistent across runner flavors
+        # (local host-root, ssh $HOME, k8s /root). Runner convention:
+        # source=local, target=remote, for both directions.
+        remote_logs = '.xsky/logs'
+        if job_id is not None:
+            head.rsync(os.path.join(local_dir, f'job-{job_id}'),
+                       f'{remote_logs}/job-{job_id}/', up=False)
+        else:
+            head.rsync(local_dir, f'{remote_logs}/', up=False)
+        return local_dir
 
     # ---- teardown / autostop ----
 

@@ -233,9 +233,16 @@ def queue(cluster):
 @cli.command()
 @click.argument('cluster')
 @click.argument('job_id', type=int, required=False)
-def logs(cluster, job_id):
-    """Print a job's logs."""
+@click.option('--sync-down', is_flag=True, default=False,
+              help='Download the job log directories instead of '
+                   'printing (to ~/.xsky/sync_down_logs/<cluster>).')
+def logs(cluster, job_id, sync_down):
+    """Print (or download) a job's logs."""
     from skypilot_tpu.client import sdk
+    if sync_down:
+        path = sdk.sync_down_logs(cluster, job_id)
+        click.echo(f'Logs synced to {path}')
+        return
     click.echo(sdk.tail_logs(cluster, job_id), nl=False)
 
 

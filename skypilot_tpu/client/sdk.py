@@ -13,6 +13,7 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 
 from skypilot_tpu import config as config_lib
 from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
 from skypilot_tpu import task as task_lib
 
 
@@ -120,6 +121,22 @@ def tail_logs(cluster_name: str, job_id: Optional[int] = None,
               follow: bool = False) -> str:
     return _local_or_remote('tail_logs', cluster_name, job_id=job_id,
                             follow=follow)
+
+
+def sync_down_logs(cluster_name: str, job_id: Optional[int] = None,
+                   local_dir: Optional[str] = None) -> str:
+    """Download a cluster's job logs to this machine."""
+    remote = _remote()
+    if remote is not None:
+        # File transfer to the *client* machine needs direct runner
+        # access; the API server only relays JSON. Run against a local
+        # server (xsky api start) or unset the remote endpoint.
+        raise exceptions.NotSupportedError(
+            'logs --sync-down is not supported through a remote API '
+            'server; run it on the API-server host.')
+    from skypilot_tpu import core as core_lib
+    return core_lib.sync_down_logs(cluster_name, job_id=job_id,
+                                   local_dir=local_dir)
 
 
 def check(quiet: bool = False) -> Dict[str, Any]:

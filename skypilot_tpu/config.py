@@ -81,9 +81,13 @@ def _layer_paths() -> List[str]:
 def reload_config() -> None:
     global _base_config, _loaded
     with _lock:
+        from skypilot_tpu.utils import schemas
         config: Dict[str, Any] = {}
         for path in _layer_paths():
-            config = merge_dicts(config, _load_yaml_file(path))
+            layer = _load_yaml_file(path)
+            if layer:
+                schemas.validate_config(layer, source=path)
+            config = merge_dicts(config, layer)
         _base_config = config
         _loaded = True
 

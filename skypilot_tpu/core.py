@@ -185,6 +185,15 @@ def tail_logs(cluster_name: str, job_id: Optional[int] = None,
     return _backend().tail_logs(record['handle'], job_id, follow=follow)
 
 
+def sync_down_logs(cluster_name: str, job_id: Optional[int] = None,
+                   local_dir: Optional[str] = None) -> str:
+    """Download job logs from a cluster; returns the local directory
+    (twin of `sky logs --sync-down`)."""
+    record = _get_handle(cluster_name)
+    return _backend().sync_down_logs(record['handle'], job_id=job_id,
+                                     local_dir=local_dir)
+
+
 def check(quiet: bool = False) -> Dict[str, Any]:
     """Probe credentials; persist enabled clouds (twin of sky check)."""
     results = check_lib.check_capabilities(quiet=quiet)

@@ -55,6 +55,16 @@ class InvalidSkyTpuConfigError(SkyTpuError):
     """Config file failed schema validation."""
 
 
+class InvalidSchemaError(InvalidSkyTpuConfigError, ValueError):
+    """User YAML (task or config) failed schema validation.
+
+    Message is one actionable line per problem, naming the bad key
+    (twin of the reference's jsonschema layer, sky/utils/schemas.py).
+    Subclasses InvalidSkyTpuConfigError so existing config-error
+    handlers catch schema failures too.
+    """
+
+
 # --- Provisioning / failover taxonomy --------------------------------------
 # The failover engine classifies provisioning failures into these buckets to
 # decide the retry scope (twin of the reference's FailoverCloudErrorHandlerV2,

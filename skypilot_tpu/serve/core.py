@@ -25,6 +25,16 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
 def up(task: task_lib.Task, service_name: Optional[str] = None,
        wait_ready: bool = True, timeout_s: float = 120.0) -> str:
     if task.service is None:
@@ -70,6 +80,18 @@ def update(task: task_lib.Task, service_name: str,
     record = serve_state.get_service(service_name)
     if record is None:
         raise ValueError(f'Service {service_name!r} not found.')
+    if record['status'] in (serve_state.ServiceStatus.FAILED,
+                            serve_state.ServiceStatus.SHUTTING_DOWN):
+        raise ValueError(
+            f'Service {service_name!r} is {record["status"].value}; its '
+            'controller is no longer rolling updates. Tear it down '
+            '(`serve down`) and `serve up` the new version instead.')
+    pid = record['controller_pid']
+    if pid and not _pid_alive(pid):
+        raise ValueError(
+            f'Service {service_name!r} controller (pid {pid}) is dead; '
+            'no process would apply the update. `serve down` and '
+            '`serve up` the new version instead.')
     new_version = serve_state.bump_service_version(service_name,
                                                    task.to_yaml_config())
     if wait_done:

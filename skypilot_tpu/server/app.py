@@ -21,6 +21,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.server import executor
+from skypilot_tpu.server import metrics
 from skypilot_tpu.server import payloads
 from skypilot_tpu.server import requests_db
 
@@ -70,6 +71,8 @@ class _Handler(BaseHTTPRequestHandler):
         logger.debug('%s - %s' % (self.address_string(), fmt % args))
 
     def _send(self, code: int, payload: Dict[str, Any]) -> None:
+        metrics.observe_http(
+            urllib.parse.urlparse(self.path).path, code)
         data = json.dumps(payload, default=str).encode()
         self.send_response(code)
         self.send_header('Content-Type', 'application/json')
@@ -92,6 +95,16 @@ class _Handler(BaseHTTPRequestHandler):
         if parsed.path == '/health':
             self._send(200, {'status': 'healthy',
                              'api_version': API_VERSION})
+        elif parsed.path == '/metrics':
+            # Prometheus text exposition (twin of sky/server/metrics.py).
+            data = metrics.render().encode()
+            metrics.observe_http('/metrics', 200)
+            self.send_response(200)
+            self.send_header('Content-Type',
+                             'text/plain; version=0.0.4; charset=utf-8')
+            self.send_header('Content-Length', str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
         elif parsed.path in ('/', '/dashboard', '/dashboard/'):
             from skypilot_tpu import dashboard
             data = dashboard.index_html()

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import threading
+import time
 import traceback
 from typing import Any, Callable, Dict, Optional
 
@@ -49,18 +50,24 @@ def _pools():
 
 def _run_request(request_id: str, func: Callable[..., Any],
                  kwargs: Dict[str, Any]) -> None:
+    from skypilot_tpu.server import metrics
     record = requests_db.get(request_id)
     if record is None or record['status'].is_terminal():
         return  # cancelled before start
     requests_db.set_status(request_id, requests_db.RequestStatus.RUNNING)
+    start = time.monotonic()
     try:
         result = func(**kwargs)
         requests_db.finish(request_id, result=result)
+        metrics.observe_request(record['name'], 'succeeded',
+                                time.monotonic() - start)
     except Exception as e:  # pylint: disable=broad-except
         logger.info(f'Request {record["name"]} failed: {e}\n'
                     f'{traceback.format_exc()}')
         requests_db.finish(request_id,
                            error=exceptions.serialize_exception(e))
+        metrics.observe_request(record['name'], 'failed',
+                                time.monotonic() - start)
 
 
 def schedule_request(name: str, user: str, body: Dict[str, Any],

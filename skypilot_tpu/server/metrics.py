@@ -1,0 +1,122 @@
+"""Prometheus-format metrics for the API server.
+
+Twin of sky/server/metrics.py:19-35 (prometheus_client counters +
+histograms on every endpoint) — rendered by hand in the text exposition
+format so the stdlib-only server stays dependency-free.
+
+Exposed at GET /metrics:
+  * xsky_http_requests_total{path,code}
+  * xsky_requests_total{verb,status}          (executor verbs)
+  * xsky_request_duration_seconds{verb}       (histogram)
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple
+
+_lock = threading.Lock()
+
+_http_requests: Dict[Tuple[str, int], int] = {}
+_verb_requests: Dict[Tuple[str, str], int] = {}
+_BUCKETS = (0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, float('inf'))
+_verb_duration_buckets: Dict[str, List[int]] = {}
+_verb_duration_sum: Dict[str, float] = {}
+_verb_duration_count: Dict[str, int] = {}
+
+
+# Known routes; anything else buckets under '<other>' so scanners can't
+# grow label cardinality without bound (or corrupt the exposition with
+# quotes/newlines in the path).
+_KNOWN_PATHS = frozenset({
+    '/health', '/metrics', '/', '/dashboard', '/dashboard/',
+    '/api/get', '/api/requests', '/api/cancel', '/tunnel',
+})
+
+
+def _normalize_path(path: str) -> str:
+    if path in _KNOWN_PATHS:
+        return path
+    if path.startswith('/api/') and path[5:].replace('.', '').replace(
+            '_', '').isalnum():
+        return path  # verb routes: /api/launch, /api/jobs.queue, ...
+    return '<other>'
+
+
+def _escape_label(value: str) -> str:
+    return value.replace('\\', r'\\').replace('"', r'\"').replace(
+        '\n', r'\n')
+
+
+def observe_http(path: str, code: int) -> None:
+    """Count one HTTP request (path should be the route, not raw URL)."""
+    with _lock:
+        key = (_normalize_path(path), code)
+        _http_requests[key] = _http_requests.get(key, 0) + 1
+
+
+def observe_request(verb: str, status: str, duration_s: float) -> None:
+    """Count one executor request with its end-to-end duration."""
+    with _lock:
+        key = (verb, status)
+        _verb_requests[key] = _verb_requests.get(key, 0) + 1
+        buckets = _verb_duration_buckets.setdefault(
+            verb, [0] * len(_BUCKETS))
+        for i, le in enumerate(_BUCKETS):
+            if duration_s <= le:
+                buckets[i] += 1
+        _verb_duration_sum[verb] = (
+            _verb_duration_sum.get(verb, 0.0) + duration_s)
+        _verb_duration_count[verb] = (
+            _verb_duration_count.get(verb, 0) + 1)
+
+
+def reset_for_test() -> None:
+    with _lock:
+        _http_requests.clear()
+        _verb_requests.clear()
+        _verb_duration_buckets.clear()
+        _verb_duration_sum.clear()
+        _verb_duration_count.clear()
+
+
+def _fmt_le(le: float) -> str:
+    return '+Inf' if le == float('inf') else f'{le:g}'
+
+
+def render() -> str:
+    """Text exposition format (version 0.0.4)."""
+    with _lock:
+        lines = [
+            '# HELP xsky_http_requests_total HTTP requests by route/code.',
+            '# TYPE xsky_http_requests_total counter',
+        ]
+        for (path, code), n in sorted(_http_requests.items()):
+            lines.append(
+                f'xsky_http_requests_total{{path="{_escape_label(path)}",'
+                f'code="{code}"}} {n}')
+        lines += [
+            '# HELP xsky_requests_total Executor requests by verb/status.',
+            '# TYPE xsky_requests_total counter',
+        ]
+        for (verb, status), n in sorted(_verb_requests.items()):
+            lines.append(
+                f'xsky_requests_total{{verb="{_escape_label(verb)}",'
+                f'status="{status}"}} {n}')
+        lines += [
+            '# HELP xsky_request_duration_seconds Executor request '
+            'duration.',
+            '# TYPE xsky_request_duration_seconds histogram',
+        ]
+        for verb in sorted(_verb_duration_buckets):
+            for i, le in enumerate(_BUCKETS):
+                lines.append(
+                    f'xsky_request_duration_seconds_bucket{{verb="{verb}"'
+                    f',le="{_fmt_le(le)}"}} '
+                    f'{_verb_duration_buckets[verb][i]}')
+            lines.append(
+                f'xsky_request_duration_seconds_sum{{verb="{verb}"}} '
+                f'{_verb_duration_sum[verb]:.6f}')
+            lines.append(
+                f'xsky_request_duration_seconds_count{{verb="{verb}"}} '
+                f'{_verb_duration_count[verb]}')
+        return '\n'.join(lines) + '\n'

@@ -173,6 +173,8 @@ class Task:
                          env_overrides: Optional[Dict[str, str]] = None,
                          secret_overrides: Optional[Dict[str, str]] = None
                          ) -> 'Task':
+        from skypilot_tpu.utils import schemas
+        schemas.validate_task_config(config)
         config = dict(config or {})
         envs = dict(config.pop('envs', None) or {})
         secrets = dict(config.pop('secrets', None) or {})

@@ -155,13 +155,13 @@ class LocalProcessCommandRunner(CommandRunner):
                                 stderr=subprocess.STDOUT)
 
     def rsync(self, source: str, target: str, *, up: bool, excludes=None):
-        source = os.path.expanduser(source)
-        if up:
-            target = os.path.join(self.host_root, target.lstrip('/'))
-        else:
-            source = os.path.join(self.host_root, source.lstrip('/'))
-        os.makedirs(os.path.dirname(target.rstrip('/')) or '/', exist_ok=True)
-        _local_sync(source, target, excludes or [])
+        # Same convention as every runner: `source` is the LOCAL path,
+        # `target` the remote one, regardless of direction.
+        local = os.path.expanduser(source)
+        remote = os.path.join(self.host_root, target.lstrip('/'))
+        src, dst = (local, remote) if up else (remote, local)
+        os.makedirs(os.path.dirname(dst.rstrip('/')) or '/', exist_ok=True)
+        _local_sync(src, dst, excludes or [])
 
 
 class SSHCommandRunner(CommandRunner):

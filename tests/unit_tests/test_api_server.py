@@ -118,3 +118,85 @@ class TestRemoteSdk:
         monkeypatch.setenv('XSKY_API_SERVER', api_server)
         result = sdk.check()
         assert result['fake']['enabled'] is True
+
+
+class TestMetrics:
+    """Prometheus /metrics endpoint (twin of sky/server/metrics.py)."""
+
+    def test_scrape_counts_requests(self, api_server, client):
+        from skypilot_tpu.server import metrics as metrics_lib
+        metrics_lib.reset_for_test()
+        client.status()   # one executor verb
+        _get_json(f'{api_server}/health')
+        with urllib.request.urlopen(f'{api_server}/metrics') as resp:
+            assert resp.status == 200
+            assert 'text/plain' in resp.headers['Content-Type']
+            body = resp.read().decode()
+        assert 'xsky_http_requests_total{path="/health",code="200"}' \
+            in body
+        assert 'xsky_requests_total{verb="status",status="succeeded"}' \
+            in body
+        assert 'xsky_request_duration_seconds_bucket{verb="status"' \
+            in body
+        assert 'xsky_request_duration_seconds_count{verb="status"} 1' \
+            in body
+
+    def test_scrape_is_prometheus_parseable(self, api_server, client):
+        """Every non-comment line is `name{labels} value`."""
+        import re
+        client.status()
+        with urllib.request.urlopen(f'{api_server}/metrics') as resp:
+            body = resp.read().decode()
+        pat = re.compile(
+            r'^[a-z_]+(\{[a-zA-Z_]+="[^"]*"(,[a-zA-Z_]+="[^"]*")*\})? '
+            r'[0-9.+eInf-]+$')
+        for line in body.strip().splitlines():
+            if line.startswith('#'):
+                continue
+            assert pat.match(line), line
+
+
+class TestSyncDownLogs:
+
+    def test_sync_down_after_job(self, fake_cluster_env):
+        from skypilot_tpu import Resources, Task, core, execution
+        task = Task('sdl', run='echo sync-down-payload')
+        task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        job_id, handle = execution.launch(task, cluster_name='sdl-c')
+        import time as time_lib
+        from skypilot_tpu.backends import tpu_gang_backend
+        backend = tpu_gang_backend.TpuGangBackend()
+        deadline = time_lib.time() + 30
+        while time_lib.time() < deadline:
+            st = backend.get_job_status(handle, job_id)
+            if st is not None and st.is_terminal():
+                break
+            time_lib.sleep(0.3)
+        import os
+        local = core.sync_down_logs(
+            'sdl-c', local_dir=os.path.join(
+                os.environ['XSKY_FAKE_CLOUD_DIR'], 'pulled'))
+        job_dirs = [d for d in os.listdir(local)
+                    if d.startswith('job-')]
+        assert job_dirs, os.listdir(local)
+        found = False
+        for root, _, files in os.walk(local):
+            for f in files:
+                with open(os.path.join(root, f), 'rb') as fh:
+                    if b'sync-down-payload' in fh.read():
+                        found = True
+        assert found, 'job output not in synced logs'
+        core.down('sdl-c', purge=True)
+
+    def test_hostile_path_cannot_corrupt_exposition(self, api_server):
+        import http.client
+        # Raw request line with quotes/braces in the path.
+        host = api_server.split('//')[1]
+        conn = http.client.HTTPConnection(host, timeout=10)
+        conn.request('GET', '/a"b}{\\weird')
+        conn.getresponse().read()
+        conn.close()
+        with urllib.request.urlopen(f'{api_server}/metrics') as resp:
+            body = resp.read().decode()
+        assert '/a"b' not in body
+        assert 'path="<other>"' in body

@@ -1,0 +1,196 @@
+"""Schema validation: top user typos must produce one-line messages
+naming the bad key (twin of sky/utils/schemas.py coverage)."""
+import textwrap
+
+import pytest
+import yaml
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.utils import schemas
+
+
+def _task_err(config):
+    with pytest.raises(exceptions.InvalidSchemaError) as exc:
+        task_lib.Task.from_yaml_config(config)
+    return str(exc.value)
+
+
+class TestTaskTypos:
+    """The top-10 user typos, each expected to name the bad key."""
+
+    def test_setupp(self):
+        msg = _task_err({'setupp': 'pip install x', 'run': 'echo'})
+        assert "unknown field 'setupp'" in msg
+        assert "did you mean 'setup'" in msg
+
+    def test_runn(self):
+        msg = _task_err({'runn': 'echo'})
+        assert "unknown field 'runn'" in msg
+        assert "did you mean 'run'" in msg
+
+    def test_resource_singular(self):
+        msg = _task_err({'resource': {'cpus': 4}})
+        assert "unknown field 'resource'" in msg
+        assert "did you mean 'resources'" in msg
+
+    def test_env_singular(self):
+        msg = _task_err({'env': {'A': '1'}, 'run': 'echo'})
+        assert "unknown field 'env'" in msg
+        assert "did you mean 'envs'" in msg
+
+    def test_accelerator_singular(self):
+        msg = _task_err(
+            {'resources': {'accelerator': 'tpu-v5e-8'}, 'run': 'echo'})
+        assert "unknown field 'accelerator'" in msg
+        assert "did you mean 'accelerators'" in msg
+        assert 'resources' in msg
+
+    def test_spot_instead_of_use_spot(self):
+        msg = _task_err({'resources': {'spot': True}, 'run': 'echo'})
+        assert "unknown field 'spot'" in msg
+
+    def test_nodes_instead_of_num_nodes(self):
+        msg = _task_err({'nodes': 4, 'run': 'echo'})
+        assert "unknown field 'nodes'" in msg
+        assert "did you mean 'num_nodes'" in msg
+
+    def test_filemounts(self):
+        msg = _task_err({'filemounts': {'/x': '.'}, 'run': 'echo'})
+        assert "unknown field 'filemounts'" in msg
+        assert "did you mean 'file_mounts'" in msg
+
+    def test_workdirr(self):
+        msg = _task_err({'workdirr': '.', 'run': 'echo'})
+        assert "unknown field 'workdirr'" in msg
+        assert "did you mean 'workdir'" in msg
+
+    def test_service_replica_typo(self):
+        msg = _task_err({
+            'run': 'echo',
+            'service': {
+                'readiness_probe': '/',
+                'replica_policy': {'min_replica': 1},
+            },
+        })
+        assert "unknown field 'min_replica'" in msg
+        assert "did you mean 'min_replicas'" in msg
+
+
+class TestTaskTypes:
+
+    def test_num_nodes_string(self):
+        msg = _task_err({'num_nodes': 'four', 'run': 'echo'})
+        assert 'num_nodes' in msg
+        assert 'expected integer' in msg
+
+    def test_run_list(self):
+        msg = _task_err({'run': ['echo a', 'echo b']})
+        assert 'run' in msg
+        assert 'expected string' in msg
+
+    def test_disk_tier_enum(self):
+        msg = _task_err(
+            {'resources': {'disk_tier': 'extreme'}, 'run': 'echo'})
+        assert 'disk_tier' in msg
+        assert 'allowed' in msg
+
+    def test_mount_mode_enum(self):
+        msg = _task_err({
+            'run': 'echo',
+            'file_mounts': {'/data': {'source': 'gs://b',
+                                      'mode': 'MOUNTED'}},
+        })
+        assert 'mode' in msg
+        assert 'MOUNT' in msg
+
+    def test_top_level_not_mapping(self):
+        with pytest.raises(exceptions.InvalidSchemaError) as exc:
+            schemas.validate_task_config(['run'])  # type: ignore
+        assert 'mapping' in str(exc.value)
+
+    def test_multiple_errors_all_reported(self):
+        msg = _task_err({'runn': 'x', 'setupp': 'y'})
+        assert 'runn' in msg and 'setupp' in msg
+
+
+class TestValidTasksPass:
+
+    def test_full_task_roundtrip(self):
+        config = yaml.safe_load(textwrap.dedent("""\
+            name: train
+            num_nodes: 2
+            workdir: .
+            envs: {LR: '3e-4'}
+            resources:
+              accelerators: tpu-v5p-64
+              use_spot: true
+              job_recovery:
+                strategy: failover
+                max_restarts_on_errors: 3
+            file_mounts:
+              /ckpt:
+                source: gs://bucket/ckpts
+                mode: MOUNT
+            service:
+              readiness_probe: /health
+              replica_policy:
+                min_replicas: 1
+                max_replicas: 4
+                target_qps_per_replica: 2.0
+            run: python train.py
+        """))
+        task = task_lib.Task.from_yaml_config(config)
+        # And the emitted config re-validates.
+        schemas.validate_task_config(task.to_yaml_config())
+
+    def test_any_of_resources(self):
+        schemas.validate_task_config({
+            'run': 'x',
+            'resources': {'any_of': [{'accelerators': 'tpu-v5e-8'},
+                                     {'accelerators': 'A100:8'}]},
+        })
+
+    def test_any_of_typo_caught(self):
+        with pytest.raises(exceptions.InvalidSchemaError) as exc:
+            schemas.validate_task_config({
+                'run': 'x',
+                'resources': {'any_of': [{'acclerators': 'tpu-v5e-8'}]},
+            })
+        assert "did you mean 'accelerators'" in str(exc.value)
+
+
+class TestConfigValidation:
+
+    def test_valid_config(self):
+        schemas.validate_config({
+            'api_server': {'endpoint': 'http://h:46580'},
+            'gcp': {'project_id': 'p'},
+            'jobs': {'controller': {'resources': {'cpus': 4}}},
+        })
+
+    def test_unknown_section(self):
+        with pytest.raises(exceptions.InvalidSchemaError) as exc:
+            schemas.validate_config({'api_sever': {'endpoint': 'x'}})
+        assert "did you mean 'api_server'" in str(exc.value)
+
+    def test_bad_nested_key(self):
+        with pytest.raises(exceptions.InvalidSchemaError) as exc:
+            schemas.validate_config(
+                {'jobs': {'controler': {}}}, source='~/.xsky/config.yaml')
+        msg = str(exc.value)
+        assert 'config.yaml' in msg
+        assert "did you mean 'controller'" in msg
+
+    def test_config_file_layer_validated(self, tmp_path, monkeypatch):
+        bad = tmp_path / 'config.yaml'
+        bad.write_text('api_sever:\n  endpoint: http://x\n')
+        monkeypatch.setenv('XSKY_CONFIG', str(bad))
+        monkeypatch.setenv('XSKY_SERVER_CONFIG',
+                           str(tmp_path / 'absent.yaml'))
+        from skypilot_tpu import config as config_lib
+        with pytest.raises(exceptions.InvalidSchemaError):
+            config_lib.reload_config()
+        # Restore a clean loaded state for other tests.
+        monkeypatch.delenv('XSKY_CONFIG')
+        config_lib.reload_config()
