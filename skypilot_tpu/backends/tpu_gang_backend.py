@@ -496,10 +496,14 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             local_dir or f'~/.xsky/sync_down_logs/{handle.cluster_name}')
         os.makedirs(local_dir, exist_ok=True)
         head = handle.head_runner()
-        # Home-relative remote path: consistent across runner flavors
-        # (local host-root, ssh $HOME, k8s /root). Runner convention:
-        # source=local, target=remote, for both directions.
-        remote_logs = '.xsky/logs'
+        # ssh/local runners resolve relative remote paths against
+        # $HOME/host-root; kubectl-cp resolves against the container
+        # working directory, so kubernetes/docker need the absolute
+        # runtime root (same special-case as the wheel bootstrap).
+        if handle.provider_name in ('kubernetes', 'docker'):
+            remote_logs = f'{handle.head_runtime_root}/logs'
+        else:
+            remote_logs = '.xsky/logs'
         if job_id is not None:
             head.rsync(os.path.join(local_dir, f'job-{job_id}'),
                        f'{remote_logs}/job-{job_id}/', up=False)

@@ -1,0 +1,85 @@
+"""Generate the AWS catalog CSV (twin of
+sky/catalog/data_fetchers/fetch_aws.py).
+
+The reference queries the EC2 + Pricing APIs per region; this
+environment is zero-egress, so the checked-in CSV is generated from a
+static table of the GPU/CPU SKUs the optimizer needs for cross-cloud
+ranking (P4d/P5 A100/H100, P3 V100, G5/G6 A10G/L4, M6i CPU tiers).
+Prices are representative public on-demand/spot rates (us-east-1,
+2024-era); regenerate against the live Pricing API when egress exists.
+
+Run: python -m skypilot_tpu.catalog.data_fetchers.fetch_aws
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Tuple
+
+# (instance_type, acc_name, acc_count, vcpus, mem_gib, acc_mem_gib,
+#  price, spot_price)
+_SKUS: List[Tuple[str, str, float, float, float, float, float, float]] = [
+    # CPU-only tiers (controllers / default instance type).
+    ('m6i.large', '', 0, 2, 8, 0, 0.0960, 0.0384),
+    ('m6i.xlarge', '', 0, 4, 16, 0, 0.1920, 0.0768),
+    ('m6i.2xlarge', '', 0, 8, 32, 0, 0.3840, 0.1536),
+    ('m6i.4xlarge', '', 0, 16, 64, 0, 0.7680, 0.3072),
+    ('m6i.8xlarge', '', 0, 32, 128, 0, 1.5360, 0.6144),
+    # V100 (P3).
+    ('p3.2xlarge', 'V100', 1, 8, 61, 16, 3.0600, 0.9180),
+    ('p3.8xlarge', 'V100', 4, 32, 244, 64, 12.2400, 3.6720),
+    ('p3.16xlarge', 'V100', 8, 64, 488, 128, 24.4800, 7.3440),
+    # A100 40GB (P4d) / 80GB (P4de).
+    ('p4d.24xlarge', 'A100', 8, 96, 1152, 320, 32.7726, 9.8318),
+    ('p4de.24xlarge', 'A100-80GB', 8, 96, 1152, 640, 40.9657, 12.2897),
+    # H100 (P5).
+    ('p5.48xlarge', 'H100', 8, 192, 2048, 640, 98.3200, 29.4960),
+    # A10G (G5) / L4 (G6).
+    ('g5.xlarge', 'A10G', 1, 4, 16, 24, 1.0060, 0.3018),
+    ('g5.12xlarge', 'A10G', 4, 48, 192, 96, 5.6720, 1.7016),
+    ('g6.xlarge', 'L4', 1, 4, 16, 24, 0.8048, 0.2414),
+    ('g6.12xlarge', 'L4', 4, 48, 192, 96, 4.6016, 1.3805),
+    # T4 (G4dn) — the budget tier.
+    ('g4dn.xlarge', 'T4', 1, 4, 16, 16, 0.5260, 0.1578),
+    ('g4dn.12xlarge', 'T4', 4, 48, 192, 64, 3.9120, 1.1736),
+]
+
+# Region multipliers approximate real cross-region price spreads.
+_REGIONS: List[Tuple[str, List[str], float]] = [
+    ('us-east-1', ['us-east-1a', 'us-east-1b'], 1.00),
+    ('us-west-2', ['us-west-2a', 'us-west-2b'], 1.00),
+    ('eu-west-1', ['eu-west-1a', 'eu-west-1b'], 1.11),
+]
+
+HEADER = ['InstanceType', 'AcceleratorName', 'AcceleratorCount', 'vCPUs',
+          'MemoryGiB', 'AcceleratorMemoryGiB', 'Price', 'SpotPrice',
+          'Region', 'AvailabilityZone']
+
+
+def rows() -> List[List[str]]:
+    out = []
+    for (itype, acc, count, vcpus, mem, acc_mem, price,
+         spot) in _SKUS:
+        for region, zones, mult in _REGIONS:
+            for zone in zones:
+                out.append([
+                    itype, acc, f'{count:g}', f'{vcpus:g}', f'{mem:g}',
+                    f'{acc_mem:g}', f'{price * mult:.4f}',
+                    f'{spot * mult:.4f}', region, zone,
+                ])
+    return out
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, 'data', 'aws', 'catalog.csv')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.writer(f)
+        writer.writerow(HEADER)
+        writer.writerows(rows())
+    print(f'Wrote {path}')
+
+
+if __name__ == '__main__':
+    main()

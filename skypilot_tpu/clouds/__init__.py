@@ -2,6 +2,7 @@
 from skypilot_tpu.clouds.cloud import Cloud
 from skypilot_tpu.clouds.cloud import CloudImplementationFeatures
 from skypilot_tpu.clouds.cloud import Region
+from skypilot_tpu.clouds.aws import AWS
 from skypilot_tpu.clouds.fake import Fake
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.kubernetes import Kubernetes

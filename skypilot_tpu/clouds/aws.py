@@ -1,0 +1,76 @@
+"""AWS cloud: EC2 GPU/CPU offerings for cross-cloud optimization.
+
+Lean twin of sky/clouds/aws.py:1 — catalog-backed feasibility via
+CatalogCloud, EC2 deploy variables for the 'aws' provisioner
+(provision/aws/instance.py), credential probing from env/ini. Makes the
+optimizer's "cheapest across clouds incl. GPU↔TPU" ranking real with a
+second compute cloud next to GCP.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import catalog_cloud
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+# Region-default AMIs (Deep Learning AMI family; override per task via
+# resources.image_id).
+_DEFAULT_AMIS = {
+    'us-east-1': 'ami-0c7217cdde317cfec',
+    'us-west-2': 'ami-008fe2fc65df48dac',
+    'eu-west-1': 'ami-0905a3c97561e0b69',
+}
+
+
+@registry.CLOUD_REGISTRY.register(aliases=['ec2'])
+class AWS(catalog_cloud.CatalogCloud):
+    _REPR = 'AWS'
+    _MAX_CLUSTER_NAME_LEN_LIMIT = 63
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        vars: Dict[str, Any] = {
+            'cluster_name': cluster_name,
+            'region': region,
+            'zone': zone,
+            'instance_type': resources.instance_type,
+            'use_spot': resources.use_spot,
+            'disk_size': resources.disk_size,
+            'ports': resources.ports,
+            'labels': dict(resources.labels or {}),
+            'image_id': resources.image_id or _DEFAULT_AMIS.get(region),
+        }
+        if resources.accelerators:
+            name, count = next(iter(resources.accelerators.items()))
+            vars.update({'gpu_type': name, 'gpu_count': count})
+        return vars
+
+    def provider_config_overrides(
+            self, node_config: Dict[str, Any]) -> Dict[str, Any]:
+        del node_config
+        return {}
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.aws import rest as aws_rest
+        if aws_rest.load_credentials() is not None:
+            return True, None
+        return False, (
+            'AWS credentials not found. Set AWS_ACCESS_KEY_ID / '
+            'AWS_SECRET_ACCESS_KEY or populate ~/.aws/credentials.')
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        path = os.path.expanduser('~/.aws/credentials')
+        if os.path.exists(path):
+            return {'~/.aws/credentials': '~/.aws/credentials'}
+        return {}
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        if num_gigabytes <= 0:
+            return 0.0
+        return 0.09 * num_gigabytes

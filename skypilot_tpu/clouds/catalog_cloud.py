@@ -89,6 +89,12 @@ class CatalogCloud(cloud_lib.Cloud):
             out.append(e)
         return out
 
+    def region_of_zone(self, zone: str) -> str:
+        for e in self._entries():
+            if e.zone == zone:
+                return e.region
+        return super().region_of_zone(zone)
+
     # ---- default instance type ----
 
     _DEFAULT_CPUS = '4+'

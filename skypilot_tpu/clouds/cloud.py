@@ -177,6 +177,12 @@ class Cloud:
     def instance_type_exists(self, instance_type: str) -> bool:
         return catalog.common.instance_type_exists(self.name, instance_type)
 
+    def region_of_zone(self, zone: str) -> str:
+        """Region containing a zone. GCP-style 'us-central1-a' strips
+        one dash segment; clouds with other conventions (AWS
+        'us-east-1a') override via their catalog."""
+        return zone.rsplit('-', 1)[0]
+
     def validate_region_zone(self, region: Optional[str],
                              zone: Optional[str]) -> None:
         catalog.validate_region_zone(self.name, region, zone)

@@ -84,6 +84,40 @@ class GCP(catalog_cloud.CatalogCloud):
             vars.update({'gpu_type': name, 'gpu_count': count})
         return vars
 
+    def provider_config_overrides(
+            self, node_config: Dict[str, Any]) -> Dict[str, Any]:
+        """Thread the GCP project into provider_config for every
+        lifecycle op (run/wait/query/terminate all need it).
+
+        Sources: $GOOGLE_CLOUD_PROJECT, config key gcp.project_id, then
+        the ADC file's quota_project_id.
+        """
+        del node_config
+        project = os.environ.get('GOOGLE_CLOUD_PROJECT')
+        if not project:
+            from skypilot_tpu import config as config_lib
+            project = config_lib.get_nested(('gcp', 'project_id'))
+        if not project:
+            import json
+            for path in DEFAULT_CREDENTIAL_PATHS:
+                if not path:
+                    continue
+                adc = os.path.expanduser(path)
+                if not os.path.exists(adc):
+                    continue
+                try:
+                    with open(adc, encoding='utf-8') as f:
+                        blob = json.load(f)
+                    # User ADC carries quota_project_id; service-account
+                    # keys carry project_id.
+                    project = blob.get('quota_project_id') or \
+                        blob.get('project_id')
+                except (OSError, ValueError):
+                    project = None
+                if project:
+                    break
+        return {'project_id': project} if project else {}
+
     def check_credentials(self) -> Tuple[bool, Optional[str]]:
         for path in DEFAULT_CREDENTIAL_PATHS:
             if path and os.path.exists(os.path.expanduser(path)):

@@ -31,6 +31,7 @@ import filelock
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.utils import common_utils
 
 logger = sky_logging.init_logger(__name__)
 
@@ -82,18 +83,6 @@ def _spawn_controller(job_id: int) -> None:
     jobs_state.set_controller_pid(job_id, proc.pid)
 
 
-def _pid_alive(pid: Optional[int]) -> bool:
-    if not pid:
-        return False
-    try:
-        os.kill(pid, 0)
-        return True
-    except ProcessLookupError:
-        return False
-    except PermissionError:
-        return True
-
-
 def _reconcile_dead_controllers() -> List[str]:
     """Release slots held by controllers that died without cleanup.
 
@@ -108,7 +97,7 @@ def _reconcile_dead_controllers() -> List[str]:
         if row['schedule_state'] not in (jobs_state.ScheduleState.LAUNCHING,
                                          jobs_state.ScheduleState.ALIVE):
             continue
-        if _pid_alive(row['controller_pid']):
+        if common_utils.pid_alive(row['controller_pid']):
             continue
         logger.warning(
             f'Managed job {row["job_id"]} controller '

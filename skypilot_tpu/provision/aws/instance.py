@@ -1,0 +1,292 @@
+"""AWS EC2 provisioner op-set (lean twin of sky/provision/aws/instance.py).
+
+Dispatched by provider name 'aws'. Instances are tracked by the
+``xsky-cluster`` tag (idempotent ops, like every provider here); the
+head node carries ``xsky-head=true``. Spot capacity goes through
+RunInstances' InstanceMarketOptions rather than the legacy spot-request
+API. Security groups are left to the account default; open_ports issues
+a best-effort AuthorizeSecurityGroupIngress.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.aws import rest
+
+logger = sky_logging.init_logger(__name__)
+
+CLUSTER_TAG = 'xsky-cluster'
+HEAD_TAG = 'xsky-head'
+NODE_INDEX_TAG = 'xsky-node-index'
+
+# Pluggable transport for tests (scripted fake API).
+_transport_factory = rest.Transport
+
+
+def set_transport_factory(factory) -> None:
+    global _transport_factory
+    _transport_factory = factory
+
+
+def _region_of(provider_config: Dict[str, Any]) -> str:
+    region = provider_config.get('region')
+    if not region:
+        raise exceptions.InvalidSkyTpuConfigError(
+            'AWS provider_config requires region.')
+    return region
+
+
+def _transport(provider_config: Dict[str, Any]) -> rest.Transport:
+    return _transport_factory(_region_of(provider_config))
+
+
+_STATE_MAP = {
+    'pending': 'PENDING',
+    'running': 'RUNNING',
+    'stopping': 'STOPPING',
+    'stopped': 'STOPPED',
+    'shutting-down': 'STOPPING',
+    'terminated': None,
+}
+
+
+def _describe(t: rest.Transport, cluster_name: str,
+              include_terminated: bool = False,
+              zone: Optional[str] = None) -> List[Dict[str, Any]]:
+    params = {
+        'Filter.1.Name': f'tag:{CLUSTER_TAG}',
+        'Filter.1.Value.1': cluster_name,
+    }
+    if not include_terminated:
+        for i, s in enumerate(('pending', 'running', 'stopping',
+                               'stopped'), 1):
+            params[f'Filter.2.Value.{i}'] = s
+        params['Filter.2.Name'] = 'instance-state-name'
+    if zone is not None:
+        params['Filter.3.Name'] = 'availability-zone'
+        params['Filter.3.Value.1'] = zone
+    out: List[Dict[str, Any]] = []
+    reply = t.call('DescribeInstances', params)
+    for reservation in rest.as_list(reply.get('reservationSet')):
+        out.extend(rest.as_list(reservation.get('instancesSet')))
+    return out
+
+
+def _tags_of(inst: Dict[str, Any]) -> Dict[str, str]:
+    return {tag['key']: tag.get('value', '')
+            for tag in rest.as_list(inst.get('tagSet'))}
+
+
+def _state_of(inst: Dict[str, Any]) -> str:
+    state = inst.get('instanceState')
+    if isinstance(state, dict):
+        return str(state.get('name', 'pending'))
+    return 'pending'
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    node_cfg = config.node_config
+    t = _transport(config.provider_config)
+    created: List[str] = []
+    resumed: List[str] = []
+    try:
+        # Count only this zone's instances: a failed attempt in another
+        # zone must not make this one under-provision (gang clusters
+        # cannot be split across zones).
+        existing = _describe(t, cluster_name, zone=zone)
+        # Resume stopped nodes first (restart path).
+        if config.resume_stopped_nodes:
+            stopped = [i['instanceId'] for i in existing
+                       if _state_of(i) == 'stopped']
+            if stopped:
+                params = {f'InstanceId.{n}': iid
+                          for n, iid in enumerate(stopped, 1)}
+                t.call('StartInstances', params)
+                resumed.extend(stopped)
+
+        have = len(existing)
+        missing = config.count - have
+        has_head = any(_tags_of(i).get(HEAD_TAG) == 'true'
+                       for i in existing)
+        for node in range(missing):
+            is_head = (not has_head and node == 0)
+            params: Dict[str, str] = {
+                'ImageId': node_cfg.get('image_id') or
+                           'ami-xsky-default',
+                'InstanceType': node_cfg['instance_type'],
+                'MinCount': '1',
+                'MaxCount': '1',
+                'TagSpecification.1.ResourceType': 'instance',
+                'TagSpecification.1.Tag.1.Key': CLUSTER_TAG,
+                'TagSpecification.1.Tag.1.Value': cluster_name,
+                'TagSpecification.1.Tag.2.Key': NODE_INDEX_TAG,
+                'TagSpecification.1.Tag.2.Value': str(have + node),
+            }
+            if is_head:
+                params['TagSpecification.1.Tag.3.Key'] = HEAD_TAG
+                params['TagSpecification.1.Tag.3.Value'] = 'true'
+            if zone:
+                params['Placement.AvailabilityZone'] = zone
+            if node_cfg.get('use_spot'):
+                params['InstanceMarketOptions.MarketType'] = 'spot'
+            if node_cfg.get('key_name'):
+                params['KeyName'] = node_cfg['key_name']
+            reply = t.call('RunInstances', params)
+            for inst in rest.as_list(reply.get('instancesSet')):
+                created.append(inst['instanceId'])
+    except rest.AwsApiError as e:
+        # Partial gang: terminate what this attempt created so the
+        # failover retry (next zone/region) starts from zero instead of
+        # leaking instances or splitting the cluster across zones.
+        if created:
+            try:
+                t.call('TerminateInstances',
+                       {f'InstanceId.{n}': iid
+                        for n, iid in enumerate(created, 1)})
+            except rest.AwsApiError as cleanup_err:
+                logger.warning(
+                    f'Cleanup of partial attempt failed: {cleanup_err}')
+        raise rest.classify_error(e, zone) from e
+    head = _head_instance_id(t, cluster_name)
+    return common.ProvisionRecord(
+        provider_name='aws', cluster_name=cluster_name, region=region,
+        zone=zone, resumed_instance_ids=resumed,
+        created_instance_ids=created, head_instance_id=head)
+
+
+def _head_instance_id(t: rest.Transport,
+                      cluster_name: str) -> Optional[str]:
+    instances = _describe(t, cluster_name)
+    for inst in instances:
+        if _tags_of(inst).get(HEAD_TAG) == 'true':
+            return inst['instanceId']
+    if instances:
+        return sorted(i['instanceId'] for i in instances)[0]
+    return None
+
+
+def wait_instances(region: str, cluster_name: str, state: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   timeout_s: float = 600.0,
+                   poll_interval_s: float = 5.0) -> None:
+    """Poll until every instance reaches `state` (EC2 creation is
+    asynchronous, unlike the GCP op-wait path).
+
+    An instance from the initial set that disappears or terminates
+    mid-wait (spot preempted during boot) raises CapacityError instead
+    of silently passing with a shrunken gang.
+    """
+    t = _transport(provider_config or {'region': region})
+    want = state.lower() if state != 'RUNNING' else 'running'
+    expected = {i['instanceId'] for i in _describe(t, cluster_name)}
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        instances = _describe(t, cluster_name)
+        alive = {i['instanceId'] for i in instances}
+        lost = expected - alive
+        if lost:
+            raise exceptions.CapacityError(
+                f'Instance(s) {sorted(lost)} terminated while waiting '
+                f'for {state} (spot preemption during boot?).')
+        if instances and all(
+                _state_of(i) == want for i in instances):
+            return
+        time.sleep(poll_interval_s)
+    raise exceptions.ProvisionError(
+        f'Cluster {cluster_name!r} did not reach {state} within '
+        f'{timeout_s}s.')
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    t = _transport(provider_config)
+    ids = [i['instanceId'] for i in _describe(t, cluster_name)
+           if _state_of(i) in ('pending', 'running')]
+    if ids:
+        t.call('StopInstances',
+               {f'InstanceId.{n}': iid for n, iid in enumerate(ids, 1)})
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    t = _transport(provider_config)
+    ids = [i['instanceId'] for i in _describe(t, cluster_name)]
+    if ids:
+        t.call('TerminateInstances',
+               {f'InstanceId.{n}': iid for n, iid in enumerate(ids, 1)})
+
+
+def query_instances(cluster_name: str, provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    t = _transport(provider_config)
+    out: Dict[str, Optional[str]] = {}
+    for inst in _describe(t, cluster_name, include_terminated=True):
+        out[inst['instanceId']] = _STATE_MAP.get(_state_of(inst))
+    return out
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
+    del region
+    t = _transport(provider_config)
+    instances: Dict[str, common.InstanceInfo] = {}
+    head_id: Optional[str] = None
+    rows = _describe(t, cluster_name)
+
+    def _sort_key(inst: Dict[str, Any]):
+        idx = _tags_of(inst).get(NODE_INDEX_TAG, '')
+        return (int(idx) if idx.isdigit() else 10**6,
+                inst['instanceId'])
+
+    rows.sort(key=_sort_key)
+    for inst in rows:
+        tags = _tags_of(inst)
+        info = common.InstanceInfo(
+            instance_id=inst['instanceId'],
+            internal_ip=str(inst.get('privateIpAddress') or ''),
+            external_ip=str(inst.get('ipAddress') or '') or None,
+            status=_STATE_MAP.get(_state_of(inst)) or 'PENDING',
+            tags=tags,
+        )
+        instances[info.instance_id] = info
+        if tags.get(HEAD_TAG) == 'true' and head_id is None:
+            head_id = info.instance_id
+    if not instances:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    if head_id is None:
+        head_id = sorted(instances)[0]
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head_id,
+        provider_name='aws',
+        provider_config=dict(provider_config or {}),
+        ssh_user=provider_config.get('ssh_user', 'ec2-user'))
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    """Best-effort ingress on the default security group."""
+    t = _transport(provider_config)
+    for port in ports:
+        lo, _, hi = str(port).partition('-')
+        try:
+            t.call('AuthorizeSecurityGroupIngress', {
+                'GroupName': provider_config.get('security_group',
+                                                 'default'),
+                'IpPermissions.1.IpProtocol': 'tcp',
+                'IpPermissions.1.FromPort': lo,
+                'IpPermissions.1.ToPort': hi or lo,
+                'IpPermissions.1.IpRanges.1.CidrIp': '0.0.0.0/0',
+            })
+        except rest.AwsApiError as e:
+            if e.code != 'InvalidPermission.Duplicate':
+                logger.warning(f'open_ports({port}) failed: {e}')
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    del cluster_name, provider_config  # default SG rules persist

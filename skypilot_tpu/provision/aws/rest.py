@@ -1,0 +1,210 @@
+"""Minimal EC2 Query API transport with SigV4 signing — no boto3.
+
+The reference drives EC2 through boto3 behind a lazy adaptor
+(sky/adaptors/aws.py:245); this image has no AWS SDK, and the op-set
+needs only eight EC2 actions, so the transport is a hand-rolled
+Query-API client: form-encoded POST, AWS Signature Version 4 (stdlib
+hmac/hashlib), XML responses parsed with xml.etree. Fully testable by
+injecting a fake transport (same pattern as provision/gcp/rest.py).
+
+Credentials, in order:
+  1. AWS_ACCESS_KEY_ID / AWS_SECRET_ACCESS_KEY (+ AWS_SESSION_TOKEN) env;
+  2. ~/.aws/credentials ([default] profile, ini format).
+"""
+from __future__ import annotations
+
+import configparser
+import datetime
+import hashlib
+import hmac
+import os
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+API_VERSION = '2016-11-15'
+_RETRYABLE_CODES = ('RequestLimitExceeded', 'Throttling',
+                    'InternalError', 'Unavailable')
+
+
+class AwsApiError(exceptions.ProvisionError):
+    """EC2 API error with the parsed <Code>/<Message>."""
+
+    def __init__(self, status: int, code: str, message: str) -> None:
+        super().__init__(f'AWS API error {status} ({code}): {message}')
+        self.status = status
+        self.code = code
+        self.message = message
+
+
+def classify_error(e: AwsApiError, zone: Optional[str]) -> Exception:
+    """Map EC2 error codes onto the failover taxonomy (role of the
+    reference's FailoverCloudErrorHandlerV2._aws_handler)."""
+    code = e.code
+    if code in ('InsufficientInstanceCapacity', 'InsufficientCapacity',
+                'SpotMaxPriceTooLow', 'InsufficientFreeAddressesInSubnet'):
+        return exceptions.CapacityError(
+            f'No capacity in {zone or "zone"}: {e.message}')
+    if code in ('InstanceLimitExceeded', 'VcpuLimitExceeded',
+                'MaxSpotInstanceCountExceeded'):
+        return exceptions.QuotaExceededError(e.message)
+    if code in ('UnauthorizedOperation', 'AuthFailure',
+                'OptInRequired'):
+        return exceptions.PermissionError_(e.message)
+    if code.startswith('InvalidParameter') or code.startswith(
+            'InvalidAMIID') or code == 'ValidationError':
+        return exceptions.InvalidRequestError(e.message)
+    return e
+
+
+def load_credentials() -> Optional[Tuple[str, str, Optional[str]]]:
+    """(access_key, secret_key, session_token) or None."""
+    access = os.environ.get('AWS_ACCESS_KEY_ID')
+    secret = os.environ.get('AWS_SECRET_ACCESS_KEY')
+    if access and secret:
+        return access, secret, os.environ.get('AWS_SESSION_TOKEN')
+    path = os.path.expanduser(
+        os.environ.get('AWS_SHARED_CREDENTIALS_FILE',
+                       '~/.aws/credentials'))
+    if os.path.exists(path):
+        parser = configparser.ConfigParser()
+        parser.read(path)
+        profile = os.environ.get('AWS_PROFILE', 'default')
+        if parser.has_section(profile):
+            sec = parser[profile]
+            if sec.get('aws_access_key_id') and \
+                    sec.get('aws_secret_access_key'):
+                return (sec['aws_access_key_id'],
+                        sec['aws_secret_access_key'],
+                        sec.get('aws_session_token'))
+    return None
+
+
+def _sign(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def sigv4_headers(region: str, body: str, host: str,
+                  creds: Tuple[str, str, Optional[str]],
+                  now: Optional[datetime.datetime] = None
+                  ) -> Dict[str, str]:
+    """AWS Signature Version 4 for a form-encoded EC2 POST."""
+    access, secret, token = creds
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime('%Y%m%dT%H%M%SZ')
+    datestamp = now.strftime('%Y%m%d')
+    service = 'ec2'
+    content_type = 'application/x-www-form-urlencoded; charset=utf-8'
+
+    canonical_headers = (f'content-type:{content_type}\n'
+                         f'host:{host}\nx-amz-date:{amz_date}\n')
+    signed_headers = 'content-type;host;x-amz-date'
+    if token:
+        canonical_headers += f'x-amz-security-token:{token}\n'
+        signed_headers += ';x-amz-security-token'
+    payload_hash = hashlib.sha256(body.encode()).hexdigest()
+    canonical_request = '\n'.join(
+        ['POST', '/', '', canonical_headers, signed_headers,
+         payload_hash])
+    scope = f'{datestamp}/{region}/{service}/aws4_request'
+    string_to_sign = '\n'.join([
+        'AWS4-HMAC-SHA256', amz_date, scope,
+        hashlib.sha256(canonical_request.encode()).hexdigest()
+    ])
+    k = _sign(f'AWS4{secret}'.encode(), datestamp)
+    k = _sign(k, region)
+    k = _sign(k, service)
+    k = _sign(k, 'aws4_request')
+    signature = hmac.new(k, string_to_sign.encode(),
+                         hashlib.sha256).hexdigest()
+    headers = {
+        'Content-Type': content_type,
+        'X-Amz-Date': amz_date,
+        'Authorization': (
+            f'AWS4-HMAC-SHA256 Credential={access}/{scope}, '
+            f'SignedHeaders={signed_headers}, Signature={signature}'),
+    }
+    if token:
+        headers['X-Amz-Security-Token'] = token
+    return headers
+
+
+def _strip_ns(tag: str) -> str:
+    return tag.split('}', 1)[-1]
+
+
+def xml_to_dict(element: ET.Element) -> Any:
+    """EC2 XML → plain dicts; <item> sequences become lists."""
+    children = list(element)
+    if not children:
+        return element.text or ''
+    if all(_strip_ns(c.tag) == 'item' for c in children):
+        return [xml_to_dict(c) for c in children]
+    out: Dict[str, Any] = {}
+    for c in children:
+        out[_strip_ns(c.tag)] = xml_to_dict(c)
+    return out
+
+
+class Transport:
+    """Signs and executes EC2 Query API calls for one region."""
+
+    def __init__(self, region: str) -> None:
+        self.region = region
+        self.host = f'ec2.{region}.amazonaws.com'
+
+    def call(self, action: str, params: Dict[str, str],
+             retries: int = 3) -> Dict[str, Any]:
+        creds = load_credentials()
+        if creds is None:
+            raise exceptions.PermissionError_(
+                'No AWS credentials (set AWS_ACCESS_KEY_ID / '
+                'AWS_SECRET_ACCESS_KEY or ~/.aws/credentials).')
+        body_params = {'Action': action, 'Version': API_VERSION}
+        body_params.update(params)
+        body = urllib.parse.urlencode(sorted(body_params.items()))
+        last: Optional[AwsApiError] = None
+        for attempt in range(retries):
+            headers = sigv4_headers(self.region, body, self.host, creds)
+            req = urllib.request.Request(f'https://{self.host}/',
+                                         data=body.encode(),
+                                         headers=headers, method='POST')
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    root = ET.fromstring(resp.read())
+                    return xml_to_dict(root)
+            except urllib.error.HTTPError as e:
+                raw = e.read()
+                code, message = 'Unknown', raw.decode(errors='replace')
+                try:
+                    root = ET.fromstring(raw)
+                    err = root.find('.//{*}Error')
+                    if err is not None:
+                        code = err.findtext('{*}Code', 'Unknown')
+                        message = err.findtext('{*}Message', message)
+                except ET.ParseError:
+                    pass
+                last = AwsApiError(e.code, code, message)
+                if code in _RETRYABLE_CODES and attempt < retries - 1:
+                    time.sleep(2 ** attempt)
+                    continue
+                raise last from e
+        assert last is not None
+        raise last
+
+
+def as_list(node: Any) -> List[Any]:
+    """EC2 sequences parse as a list, a single dict, or '' when empty."""
+    if isinstance(node, list):
+        return node
+    if node in ('', None):
+        return []
+    return [node]

@@ -157,8 +157,12 @@ class Resources:
 
     def _validate(self) -> None:
         if self._zone is not None and self._region is None:
-            # Infer region from zone when possible.
-            self._region = self._zone.rsplit('-', 1)[0]
+            # Infer region from zone when possible (cloud-aware: AWS
+            # zones are 'us-east-1a', GCP's are 'us-central1-a').
+            if self._cloud_name is not None:
+                self._region = self.cloud.region_of_zone(self._zone)
+            else:
+                self._region = self._zone.rsplit('-', 1)[0]
         if self._cloud_name is not None and (self._region is not None or
                                              self._zone is not None):
             self.cloud.validate_region_zone(self._region, self._zone)

@@ -25,14 +25,6 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _pid_alive(pid: int) -> bool:
-    try:
-        os.kill(pid, 0)
-        return True
-    except ProcessLookupError:
-        return False
-    except PermissionError:
-        return True
 
 
 def up(task: task_lib.Task, service_name: Optional[str] = None,
@@ -86,8 +78,9 @@ def update(task: task_lib.Task, service_name: str,
             f'Service {service_name!r} is {record["status"].value}; its '
             'controller is no longer rolling updates. Tear it down '
             '(`serve down`) and `serve up` the new version instead.')
+    from skypilot_tpu.utils import common_utils
     pid = record['controller_pid']
-    if pid and not _pid_alive(pid):
+    if pid and not common_utils.pid_alive(pid):
         raise ValueError(
             f'Service {service_name!r} controller (pid {pid}) is dead; '
             'no process would apply the update. `serve down` and '

@@ -36,9 +36,12 @@ _KNOWN_PATHS = frozenset({
 def _normalize_path(path: str) -> str:
     if path in _KNOWN_PATHS:
         return path
-    if path.startswith('/api/') and path[5:].replace('.', '').replace(
-            '_', '').isalnum():
-        return path  # verb routes: /api/launch, /api/jobs.queue, ...
+    if path.startswith('/api/'):
+        # Only verbs the payload registry knows; scanning /api/aaaN
+        # must not mint new label values.
+        from skypilot_tpu.server import payloads
+        if payloads.is_known_verb(path[5:]):
+            return path
     return '<other>'
 
 

@@ -218,7 +218,11 @@ class SSHCommandRunner(CommandRunner):
         ssh_cmd = ' '.join(
             ['ssh'] + SSH_COMMON_OPTS +
             ['-i', self.ssh_private_key, '-p', str(self.port)])
-        args = ['rsync', '-az', '--delete', '-e', ssh_cmd]
+        # --delete only when pushing (mirror workdir semantics); a
+        # download must never prune unrelated files from a user-supplied
+        # local directory.
+        args = ['rsync', '-az'] + (['--delete'] if up else []) + \
+            ['-e', ssh_cmd]
         for e in excludes or []:
             args += ['--exclude', e]
         remote = f'{self.ssh_user}@{self.ip}:{target}'

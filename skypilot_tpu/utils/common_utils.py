@@ -16,6 +16,19 @@ _CLUSTER_NAME_RE = re.compile(r'^[a-z]([a-z0-9-]*[a-z0-9])?$')
 _run_id: Optional[str] = None
 
 
+def pid_alive(pid: Optional[int]) -> bool:
+    """Is a process with this pid running (signal-0 probe)?"""
+    if not pid:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
 def get_usage_run_id() -> str:
     """Stable id for one client invocation (log correlation)."""
     global _run_id
