@@ -1,0 +1,14 @@
+{{- define "xsky.fullname" -}}
+{{- printf "%s" .Release.Name | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "xsky.labels" -}}
+app.kubernetes.io/name: xsky
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end -}}
+
+{{- define "xsky.selectorLabels" -}}
+app.kubernetes.io/name: xsky
+app.kubernetes.io/instance: {{ .Release.Name }}
+{{- end -}}

@@ -118,10 +118,69 @@ class GangResult:
 
     @property
     def first_failure_rank(self) -> Optional[int]:
+        """The host that *caused* the failure: positive exit codes
+        (command failures) outrank negative ones (hosts we killed in
+        response)."""
+        for i, rc in enumerate(self.returncodes):
+            if rc > 0:
+                return i
         for i, rc in enumerate(self.returncodes):
             if rc != 0:
                 return i
         return None
+
+
+# ssh transport failure exit code (the client's, not the command's).
+_SSH_EXIT_CODE = 255
+# A host start failing with ssh-transport rc inside this window is
+# retried once (transient drop during fan-out at scale).
+START_RETRY_WINDOW_S = 10.0
+
+
+def _kill_tree(p: subprocess.Popen, sig_kill: bool = False) -> None:
+    """Signal the host process's whole session (runners start each
+    command with start_new_session=True), falling back to the direct
+    child."""
+    import signal as signal_lib
+    sig = signal_lib.SIGKILL if sig_kill else signal_lib.SIGTERM
+    try:
+        os.killpg(os.getpgid(p.pid), sig)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            if sig_kill:
+                p.kill()
+            else:
+                p.terminate()
+        except ProcessLookupError:
+            pass
+
+
+def aggregate_logs(log_dir: str, num_hosts: int,
+                   max_bytes_per_host: int = 64 * 1024) -> str:
+    """Bounded multiplex of per-host logs into one ``gang.log``.
+
+    At v5p-512 scale (64 hosts) unbounded concatenation would produce
+    gigabytes; each host contributes at most its log tail, prefixed
+    ``[host-N]`` per line.
+    """
+    out_path = os.path.join(log_dir, 'gang.log')
+    with open(out_path, 'w', encoding='utf-8', errors='replace') as out:
+        for rank in range(num_hosts):
+            path = os.path.join(log_dir, f'host-{rank}.log')
+            if not os.path.exists(path):
+                continue
+            size = os.path.getsize(path)
+            with open(path, 'rb') as f:
+                if size > max_bytes_per_host:
+                    f.seek(size - max_bytes_per_host)
+                    f.readline()  # drop the partial first line
+                    out.write(f'[host-{rank}] ... '
+                              f'({size - max_bytes_per_host} bytes '
+                              'truncated)\n')
+                for line in f:
+                    out.write(f'[host-{rank}] '
+                              f'{line.decode(errors="replace")}')
+    return out_path
 
 
 def gang_launch(runners: Sequence[runner_lib.CommandRunner],
@@ -134,47 +193,72 @@ def gang_launch(runners: Sequence[runner_lib.CommandRunner],
     """Start `command` on all hosts; kill everyone on first failure.
 
     Logs go to ``{log_dir}/host-{rank}.log`` (rank 0 additionally to
-    ``run.log`` for `tail_logs` compatibility).
+    ``run.log`` for `tail_logs` compatibility), with a bounded
+    multiplexed ``gang.log`` written at the end. An ssh-transport
+    failure (rc 255) within the start window retries that host once
+    before it counts as a gang failure.
     """
     assert len(runners) == len(host_envs)
     os.makedirs(log_dir, exist_ok=True)
     procs: List[subprocess.Popen] = []
+
+    def _start(rank: int) -> subprocess.Popen:
+        log_path = os.path.join(log_dir, f'host-{rank}.log')
+        return runners[rank].run_async(command, env=host_envs[rank],
+                                       log_path=log_path, cwd=cwd)
+
     try:
-        for rank, (runner, env) in enumerate(zip(runners, host_envs)):
-            log_path = os.path.join(log_dir, f'host-{rank}.log')
-            procs.append(
-                runner.run_async(command, env=env, log_path=log_path,
-                                 cwd=cwd))
+        for rank in range(len(runners)):
+            procs.append(_start(rank))
     except Exception:
         for p in procs:
-            p.kill()
+            _kill_tree(p, sig_kill=True)
         raise
 
-    deadline = time.time() + timeout_s if timeout_s else None
+    start_time = time.time()
+    deadline = start_time + timeout_s if timeout_s else None
+    retried = [False] * len(procs)
     returncodes: List[Optional[int]] = [None] * len(procs)
     while True:
+        now = time.time()
         for i, p in enumerate(procs):
-            if returncodes[i] is None:
-                returncodes[i] = p.poll()
+            if returncodes[i] is not None:
+                continue
+            rc = p.poll()
+            if rc == _SSH_EXIT_CODE and not retried[i] and \
+                    now - start_time < START_RETRY_WINDOW_S:
+                # Transient ssh drop during fan-out: one retry.
+                retried[i] = True
+                logger.warning(f'Host {i}: ssh start failed (rc 255); '
+                               'retrying once.')
+                try:
+                    procs[i] = _start(i)
+                except Exception as e:  # pylint: disable=broad-except
+                    logger.warning(f'Host {i}: retry failed: {e}')
+                    returncodes[i] = _SSH_EXIT_CODE
+                continue
+            returncodes[i] = rc
         failed = [rc for rc in returncodes if rc not in (None, 0)]
         if failed:
-            # Gang semantics: one non-zero exit kills the whole job.
+            # Gang semantics: one non-zero exit kills the whole job —
+            # including each host's process tree, not just the launcher.
             for i, p in enumerate(procs):
                 if returncodes[i] is None:
-                    p.terminate()
+                    _kill_tree(p)
             for i, p in enumerate(procs):
                 if returncodes[i] is None:
                     try:
                         returncodes[i] = p.wait(timeout=10)
                     except subprocess.TimeoutExpired:
-                        p.kill()
+                        _kill_tree(p, sig_kill=True)
                         returncodes[i] = -9
             break
         if all(rc is not None for rc in returncodes):
             break
         if deadline and time.time() > deadline:
-            for p in procs:
-                p.kill()
+            for i, p in enumerate(procs):
+                if returncodes[i] is None:
+                    _kill_tree(p, sig_kill=True)
             returncodes = [rc if rc is not None else -15
                            for rc in returncodes]
             break
@@ -188,5 +272,9 @@ def gang_launch(runners: Sequence[runner_lib.CommandRunner],
             os.symlink('host-0.log', run_log)
         except OSError:
             pass
+    try:
+        aggregate_logs(log_dir, len(runners))
+    except OSError as e:
+        logger.warning(f'gang.log aggregation failed: {e}')
     return GangResult([rc if rc is not None else -1
                        for rc in returncodes])

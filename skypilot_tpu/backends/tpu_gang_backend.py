@@ -409,6 +409,12 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             f'add {shlex.quote(name or "-")} {user} {spec_b64}',
             env=env, require_outputs=True)
         if rc != 0:
+            # A concurrent down/preemption between provision and submit:
+            # name the real condition instead of a generic shell error.
+            if state.get_cluster_from_name(handle.cluster_name) is None:
+                raise exceptions.ClusterDoesNotExist(
+                    f'Cluster {handle.cluster_name!r} was torn down '
+                    'before the job could be submitted.')
             raise exceptions.CommandError(rc, 'job_cli add', err)
         job_id = int(out.strip().splitlines()[-1])
         rc, out, err = head.run(
@@ -423,6 +429,7 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                   timeout_s: float = 3600.0,
                   poll_s: float = 0.3) -> job_lib.JobStatus:
         deadline = time.time() + timeout_s
+        probe_failures = 0
         while time.time() < deadline:
             status = self.get_job_status(handle, job_id)
             if status is not None and status.is_terminal():
@@ -431,6 +438,19 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                         f'Job {job_id} finished with {status.value}. '
                         f'Logs:\n{self.tail_logs(handle, job_id, False)}')
                 return status
+            if status is None:
+                # Probe failed: tolerate transient hiccups, but if the
+                # cluster record is gone (concurrent `down`, preemption
+                # reconciled) stop polling a corpse.
+                probe_failures += 1
+                if probe_failures >= 3 and state.get_cluster_from_name(
+                        handle.cluster_name) is None:
+                    raise exceptions.ClusterDoesNotExist(
+                        f'Cluster {handle.cluster_name!r} disappeared '
+                        f'while waiting for job {job_id} (torn down or '
+                        'preempted).')
+            else:
+                probe_failures = 0
             time.sleep(poll_s)
         raise TimeoutError(f'Job {job_id} did not finish in {timeout_s}s')
 

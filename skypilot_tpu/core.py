@@ -149,12 +149,24 @@ def stop(cluster_name: str) -> None:
 
 
 def down(cluster_name: str, purge: bool = False) -> None:
-    record = _get_handle(cluster_name)
-    handle = record['handle']
-    if handle is None:
-        state.remove_cluster(cluster_name, terminate=True)
-        return
-    _backend().teardown(handle, terminate=True, purge=purge)
+    import filelock
+    try:
+        # Bounded wait: a launch may hold the cluster lock for a long
+        # retry-until-up loop; surface that instead of hanging 10 min
+        # and leaking a raw filelock.Timeout.
+        lock = state.cluster_lock(cluster_name, timeout=60)
+        with lock:
+            record = _get_handle(cluster_name)
+            handle = record['handle']
+            if handle is None:
+                state.remove_cluster(cluster_name, terminate=True)
+                return
+            _backend().teardown(handle, terminate=True, purge=purge)
+    except filelock.Timeout as e:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is busy (a launch/lifecycle '
+            'operation holds its lock); retry after it finishes or '
+            'cancel the pending operation.', cluster_status=None) from e
 
 
 def autostop(cluster_name: str, idle_minutes: int,

@@ -55,10 +55,9 @@ def db_path() -> str:
 
 
 def _db() -> sqlite3.Connection:
-    path = db_path()
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    conn = sqlite3.connect(path, timeout=30, check_same_thread=False)
-    conn.execute('PRAGMA journal_mode=WAL')
+    from skypilot_tpu.utils import db_utils
+    conn = db_utils.connect(db_path(), timeout=30,
+                            check_same_thread=False)
     conn.execute("""
         CREATE TABLE IF NOT EXISTS managed_jobs (
             job_id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -77,22 +76,32 @@ def _db() -> sqlite3.Connection:
     try:
         conn.execute("ALTER TABLE managed_jobs ADD COLUMN "
                      "schedule_state TEXT DEFAULT 'INACTIVE'")
-    except sqlite3.OperationalError:
-        pass  # column exists
+    except Exception:  # pylint: disable=broad-except
+        pass  # column exists (sqlite OperationalError / pg DuplicateColumn)
     conn.commit()
     return conn
 
 
 def add_job(name: Optional[str], task_config: Dict[str, Any]) -> int:
+    from skypilot_tpu.utils import db_utils
     with _lock:
         conn = _db()
-        cur = conn.execute(
-            'INSERT INTO managed_jobs (name, task_config, status, '
-            'submitted_at) VALUES (?, ?, ?, ?)',
-            (name, json.dumps(task_config),
-             ManagedJobStatus.PENDING.value, time.time()))
+        if db_utils.is_postgres():
+            # psycopg2 cursors have no meaningful lastrowid.
+            cur = conn.execute(
+                'INSERT INTO managed_jobs (name, task_config, status, '
+                'submitted_at) VALUES (?, ?, ?, ?) RETURNING job_id',
+                (name, json.dumps(task_config),
+                 ManagedJobStatus.PENDING.value, time.time()))
+            job_id = cur.fetchone()[0]
+        else:
+            cur = conn.execute(
+                'INSERT INTO managed_jobs (name, task_config, status, '
+                'submitted_at) VALUES (?, ?, ?, ?)',
+                (name, json.dumps(task_config),
+                 ManagedJobStatus.PENDING.value, time.time()))
+            job_id = cur.lastrowid
         conn.commit()
-        job_id = cur.lastrowid
         conn.close()
         return job_id
 

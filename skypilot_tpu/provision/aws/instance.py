@@ -99,6 +99,16 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
         # zone must not make this one under-provision (gang clusters
         # cannot be split across zones).
         existing = _describe(t, cluster_name, zone=zone)
+        # 'stopping' nodes can be neither started nor replaced: wait for
+        # them to settle at 'stopped' (stop-then-relaunch race).
+        deadline = time.time() + 300
+        while any(_state_of(i) == 'stopping' for i in existing):
+            if time.time() > deadline:
+                raise exceptions.ProvisionError(
+                    f'Instances of {cluster_name!r} stuck in '
+                    "'stopping'; retry once they settle.")
+            time.sleep(2.0)
+            existing = _describe(t, cluster_name, zone=zone)
         # Resume stopped nodes first (restart path).
         if config.resume_stopped_nodes:
             stopped = [i['instanceId'] for i in existing

@@ -197,6 +197,15 @@ class Transport:
                     time.sleep(2 ** attempt)
                     continue
                 raise last from e
+            except (urllib.error.URLError, TimeoutError, OSError) as e:
+                # Network-level failure (DNS, reset, timeout): keep it
+                # inside the AwsApiError taxonomy so callers' cleanup
+                # and the failover engine's classification still apply.
+                last = AwsApiError(0, 'NetworkError', str(e))
+                if attempt < retries - 1:
+                    time.sleep(2 ** attempt)
+                    continue
+                raise last from e
         assert last is not None
         raise last
 

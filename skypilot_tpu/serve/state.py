@@ -33,11 +33,10 @@ class ReplicaStatus(enum.Enum):
 
 
 def _db() -> sqlite3.Connection:
+    from skypilot_tpu.utils import db_utils
     path = os.path.expanduser(
         os.environ.get('XSKY_SERVE_DB', '~/.xsky/serve.db'))
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    conn = sqlite3.connect(path, timeout=30, check_same_thread=False)
-    conn.execute('PRAGMA journal_mode=WAL')
+    conn = db_utils.connect(path, timeout=30, check_same_thread=False)
     conn.executescript("""
         CREATE TABLE IF NOT EXISTS services (
             name TEXT PRIMARY KEY,
@@ -62,8 +61,8 @@ def _db() -> sqlite3.Connection:
         try:
             conn.execute(f'ALTER TABLE {table} ADD COLUMN '
                          'version INTEGER DEFAULT 1')
-        except sqlite3.OperationalError:
-            pass  # column exists
+        except Exception:  # pylint: disable=broad-except
+            pass  # column exists (sqlite / pg error classes differ)
     conn.commit()
     return conn
 
@@ -76,8 +75,12 @@ def add_service(name: str, task_config: Dict[str, Any],
     with _lock:
         conn = _db()
         conn.execute(
-            'INSERT OR REPLACE INTO services (name, task_config, status, '
-            'lb_port, created_at) VALUES (?, ?, ?, ?, ?)',
+            'INSERT INTO services (name, task_config, status, '
+            'lb_port, created_at) VALUES (?, ?, ?, ?, ?) '
+            'ON CONFLICT(name) DO UPDATE SET '
+            'task_config=excluded.task_config, status=excluded.status, '
+            'lb_port=excluded.lb_port, created_at=excluded.created_at, '
+            'version=1',
             (name, json.dumps(task_config),
              ServiceStatus.CONTROLLER_INIT.value, lb_port, time.time()))
         conn.commit()

@@ -257,9 +257,16 @@ class _Handler(BaseHTTPRequestHandler):
         self.close_connection = True
 
 
+class _ApiServer(ThreadingHTTPServer):
+    # Default listen backlog (5) resets connections under concurrent
+    # client bursts; size for fleets of CLI/SDK pollers.
+    request_queue_size = 128
+    daemon_threads = True
+
+
 def make_server(host: str = '127.0.0.1',
                 port: int = 46580) -> ThreadingHTTPServer:
-    return ThreadingHTTPServer((host, port), _Handler)
+    return _ApiServer((host, port), _Handler)
 
 
 def run(host: str = '127.0.0.1', port: int = 46580) -> None:

@@ -40,7 +40,7 @@ def _normalize_path(path: str) -> str:
         # Only verbs the payload registry knows; scanning /api/aaaN
         # must not mint new label values.
         from skypilot_tpu.server import payloads
-        if payloads.is_known_verb(path[5:]):
+        if payloads.known_verb(path[5:]):
             return path
     return '<other>'
 

@@ -197,10 +197,6 @@ def known_verb(verb: str) -> bool:
     return verb in _VERBS
 
 
-def is_known_verb(verb: str) -> bool:
-    return verb in _VERBS
-
-
 def resolve(verb: str, body: Dict[str, Any]
             ) -> Tuple[Callable, Dict[str, Any]]:
     # `autostop` maps the wire field 'down' onto core's down_on_idle.

@@ -37,16 +37,32 @@ def _db_path() -> str:
         os.environ.get('XSKY_STATE_DB', '~/.xsky/state.db'))
 
 
+def cluster_lock(cluster_name: str, timeout: float = 600.0):
+    """Lock serializing lifecycle ops on one cluster (launch-vs-launch,
+    launch-vs-down races). File lock normally; postgres advisory lock
+    when XSKY_DB_URL is set, so multi-replica API servers serialize
+    too. Twin of the reference's per-cluster filelocks in
+    sky/backends/backend_utils.py."""
+    from skypilot_tpu.utils import db_utils
+    return db_utils.named_lock(
+        f'cluster-{cluster_name}',
+        lock_dir=os.path.join(os.path.dirname(_db_path()), 'locks'),
+        timeout=timeout)
+
+
 def _get_conn() -> sqlite3.Connection:
+    """The cluster-state connection: sqlite by default, postgres when
+    XSKY_DB_URL is set (multi-replica API servers; twin of
+    sky/global_user_state.py:21-26). See utils/db_utils."""
     global _conn, _conn_path
+    from skypilot_tpu.utils import db_utils
     path = _db_path()
+    key = db_utils.db_url() or path
     with _lock:
-        if _conn is None or _conn_path != path:
-            os.makedirs(os.path.dirname(path), exist_ok=True)
-            _conn = sqlite3.connect(path, check_same_thread=False)
-            _conn.execute('PRAGMA journal_mode=WAL')
+        if _conn is None or _conn_path != key:
+            _conn = db_utils.connect(path, check_same_thread=False)
             _create_tables(_conn)
-            _conn_path = path
+            _conn_path = key
         return _conn
 
 

@@ -132,6 +132,7 @@ class LocalProcessCommandRunner(CommandRunner):
         super().__init__(node_id)
         self.host_root = host_root or tempfile.mkdtemp(
             prefix=f'xsky-host-{node_id}-')
+        os.makedirs(self.host_root, exist_ok=True)
 
     def _wrap(self, cmd: Union[str, List[str]],
               env: Optional[Dict[str, str]], cwd: Optional[str]) -> str:
@@ -139,7 +140,10 @@ class LocalProcessCommandRunner(CommandRunner):
             cmd = ' '.join(shlex.quote(c) for c in cmd)
         prefix = _make_env_prefix(env)
         workdir = cwd or self.host_root
-        return f'cd {shlex.quote(workdir)} && {prefix}{cmd}'
+        # `|| exit`, not `&&`: with `cd X && export A; cmd`, a failed cd
+        # would skip only the export and still run cmd env-less in the
+        # wrong directory.
+        return f'cd {shlex.quote(workdir)} || exit 254; {prefix}{cmd}'
 
     def run(self, cmd, *, env=None, cwd=None, stream_logs=False,
             log_path=None, require_outputs=False, timeout=None):
@@ -151,8 +155,11 @@ class LocalProcessCommandRunner(CommandRunner):
     def run_async(self, cmd, *, env=None, log_path=None, cwd=None):
         full = self._wrap(cmd, env, cwd)
         out = open(log_path, 'ab') if log_path else subprocess.DEVNULL
+        # Own session → the gang launcher can kill the whole process
+        # tree (bash + grandchildren), not just the top bash.
         return subprocess.Popen(['bash', '-c', full], stdout=out,
-                                stderr=subprocess.STDOUT)
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True)
 
     def rsync(self, source: str, target: str, *, up: bool, excludes=None):
         # Same convention as every runner: `source` is the LOCAL path,
@@ -212,7 +219,8 @@ class SSHCommandRunner(CommandRunner):
         remote = f'bash --login -c {shlex.quote(prefix + cmd)}'
         out = open(log_path, 'ab') if log_path else subprocess.DEVNULL
         return subprocess.Popen(self._ssh_base() + [remote], stdout=out,
-                                stderr=subprocess.STDOUT)
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True)
 
     def rsync(self, source: str, target: str, *, up: bool, excludes=None):
         ssh_cmd = ' '.join(

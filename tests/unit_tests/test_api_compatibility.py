@@ -1,0 +1,126 @@
+"""Wire-format stability of the client/server API (twin of the
+reference's tests/test_api_compatibility.py).
+
+These tests pin the JSON shapes a vN client depends on; changing them
+breaks deployed CLIs/SDKs talking to a newer server. Extending payloads
+is fine — removing/renaming pinned fields is a compatibility break that
+must bump API_VERSION.
+"""
+import json
+import urllib.request
+
+import pytest
+
+from skypilot_tpu.client import remote_client
+from skypilot_tpu.server import app as server_app
+from skypilot_tpu.server import requests_db
+
+
+@pytest.fixture
+def api(fake_cluster_env, monkeypatch, tmp_path):
+    monkeypatch.setenv('XSKY_SERVER_DB', str(tmp_path / 'req.db'))
+    requests_db.reset_for_test()
+    server, port = server_app.run_in_thread()
+    yield f'http://127.0.0.1:{port}'
+    server.shutdown()
+    requests_db.reset_for_test()
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _post(url, body):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={'Content-Type': 'application/json'}, method='POST')
+    with urllib.request.urlopen(req) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+class TestWireFormat:
+
+    def test_health_shape(self, api):
+        status, payload = _get(f'{api}/health')
+        assert status == 200
+        assert payload['status'] == 'healthy'
+        assert isinstance(payload['api_version'], int)
+        assert payload['api_version'] >= 1
+
+    def test_submit_returns_request_id(self, api):
+        status, payload = _post(f'{api}/api/status', {})
+        assert status == 200
+        assert set(payload) >= {'request_id'}
+        assert isinstance(payload['request_id'], str)
+
+    def test_get_request_lifecycle_shape(self, api):
+        _, submitted = _post(f'{api}/api/status', {})
+        rid = submitted['request_id']
+        import time
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            status, payload = _get(f'{api}/api/get?request_id={rid}')
+            assert status == 200
+            # Pinned envelope for every state.
+            assert set(payload) >= {'request_id', 'name', 'status'}
+            assert payload['name'] == 'status'
+            if payload['status'] == 'SUCCEEDED':
+                assert 'result' in payload
+                break
+            if payload['status'] == 'FAILED':
+                raise AssertionError(payload.get('error'))
+            time.sleep(0.1)
+        else:
+            raise AssertionError('request never finished')
+
+    def test_unknown_request_404_shape(self, api):
+        try:
+            urllib.request.urlopen(f'{api}/api/get?request_id=nope')
+            raise AssertionError('expected 404')
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            assert 'error' in json.loads(e.read())
+
+    def test_launch_result_shape(self, api):
+        """launch → request → result carries job_id + cluster_name."""
+        client = remote_client.RemoteClient(api, poll_interval_s=0.05,
+                                            timeout_s=120)
+        from skypilot_tpu import Resources, Task
+        task = Task('compat', run='echo shape')
+        task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        job_id, handle = client.launch(task, cluster_name='compat-c')
+        assert job_id is not None
+        assert handle.cluster_name == 'compat-c'
+        # status rows: pinned cluster fields.
+        rows = client.status()
+        row = [r for r in rows if r['name'] == 'compat-c'][0]
+        assert set(row) >= {'name', 'status', 'launched_at'}
+        assert row['status'] == 'UP'
+        client.down('compat-c')
+
+    def test_jobs_queue_row_shape(self, api, monkeypatch, tmp_path):
+        monkeypatch.setenv('XSKY_JOBS_DB', str(tmp_path / 'jobs.db'))
+        client = remote_client.RemoteClient(api, poll_interval_s=0.05,
+                                            timeout_s=120)
+        from skypilot_tpu import Resources, Task
+        task = Task('mj', run='echo q')
+        task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        client.jobs_launch(task)
+        rows = client.jobs_queue()
+        assert rows
+        assert set(rows[0]) >= {'job_id', 'name', 'status',
+                                'recovery_count', 'submitted_at'}
+
+    def test_error_serialization_across_wire(self, api):
+        """Server-side exceptions surface as typed, readable errors."""
+        client = remote_client.RemoteClient(api, poll_interval_s=0.05,
+                                            timeout_s=60)
+        from skypilot_tpu import exceptions
+        with pytest.raises(Exception) as exc:
+            client.down('never-existed')
+        assert 'never-existed' in str(exc.value)
+        # The wire carries the exception class name for typed re-raise.
+        assert isinstance(exc.value, exceptions.ClusterDoesNotExist) or \
+            'ClusterDoesNotExist' in str(type(exc.value).__name__) or \
+            'ClusterDoesNotExist' in str(exc.value)
