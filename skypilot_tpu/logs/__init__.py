@@ -1,18 +1,20 @@
 """Cluster log shipping agents (twin of sky/logs/).
 
 An agent renders the setup command that installs a log shipper on every
-cluster host; selection via config key `logs.store` (only 'gcp' today,
-matching the reference's fluentbit→Cloud Logging path).
+cluster host; selection via config key `logs.store` ('gcp' → Cloud
+Logging, 'aws' → CloudWatch, both over fluent-bit like the reference).
 """
 from __future__ import annotations
 
 from typing import Any, Dict
 
 from skypilot_tpu.logs.agent import LoggingAgent
+from skypilot_tpu.logs.aws import AwsLoggingAgent
 from skypilot_tpu.logs.gcp import GcpLoggingAgent
 
 _AGENTS = {
     'gcp': GcpLoggingAgent,
+    'aws': AwsLoggingAgent,
 }
 
 

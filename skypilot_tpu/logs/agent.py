@@ -1,7 +1,18 @@
-"""Logging agent ABC (twin of sky/logs/agent.py)."""
+"""Logging agent ABC + shared fluent-bit scaffold (twin of
+sky/logs/agent.py)."""
 from __future__ import annotations
 
+import shlex
 from typing import Any, Dict
+
+FLUENTBIT_INSTALL = (
+    'command -v fluent-bit >/dev/null || '
+    '(curl -fsSL https://raw.githubusercontent.com/fluent/fluent-bit/'
+    'master/install.sh | sudo sh)')
+
+# fluent-bit does not expand '~' in tail paths; the glob must be
+# absolute. __HOME__ is substituted with $HOME on the host at setup time.
+DEFAULT_LOG_GLOB = '__HOME__/.xsky/logs/*/*.log'
 
 
 class LoggingAgent:
@@ -16,3 +27,16 @@ class LoggingAgent:
 
     def get_credential_file_mounts(self) -> Dict[str, str]:
         return {}
+
+    def _render_setup(self, fluentbit_config: str) -> str:
+        """Install fluent-bit, write the config, start the daemon.
+
+        Install + config-write run in the foreground (failures surface
+        to the provisioner); only the daemon start is backgrounded.
+        """
+        return (f'{FLUENTBIT_INSTALL} && '
+                f'mkdir -p ~/.xsky && '
+                f'printf %s {shlex.quote(fluentbit_config)} | '
+                f'sed "s|__HOME__|$HOME|" > ~/.xsky/fluentbit.conf && '
+                f'(nohup fluent-bit -c ~/.xsky/fluentbit.conf '
+                f'>/dev/null 2>&1 &)')

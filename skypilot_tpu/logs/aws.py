@@ -1,4 +1,4 @@
-"""Fluent Bit → GCP Cloud Logging agent (twin of sky/logs/gcp.py)."""
+"""Fluent Bit → AWS CloudWatch Logs agent (twin of sky/logs/aws.py)."""
 from __future__ import annotations
 
 from typing import Dict
@@ -16,31 +16,30 @@ _CONFIG_TEMPLATE = """\
     tag          xsky.{cluster_name}
 
 [OUTPUT]
-    name         stackdriver
-    match        *
-    resource     global
-    labels       cluster={cluster_name}{extra_labels}
+    name               cloudwatch_logs
+    match              *
+    region             {region}
+    log_group_name     {log_group}
+    log_stream_prefix  {cluster_name}-
+    auto_create_group  On
 """
 
 
-class GcpLoggingAgent(LoggingAgent):
-    """Ships job logs to Cloud Logging via fluent-bit's stackdriver
-    output (uses the host's application-default credentials)."""
+class AwsLoggingAgent(LoggingAgent):
+    """Ships job logs to CloudWatch via fluent-bit's cloudwatch_logs
+    output (uses the host's instance profile / env credentials)."""
 
     def get_setup_command(self, cluster_name: str) -> str:
-        extra = ''
-        for key, value in (self.config.get('labels') or {}).items():
-            extra += f',{key}={value}'
         config = _CONFIG_TEMPLATE.format(
             log_glob=self.config.get('log_glob', DEFAULT_LOG_GLOB),
             cluster_name=cluster_name,
-            extra_labels=extra)
+            region=self.config.get('region', 'us-east-1'),
+            log_group=self.config.get('log_group', 'xsky-logs'))
         return self._render_setup(config)
 
     def get_credential_file_mounts(self) -> Dict[str, str]:
-        path = ('~/.config/gcloud/'
-                'application_default_credentials.json')
         import os
+        path = '~/.aws/credentials'
         if os.path.exists(os.path.expanduser(path)):
             return {path: path}
         return {}

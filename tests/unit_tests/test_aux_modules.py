@@ -173,3 +173,14 @@ class TestLogsAgents:
         assert 'env=prod' in cmd
         with pytest.raises(ValueError):
             logs_lib.get_logging_agent('splunk', {})
+
+    def test_aws_agent_setup_command(self):
+        from skypilot_tpu import logs as logs_lib
+        agent = logs_lib.get_logging_agent(
+            'aws', {'region': 'us-west-2', 'log_group': 'g1'})
+        cmd = agent.get_setup_command('mycluster')
+        assert 'fluent-bit' in cmd
+        assert 'cloudwatch_logs' in cmd
+        assert 'us-west-2' in cmd
+        assert 'g1' in cmd
+        assert 'mycluster-' in cmd
