@@ -34,6 +34,10 @@ def init_logger(name: str) -> logging.Logger:
             root.addHandler(handler)
             root.setLevel(_default_level())
             root.propagate = False
+    if name == '__main__':
+        # `python -m skypilot_tpu.x` imports the module as __main__;
+        # keep its logger under the configured root so INFO still shows.
+        name = f'{_root_name}.__main__'
     return logging.getLogger(name)
 
 

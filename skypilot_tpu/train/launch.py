@@ -52,6 +52,12 @@ def main() -> int:
     parser.add_argument('--n-microbatches', type=int, default=4)
     parser.add_argument('--optimizer', default='adamw')
     parser.add_argument('--learning-rate', type=float, default=3e-4)
+    parser.add_argument('--data', default=None,
+                        help='Token shards: dir | glob | a.bin,b.bin '
+                             '(uint32 streams; native loader w/ python '
+                             'fallback). Default: synthetic batches.')
+    parser.add_argument('--data-workers', type=int, default=2)
+    parser.add_argument('--seed', type=int, default=0)
     parser.add_argument('--checkpoint-dir', default=None)
     parser.add_argument('--checkpoint-every', type=int, default=500)
     parser.add_argument('--resume', default='none',
@@ -61,6 +67,12 @@ def main() -> int:
 
     distributed.initialize()
     import jax  # after distributed init
+    import os
+    if os.environ.get('JAX_PLATFORMS'):
+        # Force-registered accelerator plugins (axon sitecustomize)
+        # override the env var; the config knob wins (same pattern as
+        # tests/conftest.py).
+        jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
 
     from skypilot_tpu.train import trainer as trainer_lib
 
@@ -106,13 +118,45 @@ def main() -> int:
     if state is None:
         state = trainer.init_state()
 
+    feed = None
+    if args.data:
+        from skypilot_tpu.train import data as data_lib
+        paths = data_lib.expand_data_arg(args.data)
+        num_hosts = jax.process_count()
+        if args.global_batch_size % num_hosts:
+            raise ValueError(
+                f'global batch {args.global_batch_size} not divisible '
+                f'by {num_hosts} hosts.')
+        # Each host loads only its shard of the global batch; the
+        # host-strided epoch permutation keeps samples disjoint.
+        loader = data_lib.make_loader(
+            paths, batch=args.global_batch_size // num_hosts,
+            seq=args.seq_len,
+            seed=args.seed, workers=args.data_workers,
+            host_rank=jax.process_index(),
+            num_hosts=num_hosts)
+        logger.info(
+            f'Data: {len(paths)} shard(s), {loader.n_samples} samples '
+            f'of seq {args.seq_len} ({type(loader).__name__}).')
+        feed = data_lib.batches(loader, vocab_size=model.vocab_size)
+
     tokens_per_step = args.global_batch_size * args.seq_len
     flops_per_token = dataclasses.replace(
         model, max_seq_len=args.seq_len).train_flops_per_token()
     t0 = time.perf_counter()
     window_t0, window_steps = t0, 0
     for step in range(start_step, args.steps):
-        batch = trainer.synthetic_batch(step)
+        if feed is not None:
+            host_batch = next(feed)
+            # One transfer: numpy straight onto the sharded layout
+            # (process-local rows on multi-host meshes).
+            batch = {
+                k: jax.make_array_from_process_local_data(
+                    trainer.batch_sharding, v)
+                for k, v in host_batch.items()
+            }
+        else:
+            batch = trainer.synthetic_batch(step)
         state, metrics = trainer.step(state, batch)
         window_steps += 1
         if (step + 1) % args.log_every == 0:

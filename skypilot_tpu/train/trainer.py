@@ -75,6 +75,11 @@ class Trainer:
             self.mesh, PartitionSpec(('data', 'fsdp'), None))
         self._compiled_step = None
 
+    @property
+    def batch_sharding(self) -> NamedSharding:
+        """Sharding for input batches (batch dim over data+fsdp)."""
+        return self._batch_sharding
+
     # ---- state ----
 
     def init_state(self) -> Dict[str, Any]:
