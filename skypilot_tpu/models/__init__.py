@@ -11,22 +11,27 @@ from typing import Any
 
 
 def module_for(config: Any):
-    """Return the model module (llama/moe) that owns `config`."""
+    """Return the model module (llama/moe/gemma) that owns `config`."""
+    from skypilot_tpu.models import gemma
     from skypilot_tpu.models import llama
     from skypilot_tpu.models import moe
     if isinstance(config, moe.MoEConfig):
         return moe
     if isinstance(config, llama.LlamaConfig):
         return llama
+    if isinstance(config, gemma.GemmaConfig):
+        return gemma
     raise TypeError(f'Unknown model config type: {type(config)!r}')
 
 
 def get_config(name: str):
     """Look up a named config across all model families."""
+    from skypilot_tpu.models import gemma
     from skypilot_tpu.models import llama
     from skypilot_tpu.models import moe
-    for mod in (llama, moe):
+    families = (llama, moe, gemma)
+    for mod in families:
         if name in mod.CONFIGS:
             return mod.CONFIGS[name]
-    known = sorted(set(llama.CONFIGS) | set(moe.CONFIGS))
+    known = sorted(set().union(*(mod.CONFIGS for mod in families)))
     raise KeyError(f'Unknown model {name!r}; known: {known}')

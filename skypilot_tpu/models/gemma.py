@@ -1,0 +1,222 @@
+"""Gemma-family decoder-only transformer (second dense family).
+
+Capability twin of the reference's Gemma serving recipes (llm/gemma/);
+in-tree like llama.py so the trainer/inference engine get it for free.
+Architecturally distinct from Llama where Gemma actually differs:
+
+  * tied embeddings — the LM head reuses the (transposed) embedding
+    table, and inputs are scaled by sqrt(d_model);
+  * GeGLU MLP (gelu gate, not silu);
+  * decoupled head_dim (n_heads * head_dim != d_model is legal, e.g.
+    Gemma-2B: d=2048, 8 heads x 256);
+  * RMSNorm with (1 + w) scaling and unit init at zero;
+  * optional logit soft-capping (Gemma-2).
+
+Same functional surface as the other families (CONFIGS, logical_axes,
+init, forward, loss_fn) and the same sharding rules; the scanned-layer
+and chunked-CE machinery is reused from llama.py rather than cloned.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.parallel import mesh as mesh_lib
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class GemmaConfig:
+    vocab_size: int = 256_128
+    d_model: int = 2048
+    n_layers: int = 18
+    n_heads: int = 8
+    n_kv_heads: int = 1
+    head_dim: int = 256
+    d_ff: int = 16_384
+    max_seq_len: int = 8192
+    rope_theta: float = 10_000.0
+    norm_eps: float = 1e-6
+    final_logit_softcap: Optional[float] = None   # Gemma-2: 30.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    remat_policy: str = 'dots'
+    attention_impl: str = 'auto'
+
+    def num_params(self) -> int:
+        d, f, v = self.d_model, self.d_ff, self.vocab_size
+        hd, h, kv = self.head_dim, self.n_heads, self.n_kv_heads
+        attn = d * h * hd * 2 + d * kv * hd * 2
+        mlp = 3 * d * f
+        per_layer = attn + mlp + 2 * d
+        return v * d + self.n_layers * per_layer + d   # tied embedding
+
+    def train_flops_per_token(self) -> float:
+        attn_flops = (12 * self.n_layers * self.n_heads * self.head_dim *
+                      self.max_seq_len)
+        return 6 * self.num_params() + attn_flops
+
+
+GEMMA_2B = GemmaConfig()
+GEMMA_7B = GemmaConfig(d_model=3072, n_layers=28, n_heads=16,
+                       n_kv_heads=16, head_dim=256, d_ff=24_576)
+GEMMA_TINY = GemmaConfig(vocab_size=256, d_model=64, n_layers=2,
+                         n_heads=4, n_kv_heads=2, head_dim=16, d_ff=128,
+                         max_seq_len=128, remat=False,
+                         final_logit_softcap=30.0)
+
+CONFIGS = {
+    'gemma-2b': GEMMA_2B,
+    'gemma-7b': GEMMA_7B,
+    'gemma-tiny': GEMMA_TINY,
+}
+
+
+def logical_axes(config: GemmaConfig) -> Params:
+    del config
+    layer = {
+        'wq': ('layers', 'embed', 'heads'),
+        'wk': ('layers', 'embed', 'kv'),
+        'wv': ('layers', 'embed', 'kv'),
+        'wo': ('layers', 'heads', 'embed'),
+        'w_gate': ('layers', 'embed', 'mlp'),
+        'w_up': ('layers', 'embed', 'mlp'),
+        'w_down': ('layers', 'mlp', 'embed'),
+        'attn_norm': ('layers', 'embed'),
+        'mlp_norm': ('layers', 'embed'),
+    }
+    return {
+        'embed': ('vocab', 'embed'),
+        'layers': layer,
+        'final_norm': ('embed',),
+    }
+
+
+def init(config: GemmaConfig, key: jax.Array) -> Params:
+    c = config
+    hd = c.head_dim
+    keys = jax.random.split(key, 8)
+
+    def dense(k, shape, fan_in):
+        return (jax.random.truncated_normal(k, -2, 2, shape, jnp.float32) *
+                (fan_in ** -0.5)).astype(c.dtype)
+
+    def stack(k, shape, fan_in):
+        return dense(k, (c.n_layers,) + shape, fan_in)
+
+    return {
+        'embed': dense(keys[0], (c.vocab_size, c.d_model), c.d_model),
+        'layers': {
+            'wq': stack(keys[1], (c.d_model, c.n_heads * hd), c.d_model),
+            'wk': stack(keys[2], (c.d_model, c.n_kv_heads * hd),
+                        c.d_model),
+            'wv': stack(keys[3], (c.d_model, c.n_kv_heads * hd),
+                        c.d_model),
+            'wo': stack(keys[4], (c.n_heads * hd, c.d_model),
+                        c.n_heads * hd),
+            'w_gate': stack(keys[5], (c.d_model, c.d_ff), c.d_model),
+            'w_up': stack(keys[6], (c.d_model, c.d_ff), c.d_model),
+            'w_down': stack(keys[7], (c.d_ff, c.d_model), c.d_ff),
+            # Gemma RMSNorm scales by (1 + w): zero-init == identity.
+            'attn_norm': jnp.zeros((c.n_layers, c.d_model), c.dtype),
+            'mlp_norm': jnp.zeros((c.n_layers, c.d_model), c.dtype),
+        },
+        'final_norm': jnp.zeros((c.d_model,), c.dtype),
+    }
+
+
+def _rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    normed = x32 * jax.lax.rsqrt(var + eps)
+    return (normed * (1.0 + scale.astype(jnp.float32))).astype(x.dtype)
+
+
+def _layer(config: GemmaConfig, mesh: Optional[mesh_lib.Mesh],
+           x: jax.Array, lp: Params, positions: jax.Array) -> jax.Array:
+    c = config
+    hd = c.head_dim
+    b, s, _ = x.shape
+
+    def shard(arr, axes):
+        if mesh is None:
+            return arr
+        return mesh_lib.shard_logical(arr, mesh, axes)
+
+    h = _rms_norm(x, lp['attn_norm'], c.norm_eps)
+    q = (h @ lp['wq']).reshape(b, s, c.n_heads, hd)
+    k = (h @ lp['wk']).reshape(b, s, c.n_kv_heads, hd)
+    v = (h @ lp['wv']).reshape(b, s, c.n_kv_heads, hd)
+    q = shard(q, ('batch', 'activation_length', 'activation_heads', None))
+    # Gemma rope/theta; reuse the llama rotary helper.
+    q = llama._rope(q, positions, c.rope_theta)
+    k = llama._rope(k, positions, c.rope_theta)
+    attn = attention_ops.dot_product_attention(
+        q, k, v, causal=True, implementation=c.attention_impl)
+    attn = attn.reshape(b, s, c.n_heads * hd)
+    x = x + shard(attn @ lp['wo'],
+                  ('batch', 'activation_length', 'activation_embed'))
+
+    h = _rms_norm(x, lp['mlp_norm'], c.norm_eps)
+    gate = jax.nn.gelu((h @ lp['w_gate']).astype(jnp.float32),
+                       approximate=True)
+    up = (h @ lp['w_up']).astype(jnp.float32)
+    ff = shard((gate * up).astype(c.dtype),
+               ('batch', 'activation_length', 'activation_mlp'))
+    x = x + shard(ff @ lp['w_down'],
+                  ('batch', 'activation_length', 'activation_embed'))
+    return x
+
+
+def forward(config: GemmaConfig, params: Params, tokens: jax.Array,
+            mesh: Optional[mesh_lib.Mesh] = None,
+            positions: Optional[jax.Array] = None) -> jax.Array:
+    """Training forward → fp32 logits (tied-embedding head)."""
+    c = config
+    if positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1])[None, :], tokens.shape)
+    x = llama._embed_lookup(params['embed'], tokens, mesh).astype(c.dtype)
+    x = x * jnp.asarray(c.d_model ** 0.5, c.dtype)  # Gemma input scaling
+    if mesh is not None:
+        x = mesh_lib.shard_logical(
+            x, mesh, ('batch', 'activation_length', 'activation_embed'))
+
+    def layer_fn(x, lp):
+        return _layer(c, mesh, x, lp, positions), None
+
+    if c.remat:
+        layer_fn = jax.checkpoint(layer_fn,
+                                  policy=llama._remat_policy(c))
+    x, _ = jax.lax.scan(layer_fn, x, params['layers'])
+    x = _rms_norm(x, params['final_norm'], c.norm_eps)
+    logits = jnp.einsum('bsd,vd->bsv', x, params['embed'],
+                        preferred_element_type=jnp.float32)
+    if c.final_logit_softcap:
+        cap = c.final_logit_softcap
+        logits = cap * jnp.tanh(logits / cap)
+    return logits
+
+
+def loss_fn(config: GemmaConfig, params: Params, tokens: jax.Array,
+            targets: jax.Array, mesh: Optional[mesh_lib.Mesh] = None,
+            loss_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Mean next-token cross-entropy (fp32).
+
+    The tied, soft-capped head cannot reuse llama's chunked-CE scan
+    as-is; logits are materialized whole, which is fine for Gemma's
+    shorter training contexts (chunked variant: follow-up if an 8k+
+    Gemma train config lands).
+    """
+    logits = forward(config, params, tokens, mesh=mesh)
+    nll = llama._token_nll(logits, targets)
+    if loss_mask is not None:
+        return jnp.sum(nll * loss_mask) / jnp.maximum(
+            jnp.sum(loss_mask), 1.0)
+    return jnp.mean(nll)

@@ -191,7 +191,9 @@ void* xsky_dl_open(const char** paths, int n_paths, int batch, int seq,
     void* m = mmap(nullptr, s.map_bytes, PROT_READ, MAP_PRIVATE,
                    s.fd, 0);
     if (m == MAP_FAILED) { close(s.fd); delete L; return nullptr; }
-    madvise(m, s.map_bytes, MADV_SEQUENTIAL);
+    // Samples are read at shuffled offsets: random advice avoids
+    // readahead churn on multi-GB shards.
+    madvise(m, s.map_bytes, MADV_RANDOM);
     s.tokens = static_cast<const uint32_t*>(m);
     s.n_tokens = s.map_bytes / sizeof(uint32_t);
     L->shard_offset.push_back(L->total_tokens);
@@ -245,9 +247,14 @@ long long xsky_dl_num_samples(void* handle) {
 
 void xsky_dl_close(void* handle) {
   auto* L = static_cast<Loader*>(handle);
-  L->stop.store(true);
-  L->cv_ready.notify_all();
-  L->cv_space.notify_all();
+  {
+    // Under mu: a worker between its predicate check and blocking
+    // would otherwise miss the notify and deadlock the join.
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->stop.store(true);
+    L->cv_ready.notify_all();
+    L->cv_space.notify_all();
+  }
   for (auto& t : L->workers) t.join();
   delete L;
 }

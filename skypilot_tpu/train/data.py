@@ -192,9 +192,18 @@ class NativeTokenLoader:
 
 def make_loader(paths: Sequence[str], batch: int, seq: int,
                 seed: int = 0, workers: int = 2, host_rank: int = 0,
-                num_hosts: int = 1, prefer_native: bool = True):
-    """Native loader when buildable, python twin otherwise."""
-    if prefer_native:
+                num_hosts: int = 1, flavor: str = 'auto'):
+    """Pick the loader flavor: 'native' | 'python' | 'auto'.
+
+    The two flavors shuffle with different RNGs, so hosts MUST agree on
+    one — a mixed fleet would break epoch disjointness (duplicated and
+    skipped samples). 'auto' therefore only falls back to python on
+    single-host runs; multi-host runs fail fast with instructions
+    instead of silently degrading.
+    """
+    if flavor not in ('auto', 'native', 'python'):
+        raise ValueError(f"flavor {flavor!r}: expected auto|native|python")
+    if flavor != 'python':
         try:
             return NativeTokenLoader(paths, batch, seq, seed=seed,
                                      workers=workers,
@@ -202,7 +211,17 @@ def make_loader(paths: Sequence[str], batch: int, seq: int,
                                      num_hosts=num_hosts)
         except (RuntimeError, OSError) as e:
             # OSError: stale/foreign-arch cached .so (shared home dirs
-            # across heterogeneous hosts) — fall back, don't crash.
+            # across heterogeneous hosts).
+            if flavor == 'native':
+                raise RuntimeError(
+                    f'native data loader unavailable: {e}') from e
+            if num_hosts > 1:
+                raise RuntimeError(
+                    f'native data loader unavailable on host '
+                    f'{host_rank} ({e}). Multi-host runs must use one '
+                    'flavor fleet-wide: install a C++ toolchain '
+                    'everywhere, or pass --data-loader python on every '
+                    'host.') from e
             logger.warning(f'{e}; falling back to python loader.')
     return PyTokenLoader(paths, batch, seq, seed=seed,
                          host_rank=host_rank, num_hosts=num_hosts)

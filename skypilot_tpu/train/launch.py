@@ -57,6 +57,10 @@ def main() -> int:
                              '(uint32 streams; native loader w/ python '
                              'fallback). Default: synthetic batches.')
     parser.add_argument('--data-workers', type=int, default=2)
+    parser.add_argument('--data-loader', default='auto',
+                        choices=['auto', 'native', 'python'],
+                        help='Loader flavor; hosts must agree (the two '
+                             'flavors shuffle differently).')
     parser.add_argument('--seed', type=int, default=0)
     parser.add_argument('--checkpoint-dir', default=None)
     parser.add_argument('--checkpoint-every', type=int, default=500)
@@ -134,7 +138,7 @@ def main() -> int:
             seq=args.seq_len,
             seed=args.seed, workers=args.data_workers,
             host_rank=jax.process_index(),
-            num_hosts=num_hosts)
+            num_hosts=num_hosts, flavor=args.data_loader)
         logger.info(
             f'Data: {len(paths)} shard(s), {loader.n_samples} samples '
             f'of seq {args.seq_len} ({type(loader).__name__}).')

@@ -138,7 +138,8 @@ class Trainer:
                     mesh=self.mesh, n_microbatches=c.n_microbatches,
                     loss_mask=batch.get('mask'))
             kwargs = {}
-            if self._model_lib is not llama:
+            from skypilot_tpu.models import moe
+            if self._model_lib is moe:
                 # MoE: pads are excluded from routing; the loss mask (which
                 # targets count) is a separate concern.
                 kwargs['token_mask'] = batch.get('token_mask')

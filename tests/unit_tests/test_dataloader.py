@@ -189,3 +189,17 @@ class TestExpandDataArg:
             data_lib.NativeTokenLoader(shards, batch=1, seq=6000,
                                        seed=0, host_rank=1,
                                        num_hosts=16)
+
+    def test_multihost_mixed_flavor_fails_fast(self, shards,
+                                               monkeypatch):
+        """Fallback would desync epoch permutations across hosts —
+        multi-host runs must error instead."""
+        monkeypatch.setattr(data_lib, 'build_native_lib', lambda: None)
+        with pytest.raises(RuntimeError, match='fleet-wide'):
+            data_lib.make_loader(shards, batch=2, seq=64, host_rank=0,
+                                 num_hosts=4)
+        # Explicit python flavor is fine on any topology.
+        loader = data_lib.make_loader(shards, batch=2, seq=64,
+                                      host_rank=0, num_hosts=4,
+                                      flavor='python')
+        assert isinstance(loader, data_lib.PyTokenLoader)

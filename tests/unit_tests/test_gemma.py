@@ -1,0 +1,113 @@
+"""Gemma model family: forward/loss correctness, tied head, softcap,
+trainer integration on the 8-device mesh."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu import models
+from skypilot_tpu.models import gemma
+from skypilot_tpu.parallel import mesh as mesh_lib
+
+
+@pytest.fixture(scope='module')
+def tiny():
+    return gemma.GEMMA_TINY
+
+
+@pytest.fixture(scope='module')
+def params(tiny):
+    return gemma.init(tiny, jax.random.PRNGKey(0))
+
+
+class TestGemmaForward:
+
+    def test_logits_shape_and_dtype(self, tiny, params):
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        logits = gemma.forward(tiny, params, tokens)
+        assert logits.shape == (2, 16, tiny.vocab_size)
+        assert logits.dtype == jnp.float32
+
+    def test_softcap_bounds_logits(self, tiny, params):
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                    tiny.vocab_size)
+        logits = gemma.forward(tiny, params, tokens)
+        assert float(jnp.abs(logits).max()) <= tiny.final_logit_softcap
+
+    def test_tied_head_no_separate_lm_head(self, params):
+        assert 'lm_head' not in params
+        # Tied: changing the embedding changes the head projection.
+
+    def test_causality(self, tiny, params):
+        """Changing a future token must not affect earlier logits."""
+        t1 = jnp.zeros((1, 8), jnp.int32)
+        t2 = t1.at[0, 7].set(5)
+        l1 = gemma.forward(tiny, params, t1)
+        l2 = gemma.forward(tiny, params, t2)
+        np.testing.assert_allclose(np.asarray(l1[0, :7]),
+                                   np.asarray(l2[0, :7]), atol=1e-5)
+
+    def test_identity_norm_at_init(self, tiny, params):
+        """(1+w) RMSNorm with zero-init weights == plain normalization;
+        the forward must produce finite, non-degenerate logits."""
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 8), 0,
+                                    tiny.vocab_size)
+        logits = gemma.forward(tiny, params, tokens)
+        assert bool(jnp.isfinite(logits).all())
+        assert float(jnp.std(logits)) > 0
+
+    def test_loss_decreases_under_sgd(self, tiny):
+        params = gemma.init(tiny, jax.random.PRNGKey(3))
+        tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0,
+                                    tiny.vocab_size)
+        targets = jnp.roll(tokens, -1, axis=1)
+
+        loss0, grads = jax.value_and_grad(
+            lambda p: gemma.loss_fn(tiny, p, tokens, targets))(params)
+        params2 = jax.tree.map(
+            lambda p, g: (p - 0.5 * g.astype(p.dtype)), params, grads)
+        loss1 = gemma.loss_fn(tiny, params2, tokens, targets)
+        assert float(loss1) < float(loss0)
+
+    def test_registry_dispatch(self, tiny):
+        assert models.module_for(tiny) is gemma
+        assert models.get_config('gemma-tiny') is gemma.GEMMA_TINY
+        # Llama configs are NOT claimed by gemma (distinct types).
+        from skypilot_tpu.models import llama
+        assert models.module_for(llama.LLAMA_TINY) is llama
+
+
+class TestGemmaSharded:
+
+    def test_trainer_step_on_mesh(self, tiny):
+        """Full trainer step over a dp×tp mesh (fsdp on embed)."""
+        from skypilot_tpu.train import trainer as trainer_lib
+        plan = mesh_lib.MeshPlan(data=2, fsdp=2, tensor=2)
+        config = trainer_lib.TrainConfig(
+            model=dataclasses.replace(tiny, remat=True),
+            global_batch_size=4, seq_len=32,
+            optimizer='adafactor', warmup_steps=1,
+            mesh_plan=plan)
+        trainer = trainer_lib.Trainer(config)
+        state = trainer.init_state()
+        batch = trainer.synthetic_batch(0)
+        # Step 1 burns the zero-LR warmup step; learning shows from
+        # step 2 on.
+        state, metrics = trainer.step(state, batch)
+        state, metrics = trainer.step(state, batch)
+        loss_a = float(metrics['loss'])
+        state, metrics = trainer.step(state, batch)
+        assert float(metrics['loss']) < loss_a  # learns on repeat batch
+
+    def test_sharded_matches_single_device(self, tiny, params):
+        tokens = jax.random.randint(jax.random.PRNGKey(5), (4, 16), 0,
+                                    tiny.vocab_size)
+        targets = jnp.roll(tokens, -1, axis=1)
+        ref = gemma.loss_fn(tiny, params, tokens, targets)
+        mesh = mesh_lib.build_mesh(
+            mesh_lib.MeshPlan(data=2, fsdp=2, tensor=2).resolve(8))
+        sharded = gemma.loss_fn(tiny, params, tokens, targets, mesh=mesh)
+        np.testing.assert_allclose(float(ref), float(sharded),
+                                   rtol=2e-3)
