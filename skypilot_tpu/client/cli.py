@@ -232,6 +232,21 @@ def queue(cluster):
 
 @cli.command()
 @click.argument('cluster')
+@click.argument('command', nargs=-1)
+def ssh(cluster, command):
+    """Open a shell (or run COMMAND) on the cluster head.
+
+    With a remote API server configured, the connection tunnels
+    through it (HTTP CONNECT), so heads without public IPs work.
+    """
+    import subprocess
+    from skypilot_tpu.client import sdk
+    argv, cwd = sdk.ssh_command(cluster, command=list(command) or None)
+    raise SystemExit(subprocess.call(argv, cwd=cwd))
+
+
+@cli.command()
+@click.argument('cluster')
 @click.argument('job_id', type=int, required=False)
 @click.option('--sync-down', is_flag=True, default=False,
               help='Download the job log directories instead of '

@@ -139,6 +139,78 @@ def sync_down_logs(cluster_name: str, job_id: Optional[int] = None,
                                    local_dir=local_dir)
 
 
+def _ssh_argv_for_runner(runner, command: Optional[List[str]]
+                         ) -> Tuple[List[str], Optional[str]]:
+    from skypilot_tpu.utils import command_runner as runner_lib
+    if isinstance(runner, runner_lib.LocalProcessCommandRunner):
+        argv = ['bash']
+        if command:
+            import shlex as shlex_lib
+            argv += ['-c', ' '.join(shlex_lib.quote(c)
+                                    for c in command)]
+        return argv, runner.host_root
+    if isinstance(runner, runner_lib.SSHCommandRunner):
+        # Reuse the runner's option set (key, port, known-hosts,
+        # keepalives, jump-host ProxyCommand) — interactive sessions
+        # must reach the host the same way lifecycle ops do.
+        argv = runner.ssh_base()
+        if not runner.ssh_proxy_command:
+            endpoint = api_server_endpoint()
+            if endpoint:
+                # No provisioner jump host: ride the API server's
+                # CONNECT tunnel (heads without public IPs).
+                import shlex as shlex_lib
+                import sys
+                proxy = (f'{shlex_lib.quote(sys.executable)} -m '
+                         f'skypilot_tpu.templates.tunnel_proxy %h %p '
+                         f'--server {endpoint}')
+                argv += ['-o', f'ProxyCommand={proxy}']
+        argv.append(f'{runner.ssh_user}@{runner.ip}')
+        if command:
+            argv += list(command)
+        return argv, None
+    if isinstance(runner, runner_lib.KubernetesCommandRunner):
+        base = runner.kubectl_base() + ['exec']
+        if command:
+            return (base + ['-c', runner.container, runner.pod_name,
+                            '--'] + list(command), None)
+        return (base + ['-it', '-c', runner.container, runner.pod_name,
+                        '--', 'bash'], None)
+    raise exceptions.NotSupportedError(
+        f'ssh not supported for {type(runner).__name__}.')
+
+
+def ssh_command(cluster_name: str,
+                command: Optional[List[str]] = None
+                ) -> Tuple[List[str], Optional[str]]:
+    """(argv, cwd) opening a shell (or running `command`) on the head.
+
+    Twin of `sky ssh`: direct ssh when the head is reachable; with a
+    remote API server configured, the connection rides the server's
+    CONNECT tunnel via ProxyCommand (templates/tunnel_proxy). Local/fake
+    clusters get a bash rooted at the host's scratch dir so the verb is
+    exercisable in tests.
+
+    Remote-server mode requires the cluster's ssh key to exist on this
+    machine (keys are not transferred over the API).
+    """
+    from skypilot_tpu import state as state_lib
+    record = state_lib.get_cluster_from_name(cluster_name)
+    if record is None or record['handle'] is None:
+        if _remote() is not None:
+            raise exceptions.NotSupportedError(
+                f'Cluster {cluster_name!r} is not in the local state '
+                'DB. `xsky ssh` against a remote API server needs the '
+                'cluster record (and its ssh key) on this machine — '
+                'run it on the API-server host, or launch from here.')
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    if record['status'] != state_lib.ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is {record["status"].value}.',
+            cluster_status=record['status'])
+    return _ssh_argv_for_runner(record['handle'].head_runner(), command)
+
+
 def check(quiet: bool = False) -> Dict[str, Any]:
     return _local_or_remote('check', quiet=quiet)
 

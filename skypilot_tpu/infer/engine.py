@@ -54,6 +54,11 @@ class InferenceEngine:
             raise NotImplementedError(
                 'MoE serving is not wired into the slot engine yet; '
                 'the decode path is Llama-only (dense MLP KV layout).')
+        if type(config.model) is not llama.LlamaConfig:
+            raise NotImplementedError(
+                f'Serving is wired for the Llama family only; '
+                f'{type(config.model).__name__} needs its own '
+                'prefill/decode path (e.g. gemma tied-embedding head).')
         self.config = config
         self.params = params
         self.mesh = mesh

@@ -13,8 +13,10 @@ Architecturally distinct from Llama where Gemma actually differs:
   * optional logit soft-capping (Gemma-2).
 
 Same functional surface as the other families (CONFIGS, logical_axes,
-init, forward, loss_fn) and the same sharding rules; the scanned-layer
-and chunked-CE machinery is reused from llama.py rather than cloned.
+init, forward, loss_fn) and the same sharding rules, so the *trainer*
+dispatches to it for free; the slot inference engine is still
+Llama-only (a tied-head prefill/decode path is a follow-up and the
+engine rejects gemma configs explicitly).
 """
 from __future__ import annotations
 

@@ -187,6 +187,12 @@ class SSHCommandRunner(CommandRunner):
             tempfile.gettempdir(),
             f'xsky-ssh-{ssh_user}-{ip}-{port}')
 
+    def ssh_base(self) -> List[str]:
+        """Public ssh argv prefix (options incl. key, port, proxy) —
+        reused by `xsky ssh` so interactive sessions get the same
+        known-hosts/keepalive/jump-host behavior as the runner."""
+        return self._ssh_base()
+
     def _ssh_base(self) -> List[str]:
         args = ['ssh'] + SSH_COMMON_OPTS + [
             '-i', self.ssh_private_key,
@@ -253,6 +259,10 @@ class KubernetesCommandRunner(CommandRunner):
         self.namespace = namespace
         self.context = context
         self.container = container
+
+    def kubectl_base(self) -> List[str]:
+        """Public kubectl argv prefix (context/namespace)."""
+        return self._kubectl_base()
 
     def _kubectl_base(self) -> List[str]:
         cmd = ['kubectl']

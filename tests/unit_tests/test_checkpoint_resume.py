@@ -1,0 +1,78 @@
+"""Checkpoint/resume through the real training entrypoint.
+
+The spot-recovery story (jobs relaunch + `--resume auto` against a
+bucket mount) depends on orbax restoring sharded train state correctly;
+this drives train.launch as real subprocesses — save, die, resume —
+like a preempted job would (SURVEY §5 checkpoint/resume)."""
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+
+def _run_launch(tmp_path, extra, timeout=280):
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               XLA_FLAGS='--xla_force_host_platform_device_count=2')
+    cmd = [
+        sys.executable, '-m', 'skypilot_tpu.train.launch',
+        '--model', 'tiny', '--global-batch-size', '2',
+        '--seq-len', '32', '--log-every', '1',
+        '--optimizer', 'adafactor',
+        '--checkpoint-dir', str(tmp_path / 'ckpt'),
+    ] + extra
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc.stdout + proc.stderr
+
+
+@pytest.mark.slow
+class TestCheckpointResume:
+
+    def test_resume_continues_from_saved_step(self, tmp_path):
+        out1 = _run_launch(tmp_path,
+                           ['--steps', '3', '--checkpoint-every', '2'])
+        assert 'step 3/3' in out1
+        ckpt_root = tmp_path / 'ckpt'
+        saved = sorted(int(p) for p in os.listdir(ckpt_root)
+                       if p.isdigit())
+        assert 3 in saved  # final step always checkpointed
+
+        out2 = _run_launch(tmp_path,
+                           ['--steps', '5', '--resume', 'auto',
+                            '--checkpoint-every', '2'])
+        assert 'Resumed from checkpoint step 3' in out2
+        # Only steps 4..5 run; step 1-3 logs must not reappear.
+        assert 'step 4/5' in out2
+        assert 'step 5/5' in out2
+        assert 'step 1/5' not in out2
+
+    def test_resume_losses_continue_not_restart(self, tmp_path):
+        """The restored state must carry optimizer momentum + params:
+        the resumed first-step loss matches an uninterrupted run's
+        loss at that step, not the from-scratch loss."""
+        def losses(text):
+            return [float(m) for m in re.findall(
+                r'loss=([0-9.]+)', text)]
+
+        # Uninterrupted 4 steps.
+        solid = _run_launch(tmp_path / 'solid',
+                            ['--steps', '4', '--checkpoint-every', '99'])
+        # 2 steps, save, resume to 4.
+        _run_launch(tmp_path / 'split',
+                    ['--steps', '2', '--checkpoint-every', '2'])
+        resumed = _run_launch(tmp_path / 'split',
+                              ['--steps', '4', '--resume', 'auto',
+                               '--checkpoint-every', '99'])
+        solid_losses = losses(solid)
+        resumed_losses = losses(resumed)
+        assert len(solid_losses) == 4
+        assert len(resumed_losses) == 2  # steps 3 and 4 only
+        # Synthetic batches are step-seeded, so the trajectories line
+        # up exactly when state round-trips correctly.
+        assert solid_losses[2] == pytest.approx(resumed_losses[0],
+                                                rel=1e-4)
+        assert solid_losses[3] == pytest.approx(resumed_losses[1],
+                                                rel=1e-4)

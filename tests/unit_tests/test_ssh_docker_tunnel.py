@@ -224,3 +224,100 @@ class TestConnectTunnel:
             server.shutdown()
             state.reset_for_test()
             requests_db.reset_for_test()
+
+
+class TestSshVerb:
+    """`xsky ssh` command construction (twin of sky ssh)."""
+
+    def test_local_cluster_gets_bash_at_host_root(self,
+                                                  fake_cluster_env):
+        from skypilot_tpu import Resources, Task, core, execution
+        from skypilot_tpu.client import sdk
+        task = Task('sshv', run='echo up')
+        task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        execution.launch(task, cluster_name='ssh-c')
+        argv, cwd = sdk.ssh_command('ssh-c')
+        assert argv == ['bash']
+        import os
+        assert cwd and os.path.isdir(cwd)
+        # Running a command through the verb's argv works.
+        import subprocess
+        out = subprocess.run(argv + ['-c', 'pwd'], cwd=cwd,
+                             capture_output=True, text=True)
+        assert out.stdout.strip() == os.path.realpath(cwd) or \
+            out.stdout.strip() == cwd
+        core.down('ssh-c', purge=True)
+
+    def test_unknown_cluster_raises(self, fake_cluster_env):
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.client import sdk
+        with pytest.raises(exceptions.ClusterDoesNotExist):
+            sdk.ssh_command('nope')
+
+    def test_ssh_runner_argv_includes_proxy_when_remote(
+            self, fake_cluster_env, monkeypatch):
+        from skypilot_tpu.client import sdk
+        from skypilot_tpu.utils import command_runner
+
+        class FakeHandle:
+            def head_runner(self):
+                return command_runner.SSHCommandRunner(
+                    '10.9.8.7', 'tpuuser', '~/.ssh/k', port=2222)
+
+        from skypilot_tpu import state as state_lib
+        monkeypatch.setattr(
+            state_lib, 'get_cluster_from_name',
+            lambda name: {'handle': FakeHandle(),
+                          'status': state_lib.ClusterStatus.UP})
+        monkeypatch.setenv('XSKY_API_SERVER', 'http://api:46580')
+        argv, cwd = sdk.ssh_command('any')
+        assert cwd is None
+        assert argv[0] == 'ssh'
+        assert 'tpuuser@10.9.8.7' in argv
+        assert '2222' in argv
+        joined = ' '.join(argv)
+        assert 'ProxyCommand=' in joined
+        assert 'tunnel_proxy' in joined
+        assert 'http://api:46580' in joined
+        # Without a remote endpoint: no proxy.
+        monkeypatch.delenv('XSKY_API_SERVER')
+        argv2, _ = sdk.ssh_command('any')
+        assert 'ProxyCommand' not in ' '.join(argv2)
+
+    def test_command_mode_quotes_for_bash(self, fake_cluster_env):
+        from skypilot_tpu import Resources, Task, core, execution
+        from skypilot_tpu.client import sdk
+        task = Task('sshc', run='echo up')
+        task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        execution.launch(task, cluster_name='ssh-cmd')
+        import subprocess
+        argv, cwd = sdk.ssh_command('ssh-cmd',
+                                    command=['echo', 'a b', '&&', 'pwd'])
+        out = subprocess.run(argv, cwd=cwd, capture_output=True,
+                             text=True)
+        # Words are quoted: '&&' is a literal argument, not an operator.
+        assert out.stdout.strip() == 'a b && pwd'
+        core.down('ssh-cmd', purge=True)
+
+    def test_jump_host_proxy_preserved(self, monkeypatch):
+        from skypilot_tpu.client import sdk
+        from skypilot_tpu import state as state_lib
+        from skypilot_tpu.utils import command_runner
+
+        class FakeHandle:
+            def head_runner(self):
+                return command_runner.SSHCommandRunner(
+                    '10.0.0.2', 'u', '~/.ssh/k',
+                    ssh_proxy_command='ssh -W %h:%p jump@bastion')
+
+        monkeypatch.setattr(
+            state_lib, 'get_cluster_from_name',
+            lambda name: {'handle': FakeHandle(),
+                          'status': state_lib.ClusterStatus.UP})
+        monkeypatch.setenv('XSKY_API_SERVER', 'http://api:46580')
+        argv, _ = sdk.ssh_command('j')
+        joined = ' '.join(argv)
+        # The provisioner's jump host wins; the API tunnel must not
+        # clobber it.
+        assert 'bastion' in joined
+        assert 'tunnel_proxy' not in joined
