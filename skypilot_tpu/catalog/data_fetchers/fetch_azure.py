@@ -1,0 +1,93 @@
+"""Generate the Azure catalog CSV (twin of
+sky/catalog/data_fetchers/fetch_azure.py).
+
+The reference queries the Azure Retail Prices API per region; this
+environment is zero-egress, so the checked-in CSV is generated from a
+static table of the GPU/CPU SKUs the optimizer needs for cross-cloud
+ranking (ND A100/H100, NC A100 v4, NCsv3 V100, NVads A10, D-series
+CPU). Prices are representative public on-demand/spot rates (eastus,
+2024-era); regenerate against the live Retail Prices API when egress
+exists.
+
+Run: python -m skypilot_tpu.catalog.data_fetchers.fetch_azure
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Tuple
+
+# (instance_type, acc_name, acc_count, vcpus, mem_gib, acc_mem_gib,
+#  price, spot_price)
+_SKUS: List[Tuple[str, str, float, float, float, float, float, float]] = [
+    # CPU-only tiers (controllers / default instance type).
+    ('Standard_D2s_v5', '', 0, 2, 8, 0, 0.0960, 0.0251),
+    ('Standard_D4s_v5', '', 0, 4, 16, 0, 0.1920, 0.0502),
+    ('Standard_D8s_v5', '', 0, 8, 32, 0, 0.3840, 0.1004),
+    ('Standard_D16s_v5', '', 0, 16, 64, 0, 0.7680, 0.2008),
+    ('Standard_D32s_v5', '', 0, 32, 128, 0, 1.5360, 0.4016),
+    # V100 (NCsv3).
+    ('Standard_NC6s_v3', 'V100', 1, 6, 112, 16, 3.0600, 0.6732),
+    ('Standard_NC12s_v3', 'V100', 2, 12, 224, 32, 6.1200, 1.3464),
+    ('Standard_NC24s_v3', 'V100', 4, 24, 448, 64, 12.2400, 2.6928),
+    # A100 80GB (NC A100 v4 / ND A100 v4).
+    ('Standard_NC24ads_A100_v4', 'A100-80GB', 1, 24, 220, 80,
+     3.6730, 1.4692),
+    ('Standard_NC48ads_A100_v4', 'A100-80GB', 2, 48, 440, 160,
+     7.3460, 2.9384),
+    ('Standard_NC96ads_A100_v4', 'A100-80GB', 4, 96, 880, 320,
+     14.6920, 5.8768),
+    ('Standard_ND96asr_v4', 'A100', 8, 96, 900, 320, 27.1970, 10.8788),
+    ('Standard_ND96amsr_A100_v4', 'A100-80GB', 8, 96, 1900, 640,
+     32.7700, 13.1080),
+    # H100 (ND H100 v5).
+    ('Standard_ND96isr_H100_v5', 'H100', 8, 96, 1900, 640,
+     98.3200, 39.3280),
+    # A10 (NVadsA10 v5) — the budget tier.
+    ('Standard_NV6ads_A10_v5', 'A10', 0.167, 6, 55, 4, 0.4540, 0.0999),
+    ('Standard_NV36ads_A10_v5', 'A10', 1, 36, 440, 24, 3.2000, 0.7040),
+    ('Standard_NV72ads_A10_v5', 'A10', 2, 72, 880, 48, 6.5200, 1.4344),
+    # T4 (NCasT4 v3).
+    ('Standard_NC4as_T4_v3', 'T4', 1, 4, 28, 16, 0.5260, 0.1157),
+    ('Standard_NC64as_T4_v3', 'T4', 4, 64, 440, 64, 4.3520, 0.9574),
+]
+
+# Region multipliers approximate real cross-region price spreads.
+_REGIONS: List[Tuple[str, List[str], float]] = [
+    ('eastus', ['eastus-1', 'eastus-2'], 1.00),
+    ('westus2', ['westus2-1', 'westus2-2'], 1.00),
+    ('westeurope', ['westeurope-1', 'westeurope-2'], 1.15),
+]
+
+HEADER = ['InstanceType', 'AcceleratorName', 'AcceleratorCount', 'vCPUs',
+          'MemoryGiB', 'AcceleratorMemoryGiB', 'Price', 'SpotPrice',
+          'Region', 'AvailabilityZone']
+
+
+def rows() -> List[List[str]]:
+    out = []
+    for (itype, acc, count, vcpus, mem, acc_mem, price,
+         spot) in _SKUS:
+        for region, zones, mult in _REGIONS:
+            for zone in zones:
+                out.append([
+                    itype, acc, f'{count:g}', f'{vcpus:g}', f'{mem:g}',
+                    f'{acc_mem:g}', f'{price * mult:.4f}',
+                    f'{spot * mult:.4f}', region, zone,
+                ])
+    return out
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, 'data', 'azure', 'catalog.csv')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.writer(f)
+        writer.writerow(HEADER)
+        writer.writerows(rows())
+    print(f'Wrote {path}')
+
+
+if __name__ == '__main__':
+    main()

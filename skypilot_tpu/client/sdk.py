@@ -167,7 +167,11 @@ def _ssh_argv_for_runner(runner, command: Optional[List[str]]
                 argv += ['-o', f'ProxyCommand={proxy}']
         argv.append(f'{runner.ssh_user}@{runner.ip}')
         if command:
-            argv += list(command)
+            # The remote shell re-splits whatever ssh sends: quote each
+            # word so 'echo a b' and literal '&&' survive intact (same
+            # contract as the local-runner path above).
+            import shlex as shlex_lib
+            argv.append(' '.join(shlex_lib.quote(c) for c in command))
         return argv, None
     if isinstance(runner, runner_lib.KubernetesCommandRunner):
         base = runner.kubectl_base() + ['exec']

@@ -3,10 +3,11 @@ from skypilot_tpu.clouds.cloud import Cloud
 from skypilot_tpu.clouds.cloud import CloudImplementationFeatures
 from skypilot_tpu.clouds.cloud import Region
 from skypilot_tpu.clouds.aws import AWS
+from skypilot_tpu.clouds.azure import Azure
 from skypilot_tpu.clouds.fake import Fake
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.ssh import SSH
 
 __all__ = ['Cloud', 'CloudImplementationFeatures', 'Region', 'GCP', 'Fake',
-           'Kubernetes', 'SSH']
+           'AWS', 'Azure', 'Kubernetes', 'SSH']

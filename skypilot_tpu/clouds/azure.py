@@ -1,0 +1,88 @@
+"""Azure cloud: VM GPU/CPU offerings for cross-cloud optimization.
+
+Lean twin of sky/clouds/azure.py — catalog-backed feasibility via
+CatalogCloud, ARM deploy variables for the 'azure' provisioner
+(provision/azure/instance.py), service-principal credential probing.
+Third compute cloud next to GCP and AWS, so optimizer failover can walk
+GCP TPU → AWS GPU → Azure GPU.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import catalog_cloud
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@registry.CLOUD_REGISTRY.register(aliases=['az'])
+class Azure(catalog_cloud.CatalogCloud):
+    _REPR = 'Azure'
+    # Azure VM names cap at 64, but NIC/IP names get suffixes appended.
+    _MAX_CLUSTER_NAME_LEN_LIMIT = 42
+
+    def unsupported_features_for_resources(
+        self, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        del resources
+        return {
+            cloud_lib.CloudImplementationFeatures.TPU_POD:
+                'Azure has no TPUs.',
+            cloud_lib.CloudImplementationFeatures.TPU_MULTISLICE:
+                'Azure has no TPUs.',
+        }
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        from skypilot_tpu import authentication
+        vars: Dict[str, Any] = {
+            'cluster_name': cluster_name,
+            'region': region,
+            'zone': zone,
+            'instance_type': resources.instance_type,
+            'use_spot': resources.use_spot,
+            'disk_size': resources.disk_size,
+            'ports': resources.ports,
+            'labels': dict(resources.labels or {}),
+            'image_id': resources.image_id,
+            # ARM rejects a Linux VM with password auth disabled and no
+            # key, and the lifecycle ops all reach nodes over SSH.
+            'ssh_user': 'azureuser',
+            'ssh_public_key': authentication.public_key_content(),
+        }
+        if resources.accelerators:
+            name, count = next(iter(resources.accelerators.items()))
+            vars.update({'gpu_type': name, 'gpu_count': count})
+        return vars
+
+    def provider_config_overrides(
+            self, node_config: Dict[str, Any]) -> Dict[str, Any]:
+        # get_cluster_info builds runners with provider_config's
+        # ssh_user; keep it in lockstep with the osProfile adminUsername.
+        return {'ssh_user': node_config.get('ssh_user', 'azureuser')}
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.azure import rest as azure_rest
+        if azure_rest.load_credentials() is not None:
+            return True, None
+        return False, (
+            'Azure credentials not found. Set AZURE_TENANT_ID / '
+            'AZURE_CLIENT_ID / AZURE_CLIENT_SECRET / '
+            'AZURE_SUBSCRIPTION_ID or populate ~/.azure/credentials.')
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        path = os.path.expanduser('~/.azure/credentials')
+        if os.path.exists(path):
+            return {'~/.azure/credentials': '~/.azure/credentials'}
+        return {}
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        if num_gigabytes <= 0:
+            return 0.0
+        return 0.087 * num_gigabytes

@@ -51,6 +51,7 @@ class GCP(catalog_cloud.CatalogCloud):
     def make_deploy_resources_variables(
             self, resources: 'resources_lib.Resources', cluster_name: str,
             region: str, zone: Optional[str]) -> Dict[str, Any]:
+        from skypilot_tpu import authentication
         vars: Dict[str, Any] = {
             'cluster_name': cluster_name,
             'region': region,
@@ -61,6 +62,13 @@ class GCP(catalog_cloud.CatalogCloud):
             'ports': resources.ports,
             'labels': dict(resources.labels or {}),
             'image_id': resources.image_id,
+            # Our keypair rides the `ssh-keys` metadata entry (both the
+            # compute and TPU create bodies forward node_config
+            # metadata) so freshly created hosts are reachable without
+            # OS Login / project-wide keys.
+            'ssh_user': authentication.DEFAULT_SSH_USER,
+            'metadata': {
+                'ssh-keys': authentication.gcp_ssh_keys_metadata()},
         }
         topo = self.tpu_topology_of(resources)
         if topo is not None:

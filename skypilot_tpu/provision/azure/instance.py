@@ -1,0 +1,391 @@
+"""Azure VM provisioner op-set (lean twin of sky/provision/azure/instance.py).
+
+Dispatched by provider name 'azure'. The cluster boundary is a dedicated
+resource group ``xsky-<cluster>-rg`` — the Azure-idiomatic version of the
+tag-tracking the AWS/GCP providers use: every resource (VNet, NICs,
+public IPs, VMs, disks) lives in it, so teardown is one resource-group
+delete and there is nothing to leak. VMs carry the same
+``xsky-cluster`` / ``xsky-head`` / ``xsky-node-index`` tags as the other
+providers so shared code can stay provider-agnostic.
+
+Spot capacity uses VM ``priority: Spot`` with Deallocate eviction.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.azure import rest
+
+logger = sky_logging.init_logger(__name__)
+
+CLUSTER_TAG = 'xsky-cluster'
+HEAD_TAG = 'xsky-head'
+NODE_INDEX_TAG = 'xsky-node-index'
+
+DEFAULT_IMAGE = {
+    'publisher': 'Canonical',
+    'offer': '0001-com-ubuntu-server-jammy',
+    'sku': '22_04-lts-gen2',
+    'version': 'latest',
+}
+
+# Pluggable transport for tests (scripted fake ARM).
+_transport_factory = rest.Transport
+
+
+def set_transport_factory(factory) -> None:
+    global _transport_factory
+    _transport_factory = factory
+
+
+def _rg(cluster_name: str) -> str:
+    return f'xsky-{cluster_name}-rg'
+
+
+def _transport(provider_config: Dict[str, Any]) -> rest.Transport:
+    region = provider_config.get('region')
+    if not region:
+        raise exceptions.InvalidSkyTpuConfigError(
+            'Azure provider_config requires region.')
+    return _transport_factory(region)
+
+
+_POWER_MAP = {
+    'PowerState/starting': 'PENDING',
+    'PowerState/running': 'RUNNING',
+    'PowerState/stopping': 'STOPPING',
+    'PowerState/stopped': 'STOPPING',       # OS stopped, still billed
+    'PowerState/deallocating': 'STOPPING',
+    'PowerState/deallocated': 'STOPPED',
+}
+
+
+def _power_state(vm: Dict[str, Any]) -> str:
+    view = vm.get('properties', {}).get('instanceView', {})
+    for status in view.get('statuses', []):
+        code = status.get('code', '')
+        if code.startswith('PowerState/'):
+            return _POWER_MAP.get(code, 'PENDING')
+    return 'PENDING'
+
+
+def _compute_path(cluster_name: str, suffix: str = '') -> str:
+    return (f'/resourceGroups/{_rg(cluster_name)}/providers'
+            f'/Microsoft.Compute{suffix}')
+
+
+def _network_path(cluster_name: str, suffix: str = '') -> str:
+    return (f'/resourceGroups/{_rg(cluster_name)}/providers'
+            f'/Microsoft.Network{suffix}')
+
+
+def _list_vms(t: rest.Transport, cluster_name: str,
+              expand_view: bool = True) -> List[Dict[str, Any]]:
+    suffix = '/virtualMachines'
+    if expand_view:
+        suffix += '?$expand=instanceView'
+    try:
+        reply = t.call('GET', _compute_path(cluster_name, suffix))
+    except rest.AzureApiError as e:
+        if e.code in ('NotFound', 'ResourceGroupNotFound'):
+            return []
+        raise
+    return list(reply.get('value', []))
+
+
+def _sorted_nodes(vms: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    def key(vm):
+        idx = (vm.get('tags') or {}).get(NODE_INDEX_TAG, '')
+        return (int(idx) if idx.isdigit() else 10**6, vm.get('name', ''))
+    return sorted(vms, key=key)
+
+
+def _ensure_network(t: rest.Transport, cluster_name: str,
+                    region: str) -> str:
+    """Resource group + VNet/subnet; returns the subnet resource id."""
+    t.call('PUT', f'/resourceGroups/{_rg(cluster_name)}',
+           {'location': region, 'tags': {CLUSTER_TAG: cluster_name}})
+    vnet_path = _network_path(cluster_name,
+                              f'/virtualNetworks/{cluster_name}-vnet')
+    t.call('PUT', vnet_path, {
+        'location': region,
+        'properties': {
+            'addressSpace': {'addressPrefixes': ['10.40.0.0/16']},
+            'subnets': [{
+                'name': 'default',
+                'properties': {'addressPrefix': '10.40.0.0/20'},
+            }],
+        },
+    })
+    vnet = t.wait_provisioned(vnet_path)
+    subnets = vnet.get('properties', {}).get('subnets', [])
+    if subnets and subnets[0].get('id'):
+        return subnets[0]['id']
+    # NIC bodies need the full ARM id (the relative path only works for
+    # our own transport calls).
+    return (f'/subscriptions/{t.subscription}{vnet_path}/subnets/default')
+
+
+def _create_node(t: rest.Transport, cluster_name: str, region: str,
+                 subnet_id: str, index: int, is_head: bool,
+                 node_cfg: Dict[str, Any]) -> str:
+    name = f'{cluster_name}-{index}'
+    ip_path = _network_path(cluster_name, f'/publicIPAddresses/{name}-ip')
+    t.call('PUT', ip_path, {
+        'location': region,
+        'sku': {'name': 'Standard'},
+        'properties': {'publicIPAllocationMethod': 'Static'},
+    })
+    ip_id = t.wait_provisioned(ip_path).get('id', ip_path)
+    nic_path = _network_path(cluster_name,
+                             f'/networkInterfaces/{name}-nic')
+    t.call('PUT', nic_path, {
+        'location': region,
+        'properties': {
+            'ipConfigurations': [{
+                'name': 'primary',
+                'properties': {
+                    'subnet': {'id': subnet_id},
+                    'publicIPAddress': {'id': ip_id},
+                },
+            }],
+        },
+    })
+    nic_id = t.wait_provisioned(nic_path).get('id', nic_path)
+
+    tags = {CLUSTER_TAG: cluster_name, NODE_INDEX_TAG: str(index)}
+    if is_head:
+        tags[HEAD_TAG] = 'true'
+    image = node_cfg.get('image_id')
+    image_ref = ({'id': image} if image and image.startswith('/')
+                 else DEFAULT_IMAGE if not image else
+                 dict(zip(('publisher', 'offer', 'sku', 'version'),
+                          image.split(':'))))
+    body: Dict[str, Any] = {
+        'location': region,
+        'tags': tags,
+        'properties': {
+            'hardwareProfile': {'vmSize': node_cfg['instance_type']},
+            'storageProfile': {
+                'imageReference': image_ref,
+                'osDisk': {
+                    'createOption': 'FromImage',
+                    'diskSizeGB': int(node_cfg.get('disk_size') or 256),
+                    'managedDisk': {
+                        'storageAccountType': 'Premium_LRS'},
+                },
+            },
+            'osProfile': {
+                'computerName': name,
+                'adminUsername': node_cfg.get('ssh_user', 'azureuser'),
+                'linuxConfiguration': {
+                    'disablePasswordAuthentication': True,
+                    'ssh': {'publicKeys': [{
+                        'path': ('/home/'
+                                 f'{node_cfg.get("ssh_user", "azureuser")}'
+                                 '/.ssh/authorized_keys'),
+                        'keyData': node_cfg.get('ssh_public_key', ''),
+                    }]},
+                },
+            },
+            'networkProfile': {'networkInterfaces': [{'id': nic_id}]},
+        },
+    }
+    if node_cfg.get('use_spot'):
+        body['properties']['priority'] = 'Spot'
+        body['properties']['evictionPolicy'] = 'Deallocate'
+        body['properties']['billingProfile'] = {'maxPrice': -1}
+    t.call('PUT', _compute_path(cluster_name, f'/virtualMachines/{name}'),
+           body)
+    return name
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    node_cfg = config.node_config
+    t = _transport(config.provider_config)
+    created: List[str] = []
+    resumed: List[str] = []
+    try:
+        existing = _sorted_nodes(_list_vms(t, cluster_name))
+        if config.resume_stopped_nodes:
+            for vm in existing:
+                if _power_state(vm) == 'STOPPED':
+                    t.call('POST', _compute_path(
+                        cluster_name,
+                        f'/virtualMachines/{vm["name"]}/start'))
+                    resumed.append(vm['name'])
+        have = len(existing)
+        missing = config.count - have
+        if missing > 0:
+            subnet_id = _ensure_network(t, cluster_name, region)
+            has_head = any((vm.get('tags') or {}).get(HEAD_TAG) == 'true'
+                           for vm in existing)
+            for node in range(missing):
+                created.append(_create_node(
+                    t, cluster_name, region, subnet_id,
+                    index=have + node,
+                    is_head=(not has_head and node == 0),
+                    node_cfg=node_cfg))
+            # VM PUT is an LRO: surface allocation failures (capacity)
+            # here, inside the failover-classified scope.
+            for name in created:
+                t.wait_provisioned(_compute_path(
+                    cluster_name, f'/virtualMachines/{name}'))
+    except rest.AzureApiError as e:
+        # Partial gang cleanup. Fresh cluster: the resource group is
+        # this attempt's whole blast radius — delete it so the failover
+        # retry (next region) starts from zero. Scale-up/resume of an
+        # existing cluster: only this attempt's VMs may go; the healthy
+        # fleet (and its disks/network) must survive.
+        try:
+            if created and not existing:
+                t.call('DELETE', f'/resourceGroups/{_rg(cluster_name)}'
+                       '?forceDeletionTypes='
+                       'Microsoft.Compute/virtualMachines')
+            else:
+                for name in created:
+                    t.call('DELETE', _compute_path(
+                        cluster_name, f'/virtualMachines/{name}'))
+        except rest.AzureApiError as cleanup_err:
+            logger.warning(
+                f'Cleanup of partial attempt failed: {cleanup_err}')
+        raise rest.classify_error(e, zone or region) from e
+    head = None
+    for vm in _sorted_nodes(_list_vms(t, cluster_name,
+                                      expand_view=False)):
+        if (vm.get('tags') or {}).get(HEAD_TAG) == 'true':
+            head = vm['name']
+            break
+    return common.ProvisionRecord(
+        provider_name='azure', cluster_name=cluster_name, region=region,
+        zone=zone, resumed_instance_ids=resumed,
+        created_instance_ids=created, head_instance_id=head)
+
+
+def wait_instances(region: str, cluster_name: str, state: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   timeout_s: float = 600.0,
+                   poll_interval_s: float = 5.0) -> None:
+    t = _transport(provider_config or {'region': region})
+    want = 'RUNNING' if state == 'RUNNING' else state
+    expected = {vm['name'] for vm in _list_vms(t, cluster_name,
+                                               expand_view=False)}
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        vms = _list_vms(t, cluster_name)
+        alive = {vm['name'] for vm in vms}
+        lost = expected - alive
+        if lost:
+            raise exceptions.CapacityError(
+                f'VM(s) {sorted(lost)} disappeared while waiting for '
+                f'{state} (spot eviction during boot?).')
+        if vms and all(_power_state(vm) == want for vm in vms):
+            return
+        time.sleep(poll_interval_s)
+    raise exceptions.ProvisionError(
+        f'Cluster {cluster_name!r} did not reach {state} within '
+        f'{timeout_s}s.')
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    t = _transport(provider_config)
+    for vm in _list_vms(t, cluster_name):
+        if _power_state(vm) in ('PENDING', 'RUNNING'):
+            t.call('POST', _compute_path(
+                cluster_name,
+                f'/virtualMachines/{vm["name"]}/deallocate'))
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    t = _transport(provider_config)
+    try:
+        t.call('DELETE', f'/resourceGroups/{_rg(cluster_name)}'
+               '?forceDeletionTypes=Microsoft.Compute/virtualMachines')
+    except rest.AzureApiError as e:
+        if e.code not in ('NotFound', 'ResourceGroupNotFound'):
+            raise
+
+
+def query_instances(cluster_name: str, provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    t = _transport(provider_config)
+    # Terminated nodes are gone from the listing (the resource group is
+    # the blast radius), so every listed VM has a live status.
+    return {vm['name']: _power_state(vm)
+            for vm in _list_vms(t, cluster_name)}
+
+
+def _nic_ips(t: rest.Transport, cluster_name: str,
+             vm: Dict[str, Any]) -> Dict[str, Optional[str]]:
+    """{internal, external} for the VM's primary NIC."""
+    nics = vm.get('properties', {}).get('networkProfile', {}).get(
+        'networkInterfaces', [])
+    if not nics:
+        return {'internal': '', 'external': None}
+    nic_id = nics[0].get('id', '')
+    nic_name = nic_id.rsplit('/', 1)[-1]
+    nic = t.call('GET', _network_path(
+        cluster_name, f'/networkInterfaces/{nic_name}'))
+    internal, external = '', None
+    for ipcfg in nic.get('properties', {}).get('ipConfigurations', []):
+        props = ipcfg.get('properties', {})
+        internal = props.get('privateIPAddress', internal)
+        pub = props.get('publicIPAddress', {})
+        if pub.get('id'):
+            ip_name = pub['id'].rsplit('/', 1)[-1]
+            ip = t.call('GET', _network_path(
+                cluster_name, f'/publicIPAddresses/{ip_name}'))
+            external = ip.get('properties', {}).get('ipAddress', external)
+    return {'internal': internal, 'external': external}
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
+    del region
+    t = _transport(provider_config)
+    vms = _sorted_nodes(_list_vms(t, cluster_name))
+    if not vms:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    instances: Dict[str, common.InstanceInfo] = {}
+    head_id: Optional[str] = None
+    for vm in vms:
+        tags = dict(vm.get('tags') or {})
+        ips = _nic_ips(t, cluster_name, vm)
+        info = common.InstanceInfo(
+            instance_id=vm['name'],
+            internal_ip=ips['internal'],
+            external_ip=ips['external'],
+            status=_power_state(vm) or 'PENDING',
+            tags=tags,
+        )
+        instances[info.instance_id] = info
+        if tags.get(HEAD_TAG) == 'true' and head_id is None:
+            head_id = info.instance_id
+    if head_id is None:
+        head_id = sorted(instances)[0]
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head_id,
+        provider_name='azure',
+        provider_config=dict(provider_config or {}),
+        ssh_user=provider_config.get('ssh_user', 'azureuser'))
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    """No-op: the lean network has no NSG, so the subnet admits all
+    inbound traffic already (Azure only filters when an NSG is
+    attached). Kept as an explicit op so the dispatcher contract holds.
+    """
+    del cluster_name, ports, provider_config
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    del cluster_name, provider_config  # resource-group delete covers it

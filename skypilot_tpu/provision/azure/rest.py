@@ -1,0 +1,227 @@
+"""Minimal Azure Resource Manager (ARM) JSON transport — no azure-sdk.
+
+The reference drives Azure through the azure-mgmt SDK behind a lazy
+adaptor (sky/adaptors/azure.py:482); this image has no Azure SDK, and
+the op-set needs only a handful of ARM resource verbs, so the transport
+is a hand-rolled REST client: OAuth2 client-credentials token against
+login.microsoftonline.com, JSON bodies against management.azure.com,
+with LRO (202 + provisioningState) polling. Fully testable by injecting
+a fake transport (same pattern as provision/aws/rest.py and
+provision/gcp/rest.py).
+
+Credentials (service principal), in order:
+  1. AZURE_TENANT_ID / AZURE_CLIENT_ID / AZURE_CLIENT_SECRET /
+     AZURE_SUBSCRIPTION_ID env vars;
+  2. ~/.azure/credentials (ini: [default] tenant_id/client_id/
+     client_secret/subscription_id).
+"""
+from __future__ import annotations
+
+import configparser
+import json
+import os
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+ARM_ENDPOINT = 'https://management.azure.com'
+LOGIN_ENDPOINT = 'https://login.microsoftonline.com'
+API_VERSIONS = {
+    'Microsoft.Resources': '2022-09-01',
+    'Microsoft.Compute': '2023-07-01',
+    'Microsoft.Network': '2023-05-01',
+}
+
+_RETRYABLE_CODES = ('TooManyRequests', 'InternalServerError',
+                    'ServerTimeout', 'RetryableError')
+
+
+class AzureApiError(exceptions.ProvisionError):
+    """ARM error with the parsed error.code/error.message."""
+
+    def __init__(self, status: int, code: str, message: str) -> None:
+        super().__init__(f'Azure API error {status} ({code}): {message}')
+        self.status = status
+        self.code = code
+        self.message = message
+
+
+def classify_error(e: AzureApiError, zone: Optional[str]) -> Exception:
+    """Map ARM error codes onto the failover taxonomy (role of the
+    reference's FailoverCloudErrorHandlerV2._azure_handler)."""
+    code = e.code
+    if code in ('SkuNotAvailable', 'AllocationFailed',
+                'ZonalAllocationFailed', 'OverconstrainedAllocationRequest',
+                'OverconstrainedZonalAllocationRequest'):
+        return exceptions.CapacityError(
+            f'No capacity in {zone or "region"}: {e.message}')
+    if code in ('QuotaExceeded', 'OperationNotAllowed'):
+        # OperationNotAllowed is ARM's quota wrapper ("exceeding approved
+        # ... cores quota").
+        if 'quota' in e.message.lower() or code == 'QuotaExceeded':
+            return exceptions.QuotaExceededError(e.message)
+        return e
+    if code in ('AuthorizationFailed', 'InvalidAuthenticationToken',
+                'AuthenticationFailed'):
+        return exceptions.PermissionError_(e.message)
+    if code in ('InvalidParameter', 'InvalidRequestFormat',
+                'BadRequest') or code.startswith('InvalidResource'):
+        return exceptions.InvalidRequestError(e.message)
+    return e
+
+
+def load_credentials() -> Optional[Dict[str, str]]:
+    """{tenant, client, secret, subscription} or None."""
+    keys = ('AZURE_TENANT_ID', 'AZURE_CLIENT_ID', 'AZURE_CLIENT_SECRET',
+            'AZURE_SUBSCRIPTION_ID')
+    if all(os.environ.get(k) for k in keys):
+        return {
+            'tenant': os.environ['AZURE_TENANT_ID'],
+            'client': os.environ['AZURE_CLIENT_ID'],
+            'secret': os.environ['AZURE_CLIENT_SECRET'],
+            'subscription': os.environ['AZURE_SUBSCRIPTION_ID'],
+        }
+    path = os.path.expanduser('~/.azure/credentials')
+    if os.path.exists(path):
+        parser = configparser.ConfigParser()
+        parser.read(path)
+        if parser.has_section('default'):
+            sec = parser['default']
+            if all(sec.get(k) for k in ('tenant_id', 'client_id',
+                                        'client_secret', 'subscription_id')):
+                return {
+                    'tenant': sec['tenant_id'],
+                    'client': sec['client_id'],
+                    'secret': sec['client_secret'],
+                    'subscription': sec['subscription_id'],
+                }
+    return None
+
+
+class Transport:
+    """Authenticated ARM calls for one subscription.
+
+    ``call(method, path, body)`` — path is relative to the subscription
+    root (``/resourceGroups/...``) unless it starts with
+    '/subscriptions'. Caches the bearer token until ~5 min before
+    expiry.
+    """
+
+    def __init__(self, region: str) -> None:
+        self.region = region
+        creds = load_credentials()
+        if creds is None:
+            raise exceptions.PermissionError_(
+                'No Azure credentials (set AZURE_TENANT_ID / '
+                'AZURE_CLIENT_ID / AZURE_CLIENT_SECRET / '
+                'AZURE_SUBSCRIPTION_ID or ~/.azure/credentials).')
+        self.creds = creds
+        self.subscription = creds['subscription']
+        self._token: Optional[str] = None
+        self._token_expiry = 0.0
+
+    # -- auth --
+
+    def _bearer(self) -> str:
+        if self._token and time.time() < self._token_expiry - 300:
+            return self._token
+        body = urllib.parse.urlencode({
+            'grant_type': 'client_credentials',
+            'client_id': self.creds['client'],
+            'client_secret': self.creds['secret'],
+            'scope': f'{ARM_ENDPOINT}/.default',
+        }).encode()
+        url = (f'{LOGIN_ENDPOINT}/{self.creds["tenant"]}'
+               '/oauth2/v2.0/token')
+        req = urllib.request.Request(url, data=body, method='POST')
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                tok = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            raise AzureApiError(e.code, 'AuthenticationFailed',
+                                e.read().decode(errors='replace')) from e
+        self._token = tok['access_token']
+        self._token_expiry = time.time() + float(
+            tok.get('expires_in', 3600))
+        return self._token
+
+    # -- REST --
+
+    def _api_version(self, path: str) -> str:
+        for provider, version in API_VERSIONS.items():
+            if provider in path:
+                return version
+        return API_VERSIONS['Microsoft.Resources']
+
+    def call(self, method: str, path: str,
+             body: Optional[Dict[str, Any]] = None,
+             retries: int = 3) -> Dict[str, Any]:
+        if not path.startswith('/subscriptions'):
+            path = f'/subscriptions/{self.subscription}{path}'
+        sep = '&' if '?' in path else '?'
+        url = (f'{ARM_ENDPOINT}{path}{sep}'
+               f'api-version={self._api_version(path)}')
+        data = json.dumps(body).encode() if body is not None else None
+        last: Optional[AzureApiError] = None
+        for attempt in range(retries):
+            headers = {
+                'Authorization': f'Bearer {self._bearer()}',
+                'Content-Type': 'application/json',
+            }
+            req = urllib.request.Request(url, data=data, headers=headers,
+                                         method=method)
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    raw = resp.read()
+                    return json.loads(raw) if raw else {}
+            except urllib.error.HTTPError as e:
+                raw = e.read()
+                code, message = 'Unknown', raw.decode(errors='replace')
+                try:
+                    err = json.loads(raw).get('error', {})
+                    code = err.get('code', code)
+                    message = err.get('message', message)
+                except (json.JSONDecodeError, AttributeError):
+                    pass
+                if e.code == 404:
+                    raise AzureApiError(404, 'NotFound', message) from e
+                last = AzureApiError(e.code, code, message)
+                if code in _RETRYABLE_CODES and attempt < retries - 1:
+                    time.sleep(2 ** attempt)
+                    continue
+                raise last from e
+            except (urllib.error.URLError, TimeoutError, OSError) as e:
+                last = AzureApiError(0, 'NetworkError', str(e))
+                if attempt < retries - 1:
+                    time.sleep(2 ** attempt)
+                    continue
+                raise last from e
+        assert last is not None
+        raise last
+
+    def wait_provisioned(self, path: str, timeout_s: float = 600.0,
+                         poll_interval_s: float = 5.0) -> Dict[str, Any]:
+        """Poll an ARM resource until provisioningState settles."""
+        deadline = time.time() + timeout_s
+        while True:
+            resource = self.call('GET', path)
+            state = resource.get('properties', {}).get(
+                'provisioningState', 'Succeeded')
+            if state == 'Succeeded':
+                return resource
+            if state in ('Failed', 'Canceled'):
+                raise AzureApiError(
+                    200, 'ProvisioningFailed',
+                    f'{path} provisioningState={state}')
+            if time.time() > deadline:
+                raise AzureApiError(
+                    200, 'ProvisioningTimeout',
+                    f'{path} stuck in {state} after {timeout_s}s')
+            time.sleep(poll_interval_s)

@@ -2,10 +2,12 @@
 RequestRateAutoscaler:441, hysteresis :357)."""
 from __future__ import annotations
 
+import collections
 import dataclasses
 import math
+import threading
 import time
-from typing import List, Optional
+from typing import Deque, Optional
 
 from skypilot_tpu.serve import service_spec as spec_lib
 
@@ -60,27 +62,36 @@ class RequestRateAutoscaler(Autoscaler):
 
     def __init__(self, spec: spec_lib.SkyServiceSpec) -> None:
         super().__init__(spec)
-        self._request_timestamps: List[float] = []
+        # Appended from every LB handler thread, trimmed from the
+        # controller tick thread — guard with a lock; a deque keeps the
+        # trim O(expired) instead of rebuilding the whole window.
+        self._request_timestamps: Deque[float] = collections.deque()
+        self._window_lock = threading.Lock()
         self._upscale_since: Optional[float] = None
         self._downscale_since: Optional[float] = None
 
     def collect_request_information(self, num_requests: int,
                                     window_seconds: float = 0.0) -> None:
         now = time.time()
-        self._request_timestamps.extend([now] * num_requests)
         cutoff = now - self.QPS_WINDOW_SECONDS
-        self._request_timestamps = [
-            t for t in self._request_timestamps if t >= cutoff
-        ]
+        with self._window_lock:
+            ts = self._request_timestamps
+            ts.extend([now] * num_requests)
+            while ts and ts[0] < cutoff:
+                ts.popleft()
 
     def inherit_state(self, old: 'Autoscaler') -> None:
         super().inherit_state(old)
         if isinstance(old, RequestRateAutoscaler):
-            self._request_timestamps = list(old._request_timestamps)
+            with old._window_lock:
+                snapshot = list(old._request_timestamps)
+            with self._window_lock:
+                self._request_timestamps = collections.deque(snapshot)
 
     def current_qps(self) -> float:
         self.collect_request_information(0)
-        return len(self._request_timestamps) / self.QPS_WINDOW_SECONDS
+        with self._window_lock:
+            return len(self._request_timestamps) / self.QPS_WINDOW_SECONDS
 
     def evaluate(self, num_ready_replicas: int) -> AutoscalerDecision:
         spec = self.spec

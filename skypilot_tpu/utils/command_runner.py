@@ -188,12 +188,14 @@ class SSHCommandRunner(CommandRunner):
             f'xsky-ssh-{ssh_user}-{ip}-{port}')
 
     def ssh_base(self) -> List[str]:
-        """Public ssh argv prefix (options incl. key, port, proxy) —
-        reused by `xsky ssh` so interactive sessions get the same
-        known-hosts/keepalive/jump-host behavior as the runner."""
-        return self._ssh_base()
+        """Public ssh argv prefix (options incl. key, port, proxy),
+        WITHOUT the destination — reused by `xsky ssh`, which appends
+        its own extra options and then ``user@ip``. ssh stops option
+        parsing at the first non-option argument, so the destination
+        must come last."""
+        return self._ssh_opts()
 
-    def _ssh_base(self) -> List[str]:
+    def _ssh_opts(self) -> List[str]:
         args = ['ssh'] + SSH_COMMON_OPTS + [
             '-i', self.ssh_private_key,
             '-p', str(self.port),
@@ -203,7 +205,10 @@ class SSHCommandRunner(CommandRunner):
         ]
         if self.ssh_proxy_command:
             args += ['-o', f'ProxyCommand={self.ssh_proxy_command}']
-        return args + [f'{self.ssh_user}@{self.ip}']
+        return args
+
+    def _ssh_base(self) -> List[str]:
+        return self._ssh_opts() + [f'{self.ssh_user}@{self.ip}']
 
     def run(self, cmd, *, env=None, cwd=None, stream_logs=False,
             log_path=None, require_outputs=False, timeout=None):

@@ -1,0 +1,332 @@
+"""Azure cloud + ARM provisioner tests against an in-memory ARM fake.
+
+Same role as test_aws.py's FakeEc2 (and moto in the reference,
+tests/test_failover.py:34-60): scripted allocation failures, no network.
+Also extends the cross-cloud story to three compute clouds: the
+optimizer ranks Azure A100s against AWS and GCP, and provision-level
+failover walks AWS → Azure → GCP.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+import pytest
+
+from skypilot_tpu import Resources, Task
+from skypilot_tpu import check as check_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.azure import instance as az_instance
+from skypilot_tpu.provision.azure import rest as az_rest
+
+
+class FakeArm:
+    """Minimal in-memory ARM: resource tree keyed by path, VM power
+    states, scripted VM-create failures."""
+
+    def __init__(self) -> None:
+        self.resources: Dict[str, Dict[str, Any]] = {}
+        self.fail_vm_create: List[az_rest.AzureApiError] = []
+        self.calls: List[str] = []
+        self.subscription = 'sub-test'
+
+    def transport_factory(self, region: str) -> 'FakeArm._Transport':
+        return FakeArm._Transport(self, region)
+
+    # Path helpers ------------------------------------------------------
+
+    @staticmethod
+    def _norm(path: str) -> str:
+        return path.split('?', 1)[0]
+
+    def _rg_of(self, path: str) -> Optional[str]:
+        m = re.search(r'/resourceGroups/([^/]+)', path)
+        return m.group(1) if m else None
+
+    # ARM verbs ---------------------------------------------------------
+
+    class _Transport:
+
+        def __init__(self, fake: 'FakeArm', region: str) -> None:
+            self.fake = fake
+            self.region = region
+            self.subscription = fake.subscription
+
+        def call(self, method: str, path: str,
+                 body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+            self.fake.calls.append(f'{method} {path.split("?")[0]}')
+            return self.fake.handle(method, path, body)
+
+        def wait_provisioned(self, path: str, **kwargs) -> Dict[str, Any]:
+            return self.fake.handle('GET', path, None)
+
+    def handle(self, method: str, path: str,
+               body: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        full = path if path.startswith('/subscriptions') else \
+            f'/subscriptions/{self.subscription}{path}'
+        key = self._norm(full)
+        if method == 'PUT':
+            return self._put(key, dict(body or {}))
+        if method == 'GET':
+            return self._get(key)
+        if method == 'POST':
+            return self._post(key)
+        if method == 'DELETE':
+            return self._delete(key)
+        raise AssertionError(method)
+
+    def _put(self, key: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        if '/virtualMachines/' in key and self.fail_vm_create:
+            raise self.fail_vm_create.pop(0)
+        body.setdefault('id', key)
+        body['name'] = key.rsplit('/', 1)[-1]
+        props = body.setdefault('properties', {})
+        props['provisioningState'] = 'Succeeded'
+        if '/virtualNetworks/' in key and '/subnets/' not in key:
+            for sub in props.get('subnets', []):
+                sub['id'] = f'{key}/subnets/{sub["name"]}'
+        if '/publicIPAddresses/' in key:
+            n = len([k for k in self.resources
+                     if '/publicIPAddresses/' in k]) + 1
+            props['ipAddress'] = f'52.0.0.{n}'
+        if '/networkInterfaces/' in key:
+            n = len([k for k in self.resources
+                     if '/networkInterfaces/' in k]) + 1
+            for cfg in props.get('ipConfigurations', []):
+                cfg.setdefault('properties', {})[
+                    'privateIPAddress'] = f'10.40.0.{n}'
+        if '/virtualMachines/' in key:
+            props['instanceView'] = {
+                'statuses': [{'code': 'PowerState/starting'}]}
+        self.resources[key] = body
+        return dict(body)
+
+    def _get(self, key: str) -> Dict[str, Any]:
+        if key.endswith('/virtualMachines'):
+            rg = self._rg_of(key)
+            out = []
+            for rkey, res in self.resources.items():
+                if ('/virtualMachines/' in rkey and
+                        self._rg_of(rkey) == rg):
+                    # Fake async boot: starting→running on observation.
+                    view = res['properties'].get('instanceView', {})
+                    for st in view.get('statuses', []):
+                        if st['code'] == 'PowerState/starting':
+                            st['code'] = 'PowerState/running'
+                    out.append(dict(res))
+            return {'value': out}
+        if key not in self.resources:
+            raise az_rest.AzureApiError(404, 'NotFound', key)
+        return dict(self.resources[key])
+
+    def _post(self, key: str) -> Dict[str, Any]:
+        base, _, verb = key.rpartition('/')
+        if base not in self.resources:
+            raise az_rest.AzureApiError(404, 'NotFound', base)
+        state = {'start': 'PowerState/running',
+                 'deallocate': 'PowerState/deallocated',
+                 'restart': 'PowerState/running'}.get(verb)
+        assert state is not None, f'unexpected POST verb {verb}'
+        self.resources[base]['properties']['instanceView'] = {
+            'statuses': [{'code': state}]}
+        return {}
+
+    def _delete(self, key: str) -> Dict[str, Any]:
+        rg = self._rg_of(key)
+        if key.endswith(f'/resourceGroups/{rg}'):
+            gone = [k for k in self.resources
+                    if self._rg_of(k) == rg or k == key]
+            if key not in self.resources and not gone:
+                raise az_rest.AzureApiError(
+                    404, 'ResourceGroupNotFound', key)
+            for k in gone:
+                self.resources.pop(k, None)
+            return {}
+        self.resources.pop(key, None)
+        return {}
+
+    @property
+    def vms(self) -> List[str]:
+        return [k for k in self.resources if '/virtualMachines/' in k]
+
+
+@pytest.fixture
+def fake_arm(monkeypatch):
+    fake = FakeArm()
+    monkeypatch.setattr(az_instance, '_transport_factory',
+                        fake.transport_factory)
+    yield fake
+
+
+def _config(count=1, use_spot=False, **node_extra):
+    node = {'instance_type': 'Standard_ND96asr_v4', 'use_spot': use_spot}
+    node.update(node_extra)
+    return common.ProvisionConfig(
+        provider_config={'region': 'eastus'},
+        node_config=node, count=count,
+        tags={'cluster_name': 'azc'})
+
+
+class TestArmProvisioner:
+
+    def test_run_creates_rg_network_and_vms(self, fake_arm):
+        record = az_instance.run_instances('eastus', None, 'azc',
+                                           _config(count=2))
+        assert len(record.created_instance_ids) == 2
+        assert record.head_instance_id == 'azc-0'
+        # The cluster's whole footprint lives in its resource group.
+        rg_paths = {k for k in fake_arm.resources
+                    if '/resourceGroups/xsky-azc-rg' in k}
+        assert any('/virtualNetworks/' in k for k in rg_paths)
+        assert any('/networkInterfaces/' in k for k in rg_paths)
+        info = az_instance.get_cluster_info('eastus', 'azc',
+                                            {'region': 'eastus'})
+        assert len(info.instances) == 2
+        head = info.get_head_instance()
+        assert head.tags['xsky-head'] == 'true'
+        assert head.internal_ip.startswith('10.40.')
+        assert head.external_ip.startswith('52.')
+
+    def test_run_is_idempotent(self, fake_arm):
+        az_instance.run_instances('eastus', None, 'azc', _config(count=2))
+        record = az_instance.run_instances('eastus', None, 'azc',
+                                           _config(count=2))
+        assert record.created_instance_ids == []
+        assert len(fake_arm.vms) == 2
+
+    def test_spot_priority_set(self, fake_arm):
+        az_instance.run_instances('eastus', None, 'azc',
+                                  _config(use_spot=True))
+        vm = fake_arm.resources[fake_arm.vms[0]]
+        assert vm['properties']['priority'] == 'Spot'
+        assert vm['properties']['evictionPolicy'] == 'Deallocate'
+
+    def test_stop_resume_cycle(self, fake_arm):
+        az_instance.run_instances('eastus', None, 'azc', _config())
+        az_instance.wait_instances('eastus', 'azc', 'RUNNING',
+                                   {'region': 'eastus'},
+                                   timeout_s=5, poll_interval_s=0.01)
+        az_instance.stop_instances('azc', {'region': 'eastus'})
+        states = az_instance.query_instances('azc', {'region': 'eastus'})
+        assert set(states.values()) == {'STOPPED'}
+        record = az_instance.run_instances('eastus', None, 'azc',
+                                           _config())
+        assert record.resumed_instance_ids == ['azc-0']
+        states = az_instance.query_instances('azc', {'region': 'eastus'})
+        assert set(states.values()) == {'RUNNING'}
+
+    def test_terminate_deletes_resource_group(self, fake_arm):
+        az_instance.run_instances('eastus', None, 'azc', _config())
+        az_instance.terminate_instances('azc', {'region': 'eastus'})
+        assert not fake_arm.vms
+        assert az_instance.query_instances('azc',
+                                           {'region': 'eastus'}) == {}
+        with pytest.raises(exceptions.ClusterDoesNotExist):
+            az_instance.get_cluster_info('eastus', 'azc',
+                                         {'region': 'eastus'})
+        # Idempotent: a second terminate is a no-op, not an error.
+        az_instance.terminate_instances('azc', {'region': 'eastus'})
+
+    def test_allocation_failure_classified_and_cleaned(self, fake_arm):
+        fake_arm.fail_vm_create.append(az_rest.AzureApiError(
+            409, 'AllocationFailed', 'no ND96asr in eastus'))
+        with pytest.raises(exceptions.CapacityError):
+            az_instance.run_instances('eastus', None, 'azc',
+                                      _config(count=2))
+        # First VM may have been created before the failure — the
+        # partial resource group must be gone.
+        assert not fake_arm.vms
+
+    def test_quota_error_classified(self, fake_arm):
+        fake_arm.fail_vm_create.append(az_rest.AzureApiError(
+            403, 'QuotaExceeded', 'NDASv4 family cores quota is 0'))
+        with pytest.raises(exceptions.QuotaExceededError):
+            az_instance.run_instances('eastus', None, 'azc', _config())
+
+    def test_sku_not_available_is_capacity(self):
+        e = az_rest.classify_error(
+            az_rest.AzureApiError(409, 'SkuNotAvailable', 'restricted'),
+            'eastus')
+        assert isinstance(e, exceptions.CapacityError)
+        e = az_rest.classify_error(
+            az_rest.AzureApiError(
+                403, 'OperationNotAllowed',
+                'Operation would exceed approved cores quota'), None)
+        assert isinstance(e, exceptions.QuotaExceededError)
+
+
+@pytest.fixture
+def three_clouds_enabled():
+    check_lib.set_enabled_clouds_for_test(['aws', 'azure', 'gcp'])
+    yield
+    check_lib.set_enabled_clouds_for_test(None)
+
+
+class TestCrossCloudOptimizer:
+
+    def test_a100_offered_on_azure(self, three_clouds_enabled):
+        task = Task('t', run='x')
+        task.set_resources(Resources(accelerators='A100:8'))
+        ranked = optimizer_lib.candidates_for_failover(task, [])
+        clouds = {r.cloud_name for r in ranked}
+        assert 'azure' in clouds
+        az_entry = [r for r in ranked if r.cloud_name == 'azure'][0]
+        assert az_entry.instance_type == 'Standard_ND96asr_v4'
+
+    def test_azure_a100_cheaper_than_aws(self, three_clouds_enabled):
+        """ND96asr ($27.20/hr) undercuts p4d ($32.77/hr): given both,
+        the optimizer must rank Azure's A100 first among the GPUs."""
+        task = Task('t', run='x')
+        task.set_resources(Resources(accelerators={'A100': 8}))
+        ranked = optimizer_lib.candidates_for_failover(task, [])
+        gpu_clouds = [r.cloud_name for r in ranked
+                      if r.cloud_name in ('aws', 'azure')]
+        assert gpu_clouds and gpu_clouds[0] == 'azure'
+
+
+class TestThreeCloudProvisionFailover:
+    """AWS stocks out everywhere, Azure stocks out everywhere, the
+    failover engine lands the cluster on GCP."""
+
+    def test_walk_aws_azure_gcp(self, fake_arm, monkeypatch,
+                                three_clouds_enabled):
+        import sys
+        sys.path.insert(0, 'tests/unit_tests')
+        from test_aws import FakeEc2
+        from test_gcp_provisioner import FakeGcp
+        from skypilot_tpu.backends import failover
+        from skypilot_tpu.provision.aws import instance as aws_instance
+        from skypilot_tpu.provision.aws import rest as aws_rest
+        from skypilot_tpu.provision.gcp import instance as gcp_instance
+
+        fake_ec2 = FakeEc2()
+        monkeypatch.setattr(aws_instance, '_transport_factory',
+                            fake_ec2.transport_factory)
+        fake_gcp = FakeGcp()
+        monkeypatch.setattr(gcp_instance, '_transport_factory',
+                            lambda: fake_gcp)
+        monkeypatch.setenv('GOOGLE_CLOUD_PROJECT', 'test-proj')
+
+        for _ in range(6):   # every AWS zone (3 regions × 2)
+            fake_ec2.fail_run.append(aws_rest.AwsApiError(
+                500, 'InsufficientInstanceCapacity', 'no p4d'))
+        for _ in range(12):  # every Azure region (zones are placement)
+            fake_arm.fail_vm_create.append(az_rest.AzureApiError(
+                409, 'AllocationFailed', 'no ND96asr'))
+
+        task = Task('xc3', run='train')
+        task.set_resources([
+            Resources(cloud='aws', accelerators={'A100': 8}),
+            Resources(cloud='azure', accelerators={'A100': 8}),
+            Resources(cloud='gcp', accelerators={'A100': 8}),
+        ], ordered=True)
+        provisioner = failover.RetryingProvisioner(task, 'xc3', 1)
+        result = provisioner.provision_with_retries()
+        assert result.resources.cloud_name == 'gcp'
+        assert fake_gcp.vms, 'GCP VM was not created'
+        assert not fake_arm.vms, 'Azure partial attempt leaked'
+        capacity_events = [e for e in provisioner.failover_history
+                           if isinstance(e, exceptions.CapacityError)]
+        assert len(capacity_events) >= 8   # 6 AWS zones + Azure regions

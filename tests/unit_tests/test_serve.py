@@ -325,6 +325,32 @@ class TestAutoscalerBursts:
         assert len(calls) == 1
         assert len(scaler._request_timestamps) == 1
 
+    def test_concurrent_lb_threads_do_not_drop_requests(self):
+        """ThreadingHTTPServer handlers record requests from many
+        threads while the controller tick trims the window — no append
+        may be lost to a concurrent trim."""
+        import threading
+        scaler = autoscalers_lib.RequestRateAutoscaler(self._spec())
+        n_threads, per_thread = 8, 200
+        start = threading.Barrier(n_threads + 1)
+
+        def hammer():
+            start.wait()
+            for _ in range(per_thread):
+                scaler.collect_request_information(1, 0.0)
+
+        threads = [threading.Thread(target=hammer)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        start.wait()
+        # Controller-tick trims racing with the appends.
+        for _ in range(50):
+            scaler.current_qps()
+        for t in threads:
+            t.join()
+        assert len(scaler._request_timestamps) == n_threads * per_thread
+
     def test_autoscaler_state_survives_update(self):
         """A scaled-up service must not collapse to min_replicas when
         the autoscaler is rebuilt for a new version."""

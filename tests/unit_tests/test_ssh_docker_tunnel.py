@@ -273,16 +273,28 @@ class TestSshVerb:
         argv, cwd = sdk.ssh_command('any')
         assert cwd is None
         assert argv[0] == 'ssh'
-        assert 'tpuuser@10.9.8.7' in argv
+        # The destination appears exactly once and LAST: ssh stops
+        # option parsing at the first non-option argument, so a
+        # duplicate (or an option after it) would run as a remote
+        # command instead of opening a shell.
+        assert argv.count('tpuuser@10.9.8.7') == 1
+        assert argv[-1] == 'tpuuser@10.9.8.7'
         assert '2222' in argv
         joined = ' '.join(argv)
         assert 'ProxyCommand=' in joined
+        assert joined.index('ProxyCommand=') < joined.index('tpuuser@')
         assert 'tunnel_proxy' in joined
         assert 'http://api:46580' in joined
         # Without a remote endpoint: no proxy.
         monkeypatch.delenv('XSKY_API_SERVER')
         argv2, _ = sdk.ssh_command('any')
         assert 'ProxyCommand' not in ' '.join(argv2)
+        # Command mode: one shell-quoted string after the destination,
+        # so the remote shell sees literal words, not operators.
+        argv3, _ = sdk.ssh_command('any',
+                                   command=['echo', 'a b', '&&', 'pwd'])
+        assert argv3[-2] == 'tpuuser@10.9.8.7'
+        assert argv3[-1] == "echo 'a b' '&&' pwd"
 
     def test_command_mode_quotes_for_bash(self, fake_cluster_env):
         from skypilot_tpu import Resources, Task, core, execution
