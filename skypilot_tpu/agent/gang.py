@@ -137,6 +137,21 @@ _SSH_EXIT_CODE = 255
 START_RETRY_WINDOW_S = 10.0
 
 
+# Live per-host launcher processes of the in-flight gang_launch. Each
+# child runs in its own session (so ITS grandchildren die with it), which
+# means a signal to the job_runner's process group does NOT reach them —
+# kill_active() is how a SIGTERM'd runner takes its gang down with it.
+ACTIVE_PROCS: List[subprocess.Popen] = []
+
+
+def kill_active() -> None:
+    """Kill every live gang child (called from signal handlers)."""
+    for p in list(ACTIVE_PROCS):
+        if p.poll() is None:
+            _kill_tree(p, sig_kill=True)
+    ACTIVE_PROCS.clear()
+
+
 def _kill_tree(p: subprocess.Popen, sig_kill: bool = False) -> None:
     """Signal the host process's whole session (runners start each
     command with start_new_session=True), falling back to the direct
@@ -204,8 +219,10 @@ def gang_launch(runners: Sequence[runner_lib.CommandRunner],
 
     def _start(rank: int) -> subprocess.Popen:
         log_path = os.path.join(log_dir, f'host-{rank}.log')
-        return runners[rank].run_async(command, env=host_envs[rank],
-                                       log_path=log_path, cwd=cwd)
+        p = runners[rank].run_async(command, env=host_envs[rank],
+                                    log_path=log_path, cwd=cwd)
+        ACTIVE_PROCS.append(p)
+        return p
 
     try:
         for rank in range(len(runners)):
@@ -219,6 +236,34 @@ def gang_launch(runners: Sequence[runner_lib.CommandRunner],
     deadline = start_time + timeout_s if timeout_s else None
     retried = [False] * len(procs)
     returncodes: List[Optional[int]] = [None] * len(procs)
+    try:
+        _poll_gang(procs, returncodes, retried, _start, start_time,
+                   deadline, poll_interval_s)
+    finally:
+        for p in procs:
+            try:
+                ACTIVE_PROCS.remove(p)
+            except ValueError:
+                pass
+
+    # Symlink rank-0 log as run.log for the default log tail.
+    rank0 = os.path.join(log_dir, 'host-0.log')
+    run_log = os.path.join(log_dir, 'run.log')
+    if os.path.exists(rank0) and not os.path.exists(run_log):
+        try:
+            os.symlink('host-0.log', run_log)
+        except OSError:
+            pass
+    try:
+        aggregate_logs(log_dir, len(runners))
+    except OSError as e:
+        logger.warning(f'gang.log aggregation failed: {e}')
+    return GangResult([rc if rc is not None else -1
+                       for rc in returncodes])
+
+
+def _poll_gang(procs, returncodes, retried, _start, start_time, deadline,
+               poll_interval_s) -> None:
     while True:
         now = time.time()
         for i, p in enumerate(procs):
@@ -259,22 +304,8 @@ def gang_launch(runners: Sequence[runner_lib.CommandRunner],
             for i, p in enumerate(procs):
                 if returncodes[i] is None:
                     _kill_tree(p, sig_kill=True)
-            returncodes = [rc if rc is not None else -15
-                           for rc in returncodes]
+            # In-place: the caller owns this list.
+            returncodes[:] = [rc if rc is not None else -15
+                              for rc in returncodes]
             break
         time.sleep(poll_interval_s)
-
-    # Symlink rank-0 log as run.log for the default log tail.
-    rank0 = os.path.join(log_dir, 'host-0.log')
-    run_log = os.path.join(log_dir, 'run.log')
-    if os.path.exists(rank0) and not os.path.exists(run_log):
-        try:
-            os.symlink('host-0.log', run_log)
-        except OSError:
-            pass
-    try:
-        aggregate_logs(log_dir, len(runners))
-    except OSError as e:
-        logger.warning(f'gang.log aggregation failed: {e}')
-    return GangResult([rc if rc is not None else -1
-                       for rc in returncodes])

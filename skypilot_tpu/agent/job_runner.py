@@ -73,7 +73,12 @@ def run_job(job_id: int, root: str = None) -> int:
         job_lib.set_status(job_id, status, root)
         return 0 if result.success else 1
     except BaseException:
-        job_lib.set_status(job_id, job_lib.JobStatus.FAILED, root)
+        # A SIGTERM (cancel / teardown) exits through here via
+        # SystemExit after the handler already marked CANCELLED —
+        # don't overwrite that with FAILED.
+        current = job_lib.get_job(job_id, root)
+        if current is None or not current['status'].is_terminal():
+            job_lib.set_status(job_id, job_lib.JobStatus.FAILED, root)
         raise
     finally:
         _schedule_next(root)
@@ -88,6 +93,18 @@ def main() -> int:
     job_id = int(sys.argv[1])
     root = job_lib.cluster_root()
     job_lib.set_pid(job_id, os.getpid(), root)
+
+    def _on_term(signum, frame):
+        # Each gang child runs in its own session, so a signal to THIS
+        # process group does not reach them — take the fleet down
+        # explicitly (cancel_job / cluster teardown send us SIGTERM).
+        del signum, frame
+        gang.kill_active()
+        job_lib.set_status(job_id, job_lib.JobStatus.CANCELLED, root)
+        sys.exit(143)
+
+    import signal
+    signal.signal(signal.SIGTERM, _on_term)
     return run_job(job_id, root)
 
 

@@ -9,6 +9,7 @@ Two transports:
 from __future__ import annotations
 
 import os
+import shlex
 from typing import Any, Dict, List, Optional, Tuple, Union
 
 from skypilot_tpu import config as config_lib
@@ -145,8 +146,7 @@ def _ssh_argv_for_runner(runner, command: Optional[List[str]]
     if isinstance(runner, runner_lib.LocalProcessCommandRunner):
         argv = ['bash']
         if command:
-            import shlex as shlex_lib
-            argv += ['-c', ' '.join(shlex_lib.quote(c)
+            argv += ['-c', ' '.join(shlex.quote(c)
                                     for c in command)]
         return argv, runner.host_root
     if isinstance(runner, runner_lib.SSHCommandRunner):
@@ -159,9 +159,8 @@ def _ssh_argv_for_runner(runner, command: Optional[List[str]]
             if endpoint:
                 # No provisioner jump host: ride the API server's
                 # CONNECT tunnel (heads without public IPs).
-                import shlex as shlex_lib
                 import sys
-                proxy = (f'{shlex_lib.quote(sys.executable)} -m '
+                proxy = (f'{shlex.quote(sys.executable)} -m '
                          f'skypilot_tpu.templates.tunnel_proxy %h %p '
                          f'--server {endpoint}')
                 argv += ['-o', f'ProxyCommand={proxy}']
@@ -170,8 +169,7 @@ def _ssh_argv_for_runner(runner, command: Optional[List[str]]
             # The remote shell re-splits whatever ssh sends: quote each
             # word so 'echo a b' and literal '&&' survive intact (same
             # contract as the local-runner path above).
-            import shlex as shlex_lib
-            argv.append(' '.join(shlex_lib.quote(c) for c in command))
+            argv.append(' '.join(shlex.quote(c) for c in command))
         return argv, None
     if isinstance(runner, runner_lib.KubernetesCommandRunner):
         base = runner.kubectl_base() + ['exec']

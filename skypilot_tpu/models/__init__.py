@@ -11,16 +11,19 @@ from typing import Any
 
 
 def module_for(config: Any):
-    """Return the model module (llama/moe/gemma) that owns `config`."""
+    """Return the model module (llama/moe/gemma/qwen) owning `config`."""
     from skypilot_tpu.models import gemma
     from skypilot_tpu.models import llama
     from skypilot_tpu.models import moe
+    from skypilot_tpu.models import qwen
     if isinstance(config, moe.MoEConfig):
         return moe
     if isinstance(config, llama.LlamaConfig):
         return llama
     if isinstance(config, gemma.GemmaConfig):
         return gemma
+    if isinstance(config, qwen.QwenConfig):
+        return qwen
     raise TypeError(f'Unknown model config type: {type(config)!r}')
 
 
@@ -29,7 +32,8 @@ def get_config(name: str):
     from skypilot_tpu.models import gemma
     from skypilot_tpu.models import llama
     from skypilot_tpu.models import moe
-    families = (llama, moe, gemma)
+    from skypilot_tpu.models import qwen
+    families = (llama, moe, gemma, qwen)
     for mod in families:
         if name in mod.CONFIGS:
             return mod.CONFIGS[name]

@@ -1,0 +1,241 @@
+"""Qwen-family decoder-only transformer (third dense family).
+
+Capability twin of the reference's Qwen serving recipes (llm/qwen/);
+in-tree like llama.py/gemma.py so the trainer gets it for free.
+Architecturally distinct from Llama where Qwen actually differs:
+
+  * Qwen-2: biases on the Q/K/V projections (none elsewhere);
+  * Qwen-3: per-head QK-RMSNorm instead of projection biases;
+  * long-context RoPE base (theta = 1e6);
+  * untied LM head (like Llama, unlike Gemma), so the chunked-CE
+    scan from llama.py applies unchanged at long sequence.
+
+Same functional surface as the other families (CONFIGS, logical_axes,
+init, forward, loss_fn) and the same logical sharding axes, so the
+trainer dispatches on config type alone.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.parallel import mesh as mesh_lib
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class QwenConfig:
+    vocab_size: int = 152_064
+    d_model: int = 3584
+    n_layers: int = 28
+    n_heads: int = 28
+    n_kv_heads: int = 4
+    head_dim: int = 128
+    d_ff: int = 18_944
+    max_seq_len: int = 32_768
+    rope_theta: float = 1e6
+    norm_eps: float = 1e-6
+    qkv_bias: bool = True      # Qwen-2 style
+    qk_norm: bool = False      # Qwen-3 style
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    remat_policy: str = 'dots'
+    attention_impl: str = 'auto'
+    ce_chunk: int = 2048
+
+    def num_params(self) -> int:
+        d, f, v = self.d_model, self.d_ff, self.vocab_size
+        hd, h, kv = self.head_dim, self.n_heads, self.n_kv_heads
+        attn = d * h * hd * 2 + d * kv * hd * 2
+        if self.qkv_bias:
+            attn += h * hd + 2 * kv * hd
+        if self.qk_norm:
+            attn += 2 * hd
+        mlp = 3 * d * f
+        per_layer = attn + mlp + 2 * d
+        return v * d * 2 + self.n_layers * per_layer + d
+
+    def train_flops_per_token(self) -> float:
+        attn_flops = (12 * self.n_layers * self.n_heads * self.head_dim *
+                      self.max_seq_len)
+        return 6 * self.num_params() + attn_flops
+
+
+QWEN2_7B = QwenConfig()
+QWEN3_8B = QwenConfig(vocab_size=151_936, d_model=4096, n_layers=36,
+                      n_heads=32, n_kv_heads=8, head_dim=128,
+                      d_ff=12_288, qkv_bias=False, qk_norm=True)
+QWEN_TINY = QwenConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                       n_kv_heads=2, head_dim=16, d_ff=128,
+                       max_seq_len=128, remat=False)
+QWEN3_TINY = dataclasses.replace(QWEN_TINY, qkv_bias=False, qk_norm=True)
+
+CONFIGS = {
+    'qwen2-7b': QWEN2_7B,
+    'qwen3-8b': QWEN3_8B,
+    'qwen-tiny': QWEN_TINY,
+    'qwen3-tiny': QWEN3_TINY,
+}
+
+
+def logical_axes(config: QwenConfig) -> Params:
+    layer = {
+        'wq': ('layers', 'embed', 'heads'),
+        'wk': ('layers', 'embed', 'kv'),
+        'wv': ('layers', 'embed', 'kv'),
+        'wo': ('layers', 'heads', 'embed'),
+        'w_gate': ('layers', 'embed', 'mlp'),
+        'w_up': ('layers', 'embed', 'mlp'),
+        'w_down': ('layers', 'mlp', 'embed'),
+        'attn_norm': ('layers', 'embed'),
+        'mlp_norm': ('layers', 'embed'),
+    }
+    if config.qkv_bias:
+        layer.update({
+            'bq': ('layers', 'heads'),
+            'bk': ('layers', 'kv'),
+            'bv': ('layers', 'kv'),
+        })
+    if config.qk_norm:
+        # Per-head-dim scales, shared across heads (Qwen-3).
+        layer.update({
+            'q_norm': ('layers', None),
+            'k_norm': ('layers', None),
+        })
+    return {
+        'embed': ('vocab', 'embed'),
+        'layers': layer,
+        'final_norm': ('embed',),
+        'lm_head': ('embed', 'vocab'),
+    }
+
+
+def init(config: QwenConfig, key: jax.Array) -> Params:
+    c = config
+    hd = c.head_dim
+    keys = jax.random.split(key, 9)
+
+    def dense(k, shape, fan_in):
+        return (jax.random.truncated_normal(k, -2, 2, shape, jnp.float32) *
+                (fan_in ** -0.5)).astype(c.dtype)
+
+    def stack(k, shape, fan_in):
+        return dense(k, (c.n_layers,) + shape, fan_in)
+
+    layers: Params = {
+        'wq': stack(keys[1], (c.d_model, c.n_heads * hd), c.d_model),
+        'wk': stack(keys[2], (c.d_model, c.n_kv_heads * hd), c.d_model),
+        'wv': stack(keys[3], (c.d_model, c.n_kv_heads * hd), c.d_model),
+        'wo': stack(keys[4], (c.n_heads * hd, c.d_model), c.n_heads * hd),
+        'w_gate': stack(keys[5], (c.d_model, c.d_ff), c.d_model),
+        'w_up': stack(keys[6], (c.d_model, c.d_ff), c.d_model),
+        'w_down': stack(keys[7], (c.d_ff, c.d_model), c.d_ff),
+        'attn_norm': jnp.ones((c.n_layers, c.d_model), c.dtype),
+        'mlp_norm': jnp.ones((c.n_layers, c.d_model), c.dtype),
+    }
+    if c.qkv_bias:
+        layers.update({
+            'bq': jnp.zeros((c.n_layers, c.n_heads * hd), c.dtype),
+            'bk': jnp.zeros((c.n_layers, c.n_kv_heads * hd), c.dtype),
+            'bv': jnp.zeros((c.n_layers, c.n_kv_heads * hd), c.dtype),
+        })
+    if c.qk_norm:
+        layers.update({
+            'q_norm': jnp.ones((c.n_layers, hd), c.dtype),
+            'k_norm': jnp.ones((c.n_layers, hd), c.dtype),
+        })
+    return {
+        'embed': dense(keys[0], (c.vocab_size, c.d_model), c.d_model),
+        'layers': layers,
+        'final_norm': jnp.ones((c.d_model,), c.dtype),
+        'lm_head': dense(keys[8], (c.d_model, c.vocab_size), c.d_model),
+    }
+
+
+def _layer(config: QwenConfig, mesh: Optional[mesh_lib.Mesh],
+           x: jax.Array, lp: Params, positions: jax.Array) -> jax.Array:
+    c = config
+    hd = c.head_dim
+    b, s, _ = x.shape
+
+    def shard(arr, axes):
+        if mesh is None:
+            return arr
+        return mesh_lib.shard_logical(arr, mesh, axes)
+
+    h = llama._rms_norm(x, lp['attn_norm'], c.norm_eps)
+    q = llama._ckpt_name(h @ lp['wq'], 'attn_q')
+    k = llama._ckpt_name(h @ lp['wk'], 'attn_k')
+    v = llama._ckpt_name(h @ lp['wv'], 'attn_v')
+    if c.qkv_bias:
+        q, k, v = q + lp['bq'], k + lp['bk'], v + lp['bv']
+    q = q.reshape(b, s, c.n_heads, hd)
+    k = k.reshape(b, s, c.n_kv_heads, hd)
+    v = v.reshape(b, s, c.n_kv_heads, hd)
+    if c.qk_norm:
+        q = llama._rms_norm(q, lp['q_norm'], c.norm_eps)
+        k = llama._rms_norm(k, lp['k_norm'], c.norm_eps)
+    q = shard(q, ('batch', 'activation_length', 'activation_heads', None))
+    k = shard(k, ('batch', 'activation_length', 'activation_kv', None))
+    q = llama._rope(q, positions, c.rope_theta)
+    k = llama._rope(k, positions, c.rope_theta)
+    attn = attention_ops.dot_product_attention(
+        q, k, v, causal=True, implementation=c.attention_impl)
+    attn = attn.reshape(b, s, c.n_heads * hd)
+    x = x + shard(llama._ckpt_name(attn @ lp['wo'], 'attn_o'),
+                  ('batch', 'activation_length', 'activation_embed'))
+
+    h = llama._rms_norm(x, lp['mlp_norm'], c.norm_eps)
+    gate = jax.nn.silu(
+        llama._ckpt_name(h @ lp['w_gate'], 'mlp_gate').astype(jnp.float32))
+    up = llama._ckpt_name(h @ lp['w_up'], 'mlp_up').astype(jnp.float32)
+    ff = shard((gate * up).astype(c.dtype),
+               ('batch', 'activation_length', 'activation_mlp'))
+    x = x + shard(ff @ lp['w_down'],
+                  ('batch', 'activation_length', 'activation_embed'))
+    return x
+
+
+def _trunk(config: QwenConfig, params: Params, tokens: jax.Array,
+           positions: Optional[jax.Array],
+           mesh: Optional[mesh_lib.Mesh]) -> jax.Array:
+    c = config
+    if positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1])[None, :], tokens.shape)
+    x = llama._embed_lookup(params['embed'], tokens, mesh).astype(c.dtype)
+    if mesh is not None:
+        x = mesh_lib.shard_logical(
+            x, mesh, ('batch', 'activation_length', 'activation_embed'))
+
+    def layer_fn(x, lp):
+        return _layer(c, mesh, x, lp, positions), None
+
+    if c.remat:
+        layer_fn = jax.checkpoint(layer_fn, policy=llama._remat_policy(c))
+    x, _ = jax.lax.scan(layer_fn, x, params['layers'])
+    return llama._rms_norm(x, params['final_norm'], c.norm_eps)
+
+
+def forward(config: QwenConfig, params: Params, tokens: jax.Array,
+            mesh: Optional[mesh_lib.Mesh] = None,
+            positions: Optional[jax.Array] = None) -> jax.Array:
+    """Training forward → fp32 logits [B, S, vocab]."""
+    x = _trunk(config, params, tokens, positions, mesh)
+    return jnp.einsum('bsd,dv->bsv', x, params['lm_head'],
+                      preferred_element_type=jnp.float32)
+
+
+def loss_fn(config: QwenConfig, params: Params, tokens: jax.Array,
+            targets: jax.Array, mesh: Optional[mesh_lib.Mesh] = None,
+            loss_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Mean next-token CE; reuses llama's chunked large-vocab scan."""
+    x = _trunk(config, params, tokens, None, mesh)
+    return llama._chunked_ce(x, params['lm_head'], targets, loss_mask,
+                             config.ce_chunk)

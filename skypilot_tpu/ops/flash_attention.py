@@ -1,14 +1,19 @@
-"""Flash attention for TPU in Pallas (forward) + chunked backward.
+"""Flash attention for TPU in Pallas: forward + FA2-style backward.
 
-Forward is a Pallas kernel: online-softmax over KV blocks, accumulator in
-VMEM, causal blocks skipped on the MXU (FlashAttention-2 schedule adapted to
-the TPU grid model: the KV dimension is the innermost grid axis and running
+Forward: online-softmax over KV blocks, accumulator in VMEM, causal
+blocks skipped on the MXU (FlashAttention-2 schedule adapted to the TPU
+grid model: the KV dimension is the innermost grid axis and running
 stats live in VMEM scratch that persists across grid steps).
 
-Backward is blockwise XLA (`lax.scan` over Q blocks, recomputing P from the
-saved LSE): O(S·block) memory like flash backward, while letting XLA fuse
-the matmuls — measured faster than a naive Pallas port on v5e because the
-dq/dk/dv contractions are pure MXU work XLA already schedules well.
+Backward: two Pallas kernels recomputing P from the saved LSE —
+  * dKV: grid (BH, KV-blocks, Q-blocks), dk/dv accumulate in VMEM
+    scratch across the inner Q sweep;
+  * dQ: grid (BH, Q-blocks, KV-blocks), dq accumulates across the inner
+    KV sweep.
+Both skip fully-masked causal blocks (the earlier XLA blockwise backward
+computed the full S×S rectangle and materialized P in fp32 — at seq 8K
+that doubled the attention FLOPs and blew HBM; the kernels keep P in
+VMEM and run the matmuls in bf16 with fp32 accumulation).
 
 Layout convention: q [B, S, H, D], k/v [B, S, Hkv, D] (GQA supported by
 logical head replication, resolved without materialization).
@@ -90,7 +95,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
                block_q: int, block_kv: int
                ) -> Tuple[jax.Array, jax.Array]:
-    """Returns (out [B,H,S,D], lse [B,H,S,LANES])... internally BHSD."""
+    """Returns (out [B,H,S,D], lse [B*H,S,LANES] lane-broadcast fp32).
+
+    The LSE stays in the kernels' natural lane-broadcast layout: the
+    backward kernels consume it directly, so no reshape/transpose or
+    re-broadcast ever touches HBM."""
     b, h, s, d = q.shape
     s_kv = k.shape[2]
     block_q = min(block_q, s)
@@ -130,54 +139,181 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
         ],
         interpret=_should_interpret(),
     )(qr, kr, vr)
-    return (out.reshape(b, h, s, d), lse[:, :, 0].reshape(b, h, s))
+    return out.reshape(b, h, s, d), lse
 
 
 def _should_interpret() -> bool:
     return jax.default_backend() != 'tpu'
 
 
-def _bwd_chunked(residuals, dout, *, causal: bool, block_q: int):
-    """Blockwise XLA backward from saved LSE (flash-style memory)."""
-    q, k, v, out, lse = residuals  # q/out [B,H,S,D]; k/v [B,H,Skv,D]
-    b, h, s, d = q.shape
+def _block_p_ds(q, k, v, out, dout, lse_col, *, scale: float,
+                causal: bool, q_start, kv_start, block_q: int,
+                block_kv: int):
+    """Shared P/dS recompute for both backward kernels.
+
+    q/out/dout [bq, d]; k/v [bkv, d]; lse_col [bq, 1] fp32. The delta
+    row-stat (Σ dO⊙O) is recomputed here from the blocks already in
+    VMEM — cheaper than streaming a third stats operand from HBM.
+    Returns (p, ds) as bf16-castable fp32 [bq, bkv].
+    """
+    delta_col = jnp.sum(
+        dout.astype(jnp.float32) * out.astype(jnp.float32),
+        axis=-1, keepdims=True)                            # [bq, 1]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale        # [bq, bkv]
+    if causal:
+        q_pos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0)
+        kv_pos = kv_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+    p = jnp.exp(s - lse_col)                               # [bq, bkv]
+    dp = jax.lax.dot_general(
+        dout, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                # [bq, bkv]
+    ds = p * (dp - delta_col) * scale
+    return p, ds
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                    causal: bool, block_q: int, block_kv: int):
+    kvi = pl.program_id(1)
+    qi = pl.program_id(2)
+    num_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q
+    kv_start = kvi * block_kv
+    run = True
+    if causal:
+        run = q_start + block_q - 1 >= kv_start
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        dout = dout_ref[0]
+        p, ds = _block_p_ds(
+            q, k_ref[0], v_ref[0], out_ref[0], dout,
+            lse_ref[0][:, 0:1], scale=scale,
+            causal=causal, q_start=q_start, kv_start=kv_start,
+            block_q=block_q, block_kv=block_kv)
+        # dv += Pᵀ dO ; dk += dSᵀ Q  (contract the q dim, bf16 on MXU)
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p.astype(dout.dtype), dout, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
+                   dq_ref, dq_acc, *, scale: float, causal: bool,
+                   block_q: int, block_kv: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_kv = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = qi * block_q
+    kv_start = ki * block_kv
+    run = True
+    if causal:
+        run = q_start + block_q - 1 >= kv_start
+
+    @pl.when(run)
+    def _body():
+        k = k_ref[0]
+        _, ds = _block_p_ds(
+            q_ref[0], k, v_ref[0], out_ref[0], dout_ref[0],
+            lse_ref[0][:, 0:1], scale=scale,
+            causal=causal, q_start=q_start, kv_start=kv_start,
+            block_q=block_q, block_kv=block_kv)
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_kv - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_flash(residuals, dout, *, causal: bool, block_q: int,
+               block_kv: int):
+    """FA2 backward: dKV kernel + dQ kernel from the saved LSE."""
+    q, k, v, out, lse = residuals  # q/out [B,H,S,D]; k/v [B,H,Skv,D];
+    b, h, s, d = q.shape           # lse [B*H,S,LANES] (fwd layout)
     s_kv = k.shape[2]
     scale = d ** -0.5
     block_q = min(block_q, s)
-    num_blocks = s // block_q
+    block_kv = min(block_kv, s_kv)
 
-    kv_pos = jnp.arange(s_kv)
-    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)  # [B,H,S]
+    qr = q.reshape(b * h, s, d)
+    kr = k.reshape(b * h, s_kv, d)
+    vr = v.reshape(b * h, s_kv, d)
+    outr = out.reshape(b * h, s, d)
+    dor = dout.reshape(b * h, s, d)
 
-    def one_block(carry, idx):
-        dk_acc, dv_acc = carry
-        sl = idx * block_q
-        qb = jax.lax.dynamic_slice_in_dim(q, sl, block_q, axis=2)
-        dob = jax.lax.dynamic_slice_in_dim(dout, sl, block_q, axis=2)
-        lseb = jax.lax.dynamic_slice_in_dim(lse, sl, block_q, axis=2)
-        deltab = jax.lax.dynamic_slice_in_dim(delta, sl, block_q, axis=2)
-        sb = jnp.einsum('bhqd,bhkd->bhqk', qb, k,
-                        preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = sl + jnp.arange(block_q)
-            mask = q_pos[:, None] >= kv_pos[None, :]
-            sb = jnp.where(mask[None, None], sb, _NEG_INF)
-        p = jnp.exp(sb - lseb[..., None])                    # [B,H,bq,Skv]
-        dv = jnp.einsum('bhqk,bhqd->bhkd', p, dob.astype(jnp.float32))
-        dp = jnp.einsum('bhqd,bhkd->bhqk', dob.astype(jnp.float32),
-                        v.astype(jnp.float32))
-        ds = p * (dp - deltab[..., None]) * scale
-        dqb = jnp.einsum('bhqk,bhkd->bhqd', ds, k.astype(jnp.float32))
-        dk = jnp.einsum('bhqk,bhqd->bhkd', ds, qb.astype(jnp.float32))
-        return (dk_acc + dk, dv_acc + dv), dqb.astype(q.dtype)
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
+    kv_spec = pl.BlockSpec((1, block_kv, d), lambda bh, i, j: (bh, j, 0))
+    stat_spec = pl.BlockSpec((1, block_q, _LANES),
+                             lambda bh, i, j: (bh, i, 0))
+    # dKV: outer grid dim is the KV block, inner sweep walks Q blocks.
+    dkv_q_spec = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
+    dkv_kv_spec = pl.BlockSpec((1, block_kv, d),
+                               lambda bh, j, i: (bh, j, 0))
+    dkv_stat_spec = pl.BlockSpec((1, block_q, _LANES),
+                                 lambda bh, j, i: (bh, i, 0))
 
-    init = (jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32))
-    (dk, dv), dq_blocks = jax.lax.scan(one_block, init,
-                                       jnp.arange(num_blocks))
-    # dq_blocks: [num_blocks, B, H, block_q, D] → [B,H,S,D]
-    dq = jnp.moveaxis(dq_blocks, 0, 2).reshape(b, h, s, d)
-    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_kv=block_kv),
+        grid=(b * h, s_kv // block_kv, s // block_q),
+        in_specs=[dkv_q_spec, dkv_kv_spec, dkv_kv_spec, dkv_q_spec,
+                  dkv_q_spec, dkv_stat_spec],
+        out_specs=[
+            pl.BlockSpec((1, block_kv, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, j, i: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_kv, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s_kv, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+        ],
+        interpret=_should_interpret(),
+    )(qr, kr, vr, outr, dor, lse)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_kv=block_kv),
+        grid=(b * h, s // block_q, s_kv // block_kv),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, stat_spec],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_should_interpret(),
+    )(qr, kr, vr, outr, dor, lse)[0]
+
+    return (dq.reshape(b, h, s, d), dk.reshape(b, h, s_kv, d),
+            dv.reshape(b, h, s_kv, d))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -194,8 +330,8 @@ def _flash_bhsd_fwd(q, k, v, causal, block_q, block_kv):
 
 
 def _flash_bhsd_bwd(causal, block_q, block_kv, residuals, dout):
-    del block_kv
-    return _bwd_chunked(residuals, dout, causal=causal, block_q=block_q)
+    return _bwd_flash(residuals, dout, causal=causal, block_q=block_q,
+                      block_kv=block_kv)
 
 
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)

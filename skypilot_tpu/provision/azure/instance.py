@@ -42,8 +42,12 @@ def set_transport_factory(factory) -> None:
     _transport_factory = factory
 
 
-def _rg(cluster_name: str) -> str:
-    return f'xsky-{cluster_name}-rg'
+def _rg(cluster_name: str, region: str) -> str:
+    """Region-scoped: ARM forbids changing an existing resource group's
+    location, so a failover retry in another region must not collide
+    with the (possibly still async-deleting) group from the failed
+    attempt."""
+    return f'xsky-{cluster_name}-{region}-rg'
 
 
 def _transport(provider_config: Dict[str, Any]) -> rest.Transport:
@@ -73,13 +77,15 @@ def _power_state(vm: Dict[str, Any]) -> str:
     return 'PENDING'
 
 
-def _compute_path(cluster_name: str, suffix: str = '') -> str:
-    return (f'/resourceGroups/{_rg(cluster_name)}/providers'
+def _compute_path(t: rest.Transport, cluster_name: str,
+                  suffix: str = '') -> str:
+    return (f'/resourceGroups/{_rg(cluster_name, t.region)}/providers'
             f'/Microsoft.Compute{suffix}')
 
 
-def _network_path(cluster_name: str, suffix: str = '') -> str:
-    return (f'/resourceGroups/{_rg(cluster_name)}/providers'
+def _network_path(t: rest.Transport, cluster_name: str,
+                  suffix: str = '') -> str:
+    return (f'/resourceGroups/{_rg(cluster_name, t.region)}/providers'
             f'/Microsoft.Network{suffix}')
 
 
@@ -89,7 +95,7 @@ def _list_vms(t: rest.Transport, cluster_name: str,
     if expand_view:
         suffix += '?$expand=instanceView'
     try:
-        reply = t.call('GET', _compute_path(cluster_name, suffix))
+        reply = t.call('GET', _compute_path(t, cluster_name, suffix))
     except rest.AzureApiError as e:
         if e.code in ('NotFound', 'ResourceGroupNotFound'):
             return []
@@ -106,10 +112,35 @@ def _sorted_nodes(vms: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
 
 def _ensure_network(t: rest.Transport, cluster_name: str,
                     region: str) -> str:
-    """Resource group + VNet/subnet; returns the subnet resource id."""
-    t.call('PUT', f'/resourceGroups/{_rg(cluster_name)}',
+    """Resource group + NSG + VNet/subnet; returns the subnet id.
+
+    Standard-SKU public IPs deny ALL inbound until an NSG allows it, so
+    the subnet gets a cluster NSG with an SSH allow rule up front —
+    without it every post-provision lifecycle op (setup/run/rsync)
+    would time out on port 22. open_ports() appends rules to the same
+    NSG.
+    """
+    t.call('PUT', f'/resourceGroups/{_rg(cluster_name, region)}',
            {'location': region, 'tags': {CLUSTER_TAG: cluster_name}})
-    vnet_path = _network_path(cluster_name,
+    nsg_path = _network_path(t, cluster_name,
+                             f'/networkSecurityGroups/{cluster_name}-nsg')
+    t.call('PUT', nsg_path, {
+        'location': region,
+        'properties': {
+            'securityRules': [{
+                'name': 'xsky-ssh',
+                'properties': {
+                    'priority': 1000, 'direction': 'Inbound',
+                    'access': 'Allow', 'protocol': 'Tcp',
+                    'sourceAddressPrefix': '*', 'sourcePortRange': '*',
+                    'destinationAddressPrefix': '*',
+                    'destinationPortRange': '22',
+                },
+            }],
+        },
+    })
+    nsg_id = t.wait_provisioned(nsg_path).get('id', nsg_path)
+    vnet_path = _network_path(t, cluster_name,
                               f'/virtualNetworks/{cluster_name}-vnet')
     t.call('PUT', vnet_path, {
         'location': region,
@@ -117,7 +148,10 @@ def _ensure_network(t: rest.Transport, cluster_name: str,
             'addressSpace': {'addressPrefixes': ['10.40.0.0/16']},
             'subnets': [{
                 'name': 'default',
-                'properties': {'addressPrefix': '10.40.0.0/20'},
+                'properties': {
+                    'addressPrefix': '10.40.0.0/20',
+                    'networkSecurityGroup': {'id': nsg_id},
+                },
             }],
         },
     })
@@ -134,14 +168,14 @@ def _create_node(t: rest.Transport, cluster_name: str, region: str,
                  subnet_id: str, index: int, is_head: bool,
                  node_cfg: Dict[str, Any]) -> str:
     name = f'{cluster_name}-{index}'
-    ip_path = _network_path(cluster_name, f'/publicIPAddresses/{name}-ip')
+    ip_path = _network_path(t, cluster_name, f'/publicIPAddresses/{name}-ip')
     t.call('PUT', ip_path, {
         'location': region,
         'sku': {'name': 'Standard'},
         'properties': {'publicIPAllocationMethod': 'Static'},
     })
     ip_id = t.wait_provisioned(ip_path).get('id', ip_path)
-    nic_path = _network_path(cluster_name,
+    nic_path = _network_path(t, cluster_name,
                              f'/networkInterfaces/{name}-nic')
     t.call('PUT', nic_path, {
         'location': region,
@@ -192,14 +226,22 @@ def _create_node(t: rest.Transport, cluster_name: str, region: str,
                     }]},
                 },
             },
-            'networkProfile': {'networkInterfaces': [{'id': nic_id}]},
+            # deleteOption cascades: deleting the VM also deletes its
+            # OS disk and NIC server-side, so partial-attempt cleanup
+            # and teardown cannot leak billed resources.
+            'networkProfile': {'networkInterfaces': [{
+                'id': nic_id,
+                'properties': {'deleteOption': 'Delete'},
+            }]},
         },
     }
+    body['properties']['storageProfile']['osDisk'][
+        'deleteOption'] = 'Delete'
     if node_cfg.get('use_spot'):
         body['properties']['priority'] = 'Spot'
         body['properties']['evictionPolicy'] = 'Deallocate'
         body['properties']['billingProfile'] = {'maxPrice': -1}
-    t.call('PUT', _compute_path(cluster_name, f'/virtualMachines/{name}'),
+    t.call('PUT', _compute_path(t, cluster_name, f'/virtualMachines/{name}'),
            body)
     return name
 
@@ -209,14 +251,16 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
     node_cfg = config.node_config
     t = _transport(config.provider_config)
     created: List[str] = []
+    attempted: List[str] = []
     resumed: List[str] = []
+    existing: List[Dict[str, Any]] = []
     try:
         existing = _sorted_nodes(_list_vms(t, cluster_name))
         if config.resume_stopped_nodes:
             for vm in existing:
                 if _power_state(vm) == 'STOPPED':
                     t.call('POST', _compute_path(
-                        cluster_name,
+                        t, cluster_name,
                         f'/virtualMachines/{vm["name"]}/start'))
                     resumed.append(vm['name'])
         have = len(existing)
@@ -226,31 +270,53 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
             has_head = any((vm.get('tags') or {}).get(HEAD_TAG) == 'true'
                            for vm in existing)
             for node in range(missing):
-                created.append(_create_node(
+                # Record the attempt BEFORE creating: a failure partway
+                # through _create_node (IP/NIC made, VM refused) must
+                # still be cleaned up below.
+                attempted.append(f'{cluster_name}-{have + node}')
+                _create_node(
                     t, cluster_name, region, subnet_id,
                     index=have + node,
                     is_head=(not has_head and node == 0),
-                    node_cfg=node_cfg))
+                    node_cfg=node_cfg)
+                created.append(attempted[-1])
             # VM PUT is an LRO: surface allocation failures (capacity)
             # here, inside the failover-classified scope.
             for name in created:
                 t.wait_provisioned(_compute_path(
-                    cluster_name, f'/virtualMachines/{name}'))
+                    t, cluster_name, f'/virtualMachines/{name}'))
     except rest.AzureApiError as e:
-        # Partial gang cleanup. Fresh cluster: the resource group is
-        # this attempt's whole blast radius — delete it so the failover
-        # retry (next region) starts from zero. Scale-up/resume of an
-        # existing cluster: only this attempt's VMs may go; the healthy
-        # fleet (and its disks/network) must survive.
+        # Partial gang cleanup. Fresh cluster: the (region-scoped)
+        # resource group is this attempt's whole blast radius — delete
+        # it even if the failure hit before any VM (half-built network
+        # would otherwise linger), so the failover retry starts from
+        # zero. Scale-up/resume of an existing cluster: only this
+        # attempt's VMs may go (their disk/NIC cascade via
+        # deleteOption); the healthy fleet and its network survive.
         try:
-            if created and not existing:
-                t.call('DELETE', f'/resourceGroups/{_rg(cluster_name)}'
+            if not existing:
+                t.call('DELETE',
+                       f'/resourceGroups/{_rg(cluster_name, region)}'
                        '?forceDeletionTypes='
                        'Microsoft.Compute/virtualMachines')
             else:
-                for name in created:
-                    t.call('DELETE', _compute_path(
-                        cluster_name, f'/virtualMachines/{name}'))
+                for name in attempted:
+                    # VM delete cascades NIC/disk via deleteOption; a
+                    # node that failed before its VM existed still has
+                    # an orphan NIC/IP. All best-effort (404 for the
+                    # never-created, 409 while detaching — the next
+                    # terminate retries).
+                    for path in (
+                            _compute_path(t, cluster_name,
+                                          f'/virtualMachines/{name}'),
+                            _network_path(t, cluster_name,
+                                          f'/networkInterfaces/{name}-nic'),
+                            _network_path(t, cluster_name,
+                                          f'/publicIPAddresses/{name}-ip')):
+                        try:
+                            t.call('DELETE', path)
+                        except rest.AzureApiError:
+                            pass
         except rest.AzureApiError as cleanup_err:
             logger.warning(
                 f'Cleanup of partial attempt failed: {cleanup_err}')
@@ -298,7 +364,7 @@ def stop_instances(cluster_name: str,
     for vm in _list_vms(t, cluster_name):
         if _power_state(vm) in ('PENDING', 'RUNNING'):
             t.call('POST', _compute_path(
-                cluster_name,
+                t, cluster_name,
                 f'/virtualMachines/{vm["name"]}/deallocate'))
 
 
@@ -306,7 +372,8 @@ def terminate_instances(cluster_name: str,
                         provider_config: Dict[str, Any]) -> None:
     t = _transport(provider_config)
     try:
-        t.call('DELETE', f'/resourceGroups/{_rg(cluster_name)}'
+        t.call('DELETE',
+               f'/resourceGroups/{_rg(cluster_name, t.region)}'
                '?forceDeletionTypes=Microsoft.Compute/virtualMachines')
     except rest.AzureApiError as e:
         if e.code not in ('NotFound', 'ResourceGroupNotFound'):
@@ -332,7 +399,7 @@ def _nic_ips(t: rest.Transport, cluster_name: str,
     nic_id = nics[0].get('id', '')
     nic_name = nic_id.rsplit('/', 1)[-1]
     nic = t.call('GET', _network_path(
-        cluster_name, f'/networkInterfaces/{nic_name}'))
+        t, cluster_name, f'/networkInterfaces/{nic_name}'))
     internal, external = '', None
     for ipcfg in nic.get('properties', {}).get('ipConfigurations', []):
         props = ipcfg.get('properties', {})
@@ -341,7 +408,7 @@ def _nic_ips(t: rest.Transport, cluster_name: str,
         if pub.get('id'):
             ip_name = pub['id'].rsplit('/', 1)[-1]
             ip = t.call('GET', _network_path(
-                cluster_name, f'/publicIPAddresses/{ip_name}'))
+                t, cluster_name, f'/publicIPAddresses/{ip_name}'))
             external = ip.get('properties', {}).get('ipAddress', external)
     return {'internal': internal, 'external': external}
 
@@ -379,11 +446,26 @@ def get_cluster_info(region: str, cluster_name: str,
 
 def open_ports(cluster_name: str, ports: List[str],
                provider_config: Dict[str, Any]) -> None:
-    """No-op: the lean network has no NSG, so the subnet admits all
-    inbound traffic already (Azure only filters when an NSG is
-    attached). Kept as an explicit op so the dispatcher contract holds.
-    """
-    del cluster_name, ports, provider_config
+    """Append allow rules to the cluster NSG created at provision time
+    (Standard public IPs deny inbound by default)."""
+    t = _transport(provider_config)
+    nsg = f'/networkSecurityGroups/{cluster_name}-nsg'
+    for i, port in enumerate(ports):
+        lo, _, hi = str(port).partition('-')
+        rule = f'{nsg}/securityRules/xsky-port-{lo}'
+        try:
+            t.call('PUT', _network_path(t, cluster_name, rule), {
+                'properties': {
+                    'priority': 1100 + i,
+                    'direction': 'Inbound', 'access': 'Allow',
+                    'protocol': 'Tcp',
+                    'sourceAddressPrefix': '*', 'sourcePortRange': '*',
+                    'destinationAddressPrefix': '*',
+                    'destinationPortRange': f'{lo}-{hi}' if hi else lo,
+                },
+            })
+        except rest.AzureApiError as e:
+            logger.warning(f'open_ports({port}) failed: {e}')
 
 
 def cleanup_ports(cluster_name: str,

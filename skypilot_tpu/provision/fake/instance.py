@@ -108,12 +108,45 @@ class FailureInjector:
 injector = FailureInjector()
 
 
+def _kill_host_processes(host_root: str) -> None:
+    """Kill every process the fake host's agent spawned.
+
+    Real clouds reclaim processes when the VM dies; fake hosts are
+    directories on THIS machine, so without this, each e2e test leaks
+    its job process trees (agent daemons, job_runners, user servers) —
+    enough leaked jax-importing children can even wedge a single-client
+    accelerator tunnel for the whole machine.
+    """
+    import signal
+    import sqlite3
+    for root in (host_root, os.path.join(host_root, '.xsky')):
+        db = os.path.join(root, 'jobs.db')
+        if not os.path.exists(db):
+            continue
+        try:
+            conn = sqlite3.connect(db, timeout=5)
+            rows = conn.execute(
+                'SELECT pid FROM jobs WHERE pid IS NOT NULL').fetchall()
+            conn.close()
+        except sqlite3.Error:
+            continue
+        for (pid,) in rows:
+            try:
+                os.killpg(os.getpgid(int(pid)), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError, OSError):
+                try:
+                    os.kill(int(pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError, OSError):
+                    pass
+
+
 def reset() -> None:
     with _store() as data:
         for cluster in data['clusters'].values():
             for info in cluster['instances'].values():
                 root = info.get('tags', {}).get('host_root')
                 if root:
+                    _kill_host_processes(root)
                     shutil.rmtree(root, ignore_errors=True)
         data['clusters'] = {}
         data['provision_regions'] = {}
@@ -201,6 +234,7 @@ def terminate_instances(cluster_name: str,
         for info in cluster['instances'].values():
             root = info.get('tags', {}).get('host_root')
             if root:
+                _kill_host_processes(root)
                 shutil.rmtree(root, ignore_errors=True)
 
 

@@ -176,11 +176,26 @@ class TestArmProvisioner:
                                            _config(count=2))
         assert len(record.created_instance_ids) == 2
         assert record.head_instance_id == 'azc-0'
-        # The cluster's whole footprint lives in its resource group.
+        # The cluster's whole footprint lives in its (region-scoped)
+        # resource group.
         rg_paths = {k for k in fake_arm.resources
-                    if '/resourceGroups/xsky-azc-rg' in k}
+                    if '/resourceGroups/xsky-azc-eastus-rg' in k}
         assert any('/virtualNetworks/' in k for k in rg_paths)
         assert any('/networkInterfaces/' in k for k in rg_paths)
+        # Standard public IPs deny inbound without an NSG: the subnet
+        # must carry one with an SSH allow rule.
+        nsgs = [fake_arm.resources[k] for k in rg_paths
+                if '/networkSecurityGroups/' in k]
+        assert nsgs, 'no NSG created'
+        rules = nsgs[0]['properties']['securityRules']
+        assert any(r['properties']['destinationPortRange'] == '22'
+                   for r in rules)
+        # VM delete must cascade to OS disk + NIC (no billing leaks).
+        vm = fake_arm.resources[fake_arm.vms[0]]
+        assert vm['properties']['storageProfile']['osDisk'][
+            'deleteOption'] == 'Delete'
+        assert vm['properties']['networkProfile']['networkInterfaces'][
+            0]['properties']['deleteOption'] == 'Delete'
         info = az_instance.get_cluster_info('eastus', 'azc',
                                             {'region': 'eastus'})
         assert len(info.instances) == 2
@@ -235,9 +250,36 @@ class TestArmProvisioner:
         with pytest.raises(exceptions.CapacityError):
             az_instance.run_instances('eastus', None, 'azc',
                                       _config(count=2))
-        # First VM may have been created before the failure — the
-        # partial resource group must be gone.
+        # The whole partial resource group (VMs AND half-built network)
+        # must be gone so a next-region retry starts from zero.
         assert not fake_arm.vms
+        assert not [k for k in fake_arm.resources
+                    if '/resourceGroups/xsky-azc-eastus-rg' in k]
+
+    def test_scaleup_failure_keeps_healthy_fleet(self, fake_arm):
+        """Allocation failure while adding a node must delete only this
+        attempt's VM + public IP, never the existing fleet or its
+        network."""
+        az_instance.run_instances('eastus', None, 'azc', _config(count=2))
+        assert len(fake_arm.vms) == 2
+        fake_arm.fail_vm_create.append(az_rest.AzureApiError(
+            409, 'AllocationFailed', 'no capacity for node 3'))
+        with pytest.raises(exceptions.CapacityError):
+            az_instance.run_instances('eastus', None, 'azc',
+                                      _config(count=3))
+        assert len(fake_arm.vms) == 2          # healthy fleet intact
+        assert not [k for k in fake_arm.resources
+                    if k.endswith('/publicIPAddresses/azc-2-ip')]
+        # Network still present for the surviving nodes.
+        assert [k for k in fake_arm.resources if '/virtualNetworks/' in k]
+
+    def test_open_ports_appends_nsg_rules(self, fake_arm):
+        az_instance.run_instances('eastus', None, 'azc', _config())
+        az_instance.open_ports('azc', ['8080', '9000-9010'],
+                               {'region': 'eastus'})
+        rules = [k for k in fake_arm.resources
+                 if '/securityRules/xsky-port-' in k]
+        assert len(rules) == 2
 
     def test_quota_error_classified(self, fake_arm):
         fake_arm.fail_vm_create.append(az_rest.AzureApiError(

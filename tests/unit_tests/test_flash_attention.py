@@ -42,6 +42,30 @@ def test_gradients_match_reference():
         np.testing.assert_allclose(gf, gr, atol=5e-4)
 
 
+@pytest.mark.parametrize('causal', [True, False])
+def test_gradients_multiblock_causal_skip(causal):
+    """Small blocks over a longer sequence: the backward kernels' causal
+    block-skip predicate (and dk/dv accumulation across many inner grid
+    steps) must not drop or double-count any block."""
+    q, k, v = _rand((1, 512, 2, 64), 3), _rand((1, 512, 2, 64), 4), \
+        _rand((1, 512, 2, 64), 5)
+
+    def loss(f):
+        return lambda q, k, v: jnp.sum(f(q, k, v) ** 2)
+
+    def flash(q, k, v):
+        return fa.flash_attention(q, k, v, causal=causal,
+                                  block_q=128, block_kv=128)
+
+    def ref(q, k, v):
+        return attention_ops.xla_attention(q, k, v, causal=causal)
+
+    g_flash = jax.grad(loss(flash), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss(ref), argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(gf, gr, atol=5e-4)
+
+
 def test_uneven_block_boundary():
     # seq shorter than default block: kernel must clamp block size.
     q, k, v = _rand((1, 256, 2, 64), 0), _rand((1, 256, 2, 64), 1), \

@@ -1,0 +1,136 @@
+"""Qwen model family: QKV-bias (Qwen-2) and QK-norm (Qwen-3) variants,
+chunked-CE head, trainer integration on the 8-device mesh."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu import models
+from skypilot_tpu.models import qwen
+from skypilot_tpu.parallel import mesh as mesh_lib
+
+
+@pytest.fixture(scope='module')
+def tiny2():
+    return qwen.QWEN_TINY
+
+
+@pytest.fixture(scope='module')
+def tiny3():
+    return qwen.QWEN3_TINY
+
+
+@pytest.fixture(scope='module')
+def params2(tiny2):
+    return qwen.init(tiny2, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope='module')
+def params3(tiny3):
+    return qwen.init(tiny3, jax.random.PRNGKey(0))
+
+
+class TestQwenForward:
+
+    def test_logits_shape_and_dtype(self, tiny2, params2):
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        logits = qwen.forward(tiny2, params2, tokens)
+        assert logits.shape == (2, 16, tiny2.vocab_size)
+        assert logits.dtype == jnp.float32
+
+    def test_variant_param_sets(self, tiny2, tiny3, params2, params3):
+        # Qwen-2: biases, no qk norms; Qwen-3: the reverse.
+        assert {'bq', 'bk', 'bv'} <= set(params2['layers'])
+        assert 'q_norm' not in params2['layers']
+        assert {'q_norm', 'k_norm'} <= set(params3['layers'])
+        assert 'bq' not in params3['layers']
+        # Both count their params consistently with their pytree.
+        for c, p in ((tiny2, params2), (tiny3, params3)):
+            n = sum(x.size for x in jax.tree.leaves(p))
+            assert n == c.num_params()
+
+    @pytest.mark.parametrize('variant', ['tiny2', 'tiny3'])
+    def test_causality(self, variant, request):
+        c = request.getfixturevalue(variant)
+        p = request.getfixturevalue('params' + variant[-1])
+        t1 = jnp.zeros((1, 8), jnp.int32)
+        t2 = t1.at[0, 7].set(5)
+        l1 = qwen.forward(c, p, t1)
+        l2 = qwen.forward(c, p, t2)
+        np.testing.assert_allclose(np.asarray(l1[0, :7]),
+                                   np.asarray(l2[0, :7]), atol=1e-5)
+
+    def test_qk_norm_changes_output(self, tiny3, params3):
+        """Scaling k_norm must change logits (the norm is live)."""
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0,
+                                    tiny3.vocab_size)
+        base = qwen.forward(tiny3, params3, tokens)
+        bumped = jax.tree_util.tree_map(lambda x: x, params3)
+        bumped = {**params3, 'layers': {**params3['layers'],
+                                        'k_norm':
+                                        params3['layers']['k_norm'] * 2.0}}
+        out = qwen.forward(tiny3, bumped, tokens)
+        assert float(jnp.abs(out - base).max()) > 1e-4
+
+    @pytest.mark.parametrize('variant', ['tiny2', 'tiny3'])
+    def test_loss_decreases_under_sgd(self, variant, request):
+        c = request.getfixturevalue(variant)
+        params = qwen.init(c, jax.random.PRNGKey(3))
+        tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0,
+                                    c.vocab_size)
+        targets = jnp.roll(tokens, -1, axis=1)
+        loss0, grads = jax.value_and_grad(
+            lambda p: qwen.loss_fn(c, p, tokens, targets))(params)
+        params2 = jax.tree.map(
+            lambda p, g: (p - 0.5 * g.astype(p.dtype)), params, grads)
+        loss1 = qwen.loss_fn(c, params2, tokens, targets)
+        assert float(loss1) < float(loss0)
+
+    def test_chunked_ce_matches_whole(self, tiny2, params2):
+        """ce_chunk smaller than seq must not change the loss."""
+        tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 16), 0,
+                                    tiny2.vocab_size)
+        targets = jnp.roll(tokens, -1, axis=1)
+        whole = qwen.loss_fn(tiny2, params2, tokens, targets)
+        chunked_cfg = dataclasses.replace(tiny2, ce_chunk=4)
+        chunked = qwen.loss_fn(chunked_cfg, params2, tokens, targets)
+        np.testing.assert_allclose(float(whole), float(chunked),
+                                   rtol=1e-5)
+
+    def test_registry_dispatch(self, tiny2):
+        assert models.module_for(tiny2) is qwen
+        assert models.get_config('qwen3-8b') is qwen.QWEN3_8B
+        from skypilot_tpu.models import llama
+        assert models.module_for(llama.LLAMA_TINY) is llama
+
+
+class TestQwenSharded:
+
+    def test_trainer_step_on_mesh(self, tiny3):
+        from skypilot_tpu.train import trainer as trainer_lib
+        plan = mesh_lib.MeshPlan(data=2, fsdp=2, tensor=2)
+        config = trainer_lib.TrainConfig(
+            model=dataclasses.replace(tiny3, remat=True),
+            global_batch_size=4, seq_len=32,
+            optimizer='adafactor', warmup_steps=1,
+            mesh_plan=plan)
+        trainer = trainer_lib.Trainer(config)
+        state = trainer.init_state()
+        batch = trainer.synthetic_batch(0)
+        state, metrics = trainer.step(state, batch)
+        state, metrics = trainer.step(state, batch)
+        loss_a = float(metrics['loss'])
+        state, metrics = trainer.step(state, batch)
+        assert float(metrics['loss']) < loss_a
+
+    def test_sharded_matches_single_device(self, tiny2, params2):
+        tokens = jax.random.randint(jax.random.PRNGKey(6), (4, 16), 0,
+                                    tiny2.vocab_size)
+        targets = jnp.roll(tokens, -1, axis=1)
+        ref = qwen.loss_fn(tiny2, params2, tokens, targets)
+        mesh = mesh_lib.build_mesh(
+            mesh_lib.MeshPlan(data=2, fsdp=2, tensor=2).resolve(8))
+        sharded = qwen.loss_fn(tiny2, params2, tokens, targets, mesh=mesh)
+        np.testing.assert_allclose(float(ref), float(sharded), rtol=2e-3)
