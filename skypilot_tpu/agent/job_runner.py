@@ -100,7 +100,11 @@ def main() -> int:
         # explicitly (cancel_job / cluster teardown send us SIGTERM).
         del signum, frame
         gang.kill_active()
-        job_lib.set_status(job_id, job_lib.JobStatus.CANCELLED, root)
+        # A SIGTERM arriving after the job already finished (teardown
+        # racing completion) must not overwrite SUCCEEDED/FAILED.
+        current = job_lib.get_job(job_id, root)
+        if current is None or not current['status'].is_terminal():
+            job_lib.set_status(job_id, job_lib.JobStatus.CANCELLED, root)
         sys.exit(143)
 
     import signal

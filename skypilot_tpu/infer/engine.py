@@ -49,16 +49,18 @@ class InferenceEngine:
     def __init__(self, config: EngineConfig,
                  params: llama.Params,
                  mesh: Optional[mesh_lib.Mesh] = None) -> None:
-        from skypilot_tpu.models import moe
-        if isinstance(config.model, moe.MoEConfig):
+        from skypilot_tpu import models
+        self._model_lib = models.module_for(config.model)
+        # Any family exposing the prefill_hidden/decode_forward pair
+        # (llama, qwen) plugs into the slot engine; families without a
+        # decode path (gemma tied-softcapped head, moe expert KV
+        # layout) are rejected up front rather than failing mid-serve.
+        if not (hasattr(self._model_lib, 'prefill_hidden') and
+                hasattr(self._model_lib, 'decode_forward')):
             raise NotImplementedError(
-                'MoE serving is not wired into the slot engine yet; '
-                'the decode path is Llama-only (dense MLP KV layout).')
-        if type(config.model) is not llama.LlamaConfig:
-            raise NotImplementedError(
-                f'Serving is wired for the Llama family only; '
-                f'{type(config.model).__name__} needs its own '
-                'prefill/decode path (e.g. gemma tied-embedding head).')
+                f'Serving needs a prefill_hidden/decode_forward pair; '
+                f'{type(config.model).__name__} '
+                f'({self._model_lib.__name__}) does not provide one.')
         self.config = config
         self.params = params
         self.mesh = mesh
@@ -114,8 +116,8 @@ class InferenceEngine:
         decode step (temperature 0 → greedy).
         """
         c = self.config.model
-        last_hidden, kv = llama.prefill_hidden(c, params, tokens,
-                                               true_len, mesh=self.mesh)
+        last_hidden, kv = self._model_lib.prefill_hidden(
+            c, params, tokens, true_len, mesh=self.mesh)
         logits = jnp.einsum('bd,dv->bv', last_hidden, params['lm_head'],
                             preferred_element_type=jnp.float32)
         first_token = sampling.sample_batched(logits, key, temperature,
@@ -189,7 +191,7 @@ class InferenceEngine:
         constants."""
         c = self.config.model
         kv = {'k': state['kv_k'], 'v': state['kv_v']}
-        logits, new_kv = llama.decode_forward(
+        logits, new_kv = self._model_lib.decode_forward(
             c, params, state['tokens'], state['lengths'], kv,
             mesh=self.mesh)
         next_tokens = sampling.sample_batched(logits, key, temperatures,

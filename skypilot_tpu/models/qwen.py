@@ -159,7 +159,12 @@ def init(config: QwenConfig, key: jax.Array) -> Params:
 
 
 def _layer(config: QwenConfig, mesh: Optional[mesh_lib.Mesh],
-           x: jax.Array, lp: Params, positions: jax.Array) -> jax.Array:
+           x: jax.Array, lp: Params, positions: jax.Array,
+           kv_cache=None, cache_positions: Optional[jax.Array] = None,
+           return_kv: bool = False):
+    """One block. Training/prefill by default; with kv_cache set, a
+    decode step writing each slot's new K/V at its own position (same
+    contract as llama._layer's continuous-batching path)."""
     c = config
     hd = c.head_dim
     b, s, _ = x.shape
@@ -185,8 +190,21 @@ def _layer(config: QwenConfig, mesh: Optional[mesh_lib.Mesh],
     k = shard(k, ('batch', 'activation_length', 'activation_kv', None))
     q = llama._rope(q, positions, c.rope_theta)
     k = llama._rope(k, positions, c.rope_theta)
-    attn = attention_ops.dot_product_attention(
-        q, k, v, causal=True, implementation=c.attention_impl)
+
+    if kv_cache is not None:
+        ck, cv = kv_cache
+        slots = jnp.arange(b)
+        ck = ck.at[slots, cache_positions].set(k[:, 0])
+        cv = cv.at[slots, cache_positions].set(v[:, 0])
+        new_cache = (ck, cv)
+        kv_pos = jnp.arange(ck.shape[1])[None, :]
+        valid = kv_pos <= cache_positions[:, None]
+        attn = attention_ops.xla_attention_with_mask(
+            q, ck, cv, valid[:, None, None, :])
+    else:
+        new_cache = (k, v) if return_kv else None
+        attn = attention_ops.dot_product_attention(
+            q, k, v, causal=True, implementation=c.attention_impl)
     attn = attn.reshape(b, s, c.n_heads * hd)
     x = x + shard(llama._ckpt_name(attn @ lp['wo'], 'attn_o'),
                   ('batch', 'activation_length', 'activation_embed'))
@@ -199,12 +217,13 @@ def _layer(config: QwenConfig, mesh: Optional[mesh_lib.Mesh],
                ('batch', 'activation_length', 'activation_mlp'))
     x = x + shard(ff @ lp['w_down'],
                   ('batch', 'activation_length', 'activation_embed'))
-    return x
+    return x, new_cache
 
 
 def _trunk(config: QwenConfig, params: Params, tokens: jax.Array,
            positions: Optional[jax.Array],
-           mesh: Optional[mesh_lib.Mesh]) -> jax.Array:
+           mesh: Optional[mesh_lib.Mesh],
+           return_kv: bool = False):
     c = config
     if positions is None:
         positions = jnp.broadcast_to(
@@ -215,19 +234,53 @@ def _trunk(config: QwenConfig, params: Params, tokens: jax.Array,
             x, mesh, ('batch', 'activation_length', 'activation_embed'))
 
     def layer_fn(x, lp):
-        return _layer(c, mesh, x, lp, positions), None
+        x, kv = _layer(c, mesh, x, lp, positions, return_kv=return_kv)
+        return x, ({'k': kv[0], 'v': kv[1]} if return_kv else None)
 
-    if c.remat:
+    if c.remat and not return_kv:
         layer_fn = jax.checkpoint(layer_fn, policy=llama._remat_policy(c))
-    x, _ = jax.lax.scan(layer_fn, x, params['layers'])
-    return llama._rms_norm(x, params['final_norm'], c.norm_eps)
+    x, kv = jax.lax.scan(layer_fn, x, params['layers'])
+    return llama._rms_norm(x, params['final_norm'], c.norm_eps), kv
+
+
+def prefill_hidden(config: QwenConfig, params: Params, tokens: jax.Array,
+                   true_len: jax.Array,
+                   mesh: Optional[mesh_lib.Mesh] = None):
+    """Prefill trunk → (last_hidden [B, D], per-layer KV) — the same
+    engine contract as llama.prefill_hidden."""
+    x, kv = _trunk(config, params, tokens, None, mesh, return_kv=True)
+    last = jax.lax.dynamic_index_in_dim(x, true_len - 1, axis=1,
+                                        keepdims=False)
+    return last, kv
+
+
+def decode_forward(config: QwenConfig, params: Params,
+                   last_tokens: jax.Array, positions: jax.Array,
+                   kv, mesh: Optional[mesh_lib.Mesh] = None):
+    """One decode step for a batch of slots (llama.decode_forward twin)."""
+    c = config
+    x = params['embed'][last_tokens[:, None]].astype(c.dtype)
+    pos = positions[:, None]
+
+    def layer_fn(x, scanned):
+        lp, ck, cv = scanned
+        x, new_cache = _layer(c, mesh, x, lp, pos, kv_cache=(ck, cv),
+                              cache_positions=positions)
+        return x, {'k': new_cache[0], 'v': new_cache[1]}
+
+    x, new_kv = jax.lax.scan(layer_fn, x, (params['layers'],
+                                           kv['k'], kv['v']))
+    x = llama._rms_norm(x, params['final_norm'], c.norm_eps)
+    logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'],
+                        preferred_element_type=jnp.float32)
+    return logits[:, 0], new_kv
 
 
 def forward(config: QwenConfig, params: Params, tokens: jax.Array,
             mesh: Optional[mesh_lib.Mesh] = None,
             positions: Optional[jax.Array] = None) -> jax.Array:
     """Training forward → fp32 logits [B, S, vocab]."""
-    x = _trunk(config, params, tokens, positions, mesh)
+    x, _ = _trunk(config, params, tokens, positions, mesh)
     return jnp.einsum('bsd,dv->bsv', x, params['lm_head'],
                       preferred_element_type=jnp.float32)
 
@@ -236,6 +289,6 @@ def loss_fn(config: QwenConfig, params: Params, tokens: jax.Array,
             targets: jax.Array, mesh: Optional[mesh_lib.Mesh] = None,
             loss_mask: Optional[jax.Array] = None) -> jax.Array:
     """Mean next-token CE; reuses llama's chunked large-vocab scan."""
-    x = _trunk(config, params, tokens, None, mesh)
+    x, _ = _trunk(config, params, tokens, None, mesh)
     return llama._chunked_ce(x, params['lm_head'], targets, loss_mask,
                              config.ce_chunk)

@@ -254,6 +254,7 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
     attempted: List[str] = []
     resumed: List[str] = []
     existing: List[Dict[str, Any]] = []
+    touched_network = False
     try:
         existing = _sorted_nodes(_list_vms(t, cluster_name))
         if config.resume_stopped_nodes:
@@ -266,6 +267,7 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
         have = len(existing)
         missing = config.count - have
         if missing > 0:
+            touched_network = True
             subnet_id = _ensure_network(t, cluster_name, region)
             has_head = any((vm.get('tags') or {}).get(HEAD_TAG) == 'true'
                            for vm in existing)
@@ -294,7 +296,10 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
         # attempt's VMs may go (their disk/NIC cascade via
         # deleteOption); the healthy fleet and its network survive.
         try:
-            if not existing:
+            # Fresh-cluster delete only if this attempt actually began
+            # building (a transient error on the initial listing of a
+            # HEALTHY cluster must never nuke its resource group).
+            if not existing and touched_network:
                 t.call('DELETE',
                        f'/resourceGroups/{_rg(cluster_name, region)}'
                        '?forceDeletionTypes='
@@ -450,13 +455,29 @@ def open_ports(cluster_name: str, ports: List[str],
     (Standard public IPs deny inbound by default)."""
     t = _transport(provider_config)
     nsg = f'/networkSecurityGroups/{cluster_name}-nsg'
-    for i, port in enumerate(ports):
+    # Priorities must be unique per NSG/direction across *all* calls:
+    # read the live rule set and allocate from the first free slot.
+    try:
+        current = t.call('GET', _network_path(t, cluster_name, nsg))
+    except rest.AzureApiError as e:
+        logger.warning(f'open_ports: cannot read NSG: {e}')
+        return
+    rules = current.get('properties', {}).get('securityRules', [])
+    used = {r.get('properties', {}).get('priority') for r in rules}
+    have = {r.get('name') for r in rules}
+    next_priority = 1100
+    for port in ports:
         lo, _, hi = str(port).partition('-')
-        rule = f'{nsg}/securityRules/xsky-port-{lo}'
+        name = f'xsky-port-{lo}'
+        if name in have:
+            continue
+        while next_priority in used:
+            next_priority += 1
+        rule = f'{nsg}/securityRules/{name}'
         try:
             t.call('PUT', _network_path(t, cluster_name, rule), {
                 'properties': {
-                    'priority': 1100 + i,
+                    'priority': next_priority,
                     'direction': 'Inbound', 'access': 'Allow',
                     'protocol': 'Tcp',
                     'sourceAddressPrefix': '*', 'sourcePortRange': '*',
@@ -464,6 +485,7 @@ def open_ports(cluster_name: str, ports: List[str],
                     'destinationPortRange': f'{lo}-{hi}' if hi else lo,
                 },
             })
+            used.add(next_priority)
         except rest.AzureApiError as e:
             logger.warning(f'open_ports({port}) failed: {e}')
 

@@ -29,6 +29,7 @@ class FakeArm:
     def __init__(self) -> None:
         self.resources: Dict[str, Dict[str, Any]] = {}
         self.fail_vm_create: List[az_rest.AzureApiError] = []
+        self.fail_list: List[az_rest.AzureApiError] = []
         self.calls: List[str] = []
         self.subscription = 'sub-test'
 
@@ -104,6 +105,8 @@ class FakeArm:
         return dict(body)
 
     def _get(self, key: str) -> Dict[str, Any]:
+        if key.endswith('/virtualMachines') and self.fail_list:
+            raise self.fail_list.pop(0)
         if key.endswith('/virtualMachines'):
             rg = self._rg_of(key)
             out = []
@@ -119,7 +122,19 @@ class FakeArm:
             return {'value': out}
         if key not in self.resources:
             raise az_rest.AzureApiError(404, 'NotFound', key)
-        return dict(self.resources[key])
+        res = dict(self.resources[key])
+        if ('/networkSecurityGroups/' in key and
+                '/securityRules/' not in key):
+            # ARM returns child securityRules inline on the parent GET.
+            props = dict(res.get('properties', {}))
+            rules = list(props.get('securityRules', []))
+            for rkey, child in self.resources.items():
+                if rkey.startswith(f'{key}/securityRules/'):
+                    rules.append({'name': child['name'],
+                                  'properties': child['properties']})
+            props['securityRules'] = rules
+            res['properties'] = props
+        return res
 
     def _post(self, key: str) -> Dict[str, Any]:
         base, _, verb = key.rpartition('/')
@@ -280,6 +295,29 @@ class TestArmProvisioner:
         rules = [k for k in fake_arm.resources
                  if '/securityRules/xsky-port-' in k]
         assert len(rules) == 2
+        # A later call must allocate fresh, unique priorities (ARM
+        # rejects duplicate priorities per NSG/direction).
+        az_instance.open_ports('azc', ['7000'], {'region': 'eastus'})
+        priorities = [
+            fake_arm.resources[k]['properties']['priority']
+            for k in fake_arm.resources
+            if '/securityRules/xsky-port-' in k]
+        assert len(priorities) == 3
+        assert len(set(priorities)) == 3
+
+    def test_transient_list_error_keeps_healthy_cluster(self, fake_arm):
+        """A throttled listing at the top of a resume/scale-up must not
+        delete the healthy cluster's resource group."""
+        az_instance.run_instances('eastus', None, 'azc', _config())
+        assert len(fake_arm.vms) == 1
+        fake_arm.fail_list.append(az_rest.AzureApiError(
+            429, 'TooManyRequests', 'throttled'))
+        with pytest.raises(az_rest.AzureApiError):
+            az_instance.run_instances('eastus', None, 'azc',
+                                      _config(count=2))
+        assert len(fake_arm.vms) == 1   # fleet + RG untouched
+        assert [k for k in fake_arm.resources
+                if '/resourceGroups/xsky-azc-eastus-rg' in k]
 
     def test_quota_error_classified(self, fake_arm):
         fake_arm.fail_vm_create.append(az_rest.AzureApiError(

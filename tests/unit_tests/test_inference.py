@@ -195,3 +195,38 @@ def test_run_until_drained_marks_truncated(tiny_engine):
     orch.run_until_drained(max_steps=2)
     assert req.done and req.error is not None
     assert len(orch._free_slots) == tiny_engine.config.max_slots
+
+
+@pytest.mark.parametrize('variant', ['qwen-tiny', 'qwen3-tiny'])
+def test_qwen_cached_decode_matches_full_forward(variant):
+    """The engine's model binding is family-generic: Qwen (biased QKV
+    and QK-norm variants) decodes through the slot KV cache exactly as
+    its full re-forward greedy reference."""
+    from skypilot_tpu.models import qwen
+    c = qwen.CONFIGS[variant]
+    params = qwen.init(c, jax.random.PRNGKey(0))
+    config = engine_lib.EngineConfig(
+        model=c, max_slots=2, max_target_len=32, prefill_buckets=(16,))
+    engine = engine_lib.InferenceEngine(config, params)
+
+    prompt = [5, 17, 3, 99, 42]
+    n_new = 6
+    tokens = list(prompt)
+    for _ in range(n_new):
+        logits = qwen.forward(c, params, jnp.asarray([tokens], jnp.int32))
+        tokens.append(int(jnp.argmax(logits[0, -1])))
+    expected = tokens[len(prompt):]
+
+    orch = orch_lib.Orchestrator(engine)
+    outputs = orch.generate([prompt], max_new_tokens=n_new)
+    assert outputs[0] == expected
+
+
+def test_gemma_still_rejected_with_clear_error():
+    from skypilot_tpu.models import gemma
+    config = engine_lib.EngineConfig(
+        model=gemma.GEMMA_TINY, max_slots=2, max_target_len=32,
+        prefill_buckets=(16,))
+    params = gemma.init(gemma.GEMMA_TINY, jax.random.PRNGKey(0))
+    with pytest.raises(NotImplementedError, match='prefill_hidden'):
+        engine_lib.InferenceEngine(config, params)
