@@ -304,13 +304,16 @@ def show_gpus(accelerator_filter, show_all):
 
 @cli.command(name='cost-report')
 def cost_report():
-    """Estimated costs of live clusters."""
+    """Billable cost of live and torn-down clusters."""
     from skypilot_tpu.client import sdk
     rows = sdk.cost_report()
-    fmt = '{:<18} {:<28} {:>8} {:>10}'
-    click.echo(fmt.format('NAME', 'RESOURCES', '$/HR', 'TOTAL $'))
+    fmt = '{:<18} {:<28} {:<11} {:>9} {:>8} {:>10}'
+    click.echo(fmt.format('NAME', 'RESOURCES', 'STATUS', 'UPTIME_H',
+                          '$/HR', 'TOTAL $'))
     for r in rows:
         click.echo(fmt.format(r['name'], r['resources'][:28],
+                              r.get('status', '-'),
+                              f"{r['uptime_hours']:.2f}",
                               f"{r['hourly_cost']:.2f}",
                               f"{r['total_cost']:.2f}"))
 

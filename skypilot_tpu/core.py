@@ -217,22 +217,46 @@ def check(quiet: bool = False) -> Dict[str, Any]:
 
 
 def cost_report() -> List[Dict[str, Any]]:
-    """Per-cluster cost estimate from catalog prices."""
-    import time
+    """Per-cluster cost: catalog rate × billable uptime.
+
+    Billable uptime comes from the cluster's usage intervals (the clock
+    pauses while STOPPED), and torn-down clusters stay in the report
+    via cluster_history — twin of the reference's duration-based
+    cost_report rather than a naive price × wall-clock estimate.
+    """
+    def _rate_of(handle):
+        if handle is None:
+            return None, 0.0
+        resources = handle.launched_resources
+        try:
+            return resources, resources.get_hourly_cost()
+        except ValueError:
+            return resources, 0.0
+
     out = []
     for record in state.get_clusters():
-        handle = record['handle']
-        if handle is None:
+        resources, rate = _rate_of(record['handle'])
+        if resources is None:
             continue
-        resources = handle.launched_resources
-        hours = (time.time() - record['launched_at']) / 3600.0
-        try:
-            rate = resources.get_hourly_cost()
-        except ValueError:
-            rate = 0.0
+        hours = state.billed_seconds(
+            record.get('usage_intervals')) / 3600.0
         out.append({
             'name': record['name'],
             'resources': str(resources),
+            'status': record['status'].value,
+            'hourly_cost': rate,
+            'uptime_hours': hours,
+            'total_cost': rate * hours,
+        })
+    for record in state.get_cluster_history():
+        resources, rate = _rate_of(record['handle'])
+        if resources is None:
+            continue
+        hours = (record['duration_s'] or 0.0) / 3600.0
+        out.append({
+            'name': record['name'],
+            'resources': str(resources),
+            'status': 'TERMINATED',
             'hourly_cost': rate,
             'uptime_hours': hours,
             'total_cost': rate * hours,

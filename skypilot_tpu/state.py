@@ -8,6 +8,7 @@ XSKY_STATE_DB for tests).
 from __future__ import annotations
 
 import enum
+import json
 import os
 import pickle
 import sqlite3
@@ -103,13 +104,27 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             name TEXT PRIMARY KEY,
             created_at INTEGER
         );
+        CREATE TABLE IF NOT EXISTS cluster_history (
+            row_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            name TEXT,
+            launched_at INTEGER,
+            torn_down_at INTEGER,
+            duration_s REAL,
+            handle BLOB,
+            workspace TEXT
+        );
     """)
     # Migration for pre-workspace DBs: clusters gain a workspace column.
-    try:
-        conn.execute("ALTER TABLE clusters ADD COLUMN workspace TEXT "
-                     "DEFAULT 'default'")
-    except sqlite3.OperationalError:
-        pass  # column already exists
+    for migration in (
+            "ALTER TABLE clusters ADD COLUMN workspace TEXT "
+            "DEFAULT 'default'",
+            # Billable wall-clock: JSON [[start, end|null], ...]; an
+            # open interval means the cluster is running right now.
+            "ALTER TABLE clusters ADD COLUMN usage_intervals TEXT"):
+        try:
+            conn.execute(migration)
+        except sqlite3.OperationalError:
+            pass  # column already exists
     conn.execute("INSERT OR IGNORE INTO workspaces (name, created_at) "
                  "VALUES ('default', strftime('%s','now'))")
     conn.commit()
@@ -125,6 +140,47 @@ def reset_for_test() -> None:
 
 
 # ---- clusters -------------------------------------------------------------
+
+
+def _load_intervals(conn, name: str):
+    row = conn.execute(
+        'SELECT usage_intervals FROM clusters WHERE name=?',
+        (name,)).fetchone()
+    if row is None or not row[0]:
+        return []
+    try:
+        return json.loads(row[0])
+    except ValueError:
+        return []
+
+
+def _store_intervals(conn, name: str, intervals) -> None:
+    conn.execute('UPDATE clusters SET usage_intervals=? WHERE name=?',
+                 (json.dumps(intervals), name))
+
+
+def _open_interval(conn, name: str, now: int) -> None:
+    intervals = _load_intervals(conn, name)
+    if not intervals or intervals[-1][1] is not None:
+        intervals.append([now, None])
+        _store_intervals(conn, name, intervals)
+
+
+def _close_interval(conn, name: str, now: int):
+    intervals = _load_intervals(conn, name)
+    if intervals and intervals[-1][1] is None:
+        intervals[-1][1] = now
+        _store_intervals(conn, name, intervals)
+    return intervals
+
+
+def billed_seconds(intervals, now: Optional[float] = None) -> float:
+    """Total billable seconds across intervals (open one counts to now)."""
+    now = now if now is not None else time.time()
+    total = 0.0
+    for start, end in intervals or []:
+        total += (end if end is not None else now) - start
+    return max(total, 0.0)
 
 
 def add_or_update_cluster(cluster_name: str,
@@ -158,6 +214,8 @@ def add_or_update_cluster(cluster_name: str,
             (', launched_at=excluded.launched_at' if is_launch else ''),
             (cluster_name, now, pickle.dumps(cluster_handle),
              str(now), status.value, requested, workspace, workspace))
+        # Cluster is (about to be) running: the billing clock runs.
+        _open_interval(conn, cluster_name, now)
         conn.commit()
 
 
@@ -165,8 +223,13 @@ def update_cluster_status(cluster_name: str,
                           status: ClusterStatus) -> None:
     conn = _get_conn()
     with _lock:
+        now = int(time.time())
         conn.execute('UPDATE clusters SET status=? WHERE name=?',
                      (status.value, cluster_name))
+        if status in (ClusterStatus.STOPPED,):
+            _close_interval(conn, cluster_name, now)
+        elif status == ClusterStatus.UP:
+            _open_interval(conn, cluster_name, now)
         conn.commit()
 
 
@@ -183,22 +246,62 @@ def set_cluster_autostop(cluster_name: str, idle_minutes: int,
 def remove_cluster(cluster_name: str, terminate: bool) -> None:
     conn = _get_conn()
     with _lock:
+        now = int(time.time())
         if terminate:
+            intervals = _close_interval(conn, cluster_name, now)
+            row = conn.execute(
+                'SELECT launched_at, handle, workspace FROM clusters '
+                'WHERE name=?', (cluster_name,)).fetchone()
+            if row is not None:
+                # Keep the billing record: cost-report covers torn-down
+                # clusters too (twin of the reference's cluster_history).
+                conn.execute(
+                    'INSERT INTO cluster_history (name, launched_at, '
+                    'torn_down_at, duration_s, handle, workspace) '
+                    'VALUES (?, ?, ?, ?, ?, ?)',
+                    (cluster_name, row[0], now,
+                     billed_seconds(intervals, now), row[1], row[2]))
             conn.execute('DELETE FROM clusters WHERE name=?',
                          (cluster_name,))
         else:
             conn.execute('UPDATE clusters SET status=? WHERE name=?',
                          (ClusterStatus.STOPPED.value, cluster_name))
+            _close_interval(conn, cluster_name, now)
         conn.commit()
 
 
+def get_cluster_history() -> List[Dict[str, Any]]:
+    conn = _get_conn()
+    with _lock:
+        rows = conn.execute(
+            'SELECT name, launched_at, torn_down_at, duration_s, handle, '
+            'workspace FROM cluster_history '
+            'ORDER BY torn_down_at DESC').fetchall()
+    out = []
+    for name, launched_at, torn_down_at, duration_s, handle, ws in rows:
+        out.append({
+            'name': name,
+            'launched_at': launched_at,
+            'torn_down_at': torn_down_at,
+            'duration_s': duration_s,
+            'handle': pickle.loads(handle) if handle else None,
+            'workspace': ws,
+        })
+    return out
+
+
 _CLUSTER_COLS = ('name, launched_at, handle, last_use, status, autostop, '
-                 'to_down, requested_resources, workspace')
+                 'to_down, requested_resources, workspace, '
+                 'usage_intervals')
 
 
 def _row_to_record(row) -> Dict[str, Any]:
     (name, launched_at, handle, last_use, status, autostop, to_down,
-     requested, workspace) = row
+     requested, workspace, usage_intervals) = row
+    try:
+        intervals = json.loads(usage_intervals) if usage_intervals else []
+    except ValueError:
+        intervals = []
     return {
         'name': name,
         'launched_at': launched_at,
@@ -210,6 +313,7 @@ def _row_to_record(row) -> Dict[str, Any]:
         'requested_resources': pickle.loads(requested)
                                if requested else None,
         'workspace': workspace or 'default',
+        'usage_intervals': intervals,
     }
 
 

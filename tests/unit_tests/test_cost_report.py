@@ -1,0 +1,78 @@
+"""Duration-based cost accounting: usage intervals pause while STOPPED,
+and torn-down clusters remain in the report via cluster_history."""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from skypilot_tpu import Resources, Task, core, execution, state
+
+
+@pytest.fixture
+def fake_cluster(fake_cluster_env):
+    task = Task('t', run='echo hi')
+    task.set_resources(Resources(accelerators='tpu-v5e-8'))
+    execution.launch(task, cluster_name='costc')
+    yield 'costc'
+
+
+def _intervals(name):
+    return state.get_cluster_from_name(name)['usage_intervals']
+
+
+class TestUsageIntervals:
+
+    def test_launch_opens_interval(self, fake_cluster):
+        intervals = _intervals(fake_cluster)
+        assert len(intervals) == 1
+        assert intervals[0][1] is None     # still running
+
+    def test_stop_closes_start_reopens(self, fake_cluster, monkeypatch):
+        core.stop(fake_cluster)
+        intervals = _intervals(fake_cluster)
+        assert intervals[0][1] is not None   # clock paused
+        core.start(fake_cluster)
+        intervals = _intervals(fake_cluster)
+        assert len(intervals) == 2
+        assert intervals[1][1] is None       # running again
+
+    def test_billed_seconds_excludes_stopped_time(self):
+        now = 1000.0
+        intervals = [[0, 100], [500, None]]
+        # 100s first interval + (now-500) open interval.
+        assert state.billed_seconds(intervals, now=now) == 100 + 500
+
+    def test_down_moves_cluster_to_history(self, fake_cluster):
+        core.down(fake_cluster)
+        assert state.get_cluster_from_name(fake_cluster) is None
+        history = state.get_cluster_history()
+        assert [h['name'] for h in history] == [fake_cluster]
+        assert history[0]['duration_s'] >= 0
+
+    def test_cost_report_includes_terminated(self, fake_cluster):
+        live = core.cost_report()
+        assert live and live[0]['name'] == fake_cluster
+        assert live[0]['status'] in ('UP', 'INIT')
+        assert live[0]['hourly_cost'] > 0
+        core.down(fake_cluster)
+        rows = core.cost_report()
+        terminated = [r for r in rows if r['name'] == fake_cluster]
+        assert terminated and terminated[0]['status'] == 'TERMINATED'
+        assert terminated[0]['total_cost'] >= 0
+
+    def test_stopped_cluster_not_billed_forward(self, fake_cluster,
+                                                monkeypatch):
+        core.stop(fake_cluster)
+        rows = {r['name']: r for r in core.cost_report()}
+        before = rows[fake_cluster]['uptime_hours']
+        # Time passing while stopped must not grow the bill.
+        real_time = time.time
+
+        def later():
+            return real_time() + 3600.0
+
+        monkeypatch.setattr(state.time, 'time', later)
+        rows = {r['name']: r for r in core.cost_report()}
+        assert rows[fake_cluster]['uptime_hours'] == pytest.approx(
+            before, abs=0.01)
