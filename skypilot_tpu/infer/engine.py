@@ -52,9 +52,9 @@ class InferenceEngine:
         from skypilot_tpu import models
         self._model_lib = models.module_for(config.model)
         # Any family exposing the prefill_hidden/decode_forward pair
-        # (llama, qwen) plugs into the slot engine; families without a
-        # decode path (gemma tied-softcapped head, moe expert KV
-        # layout) are rejected up front rather than failing mid-serve.
+        # (llama, qwen, moe) plugs into the slot engine; families
+        # without a decode path (gemma tied-softcapped head) are
+        # rejected up front rather than failing mid-serve.
         if not (hasattr(self._model_lib, 'prefill_hidden') and
                 hasattr(self._model_lib, 'decode_forward')):
             raise NotImplementedError(

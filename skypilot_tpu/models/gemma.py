@@ -217,8 +217,44 @@ def loss_fn(config: GemmaConfig, params: Params, tokens: jax.Array,
     Gemma train config lands).
     """
     logits = forward(config, params, tokens, mesh=mesh)
+    return _nll_mean(config, logits, targets, loss_mask)
+
+
+def _nll_mean(config: GemmaConfig, logits: jax.Array,
+              targets: jax.Array,
+              loss_mask: Optional[jax.Array]) -> jax.Array:
+    del config
     nll = llama._token_nll(logits, targets)
     if loss_mask is not None:
         return jnp.sum(nll * loss_mask) / jnp.maximum(
             jnp.sum(loss_mask), 1.0)
     return jnp.mean(nll)
+
+
+def pipelined_loss_fn(config: GemmaConfig, params: Params,
+                      tokens: jax.Array, targets: jax.Array,
+                      mesh: mesh_lib.Mesh, n_microbatches: int,
+                      loss_mask: Optional[jax.Array] = None) -> jax.Array:
+    """loss_fn with the layer stack pipelined over the 'stage' axis.
+
+    Embed scaling, the tied head and the soft-cap run as ordinary SPMD
+    outside the GPipe region (same split as llama.pipelined_loss_fn)."""
+    from skypilot_tpu.parallel import pipeline as pipeline_lib
+    c = config
+    x = llama._embed_lookup(params['embed'], tokens, mesh).astype(c.dtype)
+    x = x * jnp.asarray(c.d_model ** 0.5, c.dtype)
+
+    def one_layer(x_mb: jax.Array, lp: Params) -> jax.Array:
+        b, s, _ = x_mb.shape
+        pos = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        return _layer(c, None, x_mb, lp, pos)
+
+    x = pipeline_lib.pipeline_apply(one_layer, params['layers'], x, mesh,
+                                    n_microbatches, remat=c.remat)
+    x = _rms_norm(x, params['final_norm'], c.norm_eps)
+    logits = jnp.einsum('bsd,vd->bsv', x, params['embed'],
+                        preferred_element_type=jnp.float32)
+    if c.final_logit_softcap:
+        cap = c.final_logit_softcap
+        logits = cap * jnp.tanh(logits / cap)
+    return _nll_mean(c, logits, targets, loss_mask)

@@ -150,7 +150,8 @@ def expert_capacity(config: MoEConfig, num_tokens: int) -> int:
 
 
 def route(config: MoEConfig, router_w: jax.Array, x: jax.Array,
-          token_mask: Optional[jax.Array] = None
+          token_mask: Optional[jax.Array] = None,
+          capacity: Optional[int] = None
           ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Top-k routing → (dispatch [T,E,C], combine [T,E,C], aux_loss).
 
@@ -166,7 +167,7 @@ def route(config: MoEConfig, router_w: jax.Array, x: jax.Array,
     """
     c = config
     t = x.shape[0]
-    cap = expert_capacity(c, t)
+    cap = capacity if capacity is not None else expert_capacity(c, t)
     logits = x.astype(jnp.float32) @ router_w            # [T, E]
     probs = jax.nn.softmax(logits, axis=-1)
     gate_vals, gate_idx = jax.lax.top_k(probs, c.experts_per_token)
@@ -211,7 +212,8 @@ def route(config: MoEConfig, router_w: jax.Array, x: jax.Array,
 
 def _moe_mlp(config: MoEConfig, mesh: Optional[mesh_lib.Mesh],
              h: jax.Array, lp: Params,
-             token_mask: Optional[jax.Array] = None
+             token_mask: Optional[jax.Array] = None,
+             capacity: Optional[int] = None
              ) -> Tuple[jax.Array, jax.Array]:
     """Routed expert MLP. h [B,S,D] → (out [B,S,D], aux_loss)."""
     c = config
@@ -220,7 +222,8 @@ def _moe_mlp(config: MoEConfig, mesh: Optional[mesh_lib.Mesh],
     flat_mask = (token_mask.reshape(b * s)
                  if token_mask is not None else None)
     dispatch, combine, aux = route(c, lp['router'], x,
-                                   token_mask=flat_mask)
+                                   token_mask=flat_mask,
+                                   capacity=capacity)
 
     def shard(arr, axes):
         if mesh is None:
@@ -245,9 +248,15 @@ def _moe_mlp(config: MoEConfig, mesh: Optional[mesh_lib.Mesh],
 
 def _layer(config: MoEConfig, mesh: Optional[mesh_lib.Mesh], x: jax.Array,
            lp: Params, positions: jax.Array,
-           token_mask: Optional[jax.Array] = None
-           ) -> Tuple[jax.Array, jax.Array]:
-    """One Mixtral block: Llama attention + routed MoE MLP."""
+           token_mask: Optional[jax.Array] = None,
+           kv_cache=None, cache_positions: Optional[jax.Array] = None,
+           return_kv: bool = False):
+    """One Mixtral block: Llama attention + routed MoE MLP.
+
+    Returns (x, aux, new_kv). With kv_cache set this is a decode step
+    (same slot-cache contract as llama._layer); expert capacity is then
+    T (= slot count) so no token is ever capacity-dropped at inference.
+    """
     c = config
     hd = c.head_dim
     b, s, _ = x.shape
@@ -265,11 +274,26 @@ def _layer(config: MoEConfig, mesh: Optional[mesh_lib.Mesh], x: jax.Array,
     k = shard(k, ('batch', 'activation_length', 'activation_kv', None))
     q = llama._rope(q, positions, c.rope_theta)
     k = llama._rope(k, positions, c.rope_theta)
-    if c.attention_impl in ('ring', 'ulysses') and mesh is not None:
+    new_cache = None
+    if kv_cache is not None:
+        ck, cv = kv_cache
+        slots = jnp.arange(b)
+        ck = ck.at[slots, cache_positions].set(k[:, 0])
+        cv = cv.at[slots, cache_positions].set(v[:, 0])
+        new_cache = (ck, cv)
+        kv_pos = jnp.arange(ck.shape[1])[None, :]
+        valid = kv_pos <= cache_positions[:, None]
+        attn = attention_ops.xla_attention_with_mask(
+            q, ck, cv, valid[:, None, None, :])
+    elif c.attention_impl in ('ring', 'ulysses') and mesh is not None:
         from skypilot_tpu.ops import ring_attention as ring_ops
+        if return_kv:
+            new_cache = (k, v)
         attn = ring_ops.sequence_parallel_attention(
             q, k, v, mesh, implementation=c.attention_impl, causal=True)
     else:
+        if return_kv:
+            new_cache = (k, v)
         attn = attention_ops.dot_product_attention(
             q, k, v, causal=True, implementation=c.attention_impl)
     attn = attn.reshape(b, s, c.n_heads * hd)
@@ -277,10 +301,12 @@ def _layer(config: MoEConfig, mesh: Optional[mesh_lib.Mesh], x: jax.Array,
                   ('batch', 'activation_length', 'activation_embed'))
 
     h = llama._rms_norm(x, lp['mlp_norm'], c.norm_eps)
-    moe_out, aux = _moe_mlp(c, mesh, h, lp, token_mask=token_mask)
+    capacity = b * s if kv_cache is not None else None
+    moe_out, aux = _moe_mlp(c, mesh, h, lp, token_mask=token_mask,
+                            capacity=capacity)
     x = x + shard(moe_out, ('batch', 'activation_length',
                             'activation_embed'))
-    return x, aux
+    return x, aux, new_cache
 
 
 def forward(config: MoEConfig,
@@ -305,7 +331,9 @@ def forward(config: MoEConfig,
             x, mesh, ('batch', 'activation_length', 'activation_embed'))
 
     def layer_fn(x, lp):
-        return _layer(c, mesh, x, lp, positions, token_mask=token_mask)
+        x, aux, _ = _layer(c, mesh, x, lp, positions,
+                           token_mask=token_mask)
+        return x, aux
 
     if c.remat:
         layer_fn = jax.checkpoint(
@@ -345,3 +373,52 @@ def loss_fn(config: MoEConfig,
     else:
         ce = jnp.mean(nll)
     return ce + config.router_aux_coef * aux
+
+
+def prefill_hidden(config: MoEConfig, params: Params, tokens: jax.Array,
+                   true_len: jax.Array,
+                   mesh: Optional[mesh_lib.Mesh] = None):
+    """Prefill trunk → (last_hidden [B, D], per-layer KV) — the engine
+    contract shared with llama/qwen. Pad positions beyond true_len are
+    masked out of expert routing so they cannot contend for capacity."""
+    c = config
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    token_mask = (positions < true_len).astype(jnp.float32)
+    x = params['embed'][tokens].astype(c.dtype)
+
+    def layer_fn(x, lp):
+        x, _, kv = _layer(c, mesh, x, lp, positions,
+                          token_mask=token_mask, return_kv=True)
+        return x, {'k': kv[0], 'v': kv[1]}
+
+    x, kv = jax.lax.scan(layer_fn, x, params['layers'])
+    x = llama._rms_norm(x, params['final_norm'], c.norm_eps)
+    last = jax.lax.dynamic_index_in_dim(x, true_len - 1, axis=1,
+                                        keepdims=False)
+    return last, kv
+
+
+def decode_forward(config: MoEConfig, params: Params,
+                   last_tokens: jax.Array, positions: jax.Array,
+                   kv, mesh: Optional[mesh_lib.Mesh] = None):
+    """One decode step for a batch of slots (llama.decode_forward twin).
+
+    Expert capacity is the slot count, so routing never drops a token —
+    decode outputs are deterministic regardless of slot contention."""
+    c = config
+    x = params['embed'][last_tokens[:, None]].astype(c.dtype)
+    pos = positions[:, None]
+
+    def layer_fn(x, scanned):
+        lp, ck, cv = scanned
+        x, _, new_cache = _layer(c, mesh, x, lp, pos, kv_cache=(ck, cv),
+                                 cache_positions=positions)
+        return x, {'k': new_cache[0], 'v': new_cache[1]}
+
+    x, new_kv = jax.lax.scan(layer_fn, x, (params['layers'],
+                                           kv['k'], kv['v']))
+    x = llama._rms_norm(x, params['final_norm'], c.norm_eps)
+    logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'],
+                        preferred_element_type=jnp.float32)
+    return logits[:, 0], new_kv

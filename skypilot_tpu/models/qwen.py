@@ -292,3 +292,27 @@ def loss_fn(config: QwenConfig, params: Params, tokens: jax.Array,
     x, _ = _trunk(config, params, tokens, None, mesh)
     return llama._chunked_ce(x, params['lm_head'], targets, loss_mask,
                              config.ce_chunk)
+
+
+def pipelined_loss_fn(config: QwenConfig, params: Params,
+                      tokens: jax.Array, targets: jax.Array,
+                      mesh: mesh_lib.Mesh, n_microbatches: int,
+                      loss_mask: Optional[jax.Array] = None) -> jax.Array:
+    """loss_fn with the layer stack pipelined over the 'stage' axis
+    (same GPipe schedule as llama.pipelined_loss_fn; the pipeline region
+    is family-agnostic, only the layer body differs)."""
+    from skypilot_tpu.parallel import pipeline as pipeline_lib
+    c = config
+    x = llama._embed_lookup(params['embed'], tokens, mesh).astype(c.dtype)
+
+    def one_layer(x_mb: jax.Array, lp: Params) -> jax.Array:
+        b, s, _ = x_mb.shape
+        pos = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        y, _ = _layer(c, None, x_mb, lp, pos)
+        return y
+
+    x = pipeline_lib.pipeline_apply(one_layer, params['layers'], x, mesh,
+                                    n_microbatches, remat=c.remat)
+    x = llama._rms_norm(x, params['final_norm'], c.norm_eps)
+    return llama._chunked_ce(x, params['lm_head'], targets, loss_mask,
+                             config.ce_chunk)

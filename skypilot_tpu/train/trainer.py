@@ -62,10 +62,12 @@ class Trainer:
         self.optimizer = make_optimizer(config)
         self._model_lib = models.module_for(config.model)
         self._n_stages = int(self.mesh.shape.get('stage', 1))
-        if self._n_stages > 1 and self._model_lib is not llama:
+        if self._n_stages > 1 and not hasattr(self._model_lib,
+                                              'pipelined_loss_fn'):
             raise NotImplementedError(
-                'Pipeline parallelism is wired for the dense Llama stack '
-                'only (MoE layers are not pipelined yet).')
+                f'Pipeline parallelism needs a pipelined_loss_fn; '
+                f'{self._model_lib.__name__} does not provide one '
+                '(MoE expert layers are not pipelined yet).')
         self._rules = (mesh_lib.PIPELINE_RULES if self._n_stages > 1
                        else mesh_lib.DEFAULT_RULES)
         self._param_shardings = mesh_lib.tree_shardings(
@@ -133,7 +135,7 @@ class Trainer:
 
         def loss_of(params):
             if self._n_stages > 1:
-                return llama.pipelined_loss_fn(
+                return self._model_lib.pipelined_loss_fn(
                     c.model, params, batch['tokens'], batch['targets'],
                     mesh=self.mesh, n_microbatches=c.n_microbatches,
                     loss_mask=batch.get('mask'))
