@@ -53,8 +53,9 @@ def _candidate_configs(platform: str, hbm_gib: float):
     import jax
     n = jax.device_count()
     big_hbm = hbm_gib >= 24
-    ladder = ([(4, 'qkvo_up'), (8, 'qkvo'), (2, 'dots')] if big_hbm else
-              [(4, 'qkvo'), (2, 'qkvo_up'), (2, 'qkvo'), (1, 'dots')])
+    ladder = ([(4, 'qkvo_gup'), (4, 'qkvo_up'), (8, 'qkvo'), (2, 'dots')]
+              if big_hbm else
+              [(1, 'qkvo_gup'), (2, 'qkvo'), (4, 'qkvo'), (1, 'dots')])
     configs = []
     for per_chip_batch, policy in ladder:
         model = dataclasses.replace(llama.LLAMA3_1B, max_seq_len=8192,

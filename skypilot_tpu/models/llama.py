@@ -154,6 +154,12 @@ def _ckpt_name(x: jax.Array, name: str) -> jax.Array:
 _REMAT_SAVE_NAMES = {
     'qkvo': ('attn_q', 'attn_k', 'attn_v', 'attn_o'),
     'qkvo_up': ('attn_q', 'attn_k', 'attn_v', 'attn_o', 'mlp_up'),
+    # Save every big matmul output: the backward then recomputes only
+    # elementwise ops (norm/rope/silu) and the flash-attention forward
+    # (its custom_vjp re-runs for residuals regardless). Costs the most
+    # HBM per token — the batch-1 long-sequence sweet spot.
+    'qkvo_gup': ('attn_q', 'attn_k', 'attn_v', 'attn_o', 'mlp_gate',
+                 'mlp_up'),
 }
 
 

@@ -14,6 +14,7 @@ import time
 from skypilot_tpu import sky_logging
 from skypilot_tpu.serve import autoscalers as autoscalers_lib
 from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import load_balancing_policies as lb_policies
 from skypilot_tpu.serve import replica_managers
 from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.serve import state as serve_state
@@ -39,6 +40,8 @@ class SkyServeController:
             version=self.version)
         self.autoscaler = autoscalers_lib.make_autoscaler(self.spec)
         self.load_balancer = lb_lib.SkyServeLoadBalancer(
+            policy=lb_policies.make_policy(
+                self.spec.load_balancing_policy),
             on_request=lambda: self.autoscaler
             .collect_request_information(1, 0.0))
         self._stop = threading.Event()
@@ -75,6 +78,14 @@ class SkyServeController:
         new_autoscaler = autoscalers_lib.make_autoscaler(self.spec)
         new_autoscaler.inherit_state(self.autoscaler)
         self.autoscaler = new_autoscaler
+        # The update may change the LB policy too. Seed the new policy
+        # with the current fleet before swapping so no request hits an
+        # empty replica set between now and the next tick.
+        new_policy = lb_policies.make_policy(
+            self.spec.load_balancing_policy)
+        new_policy.set_ready_replicas(
+            self.replica_manager.ready_endpoints())
+        self.load_balancer.policy = new_policy
         self.replica_manager.apply_update(task_config, self.spec,
                                           self.version)
         logger.info(f'Service {self.service_name}: rolling update to '

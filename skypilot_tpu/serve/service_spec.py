@@ -15,13 +15,19 @@ class SkyServiceSpec:
                  upscale_delay_seconds: float = 300.0,
                  downscale_delay_seconds: float = 1200.0,
                  replica_port: Optional[int] = None,
-                 use_ondemand_fallback: bool = False) -> None:
+                 use_ondemand_fallback: bool = False,
+                 load_balancing_policy: str = 'round_robin') -> None:
         if max_replicas is not None and max_replicas < min_replicas:
             raise ValueError('max_replicas must be >= min_replicas')
         if target_qps_per_replica is not None and max_replicas is None:
             raise ValueError(
                 'autoscaling (target_qps_per_replica) requires '
                 'max_replicas')
+        if load_balancing_policy not in ('round_robin', 'least_load'):
+            raise ValueError(
+                f'Unknown load_balancing_policy '
+                f'{load_balancing_policy!r}; expected round_robin or '
+                'least_load.')
         self.readiness_path = readiness_path
         self.initial_delay_seconds = initial_delay_seconds
         self.min_replicas = min_replicas
@@ -31,6 +37,7 @@ class SkyServiceSpec:
         self.downscale_delay_seconds = downscale_delay_seconds
         self.replica_port = replica_port
         self.use_ondemand_fallback = use_ondemand_fallback
+        self.load_balancing_policy = load_balancing_policy
 
     @property
     def autoscaling_enabled(self) -> bool:
@@ -51,6 +58,7 @@ class SkyServiceSpec:
             replicas = config.pop('replicas', 1)
             policy = {'min_replicas': replicas, 'max_replicas': None}
         port = config.pop('port', None)
+        lb_policy = config.pop('load_balancing_policy', 'round_robin')
         unknown = set(config)
         if unknown:
             raise ValueError(f'Unknown service fields: {sorted(unknown)}')
@@ -69,6 +77,7 @@ class SkyServiceSpec:
             replica_port=int(port) if port is not None else None,
             use_ondemand_fallback=bool(
                 policy.get('use_ondemand_fallback', False)),
+            load_balancing_policy=lb_policy,
         )
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -93,4 +102,6 @@ class SkyServiceSpec:
             policy['use_ondemand_fallback'] = True
         if self.replica_port is not None:
             config['port'] = self.replica_port
+        if self.load_balancing_policy != 'round_robin':
+            config['load_balancing_policy'] = self.load_balancing_policy
         return config

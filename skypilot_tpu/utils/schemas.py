@@ -85,6 +85,8 @@ _SERVICE_SCHEMA: Dict[str, Any] = {
         'replica_policy': _REPLICA_POLICY_SCHEMA,
         'replicas': _INT,
         'port': _INT,
+        'load_balancing_policy': {
+            'enum': ['round_robin', 'least_load']},
     },
 }
 

@@ -365,3 +365,40 @@ class TestAutoscalerBursts:
         # Same load, fresh object: target stays at 5 (and the window
         # carried over so QPS doesn't read as zero).
         assert new.evaluate(5).target_num_replicas == 5
+
+
+class TestLoadBalancingPolicySpec:
+
+    def test_spec_roundtrip_and_validation(self):
+        spec = spec_lib.SkyServiceSpec.from_yaml_config(
+            {'load_balancing_policy': 'least_load'})
+        assert spec.load_balancing_policy == 'least_load'
+        assert spec.to_yaml_config()[
+            'load_balancing_policy'] == 'least_load'
+        # Default round_robin is implied, not serialized.
+        spec2 = spec_lib.SkyServiceSpec.from_yaml_config({})
+        assert spec2.load_balancing_policy == 'round_robin'
+        assert 'load_balancing_policy' not in spec2.to_yaml_config()
+        with pytest.raises(ValueError, match='load_balancing_policy'):
+            spec_lib.SkyServiceSpec(load_balancing_policy='random')
+
+    def test_schema_rejects_unknown_policy(self):
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.utils import schemas
+        with pytest.raises(exceptions.InvalidSkyTpuConfigError):
+            schemas.validate_task_config({
+                'name': 's', 'run': 'x',
+                'service': {'load_balancing_policy': 'weighted'}})
+
+    def test_controller_builds_least_load_lb(self, serve_env,
+                                             monkeypatch):
+        from skypilot_tpu.serve import controller as controller_lib
+        from skypilot_tpu.serve import load_balancing_policies as lb_pol
+        from skypilot_tpu.serve import state as serve_state
+        task = _service_task()
+        config = task.to_yaml_config()
+        config['service']['load_balancing_policy'] = 'least_load'
+        serve_state.add_service('lbsvc', config, lb_port=0)
+        ctrl = controller_lib.SkyServeController('lbsvc')
+        assert isinstance(ctrl.load_balancer.policy,
+                          lb_pol.LeastLoadPolicy)
