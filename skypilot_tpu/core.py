@@ -55,6 +55,13 @@ def refresh_cluster_status(cluster_name: str) -> Optional[Dict[str, Any]]:
         # Cloud says gone: preempted or externally deleted.
         state.remove_cluster(cluster_name, terminate=True)
         return None
+    # Providers report unrecoverably-dead instances (spot-preempted TPU
+    # corpses, terminated EC2) as None: all-dead means the cluster can
+    # never run again — same as gone, so recovery relaunches instead of
+    # waiting on INIT forever.
+    if all(s is None for s in statuses.values()):
+        state.remove_cluster(cluster_name, terminate=True)
+        return None
     if all(s == 'STOPPED' for s in statuses.values()):
         state.update_cluster_status(cluster_name,
                                     state.ClusterStatus.STOPPED)

@@ -44,7 +44,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     # What the per-layer jax.checkpoint keeps for the backward pass:
-    #   'dots'         — every no-batch-dim matmul output (fast, most HBM)
+    #   'qkvo_gup'     — q/k/v/o + mlp gate+up: backward recomputes only
+    #                    elementwise ops + the flash-attn forward
+    #                    (fastest; most HBM — the batch-1 long-seq pick)
+    #   'dots'         — every no-batch-dim matmul output
     #   'qkvo_up'      — q/k/v/o projections + mlp up (recompute gate)
     #   'qkvo'         — q/k/v/o projections only (recompute gate+up)
     #   'none'         — full per-layer rematerialization (least HBM)

@@ -46,7 +46,7 @@ def _clients(provider_config: Dict[str, Any], zone: str):
             compute_api.ComputeClient(project, zone, t))
 
 
-def _normalize(state: str) -> str:
+def _normalize(state: str) -> Optional[str]:
     if state in tpu_api.PENDING_STATES or state in \
             compute_api.PENDING_STATES:
         return 'PENDING'
@@ -58,6 +58,12 @@ def _normalize(state: str) -> str:
     if state in tpu_api.STOPPING_STATES or state in \
             compute_api.STOPPING_STATES:
         return 'STOPPING'
+    if state in tpu_api.DEAD_STATES:
+        # Dead-but-listed (spot-preempted corpse): report as gone, the
+        # cross-provider convention (AWS maps 'terminated' to None), so
+        # the cloud-generic reconciliation needs no per-cloud state
+        # strings.
+        return None
     return state
 
 
@@ -97,6 +103,42 @@ def _run_tpu(zone: str, cluster_name: str, config: common.ProvisionConfig):
     by_id = {n['name'].split('/')[-1]: n for n in existing}
     created: List[str] = []
     resumed: List[str] = []
+
+    # A spot-preempted (or externally terminated) TPU node lingers in
+    # the listing but can never run again — delete it so the relaunch
+    # below recreates capacity instead of counting a corpse as a live
+    # node (reference: spot-preemption cleanup, sky/clouds/gcp.py:1069).
+    # QR-managed nodes cannot be deleted directly; their stale queued
+    # resource is deleted instead (which reaps its nodes), otherwise
+    # _create_via_queued_resources would find the SUSPENDED QR, skip
+    # creation, and fail recovery.
+    if use_qr:
+        for qr in tpu.list_queued_resources(cluster_name):
+            qr_id = qr.get('name', '').split('/')[-1]
+            if qr.get('state', {}).get('state') in \
+                    tpu_api.QR_TERMINAL_BAD:
+                try:
+                    tpu.wait_operation(
+                        tpu.delete_queued_resource(qr_id, force=True))
+                except rest.GcpApiError as e:
+                    logger.warning(f'Deleting stale QR {qr_id}: {e}')
+                    continue
+                # Its nodes die with it.
+                by_id = {nid: n for nid, n in by_id.items()
+                         if n.get('state') not in tpu_api.DEAD_STATES}
+    else:
+        dead = [node_id for node_id, n in by_id.items()
+                if n.get('state') in tpu_api.DEAD_STATES]
+        for node_id in dead:
+            try:
+                tpu.wait_operation(tpu.delete_node(node_id))
+            except rest.GcpApiError as e:
+                # Leave it in by_id: recreating over a still-existing
+                # name would only produce a misleading ALREADY_EXISTS.
+                logger.warning(
+                    f'Deleting preempted node {node_id}: {e}')
+                continue
+            by_id.pop(node_id, None)
 
     # Resume any stopped single-host nodes (multi-host cannot stop;
     # reference: sky/clouds/gcp.py:216-226).

@@ -24,6 +24,9 @@ PENDING_STATES = ('CREATING', 'STARTING', 'RESTARTING', 'REPAIRING')
 RUNNING_STATE = 'READY'
 STOPPING_STATES = ('STOPPING',)
 STOPPED_STATES = ('STOPPED', 'SUSPENDED')
+# States a node can never leave: spot preemption / external kill. The
+# node object lingers in the API until deleted.
+DEAD_STATES = ('PREEMPTED', 'TERMINATED', 'DELETING')
 
 # Queued-resource lifecycle states.
 QR_PENDING = ('CREATING', 'ACCEPTED', 'PROVISIONING', 'WAITING_FOR_RESOURCES')

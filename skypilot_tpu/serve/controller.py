@@ -78,14 +78,17 @@ class SkyServeController:
         new_autoscaler = autoscalers_lib.make_autoscaler(self.spec)
         new_autoscaler.inherit_state(self.autoscaler)
         self.autoscaler = new_autoscaler
-        # The update may change the LB policy too. Seed the new policy
-        # with the current fleet before swapping so no request hits an
-        # empty replica set between now and the next tick.
-        new_policy = lb_policies.make_policy(
-            self.spec.load_balancing_policy)
-        new_policy.set_ready_replicas(
-            self.replica_manager.ready_endpoints())
-        self.load_balancer.policy = new_policy
+        # The update may change the LB policy. Swap only on an actual
+        # change — rebuilding needlessly would zero LeastLoad's
+        # in-flight counters mid-traffic. Seed the new policy with the
+        # current fleet so no request hits an empty replica set between
+        # now and the next tick.
+        wanted = lb_policies.POLICIES[self.spec.load_balancing_policy]
+        if type(self.load_balancer.policy) is not wanted:
+            new_policy = wanted()
+            new_policy.set_ready_replicas(
+                self.replica_manager.ready_endpoints())
+            self.load_balancer.policy = new_policy
         self.replica_manager.apply_update(task_config, self.spec,
                                           self.version)
         logger.info(f'Service {self.service_name}: rolling update to '

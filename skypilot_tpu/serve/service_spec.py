@@ -23,11 +23,12 @@ class SkyServiceSpec:
             raise ValueError(
                 'autoscaling (target_qps_per_replica) requires '
                 'max_replicas')
-        if load_balancing_policy not in ('round_robin', 'least_load'):
+        from skypilot_tpu.serve import load_balancing_policies as lb_pol
+        if load_balancing_policy not in lb_pol.POLICIES:
             raise ValueError(
                 f'Unknown load_balancing_policy '
-                f'{load_balancing_policy!r}; expected round_robin or '
-                'least_load.')
+                f'{load_balancing_policy!r}; expected one of '
+                f'{sorted(lb_pol.POLICIES)}.')
         self.readiness_path = readiness_path
         self.initial_delay_seconds = initial_delay_seconds
         self.min_replicas = min_replicas

@@ -85,6 +85,9 @@ _SERVICE_SCHEMA: Dict[str, Any] = {
         'replica_policy': _REPLICA_POLICY_SCHEMA,
         'replicas': _INT,
         'port': _INT,
+        # Keep in lockstep with serve/load_balancing_policies.POLICIES
+        # (not imported here: schemas must stay dependency-free of the
+        # serve package; test_serve pins the two lists together).
         'load_balancing_policy': {
             'enum': ['round_robin', 'least_load']},
     },

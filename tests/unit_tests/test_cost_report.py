@@ -76,3 +76,23 @@ class TestUsageIntervals:
         rows = {r['name']: r for r in core.cost_report()}
         assert rows[fake_cluster]['uptime_hours'] == pytest.approx(
             before, abs=0.01)
+
+
+class TestDeadStateReconciliation:
+
+    def test_all_preempted_marks_terminated(self, fake_cluster,
+                                            monkeypatch):
+        """PREEMPTED-but-listed nodes (spot TPU corpses) reconcile to
+        terminated, so jobs recovery relaunches instead of waiting on
+        INIT forever."""
+        from skypilot_tpu import core
+        from skypilot_tpu import provision as provision_lib
+        monkeypatch.setattr(
+            provision_lib, 'query_instances',
+            lambda *a, **k: {'n0': None, 'n1': None})
+        record = core.refresh_cluster_status(fake_cluster)
+        assert record is None
+        assert state.get_cluster_from_name(fake_cluster) is None
+        # The billing record survived into history.
+        assert [h['name'] for h in state.get_cluster_history()] == \
+            [fake_cluster]

@@ -71,7 +71,17 @@ class FakeGcp:
                     self._materialize_qr(m.group(1), qr)
             return qr
         if m and method == 'DELETE':
-            self.queued.pop(m.group(1), None)
+            qr = self.queued.pop(m.group(1), None)
+            if qr is not None:
+                # Real API force-delete reaps the QR's nodes too.
+                cluster = (qr.get('tpu', {}).get('nodeSpec', [{}])[0]
+                           .get('node', {}).get('labels', {})
+                           .get('xsky-cluster'))
+                if cluster:
+                    self.tpu_nodes = {
+                        nid: n for nid, n in self.tpu_nodes.items()
+                        if n.get('labels', {}).get('xsky-cluster') !=
+                        cluster}
             return {'name': 'operations/qr-del', 'done': True}
         if path.endswith('/queuedResources') and method == 'GET':
             return {'queuedResources': list(self.queued.values())}
@@ -275,3 +285,46 @@ def test_tpu_terminate_idempotent(fake_gcp):
     gcp_instance.terminate_instances('gone', PROVIDER)  # no raise
     with pytest.raises(exceptions.ClusterDoesNotExist):
         gcp_instance.get_cluster_info('us-central2', 'gone', PROVIDER)
+
+
+def test_preempted_node_deleted_and_recreated(fake_gcp):
+    """Spot preemption: the dead node lingers in the TPU API; a
+    relaunch must delete it and create fresh capacity instead of
+    counting the corpse as a live node."""
+    cfg = _tpu_config()
+    gcp_instance.run_instances('us-c1', 'us-c1-a', 'tpu1', cfg)
+    node_id = next(iter(fake_gcp.tpu_nodes))
+    fake_gcp.tpu_nodes[node_id]['state'] = 'PREEMPTED'
+    record = gcp_instance.run_instances('us-c1', 'us-c1-a', 'tpu1', cfg)
+    assert record.created_instance_ids == [node_id]
+    assert fake_gcp.tpu_nodes[node_id]['state'] == 'READY'
+
+
+def test_query_reports_preempted_state(fake_gcp):
+    gcp_instance.run_instances('us-c1', 'us-c1-a', 'tpu2', _tpu_config())
+    node_id = next(iter(fake_gcp.tpu_nodes))
+    fake_gcp.tpu_nodes[node_id]['state'] = 'PREEMPTED'
+    statuses = gcp_instance.query_instances('tpu2', PROVIDER)
+    # Dead-but-listed normalizes to None (cross-provider 'gone').
+    assert statuses and all(s is None for s in statuses.values())
+
+
+def test_stale_suspended_qr_deleted_and_recreated(fake_gcp):
+    """Spot preemption on the queued-resources path: the SUSPENDED QR
+    (and its node corpses) must be deleted so the relaunch creates a
+    fresh QR instead of polling the dead one into CapacityError."""
+    fake_gcp.qr_states = ['ACCEPTED', 'ACTIVE']
+    cfg = _tpu_config(use_qr=True)
+    gcp_instance.run_instances('us-central2', 'us-central2-b', 'sq', cfg)
+    assert len(fake_gcp.queued) == 1
+    qr = next(iter(fake_gcp.queued.values()))
+    qr['state'] = {'state': 'SUSPENDED'}
+    for node in fake_gcp.tpu_nodes.values():
+        node['state'] = 'PREEMPTED'
+    fake_gcp.qr_states = ['ACCEPTED', 'ACTIVE']
+    record = gcp_instance.run_instances('us-central2', 'us-central2-b',
+                                        'sq', cfg)
+    assert record.created_instance_ids  # fresh capacity
+    assert len(fake_gcp.queued) == 1    # new QR replaced the stale one
+    states = {n['state'] for n in fake_gcp.tpu_nodes.values()}
+    assert states == {'READY'}

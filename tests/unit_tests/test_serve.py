@@ -402,3 +402,13 @@ class TestLoadBalancingPolicySpec:
         ctrl = controller_lib.SkyServeController('lbsvc')
         assert isinstance(ctrl.load_balancer.policy,
                           lb_pol.LeastLoadPolicy)
+
+
+def test_schema_policy_enum_matches_registry():
+    """schemas.py cannot import the serve package, so its enum is a
+    pinned copy of POLICIES — this test is the lockstep guard."""
+    from skypilot_tpu.serve import load_balancing_policies as lb_pol
+    from skypilot_tpu.utils import schemas
+    enum = schemas._SERVICE_SCHEMA['properties'][
+        'load_balancing_policy']['enum']
+    assert sorted(enum) == sorted(lb_pol.POLICIES)
