@@ -435,6 +435,76 @@ def api_start(host, port, foreground):
         click.echo(f'API server starting at http://{host}:{port}')
 
 
+def _api_remote():
+    """RemoteClient when XSKY_API_SERVER points at a server, else None
+    — the api verbs must inspect THAT server's request DB, not the
+    local file (same transport split as every other verb)."""
+    from skypilot_tpu.client import sdk as sdk_lib
+    endpoint = sdk_lib.api_server_endpoint()
+    if endpoint is None:
+        return None
+    from skypilot_tpu.client import remote_client
+    return remote_client.RemoteClient(endpoint)
+
+
+@api.command(name='status')
+@click.option('--limit', type=int, default=30)
+def api_status(limit):
+    """List recent API requests (twin of `sky api status`)."""
+    remote = _api_remote()
+    if remote is not None:
+        rows = remote.list_api_requests(limit=limit)
+    else:
+        from skypilot_tpu.server import requests_db
+        rows = requests_db.list_requests(limit=limit)
+    fmt = '{:<34} {:<14} {:<11} {:<10}'
+    click.echo(fmt.format('ID', 'VERB', 'STATUS', 'USER'))
+    for r in rows:
+        status = r['status']
+        click.echo(fmt.format(r['request_id'], r['name'],
+                              getattr(status, 'value', status),
+                              r.get('user') or '-'))
+
+
+@api.command(name='logs')
+@click.argument('request_id')
+def api_logs(request_id):
+    """Show one request's outcome (result or error)."""
+    import json as json_lib
+    remote = _api_remote()
+    if remote is not None:
+        record = remote.get_api_request(request_id)
+    else:
+        from skypilot_tpu.server import requests_db
+        record = requests_db.get(request_id)
+    if record is None:
+        raise click.ClickException(f'Unknown request {request_id}.')
+    status = record['status']
+    click.echo(f"status: {getattr(status, 'value', status)}")
+    if record.get('error'):
+        click.echo(f"error: {record['error']}")
+    elif record.get('result') is not None:
+        click.echo(json_lib.dumps(record['result'], indent=2,
+                                  default=str))
+
+
+@api.command(name='cancel')
+@click.argument('request_id')
+def api_cancel(request_id):
+    """Cancel a queued/running request."""
+    remote = _api_remote()
+    if remote is not None:
+        ok = remote.cancel_api_request(request_id)
+    else:
+        from skypilot_tpu.server import requests_db
+        ok = requests_db.mark_cancelled(request_id)
+    if ok:
+        click.echo(f'Request {request_id} cancelled.')
+    else:
+        raise click.ClickException(
+            f'Request {request_id} not found or already terminal.')
+
+
 @cli.group()
 def storage():
     """Object-storage management (twin of `sky storage`)."""

@@ -56,6 +56,28 @@ class RemoteClient:
     def _call(self, verb: str, body: Dict[str, Any]) -> Any:
         return self._get(self._submit(verb, body))
 
+    # ---- request management (xsky api status/logs/cancel) ----
+
+    def list_api_requests(self, limit: int = 30):
+        resp = self._client.get('/api/requests')
+        resp.raise_for_status()
+        return resp.json().get('requests', [])[:limit]
+
+    def get_api_request(self, request_id: str):
+        """Raw request record (no polling; terminal or not)."""
+        resp = self._client.get('/api/get',
+                                params={'request_id': request_id})
+        if resp.status_code == 404:
+            return None
+        resp.raise_for_status()
+        return resp.json()
+
+    def cancel_api_request(self, request_id: str) -> bool:
+        resp = self._client.post('/api/requests/cancel',
+                                 json={'request_id': request_id})
+        resp.raise_for_status()
+        return bool(resp.json().get('cancelled'))
+
     # ---- verbs ----
 
     def launch(self, task, **kwargs) -> Any:

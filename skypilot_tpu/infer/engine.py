@@ -51,16 +51,17 @@ class InferenceEngine:
                  mesh: Optional[mesh_lib.Mesh] = None) -> None:
         from skypilot_tpu import models
         self._model_lib = models.module_for(config.model)
-        # Any family exposing the prefill_hidden/decode_forward pair
-        # (llama, qwen, moe) plugs into the slot engine; families
-        # without a decode path (gemma tied-softcapped head) are
-        # rejected up front rather than failing mid-serve.
-        if not (hasattr(self._model_lib, 'prefill_hidden') and
-                hasattr(self._model_lib, 'decode_forward')):
+        # Any family exposing prefill_hidden/decode_forward/lm_logits
+        # plugs into the slot engine — all four in-tree families
+        # (llama, qwen, gemma incl. its tied soft-capped head, moe) do.
+        # A future family missing the trio is rejected up front rather
+        # than failing mid-serve.
+        needed = ('prefill_hidden', 'decode_forward', 'lm_logits')
+        if not all(hasattr(self._model_lib, fn) for fn in needed):
             raise NotImplementedError(
-                f'Serving needs a prefill_hidden/decode_forward pair; '
+                f'Serving needs {", ".join(needed)}; '
                 f'{type(config.model).__name__} '
-                f'({self._model_lib.__name__}) does not provide one.')
+                f'({self._model_lib.__name__}) does not provide them.')
         self.config = config
         self.params = params
         self.mesh = mesh
@@ -118,8 +119,7 @@ class InferenceEngine:
         c = self.config.model
         last_hidden, kv = self._model_lib.prefill_hidden(
             c, params, tokens, true_len, mesh=self.mesh)
-        logits = jnp.einsum('bd,dv->bv', last_hidden, params['lm_head'],
-                            preferred_element_type=jnp.float32)
+        logits = self._model_lib.lm_logits(c, params, last_hidden)
         first_token = sampling.sample_batched(logits, key, temperature,
                                               top_k, top_p)[0]
         return first_token, kv

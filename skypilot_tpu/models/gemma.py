@@ -13,10 +13,10 @@ Architecturally distinct from Llama where Gemma actually differs:
   * optional logit soft-capping (Gemma-2).
 
 Same functional surface as the other families (CONFIGS, logical_axes,
-init, forward, loss_fn) and the same sharding rules, so the *trainer*
-dispatches to it for free; the slot inference engine is still
-Llama-only (a tied-head prefill/decode path is a follow-up and the
-engine rejects gemma configs explicitly).
+init, forward, loss_fn, prefill_hidden, decode_forward, lm_logits) and
+the same sharding rules, so the trainer AND the slot inference engine
+dispatch to it for free — the tied soft-capped head rides the engine's
+model-owned lm_logits hook.
 """
 from __future__ import annotations
 
@@ -141,10 +141,16 @@ def _rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
 
 
 def _layer(config: GemmaConfig, mesh: Optional[mesh_lib.Mesh],
-           x: jax.Array, lp: Params, positions: jax.Array) -> jax.Array:
+           x: jax.Array, lp: Params, positions: jax.Array,
+           kv_cache=None, cache_positions: Optional[jax.Array] = None,
+           return_kv: bool = False):
+    """One block. Returns x (training) or (x, new_kv) when the caller
+    asked for cache handling (prefill/decode; same slot contract as
+    llama._layer)."""
     c = config
     hd = c.head_dim
     b, s, _ = x.shape
+    wants_kv = return_kv or kv_cache is not None
 
     def shard(arr, axes):
         if mesh is None:
@@ -159,8 +165,22 @@ def _layer(config: GemmaConfig, mesh: Optional[mesh_lib.Mesh],
     # Gemma rope/theta; reuse the llama rotary helper.
     q = llama._rope(q, positions, c.rope_theta)
     k = llama._rope(k, positions, c.rope_theta)
-    attn = attention_ops.dot_product_attention(
-        q, k, v, causal=True, implementation=c.attention_impl)
+    new_cache = None
+    if kv_cache is not None:
+        ck, cv = kv_cache
+        slots = jnp.arange(b)
+        ck = ck.at[slots, cache_positions].set(k[:, 0])
+        cv = cv.at[slots, cache_positions].set(v[:, 0])
+        new_cache = (ck, cv)
+        kv_pos = jnp.arange(ck.shape[1])[None, :]
+        valid = kv_pos <= cache_positions[:, None]
+        attn = attention_ops.xla_attention_with_mask(
+            q, ck, cv, valid[:, None, None, :])
+    else:
+        if return_kv:
+            new_cache = (k, v)
+        attn = attention_ops.dot_product_attention(
+            q, k, v, causal=True, implementation=c.attention_impl)
     attn = attn.reshape(b, s, c.n_heads * hd)
     x = x + shard(attn @ lp['wo'],
                   ('batch', 'activation_length', 'activation_embed'))
@@ -173,13 +193,17 @@ def _layer(config: GemmaConfig, mesh: Optional[mesh_lib.Mesh],
                ('batch', 'activation_length', 'activation_mlp'))
     x = x + shard(ff @ lp['w_down'],
                   ('batch', 'activation_length', 'activation_embed'))
+    if wants_kv:
+        return x, new_cache
     return x
 
 
-def forward(config: GemmaConfig, params: Params, tokens: jax.Array,
-            mesh: Optional[mesh_lib.Mesh] = None,
-            positions: Optional[jax.Array] = None) -> jax.Array:
-    """Training forward → fp32 logits (tied-embedding head)."""
+def _trunk(config: GemmaConfig, params: Params, tokens: jax.Array,
+           positions: Optional[jax.Array], mesh: Optional[mesh_lib.Mesh],
+           return_kv: bool = False):
+    """Scaled embed → scanned layers → final norm. Shared by
+    forward (training) and prefill_hidden (serving) so both get the
+    same activation sharding. Returns (x [B,S,D], kv-or-None)."""
     c = config
     if positions is None:
         positions = jnp.broadcast_to(
@@ -191,19 +215,24 @@ def forward(config: GemmaConfig, params: Params, tokens: jax.Array,
             x, mesh, ('batch', 'activation_length', 'activation_embed'))
 
     def layer_fn(x, lp):
+        if return_kv:
+            x, kv = _layer(c, mesh, x, lp, positions, return_kv=True)
+            return x, {'k': kv[0], 'v': kv[1]}
         return _layer(c, mesh, x, lp, positions), None
 
-    if c.remat:
+    if c.remat and not return_kv:
         layer_fn = jax.checkpoint(layer_fn,
                                   policy=llama._remat_policy(c))
-    x, _ = jax.lax.scan(layer_fn, x, params['layers'])
-    x = _rms_norm(x, params['final_norm'], c.norm_eps)
-    logits = jnp.einsum('bsd,vd->bsv', x, params['embed'],
-                        preferred_element_type=jnp.float32)
-    if c.final_logit_softcap:
-        cap = c.final_logit_softcap
-        logits = cap * jnp.tanh(logits / cap)
-    return logits
+    x, kv = jax.lax.scan(layer_fn, x, params['layers'])
+    return _rms_norm(x, params['final_norm'], c.norm_eps), kv
+
+
+def forward(config: GemmaConfig, params: Params, tokens: jax.Array,
+            mesh: Optional[mesh_lib.Mesh] = None,
+            positions: Optional[jax.Array] = None) -> jax.Array:
+    """Training forward → fp32 logits (tied-embedding head)."""
+    x, _ = _trunk(config, params, tokens, positions, mesh)
+    return lm_logits(config, params, x)
 
 
 def loss_fn(config: GemmaConfig, params: Params, tokens: jax.Array,
@@ -252,9 +281,49 @@ def pipelined_loss_fn(config: GemmaConfig, params: Params,
     x = pipeline_lib.pipeline_apply(one_layer, params['layers'], x, mesh,
                                     n_microbatches, remat=c.remat)
     x = _rms_norm(x, params['final_norm'], c.norm_eps)
-    logits = jnp.einsum('bsd,vd->bsv', x, params['embed'],
+    return _nll_mean(c, lm_logits(c, params, x), targets, loss_mask)
+
+
+def lm_logits(config: GemmaConfig, params: Params,
+              hidden: jax.Array) -> jax.Array:
+    """Tied-embedding head with optional soft-cap; hidden [..., D]."""
+    c = config
+    logits = jnp.einsum('...d,vd->...v', hidden, params['embed'],
                         preferred_element_type=jnp.float32)
     if c.final_logit_softcap:
         cap = c.final_logit_softcap
         logits = cap * jnp.tanh(logits / cap)
-    return _nll_mean(c, logits, targets, loss_mask)
+    return logits
+
+
+def prefill_hidden(config: GemmaConfig, params: Params,
+                   tokens: jax.Array, true_len: jax.Array,
+                   mesh: Optional[mesh_lib.Mesh] = None):
+    """Prefill trunk → (last_hidden [B, D], per-layer KV) — the engine
+    contract shared with llama/qwen/moe."""
+    x, kv = _trunk(config, params, tokens, None, mesh, return_kv=True)
+    last = jax.lax.dynamic_index_in_dim(x, true_len - 1, axis=1,
+                                        keepdims=False)
+    return last, kv
+
+
+def decode_forward(config: GemmaConfig, params: Params,
+                   last_tokens: jax.Array, positions: jax.Array,
+                   kv, mesh: Optional[mesh_lib.Mesh] = None):
+    """One decode step for a batch of slots (llama.decode_forward twin,
+    with the tied soft-capped head)."""
+    c = config
+    x = params['embed'][last_tokens[:, None]].astype(c.dtype)
+    x = x * jnp.asarray(c.d_model ** 0.5, c.dtype)
+    pos = positions[:, None]
+
+    def layer_fn(x, scanned):
+        lp, ck, cv = scanned
+        x, new_cache = _layer(c, mesh, x, lp, pos, kv_cache=(ck, cv),
+                              cache_positions=positions)
+        return x, {'k': new_cache[0], 'v': new_cache[1]}
+
+    x, new_kv = jax.lax.scan(layer_fn, x, (params['layers'],
+                                           kv['k'], kv['v']))
+    x = _rms_norm(x, params['final_norm'], c.norm_eps)
+    return lm_logits(c, params, x)[:, 0], new_kv

@@ -409,6 +409,14 @@ def forward(config: LlamaConfig,
     return (logits, kv) if return_kv else logits
 
 
+def lm_logits(config: LlamaConfig, params: Params,
+              hidden: jax.Array) -> jax.Array:
+    """Untied LM head; hidden [..., D] -> fp32 logits [..., V]."""
+    del config
+    return jnp.einsum('...d,dv->...v', hidden, params['lm_head'],
+                      preferred_element_type=jnp.float32)
+
+
 def prefill_hidden(config: LlamaConfig,
                    params: Params,
                    tokens: jax.Array,

@@ -422,3 +422,8 @@ def decode_forward(config: MoEConfig, params: Params,
     logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'],
                         preferred_element_type=jnp.float32)
     return logits[:, 0], new_kv
+
+
+def lm_logits(config, params: Params, hidden: jax.Array) -> jax.Array:
+    """Untied LM head (same structure as llama's)."""
+    return llama.lm_logits(None, params, hidden)

@@ -316,3 +316,8 @@ def pipelined_loss_fn(config: QwenConfig, params: Params,
     x = llama._rms_norm(x, params['final_norm'], c.norm_eps)
     return llama._chunked_ce(x, params['lm_head'], targets, loss_mask,
                              config.ce_chunk)
+
+
+def lm_logits(config, params: Params, hidden: jax.Array) -> jax.Array:
+    """Untied LM head (same structure as llama's)."""
+    return llama.lm_logits(None, params, hidden)

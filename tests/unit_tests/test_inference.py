@@ -225,14 +225,29 @@ def test_qwen_cached_decode_matches_full_forward(variant):
     assert outputs[0] == expected
 
 
-def test_gemma_still_rejected_with_clear_error():
+def test_gemma_cached_decode_matches_full_forward():
+    """Gemma serving: tied soft-capped head through the engine's
+    model-owned lm_logits hook; slot-cache decode equals full
+    re-forward greedy."""
     from skypilot_tpu.models import gemma
+    c = gemma.GEMMA_TINY
+    params = gemma.init(c, jax.random.PRNGKey(0))
     config = engine_lib.EngineConfig(
-        model=gemma.GEMMA_TINY, max_slots=2, max_target_len=32,
-        prefill_buckets=(16,))
-    params = gemma.init(gemma.GEMMA_TINY, jax.random.PRNGKey(0))
-    with pytest.raises(NotImplementedError, match='prefill_hidden'):
-        engine_lib.InferenceEngine(config, params)
+        model=c, max_slots=2, max_target_len=32, prefill_buckets=(16,))
+    engine = engine_lib.InferenceEngine(config, params)
+
+    prompt = [5, 17, 3, 99, 42]
+    n_new = 6
+    tokens = list(prompt)
+    for _ in range(n_new):
+        logits = gemma.forward(c, params,
+                               jnp.asarray([tokens], jnp.int32))
+        tokens.append(int(jnp.argmax(logits[0, -1])))
+    expected = tokens[len(prompt):]
+
+    orch = orch_lib.Orchestrator(engine)
+    outputs = orch.generate([prompt], max_new_tokens=n_new)
+    assert outputs[0] == expected
 
 
 def test_moe_cached_decode_matches_full_forward():
@@ -262,3 +277,16 @@ def test_moe_cached_decode_matches_full_forward():
     orch = orch_lib.Orchestrator(engine)
     outputs = orch.generate([prompt], max_new_tokens=n_new)
     assert outputs[0] == expected
+
+
+def test_engine_rejects_family_missing_serving_hooks(monkeypatch):
+    """The missing-hook guard still has teeth now that every in-tree
+    family serves: a family without the trio is rejected up front."""
+    import types
+
+    from skypilot_tpu import models
+    stub = types.ModuleType('stub_family')   # no serving hooks at all
+    monkeypatch.setattr(models, 'module_for', lambda cfg: stub)
+    config = engine_lib.EngineConfig(model=llama.LLAMA_TINY)
+    with pytest.raises(NotImplementedError, match='prefill_hidden'):
+        engine_lib.InferenceEngine(config, params={})
