@@ -440,11 +440,7 @@ def _api_remote():
     — the api verbs must inspect THAT server's request DB, not the
     local file (same transport split as every other verb)."""
     from skypilot_tpu.client import sdk as sdk_lib
-    endpoint = sdk_lib.api_server_endpoint()
-    if endpoint is None:
-        return None
-    from skypilot_tpu.client import remote_client
-    return remote_client.RemoteClient(endpoint)
+    return sdk_lib._remote()
 
 
 @api.command(name='status')

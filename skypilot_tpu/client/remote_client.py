@@ -59,7 +59,8 @@ class RemoteClient:
     # ---- request management (xsky api status/logs/cancel) ----
 
     def list_api_requests(self, limit: int = 30):
-        resp = self._client.get('/api/requests')
+        resp = self._client.get('/api/requests',
+                                params={'limit': limit})
         resp.raise_for_status()
         return resp.json().get('requests', [])[:limit]
 

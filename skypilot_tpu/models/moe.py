@@ -419,11 +419,45 @@ def decode_forward(config: MoEConfig, params: Params,
     x, new_kv = jax.lax.scan(layer_fn, x, (params['layers'],
                                            kv['k'], kv['v']))
     x = llama._rms_norm(x, params['final_norm'], c.norm_eps)
-    logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'],
-                        preferred_element_type=jnp.float32)
-    return logits[:, 0], new_kv
+    return lm_logits(c, params, x)[:, 0], new_kv
 
 
 def lm_logits(config, params: Params, hidden: jax.Array) -> jax.Array:
     """Untied LM head (same structure as llama's)."""
     return llama.lm_logits(None, params, hidden)
+
+
+def pipelined_loss_fn(config: MoEConfig, params: Params,
+                      tokens: jax.Array, targets: jax.Array,
+                      mesh: mesh_lib.Mesh, n_microbatches: int,
+                      loss_mask: Optional[jax.Array] = None,
+                      token_mask: Optional[jax.Array] = None) -> jax.Array:
+    """loss_fn with the layer stack pipelined over the 'stage' axis.
+
+    Routing statistics (capacity, load-balance aux) are computed per
+    microbatch — the GPipe semantics — so the aux term matches the
+    dense loss only in expectation; the CE term matches exactly in the
+    no-drop regime. Padding-aware routing (token_mask) is not threaded
+    through the pipeline state; mask pads at the batch level instead.
+    """
+    if token_mask is not None:
+        from skypilot_tpu import exceptions
+        raise exceptions.NotSupportedError(
+            'token_mask is not supported under pipeline parallelism.')
+    from skypilot_tpu.parallel import pipeline as pipeline_lib
+    c = config
+    x = llama._embed_lookup(params['embed'], tokens, mesh).astype(c.dtype)
+
+    def one_layer(x_mb, lp):
+        b, s, _ = x_mb.shape
+        pos = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        y, aux, _ = _layer(c, None, x_mb, lp, pos)
+        return y, aux
+
+    x, aux_mean = pipeline_lib.pipeline_apply(
+        one_layer, params['layers'], x, mesh, n_microbatches,
+        remat=c.remat, with_aux=True)
+    x = llama._rms_norm(x, params['final_norm'], c.norm_eps)
+    ce = llama._chunked_ce(x, params['lm_head'], targets, loss_mask,
+                           chunk=llama.LOSS_CHUNK)
+    return ce + c.router_aux_coef * aux_mean

@@ -271,9 +271,7 @@ def decode_forward(config: QwenConfig, params: Params,
     x, new_kv = jax.lax.scan(layer_fn, x, (params['layers'],
                                            kv['k'], kv['v']))
     x = llama._rms_norm(x, params['final_norm'], c.norm_eps)
-    logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'],
-                        preferred_element_type=jnp.float32)
-    return logits[:, 0], new_kv
+    return lm_logits(c, params, x)[:, 0], new_kv
 
 
 def forward(config: QwenConfig, params: Params, tokens: jax.Array,

@@ -36,11 +36,14 @@ def pipeline_apply(layer_fn: Callable[[jax.Array, Any], jax.Array],
                    mesh: Mesh,
                    n_microbatches: int,
                    stage_axis: str = 'stage',
-                   remat: bool = False) -> jax.Array:
+                   remat: bool = False,
+                   with_aux: bool = False):
     """Apply L stacked layers to x, pipelined over the stage axis.
 
     Args:
-      layer_fn: (x_mb [mb, ...], one_layer_params) -> x_mb — one layer.
+      layer_fn: (x_mb [mb, ...], one_layer_params) -> x_mb — one layer;
+        with with_aux=True it returns (x_mb, aux_scalar) instead (MoE
+        load-balance loss).
       stacked_params: pytree whose leaves have leading dim L (the layer
         axis), sharded over `stage_axis` (use mesh.PIPELINE_RULES so
         'layers' maps to 'stage').
@@ -48,9 +51,12 @@ def pipeline_apply(layer_fn: Callable[[jax.Array, Any], jax.Array],
       mesh: mesh containing `stage_axis`.
       n_microbatches: GPipe microbatch count M (bubble = (P-1)/(M+P-1)).
       remat: checkpoint each stage block (recompute in backward).
+      with_aux: accumulate the per-layer aux scalar. Fill/drain lanes
+        (holding no real microbatch) are masked out, so the returned
+        mean is over real (microbatch, layer) pairs only.
 
-    Returns [B, ...], replicated over the stage axis (ordinary SPMD
-    downstream).
+    Returns [B, ...] (replicated over the stage axis, ordinary SPMD
+    downstream); with with_aux=True, the tuple (out, aux_mean).
     """
     n_stages = int(mesh.shape[stage_axis])
     if x.shape[0] % n_microbatches:
@@ -58,20 +64,29 @@ def pipeline_apply(layer_fn: Callable[[jax.Array, Any], jax.Array],
                          f'n_microbatches={n_microbatches}.')
 
     def stage_block(params_block, x_in):
-        def one(x, lp):
-            return layer_fn(x, lp), None
-        y, _ = jax.lax.scan(one, x_in, params_block)
-        return y
+        def one(carry, lp):
+            if with_aux:
+                y, aux = layer_fn(carry, lp)
+                return y, aux
+            return layer_fn(carry, lp), None
+        y, auxes = jax.lax.scan(one, x_in, params_block)
+        if with_aux:
+            return y, jnp.sum(auxes)
+        return y, jnp.zeros((), jnp.float32)
 
     if remat:
         stage_block = jax.checkpoint(
             stage_block,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
 
-    if n_stages == 1:
-        return stage_block(stacked_params, x)
-
     n_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+
+    if n_stages == 1:
+        y, aux_sum = stage_block(stacked_params, x)
+        if with_aux:
+            return y, aux_sum / n_layers
+        return y
+
     if n_layers % n_stages:
         raise ValueError(f'{n_layers} layers not divisible by '
                          f'{n_stages} pipeline stages.')
@@ -95,15 +110,22 @@ def pipeline_apply(layer_fn: Callable[[jax.Array, Any], jax.Array],
 
     state0 = constrain(jnp.zeros((n_stages,) + xs.shape[1:], x.dtype))
     out0 = jnp.zeros_like(xs)
+    lanes = jnp.arange(n_stages)
 
     def tick(carry, t):
-        state, out = carry
+        state, out, aux_total = carry
         # Inject the next microbatch into the stage-0 lane.
         mb_t = xs[jnp.clip(t, 0, m - 1)].astype(x.dtype)
         state = state.at[0].set(mb_t)
         # Each stage advances its lane by its own layer block (vmap over
         # the stage-sharded dim → per-stage compute, zero communication).
-        state = constrain(jax.vmap(stage_block)(params_staged, state))
+        state, lane_aux = jax.vmap(stage_block)(params_staged, state)
+        state = constrain(state)
+        # Lane p holds microbatch t-p; fill/drain lanes hold zeros whose
+        # aux must not pollute the statistics.
+        valid = ((t - lanes >= 0) & (t - lanes <= m - 1)).astype(
+            jnp.float32)
+        aux_total = aux_total + jnp.sum(lane_aux * valid)
         # The last lane just finished microbatch t-(P-1): emit it.
         y = state[n_stages - 1]
         oidx = jnp.clip(t - (n_stages - 1), 0, m - 1)
@@ -112,8 +134,13 @@ def pipeline_apply(layer_fn: Callable[[jax.Array, Any], jax.Array],
             out, jnp.where(write, y, out[oidx]), oidx, 0)
         # Hand each lane to its successor (collective-permute over ICI).
         state = constrain(jnp.roll(state, 1, axis=0))
-        return (state, out), None
+        return (state, out, aux_total), None
 
-    (_, out), _ = jax.lax.scan(tick, (state0, out0),
-                               jnp.arange(m + n_stages - 1))
-    return out.reshape(x.shape)
+    (_, out, aux_total), _ = jax.lax.scan(
+        tick, (state0, out0, jnp.zeros((), jnp.float32)),
+        jnp.arange(m + n_stages - 1))
+    out = out.reshape(x.shape)
+    if with_aux:
+        # Every real (microbatch, layer) pair contributed exactly once.
+        return out, aux_total / (m * n_layers)
+    return out

@@ -123,7 +123,12 @@ class _Handler(BaseHTTPRequestHandler):
             if not self._authenticated():
                 self._send(401, {'error': 'authentication required'})
                 return
-            self._send(200, {'requests': requests_db.list_requests()})
+            try:
+                limit = int(params.get('limit', '100'))
+            except (TypeError, ValueError):
+                limit = 100
+            self._send(200, {'requests':
+                             requests_db.list_requests(limit=limit)})
         else:
             self._send(404, {'error': f'no route {parsed.path}'})
 

@@ -66,8 +66,7 @@ class Trainer:
                                               'pipelined_loss_fn'):
             raise NotImplementedError(
                 f'Pipeline parallelism needs a pipelined_loss_fn; '
-                f'{self._model_lib.__name__} does not provide one '
-                '(MoE expert layers are not pipelined yet).')
+                f'{self._model_lib.__name__} does not provide one.')
         self._rules = (mesh_lib.PIPELINE_RULES if self._n_stages > 1
                        else mesh_lib.DEFAULT_RULES)
         self._param_shardings = mesh_lib.tree_shardings(
@@ -134,13 +133,19 @@ class Trainer:
         c = self.config
 
         def loss_of(params):
+            from skypilot_tpu.models import moe
             if self._n_stages > 1:
+                kwargs = {}
+                if self._model_lib is moe:
+                    # Forward the mask so moe.pipelined_loss_fn can
+                    # refuse it loudly (pads under GPipe would silently
+                    # consume expert capacity otherwise).
+                    kwargs['token_mask'] = batch.get('token_mask')
                 return self._model_lib.pipelined_loss_fn(
                     c.model, params, batch['tokens'], batch['targets'],
                     mesh=self.mesh, n_microbatches=c.n_microbatches,
-                    loss_mask=batch.get('mask'))
+                    loss_mask=batch.get('mask'), **kwargs)
             kwargs = {}
-            from skypilot_tpu.models import moe
             if self._model_lib is moe:
                 # MoE: pads are excluded from routing; the loss mask (which
                 # targets count) is a separate concern.
