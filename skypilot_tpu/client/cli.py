@@ -403,6 +403,17 @@ def serve_status(service_names):
         click.echo(json.dumps(record, default=str))
 
 
+@serve.command(name='logs')
+@click.argument('service_name')
+@click.argument('replica_id', type=int)
+@click.option('--job-id', type=int, default=None)
+def serve_logs(service_name, replica_id, job_id):
+    """Tail one replica's logs (twin of `sky serve logs`)."""
+    from skypilot_tpu.client import sdk
+    click.echo(sdk.serve_logs(service_name, replica_id, job_id=job_id),
+               nl=False)
+
+
 @serve.command(name='down')
 @click.argument('service_names', nargs=-1, required=True)
 @click.option('--yes', '-y', is_flag=True, default=False)

@@ -294,6 +294,17 @@ def serve_status(service_names: Optional[List[str]] = None
     return serve_core.status(service_names)
 
 
+def serve_logs(service_name: str, replica_id: int,
+               job_id: Optional[int] = None) -> str:
+    remote = _remote()
+    if remote is not None:
+        return remote._call('serve.logs', {
+            'service_name': service_name, 'replica_id': replica_id,
+            'job_id': job_id})
+    from skypilot_tpu.serve import core as serve_core
+    return serve_core.tail_logs(service_name, replica_id, job_id=job_id)
+
+
 def serve_down(service_name: str) -> None:
     remote = _remote()
     if remote is not None:

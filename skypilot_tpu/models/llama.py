@@ -285,6 +285,36 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
+                      kv_cache, cache_index=None, cache_positions=None):
+    """Write this step's K/V into the slot cache and attend over it.
+
+    The decode-path cache contract shared by every family (llama, qwen,
+    gemma, moe): with ``cache_positions`` [B] each slot writes at its
+    own length (continuous batching); with scalar ``cache_index`` the
+    whole batch appends at one offset (shared-prefix prefill insert).
+    Returns (attn [B,S,H,D], (new_k, new_v)).
+    """
+    b, s = q.shape[0], q.shape[1]
+    ck, cv = kv_cache
+    if cache_positions is not None:
+        slots = jnp.arange(b)
+        ck = ck.at[slots, cache_positions].set(k[:, 0])
+        cv = cv.at[slots, cache_positions].set(v[:, 0])
+        last = cache_positions[:, None]
+    else:
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k, cache_index,
+                                                 axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v, cache_index,
+                                                 axis=1)
+        last = cache_index + s - 1
+    kv_pos = jnp.arange(ck.shape[1])[None, :]
+    valid = kv_pos <= last
+    attn = attention_ops.xla_attention_with_mask(q, ck, cv,
+                                                 valid[:, None, None, :])
+    return attn, (ck, cv)
+
+
 def _layer(config: LlamaConfig, mesh: Optional[mesh_lib.Mesh],
            x: jax.Array, layer_params: Params, positions: jax.Array,
            kv_cache: Optional[Tuple[jax.Array, jax.Array]] = None,
@@ -319,24 +349,9 @@ def _layer(config: LlamaConfig, mesh: Optional[mesh_lib.Mesh],
     k = _rope(k, positions, c.rope_theta)
 
     if kv_cache is not None:
-        # Decode path: append k/v, attend over the full cache.
-        ck, cv = kv_cache
-        if cache_positions is not None:
-            slots = jnp.arange(b)
-            ck = ck.at[slots, cache_positions].set(k[:, 0])
-            cv = cv.at[slots, cache_positions].set(v[:, 0])
-            last = cache_positions[:, None]
-        else:
-            ck = jax.lax.dynamic_update_slice_in_dim(ck, k, cache_index,
-                                                     axis=1)
-            cv = jax.lax.dynamic_update_slice_in_dim(cv, v, cache_index,
-                                                     axis=1)
-            last = cache_index + s - 1
-        new_cache = (ck, cv)
-        kv_pos = jnp.arange(ck.shape[1])[None, :]
-        valid = kv_pos <= last
-        attn = attention_ops.xla_attention_with_mask(q, ck, cv,
-                                                     valid[:, None, None, :])
+        attn, new_cache = slot_cache_attend(
+            q, k, v, kv_cache, cache_index=cache_index,
+            cache_positions=cache_positions)
     elif c.attention_impl in ('ring', 'ulysses') and mesh is not None:
         # Context parallelism: sequence stays sharded through attention
         # (K/V ring over ICI neighbors or all-to-all head scatter).

@@ -192,15 +192,8 @@ def _layer(config: QwenConfig, mesh: Optional[mesh_lib.Mesh],
     k = llama._rope(k, positions, c.rope_theta)
 
     if kv_cache is not None:
-        ck, cv = kv_cache
-        slots = jnp.arange(b)
-        ck = ck.at[slots, cache_positions].set(k[:, 0])
-        cv = cv.at[slots, cache_positions].set(v[:, 0])
-        new_cache = (ck, cv)
-        kv_pos = jnp.arange(ck.shape[1])[None, :]
-        valid = kv_pos <= cache_positions[:, None]
-        attn = attention_ops.xla_attention_with_mask(
-            q, ck, cv, valid[:, None, None, :])
+        attn, new_cache = llama.slot_cache_attend(
+            q, k, v, kv_cache, cache_positions=cache_positions)
     else:
         new_cache = (k, v) if return_kv else None
         attn = attention_ops.dot_product_attention(

@@ -66,8 +66,7 @@ def pipeline_apply(layer_fn: Callable[[jax.Array, Any], jax.Array],
     def stage_block(params_block, x_in):
         def one(carry, lp):
             if with_aux:
-                y, aux = layer_fn(carry, lp)
-                return y, aux
+                return layer_fn(carry, lp)   # (y, aux)
             return layer_fn(carry, lp), None
         y, auxes = jax.lax.scan(one, x_in, params_block)
         if with_aux:

@@ -148,3 +148,19 @@ def down(service_name: str) -> None:
         except exceptions.ClusterDoesNotExist:
             pass
     serve_state.remove_service(service_name)
+
+
+def tail_logs(service_name: str, replica_id: int,
+              job_id: Optional[int] = None) -> str:
+    """Log tail of one replica's cluster (twin of `sky serve logs`)."""
+    if serve_state.get_service(service_name) is None:
+        raise ValueError(f'Service {service_name!r} not found.')
+    replicas = serve_state.get_replicas(service_name)
+    match = [r for r in replicas if r['replica_id'] == replica_id]
+    if not match:
+        known = sorted(r['replica_id'] for r in replicas)
+        raise ValueError(
+            f'Service {service_name!r} has no replica {replica_id} '
+            f'(known: {known}).')
+    from skypilot_tpu import core as core_lib
+    return core_lib.tail_logs(match[0]['cluster_name'], job_id=job_id)

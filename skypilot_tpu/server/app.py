@@ -127,6 +127,8 @@ class _Handler(BaseHTTPRequestHandler):
                 limit = int(params.get('limit', '100'))
             except (TypeError, ValueError):
                 limit = 100
+            # Clamp: SQLite treats LIMIT -1 as unlimited.
+            limit = max(1, min(limit, 1000))
             self._send(200, {'requests':
                              requests_db.list_requests(limit=limit)})
         else:

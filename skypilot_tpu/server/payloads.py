@@ -145,10 +145,12 @@ def _serve_update(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
     return run, {'service_name': _require(body, 'service_name')}
 
 
-def _serve_verb(fn_name: str, *fields):
+def _serve_verb(fn_name: str, *fields, **defaults):
     def resolver(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
         from skypilot_tpu.serve import core as serve_core
         kwargs = {f: _require(body, f) for f in fields}
+        for key, default in defaults.items():
+            kwargs[key] = body.get(key, default)
         return getattr(serve_core, fn_name), kwargs
     return resolver
 
@@ -178,6 +180,8 @@ _VERBS.update({
         __import__('skypilot_tpu.serve.core', fromlist=['status']).status,
         {'service_names': body.get('service_names')}),
     'serve.down': _serve_verb('down', 'service_name'),
+    'serve.logs': _serve_verb('tail_logs', 'service_name', 'replica_id',
+                              job_id=None),
     # User management (admin-only via users.rbac).
     'users.list': _module_verb(_USERS, 'list_users'),
     'users.create': _module_verb(_USERS, 'create_user', 'name', 'password',

@@ -412,3 +412,20 @@ def test_schema_policy_enum_matches_registry():
     enum = schemas._SERVICE_SCHEMA['properties'][
         'load_balancing_policy']['enum']
     assert sorted(enum) == sorted(lb_pol.POLICIES)
+
+
+def test_serve_logs_tails_replica(serve_env):
+    """`xsky serve logs SVC REPLICA` returns that replica cluster's job
+    log; unknown replica ids produce a one-line error."""
+    from skypilot_tpu.client import sdk
+    task = _service_task()
+    serve_core.up(task, 'logsvc', timeout_s=90)
+    try:
+        reps = serve_state.get_replicas('logsvc')
+        assert reps
+        text = sdk.serve_logs('logsvc', reps[0]['replica_id'])
+        assert isinstance(text, str)
+        with pytest.raises(ValueError, match='no replica 99'):
+            sdk.serve_logs('logsvc', 99)
+    finally:
+        serve_core.down('logsvc')
