@@ -36,6 +36,8 @@ class EngineConfig:
     max_slots: int = 8               # concurrent decode sequences
     max_target_len: int = 2048       # prompt + generation budget per slot
     prefill_buckets: Tuple[int, ...] = (128, 256, 512, 1024)
+    # bf16, or jnp.int8 for a quantized cache (half the HBM: per-head
+    # symmetric scales, dequant fused into the attention reads).
     kv_dtype: Any = jnp.bfloat16
 
     @property
@@ -80,14 +82,27 @@ class InferenceEngine:
 
     # ---- state ----
 
-    def init_decode_state(self) -> Dict[str, jax.Array]:
+    @property
+    def _kv_quantized(self) -> bool:
+        return self.config.kv_dtype == jnp.int8
+
+    def _make_cache(self, kv_kwargs):
+        """One cache entry: plain array, or (int8, fp32 scale) pair."""
+        cfg = self.config
+        if not self._kv_quantized:
+            return jnp.zeros(self._kv_shape, cfg.kv_dtype, **kv_kwargs)
+        scale_shape = self._kv_shape[:-1] + (1,)
+        return (jnp.zeros(self._kv_shape, jnp.int8, **kv_kwargs),
+                jnp.zeros(scale_shape, jnp.float32, **kv_kwargs))
+
+    def init_decode_state(self) -> Dict[str, Any]:
         cfg = self.config
         kv_kwargs = {}
         if self._kv_sharding is not None:
             kv_kwargs['device'] = self._kv_sharding
         state = {
-            'kv_k': jnp.zeros(self._kv_shape, cfg.kv_dtype, **kv_kwargs),
-            'kv_v': jnp.zeros(self._kv_shape, cfg.kv_dtype, **kv_kwargs),
+            'kv_k': self._make_cache(kv_kwargs),
+            'kv_v': self._make_cache(kv_kwargs),
             # per-slot: index the NEXT token will be written at
             'lengths': jnp.zeros((cfg.max_slots,), jnp.int32),
             'tokens': jnp.zeros((cfg.max_slots,), jnp.int32),
@@ -161,10 +176,10 @@ class InferenceEngine:
         pad = cfg.max_target_len - k.shape[1]
         k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
-        state['kv_k'] = state['kv_k'].at[:, slot].set(
-            k.astype(cfg.kv_dtype))
-        state['kv_v'] = state['kv_v'].at[:, slot].set(
-            v.astype(cfg.kv_dtype))
+        # llama.write_cache_slot owns the cache representation (plain
+        # or quantized) together with slot_cache_attend.
+        state['kv_k'] = llama.write_cache_slot(state['kv_k'], k, slot)
+        state['kv_v'] = llama.write_cache_slot(state['kv_v'], v, slot)
         state['lengths'] = state['lengths'].at[slot].set(true_len)
         state['tokens'] = state['tokens'].at[slot].set(first_token)
         state['active'] = state['active'].at[slot].set(True)

@@ -132,15 +132,20 @@ def main() -> int:
     parser.add_argument('--port', type=int, default=8080)
     parser.add_argument('--max-slots', type=int, default=16)
     parser.add_argument('--max-target-len', type=int, default=2048)
+    parser.add_argument('--kv-dtype', default='bf16',
+                        choices=['bf16', 'int8'],
+                        help='int8 halves KV-cache HBM (per-head scales)')
     parser.add_argument('--mesh', default=None,
                         help="e.g. 'tensor=4' to shard across chips")
     args = parser.parse_args()
 
     model = models.get_config(args.model)
     model = dataclasses.replace(model, remat=False)
-    config = engine_lib.EngineConfig(model=model,
-                                     max_slots=args.max_slots,
-                                     max_target_len=args.max_target_len)
+    import jax.numpy as jnp
+    config = engine_lib.EngineConfig(
+        model=model, max_slots=args.max_slots,
+        max_target_len=args.max_target_len,
+        kv_dtype=jnp.int8 if args.kv_dtype == 'int8' else jnp.bfloat16)
     mesh = None
     if args.mesh:
         from skypilot_tpu.train.launch import parse_mesh

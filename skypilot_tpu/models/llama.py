@@ -285,6 +285,40 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-(position, head) int8 symmetric quantization over head_dim.
+
+    → (int8 values, fp32 scale with a trailing 1-dim). Halves KV-cache
+    HBM vs bf16; the dequant multiply fuses into the attention reads.
+    """
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                    keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array,
+                  dtype) -> jax.Array:
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def write_cache_slot(cache_entry, values: jax.Array, slot) -> Any:
+    """Write one slot's full K (or V) prefix into a cache entry.
+
+    cache_entry: [L, slots, len, KVH, HD] array, or the quantized
+    (int8, scale) pair; values: [L, len, KVH, HD] (bf16/fp32). Owns the
+    quantized representation together with slot_cache_attend so the
+    engine never touches the layout.
+    """
+    if isinstance(cache_entry, (tuple, list)):
+        data, scale = cache_entry
+        q_vals, q_scale = quantize_kv(values)
+        return (data.at[:, slot].set(q_vals),
+                scale.at[:, slot].set(q_scale))
+    return cache_entry.at[:, slot].set(values.astype(cache_entry.dtype))
+
+
 def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
                       kv_cache, cache_index=None, cache_positions=None):
     """Write this step's K/V into the slot cache and attend over it.
@@ -293,26 +327,57 @@ def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
     gemma, moe): with ``cache_positions`` [B] each slot writes at its
     own length (continuous batching); with scalar ``cache_index`` the
     whole batch appends at one offset (shared-prefix prefill insert).
-    Returns (attn [B,S,H,D], (new_k, new_v)).
+
+    Cache entries may be plain arrays, or ``(int8_values, fp32_scale)``
+    pairs (EngineConfig.kv_dtype = int8): new rows are quantized on
+    write and the whole cache dequantizes into the attention reads.
+    Families pass the entries through opaquely, so the quantization
+    scheme lives entirely here. Returns (attn, (new_k, new_v)) with the
+    same representation that came in.
     """
     b, s = q.shape[0], q.shape[1]
     ck, cv = kv_cache
+    quantized = isinstance(ck, (tuple, list))
+    if quantized:
+        ck, ck_scale = ck
+        cv, cv_scale = cv
+        k_write, k_scale_write = quantize_kv(k)
+        v_write, v_scale_write = quantize_kv(v)
+    else:
+        k_write, v_write = k, v
     if cache_positions is not None:
         slots = jnp.arange(b)
-        ck = ck.at[slots, cache_positions].set(k[:, 0])
-        cv = cv.at[slots, cache_positions].set(v[:, 0])
+        ck = ck.at[slots, cache_positions].set(k_write[:, 0])
+        cv = cv.at[slots, cache_positions].set(v_write[:, 0])
+        if quantized:
+            ck_scale = ck_scale.at[slots, cache_positions].set(
+                k_scale_write[:, 0])
+            cv_scale = cv_scale.at[slots, cache_positions].set(
+                v_scale_write[:, 0])
         last = cache_positions[:, None]
     else:
-        ck = jax.lax.dynamic_update_slice_in_dim(ck, k, cache_index,
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k_write, cache_index,
                                                  axis=1)
-        cv = jax.lax.dynamic_update_slice_in_dim(cv, v, cache_index,
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v_write, cache_index,
                                                  axis=1)
+        if quantized:
+            ck_scale = jax.lax.dynamic_update_slice_in_dim(
+                ck_scale, k_scale_write, cache_index, axis=1)
+            cv_scale = jax.lax.dynamic_update_slice_in_dim(
+                cv_scale, v_scale_write, cache_index, axis=1)
         last = cache_index + s - 1
     kv_pos = jnp.arange(ck.shape[1])[None, :]
     valid = kv_pos <= last
-    attn = attention_ops.xla_attention_with_mask(q, ck, cv,
+    if quantized:
+        k_full = dequantize_kv(ck, ck_scale, q.dtype)
+        v_full = dequantize_kv(cv, cv_scale, q.dtype)
+        new_cache = ((ck, ck_scale), (cv, cv_scale))
+    else:
+        k_full, v_full = ck, cv
+        new_cache = (ck, cv)
+    attn = attention_ops.xla_attention_with_mask(q, k_full, v_full,
                                                  valid[:, None, None, :])
-    return attn, (ck, cv)
+    return attn, new_cache
 
 
 def _layer(config: LlamaConfig, mesh: Optional[mesh_lib.Mesh],

@@ -163,4 +163,12 @@ def tail_logs(service_name: str, replica_id: int,
             f'Service {service_name!r} has no replica {replica_id} '
             f'(known: {known}).')
     from skypilot_tpu import core as core_lib
-    return core_lib.tail_logs(match[0]['cluster_name'], job_id=job_id)
+    from skypilot_tpu import exceptions
+    try:
+        return core_lib.tail_logs(match[0]['cluster_name'],
+                                  job_id=job_id)
+    except exceptions.ClusterDoesNotExist:
+        # FAILED replicas keep their DB row but have no live cluster.
+        raise ValueError(
+            f'Replica {replica_id} of {service_name!r} has no live '
+            f'cluster (status: {match[0]["status"].value}).') from None

@@ -290,3 +290,67 @@ def test_engine_rejects_family_missing_serving_hooks(monkeypatch):
     config = engine_lib.EngineConfig(model=llama.LLAMA_TINY)
     with pytest.raises(NotImplementedError, match='prefill_hidden'):
         engine_lib.InferenceEngine(config, params={})
+
+
+class TestInt8KvCache:
+    """kv_dtype=int8: half-HBM cache with per-(position, head) scales,
+    quantized in slot_cache_attend — shared by every family."""
+
+    def _engines(self, model_cfg, init_fn):
+        params = init_fn(model_cfg, jax.random.PRNGKey(0))
+        mk = lambda dtype: engine_lib.InferenceEngine(
+            engine_lib.EngineConfig(model=model_cfg, max_slots=2,
+                                    max_target_len=32,
+                                    prefill_buckets=(16,),
+                                    kv_dtype=dtype), params)
+        return mk(jnp.bfloat16), mk(jnp.int8)
+
+    def test_llama_int8_matches_bf16_greedy(self):
+        bf16, int8 = self._engines(llama.LLAMA_TINY, llama.init)
+        prompt = [5, 17, 3, 99, 42]
+        out_ref = orch_lib.Orchestrator(bf16).generate(
+            [prompt], max_new_tokens=6)
+        out_q = orch_lib.Orchestrator(int8).generate(
+            [prompt], max_new_tokens=6)
+        # 7-bit mantissa quantization error is far below the tiny
+        # model's logit gaps: greedy decode is unchanged.
+        assert out_q == out_ref
+
+    def test_qwen_int8_decodes(self):
+        from skypilot_tpu.models import qwen
+        bf16, int8 = self._engines(qwen.QWEN3_TINY, qwen.init)
+        prompt = [1, 2, 3]
+        out_ref = orch_lib.Orchestrator(bf16).generate(
+            [prompt], max_new_tokens=4)
+        out_q = orch_lib.Orchestrator(int8).generate(
+            [prompt], max_new_tokens=4)
+        # Tiny qk-norm logit gaps sit near the quantization noise floor,
+        # so exact greedy equality is not guaranteed here (it is for the
+        # llama tiny above); the quantized path must still produce the
+        # same first step and a full, valid generation.
+        assert out_q[0][0] == out_ref[0][0]
+        assert len(out_q[0]) == 4
+        assert all(0 <= t < qwen.QWEN3_TINY.vocab_size for t in out_q[0])
+
+    def test_cache_is_actually_int8(self):
+        _, int8 = self._engines(llama.LLAMA_TINY, llama.init)
+        state = int8.init_decode_state()
+        data, scale = state['kv_k']
+        assert data.dtype == jnp.int8
+        assert scale.dtype == jnp.float32
+        assert scale.shape == data.shape[:-1] + (1,)
+        # int8 + fp32/hd scale ≈ 0.53× the bf16 cache bytes.
+        bf16_bytes = data.size * 2
+        q_bytes = data.size + scale.size * 4
+        # Tiny head_dim=16 pays 4B/16 values of scale overhead (0.625x);
+        # real models (hd=128) sit at ~0.52x.
+        assert q_bytes < 0.65 * bf16_bytes
+
+    def test_quantize_roundtrip_error_bounded(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 2, 16),
+                              jnp.float32)
+        q, s = llama.quantize_kv(x)
+        back = llama.dequantize_kv(q, s, jnp.float32)
+        err = float(jnp.max(jnp.abs(back - x)))
+        amax = float(jnp.max(jnp.abs(x)))
+        assert err <= amax / 127.0 + 1e-6
