@@ -124,3 +124,22 @@ class TestWireFormat:
         assert isinstance(exc.value, exceptions.ClusterDoesNotExist) or \
             'ClusterDoesNotExist' in str(type(exc.value).__name__) or \
             'ClusterDoesNotExist' in str(exc.value)
+
+
+def test_verb_surface_is_append_only():
+    """The wire verb set may only grow: removing or renaming a verb
+    breaks older clients. This pin is the list as of round 2 — extend
+    it when adding verbs; never delete from it."""
+    from skypilot_tpu.server import payloads
+    pinned = {
+        'launch', 'exec', 'status', 'start', 'stop', 'down', 'autostop',
+        'queue', 'cancel', 'logs', 'check', 'cost_report',
+        'storage.ls', 'storage.delete',
+        'jobs.launch', 'jobs.queue', 'jobs.cancel', 'jobs.logs',
+        'serve.up', 'serve.update', 'serve.status', 'serve.down',
+        'serve.logs',
+        'users.list', 'users.create', 'users.delete', 'users.set_role',
+    }
+    known = {v for v in pinned if payloads.known_verb(v)}
+    missing = pinned - known
+    assert not missing, f'wire verbs removed/renamed: {sorted(missing)}'
