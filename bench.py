@@ -180,11 +180,16 @@ def main() -> None:
     configs = _candidate_configs(platform, hbm_gib)
     best, best_config, last_err = _try_ladder(configs)
     if best is None:
-        # Last resort: the guaranteed-lowerable XLA attention path at
-        # the most memory-lean rung — a slower number beats none.
+        # Last resort: the guaranteed-lowerable XLA attention path — a
+        # slower number beats none. First at the most memory-lean rung,
+        # then at seq 4096 where full-softmax scores certainly fit.
         fallback = [dataclasses.replace(
             c, model=dataclasses.replace(c.model, attention_impl='xla'))
             for c in configs[-1:]]
+        fallback.append(dataclasses.replace(
+            fallback[-1], seq_len=4096,
+            model=dataclasses.replace(fallback[-1].model,
+                                      max_seq_len=4096)))
         best, best_config, _ = _try_ladder(fallback)
     if best is None:
         raise RuntimeError(f'Every bench config failed: {last_err}')
