@@ -60,7 +60,10 @@ def _load_task(entrypoint: str, envs, secrets, name, num_nodes,
 
 
 @click.group()
-@click.version_option(package_name=None, version='0.1.0',
+@click.version_option(package_name=None,
+                      version=__import__(
+                          'skypilot_tpu.version',
+                          fromlist=['__version__']).__version__,
                       prog_name='xsky')
 def cli():
     """xsky: TPU-native multi-cloud AI workload orchestrator."""
