@@ -442,11 +442,82 @@ def api_start(host, port, foreground):
         server_app.run(host=host, port=port)
     else:
         import subprocess
-        subprocess.Popen(
-            [sys.executable, '-m', 'skypilot_tpu.server.app',
-             '--host', host, '--port', str(port)],
-            start_new_session=True)
-        click.echo(f'API server starting at http://{host}:{port}')
+        import time as time_lib
+        log_path = server_app.log_file()
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        # Give the detached child its own stdout/stderr: inheriting the
+        # parent's pipes would keep them open forever (any
+        # `xsky api start | ...` would hang waiting for EOF).
+        with open(log_path, 'ab') as log:
+            proc = subprocess.Popen(
+                [sys.executable, '-m', 'skypilot_tpu.server.app',
+                 '--host', host, '--port', str(port)],
+                stdout=log, stderr=subprocess.STDOUT,
+                stdin=subprocess.DEVNULL,
+                start_new_session=True)
+        # Don't report success for a child that died on arrival (port
+        # in use, bad import): wait for its pidfile or early exit.
+        deadline = time_lib.time() + 15
+        while time_lib.time() < deadline:
+            if proc.poll() is not None:
+                raise click.ClickException(
+                    f'API server exited immediately '
+                    f'(rc {proc.returncode}); see {log_path}.')
+            if os.path.exists(server_app.pid_file()):
+                break
+            time_lib.sleep(0.2)
+        else:
+            raise click.ClickException(
+                f'API server did not come up within 15s; '
+                f'see {log_path}.')
+        with open(server_app.pid_file(), encoding='utf-8') as f:
+            f.readline()
+            endpoint = f.readline().strip() or f'{host}:{port}'
+        click.echo(f'API server starting at http://{endpoint} '
+                   f'(logs: {log_path})')
+
+
+@api.command(name='stop')
+def api_stop():
+    """Stop the local API server started with `xsky api start`."""
+    import signal
+
+    from skypilot_tpu.server import app as server_app
+    path = server_app.pid_file()
+    if not os.path.exists(path):
+        raise click.ClickException('No local API server is running '
+                                   '(no pid file).')
+    try:
+        with open(path, encoding='utf-8') as f:
+            pid = int(f.readline().strip())
+    except (OSError, ValueError):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        raise click.ClickException(
+            f'Corrupt pid file {path} (removed); stop the server '
+            'manually if it is still running.')
+    # Guard against PID reuse after an unclean shutdown: only SIGTERM
+    # a process that is actually the xsky API server.
+    try:
+        with open(f'/proc/{pid}/cmdline', 'rb') as f:
+            cmdline = f.read().decode(errors='replace')
+    except OSError:
+        cmdline = ''
+    if 'skypilot_tpu.server.app' in cmdline:
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+        click.echo(f'API server (pid {pid}) stopped.')
+    else:
+        click.echo(f'Stale pid file (pid {pid} is not the API '
+                   'server); removed.')
+    try:
+        os.remove(path)
+    except OSError:
+        pass
 
 
 def _api_remote():

@@ -276,13 +276,49 @@ def make_server(host: str = '127.0.0.1',
     return _ApiServer((host, port), _Handler)
 
 
+def server_dir() -> str:
+    import os
+    return os.path.expanduser('~/.xsky/server')
+
+
+def pid_file() -> str:
+    import os
+    return os.path.join(server_dir(), 'api.pid')
+
+
+def log_file() -> str:
+    import os
+    return os.path.join(server_dir(), 'api.log')
+
+
 def run(host: str = '127.0.0.1', port: int = 46580) -> None:
+    import os
+    import signal
     from skypilot_tpu.users import core as users_core
     if users_core.auth_required():
         users_core.bootstrap_admin_if_empty()
     server = make_server(host, port)
-    logger.info(f'xsky API server listening on http://{host}:{port}')
-    server.serve_forever()
+    bound_port = server.server_address[1]   # real port (0 = ephemeral)
+    os.makedirs(server_dir(), exist_ok=True)
+    with open(pid_file(), 'w', encoding='utf-8') as f:
+        f.write(f'{os.getpid()}\n{host}:{bound_port}\n')
+
+    def _on_term(signum, frame):
+        # SystemExit unwinds through the finally below; the default
+        # SIGTERM disposition would kill without pidfile cleanup.
+        del signum, frame
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, _on_term)
+    logger.info(
+        f'xsky API server listening on http://{host}:{bound_port}')
+    try:
+        server.serve_forever()
+    finally:
+        try:
+            os.remove(pid_file())
+        except OSError:
+            pass
 
 
 def run_in_thread(host: str = '127.0.0.1',

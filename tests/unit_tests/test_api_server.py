@@ -284,3 +284,73 @@ class TestApiCliRemote:
         assert out.exit_code != 0
         assert called == ['list_api_requests', 'get_api_request',
                           'cancel_api_request']
+
+
+class TestApiStartStop:
+
+    def test_pidfile_lifecycle(self, monkeypatch, tmp_path):
+        """`api start` must fully detach (no inherited stdio pipes —
+        a piped invocation would otherwise hang past the child's
+        lifetime) and `api stop` must kill via the pidfile."""
+        import subprocess
+        import sys
+        import time as time_lib
+        import os as os_lib
+        import signal as signal_lib
+        env = dict(os_lib.environ, HOME=str(tmp_path))
+        pid_path = tmp_path / '.xsky' / 'server' / 'api.pid'
+        try:
+            # Piped stdout: completes only if the child got its own
+            # stdio; start reports the REAL bound port and exits 0
+            # only once the pidfile exists.
+            out = subprocess.run(
+                [sys.executable, '-m', 'skypilot_tpu.client.cli', 'api',
+                 'start', '--port', '0'],
+                capture_output=True, text=True, timeout=60, env=env)
+            assert out.returncode == 0, out.stderr
+            assert pid_path.exists()
+            endpoint = pid_path.read_text().splitlines()[1]
+            assert not endpoint.endswith(':0')   # real ephemeral port
+            assert endpoint in out.stdout
+            out = subprocess.run(
+                [sys.executable, '-m', 'skypilot_tpu.client.cli', 'api',
+                 'stop'],
+                capture_output=True, text=True, timeout=30, env=env)
+            assert out.returncode == 0, out.stderr
+            assert 'stopped' in out.stdout
+            out = subprocess.run(
+                [sys.executable, '-m', 'skypilot_tpu.client.cli', 'api',
+                 'stop'],
+                capture_output=True, text=True, timeout=30, env=env)
+            assert out.returncode != 0
+        finally:
+            # Never leak a detached server past the test.
+            if pid_path.exists():
+                try:
+                    pid = int(pid_path.read_text().splitlines()[0])
+                    os_lib.kill(pid, signal_lib.SIGKILL)
+                except (ValueError, OSError):
+                    pass
+
+    def test_stop_refuses_foreign_pid(self, tmp_path, monkeypatch):
+        """A stale pidfile pointing at a reused PID must not get an
+        unrelated process killed."""
+        import subprocess
+        import sys
+        server_rt = tmp_path / '.xsky' / 'server'
+        server_rt.mkdir(parents=True)
+        victim = subprocess.Popen([sys.executable, '-c',
+                                   'import time; time.sleep(60)'])
+        try:
+            (server_rt / 'api.pid').write_text(
+                f'{victim.pid}\n127.0.0.1:1\n')
+            env = dict(__import__('os').environ, HOME=str(tmp_path))
+            out = subprocess.run(
+                [sys.executable, '-m', 'skypilot_tpu.client.cli', 'api',
+                 'stop'],
+                capture_output=True, text=True, timeout=30, env=env)
+            assert 'Stale pid file' in out.stdout
+            assert victim.poll() is None      # victim still alive
+            assert not (server_rt / 'api.pid').exists()
+        finally:
+            victim.kill()
