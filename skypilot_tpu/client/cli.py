@@ -1,8 +1,10 @@
 """`xsky` CLI (twin of sky/client/cli/command.py click groups).
 
 Verbs: launch, exec, status, start, stop, down, autostop, queue, logs,
-cancel, check, show-gpus, cost-report, jobs (launch/queue/cancel/logs),
-serve (up/status/down), storage (ls/delete), api (start/stop).
+cancel, ssh, check, show-gpus, cost-report,
+jobs (launch/queue/cancel/logs),
+serve (up/update/status/logs/down), storage (ls/delete),
+api (start/stop/status/logs/cancel), users, workspaces.
 """
 from __future__ import annotations
 

@@ -63,6 +63,9 @@ def main() -> int:
                              'flavors shuffle differently).')
     parser.add_argument('--seed', type=int, default=0)
     parser.add_argument('--checkpoint-dir', default=None)
+    parser.add_argument('--metrics-file', default=None,
+                        help='Append one JSON line per log window '
+                             '(step, loss, tok/s, TFLOP/s/chip).')
     parser.add_argument('--checkpoint-every', type=int, default=500)
     parser.add_argument('--resume', default='none',
                         choices=['none', 'auto'])
@@ -172,6 +175,19 @@ def main() -> int:
                 f'step {step + 1}/{args.steps} loss={loss:.4f} '
                 f'{tps:,.0f} tok/s '
                 f'({tflops:.1f} model-TFLOP/s/chip)')
+            if args.metrics_file and jax.process_index() == 0:
+                import json as json_lib
+                with open(args.metrics_file, 'a',
+                          encoding='utf-8') as mf:
+                    mf.write(json_lib.dumps({
+                        'step': step + 1,
+                        'loss': round(loss, 6),
+                        'tokens_per_sec': round(tps, 1),
+                        'model_tflops_per_chip': round(tflops, 2),
+                        'grad_norm': round(
+                            float(metrics['grad_norm']), 4),
+                        'time': time.time(),
+                    }) + '\n')
             window_t0, window_steps = time.perf_counter(), 0
         if manager is not None and (step + 1) % args.checkpoint_every == 0:
             import orbax.checkpoint as ocp

@@ -76,3 +76,20 @@ class TestCheckpointResume:
                                                 rel=1e-4)
         assert solid_losses[3] == pytest.approx(resumed_losses[1],
                                                 rel=1e-4)
+
+
+@pytest.mark.slow
+def test_metrics_file_emitted(tmp_path):
+    """--metrics-file appends one JSON line per log window with the
+    observability fields the dashboard/CI can consume."""
+    import json
+    _run_launch(tmp_path, ['--steps', '3',
+                           '--metrics-file',
+                           str(tmp_path / 'metrics.jsonl')])
+    lines = [json.loads(ln) for ln in
+             (tmp_path / 'metrics.jsonl').read_text().splitlines()]
+    assert len(lines) == 3
+    for row in lines:
+        assert {'step', 'loss', 'tokens_per_sec',
+                'model_tflops_per_chip', 'grad_norm'} <= set(row)
+    assert [r['step'] for r in lines] == [1, 2, 3]
