@@ -55,6 +55,9 @@ class LlamaConfig:
     # saved 'dots' set costs ~770 MB/layer at 16k tokens on a 1B model.
     remat_policy: str = 'dots'
     attention_impl: str = 'auto'
+    # Mistral-style sliding-window attention: each token attends to at
+    # most this many recent positions. None = full causal attention.
+    sliding_window: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -84,11 +87,24 @@ LLAMA3_1B = LlamaConfig(vocab_size=32_768, d_model=2048, n_layers=16,
 LLAMA_TINY = LlamaConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
                          n_kv_heads=2, d_ff=128, max_seq_len=128,
                          remat=False)
+# Mistral-7B: the Llama architecture + a 4096-token sliding window
+# (public config). The flash kernels skip out-of-window blocks, so long
+# contexts run in O(S·W).
+MISTRAL_7B = LlamaConfig(vocab_size=32_000, d_model=4096, n_layers=32,
+                         n_heads=32, n_kv_heads=8, d_ff=14_336,
+                         max_seq_len=32_768, rope_theta=10_000.0,
+                         sliding_window=4096)
+MISTRAL_TINY = LlamaConfig(vocab_size=256, d_model=64, n_layers=2,
+                           n_heads=4, n_kv_heads=2, d_ff=128,
+                           max_seq_len=128, remat=False,
+                           sliding_window=8)
 
 CONFIGS = {
     'llama3-8b': LLAMA3_8B,
     'llama3-70b': LLAMA3_70B,
     'llama3-1b': LLAMA3_1B,
+    'mistral-7b': MISTRAL_7B,
+    'mistral-tiny': MISTRAL_TINY,
     'tiny': LLAMA_TINY,
 }
 
@@ -320,7 +336,8 @@ def write_cache_slot(cache_entry, values: jax.Array, slot) -> Any:
 
 
 def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
-                      kv_cache, cache_index=None, cache_positions=None):
+                      kv_cache, cache_index=None, cache_positions=None,
+                      window=None):
     """Write this step's K/V into the slot cache and attend over it.
 
     The decode-path cache contract shared by every family (llama, qwen,
@@ -354,7 +371,7 @@ def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
                 k_scale_write[:, 0])
             cv_scale = cv_scale.at[slots, cache_positions].set(
                 v_scale_write[:, 0])
-        last = cache_positions[:, None]
+        q_pos = cache_positions[:, None]                # [b, 1]
     else:
         ck = jax.lax.dynamic_update_slice_in_dim(ck, k_write, cache_index,
                                                  axis=1)
@@ -365,9 +382,14 @@ def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
                 ck_scale, k_scale_write, cache_index, axis=1)
             cv_scale = jax.lax.dynamic_update_slice_in_dim(
                 cv_scale, v_scale_write, cache_index, axis=1)
-        last = cache_index + s - 1
-    kv_pos = jnp.arange(ck.shape[1])[None, :]
-    valid = kv_pos <= last
+        q_pos = cache_index + jnp.arange(s)[None, :]    # [1, s]
+    # Per-QUERY validity (a multi-token step's earlier rows must not
+    # see later rows, and each row carries its own window).
+    kv_pos = jnp.arange(ck.shape[1])[None, None, :]     # [1, 1, K]
+    valid = kv_pos <= q_pos[..., None]
+    if window is not None:
+        # Sliding window: only the W most recent rows are live per query.
+        valid = valid & (kv_pos > q_pos[..., None] - window)
     if quantized:
         k_full = dequantize_kv(ck, ck_scale, q.dtype)
         v_full = dequantize_kv(cv, cv_scale, q.dtype)
@@ -376,7 +398,7 @@ def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
         k_full, v_full = ck, cv
         new_cache = (ck, cv)
     attn = attention_ops.xla_attention_with_mask(q, k_full, v_full,
-                                                 valid[:, None, None, :])
+                                                 valid[:, None])
     return attn, new_cache
 
 
@@ -416,10 +438,15 @@ def _layer(config: LlamaConfig, mesh: Optional[mesh_lib.Mesh],
     if kv_cache is not None:
         attn, new_cache = slot_cache_attend(
             q, k, v, kv_cache, cache_index=cache_index,
-            cache_positions=cache_positions)
+            cache_positions=cache_positions, window=c.sliding_window)
     elif c.attention_impl in ('ring', 'ulysses') and mesh is not None:
         # Context parallelism: sequence stays sharded through attention
         # (K/V ring over ICI neighbors or all-to-all head scatter).
+        if c.sliding_window is not None:
+            raise NotImplementedError(
+                'sliding_window is not implemented for ring/ulysses '
+                'context parallelism (a windowed model rarely needs '
+                'sequence sharding: its attention is already O(S·W)).')
         from skypilot_tpu.ops import ring_attention as ring_ops
         new_cache = (k, v) if return_kv else None
         attn = ring_ops.sequence_parallel_attention(
@@ -427,7 +454,8 @@ def _layer(config: LlamaConfig, mesh: Optional[mesh_lib.Mesh],
     else:
         new_cache = (k, v) if return_kv else None
         attn = attention_ops.dot_product_attention(
-            q, k, v, causal=True, implementation=c.attention_impl)
+            q, k, v, causal=True, implementation=c.attention_impl,
+            window=c.sliding_window)
 
     attn = attn.reshape(b, s, c.n_heads * hd)
     x = x + shard(_ckpt_name(attn @ layer_params['wo'], 'attn_o'),

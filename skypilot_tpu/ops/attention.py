@@ -30,8 +30,13 @@ def xla_attention(q: jax.Array,
                   k: jax.Array,
                   v: jax.Array,
                   causal: bool = True,
-                  segment_ids: Optional[jax.Array] = None) -> jax.Array:
-    """Reference attention in pure XLA (fp32 softmax)."""
+                  segment_ids: Optional[jax.Array] = None,
+                  window: Optional[int] = None) -> jax.Array:
+    """Reference attention in pure XLA (fp32 softmax).
+
+    window: sliding-window size W (Mistral-style) — each query attends
+    to at most the W most recent positions (inclusive of itself).
+    """
     b, s_q, h, d = q.shape
     s_kv = k.shape[1]
     groups = h // k.shape[2]
@@ -40,10 +45,13 @@ def xla_attention(q: jax.Array,
     scale = d ** -0.5
     logits = jnp.einsum('bqhd,bkhd->bhqk', q, k,
                         preferred_element_type=jnp.float32) * scale
-    if causal:
+    if causal or window is not None:
         q_pos = jnp.arange(s_q)[:, None] + (s_kv - s_q)
         kv_pos = jnp.arange(s_kv)[None, :]
-        mask = q_pos >= kv_pos
+        mask = (q_pos >= kv_pos if causal
+                else jnp.ones((s_q, s_kv), bool))
+        if window is not None:
+            mask &= (q_pos - kv_pos) < window
         logits = jnp.where(mask[None, None], logits, -1e30)
     if segment_ids is not None:
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
@@ -75,10 +83,13 @@ def dot_product_attention(q: jax.Array,
                           v: jax.Array,
                           causal: bool = True,
                           segment_ids: Optional[jax.Array] = None,
-                          implementation: str = 'auto') -> jax.Array:
+                          implementation: str = 'auto',
+                          window: Optional[int] = None) -> jax.Array:
     """Dispatching attention entry point used by the models.
 
-    implementation: 'auto' | 'xla' | 'flash'.
+    implementation: 'auto' | 'xla' | 'flash'; window: sliding-window
+    size (both paths support it; flash also SKIPS the out-of-window
+    blocks, so long-context sliding-window runs in O(S·W)).
     """
     if implementation == 'auto':
         # device_kind, not platform: TPU chips reached through a remote
@@ -93,5 +104,7 @@ def dot_product_attention(q: jax.Array,
         implementation = 'flash' if use_flash else 'xla'
     if implementation == 'flash':
         from skypilot_tpu.ops import flash_attention
-        return flash_attention.flash_attention(q, k, v, causal=causal)
-    return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+        return flash_attention.flash_attention(q, k, v, causal=causal,
+                                               window=window)
+    return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids,
+                         window=window)

@@ -34,9 +34,23 @@ _NEG_INF = -1e30
 _LANES = 128  # row-stat scratch minor dim (TPU lane width)
 
 
+def _window_kv_first(qi, block_q: int, block_kv: int, window: int):
+    """First live KV block index for query block qi under a causal
+    sliding window (used by kernels AND BlockSpec index_maps, which
+    must agree exactly)."""
+    return jnp.maximum(0, (qi * block_q - (window - 1)) // block_kv)
+
+
+def _window_inner_blocks(num_kv: int, block_q: int, block_kv: int,
+                         window: int) -> int:
+    """Static inner-grid length: how many KV blocks a query block can
+    touch under a causal window (span = window + block_q - 1)."""
+    return min(num_kv, (window + block_q - 2) // block_kv + 2)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *, scale: float, causal: bool,
-                block_q: int, block_kv: int):
+                block_q: int, block_kv: int, window, num_kv_total: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     num_kv = pl.num_programs(2)
@@ -48,12 +62,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
 
     q_start = qi * block_q
-    kv_start = ki * block_kv
+    # Under a causal window the inner grid walks only the live KV
+    # blocks (see _window_kv_first): recover the true block index the
+    # BlockSpec index_map fetched.
+    if window is not None and causal:
+        kv_idx = _window_kv_first(qi, block_q, block_kv, window) + ki
+    else:
+        kv_idx = ki
+    kv_start = kv_idx * block_kv
 
-    # Whole block above the diagonal → nothing to do.
-    run = True
+    # Whole block above the diagonal (or entirely left of the sliding
+    # window) → nothing to do: with the remapped grid, out-of-window
+    # blocks are neither computed NOR fetched, so work and HBM traffic
+    # both scale O(S·W).
+    run = kv_idx < num_kv_total
     if causal:
-        run = q_start + block_q - 1 >= kv_start
+        run = run & (q_start + block_q - 1 >= kv_start)
+    if window is not None:
+        run = run & (kv_start + block_kv - 1 >= q_start - (window - 1))
 
     @pl.when(run)
     def _body():
@@ -62,13 +88,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bkv]
-        if causal:
-            # Mask only needed on diagonal-crossing blocks.
+        if causal or window is not None:
+            # Mask only needed on diagonal/window-crossing blocks.
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0)
             kv_pos = kv_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1)
-            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+            keep = q_pos >= kv_pos if causal else (q_pos == q_pos)
+            if window is not None:
+                keep = keep & (q_pos - kv_pos < window)
+            s = jnp.where(keep, s, _NEG_INF)
 
         m_prev = m_ref[:, 0:1]                         # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)      # [bq, 1]
@@ -93,7 +122,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
-               block_q: int, block_kv: int
+               block_q: int, block_kv: int, window=None
                ) -> Tuple[jax.Array, jax.Array]:
     """Returns (out [B,H,S,D], lse [B*H,S,LANES] lane-broadcast fp32).
 
@@ -106,7 +135,20 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
     block_kv = min(block_kv, s_kv)
     assert s % block_q == 0 and s_kv % block_kv == 0, (s, s_kv, block_q,
                                                       block_kv)
-    grid = (b * h, s // block_q, s_kv // block_kv)
+    num_kv_total = s_kv // block_kv
+    if window is not None and causal:
+        inner = _window_inner_blocks(num_kv_total, block_q, block_kv,
+                                     window)
+
+        def kv_map(bh, qi, ki):
+            first = _window_kv_first(qi, block_q, block_kv, window)
+            return (bh, jnp.minimum(first + ki, num_kv_total - 1), 0)
+    else:
+        inner = num_kv_total
+
+        def kv_map(bh, qi, ki):
+            return (bh, ki, 0)
+    grid = (b * h, s // block_q, inner)
     scale = d ** -0.5
 
     qr = q.reshape(b * h, s, d)
@@ -114,14 +156,15 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
     vr = v.reshape(b * h, s_kv, d)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_kv=block_kv)
+                               block_q=block_q, block_kv=block_kv,
+                               window=window, num_kv_total=num_kv_total)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_kv, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_kv, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_kv, d), kv_map),
+            pl.BlockSpec((1, block_kv, d), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
@@ -148,7 +191,7 @@ def _should_interpret() -> bool:
 
 def _block_p_ds(q, k, v, out, dout, lse_col, *, scale: float,
                 causal: bool, q_start, kv_start, block_q: int,
-                block_kv: int):
+                block_kv: int, window):
     """Shared P/dS recompute for both backward kernels.
 
     q/out/dout [bq, d]; k/v [bkv, d]; lse_col [bq, 1] fp32. The delta
@@ -162,12 +205,15 @@ def _block_p_ds(q, k, v, out, dout, lse_col, *, scale: float,
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale        # [bq, bkv]
-    if causal:
+    if causal or window is not None:
         q_pos = q_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 0)
         kv_pos = kv_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 1)
-        s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+        keep = q_pos >= kv_pos if causal else (q_pos == q_pos)
+        if window is not None:
+            keep = keep & (q_pos - kv_pos < window)
+        s = jnp.where(keep, s, _NEG_INF)
     p = jnp.exp(s - lse_col)                               # [bq, bkv]
     dp = jax.lax.dot_general(
         dout, v, (((1,), (1,)), ((), ())),
@@ -178,7 +224,8 @@ def _block_p_ds(q, k, v, out, dout, lse_col, *, scale: float,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
-                    causal: bool, block_q: int, block_kv: int):
+                    causal: bool, block_q: int, block_kv: int, window,
+                    num_q_total: int):
     kvi = pl.program_id(1)
     qi = pl.program_id(2)
     num_q = pl.num_programs(2)
@@ -188,11 +235,19 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    q_start = qi * block_q
     kv_start = kvi * block_kv
-    run = True
+    if window is not None and causal:
+        # First live Q block for this KV block: the one containing
+        # kv_start (causal lower bound).
+        q_idx = kv_start // block_q + qi
+    else:
+        q_idx = qi
+    q_start = q_idx * block_q
+    run = q_idx < num_q_total
     if causal:
-        run = q_start + block_q - 1 >= kv_start
+        run = run & (q_start + block_q - 1 >= kv_start)
+    if window is not None:
+        run = run & (kv_start + block_kv - 1 >= q_start - (window - 1))
 
     @pl.when(run)
     def _body():
@@ -202,7 +257,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
             q, k_ref[0], v_ref[0], out_ref[0], dout,
             lse_ref[0][:, 0:1], scale=scale,
             causal=causal, q_start=q_start, kv_start=kv_start,
-            block_q=block_q, block_kv=block_kv)
+            block_q=block_q, block_kv=block_kv, window=window)
         # dv += Pᵀ dO ; dk += dSᵀ Q  (contract the q dim, bf16 on MXU)
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p.astype(dout.dtype), dout, (((0,), (0,)), ((), ())),
@@ -219,7 +274,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
                    dq_ref, dq_acc, *, scale: float, causal: bool,
-                   block_q: int, block_kv: int):
+                   block_q: int, block_kv: int, window,
+                   num_kv_total: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     num_kv = pl.num_programs(2)
@@ -229,10 +285,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     q_start = qi * block_q
-    kv_start = ki * block_kv
-    run = True
+    if window is not None and causal:
+        kv_idx = _window_kv_first(qi, block_q, block_kv, window) + ki
+    else:
+        kv_idx = ki
+    kv_start = kv_idx * block_kv
+    run = kv_idx < num_kv_total
     if causal:
-        run = q_start + block_q - 1 >= kv_start
+        run = run & (q_start + block_q - 1 >= kv_start)
+    if window is not None:
+        run = run & (kv_start + block_kv - 1 >= q_start - (window - 1))
 
     @pl.when(run)
     def _body():
@@ -241,7 +303,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
             q_ref[0], k, v_ref[0], out_ref[0], dout_ref[0],
             lse_ref[0][:, 0:1], scale=scale,
             causal=causal, q_start=q_start, kv_start=kv_start,
-            block_q=block_q, block_kv=block_kv)
+            block_q=block_q, block_kv=block_kv, window=window)
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -252,7 +314,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
 
 
 def _bwd_flash(residuals, dout, *, causal: bool, block_q: int,
-               block_kv: int):
+               block_kv: int, window):
     """FA2 backward: dKV kernel + dQ kernel from the saved LSE."""
     q, k, v, out, lse = residuals  # q/out [B,H,S,D]; k/v [B,H,Skv,D];
     b, h, s, d = q.shape           # lse [B*H,S,LANES] (fwd layout)
@@ -267,21 +329,51 @@ def _bwd_flash(residuals, dout, *, causal: bool, block_q: int,
     outr = out.reshape(b * h, s, d)
     dor = dout.reshape(b * h, s, d)
 
+    num_q_total = s // block_q
+    num_kv_total = s_kv // block_kv
+    windowed = window is not None and causal
+    if windowed:
+        # Inner sweeps walk only the live blocks (DMA included): work
+        # and traffic scale O(S·W) like the forward.
+        dq_inner = _window_inner_blocks(num_kv_total, block_q, block_kv,
+                                        window)
+        dkv_inner = min(num_q_total,
+                        (block_kv + window - 2) // block_q + 2)
+
+        def dq_kv_map(bh, i, j):
+            first = _window_kv_first(i, block_q, block_kv, window)
+            return (bh, jnp.minimum(first + j, num_kv_total - 1), 0)
+
+        def dkv_q_map(bh, j, i):
+            first = (j * block_kv) // block_q
+            return (bh, jnp.minimum(first + i, num_q_total - 1), 0)
+    else:
+        dq_inner = num_kv_total
+        dkv_inner = num_q_total
+
+        def dq_kv_map(bh, i, j):
+            return (bh, j, 0)
+
+        def dkv_q_map(bh, j, i):
+            return (bh, i, 0)
+
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
-    kv_spec = pl.BlockSpec((1, block_kv, d), lambda bh, i, j: (bh, j, 0))
+    kv_spec = pl.BlockSpec((1, block_kv, d), dq_kv_map)
     stat_spec = pl.BlockSpec((1, block_q, _LANES),
                              lambda bh, i, j: (bh, i, 0))
     # dKV: outer grid dim is the KV block, inner sweep walks Q blocks.
-    dkv_q_spec = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
+    dkv_q_spec = pl.BlockSpec((1, block_q, d), dkv_q_map)
     dkv_kv_spec = pl.BlockSpec((1, block_kv, d),
                                lambda bh, j, i: (bh, j, 0))
-    dkv_stat_spec = pl.BlockSpec((1, block_q, _LANES),
-                                 lambda bh, j, i: (bh, i, 0))
+    dkv_stat_spec = pl.BlockSpec(
+        (1, block_q, _LANES),
+        lambda bh, j, i: dkv_q_map(bh, j, i))
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_kv=block_kv),
-        grid=(b * h, s_kv // block_kv, s // block_q),
+                          block_q=block_q, block_kv=block_kv,
+                          window=window, num_q_total=num_q_total),
+        grid=(b * h, s_kv // block_kv, dkv_inner),
         in_specs=[dkv_q_spec, dkv_kv_spec, dkv_kv_spec, dkv_q_spec,
                   dkv_q_spec, dkv_stat_spec],
         out_specs=[
@@ -301,8 +393,9 @@ def _bwd_flash(residuals, dout, *, causal: bool, block_q: int,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_kv=block_kv),
-        grid=(b * h, s // block_q, s_kv // block_kv),
+                          block_q=block_q, block_kv=block_kv,
+                          window=window, num_kv_total=num_kv_total),
+        grid=(b * h, s // block_q, dq_inner),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, stat_spec],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
@@ -316,22 +409,22 @@ def _bwd_flash(residuals, dout, *, causal: bool, block_q: int,
             dv.reshape(b, h, s_kv, d))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_bhsd(q, k, v, causal, block_q, block_kv):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, causal, block_q, block_kv, window):
     out, _ = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
-                        block_kv=block_kv)
+                        block_kv=block_kv, window=window)
     return out
 
 
-def _flash_bhsd_fwd(q, k, v, causal, block_q, block_kv):
+def _flash_bhsd_fwd(q, k, v, causal, block_q, block_kv, window):
     out, lse = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
-                          block_kv=block_kv)
+                          block_kv=block_kv, window=window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bhsd_bwd(causal, block_q, block_kv, residuals, dout):
+def _flash_bhsd_bwd(causal, block_q, block_kv, window, residuals, dout):
     return _bwd_flash(residuals, dout, causal=causal, block_q=block_q,
-                      block_kv=block_kv)
+                      block_kv=block_kv, window=window)
 
 
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
@@ -340,8 +433,12 @@ _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True,
                     block_q: int = DEFAULT_BLOCK_Q,
-                    block_kv: int = DEFAULT_BLOCK_KV) -> jax.Array:
-    """Flash attention; q [B,S,H,D], k/v [B,S,Hkv,D] (GQA) → [B,S,H,D]."""
+                    block_kv: int = DEFAULT_BLOCK_KV,
+                    window=None) -> jax.Array:
+    """Flash attention; q [B,S,H,D], k/v [B,S,Hkv,D] (GQA) → [B,S,H,D].
+
+    window: Mistral-style sliding window — out-of-window blocks are
+    skipped entirely, so work scales O(S·W) instead of O(S²)."""
     b, s, h, d = q.shape
     h_kv = k.shape[2]
     groups = h // h_kv
@@ -353,5 +450,5 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         # head *indices* (gather, not materialized broadcast, under jit).
         kt = jnp.repeat(kt, groups, axis=1)
         vt = jnp.repeat(vt, groups, axis=1)
-    out = _flash_bhsd(qt, kt, vt, causal, block_q, block_kv)
+    out = _flash_bhsd(qt, kt, vt, causal, block_q, block_kv, window)
     return jnp.transpose(out, (0, 2, 1, 3))
