@@ -429,7 +429,7 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                   timeout_s: float = 3600.0,
                   poll_s: float = 0.3) -> job_lib.JobStatus:
         deadline = time.time() + timeout_s
-        probe_failures = 0
+        record_gone = 0
         while time.time() < deadline:
             status = self.get_job_status(handle, job_id)
             if status is not None and status.is_terminal():
@@ -438,19 +438,22 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                         f'Job {job_id} finished with {status.value}. '
                         f'Logs:\n{self.tail_logs(handle, job_id, False)}')
                 return status
-            if status is None:
-                # Probe failed: tolerate transient hiccups, but if the
-                # cluster record is gone (concurrent `down`, preemption
-                # reconciled) stop polling a corpse.
-                probe_failures += 1
-                if probe_failures >= 3 and state.get_cluster_from_name(
-                        handle.cluster_name) is None:
+            # A gone cluster record (concurrent `down`, preemption
+            # reconciliation) is decisive: stop polling — a job racing a
+            # teardown can leave a recreated jobs.db claiming a frozen
+            # non-terminal status (e.g. INIT whose runner never spawned
+            # because its host dir died under it), so the status alone
+            # must never keep this loop alive. A few grace probes only
+            # to be safe against torn reads.
+            if state.get_cluster_from_name(handle.cluster_name) is None:
+                record_gone += 1
+                if record_gone >= 3:
                     raise exceptions.ClusterDoesNotExist(
                         f'Cluster {handle.cluster_name!r} disappeared '
                         f'while waiting for job {job_id} (torn down or '
                         'preempted).')
             else:
-                probe_failures = 0
+                record_gone = 0
             time.sleep(poll_s)
         raise TimeoutError(f'Job {job_id} did not finish in {timeout_s}s')
 

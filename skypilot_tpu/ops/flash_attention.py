@@ -365,9 +365,7 @@ def _bwd_flash(residuals, dout, *, causal: bool, block_q: int,
     dkv_q_spec = pl.BlockSpec((1, block_q, d), dkv_q_map)
     dkv_kv_spec = pl.BlockSpec((1, block_kv, d),
                                lambda bh, j, i: (bh, j, 0))
-    dkv_stat_spec = pl.BlockSpec(
-        (1, block_q, _LANES),
-        lambda bh, j, i: dkv_q_map(bh, j, i))
+    dkv_stat_spec = pl.BlockSpec((1, block_q, _LANES), dkv_q_map)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,

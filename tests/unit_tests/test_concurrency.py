@@ -76,12 +76,15 @@ class TestLaunchRaces:
                     exceptions.CommandError) as e:
                 launch_result['r'] = e
 
-        t1 = threading.Thread(target=do_down)
-        t2 = threading.Thread(target=do_launch)
+        t1 = threading.Thread(target=do_down, daemon=True)
+        t2 = threading.Thread(target=do_launch, daemon=True)
         t1.start()
         t2.start()
-        t1.join(timeout=120)
-        t2.join(timeout=120)
+        # Generous joins: a racing fake-cluster relaunch spawns real
+        # local processes and can crawl when CI shares the box with
+        # other suites; 120 s flaked once under 3-way parallel load.
+        t1.join(timeout=300)
+        t2.join(timeout=300)
         if 'r' not in launch_result:
             import faulthandler
             import sys
