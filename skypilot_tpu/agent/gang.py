@@ -276,6 +276,14 @@ def _poll_gang(procs, returncodes, retried, _start, start_time, deadline,
                 retried[i] = True
                 logger.warning(f'Host {i}: ssh start failed (rc 255); '
                                'retrying once.')
+                # The dead Popen is being replaced: drop it from
+                # ACTIVE_PROCS now, or it leaks there for the life of
+                # the runner (the finally block only removes the
+                # *current* procs).
+                try:
+                    ACTIVE_PROCS.remove(p)
+                except ValueError:
+                    pass
                 try:
                     procs[i] = _start(i)
                 except Exception as e:  # pylint: disable=broad-except

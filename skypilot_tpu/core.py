@@ -245,8 +245,16 @@ def cost_report() -> List[Dict[str, Any]]:
         resources, rate = _rate_of(record['handle'])
         if resources is None:
             continue
-        hours = state.billed_seconds(
-            record.get('usage_intervals')) / 3600.0
+        intervals = record.get('usage_intervals')
+        if (not intervals and record.get('launched_at')
+                and record['status'] != state.ClusterStatus.STOPPED):
+            # Rows created before the usage_intervals migration have no
+            # recorded intervals; fall back to wall-clock since launch
+            # rather than reporting a live cluster as zero-cost. STOPPED
+            # rows are excluded: their clock is paused and the stop time
+            # was never recorded, so an open interval would overbill.
+            intervals = [(record['launched_at'], None)]
+        hours = state.billed_seconds(intervals) / 3600.0
         out.append({
             'name': record['name'],
             'resources': str(resources),

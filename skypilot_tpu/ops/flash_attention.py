@@ -15,8 +15,12 @@ computed the full S×S rectangle and materialized P in fp32 — at seq 8K
 that doubled the attention FLOPs and blew HBM; the kernels keep P in
 VMEM and run the matmuls in bf16 with fp32 accumulation).
 
-Layout convention: q [B, S, H, D], k/v [B, S, Hkv, D] (GQA supported by
-logical head replication, resolved without materialization).
+Layout convention: q [B, S, H, D], k/v [B, S, Hkv, D]. GQA is native:
+K/V stay at their Hkv width in HBM and every kernel resolves the shared
+KV head inside its BlockSpec index_map (`bh // groups`), so a 4-group
+Llama-3 config streams K/V once instead of four times; the dKV kernel
+sweeps the group's query heads in an extra grid dimension so dk/dv
+accumulate in VMEM without a reduction pass over replicated heads.
 """
 from __future__ import annotations
 
@@ -126,10 +130,16 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
                ) -> Tuple[jax.Array, jax.Array]:
     """Returns (out [B,H,S,D], lse [B*H,S,LANES] lane-broadcast fp32).
 
+    q is [B,H,S,D]; k/v are [B,Hkv,S,D] — the shared KV head for query
+    head bh is fetched via `bh // groups` in the KV index_map, so GQA
+    streams each K/V block from HBM once per group, not once per head.
+
     The LSE stays in the kernels' natural lane-broadcast layout: the
     backward kernels consume it directly, so no reshape/transpose or
     re-broadcast ever touches HBM."""
     b, h, s, d = q.shape
+    h_kv = k.shape[1]
+    groups = h // h_kv
     s_kv = k.shape[2]
     block_q = min(block_q, s)
     block_kv = min(block_kv, s_kv)
@@ -142,18 +152,19 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
 
         def kv_map(bh, qi, ki):
             first = _window_kv_first(qi, block_q, block_kv, window)
-            return (bh, jnp.minimum(first + ki, num_kv_total - 1), 0)
+            return (bh // groups,
+                    jnp.minimum(first + ki, num_kv_total - 1), 0)
     else:
         inner = num_kv_total
 
         def kv_map(bh, qi, ki):
-            return (bh, ki, 0)
+            return (bh // groups, ki, 0)
     grid = (b * h, s // block_q, inner)
     scale = d ** -0.5
 
     qr = q.reshape(b * h, s, d)
-    kr = k.reshape(b * h, s_kv, d)
-    vr = v.reshape(b * h, s_kv, d)
+    kr = k.reshape(b * h_kv, s_kv, d)
+    vr = v.reshape(b * h_kv, s_kv, d)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_kv=block_kv,
@@ -226,11 +237,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
                     causal: bool, block_q: int, block_kv: int, window,
                     num_q_total: int):
+    """Grid (B*Hkv, KV-blocks, groups, Q-blocks): the two inner sweeps
+    walk every query head sharing this KV head and that head's live Q
+    blocks, so the GQA gradient reduction (dk/dv summed over the group)
+    happens in the VMEM accumulators — no replicated-head HBM pass."""
     kvi = pl.program_id(1)
-    qi = pl.program_id(2)
-    num_q = pl.num_programs(2)
+    gi = pl.program_id(2)
+    qi = pl.program_id(3)
+    num_g = pl.num_programs(2)
+    num_q = pl.num_programs(3)
 
-    @pl.when(qi == 0)
+    @pl.when((gi == 0) & (qi == 0))
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -266,7 +283,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(qi == num_q - 1)
+    @pl.when((gi == num_g - 1) & (qi == num_q - 1))
     def _finalize():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -315,17 +332,24 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
 
 def _bwd_flash(residuals, dout, *, causal: bool, block_q: int,
                block_kv: int, window):
-    """FA2 backward: dKV kernel + dQ kernel from the saved LSE."""
-    q, k, v, out, lse = residuals  # q/out [B,H,S,D]; k/v [B,H,Skv,D];
-    b, h, s, d = q.shape           # lse [B*H,S,LANES] (fwd layout)
+    """FA2 backward: dKV kernel + dQ kernel from the saved LSE.
+
+    q/out/dout are [B,H,S,D]; k/v are [B,Hkv,Skv,D]. dQ resolves the
+    shared KV head via `bh // groups` like the forward; dKV runs one
+    program per KV head and sweeps (group, Q-block) inner grid dims so
+    dk/dv come out at their native Hkv width."""
+    q, k, v, out, lse = residuals  # lse [B*H,S,LANES] (fwd layout)
+    b, h, s, d = q.shape
+    h_kv = k.shape[1]
+    groups = h // h_kv
     s_kv = k.shape[2]
     scale = d ** -0.5
     block_q = min(block_q, s)
     block_kv = min(block_kv, s_kv)
 
     qr = q.reshape(b * h, s, d)
-    kr = k.reshape(b * h, s_kv, d)
-    vr = v.reshape(b * h, s_kv, d)
+    kr = k.reshape(b * h_kv, s_kv, d)
+    vr = v.reshape(b * h_kv, s_kv, d)
     outr = out.reshape(b * h, s, d)
     dor = dout.reshape(b * h, s, d)
 
@@ -342,45 +366,50 @@ def _bwd_flash(residuals, dout, *, causal: bool, block_q: int,
 
         def dq_kv_map(bh, i, j):
             first = _window_kv_first(i, block_q, block_kv, window)
-            return (bh, jnp.minimum(first + j, num_kv_total - 1), 0)
+            return (bh // groups,
+                    jnp.minimum(first + j, num_kv_total - 1), 0)
 
-        def dkv_q_map(bh, j, i):
+        def dkv_q_map(bh, j, g, i):
             first = (j * block_kv) // block_q
-            return (bh, jnp.minimum(first + i, num_q_total - 1), 0)
+            return (bh * groups + g,
+                    jnp.minimum(first + i, num_q_total - 1), 0)
     else:
         dq_inner = num_kv_total
         dkv_inner = num_q_total
 
         def dq_kv_map(bh, i, j):
-            return (bh, j, 0)
+            return (bh // groups, j, 0)
 
-        def dkv_q_map(bh, j, i):
-            return (bh, i, 0)
+        def dkv_q_map(bh, j, g, i):
+            return (bh * groups + g, i, 0)
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
     kv_spec = pl.BlockSpec((1, block_kv, d), dq_kv_map)
     stat_spec = pl.BlockSpec((1, block_q, _LANES),
                              lambda bh, i, j: (bh, i, 0))
-    # dKV: outer grid dim is the KV block, inner sweep walks Q blocks.
+    # dKV: outer grid dims are (KV head, KV block); the inner sweeps
+    # walk (query head in group, Q block).
     dkv_q_spec = pl.BlockSpec((1, block_q, d), dkv_q_map)
     dkv_kv_spec = pl.BlockSpec((1, block_kv, d),
-                               lambda bh, j, i: (bh, j, 0))
+                               lambda bh, j, g, i: (bh, j, 0))
     dkv_stat_spec = pl.BlockSpec((1, block_q, _LANES), dkv_q_map)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_kv=block_kv,
                           window=window, num_q_total=num_q_total),
-        grid=(b * h, s_kv // block_kv, dkv_inner),
+        grid=(b * h_kv, s_kv // block_kv, groups, dkv_inner),
         in_specs=[dkv_q_spec, dkv_kv_spec, dkv_kv_spec, dkv_q_spec,
                   dkv_q_spec, dkv_stat_spec],
         out_specs=[
-            pl.BlockSpec((1, block_kv, d), lambda bh, j, i: (bh, j, 0)),
-            pl.BlockSpec((1, block_kv, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_kv, d),
+                         lambda bh, j, g, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_kv, d),
+                         lambda bh, j, g, i: (bh, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, s_kv, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, s_kv, d), v.dtype),
+            jax.ShapeDtypeStruct((b * h_kv, s_kv, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h_kv, s_kv, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_kv, d), jnp.float32),
@@ -403,8 +432,8 @@ def _bwd_flash(residuals, dout, *, causal: bool, block_q: int,
         interpret=_should_interpret(),
     )(qr, kr, vr, outr, dor, lse)[0]
 
-    return (dq.reshape(b, h, s, d), dk.reshape(b, h, s_kv, d),
-            dv.reshape(b, h, s_kv, d))
+    return (dq.reshape(b, h, s, d), dk.reshape(b, h_kv, s_kv, d),
+            dv.reshape(b, h_kv, s_kv, d))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -439,14 +468,12 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     skipped entirely, so work scales O(S·W) instead of O(S²)."""
     b, s, h, d = q.shape
     h_kv = k.shape[2]
-    groups = h // h_kv
+    assert h % h_kv == 0, (h, h_kv)
+    # K/V stay at Hkv width; the kernels' index_maps resolve the shared
+    # KV head (bh // groups), so GQA reads each K/V block once per
+    # group instead of once per query head.
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    if groups > 1:
-        # Fold the group into the batch of the kernel grid by repeating KV
-        # head *indices* (gather, not materialized broadcast, under jit).
-        kt = jnp.repeat(kt, groups, axis=1)
-        vt = jnp.repeat(vt, groups, axis=1)
     out = _flash_bhsd(qt, kt, vt, causal, block_q, block_kv, window)
     return jnp.transpose(out, (0, 2, 1, 3))

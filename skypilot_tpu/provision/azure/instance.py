@@ -343,10 +343,21 @@ def wait_instances(region: str, cluster_name: str, state: str,
                    timeout_s: float = 600.0,
                    poll_interval_s: float = 5.0) -> None:
     t = _transport(provider_config or {'region': region})
-    want = 'RUNNING' if state == 'RUNNING' else state
+    deadline = time.time() + timeout_s
+    # ARM list calls can return a stale empty page right after create
+    # (create-vs-list visibility race): poll until the baseline set is
+    # non-empty instead of either raising on one stale read or burning
+    # the whole timeout against an `all(...)` that can never succeed.
     expected = {vm['name'] for vm in _list_vms(t, cluster_name,
                                                expand_view=False)}
-    deadline = time.time() + timeout_s
+    while not expected and time.time() < deadline:
+        time.sleep(poll_interval_s)
+        expected = {vm['name'] for vm in _list_vms(t, cluster_name,
+                                                   expand_view=False)}
+    if not expected:
+        raise exceptions.ProvisionError(
+            f'Cluster {cluster_name!r} has no VMs to wait on (resource '
+            'group empty or never became visible).')
     while time.time() < deadline:
         vms = _list_vms(t, cluster_name)
         alive = {vm['name'] for vm in vms}
@@ -355,7 +366,7 @@ def wait_instances(region: str, cluster_name: str, state: str,
             raise exceptions.CapacityError(
                 f'VM(s) {sorted(lost)} disappeared while waiting for '
                 f'{state} (spot eviction during boot?).')
-        if vms and all(_power_state(vm) == want for vm in vms):
+        if vms and all(_power_state(vm) == state for vm in vms):
             return
         time.sleep(poll_interval_s)
     raise exceptions.ProvisionError(
@@ -468,7 +479,10 @@ def open_ports(cluster_name: str, ports: List[str],
     next_priority = 1100
     for port in ports:
         lo, _, hi = str(port).partition('-')
-        name = f'xsky-port-{lo}'
+        # The range's upper bound is part of the identity: '8080' and
+        # '8080-8090' must not collapse to one rule name, or the wider
+        # range is silently skipped as already-open.
+        name = f'xsky-port-{lo}-{hi}' if hi else f'xsky-port-{lo}'
         if name in have:
             continue
         while next_priority in used:
