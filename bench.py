@@ -311,6 +311,7 @@ def _supervise(argv) -> int:
                 # Drain the pipe: the sentinel may still be in flight.
                 pump.join(timeout=10)
                 break
+        init_done = time.monotonic()
         if not devices_ok.is_set():
             if proc.poll() is None:
                 _kill()
@@ -326,7 +327,9 @@ def _supervise(argv) -> int:
                              f'rc={proc.returncode} before device init',
                     'stage': 'backend_init'}
         else:
-            remaining = run_timeout - (time.monotonic() - start)
+            # The measurement window starts once devices are up — a
+            # slow-but-successful init must not eat into it.
+            remaining = run_timeout - (time.monotonic() - init_done)
             try:
                 proc.wait(timeout=max(remaining, 1.0))
             except subprocess.TimeoutExpired:

@@ -2,8 +2,14 @@
 local/fake and SSH clusters). Twin of the reference's codegen-over-SSH
 pattern (sky/skylet/job_lib.py codegen + sky/jobs/utils.py ManagedJobCodeGen).
 
-Commands: add | status | queue | cancel | tail | run-detached.
+Commands: add | status | queue | cancel | tail | watch | run-detached.
 Spec payloads travel base64(json) to survive shell quoting.
+
+`watch JOB OFFSET` is the launch-wait hot path: one invocation returns
+the job status AND the next chunk of run.log past OFFSET (base64, so
+arbitrary bytes survive the SSH text channel) in a single JSON line —
+the backend's wait loop costs one remote exec per poll instead of one
+for status plus one for logs.
 """
 from __future__ import annotations
 
@@ -55,6 +61,24 @@ def main(argv=None) -> int:
     if cmd == 'cancel':
         ok = job_lib.cancel_job(int(argv[1]), root)
         print('cancelled' if ok else 'noop')
+        return 0
+
+    if cmd == 'watch':
+        job_id, offset = int(argv[1]), int(argv[2])
+        job = job_lib.get_job(job_id, root)
+        status = job['status'].value if job else 'NOT_FOUND'
+        log_path = os.path.join(job_lib.log_dir_for(job_id, root),
+                                'run.log')
+        chunk = b''
+        if os.path.exists(log_path) and offset >= 0:
+            with open(log_path, 'rb') as f:
+                f.seek(offset)
+                chunk = f.read(262144)
+        print(json.dumps({
+            'status': status,
+            'offset': offset + len(chunk),
+            'log': base64.b64encode(chunk).decode(),
+        }))
         return 0
 
     if cmd == 'tail':

@@ -14,6 +14,7 @@ import getpass
 import json
 import os
 import shlex
+import sys
 import tempfile
 import time
 import typing
@@ -425,14 +426,84 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             raise exceptions.CommandError(rc, 'job_cli run-detached', err)
         return job_id
 
+    def _watch_job(self, handle: ClusterHandle, job_id: int,
+                   offset: int) -> Optional[Dict[str, Any]]:
+        """One remote exec → {'status', 'offset', 'log'(bytes)} or None
+        on a failed probe (teardown race / transient ssh)."""
+        head = handle.head_runner()
+        rc, out, _ = head.run(
+            f'{self._head_python(handle)} -m skypilot_tpu.agent.job_cli '
+            f'watch {job_id} {offset}',
+            env=self._agent_env(handle), require_outputs=True)
+        if rc != 0:
+            return None
+        try:
+            rec = json.loads(out.strip().splitlines()[-1])
+            rec['log'] = base64.b64decode(rec.get('log', ''))
+            return rec
+        except (ValueError, KeyError, IndexError):
+            # Includes rc==0 with empty stdout (transient runner hiccup).
+            return None
+
     def _wait_job(self, handle: ClusterHandle, job_id: int,
                   timeout_s: float = 3600.0,
-                  poll_s: float = 0.3) -> job_lib.JobStatus:
+                  stream_logs: bool = True) -> job_lib.JobStatus:
+        """Wait for a job, live-tailing run.log (rank-0) as it runs.
+
+        Each poll is ONE remote exec (`job_cli watch`) returning status
+        + the next log chunk, and the interval backs off 0.3 s → 3 s
+        while the job is quiet — on a real cluster every probe is an
+        ssh exec + interpreter start (seconds), so the old fixed 0.3 s
+        status-only poll hammered the head and still showed no output
+        until failure.
+        """
         deadline = time.time() + timeout_s
         record_gone = 0
+        offset = 0
+        interval = 0.3
+        status: Optional[job_lib.JobStatus] = None
         while time.time() < deadline:
-            status = self.get_job_status(handle, job_id)
+            rec = self._watch_job(handle, job_id, offset)
+            if rec is not None:
+                offset = rec['offset']
+                if rec['log'] and stream_logs:
+                    sys.stdout.write(
+                        rec['log'].decode('utf-8', errors='replace'))
+                    sys.stdout.flush()
+                    # Output is flowing: stay snappier, but never the
+                    # old hammer rate.
+                    interval = min(interval, 1.0)
+                status = (None if rec['status'] == 'NOT_FOUND'
+                          else job_lib.JobStatus(rec['status']))
             if status is not None and status.is_terminal():
+                # The job is terminal so run.log is finite: drain until
+                # an empty chunk (sanity-capped far above any real log;
+                # if ever hit, say so rather than dropping the tail).
+                # A transient probe failure is NOT end-of-log — retry a
+                # few times before giving up on the tail.
+                probe_failures = 0
+                for _ in range(4096):
+                    rec = self._watch_job(handle, job_id, offset)
+                    if rec is None:
+                        probe_failures += 1
+                        if probe_failures > 3:
+                            break
+                        time.sleep(0.5)
+                        continue
+                    probe_failures = 0
+                    if not rec['log']:
+                        break
+                    offset = rec['offset']
+                    if stream_logs:
+                        sys.stdout.write(
+                            rec['log'].decode('utf-8', errors='replace'))
+                        sys.stdout.flush()
+                else:
+                    if stream_logs:
+                        sys.stdout.write(
+                            '\n[xsky] log drain capped; full log via '
+                            '`xsky logs`\n')
+                        sys.stdout.flush()
                 if status != job_lib.JobStatus.SUCCEEDED:
                     raise exceptions.JobExitNonZeroError(
                         f'Job {job_id} finished with {status.value}. '
@@ -454,7 +525,8 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                         'preempted).')
             else:
                 record_gone = 0
-            time.sleep(poll_s)
+            time.sleep(interval)
+            interval = min(interval * 1.5, 3.0)
         raise TimeoutError(f'Job {job_id} did not finish in {timeout_s}s')
 
     # ---- job ops ----

@@ -323,6 +323,40 @@ def cost_report():
                               f"{r['total_cost']:.2f}"))
 
 
+@cli.command()
+@click.option('--kill', is_flag=True, default=False,
+              help='Kill every framework daemon (default: report only).')
+def reap(kill):
+    """Audit/kill ALL framework daemons (round-end hygiene sweep).
+
+    Lists every live job runner, serve controller, and API server —
+    healthy or leaked; it does not consult cluster records. With
+    --kill, TERMs each process group and escalates to KILL: a
+    scorched-earth sweep for round boundaries, because a surviving
+    chip-holding process turns the next benchmark run into
+    `UNAVAILABLE`. Do not --kill while workloads you care about run.
+    """
+    from skypilot_tpu.utils import reaper
+    if kill:
+        swept = reaper.reap()
+        survivors = 0
+        for rec in swept:
+            if rec.get('killed'):
+                click.echo(f"killed {rec['pid']}: {rec['cmdline']}")
+            else:
+                survivors += 1
+                click.echo(
+                    f"SURVIVED {rec['pid']}: {rec['cmdline']}")
+        if survivors:
+            raise SystemExit(1)
+    else:
+        found = reaper.find_framework_processes()
+        if not found:
+            click.echo('no framework processes running.')
+        for rec in found:
+            click.echo(f"{rec['pid']}: {rec['cmdline']}")
+
+
 # ---- jobs / serve / storage / api groups (wired as they land) -------------
 
 
@@ -410,11 +444,20 @@ def serve_status(service_names):
 
 @serve.command(name='logs')
 @click.argument('service_name')
-@click.argument('replica_id', type=int)
+@click.argument('replica_id', type=int, required=False)
 @click.option('--job-id', type=int, default=None)
-def serve_logs(service_name, replica_id, job_id):
+@click.option('--controller', is_flag=True, default=False,
+              help="The service controller's own log (diagnostics for "
+                   'a crashed control loop).')
+def serve_logs(service_name, replica_id, job_id, controller):
     """Tail one replica's logs (twin of `sky serve logs`)."""
     from skypilot_tpu.client import sdk
+    if controller:
+        click.echo(sdk.serve_controller_logs(service_name), nl=False)
+        return
+    if replica_id is None:
+        raise click.UsageError('REPLICA_ID is required unless '
+                               '--controller is given.')
     click.echo(sdk.serve_logs(service_name, replica_id, job_id=job_id),
                nl=False)
 

@@ -305,6 +305,16 @@ def serve_logs(service_name: str, replica_id: int,
     return serve_core.tail_logs(service_name, replica_id, job_id=job_id)
 
 
+def serve_controller_logs(service_name: str) -> str:
+    """The service controller's own stdout/stderr (crash diagnostics)."""
+    remote = _remote()
+    if remote is not None:
+        return remote._call('serve.controller_logs',
+                            {'service_name': service_name})
+    from skypilot_tpu.serve import core as serve_core
+    return serve_core.controller_logs(service_name)
+
+
 def serve_down(service_name: str) -> None:
     remote = _remote()
     if remote is not None:

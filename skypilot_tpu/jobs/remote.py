@@ -5,9 +5,10 @@ Twin of the reference's jobs-controller-as-a-cluster
 ManagedJobCodeGen): the API server provisions a dedicated controller
 cluster once, then forwards every jobs verb to it by running
 ``python -m skypilot_tpu.jobs.remote_exec <verb>`` on the controller
-head over the backend command runner. The managed-jobs DB, the
-scheduler, and all controller processes live on that cluster; the local
-host only relays requests.
+head over the backend command runner (shared relay:
+utils/controller_relay.py). The managed-jobs DB, the scheduler, and all
+controller processes live on that cluster; the local host only relays
+requests.
 
 Enabled with XSKY_JOBS_CONTROLLER_REMOTE=1 (or =<cluster-name>).
 Controller sizing comes from config key jobs.controller.resources.
@@ -16,90 +17,27 @@ from __future__ import annotations
 
 import json
 import os
-import shlex
 import tempfile
 import time
 from typing import Any, Dict, List, Optional
 
-from skypilot_tpu import config as config_lib
-from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
+from skypilot_tpu.utils import controller_relay
 
 logger = sky_logging.init_logger(__name__)
 
-_DEFAULT_CLUSTER = 'xsky-jobs-controller'
+_relay = controller_relay.ControllerRelay(
+    env_var='XSKY_JOBS_CONTROLLER_REMOTE',
+    default_cluster='xsky-jobs-controller',
+    config_key=('jobs', 'controller', 'resources'),
+    exec_module='skypilot_tpu.jobs.remote_exec',
+    task_name='jobs-controller',
+    payload_dir='.xsky/managed_tasks',
+    not_up_hint='launch a managed job first.')
 
-
-def cluster_name() -> str:
-    value = os.environ.get('XSKY_JOBS_CONTROLLER_REMOTE', '')
-    if value in ('', '0', '1'):
-        return _DEFAULT_CLUSTER
-    return value
-
-
-def _controller_task() -> task_lib.Task:
-    from skypilot_tpu import resources as resources_lib
-    overrides = config_lib.get_nested(
-        ('jobs', 'controller', 'resources'), {}) or {}
-    t = task_lib.Task('jobs-controller')
-    t.set_resources(resources_lib.Resources.from_yaml_config(overrides))
-    return t
-
-
-def ensure_controller_cluster(provision: bool = True) -> Any:
-    """Return the controller cluster's handle.
-
-    provision=True (mutating verbs: launch) brings the cluster up if
-    needed; read verbs pass False and get ClusterNotUpError instead of
-    provisioning infrastructure as a side effect.
-    """
-    from skypilot_tpu import execution
-    from skypilot_tpu import state as state_lib
-    name = cluster_name()
-    record = state_lib.get_cluster_from_name(name)
-    if record is not None and record['status'] == state_lib.ClusterStatus.UP:
-        return record['handle']
-    if not provision:
-        raise exceptions.ClusterNotUpError(
-            f'Jobs controller cluster {name!r} is not UP; launch a '
-            'managed job first.',
-            cluster_status=record['status'] if record else None)
-    _, handle = execution.launch(_controller_task(), cluster_name=name)
-    return handle
-
-
-def _backend_and_handle(provision: bool):
-    from skypilot_tpu.backends import tpu_gang_backend
-    handle = ensure_controller_cluster(provision)
-    return tpu_gang_backend.TpuGangBackend(), handle
-
-
-def _call(verb: str, *args: str,
-          payload_file: Optional[str] = None,
-          provision: bool = False) -> Any:
-    """Run remote_exec on the controller head, parse its JSON reply."""
-    backend, handle = _backend_and_handle(provision)
-    remote_args = list(args)
-    if payload_file is not None:
-        # Home-relative so every runner flavor (local host-root, ssh
-        # $HOME, k8s /root) resolves it consistently for both the rsync
-        # and the remote open().
-        remote_path = (f'.xsky/managed_tasks/'
-                       f'{os.path.basename(payload_file)}')
-        runner = handle.head_runner()
-        runner.run(f'mkdir -p {shlex.quote(os.path.dirname(remote_path))}')
-        runner.rsync(payload_file, remote_path, up=True)
-        remote_args.append(remote_path)
-    rc, stdout, stderr = backend.run_module_on_head(
-        handle, 'skypilot_tpu.jobs.remote_exec', verb, *remote_args)
-    if rc != 0:
-        raise exceptions.CommandError(
-            rc, f'jobs.remote_exec {verb}',
-            f'remote jobs controller failed: {stderr.strip()}')
-    # remote_exec prints exactly one JSON line last.
-    line = stdout.strip().splitlines()[-1]
-    return json.loads(line)
+cluster_name = _relay.cluster_name
+ensure_controller_cluster = _relay.ensure_controller_cluster
 
 
 def launch(task: task_lib.Task, name: Optional[str] = None,
@@ -110,15 +48,16 @@ def launch(task: task_lib.Task, name: Optional[str] = None,
         f.write(json.dumps(task.to_yaml_config()))
         local_path = f.name
     try:
-        reply = _call('submit', *(['--name', name] if name else []),
-                      payload_file=local_path, provision=True)
+        reply = _relay.call('submit',
+                            *(['--name', name] if name else []),
+                            payload_file=local_path, provision=True)
     finally:
         os.unlink(local_path)
     job_id = int(reply['job_id'])
     if wait:
         deadline = time.time() + timeout_s
         while time.time() < deadline:
-            row = _call('get', str(job_id))
+            row = _relay.call('get', str(job_id))
             if row and row.get('terminal'):
                 return job_id
             time.sleep(1.0)
@@ -128,12 +67,12 @@ def launch(task: task_lib.Task, name: Optional[str] = None,
 
 
 def queue() -> List[Dict[str, Any]]:
-    return _call('queue')
+    return _relay.call('queue')
 
 
 def cancel(job_id: int) -> None:
-    _call('cancel', str(job_id))
+    _relay.call('cancel', str(job_id))
 
 
 def tail_logs(job_id: int) -> str:
-    return _call('logs', str(job_id))['logs']
+    return _relay.call('logs', str(job_id))['logs']

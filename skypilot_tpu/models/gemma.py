@@ -168,7 +168,8 @@ def _layer(config: GemmaConfig, mesh: Optional[mesh_lib.Mesh],
     new_cache = None
     if kv_cache is not None:
         attn, new_cache = llama.slot_cache_attend(
-            q, k, v, kv_cache, cache_positions=cache_positions)
+            q, k, v, kv_cache, cache_positions=cache_positions,
+            mesh=mesh)
     else:
         if return_kv:
             new_cache = (k, v)

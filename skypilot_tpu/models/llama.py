@@ -16,12 +16,14 @@ Design (TPU-first):
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.ops import decode_attention as decode_ops
 from skypilot_tpu.parallel import mesh as mesh_lib
 
 Params = Dict[str, Any]
@@ -337,7 +339,7 @@ def write_cache_slot(cache_entry, values: jax.Array, slot) -> Any:
 
 def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
                       kv_cache, cache_index=None, cache_positions=None,
-                      window=None):
+                      window=None, mesh=None):
     """Write this step's K/V into the slot cache and attend over it.
 
     The decode-path cache contract shared by every family (llama, qwen,
@@ -383,6 +385,29 @@ def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
             cv_scale = jax.lax.dynamic_update_slice_in_dim(
                 cv_scale, v_scale_write, cache_index, axis=1)
         q_pos = cache_index + jnp.arange(s)[None, :]    # [1, s]
+    if quantized:
+        new_cache = ((ck, ck_scale), (cv, cv_scale))
+        cache_k: Any = (ck, ck_scale)
+        cache_v: Any = (cv, cv_scale)
+    else:
+        new_cache = (ck, cv)
+        cache_k, cache_v = ck, cv
+
+    if (cache_positions is not None and s == 1
+            and ck.shape[1] % min(decode_ops.DEFAULT_BLOCK_KV,
+                                  ck.shape[1]) == 0
+            and (mesh is None or decode_ops.shardable_on(
+                mesh, b, ck.shape[2]))
+            and os.environ.get('XSKY_DECODE_ATTN') != 'xla'):
+        # The serving hot path: Pallas kernel reads only each slot's
+        # live blocks (per-slot length bound via scalar prefetch) and
+        # dequantizes int8 entries in VMEM — the padded-cache XLA path
+        # below reads max_len rows per slot regardless of true length.
+        attn = decode_ops.decode_attention(
+            q, cache_k, cache_v, lengths=cache_positions + 1,
+            window=window, mesh=mesh)
+        return attn, new_cache
+
     # Per-QUERY validity (a multi-token step's earlier rows must not
     # see later rows, and each row carries its own window).
     kv_pos = jnp.arange(ck.shape[1])[None, None, :]     # [1, 1, K]
@@ -393,10 +418,8 @@ def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
     if quantized:
         k_full = dequantize_kv(ck, ck_scale, q.dtype)
         v_full = dequantize_kv(cv, cv_scale, q.dtype)
-        new_cache = ((ck, ck_scale), (cv, cv_scale))
     else:
         k_full, v_full = ck, cv
-        new_cache = (ck, cv)
     attn = attention_ops.xla_attention_with_mask(q, k_full, v_full,
                                                  valid[:, None])
     return attn, new_cache
@@ -438,7 +461,8 @@ def _layer(config: LlamaConfig, mesh: Optional[mesh_lib.Mesh],
     if kv_cache is not None:
         attn, new_cache = slot_cache_attend(
             q, k, v, kv_cache, cache_index=cache_index,
-            cache_positions=cache_positions, window=c.sliding_window)
+            cache_positions=cache_positions, window=c.sliding_window,
+            mesh=mesh)
     elif c.attention_impl in ('ring', 'ulysses') and mesh is not None:
         # Context parallelism: sequence stays sharded through attention
         # (K/V ring over ICI neighbors or all-to-all head scatter).

@@ -277,7 +277,8 @@ def _layer(config: MoEConfig, mesh: Optional[mesh_lib.Mesh], x: jax.Array,
     new_cache = None
     if kv_cache is not None:
         attn, new_cache = llama.slot_cache_attend(
-            q, k, v, kv_cache, cache_positions=cache_positions)
+            q, k, v, kv_cache, cache_positions=cache_positions,
+            mesh=mesh)
     elif c.attention_impl in ('ring', 'ulysses') and mesh is not None:
         from skypilot_tpu.ops import ring_attention as ring_ops
         if return_kv:

@@ -193,7 +193,8 @@ def _layer(config: QwenConfig, mesh: Optional[mesh_lib.Mesh],
 
     if kv_cache is not None:
         attn, new_cache = llama.slot_cache_attend(
-            q, k, v, kv_cache, cache_positions=cache_positions)
+            q, k, v, kv_cache, cache_positions=cache_positions,
+            mesh=mesh)
     else:
         new_cache = (k, v) if return_kv else None
         attn = attention_ops.dot_product_attention(

@@ -253,25 +253,49 @@ def _solve_exact(tasks, dag, candidates, minimize):
 
 
 def _solve_local_search(tasks, dag, candidates, minimize):
-    """Coordinate descent from the independent optimum; exact on trees in
-    one sweep, good approximation otherwise."""
-    chosen = {t: candidates[t][0] for t in tasks}
-    improved = True
-    sweeps = 0
-    while improved and sweeps < 10:
-        improved = False
-        sweeps += 1
+    """Multi-start coordinate descent for DAGs too large to enumerate.
+
+    Starts: the independent optimum, plus one colocation seed per cloud
+    (each task's cheapest candidate on that cloud, if any). Egress
+    coupling makes whole-DAG colocation the usual global optimum, and
+    descent from the independent optimum alone can stall one hop away
+    from it on multi-parent nodes (e.g. a diamond's sink)."""
+    def _descend(chosen):
+        improved, sweeps = True, 0
+        while improved and sweeps < 10:
+            improved = False
+            sweeps += 1
+            for t in tasks:
+                best = chosen[t]
+                best_obj = _assignment_objective(tasks, dag, chosen,
+                                                 minimize)
+                for cand in candidates[t]:
+                    chosen[t] = cand
+                    obj = _assignment_objective(tasks, dag, chosen,
+                                                minimize)
+                    if obj < best_obj - 1e-12:
+                        best, best_obj = cand, obj
+                        improved = True
+                chosen[t] = best
+        return chosen, _assignment_objective(tasks, dag, chosen, minimize)
+
+    starts = [{t: candidates[t][0] for t in tasks}]
+    clouds = {rc[0].cloud_name for t in tasks for rc in candidates[t]}
+    for cloud in sorted(c for c in clouds if c):
+        seed = {}
         for t in tasks:
-            best = chosen[t]
-            best_obj = _assignment_objective(tasks, dag, chosen, minimize)
-            for cand in candidates[t]:
-                chosen[t] = cand
-                obj = _assignment_objective(tasks, dag, chosen, minimize)
-                if obj < best_obj - 1e-12:
-                    best, best_obj = cand, obj
-                    improved = True
-            chosen[t] = best
-    return chosen
+            on_cloud = [rc for rc in candidates[t]
+                        if rc[0].cloud_name == cloud]
+            seed[t] = on_cloud[0] if on_cloud else candidates[t][0]
+        starts.append(seed)
+
+    best_choice, best_obj = None, float('inf')
+    for seed in starts:
+        chosen, obj = _descend(dict(seed))
+        if obj < best_obj:
+            best_choice, best_obj = chosen, obj
+    assert best_choice is not None
+    return best_choice
 
 
 def candidates_for_failover(

@@ -1,8 +1,11 @@
 """Serve API: up / status / down (twin of sky/serve/server/core.py).
 
-Controller placement note: as with managed jobs (jobs/core.py), the
-controller+LB process runs on the API-server host; replicas are ordinary
-clusters launched through the engine.
+Controller placement: by default the controller+LB process runs on the
+API-server host; with XSKY_SERVE_CONTROLLER_REMOTE set, every verb is
+relayed to a dedicated provisioned controller cluster (serve.remote,
+twin of sky-serve-controller.yaml.j2) that survives API-server
+restarts. Replicas are ordinary clusters launched through the engine
+either way.
 """
 from __future__ import annotations
 
@@ -19,27 +22,55 @@ from skypilot_tpu import task as task_lib
 from skypilot_tpu.serve import state as serve_state
 
 
+def _remote_mode() -> bool:
+    return bool(os.environ.get('XSKY_SERVE_CONTROLLER_REMOTE'))
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(('127.0.0.1', 0))
         return s.getsockname()[1]
 
 
+def controller_log_path(service_name: str) -> str:
+    root = os.path.expanduser(
+        os.environ.get('XSKY_SERVE_LOG_DIR', '~/.xsky/serve'))
+    return os.path.join(root, service_name, 'controller.log')
+
+
+def controller_logs(service_name: str) -> str:
+    """The service controller's own stdout/stderr (crash diagnostics)."""
+    if _remote_mode():
+        from skypilot_tpu.serve import remote as serve_remote
+        return serve_remote.controller_logs(service_name)
+    path = controller_log_path(service_name)
+    if not os.path.exists(path):
+        return ''
+    with open(path, encoding='utf-8', errors='replace') as f:
+        return f.read()
 
 
 def up(task: task_lib.Task, service_name: Optional[str] = None,
        wait_ready: bool = True, timeout_s: float = 120.0) -> str:
     if task.service is None:
         raise ValueError("Task has no 'service:' section.")
+    if _remote_mode():
+        from skypilot_tpu.serve import remote as serve_remote
+        return serve_remote.up(task, service_name, wait_ready, timeout_s)
     name = service_name or task.name or 'service'
     if serve_state.get_service(name) is not None:
         raise ValueError(f'Service {name!r} already exists.')
     lb_port = _free_port()
     serve_state.add_service(name, task.to_yaml_config(), lb_port)
-    proc = subprocess.Popen(
-        [sys.executable, '-m', 'skypilot_tpu.serve.controller', name],
-        env=dict(os.environ), start_new_session=True,
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    # Controller stdio goes to a per-service log file, not DEVNULL — a
+    # crashed controller must leave more than a FAILED status row.
+    log_path = controller_log_path(name)
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    with open(log_path, 'ab') as logf:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.serve.controller', name],
+            env=dict(os.environ), start_new_session=True,
+            stdout=logf, stderr=subprocess.STDOUT)
     serve_state.set_service_controller_pid(name, proc.pid)
     if wait_ready:
         deadline = time.time() + timeout_s
@@ -69,6 +100,10 @@ def update(task: task_lib.Task, service_name: str,
     """
     if task.service is None:
         raise ValueError("Task has no 'service:' section.")
+    if _remote_mode():
+        from skypilot_tpu.serve import remote as serve_remote
+        return serve_remote.update(task, service_name, wait_done,
+                                   timeout_s)
     record = serve_state.get_service(service_name)
     if record is None:
         raise ValueError(f'Service {service_name!r} not found.')
@@ -107,6 +142,9 @@ def update(task: task_lib.Task, service_name: str,
 
 def status(service_names: Optional[List[str]] = None
            ) -> List[Dict[str, Any]]:
+    if _remote_mode():
+        from skypilot_tpu.serve import remote as serve_remote
+        return serve_remote.status(service_names)
     records = serve_state.get_services()
     if service_names:
         records = [r for r in records if r['name'] in service_names]
@@ -129,6 +167,10 @@ def status(service_names: Optional[List[str]] = None
 
 
 def down(service_name: str) -> None:
+    if _remote_mode():
+        from skypilot_tpu.serve import remote as serve_remote
+        serve_remote.down(service_name)
+        return
     record = serve_state.get_service(service_name)
     if record is None:
         raise ValueError(f'Service {service_name!r} not found.')
@@ -153,6 +195,9 @@ def down(service_name: str) -> None:
 def tail_logs(service_name: str, replica_id: int,
               job_id: Optional[int] = None) -> str:
     """Log tail of one replica's cluster (twin of `sky serve logs`)."""
+    if _remote_mode():
+        from skypilot_tpu.serve import remote as serve_remote
+        return serve_remote.tail_logs(service_name, replica_id, job_id)
     if serve_state.get_service(service_name) is None:
         raise ValueError(f'Service {service_name!r} not found.')
     replicas = serve_state.get_replicas(service_name)

@@ -1,0 +1,121 @@
+"""Remote serve-controller mode: controller + LB on a provisioned cluster.
+
+Twin of the reference's serve-controller-as-a-cluster
+(sky/templates/sky-serve-controller.yaml.j2 + sky/serve/service.py:155):
+the API server provisions a dedicated controller cluster once, then
+forwards every serve verb to it by running
+``python -m skypilot_tpu.serve.remote_exec <verb>`` on the controller
+head over the backend command runner (shared relay:
+utils/controller_relay.py). The serve DB, every service's controller
+process, and the load balancers live on that cluster — an
+API-server-host crash no longer takes the services' control loops (or
+their traffic path) with it, and a restarted API server reattaches by
+relaying ``status`` to the still-running controller cluster.
+
+Enabled with XSKY_SERVE_CONTROLLER_REMOTE=1 (or =<cluster-name>).
+Controller sizing comes from config key serve.controller.resources.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.utils import controller_relay
+
+logger = sky_logging.init_logger(__name__)
+
+_relay = controller_relay.ControllerRelay(
+    env_var='XSKY_SERVE_CONTROLLER_REMOTE',
+    default_cluster='xsky-serve-controller',
+    config_key=('serve', 'controller', 'resources'),
+    exec_module='skypilot_tpu.serve.remote_exec',
+    task_name='serve-controller',
+    payload_dir='.xsky/serve_tasks',
+    not_up_hint='run `serve up` first.')
+
+cluster_name = _relay.cluster_name
+ensure_controller_cluster = _relay.ensure_controller_cluster
+
+
+def _head_host(handle) -> str:
+    # Local-process providers (fake, ssh-to-self) report fictitious
+    # cluster IPs; their LB really listens on this host's loopback.
+    if getattr(handle, 'is_local_provider', False):
+        return '127.0.0.1'
+    try:
+        ips = handle.cluster_info.get_feasible_ips()
+        if ips:
+            return ips[0]
+    except Exception:  # pylint: disable=broad-except
+        pass
+    return '127.0.0.1'
+
+
+def _payload_call(verb: str, task: task_lib.Task, *args: str,
+                  provision: bool) -> Any:
+    with tempfile.NamedTemporaryFile('w', suffix='.json',
+                                     prefix='xsky-serve-',
+                                     delete=False) as f:
+        f.write(json.dumps(task.to_yaml_config()))
+        local_path = f.name
+    try:
+        return _relay.call(verb, *args, payload_file=local_path,
+                           provision=provision)
+    finally:
+        os.unlink(local_path)
+
+
+def up(task: task_lib.Task, service_name: Optional[str],
+       wait_ready: bool, timeout_s: float) -> str:
+    reply = _payload_call(
+        'up', task, *(['--name', service_name] if service_name else []),
+        '--wait' if wait_ready else '--nowait', str(timeout_s),
+        provision=True)
+    return reply['service_name']
+
+
+def update(task: task_lib.Task, service_name: str, wait_done: bool,
+           timeout_s: float) -> int:
+    reply = _payload_call('update', task, service_name,
+                          '--wait' if wait_done else '--nowait',
+                          str(timeout_s), provision=False)
+    return int(reply['version'])
+
+
+def status(service_names: Optional[List[str]]) -> List[Dict[str, Any]]:
+    bh = _relay.backend_and_handle(provision=False)
+    reply = _relay.call('status', json.dumps(service_names or []),
+                        backend_and_handle=bh)
+    host = _head_host(bh[1])
+
+    def _rewrite(endpoint):
+        # The controller host reports loopback endpoints; rewrite to
+        # the controller cluster's address for off-host clients.
+        if not endpoint:
+            return endpoint
+        return f"{host}:{endpoint.rsplit(':', 1)[-1]}"
+
+    for record in reply:
+        record['endpoint'] = _rewrite(record.get('endpoint'))
+        for rep in record.get('replicas', []):
+            rep['endpoint'] = _rewrite(rep.get('endpoint'))
+    return reply
+
+
+def down(service_name: str) -> None:
+    _relay.call('down', service_name)
+
+
+def tail_logs(service_name: str, replica_id: int,
+              job_id: Optional[int]) -> str:
+    reply = _relay.call('logs', service_name, str(replica_id),
+                        str(job_id if job_id is not None else -1))
+    return reply['logs']
+
+
+def controller_logs(service_name: str) -> str:
+    return _relay.call('controller-logs', service_name)['logs']

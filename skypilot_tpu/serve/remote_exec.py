@@ -1,0 +1,74 @@
+"""CLI executed ON the serve-controller cluster head (remote mode).
+
+The local-host relay (serve.remote) invokes
+``python -m skypilot_tpu.serve.remote_exec <verb> [args]`` over the
+backend command runner; each verb performs the local-mode serve
+operation on the controller host and prints ONE JSON line. (Role of
+the reference's serve codegen run on the controller,
+sky/serve/serve_utils.py.)
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any
+
+
+def _print(obj: Any) -> None:
+    print(json.dumps(obj))
+
+
+def main(argv) -> int:
+    import os
+    # This host IS the controller; never recurse into remote mode.
+    os.environ['XSKY_SERVE_CONTROLLER_REMOTE'] = ''
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.serve import core as serve_core
+
+    verb, args = argv[0], argv[1:]
+    try:
+        if verb == 'up':
+            name = None
+            if args and args[0] == '--name':
+                name, args = args[1], args[2:]
+            wait_ready = args[0] == '--wait'
+            timeout_s = float(args[1])
+            with open(args[2], encoding='utf-8') as f:
+                task = task_lib.Task.from_yaml_config(json.load(f))
+            service = serve_core.up(task, service_name=name,
+                                    wait_ready=wait_ready,
+                                    timeout_s=timeout_s)
+            _print({'service_name': service})
+        elif verb == 'update':
+            service, wait_flag, timeout_s, path = args
+            with open(path, encoding='utf-8') as f:
+                task = task_lib.Task.from_yaml_config(json.load(f))
+            version = serve_core.update(task, service,
+                                        wait_done=wait_flag == '--wait',
+                                        timeout_s=float(timeout_s))
+            _print({'version': version})
+        elif verb == 'status':
+            names = json.loads(args[0]) if args else []
+            _print(serve_core.status(names or None))
+        elif verb == 'down':
+            serve_core.down(args[0])
+            _print({'ok': True})
+        elif verb == 'logs':
+            job_id = int(args[2])
+            _print({'logs': serve_core.tail_logs(
+                args[0], int(args[1]),
+                job_id=None if job_id < 0 else job_id)})
+        elif verb == 'controller-logs':
+            _print({'logs': serve_core.controller_logs(args[0])})
+        else:
+            _print({'error': f'unknown verb {verb}'})
+            return 2
+    except Exception as e:  # pylint: disable=broad-except
+        # Errors must cross the runner boundary as JSON, not tracebacks.
+        _print({'error': f'{type(e).__name__}: {e}'})
+        return 0
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))

@@ -182,6 +182,8 @@ _VERBS.update({
     'serve.down': _serve_verb('down', 'service_name'),
     'serve.logs': _serve_verb('tail_logs', 'service_name', 'replica_id',
                               job_id=None),
+    'serve.controller_logs': _serve_verb('controller_logs',
+                                         'service_name'),
     # User management (admin-only via users.rbac).
     'users.list': _module_verb(_USERS, 'list_users'),
     'users.create': _module_verb(_USERS, 'create_user', 'name', 'password',

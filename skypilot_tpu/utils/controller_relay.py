@@ -1,0 +1,116 @@
+"""Shared controller-cluster relay for jobs and serve remote modes.
+
+Both managed jobs and serve can run their controllers on a dedicated
+provisioned cluster (twins of the reference's jobs-controller.yaml.j2
+and sky-serve-controller.yaml.j2). The relay mechanics are identical —
+resolve/provision the controller cluster, optionally rsync a payload
+file up, run a `remote_exec` module on the head, parse its one-line
+JSON reply — so they live here once, parameterized by env var, cluster
+name, config key, and exec module.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shlex
+from typing import Any, Optional, Tuple
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+
+
+class ControllerRelay:
+
+    def __init__(self, *, env_var: str, default_cluster: str,
+                 config_key: Tuple[str, ...], exec_module: str,
+                 task_name: str, payload_dir: str,
+                 not_up_hint: str) -> None:
+        self.env_var = env_var
+        self.default_cluster = default_cluster
+        self.config_key = config_key
+        self.exec_module = exec_module
+        self.task_name = task_name
+        self.payload_dir = payload_dir
+        self.not_up_hint = not_up_hint
+
+    def cluster_name(self) -> str:
+        value = os.environ.get(self.env_var, '')
+        if value in ('', '0', '1'):
+            return self.default_cluster
+        return value
+
+    def _controller_task(self) -> task_lib.Task:
+        from skypilot_tpu import resources as resources_lib
+        overrides = config_lib.get_nested(self.config_key, {}) or {}
+        t = task_lib.Task(self.task_name)
+        t.set_resources(resources_lib.Resources.from_yaml_config(overrides))
+        return t
+
+    def ensure_controller_cluster(self, provision: bool = True) -> Any:
+        """Return the controller cluster's handle.
+
+        provision=True (mutating verbs) brings the cluster up if
+        needed; read verbs pass False and get ClusterNotUpError instead
+        of provisioning infrastructure as a side effect.
+        """
+        from skypilot_tpu import execution
+        from skypilot_tpu import state as state_lib
+        name = self.cluster_name()
+        record = state_lib.get_cluster_from_name(name)
+        if record is not None and \
+                record['status'] == state_lib.ClusterStatus.UP:
+            return record['handle']
+        if not provision:
+            raise exceptions.ClusterNotUpError(
+                f'Controller cluster {name!r} is not UP; '
+                f'{self.not_up_hint}',
+                cluster_status=record['status'] if record else None)
+        _, handle = execution.launch(self._controller_task(),
+                                     cluster_name=name)
+        return handle
+
+    def backend_and_handle(self, provision: bool):
+        from skypilot_tpu.backends import tpu_gang_backend
+        handle = self.ensure_controller_cluster(provision)
+        return tpu_gang_backend.TpuGangBackend(), handle
+
+    def call(self, verb: str, *args: str,
+             payload_file: Optional[str] = None,
+             provision: bool = False,
+             backend_and_handle: Optional[Tuple[Any, Any]] = None) -> Any:
+        """Run the exec module on the controller head; parse its reply.
+
+        Callers that already resolved (backend, handle) — e.g. to
+        derive the controller address — pass it in so the cluster
+        record is not re-resolved per call.
+        """
+        backend, handle = (backend_and_handle if backend_and_handle
+                           else self.backend_and_handle(provision))
+        remote_args = list(args)
+        if payload_file is not None:
+            # Home-relative so every runner flavor (local host-root,
+            # ssh $HOME, k8s /root) resolves it consistently for both
+            # the rsync and the remote open().
+            remote_path = (f'{self.payload_dir}/'
+                           f'{os.path.basename(payload_file)}')
+            runner = handle.head_runner()
+            runner.run('mkdir -p '
+                       f'{shlex.quote(os.path.dirname(remote_path))}')
+            runner.rsync(payload_file, remote_path, up=True)
+            remote_args.append(remote_path)
+        rc, stdout, stderr = backend.run_module_on_head(
+            handle, self.exec_module, verb, *remote_args)
+        if rc != 0:
+            raise exceptions.CommandError(
+                rc, f'{self.exec_module} {verb}',
+                f'remote controller failed: {stderr.strip()}')
+        lines = stdout.strip().splitlines()
+        if not lines:
+            raise exceptions.CommandError(
+                rc, f'{self.exec_module} {verb}',
+                'remote controller returned no reply line')
+        reply = json.loads(lines[-1])
+        if isinstance(reply, dict) and reply.get('error'):
+            raise exceptions.SkyTpuError(reply['error'])
+        return reply

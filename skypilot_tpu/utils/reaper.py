@@ -1,0 +1,153 @@
+"""Audit / kill framework daemon processes (round-end hygiene sweep).
+
+Detached daemons are by-design during operation (the API server, serve
+controllers, and gang job runners survive their parents). But at a
+round boundary — snapshot time, bench capture, CI teardown — NOTHING
+framework-owned should still be running: a survivor chews the machine
+and, worst case, holds the TPU chip and zeroes the next benchmark
+capture ("UNAVAILABLE" at backend init).
+
+This is deliberately a scorched-earth sweep: it finds EVERY live
+framework process (healthy or leaked — it does not consult cluster or
+service records) and, in kill mode, takes them all down. Do not run
+``--kill`` while workloads you care about are still running.
+
+Usage:
+  python -m skypilot_tpu.utils.reaper            # report only
+  python -m skypilot_tpu.utils.reaper --kill     # TERM, then KILL
+  xsky reap [--kill]                             # same via the CLI
+"""
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Dict, List, Optional, Sequence
+
+# Substrings that mark a process as framework-owned. Gang job commands
+# and serve replicas run under a job_runner session, so killing the
+# runner's group takes its tree down with it.
+FRAMEWORK_PATTERNS: Sequence[str] = (
+    'skypilot_tpu.agent.job_runner',
+    'skypilot_tpu.agent.daemon',
+    'skypilot_tpu.serve.controller',
+    'skypilot_tpu.server.app',
+)
+
+
+def _cmdline(pid: int) -> Optional[str]:
+    try:
+        with open(f'/proc/{pid}/cmdline', 'rb') as f:
+            return f.read().replace(b'\0', b' ').decode(
+                'utf-8', errors='replace')
+    except OSError:
+        return None
+
+
+def _ancestors(pid: int) -> List[int]:
+    out = []
+    for _ in range(64):
+        try:
+            with open(f'/proc/{pid}/stat', encoding='utf-8') as f:
+                fields = f.read().rsplit(')', 1)[-1].split()
+            ppid = int(fields[1])
+        except (OSError, IndexError, ValueError):
+            break
+        if ppid <= 1:
+            break
+        out.append(ppid)
+        pid = ppid
+    return out
+
+
+def find_framework_processes(
+        patterns: Sequence[str] = FRAMEWORK_PATTERNS
+) -> List[Dict[str, object]]:
+    """Live framework processes (excluding this process's own tree, so
+    a sweep run from inside a launch doesn't eat itself)."""
+    self_tree = {os.getpid(), *_ancestors(os.getpid())}
+    found = []
+    for entry in os.listdir('/proc'):
+        if not entry.isdigit():
+            continue
+        pid = int(entry)
+        if pid in self_tree:
+            continue
+        cmd = _cmdline(pid)
+        if not cmd:
+            continue
+        if any(p in cmd for p in patterns):
+            found.append({'pid': pid, 'cmdline': cmd.strip()})
+    return found
+
+
+# Back-compat alias (some callers read better with this name).
+find_leaked = find_framework_processes
+
+
+def reap(patterns: Sequence[str] = FRAMEWORK_PATTERNS,
+         grace_s: float = 5.0) -> List[Dict[str, object]]:
+    """TERM each framework process's session, escalate to KILL.
+
+    Returns the swept records, each with ``killed`` (gone by return
+    time) — a False there (e.g. PermissionError on someone else's
+    process) means the sweep did NOT clear the machine.
+    """
+    swept = find_framework_processes(patterns)
+    for rec in swept:
+        pid = int(rec['pid'])  # type: ignore[arg-type]
+        try:
+            # Runners start their children in their own session: signal
+            # the group so the whole tree goes.
+            os.killpg(pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                continue
+    deadline = time.time() + grace_s
+    while time.time() < deadline:
+        if not find_framework_processes(patterns):
+            break
+        time.sleep(0.2)
+    for rec in find_framework_processes(patterns):
+        pid = int(rec['pid'])  # type: ignore[arg-type]
+        try:
+            os.killpg(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+    still_alive = {int(r['pid'])  # type: ignore[arg-type]
+                   for r in find_framework_processes(patterns)}
+    for rec in swept:
+        rec['killed'] = int(rec['pid']) not in still_alive  # type: ignore
+    return swept
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    import json
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--kill', action='store_true',
+                        help='signal the framework processes (default: '
+                             'report only)')
+    args = parser.parse_args(argv)
+    if args.kill:
+        swept = reap()
+        for rec in swept:
+            print(json.dumps(rec))
+        survivors = [r for r in swept if not r.get('killed')]
+        if survivors:
+            print(f'# {len(survivors)} framework processes survived '
+                  'the sweep')
+            return 1
+    else:
+        for rec in find_framework_processes():
+            print(json.dumps(rec))
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

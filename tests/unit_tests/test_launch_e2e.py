@@ -39,6 +39,31 @@ class TestLaunch:
         logs = backend.tail_logs(handle, job_id, follow=False)
         assert 'hello from 0' in logs
 
+    def test_launch_streams_logs_live(self, fake_cluster_env, capsys):
+        """The launch wait live-tails run.log via the one-call `watch`
+        verb: job output must land on stdout BEFORE launch returns, not
+        only in a post-hoc tail."""
+        task = Task('streamer', run='echo live-line-1; echo live-line-2')
+        task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        execution.launch(task, cluster_name='tstream')
+        out = capsys.readouterr().out
+        assert 'live-line-1' in out and 'live-line-2' in out
+
+    def test_watch_verb_batches_status_and_log(self, fake_cluster_env):
+        """`job_cli watch` returns status + next log chunk in one call,
+        and successive offsets never re-deliver bytes."""
+        task = Task('w', run='echo chunk-one')
+        task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        job_id, handle = execution.launch(task, cluster_name='twatch')
+        from skypilot_tpu.backends import tpu_gang_backend
+        backend = tpu_gang_backend.TpuGangBackend()
+        rec = backend._watch_job(handle, job_id, 0)
+        assert rec['status'] == 'SUCCEEDED'
+        assert b'chunk-one' in rec['log']
+        rec2 = backend._watch_job(handle, job_id, rec['offset'])
+        assert rec2['log'] == b''
+        assert rec2['offset'] == rec['offset']
+
     def test_gang_env_on_pod(self, fake_cluster_env):
         """All 4 hosts of a v5e-32 slice run, each with correct rank env."""
         task = Task(

@@ -112,6 +112,68 @@ class TestDag:
         # ($0.10); 500 GB of cross-cloud egress flips the choice.
         assert infer.best_resources.cloud_name == 'fake'
 
+    def _diamond(self):
+        """source → {left, right} → sink, all egress-coupled."""
+        source = Task('source', run='s')
+        source.set_resources(Resources(cloud='fake',
+                                       accelerators='tpu-v5e-8'))
+        source.estimated_outputs_gigabytes = 400
+        left = Task('left', run='l')
+        left.set_resources(Resources(cpus='2+'))
+        left.estimated_outputs_gigabytes = 400
+        right = Task('right', run='r')
+        right.set_resources(Resources(cpus='2+'))
+        right.estimated_outputs_gigabytes = 400
+        sink = Task('sink', run='k')
+        sink.set_resources(Resources(cpus='2+'))
+        with Dag() as dag:
+            for t in (source, left, right, sink):
+                dag.add(t)
+            dag.add_edge(source, left)
+            dag.add_edge(source, right)
+            dag.add_edge(left, sink)
+            dag.add_edge(right, sink)
+        assert not dag.is_chain()
+        return dag, (source, left, right, sink)
+
+    def test_diamond_egress_colocation(self, enable_gcp_and_fake,
+                                       monkeypatch):
+        """Non-chain DAG (general solver, not the chain DP): heavy
+        egress on every edge must pull the whole diamond onto the
+        source's cloud even though gcp is marginally cheaper per node
+        (twin of the reference's pulp ILP, sky/optimizer.py:490)."""
+        from skypilot_tpu.clouds.fake import Fake
+        monkeypatch.setattr(Fake, 'get_egress_cost',
+                            lambda self, gb: 0.09 * gb)
+        dag, (source, left, right, sink) = self._diamond()
+        Optimizer.optimize(dag, quiet=True)
+        for t in (left, right, sink):
+            assert t.best_resources.cloud_name == 'fake', t.name
+
+    def test_diamond_no_egress_takes_cheapest(self, enable_gcp_and_fake):
+        """Control: with no edge weights each node takes its global
+        cheapest (gcp n2-standard-2 beats fake-cpu-4)."""
+        dag, (source, left, right, sink) = self._diamond()
+        for t in (source, left, right):
+            t.estimated_outputs_gigabytes = 0
+        Optimizer.optimize(dag, quiet=True)
+        for t in (left, right, sink):
+            assert t.best_resources.cloud_name == 'gcp', t.name
+
+    def test_diamond_local_search_matches_exact(self, enable_gcp_and_fake,
+                                                monkeypatch):
+        """Force the large-DAG path (coordinate descent + colocation
+        seeds) onto the same diamond and demand the exact answer."""
+        from skypilot_tpu import optimizer as optimizer_lib
+        from skypilot_tpu.clouds.fake import Fake
+        monkeypatch.setattr(Fake, 'get_egress_cost',
+                            lambda self, gb: 0.09 * gb)
+        monkeypatch.setattr(optimizer_lib, '_EXACT_SEARCH_LIMIT', 1)
+        dag, (source, left, right, sink) = self._diamond()
+        Optimizer.optimize(dag, quiet=True)
+        for t in (left, right, sink):
+            assert t.best_resources.cloud_name == 'fake', t.name
+
     def test_time_target(self, enable_fake_cloud):
         t = Task(run='x')
         t.set_resources(Resources(accelerators='tpu-v5e-8'))

@@ -43,7 +43,19 @@ SERVICE_YAML = textwrap.dedent("""\
 def serve_env(fake_cluster_env, monkeypatch, tmp_path):
     monkeypatch.setenv('XSKY_SERVE_DB', str(tmp_path / 'serve.db'))
     monkeypatch.setenv('XSKY_SERVE_INTERVAL', '0.5')
+    monkeypatch.setenv('XSKY_SERVE_LOG_DIR', str(tmp_path / 'serve_logs'))
     yield fake_cluster_env
+    # A test that fails mid-flight must not leak its service controller
+    # (or that controller's replica clusters): tear every service down
+    # even on assertion failure — leaked controllers are exactly the
+    # round-hygiene failure the reaper exists to catch.
+    import os
+    os.environ.pop('XSKY_SERVE_CONTROLLER_REMOTE', None)
+    for record in serve_state.get_services():
+        try:
+            serve_core.down(record['name'])
+        except Exception:  # pylint: disable=broad-except
+            pass
 
 
 def _service_task(min_replicas=1, max_replicas=2):
@@ -111,6 +123,55 @@ class TestServeE2E:
         with pytest.raises(ValueError):
             serve_core.up(task, 'dup')
         serve_core.down('dup')
+
+
+class TestRemoteController:
+    """Controller-as-cluster mode (twin of sky-serve-controller.yaml.j2
+    + sky/serve/service.py:155): the controller + LB run on a
+    provisioned controller cluster, so they survive the API-server
+    host's restarts; local verbs are stateless relays."""
+
+    def test_up_traffic_reattach_down(self, serve_env, monkeypatch):
+        monkeypatch.setenv('XSKY_SERVE_CONTROLLER_REMOTE', '1')
+        task = _service_task(min_replicas=1)
+        name = serve_core.up(task, 'recho', timeout_s=90)
+        assert name == 'recho'
+        # The controller cluster itself was provisioned.
+        from skypilot_tpu import state as state_lib
+        record = state_lib.get_cluster_from_name('xsky-serve-controller')
+        assert record is not None
+        assert record['status'] == state_lib.ClusterStatus.UP
+
+        svc = serve_core.status(['recho'])[0]
+        assert svc['status'] == 'READY'
+        # Traffic flows through the controller cluster's LB.
+        with urllib.request.urlopen(f"http://{svc['endpoint']}/",
+                                    timeout=10) as resp:
+            assert resp.status == 200
+
+        # An API-server restart is a new relay process with no serve
+        # state of its own: a fresh status call must reattach purely
+        # from the cluster record, and traffic must still flow.
+        svc = serve_core.status(['recho'])[0]
+        assert svc['status'] == 'READY'
+        with urllib.request.urlopen(f"http://{svc['endpoint']}/",
+                                    timeout=10) as resp:
+            assert resp.status == 200
+
+        serve_core.down('recho')
+        assert serve_core.status(['recho']) == []
+
+    def test_controller_logs_surface_crashes(self, serve_env):
+        """Local mode: controller stdio lands in a per-service log file
+        (not DEVNULL), so a crashed controller leaves diagnostics."""
+        task = _service_task(min_replicas=1)
+        serve_core.up(task, 'logsvc', timeout_s=90)
+        try:
+            import os
+            path = serve_core.controller_log_path('logsvc')
+            assert os.path.exists(path)
+        finally:
+            serve_core.down('logsvc')
 
 
 class TestAutoscaler:
