@@ -1,0 +1,132 @@
+#!/usr/bin/env python3
+"""Static docs site builder + link checker (docs CI job).
+
+The image bakes neither mkdocs nor sphinx, so this is the build
+pipeline (role of the reference's docs/build.sh): python-markdown →
+one HTML page per .md with a shared nav sidebar, plus a link checker
+that fails the build on any intra-docs link that does not resolve.
+
+Usage:
+  python docs/build.py [--out docs/_build]     # build + check
+  python docs/build.py --check-only            # links only (CI fast path)
+"""
+from __future__ import annotations
+
+import argparse
+import pathlib
+import re
+import sys
+
+DOCS = pathlib.Path(__file__).resolve().parent
+
+# Nav order; every tracked page must be listed (build fails otherwise
+# so a new page cannot silently miss the sidebar).
+NAV = [
+    ('index.md', 'Overview'),
+    ('quickstart.md', 'Quickstart'),
+    ('cli.md', 'CLI reference'),
+    ('architecture.md', 'Architecture'),
+    ('parallelism.md', 'Parallelism'),
+    ('serving.md', 'Serving'),
+    ('jobs.md', 'Managed jobs'),
+    ('storage.md', 'Storage'),
+    ('clouds.md', 'Clouds'),
+    ('server.md', 'API server'),
+    ('performance.md', 'Performance'),
+]
+
+_TEMPLATE = """<!DOCTYPE html>
+<html lang="en"><head><meta charset="utf-8">
+<title>{title} — xsky docs</title>
+<style>
+  body {{ font: 15px/1.6 system-ui, sans-serif; color: #1a1d21;
+         margin: 0; display: flex; }}
+  nav {{ width: 220px; min-height: 100vh; border-right: 1px solid
+        #e5e7eb; padding: 24px 0; background: #f8fafc;
+        flex-shrink: 0; }}
+  nav a {{ display: block; padding: 6px 24px; color: #374151;
+          text-decoration: none; font-size: 14px; }}
+  nav a.active {{ color: #2563eb; font-weight: 600;
+                 border-left: 3px solid #2563eb; }}
+  main {{ max-width: 760px; padding: 32px 48px; }}
+  pre {{ background: #0f172a; color: #e2e8f0; padding: 12px 16px;
+        border-radius: 6px; overflow-x: auto; font-size: 13px; }}
+  code {{ font-size: 13px; background: #f1f5f9; padding: 1px 4px;
+         border-radius: 3px; }}
+  pre code {{ background: none; padding: 0; }}
+  table {{ border-collapse: collapse; }}
+  th, td {{ border: 1px solid #e5e7eb; padding: 6px 10px;
+           font-size: 14px; text-align: left; }}
+  h1, h2, h3 {{ line-height: 1.3; }}
+  a {{ color: #2563eb; }}
+</style></head><body>
+<nav>{nav}</nav>
+<main>{body}</main>
+</body></html>
+"""
+
+
+def _nav_html(active: str) -> str:
+    items = []
+    for fname, title in NAV:
+        href = fname.replace('.md', '.html')
+        cls = ' class="active"' if fname == active else ''
+        items.append(f'<a href="{href}"{cls}>{title}</a>')
+    return '\n'.join(items)
+
+
+def _check_links() -> list:
+    """Every relative intra-docs link must point at a real page."""
+    errors = []
+    pages = {f.name for f in DOCS.glob('*.md')}
+    nav_pages = {fname for fname, _ in NAV}
+    for missing in nav_pages - pages:
+        errors.append(f'NAV lists missing page: {missing}')
+    for stray in pages - nav_pages:
+        errors.append(f'page not in NAV (add to docs/build.py): {stray}')
+    link_re = re.compile(r'\[[^\]]*\]\(([^)#\s]+)(#[^)\s]*)?\)')
+    for page in sorted(DOCS.glob('*.md')):
+        for match in link_re.finditer(page.read_text(encoding='utf-8')):
+            target = match.group(1)
+            if target.startswith(('http://', 'https://', 'mailto:')):
+                continue
+            resolved = (page.parent / target).resolve()
+            if not resolved.exists():
+                errors.append(f'{page.name}: broken link → {target}')
+    return errors
+
+
+def build(out_dir: pathlib.Path) -> None:
+    import markdown
+    out_dir.mkdir(parents=True, exist_ok=True)
+    for fname, title in NAV:
+        text = (DOCS / fname).read_text(encoding='utf-8')
+        # .md links become .html links in the rendered site.
+        text = re.sub(r'\(([\w\-./]+)\.md(#[^)\s]*)?\)',
+                      r'(\1.html\2)', text)
+        body = markdown.markdown(
+            text, extensions=['fenced_code', 'tables'])
+        html = _TEMPLATE.format(title=title, nav=_nav_html(fname),
+                                body=body)
+        (out_dir / fname.replace('.md', '.html')).write_text(
+            html, encoding='utf-8')
+    print(f'built {len(NAV)} pages → {out_dir}')
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--out', default=str(DOCS / '_build'))
+    parser.add_argument('--check-only', action='store_true')
+    args = parser.parse_args()
+    errors = _check_links()
+    if errors:
+        for e in errors:
+            print(f'LINK ERROR: {e}', file=sys.stderr)
+        return 1
+    if not args.check_only:
+        build(pathlib.Path(args.out))
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -80,11 +80,16 @@ class RetryingProvisioner:
                 return result
             # Every (region, zone) of this SKU is exhausted: block the SKU
             # itself so the optimizer moves to the next-cheapest candidate
-            # (incl. GPU→TPU / TPU→GPU jumps).
+            # (incl. GPU→TPU / TPU→GPU jumps). The block names the
+            # provisioning model, so a stocked-out reservation walks on
+            # to spot, then on-demand, of the same SKU.
             self.blocked.append(
                 resources_lib.Resources(
                     cloud=resources.cloud_name,
                     accelerators=resources.accelerators,
+                    accelerator_args={
+                        'provisioning_model':
+                            resources.effective_provisioning_model()},
                     instance_type=None if resources.is_tpu
                     else resources.instance_type))
         raise exceptions.ResourcesUnavailableError(
@@ -100,6 +105,9 @@ class RetryingProvisioner:
         blocked = resources_lib.Resources(
             cloud=resources.cloud_name,
             accelerators=None if whole_cloud else resources.accelerators,
+            accelerator_args=None if whole_cloud else {
+                'provisioning_model':
+                    resources.effective_provisioning_model()},
             instance_type=None if (whole_cloud or resources.is_tpu)
             else resources.instance_type,
             region=None if whole_cloud else region,

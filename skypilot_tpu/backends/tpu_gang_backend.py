@@ -377,7 +377,8 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
 
     def execute(self, handle: ClusterHandle, task: 'task_lib.Task',
                 detach_run: bool = False,
-                dryrun: bool = False) -> Optional[int]:
+                dryrun: bool = False,
+                stream_logs: bool = True) -> Optional[int]:
         if dryrun:
             return None
         run_cmd = task.run
@@ -396,7 +397,7 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
         job_id = self._submit_job(handle, task.name, spec)
         state.update_last_use(handle.cluster_name)
         if not detach_run:
-            self._wait_job(handle, job_id)
+            self._wait_job(handle, job_id, stream_logs=stream_logs)
         return job_id
 
     def _submit_job(self, handle: ClusterHandle, name: Optional[str],
@@ -462,13 +463,16 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
         offset = 0
         interval = 0.3
         status: Optional[job_lib.JobStatus] = None
+        # Incremental decoder: a multibyte character split across chunk
+        # boundaries must not decode to replacement garbage.
+        import codecs
+        decoder = codecs.getincrementaldecoder('utf-8')('replace')
         while time.time() < deadline:
             rec = self._watch_job(handle, job_id, offset)
             if rec is not None:
                 offset = rec['offset']
                 if rec['log'] and stream_logs:
-                    sys.stdout.write(
-                        rec['log'].decode('utf-8', errors='replace'))
+                    sys.stdout.write(decoder.decode(rec['log']))
                     sys.stdout.flush()
                     # Output is flowing: stay snappier, but never the
                     # old hammer rate.
@@ -495,14 +499,18 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                         break
                     offset = rec['offset']
                     if stream_logs:
-                        sys.stdout.write(
-                            rec['log'].decode('utf-8', errors='replace'))
+                        sys.stdout.write(decoder.decode(rec['log']))
                         sys.stdout.flush()
                 else:
                     if stream_logs:
                         sys.stdout.write(
                             '\n[xsky] log drain capped; full log via '
                             '`xsky logs`\n')
+                        sys.stdout.flush()
+                if stream_logs:
+                    tail = decoder.decode(b'', final=True)
+                    if tail:
+                        sys.stdout.write(tail)
                         sys.stdout.flush()
                 if status != job_lib.JobStatus.SUCCEEDED:
                     raise exceptions.JobExitNonZeroError(

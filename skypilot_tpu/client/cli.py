@@ -8,6 +8,7 @@ api (start/stop/status/logs/cancel), users, workspaces.
 """
 from __future__ import annotations
 
+import datetime
 import json
 import os
 import sys
@@ -702,6 +703,44 @@ def users_delete(name):
     from skypilot_tpu.client import sdk
     sdk.users_delete(name)
     click.echo(f'User {name} deleted.')
+
+
+@users.command(name='token-create')
+@click.argument('name')
+@click.option('--label', default='default',
+              help='Revocation handle; unique per user.')
+def users_token_create(name, label):
+    """Mint a bearer API token (plaintext shown ONCE — save it)."""
+    from skypilot_tpu.client import sdk
+    record = sdk.users_token_create(name, label)
+    click.echo(f"Token for {name} (label {label!r}):")
+    click.echo(record['token'])
+    click.echo('Use it as:  Authorization: Bearer <token>')
+
+
+@users.command(name='token-ls')
+@click.option('--name', default=None, help='Filter by user.')
+def users_token_ls(name):
+    from skypilot_tpu.client import sdk
+    records = sdk.users_token_list(name)
+    if not records:
+        click.echo('No tokens.')
+        return
+    click.echo(f'{"USER":<24}{"LABEL":<16}{"LAST USED":<20}')
+    for r in records:
+        last = r.get('last_used_at')
+        last_str = (datetime.datetime.fromtimestamp(last).strftime(
+            '%Y-%m-%d %H:%M:%S') if last else '-')
+        click.echo(f'{r["user_name"]:<24}{r["label"]:<16}{last_str:<20}')
+
+
+@users.command(name='token-revoke')
+@click.argument('name')
+@click.argument('label')
+def users_token_revoke(name, label):
+    from skypilot_tpu.client import sdk
+    result = sdk.users_token_revoke(name, label)
+    click.echo('Revoked.' if result.get('revoked') else 'No such token.')
 
 
 @users.command(name='set-role')

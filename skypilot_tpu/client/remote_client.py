@@ -190,6 +190,17 @@ class RemoteClient:
     def users_set_role(self, name, role):
         return self._call('users.set_role', {'name': name, 'role': role})
 
+    def users_token_create(self, name, label='default'):
+        return self._call('users.token_create',
+                          {'name': name, 'label': label})
+
+    def users_token_list(self, name=None):
+        return self._call('users.token_list', {'name': name})
+
+    def users_token_revoke(self, name, label):
+        return self._call('users.token_revoke',
+                          {'name': name, 'label': label})
+
     def workspaces_list(self):
         return self._call('workspaces.list', {})
 

@@ -357,6 +357,25 @@ def users_set_role(name: str, role: str):
                                    'users_set_role', name, role)
 
 
+def users_token_create(name: str, label: str = 'default'):
+    """Mint a bearer token for API auth (plaintext returned once)."""
+    return _module_local_or_remote('skypilot_tpu.users.core',
+                                   'create_token', 'users_token_create',
+                                   name, label)
+
+
+def users_token_list(name: Optional[str] = None):
+    return _module_local_or_remote('skypilot_tpu.users.core',
+                                   'list_tokens', 'users_token_list',
+                                   name)
+
+
+def users_token_revoke(name: str, label: str):
+    return _module_local_or_remote('skypilot_tpu.users.core',
+                                   'revoke_token', 'users_token_revoke',
+                                   name, label)
+
+
 def workspaces_list() -> List[str]:
     return _module_local_or_remote('skypilot_tpu.workspaces.core',
                                    'get_workspaces', 'workspaces_list')

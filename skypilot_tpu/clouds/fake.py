@@ -39,6 +39,13 @@ class Fake(catalog_cloud.CatalogCloud):
                 'tpu_chips_per_host': topo.chips_per_host,
                 'tpu_num_slices': topo.num_slices,
             })
+            args = resources.accelerator_args or {}
+            # Mirror the GCP capacity-model threading so failover walks
+            # (reserved → spot → on-demand) are testable on the fake.
+            vars['provisioning_model'] = \
+                resources.effective_provisioning_model()
+            if args.get('reservation'):
+                vars['reservation'] = args['reservation']
         return vars
 
     def check_credentials(self) -> Tuple[bool, Optional[str]]:

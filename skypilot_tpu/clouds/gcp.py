@@ -11,6 +11,7 @@ import os
 import typing
 from typing import Any, Dict, Optional, Tuple
 
+from skypilot_tpu import exceptions
 from skypilot_tpu.clouds import catalog_cloud
 from skypilot_tpu.clouds import cloud as cloud_lib
 from skypilot_tpu.utils import registry
@@ -87,10 +88,54 @@ class GCP(catalog_cloud.CatalogCloud):
                 'tpu_use_queued_resources': bool(
                     args.get('use_queued_resources', topo.is_multislice)),
             })
+            self._apply_tpu_capacity_model(vars, args)
         elif resources.accelerators:
             name, count = next(iter(resources.accelerators.items()))
             vars.update({'gpu_type': name, 'gpu_count': count})
         return vars
+
+    @staticmethod
+    def _apply_tpu_capacity_model(vars: Dict[str, Any],
+                                  args: Dict[str, Any]) -> None:
+        """Reservations + DWS depth the reference lacks for TPUs
+        (sky/provision/gcp/instance_utils.py:1475 notes TPU nodes have
+        no reservation plumbing; DWS exists only for MIGs,
+        sky/provision/gcp/mig_utils.py:210): here reservations ride the
+        node/queued-resource scheduling config and DWS flex-start rides
+        a queued resource with a validUntilDuration window.
+
+        accelerator_args:
+          provisioning_model: standard | spot | reserved | flex-start
+              ('auto' is expanded by the optimizer before deploy)
+          reservation: <name>        (required for 'reserved')
+          provision_timeout: <sec>   (DWS window; default 1800 for
+                                      flex-start)
+        """
+        model = args.get('provisioning_model', 'standard')
+        known = ('standard', 'spot', 'reserved', 'flex-start', 'auto')
+        if model not in known:
+            raise exceptions.InvalidRequestError(
+                f'Unknown provisioning_model {model!r}; expected one '
+                f'of {known}.')
+        if model == 'spot':
+            vars['use_spot'] = True
+        elif model == 'reserved':
+            if not args.get('reservation'):
+                raise exceptions.InvalidRequestError(
+                    "provisioning_model 'reserved' requires "
+                    "accelerator_args.reservation")
+            vars['use_spot'] = False
+        elif model == 'flex-start':
+            # DWS: request capacity through the queue with a bounded
+            # wait window instead of failing immediately on stockout.
+            vars['tpu_use_queued_resources'] = True
+            vars['provision_timeout_s'] = float(
+                args.get('provision_timeout', 1800))
+        if args.get('reservation') and model in ('standard', 'reserved'):
+            vars['reservation'] = args['reservation']
+        if 'provision_timeout_s' not in vars and \
+                args.get('provision_timeout'):
+            vars['provision_timeout_s'] = float(args['provision_timeout'])
 
     def provider_config_overrides(
             self, node_config: Dict[str, Any]) -> Dict[str, Any]:

@@ -80,14 +80,16 @@ def launch(entrypoint,
                         retry_until_up=retry_until_up,
                         idle_minutes_to_autostop=idle_minutes_to_autostop,
                         down=down, detach_run=detach_run,
-                        backend=backend,
+                        stream_logs=stream_logs, backend=backend,
                         blocked_resources=blocked_resources)
 
 
 def exec(entrypoint,  # pylint: disable=redefined-builtin
          cluster_name: str,
          detach_run: bool = False,
-         dryrun: bool = False) -> Tuple[Optional[int], Optional[Any]]:
+         dryrun: bool = False,
+         stream_logs: bool = True
+         ) -> Tuple[Optional[int], Optional[Any]]:
     """Run on an existing cluster: SYNC_WORKDIR + EXEC only."""
     dag = _to_dag(entrypoint)
     if len(dag.tasks) != 1:
@@ -114,7 +116,7 @@ def exec(entrypoint,  # pylint: disable=redefined-builtin
     if task.workdir:
         backend.sync_workdir(handle, task.workdir)
     job_id = backend.execute(handle, task, detach_run=detach_run,
-                             dryrun=dryrun)
+                             dryrun=dryrun, stream_logs=stream_logs)
     return job_id, handle
 
 
@@ -127,6 +129,7 @@ def _execute_dag(dag: dag_lib.Dag,
                  down: bool,
                  detach_run: bool,
                  backend: Optional[Any],
+                 stream_logs: bool = True,
                  blocked_resources: Optional[List[Any]] = None
                  ) -> Tuple[Optional[int], Optional[Any]]:
     if len(dag.tasks) != 1:
@@ -195,7 +198,7 @@ def _execute_dag(dag: dag_lib.Dag,
     job_id = None
     if Stage.EXEC in stages and task.run is not None:
         job_id = backend.execute(handle, task, detach_run=detach_run,
-                                 dryrun=dryrun)
+                                 dryrun=dryrun, stream_logs=stream_logs)
 
     if Stage.DOWN in stages:
         backend.teardown(handle, terminate=True)

@@ -83,6 +83,13 @@ def _is_blocked(candidate: resources_lib.Resources,
         if b.accelerators is not None and \
                 b.accelerators != candidate.accelerators:
             continue
+        # Capacity is per provisioning model: a stocked-out reservation
+        # says nothing about spot or on-demand of the same SKU. Blocked
+        # entries that name a model only cover candidates on that model.
+        b_model = (b.accelerator_args or {}).get('provisioning_model')
+        if b_model is not None and \
+                candidate.effective_provisioning_model() != b_model:
+            continue
         return True
     return False
 
@@ -298,6 +305,38 @@ def _solve_local_search(tasks, dag, candidates, minimize):
     return best_choice
 
 
+def _expand_provisioning_models(
+        candidates: List[resources_lib.Resources],
+        blocked: List[resources_lib.Resources]
+) -> List[resources_lib.Resources]:
+    """`provisioning_model: auto` → an ordered reserved → spot →
+    on-demand walk (reservation is prepaid so it is always tried first;
+    spot beats on-demand on price). Twin of the reference's
+    reservation-priority + spot-first candidate ordering."""
+    out: List[resources_lib.Resources] = []
+    for r in candidates:
+        args = dict(r.accelerator_args or {})
+        if args.get('provisioning_model') != 'auto':
+            out.append(r)
+            continue
+        args.pop('provisioning_model')
+        reservation = args.pop('reservation', None)
+        variants = []
+        if reservation:
+            variants.append(r.copy(
+                accelerator_args={**args, 'reservation': reservation,
+                                  'provisioning_model': 'reserved'},
+                use_spot=False))
+        variants.append(r.copy(
+            accelerator_args={**args, 'provisioning_model': 'spot'},
+            use_spot=True))
+        variants.append(r.copy(
+            accelerator_args={**args, 'provisioning_model': 'standard'},
+            use_spot=False))
+        out.extend(v for v in variants if not _is_blocked(v, blocked))
+    return out
+
+
 def candidates_for_failover(
         task: task_lib.Task,
         blocked_resources: Optional[Iterable[resources_lib.Resources]] = None
@@ -306,5 +345,13 @@ def candidates_for_failover(
     engine to walk to the next-cheapest SKU, incl. GPU→TPU)."""
     d = dag_lib.Dag()
     d.add(task)
-    cands = _fill_in_launchable_resources(d, blocked_resources)[task]
-    return [r for r, _ in cands]
+    blocked = list(blocked_resources or [])
+    cands = _fill_in_launchable_resources(d, blocked)[task]
+    expanded = _expand_provisioning_models([r for r, _ in cands], blocked)
+    if not expanded:
+        # Every provisioning-model variant of every candidate is blocked.
+        raise exceptions.ResourcesUnavailableError(
+            f'No launchable resource left for task '
+            f'{task.name or "<unnamed>"}: all provisioning models of '
+            'every candidate are blocked.')
+    return expanded

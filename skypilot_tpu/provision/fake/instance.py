@@ -79,7 +79,8 @@ def _infos_from(cluster: Dict[str, Any]) -> Dict[str, common.InstanceInfo]:
 
 
 class FailureInjector:
-    """Scripted provisioning failures, keyed by zone (or '*').
+    """Scripted provisioning failures, keyed by zone (or '*') or by a
+    node_config predicate (capacity-model failover tests).
 
     In-process only (tests script failures and provision in-process); the
     persisted store is for cross-process cluster visibility.
@@ -87,14 +88,28 @@ class FailureInjector:
 
     def __init__(self) -> None:
         self._errors: Dict[str, List[Exception]] = {}
-        self.attempts: List[str] = []   # zones tried, in order
+        self._matchers: List[tuple] = []   # (predicate, [errors])
+        self.attempts: List[str] = []      # zones tried, in order
+        self.attempt_configs: List[Dict[str, Any]] = []
 
     def fail_zone(self, zone: str, error: Exception,
                   times: int = 10**9) -> None:
         self._errors.setdefault(zone, []).extend([error] * min(times, 1000))
 
-    def check(self, zone: str) -> None:
+    def fail_match(self, predicate, error: Exception,
+                   times: int = 1) -> None:
+        """Fail attempts whose node_config satisfies `predicate` — e.g.
+        stock out only the 'reserved' provisioning model."""
+        self._matchers.append((predicate, [error] * times))
+
+    def check(self, zone: str,
+              node_config: Optional[Dict[str, Any]] = None) -> None:
         self.attempts.append(zone)
+        self.attempt_configs.append(dict(node_config or {}))
+        for predicate, queue in self._matchers:
+            if queue and node_config is not None and \
+                    predicate(node_config):
+                raise queue.pop(0)
         for key in (zone, '*'):
             queue = self._errors.get(key)
             if queue:
@@ -102,7 +117,9 @@ class FailureInjector:
 
     def reset(self) -> None:
         self._errors.clear()
+        self._matchers.clear()
         self.attempts.clear()
+        self.attempt_configs.clear()
 
 
 injector = FailureInjector()
@@ -159,7 +176,7 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
     with _store() as data:
         data.setdefault('provision_regions', {}).setdefault(
             cluster_name, []).append(region)
-        injector.check(zone)
+        injector.check(zone, config.node_config)
         existing = data['clusters'].get(cluster_name)
         if existing is not None:
             resumed = []

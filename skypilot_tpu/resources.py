@@ -202,6 +202,16 @@ class Resources:
     def accelerator_args(self) -> Optional[Dict[str, Any]]:
         return self._accelerator_args
 
+    def effective_provisioning_model(self) -> str:
+        """Concrete capacity model of this request: 'reserved' | 'spot'
+        | 'flex-start' (DWS queued window) | 'standard' | 'auto' (to be
+        expanded into an ordered reserved→spot→standard failover walk,
+        twin of the reference's prioritize-reservations ordering)."""
+        model = (self._accelerator_args or {}).get('provisioning_model')
+        if model:
+            return model
+        return 'spot' if self.use_spot else 'standard'
+
     @property
     def use_spot(self) -> bool:
         return self._use_spot

@@ -144,10 +144,11 @@ class _Handler(BaseHTTPRequestHandler):
             # Local single-user mode: admin-equivalent, no credentials.
             body.setdefault('user', 'anon')
             return None
-        user = users_core.authenticate_basic(
+        user = users_core.authenticate(
             self.headers.get('Authorization'))
         if user is None:
-            return 401, 'authentication required (Basic auth)'
+            return 401, ('authentication required (Basic auth or '
+                         'Bearer token)')
         if not rbac.check_permission(user['role'], verb):
             return 403, (f'role {user["role"]!r} may not call {verb!r}')
         # Attribution only. Never write the caller's role into the body:
@@ -160,7 +161,7 @@ class _Handler(BaseHTTPRequestHandler):
         from skypilot_tpu.users import core as users_core
         if not users_core.auth_required():
             return True
-        return users_core.authenticate_basic(
+        return users_core.authenticate(
             self.headers.get('Authorization')) is not None
 
     def do_POST(self) -> None:  # noqa: N802

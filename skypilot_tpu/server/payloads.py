@@ -53,6 +53,10 @@ def _launch(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
         'down': bool(body.get('down', False)),
         'dryrun': bool(body.get('dryrun', False)),
         'detach_run': bool(body.get('detach_run', False)),
+        # Streamed job output lands in the request's captured log
+        # (`xsky api logs REQUEST_ID`); clients may turn it off for
+        # chatty jobs.
+        'stream_logs': bool(body.get('stream_logs', True)),
         'no_setup': bool(body.get('no_setup', False)),
     }
     return run_launch, kwargs
@@ -190,6 +194,11 @@ _VERBS.update({
                                  role='user'),
     'users.delete': _module_verb(_USERS, 'delete_user', 'name'),
     'users.set_role': _module_verb(_USERS, 'set_role', 'name', 'role'),
+    'users.token_create': _module_verb(_USERS, 'create_token', 'name',
+                                       label='default'),
+    'users.token_list': _module_verb(_USERS, 'list_tokens', name=None),
+    'users.token_revoke': _module_verb(_USERS, 'revoke_token', 'name',
+                                       'label'),
     # Workspaces.
     'workspaces.list': _module_verb(_WORKSPACES, 'get_workspaces'),
     'workspaces.create': _module_verb(_WORKSPACES, 'create_workspace',

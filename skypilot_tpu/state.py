@@ -104,6 +104,13 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             name TEXT PRIMARY KEY,
             created_at INTEGER
         );
+        CREATE TABLE IF NOT EXISTS api_tokens (
+            token_hash TEXT PRIMARY KEY,
+            user_name TEXT,
+            label TEXT,
+            created_at INTEGER,
+            last_used_at INTEGER
+        );
         CREATE TABLE IF NOT EXISTS cluster_history (
             row_id INTEGER PRIMARY KEY AUTOINCREMENT,
             name TEXT,
@@ -471,6 +478,74 @@ def set_user_role(name: str, role: str) -> bool:
                            (role, name))
         conn.commit()
     return cur.rowcount > 0
+
+
+# ---- API tokens (bearer auth; twin of the reference's service-account
+# token middleware, sky/server/server.py:176-296) ---------------------------
+
+
+def add_api_token(token_hash: str, user_name: str, label: str) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'INSERT INTO api_tokens (token_hash, user_name, label, '
+            'created_at) VALUES (?, ?, ?, ?)',
+            (token_hash, user_name, label, int(time.time())))
+        conn.commit()
+
+
+def get_api_token(token_hash: str) -> Optional[Dict[str, Any]]:
+    conn = _get_conn()
+    with _lock:
+        row = conn.execute(
+            'SELECT token_hash, user_name, label, created_at '
+            'FROM api_tokens WHERE token_hash=?',
+            (token_hash,)).fetchone()
+        if row is not None:
+            conn.execute(
+                'UPDATE api_tokens SET last_used_at=? WHERE token_hash=?',
+                (int(time.time()), token_hash))
+            conn.commit()
+    if row is None:
+        return None
+    return {'token_hash': row[0], 'user_name': row[1], 'label': row[2],
+            'created_at': row[3]}
+
+
+def list_api_tokens(user_name: Optional[str] = None
+                    ) -> List[Dict[str, Any]]:
+    conn = _get_conn()
+    with _lock:
+        if user_name is None:
+            rows = conn.execute(
+                'SELECT user_name, label, created_at, last_used_at '
+                'FROM api_tokens ORDER BY user_name, label').fetchall()
+        else:
+            rows = conn.execute(
+                'SELECT user_name, label, created_at, last_used_at '
+                'FROM api_tokens WHERE user_name=? ORDER BY label',
+                (user_name,)).fetchall()
+    return [{'user_name': r[0], 'label': r[1], 'created_at': r[2],
+             'last_used_at': r[3]} for r in rows]
+
+
+def delete_api_token(user_name: str, label: str) -> bool:
+    conn = _get_conn()
+    with _lock:
+        cur = conn.execute(
+            'DELETE FROM api_tokens WHERE user_name=? AND label=?',
+            (user_name, label))
+        conn.commit()
+    return cur.rowcount > 0
+
+
+def delete_api_tokens_for_user(user_name: str) -> int:
+    conn = _get_conn()
+    with _lock:
+        cur = conn.execute('DELETE FROM api_tokens WHERE user_name=?',
+                           (user_name,))
+        conn.commit()
+    return cur.rowcount
 
 
 # ---- workspaces -----------------------------------------------------------

@@ -39,7 +39,11 @@ def create_user(name: str, password: str,
 
 
 def delete_user(name: str) -> Dict[str, Any]:
-    return {'deleted': state.delete_user(name)}
+    deleted = state.delete_user(name)
+    if deleted:
+        # Bearer tokens die with the account.
+        state.delete_api_tokens_for_user(name)
+    return {'deleted': deleted}
 
 
 def list_users() -> List[Dict[str, Any]]:
@@ -79,6 +83,63 @@ def authenticate_basic(header: Optional[str]) -> Optional[Dict[str, Any]]:
     except Exception:  # pylint: disable=broad-except
         return None
     return verify_password(name, password)
+
+
+_TOKEN_PREFIX = 'xsky_'
+
+
+def _hash_token(token: str) -> str:
+    return hashlib.sha256(token.encode()).hexdigest()
+
+
+def create_token(name: str, label: str = 'default') -> Dict[str, Any]:
+    """Mint a bearer token for `name` (twin of the reference's
+    service-account token auth, sky/server/server.py:176-296).
+
+    The plaintext is returned exactly once; only its SHA-256 lands in
+    the DB. Label must be unique per user (revocation handle).
+    """
+    if state.get_user(name) is None:
+        raise ValueError(f'Unknown user {name!r}.')
+    if any(t['label'] == label for t in state.list_api_tokens(name)):
+        raise ValueError(
+            f'User {name!r} already has a token labeled {label!r}; '
+            'revoke it first.')
+    token = _TOKEN_PREFIX + secrets.token_urlsafe(32)
+    state.add_api_token(_hash_token(token), name, label)
+    return {'name': name, 'label': label, 'token': token}
+
+
+def list_tokens(name: Optional[str] = None) -> List[Dict[str, Any]]:
+    return state.list_api_tokens(name)
+
+
+def revoke_token(name: str, label: str) -> Dict[str, Any]:
+    return {'revoked': state.delete_api_token(name, label)}
+
+
+def authenticate_bearer(header: Optional[str]) -> Optional[Dict[str, Any]]:
+    """Parse `Authorization: Bearer xsky_...` → user record or None."""
+    if not header or not header.startswith('Bearer '):
+        return None
+    token = header[len('Bearer '):].strip()
+    if not token.startswith(_TOKEN_PREFIX):
+        return None
+    record = state.get_api_token(_hash_token(token))
+    if record is None:
+        return None
+    user = state.get_user(record['user_name'])
+    if user is None:
+        # Deleted user: the token must die with the account.
+        return None
+    return user
+
+
+def authenticate(header: Optional[str]) -> Optional[Dict[str, Any]]:
+    """Basic password or Bearer token, whichever the header carries."""
+    if header and header.startswith('Bearer '):
+        return authenticate_bearer(header)
+    return authenticate_basic(header)
 
 
 def bootstrap_admin_if_empty() -> None:

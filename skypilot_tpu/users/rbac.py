@@ -20,6 +20,9 @@ _ADMIN_ONLY_VERBS = frozenset({
     'users.create',
     'users.delete',
     'users.set_role',
+    'users.token_create',
+    'users.token_list',
+    'users.token_revoke',
     'workspaces.create',
     'workspaces.delete',
 })

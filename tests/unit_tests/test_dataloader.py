@@ -6,6 +6,9 @@ import pytest
 from skypilot_tpu.train import data as data_lib
 
 
+pytestmark = pytest.mark.slow  # heavy tier: subprocess e2e / jit compiles
+
+
 @pytest.fixture(scope='module')
 def shards(tmp_path_factory):
     """Two shards holding tokens 0..9999 (values == positions)."""

@@ -16,6 +16,9 @@ from skypilot_tpu.models import llama
 from skypilot_tpu.ops import attention as attention_ops
 from skypilot_tpu.ops import decode_attention as decode_ops
 
+pytestmark = pytest.mark.slow  # interpret-mode kernels are minutes-scale
+
+
 
 def _rand(shape, seed, dtype=jnp.float32):
     return jax.random.normal(jax.random.PRNGKey(seed), shape, dtype)

@@ -1,0 +1,35 @@
+"""Docs build pipeline: every page renders and no intra-docs link is
+broken (the docs CI job runs the same script)."""
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[2]
+
+
+def test_docs_build_and_links(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, str(REPO / 'docs' / 'build.py'),
+         '--out', str(tmp_path / 'site')],
+        capture_output=True, text=True, check=False)
+    assert proc.returncode == 0, proc.stderr
+    pages = list((tmp_path / 'site').glob('*.html'))
+    assert len(pages) >= 11
+    index = (tmp_path / 'site' / 'index.html').read_text()
+    assert 'quickstart.html' in index          # md links rewrote
+    assert 'xsky documentation' in index
+
+
+def test_link_check_catches_breakage(tmp_path):
+    docs = tmp_path / 'docs'
+    docs.mkdir()
+    src = REPO / 'docs'
+    for f in src.glob('*.md'):
+        (docs / f.name).write_text(f.read_text())
+    (docs / 'build.py').write_text((src / 'build.py').read_text())
+    (docs / 'index.md').write_text('[gone](never-exists.md)\n')
+    proc = subprocess.run(
+        [sys.executable, str(docs / 'build.py'), '--check-only'],
+        capture_output=True, text=True, check=False)
+    assert proc.returncode == 1
+    assert 'never-exists.md' in proc.stderr

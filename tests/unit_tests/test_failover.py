@@ -71,6 +71,59 @@ class TestZoneFailover:
         assert result.resources.accelerators == {'FAKEGPU': 8}
         assert len(provisioner.failover_history) == 4
 
+    def test_reserved_to_spot_to_ondemand_walk(self, fake_cluster_env):
+        """provisioning_model 'auto' + a reservation: the failover
+        engine tries the reservation first (prepaid), then spot, then
+        on-demand — stocking out one model must not block the others
+        (VERDICT r2 #6; twin of reservation-priority the reference has
+        only for GPUs)."""
+        fake = fake_cluster_env
+        task = Task(run='train')
+        task.set_resources(Resources(
+            accelerators='tpu-v5e-8',
+            accelerator_args={'provisioning_model': 'auto',
+                              'reservation': 'my-reservation'}))
+        # Stock out the reservation everywhere and spot everywhere; the
+        # on-demand attempt succeeds.
+        fake.injector.fail_match(
+            lambda cfg: cfg.get('provisioning_model') == 'reserved',
+            exceptions.CapacityError('reservation exhausted'), times=8)
+        fake.injector.fail_match(
+            lambda cfg: cfg.get('provisioning_model') == 'spot',
+            exceptions.CapacityError('spot stockout'), times=8)
+        provisioner = failover.RetryingProvisioner(task, 'walk', 1)
+        result = provisioner.provision_with_retries()
+        models = [cfg.get('provisioning_model')
+                  for cfg in fake.injector.attempt_configs]
+        # Reserved tried before any spot, spot before any standard.
+        assert 'reserved' in models and 'spot' in models
+        assert models.index('reserved') < models.index('spot')
+        assert models.index('spot') < models.index('standard')
+        assert result.resources.effective_provisioning_model() == \
+            'standard'
+        # The reserved attempts carried the reservation; on-demand not.
+        reserved_cfgs = [c for c in fake.injector.attempt_configs
+                         if c.get('provisioning_model') == 'reserved']
+        assert all(c.get('reservation') == 'my-reservation'
+                   for c in reserved_cfgs)
+
+    def test_reservation_attempt_succeeds_first(self, fake_cluster_env):
+        """With capacity available, 'auto' lands on the reservation and
+        never touches spot/on-demand."""
+        fake = fake_cluster_env
+        task = Task(run='train')
+        task.set_resources(Resources(
+            accelerators='tpu-v5e-8',
+            accelerator_args={'provisioning_model': 'auto',
+                              'reservation': 'my-reservation'}))
+        provisioner = failover.RetryingProvisioner(task, 'res1', 1)
+        result = provisioner.provision_with_retries()
+        assert result.resources.effective_provisioning_model() == \
+            'reserved'
+        models = {cfg.get('provisioning_model')
+                  for cfg in fake.injector.attempt_configs}
+        assert models == {'reserved'}
+
     def test_tpu_pod_creates_hosts(self, fake_cluster_env):
         task = Task(run='train')
         task.set_resources(Resources(accelerators='tpu-v5e-32'))

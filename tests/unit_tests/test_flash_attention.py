@@ -7,6 +7,9 @@ import pytest
 from skypilot_tpu.ops import attention as attention_ops
 from skypilot_tpu.ops import flash_attention as fa
 
+pytestmark = pytest.mark.slow  # interpret-mode kernels are minutes-scale
+
+
 
 def _rand(shape, seed):
     return jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)

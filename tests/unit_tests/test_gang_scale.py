@@ -4,8 +4,12 @@ import os
 import subprocess
 import time
 
+import pytest
+
 from skypilot_tpu.agent import gang
 from skypilot_tpu.utils import command_runner
+
+pytestmark = pytest.mark.slow  # heavy tier: subprocess e2e at scale
 
 
 def _runners(n, tmp_path):

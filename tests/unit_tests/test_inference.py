@@ -17,6 +17,9 @@ from skypilot_tpu.models import llama
 from skypilot_tpu.parallel import mesh as mesh_lib
 
 
+pytestmark = pytest.mark.slow  # heavy tier: subprocess e2e / jit compiles
+
+
 @pytest.fixture(scope='module')
 def tiny_engine():
     config = engine_lib.EngineConfig(

@@ -15,6 +15,9 @@ from skypilot_tpu import state
 from skypilot_tpu.agent import job_lib
 
 
+pytestmark = pytest.mark.slow  # heavy tier: subprocess e2e / jit compiles
+
+
 def _wait_status(backend, handle, job_id, timeout=20):
     deadline = time.time() + timeout
     while time.time() < deadline:

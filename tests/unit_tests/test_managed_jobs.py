@@ -14,6 +14,9 @@ from skypilot_tpu.jobs import core as jobs_core
 from skypilot_tpu.jobs import state as jobs_state
 
 
+pytestmark = pytest.mark.slow  # heavy tier: subprocess e2e / jit compiles
+
+
 @pytest.fixture
 def jobs_env(fake_cluster_env, monkeypatch, tmp_path):
     monkeypatch.setenv('XSKY_JOBS_DB', str(tmp_path / 'managed_jobs.db'))

@@ -12,6 +12,9 @@ from skypilot_tpu.parallel import mesh as mesh_lib
 from skypilot_tpu.train import trainer as trainer_lib
 
 
+pytestmark = pytest.mark.slow  # heavy tier: subprocess e2e / jit compiles
+
+
 @pytest.fixture(scope='module')
 def tiny():
     return llama.LLAMA_TINY

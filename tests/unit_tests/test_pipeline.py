@@ -12,6 +12,9 @@ from skypilot_tpu.parallel import pipeline as pipeline_lib
 from skypilot_tpu.train import trainer as trainer_lib
 
 
+pytestmark = pytest.mark.slow  # heavy tier: subprocess e2e / jit compiles
+
+
 def _stage_mesh(n_stages, data=1):
     n = data * n_stages
     plan = mesh_lib.MeshPlan(data=data, stage=n_stages)

@@ -12,6 +12,9 @@ from skypilot_tpu.models import qwen
 from skypilot_tpu.parallel import mesh as mesh_lib
 
 
+pytestmark = pytest.mark.slow  # heavy tier: subprocess e2e / jit compiles
+
+
 @pytest.fixture(scope='module')
 def tiny2():
     return qwen.QWEN_TINY

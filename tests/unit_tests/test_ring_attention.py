@@ -17,6 +17,9 @@ from skypilot_tpu.ops import ring_attention as ring_ops
 from skypilot_tpu.parallel import mesh as mesh_lib
 
 
+pytestmark = pytest.mark.slow  # heavy tier: subprocess e2e / jit compiles
+
+
 def _qkv(b=2, s=64, h=8, h_kv=4, d=16, dtype=jnp.float32, seed=0):
     keys = jax.random.split(jax.random.PRNGKey(seed), 3)
     q = jax.random.normal(keys[0], (b, s, h, d), dtype)

@@ -13,6 +13,9 @@ from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.serve import spot_placer as spot_placer_lib
 from skypilot_tpu.serve import state as serve_state
 
+
+pytestmark = pytest.mark.slow  # heavy tier: subprocess e2e / jit compiles
+
 SERVICE_YAML = textwrap.dedent("""\
     name: echo
     resources:

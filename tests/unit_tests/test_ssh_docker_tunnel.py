@@ -14,6 +14,9 @@ from skypilot_tpu.provision.ssh import instance as ssh_instance
 from skypilot_tpu.utils import command_runner
 
 
+pytestmark = pytest.mark.slow  # heavy tier: subprocess e2e / jit compiles
+
+
 @pytest.fixture
 def ssh_pool(tmp_path, monkeypatch):
     pools = {
