@@ -239,6 +239,14 @@ def main() -> None:
         'value': round(value, 2),
         'unit': 'TFLOP/s/chip',
         'vs_baseline': round(value / _BASELINE_MODEL_TFLOPS_PER_CHIP, 3),
+        # The metric is model-FLOPs-normalized per chip, but the scales
+        # differ: the baseline trained 8B Llama-3 on a v6e host; this
+        # config is what fits one chip of THIS host's HBM (8B bf16
+        # params alone exceed a 16 GB chip). Compare as achieved
+        # arithmetic intensity, not as same-model throughput.
+        'baseline_note': (
+            'baseline is Llama-3-8B on v6e-8 (23.5 model-TFLOP/s/chip); '
+            'bench model is sized to one chip — see model_params'),
         'tokens_per_sec_per_chip': round(
             metrics['tokens_per_sec_per_chip'], 1),
         'mfu': round(value / peak, 4),

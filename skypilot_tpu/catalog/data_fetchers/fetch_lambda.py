@@ -1,0 +1,94 @@
+"""Generate the Lambda Cloud catalog CSV (twin of
+sky/catalog/data_fetchers/fetch_lambda_cloud.py).
+
+With a $LAMBDA_API_KEY and egress, rows come live from
+`GET /api/v1/instance-types` (price_cents_per_hour + specs per type);
+offline (this environment) the checked-in CSV is generated from a
+static snapshot of Lambda's published on-demand price sheet. Lambda has
+no spot market (SpotPrice 0 → never offered for use_spot) and flat
+regions (the pseudo-zone equals the region).
+
+Run: python -m skypilot_tpu.catalog.data_fetchers.fetch_lambda
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Tuple
+
+# (instance_type, acc_name, acc_count, vcpus, mem_gib, acc_mem_gib, price)
+_SKUS: List[Tuple[str, str, float, float, float, float, float]] = [
+    ('gpu_1x_a10', 'A10', 1, 30, 200, 24, 0.75),
+    ('gpu_1x_a100_sxm4', 'A100', 1, 30, 200, 40, 1.29),
+    ('gpu_8x_a100_80gb_sxm4', 'A100-80GB', 8, 240, 1800, 640, 14.32),
+    ('gpu_1x_h100_pcie', 'H100', 1, 26, 200, 80, 2.49),
+    ('gpu_8x_h100_sxm5', 'H100', 8, 208, 1800, 640, 23.92),
+    ('gpu_1x_rtx6000', 'RTX6000', 1, 14, 46, 24, 0.50),
+    ('cpu_4x_general', '', 0, 4, 16, 0, 0.10),
+]
+
+_REGIONS = ['us-east-1', 'us-west-1', 'us-south-1', 'europe-central-1',
+            'asia-pacific-1']
+
+HEADER = ['InstanceType', 'AcceleratorName', 'AcceleratorCount', 'vCPUs',
+          'MemoryGiB', 'AcceleratorMemoryGiB', 'Price', 'SpotPrice',
+          'Region', 'AvailabilityZone']
+
+
+def rows_from_api() -> List[List[str]]:
+    """Live rows from /instance-types (requires key + egress)."""
+    from skypilot_tpu.provision.lambda_cloud import rest
+    reply = rest.Transport().call('GET', '/instance-types')
+    out = []
+    for name, entry in sorted(reply.get('data', {}).items()):
+        itype = entry.get('instance_type', {})
+        specs = itype.get('specs', {})
+        price = itype.get('price_cents_per_hour', 0) / 100.0
+        gpus = float(specs.get('gpus', 0))
+        acc = ''
+        if gpus and '_' in name:
+            # gpu_8x_a100_80gb_sxm4 → A100-80GB
+            parts = name.split('_')[2:]
+            acc = parts[0].upper()
+            if len(parts) > 1 and parts[1].endswith('gb'):
+                acc = f'{acc}-{parts[1].upper()}'
+        regions = [r['name']
+                   for r in entry.get('regions_with_capacity_available',
+                                      [])] or _REGIONS
+        for region in regions:
+            out.append([name, acc, f'{gpus:g}',
+                        f"{specs.get('vcpus', 0):g}",
+                        f"{specs.get('memory_gib', 0):g}", '0',
+                        f'{price:.4f}', '0', region, region])
+    return out
+
+
+def rows_static() -> List[List[str]]:
+    out = []
+    for itype, acc, count, vcpus, mem, acc_mem, price in _SKUS:
+        for region in _REGIONS:
+            out.append([itype, acc, f'{count:g}', f'{vcpus:g}',
+                        f'{mem:g}', f'{acc_mem:g}', f'{price:.4f}', '0',
+                        region, region])
+    return out
+
+
+def main() -> None:
+    try:
+        data = rows_from_api()
+        source = 'live API'
+    except Exception:  # pylint: disable=broad-except
+        data = rows_static()
+        source = 'static snapshot'
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, 'data', 'lambda', 'catalog.csv')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.writer(f)
+        writer.writerow(HEADER)
+        writer.writerows(data)
+    print(f'Wrote {path} ({source})')
+
+
+if __name__ == '__main__':
+    main()

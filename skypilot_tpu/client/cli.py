@@ -596,16 +596,20 @@ def api_status(limit):
 @api.command(name='logs')
 @click.argument('request_id')
 def api_logs(request_id):
-    """Show one request's outcome (result or error)."""
+    """Show one request's captured output and outcome."""
     import json as json_lib
     remote = _api_remote()
     if remote is not None:
-        record = remote.get_api_request(request_id)
+        record = remote.get_api_request(request_id, include_log=True)
+        log = (record or {}).get('log', '')
     else:
         from skypilot_tpu.server import requests_db
         record = requests_db.get(request_id)
+        log = requests_db.read_log(request_id)
     if record is None:
         raise click.ClickException(f'Unknown request {request_id}.')
+    if log:
+        click.echo(log, nl=False)
     status = record['status']
     click.echo(f"status: {getattr(status, 'value', status)}")
     if record.get('error'):

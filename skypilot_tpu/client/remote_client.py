@@ -64,10 +64,13 @@ class RemoteClient:
         resp.raise_for_status()
         return resp.json().get('requests', [])[:limit]
 
-    def get_api_request(self, request_id: str):
+    def get_api_request(self, request_id: str,
+                        include_log: bool = False):
         """Raw request record (no polling; terminal or not)."""
-        resp = self._client.get('/api/get',
-                                params={'request_id': request_id})
+        params = {'request_id': request_id}
+        if include_log:
+            params['include_log'] = '1'
+        resp = self._client.get('/api/get', params=params)
         if resp.status_code == 404:
             return None
         resp.raise_for_status()

@@ -7,7 +7,8 @@ from skypilot_tpu.clouds.azure import Azure
 from skypilot_tpu.clouds.fake import Fake
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.kubernetes import Kubernetes
+from skypilot_tpu.clouds.lambda_cloud import Lambda
 from skypilot_tpu.clouds.ssh import SSH
 
 __all__ = ['Cloud', 'CloudImplementationFeatures', 'Region', 'GCP', 'Fake',
-           'AWS', 'Azure', 'Kubernetes', 'SSH']
+           'AWS', 'Azure', 'Kubernetes', 'Lambda', 'SSH']

@@ -1,0 +1,81 @@
+"""Lambda Cloud: GPU boxes for cross-cloud optimization.
+
+Lean twin of sky/clouds/lambda_cloud.py:1-310 — catalog-backed
+feasibility via CatalogCloud, deploy variables for the 'lambda_cloud'
+provisioner (provision/lambda_cloud/instance.py), bearer-key credential
+probing. Platform facts: no stop (terminate-only), no spot market, flat
+regions, all ports open.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import catalog_cloud
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@registry.CLOUD_REGISTRY.register(aliases=['lambdacloud', 'lambda_cloud'])
+class Lambda(catalog_cloud.CatalogCloud):
+    _REPR = 'Lambda'
+
+    _UNSUPPORTED = {
+        cloud_lib.CloudImplementationFeatures.STOP:
+            'Lambda Cloud instances cannot stop; terminate instead.',
+        cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE:
+            'Lambda Cloud has no spot market.',
+    }
+
+    @property
+    def provisioner_module(self) -> str:
+        # 'lambda' is a Python keyword; the op-set module lives under
+        # provision/lambda_cloud/.
+        return 'lambda_cloud'
+
+    def unsupported_features_for_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        return dict(self._UNSUPPORTED)
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        vars: Dict[str, Any] = {
+            'cluster_name': cluster_name,
+            'region': region,
+            'zone': None,                 # flat regions
+            'instance_type': resources.instance_type,
+            'use_spot': False,
+        }
+        if resources.accelerators:
+            name, count = next(iter(resources.accelerators.items()))
+            vars.update({'gpu_type': name, 'gpu_count': count})
+        return vars
+
+    def provider_config_overrides(
+            self, node_config: Dict[str, Any]) -> Dict[str, Any]:
+        del node_config
+        return {}
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.lambda_cloud import rest
+        if rest.load_api_key() is not None:
+            return True, None
+        return False, (
+            'Lambda Cloud API key not found. Set $LAMBDA_API_KEY or '
+            f'populate {rest.CREDENTIALS_PATH} (api_key = ...).')
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        from skypilot_tpu.provision.lambda_cloud import rest
+        if os.path.exists(os.path.expanduser(rest.CREDENTIALS_PATH)):
+            return {rest.CREDENTIALS_PATH: rest.CREDENTIALS_PATH}
+        return {}
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        # Lambda does not meter egress.
+        return 0.0

@@ -1,0 +1,208 @@
+"""Lambda Cloud provisioner op-set.
+
+Behavioral twin of sky/provision/lambda_cloud/instance.py with one
+structural change: Lambda instances carry no tags, and the reference
+tracks cluster membership in a local metadata file (lambda_utils.py
+Metadata — explicitly not thread safe). Here membership rides the
+instance NAME (`<cluster>-<index>`), which the API stores server-side:
+any process can reconstruct the cluster from a plain list_instances, so
+status reconciliation works from a cold start with no local files.
+
+Platform facts encoded below: no stop/resume (terminate-only), no
+zones (regions are flat — the pseudo-zone equals the region), all
+ports open by default (open_ports is a no-op), one public IP per
+instance.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.lambda_cloud import rest
+
+logger = sky_logging.init_logger(__name__)
+
+_transport_factory = rest.Transport
+
+
+def set_transport_factory(factory) -> None:
+    global _transport_factory
+    _transport_factory = factory
+
+
+def _transport(provider_config: Dict[str, Any]) -> Any:
+    del provider_config
+    return _transport_factory()
+
+
+_STATE_MAP = {
+    'booting': 'PENDING',
+    'active': 'RUNNING',
+    'unhealthy': 'PENDING',
+    'terminating': None,
+    'terminated': None,
+}
+
+_SSH_KEY_NAME = 'xsky-key'
+
+
+def _instance_name(cluster_name: str, index: int) -> str:
+    return f'{cluster_name}-{index}'
+
+
+def _cluster_instances(t, cluster_name: str) -> List[Dict[str, Any]]:
+    out = []
+    for inst in t.call('GET', '/instances').get('data', []):
+        name = inst.get('name') or ''
+        prefix, _, idx = name.rpartition('-')
+        if prefix == cluster_name and idx.isdigit():
+            out.append(inst)
+    return sorted(out, key=lambda i: int(i['name'].rsplit('-', 1)[1]))
+
+
+def _ensure_ssh_key(t) -> str:
+    """Register our public key once; Lambda injects it at boot."""
+    import os
+    from skypilot_tpu import authentication
+    keys = t.call('GET', '/ssh-keys').get('data', [])
+    if any(k.get('name') == _SSH_KEY_NAME for k in keys):
+        return _SSH_KEY_NAME
+    _, public_key_path = authentication.get_or_generate_keys()
+    with open(os.path.expanduser(public_key_path),
+              encoding='utf-8') as f:
+        public_key = f.read().strip()
+    t.call('POST', '/ssh-keys',
+           {'name': _SSH_KEY_NAME, 'public_key': public_key})
+    return _SSH_KEY_NAME
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    del zone  # flat regions
+    t = _transport(config.provider_config)
+    node_cfg = config.node_config
+    try:
+        existing = _cluster_instances(t, cluster_name)
+        # Fill index GAPS, not just the tail: if node 1 of {0,1,2} died
+        # out-of-band, relaunching must recreate `<cluster>-1`, not a
+        # duplicate `<cluster>-2`.
+        taken = {int(i['name'].rsplit('-', 1)[1]) for i in existing}
+        missing_indices = sorted(set(range(config.count)) - taken)
+        created: List[str] = []
+        if missing_indices:
+            key_name = _ensure_ssh_key(t)
+            for node in missing_indices:
+                reply = t.call('POST', '/instance-operations/launch', {
+                    'region_name': region,
+                    'instance_type_name': node_cfg['instance_type'],
+                    'ssh_key_names': [key_name],
+                    'quantity': 1,
+                    'name': _instance_name(cluster_name, node),
+                })
+                ids = reply.get('data', {}).get('instance_ids', [])
+                if not ids:
+                    raise exceptions.CapacityError(
+                        f'Lambda launch returned no instance in {region}.')
+                created.extend(ids)
+    except rest.LambdaApiError as e:
+        raise rest.classify_error(e, region) from e
+    head = None
+    for inst in _cluster_instances(t, cluster_name):
+        if inst['name'].endswith('-0'):
+            head = inst['id']
+    return common.ProvisionRecord(
+        provider_name='lambda_cloud', cluster_name=cluster_name, region=region,
+        zone=None, resumed_instance_ids=[], created_instance_ids=created,
+        head_instance_id=head)
+
+
+def wait_instances(region: str, cluster_name: str, state: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   timeout_s: float = 900.0,
+                   poll_interval_s: float = 5.0) -> None:
+    del region
+    t = _transport(provider_config or {})
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        instances = _cluster_instances(t, cluster_name)
+        states = [_STATE_MAP.get(i.get('status', ''), 'PENDING')
+                  for i in instances]
+        if any(s is None for s in states):
+            raise exceptions.CapacityError(
+                f'Instance(s) of {cluster_name!r} terminated while '
+                f'waiting for {state}.')
+        if instances and all(s == state for s in states):
+            return
+        time.sleep(poll_interval_s)
+    raise exceptions.ProvisionError(
+        f'Cluster {cluster_name!r} did not reach {state} within '
+        f'{timeout_s}s.')
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    raise exceptions.NotSupportedError(
+        'Lambda Cloud instances cannot stop; terminate instead '
+        '(`xsky down`).')
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    t = _transport(provider_config)
+    ids = [i['id'] for i in _cluster_instances(t, cluster_name)]
+    if not ids:
+        return
+    try:
+        t.call('POST', '/instance-operations/terminate',
+               {'instance_ids': ids})
+    except rest.LambdaApiError as e:
+        raise rest.classify_error(e) from e
+
+
+def query_instances(cluster_name: str, provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    t = _transport(provider_config)
+    return {i['id']: _STATE_MAP.get(i.get('status', ''), 'PENDING')
+            for i in _cluster_instances(t, cluster_name)}
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Dict[str, Any]
+                     ) -> common.ClusterInfo:
+    t = _transport(provider_config)
+    instances: Dict[str, common.InstanceInfo] = {}
+    head_id = None
+    for inst in _cluster_instances(t, cluster_name):
+        index = int(inst['name'].rsplit('-', 1)[1])
+        state = _STATE_MAP.get(inst.get('status', ''), 'PENDING')
+        info = common.InstanceInfo(
+            instance_id=inst['id'],
+            internal_ip=inst.get('private_ip') or inst.get('ip', ''),
+            external_ip=inst.get('ip'),
+            status=state or 'TERMINATED',
+            tags={'cluster': cluster_name, 'node_index': str(index)},
+            slice_id=inst['id'],
+            host_index=0,
+        )
+        instances[inst['id']] = info
+        if index == 0:
+            head_id = inst['id']
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head_id,
+        provider_name='lambda_cloud',
+        provider_config=dict(provider_config or {}),
+        ssh_user='ubuntu')
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    # Lambda instances expose all ports on their public IP; nothing to do.
+    del cluster_name, ports, provider_config
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    del cluster_name, provider_config

@@ -1,0 +1,110 @@
+"""Lambda Cloud REST transport (urllib + bearer key, no SDK).
+
+Twin in role of the reference's LambdaCloudClient
+(sky/provision/lambda_cloud/lambda_utils.py), redesigned to match this
+repo's transport pattern (provision/{aws,azure,gcp}/rest.py): a thin
+`call()` with bounded 429 backoff and typed error classification the
+failover engine consumes directly — no error-string parsing upstream.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+API_ENDPOINT = 'https://cloud.lambdalabs.com/api/v1'
+CREDENTIALS_PATH = '~/.lambda_cloud/lambda_keys'
+_MAX_ATTEMPTS = 4
+_BACKOFF_S = 2.0
+
+
+class LambdaApiError(Exception):
+
+    def __init__(self, status: int, code: str, message: str) -> None:
+        super().__init__(f'{code or status}: {message}')
+        self.status = status
+        self.code = code or str(status)
+        self.message = message
+
+
+def load_api_key() -> Optional[str]:
+    """$LAMBDA_API_KEY, else the reference-compatible key file
+    (`api_key = ...` lines in ~/.lambda_cloud/lambda_keys)."""
+    key = os.environ.get('LAMBDA_API_KEY')
+    if key:
+        return key
+    path = os.path.expanduser(CREDENTIALS_PATH)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                if ' = ' in line:
+                    field, _, value = line.strip().partition(' = ')
+                    if field == 'api_key':
+                        return value
+    except OSError:
+        return None
+    return None
+
+
+def classify_error(e: LambdaApiError,
+                   region: Optional[str] = None) -> Exception:
+    """Map Lambda error codes onto the failover engine's taxonomy."""
+    code = (e.code or '').lower()
+    text = f'{code} {e.message}'.lower()
+    where = f' in {region}' if region else ''
+    if 'insufficient-capacity' in text or 'not enough capacity' in text:
+        return exceptions.CapacityError(f'Lambda capacity{where}: {e}')
+    if 'quota' in text:
+        return exceptions.QuotaExceededError(f'Lambda quota{where}: {e}')
+    if e.status in (401, 403):
+        return exceptions.PermissionError_(f'Lambda auth: {e}')
+    if e.status == 400:
+        return exceptions.InvalidRequestError(f'Lambda request: {e}')
+    return exceptions.ProvisionError(f'Lambda API{where}: {e}')
+
+
+class Transport:
+
+    def __init__(self, api_key: Optional[str] = None) -> None:
+        key = api_key or load_api_key()
+        if not key:
+            raise exceptions.PermissionError_(
+                'Lambda Cloud API key not found (set $LAMBDA_API_KEY or '
+                f'populate {CREDENTIALS_PATH}).')
+        self._key = key
+
+    def call(self, method: str, path: str,
+             body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        url = f'{API_ENDPOINT}{path}'
+        data = json.dumps(body).encode() if body is not None else None
+        for attempt in range(_MAX_ATTEMPTS):
+            req = urllib.request.Request(
+                url, data=data, method=method,
+                headers={'Authorization': f'Bearer {self._key}',
+                         'Content-Type': 'application/json'})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    return json.loads(resp.read() or b'{}')
+            except urllib.error.HTTPError as e:
+                if e.code == 429 and attempt < _MAX_ATTEMPTS - 1:
+                    # Launch calls are rate limited ~1/10s: back off.
+                    time.sleep(_BACKOFF_S * (attempt + 1))
+                    continue
+                try:
+                    payload = json.loads(e.read() or b'{}')
+                    err = payload.get('error', {})
+                    raise LambdaApiError(e.code, err.get('code', ''),
+                                         err.get('message', str(e)))
+                except (ValueError, AttributeError):
+                    raise LambdaApiError(e.code, '', str(e)) from e
+            except urllib.error.URLError as e:
+                raise exceptions.ProvisionError(
+                    f'Lambda API unreachable: {e}') from e
+        raise exceptions.ProvisionError('Lambda API rate limit persisted.')

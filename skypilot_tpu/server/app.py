@@ -53,6 +53,8 @@ def _get_request(params: Dict[str, str]) -> Tuple[int, Dict[str, Any]]:
         payload['result'] = payloads.jsonify(record['result'])
     elif record['status'] == requests_db.RequestStatus.FAILED:
         payload['error'] = record['error']
+    if params.get('include_log') == '1':
+        payload['log'] = requests_db.read_log(record['request_id'])
     return 200, payload
 
 

@@ -12,16 +12,86 @@ reference's mock_client_requests (tests/common_test_fixtures.py:52-135).
 from __future__ import annotations
 
 import concurrent.futures
+import os
+import sys
 import threading
 import time
 import traceback
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, TextIO
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
 from skypilot_tpu.server import requests_db
 
 logger = sky_logging.init_logger(__name__)
+
+
+class _StreamRouter:
+    """Route a worker thread's stdout/stderr into its request log.
+
+    The reference captures per-request output by giving each request a
+    worker *process*; this executor uses threads, where sys.stdout is
+    process-global — so stdout is replaced once with this router and
+    each request thread registers its own sink for the duration of its
+    request. Unregistered threads (the HTTP handler, background
+    daemons) pass through to the real stream.
+    """
+
+    def __init__(self, real: TextIO) -> None:
+        self._real = real
+        self._routes: Dict[int, TextIO] = {}
+
+    def register(self, sink: TextIO) -> None:
+        self._routes[threading.get_ident()] = sink
+
+    def unregister(self) -> None:
+        self._routes.pop(threading.get_ident(), None)
+
+    def _target(self) -> TextIO:
+        return self._routes.get(threading.get_ident(), self._real)
+
+    def write(self, data: str) -> int:
+        target = self._target()
+        n = target.write(data)
+        if target is not self._real:
+            target.flush()
+        return n
+
+    def flush(self) -> None:
+        try:
+            self._target().flush()
+        except ValueError:
+            pass  # sink already closed (late writer)
+
+    def __getattr__(self, item):
+        return getattr(self._real, item)
+
+
+_router_lock = threading.Lock()
+_routers: Optional[tuple] = None
+
+
+def _install_routers():
+    """Ensure sys.stdout/stderr ARE the routers.
+
+    Called at every request start, not just once: test harnesses
+    (pytest capture) save/restore sys.stdout around each test, which
+    silently displaces the router — re-hooking keeps capture working
+    while pointing the passthrough at whatever stream is current.
+    """
+    global _routers
+    with _router_lock:
+        if _routers is None:
+            out, err = _StreamRouter(sys.stdout), _StreamRouter(sys.stderr)
+            _routers = (out, err)
+        out, err = _routers
+        if sys.stdout is not out:
+            out._real = sys.stdout
+            sys.stdout = out
+        if sys.stderr is not err:
+            err._real = sys.stderr
+            sys.stderr = err
+    return _routers
 
 LONG_REQUESTS = {'launch', 'exec', 'start', 'stop', 'down', 'jobs.launch',
                  'serve.up', 'serve.update', 'serve.down'}
@@ -49,14 +119,26 @@ def _pools():
 
 
 def _run_request(request_id: str, func: Callable[..., Any],
-                 kwargs: Dict[str, Any]) -> None:
+                 kwargs: Dict[str, Any],
+                 capture_output: bool = True) -> None:
     from skypilot_tpu.server import metrics
     record = requests_db.get(request_id)
     if record is None or record['status'].is_terminal():
         return  # cancelled before start
     requests_db.set_status(request_id, requests_db.RequestStatus.RUNNING)
     start = time.monotonic()
+    sink = None
+    out_router = err_router = None
     try:
+        if capture_output:
+            # Inside the try: an unwritable log dir must FAIL the
+            # request, not strand it RUNNING forever.
+            out_router, err_router = _install_routers()
+            path = requests_db.log_path(request_id)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            sink = open(path, 'a', encoding='utf-8', errors='replace')
+            out_router.register(sink)
+            err_router.register(sink)
         result = func(**kwargs)
         requests_db.finish(request_id, result=result)
         metrics.observe_request(record['name'], 'succeeded',
@@ -68,6 +150,12 @@ def _run_request(request_id: str, func: Callable[..., Any],
                            error=exceptions.serialize_exception(e))
         metrics.observe_request(record['name'], 'failed',
                                 time.monotonic() - start)
+    finally:
+        if sink is not None:
+            if out_router is not None:
+                out_router.unregister()
+                err_router.unregister()
+            sink.close()
 
 
 def schedule_request(name: str, user: str, body: Dict[str, Any],
@@ -75,7 +163,8 @@ def schedule_request(name: str, user: str, body: Dict[str, Any],
                      kwargs: Dict[str, Any]) -> str:
     request_id = requests_db.create(name, user, body)
     if _synchronous:
-        _run_request(request_id, func, kwargs)
+        # Inline test mode: no routing — capsys/pytest own the streams.
+        _run_request(request_id, func, kwargs, capture_output=False)
         return request_id
     long_pool, short_pool = _pools()
     pool = long_pool if name in LONG_REQUESTS else short_pool

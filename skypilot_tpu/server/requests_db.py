@@ -37,6 +37,25 @@ def _db_path() -> str:
         os.environ.get('XSKY_SERVER_DB', '~/.xsky/server/requests.db'))
 
 
+def log_path(request_id: str) -> str:
+    """Per-request captured-output file (`xsky api logs` reads it;
+    twin of the reference's per-request log files,
+    sky/server/requests/requests.py)."""
+    return os.path.join(os.path.dirname(_db_path()), 'request_logs',
+                        f'{request_id}.log')
+
+
+def read_log(request_id: str, max_bytes: int = 1 << 20) -> str:
+    path = log_path(request_id)
+    if not os.path.exists(path):
+        return ''
+    size = os.path.getsize(path)
+    with open(path, 'rb') as f:
+        if size > max_bytes:
+            f.seek(size - max_bytes)
+        return f.read().decode('utf-8', errors='replace')
+
+
 def _get_conn() -> sqlite3.Connection:
     global _conn, _conn_path
     path = _db_path()

@@ -25,11 +25,27 @@ def _default_level() -> int:
     return logging.INFO
 
 
+class _LateBoundStdout:
+    """Resolve `sys.stdout` at WRITE time, not handler-creation time.
+
+    The API server's executor routes each request thread's stdout into
+    that request's log by swapping `sys.stdout` (and pytest's capture
+    does the same per test); a StreamHandler bound to the original
+    stream object would silently bypass both.
+    """
+
+    def write(self, data: str) -> int:
+        return sys.stdout.write(data)
+
+    def flush(self) -> None:
+        sys.stdout.flush()
+
+
 def init_logger(name: str) -> logging.Logger:
     with _setup_lock:
         root = logging.getLogger(_root_name)
         if not root.handlers:
-            handler = logging.StreamHandler(sys.stdout)
+            handler = logging.StreamHandler(_LateBoundStdout())
             handler.setFormatter(logging.Formatter(_FORMAT, _DATE_FORMAT))
             root.addHandler(handler)
             root.setLevel(_default_level())

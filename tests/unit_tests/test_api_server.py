@@ -120,6 +120,34 @@ class TestRemoteSdk:
         assert result['fake']['enabled'] is True
 
 
+class TestRequestOutputCapture:
+    """Per-request stdout capture (twin of the reference's per-request
+    log files): a launch's streamed job output must land in the
+    request's log and surface through `/api/get?include_log=1`."""
+
+    def test_launch_output_captured_per_request(self, client, api_server):
+        task = {'name': 'cap', 'run': 'echo captured-line-xyz',
+                'resources': {'cloud': 'fake',
+                              'accelerators': 'tpu-v5e-8'}}
+        rid = client._submit('launch',
+                             {'task': task, 'cluster_name': 'cap1'})
+        client._get(rid)   # wait for completion
+        payload = _get_json(
+            f'{api_server}/api/get?request_id={rid}&include_log=1')
+        assert 'captured-line-xyz' in payload.get('log', '')
+        # logging-module output (provisioning progress) must be
+        # captured too, not just raw stdout writes: the log handler
+        # late-binds sys.stdout (sky_logging._LateBoundStdout).
+        assert "Provisioning 'cap1'" in payload['log']
+        # A different request's log does not leak in.
+        rid2 = client._submit('status', {})
+        client._get(rid2)
+        payload2 = _get_json(
+            f'{api_server}/api/get?request_id={rid2}&include_log=1')
+        assert 'captured-line-xyz' not in payload2.get('log', '')
+        client._submit('down', {'cluster_name': 'cap1'})
+
+
 class TestMetrics:
     """Prometheus /metrics endpoint (twin of sky/server/metrics.py)."""
 

@@ -73,10 +73,21 @@ def proxy_env(binaries, tmp_path):
     })
     server = subprocess.Popen([binaries['server'], sock_path], env=env,
                               stderr=subprocess.PIPE)
+    # Wait until the server actually ACCEPTS connections: the socket
+    # file appears at bind(), before listen(), and a shim connecting in
+    # that window gets ECONNREFUSED (observed as a suite-order flake).
     deadline = time.time() + 10
-    while not os.path.exists(sock_path):
+    while True:
         assert time.time() < deadline, 'server did not start'
         assert server.poll() is None, server.stderr.read()
+        if os.path.exists(sock_path):
+            probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                probe.connect(sock_path)
+                probe.close()
+                break
+            except (ConnectionRefusedError, OSError):
+                probe.close()
         time.sleep(0.05)
     yield {'env': env, 'log': log, 'binaries': binaries}
     server.terminate()

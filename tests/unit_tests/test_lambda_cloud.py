@@ -1,0 +1,183 @@
+"""Lambda Cloud provisioner tests against an in-memory API fake.
+
+Same pattern as the GCP/Azure fakes (role of moto in the reference's
+tests): scripted capacity errors, no network.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.lambda_cloud import instance as lambda_instance
+from skypilot_tpu.provision.lambda_cloud import rest
+
+
+class FakeLambda:
+    """Minimal in-memory Lambda Cloud API v1."""
+
+    def __init__(self) -> None:
+        self.instances: Dict[str, Dict[str, Any]] = {}
+        self.ssh_keys: List[Dict[str, str]] = []
+        self.fail_launch: Optional[rest.LambdaApiError] = None
+        self._next_id = 0
+
+    def call(self, method: str, path: str,
+             body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        if path == '/instances' and method == 'GET':
+            return {'data': list(self.instances.values())}
+        if path == '/ssh-keys' and method == 'GET':
+            return {'data': list(self.ssh_keys)}
+        if path == '/ssh-keys' and method == 'POST':
+            self.ssh_keys.append(dict(body))
+            return {'data': dict(body)}
+        if path == '/instance-operations/launch':
+            if self.fail_launch is not None:
+                err, self.fail_launch = self.fail_launch, None
+                raise err
+            ids = []
+            for _ in range(body.get('quantity', 1)):
+                iid = f'lmb-{self._next_id}'
+                self._next_id += 1
+                self.instances[iid] = {
+                    'id': iid,
+                    'name': body['name'],
+                    'status': 'active',
+                    'ip': f'129.1.0.{self._next_id}',
+                    'private_ip': f'10.9.0.{self._next_id}',
+                    'region': {'name': body['region_name']},
+                    'instance_type': {
+                        'name': body['instance_type_name']},
+                }
+                ids.append(iid)
+            return {'data': {'instance_ids': ids}}
+        if path == '/instance-operations/terminate':
+            gone = [self.instances.pop(i, None)
+                    for i in body['instance_ids']]
+            return {'data': {'terminated_instances':
+                             [g for g in gone if g]}}
+        raise AssertionError(f'unhandled Lambda call {method} {path}')
+
+
+@pytest.fixture()
+def fake_lambda(monkeypatch, tmp_path):
+    fake = FakeLambda()
+    monkeypatch.setattr(lambda_instance, '_transport_factory',
+                        lambda: fake)
+    # Key generation writes under ~/.ssh; point it at tmp.
+    from skypilot_tpu import authentication
+    monkeypatch.setattr(authentication, 'PRIVATE_KEY_PATH',
+                        str(tmp_path / 'key'))
+    monkeypatch.setattr(authentication, 'PUBLIC_KEY_PATH',
+                        str(tmp_path / 'key.pub'))
+    yield fake
+
+
+PROVIDER: Dict[str, Any] = {}
+
+
+def _config(count=1, itype='gpu_1x_a100_sxm4'):
+    return common.ProvisionConfig(
+        provider_config=dict(PROVIDER),
+        node_config={'instance_type': itype},
+        count=count)
+
+
+def test_launch_lifecycle(fake_lambda):
+    record = lambda_instance.run_instances('us-east-1', None, 'c1',
+                                           _config(count=2))
+    assert len(record.created_instance_ids) == 2
+    assert record.head_instance_id is not None
+    # Membership rides the instance name, reconstructable cold.
+    info = lambda_instance.get_cluster_info('us-east-1', 'c1', PROVIDER)
+    assert info.num_instances == 2
+    hosts = info.sorted_instances()
+    assert info.head_instance_id == hosts[0].instance_id
+    assert all(h.external_ip for h in hosts)
+    statuses = lambda_instance.query_instances('c1', PROVIDER)
+    assert set(statuses.values()) == {'RUNNING'}
+    # The ssh key was registered exactly once.
+    assert len(fake_lambda.ssh_keys) == 1
+    lambda_instance.terminate_instances('c1', PROVIDER)
+    assert lambda_instance.query_instances('c1', PROVIDER) == {}
+
+
+def test_cluster_name_with_dashes_not_confused(fake_lambda):
+    lambda_instance.run_instances('us-east-1', None, 'xsky-a', _config())
+    lambda_instance.run_instances('us-east-1', None, 'xsky-a-b',
+                                  _config())
+    assert len(lambda_instance.query_instances('xsky-a', {})) == 1
+    assert len(lambda_instance.query_instances('xsky-a-b', {})) == 1
+
+
+def test_idempotent_relaunch(fake_lambda):
+    lambda_instance.run_instances('us-east-1', None, 'c2', _config())
+    record = lambda_instance.run_instances('us-east-1', None, 'c2',
+                                           _config())
+    assert record.created_instance_ids == []
+    assert len(fake_lambda.instances) == 1
+
+
+def test_capacity_error_classified(fake_lambda):
+    fake_lambda.fail_launch = rest.LambdaApiError(
+        400, 'instance-operations/launch/insufficient-capacity',
+        'Not enough capacity to fulfill launch request.')
+    with pytest.raises(exceptions.CapacityError):
+        lambda_instance.run_instances('us-east-1', None, 'c3', _config())
+
+
+def test_auth_error_classified():
+    err = rest.classify_error(
+        rest.LambdaApiError(403, 'global/invalid-api-key', 'bad key'))
+    assert isinstance(err, exceptions.PermissionError_)
+
+
+def test_stop_unsupported(fake_lambda):
+    with pytest.raises(exceptions.NotSupportedError):
+        lambda_instance.stop_instances('c1', PROVIDER)
+
+
+def test_wait_instances(fake_lambda):
+    lambda_instance.run_instances('us-east-1', None, 'c4', _config())
+    lambda_instance.wait_instances('us-east-1', 'c4', 'RUNNING',
+                                   PROVIDER, timeout_s=5,
+                                   poll_interval_s=0.01)
+    # A terminated-under-us instance surfaces as CapacityError.
+    for inst in fake_lambda.instances.values():
+        inst['status'] = 'terminated'
+    with pytest.raises(exceptions.CapacityError):
+        lambda_instance.wait_instances('us-east-1', 'c4', 'RUNNING',
+                                       PROVIDER, timeout_s=5,
+                                       poll_interval_s=0.01)
+
+
+def test_cloud_feasibility_and_pricing(monkeypatch):
+    """Catalog-backed: A100/H100 offerings rank in the optimizer."""
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.utils import registry
+    cloud = registry.CLOUD_REGISTRY.from_str('lambda')
+    r = resources_lib.Resources(accelerators='A100:1')
+    feasible, _ = cloud.get_feasible_launchable_resources(r)
+    assert feasible
+    assert feasible[0].instance_type == 'gpu_1x_a100_sxm4'
+    assert feasible[0].get_hourly_cost() == pytest.approx(1.29)
+    # No spot market: a spot request yields nothing on lambda.
+    regions = cloud.regions_with_offering('gpu_1x_a100_sxm4', None,
+                                          use_spot=True, region=None,
+                                          zone=None)
+    assert regions == []
+
+
+def test_check_credentials(monkeypatch, tmp_path):
+    from skypilot_tpu.utils import registry
+    cloud = registry.CLOUD_REGISTRY.from_str('lambda')
+    monkeypatch.delenv('LAMBDA_API_KEY', raising=False)
+    monkeypatch.setattr(rest, 'CREDENTIALS_PATH',
+                        str(tmp_path / 'lambda_keys'))
+    ok, reason = cloud.check_credentials()
+    assert not ok and 'LAMBDA_API_KEY' in reason
+    monkeypatch.setenv('LAMBDA_API_KEY', 'secret_123')
+    ok, _ = cloud.check_credentials()
+    assert ok
