@@ -1,0 +1,129 @@
+"""Generate the RunPod catalog CSV (twin of
+sky/catalog/data_fetchers/fetch_runpod... — the reference vendors a
+prebuilt catalog for RunPod; this repo generates its own).
+
+With a $RUNPOD_API_KEY and egress, rows come live from the GraphQL
+`gpuTypes` query (securePrice/communitySpotPrice per GPU); offline
+(this environment) the checked-in CSV is generated from a static
+snapshot of RunPod's published secure-cloud price sheet. The
+interruptible ("spot") market price is the community spot rate.
+
+InstanceType grammar: `{count}x_{ACC}` — pods are sized by GPU count
+only; vCPU/RAM scale with the GPU (snapshot below uses RunPod's
+per-GPU allocations).
+
+Run: python -m skypilot_tpu.catalog.data_fetchers.fetch_runpod
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Tuple
+
+# (acc_name, acc_mem_gib, vcpus_per_gpu, mem_gib_per_gpu,
+#  price_per_gpu, spot_price_per_gpu, max_count)
+_SKUS: List[Tuple[str, float, float, float, float, float, int]] = [
+    ('A40', 48, 9, 48, 0.39, 0.20, 8),
+    ('L4', 24, 12, 50, 0.43, 0.22, 8),
+    ('L40S', 48, 16, 62, 0.86, 0.43, 8),
+    ('RTX4090', 24, 16, 62, 0.69, 0.35, 8),
+    ('RTX5090', 32, 16, 94, 0.89, 0.45, 8),
+    ('RTXA6000', 48, 9, 50, 0.76, 0.38, 8),
+    ('RTX6000-Ada', 48, 16, 62, 0.77, 0.39, 8),
+    ('A100-80GB', 80, 8, 117, 1.64, 0.82, 8),
+    ('A100-80GB-SXM', 80, 16, 125, 1.89, 0.95, 8),
+    ('H100', 80, 16, 188, 2.39, 1.20, 8),
+    ('H100-SXM', 80, 20, 125, 2.99, 1.50, 8),
+    ('H200-SXM', 141, 24, 251, 3.59, 1.80, 8),
+    ('B200', 180, 28, 283, 5.99, 2.99, 8),
+    ('MI300X', 192, 24, 283, 2.49, 1.25, 8),
+]
+
+_REGIONS = ['US-CA-2', 'US-GA-1', 'US-TX-3', 'CA-MTL-1', 'EU-RO-1',
+            'EU-SE-1', 'AP-JP-1']
+
+HEADER = ['InstanceType', 'AcceleratorName', 'AcceleratorCount', 'vCPUs',
+          'MemoryGiB', 'AcceleratorMemoryGiB', 'Price', 'SpotPrice',
+          'Region', 'AvailabilityZone']
+
+_GPU_TYPES_QUERY = """
+query GpuTypes {
+  gpuTypes {
+    id
+    displayName
+    memoryInGb
+    securePrice
+    communitySpotPrice
+    maxGpuCount
+  }
+}
+"""
+
+
+def rows_from_api() -> List[List[str]]:
+    """Live rows from the gpuTypes query (requires key + egress)."""
+    from skypilot_tpu.clouds.runpod import ACC_TO_GPU_ID
+    from skypilot_tpu.provision.runpod import rest
+    id_to_acc = {v: k for k, v in ACC_TO_GPU_ID.items()}
+    # The gpuTypes query reports GPU VRAM, not the host's vCPU/RAM
+    # allocation; host specs come from the per-SKU snapshot (RunPod's
+    # published per-GPU allocations) keyed by accelerator.
+    host_specs = {acc: (vcpus, mem)
+                  for (acc, _, vcpus, mem, _, _, _) in _SKUS}
+    reply = rest.Transport().call(_GPU_TYPES_QUERY)
+    out = []
+    for gpu in reply.get('gpuTypes', []):
+        acc = id_to_acc.get(gpu['id'])
+        price = gpu.get('securePrice')
+        if acc is None or not price:
+            continue
+        spot = gpu.get('communitySpotPrice') or 0
+        acc_mem = gpu.get('memoryInGb', 0)
+        vcpus, host_mem = host_specs.get(acc, (8, 2 * acc_mem))
+        for count in (1, 2, 4, 8):
+            if count > gpu.get('maxGpuCount', 8):
+                continue
+            for region in _REGIONS:
+                out.append([
+                    f'{count}x_{acc}', acc, f'{count}',
+                    f'{vcpus * count:g}', f'{host_mem * count:g}',
+                    f'{acc_mem:g}',
+                    f'{price * count:.4f}', f'{spot * count:.4f}',
+                    region, region])
+    return out
+
+
+def rows_static() -> List[List[str]]:
+    out = []
+    for (acc, acc_mem, vcpus, mem, price, spot, max_count) in _SKUS:
+        for count in (1, 2, 4, 8):
+            if count > max_count:
+                continue
+            for region in _REGIONS:
+                out.append([
+                    f'{count}x_{acc}', acc, f'{count}',
+                    f'{vcpus * count:g}', f'{mem * count:g}',
+                    f'{acc_mem:g}', f'{price * count:.4f}',
+                    f'{spot * count:.4f}', region, region])
+    return out
+
+
+def main() -> None:
+    try:
+        data = rows_from_api()
+        source = 'live API'
+    except Exception:  # pylint: disable=broad-except
+        data = rows_static()
+        source = 'static snapshot'
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, 'data', 'runpod', 'catalog.csv')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.writer(f)
+        writer.writerow(HEADER)
+        writer.writerows(data)
+    print(f'Wrote {path} ({source})')
+
+
+if __name__ == '__main__':
+    main()

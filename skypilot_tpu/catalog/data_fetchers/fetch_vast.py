@@ -1,0 +1,114 @@
+"""Generate the Vast.ai catalog CSV (role of the reference's
+sky/catalog/vast_catalog.py construction).
+
+Vast is a live marketplace, so any catalog is an approximation: with a
+$VAST_API_KEY and egress, rows come from a `/bundles/` offer sweep
+aggregated per (gpu, count, country) at the median on-demand price;
+offline (this environment) the checked-in CSV is a static snapshot of
+typical marketplace medians. The provisioner re-searches live offers
+at launch, so catalog staleness only affects optimizer ranking, not
+correctness.
+
+InstanceType grammar: `{count}x_{ACC}` (same as runpod).
+
+Run: python -m skypilot_tpu.catalog.data_fetchers.fetch_vast
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Tuple
+
+# (acc_name, acc_mem_gib, vcpus_per_gpu, mem_gib_per_gpu,
+#  median_price_per_gpu, median_bid_per_gpu)
+_SKUS: List[Tuple[str, float, float, float, float, float]] = [
+    ('RTX3090', 24, 8, 32, 0.22, 0.11),
+    ('RTX4090', 24, 12, 48, 0.35, 0.18),
+    ('RTX5090', 32, 14, 64, 0.55, 0.28),
+    ('RTXA6000', 48, 10, 48, 0.45, 0.23),
+    ('L40S', 48, 12, 62, 0.67, 0.34),
+    ('A100-80GB', 80, 12, 96, 1.10, 0.55),
+    ('H100', 80, 16, 128, 1.93, 0.97),
+    ('H100-SXM', 80, 20, 128, 2.30, 1.15),
+    ('H200-SXM', 141, 24, 192, 2.90, 1.45),
+]
+
+# Two-letter country codes (Vast geolocations end in one; the
+# provisioner matches on that suffix).
+_REGIONS = ['US', 'CA', 'DE', 'SE', 'JP']
+
+HEADER = ['InstanceType', 'AcceleratorName', 'AcceleratorCount', 'vCPUs',
+          'MemoryGiB', 'AcceleratorMemoryGiB', 'Price', 'SpotPrice',
+          'Region', 'AvailabilityZone']
+
+
+def rows_from_api() -> List[List[str]]:
+    """Live medians from an offer sweep (requires key + egress)."""
+    import statistics
+    from skypilot_tpu.clouds.vast import ACC_TO_GPU_NAME
+    from skypilot_tpu.provision.vast import rest
+    t = rest.Transport()
+    out = []
+    for acc, gpu_name in ACC_TO_GPU_NAME.items():
+        for count in (1, 2, 4, 8):
+            reply = t.call('PUT', '/bundles/', {'q': {
+                'verified': {'eq': True}, 'rentable': {'eq': True},
+                'gpu_name': {'eq': gpu_name},
+                'num_gpus': {'eq': count},
+                'order': [['dph_total', 'asc']], 'type': 'on-demand'}})
+            offers = reply.get('offers', [])
+            if not offers:
+                continue
+            by_cc = {}
+            for offer in offers:
+                cc = (offer.get('geolocation') or 'US')[-2:]
+                by_cc.setdefault(cc, []).append(offer)
+            for cc, group in sorted(by_cc.items()):
+                price = statistics.median(
+                    o['dph_total'] for o in group)
+                bid = statistics.median(
+                    o.get('min_bid', price / 2) for o in group)
+                sample = group[0]
+                out.append([
+                    f'{count}x_{acc}', acc, f'{count}',
+                    f"{sample.get('cpu_cores_effective', 8 * count):g}",
+                    f"{sample.get('cpu_ram', 0) / 1024:g}",
+                    f"{sample.get('gpu_ram', 0) / 1024:g}",
+                    f'{price:.4f}', f'{bid:.4f}', cc, cc])
+    if not out:
+        raise RuntimeError('offer sweep returned nothing')
+    return out
+
+
+def rows_static() -> List[List[str]]:
+    out = []
+    for (acc, acc_mem, vcpus, mem, price, bid) in _SKUS:
+        for count in (1, 2, 4, 8):
+            for region in _REGIONS:
+                out.append([
+                    f'{count}x_{acc}', acc, f'{count}',
+                    f'{vcpus * count:g}', f'{mem * count:g}',
+                    f'{acc_mem:g}', f'{price * count:.4f}',
+                    f'{bid * count:.4f}', region, region])
+    return out
+
+
+def main() -> None:
+    try:
+        data = rows_from_api()
+        source = 'live API'
+    except Exception:  # pylint: disable=broad-except
+        data = rows_static()
+        source = 'static snapshot'
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, 'data', 'vast', 'catalog.csv')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.writer(f)
+        writer.writerow(HEADER)
+        writer.writerows(data)
+    print(f'Wrote {path} ({source})')
+
+
+if __name__ == '__main__':
+    main()

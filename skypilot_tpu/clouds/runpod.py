@@ -1,0 +1,117 @@
+"""RunPod: marketplace GPU pods for cross-cloud optimization.
+
+Lean twin of sky/clouds/runpod.py:1-314 — catalog-backed feasibility
+via CatalogCloud, deploy variables for the 'runpod' provisioner
+(provision/runpod/instance.py), GraphQL-key credential probing.
+Platform facts: pods are docker containers (no custom VM images, no
+port re-opening after create), stop supported, spot = the
+"interruptible" market (needs a per-GPU bid), flat data-center regions.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import catalog_cloud
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+# Catalog accelerator name → RunPod gpuTypeId (their display ids; the
+# same mapping role as the reference's GPU_NAME_MAP,
+# sky/provision/runpod/utils.py:16).
+ACC_TO_GPU_ID = {
+    'A40': 'NVIDIA A40',
+    'L4': 'NVIDIA L4',
+    'L40S': 'NVIDIA L40S',
+    'RTX4090': 'NVIDIA GeForce RTX 4090',
+    'RTX5090': 'NVIDIA GeForce RTX 5090',
+    'RTXA6000': 'NVIDIA RTX A6000',
+    'RTX6000-Ada': 'NVIDIA RTX 6000 Ada Generation',
+    'A100-80GB': 'NVIDIA A100 80GB PCIe',
+    'A100-80GB-SXM': 'NVIDIA A100-SXM4-80GB',
+    'H100': 'NVIDIA H100 PCIe',
+    'H100-SXM': 'NVIDIA H100 80GB HBM3',
+    'H200-SXM': 'NVIDIA H200',
+    'B200': 'NVIDIA B200',
+    'MI300X': 'AMD Instinct MI300X OAM',
+}
+
+DEFAULT_IMAGE = 'runpod/base:0.6.2-cuda12.4.1'
+
+
+@registry.CLOUD_REGISTRY.register()
+class RunPod(catalog_cloud.CatalogCloud):
+    _REPR = 'RunPod'
+
+    _UNSUPPORTED = {
+        cloud_lib.CloudImplementationFeatures.OPEN_PORTS:
+            'RunPod port mappings are fixed at pod creation.',
+        cloud_lib.CloudImplementationFeatures.CUSTOM_DISK_TIER:
+            'RunPod pods have no disk tiers.',
+    }
+
+    @property
+    def provisioner_module(self) -> str:
+        return 'runpod'
+
+    def unsupported_features_for_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        return dict(self._UNSUPPORTED)
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        # InstanceType grammar: `{count}x_{ACC}` (e.g. 2x_H100-SXM).
+        itype = resources.instance_type
+        count_s, _, acc = itype.partition('x_')
+        gpu_type_id = ACC_TO_GPU_ID.get(acc, acc)
+        vars: Dict[str, Any] = {
+            'cluster_name': cluster_name,
+            'region': region,
+            'zone': None,                 # flat data centers
+            'instance_type': itype,
+            'gpu_type_id': gpu_type_id,
+            'gpu_count': int(count_s),
+            'cloud_type': 'SECURE',
+            'image_name': resources.image_id or DEFAULT_IMAGE,
+            'disk_size': resources.disk_size,
+            'use_spot': resources.use_spot,
+        }
+        if resources.use_spot:
+            # Interruptible pods need a per-GPU bid; bid the current
+            # market (catalog spot) price.
+            spot_hourly = self.instance_type_to_hourly_cost(
+                itype, use_spot=True, region=region, zone=None)
+            vars['bid_per_gpu'] = round(spot_hourly / int(count_s), 4)
+        if resources.accelerators:
+            name, count = next(iter(resources.accelerators.items()))
+            vars.update({'gpu_type': name, 'acc_count': count})
+        return vars
+
+    def provider_config_overrides(
+            self, node_config: Dict[str, Any]) -> Dict[str, Any]:
+        del node_config
+        return {}
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.runpod import rest
+        if rest.load_api_key() is not None:
+            return True, None
+        return False, (
+            'RunPod API key not found. Set $RUNPOD_API_KEY or populate '
+            f'{rest.CONFIG_PATH} (api_key = "...").')
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        from skypilot_tpu.provision.runpod import rest
+        if os.path.exists(os.path.expanduser(rest.CONFIG_PATH)):
+            return {rest.CONFIG_PATH: rest.CONFIG_PATH}
+        return {}
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        # RunPod does not meter egress.
+        return 0.0

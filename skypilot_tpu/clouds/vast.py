@@ -1,0 +1,116 @@
+"""Vast.ai: marketplace GPU containers for cross-cloud optimization.
+
+Lean twin of sky/clouds/vast.py:1-288 — catalog-backed feasibility via
+CatalogCloud, deploy variables for the 'vast' provisioner
+(provision/vast/instance.py), key-file credential probing. Platform
+facts: hosts are a live marketplace (the catalog is a cached
+approximation; the provisioner re-searches offers at launch),
+instances are docker containers with SSH on a mapped port, stop/start
+supported, spot rides a bid, regions are two-letter country codes.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import catalog_cloud
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+# Catalog accelerator name → Vast gpu_name (their marketplace ids).
+ACC_TO_GPU_NAME = {
+    'RTX3090': 'RTX 3090',
+    'RTX4090': 'RTX 4090',
+    'RTX5090': 'RTX 5090',
+    'RTXA6000': 'RTX A6000',
+    'A100-80GB': 'A100 SXM4',
+    'H100': 'H100 PCIE',
+    'H100-SXM': 'H100 SXM',
+    'H200-SXM': 'H200',
+    'L40S': 'L40S',
+}
+
+DEFAULT_IMAGE = 'vastai/base-image:cuda-12.4.1-auto'
+
+
+@registry.CLOUD_REGISTRY.register()
+class Vast(catalog_cloud.CatalogCloud):
+    _REPR = 'Vast'
+
+    _UNSUPPORTED = {
+        cloud_lib.CloudImplementationFeatures.OPEN_PORTS:
+            'Vast container port mappings are fixed at rent time.',
+        cloud_lib.CloudImplementationFeatures.CUSTOM_DISK_TIER:
+            'Vast hosts have no disk tiers.',
+    }
+
+    @property
+    def provisioner_module(self) -> str:
+        return 'vast'
+
+    def unsupported_features_for_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        return dict(self._UNSUPPORTED)
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        from skypilot_tpu import authentication
+        itype = resources.instance_type
+        count_s, _, acc = itype.partition('x_')
+        entries = self._match_entries(itype, None, region, None)
+        memory_gb = entries[0].memory_gib if entries else 0
+        _, public_key_path = authentication.get_or_generate_keys()
+        # An unreadable key must fail HERE, before anything is rented:
+        # renting with an empty PUBLIC_KEY bills an unreachable box.
+        with open(os.path.expanduser(public_key_path),
+                  encoding='utf-8') as f:
+            public_key = f.read().strip()
+        vars: Dict[str, Any] = {
+            'cluster_name': cluster_name,
+            'region': region,
+            'zone': None,
+            'instance_type': itype,
+            'gpu_name': ACC_TO_GPU_NAME.get(acc, acc.replace('-', ' ')),
+            'gpu_count': int(count_s),
+            'memory_gb': memory_gb,
+            'image_name': resources.image_id or DEFAULT_IMAGE,
+            'disk_size': resources.disk_size,
+            'use_spot': resources.use_spot,
+            'public_key': public_key,
+        }
+        if resources.use_spot:
+            vars['bid'] = self.instance_type_to_hourly_cost(
+                itype, use_spot=True, region=region, zone=None)
+        if resources.accelerators:
+            name, count = next(iter(resources.accelerators.items()))
+            vars.update({'gpu_type': name, 'acc_count': count})
+        return vars
+
+    def provider_config_overrides(
+            self, node_config: Dict[str, Any]) -> Dict[str, Any]:
+        del node_config
+        return {}
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.vast import rest
+        if rest.load_api_key() is not None:
+            return True, None
+        return False, (
+            'Vast.ai API key not found. Set $VAST_API_KEY or populate '
+            f'{rest.CREDENTIALS_PATH}.')
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        from skypilot_tpu.provision.vast import rest
+        if os.path.exists(os.path.expanduser(rest.CREDENTIALS_PATH)):
+            return {rest.CREDENTIALS_PATH: rest.CREDENTIALS_PATH}
+        return {}
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        # Bandwidth pricing is host-set and tiny; not modeled.
+        return 0.0

@@ -1,0 +1,292 @@
+"""RunPod provisioner op-set.
+
+Behavioral twin of sky/provision/runpod/instance.py with two
+structural changes. First, the reference names pods `<cluster>-head` /
+`<cluster>-worker` and cannot tell workers apart; here pods are named
+`<cluster>-<index>` (the repo-wide convention, cf.
+provision/lambda_cloud/instance.py) so gang rank assignment and
+gap-filling relaunch are deterministic. Second, the reference
+interpolates values into GraphQL document strings; here documents are
+static and values ride JSON variables.
+
+Platform facts encoded below: pods are docker containers (SSH rides a
+mapped public port, not 22); stop is supported (podStop keeps the
+volume, releases the GPU); spot is RunPod's "interruptible" market and
+requires a per-GPU bid; regions are flat data centers.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.runpod import rest
+
+logger = sky_logging.init_logger(__name__)
+
+_transport_factory = rest.Transport
+
+
+def set_transport_factory(factory) -> None:
+    global _transport_factory
+    _transport_factory = factory
+
+
+def _transport(provider_config: Dict[str, Any]) -> Any:
+    del provider_config
+    return _transport_factory()
+
+
+# desiredStatus values → repo-wide states (None = terminal/gone).
+_STATE_MAP = {
+    'CREATED': 'PENDING',
+    'RESTARTING': 'PENDING',
+    'RUNNING': 'RUNNING',
+    'PAUSED': 'STOPPED',
+    'EXITED': 'STOPPED',
+    'TERMINATED': None,
+    'DEAD': None,
+    'FAILED': None,
+}
+
+_PODS_QUERY = """
+query Pods {
+  myself {
+    pods {
+      id
+      name
+      desiredStatus
+      gpuCount
+      runtime { ports { ip isIpPublic privatePort publicPort } }
+    }
+  }
+}
+"""
+
+_DEPLOY_MUTATION = """
+mutation Deploy($input: PodFindAndDeployOnDemandInput) {
+  podFindAndDeployOnDemand(input: $input) { id }
+}
+"""
+
+_RENT_SPOT_MUTATION = """
+mutation Rent($input: PodRentInterruptableInput) {
+  podRentInterruptable(input: $input) { id }
+}
+"""
+
+_RESUME_MUTATION = """
+mutation Resume($podId: String!, $gpuCount: Int!) {
+  podResume(input: {podId: $podId, gpuCount: $gpuCount}) { id }
+}
+"""
+
+_STOP_MUTATION = """
+mutation Stop($podId: String!) {
+  podStop(input: {podId: $podId}) { id desiredStatus }
+}
+"""
+
+_TERMINATE_MUTATION = """
+mutation Terminate($podId: String!) {
+  podTerminate(input: {podId: $podId})
+}
+"""
+
+
+def _instance_name(cluster_name: str, index: int) -> str:
+    return f'{cluster_name}-{index}'
+
+
+def _node_index(pod: Dict[str, Any]) -> int:
+    return int(pod['name'].rsplit('-', 1)[1])
+
+
+def _cluster_pods(t, cluster_name: str) -> List[Dict[str, Any]]:
+    pods = []
+    for pod in t.call(_PODS_QUERY).get('myself', {}).get('pods', []):
+        name = pod.get('name') or ''
+        prefix, _, idx = name.rpartition('-')
+        if prefix == cluster_name and idx.isdigit():
+            pods.append(pod)
+    return sorted(pods, key=_node_index)
+
+
+def _public_key() -> str:
+    import os
+    from skypilot_tpu import authentication
+    _, public_key_path = authentication.get_or_generate_keys()
+    with open(os.path.expanduser(public_key_path), encoding='utf-8') as f:
+        return f.read().strip()
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    del zone  # flat data centers
+    t = _transport(config.provider_config)
+    node_cfg = config.node_config
+    use_spot = bool(node_cfg.get('use_spot'))
+    created: List[str] = []
+    resumed: List[str] = []
+    try:
+        existing = _cluster_pods(t, cluster_name)
+        # Stopped pods resume in place (volume kept, GPU re-attached).
+        for pod in existing:
+            if _STATE_MAP.get(pod.get('desiredStatus')) == 'STOPPED':
+                t.call(_RESUME_MUTATION,
+                       {'podId': pod['id'],
+                        'gpuCount': int(node_cfg.get('gpu_count', 1))})
+                resumed.append(pod['id'])
+        # Fill index GAPS (cf. lambda_cloud: a reclaimed node 1 of
+        # {0,1,2} must come back as `<cluster>-1`, not a dup -2).
+        taken = {_node_index(p) for p in existing}
+        missing = sorted(set(range(config.count)) - taken)
+        if missing:
+            public_key = _public_key()
+            for node in missing:
+                payload: Dict[str, Any] = {
+                    'name': _instance_name(cluster_name, node),
+                    'imageName': node_cfg['image_name'],
+                    'gpuTypeId': node_cfg['gpu_type_id'],
+                    'gpuCount': int(node_cfg.get('gpu_count', 1)),
+                    'cloudType': node_cfg.get('cloud_type', 'SECURE'),
+                    'dataCenterId': region,
+                    'containerDiskInGb':
+                        int(node_cfg.get('disk_size', 50)),
+                    'volumeInGb': 0,
+                    'ports': '22/tcp',
+                    'startSsh': True,
+                    'env': [{'key': 'PUBLIC_KEY', 'value': public_key}],
+                }
+                if use_spot:
+                    payload['bidPerGpu'] = float(node_cfg['bid_per_gpu'])
+                    reply = t.call(_RENT_SPOT_MUTATION,
+                                   {'input': payload})
+                    pod = reply.get('podRentInterruptable')
+                else:
+                    reply = t.call(_DEPLOY_MUTATION, {'input': payload})
+                    pod = reply.get('podFindAndDeployOnDemand')
+                if not pod or not pod.get('id'):
+                    raise exceptions.CapacityError(
+                        f'RunPod returned no pod for {region} '
+                        f'({node_cfg["gpu_type_id"]}).')
+                created.append(pod['id'])
+    except rest.RunPodApiError as e:
+        raise rest.classify_error(e, region) from e
+    head = None
+    for pod in _cluster_pods(t, cluster_name):
+        if _node_index(pod) == 0:
+            head = pod['id']
+    return common.ProvisionRecord(
+        provider_name='runpod', cluster_name=cluster_name, region=region,
+        zone=None, resumed_instance_ids=resumed,
+        created_instance_ids=created, head_instance_id=head)
+
+
+def _ssh_endpoint(pod: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The public (ip, port) mapped onto the container's sshd."""
+    runtime = pod.get('runtime') or {}
+    for port in runtime.get('ports') or []:
+        if port.get('privatePort') == 22 and port.get('isIpPublic'):
+            return port
+    return None
+
+
+def wait_instances(region: str, cluster_name: str, state: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   timeout_s: float = 900.0,
+                   poll_interval_s: float = 5.0) -> None:
+    del region
+    t = _transport(provider_config or {})
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        pods = _cluster_pods(t, cluster_name)
+        states = [_STATE_MAP.get(p.get('desiredStatus', ''), 'PENDING')
+                  for p in pods]
+        if any(s is None for s in states):
+            raise exceptions.CapacityError(
+                f'Pod(s) of {cluster_name!r} terminated while waiting '
+                f'for {state}.')
+        ready = pods and all(s == state for s in states)
+        if ready and state == 'RUNNING':
+            # RUNNING alone is not reachable: the SSH port mapping
+            # appears only once the container runtime is up.
+            ready = all(_ssh_endpoint(p) for p in pods)
+        if ready:
+            return
+        time.sleep(poll_interval_s)
+    raise exceptions.ProvisionError(
+        f'Cluster {cluster_name!r} did not reach {state} within '
+        f'{timeout_s}s.')
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    t = _transport(provider_config)
+    try:
+        for pod in _cluster_pods(t, cluster_name):
+            if _STATE_MAP.get(pod.get('desiredStatus')) == 'RUNNING':
+                t.call(_STOP_MUTATION, {'podId': pod['id']})
+    except rest.RunPodApiError as e:
+        raise rest.classify_error(e) from e
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    t = _transport(provider_config)
+    try:
+        for pod in _cluster_pods(t, cluster_name):
+            t.call(_TERMINATE_MUTATION, {'podId': pod['id']})
+    except rest.RunPodApiError as e:
+        raise rest.classify_error(e) from e
+
+
+def query_instances(cluster_name: str, provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    t = _transport(provider_config)
+    return {p['id']: _STATE_MAP.get(p.get('desiredStatus', ''), 'PENDING')
+            for p in _cluster_pods(t, cluster_name)}
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Dict[str, Any]
+                     ) -> common.ClusterInfo:
+    t = _transport(provider_config)
+    instances: Dict[str, common.InstanceInfo] = {}
+    head_id = None
+    for pod in _cluster_pods(t, cluster_name):
+        index = _node_index(pod)
+        state = _STATE_MAP.get(pod.get('desiredStatus', ''), 'PENDING')
+        endpoint = _ssh_endpoint(pod)
+        info = common.InstanceInfo(
+            instance_id=pod['id'],
+            internal_ip=(endpoint or {}).get('ip', ''),
+            external_ip=(endpoint or {}).get('ip'),
+            status=state or 'TERMINATED',
+            tags={'cluster': cluster_name, 'node_index': str(index)},
+            slice_id=pod['id'],
+            host_index=0,
+            ssh_port=(endpoint or {}).get('publicPort', 22),
+        )
+        instances[pod['id']] = info
+        if index == 0:
+            head_id = pod['id']
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head_id,
+        provider_name='runpod',
+        provider_config=dict(provider_config or {}),
+        ssh_user='root')
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    # Port mappings are fixed at pod creation (the `ports` input);
+    # post-hoc opening is not supported by the platform.
+    del cluster_name, ports, provider_config
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    del cluster_name, provider_config

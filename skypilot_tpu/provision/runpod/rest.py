@@ -1,0 +1,119 @@
+"""RunPod GraphQL transport (urllib, no SDK).
+
+Role-twin of the reference's runpod SDK usage
+(sky/provision/runpod/utils.py, sky/provision/runpod/api/commands.py),
+redesigned to match this repo's transport pattern
+(provision/{aws,azure,gcp,lambda_cloud}/rest.py): one `call()` with
+typed error classification the failover engine consumes directly.
+RunPod's API is GraphQL-over-HTTP; queries are sent with JSON
+variables (not string-interpolated into the document) so values never
+need GraphQL escaping.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+API_URL = 'https://api.runpod.io/graphql'
+CONFIG_PATH = '~/.runpod/config.toml'
+_MAX_ATTEMPTS = 4
+_BACKOFF_S = 2.0
+
+
+class RunPodApiError(Exception):
+
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(f'{status}: {message}')
+        self.status = status
+        self.message = message
+
+
+def load_api_key() -> Optional[str]:
+    """$RUNPOD_API_KEY, else the SDK-compatible config file
+    (`api_key = "..."` in ~/.runpod/config.toml)."""
+    key = os.environ.get('RUNPOD_API_KEY')
+    if key:
+        return key
+    path = os.path.expanduser(CONFIG_PATH)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                field, sep, value = line.partition('=')
+                if sep and field.strip() == 'api_key':
+                    return value.strip().strip('"\'') or None
+    except OSError:
+        return None
+    return None
+
+
+def classify_error(e: RunPodApiError,
+                   region: Optional[str] = None) -> Exception:
+    """Map RunPod errors onto the failover engine's taxonomy."""
+    text = e.message.lower()
+    where = f' in {region}' if region else ''
+    if ('no longer any instances available' in text
+            or 'no instances' in text or 'not enough' in text
+            or 'no gpu found' in text or 'unavailable' in text):
+        return exceptions.CapacityError(f'RunPod capacity{where}: {e}')
+    if 'quota' in text or 'limit' in text and 'spend' in text:
+        return exceptions.QuotaExceededError(f'RunPod quota{where}: {e}')
+    if (e.status in (401, 403) or 'unauthorized' in text
+            or 'not authenticated' in text):
+        return exceptions.PermissionError_(f'RunPod auth: {e}')
+    if e.status == 400:
+        return exceptions.InvalidRequestError(f'RunPod request: {e}')
+    return exceptions.ProvisionError(f'RunPod API{where}: {e}')
+
+
+class Transport:
+
+    def __init__(self, api_key: Optional[str] = None) -> None:
+        key = api_key or load_api_key()
+        if not key:
+            raise exceptions.PermissionError_(
+                'RunPod API key not found (set $RUNPOD_API_KEY or '
+                f'populate {CONFIG_PATH}).')
+        self._key = key
+
+    def call(self, query: str,
+             variables: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """POST one GraphQL document; return its `data` object.
+
+        GraphQL transports errors two ways — HTTP status for transport
+        problems and a 200 + `errors` array for field errors — both are
+        normalized to RunPodApiError here.
+        """
+        body = json.dumps({'query': query,
+                           'variables': variables or {}}).encode()
+        url = f'{API_URL}?api_key={urllib.parse.quote(self._key)}'
+        for attempt in range(_MAX_ATTEMPTS):
+            req = urllib.request.Request(
+                url, data=body, method='POST',
+                headers={'Content-Type': 'application/json'})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    payload = json.loads(resp.read() or b'{}')
+            except urllib.error.HTTPError as e:
+                if e.code in (429, 502, 503) and attempt < _MAX_ATTEMPTS - 1:
+                    time.sleep(_BACKOFF_S * (attempt + 1))
+                    continue
+                raise RunPodApiError(e.code, str(e)) from e
+            except urllib.error.URLError as e:
+                raise exceptions.ProvisionError(
+                    f'RunPod API unreachable: {e}') from e
+            errors = payload.get('errors')
+            if errors:
+                raise RunPodApiError(
+                    200, '; '.join(err.get('message', str(err))
+                                   for err in errors))
+            return payload.get('data', {})
+        raise exceptions.ProvisionError('RunPod API rate limit persisted.')

@@ -1,0 +1,102 @@
+"""Vast.ai REST transport (urllib + bearer key, no SDK).
+
+Role-twin of the reference's vast SDK wrapper
+(sky/provision/vast/utils.py), redesigned to match this repo's
+transport pattern: a thin `call()` over the v0 REST API with typed
+error classification for the failover engine. The marketplace "search
+offers" query is sent as the API's structured JSON operators (e.g.
+{"gpu_name": {"eq": ...}}), not the SDK's string DSL.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+API_ENDPOINT = 'https://console.vast.ai/api/v0'
+CREDENTIALS_PATH = '~/.vast_api_key'
+_MAX_ATTEMPTS = 4
+_BACKOFF_S = 2.0
+
+
+class VastApiError(Exception):
+
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(f'{status}: {message}')
+        self.status = status
+        self.message = message
+
+
+def load_api_key() -> Optional[str]:
+    """$VAST_API_KEY, else the CLI-compatible ~/.vast_api_key file."""
+    key = os.environ.get('VAST_API_KEY')
+    if key:
+        return key
+    path = os.path.expanduser(CREDENTIALS_PATH)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding='utf-8') as f:
+            return f.read().strip() or None
+    except OSError:
+        return None
+
+
+def classify_error(e: VastApiError,
+                   region: Optional[str] = None) -> Exception:
+    text = e.message.lower()
+    where = f' in {region}' if region else ''
+    if ('no_such_ask' in text or 'no longer available' in text
+            or 'already rented' in text or 'no offer' in text):
+        return exceptions.CapacityError(f'Vast capacity{where}: {e}')
+    if 'credit' in text or 'balance' in text:
+        return exceptions.QuotaExceededError(f'Vast balance{where}: {e}')
+    if e.status in (401, 403):
+        return exceptions.PermissionError_(f'Vast auth: {e}')
+    if e.status == 400:
+        return exceptions.InvalidRequestError(f'Vast request: {e}')
+    return exceptions.ProvisionError(f'Vast API{where}: {e}')
+
+
+class Transport:
+
+    def __init__(self, api_key: Optional[str] = None) -> None:
+        key = api_key or load_api_key()
+        if not key:
+            raise exceptions.PermissionError_(
+                'Vast.ai API key not found (set $VAST_API_KEY or '
+                f'populate {CREDENTIALS_PATH}).')
+        self._key = key
+
+    def call(self, method: str, path: str,
+             body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        url = f'{API_ENDPOINT}{path}'
+        data = json.dumps(body).encode() if body is not None else None
+        for attempt in range(_MAX_ATTEMPTS):
+            req = urllib.request.Request(
+                url, data=data, method=method,
+                headers={'Authorization': f'Bearer {self._key}',
+                         'Content-Type': 'application/json'})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    return json.loads(resp.read() or b'{}')
+            except urllib.error.HTTPError as e:
+                if e.code in (429, 502, 503) and attempt < _MAX_ATTEMPTS - 1:
+                    time.sleep(_BACKOFF_S * (attempt + 1))
+                    continue
+                try:
+                    payload = json.loads(e.read() or b'{}')
+                    msg = payload.get('msg') or payload.get(
+                        'error', str(e))
+                except (ValueError, AttributeError):
+                    msg = str(e)
+                raise VastApiError(e.code, msg) from e
+            except urllib.error.URLError as e:
+                raise exceptions.ProvisionError(
+                    f'Vast API unreachable: {e}') from e
+        raise exceptions.ProvisionError('Vast API rate limit persisted.')

@@ -1,0 +1,214 @@
+"""RunPod provisioner tests against an in-memory GraphQL fake.
+
+Same pattern as the Lambda/GCP/Azure fakes (role of the reference's
+mocked runpod SDK): scripted capacity errors, no network.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.runpod import instance as runpod_instance
+from skypilot_tpu.provision.runpod import rest
+
+
+class FakeRunPod:
+    """Minimal in-memory RunPod GraphQL API."""
+
+    def __init__(self) -> None:
+        self.pods: Dict[str, Dict[str, Any]] = {}
+        self.fail_deploy: Optional[rest.RunPodApiError] = None
+        self.deploys: List[Dict[str, Any]] = []
+        self._next_id = 0
+
+    def _runtime(self, n: int) -> Dict[str, Any]:
+        return {'ports': [{'ip': f'38.1.0.{n}', 'isIpPublic': True,
+                           'privatePort': 22, 'publicPort': 10000 + n}]}
+
+    def call(self, query: str,
+             variables: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        variables = variables or {}
+        if 'myself' in query:
+            return {'myself': {'pods': list(self.pods.values())}}
+        if 'podFindAndDeployOnDemand' in query or \
+                'podRentInterruptable' in query:
+            if self.fail_deploy is not None:
+                err, self.fail_deploy = self.fail_deploy, None
+                raise err
+            payload = variables['input']
+            self.deploys.append(payload)
+            self._next_id += 1
+            pid = f'pod-{self._next_id}'
+            self.pods[pid] = {
+                'id': pid, 'name': payload['name'],
+                'desiredStatus': 'RUNNING',
+                'gpuCount': payload['gpuCount'],
+                'runtime': self._runtime(self._next_id),
+            }
+            field = ('podRentInterruptable' if 'podRentInterruptable'
+                     in query else 'podFindAndDeployOnDemand')
+            return {field: {'id': pid}}
+        if 'podResume' in query:
+            pod = self.pods[variables['podId']]
+            pod['desiredStatus'] = 'RUNNING'
+            pod['runtime'] = self._runtime(int(pod['id'].split('-')[1]))
+            return {'podResume': {'id': pod['id']}}
+        if 'podStop' in query:
+            pod = self.pods[variables['podId']]
+            pod['desiredStatus'] = 'EXITED'
+            pod['runtime'] = None
+            return {'podStop': {'id': pod['id'],
+                                'desiredStatus': 'EXITED'}}
+        if 'podTerminate' in query:
+            self.pods.pop(variables['podId'], None)
+            return {'podTerminate': None}
+        raise AssertionError(f'unhandled RunPod query: {query[:60]}')
+
+
+@pytest.fixture()
+def fake_runpod(monkeypatch, tmp_path):
+    fake = FakeRunPod()
+    monkeypatch.setattr(runpod_instance, '_transport_factory',
+                        lambda: fake)
+    from skypilot_tpu import authentication
+    monkeypatch.setattr(authentication, 'PRIVATE_KEY_PATH',
+                        str(tmp_path / 'key'))
+    monkeypatch.setattr(authentication, 'PUBLIC_KEY_PATH',
+                        str(tmp_path / 'key.pub'))
+    yield fake
+
+
+PROVIDER: Dict[str, Any] = {}
+
+
+def _config(count=1, spot=False):
+    node_config = {'instance_type': '1x_H100', 'gpu_type_id':
+                   'NVIDIA H100 PCIe', 'gpu_count': 1,
+                   'image_name': 'runpod/base:0.6.2-cuda12.4.1',
+                   'use_spot': spot}
+    if spot:
+        node_config['bid_per_gpu'] = 1.20
+    return common.ProvisionConfig(provider_config=dict(PROVIDER),
+                                  node_config=node_config, count=count)
+
+
+def test_launch_lifecycle(fake_runpod):
+    record = runpod_instance.run_instances('US-GA-1', None, 'c1',
+                                           _config(count=2))
+    assert len(record.created_instance_ids) == 2
+    assert record.head_instance_id is not None
+    info = runpod_instance.get_cluster_info('US-GA-1', 'c1', PROVIDER)
+    assert info.num_instances == 2
+    hosts = info.sorted_instances()
+    assert info.head_instance_id == hosts[0].instance_id
+    # SSH rides the mapped public port, not 22.
+    assert all(h.ssh_port >= 10000 for h in hosts)
+    assert all(h.external_ip for h in hosts)
+    assert info.ssh_user == 'root'
+    runpod_instance.terminate_instances('c1', PROVIDER)
+    assert runpod_instance.query_instances('c1', PROVIDER) == {}
+
+
+def test_stop_resume_cycle(fake_runpod):
+    runpod_instance.run_instances('US-GA-1', None, 'c2', _config())
+    runpod_instance.stop_instances('c2', PROVIDER)
+    statuses = runpod_instance.query_instances('c2', PROVIDER)
+    assert set(statuses.values()) == {'STOPPED'}
+    # run_instances on a stopped cluster resumes in place: same pod id,
+    # no new deploys.
+    deploys_before = len(fake_runpod.deploys)
+    record = runpod_instance.run_instances('US-GA-1', None, 'c2',
+                                           _config())
+    assert record.created_instance_ids == []
+    assert len(record.resumed_instance_ids) == 1
+    assert len(fake_runpod.deploys) == deploys_before
+    statuses = runpod_instance.query_instances('c2', PROVIDER)
+    assert set(statuses.values()) == {'RUNNING'}
+
+
+def test_spot_launch_carries_bid(fake_runpod):
+    runpod_instance.run_instances('US-GA-1', None, 'c3',
+                                  _config(spot=True))
+    assert fake_runpod.deploys[-1]['bidPerGpu'] == pytest.approx(1.20)
+
+
+def test_gap_fill_relaunch(fake_runpod):
+    runpod_instance.run_instances('US-GA-1', None, 'c4',
+                                  _config(count=3))
+    # Node 1 reclaimed out-of-band.
+    gone = [pid for pid, p in fake_runpod.pods.items()
+            if p['name'] == 'c4-1']
+    fake_runpod.pods.pop(gone[0])
+    runpod_instance.run_instances('US-GA-1', None, 'c4',
+                                  _config(count=3))
+    names = sorted(p['name'] for p in fake_runpod.pods.values())
+    assert names == ['c4-0', 'c4-1', 'c4-2']
+
+
+def test_capacity_error_classified(fake_runpod):
+    fake_runpod.fail_deploy = rest.RunPodApiError(
+        200, 'There are no longer any instances available with the '
+        'requested specifications.')
+    with pytest.raises(exceptions.CapacityError):
+        runpod_instance.run_instances('US-GA-1', None, 'c5', _config())
+
+
+def test_wait_instances_needs_ssh_port(fake_runpod):
+    runpod_instance.run_instances('US-GA-1', None, 'c6', _config())
+    runpod_instance.wait_instances('US-GA-1', 'c6', 'RUNNING', PROVIDER,
+                                   timeout_s=5, poll_interval_s=0.01)
+    # RUNNING without a port mapping is NOT ready (container booting).
+    for pod in fake_runpod.pods.values():
+        pod['runtime'] = None
+    with pytest.raises(exceptions.ProvisionError):
+        runpod_instance.wait_instances('US-GA-1', 'c6', 'RUNNING',
+                                       PROVIDER, timeout_s=0.2,
+                                       poll_interval_s=0.01)
+
+
+def test_cloud_feasibility_and_pricing():
+    """Catalog-backed: spot offerings priced off the community rate."""
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.utils import registry
+    cloud = registry.CLOUD_REGISTRY.from_str('runpod')
+    r = resources_lib.Resources(accelerators='H100:1')
+    feasible, _ = cloud.get_feasible_launchable_resources(r)
+    assert feasible
+    assert feasible[0].instance_type == '1x_H100'
+    assert feasible[0].get_hourly_cost() == pytest.approx(2.39)
+    spot = resources_lib.Resources(accelerators='H100:1', use_spot=True)
+    feasible, _ = cloud.get_feasible_launchable_resources(spot)
+    assert feasible
+    assert feasible[0].get_hourly_cost() == pytest.approx(1.20)
+
+
+def test_deploy_variables_spot_bid():
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.utils import registry
+    cloud = registry.CLOUD_REGISTRY.from_str('runpod')
+    r = resources_lib.Resources(cloud=cloud, instance_type='2x_H100',
+                                accelerators='H100:2', use_spot=True)
+    vars = cloud.make_deploy_resources_variables(r, 'c', 'US-GA-1', None)
+    assert vars['gpu_type_id'] == 'NVIDIA H100 PCIe'
+    assert vars['gpu_count'] == 2
+    # Bid is per GPU: the 2-GPU spot price halved.
+    assert vars['bid_per_gpu'] == pytest.approx(1.20)
+    # The requested disk must reach the provisioner (it defaults its
+    # own fallback otherwise).
+    assert vars['disk_size'] == r.disk_size
+
+
+def test_check_credentials(monkeypatch, tmp_path):
+    from skypilot_tpu.utils import registry
+    cloud = registry.CLOUD_REGISTRY.from_str('runpod')
+    monkeypatch.delenv('RUNPOD_API_KEY', raising=False)
+    monkeypatch.setattr(rest, 'CONFIG_PATH', str(tmp_path / 'config.toml'))
+    ok, reason = cloud.check_credentials()
+    assert not ok and 'RUNPOD_API_KEY' in reason
+    (tmp_path / 'config.toml').write_text('api_key = "rp_secret"\n')
+    assert rest.load_api_key() == 'rp_secret'
+    ok, _ = cloud.check_credentials()
+    assert ok

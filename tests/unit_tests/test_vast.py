@@ -1,0 +1,221 @@
+"""Vast.ai provisioner tests against an in-memory marketplace fake.
+
+Same pattern as the Lambda/RunPod fakes: scripted offer inventory and
+rent-time races, no network.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.vast import instance as vast_instance
+from skypilot_tpu.provision.vast import rest
+
+
+class FakeVast:
+    """Minimal in-memory Vast marketplace + instances API."""
+
+    def __init__(self) -> None:
+        self.offers: List[Dict[str, Any]] = [
+            {'id': 100, 'gpu_name': 'H100 PCIE', 'num_gpus': 1,
+             'dph_total': 1.93, 'min_bid': 0.97, 'geolocation':
+             'Dallas, TX, US'},
+            {'id': 101, 'gpu_name': 'H100 PCIE', 'num_gpus': 1,
+             'dph_total': 2.10, 'min_bid': 1.00, 'geolocation':
+             'Sofia, BG'},
+        ]
+        self.instances: Dict[int, Dict[str, Any]] = {}
+        self.gone_offers: set = set()
+        self.queries: List[Dict[str, Any]] = []
+        self.rents: List[Dict[str, Any]] = []
+        self._next_id = 1000
+
+    def call(self, method: str, path: str,
+             body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        if path == '/bundles/' and method == 'PUT':
+            q = body['q']
+            self.queries.append(q)
+            cc = q.get('geolocation', {}).get('eq')
+            matches = [
+                o for o in self.offers
+                if o['gpu_name'] == q['gpu_name']['eq']
+                and o['num_gpus'] == q['num_gpus']['eq']
+                and (cc is None or o['geolocation'].endswith(cc))
+            ]
+            return {'offers': sorted(matches,
+                                     key=lambda o: o['dph_total'])}
+        if path.startswith('/asks/') and method == 'PUT':
+            ask_id = int(path.split('/')[2])
+            if ask_id in self.gone_offers:
+                return {'success': False, 'msg': 'no_such_ask'}
+            self.rents.append(dict(body))
+            offer = next(o for o in self.offers if o['id'] == ask_id)
+            self._next_id += 1
+            iid = self._next_id
+            self.instances[iid] = {
+                'id': iid, 'label': body['label'],
+                'actual_status': 'running',
+                'ssh_host': f'ssh{iid}.vast.ai',
+                'ssh_port': 20000 + iid,
+                'num_gpus': offer['num_gpus'],
+            }
+            return {'success': True, 'new_contract': iid}
+        if path == '/instances/' and method == 'GET':
+            return {'instances': list(self.instances.values())}
+        if path.startswith('/instances/') and method == 'PUT':
+            iid = int(path.split('/')[2])
+            state = body['state']
+            self.instances[iid]['actual_status'] = (
+                'running' if state == 'running' else 'stopped')
+            return {'success': True}
+        if path.startswith('/instances/') and method == 'DELETE':
+            self.instances.pop(int(path.split('/')[2]), None)
+            return {'success': True}
+        raise AssertionError(f'unhandled Vast call {method} {path}')
+
+
+@pytest.fixture()
+def fake_vast(monkeypatch):
+    fake = FakeVast()
+    monkeypatch.setattr(vast_instance, '_transport_factory', lambda: fake)
+    yield fake
+
+
+PROVIDER: Dict[str, Any] = {}
+
+
+def _config(count=1, spot=False):
+    node_config = {'instance_type': '1x_H100',
+                   'gpu_name': 'H100 PCIE', 'gpu_count': 1,
+                   'memory_gb': 64, 'disk_size': 50,
+                   'image_name': 'vastai/base-image:cuda-12.4.1-auto',
+                   'use_spot': spot, 'public_key': 'ssh-ed25519 AAAA'}
+    if spot:
+        node_config['bid'] = 0.97
+    return common.ProvisionConfig(provider_config=dict(PROVIDER),
+                                  node_config=node_config, count=count)
+
+
+def test_launch_picks_cheapest_offer_in_region(fake_vast):
+    record = vast_instance.run_instances('US', None, 'v1', _config())
+    assert len(record.created_instance_ids) == 1
+    # Offer 100 ($1.93, US) beats 101 ($2.10, BG) and matches region.
+    q = fake_vast.queries[-1]
+    assert q['geolocation'] == {'eq': 'US'}
+    info = vast_instance.get_cluster_info('US', 'v1', PROVIDER)
+    hosts = info.sorted_instances()
+    assert hosts[0].ssh_port > 20000
+    assert hosts[0].external_ip.endswith('vast.ai')
+    assert info.ssh_user == 'root'
+    vast_instance.terminate_instances('v1', PROVIDER)
+    assert vast_instance.query_instances('v1', PROVIDER) == {}
+
+
+def test_rent_race_classified_as_capacity(fake_vast):
+    fake_vast.gone_offers.add(100)
+    # Offer 100 matches the search but is rented out from under us at
+    # rent time; the failure must surface as CapacityError so failover
+    # walks on.
+    with pytest.raises(exceptions.CapacityError):
+        vast_instance.run_instances('US', None, 'v2', _config())
+
+
+def test_no_offer_is_capacity_error(fake_vast):
+    fake_vast.offers.clear()
+    with pytest.raises(exceptions.CapacityError):
+        vast_instance.run_instances('US', None, 'v3', _config())
+
+
+def test_stop_resume_cycle(fake_vast):
+    vast_instance.run_instances('US', None, 'v4', _config())
+    vast_instance.stop_instances('v4', PROVIDER)
+    assert set(vast_instance.query_instances('v4', PROVIDER).values()) \
+        == {'STOPPED'}
+    record = vast_instance.run_instances('US', None, 'v4', _config())
+    assert record.created_instance_ids == []
+    assert len(record.resumed_instance_ids) == 1
+    assert set(vast_instance.query_instances('v4', PROVIDER).values()) \
+        == {'RUNNING'}
+
+
+def test_spot_rent_carries_bid(fake_vast):
+    vast_instance.run_instances('US', None, 'v5', _config(spot=True))
+    # Bid search asked the marketplace for interruptible offers.
+    assert fake_vast.queries[-1]['type'] == 'bid'
+
+
+def test_wait_instances(fake_vast):
+    vast_instance.run_instances('US', None, 'v6', _config())
+    vast_instance.wait_instances('US', 'v6', 'RUNNING', PROVIDER,
+                                 timeout_s=5, poll_interval_s=0.01)
+    for inst in fake_vast.instances.values():
+        inst['actual_status'] = 'offline'
+    with pytest.raises(exceptions.CapacityError):
+        vast_instance.wait_instances('US', 'v6', 'RUNNING', PROVIDER,
+                                     timeout_s=5, poll_interval_s=0.01)
+
+
+def test_cloud_feasibility_and_pricing():
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.utils import registry
+    cloud = registry.CLOUD_REGISTRY.from_str('vast')
+    r = resources_lib.Resources(accelerators='H100:1')
+    feasible, _ = cloud.get_feasible_launchable_resources(r)
+    assert feasible
+    assert feasible[0].instance_type == '1x_H100'
+    assert feasible[0].get_hourly_cost() == pytest.approx(1.93)
+    spot = resources_lib.Resources(accelerators='H100:1', use_spot=True)
+    feasible, _ = cloud.get_feasible_launchable_resources(spot)
+    assert feasible[0].get_hourly_cost() == pytest.approx(0.97)
+
+
+def test_deploy_variables(monkeypatch, tmp_path):
+    from skypilot_tpu import authentication
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.utils import registry
+    key = tmp_path / 'key.pub'
+    key.write_text('ssh-ed25519 AAAA test\n')
+    monkeypatch.setattr(authentication, 'get_or_generate_keys',
+                        lambda: (str(tmp_path / 'key'), str(key)))
+    cloud = registry.CLOUD_REGISTRY.from_str('vast')
+    r = resources_lib.Resources(cloud=cloud, instance_type='1x_H100',
+                                accelerators='H100:1')
+    vars = cloud.make_deploy_resources_variables(r, 'c', 'US', None)
+    assert vars['gpu_name'] == 'H100 PCIE'
+    assert vars['disk_size'] == r.disk_size
+    assert vars['public_key'].startswith('ssh-ed25519')
+    # An unreadable key fails BEFORE anything is rented.
+    key.unlink()
+    with pytest.raises(OSError):
+        cloud.make_deploy_resources_variables(r, 'c', 'US', None)
+
+
+def test_spot_bid_never_below_catalog(fake_vast):
+    # Offer 100's min_bid is 0.97. A 1.10 catalog bid must be placed
+    # as-is (bidding exactly min_bid is instantly outbid)...
+    cfg = _config(spot=True)
+    cfg.node_config['bid'] = 1.10
+    vast_instance.run_instances('US', None, 'v7', cfg)
+    assert fake_vast.rents[-1]['price'] == pytest.approx(1.10)
+    # ...and a stale catalog bid below min_bid is raised to min_bid.
+    cfg2 = _config(spot=True)
+    cfg2.node_config['bid'] = 0.50
+    vast_instance.run_instances('US', None, 'v8', cfg2)
+    assert fake_vast.rents[-1]['price'] == pytest.approx(0.97)
+
+
+def test_check_credentials(monkeypatch, tmp_path):
+    from skypilot_tpu.utils import registry
+    cloud = registry.CLOUD_REGISTRY.from_str('vast')
+    monkeypatch.delenv('VAST_API_KEY', raising=False)
+    monkeypatch.setattr(rest, 'CREDENTIALS_PATH',
+                        str(tmp_path / 'vast_key'))
+    ok, reason = cloud.check_credentials()
+    assert not ok and 'VAST_API_KEY' in reason
+    (tmp_path / 'vast_key').write_text('vast_secret\n')
+    assert rest.load_api_key() == 'vast_secret'
+    ok, _ = cloud.check_credentials()
+    assert ok
