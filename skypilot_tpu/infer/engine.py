@@ -39,6 +39,11 @@ class EngineConfig:
     # bf16, or jnp.int8 for a quantized cache (half the HBM: per-head
     # symmetric scales, dequant fused into the attention reads).
     kv_dtype: Any = jnp.bfloat16
+    # bf16, or jnp.int8 for weight-only quantization (per-output-channel
+    # scales, dequant fused into each matmul's epilogue): halves weight
+    # HBM — decode is bandwidth-bound, and an 8B model fits one 16 GB
+    # chip at int8. See ops/quantization.py.
+    weight_dtype: Any = jnp.bfloat16
 
     @property
     def max_prompt_len(self) -> int:
@@ -65,6 +70,9 @@ class InferenceEngine:
                 f'{type(config.model).__name__} '
                 f'({self._model_lib.__name__}) does not provide them.')
         self.config = config
+        if config.weight_dtype == jnp.int8:
+            from skypilot_tpu.ops import quantization as qops
+            params = qops.quantize_params(params)
         self.params = params
         self.mesh = mesh
         self._key = jax.random.PRNGKey(0)

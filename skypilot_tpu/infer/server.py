@@ -135,6 +135,11 @@ def main() -> int:
     parser.add_argument('--kv-dtype', default='bf16',
                         choices=['bf16', 'int8'],
                         help='int8 halves KV-cache HBM (per-head scales)')
+    parser.add_argument('--weight-dtype', default='bf16',
+                        choices=['bf16', 'int8'],
+                        help='int8 halves weight HBM (per-channel '
+                             'scales, dequant fused into each matmul); '
+                             'fits 8B on one 16 GB chip')
     parser.add_argument('--mesh', default=None,
                         help="e.g. 'tensor=4' to shard across chips")
     args = parser.parse_args()
@@ -145,7 +150,9 @@ def main() -> int:
     config = engine_lib.EngineConfig(
         model=model, max_slots=args.max_slots,
         max_target_len=args.max_target_len,
-        kv_dtype=jnp.int8 if args.kv_dtype == 'int8' else jnp.bfloat16)
+        kv_dtype=jnp.int8 if args.kv_dtype == 'int8' else jnp.bfloat16,
+        weight_dtype=(jnp.int8 if args.weight_dtype == 'int8'
+                      else jnp.bfloat16))
     mesh = None
     if args.mesh:
         from skypilot_tpu.train.launch import parse_mesh
@@ -154,7 +161,22 @@ def main() -> int:
     logger.info(f'Initializing {args.model} on '
                 f'{jax.devices()[0].device_kind} x{jax.device_count()}')
     model_lib = models.module_for(model)
-    params = model_lib.init(model, jax.random.PRNGKey(0))
+    if args.weight_dtype == 'int8':
+        # Init + quantize on HOST: the whole point of int8 weights is
+        # serving a model whose bf16 tree does not fit the chip (8B =
+        # 16 GB bf16 on a 16 GB chip), so the bf16 init must never
+        # touch device HBM. Only the int8 tree is shipped over.
+        from jax.sharding import NamedSharding, PartitionSpec
+        from skypilot_tpu.ops import quantization as qops
+        cpu = jax.local_devices(backend='cpu')[0]
+        with jax.default_device(cpu):
+            params = model_lib.init(model, jax.random.PRNGKey(0))
+            params = qops.quantize_params(params)
+        target = (NamedSharding(mesh, PartitionSpec())
+                  if mesh is not None else jax.devices()[0])
+        params = jax.device_put(params, target)
+    else:
+        params = model_lib.init(model, jax.random.PRNGKey(0))
     engine = engine_lib.InferenceEngine(config, params, mesh=mesh)
     orch = orch_lib.Orchestrator(engine)
     # Warm the compile caches before declaring healthy.

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from skypilot_tpu.models import llama
 from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.ops import quantization as qops
 from skypilot_tpu.parallel import mesh as mesh_lib
 
 Params = Dict[str, Any]
@@ -158,9 +159,9 @@ def _layer(config: GemmaConfig, mesh: Optional[mesh_lib.Mesh],
         return mesh_lib.shard_logical(arr, mesh, axes)
 
     h = _rms_norm(x, lp['attn_norm'], c.norm_eps)
-    q = (h @ lp['wq']).reshape(b, s, c.n_heads, hd)
-    k = (h @ lp['wk']).reshape(b, s, c.n_kv_heads, hd)
-    v = (h @ lp['wv']).reshape(b, s, c.n_kv_heads, hd)
+    q = qops.matmul(h, lp['wq']).reshape(b, s, c.n_heads, hd)
+    k = qops.matmul(h, lp['wk']).reshape(b, s, c.n_kv_heads, hd)
+    v = qops.matmul(h, lp['wv']).reshape(b, s, c.n_kv_heads, hd)
     q = shard(q, ('batch', 'activation_length', 'activation_heads', None))
     # Gemma rope/theta; reuse the llama rotary helper.
     q = llama._rope(q, positions, c.rope_theta)
@@ -176,16 +177,16 @@ def _layer(config: GemmaConfig, mesh: Optional[mesh_lib.Mesh],
         attn = attention_ops.dot_product_attention(
             q, k, v, causal=True, implementation=c.attention_impl)
     attn = attn.reshape(b, s, c.n_heads * hd)
-    x = x + shard(attn @ lp['wo'],
+    x = x + shard(qops.matmul(attn, lp['wo']),
                   ('batch', 'activation_length', 'activation_embed'))
 
     h = _rms_norm(x, lp['mlp_norm'], c.norm_eps)
-    gate = jax.nn.gelu((h @ lp['w_gate']).astype(jnp.float32),
+    gate = jax.nn.gelu(qops.matmul(h, lp['w_gate']).astype(jnp.float32),
                        approximate=True)
-    up = (h @ lp['w_up']).astype(jnp.float32)
+    up = qops.matmul(h, lp['w_up']).astype(jnp.float32)
     ff = shard((gate * up).astype(c.dtype),
                ('batch', 'activation_length', 'activation_mlp'))
-    x = x + shard(ff @ lp['w_down'],
+    x = x + shard(qops.matmul(ff, lp['w_down']),
                   ('batch', 'activation_length', 'activation_embed'))
     if wants_kv:
         return x, new_cache
@@ -282,8 +283,8 @@ def lm_logits(config: GemmaConfig, params: Params,
               hidden: jax.Array) -> jax.Array:
     """Tied-embedding head with optional soft-cap; hidden [..., D]."""
     c = config
-    logits = jnp.einsum('...d,vd->...v', hidden, params['embed'],
-                        preferred_element_type=jnp.float32)
+    logits = qops.tied_head(hidden, params['embed'],
+                            preferred_element_type=jnp.float32)
     if c.final_logit_softcap:
         cap = c.final_logit_softcap
         logits = cap * jnp.tanh(logits / cap)
@@ -307,7 +308,7 @@ def decode_forward(config: GemmaConfig, params: Params,
     """One decode step for a batch of slots (llama.decode_forward twin,
     with the tied soft-capped head)."""
     c = config
-    x = params['embed'][last_tokens[:, None]].astype(c.dtype)
+    x = qops.embed_rows(params['embed'], last_tokens[:, None]).astype(c.dtype)
     x = x * jnp.asarray(c.d_model ** 0.5, c.dtype)
     pos = positions[:, None]
 

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from skypilot_tpu.ops import attention as attention_ops
 from skypilot_tpu.ops import decode_attention as decode_ops
+from skypilot_tpu.ops import quantization as qops
 from skypilot_tpu.parallel import mesh as mesh_lib
 
 Params = Dict[str, Any]
@@ -216,11 +217,17 @@ def _embed_lookup(table: jax.Array, tokens: jax.Array,
     sharded scatter-add.
     """
     if mesh is None:
-        return table[tokens]
-    tbl = mesh_lib.shard_logical(table, mesh, ('vocab', None))
+        return qops.embed_rows(table, tokens)
+    if isinstance(table, qops.QuantizedTensor):
+        tbl = qops.QuantizedTensor(
+            mesh_lib.shard_logical(table.q, mesh, ('vocab', None)),
+            mesh_lib.shard_logical(table.scale, mesh, ('vocab',)),
+            table.axis)
+    else:
+        tbl = mesh_lib.shard_logical(table, mesh, ('vocab', None))
     idx = mesh_lib.shard_logical(tokens, mesh,
                                  ('batch', 'activation_length'))
-    return tbl[idx]
+    return qops.embed_rows(tbl, idx)
 
 
 def _token_nll(logits: jax.Array, targets: jax.Array) -> jax.Array:
@@ -447,11 +454,11 @@ def _layer(config: LlamaConfig, mesh: Optional[mesh_lib.Mesh],
         return mesh_lib.shard_logical(arr, mesh, axes)
 
     h = _rms_norm(x, layer_params['attn_norm'], c.norm_eps)
-    q = _ckpt_name(h @ layer_params['wq'], 'attn_q').reshape(
+    q = _ckpt_name(qops.matmul(h, layer_params['wq']), 'attn_q').reshape(
         b, s, c.n_heads, hd)
-    k = _ckpt_name(h @ layer_params['wk'], 'attn_k').reshape(
+    k = _ckpt_name(qops.matmul(h, layer_params['wk']), 'attn_k').reshape(
         b, s, c.n_kv_heads, hd)
-    v = _ckpt_name(h @ layer_params['wv'], 'attn_v').reshape(
+    v = _ckpt_name(qops.matmul(h, layer_params['wv']), 'attn_v').reshape(
         b, s, c.n_kv_heads, hd)
     q = shard(q, ('batch', 'activation_length', 'activation_heads', None))
     k = shard(k, ('batch', 'activation_length', 'activation_kv', None))
@@ -482,17 +489,19 @@ def _layer(config: LlamaConfig, mesh: Optional[mesh_lib.Mesh],
             window=c.sliding_window)
 
     attn = attn.reshape(b, s, c.n_heads * hd)
-    x = x + shard(_ckpt_name(attn @ layer_params['wo'], 'attn_o'),
+    x = x + shard(_ckpt_name(qops.matmul(attn, layer_params['wo']),
+                             'attn_o'),
                   ('batch', 'activation_length', 'activation_embed'))
 
     h = _rms_norm(x, layer_params['mlp_norm'], c.norm_eps)
     gate = jax.nn.silu(
-        _ckpt_name(h @ layer_params['w_gate'], 'mlp_gate').astype(
-            jnp.float32))
-    up = _ckpt_name(h @ layer_params['w_up'], 'mlp_up').astype(jnp.float32)
+        _ckpt_name(qops.matmul(h, layer_params['w_gate']),
+                   'mlp_gate').astype(jnp.float32))
+    up = _ckpt_name(qops.matmul(h, layer_params['w_up']),
+                    'mlp_up').astype(jnp.float32)
     ff = shard((gate * up).astype(c.dtype),
                ('batch', 'activation_length', 'activation_mlp'))
-    x = x + shard(ff @ layer_params['w_down'],
+    x = x + shard(qops.matmul(ff, layer_params['w_down']),
                   ('batch', 'activation_length', 'activation_embed'))
     return x, new_cache
 
@@ -536,8 +545,8 @@ def forward(config: LlamaConfig,
     twin; BASELINE: examples/tpu/v6e/README.md:119-121).
     """
     x, kv = _trunk(config, params, tokens, positions, mesh, return_kv)
-    logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'],
-                        preferred_element_type=jnp.float32)
+    logits = qops.matmul(x, params['lm_head'],
+                         preferred_element_type=jnp.float32)
     return (logits, kv) if return_kv else logits
 
 
@@ -545,8 +554,8 @@ def lm_logits(config: LlamaConfig, params: Params,
               hidden: jax.Array) -> jax.Array:
     """Untied LM head; hidden [..., D] -> fp32 logits [..., V]."""
     del config
-    return jnp.einsum('...d,dv->...v', hidden, params['lm_head'],
-                      preferred_element_type=jnp.float32)
+    return qops.matmul(hidden, params['lm_head'],
+                       preferred_element_type=jnp.float32)
 
 
 def prefill_hidden(config: LlamaConfig,
@@ -582,7 +591,8 @@ def decode_forward(config: LlamaConfig,
     scan xs/ys — one compiled layer body, O(1) compile time in depth.
     """
     c = config
-    x = params['embed'][last_tokens[:, None]].astype(c.dtype)  # [B,1,D]
+    x = qops.embed_rows(params['embed'],
+                        last_tokens[:, None]).astype(c.dtype)  # [B,1,D]
     pos = positions[:, None]                                    # [B,1]
 
     def layer_fn(x, scanned):
@@ -596,8 +606,8 @@ def decode_forward(config: LlamaConfig,
     x, new_kv = jax.lax.scan(layer_fn, x, (params['layers'],
                                            kv['k'], kv['v']))
     x = _rms_norm(x, params['final_norm'], c.norm_eps)
-    logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'],
-                        preferred_element_type=jnp.float32)
+    logits = qops.matmul(x, params['lm_head'],
+                         preferred_element_type=jnp.float32)
     return logits[:, 0], new_kv
 
 

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from skypilot_tpu.models import llama
 from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.ops import quantization as qops
 from skypilot_tpu.parallel import mesh as mesh_lib
 
 Params = Dict[str, Any]
@@ -234,13 +235,14 @@ def _moe_mlp(config: MoEConfig, mesh: Optional[mesh_lib.Mesh],
     # tokens to their experts with one all-to-all over the ICI mesh axis.
     expert_in = jnp.einsum('tec,td->ecd', dispatch.astype(c.dtype), x)
     expert_in = shard(expert_in, ('expert', None, 'activation_embed'))
-    gate = jax.nn.silu(jnp.einsum('ecd,edf->ecf', expert_in, lp['w_gate'],
-                                  preferred_element_type=jnp.float32))
-    up = jnp.einsum('ecd,edf->ecf', expert_in, lp['w_up'],
-                    preferred_element_type=jnp.float32)
+    gate = jax.nn.silu(
+        qops.expert_einsum('ecd,edf->ecf', expert_in, lp['w_gate'],
+                           preferred_element_type=jnp.float32))
+    up = qops.expert_einsum('ecd,edf->ecf', expert_in, lp['w_up'],
+                            preferred_element_type=jnp.float32)
     act = shard((gate * up).astype(c.dtype),
                 ('expert', None, 'activation_mlp'))
-    expert_out = jnp.einsum('ecf,efd->ecd', act, lp['w_down'])
+    expert_out = qops.expert_einsum('ecf,efd->ecd', act, lp['w_down'])
     expert_out = shard(expert_out, ('expert', None, 'activation_embed'))
     out = jnp.einsum('tec,ecd->td', combine.astype(c.dtype), expert_out)
     return out.reshape(b, s, d), aux
@@ -267,9 +269,9 @@ def _layer(config: MoEConfig, mesh: Optional[mesh_lib.Mesh], x: jax.Array,
         return mesh_lib.shard_logical(arr, mesh, axes)
 
     h = llama._rms_norm(x, lp['attn_norm'], c.norm_eps)
-    q = (h @ lp['wq']).reshape(b, s, c.n_heads, hd)
-    k = (h @ lp['wk']).reshape(b, s, c.n_kv_heads, hd)
-    v = (h @ lp['wv']).reshape(b, s, c.n_kv_heads, hd)
+    q = qops.matmul(h, lp['wq']).reshape(b, s, c.n_heads, hd)
+    k = qops.matmul(h, lp['wk']).reshape(b, s, c.n_kv_heads, hd)
+    v = qops.matmul(h, lp['wv']).reshape(b, s, c.n_kv_heads, hd)
     q = shard(q, ('batch', 'activation_length', 'activation_heads', None))
     k = shard(k, ('batch', 'activation_length', 'activation_kv', None))
     q = llama._rope(q, positions, c.rope_theta)
@@ -291,7 +293,7 @@ def _layer(config: MoEConfig, mesh: Optional[mesh_lib.Mesh], x: jax.Array,
         attn = attention_ops.dot_product_attention(
             q, k, v, causal=True, implementation=c.attention_impl)
     attn = attn.reshape(b, s, c.n_heads * hd)
-    x = x + shard(attn @ lp['wo'],
+    x = x + shard(qops.matmul(attn, lp['wo']),
                   ('batch', 'activation_length', 'activation_embed'))
 
     h = llama._rms_norm(x, lp['mlp_norm'], c.norm_eps)
@@ -319,7 +321,7 @@ def forward(config: MoEConfig,
     if positions is None:
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[1])[None, :], tokens.shape)
-    x = params['embed'][tokens].astype(c.dtype)
+    x = qops.embed_rows(params['embed'], tokens).astype(c.dtype)
     if mesh is not None:
         x = mesh_lib.shard_logical(
             x, mesh, ('batch', 'activation_length', 'activation_embed'))
@@ -336,8 +338,8 @@ def forward(config: MoEConfig,
     x, aux_per_layer = jax.lax.scan(layer_fn, x, params['layers'])
 
     x = llama._rms_norm(x, params['final_norm'], c.norm_eps)
-    logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'],
-                        preferred_element_type=jnp.float32)
+    logits = qops.matmul(x, params['lm_head'],
+                         preferred_element_type=jnp.float32)
     if return_aux:
         return logits, jnp.mean(aux_per_layer)
     return logits
@@ -379,7 +381,7 @@ def prefill_hidden(config: MoEConfig, params: Params, tokens: jax.Array,
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
     token_mask = (positions < true_len).astype(jnp.float32)
-    x = params['embed'][tokens].astype(c.dtype)
+    x = qops.embed_rows(params['embed'], tokens).astype(c.dtype)
 
     def layer_fn(x, lp):
         x, _, kv = _layer(c, mesh, x, lp, positions,
@@ -401,7 +403,7 @@ def decode_forward(config: MoEConfig, params: Params,
     Expert capacity is the slot count, so routing never drops a token —
     decode outputs are deterministic regardless of slot contention."""
     c = config
-    x = params['embed'][last_tokens[:, None]].astype(c.dtype)
+    x = qops.embed_rows(params['embed'], last_tokens[:, None]).astype(c.dtype)
     pos = positions[:, None]
 
     def layer_fn(x, scanned):

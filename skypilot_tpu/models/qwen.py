@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from skypilot_tpu.models import llama
 from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.ops import quantization as qops
 from skypilot_tpu.parallel import mesh as mesh_lib
 
 Params = Dict[str, Any]
@@ -175,9 +176,9 @@ def _layer(config: QwenConfig, mesh: Optional[mesh_lib.Mesh],
         return mesh_lib.shard_logical(arr, mesh, axes)
 
     h = llama._rms_norm(x, lp['attn_norm'], c.norm_eps)
-    q = llama._ckpt_name(h @ lp['wq'], 'attn_q')
-    k = llama._ckpt_name(h @ lp['wk'], 'attn_k')
-    v = llama._ckpt_name(h @ lp['wv'], 'attn_v')
+    q = llama._ckpt_name(qops.matmul(h, lp['wq']), 'attn_q')
+    k = llama._ckpt_name(qops.matmul(h, lp['wk']), 'attn_k')
+    v = llama._ckpt_name(qops.matmul(h, lp['wv']), 'attn_v')
     if c.qkv_bias:
         q, k, v = q + lp['bq'], k + lp['bk'], v + lp['bv']
     q = q.reshape(b, s, c.n_heads, hd)
@@ -200,16 +201,16 @@ def _layer(config: QwenConfig, mesh: Optional[mesh_lib.Mesh],
         attn = attention_ops.dot_product_attention(
             q, k, v, causal=True, implementation=c.attention_impl)
     attn = attn.reshape(b, s, c.n_heads * hd)
-    x = x + shard(llama._ckpt_name(attn @ lp['wo'], 'attn_o'),
+    x = x + shard(llama._ckpt_name(qops.matmul(attn, lp['wo']), 'attn_o'),
                   ('batch', 'activation_length', 'activation_embed'))
 
     h = llama._rms_norm(x, lp['mlp_norm'], c.norm_eps)
     gate = jax.nn.silu(
-        llama._ckpt_name(h @ lp['w_gate'], 'mlp_gate').astype(jnp.float32))
-    up = llama._ckpt_name(h @ lp['w_up'], 'mlp_up').astype(jnp.float32)
+        llama._ckpt_name(qops.matmul(h, lp['w_gate']), 'mlp_gate').astype(jnp.float32))
+    up = llama._ckpt_name(qops.matmul(h, lp['w_up']), 'mlp_up').astype(jnp.float32)
     ff = shard((gate * up).astype(c.dtype),
                ('batch', 'activation_length', 'activation_mlp'))
-    x = x + shard(ff @ lp['w_down'],
+    x = x + shard(qops.matmul(ff, lp['w_down']),
                   ('batch', 'activation_length', 'activation_embed'))
     return x, new_cache
 
@@ -253,7 +254,7 @@ def decode_forward(config: QwenConfig, params: Params,
                    kv, mesh: Optional[mesh_lib.Mesh] = None):
     """One decode step for a batch of slots (llama.decode_forward twin)."""
     c = config
-    x = params['embed'][last_tokens[:, None]].astype(c.dtype)
+    x = qops.embed_rows(params['embed'], last_tokens[:, None]).astype(c.dtype)
     pos = positions[:, None]
 
     def layer_fn(x, scanned):
@@ -273,8 +274,8 @@ def forward(config: QwenConfig, params: Params, tokens: jax.Array,
             positions: Optional[jax.Array] = None) -> jax.Array:
     """Training forward → fp32 logits [B, S, vocab]."""
     x, _ = _trunk(config, params, tokens, positions, mesh)
-    return jnp.einsum('bsd,dv->bsv', x, params['lm_head'],
-                      preferred_element_type=jnp.float32)
+    return qops.matmul(x, params['lm_head'],
+                       preferred_element_type=jnp.float32)
 
 
 def loss_fn(config: QwenConfig, params: Params, tokens: jax.Array,

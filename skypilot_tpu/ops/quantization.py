@@ -1,0 +1,222 @@
+"""Int8 weight-only quantization for serving.
+
+Role-twin of the reference's serving quantization (the v6e serving
+recipe quantizes weights to fit + feed the chip; cf. JetStream-class
+engines), designed TPU-first: weights are stored int8 with
+per-output-channel fp32 scales and dequantized INSIDE the consuming
+matmul — `(x @ w_q.astype(bf16)) * scale` — which XLA fuses into the
+matmul epilogue. Decode is HBM-bandwidth-bound, so halving the bytes
+per weight read is a direct step-time win, and an 8B model's weights
+(16 GB bf16) fit a single 16 GB chip at int8.
+
+Design notes:
+  * `QuantizedTensor` is a registered pytree: it flows through jit,
+    `lax.scan` (leading-axis slices of both q and scale stay paired),
+    and device_put without special cases.
+  * The contraction axis is static aux data, counted FROM THE END so a
+    stacked `[L, in, out]` weight stays valid after scan slices it to
+    `[in, out]`.
+  * `matmul`/`embed_rows`/`tied_head`/`expert_einsum` dispatch on
+    type: plain arrays pass through untouched, so training code paths
+    share the same call sites at zero cost.
+  * Scales are fp32 `max(|w|)/127` per output channel — symmetric,
+    zero-point-free, which keeps the dequant a single fused multiply.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class QuantizedTensor:
+    """int8 values + per-output-channel fp32 scales.
+
+    `axis` is the CONTRACTION axis as a negative index; `scale` has
+    the shape of `q` with that axis removed.
+    """
+    q: jax.Array
+    scale: jax.Array
+    axis: int = -2
+
+    def tree_flatten(self):
+        return (self.q, self.scale), self.axis
+
+    @classmethod
+    def tree_unflatten(cls, axis, children):
+        q, scale = children
+        return cls(q, scale, axis)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self) -> int:
+        return self.q.ndim
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+    @property
+    def nbytes(self) -> int:
+        return self.q.nbytes + self.scale.nbytes
+
+
+def quantize(w: jax.Array, axis: int = -2) -> QuantizedTensor:
+    """Symmetric per-output-channel int8 over the contraction `axis`."""
+    if axis >= 0:
+        axis = axis - w.ndim
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.round(w.astype(jnp.float32) /
+                  jnp.expand_dims(scale, axis)).astype(jnp.int8)
+    return QuantizedTensor(q, scale, axis)
+
+
+def dequantize(w: QuantizedTensor, dtype=jnp.bfloat16) -> jax.Array:
+    return (w.q.astype(jnp.float32) *
+            jnp.expand_dims(w.scale, w.axis)).astype(dtype)
+
+
+def matmul(x: jax.Array, w, preferred_element_type=None) -> jax.Array:
+    """`x @ w` for `w` either a plain `[.., in, out]` array or a
+    QuantizedTensor with contraction at -2; dequant fuses into the
+    matmul epilogue."""
+    if isinstance(w, QuantizedTensor):
+        assert w.axis == -2, (
+            f'matmul needs contraction at -2, got {w.axis}')
+        out = jnp.matmul(x, w.q.astype(x.dtype),
+                         preferred_element_type=preferred_element_type)
+        return out * w.scale.astype(out.dtype)
+    return jnp.matmul(x, w, preferred_element_type=preferred_element_type)
+
+
+def embed_rows(table, tokens: jax.Array) -> jax.Array:
+    """`table[tokens]` for a plain or row-quantized (axis=-1) table."""
+    if isinstance(table, QuantizedTensor):
+        assert table.axis == -1, (
+            f'embed_rows needs per-row scales (axis -1), got {table.axis}')
+        rows = table.q[tokens]
+        return rows.astype(table.scale.dtype) * table.scale[tokens][..., None]
+    return table[tokens]
+
+
+def tied_head(hidden: jax.Array, table,
+              preferred_element_type=jnp.float32) -> jax.Array:
+    """`einsum('...d,vd->...v')` against a (possibly row-quantized)
+    embedding table used as a tied LM head (gemma)."""
+    if isinstance(table, QuantizedTensor):
+        assert table.axis == -1
+        out = jnp.einsum('...d,vd->...v', hidden,
+                         table.q.astype(hidden.dtype),
+                         preferred_element_type=preferred_element_type)
+        return out * table.scale.astype(out.dtype)
+    return jnp.einsum('...d,vd->...v', hidden, table,
+                      preferred_element_type=preferred_element_type)
+
+
+def expert_einsum(spec: str, x: jax.Array, w,
+                  preferred_element_type=None) -> jax.Array:
+    """MoE expert einsum (`ecd,edf->ecf` / `ecf,efd->ecd`) where `w`
+    may be quantized over its middle (contraction) axis: the [E, out]
+    scale broadcasts as [E, 1, out] over the `e?out` result."""
+    if isinstance(w, QuantizedTensor):
+        assert w.axis == -2
+        out = jnp.einsum(spec, x, w.q.astype(x.dtype),
+                         preferred_element_type=preferred_element_type)
+        return out * w.scale[:, None, :].astype(out.dtype)
+    return jnp.einsum(spec, x, w,
+                      preferred_element_type=preferred_element_type)
+
+
+# Weight leaves quantized for serving, keyed by name. Contraction is
+# -2 (matmul convention) except the embedding table, whose rows must
+# dequantize independently for the token gather (and whose tied-head
+# use contracts over d = its LAST axis — the same per-row scale
+# serves both).
+_QUANT_AXES = {
+    'wq': -2, 'wk': -2, 'wv': -2, 'wo': -2,
+    'w_gate': -2, 'w_up': -2, 'w_down': -2,
+    'lm_head': -2,
+    'embed': -1,
+}
+
+
+def quantize_params(params: Params) -> Params:
+    """Quantize a family's weight matrices for serving.
+
+    Norm vectors (and any leaf not in the known weight set) stay in
+    their original dtype; already-quantized leaves pass through, so
+    the transform is idempotent.
+    """
+
+    def walk(node):
+        if isinstance(node, dict):
+            out = {}
+            for key, value in node.items():
+                if isinstance(value, dict):
+                    out[key] = walk(value)
+                elif isinstance(value, QuantizedTensor):
+                    out[key] = value
+                elif key in _QUANT_AXES and value.ndim >= 2:
+                    out[key] = quantize(value, _QUANT_AXES[key])
+                else:
+                    out[key] = value
+            return out
+        return node
+
+    return walk(params)
+
+
+def params_nbytes(params: Params) -> int:
+    return sum(leaf.nbytes
+               for leaf in jax.tree_util.tree_leaves(params))
+
+
+def synthetic_quantized_params(shapes: Params, key: jax.Array) -> Params:
+    """Random params born directly in quantized form.
+
+    For throughput benchmarks of models whose bf16 init would not fit
+    the chip (an 8B is 16 GB bf16 — exactly one v5e's HBM before
+    quantizing): weights are sampled straight as int8 with fan-in
+    scales, never materializing the full-precision tree. `shapes` is
+    the `jax.eval_shape` of the family's `init`.
+    """
+
+    def walk(node, key):
+        if isinstance(node, dict):
+            out = {}
+            for name, value in sorted(node.items()):
+                key, sub = jax.random.split(key)
+                if isinstance(value, dict):
+                    out[name] = walk(value, sub)
+                elif name in _QUANT_AXES and value.ndim >= 2:
+                    axis = _QUANT_AXES[name]
+                    # bits+bitcast, NOT randint: eager randint would
+                    # materialize a 4x int32 transient per leaf (7.5 GB
+                    # for an 8B's stacked w_gate) — defeating the whole
+                    # point of sampling straight into int8.
+                    q = jax.lax.bitcast_convert_type(
+                        jax.random.bits(sub, value.shape, jnp.uint8),
+                        jnp.int8)
+                    fan_in = value.shape[axis]
+                    scale_shape = list(value.shape)
+                    del scale_shape[axis % value.ndim]
+                    scale = jnp.full(scale_shape,
+                                     (fan_in ** -0.5) / 127.0,
+                                     jnp.float32)
+                    out[name] = QuantizedTensor(q, scale, axis)
+                else:
+                    out[name] = jnp.ones(value.shape, value.dtype)
+            return out
+        return node
+
+    return walk(shapes, key)
