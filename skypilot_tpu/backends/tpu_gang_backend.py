@@ -224,6 +224,15 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
         sky/provision/instance_setup.py:540.)
         """
         runners = handle.get_command_runners()
+        for cmd in handle.cluster_info.mount_commands:
+            # Volume mounts (idempotent; provider-built). Every host
+            # mounts before anything else lands on the cluster.
+            for rank, runner in enumerate(runners):
+                rc, _, stderr = runner.run(cmd, require_outputs=True)
+                if rc != 0:
+                    raise exceptions.ClusterSetUpError(
+                        f'Volume mount failed on host {rank}: '
+                        f'{stderr.strip()} (cmd: {cmd})')
         if self._bootstraps(handle):
             wheel_path, content_hash = wheel_utils.build_wheel()
             for rank, runner in enumerate(runners):

@@ -46,7 +46,17 @@ class Fake(catalog_cloud.CatalogCloud):
                 resources.effective_provisioning_model()
             if args.get('reservation'):
                 vars['reservation'] = args['reservation']
+        if resources.volumes:
+            vars['volumes'] = [dict(v) for v in resources.volumes]
         return vars
+
+    def provider_config_overrides(
+            self, node_config: Dict[str, Any]) -> Dict[str, Any]:
+        # Same threading as GCP: get_cluster_info builds mount commands
+        # from the persisted provider_config.
+        if node_config.get('volumes'):
+            return {'volumes': node_config['volumes']}
+        return {}
 
     def check_credentials(self) -> Tuple[bool, Optional[str]]:
         return True, None

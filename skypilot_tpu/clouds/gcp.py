@@ -70,6 +70,9 @@ class GCP(catalog_cloud.CatalogCloud):
             'ssh_user': authentication.DEFAULT_SSH_USER,
             'metadata': {
                 'ssh-keys': authentication.gcp_ssh_keys_metadata()},
+            # Copies: the provisioner annotates volume dicts (full
+            # source paths) and must never mutate Resources._volumes.
+            'volumes': [dict(v) for v in resources.volumes or []],
         }
         topo = self.tpu_topology_of(resources)
         if topo is not None:
@@ -145,7 +148,11 @@ class GCP(catalog_cloud.CatalogCloud):
         Sources: $GOOGLE_CLOUD_PROJECT, config key gcp.project_id, then
         the ADC file's quota_project_id.
         """
-        del node_config
+        overrides: Dict[str, Any] = {}
+        if node_config.get('volumes'):
+            # get_cluster_info builds the mount commands from the
+            # persisted provider_config — thread volumes through it.
+            overrides['volumes'] = node_config['volumes']
         project = os.environ.get('GOOGLE_CLOUD_PROJECT')
         if not project:
             from skypilot_tpu import config as config_lib
@@ -169,7 +176,9 @@ class GCP(catalog_cloud.CatalogCloud):
                     project = None
                 if project:
                     break
-        return {'project_id': project} if project else {}
+        if project:
+            overrides['project_id'] = project
+        return overrides
 
     def check_credentials(self) -> Tuple[bool, Optional[str]]:
         for path in DEFAULT_CREDENTIAL_PATHS:

@@ -63,6 +63,9 @@ class ClusterInfo:
     provider_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
     ssh_user: str = 'root'
     custom_ray_options: Optional[Dict[str, Any]] = None  # unused (no Ray)
+    # Idempotent per-host commands the backend runs at runtime setup
+    # (volume mkfs/mount; provider-specific, built by get_cluster_info).
+    mount_commands: List[str] = dataclasses.field(default_factory=list)
 
     def get_head_instance(self) -> Optional[InstanceInfo]:
         if self.head_instance_id is None:
@@ -103,6 +106,7 @@ class ClusterInfo:
             'provider_name': self.provider_name,
             'provider_config': self.provider_config,
             'ssh_user': self.ssh_user,
+            'mount_commands': self.mount_commands,
         }
 
     @classmethod
@@ -114,4 +118,5 @@ class ClusterInfo:
             provider_name=data['provider_name'],
             provider_config=data.get('provider_config', {}),
             ssh_user=data.get('ssh_user', 'root'),
+            mount_commands=data.get('mount_commands', []),
         )

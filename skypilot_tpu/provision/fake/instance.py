@@ -274,12 +274,23 @@ def get_cluster_info(region: str, cluster_name: str,
     cluster = _load()['clusters'].get(cluster_name)
     if cluster is None:
         raise exceptions.ClusterDoesNotExist(cluster_name)
+    # Volumes on the fake cloud: hosts are local processes, so a
+    # "mount" is a marker directory — which exercises the real
+    # resources → deploy-vars → ClusterInfo.mount_commands → backend
+    # execution path end-to-end without root or real disks.
+    import shlex
+    mount_commands = [
+        f'mkdir -p {shlex.quote(vol["path"])} && '
+        f'touch {shlex.quote(vol["path"] + "/.xsky-vol-" + vol["name"])}'
+        for vol in (provider_config or {}).get('volumes') or []
+    ]
     return common.ClusterInfo(
         instances=_infos_from(cluster),
         head_instance_id=cluster['head_id'],
         provider_name='fake',
         provider_config=dict(provider_config or {}),
-        ssh_user='fake-user')
+        ssh_user='fake-user',
+        mount_commands=mount_commands)
 
 
 # ---- test helpers ----------------------------------------------------------

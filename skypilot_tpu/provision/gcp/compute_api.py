@@ -68,6 +68,33 @@ class ComputeClient:
         return self.t.request('POST',
                               f'{self.prefix}/instances/{name}/start')
 
+    # ---- persistent disks (volumes) ------------------------------------
+
+    def get_disk(self, name: str) -> Optional[Dict[str, Any]]:
+        try:
+            return self.t.request('GET', f'{self.prefix}/disks/{name}')
+        except rest.GcpApiError as e:
+            if e.status == 404:
+                return None
+            raise
+
+    def insert_disk(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        return self.t.request('POST', f'{self.prefix}/disks', body=body)
+
+    def delete_disk(self, name: str) -> Dict[str, Any]:
+        return self.t.request('DELETE', f'{self.prefix}/disks/{name}')
+
+    def list_disks(self, label_filter: str) -> List[Dict[str, Any]]:
+        resp = self.t.request('GET', f'{self.prefix}/disks',
+                              params={'filter': label_filter})
+        return resp.get('items', [])
+
+    def attach_disk(self, vm_name: str,
+                    body: Dict[str, Any]) -> Dict[str, Any]:
+        return self.t.request(
+            'POST', f'{self.prefix}/instances/{vm_name}/attachDisk',
+            body=body)
+
     def wait_operation(self, op: Dict[str, Any],
                        timeout: float = 900.0,
                        poll_interval: float = 3.0) -> Dict[str, Any]:
@@ -134,6 +161,168 @@ def vm_body(node_config: Dict[str, Any], cluster_name: str, vm_name: str,
             'instanceTerminationAction': 'DELETE',
         })
     return body
+
+
+# ---- volumes (network persistent disks) -------------------------------
+#
+# Twin of sky/provision/gcp/volume_utils.py, redesigned around this
+# repo's flow: disks are ensured + attached during run_instances, the
+# mkfs-if-blank/mount commands ride ClusterInfo.mount_commands, and
+# auto_delete disks are labeled so terminate can find them without any
+# local state.
+
+AUTO_DELETE_LABEL = 'xsky-auto-delete'
+
+# resources disk_tier → GCP disk type.
+DISK_TIER_TYPES = {
+    None: 'pd-balanced',
+    'low': 'pd-standard',
+    'medium': 'pd-balanced',
+    'high': 'pd-ssd',
+    'ultra': 'pd-extreme',
+    'best': 'pd-ssd',
+}
+
+
+def disk_body(volume: Dict[str, Any], cluster_name: str,
+              zone: str) -> Dict[str, Any]:
+    labels = {CLUSTER_LABEL: cluster_name}
+    if volume.get('auto_delete'):
+        labels[AUTO_DELETE_LABEL] = 'true'
+    disk_type = DISK_TIER_TYPES.get(volume.get('disk_tier'),
+                                    'pd-balanced')
+    return {
+        'name': volume['name'],
+        'sizeGb': str(volume.get('size', 100)),
+        'type': f'zones/{zone}/diskTypes/{disk_type}',
+        'labels': labels,
+    }
+
+
+def validate_volumes(volumes: List[Dict[str, Any]],
+                     num_nodes: int) -> None:
+    """Fail BEFORE anything is created: a read_write persistent disk
+    attaches to one instance only, so multi-node clusters need
+    read_only (multi-attach) volumes."""
+    for vol in volumes or []:
+        if (vol.get('attach_mode', 'read_write') == 'read_write'
+                and num_nodes > 1):
+            raise exceptions.InvalidSkyTpuConfigError(
+                f'Volume {vol["name"]!r} is read_write but the cluster '
+                f'spans {num_nodes} nodes; GCP persistent disks attach '
+                'read-write to one instance only. Use attach_mode: '
+                'read_only for shared volumes.')
+
+
+def ensure_disk(gce: 'ComputeClient', vol: Dict[str, Any],
+                cluster_name: str, zone: str) -> None:
+    """Create the disk if missing; surface spec drift when reusing.
+
+    A read_only volume must already exist: it is unwritable from this
+    cluster, so a freshly created blank one could never be formatted
+    or populated — creating it here would only produce an unmountable
+    device at runtime setup.
+    """
+    existing = gce.get_disk(vol['name'])
+    if existing is None:
+        if vol.get('attach_mode') == 'read_only':
+            raise exceptions.InvalidSkyTpuConfigError(
+                f'read_only volume {vol["name"]!r} does not exist in '
+                f'{zone}. Create and populate it first (e.g. a '
+                'single-node cluster with attach_mode: read_write).')
+        gce.wait_operation(
+            gce.insert_disk(disk_body(vol, cluster_name, zone)))
+        return
+    # Reuse: the request's size/tier/auto_delete do NOT apply to an
+    # existing disk — say so instead of silently diverging.
+    want_size = str(vol.get('size', 100))
+    if existing.get('sizeGb') not in (None, want_size):
+        logger.warning(
+            f'Volume {vol["name"]!r} exists with sizeGb='
+            f'{existing.get("sizeGb")}; requested size {want_size} '
+            'is ignored (resize disks via the cloud console/CLI).')
+    if (vol.get('auto_delete') and existing.get('labels', {})
+            .get(AUTO_DELETE_LABEL) != 'true'):
+        logger.warning(
+            f'Volume {vol["name"]!r} pre-exists without the '
+            f'{AUTO_DELETE_LABEL} label; auto_delete only applies to '
+            'disks this provisioner creates — it will NOT be deleted '
+            'at teardown.')
+
+
+def ensure_and_attach_volumes(gce: 'ComputeClient',
+                              volumes: List[Dict[str, Any]],
+                              cluster_name: str, vm_names: List[str],
+                              zone: str) -> None:
+    """Create missing disks and attach them to every node."""
+    if not volumes:
+        return
+    validate_volumes(volumes, len(vm_names))
+    for vol in volumes:
+        ensure_disk(gce, vol, cluster_name, zone)
+    for vm_name in vm_names:
+        attached = {d.get('deviceName')
+                    for d in gce.get(vm_name).get('disks', [])}
+        for vol in volumes:
+            if vol['name'] in attached:
+                continue
+            mode = ('READ_ONLY' if vol.get('attach_mode') == 'read_only'
+                    else 'READ_WRITE')
+            gce.wait_operation(gce.attach_disk(vm_name, {
+                'source': (f'projects/{gce.project}/zones/{zone}/disks/'
+                           f'{vol["name"]}'),
+                'deviceName': vol['name'],
+                'mode': mode,
+            }))
+
+
+def volume_mount_commands(volumes: List[Dict[str, Any]],
+                          tpu: bool = False) -> List[str]:
+    """Idempotent mkfs-if-blank + mount for each attached volume.
+
+    Compute VMs expose an attached disk with deviceName NAME at
+    /dev/disk/by-id/google-NAME; TPU VMs name dataDisks
+    persistent-disk-{i+1} in attach order. ext4 is only created when
+    the device has no filesystem (blkid rc!=0), so data survives
+    re-attachment. Steps chain with && so ANY failure (missing device,
+    bad filesystem, mount error) exits non-zero and fails the launch —
+    a silently-unmounted "persistent" path writing to the boot disk is
+    the worst outcome.
+    """
+    import shlex
+    cmds = []
+    for i, vol in enumerate(volumes or []):
+        device = (f'persistent-disk-{i + 1}' if tpu else vol['name'])
+        dev = shlex.quote(f'/dev/disk/by-id/google-{device}')
+        path = shlex.quote(vol['path'])
+        read_only = vol.get('attach_mode') == 'read_only'
+        steps = []
+        if not read_only:
+            steps.append(f'(sudo blkid {dev} >/dev/null 2>&1 || '
+                         f'sudo mkfs.ext4 -q {dev})')
+        steps.append(f'sudo mkdir -p {path}')
+        opts = '-o ro ' if read_only else ''
+        steps.append(f'(mountpoint -q {path} || '
+                     f'sudo mount {opts}{dev} {path})')
+        if not read_only:
+            steps.append(f'sudo chmod 777 {path}')
+        cmds.append(' && '.join(steps))
+    return cmds
+
+
+def delete_auto_delete_volumes(gce: 'ComputeClient',
+                               cluster_name: str) -> None:
+    """Best-effort delete of this cluster's auto_delete-labeled disks
+    (instances must already be gone — GCP refuses to delete attached
+    disks, which is the safety net for shared volumes)."""
+    label_filter = (f'labels.{CLUSTER_LABEL}={cluster_name} AND '
+                    f'labels.{AUTO_DELETE_LABEL}=true')
+    for disk in gce.list_disks(label_filter):
+        try:
+            gce.wait_operation(gce.delete_disk(disk['name']))
+        except rest.GcpApiError as e:
+            logger.warning(
+                f'auto_delete volume {disk["name"]!r} not deleted: {e}')
 
 
 def vm_instance_info(inst: Dict[str, Any]) -> Dict[str, Any]:

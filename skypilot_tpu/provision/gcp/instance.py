@@ -94,8 +94,20 @@ def _node_name(cluster_name: str, node_index: int) -> str:
 
 
 def _run_tpu(zone: str, cluster_name: str, config: common.ProvisionConfig):
-    tpu, _ = _clients(config.provider_config, zone)
+    tpu, gce = _clients(config.provider_config, zone)
     node_cfg = config.node_config
+    volumes = node_cfg.get('volumes') or []
+    if volumes:
+        # Disks must exist before node create (TPU attaches them via
+        # dataDisks in the node body, with full source paths). A RW
+        # disk mounts on one host only — same rule as compute VMs.
+        num_hosts = (int(node_cfg.get('tpu_num_hosts', 1)) *
+                     int(node_cfg.get('tpu_num_slices', 1)))
+        compute_api.validate_volumes(volumes, num_hosts)
+        for vol in volumes:
+            compute_api.ensure_disk(gce, vol, cluster_name, zone)
+            vol['source'] = (f'projects/{gce.project}/zones/{zone}/'
+                             f'disks/{vol["name"]}')
     num_slices = int(node_cfg.get('tpu_num_slices', 1))
     use_qr = bool(node_cfg.get('tpu_use_queued_resources')) or num_slices > 1
 
@@ -230,6 +242,10 @@ def _create_via_queued_resources(tpu: tpu_api.TpuClient, cluster_name: str,
 
 def _run_vms(zone: str, cluster_name: str, config: common.ProvisionConfig):
     _, gce = _clients(config.provider_config, zone)
+    volumes = config.node_config.get('volumes') or []
+    # Fail BEFORE any VM is inserted: a post-create volume error would
+    # strand billed instances behind a no-failover config error.
+    compute_api.validate_volumes(volumes, config.count)
     existing = gce.list_cluster(cluster_name)
     by_name = {i['name']: i for i in existing}
     created: List[str] = []
@@ -253,6 +269,11 @@ def _run_vms(zone: str, cluster_name: str, config: common.ProvisionConfig):
         created.append(vm_name)
     for op in ops:
         gce.wait_operation(op)
+
+    if volumes:
+        vm_names = sorted(set(by_name) | set(created))
+        compute_api.ensure_and_attach_volumes(gce, volumes, cluster_name,
+                                              vm_names, zone)
 
     head = None
     for inst in gce.list_cluster(cluster_name):
@@ -334,6 +355,9 @@ def terminate_instances(cluster_name: str,
                 raise
     for op in ops:
         gce.wait_operation(op)
+    # auto_delete volumes die with the cluster (instances are gone, so
+    # GCP's refusal to delete attached disks protects shared volumes).
+    compute_api.delete_auto_delete_volumes(gce, cluster_name)
 
 
 def query_instances(cluster_name: str, provider_config: Dict[str, Any]
@@ -362,8 +386,9 @@ def get_cluster_info(region: str, cluster_name: str,
     tpu, gce = _clients(provider_config, zone)
     instances: Dict[str, common.InstanceInfo] = {}
     head_id: Optional[str] = None
-    for node in sorted(tpu.list_nodes(cluster_name),
-                       key=lambda n: n.get('name', '')):
+    tpu_nodes = sorted(tpu.list_nodes(cluster_name),
+                       key=lambda n: n.get('name', ''))
+    for node in tpu_nodes:
         is_head_node = node.get('labels', {}).get(
             tpu_api.HEAD_LABEL) == 'true'
         for info_dict in tpu_api.node_instance_infos(node):
@@ -383,8 +408,12 @@ def get_cluster_info(region: str, cluster_name: str,
         raise exceptions.ClusterDoesNotExist(cluster_name)
     if head_id is None:
         head_id = sorted(instances)[0]
+    volumes = provider_config.get('volumes') or []
+    is_tpu = bool(tpu_nodes)
     return common.ClusterInfo(
         instances=instances, head_instance_id=head_id,
         provider_name='gcp',
         provider_config=dict(provider_config or {}),
-        ssh_user=provider_config.get('ssh_user', 'xsky'))
+        ssh_user=provider_config.get('ssh_user', 'xsky'),
+        mount_commands=compute_api.volume_mount_commands(volumes,
+                                                         tpu=is_tpu))

@@ -185,6 +185,16 @@ def node_body(node_config: Dict[str, Any], cluster_name: str,
             'email': node_config['service_account'],
             'scope': ['https://www.googleapis.com/auth/cloud-platform'],
         }
+    if node_config.get('volumes'):
+        # TPU VMs take persistent disks via dataDisks at create time
+        # (no post-hoc attach like compute VMs). READ_ONLY_MANY allows
+        # one disk across all hosts/slices; READ_WRITE is single-host.
+        body['dataDisks'] = [{
+            'sourceDisk': vol.get('source', vol['name']),
+            'mode': ('READ_ONLY_MANY'
+                     if vol.get('attach_mode') == 'read_only'
+                     else 'READ_WRITE'),
+        } for vol in node_config['volumes']]
     return body
 
 

@@ -47,6 +47,7 @@ class Resources:
         ports: Optional[Union[int, str, List[Union[int, str]]]] = None,
         labels: Optional[Dict[str, str]] = None,
         autostop: Optional[Union[int, bool, Dict[str, Any]]] = None,
+        volumes: Optional[List[Dict[str, Any]]] = None,
         _cluster_config_overrides: Optional[Dict[str, Any]] = None,
     ) -> None:
         self._cloud_name = self._canonical_cloud(cloud)
@@ -65,6 +66,7 @@ class Resources:
         self._ports = self._canonical_ports(ports)
         self._labels = dict(labels) if labels else None
         self._autostop = self._canonical_autostop(autostop)
+        self._volumes = self._canonical_volumes(volumes)
         self._cluster_config_overrides = _cluster_config_overrides
 
         self._accelerator_args = dict(accelerator_args) \
@@ -73,6 +75,54 @@ class Resources:
         self._validate()
 
     # ---- canonicalization ----
+
+    @staticmethod
+    def _canonical_volumes(
+            volumes: Optional[List[Dict[str, Any]]]
+    ) -> Optional[List[Dict[str, Any]]]:
+        """Validate + default the `volumes:` list (network disks created
+        on demand, attached to every node, mounted at `path`; twin of
+        the reference's resources.volumes, sky/resources.py:838).
+        """
+        if not volumes:
+            return None
+        out = []
+        for vol in volumes:
+            if not isinstance(vol, dict):
+                raise ValueError(f'volumes entries must be maps, got '
+                                 f'{vol!r}')
+            unknown = set(vol) - {'name', 'path', 'size', 'disk_tier',
+                                  'attach_mode', 'auto_delete'}
+            if unknown:
+                raise ValueError(
+                    f'Unknown volume fields: {sorted(unknown)}.')
+            for req in ('name', 'path'):
+                if not vol.get(req):
+                    raise ValueError(f'volumes entries need {req!r}.')
+            import re
+            if not re.fullmatch(r'[a-z]([a-z0-9-]{0,61}[a-z0-9])?',
+                                str(vol['name'])):
+                raise ValueError(
+                    f"volume name {vol['name']!r} must match cloud disk "
+                    "naming: lowercase letters, digits, hyphens, "
+                    "starting with a letter.")
+            if not str(vol['path']).startswith('/'):
+                raise ValueError(
+                    f"volume path must be absolute: {vol['path']!r}")
+            mode = vol.get('attach_mode', 'read_write')
+            if mode not in ('read_write', 'read_only'):
+                raise ValueError(
+                    f"volume attach_mode must be read_write or "
+                    f"read_only, got {mode!r}")
+            out.append({
+                'name': str(vol['name']),
+                'path': str(vol['path']),
+                'size': int(vol.get('size', 100)),
+                'disk_tier': vol.get('disk_tier'),
+                'attach_mode': mode,
+                'auto_delete': bool(vol.get('auto_delete', False)),
+            })
+        return out
 
     @staticmethod
     def _canonical_cloud(cloud: Optional[str]) -> Optional[str]:
@@ -253,6 +303,10 @@ class Resources:
         return self._labels
 
     @property
+    def volumes(self) -> Optional[List[Dict[str, Any]]]:
+        return self._volumes
+
+    @property
     def autostop(self) -> Optional[Dict[str, Any]]:
         return self._autostop
 
@@ -399,6 +453,7 @@ class Resources:
             'ports': self._ports,
             'labels': self._labels,
             'autostop': self._autostop,
+            'volumes': self._volumes,
             '_cluster_config_overrides': self._cluster_config_overrides,
         }
         fields.update(override)
@@ -437,7 +492,7 @@ class Resources:
             'cloud', 'instance_type', 'cpus', 'memory', 'accelerators',
             'accelerator_args', 'use_spot', 'job_recovery', 'region', 'zone',
             'image_id', 'disk_size', 'disk_tier', 'ports', 'labels',
-            'autostop'
+            'autostop', 'volumes'
         }
         unknown = set(config) - known
         if unknown:
@@ -473,6 +528,7 @@ class Resources:
         add('ports', self._ports)
         add('labels', self._labels)
         add('autostop', self._autostop)
+        add('volumes', self._volumes)
         return config
 
     def __repr__(self) -> str:

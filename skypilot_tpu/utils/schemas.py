@@ -48,6 +48,18 @@ _RESOURCES_FIELDS: Dict[str, Any] = {
     'ports': {'type': ['integer', 'string', 'array']},
     'labels': _STR_MAP,
     'autostop': {'type': ['boolean', 'integer', 'string', 'object']},
+    'volumes': {'type': 'array', 'items': {
+        'type': 'object', 'additionalProperties': False,
+        'properties': {
+            'name': _STR,
+            'path': _STR,
+            'size': _INT,
+            'disk_tier': {'enum': ['low', 'medium', 'high', 'ultra',
+                                   'best']},
+            'attach_mode': {'enum': ['read_write', 'read_only']},
+            'auto_delete': _BOOL,
+        },
+        'required': ['name', 'path']}},
 }
 
 _RESOURCES_SCHEMA: Dict[str, Any] = {

@@ -25,6 +25,7 @@ class FakeGcp:
         self.tpu_nodes: Dict[str, Dict[str, Any]] = {}
         self.vms: Dict[str, Dict[str, Any]] = {}
         self.queued: Dict[str, Dict[str, Any]] = {}
+        self.disks: Dict[str, Dict[str, Any]] = {}
         self.fail_create: Optional[rest.GcpApiError] = None
         self.qr_states: list = []     # scripted QR state sequence
         self.num_hosts = 1
@@ -161,10 +162,41 @@ class FakeGcp:
             self.vms[m.group(1)]['status'] = (
                 'TERMINATED' if m.group(2) == 'stop' else 'RUNNING')
             return {'name': f'{m.group(2)}-{m.group(1)}'}
+        m = re.search(r'/instances/([^/]+)/attachDisk$', path)
+        if m:
+            self.vms[m.group(1)].setdefault('disks', []).append(dict(body))
+            return {'name': f'attach-{m.group(1)}'}
         m = re.search(r'/instances/([^/]+)$', path)
+        if m and method == 'GET':
+            return self.vms[m.group(1)]
         if m and method == 'DELETE':
             self.vms.pop(m.group(1), None)
             return {'name': f'del-{m.group(1)}'}
+        m = re.search(r'/disks/([^/]+)$', path)
+        if m and method == 'GET':
+            disk = self.disks.get(m.group(1))
+            if disk is None:
+                raise rest.GcpApiError(404, 'notFound', 'disk not found')
+            return disk
+        if m and method == 'DELETE':
+            disk = self.disks.get(m.group(1))
+            if disk and disk.get('users'):
+                raise rest.GcpApiError(400, 'resourceInUse',
+                                       'disk is attached')
+            self.disks.pop(m.group(1), None)
+            return {'name': f'del-disk-{m.group(1)}'}
+        if path.endswith('/disks') and method == 'POST':
+            self.disks[body['name']] = dict(body)
+            return {'name': f'insert-disk-{body["name"]}'}
+        if path.endswith('/disks') and method == 'GET':
+            items = list(self.disks.values())
+            for clause in params.get('filter', '').split(' AND '):
+                m2 = re.search(r'labels\.(\S+)=(\S+)', clause)
+                if m2:
+                    items = [d for d in items
+                             if d.get('labels', {}).get(m2.group(1)) ==
+                             m2.group(2)]
+            return {'items': items}
         if '/operations/' in path:
             return {'status': 'DONE'}
         raise AssertionError(f'unhandled compute call {method} {path}')
@@ -408,3 +440,165 @@ def test_deploy_vars_flex_start_and_reserved():
                 accelerators='tpu-v5p-8',
                 accelerator_args={'provisioning_model': 'bogus'}),
             'c', 'us-central2', 'us-central2-b')
+
+
+# ---- volumes (network persistent disks) --------------------------------
+
+
+VOL = {'name': 'data1', 'path': '/mnt/data', 'size': 50,
+       'disk_tier': 'high', 'attach_mode': 'read_write',
+       'auto_delete': True}
+
+
+def _vm_volume_config(count=1, volumes=None):
+    return common.ProvisionConfig(
+        provider_config=dict(PROVIDER, volumes=volumes or [dict(VOL)]),
+        node_config={'instance_type': 'n2-standard-8',
+                     'volumes': volumes or [dict(VOL)]},
+        count=count)
+
+
+def test_vm_volume_created_attached_and_mounted(fake_gcp):
+    gcp_instance.run_instances('us-central2', 'us-central2-b', 'vol1',
+                               _vm_volume_config())
+    # Disk created with the tier-mapped type and cluster label.
+    disk = fake_gcp.disks['data1']
+    assert disk['type'].endswith('pd-ssd')
+    assert disk['labels']['xsky-cluster'] == 'vol1'
+    assert disk['labels']['xsky-auto-delete'] == 'true'
+    # Attached read-write to the single node.
+    attached = fake_gcp.vms['vol1-0']['disks']
+    assert attached[0]['deviceName'] == 'data1'
+    assert attached[0]['mode'] == 'READ_WRITE'
+    # Mount commands ride ClusterInfo (mkfs-if-blank + mount + perms).
+    info = gcp_instance.get_cluster_info(
+        'us-central2', 'vol1', dict(PROVIDER, volumes=[dict(VOL)]))
+    assert len(info.mount_commands) == 1
+    cmd = info.mount_commands[0]
+    assert '/dev/disk/by-id/google-data1' in cmd
+    assert 'mkfs.ext4' in cmd and '/mnt/data' in cmd
+    # Round-trips through the serialized cluster_info.json.
+    again = common.ClusterInfo.from_json(info.to_json())
+    assert again.mount_commands == info.mount_commands
+
+
+def test_vm_volume_idempotent_relaunch(fake_gcp):
+    gcp_instance.run_instances('us-central2', 'us-central2-b', 'vol2',
+                               _vm_volume_config())
+    gcp_instance.run_instances('us-central2', 'us-central2-b', 'vol2',
+                               _vm_volume_config())
+    assert len(fake_gcp.vms['vol2-0']['disks']) == 1  # not re-attached
+
+
+def test_vm_read_write_volume_rejects_multinode(fake_gcp):
+    with pytest.raises(exceptions.InvalidSkyTpuConfigError):
+        gcp_instance.run_instances('us-central2', 'us-central2-b',
+                                   'vol3', _vm_volume_config(count=2))
+
+
+def test_vm_read_only_volume_multinode_multiattach(fake_gcp):
+    vol = dict(VOL, attach_mode='read_only', auto_delete=False)
+    # read_only volumes must pre-exist (unwritable from this cluster,
+    # so a blank one could never be formatted/populated).
+    fake_gcp.disks['data1'] = {'name': 'data1', 'sizeGb': '50',
+                               'labels': {}}
+    gcp_instance.run_instances('us-central2', 'us-central2-b', 'vol4',
+                               _vm_volume_config(count=2, volumes=[vol]))
+    for vm in ('vol4-0', 'vol4-1'):
+        assert fake_gcp.vms[vm]['disks'][0]['mode'] == 'READ_ONLY'
+    info = gcp_instance.get_cluster_info(
+        'us-central2', 'vol4', dict(PROVIDER, volumes=[vol]))
+    # Read-only: no mkfs, ro mount.
+    assert 'mkfs' not in info.mount_commands[0]
+    assert '-o ro' in info.mount_commands[0]
+
+
+def test_read_only_volume_must_preexist(fake_gcp):
+    vol = dict(VOL, attach_mode='read_only')
+    with pytest.raises(exceptions.InvalidSkyTpuConfigError):
+        gcp_instance.run_instances('us-central2', 'us-central2-b',
+                                   'vol4b',
+                                   _vm_volume_config(volumes=[vol]))
+
+
+def test_rw_multinode_fails_before_any_vm_created(fake_gcp):
+    with pytest.raises(exceptions.InvalidSkyTpuConfigError):
+        gcp_instance.run_instances('us-central2', 'us-central2-b',
+                                   'vol3b', _vm_volume_config(count=2))
+    assert not fake_gcp.vms  # nothing billed
+
+
+def test_volume_deploy_vars_never_mutate_resources():
+    """The provisioner annotates volume dicts (source paths); Resources
+    must keep clean copies or a later failover .copy() explodes."""
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.utils import registry
+    r = resources_lib.Resources(
+        cloud=registry.CLOUD_REGISTRY.from_str('fake'),
+        accelerators='tpu-v5e-8',
+        volumes=[{'name': 'v1', 'path': '/mnt/v'}])
+    vars = r.cloud.make_deploy_resources_variables(
+        r, 'c', 'fake-central1', None)
+    vars['volumes'][0]['source'] = 'projects/p/zones/z/disks/v1'
+    assert 'source' not in r.volumes[0]
+    r.copy(region='fake-east1')  # must not raise
+
+
+def test_auto_delete_volume_dies_with_cluster(fake_gcp):
+    keep = dict(VOL, name='keepme', auto_delete=False)
+    gcp_instance.run_instances(
+        'us-central2', 'us-central2-b', 'vol5',
+        _vm_volume_config(volumes=[dict(VOL)]))
+    gcp_instance.run_instances(
+        'us-central2', 'us-central2-b', 'vol6',
+        _vm_volume_config(volumes=[keep]))
+    gcp_instance.terminate_instances('vol5', PROVIDER)
+    gcp_instance.terminate_instances('vol6', PROVIDER)
+    assert 'data1' not in fake_gcp.disks      # auto_delete
+    assert 'keepme' in fake_gcp.disks         # survives its cluster
+
+
+def test_tpu_volume_rides_data_disks(fake_gcp):
+    vol = dict(VOL, attach_mode='read_only')
+    fake_gcp.disks['data1'] = {'name': 'data1', 'sizeGb': '50',
+                               'labels': {}}
+    cfg = _tpu_config()
+    cfg.node_config['volumes'] = [vol]
+    gcp_instance.run_instances('us-central2', 'us-central2-b', 'tvol',
+                               cfg)
+    # Disk pre-created, then referenced by full source path in the
+    # node body's dataDisks (READ_ONLY_MANY for shared).
+    assert 'data1' in fake_gcp.disks
+    disks = fake_gcp.last_node_body['dataDisks']
+    assert disks[0]['sourceDisk'].endswith(
+        'projects/p/zones/us-central2-b/disks/data1')
+    assert disks[0]['mode'] == 'READ_ONLY_MANY'
+    # TPU dataDisks surface as google-persistent-disk-N, not the name.
+    info = gcp_instance.get_cluster_info(
+        'us-central2', 'tvol', dict(PROVIDER, volumes=[vol]))
+    assert 'google-persistent-disk-1' in info.mount_commands[0]
+
+
+def test_tpu_read_write_volume_rejects_multihost(fake_gcp):
+    fake_gcp.num_hosts = 2
+    cfg = _tpu_config(num_hosts=2)
+    cfg.node_config['tpu_num_hosts'] = 2
+    cfg.node_config['volumes'] = [dict(VOL)]
+    with pytest.raises(exceptions.InvalidSkyTpuConfigError):
+        gcp_instance.run_instances('us-central2', 'us-central2-b',
+                                   'tvol2', cfg)
+
+
+def test_resources_volumes_grammar():
+    from skypilot_tpu import resources as resources_lib
+    r = resources_lib.Resources(volumes=[{'name': 'v', 'path': '/mnt/v'}])
+    assert r.volumes[0]['size'] == 100
+    assert r.volumes[0]['attach_mode'] == 'read_write'
+    assert r.to_yaml_config()['volumes'][0]['name'] == 'v'
+    with pytest.raises(ValueError):
+        resources_lib.Resources(volumes=[{'name': 'v', 'path': 'rel'}])
+    with pytest.raises(ValueError):
+        resources_lib.Resources(volumes=[{'path': '/mnt/v'}])
+    with pytest.raises(ValueError):
+        resources_lib.Resources(volumes=[{'name': 'v', 'path': '/m',
+                                          'attach_mode': 'rw'}])

@@ -42,6 +42,26 @@ class TestLaunch:
         logs = backend.tail_logs(handle, job_id, follow=False)
         assert 'hello from 0' in logs
 
+    def test_launch_mounts_volumes_before_job(self, fake_cluster_env,
+                                              tmp_path):
+        """resources.volumes → deploy vars → ClusterInfo.mount_commands
+        → executed on every host during runtime setup, BEFORE the job
+        runs (the job itself proves the path is ready)."""
+        mnt = tmp_path / 'mnt' / 'vol'
+        task = Task('vols', run=f'test -e {mnt}/.xsky-vol-v1 && echo '
+                                'vol-visible')
+        task.set_resources(Resources(
+            accelerators='tpu-v5e-8',
+            volumes=[{'name': 'v1', 'path': str(mnt)}]))
+        job_id, handle = execution.launch(task, cluster_name='tvol')
+        from skypilot_tpu.backends import tpu_gang_backend
+        backend = tpu_gang_backend.TpuGangBackend()
+        assert _wait_status(backend, handle, job_id) == \
+            job_lib.JobStatus.SUCCEEDED
+        assert 'vol-visible' in backend.tail_logs(handle, job_id,
+                                                  follow=False)
+        assert (mnt / '.xsky-vol-v1').exists()
+
     def test_launch_streams_logs_live(self, fake_cluster_env, capsys):
         """The launch wait live-tails run.log via the one-call `watch`
         verb: job output must land on stdout BEFORE launch returns, not
