@@ -372,10 +372,51 @@ def jobs():
 @click.option('--yes', '-y', is_flag=True, default=False)
 def jobs_launch(entrypoint, envs, secrets, name, num_nodes, accelerators,
                 cloud, use_spot, yes):
-    """Launch a managed job (controller recovers preemptions)."""
+    """Launch a managed job (controller recovers preemptions).
+
+    A `---`-separated multi-document YAML is a PIPELINE: tasks run as
+    a sequential chain, each on its own cluster; an optional leading
+    `name:`-only document names the pipeline.
+    """
     from skypilot_tpu.client import sdk
-    t = _load_task(entrypoint, envs, secrets, name, num_nodes,
-                   accelerators, cloud, use_spot)
+    if os.path.exists(entrypoint) and entrypoint.endswith(
+            ('.yaml', '.yml')):
+        chain_name, tasks = task_lib.Task.load_chain(
+            entrypoint, env_overrides=_parse_kv(envs, 'env'),
+            secret_overrides=_parse_kv(secrets, 'secret'))
+        if len(tasks) > 1:
+            # Per-task resource flags are ambiguous across a chain.
+            if (num_nodes or accelerators or cloud
+                    or use_spot is not None):
+                raise click.UsageError(
+                    'Resource flags (--num-nodes/--accelerators/'
+                    '--cloud/--use-spot) are not supported for '
+                    'pipelines; set resources per task in the YAML.')
+            job_id = sdk.jobs_launch(tasks, name=name or chain_name)
+            click.echo(f'Managed pipeline {job_id} submitted '
+                       f'({len(tasks)} tasks).')
+            return
+        # A single task (possibly behind a leading name:-only doc —
+        # which plain from_yaml cannot parse): apply the flags here
+        # instead of re-reading the file via _load_task.
+        t = tasks[0]
+        if name or chain_name:
+            t.name = name or chain_name
+        if num_nodes:
+            t.num_nodes = num_nodes
+        overrides = {}
+        if accelerators:
+            overrides['accelerators'] = accelerators
+        if cloud:
+            overrides['cloud'] = cloud
+        if use_spot is not None:
+            overrides['use_spot'] = use_spot
+        if overrides:
+            t.set_resources([r.copy(**overrides) for r in t.resources],
+                            ordered=t.resources_ordered)
+    else:
+        t = _load_task(entrypoint, envs, secrets, name, num_nodes,
+                       accelerators, cloud, use_spot)
     job_id = sdk.jobs_launch(t)
     click.echo(f'Managed job {job_id} submitted.')
 
@@ -384,11 +425,12 @@ def jobs_launch(entrypoint, envs, secrets, name, num_nodes, accelerators,
 def jobs_queue():
     from skypilot_tpu.client import sdk
     rows = sdk.jobs_queue()
-    fmt = '{:<6} {:<16} {:<14} {:<8}'
-    click.echo(fmt.format('ID', 'NAME', 'STATUS', 'RECOVERIES'))
+    fmt = '{:<6} {:<16} {:<7} {:<14} {:<8}'
+    click.echo(fmt.format('ID', 'NAME', 'TASK', 'STATUS', 'RECOVERIES'))
     for r in rows:
         click.echo(fmt.format(r['job_id'], str(r['name'])[:16],
-                              r['status'], r.get('recovery_count', 0)))
+                              r.get('task') or '-', r['status'],
+                              r.get('recovery_count', 0)))
 
 
 @jobs.command(name='cancel')

@@ -146,8 +146,10 @@ class RemoteClient:
     # ---- managed jobs ----
 
     def jobs_launch(self, task, name=None):
-        result = self._call('jobs.launch',
-                            {'task': task.to_yaml_config(), 'name': name})
+        from skypilot_tpu import task as task_lib
+        result = self._call(
+            'jobs.launch',
+            {'task': task_lib.Task.chain_to_config(task), 'name': name})
         return result['job_id']
 
     def jobs_queue(self):

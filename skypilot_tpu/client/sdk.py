@@ -232,7 +232,8 @@ def storage_delete(storage_name: str) -> None:
 # ---- managed jobs ----------------------------------------------------------
 
 
-def jobs_launch(task: task_lib.Task, name: Optional[str] = None) -> int:
+def jobs_launch(task, name: Optional[str] = None) -> int:
+    """task: one Task, or a sequence of Tasks (pipeline chain)."""
     remote = _remote()
     if remote is not None:
         return remote.jobs_launch(task, name=name)

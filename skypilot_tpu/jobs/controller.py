@@ -31,13 +31,23 @@ _MAX_PROBE_FAILURES = 3
 
 
 class JobsController:
+    """Runs one managed job: a single task, or a PIPELINE — a chain of
+    tasks executed sequentially, each on its own freshly launched
+    cluster with its own recovery budget (twin of the reference's
+    chain-DAG controller, sky/jobs/controller.py:68-95). A task's
+    cluster is torn down before the next task launches."""
 
     def __init__(self, job_id: int) -> None:
         self.job_id = job_id
         record = jobs_state.get_job(job_id)
         assert record is not None, job_id
-        self.task = task_lib.Task.from_yaml_config(record['task_config'])
+        self.tasks = [task_lib.Task.from_yaml_config(c)
+                      for c in record['task_configs']]
+        self.start_task = record['current_task']
         self.cluster_name = f'xsky-jobs-{job_id}'
+
+    def _set_task(self, task_index: int) -> None:
+        self.task = self.tasks[task_index]
         self.strategy = recovery_lib.StrategyExecutor.make(
             self.task, self.cluster_name)
 
@@ -70,13 +80,46 @@ class JobsController:
         jobs_state.set_status(self.job_id,
                               jobs_state.ManagedJobStatus.STARTING)
         jobs_state.set_cluster_name(self.job_id, self.cluster_name)
+        for task_index in range(self.start_task, len(self.tasks)):
+            record = jobs_state.get_job(self.job_id)
+            if record is not None and record['status'].is_terminal():
+                return  # cancelled between tasks
+            self._set_task(task_index)
+            jobs_state.set_current_task(self.job_id, task_index)
+            if len(self.tasks) > 1:
+                logger.info(f'Pipeline task {task_index + 1}/'
+                            f'{len(self.tasks)}: '
+                            f'{self.task.name or "<unnamed>"}')
+            # The scheduler granted the FIRST launch slot at submit;
+            # later tasks must requeue behind fresh launches.
+            ok = self._run_task(
+                acquire_slot=(task_index != self.start_task))
+            if ok and task_index == len(self.tasks) - 1:
+                # Mark SUCCEEDED before teardown: cleanup can take
+                # minutes and the workload is already done — a waiter
+                # must not see RUNNING (or cancel a finished job).
+                jobs_state.set_status(
+                    self.job_id, jobs_state.ManagedJobStatus.SUCCEEDED)
+            # Each task's cluster dies before the next one launches
+            # (and on any terminal outcome).
+            self._cleanup()
+            if not ok:
+                return
+
+    def _run_task(self, acquire_slot: bool) -> bool:
+        """Launch + monitor ONE task to a terminal state.
+
+        Returns True if the task succeeded; on failure/cancel the job's
+        terminal status is already set."""
+        if acquire_slot:
+            scheduler.acquire_launch_slot(self.job_id)
         try:
             handle, cluster_job_id = self.strategy.launch()
         except exceptions.ResourcesUnavailableError as e:
             jobs_state.set_status(
                 self.job_id, jobs_state.ManagedJobStatus.FAILED_NO_RESOURCE,
                 failure_reason=str(e))
-            return
+            return False
         finally:
             # Free the launch slot whether or not provisioning worked —
             # the scheduler can start the next queued controller.
@@ -91,13 +134,11 @@ class JobsController:
 
             if status is not None and status.is_terminal():
                 if status == cluster_job_lib.JobStatus.SUCCEEDED:
-                    jobs_state.set_status(
-                        self.job_id, jobs_state.ManagedJobStatus.SUCCEEDED)
-                    break
+                    return True
                 if status == cluster_job_lib.JobStatus.CANCELLED:
                     jobs_state.set_status(
                         self.job_id, jobs_state.ManagedJobStatus.CANCELLED)
-                    break
+                    return False
                 # User-code failure (not preemption): restart budget.
                 if self.strategy.should_restart_on_failure():
                     logger.info(f'Job failed ({status}); restarting '
@@ -105,12 +146,12 @@ class JobsController:
                                 f'/{self.strategy.max_restarts_on_errors})')
                     handle, cluster_job_id = self._recover()
                     if handle is None:
-                        return
+                        return False
                     continue
                 jobs_state.set_status(
                     self.job_id, jobs_state.ManagedJobStatus.FAILED,
                     failure_reason=f'cluster job status {status.value}')
-                break
+                return False
 
             if status is not None:
                 probe_failures = 0
@@ -134,11 +175,9 @@ class JobsController:
             jobs_state.bump_recovery_count(self.job_id)
             handle, cluster_job_id = self._recover()
             if handle is None:
-                return
+                return False
             jobs_state.set_status(
                 self.job_id, jobs_state.ManagedJobStatus.RUNNING)
-
-        self._cleanup()
 
     def _recover(self):
         # Relaunches queue behind fresh launches (preemption storms must

@@ -30,14 +30,21 @@ def _remote_mode() -> bool:
         '', '0')
 
 
-def launch(task: task_lib.Task, name: Optional[str] = None,
+def launch(task, name: Optional[str] = None,
            wait: bool = False, timeout_s: float = 600.0) -> int:
-    """Submit a managed job; returns the managed job id."""
+    """Submit a managed job; returns the managed job id.
+
+    `task` is one Task, or a SEQUENCE of Tasks — a pipeline the
+    controller runs as a sequential chain, each task on its own
+    cluster with its own recovery budget.
+    """
     if _remote_mode():
         from skypilot_tpu.jobs import remote as jobs_remote
         return jobs_remote.launch(task, name=name, wait=wait,
                                   timeout_s=timeout_s)
-    job_id = jobs_state.add_job(name or task.name, task.to_yaml_config())
+    tasks = list(task) if isinstance(task, (list, tuple)) else [task]
+    config = task_lib.Task.chain_to_config(tasks)
+    job_id = jobs_state.add_job(name or tasks[0].name, config)
     jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.SUBMITTED)
     jobs_scheduler.submit_job(job_id)
     if wait:
@@ -72,6 +79,9 @@ def queue() -> List[Dict[str, Any]]:
         'failure_reason': r['failure_reason'],
         'submitted_at': r['submitted_at'],
         'ended_at': r['ended_at'],
+        # Pipelines: which chain link is running (1-based).
+        'task': (f"{min(r['current_task'] + 1, r['num_tasks'])}"
+                 f"/{r['num_tasks']}" if r['num_tasks'] > 1 else None),
     } for r in rows]
 
 

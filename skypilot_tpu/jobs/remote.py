@@ -40,12 +40,13 @@ cluster_name = _relay.cluster_name
 ensure_controller_cluster = _relay.ensure_controller_cluster
 
 
-def launch(task: task_lib.Task, name: Optional[str] = None,
+def launch(task, name: Optional[str] = None,
            wait: bool = False, timeout_s: float = 600.0) -> int:
+    config = task_lib.Task.chain_to_config(task)
     with tempfile.NamedTemporaryFile(
             'w', suffix='.yaml', prefix='xsky-mjob-',
             delete=False) as f:
-        f.write(json.dumps(task.to_yaml_config()))
+        f.write(json.dumps(config))
         local_path = f.name
     try:
         reply = _relay.call('submit',

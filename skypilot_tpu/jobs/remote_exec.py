@@ -33,7 +33,10 @@ def main(argv) -> int:
             name, args = args[1], args[2:]
         with open(args[0], encoding='utf-8') as f:
             config = json.load(f)
-        task = task_lib.Task.from_yaml_config(config)
+        if isinstance(config, list):   # pipeline: chain of tasks
+            task = [task_lib.Task.from_yaml_config(c) for c in config]
+        else:
+            task = task_lib.Task.from_yaml_config(config)
         job_id = jobs_core.launch(task, name=name)
         _print({'job_id': job_id})
     elif verb == 'get':

@@ -73,37 +73,70 @@ def _db() -> sqlite3.Connection:
             ended_at REAL,
             schedule_state TEXT DEFAULT 'INACTIVE'
         )""")
-    try:
-        conn.execute("ALTER TABLE managed_jobs ADD COLUMN "
-                     "schedule_state TEXT DEFAULT 'INACTIVE'")
-    except Exception:  # pylint: disable=broad-except
-        pass  # column exists (sqlite OperationalError / pg DuplicateColumn)
+    for migration in (
+            "ALTER TABLE managed_jobs ADD COLUMN "
+            "schedule_state TEXT DEFAULT 'INACTIVE'",
+            # Pipelines: a managed job may be a CHAIN of tasks
+            # (multi-doc YAML), each on its own cluster in sequence.
+            "ALTER TABLE managed_jobs ADD COLUMN "
+            "current_task INTEGER DEFAULT 0",
+            "ALTER TABLE managed_jobs ADD COLUMN "
+            "num_tasks INTEGER DEFAULT 1",
+    ):
+        try:
+            conn.execute(migration)
+            conn.commit()
+        except Exception:  # pylint: disable=broad-except
+            # Column exists (sqlite OperationalError / pg
+            # DuplicateColumn). Roll back so the failed statement does
+            # NOT abort the transaction — on postgres a poisoned
+            # transaction would swallow every later ALTER in this loop.
+            try:
+                conn.rollback()
+            except Exception:  # pylint: disable=broad-except
+                pass
     conn.commit()
     return conn
 
 
-def add_job(name: Optional[str], task_config: Dict[str, Any]) -> int:
+def add_job(name: Optional[str], task_config: Any) -> int:
+    """task_config: one task's config dict, or a LIST of config dicts
+    for a pipeline (chain of tasks run sequentially, each on its own
+    cluster — twin of the reference's chain-DAG managed jobs,
+    sky/jobs/controller.py:68)."""
     from skypilot_tpu.utils import db_utils
+    num_tasks = (len(task_config)
+                 if isinstance(task_config, list) else 1)
     with _lock:
         conn = _db()
         if db_utils.is_postgres():
             # psycopg2 cursors have no meaningful lastrowid.
             cur = conn.execute(
                 'INSERT INTO managed_jobs (name, task_config, status, '
-                'submitted_at) VALUES (?, ?, ?, ?) RETURNING job_id',
+                'submitted_at, num_tasks) VALUES (?, ?, ?, ?, ?) '
+                'RETURNING job_id',
                 (name, json.dumps(task_config),
-                 ManagedJobStatus.PENDING.value, time.time()))
+                 ManagedJobStatus.PENDING.value, time.time(), num_tasks))
             job_id = cur.fetchone()[0]
         else:
             cur = conn.execute(
                 'INSERT INTO managed_jobs (name, task_config, status, '
-                'submitted_at) VALUES (?, ?, ?, ?)',
+                'submitted_at, num_tasks) VALUES (?, ?, ?, ?, ?)',
                 (name, json.dumps(task_config),
-                 ManagedJobStatus.PENDING.value, time.time()))
+                 ManagedJobStatus.PENDING.value, time.time(), num_tasks))
             job_id = cur.lastrowid
         conn.commit()
         conn.close()
         return job_id
+
+
+def set_current_task(job_id: int, task_index: int) -> None:
+    with _lock:
+        conn = _db()
+        conn.execute('UPDATE managed_jobs SET current_task=? '
+                     'WHERE job_id=?', (task_index, job_id))
+        conn.commit()
+        conn.close()
 
 
 def set_status(job_id: int, status: ManagedJobStatus,
@@ -223,12 +256,18 @@ def get_jobs() -> List[Dict[str, Any]]:
 def _to_dict(row) -> Dict[str, Any]:
     (job_id, name, task_config, status, cluster_name, recovery_count,
      failure_reason, controller_pid, submitted_at, started_at,
-     ended_at, schedule_state) = row
+     ended_at, schedule_state, current_task, num_tasks) = row
+    parsed = json.loads(task_config or '{}')
+    # Pipelines store a LIST of task configs; single jobs a dict.
+    configs = parsed if isinstance(parsed, list) else [parsed]
     return {
         'schedule_state': ScheduleState(schedule_state or 'INACTIVE'),
         'job_id': job_id,
         'name': name,
-        'task_config': json.loads(task_config or '{}'),
+        'task_config': configs[0],
+        'task_configs': configs,
+        'current_task': current_task or 0,
+        'num_tasks': num_tasks or len(configs),
         'status': ManagedJobStatus(status),
         'cluster_name': cluster_name,
         'recovery_count': recovery_count,

@@ -112,8 +112,18 @@ _VERBS: Dict[str, Callable[[Dict[str, Any]],
 
 
 def _jobs_launch(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
+    from skypilot_tpu import task as task_lib
     from skypilot_tpu.jobs import core as jobs_core
-    task = _task_from_body(body)
+    config = body.get('task')
+    if isinstance(config, list):     # pipeline: chain of task configs
+        if not config:
+            raise BadRequest("'task' pipeline list must be non-empty")
+        try:
+            task = [task_lib.Task.from_yaml_config(c) for c in config]
+        except (ValueError, KeyError) as e:
+            raise BadRequest(f'invalid pipeline task: {e}') from e
+    else:
+        task = _task_from_body(body)
 
     def run(**kwargs):
         return {'job_id': jobs_core.launch(task, **kwargs)}

@@ -9,7 +9,7 @@ from __future__ import annotations
 import os
 import re
 import typing
-from typing import Any, Callable, Dict, List, Optional, Union
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import yaml
 
@@ -235,6 +235,39 @@ class Task:
             raise ValueError(
                 f'{path} is not a task YAML (parsed as a string).')
         return cls.from_yaml_config(config, **kwargs)
+
+    @staticmethod
+    def chain_to_config(task) -> Any:
+        """Wire/DB form of one Task or a pipeline sequence: a single
+        config dict, or a list of them. The ONE place that decides the
+        single-vs-chain encoding (local submit, controller relay, and
+        API client all call this)."""
+        tasks = (list(task) if isinstance(task, (list, tuple))
+                 else [task])
+        if not tasks:
+            raise ValueError('empty task chain')
+        if len(tasks) > 1:
+            return [t.to_yaml_config() for t in tasks]
+        return tasks[0].to_yaml_config()
+
+    @classmethod
+    def load_chain(cls, path: str, **kwargs
+                   ) -> Tuple[Optional[str], List['Task']]:
+        """Load a pipeline YAML: `---`-separated task documents run as
+        a sequential chain (twin of the reference's chain-DAG yaml,
+        sky/utils/dag_utils.py load_chain_dag_from_yaml). An optional
+        leading document containing only `name:` names the pipeline.
+        A single-document file yields (None, [task]).
+        """
+        with open(os.path.expanduser(path), 'r', encoding='utf-8') as f:
+            docs = [d for d in yaml.safe_load_all(f) if d]
+        name = None
+        if docs and set(docs[0]) <= {'name'}:
+            name = docs[0].get('name')
+            docs = docs[1:]
+        if not docs:
+            raise ValueError(f'{path} contains no task documents.')
+        return name, [cls.from_yaml_config(d, **kwargs) for d in docs]
 
     def to_yaml_config(self) -> Dict[str, Any]:
         config: Dict[str, Any] = {}

@@ -110,6 +110,71 @@ class TestManagedJobs:
         assert rows[0]['status'] == 'SUCCEEDED'
 
 
+class TestPipelines:
+    """Chain-of-tasks managed jobs (twin of the reference's chain-DAG
+    pipelines, sky/jobs/controller.py:68-95)."""
+
+    def test_pipeline_runs_tasks_sequentially(self, jobs_env, tmp_path):
+        marker = tmp_path / 'order.txt'
+        tasks = [
+            _tpu_task(f'echo one >> {marker}'),
+            _tpu_task(f'echo two >> {marker}'),
+        ]
+        tasks[0].name, tasks[1].name = 'prep', 'train'
+        job_id = jobs_core.launch(tasks, name='pipe')
+        record = _wait_for(
+            job_id, [jobs_state.ManagedJobStatus.SUCCEEDED], timeout=90)
+        assert record['num_tasks'] == 2
+        assert marker.read_text().split() == ['one', 'two']
+        # Each task's cluster is torn down.
+        assert not jobs_env.cluster_exists(record['cluster_name'])
+        # Queue surfaces chain progress.
+        row = [r for r in jobs_core.queue() if r['job_id'] == job_id][0]
+        assert row['task'] == '2/2'
+
+    def test_pipeline_failure_stops_chain(self, jobs_env, tmp_path):
+        marker = tmp_path / 'never.txt'
+        tasks = [
+            _tpu_task('exit 3'),
+            _tpu_task(f'touch {marker}'),
+        ]
+        job_id = jobs_core.launch(tasks)
+        record = _wait_for(
+            job_id, [jobs_state.ManagedJobStatus.FAILED], timeout=90)
+        assert record['current_task'] == 0     # died on the first link
+        assert not marker.exists()             # second task never ran
+        assert not jobs_env.cluster_exists(record['cluster_name'])
+
+    def test_single_task_yaml_unchanged(self, jobs_env):
+        """A one-task job keeps task=None in queue (no pipeline UI)."""
+        job_id = jobs_core.launch(_tpu_task('echo solo'))
+        _wait_for(job_id, [jobs_state.ManagedJobStatus.SUCCEEDED])
+        row = [r for r in jobs_core.queue() if r['job_id'] == job_id][0]
+        assert row['task'] is None
+
+
+class TestChainYaml:
+
+    def test_load_chain_multi_doc(self, tmp_path):
+        path = tmp_path / 'pipe.yaml'
+        path.write_text(
+            'name: my-pipe\n'
+            '---\n'
+            'name: a\nrun: echo a\n'
+            '---\n'
+            'name: b\nrun: echo b\n')
+        name, tasks = Task.load_chain(str(path))
+        assert name == 'my-pipe'
+        assert [t.name for t in tasks] == ['a', 'b']
+
+    def test_load_chain_single_doc(self, tmp_path):
+        path = tmp_path / 'one.yaml'
+        path.write_text('name: solo\nrun: echo x\n')
+        name, tasks = Task.load_chain(str(path))
+        assert name is None
+        assert len(tasks) == 1 and tasks[0].name == 'solo'
+
+
 class TestJobsScheduler:
     """Bounded controller parallelism (twin of sky/jobs/scheduler.py
     caps, :295-315)."""
