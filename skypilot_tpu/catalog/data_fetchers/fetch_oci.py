@@ -1,0 +1,83 @@
+"""Generate the OCI catalog CSV (twin of
+sky/catalog/data_fetchers/fetch_oci.py in role).
+
+OCI publishes shape specs + list prices on static pages; there is no
+anonymous price API, so the checked-in CSV comes from a curated
+snapshot of the GPU/CPU shapes the provisioner supports. Zones are the
+availability-domain short names (AD-1..); the provisioner resolves them
+against the tenancy's full AD names at launch. Preemptible (spot)
+price is OCI's flat 50% of on-demand.
+
+Run: python -m skypilot_tpu.catalog.data_fetchers.fetch_oci
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Tuple
+
+# (shape, acc_name, acc_count, vcpus, mem_gib, acc_mem_gib, price)
+_SKUS: List[Tuple[str, str, float, float, float, float, float]] = [
+    ('VM.GPU2.1', 'P100', 1, 24, 72, 16, 1.275),
+    ('VM.GPU3.1', 'V100', 1, 12, 90, 16, 2.95),
+    ('VM.GPU3.2', 'V100', 2, 24, 180, 32, 5.90),
+    ('VM.GPU3.4', 'V100', 4, 48, 360, 64, 11.80),
+    ('BM.GPU3.8', 'V100', 8, 104, 768, 128, 23.60),
+    ('VM.GPU.A10.1', 'A10', 1, 15, 240, 24, 2.00),
+    ('VM.GPU.A10.2', 'A10', 2, 30, 480, 48, 4.00),
+    ('BM.GPU.A10.4', 'A10', 4, 64, 1024, 96, 8.00),
+    ('BM.GPU4.8', 'A100', 8, 64, 2048, 320, 24.40),
+    ('BM.GPU.A100-v2.8', 'A100-80GB', 8, 128, 2048, 640, 32.00),
+    ('BM.GPU.H100.8', 'H100', 8, 112, 2048, 640, 80.00),
+    ('BM.GPU.L40S.4', 'L40S', 4, 112, 1024, 192, 14.00),
+    # CPU flex shapes (per-OCPU pricing folded into the row price).
+    ('VM.Standard.E4.Flex', '', 0, 8, 32, 0, 0.122),
+    ('VM.Standard.E5.Flex', '', 0, 8, 32, 0, 0.168),
+    ('VM.Standard3.Flex', '', 0, 8, 32, 0, 0.136),
+]
+
+# Region -> number of availability domains (most regions have 1 AD;
+# the three-AD regions are the big home regions).
+_REGIONS = {
+    'us-ashburn-1': 3,
+    'us-phoenix-1': 3,
+    'us-sanjose-1': 1,
+    'eu-frankfurt-1': 3,
+    'uk-london-1': 3,
+    'ap-tokyo-1': 1,
+    'ap-singapore-1': 1,
+    'ap-mumbai-1': 1,
+    'sa-saopaulo-1': 1,
+}
+
+HEADER = ['InstanceType', 'AcceleratorName', 'AcceleratorCount', 'vCPUs',
+          'MemoryGiB', 'AcceleratorMemoryGiB', 'Price', 'SpotPrice',
+          'Region', 'AvailabilityZone']
+
+
+def rows_static() -> List[List[str]]:
+    out = []
+    for itype, acc, count, vcpus, mem, acc_mem, price in _SKUS:
+        # Preemptible capacity exists for VM shapes only (BM excluded).
+        spot = price * 0.5 if itype.startswith('VM.') else 0
+        for region, n_ads in _REGIONS.items():
+            for ad in range(1, n_ads + 1):
+                out.append([itype, acc, f'{count:g}', f'{vcpus:g}',
+                            f'{mem:g}', f'{acc_mem:g}', f'{price:.4f}',
+                            f'{spot:.4f}', region, f'AD-{ad}'])
+    return out
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, 'data', 'oci', 'catalog.csv')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.writer(f)
+        writer.writerow(HEADER)
+        writer.writerows(rows_static())
+    print(f'Wrote {path} (static snapshot)')
+
+
+if __name__ == '__main__':
+    main()

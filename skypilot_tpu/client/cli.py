@@ -34,17 +34,11 @@ def _parse_kv(items: Tuple[str, ...], what: str) -> dict:
     return out
 
 
-def _load_task(entrypoint: str, envs, secrets, name, num_nodes,
-               accelerators=None, cloud=None, use_spot=None) -> task_lib.Task:
-    if os.path.exists(entrypoint) and entrypoint.endswith(
-            ('.yaml', '.yml')):
-        t = task_lib.Task.from_yaml(entrypoint,
-                                    env_overrides=_parse_kv(envs, 'env'),
-                                    secret_overrides=_parse_kv(
-                                        secrets, 'secret'))
-    else:
-        t = task_lib.Task(run=entrypoint, envs=_parse_kv(envs, 'env'),
-                          secrets=_parse_kv(secrets, 'secret'))
+def _apply_task_flags(t: task_lib.Task, name, num_nodes,
+                      accelerators=None, cloud=None,
+                      use_spot=None) -> task_lib.Task:
+    """Apply shared CLI task-override flags to an already-built task
+    (one place, so `launch` / `exec` / `jobs launch` never diverge)."""
     if name:
         t.name = name
     if num_nodes:
@@ -60,6 +54,21 @@ def _load_task(entrypoint: str, envs, secrets, name, num_nodes,
         t.set_resources([r.copy(**overrides) for r in t.resources],
                         ordered=t.resources_ordered)
     return t
+
+
+def _load_task(entrypoint: str, envs, secrets, name, num_nodes,
+               accelerators=None, cloud=None, use_spot=None) -> task_lib.Task:
+    if os.path.exists(entrypoint) and entrypoint.endswith(
+            ('.yaml', '.yml')):
+        t = task_lib.Task.from_yaml(entrypoint,
+                                    env_overrides=_parse_kv(envs, 'env'),
+                                    secret_overrides=_parse_kv(
+                                        secrets, 'secret'))
+    else:
+        t = task_lib.Task(run=entrypoint, envs=_parse_kv(envs, 'env'),
+                          secrets=_parse_kv(secrets, 'secret'))
+    return _apply_task_flags(t, name, num_nodes, accelerators, cloud,
+                             use_spot)
 
 
 @click.group()
@@ -399,21 +408,8 @@ def jobs_launch(entrypoint, envs, secrets, name, num_nodes, accelerators,
         # A single task (possibly behind a leading name:-only doc —
         # which plain from_yaml cannot parse): apply the flags here
         # instead of re-reading the file via _load_task.
-        t = tasks[0]
-        if name or chain_name:
-            t.name = name or chain_name
-        if num_nodes:
-            t.num_nodes = num_nodes
-        overrides = {}
-        if accelerators:
-            overrides['accelerators'] = accelerators
-        if cloud:
-            overrides['cloud'] = cloud
-        if use_spot is not None:
-            overrides['use_spot'] = use_spot
-        if overrides:
-            t.set_resources([r.copy(**overrides) for r in t.resources],
-                            ordered=t.resources_ordered)
+        t = _apply_task_flags(tasks[0], name or chain_name, num_nodes,
+                              accelerators, cloud, use_spot)
     else:
         t = _load_task(entrypoint, envs, secrets, name, num_nodes,
                        accelerators, cloud, use_spot)

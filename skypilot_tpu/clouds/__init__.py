@@ -8,9 +8,11 @@ from skypilot_tpu.clouds.fake import Fake
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.lambda_cloud import Lambda
+from skypilot_tpu.clouds.oci import OCI
 from skypilot_tpu.clouds.runpod import RunPod
 from skypilot_tpu.clouds.ssh import SSH
 from skypilot_tpu.clouds.vast import Vast
 
 __all__ = ['Cloud', 'CloudImplementationFeatures', 'Region', 'GCP', 'Fake',
-           'AWS', 'Azure', 'Kubernetes', 'Lambda', 'RunPod', 'SSH', 'Vast']
+           'AWS', 'Azure', 'Kubernetes', 'Lambda', 'OCI', 'RunPod', 'SSH',
+           'Vast']

@@ -1,0 +1,105 @@
+"""Oracle Cloud Infrastructure: GPU/CPU shapes for cross-cloud
+optimization.
+
+Lean twin of sky/clouds/oci.py — catalog-backed feasibility via
+CatalogCloud, deploy variables for the 'oci' provisioner
+(provision/oci/instance.py), ~/.oci/config credential probing.
+Platform facts: placement is per availability domain (AD-1..AD-3 zones
+in the catalog), spot = preemptible instances (terminate-on-preempt,
+cannot stop), stop/start supported for on-demand, flex shapes
+(.Flex suffix) carry an ocpus/memory shapeConfig, ports via a
+per-cluster NSG.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu import authentication
+from skypilot_tpu.clouds import catalog_cloud
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@registry.CLOUD_REGISTRY.register()
+class OCI(catalog_cloud.CatalogCloud):
+    _REPR = 'OCI'
+
+    @property
+    def provisioner_module(self) -> str:
+        return 'oci'
+
+    def unsupported_features_for_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        out: Dict[cloud_lib.CloudImplementationFeatures, str] = {}
+        if resources.use_spot:
+            out[cloud_lib.CloudImplementationFeatures.STOP] = (
+                'OCI preemptible instances cannot stop; terminate '
+                'instead.')
+        return out
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        itype = resources.instance_type
+        vars: Dict[str, Any] = {
+            'cluster_name': cluster_name,
+            'region': region,
+            'zone': zone,
+            'instance_type': itype,
+            'image_id': resources.image_id,
+            'disk_size': resources.disk_size,
+            'use_spot': resources.use_spot,
+            'ssh_public_key': authentication.public_key_content(),
+        }
+        if itype and '.Flex' in itype:
+            # Flex shapes need explicit ocpus/memory; derive from the
+            # catalog row so cost and capacity agree with the optimizer.
+            for e in self._match_entries(itype, None, region, zone):
+                vars['shape_config'] = {
+                    # OCI bills flex CPU in OCPUs (2 vCPU threads each).
+                    'ocpus': max(int(e.vcpus // 2), 1),
+                    'memoryInGBs': int(e.memory_gib),
+                }
+                break
+        if resources.accelerators:
+            name, count = next(iter(resources.accelerators.items()))
+            vars.update({'gpu_type': name, 'gpu_count': count})
+        return vars
+
+    def provider_config_overrides(
+            self, node_config: Dict[str, Any]) -> Dict[str, Any]:
+        del node_config
+        return {}
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.oci import rest
+        if rest.load_profile() is not None:
+            return True, None
+        return False, (
+            'OCI config not found. Populate ~/.oci/config with user, '
+            'tenancy, fingerprint, key_file and region (see `oci setup '
+            'config`).')
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        from skypilot_tpu.provision.oci import rest
+        mounts: Dict[str, str] = {}
+        if os.path.exists(os.path.expanduser(rest.CONFIG_PATH)):
+            mounts[rest.CONFIG_PATH] = rest.CONFIG_PATH
+            profile = rest.load_profile()
+            if profile and profile.get('key_file'):
+                key = profile['key_file']
+                if os.path.exists(os.path.expanduser(key)):
+                    mounts[key] = key
+        return mounts
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        # First 10 TB/month free, then ~$0.0085/GB.
+        if num_gigabytes <= 10240:
+            return 0.0
+        return (num_gigabytes - 10240) * 0.0085

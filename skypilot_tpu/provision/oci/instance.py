@@ -1,0 +1,425 @@
+"""OCI provisioner op-set (compute instances in a compartment).
+
+Behavioral twin of sky/provision/oci/instance.py with this repo's
+conventions: cluster membership rides freeform tags
+(``xsky-cluster`` / ``xsky-node``) which the ListInstances API returns
+server-side, so reconciliation reconstructs a cluster from a cold start
+with no local files.
+
+Platform facts encoded here:
+  * placement is per availability domain (the catalog's zone column);
+    AD short names (``AD-1``) resolve against the tenancy's
+    ListAvailabilityDomains, whose full names carry a tenancy prefix;
+  * spot = ``preemptibleInstanceConfig`` at launch (terminate on
+    preempt), which cannot stop/start;
+  * stockout is a documented 'Out of host capacity' InternalError —
+    rest.classify_error turns it into CapacityError for the failover
+    engine;
+  * public/private IPs hang off the VNIC, one hop away
+    (vnicAttachments -> vnic), not off the instance record;
+  * port opening rides a per-cluster Network Security Group in the
+    subnet's VCN, attached to each VNIC at launch.
+"""
+from __future__ import annotations
+
+import base64
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.oci import rest
+
+logger = sky_logging.init_logger(__name__)
+
+_transport_factory = rest.Transport
+
+
+def set_transport_factory(factory) -> None:
+    global _transport_factory
+    _transport_factory = factory
+
+
+def _transport(provider_config: Dict[str, Any]) -> Any:
+    return _transport_factory(
+        region=(provider_config or {}).get('region'),
+        profile=(provider_config or {}).get('profile', 'DEFAULT'))
+
+
+_STATE_MAP = {
+    'PROVISIONING': 'PENDING',
+    'STARTING': 'PENDING',
+    'CREATING_IMAGE': 'PENDING',
+    'MOVING': 'PENDING',
+    'RUNNING': 'RUNNING',
+    'STOPPING': 'STOPPING',
+    'STOPPED': 'STOPPED',
+    'TERMINATING': None,
+    'TERMINATED': None,
+}
+
+CLUSTER_TAG = 'xsky-cluster'
+NODE_TAG = 'xsky-node'
+
+
+def _compartment(t, provider_config: Dict[str, Any]) -> str:
+    return (provider_config or {}).get('compartment_id') or t.tenancy
+
+
+def _cluster_instances(t, compartment: str, cluster_name: str,
+                       include_terminated: bool = False
+                       ) -> List[Dict[str, Any]]:
+    out = []
+    for inst in t.call('GET', '/instances',
+                       query={'compartmentId': compartment}) or []:
+        tags = inst.get('freeformTags') or {}
+        if tags.get(CLUSTER_TAG) != cluster_name:
+            continue
+        if not include_terminated and inst.get('lifecycleState') in \
+                ('TERMINATING', 'TERMINATED'):
+            continue
+        out.append(inst)
+    return sorted(out, key=lambda i: int(
+        (i.get('freeformTags') or {}).get(NODE_TAG, '0')))
+
+
+def _resolve_ad(t, compartment: str, zone: Optional[str]) -> str:
+    """'AD-1' (catalog) -> full tenancy-prefixed AD name."""
+    ads = t.call('GET', '/availabilityDomains/',
+                 query={'compartmentId': compartment},
+                 service='identity') or []
+    names = [ad['name'] for ad in ads]
+    if not names:
+        raise exceptions.ProvisionError('OCI returned no ADs.')
+    if zone is None:
+        return names[0]
+    for name in names:
+        if name == zone or name.endswith(zone):
+            return name
+    raise exceptions.InvalidRequestError(
+        f'OCI AD {zone!r} not in tenancy ADs {names}.')
+
+
+def _resolve_subnet(t, compartment: str,
+                    provider_config: Dict[str, Any]) -> Dict[str, Any]:
+    subnet_id = (provider_config or {}).get('subnet_id')
+    subnets = t.call('GET', '/subnets',
+                     query={'compartmentId': compartment}) or []
+    if subnet_id:
+        for s in subnets:
+            if s['id'] == subnet_id:
+                return s
+        # Configured subnet lives outside the listed compartment; fetch
+        # it directly so vcnId (NSG attachment) is still known.
+        return t.call('GET', f'/subnets/{subnet_id}')
+    if not subnets:
+        raise exceptions.ProvisionError(
+            'No OCI subnet found; create a VCN+subnet or set '
+            'provider config subnet_id.')
+    return subnets[0]
+
+
+def _resolve_image(t, compartment: str, node_config: Dict[str, Any]) -> str:
+    image = node_config.get('image_id')
+    if image:
+        return image
+    images = t.call('GET', '/images', query={
+        'compartmentId': compartment,
+        'operatingSystem': 'Canonical Ubuntu',
+        'sortBy': 'TIMECREATED', 'sortOrder': 'DESC'}) or []
+    if not images:
+        raise exceptions.ProvisionError('No Ubuntu image found in OCI.')
+    return images[0]['id']
+
+
+def _nsg_name(cluster_name: str) -> str:
+    return f'xsky-nsg-{cluster_name}'
+
+
+def _find_nsg(t, compartment: str, vcn_id: str,
+              cluster_name: str) -> Optional[str]:
+    for nsg in t.call('GET', '/networkSecurityGroups',
+                      query={'compartmentId': compartment,
+                             'vcnId': vcn_id}) or []:
+        if nsg.get('displayName') == _nsg_name(cluster_name):
+            return nsg['id']
+    return None
+
+
+def _ensure_nsg(t, compartment: str, vcn_id: str, cluster_name: str) -> str:
+    nsg_id = _find_nsg(t, compartment, vcn_id, cluster_name)
+    if nsg_id:
+        return nsg_id
+    nsg = t.call('POST', '/networkSecurityGroups', body={
+        'compartmentId': compartment, 'vcnId': vcn_id,
+        'displayName': _nsg_name(cluster_name)})
+    # Baseline rules: ssh in, everything out, intra-NSG free.
+    t.call('POST',
+           f'/networkSecurityGroups/{nsg["id"]}/actions/addSecurityRules',
+           body={'securityRules': [
+               {'direction': 'INGRESS', 'protocol': '6',
+                'source': '0.0.0.0/0', 'sourceType': 'CIDR_BLOCK',
+                'tcpOptions': {'destinationPortRange':
+                               {'min': 22, 'max': 22}}},
+               {'direction': 'INGRESS', 'protocol': 'all',
+                'source': nsg['id'],
+                'sourceType': 'NETWORK_SECURITY_GROUP'},
+               {'direction': 'EGRESS', 'protocol': 'all',
+                'destination': '0.0.0.0/0',
+                'destinationType': 'CIDR_BLOCK'},
+           ]})
+    return nsg['id']
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    t = _transport(dict(config.provider_config or {}, region=region))
+    node_cfg = config.node_config
+    compartment = _compartment(t, config.provider_config)
+    try:
+        existing = _cluster_instances(t, compartment, cluster_name)
+        taken = {int((i.get('freeformTags') or {}).get(NODE_TAG, '-1'))
+                 for i in existing}
+        # Restart any stopped members first (idempotent relaunch).
+        for inst in existing:
+            if inst.get('lifecycleState') == 'STOPPED':
+                t.call('POST', f'/instances/{inst["id"]}',
+                       query={'action': 'START'})
+        missing = sorted(set(range(config.count)) - taken)
+        created: List[str] = []
+        if missing:
+            ad = _resolve_ad(t, compartment, zone)
+            subnet = _resolve_subnet(t, compartment, config.provider_config)
+            image_id = _resolve_image(t, compartment, node_cfg)
+            nsg_ids = []
+            if subnet.get('vcnId'):
+                nsg_ids = [_ensure_nsg(t, compartment, subnet['vcnId'],
+                                       cluster_name)]
+            metadata = {}
+            public_key = node_cfg.get('ssh_public_key')
+            if public_key:
+                metadata['ssh_authorized_keys'] = public_key
+            user_data = node_cfg.get('user_data')
+            if user_data:
+                metadata['user_data'] = base64.b64encode(
+                    user_data.encode()).decode()
+            for node in missing:
+                body: Dict[str, Any] = {
+                    'compartmentId': compartment,
+                    'availabilityDomain': ad,
+                    'displayName': f'{cluster_name}-{node}',
+                    'shape': node_cfg['instance_type'],
+                    'sourceDetails': {'sourceType': 'image',
+                                      'imageId': image_id,
+                                      'bootVolumeSizeInGBs':
+                                          node_cfg.get('disk_size', 100)},
+                    'createVnicDetails': {'subnetId': subnet['id'],
+                                          'assignPublicIp': True,
+                                          'nsgIds': nsg_ids},
+                    'metadata': metadata,
+                    'freeformTags': {CLUSTER_TAG: cluster_name,
+                                     NODE_TAG: str(node)},
+                }
+                shape_cfg = node_cfg.get('shape_config')
+                if shape_cfg:  # flex shapes carry ocpus/memory
+                    body['shapeConfig'] = shape_cfg
+                if node_cfg.get('use_spot'):
+                    body['preemptibleInstanceConfig'] = {
+                        'preemptionAction': {'type': 'TERMINATE',
+                                             'preserveBootVolume': False}}
+                inst = t.call('POST', '/instances', body=body)
+                created.append(inst['id'])
+        head = None
+        for inst in existing:
+            if (inst.get('freeformTags') or {}).get(NODE_TAG) == '0':
+                head = inst['id']
+        if head is None and 0 in missing:
+            head = created[missing.index(0)]
+    except rest.OciApiError as e:
+        raise rest.classify_error(e, region) from e
+    return common.ProvisionRecord(
+        provider_name='oci', cluster_name=cluster_name, region=region,
+        zone=zone, resumed_instance_ids=[], created_instance_ids=created,
+        head_instance_id=head)
+
+
+def wait_instances(region: str, cluster_name: str, state: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   timeout_s: float = 900.0,
+                   poll_interval_s: float = 5.0) -> None:
+    t = _transport(dict(provider_config or {}, region=region))
+    compartment = _compartment(t, provider_config or {})
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        instances = _cluster_instances(t, compartment, cluster_name,
+                                       include_terminated=True)
+        states = [_STATE_MAP.get(i.get('lifecycleState', ''), 'PENDING')
+                  for i in instances]
+        if any(s is None for s in states):
+            raise exceptions.CapacityError(
+                f'Instance(s) of {cluster_name!r} terminated while '
+                f'waiting for {state}.')
+        if instances and all(s == state for s in states):
+            return
+        time.sleep(poll_interval_s)
+    raise exceptions.ProvisionError(
+        f'OCI cluster {cluster_name!r} did not reach {state} within '
+        f'{timeout_s}s.')
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    t = _transport(provider_config)
+    compartment = _compartment(t, provider_config)
+    try:
+        for inst in _cluster_instances(t, compartment, cluster_name):
+            if inst.get('preemptibleInstanceConfig'):
+                raise exceptions.NotSupportedError(
+                    'OCI preemptible instances cannot stop; terminate '
+                    'instead (`xsky down`).')
+            if inst.get('lifecycleState') == 'RUNNING':
+                t.call('POST', f'/instances/{inst["id"]}',
+                       query={'action': 'STOP'})
+    except rest.OciApiError as e:
+        raise rest.classify_error(e) from e
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    t = _transport(provider_config)
+    compartment = _compartment(t, provider_config)
+    try:
+        instances = _cluster_instances(t, compartment, cluster_name)
+        for inst in instances:
+            t.call('DELETE', f'/instances/{inst["id"]}',
+                   query={'preserveBootVolume': 'false'})
+        # The cluster NSG is only removable once no VNIC references it;
+        # best-effort here, reconciliation retries on the next down.
+        # Instance records carry no vcnId (the VCN hangs off the VNIC);
+        # resolve it the same way launch did — explicit config, else
+        # the compartment's subnets.
+        vcn_ids = {v for v in
+                   ((provider_config or {}).get('vcn_id'),) if v}
+        if not vcn_ids:
+            for s in t.call('GET', '/subnets',
+                            query={'compartmentId': compartment}) or []:
+                if s.get('vcnId'):
+                    vcn_ids.add(s['vcnId'])
+        for vcn_id in vcn_ids:
+            nsg_id = _find_nsg(t, compartment, vcn_id, cluster_name)
+            if nsg_id:
+                try:
+                    t.call('DELETE', f'/networkSecurityGroups/{nsg_id}')
+                except rest.OciApiError as e:
+                    logger.debug(f'NSG cleanup deferred: {e}')
+    except rest.OciApiError as e:
+        raise rest.classify_error(e) from e
+
+
+def query_instances(cluster_name: str, provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    t = _transport(provider_config)
+    compartment = _compartment(t, provider_config)
+    out: Dict[str, Optional[str]] = {}
+    for inst in t.call('GET', '/instances',
+                       query={'compartmentId': compartment}) or []:
+        tags = inst.get('freeformTags') or {}
+        if tags.get(CLUSTER_TAG) != cluster_name:
+            continue
+        # None (terminated) entries stay in the map: status
+        # reconciliation needs them to notice preempted/killed nodes.
+        out[inst['id']] = _STATE_MAP.get(inst.get('lifecycleState', ''),
+                                         'PENDING')
+    return out
+
+
+def _instance_ips(t, compartment: str, instance_id: str):
+    """(private_ip, public_ip) via the instance's primary VNIC."""
+    attachments = t.call('GET', '/vnicAttachments',
+                         query={'compartmentId': compartment,
+                                'instanceId': instance_id}) or []
+    for att in attachments:
+        if att.get('lifecycleState') not in (None, 'ATTACHED'):
+            continue
+        vnic = t.call('GET', f'/vnics/{att["vnicId"]}')
+        return vnic.get('privateIp', ''), vnic.get('publicIp')
+    return '', None
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Dict[str, Any]
+                     ) -> common.ClusterInfo:
+    t = _transport(dict(provider_config or {}, region=region))
+    compartment = _compartment(t, provider_config)
+    instances: Dict[str, common.InstanceInfo] = {}
+    head_id = None
+    for inst in _cluster_instances(t, compartment, cluster_name):
+        index = int((inst.get('freeformTags') or {}).get(NODE_TAG, '0'))
+        private_ip, public_ip = _instance_ips(t, compartment, inst['id'])
+        state = _STATE_MAP.get(inst.get('lifecycleState', ''), 'PENDING')
+        instances[inst['id']] = common.InstanceInfo(
+            instance_id=inst['id'],
+            internal_ip=private_ip,
+            external_ip=public_ip,
+            status=state or 'TERMINATED',
+            tags={'cluster': cluster_name, 'node_index': str(index)},
+            slice_id=inst['id'],
+            host_index=0,
+        )
+        if index == 0:
+            head_id = inst['id']
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head_id,
+        provider_name='oci',
+        provider_config=dict(provider_config or {}),
+        ssh_user='ubuntu')
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    t = _transport(provider_config)
+    compartment = _compartment(t, provider_config)
+    vcn_id = (provider_config or {}).get('vcn_id')
+    if vcn_id is None:
+        subnets = t.call('GET', '/subnets',
+                         query={'compartmentId': compartment}) or []
+        vcn_id = subnets[0]['vcnId'] if subnets else None
+    if vcn_id is None:
+        raise exceptions.ProvisionError(
+            'Cannot locate the cluster VCN to open ports on OCI.')
+    try:
+        nsg_id = _ensure_nsg(t, compartment, vcn_id, cluster_name)
+        rules = []
+        for spec in ports:
+            lo, _, hi = str(spec).partition('-')
+            lo, hi = int(lo), int(hi or lo)
+            rules.append({'direction': 'INGRESS', 'protocol': '6',
+                          'source': '0.0.0.0/0',
+                          'sourceType': 'CIDR_BLOCK',
+                          'tcpOptions': {'destinationPortRange':
+                                         {'min': lo, 'max': hi}}})
+        existing = t.call(
+            'GET', f'/networkSecurityGroups/{nsg_id}/securityRules') or []
+
+        def _key(r):
+            tcp = r.get('tcpOptions') or {}
+            pr = tcp.get('destinationPortRange') or {}
+            return (r.get('direction'), r.get('protocol'),
+                    pr.get('min'), pr.get('max'))
+
+        have = {_key(r) for r in existing}
+        rules = [r for r in rules if _key(r) not in have]
+        if rules:
+            t.call('POST', f'/networkSecurityGroups/{nsg_id}'
+                   '/actions/addSecurityRules',
+                   body={'securityRules': rules})
+    except rest.OciApiError as e:
+        raise rest.classify_error(e) from e
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    # The per-cluster NSG is torn down with the cluster in
+    # terminate_instances; nothing to do per-port.
+    del cluster_name, provider_config

@@ -1,0 +1,201 @@
+"""OCI REST transport: draft-cavage HTTP signatures, no SDK.
+
+Role twin of the reference's oci adaptor + query_helper
+(sky/adaptors/oci.py, sky/provision/oci/query_utils.py), redesigned for
+this repo's transport pattern (provision/*/rest.py): a `call()` that
+signs each request with the tenancy's API key (RSA-SHA256 over the
+canonical signing string — `(request-target)`, host, date, and for
+bodied requests content-length/content-type/x-content-sha256) and maps
+OCI service errors onto the failover engine's typed taxonomy.
+
+Credentials come from the standard ~/.oci/config INI (user / tenancy /
+fingerprint / key_file / region) — the same file the reference mounts
+onto controllers, so existing OCI setups work unchanged.
+"""
+from __future__ import annotations
+
+import base64
+import configparser
+import datetime
+import email.utils
+import hashlib
+import json
+import os
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+CONFIG_PATH = '~/.oci/config'
+_MAX_ATTEMPTS = 4
+_BACKOFF_S = 2.0
+
+# Service endpoints are regional: https://<service>.<region>.oraclecloud.com
+_SERVICE_HOSTS = {
+    'iaas': 'iaas.{region}.oraclecloud.com',           # core compute
+    'identity': 'identity.{region}.oraclecloud.com',
+}
+API_VERSION = '20160918'
+
+
+class OciApiError(Exception):
+
+    def __init__(self, status: int, code: str, message: str) -> None:
+        super().__init__(f'{code or status}: {message}')
+        self.status = status
+        self.code = code or str(status)
+        self.message = message
+
+
+def load_profile(profile: str = 'DEFAULT') -> Optional[Dict[str, str]]:
+    path = os.path.expanduser(CONFIG_PATH)
+    if not os.path.exists(path):
+        return None
+    parser = configparser.ConfigParser()
+    try:
+        parser.read(path)
+    except configparser.Error:
+        return None
+    if profile not in parser and profile != 'DEFAULT':
+        return None
+    section = parser[profile] if profile in parser else parser['DEFAULT']
+    needed = ('user', 'tenancy', 'fingerprint', 'key_file', 'region')
+    if not all(k in section for k in needed):
+        return None
+    return {k: section[k] for k in section}
+
+
+def classify_error(e: OciApiError,
+                   region: Optional[str] = None) -> Exception:
+    """Map OCI service error codes onto the failover taxonomy.
+
+    OCI's capacity signal is a 500 InternalError with 'Out of host
+    capacity' (their documented stockout response for launch), plus
+    LimitExceeded / QuotaExceeded 400s for account limits.
+    """
+    text = f'{e.code} {e.message}'.lower()
+    where = f' in {region}' if region else ''
+    if 'out of host capacity' in text or 'outofcapacity' in text:
+        return exceptions.CapacityError(f'OCI capacity{where}: {e}')
+    if e.code in ('LimitExceeded', 'QuotaExceeded') or 'quota' in text:
+        return exceptions.QuotaExceededError(f'OCI quota{where}: {e}')
+    if e.status in (401, 403) or e.code == 'NotAuthenticated':
+        return exceptions.PermissionError_(f'OCI auth: {e}')
+    if e.status == 400 or e.code == 'InvalidParameter':
+        return exceptions.InvalidRequestError(f'OCI request: {e}')
+    return exceptions.ProvisionError(f'OCI API{where}: {e}')
+
+
+class Transport:
+    """Signed OCI REST calls for one profile + region."""
+
+    def __init__(self, region: Optional[str] = None,
+                 profile: str = 'DEFAULT') -> None:
+        cfg = load_profile(profile)
+        if cfg is None:
+            raise exceptions.PermissionError_(
+                f'OCI config not found/incomplete at {CONFIG_PATH}.')
+        self._cfg = cfg
+        self.region = region or cfg['region']
+        self.tenancy = cfg['tenancy']
+        self._key_id = (f'{cfg["tenancy"]}/{cfg["user"]}/'
+                        f'{cfg["fingerprint"]}')
+        self._private_key = None  # lazy: loaded on first call
+
+    def _load_key(self):
+        if self._private_key is None:
+            from cryptography.hazmat.primitives import serialization
+            with open(os.path.expanduser(self._cfg['key_file']),
+                      'rb') as f:
+                self._private_key = serialization.load_pem_private_key(
+                    f.read(),
+                    password=(self._cfg.get('pass_phrase') or
+                              '').encode() or None)
+        return self._private_key
+
+    def _sign(self, signing_string: str) -> str:
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding
+        sig = self._load_key().sign(signing_string.encode(),
+                                    padding.PKCS1v15(), hashes.SHA256())
+        return base64.b64encode(sig).decode()
+
+    def call(self, method: str, path: str,
+             body: Optional[Dict[str, Any]] = None,
+             query: Optional[Dict[str, Any]] = None,
+             service: str = 'iaas') -> Any:
+        host = _SERVICE_HOSTS[service].format(region=self.region)
+        target = f'/{API_VERSION}{path}'
+        if query:
+            target += '?' + urllib.parse.urlencode(
+                {k: v for k, v in query.items() if v is not None})
+        date = email.utils.format_datetime(
+            datetime.datetime.now(datetime.timezone.utc), usegmt=True)
+        data = json.dumps(body).encode() if body is not None else None
+        if data is None and method.upper() in ('POST', 'PUT', 'PATCH'):
+            # OCI requires the body headers on every POST/PUT/PATCH —
+            # bodyless actions (e.g. instance START/STOP) sign an empty
+            # body or the service rejects the signature.
+            data = b''
+
+        headers_order: List[str] = ['(request-target)', 'host', 'date']
+        lines = [f'(request-target): {method.lower()} {target}',
+                 f'host: {host}', f'date: {date}']
+        req_headers = {'host': host, 'date': date,
+                       'accept': 'application/json'}
+        if data is not None:
+            sha = base64.b64encode(hashlib.sha256(data).digest()).decode()
+            headers_order += ['content-length', 'content-type',
+                              'x-content-sha256']
+            lines += [f'content-length: {len(data)}',
+                      'content-type: application/json',
+                      f'x-content-sha256: {sha}']
+            req_headers.update({'content-type': 'application/json',
+                                'x-content-sha256': sha})
+        signature = self._sign('\n'.join(lines))
+        req_headers['authorization'] = (
+            'Signature version="1",'
+            f'keyId="{self._key_id}",algorithm="rsa-sha256",'
+            f'headers="{" ".join(headers_order)}",'
+            f'signature="{signature}"')
+
+        url = f'https://{host}{target}'
+        for attempt in range(_MAX_ATTEMPTS):
+            req = urllib.request.Request(url, data=data, method=method,
+                                         headers=req_headers)
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    payload = resp.read()
+                    result = json.loads(payload) if payload else {}
+                    next_page = resp.headers.get('opc-next-page')
+                    # List endpoints paginate via opc-next-page; follow
+                    # it so a busy compartment never hides cluster
+                    # nodes beyond page one (duplicate-launch /
+                    # missed-terminate hazard).
+                    if (next_page and method == 'GET'
+                            and isinstance(result, list)):
+                        rest_pages = self.call(
+                            method, path, body=body,
+                            query=dict(query or {}, page=next_page),
+                            service=service)
+                        if isinstance(rest_pages, list):
+                            result = result + rest_pages
+                    return result
+            except urllib.error.HTTPError as e:
+                if e.code in (429, 503) and attempt < _MAX_ATTEMPTS - 1:
+                    time.sleep(_BACKOFF_S * (attempt + 1))
+                    continue
+                try:
+                    err = json.loads(e.read() or b'{}')
+                    raise OciApiError(e.code, err.get('code', ''),
+                                      err.get('message', str(e)))
+                except (ValueError, AttributeError):
+                    raise OciApiError(e.code, '', str(e)) from e
+            except urllib.error.URLError as e:
+                raise exceptions.ProvisionError(
+                    f'OCI API unreachable: {e}') from e
+        # Unreachable: every iteration returns or raises (a final-attempt
+        # 429/503 raises OciApiError above).

@@ -1,0 +1,275 @@
+"""OCI provisioner tests against an in-memory API fake.
+
+Same pattern as the Lambda/RunPod fakes (role of moto in the
+reference's tests): scripted capacity errors, no network, no SDK.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.oci import instance as oci_instance
+from skypilot_tpu.provision.oci import rest
+
+
+class FakeOci:
+    """Minimal in-memory OCI core + identity API."""
+
+    def __init__(self) -> None:
+        self.tenancy = 'ocid1.tenancy.oc1..root'
+        self.region = 'us-ashburn-1'
+        self.instances: Dict[str, Dict[str, Any]] = {}
+        self.nsgs: Dict[str, Dict[str, Any]] = {}
+        self.nsg_rules: Dict[str, List[Dict[str, Any]]] = {}
+        self.fail_launch: Optional[rest.OciApiError] = None
+        self._next = 0
+
+    def _id(self, kind: str) -> str:
+        self._next += 1
+        return f'ocid1.{kind}.oc1..{self._next:04d}'
+
+    # The transport interface the provisioner consumes.
+    def call(self, method: str, path: str, body=None, query=None,
+             service: str = 'iaas') -> Any:
+        query = query or {}
+        if path == '/availabilityDomains/':
+            return [{'name': f'Uocm:US-ASHBURN-AD-{i}'}
+                    for i in (1, 2, 3)]
+        if path == '/subnets':
+            return [{'id': 'ocid1.subnet.oc1..sub1',
+                     'vcnId': 'ocid1.vcn.oc1..vcn1'}]
+        if path.startswith('/subnets/'):
+            return {'id': path.split('/')[2],
+                    'vcnId': 'ocid1.vcn.oc1..othervcn'}
+        if path == '/images':
+            return [{'id': 'ocid1.image.oc1..ubuntu2204'}]
+        if path == '/instances' and method == 'GET':
+            return list(self.instances.values())
+        if path == '/instances' and method == 'POST':
+            if self.fail_launch is not None:
+                err, self.fail_launch = self.fail_launch, None
+                raise err
+            iid = self._id('instance')
+            # NB: real instance records carry no vcnId — the VCN hangs
+            # off the VNIC; the provisioner must not rely on it here.
+            inst = dict(body, id=iid, lifecycleState='RUNNING')
+            self.instances[iid] = inst
+            return inst
+        if path.startswith('/instances/') and method == 'POST':
+            iid = path.split('/')[2]
+            action = query.get('action')
+            if action == 'STOP':
+                self.instances[iid]['lifecycleState'] = 'STOPPED'
+            elif action == 'START':
+                self.instances[iid]['lifecycleState'] = 'RUNNING'
+            return self.instances[iid]
+        if path.startswith('/instances/') and method == 'DELETE':
+            iid = path.split('/')[2]
+            self.instances.pop(iid, None)
+            return {}
+        if path == '/vnicAttachments':
+            iid = query['instanceId']
+            return [{'vnicId': f'vnic-{iid}', 'lifecycleState': 'ATTACHED'}]
+        if path.startswith('/vnics/'):
+            iid = path.split('/')[2].removeprefix('vnic-')
+            n = int(iid.rsplit('.', 1)[-1])
+            return {'privateIp': f'10.0.0.{n}',
+                    'publicIp': f'129.146.0.{n}'}
+        if path == '/networkSecurityGroups' and method == 'GET':
+            return [n for n in self.nsgs.values()
+                    if n['vcnId'] == query.get('vcnId')]
+        if path == '/networkSecurityGroups' and method == 'POST':
+            nid = self._id('networksecuritygroup')
+            nsg = dict(body, id=nid)
+            self.nsgs[nid] = nsg
+            self.nsg_rules[nid] = []
+            return nsg
+        if path.endswith('/actions/addSecurityRules'):
+            nid = path.split('/')[2]
+            self.nsg_rules[nid].extend(body['securityRules'])
+            return {}
+        if path.endswith('/securityRules') and method == 'GET':
+            nid = path.split('/')[2]
+            return list(self.nsg_rules[nid])
+        if path.startswith('/networkSecurityGroups/') and \
+                method == 'DELETE':
+            nid = path.split('/')[2]
+            self.nsgs.pop(nid, None)
+            return {}
+        raise AssertionError(f'unhandled OCI call {method} {path}')
+
+
+@pytest.fixture()
+def fake_oci(monkeypatch, tmp_path):
+    fake = FakeOci()
+    monkeypatch.setattr(oci_instance, '_transport_factory',
+                        lambda region=None, profile='DEFAULT': fake)
+    yield fake
+
+
+PROVIDER: Dict[str, Any] = {'region': 'us-ashburn-1'}
+
+
+def _config(count=1, itype='VM.GPU.A10.1', spot=False, **node):
+    return common.ProvisionConfig(
+        provider_config=dict(PROVIDER),
+        node_config={'instance_type': itype, 'use_spot': spot,
+                     'disk_size': 100, **node},
+        count=count)
+
+
+def test_launch_lifecycle(fake_oci):
+    record = oci_instance.run_instances('us-ashburn-1', 'AD-1', 'c1',
+                                        _config(count=2))
+    assert len(record.created_instance_ids) == 2
+    assert record.head_instance_id is not None
+    # Tags round-trip: reconstruct the cluster from a cold start.
+    info = oci_instance.get_cluster_info('us-ashburn-1', 'c1', PROVIDER)
+    assert info.num_instances == 2
+    hosts = info.sorted_instances()
+    assert info.head_instance_id == hosts[0].instance_id
+    assert all(h.external_ip for h in hosts)
+    # The AD short name resolved to the tenancy's full AD name.
+    launched = list(fake_oci.instances.values())[0]
+    assert launched['availabilityDomain'] == 'Uocm:US-ASHBURN-AD-1'
+    # Cluster NSG exists and covers ssh.
+    assert len(fake_oci.nsgs) == 1
+    oci_instance.terminate_instances('c1', PROVIDER)
+    assert oci_instance.query_instances('c1', PROVIDER) == {}
+    # NSG torn down with the cluster.
+    assert not fake_oci.nsgs
+
+
+def test_idempotent_relaunch_and_gap_fill(fake_oci):
+    oci_instance.run_instances('us-ashburn-1', 'AD-1', 'c2',
+                               _config(count=3))
+    # Kill node 1 out-of-band; relaunch must recreate exactly it.
+    victim = next(i for i, v in fake_oci.instances.items()
+                  if v['freeformTags']['xsky-node'] == '1')
+    del fake_oci.instances[victim]
+    record = oci_instance.run_instances('us-ashburn-1', 'AD-1', 'c2',
+                                        _config(count=3))
+    assert len(record.created_instance_ids) == 1
+    indices = sorted(v['freeformTags']['xsky-node']
+                     for v in fake_oci.instances.values())
+    assert indices == ['0', '1', '2']
+
+
+def test_stop_resume(fake_oci):
+    oci_instance.run_instances('us-ashburn-1', 'AD-1', 'c3', _config())
+    oci_instance.stop_instances('c3', PROVIDER)
+    assert set(oci_instance.query_instances('c3', PROVIDER).values()) == \
+        {'STOPPED'}
+    record = oci_instance.run_instances('us-ashburn-1', 'AD-1', 'c3',
+                                        _config())
+    assert record.created_instance_ids == []
+    assert set(oci_instance.query_instances('c3', PROVIDER).values()) == \
+        {'RUNNING'}
+
+
+def test_spot_is_preemptible_and_cannot_stop(fake_oci):
+    oci_instance.run_instances('us-ashburn-1', 'AD-1', 'c4',
+                               _config(spot=True))
+    inst = list(fake_oci.instances.values())[0]
+    assert inst['preemptibleInstanceConfig']['preemptionAction'][
+        'type'] == 'TERMINATE'
+    with pytest.raises(exceptions.NotSupportedError):
+        oci_instance.stop_instances('c4', PROVIDER)
+
+
+def test_terminated_node_visible_to_reconciliation(fake_oci):
+    """A preempted/killed node must surface as id -> None, not vanish."""
+    oci_instance.run_instances('us-ashburn-1', 'AD-1', 'c4b',
+                               _config(count=2))
+    victim = next(iter(fake_oci.instances))
+    fake_oci.instances[victim]['lifecycleState'] = 'TERMINATED'
+    statuses = oci_instance.query_instances('c4b', PROVIDER)
+    assert statuses[victim] is None
+    assert sorted(v for v in statuses.values() if v) == ['RUNNING']
+    # wait-for-RUNNING fails fast instead of burning the timeout.
+    with pytest.raises(exceptions.CapacityError):
+        oci_instance.wait_instances('us-ashburn-1', 'c4b', 'RUNNING',
+                                    PROVIDER, timeout_s=5,
+                                    poll_interval_s=0.01)
+
+
+def test_capacity_error_classified(fake_oci):
+    fake_oci.fail_launch = rest.OciApiError(
+        500, 'InternalError', 'Out of host capacity.')
+    with pytest.raises(exceptions.CapacityError):
+        oci_instance.run_instances('us-ashburn-1', 'AD-1', 'c5', _config())
+
+
+def test_quota_and_auth_classified():
+    assert isinstance(
+        rest.classify_error(rest.OciApiError(400, 'LimitExceeded', 'x')),
+        exceptions.QuotaExceededError)
+    assert isinstance(
+        rest.classify_error(rest.OciApiError(401, 'NotAuthenticated', 'x')),
+        exceptions.PermissionError_)
+
+
+def test_open_ports_idempotent(fake_oci):
+    oci_instance.run_instances('us-ashburn-1', 'AD-1', 'c6', _config())
+    oci_instance.open_ports('c6', ['8080', '9000-9010'], PROVIDER)
+    oci_instance.open_ports('c6', ['8080'], PROVIDER)  # no duplicate
+    nid = next(iter(fake_oci.nsg_rules))
+    port_rules = [r for r in fake_oci.nsg_rules[nid]
+                  if (r.get('tcpOptions') or {}).get(
+                      'destinationPortRange', {}).get('min') in (8080, 9000)]
+    assert len(port_rules) == 2
+
+
+def test_flex_shape_config(monkeypatch):
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.utils import registry
+    cloud = registry.CLOUD_REGISTRY.from_str('oci')
+    monkeypatch.setattr(
+        'skypilot_tpu.authentication.public_key_content',
+        lambda: 'ssh-ed25519 AAAA test')
+    r = resources_lib.Resources(cloud='oci',
+                                instance_type='VM.Standard.E4.Flex')
+    vars = cloud.make_deploy_resources_variables(
+        r, 'c', 'us-ashburn-1', 'AD-1')
+    assert vars['shape_config'] == {'ocpus': 4, 'memoryInGBs': 32}
+
+
+def test_cloud_feasibility_and_pricing():
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.utils import registry
+    cloud = registry.CLOUD_REGISTRY.from_str('oci')
+    r = resources_lib.Resources(accelerators='A10:1')
+    feasible, _ = cloud.get_feasible_launchable_resources(r)
+    assert feasible
+    assert feasible[0].instance_type == 'VM.GPU.A10.1'
+    assert feasible[0].get_hourly_cost() == pytest.approx(2.00)
+    # Preemptible exists for VM shapes (50% of on-demand)...
+    spot = resources_lib.Resources(accelerators='A10:1', use_spot=True)
+    feasible, _ = cloud.get_feasible_launchable_resources(spot)
+    assert feasible and feasible[0].get_hourly_cost() == pytest.approx(1.00)
+    # ...but not for bare-metal shapes.
+    regions = cloud.regions_with_offering('BM.GPU.H100.8', None,
+                                          use_spot=True, region=None,
+                                          zone=None)
+    assert regions == []
+    # Multi-AD regions expose each AD as a zone.
+    regions = cloud.regions_with_offering('VM.GPU.A10.1', None,
+                                          use_spot=False,
+                                          region='us-ashburn-1', zone=None)
+    assert regions and regions[0].zones == ['AD-1', 'AD-2', 'AD-3']
+
+
+def test_check_credentials(monkeypatch, tmp_path):
+    from skypilot_tpu.utils import registry
+    cloud = registry.CLOUD_REGISTRY.from_str('oci')
+    monkeypatch.setattr(rest, 'CONFIG_PATH', str(tmp_path / 'config'))
+    ok, reason = cloud.check_credentials()
+    assert not ok and '.oci/config' in reason.replace(str(tmp_path), '~/.oci')
+    (tmp_path / 'config').write_text(
+        '[DEFAULT]\nuser=ocid1.user.oc1..u\ntenancy=ocid1.tenancy.oc1..t\n'
+        'fingerprint=aa:bb\nkey_file=~/.oci/key.pem\nregion=us-ashburn-1\n')
+    ok, _ = cloud.check_credentials()
+    assert ok
