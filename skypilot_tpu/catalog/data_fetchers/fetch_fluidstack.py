@@ -1,0 +1,50 @@
+"""Generate the Fluidstack catalog CSV (twin of
+sky/catalog/data_fetchers/fetch_fluidstack.py in role).
+
+Fluidstack schedules placement itself, so the catalog uses a single
+'marketplace' pseudo-region. Static published on-demand prices.
+
+Run: python -m skypilot_tpu.catalog.data_fetchers.fetch_fluidstack
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Tuple
+
+# (gpu_type, acc_name, acc_count, vcpus, mem_gib, acc_mem_gib, price)
+_SKUS: List[Tuple[str, str, float, float, float, float, float]] = [
+    ('H100_SXM5_80GB', 'H100-SXM', 1, 28, 180, 80, 2.89),
+    ('H100_PCIE_80GB', 'H100', 1, 28, 180, 80, 2.49),
+    ('A100_SXM4_80GB', 'A100-80GB-SXM', 1, 28, 120, 80, 1.79),
+    ('A100_PCIE_80GB', 'A100-80GB', 1, 28, 120, 80, 1.49),
+    ('L40_48GB', 'L40', 1, 32, 60, 48, 1.25),
+    ('RTX_A6000_48GB', 'RTXA6000', 1, 16, 60, 48, 0.79),
+    ('RTX_A5000_24GB', 'RTXA5000', 1, 16, 60, 24, 0.49),
+]
+
+HEADER = ['InstanceType', 'AcceleratorName', 'AcceleratorCount', 'vCPUs',
+          'MemoryGiB', 'AcceleratorMemoryGiB', 'Price', 'SpotPrice',
+          'Region', 'AvailabilityZone']
+
+
+def rows_static() -> List[List[str]]:
+    return [[itype, acc, f'{count:g}', f'{vcpus:g}', f'{mem:g}',
+             f'{acc_mem:g}', f'{price:.4f}', '0', 'marketplace',
+             'marketplace']
+            for itype, acc, count, vcpus, mem, acc_mem, price in _SKUS]
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, 'data', 'fluidstack', 'catalog.csv')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.writer(f)
+        writer.writerow(HEADER)
+        writer.writerows(rows_static())
+    print(f'Wrote {path} (static snapshot)')
+
+
+if __name__ == '__main__':
+    main()

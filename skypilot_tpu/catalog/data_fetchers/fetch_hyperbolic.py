@@ -1,0 +1,50 @@
+"""Generate the Hyperbolic catalog CSV (twin of
+sky/catalog/data_fetchers/fetch_hyperbolic.py in role).
+
+With a key + egress, rows come from the marketplace listing; offline
+the checked-in CSV is a static snapshot of typical marketplace offers.
+Single 'marketplace' pseudo-region; terminate-only; no spot market.
+
+Run: python -m skypilot_tpu.catalog.data_fetchers.fetch_hyperbolic
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Tuple
+
+# (itype `<count>x-<MODEL>`, acc, count, vcpus, mem, acc_mem, price)
+_SKUS: List[Tuple[str, str, float, float, float, float, float]] = [
+    ('1x-H100-SXM', 'H100-SXM', 1, 24, 128, 80, 1.49),
+    ('8x-H100-SXM', 'H100-SXM', 8, 192, 1024, 640, 11.92),
+    ('1x-A100-80GB', 'A100-80GB', 1, 16, 96, 80, 0.99),
+    ('8x-A100-80GB', 'A100-80GB', 8, 128, 768, 640, 7.92),
+    ('1x-RTX4090', 'RTX4090', 1, 8, 32, 24, 0.35),
+    ('4x-RTX4090', 'RTX4090', 4, 32, 128, 96, 1.40),
+]
+
+HEADER = ['InstanceType', 'AcceleratorName', 'AcceleratorCount', 'vCPUs',
+          'MemoryGiB', 'AcceleratorMemoryGiB', 'Price', 'SpotPrice',
+          'Region', 'AvailabilityZone']
+
+
+def rows_static() -> List[List[str]]:
+    return [[itype, acc, f'{count:g}', f'{vcpus:g}', f'{mem:g}',
+             f'{acc_mem:g}', f'{price:.4f}', '0', 'marketplace',
+             'marketplace']
+            for itype, acc, count, vcpus, mem, acc_mem, price in _SKUS]
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, 'data', 'hyperbolic', 'catalog.csv')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.writer(f)
+        writer.writerow(HEADER)
+        writer.writerows(rows_static())
+    print(f'Wrote {path} (static snapshot)')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,74 @@
+"""Generate the IBM Cloud VPC catalog CSV (twin of
+sky/catalog/data_fetchers/fetch_ibm.py in role).
+
+With credentials + egress, rows would come from the VPC
+instance/profiles endpoint plus the Global Catalog pricing API;
+offline (this environment) the checked-in CSV is a curated snapshot of
+the GPU (gx2 = V100, gx3 = L4, gx3d = L40S) and balanced CPU profiles
+at published on-demand list prices. IBM VPC Gen2 has no spot market
+(SpotPrice 0 -> never offered for use_spot).
+
+Run: python -m skypilot_tpu.catalog.data_fetchers.fetch_ibm
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Tuple
+
+# (profile, acc_name, acc_count, vcpus, mem_gib, acc_mem_gib, price)
+_SKUS: List[Tuple[str, str, float, float, float, float, float]] = [
+    ('gx2-8x64x1v100', 'V100', 1, 8, 64, 16, 2.54),
+    ('gx2-16x128x1v100', 'V100', 1, 16, 128, 16, 3.06),
+    ('gx2-16x128x2v100', 'V100', 2, 16, 128, 32, 5.07),
+    ('gx2-32x256x2v100', 'V100', 2, 32, 256, 32, 6.12),
+    ('gx3-16x80x1l4', 'L4', 1, 16, 80, 24, 1.40),
+    ('gx3-32x160x2l4', 'L4', 2, 32, 160, 48, 2.80),
+    ('gx3-64x320x4l4', 'L4', 4, 64, 320, 96, 5.60),
+    ('gx3d-40x200x1l40s', 'L40S', 1, 40, 200, 48, 3.55),
+    ('gx3d-80x400x2l40s', 'L40S', 2, 80, 400, 96, 7.10),
+    # Balanced CPU profiles.
+    ('bx2-4x16', '', 0, 4, 16, 0, 0.192),
+    ('bx2-8x32', '', 0, 8, 32, 0, 0.384),
+    ('bx2-16x64', '', 0, 16, 64, 0, 0.768),
+]
+
+# Region -> zone count (zones are {region}-1..{region}-N).
+_REGIONS = {
+    'us-south': 3,
+    'us-east': 3,
+    'eu-de': 3,
+    'eu-gb': 3,
+    'jp-tok': 3,
+    'au-syd': 3,
+}
+
+HEADER = ['InstanceType', 'AcceleratorName', 'AcceleratorCount', 'vCPUs',
+          'MemoryGiB', 'AcceleratorMemoryGiB', 'Price', 'SpotPrice',
+          'Region', 'AvailabilityZone']
+
+
+def rows_static() -> List[List[str]]:
+    out = []
+    for itype, acc, count, vcpus, mem, acc_mem, price in _SKUS:
+        for region, n_zones in _REGIONS.items():
+            for z in range(1, n_zones + 1):
+                out.append([itype, acc, f'{count:g}', f'{vcpus:g}',
+                            f'{mem:g}', f'{acc_mem:g}', f'{price:.4f}',
+                            '0', region, f'{region}-{z}'])
+    return out
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, 'data', 'ibm', 'catalog.csv')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.writer(f)
+        writer.writerow(HEADER)
+        writer.writerows(rows_static())
+    print(f'Wrote {path} (static snapshot)')
+
+
+if __name__ == '__main__':
+    main()

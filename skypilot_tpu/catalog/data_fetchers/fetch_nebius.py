@@ -1,0 +1,58 @@
+"""Generate the Nebius catalog CSV (twin of the nebius rows in the
+reference's hosted catalog).
+
+Instance type grammar `<platform>:<preset>`; regions are the Nebius
+AI Cloud regions. Static published on-demand prices. No spot market.
+
+Run: python -m skypilot_tpu.catalog.data_fetchers.fetch_nebius
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Tuple
+
+# (itype, acc, count, vcpus, mem_gib, acc_mem_gib, price)
+_SKUS: List[Tuple[str, str, float, float, float, float, float]] = [
+    ('gpu-h100-sxm:1gpu-16vcpu-200gb', 'H100', 1, 16, 200, 80, 2.95),
+    ('gpu-h100-sxm:8gpu-128vcpu-1600gb', 'H100', 8, 128, 1600, 640,
+     23.60),
+    ('gpu-h200-sxm:1gpu-16vcpu-200gb', 'H200', 1, 16, 200, 141, 3.50),
+    ('gpu-h200-sxm:8gpu-128vcpu-1600gb', 'H200', 8, 128, 1600, 1128,
+     28.00),
+    ('gpu-l40s-a:1gpu-8vcpu-32gb', 'L40S', 1, 8, 32, 48, 1.55),
+    ('gpu-l40s-a:4gpu-32vcpu-128gb', 'L40S', 4, 32, 128, 192, 6.20),
+    ('cpu-e2:4vcpu-16gb', '', 0, 4, 16, 0, 0.12),
+    ('cpu-e2:8vcpu-32gb', '', 0, 8, 32, 0, 0.24),
+]
+
+_REGIONS = ['eu-north1', 'eu-west1', 'us-central1']
+
+HEADER = ['InstanceType', 'AcceleratorName', 'AcceleratorCount', 'vCPUs',
+          'MemoryGiB', 'AcceleratorMemoryGiB', 'Price', 'SpotPrice',
+          'Region', 'AvailabilityZone']
+
+
+def rows_static() -> List[List[str]]:
+    out = []
+    for itype, acc, count, vcpus, mem, acc_mem, price in _SKUS:
+        for region in _REGIONS:
+            out.append([itype, acc, f'{count:g}', f'{vcpus:g}',
+                        f'{mem:g}', f'{acc_mem:g}', f'{price:.4f}', '0',
+                        region, region])
+    return out
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, 'data', 'nebius', 'catalog.csv')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.writer(f)
+        writer.writerow(HEADER)
+        writer.writerows(rows_static())
+    print(f'Wrote {path} (static snapshot)')
+
+
+if __name__ == '__main__':
+    main()

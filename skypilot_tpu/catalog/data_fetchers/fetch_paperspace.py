@@ -1,0 +1,61 @@
+"""Generate the Paperspace catalog CSV (twin of
+sky/catalog/data_fetchers/fetch_paperspace.py in role).
+
+Static published on-demand prices for the GPU machine types in the
+three public regions. No spot market.
+
+Run: python -m skypilot_tpu.catalog.data_fetchers.fetch_paperspace
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Tuple
+
+# (machineType, acc_name, acc_count, vcpus, mem_gib, acc_mem, price)
+_SKUS: List[Tuple[str, str, float, float, float, float, float]] = [
+    ('H100', 'H100', 1, 20, 250, 80, 5.95),
+    ('H100x8', 'H100', 8, 128, 1638, 640, 47.60),
+    ('A100-80G', 'A100-80GB', 1, 12, 90, 80, 3.18),
+    ('A100-80Gx8', 'A100-80GB', 8, 96, 720, 640, 25.44),
+    ('A100', 'A100', 1, 12, 90, 40, 3.09),
+    ('V100-32G', 'V100-32GB', 1, 8, 30, 32, 2.30),
+    ('V100', 'V100', 1, 8, 30, 16, 2.30),
+    ('RTX5000', 'RTX5000', 1, 8, 30, 16, 0.82),
+    ('A4000', 'RTXA4000', 1, 8, 45, 16, 0.76),
+    ('A6000', 'RTXA6000', 1, 8, 45, 48, 1.89),
+    ('P4000', 'P4000', 1, 8, 30, 8, 0.51),
+    ('C5', '', 0, 4, 8, 0, 0.08),
+    ('C7', '', 0, 12, 30, 0, 0.30),
+]
+
+_REGIONS = ['ny2', 'ca1', 'ams1']
+
+HEADER = ['InstanceType', 'AcceleratorName', 'AcceleratorCount', 'vCPUs',
+          'MemoryGiB', 'AcceleratorMemoryGiB', 'Price', 'SpotPrice',
+          'Region', 'AvailabilityZone']
+
+
+def rows_static() -> List[List[str]]:
+    out = []
+    for itype, acc, count, vcpus, mem, acc_mem, price in _SKUS:
+        for region in _REGIONS:
+            out.append([itype, acc, f'{count:g}', f'{vcpus:g}',
+                        f'{mem:g}', f'{acc_mem:g}', f'{price:.4f}', '0',
+                        region, region])
+    return out
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, 'data', 'paperspace', 'catalog.csv')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.writer(f)
+        writer.writerow(HEADER)
+        writer.writerows(rows_static())
+    print(f'Wrote {path} (static snapshot)')
+
+
+if __name__ == '__main__':
+    main()

@@ -4,15 +4,23 @@ from skypilot_tpu.clouds.cloud import CloudImplementationFeatures
 from skypilot_tpu.clouds.cloud import Region
 from skypilot_tpu.clouds.aws import AWS
 from skypilot_tpu.clouds.azure import Azure
+from skypilot_tpu.clouds.cudo import Cudo
+from skypilot_tpu.clouds.do import DO
 from skypilot_tpu.clouds.fake import Fake
+from skypilot_tpu.clouds.fluidstack import Fluidstack
 from skypilot_tpu.clouds.gcp import GCP
+from skypilot_tpu.clouds.hyperbolic import Hyperbolic
+from skypilot_tpu.clouds.ibm import IBM
 from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.lambda_cloud import Lambda
+from skypilot_tpu.clouds.nebius import Nebius
 from skypilot_tpu.clouds.oci import OCI
+from skypilot_tpu.clouds.paperspace import Paperspace
 from skypilot_tpu.clouds.runpod import RunPod
 from skypilot_tpu.clouds.ssh import SSH
 from skypilot_tpu.clouds.vast import Vast
 
 __all__ = ['Cloud', 'CloudImplementationFeatures', 'Region', 'GCP', 'Fake',
-           'AWS', 'Azure', 'Kubernetes', 'Lambda', 'OCI', 'RunPod', 'SSH',
-           'Vast']
+           'AWS', 'Azure', 'Cudo', 'DO', 'Fluidstack', 'Hyperbolic', 'IBM',
+           'Kubernetes', 'Lambda', 'Nebius', 'OCI', 'Paperspace', 'RunPod',
+           'SSH', 'Vast']

@@ -1,0 +1,91 @@
+"""Cudo Compute: marketplace GPU VMs for cross-cloud optimization.
+
+Lean twin of sky/clouds/cudo.py — catalog-backed feasibility via
+CatalogCloud, deploy variables for the 'cudo' provisioner. Platform
+facts: data centers as regions (gb-bournemouth-1 etc.), stop/start
+supported, all ports open, no spot market; instance type grammar
+`<machine_type>_<gpus>x<GPU>` carries both the host class and the GPU
+fit, with vcpus/memory resolved from the catalog row.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import catalog_cloud
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@registry.CLOUD_REGISTRY.register()
+class Cudo(catalog_cloud.CatalogCloud):
+    _REPR = 'Cudo'
+
+    _UNSUPPORTED = {
+        cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE:
+            'Cudo has no spot market.',
+        cloud_lib.CloudImplementationFeatures.OPEN_PORTS:
+            'Cudo VMs expose all ports; none to manage.',
+        cloud_lib.CloudImplementationFeatures.CUSTOM_DISK_TIER:
+            'Cudo boot disks have a single tier.',
+    }
+
+    @property
+    def provisioner_module(self) -> str:
+        return 'cudo'
+
+    def unsupported_features_for_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        return dict(self._UNSUPPORTED)
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        itype = resources.instance_type
+        vars: Dict[str, Any] = {
+            'cluster_name': cluster_name,
+            'region': region,
+            'zone': None,
+            'instance_type': itype,
+            'image_id': resources.image_id,
+            'disk_size': resources.disk_size,
+            'use_spot': False,
+        }
+        # The create call needs explicit vcpus/memory; take them from
+        # the catalog row so billing matches the optimizer's estimate.
+        for e in self._match_entries(itype, None, region, None):
+            vars.update({'vcpus': int(e.vcpus),
+                         'memory_gib': int(e.memory_gib)})
+            break
+        if resources.accelerators:
+            name, count = next(iter(resources.accelerators.items()))
+            vars.update({'gpu_type': name, 'gpu_count': count})
+        return vars
+
+    def provider_config_overrides(
+            self, node_config: Dict[str, Any]) -> Dict[str, Any]:
+        del node_config
+        return {}
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.cudo import rest
+        if rest.load_credentials() is not None:
+            return True, None
+        return False, (
+            'Cudo credentials not found. Set $CUDO_API_KEY + '
+            f'$CUDO_PROJECT_ID or populate {rest.CREDENTIALS_PATH} '
+            '(key/project).')
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        from skypilot_tpu.provision.cudo import rest
+        if os.path.exists(os.path.expanduser(rest.CREDENTIALS_PATH)):
+            return {rest.CREDENTIALS_PATH: rest.CREDENTIALS_PATH}
+        return {}
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        return 0.0

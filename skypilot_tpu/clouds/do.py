@@ -1,0 +1,79 @@
+"""DigitalOcean: GPU droplets for cross-cloud optimization.
+
+Lean twin of sky/clouds/do.py — catalog-backed feasibility via
+CatalogCloud, deploy variables for the 'do' provisioner. Platform
+facts: flat regions, stop/start via power actions, all ports open,
+no spot market, GPU droplets (H100/L40S/MI300X) in nyc2/tor1/atl1.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import catalog_cloud
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@registry.CLOUD_REGISTRY.register(aliases=['digitalocean'])
+class DO(catalog_cloud.CatalogCloud):
+    _REPR = 'DO'
+
+    _UNSUPPORTED = {
+        cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE:
+            'DigitalOcean has no spot market.',
+        cloud_lib.CloudImplementationFeatures.CUSTOM_DISK_TIER:
+            'DigitalOcean droplets have fixed disks per size.',
+    }
+
+    @property
+    def provisioner_module(self) -> str:
+        return 'do'
+
+    def unsupported_features_for_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        return dict(self._UNSUPPORTED)
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        vars: Dict[str, Any] = {
+            'cluster_name': cluster_name,
+            'region': region,
+            'zone': None,
+            'instance_type': resources.instance_type,
+            'image_id': resources.image_id,
+            'disk_size': resources.disk_size,
+            'use_spot': False,
+        }
+        if resources.accelerators:
+            name, count = next(iter(resources.accelerators.items()))
+            vars.update({'gpu_type': name, 'gpu_count': count})
+        return vars
+
+    def provider_config_overrides(
+            self, node_config: Dict[str, Any]) -> Dict[str, Any]:
+        del node_config
+        return {}
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.do import rest
+        if rest.load_token() is not None:
+            return True, None
+        return False, (
+            'DigitalOcean token not found. Set $DIGITALOCEAN_TOKEN or '
+            f'run `doctl auth init` (writes {rest.CREDENTIALS_PATH}).')
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        from skypilot_tpu.provision.do import rest
+        if os.path.exists(os.path.expanduser(rest.CREDENTIALS_PATH)):
+            return {rest.CREDENTIALS_PATH: rest.CREDENTIALS_PATH}
+        return {}
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        return num_gigabytes * 0.01

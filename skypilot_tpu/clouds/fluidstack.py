@@ -1,0 +1,79 @@
+"""Fluidstack: marketplace GPU instances for cross-cloud optimization.
+
+Lean twin of sky/clouds/fluidstack.py — catalog-backed feasibility via
+CatalogCloud, deploy variables for the 'fluidstack' provisioner.
+Platform facts: platform-scheduled placement (single pseudo-region),
+stop/start supported, all ports open, no spot market.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import catalog_cloud
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@registry.CLOUD_REGISTRY.register()
+class Fluidstack(catalog_cloud.CatalogCloud):
+    _REPR = 'Fluidstack'
+
+    _UNSUPPORTED = {
+        cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE:
+            'Fluidstack has no spot market.',
+        cloud_lib.CloudImplementationFeatures.OPEN_PORTS:
+            'Fluidstack exposes all ports; none to manage.',
+        cloud_lib.CloudImplementationFeatures.CUSTOM_DISK_TIER:
+            'Fluidstack instances have fixed disks.',
+    }
+
+    @property
+    def provisioner_module(self) -> str:
+        return 'fluidstack'
+
+    def unsupported_features_for_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        return dict(self._UNSUPPORTED)
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        vars: Dict[str, Any] = {
+            'cluster_name': cluster_name,
+            'region': region,
+            'zone': None,
+            'instance_type': resources.instance_type,
+            'use_spot': False,
+        }
+        if resources.accelerators:
+            name, count = next(iter(resources.accelerators.items()))
+            vars.update({'gpu_type': name, 'gpu_count': count})
+        return vars
+
+    def provider_config_overrides(
+            self, node_config: Dict[str, Any]) -> Dict[str, Any]:
+        del node_config
+        return {}
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.fluidstack import rest
+        if rest.load_api_key() is not None:
+            return True, None
+        return False, (
+            'Fluidstack API key not found. Set $FLUIDSTACK_API_KEY or '
+            f'populate {rest.CREDENTIALS_PATH}.')
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        from skypilot_tpu.provision.fluidstack import rest
+        if os.path.exists(os.path.expanduser(rest.CREDENTIALS_PATH)):
+            return {rest.CREDENTIALS_PATH: rest.CREDENTIALS_PATH}
+        return {}
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        return 0.0

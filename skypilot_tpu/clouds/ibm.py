@@ -1,0 +1,84 @@
+"""IBM Cloud (VPC Gen2): GPU profiles for cross-cloud optimization.
+
+Lean twin of sky/clouds/ibm.py — catalog-backed feasibility via
+CatalogCloud, deploy variables for the 'ibm' provisioner
+(provision/ibm/instance.py), IAM API-key credential probing.
+Platform facts: profiles encode shape (gx2-8x64x1v100 = 8 vCPU /
+64 GiB / 1×V100), zonal placement inside a VPC, no spot market on VPC
+gen2, ports via the VPC default security group, head-only floating IP.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu import authentication
+from skypilot_tpu.clouds import catalog_cloud
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@registry.CLOUD_REGISTRY.register()
+class IBM(catalog_cloud.CatalogCloud):
+    _REPR = 'IBM'
+
+    _UNSUPPORTED = {
+        cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE:
+            'IBM VPC Gen2 has no spot market.',
+        cloud_lib.CloudImplementationFeatures.CUSTOM_DISK_TIER:
+            'IBM boot volumes use the general-purpose profile.',
+    }
+
+    @property
+    def provisioner_module(self) -> str:
+        return 'ibm'
+
+    def unsupported_features_for_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        return dict(self._UNSUPPORTED)
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        vars: Dict[str, Any] = {
+            'cluster_name': cluster_name,
+            'region': region,
+            'zone': zone,
+            'instance_type': resources.instance_type,
+            'image_id': resources.image_id,
+            'disk_size': resources.disk_size,
+            'use_spot': False,
+            'ssh_public_key': authentication.public_key_content(),
+        }
+        if resources.accelerators:
+            name, count = next(iter(resources.accelerators.items()))
+            vars.update({'gpu_type': name, 'gpu_count': count})
+        return vars
+
+    def provider_config_overrides(
+            self, node_config: Dict[str, Any]) -> Dict[str, Any]:
+        del node_config
+        return {}
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.ibm import rest
+        if rest.load_credentials() is not None:
+            return True, None
+        return False, (
+            'IBM API key not found. Set $IBM_API_KEY or populate '
+            f'{rest.CREDENTIALS_PATH} (iam_api_key: ...).')
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        from skypilot_tpu.provision.ibm import rest
+        if os.path.exists(os.path.expanduser(rest.CREDENTIALS_PATH)):
+            return {rest.CREDENTIALS_PATH: rest.CREDENTIALS_PATH}
+        return {}
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        # Flat-ish published rate after the free tier; keep simple.
+        return num_gigabytes * 0.09

@@ -1,0 +1,82 @@
+"""Nebius AI Cloud: H100/H200 platforms for cross-cloud optimization.
+
+Lean twin of sky/clouds/nebius.py — catalog-backed feasibility via
+CatalogCloud, deploy variables for the 'nebius' provisioner. Platform
+facts: regional projects (eu-north1 / eu-west1 / us-central1),
+stop/start supported, instance type grammar `<platform>:<preset>`
+(gpu-h100-sxm:8gpu-128vcpu-1600gb), no spot market on the public API.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import catalog_cloud
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@registry.CLOUD_REGISTRY.register()
+class Nebius(catalog_cloud.CatalogCloud):
+    _REPR = 'Nebius'
+
+    _UNSUPPORTED = {
+        cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE:
+            'Nebius has no spot market on the public API.',
+        cloud_lib.CloudImplementationFeatures.OPEN_PORTS:
+            'Nebius port policy is project-level, not per-cluster.',
+    }
+
+    @property
+    def provisioner_module(self) -> str:
+        return 'nebius'
+
+    def unsupported_features_for_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        return dict(self._UNSUPPORTED)
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        vars: Dict[str, Any] = {
+            'cluster_name': cluster_name,
+            'region': region,
+            'zone': None,
+            'instance_type': resources.instance_type,
+            'image_id': resources.image_id,
+            'disk_size': resources.disk_size,
+            'use_spot': False,
+        }
+        if resources.accelerators:
+            name, count = next(iter(resources.accelerators.items()))
+            vars.update({'gpu_type': name, 'gpu_count': count})
+        return vars
+
+    def provider_config_overrides(
+            self, node_config: Dict[str, Any]) -> Dict[str, Any]:
+        del node_config
+        return {}
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.nebius import rest
+        if rest.load_credentials() is not None:
+            return True, None
+        return False, (
+            'Nebius credentials not found. Set $NEBIUS_IAM_TOKEN + '
+            '$NEBIUS_PROJECT_ID or run `nebius init`.')
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        from skypilot_tpu.provision.nebius import rest
+        mounts = {}
+        for path in (rest.TOKEN_PATH, rest.PROJECT_PATH):
+            if os.path.exists(os.path.expanduser(path)):
+                mounts[path] = path
+        return mounts
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        return 0.0

@@ -1,0 +1,81 @@
+"""Paperspace: GPU machines for cross-cloud optimization.
+
+Lean twin of sky/clouds/paperspace.py — catalog-backed feasibility via
+CatalogCloud, deploy variables for the 'paperspace' provisioner.
+Platform facts: coarse regions (ny2/ca1/ams1), stop/start supported,
+all ports open, no spot market.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import catalog_cloud
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@registry.CLOUD_REGISTRY.register()
+class Paperspace(catalog_cloud.CatalogCloud):
+    _REPR = 'Paperspace'
+
+    _UNSUPPORTED = {
+        cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE:
+            'Paperspace has no spot market.',
+        cloud_lib.CloudImplementationFeatures.OPEN_PORTS:
+            'Paperspace machines expose all ports; none to manage.',
+        cloud_lib.CloudImplementationFeatures.CUSTOM_DISK_TIER:
+            'Paperspace disks have a single tier.',
+    }
+
+    @property
+    def provisioner_module(self) -> str:
+        return 'paperspace'
+
+    def unsupported_features_for_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        return dict(self._UNSUPPORTED)
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        vars: Dict[str, Any] = {
+            'cluster_name': cluster_name,
+            'region': region,
+            'zone': None,
+            'instance_type': resources.instance_type,
+            'image_id': resources.image_id,
+            'disk_size': resources.disk_size,
+            'use_spot': False,
+        }
+        if resources.accelerators:
+            name, count = next(iter(resources.accelerators.items()))
+            vars.update({'gpu_type': name, 'gpu_count': count})
+        return vars
+
+    def provider_config_overrides(
+            self, node_config: Dict[str, Any]) -> Dict[str, Any]:
+        del node_config
+        return {}
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.paperspace import rest
+        if rest.load_api_key() is not None:
+            return True, None
+        return False, (
+            'Paperspace API key not found. Set $PAPERSPACE_API_KEY or '
+            f'populate {rest.CREDENTIALS_PATH} ({{"apiKey": ...}}).')
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        from skypilot_tpu.provision.paperspace import rest
+        if os.path.exists(os.path.expanduser(rest.CREDENTIALS_PATH)):
+            return {rest.CREDENTIALS_PATH: rest.CREDENTIALS_PATH}
+        return {}
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        return num_gigabytes * 0.01

@@ -54,19 +54,27 @@ def db_path() -> str:
         os.environ.get('XSKY_JOBS_DB', '~/.xsky/managed_jobs.db'))
 
 
-# DDL (CREATE TABLE + ALTER migrations) runs once per (process, db
-# path): _db() sits in hot polling loops (wait_for_terminal 0.3 s,
-# controller 2 s) and re-issuing failing ALTERs + rollbacks on every
-# connection is 4 wasted DDL round-trips per state call on postgres.
-_migrated_paths: set = set()
-
-
+# DDL (CREATE TABLE + ALTER migrations) is skipped on the hot path:
+# _db() sits in polling loops (wait_for_terminal 0.3 s, controller
+# 2 s) and re-issuing failing ALTERs + rollbacks on every connection
+# is 4 wasted DDL round-trips per state call on postgres. A cheap
+# probe SELECT (one round trip) rather than a process-level flag, so
+# a DB file deleted/reset mid-process is still re-created.
 def _db() -> sqlite3.Connection:
     from skypilot_tpu.utils import db_utils
     conn = db_utils.connect(db_path(), timeout=30,
                             check_same_thread=False)
-    if db_path() in _migrated_paths:
+    try:
+        conn.execute('SELECT num_tasks FROM managed_jobs '
+                     'LIMIT 1').fetchall()
         return conn
+    except Exception:  # pylint: disable=broad-except
+        # Missing table/column: roll back (a poisoned pg transaction
+        # would swallow the DDL below) and run the migrations.
+        try:
+            conn.rollback()
+        except Exception:  # pylint: disable=broad-except
+            pass
     conn.execute("""
         CREATE TABLE IF NOT EXISTS managed_jobs (
             job_id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -105,7 +113,6 @@ def _db() -> sqlite3.Connection:
             except Exception:  # pylint: disable=broad-except
                 pass
     conn.commit()
-    _migrated_paths.add(db_path())
     return conn
 
 

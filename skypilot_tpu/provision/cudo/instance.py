@@ -1,0 +1,169 @@
+"""Cudo Compute provisioner op-set (via the nodepool base).
+
+Behavioral twin of sky/provision/cudo/instance.py. Platform facts: VMs
+live in a project and a data center (the catalog region IS the data
+center id, e.g. gb-bournemouth-1), instance types encode machine class
++ GPU model, stop/start supported ("suspend"/"resume" in their
+vocabulary maps to poweroff/start here), one public IP, all ports
+open, no spot market.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import nodepool
+from skypilot_tpu.provision.cudo import rest
+
+_transport_factory = rest.Transport
+
+
+def set_transport_factory(factory) -> None:
+    global _transport_factory
+    _transport_factory = factory
+
+
+DEFAULT_IMAGE = 'ubuntu-2204-nvidia-535-docker-v20240214'
+
+
+class CudoApi(nodepool.NodeApi):
+    provider_name = 'cudo'
+    ssh_user = 'root'
+    supports_stop = True
+    state_map = {
+        'pending': 'PENDING',
+        'prep': 'PENDING',
+        'creating': 'PENDING',
+        'booting': 'PENDING',
+        'starting': 'PENDING',
+        'active': 'RUNNING',
+        'running': 'RUNNING',
+        'stopping': 'STOPPING',
+        'suspended': 'STOPPED',
+        'stopped': 'STOPPED',
+        'deleting': None,
+        'deleted': None,
+        'failed': None,
+    }
+
+    def __init__(self) -> None:
+        self.t = _transport_factory()
+
+    @property
+    def _base(self) -> str:
+        return f'/projects/{self.t.project}/vms'
+
+    @staticmethod
+    def _row(vm: Dict[str, Any]) -> Dict[str, Any]:
+        nic = (vm.get('nics') or [{}])[0]
+        return {'id': vm.get('id') or vm.get('vmId'),
+                'name': vm.get('id') or vm.get('vmId', ''),
+                'status': (vm.get('shortState') or
+                           vm.get('state', '')),
+                'public_ip': nic.get('externalIpAddress') or
+                vm.get('externalIpAddress'),
+                'private_ip': nic.get('internalIpAddress') or
+                vm.get('internalIpAddress')}
+
+    def list_nodes(self) -> List[Dict[str, Any]]:
+        reply = self.t.call('GET', self._base)
+        return [self._row(vm) for vm in reply.get('VMs', [])]
+
+    def create_node(self, name: str, region: str, zone: Optional[str],
+                    node_config: Dict[str, Any]) -> str:
+        del zone
+        import os
+        from skypilot_tpu import authentication
+        _, public_key_path = authentication.get_or_generate_keys()
+        with open(os.path.expanduser(public_key_path),
+                  encoding='utf-8') as f:
+            public_key = f.read().strip()
+        itype = node_config['instance_type']
+        # Grammar `<machine_type>_<gpus>x<GPU>` (e.g.
+        # epyc-rome-rtx-a5000_2xRTXA5000); CPU-only types carry no
+        # suffix.
+        machine_type, _, gpu_part = itype.partition('_')
+        gpus = int(gpu_part.split('x')[0]) if gpu_part else 0
+        self.t.call('POST', self._base, {
+            'vmId': name,
+            'dataCenterId': region,
+            'machineType': machine_type,
+            'gpus': gpus,
+            'vcpus': int(node_config.get('vcpus', 4)),
+            'memoryGib': int(node_config.get('memory_gib', 16)),
+            'bootDisk': {'sizeGib': node_config.get('disk_size', 100)},
+            'bootDiskImageId': node_config.get('image_id') or
+            DEFAULT_IMAGE,
+            'sshKeySource': 'SSH_KEY_SOURCE_NONE',
+            'customSshKeys': [public_key],
+        })
+        return name  # Cudo vmId is caller-chosen: id == name
+
+    def delete_node(self, node_id: str) -> None:
+        self.t.call('POST', f'{self._base}/{node_id}/terminate')
+
+    def stop_node(self, node_id: str) -> None:
+        self.t.call('POST', f'{self._base}/{node_id}/stop')
+
+    def start_node(self, node_id: str) -> None:
+        self.t.call('POST', f'{self._base}/{node_id}/start')
+
+    def classify(self, e: Exception,
+                 region: Optional[str] = None) -> Exception:
+        if isinstance(e, rest.CudoApiError):
+            return rest.classify_error(e, region)
+        return e
+
+
+def _api(provider_config: Dict[str, Any]) -> CudoApi:
+    del provider_config
+    return CudoApi()
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    return nodepool.run_instances(_api(config.provider_config), region,
+                                  zone, cluster_name, config)
+
+
+def wait_instances(region: str, cluster_name: str, state: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   timeout_s: float = 900.0,
+                   poll_interval_s: float = 5.0) -> None:
+    del region
+    nodepool.wait_instances(_api(provider_config or {}), cluster_name,
+                            state, timeout_s, poll_interval_s)
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    nodepool.stop_instances(_api(provider_config), cluster_name)
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    nodepool.terminate_instances(_api(provider_config), cluster_name)
+
+
+def query_instances(cluster_name: str, provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    return nodepool.query_instances(_api(provider_config), cluster_name)
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Dict[str, Any]
+                     ) -> common.ClusterInfo:
+    del region
+    return nodepool.get_cluster_info(_api(provider_config), cluster_name,
+                                     provider_config)
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    # Cudo VMs expose all ports on their public IP by default.
+    del cluster_name, ports, provider_config
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    del cluster_name, provider_config

@@ -1,0 +1,111 @@
+"""Cudo Compute REST transport.
+
+Role twin of the cudo-compute SDK use in sky/provision/cudo/, on this
+repo's transport pattern. Key from $CUDO_API_KEY or ~/.config/cudo/
+cudo.yml (`key: ...`); VMs live under a project id (same file,
+`project: ...`).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+API_ENDPOINT = 'https://rest.compute.cudo.org/v1'
+CREDENTIALS_PATH = '~/.config/cudo/cudo.yml'
+_MAX_ATTEMPTS = 4
+_BACKOFF_S = 2.0
+
+
+class CudoApiError(Exception):
+
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(f'{status}: {message}')
+        self.status = status
+        self.message = message
+
+
+def load_credentials() -> Optional[Tuple[str, str]]:
+    """(api_key, project_id) from env or the cudo CLI config."""
+    key = os.environ.get('CUDO_API_KEY')
+    project = os.environ.get('CUDO_PROJECT_ID')
+    path = os.path.expanduser(CREDENTIALS_PATH)
+    if os.path.exists(path):
+        try:
+            with open(path, encoding='utf-8') as f:
+                for line in f:
+                    stripped = line.strip()
+                    if stripped.startswith('key:') and not key:
+                        key = stripped.split(':', 1)[1].strip().strip('\'"')
+                    elif stripped.startswith('project:') and not project:
+                        project = stripped.split(':', 1)[1].strip().strip(
+                            '\'"')
+        except OSError:
+            pass
+    if key and project:
+        return key, project
+    return None
+
+
+def classify_error(e: CudoApiError,
+                   region: Optional[str] = None) -> Exception:
+    text = e.message.lower()
+    where = f' in {region}' if region else ''
+    if 'no host available' in text or 'out of capacity' in text or \
+            'insufficient resource' in text:
+        return exceptions.CapacityError(f'Cudo capacity{where}: {e}')
+    if 'quota' in text or 'limit' in text:
+        return exceptions.QuotaExceededError(f'Cudo quota{where}: {e}')
+    if e.status in (401, 403):
+        return exceptions.PermissionError_(f'Cudo auth: {e}')
+    if e.status == 400:
+        return exceptions.InvalidRequestError(f'Cudo request: {e}')
+    return exceptions.ProvisionError(f'Cudo API{where}: {e}')
+
+
+class Transport:
+
+    def __init__(self, api_key: Optional[str] = None,
+                 project: Optional[str] = None) -> None:
+        if api_key is None or project is None:
+            creds = load_credentials()
+            if creds is None:
+                raise exceptions.PermissionError_(
+                    'Cudo credentials not found (set $CUDO_API_KEY + '
+                    f'$CUDO_PROJECT_ID or populate {CREDENTIALS_PATH}).')
+            api_key, project = creds
+        self._key = api_key
+        self.project = project
+
+    def call(self, method: str, path: str,
+             body: Optional[Dict[str, Any]] = None) -> Any:
+        url = f'{API_ENDPOINT}{path}'
+        data = json.dumps(body).encode() if body is not None else None
+        for attempt in range(_MAX_ATTEMPTS):
+            req = urllib.request.Request(
+                url, data=data, method=method,
+                headers={'Authorization': f'Bearer {self._key}',
+                         'Content-Type': 'application/json'})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    payload = resp.read()
+                    return json.loads(payload) if payload else {}
+            except urllib.error.HTTPError as e:
+                if e.code == 429 and attempt < _MAX_ATTEMPTS - 1:
+                    time.sleep(_BACKOFF_S * (attempt + 1))
+                    continue
+                try:
+                    err = json.loads(e.read() or b'{}')
+                    raise CudoApiError(e.code,
+                                       str(err.get('message', str(e))))
+                except (ValueError, AttributeError):
+                    raise CudoApiError(e.code, str(e)) from e
+            except urllib.error.URLError as e:
+                raise exceptions.ProvisionError(
+                    f'Cudo API unreachable: {e}') from e
+        # Unreachable: every iteration returns or raises.

@@ -1,0 +1,160 @@
+"""DigitalOcean provisioner op-set (droplets via the nodepool base).
+
+Behavioral twin of sky/provision/do/instance.py. Platform facts: flat
+regions (nyc2/tor1/atl1 for GPU droplets), stop/start via power
+actions, one public + one private IP per droplet, all ports open (no
+cloud firewall is attached by default), no spot market. SSH keys are
+registered account-wide once; GPU droplets boot the AI/ML image.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import nodepool
+from skypilot_tpu.provision.do import rest
+
+_transport_factory = rest.Transport
+
+
+def set_transport_factory(factory) -> None:
+    global _transport_factory
+    _transport_factory = factory
+
+
+_KEY_NAME = 'xsky-key'
+DEFAULT_IMAGE = 'ubuntu-22-04-x64'
+GPU_IMAGE = 'gpu-h100x1-base'  # DO's AI/ML-ready Ubuntu image slug
+
+
+class DoApi(nodepool.NodeApi):
+    provider_name = 'do'
+    ssh_user = 'root'
+    supports_stop = True
+    state_map = {
+        'new': 'PENDING',
+        'active': 'RUNNING',
+        'off': 'STOPPED',
+        'archive': None,
+    }
+
+    def __init__(self) -> None:
+        self.t = _transport_factory()
+
+    def _ensure_key(self) -> int:
+        for k in self.t.paged('/v2/account/keys', 'ssh_keys'):
+            if k.get('name') == _KEY_NAME:
+                return k['id']
+        import os
+        from skypilot_tpu import authentication
+        _, public_key_path = authentication.get_or_generate_keys()
+        with open(os.path.expanduser(public_key_path),
+                  encoding='utf-8') as f:
+            public_key = f.read().strip()
+        key = self.t.call('POST', '/v2/account/keys',
+                          {'name': _KEY_NAME, 'public_key': public_key})
+        return key['ssh_key']['id']
+
+    @staticmethod
+    def _row(droplet: Dict[str, Any]) -> Dict[str, Any]:
+        public_ip = private_ip = None
+        for net in (droplet.get('networks') or {}).get('v4', []):
+            if net.get('type') == 'public':
+                public_ip = net.get('ip_address')
+            elif net.get('type') == 'private':
+                private_ip = net.get('ip_address')
+        return {'id': droplet['id'], 'name': droplet.get('name', ''),
+                'status': droplet.get('status', ''),
+                'public_ip': public_ip, 'private_ip': private_ip}
+
+    def list_nodes(self) -> List[Dict[str, Any]]:
+        return [self._row(d)
+                for d in self.t.paged('/v2/droplets', 'droplets')]
+
+    def create_node(self, name: str, region: str, zone: Optional[str],
+                    node_config: Dict[str, Any]) -> str:
+        del zone  # flat regions
+        size = node_config['instance_type']
+        image = node_config.get('image_id') or (
+            GPU_IMAGE if size.startswith('gpu-') else DEFAULT_IMAGE)
+        droplet = self.t.call('POST', '/v2/droplets', {
+            'name': name,
+            'region': region,
+            'size': size,
+            'image': image,
+            'ssh_keys': [self._ensure_key()],
+            'tags': ['xsky'],
+        })
+        return str(droplet['droplet']['id'])
+
+    def delete_node(self, node_id: str) -> None:
+        self.t.call('DELETE', f'/v2/droplets/{node_id}')
+
+    def stop_node(self, node_id: str) -> None:
+        self.t.call('POST', f'/v2/droplets/{node_id}/actions',
+                    {'type': 'power_off'})
+
+    def start_node(self, node_id: str) -> None:
+        self.t.call('POST', f'/v2/droplets/{node_id}/actions',
+                    {'type': 'power_on'})
+
+    def classify(self, e: Exception,
+                 region: Optional[str] = None) -> Exception:
+        if isinstance(e, rest.DoApiError):
+            return rest.classify_error(e, region)
+        return e
+
+
+def _api(provider_config: Dict[str, Any]) -> DoApi:
+    del provider_config
+    return DoApi()
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    return nodepool.run_instances(_api(config.provider_config), region,
+                                  zone, cluster_name, config)
+
+
+def wait_instances(region: str, cluster_name: str, state: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   timeout_s: float = 900.0,
+                   poll_interval_s: float = 5.0) -> None:
+    del region
+    nodepool.wait_instances(_api(provider_config or {}), cluster_name,
+                            state, timeout_s, poll_interval_s)
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    nodepool.stop_instances(_api(provider_config), cluster_name)
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    nodepool.terminate_instances(_api(provider_config), cluster_name)
+
+
+def query_instances(cluster_name: str, provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    return nodepool.query_instances(_api(provider_config), cluster_name)
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Dict[str, Any]
+                     ) -> common.ClusterInfo:
+    del region
+    return nodepool.get_cluster_info(_api(provider_config), cluster_name,
+                                     provider_config)
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    # Droplets have no default cloud firewall: all ports already open.
+    del cluster_name, ports, provider_config
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    del cluster_name, provider_config

@@ -1,0 +1,124 @@
+"""DigitalOcean REST transport (bearer token, no SDK).
+
+Role twin of the reference's pydo-based client (sky/adaptors/do.py,
+sky/provision/do/utils.py), redesigned to this repo's transport
+pattern: `call()` with pagination (`links.pages.next`), bounded 429
+backoff, and typed error classification for the failover engine.
+Token from $DIGITALOCEAN_TOKEN or doctl's config
+(~/.config/doctl/config.yaml `access-token:` line).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+API_ENDPOINT = 'https://api.digitalocean.com'
+CREDENTIALS_PATH = '~/.config/doctl/config.yaml'
+_MAX_ATTEMPTS = 4
+_BACKOFF_S = 2.0
+
+
+class DoApiError(Exception):
+
+    def __init__(self, status: int, code: str, message: str) -> None:
+        super().__init__(f'{code or status}: {message}')
+        self.status = status
+        self.code = code or str(status)
+        self.message = message
+
+
+def load_token() -> Optional[str]:
+    token = os.environ.get('DIGITALOCEAN_TOKEN')
+    if token:
+        return token
+    path = os.path.expanduser(CREDENTIALS_PATH)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                stripped = line.strip()
+                if stripped.startswith('access-token:'):
+                    return stripped.split(':', 1)[1].strip().strip('\'"')
+    except OSError:
+        return None
+    return None
+
+
+def classify_error(e: DoApiError,
+                   region: Optional[str] = None) -> Exception:
+    text = f'{e.code} {e.message}'.lower()
+    where = f' in {region}' if region else ''
+    if 'not enough capacity' in text or 'is currently sold out' in text \
+            or 'no availability' in text:
+        return exceptions.CapacityError(f'DO capacity{where}: {e}')
+    if 'droplet_limit' in text or 'limit exceeded' in text:
+        return exceptions.QuotaExceededError(f'DO quota{where}: {e}')
+    if e.status in (401, 403):
+        return exceptions.PermissionError_(f'DO auth: {e}')
+    if e.status in (400, 422):
+        return exceptions.InvalidRequestError(f'DO request: {e}')
+    return exceptions.ProvisionError(f'DO API{where}: {e}')
+
+
+class Transport:
+
+    def __init__(self, token: Optional[str] = None) -> None:
+        token = token or load_token()
+        if not token:
+            raise exceptions.PermissionError_(
+                'DigitalOcean token not found (set $DIGITALOCEAN_TOKEN '
+                f'or populate {CREDENTIALS_PATH}).')
+        self._token = token
+
+    def call(self, method: str, path: str,
+             body: Optional[Dict[str, Any]] = None,
+             query: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        url = f'{API_ENDPOINT}{path}'
+        if query:
+            url += '?' + urllib.parse.urlencode(query)
+        data = json.dumps(body).encode() if body is not None else None
+        for attempt in range(_MAX_ATTEMPTS):
+            req = urllib.request.Request(
+                url, data=data, method=method,
+                headers={'Authorization': f'Bearer {self._token}',
+                         'Content-Type': 'application/json'})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    payload = resp.read()
+                    return json.loads(payload) if payload else {}
+            except urllib.error.HTTPError as e:
+                if e.code == 429 and attempt < _MAX_ATTEMPTS - 1:
+                    time.sleep(_BACKOFF_S * (attempt + 1))
+                    continue
+                try:
+                    err = json.loads(e.read() or b'{}')
+                    raise DoApiError(e.code, err.get('id', ''),
+                                     err.get('message', str(e)))
+                except (ValueError, AttributeError):
+                    raise DoApiError(e.code, '', str(e)) from e
+            except urllib.error.URLError as e:
+                raise exceptions.ProvisionError(
+                    f'DO API unreachable: {e}') from e
+        # Unreachable: every iteration returns or raises.
+
+    def paged(self, path: str, key: str,
+              query: Optional[Dict[str, Any]] = None) -> list:
+        """GET all pages of a list endpoint, following links.pages.next."""
+        out: list = []
+        query = dict(query or {}, per_page=200)
+        page = 1
+        while True:
+            reply = self.call('GET', path, query=dict(query, page=page))
+            out.extend(reply.get(key, []))
+            pages = (reply.get('links') or {}).get('pages') or {}
+            if not pages.get('next'):
+                return out
+            page += 1

@@ -1,0 +1,139 @@
+"""Fluidstack provisioner op-set (via the nodepool base).
+
+Behavioral twin of sky/provision/fluidstack/instance.py. Platform
+facts: GPU instances by gpu_type (H100_PCIE_80GB etc.), flat regions
+chosen by the scheduler (region is advisory), stop/start supported,
+one public IP, all ports open, no spot market. SSH key content is
+passed inline at create.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import nodepool
+from skypilot_tpu.provision.fluidstack import rest
+
+_transport_factory = rest.Transport
+
+
+def set_transport_factory(factory) -> None:
+    global _transport_factory
+    _transport_factory = factory
+
+
+class FluidstackApi(nodepool.NodeApi):
+    provider_name = 'fluidstack'
+    ssh_user = 'ubuntu'
+    supports_stop = True
+    state_map = {
+        'pending': 'PENDING',
+        'provisioning': 'PENDING',
+        'customizing': 'PENDING',
+        'starting': 'PENDING',
+        'running': 'RUNNING',
+        'stopping': 'STOPPING',
+        'stopped': 'STOPPED',
+        'terminated': None,
+        'failed': None,
+    }
+
+    def __init__(self) -> None:
+        self.t = _transport_factory()
+
+    @staticmethod
+    def _row(inst: Dict[str, Any]) -> Dict[str, Any]:
+        return {'id': inst['id'], 'name': inst.get('name', ''),
+                'status': inst.get('status', ''),
+                'public_ip': inst.get('ip_address'),
+                'private_ip': None}
+
+    def list_nodes(self) -> List[Dict[str, Any]]:
+        return [self._row(i)
+                for i in self.t.call('GET', '/instances') or []]
+
+    def create_node(self, name: str, region: str, zone: Optional[str],
+                    node_config: Dict[str, Any]) -> str:
+        del region, zone  # the platform schedules placement
+        import os
+        from skypilot_tpu import authentication
+        _, public_key_path = authentication.get_or_generate_keys()
+        with open(os.path.expanduser(public_key_path),
+                  encoding='utf-8') as f:
+            public_key = f.read().strip()
+        reply = self.t.call('POST', '/instances', {
+            'name': name,
+            'gpu_type': node_config['instance_type'],
+            'ssh_key': public_key,
+            'operating_system_label': 'ubuntu_22_04_lts_nvidia',
+        })
+        return str(reply['id'])
+
+    def delete_node(self, node_id: str) -> None:
+        self.t.call('DELETE', f'/instances/{node_id}')
+
+    def stop_node(self, node_id: str) -> None:
+        self.t.call('POST', f'/instances/{node_id}/stop')
+
+    def start_node(self, node_id: str) -> None:
+        self.t.call('POST', f'/instances/{node_id}/start')
+
+    def classify(self, e: Exception,
+                 region: Optional[str] = None) -> Exception:
+        if isinstance(e, rest.FluidstackApiError):
+            return rest.classify_error(e, region)
+        return e
+
+
+def _api(provider_config: Dict[str, Any]) -> FluidstackApi:
+    del provider_config
+    return FluidstackApi()
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    return nodepool.run_instances(_api(config.provider_config), region,
+                                  zone, cluster_name, config)
+
+
+def wait_instances(region: str, cluster_name: str, state: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   timeout_s: float = 900.0,
+                   poll_interval_s: float = 5.0) -> None:
+    del region
+    nodepool.wait_instances(_api(provider_config or {}), cluster_name,
+                            state, timeout_s, poll_interval_s)
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    nodepool.stop_instances(_api(provider_config), cluster_name)
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    nodepool.terminate_instances(_api(provider_config), cluster_name)
+
+
+def query_instances(cluster_name: str, provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    return nodepool.query_instances(_api(provider_config), cluster_name)
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Dict[str, Any]
+                     ) -> common.ClusterInfo:
+    del region
+    return nodepool.get_cluster_info(_api(provider_config), cluster_name,
+                                     provider_config)
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    # Fluidstack instances expose all ports on their public IP.
+    del cluster_name, ports, provider_config
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    del cluster_name, provider_config

@@ -1,0 +1,99 @@
+"""Fluidstack REST transport (api-key header, no SDK).
+
+Role twin of sky/provision/fluidstack/fluidstack_utils.py, on this
+repo's transport pattern. Key from $FLUIDSTACK_API_KEY or
+~/.fluidstack/api_key (the same path the reference reads).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+API_ENDPOINT = 'https://platform.fluidstack.io'
+CREDENTIALS_PATH = '~/.fluidstack/api_key'
+_MAX_ATTEMPTS = 4
+_BACKOFF_S = 2.0
+
+
+class FluidstackApiError(Exception):
+
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(f'{status}: {message}')
+        self.status = status
+        self.message = message
+
+
+def load_api_key() -> Optional[str]:
+    key = os.environ.get('FLUIDSTACK_API_KEY')
+    if key:
+        return key
+    path = os.path.expanduser(CREDENTIALS_PATH)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding='utf-8') as f:
+            return f.read().strip() or None
+    except OSError:
+        return None
+
+
+def classify_error(e: FluidstackApiError,
+                   region: Optional[str] = None) -> Exception:
+    text = e.message.lower()
+    where = f' in {region}' if region else ''
+    if 'no capacity' in text or 'out of stock' in text or \
+            'unavailable' in text:
+        return exceptions.CapacityError(f'Fluidstack capacity{where}: {e}')
+    if 'quota' in text or 'limit' in text:
+        return exceptions.QuotaExceededError(f'Fluidstack quota{where}: {e}')
+    if e.status in (401, 403):
+        return exceptions.PermissionError_(f'Fluidstack auth: {e}')
+    if e.status in (400, 422):
+        return exceptions.InvalidRequestError(f'Fluidstack request: {e}')
+    return exceptions.ProvisionError(f'Fluidstack API{where}: {e}')
+
+
+class Transport:
+
+    def __init__(self, api_key: Optional[str] = None) -> None:
+        key = api_key or load_api_key()
+        if not key:
+            raise exceptions.PermissionError_(
+                'Fluidstack API key not found (set $FLUIDSTACK_API_KEY '
+                f'or populate {CREDENTIALS_PATH}).')
+        self._key = key
+
+    def call(self, method: str, path: str,
+             body: Optional[Dict[str, Any]] = None) -> Any:
+        url = f'{API_ENDPOINT}{path}'
+        data = json.dumps(body).encode() if body is not None else None
+        for attempt in range(_MAX_ATTEMPTS):
+            req = urllib.request.Request(
+                url, data=data, method=method,
+                headers={'api-key': self._key,
+                         'Content-Type': 'application/json'})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    payload = resp.read()
+                    return json.loads(payload) if payload else {}
+            except urllib.error.HTTPError as e:
+                if e.code == 429 and attempt < _MAX_ATTEMPTS - 1:
+                    time.sleep(_BACKOFF_S * (attempt + 1))
+                    continue
+                try:
+                    err = json.loads(e.read() or b'{}')
+                    message = err.get('message') or err.get(
+                        'detail') or str(e)
+                    raise FluidstackApiError(e.code, str(message))
+                except (ValueError, AttributeError):
+                    raise FluidstackApiError(e.code, str(e)) from e
+            except urllib.error.URLError as e:
+                raise exceptions.ProvisionError(
+                    f'Fluidstack API unreachable: {e}') from e
+        # Unreachable: every iteration returns or raises.

@@ -1,0 +1,144 @@
+"""Hyperbolic provisioner op-set (via the nodepool base).
+
+Behavioral twin of sky/provision/hyperbolic/instance.py. Platform
+facts: a marketplace — renting creates an "instance" on some host
+node offering the GPU model; terminate-only (no stop/resume), ssh via
+a mapped public port on the host, flat placement (no regions — the
+catalog uses a single 'marketplace' region). The rented instance name
+is server-assigned; our cluster name rides the user-metadata field.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import nodepool
+from skypilot_tpu.provision.hyperbolic import rest
+
+_transport_factory = rest.Transport
+
+
+def set_transport_factory(factory) -> None:
+    global _transport_factory
+    _transport_factory = factory
+
+
+class HyperbolicApi(nodepool.NodeApi):
+    provider_name = 'hyperbolic'
+    ssh_user = 'ubuntu'
+    supports_stop = False
+    state_map = {
+        'starting': 'PENDING',
+        'creating': 'PENDING',
+        'online': 'RUNNING',
+        'running': 'RUNNING',
+        'ready': 'RUNNING',
+        'terminated': None,
+        'failed': None,
+        'offline': None,
+    }
+
+    def __init__(self) -> None:
+        self.t = _transport_factory()
+
+    @staticmethod
+    def _row(inst: Dict[str, Any]) -> Dict[str, Any]:
+        # Rented instances: {'id', 'instance': {'status', ...},
+        # 'sshCommand': 'ssh ubuntu@<host> -p <port>'}
+        body = inst.get('instance') or {}
+        ssh = inst.get('sshCommand') or ''
+        host = None
+        if '@' in ssh:
+            host = ssh.split('@', 1)[1].split()[0]
+        # `-p <port>` as a flag only — a '-p' inside the hostname
+        # (gpu-prod-3...) must not be mistaken for it.
+        port_match = re.search(r'\s-p\s+(\d+)', ssh)
+        return {'id': inst.get('id'),
+                'name': (inst.get('userMetadata') or {}).get('name', ''),
+                'status': body.get('status', ''),
+                'public_ip': host,
+                'private_ip': None,
+                'ssh_port': int(port_match.group(1))
+                if port_match else 22}
+
+    def list_nodes(self) -> List[Dict[str, Any]]:
+        reply = self.t.call('GET', '/v1/marketplace/instances')
+        return [self._row(i) for i in reply.get('instances', [])]
+
+    def create_node(self, name: str, region: str, zone: Optional[str],
+                    node_config: Dict[str, Any]) -> str:
+        del region, zone  # marketplace scheduling
+        itype = node_config['instance_type']
+        # Grammar `<count>x-<MODEL>` (e.g. 8x-H100-SXM).
+        count_s, _, model = itype.partition('x-')
+        reply = self.t.call('POST', '/v1/marketplace/instances/create', {
+            'gpuModel': model,
+            'gpuCount': int(count_s),
+            'userMetadata': {'name': name},
+        })
+        return str(reply.get('instanceId') or reply.get('id'))
+
+    def delete_node(self, node_id: str) -> None:
+        self.t.call('POST', '/v1/marketplace/instances/terminate',
+                    {'id': node_id})
+
+    def classify(self, e: Exception,
+                 region: Optional[str] = None) -> Exception:
+        if isinstance(e, rest.HyperbolicApiError):
+            return rest.classify_error(e, region)
+        return e
+
+
+def _api(provider_config: Dict[str, Any]) -> HyperbolicApi:
+    del provider_config
+    return HyperbolicApi()
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    return nodepool.run_instances(_api(config.provider_config), region,
+                                  zone, cluster_name, config)
+
+
+def wait_instances(region: str, cluster_name: str, state: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   timeout_s: float = 900.0,
+                   poll_interval_s: float = 5.0) -> None:
+    del region
+    nodepool.wait_instances(_api(provider_config or {}), cluster_name,
+                            state, timeout_s, poll_interval_s)
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    nodepool.stop_instances(_api(provider_config), cluster_name)
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    nodepool.terminate_instances(_api(provider_config), cluster_name)
+
+
+def query_instances(cluster_name: str, provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    return nodepool.query_instances(_api(provider_config), cluster_name)
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Dict[str, Any]
+                     ) -> common.ClusterInfo:
+    del region
+    return nodepool.get_cluster_info(_api(provider_config), cluster_name,
+                                     provider_config)
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    # Host port mappings are fixed at rent time on the marketplace.
+    del cluster_name, ports, provider_config
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    del cluster_name, provider_config

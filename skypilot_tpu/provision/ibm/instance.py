@@ -1,0 +1,359 @@
+"""IBM Cloud VPC provisioner op-set.
+
+Behavioral twin of sky/provision/ibm.py + the legacy node provider
+(sky/skylet/providers/ibm/) with this repo's conventions: the VPC API
+carries no freeform instance tags (tagging is a separate global
+service), so cluster membership rides the instance NAME
+(`<cluster>-<index>`) exactly like the Lambda provisioner — any process
+reconstructs the cluster from ListInstances cold.
+
+Platform facts encoded here:
+  * instances need a VPC + zonal subnet + SSH key id at create; the
+    provisioner resolves (or creates) an `xsky-vpc` with one subnet per
+    zone and registers the user's public key once;
+  * only the head node gets a floating IP (public); workers are
+    reached over the VPC — same pattern the reference uses
+    (one FIP per cluster head);
+  * stop/start are instance actions; `deleting` instances linger in
+    listings until gone;
+  * profiles encode shape (gx2-8x64x1v100 = 8 vCPU, 64 GiB, 1×V100);
+    there is no spot market on VPC gen2.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.ibm import rest
+
+logger = sky_logging.init_logger(__name__)
+
+_transport_factory = rest.Transport
+
+
+def set_transport_factory(factory) -> None:
+    global _transport_factory
+    _transport_factory = factory
+
+
+def _transport(provider_config: Dict[str, Any]) -> Any:
+    region = (provider_config or {}).get('region', 'us-south')
+    return _transport_factory(region)
+
+
+_STATE_MAP = {
+    'pending': 'PENDING',
+    'starting': 'PENDING',
+    'restarting': 'PENDING',
+    'running': 'RUNNING',
+    'stopping': 'STOPPING',
+    'stopped': 'STOPPED',
+    'deleting': None,
+    'failed': None,
+}
+
+_VPC_NAME = 'xsky-vpc'
+_KEY_NAME = 'xsky-key'
+
+
+def _instance_name(cluster_name: str, index: int) -> str:
+    return f'{cluster_name}-{index}'
+
+
+def _cluster_instances(t, cluster_name: str,
+                       include_deleting: bool = False
+                       ) -> List[Dict[str, Any]]:
+    out = []
+    for inst in t.paged('/instances', 'instances'):
+        name = inst.get('name') or ''
+        prefix, _, idx = name.rpartition('-')
+        if prefix != cluster_name or not idx.isdigit():
+            continue
+        if not include_deleting and \
+                inst.get('status') in ('deleting', 'failed'):
+            continue
+        out.append(inst)
+    return sorted(out, key=lambda i: int(i['name'].rsplit('-', 1)[1]))
+
+
+def _ensure_vpc(t, provider_config: Dict[str, Any]) -> str:
+    vpc_id = (provider_config or {}).get('vpc_id')
+    if vpc_id:
+        return vpc_id
+    for vpc in t.paged('/vpcs', 'vpcs'):
+        if vpc.get('name') == _VPC_NAME:
+            return vpc['id']
+    vpc = t.call('POST', '/vpcs', body={'name': _VPC_NAME})
+    return vpc['id']
+
+
+def _ensure_subnet(t, vpc_id: str, zone: str,
+                   provider_config: Dict[str, Any]) -> str:
+    subnet_id = (provider_config or {}).get('subnet_id')
+    if subnet_id:
+        return subnet_id
+    for s in t.paged('/subnets', 'subnets'):
+        if s.get('vpc', {}).get('id') == vpc_id and \
+                s.get('zone', {}).get('name') == zone:
+            return s['id']
+    subnet = t.call('POST', '/subnets', body={
+        'name': f'xsky-subnet-{zone}',
+        'vpc': {'id': vpc_id},
+        'zone': {'name': zone},
+        'total_ipv4_address_count': 256,
+    })
+    return subnet['id']
+
+
+def _ensure_key(t, public_key: Optional[str]) -> str:
+    for k in t.paged('/keys', 'keys'):
+        if k.get('name') == _KEY_NAME:
+            return k['id']
+    if public_key is None:
+        import os
+        from skypilot_tpu import authentication
+        _, public_key_path = authentication.get_or_generate_keys()
+        with open(os.path.expanduser(public_key_path),
+                  encoding='utf-8') as f:
+            public_key = f.read().strip()
+    key = t.call('POST', '/keys', body={'name': _KEY_NAME,
+                                        'public_key': public_key,
+                                        'type': 'rsa'})
+    return key['id']
+
+
+def _resolve_image(t, node_config: Dict[str, Any]) -> str:
+    image = node_config.get('image_id')
+    if image:
+        return image
+    images = [
+        img for img in t.paged('/images', 'images',
+                              query={'status': 'available'})
+        if img.get('operating_system', {}).get('name',
+                                               '').startswith('ubuntu')
+        and img.get('operating_system', {}).get('architecture') ==
+        'amd64'
+    ]
+    if not images:
+        raise exceptions.ProvisionError('No Ubuntu VPC image found.')
+    return sorted(images, key=lambda i: i.get('name', ''))[-1]['id']
+
+
+def _primary_nic_id(inst: Dict[str, Any]) -> Optional[str]:
+    nic = inst.get('primary_network_interface') or {}
+    return nic.get('id')
+
+
+def _ensure_head_fip(t, inst: Dict[str, Any], cluster_name: str) -> None:
+    """Attach a floating IP to the head's primary NIC (idempotent)."""
+    nic_id = _primary_nic_id(inst)
+    if nic_id is None:
+        return
+    fip_name = f'xsky-fip-{cluster_name}'
+    for fip in t.paged('/floating_ips', 'floating_ips'):
+        if fip.get('name') == fip_name:
+            if (fip.get('target') or {}).get('id') != nic_id:
+                t.call('PATCH', f'/floating_ips/{fip["id"]}',
+                       body={'target': {'id': nic_id}})
+            return
+    t.call('POST', '/floating_ips',
+           body={'name': fip_name, 'target': {'id': nic_id}})
+
+
+def _head_fip(t, cluster_name: str) -> Optional[str]:
+    for fip in t.paged('/floating_ips', 'floating_ips'):
+        if fip.get('name') == f'xsky-fip-{cluster_name}':
+            return fip.get('address')
+    return None
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    t = _transport(dict(config.provider_config or {}, region=region))
+    node_cfg = config.node_config
+    zone = zone or f'{region}-1'
+    try:
+        existing = _cluster_instances(t, cluster_name)
+        resumed: List[str] = []
+        for inst in existing:
+            if inst.get('status') == 'stopped':
+                t.call('POST', f'/instances/{inst["id"]}/actions',
+                       body={'type': 'start'})
+                resumed.append(inst['id'])
+        taken = {int(i['name'].rsplit('-', 1)[1]) for i in existing}
+        missing = sorted(set(range(config.count)) - taken)
+        created: List[str] = []
+        if missing:
+            vpc_id = _ensure_vpc(t, config.provider_config)
+            subnet_id = _ensure_subnet(t, vpc_id, zone,
+                                       config.provider_config)
+            key_id = _ensure_key(t, node_cfg.get('ssh_public_key'))
+            image_id = _resolve_image(t, node_cfg)
+            for node in missing:
+                body: Dict[str, Any] = {
+                    'name': _instance_name(cluster_name, node),
+                    'zone': {'name': zone},
+                    'profile': {'name': node_cfg['instance_type']},
+                    'image': {'id': image_id},
+                    'vpc': {'id': vpc_id},
+                    'primary_network_interface': {
+                        'name': 'eth0',
+                        'subnet': {'id': subnet_id},
+                    },
+                    'keys': [{'id': key_id}],
+                    'boot_volume_attachment': {
+                        'volume': {
+                            'capacity': node_cfg.get('disk_size', 100),
+                            'profile': {'name': 'general-purpose'},
+                        },
+                        'delete_volume_on_instance_delete': True,
+                    },
+                }
+                rg = (config.provider_config or {}).get(
+                    'resource_group_id')
+                if rg:
+                    body['resource_group'] = {'id': rg}
+                inst = t.call('POST', '/instances', body=body)
+                created.append(inst['id'])
+        # Head public reachability: floating IP on node 0.
+        for inst in _cluster_instances(t, cluster_name):
+            if inst['name'].endswith('-0'):
+                _ensure_head_fip(t, inst, cluster_name)
+                head = inst['id']
+                break
+        else:
+            head = None
+    except rest.IbmApiError as e:
+        raise rest.classify_error(e, region) from e
+    return common.ProvisionRecord(
+        provider_name='ibm', cluster_name=cluster_name, region=region,
+        zone=zone, resumed_instance_ids=resumed,
+        created_instance_ids=created,
+        head_instance_id=head)
+
+
+def wait_instances(region: str, cluster_name: str, state: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   timeout_s: float = 900.0,
+                   poll_interval_s: float = 5.0) -> None:
+    t = _transport(dict(provider_config or {}, region=region))
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        instances = _cluster_instances(t, cluster_name,
+                                       include_deleting=True)
+        states = [_STATE_MAP.get(i.get('status', ''), 'PENDING')
+                  for i in instances]
+        if any(s is None for s in states):
+            raise exceptions.CapacityError(
+                f'Instance(s) of {cluster_name!r} died while waiting '
+                f'for {state}.')
+        if instances and all(s == state for s in states):
+            return
+        time.sleep(poll_interval_s)
+    raise exceptions.ProvisionError(
+        f'IBM cluster {cluster_name!r} did not reach {state} within '
+        f'{timeout_s}s.')
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    t = _transport(provider_config)
+    try:
+        for inst in _cluster_instances(t, cluster_name):
+            if inst.get('status') == 'running':
+                t.call('POST', f'/instances/{inst["id"]}/actions',
+                       body={'type': 'stop'})
+    except rest.IbmApiError as e:
+        raise rest.classify_error(e) from e
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    t = _transport(provider_config)
+    try:
+        for inst in _cluster_instances(t, cluster_name):
+            t.call('DELETE', f'/instances/{inst["id"]}')
+        # Release the head floating IP with the cluster.
+        for fip in t.paged('/floating_ips', 'floating_ips'):
+            if fip.get('name') == f'xsky-fip-{cluster_name}':
+                t.call('DELETE', f'/floating_ips/{fip["id"]}')
+    except rest.IbmApiError as e:
+        raise rest.classify_error(e) from e
+
+
+def query_instances(cluster_name: str, provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    t = _transport(provider_config)
+    return {
+        i['id']: _STATE_MAP.get(i.get('status', ''), 'PENDING')
+        for i in _cluster_instances(t, cluster_name,
+                                    include_deleting=True)
+    }
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Dict[str, Any]
+                     ) -> common.ClusterInfo:
+    t = _transport(dict(provider_config or {}, region=region))
+    head_fip = _head_fip(t, cluster_name)
+    instances: Dict[str, common.InstanceInfo] = {}
+    head_id = None
+    for inst in _cluster_instances(t, cluster_name):
+        index = int(inst['name'].rsplit('-', 1)[1])
+        nic = inst.get('primary_network_interface') or {}
+        private_ip = (nic.get('primary_ip') or {}).get('address', '')
+        state = _STATE_MAP.get(inst.get('status', ''), 'PENDING')
+        instances[inst['id']] = common.InstanceInfo(
+            instance_id=inst['id'],
+            internal_ip=private_ip,
+            external_ip=head_fip if index == 0 else None,
+            status=state or 'TERMINATED',
+            tags={'cluster': cluster_name, 'node_index': str(index)},
+            slice_id=inst['id'],
+            host_index=0,
+        )
+        if index == 0:
+            head_id = inst['id']
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head_id,
+        provider_name='ibm',
+        provider_config=dict(provider_config or {}),
+        ssh_user='ubuntu')
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    """Add inbound rules to the VPC's default security group."""
+    t = _transport(provider_config)
+    try:
+        vpc_id = _ensure_vpc(t, provider_config)
+        vpc = t.call('GET', f'/vpcs/{vpc_id}')
+        sg_id = (vpc.get('default_security_group') or {}).get('id')
+        if not sg_id:
+            raise exceptions.ProvisionError(
+                'IBM VPC has no default security group to open ports.')
+        existing = t.call(
+            'GET', f'/security_groups/{sg_id}/rules').get('rules', [])
+        have = {(r.get('port_min'), r.get('port_max'))
+                for r in existing if r.get('direction') == 'inbound'}
+        for spec in ports:
+            lo, _, hi = str(spec).partition('-')
+            lo, hi = int(lo), int(hi or lo)
+            if (lo, hi) in have:
+                continue
+            t.call('POST', f'/security_groups/{sg_id}/rules', body={
+                'direction': 'inbound', 'protocol': 'tcp',
+                'port_min': lo, 'port_max': hi,
+                'remote': {'cidr_block': '0.0.0.0/0'}})
+    except rest.IbmApiError as e:
+        raise rest.classify_error(e) from e
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    # Rules live on the shared xsky VPC default SG; clusters share it,
+    # so per-cluster cleanup would break neighbors. No-op by design.
+    del cluster_name, provider_config

@@ -1,0 +1,194 @@
+"""Nebius AI Cloud provisioner op-set (via the nodepool base).
+
+Behavioral twin of sky/provision/nebius/instance.py. Platform facts:
+instances live under a project in one region (eu-north1 etc.); GPU
+platforms (gpu-h100-sxm / gpu-h200-sxm / gpu-l40s-a) carry a preset
+`<gpus>gpu-<vcpus>vcpu-<mem>gb`; stop/start supported; cloud-init
+injects the SSH key; one public IP when requested; no spot market on
+the public API surface.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import nodepool
+from skypilot_tpu.provision.nebius import rest
+
+_transport_factory = rest.Transport
+
+
+def set_transport_factory(factory) -> None:
+    global _transport_factory
+    _transport_factory = factory
+
+
+class NebiusApi(nodepool.NodeApi):
+    provider_name = 'nebius'
+    ssh_user = 'ubuntu'
+    supports_stop = True
+    state_map = {
+        'creating': 'PENDING',
+        'starting': 'PENDING',
+        'provisioning': 'PENDING',
+        'running': 'RUNNING',
+        'stopping': 'STOPPING',
+        'stopped': 'STOPPED',
+        'deleting': None,
+        'deleted': None,
+        'error': None,
+    }
+
+    def __init__(self, region: str) -> None:
+        self.t = _transport_factory(region)
+
+    @property
+    def _base(self) -> str:
+        return '/compute/v1/instances'
+
+    @staticmethod
+    def _row(inst: Dict[str, Any]) -> Dict[str, Any]:
+        status_obj = inst.get('status') or {}
+        status = status_obj.get('state', '') \
+            if isinstance(status_obj, dict) else str(status_obj)
+        meta = inst.get('metadata') or {}
+        # The REST gateway emits proto3-JSON camelCase
+        # (networkInterfaces / publicIpAddress / ipAddress) — the same
+        # casing create_node writes; accept snake_case too for safety.
+        nics = []
+        if isinstance(status_obj, dict):
+            nics = status_obj.get('networkInterfaces') or \
+                status_obj.get('network_interfaces') or []
+        public_ip = private_ip = None
+        for nic in nics:
+            addr = ((nic.get('publicIpAddress') or
+                     nic.get('public_ip_address') or {}).get('address'))
+            if addr:
+                public_ip = addr.split('/')[0]
+            addr = ((nic.get('ipAddress') or
+                     nic.get('ip_address') or {}).get('address'))
+            if addr:
+                private_ip = addr.split('/')[0]
+        return {'id': meta.get('id') or inst.get('id'),
+                'name': meta.get('name') or inst.get('name', ''),
+                'status': str(status),
+                'public_ip': public_ip, 'private_ip': private_ip}
+
+    def list_nodes(self) -> List[Dict[str, Any]]:
+        # pageToken pagination: never hide nodes past page one.
+        out: List[Dict[str, Any]] = []
+        token: Optional[str] = None
+        while True:
+            query = {'parentId': self.t.project, 'pageSize': 100}
+            if token:
+                query['pageToken'] = token
+            reply = self.t.call('GET', self._base, query=query)
+            out.extend(self._row(i) for i in reply.get('items', []))
+            token = reply.get('nextPageToken')
+            if not token:
+                return out
+
+    def create_node(self, name: str, region: str, zone: Optional[str],
+                    node_config: Dict[str, Any]) -> str:
+        del region, zone  # transport is already regional
+        import os
+        from skypilot_tpu import authentication
+        _, public_key_path = authentication.get_or_generate_keys()
+        with open(os.path.expanduser(public_key_path),
+                  encoding='utf-8') as f:
+            public_key = f.read().strip()
+        itype = node_config['instance_type']
+        # Grammar `<platform>:<preset>` (e.g.
+        # gpu-h100-sxm:8gpu-128vcpu-1600gb).
+        platform, _, preset = itype.partition(':')
+        cloud_init = ('users:\n'
+                      '  - name: ubuntu\n'
+                      '    sudo: ALL=(ALL) NOPASSWD:ALL\n'
+                      '    ssh_authorized_keys:\n'
+                      f'      - {public_key}\n')
+        reply = self.t.call('POST', self._base, body={
+            'metadata': {'parentId': self.t.project, 'name': name},
+            'spec': {
+                'resources': {'platform': platform, 'preset': preset},
+                'bootDisk': {
+                    'sizeGibibytes': node_config.get('disk_size', 100),
+                    'imageFamily': node_config.get('image_id') or
+                    'ubuntu22.04-cuda12',
+                },
+                'networkInterfaces': [{
+                    'name': 'eth0',
+                    'publicIpAddress': {},
+                }],
+                'cloudInitUserData': cloud_init,
+            },
+        })
+        meta = reply.get('metadata') or {}
+        return str(meta.get('resourceId') or meta.get('id') or name)
+
+    def delete_node(self, node_id: str) -> None:
+        self.t.call('DELETE', f'{self._base}/{node_id}')
+
+    def stop_node(self, node_id: str) -> None:
+        self.t.call('POST', f'{self._base}/{node_id}:stop')
+
+    def start_node(self, node_id: str) -> None:
+        self.t.call('POST', f'{self._base}/{node_id}:start')
+
+    def classify(self, e: Exception,
+                 region: Optional[str] = None) -> Exception:
+        if isinstance(e, rest.NebiusApiError):
+            return rest.classify_error(e, region)
+        return e
+
+
+def _api(provider_config: Dict[str, Any]) -> NebiusApi:
+    return NebiusApi((provider_config or {}).get('region', 'eu-north1'))
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    api = NebiusApi(region)
+    return nodepool.run_instances(api, region, zone, cluster_name, config)
+
+
+def wait_instances(region: str, cluster_name: str, state: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   timeout_s: float = 900.0,
+                   poll_interval_s: float = 5.0) -> None:
+    api = NebiusApi(region)
+    nodepool.wait_instances(api, cluster_name, state, timeout_s,
+                            poll_interval_s)
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    nodepool.stop_instances(_api(provider_config), cluster_name)
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    nodepool.terminate_instances(_api(provider_config), cluster_name)
+
+
+def query_instances(cluster_name: str, provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    return nodepool.query_instances(_api(provider_config), cluster_name)
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Dict[str, Any]
+                     ) -> common.ClusterInfo:
+    api = NebiusApi(region)
+    return nodepool.get_cluster_info(api, cluster_name, provider_config)
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    # Nebius security groups default to open egress/ingress on the
+    # public IP for project VMs; per-port management is project-level.
+    del cluster_name, ports, provider_config
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    del cluster_name, provider_config

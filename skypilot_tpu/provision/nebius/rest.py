@@ -1,0 +1,124 @@
+"""Nebius AI Cloud REST transport.
+
+Role twin of the nebius SDK use in sky/provision/nebius/ (the
+reference drives the official gRPC SDK; this repo's dependency-free
+stance uses Nebius's REST gateway instead — same resources: Compute
+instances + disks under a project/parent id). Auth: a static IAM token
+from $NEBIUS_IAM_TOKEN or ~/.nebius/credentials (the token file the
+nebius CLI writes); project id from $NEBIUS_PROJECT_ID or
+~/.nebius/NEBIUS_PROJECT_ID.txt.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+API_ENDPOINT = 'https://api.{region}.nebius.cloud'
+TOKEN_PATH = '~/.nebius/credentials'
+PROJECT_PATH = '~/.nebius/NEBIUS_PROJECT_ID.txt'
+_MAX_ATTEMPTS = 4
+_BACKOFF_S = 2.0
+
+
+class NebiusApiError(Exception):
+
+    def __init__(self, status: int, code: str, message: str) -> None:
+        super().__init__(f'{code or status}: {message}')
+        self.status = status
+        self.code = code or str(status)
+        self.message = message
+
+
+def _read_first_line(path: str) -> Optional[str]:
+    path = os.path.expanduser(path)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding='utf-8') as f:
+            return f.readline().strip() or None
+    except OSError:
+        return None
+
+
+def load_credentials() -> Optional[Tuple[str, str]]:
+    """(iam_token, project_id) from env or the nebius CLI files."""
+    token = os.environ.get('NEBIUS_IAM_TOKEN') or \
+        _read_first_line(TOKEN_PATH)
+    project = os.environ.get('NEBIUS_PROJECT_ID') or \
+        _read_first_line(PROJECT_PATH)
+    if token and project:
+        return token, project
+    return None
+
+
+def classify_error(e: NebiusApiError,
+                   region: Optional[str] = None) -> Exception:
+    text = f'{e.code} {e.message}'.lower()
+    where = f' in {region}' if region else ''
+    if 'resource_exhausted' in text or 'not enough capacity' in text or \
+            'no capacity' in text:
+        return exceptions.CapacityError(f'Nebius capacity{where}: {e}')
+    if 'quota' in text:
+        return exceptions.QuotaExceededError(f'Nebius quota{where}: {e}')
+    if e.status in (401, 403):
+        return exceptions.PermissionError_(f'Nebius auth: {e}')
+    if e.status == 400 or 'invalid_argument' in text:
+        return exceptions.InvalidRequestError(f'Nebius request: {e}')
+    return exceptions.ProvisionError(f'Nebius API{where}: {e}')
+
+
+class Transport:
+
+    def __init__(self, region: str = 'eu-north1',
+                 token: Optional[str] = None,
+                 project: Optional[str] = None) -> None:
+        if token is None or project is None:
+            creds = load_credentials()
+            if creds is None:
+                raise exceptions.PermissionError_(
+                    'Nebius credentials not found (set '
+                    '$NEBIUS_IAM_TOKEN + $NEBIUS_PROJECT_ID or run '
+                    '`nebius init`).')
+            token, project = creds
+        self._token = token
+        self.project = project
+        self.region = region
+
+    def call(self, method: str, path: str,
+             body: Optional[Dict[str, Any]] = None,
+             query: Optional[Dict[str, Any]] = None) -> Any:
+        url = API_ENDPOINT.format(region=self.region) + path
+        if query:
+            url += '?' + urllib.parse.urlencode(
+                {k: v for k, v in query.items() if v is not None})
+        data = json.dumps(body).encode() if body is not None else None
+        for attempt in range(_MAX_ATTEMPTS):
+            req = urllib.request.Request(
+                url, data=data, method=method,
+                headers={'Authorization': f'Bearer {self._token}',
+                         'Content-Type': 'application/json'})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    payload = resp.read()
+                    return json.loads(payload) if payload else {}
+            except urllib.error.HTTPError as e:
+                if e.code in (429, 503) and attempt < _MAX_ATTEMPTS - 1:
+                    time.sleep(_BACKOFF_S * (attempt + 1))
+                    continue
+                try:
+                    err = json.loads(e.read() or b'{}')
+                    raise NebiusApiError(e.code, err.get('code', ''),
+                                         str(err.get('message', str(e))))
+                except (ValueError, AttributeError):
+                    raise NebiusApiError(e.code, '', str(e)) from e
+            except urllib.error.URLError as e:
+                raise exceptions.ProvisionError(
+                    f'Nebius API unreachable: {e}') from e
+        # Unreachable: every iteration returns or raises.

@@ -1,0 +1,204 @@
+"""Shared op-set for flat "node pool" marketplace clouds.
+
+Nebius / DigitalOcean / Fluidstack / Paperspace / Cudo / Hyperbolic all
+expose the same minimal surface: named nodes you create/list/delete
+(sometimes stop/start), flat regions, one public IP per node, all ports
+open or fixed at create. The reference re-implements that op-set per
+cloud (sky/provision/{do,fluidstack,paperspace,cudo,nebius,hyperbolic}/
+instance.py — six near-identical files); here the lifecycle logic
+lives once, over a small per-cloud ``NodeApi`` adapter.
+
+Cluster membership rides the node NAME (`<cluster>-<index>`), stored
+server-side, so any process reconstructs a cluster from a plain
+listing — the same convention as the Lambda/Vast provisioners.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+
+logger = sky_logging.init_logger(__name__)
+
+
+class NodeApi:
+    """Per-cloud adapter: raw node CRUD + state vocabulary.
+
+    list_nodes() rows are dicts with at least:
+      id, name, status, public_ip (optional), private_ip (optional).
+    """
+
+    provider_name: str = ''
+    ssh_user: str = 'ubuntu'
+    # provider status string -> PENDING/RUNNING/STOPPING/STOPPED/None.
+    state_map: Dict[str, Optional[str]] = {}
+    supports_stop: bool = False
+
+    def list_nodes(self) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def create_node(self, name: str, region: str, zone: Optional[str],
+                    node_config: Dict[str, Any]) -> str:
+        raise NotImplementedError
+
+    def delete_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def stop_node(self, node_id: str) -> None:
+        raise exceptions.NotSupportedError(
+            f'{self.provider_name} nodes cannot stop; terminate '
+            'instead (`xsky down`).')
+
+    def start_node(self, node_id: str) -> None:
+        raise exceptions.NotSupportedError(
+            f'{self.provider_name} nodes cannot restart.')
+
+    # Optional hook: map a provider error to the typed taxonomy. The
+    # default trusts the api to raise typed exceptions already.
+    def classify(self, e: Exception,
+                 region: Optional[str] = None) -> Exception:
+        return e
+
+    def state_of(self, node: Dict[str, Any]) -> Optional[str]:
+        return self.state_map.get(str(node.get('status', '')).lower(),
+                                  'PENDING')
+
+
+def _node_name(cluster_name: str, index: int) -> str:
+    return f'{cluster_name}-{index}'
+
+
+def _cluster_nodes(api: NodeApi, cluster_name: str,
+                   include_dead: bool = False) -> List[Dict[str, Any]]:
+    out = []
+    for node in api.list_nodes():
+        name = node.get('name') or ''
+        prefix, _, idx = name.rpartition('-')
+        if prefix != cluster_name or not idx.isdigit():
+            continue
+        if not include_dead and api.state_of(node) is None:
+            continue
+        out.append(node)
+    return sorted(out, key=lambda n: int(n['name'].rsplit('-', 1)[1]))
+
+
+def run_instances(api: NodeApi, region: str, zone: Optional[str],
+                  cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    try:
+        existing = _cluster_nodes(api, cluster_name)
+        resumed: List[str] = []
+        if api.supports_stop:
+            for node in existing:
+                if api.state_of(node) == 'STOPPED':
+                    api.start_node(node['id'])
+                    resumed.append(str(node['id']))
+        # Fill index gaps, not just the tail: a node killed out-of-band
+        # must be recreated under its own index.
+        taken = {int(n['name'].rsplit('-', 1)[1]) for n in existing}
+        missing = sorted(set(range(config.count)) - taken)
+        created: List[str] = []
+        for index in missing:
+            created.append(api.create_node(
+                _node_name(cluster_name, index), region, zone,
+                config.node_config))
+    except Exception as e:  # pylint: disable=broad-except
+        classified = api.classify(e, region)
+        if classified is not e:
+            raise classified from e
+        raise
+    head = None
+    for node in _cluster_nodes(api, cluster_name):
+        if node['name'].endswith('-0'):
+            head = str(node['id'])
+    return common.ProvisionRecord(
+        provider_name=api.provider_name, cluster_name=cluster_name,
+        region=region, zone=zone, resumed_instance_ids=resumed,
+        created_instance_ids=[str(c) for c in created],
+        head_instance_id=head)
+
+
+def wait_instances(api: NodeApi, cluster_name: str, state: str,
+                   timeout_s: float = 900.0,
+                   poll_interval_s: float = 5.0) -> None:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        nodes = _cluster_nodes(api, cluster_name, include_dead=True)
+        states = [api.state_of(n) for n in nodes]
+        if any(s is None for s in states):
+            raise exceptions.CapacityError(
+                f'Instance(s) of {cluster_name!r} died while waiting '
+                f'for {state}.')
+        if nodes and all(s == state for s in states):
+            return
+        time.sleep(poll_interval_s)
+    raise exceptions.ProvisionError(
+        f'Cluster {cluster_name!r} did not reach {state} within '
+        f'{timeout_s}s.')
+
+
+def stop_instances(api: NodeApi, cluster_name: str) -> None:
+    if not api.supports_stop:
+        raise exceptions.NotSupportedError(
+            f'{api.provider_name} nodes cannot stop; terminate instead '
+            '(`xsky down`).')
+    try:
+        for node in _cluster_nodes(api, cluster_name):
+            if api.state_of(node) == 'RUNNING':
+                api.stop_node(node['id'])
+    except Exception as e:  # pylint: disable=broad-except
+        classified = api.classify(e)
+        if classified is not e:
+            raise classified from e
+        raise
+
+
+def terminate_instances(api: NodeApi, cluster_name: str) -> None:
+    try:
+        for node in _cluster_nodes(api, cluster_name, include_dead=True):
+            api.delete_node(node['id'])
+    except Exception as e:  # pylint: disable=broad-except
+        classified = api.classify(e)
+        if classified is not e:
+            raise classified from e
+        raise
+
+
+def query_instances(api: NodeApi, cluster_name: str
+                    ) -> Dict[str, Optional[str]]:
+    return {str(n['id']): api.state_of(n)
+            for n in _cluster_nodes(api, cluster_name, include_dead=True)}
+
+
+def get_cluster_info(api: NodeApi, cluster_name: str,
+                     provider_config: Dict[str, Any]
+                     ) -> common.ClusterInfo:
+    instances: Dict[str, common.InstanceInfo] = {}
+    head_id = None
+    for node in _cluster_nodes(api, cluster_name):
+        index = int(node['name'].rsplit('-', 1)[1])
+        state = api.state_of(node)
+        info = common.InstanceInfo(
+            instance_id=str(node['id']),
+            internal_ip=node.get('private_ip') or
+            node.get('public_ip', ''),
+            external_ip=node.get('public_ip'),
+            status=state or 'TERMINATED',
+            tags={'cluster': cluster_name, 'node_index': str(index)},
+            slice_id=str(node['id']),
+            host_index=0,
+            # Marketplaces (Hyperbolic, Vast-style) ssh on a mapped
+            # host port, not 22.
+            ssh_port=int(node.get('ssh_port', 22)),
+        )
+        instances[str(node['id'])] = info
+        if index == 0:
+            head_id = str(node['id'])
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head_id,
+        provider_name=api.provider_name,
+        provider_config=dict(provider_config or {}),
+        ssh_user=api.ssh_user)

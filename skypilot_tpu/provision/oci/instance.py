@@ -182,10 +182,12 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
         taken = {int((i.get('freeformTags') or {}).get(NODE_TAG, '-1'))
                  for i in existing}
         # Restart any stopped members first (idempotent relaunch).
+        resumed: List[str] = []
         for inst in existing:
             if inst.get('lifecycleState') == 'STOPPED':
                 t.call('POST', f'/instances/{inst["id"]}',
                        query={'action': 'START'})
+                resumed.append(inst['id'])
         missing = sorted(set(range(config.count)) - taken)
         created: List[str] = []
         if missing:
@@ -240,7 +242,8 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
         raise rest.classify_error(e, region) from e
     return common.ProvisionRecord(
         provider_name='oci', cluster_name=cluster_name, region=region,
-        zone=zone, resumed_instance_ids=[], created_instance_ids=created,
+        zone=zone, resumed_instance_ids=resumed,
+        created_instance_ids=created,
         head_instance_id=head)
 
 

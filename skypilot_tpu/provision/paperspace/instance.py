@@ -1,0 +1,165 @@
+"""Paperspace provisioner op-set (via the nodepool base).
+
+Behavioral twin of sky/provision/paperspace/instance.py. Platform
+facts: machines by machineType (A100-80G, H100 etc.) in coarse
+regions (ny2/ca1/ams1), stop/start supported, dynamic public IP, all
+ports open, no spot market. Startup script injects the SSH key (the
+API has no key-registry endpoint for machines).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import nodepool
+from skypilot_tpu.provision.paperspace import rest
+
+_transport_factory = rest.Transport
+
+
+def set_transport_factory(factory) -> None:
+    global _transport_factory
+    _transport_factory = factory
+
+
+# Public Ubuntu 22.04 ML-in-a-Box template.
+DEFAULT_TEMPLATE = 'tkni3aa4'
+
+
+class PaperspaceApi(nodepool.NodeApi):
+    provider_name = 'paperspace'
+    ssh_user = 'paperspace'
+    supports_stop = True
+    state_map = {
+        'provisioning': 'PENDING',
+        'starting': 'PENDING',
+        'restarting': 'PENDING',
+        'upgrading': 'PENDING',
+        'ready': 'RUNNING',
+        'stopping': 'STOPPING',
+        'off': 'STOPPED',
+        'serviceready': 'PENDING',
+    }
+
+    def __init__(self) -> None:
+        self.t = _transport_factory()
+
+    @staticmethod
+    def _row(m: Dict[str, Any]) -> Dict[str, Any]:
+        return {'id': m['id'], 'name': m.get('name', ''),
+                'status': m.get('state', ''),
+                'public_ip': m.get('publicIp'),
+                'private_ip': m.get('privateIp')}
+
+    def list_nodes(self) -> List[Dict[str, Any]]:
+        # Cursor pagination (hasMore/nextPage): an account with many
+        # machines must not hide cluster nodes past page one.
+        out: List[Dict[str, Any]] = []
+        after: Optional[str] = None
+        while True:
+            query = {'limit': 100}
+            if after:
+                query['after'] = after
+            reply = self.t.call('GET', '/machines', query=query)
+            out.extend(self._row(m) for m in reply.get('items', []))
+            if not reply.get('hasMore'):
+                return out
+            after = reply.get('nextPage')
+            if not after:
+                return out
+
+    def create_node(self, name: str, region: str, zone: Optional[str],
+                    node_config: Dict[str, Any]) -> str:
+        del zone
+        import os
+        from skypilot_tpu import authentication
+        _, public_key_path = authentication.get_or_generate_keys()
+        with open(os.path.expanduser(public_key_path),
+                  encoding='utf-8') as f:
+            public_key = f.read().strip()
+        startup = ('#!/bin/bash\n'
+                   'mkdir -p /home/paperspace/.ssh\n'
+                   f"echo '{public_key}' >> "
+                   '/home/paperspace/.ssh/authorized_keys\n'
+                   'chown -R paperspace:paperspace /home/paperspace/.ssh\n')
+        reply = self.t.call('POST', '/machines', {
+            'name': name,
+            'machineType': node_config['instance_type'],
+            'templateId': node_config.get('image_id') or DEFAULT_TEMPLATE,
+            'region': region,
+            'diskSize': node_config.get('disk_size', 100),
+            'publicIpType': 'dynamic',
+            'startOnCreate': True,
+            'startupScript': startup,
+        })
+        data = reply.get('data') or reply
+        return str(data['id'])
+
+    def delete_node(self, node_id: str) -> None:
+        self.t.call('DELETE', f'/machines/{node_id}')
+
+    def stop_node(self, node_id: str) -> None:
+        self.t.call('PATCH', f'/machines/{node_id}/stop')
+
+    def start_node(self, node_id: str) -> None:
+        self.t.call('PATCH', f'/machines/{node_id}/start')
+
+    def classify(self, e: Exception,
+                 region: Optional[str] = None) -> Exception:
+        if isinstance(e, rest.PaperspaceApiError):
+            return rest.classify_error(e, region)
+        return e
+
+
+def _api(provider_config: Dict[str, Any]) -> PaperspaceApi:
+    del provider_config
+    return PaperspaceApi()
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    return nodepool.run_instances(_api(config.provider_config), region,
+                                  zone, cluster_name, config)
+
+
+def wait_instances(region: str, cluster_name: str, state: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   timeout_s: float = 900.0,
+                   poll_interval_s: float = 5.0) -> None:
+    del region
+    nodepool.wait_instances(_api(provider_config or {}), cluster_name,
+                            state, timeout_s, poll_interval_s)
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    nodepool.stop_instances(_api(provider_config), cluster_name)
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    nodepool.terminate_instances(_api(provider_config), cluster_name)
+
+
+def query_instances(cluster_name: str, provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    return nodepool.query_instances(_api(provider_config), cluster_name)
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Dict[str, Any]
+                     ) -> common.ClusterInfo:
+    del region
+    return nodepool.get_cluster_info(_api(provider_config), cluster_name,
+                                     provider_config)
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    # Paperspace machines expose all ports on their public IP.
+    del cluster_name, ports, provider_config
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    del cluster_name, provider_config

@@ -1,0 +1,103 @@
+"""Paperspace (DigitalOcean Gradient) REST transport.
+
+Role twin of sky/provision/paperspace/utils.py on this repo's
+transport pattern. Key from $PAPERSPACE_API_KEY or
+~/.paperspace/config.json ({"apiKey": "..."}).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+API_ENDPOINT = 'https://api.paperspace.com/v1'
+CREDENTIALS_PATH = '~/.paperspace/config.json'
+_MAX_ATTEMPTS = 4
+_BACKOFF_S = 2.0
+
+
+class PaperspaceApiError(Exception):
+
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(f'{status}: {message}')
+        self.status = status
+        self.message = message
+
+
+def load_api_key() -> Optional[str]:
+    key = os.environ.get('PAPERSPACE_API_KEY')
+    if key:
+        return key
+    path = os.path.expanduser(CREDENTIALS_PATH)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding='utf-8') as f:
+            return json.load(f).get('apiKey')
+    except (OSError, ValueError):
+        return None
+
+
+def classify_error(e: PaperspaceApiError,
+                   region: Optional[str] = None) -> Exception:
+    text = e.message.lower()
+    where = f' in {region}' if region else ''
+    if 'out of capacity' in text or 'no machine available' in text or \
+            'insufficient capacity' in text:
+        return exceptions.CapacityError(f'Paperspace capacity{where}: {e}')
+    if 'quota' in text or 'limit' in text:
+        return exceptions.QuotaExceededError(
+            f'Paperspace quota{where}: {e}')
+    if e.status in (401, 403):
+        return exceptions.PermissionError_(f'Paperspace auth: {e}')
+    if e.status in (400, 422):
+        return exceptions.InvalidRequestError(f'Paperspace request: {e}')
+    return exceptions.ProvisionError(f'Paperspace API{where}: {e}')
+
+
+class Transport:
+
+    def __init__(self, api_key: Optional[str] = None) -> None:
+        key = api_key or load_api_key()
+        if not key:
+            raise exceptions.PermissionError_(
+                'Paperspace API key not found (set $PAPERSPACE_API_KEY '
+                f'or populate {CREDENTIALS_PATH}).')
+        self._key = key
+
+    def call(self, method: str, path: str,
+             body: Optional[Dict[str, Any]] = None,
+             query: Optional[Dict[str, Any]] = None) -> Any:
+        url = f'{API_ENDPOINT}{path}'
+        if query:
+            url += '?' + urllib.parse.urlencode(query)
+        data = json.dumps(body).encode() if body is not None else None
+        for attempt in range(_MAX_ATTEMPTS):
+            req = urllib.request.Request(
+                url, data=data, method=method,
+                headers={'Authorization': f'Bearer {self._key}',
+                         'Content-Type': 'application/json'})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    payload = resp.read()
+                    return json.loads(payload) if payload else {}
+            except urllib.error.HTTPError as e:
+                if e.code == 429 and attempt < _MAX_ATTEMPTS - 1:
+                    time.sleep(_BACKOFF_S * (attempt + 1))
+                    continue
+                try:
+                    err = json.loads(e.read() or b'{}')
+                    message = err.get('message') or str(e)
+                    raise PaperspaceApiError(e.code, str(message))
+                except (ValueError, AttributeError):
+                    raise PaperspaceApiError(e.code, str(e)) from e
+            except urllib.error.URLError as e:
+                raise exceptions.ProvisionError(
+                    f'Paperspace API unreachable: {e}') from e
+        # Unreachable: every iteration returns or raises.

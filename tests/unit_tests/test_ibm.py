@@ -1,0 +1,220 @@
+"""IBM VPC provisioner tests against an in-memory API fake."""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.ibm import instance as ibm_instance
+from skypilot_tpu.provision.ibm import rest
+
+
+class FakeIbm:
+    """Minimal in-memory IBM VPC Gen2 API."""
+
+    def __init__(self) -> None:
+        self.region = 'us-south'
+        self.vpcs: Dict[str, Dict[str, Any]] = {}
+        self.subnets: Dict[str, Dict[str, Any]] = {}
+        self.keys: Dict[str, Dict[str, Any]] = {}
+        self.instances: Dict[str, Dict[str, Any]] = {}
+        self.fips: Dict[str, Dict[str, Any]] = {}
+        self.sg_rules: Dict[str, list] = {}
+        self.fail_create: Optional[rest.IbmApiError] = None
+        self._next = 0
+
+    def _id(self, kind: str) -> str:
+        self._next += 1
+        return f'{kind}-{self._next:04d}'
+
+    def paged(self, path: str, key: str, query=None):
+        return self.call('GET', path, query=query).get(key, [])
+
+    def call(self, method: str, path: str, body=None, query=None):
+        if path == '/vpcs' and method == 'GET':
+            return {'vpcs': list(self.vpcs.values())}
+        if path == '/vpcs' and method == 'POST':
+            vid = self._id('vpc')
+            sg_id = self._id('sg')
+            self.sg_rules[sg_id] = []
+            vpc = dict(body, id=vid,
+                       default_security_group={'id': sg_id})
+            self.vpcs[vid] = vpc
+            return vpc
+        if path.startswith('/vpcs/'):
+            return self.vpcs[path.split('/')[2]]
+        if path == '/subnets' and method == 'GET':
+            return {'subnets': list(self.subnets.values())}
+        if path == '/subnets' and method == 'POST':
+            sid = self._id('subnet')
+            subnet = dict(body, id=sid)
+            self.subnets[sid] = subnet
+            return subnet
+        if path == '/keys' and method == 'GET':
+            return {'keys': list(self.keys.values())}
+        if path == '/keys' and method == 'POST':
+            kid = self._id('key')
+            key = dict(body, id=kid)
+            self.keys[kid] = key
+            return key
+        if path == '/images':
+            return {'images': [
+                {'id': 'img-ubuntu-2204',
+                 'name': 'ibm-ubuntu-22-04-4',
+                 'operating_system': {'name': 'ubuntu-22-04-amd64',
+                                      'architecture': 'amd64'}}]}
+        if path == '/instances' and method == 'GET':
+            return {'instances': list(self.instances.values())}
+        if path == '/instances' and method == 'POST':
+            if self.fail_create is not None:
+                err, self.fail_create = self.fail_create, None
+                raise err
+            iid = self._id('inst')
+            n = self._next
+            inst = dict(body, id=iid, status='running',
+                        primary_network_interface={
+                            'id': f'nic-{iid}',
+                            'primary_ip': {'address': f'10.240.0.{n}'}})
+            self.instances[iid] = inst
+            return inst
+        if path.endswith('/actions') and method == 'POST':
+            iid = path.split('/')[2]
+            if body['type'] == 'stop':
+                self.instances[iid]['status'] = 'stopped'
+            else:
+                self.instances[iid]['status'] = 'running'
+            return {}
+        if path.startswith('/instances/') and method == 'DELETE':
+            self.instances.pop(path.split('/')[2], None)
+            return {}
+        if path == '/floating_ips' and method == 'GET':
+            return {'floating_ips': list(self.fips.values())}
+        if path == '/floating_ips' and method == 'POST':
+            fid = self._id('fip')
+            fip = dict(body, id=fid, address=f'169.63.0.{self._next}')
+            self.fips[fid] = fip
+            return fip
+        if path.startswith('/floating_ips/') and method == 'PATCH':
+            self.fips[path.split('/')[2]].update(body)
+            return {}
+        if path.startswith('/floating_ips/') and method == 'DELETE':
+            self.fips.pop(path.split('/')[2], None)
+            return {}
+        if path.endswith('/rules') and method == 'GET':
+            return {'rules': list(self.sg_rules[path.split('/')[2]])}
+        if path.endswith('/rules') and method == 'POST':
+            self.sg_rules[path.split('/')[2]].append(body)
+            return body
+        raise AssertionError(f'unhandled IBM call {method} {path}')
+
+
+@pytest.fixture()
+def fake_ibm(monkeypatch, tmp_path):
+    fake = FakeIbm()
+    monkeypatch.setattr(ibm_instance, '_transport_factory',
+                        lambda region: fake)
+    from skypilot_tpu import authentication
+    monkeypatch.setattr(authentication, 'PRIVATE_KEY_PATH',
+                        str(tmp_path / 'key'))
+    monkeypatch.setattr(authentication, 'PUBLIC_KEY_PATH',
+                        str(tmp_path / 'key.pub'))
+    yield fake
+
+
+PROVIDER: Dict[str, Any] = {'region': 'us-south'}
+
+
+def _config(count=1, itype='gx2-8x64x1v100'):
+    return common.ProvisionConfig(
+        provider_config=dict(PROVIDER),
+        node_config={'instance_type': itype, 'disk_size': 100,
+                     'ssh_public_key': 'ssh-ed25519 AAAA test'},
+        count=count)
+
+
+def test_launch_lifecycle(fake_ibm):
+    record = ibm_instance.run_instances('us-south', 'us-south-1', 'c1',
+                                        _config(count=2))
+    assert len(record.created_instance_ids) == 2
+    # VPC + zonal subnet + key registered exactly once.
+    assert len(fake_ibm.vpcs) == 1
+    assert len(fake_ibm.subnets) == 1
+    assert len(fake_ibm.keys) == 1
+    # Head (and only head) carries the floating IP.
+    info = ibm_instance.get_cluster_info('us-south', 'c1', PROVIDER)
+    hosts = info.sorted_instances()
+    assert hosts[0].external_ip and hosts[1].external_ip is None
+    assert all(h.internal_ip for h in hosts)
+    ibm_instance.terminate_instances('c1', PROVIDER)
+    assert ibm_instance.query_instances('c1', PROVIDER) == {}
+    assert not fake_ibm.fips  # FIP released with the cluster
+
+
+def test_idempotent_relaunch_reuses_network(fake_ibm):
+    ibm_instance.run_instances('us-south', 'us-south-1', 'c2', _config())
+    record = ibm_instance.run_instances('us-south', 'us-south-1', 'c2',
+                                        _config())
+    assert record.created_instance_ids == []
+    assert len(fake_ibm.vpcs) == 1 and len(fake_ibm.subnets) == 1
+
+
+def test_stop_resume(fake_ibm):
+    ibm_instance.run_instances('us-south', 'us-south-1', 'c3', _config())
+    ibm_instance.stop_instances('c3', PROVIDER)
+    assert set(ibm_instance.query_instances('c3', PROVIDER).values()) == \
+        {'STOPPED'}
+    ibm_instance.run_instances('us-south', 'us-south-1', 'c3', _config())
+    assert set(ibm_instance.query_instances('c3', PROVIDER).values()) == \
+        {'RUNNING'}
+
+
+def test_capacity_error_classified(fake_ibm):
+    fake_ibm.fail_create = rest.IbmApiError(
+        409, 'over_capacity',
+        'Insufficient capacity in zone us-south-1.')
+    with pytest.raises(exceptions.CapacityError):
+        ibm_instance.run_instances('us-south', 'us-south-1', 'c4',
+                                   _config())
+
+
+def test_open_ports_on_default_sg(fake_ibm):
+    ibm_instance.run_instances('us-south', 'us-south-1', 'c5', _config())
+    ibm_instance.open_ports('c5', ['8080', '9000-9010'], PROVIDER)
+    ibm_instance.open_ports('c5', ['8080'], PROVIDER)  # idempotent
+    sg_id = next(iter(fake_ibm.sg_rules))
+    rules = fake_ibm.sg_rules[sg_id]
+    assert len(rules) == 2
+    assert {(r['port_min'], r['port_max']) for r in rules} == \
+        {(8080, 8080), (9000, 9010)}
+
+
+def test_cloud_feasibility_and_pricing():
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.utils import registry
+    cloud = registry.CLOUD_REGISTRY.from_str('ibm')
+    r = resources_lib.Resources(accelerators='V100:1')
+    feasible, _ = cloud.get_feasible_launchable_resources(r)
+    assert feasible
+    assert feasible[0].instance_type == 'gx2-8x64x1v100'
+    assert feasible[0].get_hourly_cost() == pytest.approx(2.54)
+    # No spot market.
+    regions = cloud.regions_with_offering('gx2-8x64x1v100', None,
+                                          use_spot=True, region=None,
+                                          zone=None)
+    assert regions == []
+
+
+def test_check_credentials(monkeypatch, tmp_path):
+    from skypilot_tpu.utils import registry
+    cloud = registry.CLOUD_REGISTRY.from_str('ibm')
+    monkeypatch.delenv('IBM_API_KEY', raising=False)
+    monkeypatch.setattr(rest, 'CREDENTIALS_PATH',
+                        str(tmp_path / 'credentials.yaml'))
+    ok, reason = cloud.check_credentials()
+    assert not ok and 'IBM_API_KEY' in reason
+    (tmp_path / 'credentials.yaml').write_text(
+        'iam_api_key: abc123\nresource_group_id: rg1\n')
+    ok, _ = cloud.check_credentials()
+    assert ok

@@ -1,0 +1,373 @@
+"""Lifecycle + feasibility tests for the nodepool marketplace clouds
+(DigitalOcean, Fluidstack, Paperspace, Cudo, Nebius, Hyperbolic).
+
+One in-memory fake transport per provider API dialect; the shared
+lifecycle assertions run through each cloud's real instance module, so
+the per-cloud adapters (field mapping, create bodies, state vocab) are
+what is actually under test.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.cudo import instance as cudo_instance
+from skypilot_tpu.provision.do import instance as do_instance
+from skypilot_tpu.provision.fluidstack import instance as fs_instance
+from skypilot_tpu.provision.hyperbolic import instance as hb_instance
+from skypilot_tpu.provision.nebius import instance as nb_instance
+from skypilot_tpu.provision.paperspace import instance as ps_instance
+
+
+@pytest.fixture(autouse=True)
+def _keys(monkeypatch, tmp_path):
+    from skypilot_tpu import authentication
+    monkeypatch.setattr(authentication, 'PRIVATE_KEY_PATH',
+                        str(tmp_path / 'key'))
+    monkeypatch.setattr(authentication, 'PUBLIC_KEY_PATH',
+                        str(tmp_path / 'key.pub'))
+
+
+class FakeDo:
+
+    def __init__(self) -> None:
+        self.droplets: Dict[int, Dict[str, Any]] = {}
+        self.keys: list = []
+        self._next = 0
+
+    def paged(self, path, key, query=None):
+        if key == 'ssh_keys':
+            return list(self.keys)
+        return list(self.droplets.values())
+
+    def call(self, method, path, body=None, query=None):
+        if path == '/v2/account/keys':
+            self.keys.append(dict(body, id=77))
+            return {'ssh_key': {'id': 77}}
+        if path == '/v2/droplets' and method == 'POST':
+            self._next += 1
+            d = {'id': self._next, 'name': body['name'],
+                 'status': 'active',
+                 'networks': {'v4': [
+                     {'type': 'public',
+                      'ip_address': f'164.90.0.{self._next}'},
+                     {'type': 'private',
+                      'ip_address': f'10.108.0.{self._next}'}]}}
+            self.droplets[self._next] = d
+            return {'droplet': d}
+        if path.startswith('/v2/droplets/') and method == 'DELETE':
+            self.droplets.pop(int(path.split('/')[3]), None)
+            return {}
+        if path.endswith('/actions'):
+            did = int(path.split('/')[3])
+            self.droplets[did]['status'] = (
+                'off' if body['type'] == 'power_off' else 'active')
+            return {}
+        raise AssertionError(f'unhandled DO call {method} {path}')
+
+
+class FakeFluidstack:
+
+    def __init__(self) -> None:
+        self.instances: Dict[str, Dict[str, Any]] = {}
+        self._next = 0
+
+    def call(self, method, path, body=None):
+        if path == '/instances' and method == 'GET':
+            return list(self.instances.values())
+        if path == '/instances' and method == 'POST':
+            self._next += 1
+            iid = f'fs-{self._next}'
+            self.instances[iid] = {
+                'id': iid, 'name': body['name'], 'status': 'running',
+                'ip_address': f'38.99.0.{self._next}'}
+            return {'id': iid}
+        if method == 'DELETE':
+            self.instances.pop(path.split('/')[2], None)
+            return {}
+        if path.endswith('/stop'):
+            self.instances[path.split('/')[2]]['status'] = 'stopped'
+            return {}
+        if path.endswith('/start'):
+            self.instances[path.split('/')[2]]['status'] = 'running'
+            return {}
+        raise AssertionError(f'unhandled FS call {method} {path}')
+
+
+class FakePaperspace:
+
+    def __init__(self) -> None:
+        self.machines: Dict[str, Dict[str, Any]] = {}
+        self._next = 0
+
+    def call(self, method, path, body=None, query=None):
+        if path == '/machines' and method == 'GET':
+            return {'items': list(self.machines.values())}
+        if path == '/machines' and method == 'POST':
+            self._next += 1
+            mid = f'psn{self._next}'
+            self.machines[mid] = {
+                'id': mid, 'name': body['name'], 'state': 'ready',
+                'publicIp': f'74.82.0.{self._next}',
+                'privateIp': f'10.1.0.{self._next}'}
+            return {'data': {'id': mid}}
+        if method == 'DELETE':
+            self.machines.pop(path.split('/')[2], None)
+            return {}
+        if path.endswith('/stop'):
+            self.machines[path.split('/')[2]]['state'] = 'off'
+            return {}
+        if path.endswith('/start'):
+            self.machines[path.split('/')[2]]['state'] = 'ready'
+            return {}
+        raise AssertionError(f'unhandled PS call {method} {path}')
+
+
+class FakeCudo:
+    project = 'proj1'
+
+    def __init__(self) -> None:
+        self.vms: Dict[str, Dict[str, Any]] = {}
+        self._next = 0
+
+    def call(self, method, path, body=None):
+        base = f'/projects/{self.project}/vms'
+        if path == base and method == 'GET':
+            return {'VMs': list(self.vms.values())}
+        if path == base and method == 'POST':
+            self._next += 1
+            vm = {'id': body['vmId'], 'shortState': 'active',
+                  'nics': [{'externalIpAddress': f'185.0.0.{self._next}',
+                            'internalIpAddress': f'10.3.0.{self._next}'}]}
+            self.vms[body['vmId']] = vm
+            return vm
+        if path.endswith('/terminate'):
+            self.vms.pop(path.split('/')[4], None)
+            return {}
+        if path.endswith('/stop'):
+            self.vms[path.split('/')[4]]['shortState'] = 'stopped'
+            return {}
+        if path.endswith('/start'):
+            self.vms[path.split('/')[4]]['shortState'] = 'active'
+            return {}
+        raise AssertionError(f'unhandled Cudo call {method} {path}')
+
+
+class FakeNebius:
+    project = 'project-e0abc'
+
+    def __init__(self) -> None:
+        self.instances: Dict[str, Dict[str, Any]] = {}
+        self._next = 0
+
+    def call(self, method, path, body=None, query=None):
+        base = '/compute/v1/instances'
+        if path == base and method == 'GET':
+            return {'items': list(self.instances.values())}
+        if path == base and method == 'POST':
+            self._next += 1
+            iid = f'computeinstance-{self._next}'
+            self.instances[iid] = {
+                'metadata': {'id': iid,
+                             'name': body['metadata']['name']},
+                'status': {
+                    'state': 'RUNNING',
+                    'network_interfaces': [{
+                        'public_ip_address': {
+                            'address': f'195.242.0.{self._next}/32'},
+                        'ip_address': {
+                            'address': f'192.168.0.{self._next}/24'},
+                    }]},
+            }
+            return {'metadata': {'resourceId': iid}}
+        if method == 'DELETE':
+            self.instances.pop(path.split('/')[-1], None)
+            return {}
+        if path.endswith(':stop'):
+            iid = path.split('/')[-1].split(':')[0]
+            self.instances[iid]['status']['state'] = 'STOPPED'
+            return {}
+        if path.endswith(':start'):
+            iid = path.split('/')[-1].split(':')[0]
+            self.instances[iid]['status']['state'] = 'RUNNING'
+            return {}
+        raise AssertionError(f'unhandled Nebius call {method} {path}')
+
+
+class FakeHyperbolic:
+
+    def __init__(self) -> None:
+        self.rentals: Dict[str, Dict[str, Any]] = {}
+        self._next = 0
+
+    def call(self, method, path, body=None):
+        if path == '/v1/marketplace/instances':
+            return {'instances': list(self.rentals.values())}
+        if path == '/v1/marketplace/instances/create':
+            self._next += 1
+            iid = f'rental-{self._next}'
+            self.rentals[iid] = {
+                'id': iid,
+                'userMetadata': dict(body['userMetadata']),
+                'instance': {'status': 'online'},
+                'sshCommand': f'ssh ubuntu@host{self._next}.hb.xyz '
+                              f'-p 3100{self._next}'}
+            return {'instanceId': iid}
+        if path == '/v1/marketplace/instances/terminate':
+            self.rentals.pop(body['id'], None)
+            return {}
+        raise AssertionError(f'unhandled HB call {method} {path}')
+
+
+CASES = [
+    ('do', do_instance, FakeDo, 'gpu-h100x1-80gb', 'nyc2', True),
+    ('fluidstack', fs_instance, FakeFluidstack, 'H100_PCIE_80GB',
+     'marketplace', True),
+    ('paperspace', ps_instance, FakePaperspace, 'A100-80G', 'ny2', True),
+    ('cudo', cudo_instance, FakeCudo,
+     'epyc-genoa-h100_1xH100', 'us-newyork-1', True),
+    ('nebius', nb_instance, FakeNebius,
+     'gpu-h100-sxm:1gpu-16vcpu-200gb', 'eu-north1', True),
+    ('hyperbolic', hb_instance, FakeHyperbolic, '1x-H100-SXM',
+     'marketplace', False),
+]
+
+
+def _config(itype, count=2):
+    return common.ProvisionConfig(
+        provider_config={}, node_config={'instance_type': itype,
+                                         'disk_size': 100},
+        count=count)
+
+
+@pytest.mark.parametrize('name,mod,fake_cls,itype,region,can_stop',
+                         CASES, ids=[c[0] for c in CASES])
+def test_lifecycle(monkeypatch, name, mod, fake_cls, itype, region,
+                   can_stop):
+    fake = fake_cls()
+    monkeypatch.setattr(mod, '_transport_factory',
+                        lambda *a, **k: fake)
+    count = 1 if name == 'hyperbolic' else 2
+    record = mod.run_instances(region, None, 'c1', _config(itype, count))
+    assert len(record.created_instance_ids) == count
+    assert record.head_instance_id is not None
+    info = mod.get_cluster_info(region, 'c1', {})
+    assert info.num_instances == count
+    hosts = info.sorted_instances()
+    assert info.head_instance_id == hosts[0].instance_id
+    assert all(h.external_ip for h in hosts)
+    if name == 'hyperbolic':
+        # Marketplace ssh rides the mapped host port, not 22.
+        assert hosts[0].ssh_port == 31001
+    statuses = mod.query_instances('c1', {})
+    assert set(statuses.values()) == {'RUNNING'}
+    # Idempotent relaunch: nothing new created.
+    record = mod.run_instances(region, None, 'c1', _config(itype, count))
+    assert record.created_instance_ids == []
+    if can_stop:
+        mod.stop_instances('c1', {})
+        assert set(mod.query_instances('c1', {}).values()) == {'STOPPED'}
+        mod.run_instances(region, None, 'c1', _config(itype, count))
+        assert set(mod.query_instances('c1', {}).values()) == {'RUNNING'}
+    else:
+        with pytest.raises(exceptions.NotSupportedError):
+            mod.stop_instances('c1', {})
+    mod.terminate_instances('c1', {})
+    assert mod.query_instances('c1', {}) == {}
+
+
+@pytest.mark.parametrize('cloud_name,acc,expect_itype,price', [
+    ('do', 'H100:1', 'gpu-h100x1-80gb', 3.39),
+    ('fluidstack', 'H100:1', 'H100_PCIE_80GB', 2.49),
+    ('paperspace', 'A100-80GB:1', 'A100-80G', 3.18),
+    ('cudo', 'H100:1', 'epyc-genoa-h100_1xH100', 2.79),
+    ('nebius', 'H100:1', 'gpu-h100-sxm:1gpu-16vcpu-200gb', 2.95),
+    ('hyperbolic', 'H100-SXM:1', '1x-H100-SXM', 1.49),
+])
+def test_feasibility_and_pricing(cloud_name, acc, expect_itype, price):
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.utils import registry
+    cloud = registry.CLOUD_REGISTRY.from_str(cloud_name)
+    r = resources_lib.Resources(accelerators=acc)
+    feasible, _ = cloud.get_feasible_launchable_resources(r)
+    assert feasible, f'{cloud_name} found nothing for {acc}'
+    assert feasible[0].instance_type == expect_itype
+    assert feasible[0].get_hourly_cost() == pytest.approx(price)
+    # None of these have a spot market.
+    regions = cloud.regions_with_offering(expect_itype, None,
+                                          use_spot=True, region=None,
+                                          zone=None)
+    assert regions == []
+
+
+@pytest.mark.parametrize('cloud_name,env', [
+    ('do', 'DIGITALOCEAN_TOKEN'),
+    ('fluidstack', 'FLUIDSTACK_API_KEY'),
+    ('paperspace', 'PAPERSPACE_API_KEY'),
+    ('hyperbolic', 'HYPERBOLIC_API_KEY'),
+])
+def test_check_credentials_env(monkeypatch, tmp_path, cloud_name, env):
+    import importlib
+    from skypilot_tpu.utils import registry
+    cloud = registry.CLOUD_REGISTRY.from_str(cloud_name)
+    rest = importlib.import_module(
+        f'skypilot_tpu.provision.{cloud.provisioner_module}.rest')
+    monkeypatch.delenv(env, raising=False)
+    monkeypatch.setattr(rest, 'CREDENTIALS_PATH', str(tmp_path / 'nope'))
+    ok, reason = cloud.check_credentials()
+    assert not ok and env in reason
+    monkeypatch.setenv(env, 'k-123')
+    ok, _ = cloud.check_credentials()
+    assert ok
+
+
+def test_cudo_nebius_credentials(monkeypatch, tmp_path):
+    from skypilot_tpu.provision.cudo import rest as cudo_rest
+    from skypilot_tpu.provision.nebius import rest as nb_rest
+    monkeypatch.delenv('CUDO_API_KEY', raising=False)
+    monkeypatch.delenv('CUDO_PROJECT_ID', raising=False)
+    monkeypatch.setattr(cudo_rest, 'CREDENTIALS_PATH',
+                        str(tmp_path / 'cudo.yml'))
+    assert cudo_rest.load_credentials() is None
+    (tmp_path / 'cudo.yml').write_text('key: abc\nproject: proj1\n')
+    assert cudo_rest.load_credentials() == ('abc', 'proj1')
+    monkeypatch.delenv('NEBIUS_IAM_TOKEN', raising=False)
+    monkeypatch.delenv('NEBIUS_PROJECT_ID', raising=False)
+    monkeypatch.setattr(nb_rest, 'TOKEN_PATH', str(tmp_path / 'tok'))
+    monkeypatch.setattr(nb_rest, 'PROJECT_PATH', str(tmp_path / 'proj'))
+    assert nb_rest.load_credentials() is None
+    (tmp_path / 'tok').write_text('iam-token-xyz\n')
+    (tmp_path / 'proj').write_text('project-e0abc\n')
+    assert nb_rest.load_credentials() == ('iam-token-xyz',
+                                          'project-e0abc')
+
+
+def test_capacity_classification():
+    """Each dialect's stockout phrasing maps to CapacityError."""
+    from skypilot_tpu.provision.cudo import rest as cudo_rest
+    from skypilot_tpu.provision.do import rest as do_rest
+    from skypilot_tpu.provision.fluidstack import rest as fs_rest
+    from skypilot_tpu.provision.hyperbolic import rest as hb_rest
+    from skypilot_tpu.provision.nebius import rest as nb_rest
+    from skypilot_tpu.provision.paperspace import rest as ps_rest
+    cases = [
+        (do_rest.classify_error,
+         do_rest.DoApiError(422, 'unprocessable_entity',
+                            'region is currently sold out')),
+        (fs_rest.classify_error,
+         fs_rest.FluidstackApiError(400, 'No capacity for H100')),
+        (ps_rest.classify_error,
+         ps_rest.PaperspaceApiError(400, 'Out of capacity for A100')),
+        (cudo_rest.classify_error,
+         cudo_rest.CudoApiError(400, 'no host available')),
+        (nb_rest.classify_error,
+         nb_rest.NebiusApiError(429, 'RESOURCE_EXHAUSTED',
+                                'not enough capacity')),
+        (hb_rest.classify_error,
+         hb_rest.HyperbolicApiError(400, 'No available nodes')),
+    ]
+    for classify, err in cases:
+        assert isinstance(classify(err), exceptions.CapacityError), err
