@@ -1,9 +1,11 @@
 """Slot-based inference engine: jitted prefill / insert / decode.
 
 Architecture (JetStream-style, TPU-first):
-  * A fixed pool of ``max_slots`` decode slots shares one KV cache
-    [L, slots, max_len, KVH, HD] — static shapes, so the decode step
-    compiles once and every iteration hits the cache.
+  * A fixed pool of ``max_slots`` decode slots shares one KV cache —
+    [L, slots, max_len, KVH, HD] by default, or a family-declared
+    layout via the ``kv_cache_shapes`` hook (MLA's compressed latent).
+    Static shapes either way, so the decode step compiles once and
+    every iteration hits the cache.
   * Prefill runs per-request at a padded bucket length (few compiles),
     returns the prefix KV, which `insert` writes into a free slot.
   * Decode advances ALL slots one token per step; inactive slots decode
@@ -59,10 +61,10 @@ class InferenceEngine:
         from skypilot_tpu import models
         self._model_lib = models.module_for(config.model)
         # Any family exposing prefill_hidden/decode_forward/lm_logits
-        # plugs into the slot engine — all four in-tree families
-        # (llama, qwen, gemma incl. its tied soft-capped head, moe) do.
-        # A future family missing the trio is rejected up front rather
-        # than failing mid-serve.
+        # plugs into the slot engine — all five in-tree families
+        # (llama, qwen, gemma incl. its tied soft-capped head, moe,
+        # deepseek with its compressed MLA cache) do. A family missing
+        # the trio is rejected up front rather than failing mid-serve.
         needed = ('prefill_hidden', 'decode_forward', 'lm_logits')
         if not all(hasattr(self._model_lib, fn) for fn in needed):
             raise NotImplementedError(
@@ -77,12 +79,30 @@ class InferenceEngine:
         self.mesh = mesh
         self._key = jax.random.PRNGKey(0)
         c = config.model
-        self._kv_shape = (c.n_layers, config.max_slots,
-                          config.max_target_len, c.n_kv_heads, c.head_dim)
+        if hasattr(self._model_lib, 'kv_cache_shapes'):
+            # Families with a non-[KVH, HD] cache layout (MLA's
+            # compressed latent) declare their own shapes.
+            self._k_shape, self._v_shape = self._model_lib.kv_cache_shapes(
+                c, config.max_slots, config.max_target_len)
+            if config.kv_dtype == jnp.int8:
+                raise NotImplementedError(
+                    'int8 KV is not supported for families with a '
+                    'custom cache layout (the compressed MLA cache is '
+                    'already ~20x smaller than a dense KV cache).')
+        else:
+            self._k_shape = self._v_shape = (
+                c.n_layers, config.max_slots, config.max_target_len,
+                c.n_kv_heads, c.head_dim)
         if mesh is not None:
-            self._kv_sharding = NamedSharding(
-                mesh, PartitionSpec(None, ('data', 'fsdp'), None, 'tensor',
-                                    None))
+            if hasattr(self._model_lib, 'kv_cache_shapes'):
+                # Custom layouts (MLA: one latent "head") cannot shard
+                # the head axis; split slots only.
+                kv_spec = PartitionSpec(None, ('data', 'fsdp'), None,
+                                        None, None)
+            else:
+                kv_spec = PartitionSpec(None, ('data', 'fsdp'), None,
+                                        'tensor', None)
+            self._kv_sharding = NamedSharding(mesh, kv_spec)
             self._rep = NamedSharding(mesh, PartitionSpec())
         else:
             self._kv_sharding = None
@@ -94,13 +114,13 @@ class InferenceEngine:
     def _kv_quantized(self) -> bool:
         return self.config.kv_dtype == jnp.int8
 
-    def _make_cache(self, kv_kwargs):
+    def _make_cache(self, shape, kv_kwargs):
         """One cache entry: plain array, or (int8, fp32 scale) pair."""
         cfg = self.config
         if not self._kv_quantized:
-            return jnp.zeros(self._kv_shape, cfg.kv_dtype, **kv_kwargs)
-        scale_shape = self._kv_shape[:-1] + (1,)
-        return (jnp.zeros(self._kv_shape, jnp.int8, **kv_kwargs),
+            return jnp.zeros(shape, cfg.kv_dtype, **kv_kwargs)
+        scale_shape = shape[:-1] + (1,)
+        return (jnp.zeros(shape, jnp.int8, **kv_kwargs),
                 jnp.zeros(scale_shape, jnp.float32, **kv_kwargs))
 
     def init_decode_state(self) -> Dict[str, Any]:
@@ -109,8 +129,8 @@ class InferenceEngine:
         if self._kv_sharding is not None:
             kv_kwargs['device'] = self._kv_sharding
         state = {
-            'kv_k': self._make_cache(kv_kwargs),
-            'kv_v': self._make_cache(kv_kwargs),
+            'kv_k': self._make_cache(self._k_shape, kv_kwargs),
+            'kv_v': self._make_cache(self._v_shape, kv_kwargs),
             # per-slot: index the NEXT token will be written at
             'lengths': jnp.zeros((cfg.max_slots,), jnp.int32),
             'tokens': jnp.zeros((cfg.max_slots,), jnp.int32),

@@ -11,11 +11,15 @@ from typing import Any
 
 
 def module_for(config: Any):
-    """Return the model module (llama/moe/gemma/qwen) owning `config`."""
+    """Return the model module (llama/moe/gemma/qwen/deepseek) owning
+    `config`."""
+    from skypilot_tpu.models import deepseek
     from skypilot_tpu.models import gemma
     from skypilot_tpu.models import llama
     from skypilot_tpu.models import moe
     from skypilot_tpu.models import qwen
+    if isinstance(config, deepseek.DeepSeekConfig):
+        return deepseek
     if isinstance(config, moe.MoEConfig):
         return moe
     if isinstance(config, llama.LlamaConfig):
@@ -29,11 +33,12 @@ def module_for(config: Any):
 
 def get_config(name: str):
     """Look up a named config across all model families."""
+    from skypilot_tpu.models import deepseek
     from skypilot_tpu.models import gemma
     from skypilot_tpu.models import llama
     from skypilot_tpu.models import moe
     from skypilot_tpu.models import qwen
-    families = (llama, moe, gemma, qwen)
+    families = (llama, moe, gemma, qwen, deepseek)
     for mod in families:
         if name in mod.CONFIGS:
             return mod.CONFIGS[name]

@@ -1,0 +1,572 @@
+"""DeepSeek-family decoder: Multi-head Latent Attention + DeepSeekMoE.
+
+Capability twin of the reference's DeepSeek recipes
+(llm/deepseek-r1/deepseek-r1-671B.yaml, llm/deepseek-janus) — the
+reference serves DeepSeek via vLLM/SGLang on GPU fleets; here the
+architecture itself is in-tree, TPU-first:
+
+  * **MLA (multi-head latent attention)**: queries and keys/values are
+    projected through low-rank latents (q_lora_rank / kv_lora_rank)
+    with a decoupled RoPE branch — at decode only the compressed
+    latent (kv_lora_rank floats) + the shared RoPE key
+    (qk_rope_head_dim floats) are cached per token, ~20× smaller than
+    a Llama-8B KV row. Decode attention runs in the ABSORBED form
+    (score = (q_nope·W_uk)·c_kv + q_rope·k_rope) so the full K/V are
+    never materialized from the cache — decode HBM traffic is the
+    compressed cache itself, which is the whole point of MLA.
+  * **DeepSeekMoE**: first_k_dense dense layers, then MoE layers with
+    always-on shared experts plus fine-grained routed experts, reusing
+    the capacity-based einsum dispatch from models/moe.py (GShard-style
+    static shapes; 'expert' mesh axis → all-to-all over ICI).
+  * Train/prefill attention expands the latents and runs the standard
+    kernel path; qk head dim (nope+rope) differs from the v head dim,
+    which the XLA attention handles natively.
+
+Same functional surface as the other families (CONFIGS, logical_axes,
+init, forward, loss_fn, prefill_hidden/decode_forward/lm_logits), plus
+`kv_cache_shapes` — the engine hook that lets MLA declare its
+asymmetric compressed cache instead of the [KVH, HD] default.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.models import moe as moe_lib
+from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.ops import quantization as qops
+from skypilot_tpu.parallel import mesh as mesh_lib
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class DeepSeekConfig:
+    vocab_size: int = 102_400
+    d_model: int = 2048
+    n_layers: int = 27
+    n_heads: int = 16
+    # MLA dims (DeepSeek-V2 paper notation).
+    q_lora_rank: int = 0          # 0 = full-rank q projection (V2-Lite)
+    kv_lora_rank: int = 512
+    qk_nope_head_dim: int = 128
+    qk_rope_head_dim: int = 64
+    v_head_dim: int = 128
+    # Dense MLP (first_k_dense layers) and MoE shape.
+    d_ff: int = 10_944
+    first_k_dense: int = 1
+    n_experts: int = 64
+    n_shared_experts: int = 2
+    experts_per_token: int = 6
+    moe_d_ff: int = 1408
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
+    max_seq_len: int = 4096
+    rope_theta: float = 10_000.0
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    remat_policy: str = 'dots'
+    # qk dim (192) != v dim (128): the XLA path handles that natively;
+    # an MLA-shaped flash kernel is future work.
+    attention_impl: str = 'xla'
+    ce_chunk: int = 2048
+
+    @property
+    def qk_head_dim(self) -> int:
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+    @property
+    def n_moe_layers(self) -> int:
+        return self.n_layers - self.first_k_dense
+
+    def _attn_params(self) -> int:
+        d, h = self.d_model, self.n_heads
+        dn, dr, dv = (self.qk_nope_head_dim, self.qk_rope_head_dim,
+                      self.v_head_dim)
+        if self.q_lora_rank:
+            q = (d * self.q_lora_rank + self.q_lora_rank * h * (dn + dr) +
+                 self.q_lora_rank)                       # + q_norm
+        else:
+            q = d * h * (dn + dr)
+        kv = (d * self.kv_lora_rank + d * dr +
+              self.kv_lora_rank * h * (dn + dv) +
+              self.kv_lora_rank)                         # + kv_norm
+        return q + kv + h * dv * d
+
+    def num_params(self) -> int:
+        c = self
+        d, v = c.d_model, c.vocab_size
+        dense_layer = self._attn_params() + 3 * d * c.d_ff + 2 * d
+        shared = 3 * d * (c.moe_d_ff * c.n_shared_experts)
+        routed = 3 * d * c.moe_d_ff * c.n_experts + d * c.n_experts
+        moe_layer = self._attn_params() + shared + routed + 2 * d
+        return (v * d * 2 + c.first_k_dense * dense_layer +
+                c.n_moe_layers * moe_layer + d)
+
+    def active_params(self) -> int:
+        c = self
+        d, v = c.d_model, c.vocab_size
+        dense_layer = self._attn_params() + 3 * d * c.d_ff + 2 * d
+        shared = 3 * d * (c.moe_d_ff * c.n_shared_experts)
+        routed = (3 * d * c.moe_d_ff * c.experts_per_token +
+                  d * c.n_experts)
+        moe_layer = self._attn_params() + shared + routed + 2 * d
+        return (v * d * 2 + c.first_k_dense * dense_layer +
+                c.n_moe_layers * moe_layer + d)
+
+    def train_flops_per_token(self) -> float:
+        attn_flops = (6 * self.n_layers * self.n_heads *
+                      (self.qk_head_dim + self.v_head_dim) *
+                      self.max_seq_len)
+        return 6 * self.active_params() + attn_flops
+
+
+# DeepSeek-V2-Lite dimensions (public config: 15.7B total, 2.4B active).
+DEEPSEEK_V2_LITE = DeepSeekConfig()
+# DeepSeek-V3/R1-class dimensions (671B total, 37B active).
+DEEPSEEK_V3 = DeepSeekConfig(
+    vocab_size=129_280, d_model=7168, n_layers=61, n_heads=128,
+    q_lora_rank=1536, kv_lora_rank=512, d_ff=18_432, first_k_dense=3,
+    n_experts=256, n_shared_experts=1, experts_per_token=8,
+    moe_d_ff=2048, rope_theta=10_000.0)
+DEEPSEEK_TINY = DeepSeekConfig(
+    vocab_size=256, d_model=64, n_layers=3, n_heads=4, q_lora_rank=32,
+    kv_lora_rank=32, qk_nope_head_dim=16, qk_rope_head_dim=8,
+    v_head_dim=16, d_ff=128, first_k_dense=1, n_experts=4,
+    n_shared_experts=1, experts_per_token=2, moe_d_ff=32,
+    max_seq_len=128, remat=False)
+# A variant exercising the no-dense, full-rank-q corner.
+DEEPSEEK_TINY_MOE_ONLY = dataclasses.replace(
+    DEEPSEEK_TINY, first_k_dense=0, q_lora_rank=0, n_layers=2)
+
+CONFIGS = {
+    'deepseek-v2-lite': DEEPSEEK_V2_LITE,
+    'deepseek-v3': DEEPSEEK_V3,
+    'deepseek-tiny': DEEPSEEK_TINY,
+    'deepseek-tiny-moe-only': DEEPSEEK_TINY_MOE_ONLY,
+}
+
+
+def kv_cache_shapes(config: DeepSeekConfig, max_slots: int,
+                    max_target_len: int
+                    ) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """Engine hook: the 'k' cache holds the compressed latent c_kv, the
+    'v' cache the shared post-RoPE key — per-token cache is
+    kv_lora_rank + qk_rope_head_dim floats instead of
+    2 × n_kv_heads × head_dim."""
+    c = config
+    return ((c.n_layers, max_slots, max_target_len, 1, c.kv_lora_rank),
+            (c.n_layers, max_slots, max_target_len, 1,
+             c.qk_rope_head_dim))
+
+
+def _attn_axes(config: DeepSeekConfig) -> Params:
+    axes: Params = {
+        'w_dkv': ('layers', 'embed', None),
+        'w_kr': ('layers', 'embed', None),
+        'w_ukv': ('layers', None, 'heads'),
+        'wo': ('layers', 'heads', 'embed'),
+        'kv_norm': ('layers', None),
+        'attn_norm': ('layers', 'embed'),
+        'mlp_norm': ('layers', 'embed'),
+    }
+    if config.q_lora_rank:
+        axes.update({'w_dq': ('layers', 'embed', None),
+                     'w_uq': ('layers', None, 'heads'),
+                     'q_norm': ('layers', None)})
+    else:
+        axes.update({'wq': ('layers', 'embed', 'heads')})
+    return axes
+
+
+def logical_axes(config: DeepSeekConfig) -> Params:
+    dense = dict(_attn_axes(config))
+    dense.update({
+        'w_gate': ('layers', 'embed', 'mlp'),
+        'w_up': ('layers', 'embed', 'mlp'),
+        'w_down': ('layers', 'mlp', 'embed'),
+    })
+    moe = dict(_attn_axes(config))
+    moe.update({
+        'router': ('layers', 'embed', None),
+        'w_gate': ('layers', 'expert', 'embed', 'mlp'),
+        'w_up': ('layers', 'expert', 'embed', 'mlp'),
+        'w_down': ('layers', 'expert', 'mlp', 'embed'),
+        'ws_gate': ('layers', 'embed', 'mlp'),
+        'ws_up': ('layers', 'embed', 'mlp'),
+        'ws_down': ('layers', 'mlp', 'embed'),
+    })
+    out: Params = {
+        'embed': ('vocab', 'embed'),
+        'moe_layers': moe,
+        'final_norm': ('embed',),
+        'lm_head': ('embed', 'vocab'),
+    }
+    if config.first_k_dense:
+        out['dense_layers'] = dense
+    return out
+
+
+def _init_attn(c: DeepSeekConfig, keys, n: int) -> Params:
+    """Stacked attention params for a group of n layers."""
+    h, d = c.n_heads, c.d_model
+    dn, dr, dv = c.qk_nope_head_dim, c.qk_rope_head_dim, c.v_head_dim
+
+    def dense(k, shape, fan_in):
+        return (jax.random.truncated_normal(k, -2, 2, (n,) + shape,
+                                            jnp.float32) *
+                (fan_in ** -0.5)).astype(c.dtype)
+
+    out: Params = {
+        'w_dkv': dense(keys[2], (d, c.kv_lora_rank), d),
+        'w_kr': dense(keys[3], (d, dr), d),
+        'w_ukv': dense(keys[4], (c.kv_lora_rank, h * (dn + dv)),
+                       c.kv_lora_rank),
+        'wo': dense(keys[5], (h * dv, d), h * dv),
+        'kv_norm': jnp.ones((n, c.kv_lora_rank), c.dtype),
+        'attn_norm': jnp.ones((n, d), c.dtype),
+        'mlp_norm': jnp.ones((n, d), c.dtype),
+    }
+    if c.q_lora_rank:
+        out.update({
+            'w_dq': dense(keys[0], (d, c.q_lora_rank), d),
+            'w_uq': dense(keys[1], (c.q_lora_rank, h * (dn + dr)),
+                          c.q_lora_rank),
+            'q_norm': jnp.ones((n, c.q_lora_rank), c.dtype),
+        })
+    else:
+        out['wq'] = dense(keys[0], (d, h * (dn + dr)), d)
+    return out
+
+
+def init(config: DeepSeekConfig, key: jax.Array) -> Params:
+    c = config
+    d = c.d_model
+    keys = jax.random.split(key, 24)
+
+    def dense(k, shape, fan_in):
+        return (jax.random.truncated_normal(k, -2, 2, shape, jnp.float32) *
+                (fan_in ** -0.5)).astype(c.dtype)
+
+    def stack(k, n, shape, fan_in):
+        return dense(k, (n,) + shape, fan_in)
+
+    params: Params = {
+        'embed': dense(keys[0], (c.vocab_size, d), d),
+        'final_norm': jnp.ones((d,), c.dtype),
+        'lm_head': dense(keys[1], (d, c.vocab_size), d),
+    }
+    if c.first_k_dense:
+        n = c.first_k_dense
+        group = _init_attn(c, keys[2:8], n)
+        group.update({
+            'w_gate': stack(keys[8], n, (d, c.d_ff), d),
+            'w_up': stack(keys[9], n, (d, c.d_ff), d),
+            'w_down': stack(keys[10], n, (c.d_ff, d), c.d_ff),
+        })
+        params['dense_layers'] = group
+    n = c.n_moe_layers
+    group = _init_attn(c, keys[11:17], n)
+    sf = c.moe_d_ff * c.n_shared_experts
+    group.update({
+        'router': (jax.random.truncated_normal(
+            keys[17], -2, 2, (n, d, c.n_experts), jnp.float32) *
+            (d ** -0.5)),
+        'w_gate': stack(keys[18], n, (c.n_experts, d, c.moe_d_ff), d),
+        'w_up': stack(keys[19], n, (c.n_experts, d, c.moe_d_ff), d),
+        'w_down': stack(keys[20], n, (c.n_experts, c.moe_d_ff, d),
+                        c.moe_d_ff),
+        'ws_gate': stack(keys[21], n, (d, sf), d),
+        'ws_up': stack(keys[22], n, (d, sf), d),
+        'ws_down': stack(keys[23], n, (sf, d), sf),
+    })
+    params['moe_layers'] = group
+    return params
+
+
+def _mla_qkv(c: DeepSeekConfig, h: jax.Array, lp: Params,
+             positions: jax.Array):
+    """Project hidden → (q [B,S,H,qk], c_kv [B,S,r], k_rope [B,S,1,dr]).
+
+    c_kv is post-RMSNorm and k_rope post-RoPE — exactly what the decode
+    cache stores, so train/prefill/decode share one projection."""
+    b, s, _ = h.shape
+    dn, dr = c.qk_nope_head_dim, c.qk_rope_head_dim
+    if c.q_lora_rank:
+        cq = llama._rms_norm(qops.matmul(h, lp['w_dq']), lp['q_norm'],
+                             c.norm_eps)
+        q = qops.matmul(cq, lp['w_uq'])
+    else:
+        q = qops.matmul(h, lp['wq'])
+    q = q.reshape(b, s, c.n_heads, dn + dr)
+    q_nope, q_rope = q[..., :dn], q[..., dn:]
+    q_rope = llama._rope(q_rope, positions, c.rope_theta)
+    c_kv = llama._rms_norm(qops.matmul(h, lp['w_dkv']), lp['kv_norm'],
+                           c.norm_eps)
+    k_rope = qops.matmul(h, lp['w_kr']).reshape(b, s, 1, dr)
+    k_rope = llama._rope(k_rope, positions, c.rope_theta)
+    return q_nope, q_rope, c_kv, k_rope
+
+
+def _mla_attention(c: DeepSeekConfig, mesh, x: jax.Array, lp: Params,
+                   positions: jax.Array, kv_cache=None,
+                   cache_positions: Optional[jax.Array] = None,
+                   return_kv: bool = False):
+    """MLA block attention. Returns (attn_out [B,S,D], new_kv).
+
+    Without kv_cache: expanded form (training/prefill); with kv_cache
+    ([B,K,1,r_kv], [B,K,1,dr] slot caches): absorbed decode step."""
+    b, s, _ = x.shape
+    h = c.n_heads
+    dn, dr, dv = c.qk_nope_head_dim, c.qk_rope_head_dim, c.v_head_dim
+
+    def shard(arr, axes):
+        if mesh is None:
+            return arr
+        return mesh_lib.shard_logical(arr, mesh, axes)
+
+    hid = llama._rms_norm(x, lp['attn_norm'], c.norm_eps)
+    q_nope, q_rope, c_kv, k_rope = _mla_qkv(c, hid, lp, positions)
+    q_nope = shard(q_nope, ('batch', 'activation_length',
+                            'activation_heads', None))
+
+    if kv_cache is not None:
+        # ---- absorbed decode over the compressed cache ----
+        ck, cv = kv_cache                      # [B,K,1,r], [B,K,1,dr]
+        slots = jnp.arange(b)
+        ck = ck.at[slots, cache_positions, 0].set(
+            c_kv[:, 0].astype(ck.dtype))
+        cv = cv.at[slots, cache_positions, 0].set(
+            k_rope[:, 0, 0].astype(cv.dtype))
+        w_ukv = lp['w_ukv'].reshape(c.kv_lora_rank, h, dn + dv)
+        w_uk, w_uv = w_ukv[..., :dn], w_ukv[..., dn:]
+        latents = ck[:, :, 0].astype(jnp.float32)        # [B,K,r]
+        ropes = cv[:, :, 0].astype(jnp.float32)          # [B,K,dr]
+        q_eff = jnp.einsum('bhd,rhd->bhr',
+                           q_nope[:, 0].astype(jnp.float32),
+                           w_uk.astype(jnp.float32))
+        scores = (jnp.einsum('bhr,btr->bht', q_eff, latents) +
+                  jnp.einsum('bhd,btd->bht',
+                             q_rope[:, 0].astype(jnp.float32), ropes))
+        scores = scores * ((dn + dr) ** -0.5)
+        valid = (jnp.arange(ck.shape[1])[None, None, :] <=
+                 cache_positions[:, None, None])
+        scores = jnp.where(valid, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o_c = jnp.einsum('bht,btr->bhr', probs, latents)
+        attn = jnp.einsum('bhr,rhd->bhd', o_c,
+                          w_uv.astype(jnp.float32))
+        attn = attn.astype(c.dtype).reshape(b, 1, h * dv)
+        new_kv = (ck, cv)
+    else:
+        # ---- expanded train/prefill ----
+        kv = qops.matmul(c_kv, lp['w_ukv']).reshape(b, s, h, dn + dv)
+        k_nope, v = kv[..., :dn], kv[..., dn:]
+        k = jnp.concatenate(
+            [k_nope, jnp.broadcast_to(k_rope, (b, s, h, dr))], axis=-1)
+        q = jnp.concatenate([q_nope, q_rope], axis=-1)
+        q = shard(q, ('batch', 'activation_length', 'activation_heads',
+                      None))
+        attn = attention_ops.dot_product_attention(
+            q, k, v, causal=True, implementation=c.attention_impl)
+        attn = attn.reshape(b, s, h * dv)
+        new_kv = ((c_kv[:, :, None, :], k_rope) if return_kv else None)
+    out = shard(llama._ckpt_name(qops.matmul(attn, lp['wo']), 'attn_o'),
+                ('batch', 'activation_length', 'activation_embed'))
+    return out, new_kv
+
+
+def _dense_mlp(c: DeepSeekConfig, mesh, h: jax.Array, lp: Params,
+               gate_key='w_gate', up_key='w_up', down_key='w_down'):
+    def shard(arr, axes):
+        if mesh is None:
+            return arr
+        return mesh_lib.shard_logical(arr, mesh, axes)
+
+    gate = jax.nn.silu(
+        llama._ckpt_name(qops.matmul(h, lp[gate_key]),
+                         'mlp_gate').astype(jnp.float32))
+    up = llama._ckpt_name(qops.matmul(h, lp[up_key]),
+                          'mlp_up').astype(jnp.float32)
+    ff = shard((gate * up).astype(c.dtype),
+               ('batch', 'activation_length', 'activation_mlp'))
+    return qops.matmul(ff, lp[down_key])
+
+
+def _layer(c: DeepSeekConfig, mesh, x: jax.Array, lp: Params,
+           positions: jax.Array, is_moe: bool,
+           token_mask: Optional[jax.Array] = None,
+           kv_cache=None, cache_positions=None, return_kv: bool = False):
+    """One block → (x, aux, new_kv). Dense layers report aux = 0."""
+    attn, new_kv = _mla_attention(c, mesh, x, lp, positions,
+                                  kv_cache=kv_cache,
+                                  cache_positions=cache_positions,
+                                  return_kv=return_kv)
+    x = x + attn
+
+    def shard(arr, axes):
+        if mesh is None:
+            return arr
+        return mesh_lib.shard_logical(arr, mesh, axes)
+
+    h = llama._rms_norm(x, lp['mlp_norm'], c.norm_eps)
+    if not is_moe:
+        x = x + shard(_dense_mlp(c, mesh, h, lp),
+                      ('batch', 'activation_length', 'activation_embed'))
+        return x, jnp.float32(0.0), new_kv
+    # DeepSeekMoE: shared experts (always on) + routed fine-grained
+    # experts. Routing reuses the GShard einsum dispatch from moe.py —
+    # a view of this config quacks like MoEConfig for route().
+    shared = _dense_mlp(c, mesh, h, lp, 'ws_gate', 'ws_up', 'ws_down')
+    router_cfg = _RouterView(c)
+    capacity = (x.shape[0] * x.shape[1] if kv_cache is not None else None)
+    routed, aux = moe_lib._moe_mlp(router_cfg, mesh, h, lp,
+                                   token_mask=token_mask,
+                                   capacity=capacity)
+    x = x + shard(shared + routed,
+                  ('batch', 'activation_length', 'activation_embed'))
+    return x, aux, new_kv
+
+
+class _RouterView:
+    """Duck-typed adapter exposing the MoEConfig fields moe.route /
+    moe._moe_mlp read, backed by a DeepSeekConfig."""
+
+    def __init__(self, c: DeepSeekConfig) -> None:
+        self.n_experts = c.n_experts
+        self.experts_per_token = c.experts_per_token
+        self.capacity_factor = c.capacity_factor
+        self.dtype = c.dtype
+
+
+def _trunk(c: DeepSeekConfig, params: Params, tokens: jax.Array,
+           positions: Optional[jax.Array], mesh,
+           token_mask: Optional[jax.Array] = None,
+           return_kv: bool = False):
+    """Run all layers → (hidden, mean_aux, kv_stacked_or_None)."""
+    if positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1])[None, :], tokens.shape)
+    x = llama._embed_lookup(params['embed'], tokens, mesh).astype(c.dtype)
+    if mesh is not None:
+        x = mesh_lib.shard_logical(
+            x, mesh, ('batch', 'activation_length', 'activation_embed'))
+
+    aux_sum = jnp.float32(0.0)
+    kv_groups = []
+
+    def run_group(x, group, is_moe, aux_sum):
+        def layer_fn(x, lp):
+            x, aux, kv = _layer(c, mesh, x, lp, positions, is_moe,
+                                token_mask=token_mask,
+                                return_kv=return_kv)
+            return x, ({'k': kv[0], 'v': kv[1]} if return_kv else aux)
+
+        if c.remat and not return_kv:
+            layer_fn = jax.checkpoint(layer_fn,
+                                      policy=llama._remat_policy(c))
+        x, scanned = jax.lax.scan(layer_fn, x, group)
+        if return_kv:
+            kv_groups.append(scanned)
+        else:
+            aux_sum = aux_sum + jnp.sum(scanned)
+        return x, aux_sum
+
+    if c.first_k_dense:
+        x, aux_sum = run_group(x, params['dense_layers'], False, aux_sum)
+    x, aux_sum = run_group(x, params['moe_layers'], True, aux_sum)
+    x = llama._rms_norm(x, params['final_norm'], c.norm_eps)
+    mean_aux = aux_sum / jnp.float32(max(c.n_moe_layers, 1))
+    if return_kv:
+        kv = {
+            'k': jnp.concatenate([g['k'] for g in kv_groups], axis=0),
+            'v': jnp.concatenate([g['v'] for g in kv_groups], axis=0),
+        }
+        return x, mean_aux, kv
+    return x, mean_aux, None
+
+
+def forward(c: DeepSeekConfig, params: Params, tokens: jax.Array,
+            mesh: Optional[mesh_lib.Mesh] = None,
+            positions: Optional[jax.Array] = None,
+            return_aux: bool = False,
+            token_mask: Optional[jax.Array] = None):
+    x, aux, _ = _trunk(c, params, tokens, positions, mesh,
+                       token_mask=token_mask)
+    logits = qops.matmul(x, params['lm_head'],
+                         preferred_element_type=jnp.float32)
+    if return_aux:
+        return logits, aux
+    return logits
+
+
+def loss_fn(c: DeepSeekConfig, params: Params, tokens: jax.Array,
+            targets: jax.Array, mesh: Optional[mesh_lib.Mesh] = None,
+            loss_mask: Optional[jax.Array] = None,
+            token_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Chunked next-token CE + router load-balance aux (moe.py form)."""
+    x, aux, _ = _trunk(c, params, tokens, None, mesh,
+                       token_mask=token_mask)
+    ce = llama._chunked_ce(x, params['lm_head'], targets, loss_mask,
+                           c.ce_chunk)
+    return ce + c.router_aux_coef * aux
+
+
+def prefill_hidden(c: DeepSeekConfig, params: Params, tokens: jax.Array,
+                   true_len: jax.Array,
+                   mesh: Optional[mesh_lib.Mesh] = None):
+    """Engine contract: → (last_hidden [B, D], stacked compressed KV:
+    k = c_kv [L,B,S,1,r_kv], v = k_rope [L,B,S,1,dr])."""
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    token_mask = (positions < true_len).astype(jnp.float32)
+    x, _, kv = _trunk(c, params, tokens, positions, mesh,
+                      token_mask=token_mask, return_kv=True)
+    last = jax.lax.dynamic_index_in_dim(x, true_len - 1, axis=1,
+                                        keepdims=False)
+    return last, kv
+
+
+def decode_forward(c: DeepSeekConfig, params: Params,
+                   last_tokens: jax.Array, positions: jax.Array,
+                   kv, mesh: Optional[mesh_lib.Mesh] = None):
+    """One absorbed-MLA decode step over the compressed slot cache."""
+    x = qops.embed_rows(params['embed'],
+                        last_tokens[:, None]).astype(c.dtype)
+    pos = positions[:, None]
+    ck, cv = kv['k'], kv['v']
+    k = c.first_k_dense
+
+    def group_fn(is_moe):
+        def layer_fn(x, scanned):
+            lp, layer_ck, layer_cv = scanned
+            x, _, new_cache = _layer(c, mesh, x, lp, pos, is_moe,
+                                     kv_cache=(layer_ck, layer_cv),
+                                     cache_positions=positions)
+            return x, {'k': new_cache[0], 'v': new_cache[1]}
+        return layer_fn
+
+    new_groups = []
+    if k:
+        x, new = jax.lax.scan(group_fn(False), x,
+                              (params['dense_layers'], ck[:k], cv[:k]))
+        new_groups.append(new)
+    x, new = jax.lax.scan(group_fn(True), x,
+                          (params['moe_layers'], ck[k:], cv[k:]))
+    new_groups.append(new)
+    x = llama._rms_norm(x, params['final_norm'], c.norm_eps)
+    new_kv = {
+        'k': jnp.concatenate([g['k'] for g in new_groups], axis=0),
+        'v': jnp.concatenate([g['v'] for g in new_groups], axis=0),
+    }
+    return lm_logits(c, params, x)[:, 0], new_kv
+
+
+def lm_logits(c, params: Params, hidden: jax.Array) -> jax.Array:
+    """Untied LM head (same structure as llama's)."""
+    return llama.lm_logits(None, params, hidden)

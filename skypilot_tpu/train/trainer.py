@@ -133,10 +133,12 @@ class Trainer:
         c = self.config
 
         def loss_of(params):
+            from skypilot_tpu.models import deepseek
             from skypilot_tpu.models import moe
+            routed = self._model_lib in (moe, deepseek)
             if self._n_stages > 1:
                 kwargs = {}
-                if self._model_lib is moe:
+                if routed:
                     # Forward the mask so moe.pipelined_loss_fn can
                     # refuse it loudly (pads under GPipe would silently
                     # consume expert capacity otherwise).
@@ -146,9 +148,10 @@ class Trainer:
                     mesh=self.mesh, n_microbatches=c.n_microbatches,
                     loss_mask=batch.get('mask'), **kwargs)
             kwargs = {}
-            if self._model_lib is moe:
-                # MoE: pads are excluded from routing; the loss mask (which
-                # targets count) is a separate concern.
+            if routed:
+                # Routed-expert families: pads are excluded from routing;
+                # the loss mask (which targets count) is a separate
+                # concern.
                 kwargs['token_mask'] = batch.get('token_mask')
             return self._model_lib.loss_fn(c.model, params, batch['tokens'],
                                            batch['targets'], mesh=self.mesh,
