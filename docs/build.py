@@ -27,6 +27,7 @@ NAV = [
     ('cli.md', 'CLI reference'),
     ('architecture.md', 'Architecture'),
     ('parallelism.md', 'Parallelism'),
+    ('finetuning.md', 'Fine-tuning'),
     ('serving.md', 'Serving'),
     ('jobs.md', 'Managed jobs'),
     ('storage.md', 'Storage'),

@@ -70,6 +70,11 @@ def main() -> int:
     parser.add_argument('--resume', default='none',
                         choices=['none', 'auto'])
     parser.add_argument('--log-every', type=int, default=10)
+    parser.add_argument('--lora-rank', type=int, default=0,
+                        help='LoRA rank (0 = full fine-tune)')
+    parser.add_argument('--lora-alpha', type=float, default=16.0)
+    parser.add_argument('--lora-targets', default='wq,wk,wv,wo',
+                        help='comma-separated weight names to adapt')
     args = parser.parse_args()
 
     distributed.initialize()
@@ -97,6 +102,10 @@ def main() -> int:
         optimizer=args.optimizer,
         learning_rate=args.learning_rate,
         n_microbatches=args.n_microbatches,
+        lora_rank=args.lora_rank,
+        lora_alpha=args.lora_alpha,
+        lora_targets=tuple(t.strip() for t in args.lora_targets.split(',')
+                           if t.strip()),
     )
     mesh = mesh_lib.build_mesh(
         plan.resolve(jax.device_count()), num_slices=args.num_slices)

@@ -37,6 +37,12 @@ class TrainConfig:
     optimizer: str = 'adamw'   # 'adamw' | 'adafactor'
     n_microbatches: int = 4    # GPipe microbatches when mesh stage > 1
     seed: int = 0
+    # LoRA fine-tuning: rank 0 = full fine-tune; rank > 0 freezes the
+    # base weights (held outside the optimizer) and trains only A/B
+    # adapters on `lora_targets`, merged inside the jitted step.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: Tuple[str, ...] = ('wq', 'wk', 'wv', 'wo')
 
 
 def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
@@ -83,13 +89,26 @@ class Trainer:
 
     # ---- state ----
 
+    @property
+    def _lora(self) -> bool:
+        return self.config.lora_rank > 0
+
     def init_state(self) -> Dict[str, Any]:
         c = self.config
 
         def _init():
-            params = self._model_lib.init(c.model, jax.random.PRNGKey(c.seed))
-            opt_state = self.optimizer.init(params)
-            return {'params': params, 'opt_state': opt_state,
+            base = self._model_lib.init(c.model, jax.random.PRNGKey(c.seed))
+            if self._lora:
+                from skypilot_tpu.train import lora as lora_lib
+                adapters = lora_lib.init_lora(
+                    base, c.lora_rank, jax.random.PRNGKey(c.seed + 1),
+                    targets=tuple(c.lora_targets))
+                # Only the adapters enter the optimizer; the base is
+                # frozen state carried alongside.
+                return {'params': adapters, 'base': base,
+                        'opt_state': self.optimizer.init(adapters),
+                        'step': jnp.zeros((), jnp.int32)}
+            return {'params': base, 'opt_state': self.optimizer.init(base),
                     'step': jnp.zeros((), jnp.int32)}
 
         shardings = self.state_shardings()
@@ -98,16 +117,36 @@ class Trainer:
     def state_shardings(self) -> Dict[str, Any]:
         """Shardings pytree for the full train state."""
         c = self.config
-        params_shape = jax.eval_shape(
+        base_shape = jax.eval_shape(
             lambda: self._model_lib.init(c.model, jax.random.PRNGKey(0)))
+        replicated = NamedSharding(self.mesh, PartitionSpec())
+        if self._lora:
+            from skypilot_tpu.train import lora as lora_lib
+            # Adapters are tiny (O(rank·d·L)): replicate them and their
+            # optimizer moments; the frozen base keeps the full
+            # logical-axis sharding.
+            adapter_shape = jax.eval_shape(
+                lambda: lora_lib.init_lora(
+                    jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                                 base_shape),
+                    c.lora_rank, jax.random.PRNGKey(0),
+                    targets=tuple(c.lora_targets)))
+            opt_shape = jax.eval_shape(
+                lambda: self.optimizer.init(
+                    jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                                 adapter_shape)))
+            return {'params': jax.tree.map(lambda _: replicated,
+                                           adapter_shape),
+                    'base': self._param_shardings,
+                    'opt_state': jax.tree.map(lambda _: replicated,
+                                              opt_shape),
+                    'step': replicated}
+        params_shape = base_shape
         opt_shape = jax.eval_shape(
             lambda: self.optimizer.init(
                 jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                              params_shape)))
         param_shardings = self._param_shardings
-
-        def opt_sharding_of(path_leaf):
-            return param_shardings  # moments mirror params
 
         # Optimizer state: shard any leaf whose shape matches a param's
         # sharding; scalars replicated.
@@ -116,7 +155,6 @@ class Trainer:
         shape_to_sharding = {}
         for p, s in zip(flat_params, flat_shard):
             shape_to_sharding.setdefault(p.shape, s)
-        replicated = NamedSharding(self.mesh, PartitionSpec())
 
         def match(leaf):
             return shape_to_sharding.get(leaf.shape, replicated)
@@ -135,6 +173,13 @@ class Trainer:
         def loss_of(params):
             from skypilot_tpu.models import deepseek
             from skypilot_tpu.models import moe
+            if self._lora:
+                from skypilot_tpu.train import lora as lora_lib
+                # Gradients flow only into the adapters; the base is a
+                # frozen constant inside the step.
+                params = lora_lib.merge(
+                    jax.lax.stop_gradient(state['base']), params,
+                    c.lora_alpha, c.lora_rank)
             routed = self._model_lib in (moe, deepseek)
             if self._n_stages > 1:
                 kwargs = {}
@@ -165,6 +210,8 @@ class Trainer:
         grad_norm = optax.global_norm(grads)
         new_state = {'params': new_params, 'opt_state': new_opt,
                      'step': state['step'] + 1}
+        if self._lora:
+            new_state['base'] = state['base']
         metrics = {'loss': loss, 'grad_norm': grad_norm,
                    'step': new_state['step']}
         return new_state, metrics
