@@ -134,6 +134,12 @@ def serve_main() -> None:
              (512,), True),
             ('llama3-1b-bf16', llama.LLAMA3_1B, 16, 2048, 64, 512, 128,
              (512,), False),
+            # Degraded rungs: a serve number from a memory-constrained
+            # (shared/partial-HBM) chip still beats no number.
+            ('llama3-1b-lean', llama.LLAMA3_1B, 8, 1024, 32, 256, 64,
+             (256,), False),
+            ('tiny-bf16', llama.LLAMA_TINY, 4, 64, 8, 16, 8,
+             (16,), False),
         ]
     last_err = None
     for (model_tag, model, slots, max_len, n_req, prompt_len, new_tok,

@@ -30,6 +30,25 @@ class Autoscaler:
     def evaluate(self, num_ready_replicas: int) -> AutoscalerDecision:
         return AutoscalerDecision(self.spec.min_replicas)
 
+    def split_targets(self, target: int,
+                      num_ready_spot: int) -> 'tuple[int, int]':
+        """(spot_target, ondemand_target) for a mixed fleet.
+
+        Twin of the reference's FallbackRequestRateAutoscaler
+        (sky/serve/autoscalers.py:557): `base_ondemand_fallback_replicas`
+        are always on-demand; with `dynamic_ondemand_fallback`,
+        not-yet-ready spot replicas are covered by temporary on-demand
+        ones (the fleet temporarily overprovisions to target + gap) that
+        scale back down as spot capacity recovers.
+        """
+        spec = self.spec
+        base = min(target, spec.base_ondemand_fallback_replicas)
+        spot_target = target - base
+        ondemand = base
+        if spec.dynamic_ondemand_fallback:
+            ondemand += max(0, spot_target - num_ready_spot)
+        return spot_target, ondemand
+
     def inherit_state(self, old: 'Autoscaler') -> None:
         """Carry scaling state across a rolling update.
 

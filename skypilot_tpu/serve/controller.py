@@ -52,7 +52,7 @@ class SkyServeController:
         logger.info(f'Service {self.service_name}: LB on :{actual_port}')
         serve_state.set_service_status(
             self.service_name, serve_state.ServiceStatus.REPLICA_INIT)
-        self.replica_manager.scale_to(self.spec.min_replicas)
+        self._apply_scale(self.spec.min_replicas)
 
         while not self._stop.is_set():
             try:
@@ -94,6 +94,20 @@ class SkyServeController:
         logger.info(f'Service {self.service_name}: rolling update to '
                     f'v{self.version}.')
 
+    def _apply_scale(self, target: int) -> None:
+        """Scale the fleet to `target`, splitting spot vs on-demand when
+        the mixed-fleet knobs are on. Controller restarts count the
+        live READY spot replicas (not zero) so a healthy fleet never
+        triggers a spurious on-demand launch wave."""
+        manager = self.replica_manager
+        if (self.spec.base_ondemand_fallback_replicas or
+                self.spec.dynamic_ondemand_fallback):
+            spot_target, od_target = self.autoscaler.split_targets(
+                target, manager.ready_spot_count())
+            manager.scale_to(spot_target, target_ondemand=od_target)
+        else:
+            manager.scale_to(target)
+
     def _tick(self) -> None:
         self._maybe_adopt_new_version()
         manager = self.replica_manager
@@ -113,7 +127,7 @@ class SkyServeController:
             return
         manager.recover_preempted()
         decision = self.autoscaler.evaluate(ready)
-        manager.scale_to(decision.target_num_replicas)
+        self._apply_scale(decision.target_num_replicas)
         manager.reconcile_versions(decision.target_num_replicas)
         self.load_balancer.set_ready_replicas(manager.ready_endpoints())
         if ready > 0:

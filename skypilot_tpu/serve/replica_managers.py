@@ -78,34 +78,60 @@ class ReplicaManager:
                                    serve_state.ReplicaStatus.PREEMPTED,
                                    serve_state.ReplicaStatus.SHUTTING_DOWN)
 
-    def active_count(self, version: Optional[int] = None) -> int:
+    def active_count(self, version: Optional[int] = None,
+                     spot: Optional[bool] = None) -> int:
         return len([
             r for r in self.replicas() if self._is_active(r) and
-            (version is None or r['version'] == version)
+            (version is None or r['version'] == version) and
+            (spot is None or r['spot'] == spot)
         ])
 
-    def scale_to(self, target: int) -> None:
+    def ready_spot_count(self) -> int:
+        # Across ALL versions: during a rolling update the old fleet
+        # keeps serving until reconcile_versions drains it, so its
+        # READY spot replicas are real capacity — filtering them out
+        # would spin up a spurious on-demand fleet on every update.
+        return len([
+            r for r in self.replicas()
+            if r['spot'] and
+            r['status'] == serve_state.ReplicaStatus.READY
+        ])
+
+    def scale_to(self, target: int,
+                 target_ondemand: Optional[int] = None) -> None:
         """Launch/terminate current-version replicas toward target.
+
+        With `target_ondemand` (mixed spot fleets), `target` counts the
+        task's own (spot) replicas and `target_ondemand` replicas are
+        forced on-demand — each kind scales independently.
 
         Old-version replicas are untouched here — they keep serving
         until reconcile_versions() drains them, so an update never drops
         below the pre-update capacity.
         """
         with self._lock:
-            current = self.active_count(version=self.version)
-            for _ in range(max(0, target - current)):
-                self._start_replica()
-            if current > target:
-                # Terminate youngest non-ready first, then youngest ready.
-                candidates = sorted(
-                    [r for r in self.replicas()
-                     if r['version'] == self.version and r['status'] not in
-                     (serve_state.ReplicaStatus.SHUTTING_DOWN,)],
-                    key=lambda r: (
-                        r['status'] == serve_state.ReplicaStatus.READY,
-                        -r['replica_id']))
-                for r in candidates[:current - target]:
-                    self.terminate_replica(r['replica_id'])
+            if target_ondemand is None:
+                self._scale_kind(target, spot=None)
+            else:
+                self._scale_kind(target, spot=True)
+                self._scale_kind(target_ondemand, spot=False)
+
+    def _scale_kind(self, target: int, spot: Optional[bool]) -> None:
+        current = self.active_count(version=self.version, spot=spot)
+        for _ in range(max(0, target - current)):
+            self._start_replica(spot=spot is not False)
+        if current > target:
+            # Terminate youngest non-ready first, then youngest ready.
+            candidates = sorted(
+                [r for r in self.replicas()
+                 if r['version'] == self.version and r['status'] not in
+                 (serve_state.ReplicaStatus.SHUTTING_DOWN,) and
+                 (spot is None or r['spot'] == spot)],
+                key=lambda r: (
+                    r['status'] == serve_state.ReplicaStatus.READY,
+                    -r['replica_id']))
+            for r in candidates[:current - target]:
+                self.terminate_replica(r['replica_id'])
 
     def reconcile_versions(self, target: int) -> None:
         """Drain old-version replicas once the new fleet is ready.
@@ -132,25 +158,29 @@ class ReplicaManager:
                         f'v{self.version}).')
                     self.terminate_replica(r['replica_id'])
 
-    def _start_replica(self) -> int:
+    def _start_replica(self, spot: bool = True) -> int:
         replica_id = self._next_replica_id
         self._next_replica_id += 1
         cluster_name = f'xsky-serve-{self.service_name}-{replica_id}'
         serve_state.upsert_replica(self.service_name, replica_id,
                                    cluster_name,
                                    serve_state.ReplicaStatus.PROVISIONING,
-                                   version=self.version)
+                                   version=self.version, spot=spot)
         future = self._pool.submit(self._launch_replica, replica_id,
-                                   cluster_name, self.version)
+                                   cluster_name, self.version, spot)
         self._launching[replica_id] = future
         return replica_id
 
     def _launch_replica(self, replica_id: int, cluster_name: str,
-                        version: int) -> None:
+                        version: int, spot: bool = True) -> None:
         try:
             from skypilot_tpu import execution
             task = task_lib.Task.from_yaml_config(self.task_config)
-            if (self.spec.use_ondemand_fallback and
+            if not spot:
+                # An on-demand fallback replica of a spot fleet.
+                task.set_resources(
+                    [r.copy(use_spot=False) for r in task.resources])
+            elif (self.spec.use_ondemand_fallback and
                     task.resources[0].use_spot and
                     self.spot_placer.should_fallback_to_ondemand() and
                     self.spot_placer.preemptive_zones):
@@ -261,4 +291,4 @@ class ReplicaManager:
                 if r['status'] == serve_state.ReplicaStatus.PREEMPTED:
                     serve_state.remove_replica(self.service_name,
                                                r['replica_id'])
-                    self._start_replica()
+                    self._start_replica(spot=r['spot'])

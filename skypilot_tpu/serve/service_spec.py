@@ -16,6 +16,8 @@ class SkyServiceSpec:
                  downscale_delay_seconds: float = 1200.0,
                  replica_port: Optional[int] = None,
                  use_ondemand_fallback: bool = False,
+                 base_ondemand_fallback_replicas: int = 0,
+                 dynamic_ondemand_fallback: bool = False,
                  load_balancing_policy: str = 'round_robin') -> None:
         if max_replicas is not None and max_replicas < min_replicas:
             raise ValueError('max_replicas must be >= min_replicas')
@@ -38,6 +40,13 @@ class SkyServiceSpec:
         self.downscale_delay_seconds = downscale_delay_seconds
         self.replica_port = replica_port
         self.use_ondemand_fallback = use_ondemand_fallback
+        # Mixed spot/on-demand fleets (twin of the reference's
+        # FallbackRequestRateAutoscaler knobs): keep N replicas always
+        # on-demand, and/or cover not-ready spot replicas with
+        # temporary on-demand ones.
+        self.base_ondemand_fallback_replicas = \
+            base_ondemand_fallback_replicas
+        self.dynamic_ondemand_fallback = dynamic_ondemand_fallback
         self.load_balancing_policy = load_balancing_policy
 
     @property
@@ -78,6 +87,10 @@ class SkyServiceSpec:
             replica_port=int(port) if port is not None else None,
             use_ondemand_fallback=bool(
                 policy.get('use_ondemand_fallback', False)),
+            base_ondemand_fallback_replicas=int(
+                policy.get('base_ondemand_fallback_replicas', 0)),
+            dynamic_ondemand_fallback=bool(
+                policy.get('dynamic_ondemand_fallback', False)),
             load_balancing_policy=lb_policy,
         )
 
@@ -101,6 +114,11 @@ class SkyServiceSpec:
                 self.downscale_delay_seconds
         if self.use_ondemand_fallback:
             policy['use_ondemand_fallback'] = True
+        if self.base_ondemand_fallback_replicas:
+            policy['base_ondemand_fallback_replicas'] = \
+                self.base_ondemand_fallback_replicas
+        if self.dynamic_ondemand_fallback:
+            policy['dynamic_ondemand_fallback'] = True
         if self.replica_port is not None:
             config['port'] = self.replica_port
         if self.load_balancing_policy != 'round_robin':

@@ -57,10 +57,13 @@ def _db() -> sqlite3.Connection:
             version INTEGER DEFAULT 1,
             PRIMARY KEY (service_name, replica_id)
         )""")
-    for table in ('services', 'replicas'):
+    for table, column in (('services', 'version INTEGER DEFAULT 1'),
+                          ('replicas', 'version INTEGER DEFAULT 1'),
+                          # Mixed fleets: spot replicas + on-demand
+                          # fallback replicas coexist per service.
+                          ('replicas', 'spot INTEGER DEFAULT 1')):
         try:
-            conn.execute(f'ALTER TABLE {table} ADD COLUMN '
-                         'version INTEGER DEFAULT 1')
+            conn.execute(f'ALTER TABLE {table} ADD COLUMN {column}')
         except Exception:  # pylint: disable=broad-except
             pass  # column exists (sqlite / pg error classes differ)
     conn.commit()
@@ -168,18 +171,19 @@ def _service_dict(row) -> Dict[str, Any]:
 def upsert_replica(service_name: str, replica_id: int, cluster_name: str,
                    status: ReplicaStatus,
                    endpoint: Optional[str] = None,
-                   version: int = 1) -> None:
+                   version: int = 1,
+                   spot: bool = True) -> None:
     with _lock:
         conn = _db()
         conn.execute(
             'INSERT INTO replicas (service_name, replica_id, cluster_name,'
-            ' status, endpoint, launched_at, version) '
-            'VALUES (?, ?, ?, ?, ?, ?, ?) '
+            ' status, endpoint, launched_at, version, spot) '
+            'VALUES (?, ?, ?, ?, ?, ?, ?, ?) '
             'ON CONFLICT(service_name, replica_id) DO UPDATE SET '
             'status=excluded.status, '
             'endpoint=COALESCE(excluded.endpoint, replicas.endpoint)',
             (service_name, replica_id, cluster_name, status.value,
-             endpoint, time.time(), version))
+             endpoint, time.time(), version, int(spot)))
         conn.commit()
         conn.close()
 
@@ -209,4 +213,5 @@ def get_replicas(service_name: str) -> List[Dict[str, Any]]:
         'endpoint': r[4],
         'launched_at': r[5],
         'version': r[6] or 1,
+        'spot': bool(r[7]) if len(r) > 7 and r[7] is not None else True,
     } for r in rows]

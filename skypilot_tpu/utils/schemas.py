@@ -86,6 +86,8 @@ _REPLICA_POLICY_SCHEMA: Dict[str, Any] = {
         'upscale_delay_seconds': _NUM,
         'downscale_delay_seconds': _NUM,
         'use_ondemand_fallback': _BOOL,
+        'base_ondemand_fallback_replicas': _INT,
+        'dynamic_ondemand_fallback': _BOOL,
     },
 }
 

@@ -225,6 +225,95 @@ class TestAutoscaler:
             spec_lib.SkyServiceSpec(target_qps_per_replica=1.0)
 
 
+class TestFallbackSplit:
+    """Mixed spot/on-demand targets (twin of the reference's
+    FallbackRequestRateAutoscaler, sky/serve/autoscalers.py:557)."""
+
+    def _scaler(self, **kwargs):
+        spec = spec_lib.SkyServiceSpec(min_replicas=2, max_replicas=4,
+                                       **kwargs)
+        return autoscalers_lib.make_autoscaler(spec)
+
+    def test_base_replicas_always_ondemand(self):
+        scaler = self._scaler(base_ondemand_fallback_replicas=1)
+        assert scaler.split_targets(3, num_ready_spot=2) == (2, 1)
+        # base larger than target: everything on-demand, no negatives.
+        assert scaler.split_targets(1, num_ready_spot=0) == (0, 1)
+
+    def test_dynamic_covers_spot_gap_and_recovers(self):
+        scaler = self._scaler(dynamic_ondemand_fallback=True)
+        # No spot ready yet: temporary on-demand for the whole target.
+        assert scaler.split_targets(3, num_ready_spot=0) == (3, 3)
+        # Spot recovering: on-demand shrinks with the gap.
+        assert scaler.split_targets(3, num_ready_spot=2) == (3, 1)
+        assert scaler.split_targets(3, num_ready_spot=3) == (3, 0)
+
+    def test_base_and_dynamic_compose(self):
+        scaler = self._scaler(base_ondemand_fallback_replicas=1,
+                              dynamic_ondemand_fallback=True)
+        # target 4 = 1 base-od + 3 spot; 1 spot ready → gap 2 → od 3.
+        assert scaler.split_targets(4, num_ready_spot=1) == (3, 3)
+        assert scaler.split_targets(4, num_ready_spot=3) == (3, 1)
+
+    def test_spec_yaml_round_trip(self):
+        spec = spec_lib.SkyServiceSpec(
+            min_replicas=2, base_ondemand_fallback_replicas=1,
+            dynamic_ondemand_fallback=True)
+        config = spec.to_yaml_config()
+        policy = config['replica_policy']
+        assert policy['base_ondemand_fallback_replicas'] == 1
+        assert policy['dynamic_ondemand_fallback'] is True
+        again = spec_lib.SkyServiceSpec.from_yaml_config(config)
+        assert again.base_ondemand_fallback_replicas == 1
+        assert again.dynamic_ondemand_fallback is True
+
+
+class TestMixedFleetE2E:
+
+    def test_base_ondemand_replica_in_spot_fleet(self, serve_env):
+        """min_replicas=2 with base_ondemand_fallback_replicas=1 on a
+        spot task → the fleet converges to 1 spot + 1 on-demand, and a
+        preempted spot replica is replaced as spot."""
+        import io
+        import yaml
+        config = yaml.safe_load(io.StringIO(SERVICE_YAML.format(
+            min_replicas=2, max_replicas=3)))
+        config['resources']['use_spot'] = True
+        config['service']['replica_policy'][
+            'base_ondemand_fallback_replicas'] = 1
+        task = task_lib.Task.from_yaml_config(config)
+        serve_core.up(task, 'mixed', timeout_s=90)
+        deadline = time.time() + 60
+        kinds = None
+        while time.time() < deadline:
+            reps = [r for r in serve_state.get_replicas('mixed')
+                    if r['status'] == serve_state.ReplicaStatus.READY]
+            kinds = sorted(r['spot'] for r in reps)
+            if kinds == [False, True]:
+                break
+            time.sleep(0.5)
+        assert kinds == [False, True], kinds
+        # Preempt the spot replica; the replacement stays spot.
+        spot_rep = next(r for r in serve_state.get_replicas('mixed')
+                        if r['spot'])
+        serve_env.preempt_cluster(spot_rep['cluster_name'])
+        deadline = time.time() + 60
+        recovered = False
+        while time.time() < deadline:
+            reps = serve_state.get_replicas('mixed')
+            spot_now = [r for r in reps if r['spot']]
+            if (spot_now and all(
+                    r['cluster_name'] != spot_rep['cluster_name']
+                    for r in spot_now) and
+                    any(r['status'] == serve_state.ReplicaStatus.READY
+                        for r in spot_now)):
+                recovered = True
+                break
+            time.sleep(0.5)
+        serve_core.down('mixed')
+        assert recovered
+
+
 class TestLbPolicies:
 
     def test_round_robin(self):
