@@ -367,6 +367,72 @@ def reap(kill):
             click.echo(f"{rec['pid']}: {rec['cmdline']}")
 
 
+@cli.command()
+def dashboard():
+    """Print (and try to open) the web dashboard URL."""
+    from skypilot_tpu.client import sdk
+    endpoint = sdk.api_server_endpoint()
+    if endpoint is None:
+        raise click.ClickException(
+            'No API server configured. Start one with `xsky api start` '
+            'or set XSKY_API_SERVER.')
+    if not endpoint.startswith(('http://', 'https://')):
+        endpoint = f'http://{endpoint}'
+    url = f'{endpoint.rstrip("/")}/dashboard'
+    click.echo(url)
+    import webbrowser
+    try:
+        webbrowser.open(url)
+    except Exception:  # pylint: disable=broad-except
+        pass
+
+
+@cli.group()
+def local():
+    """Local docker cluster (dev; twin of `sky local up/down`)."""
+
+
+@local.command(name='up')
+def local_up():
+    """Enable the local docker cloud (containers as cluster hosts)."""
+    from skypilot_tpu.clouds import docker as docker_cloud
+    ok, reason = docker_cloud.Docker.daemon_available()
+    if not ok and os.environ.get('XSKY_ENABLE_DOCKER_CLOUD') != '1':
+        raise click.ClickException(f'docker unavailable: {reason}')
+    marker = os.path.expanduser(docker_cloud.Docker.MARKER_PATH)
+    os.makedirs(os.path.dirname(marker), exist_ok=True)
+    with open(marker, 'w', encoding='utf-8') as f:
+        f.write('enabled by `xsky local up`\n')
+    click.echo('Local docker cloud enabled. Launch with '
+               '`xsky launch task.yaml` (cloud: docker), tear down '
+               'clusters with `xsky down`, disable with '
+               '`xsky local down`.')
+
+
+@local.command(name='down')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def local_down(yes):
+    """Disable the local docker cloud and tear down its clusters."""
+    from skypilot_tpu import core as core_lib
+    from skypilot_tpu.clouds import docker as docker_cloud
+    records = [r for r in core_lib.status()
+               if getattr(r.get('handle'), 'provider_name', None) ==
+               'docker']
+    if records and not yes:
+        names = ', '.join(r['name'] for r in records)
+        click.confirm(f'Tear down local cluster(s) {names}?', abort=True)
+    for r in records:
+        try:
+            core_lib.down(r['name'])
+            click.echo(f"Cluster {r['name']} terminated.")
+        except Exception as e:  # pylint: disable=broad-except
+            click.echo(f"Cluster {r['name']}: {e}")
+    marker = os.path.expanduser(docker_cloud.Docker.MARKER_PATH)
+    if os.path.exists(marker):
+        os.remove(marker)
+    click.echo('Local docker cloud disabled.')
+
+
 # ---- jobs / serve / storage / api groups (wired as they land) -------------
 
 

@@ -6,6 +6,7 @@ from skypilot_tpu.clouds.aws import AWS
 from skypilot_tpu.clouds.azure import Azure
 from skypilot_tpu.clouds.cudo import Cudo
 from skypilot_tpu.clouds.do import DO
+from skypilot_tpu.clouds.docker import Docker
 from skypilot_tpu.clouds.fake import Fake
 from skypilot_tpu.clouds.fluidstack import Fluidstack
 from skypilot_tpu.clouds.gcp import GCP
@@ -21,6 +22,6 @@ from skypilot_tpu.clouds.ssh import SSH
 from skypilot_tpu.clouds.vast import Vast
 
 __all__ = ['Cloud', 'CloudImplementationFeatures', 'Region', 'GCP', 'Fake',
-           'AWS', 'Azure', 'Cudo', 'DO', 'Fluidstack', 'Hyperbolic', 'IBM',
-           'Kubernetes', 'Lambda', 'Nebius', 'OCI', 'Paperspace', 'RunPod',
-           'SSH', 'Vast']
+           'AWS', 'Azure', 'Cudo', 'DO', 'Docker', 'Fluidstack',
+           'Hyperbolic', 'IBM', 'Kubernetes', 'Lambda', 'Nebius', 'OCI',
+           'Paperspace', 'RunPod', 'SSH', 'Vast']

@@ -1,0 +1,137 @@
+"""Local Docker "cloud": containers as cluster hosts (dev backend).
+
+Twin of the reference's `sky local up/down` + LocalDockerBackend
+(sky/backends/local_docker_backend.py): a zero-cost cloud whose
+"instances" are local containers, launched through the NORMAL
+backend/gang path (provision/docker/instance.py) — no special backend
+class. Gated behind `xsky local up` (writes the ~/.xsky/enable_docker
+marker; `xsky local down` removes it) so a running docker daemon never
+silently absorbs generic CPU tasks — the same explicit opt-in as the
+reference's `sky local up`. XSKY_ENABLE_DOCKER_CLOUD=1 forces it for
+tests. Priced at 0 like Kubernetes/SSH.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@registry.CLOUD_REGISTRY.register(aliases=['local'])
+class Docker(cloud_lib.Cloud):
+    _REPR = 'Docker'
+
+    _UNSUPPORTED = {
+        cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE:
+            'Local containers have no spot market.',
+        cloud_lib.CloudImplementationFeatures.STOP:
+            'Stop local clusters with `xsky down` (containers are '
+            'cheap to recreate).',
+        cloud_lib.CloudImplementationFeatures.OPEN_PORTS:
+            'Local containers share the host network namespace.',
+        cloud_lib.CloudImplementationFeatures.CUSTOM_DISK_TIER:
+            'Local containers use the host disk.',
+        cloud_lib.CloudImplementationFeatures.STORAGE_MOUNTING:
+            'Mount host paths directly instead.',
+    }
+
+    @property
+    def provisioner_module(self) -> str:
+        return 'docker'
+
+    def unsupported_features_for_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        return dict(self._UNSUPPORTED)
+
+    def regions_with_offering(self, instance_type: str,
+                              accelerators: Optional[Dict[str, Any]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[cloud_lib.Region]:
+        if use_spot or accelerators:
+            return []
+        if region not in (None, 'local'):
+            return []
+        return [cloud_lib.Region('local', ['local'])]
+
+    def zones_provision_loop(self, region: str, num_nodes: int,
+                             instance_type: str,
+                             accelerators: Optional[Dict[str, Any]] = None,
+                             use_spot: bool = False) -> Iterator[List[str]]:
+        del region, num_nodes, instance_type, accelerators, use_spot
+        yield ['local']
+
+    def get_default_instance_type(
+            self, cpus: Optional[str] = None,
+            memory: Optional[str] = None) -> Optional[str]:
+        del cpus, memory
+        return 'container'
+
+    def instance_type_exists(self, instance_type: str) -> bool:
+        return instance_type == 'container'
+
+    def get_feasible_launchable_resources(self, resources):
+        if resources.accelerators or resources.use_spot:
+            return [], []
+        itype = resources.instance_type or 'container'
+        if itype != 'container':
+            return [], []
+        return [resources.copy(cloud=self.name,
+                               instance_type='container')], []
+
+    def instance_type_to_hourly_cost(self, instance_type: str,
+                                     use_spot: bool = False,
+                                     region: Optional[str] = None,
+                                     zone: Optional[str] = None) -> float:
+        return 0.0
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        return {
+            'cluster_name': cluster_name,
+            'region': 'local',
+            'zone': None,
+            'instance_type': 'container',
+            'image_id': resources.image_id,
+        }
+
+    def provider_config_overrides(
+            self, node_config: Dict[str, Any]) -> Dict[str, Any]:
+        del node_config
+        return {}
+
+    MARKER_PATH = '~/.xsky/enable_docker'
+
+    @classmethod
+    def daemon_available(cls) -> Tuple[bool, Optional[str]]:
+        try:
+            proc = subprocess.run(['docker', 'info'],
+                                  capture_output=True, timeout=10)
+            if proc.returncode == 0:
+                return True, None
+            return False, ('docker daemon not responding '
+                           '(`docker info` failed).')
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            return False, 'docker CLI not found or not responding.'
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        if os.environ.get('XSKY_ENABLE_DOCKER_CLOUD') == '1':
+            return True, None
+        if not os.path.exists(os.path.expanduser(self.MARKER_PATH)):
+            return False, ('Local docker cloud is opt-in: run '
+                           '`xsky local up` to enable it.')
+        return self.daemon_available()
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        return {}
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        return 0.0

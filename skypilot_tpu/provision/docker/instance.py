@@ -131,3 +131,14 @@ def get_cluster_info(region: str, cluster_name: str,
         provider_config=provider_config,
         ssh_user='root',
     )
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    # Containers share the host network reachability; nothing to open.
+    del cluster_name, ports, provider_config
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    del cluster_name, provider_config

@@ -50,10 +50,29 @@ def controller_logs(service_name: str) -> str:
         return f.read()
 
 
+def _check_fallback_knobs(task: task_lib.Task) -> None:
+    """Mixed-fleet knobs only make sense on a spot task: on an
+    on-demand task the spot-labeled replicas would never be 'spot',
+    and dynamic fallback would double the fleet at every cold start."""
+    from skypilot_tpu.serve import service_spec as spec_lib
+    spec = spec_lib.SkyServiceSpec.from_yaml_config(
+        task.to_yaml_config().get('service', {}))
+    if not (spec.base_ondemand_fallback_replicas or
+            spec.dynamic_ondemand_fallback):
+        return
+    if not any(r.use_spot for r in task.resources):
+        raise ValueError(
+            'base_ondemand_fallback_replicas / dynamic_ondemand_fallback '
+            'require spot resources (use_spot: true) — on-demand '
+            'fallback of an already-on-demand fleet would just double '
+            'it.')
+
+
 def up(task: task_lib.Task, service_name: Optional[str] = None,
        wait_ready: bool = True, timeout_s: float = 120.0) -> str:
     if task.service is None:
         raise ValueError("Task has no 'service:' section.")
+    _check_fallback_knobs(task)
     if _remote_mode():
         from skypilot_tpu.serve import remote as serve_remote
         return serve_remote.up(task, service_name, wait_ready, timeout_s)
@@ -100,6 +119,7 @@ def update(task: task_lib.Task, service_name: str,
     """
     if task.service is None:
         raise ValueError("Task has no 'service:' section.")
+    _check_fallback_knobs(task)
     if _remote_mode():
         from skypilot_tpu.serve import remote as serve_remote
         return serve_remote.update(task, service_name, wait_done,

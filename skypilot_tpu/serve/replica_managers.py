@@ -176,17 +176,18 @@ class ReplicaManager:
         try:
             from skypilot_tpu import execution
             task = task_lib.Task.from_yaml_config(self.task_config)
-            if not spot:
-                # An on-demand fallback replica of a spot fleet.
-                task.set_resources(
-                    [r.copy(use_spot=False) for r in task.resources])
-            elif (self.spec.use_ondemand_fallback and
+            # An on-demand fallback replica of a spot fleet, or zone
+            # fallback after repeated spot preemptions.
+            force_ondemand = not spot
+            if (spot and self.spec.use_ondemand_fallback and
                     task.resources[0].use_spot and
                     self.spot_placer.should_fallback_to_ondemand() and
                     self.spot_placer.preemptive_zones):
                 logger.info(f'Replica {replica_id}: all spot zones '
                             'preempted recently; falling back to '
                             'on-demand.')
+                force_ondemand = True
+            if force_ondemand:
                 task.set_resources(
                     [r.copy(use_spot=False) for r in task.resources])
             port = self.spec.replica_port or _free_port()
@@ -203,17 +204,31 @@ class ReplicaManager:
                 self._replica_zone[replica_id] = zone
                 self.spot_placer.handle_active(zone)
             self.launch_failures = 0
+            if not any(r['replica_id'] == replica_id
+                       for r in self.replicas()):
+                # The row was removed mid-launch (scale-down terminated
+                # a PROVISIONING replica): re-inserting it would
+                # resurrect a replica the controller already drained —
+                # tear the just-launched cluster down instead.
+                logger.info(f'Replica {replica_id} was terminated '
+                            'mid-launch; tearing down its cluster.')
+                from skypilot_tpu import core as core_lib
+                try:
+                    core_lib.down(cluster_name, purge=True)
+                except Exception:  # pylint: disable=broad-except
+                    pass
+                return
             serve_state.upsert_replica(
                 self.service_name, replica_id, cluster_name,
                 serve_state.ReplicaStatus.STARTING,
-                endpoint=f'{host}:{port}', version=version)
+                endpoint=f'{host}:{port}', version=version, spot=spot)
         except Exception as e:  # pylint: disable=broad-except
             logger.warning(f'Replica {replica_id} launch failed: {e}')
             self.launch_failures += 1
             serve_state.upsert_replica(self.service_name, replica_id,
                                        cluster_name,
                                        serve_state.ReplicaStatus.FAILED,
-                                       version=version)
+                                       version=version, spot=spot)
 
     def terminate_replica(self, replica_id: int) -> None:
         record = next((r for r in self.replicas()

@@ -25,6 +25,9 @@ class SkyServiceSpec:
             raise ValueError(
                 'autoscaling (target_qps_per_replica) requires '
                 'max_replicas')
+        if base_ondemand_fallback_replicas < 0:
+            raise ValueError(
+                'base_ondemand_fallback_replicas must be >= 0')
         from skypilot_tpu.serve import load_balancing_policies as lb_pol
         if load_balancing_policy not in lb_pol.POLICIES:
             raise ValueError(

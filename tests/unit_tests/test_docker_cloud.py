@@ -1,0 +1,142 @@
+"""Local docker cloud: opt-in gating (`xsky local up/down`), provisioner
+lifecycle against a mocked docker CLI, optimizer integration."""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+import pytest
+from click.testing import CliRunner
+
+from skypilot_tpu.clouds import docker as docker_cloud
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.docker import instance as docker_instance
+
+
+class FakeDockerCli:
+    """In-memory docker CLI: create/inspect/rm/stop/start/ps."""
+
+    def __init__(self) -> None:
+        self.containers: Dict[str, Dict[str, Any]] = {}
+        self._ip = 0
+
+    def __call__(self, args: List[str], input_data=None,
+                 timeout: float = 120.0) -> str:
+        cmd = args[0]
+        if cmd == 'ps':
+            label = next(a for a in args if a.startswith('label='))
+            cluster = label.split('=')[2]
+            lines = []
+            for name, c in self.containers.items():
+                if c['Config']['Labels'].get(
+                        docker_instance._LABEL) != cluster:
+                    continue
+                status = ('Up 2 minutes' if c['State']['Running']
+                          else 'Exited (0) 1 minute ago')
+                lines.append(json.dumps({'Names': name,
+                                         'Status': status}))
+            return '\n'.join(lines)
+        if cmd == 'run':
+            name = args[args.index('--name') + 1]
+            labels = {}
+            for i, a in enumerate(args):
+                if a == '--label':
+                    k, _, v = args[i + 1].partition('=')
+                    labels[k] = v
+            self._ip += 1
+            self.containers[name] = {
+                'Config': {'Labels': labels},
+                'State': {'Running': True},
+                'NetworkSettings': {'IPAddress': f'172.17.0.{self._ip}'},
+            }
+            return name
+        if cmd == 'inspect':
+            return json.dumps([self.containers[args[1]]])
+        if cmd == 'rm':
+            for name in args[1:]:
+                if name != '-f':
+                    self.containers.pop(name, None)
+            return ''
+        if cmd in ('stop', 'start'):
+            for name in args[1:]:
+                self.containers[name]['State']['Running'] = \
+                    (cmd == 'start')
+            return ''
+        if cmd == 'exec':
+            return ''
+        raise AssertionError(f'unhandled docker {args}')
+
+
+@pytest.fixture()
+def fake_docker(monkeypatch):
+    fake = FakeDockerCli()
+    monkeypatch.setattr(docker_instance, '_run_docker', fake)
+    yield fake
+
+
+def _config(count=1):
+    return common.ProvisionConfig(provider_config={},
+                                  node_config={'instance_type':
+                                               'container'},
+                                  count=count)
+
+
+def test_provisioner_lifecycle(fake_docker):
+    record = docker_instance.run_instances('local', None, 'c1',
+                                           _config(count=2))
+    assert len(record.created_instance_ids) == 2
+    info = docker_instance.get_cluster_info('local', 'c1', {})
+    assert info.num_instances == 2
+    assert info.head_instance_id is not None
+    assert all(h.internal_ip for h in info.sorted_instances())
+    statuses = docker_instance.query_instances('c1', {})
+    assert set(statuses.values()) == {'RUNNING'}
+    docker_instance.terminate_instances('c1', {})
+    assert docker_instance.query_instances('c1', {}) == {}
+
+
+def test_opt_in_gating(monkeypatch, tmp_path):
+    cloud = docker_cloud.Docker()
+    monkeypatch.delenv('XSKY_ENABLE_DOCKER_CLOUD', raising=False)
+    monkeypatch.setattr(docker_cloud.Docker, 'MARKER_PATH',
+                        str(tmp_path / 'enable_docker'))
+    # Not opted in: disabled regardless of a live daemon.
+    ok, reason = cloud.check_credentials()
+    assert not ok and 'local up' in reason
+    # Marker + daemon => enabled.
+    (tmp_path / 'enable_docker').write_text('on\n')
+    monkeypatch.setattr(docker_cloud.Docker, 'daemon_available',
+                        classmethod(lambda cls: (True, None)))
+    ok, _ = cloud.check_credentials()
+    assert ok
+
+
+def test_feasibility_cpu_only():
+    from skypilot_tpu import resources as resources_lib
+    cloud = docker_cloud.Docker()
+    feasible, _ = cloud.get_feasible_launchable_resources(
+        resources_lib.Resources())
+    assert feasible and feasible[0].instance_type == 'container'
+    assert feasible[0].get_hourly_cost() == 0.0
+    # Accelerators and spot never land on local containers.
+    feasible, _ = cloud.get_feasible_launchable_resources(
+        resources_lib.Resources(accelerators='A100:1'))
+    assert feasible == []
+    feasible, _ = cloud.get_feasible_launchable_resources(
+        resources_lib.Resources(use_spot=True))
+    assert feasible == []
+
+
+def test_local_up_down_verbs(monkeypatch, tmp_path):
+    from skypilot_tpu.client import cli
+    monkeypatch.setenv('XSKY_ENABLE_DOCKER_CLOUD', '1')
+    monkeypatch.setattr(docker_cloud.Docker, 'MARKER_PATH',
+                        str(tmp_path / 'enable_docker'))
+    runner = CliRunner()
+    result = runner.invoke(cli.cli, ['local', 'up'])
+    assert result.exit_code == 0, result.output
+    assert (tmp_path / 'enable_docker').exists()
+    monkeypatch.setattr('skypilot_tpu.core.status', lambda **kw: [])
+    result = runner.invoke(cli.cli, ['local', 'down', '-y'])
+    assert result.exit_code == 0, result.output
+    assert not (tmp_path / 'enable_docker').exists()
