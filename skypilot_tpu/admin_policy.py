@@ -1,12 +1,19 @@
 """Pluggable admin policy hook (twin of sky/admin_policy.py:246).
 
-Config key ``admin_policy`` names a class path; the class implements
-``apply(dag) -> dag`` to mutate/validate every request centrally, or
-raises to reject (UserRequestRejectedByPolicy).
+Config key ``admin_policy`` names either a class path (the class
+implements ``apply(dag) -> dag`` to mutate/validate every request
+centrally, or raises to reject), or an ``http(s)://`` URL — the
+RestfulAdminPolicy twin (sky/admin_policy.py:207): each task's config
+is POSTed to the URL, which replies with the (possibly mutated) config
+or an HTTP error to reject.
 """
 from __future__ import annotations
 
 import importlib
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
 from typing import Optional
 
 from skypilot_tpu import config as config_lib
@@ -21,10 +28,94 @@ class AdminPolicy:
         return dag
 
 
+class RestfulAdminPolicy(AdminPolicy):
+    """POST the whole request to a central policy endpoint.
+
+    Wire contract: one POST per user request with body
+    {"dag_name": ..., "tasks": [<task config dict>, ...]}; a 2xx
+    response with an empty body keeps the request as-is, a JSON body
+    {"tasks": [...]} (same length) replaces the task configs; any
+    other status rejects the request with the response text. One
+    round-trip regardless of DAG size, and the endpoint sees every
+    task so it can enforce cross-task invariants.
+    """
+
+    def __init__(self, policy_url: str) -> None:
+        self.policy_url = policy_url
+
+    def apply(self, dag: dag_lib.Dag) -> dag_lib.Dag:
+        from skypilot_tpu import sky_logging
+        from skypilot_tpu import task as task_lib
+        logger = sky_logging.init_logger(__name__)
+        for task in dag.tasks:
+            if task.run is not None and not isinstance(task.run, str):
+                # A callable `run` cannot survive the YAML round trip;
+                # silently dropping it would launch a cluster that runs
+                # nothing — and silently skipping the policy would be
+                # an enforcement hole.
+                raise exceptions.UserRequestRejectedByPolicy(
+                    'URL admin policies require YAML-serializable '
+                    'tasks; a task with a callable `run` cannot be '
+                    'submitted under a RESTful admin policy.')
+        host = urllib.parse.urlsplit(self.policy_url).hostname or ''
+        if (self.policy_url.startswith('http://') and
+                host not in ('localhost', '127.0.0.1', '::1')):
+            logger.warning(
+                f'admin_policy {self.policy_url} is plain http: task '
+                'configs (including secrets) transit unencrypted. Use '
+                'https.')
+        body = json.dumps({
+            'dag_name': dag.name,
+            'tasks': [t.to_yaml_config() for t in dag.tasks],
+        }).encode()
+        req = urllib.request.Request(
+            self.policy_url, data=body, method='POST',
+            headers={'Content-Type': 'application/json'})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as e:
+            detail = (e.read() or b'').decode(errors='replace')
+            raise exceptions.UserRequestRejectedByPolicy(
+                f'Admin policy {self.policy_url} rejected the '
+                f'request ({e.code}): {detail.strip()}') from e
+        except urllib.error.URLError as e:
+            raise exceptions.UserRequestRejectedByPolicy(
+                f'Admin policy {self.policy_url} unreachable: '
+                f'{e}') from e
+        if not payload:
+            return dag
+        try:
+            reply = json.loads(payload)
+        except ValueError as e:
+            raise exceptions.UserRequestRejectedByPolicy(
+                f'Admin policy {self.policy_url} returned invalid '
+                f'JSON: {e}') from e
+        configs = reply.get('tasks') if isinstance(reply, dict) else None
+        if configs is None:
+            return dag
+        if len(configs) != len(dag.tasks):
+            raise exceptions.UserRequestRejectedByPolicy(
+                f'Admin policy {self.policy_url} returned '
+                f'{len(configs)} tasks for a {len(dag.tasks)}-task '
+                'request.')
+        new_tasks = [task_lib.Task.from_yaml_config(c) for c in configs]
+        new_dag = dag_lib.Dag(name=dag.name)
+        replacement = dict(zip(dag.tasks, new_tasks))
+        for t in new_tasks:
+            new_dag.add(t)
+        for old in dag.tasks:              # preserve the edge structure
+            for succ in dag.downstream(old):
+                new_dag.add_edge(replacement[old], replacement[succ])
+        return new_dag
+
+
 def _load_policy() -> Optional[AdminPolicy]:
     path = config_lib.get_nested(('admin_policy',))
     if not path:
         return None
+    if path.startswith(('http://', 'https://')):
+        return RestfulAdminPolicy(path)
     module_name, _, class_name = path.rpartition('.')
     try:
         cls = getattr(importlib.import_module(module_name), class_name)

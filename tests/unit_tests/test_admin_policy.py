@@ -1,0 +1,188 @@
+"""Admin policy hooks: class-path and RESTful-URL variants (twin of
+sky/admin_policy.py incl. RestfulAdminPolicy:207)."""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from skypilot_tpu import admin_policy
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+
+
+class ForceNamePolicy(admin_policy.AdminPolicy):
+    """Test class-path policy: prefixes every task name."""
+
+    def apply(self, dag):
+        for t in dag.tasks:
+            t.name = f'corp-{t.name or "task"}'
+        return dag
+
+
+class RejectAllPolicy(admin_policy.AdminPolicy):
+
+    def apply(self, dag):
+        raise exceptions.UserRequestRejectedByPolicy('no launches today')
+
+
+@pytest.fixture()
+def policy_config(monkeypatch):
+    def set_policy(value):
+        monkeypatch.setattr(config_lib, 'get_nested',
+                            lambda keys, default=None: value
+                            if keys == ('admin_policy',) else default)
+    return set_policy
+
+
+def _dag(run='echo hi'):
+    d = dag_lib.Dag()
+    d.add(task_lib.Task(run=run, name='mine'))
+    return d
+
+
+def test_no_policy_passthrough(policy_config):
+    policy_config(None)
+    d = _dag()
+    assert admin_policy.apply(d) is d
+
+
+def test_class_path_policy_mutates(policy_config):
+    policy_config(f'{__name__}.ForceNamePolicy')
+    out = admin_policy.apply(_dag())
+    assert out.tasks[0].name == 'corp-mine'
+
+
+def test_class_path_policy_rejects(policy_config):
+    policy_config(f'{__name__}.RejectAllPolicy')
+    with pytest.raises(exceptions.UserRequestRejectedByPolicy):
+        admin_policy.apply(_dag())
+
+
+class _PolicyHandler(BaseHTTPRequestHandler):
+    mode = 'mutate'
+    seen_bodies: list = []
+
+    def do_POST(self):
+        body = json.loads(
+            self.rfile.read(int(self.headers['Content-Length'])))
+        type(self).seen_bodies.append(body)
+        if self.mode == 'reject':
+            payload = b'GPU quota exceeded for your team'
+            self.send_response(403)
+            self.send_header('Content-Length', str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
+        if self.mode == 'empty':
+            self.send_response(200)
+            self.send_header('Content-Length', '0')
+            self.end_headers()
+            return
+        if self.mode == 'garbage':
+            payload = b'OK'
+            self.send_response(200)
+            self.send_header('Content-Length', str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
+        configs = body['tasks']
+        if self.mode == 'mutate':
+            for config in configs:
+                config['name'] = 'policy-renamed'
+        payload = json.dumps({'tasks': configs}).encode()
+        self.send_response(200)
+        self.send_header('Content-Length', str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def policy_server():
+    server = HTTPServer(('127.0.0.1', 0), _PolicyHandler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f'http://127.0.0.1:{server.server_port}/policy'
+    server.shutdown()
+
+
+def test_restful_policy_mutates(policy_config, policy_server):
+    _PolicyHandler.mode = 'mutate'
+    policy_config(policy_server)
+    out = admin_policy.apply(_dag())
+    assert out.tasks[0].name == 'policy-renamed'
+    # The run command survived the round trip.
+    assert out.tasks[0].run == 'echo hi'
+
+
+def test_restful_policy_rejects_with_detail(policy_config,
+                                            policy_server):
+    _PolicyHandler.mode = 'reject'
+    policy_config(policy_server)
+    with pytest.raises(exceptions.UserRequestRejectedByPolicy,
+                       match='GPU quota exceeded'):
+        admin_policy.apply(_dag())
+
+
+def test_restful_policy_unreachable(policy_config):
+    policy_config('http://127.0.0.1:9/never')
+    with pytest.raises(exceptions.UserRequestRejectedByPolicy,
+                       match='unreachable'):
+        admin_policy.apply(_dag())
+
+
+def test_restful_policy_preserves_chain_in_one_post(policy_config,
+                                                    policy_server):
+    _PolicyHandler.mode = 'passthrough'
+    _PolicyHandler.seen_bodies = []
+    policy_config(policy_server)
+    d = dag_lib.Dag()
+    a = task_lib.Task(run='echo a', name='a')
+    b = task_lib.Task(run='echo b', name='b')
+    d.add(a)
+    d.add(b)
+    d.add_edge(a, b)
+    out = admin_policy.apply(d)
+    assert [t.name for t in out.tasks] == ['a', 'b']
+    assert out.is_chain()
+    assert out.downstream(out.tasks[0]) == [out.tasks[1]]
+    # The whole DAG went over in ONE request (cross-task invariants
+    # are enforceable; latency is one round trip).
+    assert len(_PolicyHandler.seen_bodies) == 1
+    assert len(_PolicyHandler.seen_bodies[0]['tasks']) == 2
+
+
+def test_restful_policy_empty_body_keeps_request(policy_config,
+                                                 policy_server):
+    _PolicyHandler.mode = 'empty'
+    policy_config(policy_server)
+    d = _dag()
+    out = admin_policy.apply(d)
+    assert out.tasks[0].name == 'mine'
+
+
+def test_restful_policy_invalid_json_is_diagnosable(policy_config,
+                                                    policy_server):
+    _PolicyHandler.mode = 'garbage'
+    policy_config(policy_server)
+    with pytest.raises(exceptions.UserRequestRejectedByPolicy,
+                       match='invalid JSON'):
+        admin_policy.apply(_dag())
+
+
+def test_restful_policy_rejects_callable_run(policy_config,
+                                             policy_server):
+    _PolicyHandler.mode = 'passthrough'
+    policy_config(policy_server)
+    d = dag_lib.Dag()
+    d.add(task_lib.Task(run=lambda rank, ips: 'echo hi', name='prog'))
+    with pytest.raises(exceptions.UserRequestRejectedByPolicy,
+                       match='callable'):
+        admin_policy.apply(d)
