@@ -3,9 +3,10 @@
 Config key ``admin_policy`` names either a class path (the class
 implements ``apply(dag) -> dag`` to mutate/validate every request
 centrally, or raises to reject), or an ``http(s)://`` URL — the
-RestfulAdminPolicy twin (sky/admin_policy.py:207): each task's config
-is POSTed to the URL, which replies with the (possibly mutated) config
-or an HTTP error to reject.
+RestfulAdminPolicy twin (sky/admin_policy.py:207): ONE POST per user
+request carrying ``{"dag_name": ..., "tasks": [<config>, ...]}``; the
+endpoint replies 2xx with ``{"tasks": [...]}`` (or an empty body to
+keep the request unchanged), or any error status to reject.
 """
 from __future__ import annotations
 
@@ -26,6 +27,14 @@ class AdminPolicy:
 
     def apply(self, dag: dag_lib.Dag) -> dag_lib.Dag:
         return dag
+
+
+class _NoRedirect(urllib.request.HTTPRedirectHandler):
+    """Turn any 3xx into an HTTPError instead of following it."""
+
+    def redirect_request(self, req, fp, code, msg, headers, newurl):
+        del req, fp, code, msg, headers, newurl
+        return None
 
 
 class RestfulAdminPolicy(AdminPolicy):
@@ -71,8 +80,13 @@ class RestfulAdminPolicy(AdminPolicy):
         req = urllib.request.Request(
             self.policy_url, data=body, method='POST',
             headers={'Content-Type': 'application/json'})
+        # Refuse redirects: urllib would replay a redirected POST as a
+        # body-less GET — the policy endpoint would never see the tasks
+        # and an empty 200 would silently approve. Fail closed: a 3xx
+        # surfaces as HTTPError -> rejection.
+        opener = urllib.request.build_opener(_NoRedirect())
         try:
-            with urllib.request.urlopen(req, timeout=30) as resp:
+            with opener.open(req, timeout=30) as resp:
                 payload = resp.read()
         except urllib.error.HTTPError as e:
             detail = (e.read() or b'').decode(errors='replace')

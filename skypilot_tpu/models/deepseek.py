@@ -30,6 +30,7 @@ asymmetric compressed cache instead of the [KVH, HD] default.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -38,6 +39,7 @@ import jax.numpy as jnp
 from skypilot_tpu.models import llama
 from skypilot_tpu.models import moe as moe_lib
 from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.ops import mla_decode as mla_decode_ops
 from skypilot_tpu.ops import quantization as qops
 from skypilot_tpu.parallel import mesh as mesh_lib
 
@@ -345,20 +347,34 @@ def _mla_attention(c: DeepSeekConfig, mesh, x: jax.Array, lp: Params,
             k_rope[:, 0, 0].astype(cv.dtype))
         w_ukv = lp['w_ukv'].reshape(c.kv_lora_rank, h, dn + dv)
         w_uk, w_uv = w_ukv[..., :dn], w_ukv[..., dn:]
-        latents = ck[:, :, 0].astype(jnp.float32)        # [B,K,r]
-        ropes = cv[:, :, 0].astype(jnp.float32)          # [B,K,dr]
         q_eff = jnp.einsum('bhd,rhd->bhr',
                            q_nope[:, 0].astype(jnp.float32),
                            w_uk.astype(jnp.float32))
-        scores = (jnp.einsum('bhr,btr->bht', q_eff, latents) +
-                  jnp.einsum('bhd,btd->bht',
-                             q_rope[:, 0].astype(jnp.float32), ropes))
-        scores = scores * ((dn + dr) ** -0.5)
-        valid = (jnp.arange(ck.shape[1])[None, None, :] <=
-                 cache_positions[:, None, None])
-        scores = jnp.where(valid, scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        o_c = jnp.einsum('bht,btr->bhr', probs, latents)
+        scale = (dn + dr) ** -0.5
+        max_len = ck.shape[1]
+        if (mesh is None and
+                max_len % min(mla_decode_ops.DEFAULT_BLOCK_KV,
+                              max_len) == 0 and
+                os.environ.get('XSKY_DECODE_ATTN') != 'xla'):
+            # Length-bounded Pallas kernel: each slot reads only its
+            # live cache blocks (the compressed cache is the whole HBM
+            # cost of MLA decode).
+            o_c = mla_decode_ops.mla_decode_attention(
+                q_eff, q_rope[:, 0].astype(jnp.float32),
+                ck[:, :, 0], cv[:, :, 0],
+                lengths=cache_positions + 1, scale=scale)
+        else:
+            latents = ck[:, :, 0].astype(jnp.float32)    # [B,K,r]
+            ropes = cv[:, :, 0].astype(jnp.float32)      # [B,K,dr]
+            scores = (jnp.einsum('bhr,btr->bht', q_eff, latents) +
+                      jnp.einsum('bhd,btd->bht',
+                                 q_rope[:, 0].astype(jnp.float32),
+                                 ropes)) * scale
+            valid = (jnp.arange(max_len)[None, None, :] <=
+                     cache_positions[:, None, None])
+            scores = jnp.where(valid, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            o_c = jnp.einsum('bht,btr->bhr', probs, latents)
         attn = jnp.einsum('bhr,rhd->bhd', o_c,
                           w_uv.astype(jnp.float32))
         attn = attn.astype(c.dtype).reshape(b, 1, h * dv)

@@ -1,0 +1,146 @@
+"""Pallas decode kernel for MLA (compressed-latent) attention.
+
+The absorbed MLA decode (models/deepseek.py) scores queries directly
+against the compressed cache:
+
+    scores[b,h,t] = q_eff[b,h,:]·c_kv[b,t,:] + q_rope[b,h,:]·k_rope[b,t,:]
+    out_c[b,h,:]  = softmax(scores)·c_kv[b,:,:]
+
+The XLA path reads every slot's whole padded cache each step; like the
+dense decode kernel (ops/decode_attention.py) this kernel bounds reads
+per slot by its true length via scalar-prefetched lengths — past-the-
+end blocks clamp to the last live block so Mosaic elides their DMAs,
+and compute is @pl.when-gated on the same predicate.
+
+The rank-side matmuls (q_eff = q_nope·W_uk before, out = out_c·W_uv
+after) stay OUTSIDE the kernel: they are dense batched matmuls XLA
+already tiles onto the MXU, and keeping them out keeps kernel VMEM to
+one [H, r] accumulator.
+
+Numerics follow the flash kernels (online softmax, fp32 accumulators);
+tests pin equality against the masked XLA reference.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_KV = 256
+_NEG_INF = -1e30
+_LANES = 128
+
+
+def _should_interpret() -> bool:
+    return jax.default_backend() != 'tpu'
+
+
+def _mla_decode_kernel(lengths_ref, q_eff_ref, q_rope_ref, ckv_ref,
+                       krope_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                       scale: float, block_kv: int):
+    b = pl.program_id(0)
+    ki = pl.program_id(1)
+    num_ki = pl.num_programs(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    length = lengths_ref[b]
+    last = jnp.maximum(length - 1, 0) // block_kv
+    blk = jnp.minimum(ki, last)
+    kv_start = blk * block_kv
+
+    @pl.when(ki <= last)
+    def _body():
+        q_eff = q_eff_ref[0].astype(jnp.float32)       # [H, r]
+        q_rope = q_rope_ref[0].astype(jnp.float32)     # [H, dr]
+        ckv = ckv_ref[0].astype(jnp.float32)           # [bkv, r]
+        krope = krope_ref[0].astype(jnp.float32)       # [bkv, dr]
+        s = (jax.lax.dot_general(
+                q_eff, ckv, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) +
+             jax.lax.dot_general(
+                q_rope, krope, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)) * scale  # [H, bkv]
+        pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < length, s, _NEG_INF)
+
+        m_prev = m_ref[:, 0:1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_ref[:, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, ckv, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [H, r]
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == num_ki - 1)
+    def _finalize():
+        l = l_ref[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def mla_decode_attention(q_eff: jax.Array, q_rope: jax.Array,
+                         ckv_cache: jax.Array, krope_cache: jax.Array,
+                         lengths: jax.Array, scale: float,
+                         block_kv: int = DEFAULT_BLOCK_KV) -> jax.Array:
+    """Length-bounded absorbed-MLA decode → out_c [B, H, r] (fp32).
+
+    q_eff: [B, H, r] (q_nope already absorbed through W_uk);
+    q_rope: [B, H, dr]; ckv_cache: [B, K, r]; krope_cache: [B, K, dr];
+    lengths: [B] live rows per slot (the step's own entry already
+    written at lengths[b]-1). The caller applies W_uv afterwards.
+    """
+    b, h, r = q_eff.shape
+    dr = q_rope.shape[-1]
+    max_len = ckv_cache.shape[1]
+    block_kv = min(block_kv, max_len)
+    if max_len % block_kv != 0:
+        raise ValueError(f'max_len {max_len} % block_kv {block_kv} != 0')
+    num_blocks = max_len // block_kv
+    lengths = lengths.astype(jnp.int32)
+
+    def q_map(bi, ki, lens):
+        del ki, lens
+        return (bi, 0, 0)
+
+    def kv_map(bi, ki, lens):
+        length = lens[bi]
+        last = jnp.maximum(length - 1, 0) // block_kv
+        return (bi, jnp.minimum(ki, last), 0)
+
+    kernel = functools.partial(_mla_decode_kernel, scale=scale,
+                               block_kv=block_kv)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, num_blocks),
+        in_specs=[
+            pl.BlockSpec((1, h, r), q_map),
+            pl.BlockSpec((1, h, dr), q_map),
+            pl.BlockSpec((1, block_kv, r), kv_map),
+            pl.BlockSpec((1, block_kv, dr), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, h, r), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((h, r), jnp.float32),
+            pltpu.VMEM((h, _LANES), jnp.float32),
+            pltpu.VMEM((h, _LANES), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, r), jnp.float32),
+        interpret=_should_interpret(),
+    )(lengths, q_eff, q_rope, ckv_cache, krope_cache)

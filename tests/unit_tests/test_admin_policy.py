@@ -71,6 +71,14 @@ class _PolicyHandler(BaseHTTPRequestHandler):
         body = json.loads(
             self.rfile.read(int(self.headers['Content-Length'])))
         type(self).seen_bodies.append(body)
+        if self.mode == 'redirect':
+            # A redirected POST must be rejected, not silently replayed
+            # as a body-less GET.
+            self.send_response(302)
+            self.send_header('Location', 'http://127.0.0.1:9/elsewhere')
+            self.send_header('Content-Length', '0')
+            self.end_headers()
+            return
         if self.mode == 'reject':
             payload = b'GPU quota exceeded for your team'
             self.send_response(403)
@@ -174,6 +182,15 @@ def test_restful_policy_invalid_json_is_diagnosable(policy_config,
     policy_config(policy_server)
     with pytest.raises(exceptions.UserRequestRejectedByPolicy,
                        match='invalid JSON'):
+        admin_policy.apply(_dag())
+
+
+def test_restful_policy_rejects_redirects(policy_config,
+                                          policy_server):
+    _PolicyHandler.mode = 'redirect'
+    policy_config(policy_server)
+    with pytest.raises(exceptions.UserRequestRejectedByPolicy,
+                       match='302'):
         admin_policy.apply(_dag())
 
 
