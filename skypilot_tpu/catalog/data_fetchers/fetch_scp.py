@@ -1,0 +1,56 @@
+"""Generate the SCP catalog CSV (twin of the reference's scp rows).
+
+Service zones are the regions; static published on-demand prices for
+the GPU server types plus standard CPU types. No spot market.
+
+Run: python -m skypilot_tpu.catalog.data_fetchers.fetch_scp
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Tuple
+
+# (serverType, acc, count, vcpus, mem_gib, acc_mem_gib, price)
+_SKUS: List[Tuple[str, str, float, float, float, float, float]] = [
+    ('h1v32m128-g1', 'V100', 1, 32, 128, 32, 3.60),
+    ('h1v64m256-g2', 'V100', 2, 64, 256, 64, 7.20),
+    ('h1v128m512-g4', 'V100', 4, 128, 512, 128, 14.40),
+    ('h2v32m192-ga1', 'A100', 1, 32, 192, 80, 5.10),
+    ('h2v64m384-ga2', 'A100', 2, 64, 384, 160, 10.20),
+    ('h2v128m768-ga4', 'A100', 4, 128, 768, 320, 20.40),
+    ('s1v2m4', '', 0, 2, 4, 0, 0.06),
+    ('s1v4m8', '', 0, 4, 8, 0, 0.12),
+    ('s1v8m16', '', 0, 8, 16, 0, 0.24),
+]
+
+_REGIONS = ['kr-west-1', 'kr-west-2', 'kr-east-1']
+
+HEADER = ['InstanceType', 'AcceleratorName', 'AcceleratorCount', 'vCPUs',
+          'MemoryGiB', 'AcceleratorMemoryGiB', 'Price', 'SpotPrice',
+          'Region', 'AvailabilityZone']
+
+
+def rows_static() -> List[List[str]]:
+    out = []
+    for itype, acc, count, vcpus, mem, acc_mem, price in _SKUS:
+        for region in _REGIONS:
+            out.append([itype, acc, f'{count:g}', f'{vcpus:g}',
+                        f'{mem:g}', f'{acc_mem:g}', f'{price:.4f}', '0',
+                        region, region])
+    return out
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, 'data', 'scp', 'catalog.csv')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.writer(f)
+        writer.writerow(HEADER)
+        writer.writerows(rows_static())
+    print(f'Wrote {path} (static snapshot)')
+
+
+if __name__ == '__main__':
+    main()

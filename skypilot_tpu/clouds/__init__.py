@@ -18,10 +18,11 @@ from skypilot_tpu.clouds.nebius import Nebius
 from skypilot_tpu.clouds.oci import OCI
 from skypilot_tpu.clouds.paperspace import Paperspace
 from skypilot_tpu.clouds.runpod import RunPod
+from skypilot_tpu.clouds.scp import SCP
 from skypilot_tpu.clouds.ssh import SSH
 from skypilot_tpu.clouds.vast import Vast
 
 __all__ = ['Cloud', 'CloudImplementationFeatures', 'Region', 'GCP', 'Fake',
            'AWS', 'Azure', 'Cudo', 'DO', 'Docker', 'Fluidstack',
            'Hyperbolic', 'IBM', 'Kubernetes', 'Lambda', 'Nebius', 'OCI',
-           'Paperspace', 'RunPod', 'SSH', 'Vast']
+           'Paperspace', 'RunPod', 'SCP', 'SSH', 'Vast']

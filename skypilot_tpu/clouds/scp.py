@@ -1,0 +1,87 @@
+"""Samsung Cloud Platform: GPU virtual servers for cross-cloud
+optimization.
+
+Lean twin of sky/clouds/scp.py — catalog-backed feasibility via
+CatalogCloud, deploy variables for the 'scp' provisioner. Platform
+facts: service zones as regions (kr-west-1 etc.), stop/start
+supported, no spot market, HMAC-signed OpenAPI credentials in
+~/.scp/scp_credential.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import catalog_cloud
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@registry.CLOUD_REGISTRY.register()
+class SCP(catalog_cloud.CatalogCloud):
+    _REPR = 'SCP'
+
+    _UNSUPPORTED = {
+        cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE:
+            'SCP has no spot market.',
+        cloud_lib.CloudImplementationFeatures.OPEN_PORTS:
+            'SCP port policy rides project security groups.',
+        cloud_lib.CloudImplementationFeatures.CUSTOM_DISK_TIER:
+            'SCP block storage has a single tier here.',
+        cloud_lib.CloudImplementationFeatures.MULTI_NODE:
+            'Multi-node SCP clusters need project VPC peering; '
+            'single-node only for now.',
+    }
+
+    @property
+    def provisioner_module(self) -> str:
+        return 'scp'
+
+    def unsupported_features_for_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        return dict(self._UNSUPPORTED)
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        vars: Dict[str, Any] = {
+            'cluster_name': cluster_name,
+            'region': region,
+            'zone': None,
+            'instance_type': resources.instance_type,
+            'image_id': resources.image_id,
+            'disk_size': resources.disk_size,
+            'use_spot': False,
+        }
+        if resources.accelerators:
+            name, count = next(iter(resources.accelerators.items()))
+            vars.update({'gpu_type': name, 'gpu_count': count})
+        return vars
+
+    def provider_config_overrides(
+            self, node_config: Dict[str, Any]) -> Dict[str, Any]:
+        del node_config
+        return {}
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.scp import rest
+        if rest.load_credentials() is not None:
+            return True, None
+        return False, (
+            f'SCP credentials not found. Populate {rest.CREDENTIALS_PATH} '
+            'with `access_key = ...`, `secret_key = ...`, '
+            '`project_id = ...` lines.')
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        from skypilot_tpu.provision.scp import rest
+        if os.path.exists(os.path.expanduser(rest.CREDENTIALS_PATH)):
+            return {rest.CREDENTIALS_PATH: rest.CREDENTIALS_PATH}
+        return {}
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        return num_gigabytes * 0.09

@@ -29,13 +29,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_KV = 256
-_NEG_INF = -1e30
-_LANES = 128
-
-
-def _should_interpret() -> bool:
-    return jax.default_backend() != 'tpu'
+# Shared with the dense decode kernel: one source of truth for the
+# backend switch, block size, and the last-live-block clamp.
+from skypilot_tpu.ops.decode_attention import _LANES
+from skypilot_tpu.ops.decode_attention import _last_block
+from skypilot_tpu.ops.decode_attention import _NEG_INF
+from skypilot_tpu.ops.decode_attention import _should_interpret
+from skypilot_tpu.ops.decode_attention import DEFAULT_BLOCK_KV
 
 
 def _mla_decode_kernel(lengths_ref, q_eff_ref, q_rope_ref, ckv_ref,
@@ -52,7 +52,7 @@ def _mla_decode_kernel(lengths_ref, q_eff_ref, q_rope_ref, ckv_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
 
     length = lengths_ref[b]
-    last = jnp.maximum(length - 1, 0) // block_kv
+    last = _last_block(length, block_kv)
     blk = jnp.minimum(ki, last)
     kv_start = blk * block_kv
 
@@ -116,9 +116,7 @@ def mla_decode_attention(q_eff: jax.Array, q_rope: jax.Array,
         return (bi, 0, 0)
 
     def kv_map(bi, ki, lens):
-        length = lens[bi]
-        last = jnp.maximum(length - 1, 0) // block_kv
-        return (bi, jnp.minimum(ki, last), 0)
+        return (bi, jnp.minimum(ki, _last_block(lens[bi], block_kv)), 0)
 
     kernel = functools.partial(_mla_decode_kernel, scale=scale,
                                block_kv=block_kv)

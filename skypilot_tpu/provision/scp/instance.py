@@ -1,0 +1,206 @@
+"""SCP provisioner op-set (virtual servers via the nodepool base).
+
+Behavioral twin of sky/provision/scp/instance.py, reshaped to the
+shared nodepool lifecycle: membership rides the virtual-server NAME
+(`<cluster>-<index>`), stored server-side. Platform facts: zonal
+service zones (the catalog region is the service zone), stop/start
+supported, one NAT/public IP per server when assigned, no spot market;
+servers need a service zone + subnet + image, auto-discovered from the
+project (first available of each), with the SSH key injected via the
+init script — the same bring-up the reference drives through its VPC
+helpers (sky/provision/scp/config.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import nodepool
+from skypilot_tpu.provision.scp import rest
+
+_transport_factory = rest.Transport
+
+
+def set_transport_factory(factory) -> None:
+    global _transport_factory
+    _transport_factory = factory
+
+
+class ScpApi(nodepool.NodeApi):
+    provider_name = 'scp'
+    ssh_user = 'root'
+    supports_stop = True
+    state_map = {
+        'creating': 'PENDING',
+        'editing': 'PENDING',
+        'starting': 'PENDING',
+        'restarting': 'PENDING',
+        'running': 'RUNNING',
+        'stopping': 'STOPPING',
+        'stopped': 'STOPPED',
+        'terminating': None,
+        'terminated': None,
+        'error': None,
+    }
+
+    def __init__(self) -> None:
+        self.t = _transport_factory()
+
+    @staticmethod
+    def _row(vs: Dict[str, Any]) -> Dict[str, Any]:
+        return {'id': vs.get('virtualServerId'),
+                'name': vs.get('virtualServerName', ''),
+                'status': vs.get('virtualServerState', ''),
+                'public_ip': vs.get('natIpAddress') or
+                vs.get('publicIpAddress'),
+                'private_ip': vs.get('ip') or vs.get('ipAddress')}
+
+    def list_nodes(self) -> List[Dict[str, Any]]:
+        reply = self.t.call('GET',
+                            '/virtual-server/v2/virtual-servers')
+        return [self._row(vs) for vs in reply.get('contents', [])]
+
+    def _service_zone(self, region: str) -> str:
+        zones = self.t.call(
+            'GET', '/project/v3/projects/zones').get('contents', [])
+        for z in zones:
+            if z.get('serviceZoneName') == region or \
+                    z.get('serviceZoneLocation') == region:
+                return z['serviceZoneId']
+        if zones:
+            return zones[0]['serviceZoneId']
+        raise exceptions.ProvisionError('SCP project has no '
+                                        'service zones.')
+
+    def _subnet(self, zone_id: str) -> str:
+        subnets = self.t.call('GET', '/subnet/v2/subnets').get(
+            'contents', [])
+        for s in subnets:
+            if s.get('serviceZoneId') in (None, zone_id) and \
+                    s.get('subnetState') in (None, 'ACTIVE'):
+                return s['subnetId']
+        raise exceptions.ProvisionError(
+            'No SCP subnet found; create a VPC + subnet first.')
+
+    def _image(self, zone_id: str, image_id: Optional[str]) -> str:
+        if image_id:
+            return image_id
+        images = self.t.call(
+            'GET', '/image/v2/standard-images',
+            query={'serviceZoneId': zone_id}).get('contents', [])
+        for img in images:
+            if 'ubuntu' in (img.get('imageName') or '').lower():
+                return img['imageId']
+        if images:
+            return images[0]['imageId']
+        raise exceptions.ProvisionError('No SCP standard image found.')
+
+    def create_node(self, name: str, region: str, zone: Optional[str],
+                    node_config: Dict[str, Any]) -> str:
+        del zone
+        import os
+        from skypilot_tpu import authentication
+        _, public_key_path = authentication.get_or_generate_keys()
+        with open(os.path.expanduser(public_key_path),
+                  encoding='utf-8') as f:
+            public_key = f.read().strip()
+        zone_id = self._service_zone(region)
+        init_script = ('#!/bin/bash\n'
+                       'mkdir -p /root/.ssh\n'
+                       f"echo '{public_key}' >> "
+                       '/root/.ssh/authorized_keys\n')
+        reply = self.t.call('POST',
+                            '/virtual-server/v4/virtual-servers', {
+                                'virtualServerName': name,
+                                'serviceZoneId': zone_id,
+                                'serverType':
+                                    node_config['instance_type'],
+                                'imageId': self._image(
+                                    zone_id, node_config.get('image_id')),
+                                'subnetId': self._subnet(zone_id),
+                                'blockStorage': {
+                                    'diskSize':
+                                        node_config.get('disk_size', 100),
+                                },
+                                'nicList': [{'natEnabled': True}],
+                                'initialScriptContent': init_script,
+                                'osAdmin': {'osUserId': 'root'},
+                            })
+        return str(reply.get('resourceId') or
+                   reply.get('virtualServerId') or name)
+
+    def delete_node(self, node_id: str) -> None:
+        self.t.call('DELETE',
+                    f'/virtual-server/v2/virtual-servers/{node_id}')
+
+    def stop_node(self, node_id: str) -> None:
+        self.t.call('POST',
+                    f'/virtual-server/v2/virtual-servers/{node_id}/stop')
+
+    def start_node(self, node_id: str) -> None:
+        self.t.call(
+            'POST',
+            f'/virtual-server/v2/virtual-servers/{node_id}/start')
+
+    def classify(self, e: Exception,
+                 region: Optional[str] = None) -> Exception:
+        if isinstance(e, rest.ScpApiError):
+            return rest.classify_error(e, region)
+        return e
+
+
+def _api(provider_config: Dict[str, Any]) -> ScpApi:
+    del provider_config
+    return ScpApi()
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    return nodepool.run_instances(_api(config.provider_config), region,
+                                  zone, cluster_name, config)
+
+
+def wait_instances(region: str, cluster_name: str, state: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   timeout_s: float = 900.0,
+                   poll_interval_s: float = 5.0) -> None:
+    del region
+    nodepool.wait_instances(_api(provider_config or {}), cluster_name,
+                            state, timeout_s, poll_interval_s)
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    nodepool.stop_instances(_api(provider_config), cluster_name)
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    nodepool.terminate_instances(_api(provider_config), cluster_name)
+
+
+def query_instances(cluster_name: str, provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    return nodepool.query_instances(_api(provider_config), cluster_name)
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Dict[str, Any]
+                     ) -> common.ClusterInfo:
+    del region
+    return nodepool.get_cluster_info(_api(provider_config), cluster_name,
+                                     provider_config)
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    # Port policy rides the project's security groups / firewalls,
+    # which SCP scopes per VPC; NAT-enabled NICs default-allow the
+    # provisioned service ports. Managed per project, not per cluster.
+    del cluster_name, ports, provider_config
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    del cluster_name, provider_config

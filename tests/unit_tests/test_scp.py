@@ -1,0 +1,146 @@
+"""SCP cloud: HMAC signing, provisioner lifecycle against an in-memory
+fake, feasibility/credentials."""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.scp import instance as scp_instance
+from skypilot_tpu.provision.scp import rest
+
+
+class FakeScp:
+    project = 'PROJECT-1'
+
+    def __init__(self) -> None:
+        self.servers: Dict[str, Dict[str, Any]] = {}
+        self.fail_create: Optional[rest.ScpApiError] = None
+        self._next = 0
+
+    def call(self, method, path, body=None, query=None):
+        if path == '/virtual-server/v2/virtual-servers' and \
+                method == 'GET':
+            return {'contents': list(self.servers.values())}
+        if path == '/project/v3/projects/zones':
+            return {'contents': [
+                {'serviceZoneId': 'ZONE-KRW1',
+                 'serviceZoneName': 'kr-west-1'}]}
+        if path == '/subnet/v2/subnets':
+            return {'contents': [{'subnetId': 'SUBNET-1',
+                                  'subnetState': 'ACTIVE',
+                                  'serviceZoneId': 'ZONE-KRW1'}]}
+        if path == '/image/v2/standard-images':
+            return {'contents': [
+                {'imageId': 'IMG-UBU22',
+                 'imageName': 'Ubuntu 22.04 (LTS)'}]}
+        if path == '/virtual-server/v4/virtual-servers' and \
+                method == 'POST':
+            if self.fail_create is not None:
+                err, self.fail_create = self.fail_create, None
+                raise err
+            self._next += 1
+            sid = f'VS-{self._next:04d}'
+            self.servers[sid] = {
+                'virtualServerId': sid,
+                'virtualServerName': body['virtualServerName'],
+                'virtualServerState': 'RUNNING',
+                'ip': f'192.168.0.{self._next}',
+                'natIpAddress': f'27.255.0.{self._next}',
+            }
+            return {'resourceId': sid}
+        if path.endswith('/stop'):
+            sid = path.split('/')[4]
+            self.servers[sid]['virtualServerState'] = 'STOPPED'
+            return {}
+        if path.endswith('/start'):
+            sid = path.split('/')[4]
+            self.servers[sid]['virtualServerState'] = 'RUNNING'
+            return {}
+        if method == 'DELETE':
+            self.servers.pop(path.split('/')[4], None)
+            return {}
+        raise AssertionError(f'unhandled SCP call {method} {path}')
+
+
+@pytest.fixture()
+def fake_scp(monkeypatch, tmp_path):
+    fake = FakeScp()
+    monkeypatch.setattr(scp_instance, '_transport_factory', lambda: fake)
+    from skypilot_tpu import authentication
+    monkeypatch.setattr(authentication, 'PRIVATE_KEY_PATH',
+                        str(tmp_path / 'key'))
+    monkeypatch.setattr(authentication, 'PUBLIC_KEY_PATH',
+                        str(tmp_path / 'key.pub'))
+    yield fake
+
+
+def _config(count=1, itype='h2v32m192-ga1'):
+    return common.ProvisionConfig(
+        provider_config={}, node_config={'instance_type': itype,
+                                         'disk_size': 100},
+        count=count)
+
+
+def test_lifecycle(fake_scp):
+    record = scp_instance.run_instances('kr-west-1', None, 'c1',
+                                        _config())
+    assert len(record.created_instance_ids) == 1
+    info = scp_instance.get_cluster_info('kr-west-1', 'c1', {})
+    host = info.sorted_instances()[0]
+    assert host.external_ip and host.internal_ip
+    scp_instance.stop_instances('c1', {})
+    assert set(scp_instance.query_instances('c1', {}).values()) == \
+        {'STOPPED'}
+    scp_instance.run_instances('kr-west-1', None, 'c1', _config())
+    assert set(scp_instance.query_instances('c1', {}).values()) == \
+        {'RUNNING'}
+    scp_instance.terminate_instances('c1', {})
+    assert scp_instance.query_instances('c1', {}) == {}
+
+
+def test_capacity_classified(fake_scp):
+    fake_scp.fail_create = rest.ScpApiError(
+        500, 'Requested server type is out of stock in the zone.')
+    with pytest.raises(exceptions.CapacityError):
+        scp_instance.run_instances('kr-west-1', None, 'c2', _config())
+
+
+def test_signature_is_deterministic_and_header_complete(monkeypatch,
+                                                        tmp_path):
+    cred = tmp_path / 'scp_credential'
+    cred.write_text('access_key = AK1\nsecret_key = SK1\n'
+                    'project_id = PROJECT-1\n')
+    monkeypatch.setattr(rest, 'CREDENTIALS_PATH', str(cred))
+    t = rest.Transport()
+    sig1 = t._signature('GET', f'{rest.API_ENDPOINT}/x/y?b=2&a=1',
+                        '1700000000000')
+    sig2 = t._signature('GET', f'{rest.API_ENDPOINT}/x/y?b=2&a=1',
+                        '1700000000000')
+    assert sig1 == sig2 and len(sig1) == 44  # b64(sha256)
+    # Different method/timestamp sign differently.
+    assert t._signature('POST', f'{rest.API_ENDPOINT}/x/y?b=2&a=1',
+                        '1700000000000') != sig1
+    assert t._signature('GET', f'{rest.API_ENDPOINT}/x/y?b=2&a=1',
+                        '1700000000001') != sig1
+
+
+def test_cloud_feasibility_and_credentials(monkeypatch, tmp_path):
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.utils import registry
+    cloud = registry.CLOUD_REGISTRY.from_str('scp')
+    r = resources_lib.Resources(accelerators='A100:1')
+    feasible, _ = cloud.get_feasible_launchable_resources(r)
+    assert feasible
+    assert feasible[0].instance_type == 'h2v32m192-ga1'
+    assert feasible[0].get_hourly_cost() == pytest.approx(5.10)
+    monkeypatch.setattr(rest, 'CREDENTIALS_PATH',
+                        str(tmp_path / 'nope'))
+    ok, reason = cloud.check_credentials()
+    assert not ok and 'access_key' in reason
+    (tmp_path / 'nope').write_text(
+        'access_key = a\nsecret_key = s\nproject_id = p\n')
+    ok, _ = cloud.check_credentials()
+    assert ok
