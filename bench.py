@@ -141,10 +141,25 @@ def serve_main() -> None:
             ('tiny-bf16', llama.LLAMA_TINY, 4, 64, 8, 16, 8,
              (16,), False),
         ]
+    def _hbm_note() -> str:
+        """Best-effort free-HBM readout for failure diagnosis (the
+        axon tunnel sometimes returns None from memory_stats)."""
+        try:
+            stats = devices[0].memory_stats() or {}
+            in_use = stats.get('bytes_in_use')
+            limit = stats.get('bytes_limit')
+            if in_use is not None and limit is not None:
+                return (f'hbm {in_use / (1 << 30):.2f}/'
+                        f'{limit / (1 << 30):.2f} GiB in use')
+        except Exception:  # pylint: disable=broad-except
+            pass
+        return 'hbm stats unavailable'
+
     last_err = None
     for (model_tag, model, slots, max_len, n_req, prompt_len, new_tok,
          buckets, int8) in ladder:
         import jax.numpy as jnp
+        print(f'# serve rung {model_tag}: {_hbm_note()}', flush=True)
         try:
             if int8:
                 # Weights are random either way (throughput bench);
@@ -184,7 +199,8 @@ def serve_main() -> None:
             params = engine = orch = None
             import gc
             gc.collect()
-            print(f'# serve config {model_tag} failed: {e}', flush=True)
+            print(f'# serve config {model_tag} failed ({_hbm_note()}): '
+                  f'{e}', flush=True)
     else:
         raise RuntimeError(f'no serve config initialized: {last_err}')
     metrics = orch.benchmark(prompts, max_new_tokens=new_tok)
