@@ -583,6 +583,55 @@ def decode_forward(c: DeepSeekConfig, params: Params,
     return lm_logits(c, params, x)[:, 0], new_kv
 
 
+def pipeline_supported(c: DeepSeekConfig) -> bool:
+    """pipeline needs a uniform layer stack: first_k_dense == 0 (the
+    stage axis shards the stacked layer params; a handful of
+    structurally-different dense prologue layers cannot ride it)."""
+    return c.first_k_dense == 0
+
+
+def pipelined_loss_fn(c: DeepSeekConfig, params: Params,
+                      tokens: jax.Array, targets: jax.Array,
+                      mesh: mesh_lib.Mesh, n_microbatches: int,
+                      loss_mask: Optional[jax.Array] = None,
+                      token_mask: Optional[jax.Array] = None
+                      ) -> jax.Array:
+    """loss_fn pipelined over the 'stage' axis (GPipe).
+
+    Supported for uniform stacks only (first_k_dense == 0): the
+    pipeline shards the stacked layer params over 'stage', and a
+    handful of structurally-different dense prologue layers cannot ride
+    that sharding. Same aux/masking semantics as moe.pipelined_loss_fn.
+    """
+    if token_mask is not None:
+        from skypilot_tpu import exceptions
+        raise exceptions.NotSupportedError(
+            'token_mask is not supported under pipeline parallelism.')
+    if not pipeline_supported(c):
+        from skypilot_tpu import exceptions
+        raise exceptions.NotSupportedError(
+            'DeepSeek pipeline parallelism needs a uniform layer stack '
+            f'(first_k_dense == 0; this config has {c.first_k_dense} '
+            'dense prologue layers). Use tensor/expert/fsdp axes '
+            'instead, or a first_k_dense=0 variant.')
+    from skypilot_tpu.parallel import pipeline as pipeline_lib
+    x = llama._embed_lookup(params['embed'], tokens, mesh).astype(c.dtype)
+
+    def one_layer(x_mb, lp):
+        b, s, _ = x_mb.shape
+        pos = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        y, aux, _ = _layer(c, None, x_mb, lp, pos, is_moe=True)
+        return y, aux
+
+    x, aux_mean = pipeline_lib.pipeline_apply(
+        one_layer, params['moe_layers'], x, mesh, n_microbatches,
+        remat=c.remat, with_aux=True)
+    x = llama._rms_norm(x, params['final_norm'], c.norm_eps)
+    ce = llama._chunked_ce(x, params['lm_head'], targets, loss_mask,
+                           c.ce_chunk)
+    return ce + c.router_aux_coef * aux_mean
+
+
 def lm_logits(c, params: Params, hidden: jax.Array) -> jax.Array:
     """Untied LM head (same structure as llama's)."""
     return llama.lm_logits(None, params, hidden)

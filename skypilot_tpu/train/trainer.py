@@ -68,11 +68,22 @@ class Trainer:
         self.optimizer = make_optimizer(config)
         self._model_lib = models.module_for(config.model)
         self._n_stages = int(self.mesh.shape.get('stage', 1))
-        if self._n_stages > 1 and not hasattr(self._model_lib,
-                                              'pipelined_loss_fn'):
-            raise NotImplementedError(
-                f'Pipeline parallelism needs a pipelined_loss_fn; '
-                f'{self._model_lib.__name__} does not provide one.')
+        if self._n_stages > 1:
+            if not hasattr(self._model_lib, 'pipelined_loss_fn'):
+                raise NotImplementedError(
+                    f'Pipeline parallelism needs a pipelined_loss_fn; '
+                    f'{self._model_lib.__name__} does not provide one.')
+            # Families may support pipelining only for some configs
+            # (DeepSeek: uniform stacks without dense prologue layers);
+            # fail at construction, before state is ever sharded.
+            supported = getattr(self._model_lib, 'pipeline_supported',
+                                None)
+            if supported is not None and not supported(config.model):
+                from skypilot_tpu import exceptions
+                raise exceptions.NotSupportedError(
+                    f'{self._model_lib.__name__} does not support '
+                    'pipeline parallelism for this config: '
+                    f'{supported.__doc__ or "unsupported layer stack"}')
         self._rules = (mesh_lib.PIPELINE_RULES if self._n_stages > 1
                        else mesh_lib.DEFAULT_RULES)
         self._param_shardings = mesh_lib.tree_shardings(

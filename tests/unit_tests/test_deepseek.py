@@ -197,6 +197,37 @@ class TestDeepSeekSharded:
             state, metrics = trainer.step(state, batch)
         assert float(metrics['loss']) < loss_first - 0.01
 
+    def test_pipeline_parallel_moe_only_stack(self):
+        """GPipe over the uniform MoE stack (first_k_dense == 0)."""
+        from skypilot_tpu.train import trainer as trainer_lib
+        c = dataclasses.replace(deepseek.DEEPSEEK_TINY_MOE_ONLY,
+                                remat=True)
+        config = trainer_lib.TrainConfig(
+            model=c, global_batch_size=4, seq_len=32,
+            optimizer='adafactor', warmup_steps=1, n_microbatches=2,
+            learning_rate=1e-2,
+            mesh_plan=mesh_lib.MeshPlan(data=2, stage=2, expert=2))
+        trainer = trainer_lib.Trainer(config)
+        state = trainer.init_state()
+        batch = trainer.synthetic_batch(0)
+        state, metrics = trainer.step(state, batch)
+        loss_first = float(metrics['loss'])
+        for _ in range(5):
+            state, metrics = trainer.step(state, batch)
+        assert float(metrics['loss']) < loss_first - 0.01
+
+    def test_pipeline_rejects_dense_prologue(self):
+        """Rejected at trainer CONSTRUCTION, before any sharded init."""
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.train import trainer as trainer_lib
+        config = trainer_lib.TrainConfig(
+            model=deepseek.DEEPSEEK_TINY,   # first_k_dense = 1
+            global_batch_size=4, seq_len=32, n_microbatches=2,
+            mesh_plan=mesh_lib.MeshPlan(data=2, stage=2, expert=2))
+        with pytest.raises(exceptions.NotSupportedError,
+                           match='first_k_dense'):
+            trainer_lib.Trainer(config)
+
     def test_sharded_matches_single_device(self, tiny, params):
         tokens = jax.random.randint(jax.random.PRNGKey(6), (4, 16), 0,
                                     tiny.vocab_size)
