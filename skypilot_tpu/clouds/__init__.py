@@ -21,8 +21,9 @@ from skypilot_tpu.clouds.runpod import RunPod
 from skypilot_tpu.clouds.scp import SCP
 from skypilot_tpu.clouds.ssh import SSH
 from skypilot_tpu.clouds.vast import Vast
+from skypilot_tpu.clouds.vsphere import Vsphere
 
 __all__ = ['Cloud', 'CloudImplementationFeatures', 'Region', 'GCP', 'Fake',
            'AWS', 'Azure', 'Cudo', 'DO', 'Docker', 'Fluidstack',
            'Hyperbolic', 'IBM', 'Kubernetes', 'Lambda', 'Nebius', 'OCI',
-           'Paperspace', 'RunPod', 'SCP', 'SSH', 'Vast']
+           'Paperspace', 'RunPod', 'SCP', 'SSH', 'Vast', 'Vsphere']

@@ -149,6 +149,15 @@ class Cloud:
         """Module name under skypilot_tpu.provision implementing the op-set."""
         return self.name
 
+    @property
+    def is_free_capacity(self) -> bool:
+        """True when a $0 hourly cost means GENUINELY free (BYO
+        capacity: SSH pools, Kubernetes, local docker, on-prem
+        vSphere) — the optimizer then prefers it over any paid cloud.
+        False (default) keeps the catalog semantics where a 0 price
+        means 'unpublished' and ranks after all known prices."""
+        return False
+
     def provider_config_overrides(
             self, node_config: Dict[str, Any]) -> Dict[str, Any]:
         """Keys the provisioner needs in provider_config for *every*

@@ -56,6 +56,10 @@ def _parse_spec(spec: Optional[str], default: float) -> float:
 @registry.CLOUD_REGISTRY.register(aliases=['k8s'])
 class Kubernetes(cloud_lib.Cloud):
     _REPR = 'Kubernetes'
+
+    @property
+    def is_free_capacity(self) -> bool:
+        return True  # BYO capacity: $0 means free, rank first
     _MAX_CLUSTER_NAME_LEN_LIMIT = 40  # pod-name suffix room within 63
 
     def unsupported_features_for_resources(

@@ -66,6 +66,10 @@ def load_pools(path: Optional[str] = None) -> Dict[str, Dict[str, Any]]:
 class SSH(cloud_lib.Cloud):
     _REPR = 'SSH'
 
+    @property
+    def is_free_capacity(self) -> bool:
+        return True  # BYO capacity: $0 means free, rank first
+
     def unsupported_features_for_resources(
         self, resources: 'resources_lib.Resources'
     ) -> Dict[_Features, str]:

@@ -1,19 +1,14 @@
-"""Local Docker "cloud": containers as cluster hosts (dev backend).
+"""vSphere: on-prem vCenter VMs for cross-cloud optimization.
 
-Twin of the reference's `sky local up/down` + LocalDockerBackend
-(sky/backends/local_docker_backend.py): a zero-cost cloud whose
-"instances" are local containers, launched through the NORMAL
-backend/gang path (provision/docker/instance.py) — no special backend
-class. Gated behind `xsky local up` (writes the ~/.xsky/enable_docker
-marker; `xsky local down` removes it) so a running docker daemon never
-silently absorbs generic CPU tasks — the same explicit opt-in as the
-reference's `sky local up`. XSKY_ENABLE_DOCKER_CLOUD=1 forces it for
-tests. Priced at 0 like Kubernetes/SSH.
+Lean twin of sky/clouds/vsphere.py — VMs cloned from a site-provided
+template, priced 0 (BYO capacity, like SSH pools / Kubernetes), so the
+optimizer prefers the datacenter when it fits. Instance-type grammar
+``cpu-<N>-mem-<GiB>`` resizes the clone; regions are advisory (the
+clone lands in the template's cluster).
 """
 from __future__ import annotations
 
 import os
-import subprocess
 import typing
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
@@ -23,10 +18,13 @@ from skypilot_tpu.utils import registry
 if typing.TYPE_CHECKING:
     from skypilot_tpu import resources as resources_lib
 
+_PROFILES = ('cpu-2-mem-4', 'cpu-4-mem-8', 'cpu-8-mem-16',
+             'cpu-16-mem-64', 'cpu-32-mem-128')
 
-@registry.CLOUD_REGISTRY.register(aliases=['local'])
-class Docker(cloud_lib.Cloud):
-    _REPR = 'Docker'
+
+@registry.CLOUD_REGISTRY.register()
+class Vsphere(cloud_lib.Cloud):
+    _REPR = 'Vsphere'
 
     @property
     def is_free_capacity(self) -> bool:
@@ -34,21 +32,18 @@ class Docker(cloud_lib.Cloud):
 
     _UNSUPPORTED = {
         cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE:
-            'Local containers have no spot market.',
-        cloud_lib.CloudImplementationFeatures.STOP:
-            'Stop local clusters with `xsky down` (containers are '
-            'cheap to recreate).',
+            'On-prem VMs have no spot market.',
         cloud_lib.CloudImplementationFeatures.OPEN_PORTS:
-            'Local containers share the host network namespace.',
+            'On-prem networking is site policy.',
         cloud_lib.CloudImplementationFeatures.CUSTOM_DISK_TIER:
-            'Local containers use the host disk.',
+            'Clones inherit the template datastore.',
         cloud_lib.CloudImplementationFeatures.STORAGE_MOUNTING:
-            'Mount host paths directly instead.',
+            'Mount site NFS paths directly instead.',
     }
 
     @property
     def provisioner_module(self) -> str:
-        return 'docker'
+        return 'vsphere'
 
     def unsupported_features_for_resources(
             self, resources: 'resources_lib.Resources'
@@ -61,34 +56,42 @@ class Docker(cloud_lib.Cloud):
                               zone: Optional[str]) -> List[cloud_lib.Region]:
         if use_spot or accelerators:
             return []
-        if region not in (None, 'local'):
+        if region not in (None, 'datacenter'):
             return []
-        return [cloud_lib.Region('local', ['local'])]
+        return [cloud_lib.Region('datacenter', ['datacenter'])]
 
     def zones_provision_loop(self, region: str, num_nodes: int,
                              instance_type: str,
                              accelerators: Optional[Dict[str, Any]] = None,
                              use_spot: bool = False) -> Iterator[List[str]]:
         del region, num_nodes, instance_type, accelerators, use_spot
-        yield ['local']
+        yield ['datacenter']
 
     def get_default_instance_type(
             self, cpus: Optional[str] = None,
             memory: Optional[str] = None) -> Optional[str]:
-        del cpus, memory
-        return 'container'
+        want_cpu = float((cpus or '4+').rstrip('+'))
+        want_mem = float((memory or '0+').rstrip('+'))
+        for profile in _PROFILES:
+            parts = profile.split('-')
+            if int(parts[1]) >= want_cpu and int(parts[3]) >= want_mem:
+                return profile
+        return _PROFILES[-1]
 
     def instance_type_exists(self, instance_type: str) -> bool:
-        return instance_type == 'container'
+        parts = instance_type.split('-')
+        return (len(parts) == 4 and parts[0] == 'cpu' and
+                parts[2] == 'mem' and parts[1].isdigit() and
+                parts[3].isdigit())
 
     def get_feasible_launchable_resources(self, resources):
         if resources.accelerators or resources.use_spot:
             return [], []
-        itype = resources.instance_type or 'container'
-        if itype != 'container':
+        itype = resources.instance_type or self.get_default_instance_type(
+            resources.cpus, resources.memory)
+        if not self.instance_type_exists(itype):
             return [], []
-        return [resources.copy(cloud=self.name,
-                               instance_type='container')], []
+        return [resources.copy(cloud=self.name, instance_type=itype)], []
 
     def instance_type_to_hourly_cost(self, instance_type: str,
                                      use_spot: bool = False,
@@ -101,9 +104,9 @@ class Docker(cloud_lib.Cloud):
             region: str, zone: Optional[str]) -> Dict[str, Any]:
         return {
             'cluster_name': cluster_name,
-            'region': 'local',
+            'region': 'datacenter',
             'zone': None,
-            'instance_type': 'container',
+            'instance_type': resources.instance_type,
             'image_id': resources.image_id,
         }
 
@@ -112,29 +115,19 @@ class Docker(cloud_lib.Cloud):
         del node_config
         return {}
 
-    MARKER_PATH = '~/.xsky/enable_docker'
-
-    @classmethod
-    def daemon_available(cls) -> Tuple[bool, Optional[str]]:
-        try:
-            proc = subprocess.run(['docker', 'info'],
-                                  capture_output=True, timeout=10)
-            if proc.returncode == 0:
-                return True, None
-            return False, ('docker daemon not responding '
-                           '(`docker info` failed).')
-        except (FileNotFoundError, subprocess.TimeoutExpired):
-            return False, 'docker CLI not found or not responding.'
-
     def check_credentials(self) -> Tuple[bool, Optional[str]]:
-        if os.environ.get('XSKY_ENABLE_DOCKER_CLOUD') == '1':
+        from skypilot_tpu.provision.vsphere import rest
+        if rest.load_credentials() is not None:
             return True, None
-        if not os.path.exists(os.path.expanduser(self.MARKER_PATH)):
-            return False, ('Local docker cloud is opt-in: run '
-                           '`xsky local up` to enable it.')
-        return self.daemon_available()
+        return False, (
+            f'vSphere credentials not found. Populate '
+            f'{rest.CREDENTIALS_PATH} with hostname/username/password '
+            '(and optionally skip_verification, template_vm).')
 
     def get_credential_file_mounts(self) -> Dict[str, str]:
+        from skypilot_tpu.provision.vsphere import rest
+        if os.path.exists(os.path.expanduser(rest.CREDENTIALS_PATH)):
+            return {rest.CREDENTIALS_PATH: rest.CREDENTIALS_PATH}
         return {}
 
     def get_egress_cost(self, num_gigabytes: float) -> float:

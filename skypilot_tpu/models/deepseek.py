@@ -615,7 +615,6 @@ def pipelined_loss_fn(c: DeepSeekConfig, params: Params,
             'dense prologue layers). Use tensor/expert/fsdp axes '
             'instead, or a first_k_dense=0 variant.')
     from skypilot_tpu.parallel import pipeline as pipeline_lib
-    x = llama._embed_lookup(params['embed'], tokens, mesh).astype(c.dtype)
 
     def one_layer(x_mb, lp):
         b, s, _ = x_mb.shape
@@ -623,13 +622,11 @@ def pipelined_loss_fn(c: DeepSeekConfig, params: Params,
         y, aux, _ = _layer(c, None, x_mb, lp, pos, is_moe=True)
         return y, aux
 
-    x, aux_mean = pipeline_lib.pipeline_apply(
-        one_layer, params['moe_layers'], x, mesh, n_microbatches,
-        remat=c.remat, with_aux=True)
-    x = llama._rms_norm(x, params['final_norm'], c.norm_eps)
-    ce = llama._chunked_ce(x, params['lm_head'], targets, loss_mask,
-                           c.ce_chunk)
-    return ce + c.router_aux_coef * aux_mean
+    return pipeline_lib.pipelined_aux_lm_loss(
+        params, params['moe_layers'], one_layer, tokens, targets, mesh,
+        n_microbatches, dtype=c.dtype, norm_eps=c.norm_eps,
+        remat=c.remat, ce_chunk=c.ce_chunk,
+        aux_coef=c.router_aux_coef, loss_mask=loss_mask)
 
 
 def lm_logits(c, params: Params, hidden: jax.Array) -> jax.Array:

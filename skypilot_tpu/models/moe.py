@@ -442,7 +442,6 @@ def pipelined_loss_fn(config: MoEConfig, params: Params,
             'token_mask is not supported under pipeline parallelism.')
     from skypilot_tpu.parallel import pipeline as pipeline_lib
     c = config
-    x = llama._embed_lookup(params['embed'], tokens, mesh).astype(c.dtype)
 
     def one_layer(x_mb, lp):
         b, s, _ = x_mb.shape
@@ -450,10 +449,8 @@ def pipelined_loss_fn(config: MoEConfig, params: Params,
         y, aux, _ = _layer(c, None, x_mb, lp, pos)
         return y, aux
 
-    x, aux_mean = pipeline_lib.pipeline_apply(
-        one_layer, params['layers'], x, mesh, n_microbatches,
-        remat=c.remat, with_aux=True)
-    x = llama._rms_norm(x, params['final_norm'], c.norm_eps)
-    ce = llama._chunked_ce(x, params['lm_head'], targets, loss_mask,
-                           chunk=llama.LOSS_CHUNK)
-    return ce + c.router_aux_coef * aux_mean
+    return pipeline_lib.pipelined_aux_lm_loss(
+        params, params['layers'], one_layer, tokens, targets, mesh,
+        n_microbatches, dtype=c.dtype, norm_eps=c.norm_eps,
+        remat=c.remat, ce_chunk=llama.LOSS_CHUNK,
+        aux_coef=c.router_aux_coef, loss_mask=loss_mask)

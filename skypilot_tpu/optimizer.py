@@ -130,9 +130,7 @@ def _fill_in_launchable_resources(
                         continue
                     per_request.append((cand, cost))
             if not t.resources_ordered:
-                # 0.0 means 'price unpublished' (e.g. v6e in some
-                # regions): launchable, but ranked after known prices.
-                per_request.sort(key=lambda rc: (rc[1] == 0, rc[1]))
+                per_request.sort(key=_rank_key)
             all_candidates.extend(per_request)
         if not all_candidates:
             hint = ''
@@ -144,9 +142,21 @@ def _fill_in_launchable_resources(
                 f'{t.name or "<unnamed>"} '
                 f'(requested: {t.resources}).{hint}')
         if not t.resources_ordered:
-            all_candidates.sort(key=lambda rc: (rc[1] == 0, rc[1]))
+            all_candidates.sort(key=_rank_key)
         result[t] = all_candidates
     return result
+
+
+def _rank_key(rc):
+    """Cost ranking with two zero-price meanings kept apart:
+    BYO capacity (ssh/k8s/docker/vsphere — genuinely free) ranks
+    FIRST; a 0 catalog price elsewhere means 'unpublished' (e.g. v6e
+    in some regions) and ranks after every known price."""
+    cand, cost = rc
+    cloud = cand.cloud
+    free = bool(cloud and cloud.is_free_capacity)
+    unpublished = cost == 0 and not free
+    return (unpublished, cost)
 
 
 def _node_objective(task: task_lib.Task, cost_per_hr: float,

@@ -143,3 +143,25 @@ def pipeline_apply(layer_fn: Callable[[jax.Array, Any], jax.Array],
         # Every real (microbatch, layer) pair contributed exactly once.
         return out, aux_total / (m * n_layers)
     return out
+
+
+def pipelined_aux_lm_loss(params, stacked_layers, one_layer, tokens,
+                          targets, mesh, n_microbatches, *, dtype,
+                          norm_eps: float, remat: bool, ce_chunk: int,
+                          aux_coef: float, loss_mask=None):
+    """Shared GPipe LM-loss skeleton for routed-expert families.
+
+    embed → pipeline_apply(with_aux) → final RMSNorm → chunked CE +
+    aux term. moe.pipelined_loss_fn and deepseek.pipelined_loss_fn are
+    thin wrappers over this (one source of truth for the pipeline
+    semantics; the family contributes only its layer body).
+    """
+    from skypilot_tpu.models import llama
+    x = llama._embed_lookup(params['embed'], tokens, mesh).astype(dtype)
+    x, aux_mean = pipeline_apply(one_layer, stacked_layers, x, mesh,
+                                 n_microbatches, remat=remat,
+                                 with_aux=True)
+    x = llama._rms_norm(x, params['final_norm'], norm_eps)
+    ce = llama._chunked_ce(x, params['lm_head'], targets, loss_mask,
+                           ce_chunk)
+    return ce + aux_coef * aux_mean

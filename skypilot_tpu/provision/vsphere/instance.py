@@ -1,0 +1,201 @@
+"""vSphere provisioner op-set (VMs cloned from a template, via the
+nodepool base).
+
+Behavioral twin of sky/provision/vsphere/instance.py. Platform facts:
+on-prem vCenter — "instances" are VMs cloned from a template VM named
+in the provider config (``template_vm``, default ``xsky-template``;
+same role as the reference's content-library images), powered on/off
+via the power API, reached at the guest IP VMware Tools reports.
+Instance types (cpu-N-mem-M) resize the clone's CPU/memory. Cost 0:
+like SSH pools and Kubernetes, BYO capacity ranks first when it fits.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import nodepool
+from skypilot_tpu.provision.vsphere import rest
+
+_transport_factory = rest.Transport
+
+
+def set_transport_factory(factory) -> None:
+    global _transport_factory
+    _transport_factory = factory
+
+
+class VsphereApi(nodepool.NodeApi):
+    provider_name = 'vsphere'
+    ssh_user = 'ubuntu'
+    supports_stop = True
+    state_map = {
+        'powered_on': 'RUNNING',
+        'poweredon': 'RUNNING',
+        'powered_off': 'STOPPED',
+        'poweredoff': 'STOPPED',
+        'suspended': 'STOPPED',
+    }
+
+    def __init__(self, provider_config: Dict[str, Any]) -> None:
+        self.t = _transport_factory()
+        self.config = provider_config or {}
+
+    def _vm_ip(self, vm_id: str) -> Optional[str]:
+        try:
+            nics = self.t.call(
+                'GET',
+                f'/api/vcenter/vm/{vm_id}/guest/networking/interfaces')
+        except rest.VsphereApiError:
+            return None  # VMware Tools not up yet
+        for nic in nics or []:
+            for addr in (nic.get('ip', {}) or {}).get(
+                    'ip_addresses', []):
+                ip = addr.get('ip_address', '')
+                if ip and ':' not in ip and not ip.startswith('169.254'):
+                    return ip
+        return None
+
+    def list_nodes(self) -> List[Dict[str, Any]]:
+        vms = self.t.call('GET', '/api/vcenter/vm') or []
+        out = []
+        for vm in vms:
+            name = vm.get('name', '')
+            if not name.startswith('xsky-'):
+                continue
+            vm_id = vm.get('vm')
+            state = str(vm.get('power_state', '')).lower()
+            ip = self._vm_ip(vm_id) if state == 'powered_on' else None
+            out.append({'id': vm_id,
+                        # nodepool membership matches '<cluster>-<i>';
+                        # the vSphere VM name carries an xsky- prefix to
+                        # keep unrelated inventory out.
+                        'name': name[len('xsky-'):],
+                        'status': state,
+                        'public_ip': ip, 'private_ip': ip})
+        return out
+
+    def _template_id(self) -> str:
+        template = self.config.get('template_vm', 'xsky-template')
+        vms = self.t.call('GET', '/api/vcenter/vm',
+                          query=f'names={template}') or []
+        if not vms:
+            raise exceptions.ProvisionError(
+                f'vSphere template VM {template!r} not found; create an '
+                'Ubuntu template VM (with VMware Tools + your SSH key) '
+                'or set provider config template_vm.')
+        return vms[0]['vm']
+
+    def create_node(self, name: str, region: str, zone: Optional[str],
+                    node_config: Dict[str, Any]) -> str:
+        del region, zone  # placement follows the template's cluster
+        body: Dict[str, Any] = {
+            'source': self._template_id(),
+            'name': f'xsky-{name}',
+            'power_on': True,
+        }
+        itype = node_config.get('instance_type') or ''
+        # Grammar cpu-<N>-mem-<GiB>: resize the clone's hardware.
+        parts = itype.split('-')
+        if len(parts) == 4 and parts[0] == 'cpu' and parts[2] == 'mem':
+            body['hardware_customization'] = {
+                'cpu_update': {'num_cpus': int(parts[1])},
+                'memory_update': {'memory': int(parts[3]) * 1024},
+            }
+        reply = self.t.call('POST', '/api/vcenter/vm', body=body,
+                            query='action=clone')
+        return str(reply if isinstance(reply, str) else
+                   reply.get('value', reply))
+
+    def delete_node(self, node_id: str) -> None:
+        # Power off first: vCenter refuses to delete a running VM.
+        try:
+            self.t.call('POST',
+                        f'/api/vcenter/vm/{node_id}/power',
+                        query='action=stop')
+        except rest.VsphereApiError:
+            pass  # already off
+        self.t.call('DELETE', f'/api/vcenter/vm/{node_id}')
+
+    def stop_node(self, node_id: str) -> None:
+        self.t.call('POST', f'/api/vcenter/vm/{node_id}/power',
+                    query='action=stop')
+
+    def start_node(self, node_id: str) -> None:
+        self.t.call('POST', f'/api/vcenter/vm/{node_id}/power',
+                    query='action=start')
+
+    def classify(self, e: Exception,
+                 region: Optional[str] = None) -> Exception:
+        if isinstance(e, rest.VsphereApiError):
+            return rest.classify_error(e, region)
+        return e
+
+
+def _api(provider_config: Dict[str, Any]) -> VsphereApi:
+    return VsphereApi(provider_config)
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    return nodepool.run_instances(_api(config.provider_config), region,
+                                  zone, cluster_name, config)
+
+
+def wait_instances(region: str, cluster_name: str, state: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   timeout_s: float = 900.0,
+                   poll_interval_s: float = 5.0) -> None:
+    del region
+    api = _api(provider_config or {})
+    nodepool.wait_instances(api, cluster_name, state, timeout_s,
+                            poll_interval_s)
+    if state == 'RUNNING':
+        # RUNNING means powered on; SSH needs the guest IP, which only
+        # appears once VMware Tools is up — wait for it too.
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            nodes = nodepool._cluster_nodes(api, cluster_name)
+            if nodes and all(n.get('public_ip') for n in nodes):
+                return
+            time.sleep(poll_interval_s)
+        raise exceptions.ProvisionError(
+            f'vSphere cluster {cluster_name!r} has no guest IPs after '
+            f'{timeout_s}s (is VMware Tools installed in the '
+            'template?).')
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    nodepool.stop_instances(_api(provider_config), cluster_name)
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    nodepool.terminate_instances(_api(provider_config), cluster_name)
+
+
+def query_instances(cluster_name: str, provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    return nodepool.query_instances(_api(provider_config), cluster_name)
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Dict[str, Any]
+                     ) -> common.ClusterInfo:
+    del region
+    return nodepool.get_cluster_info(_api(provider_config), cluster_name,
+                                     provider_config)
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    # On-prem networking: reachability is the site's own policy.
+    del cluster_name, ports, provider_config
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    del cluster_name, provider_config

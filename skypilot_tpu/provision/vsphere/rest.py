@@ -1,0 +1,149 @@
+"""vSphere (vCenter Automation REST) transport.
+
+Role twin of the reference's pyvmomi/vsphere-automation SDK stack
+(sky/adaptors/vsphere.py, sky/provision/vsphere/) on this repo's
+stdlib pattern: session auth (POST /api/session with basic auth →
+``vmware-api-session-id`` header) against the vCenter 7+ REST API.
+Credentials from the reference-compatible
+``~/.vsphere/credential.yaml`` (hostname/username/password per
+vCenter; the first entry is used).
+"""
+from __future__ import annotations
+
+import base64
+import json
+import ssl
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+CREDENTIALS_PATH = '~/.vsphere/credential.yaml'
+
+
+class VsphereApiError(Exception):
+
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(f'{status}: {message}')
+        self.status = status
+        self.message = message
+
+
+def load_credentials() -> Optional[Dict[str, str]]:
+    import os
+    path = os.path.expanduser(CREDENTIALS_PATH)
+    if not os.path.exists(path):
+        return None
+    try:
+        import yaml
+        with open(path, encoding='utf-8') as f:
+            doc = yaml.safe_load(f)
+    except Exception:  # pylint: disable=broad-except
+        return None
+    entries = doc.get('vcenters') if isinstance(doc, dict) else doc
+    if isinstance(entries, list) and entries:
+        entry = entries[0]
+    elif isinstance(doc, dict) and 'hostname' in doc:
+        entry = doc
+    else:
+        return None
+    needed = ('hostname', 'username', 'password')
+    if not all(k in entry for k in needed):
+        return None
+    return {k: str(entry[k]) for k in entry}
+
+
+def classify_error(e: VsphereApiError,
+                   region: Optional[str] = None) -> Exception:
+    text = e.message.lower()
+    where = f' in {region}' if region else ''
+    if 'insufficient' in text or 'no host is compatible' in text or \
+            'out of resources' in text:
+        return exceptions.CapacityError(f'vSphere capacity{where}: {e}')
+    if e.status in (401, 403):
+        return exceptions.PermissionError_(f'vSphere auth: {e}')
+    if e.status == 400:
+        return exceptions.InvalidRequestError(f'vSphere request: {e}')
+    return exceptions.ProvisionError(f'vSphere API{where}: {e}')
+
+
+class Transport:
+
+    def __init__(self) -> None:
+        creds = load_credentials()
+        if creds is None:
+            raise exceptions.PermissionError_(
+                f'vSphere credentials not found (populate '
+                f'{CREDENTIALS_PATH} with hostname/username/password).')
+        self.host = creds['hostname']
+        self._user = creds['username']
+        self._password = creds['password']
+        # Secure by default: TLS verification stays ON unless the site
+        # explicitly opts out (`skip_verification: true` for the
+        # self-signed certs common on-prem) — credentials ride basic
+        # auth, so silently accepting any cert would hand them to an
+        # on-path attacker.
+        self._ctx = ssl.create_default_context()
+        if str(creds.get('skip_verification', 'false')).lower() in \
+                ('1', 'true', 'yes'):
+            self._ctx.check_hostname = False
+            self._ctx.verify_mode = ssl.CERT_NONE
+        self._session: Optional[str] = None
+
+    def _login(self) -> str:
+        if self._session is None:
+            token = base64.b64encode(
+                f'{self._user}:{self._password}'.encode()).decode()
+            req = urllib.request.Request(
+                f'https://{self.host}/api/session', method='POST',
+                headers={'Authorization': f'Basic {token}'})
+            try:
+                with urllib.request.urlopen(req, timeout=30,
+                                            context=self._ctx) as resp:
+                    self._session = json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                raise exceptions.PermissionError_(
+                    f'vCenter login failed: {e}') from e
+            except urllib.error.URLError as e:
+                raise exceptions.ProvisionError(
+                    f'vCenter unreachable: {e}') from e
+        return self._session
+
+    def call(self, method: str, path: str,
+             body: Optional[Dict[str, Any]] = None,
+             query: Optional[str] = None) -> Any:
+        url = f'https://{self.host}{path}'
+        if query:
+            url += f'?{query}'
+        data = json.dumps(body).encode() if body is not None else None
+        # Two attempts: a 401 means the session expired — drop it,
+        # re-login, and replay ONCE with a fresh Request (mutating the
+        # old one would carry both the stale and new session headers).
+        for attempt in (1, 2):
+            req = urllib.request.Request(
+                url, data=data, method=method,
+                headers={'vmware-api-session-id': self._login(),
+                         'Content-Type': 'application/json'})
+            try:
+                with urllib.request.urlopen(req, timeout=60,
+                                            context=self._ctx) as resp:
+                    payload = resp.read()
+                    return json.loads(payload) if payload else {}
+            except urllib.error.HTTPError as e:
+                if e.code == 401 and attempt == 1:
+                    self._session = None
+                    continue
+                try:
+                    err = json.loads(e.read() or b'{}')
+                    messages = err.get('messages') or []
+                    message = (messages[0].get('default_message')
+                               if messages else err.get('error_type',
+                                                        str(e)))
+                    raise VsphereApiError(e.code, str(message))
+                except (ValueError, AttributeError, IndexError):
+                    raise VsphereApiError(e.code, str(e)) from e
+            except urllib.error.URLError as e:
+                raise exceptions.ProvisionError(
+                    f'vCenter unreachable: {e}') from e
+        # Unreachable: every iteration returns or raises.

@@ -80,10 +80,11 @@ class Trainer:
                                 None)
             if supported is not None and not supported(config.model):
                 from skypilot_tpu import exceptions
+                reason = (supported.__doc__ or
+                          'unsupported layer stack').strip().splitlines()[0]
                 raise exceptions.NotSupportedError(
                     f'{self._model_lib.__name__} does not support '
-                    'pipeline parallelism for this config: '
-                    f'{supported.__doc__ or "unsupported layer stack"}')
+                    f'pipeline parallelism for this config: {reason}')
         self._rules = (mesh_lib.PIPELINE_RULES if self._n_stages > 1
                        else mesh_lib.DEFAULT_RULES)
         self._param_shardings = mesh_lib.tree_shardings(

@@ -181,6 +181,27 @@ class TestDag:
         assert t.best_resources is not None
 
 
+class TestFreeCapacityRanking:
+
+    def test_byo_capacity_beats_paid_clouds(self, monkeypatch):
+        """$0 BYO capacity (vsphere/k8s/ssh/docker) ranks FIRST, while
+        a $0 catalog price elsewhere still means 'unpublished' and
+        ranks LAST (the two zero-price meanings must not mix)."""
+        from skypilot_tpu import check as check_lib
+        from skypilot_tpu import task as task_lib
+        monkeypatch.setenv('XSKY_ENABLE_FAKE_CLOUD', '1')
+        check_lib.set_enabled_clouds_for_test(['fake', 'vsphere'])
+        try:
+            dag = Dag()
+            dag.add(task_lib.Task(run='echo hi', name='cpu'))
+            Optimizer.optimize(dag)
+            chosen = dag.tasks[0].best_resources
+            assert chosen.cloud_name == 'vsphere'
+            assert chosen.get_hourly_cost() == 0.0
+        finally:
+            check_lib.set_enabled_clouds_for_test(None)
+
+
 class TestDagStructure:
 
     def test_is_chain(self):

@@ -1,0 +1,136 @@
+"""vSphere cloud: clone-from-template lifecycle against an in-memory
+vCenter fake, feasibility, credentials."""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.vsphere import instance as vs_instance
+from skypilot_tpu.provision.vsphere import rest
+
+
+class FakeVcenter:
+
+    def __init__(self) -> None:
+        self.vms: Dict[str, Dict[str, Any]] = {
+            'vm-1': {'vm': 'vm-1', 'name': 'xsky-template',
+                     'power_state': 'POWERED_OFF'},
+        }
+        self.fail_clone: Optional[rest.VsphereApiError] = None
+        self._next = 1
+
+    def call(self, method, path, body=None, query=None):
+        if path == '/api/vcenter/vm' and method == 'GET':
+            if query and query.startswith('names='):
+                want = query.split('=', 1)[1]
+                return [v for v in self.vms.values()
+                        if v['name'] == want]
+            return list(self.vms.values())
+        if path == '/api/vcenter/vm' and method == 'POST':
+            assert query == 'action=clone'
+            if self.fail_clone is not None:
+                err, self.fail_clone = self.fail_clone, None
+                raise err
+            assert body['source'] in self.vms
+            self._next += 1
+            vm_id = f'vm-{self._next}'
+            self.vms[vm_id] = {'vm': vm_id, 'name': body['name'],
+                               'power_state': 'POWERED_ON',
+                               'hw': body.get('hardware_customization')}
+            return vm_id
+        if path.endswith('/power') and method == 'POST':
+            vm_id = path.split('/')[4]
+            self.vms[vm_id]['power_state'] = (
+                'POWERED_ON' if query == 'action=start'
+                else 'POWERED_OFF')
+            return {}
+        if path.endswith('/guest/networking/interfaces'):
+            vm_id = path.split('/')[4]
+            n = int(vm_id.split('-')[1])
+            return [{'ip': {'ip_addresses': [
+                {'ip_address': f'10.20.0.{n}'}]}}]
+        if method == 'DELETE':
+            vm_id = path.split('/')[4]
+            assert self.vms[vm_id]['power_state'] == 'POWERED_OFF', \
+                'vCenter refuses to delete a running VM'
+            del self.vms[vm_id]
+            return {}
+        raise AssertionError(f'unhandled vCenter call {method} {path}')
+
+
+@pytest.fixture()
+def fake_vcenter(monkeypatch):
+    fake = FakeVcenter()
+    monkeypatch.setattr(vs_instance, '_transport_factory', lambda: fake)
+    yield fake
+
+
+def _config(count=1, itype='cpu-4-mem-8'):
+    return common.ProvisionConfig(
+        provider_config={}, node_config={'instance_type': itype},
+        count=count)
+
+
+def test_clone_lifecycle(fake_vcenter):
+    record = vs_instance.run_instances('datacenter', None, 'c1',
+                                       _config(count=2))
+    assert len(record.created_instance_ids) == 2
+    # Clones resized per the instance-type grammar.
+    clone = next(v for v in fake_vcenter.vms.values()
+                 if v['name'] == 'xsky-c1-0')
+    assert clone['hw']['cpu_update']['num_cpus'] == 4
+    assert clone['hw']['memory_update']['memory'] == 8 * 1024
+    info = vs_instance.get_cluster_info('datacenter', 'c1', {})
+    assert info.num_instances == 2
+    assert all(h.internal_ip for h in info.sorted_instances())
+    vs_instance.stop_instances('c1', {})
+    assert set(vs_instance.query_instances('c1', {}).values()) == \
+        {'STOPPED'}
+    vs_instance.run_instances('datacenter', None, 'c1',
+                              _config(count=2))
+    assert set(vs_instance.query_instances('c1', {}).values()) == \
+        {'RUNNING'}
+    vs_instance.terminate_instances('c1', {})
+    assert vs_instance.query_instances('c1', {}) == {}
+    # The template survives teardown.
+    assert any(v['name'] == 'xsky-template'
+               for v in fake_vcenter.vms.values())
+
+
+def test_missing_template_is_actionable(fake_vcenter):
+    del fake_vcenter.vms['vm-1']
+    with pytest.raises(exceptions.ProvisionError, match='template'):
+        vs_instance.run_instances('datacenter', None, 'c2', _config())
+
+
+def test_capacity_classified(fake_vcenter):
+    fake_vcenter.fail_clone = rest.VsphereApiError(
+        400, 'No host is compatible with the virtual machine.')
+    with pytest.raises(exceptions.CapacityError):
+        vs_instance.run_instances('datacenter', None, 'c3', _config())
+
+
+def test_cloud_feasibility_and_credentials(monkeypatch, tmp_path):
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.utils import registry
+    cloud = registry.CLOUD_REGISTRY.from_str('vsphere')
+    feasible, _ = cloud.get_feasible_launchable_resources(
+        resources_lib.Resources(cpus='8+'))
+    assert feasible and feasible[0].instance_type == 'cpu-8-mem-16'
+    assert feasible[0].get_hourly_cost() == 0.0
+    # Accelerators/spot never land on-prem here.
+    feasible, _ = cloud.get_feasible_launchable_resources(
+        resources_lib.Resources(accelerators='A100:1'))
+    assert feasible == []
+    monkeypatch.setattr(rest, 'CREDENTIALS_PATH',
+                        str(tmp_path / 'credential.yaml'))
+    ok, reason = cloud.check_credentials()
+    assert not ok and 'hostname' in reason
+    (tmp_path / 'credential.yaml').write_text(
+        'vcenters:\n  - hostname: vc.corp\n    username: u\n'
+        '    password: p\n')
+    ok, _ = cloud.check_credentials()
+    assert ok
