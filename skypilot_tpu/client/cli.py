@@ -627,6 +627,53 @@ def api_start(host, port, foreground):
                    f'(logs: {log_path})')
 
 
+@api.command(name='login')
+@click.option('--endpoint', '-e', required=True,
+              help='API server URL, e.g. http://host:46580')
+@click.option('--token', '-t', default=None,
+              help='Bearer token from `xsky users token-create`.')
+def api_login(endpoint, token):
+    """Point this client at a remote API server (twin of `sky api
+    login`): persists api_server.endpoint (and token) in the user
+    config, so every verb talks to it from now on."""
+    import yaml
+
+    from skypilot_tpu import config as config_lib
+    from skypilot_tpu.client import remote_client
+    if not endpoint.startswith(('http://', 'https://')):
+        endpoint = f'http://{endpoint}'
+    # Probe before persisting: a typo'd endpoint should fail HERE.
+    try:
+        client = remote_client.RemoteClient(endpoint, token=token)
+        client.list_api_requests(limit=1)
+    except Exception as e:  # pylint: disable=broad-except
+        raise click.ClickException(
+            f'Could not reach {endpoint}: {e}') from e
+    # The same file the config loader reads: honor $XSKY_CONFIG.
+    path = os.path.expanduser(
+        os.environ.get(config_lib.ENV_VAR_USER_CONFIG,
+                       config_lib.USER_CONFIG_PATH))
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    doc = {}
+    had_file = os.path.exists(path)
+    if had_file:
+        with open(path, encoding='utf-8') as f:
+            doc = yaml.safe_load(f) or {}
+    section = doc.setdefault('api_server', {})
+    section['endpoint'] = endpoint
+    if token:
+        section['token'] = token
+    # 0600: the file now carries a Bearer token.
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, 'w', encoding='utf-8') as f:
+        yaml.safe_dump(doc, f)
+    os.chmod(path, 0o600)
+    click.echo(f'Logged in to {endpoint} (config: {path}).')
+    if had_file:
+        click.echo('Note: the config file was rewritten as plain YAML '
+                   '(comments/ordering not preserved).')
+
+
 @api.command(name='stop')
 def api_stop():
     """Stop the local API server started with `xsky api start`."""

@@ -16,13 +16,21 @@ from skypilot_tpu import exceptions
 class RemoteClient:
 
     def __init__(self, endpoint: str, poll_interval_s: float = 0.2,
-                 timeout_s: float = 3600.0) -> None:
+                 timeout_s: float = 3600.0,
+                 token: Optional[str] = None) -> None:
         self.endpoint = endpoint.rstrip('/')
         self.poll_interval_s = poll_interval_s
         self.timeout_s = timeout_s
+        if token is None:
+            import os
+            from skypilot_tpu import config as config_lib
+            token = os.environ.get('XSKY_API_TOKEN') or \
+                config_lib.get_nested(('api_server', 'token'))
+        headers = {'Authorization': f'Bearer {token}'} if token else {}
         try:
             import httpx
-            self._client = httpx.Client(base_url=self.endpoint, timeout=30)
+            self._client = httpx.Client(base_url=self.endpoint,
+                                        timeout=30, headers=headers)
         except ImportError as e:
             raise exceptions.ApiServerConnectionError(endpoint) from e
 

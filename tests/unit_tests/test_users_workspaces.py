@@ -262,3 +262,26 @@ class TestWorkspaceRegressions:
         assert [c['name'] for c in core.status()] == ['c2']
         monkeypatch.delenv('XSKY_WORKSPACE')
         assert len(core.status()) == 2
+
+    def test_remote_client_sends_bearer_token(self, auth_server):
+        """The CLIENT side of token auth: RemoteClient attaches the
+        Authorization header (explicit arg or $XSKY_API_TOKEN), so
+        every SDK verb works against an auth-gated server."""
+        pytest.importorskip('httpx')
+        from skypilot_tpu.client import remote_client
+        token = users_core.create_token('dev', 'sdk')['token']
+        client = remote_client.RemoteClient(auth_server, token=token)
+        assert client.status() == []
+        # Without a token the same verb is rejected.
+        bare = remote_client.RemoteClient(auth_server)
+        with pytest.raises(Exception):
+            bare.status()
+
+    def test_remote_client_token_from_env(self, auth_server,
+                                          monkeypatch):
+        pytest.importorskip('httpx')
+        from skypilot_tpu.client import remote_client
+        token = users_core.create_token('dev', 'env')['token']
+        monkeypatch.setenv('XSKY_API_TOKEN', token)
+        client = remote_client.RemoteClient(auth_server)
+        assert client.status() == []
