@@ -483,6 +483,26 @@ def jobs_launch(entrypoint, envs, secrets, name, num_nodes, accelerators,
     click.echo(f'Managed job {job_id} submitted.')
 
 
+@jobs.command(name='dashboard')
+def jobs_dashboard():
+    """Print (and try to open) the dashboard's managed-jobs view."""
+    from skypilot_tpu.client import sdk
+    endpoint = sdk.api_server_endpoint()
+    if endpoint is None:
+        raise click.ClickException(
+            'No API server configured. Start one with `xsky api start` '
+            'or set XSKY_API_SERVER.')
+    if not endpoint.startswith(('http://', 'https://')):
+        endpoint = f'http://{endpoint}'
+    url = f'{endpoint.rstrip("/")}/dashboard#/jobs'
+    click.echo(url)
+    import webbrowser
+    try:
+        webbrowser.open(url)
+    except Exception:  # pylint: disable=broad-except
+        pass
+
+
 @jobs.command(name='queue')
 def jobs_queue():
     from skypilot_tpu.client import sdk
