@@ -245,10 +245,32 @@ def queue(cluster):
                               j['status'], j['username']))
 
 
-@cli.command()
+class _SSHGroup(click.Group):
+    """`xsky ssh CLUSTER [CMD...]` keeps working next to the node-pool
+    subcommands: an unknown first token routes to `connect`."""
+
+    def parse_args(self, ctx, args):
+        if args and not args[0].startswith('-') and \
+                args[0] not in self.commands:
+            args = ['connect'] + list(args)
+        return super().parse_args(ctx, args)
+
+
+@cli.group(cls=_SSHGroup)
+def ssh():
+    """Shell into a cluster head; manage SSH node pools (up/down).
+
+    `xsky ssh CLUSTER [CMD...]` opens a shell on the cluster head. A
+    cluster whose name collides with a subcommand (`up`, `down`,
+    `connect`) is reachable via the explicit form:
+    `xsky ssh connect CLUSTER`.
+    """
+
+
+@ssh.command(name='connect', hidden=True)
 @click.argument('cluster')
 @click.argument('command', nargs=-1)
-def ssh(cluster, command):
+def ssh_connect(cluster, command):
     """Open a shell (or run COMMAND) on the cluster head.
 
     With a remote API server configured, the connection tunnels
@@ -258,6 +280,61 @@ def ssh(cluster, command):
     from skypilot_tpu.client import sdk
     argv, cwd = sdk.ssh_command(cluster, command=list(command) or None)
     raise SystemExit(subprocess.call(argv, cwd=cwd))
+
+
+@ssh.command(name='up')
+@click.option('--infra', default=None,
+              help='Pool name from ~/.xsky/ssh_node_pools.yaml '
+                   '(default: all pools).')
+def ssh_up(infra):
+    """Probe and warm SSH node pool(s) (twin of `sky ssh up`)."""
+    from skypilot_tpu.client import sdk
+    try:
+        report = sdk.ssh_up(infra)
+    except ValueError as e:
+        raise click.ClickException(str(e))
+    for pool, info in sorted(report.items()):
+        mark = 'ready' if info['ok'] else 'DEGRADED'
+        click.echo(f'{pool}: {mark}')
+        if not info['hosts']:
+            click.echo('  (no hosts declared)')
+        for row in info['hosts']:
+            state = 'ok' if row['ok'] else f"FAIL ({row['error']})"
+            click.echo(f"  {row['ip']}: {state}")
+    bad_pools = sorted(p for p, info in report.items() if not info['ok'])
+    if bad_pools:
+        raise click.ClickException(
+            f"pool(s) not ready: {', '.join(bad_pools)}")
+
+
+@ssh.command(name='down')
+@click.option('--infra', default=None,
+              help='Pool name (default: all pools).')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def ssh_down(infra, yes):
+    """Release pool allocations + clean agents (twin of `sky ssh down`)."""
+    from skypilot_tpu.client import sdk
+    if not yes:
+        # Validate before the destructive prompt when the pool config
+        # is local (remote servers resolve their own pools file).
+        if sdk.api_server_endpoint() is None:
+            from skypilot_tpu.clouds import ssh as ssh_cloud_lib
+            try:
+                ssh_cloud_lib._select_pools(infra)  # unknown/empty check
+            except ValueError as e:
+                raise click.ClickException(str(e))
+        target = f'pool {infra!r}' if infra else 'ALL pools'
+        click.confirm(
+            f'Terminate all clusters allocated from {target}?',
+            abort=True)
+    try:
+        report = sdk.ssh_down(infra)
+    except ValueError as e:
+        raise click.ClickException(str(e))
+    for pool, info in sorted(report.items()):
+        released = ', '.join(info['released_clusters']) or 'none'
+        click.echo(f'{pool}: released clusters: {released}; '
+                   f"cleaned {info['hosts_cleaned']} host(s)")
 
 
 @cli.command()
@@ -805,6 +882,26 @@ def api_cancel(request_id):
     else:
         raise click.ClickException(
             f'Request {request_id} not found or already terminal.')
+
+
+@api.command(name='info')
+def api_info():
+    """Show the API server URL, health and user (twin of `sky api info`)."""
+    from skypilot_tpu.client import sdk
+    info = sdk.api_info()
+    url = info['url'] or '(local, in-process)'
+    click.echo(f'Using xsky API server: {url}')
+    click.echo(f"  Status: {info.get('status')}, "
+               f"version: {info.get('version')}, "
+               f"api_version: {info.get('api_version')}")
+    user = info.get('user')
+    if user:
+        click.echo(f"  User: {user['name']} (role: {user['role']})")
+    elif info.get('auth_required'):
+        click.echo('  User: UNAUTHENTICATED (server requires auth — '
+                   'set XSKY_API_TOKEN or `xsky api login`)')
+    else:
+        click.echo('  User: anonymous (auth not required)')
 
 
 @cli.group()

@@ -90,6 +90,15 @@ class RemoteClient:
         resp.raise_for_status()
         return bool(resp.json().get('cancelled'))
 
+    def health(self) -> Dict[str, Any]:
+        """GET /health — status/version/user (backs `xsky api info`)."""
+        try:
+            resp = self._client.get('/health')
+            resp.raise_for_status()
+        except Exception as e:
+            raise exceptions.ApiServerConnectionError(self.endpoint) from e
+        return resp.json()
+
     # ---- verbs ----
 
     def launch(self, task, **kwargs) -> Any:
@@ -225,6 +234,12 @@ class RemoteClient:
 
     def serve_down(self, service_name):
         return self._call('serve.down', {'service_name': service_name})
+
+    def ssh_up(self, infra=None):
+        return self._call('ssh.up', {'infra': infra})
+
+    def ssh_down(self, infra=None):
+        return self._call('ssh.down', {'infra': infra})
 
 
 class _HandleProxy:

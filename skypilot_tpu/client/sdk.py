@@ -392,3 +392,33 @@ def workspaces_delete(name: str):
     return _module_local_or_remote('skypilot_tpu.workspaces.core',
                                    'delete_workspace', 'workspaces_delete',
                                    name)
+
+
+def api_info() -> Dict[str, Any]:
+    """Server URL, health and identity (twin of `sky api info`,
+    sky/client/cli/command.py:5156)."""
+    remote = _remote()
+    if remote is not None:
+        info = remote.health()
+        info.setdefault('status', 'unknown')
+        info['url'] = remote.endpoint
+        info['mode'] = 'remote'
+        return info
+    from skypilot_tpu import version
+    from skypilot_tpu.server import app as server_app
+    return {'url': None, 'mode': 'local', 'status': 'healthy',
+            'version': version.__version__,
+            'api_version': server_app.API_VERSION,
+            'auth_required': False, 'user': None}
+
+
+def ssh_up(infra: Optional[str] = None) -> Dict[str, Any]:
+    """Bring up SSH node pool(s) (twin of `sky ssh up`)."""
+    return _module_local_or_remote('skypilot_tpu.clouds.ssh', 'pool_up',
+                                   'ssh_up', infra)
+
+
+def ssh_down(infra: Optional[str] = None) -> Dict[str, Any]:
+    """Tear down SSH node pool(s) (twin of `sky ssh down`)."""
+    return _module_local_or_remote('skypilot_tpu.clouds.ssh', 'pool_down',
+                                   'ssh_down', infra)

@@ -62,6 +62,97 @@ def load_pools(path: Optional[str] = None) -> Dict[str, Dict[str, Any]]:
     return pools
 
 
+def _select_pools(infra: Optional[str]) -> Dict[str, Dict[str, Any]]:
+    """Resolve `--infra` (None means every declared pool)."""
+    pools = load_pools()
+    if infra is not None:
+        if infra not in pools:
+            raise ValueError(f'Unknown SSH pool {infra!r}; known: '
+                             f'{sorted(pools)}')
+        pools = {infra: pools[infra]}
+    if not pools:
+        raise ValueError(f'No SSH node pools defined in {POOLS_PATH}.')
+    return pools
+
+
+def _host_runner(host: Dict[str, Any]):
+    from skypilot_tpu.utils import command_runner
+    return command_runner.SSHCommandRunner(
+        host['ip'], host['user'], host['identity_file'],
+        port=host['ssh_port'])
+
+
+def pool_up(infra: Optional[str] = None,
+            probe_timeout_s: float = 10.0) -> Dict[str, Any]:
+    """Bring up SSH node pool(s): probe every host over ssh.
+
+    Twin of ``sky ssh up`` (sky/client/cli/command.py:5189). The
+    reference bootstraps Kubernetes onto the pool machines; here the
+    pool itself is the launch substrate, so bring-up = validate that
+    every declared host is reachable with the declared credentials (and
+    warm the ssh ControlMaster, so the first ``xsky launch`` against
+    the pool skips the connection setup cost).
+
+    Returns ``{pool: {'ok': bool, 'hosts': [{'ip', 'ok', 'error'}]}}``.
+    A pool with no hosts is not-ok (nothing can launch on it).
+    """
+    report: Dict[str, Any] = {}
+    for name, spec in sorted(_select_pools(infra).items()):
+        rows: List[Dict[str, Any]] = []
+        for host in spec['hosts']:
+            runner = _host_runner(host)
+            try:
+                returncode = runner.run('true', timeout=probe_timeout_s)
+                ok = returncode == 0
+                error = None if ok else f'probe exited {returncode}'
+            except Exception as e:  # pylint: disable=broad-except
+                ok, error = False, str(e)
+            rows.append({'ip': host['ip'], 'ok': ok, 'error': error})
+        report[name] = {'ok': bool(rows) and all(r['ok'] for r in rows),
+                        'hosts': rows}
+    return report
+
+
+def pool_down(infra: Optional[str] = None,
+              probe_timeout_s: float = 10.0) -> Dict[str, Any]:
+    """Tear down SSH node pool(s): twin of ``sky ssh down``
+    (sky/client/cli/command.py:5212).
+
+    The reference removes its Kubernetes install from the machines.
+    Here teardown means: terminate the state-DB records of clusters
+    allocated from the pool, release their host allocations, and
+    best-effort kill any lingering framework agent daemons on each
+    host (the machines themselves are BYO and never touched further).
+
+    Returns ``{pool: {'released_clusters': [...], 'hosts_cleaned': N}}``.
+    """
+    from skypilot_tpu import state
+    from skypilot_tpu.provision.ssh import instance as ssh_instance
+    report: Dict[str, Any] = {}
+    for name, spec in sorted(_select_pools(infra).items()):
+        released = ssh_instance.release_pool(name)
+        for cluster_name in released:
+            # The hosts under the cluster are being reclaimed: the
+            # cluster record is unrecoverable, mirror that in the DB.
+            state.remove_cluster(cluster_name, terminate=True)
+        cleaned = 0
+        for host in spec['hosts']:
+            runner = _host_runner(host)
+            try:
+                # [s]kypilot: the bracket trick keeps pkill -f from
+                # matching the remote shell that carries this very
+                # command line (it would SIGTERM itself otherwise).
+                returncode = runner.run(
+                    "pkill -f '[s]kypilot_tpu.agent' || true",
+                    timeout=probe_timeout_s)
+                cleaned += int(returncode == 0)
+            except Exception:  # pylint: disable=broad-except
+                pass  # unreachable host: nothing to clean
+        report[name] = {'released_clusters': released,
+                        'hosts_cleaned': cleaned}
+    return report
+
+
 @registry.CLOUD_REGISTRY.register()
 class SSH(cloud_lib.Cloud):
     _REPR = 'SSH'

@@ -79,6 +79,22 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
     )
 
 
+def release_pool(pool_name: str) -> List[str]:
+    """Release every cluster allocation drawn from ``pool_name``.
+
+    Backs ``xsky ssh down`` (clouds/ssh.py pool_down). Returns the
+    released cluster names so the caller can retire their state-DB
+    records.
+    """
+    released: List[str] = []
+    with _allocations() as alloc:
+        for cluster_name in list(alloc):
+            if alloc[cluster_name].get('pool') == pool_name:
+                alloc.pop(cluster_name)
+                released.append(cluster_name)
+    return released
+
+
 def query_instances(cluster_name: str,
                     provider_config: Dict[str, Any]
                     ) -> Dict[str, Optional[str]]:

@@ -95,8 +95,19 @@ class _Handler(BaseHTTPRequestHandler):
         parsed = urllib.parse.urlparse(self.path)
         params = dict(urllib.parse.parse_qsl(parsed.query))
         if parsed.path == '/health':
+            # Additive fields only (wire surface is append-only):
+            # version + the authenticated caller, for `xsky api info`.
+            from skypilot_tpu import version as version_lib
+            from skypilot_tpu.users import core as users_core
+            user = users_core.authenticate(
+                self.headers.get('Authorization'))
             self._send(200, {'status': 'healthy',
-                             'api_version': API_VERSION})
+                             'api_version': API_VERSION,
+                             'version': version_lib.__version__,
+                             'auth_required': users_core.auth_required(),
+                             'user': ({'name': user['name'],
+                                       'role': user['role']}
+                                      if user else None)})
         elif parsed.path == '/metrics':
             # Prometheus text exposition (twin of sky/server/metrics.py).
             data = metrics.render().encode()

@@ -215,6 +215,11 @@ _VERBS.update({
                                       'name'),
     'workspaces.delete': _module_verb(_WORKSPACES, 'delete_workspace',
                                       'name'),
+    # SSH node pools (twin of `sky ssh up/down`).
+    'ssh.up': _module_verb('skypilot_tpu.clouds.ssh', 'pool_up',
+                           infra=None),
+    'ssh.down': _module_verb('skypilot_tpu.clouds.ssh', 'pool_down',
+                             infra=None),
 })
 
 

@@ -336,3 +336,107 @@ class TestSshVerb:
         # clobber it.
         assert 'bastion' in joined
         assert 'tunnel_proxy' not in joined
+
+
+class TestPoolUpDown:
+    """`xsky ssh up/down` — pool bring-up probe + teardown release
+    (twins of sky ssh up/down, sky/client/cli/command.py:5189,5212)."""
+
+    def test_pool_up_probes_every_host(self, ssh_pool, monkeypatch):
+        probed = []
+
+        def fake_run(self, cmd, **kwargs):
+            probed.append(self.ip)
+            return 255 if self.ip == '10.0.0.2' else 0
+
+        monkeypatch.setattr(command_runner.SSHCommandRunner, 'run',
+                            fake_run)
+        report = ssh_cloud.pool_up()
+        assert sorted(probed) == ['10.0.0.1', '10.0.0.2', '10.0.0.3']
+        assert report['rack1']['ok'] is False
+        rows = {r['ip']: r for r in report['rack1']['hosts']}
+        assert rows['10.0.0.1']['ok'] and rows['10.0.0.3']['ok']
+        assert not rows['10.0.0.2']['ok']
+        assert 'exited 255' in rows['10.0.0.2']['error']
+
+    def test_pool_up_unknown_pool_and_no_pools(self, ssh_pool,
+                                               monkeypatch, tmp_path):
+        with pytest.raises(ValueError, match='Unknown SSH pool'):
+            ssh_cloud.pool_up('nope')
+        empty = tmp_path / 'none.yaml'
+        empty.write_text('')
+        monkeypatch.setenv('XSKY_SSH_NODE_POOLS', str(empty))
+        with pytest.raises(ValueError, match='No SSH node pools'):
+            ssh_cloud.pool_up()
+
+    def test_pool_down_releases_allocations_and_state(
+            self, ssh_pool, monkeypatch, tmp_path):
+        from skypilot_tpu import state
+        monkeypatch.setenv('XSKY_STATE_DB', str(tmp_path / 'state.db'))
+        state.reset_for_test()
+        try:
+            config = common.ProvisionConfig(
+                provider_config={}, node_config={'pool': 'rack1'},
+                count=2)
+            ssh_instance.run_instances('rack1', None, 'byo-c1', config)
+            state.add_or_update_cluster('byo-c1', cluster_handle=object(),
+                                        ready=True)
+            cleaned = []
+            monkeypatch.setattr(
+                command_runner.SSHCommandRunner, 'run',
+                lambda self, cmd, **kw: cleaned.append((self.ip, cmd))
+                or 0)
+            report = ssh_cloud.pool_down('rack1')
+            assert report['rack1']['released_clusters'] == ['byo-c1']
+            assert report['rack1']['hosts_cleaned'] == 3
+            # pkill -f must not match its own carrying remote shell.
+            assert all('[s]kypilot_tpu' in cmd for _, cmd in cleaned)
+            # Allocation gone, hosts bookable again; DB row retired to
+            # history (cost report still sees it).
+            assert ssh_instance.query_instances('byo-c1', {}) == {}
+            assert state.get_cluster_from_name('byo-c1') is None
+            assert any(h['name'] == 'byo-c1'
+                       for h in state.get_cluster_history())
+        finally:
+            state.reset_for_test()
+
+
+    def test_pool_down_is_admin_only(self):
+        from skypilot_tpu.users import rbac
+        assert not rbac.check_permission('user', 'ssh.down')
+        assert rbac.check_permission('admin', 'ssh.down')
+        assert rbac.check_permission('user', 'ssh.up')
+
+
+class TestApiInfo:
+    """`xsky api info` — /health additive fields + SDK fallback."""
+
+    def test_local_mode(self, monkeypatch):
+        from skypilot_tpu.client import sdk
+        monkeypatch.delenv('XSKY_API_SERVER', raising=False)
+        info = sdk.api_info()
+        assert info['mode'] == 'local'
+        assert info['status'] == 'healthy'
+        assert info['version']
+        assert info['api_version'] >= 1
+
+    def test_health_fields_over_http(self, monkeypatch, tmp_path):
+        import json as json_lib
+        import urllib.request
+        from skypilot_tpu.server import app as server_app
+        monkeypatch.setenv('XSKY_STATE_DB', str(tmp_path / 'state.db'))
+        from skypilot_tpu import state
+        state.reset_for_test()
+        try:
+            httpd, port = server_app.run_in_thread(port=0)
+            try:
+                with urllib.request.urlopen(
+                        f'http://127.0.0.1:{port}/health') as resp:
+                    payload = json_lib.loads(resp.read())
+                assert payload['status'] == 'healthy'
+                assert payload['version']
+                assert payload['user'] is None
+            finally:
+                httpd.shutdown()
+        finally:
+            state.reset_for_test()
