@@ -1,0 +1,270 @@
+"""OpenAI-compatible request/response shaping for the serving endpoint.
+
+The reference's serving recipes expose this exact wire surface through
+vLLM/SGLang (llm/vllm/serve.yaml, llm/sglang/llama2.yaml:34 — both
+serve ``/v1/completions`` + ``/v1/chat/completions``); the framework
+owns its own engine here, so it implements the API natively. Pure
+shaping logic lives in this module (testable without HTTP); the HTTP
+routes are in ``infer/server.py``.
+
+Supported: prompt as text / token list, ``max_tokens``, ``temperature``,
+``top_p``/``top_k``, ``stop`` (string or list), ``stream`` (SSE),
+``echo``. Rejected clearly: ``n > 1``, ``logprobs``, batched prompts.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu.infer import orchestrator as orch_lib
+from skypilot_tpu.infer import tokenizer as tokenizer_lib
+
+
+class ApiError(Exception):
+    """Maps to an OpenAI-style error body with an HTTP status."""
+
+    def __init__(self, code: int, message: str) -> None:
+        super().__init__(message)
+        self.code = code
+
+    def body(self) -> Dict[str, Any]:
+        return {'error': {'message': str(self),
+                          'type': 'invalid_request_error'}}
+
+
+@dataclasses.dataclass
+class RequestMeta:
+    """Everything the response builders need beyond the orch Request."""
+    kind: str                    # 'completion' | 'chat'
+    model_id: str
+    stream: bool
+    stop: List[str]
+    echo: bool
+    prompt_text: str             # '' when prompt came as token ids
+    prompt_tokens: List[int]
+    response_id: str = ''
+    created: int = 0
+
+    def __post_init__(self) -> None:
+        prefix = 'cmpl' if self.kind == 'completion' else 'chatcmpl'
+        self.response_id = f'{prefix}-{uuid.uuid4().hex[:24]}'
+        self.created = int(time.time())
+
+
+def _parse_prompt(body: Dict[str, Any],
+                  tokenizer: Any) -> Tuple[str, List[int]]:
+    prompt = body.get('prompt')
+    if isinstance(prompt, list) and len(prompt) == 1 and \
+            isinstance(prompt[0], str):
+        prompt = prompt[0]  # single-element batch: allowed
+    if isinstance(prompt, str):
+        return prompt, tokenizer.encode(prompt)
+    if isinstance(prompt, list) and prompt and \
+            all(isinstance(t, int) for t in prompt):
+        return '', list(prompt)  # pre-tokenized (OpenAI allows this)
+    if isinstance(prompt, list):
+        raise ApiError(400, 'batched prompts are not supported; send '
+                            'one request per prompt')
+    raise ApiError(400, "'prompt' (string or token list) is required")
+
+
+def _parse_chat_prompt(body: Dict[str, Any],
+                       tokenizer: Any) -> Tuple[str, List[int]]:
+    messages = body.get('messages')
+    if not isinstance(messages, list) or not messages or not all(
+            isinstance(m, dict) and isinstance(m.get('content'), str)
+            for m in messages):
+        raise ApiError(400, "'messages' must be a non-empty list of "
+                            "{role, content} objects")
+    text = tokenizer_lib.render_chat(messages, tokenizer)
+    return text, tokenizer.encode(text)
+
+
+def build_request(body: Dict[str, Any], tokenizer: Any,
+                  engine_config: Any, model_id: str,
+                  chat: bool) -> Tuple[orch_lib.Request, RequestMeta]:
+    """Validate an API body into an orchestrator Request + meta.
+
+    Raises ApiError on anything malformed or unsupported.
+    """
+    if body.get('n', 1) != 1:
+        raise ApiError(400, 'n > 1 is not supported')
+    if body.get('logprobs'):
+        raise ApiError(400, 'logprobs are not supported')
+    if chat:
+        prompt_text, prompt_tokens = _parse_chat_prompt(body, tokenizer)
+    else:
+        prompt_text, prompt_tokens = _parse_prompt(body, tokenizer)
+
+    limit = min(engine_config.max_prompt_len,
+                engine_config.max_target_len - 1)
+    if len(prompt_tokens) > limit:
+        raise ApiError(400, f'prompt is {len(prompt_tokens)} tokens; '
+                            f'this server accepts at most {limit}')
+
+    budget = engine_config.max_target_len - len(prompt_tokens)
+    max_tokens = body.get('max_tokens')
+    if max_tokens is None:
+        # OpenAI defaults completions to 16; chat fills the budget.
+        max_tokens = 16 if not chat else budget
+    try:
+        max_tokens = int(max_tokens)
+    except (TypeError, ValueError):
+        raise ApiError(400, "'max_tokens' must be an integer")
+    if max_tokens < 1:
+        raise ApiError(400, "'max_tokens' must be ≥ 1")
+    max_tokens = min(max_tokens, budget)
+
+    stop = body.get('stop') or []
+    if isinstance(stop, str):
+        stop = [stop]
+    if not isinstance(stop, list) or not all(
+            isinstance(s, str) and s for s in stop):
+        raise ApiError(400, "'stop' must be a string or list of strings")
+    if len(stop) > 4:
+        raise ApiError(400, "at most 4 'stop' sequences")
+
+    try:
+        temperature = float(body.get('temperature', 1.0))
+        top_p = float(body.get('top_p', 1.0))
+        top_k = int(body.get('top_k', 0))
+    except (TypeError, ValueError):
+        raise ApiError(400, 'temperature/top_p/top_k must be numbers')
+
+    request = orch_lib.Request(
+        prompt_tokens=prompt_tokens,
+        max_new_tokens=max_tokens,
+        eos_token_id=getattr(tokenizer, 'eos_token_id', None),
+        temperature=temperature,
+        top_k=top_k,
+        top_p=top_p)
+    meta = RequestMeta(kind='chat' if chat else 'completion',
+                       model_id=model_id,
+                       stream=bool(body.get('stream', False)),
+                       stop=stop,
+                       echo=bool(body.get('echo', False)),
+                       prompt_text=prompt_text,
+                       prompt_tokens=prompt_tokens)
+    return request, meta
+
+
+def find_stop(text: str, stops: List[str]) -> int:
+    """Earliest index where any stop sequence begins, or -1."""
+    best = -1
+    for stop in stops:
+        idx = text.find(stop)
+        if idx != -1 and (best == -1 or idx < best):
+            best = idx
+    return best
+
+
+def finalize_text(meta: RequestMeta, request: orch_lib.Request,
+                  tokenizer: Any) -> Tuple[str, str]:
+    """(text, finish_reason) for a finished non-streamed request."""
+    text = tokenizer.decode(request.output_tokens)
+    finish_reason = ('length' if len(request.output_tokens) >=
+                     request.max_new_tokens else 'stop')
+    idx = find_stop(text, meta.stop)
+    if idx != -1:
+        text, finish_reason = text[:idx], 'stop'
+    if meta.echo and meta.kind == 'completion':
+        # prompt_text is '' when the prompt arrived as token ids —
+        # reconstruct it so echo still echoes.
+        prompt_text = meta.prompt_text or \
+            tokenizer.decode(meta.prompt_tokens)
+        text = prompt_text + text
+    return text, finish_reason
+
+
+def _usage(meta: RequestMeta,
+           request: orch_lib.Request) -> Dict[str, int]:
+    return {'prompt_tokens': len(meta.prompt_tokens),
+            'completion_tokens': len(request.output_tokens),
+            'total_tokens': (len(meta.prompt_tokens) +
+                             len(request.output_tokens))}
+
+
+def response_body(meta: RequestMeta, request: orch_lib.Request,
+                  text: str, finish_reason: str) -> Dict[str, Any]:
+    if meta.kind == 'chat':
+        choice: Dict[str, Any] = {
+            'index': 0,
+            'message': {'role': 'assistant', 'content': text},
+            'finish_reason': finish_reason,
+        }
+        obj = 'chat.completion'
+    else:
+        choice = {'index': 0, 'text': text,
+                  'finish_reason': finish_reason}
+        obj = 'text_completion'
+    return {'id': meta.response_id, 'object': obj,
+            'created': meta.created, 'model': meta.model_id,
+            'choices': [choice], 'usage': _usage(meta, request)}
+
+
+def chunk_body(meta: RequestMeta, text: str,
+               finish_reason: Optional[str],
+               first: bool = False) -> Dict[str, Any]:
+    if meta.kind == 'chat':
+        delta: Dict[str, Any] = {}
+        if first:
+            delta['role'] = 'assistant'
+        if text:
+            delta['content'] = text
+        choice: Dict[str, Any] = {'index': 0, 'delta': delta,
+                                  'finish_reason': finish_reason}
+        obj = 'chat.completion.chunk'
+    else:
+        choice = {'index': 0, 'text': text,
+                  'finish_reason': finish_reason}
+        obj = 'text_completion'
+    return {'id': meta.response_id, 'object': obj,
+            'created': meta.created, 'model': meta.model_id,
+            'choices': [choice]}
+
+
+def sse(payload: Dict[str, Any]) -> bytes:
+    return f'data: {json.dumps(payload)}\n\n'.encode()
+
+
+SSE_DONE = b'data: [DONE]\n\n'
+
+
+class StreamEmitter:
+    """Incremental text emission with stop-sequence hold-back.
+
+    Deltas are only released once they can no longer be a prefix of a
+    stop sequence still in flight; on a stop hit, the text before the
+    stop is emitted and ``finished`` flips so the caller can cancel
+    the underlying request.
+    """
+
+    def __init__(self, tokenizer: Any, stops: List[str]) -> None:
+        self._decoder = tokenizer_lib.IncrementalDecoder(tokenizer)
+        self._stops = stops
+        self._holdback = max((len(s) for s in stops), default=1) - 1
+        self._text = ''
+        self._sent = 0
+        self.finished = False
+        self.finish_reason: Optional[str] = None
+
+    def push(self, tokens: List[int], final: bool = False) -> str:
+        """Feed the full token list so far; returns newly safe text."""
+        if self.finished:
+            return ''
+        self._text += self._decoder.delta(tokens, final=final)
+        idx = find_stop(self._text, self._stops)
+        if idx != -1:
+            self.finished = True
+            self.finish_reason = 'stop'
+            out = self._text[self._sent:idx]
+            self._sent = idx
+            return out
+        safe_upto = len(self._text) if final else \
+            max(self._sent, len(self._text) - self._holdback)
+        out = self._text[self._sent:safe_upto]
+        self._sent = safe_upto
+        return out

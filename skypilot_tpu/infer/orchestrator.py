@@ -31,6 +31,10 @@ class Request:
     temperature: float = 0.0
     top_k: int = 0               # 0 → disabled
     top_p: float = 1.0           # 1 → disabled
+    # set by the caller (any thread) to stop generation early — e.g. a
+    # stop-sequence hit or client disconnect in the streaming API; the
+    # orchestrator honors it at the next token boundary:
+    cancel_requested: bool = False
     # filled by the orchestrator:
     request_id: int = -1
     output_tokens: List[int] = dataclasses.field(default_factory=list)
@@ -75,6 +79,11 @@ class Orchestrator:
             request = self._pending.get_nowait()
         except queue.Empty:
             return False
+        if request.cancel_requested:
+            # Cancelled while still queued: finish without a prefill.
+            request.done = True
+            request.finished_at = time.perf_counter()
+            return True
         prompt_len = len(request.prompt_tokens)
         # The prompt must fit the prefill buckets AND leave room for at
         # least one generated token in the per-slot KV budget.
@@ -114,7 +123,7 @@ class Orchestrator:
         hit_eos = (request.eos_token_id is not None and
                    token == request.eos_token_id)
         exhausted = len(request.output_tokens) >= request.max_new_tokens
-        if hit_eos or exhausted:
+        if hit_eos or exhausted or request.cancel_requested:
             if hit_eos:
                 request.output_tokens.pop()
             request.done = True
@@ -147,6 +156,26 @@ class Orchestrator:
             request.output_tokens.append(int(tokens[slot]))
             self._maybe_finish(slot, int(tokens[slot]))
 
+    def fail_all(self, error: str) -> None:
+        """Finish every active and pending request with `error` and
+        free their slots — never hand back silently-truncated outputs,
+        and leave no stale queue behind to leak into a later batch."""
+        for slot in list(self._slot_req):
+            request = self._slot_req.pop(slot)
+            request.error = error
+            request.done = True
+            request.finished_at = time.perf_counter()
+            self.state = self.engine.release_slot(self.state, slot)
+            self._free_slots.append(slot)
+        while True:
+            try:
+                request = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            request.error = error
+            request.done = True
+            request.finished_at = time.perf_counter()
+
     def run_until_drained(self, max_steps: int = 100_000) -> None:
         steps = 0
         while (self._slot_req or not self._pending.empty()) and \
@@ -154,29 +183,10 @@ class Orchestrator:
             self.step()
             steps += 1
         if self._slot_req or not self._pending.empty():
-            # Never hand back silently-truncated outputs: mark every
-            # unfinished request — active in a slot OR still queued — so
-            # callers can see incompleteness, and leave no stale queue
-            # behind to leak into a later batch.
             logger.warning(f'run_until_drained hit max_steps={max_steps} '
                            f'with {len(self._slot_req)} active and '
                            f'~{self._pending.qsize()} pending requests.')
-            error = f'Truncated at max_steps={max_steps}.'
-            for slot in list(self._slot_req):
-                request = self._slot_req.pop(slot)
-                request.error = error
-                request.done = True
-                request.finished_at = time.perf_counter()
-                self.state = self.engine.release_slot(self.state, slot)
-                self._free_slots.append(slot)
-            while True:
-                try:
-                    request = self._pending.get_nowait()
-                except queue.Empty:
-                    break
-                request.error = error
-                request.done = True
-                request.finished_at = time.perf_counter()
+            self.fail_all(f'Truncated at max_steps={max_steps}.')
 
     # ---- convenience ----
 

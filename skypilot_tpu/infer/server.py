@@ -8,6 +8,13 @@ Endpoints (JetStream-twin wire surface for `xsky serve` replicas):
   POST /generate            → {"prompt_tokens": [...], "max_new_tokens",
                               "temperature", "top_k", "top_p"}
                               ⇒ {"output_tokens": [...]}.
+  GET  /v1/models           → OpenAI-style model listing.
+  POST /v1/completions      → OpenAI-compatible text completion
+  POST /v1/chat/completions   (+ SSE streaming, stop sequences, echo) —
+                              the wire surface the reference's serving
+                              recipes get from vLLM (llm/vllm/serve.yaml);
+                              shaping logic in infer/openai_api.py,
+                              tokenizers in infer/tokenizer.py.
 
 The orchestrator thread runs continuous batching across concurrent
 requests; HTTP handlers block on their request's completion event.
@@ -46,11 +53,17 @@ class ServingLoop:
         self._lock = threading.Lock()
         threading.Thread(target=self._loop, daemon=True).start()
 
-    def submit_and_wait(self, request: orch_lib.Request,
-                        timeout: float = 600.0) -> orch_lib.Request:
+    def submit(self, request: orch_lib.Request) -> orch_lib.Request:
+        """Enqueue without blocking (streaming handlers poll the
+        request's output_tokens/done themselves)."""
         with self._lock:
             self.orch.submit(request)
         self._wake.set()
+        return request
+
+    def submit_and_wait(self, request: orch_lib.Request,
+                        timeout: float = 600.0) -> orch_lib.Request:
+        self.submit(request)
         deadline = time.time() + timeout
         while not request.done and time.time() < deadline:
             time.sleep(0.005)
@@ -63,15 +76,25 @@ class ServingLoop:
             self._wake.wait(timeout=1.0)
             while True:
                 with self._lock:
-                    self.orch.step()
-                    busy = bool(self.orch._slot_req or
-                                not self.orch._pending.empty())
+                    try:
+                        self.orch.step()
+                        busy = bool(self.orch._slot_req or
+                                    not self.orch._pending.empty())
+                    except Exception as e:  # pylint: disable=broad-except
+                        # A dead serving loop must not strand waiting
+                        # handlers (they poll request.done): fail every
+                        # in-flight request loudly and keep serving.
+                        logger.exception('serving loop step failed')
+                        self.orch.fail_all(f'engine step failed: {e}')
+                        busy = False
                 if not busy:
                     self._wake.clear()
                     break
 
 
-def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig):
+def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
+                  tokenizer=None, model_id: str = 'model'):
+    from skypilot_tpu.infer import openai_api
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):
@@ -89,17 +112,34 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig):
             if self.path == '/health':
                 self._json(200, {'status': 'healthy',
                                  'max_slots': config.max_slots})
+            elif self.path == '/v1/models':
+                self._json(200, {'object': 'list', 'data': [
+                    {'id': model_id, 'object': 'model',
+                     'owned_by': 'xsky'}]})
             else:
                 self._json(404, {'error': 'not found'})
 
         def do_POST(self):  # noqa: N802
-            if self.path != '/generate':
+            if self.path == '/generate':
+                self._generate()
+            elif self.path == '/v1/completions':
+                self._openai(chat=False)
+            elif self.path == '/v1/chat/completions':
+                self._openai(chat=True)
+            else:
                 self._json(404, {'error': 'not found'})
-                return
+
+        def _read_json(self):
             length = int(self.headers.get('Content-Length') or 0)
             try:
-                body = json.loads(self.rfile.read(length))
+                return json.loads(self.rfile.read(length))
             except json.JSONDecodeError:
+                return None
+
+        def _generate(self):
+            """Legacy token-ids wire surface (JetStream-twin)."""
+            body = self._read_json()
+            if body is None:
                 self._json(400, {'error': 'bad json'})
                 return
             prompt = body.get('prompt_tokens')
@@ -123,6 +163,107 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig):
                 'latency_s': round(time.perf_counter() - t0, 3),
             })
 
+        def _openai(self, chat: bool):
+            if tokenizer is None:
+                self._json(503, {'error': {
+                    'message': 'no tokenizer configured on this server',
+                    'type': 'server_error'}})
+                return
+            body = self._read_json()
+            if body is None:
+                self._json(400, {'error': {
+                    'message': 'request body is not valid JSON',
+                    'type': 'invalid_request_error'}})
+                return
+            try:
+                request, meta = openai_api.build_request(
+                    body, tokenizer, config, model_id, chat)
+            except openai_api.ApiError as e:
+                self._json(e.code, e.body())
+                return
+            if meta.stream:
+                self._stream(request, meta)
+                return
+            self._await_with_stops(request, meta)
+            if request.error:
+                self._json(400, {'error': {'message': request.error,
+                                           'type': 'engine_error'}})
+                return
+            text, finish_reason = openai_api.finalize_text(
+                meta, request, tokenizer)
+            self._json(200, openai_api.response_body(
+                meta, request, text, finish_reason))
+
+        def _await_with_stops(self, request, meta,
+                              timeout: float = 600.0):
+            """Blocking wait that still cancels on a stop-sequence hit —
+            without this, a stopped request would keep burning its
+            decode slot until max_tokens even though the text past the
+            stop is discarded."""
+            loop.submit(request)
+            deadline = time.time() + timeout
+            seen = 0
+            while not request.done and time.time() < deadline:
+                n = len(request.output_tokens)
+                if meta.stop and n > seen and not \
+                        request.cancel_requested:
+                    seen = n
+                    text = tokenizer.decode(list(request.output_tokens))
+                    if openai_api.find_stop(text, meta.stop) != -1:
+                        request.cancel_requested = True
+                time.sleep(0.005)
+            if not request.done:
+                request.error = request.error or 'server timeout'
+
+        def _stream(self, request, meta):
+            """Server-sent events; one chunk per newly safe text delta."""
+            self.send_response(200)
+            self.send_header('Content-Type', 'text/event-stream')
+            self.send_header('Cache-Control', 'no-cache')
+            self.send_header('Connection', 'close')
+            self.end_headers()
+            emitter = openai_api.StreamEmitter(tokenizer, meta.stop)
+            loop.submit(request)
+            first = True
+            deadline = time.time() + 600.0
+            seen = -1
+            try:
+                while True:
+                    if time.time() > deadline:
+                        request.cancel_requested = True
+                        break
+                    done = request.done
+                    # Snapshot: the orchestrator thread appends
+                    # concurrently; list() pins a consistent view.
+                    tokens = list(request.output_tokens)
+                    if len(tokens) == seen and not done:
+                        time.sleep(0.005)  # nothing new: don't re-decode
+                        continue
+                    seen = len(tokens)
+                    delta = emitter.push(tokens, final=done)
+                    if delta or (first and meta.kind == 'chat'):
+                        self.wfile.write(openai_api.sse(
+                            openai_api.chunk_body(meta, delta, None,
+                                                  first=first)))
+                        self.wfile.flush()
+                        first = False
+                    if emitter.finished:  # stop-sequence hit
+                        request.cancel_requested = True
+                        break
+                    if done:
+                        break
+                    time.sleep(0.005)
+                finish_reason = emitter.finish_reason or (
+                    'length' if len(request.output_tokens) >=
+                    request.max_new_tokens else 'stop')
+                self.wfile.write(openai_api.sse(openai_api.chunk_body(
+                    meta, '', finish_reason)))
+                self.wfile.write(openai_api.SSE_DONE)
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                # Client went away: free the slot at the next token.
+                request.cancel_requested = True
+
     return Handler
 
 
@@ -142,6 +283,13 @@ def main() -> int:
                              'fits 8B on one 16 GB chip')
     parser.add_argument('--mesh', default=None,
                         help="e.g. 'tensor=4' to shard across chips")
+    parser.add_argument('--tokenizer', default='byte',
+                        help="'byte' (built-in reversible byte-level) "
+                             'or a local HuggingFace tokenizer path '
+                             '(enables the /v1 text endpoints)')
+    parser.add_argument('--model-id', default=None,
+                        help='Model id reported by /v1/models '
+                             '(default: --model)')
     args = parser.parse_args()
 
     model = models.get_config(args.model)
@@ -183,8 +331,19 @@ def main() -> int:
     orch.generate([[1, 2, 3]], max_new_tokens=2)
     loop = ServingLoop(orch)
 
-    server = ThreadingHTTPServer(('0.0.0.0', args.port),
-                                 build_handler(loop, config))
+    from skypilot_tpu.infer import tokenizer as tokenizer_lib
+    try:
+        tokenizer = tokenizer_lib.get_tokenizer(args.tokenizer,
+                                                model.vocab_size)
+    except ValueError as e:
+        # Tiny-vocab models can't host the byte tokenizer; token-ids
+        # endpoint still works, /v1 routes report 503.
+        logger.warning(f'No tokenizer: {e}')
+        tokenizer = None
+    server = ThreadingHTTPServer(
+        ('0.0.0.0', args.port),
+        build_handler(loop, config, tokenizer=tokenizer,
+                      model_id=args.model_id or args.model))
     logger.info(f'Serving on :{args.port}')
     server.serve_forever()
     return 0

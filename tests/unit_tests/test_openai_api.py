@@ -1,0 +1,310 @@
+"""OpenAI-compatible serving surface: tokenizers, request shaping, and
+the live HTTP endpoints (tiny model on the CPU mesh).
+
+Twin of the wire surface the reference's serving recipes expose through
+vLLM (llm/vllm/serve.yaml) — completions + chat + SSE streaming.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import jax
+import pytest
+
+from skypilot_tpu.infer import engine as engine_lib
+from skypilot_tpu.infer import openai_api
+from skypilot_tpu.infer import orchestrator as orch_lib
+from skypilot_tpu.infer import server as server_lib
+from skypilot_tpu.infer import tokenizer as tokenizer_lib
+from skypilot_tpu.models import llama
+
+pytestmark = pytest.mark.slow  # jit compiles
+
+
+class TestByteTokenizer:
+
+    def test_round_trip(self):
+        tok = tokenizer_lib.ByteTokenizer(512)
+        text = 'héllo wörld — ¡ünïcode! 中文'
+        assert tok.decode(tok.encode(text)) == text
+
+    def test_bos_and_specials_skipped(self):
+        tok = tokenizer_lib.ByteTokenizer(512)
+        tokens = tok.encode('ab')
+        assert tokens[0] == tok.BOS_ID
+        assert tok.decode([tok.BOS_ID, tok.EOS_ID] + tokens[1:]) == 'ab'
+
+    def test_vocab_too_small(self):
+        with pytest.raises(ValueError, match='vocab'):
+            tokenizer_lib.ByteTokenizer(256)
+
+    def test_incremental_decoder_holds_partial_utf8(self):
+        tok = tokenizer_lib.ByteTokenizer(512)
+        tokens = tok.encode('a中b', add_bos=False)
+        dec = tokenizer_lib.IncrementalDecoder(tok)
+        text = ''
+        for i in range(1, len(tokens) + 1):
+            text += dec.delta(tokens[:i], final=(i == len(tokens)))
+            # Never a replacement char mid-stream:
+            assert '�' not in text
+        assert text == 'a中b'
+
+
+class TestRequestShaping:
+
+    @property
+    def config(self):
+        return engine_lib.EngineConfig(model=llama.LLAMA_TINY,
+                                       max_slots=4, max_target_len=64,
+                                       prefill_buckets=(16, 32))
+
+    @property
+    def tok(self):
+        return tokenizer_lib.ByteTokenizer(512)
+
+    def test_completion_defaults(self):
+        request, meta = openai_api.build_request(
+            {'prompt': 'hi'}, self.tok, self.config, 'm', chat=False)
+        assert request.max_new_tokens == 16  # OpenAI default
+        assert request.eos_token_id == self.tok.EOS_ID
+        assert meta.kind == 'completion' and not meta.stream
+
+    def test_chat_renders_template(self):
+        request, meta = openai_api.build_request(
+            {'messages': [{'role': 'user', 'content': 'yo'}]},
+            self.tok, self.config, 'm', chat=True)
+        assert '<|user|>' in meta.prompt_text
+        assert meta.prompt_text.endswith('<|assistant|>\n')
+        # Chat fills the remaining budget by default.
+        assert request.max_new_tokens == 64 - len(meta.prompt_tokens)
+
+    def test_rejections(self):
+        bad = [
+            ({'prompt': 'x', 'n': 2}, 'n > 1'),
+            ({'prompt': 'x', 'logprobs': 5}, 'logprobs'),
+            ({'prompt': ['a', 'b']}, 'batched'),
+            ({}, 'required'),
+            ({'prompt': 'x', 'max_tokens': 0}, 'max_tokens'),
+            ({'prompt': 'x', 'stop': [1]}, 'stop'),
+            ({'prompt': 'x' * 500}, 'at most'),
+        ]
+        for body, match in bad:
+            with pytest.raises(openai_api.ApiError, match=match):
+                openai_api.build_request(body, self.tok, self.config,
+                                         'm', chat=False)
+
+    def test_token_ids_prompt(self):
+        request, meta = openai_api.build_request(
+            {'prompt': [5, 6, 7]}, self.tok, self.config, 'm',
+            chat=False)
+        assert request.prompt_tokens == [5, 6, 7]
+        assert meta.prompt_text == ''
+
+    def test_stream_emitter_stop_holdback(self):
+        tok = tokenizer_lib.ByteTokenizer(512)
+        emitter = openai_api.StreamEmitter(tok, stops=['END'])
+        text = 'abcENDxyz'
+        tokens = tok.encode(text, add_bos=False)
+        out = ''
+        for i in range(1, len(tokens) + 1):
+            out += emitter.push(tokens[:i])
+            if emitter.finished:
+                break
+        assert out == 'abc'
+        assert emitter.finish_reason == 'stop'
+        # Nothing after the stop leaks, even if pushed again.
+        assert emitter.push(tokens) == ''
+
+    def test_stream_emitter_no_stop_emits_all_on_final(self):
+        tok = tokenizer_lib.ByteTokenizer(512)
+        emitter = openai_api.StreamEmitter(tok, stops=['LONGSTOP'])
+        tokens = tok.encode('hello', add_bos=False)
+        out = emitter.push(tokens, final=True)
+        assert out == 'hello'
+
+
+@pytest.fixture(scope='module')
+def live_server():
+    model = dataclasses.replace(llama.LLAMA_TINY, vocab_size=512)
+    config = engine_lib.EngineConfig(model=model, max_slots=4,
+                                     max_target_len=64,
+                                     prefill_buckets=(16, 32))
+    params = llama.init(model, jax.random.PRNGKey(0))
+    engine = engine_lib.InferenceEngine(config, params)
+    orch = orch_lib.Orchestrator(engine)
+    orch.generate([[1, 2, 3]], max_new_tokens=2)  # warm compile
+    loop = server_lib.ServingLoop(orch)
+    tok = tokenizer_lib.ByteTokenizer(model.vocab_size)
+    httpd = ThreadingHTTPServer(
+        ('127.0.0.1', 0),
+        server_lib.build_handler(loop, config, tokenizer=tok,
+                                 model_id='tiny-test'))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f'http://127.0.0.1:{httpd.server_address[1]}', tok
+    httpd.shutdown()
+
+
+def _post(url, path, body):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={'Content-Type': 'application/json'})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestLiveEndpoints:
+
+    def test_models_listing(self, live_server):
+        url, _ = live_server
+        with urllib.request.urlopen(url + '/v1/models') as resp:
+            payload = json.loads(resp.read())
+        assert payload['data'][0]['id'] == 'tiny-test'
+
+    def test_completion_greedy_matches_generate(self, live_server):
+        url, tok = live_server
+        body = {'prompt': 'hello', 'max_tokens': 8, 'temperature': 0}
+        status, payload = _post(url, '/v1/completions', body)
+        assert status == 200
+        choice = payload['choices'][0]
+        assert choice['finish_reason'] in ('stop', 'length')
+        assert payload['usage']['completion_tokens'] <= 8
+        # Same prompt through the token-ids endpoint agrees (greedy).
+        status2, legacy = _post(url, '/generate', {
+            'prompt_tokens': tok.encode('hello'), 'max_new_tokens': 8,
+            'eos_token_id': tok.EOS_ID})
+        assert status2 == 200
+        assert tok.decode(legacy['output_tokens']) == choice['text']
+
+    def test_chat_completion(self, live_server):
+        url, _ = live_server
+        status, payload = _post(url, '/v1/chat/completions', {
+            'messages': [{'role': 'user', 'content': 'hi'}],
+            'max_tokens': 6, 'temperature': 0})
+        assert status == 200
+        message = payload['choices'][0]['message']
+        assert message['role'] == 'assistant'
+        assert isinstance(message['content'], str)
+        assert payload['object'] == 'chat.completion'
+
+    def test_streaming_matches_non_streaming(self, live_server):
+        url, _ = live_server
+        body = {'prompt': 'abc', 'max_tokens': 8, 'temperature': 0}
+        _, non_stream = _post(url, '/v1/completions', body)
+        expected = non_stream['choices'][0]['text']
+
+        req = urllib.request.Request(
+            url + '/v1/completions',
+            data=json.dumps({**body, 'stream': True}).encode(),
+            headers={'Content-Type': 'application/json'})
+        chunks, finish = [], None
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.headers['Content-Type'] == 'text/event-stream'
+            for line in resp:
+                line = line.decode().strip()
+                if not line.startswith('data: '):
+                    continue
+                data = line[len('data: '):]
+                if data == '[DONE]':
+                    break
+                chunk = json.loads(data)
+                choice = chunk['choices'][0]
+                chunks.append(choice.get('text', ''))
+                finish = choice['finish_reason'] or finish
+        assert ''.join(chunks) == expected
+        assert finish in ('stop', 'length')
+
+    def test_bad_requests_get_openai_errors(self, live_server):
+        url, _ = live_server
+        status, payload = _post(url, '/v1/completions',
+                                {'prompt': 'x', 'n': 3})
+        assert status == 400
+        assert payload['error']['type'] == 'invalid_request_error'
+
+    def test_echo_with_token_ids_prompt(self, live_server):
+        url, tok = live_server
+        status, payload = _post(url, '/v1/completions', {
+            'prompt': tok.encode('hi'), 'echo': True, 'max_tokens': 4,
+            'temperature': 0})
+        assert status == 200
+        assert payload['choices'][0]['text'].startswith('hi')
+
+    def test_stop_sequence_truncates_and_cancels(self, live_server):
+        url, _ = live_server
+        base = {'prompt': 'abc', 'max_tokens': 12, 'temperature': 0}
+        _, full = _post(url, '/v1/completions', base)
+        text = full['choices'][0]['text']
+        printable = [c for c in text[1:] if c.strip()]
+        if not printable:
+            pytest.skip('tiny model emitted no printable stop anchor')
+        stop_char = printable[0]
+        status, stopped = _post(url, '/v1/completions',
+                                {**base, 'stop': stop_char})
+        assert status == 200
+        choice = stopped['choices'][0]
+        assert choice['finish_reason'] == 'stop'
+        assert stop_char not in choice['text']
+        assert choice['text'] == text.split(stop_char)[0]
+
+
+class TestCancellation:
+
+    def test_cancel_mid_decode_frees_slot(self, live_server):
+        # Orchestrator-level: a cancel lands at the next token boundary
+        # and the slot returns to the free pool.
+        model = dataclasses.replace(llama.LLAMA_TINY, vocab_size=512)
+        config = engine_lib.EngineConfig(model=model, max_slots=2,
+                                         max_target_len=64,
+                                         prefill_buckets=(16,))
+        params = llama.init(model, jax.random.PRNGKey(0))
+        engine = engine_lib.InferenceEngine(config, params)
+        orch = orch_lib.Orchestrator(engine)
+        request = orch.submit(orch_lib.Request(prompt_tokens=[1, 2, 3],
+                                               max_new_tokens=50))
+        orch.step()
+        orch.step()
+        request.cancel_requested = True
+        orch.step()
+        assert request.done
+        assert len(request.output_tokens) < 50
+        assert len(orch._free_slots) == config.max_slots
+
+    def test_cancel_while_queued_never_prefills(self, live_server):
+        model = dataclasses.replace(llama.LLAMA_TINY, vocab_size=512)
+        config = engine_lib.EngineConfig(model=model, max_slots=2,
+                                         max_target_len=64,
+                                         prefill_buckets=(16,))
+        params = llama.init(model, jax.random.PRNGKey(0))
+        engine = engine_lib.InferenceEngine(config, params)
+        orch = orch_lib.Orchestrator(engine)
+        request = orch.submit(orch_lib.Request(prompt_tokens=[1, 2],
+                                               max_new_tokens=10))
+        request.cancel_requested = True
+        orch.step()
+        assert request.done
+        assert request.output_tokens == []
+
+    def test_fail_all_unblocks_waiters(self, live_server):
+        model = dataclasses.replace(llama.LLAMA_TINY, vocab_size=512)
+        config = engine_lib.EngineConfig(model=model, max_slots=2,
+                                         max_target_len=64,
+                                         prefill_buckets=(16,))
+        params = llama.init(model, jax.random.PRNGKey(0))
+        engine = engine_lib.InferenceEngine(config, params)
+        orch = orch_lib.Orchestrator(engine)
+        active = orch.submit(orch_lib.Request(prompt_tokens=[1, 2],
+                                              max_new_tokens=10))
+        orch.step()
+        queued = orch.submit(orch_lib.Request(prompt_tokens=[3],
+                                              max_new_tokens=10))
+        orch.fail_all('engine step failed: boom')
+        assert active.done and 'boom' in active.error
+        assert queued.done and 'boom' in queued.error
+        assert len(orch._free_slots) == config.max_slots
