@@ -1,0 +1,125 @@
+"""Prometheus-format metrics for the inference server.
+
+The serving twin of ``server/metrics.py`` (API-server metrics): the
+reference's serving recipes get engine observability from vLLM's
+``/metrics`` (request counts, token throughput, TTFT); replicas here
+expose the same signals so the serve controller, autoscaler dashboards
+and operators can scrape them.
+
+Exposed at GET /metrics on every replica:
+  * xsky_serve_requests_total{endpoint,outcome}
+  * xsky_serve_prompt_tokens_total / xsky_serve_generated_tokens_total
+  * xsky_serve_ttft_seconds          (histogram)
+  * xsky_serve_e2e_latency_seconds   (histogram)
+  * xsky_serve_active_slots / xsky_serve_free_slots /
+    xsky_serve_queue_depth           (gauges, read live)
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+_TTFT_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                 float('inf'))
+_E2E_BUCKETS = (0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0,
+                float('inf'))
+
+
+def _fmt_le(le: float) -> str:
+    return '+Inf' if le == float('inf') else f'{le:g}'
+
+
+class _Histogram:
+
+    def __init__(self, buckets) -> None:
+        self.les = buckets
+        self.counts = [0] * len(buckets)
+        self.total = 0.0
+        self.n = 0
+
+    def observe(self, value: float) -> None:
+        for i, le in enumerate(self.les):
+            if value <= le:
+                self.counts[i] += 1
+        self.total += value
+        self.n += 1
+
+    def render(self, name: str) -> list:
+        lines = [f'# TYPE {name} histogram']
+        for i, le in enumerate(self.les):
+            lines.append(f'{name}_bucket{{le="{_fmt_le(le)}"}} '
+                         f'{self.counts[i]}')
+        lines.append(f'{name}_sum {self.total:.6f}')
+        lines.append(f'{name}_count {self.n}')
+        return lines
+
+
+class ServeMetrics:
+    """Per-replica serving metrics; thread-safe, stdlib-only."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._requests: Dict[Tuple[str, str], int] = {}
+        self._prompt_tokens = 0
+        self._generated_tokens = 0
+        self._ttft = _Histogram(_TTFT_BUCKETS)
+        self._e2e = _Histogram(_E2E_BUCKETS)
+
+    def observe(self, endpoint: str, outcome: str, prompt_tokens: int,
+                generated_tokens: int, ttft_s: Optional[float],
+                e2e_s: Optional[float]) -> None:
+        with self._lock:
+            key = (endpoint, outcome)
+            self._requests[key] = self._requests.get(key, 0) + 1
+            self._prompt_tokens += prompt_tokens
+            self._generated_tokens += generated_tokens
+            if ttft_s is not None:
+                self._ttft.observe(ttft_s)
+            if e2e_s is not None:
+                self._e2e.observe(e2e_s)
+
+    def observe_request(self, endpoint: str, request,
+                        outcome: Optional[str] = None) -> None:
+        """Record a finished orchestrator Request. Pass `outcome`
+        explicitly when the handler knows better (a stop-sequence hit
+        sets cancel_requested but is a successful 'ok' completion; a
+        client disconnect is 'cancelled')."""
+        if outcome is None:
+            outcome = 'error' if request.error else 'ok'
+        ttft = None
+        if request.first_token_at is not None:
+            ttft = request.first_token_at - request.submitted_at
+        e2e = None
+        if request.finished_at is not None:
+            e2e = request.finished_at - request.submitted_at
+        self.observe(endpoint, outcome, len(request.prompt_tokens),
+                     len(request.output_tokens), ttft, e2e)
+
+    def render(self, orch=None) -> str:
+        with self._lock:
+            lines = ['# TYPE xsky_serve_requests_total counter']
+            for (endpoint, outcome), n in sorted(self._requests.items()):
+                lines.append(
+                    f'xsky_serve_requests_total{{endpoint="{endpoint}",'
+                    f'outcome="{outcome}"}} {n}')
+            lines += [
+                '# TYPE xsky_serve_prompt_tokens_total counter',
+                f'xsky_serve_prompt_tokens_total {self._prompt_tokens}',
+                '# TYPE xsky_serve_generated_tokens_total counter',
+                f'xsky_serve_generated_tokens_total '
+                f'{self._generated_tokens}',
+            ]
+            lines += self._ttft.render('xsky_serve_ttft_seconds')
+            lines += self._e2e.render('xsky_serve_e2e_latency_seconds')
+        if orch is not None:
+            active = len(orch._slot_req)
+            free = len(orch._free_slots)
+            lines += [
+                '# TYPE xsky_serve_active_slots gauge',
+                f'xsky_serve_active_slots {active}',
+                '# TYPE xsky_serve_free_slots gauge',
+                f'xsky_serve_free_slots {free}',
+                '# TYPE xsky_serve_queue_depth gauge',
+                f'xsky_serve_queue_depth {orch._pending.qsize()}',
+            ]
+        return '\n'.join(lines) + '\n'

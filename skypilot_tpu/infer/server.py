@@ -69,6 +69,8 @@ class ServingLoop:
             time.sleep(0.005)
         if not request.done:
             request.error = request.error or 'server timeout'
+            # The caller is gone: stop decoding for it, free the slot.
+            request.cancel_requested = True
         return request
 
     def _loop(self) -> None:
@@ -93,8 +95,12 @@ class ServingLoop:
 
 
 def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
-                  tokenizer=None, model_id: str = 'model'):
+                  tokenizer=None, model_id: str = 'model',
+                  metrics=None):
+    from skypilot_tpu.infer import metrics as metrics_lib
     from skypilot_tpu.infer import openai_api
+    if metrics is None:
+        metrics = metrics_lib.ServeMetrics()
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):
@@ -116,6 +122,14 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
                 self._json(200, {'object': 'list', 'data': [
                     {'id': model_id, 'object': 'model',
                      'owned_by': 'xsky'}]})
+            elif self.path == '/metrics':
+                data = metrics.render(orch=loop.orch).encode()
+                self.send_response(200)
+                self.send_header('Content-Type', 'text/plain; '
+                                 'version=0.0.4; charset=utf-8')
+                self.send_header('Content-Length', str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
             else:
                 self._json(404, {'error': 'not found'})
 
@@ -130,11 +144,14 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
                 self._json(404, {'error': 'not found'})
 
         def _read_json(self):
+            """Body as a dict, or None (invalid JSON *or* a JSON
+            scalar/array — handlers need .get to work)."""
             length = int(self.headers.get('Content-Length') or 0)
             try:
-                return json.loads(self.rfile.read(length))
+                body = json.loads(self.rfile.read(length))
             except json.JSONDecodeError:
                 return None
+            return body if isinstance(body, dict) else None
 
         def _generate(self):
             """Legacy token-ids wire surface (JetStream-twin)."""
@@ -155,6 +172,7 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
                 top_p=float(body.get('top_p', 1.0)))
             t0 = time.perf_counter()
             loop.submit_and_wait(request)
+            metrics.observe_request('/generate', request)
             if request.error:
                 self._json(400, {'error': request.error})
                 return
@@ -181,10 +199,18 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
             except openai_api.ApiError as e:
                 self._json(e.code, e.body())
                 return
+            endpoint = ('/v1/chat/completions' if chat
+                        else '/v1/completions')
             if meta.stream:
-                self._stream(request, meta)
+                outcome = 'cancelled'
+                try:
+                    outcome = self._stream(request, meta)
+                finally:
+                    metrics.observe_request(endpoint, request,
+                                            outcome=outcome)
                 return
             self._await_with_stops(request, meta)
+            metrics.observe_request(endpoint, request)
             if request.error:
                 self._json(400, {'error': {'message': request.error,
                                            'type': 'engine_error'}})
@@ -214,9 +240,11 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
                 time.sleep(0.005)
             if not request.done:
                 request.error = request.error or 'server timeout'
+                request.cancel_requested = True  # free the slot
 
-        def _stream(self, request, meta):
-            """Server-sent events; one chunk per newly safe text delta."""
+        def _stream(self, request, meta) -> str:
+            """Server-sent events; one chunk per newly safe text delta.
+            Returns the metrics outcome ('ok'/'error'/'cancelled')."""
             self.send_response(200)
             self.send_header('Content-Type', 'text/event-stream')
             self.send_header('Cache-Control', 'no-cache')
@@ -228,9 +256,21 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
             deadline = time.time() + 600.0
             seen = -1
             try:
+                if meta.echo and meta.kind == 'completion':
+                    # OpenAI streams the echoed prompt as the first
+                    # chunk (same divergence fix as finalize_text).
+                    prompt_text = meta.prompt_text or \
+                        tokenizer.decode(meta.prompt_tokens)
+                    self.wfile.write(openai_api.sse(
+                        openai_api.chunk_body(meta, prompt_text, None,
+                                              first=True)))
+                    self.wfile.flush()
+                    first = False
+                timed_out = False
                 while True:
                     if time.time() > deadline:
                         request.cancel_requested = True
+                        timed_out = True
                         break
                     done = request.done
                     # Snapshot: the orchestrator thread appends
@@ -253,6 +293,17 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
                     if done:
                         break
                     time.sleep(0.005)
+                error = request.error or \
+                    ('server timeout' if timed_out else None)
+                if error and not emitter.finished:
+                    # Engine died / deadline: tell the client instead of
+                    # dressing a truncation up as a clean finish.
+                    self.wfile.write(openai_api.sse(
+                        {'error': {'message': error,
+                                   'type': 'engine_error'}}))
+                    self.wfile.write(openai_api.SSE_DONE)
+                    self.wfile.flush()
+                    return 'error'
                 finish_reason = emitter.finish_reason or (
                     'length' if len(request.output_tokens) >=
                     request.max_new_tokens else 'stop')
@@ -260,9 +311,11 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
                     meta, '', finish_reason)))
                 self.wfile.write(openai_api.SSE_DONE)
                 self.wfile.flush()
+                return 'ok'
             except (BrokenPipeError, ConnectionResetError):
                 # Client went away: free the slot at the next token.
                 request.cancel_requested = True
+                return 'cancelled'
 
     return Handler
 

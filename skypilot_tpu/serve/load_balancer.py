@@ -34,8 +34,16 @@ class SkyServeLoadBalancer:
     def set_ready_replicas(self, endpoints: List[str]) -> None:
         self.policy.set_ready_replicas(endpoints)
 
-    def _proxy(self, method: str, path: str, body: bytes,
-               headers) -> Tuple[int, bytes, List[Tuple[str, str]]]:
+    def _proxy(self, method: str, path: str, body: bytes, headers
+               ) -> Tuple[int, object, List[Tuple[str, str]],
+                          Callable[[], None]]:
+        """Returns (status, payload, headers, finish). `payload` is
+        either bytes (error bodies) or the OPEN upstream response — the
+        handler streams it through chunk-by-chunk so server-sent-event
+        responses (/v1 streaming) reach the client as they are
+        produced, not after the generation finishes. `finish` must be
+        called once the payload is fully relayed (or abandoned): it
+        releases the replica's in-flight accounting."""
         self.on_request()
         tried = 0
         max_tries = 3
@@ -43,7 +51,8 @@ class SkyServeLoadBalancer:
             tried += 1
             replica = self.policy.select_replica()
             if replica is None:
-                return 503, b'{"error": "no ready replicas"}', []
+                return (503, b'{"error": "no ready replicas"}', [],
+                        lambda: None)
             url = f'http://{replica}{path}'
             req = urllib.request.Request(url, data=body or None,
                                          method=method)
@@ -51,21 +60,26 @@ class SkyServeLoadBalancer:
                 if k.lower() not in _HOP_HEADERS:
                     req.add_header(k, v)
             try:
-                with urllib.request.urlopen(req, timeout=120) as resp:
-                    out_headers = [
-                        (k, v) for k, v in resp.headers.items()
-                        if k.lower() not in _HOP_HEADERS
-                    ]
-                    data = resp.read()
-                    self.policy.request_done(replica)
-                    return resp.status, data, out_headers
+                resp = urllib.request.urlopen(req, timeout=120)
             except urllib.error.HTTPError as e:
                 self.policy.request_done(replica)
-                return e.code, e.read(), []
+                return e.code, e.read(), [], lambda: None
             except (urllib.error.URLError, OSError, TimeoutError):
                 self.policy.request_done(replica)
                 continue  # replica unreachable: try another
-        return 502, b'{"error": "all replicas unreachable"}', []
+            out_headers = [(k, v) for k, v in resp.headers.items()
+                           if k.lower() not in _HOP_HEADERS]
+            done = threading.Event()
+
+            def finish(replica=replica, resp=resp, done=done):
+                if not done.is_set():  # idempotent
+                    done.set()
+                    resp.close()
+                    self.policy.request_done(replica)
+
+            return resp.status, resp, out_headers, finish
+        return (502, b'{"error": "all replicas unreachable"}', [],
+                lambda: None)
 
     def make_server(self, host: str = '0.0.0.0',
                     port: int = 0) -> ThreadingHTTPServer:
@@ -79,14 +93,46 @@ class SkyServeLoadBalancer:
             def _handle(self, method: str):
                 length = int(self.headers.get('Content-Length') or 0)
                 body = self.rfile.read(length) if length else b''
-                status, data, out_headers = lb._proxy(
+                status, payload, out_headers, finish = lb._proxy(
                     method, self.path, body, self.headers)
-                self.send_response(status)
-                for k, v in out_headers:
-                    self.send_header(k, v)
-                self.send_header('Content-Length', str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
+                try:
+                    self.send_response(status)
+                    for k, v in out_headers:
+                        self.send_header(k, v)
+                    if isinstance(payload, bytes):
+                        self.send_header('Content-Length',
+                                         str(len(payload)))
+                        self.end_headers()
+                        self.wfile.write(payload)
+                        return
+                    # Open upstream response: relay as bytes arrive
+                    # (read1 = at most one underlying socket read, so
+                    # SSE chunks flush with production latency). No
+                    # Content-Length → the client reads until close.
+                    self.send_header('Connection', 'close')
+                    self.end_headers()
+                    while True:
+                        try:
+                            chunk = payload.read1(65536)
+                        except (OSError, TimeoutError):
+                            # Replica died mid-body. Headers are already
+                            # sent, so no retry is possible — close the
+                            # connection so the client sees truncation
+                            # rather than a silent clean EOF... which
+                            # HTTP/1.0 read-until-close can't express;
+                            # log it so the operator can.
+                            logger.warning(
+                                'upstream replica failed mid-relay on '
+                                f'{self.path}')
+                            break
+                        if not chunk:
+                            break
+                        self.wfile.write(chunk)
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client went away mid-relay
+                finally:
+                    finish()
 
             def do_GET(self):  # noqa: N802
                 self._handle('GET')

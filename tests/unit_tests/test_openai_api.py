@@ -228,6 +228,36 @@ class TestLiveEndpoints:
         assert status == 400
         assert payload['error']['type'] == 'invalid_request_error'
 
+    def test_non_object_json_body_is_400(self, live_server):
+        url, _ = live_server
+        for path in ('/v1/completions', '/generate'):
+            req = urllib.request.Request(
+                url + path, data=b'"just a string"',
+                headers={'Content-Type': 'application/json'})
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                status = 200
+            except urllib.error.HTTPError as e:
+                status = e.code
+            assert status == 400, path
+
+    def test_echo_streams_prompt_first(self, live_server):
+        url, _ = live_server
+        req = urllib.request.Request(
+            url + '/v1/completions',
+            data=json.dumps({'prompt': 'zq', 'echo': True,
+                             'stream': True, 'max_tokens': 4,
+                             'temperature': 0}).encode(),
+            headers={'Content-Type': 'application/json'})
+        texts = []
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            for line in resp:
+                line = line.decode().strip()
+                if line.startswith('data: ') and line != 'data: [DONE]':
+                    texts.append(json.loads(line[6:])['choices'][0]
+                                 .get('text', ''))
+        assert texts and texts[0] == 'zq'
+
     def test_echo_with_token_ids_prompt(self, live_server):
         url, tok = live_server
         status, payload = _post(url, '/v1/completions', {
@@ -252,6 +282,50 @@ class TestLiveEndpoints:
         assert choice['finish_reason'] == 'stop'
         assert stop_char not in choice['text']
         assert choice['text'] == text.split(stop_char)[0]
+
+
+class TestServeMetrics:
+
+    def test_metrics_after_requests(self, live_server):
+        url, _ = live_server
+        _post(url, '/v1/completions',
+              {'prompt': 'metrics-probe', 'max_tokens': 4,
+               'temperature': 0})
+        with urllib.request.urlopen(url + '/metrics') as resp:
+            assert 'text/plain' in resp.headers['Content-Type']
+            text = resp.read().decode()
+        assert 'xsky_serve_requests_total{endpoint="/v1/completions"' \
+            in text
+        assert 'xsky_serve_generated_tokens_total' in text
+        assert 'xsky_serve_ttft_seconds_count' in text
+        # Gauges read live from the orchestrator.
+        assert 'xsky_serve_free_slots 4' in text
+        assert 'xsky_serve_queue_depth 0' in text
+
+    def test_stop_hit_counts_as_ok_not_cancelled(self):
+        from skypilot_tpu.infer import metrics as metrics_lib
+        m = metrics_lib.ServeMetrics()
+        request = orch_lib.Request(prompt_tokens=[1, 2])
+        request.cancel_requested = True  # stop-sequence hit
+        request.output_tokens = [5, 6]
+        m.observe_request('/v1/completions', request, outcome='ok')
+        text = m.render()
+        assert 'outcome="ok"} 1' in text
+        assert 'cancelled' not in text
+
+    def test_histogram_rendering(self):
+        from skypilot_tpu.infer import metrics as metrics_lib
+        m = metrics_lib.ServeMetrics()
+        m.observe('/generate', 'ok', 10, 5, ttft_s=0.03, e2e_s=0.3)
+        m.observe('/generate', 'error', 2, 0, ttft_s=None, e2e_s=None)
+        text = m.render()
+        assert ('xsky_serve_requests_total{endpoint="/generate",'
+                'outcome="ok"} 1') in text
+        assert ('xsky_serve_requests_total{endpoint="/generate",'
+                'outcome="error"} 1') in text
+        assert 'xsky_serve_prompt_tokens_total 12' in text
+        assert 'xsky_serve_ttft_seconds_bucket{le="0.05"} 1' in text
+        assert 'xsky_serve_ttft_seconds_count 1' in text
 
 
 class TestCancellation:

@@ -337,6 +337,65 @@ class TestLbPolicies:
         assert p.select_replica() is None
 
 
+class TestLbStreaming:
+
+    def test_sse_chunks_relay_before_upstream_finishes(self):
+        """The LB must stream response bytes through as the replica
+        produces them (server-sent events for /v1 streaming), not
+        buffer until completion."""
+        import threading
+        import time as time_lib
+        import urllib.request
+        from http.server import BaseHTTPRequestHandler
+        from http.server import ThreadingHTTPServer
+        from skypilot_tpu.serve import load_balancer as lb_lib
+
+        release = threading.Event()
+
+        class Upstream(BaseHTTPRequestHandler):
+
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):  # noqa: N802
+                self.send_response(200)
+                self.send_header('Content-Type', 'text/event-stream')
+                self.end_headers()
+                self.wfile.write(b'data: first\n\n')
+                self.wfile.flush()
+                # Hold the stream open until the test saw the first
+                # chunk arrive through the LB.
+                release.wait(timeout=10)
+                self.wfile.write(b'data: second\n\n')
+                self.wfile.flush()
+
+        upstream = ThreadingHTTPServer(('127.0.0.1', 0), Upstream)
+        threading.Thread(target=upstream.serve_forever,
+                         daemon=True).start()
+        lb = lb_lib.SkyServeLoadBalancer()
+        lb.set_ready_replicas(
+            [f'127.0.0.1:{upstream.server_address[1]}'])
+        port = lb.run_in_thread()
+        try:
+            t0 = time_lib.time()
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{port}/stream',
+                    timeout=10) as resp:
+                first = resp.readline()
+                # First chunk must arrive while upstream is still
+                # blocked — proof of pass-through, not buffering.
+                assert first == b'data: first\n'
+                assert not release.is_set()
+                assert time_lib.time() - t0 < 5
+                release.set()
+                rest = resp.read()
+            assert b'data: second' in rest
+        finally:
+            release.set()
+            lb.shutdown()
+            upstream.shutdown()
+
+
 class TestSpotPlacer:
 
     def test_preemptive_zone_avoided(self):
@@ -473,7 +532,7 @@ class TestAutoscalerBursts:
                 scaler.collect_request_information(1, 0.0)))
         # Simulate the proxy entry (no replicas → 503, but the request
         # is still counted exactly once).
-        status, _, _ = lb._proxy('GET', '/', b'', {})
+        status, _, _, _ = lb._proxy('GET', '/', b'', {})
         assert status == 503
         assert len(calls) == 1
         assert len(scaler._request_timestamps) == 1
