@@ -34,6 +34,11 @@ import time
 _BASELINE_MODEL_TFLOPS_PER_CHIP = 23.5  # see module docstring
 
 _DEVICES_OK_SENTINEL = '#DEVICES_OK'
+# Upper bound on serve_main's ladder length (supervisor spawns one
+# child per rung; a child whose ladder is shorter exits with
+# _LADDER_EXHAUSTED_RC and the supervisor stops descending).
+_SERVE_LADDER_LEN = 4
+_LADDER_EXHAUSTED_RC = 3
 
 
 def _apply_platform_override() -> None:
@@ -141,6 +146,18 @@ def serve_main() -> None:
             ('tiny-bf16', llama.LLAMA_TINY, 4, 64, 8, 16, 8,
              (16,), False),
         ]
+    # The supervisor pins each child to ONE rung: an OOM on a big rung
+    # poisons the process's TPU allocator state, so ladder descent must
+    # happen across process boundaries (see _supervise).
+    rung_pin = os.environ.get('XSKY_BENCH_SERVE_RUNG')
+    if rung_pin is not None:
+        idx = int(rung_pin)
+        if idx >= len(ladder):
+            # Shorter ladder than the supervisor planned (CPU has one
+            # rung): rc=3 tells it the ladder is exhausted.
+            print('# serve rung out of range', flush=True)
+            sys.exit(_LADDER_EXHAUSTED_RC)
+        ladder = ladder[idx:idx + 1]
     def _hbm_note() -> str:
         """Best-effort free-HBM readout for failure diagnosis (the
         axon tunnel sometimes returns None from memory_stats)."""
@@ -325,8 +342,86 @@ def main() -> None:
     print(json.dumps(result))
 
 
+def _attempt_child(argv, env, init_timeout: float, run_timeout: float,
+                   attempt: int):
+    """One watched child run. Returns (ok, failure_dict_or_None)."""
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)] + argv,
+        stdout=subprocess.PIPE, stderr=None, text=True,
+        start_new_session=True, env=env)
+    devices_ok = threading.Event()
+    result_line = []
+
+    def _pump(out=proc.stdout, ok=devices_ok, res=result_line):
+        for line in out:
+            line = line.rstrip('\n')
+            if line.startswith(_DEVICES_OK_SENTINEL):
+                print(f'# attempt: {line[1:].strip()}',
+                      file=sys.stderr, flush=True)
+                ok.set()
+            elif line.startswith('{'):
+                res.append(line)
+            elif line:
+                print(line, file=sys.stderr, flush=True)
+
+    pump = threading.Thread(target=_pump, daemon=True)
+    pump.start()
+
+    def _kill(p=proc):
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            p.kill()
+        p.wait()
+
+    start = time.monotonic()
+    # Wait for the init sentinel, but wake early if the child dies
+    # (a 2s ImportError crash must not burn the full init window).
+    while (not devices_ok.is_set()
+           and time.monotonic() - start < init_timeout):
+        if devices_ok.wait(timeout=1.0):
+            break
+        if proc.poll() is not None:
+            # Drain the pipe: the sentinel may still be in flight.
+            pump.join(timeout=10)
+            break
+    init_done = time.monotonic()
+    if not devices_ok.is_set():
+        if proc.poll() is None:
+            _kill()
+            return False, {
+                'error': f'attempt {attempt}: jax.devices() produced '
+                         f'no sentinel within {init_timeout:.0f}s '
+                         '(hung TPU backend init)',
+                'stage': 'backend_init'}
+        pump.join(timeout=10)
+        return False, {
+            'error': f'attempt {attempt}: child exited '
+                     f'rc={proc.returncode} before device init',
+            'stage': 'backend_init'}
+    # The measurement window starts once devices are up — a
+    # slow-but-successful init must not eat into it.
+    remaining = run_timeout - (time.monotonic() - init_done)
+    try:
+        proc.wait(timeout=max(remaining, 1.0))
+    except subprocess.TimeoutExpired:
+        _kill()
+        return False, {
+            'error': f'attempt {attempt}: measurement exceeded '
+                     f'{run_timeout:.0f}s after device init',
+            'stage': 'run'}
+    pump.join(timeout=10)
+    if proc.returncode == 0 and result_line:
+        print(result_line[-1], flush=True)
+        return True, None
+    return False, {
+        'error': f'attempt {attempt}: child rc={proc.returncode}, '
+                 f'json={"yes" if result_line else "no"}',
+        'stage': 'run', 'rc': proc.returncode}
+
+
 def _supervise(argv) -> int:
-    """Run the measurement in a watched child; retry on init hang.
+    """Run the measurement in watched children; retry on init hang.
 
     The child prints `#DEVICES_OK ...` right after `jax.devices()`
     returns. If that sentinel does not arrive within the init window,
@@ -334,96 +429,49 @@ def _supervise(argv) -> int:
     (it may be holding the chip) and retry with backoff. On final
     failure print one structured JSON line so the driver's `parsed`
     carries a diagnosis instead of null.
+
+    Serve rungs each get a FRESH child process: an OOM on a big rung
+    can leave the in-process TPU allocator poisoned (observed: after
+    the 8B rung hits RESOURCE_EXHAUSTED, even the tiny rung fails in
+    the same process), so falling down the ladder only works across a
+    process boundary. Init-hangs retry the same rung with backoff;
+    run-stage failures move down the ladder.
     """
     attempts = int(os.environ.get('XSKY_BENCH_ATTEMPTS', '3'))
     init_timeout = float(os.environ.get('XSKY_BENCH_INIT_TIMEOUT', '240'))
     run_timeout = float(os.environ.get('XSKY_BENCH_RUN_TIMEOUT', '2400'))
+    serve = 'serve' in argv
     metric = ('llama_serve_output_tok_per_sec_per_chip'
-              if 'serve' in argv else 'llama_train_model_tflops_per_chip')
+              if serve else 'llama_train_model_tflops_per_chip')
     failure = {'error': 'not attempted', 'stage': 'backend_init'}
-    for attempt in range(1, attempts + 1):
-        env = dict(os.environ, XSKY_BENCH_CHILD='1')
-        proc = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__)] + argv,
-            stdout=subprocess.PIPE, stderr=None, text=True,
-            start_new_session=True, env=env)
-        devices_ok = threading.Event()
-        result_line = []
-
-        def _pump(out=proc.stdout, ok=devices_ok, res=result_line):
-            for line in out:
-                line = line.rstrip('\n')
-                if line.startswith(_DEVICES_OK_SENTINEL):
-                    print(f'# attempt: {line[1:].strip()}',
-                          file=sys.stderr, flush=True)
-                    ok.set()
-                elif line.startswith('{'):
-                    res.append(line)
-                elif line:
-                    print(line, file=sys.stderr, flush=True)
-
-        pump = threading.Thread(target=_pump, daemon=True)
-        pump.start()
-
-        def _kill(p=proc):
-            try:
-                os.killpg(p.pid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                p.kill()
-            p.wait()
-
-        start = time.monotonic()
-        # Wait for the init sentinel, but wake early if the child dies
-        # (a 2s ImportError crash must not burn the full init window).
-        while (not devices_ok.is_set()
-               and time.monotonic() - start < init_timeout):
-            if devices_ok.wait(timeout=1.0):
+    base_env = dict(os.environ, XSKY_BENCH_CHILD='1')
+    if serve:
+        plans = [dict(base_env, XSKY_BENCH_SERVE_RUNG=str(i))
+                 for i in range(_SERVE_LADDER_LEN)]
+    else:
+        plans = [base_env]
+    exhausted = False
+    for env in plans:
+        if exhausted:
+            break
+        for attempt in range(1, attempts + 1):
+            ok, failure = _attempt_child(argv, env, init_timeout,
+                                         run_timeout, attempt)
+            if ok:
+                return 0
+            rung = env.get('XSKY_BENCH_SERVE_RUNG')
+            where = f' (rung {rung})' if rung is not None else ''
+            print(f'# bench {failure["stage"]} failure{where}: '
+                  f'{failure["error"]}', file=sys.stderr, flush=True)
+            if failure.get('rc') == _LADDER_EXHAUSTED_RC:
+                # The child's ladder is shorter than planned (CPU):
+                # no more rungs exist to descend to.
+                exhausted = True
                 break
-            if proc.poll() is not None:
-                # Drain the pipe: the sentinel may still be in flight.
-                pump.join(timeout=10)
-                break
-        init_done = time.monotonic()
-        if not devices_ok.is_set():
-            if proc.poll() is None:
-                _kill()
-                failure = {
-                    'error': f'attempt {attempt}: jax.devices() produced '
-                             f'no sentinel within {init_timeout:.0f}s '
-                             '(hung TPU backend init)',
-                    'stage': 'backend_init'}
-            else:
-                pump.join(timeout=10)
-                failure = {
-                    'error': f'attempt {attempt}: child exited '
-                             f'rc={proc.returncode} before device init',
-                    'stage': 'backend_init'}
-        else:
-            # The measurement window starts once devices are up — a
-            # slow-but-successful init must not eat into it.
-            remaining = run_timeout - (time.monotonic() - init_done)
-            try:
-                proc.wait(timeout=max(remaining, 1.0))
-            except subprocess.TimeoutExpired:
-                _kill()
-                failure = {
-                    'error': f'attempt {attempt}: measurement exceeded '
-                             f'{run_timeout:.0f}s after device init',
-                    'stage': 'run'}
-            else:
-                pump.join(timeout=10)
-                if proc.returncode == 0 and result_line:
-                    print(result_line[-1], flush=True)
-                    return 0
-                failure = {
-                    'error': f'attempt {attempt}: child rc='
-                             f'{proc.returncode}, '
-                             f'json={"yes" if result_line else "no"}',
-                    'stage': 'run'}
-        print(f'# bench {failure["stage"]} failure: {failure["error"]}',
-              file=sys.stderr, flush=True)
-        if attempt < attempts:
-            time.sleep(15 * attempt)
+            if failure['stage'] == 'run' and serve:
+                break  # OOM-class: fresh process, next rung down
+            if attempt < attempts:
+                time.sleep(15 * attempt)
     print(json.dumps({'metric': metric, 'value': None, 'unit': None,
                       'vs_baseline': None, **failure,
                       'attempts': attempts}), flush=True)

@@ -62,10 +62,20 @@ class ServingLoop:
         return request
 
     def submit_and_wait(self, request: orch_lib.Request,
-                        timeout: float = 600.0) -> orch_lib.Request:
+                        timeout: float = 600.0,
+                        on_progress=None) -> orch_lib.Request:
+        """Blocking submit. `on_progress(request)` runs whenever new
+        tokens have landed (callers use it for stop-sequence checks —
+        it may set request.cancel_requested)."""
         self.submit(request)
         deadline = time.time() + timeout
+        seen = -1
         while not request.done and time.time() < deadline:
+            if on_progress is not None:
+                n = len(request.output_tokens)
+                if n > seen:
+                    seen = n
+                    on_progress(request)
             time.sleep(0.005)
         if not request.done:
             request.error = request.error or 'server timeout'
@@ -220,27 +230,22 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
             self._json(200, openai_api.response_body(
                 meta, request, text, finish_reason))
 
-        def _await_with_stops(self, request, meta,
-                              timeout: float = 600.0):
+        def _await_with_stops(self, request, meta):
             """Blocking wait that still cancels on a stop-sequence hit —
             without this, a stopped request would keep burning its
             decode slot until max_tokens even though the text past the
             stop is discarded."""
-            loop.submit(request)
-            deadline = time.time() + timeout
-            seen = 0
-            while not request.done and time.time() < deadline:
-                n = len(request.output_tokens)
-                if meta.stop and n > seen and not \
-                        request.cancel_requested:
-                    seen = n
-                    text = tokenizer.decode(list(request.output_tokens))
-                    if openai_api.find_stop(text, meta.stop) != -1:
-                        request.cancel_requested = True
-                time.sleep(0.005)
-            if not request.done:
-                request.error = request.error or 'server timeout'
-                request.cancel_requested = True  # free the slot
+
+            def check_stop(req):
+                if req.cancel_requested:
+                    return
+                text = tokenizer.decode(list(req.output_tokens))
+                if openai_api.find_stop(text, meta.stop) != -1:
+                    req.cancel_requested = True
+
+            loop.submit_and_wait(
+                request,
+                on_progress=check_stop if meta.stop else None)
 
         def _stream(self, request, meta) -> str:
             """Server-sent events; one chunk per newly safe text delta.

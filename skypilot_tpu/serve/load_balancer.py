@@ -69,6 +69,13 @@ class SkyServeLoadBalancer:
                 continue  # replica unreachable: try another
             out_headers = [(k, v) for k, v in resp.headers.items()
                            if k.lower() not in _HOP_HEADERS]
+            # Forward upstream framing: with a Content-Length the
+            # client can detect a replica dying mid-body (read1 sees a
+            # clean b'' on premature FIN, so the relay itself cannot);
+            # SSE responses have none and stay read-until-close.
+            upstream_cl = resp.headers.get('Content-Length')
+            if upstream_cl is not None:
+                out_headers.append(('Content-Length', upstream_cl))
             done = threading.Event()
 
             def finish(replica=replica, resp=resp, done=done):
