@@ -252,6 +252,85 @@ class InferenceEngine:
         }
         return state, next_tokens
 
+    # ---- speculative verification ----
+
+    @property
+    def supports_verify(self) -> bool:
+        return hasattr(self._model_lib, 'verify_forward')
+
+    @functools.partial(jax.jit, static_argnums=(0,),
+                       donate_argnums=(2,))
+    def _verify_step(self, params, state, proposals):
+        """Greedy speculative verification (one target pass for γ+1
+        tokens).
+
+        proposals [B, γ]: the draft's next-γ tokens per slot. The
+        target scores [t0, d1..dγ] (t0 = each slot's last accepted
+        token) in ONE multi-token decode — weights stream from HBM once
+        per γ+1 tokens instead of once per token. Acceptance is greedy:
+        d_{i+1} survives while it equals the target argmax after
+        d_1..d_i; the first mismatch is replaced by the target's own
+        argmax (the "bonus" token), so every round emits ≥ 1 token and
+        the output equals plain greedy decoding exactly.
+
+        Returns (state, emitted [B, γ+1], n_emitted [B]). Rejected
+        cache rows sit beyond each slot's new length and are
+        overwritten by later writes — rollback is just the length.
+        """
+        gamma = proposals.shape[1]
+        c = self.config.model
+        tokens_in = jnp.concatenate([state['tokens'][:, None],
+                                     proposals], axis=1)   # [B, γ+1]
+        positions = (state['lengths'][:, None] +
+                     jnp.arange(gamma + 1)[None, :])       # [B, γ+1]
+        kv = {'k': state['kv_k'], 'v': state['kv_v']}
+        logits, new_kv = self._model_lib.verify_forward(
+            c, params, tokens_in, positions, kv, mesh=self.mesh)
+        preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B,γ+1]
+        matches = (proposals == preds[:, :-1])                 # [B, γ]
+        accepted = jnp.sum(jnp.cumprod(matches.astype(jnp.int32),
+                                       axis=1), axis=1)        # [B]
+        bonus = jnp.take_along_axis(preds, accepted[:, None],
+                                    axis=1)[:, 0]              # [B]
+        idx = jnp.arange(gamma + 1)[None, :]
+        emitted = jnp.where(
+            idx < accepted[:, None],
+            jnp.concatenate([proposals,
+                             jnp.zeros_like(bonus)[:, None]], axis=1),
+            jnp.where(idx == accepted[:, None], bonus[:, None], 0))
+        n_emitted = accepted + 1
+        new_lengths = jnp.where(state['active'],
+                                state['lengths'] + n_emitted,
+                                state['lengths'])
+        state = {
+            'kv_k': new_kv['k'], 'kv_v': new_kv['v'],
+            'lengths': new_lengths,
+            'tokens': jnp.where(state['active'], bonus,
+                                state['tokens']),
+            'active': state['active'],
+        }
+        return state, emitted, n_emitted
+
+    def verify_step(self, state, proposals):
+        """Greedy-verify γ draft proposals per slot; see _verify_step."""
+        return self._verify_step(self.params, state,
+                                 jnp.asarray(proposals, jnp.int32))
+
+    def sync_slots_from(self, state, other_state):
+        """Align this (draft) state's bookkeeping with the target's
+        after a speculative round: lengths/tokens/active copy over; the
+        cache keeps whatever the draft wrote (rows beyond a slot's
+        length are dead, rows before it match the accepted tokens).
+
+        The tiny arrays are COPIED, not aliased: both engines' step
+        functions donate their state buffers, and a shared buffer
+        donated by one side would be a deleted buffer to the other."""
+        state = dict(state)
+        state['lengths'] = jnp.copy(other_state['lengths'])
+        state['tokens'] = jnp.copy(other_state['tokens'])
+        state['active'] = jnp.copy(other_state['active'])
+        return state
+
     def decode_step(self, state, temperatures=None, top_k=None,
                     top_p=None, key: Optional[jax.Array] = None):
         """Advance every slot one token. Returns (state, tokens [slots]).

@@ -345,6 +345,13 @@ def main() -> int:
                         help="'byte' (built-in reversible byte-level) "
                              'or a local HuggingFace tokenizer path '
                              '(enables the /v1 text endpoints)')
+    parser.add_argument('--draft-model', default=None,
+                        help='Enable speculative decoding with this '
+                             'draft model (same vocab; e.g. llama3-1b '
+                             'drafting for llama3-8b)')
+    parser.add_argument('--spec-gamma', type=int, default=4,
+                        help='Draft tokens proposed per speculative '
+                             'round')
     parser.add_argument('--model-id', default=None,
                         help='Model id reported by /v1/models '
                              '(default: --model)')
@@ -384,7 +391,22 @@ def main() -> int:
     else:
         params = model_lib.init(model, jax.random.PRNGKey(0))
     engine = engine_lib.InferenceEngine(config, params, mesh=mesh)
-    orch = orch_lib.Orchestrator(engine)
+    if args.draft_model:
+        draft_cfg = dataclasses.replace(
+            models.get_config(args.draft_model), remat=False)
+        draft_engine_config = engine_lib.EngineConfig(
+            model=draft_cfg, max_slots=args.max_slots,
+            max_target_len=args.max_target_len)
+        draft_lib = models.module_for(draft_cfg)
+        draft_params = draft_lib.init(draft_cfg, jax.random.PRNGKey(1))
+        draft_engine = engine_lib.InferenceEngine(
+            draft_engine_config, draft_params, mesh=mesh)
+        orch = orch_lib.SpeculativeOrchestrator(
+            engine, draft_engine, gamma=args.spec_gamma)
+        logger.info(f'Speculative decoding: draft={args.draft_model} '
+                    f'gamma={args.spec_gamma}')
+    else:
+        orch = orch_lib.Orchestrator(engine)
     # Warm the compile caches before declaring healthy.
     orch.generate([[1, 2, 3]], max_new_tokens=2)
     loop = ServingLoop(orch)

@@ -371,7 +371,19 @@ def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
         v_write, v_scale_write = quantize_kv(v)
     else:
         k_write, v_write = k, v
-    if cache_positions is not None:
+    if cache_positions is not None and cache_positions.ndim == 2:
+        # Multi-token per-slot write [B, S] (speculative verify: each
+        # slot scores S proposed tokens at its own offsets in one pass).
+        slots = jnp.arange(b)[:, None]
+        ck = ck.at[slots, cache_positions].set(k_write)
+        cv = cv.at[slots, cache_positions].set(v_write)
+        if quantized:
+            ck_scale = ck_scale.at[slots, cache_positions].set(
+                k_scale_write)
+            cv_scale = cv_scale.at[slots, cache_positions].set(
+                v_scale_write)
+        q_pos = cache_positions                         # [b, s]
+    elif cache_positions is not None:
         slots = jnp.arange(b)
         ck = ck.at[slots, cache_positions].set(k_write[:, 0])
         cv = cv.at[slots, cache_positions].set(v_write[:, 0])
@@ -401,6 +413,7 @@ def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
         cache_k, cache_v = ck, cv
 
     if (cache_positions is not None and s == 1
+            and cache_positions.ndim == 1
             and ck.shape[1] % min(decode_ops.DEFAULT_BLOCK_KV,
                                   ck.shape[1]) == 0
             and (mesh is None or decode_ops.shardable_on(
@@ -609,6 +622,42 @@ def decode_forward(config: LlamaConfig,
     logits = qops.matmul(x, params['lm_head'],
                          preferred_element_type=jnp.float32)
     return logits[:, 0], new_kv
+
+
+def verify_forward(config: LlamaConfig,
+                   params: Params,
+                   tokens: jax.Array,
+                   positions: jax.Array,
+                   kv: Dict[str, jax.Array],
+                   mesh: Optional[mesh_lib.Mesh] = None
+                   ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Multi-token decode for speculative verification.
+
+    tokens [B, S] (the last accepted token followed by S-1 draft
+    proposals), positions [B, S] (each slot writes at its own offsets),
+    kv as in decode_forward. Returns (logits [B, S, V], new kv): logits
+    at step i score the token FOLLOWING tokens[:, i], so one pass
+    yields every accept/reject decision plus the bonus token. The
+    weights are read once for S tokens — on a bandwidth-bound decode
+    that is the whole point of speculation.
+    """
+    c = config
+    x = qops.embed_rows(params['embed'], tokens).astype(c.dtype)  # [B,S,D]
+
+    def layer_fn(x, scanned):
+        lp, ck, cv = scanned
+        x, new_cache = _layer(c, mesh, x, lp, positions,
+                              kv_cache=(ck, cv),
+                              cache_index=None,
+                              cache_positions=positions)
+        return x, {'k': new_cache[0], 'v': new_cache[1]}
+
+    x, new_kv = jax.lax.scan(layer_fn, x, (params['layers'],
+                                           kv['k'], kv['v']))
+    x = _rms_norm(x, params['final_norm'], c.norm_eps)
+    logits = qops.matmul(x, params['lm_head'],
+                         preferred_element_type=jnp.float32)
+    return logits, new_kv
 
 
 def pipelined_loss_fn(config: LlamaConfig,

@@ -1,0 +1,194 @@
+"""Speculative decoding: draft proposes γ tokens, target verifies in
+one multi-token pass. The invariant under test everywhere: speculative
+greedy output EXACTLY equals plain greedy output, no matter how good or
+bad the draft is (draft quality may only change the acceptance rate).
+
+Exactness holds per numeric path: the single-token decode kernel and
+the multi-token verify pass are different reduction orders, so with
+random weights a near-tied argmax can flip between them (~1e-3 logit
+gaps). The tests pin both reference and speculative decoding to the
+XLA attention path so token-for-token equality is well-defined.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope='module', autouse=True)
+def _xla_decode_path():
+    """Pin decode attention to the XLA path for this module only (the
+    engines here are module-scoped, so they trace under it; restoring
+    on teardown keeps decode-kernel coverage in other modules)."""
+    prev = os.environ.get('XSKY_DECODE_ATTN')
+    os.environ['XSKY_DECODE_ATTN'] = 'xla'
+    yield
+    if prev is None:
+        os.environ.pop('XSKY_DECODE_ATTN', None)
+    else:
+        os.environ['XSKY_DECODE_ATTN'] = prev
+
+from skypilot_tpu.infer import engine as engine_lib
+from skypilot_tpu.infer import orchestrator as orch_lib
+from skypilot_tpu.models import llama
+
+pytestmark = pytest.mark.slow  # jit compiles
+
+TARGET = dataclasses.replace(llama.LLAMA_TINY, vocab_size=512)
+DRAFT = dataclasses.replace(llama.LLAMA_TINY, vocab_size=512,
+                            n_layers=1, d_model=32, n_heads=2,
+                            n_kv_heads=2, d_ff=64)
+
+
+def _engine(model, seed, **over):
+    config = engine_lib.EngineConfig(
+        model=model, max_slots=over.pop('max_slots', 4),
+        max_target_len=over.pop('max_target_len', 96),
+        prefill_buckets=over.pop('prefill_buckets', (16, 32)))
+    params = llama.init(model, jax.random.PRNGKey(seed))
+    return engine_lib.InferenceEngine(config, params)
+
+
+@pytest.fixture(scope='module')
+def target_engine():
+    return _engine(TARGET, seed=0)
+
+
+@pytest.fixture(scope='module')
+def draft_engine():
+    return _engine(DRAFT, seed=7)
+
+
+PROMPTS = [[5, 17, 3, 99, 42], [1, 2, 3], [7] * 11, [250, 9]]
+
+
+def _plain_greedy(engine, prompts, n_new):
+    orch = orch_lib.Orchestrator(engine)
+    return orch.generate([list(p) for p in prompts],
+                         max_new_tokens=n_new)
+
+
+class TestVerifyStep:
+
+    def test_perfect_proposals_all_accepted(self, target_engine):
+        """Feeding the true greedy continuation as proposals accepts
+        all γ and the bonus continues the chain."""
+        n_new = 8
+        expected = _plain_greedy(target_engine, [PROMPTS[0]], n_new)[0]
+
+        orch = orch_lib.Orchestrator(target_engine)
+        request = orch.submit(orch_lib.Request(
+            prompt_tokens=list(PROMPTS[0]), max_new_tokens=n_new))
+        orch._admit_one()  # emits expected[0]
+        assert request.output_tokens == expected[:1]
+        slot = next(iter(orch._slot_req))
+        gamma = 4
+        proposals = np.zeros((4, gamma), np.int32)
+        proposals[slot] = expected[1:1 + gamma]
+        state, emitted, n_emitted = target_engine.verify_step(
+            orch.state, proposals)
+        emitted = np.asarray(jax.device_get(emitted))
+        n_emitted = np.asarray(jax.device_get(n_emitted))
+        assert int(n_emitted[slot]) == gamma + 1
+        assert list(emitted[slot][:gamma + 1]) == expected[1:gamma + 2]
+
+    def test_garbage_proposals_still_advance_correctly(self,
+                                                       target_engine):
+        """All-rejected proposals emit exactly the plain-greedy next
+        token (the bonus)."""
+        n_new = 4
+        expected = _plain_greedy(target_engine, [PROMPTS[0]], n_new)[0]
+        orch = orch_lib.Orchestrator(target_engine)
+        orch.submit(orch_lib.Request(prompt_tokens=list(PROMPTS[0]),
+                                     max_new_tokens=n_new))
+        orch._admit_one()
+        slot = next(iter(orch._slot_req))
+        bad = np.full((4, 3), 499, np.int32)  # near-certainly wrong
+        if expected[1] == 499:
+            pytest.skip('model actually predicts the "garbage" token')
+        state, emitted, n_emitted = target_engine.verify_step(
+            orch.state, bad)
+        emitted = np.asarray(jax.device_get(emitted))
+        n_emitted = np.asarray(jax.device_get(n_emitted))
+        assert int(n_emitted[slot]) == 1
+        assert int(emitted[slot][0]) == expected[1]
+
+
+class TestSpeculativeOrchestrator:
+
+    def test_self_draft_full_acceptance(self, target_engine):
+        """Draft == target: outputs identical, acceptance 100%."""
+        n_new = 10
+        expected = _plain_greedy(target_engine, PROMPTS, n_new)
+        spec = orch_lib.SpeculativeOrchestrator(
+            target_engine, target_engine, gamma=3)
+        outputs = spec.generate([list(p) for p in PROMPTS],
+                                max_new_tokens=n_new)
+        assert outputs == expected
+        stats = spec.accept_stats
+        assert stats['rounds'] > 0
+        assert stats['accepted'] / stats['proposed'] > 0.9
+
+    def test_random_draft_exact_output(self, target_engine,
+                                       draft_engine):
+        """A draft with unrelated random weights must not change the
+        output by a single token."""
+        n_new = 12
+        expected = _plain_greedy(target_engine, PROMPTS, n_new)
+        spec = orch_lib.SpeculativeOrchestrator(
+            target_engine, draft_engine, gamma=4)
+        outputs = spec.generate([list(p) for p in PROMPTS],
+                                max_new_tokens=n_new)
+        assert outputs == expected
+
+    def test_budget_respected(self, target_engine, draft_engine):
+        spec = orch_lib.SpeculativeOrchestrator(
+            target_engine, draft_engine, gamma=4)
+        outputs = spec.generate([list(PROMPTS[0])], max_new_tokens=7)
+        assert len(outputs[0]) == 7
+
+    def test_mixed_batch_falls_back_and_finishes(self, target_engine,
+                                                 draft_engine):
+        n_new = 6
+        expected = _plain_greedy(target_engine, [PROMPTS[0]], n_new)[0]
+        spec = orch_lib.SpeculativeOrchestrator(
+            target_engine, draft_engine, gamma=3)
+        greedy = spec.submit(orch_lib.Request(
+            prompt_tokens=list(PROMPTS[0]), max_new_tokens=n_new))
+        sampled = spec.submit(orch_lib.Request(
+            prompt_tokens=list(PROMPTS[1]), max_new_tokens=n_new,
+            temperature=0.9))
+        spec.run_until_drained()
+        assert greedy.done and sampled.done
+        assert greedy.output_tokens == expected
+        assert len(sampled.output_tokens) == n_new
+
+    def test_speculation_resumes_after_mixed_phase(self, target_engine,
+                                                   draft_engine):
+        """After sampled requests drain, later greedy requests go back
+        through speculative rounds (stale draft cache costs only
+        acceptance, not correctness)."""
+        n_new = 8
+        spec = orch_lib.SpeculativeOrchestrator(
+            target_engine, draft_engine, gamma=3)
+        spec.generate([list(PROMPTS[1])], max_new_tokens=4,
+                      temperature=0.8)
+        rounds_before = spec.accept_stats['rounds']
+        expected = _plain_greedy(target_engine, [PROMPTS[2]], n_new)[0]
+        outputs = spec.generate([list(PROMPTS[2])],
+                                max_new_tokens=n_new)
+        assert outputs[0] == expected
+        assert spec.accept_stats['rounds'] > rounds_before
+
+    def test_config_mismatches_rejected(self, target_engine):
+        bad_slots = _engine(DRAFT, seed=1, max_slots=2)
+        with pytest.raises(ValueError, match='max_slots'):
+            orch_lib.SpeculativeOrchestrator(target_engine, bad_slots)
+        bad_vocab = _engine(
+            dataclasses.replace(DRAFT, vocab_size=300), seed=1)
+        with pytest.raises(ValueError, match='vocab'):
+            orch_lib.SpeculativeOrchestrator(target_engine, bad_vocab)
