@@ -302,6 +302,30 @@ def prefill_hidden(config: GemmaConfig, params: Params,
     return last, kv
 
 
+def verify_forward(config: GemmaConfig, params: Params,
+                   tokens: jax.Array, positions: jax.Array, kv,
+                   mesh: Optional[mesh_lib.Mesh] = None):
+    """Multi-token decode for speculative verification
+    (llama.verify_forward twin, with the scaled embedding and tied
+    soft-capped head): tokens/positions [B, S] →
+    (logits [B, S, V], new kv)."""
+    c = config
+    x = qops.embed_rows(params['embed'], tokens).astype(c.dtype)
+    x = x * jnp.asarray(c.d_model ** 0.5, c.dtype)
+
+    def layer_fn(x, scanned):
+        lp, ck, cv = scanned
+        x, new_cache = _layer(c, mesh, x, lp, positions,
+                              kv_cache=(ck, cv),
+                              cache_positions=positions)
+        return x, {'k': new_cache[0], 'v': new_cache[1]}
+
+    x, new_kv = jax.lax.scan(layer_fn, x, (params['layers'],
+                                           kv['k'], kv['v']))
+    x = _rms_norm(x, params['final_norm'], c.norm_eps)
+    return lm_logits(c, params, x), new_kv
+
+
 def decode_forward(config: GemmaConfig, params: Params,
                    last_tokens: jax.Array, positions: jax.Array,
                    kv, mesh: Optional[mesh_lib.Mesh] = None):

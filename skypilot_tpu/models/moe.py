@@ -395,6 +395,29 @@ def prefill_hidden(config: MoEConfig, params: Params, tokens: jax.Array,
     return last, kv
 
 
+def verify_forward(config: MoEConfig, params: Params,
+                   tokens: jax.Array, positions: jax.Array, kv,
+                   mesh: Optional[mesh_lib.Mesh] = None):
+    """Multi-token decode for speculative verification
+    (llama.verify_forward twin). Expert capacity scales as B*S in the
+    decode path, so the γ+1 verified tokens are never
+    capacity-dropped: verification stays deterministic."""
+    c = config
+    x = qops.embed_rows(params['embed'], tokens).astype(c.dtype)
+
+    def layer_fn(x, scanned):
+        lp, ck, cv = scanned
+        x, _, new_cache = _layer(c, mesh, x, lp, positions,
+                                 kv_cache=(ck, cv),
+                                 cache_positions=positions)
+        return x, {'k': new_cache[0], 'v': new_cache[1]}
+
+    x, new_kv = jax.lax.scan(layer_fn, x, (params['layers'],
+                                           kv['k'], kv['v']))
+    x = llama._rms_norm(x, params['final_norm'], c.norm_eps)
+    return lm_logits(c, params, x), new_kv
+
+
 def decode_forward(config: MoEConfig, params: Params,
                    last_tokens: jax.Array, positions: jax.Array,
                    kv, mesh: Optional[mesh_lib.Mesh] = None):

@@ -269,6 +269,28 @@ def decode_forward(config: QwenConfig, params: Params,
     return lm_logits(c, params, x)[:, 0], new_kv
 
 
+def verify_forward(config: QwenConfig, params: Params,
+                   tokens: jax.Array, positions: jax.Array, kv,
+                   mesh: Optional[mesh_lib.Mesh] = None):
+    """Multi-token decode for speculative verification
+    (llama.verify_forward twin): tokens/positions [B, S] →
+    (logits [B, S, V], new kv)."""
+    c = config
+    x = qops.embed_rows(params['embed'], tokens).astype(c.dtype)
+
+    def layer_fn(x, scanned):
+        lp, ck, cv = scanned
+        x, new_cache = _layer(c, mesh, x, lp, positions,
+                              kv_cache=(ck, cv),
+                              cache_positions=positions)
+        return x, {'k': new_cache[0], 'v': new_cache[1]}
+
+    x, new_kv = jax.lax.scan(layer_fn, x, (params['layers'],
+                                           kv['k'], kv['v']))
+    x = llama._rms_norm(x, params['final_norm'], c.norm_eps)
+    return lm_logits(c, params, x), new_kv
+
+
 def forward(config: QwenConfig, params: Params, tokens: jax.Array,
             mesh: Optional[mesh_lib.Mesh] = None,
             positions: Optional[jax.Array] = None) -> jax.Array:

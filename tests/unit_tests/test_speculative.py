@@ -184,6 +184,34 @@ class TestSpeculativeOrchestrator:
         assert outputs[0] == expected
         assert spec.accept_stats['rounds'] > rounds_before
 
+    @pytest.mark.parametrize('family', ['qwen', 'gemma', 'moe'])
+    def test_other_families_speculate_exactly(self, family,
+                                              draft_engine):
+        """qwen/gemma/moe targets verify against the llama draft and
+        still emit exactly the plain-greedy output."""
+        from skypilot_tpu.models import gemma, moe, qwen
+        model = {
+            'qwen': dataclasses.replace(qwen.QWEN3_TINY,
+                                        vocab_size=512),
+            'gemma': dataclasses.replace(gemma.GEMMA_TINY,
+                                         vocab_size=512),
+            'moe': dataclasses.replace(moe.MOE_TINY, vocab_size=512),
+        }[family]
+        module = {'qwen': qwen, 'gemma': gemma, 'moe': moe}[family]
+        config = engine_lib.EngineConfig(
+            model=model, max_slots=4, max_target_len=96,
+            prefill_buckets=(16, 32))
+        params = module.init(model, jax.random.PRNGKey(3))
+        target = engine_lib.InferenceEngine(config, params)
+        assert target.supports_verify
+        n_new = 10
+        expected = _plain_greedy(target, PROMPTS[:2], n_new)
+        spec = orch_lib.SpeculativeOrchestrator(target, draft_engine,
+                                                gamma=3)
+        outputs = spec.generate([list(p) for p in PROMPTS[:2]],
+                                max_new_tokens=n_new)
+        assert outputs == expected
+
     def test_config_mismatches_rejected(self, target_engine):
         bad_slots = _engine(DRAFT, seed=1, max_slots=2)
         with pytest.raises(ValueError, match='max_slots'):
