@@ -203,7 +203,12 @@ def serve_main() -> None:
             # rung must fall through to the next config, not abort.
             # One orchestrator owns the slot KV state for warmup AND
             # the measured run (benchmark drains fully per call).
-            orch = orch_lib.Orchestrator(engine)
+            # decode_steps=8: eight tokens per device dispatch — decode
+            # here is dispatch-latency-bound (the axon tunnel RTT
+            # dwarfs the ~3 ms of per-step HBM traffic), and fusing
+            # steps is also how a production server amortizes dispatch.
+            orch = orch_lib.Orchestrator(
+                engine, decode_steps=1 if platform == 'cpu' else 8)
             prompts = [[(i * 7 + j) % model.vocab_size
                         for j in range(prompt_len)]
                        for i in range(n_req)]

@@ -46,10 +46,102 @@ class EngineConfig:
     # HBM — decode is bandwidth-bound, and an 8B model fits one 16 GB
     # chip at int8. See ops/quantization.py.
     weight_dtype: Any = jnp.bfloat16
+    # > 0 enables the host-side LRU of device-resident KV prefixes
+    # (vLLM automatic-prefix-caching twin): requests sharing a prompt
+    # prefix (the usual shared system prompt) skip recomputing it —
+    # the cached rows are copied into the chunked-prefill scratch cache
+    # and only the suffix runs through the trunk. Entry count, not
+    # bytes: one entry holds one prompt's [L, true_len, KVH, HD] K+V.
+    prefix_cache_entries: int = 0
 
     @property
     def max_prompt_len(self) -> int:
         return self.prefill_buckets[-1]
+
+
+def supports_chunked_prefill(model_lib) -> bool:
+    """Whether a family module can serve the chunked-prefill path:
+    verify_forward (multi-token decode into a cache) plus the standard
+    [L, B, len, KVH, HD] layout (MLA's compressed latent opts out).
+    One predicate shared by the engine property and the server's flag
+    gating — two copies would drift."""
+    return (hasattr(model_lib, 'verify_forward')
+            and not hasattr(model_lib, 'kv_cache_shapes'))
+
+
+class PrefixCache:
+    """Host-side LRU of device-resident KV prefixes.
+
+    Keyed by the full prompt token tuple; a lookup may reuse any
+    leading subrange of an entry (K/V rows are positionwise — row i
+    depends only on tokens[:i+1], so the longest common prefix of a
+    cached prompt and a new prompt is always valid context). Arrays
+    stay on device; eviction frees them by dropping the reference.
+
+    Bounded by entry count AND bytes — one 8B-scale entry is hundreds
+    of MB of HBM, so an entry-only bound would let a handful of long
+    prompts quietly pin gigabytes.
+    """
+
+    DEFAULT_MAX_BYTES = 1 << 30
+
+    def __init__(self, max_entries: int,
+                 max_bytes: Optional[int] = None) -> None:
+        import collections
+        self._entries: 'collections.OrderedDict' = collections.OrderedDict()
+        self._max = max_entries
+        self._max_bytes = (self.DEFAULT_MAX_BYTES if max_bytes is None
+                           else max_bytes)
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.tokens_reused = 0
+
+    # Reusing fewer rows than this costs more in scratch-cache setup
+    # than it saves in trunk FLOPs.
+    MIN_REUSE = 16
+
+    def lookup(self, prompt_tokens) -> Tuple[int, Any]:
+        """→ (usable_len, kv dict [L, 1, usable_len, KVH, HD]) or (0, None)."""
+        pt = tuple(prompt_tokens)
+        best_len, best_key = 0, None
+        for key, (_, klen) in self._entries.items():
+            cap = min(klen, len(pt) - 1)
+            lcp = 0
+            while lcp < cap and key[lcp] == pt[lcp]:
+                lcp += 1
+            if lcp > best_len:
+                best_len, best_key = lcp, key
+        if best_len < self.MIN_REUSE:
+            self.misses += 1
+            return 0, None
+        self._entries.move_to_end(best_key)
+        kv, _ = self._entries[best_key]
+        self.hits += 1
+        self.tokens_reused += best_len
+        if kv['k'].shape[2] == best_len:
+            return best_len, kv
+        return best_len, {'k': kv['k'][:, :, :best_len],
+                          'v': kv['v'][:, :, :best_len]}
+
+    def store(self, prompt_tokens, kv, true_len: int) -> None:
+        pt = tuple(prompt_tokens)
+        if pt in self._entries:
+            self._entries.move_to_end(pt)
+            return
+        entry = {'k': kv['k'][:, :, :true_len],
+                 'v': kv['v'][:, :, :true_len]}
+        nbytes = sum(int(a.size) * a.dtype.itemsize
+                     for a in entry.values())
+        if nbytes > self._max_bytes:
+            return   # one entry alone would blow the budget
+        self._entries[pt] = (entry, true_len)
+        self._bytes += nbytes
+        while (len(self._entries) > self._max
+               or self._bytes > self._max_bytes):
+            _, (old, old_len) = self._entries.popitem(last=False)
+            self._bytes -= sum(int(a.size) * a.dtype.itemsize
+                               for a in old.values())
 
 
 class InferenceEngine:
@@ -93,6 +185,14 @@ class InferenceEngine:
             self._k_shape = self._v_shape = (
                 c.n_layers, config.max_slots, config.max_target_len,
                 c.n_kv_heads, c.head_dim)
+        self._prefix_cache: Optional[PrefixCache] = None
+        if config.prefix_cache_entries > 0:
+            if not self.supports_chunked_prefill:
+                raise NotImplementedError(
+                    'prefix_cache_entries needs the chunked-prefill path '
+                    '(verify_forward + the standard KV layout); '
+                    f'{self._model_lib.__name__} lacks it.')
+            self._prefix_cache = PrefixCache(config.prefix_cache_entries)
         if mesh is not None:
             if hasattr(self._model_lib, 'kv_cache_shapes'):
                 # Custom layouts (MLA: one latent "head") cannot shard
@@ -189,6 +289,132 @@ class InferenceEngine:
             key)
         return first_token, kv, true_len
 
+    # ---- chunked prefill + prefix reuse ----
+
+    @property
+    def supports_chunked_prefill(self) -> bool:
+        """See the module-level supports_chunked_prefill predicate."""
+        return supports_chunked_prefill(self._model_lib)
+
+    @property
+    def max_admit_len(self) -> int:
+        """Longest admissible prompt: the per-slot KV budget minus one
+        row for the first generated token when chunking is available,
+        else the largest prefill bucket."""
+        if self.supports_chunked_prefill:
+            return self.config.max_target_len - 1
+        return min(self.config.max_prompt_len,
+                   self.config.max_target_len - 1)
+
+    @property
+    def prefix_cache_stats(self) -> Optional[Dict[str, int]]:
+        pc = self._prefix_cache
+        if pc is None:
+            return None
+        return {'hits': pc.hits, 'misses': pc.misses,
+                'tokens_reused': pc.tokens_reused,
+                'entries': len(pc._entries)}
+
+    @functools.partial(jax.jit, static_argnums=(0, 6),
+                       donate_argnums=(2,))
+    def _chunk_forward(self, params, scratch_kv, tokens, start, last_idx,
+                       need_logits: bool):
+        """One prompt chunk through the trunk against the scratch cache.
+
+        tokens [1, C] fill rows start..start+C-1; with need_logits the
+        row at `last_idx` (chunk-relative) comes back as [1, V] logits.
+        Intermediate chunks pass need_logits=False, so XLA dead-codes
+        the whole [C, V] lm_head matmul out of the compiled program —
+        only the final chunk pays for logits, and only one row of them
+        leaves the jit.
+        """
+        positions = start + jnp.arange(tokens.shape[1])[None, :]
+        logits, new_kv = self._model_lib.verify_forward(
+            self.config.model, params, tokens, positions, scratch_kv,
+            mesh=self.mesh)
+        if not need_logits:
+            return None, new_kv
+        row = jax.lax.dynamic_index_in_dim(logits, last_idx, axis=1,
+                                           keepdims=False)      # [1, V]
+        return row, new_kv
+
+    def _make_scratch_cache(self, prefix_kv=None) -> Dict[str, jax.Array]:
+        """[L, 1, max_target_len, KVH, HD] bf16 scratch, optionally
+        seeded with a cached prefix (rows beyond it start zero and are
+        overwritten by the chunk passes)."""
+        c = self.config.model
+        cap = self.config.max_target_len
+        if prefix_kv is not None:
+            pad = cap - prefix_kv['k'].shape[2]
+            widths = ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))
+            return {'k': jnp.pad(prefix_kv['k'].astype(c.dtype), widths),
+                    'v': jnp.pad(prefix_kv['v'].astype(c.dtype), widths)}
+        shape = (c.n_layers, 1, cap, c.n_kv_heads, c.head_dim)
+        return {'k': jnp.zeros(shape, c.dtype),
+                'v': jnp.zeros(shape, c.dtype)}
+
+    def prefill_any(self, prompt_tokens,
+                    sampling_params: Optional[sampling.SamplingParams]
+                    = None,
+                    key: Optional[jax.Array] = None
+                    ) -> Tuple[jax.Array, Any, int]:
+        """prefill() for prompts of any length ≤ max_admit_len.
+
+        Consults the prefix cache first; a hit copies the cached rows
+        into a scratch cache and runs only the suffix. Prompts beyond
+        the largest bucket run bucket-sized chunks through
+        _chunk_forward (the padded rows of the last chunk write garbage
+        beyond true_len — harmless, every row past a slot's live
+        frontier is rewritten by decode before it is ever read).
+        Returns (first_token, kv, true_len) exactly like prefill().
+        """
+        sp = sampling_params or sampling.SamplingParams()
+        true_len = len(prompt_tokens)
+        prefix_len, prefix_kv = (self._prefix_cache.lookup(prompt_tokens)
+                                 if self._prefix_cache is not None
+                                 else (0, None))
+        if prefix_len == 0 and true_len <= self.config.max_prompt_len:
+            out = self.prefill(prompt_tokens, sampling_params, key)
+            if self._prefix_cache is not None:
+                self._prefix_cache.store(prompt_tokens, out[1], true_len)
+            return out
+        if not self.supports_chunked_prefill:
+            raise ValueError(
+                f'Prompt length {true_len} exceeds max prefill bucket '
+                f'{self.config.max_prompt_len} and '
+                f'{self._model_lib.__name__} has no chunked-prefill '
+                'path.')
+        if true_len > self.max_admit_len:
+            raise ValueError(f'Prompt length {true_len} exceeds '
+                             f'max_admit_len {self.max_admit_len}.')
+        scratch = self._make_scratch_cache(prefix_kv)
+        chunk = self.config.max_prompt_len
+        pos = prefix_len
+        row_logits = None
+        while pos < true_len:
+            remaining = true_len - pos
+            size = chunk if remaining > chunk else self.bucket_for(
+                remaining)
+            n_real = min(remaining, size)
+            padded = jnp.zeros((1, size), jnp.int32).at[0, :n_real].set(
+                jnp.asarray(prompt_tokens[pos:pos + n_real], jnp.int32))
+            last = pos + size >= true_len
+            row_logits, scratch = self._chunk_forward(
+                self.params, scratch, padded, jnp.int32(pos),
+                jnp.int32(n_real - 1), last)
+            pos += n_real
+        if key is None:
+            self._key, key = jax.random.split(self._key)
+        first_token = sampling.sample_batched(
+            row_logits, key,
+            jnp.full((1,), sp.temperature, jnp.float32),
+            jnp.full((1,), sp.top_k, jnp.int32) if sp.top_k > 0 else None,
+            jnp.full((1,), sp.top_p, jnp.float32) if sp.top_p < 1.0
+            else None)[0]
+        if self._prefix_cache is not None:
+            self._prefix_cache.store(prompt_tokens, scratch, true_len)
+        return first_token, scratch, true_len
+
     # ---- insert ----
 
     @functools.partial(jax.jit, static_argnums=(0,),
@@ -224,9 +450,8 @@ class InferenceEngine:
 
     # ---- decode ----
 
-    @functools.partial(jax.jit, static_argnums=(0,),
-                       donate_argnums=(2,))
-    def _decode_step(self, params, state, temperatures, top_k, top_p, key):
+    def _decode_step_impl(self, params, state, temperatures, top_k,
+                          top_p, key):
         """Per-slot sampling params [slots] (temp 0 → greedy, top_k 0 /
         top_p 1 → filter off); all traced — no value-dependent recompiles
         mid-serving. params is a traced argument: closing over self.params
@@ -240,9 +465,15 @@ class InferenceEngine:
         next_tokens = sampling.sample_batched(logits, key, temperatures,
                                               top_k, top_p)
         # Inactive slots hold position (their garbage writes are confined
-        # to their own slot rows and overwritten on insert).
-        new_lengths = jnp.where(state['active'], state['lengths'] + 1,
-                                state['lengths'])
+        # to their own slot rows and overwritten on insert). Lengths cap
+        # at the KV budget: a finished slot kept stepping in a fused
+        # batch must not push the decode kernels toward out-of-range
+        # block indices (the kernels also clamp defensively).
+        new_lengths = jnp.where(
+            state['active'],
+            jnp.minimum(state['lengths'] + 1,
+                        self.config.max_target_len),
+            state['lengths'])
         state = {
             'kv_k': new_kv['k'], 'kv_v': new_kv['v'],
             'lengths': new_lengths,
@@ -251,6 +482,35 @@ class InferenceEngine:
             'active': state['active'],
         }
         return state, next_tokens
+
+    @functools.partial(jax.jit, static_argnums=(0,),
+                       donate_argnums=(2,))
+    def _decode_step(self, params, state, temperatures, top_k, top_p,
+                     key):
+        return self._decode_step_impl(params, state, temperatures, top_k,
+                                      top_p, key)
+
+    @functools.partial(jax.jit, static_argnums=(0, 6),
+                       donate_argnums=(2,))
+    def _decode_steps(self, params, state, temperatures, top_k, top_p,
+                      n: int, key):
+        """n fused decode steps under one dispatch (lax.scan).
+
+        One host↔device round trip per n tokens instead of per token —
+        decode is dispatch-latency-bound long before it is
+        bandwidth-bound once the per-step kernel work drops to
+        milliseconds. The host inspects the n token vectors afterwards;
+        a slot that hits EOS/budget mid-batch decodes garbage for the
+        remainder (≤ n-1 wasted steps per finish — its writes stay in
+        its own slot rows, and a slot at the KV cap is by construction
+        at its budget end, so the clamped writes land in rows that are
+        released before anything reads them).
+        """
+        def body(state, step_key):
+            return self._decode_step_impl(params, state, temperatures,
+                                          top_k, top_p, step_key)
+
+        return jax.lax.scan(body, state, jax.random.split(key, n))
 
     # ---- speculative verification ----
 
@@ -299,9 +559,11 @@ class InferenceEngine:
                              jnp.zeros_like(bonus)[:, None]], axis=1),
             jnp.where(idx == accepted[:, None], bonus[:, None], 0))
         n_emitted = accepted + 1
-        new_lengths = jnp.where(state['active'],
-                                state['lengths'] + n_emitted,
-                                state['lengths'])
+        new_lengths = jnp.where(
+            state['active'],
+            jnp.minimum(state['lengths'] + n_emitted,
+                        self.config.max_target_len),
+            state['lengths'])
         state = {
             'kv_k': new_kv['k'], 'kv_v': new_kv['v'],
             'lengths': new_lengths,
@@ -331,16 +593,22 @@ class InferenceEngine:
         state['active'] = jnp.copy(other_state['active'])
         return state
 
-    def decode_step(self, state, temperatures=None, top_k=None,
-                    top_p=None, key: Optional[jax.Array] = None):
-        """Advance every slot one token. Returns (state, tokens [slots]).
+    def decode_steps(self, state, n: int, temperatures=None, top_k=None,
+                     top_p=None, key: Optional[jax.Array] = None):
+        """Advance every slot n tokens in one dispatch.
 
-        Per-slot arrays [max_slots]: temperatures (0 = greedy), top_k
-        (0 = off), top_p (1 = off); None means disabled for all slots.
-        Mixed greedy/sampled batches are correct per slot. If `key` is
-        omitted, an engine-owned key is split per call so repeated steps
-        never reuse PRNG state.
+        Returns (state, tokens [n, slots]) — see _decode_steps for the
+        latency rationale and mid-batch-finish semantics. Sampling
+        params as in decode_step.
         """
+        temperatures, top_k, top_p = self._norm_sampling(temperatures,
+                                                         top_k, top_p)
+        if key is None:
+            self._key, key = jax.random.split(self._key)
+        return self._decode_steps(self.params, state, temperatures,
+                                  top_k, top_p, n, key)
+
+    def _norm_sampling(self, temperatures, top_k, top_p):
         import numpy as np
         slots = self.config.max_slots
         if temperatures is None:
@@ -359,6 +627,20 @@ class InferenceEngine:
             tp = np.asarray(top_p)
             top_p = None if (tp >= 1.0).all() else jnp.asarray(
                 tp, jnp.float32)
+        return temperatures, top_k, top_p
+
+    def decode_step(self, state, temperatures=None, top_k=None,
+                    top_p=None, key: Optional[jax.Array] = None):
+        """Advance every slot one token. Returns (state, tokens [slots]).
+
+        Per-slot arrays [max_slots]: temperatures (0 = greedy), top_k
+        (0 = off), top_p (1 = off); None means disabled for all slots.
+        Mixed greedy/sampled batches are correct per slot. If `key` is
+        omitted, an engine-owned key is split per call so repeated steps
+        never reuse PRNG state.
+        """
+        temperatures, top_k, top_p = self._norm_sampling(temperatures,
+                                                         top_k, top_p)
         if key is None:
             self._key, key = jax.random.split(self._key)
         return self._decode_step(self.params, state, temperatures, top_k,

@@ -50,7 +50,10 @@ class Orchestrator:
     """Runs requests to completion with continuous batching."""
 
     def __init__(self, engine: engine_lib.InferenceEngine,
-                 seed: int = 0) -> None:
+                 seed: int = 0, decode_steps: int = 1) -> None:
+        if decode_steps < 1:
+            raise ValueError(f'decode_steps must be >= 1, '
+                             f'got {decode_steps}')
         self.engine = engine
         self.state = engine.init_decode_state()
         self._slot_req: Dict[int, Request] = {}
@@ -59,6 +62,13 @@ class Orchestrator:
         self._next_id = 0
         self._lock = threading.Lock()
         self._key = jax.random.PRNGKey(seed)
+        # > 1 fuses that many decode steps into one device dispatch
+        # (engine.decode_steps): the host sees tokens in batches of n,
+        # so EOS/cancel latency grows by ≤ n-1 tokens and a finishing
+        # slot wastes ≤ n-1 garbage steps — the trade that wins
+        # whenever dispatch latency rivals per-step compute. Admission
+        # still happens every tick, so TTFT is unaffected.
+        self.decode_steps = decode_steps
 
     # ---- submission ----
 
@@ -71,6 +81,9 @@ class Orchestrator:
         return request
 
     # ---- scheduling ----
+
+    def _admit_limit(self) -> int:
+        return self.engine.max_admit_len
 
     def _admit_one(self) -> bool:
         """Prefill + insert one pending request into a free slot."""
@@ -86,10 +99,10 @@ class Orchestrator:
             request.finished_at = time.perf_counter()
             return True
         prompt_len = len(request.prompt_tokens)
-        # The prompt must fit the prefill buckets AND leave room for at
-        # least one generated token in the per-slot KV budget.
-        limit = min(self.engine.config.max_prompt_len,
-                    self.engine.config.max_target_len - 1)
+        # The prompt must leave room for at least one generated token in
+        # the per-slot KV budget; families with a chunked-prefill path
+        # admit beyond the largest bucket (engine.max_admit_len).
+        limit = self._admit_limit()
         if prompt_len == 0 or prompt_len > limit:
             # Reject rather than crash the serving loop (the slot has not
             # been claimed yet, so capacity is unaffected).
@@ -106,7 +119,9 @@ class Orchestrator:
                                       prompt_len)
         slot = self._free_slots.pop()
         # Key omitted: the engine owns sampling-key state (split per call).
-        first_token, kv, true_len = self.engine.prefill(
+        # prefill_any == prefill for in-bucket prompts with no cached
+        # prefix; beyond that it chunks and reuses cached prefixes.
+        first_token, kv, true_len = self.engine.prefill_any(
             request.prompt_tokens,
             sampling_params=sampling_lib.SamplingParams(
                 temperature=request.temperature, top_k=request.top_k,
@@ -148,14 +163,21 @@ class Orchestrator:
             top_k[slot] = request.top_k
             top_p[slot] = request.top_p
         self._key, step_key = jax.random.split(self._key)
-        self.state, tokens = self.engine.decode_step(
-            self.state, temperatures=temps, top_k=top_k, top_p=top_p,
-            key=step_key)
-        tokens = np.asarray(jax.device_get(tokens))
-        for slot in list(self._slot_req):
-            request = self._slot_req[slot]
-            request.output_tokens.append(int(tokens[slot]))
-            self._maybe_finish(slot, int(tokens[slot]))
+        if self.decode_steps == 1:
+            self.state, tokens = self.engine.decode_step(
+                self.state, temperatures=temps, top_k=top_k, top_p=top_p,
+                key=step_key)
+            batches = np.asarray(jax.device_get(tokens))[None, :]
+        else:
+            self.state, tokens = self.engine.decode_steps(
+                self.state, self.decode_steps, temperatures=temps,
+                top_k=top_k, top_p=top_p, key=step_key)
+            batches = np.asarray(jax.device_get(tokens))    # [n, slots]
+        for row in batches:
+            for slot in list(self._slot_req):
+                request = self._slot_req[slot]
+                request.output_tokens.append(int(row[slot]))
+                self._maybe_finish(slot, int(row[slot]))
 
     def fail_all(self, error: str) -> None:
         """Finish every active and pending request with `error` and
@@ -268,6 +290,12 @@ class SpeculativeOrchestrator(Orchestrator):
         self.gamma = gamma
         self.accept_stats = {'rounds': 0, 'proposed': 0, 'accepted': 0}
 
+    def _admit_limit(self) -> int:
+        # Both engines prefill every admitted prompt, so the admit gate
+        # is the tighter of the two (the draft may lack chunked prefill
+        # or have smaller buckets).
+        return min(self.engine.max_admit_len, self.draft.max_admit_len)
+
     def _admit_one(self) -> bool:
         # Snapshot which slot the base admit fills, then mirror the
         # prompt into the draft cache so its proposals have context.
@@ -282,7 +310,8 @@ class SpeculativeOrchestrator(Orchestrator):
         request = self._slot_req.get(slot)
         if request is None:
             return True  # finished during admit (eos on first token)
-        _, draft_kv, true_len = self.draft.prefill(request.prompt_tokens)
+        _, draft_kv, true_len = self.draft.prefill_any(
+            request.prompt_tokens)
         # The draft chain continues from the TARGET's sampled first
         # token (insert() records it as the slot's pending token).
         self.draft_state = self.draft.insert(

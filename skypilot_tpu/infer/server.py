@@ -355,17 +355,32 @@ def main() -> int:
     parser.add_argument('--model-id', default=None,
                         help='Model id reported by /v1/models '
                              '(default: --model)')
+    parser.add_argument('--decode-steps', type=int, default=4,
+                        help='Decode steps fused per device dispatch '
+                             '(amortizes dispatch latency; streaming '
+                             'granularity and EOS latency grow by the '
+                             'same factor). 1 = per-token.')
+    parser.add_argument('--prefix-cache', type=int, default=0,
+                        help='Prefix-cache entries (device-resident KV '
+                             'reuse for shared prompt prefixes; entry '
+                             'bytes are bounded, but entries are bf16 '
+                             'KV — budget HBM before enabling). '
+                             '0 (default) disables')
     args = parser.parse_args()
 
     model = models.get_config(args.model)
     model = dataclasses.replace(model, remat=False)
     import jax.numpy as jnp
+    prefix_entries = args.prefix_cache
+    if not engine_lib.supports_chunked_prefill(models.module_for(model)):
+        prefix_entries = 0   # family lacks the chunked-prefill path
     config = engine_lib.EngineConfig(
         model=model, max_slots=args.max_slots,
         max_target_len=args.max_target_len,
         kv_dtype=jnp.int8 if args.kv_dtype == 'int8' else jnp.bfloat16,
         weight_dtype=(jnp.int8 if args.weight_dtype == 'int8'
-                      else jnp.bfloat16))
+                      else jnp.bfloat16),
+        prefix_cache_entries=prefix_entries)
     mesh = None
     if args.mesh:
         from skypilot_tpu.train.launch import parse_mesh
@@ -406,7 +421,8 @@ def main() -> int:
         logger.info(f'Speculative decoding: draft={args.draft_model} '
                     f'gamma={args.spec_gamma}')
     else:
-        orch = orch_lib.Orchestrator(engine)
+        orch = orch_lib.Orchestrator(engine,
+                                     decode_steps=args.decode_steps)
     # Warm the compile caches before declaring healthy.
     orch.generate([[1, 2, 3]], max_new_tokens=2)
     loop = ServingLoop(orch)

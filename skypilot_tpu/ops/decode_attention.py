@@ -8,15 +8,21 @@ at max_len 2048 and true lengths ~200 that is >10× the necessary HBM
 traffic, and decode attention is pure bandwidth.
 
 This kernel is the JetStream-class fix:
-  * grid (slots, KV heads, KV blocks) with the per-slot lengths array
+  * grid (slots, KV blocks) with the per-slot lengths array
     scalar-prefetched, so the BlockSpec index_maps clamp past-the-end
     blocks to the last live block — Mosaic elides the DMA for a block
     index that does not change between grid steps, so dead blocks cost
     neither bandwidth nor MXU time (compute is @pl.when-gated on the
     same predicate);
-  * GQA-native: one program per KV head attends all `groups` query
-    heads sharing it ([groups, D] × [D, block] on the MXU), so K/V
-    stream once per group;
+  * each program holds ALL KV heads of one KV block — the block's last
+    two dims (Hkv, D) equal the array dims, which the Mosaic tiling
+    rules accept for any head count/size (a per-head grid axis would
+    need a size-1 block on the second-to-last dim, which TPU lowering
+    rejects unless Hkv == 1); the head loop is unrolled in-kernel with
+    per-head scratch tiles;
+  * GQA-native: each unrolled head step attends all `groups` query
+    heads sharing that KV head ([groups, D] × [D, block] on the MXU),
+    so K/V stream once per group;
   * int8 KV: the (values, scale) pair dequantizes in VMEM right before
     the matmuls — the int8 cache is what crosses HBM, which is the
     entire point of quantizing it;
@@ -63,10 +69,10 @@ def _first_block(length, block_kv: int, window):
 def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, k_scale_ref,
                    v_scale_ref, o_ref, acc_ref, m_ref, l_ref, *,
                    scale: float, block_kv: int, window,
-                   quantized: bool):
+                   quantized: bool, h_kv: int):
     b = pl.program_id(0)
-    ki = pl.program_id(2)
-    num_ki = pl.num_programs(2)
+    ki = pl.program_id(1)
+    num_ki = pl.num_programs(1)
 
     @pl.when(ki == 0)
     def _init():
@@ -84,40 +90,46 @@ def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, k_scale_ref,
 
     @pl.when(first + ki <= last)
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)            # [groups, d]
-        k = k_ref[0, :, 0].astype(jnp.float32)         # [bkv, d]
-        v = v_ref[0, :, 0].astype(jnp.float32)         # [bkv, d]
-        if quantized:
-            k = k * k_scale_ref[0, :, 0]               # [bkv, 1] scale
-            v = v * v_scale_ref[0, :, 0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [groups, bkv]
+        groups = q_ref.shape[2]
         pos = kv_start + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1)
+            jnp.int32, (groups, block_kv), 1)
         keep = pos < length
         if window is not None:
             keep = keep & (pos >= length - window)
-        s = jnp.where(keep, s, _NEG_INF)
+        # Static unrolled head loop: every slice below is static, and
+        # each head owns its own [groups, …] scratch tile (leading-dim
+        # indexed — no sub-tile scratch slicing).
+        for hi in range(h_kv):
+            q = q_ref[0, hi].astype(jnp.float32)       # [groups, d]
+            k = k_ref[0, :, hi].astype(jnp.float32)    # [bkv, d]
+            v = v_ref[0, :, hi].astype(jnp.float32)    # [bkv, d]
+            if quantized:
+                k = k * k_scale_ref[0, :, hi]          # [bkv, 1] scale
+                v = v * v_scale_ref[0, :, hi]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [grp,bkv]
+            s = jnp.where(keep, s, _NEG_INF)
 
-        m_prev = m_ref[:, 0:1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = l_ref[:, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [groups, d]
-        acc_ref[:] = acc_ref[:] * alpha + pv
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+            m_prev = m_ref[hi, :, 0:1]
+            m_cur = jnp.max(s, axis=1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = (l_ref[hi, :, 0:1] * alpha +
+                     jnp.sum(p, axis=1, keepdims=True))
+            pv = jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [groups, d]
+            acc_ref[hi] = acc_ref[hi] * alpha + pv
+            m_ref[hi] = jnp.broadcast_to(m_new, m_ref.shape[1:])
+            l_ref[hi] = jnp.broadcast_to(l_new, l_ref.shape[1:])
 
     @pl.when(ki == num_ki - 1)
     def _finalize():
-        l = l_ref[:, 0:1]
+        l = l_ref[:, :, 0:1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
 
 
 def shardable_on(mesh, b: int, h_kv: int) -> bool:
@@ -187,46 +199,55 @@ def decode_attention(q: jax.Array, k_cache, v_cache, lengths: jax.Array,
     if max_len % block_kv != 0:
         raise ValueError(f'max_len {max_len} % block_kv {block_kv} != 0')
     num_blocks = max_len // block_kv
-    lengths = lengths.astype(jnp.int32)
+    # Clamp: a caller tracking lengths past the cache cap (a finished
+    # slot kept decoding in a fused batch) must not drive _last_block
+    # to an out-of-range KV block index — that is an out-of-bounds DMA
+    # on TPU, not a dropped write.
+    lengths = jnp.minimum(lengths.astype(jnp.int32), max_len)
 
-    # [B, Hkv, groups, D]: one program's query block is the whole group
-    # (head hi's queries are rows hi*groups .. hi*groups+groups-1).
+    # [B, Hkv, groups, D]: one program's query block is every KV head's
+    # whole group (head hi's queries are rows hi*groups .. +groups-1).
     qg = q.reshape(b, h_kv, groups, d)
 
-    def q_map(bi, hi, ki, lens):
+    def q_map(bi, ki, lens):
         del ki, lens
-        return (bi, hi, 0, 0)
+        return (bi, 0, 0, 0)
 
-    def kv_map(bi, hi, ki, lens):
+    def kv_map(bi, ki, lens):
         length = lens[bi]
         blk = jnp.minimum(_first_block(length, block_kv, window) + ki,
                           _last_block(length, block_kv))
-        return (bi, blk, hi, 0)
+        return (bi, blk, 0, 0)
 
-    def scale_map(bi, hi, ki, lens):
+    def scale_map(bi, ki, lens):
         if not quantized:
             return (0, 0, 0, 0)
-        return kv_map(bi, hi, ki, lens)
+        return kv_map(bi, ki, lens)
 
-    scale_block = ((1, block_kv, 1, 1) if quantized else (1, 1, 1, 1))
+    # K/V (and scale) blocks carry ALL KV heads: their last two block
+    # dims equal the array dims, which the Mosaic tiling rules accept
+    # for any (Hkv, D) — a (…, 1, D) per-head block would be rejected
+    # whenever Hkv > 1.
+    scale_block = ((1, block_kv, h_kv, 1) if quantized
+                   else (1, 1, 1, 1))
     kernel = functools.partial(
         _decode_kernel, scale=d ** -0.5, block_kv=block_kv,
-        window=window, quantized=quantized)
+        window=window, quantized=quantized, h_kv=h_kv)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(b, h_kv, num_blocks),
+        grid=(b, num_blocks),
         in_specs=[
-            pl.BlockSpec((1, 1, groups, d), q_map),
-            pl.BlockSpec((1, block_kv, 1, d), kv_map),
-            pl.BlockSpec((1, block_kv, 1, d), kv_map),
+            pl.BlockSpec((1, h_kv, groups, d), q_map),
+            pl.BlockSpec((1, block_kv, h_kv, d), kv_map),
+            pl.BlockSpec((1, block_kv, h_kv, d), kv_map),
             pl.BlockSpec(scale_block, scale_map),
             pl.BlockSpec(scale_block, scale_map),
         ],
-        out_specs=pl.BlockSpec((1, 1, groups, d), q_map),
+        out_specs=pl.BlockSpec((1, h_kv, groups, d), q_map),
         scratch_shapes=[
-            pltpu.VMEM((groups, d), jnp.float32),
-            pltpu.VMEM((groups, _LANES), jnp.float32),
-            pltpu.VMEM((groups, _LANES), jnp.float32),
+            pltpu.VMEM((h_kv, groups, d), jnp.float32),
+            pltpu.VMEM((h_kv, groups, _LANES), jnp.float32),
+            pltpu.VMEM((h_kv, groups, _LANES), jnp.float32),
         ],
     )
     out = pl.pallas_call(

@@ -109,7 +109,9 @@ def mla_decode_attention(q_eff: jax.Array, q_rope: jax.Array,
     if max_len % block_kv != 0:
         raise ValueError(f'max_len {max_len} % block_kv {block_kv} != 0')
     num_blocks = max_len // block_kv
-    lengths = lengths.astype(jnp.int32)
+    # Same clamp as decode_attention: lengths past the cache cap must
+    # not index an out-of-range KV block.
+    lengths = jnp.minimum(lengths.astype(jnp.int32), max_len)
 
     def q_map(bi, ki, lens):
         del ki, lens

@@ -413,3 +413,174 @@ def test_mistral_sliding_window_cached_decode():
     logits_win = llama_lib.forward(c, params,
                                    jnp.asarray([prompt], jnp.int32))
     assert float(jnp.abs(logits_full - logits_win).max()) > 1e-4
+
+
+# ---- chunked prefill / prefix cache / multi-step decode ----
+
+
+def _engine(model_cfg=None, **overrides):
+    model_cfg = model_cfg or llama.LLAMA_TINY
+    params = llama.init(model_cfg, jax.random.PRNGKey(0))
+    kwargs = dict(model=model_cfg, max_slots=4, max_target_len=64,
+                  prefill_buckets=(8, 16))
+    kwargs.update(overrides)
+    return engine_lib.InferenceEngine(
+        engine_lib.EngineConfig(**kwargs), params)
+
+
+def test_chunked_prefill_matches_full_forward():
+    """A prompt beyond the largest bucket prefills in chunks through
+    verify_forward; greedy decode afterwards must equal full-forward
+    greedy exactly (prefix rows identical, chunk masking correct)."""
+    engine = _engine()
+    assert engine.max_admit_len == 63
+    prompt = [(i * 13 + 5) % 256 for i in range(40)]   # 40 > bucket 16
+    n_new = 6
+    expected = _reference_greedy(engine.params, prompt, n_new)
+    outputs = orch_lib.Orchestrator(engine).generate(
+        [prompt], max_new_tokens=n_new)
+    assert outputs[0] == expected
+
+
+def test_chunked_prefill_multiple_exact_chunks():
+    """Prompt length an exact multiple of the chunk size (no padded
+    tail — the last-chunk logits row is the chunk's final row)."""
+    engine = _engine()
+    prompt = [(i * 7 + 1) % 256 for i in range(32)]    # 2 × bucket 16
+    expected = _reference_greedy(engine.params, prompt, 4)
+    outputs = orch_lib.Orchestrator(engine).generate(
+        [prompt], max_new_tokens=4)
+    assert outputs[0] == expected
+
+
+def test_prefix_cache_reuse_outputs_unchanged():
+    """Two prompts sharing a >=MIN_REUSE-token prefix: the second
+    reuses cached KV rows and must decode identically to cold."""
+    shared = [(i * 11 + 2) % 256 for i in range(18)]
+    p1 = shared + [7, 8]
+    p2 = shared + [9, 10, 11, 12]
+    cold = _engine()
+    expected1 = orch_lib.Orchestrator(cold).generate(
+        [p1], max_new_tokens=5)[0]
+    expected2 = orch_lib.Orchestrator(cold).generate(
+        [p2], max_new_tokens=5)[0]
+
+    warm = _engine(prefix_cache_entries=4)
+    orch = orch_lib.Orchestrator(warm)
+    assert orch.generate([p1], max_new_tokens=5)[0] == expected1
+    assert orch.generate([p2], max_new_tokens=5)[0] == expected2
+    stats = warm.prefix_cache_stats
+    assert stats['hits'] >= 1
+    assert stats['tokens_reused'] >= 16
+
+
+def test_prefix_cache_identical_prompt_hit():
+    """The same prompt twice: the rerun reuses all but the last token's
+    rows and still matches cold greedy output exactly."""
+    prompt = [(i * 3 + 1) % 256 for i in range(24)]
+    cold = _engine()
+    expected = orch_lib.Orchestrator(cold).generate(
+        [prompt], max_new_tokens=5)[0]
+    warm = _engine(prefix_cache_entries=2)
+    orch = orch_lib.Orchestrator(warm)
+    assert orch.generate([prompt], max_new_tokens=5)[0] == expected
+    assert orch.generate([prompt], max_new_tokens=5)[0] == expected
+    assert warm.prefix_cache_stats['hits'] == 1
+
+
+def test_prefix_cache_lru_eviction():
+    warm = _engine(prefix_cache_entries=1)
+    orch = orch_lib.Orchestrator(warm)
+    p1 = [1] * 20
+    p2 = [2] * 20
+    orch.generate([p1], max_new_tokens=2)
+    orch.generate([p2], max_new_tokens=2)   # evicts p1
+    assert warm.prefix_cache_stats['entries'] == 1
+    # p1 again: must miss (evicted), still decode correctly.
+    cold = _engine()
+    expected = orch_lib.Orchestrator(cold).generate(
+        [p1], max_new_tokens=3)[0]
+    assert orch.generate([p1], max_new_tokens=3)[0] == expected
+
+
+def test_prefix_cache_rejected_for_custom_layout():
+    from skypilot_tpu.models import deepseek
+    params = deepseek.init(deepseek.DEEPSEEK_TINY, jax.random.PRNGKey(0))
+    with pytest.raises(NotImplementedError):
+        engine_lib.InferenceEngine(
+            engine_lib.EngineConfig(model=deepseek.DEEPSEEK_TINY,
+                                    max_slots=2, max_target_len=32,
+                                    prefill_buckets=(16,),
+                                    prefix_cache_entries=2), params)
+
+
+def test_multi_step_decode_matches_single_step(tiny_engine):
+    """decode_steps=4 fuses steps on-device; outputs must be identical
+    to per-token decoding, including an EOS mid-batch and a budget that
+    is not a multiple of the fused step count."""
+    prompts = [[1, 2, 3], [7, 8, 9, 10, 11], [20, 21]]
+    n_new = 6   # not a multiple of 4
+    expected = [_reference_greedy(tiny_engine.params, p, n_new)
+                for p in prompts]
+    orch = orch_lib.Orchestrator(tiny_engine, decode_steps=4)
+    assert orch.generate(prompts, max_new_tokens=n_new) == expected
+    # EOS mid-fused-batch: stop exactly at the EOS position.
+    full = _reference_greedy(tiny_engine.params, [5, 17, 3], 10)
+    eos = full[4]
+    orch2 = orch_lib.Orchestrator(tiny_engine, decode_steps=4)
+    out = orch2.generate([[5, 17, 3]], max_new_tokens=10,
+                         eos_token_id=eos)
+    assert out[0] == full[:4]
+    assert len(orch2._free_slots) == tiny_engine.config.max_slots
+
+
+def test_multi_step_decode_near_kv_budget():
+    """Fused steps past a slot's KV budget: the extra scan steps write
+    at clamped positions after the last kept token — they must not
+    change any kept output vs per-token decoding. (Compared against the
+    single-step path, not the full-forward reference: at this tiny
+    max_target_len the kernel-vs-XLA bf16 rounding difference flips a
+    near-tied argmax in the random-weight model, which is a numerics
+    artifact, not a cache-corruption signal.)"""
+    prompt = [3, 1, 4, 1, 5]
+    single = orch_lib.Orchestrator(
+        _engine(max_target_len=16, prefill_buckets=(8,))).generate(
+            [prompt], max_new_tokens=50)                # clamped to 11
+    fused = orch_lib.Orchestrator(
+        _engine(max_target_len=16, prefill_buckets=(8,)),
+        decode_steps=4).generate([prompt], max_new_tokens=50)
+    assert fused == single
+    assert len(single[0]) == 11
+
+
+def test_fused_decode_lengths_capped_at_kv_budget():
+    """Slot lengths must never exceed max_target_len even when fused
+    steps run past a finished request (the decode kernels' block
+    index_maps would otherwise read out-of-range blocks on TPU)."""
+    engine = _engine(max_target_len=16, prefill_buckets=(8,))
+    orch = orch_lib.Orchestrator(engine, decode_steps=4)
+    orch.generate([[3, 1, 4, 1, 5]], max_new_tokens=50)
+    lengths = np.asarray(jax.device_get(orch.state['lengths']))
+    assert (lengths <= engine.config.max_target_len).all()
+
+
+def test_speculative_long_prompt_chunk_prefills_draft(monkeypatch):
+    """A prompt beyond the largest bucket must chunk-prefill BOTH the
+    target and the draft (a bucketed draft prefill would raise with the
+    slot already claimed), and still equal plain greedy decoding.
+    Both runs are pinned to the XLA attend: speculation decodes through
+    verify_forward's masked path while plain decode uses the Pallas
+    kernel, and their bf16 rounding difference flips a near-tied argmax
+    on the random-weight tiny model (numerics, not a logic bug)."""
+    monkeypatch.setenv('XSKY_DECODE_ATTN', 'xla')
+    model = llama.LLAMA_TINY
+    params = llama.init(model, jax.random.PRNGKey(0))
+    mk = lambda: engine_lib.InferenceEngine(
+        engine_lib.EngineConfig(model=model, max_slots=2,
+                                max_target_len=64,
+                                prefill_buckets=(8, 16)), params)
+    prompt = [(i * 5 + 3) % 256 for i in range(40)]    # 40 > bucket 16
+    expected = orch_lib.Orchestrator(mk()).generate(
+        [prompt], max_new_tokens=6)
+    spec = orch_lib.SpeculativeOrchestrator(mk(), mk(), gamma=3)
+    assert spec.generate([prompt], max_new_tokens=6) == expected
