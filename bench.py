@@ -37,7 +37,7 @@ _DEVICES_OK_SENTINEL = '#DEVICES_OK'
 # Upper bound on serve_main's ladder length (supervisor spawns one
 # child per rung; a child whose ladder is shorter exits with
 # _LADDER_EXHAUSTED_RC and the supervisor stops descending).
-_SERVE_LADDER_LEN = 4
+_SERVE_LADDER_LEN = 6
 _LADDER_EXHAUSTED_RC = 3
 
 
@@ -136,7 +136,15 @@ def serve_main() -> None:
     else:
         ladder = [
             ('llama3-8b-int8', llama.LLAMA3_8B, 16, 2048, 32, 512, 128,
-             (512,), True),
+             (512,), 'int8'),
+            # int4 weights (~4.5 GB): the true-8B rung for chips whose
+            # usable HBM is below the int8 tree + cache (~11 GB).
+            ('llama3-8b-int4', llama.LLAMA3_8B, 16, 2048, 32, 512, 128,
+             (512,), 'int4'),
+            # With fused decode dispatches, batch (slots) is the
+            # throughput lever: 32 slots ≈ 2.1 GB of 1B-model cache.
+            ('llama3-1b-bf16-b32', llama.LLAMA3_1B, 32, 2048, 96, 512,
+             128, (512,), False),
             ('llama3-1b-bf16', llama.LLAMA3_1B, 16, 2048, 64, 512, 128,
              (512,), False),
             # Degraded rungs: a serve number from a memory-constrained
@@ -174,25 +182,29 @@ def serve_main() -> None:
 
     last_err = None
     for (model_tag, model, slots, max_len, n_req, prompt_len, new_tok,
-         buckets, int8) in ladder:
+         buckets, quant) in ladder:
         import jax.numpy as jnp
         print(f'# serve rung {model_tag}: {_hbm_note()}', flush=True)
         try:
-            if int8:
+            if quant:
                 # Weights are random either way (throughput bench);
-                # sampling them straight as int8 avoids materializing
-                # the 16 GB bf16 tree the chip cannot hold.
+                # sampling them straight in quantized form avoids
+                # materializing the 16 GB bf16 tree the chip cannot
+                # hold.
                 import functools
                 from skypilot_tpu.ops import quantization as qops
                 shapes = jax.eval_shape(
                     functools.partial(llama.init, model),
                     jax.random.PRNGKey(0))
-                params = qops.synthetic_quantized_params(
-                    shapes, jax.random.PRNGKey(0))
+                synth = (qops.synthetic_quantized4_params
+                         if quant == 'int4'
+                         else qops.synthetic_quantized_params)
+                params = synth(shapes, jax.random.PRNGKey(0))
                 config = engine_lib.EngineConfig(
                     model=model, max_slots=slots, max_target_len=max_len,
                     prefill_buckets=buckets, kv_dtype=jnp.int8,
-                    weight_dtype=jnp.int8)
+                    weight_dtype=('int4' if quant == 'int4'
+                                  else jnp.int8))
             else:
                 params = llama.init(model, jax.random.PRNGKey(0))
                 config = engine_lib.EngineConfig(

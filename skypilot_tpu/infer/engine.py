@@ -41,10 +41,13 @@ class EngineConfig:
     # bf16, or jnp.int8 for a quantized cache (half the HBM: per-head
     # symmetric scales, dequant fused into the attention reads).
     kv_dtype: Any = jnp.bfloat16
-    # bf16, or jnp.int8 for weight-only quantization (per-output-channel
-    # scales, dequant fused into each matmul's epilogue): halves weight
-    # HBM — decode is bandwidth-bound, and an 8B model fits one 16 GB
-    # chip at int8. See ops/quantization.py.
+    # bf16; jnp.int8 for weight-only quantization (per-output-channel
+    # scales, dequant fused into each matmul's epilogue); or the string
+    # 'int4' (packed nibbles, AWQ-style group-128 scales, dequant fused
+    # into the operand read). Decode is bandwidth-bound, so fewer
+    # weight bytes is a direct step-time win: an 8B fits one 16 GB chip
+    # at int8 (~8.5 GB) and a partial-HBM chip at int4 (~4.5 GB).
+    # See ops/quantization.py.
     weight_dtype: Any = jnp.bfloat16
     # > 0 enables the host-side LRU of device-resident KV prefixes
     # (vLLM automatic-prefix-caching twin): requests sharing a prompt
@@ -107,9 +110,21 @@ class PrefixCache:
         best_len, best_key = 0, None
         for key, (_, klen) in self._entries.items():
             cap = min(klen, len(pt) - 1)
-            lcp = 0
-            while lcp < cap and key[lcp] == pt[lcp]:
-                lcp += 1
+            # Longest common prefix by bisection on C-speed slice
+            # compares (this runs on the admission hot path; a
+            # per-token Python loop over 2k-token prompts would cost
+            # tens of thousands of interpreted ops per admit).
+            if key[:cap] == pt[:cap]:
+                lcp = cap
+            else:
+                lo, hi = 0, cap - 1
+                while lo < hi:
+                    mid = (lo + hi + 1) // 2
+                    if key[:mid] == pt[:mid]:
+                        lo = mid
+                    else:
+                        hi = mid - 1
+                lcp = lo
             if lcp > best_len:
                 best_len, best_key = lcp, key
         if best_len < self.MIN_REUSE:
@@ -125,6 +140,8 @@ class PrefixCache:
                           'v': kv['v'][:, :, :best_len]}
 
     def store(self, prompt_tokens, kv, true_len: int) -> None:
+        if true_len < self.MIN_REUSE:
+            return   # lookup() could never reuse it: dead entry
         pt = tuple(prompt_tokens)
         if pt in self._entries:
             self._entries.move_to_end(pt)
@@ -167,6 +184,9 @@ class InferenceEngine:
         if config.weight_dtype == jnp.int8:
             from skypilot_tpu.ops import quantization as qops
             params = qops.quantize_params(params)
+        elif config.weight_dtype == 'int4':
+            from skypilot_tpu.ops import quantization as qops
+            params = qops.quantize_params_int4(params)
         self.params = params
         self.mesh = mesh
         self._key = jax.random.PRNGKey(0)

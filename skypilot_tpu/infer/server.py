@@ -335,10 +335,12 @@ def main() -> int:
                         choices=['bf16', 'int8'],
                         help='int8 halves KV-cache HBM (per-head scales)')
     parser.add_argument('--weight-dtype', default='bf16',
-                        choices=['bf16', 'int8'],
+                        choices=['bf16', 'int8', 'int4'],
                         help='int8 halves weight HBM (per-channel '
-                             'scales, dequant fused into each matmul); '
-                             'fits 8B on one 16 GB chip')
+                             'scales, dequant fused into each matmul; '
+                             'fits 8B on one 16 GB chip); int4 halves '
+                             'it again (packed nibbles, group-128 '
+                             'scales)')
     parser.add_argument('--mesh', default=None,
                         help="e.g. 'tensor=4' to shard across chips")
     parser.add_argument('--tokenizer', default='byte',
@@ -378,8 +380,8 @@ def main() -> int:
         model=model, max_slots=args.max_slots,
         max_target_len=args.max_target_len,
         kv_dtype=jnp.int8 if args.kv_dtype == 'int8' else jnp.bfloat16,
-        weight_dtype=(jnp.int8 if args.weight_dtype == 'int8'
-                      else jnp.bfloat16),
+        weight_dtype={'int8': jnp.int8, 'int4': 'int4',
+                      'bf16': jnp.bfloat16}[args.weight_dtype],
         prefix_cache_entries=prefix_entries)
     mesh = None
     if args.mesh:
@@ -389,17 +391,19 @@ def main() -> int:
     logger.info(f'Initializing {args.model} on '
                 f'{jax.devices()[0].device_kind} x{jax.device_count()}')
     model_lib = models.module_for(model)
-    if args.weight_dtype == 'int8':
-        # Init + quantize on HOST: the whole point of int8 weights is
-        # serving a model whose bf16 tree does not fit the chip (8B =
-        # 16 GB bf16 on a 16 GB chip), so the bf16 init must never
-        # touch device HBM. Only the int8 tree is shipped over.
+    if args.weight_dtype in ('int8', 'int4'):
+        # Init + quantize on HOST: the whole point of quantized weights
+        # is serving a model whose bf16 tree does not fit the chip (8B
+        # = 16 GB bf16 on a 16 GB chip), so the bf16 init must never
+        # touch device HBM. Only the quantized tree is shipped over.
         from jax.sharding import NamedSharding, PartitionSpec
         from skypilot_tpu.ops import quantization as qops
         cpu = jax.local_devices(backend='cpu')[0]
         with jax.default_device(cpu):
             params = model_lib.init(model, jax.random.PRNGKey(0))
-            params = qops.quantize_params(params)
+            params = (qops.quantize_params(params)
+                      if args.weight_dtype == 'int8'
+                      else qops.quantize_params_int4(params))
         target = (NamedSharding(mesh, PartitionSpec())
                   if mesh is not None else jax.devices()[0])
         params = jax.device_put(params, target)
@@ -420,6 +424,10 @@ def main() -> int:
             engine, draft_engine, gamma=args.spec_gamma)
         logger.info(f'Speculative decoding: draft={args.draft_model} '
                     f'gamma={args.spec_gamma}')
+        if args.decode_steps != 1:
+            logger.warning('--decode-steps is ignored with '
+                           '--draft-model: speculation already '
+                           'amortizes dispatch per round (γ+1 tokens).')
     else:
         orch = orch_lib.Orchestrator(engine,
                                      decode_steps=args.decode_steps)

@@ -70,6 +70,112 @@ class QuantizedTensor:
         return self.q.nbytes + self.scale.nbytes
 
 
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class Quantized4Tensor:
+    """Packed int4 values + group-wise fp32 scales.
+
+    Two signed 4-bit values per byte along the CONTRACTION axis (so
+    unpack happens where the consumer contracts): `q_packed` has that
+    axis halved. `scale` has the contraction axis replaced by the
+    group count G = in/group — int4's 3-bit mantissa needs finer than
+    per-channel scaling to stay useful, and group-wise (AWQ-style) is
+    the standard accuracy/size point. Scales vary ALONG the
+    contraction, so dequant happens on the matmul operand (XLA fuses
+    the unpack+scale into the dot's operand read — weight HBM traffic
+    stays int4) rather than in the epilogue like int8.
+    """
+    q_packed: jax.Array
+    scale: jax.Array
+    axis: int = -2
+    group: int = 128
+
+    def tree_flatten(self):
+        return (self.q_packed, self.scale), (self.axis, self.group)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        q_packed, scale = children
+        return cls(q_packed, scale, aux[0], aux[1])
+
+    @property
+    def shape(self):
+        """LOGICAL shape (unpacked)."""
+        s = list(self.q_packed.shape)
+        s[self.axis] *= 2
+        return tuple(s)
+
+    @property
+    def ndim(self) -> int:
+        return self.q_packed.ndim
+
+    @property
+    def nbytes(self) -> int:
+        return self.q_packed.nbytes + self.scale.nbytes
+
+
+def _pack4(q: jax.Array, axis: int) -> jax.Array:
+    """int8 values in [-8, 7] → packed bytes; `axis` (negative) halves.
+
+    Byte b at pair index p holds (q[2p] & 0xF) | (q[2p+1] << 4)."""
+    ax = q.ndim + axis
+    pairs = q.reshape(q.shape[:ax] + (q.shape[ax] // 2, 2) +
+                      q.shape[ax + 1:])
+    lo = jax.lax.index_in_dim(pairs, 0, ax + 1, keepdims=False)
+    hi = jax.lax.index_in_dim(pairs, 1, ax + 1, keepdims=False)
+    return ((hi.astype(jnp.uint8) << 4) |
+            (lo.astype(jnp.uint8) & 0xF)).astype(jnp.int8)
+
+
+def _unpack4(packed: jax.Array, axis: int) -> jax.Array:
+    """Packed bytes → int8 values in [-8, 7]; `axis` (negative)
+    doubles. Arithmetic shifts recover the signed nibbles."""
+    ax = packed.ndim + axis
+    u = packed.astype(jnp.int8)
+    lo = jax.lax.shift_right_arithmetic(
+        jax.lax.shift_left(u, jnp.int8(4)), jnp.int8(4))
+    hi = jax.lax.shift_right_arithmetic(u, jnp.int8(4))
+    pair = jnp.stack([lo, hi], axis=ax + 1)   # [..., dim/2, 2, ...]
+    return pair.reshape(packed.shape[:ax] + (packed.shape[ax] * 2,) +
+                        packed.shape[ax + 1:])
+
+
+def quantize4(w: jax.Array, axis: int = -2,
+              group: int = 128) -> Quantized4Tensor:
+    """Symmetric group-wise int4 over the contraction `axis`.
+
+    Groups of `group` consecutive contraction rows share one fp32
+    scale (amax/7). Falls back to one group when the axis is shorter
+    than `group`; the axis length must be even (packing) and divisible
+    by the effective group size.
+    """
+    if axis >= 0:
+        axis = axis - w.ndim
+    dim = w.shape[axis]
+    group = min(group, dim)
+    if dim % 2 or dim % group or group % 2:
+        raise ValueError(f'int4 needs even, group-divisible contraction '
+                         f'(dim={dim}, group={group})')
+    ax = w.ndim + axis
+    grouped = w.astype(jnp.float32).reshape(
+        w.shape[:ax] + (dim // group, group) + w.shape[ax + 1:])
+    amax = jnp.max(jnp.abs(grouped), axis=ax + 1)        # [..., G, ...]
+    scale = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(grouped / jnp.expand_dims(scale, ax + 1)),
+                 -8, 7).astype(jnp.int8).reshape(w.shape)
+    return Quantized4Tensor(_pack4(q, axis), scale, axis, group)
+
+
+def dequantize4(w: Quantized4Tensor, dtype=jnp.bfloat16) -> jax.Array:
+    q = _unpack4(w.q_packed, w.axis)
+    ax = q.ndim + w.axis
+    dim = q.shape[ax]
+    grouped = q.astype(jnp.float32).reshape(
+        q.shape[:ax] + (dim // w.group, w.group) + q.shape[ax + 1:])
+    out = grouped * jnp.expand_dims(w.scale, ax + 1)
+    return out.reshape(q.shape).astype(dtype)
+
+
 def quantize(w: jax.Array, axis: int = -2) -> QuantizedTensor:
     """Symmetric per-output-channel int8 over the contraction `axis`."""
     if axis >= 0:
@@ -87,15 +193,21 @@ def dequantize(w: QuantizedTensor, dtype=jnp.bfloat16) -> jax.Array:
 
 
 def matmul(x: jax.Array, w, preferred_element_type=None) -> jax.Array:
-    """`x @ w` for `w` either a plain `[.., in, out]` array or a
-    QuantizedTensor with contraction at -2; dequant fuses into the
-    matmul epilogue."""
+    """`x @ w` for `w` a plain `[.., in, out]` array, a QuantizedTensor
+    (dequant fused into the matmul epilogue), or a Quantized4Tensor
+    (group scales vary along the contraction, so dequant fuses into the
+    operand read instead — HBM still only carries the packed nibbles)."""
     if isinstance(w, QuantizedTensor):
         assert w.axis == -2, (
             f'matmul needs contraction at -2, got {w.axis}')
         out = jnp.matmul(x, w.q.astype(x.dtype),
                          preferred_element_type=preferred_element_type)
         return out * w.scale.astype(out.dtype)
+    if isinstance(w, Quantized4Tensor):
+        assert w.axis == -2, (
+            f'matmul needs contraction at -2, got {w.axis}')
+        return jnp.matmul(x, dequantize4(w, x.dtype),
+                          preferred_element_type=preferred_element_type)
     return jnp.matmul(x, w, preferred_element_type=preferred_element_type)
 
 
@@ -133,6 +245,10 @@ def expert_einsum(spec: str, x: jax.Array, w,
         out = jnp.einsum(spec, x, w.q.astype(x.dtype),
                          preferred_element_type=preferred_element_type)
         return out * w.scale[:, None, :].astype(out.dtype)
+    if isinstance(w, Quantized4Tensor):
+        assert w.axis == -2
+        return jnp.einsum(spec, x, dequantize4(w, x.dtype),
+                          preferred_element_type=preferred_element_type)
     return jnp.einsum(spec, x, w,
                       preferred_element_type=preferred_element_type)
 
@@ -174,6 +290,88 @@ def quantize_params(params: Params) -> Params:
         return node
 
     return walk(params)
+
+
+def quantize_params_int4(params: Params, group: int = 128) -> Params:
+    """int4 (group-scaled) for the dense matmul weights, int8 for the
+    rest of the known weight set (the embedding's per-row gather and
+    any contraction that cannot pack evenly). Idempotent like
+    quantize_params."""
+
+    def walk(node):
+        if isinstance(node, dict):
+            out = {}
+            for key, value in node.items():
+                if isinstance(value, dict):
+                    out[key] = walk(value)
+                elif isinstance(value, (QuantizedTensor,
+                                        Quantized4Tensor)):
+                    out[key] = value
+                elif key in _QUANT_AXES and value.ndim >= 2:
+                    axis = _QUANT_AXES[key]
+                    if axis == -2:
+                        try:
+                            out[key] = quantize4(value, axis, group)
+                            continue
+                        except ValueError:
+                            pass   # odd/indivisible contraction
+                    out[key] = quantize(value, axis)
+                else:
+                    out[key] = value
+            return out
+        return node
+
+    return walk(params)
+
+
+def synthetic_quantized4_params(shapes: Params, key: jax.Array,
+                                group: int = 128) -> Params:
+    """synthetic_quantized_params at int4: packed nibbles are sampled
+    directly (no full-precision or even int8 tree ever materializes) —
+    an 8B lands at ~4.5 GB, inside even a partial-HBM chip."""
+
+    def walk(node, key):
+        if isinstance(node, dict):
+            out = {}
+            for name, value in sorted(node.items()):
+                key, sub = jax.random.split(key)
+                if isinstance(value, dict):
+                    out[name] = walk(value, sub)
+                elif (name in _QUANT_AXES and value.ndim >= 2
+                        and _QUANT_AXES[name] == -2
+                        and value.shape[-2] % 2 == 0
+                        and value.shape[-2] % min(group,
+                                                  value.shape[-2]) == 0):
+                    fan_in = value.shape[-2]
+                    g = min(group, fan_in)
+                    packed_shape = value.shape[:-2] + (fan_in // 2,
+                                                       value.shape[-1])
+                    q = jax.lax.bitcast_convert_type(
+                        jax.random.bits(sub, packed_shape, jnp.uint8),
+                        jnp.int8)
+                    scale_shape = value.shape[:-2] + (fan_in // g,
+                                                      value.shape[-1])
+                    scale = jnp.full(scale_shape,
+                                     (fan_in ** -0.5) / 7.0, jnp.float32)
+                    out[name] = Quantized4Tensor(q, scale, -2, g)
+                elif name in _QUANT_AXES and value.ndim >= 2:
+                    axis = _QUANT_AXES[name]
+                    q = jax.lax.bitcast_convert_type(
+                        jax.random.bits(sub, value.shape, jnp.uint8),
+                        jnp.int8)
+                    fan_in = value.shape[axis]
+                    scale_shape = list(value.shape)
+                    del scale_shape[axis % value.ndim]
+                    scale = jnp.full(scale_shape,
+                                     (fan_in ** -0.5) / 127.0,
+                                     jnp.float32)
+                    out[name] = QuantizedTensor(q, scale, axis)
+                else:
+                    out[name] = jnp.ones(value.shape, value.dtype)
+            return out
+        return node
+
+    return walk(shapes, key)
 
 
 def params_nbytes(params: Params) -> int:

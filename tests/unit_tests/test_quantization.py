@@ -167,3 +167,110 @@ def test_engine_int8_weights_decode_parity():
     # step; on real checkpoints the margin is far larger.
     agree = sum(a == b for a, b in zip(bf16, int8))
     assert agree >= len(bf16) - 1, (bf16, int8)
+
+
+class TestInt4:
+    """Packed-nibble int4 with group-wise scales (Quantized4Tensor)."""
+
+    def test_pack_unpack_roundtrip(self):
+        q = jax.random.randint(jax.random.PRNGKey(0), (8, 64, 32),
+                               -8, 8, jnp.int8)
+        packed = qops._pack4(q, -2)
+        assert packed.shape == (8, 32, 32)
+        back = qops._unpack4(packed, -2)
+        assert bool(jnp.all(back == q))
+
+    def test_quantize4_error_bound(self):
+        w = jax.random.normal(jax.random.PRNGKey(1), (256, 32),
+                              jnp.float32)
+        qt = qops.quantize4(w, group=128)
+        assert qt.q_packed.shape == (128, 32)
+        assert qt.scale.shape == (2, 32)
+        back = qops.dequantize4(qt, jnp.float32)
+        # Symmetric int4: error ≤ scale/2 per group (+1 LSB for the
+        # clip at -8).
+        err = jnp.abs(back - w)
+        bound = jnp.repeat(qt.scale, 128, axis=0)
+        assert bool(jnp.all(err <= bound * 0.75 + 1e-6))
+
+    def test_matmul_parity(self):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(2))
+        x = jax.random.normal(k1, (4, 256), jnp.float32)
+        w = jax.random.normal(k2, (256, 64), jnp.float32)
+        out_q = qops.matmul(x, qops.quantize4(w))
+        out_ref = x @ w
+        # int4 carries ~16x the int8 step size; the bound is loose but
+        # excludes layout/sign bugs (those produce O(1) errors).
+        rel = float(jnp.max(jnp.abs(out_q - out_ref)) /
+                    jnp.max(jnp.abs(out_ref)))
+        assert rel < 0.15, rel
+
+    def test_scan_slices_stay_paired(self):
+        """Stacked [L, in, out] weights under lax.scan: q_packed and
+        scale must slice together (pytree registration)."""
+        w = jax.random.normal(jax.random.PRNGKey(3), (3, 256, 16),
+                              jnp.float32)
+        qt = qops.quantize4(w)
+
+        def body(carry, layer_qt):
+            return carry, qops.matmul(carry, layer_qt)
+
+        x = jax.random.normal(jax.random.PRNGKey(4), (2, 256),
+                              jnp.float32)
+        _, outs = jax.lax.scan(body, x, qt)
+        refs = jnp.stack([x @ qops.dequantize4(
+            qops.quantize4(w[i]), jnp.float32) for i in range(3)])
+        assert bool(jnp.allclose(outs, refs, atol=1e-4))
+
+    def test_quantize_params_int4_mixed_tree(self):
+        from skypilot_tpu.models import llama
+        params = llama.init(llama.LLAMA_TINY, jax.random.PRNGKey(0))
+        q4 = qops.quantize_params_int4(params)
+        # Dense matmul weights → int4; embedding stays int8 (per-row
+        # gather); norms untouched.
+        assert isinstance(q4['layers']['wq'], qops.Quantized4Tensor)
+        assert isinstance(q4['lm_head'], qops.Quantized4Tensor)
+        assert isinstance(q4['embed'], qops.QuantizedTensor)
+        assert q4['final_norm'].dtype == params['final_norm'].dtype
+        # Idempotent.
+        again = qops.quantize_params_int4(q4)
+        assert again['layers']['wq'] is q4['layers']['wq']
+        # ~half the int8 bytes for the int4-eligible leaves.
+        int8_tree = qops.quantize_params(params)
+        assert (qops.params_nbytes(q4) <
+                0.75 * qops.params_nbytes(int8_tree))
+
+    def test_engine_int4_weights_decode(self):
+        from skypilot_tpu.infer import engine as engine_lib
+        from skypilot_tpu.infer import orchestrator as orch_lib
+        from skypilot_tpu.models import llama
+        params = llama.init(llama.LLAMA_TINY, jax.random.PRNGKey(0))
+        config = engine_lib.EngineConfig(
+            model=llama.LLAMA_TINY, max_slots=2, max_target_len=32,
+            prefill_buckets=(16,), weight_dtype='int4')
+        engine = engine_lib.InferenceEngine(config, params)
+        out = orch_lib.Orchestrator(engine).generate(
+            [[3, 1, 4, 1, 5]], max_new_tokens=6)
+        assert len(out[0]) == 6
+        assert all(0 <= t < llama.LLAMA_TINY.vocab_size for t in out[0])
+
+    def test_synthetic_quantized4_params_serve(self):
+        import functools
+        from skypilot_tpu.infer import engine as engine_lib
+        from skypilot_tpu.infer import orchestrator as orch_lib
+        from skypilot_tpu.models import llama
+        shapes = jax.eval_shape(
+            functools.partial(llama.init, llama.LLAMA_TINY),
+            jax.random.PRNGKey(0))
+        params = qops.synthetic_quantized4_params(
+            shapes, jax.random.PRNGKey(0))
+        assert isinstance(params['layers']['w_up'],
+                          qops.Quantized4Tensor)
+        config = engine_lib.EngineConfig(
+            model=llama.LLAMA_TINY, max_slots=2, max_target_len=32,
+            prefill_buckets=(16,), weight_dtype='int4',
+            kv_dtype=jnp.int8)
+        engine = engine_lib.InferenceEngine(config, params)
+        out = orch_lib.Orchestrator(engine).generate(
+            [[1, 2, 3]], max_new_tokens=4)
+        assert len(out[0]) == 4
