@@ -51,6 +51,8 @@ class GemmaConfig:
     remat: bool = True
     remat_policy: str = 'dots'
     attention_impl: str = 'auto'
+    # Packed-sequence training (see llama.LlamaConfig.packing_reset_eos).
+    packing_reset_eos: Optional[int] = None
 
     def num_params(self) -> int:
         d, f, v = self.d_model, self.d_ff, self.vocab_size
@@ -144,7 +146,8 @@ def _rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
 def _layer(config: GemmaConfig, mesh: Optional[mesh_lib.Mesh],
            x: jax.Array, lp: Params, positions: jax.Array,
            kv_cache=None, cache_positions: Optional[jax.Array] = None,
-           return_kv: bool = False):
+           return_kv: bool = False,
+           segment_ids: Optional[jax.Array] = None):
     """One block. Returns x (training) or (x, new_kv) when the caller
     asked for cache handling (prefill/decode; same slot contract as
     llama._layer)."""
@@ -175,7 +178,8 @@ def _layer(config: GemmaConfig, mesh: Optional[mesh_lib.Mesh],
         if return_kv:
             new_cache = (k, v)
         attn = attention_ops.dot_product_attention(
-            q, k, v, causal=True, implementation=c.attention_impl)
+            q, k, v, causal=True, implementation=c.attention_impl,
+            segment_ids=segment_ids)
     attn = attn.reshape(b, s, c.n_heads * hd)
     x = x + shard(qops.matmul(attn, lp['wo']),
                   ('batch', 'activation_length', 'activation_embed'))
@@ -200,9 +204,10 @@ def _trunk(config: GemmaConfig, params: Params, tokens: jax.Array,
     forward (training) and prefill_hidden (serving) so both get the
     same activation sharding. Returns (x [B,S,D], kv-or-None)."""
     c = config
+    segment_ids = None
     if positions is None:
-        positions = jnp.broadcast_to(
-            jnp.arange(tokens.shape[1])[None, :], tokens.shape)
+        segment_ids, positions = llama.positions_and_segments(
+            c, tokens, serving=return_kv)
     x = llama._embed_lookup(params['embed'], tokens, mesh).astype(c.dtype)
     x = x * jnp.asarray(c.d_model ** 0.5, c.dtype)  # Gemma input scaling
     if mesh is not None:
@@ -213,7 +218,8 @@ def _trunk(config: GemmaConfig, params: Params, tokens: jax.Array,
         if return_kv:
             x, kv = _layer(c, mesh, x, lp, positions, return_kv=True)
             return x, {'k': kv[0], 'v': kv[1]}
-        return _layer(c, mesh, x, lp, positions), None
+        return _layer(c, mesh, x, lp, positions,
+                      segment_ids=segment_ids), None
 
     if c.remat and not return_kv:
         layer_fn = jax.checkpoint(layer_fn,

@@ -61,6 +61,14 @@ class LlamaConfig:
     # Mistral-style sliding-window attention: each token attends to at
     # most this many recent positions. None = full causal attention.
     sliding_window: Optional[int] = None
+    # Packed-sequence training: when set to the corpus EOS token id,
+    # the training loss derives segment ids from EOS positions inside
+    # the jitted step — attention is blocked across document
+    # boundaries AND RoPE positions restart at each boundary, so
+    # concatenated-document batches train as if each document were
+    # alone in the sequence. None = classic GPT-style packing
+    # (cross-document attention allowed).
+    packing_reset_eos: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -310,6 +318,41 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def segments_from_eos(tokens: jax.Array, eos: int
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Derive (segment_ids, positions) [B, S] from EOS boundaries.
+
+    A new segment starts at index 0 and right after every EOS token
+    (the EOS itself closes its document). Positions restart at 0 per
+    segment (RoPE sees per-document offsets). All cumulative ops — a
+    prefix sum and a prefix max — lower to O(log S) XLA scans; nothing
+    here is data-dependent control flow.
+    """
+    is_start = jnp.concatenate(
+        [jnp.ones_like(tokens[:, :1], jnp.bool_),
+         tokens[:, :-1] == eos], axis=1)
+    segment_ids = jnp.cumsum(is_start.astype(jnp.int32), axis=1)
+    idx = jnp.broadcast_to(jnp.arange(tokens.shape[1])[None, :],
+                           tokens.shape)
+    seg_start = jax.lax.associative_scan(
+        jax.numpy.maximum, jnp.where(is_start, idx, 0), axis=1)
+    return segment_ids, idx - seg_start
+
+
+def positions_and_segments(config, tokens: jax.Array, serving: bool
+                           ) -> Tuple[Optional[jax.Array], jax.Array]:
+    """Default (segment_ids, positions) for a trunk given no explicit
+    positions. Training trunks with `packing_reset_eos` set get
+    EOS-derived document segments + per-document positions; serving
+    trunks (one document per slot) and unpacked training get plain
+    arange and no segments. One helper shared by all four families —
+    per-family copies of this branch were already drifting."""
+    if config.packing_reset_eos is not None and not serving:
+        return segments_from_eos(tokens, config.packing_reset_eos)
+    return None, jnp.broadcast_to(
+        jnp.arange(tokens.shape[1])[None, :], tokens.shape)
+
+
 def quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Per-(position, head) int8 symmetric quantization over head_dim.
 
@@ -450,7 +493,8 @@ def _layer(config: LlamaConfig, mesh: Optional[mesh_lib.Mesh],
            kv_cache: Optional[Tuple[jax.Array, jax.Array]] = None,
            cache_index: Optional[jax.Array] = None,
            cache_positions: Optional[jax.Array] = None,
-           return_kv: bool = False):
+           return_kv: bool = False,
+           segment_ids: Optional[jax.Array] = None):
     """One transformer block. Returns (x, new_kv_cache).
 
     Decode: with kv_cache set, the new K/V (s==1) is written either at a
@@ -491,6 +535,11 @@ def _layer(config: LlamaConfig, mesh: Optional[mesh_lib.Mesh],
                 'sliding_window is not implemented for ring/ulysses '
                 'context parallelism (a windowed model rarely needs '
                 'sequence sharding: its attention is already O(S·W)).')
+        if segment_ids is not None:
+            raise NotImplementedError(
+                'packing_reset_eos is not implemented for ring/ulysses '
+                'context parallelism (segment masks would have to ride '
+                'the K/V ring).')
         from skypilot_tpu.ops import ring_attention as ring_ops
         new_cache = (k, v) if return_kv else None
         attn = ring_ops.sequence_parallel_attention(
@@ -499,7 +548,7 @@ def _layer(config: LlamaConfig, mesh: Optional[mesh_lib.Mesh],
         new_cache = (k, v) if return_kv else None
         attn = attention_ops.dot_product_attention(
             q, k, v, causal=True, implementation=c.attention_impl,
-            window=c.sliding_window)
+            window=c.sliding_window, segment_ids=segment_ids)
 
     attn = attn.reshape(b, s, c.n_heads * hd)
     x = x + shard(_ckpt_name(qops.matmul(attn, layer_params['wo']),
@@ -524,19 +573,21 @@ def _trunk(config: LlamaConfig,
            tokens: jax.Array,
            positions: Optional[jax.Array],
            mesh: Optional[mesh_lib.Mesh],
-           return_kv: bool):
+           return_kv: bool,
+           segment_ids: Optional[jax.Array] = None):
     """Embed → scanned layers → final RMSNorm. Returns (x [B,S,D], kv)."""
     c = config
     if positions is None:
-        positions = jnp.broadcast_to(
-            jnp.arange(tokens.shape[1])[None, :], tokens.shape)
+        segment_ids, positions = positions_and_segments(
+            c, tokens, serving=return_kv)
     x = _embed_lookup(params['embed'], tokens, mesh).astype(c.dtype)
     if mesh is not None:
         x = mesh_lib.shard_logical(
             x, mesh, ('batch', 'activation_length', 'activation_embed'))
 
     def layer_fn(x, lp):
-        x, kv = _layer(c, mesh, x, lp, positions, return_kv=return_kv)
+        x, kv = _layer(c, mesh, x, lp, positions, return_kv=return_kv,
+                       segment_ids=segment_ids)
         return x, ({'k': kv[0], 'v': kv[1]} if return_kv else None)
 
     if c.remat and not return_kv:

@@ -252,7 +252,8 @@ def _layer(config: MoEConfig, mesh: Optional[mesh_lib.Mesh], x: jax.Array,
            lp: Params, positions: jax.Array,
            token_mask: Optional[jax.Array] = None,
            kv_cache=None, cache_positions: Optional[jax.Array] = None,
-           return_kv: bool = False):
+           return_kv: bool = False,
+           segment_ids: Optional[jax.Array] = None):
     """One Mixtral block: Llama attention + routed MoE MLP.
 
     Returns (x, aux, new_kv). With kv_cache set this is a decode step
@@ -291,7 +292,8 @@ def _layer(config: MoEConfig, mesh: Optional[mesh_lib.Mesh], x: jax.Array,
         if return_kv:
             new_cache = (k, v)
         attn = attention_ops.dot_product_attention(
-            q, k, v, causal=True, implementation=c.attention_impl)
+            q, k, v, causal=True, implementation=c.attention_impl,
+            segment_ids=segment_ids)
     attn = attn.reshape(b, s, c.n_heads * hd)
     x = x + shard(qops.matmul(attn, lp['wo']),
                   ('batch', 'activation_length', 'activation_embed'))
@@ -318,9 +320,12 @@ def forward(config: MoEConfig,
     the load-balance statistics (they would otherwise hog capacity).
     """
     c = config
+    segment_ids = None
     if positions is None:
-        positions = jnp.broadcast_to(
-            jnp.arange(tokens.shape[1])[None, :], tokens.shape)
+        # moe.forward is training-only (prefill_hidden builds its own
+        # positions), so serving=False is always correct here.
+        segment_ids, positions = llama.positions_and_segments(
+            c, tokens, serving=False)
     x = qops.embed_rows(params['embed'], tokens).astype(c.dtype)
     if mesh is not None:
         x = mesh_lib.shard_logical(
@@ -328,7 +333,8 @@ def forward(config: MoEConfig,
 
     def layer_fn(x, lp):
         x, aux, _ = _layer(c, mesh, x, lp, positions,
-                           token_mask=token_mask)
+                           token_mask=token_mask,
+                           segment_ids=segment_ids)
         return x, aux
 
     if c.remat:

@@ -49,6 +49,8 @@ class QwenConfig:
     remat_policy: str = 'dots'
     attention_impl: str = 'auto'
     ce_chunk: int = 2048
+    # Packed-sequence training (see llama.LlamaConfig.packing_reset_eos).
+    packing_reset_eos: Optional[int] = None
 
     def num_params(self) -> int:
         d, f, v = self.d_model, self.d_ff, self.vocab_size
@@ -162,7 +164,8 @@ def init(config: QwenConfig, key: jax.Array) -> Params:
 def _layer(config: QwenConfig, mesh: Optional[mesh_lib.Mesh],
            x: jax.Array, lp: Params, positions: jax.Array,
            kv_cache=None, cache_positions: Optional[jax.Array] = None,
-           return_kv: bool = False):
+           return_kv: bool = False,
+           segment_ids: Optional[jax.Array] = None):
     """One block. Training/prefill by default; with kv_cache set, a
     decode step writing each slot's new K/V at its own position (same
     contract as llama._layer's continuous-batching path)."""
@@ -199,7 +202,8 @@ def _layer(config: QwenConfig, mesh: Optional[mesh_lib.Mesh],
     else:
         new_cache = (k, v) if return_kv else None
         attn = attention_ops.dot_product_attention(
-            q, k, v, causal=True, implementation=c.attention_impl)
+            q, k, v, causal=True, implementation=c.attention_impl,
+            segment_ids=segment_ids)
     attn = attn.reshape(b, s, c.n_heads * hd)
     x = x + shard(llama._ckpt_name(qops.matmul(attn, lp['wo']), 'attn_o'),
                   ('batch', 'activation_length', 'activation_embed'))
@@ -220,16 +224,18 @@ def _trunk(config: QwenConfig, params: Params, tokens: jax.Array,
            mesh: Optional[mesh_lib.Mesh],
            return_kv: bool = False):
     c = config
+    segment_ids = None
     if positions is None:
-        positions = jnp.broadcast_to(
-            jnp.arange(tokens.shape[1])[None, :], tokens.shape)
+        segment_ids, positions = llama.positions_and_segments(
+            c, tokens, serving=return_kv)
     x = llama._embed_lookup(params['embed'], tokens, mesh).astype(c.dtype)
     if mesh is not None:
         x = mesh_lib.shard_logical(
             x, mesh, ('batch', 'activation_length', 'activation_embed'))
 
     def layer_fn(x, lp):
-        x, kv = _layer(c, mesh, x, lp, positions, return_kv=return_kv)
+        x, kv = _layer(c, mesh, x, lp, positions, return_kv=return_kv,
+                       segment_ids=segment_ids)
         return x, ({'k': kv[0], 'v': kv[1]} if return_kv else None)
 
     if c.remat and not return_kv:

@@ -99,12 +99,12 @@ def dot_product_attention(q: jax.Array,
             d.platform == 'tpu' or
             getattr(d, 'device_kind', '').startswith('TPU')
             for d in jax.devices())
-        use_flash = (on_tpu and q.shape[1] >= _FLASH_MIN_SEQ and
-                     segment_ids is None and causal)
+        use_flash = on_tpu and q.shape[1] >= _FLASH_MIN_SEQ and causal
         implementation = 'flash' if use_flash else 'xla'
     if implementation == 'flash':
         from skypilot_tpu.ops import flash_attention
         return flash_attention.flash_attention(q, k, v, causal=causal,
-                                               window=window)
+                                               window=window,
+                                               segment_ids=segment_ids)
     return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids,
                          window=window)

@@ -52,9 +52,10 @@ def _window_inner_blocks(num_kv: int, block_q: int, block_kv: int,
     return min(num_kv, (window + block_q - 2) // block_kv + 2)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale: float, causal: bool,
-                block_q: int, block_kv: int, window, num_kv_total: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_kv_ref, o_ref,
+                lse_ref, acc_ref, m_ref, l_ref, *, scale: float,
+                causal: bool, block_q: int, block_kv: int, window,
+                num_kv_total: int, segmented: bool):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     num_kv = pl.num_programs(2)
@@ -92,7 +93,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bkv]
-        if causal or window is not None:
+        if causal or window is not None or segmented:
             # Mask only needed on diagonal/window-crossing blocks.
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0)
@@ -101,6 +102,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             keep = q_pos >= kv_pos if causal else (q_pos == q_pos)
             if window is not None:
                 keep = keep & (q_pos - kv_pos < window)
+            if segmented:
+                # [bq, 1] == [1, bkv] → block-diagonal document mask.
+                keep = keep & (seg_q_ref[0] == seg_kv_ref[0])
             s = jnp.where(keep, s, _NEG_INF)
 
         m_prev = m_ref[:, 0:1]                         # [bq, 1]
@@ -125,8 +129,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = (m_ref[:] + jnp.log(l_safe)).astype(jnp.float32)
 
 
-def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
-               block_q: int, block_kv: int, window=None
+def _seg_views(segment_ids, b):
+    """[B, S] int32 segment ids → the two tile-legal kernel views:
+    seg_q [B, S, 1] (block (1, bq, 1): last dims (bq, 1) — legal for
+    any bq multiple of 8) and seg_kv [B, 1, S] (block (1, 1, bkv)).
+    A per-(B, S) 2D operand with a (1, block) block would trip the
+    Mosaic last-two-dims tiling rule whenever B > 1."""
+    if segment_ids is None:
+        dummy = jnp.zeros((1, 1, 1), jnp.int32)
+        return dummy, dummy
+    seg = segment_ids.astype(jnp.int32)
+    assert seg.shape[0] == b, (seg.shape, b)
+    return seg[:, :, None], seg[:, None, :]
+
+
+def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, segment_ids,
+               *, causal: bool, block_q: int, block_kv: int, window=None
                ) -> Tuple[jax.Array, jax.Array]:
     """Returns (out [B,H,S,D], lse [B*H,S,LANES] lane-broadcast fp32).
 
@@ -150,12 +168,17 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
         inner = _window_inner_blocks(num_kv_total, block_q, block_kv,
                                      window)
 
-        def kv_map(bh, qi, ki):
+        def kv_block(qi, ki):
             first = _window_kv_first(qi, block_q, block_kv, window)
-            return (bh // groups,
-                    jnp.minimum(first + ki, num_kv_total - 1), 0)
+            return jnp.minimum(first + ki, num_kv_total - 1)
+
+        def kv_map(bh, qi, ki):
+            return (bh // groups, kv_block(qi, ki), 0)
     else:
         inner = num_kv_total
+
+        def kv_block(qi, ki):
+            return ki
 
         def kv_map(bh, qi, ki):
             return (bh // groups, ki, 0)
@@ -165,10 +188,22 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
     qr = q.reshape(b * h, s, d)
     kr = k.reshape(b * h_kv, s_kv, d)
     vr = v.reshape(b * h_kv, s_kv, d)
+    segmented = segment_ids is not None
+    seg_q, seg_kv = _seg_views(segment_ids, b)
+    if segmented:
+        seg_q_spec = pl.BlockSpec(
+            (1, block_q, 1), lambda bh, qi, ki: (bh // h, qi, 0))
+        seg_kv_spec = pl.BlockSpec(
+            (1, 1, block_kv),
+            lambda bh, qi, ki: (bh // h, 0, kv_block(qi, ki)))
+    else:
+        seg_q_spec = seg_kv_spec = pl.BlockSpec(
+            (1, 1, 1), lambda bh, qi, ki: (0, 0, 0))
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_kv=block_kv,
-                               window=window, num_kv_total=num_kv_total)
+                               window=window, num_kv_total=num_kv_total,
+                               segmented=segmented)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -176,6 +211,8 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, block_kv, d), kv_map),
             pl.BlockSpec((1, block_kv, d), kv_map),
+            seg_q_spec,
+            seg_kv_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
@@ -192,7 +229,7 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
             pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
         interpret=_should_interpret(),
-    )(qr, kr, vr)
+    )(qr, kr, vr, seg_q, seg_kv)
     return out.reshape(b, h, s, d), lse
 
 
@@ -202,10 +239,11 @@ def _should_interpret() -> bool:
 
 def _block_p_ds(q, k, v, out, dout, lse_col, *, scale: float,
                 causal: bool, q_start, kv_start, block_q: int,
-                block_kv: int, window):
+                block_kv: int, window, seg_q=None, seg_kv=None):
     """Shared P/dS recompute for both backward kernels.
 
-    q/out/dout [bq, d]; k/v [bkv, d]; lse_col [bq, 1] fp32. The delta
+    q/out/dout [bq, d]; k/v [bkv, d]; lse_col [bq, 1] fp32; seg_q
+    [bq, 1] / seg_kv [1, bkv] int32 when packing masks apply. The delta
     row-stat (Σ dO⊙O) is recomputed here from the blocks already in
     VMEM — cheaper than streaming a third stats operand from HBM.
     Returns (p, ds) as bf16-castable fp32 [bq, bkv].
@@ -216,7 +254,7 @@ def _block_p_ds(q, k, v, out, dout, lse_col, *, scale: float,
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale        # [bq, bkv]
-    if causal or window is not None:
+    if causal or window is not None or seg_q is not None:
         q_pos = q_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 0)
         kv_pos = kv_start + jax.lax.broadcasted_iota(
@@ -224,6 +262,8 @@ def _block_p_ds(q, k, v, out, dout, lse_col, *, scale: float,
         keep = q_pos >= kv_pos if causal else (q_pos == q_pos)
         if window is not None:
             keep = keep & (q_pos - kv_pos < window)
+        if seg_q is not None:
+            keep = keep & (seg_q == seg_kv)
         s = jnp.where(keep, s, _NEG_INF)
     p = jnp.exp(s - lse_col)                               # [bq, bkv]
     dp = jax.lax.dot_general(
@@ -234,9 +274,10 @@ def _block_p_ds(q, k, v, out, dout, lse_col, *, scale: float,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
-                    causal: bool, block_q: int, block_kv: int, window,
-                    num_q_total: int):
+                    seg_q_ref, seg_kv_ref, dk_ref, dv_ref, dk_acc,
+                    dv_acc, *, scale: float, causal: bool, block_q: int,
+                    block_kv: int, window, num_q_total: int,
+                    segmented: bool):
     """Grid (B*Hkv, KV-blocks, groups, Q-blocks): the two inner sweeps
     walk every query head sharing this KV head and that head's live Q
     blocks, so the GQA gradient reduction (dk/dv summed over the group)
@@ -274,7 +315,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
             q, k_ref[0], v_ref[0], out_ref[0], dout,
             lse_ref[0][:, 0:1], scale=scale,
             causal=causal, q_start=q_start, kv_start=kv_start,
-            block_q=block_q, block_kv=block_kv, window=window)
+            block_q=block_q, block_kv=block_kv, window=window,
+            seg_q=seg_q_ref[0] if segmented else None,
+            seg_kv=seg_kv_ref[0] if segmented else None)
         # dv += Pᵀ dO ; dk += dSᵀ Q  (contract the q dim, bf16 on MXU)
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p.astype(dout.dtype), dout, (((0,), (0,)), ((), ())),
@@ -290,9 +333,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
-                   dq_ref, dq_acc, *, scale: float, causal: bool,
-                   block_q: int, block_kv: int, window,
-                   num_kv_total: int):
+                   seg_q_ref, seg_kv_ref, dq_ref, dq_acc, *,
+                   scale: float, causal: bool, block_q: int,
+                   block_kv: int, window, num_kv_total: int,
+                   segmented: bool):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     num_kv = pl.num_programs(2)
@@ -320,7 +364,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
             q_ref[0], k, v_ref[0], out_ref[0], dout_ref[0],
             lse_ref[0][:, 0:1], scale=scale,
             causal=causal, q_start=q_start, kv_start=kv_start,
-            block_q=block_q, block_kv=block_kv, window=window)
+            block_q=block_q, block_kv=block_kv, window=window,
+            seg_q=seg_q_ref[0] if segmented else None,
+            seg_kv=seg_kv_ref[0] if segmented else None)
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -338,7 +384,7 @@ def _bwd_flash(residuals, dout, *, causal: bool, block_q: int,
     shared KV head via `bh // groups` like the forward; dKV runs one
     program per KV head and sweeps (group, Q-block) inner grid dims so
     dk/dv come out at their native Hkv width."""
-    q, k, v, out, lse = residuals  # lse [B*H,S,LANES] (fwd layout)
+    q, k, v, segment_ids, out, lse = residuals  # lse [B*H,S,LANES]
     b, h, s, d = q.shape
     h_kv = k.shape[1]
     groups = h // h_kv
@@ -364,24 +410,28 @@ def _bwd_flash(residuals, dout, *, causal: bool, block_q: int,
         dkv_inner = min(num_q_total,
                         (block_kv + window - 2) // block_q + 2)
 
-        def dq_kv_map(bh, i, j):
+        def dq_kv_block(i, j):
             first = _window_kv_first(i, block_q, block_kv, window)
-            return (bh // groups,
-                    jnp.minimum(first + j, num_kv_total - 1), 0)
+            return jnp.minimum(first + j, num_kv_total - 1)
 
-        def dkv_q_map(bh, j, g, i):
+        def dkv_q_block(j, i):
             first = (j * block_kv) // block_q
-            return (bh * groups + g,
-                    jnp.minimum(first + i, num_q_total - 1), 0)
+            return jnp.minimum(first + i, num_q_total - 1)
     else:
         dq_inner = num_kv_total
         dkv_inner = num_q_total
 
-        def dq_kv_map(bh, i, j):
-            return (bh // groups, j, 0)
+        def dq_kv_block(i, j):
+            return j
 
-        def dkv_q_map(bh, j, g, i):
-            return (bh * groups + g, i, 0)
+        def dkv_q_block(j, i):
+            return i
+
+    def dq_kv_map(bh, i, j):
+        return (bh // groups, dq_kv_block(i, j), 0)
+
+    def dkv_q_map(bh, j, g, i):
+        return (bh * groups + g, dkv_q_block(j, i), 0)
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
     kv_spec = pl.BlockSpec((1, block_kv, d), dq_kv_map)
@@ -394,13 +444,33 @@ def _bwd_flash(residuals, dout, *, causal: bool, block_q: int,
                                lambda bh, j, g, i: (bh, j, 0))
     dkv_stat_spec = pl.BlockSpec((1, block_q, _LANES), dkv_q_map)
 
+    segmented = segment_ids is not None
+    seg_q, seg_kv = _seg_views(segment_ids, b)
+    if segmented:
+        dkv_seg_q_spec = pl.BlockSpec(
+            (1, block_q, 1),
+            lambda bh, j, g, i: (bh // h_kv, dkv_q_block(j, i), 0))
+        dkv_seg_kv_spec = pl.BlockSpec(
+            (1, 1, block_kv), lambda bh, j, g, i: (bh // h_kv, 0, j))
+        dq_seg_q_spec = pl.BlockSpec(
+            (1, block_q, 1), lambda bh, i, j: (bh // h, i, 0))
+        dq_seg_kv_spec = pl.BlockSpec(
+            (1, 1, block_kv),
+            lambda bh, i, j: (bh // h, 0, dq_kv_block(i, j)))
+    else:
+        dummy3 = pl.BlockSpec((1, 1, 1), lambda *_: (0, 0, 0))
+        dkv_seg_q_spec = dkv_seg_kv_spec = dummy3
+        dq_seg_q_spec = dq_seg_kv_spec = dummy3
+
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_kv=block_kv,
-                          window=window, num_q_total=num_q_total),
+                          window=window, num_q_total=num_q_total,
+                          segmented=segmented),
         grid=(b * h_kv, s_kv // block_kv, groups, dkv_inner),
         in_specs=[dkv_q_spec, dkv_kv_spec, dkv_kv_spec, dkv_q_spec,
-                  dkv_q_spec, dkv_stat_spec],
+                  dkv_q_spec, dkv_stat_spec, dkv_seg_q_spec,
+                  dkv_seg_kv_spec],
         out_specs=[
             pl.BlockSpec((1, block_kv, d),
                          lambda bh, j, g, i: (bh, j, 0)),
@@ -416,40 +486,47 @@ def _bwd_flash(residuals, dout, *, causal: bool, block_q: int,
             pltpu.VMEM((block_kv, d), jnp.float32),
         ],
         interpret=_should_interpret(),
-    )(qr, kr, vr, outr, dor, lse)
+    )(qr, kr, vr, outr, dor, lse, seg_q, seg_kv)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_kv=block_kv,
-                          window=window, num_kv_total=num_kv_total),
+                          window=window, num_kv_total=num_kv_total,
+                          segmented=segmented),
         grid=(b * h, s // block_q, dq_inner),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, stat_spec],
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, stat_spec,
+                  dq_seg_q_spec, dq_seg_kv_spec],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
         ],
         out_shape=[jax.ShapeDtypeStruct((b * h, s, d), q.dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_should_interpret(),
-    )(qr, kr, vr, outr, dor, lse)[0]
+    )(qr, kr, vr, outr, dor, lse, seg_q, seg_kv)[0]
 
     return (dq.reshape(b, h, s, d), dk.reshape(b, h_kv, s_kv, d),
-            dv.reshape(b, h_kv, s_kv, d))
+            dv.reshape(b, h_kv, s_kv, d), None)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhsd(q, k, v, causal, block_q, block_kv, window):
-    out, _ = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
-                        block_kv=block_kv, window=window)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_bhsd(q, k, v, segment_ids, causal, block_q, block_kv, window):
+    out, _ = _flash_fwd(q, k, v, segment_ids, causal=causal,
+                        block_q=block_q, block_kv=block_kv,
+                        window=window)
     return out
 
 
-def _flash_bhsd_fwd(q, k, v, causal, block_q, block_kv, window):
-    out, lse = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
-                          block_kv=block_kv, window=window)
-    return out, (q, k, v, out, lse)
+def _flash_bhsd_fwd(q, k, v, segment_ids, causal, block_q, block_kv,
+                    window):
+    out, lse = _flash_fwd(q, k, v, segment_ids, causal=causal,
+                          block_q=block_q, block_kv=block_kv,
+                          window=window)
+    return out, (q, k, v, segment_ids, out, lse)
 
 
 def _flash_bhsd_bwd(causal, block_q, block_kv, window, residuals, dout):
+    # 4-tuple (dq, dk, dv, None): segment ids are integral, their
+    # cotangent is symbolically zero.
     return _bwd_flash(residuals, dout, causal=causal, block_q=block_q,
                       block_kv=block_kv, window=window)
 
@@ -461,11 +538,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_kv: int = DEFAULT_BLOCK_KV,
-                    window=None) -> jax.Array:
+                    window=None, segment_ids=None) -> jax.Array:
     """Flash attention; q [B,S,H,D], k/v [B,S,Hkv,D] (GQA) → [B,S,H,D].
 
     window: Mistral-style sliding window — out-of-window blocks are
-    skipped entirely, so work scales O(S·W) instead of O(S²)."""
+    skipped entirely, so work scales O(S·W) instead of O(S²).
+    segment_ids [B, S] int: packed-sequence document masking — queries
+    attend only within their own segment. Costs one [bq,1]==[1,bkv]
+    compare per live block; no O(S²) mask ever materializes, which is
+    the whole point vs the XLA fallback at long sequence."""
     b, s, h, d = q.shape
     h_kv = k.shape[2]
     assert h % h_kv == 0, (h, h_kv)
@@ -475,5 +556,6 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    out = _flash_bhsd(qt, kt, vt, causal, block_q, block_kv, window)
+    out = _flash_bhsd(qt, kt, vt, segment_ids, causal, block_q,
+                      block_kv, window)
     return jnp.transpose(out, (0, 2, 1, 3))

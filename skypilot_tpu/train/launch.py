@@ -73,6 +73,12 @@ def main() -> int:
     parser.add_argument('--lora-rank', type=int, default=0,
                         help='LoRA rank (0 = full fine-tune)')
     parser.add_argument('--lora-alpha', type=float, default=16.0)
+    parser.add_argument('--packing-reset-eos', type=int, default=None,
+                        help='EOS token id for packed-sequence '
+                             'training: attention is blocked across '
+                             'document boundaries and RoPE positions '
+                             'restart per document (segment masks ride '
+                             'the flash kernels)')
     parser.add_argument('--lora-targets', default='wq,wk,wv,wo',
                         help='comma-separated weight names to adapt')
     args = parser.parse_args()
@@ -93,6 +99,9 @@ def main() -> int:
         model.max_seq_len, args.seq_len))
     if args.attention:
         model = dataclasses.replace(model, attention_impl=args.attention)
+    if args.packing_reset_eos is not None:
+        model = dataclasses.replace(
+            model, packing_reset_eos=args.packing_reset_eos)
     plan = parse_mesh(args.mesh)
     config = trainer_lib.TrainConfig(
         model=model,

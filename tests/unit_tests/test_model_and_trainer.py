@@ -143,3 +143,79 @@ class TestShardedTraining:
         _, m2 = tr2.step(s2, b2)
         assert float(m1['loss']) == pytest.approx(float(m2['loss']),
                                                   rel=1e-4)
+
+
+class TestPackedSequences:
+    """packing_reset_eos: EOS-derived segment masks + position resets."""
+
+    def test_segments_from_eos(self):
+        toks = jnp.asarray([[5, 7, 0, 9, 11, 0, 13, 15]])  # EOS = 0
+        seg, pos = llama.segments_from_eos(toks, 0)
+        assert seg[0].tolist() == [1, 1, 1, 2, 2, 2, 3, 3]
+        assert pos[0].tolist() == [0, 1, 2, 0, 1, 2, 0, 1]
+
+    def test_packed_forward_equals_per_document(self):
+        """Each document in a packed row must see exactly the logits it
+        would get alone: no cross-document attention, RoPE restarting
+        at each boundary."""
+        c = dataclasses.replace(llama.LLAMA_TINY, packing_reset_eos=0)
+        params = llama.init(c, jax.random.PRNGKey(0))
+        doc1 = [5, 7, 9, 0]                    # closes with EOS
+        doc2 = [11, 13, 17, 19, 23]
+        packed = jnp.asarray([doc1 + doc2], jnp.int32)
+        out = llama.forward(c, params, packed)
+        alone1 = llama.forward(c, params, jnp.asarray([doc1], jnp.int32))
+        alone2 = llama.forward(c, params, jnp.asarray([doc2], jnp.int32))
+        np.testing.assert_allclose(np.asarray(out[0, :4]),
+                                   np.asarray(alone1[0]),
+                                   rtol=2e-2, atol=2e-2)
+        np.testing.assert_allclose(np.asarray(out[0, 4:]),
+                                   np.asarray(alone2[0]),
+                                   rtol=2e-2, atol=2e-2)
+        # And without the flag, the packed row DOES leak across the
+        # boundary (cross-document attention changes doc2's logits).
+        out_leaky = llama.forward(llama.LLAMA_TINY, params, packed)
+        assert float(jnp.abs(out_leaky[0, 4:] -
+                             alone2[0]).max()) > 1e-2
+
+    @pytest.mark.parametrize('family', ['qwen', 'gemma', 'moe'])
+    def test_packed_forward_isolates_documents_all_families(self, family):
+        import importlib
+        mod = importlib.import_module(f'skypilot_tpu.models.{family}')
+        cfg = {'qwen': 'QWEN3_TINY', 'gemma': 'GEMMA_TINY',
+               'moe': 'MOE_TINY'}[family]
+        base = getattr(mod, cfg)
+        overrides = {'packing_reset_eos': 0}
+        if family == 'moe':
+            # Expert capacity is shared across the whole [B, S] token
+            # set, so packed-vs-alone equality only holds when nothing
+            # is capacity-dropped; attention isolation is what this
+            # test pins.
+            overrides['capacity_factor'] = 8.0
+        c = dataclasses.replace(base, **overrides)
+        params = mod.init(c, jax.random.PRNGKey(0))
+        doc1 = [5, 7, 0]
+        doc2 = [11, 13, 17]
+        packed = jnp.asarray([doc1 + doc2], jnp.int32)
+        out = mod.forward(c, params, packed)
+        if isinstance(out, tuple):
+            out = out[0]
+        alone2 = mod.forward(c, params, jnp.asarray([doc2], jnp.int32))
+        if isinstance(alone2, tuple):
+            alone2 = alone2[0]
+        np.testing.assert_allclose(np.asarray(out[0, 3:]),
+                                   np.asarray(alone2[0]),
+                                   rtol=3e-2, atol=3e-2)
+
+    def test_packed_loss_trains(self):
+        """loss_fn with packing set: finite loss, gradients flow."""
+        c = dataclasses.replace(llama.LLAMA_TINY, packing_reset_eos=0)
+        params = llama.init(c, jax.random.PRNGKey(0))
+        toks = jnp.asarray([[5, 7, 0, 9, 11, 0, 13, 15]], jnp.int32)
+        tgts = jnp.asarray([[7, 0, 9, 11, 0, 13, 15, 1]], jnp.int32)
+        loss, grads = jax.value_and_grad(
+            lambda p: llama.loss_fn(c, p, toks, tgts))(params)
+        assert bool(jnp.isfinite(loss))
+        gnorm = jax.tree_util.tree_reduce(
+            lambda a, g: a + float(jnp.abs(g).sum()), grads, 0.0)
+        assert gnorm > 0
