@@ -62,6 +62,18 @@ class EngineConfig:
         return self.prefill_buckets[-1]
 
 
+def _logprobs_info(logits, tokens, k: int):
+    """(chosen_lp [B], top_vals [B, k], top_ids [B, k]) from fp32
+    logits [B, V] and sampled tokens [B]; None when k == 0. One
+    log_softmax + top_k — cheap next to the decode forward."""
+    if k == 0:
+        return None
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    chosen = jnp.take_along_axis(logp, tokens[:, None], axis=-1)[:, 0]
+    top_vals, top_ids = jax.lax.top_k(logp, k)
+    return chosen, top_vals, top_ids
+
+
 def supports_chunked_prefill(model_lib) -> bool:
     """Whether a family module can serve the chunked-prefill path:
     verify_forward (multi-token decode into a cache) plus the standard
@@ -268,16 +280,19 @@ class InferenceEngine:
             f'Prompt length {length} exceeds max prefill bucket '
             f'{self.config.prefill_buckets[-1]}.')
 
-    @functools.partial(jax.jit, static_argnums=(0,))
+    @functools.partial(jax.jit, static_argnums=(0, 8))
     def _prefill(self, params, tokens, true_len, temperature, top_k,
-                 top_p, key):
-        """tokens [1, bucket] padded; returns (first_token, kv-prefix).
+                 top_p, key, logprobs_k: int = 0):
+        """tokens [1, bucket] padded; returns (first_token, kv-prefix,
+        lp-info-or-None).
 
         Only the hidden state at true_len-1 goes through the lm_head:
         projecting the whole padded bucket would burn bucket×vocab matmul
         FLOPs + fp32 HBM on the TTFT-critical path for one useful row.
         The first token obeys the request's sampling params, same as every
-        decode step (temperature 0 → greedy).
+        decode step (temperature 0 → greedy). With logprobs_k the first
+        token's logprob + the top-k alternatives come back too (one
+        log_softmax + top_k over a single [1, V] row).
         """
         c = self.config.model
         last_hidden, kv = self._model_lib.prefill_hidden(
@@ -285,13 +300,15 @@ class InferenceEngine:
         logits = self._model_lib.lm_logits(c, params, last_hidden)
         first_token = sampling.sample_batched(logits, key, temperature,
                                               top_k, top_p)[0]
-        return first_token, kv
+        return (first_token, kv,
+                _logprobs_info(logits, first_token[None], logprobs_k))
 
     def prefill(self, prompt_tokens,
                 sampling_params: Optional[sampling.SamplingParams] = None,
-                key: Optional[jax.Array] = None
-                ) -> Tuple[jax.Array, Any, int]:
-        """Run prefill on one prompt → (first_token, kv, true_len)."""
+                key: Optional[jax.Array] = None,
+                logprobs_k: int = 0):
+        """Run prefill on one prompt → (first_token, kv, true_len), or
+        (first_token, kv, true_len, lp_info) when logprobs_k > 0."""
         sp = sampling_params or sampling.SamplingParams()
         true_len = len(prompt_tokens)
         bucket = self.bucket_for(true_len)
@@ -300,13 +317,15 @@ class InferenceEngine:
             jnp.asarray(prompt_tokens, jnp.int32))
         if key is None:
             self._key, key = jax.random.split(self._key)
-        first_token, kv = self._prefill(
+        first_token, kv, lp = self._prefill(
             self.params, padded, jnp.int32(true_len),
             jnp.full((1,), sp.temperature, jnp.float32),
             jnp.full((1,), sp.top_k, jnp.int32) if sp.top_k > 0 else None,
             jnp.full((1,), sp.top_p, jnp.float32) if sp.top_p < 1.0
             else None,
-            key)
+            key, logprobs_k)
+        if logprobs_k > 0:
+            return first_token, kv, true_len, lp
         return first_token, kv, true_len
 
     # ---- chunked prefill + prefix reuse ----
@@ -376,8 +395,8 @@ class InferenceEngine:
     def prefill_any(self, prompt_tokens,
                     sampling_params: Optional[sampling.SamplingParams]
                     = None,
-                    key: Optional[jax.Array] = None
-                    ) -> Tuple[jax.Array, Any, int]:
+                    key: Optional[jax.Array] = None,
+                    logprobs_k: int = 0):
         """prefill() for prompts of any length ≤ max_admit_len.
 
         Consults the prefix cache first; a hit copies the cached rows
@@ -386,7 +405,8 @@ class InferenceEngine:
         _chunk_forward (the padded rows of the last chunk write garbage
         beyond true_len — harmless, every row past a slot's live
         frontier is rewritten by decode before it is ever read).
-        Returns (first_token, kv, true_len) exactly like prefill().
+        Returns (first_token, kv, true_len) exactly like prefill()
+        (+ lp_info when logprobs_k > 0).
         """
         sp = sampling_params or sampling.SamplingParams()
         true_len = len(prompt_tokens)
@@ -394,7 +414,8 @@ class InferenceEngine:
                                  if self._prefix_cache is not None
                                  else (0, None))
         if prefix_len == 0 and true_len <= self.config.max_prompt_len:
-            out = self.prefill(prompt_tokens, sampling_params, key)
+            out = self.prefill(prompt_tokens, sampling_params, key,
+                               logprobs_k)
             if self._prefix_cache is not None:
                 self._prefix_cache.store(prompt_tokens, out[1], true_len)
             return out
@@ -433,6 +454,10 @@ class InferenceEngine:
             else None)[0]
         if self._prefix_cache is not None:
             self._prefix_cache.store(prompt_tokens, scratch, true_len)
+        if logprobs_k > 0:
+            lp = _logprobs_info(row_logits, first_token[None],
+                                logprobs_k)
+            return first_token, scratch, true_len, lp
         return first_token, scratch, true_len
 
     # ---- insert ----
@@ -471,7 +496,7 @@ class InferenceEngine:
     # ---- decode ----
 
     def _decode_step_impl(self, params, state, temperatures, top_k,
-                          top_p, key):
+                          top_p, key, logprobs_k: int = 0):
         """Per-slot sampling params [slots] (temp 0 → greedy, top_k 0 /
         top_p 1 → filter off); all traced — no value-dependent recompiles
         mid-serving. params is a traced argument: closing over self.params
@@ -484,6 +509,7 @@ class InferenceEngine:
             mesh=self.mesh)
         next_tokens = sampling.sample_batched(logits, key, temperatures,
                                               top_k, top_p)
+        lp = _logprobs_info(logits, next_tokens, logprobs_k)
         # Inactive slots hold position (their garbage writes are confined
         # to their own slot rows and overwritten on insert). Lengths cap
         # at the KV budget: a finished slot kept stepping in a fused
@@ -501,19 +527,19 @@ class InferenceEngine:
                                 state['tokens']),
             'active': state['active'],
         }
-        return state, next_tokens
+        return state, (next_tokens, lp)
 
-    @functools.partial(jax.jit, static_argnums=(0,),
+    @functools.partial(jax.jit, static_argnums=(0, 7),
                        donate_argnums=(2,))
     def _decode_step(self, params, state, temperatures, top_k, top_p,
-                     key):
+                     key, logprobs_k: int = 0):
         return self._decode_step_impl(params, state, temperatures, top_k,
-                                      top_p, key)
+                                      top_p, key, logprobs_k)
 
-    @functools.partial(jax.jit, static_argnums=(0, 6),
+    @functools.partial(jax.jit, static_argnums=(0, 6, 8),
                        donate_argnums=(2,))
     def _decode_steps(self, params, state, temperatures, top_k, top_p,
-                      n: int, key):
+                      n: int, key, logprobs_k: int = 0):
         """n fused decode steps under one dispatch (lax.scan).
 
         One host↔device round trip per n tokens instead of per token —
@@ -528,7 +554,8 @@ class InferenceEngine:
         """
         def body(state, step_key):
             return self._decode_step_impl(params, state, temperatures,
-                                          top_k, top_p, step_key)
+                                          top_k, top_p, step_key,
+                                          logprobs_k)
 
         return jax.lax.scan(body, state, jax.random.split(key, n))
 
@@ -614,19 +641,25 @@ class InferenceEngine:
         return state
 
     def decode_steps(self, state, n: int, temperatures=None, top_k=None,
-                     top_p=None, key: Optional[jax.Array] = None):
+                     top_p=None, key: Optional[jax.Array] = None,
+                     logprobs_k: int = 0):
         """Advance every slot n tokens in one dispatch.
 
-        Returns (state, tokens [n, slots]) — see _decode_steps for the
-        latency rationale and mid-batch-finish semantics. Sampling
-        params as in decode_step.
+        Returns (state, tokens [n, slots]) — or (state, tokens, lp)
+        with lp = (chosen [n, slots], top_vals [n, slots, k], top_ids)
+        when logprobs_k > 0. See _decode_steps for the latency
+        rationale and mid-batch-finish semantics.
         """
         temperatures, top_k, top_p = self._norm_sampling(temperatures,
                                                          top_k, top_p)
         if key is None:
             self._key, key = jax.random.split(self._key)
-        return self._decode_steps(self.params, state, temperatures,
-                                  top_k, top_p, n, key)
+        state, (tokens, lp) = self._decode_steps(
+            self.params, state, temperatures, top_k, top_p, n, key,
+            logprobs_k)
+        if logprobs_k > 0:
+            return state, tokens, lp
+        return state, tokens
 
     def _norm_sampling(self, temperatures, top_k, top_p):
         import numpy as np
@@ -650,8 +683,10 @@ class InferenceEngine:
         return temperatures, top_k, top_p
 
     def decode_step(self, state, temperatures=None, top_k=None,
-                    top_p=None, key: Optional[jax.Array] = None):
-        """Advance every slot one token. Returns (state, tokens [slots]).
+                    top_p=None, key: Optional[jax.Array] = None,
+                    logprobs_k: int = 0):
+        """Advance every slot one token. Returns (state, tokens [slots])
+        — or (state, tokens, lp) when logprobs_k > 0.
 
         Per-slot arrays [max_slots]: temperatures (0 = greedy), top_k
         (0 = off), top_p (1 = off); None means disabled for all slots.
@@ -663,5 +698,9 @@ class InferenceEngine:
                                                          top_k, top_p)
         if key is None:
             self._key, key = jax.random.split(self._key)
-        return self._decode_step(self.params, state, temperatures, top_k,
-                                 top_p, key)
+        state, (tokens, lp) = self._decode_step(
+            self.params, state, temperatures, top_k, top_p, key,
+            logprobs_k)
+        if logprobs_k > 0:
+            return state, tokens, lp
+        return state, tokens

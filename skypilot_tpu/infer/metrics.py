@@ -122,4 +122,22 @@ class ServeMetrics:
                 '# TYPE xsky_serve_queue_depth gauge',
                 f'xsky_serve_queue_depth {orch._pending.qsize()}',
             ]
+            stats = orch.engine.prefix_cache_stats
+            if stats is not None:
+                lines += [
+                    '# TYPE xsky_serve_prefix_cache_hits_total counter',
+                    f'xsky_serve_prefix_cache_hits_total '
+                    f'{stats["hits"]}',
+                    '# TYPE xsky_serve_prefix_cache_misses_total '
+                    'counter',
+                    f'xsky_serve_prefix_cache_misses_total '
+                    f'{stats["misses"]}',
+                    '# TYPE xsky_serve_prefix_cache_tokens_reused_total'
+                    ' counter',
+                    f'xsky_serve_prefix_cache_tokens_reused_total '
+                    f'{stats["tokens_reused"]}',
+                    '# TYPE xsky_serve_prefix_cache_entries gauge',
+                    f'xsky_serve_prefix_cache_entries '
+                    f'{stats["entries"]}',
+                ]
         return '\n'.join(lines) + '\n'

@@ -9,7 +9,9 @@ routes are in ``infer/server.py``.
 
 Supported: prompt as text / token list, ``max_tokens``, ``temperature``,
 ``top_p``/``top_k``, ``stop`` (string or list), ``stream`` (SSE),
-``echo``. Rejected clearly: ``n > 1``, ``logprobs``, batched prompts.
+``echo``, ``logprobs`` (completions int ≤ 5 / chat ``logprobs`` +
+``top_logprobs``), ``n`` ≤ 8 (non-streamed). Rejected clearly:
+batched prompts, ``n`` with ``stream``, ``logprobs`` with ``stream``.
 """
 from __future__ import annotations
 
@@ -45,6 +47,10 @@ class RequestMeta:
     echo: bool
     prompt_text: str             # '' when prompt came as token ids
     prompt_tokens: List[int]
+    n: int = 1                   # parallel choices (non-streamed)
+    # None = logprobs off; else the requested ALTERNATIVE count (0..5 —
+    # 0 means chosen-token logprob only, per the OpenAI shape):
+    logprobs: Optional[int] = None
     response_id: str = ''
     created: int = 0
 
@@ -85,22 +91,36 @@ def _parse_chat_prompt(body: Dict[str, Any],
 
 def build_request(body: Dict[str, Any], tokenizer: Any,
                   engine_config: Any, model_id: str,
-                  chat: bool) -> Tuple[orch_lib.Request, RequestMeta]:
+                  chat: bool,
+                  admit_limit: Optional[int] = None
+                  ) -> Tuple[orch_lib.Request, RequestMeta]:
     """Validate an API body into an orchestrator Request + meta.
 
-    Raises ApiError on anything malformed or unsupported.
+    `admit_limit` overrides the prompt-length cap (servers whose engine
+    has the chunked-prefill path admit beyond the largest bucket —
+    pass orchestrator._admit_limit()). Raises ApiError on anything
+    malformed or unsupported.
     """
-    if body.get('n', 1) != 1:
-        raise ApiError(400, 'n > 1 is not supported')
-    if body.get('logprobs'):
-        raise ApiError(400, 'logprobs are not supported')
+    stream = bool(body.get('stream', False))
+    try:
+        n = int(body.get('n', 1))
+    except (TypeError, ValueError):
+        raise ApiError(400, "'n' must be an integer")
+    if not 1 <= n <= 8:
+        raise ApiError(400, "'n' must be between 1 and 8")
+    if n > 1 and stream:
+        raise ApiError(400, "'n' > 1 is not supported with streaming")
+    logprobs = _parse_logprobs(body, chat)
+    if logprobs is not None and stream:
+        raise ApiError(400, "'logprobs' is not supported with "
+                            'streaming')
     if chat:
         prompt_text, prompt_tokens = _parse_chat_prompt(body, tokenizer)
     else:
         prompt_text, prompt_tokens = _parse_prompt(body, tokenizer)
 
-    limit = min(engine_config.max_prompt_len,
-                engine_config.max_target_len - 1)
+    limit = admit_limit if admit_limit is not None else min(
+        engine_config.max_prompt_len, engine_config.max_target_len - 1)
     if len(prompt_tokens) > limit:
         raise ApiError(400, f'prompt is {len(prompt_tokens)} tokens; '
                             f'this server accepts at most {limit}')
@@ -140,15 +160,68 @@ def build_request(body: Dict[str, Any], tokenizer: Any,
         eos_token_id=getattr(tokenizer, 'eos_token_id', None),
         temperature=temperature,
         top_k=top_k,
-        top_p=top_p)
+        top_p=top_p,
+        # The orchestrator records max(alts, 1) alternatives; the
+        # response builder slices down to the exact requested count.
+        logprobs=0 if logprobs is None else max(logprobs, 1))
     meta = RequestMeta(kind='chat' if chat else 'completion',
                        model_id=model_id,
-                       stream=bool(body.get('stream', False)),
+                       stream=stream,
                        stop=stop,
                        echo=bool(body.get('echo', False)),
                        prompt_text=prompt_text,
-                       prompt_tokens=prompt_tokens)
+                       prompt_tokens=prompt_tokens,
+                       n=n,
+                       logprobs=logprobs)
     return request, meta
+
+
+def _parse_logprobs(body: Dict[str, Any], chat: bool) -> Optional[int]:
+    """Completions: `logprobs: N` (int ≤ 5). Chat: `logprobs: true` +
+    optional `top_logprobs: N`. Returns the requested ALTERNATIVE
+    count (0..5), or None when logprobs are off — 0 is a valid request
+    meaning chosen-token logprobs with no alternatives."""
+    cap = orch_lib.LOGPROBS_K
+    if chat:
+        flag = body.get('logprobs', False)
+        if not isinstance(flag, bool):
+            raise ApiError(400, "chat 'logprobs' must be a boolean")
+        if not flag:
+            if body.get('top_logprobs'):
+                raise ApiError(400, "'top_logprobs' needs "
+                                    "'logprobs': true")
+            return None
+        top = body.get('top_logprobs', 0)
+        try:
+            top = int(top)
+        except (TypeError, ValueError):
+            raise ApiError(400, "'top_logprobs' must be an integer")
+        if not 0 <= top <= cap:
+            raise ApiError(400, f"'top_logprobs' must be 0..{cap}")
+        return top
+    lp = body.get('logprobs')
+    if lp is None or lp is False:
+        return None   # NOT `in (None, False)`: 0 == False is a hit
+    try:
+        lp = int(lp)
+    except (TypeError, ValueError):
+        raise ApiError(400, "'logprobs' must be an integer")
+    if not 0 <= lp <= cap:
+        raise ApiError(400, f"'logprobs' must be 0..{cap}")
+    return lp
+
+
+def clone_request(request: orch_lib.Request) -> orch_lib.Request:
+    """A fresh Request with the same decoding parameters (for n > 1 —
+    output bookkeeping must not be shared)."""
+    return orch_lib.Request(
+        prompt_tokens=request.prompt_tokens,
+        max_new_tokens=request.max_new_tokens,
+        eos_token_id=request.eos_token_id,
+        temperature=request.temperature,
+        top_k=request.top_k,
+        top_p=request.top_p,
+        logprobs=request.logprobs)
 
 
 def find_stop(text: str, stops: List[str]) -> int:
@@ -187,22 +260,123 @@ def _usage(meta: RequestMeta,
                              len(request.output_tokens))}
 
 
-def response_body(meta: RequestMeta, request: orch_lib.Request,
-                  text: str, finish_reason: str) -> Dict[str, Any]:
-    if meta.kind == 'chat':
-        choice: Dict[str, Any] = {
-            'index': 0,
-            'message': {'role': 'assistant', 'content': text},
-            'finish_reason': finish_reason,
-        }
-        obj = 'chat.completion'
+def _logprobs_block(meta: RequestMeta, request: orch_lib.Request,
+                    tokenizer: Any, text: str
+                    ) -> Optional[Dict[str, Any]]:
+    """The per-choice `logprobs` object in the OpenAI shape.
+
+    Completions: {tokens, token_logprobs, top_logprobs, text_offset}.
+    Chat: {content: [{token, logprob, top_logprobs: [...]}]}. Token
+    strings decode one token at a time (byte-exactness is not
+    guaranteed across merges — standard for this field). Entries are
+    truncated to the RETURNED `text` (stop sequences cut generation
+    mid-list, and cancel latency can overshoot by a few tokens), and
+    the alternative count is exactly meta.logprobs (the orchestrator
+    records at least one alternative even for a 0-alternative ask).
+    """
+    alts = meta.logprobs
+    if alts is None or not request.logprobs:
+        return None
+    n = len(request.token_logprobs)
+    toks = request.output_tokens[:n]
+    # Token strings as incremental joint-decode diffs: their
+    # concatenation is EXACTLY tokenizer.decode(toks) (per-token
+    # decode is not — multi-byte characters split across tokens), so
+    # offsets and stop-truncation line up with the returned text.
+    tok_strs, prev = [], ''
+    for i in range(n):
+        cur = tokenizer.decode(toks[:i + 1])
+        tok_strs.append(cur[len(prev):])
+        prev = cur
+    # Echoed completions prepend the prompt (reconstructed when it
+    # arrived as token ids): offsets are relative to the full text.
+    base = 0
+    if meta.echo and meta.kind == 'completion':
+        base = len(meta.prompt_text or
+                   tokenizer.decode(meta.prompt_tokens))
+    gen_text = text[base:]
+    if gen_text == prev:
+        # Untruncated: every recorded token is returned (a trailing
+        # empty diff — incomplete UTF-8 tail — must not be dropped).
+        keep = n
+        offsets, pos = [], 0
+        for ts in tok_strs:
+            offsets.append(base + pos)
+            pos += len(ts)
     else:
-        choice = {'index': 0, 'text': text,
-                  'finish_reason': finish_reason}
-        obj = 'text_completion'
+        keep, pos = 0, 0
+        offsets = []
+        for ts in tok_strs:
+            if pos >= len(gen_text):
+                break
+            offsets.append(base + pos)
+            pos += len(ts)
+            keep += 1
+    tok_strs = tok_strs[:keep]
+    token_lps = request.token_logprobs[:keep]
+    top_lps = request.top_logprobs[:keep]
+    if meta.kind == 'chat':
+        content = []
+        for ts, lp, top in zip(tok_strs, token_lps, top_lps):
+            ranked = sorted(top.items(), key=lambda kv: -kv[1])[:alts]
+            content.append({
+                'token': ts, 'logprob': lp,
+                'top_logprobs': [
+                    {'token': tokenizer.decode([tid]), 'logprob': v}
+                    for tid, v in ranked],
+            })
+        return {'content': content}
+    tops = []
+    for top in top_lps:
+        merged: Dict[str, float] = {}
+        for tid, v in sorted(top.items(), key=lambda kv: -kv[1])[:alts]:
+            key = tokenizer.decode([tid])
+            # Distinct ids can decode to the same string (specials,
+            # unmapped ids); keep the most probable one.
+            merged[key] = max(v, merged.get(key, v))
+        tops.append(merged)
+    return {
+        'tokens': tok_strs,
+        'token_logprobs': token_lps,
+        'top_logprobs': tops,
+        'text_offset': offsets,
+    }
+
+
+def response_body(meta: RequestMeta, request: orch_lib.Request,
+                  text: str, finish_reason: str,
+                  tokenizer: Any = None,
+                  extra_choices: Optional[List[Tuple[
+                      orch_lib.Request, str, str]]] = None
+                  ) -> Dict[str, Any]:
+    """One response document; extra_choices carries the n>1 siblings
+    as (request, text, finish_reason) for indices 1..n-1."""
+    all_choices = [(request, text, finish_reason)]
+    all_choices += extra_choices or []
+
+    choices = []
+    for idx, (req, txt, reason) in enumerate(all_choices):
+        if meta.kind == 'chat':
+            choice: Dict[str, Any] = {
+                'index': idx,
+                'message': {'role': 'assistant', 'content': txt},
+                'finish_reason': reason,
+            }
+        else:
+            choice = {'index': idx, 'text': txt,
+                      'finish_reason': reason}
+        if req.logprobs and tokenizer is not None:
+            choice['logprobs'] = _logprobs_block(meta, req, tokenizer,
+                                                 txt)
+        choices.append(choice)
+    obj = 'chat.completion' if meta.kind == 'chat' else 'text_completion'
+    usage = _usage(meta, request)
+    for req, _, _ in all_choices[1:]:
+        usage['completion_tokens'] += len(req.output_tokens)
+        usage['total_tokens'] += len(req.output_tokens)
     return {'id': meta.response_id, 'object': obj,
             'created': meta.created, 'model': meta.model_id,
-            'choices': [choice], 'usage': _usage(meta, request)}
+            'choices': choices, 'usage': usage}
 
 
 def chunk_body(meta: RequestMeta, text: str,

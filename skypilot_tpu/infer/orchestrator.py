@@ -24,6 +24,12 @@ from skypilot_tpu.infer import sampling as sampling_lib
 logger = sky_logging.init_logger(__name__)
 
 
+# Fixed device-side top-k for logprobs-requesting batches: one extra
+# compiled decode variant total (per-request k is sliced host-side),
+# matching the OpenAI completions cap.
+LOGPROBS_K = 5
+
+
 @dataclasses.dataclass
 class Request:
     prompt_tokens: List[int]
@@ -32,6 +38,9 @@ class Request:
     temperature: float = 0.0
     top_k: int = 0               # 0 → disabled
     top_p: float = 1.0           # 1 → disabled
+    # 0 = off; 1..LOGPROBS_K = record each generated token's logprob
+    # plus that many top alternatives per step:
+    logprobs: int = 0
     # set by the caller (any thread) to stop generation early — e.g. a
     # stop-sequence hit or client disconnect in the streaming API; the
     # orchestrator honors it at the next token boundary:
@@ -39,6 +48,10 @@ class Request:
     # filled by the orchestrator:
     request_id: int = -1
     output_tokens: List[int] = dataclasses.field(default_factory=list)
+    # parallel to output_tokens when logprobs > 0:
+    token_logprobs: List[float] = dataclasses.field(default_factory=list)
+    top_logprobs: List[Dict[int, float]] = dataclasses.field(
+        default_factory=list)
     done: bool = False
     error: Optional[str] = None
     submitted_at: float = 0.0
@@ -121,11 +134,17 @@ class Orchestrator:
         # Key omitted: the engine owns sampling-key state (split per call).
         # prefill_any == prefill for in-bucket prompts with no cached
         # prefix; beyond that it chunks and reuses cached prefixes.
-        first_token, kv, true_len = self.engine.prefill_any(
+        out = self.engine.prefill_any(
             request.prompt_tokens,
             sampling_params=sampling_lib.SamplingParams(
                 temperature=request.temperature, top_k=request.top_k,
-                top_p=request.top_p))
+                top_p=request.top_p),
+            logprobs_k=LOGPROBS_K if request.logprobs else 0)
+        if request.logprobs:
+            first_token, kv, true_len, lp = out
+            self._record_logprobs(request, lp, row=0)
+        else:
+            first_token, kv, true_len = out
         self.state = self.engine.insert(self.state, kv, first_token,
                                         true_len, slot)
         request.output_tokens.append(int(first_token))
@@ -133,6 +152,17 @@ class Orchestrator:
         self._slot_req[slot] = request
         self._maybe_finish(slot, int(first_token))
         return True
+
+    def _record_logprobs(self, request: Request, lp, row) -> None:
+        """Append one generated token's logprob + top-k alternatives.
+        lp = (chosen, top_vals, top_ids) host- or device-side; `row`
+        indexes the batch dim (0 for prefill, the slot for decode)."""
+        chosen, vals, ids = (np.asarray(jax.device_get(a)) for a in lp)
+        k = min(request.logprobs, vals.shape[-1])
+        request.token_logprobs.append(float(chosen[row]))
+        request.top_logprobs.append(
+            {int(t): float(v)
+             for t, v in zip(ids[row][:k], vals[row][:k])})
 
     def _maybe_finish(self, slot: int, token: int) -> None:
         request = self._slot_req[slot]
@@ -142,6 +172,9 @@ class Orchestrator:
         if hit_eos or exhausted or request.cancel_requested:
             if hit_eos:
                 request.output_tokens.pop()
+                if request.token_logprobs:
+                    request.token_logprobs.pop()
+                    request.top_logprobs.pop()
             request.done = True
             request.finished_at = time.perf_counter()
             self.state = self.engine.release_slot(self.state, slot)
@@ -163,20 +196,31 @@ class Orchestrator:
             top_k[slot] = request.top_k
             top_p[slot] = request.top_p
         self._key, step_key = jax.random.split(self._key)
+        k = (LOGPROBS_K if any(r.logprobs
+                               for r in self._slot_req.values()) else 0)
         if self.decode_steps == 1:
-            self.state, tokens = self.engine.decode_step(
+            out = self.engine.decode_step(
                 self.state, temperatures=temps, top_k=top_k, top_p=top_p,
-                key=step_key)
+                key=step_key, logprobs_k=k)
+            self.state, tokens = out[0], out[1]
             batches = np.asarray(jax.device_get(tokens))[None, :]
+            lp = tuple(np.asarray(jax.device_get(a))[None]
+                       for a in out[2]) if k else None
         else:
-            self.state, tokens = self.engine.decode_steps(
+            out = self.engine.decode_steps(
                 self.state, self.decode_steps, temperatures=temps,
-                top_k=top_k, top_p=top_p, key=step_key)
+                top_k=top_k, top_p=top_p, key=step_key, logprobs_k=k)
+            self.state, tokens = out[0], out[1]
             batches = np.asarray(jax.device_get(tokens))    # [n, slots]
-        for row in batches:
+            lp = tuple(np.asarray(jax.device_get(a))
+                       for a in out[2]) if k else None
+        for i, row in enumerate(batches):
             for slot in list(self._slot_req):
                 request = self._slot_req[slot]
                 request.output_tokens.append(int(row[slot]))
+                if request.logprobs and lp is not None:
+                    self._record_logprobs(
+                        request, (lp[0][i], lp[1][i], lp[2][i]), slot)
                 self._maybe_finish(slot, int(row[slot]))
 
     def fail_all(self, error: str) -> None:
@@ -324,13 +368,14 @@ class SpeculativeOrchestrator(Orchestrator):
             pass
         if not self._slot_req:
             return
-        all_greedy = all(r.temperature == 0.0
+        all_greedy = all(r.temperature == 0.0 and not r.logprobs
                          for r in self._slot_req.values())
         if not all_greedy:
-            # Mixed batch: plain round for correct sampling; keep the
-            # draft's bookkeeping aligned (cache rows for these tokens
-            # are missing in the draft — acceptance pays, not
-            # correctness).
+            # Mixed batch (sampled slots, or slots wanting logprobs —
+            # verify_forward does not surface per-token logprobs):
+            # plain round; keep the draft's bookkeeping aligned (cache
+            # rows for these tokens are missing in the draft —
+            # acceptance pays, not correctness).
             super().step()
             self.draft_state = self.draft.sync_slots_from(
                 self.draft_state, self.state)

@@ -205,7 +205,8 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
                 return
             try:
                 request, meta = openai_api.build_request(
-                    body, tokenizer, config, model_id, chat)
+                    body, tokenizer, config, model_id, chat,
+                    admit_limit=loop.orch._admit_limit())
             except openai_api.ApiError as e:
                 self._json(e.code, e.body())
                 return
@@ -219,16 +220,50 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
                     metrics.observe_request(endpoint, request,
                                             outcome=outcome)
                 return
+            siblings = [openai_api.clone_request(request)
+                        for _ in range(meta.n - 1)]
+            for sib in siblings:
+                loop.submit(sib)
             self._await_with_stops(request, meta)
+            # Siblings need the same stop-sequence cancellation as the
+            # primary — without it a stopped sibling decodes its whole
+            # budget, burning slots and stalling this response.
+            deadline = time.time() + 600
+            while (any(not s.done for s in siblings)
+                   and time.time() < deadline):
+                if meta.stop:
+                    for sib in siblings:
+                        if sib.done or sib.cancel_requested:
+                            continue
+                        sib_text = tokenizer.decode(
+                            list(sib.output_tokens))
+                        if openai_api.find_stop(sib_text,
+                                                meta.stop) != -1:
+                            sib.cancel_requested = True
+                time.sleep(0.005)
+            for sib in siblings:
+                if not sib.done:
+                    # Do not assemble a response from a request the
+                    # orchestrator thread is still appending to.
+                    sib.error = sib.error or 'server timeout'
+                    sib.cancel_requested = True
             metrics.observe_request(endpoint, request)
-            if request.error:
-                self._json(400, {'error': {'message': request.error,
+            failed = request.error or next(
+                (s.error for s in siblings if s.error), None)
+            if failed:
+                self._json(400, {'error': {'message': failed,
                                            'type': 'engine_error'}})
                 return
             text, finish_reason = openai_api.finalize_text(
                 meta, request, tokenizer)
+            extra = []
+            for sib in siblings:
+                sib_text, sib_reason = openai_api.finalize_text(
+                    meta, sib, tokenizer)
+                extra.append((sib, sib_text, sib_reason))
             self._json(200, openai_api.response_body(
-                meta, request, text, finish_reason))
+                meta, request, text, finish_reason, tokenizer=tokenizer,
+                extra_choices=extra))
 
         def _await_with_stops(self, request, meta):
             """Blocking wait that still cancels on a stop-sequence hit —

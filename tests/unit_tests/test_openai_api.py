@@ -85,8 +85,11 @@ class TestRequestShaping:
 
     def test_rejections(self):
         bad = [
-            ({'prompt': 'x', 'n': 2}, 'n > 1'),
-            ({'prompt': 'x', 'logprobs': 5}, 'logprobs'),
+            ({'prompt': 'x', 'n': 9}, 'between 1 and 8'),
+            ({'prompt': 'x', 'n': 2, 'stream': True}, 'streaming'),
+            ({'prompt': 'x', 'logprobs': 50}, '0..5'),
+            ({'prompt': 'x', 'logprobs': 3, 'stream': True},
+             'streaming'),
             ({'prompt': ['a', 'b']}, 'batched'),
             ({}, 'required'),
             ({'prompt': 'x', 'max_tokens': 0}, 'max_tokens'),
@@ -97,6 +100,39 @@ class TestRequestShaping:
             with pytest.raises(openai_api.ApiError, match=match):
                 openai_api.build_request(body, self.tok, self.config,
                                          'm', chat=False)
+        with pytest.raises(openai_api.ApiError, match='top_logprobs'):
+            openai_api.build_request(
+                {'messages': [{'role': 'user', 'content': 'x'}],
+                 'top_logprobs': 3}, self.tok, self.config, 'm',
+                chat=True)
+
+    def test_logprobs_and_n_accepted(self):
+        request, meta = openai_api.build_request(
+            {'prompt': 'x', 'logprobs': 3, 'n': 2}, self.tok,
+            self.config, 'm', chat=False)
+        assert request.logprobs == 3 and meta.logprobs == 3
+        assert meta.n == 2
+        request, meta = openai_api.build_request(
+            {'messages': [{'role': 'user', 'content': 'x'}],
+             'logprobs': True, 'top_logprobs': 4},
+            self.tok, self.config, 'm', chat=True)
+        assert request.logprobs == 4 and meta.logprobs == 4
+        # 0 alternatives is a valid ask (chosen-token logprob only);
+        # the orchestrator still records one, the response slices to 0.
+        request, meta = openai_api.build_request(
+            {'prompt': 'x', 'logprobs': 0}, self.tok, self.config,
+            'm', chat=False)
+        assert request.logprobs == 1 and meta.logprobs == 0
+
+    def test_admit_limit_override(self):
+        long_prompt = 'x' * 40     # > bucket 32 with BOS
+        with pytest.raises(openai_api.ApiError, match='at most'):
+            openai_api.build_request({'prompt': long_prompt}, self.tok,
+                                     self.config, 'm', chat=False)
+        request, _ = openai_api.build_request(
+            {'prompt': long_prompt}, self.tok, self.config, 'm',
+            chat=False, admit_limit=63)
+        assert len(request.prompt_tokens) > 32
 
     def test_token_ids_prompt(self):
         request, meta = openai_api.build_request(
@@ -224,7 +260,7 @@ class TestLiveEndpoints:
     def test_bad_requests_get_openai_errors(self, live_server):
         url, _ = live_server
         status, payload = _post(url, '/v1/completions',
-                                {'prompt': 'x', 'n': 3})
+                                {'prompt': 'x', 'n': 9})
         assert status == 400
         assert payload['error']['type'] == 'invalid_request_error'
 
@@ -382,3 +418,141 @@ class TestCancellation:
         assert active.done and 'boom' in active.error
         assert queued.done and 'boom' in queued.error
         assert len(orch._free_slots) == config.max_slots
+
+
+def test_metrics_render_prefix_cache_stats():
+    """render() surfaces prefix-cache counters when the engine has one
+    (and omits them when it doesn't)."""
+    import jax
+    from skypilot_tpu.infer import engine as engine_lib
+    from skypilot_tpu.infer import metrics as metrics_lib
+    from skypilot_tpu.models import llama
+    params = llama.init(llama.LLAMA_TINY, jax.random.PRNGKey(0))
+    engine = engine_lib.InferenceEngine(
+        engine_lib.EngineConfig(model=llama.LLAMA_TINY, max_slots=2,
+                                max_target_len=64,
+                                prefill_buckets=(16, 32),
+                                prefix_cache_entries=2), params)
+    orch = orch_lib.Orchestrator(engine)
+    prompt = [(i * 3 + 1) % 256 for i in range(20)]
+    orch.generate([prompt], max_new_tokens=2)
+    orch.generate([prompt], max_new_tokens=2)
+    text = metrics_lib.ServeMetrics().render(orch=orch)
+    assert 'xsky_serve_prefix_cache_hits_total 1' in text
+    assert 'xsky_serve_prefix_cache_entries 1' in text
+
+    plain = engine_lib.InferenceEngine(
+        engine_lib.EngineConfig(model=llama.LLAMA_TINY, max_slots=2,
+                                max_target_len=64,
+                                prefill_buckets=(16, 32)), params)
+    text2 = metrics_lib.ServeMetrics().render(
+        orch=orch_lib.Orchestrator(plain))
+    assert 'prefix_cache' not in text2
+
+
+class TestLogprobsAndN:
+
+    def test_live_completion_logprobs(self, live_server):
+        url, tok = live_server
+        status, payload = _post(url, '/v1/completions', {
+            'prompt': 'hello', 'max_tokens': 5, 'temperature': 0,
+            'logprobs': 3})
+        assert status == 200
+        lp = payload['choices'][0]['logprobs']
+        n = len(lp['tokens'])
+        assert n == len(lp['token_logprobs']) == len(lp['top_logprobs'])
+        assert n == payload['usage']['completion_tokens']
+        assert all(v <= 0.0 for v in lp['token_logprobs'])
+        # ≤: the completions format keys alternatives by decoded token
+        # STRING, and distinct ids can decode identically (collapsing
+        # dict entries) — especially in the tiny byte vocab.
+        assert all(1 <= len(top) <= 3 for top in lp['top_logprobs'])
+        # Greedy: the chosen token's logprob is the max → it appears
+        # in its own top-k with the same value.
+        for ts, chosen, top in zip(lp['tokens'], lp['token_logprobs'],
+                                   lp['top_logprobs']):
+            assert abs(max(top.values()) - chosen) < 1e-4
+        assert lp['text_offset'][0] == 0
+
+    def test_live_chat_logprobs(self, live_server):
+        url, _ = live_server
+        status, payload = _post(url, '/v1/chat/completions', {
+            'messages': [{'role': 'user', 'content': 'hi'}],
+            'max_tokens': 4, 'temperature': 0,
+            'logprobs': True, 'top_logprobs': 2})
+        assert status == 200
+        content = payload['choices'][0]['logprobs']['content']
+        assert len(content) == payload['usage']['completion_tokens']
+        for entry in content:
+            assert entry['logprob'] <= 0.0
+            assert len(entry['top_logprobs']) == 2
+
+    def test_live_n_choices(self, live_server):
+        url, _ = live_server
+        status, payload = _post(url, '/v1/completions', {
+            'prompt': 'hello', 'max_tokens': 4, 'temperature': 0,
+            'n': 3, 'logprobs': 0})
+        assert status == 200
+        choices = payload['choices']
+        assert [c['index'] for c in choices] == [0, 1, 2]
+        # Greedy: all three choices identical.
+        assert len({c['text'] for c in choices}) == 1
+        # Usage must accumulate ALL choices' tokens; the logprobs
+        # token list gives choice 0's true generated count.
+        per_choice = len(choices[0]['logprobs']['tokens'])
+        assert per_choice >= 1
+        assert payload['usage']['completion_tokens'] == 3 * per_choice
+        # logprobs: 0 → chosen-token logprobs with NO alternatives.
+        assert all(len(t) == 0
+                   for t in choices[0]['logprobs']['top_logprobs'])
+
+    def test_multi_step_decode_logprobs_match_single(self):
+        """Fused decode must surface identical logprobs to per-token."""
+        import numpy as np
+        model = dataclasses.replace(llama.LLAMA_TINY, vocab_size=512)
+        params = llama.init(model, jax.random.PRNGKey(0))
+        mk = lambda: engine_lib.InferenceEngine(
+            engine_lib.EngineConfig(model=model, max_slots=2,
+                                    max_target_len=64,
+                                    prefill_buckets=(16,)), params)
+
+        def run(decode_steps):
+            orch = orch_lib.Orchestrator(mk(), decode_steps=decode_steps)
+            request = orch.submit(orch_lib.Request(
+                prompt_tokens=[5, 6, 7], max_new_tokens=6, logprobs=2))
+            orch.run_until_drained()
+            return request
+
+        r1, r4 = run(1), run(4)
+        assert r1.output_tokens == r4.output_tokens
+        np.testing.assert_allclose(r1.token_logprobs, r4.token_logprobs,
+                                   atol=1e-5)
+        assert len(r1.token_logprobs) == 6
+        assert [sorted(d) for d in r1.top_logprobs] == \
+            [sorted(d) for d in r4.top_logprobs]
+
+
+    def test_logprobs_truncate_at_stop(self, live_server):
+        """Stop-sequence truncation must cut the logprobs arrays to the
+        returned text (tokens past the stop are discarded)."""
+        url, _ = live_server
+        status, full = _post(url, '/v1/completions', {
+            'prompt': 'hello', 'max_tokens': 8, 'temperature': 0,
+            'logprobs': 1})
+        assert status == 200
+        text = full['choices'][0]['text']
+        printable = [c for c in text[:-1] if c.strip()]
+        if not printable:
+            pytest.skip('tiny model emitted no printable stop anchor')
+        stop_char = printable[0]
+        status, stopped = _post(url, '/v1/completions', {
+            'prompt': 'hello', 'max_tokens': 8, 'temperature': 0,
+            'logprobs': 1, 'stop': stop_char})
+        assert status == 200
+        choice = stopped['choices'][0]
+        lp = choice['logprobs']
+        joined = ''.join(lp['tokens'])
+        assert joined == choice['text']
+        assert len(lp['token_logprobs']) == len(lp['tokens'])
+        assert all(off <= len(choice['text'])
+                   for off in lp['text_offset'])
