@@ -279,15 +279,27 @@ def _logprobs_block(meta: RequestMeta, request: orch_lib.Request,
         return None
     n = len(request.token_logprobs)
     toks = request.output_tokens[:n]
-    # Token strings as incremental joint-decode diffs: their
-    # concatenation is EXACTLY tokenizer.decode(toks) (per-token
-    # decode is not — multi-byte characters split across tokens), so
-    # offsets and stop-truncation line up with the returned text.
-    tok_strs, prev = [], ''
+    # Token strings as joint-decode diffs: their concatenation is
+    # EXACTLY tokenizer.decode(toks) (per-token decode is not —
+    # multi-byte characters split across tokens), so offsets and
+    # stop-truncation line up with the returned text. Diffs use a
+    # small sliding window (two ≤W+1-token decodes per token, O(n·W)
+    # total — a full cumulative decode per token would be O(n²));
+    # if windowing ever disagrees with the joint decode (a merge
+    # spanning the window), fall back to the exact cumulative pass.
+    full_join = tokenizer.decode(toks)
+    window = 8
+    tok_strs = []
     for i in range(n):
-        cur = tokenizer.decode(toks[:i + 1])
-        tok_strs.append(cur[len(prev):])
-        prev = cur
+        lo = max(0, i + 1 - window)
+        head = tokenizer.decode(toks[lo:i]) if i > lo else ''
+        tok_strs.append(tokenizer.decode(toks[lo:i + 1])[len(head):])
+    if ''.join(tok_strs) != full_join:
+        tok_strs, prev = [], ''
+        for i in range(n):
+            cur = tokenizer.decode(toks[:i + 1])
+            tok_strs.append(cur[len(prev):])
+            prev = cur
     # Echoed completions prepend the prompt (reconstructed when it
     # arrived as token ids): offsets are relative to the full text.
     base = 0
@@ -295,7 +307,7 @@ def _logprobs_block(meta: RequestMeta, request: orch_lib.Request,
         base = len(meta.prompt_text or
                    tokenizer.decode(meta.prompt_tokens))
     gen_text = text[base:]
-    if gen_text == prev:
+    if gen_text == full_join:
         # Untruncated: every recorded token is returned (a trailing
         # empty diff — incomplete UTF-8 tail — must not be dropped).
         keep = n

@@ -229,12 +229,20 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
             # primary — without it a stopped sibling decodes its whole
             # budget, burning slots and stalling this response.
             deadline = time.time() + 600
+            seen = {id(s): -1 for s in siblings}
             while (any(not s.done for s in siblings)
                    and time.time() < deadline):
                 if meta.stop:
                     for sib in siblings:
                         if sib.done or sib.cancel_requested:
                             continue
+                        # Decode only on new tokens (same guard as
+                        # submit_and_wait): a per-tick full decode
+                        # would be O(T²) detokenization at 200 Hz.
+                        m = len(sib.output_tokens)
+                        if m == seen[id(sib)]:
+                            continue
+                        seen[id(sib)] = m
                         sib_text = tokenizer.decode(
                             list(sib.output_tokens))
                         if openai_api.find_stop(sib_text,
@@ -248,6 +256,9 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
                     sib.error = sib.error or 'server timeout'
                     sib.cancel_requested = True
             metrics.observe_request(endpoint, request)
+            for sib in siblings:
+                # Token counters must see every choice's generation.
+                metrics.observe_request(endpoint, sib)
             failed = request.error or next(
                 (s.error for s in siblings if s.error), None)
             if failed:
@@ -466,8 +477,13 @@ def main() -> int:
     else:
         orch = orch_lib.Orchestrator(engine,
                                      decode_steps=args.decode_steps)
-    # Warm the compile caches before declaring healthy.
+    # Warm the compile caches before declaring healthy — including the
+    # logprobs decode variant, or the first logprobs request would
+    # trigger a mid-serving XLA compile that stalls every active slot.
     orch.generate([[1, 2, 3]], max_new_tokens=2)
+    orch.submit(orch_lib.Request(prompt_tokens=[1, 2, 3],
+                                 max_new_tokens=2, logprobs=1))
+    orch.run_until_drained()
     loop = ServingLoop(orch)
 
     from skypilot_tpu.infer import tokenizer as tokenizer_lib

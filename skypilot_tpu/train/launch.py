@@ -57,6 +57,16 @@ def main() -> int:
                              '(uint32 streams; native loader w/ python '
                              'fallback). Default: synthetic batches.')
     parser.add_argument('--data-workers', type=int, default=2)
+    parser.add_argument('--eval-data', default=None,
+                        help='Validation shards (same forms as --data); '
+                             'enables periodic eval-loss passes')
+    parser.add_argument('--eval-every', type=int, default=200,
+                        help='Steps between eval passes (with '
+                             '--eval-data)')
+    parser.add_argument('--eval-batches', type=int, default=8,
+                        help='Batches averaged per eval pass (a fresh '
+                             'loader each pass → the same leading '
+                             'slice of the eval set every time)')
     parser.add_argument('--data-loader', default='auto',
                         choices=['auto', 'native', 'python'],
                         help='Loader flavor; hosts must agree (the two '
@@ -165,6 +175,33 @@ def main() -> int:
             f'of seq {args.seq_len} ({type(loader).__name__}).')
         feed = data_lib.batches(loader, vocab_size=model.vocab_size)
 
+    def run_eval(state) -> float:
+        """Mean loss over the leading eval batches (fresh loader each
+        pass: deterministic slice, no epoch drift across passes)."""
+        from skypilot_tpu.train import data as data_lib
+        paths = data_lib.expand_data_arg(args.eval_data)
+        num_hosts = jax.process_count()
+        loader = data_lib.make_loader(
+            paths, batch=args.global_batch_size // num_hosts,
+            seq=args.seq_len, seed=args.seed, workers=1,
+            host_rank=jax.process_index(), num_hosts=num_hosts,
+            flavor=args.data_loader)
+        try:
+            eval_feed = data_lib.batches(loader,
+                                         vocab_size=model.vocab_size)
+            losses = []
+            for _ in range(args.eval_batches):
+                host_batch = next(eval_feed)
+                batch = {
+                    k: jax.make_array_from_process_local_data(
+                        trainer.batch_sharding, v)
+                    for k, v in host_batch.items()
+                }
+                losses.append(trainer.eval_step(state, batch))
+            return float(sum(float(l) for l in losses) / len(losses))
+        finally:
+            loader.close()
+
     tokens_per_step = args.global_batch_size * args.seq_len
     flops_per_token = dataclasses.replace(
         model, max_seq_len=args.seq_len).train_flops_per_token()
@@ -206,6 +243,21 @@ def main() -> int:
                             float(metrics['grad_norm']), 4),
                         'time': time.time(),
                     }) + '\n')
+            window_t0, window_steps = time.perf_counter(), 0
+        if args.eval_data and (step + 1) % args.eval_every == 0:
+            eval_loss = run_eval(state)
+            logger.info(f'step {step + 1} eval_loss={eval_loss:.4f} '
+                        f'({args.eval_batches} batches)')
+            if args.metrics_file and jax.process_index() == 0:
+                import json as json_lib
+                with open(args.metrics_file, 'a',
+                          encoding='utf-8') as mf:
+                    mf.write(json_lib.dumps({
+                        'step': step + 1,
+                        'eval_loss': round(eval_loss, 6),
+                        'time': time.time(),
+                    }) + '\n')
+            # Eval wall time must not pollute the throughput window.
             window_t0, window_steps = time.perf_counter(), 0
         if manager is not None and (step + 1) % args.checkpoint_every == 0:
             import orbax.checkpoint as ocp

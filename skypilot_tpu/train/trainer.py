@@ -93,6 +93,7 @@ class Trainer:
         self._batch_sharding = NamedSharding(
             self.mesh, PartitionSpec(('data', 'fsdp'), None))
         self._compiled_step = None
+        self._compiled_eval = None
 
     @property
     def batch_sharding(self) -> NamedSharding:
@@ -177,43 +178,43 @@ class Trainer:
 
     # ---- step ----
 
+    def _forward_loss(self, state: Dict[str, Any], params,
+                      batch: Dict[str, jax.Array]) -> jax.Array:
+        """The model loss for `params` — shared by the training grad
+        closure and the (grad-free) eval step."""
+        c = self.config
+        from skypilot_tpu.models import deepseek
+        from skypilot_tpu.models import moe
+        if self._lora:
+            from skypilot_tpu.train import lora as lora_lib
+            # Gradients flow only into the adapters; the base is a
+            # frozen constant inside the step.
+            params = lora_lib.merge(
+                jax.lax.stop_gradient(state['base']), params,
+                c.lora_alpha, c.lora_rank)
+        routed = self._model_lib in (moe, deepseek)
+        kwargs = {}
+        if routed:
+            # Routed-expert families: pads are excluded from routing
+            # (the loss mask — which targets count — is a separate
+            # concern); pipelined_loss_fn refuses the mask loudly.
+            kwargs['token_mask'] = batch.get('token_mask')
+        if self._n_stages > 1:
+            return self._model_lib.pipelined_loss_fn(
+                c.model, params, batch['tokens'], batch['targets'],
+                mesh=self.mesh, n_microbatches=c.n_microbatches,
+                loss_mask=batch.get('mask'), **kwargs)
+        return self._model_lib.loss_fn(c.model, params, batch['tokens'],
+                                       batch['targets'], mesh=self.mesh,
+                                       loss_mask=batch.get('mask'),
+                                       **kwargs)
+
     def _step_fn(self, state: Dict[str, Any],
                  batch: Dict[str, jax.Array]) -> Tuple[Dict[str, Any],
                                                        Dict[str, jax.Array]]:
-        c = self.config
 
         def loss_of(params):
-            from skypilot_tpu.models import deepseek
-            from skypilot_tpu.models import moe
-            if self._lora:
-                from skypilot_tpu.train import lora as lora_lib
-                # Gradients flow only into the adapters; the base is a
-                # frozen constant inside the step.
-                params = lora_lib.merge(
-                    jax.lax.stop_gradient(state['base']), params,
-                    c.lora_alpha, c.lora_rank)
-            routed = self._model_lib in (moe, deepseek)
-            if self._n_stages > 1:
-                kwargs = {}
-                if routed:
-                    # Forward the mask so moe.pipelined_loss_fn can
-                    # refuse it loudly (pads under GPipe would silently
-                    # consume expert capacity otherwise).
-                    kwargs['token_mask'] = batch.get('token_mask')
-                return self._model_lib.pipelined_loss_fn(
-                    c.model, params, batch['tokens'], batch['targets'],
-                    mesh=self.mesh, n_microbatches=c.n_microbatches,
-                    loss_mask=batch.get('mask'), **kwargs)
-            kwargs = {}
-            if routed:
-                # Routed-expert families: pads are excluded from routing;
-                # the loss mask (which targets count) is a separate
-                # concern.
-                kwargs['token_mask'] = batch.get('token_mask')
-            return self._model_lib.loss_fn(c.model, params, batch['tokens'],
-                                           batch['targets'], mesh=self.mesh,
-                                           loss_mask=batch.get('mask'),
-                                           **kwargs)
+            return self._forward_loss(state, params, batch)
 
         loss, grads = jax.value_and_grad(loss_of)(state['params'])
         updates, new_opt = self.optimizer.update(grads, state['opt_state'],
@@ -240,6 +241,24 @@ class Trainer:
 
     def step(self, state, batch):
         return self.compile_step()(state, batch)
+
+    def compile_eval(self) -> Callable:
+        """Loss-only step (no grads, no optimizer): the validation
+        pass. State is NOT donated — training continues from it."""
+        if self._compiled_eval is None:
+            shardings = self.state_shardings()
+
+            def eval_fn(state, batch):
+                return self._forward_loss(state, state['params'], batch)
+
+            self._compiled_eval = jax.jit(
+                eval_fn,
+                in_shardings=(shardings, self._batch_sharding),
+                out_shardings=None)
+        return self._compiled_eval
+
+    def eval_step(self, state, batch) -> jax.Array:
+        return self.compile_eval()(state, batch)
 
     # ---- data ----
 

@@ -93,3 +93,44 @@ def test_metrics_file_emitted(tmp_path):
         assert {'step', 'loss', 'tokens_per_sec',
                 'model_tflops_per_chip', 'grad_norm'} <= set(row)
     assert [r['step'] for r in lines] == [1, 2, 3]
+
+
+@pytest.mark.slow
+class TestEvalLoop:
+
+    def test_eval_loss_logged_and_recorded(self, tmp_path):
+        """--eval-data drives periodic grad-free eval passes: logged
+        and written to the metrics file alongside train metrics."""
+        import json
+        import numpy as np
+        shard = tmp_path / 'tok.bin'
+        np.random.default_rng(0).integers(
+            0, 256, size=4000, dtype=np.uint32).astype('<u4').tofile(
+                shard)
+        metrics_file = tmp_path / 'metrics.jsonl'
+        env = dict(os.environ, JAX_PLATFORMS='cpu',
+                   XLA_FLAGS='--xla_force_host_platform_device_count=2')
+        cmd = [
+            sys.executable, '-m', 'skypilot_tpu.train.launch',
+            '--model', 'tiny', '--global-batch-size', '2',
+            '--seq-len', '32', '--log-every', '2', '--steps', '4',
+            '--optimizer', 'adafactor',
+            '--data', str(shard), '--data-loader', 'python',
+            '--eval-data', str(shard), '--eval-every', '2',
+            '--eval-batches', '2',
+            '--metrics-file', str(metrics_file),
+        ]
+        proc = subprocess.run(cmd, env=env, capture_output=True,
+                              text=True, timeout=280)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        log = proc.stdout + proc.stderr
+        assert 'eval_loss=' in log
+        entries = [json.loads(line) for line in
+                   metrics_file.read_text().splitlines()]
+        eval_entries = [e for e in entries if 'eval_loss' in e]
+        assert len(eval_entries) == 2          # steps 2 and 4
+        assert {e['step'] for e in eval_entries} == {2, 4}
+        assert all(e['eval_loss'] > 0 for e in eval_entries)
+        # Same eval slice both times, params changed → losses differ.
+        losses = [e['eval_loss'] for e in eval_entries]
+        assert losses[0] != losses[1]
