@@ -78,6 +78,14 @@ class ServeMetrics:
             if e2e_s is not None:
                 self._e2e.observe(e2e_s)
 
+    def observe_choice_tokens(self, request) -> None:
+        """Token accounting for an n>1 sibling choice: its generated
+        tokens are real device work, but it is NOT another request —
+        counting it through observe_request would inflate request
+        counts and latency histograms n-fold."""
+        with self._lock:
+            self._generated_tokens += len(request.output_tokens)
+
     def observe_request(self, endpoint: str, request,
                         outcome: Optional[str] = None) -> None:
         """Record a finished orchestrator Request. Pass `outcome`

@@ -257,8 +257,10 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
                     sib.cancel_requested = True
             metrics.observe_request(endpoint, request)
             for sib in siblings:
-                # Token counters must see every choice's generation.
-                metrics.observe_request(endpoint, sib)
+                # Token counters must see every choice's generation
+                # (but one HTTP request stays ONE request in the
+                # count/latency series).
+                metrics.observe_choice_tokens(sib)
             failed = request.error or next(
                 (s.error for s in siblings if s.error), None)
             if failed:

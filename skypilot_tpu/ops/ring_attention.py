@@ -34,15 +34,73 @@ from skypilot_tpu.ops.attention import _repeat_kv
 _NEG_INF = -1e30  # finite: keeps online-softmax free of NaN on masked rows
 
 
+DEFAULT_BLOCK_Q = 512
+
+
+def _chunked_attend(q, kb, vb, o, l, m, scale: float, block_q: int,
+                    q_pos=None, k_pos=None):
+    """Online-softmax update of (o, l, m) with one K/V block, walking q
+    in chunks so the logits transient is O(block_q · S_kv) instead of
+    O(S² ) — the difference between ring attention scaling to long
+    contexts and OOMing on its own scratch. q [B,S,H,D];
+    kb/vb [B,Sk,H,D]; o [B,H,S,D] fp32; l/m [B,H,S] fp32; q_pos/k_pos
+    enable the causal mask (diagonal block only).
+    """
+    s = q.shape[1]
+    n_chunks = s // block_q
+
+    def chunk_step(carry, ci):
+        o, l, m = carry
+        start = ci * block_q
+        qs = jax.lax.dynamic_slice_in_dim(q, start, block_q, axis=1)
+        logits = jnp.einsum('bqhd,bkhd->bhqk', qs, kb,
+                            preferred_element_type=jnp.float32) * scale
+        if q_pos is not None:
+            qp = jax.lax.dynamic_slice_in_dim(q_pos, start, block_q, 0)
+            mask = qp[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask[None, None], logits, _NEG_INF)
+        m_prev = jax.lax.dynamic_slice_in_dim(m, start, block_q, axis=2)
+        l_prev = jax.lax.dynamic_slice_in_dim(l, start, block_q, axis=2)
+        o_prev = jax.lax.dynamic_slice_in_dim(o, start, block_q, axis=2)
+        m_new = jnp.maximum(m_prev, logits.max(axis=-1))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(logits - m_new[..., None])
+        l_new = l_prev * corr + p.sum(axis=-1)
+        # P in bf16 onto the MXU (fp32 accumulation via
+        # preferred_element_type) — the fp32 P×V einsum doubled the
+        # dominant matmul's input traffic for no accuracy gain.
+        o_new = o_prev * corr[..., None] + jnp.einsum(
+            'bhqk,bkhd->bhqd', p.astype(vb.dtype), vb,
+            preferred_element_type=jnp.float32)
+        o = jax.lax.dynamic_update_slice_in_dim(o, o_new, start, axis=2)
+        l = jax.lax.dynamic_update_slice_in_dim(l, l_new, start, axis=2)
+        m = jax.lax.dynamic_update_slice_in_dim(m, m_new, start, axis=2)
+        return (o, l, m), None
+
+    (o, l, m), _ = jax.lax.scan(chunk_step, (o, l, m),
+                                jnp.arange(n_chunks))
+    return o, l, m
+
+
 def ring_attention_local(q: jax.Array,
                          k: jax.Array,
                          v: jax.Array,
                          axis_name: str = 'sequence',
-                         causal: bool = True) -> jax.Array:
+                         causal: bool = True,
+                         block_q: int = DEFAULT_BLOCK_Q) -> jax.Array:
     """Ring attention body — call inside shard_map over `axis_name`.
 
     q: [B, S_local, H, D]; k/v: [B, S_local, Hkv, D] (GQA ok). The device's
     shard covers global positions [idx*S_local, (idx+1)*S_local).
+
+    Schedule: the diagonal block runs first (statically causal-masked,
+    so the finite _NEG_INF trick stays exact), then size-1 ring hops.
+    Under causality a hop's block is either fully visible (source rank
+    below this device) or fully dead (above it) — dead hops skip ALL
+    compute via lax.cond (the scalar core branches per device; only
+    the ppermute still runs to keep the ring rotating), which halves
+    the causal FLOPs the previous revision spent exp()-ing fully
+    masked logits.
     """
     size = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -51,38 +109,46 @@ def ring_attention_local(q: jax.Array,
     v = _repeat_kv(v, groups)
     b, s, h, d = q.shape
     scale = d ** -0.5
-    q_pos = idx * s + jnp.arange(s)
+    block_q = min(block_q, s)
+    while s % block_q:
+        # Largest divisor of s that fits: falling back to block_q = s
+        # would silently reinstate the O(S_local²) logits transient
+        # chunking exists to avoid.
+        block_q -= 1
+    positions = jnp.arange(s)
 
     o0 = jnp.zeros((b, h, s, d), jnp.float32)
     l0 = jnp.zeros((b, h, s), jnp.float32)
     m0 = jnp.full((b, h, s), _NEG_INF, jnp.float32)
     perm = [(j, (j + 1) % size) for j in range(size)]
 
+    # Diagonal block (statically i == 0 on every device).
+    olm = _chunked_attend(q, k, v, o0, l0, m0, scale, block_q,
+                          q_pos=positions if causal else None,
+                          k_pos=positions if causal else None)
+    kb = jax.lax.ppermute(k, axis_name, perm)
+    vb = jax.lax.ppermute(v, axis_name, perm)
+
     def step(carry, i):
-        o, l, m, kb, vb = carry
-        # Step i holds the block originally on device (idx - i) % size;
-        # step 0 is the diagonal block, so every causal row sees at least
-        # its own key before any fully-masked block arrives (keeps the
-        # finite _NEG_INF trick exact).
-        src = (idx - i) % size
-        logits = jnp.einsum('bqhd,bkhd->bhqk', q, kb,
-                            preferred_element_type=jnp.float32) * scale
+        olm, kb, vb = carry
         if causal:
-            k_pos = src * s + jnp.arange(s)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            logits = jnp.where(mask[None, None], logits, _NEG_INF)
-        m_new = jnp.maximum(m, logits.max(axis=-1))
-        corr = jnp.exp(m - m_new)
-        p = jnp.exp(logits - m_new[..., None])
-        l = l * corr + p.sum(axis=-1)
-        o = o * corr[..., None] + jnp.einsum(
-            'bhqk,bkhd->bhqd', p, vb.astype(jnp.float32))
+            # Hop i holds rank (idx - i) % size's block: visible iff
+            # that rank is below this device — i.e. idx >= i.
+            olm = jax.lax.cond(
+                idx >= i,
+                lambda olm: _chunked_attend(q, kb, vb, *olm, scale,
+                                            block_q),
+                lambda olm: olm,
+                olm)
+        else:
+            olm = _chunked_attend(q, kb, vb, *olm, scale, block_q)
         kb = jax.lax.ppermute(kb, axis_name, perm)
         vb = jax.lax.ppermute(vb, axis_name, perm)
-        return (o, l, m_new, kb, vb), None
+        return (olm, kb, vb), None
 
-    (o, l, _, _, _), _ = jax.lax.scan(
-        step, (o0, l0, m0, k, v), jnp.arange(size))
+    (olm, _, _), _ = jax.lax.scan(step, (olm, kb, vb),
+                                  jnp.arange(1, size))
+    o, l, _ = olm
     out = o / jnp.maximum(l[..., None], 1e-30)
     return out.transpose(0, 2, 1, 3).astype(v.dtype)
 

@@ -175,6 +175,14 @@ def main() -> int:
             f'of seq {args.seq_len} ({type(loader).__name__}).')
         feed = data_lib.batches(loader, vocab_size=model.vocab_size)
 
+    if args.eval_data and args.global_batch_size % jax.process_count():
+        # Fail at launch, not hundreds of steps in when the first eval
+        # fires (the --data path has the same guard; synthetic-train +
+        # --eval-data runs would otherwise skip it).
+        raise ValueError(
+            f'global batch {args.global_batch_size} not divisible by '
+            f'{jax.process_count()} hosts (required for --eval-data).')
+
     def run_eval(state) -> float:
         """Mean loss over the leading eval batches (fresh loader each
         pass: deterministic slice, no epoch drift across passes)."""

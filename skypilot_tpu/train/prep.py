@@ -28,8 +28,15 @@ from skypilot_tpu.infer import tokenizer as tokenizer_lib
 
 
 def prep_files(paths: List[str], out: str, tokenizer,
-               append_eos: bool = True) -> dict:
-    """Tokenize `paths` into one shard at `out`; returns a summary."""
+               append_eos: bool = True,
+               vocab_size: int = 0) -> dict:
+    """Tokenize `paths` into one shard at `out`; returns a summary.
+
+    With vocab_size > 0, ids outside the model vocab fail fast: the
+    training loader clamps out-of-range ids silently (data.batches'
+    vocab guard), so an HF tokenizer larger than the model's embedding
+    would otherwise corrupt the corpus with no error anywhere.
+    """
     n_tokens = 0
     n_docs = 0
     eos = getattr(tokenizer, 'eos_token_id', None)
@@ -43,6 +50,12 @@ def prep_files(paths: List[str], out: str, tokenizer,
             if append_eos and eos is not None:
                 tokens = list(tokens) + [eos]
             arr = np.asarray(tokens, dtype=np.uint32)
+            if vocab_size and int(arr.max()) >= vocab_size:
+                raise ValueError(
+                    f'{path}: token id {int(arr.max())} >= model vocab '
+                    f'{vocab_size} — this tokenizer does not fit the '
+                    'target model (the loader would silently clamp '
+                    'these ids at training time).')
             arr.astype('<u4').tofile(sink)
             n_tokens += arr.size
             n_docs += 1
@@ -67,7 +80,8 @@ def main(argv=None) -> int:
     tokenizer = tokenizer_lib.get_tokenizer(args.tokenizer,
                                             args.vocab_size)
     summary = prep_files(args.inputs, args.out, tokenizer,
-                         append_eos=not args.no_eos)
+                         append_eos=not args.no_eos,
+                         vocab_size=args.vocab_size)
     print(json.dumps(summary))
     return 0
 
