@@ -267,6 +267,13 @@ class InferenceEngine:
             'lengths': jnp.zeros((cfg.max_slots,), jnp.int32),
             'tokens': jnp.zeros((cfg.max_slots,), jnp.int32),
             'active': jnp.zeros((cfg.max_slots,), jnp.bool_),
+            # per-slot generated-token counts (uint8 saturating: the
+            # penalty semantics only need "appeared" + a magnitude;
+            # int32 would cost 4x the HBM on a 128k vocab). Only
+            # maintained by penalized decode variants — stale rows are
+            # harmless because non-penalized slots multiply them by 0.
+            'counts': jnp.zeros((cfg.max_slots,
+                                 cfg.model.vocab_size), jnp.uint8),
         }
         return state
 
@@ -482,6 +489,8 @@ class InferenceEngine:
         state['lengths'] = state['lengths'].at[slot].set(true_len)
         state['tokens'] = state['tokens'].at[slot].set(first_token)
         state['active'] = state['active'].at[slot].set(True)
+        state['counts'] = (state['counts'].at[slot].set(0)
+                           .at[slot, first_token].set(1))
         return state
 
     def insert(self, state, kv, first_token, true_len: int, slot: int):
@@ -496,20 +505,38 @@ class InferenceEngine:
     # ---- decode ----
 
     def _decode_step_impl(self, params, state, temperatures, top_k,
-                          top_p, key, logprobs_k: int = 0):
+                          top_p, key, logprobs_k: int = 0,
+                          penalties=None):
         """Per-slot sampling params [slots] (temp 0 → greedy, top_k 0 /
         top_p 1 → filter off); all traced — no value-dependent recompiles
         mid-serving. params is a traced argument: closing over self.params
         would bake 2+ GB of weights into the lowered program as
-        constants."""
+        constants. `penalties` = (presence [slots], frequency [slots])
+        enables the OpenAI repetition penalties (a distinct compiled
+        variant: the [slots, vocab] count ops stay out of the common
+        path)."""
         c = self.config.model
         kv = {'k': state['kv_k'], 'v': state['kv_v']}
         logits, new_kv = self._model_lib.decode_forward(
             c, params, state['tokens'], state['lengths'], kv,
             mesh=self.mesh)
+        counts = state['counts']
+        if penalties is not None:
+            presence, frequency = penalties
+            cnt = counts.astype(jnp.float32)
+            logits = (logits
+                      - presence[:, None] * (cnt > 0)
+                      - frequency[:, None] * cnt)
         next_tokens = sampling.sample_batched(logits, key, temperatures,
                                               top_k, top_p)
         lp = _logprobs_info(logits, next_tokens, logprobs_k)
+        if penalties is not None:
+            # Saturating add at uint8 max; inactive slots excluded.
+            slots_idx = jnp.arange(counts.shape[0])
+            cur = counts[slots_idx, next_tokens]
+            bump = jnp.where(state['active'] & (cur < 255), 1,
+                             0).astype(jnp.uint8)
+            counts = counts.at[slots_idx, next_tokens].add(bump)
         # Inactive slots hold position (their garbage writes are confined
         # to their own slot rows and overwritten on insert). Lengths cap
         # at the KV budget: a finished slot kept stepping in a fused
@@ -526,20 +553,21 @@ class InferenceEngine:
             'tokens': jnp.where(state['active'], next_tokens,
                                 state['tokens']),
             'active': state['active'],
+            'counts': counts,
         }
         return state, (next_tokens, lp)
 
     @functools.partial(jax.jit, static_argnums=(0, 7),
                        donate_argnums=(2,))
     def _decode_step(self, params, state, temperatures, top_k, top_p,
-                     key, logprobs_k: int = 0):
+                     key, logprobs_k: int = 0, penalties=None):
         return self._decode_step_impl(params, state, temperatures, top_k,
-                                      top_p, key, logprobs_k)
+                                      top_p, key, logprobs_k, penalties)
 
     @functools.partial(jax.jit, static_argnums=(0, 6, 8),
                        donate_argnums=(2,))
     def _decode_steps(self, params, state, temperatures, top_k, top_p,
-                      n: int, key, logprobs_k: int = 0):
+                      n: int, key, logprobs_k: int = 0, penalties=None):
         """n fused decode steps under one dispatch (lax.scan).
 
         One host↔device round trip per n tokens instead of per token —
@@ -555,7 +583,7 @@ class InferenceEngine:
         def body(state, step_key):
             return self._decode_step_impl(params, state, temperatures,
                                           top_k, top_p, step_key,
-                                          logprobs_k)
+                                          logprobs_k, penalties)
 
         return jax.lax.scan(body, state, jax.random.split(key, n))
 
@@ -617,6 +645,10 @@ class InferenceEngine:
             'tokens': jnp.where(state['active'], bonus,
                                 state['tokens']),
             'active': state['active'],
+            # Not updated: speculation only runs rounds where no slot
+            # is penalized (the orchestrator falls back otherwise),
+            # and stale counts are neutral at penalty 0.
+            'counts': state['counts'],
         }
         return state, emitted, n_emitted
 
@@ -642,13 +674,14 @@ class InferenceEngine:
 
     def decode_steps(self, state, n: int, temperatures=None, top_k=None,
                      top_p=None, key: Optional[jax.Array] = None,
-                     logprobs_k: int = 0):
+                     logprobs_k: int = 0, penalties=None):
         """Advance every slot n tokens in one dispatch.
 
         Returns (state, tokens [n, slots]) — or (state, tokens, lp)
         with lp = (chosen [n, slots], top_vals [n, slots, k], top_ids)
-        when logprobs_k > 0. See _decode_steps for the latency
-        rationale and mid-batch-finish semantics.
+        when logprobs_k > 0. `penalties` = (presence [slots],
+        frequency [slots]) per-slot arrays (0 = off). See _decode_steps
+        for the latency rationale and mid-batch-finish semantics.
         """
         temperatures, top_k, top_p = self._norm_sampling(temperatures,
                                                          top_k, top_p)
@@ -656,10 +689,17 @@ class InferenceEngine:
             self._key, key = jax.random.split(self._key)
         state, (tokens, lp) = self._decode_steps(
             self.params, state, temperatures, top_k, top_p, n, key,
-            logprobs_k)
+            logprobs_k, self._norm_penalties(penalties))
         if logprobs_k > 0:
             return state, tokens, lp
         return state, tokens
+
+    def _norm_penalties(self, penalties):
+        if penalties is None:
+            return None
+        presence, frequency = penalties
+        return (jnp.asarray(presence, jnp.float32),
+                jnp.asarray(frequency, jnp.float32))
 
     def _norm_sampling(self, temperatures, top_k, top_p):
         import numpy as np
@@ -684,15 +724,15 @@ class InferenceEngine:
 
     def decode_step(self, state, temperatures=None, top_k=None,
                     top_p=None, key: Optional[jax.Array] = None,
-                    logprobs_k: int = 0):
+                    logprobs_k: int = 0, penalties=None):
         """Advance every slot one token. Returns (state, tokens [slots])
         — or (state, tokens, lp) when logprobs_k > 0.
 
         Per-slot arrays [max_slots]: temperatures (0 = greedy), top_k
-        (0 = off), top_p (1 = off); None means disabled for all slots.
-        Mixed greedy/sampled batches are correct per slot. If `key` is
-        omitted, an engine-owned key is split per call so repeated steps
-        never reuse PRNG state.
+        (0 = off), top_p (1 = off), penalties = (presence, frequency)
+        (0 = off); None means disabled for all slots. Mixed batches are
+        correct per slot. If `key` is omitted, an engine-owned key is
+        split per call so repeated steps never reuse PRNG state.
         """
         temperatures, top_k, top_p = self._norm_sampling(temperatures,
                                                          top_k, top_p)
@@ -700,7 +740,7 @@ class InferenceEngine:
             self._key, key = jax.random.split(self._key)
         state, (tokens, lp) = self._decode_step(
             self.params, state, temperatures, top_k, top_p, key,
-            logprobs_k)
+            logprobs_k, self._norm_penalties(penalties))
         if logprobs_k > 0:
             return state, tokens, lp
         return state, tokens

@@ -79,11 +79,13 @@ class ServeMetrics:
                 self._e2e.observe(e2e_s)
 
     def observe_choice_tokens(self, request) -> None:
-        """Token accounting for an n>1 sibling choice: its generated
-        tokens are real device work, but it is NOT another request —
-        counting it through observe_request would inflate request
-        counts and latency histograms n-fold."""
+        """Token accounting for an n>1 sibling choice: its prompt AND
+        generated tokens are real device work (each sibling prefills),
+        but it is NOT another request — counting it through
+        observe_request would inflate request counts and latency
+        histograms n-fold."""
         with self._lock:
+            self._prompt_tokens += len(request.prompt_tokens)
             self._generated_tokens += len(request.output_tokens)
 
     def observe_request(self, endpoint: str, request,

@@ -151,8 +151,15 @@ def build_request(body: Dict[str, Any], tokenizer: Any,
         temperature = float(body.get('temperature', 1.0))
         top_p = float(body.get('top_p', 1.0))
         top_k = int(body.get('top_k', 0))
+        presence = float(body.get('presence_penalty', 0.0))
+        frequency = float(body.get('frequency_penalty', 0.0))
     except (TypeError, ValueError):
-        raise ApiError(400, 'temperature/top_p/top_k must be numbers')
+        raise ApiError(400, 'temperature/top_p/top_k/penalties must '
+                            'be numbers')
+    for name, value in (('presence_penalty', presence),
+                        ('frequency_penalty', frequency)):
+        if not -2.0 <= value <= 2.0:
+            raise ApiError(400, f"'{name}' must be in [-2, 2]")
 
     request = orch_lib.Request(
         prompt_tokens=prompt_tokens,
@@ -161,6 +168,8 @@ def build_request(body: Dict[str, Any], tokenizer: Any,
         temperature=temperature,
         top_k=top_k,
         top_p=top_p,
+        presence_penalty=presence,
+        frequency_penalty=frequency,
         # The orchestrator records max(alts, 1) alternatives; the
         # response builder slices down to the exact requested count.
         logprobs=0 if logprobs is None else max(logprobs, 1))
@@ -221,6 +230,8 @@ def clone_request(request: orch_lib.Request) -> orch_lib.Request:
         temperature=request.temperature,
         top_k=request.top_k,
         top_p=request.top_p,
+        presence_penalty=request.presence_penalty,
+        frequency_penalty=request.frequency_penalty,
         logprobs=request.logprobs)
 
 

@@ -41,6 +41,11 @@ class Request:
     # 0 = off; 1..LOGPROBS_K = record each generated token's logprob
     # plus that many top alternatives per step:
     logprobs: int = 0
+    # OpenAI repetition penalties over this request's GENERATED tokens
+    # (0 = off): presence subtracts once per seen token, frequency per
+    # occurrence.
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
     # set by the caller (any thread) to stop generation early — e.g. a
     # stop-sequence hit or client disconnect in the streaming API; the
     # orchestrator honors it at the next token boundary:
@@ -191,17 +196,23 @@ class Orchestrator:
         temps = np.zeros((slots,), np.float32)
         top_k = np.zeros((slots,), np.int32)
         top_p = np.ones((slots,), np.float32)
+        pres = np.zeros((slots,), np.float32)
+        freq = np.zeros((slots,), np.float32)
         for slot, request in self._slot_req.items():
             temps[slot] = request.temperature
             top_k[slot] = request.top_k
             top_p[slot] = request.top_p
+            pres[slot] = request.presence_penalty
+            freq[slot] = request.frequency_penalty
         self._key, step_key = jax.random.split(self._key)
         k = (LOGPROBS_K if any(r.logprobs
                                for r in self._slot_req.values()) else 0)
+        penalties = ((pres, freq) if (pres.any() or freq.any())
+                     else None)
         if self.decode_steps == 1:
             out = self.engine.decode_step(
                 self.state, temperatures=temps, top_k=top_k, top_p=top_p,
-                key=step_key, logprobs_k=k)
+                key=step_key, logprobs_k=k, penalties=penalties)
             self.state, tokens = out[0], out[1]
             batches = np.asarray(jax.device_get(tokens))[None, :]
             lp = tuple(np.asarray(jax.device_get(a))[None]
@@ -209,7 +220,8 @@ class Orchestrator:
         else:
             out = self.engine.decode_steps(
                 self.state, self.decode_steps, temperatures=temps,
-                top_k=top_k, top_p=top_p, key=step_key, logprobs_k=k)
+                top_k=top_k, top_p=top_p, key=step_key, logprobs_k=k,
+                penalties=penalties)
             self.state, tokens = out[0], out[1]
             batches = np.asarray(jax.device_get(tokens))    # [n, slots]
             lp = tuple(np.asarray(jax.device_get(a))
@@ -369,10 +381,13 @@ class SpeculativeOrchestrator(Orchestrator):
         if not self._slot_req:
             return
         all_greedy = all(r.temperature == 0.0 and not r.logprobs
+                         and not r.presence_penalty
+                         and not r.frequency_penalty
                          for r in self._slot_req.values())
         if not all_greedy:
-            # Mixed batch (sampled slots, or slots wanting logprobs —
-            # verify_forward does not surface per-token logprobs):
+            # Mixed batch (sampled slots, slots wanting logprobs —
+            # verify_forward does not surface per-token logprobs — or
+            # penalized slots, whose counts only plain rounds update):
             # plain round; keep the draft's bookkeeping aligned (cache
             # rows for these tokens are missing in the draft —
             # acceptance pays, not correctness).

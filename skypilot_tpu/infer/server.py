@@ -106,7 +106,11 @@ class ServingLoop:
 
 def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
                   tokenizer=None, model_id: str = 'model',
-                  metrics=None):
+                  metrics=None, max_queue: int = 0):
+    """max_queue > 0 sheds load: requests beyond that many pending
+    admissions get 429 instead of unbounded queueing (an overloaded
+    replica should fail fast so the serve LB retries a healthier one).
+    """
     from skypilot_tpu.infer import metrics as metrics_lib
     from skypilot_tpu.infer import openai_api
     if metrics is None:
@@ -144,6 +148,12 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
                 self._json(404, {'error': 'not found'})
 
         def do_POST(self):  # noqa: N802
+            if max_queue and loop.orch._pending.qsize() >= max_queue:
+                self._json(429, {'error': {
+                    'message': 'server overloaded: admission queue is '
+                               'full, retry another replica',
+                    'type': 'overloaded_error'}})
+                return
             if self.path == '/generate':
                 self._generate()
             elif self.path == '/v1/completions':
@@ -405,6 +415,10 @@ def main() -> int:
     parser.add_argument('--model-id', default=None,
                         help='Model id reported by /v1/models '
                              '(default: --model)')
+    parser.add_argument('--max-queue', type=int, default=64,
+                        help='Pending-admission cap: beyond this the '
+                             'replica sheds load with 429 so the serve '
+                             'LB retries elsewhere. 0 = unbounded.')
     parser.add_argument('--decode-steps', type=int, default=4,
                         help='Decode steps fused per device dispatch '
                              '(amortizes dispatch latency; streaming '
@@ -500,7 +514,8 @@ def main() -> int:
     server = ThreadingHTTPServer(
         ('0.0.0.0', args.port),
         build_handler(loop, config, tokenizer=tokenizer,
-                      model_id=args.model_id or args.model))
+                      model_id=args.model_id or args.model,
+                      max_queue=args.max_queue))
     logger.info(f'Serving on :{args.port}')
     server.serve_forever()
     return 0

@@ -50,6 +50,8 @@ def prep_files(paths: List[str], out: str, tokenizer,
             if append_eos and eos is not None:
                 tokens = list(tokens) + [eos]
             arr = np.asarray(tokens, dtype=np.uint32)
+            if arr.size == 0:
+                continue   # text normalized/encoded to nothing
             if vocab_size and int(arr.max()) >= vocab_size:
                 raise ValueError(
                     f'{path}: token id {int(arr.max())} >= model vocab '

@@ -556,3 +556,56 @@ class TestLogprobsAndN:
         assert len(lp['token_logprobs']) == len(lp['tokens'])
         assert all(off <= len(choice['text'])
                    for off in lp['text_offset'])
+
+
+def test_penalties_parsed_and_validated():
+    tok = tokenizer_lib.ByteTokenizer(512)
+    config = engine_lib.EngineConfig(model=llama.LLAMA_TINY,
+                                     max_slots=4, max_target_len=64,
+                                     prefill_buckets=(16, 32))
+    request, _ = openai_api.build_request(
+        {'prompt': 'x', 'presence_penalty': 0.5,
+         'frequency_penalty': -0.25}, tok, config, 'm', chat=False)
+    assert request.presence_penalty == 0.5
+    assert request.frequency_penalty == -0.25
+    with pytest.raises(openai_api.ApiError, match=r'\[-2, 2\]'):
+        openai_api.build_request(
+            {'prompt': 'x', 'presence_penalty': 3.0}, tok, config,
+            'm', chat=False)
+    sib = openai_api.clone_request(request)
+    assert sib.presence_penalty == 0.5
+    assert sib.frequency_penalty == -0.25
+
+
+def test_max_queue_sheds_load():
+    """A full admission queue returns 429 instead of queueing forever."""
+    import queue as queue_mod
+
+    class FakeOrch:
+        _pending = queue_mod.Queue()
+        class engine:  # noqa: N801 — minimal attribute surface
+            prefix_cache_stats = None
+        _slot_req: dict = {}
+        _free_slots: list = []
+
+        def _admit_limit(self):
+            return 63
+
+    class FakeLoop:
+        orch = FakeOrch()
+
+    for _ in range(4):
+        FakeLoop.orch._pending.put(object())
+    handler_cls = server_lib.build_handler(
+        FakeLoop(), engine_lib.EngineConfig(model=llama.LLAMA_TINY),
+        tokenizer=tokenizer_lib.ByteTokenizer(512), max_queue=4)
+    httpd = ThreadingHTTPServer(('127.0.0.1', 0), handler_cls)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f'http://127.0.0.1:{httpd.server_address[1]}'
+    try:
+        status, payload = _post(url, '/v1/completions',
+                                {'prompt': 'x', 'max_tokens': 2})
+        assert status == 429
+        assert payload['error']['type'] == 'overloaded_error'
+    finally:
+        httpd.shutdown()
