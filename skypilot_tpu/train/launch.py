@@ -50,6 +50,10 @@ def main() -> int:
     parser.add_argument('--seq-len', type=int, default=2048)
     parser.add_argument('--steps', type=int, default=100)
     parser.add_argument('--n-microbatches', type=int, default=4)
+    parser.add_argument('--accum-steps', type=int, default=1,
+                        help='Gradient-accumulation microbatches per '
+                             'optimizer step (activation memory drops '
+                             'to one microbatch)')
     parser.add_argument('--optimizer', default='adamw')
     parser.add_argument('--learning-rate', type=float, default=3e-4)
     parser.add_argument('--data', default=None,
@@ -121,6 +125,7 @@ def main() -> int:
         optimizer=args.optimizer,
         learning_rate=args.learning_rate,
         n_microbatches=args.n_microbatches,
+        accum_steps=args.accum_steps,
         lora_rank=args.lora_rank,
         lora_alpha=args.lora_alpha,
         lora_targets=tuple(t.strip() for t in args.lora_targets.split(',')

@@ -36,6 +36,12 @@ class TrainConfig:
     grad_clip_norm: float = 1.0
     optimizer: str = 'adamw'   # 'adamw' | 'adafactor'
     n_microbatches: int = 4    # GPipe microbatches when mesh stage > 1
+    # > 1: gradient accumulation — the step scans that many
+    # microbatches (activation memory drops to one microbatch's worth)
+    # and applies ONE averaged optimizer update, so a small-HBM chip
+    # trains at large effective batch. Microbatch rows are strided so
+    # every data shard stays balanced.
+    accum_steps: int = 1
     seed: int = 0
     # LoRA fine-tuning: rank 0 = full fine-tune; rank > 0 freezes the
     # base weights (held outside the optimizer) and trains only A/B
@@ -63,8 +69,24 @@ class Trainer:
     def __init__(self, config: TrainConfig,
                  mesh: Optional[mesh_lib.Mesh] = None) -> None:
         self.config = config
+        if config.accum_steps < 1:
+            raise ValueError(f'accum_steps must be >= 1, got '
+                             f'{config.accum_steps}')
+        if config.global_batch_size % config.accum_steps:
+            raise ValueError(
+                f'global_batch_size {config.global_batch_size} not '
+                f'divisible by accum_steps {config.accum_steps}')
         self.mesh = mesh if mesh is not None else mesh_lib.build_mesh(
             config.mesh_plan)
+        if (config.accum_steps > 1
+                and int(self.mesh.shape.get('stage', 1)) > 1
+                and (config.global_batch_size // config.accum_steps)
+                % config.n_microbatches):
+            raise ValueError(
+                f'Each accumulation microbatch '
+                f'({config.global_batch_size} // {config.accum_steps} '
+                f'rows) must divide into n_microbatches='
+                f'{config.n_microbatches} for the GPipe schedule.')
         self.optimizer = make_optimizer(config)
         self._model_lib = models.module_for(config.model)
         self._n_stages = int(self.mesh.shape.get('stage', 1))
@@ -212,11 +234,55 @@ class Trainer:
     def _step_fn(self, state: Dict[str, Any],
                  batch: Dict[str, jax.Array]) -> Tuple[Dict[str, Any],
                                                        Dict[str, jax.Array]]:
+        accum = self.config.accum_steps
+        if accum > 1:
+            # [GB, ...] → [A, GB/A, ...] with STRIDED rows (reshape +
+            # swap): microbatch i holds rows {i, A+i, 2A+i, …}, so a
+            # data-sharded batch stays balanced across devices within
+            # every microbatch.
+            micro = {
+                k: v.reshape((v.shape[0] // accum, accum) +
+                             v.shape[1:]).swapaxes(0, 1)
+                for k, v in batch.items()
+            }
 
-        def loss_of(params):
-            return self._forward_loss(state, params, batch)
+            def one(carry, mb):
+                g_acc, l_acc, w_acc = carry
+                loss, grads = jax.value_and_grad(
+                    lambda p: self._forward_loss(state, p, mb))(
+                        state['params'])
+                # The family loss is a (mask-)weighted MEAN per
+                # microbatch; combining microbatches must weight by
+                # their token counts or an unbalanced mask (packed/SFT
+                # data) silently reweights gradients vs accum=1.
+                if 'mask' in mb:
+                    w = jnp.sum(mb['mask']).astype(jnp.float32)
+                else:
+                    w = jnp.float32(mb['tokens'].shape[0] *
+                                    mb['tokens'].shape[1])
+                g_acc = jax.tree.map(
+                    lambda a, g: a + w * g.astype(jnp.float32),
+                    g_acc, grads)
+                return (g_acc, l_acc + w * loss, w_acc + w), None
 
-        loss, grads = jax.value_and_grad(loss_of)(state['params'])
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32),
+                state['params'])
+            (g_sum, l_sum, w_sum), _ = jax.lax.scan(
+                one, (zeros, jnp.float32(0), jnp.float32(0)), micro)
+            # Back to the param dtype: f32 grads against a bf16-typed
+            # optimizer state would silently re-trace the step and
+            # double the second-moment HBM.
+            grads = jax.tree.map(
+                lambda g, p: (g / w_sum).astype(p.dtype),
+                g_sum, state['params'])
+            loss = l_sum / w_sum
+        else:
+
+            def loss_of(params):
+                return self._forward_loss(state, params, batch)
+
+            loss, grads = jax.value_and_grad(loss_of)(state['params'])
         updates, new_opt = self.optimizer.update(grads, state['opt_state'],
                                                  state['params'])
         new_params = optax.apply_updates(state['params'], updates)

@@ -219,3 +219,72 @@ class TestPackedSequences:
         gnorm = jax.tree_util.tree_reduce(
             lambda a, g: a + float(jnp.abs(g).sum()), grads, 0.0)
         assert gnorm > 0
+
+
+class TestGradAccumulation:
+
+    def _trainer(self, accum):
+        config = trainer_lib.TrainConfig(
+            model=llama.LLAMA_TINY, global_batch_size=4, seq_len=16,
+            optimizer='adafactor', accum_steps=accum,
+            mesh_plan=mesh_lib.MeshPlan(data=1))
+        return trainer_lib.Trainer(
+            config, mesh=mesh_lib.build_mesh(
+                mesh_lib.MeshPlan(data=1).resolve(1),
+                devices=jax.devices()[:1]))
+
+    def test_accum_matches_single_step(self):
+        """accum_steps=2 over the same global batch must produce the
+        same loss and (numerically) the same updated params as one
+        unaccumulated step."""
+        t1, t2 = self._trainer(1), self._trainer(2)
+        batch = t1.synthetic_batch()
+        s1, m1 = t1.step(t1.init_state(), dict(batch))
+        s2, m2 = t2.step(t2.init_state(), dict(batch))
+        assert float(m1['loss']) == pytest.approx(float(m2['loss']),
+                                                  rel=1e-5)
+        flat1 = jax.tree_util.tree_leaves(s1['params'])
+        flat2 = jax.tree_util.tree_leaves(s2['params'])
+        for a, b in zip(flat1, flat2):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=5e-3)
+
+    def test_accum_validation(self):
+        def build(**kwargs):
+            return trainer_lib.Trainer(
+                trainer_lib.TrainConfig(
+                    model=llama.LLAMA_TINY, global_batch_size=4,
+                    seq_len=16, mesh_plan=mesh_lib.MeshPlan(data=1),
+                    **kwargs),
+                mesh=mesh_lib.build_mesh(
+                    mesh_lib.MeshPlan(data=1).resolve(1),
+                    devices=jax.devices()[:1]))
+
+        with pytest.raises(ValueError, match='divisible'):
+            build(accum_steps=3)
+        with pytest.raises(ValueError, match='>= 1'):
+            build(accum_steps=0)
+
+    def test_accum_weighted_mask_matches_unaccumulated(self):
+        """An unbalanced loss mask must produce the same loss under
+        accumulation as in one step (token-weighted combination)."""
+        t1, t2 = self._trainer(1), self._trainer(2)
+        batch = t1.synthetic_batch()
+        mask = np.ones((4, 16), np.float32)
+        mask[0, 4:] = 0.0            # row 0 nearly all masked
+        batch = dict(batch, mask=jnp.asarray(mask))
+        _, m1 = t1.step(t1.init_state(), dict(batch))
+        _, m2 = t2.step(t2.init_state(), dict(batch))
+        assert float(m1['loss']) == pytest.approx(float(m2['loss']),
+                                                  rel=1e-5)
+
+    def test_accum_on_data_sharded_mesh(self):
+        """Strided microbatching keeps every data shard populated."""
+        config = trainer_lib.TrainConfig(
+            model=llama.LLAMA_TINY, global_batch_size=8, seq_len=16,
+            optimizer='adafactor', accum_steps=2,
+            mesh_plan=mesh_lib.MeshPlan(data=4, tensor=2))
+        tr = trainer_lib.Trainer(config)
+        state, metrics = tr.step(tr.init_state(), tr.synthetic_batch())
+        assert np.isfinite(float(metrics['loss']))
