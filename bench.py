@@ -258,6 +258,8 @@ def serve_main() -> None:
         'model': model_tag,
         'num_requests': n_req,
         'max_slots': slots,
+        'decode_steps': orch.decode_steps,
+        'weight_dtype': quant or 'bf16',
     }
     print(json.dumps(result))
 

@@ -270,13 +270,17 @@ class Trainer:
                 state['params'])
             (g_sum, l_sum, w_sum), _ = jax.lax.scan(
                 one, (zeros, jnp.float32(0), jnp.float32(0)), micro)
+            # Same zero guard as the family loss (_chunked_ce): a
+            # fully-masked batch must be a harmless zero-gradient
+            # step, not a NaN that destroys the params.
+            w_safe = jnp.maximum(w_sum, 1.0)
             # Back to the param dtype: f32 grads against a bf16-typed
             # optimizer state would silently re-trace the step and
             # double the second-moment HBM.
             grads = jax.tree.map(
-                lambda g, p: (g / w_sum).astype(p.dtype),
+                lambda g, p: (g / w_safe).astype(p.dtype),
                 g_sum, state['params'])
-            loss = l_sum / w_sum
+            loss = l_sum / w_safe
         else:
 
             def loss_of(params):

@@ -288,3 +288,14 @@ class TestGradAccumulation:
         tr = trainer_lib.Trainer(config)
         state, metrics = tr.step(tr.init_state(), tr.synthetic_batch())
         assert np.isfinite(float(metrics['loss']))
+
+    def test_accum_fully_masked_batch_is_harmless(self):
+        """All-zero loss mask under accumulation: zero loss, finite
+        params (the w_sum division is guarded like the family loss)."""
+        t2 = self._trainer(2)
+        batch = dict(t2.synthetic_batch(),
+                     mask=jnp.zeros((4, 16), jnp.float32))
+        state, metrics = t2.step(t2.init_state(), batch)
+        assert float(metrics['loss']) == 0.0
+        assert all(bool(jnp.isfinite(x).all())
+                   for x in jax.tree_util.tree_leaves(state['params']))
