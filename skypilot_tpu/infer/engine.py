@@ -399,6 +399,29 @@ class InferenceEngine:
         return {'k': jnp.zeros(shape, c.dtype),
                 'v': jnp.zeros(shape, c.dtype)}
 
+    def start_chunked_prefill(self, prompt_tokens,
+                              sampling_params=None,
+                              logprobs_k: int = 0,
+                              _prefix=None) -> 'ChunkedPrefill':
+        """Begin a stepwise chunked prefill (one chunk per .step()
+        call) — the orchestrator interleaves these steps with decode
+        ticks so a long prompt never stalls running streams for its
+        whole prefill."""
+        if not self.supports_chunked_prefill:
+            raise ValueError(
+                f'Prompt length {len(prompt_tokens)} exceeds max '
+                f'prefill bucket {self.config.max_prompt_len} and '
+                f'{self._model_lib.__name__} has no chunked-prefill '
+                'path.')
+        if len(prompt_tokens) > self.max_admit_len:
+            raise ValueError(f'Prompt length {len(prompt_tokens)} '
+                             f'exceeds max_admit_len '
+                             f'{self.max_admit_len}.')
+        return ChunkedPrefill(self, list(prompt_tokens),
+                              sampling_params or
+                              sampling.SamplingParams(), logprobs_k,
+                              _prefix=_prefix)
+
     def prefill_any(self, prompt_tokens,
                     sampling_params: Optional[sampling.SamplingParams]
                     = None,
@@ -415,57 +438,22 @@ class InferenceEngine:
         Returns (first_token, kv, true_len) exactly like prefill()
         (+ lp_info when logprobs_k > 0).
         """
-        sp = sampling_params or sampling.SamplingParams()
         true_len = len(prompt_tokens)
-        prefix_len, prefix_kv = (self._prefix_cache.lookup(prompt_tokens)
-                                 if self._prefix_cache is not None
-                                 else (0, None))
-        if prefix_len == 0 and true_len <= self.config.max_prompt_len:
+        prefix = None
+        if self._prefix_cache is not None:
+            prefix = self._prefix_cache.lookup(prompt_tokens)
+        if (true_len <= self.config.max_prompt_len
+                and (prefix is None or prefix[0] == 0)):
             out = self.prefill(prompt_tokens, sampling_params, key,
                                logprobs_k)
             if self._prefix_cache is not None:
                 self._prefix_cache.store(prompt_tokens, out[1], true_len)
             return out
-        if not self.supports_chunked_prefill:
-            raise ValueError(
-                f'Prompt length {true_len} exceeds max prefill bucket '
-                f'{self.config.max_prompt_len} and '
-                f'{self._model_lib.__name__} has no chunked-prefill '
-                'path.')
-        if true_len > self.max_admit_len:
-            raise ValueError(f'Prompt length {true_len} exceeds '
-                             f'max_admit_len {self.max_admit_len}.')
-        scratch = self._make_scratch_cache(prefix_kv)
-        chunk = self.config.max_prompt_len
-        pos = prefix_len
-        row_logits = None
-        while pos < true_len:
-            remaining = true_len - pos
-            size = chunk if remaining > chunk else self.bucket_for(
-                remaining)
-            n_real = min(remaining, size)
-            padded = jnp.zeros((1, size), jnp.int32).at[0, :n_real].set(
-                jnp.asarray(prompt_tokens[pos:pos + n_real], jnp.int32))
-            last = pos + size >= true_len
-            row_logits, scratch = self._chunk_forward(
-                self.params, scratch, padded, jnp.int32(pos),
-                jnp.int32(n_real - 1), last)
-            pos += n_real
-        if key is None:
-            self._key, key = jax.random.split(self._key)
-        first_token = sampling.sample_batched(
-            row_logits, key,
-            jnp.full((1,), sp.temperature, jnp.float32),
-            jnp.full((1,), sp.top_k, jnp.int32) if sp.top_k > 0 else None,
-            jnp.full((1,), sp.top_p, jnp.float32) if sp.top_p < 1.0
-            else None)[0]
-        if self._prefix_cache is not None:
-            self._prefix_cache.store(prompt_tokens, scratch, true_len)
-        if logprobs_k > 0:
-            lp = _logprobs_info(row_logits, first_token[None],
-                                logprobs_k)
-            return first_token, scratch, true_len, lp
-        return first_token, scratch, true_len
+        cp = self.start_chunked_prefill(prompt_tokens, sampling_params,
+                                        logprobs_k, _prefix=prefix)
+        while not cp.step():
+            pass
+        return cp.finalize(key)
 
     # ---- insert ----
 
@@ -744,3 +732,79 @@ class InferenceEngine:
         if logprobs_k > 0:
             return state, tokens, lp
         return state, tokens
+
+
+class ChunkedPrefill:
+    """Stepwise chunked prefill: one device chunk per step() call.
+
+    Owns the scratch cache and position cursor between steps so the
+    orchestrator can interleave prompt chunks with decode ticks — a
+    long prompt then adds at most one chunk of latency per emitted
+    token wave instead of stalling every running stream for its whole
+    prefill (vLLM-style chunked-prefill scheduling). finalize() samples
+    the first token and returns exactly what prefill_any returns.
+    """
+
+    def __init__(self, engine: InferenceEngine, prompt_tokens,
+                 sampling_params, logprobs_k: int = 0,
+                 _prefix=None) -> None:
+        self.engine = engine
+        self.prompt_tokens = prompt_tokens
+        self.true_len = len(prompt_tokens)
+        self._sp = sampling_params
+        self._logprobs_k = logprobs_k
+        cache = engine._prefix_cache
+        if _prefix is None and cache is not None:
+            _prefix = cache.lookup(prompt_tokens)
+        prefix_len, prefix_kv = _prefix if _prefix is not None else (0,
+                                                                     None)
+        self._scratch = engine._make_scratch_cache(prefix_kv)
+        self._pos = prefix_len
+        self._chunk = engine.config.max_prompt_len
+        self._row_logits = None
+
+    @property
+    def done(self) -> bool:
+        return self._pos >= self.true_len
+
+    def step(self) -> bool:
+        """Run one chunk; returns True when the prefill is complete."""
+        if self.done:
+            return True
+        engine = self.engine
+        remaining = self.true_len - self._pos
+        size = (self._chunk if remaining > self._chunk
+                else engine.bucket_for(remaining))
+        n_real = min(remaining, size)
+        padded = jnp.zeros((1, size), jnp.int32).at[0, :n_real].set(
+            jnp.asarray(self.prompt_tokens[self._pos:self._pos + n_real],
+                        jnp.int32))
+        last = self._pos + size >= self.true_len
+        self._row_logits, self._scratch = engine._chunk_forward(
+            engine.params, self._scratch, padded, jnp.int32(self._pos),
+            jnp.int32(n_real - 1), last)
+        self._pos += n_real
+        return self.done
+
+    def finalize(self, key: Optional[jax.Array] = None):
+        """→ (first_token, kv, true_len[, lp]) like prefill_any()."""
+        assert self.done, 'finalize() before the last chunk ran'
+        engine = self.engine
+        sp = self._sp
+        if key is None:
+            engine._key, key = jax.random.split(engine._key)
+        first_token = sampling.sample_batched(
+            self._row_logits, key,
+            jnp.full((1,), sp.temperature, jnp.float32),
+            jnp.full((1,), sp.top_k, jnp.int32) if sp.top_k > 0
+            else None,
+            jnp.full((1,), sp.top_p, jnp.float32) if sp.top_p < 1.0
+            else None)[0]
+        if engine._prefix_cache is not None:
+            engine._prefix_cache.store(self.prompt_tokens, self._scratch,
+                                       self.true_len)
+        if self._logprobs_k > 0:
+            lp = _logprobs_info(self._row_logits, first_token[None],
+                                self._logprobs_k)
+            return first_token, self._scratch, self.true_len, lp
+        return first_token, self._scratch, self.true_len

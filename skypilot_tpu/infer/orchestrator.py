@@ -87,6 +87,14 @@ class Orchestrator:
         # whenever dispatch latency rivals per-step compute. Admission
         # still happens every tick, so TTFT is unaffected.
         self.decode_steps = decode_steps
+        # Long prompts run their chunked prefill interleaved with
+        # decode (see _advance_partials); slot →
+        # (request, ChunkedPrefill) for admissions mid-prefill, with a
+        # per-tick chunk budget so concurrent long prompts cannot
+        # multiply running streams' inter-token latency.
+        self.interleave_prefill = True
+        self.prefill_chunks_per_tick = 1
+        self._partials: Dict[int, Any] = {}
 
     # ---- submission ----
 
@@ -136,15 +144,31 @@ class Orchestrator:
             request.max_new_tokens = (self.engine.config.max_target_len -
                                       prompt_len)
         slot = self._free_slots.pop()
+        sp = sampling_lib.SamplingParams(
+            temperature=request.temperature, top_k=request.top_k,
+            top_p=request.top_p)
+        lp_k = LOGPROBS_K if request.logprobs else 0
+        if (self.interleave_prefill
+                and prompt_len > self.engine.config.max_prompt_len
+                and self.engine.supports_chunked_prefill):
+            # Long prompt: claim the slot but run its prefill one chunk
+            # per tick interleaved with decode (vLLM-style chunked
+            # scheduling) — running streams keep emitting instead of
+            # stalling for the whole multi-chunk prefill.
+            self._partials[slot] = (
+                request, self.engine.start_chunked_prefill(
+                    request.prompt_tokens, sp, lp_k))
+            return True
         # Key omitted: the engine owns sampling-key state (split per call).
         # prefill_any == prefill for in-bucket prompts with no cached
         # prefix; beyond that it chunks and reuses cached prefixes.
-        out = self.engine.prefill_any(
-            request.prompt_tokens,
-            sampling_params=sampling_lib.SamplingParams(
-                temperature=request.temperature, top_k=request.top_k,
-                top_p=request.top_p),
-            logprobs_k=LOGPROBS_K if request.logprobs else 0)
+        out = self.engine.prefill_any(request.prompt_tokens,
+                                      sampling_params=sp,
+                                      logprobs_k=lp_k)
+        self._finish_admit(slot, request, out)
+        return True
+
+    def _finish_admit(self, slot: int, request: Request, out) -> None:
         if request.logprobs:
             first_token, kv, true_len, lp = out
             self._record_logprobs(request, lp, row=0)
@@ -156,7 +180,30 @@ class Orchestrator:
         request.first_token_at = time.perf_counter()
         self._slot_req[slot] = request
         self._maybe_finish(slot, int(first_token))
-        return True
+
+    def _advance_partials(self) -> None:
+        """Advance in-flight chunked admissions, oldest first, up to
+        prefill_chunks_per_tick chunks total — the budget bounds how
+        much prefill work can delay each decode wave (the stall class
+        interleaving exists to fix would otherwise return when many
+        long prompts arrive at once); on a request's last chunk it
+        joins the decode batch this tick. Cancelled partials are
+        always reaped regardless of budget."""
+        budget = self.prefill_chunks_per_tick
+        for slot in list(self._partials):
+            request, cp = self._partials[slot]
+            if request.cancel_requested:
+                del self._partials[slot]
+                self._free_slots.append(slot)
+                request.done = True
+                request.finished_at = time.perf_counter()
+                continue
+            if budget <= 0:
+                continue
+            budget -= 1
+            if cp.step():
+                del self._partials[slot]
+                self._finish_admit(slot, request, cp.finalize())
 
     def _record_logprobs(self, request: Request, lp, row) -> None:
         """Append one generated token's logprob + top-k alternatives.
@@ -187,9 +234,11 @@ class Orchestrator:
             self._free_slots.append(slot)
 
     def step(self) -> None:
-        """One scheduler tick: admit while possible, then decode."""
+        """One scheduler tick: admit while possible, advance in-flight
+        chunked prefills by one chunk, then decode."""
         while self._admit_one():
             pass
+        self._advance_partials()
         if not self._slot_req:
             return
         slots = self.engine.config.max_slots
@@ -239,6 +288,12 @@ class Orchestrator:
         """Finish every active and pending request with `error` and
         free their slots — never hand back silently-truncated outputs,
         and leave no stale queue behind to leak into a later batch."""
+        for slot in list(self._partials):
+            request, _ = self._partials.pop(slot)
+            request.error = error
+            request.done = True
+            request.finished_at = time.perf_counter()
+            self._free_slots.append(slot)
         for slot in list(self._slot_req):
             request = self._slot_req.pop(slot)
             request.error = error
@@ -257,13 +312,14 @@ class Orchestrator:
 
     def run_until_drained(self, max_steps: int = 100_000) -> None:
         steps = 0
-        while (self._slot_req or not self._pending.empty()) and \
-                steps < max_steps:
+        while (self._slot_req or self._partials
+               or not self._pending.empty()) and steps < max_steps:
             self.step()
             steps += 1
-        if self._slot_req or not self._pending.empty():
+        if self._slot_req or self._partials or not self._pending.empty():
             logger.warning(f'run_until_drained hit max_steps={max_steps} '
-                           f'with {len(self._slot_req)} active and '
+                           f'with {len(self._slot_req)} active, '
+                           f'{len(self._partials)} mid-prefill and '
                            f'~{self._pending.qsize()} pending requests.')
             self.fail_all(f'Truncated at max_steps={max_steps}.')
 
@@ -352,20 +408,14 @@ class SpeculativeOrchestrator(Orchestrator):
         # or have smaller buckets).
         return min(self.engine.max_admit_len, self.draft.max_admit_len)
 
-    def _admit_one(self) -> bool:
-        # Snapshot which slot the base admit fills, then mirror the
-        # prompt into the draft cache so its proposals have context.
-        free_before = set(self._free_slots)
-        admitted = super()._admit_one()
-        if not admitted:
-            return False
-        filled = free_before - set(self._free_slots)
-        if not filled:
-            return True  # rejected request: no slot claimed
-        slot = filled.pop()
-        request = self._slot_req.get(slot)
-        if request is None:
-            return True  # finished during admit (eos on first token)
+    def _finish_admit(self, slot, request, out) -> None:
+        # Mirror every completed admission (direct or interleaved
+        # chunked) into the draft cache so its proposals have context —
+        # hooking here rather than _admit_one keeps interleaved
+        # prefills speculation-safe.
+        super()._finish_admit(slot, request, out)
+        if slot not in self._slot_req:
+            return   # finished during admit (eos on first token)
         _, draft_kv, true_len = self.draft.prefill_any(
             request.prompt_tokens)
         # The draft chain continues from the TARGET's sampled first
@@ -373,11 +423,11 @@ class SpeculativeOrchestrator(Orchestrator):
         self.draft_state = self.draft.insert(
             self.draft_state, draft_kv,
             np.int32(request.output_tokens[-1]), true_len, slot)
-        return True
 
     def step(self) -> None:
         while self._admit_one():
             pass
+        self._advance_partials()
         if not self._slot_req:
             return
         all_greedy = all(r.temperature == 0.0 and not r.logprobs

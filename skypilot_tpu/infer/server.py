@@ -91,6 +91,7 @@ class ServingLoop:
                     try:
                         self.orch.step()
                         busy = bool(self.orch._slot_req or
+                                    self.orch._partials or
                                     not self.orch._pending.empty())
                     except Exception as e:  # pylint: disable=broad-except
                         # A dead serving loop must not strand waiting

@@ -671,3 +671,97 @@ class TestRepetitionPenalties:
             frequency_penalty=2.0))
         orch.run_until_drained()
         assert first.output_tokens == second.output_tokens
+
+
+class TestInterleavedChunkedPrefill:
+
+    def test_outputs_equal_non_interleaved(self):
+        """Interleaving changes scheduling, never outputs."""
+        prompts = [[(i * 13 + 5) % 256 for i in range(40)],   # 3 chunks
+                   [1, 2, 3],
+                   [(i * 7 + 2) % 256 for i in range(50)]]    # 4 chunks
+        mk = lambda: _engine()
+        o_on = orch_lib.Orchestrator(mk())
+        assert o_on.interleave_prefill
+        out_on = o_on.generate(prompts, max_new_tokens=5)
+        o_off = orch_lib.Orchestrator(mk())
+        o_off.interleave_prefill = False
+        out_off = o_off.generate(prompts, max_new_tokens=5)
+        assert out_on == out_off
+
+    def test_short_request_decodes_during_long_prefill(self):
+        """A long prompt's chunked prefill must not stall an active
+        stream: the short request keeps emitting while the long one is
+        mid-prefill."""
+        engine = _engine()
+        orch = orch_lib.Orchestrator(engine)
+        long_req = orch.submit(orch_lib.Request(
+            prompt_tokens=[(i * 11 + 1) % 256 for i in range(60)],
+            max_new_tokens=3))
+        orch.step()                       # claim slot, chunk 1 of 4
+        assert orch._partials and not long_req.output_tokens
+        short = orch.submit(orch_lib.Request(prompt_tokens=[5, 6, 7],
+                                             max_new_tokens=8))
+        orch.step()                       # short admits AND decodes
+        assert len(short.output_tokens) >= 2
+        assert orch._partials             # long still mid-prefill
+        orch.run_until_drained()
+        assert long_req.done and len(long_req.output_tokens) == 3
+        assert short.done and len(short.output_tokens) == 8
+
+    def test_cancel_mid_prefill_frees_slot(self):
+        engine = _engine(max_slots=1)
+        orch = orch_lib.Orchestrator(engine)
+        long_req = orch.submit(orch_lib.Request(
+            prompt_tokens=[(i * 3 + 1) % 256 for i in range(60)],
+            max_new_tokens=3))
+        orch.step()
+        assert orch._partials
+        long_req.cancel_requested = True
+        follow = orch.submit(orch_lib.Request(prompt_tokens=[9, 9, 9],
+                                              max_new_tokens=2))
+        orch.run_until_drained()
+        assert long_req.done and long_req.output_tokens == []
+        assert follow.done and len(follow.output_tokens) == 2
+        assert len(orch._free_slots) == 1
+
+    def test_speculative_interleaved_long_prompt(self):
+        """Speculation + interleaved chunked prefill: the draft mirror
+        runs at admission completion (the _finish_admit hook), so
+        outputs still equal plain greedy decoding."""
+        model = llama.LLAMA_TINY
+        params = llama.init(model, jax.random.PRNGKey(0))
+        mk = lambda: engine_lib.InferenceEngine(
+            engine_lib.EngineConfig(model=model, max_slots=2,
+                                    max_target_len=64,
+                                    prefill_buckets=(8, 16)), params)
+        import os
+        os.environ['XSKY_DECODE_ATTN'] = 'xla'
+        try:
+            prompt = [(i * 5 + 3) % 256 for i in range(40)]
+            expected = orch_lib.Orchestrator(mk()).generate(
+                [prompt], max_new_tokens=6)
+            spec = orch_lib.SpeculativeOrchestrator(mk(), mk(), gamma=3)
+            assert spec.interleave_prefill
+            assert spec.generate([prompt], max_new_tokens=6) == expected
+        finally:
+            os.environ.pop('XSKY_DECODE_ATTN', None)
+
+    def test_prefill_budget_bounds_chunks_per_tick(self):
+        """Two concurrent long prompts advance one chunk per tick
+        total (budget 1): ticks-to-complete reflects the cap."""
+        engine = _engine(max_slots=4)
+        orch = orch_lib.Orchestrator(engine)
+        for _ in range(2):
+            orch.submit(orch_lib.Request(
+                prompt_tokens=[(i * 11 + 1) % 256 for i in range(60)],
+                max_new_tokens=2))
+        orch.step()   # both claimed; 1 chunk ran (budget)
+        assert len(orch._partials) == 2
+        # 4 chunks each → 8 chunk-ticks total; after 6 more ticks at
+        # budget 1, at least one must still be mid-prefill.
+        for _ in range(6):
+            orch.step()
+        assert orch._partials
+        orch.run_until_drained()
+        assert not orch._partials
