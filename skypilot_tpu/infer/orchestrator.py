@@ -239,6 +239,12 @@ class Orchestrator:
         while self._admit_one():
             pass
         self._advance_partials()
+        self._decode_tick()
+
+    def _decode_tick(self) -> None:
+        """The decode half of a tick — subclasses' mixed-batch
+        fallbacks call this directly so admission and the partials
+        budget run exactly once per tick."""
         if not self._slot_req:
             return
         slots = self.engine.config.max_slots
@@ -283,6 +289,27 @@ class Orchestrator:
                     self._record_logprobs(
                         request, (lp[0][i], lp[1][i], lp[2][i]), slot)
                 self._maybe_finish(slot, int(row[slot]))
+
+    def _verify_round(self, active_before, proposals) -> None:
+        """One greedy verify pass over [slots, γ] proposals: append the
+        accepted tokens + bonus per slot and update accept_stats.
+        Shared by the draft-model and prompt-lookup speculators (which
+        own the accept_stats dict this updates)."""
+        gamma = proposals.shape[1]
+        self.state, emitted, n_emitted = self.engine.verify_step(
+            self.state, proposals)
+        emitted = np.asarray(jax.device_get(emitted))
+        n_emitted = np.asarray(jax.device_get(n_emitted))
+        for slot, request in active_before.items():
+            for i in range(int(n_emitted[slot])):
+                if slot not in self._slot_req:
+                    break  # finished mid-round: drop the tail
+                request.output_tokens.append(int(emitted[slot, i]))
+                self._maybe_finish(slot, int(emitted[slot, i]))
+        self.accept_stats['rounds'] += 1
+        self.accept_stats['proposed'] += gamma * len(active_before)
+        self.accept_stats['accepted'] += int(
+            sum(n_emitted[s] - 1 for s in active_before))
 
     def fail_all(self, error: str) -> None:
         """Finish every active and pending request with `error` and
@@ -401,6 +428,9 @@ class SpeculativeOrchestrator(Orchestrator):
         self.draft_state = draft_engine.init_decode_state()
         self.gamma = gamma
         self.accept_stats = {'rounds': 0, 'proposed': 0, 'accepted': 0}
+        # slot → (request, ChunkedPrefill) for draft mirrors of long
+        # prompts still prefilling (see _advance_draft_partials).
+        self._draft_partials: Dict[int, Any] = {}
 
     def _admit_limit(self) -> int:
         # Both engines prefill every admitted prompt, so the admit gate
@@ -416,6 +446,19 @@ class SpeculativeOrchestrator(Orchestrator):
         super()._finish_admit(slot, request, out)
         if slot not in self._slot_req:
             return   # finished during admit (eos on first token)
+        if (len(request.prompt_tokens) > self.draft.config.max_prompt_len
+                and self.draft.supports_chunked_prefill):
+            # A long prompt's DRAFT prefill is chunked+budgeted across
+            # ticks too — running it whole here would stall every
+            # stream for the draft's multi-chunk prefill in one tick.
+            # Until it lands, rounds fall back to plain decoding; the
+            # late mirror only costs acceptance on the tokens emitted
+            # meanwhile (their draft cache rows are absent), never
+            # correctness.
+            self._draft_partials[slot] = (
+                request, self.draft.start_chunked_prefill(
+                    request.prompt_tokens))
+            return
         _, draft_kv, true_len = self.draft.prefill_any(
             request.prompt_tokens)
         # The draft chain continues from the TARGET's sampled first
@@ -424,24 +467,48 @@ class SpeculativeOrchestrator(Orchestrator):
             self.draft_state, draft_kv,
             np.int32(request.output_tokens[-1]), true_len, slot)
 
+    def _advance_draft_partials(self) -> None:
+        budget = self.prefill_chunks_per_tick
+        for slot in list(self._draft_partials):
+            request, cp = self._draft_partials[slot]
+            if slot not in self._slot_req:
+                del self._draft_partials[slot]   # finished/cancelled
+                continue
+            if budget <= 0:
+                continue
+            budget -= 1
+            if cp.step():
+                del self._draft_partials[slot]
+                _, draft_kv, true_len = cp.finalize()
+                self.draft_state = self.draft.insert(
+                    self.draft_state, draft_kv,
+                    np.int32(request.output_tokens[-1]), true_len, slot)
+                # Bookkeeping catches up to the target's frontier; the
+                # generated-token cache rows stay absent (acceptance
+                # cost only).
+                self.draft_state = self.draft.sync_slots_from(
+                    self.draft_state, self.state)
+
     def step(self) -> None:
         while self._admit_one():
             pass
         self._advance_partials()
+        self._advance_draft_partials()
         if not self._slot_req:
             return
         all_greedy = all(r.temperature == 0.0 and not r.logprobs
                          and not r.presence_penalty
                          and not r.frequency_penalty
                          for r in self._slot_req.values())
-        if not all_greedy:
+        if not all_greedy or self._draft_partials:
             # Mixed batch (sampled slots, slots wanting logprobs —
             # verify_forward does not surface per-token logprobs — or
-            # penalized slots, whose counts only plain rounds update):
-            # plain round; keep the draft's bookkeeping aligned (cache
-            # rows for these tokens are missing in the draft —
-            # acceptance pays, not correctness).
-            super().step()
+            # penalized slots, whose counts only plain rounds update),
+            # or a slot whose draft mirror is still prefilling: plain
+            # round; keep the draft's bookkeeping aligned (cache rows
+            # for these tokens are missing in the draft — acceptance
+            # pays, not correctness).
+            self._decode_tick()
             self.draft_state = self.draft.sync_slots_from(
                 self.draft_state, self.state)
             return
@@ -456,20 +523,93 @@ class SpeculativeOrchestrator(Orchestrator):
         self.draft_state, _ = self.draft.decode_step(self.draft_state)
         # All γ+1 draft steps and the verify dispatch asynchronously;
         # the only host sync per round is fetching emitted/n_emitted.
-        self.state, emitted, n_emitted = self.engine.verify_step(
-            self.state, jnp.stack(proposals, axis=1))   # [slots, γ]
-        emitted = np.asarray(jax.device_get(emitted))
-        n_emitted = np.asarray(jax.device_get(n_emitted))
-        for slot, request in active_before.items():
-            for i in range(int(n_emitted[slot])):
-                if slot not in self._slot_req:
-                    break  # finished mid-round: drop the tail
-                request.output_tokens.append(int(emitted[slot, i]))
-                self._maybe_finish(slot, int(emitted[slot, i]))
-        self.accept_stats['rounds'] += 1
-        self.accept_stats['proposed'] += self.gamma * len(active_before)
-        self.accept_stats['accepted'] += int(
-            sum(n_emitted[s] - 1 for s in active_before))
+        self._verify_round(active_before, jnp.stack(proposals, axis=1))
         # Draft follows the target's accepted frontier.
         self.draft_state = self.draft.sync_slots_from(
             self.draft_state, self.state)
+
+
+class NgramSpeculator(Orchestrator):
+    """Draft-model-free speculation: prompt-lookup (n-gram) proposals.
+
+    The last `match_len` tokens of each slot's history (prompt +
+    generated so far) are matched against the most recent earlier
+    occurrence in that same history; the γ tokens that followed it
+    become the proposals, verified in ONE multi-token target pass
+    (engine.verify_step) exactly like draft-model speculation. Greedy
+    acceptance keeps outputs equal to plain greedy decoding — a failed
+    lookup only wastes the round's extra verify columns. Wins on
+    copy-heavy generation (quoting the prompt, code, RAG answers)
+    with no second model and no extra HBM.
+    """
+
+    def __init__(self, engine: engine_lib.InferenceEngine,
+                 gamma: int = 4, match_len: int = 2,
+                 seed: int = 0) -> None:
+        if gamma < 1:
+            raise ValueError(f'gamma must be >= 1, got {gamma}')
+        if match_len < 1:
+            raise ValueError(f'match_len must be >= 1, got {match_len}')
+        if not engine.supports_verify:
+            raise NotImplementedError(
+                'target model family has no verify_forward')
+        super().__init__(engine, seed)
+        self.gamma = gamma
+        self.match_len = match_len
+        self.accept_stats = {'rounds': 0, 'proposed': 0, 'accepted': 0}
+        # slot → (request_id, gram → most recent start pos, tokens
+        # indexed so far): maintained incrementally, so each round's
+        # lookup is O(new tokens), not an O(history) backward scan per
+        # slot per round. Keyed by request_id so a slot reused by a
+        # new request never inherits a stale index.
+        self._grams: Dict[int, Tuple[int, Dict[tuple, int], int]] = {}
+
+    def _propose(self, slot: int, request: Request) -> List[int]:
+        """γ proposals from the most recent earlier occurrence of the
+        history's trailing match_len-gram; repeats of the last token
+        when nothing matches (wrong proposals cost only acceptance)."""
+        history = request.prompt_tokens + request.output_tokens
+        k = self.match_len
+        fallback = [history[-1]] * self.gamma
+        if len(history) <= k:
+            return fallback
+        entry = self._grams.get(slot)
+        if entry is None or entry[0] != request.request_id:
+            entry = (request.request_id, {}, 0)
+        _, index, upto = entry
+        # Index every gram STARTING before the trailing one (the
+        # trailing gram itself must not match in place).
+        for j in range(upto, len(history) - k):
+            index[tuple(history[j:j + k])] = j
+        self._grams[slot] = (request.request_id, index,
+                             len(history) - k)
+        j = index.get(tuple(history[-k:]))
+        if j is None:
+            return fallback
+        return (history[j + k:j + k + self.gamma] +
+                fallback)[:self.gamma]
+
+    def step(self) -> None:
+        while self._admit_one():
+            pass
+        self._advance_partials()
+        # Drop gram indexes of released slots (memory hygiene; staleness
+        # itself is prevented by the request_id key).
+        for slot in list(self._grams):
+            if slot not in self._slot_req:
+                del self._grams[slot]
+        if not self._slot_req:
+            return
+        all_greedy = all(r.temperature == 0.0 and not r.logprobs
+                         and not r.presence_penalty
+                         and not r.frequency_penalty
+                         for r in self._slot_req.values())
+        if not all_greedy:
+            self._decode_tick()
+            return
+        active_before = dict(self._slot_req)
+        slots = self.engine.config.max_slots
+        proposals = np.zeros((slots, self.gamma), np.int32)
+        for slot, request in active_before.items():
+            proposals[slot] = self._propose(slot, request)
+        self._verify_round(active_before, jnp.asarray(proposals))

@@ -413,6 +413,12 @@ def main() -> int:
     parser.add_argument('--spec-gamma', type=int, default=4,
                         help='Draft tokens proposed per speculative '
                              'round')
+    parser.add_argument('--spec-ngram', action='store_true',
+                        help='Draft-model-free speculation: propose '
+                             'continuations by prompt-lookup (n-gram '
+                             'match against the request history), '
+                             'verified in one target pass. Wins on '
+                             'copy-heavy generation; no extra HBM.')
     parser.add_argument('--model-id', default=None,
                         help='Model id reported by /v1/models '
                              '(default: --model)')
@@ -490,6 +496,18 @@ def main() -> int:
         if args.decode_steps != 1:
             logger.warning('--decode-steps is ignored with '
                            '--draft-model: speculation already '
+                           'amortizes dispatch per round (γ+1 tokens).')
+        if args.spec_ngram:
+            logger.warning('--spec-ngram is ignored with '
+                           '--draft-model: draft-model speculation '
+                           'takes precedence.')
+    elif args.spec_ngram:
+        orch = orch_lib.NgramSpeculator(engine, gamma=args.spec_gamma)
+        logger.info(f'Prompt-lookup speculation: gamma='
+                    f'{args.spec_gamma}')
+        if args.decode_steps != 1:
+            logger.warning('--decode-steps is ignored with '
+                           '--spec-ngram: speculation already '
                            'amortizes dispatch per round (γ+1 tokens).')
     else:
         orch = orch_lib.Orchestrator(engine,

@@ -220,3 +220,66 @@ class TestSpeculativeOrchestrator:
             dataclasses.replace(DRAFT, vocab_size=300), seed=1)
         with pytest.raises(ValueError, match='vocab'):
             orch_lib.SpeculativeOrchestrator(target_engine, bad_vocab)
+
+
+class TestNgramSpeculator:
+
+    @pytest.fixture(autouse=True)
+    def _pin_xla_attend(self, monkeypatch):
+        # Same rationale as the module docstring: verify and decode
+        # use different reduction orders; pin one attend path so
+        # token-for-token equality is well-defined.
+        monkeypatch.setenv('XSKY_DECODE_ATTN', 'xla')
+
+    def _engines(self):
+        from skypilot_tpu.models import llama
+        params = llama.init(llama.LLAMA_TINY, jax.random.PRNGKey(0))
+        mk = lambda: engine_lib.InferenceEngine(
+            engine_lib.EngineConfig(model=llama.LLAMA_TINY, max_slots=2,
+                                    max_target_len=64,
+                                    prefill_buckets=(16, 32)), params)
+        return mk
+
+    def test_outputs_equal_plain_greedy(self):
+        mk = self._engines()
+        prompts = [[5, 17, 3, 99, 42], [7, 8, 9, 7, 8, 9, 7, 8]]
+        expected = orch_lib.Orchestrator(mk()).generate(
+            prompts, max_new_tokens=10)
+        ng = orch_lib.NgramSpeculator(mk(), gamma=3, match_len=2)
+        assert ng.generate(prompts, max_new_tokens=10) == expected
+        assert ng.accept_stats['rounds'] > 0
+
+    def test_copyable_history_gets_accepted(self):
+        """A prompt whose greedy continuation repeats (tiny random
+        models loop hard) must yield a positive acceptance rate."""
+        mk = self._engines()
+        plain = orch_lib.Orchestrator(mk()).generate(
+            [[5, 17, 3]], max_new_tokens=16)[0]
+        # Only meaningful if the continuation actually repeats.
+        repeats = len(plain) - len(set(zip(plain, plain[1:])))
+        ng = orch_lib.NgramSpeculator(mk(), gamma=4, match_len=2)
+        out = ng.generate([[5, 17, 3]], max_new_tokens=16)
+        assert out[0] == plain
+        if repeats > 4:
+            assert ng.accept_stats['accepted'] > 0
+
+    def test_propose_prefers_most_recent_match(self):
+        ng = orch_lib.NgramSpeculator(self._engines()(), gamma=3,
+                                      match_len=2)
+        request = orch_lib.Request(prompt_tokens=[1, 2, 7, 1, 2, 9, 1])
+        request.output_tokens = [2]
+        # tail (1,2): most recent earlier occurrence at index 3 → the
+        # continuation starts with 9.
+        assert ng._propose(0, request)[0] == 9
+
+    def test_mixed_batch_falls_back(self):
+        mk = self._engines()
+        ng = orch_lib.NgramSpeculator(mk(), gamma=3)
+        greedy = ng.submit(orch_lib.Request(prompt_tokens=[5, 17, 3],
+                                            max_new_tokens=6))
+        ng.submit(orch_lib.Request(prompt_tokens=[9, 8, 7],
+                                   max_new_tokens=6, temperature=1.0))
+        ng.run_until_drained()
+        expected = orch_lib.Orchestrator(mk()).generate(
+            [[5, 17, 3]], max_new_tokens=6)[0]
+        assert greedy.output_tokens == expected
