@@ -264,6 +264,70 @@ def serve_main() -> None:
     print(json.dumps(result))
 
 
+def autotune_main() -> None:
+    """`python bench.py autotune`: sweep flash block sizes on the
+    attached chip and print one JSON line with the ranking.
+
+    Each (block_q, block_kv) point runs the train bench in a child
+    process (the env override must be set before the kernels import,
+    and an OOM on one point must not poison the next). The best point
+    is what `XSKY_FLASH_BLOCK_Q/KV` should be pinned to on this chip
+    generation.
+    """
+    points = [(512, 512), (256, 512), (512, 1024), (1024, 512),
+              (256, 1024), (512, 256)]
+    results = []
+    for bq, bkv in points:
+        # Bound the child's own supervisor BELOW the outer timeout (one
+        # attempt, shorter run window) so a hung point is a failed
+        # point, never an aborted sweep.
+        env = dict(os.environ, XSKY_FLASH_BLOCK_Q=str(bq),
+                   XSKY_FLASH_BLOCK_KV=str(bkv), XSKY_BENCH_CHILD='',
+                   XSKY_BENCH_ATTEMPTS='1',
+                   XSKY_BENCH_INIT_TIMEOUT='240',
+                   XSKY_BENCH_RUN_TIMEOUT='1200')
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                capture_output=True, text=True, timeout=1700, env=env)
+        except subprocess.TimeoutExpired:
+            print(f'# block_q={bq} block_kv={bkv}: outer timeout',
+                  file=sys.stderr, flush=True)
+            continue
+        parsed = None
+        for line in (proc.stdout or '').splitlines():
+            if line.startswith('{'):
+                try:
+                    parsed = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+        value = (parsed or {}).get('value')
+        note = ('' if value is not None else
+                f" ({(parsed or {}).get('error', 'no JSON')})")
+        print(f'# block_q={bq} block_kv={bkv}: '
+              f'{value} TFLOP/s/chip{note}', file=sys.stderr, flush=True)
+        if value is None and proc.stderr:
+            print(proc.stderr.strip()[-500:], file=sys.stderr,
+                  flush=True)
+        if value is not None:
+            results.append({'block_q': bq, 'block_kv': bkv,
+                            'tflops_per_chip': value,
+                            'mfu': (parsed or {}).get('mfu')})
+    if not results:
+        print(json.dumps({'metric': 'flash_block_autotune',
+                          'value': None, 'error': 'no point succeeded'}))
+        sys.exit(1)
+    results.sort(key=lambda r: -r['tflops_per_chip'])
+    best = results[0]
+    print(json.dumps({
+        'metric': 'flash_block_autotune',
+        'value': best['tflops_per_chip'],
+        'unit': 'TFLOP/s/chip',
+        'best': best,
+        'ranking': results,
+    }))
+
+
 def main() -> None:
     import jax
 
@@ -499,6 +563,8 @@ def _supervise(argv) -> int:
 
 if __name__ == '__main__':
     args = sys.argv[1:]
+    if args and args[0] == 'autotune':
+        sys.exit(autotune_main())
     if os.environ.get('XSKY_BENCH_CHILD') == '1':
         if args and args[0] == 'serve':
             sys.exit(serve_main())

@@ -32,8 +32,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_KV = 512
+def _env_block(name: str, default: int) -> int:
+    """Block-size override for autotuning (python bench.py autotune):
+    sweeping (block_q, block_kv) per chip generation beats guessing —
+    the best point moved between v4 and v5e in our measurements."""
+    import os
+    value = os.environ.get(name)
+    return int(value) if value else default
+
+
+DEFAULT_BLOCK_Q = _env_block('XSKY_FLASH_BLOCK_Q', 512)
+DEFAULT_BLOCK_KV = _env_block('XSKY_FLASH_BLOCK_KV', 512)
 _NEG_INF = -1e30
 _LANES = 128  # row-stat scratch minor dim (TPU lane width)
 

@@ -765,3 +765,26 @@ class TestInterleavedChunkedPrefill:
         assert orch._partials
         orch.run_until_drained()
         assert not orch._partials
+
+
+def test_penalties_on_sharded_mesh(monkeypatch):
+    """Repetition penalties under a tensor-parallel mesh: the
+    [slots, vocab] count ops must compile and stay per-slot correct."""
+    monkeypatch.setenv('XSKY_DECODE_ATTN', 'xla')
+    mesh = mesh_lib.build_mesh(mesh_lib.MeshPlan(data=4, tensor=2))
+    config = engine_lib.EngineConfig(
+        model=llama.LLAMA_TINY, max_slots=4, max_target_len=32,
+        prefill_buckets=(16,))
+    params = llama.init(llama.LLAMA_TINY, jax.random.PRNGKey(0))
+    sharded = engine_lib.InferenceEngine(config, params, mesh=mesh)
+    plain = engine_lib.InferenceEngine(config, params)
+
+    def run(engine):
+        orch = orch_lib.Orchestrator(engine)
+        request = orch.submit(orch_lib.Request(
+            prompt_tokens=[5, 17, 3], max_new_tokens=8,
+            frequency_penalty=2.0))
+        orch.run_until_drained()
+        return request.output_tokens
+
+    assert run(sharded) == run(plain)
