@@ -302,6 +302,15 @@ def autotune_main() -> None:
                 except json.JSONDecodeError:
                     pass
         value = (parsed or {}).get('value')
+        impl = (parsed or {}).get('attention_impl')
+        if value is not None and impl == 'xla':
+            # The ladder's XLA fallback rescued this point: its number
+            # never exercised the swept flash blocks, so ranking it
+            # would pin block sizes validated by a non-flash run.
+            print(f'# block_q={bq} block_kv={bkv}: flash failed '
+                  '(xla fallback measured; point excluded)',
+                  file=sys.stderr, flush=True)
+            continue
         note = ('' if value is not None else
                 f" ({(parsed or {}).get('error', 'no JSON')})")
         print(f'# block_q={bq} block_kv={bkv}: '
@@ -312,7 +321,8 @@ def autotune_main() -> None:
         if value is not None:
             results.append({'block_q': bq, 'block_kv': bkv,
                             'tflops_per_chip': value,
-                            'mfu': (parsed or {}).get('mfu')})
+                            'mfu': (parsed or {}).get('mfu'),
+                            'attention_impl': impl})
     if not results:
         print(json.dumps({'metric': 'flash_block_autotune',
                           'value': None, 'error': 'no point succeeded'}))
@@ -421,6 +431,7 @@ def main() -> None:
         'seq_len': best_config.seq_len,
         'global_batch_size': best_config.global_batch_size,
         'remat_policy': best_config.model.remat_policy,
+        'attention_impl': best_config.model.attention_impl,
     }
     print(json.dumps(result))
 

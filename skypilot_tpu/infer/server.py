@@ -387,6 +387,12 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default='llama3-1b')
+    parser.add_argument('--hf-checkpoint', default=None,
+                        help='Local HuggingFace checkpoint dir '
+                             '(llama/mistral/qwen/gemma): real weights '
+                             'are converted on the host and served; '
+                             'overrides --model. Point --tokenizer at '
+                             'the same dir for text endpoints.')
     parser.add_argument('--port', type=int, default=8080)
     parser.add_argument('--max-slots', type=int, default=16)
     parser.add_argument('--max-target-len', type=int, default=2048)
@@ -439,9 +445,22 @@ def main() -> int:
                              '0 (default) disables')
     args = parser.parse_args()
 
-    model = models.get_config(args.model)
-    model = dataclasses.replace(model, remat=False)
     import jax.numpy as jnp
+    hf_params = None
+    if args.hf_checkpoint:
+        # Convert on the HOST (CPU): real checkpoints are often larger
+        # than a chip's HBM at bf16, and quantization below must see
+        # the bf16 tree before anything ships to the device.
+        from skypilot_tpu.models import convert as convert_lib
+        cpu = jax.local_devices(backend='cpu')[0]
+        with jax.default_device(cpu):
+            model, hf_params = convert_lib.from_hf(args.hf_checkpoint)
+        logger.info(f'Converted {args.hf_checkpoint}: '
+                    f'{type(model).__name__}, '
+                    f'{model.num_params() / 1e9:.2f}B params')
+    else:
+        model = models.get_config(args.model)
+    model = dataclasses.replace(model, remat=False)
     prefix_entries = args.prefix_cache
     if not engine_lib.supports_chunked_prefill(models.module_for(model)):
         prefix_entries = 0   # family lacks the chunked-prefill path
@@ -460,22 +479,25 @@ def main() -> int:
     logger.info(f'Initializing {args.model} on '
                 f'{jax.devices()[0].device_kind} x{jax.device_count()}')
     model_lib = models.module_for(model)
+    from jax.sharding import NamedSharding, PartitionSpec
+    replicated = (NamedSharding(mesh, PartitionSpec())
+                  if mesh is not None else jax.devices()[0])
     if args.weight_dtype in ('int8', 'int4'):
-        # Init + quantize on HOST: the whole point of quantized weights
-        # is serving a model whose bf16 tree does not fit the chip (8B
-        # = 16 GB bf16 on a 16 GB chip), so the bf16 init must never
-        # touch device HBM. Only the quantized tree is shipped over.
-        from jax.sharding import NamedSharding, PartitionSpec
+        # Init/convert + quantize on HOST: the whole point of quantized
+        # weights is serving a model whose bf16 tree does not fit the
+        # chip (8B = 16 GB bf16 on a 16 GB chip), so the bf16 tree must
+        # never touch device HBM. Only the quantized tree is shipped.
         from skypilot_tpu.ops import quantization as qops
         cpu = jax.local_devices(backend='cpu')[0]
         with jax.default_device(cpu):
-            params = model_lib.init(model, jax.random.PRNGKey(0))
+            params = (hf_params if hf_params is not None
+                      else model_lib.init(model, jax.random.PRNGKey(0)))
             params = (qops.quantize_params(params)
                       if args.weight_dtype == 'int8'
                       else qops.quantize_params_int4(params))
-        target = (NamedSharding(mesh, PartitionSpec())
-                  if mesh is not None else jax.devices()[0])
-        params = jax.device_put(params, target)
+        params = jax.device_put(params, replicated)
+    elif hf_params is not None:
+        params = jax.device_put(hf_params, replicated)
     else:
         params = model_lib.init(model, jax.random.PRNGKey(0))
     engine = engine_lib.InferenceEngine(config, params, mesh=mesh)
@@ -530,10 +552,13 @@ def main() -> int:
         # endpoint still works, /v1 routes report 503.
         logger.warning(f'No tokenizer: {e}')
         tokenizer = None
+    import os
+    default_id = (os.path.basename(args.hf_checkpoint.rstrip('/'))
+                  if args.hf_checkpoint else args.model)
     server = ThreadingHTTPServer(
         ('0.0.0.0', args.port),
         build_handler(loop, config, tokenizer=tokenizer,
-                      model_id=args.model_id or args.model,
+                      model_id=args.model_id or default_id,
                       max_queue=args.max_queue))
     logger.info(f'Serving on :{args.port}')
     server.serve_forever()

@@ -1,0 +1,312 @@
+"""HuggingFace checkpoint → in-tree param-tree conversion.
+
+The reference serves public checkpoints through vLLM/torch recipes
+(llm/vllm/serve.yaml, llm/llama-3_1-finetuning); here the framework
+owns its models, so it owns the weight import too:
+
+    from skypilot_tpu.models import convert
+    config, params = convert.from_hf('/ckpts/Llama-3.1-8B')
+
+or from the CLI (saves an orbax dir the trainer/server can load):
+
+    python -m skypilot_tpu.models.convert \
+        --src /ckpts/Llama-3.1-8B --out /ckpts/llama31-xsky
+
+Supported families: Llama/Mistral (LlamaConfig), Qwen-2/3 (QwenConfig,
+qkv biases + qk-norm), Gemma (tied head, (1+w) norms — weights map
+directly since the in-tree gemma uses the same convention). Safetensors
+shards are streamed tensor-by-tensor (an 8B never needs a torch model
+instantiated); `.bin` checkpoints fall back to torch.load. Layer
+weights stack to the in-tree `[L, in, out]` scan layout with the
+contraction transposed from torch's `[out, in]`.
+
+Numeric parity with the HF implementations is test-pinned
+(tests/unit_tests/test_hf_convert.py): logits from converted weights
+match transformers' forward on the same tokens.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import Any, Callable, Dict, Tuple
+
+import numpy as np
+
+Params = Dict[str, Any]
+
+
+class _TensorSource:
+    """Uniform tensor access over safetensors shards / torch bins /
+    an in-memory transformers model's state_dict."""
+
+    def __init__(self, src) -> None:
+        self._get: Callable[[str], np.ndarray]
+        if not isinstance(src, (str, os.PathLike)):
+            state = {k: v.detach().cpu().float().numpy()
+                     for k, v in src.state_dict().items()}
+            # transformers state_dicts may or may not carry the
+            # 'model.' prefix depending on how the module was built.
+            self._keys = set(state)
+            self._get = state.__getitem__
+            self.config = json.loads(src.config.to_json_string())
+            return
+        src = str(src)
+        with open(os.path.join(src, 'config.json'),
+                  encoding='utf-8') as f:
+            self.config = json.load(f)
+        st_files = sorted(
+            f for f in os.listdir(src) if f.endswith('.safetensors'))
+        if st_files:
+            from safetensors import safe_open
+            self._handles = [safe_open(os.path.join(src, f),
+                                       framework='numpy')
+                             for f in st_files]
+            self._where = {}
+            for handle in self._handles:
+                for key in handle.keys():
+                    self._where[key] = handle
+            self._keys = set(self._where)
+            self._get = lambda k: np.asarray(
+                self._where[k].get_tensor(k), np.float32)
+            return
+        import torch
+        bins = sorted(f for f in os.listdir(src)
+                      if f.endswith('.bin') and 'pytorch_model' in f)
+        if not bins:
+            raise FileNotFoundError(
+                f'{src}: no *.safetensors or pytorch_model*.bin')
+        state = {}
+        for b in bins:
+            state.update(torch.load(os.path.join(src, b),
+                                    map_location='cpu',
+                                    weights_only=True))
+        state = {k: v.float().numpy() for k, v in state.items()}
+        self._keys = set(state)
+        self._get = state.__getitem__
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._keys or f'model.{key}' in self._keys
+
+    def get(self, key: str) -> np.ndarray:
+        if key in self._keys:
+            return np.asarray(self._get(key), np.float32)
+        return np.asarray(self._get(f'model.{key}'), np.float32)
+
+
+def _stack(source: _TensorSource, template: str, n_layers: int,
+           transpose: bool) -> np.ndarray:
+    rows = []
+    for i in range(n_layers):
+        t = source.get(template.format(i=i))
+        rows.append(t.T if transpose else t)
+    return np.stack(rows)
+
+
+def _detect_family(hf_config: dict) -> str:
+    mt = hf_config.get('model_type', '')
+    if mt in ('qwen2', 'qwen3'):
+        return 'qwen'
+    if mt in ('gemma', 'gemma2'):
+        return 'gemma'
+    if mt in ('llama', 'mistral'):
+        return 'llama'
+    raise ValueError(f'Unsupported HF model_type {mt!r} (supported: '
+                     'llama, mistral, qwen2, qwen3, gemma, gemma2)')
+
+
+def _common_layers(source: _TensorSource, n_layers: int) -> Params:
+    p = 'layers.{i}.'
+    return {
+        'wq': _stack(source, p + 'self_attn.q_proj.weight', n_layers,
+                     transpose=True),
+        'wk': _stack(source, p + 'self_attn.k_proj.weight', n_layers,
+                     transpose=True),
+        'wv': _stack(source, p + 'self_attn.v_proj.weight', n_layers,
+                     transpose=True),
+        'wo': _stack(source, p + 'self_attn.o_proj.weight', n_layers,
+                     transpose=True),
+        'w_gate': _stack(source, p + 'mlp.gate_proj.weight', n_layers,
+                         transpose=True),
+        'w_up': _stack(source, p + 'mlp.up_proj.weight', n_layers,
+                       transpose=True),
+        'w_down': _stack(source, p + 'mlp.down_proj.weight', n_layers,
+                         transpose=True),
+        'attn_norm': _stack(source, p + 'input_layernorm.weight',
+                            n_layers, transpose=False),
+        'mlp_norm': _stack(source,
+                           p + 'post_attention_layernorm.weight',
+                           n_layers, transpose=False),
+    }
+
+
+def _lm_head(source: _TensorSource, hf: dict) -> np.ndarray:
+    if not hf.get('tie_word_embeddings', False) and \
+            'lm_head.weight' in source:
+        return source.get('lm_head.weight').T
+    return source.get('embed_tokens.weight').T
+
+
+def _convert_llama(source: _TensorSource, dtype):
+    import jax.numpy as jnp
+    from skypilot_tpu.models import llama
+    hf = source.config
+    n_layers = hf['num_hidden_layers']
+    config = llama.LlamaConfig(
+        vocab_size=hf['vocab_size'],
+        d_model=hf['hidden_size'],
+        n_layers=n_layers,
+        n_heads=hf['num_attention_heads'],
+        n_kv_heads=hf.get('num_key_value_heads',
+                          hf['num_attention_heads']),
+        d_ff=hf['intermediate_size'],
+        max_seq_len=hf.get('max_position_embeddings', 8192),
+        rope_theta=float(hf.get('rope_theta', 10_000.0)),
+        norm_eps=float(hf.get('rms_norm_eps', 1e-5)),
+        sliding_window=hf.get('sliding_window'),
+        dtype=dtype,
+    )
+    cast = lambda a: jnp.asarray(a, dtype)
+    params = {
+        'embed': cast(source.get('embed_tokens.weight')),
+        'layers': {k: cast(v) for k, v in
+                   _common_layers(source, n_layers).items()},
+        'final_norm': cast(source.get('norm.weight')),
+        'lm_head': cast(_lm_head(source, hf)),
+    }
+    return config, params
+
+
+def _convert_qwen(source: _TensorSource, dtype):
+    import jax.numpy as jnp
+    from skypilot_tpu.models import qwen
+    hf = source.config
+    n_layers = hf['num_hidden_layers']
+    qkv_bias = 'layers.0.self_attn.q_proj.bias' in source
+    qk_norm = 'layers.0.self_attn.q_norm.weight' in source
+    config = qwen.QwenConfig(
+        vocab_size=hf['vocab_size'],
+        d_model=hf['hidden_size'],
+        n_layers=n_layers,
+        n_heads=hf['num_attention_heads'],
+        n_kv_heads=hf.get('num_key_value_heads',
+                          hf['num_attention_heads']),
+        head_dim=hf.get('head_dim', hf['hidden_size'] //
+                        hf['num_attention_heads']),
+        d_ff=hf['intermediate_size'],
+        max_seq_len=hf.get('max_position_embeddings', 8192),
+        rope_theta=float(hf.get('rope_theta', 1e6)),
+        norm_eps=float(hf.get('rms_norm_eps', 1e-6)),
+        qkv_bias=qkv_bias,
+        qk_norm=qk_norm,
+        dtype=dtype,
+    )
+    cast = lambda a: jnp.asarray(a, dtype)
+    layers = {k: cast(v) for k, v in
+              _common_layers(source, n_layers).items()}
+    p = 'layers.{i}.'
+    if qkv_bias:
+        layers['bq'] = cast(_stack(source, p + 'self_attn.q_proj.bias',
+                                   n_layers, transpose=False))
+        layers['bk'] = cast(_stack(source, p + 'self_attn.k_proj.bias',
+                                   n_layers, transpose=False))
+        layers['bv'] = cast(_stack(source, p + 'self_attn.v_proj.bias',
+                                   n_layers, transpose=False))
+    if qk_norm:
+        layers['q_norm'] = cast(_stack(
+            source, p + 'self_attn.q_norm.weight', n_layers,
+            transpose=False))
+        layers['k_norm'] = cast(_stack(
+            source, p + 'self_attn.k_norm.weight', n_layers,
+            transpose=False))
+    params = {
+        'embed': cast(source.get('embed_tokens.weight')),
+        'layers': layers,
+        'final_norm': cast(source.get('norm.weight')),
+        'lm_head': cast(_lm_head(source, hf)),
+    }
+    return config, params
+
+
+def _convert_gemma(source: _TensorSource, dtype):
+    import jax.numpy as jnp
+    from skypilot_tpu.models import gemma
+    hf = source.config
+    n_layers = hf['num_hidden_layers']
+    config = gemma.GemmaConfig(
+        vocab_size=hf['vocab_size'],
+        d_model=hf['hidden_size'],
+        n_layers=n_layers,
+        n_heads=hf['num_attention_heads'],
+        n_kv_heads=hf.get('num_key_value_heads',
+                          hf['num_attention_heads']),
+        head_dim=hf.get('head_dim', hf['hidden_size'] //
+                        hf['num_attention_heads']),
+        d_ff=hf['intermediate_size'],
+        max_seq_len=hf.get('max_position_embeddings', 8192),
+        rope_theta=float(hf.get('rope_theta', 10_000.0)),
+        norm_eps=float(hf.get('rms_norm_eps', 1e-6)),
+        final_logit_softcap=hf.get('final_logit_softcapping'),
+        dtype=dtype,
+    )
+    cast = lambda a: jnp.asarray(a, dtype)
+    # Gemma norms share the (1 + w) convention with the in-tree model,
+    # so weights map directly; the head is tied to the embedding.
+    params = {
+        'embed': cast(source.get('embed_tokens.weight')),
+        'layers': {k: cast(v) for k, v in
+                   _common_layers(source, n_layers).items()},
+        'final_norm': cast(source.get('norm.weight')),
+    }
+    return config, params
+
+
+def from_hf(src, dtype=None) -> Tuple[Any, Params]:
+    """(config, params) from a local HF checkpoint directory or an
+    in-memory transformers model. `dtype` defaults to bfloat16."""
+    import jax.numpy as jnp
+    dtype = dtype or jnp.bfloat16
+    source = _TensorSource(src)
+    family = _detect_family(source.config)
+    return {
+        'llama': _convert_llama,
+        'qwen': _convert_qwen,
+        'gemma': _convert_gemma,
+    }[family](source, dtype)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description='Convert a local HF checkpoint to the in-tree '
+                    'param layout (orbax).')
+    parser.add_argument('--src', required=True,
+                        help='HF checkpoint dir (config.json + '
+                             'safetensors or pytorch_model*.bin)')
+    parser.add_argument('--out', required=True,
+                        help='Output orbax checkpoint dir')
+    parser.add_argument('--dtype', default='bf16',
+                        choices=['bf16', 'f32'])
+    args = parser.parse_args(argv)
+    import jax.numpy as jnp
+    import orbax.checkpoint as ocp
+    config, params = from_hf(
+        args.src, jnp.bfloat16 if args.dtype == 'bf16' else jnp.float32)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(args.out), params)
+    ckptr.wait_until_finished()
+    meta = dataclasses.asdict(config)
+    meta['dtype'] = args.dtype
+    meta['family'] = type(config).__name__
+    with open(os.path.join(args.out, 'xsky_model.json'), 'w',
+              encoding='utf-8') as f:
+        json.dump(meta, f, indent=1, default=str)
+    print(json.dumps({'out': args.out,
+                      'family': meta['family'],
+                      'params': int(config.num_params())}))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

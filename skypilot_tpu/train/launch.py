@@ -77,6 +77,12 @@ def main() -> int:
                              'flavors shuffle differently).')
     parser.add_argument('--seed', type=int, default=0)
     parser.add_argument('--checkpoint-dir', default=None)
+    parser.add_argument('--init-params', default=None,
+                        help='Orbax params dir (models.convert output) '
+                             'to initialize weights from — fine-tune a '
+                             'real HF checkpoint. Model dims must '
+                             'match --model. With --lora-rank these '
+                             'become the frozen base.')
     parser.add_argument('--metrics-file', default=None,
                         help='Append one JSON line per log window '
                              '(step, loss, tok/s, TFLOP/s/chip).')
@@ -157,6 +163,29 @@ def main() -> int:
             logger.info(f'Resumed from checkpoint step {start_step}.')
     if state is None:
         state = trainer.init_state()
+        if args.init_params:
+            import orbax.checkpoint as ocp
+            restored = ocp.StandardCheckpointer().restore(
+                os.path.abspath(args.init_params))
+            key = 'base' if args.lora_rank > 0 else 'params'
+            target = state[key]
+            ref_shapes = jax.tree.map(lambda a: a.shape, target)
+            got_shapes = jax.tree.map(lambda a: a.shape, restored)
+            if ref_shapes != got_shapes:
+                raise ValueError(
+                    f'--init-params does not match --model '
+                    f'{args.model}: expected {ref_shapes}, got '
+                    f'{got_shapes}')
+            shardings = trainer.state_shardings()[key]
+            # Cast on HOST, then ship straight to each leaf's sharding:
+            # jnp.asarray first would commit every full leaf to device
+            # 0 before resharding — a full-leaf HBM spike per leaf.
+            import numpy as np
+            state[key] = jax.tree.map(
+                lambda a, ref, s: jax.device_put(
+                    np.asarray(a).astype(ref.dtype), s),
+                restored, target, shardings)
+            logger.info(f'Initialized {key} from {args.init_params}.')
 
     feed = None
     if args.data:
