@@ -109,12 +109,21 @@ def _detect_family(hf_config: dict) -> str:
     mt = hf_config.get('model_type', '')
     if mt in ('qwen2', 'qwen3'):
         return 'qwen'
-    if mt in ('gemma', 'gemma2'):
+    if mt == 'gemma':
         return 'gemma'
+    if mt == 'gemma2':
+        # Gemma-2 adds pre/post-feedforward norms, attention logit
+        # softcapping and alternating sliding windows the in-tree
+        # gemma does not model — converting would be silently wrong.
+        raise ValueError("model_type 'gemma2' is not supported yet "
+                         '(extra norms + attn softcap would be '
+                         'silently dropped); gemma-1 converts.')
     if mt in ('llama', 'mistral'):
         return 'llama'
+    if mt == 'mixtral':
+        return 'moe'
     raise ValueError(f'Unsupported HF model_type {mt!r} (supported: '
-                     'llama, mistral, qwen2, qwen3, gemma, gemma2)')
+                     'llama, mistral, qwen2, qwen3, gemma, mixtral)')
 
 
 def _common_layers(source: _TensorSource, n_layers: int) -> Params:
@@ -149,11 +158,41 @@ def _lm_head(source: _TensorSource, hf: dict) -> np.ndarray:
     return source.get('embed_tokens.weight').T
 
 
+def _rope_scaling_tuple(hf: dict):
+    """HF rope_scaling → the in-tree (factor, low, high, orig_ctx)
+    tuple; None when absent/default; raise on schemes the in-tree RoPE
+    does not implement (silently dropping one changes attention)."""
+    rs = hf.get('rope_scaling')
+    if not rs:
+        return None
+    rope_type = rs.get('rope_type') or rs.get('type')
+    if rope_type in (None, 'default'):
+        return None
+    if rope_type == 'llama3':
+        return (float(rs['factor']),
+                float(rs.get('low_freq_factor', 1.0)),
+                float(rs.get('high_freq_factor', 4.0)),
+                int(rs['original_max_position_embeddings']))
+    raise ValueError(f'Unsupported rope_scaling type {rope_type!r} '
+                     "(supported: 'llama3', 'default').")
+
+
+def _check_head_dim(hf: dict) -> None:
+    derived = hf['hidden_size'] // hf['num_attention_heads']
+    explicit = hf.get('head_dim')
+    if explicit is not None and explicit != derived:
+        raise ValueError(
+            f"checkpoint head_dim {explicit} != hidden_size/num_heads "
+            f'{derived}; this family config derives head_dim, so the '
+            'converted weights would not reshape (e.g. Mistral-Nemo).')
+
+
 def _convert_llama(source: _TensorSource, dtype):
     import jax.numpy as jnp
     from skypilot_tpu.models import llama
     hf = source.config
     n_layers = hf['num_hidden_layers']
+    _check_head_dim(hf)
     config = llama.LlamaConfig(
         vocab_size=hf['vocab_size'],
         d_model=hf['hidden_size'],
@@ -166,6 +205,7 @@ def _convert_llama(source: _TensorSource, dtype):
         rope_theta=float(hf.get('rope_theta', 10_000.0)),
         norm_eps=float(hf.get('rms_norm_eps', 1e-5)),
         sliding_window=hf.get('sliding_window'),
+        rope_scaling=_rope_scaling_tuple(hf),
         dtype=dtype,
     )
     cast = lambda a: jnp.asarray(a, dtype)
@@ -184,6 +224,9 @@ def _convert_qwen(source: _TensorSource, dtype):
     from skypilot_tpu.models import qwen
     hf = source.config
     n_layers = hf['num_hidden_layers']
+    if _rope_scaling_tuple(hf) is not None:
+        raise ValueError('rope_scaling is not supported for qwen '
+                         'conversion yet.')
     qkv_bias = 'layers.0.self_attn.q_proj.bias' in source
     qk_norm = 'layers.0.self_attn.q_norm.weight' in source
     config = qwen.QwenConfig(
@@ -235,6 +278,9 @@ def _convert_gemma(source: _TensorSource, dtype):
     from skypilot_tpu.models import gemma
     hf = source.config
     n_layers = hf['num_hidden_layers']
+    if _rope_scaling_tuple(hf) is not None:
+        raise ValueError('rope_scaling is not supported for gemma '
+                         'conversion yet.')
     config = gemma.GemmaConfig(
         vocab_size=hf['vocab_size'],
         d_model=hf['hidden_size'],
@@ -263,6 +309,76 @@ def _convert_gemma(source: _TensorSource, dtype):
     return config, params
 
 
+def _convert_mixtral(source: _TensorSource, dtype):
+    import jax.numpy as jnp
+    from skypilot_tpu.models import moe
+    hf = source.config
+    n_layers = hf['num_hidden_layers']
+    n_experts = hf['num_local_experts']
+    _check_head_dim(hf)
+    config = moe.MoEConfig(
+        vocab_size=hf['vocab_size'],
+        d_model=hf['hidden_size'],
+        n_layers=n_layers,
+        n_heads=hf['num_attention_heads'],
+        n_kv_heads=hf.get('num_key_value_heads',
+                          hf['num_attention_heads']),
+        d_ff=hf['intermediate_size'],
+        max_seq_len=hf.get('max_position_embeddings', 8192),
+        rope_theta=float(hf.get('rope_theta', 1e6)),
+        norm_eps=float(hf.get('rms_norm_eps', 1e-5)),
+        sliding_window=hf.get('sliding_window'),
+        rope_scaling=_rope_scaling_tuple(hf),
+        n_experts=n_experts,
+        experts_per_token=hf.get('num_experts_per_tok', 2),
+        dtype=dtype,
+    )
+    cast = lambda a: jnp.asarray(a, dtype)
+    p = 'layers.{i}.'
+
+    def expert_stack(name: str) -> np.ndarray:
+        # [L, E, in, out]: HF stores each expert's [out, in] matrix
+        # separately; w1 = gate (silu input), w3 = up, w2 = down —
+        # routing weights already match (softmax → top-k → renorm).
+        return np.stack([
+            np.stack([source.get(
+                p.format(i=i) +
+                f'block_sparse_moe.experts.{e}.{name}.weight').T
+                for e in range(n_experts)])
+            for i in range(n_layers)])
+
+    layers = {
+        'wq': cast(_stack(source, p + 'self_attn.q_proj.weight',
+                          n_layers, transpose=True)),
+        'wk': cast(_stack(source, p + 'self_attn.k_proj.weight',
+                          n_layers, transpose=True)),
+        'wv': cast(_stack(source, p + 'self_attn.v_proj.weight',
+                          n_layers, transpose=True)),
+        'wo': cast(_stack(source, p + 'self_attn.o_proj.weight',
+                          n_layers, transpose=True)),
+        # Router stays fp32 (routing decisions are precision-sensitive,
+        # matching the in-tree init).
+        'router': jnp.asarray(
+            _stack(source, p + 'block_sparse_moe.gate.weight',
+                   n_layers, transpose=True), jnp.float32),
+        'w_gate': cast(expert_stack('w1')),
+        'w_up': cast(expert_stack('w3')),
+        'w_down': cast(expert_stack('w2')),
+        'attn_norm': cast(_stack(source, p + 'input_layernorm.weight',
+                                 n_layers, transpose=False)),
+        'mlp_norm': cast(_stack(
+            source, p + 'post_attention_layernorm.weight', n_layers,
+            transpose=False)),
+    }
+    params = {
+        'embed': cast(source.get('embed_tokens.weight')),
+        'layers': layers,
+        'final_norm': cast(source.get('norm.weight')),
+        'lm_head': cast(_lm_head(source, hf)),
+    }
+    return config, params
+
+
 def from_hf(src, dtype=None) -> Tuple[Any, Params]:
     """(config, params) from a local HF checkpoint directory or an
     in-memory transformers model. `dtype` defaults to bfloat16."""
@@ -274,6 +390,7 @@ def from_hf(src, dtype=None) -> Tuple[Any, Params]:
         'llama': _convert_llama,
         'qwen': _convert_qwen,
         'gemma': _convert_gemma,
+        'moe': _convert_mixtral,
     }[family](source, dtype)
 
 

@@ -61,6 +61,11 @@ class LlamaConfig:
     # Mistral-style sliding-window attention: each token attends to at
     # most this many recent positions. None = full causal attention.
     sliding_window: Optional[int] = None
+    # Llama-3.1-style RoPE frequency scaling, as a hashable tuple
+    # (factor, low_freq_factor, high_freq_factor, original_ctx) — set
+    # by the HF converter when the checkpoint carries
+    # rope_scaling={rope_type: 'llama3', ...}. None = unscaled.
+    rope_scaling: Optional[Tuple[float, float, float, int]] = None
     # Packed-sequence training: when set to the corpus EOS token id,
     # the training loss derives segment ids from EOS positions inside
     # the jitted step — attention is blocked across document
@@ -305,10 +310,29 @@ def _rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
     return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale
 
 
-def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary embeddings; x [B, S, H, D], positions [B, S]."""
+def _rope(x: jax.Array, positions: jax.Array, theta: float,
+          scaling=None) -> jax.Array:
+    """Rotary embeddings; x [B, S, H, D], positions [B, S].
+
+    `scaling` = (factor, low_freq_factor, high_freq_factor, orig_ctx)
+    applies Llama-3.1's piecewise frequency remap: wavelengths beyond
+    orig_ctx/low divide by `factor`, those under orig_ctx/high stay
+    raw, and the band between interpolates smoothly — matching HF's
+    rope_type='llama3' exactly (converted 3.1 checkpoints depend on
+    it; unscaled frequencies would silently change attention).
+    """
     d = x.shape[-1]
     freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    if scaling is not None:
+        factor, low_f, high_f, orig_ctx = scaling
+        wavelen = 2.0 * jnp.pi / freqs
+        low_wl = orig_ctx / low_f
+        high_wl = orig_ctx / high_f
+        smooth = jnp.clip((orig_ctx / wavelen - low_f) /
+                          (high_f - low_f), 0.0, 1.0)
+        mid = (1.0 - smooth) * freqs / factor + smooth * freqs
+        freqs = jnp.where(wavelen > low_wl, freqs / factor,
+                          jnp.where(wavelen < high_wl, freqs, mid))
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,D/2]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
@@ -519,8 +543,8 @@ def _layer(config: LlamaConfig, mesh: Optional[mesh_lib.Mesh],
         b, s, c.n_kv_heads, hd)
     q = shard(q, ('batch', 'activation_length', 'activation_heads', None))
     k = shard(k, ('batch', 'activation_length', 'activation_kv', None))
-    q = _rope(q, positions, c.rope_theta)
-    k = _rope(k, positions, c.rope_theta)
+    q = _rope(q, positions, c.rope_theta, c.rope_scaling)
+    k = _rope(k, positions, c.rope_theta, c.rope_scaling)
 
     if kv_cache is not None:
         attn, new_cache = slot_cache_attend(

@@ -275,8 +275,8 @@ def _layer(config: MoEConfig, mesh: Optional[mesh_lib.Mesh], x: jax.Array,
     v = qops.matmul(h, lp['wv']).reshape(b, s, c.n_kv_heads, hd)
     q = shard(q, ('batch', 'activation_length', 'activation_heads', None))
     k = shard(k, ('batch', 'activation_length', 'activation_kv', None))
-    q = llama._rope(q, positions, c.rope_theta)
-    k = llama._rope(k, positions, c.rope_theta)
+    q = llama._rope(q, positions, c.rope_theta, c.rope_scaling)
+    k = llama._rope(k, positions, c.rope_theta, c.rope_scaling)
     new_cache = None
     if kv_cache is not None:
         attn, new_cache = llama.slot_cache_attend(

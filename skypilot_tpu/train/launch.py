@@ -177,14 +177,20 @@ def main() -> int:
                     f'{args.model}: expected {ref_shapes}, got '
                     f'{got_shapes}')
             shardings = trainer.state_shardings()[key]
-            # Cast on HOST, then ship straight to each leaf's sharding:
-            # jnp.asarray first would commit every full leaf to device
-            # 0 before resharding — a full-leaf HBM spike per leaf.
+            # Capture dtype metadata, then FREE the randomly
+            # initialized tree before materializing the converted one
+            # — otherwise both full param trees coexist in HBM at the
+            # exact model scale this flag exists for. Cast on HOST and
+            # ship straight to each leaf's sharding (jnp.asarray first
+            # would commit full leaves to device 0 before resharding).
             import numpy as np
+            dtypes = jax.tree.map(lambda a: a.dtype, target)
+            state[key] = None
+            del target
             state[key] = jax.tree.map(
-                lambda a, ref, s: jax.device_put(
-                    np.asarray(a).astype(ref.dtype), s),
-                restored, target, shardings)
+                lambda a, dt, s: jax.device_put(
+                    np.asarray(a).astype(dt), s),
+                restored, dtypes, shardings)
             logger.info(f'Initialized {key} from {args.init_params}.')
 
     feed = None

@@ -193,3 +193,82 @@ def test_finetune_from_converted_checkpoint(tmp_path):
     ], env=env, capture_output=True, text=True, timeout=280)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert 'Initialized params from' in proc.stdout + proc.stderr
+
+
+class TestMixtralParity:
+
+    def test_logits_match_transformers(self):
+        import dataclasses
+        torch.manual_seed(0)
+        hf_model = transformers.MixtralForCausalLM(
+            transformers.MixtralConfig(
+                vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, num_local_experts=4,
+                num_experts_per_tok=2, max_position_embeddings=128,
+                rope_theta=10_000.0,
+                tie_word_embeddings=False)).eval()
+        config, params = convert.from_hf(hf_model, dtype=jnp.float32)
+        assert config.n_experts == 4
+        # HF has no expert-capacity concept: raise ours so nothing is
+        # capacity-dropped and parity is exact.
+        config = dataclasses.replace(config, capacity_factor=8.0)
+        from skypilot_tpu.models import moe
+        ours = moe.forward(config, params,
+                           jnp.asarray(TOKENS, jnp.int32))
+        _assert_close(ours, _hf_logits(hf_model, TOKENS), atol=1e-2)
+
+
+class TestConversionGuards:
+
+    def test_llama31_rope_scaling_parity(self):
+        """rope_type='llama3' frequency remap must match transformers
+        exactly (Llama-3.1 checkpoints depend on it)."""
+        torch.manual_seed(0)
+        hf_model = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=256,
+            rope_theta=10_000.0, tie_word_embeddings=False,
+            rope_scaling={'rope_type': 'llama3', 'factor': 8.0,
+                          'low_freq_factor': 1.0,
+                          'high_freq_factor': 4.0,
+                          'original_max_position_embeddings': 32},
+        )).eval()
+        config, params = convert.from_hf(hf_model, dtype=jnp.float32)
+        assert config.rope_scaling == (8.0, 1.0, 4.0, 32)
+        from skypilot_tpu.models import llama
+        tokens = [[5, 17, 3, 99, 42, 7, 1, 250] * 8]   # 64 positions
+        ours = llama.forward(config, params,
+                             jnp.asarray(tokens, jnp.int32))
+        _assert_close(ours, _hf_logits(hf_model, tokens))
+
+    def test_unsupported_rope_scaling_rejected(self):
+        torch.manual_seed(0)
+        hf_model = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=1, num_attention_heads=4,
+            num_key_value_heads=2,
+            rope_scaling={'rope_type': 'yarn', 'factor': 4.0})).eval()
+        with pytest.raises(ValueError, match='rope_scaling'):
+            convert.from_hf(hf_model)
+
+    def test_explicit_head_dim_mismatch_rejected(self):
+        torch.manual_seed(0)
+        cfg = transformers.MistralConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=1, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=32)   # != 64/4
+        hf_model = transformers.MistralForCausalLM(cfg).eval()
+        with pytest.raises(ValueError, match='head_dim'):
+            convert.from_hf(hf_model)
+
+    def test_gemma2_rejected(self):
+        torch.manual_seed(0)
+        hf_model = transformers.Gemma2ForCausalLM(
+            transformers.Gemma2Config(
+                vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, head_dim=16)).eval()
+        with pytest.raises(ValueError, match='gemma2'):
+            convert.from_hf(hf_model)
