@@ -132,6 +132,18 @@ class ServeMetrics:
                 '# TYPE xsky_serve_queue_depth gauge',
                 f'xsky_serve_queue_depth {orch._pending.qsize()}',
             ]
+            accept = getattr(orch, 'accept_stats', None)
+            if accept is not None:
+                lines += [
+                    '# TYPE xsky_serve_spec_rounds_total counter',
+                    f"xsky_serve_spec_rounds_total {accept['rounds']}",
+                    '# TYPE xsky_serve_spec_proposed_total counter',
+                    f"xsky_serve_spec_proposed_total "
+                    f"{accept['proposed']}",
+                    '# TYPE xsky_serve_spec_accepted_total counter',
+                    f"xsky_serve_spec_accepted_total "
+                    f"{accept['accepted']}",
+                ]
             stats = orch.engine.prefix_cache_stats
             if stats is not None:
                 lines += [

@@ -45,7 +45,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 _shard_map = jax.shard_map
 
-DEFAULT_BLOCK_KV = 256
+from skypilot_tpu.ops.flash_attention import _env_block
+
+# Overridable for per-chip tuning (mirrors the flash kernels'
+# XSKY_FLASH_BLOCK_* knobs).
+DEFAULT_BLOCK_KV = _env_block('XSKY_DECODE_BLOCK_KV', 256)
 _NEG_INF = -1e30
 _LANES = 128
 

@@ -609,3 +609,21 @@ def test_max_queue_sheds_load():
         assert payload['error']['type'] == 'overloaded_error'
     finally:
         httpd.shutdown()
+
+
+def test_metrics_render_speculation_accept_rate():
+    from skypilot_tpu.infer import metrics as metrics_lib
+    params = llama.init(llama.LLAMA_TINY, jax.random.PRNGKey(0))
+    mk = lambda: engine_lib.InferenceEngine(
+        engine_lib.EngineConfig(model=llama.LLAMA_TINY, max_slots=2,
+                                max_target_len=64,
+                                prefill_buckets=(16, 32)), params)
+    ng = orch_lib.NgramSpeculator(mk(), gamma=3)
+    ng.generate([[5, 17, 3]], max_new_tokens=6)
+    text = metrics_lib.ServeMetrics().render(orch=ng)
+    assert 'xsky_serve_spec_rounds_total' in text
+    assert 'xsky_serve_spec_proposed_total' in text
+    # Plain orchestrators emit no speculation series.
+    text2 = metrics_lib.ServeMetrics().render(
+        orch=orch_lib.Orchestrator(mk()))
+    assert 'spec_rounds' not in text2
