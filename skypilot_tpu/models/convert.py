@@ -109,15 +109,8 @@ def _detect_family(hf_config: dict) -> str:
     mt = hf_config.get('model_type', '')
     if mt in ('qwen2', 'qwen3'):
         return 'qwen'
-    if mt == 'gemma':
+    if mt in ('gemma', 'gemma2'):
         return 'gemma'
-    if mt == 'gemma2':
-        # Gemma-2 adds pre/post-feedforward norms, attention logit
-        # softcapping and alternating sliding windows the in-tree
-        # gemma does not model — converting would be silently wrong.
-        raise ValueError("model_type 'gemma2' is not supported yet "
-                         '(extra norms + attn softcap would be '
-                         'silently dropped); gemma-1 converts.')
     if mt in ('llama', 'mistral'):
         return 'llama'
     if mt == 'mixtral':
@@ -278,9 +271,15 @@ def _convert_gemma(source: _TensorSource, dtype):
     from skypilot_tpu.models import gemma
     hf = source.config
     n_layers = hf['num_hidden_layers']
+    gemma2 = hf.get('model_type') == 'gemma2'
     if _rope_scaling_tuple(hf) is not None:
         raise ValueError('rope_scaling is not supported for gemma '
                          'conversion yet.')
+    attn_scale = None
+    if gemma2:
+        scalar = hf.get('query_pre_attn_scalar')
+        if scalar:
+            attn_scale = float(scalar) ** -0.5
     config = gemma.GemmaConfig(
         vocab_size=hf['vocab_size'],
         d_model=hf['hidden_size'],
@@ -295,15 +294,33 @@ def _convert_gemma(source: _TensorSource, dtype):
         rope_theta=float(hf.get('rope_theta', 10_000.0)),
         norm_eps=float(hf.get('rms_norm_eps', 1e-6)),
         final_logit_softcap=hf.get('final_logit_softcapping'),
+        gemma2=gemma2,
+        attn_logit_softcap=(hf.get('attn_logit_softcapping')
+                            if gemma2 else None),
+        attn_scale=attn_scale,
+        sliding_window=hf.get('sliding_window') if gemma2 else None,
         dtype=dtype,
     )
     cast = lambda a: jnp.asarray(a, dtype)
     # Gemma norms share the (1 + w) convention with the in-tree model,
     # so weights map directly; the head is tied to the embedding.
+    layers = {k: cast(v) for k, v in
+              _common_layers(source, n_layers).items()}
+    if gemma2:
+        p = 'layers.{i}.'
+        # Gemma-2 renames: input_layernorm stays the pre-attention
+        # norm; post_attention_layernorm becomes an OUTPUT norm; the
+        # pre-MLP norm is pre_feedforward_layernorm.
+        layers['post_attn_norm'] = layers.pop('mlp_norm')
+        layers['mlp_norm'] = cast(_stack(
+            source, p + 'pre_feedforward_layernorm.weight', n_layers,
+            transpose=False))
+        layers['post_ffw_norm'] = cast(_stack(
+            source, p + 'post_feedforward_layernorm.weight', n_layers,
+            transpose=False))
     params = {
         'embed': cast(source.get('embed_tokens.weight')),
-        'layers': {k: cast(v) for k, v in
-                   _common_layers(source, n_layers).items()},
+        'layers': layers,
         'final_norm': cast(source.get('norm.weight')),
     }
     return config, params

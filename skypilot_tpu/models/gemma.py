@@ -53,13 +53,31 @@ class GemmaConfig:
     attention_impl: str = 'auto'
     # Packed-sequence training (see llama.LlamaConfig.packing_reset_eos).
     packing_reset_eos: Optional[int] = None
+    # Gemma-2 block structure: output norms after the attention and
+    # FFW sublayers (post_attn_norm/post_ffw_norm params), attention
+    # logit softcapping (cap·tanh(s/cap), 50.0 in the release), an
+    # explicit attention scale (query_pre_attn_scalar**-0.5), and a
+    # sliding window on EVEN layers only (the layer scan runs pairs:
+    # one windowed + one global block per step, so n_layers must be
+    # even — every released Gemma-2 is).
+    gemma2: bool = False
+    attn_logit_softcap: Optional[float] = None
+    attn_scale: Optional[float] = None
+    sliding_window: Optional[int] = None
+
+    def __post_init__(self):
+        if self.gemma2 and self.n_layers % 2:
+            raise ValueError('gemma2 needs an even n_layers '
+                             '(the layer scan runs windowed/global '
+                             'pairs).')
 
     def num_params(self) -> int:
         d, f, v = self.d_model, self.d_ff, self.vocab_size
         hd, h, kv = self.head_dim, self.n_heads, self.n_kv_heads
         attn = d * h * hd * 2 + d * kv * hd * 2
         mlp = 3 * d * f
-        per_layer = attn + mlp + 2 * d
+        norms = 4 * d if self.gemma2 else 2 * d
+        per_layer = attn + mlp + norms
         return v * d + self.n_layers * per_layer + d   # tied embedding
 
     def train_flops_per_token(self) -> float:
@@ -76,15 +94,33 @@ GEMMA_TINY = GemmaConfig(vocab_size=256, d_model=64, n_layers=2,
                          max_seq_len=128, remat=False,
                          final_logit_softcap=30.0)
 
+# Gemma-2 (public configs): post-sublayer norms, softcaps 50/30,
+# alternating 4096-token sliding windows, query_pre_attn_scalar scale.
+GEMMA2_2B = GemmaConfig(
+    d_model=2304, n_layers=26, n_heads=8, n_kv_heads=4, head_dim=256,
+    d_ff=9216, gemma2=True, attn_logit_softcap=50.0,
+    final_logit_softcap=30.0, sliding_window=4096,
+    attn_scale=256.0 ** -0.5)
+GEMMA2_9B = GemmaConfig(
+    d_model=3584, n_layers=42, n_heads=16, n_kv_heads=8, head_dim=256,
+    d_ff=14_336, gemma2=True, attn_logit_softcap=50.0,
+    final_logit_softcap=30.0, sliding_window=4096,
+    attn_scale=256.0 ** -0.5)
+GEMMA2_TINY = dataclasses.replace(
+    GEMMA_TINY, gemma2=True, attn_logit_softcap=50.0,
+    sliding_window=8, attn_scale=24.0 ** -0.5)
+
 CONFIGS = {
     'gemma-2b': GEMMA_2B,
     'gemma-7b': GEMMA_7B,
     'gemma-tiny': GEMMA_TINY,
+    'gemma2-2b': GEMMA2_2B,
+    'gemma2-9b': GEMMA2_9B,
+    'gemma2-tiny': GEMMA2_TINY,
 }
 
 
 def logical_axes(config: GemmaConfig) -> Params:
-    del config
     layer = {
         'wq': ('layers', 'embed', 'heads'),
         'wk': ('layers', 'embed', 'kv'),
@@ -96,6 +132,9 @@ def logical_axes(config: GemmaConfig) -> Params:
         'attn_norm': ('layers', 'embed'),
         'mlp_norm': ('layers', 'embed'),
     }
+    if config.gemma2:
+        layer['post_attn_norm'] = ('layers', 'embed')
+        layer['post_ffw_norm'] = ('layers', 'embed')
     return {
         'embed': ('vocab', 'embed'),
         'layers': layer,
@@ -131,6 +170,11 @@ def init(config: GemmaConfig, key: jax.Array) -> Params:
             # Gemma RMSNorm scales by (1 + w): zero-init == identity.
             'attn_norm': jnp.zeros((c.n_layers, c.d_model), c.dtype),
             'mlp_norm': jnp.zeros((c.n_layers, c.d_model), c.dtype),
+            **({'post_attn_norm': jnp.zeros((c.n_layers, c.d_model),
+                                            c.dtype),
+                'post_ffw_norm': jnp.zeros((c.n_layers, c.d_model),
+                                           c.dtype)}
+               if c.gemma2 else {}),
         },
         'final_norm': jnp.zeros((c.d_model,), c.dtype),
     }
@@ -147,10 +191,13 @@ def _layer(config: GemmaConfig, mesh: Optional[mesh_lib.Mesh],
            x: jax.Array, lp: Params, positions: jax.Array,
            kv_cache=None, cache_positions: Optional[jax.Array] = None,
            return_kv: bool = False,
-           segment_ids: Optional[jax.Array] = None):
+           segment_ids: Optional[jax.Array] = None,
+           window: Optional[int] = None):
     """One block. Returns x (training) or (x, new_kv) when the caller
     asked for cache handling (prefill/decode; same slot contract as
-    llama._layer)."""
+    llama._layer). Gemma-2 adds post-sublayer norms, attention
+    softcapping, an explicit scale, and a caller-chosen window (the
+    pair scan passes it on even layers only)."""
     c = config
     hd = c.head_dim
     b, s, _ = x.shape
@@ -179,19 +226,27 @@ def _layer(config: GemmaConfig, mesh: Optional[mesh_lib.Mesh],
             new_cache = (k, v)
         attn = attention_ops.dot_product_attention(
             q, k, v, causal=True, implementation=c.attention_impl,
-            segment_ids=segment_ids)
+            segment_ids=segment_ids, window=window,
+            logit_softcap=c.attn_logit_softcap, scale=c.attn_scale)
     attn = attn.reshape(b, s, c.n_heads * hd)
-    x = x + shard(qops.matmul(attn, lp['wo']),
-                  ('batch', 'activation_length', 'activation_embed'))
+    attn_out = shard(qops.matmul(attn, lp['wo']),
+                     ('batch', 'activation_length', 'activation_embed'))
+    if c.gemma2:
+        attn_out = _rms_norm(attn_out, lp['post_attn_norm'], c.norm_eps)
+    x = x + attn_out
 
-    h = _rms_norm(x, lp['mlp_norm'], c.norm_eps)
+    pre_ffw = lp['mlp_norm']   # gemma2: pre_feedforward_layernorm
+    h = _rms_norm(x, pre_ffw, c.norm_eps)
     gate = jax.nn.gelu(qops.matmul(h, lp['w_gate']).astype(jnp.float32),
                        approximate=True)
     up = qops.matmul(h, lp['w_up']).astype(jnp.float32)
     ff = shard((gate * up).astype(c.dtype),
                ('batch', 'activation_length', 'activation_mlp'))
-    x = x + shard(qops.matmul(ff, lp['w_down']),
-                  ('batch', 'activation_length', 'activation_embed'))
+    ffw_out = shard(qops.matmul(ff, lp['w_down']),
+                    ('batch', 'activation_length', 'activation_embed'))
+    if c.gemma2:
+        ffw_out = _rms_norm(ffw_out, lp['post_ffw_norm'], c.norm_eps)
+    x = x + ffw_out
     if wants_kv:
         return x, new_cache
     return x
@@ -213,6 +268,33 @@ def _trunk(config: GemmaConfig, params: Params, tokens: jax.Array,
     if mesh is not None:
         x = mesh_lib.shard_logical(
             x, mesh, ('batch', 'activation_length', 'activation_embed'))
+
+    if c.gemma2:
+        if return_kv:
+            raise NotImplementedError(
+                'gemma2 serving (per-layer alternating windows + '
+                'softcap in the decode cache path) is not wired yet; '
+                'training/forward only.')
+        # Alternating windows: scan PAIRS (windowed even layer, global
+        # odd layer) so the window stays a static kernel parameter.
+        paired = jax.tree.map(
+            lambda a: a.reshape((a.shape[0] // 2, 2) + a.shape[1:]),
+            params['layers'])
+
+        def pair_fn(x, lp2):
+            even = jax.tree.map(lambda a: a[0], lp2)
+            odd = jax.tree.map(lambda a: a[1], lp2)
+            x = _layer(c, mesh, x, even, positions,
+                       segment_ids=segment_ids, window=c.sliding_window)
+            x = _layer(c, mesh, x, odd, positions,
+                       segment_ids=segment_ids, window=None)
+            return x, None
+
+        if c.remat:
+            pair_fn = jax.checkpoint(pair_fn,
+                                     policy=llama._remat_policy(c))
+        x, kv = jax.lax.scan(pair_fn, x, paired)
+        return _rms_norm(x, params['final_norm'], c.norm_eps), kv
 
     def layer_fn(x, lp):
         if return_kv:
@@ -259,6 +341,13 @@ def _nll_mean(config: GemmaConfig, logits: jax.Array,
         return jnp.sum(nll * loss_mask) / jnp.maximum(
             jnp.sum(loss_mask), 1.0)
     return jnp.mean(nll)
+
+
+def pipeline_supported(config: GemmaConfig) -> bool:
+    """gemma2's alternating windows are not threaded through the GPipe
+    schedule yet — pipelining it would silently train full-attention
+    even layers."""
+    return not config.gemma2
 
 
 def pipelined_loss_fn(config: GemmaConfig, params: Params,
@@ -315,6 +404,10 @@ def verify_forward(config: GemmaConfig, params: Params,
     (llama.verify_forward twin, with the scaled embedding and tied
     soft-capped head): tokens/positions [B, S] →
     (logits [B, S, V], new kv)."""
+    if config.gemma2:
+        raise NotImplementedError(
+            'gemma2 serving is not wired yet (alternating windows + '
+            'softcap in the cache path); training/forward only.')
     c = config
     x = qops.embed_rows(params['embed'], tokens).astype(c.dtype)
     x = x * jnp.asarray(c.d_model ** 0.5, c.dtype)
@@ -337,6 +430,10 @@ def decode_forward(config: GemmaConfig, params: Params,
                    kv, mesh: Optional[mesh_lib.Mesh] = None):
     """One decode step for a batch of slots (llama.decode_forward twin,
     with the tied soft-capped head)."""
+    if config.gemma2:
+        raise NotImplementedError(
+            'gemma2 serving is not wired yet (alternating windows + '
+            'softcap in the cache path); training/forward only.')
     c = config
     x = qops.embed_rows(params['embed'], last_tokens[:, None]).astype(c.dtype)
     x = x * jnp.asarray(c.d_model ** 0.5, c.dtype)

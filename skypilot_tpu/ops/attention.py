@@ -31,20 +31,27 @@ def xla_attention(q: jax.Array,
                   v: jax.Array,
                   causal: bool = True,
                   segment_ids: Optional[jax.Array] = None,
-                  window: Optional[int] = None) -> jax.Array:
+                  window: Optional[int] = None,
+                  logit_softcap: Optional[float] = None,
+                  scale: Optional[float] = None) -> jax.Array:
     """Reference attention in pure XLA (fp32 softmax).
 
     window: sliding-window size W (Mistral-style) — each query attends
     to at most the W most recent positions (inclusive of itself).
+    logit_softcap: Gemma-2's cap·tanh(s/cap) on the scores (before
+    masking). scale: score multiplier (default head_dim**-0.5 —
+    Gemma-2 uses query_pre_attn_scalar**-0.5 instead).
     """
     b, s_q, h, d = q.shape
     s_kv = k.shape[1]
     groups = h // k.shape[2]
     k = _repeat_kv(k, groups)
     v = _repeat_kv(v, groups)
-    scale = d ** -0.5
+    scale = d ** -0.5 if scale is None else scale
     logits = jnp.einsum('bqhd,bkhd->bhqk', q, k,
                         preferred_element_type=jnp.float32) * scale
+    if logit_softcap is not None:
+        logits = logit_softcap * jnp.tanh(logits / logit_softcap)
     if causal or window is not None:
         q_pos = jnp.arange(s_q)[:, None] + (s_kv - s_q)
         kv_pos = jnp.arange(s_kv)[None, :]
@@ -61,7 +68,9 @@ def xla_attention(q: jax.Array,
 
 
 def xla_attention_with_mask(q: jax.Array, k: jax.Array, v: jax.Array,
-                            mask: jax.Array) -> jax.Array:
+                            mask: jax.Array,
+                            logit_softcap: Optional[float] = None,
+                            scale: Optional[float] = None) -> jax.Array:
     """Attention with an explicit boolean mask [B, 1|H, S_q|1, S_kv].
 
     Used by the decode path (KV-cache validity mask).
@@ -70,9 +79,11 @@ def xla_attention_with_mask(q: jax.Array, k: jax.Array, v: jax.Array,
     groups = h // k.shape[2]
     k = _repeat_kv(k, groups)
     v = _repeat_kv(v, groups)
-    scale = d ** -0.5
+    scale = d ** -0.5 if scale is None else scale
     logits = jnp.einsum('bqhd,bkhd->bhqk', q, k,
                         preferred_element_type=jnp.float32) * scale
+    if logit_softcap is not None:
+        logits = logit_softcap * jnp.tanh(logits / logit_softcap)
     logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     return jnp.einsum('bhqk,bkhd->bqhd', probs.astype(v.dtype), v)
@@ -84,13 +95,19 @@ def dot_product_attention(q: jax.Array,
                           causal: bool = True,
                           segment_ids: Optional[jax.Array] = None,
                           implementation: str = 'auto',
-                          window: Optional[int] = None) -> jax.Array:
+                          window: Optional[int] = None,
+                          logit_softcap: Optional[float] = None,
+                          scale: Optional[float] = None) -> jax.Array:
     """Dispatching attention entry point used by the models.
 
     implementation: 'auto' | 'xla' | 'flash'; window: sliding-window
     size (both paths support it; flash also SKIPS the out-of-window
     blocks, so long-context sliding-window runs in O(S·W)).
+    logit_softcap / non-default scale (Gemma-2) run the XLA path — the
+    flash kernels do not implement the tanh cap yet, and a silently
+    uncapped kernel would change the model.
     """
+    special = logit_softcap is not None or scale is not None
     if implementation == 'auto':
         # device_kind, not platform: TPU chips reached through a remote
         # PJRT plugin (e.g. an 'axon' tunnel) report platform != 'tpu'
@@ -99,12 +116,19 @@ def dot_product_attention(q: jax.Array,
             d.platform == 'tpu' or
             getattr(d, 'device_kind', '').startswith('TPU')
             for d in jax.devices())
-        use_flash = on_tpu and q.shape[1] >= _FLASH_MIN_SEQ and causal
+        use_flash = (on_tpu and q.shape[1] >= _FLASH_MIN_SEQ and causal
+                     and not special)
         implementation = 'flash' if use_flash else 'xla'
     if implementation == 'flash':
+        if special:
+            raise NotImplementedError(
+                'logit_softcap / custom scale are not implemented in '
+                'the flash kernels; use implementation="xla" (or '
+                '"auto", which picks it).')
         from skypilot_tpu.ops import flash_attention
         return flash_attention.flash_attention(q, k, v, causal=causal,
                                                window=window,
                                                segment_ids=segment_ids)
     return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids,
-                         window=window)
+                         window=window, logit_softcap=logit_softcap,
+                         scale=scale)

@@ -263,12 +263,28 @@ class TestConversionGuards:
         with pytest.raises(ValueError, match='head_dim'):
             convert.from_hf(hf_model)
 
-    def test_gemma2_rejected(self):
+    def test_gemma2_logits_match_transformers(self):
+        """Gemma-2: post-sublayer norms, attn softcapping, explicit
+        attention scale, alternating sliding windows — all must match
+        HF's eager implementation (sdpa skips softcapping)."""
         torch.manual_seed(0)
         hf_model = transformers.Gemma2ForCausalLM(
             transformers.Gemma2Config(
                 vocab_size=256, hidden_size=64, intermediate_size=128,
-                num_hidden_layers=2, num_attention_heads=4,
-                num_key_value_heads=2, head_dim=16)).eval()
-        with pytest.raises(ValueError, match='gemma2'):
-            convert.from_hf(hf_model)
+                num_hidden_layers=4, num_attention_heads=4,
+                num_key_value_heads=2, head_dim=16,
+                max_position_embeddings=128,
+                query_pre_attn_scalar=24,      # != head_dim: scale path
+                attn_logit_softcapping=50.0,
+                final_logit_softcapping=30.0,
+                sliding_window=4,              # tighter than the prompt
+                hidden_act='gelu_pytorch_tanh',
+                attn_implementation='eager')).eval()
+        config, params = convert.from_hf(hf_model, dtype=jnp.float32)
+        assert config.gemma2 and config.sliding_window == 4
+        assert config.attn_scale == pytest.approx(24 ** -0.5)
+        from skypilot_tpu.models import gemma
+        tokens = [[5, 17, 3, 99, 42, 7, 1, 250, 9, 11, 13, 15]]
+        ours = gemma.forward(config, params,
+                             jnp.asarray(tokens, jnp.int32))
+        _assert_close(ours, _hf_logits(hf_model, tokens), atol=1e-2)
