@@ -220,7 +220,8 @@ def _layer(config: GemmaConfig, mesh: Optional[mesh_lib.Mesh],
     if kv_cache is not None:
         attn, new_cache = llama.slot_cache_attend(
             q, k, v, kv_cache, cache_positions=cache_positions,
-            mesh=mesh)
+            mesh=mesh, window=window,
+            logit_softcap=c.attn_logit_softcap, scale=c.attn_scale)
     else:
         if return_kv:
             new_cache = (k, v)
@@ -270,11 +271,6 @@ def _trunk(config: GemmaConfig, params: Params, tokens: jax.Array,
             x, mesh, ('batch', 'activation_length', 'activation_embed'))
 
     if c.gemma2:
-        if return_kv:
-            raise NotImplementedError(
-                'gemma2 serving (per-layer alternating windows + '
-                'softcap in the decode cache path) is not wired yet; '
-                'training/forward only.')
         # Alternating windows: scan PAIRS (windowed even layer, global
         # odd layer) so the window stays a static kernel parameter.
         paired = jax.tree.map(
@@ -284,16 +280,28 @@ def _trunk(config: GemmaConfig, params: Params, tokens: jax.Array,
         def pair_fn(x, lp2):
             even = jax.tree.map(lambda a: a[0], lp2)
             odd = jax.tree.map(lambda a: a[1], lp2)
+            if return_kv:
+                x, kv_e = _layer(c, mesh, x, even, positions,
+                                 return_kv=True,
+                                 window=c.sliding_window)
+                x, kv_o = _layer(c, mesh, x, odd, positions,
+                                 return_kv=True, window=None)
+                return x, {'k': jnp.stack([kv_e[0], kv_o[0]]),
+                           'v': jnp.stack([kv_e[1], kv_o[1]])}
             x = _layer(c, mesh, x, even, positions,
                        segment_ids=segment_ids, window=c.sliding_window)
             x = _layer(c, mesh, x, odd, positions,
                        segment_ids=segment_ids, window=None)
             return x, None
 
-        if c.remat:
+        if c.remat and not return_kv:
             pair_fn = jax.checkpoint(pair_fn,
                                      policy=llama._remat_policy(c))
         x, kv = jax.lax.scan(pair_fn, x, paired)
+        if return_kv:
+            # [L/2, 2, …] pair layout back to the engine's [L, …].
+            kv = jax.tree.map(
+                lambda a: a.reshape((-1,) + a.shape[2:]), kv)
         return _rms_norm(x, params['final_norm'], c.norm_eps), kv
 
     def layer_fn(x, lp):
@@ -404,13 +412,15 @@ def verify_forward(config: GemmaConfig, params: Params,
     (llama.verify_forward twin, with the scaled embedding and tied
     soft-capped head): tokens/positions [B, S] →
     (logits [B, S, V], new kv)."""
-    if config.gemma2:
-        raise NotImplementedError(
-            'gemma2 serving is not wired yet (alternating windows + '
-            'softcap in the cache path); training/forward only.')
     c = config
     x = qops.embed_rows(params['embed'], tokens).astype(c.dtype)
     x = x * jnp.asarray(c.d_model ** 0.5, c.dtype)
+
+    if c.gemma2:
+        x, new_kv = _cached_pair_scan(c, params, x, positions,
+                                      positions, kv, mesh)
+        x = _rms_norm(x, params['final_norm'], c.norm_eps)
+        return lm_logits(c, params, x), new_kv
 
     def layer_fn(x, scanned):
         lp, ck, cv = scanned
@@ -425,19 +435,56 @@ def verify_forward(config: GemmaConfig, params: Params,
     return lm_logits(c, params, x), new_kv
 
 
+def _cached_pair_scan(c: GemmaConfig, params: Params, x, pos_2d,
+                      positions, kv, mesh):
+    """Decode-path layer scan for Gemma-2: windowed/global PAIRS over
+    pair-reshaped cache leaves (works for plain arrays AND the int8
+    (values, scale) tuples — everything moves through jax.tree ops).
+    Returns (x, new_kv in the engine's [L, …] layout)."""
+    pair = lambda t: jax.tree.map(
+        lambda a: a.reshape((a.shape[0] // 2, 2) + a.shape[1:]), t)
+    unpair = lambda t: jax.tree.map(
+        lambda a: a.reshape((-1,) + a.shape[2:]), t)
+
+    def pair_fn(x, scanned):
+        lp2, ck2, cv2 = scanned
+        new_k, new_v = [], []
+        for idx, win in ((0, c.sliding_window), (1, None)):
+            lp = jax.tree.map(lambda a: a[idx], lp2)
+            ck = jax.tree.map(lambda a: a[idx], ck2)
+            cv = jax.tree.map(lambda a: a[idx], cv2)
+            x, new_cache = _layer(c, mesh, x, lp, pos_2d,
+                                  kv_cache=(ck, cv),
+                                  cache_positions=positions,
+                                  window=win)
+            new_k.append(new_cache[0])
+            new_v.append(new_cache[1])
+        stack = lambda pair_: jax.tree.map(
+            lambda a, b: jnp.stack([a, b]), pair_[0], pair_[1])
+        return x, {'k': stack(new_k), 'v': stack(new_v)}
+
+    x, new_kv = jax.lax.scan(
+        pair_fn, x,
+        (pair(params['layers']), pair(kv['k']), pair(kv['v'])))
+    return x, unpair(new_kv)
+
+
 def decode_forward(config: GemmaConfig, params: Params,
                    last_tokens: jax.Array, positions: jax.Array,
                    kv, mesh: Optional[mesh_lib.Mesh] = None):
     """One decode step for a batch of slots (llama.decode_forward twin,
-    with the tied soft-capped head)."""
-    if config.gemma2:
-        raise NotImplementedError(
-            'gemma2 serving is not wired yet (alternating windows + '
-            'softcap in the cache path); training/forward only.')
+    with the tied soft-capped head; Gemma-2 runs the windowed/global
+    pair scan with softcap + scale in the masked attend)."""
     c = config
     x = qops.embed_rows(params['embed'], last_tokens[:, None]).astype(c.dtype)
     x = x * jnp.asarray(c.d_model ** 0.5, c.dtype)
     pos = positions[:, None]
+
+    if c.gemma2:
+        x, new_kv = _cached_pair_scan(c, params, x, pos, positions,
+                                      kv, mesh)
+        x = _rms_norm(x, params['final_norm'], c.norm_eps)
+        return lm_logits(c, params, x)[:, 0], new_kv
 
     def layer_fn(x, scanned):
         lp, ck, cv = scanned

@@ -413,7 +413,8 @@ def write_cache_slot(cache_entry, values: jax.Array, slot) -> Any:
 
 def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
                       kv_cache, cache_index=None, cache_positions=None,
-                      window=None, mesh=None):
+                      window=None, mesh=None, logit_softcap=None,
+                      scale=None):
     """Write this step's K/V into the slot cache and attend over it.
 
     The decode-path cache contract shared by every family (llama, qwen,
@@ -442,8 +443,12 @@ def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
         # Multi-token per-slot write [B, S] (speculative verify: each
         # slot scores S proposed tokens at its own offsets in one pass).
         slots = jnp.arange(b)[:, None]
-        ck = ck.at[slots, cache_positions].set(k_write)
-        cv = cv.at[slots, cache_positions].set(v_write)
+        # Explicit cast: a mixed-dtype scatter (f32 model into a bf16
+        # cache) is a FutureWarning today and an error in future JAX.
+        ck = ck.at[slots, cache_positions].set(
+            k_write.astype(ck.dtype))
+        cv = cv.at[slots, cache_positions].set(
+            v_write.astype(cv.dtype))
         if quantized:
             ck_scale = ck_scale.at[slots, cache_positions].set(
                 k_scale_write)
@@ -452,8 +457,10 @@ def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
         q_pos = cache_positions                         # [b, s]
     elif cache_positions is not None:
         slots = jnp.arange(b)
-        ck = ck.at[slots, cache_positions].set(k_write[:, 0])
-        cv = cv.at[slots, cache_positions].set(v_write[:, 0])
+        ck = ck.at[slots, cache_positions].set(
+            k_write[:, 0].astype(ck.dtype))
+        cv = cv.at[slots, cache_positions].set(
+            v_write[:, 0].astype(cv.dtype))
         if quantized:
             ck_scale = ck_scale.at[slots, cache_positions].set(
                 k_scale_write[:, 0])
@@ -461,10 +468,10 @@ def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
                 v_scale_write[:, 0])
         q_pos = cache_positions[:, None]                # [b, 1]
     else:
-        ck = jax.lax.dynamic_update_slice_in_dim(ck, k_write, cache_index,
-                                                 axis=1)
-        cv = jax.lax.dynamic_update_slice_in_dim(cv, v_write, cache_index,
-                                                 axis=1)
+        ck = jax.lax.dynamic_update_slice_in_dim(
+            ck, k_write.astype(ck.dtype), cache_index, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(
+            cv, v_write.astype(cv.dtype), cache_index, axis=1)
         if quantized:
             ck_scale = jax.lax.dynamic_update_slice_in_dim(
                 ck_scale, k_scale_write, cache_index, axis=1)
@@ -479,8 +486,13 @@ def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
         new_cache = (ck, cv)
         cache_k, cache_v = ck, cv
 
+    # NOTE: softcap/scale exclude the Pallas decode kernel (it has
+    # neither yet) — Gemma-2 decode therefore runs the padded-cache
+    # XLA attend; in-kernel tanh capping is the known follow-up for
+    # Gemma-2 serving throughput.
     if (cache_positions is not None and s == 1
             and cache_positions.ndim == 1
+            and logit_softcap is None and scale is None
             and ck.shape[1] % min(decode_ops.DEFAULT_BLOCK_KV,
                                   ck.shape[1]) == 0
             and (mesh is None or decode_ops.shardable_on(
@@ -507,8 +519,9 @@ def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
         v_full = dequantize_kv(cv, cv_scale, q.dtype)
     else:
         k_full, v_full = ck, cv
-    attn = attention_ops.xla_attention_with_mask(q, k_full, v_full,
-                                                 valid[:, None])
+    attn = attention_ops.xla_attention_with_mask(
+        q, k_full, v_full, valid[:, None],
+        logit_softcap=logit_softcap, scale=scale)
     return attn, new_cache
 
 

@@ -149,15 +149,29 @@ class TestGemma2:
         assert float(jnp.abs(base - no_window).max()) > 1e-4
         assert float(jnp.abs(base - no_cap).max()) > 1e-4
 
-    def test_serving_gated_loudly(self):
+    def test_cached_decode_matches_full_forward(self):
+        """Gemma-2 serving: the pair-scan decode path (alternating
+        windows + softcap in the masked attend) must reproduce
+        full-forward greedy token-for-token."""
         from skypilot_tpu.infer import engine as engine_lib
-        params = gemma.init(gemma.GEMMA2_TINY, jax.random.PRNGKey(0))
+        from skypilot_tpu.infer import orchestrator as orch_lib
+        c = gemma.GEMMA2_TINY
+        params = gemma.init(c, jax.random.PRNGKey(0))
+        prompt = [5, 17, 3, 99, 42, 7, 8, 9, 10, 11, 12, 13]
+        n_new = 6
+        tokens = list(prompt)
+        for _ in range(n_new):
+            logits = gemma.forward(c, params,
+                                   jnp.asarray([tokens], jnp.int32))
+            tokens.append(int(jnp.argmax(logits[0, -1])))
+        expected = tokens[len(prompt):]
         engine = engine_lib.InferenceEngine(
-            engine_lib.EngineConfig(model=gemma.GEMMA2_TINY, max_slots=2,
+            engine_lib.EngineConfig(model=c, max_slots=2,
                                     max_target_len=32,
                                     prefill_buckets=(16,)), params)
-        with pytest.raises(NotImplementedError, match='gemma2'):
-            engine.prefill([1, 2, 3])
+        out = orch_lib.Orchestrator(engine).generate(
+            [prompt], max_new_tokens=n_new)
+        assert out[0] == expected
 
     def test_odd_layer_count_rejected(self):
         import dataclasses as dc

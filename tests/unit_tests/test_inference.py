@@ -788,3 +788,22 @@ def test_penalties_on_sharded_mesh(monkeypatch):
         return request.output_tokens
 
     assert run(sharded) == run(plain)
+
+
+def test_gemma2_int8_kv_decodes():
+    """Gemma-2's pair scan over QUANTIZED (values, scale) cache tuples:
+    int8 KV greedy equals bf16 greedy (the pair reshape must keep
+    values and scales together)."""
+    from skypilot_tpu.models import gemma
+    params = gemma.init(gemma.GEMMA2_TINY, jax.random.PRNGKey(0))
+    mk = lambda dtype: engine_lib.InferenceEngine(
+        engine_lib.EngineConfig(model=gemma.GEMMA2_TINY, max_slots=2,
+                                max_target_len=32,
+                                prefill_buckets=(16,),
+                                kv_dtype=dtype), params)
+    prompt = [5, 17, 3, 99, 42]
+    out_ref = orch_lib.Orchestrator(mk(jnp.bfloat16)).generate(
+        [prompt], max_new_tokens=6)
+    out_q = orch_lib.Orchestrator(mk(jnp.int8)).generate(
+        [prompt], max_new_tokens=6)
+    assert out_q == out_ref
