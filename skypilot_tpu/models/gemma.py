@@ -273,9 +273,7 @@ def _trunk(config: GemmaConfig, params: Params, tokens: jax.Array,
     if c.gemma2:
         # Alternating windows: scan PAIRS (windowed even layer, global
         # odd layer) so the window stays a static kernel parameter.
-        paired = jax.tree.map(
-            lambda a: a.reshape((a.shape[0] // 2, 2) + a.shape[1:]),
-            params['layers'])
+        paired = _pair(params['layers'])
 
         def pair_fn(x, lp2):
             even = jax.tree.map(lambda a: a[0], lp2)
@@ -300,8 +298,7 @@ def _trunk(config: GemmaConfig, params: Params, tokens: jax.Array,
         x, kv = jax.lax.scan(pair_fn, x, paired)
         if return_kv:
             # [L/2, 2, …] pair layout back to the engine's [L, …].
-            kv = jax.tree.map(
-                lambda a: a.reshape((-1,) + a.shape[2:]), kv)
+            kv = _unpair(kv)
         return _rms_norm(x, params['final_norm'], c.norm_eps), kv
 
     def layer_fn(x, lp):
@@ -435,16 +432,24 @@ def verify_forward(config: GemmaConfig, params: Params,
     return lm_logits(c, params, x), new_kv
 
 
+def _pair(t):
+    """[L, …] layer-stacked leaves → [L/2, 2, …] windowed/global
+    pairs (one layout convention for _trunk and the cache scans)."""
+    return jax.tree.map(
+        lambda a: a.reshape((a.shape[0] // 2, 2) + a.shape[1:]), t)
+
+
+def _unpair(t):
+    return jax.tree.map(lambda a: a.reshape((-1,) + a.shape[2:]), t)
+
+
 def _cached_pair_scan(c: GemmaConfig, params: Params, x, pos_2d,
                       positions, kv, mesh):
     """Decode-path layer scan for Gemma-2: windowed/global PAIRS over
     pair-reshaped cache leaves (works for plain arrays AND the int8
     (values, scale) tuples — everything moves through jax.tree ops).
     Returns (x, new_kv in the engine's [L, …] layout)."""
-    pair = lambda t: jax.tree.map(
-        lambda a: a.reshape((a.shape[0] // 2, 2) + a.shape[1:]), t)
-    unpair = lambda t: jax.tree.map(
-        lambda a: a.reshape((-1,) + a.shape[2:]), t)
+    pair, unpair = _pair, _unpair
 
     def pair_fn(x, scanned):
         lp2, ck2, cv2 = scanned

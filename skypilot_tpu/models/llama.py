@@ -486,13 +486,8 @@ def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
         new_cache = (ck, cv)
         cache_k, cache_v = ck, cv
 
-    # NOTE: softcap/scale exclude the Pallas decode kernel (it has
-    # neither yet) — Gemma-2 decode therefore runs the padded-cache
-    # XLA attend; in-kernel tanh capping is the known follow-up for
-    # Gemma-2 serving throughput.
     if (cache_positions is not None and s == 1
             and cache_positions.ndim == 1
-            and logit_softcap is None and scale is None
             and ck.shape[1] % min(decode_ops.DEFAULT_BLOCK_KV,
                                   ck.shape[1]) == 0
             and (mesh is None or decode_ops.shardable_on(
@@ -502,9 +497,11 @@ def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
         # live blocks (per-slot length bound via scalar prefetch) and
         # dequantizes int8 entries in VMEM — the padded-cache XLA path
         # below reads max_len rows per slot regardless of true length.
+        # Gemma-2's softcap/scale apply in-kernel.
         attn = decode_ops.decode_attention(
             q, cache_k, cache_v, lengths=cache_positions + 1,
-            window=window, mesh=mesh)
+            window=window, mesh=mesh, logit_softcap=logit_softcap,
+            scale=scale)
         return attn, new_cache
 
     # Per-QUERY validity (a multi-token step's earlier rows must not

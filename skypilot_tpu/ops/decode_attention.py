@@ -73,7 +73,7 @@ def _first_block(length, block_kv: int, window):
 def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, k_scale_ref,
                    v_scale_ref, o_ref, acc_ref, m_ref, l_ref, *,
                    scale: float, block_kv: int, window,
-                   quantized: bool, h_kv: int):
+                   quantized: bool, h_kv: int, logit_softcap=None):
     b = pl.program_id(0)
     ki = pl.program_id(1)
     num_ki = pl.num_programs(1)
@@ -113,6 +113,10 @@ def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, k_scale_ref,
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale  # [grp,bkv]
+            if logit_softcap is not None:
+                # Gemma-2: cap·tanh(s/cap) BEFORE masking, matching
+                # the XLA reference and HF eager.
+                s = logit_softcap * jnp.tanh(s / logit_softcap)
             s = jnp.where(keep, s, _NEG_INF)
 
             m_prev = m_ref[hi, :, 0:1]
@@ -151,13 +155,16 @@ def shardable_on(mesh, b: int, h_kv: int) -> bool:
 def decode_attention(q: jax.Array, k_cache, v_cache, lengths: jax.Array,
                      window: Optional[int] = None,
                      block_kv: int = DEFAULT_BLOCK_KV,
-                     mesh=None) -> jax.Array:
+                     mesh=None, logit_softcap: Optional[float] = None,
+                     scale: Optional[float] = None) -> jax.Array:
     """Single-token decode attention over the slot cache.
 
     q: [B, 1, H, D]; k_cache/v_cache: [B, K, Hkv, D] arrays or
     (int8 values, fp32 scale [B, K, Hkv, 1]) pairs; lengths: [B] —
     rows < lengths[b] are live for slot b (the step's own K/V must
     already be written at position lengths[b]-1). Returns [B, 1, H, D].
+    logit_softcap / scale: Gemma-2's cap·tanh(s/cap) and explicit
+    score multiplier (default head_dim**-0.5).
 
     With a mesh, the kernel runs as a shard_map island: slots split
     over ('data','fsdp') and KV heads over 'tensor' (the engine's
@@ -176,7 +183,9 @@ def decode_attention(q: jax.Array, k_cache, v_cache, lengths: jax.Array,
 
         def local(q, k_cache, v_cache, lengths):
             return decode_attention(q, k_cache, v_cache, lengths,
-                                    window=window, block_kv=block_kv)
+                                    window=window, block_kv=block_kv,
+                                    logit_softcap=logit_softcap,
+                                    scale=scale)
 
         return _shard_map(
             local, mesh=mesh,
@@ -235,8 +244,9 @@ def decode_attention(q: jax.Array, k_cache, v_cache, lengths: jax.Array,
     scale_block = ((1, block_kv, h_kv, 1) if quantized
                    else (1, 1, 1, 1))
     kernel = functools.partial(
-        _decode_kernel, scale=d ** -0.5, block_kv=block_kv,
-        window=window, quantized=quantized, h_kv=h_kv)
+        _decode_kernel, scale=d ** -0.5 if scale is None else scale,
+        block_kv=block_kv, window=window, quantized=quantized,
+        h_kv=h_kv, logit_softcap=logit_softcap)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, num_blocks),

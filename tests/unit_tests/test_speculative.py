@@ -283,3 +283,23 @@ class TestNgramSpeculator:
         expected = orch_lib.Orchestrator(mk()).generate(
             [[5, 17, 3]], max_new_tokens=6)[0]
         assert greedy.output_tokens == expected
+
+
+def test_gemma2_target_speculative_exact(monkeypatch):
+    """Speculation with a Gemma-2 TARGET: the pair-scan verify path
+    (alternating windows + softcap in the multi-token attend) must
+    keep outputs exactly equal to plain greedy decoding."""
+    monkeypatch.setenv('XSKY_DECODE_ATTN', 'xla')
+    from skypilot_tpu.models import gemma
+    params = gemma.init(gemma.GEMMA2_TINY, jax.random.PRNGKey(0))
+    mk = lambda: engine_lib.InferenceEngine(
+        engine_lib.EngineConfig(model=gemma.GEMMA2_TINY, max_slots=2,
+                                max_target_len=64,
+                                prefill_buckets=(16, 32)), params)
+    prompt = [5, 17, 3, 99, 42, 7, 8, 9, 10, 11, 12, 13]
+    expected = orch_lib.Orchestrator(mk()).generate(
+        [prompt], max_new_tokens=10)
+    spec = orch_lib.SpeculativeOrchestrator(mk(), mk(), gamma=3)
+    assert spec.generate([prompt], max_new_tokens=10) == expected
+    ng = orch_lib.NgramSpeculator(mk(), gamma=3)
+    assert ng.generate([prompt], max_new_tokens=10) == expected
