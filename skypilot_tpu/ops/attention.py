@@ -103,11 +103,10 @@ def dot_product_attention(q: jax.Array,
     implementation: 'auto' | 'xla' | 'flash'; window: sliding-window
     size (both paths support it; flash also SKIPS the out-of-window
     blocks, so long-context sliding-window runs in O(S·W)).
-    logit_softcap / non-default scale (Gemma-2) run the XLA path — the
-    flash kernels do not implement the tanh cap yet, and a silently
-    uncapped kernel would change the model.
+    logit_softcap / non-default scale (Gemma-2) are supported by BOTH
+    paths (the flash kernels apply the tanh cap in fwd and carry its
+    (1 - tanh²) chain factor through the FA2 backward recompute).
     """
-    special = logit_softcap is not None or scale is not None
     if implementation == 'auto':
         # device_kind, not platform: TPU chips reached through a remote
         # PJRT plugin (e.g. an 'axon' tunnel) report platform != 'tpu'
@@ -116,19 +115,14 @@ def dot_product_attention(q: jax.Array,
             d.platform == 'tpu' or
             getattr(d, 'device_kind', '').startswith('TPU')
             for d in jax.devices())
-        use_flash = (on_tpu and q.shape[1] >= _FLASH_MIN_SEQ and causal
-                     and not special)
+        use_flash = on_tpu and q.shape[1] >= _FLASH_MIN_SEQ and causal
         implementation = 'flash' if use_flash else 'xla'
     if implementation == 'flash':
-        if special:
-            raise NotImplementedError(
-                'logit_softcap / custom scale are not implemented in '
-                'the flash kernels; use implementation="xla" (or '
-                '"auto", which picks it).')
         from skypilot_tpu.ops import flash_attention
-        return flash_attention.flash_attention(q, k, v, causal=causal,
-                                               window=window,
-                                               segment_ids=segment_ids)
+        return flash_attention.flash_attention(
+            q, k, v, causal=causal, window=window,
+            segment_ids=segment_ids, logit_softcap=logit_softcap,
+            scale=scale)
     return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids,
                          window=window, logit_softcap=logit_softcap,
                          scale=scale)

@@ -64,7 +64,7 @@ def _window_inner_blocks(num_kv: int, block_q: int, block_kv: int,
 def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_kv_ref, o_ref,
                 lse_ref, acc_ref, m_ref, l_ref, *, scale: float,
                 causal: bool, block_q: int, block_kv: int, window,
-                num_kv_total: int, segmented: bool):
+                num_kv_total: int, segmented: bool, softcap=None):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     num_kv = pl.num_programs(2)
@@ -102,6 +102,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_kv_ref, o_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bkv]
+        if softcap is not None:
+            # Gemma-2: cap·tanh(s/cap) before masking (matches the
+            # XLA reference and HF eager).
+            s = softcap * jnp.tanh(s / softcap)
         if causal or window is not None or segmented:
             # Mask only needed on diagonal/window-crossing blocks.
             q_pos = q_start + jax.lax.broadcasted_iota(
@@ -153,7 +157,8 @@ def _seg_views(segment_ids, b):
 
 
 def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, segment_ids,
-               *, causal: bool, block_q: int, block_kv: int, window=None
+               *, causal: bool, block_q: int, block_kv: int,
+               window=None, softcap=None, scale_override=None
                ) -> Tuple[jax.Array, jax.Array]:
     """Returns (out [B,H,S,D], lse [B*H,S,LANES] lane-broadcast fp32).
 
@@ -192,7 +197,7 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, segment_ids,
         def kv_map(bh, qi, ki):
             return (bh // groups, ki, 0)
     grid = (b * h, s // block_q, inner)
-    scale = d ** -0.5
+    scale = d ** -0.5 if scale_override is None else scale_override
 
     qr = q.reshape(b * h, s, d)
     kr = k.reshape(b * h_kv, s_kv, d)
@@ -212,7 +217,7 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, segment_ids,
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_kv=block_kv,
                                window=window, num_kv_total=num_kv_total,
-                               segmented=segmented)
+                               segmented=segmented, softcap=softcap)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -248,13 +253,16 @@ def _should_interpret() -> bool:
 
 def _block_p_ds(q, k, v, out, dout, lse_col, *, scale: float,
                 causal: bool, q_start, kv_start, block_q: int,
-                block_kv: int, window, seg_q=None, seg_kv=None):
+                block_kv: int, window, seg_q=None, seg_kv=None,
+                softcap=None):
     """Shared P/dS recompute for both backward kernels.
 
     q/out/dout [bq, d]; k/v [bkv, d]; lse_col [bq, 1] fp32; seg_q
     [bq, 1] / seg_kv [1, bkv] int32 when packing masks apply. The delta
     row-stat (Σ dO⊙O) is recomputed here from the blocks already in
     VMEM — cheaper than streaming a third stats operand from HBM.
+    With `softcap`, P is recomputed through cap·tanh(s/cap) and dS
+    carries the (1 - tanh²) chain factor.
     Returns (p, ds) as bf16-castable fp32 [bq, bkv].
     """
     delta_col = jnp.sum(
@@ -263,6 +271,11 @@ def _block_p_ds(q, k, v, out, dout, lse_col, *, scale: float,
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale        # [bq, bkv]
+    dcap = None
+    if softcap is not None:
+        t = jnp.tanh(s / softcap)
+        s = softcap * t
+        dcap = 1.0 - t * t
     if causal or window is not None or seg_q is not None:
         q_pos = q_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 0)
@@ -279,6 +292,8 @@ def _block_p_ds(q, k, v, out, dout, lse_col, *, scale: float,
         dout, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)                # [bq, bkv]
     ds = p * (dp - delta_col) * scale
+    if dcap is not None:
+        ds = ds * dcap
     return p, ds
 
 
@@ -286,7 +301,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
                     seg_q_ref, seg_kv_ref, dk_ref, dv_ref, dk_acc,
                     dv_acc, *, scale: float, causal: bool, block_q: int,
                     block_kv: int, window, num_q_total: int,
-                    segmented: bool):
+                    segmented: bool, softcap=None):
     """Grid (B*Hkv, KV-blocks, groups, Q-blocks): the two inner sweeps
     walk every query head sharing this KV head and that head's live Q
     blocks, so the GQA gradient reduction (dk/dv summed over the group)
@@ -326,7 +341,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
             causal=causal, q_start=q_start, kv_start=kv_start,
             block_q=block_q, block_kv=block_kv, window=window,
             seg_q=seg_q_ref[0] if segmented else None,
-            seg_kv=seg_kv_ref[0] if segmented else None)
+            seg_kv=seg_kv_ref[0] if segmented else None,
+            softcap=softcap)
         # dv += Pᵀ dO ; dk += dSᵀ Q  (contract the q dim, bf16 on MXU)
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p.astype(dout.dtype), dout, (((0,), (0,)), ((), ())),
@@ -345,7 +361,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
                    seg_q_ref, seg_kv_ref, dq_ref, dq_acc, *,
                    scale: float, causal: bool, block_q: int,
                    block_kv: int, window, num_kv_total: int,
-                   segmented: bool):
+                   segmented: bool, softcap=None):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     num_kv = pl.num_programs(2)
@@ -375,7 +391,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
             causal=causal, q_start=q_start, kv_start=kv_start,
             block_q=block_q, block_kv=block_kv, window=window,
             seg_q=seg_q_ref[0] if segmented else None,
-            seg_kv=seg_kv_ref[0] if segmented else None)
+            seg_kv=seg_kv_ref[0] if segmented else None,
+            softcap=softcap)
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -386,7 +403,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, out_ref, dout_ref, lse_ref,
 
 
 def _bwd_flash(residuals, dout, *, causal: bool, block_q: int,
-               block_kv: int, window):
+               block_kv: int, window, softcap=None,
+               scale_override=None):
     """FA2 backward: dKV kernel + dQ kernel from the saved LSE.
 
     q/out/dout are [B,H,S,D]; k/v are [B,Hkv,Skv,D]. dQ resolves the
@@ -398,7 +416,7 @@ def _bwd_flash(residuals, dout, *, causal: bool, block_q: int,
     h_kv = k.shape[1]
     groups = h // h_kv
     s_kv = k.shape[2]
-    scale = d ** -0.5
+    scale = d ** -0.5 if scale_override is None else scale_override
     block_q = min(block_q, s)
     block_kv = min(block_kv, s_kv)
 
@@ -475,7 +493,7 @@ def _bwd_flash(residuals, dout, *, causal: bool, block_q: int,
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_kv=block_kv,
                           window=window, num_q_total=num_q_total,
-                          segmented=segmented),
+                          segmented=segmented, softcap=softcap),
         grid=(b * h_kv, s_kv // block_kv, groups, dkv_inner),
         in_specs=[dkv_q_spec, dkv_kv_spec, dkv_kv_spec, dkv_q_spec,
                   dkv_q_spec, dkv_stat_spec, dkv_seg_q_spec,
@@ -501,7 +519,7 @@ def _bwd_flash(residuals, dout, *, causal: bool, block_q: int,
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_kv=block_kv,
                           window=window, num_kv_total=num_kv_total,
-                          segmented=segmented),
+                          segmented=segmented, softcap=softcap),
         grid=(b * h, s // block_q, dq_inner),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, stat_spec,
                   dq_seg_q_spec, dq_seg_kv_spec],
@@ -517,27 +535,32 @@ def _bwd_flash(residuals, dout, *, causal: bool, block_q: int,
             dv.reshape(b, h_kv, s_kv, d), None)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_bhsd(q, k, v, segment_ids, causal, block_q, block_kv, window):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_bhsd(q, k, v, segment_ids, causal, block_q, block_kv, window,
+                softcap, scale_override):
     out, _ = _flash_fwd(q, k, v, segment_ids, causal=causal,
                         block_q=block_q, block_kv=block_kv,
-                        window=window)
+                        window=window, softcap=softcap,
+                        scale_override=scale_override)
     return out
 
 
 def _flash_bhsd_fwd(q, k, v, segment_ids, causal, block_q, block_kv,
-                    window):
+                    window, softcap, scale_override):
     out, lse = _flash_fwd(q, k, v, segment_ids, causal=causal,
                           block_q=block_q, block_kv=block_kv,
-                          window=window)
+                          window=window, softcap=softcap,
+                          scale_override=scale_override)
     return out, (q, k, v, segment_ids, out, lse)
 
 
-def _flash_bhsd_bwd(causal, block_q, block_kv, window, residuals, dout):
+def _flash_bhsd_bwd(causal, block_q, block_kv, window, softcap,
+                    scale_override, residuals, dout):
     # 4-tuple (dq, dk, dv, None): segment ids are integral, their
     # cotangent is symbolically zero.
     return _bwd_flash(residuals, dout, causal=causal, block_q=block_q,
-                      block_kv=block_kv, window=window)
+                      block_kv=block_kv, window=window, softcap=softcap,
+                      scale_override=scale_override)
 
 
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
@@ -547,7 +570,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_kv: int = DEFAULT_BLOCK_KV,
-                    window=None, segment_ids=None) -> jax.Array:
+                    window=None, segment_ids=None,
+                    logit_softcap=None, scale=None) -> jax.Array:
     """Flash attention; q [B,S,H,D], k/v [B,S,Hkv,D] (GQA) → [B,S,H,D].
 
     window: Mistral-style sliding window — out-of-window blocks are
@@ -566,5 +590,5 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
     out = _flash_bhsd(qt, kt, vt, segment_ids, causal, block_q,
-                      block_kv, window)
+                      block_kv, window, logit_softcap, scale)
     return jnp.transpose(out, (0, 2, 1, 3))

@@ -242,3 +242,34 @@ def test_segment_ids_with_window_and_gqa():
     out = fa.flash_attention(q, k, v, causal=True, segment_ids=seg,
                              window=64, block_q=64, block_kv=64)
     np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
+@pytest.mark.parametrize('with_window', [False, True])
+def test_softcap_scale_forward_and_grads(with_window):
+    """Gemma-2 softcap + explicit scale in-kernel: forward parity and
+    gradient parity vs the XLA reference (the (1 - tanh²) chain factor
+    in the FA2 backward recompute)."""
+    s = 256
+    q, k, v = _rand((1, s, 4, 32), 0), _rand((1, s, 2, 32), 1), \
+        _rand((1, s, 2, 32), 2)
+    q = q * 3   # push scores into the cap's nonlinear range
+    cap, scale = 20.0, 24.0 ** -0.5
+    window = 64 if with_window else None
+    kwargs = dict(causal=True, window=window, logit_softcap=cap,
+                  scale=scale)
+    ref = attention_ops.xla_attention(q, k, v, **kwargs)
+    out = fa.flash_attention(q, k, v, block_q=64, block_kv=64, **kwargs)
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def loss(f):
+        return lambda q, k, v: jnp.sum(f(q, k, v) ** 2)
+
+    g_flash = jax.grad(
+        loss(lambda q, k, v: fa.flash_attention(
+            q, k, v, block_q=64, block_kv=64, **kwargs)),
+        argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        loss(lambda q, k, v: attention_ops.xla_attention(
+            q, k, v, **kwargs)), argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(gf, gr, atol=5e-4)
