@@ -30,8 +30,27 @@ import subprocess
 import sys
 import threading
 import time
+from typing import Optional
 
 _BASELINE_MODEL_TFLOPS_PER_CHIP = 23.5  # see module docstring
+
+# Decode-phase serving is HBM-bandwidth-bound; the baseline ran on v6e.
+# Per-chip HBM read bandwidth (GB/s, Cloud TPU published specs) lets the
+# serve bench report an explicit bandwidth-normalized comparison when
+# the attached chip is a different generation than the baseline's.
+_HBM_BW_GBPS = {
+    'TPU v2': 700, 'TPU v3': 900, 'TPU v4': 1200, 'TPU v5 lite': 819,
+    'TPU v5': 2765, 'TPU v6 lite': 1640, 'TPU v6e': 1640,
+}
+_BASELINE_HBM_BW_GBPS = 1640.0  # v6e (JetStream baseline hardware)
+
+# Last-known-good on-silicon captures: every successful bench run saves
+# its JSON here; on failure the supervisor embeds them in the failure
+# JSON so a dead tunnel at round end still leaves on-silicon evidence.
+_LAST_GOOD = {
+    'train': '.bench_last_good_train.json',
+    'serve': '.bench_last_good_serve.json',
+}
 
 _DEVICES_OK_SENTINEL = '#DEVICES_OK'
 # Upper bound on serve_main's ladder length (supervisor spawns one
@@ -64,6 +83,38 @@ def _device_peak_tflops(device) -> float:
         if kind.startswith(prefix):
             return float(peak)
     return 100.0
+
+
+def _device_hbm_bw_gbps(device) -> Optional[float]:
+    kind = getattr(device, 'device_kind', 'cpu')
+    for prefix, bw in _HBM_BW_GBPS.items():
+        if kind.startswith(prefix):
+            return float(bw)
+    return None
+
+
+def _save_last_good(mode: str, result: dict) -> None:
+    """Record a successful on-silicon capture (best-effort).
+
+    CPU smoke runs are NOT evidence — only real-accelerator captures
+    may stand in for a failed round-end bench."""
+    if str(result.get('device', 'cpu')).lower() in ('cpu', ''):
+        return
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, _LAST_GOOD[mode]), 'w') as f:
+            json.dump(dict(result, captured_unix=time.time()), f)
+    except OSError:
+        pass
+
+
+def _load_last_good(mode: str) -> Optional[dict]:
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, _LAST_GOOD[mode])) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
 
 
 def _candidate_configs(platform: str, hbm_gib: float):
@@ -243,11 +294,12 @@ def serve_main() -> None:
     out_tps_chip = out_tps / n_chips
     # Baseline 2147.98 out tok/s was a single v6e host serving run
     # (8 chips, examples/tpu/v6e/README.md:92-121) → 268.5 tok/s/chip.
+    baseline_chip = 2147.98 / 8
     result = {
         'metric': 'llama_serve_output_tok_per_sec_per_chip',
         'value': round(out_tps_chip, 2),
         'unit': 'tok/s/chip',
-        'vs_baseline': round(out_tps_chip / (2147.98 / 8), 3),
+        'vs_baseline': round(out_tps_chip / baseline_chip, 3),
         'output_token_throughput_tps': round(out_tps, 2),
         'request_throughput_rps': round(
             metrics['request_throughput_rps'], 3),
@@ -261,6 +313,18 @@ def serve_main() -> None:
         'decode_steps': orch.decode_steps,
         'weight_dtype': quant or 'bf16',
     }
+    # Decode is HBM-bound: when the attached chip is a different
+    # generation than the baseline's v6e, report the bandwidth-
+    # normalized ratio explicitly (VERDICT r3 asked for this in the
+    # output, not a prose note).
+    bw = _device_hbm_bw_gbps(devices[0])
+    if bw is not None:
+        result['hbm_bw_gbps'] = bw
+        result['baseline_hbm_bw_gbps'] = _BASELINE_HBM_BW_GBPS
+        result['vs_baseline_bw_normalized'] = round(
+            (out_tps_chip / bw) / (baseline_chip / _BASELINE_HBM_BW_GBPS),
+            3)
+    _save_last_good('serve', result)
     print(json.dumps(result))
 
 
@@ -433,6 +497,7 @@ def main() -> None:
         'remat_policy': best_config.model.remat_policy,
         'attention_impl': best_config.model.attention_impl,
     }
+    _save_last_good('train', result)
     print(json.dumps(result))
 
 
@@ -506,8 +571,7 @@ def _attempt_child(argv, env, init_timeout: float, run_timeout: float,
             'stage': 'run'}
     pump.join(timeout=10)
     if proc.returncode == 0 and result_line:
-        print(result_line[-1], flush=True)
-        return True, None
+        return True, {'result': result_line[-1]}
     return False, {
         'error': f'attempt {attempt}: child rc={proc.returncode}, '
                  f'json={"yes" if result_line else "no"}',
@@ -531,10 +595,11 @@ def _supervise(argv) -> int:
     process boundary. Init-hangs retry the same rung with backoff;
     run-stage failures move down the ladder.
     """
-    attempts = int(os.environ.get('XSKY_BENCH_ATTEMPTS', '3'))
-    init_timeout = float(os.environ.get('XSKY_BENCH_INIT_TIMEOUT', '240'))
+    attempts = int(os.environ.get('XSKY_BENCH_ATTEMPTS', '5'))
+    init_timeout = float(os.environ.get('XSKY_BENCH_INIT_TIMEOUT', '150'))
     run_timeout = float(os.environ.get('XSKY_BENCH_RUN_TIMEOUT', '2400'))
     serve = 'serve' in argv
+    mode = 'serve' if serve else 'train'
     metric = ('llama_serve_output_tok_per_sec_per_chip'
               if serve else 'llama_train_model_tflops_per_chip')
     failure = {'error': 'not attempted', 'stage': 'backend_init'}
@@ -552,6 +617,20 @@ def _supervise(argv) -> int:
             ok, failure = _attempt_child(argv, env, init_timeout,
                                          run_timeout, attempt)
             if ok:
+                line = failure['result']
+                if not serve:
+                    # The primary (train) output also carries the
+                    # round's freshest on-silicon serve capture so one
+                    # driver invocation records both stories.
+                    serve_good = _load_last_good('serve')
+                    if serve_good is not None:
+                        try:
+                            merged = json.loads(line)
+                            merged['serve_last_good'] = serve_good
+                            line = json.dumps(merged)
+                        except json.JSONDecodeError:
+                            pass
+                print(line, flush=True)
                 return 0
             rung = env.get('XSKY_BENCH_SERVE_RUNG')
             where = f' (rung {rung})' if rung is not None else ''
@@ -566,9 +645,21 @@ def _supervise(argv) -> int:
                 break  # OOM-class: fresh process, next rung down
             if attempt < attempts:
                 time.sleep(15 * attempt)
-    print(json.dumps({'metric': metric, 'value': None, 'unit': None,
-                      'vs_baseline': None, **failure,
-                      'attempts': attempts}), flush=True)
+    # Dead tunnel / repeated failure: the failure JSON still carries the
+    # round's last-known-good on-silicon captures as evidence.
+    out = {'metric': metric, 'value': None, 'unit': None,
+           'vs_baseline': None, **failure, 'attempts': attempts}
+    good = _load_last_good(mode)
+    if good is not None:
+        # Evidence only — the headline value stays null so a failed
+        # round is never mistaken for a fresh measurement; captured_unix
+        # inside the blob makes the capture's age auditable.
+        out['last_known_good'] = good
+    other = 'serve' if mode == 'train' else 'train'
+    other_good = _load_last_good(other)
+    if other_good is not None:
+        out[f'{other}_last_good'] = other_good
+    print(json.dumps(out), flush=True)
     return 1
 
 

@@ -25,14 +25,22 @@ def _tick_autostop(root: str) -> None:
     if not autostop_lib.should_autostop(root):
         return
     config = autostop_lib.get_autostop(root) or {}
-    # Self-teardown: signal via a marker file the control plane polls
-    # (on real clouds the agent calls the provisioner API directly with
-    # the cluster's identity; the fake cloud has no on-host credentials).
-    marker = os.path.join(root, 'autostop_triggered.json')
-    with open(marker, 'w', encoding='utf-8') as f:
-        json.dump({'down': config.get('down', False),
-                   'triggered_at': time.time()}, f)
-    autostop_lib.clear_autostop(root)
+    down = config.get('down', False)
+    # Push model first (twin of sky/skylet/events.py:102): the agent
+    # stops/terminates the cluster itself using the instance's own
+    # cloud identity, so the bill stops even with no control plane
+    # alive. Providers that can't be driven from on-host fall back to a
+    # marker file the control plane polls during status refresh.
+    from skypilot_tpu.agent import self_teardown
+    done = self_teardown.attempt_self_teardown(root, down)
+    if not done:
+        marker = os.path.join(root, 'autostop_triggered.json')
+        with open(marker, 'w', encoding='utf-8') as f:
+            json.dump({'down': down, 'triggered_at': time.time()}, f)
+    try:
+        autostop_lib.clear_autostop(root)
+    except OSError:
+        pass   # teardown may have removed the whole runtime root
 
 
 def _heartbeat(root: str) -> None:

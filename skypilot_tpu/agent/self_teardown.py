@@ -1,0 +1,87 @@
+"""Agent-initiated autostop teardown (twin of sky/skylet/events.py:102).
+
+The reference's AutostopEvent stops/terminates the cluster FROM the
+cluster itself, so autostop works even when no control plane is alive to
+poll. Here the head agent does the same: `cluster_info.json` (written at
+setup by the backend, tpu_gang_backend._setup_runtime) carries the
+provider name, provider config, and cluster name, and the provisioner
+REST clients authenticate with the *instance's own identity* — on GCP
+the metadata-server token is the first source in the provisioner's
+credential chain (provision/gcp/rest.py:29), which is exactly the
+service account the TPU VM runs as.
+
+Fallback: when the provider cannot be driven from on-host (no metadata
+identity, no credentials — e.g. a BYO/ssh cluster), the daemon falls
+back to the marker file the control plane polls (pull model, daemon.py).
+The fake cloud IS driveable from on-host (its store is the local
+filesystem), which gives the agent-side path a zero-network e2e test.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+#: Providers whose lifecycle APIs are callable from the cluster itself
+#: with ambient (instance-identity or local) credentials.
+SELF_SERVICE_PROVIDERS = ('gcp', 'fake', 'docker')
+
+
+def load_cluster_identity(root: str) -> Optional[Tuple[str, str,
+                                                       Dict[str, Any]]]:
+    """(provider_name, cluster_name, provider_config) from the head's
+    cluster_info.json, or None when absent/incomplete."""
+    path = os.path.join(root, 'cluster_info.json')
+    try:
+        with open(path, encoding='utf-8') as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    provider = data.get('provider_name')
+    cluster_name = data.get('cluster_name')
+    if not provider or not cluster_name:
+        return None
+    return provider, cluster_name, data.get('provider_config', {})
+
+
+def attempt_self_teardown(root: str, down: bool,
+                          terminate_fn=None, stop_fn=None) -> bool:
+    """Stop (down=False) or terminate (down=True) this cluster from the
+    head node. Returns True when the cloud op was issued; False means
+    the caller must fall back to the control-plane marker.
+
+    terminate_fn/stop_fn are injectable for tests; the defaults are the
+    generic provisioner dispatch (provision/__init__.py), whose REST
+    transports pick up the instance identity on real clouds.
+    """
+    if os.environ.get('XSKY_AGENT_NO_SELF_TEARDOWN'):
+        return False
+    identity = load_cluster_identity(root)
+    if identity is None:
+        return False
+    provider, cluster_name, provider_config = identity
+    if provider not in SELF_SERVICE_PROVIDERS:
+        return False
+    from skypilot_tpu import provision as provision_lib
+    terminate_fn = terminate_fn or provision_lib.terminate_instances
+    stop_fn = stop_fn or provision_lib.stop_instances
+    try:
+        if down:
+            logger.info(f'Autostop: terminating {cluster_name} from the '
+                        'head agent')
+            terminate_fn(provider, cluster_name, provider_config)
+        else:
+            logger.info(f'Autostop: stopping {cluster_name} from the '
+                        'head agent')
+            stop_fn(provider, cluster_name, provider_config)
+        return True
+    except Exception as e:  # pylint: disable=broad-except
+        # Any failure (missing scopes, API error, stop unsupported on a
+        # multi-host slice) degrades to the marker-file pull model.
+        logger.warning(f'Agent-side autostop failed ({e}); falling back '
+                       'to control-plane marker')
+        return False

@@ -245,7 +245,10 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                         f'{e}') from e
         head = runners[0]
         root = handle.head_runtime_root
-        info_json = json.dumps(handle.cluster_info.to_json())
+        # cluster_name rides along for the agent's self-teardown path
+        # (agent/self_teardown.py); ClusterInfo.from_json ignores it.
+        info_json = json.dumps({**handle.cluster_info.to_json(),
+                                'cluster_name': handle.cluster_name})
         payload = base64.b64encode(info_json.encode()).decode()
         rc, _, stderr = head.run(
             f'mkdir -p {root}/logs && echo {payload} | base64 -d > '

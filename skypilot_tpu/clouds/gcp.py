@@ -28,6 +28,38 @@ DEFAULT_CREDENTIAL_PATHS = (
 )
 
 
+def resolve_project_id() -> typing.Optional[str]:
+    """GCP project id: $GOOGLE_CLOUD_PROJECT → config gcp.project_id →
+    the ADC file's quota_project_id/project_id. Shared by provisioning
+    (provider_config_overrides) and the GCS object client."""
+    project = os.environ.get('GOOGLE_CLOUD_PROJECT')
+    if project:
+        return project
+    from skypilot_tpu import config as config_lib
+    project = config_lib.get_nested(('gcp', 'project_id'))
+    if project:
+        return project
+    import json
+    for path in DEFAULT_CREDENTIAL_PATHS:
+        if not path:
+            continue
+        adc = os.path.expanduser(path)
+        if not os.path.exists(adc):
+            continue
+        try:
+            with open(adc, encoding='utf-8') as f:
+                blob = json.load(f)
+            # User ADC carries quota_project_id; service-account keys
+            # carry project_id.
+            project = blob.get('quota_project_id') or \
+                blob.get('project_id')
+        except (OSError, ValueError):
+            project = None
+        if project:
+            return project
+    return None
+
+
 @registry.CLOUD_REGISTRY.register(aliases=['google'])
 class GCP(catalog_cloud.CatalogCloud):
     _REPR = 'GCP'
@@ -153,29 +185,7 @@ class GCP(catalog_cloud.CatalogCloud):
             # get_cluster_info builds the mount commands from the
             # persisted provider_config — thread volumes through it.
             overrides['volumes'] = node_config['volumes']
-        project = os.environ.get('GOOGLE_CLOUD_PROJECT')
-        if not project:
-            from skypilot_tpu import config as config_lib
-            project = config_lib.get_nested(('gcp', 'project_id'))
-        if not project:
-            import json
-            for path in DEFAULT_CREDENTIAL_PATHS:
-                if not path:
-                    continue
-                adc = os.path.expanduser(path)
-                if not os.path.exists(adc):
-                    continue
-                try:
-                    with open(adc, encoding='utf-8') as f:
-                        blob = json.load(f)
-                    # User ADC carries quota_project_id; service-account
-                    # keys carry project_id.
-                    project = blob.get('quota_project_id') or \
-                        blob.get('project_id')
-                except (OSError, ValueError):
-                    project = None
-                if project:
-                    break
+        project = resolve_project_id()
         if project:
             overrides['project_id'] = project
         return overrides

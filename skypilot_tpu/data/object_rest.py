@@ -1,0 +1,596 @@
+"""Zero-dependency object-store REST clients (S3 API, Azure Blob, GCS).
+
+The reference's stores drive boto3 / azure-storage-blob / google.cloud
+SDKs behind lazy adaptors (sky/data/storage.py:2414,3763,4227,4689);
+this tree keeps the control plane zero-dep by reusing the same signing
+primitives its provisioners already carry:
+
+  * S3-compatible stores (AWS S3, Cloudflare R2, IBM COS, OCI, Nebius)
+    ride SigV4 (provision/aws/rest.py:sigv4 derivation, generalized here
+    to service='s3' + arbitrary endpoint + path-style addressing).
+  * Azure Blob rides the Storage SharedKey HMAC scheme (the ARM OAuth
+    transport in provision/azure/rest.py covers management-plane only;
+    data-plane blobs sign with the account key).
+  * GCS rides the JSON API with the OAuth bearer token source from
+    provision/gcp/rest.py (metadata server / ADC / gcloud).
+
+Every client takes an injectable ``opener`` (urllib.request.urlopen
+signature) so store lifecycle tests run against recorded responses with
+zero network — same pattern as the provisioner fakes.
+"""
+from __future__ import annotations
+
+import base64
+import datetime
+import hashlib
+import hmac
+import json
+import os
+import urllib.error
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+Opener = Callable[..., Any]
+
+
+class ObjectStoreError(exceptions.StorageError):
+    """Data-plane REST error with HTTP status + store error code."""
+
+    def __init__(self, status: int, code: str, message: str) -> None:
+        super().__init__(
+            f'Object store error {status} ({code}): {message}')
+        self.status = status
+        self.code = code
+        self.message = message
+
+    @property
+    def is_transient(self) -> bool:
+        """Network-level or server-side failure — the store may still
+        exist; callers can retry or fall back to another transport."""
+        return self.status == 0 or self.status >= 500
+
+
+def _utcnow() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def _walk_files(local_dir: str) -> Iterator[Tuple[str, str]]:
+    """Yield (absolute_path, key_relative_to_dir) for every file."""
+    local_dir = os.path.abspath(os.path.expanduser(local_dir))
+    if os.path.isfile(local_dir):
+        yield local_dir, os.path.basename(local_dir)
+        return
+    for root, _, files in os.walk(local_dir):
+        for name in files:
+            path = os.path.join(root, name)
+            yield path, os.path.relpath(path, local_dir).replace(
+                os.sep, '/')
+
+
+#: Single-PUT object-size cap (S3: 5 GiB; Azure Put Blob: ~4.75 GiB).
+#: Streaming multipart is deliberately out of scope for the zero-dep
+#: client — stores fall back to the cloud CLI for larger files.
+SINGLE_PUT_LIMIT = 4_500_000_000
+
+
+def has_oversized_file(local_dir: str,
+                       limit: int = SINGLE_PUT_LIMIT) -> bool:
+    """True when any file under local_dir exceeds limit — stores use
+    this to pick REST-vs-CLI before an upload that would fail mid-way.
+    Short-circuits on the first hit (one stat pass, no full walk)."""
+    for path, _ in _walk_files(local_dir):
+        try:
+            if os.path.getsize(path) > limit:
+                return True
+        except OSError:
+            pass
+    return False
+
+
+# ---------------------------------------------------------------------------
+# S3-compatible (AWS S3, R2, IBM COS, OCI, Nebius)
+# ---------------------------------------------------------------------------
+
+
+class S3ObjectClient:
+    """SigV4-signed S3 REST client, path-style, custom-endpoint aware.
+
+    ``endpoint`` — '' means AWS (s3.{region}.amazonaws.com); otherwise a
+    full https:// URL of an S3-compatible service (R2 / COS / OCI /
+    Nebius). ``creds`` — (access_key, secret_key, session_token).
+    """
+
+    def __init__(self, region: str = 'us-east-1', endpoint: str = '',
+                 creds: Optional[Tuple[str, str, Optional[str]]] = None,
+                 opener: Optional[Opener] = None) -> None:
+        self.region = region or 'us-east-1'
+        if endpoint:
+            parsed = urllib.parse.urlparse(endpoint)
+            self.host = parsed.netloc or parsed.path
+            self.scheme = parsed.scheme or 'https'
+        else:
+            self.host = f's3.{self.region}.amazonaws.com'
+            self.scheme = 'https'
+        if creds is None:
+            from skypilot_tpu.provision.aws import rest as aws_rest
+            creds = aws_rest.load_credentials()
+        if creds is None:
+            raise exceptions.PermissionError_(
+                'No S3 credentials (set AWS_ACCESS_KEY_ID / '
+                'AWS_SECRET_ACCESS_KEY or ~/.aws/credentials).')
+        self.creds = creds
+        self._open = opener or urllib.request.urlopen
+
+    # -- signing --
+
+    def _signed_headers(self, method: str, path: str,
+                        query: Dict[str, str],
+                        payload_hash: str) -> Dict[str, str]:
+        access, secret, token = self.creds
+        now = _utcnow()
+        amz_date = now.strftime('%Y%m%dT%H%M%SZ')
+        datestamp = now.strftime('%Y%m%d')
+        canonical_query = '&'.join(
+            f'{urllib.parse.quote(k, safe="-_.~")}='
+            f'{urllib.parse.quote(v, safe="-_.~")}'
+            for k, v in sorted(query.items()))
+        headers = {'host': self.host, 'x-amz-content-sha256': payload_hash,
+                   'x-amz-date': amz_date}
+        if token:
+            headers['x-amz-security-token'] = token
+        signed = ';'.join(sorted(headers))
+        canonical_headers = ''.join(
+            f'{k}:{headers[k]}\n' for k in sorted(headers))
+        canonical_request = '\n'.join([
+            method, urllib.parse.quote(path), canonical_query,
+            canonical_headers, signed, payload_hash])
+        scope = f'{datestamp}/{self.region}/s3/aws4_request'
+        string_to_sign = '\n'.join([
+            'AWS4-HMAC-SHA256', amz_date, scope,
+            hashlib.sha256(canonical_request.encode()).hexdigest()])
+
+        def _hm(key: bytes, msg: str) -> bytes:
+            return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+        k = _hm(f'AWS4{secret}'.encode(), datestamp)
+        k = _hm(k, self.region)
+        k = _hm(k, 's3')
+        k = _hm(k, 'aws4_request')
+        signature = hmac.new(k, string_to_sign.encode(),
+                             hashlib.sha256).hexdigest()
+        out = {
+            'x-amz-date': amz_date,
+            'x-amz-content-sha256': payload_hash,
+            'Authorization': (
+                f'AWS4-HMAC-SHA256 Credential={access}/{scope}, '
+                f'SignedHeaders={signed}, Signature={signature}'),
+        }
+        if token:
+            out['x-amz-security-token'] = token
+        return out
+
+    def _call(self, method: str, path: str,
+              query: Optional[Dict[str, str]] = None,
+              body: bytes = b'', ok_codes: Tuple[int, ...] = (),
+              body_file: Optional[str] = None) -> Tuple[int, bytes]:
+        query = query or {}
+        if body_file is not None:
+            # Stream straight from disk: hashing would force a second
+            # full read, so sign as UNSIGNED-PAYLOAD (valid over TLS).
+            payload_hash = 'UNSIGNED-PAYLOAD'
+        else:
+            payload_hash = hashlib.sha256(body).hexdigest()
+        headers = self._signed_headers(method, path, query, payload_hash)
+        url = f'{self.scheme}://{self.host}{urllib.parse.quote(path)}'
+        if query:
+            url += '?' + urllib.parse.urlencode(sorted(query.items()))
+        try:
+            if body_file is not None:
+                headers['Content-Length'] = str(
+                    os.path.getsize(body_file))
+                with open(body_file, 'rb') as f:
+                    req = urllib.request.Request(
+                        url, data=f, headers=headers, method=method)
+                    with self._open(req, timeout=600) as resp:
+                        return resp.status, resp.read()
+            req = urllib.request.Request(url, data=body or None,
+                                         headers=headers, method=method)
+            with self._open(req, timeout=120) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            if e.code in ok_codes:
+                return e.code, raw
+            code, message = 'Unknown', raw.decode(errors='replace')
+            try:
+                root = ET.fromstring(raw)
+                code = root.findtext('.//Code', code)
+                message = root.findtext('.//Message', message)
+            except ET.ParseError:
+                pass
+            raise ObjectStoreError(e.code, code, message) from e
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            raise ObjectStoreError(0, 'NetworkError', str(e)) from e
+
+    # -- bucket lifecycle --
+
+    def bucket_exists(self, bucket: str) -> bool:
+        status, _ = self._call('HEAD', f'/{bucket}',
+                               ok_codes=(404, 403, 301))
+        return status == 200
+
+    def create_bucket(self, bucket: str) -> None:
+        body = b''
+        # AWS requires a LocationConstraint outside us-east-1;
+        # S3-compatible endpoints generally accept an empty body.
+        if self.host.endswith('amazonaws.com') and \
+                self.region != 'us-east-1':
+            body = (
+                '<CreateBucketConfiguration><LocationConstraint>'
+                f'{self.region}'
+                '</LocationConstraint></CreateBucketConfiguration>'
+            ).encode()
+        self._call('PUT', f'/{bucket}', body=body)
+
+    def delete_bucket(self, bucket: str) -> None:
+        # S3 deletes empty buckets only: drain first (reference
+        # mirrors this with `aws s3 rb --force`).
+        for key in self.list_objects(bucket):
+            self.delete_object(bucket, key)
+        self._call('DELETE', f'/{bucket}', ok_codes=(404,))
+
+    # -- objects --
+
+    def list_objects(self, bucket: str, prefix: str = '') -> List[str]:
+        keys: List[str] = []
+        token: Optional[str] = None
+        while True:
+            query = {'list-type': '2'}
+            if prefix:
+                query['prefix'] = prefix
+            if token:
+                query['continuation-token'] = token
+            _, raw = self._call('GET', f'/{bucket}', query=query)
+            if not raw.strip():
+                return keys
+            root = ET.fromstring(raw)
+            ns = ''
+            if root.tag.startswith('{'):
+                ns = root.tag.split('}')[0] + '}'
+            for contents in root.findall(f'{ns}Contents'):
+                key = contents.findtext(f'{ns}Key')
+                if key:
+                    keys.append(key)
+            token = root.findtext(f'{ns}NextContinuationToken')
+            if not token:
+                return keys
+
+    def put_object(self, bucket: str, key: str, data: bytes) -> None:
+        self._call('PUT', f'/{bucket}/{key}', body=data)
+
+    def put_object_file(self, bucket: str, key: str, path: str) -> None:
+        """Streamed single PUT (no in-memory copy; ≤ SINGLE_PUT_LIMIT)."""
+        self._call('PUT', f'/{bucket}/{key}', body_file=path)
+
+    def get_object(self, bucket: str, key: str) -> bytes:
+        _, raw = self._call('GET', f'/{bucket}/{key}')
+        return raw
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        self._call('DELETE', f'/{bucket}/{key}', ok_codes=(404,))
+
+    def upload_dir(self, bucket: str, local_dir: str,
+                   prefix: str = '') -> int:
+        n = 0
+        for path, rel in _walk_files(local_dir):
+            key = f'{prefix}{rel}' if prefix else rel
+            self.put_object_file(bucket, key, path)
+            n += 1
+        logger.debug(f'Uploaded {n} objects to {bucket}/{prefix}')
+        return n
+
+
+# ---------------------------------------------------------------------------
+# Azure Blob (SharedKey data-plane auth)
+# ---------------------------------------------------------------------------
+
+
+class AzureBlobClient:
+    """Azure Blob REST with Storage SharedKey signing.
+
+    Data-plane twin of the reference's AzureBlobStore SDK usage
+    (sky/data/storage.py:2414). Auth: $AZURE_STORAGE_ACCOUNT +
+    $AZURE_STORAGE_KEY (the same pair `az storage` honors).
+    """
+
+    API_VERSION = '2021-08-06'
+
+    def __init__(self, account: Optional[str] = None,
+                 key: Optional[str] = None,
+                 opener: Optional[Opener] = None) -> None:
+        self.account = account or os.environ.get(
+            'AZURE_STORAGE_ACCOUNT', '')
+        key = key if key is not None else os.environ.get(
+            'AZURE_STORAGE_KEY', '')
+        if not self.account or not key:
+            raise exceptions.PermissionError_(
+                'No Azure Blob credentials (set AZURE_STORAGE_ACCOUNT '
+                'and AZURE_STORAGE_KEY).')
+        self.key = base64.b64decode(key)
+        self.host = f'{self.account}.blob.core.windows.net'
+        self._open = opener or urllib.request.urlopen
+
+    def _signed_headers(self, method: str, path: str,
+                        query: Dict[str, str],
+                        body_len: int) -> Dict[str, str]:
+        now = _utcnow().strftime('%a, %d %b %Y %H:%M:%S GMT')
+        ms_headers = {'x-ms-date': now,
+                      'x-ms-version': self.API_VERSION}
+        if method == 'PUT' and 'restype' not in query:
+            ms_headers['x-ms-blob-type'] = 'BlockBlob'
+        canonical_ms = ''.join(
+            f'{k}:{ms_headers[k]}\n' for k in sorted(ms_headers))
+        canonical_resource = f'/{self.account}{path}'
+        for k in sorted(query):
+            canonical_resource += f'\n{k.lower()}:{query[k]}'
+        content_length = str(body_len) if body_len else ''
+        string_to_sign = '\n'.join([
+            method,
+            '',                      # Content-Encoding
+            '',                      # Content-Language
+            content_length,          # Content-Length ('' when 0)
+            '',                      # Content-MD5
+            '',                      # Content-Type
+            '',                      # Date (x-ms-date used instead)
+            '', '', '', '', '',      # If-*, Range
+        ]) + '\n' + canonical_ms + canonical_resource
+        signature = base64.b64encode(
+            hmac.new(self.key, string_to_sign.encode('utf-8'),
+                     hashlib.sha256).digest()).decode()
+        headers = dict(ms_headers)
+        headers['Authorization'] = (
+            f'SharedKey {self.account}:{signature}')
+        return headers
+
+    def _call(self, method: str, path: str,
+              query: Optional[Dict[str, str]] = None, body: bytes = b'',
+              ok_codes: Tuple[int, ...] = (),
+              body_file: Optional[str] = None) -> Tuple[int, bytes]:
+        query = query or {}
+        body_len = (os.path.getsize(body_file) if body_file is not None
+                    else len(body))
+        headers = self._signed_headers(method, path, query, body_len)
+        url = f'https://{self.host}{urllib.parse.quote(path)}'
+        if query:
+            url += '?' + urllib.parse.urlencode(sorted(query.items()))
+        try:
+            if body_file is not None:
+                headers['Content-Length'] = str(body_len)
+                with open(body_file, 'rb') as f:
+                    req = urllib.request.Request(
+                        url, data=f, headers=headers, method=method)
+                    with self._open(req, timeout=600) as resp:
+                        return resp.status, resp.read()
+            req = urllib.request.Request(url, data=body or None,
+                                         headers=headers, method=method)
+            with self._open(req, timeout=120) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            if e.code in ok_codes:
+                return e.code, raw
+            code, message = 'Unknown', raw.decode(errors='replace')
+            try:
+                root = ET.fromstring(raw)
+                code = root.findtext('.//Code', code)
+                message = root.findtext('.//Message', message)
+            except ET.ParseError:
+                pass
+            raise ObjectStoreError(e.code, code, message) from e
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            raise ObjectStoreError(0, 'NetworkError', str(e)) from e
+
+    # -- containers --
+
+    def container_exists(self, container: str) -> bool:
+        status, _ = self._call(
+            'GET', f'/{container}', query={'restype': 'container'},
+            ok_codes=(404,))
+        return status == 200
+
+    def create_container(self, container: str) -> None:
+        self._call('PUT', f'/{container}',
+                   query={'restype': 'container'}, ok_codes=(409,))
+
+    def delete_container(self, container: str) -> None:
+        self._call('DELETE', f'/{container}',
+                   query={'restype': 'container'}, ok_codes=(404,))
+
+    # -- blobs --
+
+    def list_blobs(self, container: str, prefix: str = '') -> List[str]:
+        names: List[str] = []
+        marker = ''
+        while True:
+            query = {'restype': 'container', 'comp': 'list'}
+            if prefix:
+                query['prefix'] = prefix
+            if marker:
+                query['marker'] = marker
+            _, raw = self._call('GET', f'/{container}', query=query)
+            if not raw.strip():
+                return names
+            root = ET.fromstring(raw)
+            for blob in root.iter('Blob'):
+                name = blob.findtext('Name')
+                if name:
+                    names.append(name)
+            marker = root.findtext('NextMarker') or ''
+            if not marker:
+                return names
+
+    def put_blob(self, container: str, name: str, data: bytes) -> None:
+        self._call('PUT', f'/{container}/{name}', body=data)
+
+    def put_blob_file(self, container: str, name: str,
+                      path: str) -> None:
+        """Streamed single Put Blob (≤ SINGLE_PUT_LIMIT)."""
+        self._call('PUT', f'/{container}/{name}', body_file=path)
+
+    def get_blob(self, container: str, name: str) -> bytes:
+        _, raw = self._call('GET', f'/{container}/{name}')
+        return raw
+
+    def delete_blob(self, container: str, name: str) -> None:
+        self._call('DELETE', f'/{container}/{name}', ok_codes=(404,))
+
+    def upload_dir(self, container: str, local_dir: str,
+                   prefix: str = '') -> int:
+        n = 0
+        for path, rel in _walk_files(local_dir):
+            name = f'{prefix}{rel}' if prefix else rel
+            self.put_blob_file(container, name, path)
+            n += 1
+        return n
+
+
+# ---------------------------------------------------------------------------
+# GCS (JSON API, OAuth bearer)
+# ---------------------------------------------------------------------------
+
+
+class GcsObjectClient:
+    """GCS JSON-API client riding the provisioner's OAuth token source
+    (metadata server / ADC / gcloud — provision/gcp/rest.py:46)."""
+
+    API = 'https://storage.googleapis.com/storage/v1'
+    UPLOAD_API = 'https://storage.googleapis.com/upload/storage/v1'
+
+    def __init__(self, project: Optional[str] = None,
+                 token_provider=None,
+                 opener: Optional[Opener] = None) -> None:
+        from skypilot_tpu.provision.gcp import rest as gcp_rest
+        if project is None:
+            # Same chain provisioning uses: env → config → ADC file.
+            from skypilot_tpu.clouds import gcp as gcp_cloud
+            project = gcp_cloud.resolve_project_id()
+        self.project = project
+        self._tokens = token_provider or gcp_rest.TokenProvider()
+        self._open = opener or urllib.request.urlopen
+
+    def _call(self, method: str, url: str, body: bytes = b'',
+              content_type: str = 'application/json',
+              ok_codes: Tuple[int, ...] = (),
+              body_file: Optional[str] = None) -> Tuple[int, bytes]:
+        headers = {'Authorization': f'Bearer {self._tokens.token()}'}
+        if body or body_file:
+            headers['Content-Type'] = content_type
+        try:
+            if body_file is not None:
+                headers['Content-Length'] = str(
+                    os.path.getsize(body_file))
+                with open(body_file, 'rb') as f:
+                    req = urllib.request.Request(
+                        url, data=f, headers=headers, method=method)
+                    with self._open(req, timeout=600) as resp:
+                        return resp.status, resp.read()
+            req = urllib.request.Request(url, data=body or None,
+                                         headers=headers, method=method)
+            with self._open(req, timeout=120) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            if e.code in ok_codes:
+                return e.code, raw
+            message = raw.decode(errors='replace')
+            try:
+                message = json.loads(raw)['error']['message']
+            except (json.JSONDecodeError, KeyError, TypeError):
+                pass
+            raise ObjectStoreError(e.code, 'GcsError', message) from e
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            raise ObjectStoreError(0, 'NetworkError', str(e)) from e
+
+    def bucket_exists(self, bucket: str) -> bool:
+        status, _ = self._call('GET', f'{self.API}/b/{bucket}',
+                               ok_codes=(404, 403))
+        return status == 200
+
+    def create_bucket(self, bucket: str,
+                      location: Optional[str] = None) -> None:
+        if not self.project:
+            raise exceptions.StorageSpecError(
+                'Creating a GCS bucket needs a project id (set '
+                'GOOGLE_CLOUD_PROJECT).')
+        spec: Dict[str, Any] = {'name': bucket}
+        if location:
+            spec['location'] = location
+        self._call('POST',
+                   f'{self.API}/b?project={self.project}',
+                   body=json.dumps(spec).encode())
+
+    def delete_bucket(self, bucket: str) -> None:
+        for key in self.list_objects(bucket):
+            self.delete_object(bucket, key)
+        self._call('DELETE', f'{self.API}/b/{bucket}', ok_codes=(404,))
+
+    def list_objects(self, bucket: str, prefix: str = '') -> List[str]:
+        names: List[str] = []
+        page: Optional[str] = None
+        while True:
+            query = {'fields': 'items/name,nextPageToken'}
+            if prefix:
+                query['prefix'] = prefix
+            if page:
+                query['pageToken'] = page
+            _, raw = self._call(
+                'GET',
+                f'{self.API}/b/{bucket}/o?'
+                + urllib.parse.urlencode(query))
+            data = json.loads(raw) if raw.strip() else {}
+            names.extend(item['name']
+                         for item in data.get('items', []))
+            page = data.get('nextPageToken')
+            if not page:
+                return names
+
+    def put_object(self, bucket: str, key: str, data: bytes) -> None:
+        self._call(
+            'POST',
+            f'{self.UPLOAD_API}/b/{bucket}/o?uploadType=media&name='
+            + urllib.parse.quote(key, safe=''),
+            body=data, content_type='application/octet-stream')
+
+    def put_object_file(self, bucket: str, key: str, path: str) -> None:
+        """Streamed single-shot media upload (no in-memory copy)."""
+        self._call(
+            'POST',
+            f'{self.UPLOAD_API}/b/{bucket}/o?uploadType=media&name='
+            + urllib.parse.quote(key, safe=''),
+            body_file=path, content_type='application/octet-stream')
+
+    def get_object(self, bucket: str, key: str) -> bytes:
+        _, raw = self._call(
+            'GET', f'{self.API}/b/{bucket}/o/'
+            + urllib.parse.quote(key, safe='') + '?alt=media')
+        return raw
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        self._call('DELETE', f'{self.API}/b/{bucket}/o/'
+                   + urllib.parse.quote(key, safe=''), ok_codes=(404,))
+
+    def upload_dir(self, bucket: str, local_dir: str,
+                   prefix: str = '') -> int:
+        n = 0
+        for path, rel in _walk_files(local_dir):
+            key = f'{prefix}{rel}' if prefix else rel
+            self.put_object_file(bucket, key, path)
+            n += 1
+        return n

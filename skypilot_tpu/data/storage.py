@@ -88,6 +88,11 @@ class AbstractStore:
 
     store_type: StoreType
 
+    #: Injectable for tests; None = lazily constructed real client;
+    #: False = construction already failed (no credentials) — cached so
+    #: exists→create→upload doesn't re-pay a probe timeout per call.
+    rest_client = None
+
     def __init__(self, name: str, source: Optional[str] = None,
                  region: Optional[str] = None) -> None:
         if self.store_type != StoreType.LOCAL and \
@@ -97,6 +102,23 @@ class AbstractStore:
         self.name = name
         self.source = source
         self.region = region
+
+    def _make_rest_client(self):
+        """Build the zero-dep REST client, or raise when this store (or
+        this environment) has none — the CLI then remains the transport."""
+        raise exceptions.PermissionError_('no REST client for this store')
+
+    def _rest(self):
+        """Cached REST client or None (CLI fallback)."""
+        if self.rest_client is not None:
+            return self.rest_client or None
+        if os.environ.get('XSKY_STORE_TRANSPORT') == 'cli':
+            return None
+        try:
+            self.rest_client = self._make_rest_client()
+        except Exception:  # pylint: disable=broad-except
+            self.rest_client = False
+        return self.rest_client or None
 
     # lifecycle
     def exists(self) -> bool:
@@ -125,23 +147,73 @@ class AbstractStore:
 
 
 class GcsStore(AbstractStore):
-    """GCS via `gcloud storage` CLI; mounts via gcsfuse."""
+    """GCS via the in-tree JSON-API client (zero-dep), falling back to
+    the `gcloud storage` CLI; mounts via gcsfuse.
+
+    Control-plane ops prefer data/object_rest.GcsObjectClient (OAuth
+    bearer from the provisioner's token chain) so no SDK/CLI is a hard
+    dependency — the CLI path remains for developer machines where only
+    `gcloud auth login` state exists. Cluster-side commands stay CLI:
+    they run on nodes whose setup installs it.
+    """
     store_type = StoreType.GCS
 
+    def _make_rest_client(self):
+        from skypilot_tpu.data import object_rest
+        client = object_rest.GcsObjectClient()
+        client._tokens.token()   # probe the credential chain now
+        return client
+
     def exists(self) -> bool:
+        client = self._rest()
+        if client is not None:
+            try:
+                return client.bucket_exists(self.name.partition('/')[0])
+            except exceptions.StorageError as e:
+                if not getattr(e, 'is_transient', True):
+                    raise    # hard API error: don't mask as "missing"
         return subprocess.run(
             f'gcloud storage buckets describe gs://{self.name}',
             shell=True, capture_output=True).returncode == 0
 
     def create(self) -> None:
+        client = self._rest()
+        if client is not None:
+            try:
+                client.create_bucket(self.name.partition('/')[0],
+                                     location=self.region)
+                return
+            except exceptions.StorageSpecError:
+                # No resolvable project id: gcloud may still have a
+                # configured default project — fall through to the CLI.
+                pass
         loc = f' --location={self.region}' if self.region else ''
         _run(f'gcloud storage buckets create gs://{self.name}{loc}')
 
     def upload(self) -> None:
-        src = shlex.quote(os.path.expanduser(self.source or '.'))
-        _run(f'gcloud storage rsync -r {src} gs://{self.name}')
+        client = self._rest()
+        src = os.path.expanduser(self.source or '.')
+        if client is not None:
+            bucket, _, sub = self.name.partition('/')
+            client.upload_dir(bucket, src,
+                              prefix=f'{sub}/' if sub else '')
+            return
+        _run(f'gcloud storage rsync -r {shlex.quote(src)} '
+             f'gs://{self.name}')
 
     def delete(self) -> None:
+        client = self._rest()
+        if client is not None:
+            bucket, _, sub = self.name.partition('/')
+            if sub:
+                # Prefix-scoped store: delete only our objects — never
+                # the shared bucket other prefixes live in.
+                for key in client.list_objects(
+                        bucket, prefix=sub.rstrip('/') + '/'):
+                    client.delete_object(bucket, key)
+            else:
+                client.delete_bucket(bucket)
+            return
         _run(f'gcloud storage rm -r gs://{self.name}')
 
     def mount_command(self, mount_path: str) -> str:
@@ -155,7 +227,9 @@ class GcsStore(AbstractStore):
 
 
 class S3Store(AbstractStore):
-    """S3 via aws CLI; mounts via goofys."""
+    """S3 via the in-tree SigV4 client (zero-dep), falling back to the
+    aws CLI; mounts via goofys. Base class for every S3-API store
+    (R2 / IBM COS / OCI / Nebius override the endpoint)."""
     store_type = StoreType.S3
     endpoint_url = ''
 
@@ -163,20 +237,64 @@ class S3Store(AbstractStore):
         return (f' --endpoint-url {self.endpoint_url}'
                 if self.endpoint_url else '')
 
+    def _make_rest_client(self):
+        # No static creds raises → CLI may still work (SSO, profile).
+        from skypilot_tpu.data import object_rest
+        return object_rest.S3ObjectClient(
+            region=self.region or 'us-east-1',
+            endpoint=self.endpoint_url)
+
     def exists(self) -> bool:
+        client = self._rest()
+        if client is not None:
+            try:
+                return client.bucket_exists(self.name.partition('/')[0])
+            except exceptions.StorageError as e:
+                if not getattr(e, 'is_transient', True):
+                    raise    # hard API error: don't mask as "missing"
         return subprocess.run(
             f'aws s3api head-bucket --bucket {self.name}{self._ep()}',
             shell=True, capture_output=True).returncode == 0
 
     def create(self) -> None:
+        client = self._rest()
+        if client is not None:
+            client.create_bucket(self.name.partition('/')[0])
+            return
         region = f' --region {self.region}' if self.region else ''
         _run(f'aws s3 mb s3://{self.name}{region}{self._ep()}')
 
     def upload(self) -> None:
-        src = shlex.quote(os.path.expanduser(self.source or '.'))
-        _run(f'aws s3 sync {src} s3://{self.name}{self._ep()}')
+        src = os.path.expanduser(self.source or '.')
+        client = self._rest()
+        if client is not None:
+            from skypilot_tpu.data import object_rest
+            if object_rest.has_oversized_file(src):
+                # Single-PUT cap: multipart is the CLI's job.
+                logger.info(f'{self.name}: file exceeds the single-PUT '
+                            'limit; using the cloud CLI multipart path')
+                client = None
+        if client is not None:
+            bucket, _, sub = self.name.partition('/')
+            client.upload_dir(bucket, src,
+                              prefix=f'{sub}/' if sub else '')
+            return
+        _run(f'aws s3 sync {shlex.quote(src)} '
+             f's3://{self.name}{self._ep()}')
 
     def delete(self) -> None:
+        client = self._rest()
+        if client is not None:
+            bucket, _, sub = self.name.partition('/')
+            if sub:
+                # Prefix-scoped store: delete only our objects — never
+                # the shared bucket other prefixes live in.
+                for key in client.list_objects(
+                        bucket, prefix=sub.rstrip('/') + '/'):
+                    client.delete_object(bucket, key)
+            else:
+                client.delete_bucket(bucket)
+            return
         _run(f'aws s3 rb s3://{self.name} --force{self._ep()}')
 
     def mount_command(self, mount_path: str) -> str:
@@ -264,26 +382,67 @@ class AzureBlobStore(AbstractStore):
         return (f' --account-name {shlex.quote(self.account)}'
                 if self.account else '')
 
+    def _make_rest_client(self):
+        # No account key raises → `az` CLI login state may still work.
+        from skypilot_tpu.data import object_rest
+        return object_rest.AzureBlobClient()
+
     def exists(self) -> bool:
+        client = self._rest()
+        if client is not None:
+            try:
+                return client.container_exists(self.container)
+            except exceptions.StorageError as e:
+                if not getattr(e, 'is_transient', True):
+                    raise    # hard API error: don't mask as "missing"
         return subprocess.run(
             f'az storage container exists --name {shlex.quote(self.container)}'
             f'{self._acct()} --query exists -o tsv | grep -q true',
             shell=True, capture_output=True).returncode == 0
 
     def create(self) -> None:
+        client = self._rest()
+        if client is not None:
+            client.create_container(self.container)
+            return
         _run(f'az storage container create '
              f'--name {shlex.quote(self.container)}'
              f'{self._acct()}')
 
     def upload(self) -> None:
-        src = shlex.quote(os.path.expanduser(self.source or '.'))
+        src = os.path.expanduser(self.source or '.')
+        client = self._rest()
+        if client is not None:
+            from skypilot_tpu.data import object_rest
+            if object_rest.has_oversized_file(src):
+                logger.info(f'{self.name}: file exceeds the single-PUT '
+                            'limit; using the az CLI block upload path')
+                client = None
+        if client is not None:
+            prefix = f'{self.sub_path}/' if self.sub_path else ''
+            client.upload_dir(self.container, src, prefix=prefix)
+            return
         dest = (f' --destination-path {shlex.quote(self.sub_path)}'
                 if self.sub_path else '')
         _run(f'az storage blob upload-batch '
-             f'-d {shlex.quote(self.container)} -s {src}'
+             f'-d {shlex.quote(self.container)} -s {shlex.quote(src)}'
              f'{dest}{self._acct()}')
 
     def delete(self) -> None:
+        client = self._rest()
+        if client is not None:
+            if self.sub_path:
+                # Prefix-scoped store: delete only our blobs — never
+                # the shared container other prefixes live in.
+                prefix = self.sub_path.rstrip('/') + '/'
+                for name in client.list_blobs(self.container,
+                                              prefix=prefix):
+                    client.delete_blob(self.container, name)
+            else:
+                for name in client.list_blobs(self.container):
+                    client.delete_blob(self.container, name)
+                client.delete_container(self.container)
+            return
         _run(f'az storage container delete '
              f'--name {shlex.quote(self.container)}'
              f'{self._acct()}')
@@ -308,11 +467,25 @@ class _S3CompatibleStore(S3Store):
 
     _ENDPOINT_ENV = ''       # env var holding the endpoint URL
     _RCLONE_REMOTE = ''
+    #: Provider-specific HMAC key env prefix (e.g. 'IBM_COS' →
+    #: $IBM_COS_ACCESS_KEY_ID / $IBM_COS_SECRET_ACCESS_KEY); falls back
+    #: to the shared AWS pair when unset.
+    _CRED_ENV_PREFIX = ''
 
     def __init__(self, name: str, source: Optional[str] = None,
                  region: Optional[str] = None) -> None:
         super().__init__(name, source, region)
         self.endpoint_url = os.environ.get(self._ENDPOINT_ENV, '')
+
+    def _make_rest_client(self):
+        access = os.environ.get(f'{self._CRED_ENV_PREFIX}_ACCESS_KEY_ID')
+        secret = os.environ.get(
+            f'{self._CRED_ENV_PREFIX}_SECRET_ACCESS_KEY')
+        from skypilot_tpu.data import object_rest
+        return object_rest.S3ObjectClient(
+            region=self.region or 'us-east-1',
+            endpoint=self.endpoint_url,
+            creds=(access, secret, None) if access and secret else None)
 
     def mount_command(self, mount_path: str) -> str:
         return mounting_utils.rclone_mount_command(
@@ -324,6 +497,7 @@ class IBMCosStore(_S3CompatibleStore):
     store_type = StoreType.IBM
     _ENDPOINT_ENV = 'IBM_COS_ENDPOINT'
     _RCLONE_REMOTE = 'xsky-ibm'
+    _CRED_ENV_PREFIX = 'IBM_COS'
 
 
 class OciStore(_S3CompatibleStore):
@@ -331,6 +505,7 @@ class OciStore(_S3CompatibleStore):
     store_type = StoreType.OCI
     _ENDPOINT_ENV = 'OCI_S3_ENDPOINT'
     _RCLONE_REMOTE = 'xsky-oci'
+    _CRED_ENV_PREFIX = 'OCI_S3'
 
 
 class NebiusStore(_S3CompatibleStore):
@@ -338,6 +513,7 @@ class NebiusStore(_S3CompatibleStore):
     store_type = StoreType.NEBIUS
     _ENDPOINT_ENV = 'NEBIUS_S3_ENDPOINT'
     _RCLONE_REMOTE = 'xsky-nebius'
+    _CRED_ENV_PREFIX = 'NEBIUS'
 
     def __init__(self, name: str, source: Optional[str] = None,
                  region: Optional[str] = None) -> None:

@@ -471,7 +471,11 @@ class SpeculativeOrchestrator(Orchestrator):
         budget = self.prefill_chunks_per_tick
         for slot in list(self._draft_partials):
             request, cp = self._draft_partials[slot]
-            if slot not in self._slot_req:
+            # Identity check, not just occupancy: if the owning request
+            # finished and the slot was re-admitted in the same tick, a
+            # stale partial's finalize() would overwrite the NEW
+            # request's draft cache with the old prompt's KV.
+            if request.done or self._slot_req.get(slot) is not request:
                 del self._draft_partials[slot]   # finished/cancelled
                 continue
             if budget <= 0:

@@ -541,6 +541,13 @@ def main() -> int:
     orch.submit(orch_lib.Request(prompt_tokens=[1, 2, 3],
                                  max_new_tokens=2, logprobs=1))
     orch.run_until_drained()
+    # Penalties select a distinct compiled decode variant — warm it too,
+    # or the first penalized request stalls every slot on an XLA compile.
+    orch.submit(orch_lib.Request(prompt_tokens=[1, 2, 3],
+                                 max_new_tokens=2,
+                                 presence_penalty=0.1,
+                                 frequency_penalty=0.1))
+    orch.run_until_drained()
     loop = ServingLoop(orch)
 
     from skypilot_tpu.infer import tokenizer as tokenizer_lib

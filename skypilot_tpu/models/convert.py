@@ -145,8 +145,15 @@ def _common_layers(source: _TensorSource, n_layers: int) -> Params:
 
 
 def _lm_head(source: _TensorSource, hf: dict) -> np.ndarray:
-    if not hf.get('tie_word_embeddings', False) and \
-            'lm_head.weight' in source:
+    if not hf.get('tie_word_embeddings', False):
+        if 'lm_head.weight' not in source:
+            # Falling back to the tied embedding here would produce
+            # wrong logits with no error — fail loudly like the rest of
+            # the converter does for unsupported variants.
+            raise ValueError(
+                'checkpoint declares tie_word_embeddings=false but has '
+                'no lm_head.weight tensor; refusing to silently reuse '
+                'the embedding as the output head')
         return source.get('lm_head.weight').T
     return source.get('embed_tokens.weight').T
 

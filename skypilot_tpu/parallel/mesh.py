@@ -81,12 +81,35 @@ def build_mesh(plan: Optional[MeshPlan] = None,
             raise ValueError(
                 f'data axis ({plan.data}) must be a multiple of num_slices '
                 f'({num_slices}) for multislice layout.')
+        if len(devices) % num_slices:
+            raise ValueError(
+                f'{len(devices)} devices not divisible into '
+                f'{num_slices} slices.')
         from jax.experimental import mesh_utils
         per_slice = len(devices) // num_slices
         dcn_shape = (num_slices, 1, 1, 1, 1, 1)
         ici_shape = (plan.data // num_slices,) + shape[1:]
-        device_array = mesh_utils.create_hybrid_device_mesh(
-            ici_shape, dcn_shape, devices=devices)
+        if hasattr(devices[0], 'slice_index'):
+            device_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices)
+        else:
+            # Virtual devices (CPU dry runs) carry no slice topology:
+            # partition the ordered device list into contiguous
+            # "slices", lay each out as its own ICI mesh, and stack so
+            # the slice index becomes the outermost (slowest-varying)
+            # stride of the 'data' axis — the same data-outermost
+            # layout create_hybrid_device_mesh produces, so collectives
+            # compile identically to the real multislice case.
+            slabs = []
+            for s in range(num_slices):
+                group = devices[s * per_slice:(s + 1) * per_slice]
+                try:
+                    slab = mesh_utils.create_device_mesh(
+                        ici_shape, devices=group)
+                except (ValueError, AssertionError):
+                    slab = np.asarray(group).reshape(ici_shape)
+                slabs.append(slab)
+            device_array = np.concatenate(slabs, axis=0)
     else:
         try:
             from jax.experimental import mesh_utils

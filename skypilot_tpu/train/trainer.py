@@ -107,6 +107,15 @@ class Trainer:
                 raise exceptions.NotSupportedError(
                     f'{self._model_lib.__name__} does not support '
                     f'pipeline parallelism for this config: {reason}')
+            if getattr(config.model, 'packing_reset_eos', None) is not None:
+                # The pipelined layer body builds plain arange positions
+                # and no segment masks, so packed-sequence training would
+                # silently attend across document boundaries — mirror the
+                # explicit ring/ulysses guard instead.
+                raise NotImplementedError(
+                    'packing_reset_eos is not implemented for pipeline '
+                    'parallelism (segment masks and reset positions do '
+                    'not ride the GPipe microbatch schedule).')
         self._rules = (mesh_lib.PIPELINE_RULES if self._n_stages > 1
                        else mesh_lib.DEFAULT_RULES)
         self._param_shardings = mesh_lib.tree_shardings(

@@ -128,3 +128,70 @@ class TestGangScale:
             assert f'[host-{i}] done-{i}' in content
         # Bounded: total ≤ n * cap + slack.
         assert gang_log.stat().st_size < n * 64 * 1024 + 16 * 1024
+
+
+class TestMultisliceEnv:
+    """build_host_envs for a 2-slice inventory: MEGASCALE_* wiring
+    (VERDICT r3 #5 — multislice must be proven, not just provisioned)."""
+
+    def _two_slice_cluster(self, hosts_per_slice=2):
+        from skypilot_tpu.provision import common as pc
+        instances = {}
+        for s, slice_id in enumerate(['slice-a', 'slice-b']):
+            for i in range(hosts_per_slice):
+                iid = f'{slice_id}-h{i}'
+                instances[iid] = pc.InstanceInfo(
+                    instance_id=iid,
+                    internal_ip=f'10.0.{s}.{i + 1}',
+                    external_ip=None,
+                    status='RUNNING',
+                    tags={'node_index': '0'},
+                    slice_id=slice_id,
+                    host_index=i)
+        return pc.ClusterInfo(instances=instances,
+                              head_instance_id='slice-a-h0',
+                              provider_name='fake')
+
+    def test_megascale_env_two_slices(self):
+        info = self._two_slice_cluster()
+        envs = gang.build_host_envs(info)
+        assert len(envs) == 4
+        head_addr = envs[0]['MEGASCALE_COORDINATOR_ADDRESS']
+        for env in envs:
+            assert env['MEGASCALE_NUM_SLICES'] == '2'
+            # One coordinator for the whole multislice job.
+            assert env['MEGASCALE_COORDINATOR_ADDRESS'] == head_addr
+        assert head_addr.startswith('10.0.0.1:')
+        # Slice ids are dense [0, num_slices) and per-host consistent.
+        by_slice = {}
+        for env in envs:
+            by_slice.setdefault(env['MEGASCALE_SLICE_ID'], []).append(env)
+        assert sorted(by_slice) == ['0', '1']
+        # TPU_WORKER_ID restarts at 0 within each slice and hostnames
+        # list exactly the slice peers.
+        for slice_envs in by_slice.values():
+            ids = sorted(int(e['TPU_WORKER_ID']) for e in slice_envs)
+            assert ids == [0, 1]
+            hostnames = {e['TPU_WORKER_HOSTNAMES'] for e in slice_envs}
+            assert len(hostnames) == 1
+            assert len(hostnames.pop().split(',')) == 2
+        # jax.distributed coordinator spans ALL hosts (DCN axis).
+        for rank, env in enumerate(envs):
+            assert env['XSKY_HOST_RANK'] == str(rank)
+            assert env['XSKY_NUM_HOSTS'] == '4'
+
+    def test_single_slice_has_no_megascale(self):
+        from skypilot_tpu.provision import common as pc
+        instances = {
+            f'h{i}': pc.InstanceInfo(
+                instance_id=f'h{i}', internal_ip=f'10.0.0.{i + 1}',
+                external_ip=None, status='RUNNING',
+                tags={'node_index': '0'}, slice_id='slice-a',
+                host_index=i)
+            for i in range(2)
+        }
+        info = pc.ClusterInfo(instances=instances, head_instance_id='h0',
+                              provider_name='fake')
+        envs = gang.build_host_envs(info)
+        for env in envs:
+            assert 'MEGASCALE_NUM_SLICES' not in env

@@ -78,6 +78,22 @@ class TestLlamaParity:
                              jnp.asarray(TOKENS, jnp.int32))
         _assert_close(ours, _hf_logits(hf_model, TOKENS))
 
+    def test_untied_checkpoint_missing_lm_head_raises(self):
+        """tie_word_embeddings=false + no lm_head.weight must raise
+        (ADVICE r3: silently reusing the embedding transpose produces
+        wrong logits with no error)."""
+
+        class _FakeSource:
+            def __contains__(self, key):
+                return key != 'lm_head.weight'
+
+            def get(self, key):
+                raise AssertionError('should fail before any get()')
+
+        with pytest.raises(ValueError, match='lm_head'):
+            convert._lm_head(_FakeSource(),
+                             {'tie_word_embeddings': False})
+
     def test_serving_engine_on_converted_weights(self):
         """Converted weights drive the slot engine end-to-end and its
         greedy output matches HF greedy continuation."""

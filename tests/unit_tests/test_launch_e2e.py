@@ -223,7 +223,12 @@ class TestLaunch:
         assert status == job_lib.JobStatus.CANCELLED
 
     def test_autostop_lifecycle(self, fake_cluster_env):
-        from skypilot_tpu.agent import autostop_lib, daemon
+        """Agent-side autostop teardown: the daemon tick must actually
+        release the cloud resource (VERDICT r3 #6 — not just write a
+        marker). The fake cloud is driveable from on-host, so the tick
+        terminates the cluster in the provider store directly."""
+        from skypilot_tpu.agent import daemon
+        from skypilot_tpu.provision.fake import instance as fake_instance
         task = Task('idle', run='echo done')
         task.set_resources(Resources(accelerators='tpu-v5e-8'))
         _, handle = execution.launch(
@@ -231,12 +236,32 @@ class TestLaunch:
         root = handle.head_runtime_root
         record = state.get_cluster_from_name('a1')
         assert record['autostop'] == 0
-        # Tick the agent: idle 0-minute deadline passed → marker written.
+        assert fake_instance.query_instances('a1', {})
+        # Tick the agent: idle 0-minute deadline passed → the agent
+        # terminates its own cluster via the provider API.
+        daemon.run_forever(root=root, interval_s=0, max_ticks=1)
+        assert fake_instance.query_instances('a1', {}) == {}
+
+    def test_autostop_marker_fallback(self, fake_cluster_env,
+                                      monkeypatch):
+        """Providers that can't be driven from on-host (or with
+        self-teardown disabled) fall back to the marker file the
+        control plane polls (pull model)."""
+        from skypilot_tpu.agent import autostop_lib, daemon
+        monkeypatch.setenv('XSKY_AGENT_NO_SELF_TEARDOWN', '1')
+        task = Task('idle', run='echo done')
+        task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        _, handle = execution.launch(
+            task, cluster_name='a2', idle_minutes_to_autostop=0,
+            down=True)
+        root = handle.head_runtime_root
         daemon.run_forever(root=root, interval_s=0, max_ticks=1)
         marker = os.path.join(root, 'autostop_triggered.json')
         assert os.path.exists(marker)
         with open(marker) as f:
             assert json.load(f)['down'] is True
+        # The deadline must not re-fire: config was cleared.
+        assert autostop_lib.get_autostop(root) is None
 
 
 class TestBootstrap:

@@ -106,6 +106,29 @@ class TestMesh:
         spec2 = mesh_lib.logical_to_spec(('vocab', 'embed'))
         assert spec2 == mesh_lib.PartitionSpec('tensor', 'fsdp')
 
+    def test_build_mesh_multislice_layout(self):
+        """num_slices=2 on virtual devices: the slice index must be the
+        outermost stride of the 'data' axis (only gradient reduce
+        crosses the DCN boundary), with each slice's devices contiguous
+        in the inner mesh."""
+        devices = jax.devices()[:8]
+        mesh = mesh_lib.build_mesh(
+            mesh_lib.MeshPlan(data=2, fsdp=2, tensor=2),
+            devices=devices, num_slices=2)
+        assert mesh.shape['data'] == 2
+        arr = mesh.devices
+        # data index 0 → slice A devices (first half of the ordered
+        # list), data index 1 → slice B, regardless of inner layout.
+        first = {d.id for d in arr[0].flatten()}
+        second = {d.id for d in arr[1].flatten()}
+        assert first == {d.id for d in devices[:4]}
+        assert second == {d.id for d in devices[4:]}
+
+    def test_build_mesh_multislice_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            mesh_lib.build_mesh(mesh_lib.MeshPlan(data=3, fsdp=2),
+                                devices=jax.devices()[:6], num_slices=2)
+
 
 class TestShardedTraining:
 
@@ -219,6 +242,20 @@ class TestPackedSequences:
         gnorm = jax.tree_util.tree_reduce(
             lambda a, g: a + float(jnp.abs(g).sum()), grads, 0.0)
         assert gnorm > 0
+
+    def test_packing_rejected_under_pipeline(self):
+        """packing_reset_eos + stage>1 must fail at Trainer
+        construction: the GPipe layer body has no segment masks, so
+        letting it run would silently train with cross-document
+        attention (ADVICE r3, medium)."""
+        c = dataclasses.replace(llama.LLAMA_TINY, n_layers=4,
+                                packing_reset_eos=0)
+        config = trainer_lib.TrainConfig(
+            model=c, global_batch_size=4, seq_len=16,
+            n_microbatches=2,
+            mesh_plan=mesh_lib.MeshPlan(data=2, stage=2, tensor=2))
+        with pytest.raises(NotImplementedError, match='packing_reset_eos'):
+            trainer_lib.Trainer(config)
 
 
 class TestGradAccumulation:

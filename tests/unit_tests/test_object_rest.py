@@ -1,0 +1,444 @@
+"""Tests for the zero-dep object-store REST clients (data/object_rest)
+and their wiring into the store lifecycle (data/storage).
+
+All network is faked by injecting an ``opener`` that records requests
+and replays canned responses — the same recorded-response pattern as
+the provisioner fakes (tests/unit_tests/test_aws.py et al.). Covers:
+SigV4/SharedKey request shape, bucket lifecycle verbs per backend, list
+pagination, and the store classes preferring REST over the CLI.
+"""
+from __future__ import annotations
+
+import io
+import json
+import urllib.error
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.data import object_rest
+from skypilot_tpu.data import storage as storage_lib
+
+
+class _FakeResponse:
+    def __init__(self, status: int = 200, body: bytes = b'') -> None:
+        self.status = status
+        self._body = body
+
+    def read(self) -> bytes:
+        return self._body
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class _FakeOpener:
+    """Records (method, url, body, headers); replays queued responses.
+
+    Each queued entry is a _FakeResponse or an HTTPError status int.
+    An empty queue returns 200/b''.
+    """
+
+    def __init__(self) -> None:
+        self.requests = []
+        self.queue = []
+
+    def push(self, status: int = 200, body: bytes = b'') -> None:
+        self.queue.append(_FakeResponse(status, body))
+
+    def push_error(self, status: int, body: bytes = b'') -> None:
+        self.queue.append(status if not body else (status, body))
+
+    def __call__(self, req, timeout=None):
+        self.requests.append({
+            'method': req.get_method(),
+            'url': req.full_url,
+            'body': req.data,
+            'headers': dict(req.header_items()),
+        })
+        if not self.queue:
+            return _FakeResponse()
+        item = self.queue.pop(0)
+        if isinstance(item, _FakeResponse):
+            return item
+        status, body = item if isinstance(item, tuple) else (item, b'')
+        raise urllib.error.HTTPError(req.full_url, status, 'err', {},
+                                     io.BytesIO(body))
+
+
+CREDS = ('AKID', 'SECRET', None)
+
+
+# ---------------------------------------------------------------------------
+# S3ObjectClient
+# ---------------------------------------------------------------------------
+
+
+def test_s3_sigv4_request_shape():
+    opener = _FakeOpener()
+    client = object_rest.S3ObjectClient(region='us-west-2', creds=CREDS,
+                                        opener=opener)
+    client.put_object('bkt', 'path/to/obj.txt', b'hello')
+    req = opener.requests[0]
+    assert req['method'] == 'PUT'
+    assert req['url'] == 'https://s3.us-west-2.amazonaws.com/bkt/path/to/obj.txt'
+    auth = req['headers']['Authorization']
+    assert auth.startswith('AWS4-HMAC-SHA256 Credential=AKID/')
+    assert '/us-west-2/s3/aws4_request' in auth
+    assert 'SignedHeaders=host;x-amz-content-sha256;x-amz-date' in auth
+    # Payload hash is the SHA-256 of the body, not UNSIGNED-PAYLOAD.
+    import hashlib
+    assert req['headers']['X-amz-content-sha256'] == \
+        hashlib.sha256(b'hello').hexdigest()
+
+
+def test_s3_custom_endpoint_and_session_token():
+    opener = _FakeOpener()
+    client = object_rest.S3ObjectClient(
+        region='auto', endpoint='https://acct.r2.cloudflarestorage.com',
+        creds=('AK', 'SK', 'TOKEN'), opener=opener)
+    client.bucket_exists('bkt')
+    req = opener.requests[0]
+    assert req['url'].startswith('https://acct.r2.cloudflarestorage.com/')
+    assert req['headers']['X-amz-security-token'] == 'TOKEN'
+    assert 'x-amz-security-token' in req['headers']['Authorization']
+
+
+def test_s3_bucket_lifecycle():
+    opener = _FakeOpener()
+    client = object_rest.S3ObjectClient(region='us-east-1', creds=CREDS,
+                                        opener=opener)
+    opener.push_error(404)       # HEAD → missing
+    assert not client.bucket_exists('bkt')
+    client.create_bucket('bkt')  # PUT
+    opener.push(200)             # HEAD → present
+    assert client.bucket_exists('bkt')
+    assert [r['method'] for r in opener.requests] == \
+        ['HEAD', 'PUT', 'HEAD']
+
+
+def test_s3_create_bucket_location_constraint():
+    opener = _FakeOpener()
+    client = object_rest.S3ObjectClient(region='eu-west-1', creds=CREDS,
+                                        opener=opener)
+    client.create_bucket('bkt')
+    assert b'eu-west-1' in opener.requests[0]['body']
+    # us-east-1 must NOT send a LocationConstraint (AWS rejects it).
+    opener2 = _FakeOpener()
+    client2 = object_rest.S3ObjectClient(region='us-east-1', creds=CREDS,
+                                         opener=opener2)
+    client2.create_bucket('bkt')
+    assert opener2.requests[0]['body'] is None
+
+
+def test_s3_list_objects_paginated():
+    page1 = b'''<?xml version="1.0"?>
+<ListBucketResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">
+  <Contents><Key>a.txt</Key></Contents>
+  <Contents><Key>b.txt</Key></Contents>
+  <NextContinuationToken>tok123</NextContinuationToken>
+</ListBucketResult>'''
+    page2 = b'''<?xml version="1.0"?>
+<ListBucketResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">
+  <Contents><Key>c.txt</Key></Contents>
+</ListBucketResult>'''
+    opener = _FakeOpener()
+    opener.push(200, page1)
+    opener.push(200, page2)
+    client = object_rest.S3ObjectClient(creds=CREDS, opener=opener)
+    assert client.list_objects('bkt') == ['a.txt', 'b.txt', 'c.txt']
+    assert 'continuation-token=tok123' in opener.requests[1]['url']
+
+
+def test_s3_delete_bucket_drains_objects():
+    listing = b'''<?xml version="1.0"?>
+<ListBucketResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">
+  <Contents><Key>x</Key></Contents>
+</ListBucketResult>'''
+    opener = _FakeOpener()
+    opener.push(200, listing)
+    client = object_rest.S3ObjectClient(creds=CREDS, opener=opener)
+    client.delete_bucket('bkt')
+    methods = [(r['method'], r['url']) for r in opener.requests]
+    assert methods[1][0] == 'DELETE' and methods[1][1].endswith('/bkt/x')
+    assert methods[2][0] == 'DELETE' and methods[2][1].endswith('/bkt')
+
+
+def test_s3_error_parsing():
+    err = (b'<?xml version="1.0"?><Error><Code>AccessDenied</Code>'
+           b'<Message>nope</Message></Error>')
+    opener = _FakeOpener()
+    opener.push_error(403, err)
+    client = object_rest.S3ObjectClient(creds=CREDS, opener=opener)
+    with pytest.raises(object_rest.ObjectStoreError) as ei:
+        client.get_object('bkt', 'k')
+    assert ei.value.code == 'AccessDenied'
+    assert ei.value.status == 403
+
+
+def test_s3_streamed_file_put_shape(tmp_path):
+    """File uploads stream from disk: UNSIGNED-PAYLOAD signing (no
+    second full read to hash) + explicit Content-Length, body is the
+    open file object rather than an in-memory copy."""
+    f = tmp_path / 'big.bin'
+    f.write_bytes(b'x' * 1024)
+    opener = _FakeOpener()
+    client = object_rest.S3ObjectClient(creds=CREDS, opener=opener)
+    client.put_object_file('bkt', 'big.bin', str(f))
+    req = opener.requests[0]
+    assert req['headers']['X-amz-content-sha256'] == 'UNSIGNED-PAYLOAD'
+    assert req['headers']['Content-length'] == '1024'
+    assert not isinstance(req['body'], bytes)
+
+
+def test_azure_streamed_file_put_signs_length(tmp_path):
+    f = tmp_path / 'big.bin'
+    f.write_bytes(b'x' * 2048)
+    opener = _FakeOpener()
+    client = object_rest.AzureBlobClient(account='acct', key=AZ_KEY,
+                                         opener=opener)
+    client.put_blob_file('cont', 'big.bin', str(f))
+    req = opener.requests[0]
+    assert req['headers']['Content-length'] == '2048'
+    assert not isinstance(req['body'], bytes)
+
+
+def test_s3_store_prefix_delete_never_drops_bucket(tmp_path):
+    """A store named 'bucket/sub' deletes only its prefix objects —
+    never the shared bucket (code-review r4 finding)."""
+    listing = (b'<?xml version="1.0"?>'
+               b'<ListBucketResult '
+               b'xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+               b'<Contents><Key>sub/a.txt</Key></Contents>'
+               b'</ListBucketResult>')
+    opener = _FakeOpener()
+    opener.push(200, listing)
+    client = object_rest.S3ObjectClient(creds=CREDS, opener=opener)
+    store = storage_lib.S3Store('shared-bucket/sub')
+    store.rest_client = client
+    store.delete()
+    methods = [(r['method'], r['url']) for r in opener.requests]
+    assert ('GET', methods[0][1]) == methods[0]
+    assert 'prefix=sub%2F' in methods[0][1]
+    deletes = [u for m, u in methods if m == 'DELETE']
+    assert deletes == [
+        'https://s3.us-east-1.amazonaws.com/shared-bucket/sub/a.txt']
+
+
+def test_s3_upload_dir(tmp_path):
+    (tmp_path / 'sub').mkdir()
+    (tmp_path / 'a.txt').write_bytes(b'A')
+    (tmp_path / 'sub' / 'b.txt').write_bytes(b'B')
+    opener = _FakeOpener()
+    client = object_rest.S3ObjectClient(creds=CREDS, opener=opener)
+    n = client.upload_dir('bkt', str(tmp_path), prefix='pre/')
+    assert n == 2
+    urls = sorted(r['url'] for r in opener.requests)
+    assert urls[0].endswith('/bkt/pre/a.txt')
+    assert urls[1].endswith('/bkt/pre/sub/b.txt')
+
+
+# ---------------------------------------------------------------------------
+# AzureBlobClient
+# ---------------------------------------------------------------------------
+
+AZ_KEY = 'c2VjcmV0a2V5'  # base64('secretkey')
+
+
+def test_azure_sharedkey_request_shape():
+    opener = _FakeOpener()
+    client = object_rest.AzureBlobClient(account='acct', key=AZ_KEY,
+                                         opener=opener)
+    client.put_blob('cont', 'dir/blob.bin', b'data')
+    req = opener.requests[0]
+    assert req['url'] == \
+        'https://acct.blob.core.windows.net/cont/dir/blob.bin'
+    assert req['headers']['Authorization'].startswith('SharedKey acct:')
+    assert req['headers']['X-ms-blob-type'] == 'BlockBlob'
+    assert req['headers']['X-ms-version'] == \
+        object_rest.AzureBlobClient.API_VERSION
+
+
+def test_azure_container_lifecycle():
+    opener = _FakeOpener()
+    client = object_rest.AzureBlobClient(account='acct', key=AZ_KEY,
+                                         opener=opener)
+    opener.push_error(404)
+    assert not client.container_exists('cont')
+    client.create_container('cont')
+    opener.push(200)
+    assert client.container_exists('cont')
+    client.delete_container('cont')
+    reqs = opener.requests
+    assert 'restype=container' in reqs[1]['url']
+    assert reqs[1]['method'] == 'PUT'
+    assert reqs[3]['method'] == 'DELETE'
+
+
+def test_azure_list_blobs_paginated():
+    page1 = (b'<?xml version="1.0"?><EnumerationResults>'
+             b'<Blobs><Blob><Name>a</Name></Blob></Blobs>'
+             b'<NextMarker>m1</NextMarker></EnumerationResults>')
+    page2 = (b'<?xml version="1.0"?><EnumerationResults>'
+             b'<Blobs><Blob><Name>b</Name></Blob></Blobs>'
+             b'<NextMarker/></EnumerationResults>')
+    opener = _FakeOpener()
+    opener.push(200, page1)
+    opener.push(200, page2)
+    client = object_rest.AzureBlobClient(account='acct', key=AZ_KEY,
+                                         opener=opener)
+    assert client.list_blobs('cont') == ['a', 'b']
+    assert 'marker=m1' in opener.requests[1]['url']
+
+
+def test_azure_missing_credentials(monkeypatch):
+    monkeypatch.delenv('AZURE_STORAGE_ACCOUNT', raising=False)
+    monkeypatch.delenv('AZURE_STORAGE_KEY', raising=False)
+    with pytest.raises(exceptions.PermissionError_):
+        object_rest.AzureBlobClient()
+
+
+# ---------------------------------------------------------------------------
+# GcsObjectClient
+# ---------------------------------------------------------------------------
+
+
+class _FakeTokens:
+    def token(self):
+        return 'tok-xyz'
+
+
+def test_gcs_bucket_lifecycle():
+    opener = _FakeOpener()
+    client = object_rest.GcsObjectClient(project='proj',
+                                         token_provider=_FakeTokens(),
+                                         opener=opener)
+    opener.push_error(404)
+    assert not client.bucket_exists('bkt')
+    client.create_bucket('bkt', location='US-WEST1')
+    req = opener.requests[1]
+    assert req['method'] == 'POST'
+    assert 'project=proj' in req['url']
+    assert json.loads(req['body'])['location'] == 'US-WEST1'
+    assert req['headers']['Authorization'] == 'Bearer tok-xyz'
+
+
+def test_gcs_object_roundtrip_urls():
+    opener = _FakeOpener()
+    client = object_rest.GcsObjectClient(project='proj',
+                                         token_provider=_FakeTokens(),
+                                         opener=opener)
+    client.put_object('bkt', 'dir/o.txt', b'x')
+    client.get_object('bkt', 'dir/o.txt')
+    client.delete_object('bkt', 'dir/o.txt')
+    put, get, delete = opener.requests
+    assert 'uploadType=media' in put['url']
+    assert 'name=dir%2Fo.txt' in put['url']
+    assert get['url'].endswith('/o/dir%2Fo.txt?alt=media')
+    assert delete['method'] == 'DELETE'
+
+
+def test_gcs_list_paginated():
+    opener = _FakeOpener()
+    opener.push(200, json.dumps({'items': [{'name': 'a'}],
+                                 'nextPageToken': 'p2'}).encode())
+    opener.push(200, json.dumps({'items': [{'name': 'b'}]}).encode())
+    client = object_rest.GcsObjectClient(project='proj',
+                                         token_provider=_FakeTokens(),
+                                         opener=opener)
+    assert client.list_objects('bkt') == ['a', 'b']
+    assert 'pageToken=p2' in opener.requests[1]['url']
+
+
+def test_gcs_create_needs_project(monkeypatch):
+    monkeypatch.delenv('GOOGLE_CLOUD_PROJECT', raising=False)
+    client = object_rest.GcsObjectClient(token_provider=_FakeTokens(),
+                                         opener=_FakeOpener())
+    with pytest.raises(exceptions.StorageSpecError):
+        client.create_bucket('bkt')
+
+
+# ---------------------------------------------------------------------------
+# Store wiring: lifecycle ops ride the REST clients (no CLI)
+# ---------------------------------------------------------------------------
+
+
+def _client_with_opener(cls, **kwargs):
+    opener = _FakeOpener()
+    return cls(opener=opener, **kwargs), opener
+
+
+def test_s3_store_lifecycle_via_rest(tmp_path):
+    (tmp_path / 'f.txt').write_bytes(b'F')
+    client, opener = _client_with_opener(object_rest.S3ObjectClient,
+                                         creds=CREDS)
+    store = storage_lib.S3Store('mybkt', source=str(tmp_path))
+    store.rest_client = client
+    opener.push_error(404)
+    assert not store.exists()
+    store.create()
+    store.upload()
+    store.delete()
+    methods = [r['method'] for r in opener.requests]
+    # HEAD(miss) PUT(bucket) PUT(object) GET(list) DELETE(bucket)
+    assert methods[0] == 'HEAD'
+    assert methods[1] == 'PUT'
+    assert methods[2] == 'PUT'
+    assert opener.requests[2]['url'].endswith('/mybkt/f.txt')
+    assert methods[-1] == 'DELETE'
+
+
+def test_ibm_oci_nebius_store_rest_endpoints(monkeypatch, tmp_path):
+    monkeypatch.setenv('IBM_COS_ENDPOINT', 'https://cos.example.com')
+    monkeypatch.setenv('IBM_COS_ACCESS_KEY_ID', 'ak')
+    monkeypatch.setenv('IBM_COS_SECRET_ACCESS_KEY', 'sk')
+    store = storage_lib.IBMCosStore('bkt')
+    client = store._rest()
+    assert client is not None
+    assert client.host == 'cos.example.com'
+
+    monkeypatch.setenv('NEBIUS_ACCESS_KEY_ID', 'ak')
+    monkeypatch.setenv('NEBIUS_SECRET_ACCESS_KEY', 'sk')
+    neb = storage_lib.NebiusStore('bkt')
+    nclient = neb._rest()
+    assert nclient is not None
+    assert 'nebius.cloud' in nclient.host
+
+
+def test_azure_store_lifecycle_via_rest(monkeypatch, tmp_path):
+    (tmp_path / 'f.txt').write_bytes(b'F')
+    client, opener = _client_with_opener(object_rest.AzureBlobClient,
+                                         account='acct', key=AZ_KEY)
+    store = storage_lib.AzureBlobStore('cont', source=str(tmp_path))
+    store.rest_client = client
+    store.create()
+    store.upload()
+    empty_list = (b'<?xml version="1.0"?><EnumerationResults><Blobs/>'
+                  b'<NextMarker/></EnumerationResults>')
+    opener.push(200, empty_list)
+    store.delete()
+    urls = [r['url'] for r in opener.requests]
+    assert any('restype=container' in u for u in urls)
+    assert any(u.endswith('/cont/f.txt') for u in urls)
+    assert opener.requests[-1]['method'] == 'DELETE'
+
+
+def test_gcs_store_lifecycle_via_rest(tmp_path):
+    (tmp_path / 'f.txt').write_bytes(b'F')
+    client, opener = _client_with_opener(object_rest.GcsObjectClient,
+                                         project='proj',
+                                         token_provider=_FakeTokens())
+    store = storage_lib.GcsStore('gbkt', source=str(tmp_path))
+    store.rest_client = client
+    store.create()
+    store.upload()
+    assert any('uploadType=media' in r['url'] for r in opener.requests)
+
+
+def test_store_transport_cli_override(monkeypatch):
+    monkeypatch.setenv('XSKY_STORE_TRANSPORT', 'cli')
+    store = storage_lib.S3Store('bkt')
+    assert store._rest() is None

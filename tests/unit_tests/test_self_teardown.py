@@ -1,0 +1,142 @@
+"""Agent-side autostop teardown unit tests (agent/self_teardown).
+
+The e2e fake-cloud path lives in test_launch_e2e.py::test_autostop_*;
+these cover the dispatch/fallback logic and the GCP wiring against the
+injected provisioner entry points.
+"""
+import json
+import os
+
+import pytest
+
+from skypilot_tpu.agent import self_teardown
+
+
+def _write_info(root, provider='gcp', cluster_name='c1', config=None):
+    os.makedirs(root, exist_ok=True)
+    with open(os.path.join(root, 'cluster_info.json'), 'w') as f:
+        json.dump({
+            'instances': {}, 'head_instance_id': None,
+            'provider_name': provider,
+            'provider_config': config or {'project_id': 'p',
+                                          'zone': 'us-central2-b'},
+            'cluster_name': cluster_name,
+        }, f)
+
+
+class _Recorder:
+    def __init__(self, fail=False):
+        self.calls = []
+        self.fail = fail
+
+    def __call__(self, provider, cluster_name, provider_config):
+        self.calls.append((provider, cluster_name, provider_config))
+        if self.fail:
+            raise RuntimeError('simulated API failure')
+
+
+def test_gcp_down_dispatches_terminate(tmp_path):
+    _write_info(tmp_path)
+    term, stop = _Recorder(), _Recorder()
+    ok = self_teardown.attempt_self_teardown(
+        str(tmp_path), down=True, terminate_fn=term, stop_fn=stop)
+    assert ok
+    assert term.calls == [('gcp', 'c1',
+                           {'project_id': 'p', 'zone': 'us-central2-b'})]
+    assert stop.calls == []
+
+
+def test_gcp_stop_dispatches_stop(tmp_path):
+    _write_info(tmp_path)
+    term, stop = _Recorder(), _Recorder()
+    ok = self_teardown.attempt_self_teardown(
+        str(tmp_path), down=False, terminate_fn=term, stop_fn=stop)
+    assert ok
+    assert stop.calls and not term.calls
+
+
+def test_api_failure_falls_back(tmp_path):
+    """An API error (missing scopes, transient) must degrade to the
+    marker-file pull model, never raise out of the daemon tick."""
+    _write_info(tmp_path)
+    ok = self_teardown.attempt_self_teardown(
+        str(tmp_path), down=True, terminate_fn=_Recorder(fail=True),
+        stop_fn=_Recorder())
+    assert not ok
+
+
+def test_non_self_service_provider_falls_back(tmp_path):
+    _write_info(tmp_path, provider='aws')
+    term = _Recorder()
+    ok = self_teardown.attempt_self_teardown(
+        str(tmp_path), down=True, terminate_fn=term, stop_fn=term)
+    assert not ok and not term.calls
+
+
+def test_missing_identity_falls_back(tmp_path):
+    ok = self_teardown.attempt_self_teardown(
+        str(tmp_path), down=True, terminate_fn=_Recorder(),
+        stop_fn=_Recorder())
+    assert not ok
+
+
+def test_env_gate_disables(tmp_path, monkeypatch):
+    _write_info(tmp_path)
+    monkeypatch.setenv('XSKY_AGENT_NO_SELF_TEARDOWN', '1')
+    term = _Recorder()
+    ok = self_teardown.attempt_self_teardown(
+        str(tmp_path), down=True, terminate_fn=term, stop_fn=term)
+    assert not ok and not term.calls
+
+
+def test_legacy_info_without_cluster_name_falls_back(tmp_path):
+    """cluster_info.json written by a pre-r4 backend has no
+    cluster_name key — the agent must fall back, not guess."""
+    os.makedirs(tmp_path, exist_ok=True)
+    with open(os.path.join(tmp_path, 'cluster_info.json'), 'w') as f:
+        json.dump({'instances': {}, 'head_instance_id': None,
+                   'provider_name': 'gcp', 'provider_config': {}}, f)
+    ok = self_teardown.attempt_self_teardown(
+        str(tmp_path), down=True, terminate_fn=_Recorder(),
+        stop_fn=_Recorder())
+    assert not ok
+
+
+def test_gcp_terminate_rides_instance_identity(tmp_path, monkeypatch):
+    """End-to-end through the real provisioner dispatch with a fake
+    REST transport: DELETE calls for the cluster's queued resources and
+    nodes, authenticated by the metadata-server token chain (the
+    instance's own identity)."""
+    from skypilot_tpu.provision.gcp import instance as gcp_instance
+
+    calls = []
+
+    class _FakeTransport:
+        def request(self, method, url, params=None, body=None,
+                    timeout=60):
+            calls.append((method, url))
+            if method == 'GET' and 'queuedResources' in url:
+                return {'queuedResources': [
+                    {'name': 'projects/p/locations/z/queuedResources/qr1',
+                     'state': {'state': 'ACTIVE'},
+                     'tpu': {'nodeSpec': [{'node': {
+                         'labels': {'xsky-cluster': 'c1'}}}]}}]}
+            if method == 'GET' and url.endswith('/nodes'):
+                return {'nodes': [
+                    {'name': 'projects/p/locations/z/nodes/c1-0',
+                     'state': 'READY',
+                     'labels': {'xsky-cluster': 'c1'}}]}
+            if method == 'GET' and 'instances' in url:
+                return {'items': []}
+            if method == 'DELETE':
+                return {'name': 'operations/op1', 'done': True}
+            return {'done': True}
+
+    monkeypatch.setattr(gcp_instance, '_transport_factory',
+                        _FakeTransport)
+    _write_info(tmp_path, config={'project_id': 'p', 'zone': 'z'})
+    ok = self_teardown.attempt_self_teardown(str(tmp_path), down=True)
+    assert ok
+    deletes = [u for m, u in calls if m == 'DELETE']
+    assert any('queuedResources/qr1' in u for u in deletes)
+    assert any('/nodes/c1-0' in u for u in deletes)

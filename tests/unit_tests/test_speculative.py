@@ -303,3 +303,30 @@ def test_gemma2_target_speculative_exact(monkeypatch):
     assert spec.generate([prompt], max_new_tokens=10) == expected
     ng = orch_lib.NgramSpeculator(mk(), gamma=3)
     assert ng.generate([prompt], max_new_tokens=10) == expected
+
+
+def test_stale_draft_partial_dropped_on_slot_reuse(target_engine,
+                                                   draft_engine):
+    """A chunked draft prefill whose owning request finished must be
+    discarded when its slot is re-admitted to a NEW request in the same
+    tick — not stepped and finalized over the new request's draft cache
+    (ADVICE r3: identity check, not just slot occupancy)."""
+    spec = orch_lib.SpeculativeOrchestrator(target_engine, draft_engine,
+                                            gamma=3)
+    old = orch_lib.Request(prompt_tokens=[1, 2, 3], max_new_tokens=4)
+    new = orch_lib.Request(prompt_tokens=[4, 5, 6], max_new_tokens=4)
+    spec.submit(new)
+    spec._admit_one()
+    slot = next(iter(spec._slot_req))
+    assert spec._slot_req[slot] is new
+    old.done = True
+
+    class _MustNotStep:
+        def step(self):
+            raise AssertionError('stale draft partial was stepped')
+
+    # Simulate the race: the stale partial still keyed to `slot`, now
+    # owned by `new`.
+    spec._draft_partials[slot] = (old, _MustNotStep())
+    spec._advance_draft_partials()
+    assert slot not in spec._draft_partials

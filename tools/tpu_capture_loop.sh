@@ -1,0 +1,65 @@
+#!/bin/bash
+# Round-long TPU bench capture loop.
+#
+# The axon TPU tunnel is intermittently down (rounds 2 and 3 both ended
+# with `jax.devices()` hung at the exact moment the driver ran bench.py,
+# losing the round's official number). This loop runs all round in the
+# background: every cycle it probes the tunnel cheaply, and whenever the
+# chip is reachable it captures train AND serve benches, saving each
+# success to BENCH_LOCAL_r04_{train,serve}.json and to the
+# .bench_last_good_{train,serve}.json files that bench.py embeds in its
+# failure JSON — so even a dead tunnel at round end leaves on-silicon
+# evidence.
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/tpu_capture_loop.log
+echo "=== capture loop start $(date -u +%FT%TZ) pid $$" >> "$LOG"
+
+probe() {
+  # Cheap liveness probe: init must print the sentinel within 150 s.
+  timeout 150 python - <<'EOF' >/dev/null 2>&1
+import jax
+assert jax.devices()[0].platform != 'cpu'
+EOF
+}
+
+capture() { # $1 = train|serve
+  local mode="$1" out rc args=()
+  [ "$mode" = serve ] && args=(serve)
+  out=$(XSKY_BENCH_ATTEMPTS=2 XSKY_BENCH_INIT_TIMEOUT=150 \
+        XSKY_BENCH_RUN_TIMEOUT=1800 \
+        timeout 3900 python bench.py "${args[@]}" 2>>"$LOG")
+  rc=$?
+  echo "--- $mode rc=$rc $(date -u +%FT%TZ)" >> "$LOG"
+  echo "$out" >> "$LOG"
+  local line
+  line=$(printf '%s\n' "$out" | grep '^{' | tail -1)
+  if [ $rc -eq 0 ] && [ -n "$line" ] && \
+     ! printf '%s' "$line" | grep -q '"value": null'; then
+    # Round evidence only; .bench_last_good_* is written by bench.py
+    # itself (with captured_unix) on every successful on-silicon run.
+    printf '%s\n' "$line" > "BENCH_LOCAL_r04_${mode}.json"
+    echo "+++ saved $mode capture" >> "$LOG"
+    return 0
+  fi
+  return 1
+}
+
+train_done=0
+serve_done=0
+while true; do
+  if probe; then
+    echo "tunnel UP $(date -u +%FT%TZ)" >> "$LOG"
+    # Re-capture even after a success if >90 min old: later code may be
+    # faster, and fresher evidence is better evidence.
+    for mode in train serve; do
+      f="BENCH_LOCAL_r04_${mode}.json"
+      if [ ! -f "$f" ] || [ -n "$(find "$f" -mmin +90)" ]; then
+        capture "$mode"
+      fi
+    done
+  else
+    echo "tunnel down $(date -u +%FT%TZ)" >> "$LOG"
+  fi
+  sleep 300
+done
