@@ -62,8 +62,16 @@ def _utcnow() -> datetime.datetime:
 
 
 def _walk_files(local_dir: str) -> Iterator[Tuple[str, str]]:
-    """Yield (absolute_path, key_relative_to_dir) for every file."""
+    """Yield (absolute_path, key_relative_to_dir) for every file.
+
+    A missing source raises: os.walk would silently yield nothing, and
+    an upload that "succeeds" with zero objects marks a typo'd source
+    READY with an empty bucket (the old CLI path failed loudly here).
+    """
     local_dir = os.path.abspath(os.path.expanduser(local_dir))
+    if not os.path.exists(local_dir):
+        raise exceptions.StorageUploadError(
+            f'Upload source not found: {local_dir}')
     if os.path.isfile(local_dir):
         yield local_dir, os.path.basename(local_dir)
         return
@@ -72,6 +80,61 @@ def _walk_files(local_dir: str) -> Iterator[Tuple[str, str]]:
             path = os.path.join(root, name)
             yield path, os.path.relpath(path, local_dir).replace(
                 os.sep, '/')
+
+
+def _parse_xml_error(raw: bytes) -> Tuple[str, str]:
+    """S3/Azure error body → (Code, Message)."""
+    code, message = 'Unknown', raw.decode(errors='replace')
+    try:
+        root = ET.fromstring(raw)
+        code = root.findtext('.//Code', code)
+        message = root.findtext('.//Message', message)
+    except ET.ParseError:
+        pass
+    return code, message
+
+
+def _parse_json_error(raw: bytes) -> Tuple[str, str]:
+    """GCS JSON-API error body → ('GcsError', message)."""
+    message = raw.decode(errors='replace')
+    try:
+        message = json.loads(raw)['error']['message']
+    except (json.JSONDecodeError, KeyError, TypeError):
+        pass
+    return 'GcsError', message
+
+
+def _http_call(opener: Opener, method: str, url: str,
+               headers: Dict[str, str], body: bytes = b'',
+               body_file: Optional[str] = None,
+               ok_codes: Tuple[int, ...] = (),
+               parse_error=_parse_xml_error) -> Tuple[int, bytes]:
+    """Shared dispatch for all three clients: optional disk-streamed
+    body (explicit Content-Length so urllib doesn't chunk), tolerated
+    status codes, store-specific error parsing, network-error wrapping.
+    """
+    try:
+        if body_file is not None:
+            headers = dict(headers)
+            headers['Content-Length'] = str(os.path.getsize(body_file))
+            with open(body_file, 'rb') as f:
+                req = urllib.request.Request(url, data=f,
+                                             headers=headers,
+                                             method=method)
+                with opener(req, timeout=600) as resp:
+                    return resp.status, resp.read()
+        req = urllib.request.Request(url, data=body or None,
+                                     headers=headers, method=method)
+        with opener(req, timeout=120) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        if e.code in ok_codes:
+            return e.code, raw
+        code, message = parse_error(raw)
+        raise ObjectStoreError(e.code, code, message) from e
+    except (urllib.error.URLError, TimeoutError, OSError) as e:
+        raise ObjectStoreError(0, 'NetworkError', str(e)) from e
 
 
 #: Single-PUT object-size cap (S3: 5 GiB; Azure Put Blob: ~4.75 GiB).
@@ -191,33 +254,8 @@ class S3ObjectClient:
         url = f'{self.scheme}://{self.host}{urllib.parse.quote(path)}'
         if query:
             url += '?' + urllib.parse.urlencode(sorted(query.items()))
-        try:
-            if body_file is not None:
-                headers['Content-Length'] = str(
-                    os.path.getsize(body_file))
-                with open(body_file, 'rb') as f:
-                    req = urllib.request.Request(
-                        url, data=f, headers=headers, method=method)
-                    with self._open(req, timeout=600) as resp:
-                        return resp.status, resp.read()
-            req = urllib.request.Request(url, data=body or None,
-                                         headers=headers, method=method)
-            with self._open(req, timeout=120) as resp:
-                return resp.status, resp.read()
-        except urllib.error.HTTPError as e:
-            raw = e.read()
-            if e.code in ok_codes:
-                return e.code, raw
-            code, message = 'Unknown', raw.decode(errors='replace')
-            try:
-                root = ET.fromstring(raw)
-                code = root.findtext('.//Code', code)
-                message = root.findtext('.//Message', message)
-            except ET.ParseError:
-                pass
-            raise ObjectStoreError(e.code, code, message) from e
-        except (urllib.error.URLError, TimeoutError, OSError) as e:
-            raise ObjectStoreError(0, 'NetworkError', str(e)) from e
+        return _http_call(self._open, method, url, headers, body=body,
+                          body_file=body_file, ok_codes=ok_codes)
 
     # -- bucket lifecycle --
 
@@ -370,32 +408,8 @@ class AzureBlobClient:
         url = f'https://{self.host}{urllib.parse.quote(path)}'
         if query:
             url += '?' + urllib.parse.urlencode(sorted(query.items()))
-        try:
-            if body_file is not None:
-                headers['Content-Length'] = str(body_len)
-                with open(body_file, 'rb') as f:
-                    req = urllib.request.Request(
-                        url, data=f, headers=headers, method=method)
-                    with self._open(req, timeout=600) as resp:
-                        return resp.status, resp.read()
-            req = urllib.request.Request(url, data=body or None,
-                                         headers=headers, method=method)
-            with self._open(req, timeout=120) as resp:
-                return resp.status, resp.read()
-        except urllib.error.HTTPError as e:
-            raw = e.read()
-            if e.code in ok_codes:
-                return e.code, raw
-            code, message = 'Unknown', raw.decode(errors='replace')
-            try:
-                root = ET.fromstring(raw)
-                code = root.findtext('.//Code', code)
-                message = root.findtext('.//Message', message)
-            except ET.ParseError:
-                pass
-            raise ObjectStoreError(e.code, code, message) from e
-        except (urllib.error.URLError, TimeoutError, OSError) as e:
-            raise ObjectStoreError(0, 'NetworkError', str(e)) from e
+        return _http_call(self._open, method, url, headers, body=body,
+                          body_file=body_file, ok_codes=ok_codes)
 
     # -- containers --
 
@@ -492,31 +506,9 @@ class GcsObjectClient:
         headers = {'Authorization': f'Bearer {self._tokens.token()}'}
         if body or body_file:
             headers['Content-Type'] = content_type
-        try:
-            if body_file is not None:
-                headers['Content-Length'] = str(
-                    os.path.getsize(body_file))
-                with open(body_file, 'rb') as f:
-                    req = urllib.request.Request(
-                        url, data=f, headers=headers, method=method)
-                    with self._open(req, timeout=600) as resp:
-                        return resp.status, resp.read()
-            req = urllib.request.Request(url, data=body or None,
-                                         headers=headers, method=method)
-            with self._open(req, timeout=120) as resp:
-                return resp.status, resp.read()
-        except urllib.error.HTTPError as e:
-            raw = e.read()
-            if e.code in ok_codes:
-                return e.code, raw
-            message = raw.decode(errors='replace')
-            try:
-                message = json.loads(raw)['error']['message']
-            except (json.JSONDecodeError, KeyError, TypeError):
-                pass
-            raise ObjectStoreError(e.code, 'GcsError', message) from e
-        except (urllib.error.URLError, TimeoutError, OSError) as e:
-            raise ObjectStoreError(0, 'NetworkError', str(e)) from e
+        return _http_call(self._open, method, url, headers, body=body,
+                          body_file=body_file, ok_codes=ok_codes,
+                          parse_error=_parse_json_error)
 
     def bucket_exists(self, bucket: str) -> bool:
         status, _ = self._call('GET', f'{self.API}/b/{bucket}',

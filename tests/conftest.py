@@ -6,18 +6,28 @@ testable without TPUs); orchestration tests enable the fake cloud.
 import os
 
 # Must be set before jax import anywhere in the test process.
-_xla_flags = os.environ.get('XLA_FLAGS', '')
-if '--xla_force_host_platform_device_count' not in _xla_flags:
-    os.environ['XLA_FLAGS'] = (
-        _xla_flags + ' --xla_force_host_platform_device_count=8').strip()
-# Tests always run on the virtual CPU mesh, even when a TPU is attached
-# (the real chip is for bench.py). The axon sitecustomize force-registers
-# the TPU backend and overrides JAX_PLATFORMS, so the env var alone is not
-# enough — set the config knob before any jax computation.
-os.environ['JAX_PLATFORMS'] = 'cpu'
-import jax  # noqa: E402
+if os.environ.get('XSKY_TPU_TESTS'):
+    # On-silicon kernel tier (`XSKY_TPU_TESTS=1 pytest tests/tpu -m tpu`):
+    # keep the real TPU backend — Mosaic lowering + numerics on the chip
+    # are exactly what this tier exists to catch (VERDICT r3 #3: the
+    # decode kernel shipped un-lowerable for two sessions because only
+    # interpret mode ever ran it).
+    import jax  # noqa: E402
+else:
+    _xla_flags = os.environ.get('XLA_FLAGS', '')
+    if '--xla_force_host_platform_device_count' not in _xla_flags:
+        os.environ['XLA_FLAGS'] = (
+            _xla_flags + ' --xla_force_host_platform_device_count=8'
+        ).strip()
+    # Tests run on the virtual CPU mesh, even when a TPU is attached
+    # (the real chip is for bench.py and the tpu tier). The axon
+    # sitecustomize force-registers the TPU backend and overrides
+    # JAX_PLATFORMS, so the env var alone is not enough — set the config
+    # knob before any jax computation.
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    import jax  # noqa: E402
 
-jax.config.update('jax_platforms', 'cpu')
+    jax.config.update('jax_platforms', 'cpu')
 
 import pytest
 

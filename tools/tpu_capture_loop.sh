@@ -45,11 +45,25 @@ capture() { # $1 = train|serve
   return 1
 }
 
-train_done=0
-serve_done=0
+kernel_tier() {
+  # On-silicon Pallas kernel tier (VERDICT r3 #3): Mosaic lowering +
+  # numerics on the real chip, recorded for the round log. Runs before
+  # bench so a broken kernel is caught as a test failure, not a bench
+  # mystery. jax.devices() hangs when the tunnel is down, so this only
+  # runs behind a successful probe (plus its own hard timeout).
+  XSKY_TPU_TESTS=1 timeout 2400 python -m pytest tests/tpu -m tpu -q \
+    > TPU_TIER_r04.txt 2>&1
+  echo "--- kernel tier rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+  tail -3 TPU_TIER_r04.txt >> "$LOG"
+}
+
 while true; do
   if probe; then
     echo "tunnel UP $(date -u +%FT%TZ)" >> "$LOG"
+    if [ ! -f TPU_TIER_r04.txt ] || \
+       [ -n "$(find TPU_TIER_r04.txt -mmin +180)" ]; then
+      kernel_tier
+    fi
     # Re-capture even after a success if >90 min old: later code may be
     # faster, and fresher evidence is better evidence.
     for mode in train serve; do
