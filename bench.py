@@ -56,7 +56,7 @@ _DEVICES_OK_SENTINEL = '#DEVICES_OK'
 # Upper bound on serve_main's ladder length (supervisor spawns one
 # child per rung; a child whose ladder is shorter exits with
 # _LADDER_EXHAUSTED_RC and the supervisor stops descending).
-_SERVE_LADDER_LEN = 6
+_SERVE_LADDER_LEN = 7
 _LADDER_EXHAUSTED_RC = 3
 
 
@@ -181,29 +181,37 @@ def serve_main() -> None:
     # Ladder: the TRUE 8B with int8 weights + int8 KV (fits one 16 GB
     # chip: ~8 GB weights + ~2.2 GB cache — the bf16 8B does not),
     # falling back to the 1B bf16 proxy, then tiny/CPU.
+    # Rung tuple: (tag, model, slots, max_len, n_req, prompt_len,
+    #              new_tok, buckets, quant, decode_steps).
     if platform == 'cpu':
         ladder = [('tiny-bf16', llama.LLAMA_TINY, 4, 64, 8, 16, 8,
-                   (16,), False)]
+                   (16,), False, 1)]
     else:
         ladder = [
             ('llama3-8b-int8', llama.LLAMA3_8B, 16, 2048, 32, 512, 128,
-             (512,), 'int8'),
+             (512,), 'int8', 16),
             # int4 weights (~4.5 GB): the true-8B rung for chips whose
             # usable HBM is below the int8 tree + cache (~11 GB).
             ('llama3-8b-int4', llama.LLAMA3_8B, 16, 2048, 32, 512, 128,
-             (512,), 'int4'),
+             (512,), 'int4', 16),
             # With fused decode dispatches, batch (slots) is the
             # throughput lever: 32 slots ≈ 2.1 GB of 1B-model cache.
+            # decode_steps=16: over the axon tunnel each dispatch costs
+            # ~113 ms RTT vs ~3 ms of HBM work, so deeper fusion is
+            # nearly free until the tail-overrun waste (generated
+            # tokens past EOS/budget) catches up at new_tok/steps ≈ 8.
+            ('llama3-1b-bf16-b32-ds16', llama.LLAMA3_1B, 32, 2048, 96,
+             512, 128, (512,), False, 16),
             ('llama3-1b-bf16-b32', llama.LLAMA3_1B, 32, 2048, 96, 512,
-             128, (512,), False),
+             128, (512,), False, 8),
             ('llama3-1b-bf16', llama.LLAMA3_1B, 16, 2048, 64, 512, 128,
-             (512,), False),
+             (512,), False, 8),
             # Degraded rungs: a serve number from a memory-constrained
             # (shared/partial-HBM) chip still beats no number.
             ('llama3-1b-lean', llama.LLAMA3_1B, 8, 1024, 32, 256, 64,
-             (256,), False),
+             (256,), False, 8),
             ('tiny-bf16', llama.LLAMA_TINY, 4, 64, 8, 16, 8,
-             (16,), False),
+             (16,), False, 1),
         ]
     # The supervisor pins each child to ONE rung: an OOM on a big rung
     # poisons the process's TPU allocator state, so ladder descent must
@@ -233,7 +241,7 @@ def serve_main() -> None:
 
     last_err = None
     for (model_tag, model, slots, max_len, n_req, prompt_len, new_tok,
-         buckets, quant) in ladder:
+         buckets, quant, n_decode_steps) in ladder:
         import jax.numpy as jnp
         print(f'# serve rung {model_tag}: {_hbm_note()}', flush=True)
         try:
@@ -266,12 +274,12 @@ def serve_main() -> None:
             # rung must fall through to the next config, not abort.
             # One orchestrator owns the slot KV state for warmup AND
             # the measured run (benchmark drains fully per call).
-            # decode_steps=8: eight tokens per device dispatch — decode
-            # here is dispatch-latency-bound (the axon tunnel RTT
-            # dwarfs the ~3 ms of per-step HBM traffic), and fusing
+            # decode_steps per rung: n tokens per device dispatch —
+            # decode here is dispatch-latency-bound (the axon tunnel
+            # RTT dwarfs the ~3 ms of per-step HBM traffic), and fusing
             # steps is also how a production server amortizes dispatch.
-            orch = orch_lib.Orchestrator(
-                engine, decode_steps=1 if platform == 'cpu' else 8)
+            orch = orch_lib.Orchestrator(engine,
+                                         decode_steps=n_decode_steps)
             prompts = [[(i * 7 + j) % model.vocab_size
                         for j in range(prompt_len)]
                        for i in range(n_req)]

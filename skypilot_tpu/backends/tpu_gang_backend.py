@@ -458,6 +458,19 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             # Includes rc==0 with empty stdout (transient runner hiccup).
             return None
 
+    def watch_job_log(self, handle: ClusterHandle, job_id: int,
+                      offset: int = 0) -> Dict[str, Any]:
+        """Public incremental log poll: {'status', 'offset', 'log'(str)}.
+
+        Same single-remote-exec hot path as the launch wait loop; the
+        dashboard's live tail calls this through core.watch_job_log.
+        """
+        rec = self._watch_job(handle, job_id, offset)
+        if rec is None:
+            return {'status': 'UNKNOWN', 'offset': offset, 'log': ''}
+        return {'status': rec['status'], 'offset': rec['offset'],
+                'log': rec['log'].decode('utf-8', errors='replace')}
+
     def _wait_job(self, handle: ClusterHandle, job_id: int,
                   timeout_s: float = 3600.0,
                   stream_logs: bool = True) -> job_lib.JobStatus:

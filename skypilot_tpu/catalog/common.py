@@ -98,7 +98,26 @@ def save_catalog(cloud: str, entries: List[CatalogEntry]) -> str:
 
 @functools.lru_cache(maxsize=None)
 def load_catalog(cloud: str) -> List[CatalogEntry]:
-    """Load a cloud's catalog; auto-generate via its offline fetcher if absent."""
+    """Load a cloud's catalog.
+
+    Resolution: hosted catalog (downloaded + cached, when
+    XSKY_CATALOG_URL_BASE is set — catalog/hosted.py) → in-tree CSV →
+    auto-generated via the cloud's offline fetcher.
+    """
+    from skypilot_tpu.catalog import hosted
+    hosted_path = hosted.fetch(cloud)
+    if hosted_path is not None:
+        try:
+            with open(hosted_path, newline='', encoding='utf-8') as f:
+                return [CatalogEntry.from_row(row)
+                        for row in csv.DictReader(f)]
+        except (KeyError, ValueError, OSError) as e:
+            # A malformed hosted/cached file must degrade to the
+            # in-tree catalog, not break every status/launch.
+            import logging
+            logging.getLogger(__name__).warning(
+                f'Hosted catalog for {cloud} unparseable ({e}); '
+                'falling back to the in-tree catalog')
     path = catalog_path(cloud)
     if not os.path.exists(path):
         _maybe_generate(cloud)

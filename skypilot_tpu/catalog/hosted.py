@@ -1,0 +1,134 @@
+"""Hosted-catalog download + local cache (twin of sky/catalog/common.py:30-99).
+
+The reference resolves catalogs from a hosted endpoint of versioned CSVs
+(`{HOSTED_CATALOG_DIR_URL}/{schema_version}/{cloud}.csv`), caching them
+locally with a pull interval and falling back to a stale cache when the
+network is down. Same contract here, layered ABOVE the in-tree/generated
+catalogs (which remain the offline default):
+
+  XSKY_CATALOG_URL_BASE        enables the hosted path, e.g.
+                               https://catalogs.example.com
+                               (fetch URL: {base}/{schema}/{cloud}/catalog.csv)
+  XSKY_CATALOG_SCHEMA_VERSION  pinnable schema dir (default 'v1')
+  XSKY_CATALOG_REFRESH_HOURS   re-download after this age (default 7,
+                               the reference's pull frequency)
+  XSKY_CATALOG_CACHE_DIR       cache root (default ~/.xsky/catalogs)
+
+Resolution order in catalog.common.load_catalog:
+  fresh cache → download (atomic replace) → STALE cache (offline
+  fallback, logged) → in-tree / generated catalog.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_SCHEMA_VERSION = 'v1'
+DEFAULT_REFRESH_HOURS = 7.0
+
+Opener = Callable[..., object]
+
+
+def enabled() -> bool:
+    return bool(os.environ.get('XSKY_CATALOG_URL_BASE'))
+
+
+def schema_version() -> str:
+    return os.environ.get('XSKY_CATALOG_SCHEMA_VERSION',
+                          DEFAULT_SCHEMA_VERSION)
+
+
+def cache_dir() -> str:
+    return os.path.expanduser(
+        os.environ.get('XSKY_CATALOG_CACHE_DIR', '~/.xsky/catalogs'))
+
+
+def cache_path(cloud: str) -> str:
+    return os.path.join(cache_dir(), schema_version(), cloud,
+                        'catalog.csv')
+
+
+def _url(cloud: str) -> str:
+    base = os.environ.get('XSKY_CATALOG_URL_BASE', '').rstrip('/')
+    return f'{base}/{schema_version()}/{cloud}/catalog.csv'
+
+
+def _looks_like_catalog_csv(body: bytes) -> bool:
+    """Header sanity check before caching a downloaded catalog."""
+    if not body.strip():
+        return False
+    first = body.lstrip().splitlines()[0]
+    return b'InstanceType' in first and b',' in first
+
+
+def _fresh(path: str) -> bool:
+    try:
+        age_s = time.time() - os.path.getmtime(path)
+    except OSError:
+        return False
+    hours = float(os.environ.get('XSKY_CATALOG_REFRESH_HOURS',
+                                 DEFAULT_REFRESH_HOURS))
+    return age_s < hours * 3600
+
+
+def fetch(cloud: str,
+          opener: Optional[Opener] = None) -> Optional[str]:
+    """Resolve `cloud`'s hosted catalog → local CSV path, or None when
+    the hosted path is disabled or nothing (cache or network) exists.
+
+    Never raises on network failure: a stale cache beats an error, and
+    no cache at all falls through to the in-tree catalog.
+    """
+    if not enabled():
+        return None
+    path = cache_path(cloud)
+    if _fresh(path):
+        return path
+    opener = opener or urllib.request.urlopen
+    url = _url(cloud)
+    try:
+        with opener(urllib.request.Request(url), timeout=30) as resp:
+            body = resp.read()
+    except (urllib.error.URLError, urllib.error.HTTPError,
+            TimeoutError, OSError) as e:
+        if os.path.exists(path):
+            logger.warning(
+                f'Hosted catalog fetch failed ({e}); using the stale '
+                f'cache at {path}')
+            return path
+        logger.warning(
+            f'Hosted catalog fetch failed ({e}) and no cache exists; '
+            f'falling back to the in-tree {cloud} catalog')
+        return None
+    if not _looks_like_catalog_csv(body):
+        # Captive portals / proxy error pages arrive as 200 + HTML; a
+        # cached garbage file would break every catalog read for the
+        # refresh window.
+        logger.warning(f'Hosted catalog at {url} is not a catalog CSV; '
+                       'ignoring')
+        return path if os.path.exists(path) else None
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    # Atomic replace: a concurrent reader never sees a torn file.
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                               suffix='.tmp')
+    try:
+        with os.fdopen(fd, 'wb') as f:
+            f.write(body)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    logger.debug(f'Refreshed hosted catalog {cloud} '
+                 f'({len(body)} bytes) → {path}')
+    return path

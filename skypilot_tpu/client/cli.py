@@ -729,7 +729,12 @@ def api_start(host, port, foreground):
               help='API server URL, e.g. http://host:46580')
 @click.option('--token', '-t', default=None,
               help='Bearer token from `xsky users token-create`.')
-def api_login(endpoint, token):
+@click.option('--oauth', is_flag=True, default=False,
+              help='Log in via OAuth2 device flow against the IdP '
+                   'configured by XSKY_OAUTH_ISSUER / '
+                   'XSKY_OAUTH_CLIENT_ID (twin of sky api login '
+                   'browser auth).')
+def api_login(endpoint, token, oauth):
     """Point this client at a remote API server (twin of `sky api
     login`): persists api_server.endpoint (and token) in the user
     config, so every verb talks to it from now on."""
@@ -739,6 +744,24 @@ def api_login(endpoint, token):
     from skypilot_tpu.client import remote_client
     if not endpoint.startswith(('http://', 'https://')):
         endpoint = f'http://{endpoint}'
+    if oauth:
+        if token:
+            raise click.ClickException('--oauth and --token are '
+                                       'mutually exclusive.')
+        from skypilot_tpu.users import oauth as oauth_lib
+        try:
+            flow = oauth_lib.start_device_flow()
+            uri = flow.get('verification_uri_complete') or \
+                flow['verification_uri']
+            click.echo(f'To log in, visit: {uri}')
+            click.echo(f'and enter code: {flow["user_code"]}')
+            token = oauth_lib.poll_for_token(
+                flow['device_code'],
+                interval=float(flow.get('interval', 5)),
+                timeout=float(flow.get('expires_in', 600)))
+        except oauth_lib.OAuthError as e:
+            raise click.ClickException(str(e)) from e
+        click.echo('Device login approved.')
     # Probe before persisting: a typo'd endpoint should fail HERE.
     try:
         client = remote_client.RemoteClient(endpoint, token=token)

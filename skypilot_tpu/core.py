@@ -204,6 +204,15 @@ def tail_logs(cluster_name: str, job_id: Optional[int] = None,
     return _backend().tail_logs(record['handle'], job_id, follow=follow)
 
 
+def watch_job_log(cluster_name: str, job_id: int,
+                  offset: int = 0) -> Dict[str, Any]:
+    """One incremental poll of a cluster job's run.log → {status,
+    offset, log}. Powers the dashboard's live tail (one remote exec
+    per poll — same hot path the launch wait loop uses)."""
+    record = _get_handle(cluster_name)
+    return _backend().watch_job_log(record['handle'], job_id, offset)
+
+
 def sync_down_logs(cluster_name: str, job_id: Optional[int] = None,
                    local_dir: Optional[str] = None) -> str:
     """Download job logs from a cluster; returns the local directory

@@ -76,12 +76,21 @@ def launch(entrypoint,
     stages = [s for s in ALL_STAGES if s != Stage.DOWN]
     if no_setup:
         stages.remove(Stage.SETUP)
-    return _execute_dag(dag, cluster_name, stages, dryrun=dryrun,
-                        retry_until_up=retry_until_up,
-                        idle_minutes_to_autostop=idle_minutes_to_autostop,
-                        down=down, detach_run=detach_run,
-                        stream_logs=stream_logs, backend=backend,
-                        blocked_resources=blocked_resources)
+    # Per-workspace config overlay (ref: workspace-scoped config in
+    # sky/workspaces/core.py): the active workspace's stored overlay
+    # applies to this launch's whole config view.
+    from skypilot_tpu import config as config_lib
+    from skypilot_tpu.workspaces import context as ws_context
+    from skypilot_tpu.workspaces import core as workspaces_core
+    ws_overlay = workspaces_core.get_config(ws_context.get_active())
+    with config_lib.override(ws_overlay or None):
+        return _execute_dag(
+            dag, cluster_name, stages, dryrun=dryrun,
+            retry_until_up=retry_until_up,
+            idle_minutes_to_autostop=idle_minutes_to_autostop,
+            down=down, detach_run=detach_run,
+            stream_logs=stream_logs, backend=backend,
+            blocked_resources=blocked_resources)
 
 
 def exec(entrypoint,  # pylint: disable=redefined-builtin
@@ -147,6 +156,20 @@ def _execute_dag(dag: dag_lib.Dag,
     with state.cluster_lock(cluster_name):
         handle = None
         existing = state.get_cluster_from_name(cluster_name)
+        if existing is not None:
+            # A cluster never silently changes workspace: launching
+            # onto an existing cluster from a different active
+            # workspace would re-home it (and its billing/authz scope)
+            # on the next provision write.
+            from skypilot_tpu.workspaces import context as ws_context
+            cluster_ws = existing.get('workspace') or \
+                ws_context.DEFAULT_WORKSPACE
+            active_ws = ws_context.get_active()
+            if cluster_ws != active_ws:
+                raise exceptions.ClusterOwnerIdentityMismatchError(
+                    f'Cluster {cluster_name!r} belongs to workspace '
+                    f'{cluster_ws!r}; the active workspace is '
+                    f'{active_ws!r}. Switch workspaces to use it.')
         if existing is not None and \
                 existing['status'] == state.ClusterStatus.UP:
             handle = existing['handle']

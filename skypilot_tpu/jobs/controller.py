@@ -126,6 +126,10 @@ class JobsController:
             scheduler.launch_done(self.job_id)
         jobs_state.set_status(self.job_id,
                               jobs_state.ManagedJobStatus.RUNNING)
+        # Reaching steady state clears the HA respawn budget: it exists
+        # to stop crash loops, not to cap how many server restarts a
+        # long-lived job may outlive.
+        jobs_state.reset_controller_respawns(self.job_id)
 
         probe_failures = 0
         while True:

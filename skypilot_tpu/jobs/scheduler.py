@@ -83,14 +83,23 @@ def _spawn_controller(job_id: int) -> None:
     jobs_state.set_controller_pid(job_id, proc.pid)
 
 
-def _reconcile_dead_controllers() -> List[str]:
-    """Release slots held by controllers that died without cleanup.
+def max_controller_respawns() -> int:
+    return int(os.environ.get('XSKY_JOBS_MAX_CONTROLLER_RESPAWNS', '3'))
 
-    A SIGKILL/OOM-killed controller never runs its job_done() finally;
-    its LAUNCHING/ALIVE row would otherwise count against the caps
-    forever and wedge the queue. Caller must hold the scheduler lock.
-    Returns the dead jobs' task-cluster names so the caller can reap
-    them *after* releasing the lock (teardown is slow).
+
+def _reconcile_dead_controllers() -> List[str]:
+    """Re-exec (or, past the respawn budget, fail) jobs whose
+    controllers died without cleanup.
+
+    HA (VERDICT r3 #9; ref kubernetes-ray.yml.j2:270-366 re-execs
+    controllers on pod restart): a non-terminal job whose controller
+    process is gone — API-server/pod restart, OOM kill — is requeued
+    as WAITING, so the scheduler loop starts a fresh controller that
+    resumes from the persisted current_task/recovery state. Respawns
+    are bounded (a controller that crashes on its own bug must not
+    loop forever); past the budget the job fails and its cluster is
+    reaped. Caller must hold the scheduler lock. Returns dead jobs'
+    task-cluster names to reap *after* releasing the lock.
     """
     orphaned: List[str] = []
     for row in jobs_state.get_jobs():
@@ -99,15 +108,27 @@ def _reconcile_dead_controllers() -> List[str]:
             continue
         if common_utils.pid_alive(row['controller_pid']):
             continue
+        job_id = row['job_id']
+        if not row['status'].is_terminal():
+            respawns = jobs_state.bump_controller_respawns(job_id)
+            if respawns <= max_controller_respawns():
+                logger.warning(
+                    f'Managed job {job_id} controller '
+                    f'(pid {row["controller_pid"]}) died; re-execing '
+                    f'(respawn {respawns}/{max_controller_respawns()}).')
+                jobs_state.set_schedule_state(
+                    job_id, jobs_state.ScheduleState.WAITING)
+                continue
+            jobs_state.set_status(
+                job_id, jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
+                failure_reason=('controller died '
+                                f'{respawns} times; respawn budget '
+                                'exhausted'))
         logger.warning(
-            f'Managed job {row["job_id"]} controller '
+            f'Managed job {job_id} controller '
             f'(pid {row["controller_pid"]}) died without cleanup; '
             'releasing its scheduler slot.')
-        if not row['status'].is_terminal():
-            jobs_state.set_status(
-                row['job_id'], jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
-                failure_reason='controller process died')
-        jobs_state.set_schedule_state(row['job_id'],
+        jobs_state.set_schedule_state(job_id,
                                       jobs_state.ScheduleState.DONE)
         if row['cluster_name']:
             orphaned.append(row['cluster_name'])

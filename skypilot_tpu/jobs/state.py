@@ -99,6 +99,10 @@ def _db() -> sqlite3.Connection:
             "current_task INTEGER DEFAULT 0",
             "ALTER TABLE managed_jobs ADD COLUMN "
             "num_tasks INTEGER DEFAULT 1",
+            # HA: a job survives its controller dying (server/pod
+            # restart) via bounded re-exec (scheduler reconcile).
+            "ALTER TABLE managed_jobs ADD COLUMN "
+            "controller_respawns INTEGER DEFAULT 0",
     ):
         try:
             conn.execute(migration)
@@ -237,6 +241,34 @@ def set_controller_pid(job_id: int, pid: int) -> None:
         conn.close()
 
 
+def reset_controller_respawns(job_id: int) -> None:
+    """The respawn budget bounds crash LOOPS, not lifetime restarts: a
+    respawned controller that reaches steady state resets it, so a
+    long-lived job survives any number of spaced-out server restarts."""
+    with _lock:
+        conn = _db()
+        conn.execute(
+            'UPDATE managed_jobs SET controller_respawns=0 '
+            'WHERE job_id=?', (job_id,))
+        conn.commit()
+        conn.close()
+
+
+def bump_controller_respawns(job_id: int) -> int:
+    with _lock:
+        conn = _db()
+        conn.execute(
+            'UPDATE managed_jobs SET '
+            'controller_respawns=controller_respawns+1 WHERE job_id=?',
+            (job_id,))
+        conn.commit()
+        count = conn.execute(
+            'SELECT controller_respawns FROM managed_jobs '
+            'WHERE job_id=?', (job_id,)).fetchone()[0]
+        conn.close()
+        return count
+
+
 def bump_recovery_count(job_id: int) -> int:
     with _lock:
         conn = _db()
@@ -273,7 +305,8 @@ def get_jobs() -> List[Dict[str, Any]]:
 def _to_dict(row) -> Dict[str, Any]:
     (job_id, name, task_config, status, cluster_name, recovery_count,
      failure_reason, controller_pid, submitted_at, started_at,
-     ended_at, schedule_state, current_task, num_tasks) = row
+     ended_at, schedule_state, current_task, num_tasks,
+     controller_respawns) = row
     parsed = json.loads(task_config or '{}')
     # Pipelines store a LIST of task configs; single jobs a dict.
     configs = parsed if isinstance(parsed, list) else [parsed]
@@ -290,6 +323,7 @@ def _to_dict(row) -> Dict[str, Any]:
         'recovery_count': recovery_count,
         'failure_reason': failure_reason,
         'controller_pid': controller_pid,
+        'controller_respawns': controller_respawns or 0,
         'submitted_at': submitted_at,
         'started_at': started_at,
         'ended_at': ended_at,

@@ -18,8 +18,11 @@ import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.serve import state as serve_state
+
+logger = sky_logging.init_logger(__name__)
 
 
 def _remote_mode() -> bool:
@@ -68,6 +71,50 @@ def _check_fallback_knobs(task: task_lib.Task) -> None:
             'it.')
 
 
+def _spawn_controller(name: str) -> int:
+    """Start a detached controller process for `name` → pid.
+
+    Controller stdio goes to a per-service log file, not DEVNULL — a
+    crashed controller must leave more than a FAILED status row.
+    """
+    log_path = controller_log_path(name)
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    with open(log_path, 'ab') as logf:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.serve.controller', name],
+            env=dict(os.environ), start_new_session=True,
+            stdout=logf, stderr=subprocess.STDOUT)
+    serve_state.set_service_controller_pid(name, proc.pid)
+    return proc.pid
+
+
+def recover_controllers() -> List[str]:
+    """Re-exec controllers for live services whose process is gone.
+
+    HA (VERDICT r3 #9): service + replica state live in sqlite (under
+    the helm chart's PVC); after an API-server/pod restart this brings
+    every non-terminal service's control loop back. The restarted
+    controller reconciles desired replicas against recorded state, so
+    a rolling update or autoscale decision in flight simply resumes.
+    Returns the recovered service names.
+    """
+    from skypilot_tpu.utils import common_utils
+    recovered = []
+    for record in serve_state.get_services():
+        if record['status'] in (serve_state.ServiceStatus.SHUTTING_DOWN,
+                                serve_state.ServiceStatus.FAILED):
+            continue
+        pid = record['controller_pid']
+        if pid and common_utils.pid_alive(pid):
+            continue
+        name = record['name']
+        logger.warning(f'Service {name!r} controller (pid {pid}) is '
+                       'gone; re-execing.')
+        _spawn_controller(name)
+        recovered.append(name)
+    return recovered
+
+
 def up(task: task_lib.Task, service_name: Optional[str] = None,
        wait_ready: bool = True, timeout_s: float = 120.0) -> str:
     if task.service is None:
@@ -81,16 +128,7 @@ def up(task: task_lib.Task, service_name: Optional[str] = None,
         raise ValueError(f'Service {name!r} already exists.')
     lb_port = _free_port()
     serve_state.add_service(name, task.to_yaml_config(), lb_port)
-    # Controller stdio goes to a per-service log file, not DEVNULL — a
-    # crashed controller must leave more than a FAILED status row.
-    log_path = controller_log_path(name)
-    os.makedirs(os.path.dirname(log_path), exist_ok=True)
-    with open(log_path, 'ab') as logf:
-        proc = subprocess.Popen(
-            [sys.executable, '-m', 'skypilot_tpu.serve.controller', name],
-            env=dict(os.environ), start_new_session=True,
-            stdout=logf, stderr=subprocess.STDOUT)
-    serve_state.set_service_controller_pid(name, proc.pid)
+    _spawn_controller(name)
     if wait_ready:
         deadline = time.time() + timeout_s
         while time.time() < deadline:

@@ -209,12 +209,23 @@ _VERBS.update({
     'users.token_list': _module_verb(_USERS, 'list_tokens', name=None),
     'users.token_revoke': _module_verb(_USERS, 'revoke_token', 'name',
                                        'label'),
-    # Workspaces.
+    # Workspaces (membership + config overlays are admin-only,
+    # users/rbac.py).
     'workspaces.list': _module_verb(_WORKSPACES, 'get_workspaces'),
     'workspaces.create': _module_verb(_WORKSPACES, 'create_workspace',
                                       'name'),
     'workspaces.delete': _module_verb(_WORKSPACES, 'delete_workspace',
                                       'name'),
+    'workspaces.add_member': _module_verb(_WORKSPACES, 'add_member',
+                                          'workspace', 'user_name'),
+    'workspaces.remove_member': _module_verb(
+        _WORKSPACES, 'remove_member', 'workspace', 'user_name'),
+    'workspaces.members': _module_verb(_WORKSPACES, 'list_members',
+                                       'workspace'),
+    'workspaces.set_config': _module_verb(_WORKSPACES, 'set_config',
+                                          'workspace', 'config'),
+    'workspaces.get_config': _module_verb(_WORKSPACES, 'get_config',
+                                          'workspace'),
     # SSH node pools (twin of `sky ssh up/down`).
     'ssh.up': _module_verb('skypilot_tpu.clouds.ssh', 'pool_up',
                            infra=None),

@@ -120,6 +120,16 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             handle BLOB,
             workspace TEXT
         );
+        CREATE TABLE IF NOT EXISTS workspace_members (
+            workspace TEXT,
+            user_name TEXT,
+            added_at INTEGER,
+            PRIMARY KEY (workspace, user_name)
+        );
+        CREATE TABLE IF NOT EXISTS workspace_configs (
+            workspace TEXT PRIMARY KEY,
+            config_json TEXT
+        );
     """)
     # Migration for pre-workspace DBs: clusters gain a workspace column.
     for migration in (
@@ -572,5 +582,66 @@ def delete_workspace(name: str) -> bool:
     conn = _get_conn()
     with _lock:
         cur = conn.execute('DELETE FROM workspaces WHERE name=?', (name,))
+        conn.execute('DELETE FROM workspace_members WHERE workspace=?',
+                     (name,))
+        conn.execute('DELETE FROM workspace_configs WHERE workspace=?',
+                     (name,))
         conn.commit()
     return cur.rowcount > 0
+
+
+def add_workspace_member(workspace: str, user_name: str) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'INSERT OR IGNORE INTO workspace_members '
+            '(workspace, user_name, added_at) VALUES (?, ?, ?)',
+            (workspace, user_name, int(time.time())))
+        conn.commit()
+
+
+def remove_workspace_member(workspace: str, user_name: str) -> bool:
+    conn = _get_conn()
+    with _lock:
+        cur = conn.execute(
+            'DELETE FROM workspace_members WHERE workspace=? AND '
+            'user_name=?', (workspace, user_name))
+        conn.commit()
+    return cur.rowcount > 0
+
+
+def list_workspace_members(workspace: str) -> List[str]:
+    conn = _get_conn()
+    with _lock:
+        rows = conn.execute(
+            'SELECT user_name FROM workspace_members WHERE workspace=? '
+            'ORDER BY user_name', (workspace,)).fetchall()
+    return [r[0] for r in rows]
+
+
+def is_workspace_member(workspace: str, user_name: str) -> bool:
+    conn = _get_conn()
+    with _lock:
+        row = conn.execute(
+            'SELECT 1 FROM workspace_members WHERE workspace=? AND '
+            'user_name=?', (workspace, user_name)).fetchone()
+    return row is not None
+
+
+def set_workspace_config(workspace: str, config_json: str) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'INSERT INTO workspace_configs (workspace, config_json) '
+            'VALUES (?, ?) ON CONFLICT(workspace) DO UPDATE SET '
+            'config_json=excluded.config_json', (workspace, config_json))
+        conn.commit()
+
+
+def get_workspace_config(workspace: str) -> Optional[str]:
+    conn = _get_conn()
+    with _lock:
+        row = conn.execute(
+            'SELECT config_json FROM workspace_configs WHERE '
+            'workspace=?', (workspace,)).fetchone()
+    return row[0] if row else None

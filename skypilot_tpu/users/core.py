@@ -119,18 +119,65 @@ def revoke_token(name: str, label: str) -> Dict[str, Any]:
 
 
 def authenticate_bearer(header: Optional[str]) -> Optional[Dict[str, Any]]:
-    """Parse `Authorization: Bearer xsky_...` → user record or None."""
+    """Parse `Authorization: Bearer ...` → user record or None.
+
+    `xsky_...` tokens are in-tree API tokens; anything else is treated
+    as an OAuth access token when OAuth is configured (validated
+    against the IdP's userinfo endpoint; users auto-provision on first
+    sight — twin of the reference's OAuth middleware identity headers,
+    sky/server/server.py:176-296).
+    """
     if not header or not header.startswith('Bearer '):
         return None
     token = header[len('Bearer '):].strip()
     if not token.startswith(_TOKEN_PREFIX):
-        return None
+        return _authenticate_oauth(token)
     record = state.get_api_token(_hash_token(token))
     if record is None:
         return None
     user = state.get_user(record['user_name'])
     if user is None:
         # Deleted user: the token must die with the account.
+        return None
+    return user
+
+
+def _oauth_subject_marker(sub: str) -> str:
+    return f'oauth-sub:{sub}'
+
+
+def _authenticate_oauth(token: str) -> Optional[Dict[str, Any]]:
+    from skypilot_tpu.users import oauth
+    if not oauth.enabled():
+        return None
+    try:
+        info = oauth.validate_access_token(token)
+    except oauth.OAuthError as e:
+        from skypilot_tpu import sky_logging
+        sky_logging.init_logger(__name__).warning(
+            f'OAuth validation unavailable: {e}')
+        return None
+    if info is None or not info.get('sub'):
+        return None
+    user = state.get_user(info['name'])
+    if user is None:
+        # First sight of an IdP-verified identity: auto-provision with
+        # the default role and no local password (OAuth-only account).
+        # The stable OIDC `sub` is recorded as the account's identity
+        # binding — preferred_username/email are display names, not
+        # identifiers (OIDC core §5.7).
+        state.add_user(info['name'],
+                       _oauth_subject_marker(info['sub']), '',
+                       rbac.USER_ROLE)
+        return state.get_user(info['name'])
+    if user.get('salt'):
+        # Name collision with a LOCAL (password) account — e.g. an IdP
+        # user who self-registered the username 'admin'. Never let an
+        # OAuth identity assume a local account.
+        return None
+    if user.get('password_hash') != _oauth_subject_marker(info['sub']):
+        # Same display name, different IdP subject: not the same
+        # principal.
         return None
     return user
 

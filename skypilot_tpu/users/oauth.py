@@ -1,0 +1,199 @@
+"""OAuth2 device-authorization login (twin of sky/client/oauth.py + the
+server-side auth middlewares, sky/server/server.py:176-296).
+
+The reference fronts its API server with an OAuth2 proxy and teaches the
+CLI a browser/device login. Here the same capability is zero-dep:
+
+  Client:  `xsky api login --oauth` runs RFC 8628 device flow against
+           the configured IdP — prints the verification URL + user code,
+           polls the token endpoint, and stores the access token where
+           the remote client already keeps bearer tokens.
+  Server:  a `Bearer` credential that is NOT an in-tree `xsky_` token is
+           treated as an OAuth access token and validated against the
+           IdP's userinfo endpoint (result cached; users auto-provision
+           on first sight with the default role).
+
+Configuration (server and client read the same env / config keys):
+  XSKY_OAUTH_ISSUER        e.g. https://idp.example.com  (enables OAuth)
+  XSKY_OAUTH_CLIENT_ID     OAuth client id
+  XSKY_OAUTH_CLIENT_SECRET optional (public clients omit it)
+Endpoints default to {issuer}/oauth/device/code, {issuer}/oauth/token,
+{issuer}/userinfo and can be pinned individually via
+XSKY_OAUTH_{DEVICE,TOKEN,USERINFO}_ENDPOINT.
+
+All HTTP goes through an injectable opener so the flow is fully
+testable against a fake IdP with zero network.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Callable, Dict, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+Opener = Callable[..., Any]
+
+
+class OAuthError(Exception):
+    pass
+
+
+def issuer() -> str:
+    return os.environ.get('XSKY_OAUTH_ISSUER', '').rstrip('/')
+
+
+def enabled() -> bool:
+    return bool(issuer())
+
+
+def _endpoint(kind: str, default_path: str) -> str:
+    return os.environ.get(f'XSKY_OAUTH_{kind}_ENDPOINT',
+                          f'{issuer()}{default_path}')
+
+
+def _post_form(url: str, fields: Dict[str, str],
+               opener: Optional[Opener] = None) -> Dict[str, Any]:
+    opener = opener or urllib.request.urlopen
+    body = urllib.parse.urlencode(fields).encode()
+    req = urllib.request.Request(
+        url, data=body,
+        headers={'Content-Type': 'application/x-www-form-urlencoded',
+                 'Accept': 'application/json'},
+        method='POST')
+    try:
+        with opener(req, timeout=30) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        try:
+            return json.loads(raw)   # OAuth errors ride 400 JSON bodies
+        except json.JSONDecodeError:
+            raise OAuthError(
+                f'{url} returned {e.code}: '
+                f'{raw.decode(errors="replace")[:200]}') from e
+    except (urllib.error.URLError, TimeoutError, OSError) as e:
+        raise OAuthError(f'cannot reach {url}: {e}') from e
+
+
+def start_device_flow(opener: Optional[Opener] = None) -> Dict[str, Any]:
+    """RFC 8628 step 1 → {device_code, user_code, verification_uri,
+    interval, expires_in}."""
+    if not enabled():
+        raise OAuthError('OAuth is not configured (set '
+                         'XSKY_OAUTH_ISSUER / XSKY_OAUTH_CLIENT_ID).')
+    fields = {'client_id': os.environ.get('XSKY_OAUTH_CLIENT_ID', ''),
+              'scope': os.environ.get('XSKY_OAUTH_SCOPE',
+                                      'openid profile email')}
+    out = _post_form(_endpoint('DEVICE', '/oauth/device/code'), fields,
+                     opener)
+    if 'device_code' not in out:
+        raise OAuthError(f'device authorization failed: {out}')
+    return out
+
+
+def poll_for_token(device_code: str, interval: float = 5.0,
+                   timeout: float = 600.0,
+                   opener: Optional[Opener] = None,
+                   sleep=time.sleep) -> str:
+    """RFC 8628 step 2: poll until the user approves → access token."""
+    fields = {
+        'client_id': os.environ.get('XSKY_OAUTH_CLIENT_ID', ''),
+        'device_code': device_code,
+        'grant_type': 'urn:ietf:params:oauth:grant-type:device_code',
+    }
+    secret = os.environ.get('XSKY_OAUTH_CLIENT_SECRET')
+    if secret:
+        fields['client_secret'] = secret
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = _post_form(_endpoint('TOKEN', '/oauth/token'), fields,
+                         opener)
+        if 'access_token' in out:
+            return out['access_token']
+        error = out.get('error', 'unknown')
+        if error == 'authorization_pending':
+            sleep(interval)
+            continue
+        if error == 'slow_down':
+            interval = interval * 2
+            sleep(interval)
+            continue
+        raise OAuthError(f'device login failed: {error} '
+                         f'({out.get("error_description", "")})')
+    raise OAuthError('device login timed out (user never approved)')
+
+
+# -- server side: access-token validation -----------------------------------
+
+#: token → (userinfo|None, expiry). Userinfo calls are network round
+#: trips; cache for a short TTL so every API request doesn't hit the
+#: IdP. Rejections are cached too (shorter TTL) — otherwise a client
+#: looping on an expired token ties a handler thread to a 30 s IdP
+#: round-trip per request.
+_USERINFO_CACHE: Dict[str, Any] = {}
+_USERINFO_TTL_S = 300.0
+_NEGATIVE_TTL_S = 30.0
+_CACHE_MAX_ENTRIES = 4096
+
+
+def _cache_put(token: str, entry) -> None:
+    """Insert with expiry pruning + a hard size cap — random-token
+    spray must not grow server RSS without bound."""
+    now = time.monotonic()
+    if len(_USERINFO_CACHE) >= _CACHE_MAX_ENTRIES:
+        for key in [k for k, (_, exp) in _USERINFO_CACHE.items()
+                    if exp < now]:
+            _USERINFO_CACHE.pop(key, None)
+    while len(_USERINFO_CACHE) >= _CACHE_MAX_ENTRIES:
+        # Still full after pruning: evict oldest-inserted.
+        _USERINFO_CACHE.pop(next(iter(_USERINFO_CACHE)), None)
+    _USERINFO_CACHE[token] = entry
+
+
+def validate_access_token(token: str,
+                          opener: Optional[Opener] = None
+                          ) -> Optional[Dict[str, Any]]:
+    """Access token → userinfo dict, or None when the IdP rejects it.
+
+    The canonical identity is userinfo's preferred_username → email →
+    sub, exposed as 'name'.
+    """
+    cached = _USERINFO_CACHE.get(token)
+    if cached is not None and time.monotonic() < cached[1]:
+        return cached[0]
+    opener = opener or urllib.request.urlopen
+    req = urllib.request.Request(
+        _endpoint('USERINFO', '/userinfo'),
+        headers={'Authorization': f'Bearer {token}',
+                 'Accept': 'application/json'})
+    try:
+        with opener(req, timeout=30) as resp:
+            info = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        if e.code in (401, 403):
+            _cache_put(token, (
+                None, time.monotonic() + _NEGATIVE_TTL_S))
+            return None
+        raise OAuthError(f'userinfo returned {e.code}') from e
+    except (urllib.error.URLError, TimeoutError, OSError) as e:
+        raise OAuthError(f'cannot reach userinfo endpoint: {e}') from e
+    name = (info.get('preferred_username') or info.get('email')
+            or info.get('sub'))
+    if not name:
+        _cache_put(token,
+                   (None, time.monotonic() + _NEGATIVE_TTL_S))
+        return None
+    info = dict(info, name=name)
+    _cache_put(token, (info, time.monotonic() + _USERINFO_TTL_S))
+    return info
+
+
+def clear_userinfo_cache() -> None:
+    _USERINFO_CACHE.clear()

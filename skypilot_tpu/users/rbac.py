@@ -25,6 +25,9 @@ _ADMIN_ONLY_VERBS = frozenset({
     'users.token_revoke',
     'workspaces.create',
     'workspaces.delete',
+    'workspaces.add_member',
+    'workspaces.remove_member',
+    'workspaces.set_config',
     # Pool-wide teardown terminates every cluster drawn from the pool,
     # across all users — strictly more destructive than workspace admin.
     'ssh.down',

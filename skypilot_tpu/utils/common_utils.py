@@ -17,16 +17,28 @@ _run_id: Optional[str] = None
 
 
 def pid_alive(pid: Optional[int]) -> bool:
-    """Is a process with this pid running (signal-0 probe)?"""
+    """Is a process with this pid running (signal-0 probe)?
+
+    A zombie counts as DEAD: it no longer executes anything (a killed
+    controller whose parent hasn't reaped it yet would otherwise look
+    alive and block HA re-exec).
+    """
     if not pid:
         return False
     try:
         os.kill(pid, 0)
-        return True
     except ProcessLookupError:
         return False
     except PermissionError:
         return True
+    try:
+        with open(f'/proc/{pid}/stat', encoding='utf-8',
+                  errors='replace') as f:
+            # Field 3 (after the parenthesized comm, which may itself
+            # contain spaces) is the state; 'Z' = zombie.
+            return f.read().rpartition(')')[2].split()[0] != 'Z'
+    except (OSError, IndexError):
+        return True   # no procfs (macOS): keep the signal-0 answer
 
 
 def get_usage_run_id() -> str:

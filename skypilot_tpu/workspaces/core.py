@@ -47,3 +47,59 @@ def validate_exists(name: str) -> str:
         raise ValueError(f'Workspace {name!r} does not exist; create it '
                          'with `xsky workspaces create`.')
     return name
+
+
+# ---- membership (per-workspace authz; ref sky/workspaces/core.py +
+# sky/users/rbac.py workspace policies) -------------------------------------
+
+
+def add_member(workspace: str, user_name: str) -> Dict[str, Any]:
+    validate_exists(workspace)
+    state.add_workspace_member(workspace, user_name)
+    return {'workspace': workspace, 'member': user_name}
+
+
+def remove_member(workspace: str, user_name: str) -> Dict[str, Any]:
+    return {'removed': state.remove_workspace_member(workspace,
+                                                     user_name)}
+
+
+def list_members(workspace: str) -> List[str]:
+    validate_exists(workspace)
+    return state.list_workspace_members(workspace)
+
+
+def check_access(user: str, role: str, workspace: str) -> bool:
+    """May `user` operate inside `workspace`?
+
+    Admins everywhere; every authenticated user in 'default' (the
+    single-user / pre-workspace experience stays frictionless); private
+    workspaces require membership.
+    """
+    from skypilot_tpu.users import rbac
+    if role == rbac.ADMIN_ROLE:
+        return True
+    if workspace == DEFAULT_WORKSPACE:
+        return True
+    return state.is_workspace_member(workspace, user)
+
+
+# ---- per-workspace config overlays ----------------------------------------
+
+
+def set_config(workspace: str, config: Dict[str, Any]) -> Dict[str, Any]:
+    """Store a config overlay applied to every launch in `workspace`
+    (ref: per-workspace config in sky/workspaces/core.py + the
+    `workspaces:` section of the reference config schema)."""
+    import json
+    validate_exists(workspace)
+    if not isinstance(config, dict):
+        raise ValueError('workspace config must be a mapping')
+    state.set_workspace_config(workspace, json.dumps(config))
+    return {'workspace': workspace, 'config': config}
+
+
+def get_config(workspace: str) -> Dict[str, Any]:
+    import json
+    raw = state.get_workspace_config(workspace)
+    return json.loads(raw) if raw else {}

@@ -5,8 +5,33 @@ testable without TPUs); orchestration tests enable the fake cloud.
 """
 import os
 
+import sys
+
+
+def _tpu_tier_invocation() -> bool:
+    """True only for a run that actually targets the on-silicon tier.
+
+    Both the env opt-in AND a tpu-targeting argument must be present
+    (a path under tests/tpu, or `-m tpu`): XSKY_TPU_TESTS=1 on a broad
+    `pytest tests/` run must NOT silently strip the 8-device virtual
+    CPU mesh from every other test.
+    """
+    if not os.environ.get('XSKY_TPU_TESTS'):
+        return False
+    args = sys.argv
+    if any('tests/tpu' in a or a.rstrip('/').endswith('/tpu')
+           or a.rstrip('/') == 'tpu' for a in args):
+        return True
+    for i, a in enumerate(args):
+        if a == '-m' and i + 1 < len(args) and 'tpu' in args[i + 1]:
+            return True
+        if a.startswith('-m=') and 'tpu' in a:
+            return True
+    return False
+
+
 # Must be set before jax import anywhere in the test process.
-if os.environ.get('XSKY_TPU_TESTS'):
+if _tpu_tier_invocation():
     # On-silicon kernel tier (`XSKY_TPU_TESTS=1 pytest tests/tpu -m tpu`):
     # keep the real TPU backend — Mosaic lowering + numerics on the chip
     # are exactly what this tier exists to catch (VERDICT r3 #3: the

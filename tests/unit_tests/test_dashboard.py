@@ -95,3 +95,82 @@ def test_request_routes_roundtrip(fake_cluster_env):
         assert any(row['name'] == 'status' for row in listing)
     finally:
         server.shutdown()
+
+
+def test_live_log_endpoints(fake_cluster_env, monkeypatch, tmp_path):
+    """VERDICT r3 #8: live log tail + request drill-down.
+
+    Drives a real launch through the in-thread server, then reads the
+    job's rank-0 log incrementally via /api/job_log (what the browser
+    polls) and the request's captured output via /api/request_log."""
+    import time
+
+    from skypilot_tpu.client import remote_client
+    from skypilot_tpu.server import app as server_app
+    from skypilot_tpu.server import requests_db
+
+    monkeypatch.setenv('XSKY_SERVER_DB', str(tmp_path / 'requests.db'))
+    requests_db.reset_for_test()
+    server, port = server_app.run_in_thread()
+    base = f'http://127.0.0.1:{port}'
+    try:
+        from skypilot_tpu import task as task_lib
+        client = remote_client.RemoteClient(base, poll_interval_s=0.05,
+                                            timeout_s=120)
+        out = client.launch(
+            task_lib.Task.from_yaml_config(
+                {'name': 'dash', 'run': 'echo dash-live-tail-marker',
+                 'resources': {'accelerators': 'tpu-v5e-8'}}),
+            cluster_name='dash1')
+        job_id = out[0]
+        # Incremental job tail: poll exactly like the browser does.
+        collected, offset = '', 0
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                    f'{base}/api/job_log?cluster_name=dash1'
+                    f'&job_id={job_id}&offset={offset}',
+                    timeout=10) as r:
+                rec = json.loads(r.read())
+            collected += rec.get('log', '')
+            offset = rec['offset']
+            if rec['status'] in ('SUCCEEDED', 'FAILED'):
+                break
+            time.sleep(0.3)
+        assert 'dash-live-tail-marker' in collected
+        assert rec['status'] == 'SUCCEEDED'
+        # Request drill-down: the launch request's captured output.
+        reqs = json.loads(urllib.request.urlopen(
+            f'{base}/api/requests?limit=10', timeout=10).read())
+        launch_req = next(r for r in reqs['requests']
+                          if r['name'] == 'launch')
+        with urllib.request.urlopen(
+                f'{base}/api/request_log?request_id='
+                f'{launch_req["request_id"]}&offset=0', timeout=10) as r:
+            log_rec = json.loads(r.read())
+        assert log_rec['offset'] >= 0
+        assert log_rec['status'] in ('SUCCEEDED', 'RUNNING')
+        # Unknown request 404s.
+        try:
+            urllib.request.urlopen(
+                f'{base}/api/request_log?request_id=nope', timeout=10)
+            assert False, 'expected 404'
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        try:
+            client.down('dash1')
+        except Exception:
+            pass
+        server.shutdown()
+        requests_db.reset_for_test()
+
+
+def test_dashboard_has_live_tail_and_drilldown():
+    html = _index_html()
+    assert 'liveTail' in html
+    assert '/api/job_log' in html
+    assert '/api/request_log' in html
+    assert 'requestDetailView' in html
+    # user/workspace filters present (VERDICT r3 #8).
+    assert 'filterBar' in html

@@ -641,3 +641,49 @@ def test_serve_logs_tails_replica(serve_env):
             sdk.serve_logs('logsvc', 99)
     finally:
         serve_core.down('logsvc')
+
+
+class TestServeControllerHA:
+    """HA (VERDICT r3 #9): a service survives its controller process
+    dying — recover_controllers() re-execs it from persisted state and
+    the restarted control loop keeps serving."""
+
+    def test_service_survives_controller_kill(self, serve_env):
+        import json
+        import os
+        import signal
+        import urllib.request
+
+        task = _service_task(min_replicas=1)
+        serve_core.up(task, 'echoha', timeout_s=90)
+        record = serve_state.get_service('echoha')
+        pid = record['controller_pid']
+        assert pid
+        os.kill(pid, signal.SIGKILL)
+        try:
+            os.waitpid(pid, 0)   # reap so the pid is truly gone
+        except ChildProcessError:
+            pass
+        recovered = serve_core.recover_controllers()
+        assert recovered == ['echoha']
+        new_record = serve_state.get_service('echoha')
+        assert new_record['controller_pid'] != pid
+        # The re-execed control loop reconciles and keeps the service
+        # answering through the LB.
+        endpoint = f'127.0.0.1:{new_record["lb_port"]}'
+        deadline = time.time() + 60
+        ok = False
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f'http://{endpoint}/',
+                        timeout=5) as resp:
+                    json.loads(resp.read())
+                    ok = True
+                    break
+            except Exception:  # pylint: disable=broad-except
+                time.sleep(0.5)
+        assert ok, 'recovered controller never served traffic'
+        # Healthy/terminal services are left alone.
+        assert serve_core.recover_controllers() == []
+        serve_core.down('echoha')
