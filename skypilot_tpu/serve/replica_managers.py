@@ -199,14 +199,26 @@ class ReplicaManager:
             # failover blocklist: provisioning SKIPS recently-preempted
             # zones instead of re-rolling the same dice (VERDICT r3
             # weak #6 — the placer was disconnected from the blocklist
-            # the backend already honors).
+            # the backend already honors). Three deliberate limits:
+            # only for launches that actually USE spot (an on-demand
+            # replica dying says nothing about preemption), scoped to
+            # the spot provisioning model (a spot preemption must not
+            # block the same zone's on-demand failover candidate), and
+            # only while the placer still knows a good zone — with
+            # every learned zone preemptive, blocking them all would
+            # leave no recovery path (SpotPlacer._reset's "try
+            # somewhere" rule, applied here).
             blocked = None
-            if spot and not force_ondemand and \
-                    self.spot_placer.preemptive_zones:
+            launch_uses_spot = (not force_ondemand and
+                                any(r.use_spot for r in task.resources))
+            if launch_uses_spot and self.spot_placer.preemptive_zones \
+                    and self.spot_placer.active_zones:
                 from skypilot_tpu import resources as resources_lib
-                blocked = [resources_lib.Resources(zone=z)
-                           for z in sorted(
-                               self.spot_placer.preemptive_zones)]
+                blocked = [
+                    resources_lib.Resources(
+                        zone=z,
+                        accelerator_args={'provisioning_model': 'spot'})
+                    for z in sorted(self.spot_placer.preemptive_zones)]
             _, handle = execution.launch(task, cluster_name=cluster_name,
                                          detach_run=True,
                                          blocked_resources=blocked)

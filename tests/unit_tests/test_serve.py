@@ -696,10 +696,14 @@ def test_spot_placer_feeds_failover_blocklist(serve_env, monkeypatch):
     from skypilot_tpu.serve import replica_managers
 
     task = _service_task(min_replicas=1)
+    # The link only engages for SPOT launches.
+    task.set_resources([r.copy(use_spot=True) for r in task.resources])
     serve_state.add_service('sp1', task.to_yaml_config(), 0)
     spec = task.service
     mgr = replica_managers.ReplicaManager('sp1', task.to_yaml_config(),
                                           spec)
+    # One good zone known, one preempted: the blocklist engages.
+    mgr.spot_placer.handle_active('fake-central1-b')
     mgr.spot_placer.handle_preemption('fake-central1-a')
 
     captured = {}
@@ -722,9 +726,21 @@ def test_spot_placer_feeds_failover_blocklist(serve_env, monkeypatch):
     mgr._launch_replica(7, 'sp1-rep7', version=1, spot=True)
     blocked = captured['blocked']
     assert blocked and blocked[0].zone == 'fake-central1-a'
+    # Scoped to the spot model: a preemption must not block the zone's
+    # on-demand failover candidate (code-review r4).
+    assert blocked[0].accelerator_args == {'provisioning_model': 'spot'}
+    # With EVERY known zone preemptive, blocking them all would leave
+    # no recovery path — the blocklist stands down.
+    mgr.spot_placer.handle_preemption('fake-central1-b')
+    serve_state.upsert_replica('sp1', 9, 'sp1-rep9',
+                               serve_state.ReplicaStatus.PROVISIONING)
+    mgr._launch_replica(9, 'sp1-rep9', version=1, spot=True)
+    assert captured['blocked'] is None
     # On-demand fallback launches carry no spot-zone blocklist.
     mgr2 = replica_managers.ReplicaManager('sp1', task.to_yaml_config(),
                                            spec)
+    mgr2.spot_placer.handle_active('fake-central1-b')
+    mgr2.spot_placer.handle_preemption('fake-central1-a')
     serve_state.upsert_replica('sp1', 8, 'sp1-rep8',
                                serve_state.ReplicaStatus.PROVISIONING)
     mgr2._launch_replica(8, 'sp1-rep8', version=1, spot=False)
