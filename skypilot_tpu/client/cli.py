@@ -1075,6 +1075,63 @@ def workspaces_delete(name):
     click.echo(f'Workspace {name} deleted.')
 
 
+@workspaces.command(name='add-member')
+@click.argument('workspace')
+@click.argument('user_name')
+def workspaces_add_member(workspace, user_name):
+    """Grant USER_NAME access to WORKSPACE (admin only)."""
+    from skypilot_tpu.client import sdk
+    sdk.workspaces_add_member(workspace, user_name)
+    click.echo(f'{user_name} added to {workspace}.')
+
+
+@workspaces.command(name='remove-member')
+@click.argument('workspace')
+@click.argument('user_name')
+def workspaces_remove_member(workspace, user_name):
+    """Revoke USER_NAME's access to WORKSPACE (admin only)."""
+    from skypilot_tpu.client import sdk
+    result = sdk.workspaces_remove_member(workspace, user_name)
+    if result.get('removed'):
+        click.echo(f'{user_name} removed from {workspace}.')
+    else:
+        raise click.ClickException(
+            f'{user_name} was not a member of {workspace}.')
+
+
+@workspaces.command(name='members')
+@click.argument('workspace')
+def workspaces_members(workspace):
+    """List WORKSPACE's members."""
+    from skypilot_tpu.client import sdk
+    for name in sdk.workspaces_members(workspace):
+        click.echo(name)
+
+
+@workspaces.command(name='set-config')
+@click.argument('workspace')
+@click.argument('config_yaml', type=click.Path(exists=True))
+def workspaces_set_config(workspace, config_yaml):
+    """Store CONFIG_YAML as WORKSPACE's launch config overlay."""
+    import yaml
+
+    from skypilot_tpu.client import sdk
+    with open(config_yaml, encoding='utf-8') as f:
+        config = yaml.safe_load(f) or {}
+    sdk.workspaces_set_config(workspace, config)
+    click.echo(f'Config overlay set for {workspace}.')
+
+
+@workspaces.command(name='get-config')
+@click.argument('workspace')
+def workspaces_get_config(workspace):
+    """Print WORKSPACE's launch config overlay."""
+    import yaml
+
+    from skypilot_tpu.client import sdk
+    click.echo(yaml.safe_dump(sdk.workspaces_get_config(workspace)))
+
+
 def main() -> None:
     cli()
 

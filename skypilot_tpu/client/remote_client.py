@@ -232,6 +232,27 @@ class RemoteClient:
     def workspaces_delete(self, name):
         return self._call('workspaces.delete', {'name': name})
 
+    def workspaces_add_member(self, workspace, user_name):
+        return self._call('workspaces.add_member',
+                          {'workspace': workspace,
+                           'user_name': user_name})
+
+    def workspaces_remove_member(self, workspace, user_name):
+        return self._call('workspaces.remove_member',
+                          {'workspace': workspace,
+                           'user_name': user_name})
+
+    def workspaces_members(self, workspace):
+        return self._call('workspaces.members', {'workspace': workspace})
+
+    def workspaces_set_config(self, workspace, config):
+        return self._call('workspaces.set_config',
+                          {'workspace': workspace, 'config': config})
+
+    def workspaces_get_config(self, workspace):
+        return self._call('workspaces.get_config',
+                          {'workspace': workspace})
+
     def serve_down(self, service_name):
         return self._call('serve.down', {'service_name': service_name})
 

@@ -394,6 +394,36 @@ def workspaces_delete(name: str):
                                    name)
 
 
+def workspaces_add_member(workspace: str, user_name: str):
+    return _module_local_or_remote('skypilot_tpu.workspaces.core',
+                                   'add_member', 'workspaces_add_member',
+                                   workspace, user_name)
+
+
+def workspaces_remove_member(workspace: str, user_name: str):
+    return _module_local_or_remote(
+        'skypilot_tpu.workspaces.core', 'remove_member',
+        'workspaces_remove_member', workspace, user_name)
+
+
+def workspaces_members(workspace: str) -> List[str]:
+    return _module_local_or_remote('skypilot_tpu.workspaces.core',
+                                   'list_members', 'workspaces_members',
+                                   workspace)
+
+
+def workspaces_set_config(workspace: str, config: Dict[str, Any]):
+    return _module_local_or_remote('skypilot_tpu.workspaces.core',
+                                   'set_config', 'workspaces_set_config',
+                                   workspace, config)
+
+
+def workspaces_get_config(workspace: str) -> Dict[str, Any]:
+    return _module_local_or_remote('skypilot_tpu.workspaces.core',
+                                   'get_config', 'workspaces_get_config',
+                                   workspace)
+
+
 def api_info() -> Dict[str, Any]:
     """Server URL, health and identity (twin of `sky api info`,
     sky/client/cli/command.py:5156)."""

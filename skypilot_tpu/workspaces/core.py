@@ -55,11 +55,18 @@ def validate_exists(name: str) -> str:
 
 def add_member(workspace: str, user_name: str) -> Dict[str, Any]:
     validate_exists(workspace)
+    if state.list_users() and state.get_user(user_name) is None:
+        # With a user registry in play, granting access to an unknown
+        # name is a typo, not a grant (and would pre-authorize whoever
+        # registers that name later).
+        raise ValueError(f'Unknown user {user_name!r}; create the '
+                         'account first (`xsky users create`).')
     state.add_workspace_member(workspace, user_name)
     return {'workspace': workspace, 'member': user_name}
 
 
 def remove_member(workspace: str, user_name: str) -> Dict[str, Any]:
+    validate_exists(workspace)
     return {'removed': state.remove_workspace_member(workspace,
                                                      user_name)}
 
@@ -101,5 +108,7 @@ def set_config(workspace: str, config: Dict[str, Any]) -> Dict[str, Any]:
 
 def get_config(workspace: str) -> Dict[str, Any]:
     import json
+    if workspace != DEFAULT_WORKSPACE:
+        validate_exists(workspace)
     raw = state.get_workspace_config(workspace)
     return json.loads(raw) if raw else {}
