@@ -108,7 +108,6 @@ def set_config(workspace: str, config: Dict[str, Any]) -> Dict[str, Any]:
 
 def get_config(workspace: str) -> Dict[str, Any]:
     import json
-    if workspace != DEFAULT_WORKSPACE:
-        validate_exists(workspace)
+    validate_exists(workspace)   # 'default' is always seeded
     raw = state.get_workspace_config(workspace)
     return json.loads(raw) if raw else {}

@@ -59,6 +59,22 @@ import pytest
 from skypilot_tpu import check as check_lib
 
 
+@pytest.fixture(autouse=True, scope='module')
+def _clear_jax_caches_between_modules():
+    """Drop compiled executables between test modules.
+
+    A full single-process slow-tier run accumulates hundreds of
+    compiled programs; around the ~190th jit-heavy test XLA's CPU
+    backend segfaults inside backend_compile_and_load (observed
+    deterministically in round 4, with >100 GB RAM free — native
+    compile-state buildup, not OOM). Modules rarely share shapes, so
+    per-module cache clearing costs little and keeps the one-process
+    suite viable.
+    """
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture
 def enable_fake_cloud(monkeypatch):
     """Enable only the fake cloud (twin of reference enable_all_clouds,

@@ -524,6 +524,10 @@ def test_multi_step_decode_matches_single_step(tiny_engine):
                 for p in prompts]
     orch = orch_lib.Orchestrator(tiny_engine, decode_steps=4)
     assert orch.generate(prompts, max_new_tokens=n_new) == expected
+    # decode_steps DEEPER than the whole budget (the bench's ds=16
+    # rungs with short max_new): must truncate exactly, not run over.
+    orch16 = orch_lib.Orchestrator(tiny_engine, decode_steps=16)
+    assert orch16.generate(prompts, max_new_tokens=n_new) == expected
     # EOS mid-fused-batch: stop exactly at the EOS position.
     full = _reference_greedy(tiny_engine.params, [5, 17, 3], 10)
     eos = full[4]
