@@ -24,6 +24,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from skypilot_tpu.infer import sampling
@@ -310,6 +311,104 @@ class InferenceEngine:
         return (first_token, kv,
                 _logprobs_info(logits, first_token[None], logprobs_k))
 
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _prefill_batch(self, params, tokens, true_lens, temperature,
+                       top_k, top_p, key):
+        """Batched prefill: tokens [B, bucket] (one shared bucket),
+        true_lens [B] → (first_tokens [B], kv [L, B, bucket, KVH, HD]).
+
+        One device dispatch admits the whole wave — on dispatch-bound
+        links (remote TPU terminals) per-prompt prefill costs one RTT
+        per request, which dominates TTFT when many requests arrive at
+        once. Sampling params are per-row like the decode path.
+        """
+        c = self.config.model
+        last_hidden, kv = self._model_lib.prefill_hidden(
+            c, params, tokens, true_lens, mesh=self.mesh)
+        logits = self._model_lib.lm_logits(c, params, last_hidden)
+        first_tokens = sampling.sample_batched(logits, key, temperature,
+                                               top_k, top_p)
+        return first_tokens, kv
+
+    @functools.partial(jax.jit, static_argnums=(0,),
+                       donate_argnums=(1,))
+    def _insert_batch(self, state, kv, first_tokens, true_lens, slots):
+        """Scatter a batched prefill into decode slots — one dispatch
+        for the whole wave. Pad rows carry the out-of-range slot index
+        max_slots: JAX drops out-of-bounds scatter updates, so nothing
+        a pad row computed (including its independently sampled first
+        token) ever reaches a real slot."""
+        cfg = self.config
+        k = kv['k'][:, :, :cfg.max_target_len]
+        v = kv['v'][:, :, :cfg.max_target_len]
+        pad = cfg.max_target_len - k.shape[2]
+        widths = ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))
+        k = jnp.pad(k, widths)
+        v = jnp.pad(v, widths)
+        state['kv_k'] = llama.write_cache_slots(state['kv_k'], k, slots)
+        state['kv_v'] = llama.write_cache_slots(state['kv_v'], v, slots)
+        state['lengths'] = state['lengths'].at[slots].set(true_lens)
+        state['tokens'] = state['tokens'].at[slots].set(first_tokens)
+        state['active'] = state['active'].at[slots].set(True)
+        state['counts'] = (state['counts'].at[slots].set(0)
+                           .at[slots, first_tokens].set(1))
+        return state
+
+    @property
+    def supports_batched_prefill(self) -> bool:
+        """Batched admission rides the plain bucket path; the prefix
+        cache works on individual prompts, so engines with it enabled
+        keep per-prompt admission (reuse beats dispatch fusion)."""
+        return self._prefix_cache is None
+
+    def prefill_insert_batch(self, state, requests_args, slots):
+        """Admit a wave of requests in two dispatches (forward +
+        scatter insert).
+
+        requests_args: list of (prompt_tokens, SamplingParams), all
+        with len(prompt) ≤ max_prompt_len; slots: one free slot per
+        request. The batch is always padded to max_slots — ONE
+        compiled variant per bucket, warmed by a single full-wave
+        warmup call. Pad rows repeat row 0's inputs but scatter to the
+        out-of-range slot index max_slots, so every one of their
+        updates is DROPPED (JAX scatter semantics) — their
+        independently-sampled tokens can never leak into a real slot.
+        Returns (state, first_tokens [n] host list).
+        """
+        n = len(requests_args)
+        assert 0 < n == len(slots) <= self.config.max_slots
+        bucket = self.bucket_for(max(len(p) for p, _ in requests_args))
+        padded_n = self.config.max_slots
+        tokens = np.zeros((padded_n, bucket), np.int32)
+        true_lens = np.zeros((padded_n,), np.int32)
+        temps = np.zeros((padded_n,), np.float32)
+        top_ks = np.zeros((padded_n,), np.int32)
+        top_ps = np.ones((padded_n,), np.float32)
+        # Pad rows scatter out of bounds: dropped, never written.
+        slot_arr = np.full((padded_n,), self.config.max_slots, np.int32)
+        for i in range(padded_n):
+            row = i if i < n else 0   # pad rows repeat row 0's inputs
+            prompt, sp = requests_args[row]
+            tokens[i, :len(prompt)] = prompt
+            true_lens[i] = len(prompt)
+            temps[i] = sp.temperature
+            top_ks[i] = sp.top_k
+            top_ps[i] = sp.top_p
+        slot_arr[:n] = slots
+        self._key, key = jax.random.split(self._key)
+        first_tokens, kv = self._prefill_batch(
+            self.params, jnp.asarray(tokens), jnp.asarray(true_lens),
+            jnp.asarray(temps),
+            jnp.asarray(top_ks) if (top_ks[:n] > 0).any() else None,
+            jnp.asarray(top_ps) if (top_ps[:n] < 1.0).any() else None,
+            key)
+        state = self._insert_batch(state, kv, first_tokens,
+                                   jnp.asarray(true_lens),
+                                   jnp.asarray(slot_arr))
+        host_tokens = [int(t) for t in
+                       np.asarray(jax.device_get(first_tokens))[:n]]
+        return state, host_tokens
+
     def prefill(self, prompt_tokens,
                 sampling_params: Optional[sampling.SamplingParams] = None,
                 key: Optional[jax.Array] = None,
@@ -457,33 +556,14 @@ class InferenceEngine:
 
     # ---- insert ----
 
-    @functools.partial(jax.jit, static_argnums=(0,),
-                       donate_argnums=(1,))
-    def _insert(self, state, kv, first_token, true_len, slot):
-        """Write a prefill prefix into decode slot `slot`."""
-        cfg = self.config
-        # kv arrays: [L, 1, bucket, KVH, HD] → pad/crop to max_target_len.
-        # (bucket is a static shape; crop first so a bucket larger than the
-        # KV budget can never produce a negative pad width.)
-        k = kv['k'][:, 0, :cfg.max_target_len]
-        v = kv['v'][:, 0, :cfg.max_target_len]
-        pad = cfg.max_target_len - k.shape[1]
-        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
-        # llama.write_cache_slot owns the cache representation (plain
-        # or quantized) together with slot_cache_attend.
-        state['kv_k'] = llama.write_cache_slot(state['kv_k'], k, slot)
-        state['kv_v'] = llama.write_cache_slot(state['kv_v'], v, slot)
-        state['lengths'] = state['lengths'].at[slot].set(true_len)
-        state['tokens'] = state['tokens'].at[slot].set(first_token)
-        state['active'] = state['active'].at[slot].set(True)
-        state['counts'] = (state['counts'].at[slot].set(0)
-                           .at[slot, first_token].set(1))
-        return state
-
     def insert(self, state, kv, first_token, true_len: int, slot: int):
-        return self._insert(state, kv, first_token,
-                            jnp.int32(true_len), jnp.int32(slot))
+        """Write one prefill prefix into decode slot `slot` — the B=1
+        case of _insert_batch (one insert body owns the pad/crop/
+        scatter/counts logic and the cache representation)."""
+        return self._insert_batch(
+            state, kv, jnp.asarray(first_token).reshape(1),
+            jnp.asarray([true_len], jnp.int32),
+            jnp.asarray([slot], jnp.int32))
 
     def release_slot(self, state, slot: int):
         state = dict(state)

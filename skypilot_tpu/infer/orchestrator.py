@@ -111,19 +111,14 @@ class Orchestrator:
     def _admit_limit(self) -> int:
         return self.engine.max_admit_len
 
-    def _admit_one(self) -> bool:
-        """Prefill + insert one pending request into a free slot."""
-        if not self._free_slots:
-            return False
-        try:
-            request = self._pending.get_nowait()
-        except queue.Empty:
-            return False
+    def _validate_admit(self, request: Request) -> bool:
+        """Cancel/length checks + KV-budget clamp. False ⇒ the request
+        was finished (cancelled/rejected) and must not be admitted."""
         if request.cancel_requested:
             # Cancelled while still queued: finish without a prefill.
             request.done = True
             request.finished_at = time.perf_counter()
-            return True
+            return False
         prompt_len = len(request.prompt_tokens)
         # The prompt must leave room for at least one generated token in
         # the per-slot KV budget; families with a chunked-prefill path
@@ -138,12 +133,16 @@ class Orchestrator:
             request.finished_at = time.perf_counter()
             logger.warning(f'Rejected request {request.request_id}: '
                            f'{request.error}')
-            return True
+            return False
         budget = prompt_len + request.max_new_tokens
         if budget > self.engine.config.max_target_len:
             request.max_new_tokens = (self.engine.config.max_target_len -
                                       prompt_len)
-        slot = self._free_slots.pop()
+        return True
+
+    def _admit_claimed(self, request: Request, slot: int) -> None:
+        """Single-request admission into an already-claimed slot."""
+        prompt_len = len(request.prompt_tokens)
         sp = sampling_lib.SamplingParams(
             temperature=request.temperature, top_k=request.top_k,
             top_p=request.top_p)
@@ -158,7 +157,7 @@ class Orchestrator:
             self._partials[slot] = (
                 request, self.engine.start_chunked_prefill(
                     request.prompt_tokens, sp, lp_k))
-            return True
+            return
         # Key omitted: the engine owns sampling-key state (split per call).
         # prefill_any == prefill for in-bucket prompts with no cached
         # prefix; beyond that it chunks and reuses cached prefixes.
@@ -166,7 +165,70 @@ class Orchestrator:
                                       sampling_params=sp,
                                       logprobs_k=lp_k)
         self._finish_admit(slot, request, out)
+
+    def _admit_one(self) -> bool:
+        """Prefill + insert one pending request into a free slot."""
+        if not self._free_slots:
+            return False
+        try:
+            request = self._pending.get_nowait()
+        except queue.Empty:
+            return False
+        if not self._validate_admit(request):
+            return True
+        self._admit_claimed(request, self._free_slots.pop())
         return True
+
+    #: Subclasses with per-request admission hooks (speculation mirrors
+    #: each prefill into a draft cache) keep the single path.
+    _batched_admit = True
+
+    def _admit_wave(self) -> None:
+        """Admit pending requests, batching plain-bucket prefills into
+        one forward + one scatter-insert dispatch per bucket group.
+
+        Per-prompt prefill costs one device dispatch each; on
+        dispatch-bound links the RTT per prefill dominates TTFT when a
+        wave of requests arrives. Logprobs requests, long prompts
+        (chunked path), and prefix-cached engines use the single path.
+        """
+        if not (self._batched_admit
+                and getattr(self.engine, 'supports_batched_prefill',
+                            False)):
+            while self._admit_one():
+                pass
+            return
+        batch: List = []       # (request, claimed slot)
+        while self._free_slots:
+            try:
+                request = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            if not self._validate_admit(request):
+                continue
+            if (not request.logprobs
+                    and len(request.prompt_tokens)
+                    <= self.engine.config.max_prompt_len):
+                batch.append((request, self._free_slots.pop()))
+            else:
+                self._admit_claimed(request, self._free_slots.pop())
+        groups: Dict[int, List] = {}
+        for request, slot in batch:
+            bucket = self.engine.bucket_for(len(request.prompt_tokens))
+            groups.setdefault(bucket, []).append((request, slot))
+        for group in groups.values():
+            if len(group) == 1:
+                request, slot = group[0]
+                self._admit_claimed(request, slot)
+                continue
+            args = [(r.prompt_tokens, sampling_lib.SamplingParams(
+                temperature=r.temperature, top_k=r.top_k,
+                top_p=r.top_p)) for r, _ in group]
+            slots = [s for _, s in group]
+            self.state, first_tokens = self.engine.prefill_insert_batch(
+                self.state, args, slots)
+            for (request, slot), token in zip(group, first_tokens):
+                self._post_insert(slot, request, token)
 
     def _finish_admit(self, slot: int, request: Request, out) -> None:
         if request.logprobs:
@@ -176,6 +238,12 @@ class Orchestrator:
             first_token, kv, true_len = out
         self.state = self.engine.insert(self.state, kv, first_token,
                                         true_len, slot)
+        self._post_insert(slot, request, int(first_token))
+
+    def _post_insert(self, slot: int, request: Request,
+                     first_token: int) -> None:
+        """Host-side bookkeeping once a prefill is in the slot cache
+        (shared by single and batched admission)."""
         request.output_tokens.append(int(first_token))
         request.first_token_at = time.perf_counter()
         self._slot_req[slot] = request
@@ -234,10 +302,10 @@ class Orchestrator:
             self._free_slots.append(slot)
 
     def step(self) -> None:
-        """One scheduler tick: admit while possible, advance in-flight
-        chunked prefills by one chunk, then decode."""
-        while self._admit_one():
-            pass
+        """One scheduler tick: admit while possible (batching same-
+        bucket prefills into one dispatch), advance in-flight chunked
+        prefills by one chunk, then decode."""
+        self._admit_wave()
         self._advance_partials()
         self._decode_tick()
 
@@ -407,6 +475,10 @@ class SpeculativeOrchestrator(Orchestrator):
     rate, never correctness.
     """
 
+    # Admission mirrors every prefill into the draft cache per
+    # request (_finish_admit hook) — keep the single path.
+    _batched_admit = False
+
     def __init__(self, engine: engine_lib.InferenceEngine,
                  draft_engine: engine_lib.InferenceEngine,
                  gamma: int = 4, seed: int = 0) -> None:
@@ -546,6 +618,10 @@ class NgramSpeculator(Orchestrator):
     copy-heavy generation (quoting the prompt, code, RAG answers)
     with no second model and no extra HBM.
     """
+
+    # Keep per-request admission: gram indexes key off request
+    # state at admit time.
+    _batched_admit = False
 
     def __init__(self, engine: engine_lib.InferenceEngine,
                  gamma: int = 4, match_len: int = 2,

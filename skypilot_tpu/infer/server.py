@@ -548,6 +548,16 @@ def main() -> int:
                                  presence_penalty=0.1,
                                  frequency_penalty=0.1))
     orch.run_until_drained()
+    # Full admission wave (batched prefill pads every wave to
+    # max_slots: one variant per bucket), both the greedy and the
+    # sampled trace signatures (top_k/top_p arrays vs None).
+    orch.generate([[1, 2, 3]] * engine.config.max_slots,
+                  max_new_tokens=2)
+    for _ in range(engine.config.max_slots):
+        orch.submit(orch_lib.Request(prompt_tokens=[1, 2, 3],
+                                     max_new_tokens=2, temperature=0.8,
+                                     top_k=5, top_p=0.9))
+    orch.run_until_drained()
     loop = ServingLoop(orch)
 
     from skypilot_tpu.infer import tokenizer as tokenizer_lib

@@ -540,12 +540,13 @@ def prefill_hidden(c: DeepSeekConfig, params: Params, tokens: jax.Array,
     k = c_kv [L,B,S,1,r_kv], v = k_rope [L,B,S,1,dr])."""
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
-    token_mask = (positions < true_len).astype(jnp.float32)
+    # true_len: scalar or [B] (batched prefill).
+    token_mask = (positions
+                  < jnp.asarray(true_len).reshape(-1, 1)).astype(
+                      jnp.float32)
     x, _, kv = _trunk(c, params, tokens, positions, mesh,
                       token_mask=token_mask, return_kv=True)
-    last = jax.lax.dynamic_index_in_dim(x, true_len - 1, axis=1,
-                                        keepdims=False)
-    return last, kv
+    return llama.last_token_hidden(x, true_len), kv
 
 
 def decode_forward(c: DeepSeekConfig, params: Params,

@@ -397,9 +397,7 @@ def prefill_hidden(config: GemmaConfig, params: Params,
     """Prefill trunk → (last_hidden [B, D], per-layer KV) — the engine
     contract shared with llama/qwen/moe."""
     x, kv = _trunk(config, params, tokens, None, mesh, return_kv=True)
-    last = jax.lax.dynamic_index_in_dim(x, true_len - 1, axis=1,
-                                        keepdims=False)
-    return last, kv
+    return llama.last_token_hidden(x, true_len), kv
 
 
 def verify_forward(config: GemmaConfig, params: Params,

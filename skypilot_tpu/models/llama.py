@@ -395,20 +395,36 @@ def dequantize_kv(q: jax.Array, scale: jax.Array,
     return (q.astype(jnp.float32) * scale).astype(dtype)
 
 
-def write_cache_slot(cache_entry, values: jax.Array, slot) -> Any:
-    """Write one slot's full K (or V) prefix into a cache entry.
+def write_cache_slots(cache_entry, values: jax.Array,
+                      slots: jax.Array) -> Any:
+    """Write full K (or V) prefixes into cache slots.
 
-    cache_entry: [L, slots, len, KVH, HD] array, or the quantized
-    (int8, scale) pair; values: [L, len, KVH, HD] (bf16/fp32). Owns the
-    quantized representation together with slot_cache_attend so the
-    engine never touches the layout.
+    cache_entry: [L, n_slots, len, KVH, HD] array, or the quantized
+    (int8, scale) pair; values: [L, B, len, KVH, HD] scattered into
+    slots [B]. Owns the quantized representation together with
+    slot_cache_attend so the engine never touches the layout.
+    Out-of-range slot indices are dropped (JAX scatter semantics) —
+    the batched-prefill pad rows rely on that.
     """
     if isinstance(cache_entry, (tuple, list)):
         data, scale = cache_entry
         q_vals, q_scale = quantize_kv(values)
-        return (data.at[:, slot].set(q_vals),
-                scale.at[:, slot].set(q_scale))
-    return cache_entry.at[:, slot].set(values.astype(cache_entry.dtype))
+        return (data.at[:, slots].set(q_vals),
+                scale.at[:, slots].set(q_scale))
+    return cache_entry.at[:, slots].set(
+        values.astype(cache_entry.dtype))
+
+
+def last_token_hidden(x: jax.Array, true_len) -> jax.Array:
+    """x [B, S, D] → [B, D] rows at position true_len-1.
+
+    true_len: scalar (shared) or [B] (per-row — the batched-prefill
+    path, where every row has its own prompt length).
+    """
+    idx = jnp.broadcast_to(jnp.asarray(true_len).reshape(-1),
+                           (x.shape[0],))
+    return jnp.take_along_axis(x, (idx - 1)[:, None, None],
+                               axis=1)[:, 0]
 
 
 def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -666,12 +682,11 @@ def prefill_hidden(config: LlamaConfig,
 
     → (last_hidden [B, D] in model dtype, per-layer KV). The caller does
     the single-row lm_head projection — avoids materializing fp32 logits
-    for the whole padded prefill bucket.
+    for the whole padded prefill bucket. true_len may be scalar or [B]
+    (batched prefill: one padded bucket, per-row prompt lengths).
     """
     x, kv = _trunk(config, params, tokens, None, mesh, return_kv=True)
-    last = jax.lax.dynamic_index_in_dim(x, true_len - 1, axis=1,
-                                        keepdims=False)
-    return last, kv
+    return last_token_hidden(x, true_len), kv
 
 
 def decode_forward(config: LlamaConfig,

@@ -386,7 +386,11 @@ def prefill_hidden(config: MoEConfig, params: Params, tokens: jax.Array,
     c = config
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
-    token_mask = (positions < true_len).astype(jnp.float32)
+    # true_len: scalar or [B] (batched prefill) — per-row reshape
+    # broadcasts either against positions [B, S].
+    token_mask = (positions
+                  < jnp.asarray(true_len).reshape(-1, 1)).astype(
+                      jnp.float32)
     x = qops.embed_rows(params['embed'], tokens).astype(c.dtype)
 
     def layer_fn(x, lp):
@@ -396,9 +400,7 @@ def prefill_hidden(config: MoEConfig, params: Params, tokens: jax.Array,
 
     x, kv = jax.lax.scan(layer_fn, x, params['layers'])
     x = llama._rms_norm(x, params['final_norm'], c.norm_eps)
-    last = jax.lax.dynamic_index_in_dim(x, true_len - 1, axis=1,
-                                        keepdims=False)
-    return last, kv
+    return llama.last_token_hidden(x, true_len), kv
 
 
 def verify_forward(config: MoEConfig, params: Params,

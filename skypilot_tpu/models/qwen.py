@@ -250,9 +250,7 @@ def prefill_hidden(config: QwenConfig, params: Params, tokens: jax.Array,
     """Prefill trunk → (last_hidden [B, D], per-layer KV) — the same
     engine contract as llama.prefill_hidden."""
     x, kv = _trunk(config, params, tokens, None, mesh, return_kv=True)
-    last = jax.lax.dynamic_index_in_dim(x, true_len - 1, axis=1,
-                                        keepdims=False)
-    return last, kv
+    return llama.last_token_hidden(x, true_len), kv
 
 
 def decode_forward(config: QwenConfig, params: Params,
