@@ -44,7 +44,12 @@ def launch(task, name: Optional[str] = None,
                                   timeout_s=timeout_s)
     tasks = list(task) if isinstance(task, (list, tuple)) else [task]
     config = task_lib.Task.chain_to_config(tasks)
-    job_id = jobs_state.add_job(name or tasks[0].name, config)
+    # Record the submitting workspace: jobs.cancel/jobs.logs authz
+    # resolves ownership from this column (server/app.py
+    # _target_workspace).
+    from skypilot_tpu.workspaces import context as ws_context
+    job_id = jobs_state.add_job(name or tasks[0].name, config,
+                                workspace=ws_context.get_active())
     jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.SUBMITTED)
     jobs_scheduler.submit_job(job_id)
     if wait:

@@ -74,10 +74,14 @@ def schedule_lock() -> filelock.FileLock:
 
 
 def _spawn_controller(job_id: int) -> None:
+    from skypilot_tpu.workspaces import context as ws_context
+    record = jobs_state.get_job(job_id)
+    env = ws_context.controller_env(
+        record.get('workspace') if record else None)
     proc = subprocess.Popen(
         [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
          str(job_id)],
-        env=dict(os.environ),
+        env=env,
         start_new_session=True,
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
     jobs_state.set_controller_pid(job_id, proc.pid)

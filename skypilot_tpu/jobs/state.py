@@ -65,7 +65,9 @@ def _db() -> sqlite3.Connection:
     conn = db_utils.connect(db_path(), timeout=30,
                             check_same_thread=False)
     try:
-        conn.execute('SELECT num_tasks FROM managed_jobs '
+        # Probe the NEWEST column so a pre-migration DB falls through
+        # to the DDL below (an older probe column would skip it).
+        conn.execute('SELECT workspace FROM managed_jobs '
                      'LIMIT 1').fetchall()
         return conn
     except Exception:  # pylint: disable=broad-except
@@ -103,6 +105,10 @@ def _db() -> sqlite3.Connection:
             # restart) via bounded re-exec (scheduler reconcile).
             "ALTER TABLE managed_jobs ADD COLUMN "
             "controller_respawns INTEGER DEFAULT 0",
+            # Workspace isolation: jobs belong to the workspace active
+            # at submit time; jobs.cancel/logs authz resolves it
+            # (advisor r4: these verbs bypassed per-workspace authz).
+            "ALTER TABLE managed_jobs ADD COLUMN workspace TEXT",
     ):
         try:
             conn.execute(migration)
@@ -120,7 +126,8 @@ def _db() -> sqlite3.Connection:
     return conn
 
 
-def add_job(name: Optional[str], task_config: Any) -> int:
+def add_job(name: Optional[str], task_config: Any,
+            workspace: Optional[str] = None) -> int:
     """task_config: one task's config dict, or a LIST of config dicts
     for a pipeline (chain of tasks run sequentially, each on its own
     cluster — twin of the reference's chain-DAG managed jobs,
@@ -134,17 +141,20 @@ def add_job(name: Optional[str], task_config: Any) -> int:
             # psycopg2 cursors have no meaningful lastrowid.
             cur = conn.execute(
                 'INSERT INTO managed_jobs (name, task_config, status, '
-                'submitted_at, num_tasks) VALUES (?, ?, ?, ?, ?) '
-                'RETURNING job_id',
+                'submitted_at, num_tasks, workspace) '
+                'VALUES (?, ?, ?, ?, ?, ?) RETURNING job_id',
                 (name, json.dumps(task_config),
-                 ManagedJobStatus.PENDING.value, time.time(), num_tasks))
+                 ManagedJobStatus.PENDING.value, time.time(), num_tasks,
+                 workspace))
             job_id = cur.fetchone()[0]
         else:
             cur = conn.execute(
                 'INSERT INTO managed_jobs (name, task_config, status, '
-                'submitted_at, num_tasks) VALUES (?, ?, ?, ?, ?)',
+                'submitted_at, num_tasks, workspace) '
+                'VALUES (?, ?, ?, ?, ?, ?)',
                 (name, json.dumps(task_config),
-                 ManagedJobStatus.PENDING.value, time.time(), num_tasks))
+                 ManagedJobStatus.PENDING.value, time.time(), num_tasks,
+                 workspace))
             job_id = cur.lastrowid
         conn.commit()
         conn.close()
@@ -306,7 +316,7 @@ def _to_dict(row) -> Dict[str, Any]:
     (job_id, name, task_config, status, cluster_name, recovery_count,
      failure_reason, controller_pid, submitted_at, started_at,
      ended_at, schedule_state, current_task, num_tasks,
-     controller_respawns) = row
+     controller_respawns, workspace) = row
     parsed = json.loads(task_config or '{}')
     # Pipelines store a LIST of task configs; single jobs a dict.
     configs = parsed if isinstance(parsed, list) else [parsed]
@@ -324,6 +334,7 @@ def _to_dict(row) -> Dict[str, Any]:
         'failure_reason': failure_reason,
         'controller_pid': controller_pid,
         'controller_respawns': controller_respawns or 0,
+        'workspace': workspace,
         'submitted_at': submitted_at,
         'started_at': started_at,
         'ended_at': ended_at,

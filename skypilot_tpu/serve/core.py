@@ -79,10 +79,14 @@ def _spawn_controller(name: str) -> int:
     """
     log_path = controller_log_path(name)
     os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    from skypilot_tpu.workspaces import context as ws_context
+    record = serve_state.get_service(name)
+    env = ws_context.controller_env(
+        record.get('workspace') if record else None)
     with open(log_path, 'ab') as logf:
         proc = subprocess.Popen(
             [sys.executable, '-m', 'skypilot_tpu.serve.controller', name],
-            env=dict(os.environ), start_new_session=True,
+            env=env, start_new_session=True,
             stdout=logf, stderr=subprocess.STDOUT)
     serve_state.set_service_controller_pid(name, proc.pid)
     return proc.pid
@@ -127,7 +131,9 @@ def up(task: task_lib.Task, service_name: Optional[str] = None,
     if serve_state.get_service(name) is not None:
         raise ValueError(f'Service {name!r} already exists.')
     lb_port = _free_port()
-    serve_state.add_service(name, task.to_yaml_config(), lb_port)
+    from skypilot_tpu.workspaces import context as ws_context
+    serve_state.add_service(name, task.to_yaml_config(), lb_port,
+                            workspace=ws_context.get_active())
     _spawn_controller(name)
     if wait_ready:
         deadline = time.time() + timeout_s

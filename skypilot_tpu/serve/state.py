@@ -61,7 +61,10 @@ def _db() -> sqlite3.Connection:
                           ('replicas', 'version INTEGER DEFAULT 1'),
                           # Mixed fleets: spot replicas + on-demand
                           # fallback replicas coexist per service.
-                          ('replicas', 'spot INTEGER DEFAULT 1')):
+                          ('replicas', 'spot INTEGER DEFAULT 1'),
+                          # Workspace isolation: serve.down/logs authz
+                          # resolves service ownership from this column.
+                          ('services', 'workspace TEXT')):
         try:
             conn.execute(f'ALTER TABLE {table} ADD COLUMN {column}')
         except Exception:  # pylint: disable=broad-except
@@ -74,18 +77,34 @@ def _db() -> sqlite3.Connection:
 
 
 def add_service(name: str, task_config: Dict[str, Any],
-                lb_port: int) -> None:
+                lb_port: int, workspace: Optional[str] = None) -> None:
+    """Create the service row; raises ValueError if the name is taken.
+
+    Plain INSERT, no upsert: creation must be atomic so two concurrent
+    `serve.up` calls cannot race past up()'s exists-check and the
+    second silently re-home the first's service (and its workspace)
+    — the loser gets the constraint error instead (code-review r5).
+    """
     with _lock:
         conn = _db()
-        conn.execute(
-            'INSERT INTO services (name, task_config, status, '
-            'lb_port, created_at) VALUES (?, ?, ?, ?, ?) '
-            'ON CONFLICT(name) DO UPDATE SET '
-            'task_config=excluded.task_config, status=excluded.status, '
-            'lb_port=excluded.lb_port, created_at=excluded.created_at, '
-            'version=1',
-            (name, json.dumps(task_config),
-             ServiceStatus.CONTROLLER_INIT.value, lb_port, time.time()))
+        try:
+            conn.execute(
+                'INSERT INTO services (name, task_config, status, '
+                'lb_port, created_at, workspace) '
+                'VALUES (?, ?, ?, ?, ?, ?)',
+                (name, json.dumps(task_config),
+                 ServiceStatus.CONTROLLER_INIT.value, lb_port,
+                 time.time(), workspace))
+        except Exception as e:  # pylint: disable=broad-except
+            conn.rollback()
+            conn.close()
+            # sqlite IntegrityError / pg UniqueViolation → name taken.
+            if (isinstance(e, sqlite3.IntegrityError)
+                    or 'unique' in str(e).lower()
+                    or 'duplicate' in str(e).lower()):
+                raise ValueError(
+                    f'Service {name!r} already exists.') from e
+            raise
         conn.commit()
         conn.close()
 
@@ -153,7 +172,8 @@ def remove_service(name: str) -> None:
 
 
 def _service_dict(row) -> Dict[str, Any]:
-    name, task_config, status, pid, lb_port, created_at, version = row
+    (name, task_config, status, pid, lb_port, created_at, version,
+     workspace) = row
     return {
         'name': name,
         'task_config': json.loads(task_config or '{}'),
@@ -162,6 +182,7 @@ def _service_dict(row) -> Dict[str, Any]:
         'lb_port': lb_port,
         'created_at': created_at,
         'version': version or 1,
+        'workspace': workspace,
     }
 
 

@@ -102,6 +102,33 @@ def _target_workspace(verb: str, body: Dict[str, Any]) -> 'Optional[str]':
         if record is None:
             return None   # nonexistent cluster: the verb 404s itself
         return record.get('workspace') or ws_context.DEFAULT_WORKSPACE
+    if verb in ('jobs.launch', 'serve.up'):
+        # Submissions target the requested (or active) workspace; the
+        # payload resolver re-validates and records it on the job/
+        # service row for the lifecycle verbs below.
+        return body.get('workspace') or ws_context.get_active()
+    if verb in ('jobs.cancel', 'jobs.logs'):
+        # Managed jobs belong to the workspace recorded at submit time
+        # (advisor r4: these verbs bypassed workspace isolation).
+        try:
+            job_id = int(body.get('job_id'))
+        except (TypeError, ValueError):
+            return None   # the verb itself rejects the bad id
+        from skypilot_tpu.jobs import state as jobs_state
+        record = jobs_state.get_job(job_id)
+        if record is None:
+            return None   # nonexistent job: the verb no-ops/404s
+        return record.get('workspace') or ws_context.DEFAULT_WORKSPACE
+    if verb in ('serve.down', 'serve.update', 'serve.logs',
+                'serve.controller_logs'):
+        service = body.get('service_name')
+        if not service:
+            return None
+        from skypilot_tpu.serve import state as serve_state
+        record = serve_state.get_service(service)
+        if record is None:
+            return None   # nonexistent service: the verb 404s itself
+        return record.get('workspace') or ws_context.DEFAULT_WORKSPACE
     return None
 
 

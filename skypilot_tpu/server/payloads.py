@@ -30,18 +30,25 @@ def _require(body: Dict[str, Any], key: str) -> Any:
     return body[key]
 
 
+def _in_workspace(workspace, fn, *args, **kwargs):
+    """Run `fn` with the request's workspace active (validated first),
+    shared by every submission resolver (launch/jobs.launch/serve.up)."""
+    from skypilot_tpu.workspaces import context as ws_context
+    if workspace is not None:
+        from skypilot_tpu.workspaces import core as workspaces_core
+        workspaces_core.validate_exists(workspace)
+    with ws_context.active(workspace):
+        return fn(*args, **kwargs)
+
+
 def _launch(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
     from skypilot_tpu import execution
-    from skypilot_tpu.workspaces import context as ws_context
     task = _task_from_body(body)
     workspace = body.get('workspace')
 
     def run_launch(**kwargs):
-        if workspace is not None:
-            from skypilot_tpu.workspaces import core as workspaces_core
-            workspaces_core.validate_exists(workspace)
-        with ws_context.active(workspace):
-            job_id, handle = execution.launch(task, **kwargs)
+        job_id, handle = _in_workspace(workspace, execution.launch,
+                                       task, **kwargs)
         return {'job_id': job_id,
                 'cluster_name': handle.get_cluster_name()
                 if handle else None}
@@ -124,9 +131,11 @@ def _jobs_launch(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
             raise BadRequest(f'invalid pipeline task: {e}') from e
     else:
         task = _task_from_body(body)
+    workspace = body.get('workspace')
 
     def run(**kwargs):
-        return {'job_id': jobs_core.launch(task, **kwargs)}
+        return {'job_id': _in_workspace(workspace, jobs_core.launch,
+                                        task, **kwargs)}
 
     return run, {'name': body.get('name')}
 
@@ -142,9 +151,11 @@ def _jobs_verb(fn_name: str, *fields):
 def _serve_up(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
     from skypilot_tpu.serve import core as serve_core
     task = _task_from_body(body)
+    workspace = body.get('workspace')
 
     def run(**kwargs):
-        return {'service_name': serve_core.up(task, **kwargs)}
+        return {'service_name': _in_workspace(workspace, serve_core.up,
+                                              task, **kwargs)}
 
     return run, {'service_name': body.get('service_name')}
 

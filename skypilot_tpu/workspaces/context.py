@@ -26,6 +26,20 @@ def set_active(workspace: Optional[str]) -> None:
     _local.workspace = workspace
 
 
+def controller_env(workspace: Optional[str]) -> dict:
+    """os.environ copy with XSKY_WORKSPACE pinned to `workspace`.
+
+    For detached controller processes (jobs/serve): the clusters they
+    launch must land in the owning job's/service's workspace, not
+    whatever the server process happens to have active. A None
+    workspace (legacy rows) leaves the env untouched.
+    """
+    env = dict(os.environ)
+    if workspace:
+        env['XSKY_WORKSPACE'] = workspace
+    return env
+
+
 @contextlib.contextmanager
 def active(workspace: Optional[str]) -> Iterator[None]:
     prev = getattr(_local, 'workspace', None)

@@ -299,6 +299,115 @@ class TestWorkspaceAuthz:
         assert code == 200
 
 
+class TestJobsServeWorkspaceAuthz:
+    """Managed-job and serve verbs are scoped to the owning workspace
+    (advisor r4: jobs.cancel/jobs.logs/serve.down/serve.logs bypassed
+    the per-workspace authz that cluster verbs enforce)."""
+
+    @pytest.fixture(autouse=True)
+    def _scoped_dbs(self, monkeypatch, tmp_path):
+        monkeypatch.setenv('XSKY_JOBS_DB', str(tmp_path / 'jobs.db'))
+        monkeypatch.setenv('XSKY_SERVE_DB', str(tmp_path / 'serve.db'))
+
+    def test_jobs_verbs_scoped_by_job_workspace(self, authz_server):
+        from skypilot_tpu.jobs import state as jobs_state
+        job_id = jobs_state.add_job('j', {'run': 'echo'},
+                                    workspace='team-a')
+        for verb in ('jobs.cancel', 'jobs.logs'):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(authz_server, verb, {'job_id': job_id},
+                      user='outsider', password='pw')
+            assert e.value.code == 403, verb
+            code, _ = _post(authz_server, verb, {'job_id': job_id},
+                            user='member', password='pw')
+            assert code == 200, verb
+
+    def test_serve_verbs_scoped_by_service_workspace(self, authz_server):
+        from skypilot_tpu.serve import state as serve_state
+        serve_state.add_service('svc', {'run': 'echo'}, 12345,
+                                workspace='team-a')
+        for verb, body in (
+                ('serve.down', {'service_name': 'svc'}),
+                ('serve.logs', {'service_name': 'svc',
+                                'replica_id': 0}),
+                ('serve.controller_logs', {'service_name': 'svc'}),
+                ('serve.update', {'service_name': 'svc',
+                                  'task': {'name': 't',
+                                           'run': 'echo'}})):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(authz_server, verb, body,
+                      user='outsider', password='pw')
+            assert e.value.code == 403, verb
+        # A member's submit is accepted (the request itself runs
+        # async; authz happens at admission).
+        code, _ = _post(authz_server, 'serve.controller_logs',
+                        {'service_name': 'svc'},
+                        user='member', password='pw')
+        assert code == 200
+
+    def test_jobs_launch_scoped_by_requested_workspace(
+            self, authz_server):
+        body = {'task': {'name': 't', 'run': 'echo'},
+                'workspace': 'team-a'}
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(authz_server, 'jobs.launch', body,
+                  user='outsider', password='pw')
+        assert e.value.code == 403
+
+    def test_serve_up_scoped_by_requested_workspace(self, authz_server):
+        body = {'task': {'name': 't', 'run': 'echo',
+                         'service': {'readiness_probe': '/'}},
+                'workspace': 'team-a'}
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(authz_server, 'serve.up', body,
+                  user='outsider', password='pw')
+        assert e.value.code == 403
+
+    def test_controllers_inherit_job_service_workspace(
+            self, authz_server, monkeypatch):
+        """Spawned controllers must pin XSKY_WORKSPACE to the job's/
+        service's workspace so the clusters THEY launch land there too
+        (code-review r5: otherwise task clusters fall into 'default'
+        and stay reachable cross-workspace)."""
+        import subprocess as subprocess_lib
+        from skypilot_tpu.jobs import scheduler as jobs_scheduler
+        from skypilot_tpu.jobs import state as jobs_state
+        from skypilot_tpu.serve import core as serve_core
+        from skypilot_tpu.serve import state as serve_state
+        captured = {}
+
+        class _FakeProc:
+            pid = 4242
+
+        def fake_popen(cmd, env=None, **kwargs):
+            captured['env'] = env
+            return _FakeProc()
+
+        monkeypatch.setattr(subprocess_lib, 'Popen', fake_popen)
+        job_id = jobs_state.add_job('j', {'run': 'echo'},
+                                    workspace='team-a')
+        jobs_scheduler._spawn_controller(job_id)
+        assert captured['env']['XSKY_WORKSPACE'] == 'team-a'
+        serve_state.add_service('svc3', {'run': 'echo'}, 12347,
+                                workspace='team-a')
+        serve_core._spawn_controller('svc3')
+        assert captured['env']['XSKY_WORKSPACE'] == 'team-a'
+
+    def test_launch_records_active_workspace(self, authz_server):
+        from skypilot_tpu.jobs import state as jobs_state
+        from skypilot_tpu.serve import state as serve_state
+        from skypilot_tpu.workspaces import context as ws_context
+        with ws_context.active('team-a'):
+            job_id = jobs_state.add_job(
+                'j', {'run': 'echo'},
+                workspace=ws_context.get_active())
+            serve_state.add_service(
+                'svc2', {'run': 'echo'}, 12346,
+                workspace=ws_context.get_active())
+        assert jobs_state.get_job(job_id)['workspace'] == 'team-a'
+        assert serve_state.get_service('svc2')['workspace'] == 'team-a'
+
+
 class TestWorkspaceConfigOverlay:
 
     def test_overlay_applied_at_launch(self, clean_state, monkeypatch):

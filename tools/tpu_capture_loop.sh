@@ -6,7 +6,7 @@
 # losing the round's official number). This loop runs all round in the
 # background: every cycle it probes the tunnel cheaply, and whenever the
 # chip is reachable it captures train AND serve benches, saving each
-# success to BENCH_LOCAL_r04_{train,serve}.json and to the
+# success to BENCH_LOCAL_r05_{train,serve}.json and to the
 # .bench_last_good_{train,serve}.json files that bench.py embeds in its
 # failure JSON — so even a dead tunnel at round end leaves on-silicon
 # evidence.
@@ -38,7 +38,7 @@ capture() { # $1 = train|serve
      ! printf '%s' "$line" | grep -q '"value": null'; then
     # Round evidence only; .bench_last_good_* is written by bench.py
     # itself (with captured_unix) on every successful on-silicon run.
-    printf '%s\n' "$line" > "BENCH_LOCAL_r04_${mode}.json"
+    printf '%s\n' "$line" > "BENCH_LOCAL_r05_${mode}.json"
     echo "+++ saved $mode capture" >> "$LOG"
     return 0
   fi
@@ -52,22 +52,22 @@ kernel_tier() {
   # mystery. jax.devices() hangs when the tunnel is down, so this only
   # runs behind a successful probe (plus its own hard timeout).
   XSKY_TPU_TESTS=1 timeout 2400 python -m pytest tests/tpu -m tpu -q \
-    > TPU_TIER_r04.txt 2>&1
+    > TPU_TIER_r05.txt 2>&1
   echo "--- kernel tier rc=$? $(date -u +%FT%TZ)" >> "$LOG"
-  tail -3 TPU_TIER_r04.txt >> "$LOG"
+  tail -3 TPU_TIER_r05.txt >> "$LOG"
 }
 
 while true; do
   if probe; then
     echo "tunnel UP $(date -u +%FT%TZ)" >> "$LOG"
-    if [ ! -f TPU_TIER_r04.txt ] || \
-       [ -n "$(find TPU_TIER_r04.txt -mmin +180)" ]; then
+    if [ ! -f TPU_TIER_r05.txt ] || \
+       [ -n "$(find TPU_TIER_r05.txt -mmin +180)" ]; then
       kernel_tier
     fi
     # Re-capture even after a success if >90 min old: later code may be
     # faster, and fresher evidence is better evidence.
     for mode in train serve; do
-      f="BENCH_LOCAL_r04_${mode}.json"
+      f="BENCH_LOCAL_r05_${mode}.json"
       if [ ! -f "$f" ] || [ -n "$(find "$f" -mmin +90)" ]; then
         capture "$mode"
       fi
