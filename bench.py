@@ -284,11 +284,18 @@ def serve_main() -> None:
                         for j in range(prompt_len)]
                        for i in range(n_req)]
             orch.benchmark(prompts[:2], max_new_tokens=2)
-            # Warm the FULL admission wave too: batched prefill
-            # compiles one variant per power-of-two batch size, and the
-            # measured run's first wave fills every slot — that compile
-            # must land here, not inside the timed window.
-            orch.benchmark(prompts[:slots], max_new_tokens=2)
+            # Warm EVERY admission-wave variant: batched prefill
+            # compiles one variant per power-of-two wave size (capped
+            # at max_slots), and as slots free mid-run the refill
+            # waves are odd-sized — any unwarmed variant would compile
+            # inside the timed window.
+            pow2 = 4
+            while True:
+                wave = min(pow2, slots)
+                orch.benchmark(prompts[:wave], max_new_tokens=2)
+                if wave == slots:
+                    break
+                pow2 *= 2
             break
         except Exception as e:  # pylint: disable=broad-except
             last_err = e

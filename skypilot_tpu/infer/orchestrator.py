@@ -193,6 +193,8 @@ class Orchestrator:
         (chunked path), and prefix-cached engines use the single path.
         """
         if not (self._batched_admit
+                and getattr(self.engine.config, 'batched_admission',
+                            True)
                 and getattr(self.engine, 'supports_batched_prefill',
                             False)):
             while self._admit_one():

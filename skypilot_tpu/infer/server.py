@@ -437,6 +437,14 @@ def main() -> int:
                              '(amortizes dispatch latency; streaming '
                              'granularity and EOS latency grow by the '
                              'same factor). 1 = per-token.')
+    parser.add_argument('--no-batched-admission', action='store_true',
+                        help='Per-prompt prefill admission. Batched '
+                             'admission (default) fuses a wave into '
+                             'one dispatch — right when dispatch '
+                             'latency dominates (remote TPU); disable '
+                             'on compute-bound deployments where '
+                             'prefill FLOPs dominate and pow2 wave '
+                             'padding wastes forward work.')
     parser.add_argument('--prefix-cache', type=int, default=0,
                         help='Prefix-cache entries (device-resident KV '
                              'reuse for shared prompt prefixes; entry '
@@ -470,7 +478,8 @@ def main() -> int:
         kv_dtype=jnp.int8 if args.kv_dtype == 'int8' else jnp.bfloat16,
         weight_dtype={'int8': jnp.int8, 'int4': 'int4',
                       'bf16': jnp.bfloat16}[args.weight_dtype],
-        prefix_cache_entries=prefix_entries)
+        prefix_cache_entries=prefix_entries,
+        batched_admission=not args.no_batched_admission)
     mesh = None
     if args.mesh:
         from skypilot_tpu.train.launch import parse_mesh
@@ -548,16 +557,25 @@ def main() -> int:
                                  presence_penalty=0.1,
                                  frequency_penalty=0.1))
     orch.run_until_drained()
-    # Full admission wave (batched prefill pads every wave to
-    # max_slots: one variant per bucket), both the greedy and the
-    # sampled trace signatures (top_k/top_p arrays vs None).
-    orch.generate([[1, 2, 3]] * engine.config.max_slots,
-                  max_new_tokens=2)
-    for _ in range(engine.config.max_slots):
-        orch.submit(orch_lib.Request(prompt_tokens=[1, 2, 3],
-                                     max_new_tokens=2, temperature=0.8,
-                                     top_k=5, top_p=0.9))
-    orch.run_until_drained()
+    # Admission waves: batched prefill compiles one variant per
+    # power-of-two wave size per bucket — warm every size (greedy and
+    # sampled trace signatures both), or the first odd-sized wave
+    # mid-serving stalls every active slot on an XLA compile.
+    pow2 = 2
+    while True:
+        # min() mirrors the engine's padding rule, so a non-pow2
+        # max_slots still gets its capped full-wave variant warmed.
+        wave = min(pow2, engine.config.max_slots)
+        orch.generate([[1, 2, 3]] * wave, max_new_tokens=2)
+        for _ in range(wave):
+            orch.submit(orch_lib.Request(prompt_tokens=[1, 2, 3],
+                                         max_new_tokens=2,
+                                         temperature=0.8,
+                                         top_k=5, top_p=0.9))
+        orch.run_until_drained()
+        if wave == engine.config.max_slots:
+            break
+        pow2 *= 2
     loop = ServingLoop(orch)
 
     from skypilot_tpu.infer import tokenizer as tokenizer_lib

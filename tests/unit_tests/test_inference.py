@@ -862,17 +862,76 @@ class TestBatchedAdmission:
         assert [r.output_tokens for r in reqs] == expected
         assert sorted(map(tuple, calls)) == [(3, 3), (20, 22)]
 
-    def test_wave_padding_to_pow2(self, tiny_engine):
-        """3 requests pad to 4 rows by repeating row 0 — outputs and
-        slot state must be unaffected by the duplicate scatter row."""
+    def test_wave_padding_to_pow2(self, tiny_engine, monkeypatch):
+        """3 requests pad to 4 rows (next pow2) by repeating row 0 —
+        outputs and slot state must be unaffected by the duplicate
+        scatter row, and the forward must see the pow2-padded batch,
+        not a full max_slots one."""
+        rows = []
+        orig = tiny_engine._prefill_batch
+
+        def spy(params, tokens, *args, **kwargs):
+            rows.append(tokens.shape[0])
+            return orig(params, tokens, *args, **kwargs)
+
+        monkeypatch.setattr(tiny_engine, '_prefill_batch', spy)
         prompts = [[1, 2, 3], [7, 8, 9, 10], [20, 21]]
         n_new = 5
         expected = [_reference_greedy(tiny_engine.params, p, n_new)
                     for p in prompts]
         orch = orch_lib.Orchestrator(tiny_engine)
         assert orch.generate(prompts, max_new_tokens=n_new) == expected
+        assert rows == [4]
         assert sorted(orch._free_slots) == list(
             range(tiny_engine.config.max_slots))
+
+    def test_small_wave_pads_to_pow2_not_max_slots(self, monkeypatch):
+        """A 2-request wave on a wide engine pays a 2-row forward, not
+        a max_slots-row one (advisor r4: full-slot padding was ~16x
+        the needed prefill FLOPs)."""
+        config = engine_lib.EngineConfig(
+            model=llama.LLAMA_TINY, max_slots=8, max_target_len=64,
+            prefill_buckets=(16,))
+        params = llama.init(llama.LLAMA_TINY, jax.random.PRNGKey(0))
+        engine = engine_lib.InferenceEngine(config, params)
+        rows = []
+        orig = engine._prefill_batch
+
+        def spy(p, tokens, *args, **kwargs):
+            rows.append(tokens.shape[0])
+            return orig(p, tokens, *args, **kwargs)
+
+        monkeypatch.setattr(engine, '_prefill_batch', spy)
+        n_new = 3
+        prompts = [[1, 2, 3], [7, 8, 9]]
+        expected = [_reference_greedy(params, p, n_new)
+                    for p in prompts]
+        orch = orch_lib.Orchestrator(engine)
+        assert orch.generate(prompts, max_new_tokens=n_new) == expected
+        assert rows == [2]
+
+    def test_batched_admission_knob_forces_single_path(self,
+                                                       monkeypatch):
+        """batched_admission=False routes every admission through the
+        per-prompt path (compute-bound deployments opt out of wave
+        fusion)."""
+        config = engine_lib.EngineConfig(
+            model=llama.LLAMA_TINY, max_slots=4, max_target_len=64,
+            prefill_buckets=(16,), batched_admission=False)
+        params = llama.init(llama.LLAMA_TINY, jax.random.PRNGKey(0))
+        engine = engine_lib.InferenceEngine(config, params)
+        calls = []
+        orig = engine.prefill_insert_batch
+        monkeypatch.setattr(
+            engine, 'prefill_insert_batch',
+            lambda s, a, sl: (calls.append(len(a)), orig(s, a, sl))[1])
+        n_new = 3
+        prompts = [[1, 2, 3], [7, 8, 9], [2, 4]]
+        expected = [_reference_greedy(params, p, n_new)
+                    for p in prompts]
+        orch = orch_lib.Orchestrator(engine)
+        assert orch.generate(prompts, max_new_tokens=n_new) == expected
+        assert calls == []
 
     def test_logprobs_requests_use_single_path(self, tiny_engine,
                                                monkeypatch):
