@@ -180,6 +180,14 @@ class RetryingProvisioner:
             provision_lib.wait_instances(provider, region,
                                          self._cluster_name, 'RUNNING',
                                          provider_config=provider_config)
+            if resources.ports:
+                # Expose user-requested ports (Resources(ports=…), serve
+                # endpoints) once the nodes exist — clouds whose module
+                # lacks open_ports have ports-open-by-default semantics
+                # (the feature gate rejected the rest upfront).
+                provision_lib.open_ports(provider, self._cluster_name,
+                                         resources.ports,
+                                         config.provider_config)
             info = provision_lib.get_cluster_info(provider, record.region,
                                                   self._cluster_name,
                                                   config.provider_config)

@@ -650,6 +650,12 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                 provision_lib.terminate_instances(
                     provider, handle.cluster_name,
                     handle.cluster_info.provider_config)
+                # Port rules are per-cluster resources (firewall
+                # allow-rules on the cluster tag): reap them with the
+                # instances. Best-effort — the provider logs failures.
+                provision_lib.cleanup_ports(
+                    provider, handle.cluster_name,
+                    handle.cluster_info.provider_config)
             else:
                 provision_lib.stop_instances(
                     provider, handle.cluster_name,

@@ -167,6 +167,7 @@ def reset() -> None:
                     shutil.rmtree(root, ignore_errors=True)
         data['clusters'] = {}
         data['provision_regions'] = {}
+        data['open_ports'] = {}
     injector.reset()
 
 
@@ -253,6 +254,28 @@ def terminate_instances(cluster_name: str,
             if root:
                 _kill_host_processes(root)
                 shutil.rmtree(root, ignore_errors=True)
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    """Record the request so e2e tests can assert the launch path
+    actually exposes Resources(ports=…) (real clouds create firewall
+    rules here)."""
+    with _store() as data:
+        opened = data.setdefault('open_ports', {})
+        have = set(opened.get(cluster_name, []))
+        opened[cluster_name] = sorted(have | {str(p) for p in ports})
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    with _store() as data:
+        data.setdefault('open_ports', {}).pop(cluster_name, None)
+
+
+def opened_ports(cluster_name: str) -> List[str]:
+    """Test helper: the ports open_ports recorded for the cluster."""
+    return list(_load().get('open_ports', {}).get(cluster_name, []))
 
 
 def query_instances(cluster_name: str, provider_config: Dict[str, Any]

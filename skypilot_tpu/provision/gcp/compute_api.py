@@ -12,7 +12,8 @@ from typing import Any, Dict, List, Optional
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
 from skypilot_tpu.provision.gcp import rest
-from skypilot_tpu.provision.gcp.tpu_api import CLUSTER_LABEL, HEAD_LABEL
+from skypilot_tpu.provision.gcp.tpu_api import (CLUSTER_LABEL, HEAD_LABEL,
+                                                cluster_tag)
 
 logger = sky_logging.init_logger(__name__)
 
@@ -117,6 +118,84 @@ class ComputeClient:
         raise exceptions.ProvisionError(
             f'Timed out waiting for compute operation {name}')
 
+    # ---- firewalls (global resources; ports exposure) ------------------
+
+    @property
+    def global_prefix(self) -> str:
+        return f'{BASE}/projects/{self.project}/global'
+
+    def get_firewall(self, name: str) -> Optional[Dict[str, Any]]:
+        try:
+            return self.t.request(
+                'GET', f'{self.global_prefix}/firewalls/{name}')
+        except rest.GcpApiError as e:
+            if e.status == 404:
+                return None
+            raise
+
+    def insert_firewall(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        return self.t.request('POST', f'{self.global_prefix}/firewalls',
+                              body=body)
+
+    def patch_firewall(self, name: str,
+                       body: Dict[str, Any]) -> Dict[str, Any]:
+        return self.t.request(
+            'PATCH', f'{self.global_prefix}/firewalls/{name}', body=body)
+
+    def delete_firewall(self, name: str) -> Dict[str, Any]:
+        return self.t.request(
+            'DELETE', f'{self.global_prefix}/firewalls/{name}')
+
+    def wait_global_operation(self, op: Dict[str, Any],
+                              timeout: float = 300.0,
+                              poll_interval: float = 2.0
+                              ) -> Dict[str, Any]:
+        """Firewalls are global resources; their operations live under
+        global/operations, not the zonal endpoint wait_operation polls."""
+        name = op.get('name')
+        if not name:
+            return op
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            cur = self.t.request(
+                'POST',
+                f'{self.global_prefix}/operations/{name}/wait')
+            if cur.get('status') == 'DONE':
+                errors = cur.get('error', {}).get('errors', [])
+                if errors:
+                    e = errors[0]
+                    api_err = rest.GcpApiError(
+                        409, e.get('code', ''), e.get('message', ''))
+                    raise rest.classify_error(api_err, self.zone)
+                return cur
+            time.sleep(poll_interval)
+        raise exceptions.ProvisionError(
+            f'Timed out waiting for global operation {name}')
+
+
+def firewall_rule_name(cluster_name: str) -> str:
+    return f'xsky-{cluster_name}-ports'[:63].rstrip('-')
+
+
+def firewall_body(cluster_name: str, ports: List[str],
+                  network: str) -> Dict[str, Any]:
+    """Ingress allow-rule for the cluster's user-requested ports.
+
+    `ports` entries are '80' or '4000-4100' strings (GCP's own
+    ports syntax matches Resources' canonical form).
+    """
+    return {
+        'name': firewall_rule_name(cluster_name),
+        'network': network,
+        'direction': 'INGRESS',
+        'allowed': [{
+            'IPProtocol': 'tcp',
+            'ports': [str(p) for p in ports],
+        }],
+        'sourceRanges': ['0.0.0.0/0'],
+        'targetTags': [cluster_tag(cluster_name)],
+    }
+
 
 def vm_body(node_config: Dict[str, Any], cluster_name: str, vm_name: str,
             zone: str, is_head: bool, node_index: int) -> Dict[str, Any]:
@@ -142,7 +221,7 @@ def vm_body(node_config: Dict[str, Any], cluster_name: str, vm_name: str,
             'accessConfigs': [{'name': 'External NAT',
                                'type': 'ONE_TO_ONE_NAT'}],
         }],
-        'tags': {'items': ['xsky']},
+        'tags': {'items': ['xsky', cluster_tag(cluster_name)]},
         'metadata': {'items': [
             {'key': k, 'value': v}
             for k, v in node_config.get('metadata', {}).items()

@@ -360,6 +360,59 @@ def terminate_instances(cluster_name: str,
     compute_api.delete_auto_delete_volumes(gce, cluster_name)
 
 
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    """Ingress firewall rule for the cluster's user-requested ports.
+
+    One rule per cluster (xsky-<cluster>-ports), targeting the
+    cluster's network tag so only its hosts are exposed. Re-opening
+    merges with any already-open ports (idempotent across relaunches
+    and serve replica scale-ups). Twin of the reference's allow-rule
+    bootstrap (sky/provision/gcp/config.py firewall section) without
+    the full VPC creation machinery — the instance's network is used
+    as-is.
+    """
+    zone = _zone_of(provider_config)
+    _, gce = _clients(provider_config, zone)
+    network = provider_config.get('network', 'global/networks/default')
+    body = compute_api.firewall_body(cluster_name, ports, network)
+    try:
+        existing = gce.get_firewall(body['name'])
+        if existing is None:
+            gce.wait_global_operation(gce.insert_firewall(body))
+            return
+        have = set()
+        for allowed in existing.get('allowed', []):
+            have.update(str(p) for p in allowed.get('ports', []))
+        want = {str(p) for p in ports}
+        if want <= have:
+            return
+        body['allowed'][0]['ports'] = sorted(have | want)
+        gce.wait_global_operation(
+            gce.patch_firewall(body['name'], body))
+    except rest.GcpApiError as e:
+        # Unexposed ports break the task the user asked for (serve
+        # endpoints, dashboards): fail loudly, never silently.
+        raise exceptions.ProvisionError(
+            f'Opening ports {ports} for {cluster_name!r} failed: '
+            f'{e}') from e
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    """Delete the cluster's port rule at teardown (best effort — a
+    leaked allow-rule targets a tag no instance carries anymore)."""
+    zone = _zone_of(provider_config)
+    _, gce = _clients(provider_config, zone)
+    name = compute_api.firewall_rule_name(cluster_name)
+    try:
+        op = gce.delete_firewall(name)
+        gce.wait_global_operation(op)
+    except rest.GcpApiError as e:
+        if e.status != 404:
+            logger.warning(f'cleanup_ports({cluster_name}): {e}')
+
+
 def query_instances(cluster_name: str, provider_config: Dict[str, Any]
                     ) -> Dict[str, Optional[str]]:
     zone = _zone_of(provider_config)

@@ -144,6 +144,15 @@ class TpuClient:
             f'Timed out waiting for TPU operation {name}')
 
 
+def cluster_tag(cluster_name: str) -> str:
+    """Per-cluster network tag: open_ports firewall rules target it,
+    so opened ports hit only this cluster's hosts (twin of the
+    reference's cluster-tag-scoped allow rules,
+    sky/provision/gcp/config.py). Network tags must be RFC1035
+    (lowercase, ≤63 chars)."""
+    return f'xsky-{cluster_name}'[:63].rstrip('-')
+
+
 def node_body(node_config: Dict[str, Any], cluster_name: str,
               is_head: bool, node_index: int) -> Dict[str, Any]:
     """Build a TPU node resource from deploy variables.
@@ -165,7 +174,9 @@ def node_body(node_config: Dict[str, Any], cluster_name: str,
                 node_config.get('enable_external_ips', True),
         },
         'metadata': dict(node_config.get('metadata', {})),
-        'tags': ['xsky'],
+        # Cluster tag scopes open_ports firewall rules to this
+        # cluster's hosts.
+        'tags': ['xsky', cluster_tag(cluster_name)],
     }
     network = node_config.get('network')
     subnetwork = node_config.get('subnetwork')
