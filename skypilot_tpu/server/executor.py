@@ -96,10 +96,35 @@ def _install_routers():
 LONG_REQUESTS = {'launch', 'exec', 'start', 'stop', 'down', 'jobs.launch',
                  'serve.up', 'serve.update', 'serve.down'}
 
+
+def long_slots() -> int:
+    return int(os.environ.get('XSKY_LONG_WORKERS', '8'))
+
+
+def long_request_timeout_s() -> float:
+    """Wall-clock budget for long requests; 0 disables (the default —
+    `launch --retry-until-up` legitimately runs for hours)."""
+    return float(os.environ.get('XSKY_LONG_REQUEST_TIMEOUT_S', '0'))
+
+
 _pools_lock = threading.Lock()
-_long_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
 _short_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
 _synchronous = False
+
+# Long-queue slot model (hardening; twin concern of the reference's
+# per-request worker PROCESSES, sky/server/requests/executor.py:131):
+# each long request runs on its own daemon thread gated by a slot
+# semaphore. Python threads cannot be killed, so when the watchdog
+# times a request out (or a client cancels a running one) it marks the
+# request terminal and RELEASES THE SLOT — the zombie thread lingers
+# harmlessly (its finish() is a no-op on a terminal row) while the
+# server regains admission capacity. A fixed ThreadPoolExecutor would
+# instead lose a worker to every hung request until restart.
+_long_lock = threading.Lock()
+_long_queue: 'Optional[Any]' = None
+_long_sema: Optional[threading.Semaphore] = None
+_long_running: Dict[str, Dict[str, Any]] = {}   # id → {started, released}
+_long_threads_started = False
 
 
 def set_synchronous_for_test(value: bool) -> None:
@@ -107,15 +132,111 @@ def set_synchronous_for_test(value: bool) -> None:
     _synchronous = value
 
 
-def _pools():
-    global _long_pool, _short_pool
+def _short():
+    global _short_pool
     with _pools_lock:
-        if _long_pool is None:
-            _long_pool = concurrent.futures.ThreadPoolExecutor(
-                max_workers=8, thread_name_prefix='xsky-long')
+        if _short_pool is None:
             _short_pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=16, thread_name_prefix='xsky-short')
-    return _long_pool, _short_pool
+    return _short_pool
+
+
+def _release_slot(request_id: str) -> None:
+    """Idempotent: the worker's finally and the watchdog can both call.
+    Releases the semaphore the request was admitted under (entries pin
+    their own semaphore so a test reset can't inflate a fresh one)."""
+    with _long_lock:
+        entry = _long_running.get(request_id)
+        if entry is None or entry['released']:
+            return
+        entry['released'] = True
+        _long_running.pop(request_id, None)
+    entry['sema'].release()
+
+
+def _long_worker(request_id: str, func, kwargs) -> None:
+    try:
+        _run_request(request_id, func, kwargs)
+    finally:
+        _release_slot(request_id)
+
+
+def _long_dispatcher(q, sema) -> None:
+    while True:
+        item = q.get()
+        if item is None:   # reset_for_test sentinel
+            return
+        request_id, func, kwargs = item
+        sema.acquire()
+        with _long_lock:
+            _long_running[request_id] = {'started': time.monotonic(),
+                                         'released': False,
+                                         'sema': sema}
+        threading.Thread(target=_long_worker,
+                         args=(request_id, func, kwargs),
+                         name=f'xsky-long-{request_id[:8]}',
+                         daemon=True).start()
+
+
+def _watchdog() -> None:
+    from skypilot_tpu.server import requests_db as rdb
+    while True:
+        time.sleep(float(os.environ.get('XSKY_WATCHDOG_INTERVAL_S', '2')))
+        budget = long_request_timeout_s()
+        with _long_lock:
+            snapshot = {rid: e['started']
+                        for rid, e in _long_running.items()
+                        if not e['released']}
+        for rid, started in snapshot.items():
+            record = rdb.get(rid)
+            if record is None or record['status'].is_terminal():
+                # Client cancelled (or row vanished): the thread may
+                # hang forever — reclaim its admission slot now.
+                _release_slot(rid)
+                continue
+            if budget > 0 and time.monotonic() - started > budget:
+                logger.warning(f'Request {rid} exceeded '
+                               f'{budget:.0f}s budget; failing it.')
+                rdb.finish(rid, error=exceptions.serialize_exception(
+                    TimeoutError(
+                        f'Request exceeded the server-side budget of '
+                        f'{budget:.0f}s (XSKY_LONG_REQUEST_TIMEOUT_S).')))
+                _release_slot(rid)
+
+
+_watchdog_started = False
+
+
+def _ensure_long_runtime() -> None:
+    global _long_queue, _long_sema, _long_threads_started
+    global _watchdog_started
+    with _pools_lock:
+        if _long_threads_started:
+            return
+        import queue as queue_lib
+        _long_queue = queue_lib.Queue()
+        _long_sema = threading.Semaphore(long_slots())
+        threading.Thread(target=_long_dispatcher,
+                         args=(_long_queue, _long_sema),
+                         name='xsky-long-disp', daemon=True).start()
+        if not _watchdog_started:
+            threading.Thread(target=_watchdog, name='xsky-watchdog',
+                             daemon=True).start()
+            _watchdog_started = True
+        _long_threads_started = True
+
+
+def reset_long_runtime_for_test() -> None:
+    """Detach the current long-queue generation (tests tune
+    XSKY_LONG_WORKERS / timeouts): the old dispatcher exits via
+    sentinel; in-flight entries keep their own semaphore reference."""
+    global _long_threads_started
+    with _pools_lock:
+        if _long_queue is not None:
+            _long_queue.put(None)
+        _long_threads_started = False
+    with _long_lock:
+        _long_running.clear()
 
 
 def _run_request(request_id: str, func: Callable[..., Any],
@@ -166,7 +287,9 @@ def schedule_request(name: str, user: str, body: Dict[str, Any],
         # Inline test mode: no routing — capsys/pytest own the streams.
         _run_request(request_id, func, kwargs, capture_output=False)
         return request_id
-    long_pool, short_pool = _pools()
-    pool = long_pool if name in LONG_REQUESTS else short_pool
-    pool.submit(_run_request, request_id, func, kwargs)
+    if name in LONG_REQUESTS:
+        _ensure_long_runtime()
+        _long_queue.put((request_id, func, kwargs))
+    else:
+        _short().submit(_run_request, request_id, func, kwargs)
     return request_id

@@ -382,3 +382,108 @@ class TestApiStartStop:
             assert not (server_rt / 'api.pid').exists()
         finally:
             victim.kill()
+
+
+class TestExecutorHardening:
+    """Long-queue slot model + watchdog (VERDICT r4 #6): a hung launch
+    must never block status reads, and cancelled/timed-out requests
+    give their admission slot back."""
+
+    @pytest.fixture
+    def hardened(self, monkeypatch, tmp_path):
+        from skypilot_tpu.server import executor
+        monkeypatch.setenv('XSKY_SERVER_DB', str(tmp_path / 'req.db'))
+        monkeypatch.setenv('XSKY_LONG_WORKERS', '2')
+        monkeypatch.setenv('XSKY_WATCHDOG_INTERVAL_S', '0.05')
+        requests_db.reset_for_test()
+        executor.reset_long_runtime_for_test()
+        yield executor
+        executor.reset_long_runtime_for_test()
+        requests_db.reset_for_test()
+
+    @staticmethod
+    def _wait(pred, timeout=10.0):
+        import time
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if pred():
+                return True
+            time.sleep(0.02)
+        return False
+
+    @staticmethod
+    def _hang(event):
+        def run():
+            event.wait(30)
+            return 'done'
+        return run
+
+    def test_hung_launches_never_block_status_reads(self, hardened):
+        import threading
+        release = threading.Event()
+        hung = [hardened.schedule_request(
+            'launch', 'u', {}, self._hang(release), {})
+            for _ in range(3)]   # 2 slots: third queues
+        # Short verbs ride their own pool: status stays responsive.
+        rid = hardened.schedule_request('status', 'u', {},
+                                        lambda: {'ok': True}, {})
+        assert self._wait(lambda: requests_db.get(rid)['status'] ==
+                          requests_db.RequestStatus.SUCCEEDED)
+        # The third long request is starved (both slots hung), the
+        # first two are RUNNING.
+        assert self._wait(lambda: [
+            requests_db.get(r)['status'].value for r in hung] ==
+            ['RUNNING', 'RUNNING', 'PENDING'])
+        release.set()
+        assert self._wait(lambda: all(
+            requests_db.get(r)['status'] ==
+            requests_db.RequestStatus.SUCCEEDED for r in hung))
+
+    def test_cancel_reclaims_hung_slot(self, hardened):
+        import threading
+        never = threading.Event()
+        hung = [hardened.schedule_request(
+            'launch', 'u', {}, self._hang(never), {})
+            for _ in range(2)]
+        queued = hardened.schedule_request('launch', 'u', {},
+                                           lambda: 'ran', {})
+        assert self._wait(lambda: requests_db.get(hung[0])['status'] ==
+                          requests_db.RequestStatus.RUNNING)
+        # Both slots hung: the queued request cannot start...
+        assert requests_db.get(queued)['status'] == \
+            requests_db.RequestStatus.PENDING
+        # ...until a cancel frees a slot via the watchdog.
+        assert requests_db.mark_cancelled(hung[0])
+        assert self._wait(lambda: requests_db.get(queued)['status'] ==
+                          requests_db.RequestStatus.SUCCEEDED)
+
+    def test_timeout_budget_fails_hung_request(self, hardened,
+                                               monkeypatch):
+        import threading
+        monkeypatch.setenv('XSKY_LONG_REQUEST_TIMEOUT_S', '0.2')
+        never = threading.Event()
+        rid = hardened.schedule_request('launch', 'u', {},
+                                        self._hang(never), {})
+        assert self._wait(lambda: requests_db.get(rid)['status'] ==
+                          requests_db.RequestStatus.FAILED)
+        assert 'budget' in requests_db.get(rid)['error']['message']
+        # The slot is back: a fresh request runs to completion.
+        rid2 = hardened.schedule_request('launch', 'u', {},
+                                         lambda: 'ran', {})
+        assert self._wait(lambda: requests_db.get(rid2)['status'] ==
+                          requests_db.RequestStatus.SUCCEEDED)
+
+    def test_zombie_completion_cannot_overwrite_timeout(self, hardened,
+                                                        monkeypatch):
+        import threading
+        monkeypatch.setenv('XSKY_LONG_REQUEST_TIMEOUT_S', '0.2')
+        release = threading.Event()
+        rid = hardened.schedule_request('launch', 'u', {},
+                                        self._hang(release), {})
+        assert self._wait(lambda: requests_db.get(rid)['status'] ==
+                          requests_db.RequestStatus.FAILED)
+        release.set()   # zombie thread finishes late
+        import time
+        time.sleep(0.3)
+        assert requests_db.get(rid)['status'] == \
+            requests_db.RequestStatus.FAILED

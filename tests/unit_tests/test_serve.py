@@ -214,6 +214,56 @@ class TestAutoscaler:
         scaler._request_timestamps.clear()
         assert scaler.evaluate(4).target_num_replicas == 4
 
+    def test_bursty_trace_no_flapping(self, monkeypatch):
+        """Replay a bursty QPS trace (VERDICT r4 #5): short bursts and
+        dips inside the hysteresis windows must never move the target;
+        sustained load must, exactly once per sustained shift."""
+        clock = {'now': 1_000_000.0}
+        monkeypatch.setattr(autoscalers_lib.time, 'time',
+                            lambda: clock['now'])
+        # A 10 s burst keeps the 60 s averaging window elevated for up
+        # to ~70 s, so "short burst" means shorter than window +
+        # upscale delay — delays are sized accordingly.
+        scaler = autoscalers_lib.RequestRateAutoscaler(
+            self._spec(upscale_delay_seconds=90,
+                       downscale_delay_seconds=150))
+        targets = []
+
+        def tick(qps, seconds):
+            # One evaluate per second, like the controller loop.
+            for _ in range(int(seconds)):
+                clock['now'] += 1.0
+                scaler.collect_request_information(qps, 0)
+                targets.append(
+                    scaler.evaluate(1).target_num_replicas)
+
+        # Phase 1: 10 s bursts to 20 qps with long quiet gaps — the
+        # window drains each burst before the 90 s delay elapses, so
+        # the target must never leave 1.
+        for _ in range(3):
+            tick(20, 10)
+            tick(0, 110)
+        assert set(targets) == {1}, 'short bursts must not flap up'
+        # Phase 2: sustained 4 qps → exactly one upscale to 4.
+        tick(4, 200)
+        assert targets[-1] == 4
+        assert sorted(set(targets)) == [1, 4], \
+            'exactly one upward move under sustained load'
+        # Phase 3: 60 s dips to 1 qps interleaved with recoveries —
+        # each below-target spell stays under the 150 s downscale
+        # delay, so the target must hold at 4.
+        before = len(targets)
+        for _ in range(3):
+            tick(1, 60)
+            tick(4, 60)
+        assert set(targets[before:]) == {4}, \
+            'dips shorter than downscale delay must not flap down'
+        # Phase 4: sustained quiet (window drains) → one downscale.
+        tick(0, 300)
+        assert targets[-1] == 1
+        assert sorted(set(targets[before:])) == [1, 4], \
+            'exactly one downward move under sustained quiet'
+
     def test_fixed_when_no_target_qps(self):
         spec = spec_lib.SkyServiceSpec(min_replicas=2)
         scaler = autoscalers_lib.make_autoscaler(spec)
