@@ -755,13 +755,17 @@ def api_login(endpoint, token, oauth):
                 flow['verification_uri']
             click.echo(f'To log in, visit: {uri}')
             click.echo(f'and enter code: {flow["user_code"]}')
-            token = oauth_lib.poll_for_token(
+            tokens = oauth_lib.poll_for_tokens(
                 flow['device_code'],
                 interval=float(flow.get('interval', 5)),
                 timeout=float(flow.get('expires_in', 600)))
+            token = tokens['access_token']
+            refresh_token = tokens.get('refresh_token')
         except oauth_lib.OAuthError as e:
             raise click.ClickException(str(e)) from e
         click.echo('Device login approved.')
+    else:
+        refresh_token = None
     # Probe before persisting: a typo'd endpoint should fail HERE.
     try:
         client = remote_client.RemoteClient(endpoint, token=token)
@@ -783,6 +787,10 @@ def api_login(endpoint, token, oauth):
     section['endpoint'] = endpoint
     if token:
         section['token'] = token
+    if refresh_token:
+        # The client renews expired access tokens with this instead of
+        # forcing a fresh device login (remote_client 401 handling).
+        section['refresh_token'] = refresh_token
     # 0600: the file now carries a Bearer token.
     fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
     with os.fdopen(fd, 'w', encoding='utf-8') as f:

@@ -36,19 +36,53 @@ class RemoteClient:
 
     # ---- request plumbing ----
 
-    def _submit(self, verb: str, body: Dict[str, Any]) -> str:
+    def _request(self, method: str, url: str, **kwargs):
+        """One HTTP call, with a single OAuth refresh retry on 401:
+        access tokens are short-lived (~1h), the stored refresh token
+        renews them without another device login."""
         try:
-            resp = self._client.post(f'/api/{verb}', json=body)
+            resp = getattr(self._client, method)(url, **kwargs)
         except Exception as e:
             raise exceptions.ApiServerConnectionError(self.endpoint) from e
+        if resp.status_code == 401 and self._try_oauth_refresh():
+            try:
+                resp = getattr(self._client, method)(url, **kwargs)
+            except Exception as e:
+                raise exceptions.ApiServerConnectionError(
+                    self.endpoint) from e
+        return resp
+
+    def _try_oauth_refresh(self) -> bool:
+        """Renew the bearer token via the stored OAuth refresh token.
+        One attempt per client instance; persists the rotated tokens."""
+        if getattr(self, '_refresh_attempted', False):
+            return False
+        self._refresh_attempted = True
+        from skypilot_tpu import config as config_lib
+        from skypilot_tpu.users import oauth as oauth_lib
+        refresh_token = config_lib.get_nested(
+            ('api_server', 'refresh_token'))
+        if not refresh_token or not oauth_lib.enabled():
+            return False
+        try:
+            tokens = oauth_lib.refresh_access_token(refresh_token)
+        except oauth_lib.OAuthError:
+            return False
+        access = tokens['access_token']
+        self._client.headers['Authorization'] = f'Bearer {access}'
+        _persist_tokens(access, tokens.get('refresh_token'))
+        return True
+
+    def _submit(self, verb: str, body: Dict[str, Any]) -> str:
+        resp = self._request('post', f'/api/{verb}', json=body)
         resp.raise_for_status()
         return resp.json()['request_id']
 
     def _get(self, request_id: str) -> Any:
         deadline = time.time() + self.timeout_s
         while time.time() < deadline:
-            resp = self._client.get('/api/get',
-                                    params={'request_id': request_id})
+            resp = self._request('get', '/api/get',
+                                 params={'request_id': request_id})
             resp.raise_for_status()
             payload = resp.json()
             if payload['status'] in ('PENDING', 'RUNNING'):
@@ -67,8 +101,8 @@ class RemoteClient:
     # ---- request management (xsky api status/logs/cancel) ----
 
     def list_api_requests(self, limit: int = 30):
-        resp = self._client.get('/api/requests',
-                                params={'limit': limit})
+        resp = self._request('get', '/api/requests',
+                             params={'limit': limit})
         resp.raise_for_status()
         return resp.json().get('requests', [])[:limit]
 
@@ -78,22 +112,22 @@ class RemoteClient:
         params = {'request_id': request_id}
         if include_log:
             params['include_log'] = '1'
-        resp = self._client.get('/api/get', params=params)
+        resp = self._request('get', '/api/get', params=params)
         if resp.status_code == 404:
             return None
         resp.raise_for_status()
         return resp.json()
 
     def cancel_api_request(self, request_id: str) -> bool:
-        resp = self._client.post('/api/requests/cancel',
-                                 json={'request_id': request_id})
+        resp = self._request('post', '/api/requests/cancel',
+                             json={'request_id': request_id})
         resp.raise_for_status()
         return bool(resp.json().get('cancelled'))
 
     def health(self) -> Dict[str, Any]:
         """GET /health — status/version/user (backs `xsky api info`)."""
+        resp = self._request('get', '/health')
         try:
-            resp = self._client.get('/health')
             resp.raise_for_status()
         except Exception as e:
             raise exceptions.ApiServerConnectionError(self.endpoint) from e
@@ -275,3 +309,34 @@ class _HandleProxy:
 
 def _clean(kwargs: Dict[str, Any]) -> Dict[str, Any]:
     return {k: v for k, v in kwargs.items() if v is not None}
+
+
+def _persist_tokens(access_token: str,
+                    refresh_token: Optional[str] = None) -> None:
+    """Write renewed OAuth tokens back to the user config (the same
+    api_server section `xsky api login` fills), so the next process
+    starts with the fresh access token. Best-effort: a read-only
+    config just means another refresh next run."""
+    import os
+
+    import yaml
+
+    from skypilot_tpu import config as config_lib
+    path = os.path.expanduser(
+        os.environ.get(config_lib.ENV_VAR_USER_CONFIG,
+                       config_lib.USER_CONFIG_PATH))
+    try:
+        doc = {}
+        if os.path.exists(path):
+            with open(path, encoding='utf-8') as f:
+                doc = yaml.safe_load(f) or {}
+        section = doc.setdefault('api_server', {})
+        section['token'] = access_token
+        if refresh_token:
+            section['refresh_token'] = refresh_token
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, 'w', encoding='utf-8') as f:
+            yaml.safe_dump(doc, f)
+        config_lib.reload_config()
+    except OSError:
+        pass

@@ -262,6 +262,16 @@ class S3ObjectClient:
     def bucket_exists(self, bucket: str) -> bool:
         status, _ = self._call('HEAD', f'/{bucket}',
                                ok_codes=(404, 403, 301))
+        if status == 403:
+            # On S3, HEAD 403 means the bucket EXISTS but is owned by
+            # someone else (or the caller lacks s3:ListBucket) —
+            # reporting it missing would send exists()->create() flows
+            # into a confusing BucketAlreadyExists instead of a
+            # permission error (advisor r4).
+            raise PermissionError(
+                f'Bucket {bucket!r} exists but is not accessible with '
+                'the current credentials (HEAD returned 403 — likely '
+                'owned by another account).')
         return status == 200
 
     def create_bucket(self, bucket: str) -> None:

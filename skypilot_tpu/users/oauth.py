@@ -98,11 +98,14 @@ def start_device_flow(opener: Optional[Opener] = None) -> Dict[str, Any]:
     return out
 
 
-def poll_for_token(device_code: str, interval: float = 5.0,
-                   timeout: float = 600.0,
-                   opener: Optional[Opener] = None,
-                   sleep=time.sleep) -> str:
-    """RFC 8628 step 2: poll until the user approves → access token."""
+def poll_for_tokens(device_code: str, interval: float = 5.0,
+                    timeout: float = 600.0,
+                    opener: Optional[Opener] = None,
+                    sleep=time.sleep) -> Dict[str, Any]:
+    """RFC 8628 step 2: poll until the user approves → the full token
+    response ({access_token, refresh_token?, expires_in?, ...}) —
+    callers should keep refresh_token so the (typically ~1h) access
+    token can be renewed without a fresh device login."""
     fields = {
         'client_id': os.environ.get('XSKY_OAUTH_CLIENT_ID', ''),
         'device_code': device_code,
@@ -116,7 +119,7 @@ def poll_for_token(device_code: str, interval: float = 5.0,
         out = _post_form(_endpoint('TOKEN', '/oauth/token'), fields,
                          opener)
         if 'access_token' in out:
-            return out['access_token']
+            return out
         error = out.get('error', 'unknown')
         if error == 'authorization_pending':
             sleep(interval)
@@ -130,6 +133,39 @@ def poll_for_token(device_code: str, interval: float = 5.0,
     raise OAuthError('device login timed out (user never approved)')
 
 
+def poll_for_token(device_code: str, interval: float = 5.0,
+                   timeout: float = 600.0,
+                   opener: Optional[Opener] = None,
+                   sleep=time.sleep) -> str:
+    """poll_for_tokens, returning just the access token."""
+    return poll_for_tokens(device_code, interval, timeout, opener,
+                           sleep)['access_token']
+
+
+def refresh_access_token(refresh_token: str,
+                         opener: Optional[Opener] = None
+                         ) -> Dict[str, Any]:
+    """refresh_token grant → new token response ({access_token,
+    refresh_token?}). Raises OAuthError when the IdP declines (revoked
+    or expired refresh token → the user must device-login again)."""
+    if not enabled():
+        raise OAuthError('OAuth is not configured.')
+    fields = {
+        'client_id': os.environ.get('XSKY_OAUTH_CLIENT_ID', ''),
+        'grant_type': 'refresh_token',
+        'refresh_token': refresh_token,
+    }
+    secret = os.environ.get('XSKY_OAUTH_CLIENT_SECRET')
+    if secret:
+        fields['client_secret'] = secret
+    out = _post_form(_endpoint('TOKEN', '/oauth/token'), fields, opener)
+    if 'access_token' not in out:
+        raise OAuthError(
+            f'token refresh failed: {out.get("error", "unknown")} '
+            f'({out.get("error_description", "")})')
+    return out
+
+
 # -- server side: access-token validation -----------------------------------
 
 #: token → (userinfo|None, expiry). Userinfo calls are network round
@@ -137,10 +173,18 @@ def poll_for_token(device_code: str, interval: float = 5.0,
 #: IdP. Rejections are cached too (shorter TTL) — otherwise a client
 #: looping on an expired token ties a handler thread to a 30 s IdP
 #: round-trip per request.
+#:
+#: SECURITY TRADE-OFF: a token the IdP revokes keeps working here for
+#: up to the positive TTL (default 300 s). Deployments needing faster
+#: revocation can shrink XSKY_OAUTH_USERINFO_TTL_S at the cost of more
+#: IdP round trips (0 disables caching entirely).
 _USERINFO_CACHE: Dict[str, Any] = {}
-_USERINFO_TTL_S = 300.0
 _NEGATIVE_TTL_S = 30.0
 _CACHE_MAX_ENTRIES = 4096
+
+
+def _positive_ttl_s() -> float:
+    return float(os.environ.get('XSKY_OAUTH_USERINFO_TTL_S', '300'))
 
 
 def _cache_put(token: str, entry) -> None:
@@ -191,7 +235,7 @@ def validate_access_token(token: str,
                    (None, time.monotonic() + _NEGATIVE_TTL_S))
         return None
     info = dict(info, name=name)
-    _cache_put(token, (info, time.monotonic() + _USERINFO_TTL_S))
+    _cache_put(token, (info, time.monotonic() + _positive_ttl_s()))
     return info
 
 

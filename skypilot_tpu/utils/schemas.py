@@ -146,7 +146,8 @@ CONFIG_SCHEMA: Dict[str, Any] = {
         'admin_policy': _STR,
         'api_server': {
             'type': 'object', 'additionalProperties': False,
-            'properties': {'endpoint': _STR, 'token': _STR}},
+            'properties': {'endpoint': _STR, 'token': _STR,
+                           'refresh_token': _STR}},
         'gcp': {
             'type': 'object', 'additionalProperties': False,
             'properties': {'project_id': _STR,

@@ -48,11 +48,20 @@ class _FakeIdP:
                           'verification_uri': 'https://idp/activate',
                           'interval': 0, 'expires_in': 60})
         if '/oauth/token' in url:
+            fields = urllib.parse.parse_qs(
+                (req.data or b'').decode())
+            if fields.get('grant_type') == ['refresh_token']:
+                if fields.get('refresh_token') == ['rt_good']:
+                    return _resp({'access_token': 'oat_refreshed',
+                                  'refresh_token': 'rt_rotated',
+                                  'token_type': 'Bearer'})
+                raise _http_error(url, 400, {'error': 'invalid_grant'})
             if self.pending_polls > 0:
                 self.pending_polls -= 1
                 raise _http_error(url, 400, {
                     'error': 'authorization_pending'})
             return _resp({'access_token': 'oat_good',
+                          'refresh_token': 'rt_good',
                           'token_type': 'Bearer'})
         if '/userinfo' in url:
             token = dict(req.header_items()).get(
@@ -125,6 +134,22 @@ class TestDeviceFlow:
         assert not oauth_lib.enabled()
         with pytest.raises(oauth_lib.OAuthError):
             oauth_lib.start_device_flow()
+
+    def test_device_flow_returns_refresh_token(self, idp):
+        idp.pending_polls = 0
+        flow = oauth_lib.start_device_flow(opener=idp)
+        tokens = oauth_lib.poll_for_tokens(flow['device_code'],
+                                           interval=0, opener=idp,
+                                           sleep=lambda s: None)
+        assert tokens['access_token'] == 'oat_good'
+        assert tokens['refresh_token'] == 'rt_good'
+
+    def test_refresh_access_token(self, idp):
+        tokens = oauth_lib.refresh_access_token('rt_good', opener=idp)
+        assert tokens['access_token'] == 'oat_refreshed'
+        assert tokens['refresh_token'] == 'rt_rotated'
+        with pytest.raises(oauth_lib.OAuthError, match='invalid_grant'):
+            oauth_lib.refresh_access_token('rt_revoked', opener=idp)
 
 
 class TestOAuthBearer:
@@ -297,6 +322,44 @@ class TestWorkspaceAuthz:
                         {'workspace': 'team-a', 'user_name': 'outsider'},
                         user='root', password='rootpw')
         assert code == 200
+
+
+class TestClientAutoRefresh:
+
+    def test_client_refreshes_expired_token_on_401(
+            self, authz_server, idp, monkeypatch, tmp_path):
+        """A 401 (expired access token) triggers one refresh-token
+        grant, a retry with the new bearer, and persists the rotated
+        tokens — no fresh device login (advisor r4)."""
+        import yaml
+
+        from skypilot_tpu import config as config_lib
+        from skypilot_tpu.client import remote_client
+        # A real server-side token the refresh will rotate onto.
+        from skypilot_tpu.users import core as users_core
+        good = users_core.create_token('member', label='cli')['token']
+        cfg = tmp_path / 'cfg.yaml'
+        cfg.write_text(yaml.safe_dump({'api_server': {
+            'endpoint': authz_server, 'token': 'oat_expired',
+            'refresh_token': 'rt_good'}}))
+        monkeypatch.setenv('XSKY_CONFIG', str(cfg))
+        config_lib.reload_config()
+        monkeypatch.setattr(
+            oauth_lib, 'refresh_access_token',
+            lambda rt, opener=None: {'access_token': good,
+                                     'refresh_token': 'rt_rotated'}
+            if rt == 'rt_good' else (_ for _ in ()).throw(
+                oauth_lib.OAuthError('invalid_grant')))
+        client = remote_client.RemoteClient(authz_server,
+                                            poll_interval_s=0.05,
+                                            timeout_s=30)
+        assert client.list_api_requests(limit=1) is not None
+        # The protected verb path succeeds after the refresh retry.
+        client.status()
+        saved = yaml.safe_load(cfg.read_text())['api_server']
+        assert saved['token'] == good
+        assert saved['refresh_token'] == 'rt_rotated'
+        config_lib.reload_config()
 
 
 class TestJobsServeWorkspaceAuthz:

@@ -120,6 +120,18 @@ def test_s3_bucket_lifecycle():
         ['HEAD', 'PUT', 'HEAD']
 
 
+def test_s3_bucket_exists_403_is_permission_error():
+    """HEAD 403 means the bucket exists under another account — not
+    'missing' (advisor r4: exists()->create() would hit a confusing
+    BucketAlreadyExists instead of a permission error)."""
+    opener = _FakeOpener()
+    client = object_rest.S3ObjectClient(region='us-east-1', creds=CREDS,
+                                        opener=opener)
+    opener.push_error(403)
+    with pytest.raises(PermissionError, match='not accessible'):
+        client.bucket_exists('taken-name')
+
+
 def test_s3_create_bucket_location_constraint():
     opener = _FakeOpener()
     client = object_rest.S3ObjectClient(region='eu-west-1', creds=CREDS,
