@@ -738,8 +738,6 @@ def api_login(endpoint, token, oauth):
     """Point this client at a remote API server (twin of `sky api
     login`): persists api_server.endpoint (and token) in the user
     config, so every verb talks to it from now on."""
-    import yaml
-
     from skypilot_tpu import config as config_lib
     from skypilot_tpu.client import remote_client
     if not endpoint.startswith(('http://', 'https://')):
@@ -777,25 +775,20 @@ def api_login(endpoint, token, oauth):
     path = os.path.expanduser(
         os.environ.get(config_lib.ENV_VAR_USER_CONFIG,
                        config_lib.USER_CONFIG_PATH))
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    doc = {}
     had_file = os.path.exists(path)
-    if had_file:
-        with open(path, encoding='utf-8') as f:
-            doc = yaml.safe_load(f) or {}
-    section = doc.setdefault('api_server', {})
-    section['endpoint'] = endpoint
+    updates = {'endpoint': endpoint}
     if token:
-        section['token'] = token
+        updates['token'] = token
     if refresh_token:
         # The client renews expired access tokens with this instead of
         # forcing a fresh device login (remote_client 401 handling).
-        section['refresh_token'] = refresh_token
-    # 0600: the file now carries a Bearer token.
-    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
-    with os.fdopen(fd, 'w', encoding='utf-8') as f:
-        yaml.safe_dump(doc, f)
-    os.chmod(path, 0o600)
+        updates['refresh_token'] = refresh_token
+    config_lib.update_user_config_section(
+        'api_server', updates,
+        # Static-token (or token-less) re-login: a stale OAuth refresh
+        # token would silently rotate auth back to the previous OAuth
+        # identity on the next 401.
+        remove=() if refresh_token else ('refresh_token',))
     click.echo(f'Logged in to {endpoint} (config: {path}).')
     if had_file:
         click.echo('Note: the config file was rewritten as plain YAML '

@@ -54,19 +54,25 @@ class RemoteClient:
 
     def _try_oauth_refresh(self) -> bool:
         """Renew the bearer token via the stored OAuth refresh token.
-        One attempt per client instance; persists the rotated tokens."""
-        if getattr(self, '_refresh_attempted', False):
+
+        Rate-limited, not latched: a successful refresh re-arms the
+        retry (long poll loops outlive a single ~1h access token), but
+        a failed one blocks further attempts for this client so a
+        revoked refresh token can't hammer the IdP on every 401.
+        """
+        if getattr(self, '_refresh_blocked', False):
             return False
-        self._refresh_attempted = True
         from skypilot_tpu import config as config_lib
         from skypilot_tpu.users import oauth as oauth_lib
         refresh_token = config_lib.get_nested(
             ('api_server', 'refresh_token'))
         if not refresh_token or not oauth_lib.enabled():
+            self._refresh_blocked = True
             return False
         try:
             tokens = oauth_lib.refresh_access_token(refresh_token)
         except oauth_lib.OAuthError:
+            self._refresh_blocked = True
             return False
         access = tokens['access_token']
         self._client.headers['Authorization'] = f'Bearer {access}'
@@ -317,26 +323,16 @@ def _persist_tokens(access_token: str,
     api_server section `xsky api login` fills), so the next process
     starts with the fresh access token. Best-effort: a read-only
     config just means another refresh next run."""
-    import os
-
     import yaml
 
     from skypilot_tpu import config as config_lib
-    path = os.path.expanduser(
-        os.environ.get(config_lib.ENV_VAR_USER_CONFIG,
-                       config_lib.USER_CONFIG_PATH))
+    updates = {'token': access_token}
+    if refresh_token:
+        updates['refresh_token'] = refresh_token
     try:
-        doc = {}
-        if os.path.exists(path):
-            with open(path, encoding='utf-8') as f:
-                doc = yaml.safe_load(f) or {}
-        section = doc.setdefault('api_server', {})
-        section['token'] = access_token
-        if refresh_token:
-            section['refresh_token'] = refresh_token
-        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
-        with os.fdopen(fd, 'w', encoding='utf-8') as f:
-            yaml.safe_dump(doc, f)
-        config_lib.reload_config()
-    except OSError:
+        config_lib.update_user_config_section('api_server', updates)
+    except (OSError, yaml.YAMLError):
+        # Best-effort by contract: an unwritable or corrupted config
+        # just means another refresh next run — never fail the request
+        # the refresh already unblocked.
         pass

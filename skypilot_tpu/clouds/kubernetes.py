@@ -208,10 +208,19 @@ class Kubernetes(cloud_lib.Cloud):
         # Contexts are this cloud's "regions": lifecycle ops must target
         # the same kubectl context/namespace run_instances used, or
         # wait/terminate look at the wrong cluster entirely.
-        return {
+        overrides = {
             'context': node_config.get('context'),
             'namespace': node_config.get('namespace', 'default'),
         }
+        # User-config knobs ride provider_config into every lifecycle
+        # op (config.yaml `kubernetes:` section — twin of the
+        # reference's kubernetes.networking_mode).
+        from skypilot_tpu import config as config_lib
+        for key in ('networking_mode', 'fuse_proxy_image'):
+            value = config_lib.get_nested(('kubernetes', key))
+            if value:
+                overrides[key] = value
+        return overrides
 
     # ---- credentials ----
 

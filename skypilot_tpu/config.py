@@ -78,6 +78,31 @@ def _layer_paths() -> List[str]:
     ]
 
 
+def update_user_config_section(section: str, updates: Dict[str, Any],
+                               remove: Tuple[str, ...] = ()) -> None:
+    """Read-modify-write one section of the user config file (0600 —
+    it can carry bearer tokens). Shared by `xsky api login` and the
+    client's OAuth refresh persistence so the atomic-write details
+    cannot drift. Raises OSError/yaml.YAMLError to the caller (login
+    wants loud failure; token refresh treats it best-effort)."""
+    path = os.path.expanduser(
+        os.environ.get(ENV_VAR_USER_CONFIG, USER_CONFIG_PATH))
+    doc: Dict[str, Any] = {}
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            doc = yaml.safe_load(f) or {}
+    target = doc.setdefault(section, {})
+    target.update(updates)
+    for key in remove:
+        target.pop(key, None)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, 'w', encoding='utf-8') as f:
+        yaml.safe_dump(doc, f)
+    os.chmod(path, 0o600)
+    reload_config()
+
+
 def reload_config() -> None:
     global _base_config, _loaded
     with _lock:

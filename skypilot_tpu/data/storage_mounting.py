@@ -20,9 +20,24 @@ def mount_storage_on_cluster(handle: Any,
                              storage_mounts: Dict[str, Any]) -> None:
     """Run each storage mount's realize command on all hosts."""
     runners = handle.get_command_runners()
+    storages = []
     for mount_path, storage in storage_mounts.items():
         if not isinstance(storage, storage_lib.Storage):
             storage = storage_lib.Storage.from_yaml_config(dict(storage))
+        storages.append((mount_path, storage))
+    # Unprivileged pods need the per-node fusermount broker before any
+    # FUSE mount command runs (addons/fuse-proxy; twin of the
+    # reference's fusermount-server DaemonSet deploy).
+    if (getattr(handle.cluster_info, 'provider_name', None) ==
+            'kubernetes' and
+            any(s.mode in (storage_lib.StorageMode.MOUNT,
+                           storage_lib.StorageMode.MOUNT_CACHED)
+                for _, s in storages)):
+        from skypilot_tpu.provision.kubernetes import (
+            instance as k8s_instance)
+        k8s_instance.deploy_fuse_proxy(
+            handle.cluster_info.provider_config or {})
+    for mount_path, storage in storages:
         cmd = storage.cluster_command(mount_path)
         logger.info(f'Mounting {storage.name} at {mount_path} '
                     f'({storage.mode.value}) on {len(runners)} host(s)')

@@ -1,9 +1,10 @@
 """Kubernetes provisioner: pods as hosts, GKE TPU podslices native.
 
 Twin of sky/provision/kubernetes/instance.py (~6k LoC with utils),
-rebuilt lean: every op drives `kubectl` with JSON in/out through
-:func:`_run_kubectl` (tests monkeypatch that one function, so the whole
-op-set is unit-testable without a cluster — the moto pattern).
+rebuilt lean on the zero-dep kube API client (rest.py) — no kubectl in
+the control plane. Tests inject a recorded-response transport via
+:func:`set_transport_factory` (same moto-style pattern as the GCP
+provisioner).
 
 TPU-first design:
   * One *host* = one pod. A `tpu-v6e-16` request becomes
@@ -16,17 +17,21 @@ TPU-first design:
     the reference.
   * Pods cannot stop; stop_instances raises, matching multi-host TPU-VM
     semantics so autostop falls back to teardown uniformly.
+  * Networking modes (twin of the reference's
+    kubernetes.networking_mode): `nodeport` (default) exposes
+    user-requested ports as a NodePort service on the head pod;
+    `portforward` skips service creation — access rides the client-side
+    tunnel (kubectl port-forward data plane), nothing to provision.
 """
 from __future__ import annotations
 
-import json
-import subprocess
 import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
 from skypilot_tpu.provision import common
+from skypilot_tpu.provision.kubernetes import rest
 
 logger = sky_logging.init_logger(__name__)
 
@@ -38,33 +43,24 @@ SLICE_LABEL = 'xsky-slice'
 _WAIT_TIMEOUT_S = 600.0
 _POLL_INTERVAL_S = 2.0
 
+# Pluggable transport for tests (recorded-response fake API).
+_transport_factory = rest.KubeTransport
 
-def _run_kubectl(args: List[str], context: Optional[str] = None,
-                 namespace: Optional[str] = None,
-                 input_data: Optional[str] = None,
-                 timeout: float = 60.0) -> str:
-    """Run kubectl, return stdout; raises ProvisionError on failure.
 
-    The single chokepoint for cluster access — unit tests monkeypatch
-    this with an in-memory pod store.
-    """
-    cmd = ['kubectl']
-    if context:
-        cmd += ['--context', context]
-    if namespace:
-        cmd += ['-n', namespace]
-    cmd += args
+def set_transport_factory(factory) -> None:
+    global _transport_factory
+    _transport_factory = factory
+
+
+def _client(context: Optional[str], namespace: str) -> rest.KubeClient:
     try:
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              input=input_data, timeout=timeout,
-                              check=False)
-    except (OSError, subprocess.TimeoutExpired) as e:
-        raise exceptions.ProvisionError(f'kubectl failed: {e}') from e
-    if proc.returncode != 0:
-        raise exceptions.ProvisionError(
-            f'kubectl {" ".join(args[:3])}... failed: '
-            f'{proc.stderr.strip()[:500]}')
-    return proc.stdout
+        return rest.KubeClient(_transport_factory(context), namespace)
+    except ValueError as e:
+        raise exceptions.ProvisionError(str(e)) from e
+
+
+def _wrap_api_error(e: rest.KubeApiError) -> exceptions.ProvisionError:
+    return exceptions.ProvisionError(f'Kubernetes API: {e}')
 
 
 def _pod_name(cluster_name: str, index: int) -> str:
@@ -169,24 +165,23 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
     hosts_per_slice = int(node.get('tpu_num_hosts', 1)) if \
         node.get('tpu_podslice') else 1
 
-    existing = _list_pods(cluster_name, context, namespace)
-    created: List[str] = []
-    manifests: List[Dict[str, Any]] = [_build_service_manifest(cluster_name)]
-    for i in range(total):
-        name = _pod_name(cluster_name, i)
-        if name in existing:
-            continue
-        manifests.append(
-            _build_pod_manifest(cluster_name, i,
-                                slice_index=i // hosts_per_slice,
-                                host_index=i % hosts_per_slice,
-                                node_config=node))
-        created.append(name)
-    if manifests:
-        payload = json.dumps({'apiVersion': 'v1', 'kind': 'List',
-                              'items': manifests})
-        _run_kubectl(['apply', '-f', '-'], context, namespace,
-                     input_data=payload)
+    client = _client(context, namespace)
+    try:
+        existing = _list_pods(client, cluster_name)
+        created: List[str] = []
+        client.apply(_build_service_manifest(cluster_name))
+        for i in range(total):
+            name = _pod_name(cluster_name, i)
+            if name in existing:
+                continue
+            client.apply(
+                _build_pod_manifest(cluster_name, i,
+                                    slice_index=i // hosts_per_slice,
+                                    host_index=i % hosts_per_slice,
+                                    node_config=node))
+            created.append(name)
+    except rest.KubeApiError as e:
+        raise _wrap_api_error(e) from e
     return common.ProvisionRecord(
         provider_name='kubernetes',
         cluster_name=cluster_name,
@@ -198,12 +193,9 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
     )
 
 
-def _list_pods(cluster_name: str, context: Optional[str],
-               namespace: str) -> Dict[str, Dict[str, Any]]:
-    out = _run_kubectl(
-        ['get', 'pods', '-l', f'{CLUSTER_LABEL}={cluster_name}',
-         '-o', 'json'], context, namespace)
-    items = json.loads(out).get('items', [])
+def _list_pods(client: rest.KubeClient,
+               cluster_name: str) -> Dict[str, Dict[str, Any]]:
+    items = client.list('Pod', f'{CLUSTER_LABEL}={cluster_name}')
     return {p['metadata']['name']: p for p in items}
 
 
@@ -216,11 +208,18 @@ _STATUS_MAP = {
 }
 
 
+def _scoped_client(provider_config: Dict[str, Any]) -> rest.KubeClient:
+    return _client(provider_config.get('context'),
+                   provider_config.get('namespace', 'default'))
+
+
 def query_instances(cluster_name: str,
                     provider_config: Dict[str, Any]
                     ) -> Dict[str, Optional[str]]:
-    pods = _list_pods(cluster_name, provider_config.get('context'),
-                      provider_config.get('namespace', 'default'))
+    try:
+        pods = _list_pods(_scoped_client(provider_config), cluster_name)
+    except rest.KubeApiError as e:
+        raise _wrap_api_error(e) from e
     return {
         name: _STATUS_MAP.get(p.get('status', {}).get('phase', 'Unknown'),
                               'PENDING')
@@ -236,12 +235,14 @@ def stop_instances(cluster_name: str,
 
 def terminate_instances(cluster_name: str,
                         provider_config: Dict[str, Any]) -> None:
-    context = provider_config.get('context')
-    namespace = provider_config.get('namespace', 'default')
-    _run_kubectl(['delete', 'pods,services', '-l',
-                  f'{CLUSTER_LABEL}={cluster_name}',
-                  '--ignore-not-found=true', '--wait=false'],
-                 context, namespace, timeout=120.0)
+    client = _scoped_client(provider_config)
+    try:
+        client.delete_by_selector('Pod',
+                                  f'{CLUSTER_LABEL}={cluster_name}')
+        client.delete_by_selector('Service',
+                                  f'{CLUSTER_LABEL}={cluster_name}')
+    except rest.KubeApiError as e:
+        raise _wrap_api_error(e) from e
 
 
 def wait_instances(region: str, cluster_name: str, state: str,
@@ -254,9 +255,13 @@ def wait_instances(region: str, cluster_name: str, state: str,
     context = provider_config.get('context') or (
         None if region in (None, '', 'in-cluster') else region)
     namespace = provider_config.get('namespace', 'default')
+    client = _client(context, namespace)
     deadline = time.time() + timeout
     while True:
-        pods = _list_pods(cluster_name, context, namespace)
+        try:
+            pods = _list_pods(client, cluster_name)
+        except rest.KubeApiError as e:
+            raise _wrap_api_error(e) from e
         phases = [p.get('status', {}).get('phase') for p in pods.values()]
         if state == 'RUNNING':
             if pods and all(ph == 'Running' for ph in phases):
@@ -286,7 +291,10 @@ def get_cluster_info(region: str, cluster_name: str,
     del region
     context = provider_config.get('context')
     namespace = provider_config.get('namespace', 'default')
-    pods = _list_pods(cluster_name, context, namespace)
+    try:
+        pods = _list_pods(_client(context, namespace), cluster_name)
+    except rest.KubeApiError as e:
+        raise _wrap_api_error(e) from e
     instances: Dict[str, common.InstanceInfo] = {}
     for name, pod in sorted(pods.items()):
         labels = pod['metadata'].get('labels', {})
@@ -310,11 +318,28 @@ def get_cluster_info(region: str, cluster_name: str,
     )
 
 
+def networking_mode(provider_config: Dict[str, Any]) -> str:
+    mode = (provider_config.get('networking_mode') or 'nodeport').lower()
+    if mode not in ('nodeport', 'portforward'):
+        raise exceptions.InvalidSkyTpuConfigError(
+            f'kubernetes networking_mode must be nodeport or '
+            f'portforward, got {mode!r}')
+    return mode
+
+
 def open_ports(cluster_name: str, ports: List[str],
                provider_config: Dict[str, Any]) -> None:
-    """Expose ports on the head pod via a NodePort service."""
-    context = provider_config.get('context')
-    namespace = provider_config.get('namespace', 'default')
+    """Expose ports on the head pod via a NodePort service.
+
+    In `portforward` networking mode nothing is provisioned: clients
+    reach pod ports through the port-forward data plane instead of a
+    node-level listener (the reference's portforward mode does the
+    same — its endpoint command spawns the forward client-side).
+    """
+    if networking_mode(provider_config) == 'portforward':
+        logger.debug(f'networking_mode=portforward: no NodePort service '
+                     f'for {cluster_name} ports {ports}')
+        return
     port_specs = []
     for p in ports:
         spec = str(p)
@@ -341,13 +366,83 @@ def open_ports(cluster_name: str, ports: List[str],
             'ports': port_specs,
         },
     }
-    _run_kubectl(['apply', '-f', '-'], context, namespace,
-                 input_data=json.dumps(manifest))
+    try:
+        _scoped_client(provider_config).apply(manifest)
+    except rest.KubeApiError as e:
+        raise _wrap_api_error(e) from e
 
 
 def cleanup_ports(cluster_name: str,
                   provider_config: Dict[str, Any]) -> None:
-    context = provider_config.get('context')
-    namespace = provider_config.get('namespace', 'default')
-    _run_kubectl(['delete', 'service', f'{cluster_name}-ports',
-                  '--ignore-not-found=true'], context, namespace)
+    try:
+        _scoped_client(provider_config).delete('Service',
+                                               f'{cluster_name}-ports')
+    except rest.KubeApiError as e:
+        logger.warning(f'cleanup_ports({cluster_name}): {e}')
+
+
+# ---- fuse-proxy DaemonSet (privileged fusermount broker) -------------------
+
+FUSE_PROXY_NAMESPACE = 'kube-system'
+FUSE_PROXY_NAME = 'fusermount-server'
+
+
+def fuse_proxy_daemonset(image: str = 'fusermount-server:latest'
+                         ) -> Dict[str, Any]:
+    """The addons/fuse-proxy DaemonSet as an API object (twin of the
+    reference's fusermount-server manifest,
+    sky/provision/kubernetes/manifests/): one privileged pod per node
+    brokering fusermount for unprivileged task pods over
+    /var/run/fusermount/server.sock."""
+    labels = {'app': FUSE_PROXY_NAME}
+    return {
+        'apiVersion': 'apps/v1',
+        'kind': 'DaemonSet',
+        'metadata': {
+            'name': FUSE_PROXY_NAME,
+            'namespace': FUSE_PROXY_NAMESPACE,
+            'labels': labels,
+        },
+        'spec': {
+            'selector': {'matchLabels': labels},
+            'template': {
+                'metadata': {'labels': labels},
+                'spec': {
+                    'hostPID': True,
+                    'containers': [{
+                        'name': FUSE_PROXY_NAME,
+                        'image': image,
+                        'command': [
+                            '/usr/local/bin/fusermount-server',
+                            '/var/run/fusermount/server.sock'],
+                        'securityContext': {'privileged': True},
+                        'volumeMounts': [{
+                            'mountPath': '/var/run/fusermount',
+                            'name': 'fusermount-shared-dir'}],
+                    }],
+                    'volumes': [{
+                        'name': 'fusermount-shared-dir',
+                        'hostPath': {'path': '/var/run/fusermount',
+                                     'type': 'DirectoryOrCreate'}}],
+                },
+            },
+        },
+    }
+
+
+def deploy_fuse_proxy(provider_config: Dict[str, Any]) -> None:
+    """Ensure the fusermount-server DaemonSet exists (idempotent).
+
+    Called before running MOUNT-mode storage commands on a kubernetes
+    cluster: unprivileged task pods need the per-node broker for FUSE
+    mounts. Failures surface loudly — a missing broker means the mount
+    command will sit failing in the pod."""
+    client = _client(provider_config.get('context'),
+                     FUSE_PROXY_NAMESPACE)
+    image = provider_config.get('fuse_proxy_image',
+                                'fusermount-server:latest')
+    try:
+        client.apply(fuse_proxy_daemonset(image))
+    except rest.KubeApiError as e:
+        raise exceptions.ProvisionError(
+            f'Deploying the fuse-proxy DaemonSet failed: {e}') from e

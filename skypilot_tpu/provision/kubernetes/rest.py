@@ -1,0 +1,329 @@
+"""Zero-dependency Kubernetes API client.
+
+Control-plane twin of the reference's `kubernetes` SDK usage
+(sky/provision/kubernetes/utils.py:78-401 builds API clients with
+exec-plugin auth; sky/adaptors/kubernetes.py wraps the SDK). This repo
+owns its transports (same pattern as provision/gcp/rest.py,
+provision/aws/rest.py), so the provisioner drives the kube API server
+over plain HTTPS from the stdlib:
+
+  * kubeconfig parsing — KUBECONFIG / ~/.kube/config: clusters
+    (server, CA data), users (token, client certs, exec plugins),
+    contexts; `context` selects one, else current-context.
+  * in-cluster config — the pod service account
+    (/var/run/secrets/kubernetes.io/serviceaccount) when no kubeconfig
+    matches, mirroring client library fallback order.
+  * exec-plugin auth — runs the user's credential plugin (GKE's
+    gke-gcloud-auth-plugin, EKS's aws-iam-authenticator), parses the
+    ExecCredential, caches the token until expirationTimestamp.
+
+The pod EXEC data plane (command running / rsync) stays on kubectl:
+exec rides a SPDY/websocket upgrade that buys nothing reimplemented,
+while control-plane CRUD here removes the kubectl dependency from every
+provisioner op and makes them unit-testable with a recorded-response
+transport.
+"""
+from __future__ import annotations
+
+import base64
+import datetime
+import json
+import os
+import ssl
+import subprocess
+import tempfile
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_SA_DIR = '/var/run/secrets/kubernetes.io/serviceaccount'
+
+
+class KubeApiError(Exception):
+
+    def __init__(self, status: int, reason: str, message: str) -> None:
+        super().__init__(f'{status} {reason}: {message}')
+        self.status = status
+        self.reason = reason
+        self.message = message
+
+
+def _load_kubeconfig() -> Optional[Dict[str, Any]]:
+    import yaml
+    path = os.path.expanduser(
+        os.environ.get('KUBECONFIG', '~/.kube/config').split(os.pathsep)[0])
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding='utf-8') as f:
+        return yaml.safe_load(f) or {}
+
+
+def _by_name(entries: List[Dict[str, Any]], name: str,
+             kind: str) -> Dict[str, Any]:
+    for entry in entries or []:
+        if entry.get('name') == name:
+            return entry.get(kind, {})
+    raise ValueError(f'kubeconfig has no {kind} named {name!r}')
+
+
+def _write_temp(data: bytes, suffix: str) -> str:
+    fd, path = tempfile.mkstemp(prefix='xsky-kube-', suffix=suffix)
+    with os.fdopen(fd, 'wb') as f:
+        f.write(data)
+    os.chmod(path, 0o600)
+    return path
+
+
+class KubeTransport:
+    """Authenticated HTTPS to one cluster's API server."""
+
+    def __init__(self, context: Optional[str] = None) -> None:
+        self.server: str = ''
+        self._headers: Dict[str, str] = {}
+        self._ssl: Optional[ssl.SSLContext] = None
+        self._exec_spec: Optional[Dict[str, Any]] = None
+        self._exec_token: Optional[str] = None
+        self._exec_expiry: Optional[datetime.datetime] = None
+        config = _load_kubeconfig()
+        if config and (context or config.get('current-context')):
+            self._init_from_kubeconfig(config, context)
+        elif os.path.exists(os.path.join(_SA_DIR, 'token')):
+            self._init_in_cluster()
+        else:
+            raise ValueError(
+                'No Kubernetes credentials: neither a kubeconfig '
+                f'(KUBECONFIG / ~/.kube/config) nor an in-cluster '
+                f'service account ({_SA_DIR}) is present.')
+
+    # -- credential resolution ------------------------------------------
+
+    def _init_in_cluster(self) -> None:
+        host = os.environ.get('KUBERNETES_SERVICE_HOST', 'kubernetes.default.svc')
+        port = os.environ.get('KUBERNETES_SERVICE_PORT', '443')
+        self.server = f'https://{host}:{port}'
+        with open(os.path.join(_SA_DIR, 'token'), encoding='utf-8') as f:
+            self._headers['Authorization'] = f'Bearer {f.read().strip()}'
+        ca = os.path.join(_SA_DIR, 'ca.crt')
+        self._ssl = ssl.create_default_context(
+            cafile=ca if os.path.exists(ca) else None)
+
+    def _init_from_kubeconfig(self, config: Dict[str, Any],
+                              context: Optional[str]) -> None:
+        ctx_name = context or config.get('current-context')
+        ctx = _by_name(config.get('contexts', []), ctx_name, 'context')
+        cluster = _by_name(config.get('clusters', []),
+                           ctx.get('cluster', ''), 'cluster')
+        user = _by_name(config.get('users', []), ctx.get('user', ''),
+                        'user')
+        self.server = cluster['server'].rstrip('/')
+        if cluster.get('insecure-skip-tls-verify'):
+            self._ssl = ssl._create_unverified_context()  # pylint: disable=protected-access
+        else:
+            ca_pem: Optional[str] = None
+            if cluster.get('certificate-authority-data'):
+                ca_pem = base64.b64decode(
+                    cluster['certificate-authority-data']).decode()
+            self._ssl = ssl.create_default_context(
+                cafile=cluster.get('certificate-authority'),
+                cadata=ca_pem)
+        if user.get('token'):
+            self._headers['Authorization'] = f"Bearer {user['token']}"
+        elif user.get('exec'):
+            self._exec_spec = user['exec']
+        elif user.get('username') and user.get('password'):
+            basic = base64.b64encode(
+                f"{user['username']}:{user['password']}".encode()).decode()
+            self._headers['Authorization'] = f'Basic {basic}'
+        cert = user.get('client-certificate')
+        key = user.get('client-key')
+        if user.get('client-certificate-data'):
+            cert = _write_temp(
+                base64.b64decode(user['client-certificate-data']), '.crt')
+        if user.get('client-key-data'):
+            key = _write_temp(
+                base64.b64decode(user['client-key-data']), '.key')
+        if cert and key and self._ssl is not None:
+            self._ssl.load_cert_chain(cert, key)
+
+    def _exec_credential(self) -> str:
+        """Run the kubeconfig exec plugin → bearer token (cached until
+        the plugin-reported expiry)."""
+        now = datetime.datetime.now(datetime.timezone.utc)
+        if self._exec_token and self._exec_expiry and now < self._exec_expiry:
+            return self._exec_token
+        spec = self._exec_spec or {}
+        cmd = [spec.get('command', '')] + list(spec.get('args') or [])
+        env = dict(os.environ)
+        for pair in spec.get('env') or []:
+            env[pair['name']] = pair['value']
+        env.setdefault(
+            'KUBERNETES_EXEC_INFO',
+            json.dumps({'apiVersion': spec.get(
+                'apiVersion', 'client.authentication.k8s.io/v1beta1'),
+                'kind': 'ExecCredential', 'spec': {'interactive': False}}))
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 env=env, timeout=60, check=True).stdout
+            cred = json.loads(out)
+        except (OSError, subprocess.SubprocessError,
+                json.JSONDecodeError) as e:
+            raise KubeApiError(
+                401, 'ExecPluginFailed',
+                f'credential plugin {cmd[0]!r} failed: {e}') from e
+        status = cred.get('status', {})
+        token = status.get('token')
+        if not token:
+            raise KubeApiError(401, 'ExecPluginFailed',
+                               f'plugin {cmd[0]!r} returned no token')
+        self._exec_token = token
+        expiry = status.get('expirationTimestamp')
+        if expiry:
+            try:
+                self._exec_expiry = datetime.datetime.fromisoformat(
+                    expiry.replace('Z', '+00:00'))
+            except ValueError:
+                self._exec_expiry = None
+        return token
+
+    # -- HTTP -----------------------------------------------------------
+
+    def request(self, method: str, path: str,
+                params: Optional[Dict[str, str]] = None,
+                body: Optional[Any] = None,
+                content_type: str = 'application/json') -> Dict[str, Any]:
+        url = self.server + path
+        if params:
+            url += '?' + urllib.parse.urlencode(params)
+        headers = dict(self._headers)
+        if self._exec_spec is not None:
+            headers['Authorization'] = f'Bearer {self._exec_credential()}'
+        data = None
+        if body is not None:
+            data = json.dumps(body).encode()
+            headers['Content-Type'] = content_type
+        headers['Accept'] = 'application/json'
+        req = urllib.request.Request(url, data=data, headers=headers,
+                                     method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=60,
+                                        context=self._ssl) as resp:
+                raw = resp.read()
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            try:
+                payload = json.loads(raw)
+            except json.JSONDecodeError:
+                payload = {}
+            raise KubeApiError(
+                e.code, payload.get('reason', e.reason or ''),
+                payload.get('message',
+                            raw.decode(errors='replace')[:300])) from e
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            raise KubeApiError(0, 'Unreachable',
+                               f'cannot reach {self.server}: {e}') from e
+        return json.loads(raw) if raw else {}
+
+
+def _api_prefix(api_version: str) -> str:
+    """'v1' → /api/v1; 'apps/v1' → /apis/apps/v1."""
+    if '/' in api_version:
+        return f'/apis/{api_version}'
+    return f'/api/{api_version}'
+
+
+_KIND_PLURALS = {
+    'Pod': 'pods',
+    'Service': 'services',
+    'DaemonSet': 'daemonsets',
+    'ConfigMap': 'configmaps',
+    'Node': 'nodes',
+}
+
+
+class KubeClient:
+    """Typed CRUD over a transport; namespace-scoped unless noted."""
+
+    def __init__(self, transport: KubeTransport,
+                 namespace: str = 'default') -> None:
+        self.t = transport
+        self.namespace = namespace
+
+    def _path(self, api_version: str, kind: str,
+              name: Optional[str] = None,
+              namespace: Optional[str] = None) -> str:
+        plural = _KIND_PLURALS[kind]
+        ns = namespace or self.namespace
+        base = f'{_api_prefix(api_version)}/namespaces/{ns}/{plural}'
+        return f'{base}/{name}' if name else base
+
+    def list(self, kind: str, label_selector: str = '',
+             api_version: str = 'v1',
+             namespace: Optional[str] = None) -> List[Dict[str, Any]]:
+        params = {}
+        if label_selector:
+            params['labelSelector'] = label_selector
+        out = self.t.request(
+            'GET', self._path(api_version, kind, namespace=namespace),
+            params=params)
+        return out.get('items', [])
+
+    def get(self, kind: str, name: str, api_version: str = 'v1',
+            namespace: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        try:
+            return self.t.request(
+                'GET', self._path(api_version, kind, name, namespace))
+        except KubeApiError as e:
+            if e.status == 404:
+                return None
+            raise
+
+    def apply(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        """Create-or-update (kubectl-apply semantics): POST, and on
+        409 AlreadyExists fall back to a JSON merge-patch."""
+        api_version = obj['apiVersion']
+        kind = obj['kind']
+        name = obj['metadata']['name']
+        namespace = obj['metadata'].get('namespace')
+        try:
+            return self.t.request(
+                'POST', self._path(api_version, kind, namespace=namespace),
+                body=obj)
+        except KubeApiError as e:
+            if e.status != 409:
+                raise
+        return self.t.request(
+            'PATCH', self._path(api_version, kind, name, namespace),
+            body=obj, content_type='application/merge-patch+json')
+
+    def delete(self, kind: str, name: str, api_version: str = 'v1',
+               namespace: Optional[str] = None,
+               ignore_missing: bool = True) -> None:
+        try:
+            self.t.request(
+                'DELETE', self._path(api_version, kind, name, namespace))
+        except KubeApiError as e:
+            if not (ignore_missing and e.status == 404):
+                raise
+
+    def delete_by_selector(self, kind: str, label_selector: str,
+                           api_version: str = 'v1',
+                           namespace: Optional[str] = None) -> None:
+        """DELETE collection (pods support it server-side); falls back
+        to per-object deletes for kinds without a collection endpoint."""
+        try:
+            self.t.request(
+                'DELETE', self._path(api_version, kind,
+                                     namespace=namespace),
+                params={'labelSelector': label_selector})
+        except KubeApiError as e:
+            if e.status not in (404, 405):
+                raise
+            for obj in self.list(kind, label_selector, api_version,
+                                 namespace):
+                self.delete(kind, obj['metadata']['name'], api_version,
+                            namespace)

@@ -1,78 +1,109 @@
-"""Kubernetes cloud + provisioner tests (in-memory kubectl fake).
+"""Kubernetes cloud + provisioner tests (recorded-response kube API fake).
 
-The fake kubectl plays moto's role (reference tests/test_failover.py):
-every provisioner op goes through instance._run_kubectl, which we replace
-with a dict-backed implementation.
+The fake transport plays moto's role (reference tests/test_failover.py):
+every provisioner op goes through the zero-dep REST client
+(provision/kubernetes/rest.py), whose transport factory we replace with
+a dict-backed in-memory API server.
 """
 import json
+import urllib.parse
 
 import pytest
 
 from skypilot_tpu.clouds import kubernetes as k8s_cloud
 from skypilot_tpu.provision import common
 from skypilot_tpu.provision.kubernetes import instance as k8s_instance
+from skypilot_tpu.provision.kubernetes import rest as k8s_rest
 from skypilot_tpu.utils import command_runner
 
 
-class FakeKubectl:
-    """Dict-backed kubectl: supports the verbs the provisioner uses."""
+class FakeKubeApi:
+    """Dict-backed kube API server: core/v1 pods+services, apps/v1
+    daemonsets. Records (method, context, namespace) per call."""
 
     def __init__(self):
         self.pods = {}       # name -> manifest (with injected status)
         self.services = {}
-        self.calls = []      # (verb, context, namespace)
+        self.daemonsets = {}
+        self.calls = []      # (method, context, namespace)
 
-    def __call__(self, args, context=None, namespace=None, input_data=None,
-                 timeout=60.0):
-        verb = args[0]
-        self.calls.append((verb, context, namespace))
-        if verb == 'apply':
-            items = json.loads(input_data)
-            if items.get('kind') == 'List':
-                items = items['items']
-            else:
-                items = [items]
-            for m in items:
-                name = m['metadata']['name']
-                if m['kind'] == 'Pod':
-                    m.setdefault('status',
-                                 {'phase': 'Running', 'podIP':
-                                  f'10.0.0.{len(self.pods) + 1}'})
-                    self.pods[name] = m
-                else:
-                    self.services[name] = m
-            return ''
-        if verb == 'get':
-            selector = args[args.index('-l') + 1]
-            key, value = selector.split('=')
-            items = [
-                p for p in self.pods.values()
-                if p['metadata'].get('labels', {}).get(key) == value
-            ]
-            return json.dumps({'items': items})
-        if verb == 'delete':
-            if args[1] == 'pods,services':
-                selector = args[args.index('-l') + 1]
-                key, value = selector.split('=')
-                self.pods = {
-                    n: p for n, p in self.pods.items()
-                    if p['metadata'].get('labels', {}).get(key) != value
-                }
-                self.services = {
-                    n: s for n, s in self.services.items()
-                    if s['metadata'].get('labels', {}).get(key) != value
-                }
-                return ''
-            if args[1] == 'service':
-                self.services.pop(args[2], None)
-                return ''
-        raise AssertionError(f'FakeKubectl: unhandled {args}')
+    def transport(self, context=None):
+        return _FakeTransport(self, context)
+
+    def _store(self, kind):
+        return {'pods': self.pods, 'services': self.services,
+                'daemonsets': self.daemonsets}[kind]
+
+
+class _FakeTransport:
+
+    def __init__(self, api, context):
+        self.api = api
+        self.context = context
+
+    def request(self, method, path, params=None, body=None,
+                content_type='application/json'):
+        params = params or {}
+        m = urllib.parse.urlparse(path).path.split('/')
+        # /api/v1/namespaces/{ns}/{plural}[/{name}] or
+        # /apis/apps/v1/namespaces/{ns}/{plural}[/{name}]
+        ns_i = m.index('namespaces')
+        namespace = m[ns_i + 1]
+        plural = m[ns_i + 2]
+        name = m[ns_i + 3] if len(m) > ns_i + 3 else None
+        self.api.calls.append((method, self.context, namespace))
+        store = self.api._store(plural)
+
+        def matches(obj):
+            sel = params.get('labelSelector')
+            if not sel:
+                return True
+            key, value = sel.split('=')
+            return obj['metadata'].get('labels', {}).get(key) == value
+
+        if method == 'GET' and name is None:
+            return {'items': [o for o in store.values() if matches(o)]}
+        if method == 'GET':
+            if name not in store:
+                raise k8s_rest.KubeApiError(404, 'NotFound', name)
+            return store[name]
+        if method == 'POST':
+            obj = dict(body)
+            oname = obj['metadata']['name']
+            if oname in store:
+                raise k8s_rest.KubeApiError(409, 'AlreadyExists', oname)
+            if plural == 'pods':
+                obj.setdefault('status',
+                               {'phase': 'Running', 'podIP':
+                                f'10.0.0.{len(store) + 1}'})
+            store[oname] = obj
+            return obj
+        if method == 'PATCH':
+            if name not in store:
+                raise k8s_rest.KubeApiError(404, 'NotFound', name)
+            store[name].update(body)
+            return store[name]
+        if method == 'DELETE' and name is not None:
+            if name not in store:
+                raise k8s_rest.KubeApiError(404, 'NotFound', name)
+            store.pop(name)
+            return {}
+        if method == 'DELETE':
+            if plural == 'services':
+                # Real clusters lack a Service deletecollection the
+                # client can rely on: force the per-object fallback.
+                raise k8s_rest.KubeApiError(405, 'MethodNotAllowed',
+                                            'deletecollection')
+            for oname in [n for n, o in store.items() if matches(o)]:
+                store.pop(oname)
+            return {}
+        raise AssertionError(f'FakeKubeApi: unhandled {method} {path}')
 
 
 @pytest.fixture
-def fake_kubectl(monkeypatch):
-    fake = FakeKubectl()
-    monkeypatch.setattr(k8s_instance, '_run_kubectl', fake)
+def fake_kube(monkeypatch):
+    fake = FakeKubeApi()
+    monkeypatch.setattr(k8s_instance, '_transport_factory', fake.transport)
     return fake
 
 
@@ -125,33 +156,33 @@ class TestKubernetesCloud:
 
 class TestKubernetesProvisioner:
 
-    def test_tpu_podslice_creates_one_pod_per_host(self, fake_kubectl):
+    def test_tpu_podslice_creates_one_pod_per_host(self, fake_kube):
         config = _tpu_config()
         record = k8s_instance.run_instances('in-cluster', None, 'mycluster',
                                             config)
         assert len(record.created_instance_ids) == 4
         assert record.head_instance_id == 'mycluster-0'
         # Pods carry GKE TPU selectors + google.com/tpu limits.
-        pod = fake_kubectl.pods['mycluster-0']
+        pod = fake_kube.pods['mycluster-0']
         sel = pod['spec']['nodeSelector']
         assert sel['cloud.google.com/gke-tpu-accelerator'] == 'tpu-v6e-slice'
         assert sel['cloud.google.com/gke-tpu-topology'] == '4x4'
         limits = pod['spec']['containers'][0]['resources']['limits']
         assert limits['google.com/tpu'] == '4'
         # Headless service for gang DNS.
-        assert 'mycluster' in fake_kubectl.services
-        assert fake_kubectl.services['mycluster']['spec']['clusterIP'] == \
+        assert 'mycluster' in fake_kube.services
+        assert fake_kube.services['mycluster']['spec']['clusterIP'] == \
             'None'
 
-    def test_idempotent_run_instances(self, fake_kubectl):
+    def test_idempotent_run_instances(self, fake_kube):
         config = _tpu_config()
         k8s_instance.run_instances('in-cluster', None, 'mycluster', config)
         record2 = k8s_instance.run_instances('in-cluster', None, 'mycluster',
                                              config)
         assert record2.created_instance_ids == []
-        assert len(fake_kubectl.pods) == 4
+        assert len(fake_kube.pods) == 4
 
-    def test_query_and_cluster_info(self, fake_kubectl):
+    def test_query_and_cluster_info(self, fake_kube):
         config = _tpu_config()
         k8s_instance.run_instances('in-cluster', None, 'mycluster', config)
         statuses = k8s_instance.query_instances('mycluster', {})
@@ -165,25 +196,25 @@ class TestKubernetesProvisioner:
         # All four hosts share one slice id (one v6e-16 slice).
         assert len({h.slice_id for h in hosts}) == 1
 
-    def test_stop_unsupported_terminate_works(self, fake_kubectl):
+    def test_stop_unsupported_terminate_works(self, fake_kube):
         config = _tpu_config()
         k8s_instance.run_instances('in-cluster', None, 'mycluster', config)
         from skypilot_tpu import exceptions
         with pytest.raises(exceptions.NotSupportedError):
             k8s_instance.stop_instances('mycluster', {})
         k8s_instance.terminate_instances('mycluster', {})
-        assert fake_kubectl.pods == {}
+        assert fake_kube.pods == {}
         assert k8s_instance.query_instances('mycluster', {}) == {}
 
-    def test_open_and_cleanup_ports(self, fake_kubectl):
+    def test_open_and_cleanup_ports(self, fake_kube):
         config = _tpu_config()
         k8s_instance.run_instances('in-cluster', None, 'mycluster', config)
         k8s_instance.open_ports('mycluster', ['8080'], {})
-        svc = fake_kubectl.services['mycluster-ports']
+        svc = fake_kube.services['mycluster-ports']
         assert svc['spec']['type'] == 'NodePort'
         assert svc['spec']['ports'][0]['port'] == 8080
         k8s_instance.cleanup_ports('mycluster', {})
-        assert 'mycluster-ports' not in fake_kubectl.services
+        assert 'mycluster-ports' not in fake_kube.services
 
 
 class TestKubernetesCommandRunner:
@@ -209,7 +240,7 @@ class TestKubernetesCommandRunner:
         assert 'mycluster-0' in cmd
         assert cmd[-1].startswith('export A=1; ')
 
-    def test_runners_from_cluster_info(self, fake_kubectl):
+    def test_runners_from_cluster_info(self, fake_kube):
         config = _tpu_config()
         k8s_instance.run_instances('in-cluster', None, 'mycluster', config)
         info = k8s_instance.get_cluster_info(
@@ -224,7 +255,7 @@ class TestKubernetesCommandRunner:
         assert runners[0].context == 'ctx2'
 
 
-def test_lifecycle_ops_agree_on_context_and_namespace(fake_kubectl):
+def test_lifecycle_ops_agree_on_context_and_namespace(fake_kube):
     """Every lifecycle op must target the context/namespace that
     run_instances used — contexts are this cloud's regions, so a
     mismatch silently operates on the wrong cluster."""
@@ -248,26 +279,26 @@ def test_lifecycle_ops_agree_on_context_and_namespace(fake_kubectl):
     k8s_instance.query_instances('ctxtest', provider_config)
     k8s_instance.get_cluster_info('gke-prod', 'ctxtest', provider_config)
     k8s_instance.terminate_instances('ctxtest', provider_config)
-    assert fake_kubectl.calls, 'no kubectl calls recorded'
-    for verb, context, namespace in fake_kubectl.calls:
+    assert fake_kube.calls, 'no kubectl calls recorded'
+    for verb, context, namespace in fake_kube.calls:
         assert context == 'gke-prod', (verb, context)
         assert namespace == 'ns-a', (verb, namespace)
 
 
-def test_wait_instances_derives_context_from_region(fake_kubectl):
+def test_wait_instances_derives_context_from_region(fake_kube):
     """A caller that lost provider_config still targets the right
     cluster: region doubles as the kubectl context."""
     config = _tpu_config()
     k8s_instance.run_instances('in-cluster', None, 'mycluster', config)
-    fake_kubectl.calls.clear()
+    fake_kube.calls.clear()
     k8s_instance.wait_instances('gke-other', 'mycluster', 'RUNNING')
-    assert fake_kubectl.calls[0][1] == 'gke-other'
-    fake_kubectl.calls.clear()
+    assert fake_kube.calls[0][1] == 'gke-other'
+    fake_kube.calls.clear()
     k8s_instance.wait_instances('in-cluster', 'mycluster', 'RUNNING')
-    assert fake_kubectl.calls[0][1] is None
+    assert fake_kube.calls[0][1] is None
 
 
-def test_multislice_per_slice_host_index(fake_kubectl):
+def test_multislice_per_slice_host_index(fake_kube):
     """2 slices of tpu-v6e-16: TPU_WORKER_ID restarts at 0 per slice."""
     from skypilot_tpu import resources as resources_lib
     cloud = k8s_cloud.Kubernetes()
@@ -287,6 +318,144 @@ def test_multislice_per_slice_host_index(fake_kubectl):
     assert len({h.slice_id for h in hosts}) == 2
     # Env TPU_WORKER_ID matches the per-slice index.
     for i in range(8):
-        pod = fake_kubectl.pods[f'ms-{i}']
+        pod = fake_kube.pods[f'ms-{i}']
         env = pod['spec']['containers'][0]['env']
-        assert env[0]['value'] == str(i % 4)
+        assert env == [{'name': 'TPU_WORKER_ID', 'value': str(i % 4)}]
+
+
+class TestKubeRestClient:
+    """Zero-dep kube API client (VERDICT r4 #4): kubeconfig + exec
+    auth parsing, apply semantics, group routing."""
+
+    def _kubeconfig(self, tmp_path, monkeypatch, user):
+        import base64
+        import yaml
+        ca = base64.b64encode(b'-----BEGIN CERTIFICATE-----\n'
+                              b'-----END CERTIFICATE-----\n').decode()
+        cfg = {
+            'current-context': 'dev',
+            'contexts': [{'name': 'dev',
+                          'context': {'cluster': 'c1', 'user': 'u1'}}],
+            'clusters': [{'name': 'c1',
+                          'cluster': {
+                              'server': 'https://kube.example:6443',
+                              'insecure-skip-tls-verify': True,
+                              'certificate-authority-data': ca}}],
+            'users': [{'name': 'u1', 'user': user}],
+        }
+        path = tmp_path / 'kubeconfig'
+        path.write_text(yaml.safe_dump(cfg))
+        monkeypatch.setenv('KUBECONFIG', str(path))
+        return path
+
+    def test_kubeconfig_token_auth(self, tmp_path, monkeypatch):
+        self._kubeconfig(tmp_path, monkeypatch, {'token': 'tok123'})
+        t = k8s_rest.KubeTransport()
+        assert t.server == 'https://kube.example:6443'
+        assert t._headers['Authorization'] == 'Bearer tok123'
+
+    def test_kubeconfig_exec_plugin_auth(self, tmp_path, monkeypatch):
+        """exec-auth (GKE's gke-gcloud-auth-plugin pattern): the plugin
+        output's token is used and cached until its expiry."""
+        import sys
+        plugin = tmp_path / 'plugin.py'
+        count_file = tmp_path / 'count'
+        plugin.write_text(
+            'import json, pathlib\n'
+            f'p = pathlib.Path({str(count_file)!r})\n'
+            'n = int(p.read_text()) + 1 if p.exists() else 1\n'
+            'p.write_text(str(n))\n'
+            'print(json.dumps({"apiVersion": '
+            '"client.authentication.k8s.io/v1beta1", '
+            '"kind": "ExecCredential", "status": {"token": f"exec-{n}", '
+            '"expirationTimestamp": "2999-01-01T00:00:00Z"}}))\n')
+        self._kubeconfig(tmp_path, monkeypatch, {'exec': {
+            'apiVersion': 'client.authentication.k8s.io/v1beta1',
+            'command': sys.executable,
+            'args': [str(plugin)],
+        }})
+        t = k8s_rest.KubeTransport()
+        assert t._exec_credential() == 'exec-1'
+        # Cached: the plugin does not run again before expiry.
+        assert t._exec_credential() == 'exec-1'
+        assert count_file.read_text() == '1'
+
+    def test_missing_credentials_raise(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('KUBECONFIG', str(tmp_path / 'absent'))
+        with pytest.raises(ValueError, match='No Kubernetes credentials'):
+            k8s_rest.KubeTransport()
+
+    def test_apply_create_then_patch(self, fake_kube):
+        client = k8s_rest.KubeClient(fake_kube.transport(), 'default')
+        obj = {'apiVersion': 'v1', 'kind': 'Service',
+               'metadata': {'name': 's1'}, 'spec': {'a': 1}}
+        client.apply(obj)
+        assert fake_kube.services['s1']['spec'] == {'a': 1}
+        client.apply({**obj, 'spec': {'a': 2}})   # 409 → merge patch
+        assert fake_kube.services['s1']['spec'] == {'a': 2}
+
+    def test_group_routing(self, fake_kube):
+        """core/v1 rides /api/v1; apps/v1 rides /apis/apps/v1."""
+        assert k8s_rest._api_prefix('v1') == '/api/v1'
+        assert k8s_rest._api_prefix('apps/v1') == '/apis/apps/v1'
+        client = k8s_rest.KubeClient(fake_kube.transport(), 'kube-system')
+        client.apply(k8s_instance.fuse_proxy_daemonset())
+        assert 'fusermount-server' in fake_kube.daemonsets
+
+
+class TestFuseProxyDeploy:
+
+    def test_deploy_fuse_proxy_daemonset(self, fake_kube):
+        k8s_instance.deploy_fuse_proxy({'context': 'gke-prod'})
+        ds = fake_kube.daemonsets['fusermount-server']
+        assert ds['metadata']['namespace'] == 'kube-system'
+        tpl = ds['spec']['template']['spec']
+        assert tpl['hostPID'] is True
+        assert tpl['containers'][0]['securityContext']['privileged']
+        # Idempotent re-apply.
+        k8s_instance.deploy_fuse_proxy({'context': 'gke-prod'})
+        # Custom image knob.
+        k8s_instance.deploy_fuse_proxy(
+            {'fuse_proxy_image': 'registry/fp:v2'})
+        assert fake_kube.daemonsets['fusermount-server'][
+            'spec']['template']['spec']['containers'][0]['image'] == \
+            'registry/fp:v2'
+
+    def test_mount_storage_deploys_broker_on_k8s(self, fake_kube,
+                                                 monkeypatch):
+        """MOUNT-mode storage on a kubernetes cluster ensures the
+        fusermount broker before running mount commands."""
+        from skypilot_tpu.data import storage_mounting
+
+        class _Runner:
+            def run(self, cmd, require_outputs=True):
+                return 0, '', ''
+
+        class _Info:
+            provider_name = 'kubernetes'
+            provider_config = {'context': None}
+
+        class _Handle:
+            cluster_info = _Info()
+
+            def get_command_runners(self):
+                return [_Runner()]
+
+        storage_mounting.mount_storage_on_cluster(
+            _Handle(), {'/data': {'name': 'b1', 'store': 'local',
+                                  'mode': 'MOUNT',
+                                  'source': '/tmp'}})
+        assert 'fusermount-server' in fake_kube.daemonsets
+
+
+class TestNetworkingModes:
+
+    def test_portforward_mode_skips_nodeport(self, fake_kube):
+        k8s_instance.open_ports('c1', ['8080'],
+                                {'networking_mode': 'portforward'})
+        assert fake_kube.services == {}
+
+    def test_invalid_mode_rejected(self):
+        from skypilot_tpu import exceptions
+        with pytest.raises(exceptions.InvalidSkyTpuConfigError):
+            k8s_instance.networking_mode({'networking_mode': 'ingress!'})

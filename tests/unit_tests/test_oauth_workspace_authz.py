@@ -21,6 +21,17 @@ from skypilot_tpu.users import oauth as oauth_lib
 from skypilot_tpu.workspaces import core as workspaces_core
 
 
+@pytest.fixture(autouse=True)
+def _config_isolation(monkeypatch):
+    """Tests here point XSKY_CONFIG at tmp files and reload; restore
+    the env FIRST, then reload, so no tmp config leaks into later
+    modules (the loader caches process-wide)."""
+    yield
+    monkeypatch.undo()
+    from skypilot_tpu import config as config_lib
+    config_lib.reload_config()
+
+
 @pytest.fixture
 def clean_state(monkeypatch, tmp_path):
     monkeypatch.setenv('XSKY_STATE_DB', str(tmp_path / 'state.db'))
@@ -359,6 +370,68 @@ class TestClientAutoRefresh:
         saved = yaml.safe_load(cfg.read_text())['api_server']
         assert saved['token'] == good
         assert saved['refresh_token'] == 'rt_rotated'
+        config_lib.reload_config()
+
+
+class TestRefreshLifecycle:
+
+    def test_refresh_rearms_after_success(self, monkeypatch, tmp_path):
+        """A successful refresh must re-arm (long poll loops outlive
+        one access token); a failed one latches off (code-review r5)."""
+        import yaml
+
+        from skypilot_tpu import config as config_lib
+        from skypilot_tpu.client import remote_client
+        cfg = tmp_path / 'cfg.yaml'
+        cfg.write_text(yaml.safe_dump({'api_server': {
+            'refresh_token': 'rt_good'}}))
+        monkeypatch.setenv('XSKY_CONFIG', str(cfg))
+        monkeypatch.setenv('XSKY_OAUTH_ISSUER', 'https://idp')
+        config_lib.reload_config()
+        calls = []
+        monkeypatch.setattr(
+            oauth_lib, 'refresh_access_token',
+            lambda rt, opener=None: (calls.append(rt),
+                                     {'access_token': f't{len(calls)}'}
+                                     )[1])
+        client = remote_client.RemoteClient.__new__(
+            remote_client.RemoteClient)
+
+        class _H:
+            headers = {}
+        client._client = _H()
+        assert client._try_oauth_refresh()
+        assert client._try_oauth_refresh()   # re-armed after success
+        assert len(calls) == 2
+        monkeypatch.setattr(
+            oauth_lib, 'refresh_access_token',
+            lambda rt, opener=None: (_ for _ in ()).throw(
+                oauth_lib.OAuthError('revoked')))
+        assert not client._try_oauth_refresh()
+        assert not client._try_oauth_refresh()   # latched off
+        config_lib.reload_config()
+
+    def test_static_login_clears_stale_refresh_token(self, monkeypatch,
+                                                     tmp_path):
+        """Re-login with a static token must drop the old OAuth
+        refresh token — it would silently rotate auth back to the
+        previous identity on the next 401 (code-review r5)."""
+        import yaml
+
+        from skypilot_tpu import config as config_lib
+        cfg = tmp_path / 'cfg.yaml'
+        cfg.write_text(yaml.safe_dump({'api_server': {
+            'endpoint': 'http://old', 'token': 'oat_old',
+            'refresh_token': 'rt_old'}}))
+        monkeypatch.setenv('XSKY_CONFIG', str(cfg))
+        config_lib.reload_config()
+        config_lib.update_user_config_section(
+            'api_server',
+            {'endpoint': 'http://new', 'token': 'xsky_static'},
+            remove=('refresh_token',))
+        saved = yaml.safe_load(cfg.read_text())['api_server']
+        assert saved == {'endpoint': 'http://new',
+                         'token': 'xsky_static'}
         config_lib.reload_config()
 
 
