@@ -31,23 +31,64 @@ _STR_OR_NUM = {'type': ['string', 'number']}
 _STR_MAP = {'type': 'object', 'additionalProperties': {
     'type': ['string', 'number', 'boolean', 'null']}}
 
+#: accelerator_args keys are the full set the TPU deploy path reads
+#: (clouds/gcp.py:111-173, utils/tpu_topology.py:161-238).
+_ACCELERATOR_ARGS_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'topology': _STR,
+        'num_slices': _INT,
+        'runtime_version': _STR,
+        'use_queued_resources': _BOOL,
+        'provisioning_model': {
+            'enum': ['standard', 'spot', 'reserved', 'queued']},
+        'reservation': _STR,
+        'provision_timeout': _NUM,
+        'tpu_vm': _BOOL,
+    },
+}
+
+#: resources.autostop: 10 / true / {idle_minutes, down}
+#: (resources.py _canonical_autostop).
+_AUTOSTOP_SCHEMA: Dict[str, Any] = {
+    'type': ['boolean', 'integer', 'object'],
+    'additionalProperties': False,
+    'properties': {
+        'idle_minutes': _INT,
+        'down': _BOOL,
+    },
+}
+
+_JOB_RECOVERY_SCHEMA: Dict[str, Any] = {
+    'type': ['string', 'object'],
+    'additionalProperties': False,
+    'properties': {
+        'strategy': _STR,
+        'max_restarts_on_errors': _INT,
+    },
+}
+
 _RESOURCES_FIELDS: Dict[str, Any] = {
     'cloud': _STR,
     'instance_type': _STR,
     'cpus': _STR_OR_NUM,
     'memory': _STR_OR_NUM,
-    'accelerators': {'type': ['string', 'object']},
-    'accelerator_args': {'type': 'object'},
+    # Object form maps accelerator name → count.
+    'accelerators': {'type': ['string', 'object'],
+                     'additionalProperties': _NUM},
+    'accelerator_args': _ACCELERATOR_ARGS_SCHEMA,
     'use_spot': _BOOL,
-    'job_recovery': {'type': ['string', 'object']},
+    'job_recovery': _JOB_RECOVERY_SCHEMA,
     'region': _STR,
     'zone': _STR,
     'image_id': _STR,
     'disk_size': _INT,
     'disk_tier': {'enum': ['low', 'medium', 'high', 'ultra', 'best']},
-    'ports': {'type': ['integer', 'string', 'array']},
+    'ports': {'type': ['integer', 'string', 'array'],
+              'items': {'type': ['integer', 'string']}},
     'labels': _STR_MAP,
-    'autostop': {'type': ['boolean', 'integer', 'string', 'object']},
+    'autostop': _AUTOSTOP_SCHEMA,
     'volumes': {'type': 'array', 'items': {
         'type': 'object', 'additionalProperties': False,
         'properties': {
@@ -91,11 +132,22 @@ _REPLICA_POLICY_SCHEMA: Dict[str, Any] = {
     },
 }
 
+#: readiness_probe: a path string, or {path, initial_delay_seconds}
+#: (serve/service_spec.py:60-68).
+_READINESS_PROBE_SCHEMA: Dict[str, Any] = {
+    'type': ['string', 'object'],
+    'additionalProperties': False,
+    'properties': {
+        'path': _STR,
+        'initial_delay_seconds': _NUM,
+    },
+}
+
 _SERVICE_SCHEMA: Dict[str, Any] = {
     'type': 'object',
     'additionalProperties': False,
     'properties': {
-        'readiness_probe': {'type': ['string', 'object']},
+        'readiness_probe': _READINESS_PROBE_SCHEMA,
         'replica_policy': _REPLICA_POLICY_SCHEMA,
         'replicas': _INT,
         'port': _INT,
@@ -163,12 +215,38 @@ CONFIG_SCHEMA: Dict[str, Any] = {
             'properties': {'controller': {
                 'type': 'object', 'additionalProperties': False,
                 'properties': {'resources': _RESOURCES_SCHEMA}}}},
-        'logs': {'type': 'object'},
-        'usage': {'type': 'object'},
-        'kubernetes': {'type': 'object'},
-        'ssh': {'type': 'object'},
-        'docker': {'type': 'object'},
-        'aws': {'type': 'object'},
+        'logs': {
+            'type': 'object', 'additionalProperties': False,
+            'properties': {
+                'store': {'enum': ['gcp', 'aws']},
+                # Agent-specific knobs (logs/gcp.py, logs/aws.py).
+                'labels': _STR_MAP,
+                'log_glob': _STR,
+                'region': _STR,
+                'log_group': _STR,
+            }},
+        'usage': {
+            'type': 'object', 'additionalProperties': False,
+            'properties': {'enabled': _BOOL, 'endpoint': _STR}},
+        'kubernetes': {
+            'type': 'object', 'additionalProperties': False,
+            'properties': {
+                'networking_mode': {'enum': ['nodeport', 'portforward']},
+                'fuse_proxy_image': _STR,
+            }},
+        'ssh': {
+            'type': 'object', 'additionalProperties': False,
+            'properties': {'pools_file': _STR}},
+        'docker': {
+            'type': 'object', 'additionalProperties': False,
+            'properties': {'run_options': {
+                'type': ['string', 'array'], 'items': _STR}}},
+        'aws': {
+            'type': 'object', 'additionalProperties': False,
+            'properties': {
+                'security_group': _STR,
+                'labels': _STR_MAP,
+            }},
     },
 }
 
