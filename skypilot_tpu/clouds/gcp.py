@@ -127,7 +127,41 @@ class GCP(catalog_cloud.CatalogCloud):
         elif resources.accelerators:
             name, count = next(iter(resources.accelerators.items()))
             vars.update({'gpu_type': name, 'gpu_count': count})
+            self._apply_gpu_capacity_model(
+                vars, resources.accelerator_args or {})
         return vars
+
+    @staticmethod
+    def _apply_gpu_capacity_model(vars: Dict[str, Any],
+                                  args: Dict[str, Any]) -> None:
+        """GPU VM twin of the TPU capacity model (reference:
+        sky/provision/gcp/mig_utils.py DWS MIGs + reservation-aware
+        placement): 'reserved' pins a specific reservation on the VM
+        body; 'flex-start' provisions through a DWS MIG resize request
+        instead of failing immediately on stockout."""
+        model = args.get('provisioning_model', 'standard')
+        known = ('standard', 'spot', 'reserved', 'flex-start', 'auto')
+        if model not in known:
+            raise exceptions.InvalidRequestError(
+                f'Unknown provisioning_model {model!r}; expected one '
+                f'of {known}.')
+        if model == 'spot':
+            vars['use_spot'] = True
+        elif model == 'reserved':
+            if not args.get('reservation'):
+                raise exceptions.InvalidRequestError(
+                    "provisioning_model 'reserved' requires "
+                    "accelerator_args.reservation")
+            vars['use_spot'] = False
+        elif model == 'flex-start':
+            vars['gpu_dws'] = True
+            vars['provision_timeout_s'] = float(
+                args.get('provision_timeout', 1800))
+            if args.get('dws_run_duration'):
+                vars['dws_run_duration_s'] = float(
+                    args['dws_run_duration'])
+        if args.get('reservation') and model in ('standard', 'reserved'):
+            vars['reservation'] = args['reservation']
 
     @staticmethod
     def _apply_tpu_capacity_model(vars: Dict[str, Any],

@@ -118,6 +118,53 @@ class ComputeClient:
         raise exceptions.ProvisionError(
             f'Timed out waiting for compute operation {name}')
 
+    # ---- MIG / DWS (GPU flex-start capacity) ---------------------------
+
+    def insert_instance_template(self, body: Dict[str, Any]
+                                 ) -> Dict[str, Any]:
+        return self.t.request(
+            'POST', f'{self.global_prefix}/instanceTemplates', body=body)
+
+    def delete_instance_template(self, name: str) -> Dict[str, Any]:
+        return self.t.request(
+            'DELETE', f'{self.global_prefix}/instanceTemplates/{name}')
+
+    def insert_mig(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        return self.t.request(
+            'POST', f'{self.prefix}/instanceGroupManagers', body=body)
+
+    def get_mig(self, name: str) -> Optional[Dict[str, Any]]:
+        try:
+            return self.t.request(
+                'GET', f'{self.prefix}/instanceGroupManagers/{name}')
+        except rest.GcpApiError as e:
+            if e.status == 404:
+                return None
+            raise
+
+    def delete_mig(self, name: str) -> Dict[str, Any]:
+        return self.t.request(
+            'DELETE', f'{self.prefix}/instanceGroupManagers/{name}')
+
+    def insert_resize_request(self, mig: str,
+                              body: Dict[str, Any]) -> Dict[str, Any]:
+        return self.t.request(
+            'POST',
+            f'{self.prefix}/instanceGroupManagers/{mig}/resizeRequests',
+            body=body)
+
+    def get_resize_request(self, mig: str,
+                           name: str) -> Dict[str, Any]:
+        return self.t.request(
+            'GET', f'{self.prefix}/instanceGroupManagers/{mig}'
+                   f'/resizeRequests/{name}')
+
+    def list_managed_instances(self, mig: str) -> List[Dict[str, Any]]:
+        out = self.t.request(
+            'POST', f'{self.prefix}/instanceGroupManagers/{mig}'
+                    '/listManagedInstances')
+        return out.get('managedInstances', [])
+
     # ---- firewalls (global resources; ports exposure) ------------------
 
     @property
@@ -239,6 +286,81 @@ def vm_body(node_config: Dict[str, Any], cluster_name: str, vm_name: str,
             'provisioningModel': 'SPOT',
             'instanceTerminationAction': 'DELETE',
         })
+    if node_config.get('reservation'):
+        # Pin to a specific reservation (twin of the reference's
+        # reservation-aware placement, sky/clouds/gcp.py specific_
+        # reservations): capacity comes from the named block, never
+        # opportunistically from open reservations.
+        body['reservationAffinity'] = {
+            'consumeReservationType': 'SPECIFIC_RESERVATION',
+            'key': 'compute.googleapis.com/reservation-name',
+            'values': [node_config['reservation']],
+        }
+    if node_config.get('service_account'):
+        body['serviceAccounts'] = [{
+            'email': node_config['service_account'],
+            'scopes': ['https://www.googleapis.com/auth/cloud-platform'],
+        }]
+    return body
+
+
+# ---- MIG / DWS flex-start (twin of sky/provision/gcp/mig_utils.py) ---------
+
+
+def mig_name(cluster_name: str) -> str:
+    return f'xsky-mig-{cluster_name}'[:63].rstrip('-')
+
+
+def instance_template_body(node_config: Dict[str, Any],
+                           cluster_name: str,
+                           zone: str) -> Dict[str, Any]:
+    """Instance template wrapping vm_body's properties: the MIG stamps
+    cluster-labeled VMs from it, so list_cluster/get_cluster_info find
+    DWS-provisioned instances exactly like directly-inserted ones."""
+    props = vm_body(node_config, cluster_name,
+                    vm_name='unused', zone=zone, is_head=True,
+                    node_index=0)
+    props.pop('name')
+    # Templates take bare machine-type names, not zonal URLs; labels
+    # drop the per-node identity (the MIG names instances itself —
+    # host identity comes from instance enumeration order).
+    props['machineType'] = props['machineType'].rsplit('/', 1)[-1]
+    for label in (HEAD_LABEL, 'xsky-node-index'):
+        props['labels'].pop(label, None)
+    return {
+        'name': mig_name(cluster_name),
+        'properties': props,
+    }
+
+
+def mig_body(cluster_name: str, project: str,
+             template_name: str) -> Dict[str, Any]:
+    return {
+        'name': mig_name(cluster_name),
+        'instanceTemplate': (f'projects/{project}/global/'
+                             f'instanceTemplates/{template_name}'),
+        'baseInstanceName': cluster_name,
+        # DWS requires the MIG itself to start empty; capacity arrives
+        # through resize requests.
+        'targetSize': 0,
+        'instanceLifecyclePolicy': {
+            'defaultActionOnFailure': 'DO_NOTHING'},
+        'updatePolicy': {'type': 'OPPORTUNISTIC'},
+    }
+
+
+def resize_request_body(cluster_name: str, count: int,
+                        run_duration_s: Optional[float] = None
+                        ) -> Dict[str, Any]:
+    body: Dict[str, Any] = {
+        'name': f'{mig_name(cluster_name)}-rr',
+        'resizeBy': count,
+    }
+    if run_duration_s:
+        # DWS run duration: the capacity is granted for this window
+        # then reclaimed (flex-start contract).
+        body['requestedRunDuration'] = {
+            'seconds': str(int(run_duration_s))}
     return body
 
 

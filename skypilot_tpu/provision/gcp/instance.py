@@ -240,12 +240,84 @@ def _create_via_queued_resources(tpu: tpu_api.TpuClient, cluster_name: str,
             created.append(node_id)
 
 
+def _run_vms_via_mig(gce, zone: str, cluster_name: str,
+                     config: common.ProvisionConfig):
+    """DWS flex-start for GPU VMs: instance template → empty MIG →
+    resize request, then poll until the queued capacity materializes
+    (twin of sky/provision/gcp/mig_utils.py:1-210). The template
+    carries the cluster label, so every later lifecycle op sees MIG
+    instances exactly like directly-inserted ones."""
+    node_cfg = config.node_config
+    timeout = float(node_cfg.get('provision_timeout_s', 1800))
+    poll = float(node_cfg.get('qr_poll_interval_s',
+                              min(10.0, max(1.0, timeout / 60))))
+    existing = gce.list_cluster(cluster_name)
+    if len(existing) >= config.count:
+        return [], [], sorted(i['name'] for i in existing)[0]
+    if gce.get_mig(compute_api.mig_name(cluster_name)) is None:
+        template = compute_api.instance_template_body(
+            node_cfg, cluster_name, zone)
+        gce.wait_global_operation(
+            gce.insert_instance_template(template))
+        gce.wait_operation(gce.insert_mig(compute_api.mig_body(
+            cluster_name, gce.project, template['name'])))
+        run_duration = node_cfg.get('dws_run_duration_s')
+        gce.insert_resize_request(
+            compute_api.mig_name(cluster_name),
+            compute_api.resize_request_body(
+                cluster_name, config.count - len(existing),
+                run_duration))
+    deadline = time.time() + timeout
+    while True:
+        rr = gce.get_resize_request(
+            compute_api.mig_name(cluster_name),
+            f'{compute_api.mig_name(cluster_name)}-rr')
+        state = rr.get('state', 'ACCEPTED')
+        if state == 'SUCCEEDED':
+            break
+        if state in ('FAILED', 'CANCELLED'):
+            _teardown_mig(gce, cluster_name)
+            raise exceptions.CapacityError(
+                f'DWS resize request for {cluster_name} entered '
+                f'{state} in {zone}: '
+                f'{rr.get("status", {}).get("error", "")}')
+        if time.time() > deadline:
+            _teardown_mig(gce, cluster_name)
+            raise exceptions.QueuedResourceTimeoutError(
+                f'DWS capacity for {cluster_name} not granted within '
+                f'{timeout}s in {zone} (last state: {state}).')
+        time.sleep(poll)
+    instances = gce.list_cluster(cluster_name)
+    created = sorted(set(i['name'] for i in instances) -
+                     set(i['name'] for i in existing))
+    head = sorted(i['name'] for i in instances)[0] if instances else None
+    return created, [], head
+
+
+def _teardown_mig(gce, cluster_name: str) -> None:
+    """Best-effort MIG + template teardown (instances die with the
+    MIG)."""
+    name = compute_api.mig_name(cluster_name)
+    if gce.get_mig(name) is not None:
+        try:
+            gce.wait_operation(gce.delete_mig(name))
+        except rest.GcpApiError as e:
+            logger.warning(f'Deleting MIG {name}: {e}')
+    try:
+        gce.wait_global_operation(gce.delete_instance_template(name))
+    except rest.GcpApiError as e:
+        if e.status != 404:
+            logger.warning(f'Deleting instance template {name}: {e}')
+
+
 def _run_vms(zone: str, cluster_name: str, config: common.ProvisionConfig):
     _, gce = _clients(config.provider_config, zone)
     volumes = config.node_config.get('volumes') or []
     # Fail BEFORE any VM is inserted: a post-create volume error would
     # strand billed instances behind a no-failover config error.
     compute_api.validate_volumes(volumes, config.count)
+    if config.node_config.get('gpu_dws'):
+        return _run_vms_via_mig(gce, zone, cluster_name, config)
     existing = gce.list_cluster(cluster_name)
     by_name = {i['name']: i for i in existing}
     created: List[str] = []
@@ -346,6 +418,10 @@ def terminate_instances(cluster_name: str,
     zone = _zone_of(provider_config)
     tpu, gce = _clients(provider_config, zone)
     _teardown_tpu(tpu, cluster_name)
+    # DWS clusters: the MIG owns its instances — delete it first (and
+    # its template) so the per-instance deletes below are no-ops.
+    if gce.get_mig(compute_api.mig_name(cluster_name)) is not None:
+        _teardown_mig(gce, cluster_name)
     ops = []
     for inst in gce.list_cluster(cluster_name):
         try:

@@ -41,10 +41,13 @@ _ACCELERATOR_ARGS_SCHEMA: Dict[str, Any] = {
         'num_slices': _INT,
         'runtime_version': _STR,
         'use_queued_resources': _BOOL,
+        # Keep in lockstep with clouds/gcp.py _apply_capacity_model.
         'provisioning_model': {
-            'enum': ['standard', 'spot', 'reserved', 'queued']},
+            'enum': ['standard', 'spot', 'reserved', 'flex-start',
+                     'auto']},
         'reservation': _STR,
         'provision_timeout': _NUM,
+        'dws_run_duration': _NUM,
         'tpu_vm': _BOOL,
     },
 }

@@ -27,6 +27,10 @@ class FakeGcp:
         self.queued: Dict[str, Dict[str, Any]] = {}
         self.disks: Dict[str, Dict[str, Any]] = {}
         self.firewalls: Dict[str, Dict[str, Any]] = {}
+        self.templates: Dict[str, Dict[str, Any]] = {}
+        self.migs: Dict[str, Dict[str, Any]] = {}
+        self.resize_requests: Dict[str, Dict[str, Any]] = {}
+        self.rr_states: list = []     # scripted resize-request states
         self.fail_create: Optional[rest.GcpApiError] = None
         self.qr_states: list = []     # scripted QR state sequence
         self.num_hosts = 1
@@ -220,9 +224,78 @@ class FakeGcp:
                 raise err
             self.firewalls[body['name']] = dict(body)
             return {'name': f'insert-fw-{body["name"]}'}
+        m = re.search(r'/global/instanceTemplates(?:/([^/]+))?$', path)
+        if m and method == 'POST':
+            self.templates[body['name']] = dict(body)
+            return {'name': f'insert-tpl-{body["name"]}'}
+        if m and method == 'DELETE':
+            if m.group(1) not in self.templates:
+                raise rest.GcpApiError(404, 'notFound', 'no template')
+            self.templates.pop(m.group(1))
+            return {'name': 'del-tpl'}
+        m = re.search(
+            r'/instanceGroupManagers/([^/]+)/resizeRequests$', path)
+        if m and method == 'POST':
+            self.resize_requests[body['name']] = dict(
+                body, state='ACCEPTED', mig=m.group(1))
+            return {'name': f'insert-rr-{body["name"]}'}
+        m = re.search(
+            r'/instanceGroupManagers/([^/]+)/resizeRequests/([^/]+)$',
+            path)
+        if m and method == 'GET':
+            rr = self.resize_requests[m.group(2)]
+            if self.rr_states:
+                rr['state'] = self.rr_states.pop(0)
+                if rr['state'] == 'SUCCEEDED':
+                    self._materialize_mig(rr)
+            return rr
+        m = re.search(
+            r'/instanceGroupManagers/([^/]+)/listManagedInstances$', path)
+        if m and method == 'POST':
+            mig = self.migs.get(m.group(1), {})
+            return {'managedInstances': [
+                {'instance': f'.../instances/{n}'}
+                for n in mig.get('instances', [])]}
+        m = re.search(r'/instanceGroupManagers(?:/([^/]+))?$', path)
+        if m and method == 'POST':
+            self.migs[body['name']] = dict(body, instances=[])
+            return {'name': f'insert-mig-{body["name"]}'}
+        if m and method == 'GET':
+            mig = self.migs.get(m.group(1))
+            if mig is None:
+                raise rest.GcpApiError(404, 'notFound', 'no mig')
+            return mig
+        if m and method == 'DELETE':
+            mig = self.migs.pop(m.group(1), None)
+            if mig is None:
+                raise rest.GcpApiError(404, 'notFound', 'no mig')
+            for name in mig.get('instances', []):
+                self.vms.pop(name, None)
+            return {'name': 'del-mig'}
         if '/operations/' in path:
             return {'status': 'DONE'}
         raise AssertionError(f'unhandled compute call {method} {path}')
+
+    def _materialize_mig(self, rr: Dict[str, Any]) -> None:
+        """A SUCCEEDED resize request stamps VMs from the MIG's
+        template (labels included, like the real control plane)."""
+        mig = self.migs[rr['mig']]
+        template = self.templates[
+            mig['instanceTemplate'].rsplit('/', 1)[-1]]
+        for i in range(int(rr.get('resizeBy', 0))):
+            name = f"{mig['baseInstanceName']}-{len(mig['instances'])}"
+            self.vms[name] = {
+                'name': name,
+                'status': 'RUNNING',
+                'labels': dict(
+                    template['properties'].get('labels', {})),
+                'networkInterfaces': [{
+                    'networkIP': f'10.3.0.{len(self.vms) + 1}',
+                    'accessConfigs': [{'natIP':
+                                       f'35.3.0.{len(self.vms) + 1}'}],
+                }],
+            }
+            mig['instances'].append(name)
 
 
 @pytest.fixture()
@@ -685,3 +758,91 @@ def test_node_bodies_carry_cluster_tag(fake_gcp):
     body = compute_api.vm_body({'instance_type': 'n2-standard-8'}, 'cvm',
                                'cvm-0', 'us-central2-b', True, 0)
     assert 'xsky-cvm' in body['tags']['items']
+
+
+# ---- GPU VMs: reservations + DWS via MIG (VERDICT r4 #7) -----------------
+
+
+def _gpu_config(count=1, **node_extra):
+    node = {'instance_type': 'a2-highgpu-1g', 'gpu_type': 'nvidia-a100',
+            'gpu_count': 1, 'provision_timeout_s': 1,
+            'qr_poll_interval_s': 0.01}
+    node.update(node_extra)
+    return common.ProvisionConfig(provider_config=dict(PROVIDER),
+                                  node_config=node, count=count)
+
+
+def test_gpu_vm_reservation_affinity(fake_gcp):
+    gcp_instance.run_instances('us-central2', 'us-central2-b', 'resv',
+                               _gpu_config(reservation='block-a'))
+    vm = fake_gcp.vms['resv-0']
+    # The insert body's reservationAffinity pins the named block.
+    body = gcp_instance.compute_api.vm_body(
+        {'instance_type': 'a2-highgpu-1g', 'reservation': 'block-a'},
+        'resv', 'resv-0', 'us-central2-b', True, 0)
+    aff = body['reservationAffinity']
+    assert aff['consumeReservationType'] == 'SPECIFIC_RESERVATION'
+    assert aff['values'] == ['block-a']
+    assert vm['status'] == 'RUNNING'
+
+
+def test_gpu_dws_provisions_via_mig(fake_gcp):
+    fake_gcp.rr_states = ['ACCEPTED', 'SUCCEEDED']
+    record = gcp_instance.run_instances(
+        'us-central2', 'us-central2-b', 'dws',
+        _gpu_config(count=2, gpu_dws=True))
+    assert sorted(record.created_instance_ids) == ['dws-0', 'dws-1']
+    # Template + MIG + resize request all exist; instances carry the
+    # cluster label so lifecycle ops find them.
+    assert 'xsky-mig-dws' in fake_gcp.templates
+    assert 'xsky-mig-dws' in fake_gcp.migs
+    assert fake_gcp.vms['dws-0']['labels']['xsky-cluster'] == 'dws'
+    statuses = gcp_instance.query_instances('dws', PROVIDER)
+    assert set(statuses.values()) == {'RUNNING'}
+    # Teardown reaps MIG + template + instances.
+    gcp_instance.terminate_instances('dws', PROVIDER)
+    assert fake_gcp.migs == {} and fake_gcp.templates == {}
+    assert gcp_instance.query_instances('dws', PROVIDER) == {}
+
+
+def test_gpu_dws_timeout_is_capacity_scoped(fake_gcp):
+    fake_gcp.rr_states = ['ACCEPTED'] * 1000
+    with pytest.raises(exceptions.QueuedResourceTimeoutError):
+        gcp_instance.run_instances('us-central2', 'us-central2-b',
+                                   'dwt', _gpu_config(gpu_dws=True))
+    # Failed request cleans up its MIG/template so failover can retry
+    # elsewhere without name collisions.
+    assert fake_gcp.migs == {} and fake_gcp.templates == {}
+
+
+def test_gpu_dws_failed_state_raises_capacity_error(fake_gcp):
+    fake_gcp.rr_states = ['FAILED']
+    with pytest.raises(exceptions.CapacityError):
+        gcp_instance.run_instances('us-central2', 'us-central2-b',
+                                   'dwf', _gpu_config(gpu_dws=True))
+    assert fake_gcp.migs == {}
+
+
+def test_gpu_capacity_model_deploy_vars():
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.clouds import gcp as gcp_cloud
+    cloud = gcp_cloud.GCP()
+    res = resources_lib.Resources(
+        cloud='gcp', accelerators={'nvidia-a100': 1},
+        instance_type='a2-highgpu-1g',
+        accelerator_args={'provisioning_model': 'flex-start',
+                          'provision_timeout': 120,
+                          'dws_run_duration': 3600})
+    vars = cloud.make_deploy_resources_variables(
+        res, 'c', 'us-central2', 'us-central2-b')
+    assert vars['gpu_dws'] is True
+    assert vars['provision_timeout_s'] == 120
+    assert vars['dws_run_duration_s'] == 3600
+    res2 = resources_lib.Resources(
+        cloud='gcp', accelerators={'nvidia-a100': 1},
+        instance_type='a2-highgpu-1g',
+        accelerator_args={'provisioning_model': 'reserved',
+                          'reservation': 'block-a'})
+    vars2 = cloud.make_deploy_resources_variables(
+        res2, 'c', 'us-central2', 'us-central2-b')
+    assert vars2['reservation'] == 'block-a'
