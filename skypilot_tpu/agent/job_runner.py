@@ -32,6 +32,32 @@ def _build_runners(info: provision_common.ClusterInfo):
         internal_ips=True)
 
 
+def _resolve_commands(spec, host_envs):
+    """(setup_cmd, run_cmd, cwd) for the gang launch.
+
+    With spec['docker_container'] (image_id: docker:…), the task's
+    commands execute inside the keep-alive container via docker exec;
+    per-host env values are exported on the host and forwarded by
+    name, and the cd happens inside the container (the host cwd is
+    meaningless there).
+    """
+    cwd = spec.get('cwd')  # same dir for setup and run
+    setup_cmd = spec.get('setup')
+    run_cmd = spec.get('run')
+    container = spec.get('docker_container')
+    if container:
+        from skypilot_tpu.utils import docker_utils
+        env_keys = list(host_envs[0]) if host_envs else []
+        if setup_cmd:
+            setup_cmd = docker_utils.exec_wrap(
+                setup_cmd, env_keys, cwd=cwd, container=container)
+        if run_cmd:
+            run_cmd = docker_utils.exec_wrap(
+                run_cmd, env_keys, cwd=cwd, container=container)
+        cwd = None
+    return setup_cmd, run_cmd, cwd
+
+
 def run_job(job_id: int, root: str = None) -> int:
     root = root or job_lib.cluster_root()
     job = job_lib.get_job(job_id, root)
@@ -48,8 +74,7 @@ def run_job(job_id: int, root: str = None) -> int:
         for env in host_envs:
             env['XSKY_JOB_ID'] = str(job_id)
 
-        cwd = spec.get('cwd')  # same dir for setup and run
-        setup_cmd = spec.get('setup')
+        setup_cmd, run_spec_cmd, cwd = _resolve_commands(spec, host_envs)
         if setup_cmd:
             job_lib.set_status(job_id, job_lib.JobStatus.SETTING_UP, root)
             result = gang.gang_launch(runners, host_envs, setup_cmd,
@@ -60,7 +85,7 @@ def run_job(job_id: int, root: str = None) -> int:
                                    root)
                 return 1
 
-        run_cmd = spec.get('run')
+        run_cmd = run_spec_cmd
         if not run_cmd:
             job_lib.set_status(job_id, job_lib.JobStatus.SUCCEEDED, root)
             return 0

@@ -257,11 +257,44 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
         if rc != 0:
             raise exceptions.ClusterSetUpError(
                 f'Failed to initialize cluster runtime: {stderr}')
+        image = self._docker_image(handle)
+        if image is not None:
+            # Per-task container runtime (image_id: docker:…): install
+            # docker, pull, start the keep-alive container on every
+            # host. Task setup/run then execute inside it (docker_utils
+            # module docstring has the layout contract).
+            from skypilot_tpu.utils import docker_utils
+            init = docker_utils.initialize_command(image)
+            for rank, runner in enumerate(runners):
+                rc, _, stderr = runner.run(init, require_outputs=True)
+                if rc != 0:
+                    raise exceptions.ClusterSetUpError(
+                        f'Docker runtime init failed on host {rank}: '
+                        f'{stderr.strip()[:500]}')
         if not handle.is_local_provider:
             head.run_async(
                 f'{self._head_python(handle)} -m skypilot_tpu.agent.daemon',
                 env=self._agent_env(handle),
                 log_path=None)
+
+    @staticmethod
+    def _docker_image(handle: ClusterHandle) -> Optional[str]:
+        """The task container image, or None for host execution.
+
+        Kubernetes/docker providers already ARE containers — the pod
+        image handles `docker:` there, not a nested runtime.
+        """
+        from skypilot_tpu.utils import docker_utils
+        if handle.provider_name in ('kubernetes', 'docker'):
+            return None
+        if handle.is_local_provider:
+            # Fake/local hosts are plain processes — no docker daemon
+            # to initialize; command construction is unit-tested.
+            return None
+        image_id = handle.launched_resources.image_id
+        if docker_utils.is_docker_image(image_id):
+            return docker_utils.image_of(image_id)
+        return None
 
     def _bootstrap_host(self, handle: ClusterHandle,
                         runner: runner_lib.CommandRunner,
@@ -379,8 +412,14 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
         runners = handle.get_command_runners()
         env = dict(task.envs_and_secrets)
         cwd = self._job_cwd(handle, task)
+        setup_cmd = task.setup
+        image = self._docker_image(handle)
+        if image is not None:
+            from skypilot_tpu.utils import docker_utils
+            setup_cmd = docker_utils.exec_wrap(setup_cmd, env, cwd=cwd)
+            cwd = None   # cd happens inside the container
         for rank, runner in enumerate(runners):
-            rc, out, err = runner.run(task.setup, env=env, cwd=cwd,
+            rc, out, err = runner.run(setup_cmd, env=env, cwd=cwd,
                                       require_outputs=True)
             if rc != 0:
                 raise exceptions.ClusterSetUpError(
@@ -400,11 +439,18 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             ips = handle.cluster_info.get_feasible_ips(internal=True)
             cmds = {r: run_cmd(r, ips) for r in range(task.num_nodes)}
             run_cmd = _dispatch_script(cmds)
+        from skypilot_tpu.utils import docker_utils
         spec = {
             'run': run_cmd,
             'envs': task.envs_and_secrets,
             'num_nodes': task.num_nodes,
             'cwd': self._job_cwd(handle, task),
+            # Container runtime: the on-host job runner wraps setup/run
+            # with `docker exec` into this container (env forwarded by
+            # name so per-rank gang env arrives intact).
+            'docker_container': (docker_utils.CONTAINER_NAME
+                                 if self._docker_image(handle) is not None
+                                 else None),
         }
         job_id = self._submit_job(handle, task.name, spec)
         state.update_last_use(handle.cluster_name)

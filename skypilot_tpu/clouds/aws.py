@@ -13,6 +13,7 @@ import typing
 from typing import Any, Dict, Optional, Tuple
 
 from skypilot_tpu.clouds import catalog_cloud
+from skypilot_tpu.utils import docker_utils
 from skypilot_tpu.utils import registry
 
 if typing.TYPE_CHECKING:
@@ -44,7 +45,13 @@ class AWS(catalog_cloud.CatalogCloud):
             'disk_size': resources.disk_size,
             'ports': resources.ports,
             'labels': dict(resources.labels or {}),
-            'image_id': resources.image_id or _DEFAULT_AMIS.get(region),
+            # docker: image_ids are a task container on a default AMI,
+            # not an AMI (backend docker runtime).
+            'image_id': (
+                _DEFAULT_AMIS.get(region)
+                if (resources.image_id is None or
+                    docker_utils.is_docker_image(resources.image_id))
+                else resources.image_id),
         }
         if resources.accelerators:
             name, count = next(iter(resources.accelerators.items()))

@@ -14,6 +14,7 @@ from typing import Any, Dict, Optional, Tuple
 from skypilot_tpu import exceptions
 from skypilot_tpu.clouds import catalog_cloud
 from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import docker_utils
 from skypilot_tpu.utils import registry
 from skypilot_tpu.utils import tpu_topology
 
@@ -94,7 +95,10 @@ class GCP(catalog_cloud.CatalogCloud):
             'disk_size': resources.disk_size,
             'ports': resources.ports,
             'labels': dict(resources.labels or {}),
-            'image_id': resources.image_id,
+            # docker: image_ids are a task CONTAINER on a default-image
+            # VM (backend docker runtime), never a VM source image.
+            'image_id': (None if docker_utils.is_docker_image(
+                resources.image_id) else resources.image_id),
             # Our keypair rides the `ssh-keys` metadata entry (both the
             # compute and TPU create bodies forward node_config
             # metadata) so freshly created hosts are reachable without

@@ -20,6 +20,7 @@ import typing
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import docker_utils
 from skypilot_tpu.utils import registry
 from skypilot_tpu.utils import tpu_topology
 
@@ -180,8 +181,12 @@ class Kubernetes(cloud_lib.Cloud):
                 'kubernetes/namespace', 'default'),
             'cpus': cpus,
             'memory_gib': memory,
-            'image_id': resources.image_id or
-                        'python:3.11-slim',
+            # Pods ARE containers: a docker: image_id is simply the
+            # pod image (no nested runtime).
+            'image_id': (docker_utils.image_of(resources.image_id)
+                         if docker_utils.is_docker_image(
+                             resources.image_id)
+                         else resources.image_id) or 'python:3.11-slim',
             'labels': dict(resources.labels or {}),
             'ports': resources.ports,
         }

@@ -1,0 +1,83 @@
+"""Per-task container runtime on provisioned VMs (``image_id: docker:…``).
+
+Behavioral twin of sky/provision/docker_utils.py:1-469, redesigned for
+this repo's agent architecture: the reference initializes docker over
+SSH and re-homes its whole runtime inside the container; here the host
+keeps the agent/runtime (wheel venv, job queue, log watch) and only the
+TASK'S setup/run commands execute inside the container via
+``docker exec``. That keeps one runtime path for all image types — the
+container is a task sandbox, not a second runtime to bootstrap.
+
+Layout contract:
+  * The container mounts the host ``$HOME`` at the same path and uses
+    it as its working directory, so workdir rsyncs, file_mounts and
+    setup artifacts (venvs under ``sky_workdir``) are shared verbatim.
+  * ``--net=host`` — ports behave exactly like host execution (serve
+    endpoints, jax.distributed coordinator).
+  * ``--privileged`` — TPU/GPU device access (``/dev/accel*``,
+    ``/dev/nvidia*``) without per-device flags.
+  * Env forwarding rides ``docker exec -e KEY`` (no value): the gang
+    launcher exports per-host values on the host, docker copies them
+    into the container, so per-rank TPU_WORKER_ID / coordinator env
+    arrives untouched.
+"""
+from __future__ import annotations
+
+import shlex
+from typing import Iterable, Optional
+
+DOCKER_IMAGE_PREFIX = 'docker:'
+CONTAINER_NAME = 'xsky-container'
+
+
+def is_docker_image(image_id: Optional[str]) -> bool:
+    return bool(image_id) and image_id.startswith(DOCKER_IMAGE_PREFIX)
+
+
+def image_of(image_id: str) -> str:
+    """'docker:ubuntu:22.04' → 'ubuntu:22.04'."""
+    return image_id[len(DOCKER_IMAGE_PREFIX):]
+
+
+def initialize_command(image: str,
+                       container: str = CONTAINER_NAME) -> str:
+    """Idempotent host-side init: install docker if absent, pull the
+    image, (re)start the keep-alive container. Safe to re-run on every
+    launch — an existing container with the right image is reused; an
+    image change recreates it (rolling a new task version onto a live
+    cluster)."""
+    image_q = shlex.quote(image)
+    c = shlex.quote(container)
+    return ' && '.join([
+        # Docker install (Debian/Ubuntu hosts; get.docker.com handles
+        # distro detection). sudo -n: non-interactive like every other
+        # runtime-setup command.
+        ('command -v docker >/dev/null 2>&1 || '
+         '(curl -fsSL https://get.docker.com | sudo -n sh)'),
+        ('sudo -n usermod -aG docker $USER 2>/dev/null || true'),
+        f'sudo -n docker pull {image_q}',
+        # Recreate on image drift; keep a matching live container.
+        (f'if [ "$(sudo -n docker inspect -f '
+         f"'{{{{.Config.Image}}}}' {c} 2>/dev/null)\" != {image_q} ]; "
+         f'then sudo -n docker rm -f {c} 2>/dev/null || true; fi'),
+        (f'sudo -n docker ps -q -f name=^{container}$ | grep -q . || '
+         f'sudo -n docker run -d --name {c} --net=host --privileged '
+         f'-v "$HOME:$HOME" -w "$HOME" {image_q} '
+         f'sh -c "sleep infinity"'),
+    ])
+
+
+def exec_wrap(cmd: str, env_keys: Iterable[str],
+              cwd: Optional[str] = None,
+              container: str = CONTAINER_NAME) -> str:
+    """Wrap a task command to run inside the container.
+
+    env_keys are forwarded by NAME (-e KEY): the caller exports the
+    per-host values on the host first (gang launcher / command runner
+    env prefix), so one wrapped command string serves every rank.
+    """
+    flags = ' '.join(f'-e {shlex.quote(k)}'
+                     for k in sorted(set(env_keys)))
+    inner = cmd if cwd is None else f'cd {shlex.quote(cwd)} && {cmd}'
+    return (f'sudo -n docker exec {flags} {shlex.quote(container)} '
+            f'bash -c {shlex.quote(inner)}')

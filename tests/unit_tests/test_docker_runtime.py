@@ -1,0 +1,137 @@
+"""Per-task container runtime on VMs (image_id: docker:…).
+
+Twin coverage of sky/provision/docker_utils.py:1-469 behavior, adapted
+to this repo's design: the host keeps the agent runtime, task commands
+run inside the container via docker exec (utils/docker_utils.py).
+"""
+import pytest
+
+from skypilot_tpu.agent import job_runner
+from skypilot_tpu.utils import docker_utils
+
+
+class TestDockerUtils:
+
+    def test_image_id_grammar(self):
+        assert docker_utils.is_docker_image('docker:ubuntu:22.04')
+        assert not docker_utils.is_docker_image('projects/x/images/y')
+        assert not docker_utils.is_docker_image(None)
+        assert docker_utils.image_of('docker:nvcr.io/nvidia/jax:23.10'
+                                     ) == 'nvcr.io/nvidia/jax:23.10'
+
+    def test_initialize_command_shape(self):
+        cmd = docker_utils.initialize_command('ubuntu:22.04')
+        # Install-if-missing, pull, and an idempotent keep-alive run
+        # with the layout contract: host network, privileged (TPU
+        # devices), $HOME shared at the same path.
+        assert 'command -v docker' in cmd
+        assert 'get.docker.com' in cmd
+        assert 'docker pull ubuntu:22.04' in cmd
+        assert '--net=host' in cmd
+        assert '--privileged' in cmd
+        assert '-v "$HOME:$HOME" -w "$HOME"' in cmd
+        assert 'sleep infinity' in cmd
+        # Image drift recreates the container (rolling a new version).
+        assert 'docker rm -f' in cmd
+
+    def test_exec_wrap_forwards_env_and_cwd(self):
+        cmd = docker_utils.exec_wrap(
+            'python train.py', ['XSKY_HOST_RANK', 'TPU_WORKER_ID'],
+            cwd='sky_workdir')
+        assert 'docker exec' in cmd
+        # Env forwarded by NAME so per-rank host exports arrive.
+        assert '-e TPU_WORKER_ID' in cmd and '-e XSKY_HOST_RANK' in cmd
+        assert 'cd sky_workdir && python train.py' in cmd
+
+    def test_exec_wrap_quotes_hostile_command(self):
+        cmd = docker_utils.exec_wrap("echo '$(rm -rf /)'", [])
+        # The task command is a single quoted bash -c argument.
+        assert 'bash -c' in cmd
+        assert '$(rm -rf /)' not in cmd.split('bash -c')[0]
+
+
+class TestJobRunnerContainerSpec:
+
+    def test_commands_wrapped_when_container_set(self):
+        spec = {'setup': 'pip install -e .', 'run': 'python t.py',
+                'cwd': 'sky_workdir',
+                'docker_container': 'xsky-container'}
+        envs = [{'XSKY_HOST_RANK': '0', 'XSKY_JOB_ID': '1'}]
+        setup_cmd, run_cmd, cwd = job_runner._resolve_commands(spec, envs)
+        assert cwd is None          # cd moved inside the container
+        for cmd in (setup_cmd, run_cmd):
+            assert 'docker exec' in cmd
+            assert '-e XSKY_HOST_RANK' in cmd
+            assert 'cd sky_workdir' in cmd
+
+    def test_host_execution_unchanged_without_container(self):
+        spec = {'setup': 's', 'run': 'r', 'cwd': 'w'}
+        assert job_runner._resolve_commands(spec, [{}]) == ('s', 'r', 'w')
+
+
+class TestCloudImageGuards:
+
+    def test_gcp_docker_image_never_a_vm_source_image(self):
+        from skypilot_tpu import resources as resources_lib
+        from skypilot_tpu.clouds import gcp as gcp_cloud
+        res = resources_lib.Resources(cloud='gcp',
+                                      instance_type='n2-standard-8',
+                                      image_id='docker:ubuntu:22.04')
+        vars = gcp_cloud.GCP().make_deploy_resources_variables(
+            res, 'c', 'us-central2', 'us-central2-b')
+        assert vars['image_id'] is None
+
+    def test_kubernetes_docker_image_is_the_pod_image(self):
+        from skypilot_tpu import resources as resources_lib
+        from skypilot_tpu.clouds import kubernetes as k8s_cloud
+        res = resources_lib.Resources(cloud='kubernetes',
+                                      image_id='docker:myimg:v1')
+        vars = k8s_cloud.Kubernetes().make_deploy_resources_variables(
+            res, 'c', 'in-cluster', None)
+        assert vars['image_id'] == 'myimg:v1'
+
+
+class TestBackendWiring:
+
+    def _handle(self, image_id, provider='gcp', local=False):
+        class _Res:
+            pass
+        _Res.image_id = image_id
+
+        class _H:
+            provider_name = provider
+            is_local_provider = local
+            launched_resources = _Res()
+        return _H()
+
+    def test_docker_image_resolution(self):
+        from skypilot_tpu.backends import tpu_gang_backend as be
+        fn = be.TpuGangBackend._docker_image
+        assert fn(self._handle('docker:img:v1')) == 'img:v1'
+        assert fn(self._handle('projects/x/images/y')) is None
+        assert fn(self._handle(None)) is None
+        # Pods/containers and local fakes never nest a runtime.
+        assert fn(self._handle('docker:img', provider='kubernetes')) \
+            is None
+        assert fn(self._handle('docker:img', local=True)) is None
+
+    def test_execute_spec_carries_container(self, monkeypatch):
+        from skypilot_tpu import task as task_lib
+        from skypilot_tpu.backends import tpu_gang_backend as be
+        backend = be.TpuGangBackend()
+        captured = {}
+        monkeypatch.setattr(
+            backend, '_submit_job',
+            lambda handle, name, spec: captured.update(spec) or 7)
+        monkeypatch.setattr(
+            be.state, 'update_last_use', lambda name: None)
+        handle = self._handle('docker:img:v1')
+        handle.cluster_name = 'c'
+        task = task_lib.Task('t', run='echo hi')
+        job_id = backend.execute(handle, task, detach_run=True)
+        assert job_id == 7
+        assert captured['docker_container'] == 'xsky-container'
+        handle2 = self._handle(None)
+        handle2.cluster_name = 'c'
+        backend.execute(handle2, task, detach_run=True)
+        assert captured['docker_container'] is None
