@@ -187,6 +187,25 @@ def queue(cluster_name: str) -> List[Dict[str, Any]]:
     return _backend().get_job_queue(record['handle'])
 
 
+def cluster_hosts(cluster_name: str) -> List[Dict[str, Any]]:
+    """Per-host inventory of a cluster from its recorded handle
+    (dashboard cluster drill-down; twin of the reference's per-cluster
+    page host table, sky/dashboard/src/pages/clusters/[cluster].js)."""
+    record = _get_handle(cluster_name)
+    handle = record['handle']
+    info = getattr(handle, 'cluster_info', None)
+    if info is None:
+        return []
+    return [{
+        'instance_id': h.instance_id,
+        'internal_ip': h.internal_ip,
+        'external_ip': h.external_ip,
+        'status': h.status,
+        'slice_id': h.slice_id,
+        'host_index': h.host_index,
+    } for h in info.sorted_instances()]
+
+
 def cancel(cluster_name: str, job_ids: Optional[List[int]] = None,
            all_jobs: bool = False) -> None:
     record = _get_handle(cluster_name)

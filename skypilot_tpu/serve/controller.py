@@ -127,6 +127,10 @@ class SkyServeController:
             return
         manager.recover_preempted()
         decision = self.autoscaler.evaluate(ready)
+        qps_fn = getattr(self.autoscaler, 'current_qps', None)
+        serve_state.set_service_metrics(
+            self.service_name, qps_fn() if qps_fn else None,
+            decision.target_num_replicas)
         self._apply_scale(decision.target_num_replicas)
         manager.reconcile_versions(decision.target_num_replicas)
         self.load_balancer.set_ready_replicas(manager.ready_endpoints())

@@ -220,6 +220,9 @@ def status(service_names: Optional[List[str]] = None
             'status': r['status'].value,
             'version': r['version'],
             'endpoint': f"127.0.0.1:{r['lb_port']}",
+            'workspace': r.get('workspace'),
+            'qps': r.get('qps'),
+            'target_replicas': r.get('target_replicas'),
             'replicas': [{
                 'replica_id': rep['replica_id'],
                 'status': rep['status'].value,

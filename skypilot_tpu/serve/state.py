@@ -64,7 +64,11 @@ def _db() -> sqlite3.Connection:
                           ('replicas', 'spot INTEGER DEFAULT 1'),
                           # Workspace isolation: serve.down/logs authz
                           # resolves service ownership from this column.
-                          ('services', 'workspace TEXT')):
+                          ('services', 'workspace TEXT'),
+                          # Live metrics, written each controller tick
+                          # (dashboard service detail: QPS + target).
+                          ('services', 'qps REAL'),
+                          ('services', 'target_replicas INTEGER')):
         try:
             conn.execute(f'ALTER TABLE {table} ADD COLUMN {column}')
         except Exception:  # pylint: disable=broad-except
@@ -136,6 +140,18 @@ def set_service_status(name: str, status: ServiceStatus) -> None:
         conn.close()
 
 
+def set_service_metrics(name: str, qps: Optional[float],
+                        target_replicas: Optional[int]) -> None:
+    """Controller-tick metrics snapshot (serve.status / dashboard)."""
+    with _lock:
+        conn = _db()
+        conn.execute(
+            'UPDATE services SET qps=?, target_replicas=? WHERE name=?',
+            (qps, target_replicas, name))
+        conn.commit()
+        conn.close()
+
+
 def set_service_controller_pid(name: str, pid: int) -> None:
     with _lock:
         conn = _db()
@@ -173,7 +189,7 @@ def remove_service(name: str) -> None:
 
 def _service_dict(row) -> Dict[str, Any]:
     (name, task_config, status, pid, lb_port, created_at, version,
-     workspace) = row
+     workspace, qps, target_replicas) = row
     return {
         'name': name,
         'task_config': json.loads(task_config or '{}'),
@@ -183,6 +199,8 @@ def _service_dict(row) -> Dict[str, Any]:
         'created_at': created_at,
         'version': version or 1,
         'workspace': workspace,
+        'qps': qps,
+        'target_replicas': target_replicas,
     }
 
 

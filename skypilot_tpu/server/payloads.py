@@ -108,6 +108,7 @@ _VERBS: Dict[str, Callable[[Dict[str, Any]],
     'autostop': _core_verb('autostop', 'cluster_name', 'idle_minutes',
                            down_on_idle=False),
     'queue': _core_verb('queue', 'cluster_name'),
+    'cluster_hosts': _core_verb('cluster_hosts', 'cluster_name'),
     'cancel': _core_verb('cancel', 'cluster_name', job_ids=None,
                          all_jobs=False),
     'logs': _core_verb('tail_logs', 'cluster_name', job_id=None),
