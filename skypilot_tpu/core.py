@@ -188,19 +188,40 @@ def queue(cluster_name: str) -> List[Dict[str, Any]]:
 
 
 def cluster_hosts(cluster_name: str) -> List[Dict[str, Any]]:
-    """Per-host inventory of a cluster from its recorded handle
-    (dashboard cluster drill-down; twin of the reference's per-cluster
-    page host table, sky/dashboard/src/pages/clusters/[cluster].js)."""
+    """Per-host inventory of a cluster (dashboard drill-down; twin of
+    the reference's per-cluster page host table,
+    sky/dashboard/src/pages/clusters/[cluster].js).
+
+    Host identity/IPs come from the recorded handle; status is
+    queried live from the provider when reachable (the handle snapshot
+    is launch-time state — a stopped or preempted cluster would
+    otherwise show every host RUNNING), falling back to the snapshot
+    marked as such.
+    """
     record = _get_handle(cluster_name)
     handle = record['handle']
     info = getattr(handle, 'cluster_info', None)
     if info is None:
         return []
+    live: Dict[str, Optional[str]] = {}
+    try:
+        from skypilot_tpu import provision as provision_lib
+        live = provision_lib.query_instances(
+            info.provider_name, cluster_name, info.provider_config)
+    except Exception:  # pylint: disable=broad-except
+        pass  # unreachable provider: snapshot below, labeled
+    def host_status(h) -> str:
+        if h.instance_id in live:
+            # None from query_instances means "gone" (cross-provider
+            # convention for terminated/preempted corpses).
+            return live[h.instance_id] or 'TERMINATED'
+        return f'{h.status} (at launch)'
+
     return [{
         'instance_id': h.instance_id,
         'internal_ip': h.internal_ip,
         'external_ip': h.external_ip,
-        'status': h.status,
+        'status': host_status(h),
         'slice_id': h.slice_id,
         'host_index': h.host_index,
     } for h in info.sorted_instances()]

@@ -72,7 +72,15 @@ def _db() -> sqlite3.Connection:
         try:
             conn.execute(f'ALTER TABLE {table} ADD COLUMN {column}')
         except Exception:  # pylint: disable=broad-except
-            pass  # column exists (sqlite / pg error classes differ)
+            # Column exists (sqlite / pg error classes differ). Roll
+            # back so a poisoned pg transaction doesn't swallow every
+            # LATER alter in this loop (jobs/state.py has the same
+            # guard) — without it the services table misses columns
+            # and the SELECT * unpack breaks.
+            try:
+                conn.rollback()
+            except Exception:  # pylint: disable=broad-except
+                pass
     conn.commit()
     return conn
 

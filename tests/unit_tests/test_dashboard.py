@@ -191,27 +191,20 @@ def test_cluster_hosts_verb(fake_cluster_env):
     assert payloads.known_verb('cluster_hosts')
 
 
-def test_service_metrics_surface():
+def test_service_metrics_surface(monkeypatch, tmp_path):
     """serve.status exposes the controller's QPS + autoscaler target
     (dashboard service detail), from the metrics columns the controller
     tick writes."""
-    import os
-    import tempfile
-
     from skypilot_tpu.serve import state as serve_state
-    with tempfile.TemporaryDirectory() as d:
-        os.environ['XSKY_SERVE_DB'] = os.path.join(d, 's.db')
-        try:
-            serve_state.add_service('m1', {'run': 'x'}, 9999)
-            serve_state.set_service_metrics('m1', 3.25, 4)
-            rec = serve_state.get_service('m1')
-            assert rec['qps'] == 3.25
-            assert rec['target_replicas'] == 4
-            from skypilot_tpu.serve import core as serve_core
-            out = serve_core.status(['m1'])[0]
-            assert out['qps'] == 3.25 and out['target_replicas'] == 4
-        finally:
-            os.environ.pop('XSKY_SERVE_DB', None)
+    monkeypatch.setenv('XSKY_SERVE_DB', str(tmp_path / 's.db'))
+    serve_state.add_service('m1', {'run': 'x'}, 9999)
+    serve_state.set_service_metrics('m1', 3.25, 4)
+    rec = serve_state.get_service('m1')
+    assert rec['qps'] == 3.25
+    assert rec['target_replicas'] == 4
+    from skypilot_tpu.serve import core as serve_core
+    out = serve_core.status(['m1'])[0]
+    assert out['qps'] == 3.25 and out['target_replicas'] == 4
 
 
 def test_dashboard_shows_hosts_and_qps():
