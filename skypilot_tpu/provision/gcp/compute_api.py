@@ -118,6 +118,21 @@ class ComputeClient:
         raise exceptions.ProvisionError(
             f'Timed out waiting for compute operation {name}')
 
+    # ---- networks (VPC bootstrap) --------------------------------------
+
+    def get_network(self, name: str) -> Optional[Dict[str, Any]]:
+        try:
+            return self.t.request(
+                'GET', f'{self.global_prefix}/networks/{name}')
+        except rest.GcpApiError as e:
+            if e.status == 404:
+                return None
+            raise
+
+    def insert_network(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        return self.t.request('POST', f'{self.global_prefix}/networks',
+                              body=body)
+
     # ---- MIG / DWS (GPU flex-start capacity) ---------------------------
 
     def insert_instance_template(self, body: Dict[str, Any]

@@ -70,6 +70,68 @@ def _normalize(state: str) -> Optional[str]:
 # ---- run_instances ---------------------------------------------------------
 
 
+XSKY_VPC = 'xsky-vpc'
+
+#: Base rules stamped onto a freshly created VPC (twin of the network
+#: bootstrap in sky/provision/gcp/config.py: a new network has NO
+#: rules, so ssh and the gang's internal traffic would be dead).
+_VPC_BOOTSTRAP_RULES = (
+    # jax.distributed / agent traffic between hosts rides internal IPs
+    # (auto-mode subnets all live in 10.128.0.0/9).
+    ('internal', {'allowed': [{'IPProtocol': 'tcp'},
+                              {'IPProtocol': 'udp'},
+                              {'IPProtocol': 'icmp'}],
+                  'sourceRanges': ['10.128.0.0/9']}),
+    ('ssh', {'allowed': [{'IPProtocol': 'tcp', 'ports': ['22']}],
+             'sourceRanges': ['0.0.0.0/0'],
+             'targetTags': ['xsky']}),
+)
+
+
+def _ensure_network(gce, node_cfg: Dict[str, Any],
+                    provider_config: Dict[str, Any]) -> None:
+    """Make sure the cluster's network exists before any create call.
+
+    Three cases (twin of sky/provision/gcp/config.py:1-1026's network
+    half, without the legacy-subnet machinery):
+      * network exists → use as-is (a user's VPC or the project
+        default; its rules are their business).
+      * implicit default missing (projects created with default-VPC
+        creation disabled) → create/reuse an auto-subnet 'xsky-vpc'
+        with ssh + internal allow-rules and route the cluster there.
+      * user-named network missing → fail loudly; silently creating a
+        network the user named would mask a typo'd config.
+    """
+    requested = node_cfg.get('network')
+    name = (requested or 'global/networks/default').rsplit('/', 1)[-1]
+    if gce.get_network(name) is not None:
+        return
+    if requested:
+        raise exceptions.InvalidSkyTpuConfigError(
+            f'GCP network {requested!r} does not exist in project '
+            f'{gce.project!r}. Create it first (or drop the network '
+            'setting to use an auto-managed VPC).')
+    if gce.get_network(XSKY_VPC) is None:
+        logger.info(f'Project {gce.project!r} has no default network; '
+                    f'creating {XSKY_VPC!r} (auto subnets + ssh/'
+                    'internal rules).')
+        gce.wait_global_operation(gce.insert_network(
+            {'name': XSKY_VPC, 'autoCreateSubnetworks': True}))
+        for suffix, rule in _VPC_BOOTSTRAP_RULES:
+            body = {'name': f'{XSKY_VPC}-{suffix}',
+                    'network': f'global/networks/{XSKY_VPC}',
+                    'direction': 'INGRESS', **rule}
+            try:
+                gce.wait_global_operation(gce.insert_firewall(body))
+            except rest.GcpApiError as e:
+                if e.status != 409:   # concurrent bootstrap
+                    raise
+    node_cfg['network'] = f'global/networks/{XSKY_VPC}'
+    # open_ports / later lifecycle ops read the network from
+    # provider_config (it is persisted into the cluster handle).
+    provider_config['network'] = node_cfg['network']
+
+
 def run_instances(region: str, zone: Optional[str], cluster_name: str,
                   config: common.ProvisionConfig) -> common.ProvisionRecord:
     if zone is None:
@@ -77,6 +139,8 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
             'GCP provisioning requires an explicit zone.')
     node_cfg = config.node_config
     try:
+        _, gce_for_net = _clients(config.provider_config, zone)
+        _ensure_network(gce_for_net, node_cfg, config.provider_config)
         if node_cfg.get('tpu_vm'):
             created, resumed, head = _run_tpu(zone, cluster_name, config)
         else:
