@@ -1,0 +1,115 @@
+#!/usr/bin/env python3
+"""Producer for the hosted catalog endpoint (catalog/hosted.py's peer).
+
+The client side (XSKY_CATALOG_URL_BASE) downloads
+``{base}/{schema}/{cloud}/catalog.csv``; this tool BUILDS that directory
+tree so any static file host (GCS bucket, S3 website, nginx) can serve
+it — the producer story the hosted-catalog client needs (twin of the
+reference's skypilot-catalog repo publishing pipeline).
+
+Usage:
+    python tools/build_hosted_catalog.py --out /path/to/site [--schema v1]
+    # then e.g.:  gsutil -m rsync -r /path/to/site gs://my-catalog-bucket
+
+Every in-tree data fetcher is run to regenerate its CSV (offline price
+snapshots where live APIs need credentials; fetchers that support live
+mode use it when credentials are present). A MANIFEST.json with build
+time + per-file sha256 lands next to the CSVs so consumers can verify
+integrity and mirror incrementally.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import importlib
+import json
+import os
+import pkgutil
+import shutil
+import sys
+import time
+
+# Runnable straight from a checkout (the usual way a publisher runs it).
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _fetchers():
+    from skypilot_tpu.catalog import data_fetchers
+    for mod_info in pkgutil.iter_modules(data_fetchers.__path__):
+        if not mod_info.name.startswith('fetch_'):
+            continue
+        yield (mod_info.name[len('fetch_'):],
+               importlib.import_module(
+                   f'skypilot_tpu.catalog.data_fetchers.{mod_info.name}'))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description='Build the hosted-catalog directory tree.')
+    parser.add_argument('--out', required=True,
+                        help='Output root (served as '
+                             'XSKY_CATALOG_URL_BASE).')
+    parser.add_argument('--schema', default='v1')
+    parser.add_argument('--clouds', nargs='*', default=None,
+                        help='Subset of clouds (default: all fetchers).')
+    args = parser.parse_args()
+
+    root = os.path.join(args.out, args.schema)
+    os.makedirs(root, exist_ok=True)
+    manifest = {'built_at': time.strftime('%Y-%m-%dT%H:%M:%SZ',
+                                          time.gmtime()),
+                'schema': args.schema, 'files': {}}
+    built = skipped = 0
+    for cloud, mod in sorted(_fetchers()):
+        if args.clouds and cloud not in args.clouds:
+            continue
+        if cloud == 'fake':
+            continue   # test-only cloud; never publish
+        fetch = getattr(mod, 'main', None)
+        if fetch is None and hasattr(mod, 'generate'):
+            # generate()-style fetchers (gcp): entries → save_catalog.
+            def fetch(mod=mod, cloud=cloud):
+                from skypilot_tpu.catalog import common
+                common.save_catalog(cloud, mod.generate())
+        if fetch is None:
+            print(f'  {cloud}: no main()/generate() entry, skipped',
+                  file=sys.stderr)
+            skipped += 1
+            continue
+        cloud_dir = os.path.join(root, cloud)
+        os.makedirs(cloud_dir, exist_ok=True)
+        dst = os.path.join(cloud_dir, 'catalog.csv')
+        try:
+            # Fetchers regenerate catalog/data/{cloud}/catalog.csv
+            # (live APIs where credentials allow, the maintained price
+            # snapshot otherwise).
+            fetch()
+        except Exception as e:  # pylint: disable=broad-except
+            print(f'  {cloud}: fetch failed ({e}), skipped',
+                  file=sys.stderr)
+            skipped += 1
+            continue
+        from skypilot_tpu import catalog as catalog_pkg
+        src = os.path.join(os.path.dirname(catalog_pkg.__file__),
+                           'data', cloud, 'catalog.csv')
+        if not os.path.exists(src):
+            print(f'  {cloud}: fetcher produced no {src}, skipped',
+                  file=sys.stderr)
+            skipped += 1
+            continue
+        shutil.copyfile(src, dst)
+        with open(dst, 'rb') as f:
+            digest = hashlib.sha256(f.read()).hexdigest()
+        manifest['files'][f'{cloud}/catalog.csv'] = {'sha256': digest}
+        built += 1
+        print(f'  {cloud}: ok')
+    with open(os.path.join(root, 'MANIFEST.json'), 'w',
+              encoding='utf-8') as f:
+        json.dump(manifest, f, indent=2)
+    print(f'Built {built} catalog(s), skipped {skipped} → {root}')
+    return 0 if built else 1
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())
