@@ -669,16 +669,29 @@ def _supervise(argv) -> int:
     # round's last-known-good on-silicon captures as evidence.
     out = {'metric': metric, 'value': None, 'unit': None,
            'vs_baseline': None, **failure, 'attempts': attempts}
+    def _labeled(blob: dict) -> dict:
+        # Evidence only — the headline value stays null so a failed
+        # round is never mistaken for a fresh measurement. Label the
+        # embed LOUDLY (VERDICT r4 weak #7): these numbers are from an
+        # earlier successful on-silicon run, not this invocation.
+        captured = blob.get('captured_unix')
+        age_h = ((time.time() - captured) / 3600.0
+                 if captured else None)
+        return dict(
+            blob,
+            provenance='PRIOR-RUN on-silicon capture — NOT this '
+                       'invocation (headline value above is null '
+                       'because this run failed)',
+            capture_age_hours=(round(age_h, 1)
+                               if age_h is not None else None))
+
     good = _load_last_good(mode)
     if good is not None:
-        # Evidence only — the headline value stays null so a failed
-        # round is never mistaken for a fresh measurement; captured_unix
-        # inside the blob makes the capture's age auditable.
-        out['last_known_good'] = good
+        out['last_known_good'] = _labeled(good)
     other = 'serve' if mode == 'train' else 'train'
     other_good = _load_last_good(other)
     if other_good is not None:
-        out[f'{other}_last_good'] = other_good
+        out[f'{other}_last_good'] = _labeled(other_good)
     print(json.dumps(out), flush=True)
     return 1
 

@@ -126,6 +126,10 @@ def test_gcp_terminate_rides_instance_identity(tmp_path, monkeypatch):
                     {'name': 'projects/p/locations/z/nodes/c1-0',
                      'state': 'READY',
                      'labels': {'xsky-cluster': 'c1'}}]}
+            if method == 'GET' and 'instanceGroupManagers' in url:
+                # No DWS MIG for this cluster (terminate probes it).
+                from skypilot_tpu.provision.gcp import rest
+                raise rest.GcpApiError(404, 'notFound', 'no mig')
             if method == 'GET' and 'instances' in url:
                 return {'items': []}
             if method == 'DELETE':
