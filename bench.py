@@ -111,8 +111,14 @@ def _save_last_good(mode: str, result: dict) -> None:
 def _load_last_good(mode: str) -> Optional[dict]:
     try:
         here = os.path.dirname(os.path.abspath(__file__))
-        with open(os.path.join(here, _LAST_GOOD[mode])) as f:
-            return json.load(f)
+        path = os.path.join(here, _LAST_GOOD[mode])
+        with open(path) as f:
+            blob = json.load(f)
+        # Blobs saved before the captured_unix field existed: the file
+        # mtime is the capture time (the file is written atomically at
+        # capture).
+        blob.setdefault('captured_unix', os.path.getmtime(path))
+        return blob
     except (OSError, json.JSONDecodeError):
         return None
 
