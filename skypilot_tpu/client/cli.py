@@ -245,6 +245,25 @@ def queue(cluster):
                               j['status'], j['username']))
 
 
+@cli.command()
+@click.argument('cluster')
+def hosts(cluster):
+    """Show a cluster's per-host inventory (slice, IPs, live status)."""
+    from skypilot_tpu.client import sdk
+    rows = sdk.cluster_hosts(cluster)
+    if not rows:
+        click.echo('(no host records)')
+        return
+    fmt = '{:<24} {:<22} {:<6} {:<15} {:<15} {:<12}'
+    click.echo(fmt.format('HOST', 'SLICE', 'INDEX', 'INTERNAL_IP',
+                          'EXTERNAL_IP', 'STATUS'))
+    for h in rows:
+        click.echo(fmt.format(
+            str(h['instance_id'])[:24], str(h['slice_id'] or '-')[:22],
+            h['host_index'], h['internal_ip'] or '-',
+            h['external_ip'] or '-', h['status']))
+
+
 class _SSHGroup(click.Group):
     """`xsky ssh CLUSTER [CMD...]` keeps working next to the node-pool
     subcommands: an unknown first token routes to `connect`."""

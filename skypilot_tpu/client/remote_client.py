@@ -178,6 +178,10 @@ class RemoteClient:
     def queue(self, cluster_name):
         return self._call('queue', {'cluster_name': cluster_name})
 
+    def cluster_hosts(self, cluster_name):
+        return self._call('cluster_hosts',
+                          {'cluster_name': cluster_name})
+
     def cancel(self, cluster_name, job_ids=None, all_jobs=False):
         return self._call('cancel', {'cluster_name': cluster_name,
                                      'job_ids': job_ids,

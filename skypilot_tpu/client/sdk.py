@@ -112,6 +112,11 @@ def queue(cluster_name: str) -> List[Dict[str, Any]]:
     return _local_or_remote('queue', cluster_name)
 
 
+def cluster_hosts(cluster_name: str) -> List[Dict[str, Any]]:
+    """Per-host inventory (live provider status when reachable)."""
+    return _local_or_remote('cluster_hosts', cluster_name)
+
+
 def cancel(cluster_name: str, job_ids: Optional[List[int]] = None,
            all_jobs: bool = False) -> None:
     return _local_or_remote('cancel', cluster_name, job_ids=job_ids,
