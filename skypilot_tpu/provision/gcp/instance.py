@@ -318,24 +318,33 @@ def _run_vms_via_mig(gce, zone: str, cluster_name: str,
     existing = gce.list_cluster(cluster_name)
     if len(existing) >= config.count:
         return [], [], sorted(i['name'] for i in existing)[0]
-    if gce.get_mig(compute_api.mig_name(cluster_name)) is None:
+    mig = compute_api.mig_name(cluster_name)
+    if gce.get_mig(mig) is None:
         template = compute_api.instance_template_body(
             node_cfg, cluster_name, zone)
         gce.wait_global_operation(
             gce.insert_instance_template(template))
         gce.wait_operation(gce.insert_mig(compute_api.mig_body(
             cluster_name, gce.project, template['name'])))
-        run_duration = node_cfg.get('dws_run_duration_s')
-        gce.insert_resize_request(
-            compute_api.mig_name(cluster_name),
-            compute_api.resize_request_body(
-                cluster_name, config.count - len(existing),
-                run_duration))
+    # The resize-request name encodes the size it grows FROM, so a
+    # scale-up of an existing DWS cluster files a fresh request (the
+    # old SUCCEEDED one must not satisfy the poll below) and a crash
+    # between MIG create and request insert recovers by inserting on
+    # retry instead of 404ing.
+    rr_name = f'{mig}-rr{len(existing)}'
+    try:
+        gce.get_resize_request(mig, rr_name)
+    except rest.GcpApiError as e:
+        if e.status != 404:
+            raise
+        body = compute_api.resize_request_body(
+            cluster_name, config.count - len(existing),
+            node_cfg.get('dws_run_duration_s'))
+        body['name'] = rr_name
+        gce.insert_resize_request(mig, body)
     deadline = time.time() + timeout
     while True:
-        rr = gce.get_resize_request(
-            compute_api.mig_name(cluster_name),
-            f'{compute_api.mig_name(cluster_name)}-rr')
+        rr = gce.get_resize_request(mig, rr_name)
         state = rr.get('state', 'ACCEPTED')
         if state == 'SUCCEEDED':
             break

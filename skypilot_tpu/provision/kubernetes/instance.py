@@ -46,15 +46,29 @@ _POLL_INTERVAL_S = 2.0
 # Pluggable transport for tests (recorded-response fake API).
 _transport_factory = rest.KubeTransport
 
+# One transport per context: building one parses the kubeconfig,
+# writes client-cert temp files, and may run an exec credential
+# plugin — a poll loop (dashboard, autostop) must not pay that (or
+# leak temp files) on every lifecycle op.
+_transport_cache: Dict[Optional[str], Any] = {}
+
 
 def set_transport_factory(factory) -> None:
     global _transport_factory
     _transport_factory = factory
+    _transport_cache.clear()
 
 
 def _client(context: Optional[str], namespace: str) -> rest.KubeClient:
     try:
-        return rest.KubeClient(_transport_factory(context), namespace)
+        cached = _transport_cache.get(context)
+        # Entries pin the factory that built them, so swapping the
+        # factory (tests monkeypatch it directly) never serves a stale
+        # transport.
+        if cached is None or cached[0] is not _transport_factory:
+            cached = (_transport_factory, _transport_factory(context))
+            _transport_cache[context] = cached
+        return rest.KubeClient(cached[1], namespace)
     except ValueError as e:
         raise exceptions.ProvisionError(str(e)) from e
 

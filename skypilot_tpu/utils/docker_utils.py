@@ -60,7 +60,13 @@ def initialize_command(image: str,
         (f'if [ "$(sudo -n docker inspect -f '
          f"'{{{{.Config.Image}}}}' {c} 2>/dev/null)\" != {image_q} ]; "
          f'then sudo -n docker rm -f {c} 2>/dev/null || true; fi'),
+        # Running → keep; exited (VM reboot, dockerd restart — no
+        # --restart policy) → start it; absent → create. A plain
+        # `docker run --name` against an Exited container would fail
+        # with a name conflict on every relaunch.
         (f'sudo -n docker ps -q -f name=^{container}$ | grep -q . || '
+         f'{{ sudo -n docker ps -aq -f name=^{container}$ | grep -q . '
+         f'&& sudo -n docker start {c}; }} || '
          f'sudo -n docker run -d --name {c} --net=host --privileged '
          f'-v "$HOME:$HOME" -w "$HOME" {image_q} '
          f'sh -c "sleep infinity"'),
@@ -72,12 +78,15 @@ def exec_wrap(cmd: str, env_keys: Iterable[str],
               container: str = CONTAINER_NAME) -> str:
     """Wrap a task command to run inside the container.
 
-    env_keys are forwarded by NAME (-e KEY): the caller exports the
-    per-host values on the host first (gang launcher / command runner
-    env prefix), so one wrapped command string serves every rank.
+    Env is forwarded as ``-e KEY="$KEY"`` — the HOST shell expands the
+    per-host exported value before sudo runs, because sudo's default
+    env_reset would strip exported variables and a bare ``-e KEY``
+    would then forward nothing. One wrapped command string serves
+    every rank (each host expands its own values).
     """
-    flags = ' '.join(f'-e {shlex.quote(k)}'
-                     for k in sorted(set(env_keys)))
+    flags = ' '.join(f'-e {k}="${{{k}}}"'
+                     for k in sorted(set(env_keys))
+                     if k.isidentifier())
     inner = cmd if cwd is None else f'cd {shlex.quote(cwd)} && {cmd}'
     return (f'sudo -n docker exec {flags} {shlex.quote(container)} '
             f'bash -c {shlex.quote(inner)}')

@@ -254,7 +254,9 @@ class FakeGcp:
             r'/instanceGroupManagers/([^/]+)/resizeRequests/([^/]+)$',
             path)
         if m and method == 'GET':
-            rr = self.resize_requests[m.group(2)]
+            rr = self.resize_requests.get(m.group(2))
+            if rr is None:
+                raise rest.GcpApiError(404, 'notFound', 'no rr')
             if self.rr_states:
                 rr['state'] = self.rr_states.pop(0)
                 if rr['state'] == 'SUCCEEDED':
@@ -896,3 +898,23 @@ def test_missing_user_named_network_fails_loudly(fake_gcp):
                        match='my-vpc'):
         gcp_instance.run_instances('us-central2', 'us-central2-b',
                                    'nv3', cfg)
+
+
+def test_gpu_dws_scale_up_files_fresh_resize_request(fake_gcp):
+    """Relaunching a DWS cluster with a larger count must file a NEW
+    resize request for the gap — the old SUCCEEDED request must not
+    satisfy the poll and return an under-provisioned gang
+    (code-review r5)."""
+    fake_gcp.rr_states = ['SUCCEEDED']
+    gcp_instance.run_instances('us-central2', 'us-central2-b', 'dsc',
+                               _gpu_config(count=2, gpu_dws=True))
+    assert len(fake_gcp.vms) == 2
+    fake_gcp.rr_states = ['SUCCEEDED']
+    record = gcp_instance.run_instances(
+        'us-central2', 'us-central2-b', 'dsc',
+        _gpu_config(count=4, gpu_dws=True))
+    assert len(fake_gcp.vms) == 4
+    assert len(record.created_instance_ids) == 2
+    # Two distinct requests were filed (named by their FROM size).
+    assert {'xsky-mig-dsc-rr0', 'xsky-mig-dsc-rr2'} <= set(
+        fake_gcp.resize_requests)
