@@ -327,16 +327,26 @@ class TestServeMetrics:
         _post(url, '/v1/completions',
               {'prompt': 'metrics-probe', 'max_tokens': 4,
                'temperature': 0})
-        with urllib.request.urlopen(url + '/metrics') as resp:
-            assert 'text/plain' in resp.headers['Content-Type']
-            text = resp.read().decode()
+        # The serving loop frees the slot asynchronously after the
+        # response returns: poll until the gauges settle (flaked under
+        # full-suite CPU load when scraped immediately).
+        import time as time_lib
+        deadline = time_lib.time() + 15
+        while True:
+            with urllib.request.urlopen(url + '/metrics') as resp:
+                assert 'text/plain' in resp.headers['Content-Type']
+                text = resp.read().decode()
+            if ('xsky_serve_free_slots 4' in text and
+                    'xsky_serve_queue_depth 0' in text):
+                break
+            if time_lib.time() > deadline:
+                raise AssertionError(
+                    f'gauges never settled:\n{text[:2000]}')
+            time_lib.sleep(0.3)
         assert 'xsky_serve_requests_total{endpoint="/v1/completions"' \
             in text
         assert 'xsky_serve_generated_tokens_total' in text
         assert 'xsky_serve_ttft_seconds_count' in text
-        # Gauges read live from the orchestrator.
-        assert 'xsky_serve_free_slots 4' in text
-        assert 'xsky_serve_queue_depth 0' in text
 
     def test_stop_hit_counts_as_ok_not_cancelled(self):
         from skypilot_tpu.infer import metrics as metrics_lib
