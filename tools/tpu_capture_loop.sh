@@ -66,12 +66,23 @@ while true; do
     fi
     # Re-capture even after a success if >90 min old: later code may be
     # faster, and fresher evidence is better evidence.
+    captured=0
     for mode in train serve; do
       f="BENCH_LOCAL_r05_${mode}.json"
       if [ ! -f "$f" ] || [ -n "$(find "$f" -mmin +90)" ]; then
-        capture "$mode"
+        capture "$mode" && captured=1
       fi
     done
+    # Evidence lands in git the moment it exists — the session may not
+    # be watching when the tunnel finally answers.
+    if [ "$captured" = 1 ] || { [ -f TPU_TIER_r05.txt ] && \
+         ! git diff --quiet -- TPU_TIER_r05.txt 2>/dev/null; }; then
+      git add BENCH_LOCAL_r05_*.json .bench_last_good_*.json \
+              TPU_TIER_r05.txt 2>/dev/null
+      git diff --cached --quiet 2>/dev/null || \
+        git commit -q -m "Record on-silicon round-5 captures" \
+          >> "$LOG" 2>&1
+    fi
   else
     echo "tunnel down $(date -u +%FT%TZ)" >> "$LOG"
   fi
