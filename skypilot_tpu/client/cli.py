@@ -247,6 +247,20 @@ def queue(cluster):
 
 @cli.command()
 @click.argument('cluster')
+@click.argument('port', required=False, type=int)
+def endpoints(cluster, port):
+    """Show reachable URLs for a cluster's opened ports."""
+    from skypilot_tpu.client import sdk
+    out = sdk.endpoints(cluster, port=port)
+    if not out:
+        click.echo('(no opened ports — set resources.ports)')
+        return
+    for p, url in sorted(out.items()):
+        click.echo(f'{p}\t{url}' if p else url)
+
+
+@cli.command()
+@click.argument('cluster')
 def hosts(cluster):
     """Show a cluster's per-host inventory (slice, IPs, live status)."""
     from skypilot_tpu.client import sdk

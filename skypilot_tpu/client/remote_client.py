@@ -182,6 +182,13 @@ class RemoteClient:
         return self._call('cluster_hosts',
                           {'cluster_name': cluster_name})
 
+    def endpoints(self, cluster_name, port=None):
+        out = self._call('endpoints', {'cluster_name': cluster_name,
+                                       'port': port})
+        # JSON object keys arrive as strings; the SDK contract is
+        # int ports.
+        return {int(k): v for k, v in (out or {}).items()}
+
     def cancel(self, cluster_name, job_ids=None, all_jobs=False):
         return self._call('cancel', {'cluster_name': cluster_name,
                                      'job_ids': job_ids,

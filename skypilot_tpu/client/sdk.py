@@ -117,6 +117,12 @@ def cluster_hosts(cluster_name: str) -> List[Dict[str, Any]]:
     return _local_or_remote('cluster_hosts', cluster_name)
 
 
+def endpoints(cluster_name: str,
+              port: Optional[int] = None) -> Dict[int, str]:
+    """port → URL for the cluster's opened ports."""
+    return _local_or_remote('endpoints', cluster_name, port=port)
+
+
 def cancel(cluster_name: str, job_ids: Optional[List[int]] = None,
            all_jobs: bool = False) -> None:
     return _local_or_remote('cancel', cluster_name, job_ids=job_ids,

@@ -187,6 +187,28 @@ def queue(cluster_name: str) -> List[Dict[str, Any]]:
     return _backend().get_job_queue(record['handle'])
 
 
+def endpoints(cluster_name: str,
+              port: Optional[int] = None) -> Dict[int, str]:
+    """port → reachable URL for the cluster's opened ports (twin of
+    `sky status --endpoint`, backed by the provision query_ports op —
+    kubernetes resolves NodePort indirection, VM clouds map the head
+    IP)."""
+    record = _get_handle(cluster_name)
+    handle = record['handle']
+    info = getattr(handle, 'cluster_info', None)
+    resources = getattr(handle, 'launched_resources', None)
+    ports = list(resources.ports or []) if resources is not None else []
+    if info is None or not ports:
+        return {}
+    from skypilot_tpu import provision as provision_lib
+    out = provision_lib.query_ports(
+        info.provider_name, cluster_name, ports,
+        info.provider_config or {}, info)
+    if port is not None:
+        return {p: u for p, u in out.items() if p == port}
+    return out
+
+
 def cluster_hosts(cluster_name: str) -> List[Dict[str, Any]]:
     """Per-host inventory of a cluster (dashboard drill-down; twin of
     the reference's per-cluster page host table,

@@ -69,3 +69,33 @@ def cleanup_ports(provider_name: str, cluster_name: str,
     impl = _impl(provider_name)
     if hasattr(impl, 'cleanup_ports'):
         impl.cleanup_ports(cluster_name, provider_config)
+
+
+def query_ports(provider_name: str, cluster_name: str,
+                ports: List[str],
+                provider_config: Dict[str, Any],
+                cluster_info: common.ClusterInfo
+                ) -> Dict[int, str]:
+    """port → reachable endpoint URL (twin of the reference's
+    query_ports op backing `sky status --endpoint`).
+
+    Providers with indirection (kubernetes NodePort) implement their
+    own; the default maps each requested port onto the head host's
+    feasible IP — correct wherever open_ports exposed the port on the
+    instance itself (firewall/security-group clouds).
+    """
+    impl = _impl(provider_name)
+    if hasattr(impl, 'query_ports'):
+        return impl.query_ports(cluster_name, ports, provider_config,
+                                cluster_info)
+    head = cluster_info.get_head_instance()
+    if head is None:
+        return {}
+    ip = head.get_feasible_ip()
+    out: Dict[int, str] = {}
+    for spec in ports or []:
+        spec = str(spec)
+        lo, _, hi = spec.partition('-')
+        for port in range(int(lo), int(hi or lo) + 1):
+            out[port] = f'http://{ip}:{port}'
+    return out

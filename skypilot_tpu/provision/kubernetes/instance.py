@@ -395,6 +395,43 @@ def cleanup_ports(cluster_name: str,
         logger.warning(f'cleanup_ports({cluster_name}): {e}')
 
 
+def query_ports(cluster_name: str, ports, provider_config: Dict[str, Any],
+                cluster_info) -> Dict[int, str]:
+    """port → endpoint. NodePort mode reads the allocated nodePorts off
+    the ports service and pairs them with the head pod's node IP;
+    portforward mode returns the kubectl command the user runs (no
+    cluster-side listener exists)."""
+    del ports
+    context = provider_config.get('context')
+    namespace = provider_config.get('namespace', 'default')
+    client = _client(context, namespace)
+    if networking_mode(provider_config) == 'portforward':
+        head = cluster_info.get_head_instance()
+        pod = head.instance_id if head else f'{cluster_name}-0'
+        ctx = f'--context {context} ' if context else ''
+        return {0: f'kubectl {ctx}-n {namespace} port-forward '
+                   f'pod/{pod} <local>:<port>'}
+    try:
+        svc = client.get('Service', f'{cluster_name}-ports')
+    except rest.KubeApiError as e:
+        raise _wrap_api_error(e) from e
+    if svc is None:
+        return {}
+    node_ip = ''
+    head = cluster_info.get_head_instance() if cluster_info else None
+    if head is not None:
+        pod = client.get('Pod', head.instance_id)
+        if pod:
+            node_ip = pod.get('status', {}).get('hostIP', '')
+    out: Dict[int, str] = {}
+    for entry in svc.get('spec', {}).get('ports', []):
+        node_port = entry.get('nodePort')
+        if node_port:
+            out[int(entry['port'])] = (
+                f'http://{node_ip or "<node-ip>"}:{node_port}')
+    return out
+
+
 # ---- fuse-proxy DaemonSet (privileged fusermount broker) -------------------
 
 FUSE_PROXY_NAMESPACE = 'kube-system'

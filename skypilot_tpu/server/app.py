@@ -62,7 +62,7 @@ def _get_request(params: Dict[str, str]) -> Tuple[int, Dict[str, Any]]:
 #: workspace is the cluster record's, not the caller's choice.
 _CLUSTER_VERBS = frozenset({
     'exec', 'start', 'stop', 'down', 'autostop', 'queue', 'cancel',
-    'logs', 'cluster_hosts',
+    'logs', 'cluster_hosts', 'endpoints',
 })
 
 

@@ -455,6 +455,29 @@ class TestNetworkingModes:
                                 {'networking_mode': 'portforward'})
         assert fake_kube.services == {}
 
+    def test_query_ports_resolves_nodeports(self, fake_kube):
+        """query_ports pairs the service's allocated nodePorts with the
+        head pod's node IP (sky status --endpoint twin)."""
+        config = _tpu_config()
+        k8s_instance.run_instances('in-cluster', None, 'mycluster',
+                                   config)
+        fake_kube.pods['mycluster-0'].setdefault(
+            'status', {})['hostIP'] = '34.1.2.3'
+        k8s_instance.open_ports('mycluster', ['8080'], {})
+        # The control plane allocates nodePorts server-side.
+        fake_kube.services['mycluster-ports']['spec']['ports'][0][
+            'nodePort'] = 30123
+        info = k8s_instance.get_cluster_info('in-cluster', 'mycluster',
+                                             {})
+        out = k8s_instance.query_ports('mycluster', ['8080'], {}, info)
+        assert out == {8080: 'http://34.1.2.3:30123'}
+        # portforward mode: no listener — the forward command instead.
+        out2 = k8s_instance.query_ports(
+            'mycluster', ['8080'],
+            {'networking_mode': 'portforward', 'namespace': 'ns1'},
+            info)
+        assert 'port-forward' in out2[0] and 'mycluster-0' in out2[0]
+
     def test_invalid_mode_rejected(self):
         from skypilot_tpu import exceptions
         with pytest.raises(exceptions.InvalidSkyTpuConfigError):

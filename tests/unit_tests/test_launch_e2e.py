@@ -332,3 +332,27 @@ class TestBootstrap:
         with open(marker) as f:
             assert f.read().strip() == first_hash
         assert os.path.getmtime(venv_py) == mtime
+
+
+class TestEndpoints:
+
+    def test_endpoints_map_ports_to_head_ip(self, fake_cluster_env):
+        """`xsky endpoints` (query_ports twin): opened ports resolve to
+        reachable URLs on the head host's feasible IP."""
+        from skypilot_tpu import core
+        task = Task('svc', run='echo up')
+        task.set_resources(Resources(accelerators='tpu-v5e-8',
+                                     ports=[8080, '9000-9001']))
+        _, handle = execution.launch(task, cluster_name='teps')
+        head_ip = handle.cluster_info.get_head_instance().get_feasible_ip()
+        eps = core.endpoints('teps')
+        assert eps == {8080: f'http://{head_ip}:8080',
+                       9000: f'http://{head_ip}:9000',
+                       9001: f'http://{head_ip}:9001'}
+        assert core.endpoints('teps', port=8080) == {
+            8080: f'http://{head_ip}:8080'}
+        # No ports requested → empty.
+        task2 = Task('plain', run='echo hi')
+        task2.set_resources(Resources(accelerators='tpu-v5e-8'))
+        execution.launch(task2, cluster_name='teps2')
+        assert core.endpoints('teps2') == {}
