@@ -174,6 +174,12 @@ class ComputeClient:
             'GET', f'{self.prefix}/instanceGroupManagers/{mig}'
                    f'/resizeRequests/{name}')
 
+    def delete_resize_request(self, mig: str,
+                              name: str) -> Dict[str, Any]:
+        return self.t.request(
+            'DELETE', f'{self.prefix}/instanceGroupManagers/{mig}'
+                      f'/resizeRequests/{name}')
+
     def list_managed_instances(self, mig: str) -> List[Dict[str, Any]]:
         out = self.t.request(
             'POST', f'{self.prefix}/instanceGroupManagers/{mig}'

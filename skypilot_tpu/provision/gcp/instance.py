@@ -330,13 +330,22 @@ def _run_vms_via_mig(gce, zone: str, cluster_name: str,
     # scale-up of an existing DWS cluster files a fresh request (the
     # old SUCCEEDED one must not satisfy the poll below) and a crash
     # between MIG create and request insert recovers by inserting on
-    # retry instead of 404ing.
+    # retry instead of 404ing. A TERMINAL request found at this name
+    # while instances are still missing is stale — run-duration expiry
+    # reclaimed the VMs (or the request failed earlier): delete and
+    # re-file, or the poll would report success with zero instances.
     rr_name = f'{mig}-rr{len(existing)}'
+    needs_insert = False
     try:
-        gce.get_resize_request(mig, rr_name)
+        stale = gce.get_resize_request(mig, rr_name)
+        if stale.get('state') in ('SUCCEEDED', 'FAILED', 'CANCELLED'):
+            gce.delete_resize_request(mig, rr_name)
+            needs_insert = True
     except rest.GcpApiError as e:
         if e.status != 404:
             raise
+        needs_insert = True
+    if needs_insert:
         body = compute_api.resize_request_body(
             cluster_name, config.count - len(existing),
             node_cfg.get('dws_run_duration_s'))

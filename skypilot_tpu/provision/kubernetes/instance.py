@@ -404,25 +404,28 @@ def query_ports(cluster_name: str, ports, provider_config: Dict[str, Any],
     del ports
     context = provider_config.get('context')
     namespace = provider_config.get('namespace', 'default')
-    client = _client(context, namespace)
     if networking_mode(provider_config) == 'portforward':
+        # No cluster-side listener exists in this mode — nothing to
+        # query (and no client to build): the forward command IS the
+        # endpoint.
         head = cluster_info.get_head_instance()
         pod = head.instance_id if head else f'{cluster_name}-0'
         ctx = f'--context {context} ' if context else ''
         return {0: f'kubectl {ctx}-n {namespace} port-forward '
                    f'pod/{pod} <local>:<port>'}
+    client = _client(context, namespace)
     try:
         svc = client.get('Service', f'{cluster_name}-ports')
+        if svc is None:
+            return {}
+        node_ip = ''
+        head = cluster_info.get_head_instance() if cluster_info else None
+        if head is not None:
+            pod = client.get('Pod', head.instance_id)
+            if pod:
+                node_ip = pod.get('status', {}).get('hostIP', '')
     except rest.KubeApiError as e:
         raise _wrap_api_error(e) from e
-    if svc is None:
-        return {}
-    node_ip = ''
-    head = cluster_info.get_head_instance() if cluster_info else None
-    if head is not None:
-        pod = client.get('Pod', head.instance_id)
-        if pod:
-            node_ip = pod.get('status', {}).get('hostIP', '')
     out: Dict[int, str] = {}
     for entry in svc.get('spec', {}).get('ports', []):
         node_port = entry.get('nodePort')

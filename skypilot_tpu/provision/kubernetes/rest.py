@@ -89,6 +89,7 @@ class KubeTransport:
         self._exec_spec: Optional[Dict[str, Any]] = None
         self._exec_token: Optional[str] = None
         self._exec_expiry: Optional[datetime.datetime] = None
+        self._sa_token_path: Optional[str] = None
         config = _load_kubeconfig()
         if config and (context or config.get('current-context')):
             self._init_from_kubeconfig(config, context)
@@ -106,8 +107,11 @@ class KubeTransport:
         host = os.environ.get('KUBERNETES_SERVICE_HOST', 'kubernetes.default.svc')
         port = os.environ.get('KUBERNETES_SERVICE_PORT', '443')
         self.server = f'https://{host}:{port}'
-        with open(os.path.join(_SA_DIR, 'token'), encoding='utf-8') as f:
-            self._headers['Authorization'] = f'Bearer {f.read().strip()}'
+        # Re-read per request (see request()): bound service-account
+        # tokens expire (~1h) and the kubelet rotates the projected
+        # file — a token pinned at construction would start 401ing on
+        # long-lived transports.
+        self._sa_token_path: Optional[str] = os.path.join(_SA_DIR, 'token')
         ca = os.path.join(_SA_DIR, 'ca.crt')
         self._ssl = ssl.create_default_context(
             cafile=ca if os.path.exists(ca) else None)
@@ -202,6 +206,9 @@ class KubeTransport:
         headers = dict(self._headers)
         if self._exec_spec is not None:
             headers['Authorization'] = f'Bearer {self._exec_credential()}'
+        elif getattr(self, '_sa_token_path', None):
+            with open(self._sa_token_path, encoding='utf-8') as f:
+                headers['Authorization'] = f'Bearer {f.read().strip()}'
         data = None
         if body is not None:
             data = json.dumps(body).encode()

@@ -257,11 +257,18 @@ class FakeGcp:
             rr = self.resize_requests.get(m.group(2))
             if rr is None:
                 raise rest.GcpApiError(404, 'notFound', 'no rr')
-            if self.rr_states:
+            # Terminal states are sticky (like the real API): scripted
+            # transitions only apply to in-flight requests.
+            if self.rr_states and rr.get('state') not in (
+                    'SUCCEEDED', 'FAILED', 'CANCELLED'):
                 rr['state'] = self.rr_states.pop(0)
                 if rr['state'] == 'SUCCEEDED':
                     self._materialize_mig(rr)
             return rr
+        if m and method == 'DELETE':
+            if self.resize_requests.pop(m.group(2), None) is None:
+                raise rest.GcpApiError(404, 'notFound', 'no rr')
+            return {'name': f'del-rr-{m.group(2)}'}
         m = re.search(
             r'/instanceGroupManagers/([^/]+)/listManagedInstances$', path)
         if m and method == 'POST':
@@ -918,3 +925,24 @@ def test_gpu_dws_scale_up_files_fresh_resize_request(fake_gcp):
     # Two distinct requests were filed (named by their FROM size).
     assert {'xsky-mig-dsc-rr0', 'xsky-mig-dsc-rr2'} <= set(
         fake_gcp.resize_requests)
+
+
+def test_gpu_dws_refiles_after_run_duration_reclaim(fake_gcp):
+    """DWS run-duration expiry reclaims the VMs but leaves the MIG and
+    its SUCCEEDED resize request: relaunch must delete the stale
+    request and file a fresh one — never report success with zero
+    instances (code-review r5)."""
+    fake_gcp.rr_states = ['SUCCEEDED']
+    gcp_instance.run_instances('us-central2', 'us-central2-b', 'drc',
+                               _gpu_config(count=2, gpu_dws=True))
+    assert len(fake_gcp.vms) == 2
+    # Reclamation: VMs vanish, MIG + old SUCCEEDED request persist.
+    for name in list(fake_gcp.vms):
+        fake_gcp.vms.pop(name)
+    fake_gcp.migs['xsky-mig-drc']['instances'].clear()
+    fake_gcp.rr_states = ['SUCCEEDED']
+    record = gcp_instance.run_instances(
+        'us-central2', 'us-central2-b', 'drc',
+        _gpu_config(count=2, gpu_dws=True))
+    assert len(record.created_instance_ids) == 2
+    assert len(fake_gcp.vms) == 2

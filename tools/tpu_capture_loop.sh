@@ -74,14 +74,25 @@ while true; do
       fi
     done
     # Evidence lands in git the moment it exists — the session may not
-    # be watching when the tunnel finally answers.
-    if [ "$captured" = 1 ] || { [ -f TPU_TIER_r05.txt ] && \
-         ! git diff --quiet -- TPU_TIER_r05.txt 2>/dev/null; }; then
-      git add BENCH_LOCAL_r05_*.json .bench_last_good_*.json \
-              TPU_TIER_r05.txt 2>/dev/null
-      git diff --cached --quiet 2>/dev/null || \
-        git commit -q -m "Record on-silicon round-5 captures" \
-          >> "$LOG" 2>&1
+    # be watching when the tunnel finally answers. Add each EXISTING
+    # file individually (git add is all-or-nothing across pathspecs, so
+    # one unmatched glob would silently stage nothing), detect new
+    # untracked evidence via status --porcelain (diff --quiet misses
+    # untracked files), and scope the commit with a pathspec so a
+    # concurrently-staged interactive change is never swept in.
+    evidence=""
+    for f in BENCH_LOCAL_r05_train.json BENCH_LOCAL_r05_serve.json \
+             .bench_last_good_train.json .bench_last_good_serve.json \
+             TPU_TIER_r05.txt; do
+      [ -f "$f" ] || continue
+      if [ -n "$(git status --porcelain -- "$f" 2>/dev/null)" ]; then
+        git add -- "$f" >> "$LOG" 2>&1 || true
+        evidence="$evidence $f"
+      fi
+    done
+    if [ -n "$evidence" ]; then
+      git commit -q -m "Record on-silicon round-5 captures" \
+        -- $evidence >> "$LOG" 2>&1 || true
     fi
   else
     echo "tunnel down $(date -u +%FT%TZ)" >> "$LOG"
