@@ -66,6 +66,22 @@ class TestServer:
 
 class TestRemoteSdk:
 
+    def test_endpoints_and_hosts_over_the_wire(self, client):
+        """endpoints/cluster_hosts round-trip through the API server
+        (JSON object keys arrive as strings; the client restores int
+        ports)."""
+        from skypilot_tpu import Resources, Task
+        task = Task('wired', run='echo up')
+        task.set_resources(Resources(accelerators='tpu-v5e-8',
+                                     ports=[8080]))
+        client.launch(task, cluster_name='rce1')
+        eps = client.endpoints('rce1')
+        assert list(eps) == [8080]
+        assert eps[8080].startswith('http://')
+        hosts = client.cluster_hosts('rce1')
+        assert hosts and hosts[0]['status'] == 'RUNNING'
+        client.down('rce1')
+
     def test_launch_status_logs_down(self, client):
         from skypilot_tpu import Resources, Task
         task = Task('remote-hello', run='echo remote-hi')
