@@ -1,0 +1,337 @@
+"""Live price refresh for the snapshot catalogs.
+
+Twin of the reference's live fetchers: its GCP fetcher queries the Cloud
+Billing SKU service (sky/catalog/data_fetchers/fetch_gcp.py:34-83) and its
+Azure fetcher pages the public Retail Prices API
+(sky/catalog/data_fetchers/fetch_azure.py). This repo's offline generators
+embed price snapshots so everything works with zero egress; prices rot,
+though, so this module patches the generated entries with *live* unit
+prices whenever network (and, for GCP, credentials) are available:
+
+  * GCP — Cloud Billing ``services/{id}/skus``, authenticated with the
+    same token chain as the provisioner (`provision/gcp/rest.py`): TPU
+    per-chip-hour SKUs by region. TPU slice rows are repriced as
+    ``chip_price * num_chips`` via the topology database, so live prices
+    stay consistent across every slice size by construction.
+  * Azure — Retail Prices API (public, unauthenticated): per-VM-size
+    on-demand + spot consumption rates by region.
+
+Scope is deliberately the rows the optimizer ranks on: TPU slices (the
+flagship) and Azure VM sizes. GCP GPU-VM prices are a composition of
+per-core, per-GiB and per-GPU SKUs in the billing API (the reference
+spends ~700 LoC decomposing them); the snapshot keeps covering those.
+
+Failure contract mirrors `hosted.py`: any error leaves the snapshot
+catalog untouched — stale prices beat a missing catalog. Never called on
+the task hot path; run explicitly (``python -m
+skypilot_tpu.catalog.live_prices gcp azure``) or via
+``tools/build_hosted_catalog.py --live``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import urllib.parse
+import urllib.request
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.catalog import common
+from skypilot_tpu.utils import tpu_topology
+
+logger = sky_logging.init_logger(__name__)
+
+# Cloud Billing TPU service ID (stable, listed at cloud.google.com/skus).
+# The GCE service (6F81-5844-456A) is deliberately NOT queried: GPU-VM
+# prices are a composition of per-core/per-GiB/per-GPU SKUs (see module
+# docstring) and stay on the snapshot.
+TPU_SERVICE_ID = 'E000-3F24-B8AA'
+
+_BILLING_URL = ('https://cloudbilling.googleapis.com/v1/services/'
+                '{service}/skus?pageSize=5000')
+_AZURE_RETAIL_BASE = 'https://prices.azure.com/api/retail/prices'
+
+# fetch_json(url, headers) -> parsed JSON body. Injectable for tests.
+FetchJson = Callable[[str, Dict[str, str]], dict]
+
+
+def _default_fetch(url: str, headers: Dict[str, str]) -> dict:
+    req = urllib.request.Request(url, headers=headers)
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+# --------------------------------------------------------------------------
+# GCP: Cloud Billing SKU service
+
+
+def _gcp_token() -> str:
+    from skypilot_tpu.provision.gcp import rest as gcp_rest
+    return gcp_rest.TokenProvider().token()
+
+
+def iter_gcp_skus(service_id: str,
+                  fetch: FetchJson,
+                  token: str) -> Iterable[dict]:
+    """Yield every SKU object for a billing service, following pages."""
+    headers = {'Authorization': f'Bearer {token}'}
+    url = _BILLING_URL.format(service=service_id)
+    while True:
+        page = fetch(url, headers)
+        yield from page.get('skus', [])
+        next_token = page.get('nextPageToken')
+        if not next_token:
+            return
+        url = (_BILLING_URL.format(service=service_id) +
+               '&pageToken=' + urllib.parse.quote(next_token))
+
+
+def _sku_unit_price(sku: dict) -> Optional[float]:
+    """$/usage-unit from the last (highest) tiered rate, like the ref."""
+    try:
+        rates = sku['pricingInfo'][0]['pricingExpression']['tieredRates']
+        unit = rates[-1]['unitPrice']
+        return float(unit.get('units') or 0) + unit.get('nanos', 0) * 1e-9
+    except (KeyError, IndexError, TypeError, ValueError):
+        return None
+
+
+def gcp_tpu_chip_prices(
+        skus: Iterable[dict]) -> Dict[Tuple[str, str], Dict[str, float]]:
+    """(generation, region) -> {'od': $/chip-hr, 'spot': $/chip-hr}.
+
+    TPU SKU descriptions name the generation ('Tpu-v5p ...', 'Tpu v4
+    pod ...'); spot SKUs carry 'Preemptible'/'Spot' in the description or
+    usageType. Commitment (1yr/3yr) SKUs are skipped — only OnDemand and
+    Preemptible usage maps onto the catalog's price columns. Where one
+    generation has both 'device' and 'pod' SKU variants (v5e), the pod
+    rate wins: the catalog prices whole slices, and pod rates are what
+    multi-host slices bill at. Unparseable SKUs are skipped — a partial
+    live map is fine because apply() only patches rows it has live data
+    for.
+    """
+    prices: Dict[Tuple[str, str], Dict[str, float]] = {}
+    from_pod: Dict[Tuple[str, str, str], bool] = {}
+    for sku in skus:
+        category = sku.get('category', {})
+        if category.get('resourceGroup') != 'TPU':
+            continue
+        usage = category.get('usageType', 'OnDemand')
+        if usage not in ('OnDemand', 'Preemptible'):
+            continue  # Commit1Yr/Commit3Yr etc.
+        desc = sku.get('description', '')
+        if 'Commitment' in desc:
+            continue
+        desc_l = desc.lower().replace(' ', '-')
+        gen = None
+        for name in tpu_topology.GENERATIONS:
+            # 'tpu-v5e', and the SKU spellings 'tpu-v5-lite*' for v5e.
+            if f'tpu-{name}' in desc_l:
+                gen = name
+                break
+        if gen is None and 'tpu-v5-lite' in desc_l:
+            gen = 'v5e'
+        if gen is None:
+            continue
+        price = _sku_unit_price(sku)
+        if price is None or price <= 0:
+            continue
+        spot = ('Preemptible' in desc or 'Spot' in desc
+                or usage == 'Preemptible')
+        kind = 'spot' if spot else 'od'
+        pod = 'pod' in desc_l
+        for region in sku.get('serviceRegions', []):
+            slot = prices.setdefault((gen, region), {})
+            key = (gen, region, kind)
+            # Last-write-wins would make prices depend on API ordering;
+            # instead a pod-variant rate always beats a device-variant
+            # one, and ties keep the first seen.
+            if kind in slot and (from_pod[key] or not pod):
+                continue
+            slot[kind] = price
+            from_pod[key] = pod
+    return prices
+
+
+def apply_gcp_live(
+    entries: List[common.CatalogEntry],
+    chip_prices: Dict[Tuple[str, str], Dict[str, float]],
+) -> Tuple[List[common.CatalogEntry], int]:
+    """Reprice TPU slice rows from live per-chip prices.
+
+    Rows without live data (unknown region/generation, GPU/CPU VMs) pass
+    through unchanged. Returns (entries, patched_count).
+    """
+    out: List[common.CatalogEntry] = []
+    patched = 0
+    for entry in entries:
+        if not entry.is_tpu:
+            out.append(entry)
+            continue
+        try:
+            topo = tpu_topology.parse(entry.accelerator_name)
+        except (ValueError, exceptions.SkyTpuError):
+            # parse raises InvalidRequestError (a SkyTpuError) for
+            # unknown generations/shapes; one odd snapshot row must not
+            # abort the whole refresh.
+            out.append(entry)
+            continue
+        live = chip_prices.get((topo.generation.name, entry.region))
+        if not live:
+            out.append(entry)
+            continue
+        od = live.get('od')
+        spot = live.get('spot')
+        entry = dataclasses.replace(
+            entry,
+            price=(od * topo.num_chips if od is not None else entry.price),
+            spot_price=(spot * topo.num_chips
+                        if spot is not None else entry.spot_price))
+        patched += 1
+        out.append(entry)
+    return out, patched
+
+
+# --------------------------------------------------------------------------
+# Azure: Retail Prices API (public)
+
+
+def azure_retail_url(regions: Iterable[str]) -> str:
+    """Retail Prices query scoped to the catalog's regions.
+
+    The unrestricted 'Virtual Machines' dataset is hundreds of thousands
+    of rows at ~100/page; constraining armRegionName to the handful of
+    regions the catalog actually covers keeps a --live run to a few
+    pages. The $filter value is URL-encoded (it contains spaces and
+    quotes; urllib refuses raw spaces in a request URL).
+    """
+    clauses = ' or '.join(f"armRegionName eq '{r}'" for r in sorted(regions))
+    filt = ("serviceName eq 'Virtual Machines' and "
+            "priceType eq 'Consumption'")
+    if clauses:
+        filt += f' and ({clauses})'
+    return _AZURE_RETAIL_BASE + '?$filter=' + urllib.parse.quote(filt)
+
+
+def iter_azure_prices(fetch: FetchJson,
+                      regions: Iterable[str]) -> Iterable[dict]:
+    url = azure_retail_url(regions)
+    while url:
+        page = fetch(url, {})
+        yield from page.get('Items', [])
+        url = page.get('NextPageLink') or ''
+
+
+def azure_vm_prices(
+        items: Iterable[dict]) -> Dict[Tuple[str, str], Dict[str, float]]:
+    """(armSkuName, armRegionName) -> {'od': $/hr, 'spot': $/hr}.
+
+    Windows-licensed and low-priority rows are skipped (the catalog
+    models Linux on-demand + spot, like the reference fetcher).
+    """
+    prices: Dict[Tuple[str, str], Dict[str, float]] = {}
+    for item in items:
+        sku = item.get('armSkuName') or ''
+        region = item.get('armRegionName') or ''
+        if not sku or not region:
+            continue
+        name = item.get('skuName', '') + ' ' + item.get('productName', '')
+        if 'Windows' in name or 'Low Priority' in name:
+            continue
+        try:
+            price = float(item.get('retailPrice', 0))
+        except (TypeError, ValueError):
+            continue
+        if price <= 0:
+            continue
+        kind = 'spot' if 'Spot' in name else 'od'
+        prices.setdefault((sku, region), {})[kind] = price
+    return prices
+
+
+def apply_azure_live(
+    entries: List[common.CatalogEntry],
+    vm_prices: Dict[Tuple[str, str], Dict[str, float]],
+) -> Tuple[List[common.CatalogEntry], int]:
+    out: List[common.CatalogEntry] = []
+    patched = 0
+    for entry in entries:
+        live = vm_prices.get((entry.instance_type, entry.region))
+        if not live:
+            out.append(entry)
+            continue
+        entry = dataclasses.replace(
+            entry,
+            price=live.get('od', entry.price),
+            spot_price=live.get('spot', entry.spot_price))
+        patched += 1
+        out.append(entry)
+    return out, patched
+
+
+# --------------------------------------------------------------------------
+# Top-level refresh
+
+
+def _read_catalog_csv(cloud: str) -> List[common.CatalogEntry]:
+    import csv
+    path = common.catalog_path(cloud)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f'no in-tree catalog for {cloud}: {path}')
+    with open(path, newline='', encoding='utf-8') as f:
+        return [common.CatalogEntry.from_row(row) for row in csv.DictReader(f)]
+
+
+def refresh(clouds: Iterable[str],
+            fetch: Optional[FetchJson] = None) -> Dict[str, int]:
+    """Patch each cloud's on-disk catalog with live prices.
+
+    Best-effort per cloud: a failure (no network, no credentials, API
+    change) logs and leaves that cloud's snapshot untouched. Returns
+    {cloud: rows_patched} for the clouds that succeeded.
+    """
+    fetch = fetch or _default_fetch
+    results: Dict[str, int] = {}
+    for cloud in clouds:
+        try:
+            # Read the in-tree CSV directly — NOT load_catalog(), whose
+            # hosted-download preference / lru cache could hand back a
+            # stale prior build that save_catalog would then clobber the
+            # fresh snapshot with.
+            entries = _read_catalog_csv(cloud)
+            if cloud == 'gcp':
+                prices = gcp_tpu_chip_prices(
+                    iter_gcp_skus(TPU_SERVICE_ID, fetch, _gcp_token()))
+                entries, patched = apply_gcp_live(entries, prices)
+            elif cloud == 'azure':
+                regions = {e.region for e in entries}
+                entries, patched = apply_azure_live(
+                    entries,
+                    azure_vm_prices(iter_azure_prices(fetch, regions)))
+            else:
+                logger.warning('live prices: no live source for %s', cloud)
+                continue
+            if patched:
+                common.save_catalog(cloud, entries)
+                common.clear_cache()
+            results[cloud] = patched
+            logger.info('live prices: %s — %d rows patched', cloud, patched)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning('live prices: %s refresh failed (%s); '
+                           'keeping snapshot', cloud, e)
+    return results
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import sys
+    clouds = (argv if argv is not None else sys.argv[1:]) or ['gcp', 'azure']
+    results = refresh(clouds)
+    for cloud, patched in results.items():
+        print(f'{cloud}: {patched} rows repriced')
+    return 0 if results else 1
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -53,6 +53,12 @@ def main() -> int:
     parser.add_argument('--schema', default='v1')
     parser.add_argument('--clouds', nargs='*', default=None,
                         help='Subset of clouds (default: all fetchers).')
+    parser.add_argument('--live', action='store_true',
+                        help='After the snapshot fetchers run, patch the '
+                             'generated CSVs with live prices (Cloud '
+                             'Billing SKUs for GCP, Retail Prices API '
+                             'for Azure). Best-effort: failures keep '
+                             'the snapshot numbers.')
     args = parser.parse_args()
 
     root = os.path.join(args.out, args.schema)
@@ -85,6 +91,9 @@ def main() -> int:
             # (live APIs where credentials allow, the maintained price
             # snapshot otherwise).
             fetch()
+            if args.live:
+                from skypilot_tpu.catalog import live_prices
+                live_prices.refresh([cloud])
         except Exception as e:  # pylint: disable=broad-except
             print(f'  {cloud}: fetch failed ({e}), skipped',
                   file=sys.stderr)
