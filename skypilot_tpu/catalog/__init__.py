@@ -14,7 +14,18 @@ from skypilot_tpu.utils import tpu_topology
 
 CatalogEntry = common.CatalogEntry
 
-_ALL_CLOUDS = ('gcp', 'fake')
+def _all_clouds() -> Tuple[str, ...]:
+    """Every cloud with an in-tree catalog (discovered, not hardcoded —
+    a hardcoded tuple silently dropped 14 clouds from show-gpus).
+    The test-only fake cloud is included only when it's enabled."""
+    import os
+    data_dir = os.path.join(os.path.dirname(__file__), 'data')
+    clouds = sorted(
+        d for d in os.listdir(data_dir)
+        if os.path.isdir(os.path.join(data_dir, d)) and d != 'fake')
+    if os.environ.get('XSKY_ENABLE_FAKE_CLOUD') == '1':  # check.py's gate
+        clouds.append('fake')
+    return tuple(clouds)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,7 +49,7 @@ def list_accelerators(
 ) -> Dict[str, List[AcceleratorOffering]]:
     """accelerator name → offerings, cheapest first."""
     result: Dict[str, List[AcceleratorOffering]] = {}
-    for cloud in clouds or _ALL_CLOUDS:
+    for cloud in clouds or _all_clouds():
         groups: Dict[Tuple[str, float, str], List[common.CatalogEntry]] = {}
         for e in common.load_catalog(cloud):
             if not e.accelerator_name:
@@ -76,7 +87,7 @@ def list_accelerators(
 def get_tpus(clouds: Optional[List[str]] = None) -> List[str]:
     """All TPU slice names in the catalogs (twin of catalog get_tpus:337)."""
     names = set()
-    for cloud in clouds or _ALL_CLOUDS:
+    for cloud in clouds or _all_clouds():
         for e in common.load_catalog(cloud):
             if e.is_tpu:
                 names.add(e.accelerator_name)

@@ -412,19 +412,27 @@ def check():
 @click.argument('accelerator_filter', required=False)
 @click.option('--all', '-a', 'show_all', is_flag=True, default=False)
 def show_gpus(accelerator_filter, show_all):
-    """List accelerators (GPUs and TPU slices) with prices."""
-    from skypilot_tpu import catalog
-    accs = catalog.list_accelerators(name_filter=accelerator_filter)
-    fmt = '{:<16} {:<8} {:<7} {:<11} {:<11} {:<10}'
+    """List accelerators (GPUs and TPU slices) with prices.
+
+    Goes through the SDK so a configured remote API server answers
+    from ITS catalogs (the reference's show-gpus is server-side too);
+    falls back to the local catalog otherwise."""
+    from skypilot_tpu.client import sdk
+    rows = sdk.accelerators(name_filter=accelerator_filter)
+    fmt = '{:<16} {:<8} {:<12} {:<11} {:<11} {:<10}'
     click.echo(fmt.format('ACCELERATOR', 'COUNT', 'CLOUD', '$/HR',
                           'SPOT $/HR', 'MEM(GB)'))
-    for name in sorted(accs):
-        for o in accs[name][:None if show_all else 1]:
-            click.echo(fmt.format(
-                name, f'{o.accelerator_count:g}', o.cloud,
-                f'{o.price:.2f}' if o.price else '-',
-                f'{o.spot_price:.2f}' if o.spot_price else '-',
-                f'{o.memory_gib:g}'))
+    shown = set()
+    for o in rows:   # name-sorted, cheapest offering first per name
+        if not show_all and o['accelerator_name'] in shown:
+            continue
+        shown.add(o['accelerator_name'])
+        click.echo(fmt.format(
+            o['accelerator_name'], f"{o['accelerator_count']:g}",
+            o['cloud'],
+            f"{o['price']:.2f}" if o['price'] else '-',
+            f"{o['spot_price']:.2f}" if o['spot_price'] else '-',
+            f"{o['memory_gib']:g}"))
 
 
 @cli.command(name='cost-report')
@@ -705,6 +713,29 @@ def serve_down(service_names, yes):
     for name in service_names:
         sdk.serve_down(name)
         click.echo(f'Service {name} torn down.')
+
+
+@serve.command(name='history')
+@click.argument('service_name')
+@click.option('--limit', type=int, default=30,
+              help='Most recent controller ticks to show.')
+def serve_history(service_name, limit):
+    """QPS / autoscaler-target / ready-replica trend per tick."""
+    from skypilot_tpu.client import sdk
+    rows = sdk.serve_history(service_name, limit=limit)
+    fmt = '{:<20} {:>8} {:>8} {:>7}'
+    click.echo(fmt.format('TICK', 'QPS', 'TARGET', 'READY'))
+    import datetime
+    for r in rows:
+        tick = datetime.datetime.fromtimestamp(
+            r['ts']).strftime('%m-%d %H:%M:%S')
+        qps = f"{r['qps']:.2f}" if r['qps'] is not None else '-'
+        click.echo(fmt.format(
+            tick, qps,
+            r['target_replicas'] if r['target_replicas'] is not None
+            else '-',
+            r['ready_replicas'] if r['ready_replicas'] is not None
+            else '-'))
 
 
 @cli.group()

@@ -327,6 +327,29 @@ def serve_controller_logs(service_name: str) -> str:
     return serve_core.controller_logs(service_name)
 
 
+def serve_history(service_name: str,
+                  limit: int = 720) -> List[Dict[str, Any]]:
+    """Per-tick QPS / autoscaler-target / ready-replica trend."""
+    remote = _remote()
+    if remote is not None:
+        return remote._call('serve.history', {
+            'service_name': service_name, 'limit': limit})
+    from skypilot_tpu.serve import core as serve_core
+    return serve_core.metrics_history(service_name, limit=limit)
+
+
+def accelerators(name_filter: Optional[str] = None,
+                 gpus_only: bool = False) -> List[Dict[str, Any]]:
+    """Accelerator offerings across all catalogs (show-gpus twin)."""
+    remote = _remote()
+    if remote is not None:
+        return remote._call('accelerators', {
+            'name_filter': name_filter, 'gpus_only': gpus_only})
+    from skypilot_tpu import core as core_lib
+    return core_lib.list_accelerators(name_filter=name_filter,
+                                      gpus_only=gpus_only)
+
+
 def serve_down(service_name: str) -> None:
     remote = _remote()
     if remote is not None:

@@ -294,6 +294,26 @@ def check(quiet: bool = False) -> Dict[str, Any]:
             for name, (ok, reason) in results.items()}
 
 
+def list_accelerators(name_filter: Optional[str] = None,
+                      gpus_only: bool = False) -> List[Dict[str, Any]]:
+    """Accelerator offerings across every in-tree catalog, as plain
+    dicts for the wire (`accelerators` verb — the dashboard infra view
+    and remote `show-gpus` twins of sky/core.py list_accelerators)."""
+    from skypilot_tpu import catalog
+    offerings = catalog.list_accelerators(name_filter=name_filter,
+                                          gpus_only=gpus_only)
+    return [{
+        'accelerator_name': o.accelerator_name,
+        'accelerator_count': o.accelerator_count,
+        'cloud': o.cloud,
+        'instance_type': o.instance_type,
+        'regions': list(o.regions),
+        'price': o.price,
+        'spot_price': o.spot_price,
+        'memory_gib': o.memory_gib,
+    } for name in sorted(offerings) for o in offerings[name]]
+
+
 def cost_report() -> List[Dict[str, Any]]:
     """Per-cluster cost: catalog rate × billable uptime.
 

@@ -130,7 +130,7 @@ class SkyServeController:
         qps_fn = getattr(self.autoscaler, 'current_qps', None)
         serve_state.set_service_metrics(
             self.service_name, qps_fn() if qps_fn else None,
-            decision.target_num_replicas)
+            decision.target_num_replicas, ready_replicas=ready)
         self._apply_scale(decision.target_num_replicas)
         manager.reconcile_versions(decision.target_num_replicas)
         self.load_balancer.set_ready_replicas(manager.ready_endpoints())

@@ -259,6 +259,19 @@ def down(service_name: str) -> None:
     serve_state.remove_service(service_name)
 
 
+def metrics_history(service_name: str,
+                    limit: int = 720) -> List[Dict[str, Any]]:
+    """Per-tick QPS/target/ready trend for the dashboard chart
+    (`serve.history` verb; the reference dashboard charts the same
+    series from its controller DB). Oldest-first, bounded."""
+    if _remote_mode():
+        from skypilot_tpu.serve import remote as serve_remote
+        return serve_remote.metrics_history(service_name, limit)
+    if serve_state.get_service(service_name) is None:
+        raise ValueError(f'Service {service_name!r} not found.')
+    return serve_state.get_metrics_history(service_name, limit=limit)
+
+
 def tail_logs(service_name: str, replica_id: int,
               job_id: Optional[int] = None) -> str:
     """Log tail of one replica's cluster (twin of `sky serve logs`)."""

@@ -119,3 +119,7 @@ def tail_logs(service_name: str, replica_id: int,
 
 def controller_logs(service_name: str) -> str:
     return _relay.call('controller-logs', service_name)['logs']
+
+
+def metrics_history(service_name: str, limit: int) -> List[Dict[str, Any]]:
+    return _relay.call('history', service_name, str(int(limit)))

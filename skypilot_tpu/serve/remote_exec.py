@@ -60,6 +60,9 @@ def main(argv) -> int:
                 job_id=None if job_id < 0 else job_id)})
         elif verb == 'controller-logs':
             _print({'logs': serve_core.controller_logs(args[0])})
+        elif verb == 'history':
+            _print(serve_core.metrics_history(args[0],
+                                              limit=int(args[1])))
         else:
             _print({'error': f'unknown verb {verb}'})
             return 2

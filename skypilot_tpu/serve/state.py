@@ -56,7 +56,16 @@ def _db() -> sqlite3.Connection:
             launched_at REAL,
             version INTEGER DEFAULT 1,
             PRIMARY KEY (service_name, replica_id)
-        )""")
+        );
+        CREATE TABLE IF NOT EXISTS service_metrics_history (
+            service_name TEXT,
+            ts REAL,
+            qps REAL,
+            target_replicas INTEGER,
+            ready_replicas INTEGER
+        );
+        CREATE INDEX IF NOT EXISTS idx_metrics_history
+            ON service_metrics_history (service_name, ts)""")
     for table, column in (('services', 'version INTEGER DEFAULT 1'),
                           ('replicas', 'version INTEGER DEFAULT 1'),
                           # Mixed fleets: spot replicas + on-demand
@@ -148,16 +157,52 @@ def set_service_status(name: str, status: ServiceStatus) -> None:
         conn.close()
 
 
+# Bounded per-service metrics history ring for the dashboard chart. At
+# the controller's default 2 s tick (controller.py CONTROLLER_INTERVAL_S)
+# 3600 rows retain the last ~2 hours; slower ticks retain
+# proportionally more. Row count, not wall clock, bounds the DB.
+_METRICS_HISTORY_MAX = 3600
+
+
 def set_service_metrics(name: str, qps: Optional[float],
-                        target_replicas: Optional[int]) -> None:
-    """Controller-tick metrics snapshot (serve.status / dashboard)."""
+                        target_replicas: Optional[int],
+                        ready_replicas: Optional[int] = None) -> None:
+    """Controller-tick metrics snapshot (serve.status / dashboard).
+
+    Besides the live columns on the services row, each tick appends to
+    a bounded `service_metrics_history` ring so the dashboard can chart
+    the trend (`serve.history` verb), not just the instant."""
     with _lock:
         conn = _db()
         conn.execute(
             'UPDATE services SET qps=?, target_replicas=? WHERE name=?',
             (qps, target_replicas, name))
+        conn.execute(
+            'INSERT INTO service_metrics_history '
+            '(service_name, ts, qps, target_replicas, ready_replicas) '
+            'VALUES (?, ?, ?, ?, ?)',
+            (name, time.time(), qps, target_replicas, ready_replicas))
+        conn.execute(
+            'DELETE FROM service_metrics_history WHERE service_name=? '
+            'AND ts NOT IN (SELECT ts FROM service_metrics_history '
+            'WHERE service_name=? ORDER BY ts DESC LIMIT ?)',
+            (name, name, _METRICS_HISTORY_MAX))
         conn.commit()
         conn.close()
+
+
+def get_metrics_history(name: str,
+                        limit: int = 720) -> List[Dict[str, Any]]:
+    """Most recent `limit` ticks, oldest first (chart-ready)."""
+    with _lock:
+        conn = _db()
+        rows = conn.execute(
+            'SELECT ts, qps, target_replicas, ready_replicas FROM '
+            'service_metrics_history WHERE service_name=? '
+            'ORDER BY ts DESC LIMIT ?', (name, int(limit))).fetchall()
+        conn.close()
+    return [{'ts': r[0], 'qps': r[1], 'target_replicas': r[2],
+             'ready_replicas': r[3]} for r in reversed(rows)]
 
 
 def set_service_controller_pid(name: str, pid: int) -> None:
@@ -191,6 +236,8 @@ def remove_service(name: str) -> None:
         conn = _db()
         conn.execute('DELETE FROM services WHERE name=?', (name,))
         conn.execute('DELETE FROM replicas WHERE service_name=?', (name,))
+        conn.execute('DELETE FROM service_metrics_history '
+                     'WHERE service_name=?', (name,))
         conn.commit()
         conn.close()
 

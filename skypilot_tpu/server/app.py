@@ -120,7 +120,7 @@ def _target_workspace(verb: str, body: Dict[str, Any]) -> 'Optional[str]':
             return None   # nonexistent job: the verb no-ops/404s
         return record.get('workspace') or ws_context.DEFAULT_WORKSPACE
     if verb in ('serve.down', 'serve.update', 'serve.logs',
-                'serve.controller_logs'):
+                'serve.controller_logs', 'serve.history'):
         service = body.get('service_name')
         if not service:
             return None

@@ -115,6 +115,8 @@ _VERBS: Dict[str, Callable[[Dict[str, Any]],
     'logs': _core_verb('tail_logs', 'cluster_name', job_id=None),
     'check': _core_verb('check', quiet=True),
     'cost_report': _core_verb('cost_report'),
+    'accelerators': _core_verb('list_accelerators', name_filter=None,
+                               gpus_only=False),
     'storage.ls': _core_verb('storage_ls'),
     'storage.delete': _core_verb('storage_delete', 'storage_name'),
 }
@@ -211,6 +213,8 @@ _VERBS.update({
                               job_id=None),
     'serve.controller_logs': _serve_verb('controller_logs',
                                          'service_name'),
+    'serve.history': _serve_verb('metrics_history', 'service_name',
+                                 limit=720),
     # User management (admin-only via users.rbac).
     'users.list': _module_verb(_USERS, 'list_users'),
     'users.create': _module_verb(_USERS, 'create_user', 'name', 'password',

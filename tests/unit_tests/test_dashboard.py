@@ -212,3 +212,51 @@ def test_dashboard_shows_hosts_and_qps():
     assert "tryCall('cluster_hosts'" in html
     assert 'qps' in html
     assert 'autoscaler target' in html
+
+
+def test_metrics_history_bounded_and_ordered(monkeypatch, tmp_path):
+    """Every controller tick appends one history row; the ring stays
+    bounded; the verb returns oldest-first for the chart."""
+    from skypilot_tpu.serve import state as serve_state
+    monkeypatch.setenv('XSKY_SERVE_DB', str(tmp_path / 's.db'))
+    monkeypatch.setattr(serve_state, '_METRICS_HISTORY_MAX', 5)
+    serve_state.add_service('h1', {'run': 'x'}, 9999)
+    for i in range(8):
+        serve_state.set_service_metrics('h1', float(i), i, ready_replicas=i)
+    hist = serve_state.get_metrics_history('h1', limit=100)
+    assert len(hist) == 5                       # pruned to the ring max
+    assert [r['qps'] for r in hist] == [3.0, 4.0, 5.0, 6.0, 7.0]
+    assert hist[-1]['ready_replicas'] == 7
+    assert hist[0]['ts'] <= hist[-1]['ts']
+
+    from skypilot_tpu.serve import core as serve_core
+    assert serve_core.metrics_history('h1', limit=2) == hist[-2:]
+    with pytest.raises(ValueError):
+        serve_core.metrics_history('nope')
+    # Teardown reaps the history rows with the service.
+    serve_state.remove_service('h1')
+    assert serve_state.get_metrics_history('h1') == []
+
+
+def test_accelerators_verb_wire_shape():
+    """The infra view's accelerators verb returns plain JSON dicts,
+    name-sorted with the cheapest offering first per name."""
+    from skypilot_tpu import core as core_lib
+    rows = core_lib.list_accelerators(name_filter='a100')
+    assert rows, 'A100 missing from catalogs'
+    assert {'accelerator_name', 'cloud', 'price', 'spot_price',
+            'regions'} <= set(rows[0])
+    json.dumps(rows)   # wire-serializable as-is
+    names = [r['accelerator_name'] for r in rows]
+    assert names == sorted(names)
+    first_a100 = [r for r in rows if r['accelerator_name'] == 'A100']
+    priced = [r['price'] for r in first_a100 if r['price'] > 0]
+    assert priced == sorted(priced)
+
+
+def test_dashboard_has_chart_endpoints_and_accelerators():
+    html = _index_html()
+    assert "tryCall('serve.history'" in html
+    assert "tryCall('endpoints'" in html
+    assert "tryCall('accelerators'" in html
+    assert 'metricsChart' in html
