@@ -669,6 +669,13 @@ def _supervise(argv) -> int:
                 break
             if failure['stage'] == 'run' and serve:
                 break  # OOM-class: fresh process, next rung down
+            if attempt == attempts and failure['stage'] == 'backend_init':
+                # Init hangs are rung-independent (the tunnel itself is
+                # down): descending would burn attempts*init_timeout per
+                # remaining rung for the same hang. Fail fast so the
+                # capture loop gets back to cheap probing sooner.
+                exhausted = True
+                break
             if attempt < attempts:
                 time.sleep(15 * attempt)
     # Dead tunnel / repeated failure: the failure JSON still carries the

@@ -215,6 +215,18 @@ def gang_launch(runners: Sequence[runner_lib.CommandRunner],
     """
     assert len(runners) == len(host_envs)
     os.makedirs(log_dir, exist_ok=True)
+    # Symlink rank-0's log as run.log BEFORE the gang starts: the live
+    # tails (job_cli watch → dashboard / `logs --follow`) poll run.log
+    # while the job runs — created only at gang end, every mid-run poll
+    # read an empty tail and the whole log arrived in one chunk at
+    # completion (or never, when a managed controller reaped the
+    # cluster first).
+    run_log = os.path.join(log_dir, 'run.log')
+    if not os.path.lexists(run_log):   # lexists: catch dangling links
+        try:
+            os.symlink('host-0.log', run_log)
+        except OSError:
+            pass
     procs: List[subprocess.Popen] = []
 
     def _start(rank: int) -> subprocess.Popen:
@@ -246,10 +258,9 @@ def gang_launch(runners: Sequence[runner_lib.CommandRunner],
             except ValueError:
                 pass
 
-    # Symlink rank-0 log as run.log for the default log tail.
-    rank0 = os.path.join(log_dir, 'host-0.log')
-    run_log = os.path.join(log_dir, 'run.log')
-    if os.path.exists(rank0) and not os.path.exists(run_log):
+    # Retry if the start-of-gang symlink attempt failed (transient
+    # OSError): by now host-0.log certainly exists.
+    if not os.path.lexists(run_log):
         try:
             os.symlink('host-0.log', run_log)
         except OSError:

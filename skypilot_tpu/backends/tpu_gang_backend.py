@@ -517,6 +517,26 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
         return {'status': rec['status'], 'offset': rec['offset'],
                 'log': rec['log'].decode('utf-8', errors='replace')}
 
+    def fetch_job_log_bytes(self, handle: ClusterHandle, job_id: int,
+                            max_bytes: int = 64 << 20) -> bytes:
+        """Byte-exact run.log fetch via the incremental watch channel.
+
+        `tail_logs` goes through a text-mode login-shell capture that
+        rewrites newlines (\\r from progress bars → \\n) and can prepend
+        profile noise; archives made from it would break the live
+        tail's byte-offset carry-over. The watch channel ships base64
+        chunks of the raw file, so offsets stay true.
+        """
+        out = bytearray()
+        offset = 0
+        while len(out) < max_bytes:
+            rec = self._watch_job(handle, job_id, offset)
+            if rec is None or not rec['log']:
+                break
+            out += rec['log']
+            offset = rec['offset']
+        return bytes(out)
+
     def _wait_job(self, handle: ClusterHandle, job_id: int,
                   timeout_s: float = 3600.0,
                   stream_logs: bool = True) -> job_lib.JobStatus:

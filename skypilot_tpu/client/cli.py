@@ -644,9 +644,50 @@ def jobs_cancel(job_ids):
 
 @jobs.command(name='logs')
 @click.argument('job_id', type=int)
-def jobs_logs(job_id):
+@click.option('--follow', '-f', is_flag=True, default=False,
+              help='Stream the task log until the job reaches a '
+                   'terminal state (survives recovery cluster swaps).')
+def jobs_logs(job_id, follow):
     from skypilot_tpu.client import sdk
-    click.echo(sdk.jobs_logs(job_id), nl=False)
+    if not follow:
+        click.echo(sdk.jobs_logs(job_id), nl=False)
+        return
+    import time as time_lib
+    offset, epoch, errors = 0, None, 0
+    while True:
+        try:
+            poll = sdk.jobs_watch_logs(job_id, offset=offset)
+        except Exception as e:  # pylint: disable=broad-except
+            # Transient API-server / remote-exec blips must not kill a
+            # follow that exists to survive recovery windows. Back off;
+            # give up only when the source stays dead.
+            errors += 1
+            if errors >= 8:
+                raise click.ClickException(
+                    f'log source unavailable after {errors} '
+                    f'consecutive poll failures: {e}')
+            time_lib.sleep(min(2 * errors, 15))
+            continue
+        errors = 0
+        if epoch is not None and poll.get('epoch') not in (None, epoch):
+            # Recovery swapped the task cluster: its fresh log starts
+            # over at 0.
+            click.echo('\n--- job recovered; log restarted ---')
+            offset, epoch = 0, poll.get('epoch')
+            continue
+        if poll.get('epoch') is not None:
+            epoch = poll['epoch']
+        if poll.get('data'):
+            click.echo(poll['data'], nl=False)
+        offset = poll.get('offset', offset)
+        if poll.get('done'):
+            # Drain: polls cap at 256 KB, so a finished job may still
+            # have backlog — keep reading until a dry poll.
+            if poll.get('data'):
+                continue
+            click.echo(f"\n(job {poll['status']})")
+            return
+        time_lib.sleep(2)
 
 
 @cli.group()

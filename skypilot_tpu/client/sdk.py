@@ -276,6 +276,17 @@ def jobs_logs(job_id: int) -> str:
     return jobs_core.tail_logs(job_id)
 
 
+def jobs_watch_logs(job_id: int, offset: int = 0) -> Dict[str, Any]:
+    """One incremental managed-job log poll → {status, offset, data,
+    epoch} (epoch changes when recovery swaps the task cluster)."""
+    remote = _remote()
+    if remote is not None:
+        return remote._call('jobs.watch_logs',
+                            {'job_id': job_id, 'offset': offset})
+    from skypilot_tpu.jobs import core as jobs_core
+    return jobs_core.watch_logs(job_id, offset=offset)
+
+
 # ---- serve -----------------------------------------------------------------
 
 

@@ -124,6 +124,7 @@ class JobsController:
             # Free the launch slot whether or not provisioning worked —
             # the scheduler can start the next queued controller.
             scheduler.launch_done(self.job_id)
+        jobs_state.set_cluster_job_id(self.job_id, cluster_job_id)
         jobs_state.set_status(self.job_id,
                               jobs_state.ManagedJobStatus.RUNNING)
         # Reaching steady state clears the HA respawn budget: it exists
@@ -190,6 +191,9 @@ class JobsController:
         try:
             handle, cluster_job_id = self.strategy.recover(
                 self._current_handle())
+            # The relaunched task runs under a NEW cluster job id (and
+            # possibly a new cluster); keep the live-tail pointer fresh.
+            jobs_state.set_cluster_job_id(self.job_id, cluster_job_id)
             return handle, cluster_job_id
         except exceptions.ResourcesUnavailableError as e:
             jobs_state.set_status(
@@ -206,16 +210,52 @@ class JobsController:
         return record['handle'] if record else None
 
     def _cleanup(self) -> None:
-        """Tear down the task cluster after terminal states
-        (twin of controller.py:573)."""
+        """Archive the task log, then tear down the task cluster
+        (twin of controller.py:573; the reference syncs managed-job
+        logs to the controller before teardown too)."""
         from skypilot_tpu import state as state_lib
         record = state_lib.get_cluster_from_name(self.cluster_name)
         if record is not None and record['handle'] is not None:
+            self._archive_task_log(record['handle'])
             try:
                 self.strategy.backend.teardown(record['handle'],
                                                terminate=True, purge=True)
             except Exception as e:  # pylint: disable=broad-except
                 logger.warning(f'Cleanup teardown failed: {e}')
+
+    def _archive_task_log(self, handle) -> None:
+        """Copy the task's rank-0 run.log next to the controller so
+        `jobs logs` / live tails outlive the cluster reap (without
+        this, the final log chunk raced teardown and whole logs were
+        unreadable after completion)."""
+        job_record = jobs_state.get_job(self.job_id)
+        if job_record is None:
+            return
+        cluster_job_id = job_record.get('cluster_job_id')
+        if cluster_job_id is None:
+            return
+        try:
+            # Byte-exact fetch (base64 watch channel), NOT tail_logs:
+            # the archive must preserve the live tail's byte offsets so
+            # a follower can carry its offset across the teardown.
+            fetch = getattr(self.strategy.backend,
+                            'fetch_job_log_bytes', None)
+            if fetch is not None:
+                log = fetch(handle, cluster_job_id)
+            else:   # non-gang backend: text tail beats no archive
+                log = self.strategy.backend.tail_logs(
+                    handle, cluster_job_id, follow=False).encode()
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Task log archive fetch failed: {e}')
+            return
+        path = jobs_state.task_log_archive_path(
+            self.job_id, job_record.get('current_task') or 0)
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, 'wb') as f:
+                f.write(log)
+        except OSError as e:
+            logger.warning(f'Task log archive write failed: {e}')
 
 
 def main() -> int:

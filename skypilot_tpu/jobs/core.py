@@ -126,6 +126,68 @@ def cancel(job_id: int) -> None:
             pass
 
 
+def watch_logs(job_id: int, offset: int = 0) -> Dict[str, Any]:
+    """One incremental poll of a managed job's task log → {status,
+    offset, data}. Status is the MANAGED job status (so tails stop on
+    SUCCEEDED/FAILED/CANCELLED, not on a mid-recovery cluster swap);
+    offset resets naturally when recovery moves the job to a fresh
+    cluster log. Powers the dashboard live tail + `jobs logs
+    --follow`."""
+    if _remote_mode():
+        from skypilot_tpu.jobs import remote as jobs_remote
+        return jobs_remote.watch_logs(job_id, offset)
+    record = jobs_state.get_job(job_id)
+    if record is None:
+        return {'status': 'NOT_FOUND', 'offset': offset, 'data': '',
+                'done': True}
+    # `done` is the single source of truth for "stop tailing" —
+    # clients must not hand-copy the terminal-status list (it would go
+    # stale the day the enum grows).
+    done = record['status'].is_terminal()
+    status = record['status'].value
+    cluster_name = record['cluster_name']
+    cluster_job_id = record.get('cluster_job_id')
+    if not cluster_name or cluster_job_id is None:
+        return {'status': status, 'offset': offset, 'data': '',
+                'done': done}
+    # Recovery moves the task to a fresh cluster/log whose file is
+    # shorter than the caller's offset; `epoch` lets the client detect
+    # the swap and restart its offset at 0. Task index is part of the
+    # epoch: pipeline tasks reuse the cluster NAME and restart cluster
+    # job ids at 1, so name#cjid alone wouldn't reset the offset.
+    task_index = record.get('current_task') or 0
+    epoch = f'{cluster_name}#task{task_index}#{cluster_job_id}'
+    from skypilot_tpu import core as core_lib
+    try:
+        poll = core_lib.watch_job_log(cluster_name, cluster_job_id,
+                                      offset)
+        return {'status': status, 'offset': poll.get('offset', offset),
+                'data': poll.get('log') or poll.get('data') or '',
+                'epoch': epoch, 'done': done}
+    except Exception:  # pylint: disable=broad-except
+        # Cluster torn down (job done, or mid-recovery): serve the
+        # controller-side archive — a byte-identical copy of the same
+        # rank-0 run.log (fetched over the base64 watch channel), so
+        # the caller's offset carries straight over and the final
+        # chunk never races the reap.
+        data, new_offset = _read_archive(job_id, task_index, offset)
+        return {'status': status, 'offset': new_offset, 'data': data,
+                'epoch': epoch, 'done': done}
+
+
+def _read_archive(job_id: int, task_index: int,
+                  offset: int) -> tuple:
+    path = jobs_state.task_log_archive_path(job_id, task_index)
+    try:
+        with open(path, 'rb') as f:
+            f.seek(max(0, offset))
+            chunk = f.read(262144)
+        return chunk.decode('utf-8', errors='replace'), \
+            max(0, offset) + len(chunk)
+    except OSError:
+        return '', offset
+
+
 def tail_logs(job_id: int) -> str:
     if _remote_mode():
         from skypilot_tpu.jobs import remote as jobs_remote
@@ -141,5 +203,11 @@ def tail_logs(job_id: int) -> str:
     try:
         return core_lib.tail_logs(cluster_name)
     except (exceptions.ClusterDoesNotExist, exceptions.ClusterNotUpError):
+        # Reaped cluster: the controller archived the task log before
+        # teardown.
+        data, _ = _read_archive(job_id,
+                                record.get('current_task') or 0, 0)
+        if data:
+            return data
         return f'(cluster {cluster_name} is gone; job status: ' \
                f'{record["status"].value})'

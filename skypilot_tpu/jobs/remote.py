@@ -77,3 +77,7 @@ def cancel(job_id: int) -> None:
 
 def tail_logs(job_id: int) -> str:
     return _relay.call('logs', str(job_id))['logs']
+
+
+def watch_logs(job_id: int, offset: int) -> Dict[str, Any]:
+    return _relay.call('watch-logs', str(job_id), str(int(offset)))

@@ -55,6 +55,8 @@ def main(argv) -> int:
         _print({'ok': True})
     elif verb == 'logs':
         _print({'logs': jobs_core.tail_logs(int(args[0]))})
+    elif verb == 'watch-logs':
+        _print(jobs_core.watch_logs(int(args[0]), offset=int(args[1])))
     else:
         print(json.dumps({'error': f'unknown verb {verb}'}),
               file=sys.stderr)

@@ -109,6 +109,9 @@ def _db() -> sqlite3.Connection:
             # at submit time; jobs.cancel/logs authz resolves it
             # (advisor r4: these verbs bypassed per-workspace authz).
             "ALTER TABLE managed_jobs ADD COLUMN workspace TEXT",
+            # The task's job id ON its task cluster (strategy.launch
+            # return): live log tail polls that cluster job directly.
+            "ALTER TABLE managed_jobs ADD COLUMN cluster_job_id INTEGER",
     ):
         try:
             conn.execute(migration)
@@ -241,6 +244,27 @@ def set_cluster_name(job_id: int, cluster_name: str) -> None:
         conn.close()
 
 
+def task_log_archive_path(job_id: int, task_index: int) -> str:
+    """Controller-side copy of a task's rank-0 run.log, written just
+    before the task cluster is torn down (the reference's managed jobs
+    sync logs to the controller the same way) — log tails keep working
+    after the cluster is reaped."""
+    root = os.path.expanduser(
+        os.environ.get('XSKY_JOBS_LOG_DIR', '~/.xsky/jobs_logs'))
+    return os.path.join(root, str(job_id), f'task-{task_index}-run.log')
+
+
+def set_cluster_job_id(job_id: int,
+                       cluster_job_id: Optional[int]) -> None:
+    with _lock:
+        conn = _db()
+        conn.execute(
+            'UPDATE managed_jobs SET cluster_job_id=? WHERE job_id=?',
+            (cluster_job_id, job_id))
+        conn.commit()
+        conn.close()
+
+
 def set_controller_pid(job_id: int, pid: int) -> None:
     with _lock:
         conn = _db()
@@ -316,7 +340,7 @@ def _to_dict(row) -> Dict[str, Any]:
     (job_id, name, task_config, status, cluster_name, recovery_count,
      failure_reason, controller_pid, submitted_at, started_at,
      ended_at, schedule_state, current_task, num_tasks,
-     controller_respawns, workspace) = row
+     controller_respawns, workspace, cluster_job_id) = row
     parsed = json.loads(task_config or '{}')
     # Pipelines store a LIST of task configs; single jobs a dict.
     configs = parsed if isinstance(parsed, list) else [parsed]
@@ -330,6 +354,7 @@ def _to_dict(row) -> Dict[str, Any]:
         'num_tasks': num_tasks or len(configs),
         'status': ManagedJobStatus(status),
         'cluster_name': cluster_name,
+        'cluster_job_id': cluster_job_id,
         'recovery_count': recovery_count,
         'failure_reason': failure_reason,
         'controller_pid': controller_pid,

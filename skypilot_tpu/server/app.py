@@ -107,7 +107,7 @@ def _target_workspace(verb: str, body: Dict[str, Any]) -> 'Optional[str]':
         # payload resolver re-validates and records it on the job/
         # service row for the lifecycle verbs below.
         return body.get('workspace') or ws_context.get_active()
-    if verb in ('jobs.cancel', 'jobs.logs'):
+    if verb in ('jobs.cancel', 'jobs.logs', 'jobs.watch_logs'):
         # Managed jobs belong to the workspace recorded at submit time
         # (advisor r4: these verbs bypassed workspace isolation).
         try:
@@ -277,6 +277,29 @@ class _Handler(BaseHTTPRequestHandler):
                     cluster, job_id, offset))
             except Exception as e:  # pylint: disable=broad-except
                 self._send(404, {'error': str(e)})
+        elif parsed.path == '/api/managed_job_log':
+            # Live managed-job tail: one task-cluster poll per GET,
+            # gated on the job's OWNING workspace (same isolation as
+            # the jobs.cancel/jobs.logs verbs).
+            caller = self._caller()
+            if caller is None:
+                self._send(401, {'error': 'authentication required'})
+                return
+            try:
+                job_id = int(params.get('job_id', ''))
+                offset = max(0, int(params.get('offset', '0')))
+            except (TypeError, ValueError):
+                self._send(400, {'error': 'job_id/offset must be ints'})
+                return
+            if not self._can_read_managed_job(caller, job_id):
+                self._send(403, {'error': 'not a member of this '
+                                          "job's workspace"})
+                return
+            from skypilot_tpu.jobs import core as jobs_core
+            try:
+                self._send(200, jobs_core.watch_logs(job_id, offset))
+            except Exception as e:  # pylint: disable=broad-except
+                self._send(404, {'error': str(e)})
         else:
             self._send(404, {'error': f'no route {parsed.path}'})
 
@@ -337,6 +360,21 @@ class _Handler(BaseHTTPRequestHandler):
         record = state.get_cluster_from_name(cluster_name)
         if record is None:
             return True   # nonexistent: the handler 404s itself
+        workspace = record.get('workspace') or \
+            ws_context.DEFAULT_WORKSPACE
+        return workspaces_core.check_access(user['name'], user['role'],
+                                            workspace)
+
+    def _can_read_managed_job(self, user: Dict[str, Any],
+                              job_id: int) -> bool:
+        """Workspace-membership gate for the managed-job log route —
+        same ownership resolution as the jobs.cancel/jobs.logs verbs."""
+        from skypilot_tpu.jobs import state as jobs_state
+        from skypilot_tpu.workspaces import context as ws_context
+        from skypilot_tpu.workspaces import core as workspaces_core
+        record = jobs_state.get_job(job_id)
+        if record is None:
+            return True   # nonexistent: the handler reports NOT_FOUND
         workspace = record.get('workspace') or \
             ws_context.DEFAULT_WORKSPACE
         return workspaces_core.check_access(user['name'], user['role'],

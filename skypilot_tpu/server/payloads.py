@@ -203,6 +203,11 @@ _VERBS.update({
     'jobs.queue': _jobs_verb('queue'),
     'jobs.cancel': _jobs_verb('cancel', 'job_id'),
     'jobs.logs': _jobs_verb('tail_logs', 'job_id'),
+    'jobs.watch_logs': lambda body: (
+        __import__('skypilot_tpu.jobs.core',
+                   fromlist=['watch_logs']).watch_logs,
+        {'job_id': _require(body, 'job_id'),
+         'offset': body.get('offset', 0)}),
     'serve.up': _serve_up,
     'serve.update': _serve_update,
     'serve.status': lambda body: (

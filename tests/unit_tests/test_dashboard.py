@@ -260,3 +260,31 @@ def test_dashboard_has_chart_endpoints_and_accelerators():
     assert "tryCall('endpoints'" in html
     assert "tryCall('accelerators'" in html
     assert 'metricsChart' in html
+
+
+def test_managed_job_log_route(monkeypatch, tmp_path):
+    """GET /api/managed_job_log answers with status+epoch JSON (live
+    jobs-detail tail); bad ids are 400; the dashboard tails it."""
+    from skypilot_tpu.jobs import state as jobs_state
+    monkeypatch.setenv('XSKY_JOBS_DB', str(tmp_path / 'jobs.db'))
+    job_id = jobs_state.add_job('wlog', {'run': 'x'})
+    jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.PENDING)
+
+    from skypilot_tpu.server import app as server_app
+    server, port = server_app.run_in_thread(port=0)
+    try:
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/api/managed_job_log'
+                f'?job_id={job_id}&offset=0', timeout=10) as r:
+            payload = json.load(r)
+        assert payload['status'] == 'PENDING'
+        assert payload['data'] == ''   # no task cluster yet
+        bad = urllib.request.Request(
+            f'http://127.0.0.1:{port}/api/managed_job_log?job_id=x')
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(bad, timeout=10)
+        assert err.value.code == 400
+    finally:
+        server.shutdown()
+    html = _index_html()
+    assert '/api/managed_job_log?job_id=' in html

@@ -21,6 +21,7 @@ pytestmark = pytest.mark.slow  # heavy tier: subprocess e2e / jit compiles
 def jobs_env(fake_cluster_env, monkeypatch, tmp_path):
     monkeypatch.setenv('XSKY_JOBS_DB', str(tmp_path / 'managed_jobs.db'))
     monkeypatch.setenv('XSKY_JOBS_POLL_INTERVAL', '0.3')
+    monkeypatch.setenv('XSKY_JOBS_LOG_DIR', str(tmp_path / 'jobs_logs'))
     yield fake_cluster_env
 
 
@@ -35,6 +36,15 @@ def _wait_for(job_id, statuses, timeout=60):
     raise TimeoutError(
         f'job {job_id} stuck at '
         f'{record["status"] if record else None}')
+
+
+def _wait_reaped(env, cluster_name, timeout=20):
+    """Terminal status lands BEFORE cleanup by design (waiters must not
+    see RUNNING while teardown runs), so reap checks must poll."""
+    deadline = time.time() + timeout
+    while time.time() < deadline and env.cluster_exists(cluster_name):
+        time.sleep(0.2)
+    assert not env.cluster_exists(cluster_name)
 
 
 def _tpu_task(run, **recovery):
@@ -53,7 +63,7 @@ class TestManagedJobs:
             job_id, [jobs_state.ManagedJobStatus.SUCCEEDED])
         assert record['recovery_count'] == 0
         # Task cluster cleaned up after success.
-        assert not jobs_env.cluster_exists(record['cluster_name'])
+        _wait_reaped(jobs_env, record['cluster_name'])
 
     def test_preemption_recovery(self, jobs_env):
         """THE spot story: preempt mid-run → recover → complete."""
@@ -96,11 +106,43 @@ class TestManagedJobs:
         record = jobs_state.get_job(job_id)
         assert record['status'] == jobs_state.ManagedJobStatus.CANCELLED
         # Cluster reaped.
-        deadline = time.time() + 10
-        while time.time() < deadline and \
-                jobs_env.cluster_exists(record['cluster_name']):
-            time.sleep(0.2)
-        assert not jobs_env.cluster_exists(record['cluster_name'])
+        _wait_reaped(jobs_env, record['cluster_name'])
+
+    def test_watch_logs_streams_and_reports_epoch(self, jobs_env):
+        """Incremental managed-job tail: data arrives while RUNNING,
+        epoch pins the (cluster, cluster-job) pair, the persisted
+        cluster_job_id powers it, and terminal status ends the tail."""
+        job_id = jobs_core.launch(
+            _tpu_task('echo watch-me; sleep 3; echo done-watching'))
+        _wait_for(job_id, [jobs_state.ManagedJobStatus.RUNNING])
+        record = jobs_state.get_job(job_id)
+        assert record['cluster_job_id'] is not None
+
+        offset, seen, epoch = 0, '', None
+        deadline = time.time() + 60
+        while time.time() < deadline and 'watch-me' not in seen:
+            poll = jobs_core.watch_logs(job_id, offset=offset)
+            seen += poll['data']
+            offset = poll['offset']
+            epoch = poll.get('epoch') or epoch
+            time.sleep(0.3)
+        assert 'watch-me' in seen
+        assert epoch == (f"{record['cluster_name']}#task0"
+                         f"#{record['cluster_job_id']}")
+
+        _wait_for(job_id, [jobs_state.ManagedJobStatus.SUCCEEDED])
+        _wait_reaped(jobs_env, record['cluster_name'])
+        # The cluster is gone, but the controller archived the log
+        # before teardown: the tail continues from the SAME offset and
+        # the final chunk is never lost to the reap race.
+        final = jobs_core.watch_logs(job_id, offset=offset)
+        assert final['status'] == 'SUCCEEDED'
+        assert 'done-watching' in (seen + final['data'])
+        # One-shot logs serve the full archive after teardown too.
+        full = jobs_core.tail_logs(job_id)
+        assert 'watch-me' in full and 'done-watching' in full
+        # Unknown job: tail stops via NOT_FOUND, no exception.
+        assert jobs_core.watch_logs(99999)['status'] == 'NOT_FOUND'
 
     def test_queue_listing(self, jobs_env):
         job_id = jobs_core.launch(_tpu_task('echo q'))
@@ -127,7 +169,7 @@ class TestPipelines:
         assert record['num_tasks'] == 2
         assert marker.read_text().split() == ['one', 'two']
         # Each task's cluster is torn down.
-        assert not jobs_env.cluster_exists(record['cluster_name'])
+        _wait_reaped(jobs_env, record['cluster_name'])
         # Queue surfaces chain progress.
         row = [r for r in jobs_core.queue() if r['job_id'] == job_id][0]
         assert row['task'] == '2/2'
@@ -143,7 +185,7 @@ class TestPipelines:
             job_id, [jobs_state.ManagedJobStatus.FAILED], timeout=90)
         assert record['current_task'] == 0     # died on the first link
         assert not marker.exists()             # second task never ran
-        assert not jobs_env.cluster_exists(record['cluster_name'])
+        _wait_reaped(jobs_env, record['cluster_name'])
 
     def test_single_task_yaml_unchanged(self, jobs_env):
         """A one-task job keeps task=None in queue (no pipeline UI)."""
@@ -206,8 +248,19 @@ class TestJobsScheduler:
             record = jobs_state.get_job(jid)
             assert record['status'] == \
                 jobs_state.ManagedJobStatus.SUCCEEDED, record
-            assert record['schedule_state'] == \
-                jobs_state.ScheduleState.DONE
+        # schedule_state flips to DONE when the controller process
+        # exits — AFTER the terminal status (cleanup archives the task
+        # log and tears the cluster down first), so poll.
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            states = {jid: jobs_state.get_job(jid)['schedule_state']
+                      for jid in job_ids}
+            if all(s == jobs_state.ScheduleState.DONE
+                   for s in states.values()):
+                break
+            time.sleep(0.2)
+        assert all(s == jobs_state.ScheduleState.DONE
+                   for s in states.values()), states
 
     def test_waiting_jobs_queue_behind_cap(self, jobs_env, monkeypatch):
         """With cap 1, the second job stays WAITING until the first
