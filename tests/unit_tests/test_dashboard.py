@@ -262,6 +262,20 @@ def test_dashboard_has_chart_endpoints_and_accelerators():
     assert 'metricsChart' in html
 
 
+def test_dashboard_management_surface():
+    """Workspace/user management parity with the reference dashboard's
+    workspaces/[name], workspace/new and users pages: detail route,
+    member add/remove, config overlay editor, user create/role/delete."""
+    html = _index_html()
+    assert 'workspaceDetailView' in html
+    for verb in ('workspaces.create', 'workspaces.add_member',
+                 'workspaces.remove_member', 'workspaces.get_config',
+                 'workspaces.set_config', 'users.create',
+                 'users.set_role', 'users.delete'):
+        assert (f"call('{verb}'" in html or
+                f"tryCall('{verb}'" in html), verb
+
+
 def test_managed_job_log_route(monkeypatch, tmp_path):
     """GET /api/managed_job_log answers with status+epoch JSON (live
     jobs-detail tail); bad ids are 400; the dashboard tails it."""
