@@ -276,6 +276,13 @@ def test_dashboard_management_surface():
                 f"tryCall('{verb}'" in html), verb
 
 
+def test_infra_drilldown_surface():
+    """Per-cloud infra drill-down (reference infra/[context] twin)."""
+    html = _index_html()
+    assert 'infraDetailView' in html
+    assert "'#/infra/' + encodeURIComponent(r.cloud)" in html
+
+
 def test_managed_job_log_route(monkeypatch, tmp_path):
     """GET /api/managed_job_log answers with status+epoch JSON (live
     jobs-detail tail); bad ids are 400; the dashboard tails it."""
