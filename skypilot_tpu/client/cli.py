@@ -601,9 +601,7 @@ def jobs_launch(entrypoint, envs, secrets, name, num_nodes, accelerators,
     click.echo(f'Managed job {job_id} submitted.')
 
 
-@jobs.command(name='dashboard')
-def jobs_dashboard():
-    """Print (and try to open) the dashboard's managed-jobs view."""
+def _open_dashboard(view: str) -> None:
     from skypilot_tpu.client import sdk
     endpoint = sdk.api_server_endpoint()
     if endpoint is None:
@@ -612,13 +610,26 @@ def jobs_dashboard():
             'or set XSKY_API_SERVER.')
     if not endpoint.startswith(('http://', 'https://')):
         endpoint = f'http://{endpoint}'
-    url = f'{endpoint.rstrip("/")}/dashboard#/jobs'
+    url = f'{endpoint.rstrip("/")}/dashboard#/{view}'
     click.echo(url)
     import webbrowser
     try:
         webbrowser.open(url)
     except Exception:  # pylint: disable=broad-except
         pass
+
+
+@cli.command(name='dashboard')
+def dashboard_cmd():
+    """Print (and try to open) the web dashboard (twin of
+    `sky dashboard`)."""
+    _open_dashboard('clusters')
+
+
+@jobs.command(name='dashboard')
+def jobs_dashboard():
+    """Print (and try to open) the dashboard's managed-jobs view."""
+    _open_dashboard('jobs')
 
 
 @jobs.command(name='queue')
