@@ -10,6 +10,9 @@ from skypilot_tpu import clouds as _clouds  # registers clouds  # noqa: F401
 from skypilot_tpu import exceptions
 from skypilot_tpu.dag import Dag
 from skypilot_tpu.optimizer import Optimizer, OptimizeTarget
+
+# `sky.optimize(dag)` twin: rank/assign best resources, no provisioning.
+optimize = Optimizer.optimize
 from skypilot_tpu.resources import Resources
 from skypilot_tpu.task import Task
 
@@ -22,6 +25,7 @@ __all__ = [
     'Resources',
     'Task',
     'exceptions',
+    'optimize',
     '__version__',
 ]
 
@@ -30,7 +34,9 @@ def __getattr__(name):
     # Lazy: the SDK pulls in backends/provision/state; keep `import
     # skypilot_tpu` light for library users (models/ops only).
     if name in ('launch', 'exec', 'status', 'start', 'stop', 'down',
-                'autostop', 'queue', 'cancel', 'tail_logs'):
+                'autostop', 'queue', 'cancel', 'tail_logs',
+                'cost_report', 'endpoints', 'cluster_hosts',
+                'accelerators', 'serve_history', 'jobs_watch_logs'):
         from skypilot_tpu.client import sdk
         return getattr(sdk, name)
     raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

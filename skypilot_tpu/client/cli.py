@@ -485,26 +485,6 @@ def reap(kill):
             click.echo(f"{rec['pid']}: {rec['cmdline']}")
 
 
-@cli.command()
-def dashboard():
-    """Print (and try to open) the web dashboard URL."""
-    from skypilot_tpu.client import sdk
-    endpoint = sdk.api_server_endpoint()
-    if endpoint is None:
-        raise click.ClickException(
-            'No API server configured. Start one with `xsky api start` '
-            'or set XSKY_API_SERVER.')
-    if not endpoint.startswith(('http://', 'https://')):
-        endpoint = f'http://{endpoint}'
-    url = f'{endpoint.rstrip("/")}/dashboard'
-    click.echo(url)
-    import webbrowser
-    try:
-        webbrowser.open(url)
-    except Exception:  # pylint: disable=broad-except
-        pass
-
-
 @cli.group()
 def local():
     """Local docker cluster (dev; twin of `sky local up/down`)."""
