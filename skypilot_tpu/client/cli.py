@@ -779,10 +779,24 @@ def api():
 @click.option('--host', default='127.0.0.1')
 @click.option('--port', type=int, default=46580)
 @click.option('--foreground', is_flag=True, default=False)
-def api_start(host, port, foreground):
+@click.option('--tls-certfile', default=None,
+              help='Serve HTTPS with this certificate (with '
+                   '--tls-keyfile). Production: prefer TLS at the '
+                   'ingress (helm chart).')
+@click.option('--tls-keyfile', default=None)
+def api_start(host, port, foreground, tls_certfile, tls_keyfile):
+    if bool(tls_certfile) != bool(tls_keyfile):
+        raise click.UsageError(
+            '--tls-certfile and --tls-keyfile go together')
     from skypilot_tpu.server import app as server_app
+    tls_args = []
+    if tls_certfile:
+        tls_args = ['--tls-certfile', tls_certfile,
+                    '--tls-keyfile', tls_keyfile]
     if foreground:
-        server_app.run(host=host, port=port)
+        server_app.run(host=host, port=port,
+                       tls_certfile=tls_certfile,
+                       tls_keyfile=tls_keyfile)
     else:
         import subprocess
         import time as time_lib
@@ -794,7 +808,7 @@ def api_start(host, port, foreground):
         with open(log_path, 'ab') as log:
             proc = subprocess.Popen(
                 [sys.executable, '-m', 'skypilot_tpu.server.app',
-                 '--host', host, '--port', str(port)],
+                 '--host', host, '--port', str(port)] + tls_args,
                 stdout=log, stderr=subprocess.STDOUT,
                 stdin=subprocess.DEVNULL,
                 start_new_session=True)
@@ -816,7 +830,8 @@ def api_start(host, port, foreground):
         with open(server_app.pid_file(), encoding='utf-8') as f:
             f.readline()
             endpoint = f.readline().strip() or f'{host}:{port}'
-        click.echo(f'API server starting at http://{endpoint} '
+        scheme = 'https' if tls_certfile else 'http'
+        click.echo(f'API server starting at {scheme}://{endpoint} '
                    f'(logs: {log_path})')
 
 

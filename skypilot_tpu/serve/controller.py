@@ -48,8 +48,15 @@ class SkyServeController:
 
     def run(self) -> None:
         lb_port = serve_state.get_service(self.service_name)['lb_port']
-        actual_port = self.load_balancer.run_in_thread(port=lb_port)
-        logger.info(f'Service {self.service_name}: LB on :{actual_port}')
+        certfile = keyfile = None
+        if self.spec.tls_enabled:
+            certfile = os.path.expanduser(self.spec.tls_certfile)
+            keyfile = os.path.expanduser(self.spec.tls_keyfile)
+        actual_port = self.load_balancer.run_in_thread(
+            port=lb_port, certfile=certfile, keyfile=keyfile)
+        scheme = 'https' if certfile else 'http'
+        logger.info(f'Service {self.service_name}: LB on '
+                    f'{scheme}://:{actual_port}')
         serve_state.set_service_status(
             self.service_name, serve_state.ServiceStatus.REPLICA_INIT)
         self._apply_scale(self.spec.min_replicas)

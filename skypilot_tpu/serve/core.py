@@ -215,11 +215,15 @@ def status(service_names: Optional[List[str]] = None
     out = []
     for r in records:
         replicas = serve_state.get_replicas(r['name'])
+        # TLS-terminating LBs serve HTTPS; say so in the endpoint.
+        tls = bool((r.get('task_config') or {}).get(
+            'service', {}).get('tls'))
+        scheme = 'https://' if tls else ''
         out.append({
             'name': r['name'],
             'status': r['status'].value,
             'version': r['version'],
-            'endpoint': f"127.0.0.1:{r['lb_port']}",
+            'endpoint': f"{scheme}127.0.0.1:{r['lb_port']}",
             'workspace': r.get('workspace'),
             'qps': r.get('qps'),
             'target_replicas': r.get('target_replicas'),

@@ -89,7 +89,10 @@ class SkyServeLoadBalancer:
                 lambda: None)
 
     def make_server(self, host: str = '0.0.0.0',
-                    port: int = 0) -> ThreadingHTTPServer:
+                    port: int = 0,
+                    certfile: Optional[str] = None,
+                    keyfile: Optional[str] = None
+                    ) -> ThreadingHTTPServer:
         lb = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -154,11 +157,21 @@ class SkyServeLoadBalancer:
                 self._handle('DELETE')
 
         self._server = ThreadingHTTPServer((host, port), _Handler)
+        if certfile:
+            # TLS termination at the LB (twin of the reference's
+            # service-spec `tls:` → uvicorn ssl kwargs,
+            # sky/serve/load_balancer.py:251): replicas stay plain
+            # HTTP inside the deployment; clients get HTTPS.
+            from skypilot_tpu.utils import tls as tls_utils
+            tls_utils.wrap_server_socket(self._server, certfile, keyfile)
         return self._server
 
     def run_in_thread(self, host: str = '127.0.0.1',
-                      port: int = 0) -> int:
-        server = self.make_server(host, port)
+                      port: int = 0,
+                      certfile: Optional[str] = None,
+                      keyfile: Optional[str] = None) -> int:
+        server = self.make_server(host, port, certfile=certfile,
+                                  keyfile=keyfile)
         thread = threading.Thread(target=server.serve_forever, daemon=True)
         thread.start()
         return server.server_address[1]

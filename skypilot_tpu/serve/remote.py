@@ -94,10 +94,15 @@ def status(service_names: Optional[List[str]]) -> List[Dict[str, Any]]:
 
     def _rewrite(endpoint):
         # The controller host reports loopback endpoints; rewrite to
-        # the controller cluster's address for off-host clients.
+        # the controller cluster's address for off-host clients
+        # (preserving an https:// scheme from a TLS-terminating LB).
         if not endpoint:
             return endpoint
-        return f"{host}:{endpoint.rsplit(':', 1)[-1]}"
+        scheme = ''
+        if '://' in endpoint:
+            scheme, endpoint = endpoint.split('://', 1)
+            scheme += '://'
+        return f"{scheme}{host}:{endpoint.rsplit(':', 1)[-1]}"
 
     for record in reply:
         record['endpoint'] = _rewrite(record.get('endpoint'))

@@ -18,7 +18,12 @@ class SkyServiceSpec:
                  use_ondemand_fallback: bool = False,
                  base_ondemand_fallback_replicas: int = 0,
                  dynamic_ondemand_fallback: bool = False,
-                 load_balancing_policy: str = 'round_robin') -> None:
+                 load_balancing_policy: str = 'round_robin',
+                 tls_certfile: Optional[str] = None,
+                 tls_keyfile: Optional[str] = None) -> None:
+        if bool(tls_certfile) != bool(tls_keyfile):
+            raise ValueError(
+                'tls requires BOTH certfile and keyfile')
         if max_replicas is not None and max_replicas < min_replicas:
             raise ValueError('max_replicas must be >= min_replicas')
         if target_qps_per_replica is not None and max_replicas is None:
@@ -51,6 +56,14 @@ class SkyServiceSpec:
             base_ondemand_fallback_replicas
         self.dynamic_ondemand_fallback = dynamic_ondemand_fallback
         self.load_balancing_policy = load_balancing_policy
+        # TLS termination at the load balancer (twin of the reference's
+        # service-spec `tls:` section → HTTPS endpoint).
+        self.tls_certfile = tls_certfile
+        self.tls_keyfile = tls_keyfile
+
+    @property
+    def tls_enabled(self) -> bool:
+        return self.tls_certfile is not None
 
     @property
     def autoscaling_enabled(self) -> bool:
@@ -72,6 +85,7 @@ class SkyServiceSpec:
             policy = {'min_replicas': replicas, 'max_replicas': None}
         port = config.pop('port', None)
         lb_policy = config.pop('load_balancing_policy', 'round_robin')
+        tls = config.pop('tls', None) or {}
         unknown = set(config)
         if unknown:
             raise ValueError(f'Unknown service fields: {sorted(unknown)}')
@@ -95,6 +109,8 @@ class SkyServiceSpec:
             dynamic_ondemand_fallback=bool(
                 policy.get('dynamic_ondemand_fallback', False)),
             load_balancing_policy=lb_policy,
+            tls_certfile=tls.get('certfile'),
+            tls_keyfile=tls.get('keyfile'),
         )
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -126,4 +142,7 @@ class SkyServiceSpec:
             config['port'] = self.replica_port
         if self.load_balancing_policy != 'round_robin':
             config['load_balancing_policy'] = self.load_balancing_policy
+        if self.tls_enabled:
+            config['tls'] = {'certfile': self.tls_certfile,
+                             'keyfile': self.tls_keyfile}
         return config

@@ -489,8 +489,18 @@ class _ApiServer(ThreadingHTTPServer):
 
 
 def make_server(host: str = '127.0.0.1',
-                port: int = 46580) -> ThreadingHTTPServer:
-    return _ApiServer((host, port), _Handler)
+                port: int = 46580,
+                tls_certfile: Optional[str] = None,
+                tls_keyfile: Optional[str] = None
+                ) -> ThreadingHTTPServer:
+    server = _ApiServer((host, port), _Handler)
+    if tls_certfile:
+        # TLS at the server socket (deployments without an ingress to
+        # terminate HTTPS; the helm chart's ingress path stays the
+        # recommended production setup).
+        from skypilot_tpu.utils import tls as tls_utils
+        tls_utils.wrap_server_socket(server, tls_certfile, tls_keyfile)
+    return server
 
 
 def server_dir() -> str:
@@ -508,13 +518,16 @@ def log_file() -> str:
     return os.path.join(server_dir(), 'api.log')
 
 
-def run(host: str = '127.0.0.1', port: int = 46580) -> None:
+def run(host: str = '127.0.0.1', port: int = 46580,
+        tls_certfile: Optional[str] = None,
+        tls_keyfile: Optional[str] = None) -> None:
     import os
     import signal
     from skypilot_tpu.users import core as users_core
     if users_core.auth_required():
         users_core.bootstrap_admin_if_empty()
-    server = make_server(host, port)
+    server = make_server(host, port, tls_certfile=tls_certfile,
+                         tls_keyfile=tls_keyfile)
     bound_port = server.server_address[1]   # real port (0 = ephemeral)
     os.makedirs(server_dir(), exist_ok=True)
     with open(pid_file(), 'w', encoding='utf-8') as f:
@@ -540,8 +553,9 @@ def run(host: str = '127.0.0.1', port: int = 46580) -> None:
             logger.info(f'Recovered serve controllers: {recovered}')
     except Exception as e:  # pylint: disable=broad-except
         logger.warning(f'Controller recovery at startup failed: {e}')
+    scheme = 'https' if tls_certfile else 'http'
     logger.info(
-        f'xsky API server listening on http://{host}:{bound_port}')
+        f'xsky API server listening on {scheme}://{host}:{bound_port}')
     try:
         server.serve_forever()
     finally:
@@ -564,5 +578,8 @@ if __name__ == '__main__':
     parser = argparse.ArgumentParser()
     parser.add_argument('--host', default='127.0.0.1')
     parser.add_argument('--port', type=int, default=46580)
+    parser.add_argument('--tls-certfile', default=None)
+    parser.add_argument('--tls-keyfile', default=None)
     args = parser.parse_args()
-    run(args.host, args.port)
+    run(args.host, args.port, tls_certfile=args.tls_certfile,
+        tls_keyfile=args.tls_keyfile)

@@ -159,6 +159,15 @@ _SERVICE_SCHEMA: Dict[str, Any] = {
         # serve package; test_serve pins the two lists together).
         'load_balancing_policy': {
             'enum': ['round_robin', 'least_load']},
+        # TLS termination at the load balancer (service_spec.py tls).
+        'tls': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'certfile': _STR,
+                'keyfile': _STR,
+            },
+        },
     },
 }
 

@@ -1,0 +1,26 @@
+"""TLS socket wrapping shared by the API server and the serve LB."""
+from __future__ import annotations
+
+import os
+import ssl
+from typing import Optional
+
+
+def wrap_server_socket(server, certfile: str,
+                       keyfile: Optional[str]) -> None:
+    """Wrap a ThreadingHTTPServer's listening socket for TLS.
+
+    ``do_handshake_on_connect=False`` is load-bearing: ``accept()``
+    runs in the server's single ``serve_forever`` thread (only request
+    HANDLING is dispatched to workers), so a handshake there would let
+    one stalled client — open TCP, never send a ClientHello — freeze
+    every other connection. Deferred, the handshake happens on first
+    read inside the per-connection handler thread, where a stalled
+    client costs one worker like any plain-HTTP slowloris.
+    """
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(
+        certfile=os.path.expanduser(certfile),
+        keyfile=os.path.expanduser(keyfile) if keyfile else None)
+    server.socket = ctx.wrap_socket(server.socket, server_side=True,
+                                    do_handshake_on_connect=False)
