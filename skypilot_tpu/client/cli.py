@@ -643,11 +643,19 @@ def jobs_logs(job_id, follow):
     if not follow:
         click.echo(sdk.jobs_logs(job_id), nl=False)
         return
+    _follow_logs(lambda off: sdk.jobs_watch_logs(job_id, offset=off),
+                 what='job')
+
+
+def _follow_logs(poll_fn, what: str) -> None:
+    """Generic incremental-tail loop over a {status, offset, data,
+    epoch, done} poll function (jobs_watch_logs / serve_watch_logs):
+    error backoff, epoch-reset on recovery swaps, terminal drain."""
     import time as time_lib
     offset, epoch, errors = 0, None, 0
     while True:
         try:
-            poll = sdk.jobs_watch_logs(job_id, offset=offset)
+            poll = poll_fn(offset)
         except Exception as e:  # pylint: disable=broad-except
             # Transient API-server / remote-exec blips must not kill a
             # follow that exists to survive recovery windows. Back off;
@@ -663,7 +671,7 @@ def jobs_logs(job_id, follow):
         if epoch is not None and poll.get('epoch') not in (None, epoch):
             # Recovery swapped the task cluster: its fresh log starts
             # over at 0.
-            click.echo('\n--- job recovered; log restarted ---')
+            click.echo(f'\n--- {what} recovered; log restarted ---')
             offset, epoch = 0, poll.get('epoch')
             continue
         if poll.get('epoch') is not None:
@@ -672,11 +680,11 @@ def jobs_logs(job_id, follow):
             click.echo(poll['data'], nl=False)
         offset = poll.get('offset', offset)
         if poll.get('done'):
-            # Drain: polls cap at 256 KB, so a finished job may still
-            # have backlog — keep reading until a dry poll.
+            # Drain: polls cap at 256 KB, so a finished source may
+            # still have backlog — keep reading until a dry poll.
             if poll.get('data'):
                 continue
-            click.echo(f"\n(job {poll['status']})")
+            click.echo(f"\n({what} {poll['status']})")
             return
         time_lib.sleep(2)
 
@@ -724,15 +732,31 @@ def serve_status(service_names):
 @click.option('--controller', is_flag=True, default=False,
               help="The service controller's own log (diagnostics for "
                    'a crashed control loop).')
-def serve_logs(service_name, replica_id, job_id, controller):
+@click.option('--follow', '-f', is_flag=True, default=False,
+              help="Stream the replica's task log until it reaches a "
+                   'terminal state.')
+def serve_logs(service_name, replica_id, job_id, controller, follow):
     """Tail one replica's logs (twin of `sky serve logs`)."""
     from skypilot_tpu.client import sdk
     if controller:
+        if follow:
+            raise click.UsageError(
+                '--controller logs have no follow mode.')
         click.echo(sdk.serve_controller_logs(service_name), nl=False)
         return
     if replica_id is None:
         raise click.UsageError('REPLICA_ID is required unless '
                                '--controller is given.')
+    if follow:
+        if job_id is not None:
+            raise click.UsageError(
+                '--follow tails the replica task log; --job-id is '
+                'only for one-shot reads.')
+        _follow_logs(
+            lambda off: sdk.serve_watch_logs(service_name, replica_id,
+                                             offset=off),
+            what='replica')
+        return
     click.echo(sdk.serve_logs(service_name, replica_id, job_id=job_id),
                nl=False)
 

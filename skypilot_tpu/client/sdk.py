@@ -361,6 +361,20 @@ def accelerators(name_filter: Optional[str] = None,
                                       gpus_only=gpus_only)
 
 
+def serve_watch_logs(service_name: str, replica_id: int,
+                     offset: int = 0) -> Dict[str, Any]:
+    """One incremental replica-log poll → {status, offset, data,
+    epoch, done} (same contract as jobs_watch_logs)."""
+    remote = _remote()
+    if remote is not None:
+        return remote._call('serve.watch_logs', {
+            'service_name': service_name, 'replica_id': replica_id,
+            'offset': offset})
+    from skypilot_tpu.serve import core as serve_core
+    return serve_core.watch_replica_logs(service_name, replica_id,
+                                         offset=offset)
+
+
 def serve_down(service_name: str) -> None:
     remote = _remote()
     if remote is not None:

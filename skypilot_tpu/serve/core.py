@@ -276,6 +276,50 @@ def metrics_history(service_name: str,
     return serve_state.get_metrics_history(service_name, limit=limit)
 
 
+def watch_replica_logs(service_name: str, replica_id: int,
+                       offset: int = 0) -> Dict[str, Any]:
+    """One incremental poll of a replica's task log → {status, offset,
+    data, epoch, done} (same contract as jobs.watch_logs; powers the
+    dashboard replica tail + `serve logs --follow`)."""
+    if _remote_mode():
+        from skypilot_tpu.serve import remote as serve_remote
+        return serve_remote.watch_replica_logs(service_name,
+                                               replica_id, offset)
+    if serve_state.get_service(service_name) is None:
+        return {'status': 'NOT_FOUND', 'offset': offset, 'data': '',
+                'done': True}
+    match = [r for r in serve_state.get_replicas(service_name)
+             if r['replica_id'] == replica_id]
+    if not match:
+        return {'status': 'NOT_FOUND', 'offset': offset, 'data': '',
+                'done': True}
+    replica = match[0]
+    status = replica['status'].value
+    done = replica['status'].is_terminal()
+    cluster_name = replica['cluster_name']
+    from skypilot_tpu import core as core_lib
+    try:
+        # The launch-time job id on the replica record makes each poll
+        # ONE remote exec; pre-migration rows fall back to a queue
+        # lookup once per poll.
+        job_id = replica.get('job_id')
+        if job_id is None:
+            jobs = core_lib.queue(cluster_name)
+            if not jobs:
+                return {'status': status, 'offset': offset, 'data': '',
+                        'done': done}
+            job_id = max(j['job_id'] for j in jobs)
+        epoch = f'{cluster_name}#{job_id}'
+        poll = core_lib.watch_job_log(cluster_name, job_id, offset)
+        return {'status': status, 'offset': poll.get('offset', offset),
+                'data': poll.get('log') or poll.get('data') or '',
+                'epoch': epoch, 'done': done}
+    except Exception:  # pylint: disable=broad-except
+        # Cluster mid-provision or torn down: status-only poll.
+        return {'status': status, 'offset': offset, 'data': '',
+                'done': done}
+
+
 def tail_logs(service_name: str, replica_id: int,
               job_id: Optional[int] = None) -> str:
     """Log tail of one replica's cluster (twin of `sky serve logs`)."""

@@ -128,3 +128,9 @@ def controller_logs(service_name: str) -> str:
 
 def metrics_history(service_name: str, limit: int) -> List[Dict[str, Any]]:
     return _relay.call('history', service_name, str(int(limit)))
+
+
+def watch_replica_logs(service_name: str, replica_id: int,
+                       offset: int) -> Dict[str, Any]:
+    return _relay.call('watch-logs', service_name, str(int(replica_id)),
+                       str(int(offset)))

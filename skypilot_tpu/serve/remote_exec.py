@@ -63,6 +63,9 @@ def main(argv) -> int:
         elif verb == 'history':
             _print(serve_core.metrics_history(args[0],
                                               limit=int(args[1])))
+        elif verb == 'watch-logs':
+            _print(serve_core.watch_replica_logs(
+                args[0], int(args[1]), offset=int(args[2])))
         else:
             _print({'error': f'unknown verb {verb}'})
             return 2

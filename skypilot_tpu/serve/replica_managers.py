@@ -74,9 +74,7 @@ class ReplicaManager:
         self.launch_failures = 0
 
     def _is_active(self, r: Dict[str, Any]) -> bool:
-        return r['status'] not in (serve_state.ReplicaStatus.FAILED,
-                                   serve_state.ReplicaStatus.PREEMPTED,
-                                   serve_state.ReplicaStatus.SHUTTING_DOWN)
+        return not r['status'].is_terminal()
 
     def active_count(self, version: Optional[int] = None,
                      spot: Optional[bool] = None) -> int:
@@ -219,9 +217,9 @@ class ReplicaManager:
                         zone=z,
                         accelerator_args={'provisioning_model': 'spot'})
                     for z in sorted(self.spot_placer.preemptive_zones)]
-            _, handle = execution.launch(task, cluster_name=cluster_name,
-                                         detach_run=True,
-                                         blocked_resources=blocked)
+            job_id, handle = execution.launch(
+                task, cluster_name=cluster_name, detach_run=True,
+                blocked_resources=blocked)
             local = handle.is_local_provider
             host = '127.0.0.1' if local else handle.head_ip
             zone = handle.launched_resources.zone
@@ -246,7 +244,8 @@ class ReplicaManager:
             serve_state.upsert_replica(
                 self.service_name, replica_id, cluster_name,
                 serve_state.ReplicaStatus.STARTING,
-                endpoint=f'{host}:{port}', version=version, spot=spot)
+                endpoint=f'{host}:{port}', version=version, spot=spot,
+                job_id=job_id)
         except Exception as e:  # pylint: disable=broad-except
             logger.warning(f'Replica {replica_id} launch failed: {e}')
             self.launch_failures += 1

@@ -31,6 +31,13 @@ class ReplicaStatus(enum.Enum):
     PREEMPTED = 'PREEMPTED'
     SHUTTING_DOWN = 'SHUTTING_DOWN'
 
+    def is_terminal(self) -> bool:
+        """No more log output coming / cluster going away. The single
+        source of truth for replica-tail stop + active-count logic —
+        hand-copied lists go stale the day this enum grows."""
+        return self in (ReplicaStatus.FAILED, ReplicaStatus.PREEMPTED,
+                        ReplicaStatus.SHUTTING_DOWN)
+
 
 def _db() -> sqlite3.Connection:
     from skypilot_tpu.utils import db_utils
@@ -77,7 +84,12 @@ def _db() -> sqlite3.Connection:
                           # Live metrics, written each controller tick
                           # (dashboard service detail: QPS + target).
                           ('services', 'qps REAL'),
-                          ('services', 'target_replicas INTEGER')):
+                          ('services', 'target_replicas INTEGER'),
+                          # The task's job id on the replica cluster
+                          # (execution.launch return): live log tails
+                          # poll it directly — one remote exec instead
+                          # of a queue lookup per poll.
+                          ('replicas', 'job_id INTEGER')):
         try:
             conn.execute(f'ALTER TABLE {table} ADD COLUMN {column}')
         except Exception:  # pylint: disable=broad-except
@@ -266,18 +278,20 @@ def upsert_replica(service_name: str, replica_id: int, cluster_name: str,
                    status: ReplicaStatus,
                    endpoint: Optional[str] = None,
                    version: int = 1,
-                   spot: bool = True) -> None:
+                   spot: bool = True,
+                   job_id: Optional[int] = None) -> None:
     with _lock:
         conn = _db()
         conn.execute(
             'INSERT INTO replicas (service_name, replica_id, cluster_name,'
-            ' status, endpoint, launched_at, version, spot) '
-            'VALUES (?, ?, ?, ?, ?, ?, ?, ?) '
+            ' status, endpoint, launched_at, version, spot, job_id) '
+            'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?) '
             'ON CONFLICT(service_name, replica_id) DO UPDATE SET '
             'status=excluded.status, '
-            'endpoint=COALESCE(excluded.endpoint, replicas.endpoint)',
+            'endpoint=COALESCE(excluded.endpoint, replicas.endpoint), '
+            'job_id=COALESCE(excluded.job_id, replicas.job_id)',
             (service_name, replica_id, cluster_name, status.value,
-             endpoint, time.time(), version, int(spot)))
+             endpoint, time.time(), version, int(spot), job_id))
         conn.commit()
         conn.close()
 
@@ -308,4 +322,5 @@ def get_replicas(service_name: str) -> List[Dict[str, Any]]:
         'launched_at': r[5],
         'version': r[6] or 1,
         'spot': bool(r[7]) if len(r) > 7 and r[7] is not None else True,
+        'job_id': r[8] if len(r) > 8 else None,
     } for r in rows]

@@ -120,7 +120,8 @@ def _target_workspace(verb: str, body: Dict[str, Any]) -> 'Optional[str]':
             return None   # nonexistent job: the verb no-ops/404s
         return record.get('workspace') or ws_context.DEFAULT_WORKSPACE
     if verb in ('serve.down', 'serve.update', 'serve.logs',
-                'serve.controller_logs', 'serve.history'):
+                'serve.controller_logs', 'serve.history',
+                'serve.watch_logs'):
         service = body.get('service_name')
         if not service:
             return None
@@ -277,6 +278,32 @@ class _Handler(BaseHTTPRequestHandler):
                     cluster, job_id, offset))
             except Exception as e:  # pylint: disable=broad-except
                 self._send(404, {'error': str(e)})
+        elif parsed.path == '/api/serve_replica_log':
+            # Live replica tail: one task-cluster poll per GET, gated
+            # on the service's owning workspace (same isolation as the
+            # serve.* verbs).
+            caller = self._caller()
+            if caller is None:
+                self._send(401, {'error': 'authentication required'})
+                return
+            service = params.get('service_name', '')
+            try:
+                replica_id = int(params.get('replica_id', ''))
+                offset = max(0, int(params.get('offset', '0')))
+            except (TypeError, ValueError):
+                self._send(400, {'error': 'replica_id/offset must be '
+                                          'ints'})
+                return
+            if not self._can_read_service(caller, service):
+                self._send(403, {'error': 'not a member of this '
+                                          "service's workspace"})
+                return
+            from skypilot_tpu.serve import core as serve_core
+            try:
+                self._send(200, serve_core.watch_replica_logs(
+                    service, replica_id, offset))
+            except Exception as e:  # pylint: disable=broad-except
+                self._send(404, {'error': str(e)})
         elif parsed.path == '/api/managed_job_log':
             # Live managed-job tail: one task-cluster poll per GET,
             # gated on the job's OWNING workspace (same isolation as
@@ -360,6 +387,21 @@ class _Handler(BaseHTTPRequestHandler):
         record = state.get_cluster_from_name(cluster_name)
         if record is None:
             return True   # nonexistent: the handler 404s itself
+        workspace = record.get('workspace') or \
+            ws_context.DEFAULT_WORKSPACE
+        return workspaces_core.check_access(user['name'], user['role'],
+                                            workspace)
+
+    def _can_read_service(self, user: Dict[str, Any],
+                          service_name: str) -> bool:
+        """Workspace-membership gate for the replica log route — same
+        ownership resolution as the serve.* verbs."""
+        from skypilot_tpu.serve import state as serve_state
+        from skypilot_tpu.workspaces import context as ws_context
+        from skypilot_tpu.workspaces import core as workspaces_core
+        record = serve_state.get_service(service_name)
+        if record is None:
+            return True   # nonexistent: the handler reports NOT_FOUND
         workspace = record.get('workspace') or \
             ws_context.DEFAULT_WORKSPACE
         return workspaces_core.check_access(user['name'], user['role'],

@@ -220,6 +220,9 @@ _VERBS.update({
                                          'service_name'),
     'serve.history': _serve_verb('metrics_history', 'service_name',
                                  limit=720),
+    'serve.watch_logs': _serve_verb('watch_replica_logs',
+                                    'service_name', 'replica_id',
+                                    offset=0),
     # User management (admin-only via users.rbac).
     'users.list': _module_verb(_USERS, 'list_users'),
     'users.create': _module_verb(_USERS, 'create_user', 'name', 'password',

@@ -276,6 +276,39 @@ def test_dashboard_management_surface():
                 f"tryCall('{verb}'" in html), verb
 
 
+def test_replica_log_route_and_surface(monkeypatch, tmp_path):
+    """GET /api/serve_replica_log answers status+done JSON (replica
+    live tail); unknown services report NOT_FOUND/done; the dashboard
+    drills replica rows into the tail view."""
+    from skypilot_tpu.serve import state as serve_state
+    monkeypatch.setenv('XSKY_SERVE_DB', str(tmp_path / 's.db'))
+    serve_state.add_service('rl-svc', {'run': 'x'}, 9999)
+    serve_state.upsert_replica('rl-svc', 1, 'no-such-cluster',
+                               serve_state.ReplicaStatus.PROVISIONING)
+
+    from skypilot_tpu.server import app as server_app
+    server, port = server_app.run_in_thread(port=0)
+    try:
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/api/serve_replica_log'
+                f'?service_name=rl-svc&replica_id=1&offset=0',
+                timeout=10) as r:
+            payload = json.load(r)
+        assert payload['status'] == 'PROVISIONING'
+        assert payload['done'] is False
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/api/serve_replica_log'
+                f'?service_name=ghost&replica_id=1&offset=0',
+                timeout=10) as r:
+            ghost = json.load(r)
+        assert ghost['status'] == 'NOT_FOUND' and ghost['done'] is True
+    finally:
+        server.shutdown()
+    html = _index_html()
+    assert '/api/serve_replica_log?service_name=' in html
+    assert 'replicaLogView' in html
+
+
 def test_infra_drilldown_surface():
     """Per-cloud infra drill-down (reference infra/[context] twin)."""
     html = _index_html()
