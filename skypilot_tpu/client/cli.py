@@ -708,13 +708,20 @@ def serve_up(entrypoint, service_name, yes):
 @serve.command(name='update')
 @click.argument('service_name')
 @click.argument('entrypoint')
+@click.option('--mode', type=click.Choice(['rolling', 'blue_green']),
+              default='rolling',
+              help='rolling: mixed old+new traffic while the fleet '
+                   'turns over. blue_green: old fleet keeps all '
+                   'traffic until the new fleet is READY, then one '
+                   'cutover (no mixed-version responses).')
 @click.option('--yes', '-y', is_flag=True, default=False)
-def serve_update(service_name, entrypoint, yes):
-    """Rolling update: new replicas launch, old ones drain when ready."""
+def serve_update(service_name, entrypoint, mode, yes):
+    """Update a live service (twin of `sky serve update --mode`)."""
     from skypilot_tpu.client import sdk
     t = task_lib.Task.from_yaml(entrypoint)
-    version = sdk.serve_update(t, service_name)
-    click.echo(f'Service {service_name} updating to v{version}.')
+    version = sdk.serve_update(t, service_name, mode=mode)
+    click.echo(f'Service {service_name} updating to v{version} '
+               f'({mode}).')
 
 
 @serve.command(name='status')

@@ -237,10 +237,11 @@ class RemoteClient:
                              'service_name': service_name})
         return result['service_name']
 
-    def serve_update(self, task, service_name):
+    def serve_update(self, task, service_name, mode='rolling'):
         result = self._call('serve.update',
                             {'task': task.to_yaml_config(),
-                             'service_name': service_name})
+                             'service_name': service_name,
+                             'mode': mode})
         return result['version']
 
     def serve_status(self, service_names=None):

@@ -299,13 +299,15 @@ def serve_up(task: task_lib.Task,
     return serve_core.up(task, service_name)
 
 
-def serve_update(task: task_lib.Task, service_name: str) -> int:
-    """Rolling update of a live service; returns the new version."""
+def serve_update(task: task_lib.Task, service_name: str,
+                 mode: str = 'rolling') -> int:
+    """Update a live service (rolling | blue_green); returns the new
+    version."""
     remote = _remote()
     if remote is not None:
-        return remote.serve_update(task, service_name)
+        return remote.serve_update(task, service_name, mode=mode)
     from skypilot_tpu.serve import core as serve_core
-    return serve_core.update(task, service_name)
+    return serve_core.update(task, service_name, mode=mode)
 
 
 def serve_status(service_names: Optional[List[str]] = None

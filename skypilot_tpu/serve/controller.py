@@ -32,6 +32,9 @@ class SkyServeController:
         assert record is not None, service_name
         self.service_name = service_name
         self.version = record['version']
+        # HA: a respawned controller resumes a mid-flight blue_green
+        # cutover from the persisted mode, not a default.
+        self.update_mode = record.get('update_mode') or 'rolling'
         task_config = record['task_config']
         self.spec = spec_lib.SkyServiceSpec.from_yaml_config(
             task_config.get('service', {}))
@@ -79,6 +82,7 @@ class SkyServeController:
         if record is None or record['version'] == self.version:
             return
         self.version = record['version']
+        self.update_mode = record.get('update_mode') or 'rolling'
         task_config = record['task_config']
         self.spec = spec_lib.SkyServiceSpec.from_yaml_config(
             task_config.get('service', {}))
@@ -139,8 +143,15 @@ class SkyServeController:
             self.service_name, qps_fn() if qps_fn else None,
             decision.target_num_replicas, ready_replicas=ready)
         self._apply_scale(decision.target_num_replicas)
+        # Cut the LB over BEFORE draining old versions: in blue_green
+        # the pre-drain LB holds only OLD endpoints, and
+        # reconcile_versions tears those clusters down (minutes on real
+        # clouds) — draining first would serve terminated endpoints for
+        # the whole window.
+        self.load_balancer.set_ready_replicas(
+            manager.serving_endpoints(self.update_mode,
+                                      decision.target_num_replicas))
         manager.reconcile_versions(decision.target_num_replicas)
-        self.load_balancer.set_ready_replicas(manager.ready_endpoints())
         if ready > 0:
             serve_state.set_service_status(
                 self.service_name, serve_state.ServiceStatus.READY)

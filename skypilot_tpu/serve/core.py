@@ -149,12 +149,17 @@ def up(task: task_lib.Task, service_name: Optional[str] = None,
 
 
 def update(task: task_lib.Task, service_name: str,
-           wait_done: bool = False, timeout_s: float = 120.0) -> int:
-    """Rolling update to a new task version (twin of `sky serve update`).
+           wait_done: bool = False, timeout_s: float = 120.0,
+           mode: str = 'rolling') -> int:
+    """Update to a new task version (twin of `sky serve update
+    --mode`). Returns the new version.
 
-    New-version replicas launch alongside the old fleet; old replicas
-    keep serving and drain only after >= target new replicas are READY
-    — traffic never drops. Returns the new version.
+    mode='rolling': new-version replicas launch alongside the old
+    fleet and serve as they come READY; old replicas drain only after
+    the new fleet passes readiness — traffic never drops.
+    mode='blue_green': the old fleet keeps ALL traffic until the full
+    new fleet is READY, then the LB cuts over in one step and the old
+    fleet drains — no mixed-version responses.
 
     Async by default (like the reference): the version bump is durable
     once this returns and the controller rolls in the background; pass
@@ -163,11 +168,15 @@ def update(task: task_lib.Task, service_name: str,
     """
     if task.service is None:
         raise ValueError("Task has no 'service:' section.")
+    if mode not in ('rolling', 'blue_green'):
+        raise ValueError(
+            f"update mode must be 'rolling' or 'blue_green', "
+            f'got {mode!r}')
     _check_fallback_knobs(task)
     if _remote_mode():
         from skypilot_tpu.serve import remote as serve_remote
         return serve_remote.update(task, service_name, wait_done,
-                                   timeout_s)
+                                   timeout_s, mode)
     record = serve_state.get_service(service_name)
     if record is None:
         raise ValueError(f'Service {service_name!r} not found.')
@@ -184,8 +193,8 @@ def update(task: task_lib.Task, service_name: str,
             f'Service {service_name!r} controller (pid {pid}) is dead; '
             'no process would apply the update. `serve down` and '
             '`serve up` the new version instead.')
-    new_version = serve_state.bump_service_version(service_name,
-                                                   task.to_yaml_config())
+    new_version = serve_state.bump_service_version(
+        service_name, task.to_yaml_config(), mode=mode)
     if wait_done:
         deadline = time.time() + timeout_s
         while time.time() < deadline:

@@ -79,10 +79,15 @@ def up(task: task_lib.Task, service_name: Optional[str],
 
 
 def update(task: task_lib.Task, service_name: str, wait_done: bool,
-           timeout_s: float) -> int:
+           timeout_s: float, mode: str = 'rolling') -> int:
+    # `mode` is appended only when non-default, so a newer client can
+    # still drive a controller host provisioned before the arg existed
+    # (its exec does a fixed 4-way unpack); remote_exec defaults the
+    # missing arg for the same reason in the other direction.
+    extra = [mode] if mode != 'rolling' else []
     reply = _payload_call('update', task, service_name,
                           '--wait' if wait_done else '--nowait',
-                          str(timeout_s), provision=False)
+                          str(timeout_s), *extra, provision=False)
     return int(reply['version'])
 
 

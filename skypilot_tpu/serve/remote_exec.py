@@ -40,12 +40,18 @@ def main(argv) -> int:
                                     timeout_s=timeout_s)
             _print({'service_name': service})
         elif verb == 'update':
-            service, wait_flag, timeout_s, path = args
+            # The mode arg is newer than some controller hosts; an
+            # older CLIENT omits it entirely (and a newer client omits
+            # the default), so default it here.
+            service, wait_flag, timeout_s = args[0], args[1], args[2]
+            mode = args[3] if len(args) > 4 else 'rolling'
+            path = args[-1]
             with open(path, encoding='utf-8') as f:
                 task = task_lib.Task.from_yaml_config(json.load(f))
             version = serve_core.update(task, service,
                                         wait_done=wait_flag == '--wait',
-                                        timeout_s=float(timeout_s))
+                                        timeout_s=float(timeout_s),
+                                        mode=mode)
             _print({'version': version})
         elif verb == 'status':
             names = json.loads(args[0]) if args else []

@@ -323,6 +323,27 @@ class ReplicaManager:
                 if r['status'] == serve_state.ReplicaStatus.READY and
                 r['endpoint']]
 
+    def serving_endpoints(self, mode: str = 'rolling',
+                          target: int = 1) -> List[str]:
+        """Endpoints the LB should route to under the update mode.
+
+        rolling: every READY replica (old + new mix while rolling).
+        blue_green (reference autoscalers.py:323): traffic stays on
+        the OLD fleet until >= target new-version replicas are READY,
+        then cuts over to the new fleet in one step (the old fleet is
+        drained by reconcile_versions right after).
+        """
+        if mode != 'blue_green':
+            return self.ready_endpoints()
+        ready = [r for r in self.replicas()
+                 if r['status'] == serve_state.ReplicaStatus.READY and
+                 r['endpoint']]
+        old_ready = [r for r in ready if r['version'] < self.version]
+        new_ready = [r for r in ready if r['version'] == self.version]
+        if old_ready and len(new_ready) < max(1, target):
+            return [r['endpoint'] for r in old_ready]
+        return [r['endpoint'] for r in new_ready]
+
     def recover_preempted(self) -> None:
         """Replace PREEMPTED replicas (spot recovery for serving)."""
         with self._lock:

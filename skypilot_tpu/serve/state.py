@@ -89,7 +89,11 @@ def _db() -> sqlite3.Connection:
                           # (execution.launch return): live log tails
                           # poll it directly — one remote exec instead
                           # of a queue lookup per poll.
-                          ('replicas', 'job_id INTEGER')):
+                          ('replicas', 'job_id INTEGER'),
+                          # How the current update shifts traffic:
+                          # 'rolling' (mixed old+new) or 'blue_green'
+                          # (old-only until the new fleet is ready).
+                          ('services', "update_mode TEXT")):
         try:
             conn.execute(f'ALTER TABLE {table} ADD COLUMN {column}')
         except Exception:  # pylint: disable=broad-except
@@ -142,15 +146,17 @@ def add_service(name: str, task_config: Dict[str, Any],
         conn.close()
 
 
-def bump_service_version(name: str, task_config: Dict[str, Any]) -> int:
+def bump_service_version(name: str, task_config: Dict[str, Any],
+                         mode: str = 'rolling') -> int:
     """Install a new task config as the service's next version
     (twin of sky/serve update: ReplicaInfo.version,
     sky/serve/replica_managers.py:388). Returns the new version."""
     with _lock:
         conn = _db()
         conn.execute(
-            'UPDATE services SET task_config=?, version=version+1 '
-            'WHERE name=?', (json.dumps(task_config), name))
+            'UPDATE services SET task_config=?, version=version+1, '
+            'update_mode=? WHERE name=?',
+            (json.dumps(task_config), mode, name))
         conn.commit()
         row = conn.execute('SELECT version FROM services WHERE name=?',
                            (name,)).fetchone()
@@ -256,7 +262,7 @@ def remove_service(name: str) -> None:
 
 def _service_dict(row) -> Dict[str, Any]:
     (name, task_config, status, pid, lb_port, created_at, version,
-     workspace, qps, target_replicas) = row
+     workspace, qps, target_replicas, update_mode) = row
     return {
         'name': name,
         'task_config': json.loads(task_config or '{}'),
@@ -268,6 +274,7 @@ def _service_dict(row) -> Dict[str, Any]:
         'workspace': workspace,
         'qps': qps,
         'target_replicas': target_replicas,
+        'update_mode': update_mode or 'rolling',
     }
 
 

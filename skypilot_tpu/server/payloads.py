@@ -171,7 +171,8 @@ def _serve_update(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
     def run(**kwargs):
         return {'version': serve_core.update(task, **kwargs)}
 
-    return run, {'service_name': _require(body, 'service_name')}
+    return run, {'service_name': _require(body, 'service_name'),
+                 'mode': body.get('mode', 'rolling')}
 
 
 def _serve_verb(fn_name: str, *fields, **defaults):

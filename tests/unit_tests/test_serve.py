@@ -524,6 +524,61 @@ class TestRollingUpdate:
         assert all(r['version'] == 2 for r in replicas), replicas
         serve_core.down('roll1')
 
+    def test_blue_green_update_single_cutover(self, serve_env):
+        """--mode blue_green: the old fleet keeps ALL traffic until
+        the new fleet is READY, then one cutover — once a v2 response
+        is seen, no v1 response ever follows, and traffic never
+        drops."""
+        import json
+        import threading
+        import urllib.error
+
+        task = _service_task(min_replicas=1)
+        serve_core.up(task, 'bg1', timeout_s=90)
+        endpoint = serve_core.status(['bg1'])[0]['endpoint']
+
+        failures = []
+        versions_seen = []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    with urllib.request.urlopen(
+                            f'http://{endpoint}/', timeout=10) as resp:
+                        versions_seen.append(
+                            json.loads(resp.read()).get('v'))
+                except (urllib.error.URLError, OSError) as e:
+                    failures.append(str(e))
+                time.sleep(0.1)
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        try:
+            new_version = serve_core.update(
+                _service_task_v2(min_replicas=1), 'bg1',
+                wait_done=True, timeout_s=120, mode='blue_green')
+        finally:
+            deadline = time.time() + 15
+            while time.time() < deadline and 2 not in versions_seen:
+                time.sleep(0.3)
+            stop.set()
+            t.join(timeout=5)
+        assert new_version == 2
+        assert not failures, failures
+        assert 2 in versions_seen, 'LB never cut over to v2'
+        first_v2 = versions_seen.index(2)
+        after_cutover = set(versions_seen[first_v2:])
+        assert after_cutover == {2}, (
+            f'v1 served after the blue/green cutover: {versions_seen}')
+        record = serve_core.status(['bg1'])[0]
+        assert all(r['version'] == 2 for r in record['replicas'])
+        serve_core.down('bg1')
+
+    def test_update_mode_validated(self, serve_env):
+        with pytest.raises(ValueError, match='rolling'):
+            serve_core.update(_service_task(), 'nope', mode='canary')
+
     def test_update_survives_controller_kill_mid_roll(self, serve_env):
         """Adversarial HA (VERDICT r4 weak #2): SIGKILL the controller
         right after the version bump lands, recover it, and the rolling
