@@ -582,6 +582,16 @@ def run(host: str = '127.0.0.1', port: int = 46580,
         raise SystemExit(0)
 
     signal.signal(signal.SIGTERM, _on_term)
+    # A restart strands in-flight request rows (no executor will ever
+    # finish them): mark them FAILED so pollers stop waiting and the
+    # retention GC can eventually reclaim them.
+    try:
+        stale = requests_db.fail_stale_inflight()
+        if stale:
+            logger.info(f'Marked {stale} stranded in-flight request(s) '
+                        'FAILED after restart')
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning(f'Stale-request reconciliation failed: {e}')
     # HA controller recovery (VERDICT r3 #9): jobs/serve state lives in
     # sqlite under ~/.xsky (the helm chart's PVC) — after a pod/server
     # restart, re-exec the controllers for every non-terminal managed

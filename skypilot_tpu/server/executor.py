@@ -279,9 +279,41 @@ def _run_request(request_id: str, func: Callable[..., Any],
             sink.close()
 
 
+_GC_EVERY = 200
+_gc_counter = 0
+
+
+def _gc_sweep() -> None:
+    try:
+        reclaimed = requests_db.gc_finished()
+        if reclaimed:
+            logger.info(f'Request GC: reclaimed {reclaimed} finished '
+                        'request(s) past retention')
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning(f'Request GC failed: {e}')
+
+
+def _maybe_gc() -> None:
+    """Opportunistic retention sweep: every Nth submission, reclaim
+    finished requests past XSKY_REQUEST_RETENTION_HOURS (72h default)
+    plus their log files, so the requests DB stays bounded without a
+    dedicated daemon. The sweep itself runs on the short pool — a
+    large backlog's bulk delete must not charge multi-second latency
+    to one unlucky submitter's HTTP request."""
+    global _gc_counter
+    _gc_counter += 1
+    if _gc_counter % _GC_EVERY != 1:    # first submission sweeps too
+        return
+    if _synchronous:
+        _gc_sweep()
+        return
+    _short().submit(_gc_sweep)
+
+
 def schedule_request(name: str, user: str, body: Dict[str, Any],
                      func: Callable[..., Any],
                      kwargs: Dict[str, Any]) -> str:
+    _maybe_gc()
     request_id = requests_db.create(name, user, body)
     if _synchronous:
         # Inline test mode: no routing — capsys/pytest own the streams.

@@ -164,6 +164,76 @@ def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
     } for r in rows]
 
 
+# Finished requests are kept this long before GC reclaims the row and
+# its log file. Long enough for post-mortems and `xsky api logs`; short
+# enough that a busy API server's DB and request_logs/ stay bounded.
+_RETENTION_HOURS_ENV = 'XSKY_REQUEST_RETENTION_HOURS'
+_DEFAULT_RETENTION_HOURS = 72.0
+
+
+def gc_finished(now: Optional[float] = None) -> int:
+    """Delete finished requests (and their log files) older than the
+    retention window. Returns the number of rows reclaimed.
+
+    Called opportunistically from the executor (every Nth submission)
+    — a dedicated daemon would be one more thing to supervise for a
+    sweep that takes milliseconds. PENDING/RUNNING rows are never
+    touched regardless of age.
+    """
+    try:
+        hours = float(os.environ.get(_RETENTION_HOURS_ENV,
+                                     _DEFAULT_RETENTION_HOURS))
+    except ValueError:
+        hours = _DEFAULT_RETENTION_HOURS
+    if hours <= 0:       # retention disabled
+        return 0
+    cutoff = (now if now is not None else time.time()) - hours * 3600
+    terminal = tuple(s.value for s in RequestStatus if s.is_terminal())
+    conn = _get_conn()
+    with _lock:
+        rows = conn.execute(
+            'SELECT request_id FROM requests WHERE finished_at IS NOT '
+            'NULL AND finished_at < ? AND status IN '
+            f"({','.join('?' * len(terminal))})",
+            (cutoff, *terminal)).fetchall()
+    ids = [r[0] for r in rows]
+    if not ids:
+        return 0
+    # Log files first, rows after: a crash between the two leaves a
+    # still-selectable row for the next sweep, whereas committing the
+    # deletes first would orphan the files forever.
+    for request_id in ids:
+        try:
+            os.remove(log_path(request_id))
+        except OSError:
+            pass
+    with _lock:
+        conn.executemany('DELETE FROM requests WHERE request_id=?',
+                         [(i,) for i in ids])
+        conn.commit()
+    return len(ids)
+
+
+def fail_stale_inflight() -> int:
+    """Mark PENDING/RUNNING rows as FAILED at server startup.
+
+    A crash/restart strands in-flight rows with finished_at=NULL —
+    they would dodge retention GC forever and lie to pollers that the
+    work is still running (no executor will ever finish them)."""
+    conn = _get_conn()
+    with _lock:
+        cur = conn.execute(
+            "UPDATE requests SET status='FAILED', finished_at=?, "
+            'error=? WHERE status IN (?, ?)',
+            (time.time(),
+             json.dumps({'type': 'ServerRestart',
+                         'message': 'API server restarted while this '
+                                    'request was in flight.'}),
+             RequestStatus.PENDING.value, RequestStatus.RUNNING.value))
+        conn.commit()
+        return cur.rowcount
+
+
 def mark_cancelled(request_id: str) -> bool:
     conn = _get_conn()
     with _lock:

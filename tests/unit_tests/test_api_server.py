@@ -503,3 +503,66 @@ class TestExecutorHardening:
         time.sleep(0.3)
         assert requests_db.get(rid)['status'] == \
             requests_db.RequestStatus.FAILED
+
+
+def test_request_gc_reclaims_old_finished(monkeypatch, tmp_path):
+    """Finished requests past retention are reclaimed (row + log
+    file); in-flight and fresh rows survive regardless of age."""
+    import os
+    import time as time_lib
+    from skypilot_tpu.server import requests_db
+    monkeypatch.setenv('XSKY_SERVER_DB', str(tmp_path / 'requests.db'))
+    monkeypatch.setenv('XSKY_REQUEST_RETENTION_HOURS', '1')
+    requests_db.reset_for_test()
+    old_done = requests_db.create('status', 'u', {})
+    requests_db.finish(old_done, result=[])
+    old_running = requests_db.create('launch', 'u', {})
+    requests_db.set_status(old_running, requests_db.RequestStatus.RUNNING)
+    fresh_done = requests_db.create('status', 'u', {})
+    requests_db.finish(fresh_done, result=[])
+    # Age the first two rows past the 1h window.
+    conn = requests_db._get_conn()
+    past = time_lib.time() - 7200
+    conn.execute('UPDATE requests SET created_at=?, finished_at='
+                 'CASE WHEN finished_at IS NULL THEN NULL ELSE ? END '
+                 'WHERE request_id IN (?, ?)',
+                 (past, past, old_done, old_running))
+    conn.commit()
+    log = requests_db.log_path(old_done)
+    os.makedirs(os.path.dirname(log), exist_ok=True)
+    with open(log, 'w') as f:
+        f.write('x')
+
+    assert requests_db.gc_finished() == 1
+    assert requests_db.get(old_done) is None
+    assert not os.path.exists(log)
+    # RUNNING survives any age; fresh finished survives the window.
+    assert requests_db.get(old_running) is not None
+    assert requests_db.get(fresh_done) is not None
+    # Disabled retention is a no-op.
+    monkeypatch.setenv('XSKY_REQUEST_RETENTION_HOURS', '0')
+    assert requests_db.gc_finished() == 0
+    requests_db.reset_for_test()
+
+
+def test_fail_stale_inflight_on_restart(monkeypatch, tmp_path):
+    """Crash-stranded PENDING/RUNNING rows are failed at startup so
+    pollers stop waiting and retention GC can reclaim them."""
+    from skypilot_tpu.server import requests_db
+    monkeypatch.setenv('XSKY_SERVER_DB', str(tmp_path / 'requests.db'))
+    requests_db.reset_for_test()
+    pending = requests_db.create('launch', 'u', {})
+    running = requests_db.create('launch', 'u', {})
+    requests_db.set_status(running, requests_db.RequestStatus.RUNNING)
+    done = requests_db.create('status', 'u', {})
+    requests_db.finish(done, result=[])
+
+    assert requests_db.fail_stale_inflight() == 2
+    for rid in (pending, running):
+        record = requests_db.get(rid)
+        assert record['status'] == requests_db.RequestStatus.FAILED
+        assert 'restarted' in record['error']['message']
+        assert record['finished_at'] is not None
+    assert requests_db.get(done)['status'] == \
+        requests_db.RequestStatus.SUCCEEDED
+    requests_db.reset_for_test()
