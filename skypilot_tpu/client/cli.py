@@ -56,16 +56,57 @@ def _apply_task_flags(t: task_lib.Task, name, num_nodes,
     return t
 
 
+def _parse_env_file(path: Optional[str]) -> dict:
+    """dotenv format: KEY=VALUE lines; blank lines and #-comments
+    skipped; values may be single- or double-quoted."""
+    if not path:
+        return {}
+    out = {}
+    with open(path, encoding='utf-8') as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith('#'):
+                continue
+            if line.startswith('export '):
+                # Shell-sourceable .env files are common; python-dotenv
+                # accepts the prefix too.
+                line = line[len('export '):].lstrip()
+            if '=' not in line:
+                raise click.UsageError(
+                    f'{path}:{lineno}: expected KEY=VALUE, got '
+                    f'{line!r}')
+            k, _, v = line.partition('=')
+            v = v.strip()
+            if len(v) >= 2 and v[0] == v[-1] and v[0] in ('"', "'"):
+                v = v[1:-1]
+            elif ' #' in v:
+                # Unquoted values lose inline comments (dotenv
+                # semantics); quoted values keep their # literally.
+                v = v.split(' #', 1)[0].rstrip()
+            out[k.strip()] = v
+    return out
+
+
+def _merged_envs(envs, env_file) -> dict:
+    """File entries first; explicit --env flags win (reference
+    _merge_env_vars semantics)."""
+    merged = _parse_env_file(env_file)
+    merged.update(_parse_kv(envs, 'env'))
+    return merged
+
+
 def _load_task(entrypoint: str, envs, secrets, name, num_nodes,
-               accelerators=None, cloud=None, use_spot=None) -> task_lib.Task:
+               accelerators=None, cloud=None, use_spot=None,
+               env_file=None) -> task_lib.Task:
+    env_overrides = _merged_envs(envs, env_file)
     if os.path.exists(entrypoint) and entrypoint.endswith(
             ('.yaml', '.yml')):
         t = task_lib.Task.from_yaml(entrypoint,
-                                    env_overrides=_parse_kv(envs, 'env'),
+                                    env_overrides=env_overrides,
                                     secret_overrides=_parse_kv(
                                         secrets, 'secret'))
     else:
-        t = task_lib.Task(run=entrypoint, envs=_parse_kv(envs, 'env'),
+        t = task_lib.Task(run=entrypoint, envs=env_overrides,
                           secrets=_parse_kv(secrets, 'secret'))
     return _apply_task_flags(t, name, num_nodes, accelerators, cloud,
                              use_spot)
@@ -84,6 +125,10 @@ def cli():
 _task_options = [
     click.option('--env', 'envs', multiple=True,
                  help='Env override KEY=VALUE (or KEY to inherit).'),
+    click.option('--env-file', 'env_file', default=None,
+                 type=click.Path(exists=True, dir_okay=False),
+                 help='dotenv file of KEY=VALUE lines; explicit --env '
+                      'flags override entries from the file.'),
     click.option('--secret', 'secrets', multiple=True,
                  help='Secret override KEY=VALUE.'),
     click.option('--name', '-n', default=None, help='Task name.'),
@@ -114,13 +159,13 @@ def _apply(options):
 @click.option('--dryrun', is_flag=True, default=False)
 @click.option('--detach-run', '-d', is_flag=True, default=False)
 @click.option('--yes', '-y', is_flag=True, default=False)
-def launch(entrypoint, envs, secrets, name, num_nodes, accelerators, cloud,
-           use_spot, cluster, retry_until_up, idle_minutes_to_autostop,
-           down, dryrun, detach_run, yes):
+def launch(entrypoint, envs, env_file, secrets, name, num_nodes,
+           accelerators, cloud, use_spot, cluster, retry_until_up,
+           idle_minutes_to_autostop, down, dryrun, detach_run, yes):
     """Launch a task (provision a cluster if needed)."""
     from skypilot_tpu.client import sdk
     t = _load_task(entrypoint, envs, secrets, name, num_nodes,
-                   accelerators, cloud, use_spot)
+                   accelerators, cloud, use_spot, env_file=env_file)
     if not yes and not dryrun:
         click.confirm(f'Launching task on cluster {cluster or "<new>"}. '
                       'Proceed?', default=True, abort=True)
@@ -140,12 +185,12 @@ def launch(entrypoint, envs, secrets, name, num_nodes, accelerators, cloud,
 @click.argument('entrypoint')
 @_apply(_task_options)
 @click.option('--detach-run', '-d', is_flag=True, default=False)
-def exec_cmd(cluster, entrypoint, envs, secrets, name, num_nodes,
-             accelerators, cloud, use_spot, detach_run):
+def exec_cmd(cluster, entrypoint, envs, env_file, secrets, name,
+             num_nodes, accelerators, cloud, use_spot, detach_run):
     """Run a task on an existing cluster (no provisioning)."""
     from skypilot_tpu.client import sdk
     t = _load_task(entrypoint, envs, secrets, name, num_nodes,
-                   accelerators, cloud, use_spot)
+                   accelerators, cloud, use_spot, env_file=env_file)
     job_id, _ = sdk.exec(t, cluster, detach_run=detach_run)
     click.echo(f'Job {job_id} on cluster {cluster}: submitted.')
 
@@ -543,8 +588,8 @@ def jobs():
 @click.argument('entrypoint')
 @_apply(_task_options)
 @click.option('--yes', '-y', is_flag=True, default=False)
-def jobs_launch(entrypoint, envs, secrets, name, num_nodes, accelerators,
-                cloud, use_spot, yes):
+def jobs_launch(entrypoint, envs, env_file, secrets, name, num_nodes,
+                accelerators, cloud, use_spot, yes):
     """Launch a managed job (controller recovers preemptions).
 
     A `---`-separated multi-document YAML is a PIPELINE: tasks run as
@@ -555,7 +600,7 @@ def jobs_launch(entrypoint, envs, secrets, name, num_nodes, accelerators,
     if os.path.exists(entrypoint) and entrypoint.endswith(
             ('.yaml', '.yml')):
         chain_name, tasks = task_lib.Task.load_chain(
-            entrypoint, env_overrides=_parse_kv(envs, 'env'),
+            entrypoint, env_overrides=_merged_envs(envs, env_file),
             secret_overrides=_parse_kv(secrets, 'secret'))
         if len(tasks) > 1:
             # Per-task resource flags are ambiguous across a chain.
@@ -576,7 +621,8 @@ def jobs_launch(entrypoint, envs, secrets, name, num_nodes, accelerators,
                               accelerators, cloud, use_spot)
     else:
         t = _load_task(entrypoint, envs, secrets, name, num_nodes,
-                       accelerators, cloud, use_spot)
+                       accelerators, cloud, use_spot,
+                       env_file=env_file)
     job_id = sdk.jobs_launch(t)
     click.echo(f'Managed job {job_id} submitted.')
 

@@ -87,3 +87,40 @@ class TestConfig:
             with config_lib.override({'a': {'b': 2}}):
                 assert config_lib.get_nested(('a', 'b')) == 2
             assert config_lib.get_nested(('a', 'b')) == 1
+
+
+class TestEnvFile:
+    """--env-file dotenv parsing (reference _merge_env_vars: explicit
+    --env flags beat file entries)."""
+
+    def test_parse_and_precedence(self, tmp_path):
+        from skypilot_tpu.client import cli as cli_mod
+        f = tmp_path / 'vars.env'
+        f.write_text('# comment\n\nA=1\nB="two words"\n'
+                     "C='single'\nD=plain\n")
+        merged = cli_mod._merged_envs(('B=cli-wins', 'E=extra'), str(f))
+        assert merged == {'A': '1', 'B': 'cli-wins', 'C': 'single',
+                          'D': 'plain', 'E': 'extra'}
+
+    def test_export_prefix_and_inline_comments(self, tmp_path):
+        from skypilot_tpu.client import cli as cli_mod
+        f = tmp_path / 'shell.env'
+        f.write_text('export API_KEY=abc\n'
+                     'PORT=8080  # web server\n'
+                     'TAG="v1 # literal"\n')
+        parsed = cli_mod._parse_env_file(str(f))
+        assert parsed == {'API_KEY': 'abc', 'PORT': '8080',
+                          'TAG': 'v1 # literal'}
+
+    def test_malformed_line_rejected(self, tmp_path):
+        import click
+        import pytest as _pytest
+        from skypilot_tpu.client import cli as cli_mod
+        f = tmp_path / 'bad.env'
+        f.write_text('JUSTAKEY\n')
+        with _pytest.raises(click.UsageError, match='bad.env:1'):
+            cli_mod._parse_env_file(str(f))
+
+    def test_no_file_is_empty(self):
+        from skypilot_tpu.client import cli as cli_mod
+        assert cli_mod._parse_env_file(None) == {}
