@@ -139,6 +139,13 @@ def test_verb_surface_is_append_only():
         'serve.up', 'serve.update', 'serve.status', 'serve.down',
         'serve.logs',
         'users.list', 'users.create', 'users.delete', 'users.set_role',
+        # round 5 additions (append-only from here on too):
+        'cluster_hosts', 'endpoints', 'accelerators',
+        'jobs.watch_logs', 'serve.history', 'serve.watch_logs',
+        'serve.controller_logs',
+        'workspaces.list', 'workspaces.create', 'workspaces.members',
+        'workspaces.add_member', 'workspaces.remove_member',
+        'workspaces.get_config', 'workspaces.set_config',
     }
     known = {v for v in pinned if payloads.known_verb(v)}
     missing = pinned - known
