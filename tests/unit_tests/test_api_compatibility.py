@@ -128,8 +128,8 @@ class TestWireFormat:
 
 def test_verb_surface_is_append_only():
     """The wire verb set may only grow: removing or renaming a verb
-    breaks older clients. This pin is the list as of round 2 — extend
-    it when adding verbs; never delete from it."""
+    breaks older clients. Started as the round-2 list, extended every
+    round since — add new verbs here; never delete from this set."""
     from skypilot_tpu.server import payloads
     pinned = {
         'launch', 'exec', 'status', 'start', 'stop', 'down', 'autostop',
@@ -143,9 +143,12 @@ def test_verb_surface_is_append_only():
         'cluster_hosts', 'endpoints', 'accelerators',
         'jobs.watch_logs', 'serve.history', 'serve.watch_logs',
         'serve.controller_logs',
-        'workspaces.list', 'workspaces.create', 'workspaces.members',
-        'workspaces.add_member', 'workspaces.remove_member',
-        'workspaces.get_config', 'workspaces.set_config',
+        'workspaces.list', 'workspaces.create', 'workspaces.delete',
+        'workspaces.members', 'workspaces.add_member',
+        'workspaces.remove_member', 'workspaces.get_config',
+        'workspaces.set_config',
+        'users.token_create', 'users.token_list', 'users.token_revoke',
+        'ssh.up', 'ssh.down',
     }
     known = {v for v in pinned if payloads.known_verb(v)}
     missing = pinned - known
