@@ -85,6 +85,13 @@ def catalog_path(cloud: str) -> str:
     return os.path.join(_DATA_DIR, cloud, 'catalog.csv')
 
 
+def read_catalog_csv(path: str) -> List[CatalogEntry]:
+    """Parse one catalog CSV file (shared by the hosted, in-tree and
+    live-price readers)."""
+    with open(path, newline='', encoding='utf-8') as f:
+        return [CatalogEntry.from_row(row) for row in csv.DictReader(f)]
+
+
 def save_catalog(cloud: str, entries: List[CatalogEntry]) -> str:
     path = catalog_path(cloud)
     os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -108,9 +115,7 @@ def load_catalog(cloud: str) -> List[CatalogEntry]:
     hosted_path = hosted.fetch(cloud)
     if hosted_path is not None:
         try:
-            with open(hosted_path, newline='', encoding='utf-8') as f:
-                return [CatalogEntry.from_row(row)
-                        for row in csv.DictReader(f)]
+            return read_catalog_csv(hosted_path)
         except (KeyError, ValueError, OSError) as e:
             # A malformed hosted/cached file must degrade to the
             # in-tree catalog, not break every status/launch.
@@ -123,8 +128,7 @@ def load_catalog(cloud: str) -> List[CatalogEntry]:
         _maybe_generate(cloud)
     if not os.path.exists(path):
         return []
-    with open(path, newline='', encoding='utf-8') as f:
-        return [CatalogEntry.from_row(row) for row in csv.DictReader(f)]
+    return read_catalog_csv(path)
 
 
 def _maybe_generate(cloud: str) -> None:

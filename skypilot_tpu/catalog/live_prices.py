@@ -276,12 +276,10 @@ def apply_azure_live(
 
 
 def _read_catalog_csv(cloud: str) -> List[common.CatalogEntry]:
-    import csv
     path = common.catalog_path(cloud)
     if not os.path.exists(path):
         raise FileNotFoundError(f'no in-tree catalog for {cloud}: {path}')
-    with open(path, newline='', encoding='utf-8') as f:
-        return [common.CatalogEntry.from_row(row) for row in csv.DictReader(f)]
+    return common.read_catalog_csv(path)
 
 
 def refresh(clouds: Iterable[str],

@@ -162,7 +162,7 @@ def watch_logs(job_id: int, offset: int = 0) -> Dict[str, Any]:
         poll = core_lib.watch_job_log(cluster_name, cluster_job_id,
                                       offset)
         return {'status': status, 'offset': poll.get('offset', offset),
-                'data': poll.get('log') or poll.get('data') or '',
+                'data': poll.get('log') or '',
                 'epoch': epoch, 'done': done}
     except Exception:  # pylint: disable=broad-except
         # Cluster torn down (job done, or mid-recovery): serve the

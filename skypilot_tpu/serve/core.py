@@ -321,7 +321,7 @@ def watch_replica_logs(service_name: str, replica_id: int,
         epoch = f'{cluster_name}#{job_id}'
         poll = core_lib.watch_job_log(cluster_name, job_id, offset)
         return {'status': status, 'offset': poll.get('offset', offset),
-                'data': poll.get('log') or poll.get('data') or '',
+                'data': poll.get('log') or '',
                 'epoch': epoch, 'done': done}
     except Exception:  # pylint: disable=broad-except
         # Cluster mid-provision or torn down: status-only poll.

@@ -376,51 +376,39 @@ class _Handler(BaseHTTPRequestHandler):
         return users_core.authenticate(
             self.headers.get('Authorization'))
 
-    def _can_read_cluster(self, user: Dict[str, Any],
-                          cluster_name: str) -> bool:
-        """Workspace-membership gate for cluster READ endpoints — the
-        GET log routes must match the POST verbs' authz (code-review
-        r4: GETs bypassed the isolation the verbs enforce)."""
-        from skypilot_tpu import state
+    def _record_workspace_allows(self, user: Dict[str, Any],
+                                 record: Optional[Dict[str, Any]]
+                                 ) -> bool:
+        """Workspace-membership gate shared by every GET log route —
+        GETs must match the POST verbs' authz (code-review r4: GETs
+        bypassed the isolation the verbs enforce). A missing record
+        passes: the handler 404s/NOT_FOUNDs it itself."""
+        if record is None:
+            return True
         from skypilot_tpu.workspaces import context as ws_context
         from skypilot_tpu.workspaces import core as workspaces_core
-        record = state.get_cluster_from_name(cluster_name)
-        if record is None:
-            return True   # nonexistent: the handler 404s itself
         workspace = record.get('workspace') or \
             ws_context.DEFAULT_WORKSPACE
         return workspaces_core.check_access(user['name'], user['role'],
                                             workspace)
+
+    def _can_read_cluster(self, user: Dict[str, Any],
+                          cluster_name: str) -> bool:
+        from skypilot_tpu import state
+        return self._record_workspace_allows(
+            user, state.get_cluster_from_name(cluster_name))
 
     def _can_read_service(self, user: Dict[str, Any],
                           service_name: str) -> bool:
-        """Workspace-membership gate for the replica log route — same
-        ownership resolution as the serve.* verbs."""
         from skypilot_tpu.serve import state as serve_state
-        from skypilot_tpu.workspaces import context as ws_context
-        from skypilot_tpu.workspaces import core as workspaces_core
-        record = serve_state.get_service(service_name)
-        if record is None:
-            return True   # nonexistent: the handler reports NOT_FOUND
-        workspace = record.get('workspace') or \
-            ws_context.DEFAULT_WORKSPACE
-        return workspaces_core.check_access(user['name'], user['role'],
-                                            workspace)
+        return self._record_workspace_allows(
+            user, serve_state.get_service(service_name))
 
     def _can_read_managed_job(self, user: Dict[str, Any],
                               job_id: int) -> bool:
-        """Workspace-membership gate for the managed-job log route —
-        same ownership resolution as the jobs.cancel/jobs.logs verbs."""
         from skypilot_tpu.jobs import state as jobs_state
-        from skypilot_tpu.workspaces import context as ws_context
-        from skypilot_tpu.workspaces import core as workspaces_core
-        record = jobs_state.get_job(job_id)
-        if record is None:
-            return True   # nonexistent: the handler reports NOT_FOUND
-        workspace = record.get('workspace') or \
-            ws_context.DEFAULT_WORKSPACE
-        return workspaces_core.check_access(user['name'], user['role'],
-                                            workspace)
+        return self._record_workspace_allows(
+            user, jobs_state.get_job(job_id))
 
     def do_POST(self) -> None:  # noqa: N802
         parsed = urllib.parse.urlparse(self.path)
