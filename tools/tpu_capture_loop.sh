@@ -60,10 +60,9 @@ kernel_tier() {
 while true; do
   if probe; then
     echo "tunnel UP $(date -u +%FT%TZ)" >> "$LOG"
-    if [ ! -f TPU_TIER_r05.txt ] || \
-       [ -n "$(find TPU_TIER_r05.txt -mmin +180)" ]; then
-      kernel_tier
-    fi
+    # Benches BEFORE the kernel tier: after a long outage the window
+    # until the next flap may be short, and the round's bar is the
+    # bench numbers — the tier (up to 40 min) must not eat the window.
     # Re-capture even after a success if >90 min old: later code may be
     # faster, and fresher evidence is better evidence.
     captured=0
@@ -73,6 +72,12 @@ while true; do
         capture "$mode" && captured=1
       fi
     done
+    # Re-run the tier when it has never produced a pass summary
+    # (missing / interrupted run) or is stale.
+    if ! grep -q "passed" TPU_TIER_r05.txt 2>/dev/null || \
+       [ -n "$(find TPU_TIER_r05.txt -mmin +180)" ]; then
+      kernel_tier
+    fi
     # Evidence lands in git the moment it exists — the session may not
     # be watching when the tunnel finally answers. Add each EXISTING
     # file individually (git add is all-or-nothing across pathspecs, so
