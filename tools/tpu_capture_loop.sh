@@ -47,14 +47,22 @@ capture() { # $1 = train|serve
 
 kernel_tier() {
   # On-silicon Pallas kernel tier (VERDICT r3 #3): Mosaic lowering +
-  # numerics on the real chip, recorded for the round log. Runs before
-  # bench so a broken kernel is caught as a test failure, not a bench
-  # mystery. jax.devices() hangs when the tunnel is down, so this only
-  # runs behind a successful probe (plus its own hard timeout).
+  # numerics on the real chip, recorded for the round log. Runs AFTER
+  # the benches (the round's bar) so a short tunnel window is spent on
+  # numbers first. Output lands in a temp file and only replaces the
+  # round evidence when the run produced a pytest summary — a mid-run
+  # tunnel flap must not clobber a previously complete tier file with
+  # truncated hang output.
   XSKY_TPU_TESTS=1 timeout 2400 python -m pytest tests/tpu -m tpu -q \
-    > TPU_TIER_r05.txt 2>&1
-  echo "--- kernel tier rc=$? $(date -u +%FT%TZ)" >> "$LOG"
-  tail -3 TPU_TIER_r05.txt >> "$LOG"
+    > TPU_TIER_r05.txt.tmp 2>&1
+  rc=$?
+  echo "--- kernel tier rc=$rc $(date -u +%FT%TZ)" >> "$LOG"
+  tail -3 TPU_TIER_r05.txt.tmp >> "$LOG"
+  if grep -Eq '[0-9]+ (passed|failed|error)' TPU_TIER_r05.txt.tmp; then
+    mv TPU_TIER_r05.txt.tmp TPU_TIER_r05.txt
+  else
+    rm -f TPU_TIER_r05.txt.tmp
+  fi
 }
 
 while true; do
@@ -72,11 +80,17 @@ while true; do
         capture "$mode" && captured=1
       fi
     done
-    # Re-run the tier when it has never produced a pass summary
-    # (missing / interrupted run) or is stale.
-    if ! grep -q "passed" TPU_TIER_r05.txt 2>/dev/null || \
-       [ -n "$(find TPU_TIER_r05.txt -mmin +180)" ]; then
-      kernel_tier
+    # Re-run the tier when no COMPLETE run exists (no pytest summary —
+    # a finished all-fail run still counts as complete; it retries only
+    # on staleness, not every cycle) or the last one is stale. The
+    # benches above can take hours, so re-probe first: kernel_tier on a
+    # flapped tunnel would hang its full timeout for nothing.
+    if ! grep -Eq '[0-9]+ (passed|failed|error)' TPU_TIER_r05.txt \
+         2>/dev/null || \
+       [ -n "$(find TPU_TIER_r05.txt -mmin +180 2>/dev/null)" ]; then
+      if probe; then
+        kernel_tier
+      fi
     fi
     # Evidence lands in git the moment it exists — the session may not
     # be watching when the tunnel finally answers. Add each EXISTING
