@@ -266,15 +266,32 @@ def down(clusters, yes, purge):
 
 @cli.command()
 @click.argument('cluster')
-@click.option('--idle-minutes', '-i', type=int, required=True,
+@click.option('--idle-minutes', '-i', type=int, default=None,
               help='Idle minutes before autostop; -1 cancels.')
+@click.option('--cancel', is_flag=True, default=False,
+              help='Cancel a scheduled autostop (same as -i -1; twin '
+                   'of `sky autostop --cancel`).')
 @click.option('--down', is_flag=True, default=False)
-def autostop(cluster, idle_minutes, down):
-    """Schedule autostop/autodown for a cluster."""
+def autostop(cluster, idle_minutes, cancel, down):
+    """Schedule (or cancel) autostop/autodown for a cluster."""
     from skypilot_tpu.client import sdk
+    if cancel:
+        if idle_minutes is not None:
+            raise click.UsageError(
+                '--cancel and --idle-minutes are mutually exclusive.')
+        if down:
+            raise click.UsageError(
+                '--down has no effect with --cancel.')
+        idle_minutes = -1
+    elif idle_minutes is None:
+        raise click.UsageError(
+            'one of --idle-minutes/-i or --cancel is required.')
     sdk.autostop(cluster, idle_minutes, down=down)
-    click.echo(f'Autostop set on {cluster}: {idle_minutes}m'
-               f'{" (down)" if down else ""}.')
+    if idle_minutes < 0:
+        click.echo(f'Autostop cancelled on {cluster}.')
+    else:
+        click.echo(f'Autostop set on {cluster}: {idle_minutes}m'
+                   f'{" (down)" if down else ""}.')
 
 
 @cli.command()
