@@ -158,10 +158,14 @@ def _apply(options):
               help='Tear down (not stop) on idle autostop.')
 @click.option('--dryrun', is_flag=True, default=False)
 @click.option('--detach-run', '-d', is_flag=True, default=False)
+@click.option('--fast', is_flag=True, default=False,
+              help='If the cluster is already UP, skip the setup phase '
+                   '(twin of `sky launch --fast`).')
 @click.option('--yes', '-y', is_flag=True, default=False)
 def launch(entrypoint, envs, env_file, secrets, name, num_nodes,
            accelerators, cloud, use_spot, cluster, retry_until_up,
-           idle_minutes_to_autostop, down, dryrun, detach_run, yes):
+           idle_minutes_to_autostop, down, dryrun, detach_run, fast,
+           yes):
     """Launch a task (provision a cluster if needed)."""
     from skypilot_tpu.client import sdk
     t = _load_task(entrypoint, envs, secrets, name, num_nodes,
@@ -172,7 +176,7 @@ def launch(entrypoint, envs, env_file, secrets, name, num_nodes,
     job_id, handle = sdk.launch(
         t, cluster_name=cluster, retry_until_up=retry_until_up,
         idle_minutes_to_autostop=idle_minutes_to_autostop, down=down,
-        dryrun=dryrun, detach_run=detach_run)
+        dryrun=dryrun, detach_run=detach_run, no_setup=fast)
     if dryrun:
         click.echo('Dryrun complete.')
         return

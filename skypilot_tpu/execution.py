@@ -74,8 +74,6 @@ def launch(entrypoint,
     # `down` modifies autostop semantics (teardown-on-idle), it does not
     # add a DOWN stage; Stage.DOWN exists for jobs-controller cleanup.
     stages = [s for s in ALL_STAGES if s != Stage.DOWN]
-    if no_setup:
-        stages.remove(Stage.SETUP)
     # Per-workspace config overlay (ref: workspace-scoped config in
     # sky/workspaces/core.py): the active workspace's stored overlay
     # applies to this launch's whole config view.
@@ -90,7 +88,7 @@ def launch(entrypoint,
             idle_minutes_to_autostop=idle_minutes_to_autostop,
             down=down, detach_run=detach_run,
             stream_logs=stream_logs, backend=backend,
-            blocked_resources=blocked_resources)
+            blocked_resources=blocked_resources, no_setup=no_setup)
 
 
 def exec(entrypoint,  # pylint: disable=redefined-builtin
@@ -139,7 +137,8 @@ def _execute_dag(dag: dag_lib.Dag,
                  detach_run: bool,
                  backend: Optional[Any],
                  stream_logs: bool = True,
-                 blocked_resources: Optional[List[Any]] = None
+                 blocked_resources: Optional[List[Any]] = None,
+                 no_setup: bool = False
                  ) -> Tuple[Optional[int], Optional[Any]]:
     if len(dag.tasks) != 1:
         raise ValueError(
@@ -173,6 +172,11 @@ def _execute_dag(dag: dag_lib.Dag,
         if existing is not None and \
                 existing['status'] == state.ClusterStatus.UP:
             handle = existing['handle']
+        # --fast semantics (sky launch --fast): setup is skipped only
+        # when an UP cluster is being REUSED — a fresh provision (or a
+        # restart) still needs its dependency setup, whatever the flag
+        # says.
+        reused_up = handle is not None
 
         if Stage.OPTIMIZE in stages and handle is None:
             best = None
@@ -204,7 +208,7 @@ def _execute_dag(dag: dag_lib.Dag,
             task.sync_storage_mounts()
         backend.sync_file_mounts(handle, task.file_mounts,
                                  task.storage_mounts)
-    if Stage.SETUP in stages:
+    if Stage.SETUP in stages and not (no_setup and reused_up):
         backend.setup(handle, task)
 
     # Autostop before EXEC so failures still get reaped.
