@@ -1143,8 +1143,22 @@ def storage():
 
 
 @storage.command(name='ls')
-def storage_ls():
+@click.argument('name', required=False)
+@click.option('--prefix', default='', help='Object-key prefix filter.')
+@click.option('--limit', type=int, default=100)
+def storage_ls(name, prefix, limit):
+    """List storages, or one storage's objects when NAME is given."""
+    from skypilot_tpu import exceptions as exc
     from skypilot_tpu.client import sdk
+    if name:
+        try:
+            keys = sdk.storage_ls_objects(name, prefix=prefix,
+                                          limit=limit)
+        except exc.StorageError as e:
+            raise click.ClickException(str(e)) from e
+        for key in keys:
+            click.echo(key)
+        return
     records = sdk.storage_ls()
     if not records:
         click.echo('No storage.')

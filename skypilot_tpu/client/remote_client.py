@@ -208,6 +208,11 @@ class RemoteClient:
         return self._call('storage.delete',
                           {'storage_name': storage_name})
 
+    def storage_ls_objects(self, storage_name, prefix='', limit=100):
+        return self._call('storage.ls_objects',
+                          {'storage_name': storage_name,
+                           'prefix': prefix, 'limit': limit})
+
     def cost_report(self):
         return self._call('cost_report', {})
 

@@ -123,6 +123,13 @@ def endpoints(cluster_name: str,
     return _local_or_remote('endpoints', cluster_name, port=port)
 
 
+def storage_ls_objects(storage_name: str, prefix: str = '',
+                       limit: int = 100) -> List[str]:
+    """First `limit` object keys of a storage's primary store."""
+    return _local_or_remote('storage_ls_objects', storage_name,
+                            prefix=prefix, limit=limit)
+
+
 def cancel(cluster_name: str, job_ids: Optional[List[int]] = None,
            all_jobs: bool = False) -> None:
     return _local_or_remote('cancel', cluster_name, job_ids=job_ids,

@@ -384,6 +384,18 @@ def storage_ls() -> List[Dict[str, Any]]:
     return out
 
 
+def storage_ls_objects(storage_name: str, prefix: str = '',
+                       limit: int = 100) -> List[str]:
+    """First `limit` object keys of a storage's primary store
+    (`storage.ls_objects` verb — dashboard drill + `storage ls NAME`)."""
+    from skypilot_tpu.data import storage as storage_lib
+    record = state.get_storage_from_name(storage_name)
+    if record is None:
+        raise exceptions.StorageError(f'Storage {storage_name!r} not found.')
+    return storage_lib.Storage.from_handle(record['handle']).list_objects(
+        prefix=prefix, limit=int(limit))
+
+
 def storage_delete(storage_name: str) -> None:
     """Delete one storage (managed buckets removed; external kept)."""
     from skypilot_tpu.data import storage as storage_lib

@@ -296,11 +296,15 @@ class S3ObjectClient:
 
     # -- objects --
 
-    def list_objects(self, bucket: str, prefix: str = '') -> List[str]:
+    def list_objects(self, bucket: str, prefix: str = '',
+                     max_keys: Optional[int] = None) -> List[str]:
         keys: List[str] = []
         token: Optional[str] = None
         while True:
             query = {'list-type': '2'}
+            if max_keys is not None:
+                query['max-keys'] = str(
+                    min(1000, max_keys - len(keys)))
             if prefix:
                 query['prefix'] = prefix
             if token:
@@ -316,6 +320,8 @@ class S3ObjectClient:
                 key = contents.findtext(f'{ns}Key')
                 if key:
                     keys.append(key)
+            if max_keys is not None and len(keys) >= max_keys:
+                return keys[:max_keys]
             token = root.findtext(f'{ns}NextContinuationToken')
             if not token:
                 return keys
@@ -439,11 +445,15 @@ class AzureBlobClient:
 
     # -- blobs --
 
-    def list_blobs(self, container: str, prefix: str = '') -> List[str]:
+    def list_blobs(self, container: str, prefix: str = '',
+                   max_results: Optional[int] = None) -> List[str]:
         names: List[str] = []
         marker = ''
         while True:
             query = {'restype': 'container', 'comp': 'list'}
+            if max_results is not None:
+                query['maxresults'] = str(
+                    min(5000, max_results - len(names)))
             if prefix:
                 query['prefix'] = prefix
             if marker:
@@ -456,6 +466,8 @@ class AzureBlobClient:
                 name = blob.findtext('Name')
                 if name:
                     names.append(name)
+            if max_results is not None and len(names) >= max_results:
+                return names[:max_results]
             marker = root.findtext('NextMarker') or ''
             if not marker:
                 return names
@@ -543,11 +555,15 @@ class GcsObjectClient:
             self.delete_object(bucket, key)
         self._call('DELETE', f'{self.API}/b/{bucket}', ok_codes=(404,))
 
-    def list_objects(self, bucket: str, prefix: str = '') -> List[str]:
+    def list_objects(self, bucket: str, prefix: str = '',
+                     max_results: Optional[int] = None) -> List[str]:
         names: List[str] = []
         page: Optional[str] = None
         while True:
             query = {'fields': 'items/name,nextPageToken'}
+            if max_results is not None:
+                query['maxResults'] = str(
+                    min(1000, max_results - len(names)))
             if prefix:
                 query['prefix'] = prefix
             if page:
@@ -559,6 +575,8 @@ class GcsObjectClient:
             data = json.loads(raw) if raw.strip() else {}
             names.extend(item['name']
                          for item in data.get('items', []))
+            if max_results is not None and len(names) >= max_results:
+                return names[:max_results]
             page = data.get('nextPageToken')
             if not page:
                 return names

@@ -134,6 +134,33 @@ class AbstractStore:
     def delete(self) -> None:
         raise NotImplementedError
 
+    def list_objects(self, prefix: str = '',
+                     limit: int = 100) -> List[str]:
+        """First `limit` object keys under `prefix` (dashboard /
+        `storage ls NAME` drill-down). REST-transport only: stores
+        without a usable zero-dep client raise StorageError rather
+        than shelling out on the API-server hot path."""
+        raise exceptions.StorageError(
+            f'{self.store_type.value}: object listing not supported')
+
+    @staticmethod
+    def _strip_sub(keys: List[str], sub: str) -> List[str]:
+        """Return keys relative to the store's sub-path so prefix-in
+        and keys-out share one namespace (LocalStore is root-relative
+        already)."""
+        if not sub:
+            return keys
+        cut = sub.rstrip('/') + '/'
+        return [k[len(cut):] if k.startswith(cut) else k for k in keys]
+
+    def _rest_or_error(self):
+        client = self._rest()
+        if client is None:
+            raise exceptions.StorageError(
+                f'{self.store_type.value}: no credentials for object '
+                'listing')
+        return client
+
     # cluster-side commands
     def mount_command(self, mount_path: str) -> str:
         raise NotImplementedError
@@ -163,6 +190,14 @@ class GcsStore(AbstractStore):
         client = object_rest.GcsObjectClient()
         client._tokens.token()   # probe the credential chain now
         return client
+
+    def list_objects(self, prefix: str = '',
+                     limit: int = 100) -> List[str]:
+        bucket, _, sub = self.name.partition('/')
+        full = f'{sub}/{prefix}'.lstrip('/') if sub else prefix
+        return self._strip_sub(
+            self._rest_or_error().list_objects(
+                bucket, prefix=full, max_results=limit), sub)
 
     def exists(self) -> bool:
         client = self._rest()
@@ -243,6 +278,14 @@ class S3Store(AbstractStore):
         return object_rest.S3ObjectClient(
             region=self.region or 'us-east-1',
             endpoint=self.endpoint_url)
+
+    def list_objects(self, prefix: str = '',
+                     limit: int = 100) -> List[str]:
+        bucket, _, sub = self.name.partition('/')
+        full = f'{sub}/{prefix}'.lstrip('/') if sub else prefix
+        return self._strip_sub(
+            self._rest_or_error().list_objects(
+                bucket, prefix=full, max_keys=limit), sub)
 
     def exists(self) -> bool:
         client = self._rest()
@@ -347,6 +390,23 @@ class LocalStore(AbstractStore):
     def delete(self) -> None:
         _run(f'rm -rf {shlex.quote(self._root())}')
 
+    def list_objects(self, prefix: str = '',
+                     limit: int = 100) -> List[str]:
+        root = self._root()
+        out: List[str] = []
+        # Topdown walk with in-place dirname sort: deterministic order
+        # WITHOUT materializing the whole tree (sorted(os.walk(...))
+        # would exhaust the generator before the limit could stop it).
+        for dirpath, dirs, files in os.walk(root):
+            dirs.sort()
+            for f in sorted(files):
+                rel = os.path.relpath(os.path.join(dirpath, f), root)
+                if rel.startswith(prefix):
+                    out.append(rel)
+                    if len(out) >= limit:
+                        return out
+        return out
+
     def mount_command(self, mount_path: str) -> str:
         return mounting_utils.local_mount_command(self._root(), mount_path)
 
@@ -386,6 +446,15 @@ class AzureBlobStore(AbstractStore):
         # No account key raises → `az` CLI login state may still work.
         from skypilot_tpu.data import object_rest
         return object_rest.AzureBlobClient()
+
+    def list_objects(self, prefix: str = '',
+                     limit: int = 100) -> List[str]:
+        full = (f'{self.sub_path}/{prefix}'.lstrip('/')
+                if self.sub_path else prefix)
+        return self._strip_sub(
+            self._rest_or_error().list_blobs(
+                self.container, prefix=full, max_results=limit),
+            self.sub_path)
 
     def exists(self) -> bool:
         client = self._rest()
@@ -630,6 +699,11 @@ class Storage:
         state.remove_storage(self.name)
 
     # ---- cluster-side ----
+
+    def list_objects(self, prefix: str = '',
+                     limit: int = 100) -> List[str]:
+        return self.primary_store().list_objects(prefix=prefix,
+                                                 limit=limit)
 
     def primary_store(self) -> AbstractStore:
         if not self.stores:

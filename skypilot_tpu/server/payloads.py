@@ -119,6 +119,9 @@ _VERBS: Dict[str, Callable[[Dict[str, Any]],
                                gpus_only=False),
     'storage.ls': _core_verb('storage_ls'),
     'storage.delete': _core_verb('storage_delete', 'storage_name'),
+    'storage.ls_objects': _core_verb('storage_ls_objects',
+                                     'storage_name', prefix='',
+                                     limit=100),
 }
 
 

@@ -266,3 +266,27 @@ def test_sts_transfer_job_body():
     assert body['transferSpec']['awsS3DataSource']['bucketName'] == 'sbkt'
     assert body['transferSpec']['gcsDataSink']['bucketName'] == 'gbkt'
     assert body['projectId'] == 'proj'
+
+
+def test_list_objects_subpath_namespace_round_trip():
+    """Sub-path stores ('bucket/sub') list with the sub applied to the
+    REQUEST prefix and stripped from the RETURNED keys, so a listed key
+    pasted back into --prefix round-trips (code-review r5)."""
+    from skypilot_tpu.data import storage as storage_lib
+
+    class FakeS3Client:
+        def __init__(self):
+            self.calls = []
+
+        def list_objects(self, bucket, prefix='', max_keys=None):
+            self.calls.append((bucket, prefix, max_keys))
+            return [f'{prefix}data/x.csv', f'{prefix}data/y.csv']
+
+    store = storage_lib.S3Store('shared-bucket/team-a')
+    store.rest_client = FakeS3Client()
+    keys = store.list_objects(prefix='data/', limit=2)
+    assert store.rest_client.calls == [
+        ('shared-bucket', 'team-a/data/', 2)]
+    # The fake echoes the request prefix into its keys; stripping the
+    # 'team-a/' sub leaves them in the user's namespace.
+    assert keys == ['data/data/x.csv', 'data/data/y.csv']
