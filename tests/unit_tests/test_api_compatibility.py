@@ -148,7 +148,7 @@ def test_verb_surface_is_append_only():
         'workspaces.remove_member', 'workspaces.get_config',
         'workspaces.set_config',
         'users.token_create', 'users.token_list', 'users.token_revoke',
-        'ssh.up', 'ssh.down',
+        'ssh.up', 'ssh.down', 'storage.ls_objects',
     }
     known = {v for v in pinned if payloads.known_verb(v)}
     missing = pinned - known
