@@ -30,6 +30,7 @@ NAV = [
     ('finetuning.md', 'Fine-tuning'),
     ('serving.md', 'Serving'),
     ('jobs.md', 'Managed jobs'),
+    ('robustness.md', 'Robustness'),
     ('storage.md', 'Storage'),
     ('clouds.md', 'Clouds'),
     ('server.md', 'API server'),

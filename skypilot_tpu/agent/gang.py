@@ -25,7 +25,9 @@ from typing import Dict, List, Optional, Sequence
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.utils import chaos
 from skypilot_tpu.utils import command_runner as runner_lib
+from skypilot_tpu.utils import resilience
 
 logger = sky_logging.init_logger(__name__)
 
@@ -231,7 +233,15 @@ def gang_launch(runners: Sequence[runner_lib.CommandRunner],
 
     def _start(rank: int) -> subprocess.Popen:
         log_path = os.path.join(log_dir, f'host-{rank}.log')
-        p = runners[rank].run_async(command, env=host_envs[rank],
+        # Chaos point: a rule may raise (start failure) or carry a
+        # `returncode` — the host's launcher then exits with that code
+        # without running the job, indistinguishable from an ssh
+        # transport drop (rc 255 exercises the fan-out retry below).
+        rule = chaos.inject('gang.host_start', rank=rank)
+        cmd = command
+        if rule is not None and rule.get('returncode') is not None:
+            cmd = f'exit {int(rule["returncode"])}'
+        p = runners[rank].run_async(cmd, env=host_envs[rank],
                                     log_path=log_path, cwd=cwd)
         ACTIVE_PROCS.append(p)
         return p
@@ -273,10 +283,37 @@ def gang_launch(runners: Sequence[runner_lib.CommandRunner],
                        for rc in returncodes])
 
 
+def _chaos_mid_run_exit(procs, returncodes) -> None:
+    """`gang.mid_run_exit` chaos point: kill one live host's process
+    tree mid-run (rule may pin `rank`), simulating a worker dying on a
+    flaky host — the gang barrier must then take everyone down."""
+    try:
+        rule = chaos.inject('gang.mid_run_exit')
+    except Exception as e:  # pylint: disable=broad-except
+        # A rule configured with `error` would otherwise abort the poll
+        # loop and orphan every live host process — for this point the
+        # fault *is* the kill below, so demote a raise to a fire.
+        logger.warning(f'gang.mid_run_exit chaos rule raised ({e}); '
+                       'treating as a plain fire.')
+        rule = {}
+    if rule is None:
+        return
+    victim = rule.get('rank')
+    if victim is None:
+        alive = [i for i, rc in enumerate(returncodes)
+                 if rc is None and procs[i].poll() is None]
+        victim = alive[0] if alive else None
+    if victim is not None and 0 <= victim < len(returncodes) and \
+            returncodes[victim] is None:
+        logger.warning(f'Host {victim}: chaos mid-run kill.')
+        _kill_tree(procs[victim], sig_kill=True)
+
+
 def _poll_gang(procs, returncodes, retried, _start, start_time, deadline,
                poll_interval_s) -> None:
     while True:
         now = time.time()
+        _chaos_mid_run_exit(procs, returncodes)
         for i, p in enumerate(procs):
             if returncodes[i] is not None:
                 continue
@@ -327,4 +364,4 @@ def _poll_gang(procs, returncodes, retried, _start, start_time, deadline,
             returncodes[:] = [rc if rc is not None else -15
                               for rc in returncodes]
             break
-        time.sleep(poll_interval_s)
+        resilience.sleep(poll_interval_s)

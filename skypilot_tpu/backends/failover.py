@@ -27,10 +27,18 @@ from skypilot_tpu import optimizer as optimizer_lib
 from skypilot_tpu import provision as provision_lib
 from skypilot_tpu import resources as resources_lib
 from skypilot_tpu import sky_logging
+from skypilot_tpu import state as state_lib
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.utils import chaos
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import resilience
 
 logger = sky_logging.init_logger(__name__)
+
+# Retry-until-up runs for hours under a capacity drought; the history
+# keeps the newest window (total_failures keeps the true count).
+_MAX_FAILOVER_HISTORY = 50
 
 
 @dataclasses.dataclass
@@ -58,6 +66,9 @@ class RetryingProvisioner:
         self._max_sku_retries = max_sku_retries
         self.blocked: List[resources_lib.Resources] = []
         self.failover_history: List[Exception] = []
+        # Total failures ever recorded (history itself is bounded).
+        self.total_failures = 0
+        self._first_failure_ts: Optional[float] = None
         # Called with (concrete_resources, provision_config) right before
         # each cloud attempt — lets the backend record a provisional
         # cluster handle so a kill/crash mid-provision still leaves
@@ -98,6 +109,32 @@ class RetryingProvisioner:
                 self.failover_history)
 
     # ---- internals ----
+
+    def _record_failure(self, e: Exception, block_scope: str) -> None:
+        """Bounded history append + one journal row per failed attempt."""
+        if self._first_failure_ts is None:
+            self._first_failure_ts = time.time()
+        self.total_failures += 1
+        self.failover_history.append(e)
+        if len(self.failover_history) > _MAX_FAILOVER_HISTORY:
+            del self.failover_history[:-_MAX_FAILOVER_HISTORY]
+        state_lib.record_recovery_event(
+            'failover.blocked',
+            scope=f'cluster/{self._cluster_name}',
+            cause=type(e).__name__,
+            detail={'block_scope': block_scope, 'error': str(e)[:500]})
+
+    def _record_success(self) -> None:
+        """Provisioned after at least one failure: journal the latency
+        from the first failed attempt to success."""
+        if self._first_failure_ts is None:
+            return
+        state_lib.record_recovery_event(
+            'failover.recovered',
+            scope=f'cluster/{self._cluster_name}',
+            cause=f'{self.total_failures} failed attempts',
+            latency_s=time.time() - self._first_failure_ts)
+        self._first_failure_ts = None
 
     def _block(self, resources: resources_lib.Resources,
                zone: Optional[str], region: Optional[str],
@@ -177,6 +214,9 @@ class RetryingProvisioner:
                     resources.copy(region=region, zone=zone), config)
             record = provision_lib.run_instances(provider, region, zone,
                                                  self._cluster_name, config)
+            chaos.inject('failover.wait_instances',
+                         cluster_name=self._cluster_name, zone=zone or '',
+                         region=region)
             provision_lib.wait_instances(provider, region,
                                          self._cluster_name, 'RUNNING',
                                          provider_config=provider_config)
@@ -188,34 +228,38 @@ class RetryingProvisioner:
                 provision_lib.open_ports(provider, self._cluster_name,
                                          resources.ports,
                                          config.provider_config)
+            chaos.inject('failover.get_cluster_info',
+                         cluster_name=self._cluster_name, zone=zone or '',
+                         region=record.region)
             info = provision_lib.get_cluster_info(provider, record.region,
                                                   self._cluster_name,
                                                   config.provider_config)
             concrete = resources.copy(region=record.region,
                                       zone=record.zone)
+            self._record_success()
             return ProvisionResult(concrete, record, info, self._num_nodes)
         except exceptions.InvalidRequestError as e:
-            self.failover_history.append(e)
+            self._record_failure(e, block_scope='none (no failover)')
             raise exceptions.ResourcesUnavailableError(
                 f'Invalid request for {resources}: {e}',
                 no_failover=True,
                 failover_history=self.failover_history) from e
         except (exceptions.CapacityError,
                 exceptions.QueuedResourceTimeoutError) as e:
-            self.failover_history.append(e)
+            self._record_failure(e, block_scope=f'zone:{zone}')
             logger.info(f'  Capacity error in {zone}: {e}')
             self._block(resources, zone=zone, region=None)
         except exceptions.QuotaExceededError as e:
-            self.failover_history.append(e)
+            self._record_failure(e, block_scope=f'region:{region}')
             logger.info(f'  Quota exceeded in {region}: {e}')
             self._block(resources, zone=None, region=region)
         except exceptions.PermissionError_ as e:
-            self.failover_history.append(e)
+            self._record_failure(e, block_scope=f'cloud:{cloud}')
             logger.info(f'  Permission error on {cloud}: {e}')
             self._block(resources, zone=None, region=None, whole_cloud=True)
         except exceptions.ProvisionError as e:
             # Unclassified provisioning failure: treat as capacity-scoped.
-            self.failover_history.append(e)
+            self._record_failure(e, block_scope=f'zone:{zone}')
             self._block(resources, zone=zone, region=None)
         return None
 
@@ -224,9 +268,19 @@ def provision_with_retry_until_up(
         provisioner: RetryingProvisioner,
         retry_until_up: bool = False,
         retry_interval_s: float = 30.0,
-        max_total_retries: int = 10**6) -> ProvisionResult:
-    """Optionally loop forever (jobs-controller recovery uses this)."""
+        max_total_retries: int = 10**6,
+        deadline: Optional[resilience.Deadline] = None) -> ProvisionResult:
+    """Optionally loop until capacity appears (jobs recovery uses this).
+
+    The wait between whole-catalog sweeps is `retry_interval_s` with
+    ±20% jitter, so a preemption storm's worth of recovering controllers
+    doesn't hammer the provider APIs in lockstep. An optional
+    :class:`resilience.Deadline` bounds the total budget.
+    """
     attempt = 0
+    deadline = deadline or resilience.Deadline.unlimited()
+    backoff = common_utils.Backoff(initial=retry_interval_s, factor=1.0,
+                                   cap=retry_interval_s, jitter=0.2)
     while True:
         attempt += 1
         try:
@@ -243,7 +297,11 @@ def provision_with_retry_until_up(
                 raise
             if not retry_until_up or attempt >= max_total_retries:
                 raise
-            logger.info(f'Retrying in {retry_interval_s}s '
-                        f'(attempt {attempt})...')
+            wait_s = backoff.current_backoff()
+            logger.info(f'Retrying in {wait_s:.1f}s (attempt {attempt})...')
             provisioner.blocked.clear()
-            time.sleep(retry_interval_s)
+            # A whole-catalog sweep can take minutes: do not start one
+            # past the deadline even if the (truncated) sleep succeeded.
+            if not resilience.sleep(wait_s, deadline=deadline) or \
+                    deadline.expired:
+                raise

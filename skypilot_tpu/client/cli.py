@@ -344,6 +344,42 @@ def hosts(cluster):
             h['external_ip'] or '-', h['status']))
 
 
+@cli.command()
+@click.option('--scope', default=None,
+              help='Filter by scope path prefix (e.g. job/3, '
+                   'cluster/my-train, service/svc, chaos).')
+@click.option('--type', 'event_type', default=None,
+              help='Filter by event type (e.g. job.recovered, '
+                   'failover.blocked, chaos.injected).')
+@click.option('--limit', '-n', type=int, default=50,
+              help='Newest N events (shown oldest-first).')
+def events(scope, event_type, limit):
+    """Show the recovery-event journal (preemption→recovery timeline).
+
+    Every fault and recovery — failover blocks, managed-job preemptions
+    and relaunches, serve replica churn, injected chaos — lands here
+    with its scope, cause, and recovery latency.
+    """
+    import datetime
+
+    from skypilot_tpu import state as state_lib
+    rows = state_lib.get_recovery_events(scope=scope,
+                                         event_type=event_type,
+                                         limit=limit)
+    if not rows:
+        click.echo('No recovery events recorded.')
+        return
+    fmt = '{:<19} {:<22} {:<34} {:<24} {:>9}'
+    click.echo(fmt.format('TIME', 'EVENT', 'SCOPE', 'CAUSE', 'LATENCY'))
+    for r in rows:
+        ts = datetime.datetime.fromtimestamp(
+            r['ts']).strftime('%Y-%m-%d %H:%M:%S')
+        latency = (f'{r["latency_s"]:.2f}s'
+                   if r['latency_s'] is not None else '-')
+        click.echo(fmt.format(ts, r['event_type'][:22], r['scope'][:34],
+                              (r['cause'] or '-')[:24], latency))
+
+
 class _SSHGroup(click.Group):
     """`xsky ssh CLUSTER [CMD...]` keeps working next to the node-pool
     subcommands: an unknown first token routes to `connect`."""

@@ -16,17 +16,21 @@ from typing import Any, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
+from skypilot_tpu import state as global_state
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.agent import job_lib as cluster_job_lib
 from skypilot_tpu.jobs import recovery as recovery_lib
 from skypilot_tpu.jobs import scheduler
 from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.utils import chaos
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import resilience
 
 logger = sky_logging.init_logger(__name__)
 
 POLL_INTERVAL_S = float(os.environ.get('XSKY_JOBS_POLL_INTERVAL', '2.0'))
-# Consecutive failed probes (with the cloud still reporting the cluster
-# alive) tolerated before declaring the cluster lost.
+# Failed probes (with the cloud still reporting the cluster alive)
+# tolerated within one poll cycle before declaring the cluster lost.
 _MAX_PROBE_FAILURES = 3
 
 
@@ -62,8 +66,36 @@ class JobsController:
 
     def _job_status(self, handle: Any,
                     job_id: int) -> Optional[cluster_job_lib.JobStatus]:
+        """One probe cycle: bounded retries with jittered backoff.
+
+        Probe failures cross an SSH hop and are not usefully typed, so
+        — matching the seed's consecutive-failure counter — ANY failure
+        (SSH hiccup, busy sqlite, injected fault) is retried up to
+        ``_MAX_PROBE_FAILURES`` times while the cloud still reports the
+        cluster alive (the ``give_up`` check — the twin of the
+        reference's retry loop, recovery_strategy.py:174). Returns None
+        when the budget is spent or the cluster is gone: the caller
+        treats that as the cluster being lost.
+        """
+
+        def probe() -> cluster_job_lib.JobStatus:
+            chaos.inject('jobs.status_probe', job_id=self.job_id)
+            status = self.strategy.backend.get_job_status(handle, job_id)
+            if status is None:
+                raise resilience.TransientError(
+                    'status probe returned nothing')
+            return status
+
         try:
-            return self.strategy.backend.get_job_status(handle, job_id)
+            return resilience.retry_transient(
+                probe,
+                max_attempts=_MAX_PROBE_FAILURES,
+                transient=(Exception,),
+                backoff=common_utils.Backoff(initial=POLL_INTERVAL_S,
+                                             factor=1.0,
+                                             cap=POLL_INTERVAL_S,
+                                             jitter=0.2),
+                give_up=lambda: not self._cluster_alive())
         except Exception:  # pylint: disable=broad-except
             return None
 
@@ -132,9 +164,8 @@ class JobsController:
         # long-lived job may outlive.
         jobs_state.reset_controller_respawns(self.job_id)
 
-        probe_failures = 0
         while True:
-            time.sleep(POLL_INTERVAL_S)
+            resilience.sleep(POLL_INTERVAL_S)
             status = self._job_status(handle, cluster_job_id)
 
             if status is not None and status.is_terminal():
@@ -149,9 +180,14 @@ class JobsController:
                     logger.info(f'Job failed ({status}); restarting '
                                 f'({self.strategy.restart_count_on_errors}'
                                 f'/{self.strategy.max_restarts_on_errors})')
+                    restart_start = time.time()
                     handle, cluster_job_id = self._recover()
                     if handle is None:
                         return False
+                    global_state.record_recovery_event(
+                        'job.restarted', scope=f'job/{self.job_id}',
+                        cause=f'cluster job status {status.value}',
+                        latency_s=time.time() - restart_start)
                     continue
                 jobs_state.set_status(
                     self.job_id, jobs_state.ManagedJobStatus.FAILED,
@@ -159,28 +195,30 @@ class JobsController:
                 return False
 
             if status is not None:
-                probe_failures = 0
                 continue
 
-            # Status probe failed: could be transient (SSH hiccup, busy
-            # sqlite). Tolerate a few consecutive failures while the
-            # cloud still reports the cluster alive (twin of the
-            # reference's retry loop, recovery_strategy.py:174).
-            probe_failures += 1
-            if probe_failures < _MAX_PROBE_FAILURES and \
-                    self._cluster_alive():
-                continue
-
-            # Cluster unreachable or gone from cloud: preemption.
+            # Probe budget spent (or cluster gone from cloud): the
+            # cluster is lost — preemption or infra failure.
             logger.info(f'Cluster {self.cluster_name} lost; '
                         'recovering...')
-            probe_failures = 0
+            lost_at = time.time()
+            global_state.record_recovery_event(
+                'job.preempted', scope=f'job/{self.job_id}',
+                cause='cluster lost (probe budget spent or gone '
+                      'from cloud)',
+                detail={'cluster': self.cluster_name,
+                        'task': getattr(self.task, 'name', None) or ''})
             jobs_state.set_status(
                 self.job_id, jobs_state.ManagedJobStatus.RECOVERING)
             jobs_state.bump_recovery_count(self.job_id)
             handle, cluster_job_id = self._recover()
             if handle is None:
                 return False
+            global_state.record_recovery_event(
+                'job.recovered', scope=f'job/{self.job_id}',
+                cause='relaunched after cluster loss',
+                latency_s=time.time() - lost_at,
+                detail={'cluster': self.cluster_name})
             jobs_state.set_status(
                 self.job_id, jobs_state.ManagedJobStatus.RUNNING)
 
@@ -205,16 +243,14 @@ class JobsController:
             scheduler.launch_done(self.job_id)
 
     def _current_handle(self):
-        from skypilot_tpu import state as state_lib
-        record = state_lib.get_cluster_from_name(self.cluster_name)
+        record = global_state.get_cluster_from_name(self.cluster_name)
         return record['handle'] if record else None
 
     def _cleanup(self) -> None:
         """Archive the task log, then tear down the task cluster
         (twin of controller.py:573; the reference syncs managed-job
         logs to the controller before teardown too)."""
-        from skypilot_tpu import state as state_lib
-        record = state_lib.get_cluster_from_name(self.cluster_name)
+        record = global_state.get_cluster_from_name(self.cluster_name)
         if record is not None and record['handle'] is not None:
             self._archive_task_log(record['handle'])
             try:

@@ -85,7 +85,7 @@ class StrategyExecutor:
         pre-seeded into the failover blocklist when a strategy asks.
         """
         from skypilot_tpu import state as state_lib
-        # Clean any half-dead cluster record.
+        # Clean any half-dead cluster leftovers.
         record = state_lib.get_cluster_from_name(self.cluster_name)
         if record is not None and record['handle'] is not None:
             try:
@@ -93,7 +93,12 @@ class StrategyExecutor:
                                       purge=True)
             except Exception as e:  # pylint: disable=broad-except
                 logger.warning(f'Teardown before recovery failed: {e}')
-                state_lib.remove_cluster(self.cluster_name, terminate=True)
+        # Reconcile unconditionally, not only when teardown raised: a
+        # partially-failed teardown can swallow its error downstream yet
+        # leave the record (or a handle-less stub) behind, and a stale
+        # half-dead record would shadow the relaunch.
+        if state_lib.get_cluster_from_name(self.cluster_name) is not None:
+            state_lib.remove_cluster(self.cluster_name, terminate=True)
         return self.launch(retry_until_up=True, blocked=blocked)
 
     def should_restart_on_failure(self) -> bool:

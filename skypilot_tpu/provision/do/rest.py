@@ -11,18 +11,21 @@ from __future__ import annotations
 
 import json
 import os
-import time
 import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Any, Dict, Optional
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import resilience
 
 API_ENDPOINT = 'https://api.digitalocean.com'
 CREDENTIALS_PATH = '~/.config/doctl/config.yaml'
 _MAX_ATTEMPTS = 4
 _BACKOFF_S = 2.0
+# Total wall-clock budget for one call() including 429 retries.
+_RETRY_BUDGET_S = 60.0
 
 
 class DoApiError(Exception):
@@ -85,7 +88,8 @@ class Transport:
         if query:
             url += '?' + urllib.parse.urlencode(query)
         data = json.dumps(body).encode() if body is not None else None
-        for attempt in range(_MAX_ATTEMPTS):
+
+        def attempt() -> Dict[str, Any]:
             req = urllib.request.Request(
                 url, data=data, method=method,
                 headers={'Authorization': f'Bearer {self._token}',
@@ -95,9 +99,9 @@ class Transport:
                     payload = resp.read()
                     return json.loads(payload) if payload else {}
             except urllib.error.HTTPError as e:
-                if e.code == 429 and attempt < _MAX_ATTEMPTS - 1:
-                    time.sleep(_BACKOFF_S * (attempt + 1))
-                    continue
+                if e.code == 429:
+                    raise resilience.TransientError(
+                        f'DO rate limited: {e}') from e
                 try:
                     err = json.loads(e.read() or b'{}')
                     raise DoApiError(e.code, err.get('id', ''),
@@ -107,7 +111,19 @@ class Transport:
             except urllib.error.URLError as e:
                 raise exceptions.ProvisionError(
                     f'DO API unreachable: {e}') from e
-        # Unreachable: every iteration returns or raises.
+
+        try:
+            return resilience.retry_transient(
+                attempt,
+                max_attempts=_MAX_ATTEMPTS,
+                transient=(resilience.TransientError,),
+                backoff=common_utils.Backoff(initial=_BACKOFF_S,
+                                             factor=1.6, cap=16.0,
+                                             jitter=0.2),
+                deadline=resilience.Deadline(_RETRY_BUDGET_S))
+        except resilience.TransientError as e:
+            raise exceptions.ProvisionError(
+                f'DO API rate limit persisted: {e}') from e
 
     def paged(self, path: str, key: str,
               query: Optional[Dict[str, Any]] = None) -> list:

@@ -32,6 +32,7 @@ import filelock
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.provision import common
+from skypilot_tpu.utils import chaos
 
 _local = threading.RLock()
 
@@ -280,6 +281,14 @@ def opened_ports(cluster_name: str) -> List[str]:
 
 def query_instances(cluster_name: str, provider_config: Dict[str, Any]
                     ) -> Dict[str, Optional[str]]:
+    # Runtime chaos: the `fake.preempt` point makes the instances vanish
+    # out-of-band on the Nth status query — exactly preempt_cluster(),
+    # but driven deterministically from an XSKY_CHAOS_PLAN instead of a
+    # test calling in. This is the fake cloud acting as a chaotic
+    # provider, so recovery paths can be exercised end-to-end.
+    if chaos.inject('fake.preempt', cluster_name=cluster_name) is not None:
+        terminate_instances(cluster_name, provider_config)
+        return {}
     cluster = _load()['clusters'].get(cluster_name)
     if cluster is None:
         return {}

@@ -10,17 +10,20 @@ from __future__ import annotations
 
 import json
 import os
-import time
 import urllib.error
 import urllib.request
 from typing import Any, Dict, Optional
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import resilience
 
 API_ENDPOINT = 'https://cloud.lambdalabs.com/api/v1'
 CREDENTIALS_PATH = '~/.lambda_cloud/lambda_keys'
 _MAX_ATTEMPTS = 4
 _BACKOFF_S = 2.0
+# Total wall-clock budget for one call() including 429 retries.
+_RETRY_BUDGET_S = 60.0
 
 
 class LambdaApiError(Exception):
@@ -84,7 +87,8 @@ class Transport:
              body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         url = f'{API_ENDPOINT}{path}'
         data = json.dumps(body).encode() if body is not None else None
-        for attempt in range(_MAX_ATTEMPTS):
+
+        def attempt() -> Dict[str, Any]:
             req = urllib.request.Request(
                 url, data=data, method=method,
                 headers={'Authorization': f'Bearer {self._key}',
@@ -93,10 +97,10 @@ class Transport:
                 with urllib.request.urlopen(req, timeout=60) as resp:
                     return json.loads(resp.read() or b'{}')
             except urllib.error.HTTPError as e:
-                if e.code == 429 and attempt < _MAX_ATTEMPTS - 1:
+                if e.code == 429:
                     # Launch calls are rate limited ~1/10s: back off.
-                    time.sleep(_BACKOFF_S * (attempt + 1))
-                    continue
+                    raise resilience.TransientError(
+                        f'Lambda rate limited: {e}') from e
                 try:
                     payload = json.loads(e.read() or b'{}')
                     err = payload.get('error', {})
@@ -107,4 +111,16 @@ class Transport:
             except urllib.error.URLError as e:
                 raise exceptions.ProvisionError(
                     f'Lambda API unreachable: {e}') from e
-        raise exceptions.ProvisionError('Lambda API rate limit persisted.')
+
+        try:
+            return resilience.retry_transient(
+                attempt,
+                max_attempts=_MAX_ATTEMPTS,
+                transient=(resilience.TransientError,),
+                backoff=common_utils.Backoff(initial=_BACKOFF_S,
+                                             factor=1.6, cap=16.0,
+                                             jitter=0.2),
+                deadline=resilience.Deadline(_RETRY_BUDGET_S))
+        except resilience.TransientError as e:
+            raise exceptions.ProvisionError(
+                f'Lambda API rate limit persisted: {e}') from e

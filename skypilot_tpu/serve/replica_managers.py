@@ -7,18 +7,31 @@ HTTP, and detects preempted replicas via cloud-truth status refresh.
 from __future__ import annotations
 
 import concurrent.futures
+import os
 import socket
 import threading
+import time
 import urllib.request
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
+from skypilot_tpu import state as global_state
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.serve import state as serve_state
+from skypilot_tpu.utils import chaos
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import resilience
 
 logger = sky_logging.init_logger(__name__)
+
+# Readiness probe RETRIES per replica per tick, on top of the initial
+# probe (a single dropped HTTP request must not flap READY →
+# NOT_READY). "0 retries" still means one probe.
+_PROBE_ATTEMPTS = 1 + max(
+    0, int(os.environ.get('XSKY_SERVE_PROBE_RETRIES', '1')))
+_PROBE_TIMEOUT_S = float(os.environ.get('XSKY_SERVE_PROBE_TIMEOUT', '5'))
 
 
 def _free_port() -> int:
@@ -58,6 +71,9 @@ class ReplicaManager:
         from skypilot_tpu.serve import spot_placer as spot_placer_lib
         self.spot_placer = spot_placer_lib.DynamicFallbackSpotPlacer([])
         self._replica_zone: Dict[int, str] = {}
+        # Preemption-detection timestamps: journal recovery latency when
+        # the replacement launches.
+        self._preempted_at: Dict[int, float] = {}
 
     # ---- scaling ----
 
@@ -288,6 +304,14 @@ class ReplicaManager:
                 zone = self._replica_zone.get(r['replica_id'])
                 if zone:
                     self.spot_placer.handle_preemption(zone)
+                self._preempted_at[r['replica_id']] = time.time()
+                global_state.record_recovery_event(
+                    'replica.preempted',
+                    scope=(f'service/{self.service_name}/replica/'
+                           f'{r["replica_id"]}'),
+                    cause='cluster gone from cloud',
+                    detail={'cluster': r['cluster_name'],
+                            'zone': zone or ''})
                 serve_state.upsert_replica(
                     self.service_name, r['replica_id'],
                     r['cluster_name'],
@@ -307,9 +331,24 @@ class ReplicaManager:
 
     def _probe(self, endpoint: str) -> bool:
         url = f'http://{endpoint}{self.spec.readiness_path}'
+
+        def attempt() -> bool:
+            chaos.inject('serve.probe', service=self.service_name,
+                         endpoint=endpoint)
+            with urllib.request.urlopen(
+                    url, timeout=_PROBE_TIMEOUT_S) as resp:
+                if not 200 <= resp.status < 400:
+                    raise resilience.TransientError(
+                        f'readiness returned {resp.status}')
+                return True
+
         try:
-            with urllib.request.urlopen(url, timeout=5) as resp:
-                return 200 <= resp.status < 400
+            return resilience.retry_transient(
+                attempt,
+                max_attempts=_PROBE_ATTEMPTS,
+                transient=(Exception,),
+                backoff=common_utils.Backoff(initial=0.2, cap=1.0,
+                                             jitter=0.2))
         except Exception:  # pylint: disable=broad-except
             return False
 
@@ -347,8 +386,26 @@ class ReplicaManager:
     def recover_preempted(self) -> None:
         """Replace PREEMPTED replicas (spot recovery for serving)."""
         with self._lock:
-            for r in self.replicas():
+            live = self.replicas()
+            # Replicas that left by another path (scale-down, version
+            # reconcile) must not leak detection timestamps — a reused
+            # replica id would report a bogus multi-hour latency.
+            live_ids = {r['replica_id'] for r in live}
+            for rid in list(self._preempted_at):
+                if rid not in live_ids:
+                    del self._preempted_at[rid]
+            for r in live:
                 if r['status'] == serve_state.ReplicaStatus.PREEMPTED:
                     serve_state.remove_replica(self.service_name,
                                                r['replica_id'])
-                    self._start_replica(spot=r['spot'])
+                    new_id = self._start_replica(spot=r['spot'])
+                    preempted_at = self._preempted_at.pop(
+                        r['replica_id'], None)
+                    global_state.record_recovery_event(
+                        'replica.relaunched',
+                        scope=(f'service/{self.service_name}/replica/'
+                               f'{r["replica_id"]}'),
+                        cause='preemption',
+                        latency_s=(time.time() - preempted_at
+                                   if preempted_at is not None else None),
+                        detail={'replacement_replica': new_id})

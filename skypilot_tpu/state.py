@@ -130,6 +130,17 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             workspace TEXT PRIMARY KEY,
             config_json TEXT
         );
+        CREATE TABLE IF NOT EXISTS recovery_events (
+            event_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            ts REAL,
+            event_type TEXT,
+            scope TEXT,
+            cause TEXT,
+            latency_s REAL,
+            detail TEXT
+        );
+        CREATE INDEX IF NOT EXISTS idx_recovery_events_scope
+            ON recovery_events (scope);
     """)
     # Migration for pre-workspace DBs: clusters gain a workspace column.
     for migration in (
@@ -369,6 +380,110 @@ def update_last_use(cluster_name: str) -> None:
         conn.execute('UPDATE clusters SET last_use=? WHERE name=?',
                      (str(int(time.time())), cluster_name))
         conn.commit()
+
+
+# ---- recovery-event journal ------------------------------------------------
+# Structured timeline of faults and recoveries (failover blocks, managed-job
+# preemption/recovery, serve replica churn, injected chaos), written by every
+# recovery path and surfaced via `xsky events` — the preemption→recovery
+# story becomes inspectable instead of buried in controller logs.
+
+# Newest rows kept (pruned lazily every 256 inserts).
+_MAX_RECOVERY_EVENTS = 20000
+# Process-local insert count gating the lazy prune; cursor.lastrowid
+# can't gate it — psycopg2 reports 0 for ordinary-table inserts.
+_recovery_event_inserts = 0
+
+
+def record_recovery_event(event_type: str,
+                          scope: str,
+                          cause: Optional[str] = None,
+                          latency_s: Optional[float] = None,
+                          detail: Optional[Dict[str, Any]] = None) -> None:
+    """Append one journal row. NEVER raises: the journal is
+    observability — a recovery path must not die because the state DB
+    hiccuped while it was busy recovering.
+
+    scope is a '/'-separated path (``job/3``, ``cluster/my-train``,
+    ``service/svc/replica/2``, ``chaos/<point>``) so callers can filter
+    by prefix.
+    """
+    global _recovery_event_inserts
+    try:
+        conn = _get_conn()
+    except Exception:  # pylint: disable=broad-except
+        return
+    try:
+        with _lock:
+            conn.execute(
+                'INSERT INTO recovery_events '
+                '(ts, event_type, scope, cause, latency_s, detail) '
+                'VALUES (?, ?, ?, ?, ?, ?)',
+                (time.time(), event_type, scope, cause, latency_s,
+                 json.dumps(detail) if detail is not None else None))
+            # Retention: a days-long capacity drought writes one row per
+            # failed attempt — keep the newest window, same rationale as
+            # the failover-history cap. Prune on the FIRST insert too:
+            # most writers (CLI, per-job controllers) are short-lived
+            # processes that would never reach the amortized gate.
+            _recovery_event_inserts += 1
+            if _recovery_event_inserts == 1 or \
+                    _recovery_event_inserts % 256 == 0:
+                conn.execute(
+                    'DELETE FROM recovery_events WHERE event_id <= '
+                    '(SELECT MAX(event_id) FROM recovery_events) - ?',
+                    (_MAX_RECOVERY_EVENTS,))
+            conn.commit()
+    except Exception:  # pylint: disable=broad-except
+        # Never raise — but also never leave the (possibly shared
+        # postgres) connection in an aborted transaction that would
+        # poison the next state call.
+        try:
+            conn.rollback()
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+def get_recovery_events(scope: Optional[str] = None,
+                        event_type: Optional[str] = None,
+                        limit: int = 200) -> List[Dict[str, Any]]:
+    """Newest `limit` events, oldest-first (a readable timeline).
+    `scope` matches exactly or as a path prefix."""
+    conn = _get_conn()
+    conds, args = [], []
+    if scope is not None:
+        # Escape LIKE metacharacters: a cluster named my_train must not
+        # match my-train's events via the `_` wildcard.
+        prefix = (scope.rstrip('/').replace('\\', '\\\\')
+                  .replace('%', '\\%').replace('_', '\\_'))
+        conds.append("(scope = ? OR scope LIKE ? ESCAPE '\\')")
+        args += [scope, prefix + '/%']
+    if event_type is not None:
+        conds.append('event_type = ?')
+        args.append(event_type)
+    query = ('SELECT ts, event_type, scope, cause, latency_s, detail '
+             'FROM recovery_events')
+    if conds:
+        query += ' WHERE ' + ' AND '.join(conds)
+    query += ' ORDER BY event_id DESC LIMIT ?'
+    args.append(int(limit))
+    with _lock:
+        rows = conn.execute(query, args).fetchall()
+    out = []
+    for ts, etype, escope, cause, latency, detail in reversed(rows):
+        try:
+            parsed = json.loads(detail) if detail else None
+        except ValueError:
+            parsed = None
+        out.append({
+            'ts': ts,
+            'event_type': etype,
+            'scope': escope,
+            'cause': cause,
+            'latency_s': latency,
+            'detail': parsed,
+        })
+    return out
 
 
 # ---- storage --------------------------------------------------------------

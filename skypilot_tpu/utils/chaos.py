@@ -1,0 +1,290 @@
+"""Runtime fault injection: named chaos points on recovery hot paths.
+
+The fake cloud's provision-time ``FailureInjector`` scripts *provisioning*
+failures; this module covers everything after bring-up — SSH transport,
+gang fan-out, status probes, serve readiness probes — so the recovery
+machinery (jobs controller, gang retry, serve replica recovery, failover
+engine) can be driven under fault deterministically.
+
+A *chaos point* is a named call site::
+
+    chaos.inject('jobs.status_probe', job_id=self.job_id)
+
+With no plan loaded the call is a no-op (one dict lookup; hit counters
+stay untouched, nothing allocates). A plan comes from ``XSKY_CHAOS_PLAN``
+— a JSON object, or a path to a JSON file (handy for subprocess trees:
+the env var is inherited by spawned controllers/job runners)::
+
+    {
+      "seed": 7,
+      "points": {
+        "gang.host_start":   {"first_n": 1, "returncode": 255},
+        "jobs.status_probe": {"skip_first": 2, "first_n": 3,
+                              "error": "TimeoutError", "latency_s": 0.05},
+        "runner.run":        {"probability": 0.05,
+                              "error": "ConnectionError"},
+        "failover.wait_instances": [{"every_kth": 3,
+                                     "error": "CapacityError"}]
+      }
+    }
+
+Each point maps to one rule or a list of rules (evaluated in order; the
+first rule whose selectors match fires). Hit numbers are 1-based and
+per-process.
+
+Selectors (ANDed within a rule):
+  ``probability``  fire with this probability (seeded RNG → deterministic)
+  ``first_n``      fire only on the first N eligible hits
+  ``every_kth``    fire when the eligible hit number is a multiple of K
+  ``skip_first``   the first N hits are never eligible
+  ``match``        ``{ctx_key: value}`` — only hits whose call-site
+                   context matches (e.g. ``{"rank": 0}``). Non-matching
+                   hits do not advance the rule's hit numbering, so
+                   ``{"match": {"rank": 1}, "first_n": 1}`` fires on
+                   rank 1's first traversal no matter how many other
+                   ranks hit the point before it.
+
+Actions (applied when a rule fires):
+  ``latency_s``    sleep this long before returning/raising
+  ``error``        raise this exception type (resolved from
+                   ``skypilot_tpu.exceptions``, then builtins; unknown
+                   names raise :class:`ChaosError`)
+  anything else    returned to the call site in the fired rule dict for
+                   site-specific handling (e.g. ``returncode`` makes the
+                   gang launcher start ``exit <rc>`` instead of the real
+                   command; ``fake.preempt`` terminates the cluster).
+
+Every fire is appended to the recovery-event journal
+(``state.record_recovery_event``) as ``chaos.injected`` with the point
+name as scope, so tests and ``xsky events`` can correlate injected
+faults with the recovery they triggered.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_plan: Optional['_Plan'] = None
+_plan_src: Optional[str] = None   # env value the cached plan was parsed from
+_direct = False                   # plan installed via load_plan(), not env
+_bad_src: Optional[str] = None    # env value that failed to parse
+
+
+class ChaosError(Exception):
+    """Injected failure whose rule names no (or an unknown) error type."""
+
+
+class ChaosPlanError(ValueError):
+    """XSKY_CHAOS_PLAN is not valid JSON / not readable."""
+
+
+def _resolve_error(name: str) -> type:
+    from skypilot_tpu import exceptions as exceptions_lib
+    cls = getattr(exceptions_lib, name, None)
+    if cls is None:
+        import builtins
+        cls = getattr(builtins, name, None)
+    if not (isinstance(cls, type) and issubclass(cls, BaseException)):
+        return ChaosError
+    return cls
+
+
+class _Plan:
+
+    def __init__(self, config: Dict[str, Any]) -> None:
+        points = config.get('points') or {}
+        self.rules: Dict[str, List[Dict[str, Any]]] = {
+            point: list(rule) if isinstance(rule, list) else [rule]
+            for point, rule in points.items()
+        }
+        self.rng = random.Random(config.get('seed'))
+        self._lock = threading.Lock()
+        self.hit_counts: Dict[str, int] = {}
+        self.fired_counts: Dict[str, int] = {}
+        # (point, rule index) → hits whose `match` selector passed; this
+        # is the hit number skip_first/first_n/every_kth count against.
+        self._rule_hits: Dict[Any, int] = {}
+
+    def fire(self, point: str, ctx: Dict[str, Any]
+             ) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            hit = self.hit_counts.get(point, 0) + 1
+            self.hit_counts[point] = hit
+            rule = None
+            for idx, r in enumerate(self.rules.get(point, ())):
+                m = r.get('match')
+                if m and any(ctx.get(k) != v for k, v in m.items()):
+                    continue
+                # Every matching rule's numbering advances on every
+                # matching hit, fired or not — rule order never warps
+                # another rule's skip_first/every_kth arithmetic.
+                rhit = self._rule_hits.get((point, idx), 0) + 1
+                self._rule_hits[(point, idx)] = rhit
+                if rule is None and self._selected(r, rhit):
+                    rule = r
+            if rule is not None:
+                self.fired_counts[point] = \
+                    self.fired_counts.get(point, 0) + 1
+        if rule is None:
+            return None
+        latency = rule.get('latency_s')
+        if latency:
+            time.sleep(float(latency))
+        _journal(point, rule, ctx)
+        error = rule.get('error')
+        if error:
+            raise _resolve_error(error)(
+                f'chaos: injected {error} at {point} (hit {hit})')
+        return dict(rule)
+
+    def _selected(self, rule: Dict[str, Any], hit: int) -> bool:
+        eligible = hit - int(rule.get('skip_first', 0))
+        if eligible < 1:
+            return False
+        if 'first_n' in rule and eligible > int(rule['first_n']):
+            return False
+        if 'every_kth' in rule and eligible % int(rule['every_kth']) != 0:
+            return False
+        if 'probability' in rule and \
+                self.rng.random() >= float(rule['probability']):
+            return False
+        return True
+
+
+def _journal(point: str, rule: Dict[str, Any],
+             ctx: Dict[str, Any]) -> None:
+    """Record the injected fault; never let observability kill the path."""
+    if rule.get('error'):
+        cause = rule['error']
+    elif 'returncode' in rule:
+        cause = f'returncode={rule["returncode"]}'
+    else:
+        cause = 'latency' if rule.get('latency_s') else 'fired'
+    try:
+        from skypilot_tpu import state
+        state.record_recovery_event(
+            'chaos.injected', scope=f'chaos/{point}', cause=cause,
+            detail={k: v for k, v in ctx.items()
+                    if isinstance(v, (str, int, float, bool))} or None)
+    except Exception:  # pylint: disable=broad-except
+        pass
+
+
+def _parse(src: str) -> '_Plan':
+    text = src.strip()
+    if not text.startswith('{'):
+        try:
+            with open(os.path.expanduser(text), encoding='utf-8') as f:
+                text = f.read()
+        except OSError as e:
+            raise ChaosPlanError(
+                f'XSKY_CHAOS_PLAN file unreadable: {e}') from e
+    try:
+        config = json.loads(text)
+    except ValueError as e:
+        raise ChaosPlanError(f'XSKY_CHAOS_PLAN is not valid JSON: {e}') \
+            from e
+    if not isinstance(config, dict):
+        raise ChaosPlanError('XSKY_CHAOS_PLAN must be a JSON object.')
+    return _Plan(config)
+
+
+def _current_plan() -> Optional['_Plan']:
+    global _plan, _plan_src, _bad_src
+    if _direct:
+        return _plan
+    src = os.environ.get('XSKY_CHAOS_PLAN')
+    if not src:
+        if _plan is not None:
+            with _lock:
+                if not _direct:
+                    _plan, _plan_src = None, None
+        return None
+    if src == _bad_src:
+        return None
+    if src != _plan_src:
+        with _lock:
+            if src != _plan_src and src != _bad_src and not _direct:
+                try:
+                    _plan = _parse(src)
+                    _plan_src = src
+                except ChaosPlanError as e:
+                    # A typo'd plan must never take down the recovery
+                    # paths it instruments: log once, run chaos-free.
+                    # (Counters stay empty, so a test driving a broken
+                    # plan still fails loudly on its hit assertions.)
+                    _bad_src = src
+                    _plan, _plan_src = None, None
+                    logger.error('Ignoring XSKY_CHAOS_PLAN: %s', e)
+    return _plan
+
+
+# ---- call-site API ---------------------------------------------------------
+
+
+def inject(point: str, **ctx: Any) -> Optional[Dict[str, Any]]:
+    """Evaluate the chaos point. Returns the fired rule dict (after
+    applying latency and raising any configured error), or None.
+
+    With no plan loaded this returns immediately without touching
+    counters — instrumented hot paths pay one env lookup.
+    """
+    plan = _current_plan()
+    if plan is None:
+        return None
+    return plan.fire(point, ctx)
+
+
+def enabled() -> bool:
+    return _current_plan() is not None
+
+
+# ---- test / observability API ---------------------------------------------
+
+
+def load_plan(config: Dict[str, Any]) -> None:
+    """Install a plan programmatically (in-process tests). Pair with
+    :func:`clear` — a directly-loaded plan shadows the env var."""
+    global _plan, _plan_src, _direct
+    with _lock:
+        _plan = _Plan(config)
+        _plan_src = None
+        _direct = True
+
+
+def clear() -> None:
+    """Drop any loaded plan and all counters."""
+    global _plan, _plan_src, _direct, _bad_src
+    with _lock:
+        _plan, _plan_src, _direct, _bad_src = None, None, False, None
+
+
+def counters() -> Dict[str, int]:
+    """Point → times the point was traversed (this process). Empty when
+    no plan is loaded — the zero-overhead-when-disabled assertion."""
+    plan = _current_plan()
+    if plan is None:
+        return {}
+    with plan._lock:  # pylint: disable=protected-access
+        return dict(plan.hit_counts)
+
+
+def fired() -> Dict[str, int]:
+    """Point → times a rule actually fired (this process)."""
+    plan = _current_plan()
+    if plan is None:
+        return {}
+    with plan._lock:  # pylint: disable=protected-access
+        return dict(plan.fired_counts)
+
+
+def hits(point: str) -> int:
+    return counters().get(point, 0)

@@ -7,6 +7,7 @@ in for SSH so multi-host logic runs hermetically.
 """
 from __future__ import annotations
 
+import functools
 import os
 import shlex
 import subprocess
@@ -14,8 +15,23 @@ import tempfile
 from typing import Dict, List, Optional, Tuple, Union
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import chaos
 
 logger = sky_logging.init_logger(__name__)
+
+
+def _chaos_instrumented(op: str, impl):
+    """Wrap a runner method with the `runner.<op>` chaos point (no-op
+    without a loaded plan)."""
+
+    @functools.wraps(impl)
+    def wrapper(self, *args, **kwargs):
+        chaos.inject(f'runner.{op}', runner=type(self).__name__,
+                     node=self.node_id)
+        return impl(self, *args, **kwargs)
+
+    wrapper._chaos_wrapped = True  # pylint: disable=protected-access
+    return wrapper
 
 SSH_COMMON_OPTS = [
     '-o', 'StrictHostKeyChecking=no',
@@ -82,6 +98,17 @@ class CommandRunner:
 
     def __init__(self, node_id: str) -> None:
         self.node_id = node_id
+
+    def __init_subclass__(cls, **kwargs) -> None:
+        # Every concrete runner's run/rsync is a chaos point
+        # (`runner.run` / `runner.rsync`) — including runners defined
+        # elsewhere — without each subclass remembering to instrument.
+        super().__init_subclass__(**kwargs)
+        for op in ('run', 'rsync'):
+            impl = cls.__dict__.get(op)
+            if impl is None or getattr(impl, '_chaos_wrapped', False):
+                continue
+            setattr(cls, op, _chaos_instrumented(op, impl))
 
     @staticmethod
     def _finish(proc, log_path, stream_logs, require_outputs):

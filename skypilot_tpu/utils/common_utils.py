@@ -5,6 +5,7 @@ import functools
 import getpass
 import hashlib
 import os
+import random
 import re
 import socket
 import time
@@ -141,15 +142,31 @@ def make_decorator(check_fn):
 
 
 class Backoff:
-    """Capped exponential backoff with jitter-free determinism for tests."""
+    """Capped exponential backoff, optionally jittered.
+
+    jitter=0 (the default) keeps the old fully-deterministic sequence;
+    jitter=j spreads each value uniformly over [v*(1-j), v*(1+j)] so
+    synchronized retriers (a preemption storm's worth of recovering
+    controllers) don't stampede in lockstep. Pass a seed to make the
+    jittered sequence deterministic too (tests).
+    """
 
     def __init__(self, initial: float = 1.0, factor: float = 1.6,
-                 cap: float = 30.0) -> None:
+                 cap: float = 30.0, jitter: float = 0.0,
+                 seed: Optional[int] = None) -> None:
+        assert 0.0 <= jitter < 1.0, jitter
         self._next = initial
         self._factor = factor
         self._cap = cap
+        self._jitter = jitter
+        self._rng = random.Random(seed) if jitter else None
 
     def current_backoff(self) -> float:
         value = self._next
         self._next = min(self._next * self._factor, self._cap)
+        if self._rng is not None:
+            # Jitter AFTER capping, unclamped: retriers parked at the
+            # cap must keep their full ±j spread, or a preemption
+            # storm's worth of them re-synchronize on exactly `cap`.
+            value *= 1.0 + self._jitter * (2.0 * self._rng.random() - 1.0)
         return value

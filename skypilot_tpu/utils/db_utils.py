@@ -102,6 +102,13 @@ class PostgresConnection:
         with self._lock:
             self._conn.commit()
 
+    def rollback(self) -> None:
+        # Required by callers that swallow write errors: psycopg2 leaves
+        # the connection in an aborted transaction until rolled back,
+        # which would poison every later statement on this singleton.
+        with self._lock:
+            self._conn.rollback()
+
     def close(self) -> None:
         self._conn.close()
 

@@ -1,0 +1,148 @@
+"""Unified retry/deadline helpers for every recovery path.
+
+Before this module each recovery path hand-rolled its own
+``time.sleep``/attempt-counter loop (REST 429 backoff in the provider
+transports, probe tolerance in the jobs controller, readiness probes in
+serve). They all reduce to the same three primitives:
+
+  * :class:`Deadline` — a remaining-time budget that propagates across
+    layers (``deadline.sub(10)`` hands a callee at most 10s *and* never
+    more than the caller has left);
+  * ``common_utils.Backoff`` — capped exponential backoff, optionally
+    jittered (deterministic when seeded, for tests);
+  * :func:`retry_transient` — retry a callable on *typed* transient
+    failures only, under an attempt cap, a backoff, a deadline, and an
+    optional early give-up predicate.
+
+Instrumented modules route their cadence sleeps through
+:func:`sleep` — one choke point, so the no-raw-``time.sleep``-in-retry-
+loops lint (tests/unit_tests/test_chaos.py) stays a simple AST check.
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Callable, Optional, Tuple, Type
+
+from skypilot_tpu.utils import common_utils
+
+
+class DeadlineExceeded(Exception):
+    """A Deadline's budget ran out."""
+
+
+class TransientError(Exception):
+    """Marker for failures worth retrying (rate limits, transport drops,
+    empty probe replies). Raise it (or subclass it) inside a callable
+    passed to :func:`retry_transient`."""
+
+
+# HTTP statuses every provider transport treats as transient.
+TRANSIENT_HTTP_STATUSES = frozenset({408, 429, 500, 502, 503, 504})
+
+DEFAULT_TRANSIENT_TYPES: Tuple[Type[BaseException], ...] = (
+    TransientError, ConnectionError, TimeoutError, InterruptedError)
+
+
+class Deadline:
+    """Monotonic remaining-time budget.
+
+    ``Deadline(30)`` expires 30s from now; ``Deadline.unlimited()`` never
+    does. Pass deadlines *down* — a callee that needs its own cap takes
+    ``deadline.sub(cap)`` so it can never outlive its caller's budget.
+    """
+
+    def __init__(self, budget_s: Optional[float]) -> None:
+        self._expires_at = (None if budget_s is None
+                            else time.monotonic() + budget_s)
+
+    @classmethod
+    def unlimited(cls) -> 'Deadline':
+        return cls(None)
+
+    @property
+    def bounded(self) -> bool:
+        return self._expires_at is not None
+
+    def remaining(self) -> float:
+        if self._expires_at is None:
+            return math.inf
+        return max(0.0, self._expires_at - time.monotonic())
+
+    @property
+    def expired(self) -> bool:
+        return self._expires_at is not None and \
+            time.monotonic() >= self._expires_at
+
+    def sub(self, budget_s: float) -> 'Deadline':
+        """Child budget: at most `budget_s`, never more than remains."""
+        return Deadline(min(budget_s, self.remaining()))
+
+    def check(self, what: str = 'operation') -> None:
+        if self.expired:
+            raise DeadlineExceeded(f'{what} exceeded its deadline.')
+
+    def sleep(self, seconds: float) -> bool:
+        """Sleep up to `seconds`, capped at the remaining budget.
+        Returns False (without sleeping) when the budget is exhausted."""
+        if self.expired:
+            return False
+        time.sleep(min(seconds, self.remaining()))
+        return True
+
+
+def sleep(seconds: float, deadline: Optional[Deadline] = None) -> bool:
+    """Cadence sleep for instrumented recovery loops.
+
+    Equivalent to ``time.sleep`` (optionally deadline-capped) but gives
+    poll loops one auditable entry point instead of scattered raw
+    sleeps.
+    """
+    if deadline is not None:
+        return deadline.sleep(seconds)
+    time.sleep(seconds)
+    return True
+
+
+def retry_transient(
+        fn: Callable[[], Any],
+        *,
+        max_attempts: int = 3,
+        backoff: Optional[common_utils.Backoff] = None,
+        deadline: Optional[Deadline] = None,
+        transient: Tuple[Type[BaseException], ...] = DEFAULT_TRANSIENT_TYPES,
+        give_up: Optional[Callable[[], bool]] = None,
+        on_retry: Optional[Callable[[int, BaseException], None]] = None
+) -> Any:
+    """Call `fn`, retrying typed-transient failures with backoff.
+
+    Only exceptions in `transient` are retried — anything else
+    propagates immediately (a permission error must not burn a retry
+    budget). Retrying stops when attempts run out, the deadline budget
+    is spent, or `give_up()` turns True (checked after each failure —
+    e.g. "the cloud no longer reports the cluster alive, stop probing");
+    the last transient error is re-raised.
+    """
+    assert max_attempts >= 1, max_attempts
+    backoff = backoff or common_utils.Backoff(
+        initial=0.5, cap=10.0, jitter=0.2)
+    deadline = deadline or Deadline.unlimited()
+    last_error: Optional[BaseException] = None
+    for attempt in range(1, max_attempts + 1):
+        try:
+            return fn()
+        except transient as e:  # pylint: disable=catching-non-exception
+            last_error = e
+            if attempt >= max_attempts:
+                break
+            if give_up is not None and give_up():
+                break
+            if on_retry is not None:
+                on_retry(attempt, e)
+            if not deadline.sleep(backoff.current_backoff()) or \
+                    deadline.expired:
+                # Budget spent (possibly by the capped sleep we just
+                # took): do not start another full attempt past it.
+                break
+    assert last_error is not None
+    raise last_error

@@ -1,0 +1,410 @@
+"""Unit tests for the unified resilience layer (Deadline / Backoff /
+retry_transient) and the recovery paths it hardens."""
+import time
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import chaos
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import resilience
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+class TestDeadline:
+
+    def test_budget_counts_down_and_expires(self):
+        d = resilience.Deadline(0.05)
+        assert d.bounded and not d.expired
+        assert 0 < d.remaining() <= 0.05
+        time.sleep(0.06)
+        assert d.expired and d.remaining() == 0
+        with pytest.raises(resilience.DeadlineExceeded):
+            d.check('probe')
+
+    def test_unlimited_never_expires(self):
+        d = resilience.Deadline.unlimited()
+        assert not d.bounded and not d.expired
+        assert d.remaining() == float('inf')
+        d.check()  # no raise
+
+    def test_sub_propagates_the_smaller_budget(self):
+        parent = resilience.Deadline(0.05)
+        child = parent.sub(100.0)
+        assert child.remaining() <= 0.05
+        # And a child wanting less gets its own, smaller budget.
+        small = resilience.Deadline(100.0).sub(0.01)
+        assert small.remaining() <= 0.01
+
+    def test_sleep_caps_at_remaining_and_reports_exhaustion(self):
+        d = resilience.Deadline(0.05)
+        start = time.monotonic()
+        assert d.sleep(10.0)  # returns, capped at the remaining budget
+        assert time.monotonic() - start < 1.0
+        assert not d.sleep(0.01)  # budget gone: no sleep, False
+
+
+class TestBackoff:
+
+    def test_default_is_jitter_free_and_capped(self):
+        b = common_utils.Backoff(initial=1.0, factor=2.0, cap=5.0)
+        assert [b.current_backoff() for _ in range(4)] == \
+            [1.0, 2.0, 4.0, 5.0]
+
+    def test_seeded_jitter_is_deterministic(self):
+        mk = lambda: common_utils.Backoff(initial=1.0, factor=2.0,
+                                          cap=30.0, jitter=0.4, seed=7)
+        a = [mk().current_backoff() for _ in range(1)]
+        b1, b2 = mk(), mk()
+        seq1 = [b1.current_backoff() for _ in range(6)]
+        seq2 = [b2.current_backoff() for _ in range(6)]
+        assert seq1 == seq2
+        assert a[0] == seq1[0]
+
+    def test_jitter_stays_in_band_around_capped_base(self):
+        # The cap bounds the base progression; the jitter band applies
+        # on top of it SYMMETRICALLY — capped retriers must not
+        # re-synchronize on exactly `cap`.
+        b = common_utils.Backoff(initial=1.0, factor=2.0, cap=8.0,
+                                 jitter=0.25, seed=3)
+        expected_base = [1.0, 2.0, 4.0, 8.0, 8.0, 8.0]
+        values = [b.current_backoff() for _ in expected_base]
+        for base, v in zip(expected_base, values):
+            assert base * 0.75 <= v <= base * 1.25
+        at_cap = values[3:]
+        assert len(set(at_cap)) == len(at_cap)   # still spread out
+
+
+class TestRetryTransient:
+
+    def test_succeeds_after_transient_failures(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise resilience.TransientError('blip')
+            return 'ok'
+
+        out = resilience.retry_transient(
+            fn, max_attempts=3,
+            backoff=common_utils.Backoff(initial=0.01, cap=0.01))
+        assert out == 'ok' and len(calls) == 3
+
+    def test_non_transient_propagates_immediately(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise exceptions.PermissionError_('iam')
+
+        with pytest.raises(exceptions.PermissionError_):
+            resilience.retry_transient(
+                fn, max_attempts=5,
+                backoff=common_utils.Backoff(initial=0.01, cap=0.01))
+        assert len(calls) == 1
+
+    def test_exhaustion_reraises_last_transient(self):
+        with pytest.raises(resilience.TransientError, match='blip-3'):
+            attempts = []
+
+            def fn():
+                attempts.append(1)
+                raise resilience.TransientError(f'blip-{len(attempts)}')
+
+            resilience.retry_transient(
+                fn, max_attempts=3,
+                backoff=common_utils.Backoff(initial=0.01, cap=0.01))
+
+    def test_give_up_stops_early(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise resilience.TransientError('down')
+
+        with pytest.raises(resilience.TransientError):
+            resilience.retry_transient(
+                fn, max_attempts=10, give_up=lambda: True,
+                backoff=common_utils.Backoff(initial=0.01, cap=0.01))
+        assert len(calls) == 1
+
+    def test_deadline_bounds_total_retry_time(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise resilience.TransientError('slow')
+
+        start = time.monotonic()
+        with pytest.raises(resilience.TransientError):
+            resilience.retry_transient(
+                fn, max_attempts=1000,
+                backoff=common_utils.Backoff(initial=0.02, factor=1.0,
+                                             cap=0.02),
+                deadline=resilience.Deadline(0.1))
+        assert time.monotonic() - start < 2.0
+        assert 2 <= len(calls) < 100
+
+    def test_on_retry_observer_sees_each_failure(self):
+        seen = []
+
+        def fn():
+            if len(seen) < 2:
+                raise resilience.TransientError('x')
+            return 1
+
+        resilience.retry_transient(
+            fn, max_attempts=5,
+            on_retry=lambda attempt, e: seen.append((attempt, str(e))),
+            backoff=common_utils.Backoff(initial=0.01, cap=0.01))
+        assert [a for a, _ in seen] == [1, 2]
+
+
+class TestFailoverHistoryCap:
+
+    def test_history_bounded_but_count_kept(self):
+        from skypilot_tpu.backends import failover
+        from skypilot_tpu import Resources, Task
+        task = Task('t', run='echo x')
+        task.set_resources(Resources())
+        provisioner = failover.RetryingProvisioner(task, 'cap-test', 1)
+        for i in range(failover._MAX_FAILOVER_HISTORY + 25):
+            provisioner._record_failure(
+                exceptions.CapacityError(f'stockout {i}'),
+                block_scope='zone:z')
+        assert len(provisioner.failover_history) == \
+            failover._MAX_FAILOVER_HISTORY
+        assert provisioner.total_failures == \
+            failover._MAX_FAILOVER_HISTORY + 25
+        # The kept window is the newest one.
+        assert 'stockout 74' in str(provisioner.failover_history[-1])
+
+
+class TestRecoveryStrategies:
+    """Satellite coverage: eager recover with nothing launched yet, and
+    the reconcile-before-relaunch guarantee."""
+
+    def _task(self):
+        from skypilot_tpu import Resources, Task
+        t = Task('t', run='echo x')
+        t.set_resources(Resources(use_spot=True))
+        return t
+
+    def test_eager_recover_handles_no_handle_no_last_launched(
+            self, monkeypatch):
+        from skypilot_tpu.jobs import recovery
+        ex = recovery.EagerFailoverStrategyExecutor(
+            self._task(), 'eager-none')
+        assert ex.last_launched is None
+        captured = {}
+
+        def fake_relaunch(self, blocked=None):
+            captured['blocked'] = blocked
+            return 'handle', 7
+
+        monkeypatch.setattr(recovery.StrategyExecutor, '_relaunch',
+                            fake_relaunch)
+        assert ex.recover(None) == ('handle', 7)
+        # Nothing known about where the last launch landed: nothing to
+        # blocklist, and no crash dereferencing a missing handle.
+        assert captured['blocked'] == []
+
+    def test_eager_recover_blocks_last_launched_region(self, monkeypatch):
+        from skypilot_tpu import resources as resources_lib
+        from skypilot_tpu.jobs import recovery
+        ex = recovery.EagerFailoverStrategyExecutor(
+            self._task(), 'eager-region')
+        ex.last_launched = resources_lib.Resources(cloud='fake',
+                                                   region='fake-west1')
+        captured = {}
+        monkeypatch.setattr(
+            recovery.StrategyExecutor, '_relaunch',
+            lambda self, blocked=None: captured.update(blocked=blocked))
+        ex.recover(None)
+        assert len(captured['blocked']) == 1
+        assert captured['blocked'][0].region == 'fake-west1'
+
+    def test_relaunch_reconciles_record_when_teardown_lies(
+            self, fake_cluster_env, monkeypatch):
+        """A teardown that 'succeeds' but leaves the record behind must
+        not shadow the relaunch with a half-dead cluster record."""
+        del fake_cluster_env
+        from skypilot_tpu import state as state_lib
+        from skypilot_tpu.jobs import recovery
+        name = 'xsky-test-reconcile'
+        ex = recovery.FailoverStrategyExecutor(self._task(), name)
+        state_lib.add_or_update_cluster(name, cluster_handle='stub',
+                                        ready=True)
+        monkeypatch.setattr(ex.backend, 'teardown',
+                            lambda *a, **k: None)  # leaves the record
+        seen = {}
+
+        def fake_launch(self, retry_until_up=True, blocked=None):
+            seen['record_at_launch'] = state_lib.get_cluster_from_name(
+                name)
+            return 'handle', 3
+
+        monkeypatch.setattr(recovery.StrategyExecutor, 'launch',
+                            fake_launch)
+        assert ex._relaunch() == ('handle', 3)
+        assert seen['record_at_launch'] is None
+
+    def test_relaunch_reconciles_record_when_teardown_raises(
+            self, fake_cluster_env, monkeypatch):
+        del fake_cluster_env
+        from skypilot_tpu import state as state_lib
+        from skypilot_tpu.jobs import recovery
+        name = 'xsky-test-reconcile-raise'
+        ex = recovery.FailoverStrategyExecutor(self._task(), name)
+        state_lib.add_or_update_cluster(name, cluster_handle='stub',
+                                        ready=True)
+
+        def bad_teardown(*a, **k):
+            raise RuntimeError('cloud API died mid-teardown')
+
+        monkeypatch.setattr(ex.backend, 'teardown', bad_teardown)
+        seen = {}
+
+        def fake_launch(self, retry_until_up=True, blocked=None):
+            seen['record_at_launch'] = state_lib.get_cluster_from_name(
+                name)
+            return 'handle', 4
+
+        monkeypatch.setattr(recovery.StrategyExecutor, 'launch',
+                            fake_launch)
+        assert ex._relaunch() == ('handle', 4)
+        assert seen['record_at_launch'] is None
+
+
+class TestGangSshRetry:
+    """Satellite coverage for the gang launcher's rc-255 path, driven
+    through the chaos layer (dogfooding `gang.host_start`)."""
+
+    def _runners(self, n):
+        from skypilot_tpu.utils import command_runner as runner_lib
+        return [runner_lib.LocalProcessCommandRunner(f'h{i}')
+                for i in range(n)]
+
+    def test_rc255_start_is_retried_once_and_succeeds(self, tmp_path):
+        from skypilot_tpu.agent import gang
+        chaos.load_plan({'points': {
+            'gang.host_start': {'first_n': 1, 'returncode': 255}}})
+        runners = self._runners(2)
+        result = gang.gang_launch(runners, [{}, {}], 'echo gang-ok',
+                                  str(tmp_path / 'logs'))
+        assert result.success, result.returncodes
+        # 2 fan-out starts + 1 retry of the injected-255 host.
+        assert chaos.hits('gang.host_start') == 3
+
+    def test_rc255_replacement_start_raising_fails_the_gang(
+            self, tmp_path):
+        from skypilot_tpu.agent import gang
+        # Hit 1 (fan-out): exit 255. Hit 2 (the retry _start): raises.
+        chaos.load_plan({'points': {'gang.host_start': [
+            {'first_n': 1, 'returncode': 255},
+            {'skip_first': 1, 'first_n': 1, 'error': 'RuntimeError'},
+        ]}})
+        runners = self._runners(1)
+        result = gang.gang_launch(runners, [{}], 'echo never-runs',
+                                  str(tmp_path / 'logs'))
+        assert not result.success
+        # The host is charged the ssh-transport rc, not left hanging.
+        assert result.returncodes == [255]
+        assert result.first_failure_rank == 0
+
+    def test_mid_run_exit_point_kills_the_gang(self, tmp_path):
+        from skypilot_tpu.agent import gang
+        chaos.load_plan({'points': {
+            # Let a few polls pass so both hosts are running.
+            'gang.mid_run_exit': {'skip_first': 2, 'first_n': 1}}})
+        runners = self._runners(2)
+        result = gang.gang_launch(runners, [{}, {}], 'sleep 20',
+                                  str(tmp_path / 'logs'),
+                                  poll_interval_s=0.05)
+        assert not result.success
+        # Gang semantics: everyone is dead, nobody waited out the sleep.
+        assert all(rc != 0 for rc in result.returncodes)
+
+
+class TestRecoveryJournal:
+
+    def test_record_and_prefix_filtering(self, fake_cluster_env):
+        del fake_cluster_env
+        from skypilot_tpu import state as state_lib
+        state_lib.record_recovery_event('job.preempted', scope='job/1',
+                                        cause='test')
+        state_lib.record_recovery_event('job.recovered', scope='job/1',
+                                        latency_s=2.5,
+                                        detail={'cluster': 'c1'})
+        state_lib.record_recovery_event('replica.preempted',
+                                        scope='service/s/replica/2')
+        rows = state_lib.get_recovery_events()
+        assert [r['event_type'] for r in rows] == [
+            'job.preempted', 'job.recovered', 'replica.preempted']
+        assert rows[1]['latency_s'] == 2.5
+        assert rows[1]['detail'] == {'cluster': 'c1'}
+        # Prefix filter: job/1 but not job/11.
+        state_lib.record_recovery_event('job.preempted', scope='job/11')
+        scoped = state_lib.get_recovery_events(scope='job/1')
+        assert len(scoped) == 2
+        by_type = state_lib.get_recovery_events(
+            event_type='replica.preempted')
+        assert len(by_type) == 1
+
+    def test_journal_retention_caps_growth(self, fake_cluster_env,
+                                           monkeypatch):
+        """A days-long drought writes one row per failed attempt; the
+        journal keeps the newest window instead of growing forever."""
+        del fake_cluster_env
+        from skypilot_tpu import state as state_lib
+        monkeypatch.setattr(state_lib, '_MAX_RECOVERY_EVENTS', 100)
+        # The prune gate is a process-global insert counter (psycopg2
+        # gives no usable lastrowid): zero it so the lazy prune lands
+        # exactly on this test's 256th and 512th inserts.
+        monkeypatch.setattr(state_lib, '_recovery_event_inserts', 0)
+        for i in range(512):
+            state_lib.record_recovery_event(
+                'failover.blocked', scope='cluster/drought',
+                cause=f'attempt {i}')
+        rows = state_lib.get_recovery_events(limit=10000)
+        assert len(rows) == 100
+        assert rows[-1]['cause'] == 'attempt 511'   # newest kept
+
+    def test_journal_never_raises_without_db(self, monkeypatch,
+                                             tmp_path):
+        from skypilot_tpu import state as state_lib
+        # Point the DB at an unwritable path: the write must be
+        # swallowed — recovery paths cannot die on observability.
+        monkeypatch.setenv('XSKY_STATE_DB',
+                           str(tmp_path / 'no' / 'such' / 'dir' / 'x.db'))
+        state_lib.reset_for_test()
+        try:
+            state_lib.record_recovery_event('job.preempted', scope='j/1')
+        finally:
+            monkeypatch.delenv('XSKY_STATE_DB')
+            state_lib.reset_for_test()
+
+    def test_events_cli_renders_timeline(self, fake_cluster_env):
+        del fake_cluster_env
+        from click.testing import CliRunner
+
+        from skypilot_tpu import state as state_lib
+        from skypilot_tpu.client import cli as cli_mod
+        state_lib.record_recovery_event(
+            'job.recovered', scope='job/9', cause='relaunched',
+            latency_s=3.25)
+        result = CliRunner().invoke(cli_mod.cli, ['events'])
+        assert result.exit_code == 0, result.output
+        assert 'job.recovered' in result.output
+        assert 'job/9' in result.output
+        assert '3.25s' in result.output
+        result = CliRunner().invoke(
+            cli_mod.cli, ['events', '--scope', 'job/8'])
+        assert 'No recovery events' in result.output
