@@ -380,6 +380,84 @@ def events(scope, event_type, limit):
                               (r['cause'] or '-')[:24], latency))
 
 
+@cli.command()
+@click.option('--fix', is_flag=True, default=False,
+              help='Run the reconciler: repair every unhealthy scope '
+                   '(requeue/fail stranded requests, respawn dead '
+                   'controllers, tear down orphan clusters).')
+def doctor(fix):
+    """Control-plane crash-safety health: liveness leases + ownership.
+
+    Reports every liveness lease (who holds it, whether its pid is
+    alive, when it expires), in-flight API requests stranded by a dead
+    server, non-terminal jobs/services whose controller process is
+    gone, and task clusters whose owning record is already terminal.
+    With --fix, runs the reconciler on the spot and prints each repair
+    (every repair also lands in `xsky events` as a reconcile.* row).
+    """
+    import datetime as datetime_lib
+
+    from skypilot_tpu import reconciler
+    report = reconciler.health_report()
+    leases = report['leases']
+    click.echo(f'Liveness leases ({len(leases)}):')
+    if leases:
+        fmt = '  {:<30} {:<22} {:>8} {:<6} {:>10} {:<8}'
+        click.echo(fmt.format('SCOPE', 'OWNER', 'PID', 'ALIVE',
+                              'EXPIRES', 'STATE'))
+        for l in leases:
+            expires = f"{l['expires_in_s']:.0f}s" \
+                if l['expires_in_s'] > 0 else 'expired'
+            click.echo(fmt.format(
+                l['scope'][:30], (l['owner'] or '-')[:22],
+                l['pid'] or '-', 'yes' if l['pid_alive'] else 'NO',
+                expires, 'live' if l['live'] else 'STALE'))
+    else:
+        click.echo('  (none — no long-lived actors running)')
+    if report['suspect_leases']:
+        click.echo(f"Suspect holders ({len(report['suspect_leases'])}) "
+                   '— lease expired but pid alive (wedged, or blocked '
+                   'in a long provisioning step); not auto-repaired:')
+        for l in report['suspect_leases']:
+            click.echo(f"  {l['scope']} (pid {l['pid']}, expired "
+                       f"{-l['expires_in_s']:.0f}s ago)")
+    problems = [
+        ('Stranded in-flight requests', report['stranded_requests'],
+         lambda r: f"{r['request_id']} ({r['verb']}, {r['status']})"),
+        ('Dead jobs controllers', report['dead_job_controllers'],
+         lambda r: f"job {r['job_id']} (pid {r['pid']}, {r['status']})"),
+        ('Dead serve controllers', report['dead_serve_controllers'],
+         lambda r: f"{r['service']} (pid {r['pid']}, {r['status']})"),
+        ('Orphaned task clusters', report['orphan_clusters'],
+         lambda r: f"{r['cluster']} (job {r['job_id']} terminal/gone)"),
+    ]
+    for title, rows, render in problems:
+        if rows:
+            click.echo(f'{title} ({len(rows)}):')
+            for row in rows:
+                click.echo(f'  {render(row)}')
+    if report['healthy']:
+        click.echo('Control plane healthy: every in-flight scope has '
+                   'a live owner.')
+        if not fix:
+            return
+    elif not fix:
+        click.echo('Run `xsky doctor --fix` to reconcile.')
+        raise SystemExit(1)
+    if fix:
+        # No request requeue from the CLI: a requeued request would
+        # run inside this short-lived doctor process and be orphaned
+        # again at exit — fail-abort is the honest repair here.
+        repairs = reconciler.reconcile(requeue_requests=False)
+        if not repairs:
+            click.echo('Reconciler: nothing to repair.')
+            return
+        now = datetime_lib.datetime.now().strftime('%H:%M:%S')
+        for r in repairs:
+            click.echo(f"[{now}] {r['action']}: {r['scope']} "
+                       f"({r['cause']})")
+
+
 class _SSHGroup(click.Group):
     """`xsky ssh CLUSTER [CMD...]` keeps working next to the node-pool
     subcommands: an unknown first token routes to `connect`."""
@@ -555,20 +633,26 @@ def cost_report():
 
 @cli.command()
 @click.option('--kill', is_flag=True, default=False,
-              help='Kill every framework daemon (default: report only).')
-def reap(kill):
-    """Audit/kill ALL framework daemons (round-end hygiene sweep).
+              help='Kill the targeted framework daemons (default: '
+                   'report only).')
+@click.option('--leaked-only', is_flag=True, default=False,
+              help='Only processes no cluster/job/service/server '
+                   'record owns.')
+def reap(kill, leaked_only):
+    """Audit/kill framework daemons (round-end hygiene sweep).
 
-    Lists every live job runner, serve controller, and API server —
-    healthy or leaked; it does not consult cluster records. With
-    --kill, TERMs each process group and escalates to KILL: a
+    Lists every live job runner, jobs/serve controller, and API
+    server, annotating each as `owned` (a live record claims it) or
+    `leaked` (nothing in the control plane knows it exists). With
+    --kill, TERMs each targeted process group and escalates to KILL: a
     scorched-earth sweep for round boundaries, because a surviving
     chip-holding process turns the next benchmark run into
-    `UNAVAILABLE`. Do not --kill while workloads you care about run.
+    `UNAVAILABLE`. Do not --kill while workloads you care about run —
+    or pass --leaked-only to spare everything a record owns.
     """
     from skypilot_tpu.utils import reaper
     if kill:
-        swept = reaper.reap()
+        swept = reaper.reap(leaked_only=leaked_only)
         survivors = 0
         for rec in swept:
             if rec.get('killed'):
@@ -580,11 +664,15 @@ def reap(kill):
         if survivors:
             raise SystemExit(1)
     else:
-        found = reaper.find_framework_processes()
+        found = reaper.classify()
+        if leaked_only:
+            found = [r for r in found if not r['owned']]
         if not found:
             click.echo('no framework processes running.')
         for rec in found:
-            click.echo(f"{rec['pid']}: {rec['cmdline']}")
+            tag = ('owned by ' + str(rec['owner'])
+                   if rec['owned'] else 'LEAKED')
+            click.echo(f"{rec['pid']} [{tag}]: {rec['cmdline']}")
 
 
 @cli.group()

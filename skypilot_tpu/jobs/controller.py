@@ -49,6 +49,17 @@ class JobsController:
                       for c in record['task_configs']]
         self.start_task = record['current_task']
         self.cluster_name = f'xsky-jobs-{job_id}'
+        # Respawn generation at controller start, frozen for the whole
+        # run (the steady-state reset of the budget must not reset it):
+        # chaos kill rules key on it so a crash drill takes down one
+        # generation, not every respawn after it.
+        self.respawn_generation = record['controller_respawns'] or 0
+
+    def _heartbeat(self) -> None:
+        """Renew this job's liveness lease (reconciler crash-safety:
+        an expired lease marks this controller dead or wedged)."""
+        global_state.heartbeat_lease(f'job/{self.job_id}',
+                                     owner='jobs-controller')
 
     def _set_task(self, task_index: int) -> None:
         self.task = self.tasks[task_index]
@@ -109,6 +120,7 @@ class JobsController:
             logger.info(f'Job {self.job_id} already '
                         f'{record["status"].value}; exiting.')
             return
+        self._heartbeat()   # lease acquired before any long work
         jobs_state.set_status(self.job_id,
                               jobs_state.ManagedJobStatus.STARTING)
         jobs_state.set_cluster_name(self.job_id, self.cluster_name)
@@ -166,6 +178,13 @@ class JobsController:
 
         while True:
             resilience.sleep(POLL_INTERVAL_S)
+            self._heartbeat()
+            # Crash drill: a {"signal": "SIGKILL"} rule here IS the
+            # kill -9 of a live controller; keyed on the respawn
+            # generation so the reconciler-respawned controller
+            # survives the same plan.
+            chaos.inject('jobs.controller_kill', job_id=self.job_id,
+                         respawn=self.respawn_generation)
             status = self._job_status(handle, cluster_job_id)
 
             if status is not None and status.is_terminal():

@@ -25,11 +25,12 @@ import os
 import subprocess
 import sys
 import time
-from typing import Optional
+from typing import Dict, List, Optional
 
 import filelock
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu import state as global_state
 from skypilot_tpu.jobs import state as jobs_state
 from skypilot_tpu.utils import common_utils
 
@@ -91,20 +92,23 @@ def max_controller_respawns() -> int:
     return int(os.environ.get('XSKY_JOBS_MAX_CONTROLLER_RESPAWNS', '3'))
 
 
-def _reconcile_dead_controllers() -> List[str]:
+def _reconcile_dead_controllers() -> Dict[str, List]:
     """Re-exec (or, past the respawn budget, fail) jobs whose
     controllers died without cleanup.
 
     HA (VERDICT r3 #9; ref kubernetes-ray.yml.j2:270-366 re-execs
     controllers on pod restart): a non-terminal job whose controller
-    process is gone — API-server/pod restart, OOM kill — is requeued
-    as WAITING, so the scheduler loop starts a fresh controller that
-    resumes from the persisted current_task/recovery state. Respawns
-    are bounded (a controller that crashes on its own bug must not
-    loop forever); past the budget the job fails and its cluster is
-    reaped. Caller must hold the scheduler lock. Returns dead jobs'
-    task-cluster names to reap *after* releasing the lock.
+    process is gone — API-server/pod restart, OOM kill, chaos SIGKILL
+    — is requeued as WAITING, so the scheduler loop starts a fresh
+    controller that resumes from the persisted current_task/recovery
+    state. Respawns are bounded (a controller that crashes on its own
+    bug must not loop forever); past the budget the job fails and its
+    cluster is reaped. Every repair lands in the recovery journal as a
+    ``reconcile.*`` event. Caller must hold the scheduler lock.
+    Returns ``{'respawned': [job_ids], 'orphaned': [cluster_names]}``;
+    the orphaned clusters must be reaped *after* releasing the lock.
     """
+    respawned: List[int] = []
     orphaned: List[str] = []
     for row in jobs_state.get_jobs():
         if row['schedule_state'] not in (jobs_state.ScheduleState.LAUNCHING,
@@ -120,38 +124,55 @@ def _reconcile_dead_controllers() -> List[str]:
                     f'Managed job {job_id} controller '
                     f'(pid {row["controller_pid"]}) died; re-execing '
                     f'(respawn {respawns}/{max_controller_respawns()}).')
+                global_state.record_recovery_event(
+                    'reconcile.controller_respawn',
+                    scope=f'job/{job_id}',
+                    cause='controller process died',
+                    detail={'pid': row['controller_pid'] or 0,
+                            'respawn': respawns})
                 jobs_state.set_schedule_state(
                     job_id, jobs_state.ScheduleState.WAITING)
+                respawned.append(job_id)
                 continue
             jobs_state.set_status(
                 job_id, jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
                 failure_reason=('controller died '
                                 f'{respawns} times; respawn budget '
                                 'exhausted'))
+            global_state.record_recovery_event(
+                'reconcile.respawn_budget_exhausted',
+                scope=f'job/{job_id}',
+                cause=f'controller died {respawns} times')
         logger.warning(
             f'Managed job {job_id} controller '
             f'(pid {row["controller_pid"]}) died without cleanup; '
             'releasing its scheduler slot.')
         jobs_state.set_schedule_state(job_id,
                                       jobs_state.ScheduleState.DONE)
+        global_state.release_lease(f'job/{job_id}')
         if row['cluster_name']:
             orphaned.append(row['cluster_name'])
-    return orphaned
+    return {'respawned': respawned, 'orphaned': orphaned}
 
 
 def _reap_clusters(cluster_names: List[str]) -> None:
     """Best-effort teardown of task clusters orphaned by dead
-    controllers (nothing else will ever down them)."""
+    controllers (nothing else will ever down them). Each teardown is
+    journalled so `xsky events` shows who reclaimed the cluster."""
     from skypilot_tpu import core as core_lib
     from skypilot_tpu import exceptions
     for name in cluster_names:
         try:
             core_lib.down(name, purge=True)
         except exceptions.ClusterDoesNotExist:
-            pass
+            continue
         except Exception as e:  # pylint: disable=broad-except
             logger.warning(f'Failed to reap orphaned cluster '
                            f'{name!r}: {e}')
+            continue
+        global_state.record_recovery_event(
+            'reconcile.orphan_teardown', scope=f'cluster/{name}',
+            cause='task cluster of a dead controller')
 
 
 def submit_job(job_id: int) -> None:
@@ -161,16 +182,18 @@ def submit_job(job_id: int) -> None:
     maybe_schedule_next_jobs()
 
 
-def maybe_schedule_next_jobs() -> None:
+def maybe_schedule_next_jobs() -> Dict[str, List]:
     """Start controllers for WAITING jobs while slots are free.
 
     Safe to call from anywhere at any time; does nothing when no slots
-    or no waiting jobs. (Twin of sky/jobs/scheduler.py:114.)
+    or no waiting jobs. (Twin of sky/jobs/scheduler.py:114.) Returns
+    the dead-controller reconcile summary (`{'respawned', 'orphaned'}`)
+    for the reconciler/doctor; all other callers ignore it.
     """
-    orphaned: List[str] = []
+    reconciled: Dict[str, List] = {'respawned': [], 'orphaned': []}
     try:
         with _lock():
-            orphaned = _reconcile_dead_controllers()
+            reconciled = _reconcile_dead_controllers()
             while True:
                 counts = jobs_state.schedule_state_counts()
                 launching = counts.get(jobs_state.ScheduleState.LAUNCHING,
@@ -199,7 +222,8 @@ def maybe_schedule_next_jobs() -> None:
         # Another process owns the schedule; it will pick the jobs up.
         logger.debug('Scheduler lock busy; skipping tick.')
     # Outside the lock: teardown is slow and must not block scheduling.
-    _reap_clusters(orphaned)
+    _reap_clusters(reconciled['orphaned'])
+    return reconciled
 
 
 def launch_done(job_id: int) -> None:
@@ -222,16 +246,22 @@ def acquire_launch_slot(job_id: int,
     """
     deadline = (time.time() + timeout_s) if timeout_s else None
     while True:
+        # A controller can queue here for a long time during a
+        # preemption storm; keep its liveness lease fresh or the
+        # reconciler would report a healthy-but-waiting controller
+        # as expired.
+        global_state.heartbeat_lease(f'job/{job_id}',
+                                     owner='jobs-controller')
         acquired = False
         with _lock():
-            orphaned = _reconcile_dead_controllers()
+            reconciled = _reconcile_dead_controllers()
             counts = jobs_state.schedule_state_counts()
             if counts.get(jobs_state.ScheduleState.LAUNCHING,
                           0) < max_launching():
                 jobs_state.set_schedule_state(
                     job_id, jobs_state.ScheduleState.LAUNCHING)
                 acquired = True
-        _reap_clusters(orphaned)
+        _reap_clusters(reconciled['orphaned'])
         if acquired:
             return
         if deadline and time.time() > deadline:
@@ -245,4 +275,7 @@ def job_done(job_id: int) -> None:
     with _lock():
         jobs_state.set_schedule_state(job_id,
                                       jobs_state.ScheduleState.DONE)
+    # Clean exit releases the liveness lease (a crash leaves it for
+    # the reconciler to expire).
+    global_state.release_lease(f'job/{job_id}')
     maybe_schedule_next_jobs()

@@ -12,6 +12,7 @@ import threading
 import time
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu import state as global_state
 from skypilot_tpu.serve import autoscalers as autoscalers_lib
 from skypilot_tpu.serve import load_balancer as lb_lib
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
@@ -48,6 +49,7 @@ class SkyServeController:
             on_request=lambda: self.autoscaler
             .collect_request_information(1, 0.0))
         self._stop = threading.Event()
+        self._respawn_budget_cleared = False
 
     def run(self) -> None:
         lb_port = serve_state.get_service(self.service_name)['lb_port']
@@ -65,11 +67,18 @@ class SkyServeController:
         self._apply_scale(self.spec.min_replicas)
 
         while not self._stop.is_set():
+            self._heartbeat()
             try:
                 self._tick()
             except Exception as e:  # pylint: disable=broad-except
                 logger.warning(f'controller tick failed: {e}')
             self._stop.wait(CONTROLLER_INTERVAL_S)
+
+    def _heartbeat(self) -> None:
+        """Renew this service's liveness lease (reconciler
+        crash-safety: an expired lease marks this controller dead)."""
+        global_state.heartbeat_lease(f'service/{self.service_name}',
+                                     owner='serve-controller')
 
     def _maybe_adopt_new_version(self) -> None:
         """Pick up `serve update`: reload spec + task at the new version.
@@ -155,6 +164,13 @@ class SkyServeController:
         if ready > 0:
             serve_state.set_service_status(
                 self.service_name, serve_state.ServiceStatus.READY)
+            if not self._respawn_budget_cleared:
+                # Steady state clears the HA respawn budget ONCE per
+                # controller run: it bounds crash loops, not how many
+                # restarts a long-lived service may outlive (same
+                # semantics as the jobs controller's reset).
+                serve_state.reset_controller_respawns(self.service_name)
+                self._respawn_budget_cleared = True
         else:
             current = serve_state.get_service(self.service_name)
             if current and current['status'] == \
@@ -179,6 +195,9 @@ def main() -> int:
         return 0
     finally:
         controller.stop()
+        # Clean exit drops the lease; a SIGKILL leaves it for the
+        # reconciler to expire and repair.
+        global_state.release_lease(f'service/{service_name}')
 
 
 if __name__ == '__main__':

@@ -92,6 +92,11 @@ def _spawn_controller(name: str) -> int:
     return proc.pid
 
 
+def max_controller_respawns() -> int:
+    return int(os.environ.get('XSKY_SERVE_MAX_CONTROLLER_RESPAWNS',
+                              '3'))
+
+
 def recover_controllers() -> List[str]:
     """Re-exec controllers for live services whose process is gone.
 
@@ -100,10 +105,59 @@ def recover_controllers() -> List[str]:
     every non-terminal service's control loop back. The restarted
     controller reconciles desired replicas against recorded state, so
     a rolling update or autoscale decision in flight simply resumes.
+    Respawns are bounded (a controller crashing on its own bug must
+    not be re-execed every reconcile tick forever; reaching READY
+    resets the budget); past the budget the service is marked FAILED.
+    Serialized by an inter-process lock: the background reconciler
+    and a concurrent `xsky doctor --fix` must not both observe the
+    same dead pid and double-spawn one service's controller (the jobs
+    path gets the same guarantee from the scheduler filelock).
     Returns the recovered service names.
     """
+    import filelock
+    from skypilot_tpu import state as global_state
     from skypilot_tpu.utils import common_utils
+    lock_path = os.path.join(
+        os.path.dirname(os.path.expanduser(
+            os.environ.get('XSKY_SERVE_DB', '~/.xsky/serve.db'))),
+        'serve_recover.lock')
+    os.makedirs(os.path.dirname(lock_path), exist_ok=True)
+    try:
+        lock = filelock.FileLock(lock_path, timeout=10)
+        lock.acquire()
+    except filelock.Timeout:
+        # Another process is recovering; it owns this pass.
+        return []
+    try:
+        recovered, dead_replicas = _recover_controllers_locked(
+            global_state, common_utils)
+    finally:
+        lock.release()
+    # Outside the lock (teardown is slow and must not block a
+    # concurrent doctor): reap the replica clusters of services whose
+    # respawn budget is exhausted — their controller and LB are dead,
+    # nothing serves traffic, and nothing else will ever down them
+    # (jobs-side twin: the scheduler reaps on budget exhaustion too).
+    from skypilot_tpu import core as core_lib
+    for service_name, cluster in dead_replicas:
+        try:
+            core_lib.down(cluster, purge=True)
+        except exceptions.ClusterDoesNotExist:
+            continue
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Failed to reap replica cluster '
+                           f'{cluster!r} of failed service: {e}')
+            continue
+        global_state.record_recovery_event(
+            'reconcile.replica_teardown', scope=f'cluster/{cluster}',
+            cause='service respawn budget exhausted',
+            detail={'service': service_name})
+    return recovered
+
+
+def _recover_controllers_locked(global_state, common_utils):
     recovered = []
+    dead_replicas = []
     for record in serve_state.get_services():
         if record['status'] in (serve_state.ServiceStatus.SHUTTING_DOWN,
                                 serve_state.ServiceStatus.FAILED):
@@ -111,12 +165,40 @@ def recover_controllers() -> List[str]:
         pid = record['controller_pid']
         if pid and common_utils.pid_alive(pid):
             continue
+        if not pid and time.time() - (record['created_at'] or 0) < 10:
+            # `serve up` writes the record an instant before spawning
+            # the controller; the periodic reconciler must not race
+            # that window into a duplicate spawn.
+            continue
         name = record['name']
+        respawns = serve_state.bump_controller_respawns(name)
+        if respawns > max_controller_respawns():
+            logger.warning(
+                f'Service {name!r} controller died {respawns} times; '
+                'respawn budget exhausted — marking FAILED.')
+            serve_state.set_service_status(
+                name, serve_state.ServiceStatus.FAILED)
+            # The record stays (post-mortem via `serve status`), but
+            # its lease and chip-holding replicas must not linger.
+            global_state.release_lease(f'service/{name}')
+            dead_replicas.extend(
+                (name, rep['cluster_name'])
+                for rep in serve_state.get_replicas(name))
+            global_state.record_recovery_event(
+                'reconcile.respawn_budget_exhausted',
+                scope=f'service/{name}',
+                cause=f'controller died {respawns} times')
+            continue
         logger.warning(f'Service {name!r} controller (pid {pid}) is '
-                       'gone; re-execing.')
+                       f'gone; re-execing (respawn {respawns}/'
+                       f'{max_controller_respawns()}).')
         _spawn_controller(name)
+        global_state.record_recovery_event(
+            'reconcile.service_respawn', scope=f'service/{name}',
+            cause='controller process died',
+            detail={'pid': pid or 0, 'respawn': respawns})
         recovered.append(name)
-    return recovered
+    return recovered, dead_replicas
 
 
 def up(task: task_lib.Task, service_name: Optional[str] = None,
@@ -270,6 +352,10 @@ def down(service_name: str) -> None:
         except exceptions.ClusterDoesNotExist:
             pass
     serve_state.remove_service(service_name)
+    # The service is gone; its liveness lease must not linger as a
+    # phantom for the reconciler/doctor.
+    from skypilot_tpu import state as global_state
+    global_state.release_lease(f'service/{service_name}')
 
 
 def metrics_history(service_name: str,

@@ -93,7 +93,12 @@ def _db() -> sqlite3.Connection:
                           # How the current update shifts traffic:
                           # 'rolling' (mixed old+new) or 'blue_green'
                           # (old-only until the new fleet is ready).
-                          ('services', "update_mode TEXT")):
+                          ('services', "update_mode TEXT"),
+                          # HA respawn budget (reconciler): a
+                          # controller that crashes on its own bug
+                          # must not be re-execed every tick forever.
+                          ('services',
+                           'controller_respawns INTEGER DEFAULT 0')):
         try:
             conn.execute(f'ALTER TABLE {table} ADD COLUMN {column}')
         except Exception:  # pylint: disable=broad-except
@@ -232,6 +237,34 @@ def set_service_controller_pid(name: str, pid: int) -> None:
         conn.close()
 
 
+def bump_controller_respawns(name: str) -> int:
+    with _lock:
+        conn = _db()
+        conn.execute(
+            'UPDATE services SET '
+            'controller_respawns=COALESCE(controller_respawns, 0)+1 '
+            'WHERE name=?', (name,))
+        conn.commit()
+        row = conn.execute(
+            'SELECT controller_respawns FROM services WHERE name=?',
+            (name,)).fetchone()
+        conn.close()
+    return row[0] if row else 0
+
+
+def reset_controller_respawns(name: str) -> None:
+    """The respawn budget bounds crash LOOPS, not lifetime restarts: a
+    respawned controller that reaches steady state (READY) resets it,
+    matching the managed-jobs budget semantics."""
+    with _lock:
+        conn = _db()
+        conn.execute(
+            'UPDATE services SET controller_respawns=0 WHERE name=?',
+            (name,))
+        conn.commit()
+        conn.close()
+
+
 def get_service(name: str) -> Optional[Dict[str, Any]]:
     with _lock:
         conn = _db()
@@ -262,7 +295,8 @@ def remove_service(name: str) -> None:
 
 def _service_dict(row) -> Dict[str, Any]:
     (name, task_config, status, pid, lb_port, created_at, version,
-     workspace, qps, target_replicas, update_mode) = row
+     workspace, qps, target_replicas, update_mode,
+     controller_respawns) = row
     return {
         'name': name,
         'task_config': json.loads(task_config or '{}'),
@@ -275,6 +309,7 @@ def _service_dict(row) -> Dict[str, Any]:
         'qps': qps,
         'target_replicas': target_replicas,
         'update_mode': update_mode or 'rolling',
+        'controller_respawns': controller_respawns or 0,
     }
 
 

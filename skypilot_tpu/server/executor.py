@@ -111,6 +111,38 @@ _pools_lock = threading.Lock()
 _short_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
 _synchronous = False
 
+# Every request this process has accepted but not finished (queued OR
+# running, long and short pools alike). The watchdog renews each one's
+# liveness lease, so the reconciler can tell "queued behind a busy
+# pool" from "stranded by a dead server" — only the latter is repaired.
+_inflight_lock = threading.Lock()
+_inflight_ids: set = set()
+
+
+def _track_inflight(request_id: str) -> None:
+    """Tracking only — no synchronous lease write. The watchdog's next
+    batched heartbeat (≤ XSKY_WATCHDOG_INTERVAL_S, default 2 s) covers
+    the id well inside the reconciler's acceptance grace window
+    (XSKY_REQUEST_RECONCILE_GRACE_S, default 5 s), so the HTTP handler
+    thread never pays a state-DB fsync per submission. Keep those two
+    knobs ordered (watchdog interval < grace) if you tune either."""
+    with _inflight_lock:
+        _inflight_ids.add(request_id)
+
+
+def _untrack_inflight(request_id: str) -> None:
+    with _inflight_lock:
+        _inflight_ids.discard(request_id)
+
+
+def _give_up_inflight(request_id: str) -> None:
+    """Untrack AND release the lease of a request whose worker thread
+    will never reach its own finally (hung past the budget, or hung
+    after a cancel)."""
+    from skypilot_tpu import state as global_state
+    _untrack_inflight(request_id)
+    global_state.release_lease(f'request/{request_id}')
+
 # Long-queue slot model (hardening; twin concern of the reference's
 # per-request worker PROCESSES, sky/server/requests/executor.py:131):
 # each long request runs on its own daemon thread gated by a slot
@@ -178,38 +210,81 @@ def _long_dispatcher(q, sema) -> None:
                          daemon=True).start()
 
 
+def _heartbeat_inflight() -> None:
+    """Renew the liveness lease of every request this process owns
+    (queued or running): a lease that stops renewing marks the request
+    as stranded by a dead (or wedged) server process, and the
+    reconciler fail-aborts/requeues it instead of letting clients poll
+    forever. Batched: one transaction however deep the queue."""
+    from skypilot_tpu import state as global_state
+    with _inflight_lock:
+        snapshot = list(_inflight_ids)
+    global_state.heartbeat_leases([f'request/{rid}' for rid in snapshot],
+                                  owner='api-server-executor')
+
+
 def _watchdog() -> None:
     from skypilot_tpu.server import requests_db as rdb
     while True:
-        time.sleep(float(os.environ.get('XSKY_WATCHDOG_INTERVAL_S', '2')))
-        budget = long_request_timeout_s()
-        with _long_lock:
-            snapshot = {rid: e['started']
-                        for rid, e in _long_running.items()
-                        if not e['released']}
-        for rid, started in snapshot.items():
-            record = rdb.get(rid)
-            if record is None or record['status'].is_terminal():
-                # Client cancelled (or row vanished): the thread may
-                # hang forever — reclaim its admission slot now.
-                _release_slot(rid)
-                continue
-            if budget > 0 and time.monotonic() - started > budget:
-                logger.warning(f'Request {rid} exceeded '
-                               f'{budget:.0f}s budget; failing it.')
-                rdb.finish(rid, error=exceptions.serialize_exception(
-                    TimeoutError(
-                        f'Request exceeded the server-side budget of '
-                        f'{budget:.0f}s (XSKY_LONG_REQUEST_TIMEOUT_S).')))
-                _release_slot(rid)
+        try:
+            interval = float(
+                os.environ.get('XSKY_WATCHDOG_INTERVAL_S', '2'))
+        except ValueError:
+            interval = 2.0
+        time.sleep(max(interval, 0.1))
+        try:
+            _watchdog_heartbeat_tick(rdb)
+        except Exception as e:  # pylint: disable=broad-except
+            # This thread now carries every request-lease heartbeat: a
+            # transient DB error must cost one tick, not kill renewal
+            # forever (expired leases would turn the reconciler
+            # against this server's own live requests).
+            logger.warning(f'Watchdog tick failed: {e}')
+
+
+def _watchdog_heartbeat_tick(rdb) -> None:
+    budget = long_request_timeout_s()
+    _heartbeat_inflight()
+    with _long_lock:
+        snapshot = {rid: e['started']
+                    for rid, e in _long_running.items()
+                    if not e['released']}
+    for rid, started in snapshot.items():
+        record = rdb.get(rid)
+        if record is None or record['status'].is_terminal():
+            # Client cancelled (or row vanished): the thread may
+            # hang forever — reclaim its admission slot now, and
+            # stop renewing its lease (the hung thread will never
+            # reach _run_request's finally to do it).
+            _release_slot(rid)
+            _give_up_inflight(rid)
+            continue
+        if budget > 0 and time.monotonic() - started > budget:
+            logger.warning(f'Request {rid} exceeded '
+                           f'{budget:.0f}s budget; failing it.')
+            rdb.finish(rid, error=exceptions.serialize_exception(
+                TimeoutError(
+                    f'Request exceeded the server-side budget of '
+                    f'{budget:.0f}s (XSKY_LONG_REQUEST_TIMEOUT_S).')))
+            _release_slot(rid)
+            _give_up_inflight(rid)
 
 
 _watchdog_started = False
 
 
+def _ensure_watchdog() -> None:
+    global _watchdog_started
+    with _pools_lock:
+        if not _watchdog_started:
+            threading.Thread(target=_watchdog, name='xsky-watchdog',
+                             daemon=True).start()
+            _watchdog_started = True
+
+
 def _ensure_long_runtime() -> None:
     global _long_queue, _long_sema, _long_threads_started
-    global _watchdog_started
+    _ensure_watchdog()
     with _pools_lock:
         if _long_threads_started:
             return
@@ -219,10 +294,6 @@ def _ensure_long_runtime() -> None:
         threading.Thread(target=_long_dispatcher,
                          args=(_long_queue, _long_sema),
                          name='xsky-long-disp', daemon=True).start()
-        if not _watchdog_started:
-            threading.Thread(target=_watchdog, name='xsky-watchdog',
-                             daemon=True).start()
-            _watchdog_started = True
         _long_threads_started = True
 
 
@@ -237,16 +308,29 @@ def reset_long_runtime_for_test() -> None:
         _long_threads_started = False
     with _long_lock:
         _long_running.clear()
+    with _inflight_lock:
+        _inflight_ids.clear()
 
 
 def _run_request(request_id: str, func: Callable[..., Any],
                  kwargs: Dict[str, Any],
                  capture_output: bool = True) -> None:
+    from skypilot_tpu import state as global_state
     from skypilot_tpu.server import metrics
     record = requests_db.get(request_id)
     if record is None or record['status'].is_terminal():
-        return  # cancelled before start
+        # Cancelled before start: drop the acceptance-time tracking or
+        # the watchdog would heartbeat this dead request's lease (and
+        # grow _inflight_ids) forever.
+        _untrack_inflight(request_id)
+        global_state.release_lease(f'request/{request_id}')
+        return
     requests_db.set_status(request_id, requests_db.RequestStatus.RUNNING)
+    # No synchronous lease write here: acceptance-time tracking plus
+    # the watchdog's batched heartbeat (well inside the reconcile
+    # grace window) already prove ownership — a per-request state-DB
+    # fsync on every short read would double write contention for no
+    # added crash-safety. (The finally below still releases.)
     start = time.monotonic()
     sink = None
     out_router = err_router = None
@@ -272,6 +356,8 @@ def _run_request(request_id: str, func: Callable[..., Any],
         metrics.observe_request(record['name'], 'failed',
                                 time.monotonic() - start)
     finally:
+        _untrack_inflight(request_id)
+        global_state.release_lease(f'request/{request_id}')
         if sink is not None:
             if out_router is not None:
                 out_router.unregister()
@@ -310,18 +396,51 @@ def _maybe_gc() -> None:
     _short().submit(_gc_sweep)
 
 
-def schedule_request(name: str, user: str, body: Dict[str, Any],
-                     func: Callable[..., Any],
-                     kwargs: Dict[str, Any]) -> str:
-    _maybe_gc()
-    request_id = requests_db.create(name, user, body)
+def _dispatch(request_id: str, name: str, func: Callable[..., Any],
+              kwargs: Dict[str, Any]) -> None:
+    """The single dispatch tail for fresh AND requeued requests (they
+    must never drift apart: a requeued request with different
+    semantics is exactly the bug the requeue path exists to avoid)."""
     if _synchronous:
         # Inline test mode: no routing — capsys/pytest own the streams.
         _run_request(request_id, func, kwargs, capture_output=False)
-        return request_id
+        return
+    # Tracked from acceptance, not first run: a row queued behind a
+    # busy pool must look owned (the watchdog leases everything
+    # tracked), or the periodic reconciler would mistake it for
+    # stranded and dispatch it twice.
+    _ensure_watchdog()
+    _track_inflight(request_id)
     if name in LONG_REQUESTS:
         _ensure_long_runtime()
         _long_queue.put((request_id, func, kwargs))
     else:
         _short().submit(_run_request, request_id, func, kwargs)
+
+
+def schedule_request(name: str, user: str, body: Dict[str, Any],
+                     func: Callable[..., Any],
+                     kwargs: Dict[str, Any]) -> str:
+    _maybe_gc()
+    request_id = requests_db.create(name, user, body)
+    _dispatch(request_id, name, func, kwargs)
     return request_id
+
+
+def requeue_request(request_id: str, name: str,
+                    body: Dict[str, Any]) -> None:
+    """Re-enqueue an EXISTING request row (startup reconciliation of
+    PENDING rows a dead server never started). The row keeps its id so
+    clients polling it see it progress; func/kwargs are re-derived from
+    the persisted verb + body, which is all the original dispatch had.
+    """
+    from skypilot_tpu import state as global_state
+    from skypilot_tpu.server import payloads
+    func, kwargs = payloads.resolve(name, dict(body))
+    # Requeued rows keep their ORIGINAL created_at, so the acceptance
+    # grace window does not protect them — lease synchronously before
+    # dispatch or a concurrent reconcile pass could requeue twice.
+    # (Not a hot path: requeues happen once per server crash.)
+    global_state.heartbeat_lease(f'request/{request_id}',
+                                 owner='api-server-executor')
+    _dispatch(request_id, name, func, kwargs)

@@ -214,24 +214,58 @@ def gc_finished(now: Optional[float] = None) -> int:
     return len(ids)
 
 
-def fail_stale_inflight() -> int:
-    """Mark PENDING/RUNNING rows as FAILED at server startup.
+def list_inflight() -> List[Dict[str, Any]]:
+    """PENDING/RUNNING rows with the fields reconciliation needs."""
+    conn = _get_conn()
+    with _lock:
+        rows = conn.execute(
+            'SELECT request_id, name, user, status, body, created_at '
+            'FROM requests WHERE status IN (?, ?) ORDER BY created_at',
+            (RequestStatus.PENDING.value,
+             RequestStatus.RUNNING.value)).fetchall()
+    return [{
+        'request_id': r[0], 'name': r[1], 'user': r[2],
+        'status': RequestStatus(r[3]), 'body': json.loads(r[4] or '{}'),
+        'created_at': r[5],
+    } for r in rows]
 
-    A crash/restart strands in-flight rows with finished_at=NULL —
-    they would dodge retention GC forever and lie to pollers that the
-    work is still running (no executor will ever finish them)."""
+
+def fail_request(request_id: str, message: str,
+                 error_type: str = 'ServerRestart') -> bool:
+    """Fail-abort one in-flight row with an explicit reason (terminal
+    rows are left alone — repairs must be idempotent)."""
     conn = _get_conn()
     with _lock:
         cur = conn.execute(
             "UPDATE requests SET status='FAILED', finished_at=?, "
-            'error=? WHERE status IN (?, ?)',
+            'error=? WHERE request_id=? AND status IN (?, ?)',
             (time.time(),
-             json.dumps({'type': 'ServerRestart',
-                         'message': 'API server restarted while this '
-                                    'request was in flight.'}),
-             RequestStatus.PENDING.value, RequestStatus.RUNNING.value))
+             json.dumps({'type': error_type, 'message': message}),
+             request_id, RequestStatus.PENDING.value,
+             RequestStatus.RUNNING.value))
         conn.commit()
-        return cur.rowcount
+        return cur.rowcount == 1
+
+
+def fail_stale_inflight() -> int:
+    """Fail-abort in-flight rows whose executor is provably gone.
+
+    A crash/restart strands PENDING/RUNNING rows with finished_at=NULL
+    — they would dodge retention GC forever and lie to pollers that
+    the work is still running (no executor will ever finish them).
+    Lease-aware: a row whose ``request/<id>`` liveness lease is still
+    live belongs to a healthy executor (another API-server process on
+    THIS host, or this process's own worker) and is left alone. Lease
+    liveness probes local pids, so cross-host replicas sharing one DB
+    are outside this guarantee — same single-host assumption as the
+    scheduler's controller_pid checks.
+
+    One code path with the reconciler (abort-only, no acceptance
+    grace: the caller asserts nothing in this process has accepted
+    work yet) so the two can never drift."""
+    from skypilot_tpu import reconciler
+    repairs = reconciler.reconcile_requests(requeue=False, grace_s=0)
+    return sum(1 for r in repairs if r['action'] == 'request_aborted')
 
 
 def mark_cancelled(request_id: str) -> bool:

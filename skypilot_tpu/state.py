@@ -141,6 +141,13 @@ def _create_tables(conn: sqlite3.Connection) -> None:
         );
         CREATE INDEX IF NOT EXISTS idx_recovery_events_scope
             ON recovery_events (scope);
+        CREATE TABLE IF NOT EXISTS liveness_leases (
+            scope TEXT PRIMARY KEY,
+            owner TEXT,
+            pid INTEGER,
+            started_at REAL,
+            expires_at REAL
+        );
     """)
     # Migration for pre-workspace DBs: clusters gain a workspace column.
     for migration in (
@@ -484,6 +491,139 @@ def get_recovery_events(scope: Optional[str] = None,
             'detail': parsed,
         })
     return out
+
+
+# ---- liveness leases -------------------------------------------------------
+# Crash-safety contract for every long-lived actor (jobs controller per
+# job, serve controller per service, API-server executor per in-flight
+# request): the actor heartbeats a lease row keyed by its scope
+# (``job/3``, ``service/svc``, ``request/<id>``). The reconciler
+# (skypilot_tpu/reconciler.py) treats an expired lease as the actor
+# being dead or wedged and repairs the scope. A live pid alone is not
+# proof of liveness — a wedged process renews nothing.
+
+_DEFAULT_LEASE_TTL_S = 60.0
+
+
+def lease_ttl_s() -> float:
+    try:
+        return float(os.environ.get('XSKY_LEASE_TTL_S',
+                                    _DEFAULT_LEASE_TTL_S))
+    except ValueError:
+        return _DEFAULT_LEASE_TTL_S
+
+
+def heartbeat_lease(scope: str, owner: str,
+                    pid: Optional[int] = None,
+                    ttl_s: Optional[float] = None) -> None:
+    """Acquire-or-renew the lease for `scope`. NEVER raises: a
+    heartbeat sits inside control loops whose job is to keep workloads
+    alive — a state-DB hiccup must not kill the actor it monitors.
+
+    `started_at` survives renewal (it records when this scope first
+    came under lease, for doctor output); owner/pid follow the current
+    holder so a respawned controller takes the row over cleanly.
+    """
+    heartbeat_leases([scope], owner, pid=pid, ttl_s=ttl_s)
+
+
+def heartbeat_leases(scopes: List[str], owner: str,
+                     pid: Optional[int] = None,
+                     ttl_s: Optional[float] = None) -> None:
+    """Batched :func:`heartbeat_lease`: one transaction for N scopes.
+    The executor watchdog renews every in-flight request each tick —
+    per-row commits would turn a deep queue into a steady fsync storm
+    on the shared state DB. Never raises."""
+    if not scopes:
+        return
+    pid = pid if pid is not None else os.getpid()
+    ttl = ttl_s if ttl_s is not None else lease_ttl_s()
+    now = time.time()
+    try:
+        conn = _get_conn()
+    except Exception:  # pylint: disable=broad-except
+        return
+    try:
+        with _lock:
+            conn.executemany(
+                'INSERT INTO liveness_leases '
+                '(scope, owner, pid, started_at, expires_at) '
+                'VALUES (?, ?, ?, ?, ?) '
+                'ON CONFLICT(scope) DO UPDATE SET '
+                'owner=excluded.owner, pid=excluded.pid, '
+                'expires_at=excluded.expires_at',
+                [(scope, owner, pid, now, now + ttl)
+                 for scope in scopes])
+            conn.commit()
+    except Exception:  # pylint: disable=broad-except
+        try:
+            conn.rollback()
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+def release_lease(scope: str) -> None:
+    """Drop the lease on clean exit. Never raises (exit paths)."""
+    try:
+        conn = _get_conn()
+    except Exception:  # pylint: disable=broad-except
+        return
+    try:
+        with _lock:
+            conn.execute('DELETE FROM liveness_leases WHERE scope=?',
+                         (scope,))
+            conn.commit()
+    except Exception:  # pylint: disable=broad-except
+        try:
+            conn.rollback()
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+def _lease_dict(row) -> Dict[str, Any]:
+    scope, owner, pid, started_at, expires_at = row
+    return {'scope': scope, 'owner': owner, 'pid': pid,
+            'started_at': started_at, 'expires_at': expires_at}
+
+
+def get_lease(scope: str) -> Optional[Dict[str, Any]]:
+    conn = _get_conn()
+    with _lock:
+        row = conn.execute(
+            'SELECT scope, owner, pid, started_at, expires_at '
+            'FROM liveness_leases WHERE scope=?', (scope,)).fetchone()
+    return _lease_dict(row) if row else None
+
+
+def list_leases(prefix: Optional[str] = None) -> List[Dict[str, Any]]:
+    """All lease rows, optionally filtered by scope path prefix."""
+    conn = _get_conn()
+    with _lock:
+        rows = conn.execute(
+            'SELECT scope, owner, pid, started_at, expires_at '
+            'FROM liveness_leases ORDER BY scope').fetchall()
+    leases = [_lease_dict(r) for r in rows]
+    if prefix is not None:
+        prefix = prefix.rstrip('/') + '/'
+        leases = [l for l in leases if l['scope'].startswith(prefix)]
+    return leases
+
+
+def lease_is_live(lease: Optional[Dict[str, Any]],
+                  now: Optional[float] = None) -> bool:
+    """Is this lease proof its holder is alive? Expiry is the primary
+    signal; a dead pid fails the lease even before expiry (a crashed
+    holder should not get its full TTL of grace). The pid probe
+    assumes lease holders run on this host — the same single-host
+    assumption the scheduler/serve recovery already make with
+    controller_pid."""
+    if lease is None:
+        return False
+    from skypilot_tpu.utils import common_utils
+    now = now if now is not None else time.time()
+    if (lease['expires_at'] or 0) <= now:
+        return False
+    return common_utils.pid_alive(lease['pid'])
 
 
 # ---- storage --------------------------------------------------------------

@@ -49,6 +49,11 @@ Actions (applied when a rule fires):
   ``error``        raise this exception type (resolved from
                    ``skypilot_tpu.exceptions``, then builtins; unknown
                    names raise :class:`ChaosError`)
+  ``signal``       send this signal (name like ``"SIGKILL"`` or a
+                   number) to the CURRENT process — a ``kill -9`` of a
+                   controller mid-flight, for crash-safety drills. The
+                   journal row is written before the signal lands, so a
+                   SIGKILL still leaves its trace.
   anything else    returned to the call site in the fired rule dict for
                    site-specific handling (e.g. ``returncode`` makes the
                    gang launcher start ``exit <rc>`` instead of the real
@@ -84,6 +89,16 @@ class ChaosError(Exception):
 
 class ChaosPlanError(ValueError):
     """XSKY_CHAOS_PLAN is not valid JSON / not readable."""
+
+
+def _resolve_signal(sig) -> int:
+    import signal as signal_lib
+    if isinstance(sig, str):
+        num = getattr(signal_lib, sig, None)
+        if num is None:
+            raise ChaosError(f'unknown signal name {sig!r}')
+        return int(num)
+    return int(sig)
 
 
 def _resolve_error(name: str) -> type:
@@ -139,6 +154,11 @@ class _Plan:
         if latency:
             time.sleep(float(latency))
         _journal(point, rule, ctx)
+        sig = rule.get('signal')
+        if sig is not None:
+            # Crash drill: the journal row above is already committed,
+            # so even SIGKILL (unhandleable) leaves its trace.
+            os.kill(os.getpid(), _resolve_signal(sig))
         error = rule.get('error')
         if error:
             raise _resolve_error(error)(
@@ -164,6 +184,8 @@ def _journal(point: str, rule: Dict[str, Any],
     """Record the injected fault; never let observability kill the path."""
     if rule.get('error'):
         cause = rule['error']
+    elif 'signal' in rule:
+        cause = f'signal={rule["signal"]}'
     elif 'returncode' in rule:
         cause = f'returncode={rule["returncode"]}'
     else:

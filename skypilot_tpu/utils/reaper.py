@@ -7,15 +7,18 @@ framework-owned should still be running: a survivor chews the machine
 and, worst case, holds the TPU chip and zeroes the next benchmark
 capture ("UNAVAILABLE" at backend init).
 
-This is deliberately a scorched-earth sweep: it finds EVERY live
-framework process (healthy or leaked — it does not consult cluster or
-service records) and, in kill mode, takes them all down. Do not run
-``--kill`` while workloads you care about are still running.
+The sweep finds EVERY live framework process and annotates each as
+``owned`` (a live cluster/job/service/server record claims it) vs
+``leaked`` (nothing in the control plane knows it exists). Kill mode
+stays deliberately scorched-earth by default — do not run ``--kill``
+while workloads you care about are still running; ``--leaked-only``
+is the surgical variant that spares record-owned processes.
 
 Usage:
-  python -m skypilot_tpu.utils.reaper            # report only
-  python -m skypilot_tpu.utils.reaper --kill     # TERM, then KILL
-  xsky reap [--kill]                             # same via the CLI
+  python -m skypilot_tpu.utils.reaper                  # report (annotated)
+  python -m skypilot_tpu.utils.reaper --kill           # TERM, then KILL all
+  python -m skypilot_tpu.utils.reaper --kill --leaked-only
+  xsky reap [--kill] [--leaked-only]                   # same via the CLI
 """
 from __future__ import annotations
 
@@ -30,6 +33,7 @@ from typing import Dict, List, Optional, Sequence
 FRAMEWORK_PATTERNS: Sequence[str] = (
     'skypilot_tpu.agent.job_runner',
     'skypilot_tpu.agent.daemon',
+    'skypilot_tpu.jobs.controller',
     'skypilot_tpu.serve.controller',
     'skypilot_tpu.server.app',
 )
@@ -85,42 +89,191 @@ def find_framework_processes(
 find_leaked = find_framework_processes
 
 
+# ---- record-aware ownership ------------------------------------------------
+# `xsky reap` report mode annotates each process as `owned` (a live
+# cluster/job/service/server record claims it) vs `leaked` (nothing in
+# the control plane knows it exists). --kill stays scorched-earth;
+# --leaked-only kills only what no record owns.
+
+
+def _proc_environ(pid: int) -> Dict[str, str]:
+    try:
+        with open(f'/proc/{pid}/environ', 'rb') as f:
+            raw = f.read()
+    except OSError:
+        return {}
+    out = {}
+    for chunk in raw.split(b'\0'):
+        if b'=' in chunk:
+            k, _, v = chunk.partition(b'=')
+            out[k.decode('utf-8', 'replace')] = v.decode('utf-8',
+                                                         'replace')
+    return out
+
+
+def _trailing_arg(cmd: str, marker: str) -> Optional[str]:
+    """The first argv token after `-m <marker>` (job id / service)."""
+    tokens = cmd.split()
+    try:
+        idx = tokens.index(marker)
+    except ValueError:
+        return None
+    return tokens[idx + 1] if len(tokens) > idx + 1 else None
+
+
+def _live_host_roots() -> List[str]:
+    """host_root dirs of every recorded (non-torn-down) cluster — the
+    record-side truth agent daemons/job runners are matched against."""
+    from skypilot_tpu import state
+    roots = []
+    for record in state.get_clusters():
+        info = getattr(record.get('handle'), 'cluster_info', None)
+        for inst in getattr(info, 'instances', {}).values():
+            root = (getattr(inst, 'tags', None) or {}).get('host_root')
+            if root:
+                roots.append(root)
+    return roots
+
+
+def _owner_of(pid: int, cmd: str,
+              host_roots: Sequence[str]) -> Optional[str]:
+    """Which record owns this process, or None (= leaked).
+
+    `host_roots` is the precomputed cluster-host truth (one state scan
+    for the whole sweep, not one per process). All lookups read the
+    local state DBs — errors propagate to classify(), which fails
+    closed (marks the process owned).
+    """
+    if 'skypilot_tpu.jobs.controller' in cmd:
+        from skypilot_tpu.jobs import state as jobs_state
+        arg = _trailing_arg(cmd, 'skypilot_tpu.jobs.controller')
+        try:
+            job = jobs_state.get_job(int(arg))
+        except (TypeError, ValueError):
+            return None
+        if job is not None and not job['status'].is_terminal() and \
+                job['controller_pid'] == pid:
+            return f'job/{job["job_id"]}'
+        return None
+    if 'skypilot_tpu.serve.controller' in cmd:
+        from skypilot_tpu.serve import state as serve_state
+        name = _trailing_arg(cmd, 'skypilot_tpu.serve.controller')
+        record = serve_state.get_service(name) if name else None
+        if record is not None and record['controller_pid'] == pid and \
+                record['status'] != serve_state.ServiceStatus.FAILED:
+            return f'service/{name}'
+        return None
+    if 'skypilot_tpu.server.app' in cmd:
+        from skypilot_tpu.server import app as server_app
+        try:
+            with open(server_app.pid_file(), encoding='utf-8') as f:
+                recorded = int(f.readline().strip())
+        except (FileNotFoundError, ValueError):
+            # No/corrupt pid file: genuinely unrecorded → leaked.
+            return None
+        # Other OSErrors (e.g. PermissionError: CLI running under a
+        # different home than the server) propagate to classify()'s
+        # fail-closed handler — an unreadable record must spare the
+        # process, not condemn it.
+        return 'api-server' if recorded == pid else None
+    # Agent daemons / job runners: owned when their cluster root (from
+    # the process env) sits inside a recorded cluster's host dir.
+    cluster_root = _proc_environ(pid).get('XSKY_CLUSTER_ROOT')
+    if cluster_root:
+        for root in host_roots:
+            if cluster_root == root or \
+                    cluster_root.startswith(root.rstrip('/') + '/'):
+                return f'cluster-host:{root}'
+    return None
+
+
+def classify(procs: Optional[List[Dict[str, object]]] = None
+             ) -> List[Dict[str, object]]:
+    """Annotate framework processes with ``owned``/``owner``.
+
+    Fails CLOSED: if the record lookup itself errors (sqlite busy,
+    corrupt DB), the process is marked owned — `--leaked-only` exists
+    to spare record-owned workloads, and a transient DB error must
+    never turn it into a workload kill.
+    """
+    if procs is None:
+        procs = find_framework_processes()
+    host_roots: Optional[List[str]] = None
+    for rec in procs:
+        try:
+            if host_roots is None:
+                host_roots = _live_host_roots()
+            owner = _owner_of(int(rec['pid']),  # type: ignore[arg-type]
+                              str(rec['cmdline']), host_roots)
+            owned = owner is not None
+        except Exception as e:  # pylint: disable=broad-except
+            owner = f'unknown (record check failed: {e})'
+            owned = True
+        rec['owner'] = owner
+        rec['owned'] = owned
+    return procs
+
+
+def _signal_tree(pid: int, sig: int) -> None:
+    """Signal the process's session group (runners start children in
+    their own session), falling back to the single pid."""
+    try:
+        os.killpg(pid, sig)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            os.kill(pid, sig)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
 def reap(patterns: Sequence[str] = FRAMEWORK_PATTERNS,
-         grace_s: float = 5.0) -> List[Dict[str, object]]:
-    """TERM each framework process's session, escalate to KILL.
+         grace_s: float = 5.0,
+         leaked_only: bool = False) -> List[Dict[str, object]]:
+    """TERM each targeted framework process's session, escalate to KILL.
+
+    Default: scorched-earth over every framework process (round-end
+    hygiene). With ``leaked_only``, processes a live record owns are
+    spared — the surgical mode for reclaiming strays on a machine with
+    workloads still running.
 
     Returns the swept records, each with ``killed`` (gone by return
     time) — a False there (e.g. PermissionError on someone else's
-    process) means the sweep did NOT clear the machine.
+    process) means the sweep did NOT clear the targets.
     """
-    swept = find_framework_processes(patterns)
-    for rec in swept:
-        pid = int(rec['pid'])  # type: ignore[arg-type]
-        try:
-            # Runners start their children in their own session: signal
-            # the group so the whole tree goes.
-            os.killpg(pid, signal.SIGTERM)
-        except (ProcessLookupError, PermissionError, OSError):
-            try:
-                os.kill(pid, signal.SIGTERM)
-            except (ProcessLookupError, PermissionError):
-                continue
+    swept = classify(find_framework_processes(patterns))
+    if leaked_only:
+        swept = [r for r in swept if not r['owned']]
+    selected = {int(r['pid']) for r in swept}  # type: ignore[arg-type]
+
+    def _targets() -> set:
+        """Scorched-earth re-finds every framework process each pass —
+        one spawned mid-sweep (e.g. by a not-yet-dead reconciler) must
+        still die, or it holds the chip into the next benchmark run.
+        leaked-only stays pinned to the classified set: a process that
+        appeared mid-sweep was never classified and must be spared."""
+        found = {int(r['pid'])  # type: ignore[arg-type]
+                 for r in find_framework_processes(patterns)}
+        return (selected & found) if leaked_only else found
+
+    for pid in _targets():
+        _signal_tree(pid, signal.SIGTERM)
     deadline = time.time() + grace_s
     while time.time() < deadline:
-        if not find_framework_processes(patterns):
+        if not _targets():
             break
         time.sleep(0.2)
-    for rec in find_framework_processes(patterns):
-        pid = int(rec['pid'])  # type: ignore[arg-type]
-        try:
-            os.killpg(pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError, OSError):
-            try:
-                os.kill(pid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                pass
-    still_alive = {int(r['pid'])  # type: ignore[arg-type]
-                   for r in find_framework_processes(patterns)}
+    for pid in _targets():
+        _signal_tree(pid, signal.SIGKILL)
+    survivors = find_framework_processes(patterns)
+    still_alive = {int(r['pid']) for r in survivors}  # type: ignore
+    if not leaked_only:
+        # Late arrivals belong in the report (killed=False makes the
+        # sweep exit nonzero rather than lie that the machine is clean).
+        known = {int(r['pid']) for r in swept}  # type: ignore[arg-type]
+        swept.extend(r for r in survivors
+                     if int(r['pid']) not in known)  # type: ignore
+    else:
+        still_alive &= selected
     for rec in swept:
         rec['killed'] = int(rec['pid']) not in still_alive  # type: ignore
     return swept
@@ -133,9 +286,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument('--kill', action='store_true',
                         help='signal the framework processes (default: '
                              'report only)')
+    parser.add_argument('--leaked-only', action='store_true',
+                        help='restrict to processes no cluster/job/'
+                             'service/server record owns')
     args = parser.parse_args(argv)
     if args.kill:
-        swept = reap()
+        swept = reap(leaked_only=args.leaked_only)
         for rec in swept:
             print(json.dumps(rec))
         survivors = [r for r in swept if not r.get('killed')]
@@ -144,7 +300,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   'the sweep')
             return 1
     else:
-        for rec in find_framework_processes():
+        for rec in classify():
+            if args.leaked_only and rec['owned']:
+                continue
             print(json.dumps(rec))
     return 0
 

@@ -84,6 +84,28 @@ class TestChaosPlan:
         with pytest.raises(chaos.ChaosError):
             chaos.inject('c')
 
+    def test_signal_action_delivers_to_self(self):
+        """The `signal` action (crash drills: SIGKILL a controller
+        mid-flight) sends the configured signal to the injecting
+        process — verified with a catchable signal."""
+        import signal as signal_lib
+        received = []
+        old = signal_lib.signal(signal_lib.SIGUSR1,
+                                lambda *a: received.append(1))
+        try:
+            chaos.load_plan({'points': {
+                'p': {'first_n': 1, 'signal': 'SIGUSR1'}}})
+            chaos.inject('p')
+            assert received == [1]
+            assert chaos.inject('p') is None   # rule spent
+        finally:
+            signal_lib.signal(signal_lib.SIGUSR1, old)
+
+    def test_unknown_signal_name_raises_chaos_error(self):
+        chaos.load_plan({'points': {'p': {'signal': 'SIGNOPE'}}})
+        with pytest.raises(chaos.ChaosError):
+            chaos.inject('p')
+
     def test_latency_action_sleeps(self):
         chaos.load_plan({'points': {'p': {'latency_s': 0.05}}})
         start = time.monotonic()
@@ -279,6 +301,100 @@ class TestNoRawSleepLint:
         assert self._raw_sleeps_in_loops(tree) == [(4, 'poll')]
         clean = ast.parse('import time\ntime.sleep(1)\n')   # not a loop
         assert self._raw_sleeps_in_loops(clean) == []
+
+
+class TestLeaseHeartbeatLint:
+    """Every lease-holding module's long-lived loop must renew its
+    liveness lease: a loop that spins without heartbeating looks dead
+    to the reconciler after one TTL and gets its scope 'repaired' out
+    from under it. The list below names the loops that hold leases;
+    each must contain a call whose name mentions ``heartbeat``."""
+
+    REQUIRED = [
+        # jobs controller: monitor loop (scope job/<id>)
+        ('skypilot_tpu/jobs/controller.py', '_run_task'),
+        # controller queued for a launch slot still holds its lease
+        ('skypilot_tpu/jobs/scheduler.py', 'acquire_launch_slot'),
+        # serve controller: autoscaler tick loop (scope service/<name>)
+        ('skypilot_tpu/serve/controller.py', 'run'),
+        # API-server watchdog renews every in-flight request lease
+        ('skypilot_tpu/server/executor.py', '_watchdog'),
+    ]
+
+    @staticmethod
+    def _loops_missing_heartbeat(tree, func_name):
+        """Line numbers of OUTERMOST while/for loops inside
+        `func_name` whose body (nested loops included) never calls a
+        *heartbeat* helper. Returns None when the function has no loop
+        at all (itself a lint failure: the listed functions are
+        long-lived loops by contract)."""
+
+        def has_heartbeat(node):
+            for child in ast.walk(node):
+                if not isinstance(child, ast.Call):
+                    continue
+                func = child.func
+                name = func.attr if isinstance(func, ast.Attribute) \
+                    else getattr(func, 'id', '')
+                if 'heartbeat' in (name or ''):
+                    return True
+            return False
+
+        def outer_loops(node):
+            loops = []
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.While, ast.For)):
+                    loops.append(child)   # nested loops ride along
+                else:
+                    loops.extend(outer_loops(child))
+            return loops
+
+        found_func = False
+        offenders = []
+        saw_loop = False
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) and \
+                    node.name == func_name:
+                found_func = True
+                for loop in outer_loops(node):
+                    saw_loop = True
+                    if not has_heartbeat(loop):
+                        offenders.append(loop.lineno)
+        assert found_func, f'lint list is stale: no function {func_name}'
+        return None if not saw_loop else offenders
+
+    def test_lease_holding_loops_heartbeat(self):
+        repo_root = os.path.join(os.path.dirname(__file__), '..', '..')
+        violations = []
+        for rel, func in self.REQUIRED:
+            path = os.path.join(repo_root, rel)
+            with open(path, encoding='utf-8') as f:
+                tree = ast.parse(f.read(), filename=rel)
+            missing = self._loops_missing_heartbeat(tree, func)
+            if missing is None:
+                violations.append(f'{rel}:{func} has no loop (stale '
+                                  'lint list?)')
+            else:
+                violations.extend(f'{rel}:{line} (in {func})'
+                                  for line in missing)
+        assert not violations, (
+            'long-lived loop in a lease-holding module never calls a '
+            'heartbeat helper — the reconciler will declare it dead '
+            'after one TTL:\n  ' + '\n  '.join(violations))
+
+    def test_lint_catches_a_heartbeatless_loop(self):
+        tree = ast.parse(
+            'def run(self):\n'
+            '    while True:\n'
+            '        self.tick()\n')
+        assert self._loops_missing_heartbeat(tree, 'run') == [2]
+        clean = ast.parse(
+            'def run(self):\n'
+            '    while True:\n'
+            '        self._heartbeat()\n'
+            '        self.tick()\n')
+        assert self._loops_missing_heartbeat(clean, 'run') == []
 
 
 class TestChaosSmoke:

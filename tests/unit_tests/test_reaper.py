@@ -1,4 +1,6 @@
-"""Round-hygiene reaper: leaked framework processes are found + killed."""
+"""Round-hygiene reaper: leaked framework processes are found + killed,
+and report mode tells `owned` (a record claims the process) from
+`leaked` (nothing in the control plane knows it)."""
 import os
 import subprocess
 import sys
@@ -7,13 +9,15 @@ import time
 from skypilot_tpu.utils import reaper
 
 
-def _spawn_decoy() -> subprocess.Popen:
+def _spawn_decoy(marker: str = 'skypilot_tpu.agent.job_runner',
+                 *args: str) -> subprocess.Popen:
     """A detached process whose cmdline carries a framework marker —
-    stands in for a leaked job runner without needing a cluster."""
+    stands in for a leaked daemon without needing a cluster. Extra
+    args land in argv after the marker (ownership lookups parse the
+    token following the module name)."""
     return subprocess.Popen(
-        [sys.executable, '-c',
-         'import time; time.sleep(120)  '
-         '# skypilot_tpu.agent.job_runner decoy'],
+        [sys.executable, '-c', 'import time; time.sleep(120)',
+         marker, *args],
         start_new_session=True)
 
 
@@ -61,3 +65,82 @@ def test_cli_reap_reports(capsys):
         if proc.poll() is None:
             proc.kill()
         proc.wait()
+
+
+class TestOwnedVsLeaked:
+    """Report mode consults cluster/job/service records: a process a
+    live record claims is `owned`; everything else is `leaked`, and
+    --leaked-only kills only the latter."""
+
+    def test_jobs_controller_classification(self, monkeypatch,
+                                            tmp_path):
+        from skypilot_tpu.jobs import state as jobs_state
+        monkeypatch.setenv('XSKY_JOBS_DB',
+                           str(tmp_path / 'managed_jobs.db'))
+        job_id = jobs_state.add_job('mine', {'run': 'echo x'})
+        jobs_state.set_status(job_id,
+                              jobs_state.ManagedJobStatus.RUNNING)
+        owned = _spawn_decoy('skypilot_tpu.jobs.controller',
+                             str(job_id))
+        leaked = _spawn_decoy('skypilot_tpu.jobs.controller', '424242')
+        jobs_state.set_controller_pid(job_id, owned.pid)
+        try:
+            time.sleep(0.3)
+            by_pid = {r['pid']: r for r in reaper.classify()}
+            assert by_pid[owned.pid]['owned'], by_pid[owned.pid]
+            assert by_pid[owned.pid]['owner'] == f'job/{job_id}'
+            assert not by_pid[leaked.pid]['owned']
+            # --leaked-only spares the record-owned controller.
+            swept = reaper.reap(grace_s=3.0, leaked_only=True)
+            swept_pids = {r['pid'] for r in swept}
+            assert leaked.pid in swept_pids
+            assert owned.pid not in swept_pids
+            assert owned.poll() is None   # still running
+        finally:
+            for proc in (owned, leaked):
+                if proc.poll() is None:
+                    proc.kill()
+                proc.wait()
+
+    def test_terminal_job_controller_is_leaked(self, monkeypatch,
+                                               tmp_path):
+        """A controller whose job already finished holds nothing: its
+        record is terminal, so the process is a leak."""
+        from skypilot_tpu.jobs import state as jobs_state
+        monkeypatch.setenv('XSKY_JOBS_DB',
+                           str(tmp_path / 'managed_jobs.db'))
+        job_id = jobs_state.add_job('done', {'run': 'echo x'})
+        jobs_state.set_status(job_id,
+                              jobs_state.ManagedJobStatus.SUCCEEDED)
+        proc = _spawn_decoy('skypilot_tpu.jobs.controller', str(job_id))
+        jobs_state.set_controller_pid(job_id, proc.pid)
+        try:
+            time.sleep(0.3)
+            by_pid = {r['pid']: r for r in reaper.classify()}
+            assert not by_pid[proc.pid]['owned']
+        finally:
+            proc.kill()
+            proc.wait()
+
+    def test_cli_reap_annotates_and_filters(self, monkeypatch,
+                                            tmp_path):
+        from click.testing import CliRunner
+        from skypilot_tpu.client import cli as cli_mod
+        monkeypatch.setenv('XSKY_JOBS_DB',
+                           str(tmp_path / 'managed_jobs.db'))
+        proc = _spawn_decoy()   # record-less job runner → leaked
+        try:
+            time.sleep(0.3)
+            runner = CliRunner()
+            result = runner.invoke(cli_mod.cli, ['reap'])
+            assert result.exit_code == 0, result.output
+            line = next(l for l in result.output.splitlines()
+                        if str(proc.pid) in l)
+            assert 'LEAKED' in line
+            result = runner.invoke(cli_mod.cli,
+                                   ['reap', '--leaked-only'])
+            assert str(proc.pid) in result.output
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait()

@@ -1,0 +1,395 @@
+"""Crash-safe control plane: liveness leases, startup reconciliation,
+orphan adoption (docs/robustness.md "Crash safety").
+
+Covers the lease primitives (acquire/renew/expire/release), each
+reconciler scope in isolation (requests, job-orphan clusters, serve
+orphans), the idempotence contract (a second pass right after a first
+is a no-op), and the tier-1 crash smoke: a chaos ``signal`` rule
+SIGKILLs the real jobs-controller subprocess mid-run and the
+reconciler must bring the job to SUCCEEDED with the full
+fault→reconcile→recover timeline in the journal.
+"""
+import json
+import os
+import time
+
+import pytest
+
+from skypilot_tpu import reconciler
+from skypilot_tpu import state as state_lib
+
+
+@pytest.fixture
+def lease_env(monkeypatch, tmp_path):
+    monkeypatch.setenv('XSKY_STATE_DB', str(tmp_path / 'state.db'))
+    state_lib.reset_for_test()
+    yield tmp_path
+    state_lib.reset_for_test()
+
+
+@pytest.fixture
+def control_plane_env(fake_cluster_env, monkeypatch, tmp_path):
+    """Every control-plane DB isolated (the reconciler touches all of
+    them), fake cloud enabled for cluster-teardown paths."""
+    monkeypatch.setenv('XSKY_JOBS_DB', str(tmp_path / 'managed_jobs.db'))
+    monkeypatch.setenv('XSKY_JOBS_LOG_DIR', str(tmp_path / 'jobs_logs'))
+    monkeypatch.setenv('XSKY_SERVER_DB', str(tmp_path / 'requests.db'))
+    monkeypatch.setenv('XSKY_SERVE_DB', str(tmp_path / 'serve.db'))
+    # Tests create rows and reconcile immediately; the acceptance
+    # grace window (tested explicitly below) would hide them.
+    monkeypatch.setenv('XSKY_REQUEST_RECONCILE_GRACE_S', '0')
+    from skypilot_tpu.server import requests_db
+    requests_db.reset_for_test()
+    yield fake_cluster_env
+    requests_db.reset_for_test()
+
+
+class TestLeases:
+    """The lease primitives the whole crash-safety layer rests on."""
+
+    def test_heartbeat_acquires_and_renews(self, lease_env):
+        # Wall-clock-robust: t0 is taken BEFORE the heartbeat, so the
+        # margin holds however slow the commit is on a loaded host.
+        t0 = time.time()
+        state_lib.heartbeat_lease('job/1', owner='jobs-controller',
+                                  ttl_s=30)
+        lease = state_lib.get_lease('job/1')
+        assert lease['owner'] == 'jobs-controller'
+        assert lease['pid'] == os.getpid()
+        assert lease['expires_at'] >= t0 + 30
+        assert state_lib.lease_is_live(lease, now=t0)
+        # Renewal pushes expiry forward but keeps started_at.
+        first_started = lease['started_at']
+        state_lib.heartbeat_lease('job/1', owner='jobs-controller',
+                                  ttl_s=90)
+        renewed = state_lib.get_lease('job/1')
+        assert renewed['started_at'] == first_started
+        assert renewed['expires_at'] > lease['expires_at']
+
+    def test_expiry_marks_lease_dead(self, lease_env):
+        """Deterministic via lease_is_live's explicit clock — no
+        sleeps racing real fsync latency."""
+        state_lib.heartbeat_lease('service/svc', owner='serve-controller',
+                                  ttl_s=30)
+        lease = state_lib.get_lease('service/svc')
+        assert state_lib.lease_is_live(lease,
+                                       now=lease['expires_at'] - 1)
+        assert not state_lib.lease_is_live(lease,
+                                           now=lease['expires_at'] + 1)
+        # ...and a fresh heartbeat resurrects it (respawned holder).
+        state_lib.heartbeat_lease('service/svc', owner='serve-controller',
+                                  ttl_s=30)
+        renewed = state_lib.get_lease('service/svc')
+        assert state_lib.lease_is_live(renewed,
+                                       now=renewed['expires_at'] - 1)
+
+    def test_dead_pid_fails_lease_before_expiry(self, lease_env):
+        state_lib.heartbeat_lease('request/r1', owner='api-server',
+                                  pid=2 ** 22 + 12345, ttl_s=600)
+        assert not state_lib.lease_is_live(state_lib.get_lease(
+            'request/r1'))
+
+    def test_release_and_prefix_listing(self, lease_env):
+        state_lib.heartbeat_lease('job/1', owner='a')
+        state_lib.heartbeat_lease('job/2', owner='a')
+        state_lib.heartbeat_lease('service/x', owner='b')
+        assert [l['scope'] for l in state_lib.list_leases(prefix='job')] \
+            == ['job/1', 'job/2']
+        assert len(state_lib.list_leases()) == 3
+        state_lib.release_lease('job/1')
+        assert state_lib.get_lease('job/1') is None
+        state_lib.release_lease('job/1')   # idempotent
+        assert state_lib.lease_is_live(None) is False
+
+    def test_missing_lease_is_not_live(self, lease_env):
+        assert state_lib.get_lease('job/404') is None
+        assert not state_lib.lease_is_live(None)
+
+
+class TestRequestReconcile:
+    """Requests stranded by a dead server: requeue PENDING, fail-abort
+    RUNNING, leave lease-protected rows alone."""
+
+    def _make(self, name, status):
+        from skypilot_tpu.server import requests_db
+        rid = requests_db.create(name, 'u', {})
+        if status is not None:
+            requests_db.set_status(rid, status)
+        return rid
+
+    def test_stranded_running_failed_with_restart_message(
+            self, control_plane_env):
+        from skypilot_tpu.server import requests_db
+        rid = self._make('launch', requests_db.RequestStatus.RUNNING)
+        repairs = reconciler.reconcile_requests(requeue=False)
+        assert [r['action'] for r in repairs] == ['request_aborted']
+        record = requests_db.get(rid)
+        assert record['status'] == requests_db.RequestStatus.FAILED
+        assert 'restarted' in record['error']['message']
+        # Journalled with the request scope.
+        events = state_lib.get_recovery_events(
+            event_type='reconcile.request_aborted')
+        assert events and events[-1]['scope'] == f'request/{rid}'
+        # Idempotence: the row is terminal now — a second pass no-ops.
+        assert reconciler.reconcile_requests(requeue=False) == []
+
+    def test_stranded_pending_requeued_on_live_executor(
+            self, control_plane_env):
+        from skypilot_tpu.server import executor
+        from skypilot_tpu.server import requests_db
+        executor.set_synchronous_for_test(True)
+        try:
+            rid = self._make('workspaces.list', None)
+            repairs = reconciler.reconcile_requests(requeue=True)
+            assert [r['action'] for r in repairs] == ['request_requeued']
+            # Synchronous executor ran it inline: the SAME row (same
+            # id a client is polling) progressed to a terminal state.
+            record = requests_db.get(rid)
+            assert record['status'] == requests_db.RequestStatus.SUCCEEDED
+            assert reconciler.reconcile_requests(requeue=True) == []
+        finally:
+            executor.set_synchronous_for_test(False)
+
+    def test_live_lease_protects_inflight_row(self, control_plane_env):
+        from skypilot_tpu.server import requests_db
+        rid = self._make('launch', requests_db.RequestStatus.RUNNING)
+        # A healthy executor (this process) is heartbeating the lease.
+        state_lib.heartbeat_lease(f'request/{rid}',
+                                  owner='api-server-executor', ttl_s=60)
+        assert reconciler.reconcile_requests(requeue=False) == []
+        assert requests_db.get(rid)['status'] == \
+            requests_db.RequestStatus.RUNNING
+        # fail_stale_inflight (startup fast path) honors it too.
+        assert requests_db.fail_stale_inflight() == 0
+        # Once the lease expires the row is fair game.
+        state_lib.heartbeat_lease(f'request/{rid}',
+                                  owner='api-server-executor',
+                                  ttl_s=0.2)
+        time.sleep(0.3)
+        assert requests_db.fail_stale_inflight() == 1
+
+    def test_acceptance_grace_protects_young_rows(
+            self, control_plane_env):
+        """The executor commits the row an instant before leasing it;
+        a reconcile pass in that gap must not double-dispatch or
+        false-abort the just-accepted request."""
+        from skypilot_tpu.server import requests_db
+        rid = self._make('launch', None)
+        assert reconciler.reconcile_requests(requeue=False,
+                                             grace_s=30) == []
+        assert requests_db.get(rid)['status'] == \
+            requests_db.RequestStatus.PENDING
+        # Past the grace window the same row is repairable.
+        assert [r['action'] for r in reconciler.reconcile_requests(
+            requeue=False, grace_s=0)] == ['request_aborted']
+
+    def test_terminal_row_lease_is_dropped(self, control_plane_env):
+        from skypilot_tpu.server import requests_db
+        rid = self._make('launch', requests_db.RequestStatus.RUNNING)
+        state_lib.heartbeat_lease(f'request/{rid}', owner='x', ttl_s=60)
+        requests_db.finish(rid, result=None)
+        reconciler.reconcile_requests(requeue=False)
+        assert state_lib.get_lease(f'request/{rid}') is None
+
+
+class TestOrphanClusterReconcile:
+    """Task clusters whose owning record is terminal or gone are torn
+    down (jobs scope) — the scheduler only reaps clusters it watched a
+    controller die with; a crash between the terminal write and
+    cleanup leaks one."""
+
+    @pytest.fixture
+    def downs(self, monkeypatch):
+        calls = []
+
+        def fake_down(name, purge=False):
+            calls.append(name)
+            state_lib.remove_cluster(name, terminate=True)
+
+        from skypilot_tpu import core as core_lib
+        monkeypatch.setattr(core_lib, 'down', fake_down)
+        return calls
+
+    def test_terminal_job_cluster_torn_down(self, control_plane_env,
+                                            downs):
+        from skypilot_tpu.jobs import state as jobs_state
+        job_id = jobs_state.add_job('dead', {'run': 'echo x'})
+        jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.FAILED)
+        state_lib.add_or_update_cluster(f'xsky-jobs-{job_id}', None,
+                                        ready=True)
+        repairs = reconciler.reconcile_jobs()
+        assert [r['action'] for r in repairs] == ['orphan_teardown']
+        assert downs == [f'xsky-jobs-{job_id}']
+        events = state_lib.get_recovery_events(
+            event_type='reconcile.orphan_teardown')
+        assert events and \
+            events[-1]['scope'] == f'cluster/xsky-jobs-{job_id}'
+        # Idempotence: the record is gone; a second pass no-ops.
+        assert reconciler.reconcile_jobs() == []
+
+    def test_recordless_job_cluster_torn_down(self, control_plane_env,
+                                              downs):
+        state_lib.add_or_update_cluster('xsky-jobs-424242', None,
+                                        ready=True)
+        repairs = reconciler.reconcile_jobs()
+        assert [r['action'] for r in repairs] == ['orphan_teardown']
+        assert downs == ['xsky-jobs-424242']
+
+    def test_live_job_cluster_left_alone(self, control_plane_env, downs):
+        from skypilot_tpu.jobs import state as jobs_state
+        job_id = jobs_state.add_job('alive', {'run': 'echo x'})
+        jobs_state.set_status(job_id,
+                              jobs_state.ManagedJobStatus.RUNNING)
+        state_lib.add_or_update_cluster(f'xsky-jobs-{job_id}', None,
+                                        ready=True)
+        # Non-jobs clusters are never candidates either.
+        state_lib.add_or_update_cluster('my-train', None, ready=True)
+        assert reconciler.reconcile_jobs() == []
+        assert downs == []
+
+    def test_orphan_serve_replica_cluster_torn_down(
+            self, control_plane_env, downs):
+        from skypilot_tpu.serve import state as serve_state
+        serve_state.add_service('live-svc', {}, 0)
+        # A live controller (this process) owns the service, so the
+        # controller-respawn arm of the serve reconcile stays quiet.
+        serve_state.set_service_controller_pid('live-svc', os.getpid())
+        state_lib.add_or_update_cluster('xsky-serve-live-svc-1', None,
+                                        ready=True)
+        state_lib.add_or_update_cluster('xsky-serve-ghost-2', None,
+                                        ready=True)
+        repairs = reconciler.reconcile_serve()
+        assert [r['action'] for r in repairs] == ['orphan_teardown']
+        assert downs == ['xsky-serve-ghost-2']
+        assert reconciler.reconcile_serve() == []
+
+    def test_stale_leases_of_finished_scopes_dropped(
+            self, control_plane_env, downs):
+        from skypilot_tpu.jobs import state as jobs_state
+        job_id = jobs_state.add_job('done', {'run': 'echo x'})
+        jobs_state.set_status(job_id,
+                              jobs_state.ManagedJobStatus.SUCCEEDED)
+        state_lib.heartbeat_lease(f'job/{job_id}',
+                                  owner='jobs-controller')
+        state_lib.heartbeat_lease('service/ghost',
+                                  owner='serve-controller')
+        reconciler.reconcile()
+        assert state_lib.get_lease(f'job/{job_id}') is None
+        assert state_lib.get_lease('service/ghost') is None
+
+
+class TestDoctor:
+
+    def test_doctor_reports_health_and_fix_reconciles(
+            self, control_plane_env, monkeypatch):
+        from click.testing import CliRunner
+        from skypilot_tpu.client import cli as cli_mod
+        from skypilot_tpu.server import requests_db
+        rid = requests_db.create('launch', 'u', {})
+        requests_db.set_status(rid, requests_db.RequestStatus.RUNNING)
+        runner = CliRunner()
+        result = runner.invoke(cli_mod.cli, ['doctor'])
+        assert result.exit_code == 1, result.output
+        assert 'Stranded in-flight requests' in result.output
+        result = runner.invoke(cli_mod.cli, ['doctor', '--fix'])
+        assert result.exit_code == 0, result.output
+        assert 'request_aborted' in result.output
+        # Healed: a second doctor run reports a healthy control plane.
+        result = runner.invoke(cli_mod.cli, ['doctor'])
+        assert result.exit_code == 0, result.output
+        assert 'healthy' in result.output
+
+    def test_health_report_annotates_lease_liveness(self, lease_env):
+        state_lib.heartbeat_lease('job/7', owner='jobs-controller',
+                                  ttl_s=600)
+        state_lib.heartbeat_lease('job/8', owner='jobs-controller',
+                                  pid=2 ** 22 + 999, ttl_s=600)
+        report = reconciler.health_report()
+        by_scope = {l['scope']: l for l in report['leases']}
+        assert by_scope['job/7']['live']
+        assert by_scope['job/7']['pid_alive']
+        assert not by_scope['job/8']['live']
+        assert not by_scope['job/8']['pid_alive']
+
+
+class TestCrashSmoke:
+    """The acceptance scenario: a chaos plan SIGKILLs the real
+    jobs-controller subprocess once mid-run; reconciliation must bring
+    the job to SUCCEEDED, the journal must hold the kill and the
+    reconcile events, and a second reconciler pass must be a no-op."""
+
+    KILL_PLAN = {
+        'points': {
+            # Generation-keyed: only the ORIGINAL controller (respawn
+            # generation 0) dies; the reconciler-respawned one, which
+            # inherits the same plan via the env var, survives.
+            'jobs.controller_kill': {'match': {'respawn': 0},
+                                     'first_n': 1,
+                                     'signal': 'SIGKILL'},
+        },
+    }
+
+    def test_controller_sigkill_reconciles_to_success(
+            self, control_plane_env, monkeypatch, tmp_path):
+        from skypilot_tpu import Resources, Task
+        from skypilot_tpu.jobs import core as jobs_core
+        from skypilot_tpu.jobs import state as jobs_state
+
+        monkeypatch.setenv('XSKY_JOBS_POLL_INTERVAL', '0.2')
+        plan_file = tmp_path / 'kill.json'
+        plan_file.write_text(json.dumps(self.KILL_PLAN))
+        # Via the env var so the controller SUBPROCESS tree sees it.
+        monkeypatch.setenv('XSKY_CHAOS_PLAN', str(plan_file))
+
+        task = Task('crash', run='sleep 1; echo crash-ok')
+        task.set_resources(Resources(accelerators='tpu-v5e-8',
+                                     use_spot=True))
+        job_id = jobs_core.launch(task)
+
+        first_pid = None
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            record = jobs_state.get_job(job_id)
+            if first_pid is None and record['controller_pid']:
+                first_pid = record['controller_pid']
+            if record['status'].is_terminal():
+                break
+            # The repair loop under test: periodic reconcile ticks
+            # (what the API server's background reconciler runs).
+            reconciler.reconcile(requeue_requests=False)
+            time.sleep(0.3)
+        record = jobs_state.get_job(job_id)
+        assert record['status'] == \
+            jobs_state.ManagedJobStatus.SUCCEEDED, record
+
+        # The kill actually happened (journalled by the dying
+        # controller before the signal landed), and the controller
+        # that finished is a different process.
+        injected = [r for r in state_lib.get_recovery_events(
+            event_type='chaos.injected')
+            if r['scope'] == 'chaos/jobs.controller_kill']
+        assert injected, 'chaos kill never fired'
+        assert record['controller_pid'] != first_pid
+
+        # The fault→reconcile→recover timeline is one journal query.
+        types = [r['event_type'] for r in
+                 state_lib.get_recovery_events(scope=f'job/{job_id}')]
+        assert 'reconcile.controller_respawn' in types
+
+        # Terminal status lands BEFORE cleanup by design; let the
+        # respawned controller finish teardown + lease release (its
+        # job_done is the last step) before asserting quiescence.
+        deadline = time.time() + 60
+        while time.time() < deadline and (
+                state_lib.get_lease(f'job/{job_id}') is not None or
+                state_lib.get_cluster_from_name(
+                    record['cluster_name']) is not None):
+            time.sleep(0.3)
+        # Clean exit released the job lease.
+        assert state_lib.get_lease(f'job/{job_id}') is None
+
+        # Idempotence: the control plane is healthy again — another
+        # full pass repairs nothing, and doctor agrees.
+        assert reconciler.reconcile(requeue_requests=False) == []
+        report = reconciler.health_report()
+        assert report['healthy'], report
