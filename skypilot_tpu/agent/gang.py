@@ -252,6 +252,14 @@ def gang_launch(runners: Sequence[runner_lib.CommandRunner],
     except Exception:
         for p in procs:
             _kill_tree(p, sig_kill=True)
+            # _start registered p in ACTIVE_PROCS before the fan-out
+            # died; without this, the killed procs stay registered for
+            # the life of the runner and every later kill_active()
+            # re-signals their (recycled) pids.
+            try:
+                ACTIVE_PROCS.remove(p)
+            except ValueError:
+                pass
         raise
 
     start_time = time.time()

@@ -30,6 +30,7 @@ from skypilot_tpu.backends import failover
 from skypilot_tpu.backends import wheel_utils
 from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu.utils import command_runner as runner_lib
+from skypilot_tpu.utils import parallelism
 from skypilot_tpu.utils import registry
 
 if typing.TYPE_CHECKING:
@@ -222,20 +223,31 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
         sky/provision/provisioner.py:671 — minus Ray cluster start. The
         wheel ship+install matches internal_file_mounts + runtime setup,
         sky/provision/instance_setup.py:540.)
+
+        Every per-host step fans out through
+        ``parallelism.run_in_parallel`` — at pod scale (64 hosts) the
+        sequential loops made bring-up latency O(num_hosts).
         """
         runners = handle.get_command_runners()
         for cmd in handle.cluster_info.mount_commands:
             # Volume mounts (idempotent; provider-built). Every host
             # mounts before anything else lands on the cluster.
-            for rank, runner in enumerate(runners):
+            def _mount(pair, cmd=cmd):
+                rank, runner = pair
                 rc, _, stderr = runner.run(cmd, require_outputs=True)
                 if rc != 0:
                     raise exceptions.ClusterSetUpError(
                         f'Volume mount failed on host {rank}: '
                         f'{stderr.strip()} (cmd: {cmd})')
+
+            parallelism.run_in_parallel(
+                _mount, list(enumerate(runners)),
+                phase='mount', what='volume mount')
         if self._bootstraps(handle):
             wheel_path, content_hash = wheel_utils.build_wheel()
-            for rank, runner in enumerate(runners):
+
+            def _bootstrap(pair):
+                rank, runner = pair
                 try:
                     self._bootstrap_host(handle, runner, wheel_path,
                                          content_hash)
@@ -243,6 +255,10 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                     raise exceptions.ClusterSetUpError(
                         f'Runtime bootstrap failed on host {rank}: '
                         f'{e}') from e
+
+            parallelism.run_in_parallel(
+                _bootstrap, list(enumerate(runners)),
+                phase='bootstrap', what='runtime bootstrap')
         head = runners[0]
         root = handle.head_runtime_root
         # cluster_name rides along for the agent's self-teardown path
@@ -265,12 +281,18 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             # module docstring has the layout contract).
             from skypilot_tpu.utils import docker_utils
             init = docker_utils.initialize_command(image)
-            for rank, runner in enumerate(runners):
+
+            def _docker_init(pair):
+                rank, runner = pair
                 rc, _, stderr = runner.run(init, require_outputs=True)
                 if rc != 0:
                     raise exceptions.ClusterSetUpError(
                         f'Docker runtime init failed on host {rank}: '
                         f'{stderr.strip()[:500]}')
+
+            parallelism.run_in_parallel(
+                _docker_init, list(enumerate(runners)),
+                phase='docker_init', what='docker runtime init')
         if not handle.is_local_provider:
             head.run_async(
                 f'{self._head_python(handle)} -m skypilot_tpu.agent.daemon',
@@ -370,25 +392,37 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
 
     def sync_workdir(self, handle: ClusterHandle, workdir: str) -> None:
         runners = handle.get_command_runners()
-        for runner in runners:
-            runner.rsync(os.path.join(os.path.expanduser(workdir), ''),
-                         'sky_workdir/', up=True,
-                         excludes=['.git'])
+        src = os.path.join(os.path.expanduser(workdir), '')
+
+        def _sync(pair):
+            _, runner = pair
+            runner.rsync(src, 'sky_workdir/', up=True, excludes=['.git'])
+
+        parallelism.run_in_parallel(
+            _sync, list(enumerate(runners)),
+            phase='sync_workdir', what=f'workdir sync ({workdir})')
 
     def sync_file_mounts(self, handle: ClusterHandle,
                          all_file_mounts: Optional[Dict[str, str]],
                          storage_mounts: Optional[Dict[str, Any]]) -> None:
+        runners = handle.get_command_runners()
         for target, source in (all_file_mounts or {}).items():
             source = os.path.expanduser(source)
             if not os.path.exists(source):
                 raise FileNotFoundError(
                     f'file_mount source {source} not found')
-            for runner in handle.get_command_runners():
+
+            def _push(pair, source=source, target=target):
+                _, runner = pair
                 if os.path.isdir(source):
                     runner.rsync(os.path.join(source, ''),
                                  target.rstrip('/') + '/', up=True)
                 else:
                     runner.rsync(source, target, up=True)
+
+            parallelism.run_in_parallel(
+                _push, list(enumerate(runners)),
+                phase='file_mounts', what=f'file mount ({target})')
         if storage_mounts:
             from skypilot_tpu.data import storage_mounting
             storage_mounting.mount_storage_on_cluster(
@@ -418,13 +452,19 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             from skypilot_tpu.utils import docker_utils
             setup_cmd = docker_utils.exec_wrap(setup_cmd, env, cwd=cwd)
             cwd = None   # cd happens inside the container
-        for rank, runner in enumerate(runners):
+
+        def _setup(pair):
+            rank, runner = pair
             rc, out, err = runner.run(setup_cmd, env=env, cwd=cwd,
                                       require_outputs=True)
             if rc != 0:
                 raise exceptions.ClusterSetUpError(
                     f'Setup failed on host {rank} (rc={rc}): '
                     f'{err or out}')
+
+        parallelism.run_in_parallel(
+            _setup, list(enumerate(runners)),
+            phase='setup', what='task setup')
 
     def execute(self, handle: ClusterHandle, task: 'task_lib.Task',
                 detach_run: bool = False,
@@ -656,10 +696,21 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
 
     def cancel_jobs(self, handle: ClusterHandle, job_ids) -> None:
         head = handle.head_runner()
-        for job_id in job_ids:
+
+        def _cancel(job_id):
+            # Best-effort (rc ignored), matching the sequential loop.
             head.run(f'{self._head_python(handle)} -m '
                      f'skypilot_tpu.agent.job_cli cancel '
                      f'{job_id}', env=self._agent_env(handle))
+
+        try:
+            parallelism.run_in_parallel(
+                _cancel, list(job_ids),
+                phase='cancel_jobs', what='job cancel')
+        except exceptions.MultiHostError as e:
+            # A cancel exec raising (dead head mid-teardown) was never
+            # fatal in the sequential loop either.
+            logger.warning(f'Job cancel fan-out incomplete: {e}')
 
     def tail_logs(self, handle: ClusterHandle, job_id: Optional[int],
                   follow: bool = True) -> str:
@@ -701,8 +752,28 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
         if job_id is not None:
             head.rsync(os.path.join(local_dir, f'job-{job_id}'),
                        f'{remote_logs}/job-{job_id}/', up=False)
-        else:
+            return local_dir
+        # All jobs: one rsync per job dir, fanned out — a long-lived
+        # cluster accumulates hundreds of job dirs and the single
+        # recursive rsync serialized them behind one ssh stream.
+        rc, out, _ = head.run(f'ls -1 {remote_logs} 2>/dev/null',
+                              env=self._agent_env(handle),
+                              require_outputs=True)
+        job_dirs = [d for d in out.split() if d.startswith('job-')] \
+            if rc == 0 else []
+        if not job_dirs:
+            # Listing failed or nothing job-shaped: the old recursive
+            # pull still works and covers non-job log files.
             head.rsync(local_dir, f'{remote_logs}/', up=False)
+            return local_dir
+
+        def _pull(job_dir):
+            head.rsync(os.path.join(local_dir, job_dir),
+                       f'{remote_logs}/{job_dir}/', up=False)
+
+        parallelism.run_in_parallel(
+            _pull, job_dirs,
+            phase='sync_down_logs', what='log sync-down')
         return local_dir
 
     # ---- teardown / autostop ----
@@ -713,15 +784,36 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
         provider = cloud.provisioner_module
         try:
             if terminate:
-                provision_lib.terminate_instances(
-                    provider, handle.cluster_name,
-                    handle.cluster_info.provider_config)
-                # Port rules are per-cluster resources (firewall
-                # allow-rules on the cluster tag): reap them with the
-                # instances. Best-effort — the provider logs failures.
-                provision_lib.cleanup_ports(
-                    provider, handle.cluster_name,
-                    handle.cluster_info.provider_config)
+                # Instance termination and port-rule cleanup are
+                # independent per-cluster resources: overlap them
+                # (each can be a slow cloud API round trip). A plain
+                # side thread, NOT run_in_parallel: the purge /
+                # NotSupportedError guards below key on the original
+                # exception types, which a MultiHostError wrapper
+                # would defeat.
+                import threading
+                ports_err: List[BaseException] = []
+
+                def _cleanup_ports():
+                    try:
+                        provision_lib.cleanup_ports(
+                            provider, handle.cluster_name,
+                            handle.cluster_info.provider_config)
+                    except Exception as e:  # pylint: disable=broad-except
+                        ports_err.append(e)
+
+                ports_thread = threading.Thread(
+                    target=_cleanup_ports, daemon=True,
+                    name=f'xsky-ports-{handle.cluster_name}')
+                ports_thread.start()
+                try:
+                    provision_lib.terminate_instances(
+                        provider, handle.cluster_name,
+                        handle.cluster_info.provider_config)
+                finally:
+                    ports_thread.join()
+                if ports_err:
+                    raise ports_err[0]
             else:
                 provision_lib.stop_instances(
                     provider, handle.cluster_name,

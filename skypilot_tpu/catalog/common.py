@@ -142,8 +142,25 @@ def _maybe_generate(cloud: str) -> None:
         save_catalog(cloud, fetcher.generate())
 
 
+@functools.lru_cache(maxsize=None)
+def instance_type_index(cloud: str) -> Dict[str, List[CatalogEntry]]:
+    """``{instance_type: [entries]}`` for one cloud's catalog.
+
+    The per-instance-type query helpers below are called per candidate
+    inside the optimizer's feasibility/pricing loops; rescanning the
+    full entry list each call made those loops O(catalog) per lookup.
+    Built lazily from :func:`load_catalog`; invalidated together with
+    it by :func:`clear_cache`.
+    """
+    index: Dict[str, List[CatalogEntry]] = {}
+    for e in load_catalog(cloud):
+        index.setdefault(e.instance_type, []).append(e)
+    return index
+
+
 def clear_cache() -> None:
     load_catalog.cache_clear()
+    instance_type_index.cache_clear()
 
 
 # --- generic query helpers (used by per-cloud catalog modules) -------------
@@ -155,15 +172,16 @@ def filter_entries(cloud: str,
 
 
 def instance_type_exists(cloud: str, instance_type: str) -> bool:
-    return any(e.instance_type == instance_type for e in load_catalog(cloud))
+    return instance_type in instance_type_index(cloud)
 
 
 def get_vcpus_mem_from_instance_type(
         cloud: str, instance_type: str) -> Optional[tuple]:
-    for e in load_catalog(cloud):
-        if e.instance_type == instance_type:
-            return (e.vcpus, e.memory_gib)
-    return None
+    entries = instance_type_index(cloud).get(instance_type)
+    if not entries:
+        return None
+    e = entries[0]
+    return (e.vcpus, e.memory_gib)
 
 
 def get_hourly_cost(cloud: str,
@@ -172,9 +190,8 @@ def get_hourly_cost(cloud: str,
                     region: Optional[str] = None,
                     zone: Optional[str] = None) -> float:
     candidates = [
-        e for e in load_catalog(cloud)
-        if e.instance_type == instance_type and
-        (region is None or e.region == region) and
+        e for e in instance_type_index(cloud).get(instance_type, [])
+        if (region is None or e.region == region) and
         (zone is None or e.zone == zone)
     ]
     if not candidates:

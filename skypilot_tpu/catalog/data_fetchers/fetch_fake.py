@@ -31,13 +31,16 @@ def generate() -> List[common.CatalogEntry]:
         entries.append(
             common.CatalogEntry('fake-gpu-8', 'FAKEGPU', 8, 96, 680, 320,
                                 20.0, 6.0, region, zone))
-        # TPU twins: single host and a 4-host pod slice.
-        entries.append(
-            common.CatalogEntry('', 'tpu-v5e-8', 1, 112, 192, 128, 9.6, 3.36,
-                                region, zone))
-        entries.append(
-            common.CatalogEntry('', 'tpu-v5e-32', 1, 448, 768, 512, 38.4,
-                                13.44, region, zone))
+        # TPU twins: a v5e pod ladder from one host to 32 hosts
+        # (fan-out / launch-latency tests at pod scale; per-host specs
+        # scale linearly from the single-host offering).
+        for chips in (8, 32, 64, 128, 256):
+            hosts = chips // 8
+            entries.append(
+                common.CatalogEntry('', f'tpu-v5e-{chips}', 1,
+                                    112 * hosts, 192 * hosts,
+                                    128 * hosts, 9.6 * hosts,
+                                    3.36 * hosts, region, zone))
         entries.append(
             common.CatalogEntry('', 'tpu-v5p-64', 1, 208 * 8, 448 * 8,
                                 95.0 * 32, 134.4, 47.04, region, zone))

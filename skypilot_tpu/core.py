@@ -101,12 +101,17 @@ def status(cluster_names: Optional[List[str]] = None,
     if cluster_names:
         records = [r for r in records if r['name'] in cluster_names]
     if refresh:
-        refreshed = []
-        for r in records:
-            nr = refresh_cluster_status(r['name'])
-            if nr is not None:
-                refreshed.append(nr)
-        records = refreshed
+        # Each refresh is a cloud API round trip (plus an autostop
+        # probe against the head host): fan the clusters out instead
+        # of paying the sum of every provider's latency. Per-cluster
+        # provider errors are already swallowed inside
+        # refresh_cluster_status, so one unreachable cloud cannot
+        # fail the whole status call.
+        from skypilot_tpu.utils import parallelism
+        refreshed = parallelism.run_in_parallel(
+            lambda r: refresh_cluster_status(r['name']), records,
+            phase='status_refresh', what='status refresh')
+        records = [r for r in refreshed if r is not None]
     return records
 
 

@@ -37,14 +37,22 @@ def mount_storage_on_cluster(handle: Any,
             instance as k8s_instance)
         k8s_instance.deploy_fuse_proxy(
             handle.cluster_info.provider_config or {})
+    from skypilot_tpu.utils import parallelism
     for mount_path, storage in storages:
         cmd = storage.cluster_command(mount_path)
         logger.info(f'Mounting {storage.name} at {mount_path} '
                     f'({storage.mode.value}) on {len(runners)} host(s)')
-        for runner in runners:
-            result = runner.run(cmd, require_outputs=True)
-            rc, _, stderr = result
+
+        def _mount(pair, cmd=cmd, storage=storage,
+                   mount_path=mount_path):
+            rank, runner = pair
+            rc, _, stderr = runner.run(cmd, require_outputs=True)
             if rc != 0:
                 raise exceptions.StorageError(
                     f'Mounting {storage.name} at {mount_path} failed '
-                    f'(rc={rc}): {stderr}')
+                    f'on host {rank} (rc={rc}): {stderr}')
+
+        parallelism.run_in_parallel(
+            _mount, list(enumerate(runners)),
+            phase='storage_mount',
+            what=f'storage mount ({storage.name} at {mount_path})')

@@ -124,6 +124,49 @@ class ClusterSetUpError(SkyTpuError):
     """Setup commands failed on the cluster."""
 
 
+class MultiHostError(ClusterSetUpError):
+    """A parallel per-host fan-out failed on one or more ranks.
+
+    Aggregates every failed rank's error (not just the first), so a
+    64-host bring-up that lost ranks 3 and 41 names both in one
+    exception. Subclasses ClusterSetUpError: callers that caught the
+    sequential loops' per-host setup errors keep working unchanged.
+
+    Attributes:
+        what: human-readable phase name ('task setup', 'runtime
+            bootstrap', ...).
+        failures: rank → the exception that rank raised.
+        total: number of items the fan-out was asked to run.
+        not_started: ranks never started because an earlier failure
+            (or deadline expiry) aborted the phase — gang semantics.
+    """
+
+    def __init__(self, what: str, failures=None, total=None,
+                 not_started=()) -> None:
+        self.what = what
+        self.failures = dict(failures or {})
+        self.total = total if total is not None else len(self.failures)
+        self.not_started = tuple(not_started)
+        if failures is None and total is None:
+            # Single-arg reconstruction (deserialize_exception calls
+            # cls(message) when an error crosses the API-server wire):
+            # keep the already-rendered message verbatim so remote
+            # clients still see — and `except ClusterSetUpError` still
+            # catches — the aggregated per-rank report.
+            super().__init__(what)
+            return
+        parts = [
+            f'[host {rank}] {type(err).__name__}: {err}'
+            for rank, err in sorted(self.failures.items())
+        ]
+        msg = (f'{what} failed on {len(self.failures)}/{self.total} '
+               f'host(s): ' + '; '.join(parts))
+        if self.not_started:
+            msg += (f' ({len(self.not_started)} host(s) not started: '
+                    f'{list(self.not_started)})')
+        super().__init__(msg)
+
+
 class CommandError(SkyTpuError):
     """A remote command exited non-zero."""
 

@@ -2,7 +2,9 @@
 
 The fake cloud's provision-time ``FailureInjector`` scripts *provisioning*
 failures; this module covers everything after bring-up — SSH transport,
-gang fan-out, status probes, serve readiness probes — so the recovery
+gang fan-out, the control plane's parallel host fan-out
+(``fanout.worker``, with ``phase``/``rank`` context), status probes,
+serve readiness probes — so the recovery
 machinery (jobs controller, gang retry, serve replica recovery, failover
 engine) can be driven under fault deterministically.
 

@@ -244,6 +244,7 @@ class TestNoRawSleepLint:
         'skypilot_tpu/serve/replica_managers.py',
         'skypilot_tpu/provision/do/rest.py',
         'skypilot_tpu/provision/lambda_cloud/rest.py',
+        'skypilot_tpu/utils/parallelism.py',
         'skypilot_tpu/utils/resilience.py',
     ]
     # resilience.py IS the choke point: its Deadline.sleep / module
@@ -301,6 +302,92 @@ class TestNoRawSleepLint:
         assert self._raw_sleeps_in_loops(tree) == [(4, 'poll')]
         clean = ast.parse('import time\ntime.sleep(1)\n')   # not a loop
         assert self._raw_sleeps_in_loops(clean) == []
+
+
+class TestNoSequentialRunnerLoopLint:
+    """Control-plane code must not fan per-host work out with a
+    sequential ``for ... in ...runners...`` loop: every such loop is
+    O(num_hosts) launch latency at pod scale. Host fan-out goes
+    through ``parallelism.run_in_parallel`` (bounded concurrency,
+    aggregated MultiHostError, deadline, chaos point, trace events).
+
+    The lint flags any ``for`` loop in ``backends/`` or ``serve/``
+    whose iterable mentions a ``runners`` collection and whose body
+    calls ``<runner>.run`` / ``<runner>.rsync`` / ``<runner>.run_async``
+    directly."""
+
+    SCANNED_DIRS = ['skypilot_tpu/backends', 'skypilot_tpu/serve']
+    RUNNER_OPS = {'run', 'rsync', 'run_async'}
+
+    @classmethod
+    def _sequential_runner_loops(cls, tree):
+        """(lineno, op) of every for-loop over a runners collection
+        whose body drives a runner method directly."""
+        offenders = []
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.For, ast.AsyncFor)):
+                continue
+            iter_names = set()
+            for sub in ast.walk(node.iter):
+                if isinstance(sub, ast.Name):
+                    iter_names.add(sub.id)
+                elif isinstance(sub, ast.Attribute):
+                    iter_names.add(sub.attr)
+            if not any('runners' in name.lower()
+                       for name in iter_names):
+                continue
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if (isinstance(sub, ast.Call) and
+                            isinstance(sub.func, ast.Attribute) and
+                            sub.func.attr in cls.RUNNER_OPS and
+                            isinstance(sub.func.value, ast.Name) and
+                            'runner' in sub.func.value.id.lower()):
+                        offenders.append((sub.lineno, sub.func.attr))
+        return offenders
+
+    def test_no_sequential_runner_loops_in_control_plane(self):
+        repo_root = os.path.join(os.path.dirname(__file__), '..', '..')
+        violations = []
+        for rel_dir in self.SCANNED_DIRS:
+            abs_dir = os.path.join(repo_root, rel_dir)
+            for dirpath, _, filenames in os.walk(abs_dir):
+                for fname in sorted(filenames):
+                    if not fname.endswith('.py'):
+                        continue
+                    path = os.path.join(dirpath, fname)
+                    rel = os.path.relpath(path, repo_root)
+                    with open(path, encoding='utf-8') as f:
+                        tree = ast.parse(f.read(), filename=rel)
+                    violations.extend(
+                        f'{rel}:{line} (runner.{op})'
+                        for line, op in
+                        self._sequential_runner_loops(tree))
+        assert not violations, (
+            'sequential per-host runner loop — use '
+            'parallelism.run_in_parallel for host fan-out:\n  ' +
+            '\n  '.join(violations))
+
+    def test_lint_catches_a_sequential_runner_loop(self):
+        tree = ast.parse(
+            'def setup(runners):\n'
+            '    for rank, runner in enumerate(runners):\n'
+            '        runner.run("true")\n')
+        assert self._sequential_runner_loops(tree) == [(3, 'run')]
+        # Fan-out through the primitive (runner driven inside a helper
+        # fn, not a for-body) passes.
+        clean = ast.parse(
+            'def setup(runners):\n'
+            '    def _one(pair):\n'
+            '        rank, runner = pair\n'
+            '        runner.run("true")\n'
+            '    run_in_parallel(_one, list(enumerate(runners)))\n')
+        assert self._sequential_runner_loops(clean) == []
+        # A loop over something else entirely is not flagged.
+        other = ast.parse(
+            'for job_id in job_ids:\n'
+            '    head.run(str(job_id))\n')
+        assert self._sequential_runner_loops(other) == []
 
 
 class TestLeaseHeartbeatLint:

@@ -1,0 +1,382 @@
+"""Parallel host fan-out: run_in_parallel mechanics (ordering,
+multi-rank failure aggregation, deadlines, sequential degeneration,
+chaos/timeline interplay), the catalog instance-type index, the gang
+start-loop ACTIVE_PROCS cleanup, and the tier-1 fan-out-abort smoke."""
+import json
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import chaos
+from skypilot_tpu.utils import parallelism
+from skypilot_tpu.utils import resilience
+from skypilot_tpu.utils import timeline
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+class _ConcurrencyProbe:
+    """Callable tracking peak concurrent executions."""
+
+    def __init__(self, delay: float = 0.0, fail_ranks=()):
+        self.delay = delay
+        self.fail_ranks = set(fail_ranks)
+        self.started = []
+        self.cur = 0
+        self.peak = 0
+        self._lock = threading.Lock()
+
+    def __call__(self, rank):
+        with self._lock:
+            self.started.append(rank)
+            self.cur += 1
+            self.peak = max(self.peak, self.cur)
+        try:
+            if self.delay:
+                time.sleep(self.delay)
+            if rank in self.fail_ranks:
+                raise RuntimeError(f'boom-{rank}')
+            return rank * 10
+        finally:
+            with self._lock:
+                self.cur -= 1
+
+
+class TestRunInParallel:
+
+    def test_empty_args(self):
+        assert parallelism.run_in_parallel(lambda x: x, []) == []
+
+    def test_ordered_results_under_out_of_order_completion(self):
+        # Rank 0 finishes LAST; results must still be in input order.
+        delays = [0.2, 0.0, 0.1, 0.05]
+        order = []
+        lock = threading.Lock()
+
+        def fn(pair):
+            rank, delay = pair
+            time.sleep(delay)
+            with lock:
+                order.append(rank)
+            return rank * 10
+
+        results = parallelism.run_in_parallel(
+            fn, list(enumerate(delays)), max_workers=4)
+        assert results == [0, 10, 20, 30]
+        assert order != [0, 1, 2, 3]       # completion really reordered
+        assert order[-1] == 0
+
+    def test_multi_rank_failure_aggregation(self):
+        """Ranks 1 and 3 both fail: the MultiHostError names BOTH, not
+        just the first, and carries each rank's exception."""
+        probe = _ConcurrencyProbe(delay=0.05, fail_ranks={1, 3})
+        with pytest.raises(exceptions.MultiHostError) as ei:
+            parallelism.run_in_parallel(
+                probe, [0, 1, 2, 3], max_workers=4, what='unit phase')
+        err = ei.value
+        assert set(err.failures) == {1, 3}
+        assert isinstance(err.failures[1], RuntimeError)
+        assert 'host 1' in str(err) and 'host 3' in str(err)
+        assert 'boom-1' in str(err) and 'boom-3' in str(err)
+        assert err.total == 4
+        # It is also a ClusterSetUpError: sequential-era callers still
+        # catch it.
+        assert isinstance(err, exceptions.ClusterSetUpError)
+
+    def test_failure_aborts_unstarted_ranks(self):
+        """Gang semantics: ranks still queued when a failure lands
+        never start (and are reported as not_started)."""
+        probe = _ConcurrencyProbe(delay=0.15, fail_ranks={0})
+        with pytest.raises(exceptions.MultiHostError) as ei:
+            parallelism.run_in_parallel(
+                probe, list(range(8)), max_workers=2)
+        err = ei.value
+        assert 0 in err.failures
+        # Whatever was cancelled truly never ran.
+        assert set(err.not_started).isdisjoint(set(probe.started))
+        # With 2 workers and rank 0 failing early, the tail of the
+        # queue must have been cancelled.
+        assert err.not_started
+
+    def test_deadline_expiry_kills_stragglers(self):
+        """Budget spent with ranks still running: they are recorded as
+        DeadlineExceeded failures and the call returns promptly
+        instead of waiting out the stragglers."""
+        t0 = time.monotonic()
+        with pytest.raises(exceptions.MultiHostError) as ei:
+            parallelism.run_in_parallel(
+                lambda x: time.sleep(1.0), [1, 2, 3], max_workers=3,
+                deadline=resilience.Deadline(0.25), what='slowphase')
+        elapsed = time.monotonic() - t0
+        assert elapsed < 0.9, elapsed
+        err = ei.value
+        assert set(err.failures) == {0, 1, 2}
+        assert all(isinstance(e, resilience.DeadlineExceeded)
+                   for e in err.failures.values())
+
+    def test_deadline_expiry_cancels_queued_ranks(self):
+        with pytest.raises(exceptions.MultiHostError) as ei:
+            parallelism.run_in_parallel(
+                lambda x: time.sleep(1.0), list(range(6)), max_workers=2,
+                deadline=resilience.Deadline(0.3))
+        err = ei.value
+        assert set(err.failures) == {0, 1}      # the two in flight
+        assert sorted(err.not_started) == [2, 3, 4, 5]
+
+    def test_workers_1_is_sequential_fail_fast(self):
+        """max_workers=1 degenerates to the old sequential loop: ranks
+        run strictly in order, one at a time, and the first failure
+        aborts before the next rank starts."""
+        probe = _ConcurrencyProbe(delay=0.02, fail_ranks={1})
+        with pytest.raises(exceptions.MultiHostError) as ei:
+            parallelism.run_in_parallel(probe, [0, 1, 2, 3],
+                                        max_workers=1)
+        assert probe.started == [0, 1]          # 2, 3 never ran
+        assert probe.peak == 1
+        err = ei.value
+        assert set(err.failures) == {1}
+        assert sorted(err.not_started) == [2, 3]
+
+    def test_env_var_sets_default_width(self, monkeypatch):
+        monkeypatch.setenv('XSKY_FANOUT_WORKERS', '1')
+        probe = _ConcurrencyProbe(delay=0.02)
+        assert parallelism.run_in_parallel(probe, [0, 1, 2]) == \
+            [0, 10, 20]
+        assert probe.peak == 1
+        assert probe.started == [0, 1, 2]
+        monkeypatch.setenv('XSKY_FANOUT_WORKERS', '4')
+        probe2 = _ConcurrencyProbe(delay=0.1)
+        parallelism.run_in_parallel(probe2, [0, 1, 2, 3])
+        assert probe2.peak > 1
+        # Garbage falls back to the default instead of crashing a
+        # launch.
+        monkeypatch.setenv('XSKY_FANOUT_WORKERS', 'lots')
+        assert parallelism.fanout_workers() == \
+            parallelism.DEFAULT_FANOUT_WORKERS
+
+    def test_chaos_point_fails_individual_rank(self):
+        """A chaos rule matched on (phase, rank) fails exactly that
+        rank mid-fan-out; every rank traverses the point."""
+        chaos.load_plan({'points': {'fanout.worker': {
+            'match': {'phase': 'unitboot', 'rank': 2},
+            'first_n': 1, 'error': 'ConnectionError'}}})
+        probe = _ConcurrencyProbe(delay=0.1)
+        with pytest.raises(exceptions.MultiHostError) as ei:
+            parallelism.run_in_parallel(probe, [0, 1, 2, 3],
+                                        max_workers=4, phase='unitboot')
+        err = ei.value
+        assert set(err.failures) == {2}
+        assert isinstance(err.failures[2], ConnectionError)
+        assert chaos.hits('fanout.worker') == 4
+        # Rank 2 failed at the chaos point, before fn ran.
+        assert 2 not in probe.started
+
+    def test_chaos_latency_is_absorbed_in_parallel(self, monkeypatch,
+                                                   tmp_path):
+        """The micro form of the bench claim: per-rank injected setup
+        latency costs ~1× in parallel and ~N× sequentially."""
+        # Fresh sqlite for the chaos journal: each fire commits a
+        # journal row under a module-wide lock, and a slow shared
+        # ~/.xsky DB would let serialized fsyncs dominate the
+        # injected latency and flake the ratio below.
+        monkeypatch.setenv('XSKY_STATE_DB', str(tmp_path / 'state.db'))
+        chaos.load_plan({'points': {'fanout.worker': {
+            'latency_s': 0.3}}})
+        items = list(range(4))
+        t0 = time.monotonic()
+        parallelism.run_in_parallel(lambda x: x, items, max_workers=4)
+        parallel_s = time.monotonic() - t0
+        t0 = time.monotonic()
+        parallelism.run_in_parallel(lambda x: x, items, max_workers=1)
+        sequential_s = time.monotonic() - t0
+        assert sequential_s >= 1.2               # 4 × 0.3
+        assert parallel_s < sequential_s * 0.75
+
+    def test_timeline_events_show_phase_concurrency(self, monkeypatch,
+                                                    tmp_path):
+        trace = tmp_path / 'trace.json'
+        monkeypatch.setenv('XSKY_TIMELINE_FILE', str(trace))
+        timeline.reset_for_test()
+        parallelism.run_in_parallel(
+            lambda x: time.sleep(0.1), list(range(4)), max_workers=4,
+            phase='traced')
+        timeline.save(str(trace))
+        events = json.loads(trace.read_text())['traceEvents']
+        mine = [e for e in events if e['name'] == 'fanout.traced']
+        begins = [e for e in mine if e['ph'] == 'B']
+        ends = [e for e in mine if e['ph'] == 'E']
+        assert len(begins) == 4 and len(ends) == 4
+        assert sorted(b['args']['rank'] for b in begins) == [0, 1, 2, 3]
+        # Concurrency is visible: intervals overlap (>=2 begins before
+        # the first end).
+        first_end = min(e['ts'] for e in ends)
+        assert sum(1 for b in begins if b['ts'] < first_end) >= 2
+        timeline.reset_for_test()
+
+
+class TestCatalogIndex:
+    """The per-cloud {instance_type: [entries]} index: same answers as
+    the linear scans, invalidated by clear_cache."""
+
+    @pytest.fixture(autouse=True)
+    def _fresh_index(self):
+        from skypilot_tpu.catalog import common as catalog_common
+        catalog_common.instance_type_index.cache_clear()
+        yield
+        catalog_common.instance_type_index.cache_clear()
+
+    @staticmethod
+    def _entry(instance_type, region='r1', zone='r1-a', price=1.0,
+               spot=0.5, vcpus=8, mem=32):
+        from skypilot_tpu.catalog import common as catalog_common
+        return catalog_common.CatalogEntry(
+            instance_type=instance_type, accelerator_name='',
+            accelerator_count=0, vcpus=vcpus, memory_gib=mem,
+            accelerator_memory_gib=0, price=price, spot_price=spot,
+            region=region, zone=zone)
+
+    def _install(self, monkeypatch, entries):
+        import functools
+
+        from skypilot_tpu.catalog import common as catalog_common
+
+        @functools.lru_cache(maxsize=None)
+        def fake_load(cloud):
+            return list(entries) if cloud == 'idxcloud' else []
+
+        monkeypatch.setattr(catalog_common, 'load_catalog', fake_load)
+        catalog_common.instance_type_index.cache_clear()
+
+    def test_query_helpers_answer_from_index(self, monkeypatch):
+        from skypilot_tpu.catalog import common as catalog_common
+        self._install(monkeypatch, [
+            self._entry('m1', region='r1', price=2.0, spot=0.8),
+            self._entry('m1', region='r2', price=1.5, spot=0.0),
+            self._entry('m2', vcpus=16, mem=64, price=4.0),
+        ])
+        assert catalog_common.instance_type_exists('idxcloud', 'm1')
+        assert not catalog_common.instance_type_exists('idxcloud', 'nope')
+        assert catalog_common.get_vcpus_mem_from_instance_type(
+            'idxcloud', 'm2') == (16, 64)
+        assert catalog_common.get_vcpus_mem_from_instance_type(
+            'idxcloud', 'nope') is None
+        # Cheapest across regions; region filter narrows.
+        assert catalog_common.get_hourly_cost(
+            'idxcloud', 'm1', use_spot=False) == 1.5
+        assert catalog_common.get_hourly_cost(
+            'idxcloud', 'm1', use_spot=False, region='r1') == 2.0
+        # Zero spot prices are "no offer", not free.
+        assert catalog_common.get_hourly_cost(
+            'idxcloud', 'm1', use_spot=True) == 0.8
+        with pytest.raises(ValueError):
+            catalog_common.get_hourly_cost('idxcloud', 'nope',
+                                           use_spot=False)
+        with pytest.raises(ValueError):
+            catalog_common.get_hourly_cost('idxcloud', 'm2',
+                                           use_spot=False, region='r9')
+
+    def test_clear_cache_invalidates_index(self, monkeypatch):
+        from skypilot_tpu.catalog import common as catalog_common
+        self._install(monkeypatch, [self._entry('m1')])
+        assert catalog_common.instance_type_exists('idxcloud', 'm1')
+        self._install(monkeypatch, [self._entry('m9')])
+        # _install clears; a query after clear_cache sees the new world.
+        catalog_common.clear_cache()
+        assert not catalog_common.instance_type_exists('idxcloud', 'm1')
+        assert catalog_common.instance_type_exists('idxcloud', 'm9')
+
+    def test_index_matches_linear_scan_on_real_catalog(self):
+        from skypilot_tpu.catalog import common as catalog_common
+        entries = catalog_common.load_catalog('gcp')
+        assert entries, 'gcp catalog missing'
+        seen = []
+        for e in entries:
+            if e.instance_type and e.instance_type not in seen:
+                seen.append(e.instance_type)
+            if len(seen) >= 5:
+                break
+        for itype in seen:
+            scan = [e for e in entries if e.instance_type == itype]
+            assert catalog_common.instance_type_exists('gcp', itype)
+            assert catalog_common.get_vcpus_mem_from_instance_type(
+                'gcp', itype) == (scan[0].vcpus, scan[0].memory_gib)
+            expected = min([p for p in (e.price for e in scan) if p > 0],
+                           default=0.0)
+            assert catalog_common.get_hourly_cost(
+                'gcp', itype, use_spot=False) == expected
+
+
+class TestGangStartCleanup:
+    """A mid-fan-out start failure must deregister the already-started
+    (and killed) host processes from ACTIVE_PROCS — otherwise every
+    later kill_active() re-signals their recycled pids."""
+
+    def test_start_failure_leaves_no_active_procs(self, tmp_path):
+        from skypilot_tpu.agent import gang
+        from skypilot_tpu.utils import command_runner
+        chaos.load_plan({'points': {'gang.host_start': {
+            'match': {'rank': 2}, 'first_n': 1,
+            'error': 'ConnectionError'}}})
+        runners = [
+            command_runner.LocalProcessCommandRunner(
+                f'h{i}', host_root=str(tmp_path / f'h{i}'))
+            for i in range(4)
+        ]
+        assert gang.ACTIVE_PROCS == []
+        with pytest.raises(ConnectionError):
+            gang.gang_launch(runners, [{} for _ in range(4)],
+                             'sleep 30', str(tmp_path / 'logs'),
+                             poll_interval_s=0.05)
+        assert gang.ACTIVE_PROCS == []
+
+
+class TestFanoutSmoke:
+    """Tier-1 acceptance smoke: a fake-cloud multi-host launch with a
+    chaos rule failing one rank's bring-up mid-fan-out must abort the
+    launch with that rank named, clean up the provisioned cluster, and
+    strand no host processes."""
+
+    def test_rank_failure_aborts_launch_and_cleans_up(
+            self, fake_cluster_env, tmp_path):
+        from skypilot_tpu import Resources, Task
+        from skypilot_tpu import execution
+        from skypilot_tpu import state
+        from skypilot_tpu.agent import gang
+        chaos.load_plan({'points': {'fanout.worker': {
+            'match': {'phase': 'mount', 'rank': 2},
+            'first_n': 1, 'error': 'ClusterSetUpError'}}})
+        mnt = tmp_path / 'mnt' / 'vol'
+        task = Task('smoke', run='echo never')
+        task.set_resources(Resources(
+            accelerators='tpu-v5e-32',      # 4 hosts
+            volumes=[{'name': 'v1', 'path': str(mnt)}]))
+        with pytest.raises(exceptions.ClusterSetUpError) as ei:
+            execution.launch(task, cluster_name='smoke')
+        err = ei.value
+        # The failed rank is named (and only that rank failed).
+        assert isinstance(err, exceptions.MultiHostError)
+        assert set(err.failures) == {2}
+        assert 'host 2' in str(err)
+        # Mid-fan-out: every rank (minus any cancelled tail) traversed
+        # the chaos point concurrently.
+        assert chaos.hits('fanout.worker') >= 3
+        # The launch aborted before any job could start: no gang
+        # processes exist and the cluster never reached UP.
+        assert gang.ACTIVE_PROCS == []
+        record = state.get_cluster_from_name('smoke')
+        assert record is not None
+        assert record['status'] == state.ClusterStatus.INIT
+        # Nothing is stranded: the half-set-up cluster tears down
+        # cleanly (terminate overlapped with port cleanup),
+        # reclaiming every fake host process and instance.
+        from skypilot_tpu import core
+        core.down('smoke')
+        assert state.get_cluster_from_name('smoke') is None
+        assert not fake_cluster_env.cluster_exists('smoke')

@@ -1,0 +1,155 @@
+#!/usr/bin/env python3
+"""Fake-cloud launch fan-out micro-benchmark.
+
+Launches an N-host cluster end-to-end on the fake cloud twice — once
+with `XSKY_FANOUT_WORKERS=1` (the pre-fan-out sequential control
+plane) and once at the configured width — with a per-host bring-up
+latency injected at the `fanout.worker` chaos point, and prints ONE
+JSON line comparing launch wall-clock:
+
+    {"metric": "launch_wall_clock_s", "hosts": 16,
+     "sequential_s": ..., "parallel_s": ..., "speedup": ..., ...}
+
+Each launch exercises every converted fan-out phase (volume mount,
+workdir sync, file-mount sync, task setup) across all hosts, so the
+sequential run pays `hosts × phases × latency` and the parallel run
+`ceil(hosts/workers) × phases × latency`. The parallel run is traced
+via `XSKY_TIMELINE_FILE`; the tool verifies per-host bring-up events
+actually overlap in time and reports the peak concurrency it saw.
+
+Usage:
+    python tools/bench_fanout.py [--hosts 16] [--latency 0.2]
+                                 [--workers 16] [--keep-trace PATH]
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+# v5e packs 8 chips per host: chips = hosts * 8 resolves to an N-host
+# slice in the topology database.
+_CHIPS_PER_HOST = 8
+
+
+def _setup_env(workdir: str, latency_s: float) -> None:
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    os.environ['XSKY_ENABLE_FAKE_CLOUD'] = '1'
+    os.environ['XSKY_STATE_DB'] = os.path.join(workdir, 'state.db')
+    os.environ['XSKY_FAKE_CLOUD_DIR'] = os.path.join(workdir,
+                                                     'fake_cloud')
+    os.environ['XSKY_CHAOS_PLAN'] = json.dumps({
+        'points': {'fanout.worker': {'latency_s': latency_s}}})
+
+
+def _make_task(hosts: int, scratch: str):
+    from skypilot_tpu import Resources, Task
+    src_dir = os.path.join(scratch, 'workdir')
+    os.makedirs(src_dir, exist_ok=True)
+    with open(os.path.join(src_dir, 'payload.txt'), 'w',
+              encoding='utf-8') as f:
+        f.write('bench')
+    mount_src = os.path.join(scratch, 'mount_src.txt')
+    with open(mount_src, 'w', encoding='utf-8') as f:
+        f.write('mounted')
+    # run=None: the metric is bring-up (provision → mounts → sync →
+    # setup) wall-clock; job submission/execution is not part of it.
+    task = Task('bench-fanout', run=None, setup='true',
+                workdir=src_dir,
+                file_mounts={'bench/in.txt': mount_src})
+    task.set_resources(Resources(
+        accelerators=f'tpu-v5e-{hosts * _CHIPS_PER_HOST}',
+        volumes=[{'name': 'benchvol',
+                  'path': os.path.join(scratch, 'vol')}]))
+    return task
+
+
+def _one_launch(name: str, hosts: int, workers: int, scratch: str,
+                trace_path: str) -> float:
+    from skypilot_tpu import core
+    from skypilot_tpu import execution
+    from skypilot_tpu.utils import timeline
+    os.environ['XSKY_FANOUT_WORKERS'] = str(workers)
+    os.environ['XSKY_TIMELINE_FILE'] = trace_path
+    timeline.reset_for_test()
+    task = _make_task(hosts, scratch)
+    t0 = time.monotonic()
+    execution.launch(task, cluster_name=name, detach_run=True)
+    elapsed = time.monotonic() - t0
+    timeline.save(trace_path)
+    core.down(name)
+    return elapsed
+
+
+def _fanout_concurrency(trace_path: str) -> int:
+    """Peak number of overlapping fanout.* events in a Chrome trace."""
+    with open(trace_path, encoding='utf-8') as f:
+        events = json.load(f)['traceEvents']
+    deltas = []
+    for e in events:
+        if not e['name'].startswith('fanout.'):
+            continue
+        if e['ph'] == 'B':
+            deltas.append((e['ts'], 1))
+        elif e['ph'] == 'E':
+            deltas.append((e['ts'], -1))
+    cur = peak = 0
+    # Close ('-1') before open at equal timestamps: undercounts rather
+    # than fabricating overlap.
+    for _, d in sorted(deltas):
+        cur += d
+        peak = max(peak, cur)
+    return peak
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--hosts', type=int, default=16,
+                        choices=[1, 4, 8, 16, 32],
+                        help='fake-cloud v5e slice sizes (hosts)')
+    parser.add_argument('--latency', type=float, default=0.2,
+                        help='injected per-host bring-up latency (s)')
+    parser.add_argument('--workers', type=int, default=16,
+                        help='fan-out width for the parallel run')
+    parser.add_argument('--keep-trace', default=None,
+                        help='copy the parallel run trace here')
+    args = parser.parse_args()
+
+    scratch = tempfile.mkdtemp(prefix='xsky-bench-fanout-')
+    _setup_env(scratch, args.latency)
+    from skypilot_tpu import check as check_lib
+    check_lib.set_enabled_clouds_for_test(['fake'])
+
+    seq_trace = os.path.join(scratch, 'trace_seq.json')
+    par_trace = os.path.join(scratch, 'trace_par.json')
+    sequential_s = _one_launch('bench-fanout-seq', args.hosts, 1,
+                               scratch, seq_trace)
+    parallel_s = _one_launch('bench-fanout-par', args.hosts,
+                             args.workers, scratch, par_trace)
+    peak = _fanout_concurrency(par_trace)
+    if args.keep_trace:
+        import shutil
+        shutil.copy(par_trace, args.keep_trace)
+        par_trace = args.keep_trace
+
+    print(json.dumps({
+        'metric': 'launch_wall_clock_s',
+        'hosts': args.hosts,
+        'workers': args.workers,
+        'injected_latency_s': args.latency,
+        'sequential_s': round(sequential_s, 3),
+        'parallel_s': round(parallel_s, 3),
+        'speedup': round(sequential_s / parallel_s, 2),
+        'max_concurrent_fanout': peak,
+        'overlapping': peak >= 2,
+        'trace': par_trace,
+    }))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
