@@ -31,6 +31,7 @@ NAV = [
     ('serving.md', 'Serving'),
     ('jobs.md', 'Managed jobs'),
     ('robustness.md', 'Robustness'),
+    ('observability.md', 'Observability'),
     ('storage.md', 'Storage'),
     ('clouds.md', 'Clouds'),
     ('server.md', 'API server'),

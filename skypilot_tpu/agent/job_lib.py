@@ -43,7 +43,20 @@ def _db(root: Optional[str] = None) -> sqlite3.Connection:
     os.makedirs(root, exist_ok=True)
     conn = sqlite3.connect(os.path.join(root, 'jobs.db'), timeout=30,
                            check_same_thread=False)
-    conn.execute('PRAGMA journal_mode=WAL')
+    # Converting a FRESH db to WAL needs a moment of exclusive access;
+    # two job_cli subprocesses racing the first-ever connection (two
+    # concurrent `exec`s against a new cluster) can hit 'database is
+    # locked' here despite the busy timeout. Retry briefly, then fall
+    # back to the default journal — WAL is a concurrency optimization,
+    # not a correctness requirement.
+    for attempt in range(10):
+        try:
+            conn.execute('PRAGMA journal_mode=WAL')
+            break
+        except sqlite3.OperationalError:
+            if attempt == 9:
+                break
+            time.sleep(0.05 * (attempt + 1))
     conn.execute("""
         CREATE TABLE IF NOT EXISTS jobs (
             job_id INTEGER PRIMARY KEY AUTOINCREMENT,

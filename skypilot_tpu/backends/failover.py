@@ -32,7 +32,9 @@ from skypilot_tpu import task as task_lib
 from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu.utils import chaos
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import metrics
 from skypilot_tpu.utils import resilience
+from skypilot_tpu.utils import tracing
 
 logger = sky_logging.init_logger(__name__)
 
@@ -79,34 +81,40 @@ class RetryingProvisioner:
 
     def provision_with_retries(self) -> ProvisionResult:
         """Walk optimizer candidates until one provisions."""
-        for _ in range(self._max_sku_retries):
-            try:
-                candidates = optimizer_lib.candidates_for_failover(
-                    self._task, self.blocked)
-            except exceptions.ResourcesUnavailableError as e:
-                raise e.with_failover_history(self.failover_history)
-            resources = candidates[0]
-            result = self._try_resources(resources)
-            if result is not None:
-                return result
-            # Every (region, zone) of this SKU is exhausted: block the SKU
-            # itself so the optimizer moves to the next-cheapest candidate
-            # (incl. GPU→TPU / TPU→GPU jumps). The block names the
-            # provisioning model, so a stocked-out reservation walks on
-            # to spot, then on-demand, of the same SKU.
-            self.blocked.append(
-                resources_lib.Resources(
-                    cloud=resources.cloud_name,
-                    accelerators=resources.accelerators,
-                    accelerator_args={
-                        'provisioning_model':
-                            resources.effective_provisioning_model()},
-                    instance_type=None if resources.is_tpu
-                    else resources.instance_type))
-        raise exceptions.ResourcesUnavailableError(
-            'Exhausted provisioning retries for '
-            f'{self._cluster_name}.').with_failover_history(
-                self.failover_history)
+        with tracing.span('failover.provision',
+                          cluster=self._cluster_name) as sp:
+            for _ in range(self._max_sku_retries):
+                try:
+                    candidates = optimizer_lib.candidates_for_failover(
+                        self._task, self.blocked)
+                except exceptions.ResourcesUnavailableError as e:
+                    sp.set(failed_attempts=self.total_failures)
+                    raise e.with_failover_history(self.failover_history)
+                resources = candidates[0]
+                result = self._try_resources(resources)
+                if result is not None:
+                    sp.set(failed_attempts=self.total_failures)
+                    return result
+                # Every (region, zone) of this SKU is exhausted: block
+                # the SKU itself so the optimizer moves to the
+                # next-cheapest candidate (incl. GPU→TPU / TPU→GPU
+                # jumps). The block names the provisioning model, so a
+                # stocked-out reservation walks on to spot, then
+                # on-demand, of the same SKU.
+                self.blocked.append(
+                    resources_lib.Resources(
+                        cloud=resources.cloud_name,
+                        accelerators=resources.accelerators,
+                        accelerator_args={
+                            'provisioning_model':
+                                resources.effective_provisioning_model()},
+                        instance_type=None if resources.is_tpu
+                        else resources.instance_type))
+            sp.set(failed_attempts=self.total_failures)
+            raise exceptions.ResourcesUnavailableError(
+                'Exhausted provisioning retries for '
+                f'{self._cluster_name}.').with_failover_history(
+                    self.failover_history)
 
     # ---- internals ----
 
@@ -123,6 +131,9 @@ class RetryingProvisioner:
             scope=f'cluster/{self._cluster_name}',
             cause=type(e).__name__,
             detail={'block_scope': block_scope, 'error': str(e)[:500]})
+        metrics.inc_counter('xsky_failover_attempts_total',
+                            'Failed provisioning attempts by cause.',
+                            1.0, cause=type(e).__name__)
 
     def _record_success(self) -> None:
         """Provisioned after at least one failure: journal the latency
@@ -162,17 +173,25 @@ class RetryingProvisioner:
         regions = cloud.regions_with_offering(
             resources.instance_type or '', resources.accelerators,
             resources.use_spot, resources.region, resources.zone)
-        for region in regions:
-            zones = [resources.zone] if resources.zone else region.zones
-            for zone in zones:
-                if self._is_scope_blocked(resources, region.name, zone):
-                    continue
-                outcome = self._try_zone(resources, region.name, zone)
-                if outcome is not None:
-                    return outcome
-                if self._gave_up_on(resources):
-                    return None
-        return None
+        with tracing.span('failover.sku',
+                          cluster=self._cluster_name,
+                          cloud=resources.cloud_name,
+                          sku=str(resources.accelerators or
+                                  resources.instance_type)):
+            for region in regions:
+                zones = [resources.zone] if resources.zone \
+                    else region.zones
+                for zone in zones:
+                    if self._is_scope_blocked(resources, region.name,
+                                              zone):
+                        continue
+                    outcome = self._try_zone(resources, region.name,
+                                             zone)
+                    if outcome is not None:
+                        return outcome
+                    if self._gave_up_on(resources):
+                        return None
+            return None
 
     def _is_scope_blocked(self, resources: resources_lib.Resources,
                           region: str, zone: Optional[str]) -> bool:
@@ -206,62 +225,76 @@ class RetryingProvisioner:
             tags={'cluster_name': self._cluster_name},
         )
         provider = cloud.provisioner_module
-        try:
-            logger.info(f'Provisioning {self._cluster_name!r} '
-                        f'({resources}) in {zone or region}...')
-            if self.attempt_observer is not None:
-                self.attempt_observer(
-                    resources.copy(region=region, zone=zone), config)
-            record = provision_lib.run_instances(provider, region, zone,
-                                                 self._cluster_name, config)
-            chaos.inject('failover.wait_instances',
-                         cluster_name=self._cluster_name, zone=zone or '',
-                         region=region)
-            provision_lib.wait_instances(provider, region,
-                                         self._cluster_name, 'RUNNING',
-                                         provider_config=provider_config)
-            if resources.ports:
-                # Expose user-requested ports (Resources(ports=…), serve
-                # endpoints) once the nodes exist — clouds whose module
-                # lacks open_ports have ports-open-by-default semantics
-                # (the feature gate rejected the rest upfront).
-                provision_lib.open_ports(provider, self._cluster_name,
-                                         resources.ports,
-                                         config.provider_config)
-            chaos.inject('failover.get_cluster_info',
-                         cluster_name=self._cluster_name, zone=zone or '',
-                         region=record.region)
-            info = provision_lib.get_cluster_info(provider, record.region,
-                                                  self._cluster_name,
-                                                  config.provider_config)
-            concrete = resources.copy(region=record.region,
-                                      zone=record.zone)
-            self._record_success()
-            return ProvisionResult(concrete, record, info, self._num_nodes)
-        except exceptions.InvalidRequestError as e:
-            self._record_failure(e, block_scope='none (no failover)')
-            raise exceptions.ResourcesUnavailableError(
-                f'Invalid request for {resources}: {e}',
-                no_failover=True,
-                failover_history=self.failover_history) from e
-        except (exceptions.CapacityError,
-                exceptions.QueuedResourceTimeoutError) as e:
-            self._record_failure(e, block_scope=f'zone:{zone}')
-            logger.info(f'  Capacity error in {zone}: {e}')
-            self._block(resources, zone=zone, region=None)
-        except exceptions.QuotaExceededError as e:
-            self._record_failure(e, block_scope=f'region:{region}')
-            logger.info(f'  Quota exceeded in {region}: {e}')
-            self._block(resources, zone=None, region=region)
-        except exceptions.PermissionError_ as e:
-            self._record_failure(e, block_scope=f'cloud:{cloud}')
-            logger.info(f'  Permission error on {cloud}: {e}')
-            self._block(resources, zone=None, region=None, whole_cloud=True)
-        except exceptions.ProvisionError as e:
-            # Unclassified provisioning failure: treat as capacity-scoped.
-            self._record_failure(e, block_scope=f'zone:{zone}')
-            self._block(resources, zone=zone, region=None)
-        return None
+        with tracing.span('failover.attempt',
+                          cluster=self._cluster_name, region=region,
+                          zone=zone or '',
+                          attempt=self.total_failures + 1) as sp:
+            try:
+                logger.info(f'Provisioning {self._cluster_name!r} '
+                            f'({resources}) in {zone or region}...')
+                if self.attempt_observer is not None:
+                    self.attempt_observer(
+                        resources.copy(region=region, zone=zone), config)
+                record = provision_lib.run_instances(
+                    provider, region, zone, self._cluster_name, config)
+                chaos.inject('failover.wait_instances',
+                             cluster_name=self._cluster_name,
+                             zone=zone or '', region=region)
+                provision_lib.wait_instances(
+                    provider, region, self._cluster_name, 'RUNNING',
+                    provider_config=provider_config)
+                if resources.ports:
+                    # Expose user-requested ports (Resources(ports=…),
+                    # serve endpoints) once the nodes exist — clouds
+                    # whose module lacks open_ports have
+                    # ports-open-by-default semantics (the feature gate
+                    # rejected the rest upfront).
+                    provision_lib.open_ports(provider,
+                                             self._cluster_name,
+                                             resources.ports,
+                                             config.provider_config)
+                chaos.inject('failover.get_cluster_info',
+                             cluster_name=self._cluster_name,
+                             zone=zone or '', region=record.region)
+                info = provision_lib.get_cluster_info(
+                    provider, record.region, self._cluster_name,
+                    config.provider_config)
+                concrete = resources.copy(region=record.region,
+                                          zone=record.zone)
+                self._record_success()
+                sp.set(outcome='ok')
+                return ProvisionResult(concrete, record, info,
+                                       self._num_nodes)
+            except exceptions.InvalidRequestError as e:
+                self._record_failure(e, block_scope='none (no failover)')
+                raise exceptions.ResourcesUnavailableError(
+                    f'Invalid request for {resources}: {e}',
+                    no_failover=True,
+                    failover_history=self.failover_history) from e
+            except (exceptions.CapacityError,
+                    exceptions.QueuedResourceTimeoutError) as e:
+                self._record_failure(e, block_scope=f'zone:{zone}')
+                logger.info(f'  Capacity error in {zone}: {e}')
+                sp.set(outcome=type(e).__name__)
+                self._block(resources, zone=zone, region=None)
+            except exceptions.QuotaExceededError as e:
+                self._record_failure(e, block_scope=f'region:{region}')
+                logger.info(f'  Quota exceeded in {region}: {e}')
+                sp.set(outcome=type(e).__name__)
+                self._block(resources, zone=None, region=region)
+            except exceptions.PermissionError_ as e:
+                self._record_failure(e, block_scope=f'cloud:{cloud}')
+                logger.info(f'  Permission error on {cloud}: {e}')
+                sp.set(outcome=type(e).__name__)
+                self._block(resources, zone=None, region=None,
+                            whole_cloud=True)
+            except exceptions.ProvisionError as e:
+                # Unclassified provisioning failure: treat as
+                # capacity-scoped.
+                self._record_failure(e, block_scope=f'zone:{zone}')
+                sp.set(outcome=type(e).__name__)
+                self._block(resources, zone=zone, region=None)
+            return None
 
 
 def provision_with_retry_until_up(

@@ -32,6 +32,7 @@ from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu.utils import command_runner as runner_lib
 from skypilot_tpu.utils import parallelism
 from skypilot_tpu.utils import registry
+from skypilot_tpu.utils import tracing
 
 if typing.TYPE_CHECKING:
     from skypilot_tpu import resources as resources_lib
@@ -113,6 +114,17 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             logger.info(f'Dryrun: would provision {cluster_name} with '
                         f'{to_provision or task.resources}')
             return None
+        with tracing.span('backend.provision', cluster=cluster_name,
+                          nodes=task.num_nodes):
+            return self._provision(task, to_provision, cluster_name,
+                                   retry_until_up, blocked_resources)
+
+    def _provision(self, task: 'task_lib.Task',
+                   to_provision: Optional['resources_lib.Resources'],
+                   cluster_name: str, retry_until_up: bool,
+                   blocked_resources: Optional[List[
+                       'resources_lib.Resources']]
+                   ) -> Optional[ClusterHandle]:
         if to_provision is not None:
             task = _pin_task(task, to_provision)
         from skypilot_tpu.workspaces import context as ws_context
@@ -240,9 +252,11 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                         f'Volume mount failed on host {rank}: '
                         f'{stderr.strip()} (cmd: {cmd})')
 
-            parallelism.run_in_parallel(
-                _mount, list(enumerate(runners)),
-                phase='mount', what='volume mount')
+            with tracing.span('backend.mount',
+                              cluster=handle.cluster_name):
+                parallelism.run_in_parallel(
+                    _mount, list(enumerate(runners)),
+                    phase='mount', what='volume mount')
         if self._bootstraps(handle):
             wheel_path, content_hash = wheel_utils.build_wheel()
 
@@ -256,9 +270,11 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                         f'Runtime bootstrap failed on host {rank}: '
                         f'{e}') from e
 
-            parallelism.run_in_parallel(
-                _bootstrap, list(enumerate(runners)),
-                phase='bootstrap', what='runtime bootstrap')
+            with tracing.span('backend.bootstrap',
+                              cluster=handle.cluster_name):
+                parallelism.run_in_parallel(
+                    _bootstrap, list(enumerate(runners)),
+                    phase='bootstrap', what='runtime bootstrap')
         head = runners[0]
         root = handle.head_runtime_root
         # cluster_name rides along for the agent's self-teardown path
@@ -290,9 +306,11 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                         f'Docker runtime init failed on host {rank}: '
                         f'{stderr.strip()[:500]}')
 
-            parallelism.run_in_parallel(
-                _docker_init, list(enumerate(runners)),
-                phase='docker_init', what='docker runtime init')
+            with tracing.span('backend.docker_init',
+                              cluster=handle.cluster_name):
+                parallelism.run_in_parallel(
+                    _docker_init, list(enumerate(runners)),
+                    phase='docker_init', what='docker runtime init')
         if not handle.is_local_provider:
             head.run_async(
                 f'{self._head_python(handle)} -m skypilot_tpu.agent.daemon',
@@ -398,9 +416,11 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             _, runner = pair
             runner.rsync(src, 'sky_workdir/', up=True, excludes=['.git'])
 
-        parallelism.run_in_parallel(
-            _sync, list(enumerate(runners)),
-            phase='sync_workdir', what=f'workdir sync ({workdir})')
+        with tracing.span('backend.sync_workdir',
+                          cluster=handle.cluster_name):
+            parallelism.run_in_parallel(
+                _sync, list(enumerate(runners)),
+                phase='sync_workdir', what=f'workdir sync ({workdir})')
 
     def sync_file_mounts(self, handle: ClusterHandle,
                          all_file_mounts: Optional[Dict[str, str]],
@@ -420,9 +440,12 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                 else:
                     runner.rsync(source, target, up=True)
 
-            parallelism.run_in_parallel(
-                _push, list(enumerate(runners)),
-                phase='file_mounts', what=f'file mount ({target})')
+            with tracing.span('backend.file_mounts',
+                              cluster=handle.cluster_name,
+                              target=target):
+                parallelism.run_in_parallel(
+                    _push, list(enumerate(runners)),
+                    phase='file_mounts', what=f'file mount ({target})')
         if storage_mounts:
             from skypilot_tpu.data import storage_mounting
             storage_mounting.mount_storage_on_cluster(
@@ -462,9 +485,11 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                     f'Setup failed on host {rank} (rc={rc}): '
                     f'{err or out}')
 
-        parallelism.run_in_parallel(
-            _setup, list(enumerate(runners)),
-            phase='setup', what='task setup')
+        with tracing.span('backend.setup',
+                          cluster=handle.cluster_name):
+            parallelism.run_in_parallel(
+                _setup, list(enumerate(runners)),
+                phase='setup', what='task setup')
 
     def execute(self, handle: ClusterHandle, task: 'task_lib.Task',
                 detach_run: bool = False,
@@ -492,7 +517,9 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                                  if self._docker_image(handle) is not None
                                  else None),
         }
-        job_id = self._submit_job(handle, task.name, spec)
+        with tracing.span('backend.submit',
+                          cluster=handle.cluster_name):
+            job_id = self._submit_job(handle, task.name, spec)
         state.update_last_use(handle.cluster_name)
         if not detach_run:
             self._wait_job(handle, job_id, stream_logs=stream_logs)
@@ -704,9 +731,11 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                      f'{job_id}', env=self._agent_env(handle))
 
         try:
-            parallelism.run_in_parallel(
-                _cancel, list(job_ids),
-                phase='cancel_jobs', what='job cancel')
+            with tracing.span('backend.cancel_jobs',
+                              cluster=handle.cluster_name):
+                parallelism.run_in_parallel(
+                    _cancel, list(job_ids),
+                    phase='cancel_jobs', what='job cancel')
         except exceptions.MultiHostError as e:
             # A cancel exec raising (dead head mid-teardown) was never
             # fatal in the sequential loop either.
@@ -771,9 +800,11 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             head.rsync(os.path.join(local_dir, job_dir),
                        f'{remote_logs}/{job_dir}/', up=False)
 
-        parallelism.run_in_parallel(
-            _pull, job_dirs,
-            phase='sync_down_logs', what='log sync-down')
+        with tracing.span('backend.sync_down_logs',
+                          cluster=handle.cluster_name):
+            parallelism.run_in_parallel(
+                _pull, job_dirs,
+                phase='sync_down_logs', what='log sync-down')
         return local_dir
 
     # ---- teardown / autostop ----

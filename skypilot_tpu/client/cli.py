@@ -344,6 +344,31 @@ def hosts(cluster):
             h['external_ip'] or '-', h['status']))
 
 
+def _parse_since(value: Optional[str]) -> Optional[float]:
+    """--since accepts a relative window (30s, 15m, 2h, 1d), a unix
+    timestamp, or an ISO date/datetime; returns a unix-ts lower bound."""
+    if not value:
+        return None
+    import time as time_lib
+    units = {'s': 1, 'm': 60, 'h': 3600, 'd': 86400}
+    v = value.strip()
+    if v and v[-1].lower() in units and \
+            v[:-1].replace('.', '', 1).isdigit():
+        return time_lib.time() - float(v[:-1]) * units[v[-1].lower()]
+    try:
+        return float(v)
+    except ValueError:
+        pass
+    for fmt in ('%Y-%m-%dT%H:%M:%S', '%Y-%m-%d %H:%M:%S', '%Y-%m-%d'):
+        try:
+            return datetime.datetime.strptime(v, fmt).timestamp()
+        except ValueError:
+            continue
+    raise click.UsageError(
+        f'--since {value!r}: expected 30s/15m/2h/1d, a unix '
+        'timestamp, or YYYY-MM-DD[THH:MM:SS].')
+
+
 @cli.command()
 @click.option('--scope', default=None,
               help='Filter by scope path prefix (e.g. job/3, '
@@ -353,31 +378,224 @@ def hosts(cluster):
                    'failover.blocked, chaos.injected).')
 @click.option('--limit', '-n', type=int, default=50,
               help='Newest N events (shown oldest-first).')
-def events(scope, event_type, limit):
+@click.option('--since', default=None,
+              help='Only events after this point: 30s/15m/2h/1d ago, '
+                   'a unix timestamp, or an ISO date.')
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='One JSON object per line (joinable with '
+                   '`xsky trace --json` on trace_id).')
+def events(scope, event_type, limit, since, as_json):
     """Show the recovery-event journal (preemption→recovery timeline).
 
     Every fault and recovery — failover blocks, managed-job preemptions
     and relaunches, serve replica churn, injected chaos — lands here
-    with its scope, cause, and recovery latency.
+    with its scope, cause, recovery latency, and the trace it happened
+    under (see `xsky trace`).
     """
     import datetime
 
     from skypilot_tpu import state as state_lib
     rows = state_lib.get_recovery_events(scope=scope,
                                          event_type=event_type,
-                                         limit=limit)
+                                         limit=limit,
+                                         since=_parse_since(since))
+    if as_json:
+        for r in rows:
+            click.echo(json.dumps(r, default=str))
+        return
     if not rows:
         click.echo('No recovery events recorded.')
         return
-    fmt = '{:<19} {:<22} {:<34} {:<24} {:>9}'
-    click.echo(fmt.format('TIME', 'EVENT', 'SCOPE', 'CAUSE', 'LATENCY'))
+    fmt = '{:<19} {:<22} {:<30} {:<20} {:>9} {:<16}'
+    click.echo(fmt.format('TIME', 'EVENT', 'SCOPE', 'CAUSE', 'LATENCY',
+                          'TRACE'))
     for r in rows:
         ts = datetime.datetime.fromtimestamp(
             r['ts']).strftime('%Y-%m-%d %H:%M:%S')
         latency = (f'{r["latency_s"]:.2f}s'
                    if r['latency_s'] is not None else '-')
-        click.echo(fmt.format(ts, r['event_type'][:22], r['scope'][:34],
-                              (r['cause'] or '-')[:24], latency))
+        click.echo(fmt.format(ts, r['event_type'][:22], r['scope'][:30],
+                              (r['cause'] or '-')[:20], latency,
+                              (r.get('trace_id') or '-')[:16]))
+
+
+def _trace_children(spans):
+    """span_id → [child spans] (children ordered by start time), plus
+    the roots/orphans list. An orphan (parent recorded but missing —
+    pruned, or the parent never finished) renders as a root, marked."""
+    by_id = {s['span_id']: s for s in spans}
+    children = {}
+    roots = []
+    for s in spans:
+        parent = s['parent_span_id']
+        if parent and parent in by_id:
+            children.setdefault(parent, []).append(s)
+        else:
+            s['orphan'] = bool(parent)
+            roots.append(s)
+    return children, roots
+
+
+def _critical_path(roots, children):
+    """Span ids on the critical path: from each root, repeatedly
+    descend into the child that finished last — the chain that gated
+    the trace's wall-clock."""
+    marked = set()
+    for root in roots:
+        node = root
+        while node is not None:
+            marked.add(node['span_id'])
+            kids = children.get(node['span_id'])
+            node = max(kids, key=lambda s: s['end_ts'] or 0) \
+                if kids else None
+    return marked
+
+
+def _sibling_stragglers(children):
+    """Span ids slower than 1.5x their sibling-group median (groups =
+    same parent + same name, ≥3 members: fan-out ranks)."""
+    straggler_ids = set()
+    for kids in children.values():
+        groups = {}
+        for s in kids:
+            groups.setdefault(s['name'], []).append(s)
+        for group in groups.values():
+            if len(group) < 3:
+                continue
+            durs = sorted(
+                (s['end_ts'] or 0) - (s['start_ts'] or 0)
+                for s in group)
+            median = durs[len(durs) // 2]
+            for s in group:
+                if median > 0 and ((s['end_ts'] or 0) -
+                                   (s['start_ts'] or 0)) > 1.5 * median:
+                    straggler_ids.add(s['span_id'])
+    return straggler_ids
+
+
+@cli.command(name='trace')
+@click.argument('target')
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='Raw span rows as JSON (joinable with `xsky events '
+                   '--json` on trace_id).')
+@click.option('--limit', type=int, default=5000,
+              help='Max spans to load.')
+def trace_cmd(target, as_json, limit):
+    """Render a trace's span waterfall (request id, cluster, or trace
+    id).
+
+    Shows where a launch/request spent its time: per-phase durations,
+    parent/child nesting, the critical path (marked `*`), failed spans
+    (`!`), and per-phase straggler ranks. Trace ids come from `xsky
+    events` rows, `/metrics` drill-downs, or the request id returned
+    by any API verb.
+    """
+    from skypilot_tpu import state as state_lib
+    spans = state_lib.get_spans(target, limit=limit)
+    trace_id = target
+    if not spans:
+        # A request id resolves through the request row's minted
+        # trace_id — valid the moment the POST returns, even while
+        # the request is still running (its root span lands only at
+        # completion).
+        ids = []
+        try:
+            from skypilot_tpu.server import requests_db
+            minted = requests_db.get_trace_id(target)
+            if minted:
+                ids = [minted]
+        except Exception:  # pylint: disable=broad-except
+            pass
+        ids = ids or state_lib.find_trace_ids(target)
+        if not ids:
+            raise click.ClickException(
+                f'No trace matches {target!r} (searched trace ids, '
+                'request ids, cluster names and span attributes).')
+        if len(ids) > 1:
+            # stderr, so `--json | jq` pipelines stay parseable.
+            click.echo(f'{len(ids)} traces match {target!r}; '
+                       'showing the newest. Others: '
+                       + ', '.join(ids[1:]), err=True)
+        trace_id = ids[0]
+        spans = state_lib.get_spans(trace_id, limit=limit)
+        if not spans:
+            # A just-accepted request: trace minted, no span finished
+            # (the buffer flushes per phase / at completion).
+            click.echo(f'Trace {trace_id}: no finished spans yet '
+                       '(request still in its first phase?). '
+                       'Re-run in a moment.')
+            return
+    if as_json:
+        for s in spans:
+            click.echo(json.dumps(s, default=str))
+        return
+    children, roots = _trace_children(spans)
+    critical = _critical_path(roots, children)
+    stragglers = _sibling_stragglers(children)
+    t0 = min(s['start_ts'] for s in spans)
+    t1 = max(s['end_ts'] or s['start_ts'] for s in spans)
+    total = max(t1 - t0, 1e-9)
+    errors = sum(1 for s in spans if s['status'] != 'OK')
+    click.echo(f'TRACE {trace_id} — {len(spans)} span(s), '
+               f'{total:.2f}s wall-clock'
+               + (f', {errors} error(s)' if errors else ''))
+    click.echo('(`*` critical path, `!` error, `~` straggler rank '
+               '>1.5x phase median)')
+    width = 30
+    fmt = '{:>9} {:<32} {}'
+    click.echo(fmt.format('DUR', 'WATERFALL', 'SPAN'))
+
+    def render(span, depth):
+        start = span['start_ts'] - t0
+        dur = max((span['end_ts'] or span['start_ts']) -
+                  span['start_ts'], 0.0)
+        lead = min(int(start / total * width), width - 1)
+        bar_len = max(1, min(int(round(dur / total * width)),
+                             width - lead))
+        bar = ' ' * lead + '#' * bar_len
+        flags = ''
+        if span['span_id'] in critical:
+            flags += ' *'
+        if span['status'] != 'OK':
+            flags += ' !'
+        if span['span_id'] in stragglers:
+            flags += ' ~'
+        attrs = span.get('attrs') or {}
+        note = ''
+        if span.get('orphan'):
+            note = ' (orphan)'
+        elif 'rank' in attrs:
+            note = f' [rank {attrs["rank"]}]'
+        elif 'slowest_rank' in attrs:
+            note = (f' [slowest rank {attrs["slowest_rank"]}: '
+                    f'{attrs.get("slowest_s", 0):.2f}s]')
+        click.echo(fmt.format(
+            f'{dur:.3f}s', bar,
+            '  ' * depth + span['name'] + note + flags))
+        for child in children.get(span['span_id'], []):
+            render(child, depth + 1)
+
+    for root in roots:
+        render(root, 0)
+    # Per-phase slowest-rank digest: the tuning table for fan-out
+    # phases (which host gated each phase).
+    fanouts = [s for s in spans
+               if (s.get('attrs') or {}).get('slowest_rank')
+               is not None]
+    if fanouts:
+        click.echo('')
+        click.echo('Fan-out phases (slowest rank gates the phase):')
+        pfmt = '  {:<28} {:>12} {:>10} {:>10}  {}'
+        click.echo(pfmt.format('PHASE', 'SLOWEST RANK', 'SLOWEST',
+                               'MEDIAN', 'STRAGGLERS'))
+        for s in fanouts:
+            attrs = s['attrs']
+            lagging = attrs.get('stragglers') or []
+            click.echo(pfmt.format(
+                s['name'][:28], attrs['slowest_rank'],
+                f"{attrs.get('slowest_s', 0):.3f}s",
+                f"{attrs.get('median_s', 0):.3f}s",
+                ','.join(str(r) for r in lagging) or '-'))
 
 
 @cli.command()

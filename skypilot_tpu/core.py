@@ -108,9 +108,11 @@ def status(cluster_names: Optional[List[str]] = None,
         # refresh_cluster_status, so one unreachable cloud cannot
         # fail the whole status call.
         from skypilot_tpu.utils import parallelism
-        refreshed = parallelism.run_in_parallel(
-            lambda r: refresh_cluster_status(r['name']), records,
-            phase='status_refresh', what='status refresh')
+        from skypilot_tpu.utils import tracing
+        with tracing.span('status_refresh', clusters=len(records)):
+            refreshed = parallelism.run_in_parallel(
+                lambda r: refresh_cluster_status(r['name']), records,
+                phase='status_refresh', what='status refresh')
         records = [r for r in refreshed if r is not None]
     return records
 

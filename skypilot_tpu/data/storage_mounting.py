@@ -52,7 +52,11 @@ def mount_storage_on_cluster(handle: Any,
                     f'Mounting {storage.name} at {mount_path} failed '
                     f'on host {rank} (rc={rc}): {stderr}')
 
-        parallelism.run_in_parallel(
-            _mount, list(enumerate(runners)),
-            phase='storage_mount',
-            what=f'storage mount ({storage.name} at {mount_path})')
+        from skypilot_tpu.utils import tracing
+        with tracing.span('backend.storage_mount',
+                          cluster=getattr(handle, 'cluster_name', ''),
+                          storage=storage.name):
+            parallelism.run_in_parallel(
+                _mount, list(enumerate(runners)),
+                phase='storage_mount',
+                what=f'storage mount ({storage.name} at {mount_path})')

@@ -19,6 +19,7 @@ from skypilot_tpu import state
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.backends import tpu_gang_backend
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import tracing
 
 logger = sky_logging.init_logger(__name__)
 
@@ -81,7 +82,13 @@ def launch(entrypoint,
     from skypilot_tpu.workspaces import context as ws_context
     from skypilot_tpu.workspaces import core as workspaces_core
     ws_overlay = workspaces_core.get_config(ws_context.get_active())
-    with config_lib.override(ws_overlay or None):
+    # One launch = one span subtree: every backend phase below
+    # (provision, failover attempts, mounts, bootstrap, setup, syncs)
+    # parents here, so `xsky trace <cluster>` shows the whole launch
+    # even without an API-server request boundary (local SDK/CLI path
+    # auto-roots a fresh trace).
+    with config_lib.override(ws_overlay or None), \
+            tracing.span('launch', cluster=cluster_name):
         return _execute_dag(
             dag, cluster_name, stages, dryrun=dryrun,
             retry_until_up=retry_until_up,
@@ -120,10 +127,12 @@ def exec(entrypoint,  # pylint: disable=redefined-builtin
             f'Task resources {task.resources} do not fit cluster '
             f'{cluster_name} ({handle.launched_resources}).')
     backend = tpu_gang_backend.TpuGangBackend()
-    if task.workdir:
-        backend.sync_workdir(handle, task.workdir)
-    job_id = backend.execute(handle, task, detach_run=detach_run,
-                             dryrun=dryrun, stream_logs=stream_logs)
+    with tracing.span('exec', cluster=cluster_name):
+        if task.workdir:
+            backend.sync_workdir(handle, task.workdir)
+        job_id = backend.execute(handle, task, detach_run=detach_run,
+                                 dryrun=dryrun,
+                                 stream_logs=stream_logs)
     return job_id, handle
 
 

@@ -25,6 +25,7 @@ from skypilot_tpu.jobs import state as jobs_state
 from skypilot_tpu.utils import chaos
 from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import resilience
+from skypilot_tpu.utils import tracing
 
 logger = sky_logging.init_logger(__name__)
 
@@ -158,7 +159,12 @@ class JobsController:
         if acquire_slot:
             scheduler.acquire_launch_slot(self.job_id)
         try:
-            handle, cluster_job_id = self.strategy.launch()
+            # The launch span parents under the jobs.launch request's
+            # trace (handed over via XSKY_TRACE_CONTEXT at controller
+            # spawn); a respawned controller roots a fresh trace.
+            with tracing.span('jobs.launch_task', job=self.job_id,
+                              cluster=self.cluster_name):
+                handle, cluster_job_id = self.strategy.launch()
         except exceptions.ResourcesUnavailableError as e:
             jobs_state.set_status(
                 self.job_id, jobs_state.ManagedJobStatus.FAILED_NO_RESOURCE,
@@ -246,8 +252,14 @@ class JobsController:
         # not stampede the provisioner) — reacquire a launch slot first.
         scheduler.acquire_launch_slot(self.job_id)
         try:
-            handle, cluster_job_id = self.strategy.recover(
-                self._current_handle())
+            record = jobs_state.get_job(self.job_id)
+            with tracing.span(
+                    'jobs.recover', job=self.job_id,
+                    cluster=self.cluster_name,
+                    recovery_count=(record or {}).get(
+                        'recovery_count', 0)):
+                handle, cluster_job_id = self.strategy.recover(
+                    self._current_handle())
             # The relaunched task runs under a NEW cluster job id (and
             # possibly a new cluster); keep the live-tail pointer fresh.
             jobs_state.set_cluster_job_id(self.job_id, cluster_job_id)

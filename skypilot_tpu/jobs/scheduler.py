@@ -75,10 +75,16 @@ def schedule_lock() -> filelock.FileLock:
 
 
 def _spawn_controller(job_id: int) -> None:
+    from skypilot_tpu.utils import tracing
     from skypilot_tpu.workspaces import context as ws_context
     record = jobs_state.get_job(job_id)
     env = ws_context.controller_env(
         record.get('workspace') if record else None)
+    # Hand the submitting request's trace to the controller: its
+    # launch/recovery spans parent back to the `jobs.launch` request
+    # (a reconciler respawn has no ambient trace — the controller
+    # then roots a fresh one).
+    env = tracing.env_for_child(env)
     proc = subprocess.Popen(
         [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
          str(job_id)],

@@ -53,13 +53,27 @@ def reconcile_interval_s() -> float:
         return _DEFAULT_INTERVAL_S
 
 
-def _repair(repairs: List[Dict[str, Any]], action: str, scope: str,
-            cause: str, detail: Optional[Dict[str, Any]] = None) -> None:
-    """Record one executed repair: journal row + doctor report entry."""
-    global_state.record_recovery_event(
-        f'reconcile.{action}', scope=scope, cause=cause, detail=detail)
+def _count_repair(repairs: List[Dict[str, Any]], action: str,
+                  scope: str, cause: str,
+                  detail: Optional[Dict[str, Any]] = None) -> None:
+    """Report entry + /metrics counter for one executed repair whose
+    journal row was already written elsewhere (e.g. inside the
+    scheduler, shared with non-reconciler callers)."""
+    from skypilot_tpu.utils import metrics
+    metrics.inc_counter('xsky_reconciler_repairs_total',
+                        'Reconciler repairs executed, by action.', 1.0,
+                        action=action)
     repairs.append({'action': action, 'scope': scope, 'cause': cause,
                     **(detail or {})})
+
+
+def _repair(repairs: List[Dict[str, Any]], action: str, scope: str,
+            cause: str, detail: Optional[Dict[str, Any]] = None) -> None:
+    """Record one executed repair: journal row + doctor report entry
+    + /metrics counter."""
+    global_state.record_recovery_event(
+        f'reconcile.{action}', scope=scope, cause=cause, detail=detail)
+    _count_repair(repairs, action, scope, cause, detail)
 
 
 # ---- requests --------------------------------------------------------------
@@ -179,13 +193,11 @@ def reconcile_jobs() -> List[Dict[str, Any]]:
     for job_id in summary['respawned']:
         # The journal row was written inside the scheduler (one code
         # path for every caller); surface it in this pass's report.
-        repairs.append({'action': 'controller_respawn',
-                        'scope': f'job/{job_id}',
-                        'cause': 'controller process died'})
+        _count_repair(repairs, 'controller_respawn', f'job/{job_id}',
+                      'controller process died')
     for name in summary['orphaned']:
-        repairs.append({'action': 'orphan_teardown',
-                        'scope': f'cluster/{name}',
-                        'cause': 'task cluster of a dead controller'})
+        _count_repair(repairs, 'orphan_teardown', f'cluster/{name}',
+                      'task cluster of a dead controller')
     for name, job_id in _terminal_job_clusters():
         if _teardown_cluster(name):
             _repair(repairs, 'orphan_teardown', f'cluster/{name}',
@@ -233,9 +245,8 @@ def reconcile_serve() -> List[Dict[str, Any]]:
     from skypilot_tpu.serve import state as serve_state
     repairs: List[Dict[str, Any]] = []
     for name in serve_core.recover_controllers():
-        repairs.append({'action': 'service_respawn',
-                        'scope': f'service/{name}',
-                        'cause': 'controller process died'})
+        _count_repair(repairs, 'service_respawn', f'service/{name}',
+                      'controller process died')
     services = {record['name'] for record in serve_state.get_services()}
     for record in global_state.get_clusters():
         match = _SERVE_CLUSTER_RE.match(record['name'])

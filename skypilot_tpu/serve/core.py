@@ -79,10 +79,14 @@ def _spawn_controller(name: str) -> int:
     """
     log_path = controller_log_path(name)
     os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    from skypilot_tpu.utils import tracing
     from skypilot_tpu.workspaces import context as ws_context
     record = serve_state.get_service(name)
     env = ws_context.controller_env(
         record.get('workspace') if record else None)
+    # Hand the `serve.up` request's trace to the controller so its
+    # replica launches/recoveries cross-link to the submitting request.
+    env = tracing.env_for_child(env)
     with open(log_path, 'ab') as logf:
         proc = subprocess.Popen(
             [sys.executable, '-m', 'skypilot_tpu.serve.controller', name],

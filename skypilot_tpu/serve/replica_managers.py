@@ -396,9 +396,13 @@ class ReplicaManager:
                     del self._preempted_at[rid]
             for r in live:
                 if r['status'] == serve_state.ReplicaStatus.PREEMPTED:
+                    from skypilot_tpu.utils import tracing
                     serve_state.remove_replica(self.service_name,
                                                r['replica_id'])
-                    new_id = self._start_replica(spot=r['spot'])
+                    with tracing.span('serve.recover_replica',
+                                      service=self.service_name,
+                                      replica=r['replica_id']):
+                        new_id = self._start_replica(spot=r['spot'])
                     preempted_at = self._preempted_at.pop(
                         r['replica_id'], None)
                     global_state.record_recovery_event(

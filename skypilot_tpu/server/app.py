@@ -48,6 +48,9 @@ def _get_request(params: Dict[str, str]) -> Tuple[int, Dict[str, Any]]:
         'request_id': record['request_id'],
         'name': record['name'],
         'status': record['status'].value,
+        # Additive field: the request-scoped trace, usable with
+        # `xsky trace` while the request is still running.
+        'trace_id': record.get('trace_id'),
     }
     if record['status'] == requests_db.RequestStatus.SUCCEEDED:
         payload['result'] = payloads.jsonify(record['result'])

@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, Optional, TextIO
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
 from skypilot_tpu.server import requests_db
+from skypilot_tpu.utils import tracing
 
 logger = sky_logging.init_logger(__name__)
 
@@ -186,9 +187,9 @@ def _release_slot(request_id: str) -> None:
     entry['sema'].release()
 
 
-def _long_worker(request_id: str, func, kwargs) -> None:
+def _long_worker(request_id: str, func, kwargs, trace_id) -> None:
     try:
-        _run_request(request_id, func, kwargs)
+        _run_request(request_id, func, kwargs, trace_id=trace_id)
     finally:
         _release_slot(request_id)
 
@@ -198,14 +199,14 @@ def _long_dispatcher(q, sema) -> None:
         item = q.get()
         if item is None:   # reset_for_test sentinel
             return
-        request_id, func, kwargs = item
+        request_id, func, kwargs, trace_id = item
         sema.acquire()
         with _long_lock:
             _long_running[request_id] = {'started': time.monotonic(),
                                          'released': False,
                                          'sema': sema}
         threading.Thread(target=_long_worker,
-                         args=(request_id, func, kwargs),
+                         args=(request_id, func, kwargs, trace_id),
                          name=f'xsky-long-{request_id[:8]}',
                          daemon=True).start()
 
@@ -314,7 +315,8 @@ def reset_long_runtime_for_test() -> None:
 
 def _run_request(request_id: str, func: Callable[..., Any],
                  kwargs: Dict[str, Any],
-                 capture_output: bool = True) -> None:
+                 capture_output: bool = True,
+                 trace_id: Optional[str] = None) -> None:
     from skypilot_tpu import state as global_state
     from skypilot_tpu.server import metrics
     record = requests_db.get(request_id)
@@ -344,7 +346,16 @@ def _run_request(request_id: str, func: Callable[..., Any],
             sink = open(path, 'a', encoding='utf-8', errors='replace')
             out_router.register(sink)
             err_router.register(sink)
-        result = func(**kwargs)
+        # Root span of the request-scoped trace: the id was minted at
+        # acceptance (schedule_request), so clients can `xsky trace
+        # <request-id>` the moment the POST returns. Everything the
+        # verb does — backend phases, fan-out ranks, failover attempts
+        # — parents under this span via the contextvar.
+        with tracing.request_span(trace_id, f'request.{record["name"]}',
+                                  request_id=request_id,
+                                  verb=record['name'],
+                                  user=record.get('user')):
+            result = func(**kwargs)
         requests_db.finish(request_id, result=result)
         metrics.observe_request(record['name'], 'succeeded',
                                 time.monotonic() - start)
@@ -397,13 +408,15 @@ def _maybe_gc() -> None:
 
 
 def _dispatch(request_id: str, name: str, func: Callable[..., Any],
-              kwargs: Dict[str, Any]) -> None:
+              kwargs: Dict[str, Any],
+              trace_id: Optional[str] = None) -> None:
     """The single dispatch tail for fresh AND requeued requests (they
     must never drift apart: a requeued request with different
     semantics is exactly the bug the requeue path exists to avoid)."""
     if _synchronous:
         # Inline test mode: no routing — capsys/pytest own the streams.
-        _run_request(request_id, func, kwargs, capture_output=False)
+        _run_request(request_id, func, kwargs, capture_output=False,
+                     trace_id=trace_id)
         return
     # Tracked from acceptance, not first run: a row queued behind a
     # busy pool must look owned (the watchdog leases everything
@@ -413,17 +426,24 @@ def _dispatch(request_id: str, name: str, func: Callable[..., Any],
     _track_inflight(request_id)
     if name in LONG_REQUESTS:
         _ensure_long_runtime()
-        _long_queue.put((request_id, func, kwargs))
+        _long_queue.put((request_id, func, kwargs, trace_id))
     else:
-        _short().submit(_run_request, request_id, func, kwargs)
+        _short().submit(_run_request, request_id, func, kwargs,
+                        True, trace_id)
 
 
 def schedule_request(name: str, user: str, body: Dict[str, Any],
                      func: Callable[..., Any],
                      kwargs: Dict[str, Any]) -> str:
     _maybe_gc()
-    request_id = requests_db.create(name, user, body)
-    _dispatch(request_id, name, func, kwargs)
+    # The trace is minted at ACCEPTANCE and persisted on the request
+    # row, so `xsky trace <request-id>` resolves while the request is
+    # still in flight (the root span starts when the work does; the
+    # gap to created_at is the queue wait).
+    trace_id = tracing.new_trace_id() if tracing.enabled() else None
+    request_id = requests_db.create(name, user, body,
+                                    trace_id=trace_id)
+    _dispatch(request_id, name, func, kwargs, trace_id=trace_id)
     return request_id
 
 
@@ -443,4 +463,10 @@ def requeue_request(request_id: str, name: str,
     # (Not a hot path: requeues happen once per server crash.)
     global_state.heartbeat_lease(f'request/{request_id}',
                                  owner='api-server-executor')
-    _dispatch(request_id, name, func, kwargs)
+    # A fresh trace for the requeued run: the dead server's spans (if
+    # any) stay under the old trace; this run's story starts clean —
+    # and the row is re-pointed so `xsky trace <request-id>` resolves
+    # to the run that is actually executing.
+    trace_id = tracing.new_trace_id() if tracing.enabled() else None
+    requests_db.set_trace_id(request_id, trace_id)
+    _dispatch(request_id, name, func, kwargs, trace_id=trace_id)

@@ -8,11 +8,27 @@ Exposed at GET /metrics:
   * xsky_http_requests_total{path,code}
   * xsky_requests_total{verb,status}          (executor verbs)
   * xsky_request_duration_seconds{verb}       (histogram)
+
+plus everything the control plane records into the generic registry
+(``skypilot_tpu/utils/metrics.py``):
+  * xsky_phase_duration_seconds{phase,status}   (span-fed histograms:
+    launch phases, failover attempts, fan-out phases)
+  * xsky_failover_attempts_total{cause}
+  * xsky_chaos_fires_total{point}
+  * xsky_reconciler_repairs_total{action}
+  * xsky_fanout_ranks_total{phase} / xsky_fanout_stragglers_total{phase}
+  * xsky_fanout_rank_duration_seconds{phase}    (histogram)
+
+and two gauges computed at scrape time from the state DB:
+  * xsky_lease_expires_in_seconds{scope}  (negative ⇒ expired holder)
+  * xsky_leases_live
 """
 from __future__ import annotations
 
 import threading
 from typing import Dict, List, Tuple
+
+from skypilot_tpu.utils import metrics as registry
 
 _lock = threading.Lock()
 
@@ -45,9 +61,10 @@ def _normalize_path(path: str) -> str:
     return '<other>'
 
 
-def _escape_label(value: str) -> str:
-    return value.replace('\\', r'\\').replace('"', r'\"').replace(
-        '\n', r'\n')
+# One escaping/formatting implementation for the whole merged
+# /metrics output (utils/metrics is the canonical copy).
+_escape_label = registry.escape_label
+_fmt_le = registry.fmt_le
 
 
 def observe_http(path: str, code: int) -> None:
@@ -82,12 +99,46 @@ def reset_for_test() -> None:
         _verb_duration_count.clear()
 
 
-def _fmt_le(le: float) -> str:
-    return '+Inf' if le == float('inf') else f'{le:g}'
+def _render_lease_gauges() -> List[str]:
+    """Lease-heartbeat health computed at scrape time (no sampler
+    daemon to keep alive): seconds until each liveness lease expires —
+    an actor whose gauge trends toward zero stopped heartbeating.
+    Never raises; an unreadable state DB costs the gauges, not the
+    scrape."""
+    lines: List[str] = []
+    try:
+        import time as time_lib
+
+        from skypilot_tpu import state
+        leases = state.list_leases()
+        now = time_lib.time()
+        lines.append('# HELP xsky_lease_expires_in_seconds Seconds '
+                     'until the liveness lease expires (negative: '
+                     'holder stopped heartbeating).')
+        lines.append('# TYPE xsky_lease_expires_in_seconds gauge')
+        live = 0
+        for lease in leases:
+            if state.lease_is_live(lease, now):
+                live += 1
+            lines.append(
+                'xsky_lease_expires_in_seconds{scope="'
+                f'{_escape_label(lease["scope"])}"}} '
+                f'{(lease["expires_at"] or 0) - now:.3f}')
+        lines.append('# HELP xsky_leases_live Leases with a live, '
+                     'unexpired holder.')
+        lines.append('# TYPE xsky_leases_live gauge')
+        lines.append(f'xsky_leases_live {live}')
+    except Exception:  # pylint: disable=broad-except
+        return []
+    return lines
 
 
 def render() -> str:
-    """Text exposition format (version 0.0.4)."""
+    """Text exposition format (version 0.0.4): the server's own
+    HTTP/verb series, then the generic control-plane registry, then
+    the scrape-time lease gauges."""
+    tail = registry.render_registry() + '\n'.join(
+        _render_lease_gauges())
     with _lock:
         lines = [
             '# HELP xsky_http_requests_total HTTP requests by route/code.',
@@ -122,4 +173,7 @@ def render() -> str:
             lines.append(
                 f'xsky_request_duration_seconds_count{{verb="{verb}"}} '
                 f'{_verb_duration_count[verb]}')
-        return '\n'.join(lines) + '\n'
+        out = '\n'.join(lines) + '\n'
+    if tail.strip():
+        out += tail if tail.endswith('\n') else tail + '\n'
+    return out

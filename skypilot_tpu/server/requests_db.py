@@ -76,6 +76,15 @@ def _get_conn() -> sqlite3.Connection:
                     created_at REAL,
                     finished_at REAL
                 )""")
+            try:
+                # The request-scoped trace id, minted at acceptance:
+                # `xsky trace <request-id>` resolves through this
+                # column while the request is still in flight (its
+                # root span is only written at completion).
+                _conn.execute(
+                    'ALTER TABLE requests ADD COLUMN trace_id TEXT')
+            except sqlite3.OperationalError:
+                pass  # column already exists
             _conn.commit()
             _conn_path = path
         return _conn
@@ -90,17 +99,39 @@ def reset_for_test() -> None:
         _conn_path = None
 
 
-def create(name: str, user: str, body: Dict[str, Any]) -> str:
+def create(name: str, user: str, body: Dict[str, Any],
+           trace_id: Optional[str] = None) -> str:
     request_id = uuid.uuid4().hex
     conn = _get_conn()
     with _lock:
         conn.execute(
             'INSERT INTO requests (request_id, name, user, status, body, '
-            'created_at) VALUES (?, ?, ?, ?, ?, ?)',
+            'created_at, trace_id) VALUES (?, ?, ?, ?, ?, ?, ?)',
             (request_id, name, user, RequestStatus.PENDING.value,
-             json.dumps(body, default=str), time.time()))
+             json.dumps(body, default=str), time.time(), trace_id))
         conn.commit()
     return request_id
+
+
+def get_trace_id(request_id: str) -> Optional[str]:
+    """The trace minted for this request at acceptance, or None."""
+    conn = _get_conn()
+    with _lock:
+        row = conn.execute(
+            'SELECT trace_id FROM requests WHERE request_id=?',
+            (request_id,)).fetchone()
+    return row[0] if row else None
+
+
+def set_trace_id(request_id: str, trace_id: Optional[str]) -> None:
+    """Re-point the request at a new trace (requeue after a server
+    crash: the fresh run's story must be the one the request id
+    resolves to, not the dead server's)."""
+    conn = _get_conn()
+    with _lock:
+        conn.execute('UPDATE requests SET trace_id=? WHERE request_id=?',
+                     (trace_id, request_id))
+        conn.commit()
 
 
 def set_status(request_id: str, status: RequestStatus) -> None:
@@ -134,7 +165,8 @@ def get(request_id: str) -> Optional[Dict[str, Any]]:
     with _lock:
         row = conn.execute(
             'SELECT request_id, name, user, status, body, result, error, '
-            'created_at, finished_at FROM requests WHERE request_id=?',
+            'created_at, finished_at, trace_id FROM requests '
+            'WHERE request_id=?',
             (request_id,)).fetchone()
     if row is None:
         return None
@@ -148,6 +180,7 @@ def get(request_id: str) -> Optional[Dict[str, Any]]:
         'error': json.loads(row[6]) if row[6] else None,
         'created_at': row[7],
         'finished_at': row[8],
+        'trace_id': row[9],
     }
 
 

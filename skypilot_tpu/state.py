@@ -148,6 +148,19 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             started_at REAL,
             expires_at REAL
         );
+        CREATE TABLE IF NOT EXISTS spans (
+            row_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            trace_id TEXT,
+            span_id TEXT,
+            parent_span_id TEXT,
+            name TEXT,
+            start_ts REAL,
+            end_ts REAL,
+            status TEXT,
+            attrs TEXT
+        );
+        CREATE INDEX IF NOT EXISTS idx_spans_trace
+            ON spans (trace_id);
     """)
     # Migration for pre-workspace DBs: clusters gain a workspace column.
     for migration in (
@@ -155,7 +168,10 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             "DEFAULT 'default'",
             # Billable wall-clock: JSON [[start, end|null], ...]; an
             # open interval means the cluster is running right now.
-            "ALTER TABLE clusters ADD COLUMN usage_intervals TEXT"):
+            "ALTER TABLE clusters ADD COLUMN usage_intervals TEXT",
+            # Journal rows record the trace they happened under, so
+            # `xsky events` and `xsky trace` cross-link.
+            "ALTER TABLE recovery_events ADD COLUMN trace_id TEXT"):
         try:
             conn.execute(migration)
         except sqlite3.OperationalError:
@@ -406,16 +422,24 @@ def record_recovery_event(event_type: str,
                           scope: str,
                           cause: Optional[str] = None,
                           latency_s: Optional[float] = None,
-                          detail: Optional[Dict[str, Any]] = None) -> None:
+                          detail: Optional[Dict[str, Any]] = None,
+                          trace_id: Optional[str] = None) -> None:
     """Append one journal row. NEVER raises: the journal is
     observability — a recovery path must not die because the state DB
     hiccuped while it was busy recovering.
 
     scope is a '/'-separated path (``job/3``, ``cluster/my-train``,
     ``service/svc/replica/2``, ``chaos/<point>``) so callers can filter
-    by prefix.
+    by prefix. The active trace id (if any) is recorded automatically
+    so `xsky events` rows cross-link to `xsky trace`.
     """
     global _recovery_event_inserts
+    if trace_id is None:
+        try:
+            from skypilot_tpu.utils import tracing
+            trace_id = tracing.current_trace_id()
+        except Exception:  # pylint: disable=broad-except
+            trace_id = None
     try:
         conn = _get_conn()
     except Exception:  # pylint: disable=broad-except
@@ -424,10 +448,11 @@ def record_recovery_event(event_type: str,
         with _lock:
             conn.execute(
                 'INSERT INTO recovery_events '
-                '(ts, event_type, scope, cause, latency_s, detail) '
-                'VALUES (?, ?, ?, ?, ?, ?)',
+                '(ts, event_type, scope, cause, latency_s, detail, '
+                'trace_id) VALUES (?, ?, ?, ?, ?, ?, ?)',
                 (time.time(), event_type, scope, cause, latency_s,
-                 json.dumps(detail) if detail is not None else None))
+                 json.dumps(detail) if detail is not None else None,
+                 trace_id))
             # Retention: a days-long capacity drought writes one row per
             # failed attempt — keep the newest window, same rationale as
             # the failover-history cap. Prune on the FIRST insert too:
@@ -453,9 +478,13 @@ def record_recovery_event(event_type: str,
 
 def get_recovery_events(scope: Optional[str] = None,
                         event_type: Optional[str] = None,
-                        limit: int = 200) -> List[Dict[str, Any]]:
+                        limit: int = 200,
+                        since: Optional[float] = None
+                        ) -> List[Dict[str, Any]]:
     """Newest `limit` events, oldest-first (a readable timeline).
-    `scope` matches exactly or as a path prefix."""
+    `scope` matches exactly or as a path prefix; `since` is a unix
+    timestamp lower bound (``xsky events --since``), so scripts can
+    join the journal with traces over a window."""
     conn = _get_conn()
     conds, args = [], []
     if scope is not None:
@@ -468,8 +497,11 @@ def get_recovery_events(scope: Optional[str] = None,
     if event_type is not None:
         conds.append('event_type = ?')
         args.append(event_type)
-    query = ('SELECT ts, event_type, scope, cause, latency_s, detail '
-             'FROM recovery_events')
+    if since is not None:
+        conds.append('ts >= ?')
+        args.append(float(since))
+    query = ('SELECT ts, event_type, scope, cause, latency_s, detail, '
+             'trace_id FROM recovery_events')
     if conds:
         query += ' WHERE ' + ' AND '.join(conds)
     query += ' ORDER BY event_id DESC LIMIT ?'
@@ -477,7 +509,8 @@ def get_recovery_events(scope: Optional[str] = None,
     with _lock:
         rows = conn.execute(query, args).fetchall()
     out = []
-    for ts, etype, escope, cause, latency, detail in reversed(rows):
+    for ts, etype, escope, cause, latency, detail, trace_id in \
+            reversed(rows):
         try:
             parsed = json.loads(detail) if detail else None
         except ValueError:
@@ -489,8 +522,111 @@ def get_recovery_events(scope: Optional[str] = None,
             'cause': cause,
             'latency_s': latency,
             'detail': parsed,
+            'trace_id': trace_id,
         })
     return out
+
+
+# ---- trace spans -----------------------------------------------------------
+# Finished spans from utils/tracing: one row per span with parent/child
+# links, persisted with the journal's never-raise discipline and the
+# same bounded-retention model. `xsky trace` reads these back into a
+# waterfall; recovery_events.trace_id points into this table.
+
+# Newest rows kept (pruned lazily every 256 inserts). A 64-host launch
+# is a few hundred spans; 50k keeps days of heavy traffic inspectable.
+_MAX_SPANS = 50000
+_span_inserts = 0
+
+
+def record_spans(rows: List[Dict[str, Any]]) -> None:
+    """Persist a batch of finished spans in ONE transaction. NEVER
+    raises — tracing wraps the very provisioning/recovery paths a DB
+    hiccup would otherwise kill (same contract as
+    record_recovery_event). Batched because the tracing buffer flushes
+    a launch's worth of spans at a time: per-row commits would put an
+    fsync on every fan-out rank."""
+    global _span_inserts
+    if not rows:
+        return
+    try:
+        conn = _get_conn()
+    except Exception:  # pylint: disable=broad-except
+        return
+    try:
+        with _lock:
+            conn.executemany(
+                'INSERT INTO spans (trace_id, span_id, parent_span_id, '
+                'name, start_ts, end_ts, status, attrs) '
+                'VALUES (?, ?, ?, ?, ?, ?, ?, ?)',
+                [(r['trace_id'], r['span_id'], r.get('parent_span_id'),
+                  r['name'], r['start_ts'], r['end_ts'],
+                  r.get('status', 'OK'),
+                  json.dumps(r['attrs'], default=str)
+                  if r.get('attrs') is not None else None)
+                 for r in rows])
+            # Prune on the FIRST batch too: most writers (CLI launches)
+            # are short-lived processes that would never reach the
+            # amortized gate (same rationale as the journal prune).
+            _span_inserts += len(rows)
+            if _span_inserts == len(rows) or \
+                    _span_inserts % 256 < len(rows):
+                conn.execute(
+                    'DELETE FROM spans WHERE row_id <= '
+                    '(SELECT MAX(row_id) FROM spans) - ?',
+                    (_MAX_SPANS,))
+            conn.commit()
+    except Exception:  # pylint: disable=broad-except
+        try:
+            conn.rollback()
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+def get_spans(trace_id: str, limit: int = 5000) -> List[Dict[str, Any]]:
+    """Every finished span of one trace, ordered by start time."""
+    conn = _get_conn()
+    with _lock:
+        rows = conn.execute(
+            'SELECT trace_id, span_id, parent_span_id, name, start_ts, '
+            'end_ts, status, attrs FROM spans WHERE trace_id=? '
+            'ORDER BY start_ts, row_id LIMIT ?',
+            (trace_id, int(limit))).fetchall()
+    out = []
+    for tid, sid, parent, name, start_ts, end_ts, status, attrs in rows:
+        try:
+            parsed = json.loads(attrs) if attrs else None
+        except ValueError:
+            parsed = None
+        out.append({
+            'trace_id': tid,
+            'span_id': sid,
+            'parent_span_id': parent,
+            'name': name,
+            'start_ts': start_ts,
+            'end_ts': end_ts,
+            'status': status,
+            'attrs': parsed,
+        })
+    return out
+
+
+def find_trace_ids(needle: str, limit: int = 5) -> List[str]:
+    """Trace ids whose spans mention `needle` (request id, cluster
+    name, span name), newest trace first — the `xsky trace <target>`
+    resolver. LIKE metacharacters are escaped: a literal search, not a
+    pattern one."""
+    escaped = (needle.replace('\\', '\\\\').replace('%', '\\%')
+               .replace('_', '\\_'))
+    pattern = f'%{escaped}%'
+    conn = _get_conn()
+    with _lock:
+        rows = conn.execute(
+            'SELECT trace_id, MAX(row_id) AS newest FROM spans '
+            "WHERE attrs LIKE ? ESCAPE '\\' OR name LIKE ? ESCAPE '\\' "
+            'GROUP BY trace_id ORDER BY newest DESC LIMIT ?',
+            (pattern, pattern, int(limit))).fetchall()
+    return [r[0] for r in rows]
 
 
 # ---- liveness leases -------------------------------------------------------

@@ -153,9 +153,15 @@ class _Plan:
         if rule is None:
             return None
         latency = rule.get('latency_s')
+        measured_s = None
         if latency:
+            # Journal the MEASURED delay, not the configured one: an
+            # oversleeping host (cgroup throttling, a loaded box) is
+            # exactly the signal a latency drill exists to surface.
+            t0 = time.monotonic()
             time.sleep(float(latency))
-        _journal(point, rule, ctx)
+            measured_s = time.monotonic() - t0
+        _journal(point, rule, ctx, measured_s)
         sig = rule.get('signal')
         if sig is not None:
             # Crash drill: the journal row above is already committed,
@@ -181,9 +187,14 @@ class _Plan:
         return True
 
 
-def _journal(point: str, rule: Dict[str, Any],
-             ctx: Dict[str, Any]) -> None:
-    """Record the injected fault; never let observability kill the path."""
+def _journal(point: str, rule: Dict[str, Any], ctx: Dict[str, Any],
+             measured_latency_s: Optional[float] = None) -> None:
+    """Record the injected fault; never let observability kill the path.
+
+    ``measured_latency_s`` is the actually-injected sleep (measured at
+    the call site), journalled as the row's latency and attached to
+    the active trace span — NOT the plan's configured value.
+    """
     if rule.get('error'):
         cause = rule['error']
     elif 'signal' in rule:
@@ -196,8 +207,24 @@ def _journal(point: str, rule: Dict[str, Any],
         from skypilot_tpu import state
         state.record_recovery_event(
             'chaos.injected', scope=f'chaos/{point}', cause=cause,
+            latency_s=measured_latency_s,
             detail={k: v for k, v in ctx.items()
                     if isinstance(v, (str, int, float, bool))} or None)
+    except Exception:  # pylint: disable=broad-except
+        pass
+    try:
+        # Cross-link: the span this fault fired under carries every
+        # chaos fire (point, cause, measured latency), and /metrics
+        # counts fires by point.
+        from skypilot_tpu.utils import metrics
+        from skypilot_tpu.utils import tracing
+        fire = {'point': point, 'cause': cause}
+        if measured_latency_s is not None:
+            fire['latency_s'] = round(measured_latency_s, 6)
+        tracing.annotate_append('chaos_fires', fire)
+        metrics.inc_counter('xsky_chaos_fires_total',
+                            'Chaos rules fired, by point.', 1.0,
+                            point=point)
     except Exception:  # pylint: disable=broad-except
         pass
 

@@ -1,0 +1,144 @@
+"""Lock-cheap in-process metrics registry (Prometheus exposition).
+
+The server-specific HTTP/verb metrics live in
+``skypilot_tpu/server/metrics.py``; this module is the generic
+substrate the rest of the control plane records into — launch-phase
+latency histograms (fed by ``utils/tracing`` at span end), failover
+attempts by cause, chaos fires, reconciler repairs, fan-out straggler
+counts. ``server/metrics.render()`` appends :func:`render_registry` to
+its own output, so everything lands on the API server's ``/metrics``
+endpoint in one scrape.
+
+Design constraints:
+  * **Lock-cheap** — one module lock around plain dict bumps; no
+    per-metric objects to allocate on the hot path.
+  * **Never raises** — a metrics bump sits inside recovery and launch
+    paths; observability must not take them down.
+  * **Bounded cardinality is the CALLER's contract** — label values
+    must come from closed sets (phase names, exception class names,
+    chaos point names), never user input.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_lock = threading.Lock()
+
+# Shared latency bucket ladder: wide enough for sub-second fan-out
+# ranks and multi-minute provision attempts alike.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0, 300.0, 900.0,
+    float('inf'))
+
+# name -> (help, type)
+_meta: Dict[str, Tuple[str, str]] = {}
+# name -> {(label_items sorted tuple): value}
+_counters: Dict[str, Dict[Tuple, float]] = {}
+# name -> {labels: [bucket_counts, sum, count]}; buckets per name
+_hist_buckets: Dict[str, Tuple[float, ...]] = {}
+_hists: Dict[str, Dict[Tuple, List]] = {}
+
+
+def _label_key(labels: Dict[str, object]) -> Tuple:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def inc_counter(name: str, help_text: str, value: float = 1.0,
+                **labels: object) -> None:
+    """Bump a counter. Never raises."""
+    try:
+        key = _label_key(labels)
+        with _lock:
+            _meta.setdefault(name, (help_text, 'counter'))
+            series = _counters.setdefault(name, {})
+            series[key] = series.get(key, 0.0) + value
+    except Exception:  # pylint: disable=broad-except
+        pass
+
+
+def observe(name: str, help_text: str, value: float,
+            buckets: Optional[Tuple[float, ...]] = None,
+            **labels: object) -> None:
+    """Record one histogram observation. Never raises."""
+    try:
+        key = _label_key(labels)
+        with _lock:
+            _meta.setdefault(name, (help_text, 'histogram'))
+            bks = _hist_buckets.setdefault(name,
+                                           buckets or DEFAULT_BUCKETS)
+            series = _hists.setdefault(name, {})
+            entry = series.get(key)
+            if entry is None:
+                entry = series[key] = [[0] * len(bks), 0.0, 0]
+            counts, _, _ = entry
+            for i, le in enumerate(bks):
+                if value <= le:
+                    counts[i] += 1
+            entry[1] += value
+            entry[2] += 1
+    except Exception:  # pylint: disable=broad-except
+        pass
+
+
+def reset_for_test() -> None:
+    with _lock:
+        _meta.clear()
+        _counters.clear()
+        _hist_buckets.clear()
+        _hists.clear()
+
+
+# ---- exposition ------------------------------------------------------------
+
+
+def escape_label(value: str) -> str:
+    """Prometheus label-value escaping (shared with server/metrics —
+    ONE implementation so the merged /metrics output can't drift)."""
+    return value.replace('\\', r'\\').replace('"', r'\"').replace(
+        '\n', r'\n')
+
+
+def fmt_le(le: float) -> str:
+    """Bucket upper-bound formatting (`+Inf` per the exposition
+    format); shared with server/metrics."""
+    return '+Inf' if le == float('inf') else f'{le:g}'
+
+
+def _fmt_labels(key: Tuple, extra: str = '') -> str:
+    parts = [f'{k}="{escape_label(v)}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return '{' + ','.join(parts) + '}' if parts else ''
+
+
+def _fmt_value(value: float) -> str:
+    return f'{value:g}' if value == int(value) else f'{value:.6f}'
+
+
+def render_registry() -> str:
+    """The generic registry in text exposition format (0.0.4). Empty
+    string when nothing has been recorded."""
+    with _lock:
+        lines: List[str] = []
+        for name in sorted(_meta):
+            help_text, mtype = _meta[name]
+            lines.append(f'# HELP {name} {help_text}')
+            lines.append(f'# TYPE {name} {mtype}')
+            if mtype == 'counter':
+                for key, value in sorted(_counters.get(name, {}).items()):
+                    lines.append(
+                        f'{name}{_fmt_labels(key)} {_fmt_value(value)}')
+            else:
+                bks = _hist_buckets[name]
+                for key, (counts, total, count) in sorted(
+                        _hists.get(name, {}).items()):
+                    for i, le in enumerate(bks):
+                        le_label = 'le="%s"' % fmt_le(le)
+                        lines.append(
+                            f'{name}_bucket{_fmt_labels(key, le_label)} '
+                            f'{counts[i]}')
+                    lines.append(
+                        f'{name}_sum{_fmt_labels(key)} {total:.6f}')
+                    lines.append(f'{name}_count{_fmt_labels(key)} {count}')
+        return '\n'.join(lines) + ('\n' if lines else '')

@@ -25,9 +25,14 @@ work is subprocess/ssh-bound, not CPU-bound):
     ``{'phase': ..., 'rank': ...}`` context, so fault tests can fail
     or delay individual ranks mid-fan-out
     (``{"match": {"phase": "setup", "rank": 1}, "error": ...}``).
-  * **Tracing** — each rank runs inside a ``timeline.Event`` named
-    ``fanout.<phase>``; with ``XSKY_TIMELINE_FILE`` set the Chrome
-    trace shows per-phase concurrency (overlapping bars across tids).
+  * **Tracing** — the whole phase runs inside a ``fanout.<phase>``
+    span and each rank inside a ``fanout.<phase>.rank`` child
+    (utils/tracing; `xsky trace` renders the waterfall and flags the
+    slowest rank + stragglers; per-rank timings feed the
+    ``xsky_fanout_*`` metrics). Each rank also emits a
+    ``timeline.Event`` named ``fanout.<phase>`` carrying its
+    ``trace_id``; with ``XSKY_TIMELINE_FILE`` set the Chrome trace
+    shows per-phase concurrency (overlapping bars across tids).
 
 Concurrency is bounded by ``max_workers`` (default
 ``$XSKY_FANOUT_WORKERS``, 16): enough to hide per-host ssh latency
@@ -41,13 +46,16 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
 from skypilot_tpu.utils import chaos
+from skypilot_tpu.utils import metrics
 from skypilot_tpu.utils import resilience
 from skypilot_tpu.utils import timeline
+from skypilot_tpu.utils import tracing
 
 logger = sky_logging.init_logger(__name__)
 
@@ -107,6 +115,19 @@ def run_in_parallel(fn: Callable[[Any], Any],
         max_workers = fanout_workers()
     workers = max(1, min(int(max_workers), total))
     deadline = deadline or resilience.Deadline.unlimited()
+    # Whole-phase span: rank spans parent under it, so `xsky trace`
+    # shows the fan-out as one bar with per-rank children (and the
+    # slowest rank called out). With tracing disabled this is the
+    # no-op singleton and nothing below allocates for observability.
+    with tracing.span(f'fanout.{phase}', hosts=total,
+                      workers=workers) as fanout_span:
+        return _fanout(fn, items, total, workers, deadline, phase,
+                       what, fanout_span)
+
+
+def _fanout(fn: Callable[[Any], Any], items: List[Any], total: int,
+            workers: int, deadline: resilience.Deadline, phase: str,
+            what: str, fanout_span: Any) -> List[Any]:
     results: List[Any] = [None] * total
     failures: Dict[int, BaseException] = {}
     not_started: List[int] = []
@@ -117,14 +138,36 @@ def run_in_parallel(fn: Callable[[Any], Any],
     # iteration" inside MultiHostError.
     final_failures: Dict[int, BaseException] = failures
     final_not_started: List[int] = not_started
+    # Contextvars do not cross thread spawns: capture the fan-out
+    # span's context here (the caller thread, inside the span) and
+    # re-attach it per rank. None ⇔ tracing disabled — the rank span
+    # is then the no-op singleton and durations are not tracked.
+    parent = tracing.capture() if tracing.enabled() else None
+    durations: Optional[List[Optional[float]]] = \
+        [None] * total if parent is not None else None
 
     def _one(rank: int, item: Any) -> Any:
-        with timeline.Event(f'fanout.{phase}', args={'rank': rank}):
-            # Chaos rules keyed on phase/rank can fail or delay
-            # individual ranks mid-fan-out; an injected raise counts
-            # as that rank's failure.
+        if parent is None:
+            with timeline.Event(f'fanout.{phase}', args={'rank': rank}):
+                # Chaos rules keyed on phase/rank can fail or delay
+                # individual ranks mid-fan-out; an injected raise
+                # counts as that rank's failure.
+                chaos.inject('fanout.worker', phase=phase, rank=rank)
+                return fn(item)
+        with tracing.span(f'fanout.{phase}.rank', parent=parent,
+                          rank=rank), \
+                timeline.Event(f'fanout.{phase}',
+                               args={'rank': rank,
+                                     'trace_id': parent[0]}):
+            # Duration measured around the rank's WORK (chaos delay
+            # included — it simulates a slow host), not the span's
+            # own serialized DB commit; a failed rank stays None and
+            # is not straggler-scored.
+            t0 = time.monotonic()
             chaos.inject('fanout.worker', phase=phase, rank=rank)
-            return fn(item)
+            result = fn(item)
+            durations[rank] = time.monotonic() - t0
+            return result
 
     if workers == 1:
         # Degenerate mode: byte-for-byte the old sequential loops —
@@ -222,7 +265,42 @@ def run_in_parallel(fn: Callable[[Any], Any],
             final_failures = dict(failures)
             final_not_started = list(not_started)
 
+    if durations is not None:
+        _observe_ranks(phase, list(durations), fanout_span)
     if final_failures:
         raise exceptions.MultiHostError(what, final_failures, total,
                                         sorted(final_not_started))
     return results
+
+
+def _observe_ranks(phase: str, durations: List[Optional[float]],
+                   fanout_span: Any) -> None:
+    """Feed per-rank timings into the metrics registry and flag the
+    phase's slowest rank / stragglers on the fan-out span. A straggler
+    is a rank slower than 1.5x the phase median — the signal `xsky
+    trace` and the `/metrics` straggler ratio both key on."""
+    done = [(rank, d) for rank, d in enumerate(durations)
+            if d is not None]
+    if not done:
+        return
+    times = sorted(d for _, d in done)
+    median = times[len(times) // 2]
+    stragglers = [rank for rank, d in done
+                  if median > 0 and d > 1.5 * median]
+    for _, d in done:
+        metrics.observe('xsky_fanout_rank_duration_seconds',
+                        'Per-rank fan-out work duration.', d,
+                        phase=phase)
+    metrics.inc_counter('xsky_fanout_ranks_total',
+                        'Fan-out ranks executed.', len(done),
+                        phase=phase)
+    if stragglers:
+        metrics.inc_counter(
+            'xsky_fanout_stragglers_total',
+            'Ranks slower than 1.5x their phase median.',
+            len(stragglers), phase=phase)
+    slowest_rank, slowest = max(done, key=lambda rd: rd[1])
+    fanout_span.set(slowest_rank=slowest_rank,
+                    slowest_s=round(slowest, 6),
+                    median_s=round(median, 6),
+                    stragglers=stragglers)

@@ -112,6 +112,31 @@ class TestChaosPlan:
         assert chaos.inject('p') is not None
         assert time.monotonic() - start >= 0.05
 
+    def test_latency_action_journals_measured_duration(
+            self, fake_cluster_env):
+        """The journal row records the MEASURED sleep, not the plan's
+        configured value (an oversleeping host is the signal), and the
+        fire lands on the active trace span with that latency."""
+        del fake_cluster_env
+        from skypilot_tpu import state as state_lib
+        from skypilot_tpu.utils import tracing
+        chaos.load_plan({'points': {'p': {'latency_s': 0.05}}})
+        with tracing.span('chaos.host') as sp:
+            chaos.inject('p')
+        rows = state_lib.get_recovery_events(
+            event_type='chaos.injected')
+        assert len(rows) == 1
+        measured = rows[0]['latency_s']
+        assert measured is not None and measured >= 0.05
+        # Measured, not configured: a real sleep always overshoots.
+        assert measured != 0.05
+        span_row = state_lib.get_spans(sp.trace_id)[0]
+        fires = span_row['attrs']['chaos_fires']
+        assert fires[0]['point'] == 'p'
+        assert fires[0]['latency_s'] >= 0.05
+        # Journal row cross-links to the span's trace.
+        assert rows[0]['trace_id'] == sp.trace_id
+
     def test_plan_from_env_json_and_file(self, monkeypatch, tmp_path):
         monkeypatch.setenv('XSKY_CHAOS_PLAN',
                            '{"points": {"p": {"first_n": 1}}}')
@@ -482,6 +507,162 @@ class TestLeaseHeartbeatLint:
             '        self._heartbeat()\n'
             '        self.tick()\n')
         assert self._loops_missing_heartbeat(clean, 'run') == []
+
+
+class TestSpanCoverageLint:
+    """Observability coverage lints: (1) every
+    ``parallelism.run_in_parallel`` call site in the tree must execute
+    under an active tracing span (a ``with tracing.span(...)`` block
+    lexically enclosing the call, within the same function) — an
+    untraced fan-out is invisible to `xsky trace` and to the
+    `/metrics` phase histograms; (2) every failover retry loop (a
+    loop driving ``_try_resources`` / ``_try_zone``) must likewise run
+    under a span, so failed attempts land on the trace."""
+
+    SKIPPED_FILES = {
+        # The primitive's own definition site (it opens the
+        # fanout.<phase> span internally).
+        'skypilot_tpu/utils/parallelism.py',
+    }
+    RETRY_CALLEES = {'_try_resources', '_try_zone'}
+
+    @staticmethod
+    def _is_span_with(node):
+        if not isinstance(node, ast.With):
+            return False
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                func = expr.func
+                name = func.attr if isinstance(func, ast.Attribute) \
+                    else getattr(func, 'id', '')
+                if 'span' in (name or ''):
+                    return True
+        return False
+
+    @classmethod
+    def _uncovered_fanout_calls(cls, tree):
+        """Line numbers of run_in_parallel calls NOT lexically inside
+        a span-With. Coverage resets at function boundaries: a nested
+        def runs when called, not where a span happens to enclose its
+        definition."""
+        offenders = []
+
+        def walk(node, covered):
+            for child in ast.iter_child_nodes(node):
+                child_covered = covered
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    child_covered = False
+                elif cls._is_span_with(child):
+                    child_covered = True
+                if (isinstance(child, ast.Call) and
+                        isinstance(child.func, ast.Attribute) and
+                        child.func.attr == 'run_in_parallel' and
+                        not covered):
+                    offenders.append(child.lineno)
+                walk(child, child_covered)
+
+        walk(tree, False)
+        return offenders
+
+    @classmethod
+    def _uncovered_retry_loops(cls, tree):
+        """Line numbers of failover retry loops (loops whose body
+        calls a RETRY_CALLEES member) not under a span-With."""
+        offenders = []
+
+        def drives_retry(loop):
+            for sub in ast.walk(loop):
+                if isinstance(sub, ast.Call):
+                    func = sub.func
+                    name = func.attr if isinstance(func, ast.Attribute) \
+                        else getattr(func, 'id', '')
+                    if name in cls.RETRY_CALLEES:
+                        return True
+            return False
+
+        def walk(node, covered):
+            for child in ast.iter_child_nodes(node):
+                child_covered = covered
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    child_covered = False
+                elif cls._is_span_with(child):
+                    child_covered = True
+                if (isinstance(child, (ast.For, ast.While)) and
+                        not covered and drives_retry(child)):
+                    offenders.append(child.lineno)
+                walk(child, child_covered)
+
+        walk(tree, False)
+        return offenders
+
+    def test_every_fanout_call_site_runs_under_a_span(self):
+        repo_root = os.path.join(os.path.dirname(__file__), '..', '..')
+        pkg_root = os.path.join(repo_root, 'skypilot_tpu')
+        violations = []
+        for dirpath, _, filenames in os.walk(pkg_root):
+            for fname in sorted(filenames):
+                if not fname.endswith('.py'):
+                    continue
+                path = os.path.join(dirpath, fname)
+                rel = os.path.relpath(path, repo_root)
+                if rel in self.SKIPPED_FILES:
+                    continue
+                with open(path, encoding='utf-8') as f:
+                    tree = ast.parse(f.read(), filename=rel)
+                violations.extend(
+                    f'{rel}:{line}'
+                    for line in self._uncovered_fanout_calls(tree))
+        assert not violations, (
+            'run_in_parallel call site outside a tracing span — wrap '
+            'it in `with tracing.span(...)` so the fan-out lands on '
+            'the trace:\n  ' + '\n  '.join(violations))
+
+    def test_failover_retry_loops_run_under_a_span(self):
+        repo_root = os.path.join(os.path.dirname(__file__), '..', '..')
+        path = os.path.join(repo_root,
+                            'skypilot_tpu/backends/failover.py')
+        with open(path, encoding='utf-8') as f:
+            tree = ast.parse(f.read(), filename='failover.py')
+        missing = self._uncovered_retry_loops(tree)
+        assert not missing, (
+            'failover retry loop outside a tracing span (lines '
+            f'{missing}) — failed attempts must land on the trace.')
+
+    def test_lint_catches_an_uncovered_fanout_call(self):
+        bad = ast.parse(
+            'def setup(runners):\n'
+            '    parallelism.run_in_parallel(f, runners)\n')
+        assert self._uncovered_fanout_calls(bad) == [2]
+        clean = ast.parse(
+            'def setup(runners):\n'
+            '    with tracing.span("setup"):\n'
+            '        parallelism.run_in_parallel(f, runners)\n')
+        assert self._uncovered_fanout_calls(clean) == []
+        # A span enclosing only the DEFINITION of a nested function
+        # does not cover calls inside it.
+        leaky = ast.parse(
+            'def outer():\n'
+            '    with tracing.span("outer"):\n'
+            '        def inner():\n'
+            '            parallelism.run_in_parallel(f, [])\n'
+            '        inner()\n')
+        assert self._uncovered_fanout_calls(leaky) == [4]
+
+    def test_lint_catches_an_uncovered_retry_loop(self):
+        bad = ast.parse(
+            'def provision(self):\n'
+            '    for _ in range(3):\n'
+            '        self._try_resources(r)\n')
+        assert self._uncovered_retry_loops(bad) == [2]
+        clean = ast.parse(
+            'def provision(self):\n'
+            '    with tracing.span("failover.provision"):\n'
+            '        for _ in range(3):\n'
+            '            self._try_resources(r)\n')
+        assert self._uncovered_retry_loops(clean) == []
 
 
 class TestChaosSmoke:

@@ -185,8 +185,13 @@ class TestRunInParallel:
         # Fresh sqlite for the chaos journal: each fire commits a
         # journal row under a module-wide lock, and a slow shared
         # ~/.xsky DB would let serialized fsyncs dominate the
-        # injected latency and flake the ratio below.
+        # injected latency and flake the ratio below. Tracing off for
+        # the same reason: this is a timing micro-benchmark, and span
+        # buffer flushes would add fsyncs to the measured window on a
+        # loaded box (the tracing overhead gate lives in
+        # tools/bench_fanout.py --trace-overhead).
         monkeypatch.setenv('XSKY_STATE_DB', str(tmp_path / 'state.db'))
+        monkeypatch.setenv('XSKY_TRACING', '0')
         chaos.load_plan({'points': {'fanout.worker': {
             'latency_s': 0.3}}})
         items = list(range(4))

@@ -1,0 +1,381 @@
+"""Tracing-plane tests: span mechanics, context propagation across
+threads/processes, the never-raise persistence contract, the metrics
+registry exposition, and the tier-1 fake-cloud smoke asserting a
+launch produces a complete span tree (no orphans) surfaced by
+`xsky trace` and `/metrics`."""
+import json
+import re
+
+import pytest
+
+from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import tracing
+
+
+@pytest.fixture
+def tmp_state(monkeypatch, tmp_path):
+    """Isolated state DB + clean span buffer."""
+    from skypilot_tpu import state
+    monkeypatch.setenv('XSKY_STATE_DB', str(tmp_path / 'state.db'))
+    monkeypatch.delenv(tracing.ENV_TRACE_CONTEXT, raising=False)
+    state.reset_for_test()
+    tracing.reset_for_test()
+    yield state
+    tracing.reset_for_test()
+    state.reset_for_test()
+
+
+class TestSpanBasics:
+
+    def test_root_span_persists_with_attrs(self, tmp_state):
+        with tracing.span('unit.op', cluster='c1') as sp:
+            trace_id = sp.trace_id
+            sp.set(extra=7)
+        # Root exit flushes the buffer synchronously.
+        rows = tmp_state.get_spans(trace_id)
+        assert len(rows) == 1
+        row = rows[0]
+        assert row['name'] == 'unit.op'
+        assert row['parent_span_id'] is None
+        assert row['status'] == 'OK'
+        assert row['attrs'] == {'cluster': 'c1', 'extra': 7}
+        assert row['end_ts'] >= row['start_ts']
+        # The resolver finds the trace by attribute value.
+        assert tmp_state.find_trace_ids('c1') == [trace_id]
+
+    def test_nested_spans_link_parent_child(self, tmp_state):
+        with tracing.span('parent') as parent:
+            with tracing.span('child') as child:
+                assert child.trace_id == parent.trace_id
+        rows = {r['name']: r for r in tmp_state.get_spans(parent.trace_id)}
+        assert rows['child']['parent_span_id'] == \
+            rows['parent']['span_id']
+        assert rows['parent']['parent_span_id'] is None
+
+    def test_exception_marks_span_error(self, tmp_state):
+        with pytest.raises(ValueError):
+            with tracing.span('boom') as sp:
+                raise ValueError('kaput')
+        row = tmp_state.get_spans(sp.trace_id)[0]
+        assert row['status'] == 'ERROR'
+        assert 'ValueError' in row['attrs']['error']
+
+    def test_disabled_returns_noop_singleton(self, tmp_state,
+                                             monkeypatch):
+        """The zero-allocation contract: with XSKY_TRACING=0 every
+        span() call returns the SAME no-op object — no Span allocated,
+        no ids minted, no row written."""
+        monkeypatch.setenv(tracing.ENV_TRACING, '0')
+        s1, s2 = tracing.span('a', big='attr'), tracing.span('b')
+        assert s1 is s2 is tracing.NOOP_SPAN
+        with s1:
+            assert tracing.capture() is None
+        tracing.flush()
+        with tmp_state._lock:  # pylint: disable=protected-access
+            count = tmp_state._get_conn().execute(  # pylint: disable=protected-access
+                'SELECT COUNT(*) FROM spans').fetchone()[0]
+        assert count == 0
+
+    def test_never_raises_on_db_failure(self, tmp_state, monkeypatch):
+        """Tracing wraps provisioning/recovery paths: a broken state
+        DB must cost the spans, never the operation."""
+        def _boom():
+            raise RuntimeError('db down')
+
+        monkeypatch.setattr(tmp_state, '_get_conn', _boom)
+        with tracing.span('survives'):
+            pass           # root exit triggers a flush → swallowed
+        tmp_state.record_spans([{'trace_id': 't', 'span_id': 's',
+                                 'name': 'n', 'start_ts': 0,
+                                 'end_ts': 1}])   # also never raises
+
+    def test_request_span_uses_minted_trace_id(self, tmp_state):
+        minted = tracing.new_trace_id()
+        with tracing.request_span(minted, 'request.launch',
+                                  request_id='abc') as sp:
+            assert sp.trace_id == minted
+        assert tmp_state.find_trace_ids('abc') == [minted]
+
+
+class TestContextPropagation:
+
+    def test_run_in_parallel_ranks_inherit_trace(self, tmp_state):
+        """The contextvar does not cross thread spawns on its own —
+        run_in_parallel re-attaches the fan-out span's context in
+        every worker, so rank code sees the launch trace."""
+        from skypilot_tpu.utils import parallelism
+        seen = {}
+
+        def work(i):
+            seen[i] = tracing.current_trace_id()
+
+        with tracing.span('root') as root:
+            parallelism.run_in_parallel(work, list(range(4)),
+                                        max_workers=4, phase='unittrace')
+        assert set(seen) == {0, 1, 2, 3}
+        assert set(seen.values()) == {root.trace_id}
+
+    def test_rank_spans_parent_under_fanout_span(self, tmp_state):
+        from skypilot_tpu.utils import parallelism
+        with tracing.span('root') as root:
+            parallelism.run_in_parallel(lambda i: i, list(range(3)),
+                                        max_workers=3, phase='unitp')
+        rows = tmp_state.get_spans(root.trace_id)
+        fanout = [r for r in rows if r['name'] == 'fanout.unitp']
+        ranks = [r for r in rows if r['name'] == 'fanout.unitp.rank']
+        assert len(fanout) == 1 and len(ranks) == 3
+        assert {r['parent_span_id'] for r in ranks} == \
+            {fanout[0]['span_id']}
+        assert sorted(r['attrs']['rank'] for r in ranks) == [0, 1, 2]
+        # The fan-out span names the phase's slowest rank.
+        assert 'slowest_rank' in fanout[0]['attrs']
+
+    def test_env_handoff_to_subprocess_context(self, tmp_state,
+                                               monkeypatch):
+        """XSKY_TRACE_CONTEXT is how controller subprocesses join the
+        submitting request's trace."""
+        with tracing.span('submitter') as sp:
+            env = tracing.env_for_child({})
+            assert env[tracing.ENV_TRACE_CONTEXT] == \
+                f'{sp.trace_id}:{sp.span_id}'
+        # "In the child process": no contextvar, only the env var.
+        monkeypatch.setenv(tracing.ENV_TRACE_CONTEXT,
+                           env[tracing.ENV_TRACE_CONTEXT])
+        assert tracing.capture() == (sp.trace_id, sp.span_id)
+        with tracing.span('child.work') as child:
+            assert child.trace_id == sp.trace_id
+            assert child.parent_span_id == sp.span_id
+
+    def test_request_id_resolves_before_any_span_lands(
+            self, tmp_state, monkeypatch, tmp_path):
+        """`xsky trace <request-id>` works the moment the POST
+        returns: the trace id is persisted on the request row at
+        acceptance, before any span has finished."""
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        from skypilot_tpu.server import requests_db
+        monkeypatch.setenv('XSKY_SERVER_DB',
+                           str(tmp_path / 'requests.db'))
+        requests_db.reset_for_test()
+        try:
+            minted = tracing.new_trace_id()
+            rid = requests_db.create('launch', 'anon', {},
+                                     trace_id=minted)
+            assert requests_db.get_trace_id(rid) == minted
+            result = CliRunner().invoke(cli_mod.cli, ['trace', rid])
+            assert result.exit_code == 0, result.output
+            assert 'no finished spans yet' in result.output
+            # Once a span lands under the minted trace, the same
+            # request id renders the waterfall.
+            with tracing.request_span(minted, 'request.launch',
+                                      request_id=rid):
+                pass
+            result = CliRunner().invoke(cli_mod.cli, ['trace', rid])
+            assert 'request.launch' in result.output
+        finally:
+            requests_db.reset_for_test()
+
+    def test_recovery_events_record_active_trace(self, tmp_state):
+        with tracing.span('recovering') as sp:
+            tmp_state.record_recovery_event('unit.event', scope='job/1')
+        rows = tmp_state.get_recovery_events(event_type='unit.event')
+        assert rows[0]['trace_id'] == sp.trace_id
+
+    def test_events_since_filter(self, tmp_state):
+        import time
+        tmp_state.record_recovery_event('unit.old', scope='x')
+        cutoff = time.time()
+        tmp_state.record_recovery_event('unit.new', scope='x')
+        rows = tmp_state.get_recovery_events(scope='x', since=cutoff)
+        assert [r['event_type'] for r in rows] == ['unit.new']
+        assert len(tmp_state.get_recovery_events(scope='x')) == 2
+
+
+_EXPOSITION_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9eE.+]+$|'
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [+-]?Inf$')
+
+
+def _assert_parseable(text):
+    for line in text.splitlines():
+        if not line or line.startswith('#'):
+            continue
+        assert _EXPOSITION_LINE.match(line), f'unparseable: {line!r}'
+
+
+class TestMetricsRegistry:
+
+    def test_counter_and_histogram_render(self):
+        metrics_lib.inc_counter('xsky_unit_total', 'Unit counter.',
+                                2.0, kind='a')
+        metrics_lib.observe('xsky_unit_seconds', 'Unit histogram.',
+                            0.3, kind='b')
+        metrics_lib.inc_counter('xsky_unit_esc_total', 'Escaping.',
+                                1.0, kind='c d"e')
+        text = metrics_lib.render_registry()
+        assert 'xsky_unit_total{kind="a"} 2' in text
+        assert 'xsky_unit_seconds_bucket{kind="b",le="0.5"} 1' in text
+        assert 'xsky_unit_seconds_count{kind="b"} 1' in text
+        assert r'xsky_unit_esc_total{kind="c d\"e"} 1' in text
+        _assert_parseable(text)
+
+    def test_server_metrics_merges_registry(self, tmp_state):
+        from skypilot_tpu.server import metrics as server_metrics
+        metrics_lib.inc_counter('xsky_unit_merge_total', 'Unit.', 1.0)
+        tmp_state.heartbeat_lease('unit/scope', owner='test')
+        text = server_metrics.render()
+        assert 'xsky_unit_merge_total 1' in text
+        assert 'xsky_lease_expires_in_seconds{scope="unit/scope"}' \
+            in text
+        _assert_parseable(text)
+
+
+class TestLaunchTraceSmoke:
+    """Tier-1 acceptance: a fake-cloud multi-host launch produces ONE
+    complete span tree — every phase present, every span's parent in
+    the tree, rank spans under their fan-out phase — and the trace is
+    reachable through `xsky trace` and `/metrics`."""
+
+    def _launch(self, tmp_path, cluster):
+        import os
+
+        from skypilot_tpu import Resources, Task, execution
+        src = tmp_path / 'workdir'
+        src.mkdir(exist_ok=True)
+        (src / 'payload.txt').write_text('trace-smoke')
+        mount_src = tmp_path / 'mount.txt'
+        mount_src.write_text('mounted')
+        task = Task('trace-smoke', run=None, setup='true',
+                    workdir=str(src),
+                    file_mounts={'smoke/in.txt': str(mount_src)})
+        # tpu-v5e-32 = 4 fake hosts: multi-host fan-out without the
+        # wall-clock of a 16-host launch in tier-1.
+        task.set_resources(Resources(accelerators='tpu-v5e-32'))
+        execution.launch(task, cluster_name=cluster, detach_run=True)
+        del os
+        return task
+
+    def test_launch_produces_complete_span_tree(self, fake_cluster_env,
+                                                tmp_path):
+        del fake_cluster_env
+        from skypilot_tpu import core
+        from skypilot_tpu import state as state_lib
+        tracing.reset_for_test()
+        cluster = 'trace-smoke-tree'
+        self._launch(tmp_path, cluster)
+        try:
+            ids = state_lib.find_trace_ids(cluster)
+            assert len(ids) == 1, ids
+            spans = state_lib.get_spans(ids[0])
+            by_id = {s['span_id'] for s in spans}
+            roots = [s for s in spans if s['parent_span_id'] is None]
+            orphans = [s for s in spans
+                       if s['parent_span_id'] and
+                       s['parent_span_id'] not in by_id]
+            assert not orphans, orphans
+            assert [r['name'] for r in roots] == ['launch']
+            names = {s['name'] for s in spans}
+            for phase in ('backend.provision', 'failover.provision',
+                          'failover.attempt', 'backend.sync_workdir',
+                          'backend.file_mounts', 'backend.setup',
+                          'fanout.setup', 'fanout.setup.rank'):
+                assert phase in names, f'missing span {phase}'
+            # 4 hosts ⇒ 4 rank spans per fan-out phase.
+            setup_ranks = [s for s in spans
+                           if s['name'] == 'fanout.setup.rank']
+            assert sorted(s['attrs']['rank'] for s in setup_ranks) == \
+                [0, 1, 2, 3]
+            assert all(s['status'] == 'OK' for s in spans), spans
+            # Children stay inside their parent's window (the
+            # waterfall invariant) and phases sum to the measured
+            # wall-clock within overlap: no child may outrun the root.
+            by_span = {s['span_id']: s for s in spans}
+            root = roots[0]
+            eps = 0.05
+            for s in spans:
+                parent = by_span.get(s['parent_span_id'])
+                if parent is None:
+                    continue
+                assert s['start_ts'] >= parent['start_ts'] - eps
+                assert s['end_ts'] <= parent['end_ts'] + eps
+            top = [s for s in spans
+                   if s['parent_span_id'] == root['span_id']]
+            top_sum = sum(s['end_ts'] - s['start_ts'] for s in top)
+            root_dur = root['end_ts'] - root['start_ts']
+            assert top_sum <= root_dur + eps * (len(top) + 1)
+        finally:
+            core.down(cluster)
+
+    def test_trace_cli_and_metrics_surface(self, fake_cluster_env,
+                                           tmp_path):
+        del fake_cluster_env
+        from click.testing import CliRunner
+
+        from skypilot_tpu import core
+        from skypilot_tpu.client import cli as cli_mod
+        from skypilot_tpu.server import metrics as server_metrics
+        tracing.reset_for_test()
+        cluster = 'trace-smoke-cli'
+        self._launch(tmp_path, cluster)
+        try:
+            runner = CliRunner()
+            result = runner.invoke(cli_mod.cli, ['trace', cluster])
+            assert result.exit_code == 0, result.output
+            out = result.output
+            assert 'backend.provision' in out
+            assert 'fanout.setup' in out
+            assert '*' in out                   # critical path marked
+            assert 'slowest rank' in out or 'SLOWEST' in out
+            # --json rows are joinable with `xsky events --json`.
+            as_json = runner.invoke(cli_mod.cli,
+                                    ['trace', cluster, '--json'])
+            assert as_json.exit_code == 0
+            rows = [json.loads(line)
+                    for line in as_json.output.splitlines()
+                    if line.startswith('{')]
+            assert {r['trace_id'] for r in rows} and \
+                all('span_id' in r for r in rows)
+            # /metrics: parseable text including launch-phase
+            # histograms fed by this launch's spans.
+            text = server_metrics.render()
+            _assert_parseable(text)
+            assert 'xsky_phase_duration_seconds_bucket{phase=' \
+                '"backend.provision"' in text
+            assert 'xsky_fanout_ranks_total' in text
+        finally:
+            core.down(cluster)
+
+    def test_failover_attempts_hit_metrics_and_trace(
+            self, fake_cluster_env, tmp_path):
+        """A capacity-blocked first zone shows up as a failed
+        failover.attempt span AND an xsky_failover_attempts_total
+        counter — the acceptance criterion's failover counters."""
+        del fake_cluster_env
+        from skypilot_tpu import Resources, Task, core, execution
+        from skypilot_tpu import state as state_lib
+        from skypilot_tpu.utils import chaos
+        tracing.reset_for_test()
+        metrics_lib.reset_for_test()
+        chaos.load_plan({'points': {
+            'failover.wait_instances': {'first_n': 1,
+                                        'error': 'CapacityError'}}})
+        cluster = 'trace-smoke-failover'
+        task = Task('fo', run=None)
+        task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        try:
+            execution.launch(task, cluster_name=cluster,
+                             detach_run=True)
+            ids = state_lib.find_trace_ids(cluster)
+            spans = state_lib.get_spans(ids[0])
+            attempts = [s for s in spans
+                        if s['name'] == 'failover.attempt']
+            outcomes = [s['attrs'].get('outcome') for s in attempts]
+            assert 'CapacityError' in outcomes and 'ok' in outcomes
+            text = metrics_lib.render_registry()
+            assert ('xsky_failover_attempts_total{'
+                    'cause="CapacityError"} 1') in text
+            assert 'xsky_chaos_fires_total' in text
+        finally:
+            chaos.clear()
+            core.down(cluster)

@@ -17,9 +17,18 @@ sequential run pays `hosts × phases × latency` and the parallel run
 via `XSKY_TIMELINE_FILE`; the tool verifies per-host bring-up events
 actually overlap in time and reports the peak concurrency it saw.
 
+A second mode, ``--trace-overhead``, measures the tracing subsystem's
+cost instead: two identical parallel launches — one with
+``XSKY_TRACING=0`` (spans compiled out to the no-op singleton) and one
+with tracing enabled (every phase/rank span persisted to the state DB)
+— and asserts the traced launch costs <2% extra wall-clock (exit 1
+otherwise). This is the acceptance gate that keeps span recording off
+the launch critical path.
+
 Usage:
     python tools/bench_fanout.py [--hosts 16] [--latency 0.2]
                                  [--workers 16] [--keep-trace PATH]
+                                 [--trace-overhead]
 """
 import argparse
 import json
@@ -106,6 +115,54 @@ def _fanout_concurrency(trace_path: str) -> int:
     return peak
 
 
+def _trace_overhead(args, scratch: str) -> int:
+    """Tracing-overhead mode: identical parallel launches with spans
+    disabled vs enabled; asserts <2% wall-clock cost."""
+    max_overhead_pct = 2.0
+    repeats = 3
+    # Untimed warm-up launch: first-launch one-time costs (state-DB
+    # creation, fake-cloud store init, lazy imports) would otherwise
+    # be charged to whichever measured run goes first and drown the
+    # few-ms effect being measured.
+    os.environ['XSKY_TRACING'] = '0'
+    _one_launch('bench-overhead-warmup', args.hosts, args.workers,
+                scratch, os.path.join(scratch, 'trace_warmup.json'))
+    # Interleaved best-of-N: fake-cloud launch wall-clock jitters far
+    # more run-to-run (subprocess spawns, agent polls) than the
+    # few-ms effect under test; min-of-N per mode suppresses it.
+    base_runs, traced_runs = [], []
+    for i in range(repeats):
+        os.environ['XSKY_TRACING'] = '0'
+        base_runs.append(_one_launch(
+            f'bench-overhead-base-{i}', args.hosts, args.workers,
+            scratch, os.path.join(scratch, f'trace_base_{i}.json')))
+        os.environ['XSKY_TRACING'] = '1'
+        traced_runs.append(_one_launch(
+            f'bench-overhead-traced-{i}', args.hosts, args.workers,
+            scratch, os.path.join(scratch, f'trace_traced_{i}.json')))
+    base_s, traced_s = min(base_runs), min(traced_runs)
+    overhead_pct = (traced_s - base_s) / base_s * 100.0
+    from skypilot_tpu import state
+    spans = len(state.get_spans(
+        (state.find_trace_ids('bench-overhead-traced-0') or [''])[0]))
+    ok = overhead_pct < max_overhead_pct
+    print(json.dumps({
+        'metric': 'tracing_overhead',
+        'hosts': args.hosts,
+        'workers': args.workers,
+        'injected_latency_s': args.latency,
+        'untraced_s': round(base_s, 3),
+        'traced_s': round(traced_s, 3),
+        'untraced_runs_s': [round(s, 3) for s in base_runs],
+        'traced_runs_s': [round(s, 3) for s in traced_runs],
+        'overhead_pct': round(overhead_pct, 2),
+        'spans_recorded': spans,
+        'max_overhead_pct': max_overhead_pct,
+        'pass': ok,
+    }))
+    return 0 if ok else 1
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--hosts', type=int, default=16,
@@ -117,12 +174,19 @@ def main() -> int:
                         help='fan-out width for the parallel run')
     parser.add_argument('--keep-trace', default=None,
                         help='copy the parallel run trace here')
+    parser.add_argument('--trace-overhead', action='store_true',
+                        help='measure span-recording cost: parallel '
+                             'launch with XSKY_TRACING=0 vs enabled; '
+                             'exit 1 if the traced launch costs >2%% '
+                             'extra wall-clock')
     args = parser.parse_args()
 
     scratch = tempfile.mkdtemp(prefix='xsky-bench-fanout-')
     _setup_env(scratch, args.latency)
     from skypilot_tpu import check as check_lib
     check_lib.set_enabled_clouds_for_test(['fake'])
+    if args.trace_overhead:
+        return _trace_overhead(args, scratch)
 
     seq_trace = os.path.join(scratch, 'trace_seq.json')
     par_trace = os.path.join(scratch, 'trace_par.json')
