@@ -171,6 +171,11 @@ def serve_main() -> None:
     11.42 req/s, 2147.98 output tok/s. The headline value and vs_baseline
     are per-chip so chip counts don't skew the comparison.
     """
+    # Telemetry BEFORE jax.devices(): a hung backend init then leaves a
+    # spool with phase=init + live heartbeat for the supervisor's
+    # failure diagnosis (dump in the failure JSON).
+    from skypilot_tpu.agent import telemetry
+    telemetry.emit(phase=telemetry.PHASE_INIT)
     import jax
 
     _apply_platform_override()
@@ -429,6 +434,10 @@ def autotune_main() -> None:
 
 
 def main() -> None:
+    # Telemetry before device init (see serve_main); the measurement
+    # loop then heartbeats per step through trainer.step.
+    from skypilot_tpu.agent import telemetry
+    telemetry.emit(phase=telemetry.PHASE_INIT)
     import jax
 
     _apply_platform_override()
@@ -527,9 +536,51 @@ def main() -> None:
     print(json.dumps(result))
 
 
+def _telemetry_tail(env: dict) -> Optional[dict]:
+    """Phase + last-progress snapshot from the child's telemetry spool
+    (skypilot_tpu/agent/telemetry.py writes rank-N.json samples) — the
+    diagnosis a bare backend_init timeout lacks: was the child still in
+    `init` (hung device bring-up) or mid-`step` (a wedged run)? Only
+    runs on failure paths, so the (stdlib-only) telemetry import cost
+    never touches a healthy bench."""
+    spool = env.get('XSKY_TELEMETRY_DIR')
+    if not spool:
+        return None
+    try:
+        from skypilot_tpu.agent import telemetry
+        samples = telemetry.read_spool(spool)
+    except Exception:  # pylint: disable=broad-except
+        return None
+    now = time.time()
+    return {
+        str(rank): {
+            'phase': s.get('phase'),
+            'step': s.get('step'),
+            'hb_age_s': round(now - (s.get('hb_ts') or 0), 1),
+            'progress_age_s': round(
+                now - (s.get('last_progress_ts') or 0), 1),
+        } for rank, s in sorted(samples.items())
+    } or None
+
+
+def _clear_telemetry_spool(env: dict) -> None:
+    """Drop the previous attempt's samples so a failure dump never
+    shows a stale attempt's phase as this attempt's."""
+    spool = env.get('XSKY_TELEMETRY_DIR')
+    if not spool or not os.path.isdir(spool):
+        return
+    for name in os.listdir(spool):
+        if name.startswith('rank-'):
+            try:
+                os.remove(os.path.join(spool, name))
+            except OSError:
+                pass
+
+
 def _attempt_child(argv, env, init_timeout: float, run_timeout: float,
                    attempt: int):
     """One watched child run. Returns (ok, failure_dict_or_None)."""
+    _clear_telemetry_spool(env)
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__)] + argv,
         stdout=subprocess.PIPE, stderr=None, text=True,
@@ -578,12 +629,16 @@ def _attempt_child(argv, env, init_timeout: float, run_timeout: float,
                 'error': f'attempt {attempt}: jax.devices() produced '
                          f'no sentinel within {init_timeout:.0f}s '
                          '(hung TPU backend init)',
-                'stage': 'backend_init'}
+                'stage': 'backend_init',
+                # Spool tail: live heartbeat + phase=init pins the hang
+                # to device bring-up, not a dead interpreter.
+                'telemetry': _telemetry_tail(env)}
         pump.join(timeout=10)
         return False, {
             'error': f'attempt {attempt}: child exited '
                      f'rc={proc.returncode} before device init',
-            'stage': 'backend_init'}
+            'stage': 'backend_init',
+            'telemetry': _telemetry_tail(env)}
     # The measurement window starts once devices are up — a
     # slow-but-successful init must not eat into it.
     remaining = run_timeout - (time.monotonic() - init_done)
@@ -594,7 +649,8 @@ def _attempt_child(argv, env, init_timeout: float, run_timeout: float,
         return False, {
             'error': f'attempt {attempt}: measurement exceeded '
                      f'{run_timeout:.0f}s after device init',
-            'stage': 'run'}
+            'stage': 'run',
+            'telemetry': _telemetry_tail(env)}
     pump.join(timeout=10)
     if proc.returncode == 0 and result_line:
         return True, {'result': result_line[-1]}
@@ -630,6 +686,24 @@ def _supervise(argv) -> int:
               if serve else 'llama_train_model_tflops_per_chip')
     failure = {'error': 'not attempted', 'stage': 'backend_init'}
     base_env = dict(os.environ, XSKY_BENCH_CHILD='1')
+    # Child-side telemetry spool (skypilot_tpu/agent/telemetry.py): the
+    # child emits phase=init before jax.devices() and per-step samples
+    # during measurement; on failure the supervisor dumps the spool
+    # tail into the failure JSON so hangs are diagnosable, not just
+    # counted. Created only when the caller didn't provide one, and
+    # removed at exit (_cleanup_spool) so repeated rounds don't
+    # accumulate temp dirs.
+    _own_spool = None
+    if 'XSKY_TELEMETRY_DIR' not in base_env:
+        import tempfile
+        _own_spool = tempfile.mkdtemp(prefix='xsky-bench-telemetry-')
+        base_env['XSKY_TELEMETRY_DIR'] = _own_spool
+    base_env.setdefault('XSKY_TELEMETRY_INTERVAL_S', '1')
+
+    def _cleanup_spool() -> None:
+        if _own_spool is not None:
+            import shutil
+            shutil.rmtree(_own_spool, ignore_errors=True)
     if serve:
         plans = [dict(base_env, XSKY_BENCH_SERVE_RUNG=str(i))
                  for i in range(_SERVE_LADDER_LEN)]
@@ -657,6 +731,7 @@ def _supervise(argv) -> int:
                         except json.JSONDecodeError:
                             pass
                 print(line, flush=True)
+                _cleanup_spool()
                 return 0
             rung = env.get('XSKY_BENCH_SERVE_RUNG')
             where = f' (rung {rung})' if rung is not None else ''
@@ -706,6 +781,7 @@ def _supervise(argv) -> int:
     if other_good is not None:
         out[f'{other}_last_good'] = _labeled(other_good)
     print(json.dumps(out), flush=True)
+    _cleanup_spool()
     return 1
 
 

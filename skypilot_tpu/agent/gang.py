@@ -172,14 +172,36 @@ def _kill_tree(p: subprocess.Popen, sig_kill: bool = False) -> None:
             pass
 
 
-def aggregate_logs(log_dir: str, num_hosts: int,
+def detect_num_hosts(log_dir: str) -> int:
+    """Host count from the per-host log files present (max rank + 1) —
+    lets log consumers (job_cli tail, sync-down regeneration) rebuild
+    gang.log without knowing the gang's original size."""
+    highest = -1
+    try:
+        for name in os.listdir(log_dir):
+            if name.startswith('host-') and name.endswith('.log'):
+                try:
+                    highest = max(highest, int(name[5:-4]))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return highest + 1
+
+
+def aggregate_logs(log_dir: str, num_hosts: Optional[int] = None,
                    max_bytes_per_host: int = 64 * 1024) -> str:
     """Bounded multiplex of per-host logs into one ``gang.log``.
 
     At v5p-512 scale (64 hosts) unbounded concatenation would produce
     gigabytes; each host contributes at most its log tail, prefixed
-    ``[host-N]`` per line.
+    ``[rank N]`` per line so interleaved pod output stays attributable
+    (the tag matches the rank vocabulary of `xsky top` and the trace
+    waterfall). ``num_hosts=None`` detects the gang size from the
+    host-N.log files present.
     """
+    if num_hosts is None:
+        num_hosts = detect_num_hosts(log_dir)
     out_path = os.path.join(log_dir, 'gang.log')
     with open(out_path, 'w', encoding='utf-8', errors='replace') as out:
         for rank in range(num_hosts):
@@ -191,11 +213,11 @@ def aggregate_logs(log_dir: str, num_hosts: int,
                 if size > max_bytes_per_host:
                     f.seek(size - max_bytes_per_host)
                     f.readline()  # drop the partial first line
-                    out.write(f'[host-{rank}] ... '
+                    out.write(f'[rank {rank}] ... '
                               f'({size - max_bytes_per_host} bytes '
                               'truncated)\n')
                 for line in f:
-                    out.write(f'[host-{rank}] '
+                    out.write(f'[rank {rank}] '
                               f'{line.decode(errors="replace")}')
     return out_path
 

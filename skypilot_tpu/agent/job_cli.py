@@ -83,8 +83,19 @@ def main(argv=None) -> int:
 
     if cmd == 'tail':
         job_id = int(argv[1])
-        log_path = os.path.join(job_lib.log_dir_for(job_id, root),
-                                'run.log')
+        log_dir = job_lib.log_dir_for(job_id, root)
+        if len(argv) > 2 and argv[2] == 'gang':
+            # Rank-attributed view: regenerate the [rank N]-tagged
+            # multiplex from the per-host logs (always fresh — the
+            # gang.log written at job end misses a still-running or
+            # killed-mid-run gang).
+            from skypilot_tpu.agent import gang
+            try:
+                log_path = gang.aggregate_logs(log_dir)
+            except OSError:
+                return 0
+        else:
+            log_path = os.path.join(log_dir, 'run.log')
         if os.path.exists(log_path):
             with open(log_path, encoding='utf-8', errors='replace') as f:
                 sys.stdout.write(f.read())

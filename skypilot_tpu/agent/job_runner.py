@@ -14,6 +14,7 @@ import sys
 
 from skypilot_tpu.agent import gang
 from skypilot_tpu.agent import job_lib
+from skypilot_tpu.agent import telemetry
 from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu.utils import command_runner as runner_lib
 
@@ -44,6 +45,18 @@ def _resolve_commands(spec, host_envs):
     cwd = spec.get('cwd')  # same dir for setup and run
     setup_cmd = spec.get('setup')
     run_cmd = spec.get('run')
+    if run_cmd:
+        # A restarted/reused host may still hold a previous
+        # incarnation's telemetry spool; a stale frozen sample would
+        # read as a dead rank and re-trigger stall recovery. Each rank
+        # clears its own spool file just before the workload starts
+        # (before any container wrap, so the rm lands on the same
+        # filesystem emit() writes to). The dir env value may start
+        # with '~' (SSH hosts) — tilde NEVER expands out of a variable
+        # expansion, so substitute $HOME explicitly (bash; every
+        # runner wraps commands in bash -c).
+        run_cmd = ('rm -f "${XSKY_TELEMETRY_DIR/#\\~/$HOME}/rank-'
+                   '${XSKY_HOST_RANK}.json" 2>/dev/null; ' + run_cmd)
     container = spec.get('docker_container')
     if container:
         from skypilot_tpu.utils import docker_utils
@@ -71,8 +84,17 @@ def run_job(job_id: int, root: str = None) -> int:
 
     try:
         host_envs = gang.build_host_envs(info, spec.get('envs') or {})
-        for env in host_envs:
+        for rank, env in enumerate(host_envs):
             env['XSKY_JOB_ID'] = str(job_id)
+            # Per-rank telemetry spool on the rank's OWN host: the
+            # workload's telemetry.emit() writes here and the control
+            # plane pulls the same path through this rank's runner
+            # (runner.remote_runtime_root() keeps the two in
+            # agreement). Task envs may override for tests.
+            env.setdefault(
+                telemetry.ENV_DIR,
+                telemetry.spool_dir(runners[rank].remote_runtime_root(),
+                                    job_id))
 
         setup_cmd, run_spec_cmd, cwd = _resolve_commands(spec, host_envs)
         if setup_cmd:

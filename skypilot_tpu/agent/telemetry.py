@@ -1,0 +1,576 @@
+"""Per-rank workload telemetry: heartbeat + runtime samples on a spool.
+
+The control plane goes blind the moment a gang job starts running: the
+launch path is traced (PR 4) but a rank wedged in the `jax.distributed`
+init barrier, a straggling host, or a silently-hung step loop all look
+identical — a timeout with zero diagnostics. This module is the
+agent-side half of the workload telemetry plane:
+
+  * the **workload process** on each gang rank calls :func:`emit` from
+    its hot paths (``train/trainer.py`` step loop, ``train/launch.py``
+    init barrier, ``infer/metrics.py`` request accounting). ``emit``
+    maintains one *sample* — phase (``init``/``step``/``idle``), step
+    index, step-time EMA, tokens/s, host memory, last-progress
+    timestamp — and writes it atomically to a host-local spool file
+    (``<runtime_root>/telemetry/job-<id>/rank-<N>.json``). Writes are
+    rate-limited; a background **heartbeat thread** re-touches
+    ``hb_ts`` every interval, so a rank blocked inside a collective
+    still proves its process is alive while its *progress* goes stale —
+    exactly the signal that separates a hung rank from a dead one;
+
+  * the **control plane** (gang backend wait loop, jobs controller)
+    pulls every rank's sample over the existing runner fan-out,
+    records them into the bounded ``workload_telemetry`` table
+    (``state.py``) via :func:`record_samples`, and reacts to the
+    :func:`verdict`:
+
+      - heartbeat stale           ⇒ ``dead``  (process gone or wedged solid)
+      - heartbeat fresh, progress stale ⇒ ``hung`` (the ``backend_init``
+        failure mode: alive but not advancing)
+      - otherwise                 ⇒ ``ok``
+
+Chaos: the ``telemetry.stall`` point fires inside :func:`emit` — a
+fired rule freezes the rank's progress (the heartbeat thread keeps
+beating), driving the hung-rank detection end-to-end without killing
+anything.
+
+Never-raise discipline throughout: telemetry instruments the very step
+loop whose throughput it measures — a full disk or a torn spool must
+cost the sample, never the step. With no ``XSKY_TELEMETRY_DIR`` in the
+environment (any process outside a gang job), :func:`emit` is a single
+dict lookup.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+ENV_DIR = 'XSKY_TELEMETRY_DIR'            # spool dir; unset ⇒ emit no-op
+ENV_ENABLED = 'XSKY_TELEMETRY'            # "0" disables emit entirely
+ENV_RANK = 'XSKY_HOST_RANK'               # set by the gang launcher
+ENV_INTERVAL = 'XSKY_TELEMETRY_INTERVAL_S'
+ENV_HB_STALE = 'XSKY_TELEMETRY_HB_STALE_S'
+ENV_PROGRESS_STALE = 'XSKY_TELEMETRY_PROGRESS_STALE_S'
+ENV_PULL_INTERVAL = 'XSKY_TELEMETRY_PULL_INTERVAL_S'
+
+PHASE_INIT = 'init'
+PHASE_STEP = 'step'
+PHASE_IDLE = 'idle'
+
+VERDICT_OK = 'ok'
+VERDICT_HUNG = 'hung'
+VERDICT_DEAD = 'dead'
+
+# Spool write + heartbeat cadence. The heartbeat thread re-touches the
+# sample at this interval, so staleness thresholds are multiples of it.
+_DEFAULT_INTERVAL_S = 2.0
+# Heartbeat older than this ⇒ the PROCESS stopped (dead rank). The
+# heartbeat rides a dedicated thread, so even a rank blocked in a
+# collective keeps renewing it.
+_DEFAULT_HB_STALE_S = 30.0
+# Progress older than this (with a live heartbeat) ⇒ hung rank. Default
+# is generous: XLA compiles and checkpoint saves legitimately stall the
+# step counter for minutes.
+_DEFAULT_PROGRESS_STALE_S = 300.0
+# Control-plane pull cadence (one runner fan-out per pull).
+_DEFAULT_PULL_INTERVAL_S = 10.0
+
+EMA_ALPHA = 0.2
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def interval_s() -> float:
+    return _env_float(ENV_INTERVAL, _DEFAULT_INTERVAL_S)
+
+
+def hb_stale_s() -> float:
+    return _env_float(ENV_HB_STALE, _DEFAULT_HB_STALE_S)
+
+
+def progress_stale_s() -> float:
+    return _env_float(ENV_PROGRESS_STALE, _DEFAULT_PROGRESS_STALE_S)
+
+
+def pull_interval_s() -> float:
+    return _env_float(ENV_PULL_INTERVAL, _DEFAULT_PULL_INTERVAL_S)
+
+
+def spool_dir(runtime_root: str, job_id: int) -> str:
+    """The job's spool dir under a host runtime root. Plain '/' joins:
+    the result may be a REMOTE path ('~/.xsky' on an SSH host)."""
+    return f'{runtime_root}/telemetry/job-{job_id}'
+
+
+def spool_path(runtime_root: str, job_id: int, rank: int) -> str:
+    return f'{spool_dir(runtime_root, job_id)}/rank-{rank}.json'
+
+
+def ema(prev: Optional[float], value: float,
+        alpha: float = EMA_ALPHA) -> float:
+    """Exponential moving average; first observation seeds it."""
+    if prev is None:
+        return float(value)
+    return alpha * float(value) + (1.0 - alpha) * float(prev)
+
+
+# ---- emitter (workload-process side) ---------------------------------------
+
+
+class _Emitter:
+    """One rank's in-memory sample + spool writer + heartbeat thread."""
+
+    def __init__(self, path: str, rank: int) -> None:
+        self.path = path
+        self.rank = rank
+        now = time.time()
+        self.sample: Dict[str, Any] = {
+            'rank': rank,
+            'pid': os.getpid(),
+            'phase': None,
+            'step': None,
+            'step_time_ema_s': None,
+            'tokens_per_sec': None,
+            'host_mem_mb': None,
+            'started_ts': now,
+            'last_progress_ts': now,
+            'hb_ts': now,
+            'ts': now,
+        }
+        self._lock = threading.Lock()
+        self._last_write = 0.0
+        self._tokens_acc = 0.0
+        self._tokens_at_write = 0.0
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+
+    def update(self, phase: Optional[str], step: Optional[int],
+               step_time_s: Optional[float],
+               tokens_per_sec: Optional[float],
+               tokens: Optional[float],
+               extra: Dict[str, Any]) -> None:
+        now = time.time()
+        with self._lock:
+            s = self.sample
+            phase_changed = phase is not None and phase != s['phase']
+            progressed = phase_changed
+            if phase_changed:
+                s['phase'] = phase
+            if step is not None and step != s['step']:
+                s['step'] = int(step)
+                progressed = True
+            if step_time_s is not None:
+                s['step_time_ema_s'] = ema(s['step_time_ema_s'],
+                                           step_time_s)
+            if tokens_per_sec is not None:
+                s['tokens_per_sec'] = ema(s['tokens_per_sec'],
+                                          tokens_per_sec)
+            if tokens is not None:
+                self._tokens_acc += float(tokens)
+            if extra:
+                s.update(extra)
+            if progressed:
+                s['last_progress_ts'] = now
+            s['hb_ts'] = now
+            # Spool writes are INTERVAL-driven, never step-driven: a
+            # fast step loop progresses every emit, and writing the
+            # file per step was measured at >8x loop cost. Only phase
+            # transitions (rare, diagnosis-critical: init→step) and
+            # the first emit force a write; in-memory progress lands
+            # with the next interval/heartbeat write, adding at most
+            # one interval of staleness — far under the stall
+            # thresholds.
+            due = (self._last_write == 0.0 or phase_changed or
+                   now - self._last_write >= interval_s())
+            if due:
+                self._write_locked(now)
+        self._ensure_heartbeat()
+
+    def _write_locked(self, now: float) -> None:
+        """Serialize + atomically replace the spool file (caller holds
+        the lock)."""
+        s = self.sample
+        # Token rate over the window since the previous write — which
+        # doesn't exist on the first write (_last_write still 0 would
+        # make the window span the epoch and seed the EMA at ~0); the
+        # first window's tokens stay accrued and count in the second.
+        window = now - self._last_write
+        if self._last_write > 0 and window > 0 and \
+                self._tokens_acc > self._tokens_at_write:
+            rate = (self._tokens_acc - self._tokens_at_write) / window
+            s['tokens_per_sec'] = ema(s['tokens_per_sec'], rate)
+            self._tokens_at_write = self._tokens_acc
+        try:
+            import resource
+            s['host_mem_mb'] = round(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+                / 1024.0, 1)
+        except Exception:  # pylint: disable=broad-except
+            pass
+        s['ts'] = now
+        self._last_write = now
+        tmp = f'{self.path}.tmp.{os.getpid()}'
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        with open(tmp, 'w', encoding='utf-8') as f:
+            f.write(json.dumps(s, default=str))
+        os.replace(tmp, self.path)
+
+    def _ensure_heartbeat(self) -> None:
+        if self._hb_thread is not None and self._hb_thread.is_alive():
+            return
+        self._hb_thread = threading.Thread(
+            target=self._hb_loop, daemon=True,
+            name=f'xsky-telemetry-hb-{self.rank}')
+        self._hb_thread.start()
+
+    def _hb_loop(self) -> None:
+        """Re-touch hb_ts every interval: liveness proof independent of
+        the (possibly blocked) workload thread. Dies with the process —
+        which is the point: a stale hb_ts means the process is gone.
+        The wait is floored at 50 ms so an interval of 0 (tests: write
+        every emit) never becomes a busy loop."""
+        while not self._stop.wait(max(interval_s(), 0.05)):
+            try:
+                with self._lock:
+                    self.sample['hb_ts'] = time.time()
+                    self._write_locked(time.time())
+            except Exception:  # pylint: disable=broad-except
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+_emitter_lock = threading.Lock()
+_emitter: Optional[_Emitter] = None
+# (ENV_DIR, ENV_RANK) raw values the cached emitter was built from:
+# emit() is on the step loop, so the steady-state resolve must be two
+# dict lookups and a tuple compare — no path building per call.
+_emitter_key = None
+
+
+def _current_emitter() -> Optional[_Emitter]:
+    """Resolve (spool dir, rank) from the environment; rebuild the
+    emitter when either changed (a fresh gang job in the same
+    process)."""
+    global _emitter, _emitter_key
+    if os.environ.get(ENV_ENABLED, '1') == '0':
+        return None
+    directory = os.environ.get(ENV_DIR)
+    if not directory:
+        return None
+    rank_raw = os.environ.get(ENV_RANK, '0')
+    key = (directory, rank_raw)
+    if key == _emitter_key and _emitter is not None:
+        return _emitter
+    try:
+        rank = int(rank_raw)
+    except ValueError:
+        rank = 0
+    path = os.path.join(os.path.expanduser(directory),
+                        f'rank-{rank}.json')
+    with _emitter_lock:
+        if _emitter is None or _emitter.path != path:
+            if _emitter is not None:
+                _emitter.stop()
+            _emitter = _Emitter(path, rank)
+        _emitter_key = key
+        return _emitter
+
+
+def emit(phase: Optional[str] = None, step: Optional[int] = None,
+         step_time_s: Optional[float] = None,
+         tokens_per_sec: Optional[float] = None,
+         tokens: Optional[float] = None, **extra: Any) -> None:
+    """Record one telemetry observation for this rank. NEVER raises,
+    and with no spool configured (``XSKY_TELEMETRY_DIR`` unset) returns
+    after one env lookup — the hook is safe on any hot path.
+
+    ``tokens`` is an incremental token count (serving); the emitter
+    converts it to a rate over the write window. ``tokens_per_sec`` is
+    a direct rate (training); both feed the sample's EMA.
+    """
+    try:
+        emitter = _current_emitter()
+        if emitter is None:
+            return
+        try:
+            from skypilot_tpu.utils import chaos
+            # A fired rule freezes this rank's PROGRESS (the heartbeat
+            # thread keeps beating): the hung-rank drill.
+            if chaos.inject('telemetry.stall',
+                            rank=emitter.rank) is not None:
+                return
+        except Exception:  # pylint: disable=broad-except
+            # Even a rule configured with `error` must only freeze the
+            # emit, never take down the step loop it instruments.
+            return
+        emitter.update(phase, step, step_time_s, tokens_per_sec, tokens,
+                       extra)
+    except Exception:  # pylint: disable=broad-except
+        pass
+
+
+# ---- spool reading + verdicts (control-plane side) -------------------------
+
+
+def parse_sample(text: str) -> Optional[Dict[str, Any]]:
+    """One spool line → sample dict, or None if torn/invalid."""
+    try:
+        sample = json.loads(text)
+    except ValueError:
+        return None
+    if not isinstance(sample, dict) or 'hb_ts' not in sample:
+        return None
+    return sample
+
+
+def read_spool(directory: str) -> Dict[int, Dict[str, Any]]:
+    """All rank samples in a LOCAL spool dir (bench.py, tests)."""
+    samples: Dict[int, Dict[str, Any]] = {}
+    try:
+        names = os.listdir(os.path.expanduser(directory))
+    except OSError:
+        return samples
+    for name in names:
+        if not (name.startswith('rank-') and name.endswith('.json')):
+            continue
+        try:
+            rank = int(name[len('rank-'):-len('.json')])
+            with open(os.path.join(os.path.expanduser(directory), name),
+                      encoding='utf-8') as f:
+                sample = parse_sample(f.read())
+        except (OSError, ValueError):
+            continue
+        if sample is not None:
+            samples[rank] = sample
+    return samples
+
+
+def verdict(sample: Optional[Dict[str, Any]],
+            now: Optional[float] = None,
+            hb_stale: Optional[float] = None,
+            progress_stale: Optional[float] = None) -> str:
+    """Stall classification for one rank's sample.
+
+    Heartbeat stale ⇒ ``dead`` (the emitting process stopped); live
+    heartbeat with stale progress ⇒ ``hung`` (alive but not advancing —
+    the ``backend_init`` barrier failure mode); else ``ok``.
+    """
+    now = now if now is not None else time.time()
+    hb_stale = hb_stale if hb_stale is not None else hb_stale_s()
+    progress_stale = (progress_stale if progress_stale is not None
+                      else progress_stale_s())
+    if sample is None:
+        return VERDICT_DEAD
+    hb = sample.get('hb_ts') or 0
+    if now - hb > hb_stale:
+        return VERDICT_DEAD
+    # Phase `idle` is declared no-work (a serving replica with no
+    # traffic, a finished run): absence of progress is the expected
+    # state, not a hang.
+    if sample.get('phase') == PHASE_IDLE:
+        return VERDICT_OK
+    # Progress staleness is measured against the rank's OWN heartbeat
+    # timestamp — both written by the same host clock, so cross-host
+    # clock skew (which the hb-vs-now dead check above tolerates only
+    # up to hb_stale) cannot fabricate or mask a hung verdict.
+    if hb - (sample.get('last_progress_ts') or 0) > progress_stale:
+        return VERDICT_HUNG
+    return VERDICT_OK
+
+
+def verdicts(samples: Dict[int, Dict[str, Any]],
+             now: Optional[float] = None,
+             hb_stale: Optional[float] = None,
+             progress_stale: Optional[float] = None) -> Dict[int, str]:
+    return {rank: verdict(s, now, hb_stale, progress_stale)
+            for rank, s in samples.items()}
+
+
+def stalled(samples: Dict[int, Dict[str, Any]],
+            now: Optional[float] = None) -> Dict[int, str]:
+    """Ranks whose verdict is not ``ok`` (hung or dead)."""
+    return {rank: v for rank, v in verdicts(samples, now).items()
+            if v != VERDICT_OK}
+
+
+def rank_skew(samples: Dict[int, Dict[str, Any]]) -> Optional[int]:
+    """max − min step index across ranks (straggler spread), or None
+    when no rank has reported a step yet."""
+    steps = [s['step'] for s in samples.values()
+             if s.get('step') is not None]
+    if not steps:
+        return None
+    return int(max(steps) - min(steps))
+
+
+def stragglers(samples: Dict[int, Dict[str, Any]],
+               factor: float = 1.5) -> set:
+    """Ranks whose step-time EMA exceeds ``factor``× the group median
+    (same threshold as the trace waterfall; needs ≥3 reporting ranks
+    for a meaningful median)."""
+    durs = {rank: s['step_time_ema_s'] for rank, s in samples.items()
+            if s.get('step_time_ema_s')}
+    if len(durs) < 3:
+        return set()
+    ordered = sorted(durs.values())
+    median = ordered[len(ordered) // 2]
+    if median <= 0:
+        return set()
+    return {rank for rank, d in durs.items() if d > factor * median}
+
+
+# ---- goodput ---------------------------------------------------------------
+
+
+def goodput(samples: Dict[int, Dict[str, Any]],
+            recovery_s: float = 0.0,
+            wall_s: Optional[float] = None,
+            now: Optional[float] = None) -> Dict[str, Any]:
+    """Productive step time over wall time (arxiv 2502.06982's fleet
+    metric, per job).
+
+    Productive time per rank = steps completed × step-time EMA; the
+    job's productive time is the mean across reporting ranks (gang
+    semantics: all ranks step together, the mean smooths clock skew).
+    ``wall_s`` defaults to now − the earliest rank start, which only
+    covers the CURRENT incarnation — callers pass lease-derived wall
+    (survives relaunches) and the journal's recovery time so lost time
+    counts against goodput.
+    """
+    now = now if now is not None else time.time()
+    productive = [s['step'] * s['step_time_ema_s']
+                  for s in samples.values()
+                  if s.get('step') is not None and
+                  s.get('step_time_ema_s')]
+    productive_s = (sum(productive) / len(productive)
+                    if productive else 0.0)
+    if wall_s is None:
+        starts = [s['started_ts'] for s in samples.values()
+                  if s.get('started_ts')]
+        wall_s = now - min(starts) if starts else None
+    wall_total = (wall_s or 0.0) + max(recovery_s, 0.0)
+    ratio = (min(1.0, productive_s / wall_total)
+             if wall_total > 0 else None)
+    return {
+        'goodput': ratio,
+        'productive_s': productive_s,
+        'wall_s': wall_total,
+        'recovery_s': recovery_s,
+    }
+
+
+def _job_scope_for_cluster(cluster: str) -> Optional[str]:
+    """Managed-job clusters are named ``xsky-jobs-<id>``; their journal
+    and lease scope is ``job/<id>``."""
+    prefix = 'xsky-jobs-'
+    if cluster.startswith(prefix) and cluster[len(prefix):].isdigit():
+        return f'job/{cluster[len(prefix):]}'
+    return None
+
+
+def goodput_for_cluster(cluster: str,
+                        samples: Dict[int, Dict[str, Any]],
+                        now: Optional[float] = None) -> Dict[str, Any]:
+    """:func:`goodput` with wall/recovery pulled from the control
+    plane's history: the liveness lease's ``started_at`` (PR 2 —
+    survives controller renewals, so wall spans relaunches) and the
+    recovery journal's measured recovery latencies (PR 1). Never
+    raises; falls back to sample-derived wall."""
+    now = now if now is not None else time.time()
+    recovery_s = 0.0
+    wall_s = None
+    scope = _job_scope_for_cluster(cluster)
+    if scope is not None:
+        try:
+            from skypilot_tpu import state
+            for event in state.get_recovery_events(scope=scope,
+                                                   limit=1000):
+                if event['event_type'] in ('job.recovered',
+                                           'job.restarted') and \
+                        event['latency_s']:
+                    recovery_s += event['latency_s']
+            lease = state.get_lease(scope)
+            if lease is not None and lease.get('started_at'):
+                wall_s = now - lease['started_at'] - recovery_s
+        except Exception:  # pylint: disable=broad-except
+            pass
+    return goodput(samples, recovery_s=recovery_s, wall_s=wall_s,
+                   now=now)
+
+
+# ---- control-plane recording ----------------------------------------------
+
+# (cluster, job_id, rank) → (verdict, step) at the previous pull:
+# transition tracking so stall counters count events, not polls.
+_last_seen: Dict[Any, Any] = {}
+
+
+def record_samples(cluster: str, job_id: Optional[int],
+                   samples: Dict[int, Dict[str, Any]],
+                   now: Optional[float] = None) -> Dict[int, str]:
+    """Persist pulled samples to the bounded ``workload_telemetry``
+    table and feed the metrics registry. Returns the per-rank verdicts
+    so callers (jobs controller) can react. NEVER raises."""
+    now = now if now is not None else time.time()
+    result = verdicts(samples, now)
+    try:
+        from skypilot_tpu import state
+        rows = []
+        for rank, s in sorted(samples.items()):
+            rows.append({
+                'rank': rank,
+                'phase': s.get('phase'),
+                'step': s.get('step'),
+                'step_time_ema_s': s.get('step_time_ema_s'),
+                'tokens_per_sec': s.get('tokens_per_sec'),
+                'host_mem_mb': s.get('host_mem_mb'),
+                'started_ts': s.get('started_ts'),
+                'last_progress_ts': s.get('last_progress_ts'),
+                'hb_ts': s.get('hb_ts'),
+                'verdict': result[rank],
+            })
+        state.record_workload_telemetry(cluster, job_id, rows, ts=now)
+    except Exception:  # pylint: disable=broad-except
+        pass
+    try:
+        from skypilot_tpu.utils import metrics
+        for rank, s in samples.items():
+            key = (cluster, job_id, rank)
+            prev = _last_seen.get(key)
+            if result[rank] != VERDICT_OK and \
+                    (prev is None or prev[0] == VERDICT_OK):
+                metrics.inc_counter(
+                    'xsky_workload_rank_stalls_total',
+                    'Workload ranks flagged hung/dead, by verdict.',
+                    1.0, verdict=result[rank])
+            if s.get('step_time_ema_s') and \
+                    (prev is None or s.get('step') != prev[1]):
+                metrics.observe(
+                    'xsky_workload_step_seconds',
+                    'Per-rank training/serving step time '
+                    '(EMA sampled at pull).',
+                    s['step_time_ema_s'])
+            _last_seen[key] = (result[rank], s.get('step'))
+    except Exception:  # pylint: disable=broad-except
+        pass
+    return result
+
+
+def reset_for_test() -> None:
+    global _emitter, _emitter_key
+    with _emitter_lock:
+        if _emitter is not None:
+            _emitter.stop()
+        _emitter = None
+        _emitter_key = None
+    _last_seen.clear()

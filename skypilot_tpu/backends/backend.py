@@ -67,5 +67,12 @@ class Backend(Generic[_HandleT]):
         raise NotImplementedError
 
     def tail_logs(self, handle: _HandleT, job_id: Optional[int],
-                  follow: bool = True) -> str:
+                  follow: bool = True, all_ranks: bool = False) -> str:
         raise NotImplementedError
+
+    def get_workload_telemetry(self, handle: _HandleT,
+                               job_id: int) -> dict:
+        """Per-rank workload telemetry samples ({rank: sample}), or
+        empty for backends without rank-level telemetry."""
+        del handle, job_id
+        return {}

@@ -203,11 +203,8 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
 
     def _host_runtime_root(self, handle: ClusterHandle,
                            runner: runner_lib.CommandRunner) -> str:
-        if handle.is_local_provider:
-            return os.path.join(runner.host_root, '.xsky')
-        if handle.provider_name in ('kubernetes', 'docker'):
-            return '/root/.xsky'  # pods/containers run as root
-        return '~/.xsky'
+        del handle  # the runner class encodes the provider layout
+        return runner.remote_runtime_root()
 
     def _head_python(self, handle: ClusterHandle) -> str:
         """Python invocation for agent/job commands on the head host.
@@ -604,6 +601,61 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             offset = rec['offset']
         return bytes(out)
 
+    # ---- workload telemetry ----
+
+    def get_workload_telemetry(self, handle: ClusterHandle,
+                               job_id: int
+                               ) -> Dict[int, Dict[str, Any]]:
+        """Pull every rank's telemetry spool sample in one host
+        fan-out: {rank: sample}. Ranks with no spool yet (job not
+        started, pre-telemetry workload) are simply absent; a partial
+        fan-out failure costs the missing ranks, not the pull.
+        """
+        from skypilot_tpu.agent import telemetry
+        runners = handle.get_command_runners()
+        samples: Dict[int, Dict[str, Any]] = {}
+
+        def _pull(pair):
+            rank, runner = pair
+            path = telemetry.spool_path(runner.remote_runtime_root(),
+                                        job_id, rank)
+            rc, out, _ = runner.run(f'cat {path} 2>/dev/null',
+                                    require_outputs=True)
+            if rc == 0 and out.strip():
+                sample = telemetry.parse_sample(
+                    out.strip().splitlines()[-1])
+                if sample is not None:
+                    samples[rank] = sample
+
+        try:
+            with tracing.span('backend.pull_telemetry',
+                              cluster=handle.cluster_name, job=job_id):
+                parallelism.run_in_parallel(
+                    _pull, list(enumerate(runners)),
+                    phase='pull_telemetry', what='telemetry pull')
+        except exceptions.MultiHostError:
+            pass
+        return samples
+
+    def _maybe_pull_telemetry(self, handle: ClusterHandle, job_id: int,
+                              pull_state: Dict[str, float]) -> None:
+        """Rate-limited telemetry pull + heartbeat-staleness recording
+        inside the wait loop (`pull_state['next']` carries the
+        schedule). Never raises — observability must not break the
+        wait."""
+        from skypilot_tpu.agent import telemetry
+        now = time.time()
+        if now < pull_state['next']:
+            return
+        pull_state['next'] = now + telemetry.pull_interval_s()
+        try:
+            samples = self.get_workload_telemetry(handle, job_id)
+            if samples:
+                telemetry.record_samples(handle.cluster_name, job_id,
+                                         samples)
+        except Exception:  # pylint: disable=broad-except
+            pass
+
     def _wait_job(self, handle: ClusterHandle, job_id: int,
                   timeout_s: float = 3600.0,
                   stream_logs: bool = True) -> job_lib.JobStatus:
@@ -620,6 +672,12 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
         record_gone = 0
         offset = 0
         interval = 0.3
+        # Workload telemetry rides the wait loop (rate-limited: one
+        # host fan-out per pull interval, first pull one interval in so
+        # short jobs never pay it) — `xsky top`/`xsky status` get live
+        # rank state for plain launches, not just managed jobs.
+        from skypilot_tpu.agent import telemetry
+        pull_state = {'next': time.time() + telemetry.pull_interval_s()}
         status: Optional[job_lib.JobStatus] = None
         # Incremental decoder: a multibyte character split across chunk
         # boundaries must not decode to replacement garbage.
@@ -691,6 +749,7 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                         'preempted).')
             else:
                 record_gone = 0
+            self._maybe_pull_telemetry(handle, job_id, pull_state)
             time.sleep(interval)
             interval = min(interval * 1.5, 3.0)
         raise TimeoutError(f'Job {job_id} did not finish in {timeout_s}s')
@@ -742,16 +801,21 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             logger.warning(f'Job cancel fan-out incomplete: {e}')
 
     def tail_logs(self, handle: ClusterHandle, job_id: Optional[int],
-                  follow: bool = True) -> str:
+                  follow: bool = True, all_ranks: bool = False) -> str:
+        """Job log text. Default: rank 0's run.log (the live-tail
+        view); ``all_ranks`` returns the ``[rank N]``-tagged multiplex
+        of every host's output, so interleaved pod logs stay
+        attributable."""
         if job_id is None:
             jobs = self.get_job_queue(handle)
             if not jobs:
                 return ''
             job_id = jobs[0]['job_id']
         head = handle.head_runner()
+        mode = ' gang' if all_ranks else ''
         rc, out, _ = head.run(
             f'{self._head_python(handle)} -m skypilot_tpu.agent.job_cli '
-            f'tail {job_id}',
+            f'tail {job_id}{mode}',
             env=self._agent_env(handle), require_outputs=True)
         return out
 
@@ -805,6 +869,18 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             parallelism.run_in_parallel(
                 _pull, job_dirs,
                 phase='sync_down_logs', what='log sync-down')
+        # A gang killed mid-run (preemption, stall recovery) never
+        # wrote its merged log; regenerate the [rank N]-tagged
+        # multiplex locally so synced-down pod logs stay attributable.
+        from skypilot_tpu.agent import gang as gang_lib
+        for job_dir in job_dirs:
+            local_job = os.path.join(local_dir, job_dir)
+            if os.path.isdir(local_job) and not os.path.exists(
+                    os.path.join(local_job, 'gang.log')):
+                try:
+                    gang_lib.aggregate_logs(local_job)
+                except OSError:
+                    pass
         return local_dir
 
     # ---- teardown / autostop ----

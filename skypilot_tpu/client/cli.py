@@ -199,18 +199,49 @@ def exec_cmd(cluster, entrypoint, envs, env_file, secrets, name,
     click.echo(f'Job {job_id} on cluster {cluster}: submitted.')
 
 
+def _age_str(seconds: Optional[float]) -> str:
+    """Compact age: 3s / 2m / 5h / 1d (heartbeat + top columns)."""
+    if seconds is None or seconds < 0:
+        return '-'
+    for unit, div in (('s', 1), ('m', 60), ('h', 3600), ('d', 86400)):
+        if seconds < 100 * div or unit == 'd':
+            return f'{seconds / div:.0f}{unit}'
+    return '-'
+
+
+def _cluster_heartbeats() -> dict:
+    """cluster → newest hb_ts across its ranks (from the local state
+    DB's workload-telemetry table; empty against a remote server)."""
+    out = {}
+    try:
+        from skypilot_tpu import state as state_lib
+        for row in state_lib.get_workload_telemetry():
+            prev = out.get(row['cluster'])
+            hb = row['hb_ts'] or 0
+            if prev is None or hb > prev:
+                out[row['cluster']] = hb
+    except Exception:  # pylint: disable=broad-except
+        pass
+    return out
+
+
 @cli.command()
 @click.argument('clusters', nargs=-1)
 @click.option('--refresh', '-r', is_flag=True, default=False)
 def status(clusters, refresh):
     """Show clusters."""
+    import time as time_lib
+
     from skypilot_tpu.client import sdk
     records = sdk.status(list(clusters) or None, refresh=refresh)
     if not records:
         click.echo('No existing clusters.')
         return
-    fmt = '{:<18} {:<40} {:<9} {:<10}'
-    click.echo(fmt.format('NAME', 'RESOURCES', 'STATUS', 'AUTOSTOP'))
+    heartbeats = _cluster_heartbeats()
+    now = time_lib.time()
+    fmt = '{:<18} {:<40} {:<9} {:<10} {:<9}'
+    click.echo(fmt.format('NAME', 'RESOURCES', 'STATUS', 'AUTOSTOP',
+                          'HEARTBEAT'))
     for r in records:
         # Records may be local (enums/handles) or jsonified (remote API).
         handle = r.get('handle')
@@ -224,8 +255,10 @@ def status(clusters, refresh):
         autostop_s = (f'{r["autostop"]}m' +
                       ('(down)' if r['to_down'] else '')
                       if r['autostop'] >= 0 else '-')
+        hb = heartbeats.get(r['name'])
+        hb_s = _age_str(now - hb) if hb else '-'
         click.echo(fmt.format(r['name'], resources[:40], status_v,
-                              autostop_s))
+                              autostop_s, hb_s))
 
 
 @cli.command()
@@ -598,6 +631,117 @@ def trace_cmd(target, as_json, limit):
                 ','.join(str(r) for r in lagging) or '-'))
 
 
+def _top_rows(cluster: Optional[str]) -> List[dict]:
+    """Latest per-rank telemetry rows annotated with ages + straggler
+    flags (shared by the table and --json renderers)."""
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu.agent import telemetry
+    rows = state_lib.get_workload_telemetry(cluster=cluster)
+    by_cluster: dict = {}
+    for row in rows:
+        by_cluster.setdefault((row['cluster'], row['job_id']),
+                              {})[row['rank']] = row
+    out = []
+    for (cl, job_id), ranks in sorted(by_cluster.items()):
+        lagging = telemetry.stragglers(ranks)
+        skew = telemetry.rank_skew(ranks)
+        goodput = telemetry.goodput_for_cluster(cl, ranks)
+        for rank, row in sorted(ranks.items()):
+            pulled = row['ts'] or 0
+            out.append(dict(
+                row,
+                # Ages at PULL time: the spool truth when last read
+                # (age_s says how stale the row itself is).
+                hb_age_s=round(pulled - (row['hb_ts'] or 0), 1),
+                progress_age_s=round(
+                    pulled - (row['last_progress_ts'] or 0), 1),
+                straggler=rank in lagging,
+                rank_skew=skew,
+                goodput=goodput.get('goodput')))
+    return out
+
+
+@cli.command(name='top')
+@click.argument('cluster', required=False)
+@click.option('--watch', '-w', is_flag=True, default=False,
+              help='Refresh continuously (Ctrl-C to stop).')
+@click.option('--interval', type=float, default=2.0,
+              help='Refresh interval with --watch (seconds).')
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='One JSON object per rank row (joinable with '
+                   '`xsky events --json` / `xsky trace --json`).')
+def top(cluster, watch, interval, as_json):
+    """Live per-rank workload view: phase, step, step time, tokens/s,
+    heartbeat age, and the stall verdict for every gang rank.
+
+    Rows come from the workload-telemetry table (agents spool samples
+    on each host; the gang backend and jobs controller pull them every
+    poll interval). A `hung` verdict means the rank heartbeats without
+    progressing (the backend_init failure mode); `dead` means the
+    heartbeat itself went stale. `~` marks stragglers (step-time >1.5x
+    the gang median).
+    """
+    import time as time_lib
+
+    def render_once():
+        rows = _top_rows(cluster)
+        if as_json:
+            for row in rows:
+                click.echo(json.dumps(row, default=str))
+            return
+        if not rows:
+            click.echo('No workload telemetry recorded'
+                       + (f' for {cluster!r}.' if cluster else '.'))
+            return
+        now = time_lib.time()
+        fmt = ('{:<20} {:>4} {:>5} {:<6} {:>8} {:>10} {:>9} {:>7} '
+               '{:>8} {:<7}')
+        click.echo(fmt.format('CLUSTER', 'JOB', 'RANK', 'PHASE',
+                              'STEP', 'STEP_TIME', 'TOK/S', 'MEM_MB',
+                              'HB_AGE', 'VERDICT'))
+        for row in rows:
+            step_time = (f'{row["step_time_ema_s"]:.3f}s'
+                         if row['step_time_ema_s'] else '-')
+            if row['straggler']:
+                step_time += '~'
+            tps = (f'{row["tokens_per_sec"]:,.0f}'
+                   if row['tokens_per_sec'] else '-')
+            mem = (f'{row["host_mem_mb"]:.0f}'
+                   if row['host_mem_mb'] else '-')
+            click.echo(fmt.format(
+                row['cluster'][:20], str(row['job_id'] or '-'),
+                row['rank'], (row['phase'] or '-')[:6],
+                str(row['step'] if row['step'] is not None else '-'),
+                step_time, tps, mem, _age_str(row['hb_age_s']),
+                row['verdict'] or '-'))
+        # Per-gang summary: skew + goodput + data freshness.
+        gangs = sorted({(r['cluster'], r['job_id']) for r in rows},
+                       key=str)
+        for key in gangs:
+            group = [r for r in rows
+                     if (r['cluster'], r['job_id']) == key]
+            first = group[0]
+            stalls = sum(1 for r in group if r['verdict'] != 'ok')
+            goodput = (f'{first["goodput"]:.1%}'
+                       if first.get('goodput') is not None else '-')
+            click.echo(
+                f'  {first["cluster"]} job {first["job_id"]}: '
+                f'{len(group)} rank(s), skew={first["rank_skew"]}, '
+                f'goodput={goodput}, stalled={stalls}, '
+                f'pulled {_age_str(now - (first["ts"] or 0))} ago')
+
+    if not watch:
+        render_once()
+        return
+    try:
+        while True:
+            click.clear()
+            render_once()
+            time_lib.sleep(max(interval, 0.2))
+    except KeyboardInterrupt:
+        pass
+
+
 @cli.command()
 @click.option('--fix', is_flag=True, default=False,
               help='Run the reconciler: repair every unhealthy scope '
@@ -774,14 +918,18 @@ def ssh_down(infra, yes):
 @click.option('--sync-down', is_flag=True, default=False,
               help='Download the job log directories instead of '
                    'printing (to ~/.xsky/sync_down_logs/<cluster>).')
-def logs(cluster, job_id, sync_down):
+@click.option('--all-ranks', is_flag=True, default=False,
+              help='Print every rank interleaved with [rank N] tags '
+                   '(default: rank 0 only).')
+def logs(cluster, job_id, sync_down, all_ranks):
     """Print (or download) a job's logs."""
     from skypilot_tpu.client import sdk
     if sync_down:
         path = sdk.sync_down_logs(cluster, job_id)
         click.echo(f'Logs synced to {path}')
         return
-    click.echo(sdk.tail_logs(cluster, job_id), nl=False)
+    click.echo(sdk.tail_logs(cluster, job_id, all_ranks=all_ranks),
+               nl=False)
 
 
 @cli.command()

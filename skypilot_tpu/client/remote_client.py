@@ -194,9 +194,11 @@ class RemoteClient:
                                      'job_ids': job_ids,
                                      'all_jobs': all_jobs})
 
-    def tail_logs(self, cluster_name, job_id=None, follow=False):
+    def tail_logs(self, cluster_name, job_id=None, follow=False,
+                  all_ranks=False):
         return self._call('logs', {'cluster_name': cluster_name,
-                                   'job_id': job_id})
+                                   'job_id': job_id,
+                                   'all_ranks': all_ranks})
 
     def check(self, quiet=False):
         return self._call('check', {})

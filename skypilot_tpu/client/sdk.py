@@ -137,9 +137,9 @@ def cancel(cluster_name: str, job_ids: Optional[List[int]] = None,
 
 
 def tail_logs(cluster_name: str, job_id: Optional[int] = None,
-              follow: bool = False) -> str:
+              follow: bool = False, all_ranks: bool = False) -> str:
     return _local_or_remote('tail_logs', cluster_name, job_id=job_id,
-                            follow=follow)
+                            follow=follow, all_ranks=all_ranks)
 
 
 def sync_down_logs(cluster_name: str, job_id: Optional[int] = None,

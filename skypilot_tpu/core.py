@@ -268,9 +268,10 @@ def cancel(cluster_name: str, job_ids: Optional[List[int]] = None,
 
 
 def tail_logs(cluster_name: str, job_id: Optional[int] = None,
-              follow: bool = False) -> str:
+              follow: bool = False, all_ranks: bool = False) -> str:
     record = _get_handle(cluster_name)
-    return _backend().tail_logs(record['handle'], job_id, follow=follow)
+    return _backend().tail_logs(record['handle'], job_id, follow=follow,
+                                all_ranks=all_ranks)
 
 
 def watch_job_log(cluster_name: str, job_id: int,

@@ -19,6 +19,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional, Tuple
 
+from skypilot_tpu.agent import telemetry
+
 _TTFT_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
                  float('inf'))
 _E2E_BUCKETS = (0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0,
@@ -77,6 +79,13 @@ class ServeMetrics:
                 self._ttft.observe(ttft_s)
             if e2e_s is not None:
                 self._e2e.observe(e2e_s)
+            n_requests = sum(self._requests.values())
+        # Workload-telemetry heartbeat: each finished request is
+        # progress; generated tokens feed the rank's tokens/s rate. A
+        # replica that keeps heartbeating without completing requests
+        # shows up hung in `xsky top`, same as a stalled train step.
+        telemetry.emit(phase=telemetry.PHASE_STEP, step=n_requests,
+                       tokens=generated_tokens)
 
     def observe_choice_tokens(self, request) -> None:
         """Token accounting for an n>1 sibling choice: its prompt AND

@@ -56,8 +56,16 @@ class ServingLoop:
     def submit(self, request: orch_lib.Request) -> orch_lib.Request:
         """Enqueue without blocking (streaming handlers poll the
         request's output_tokens/done themselves)."""
+        # Phase flips to `step` at request ARRIVAL, not completion: an
+        # engine that wedges on the very first request after an idle
+        # stretch must sit in phase=step (hung-detectable), not hide
+        # behind the idle exemption. Emitted INSIDE the lock, after
+        # the enqueue: the serving loop's idle emit shares the lock,
+        # so it can never land after this one and re-mask the phase.
+        from skypilot_tpu.agent import telemetry
         with self._lock:
             self.orch.submit(request)
+            telemetry.emit(phase=telemetry.PHASE_STEP)
         self._wake.set()
         return request
 
@@ -100,6 +108,17 @@ class ServingLoop:
                         logger.exception('serving loop step failed')
                         self.orch.fail_all(f'engine step failed: {e}')
                         busy = False
+                    if not busy:
+                        # Declared idle: no slots, no partials, empty
+                        # queue — checked and emitted under the SAME
+                        # lock submit() emits phase=step under, so an
+                        # arriving request's step emit can never be
+                        # overwritten by a racing idle emit. The stall
+                        # detector exempts phase=idle from the hung
+                        # verdict, so a traffic-less replica is never
+                        # mistaken for a wedged one.
+                        from skypilot_tpu.agent import telemetry
+                        telemetry.emit(phase=telemetry.PHASE_IDLE)
                 if not busy:
                     self._wake.clear()
                     break

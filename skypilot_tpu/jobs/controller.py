@@ -19,6 +19,7 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu import state as global_state
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.agent import job_lib as cluster_job_lib
+from skypilot_tpu.agent import telemetry
 from skypilot_tpu.jobs import recovery as recovery_lib
 from skypilot_tpu.jobs import scheduler
 from skypilot_tpu.jobs import state as jobs_state
@@ -55,6 +56,9 @@ class JobsController:
         # chaos kill rules key on it so a crash drill takes down one
         # generation, not every respawn after it.
         self.respawn_generation = record['controller_respawns'] or 0
+        # Workload-telemetry pull schedule (rate-limited: one host
+        # fan-out per pull interval inside the monitor loop).
+        self._telemetry_next = 0.0
 
     def _heartbeat(self) -> None:
         """Renew this job's liveness lease (reconciler crash-safety:
@@ -110,6 +114,68 @@ class JobsController:
                 give_up=lambda: not self._cluster_alive())
         except Exception:  # pylint: disable=broad-except
             return None
+
+    def _check_workload_telemetry(self, handle: Any,
+                                  cluster_job_id: int) -> Dict[int, str]:
+        """Pull per-rank heartbeat/runtime samples (rate-limited),
+        record them, and return the stalled ranks ({rank: verdict}).
+
+        A rank that heartbeats without progressing (``hung`` — the
+        backend_init barrier failure mode) or whose heartbeat went
+        stale while the job still reports RUNNING (``dead``) is a
+        recovery trigger: the cloud says the cluster is healthy, the
+        workload says otherwise. Never raises.
+        """
+        now = time.time()
+        if now < self._telemetry_next:
+            return {}
+        self._telemetry_next = now + telemetry.pull_interval_s()
+        try:
+            samples = self.strategy.backend.get_workload_telemetry(
+                handle, cluster_job_id)
+        except Exception:  # pylint: disable=broad-except
+            return {}
+        if not samples:
+            # Pre-telemetry workloads (no emit calls) stay invisible —
+            # absence of a spool is not evidence of a stall.
+            return {}
+        results = telemetry.record_samples(self.cluster_name,
+                                           cluster_job_id, samples)
+        return {rank: v for rank, v in results.items()
+                if v != telemetry.VERDICT_OK}
+
+    def _recover_from_stall(self, stalled: Dict[int, str]):
+        """Hung/dead ranks take the SAME recovery path as a preemption,
+        journalled and trace-linked (`jobs.stall_recover` span →
+        `jobs.recover` child)."""
+        cause = ', '.join(f'rank {r}: {v}'
+                          for r, v in sorted(stalled.items()))
+        logger.info(f'Workload stall on {self.cluster_name} ({cause}); '
+                    'recovering...')
+        stall_at = time.time()
+        with tracing.span('jobs.stall_recover', job=self.job_id,
+                          cluster=self.cluster_name,
+                          ranks=','.join(str(r) for r in
+                                         sorted(stalled))):
+            global_state.record_recovery_event(
+                'job.rank_stall', scope=f'job/{self.job_id}',
+                cause=cause,
+                detail={'cluster': self.cluster_name,
+                        'ranks': {str(r): v
+                                  for r, v in stalled.items()}})
+            jobs_state.set_status(
+                self.job_id, jobs_state.ManagedJobStatus.RECOVERING)
+            jobs_state.bump_recovery_count(self.job_id)
+            handle, cluster_job_id = self._recover()
+            if handle is not None:
+                global_state.record_recovery_event(
+                    'job.recovered', scope=f'job/{self.job_id}',
+                    cause='relaunched after rank stall',
+                    latency_s=time.time() - stall_at,
+                    detail={'cluster': self.cluster_name})
+                jobs_state.set_status(
+                    self.job_id, jobs_state.ManagedJobStatus.RUNNING)
+        return handle, cluster_job_id
 
     # ---- main loop ----
 
@@ -220,6 +286,17 @@ class JobsController:
                 return False
 
             if status is not None:
+                # Cluster job alive per the head's queue — but is the
+                # WORKLOAD advancing? Heartbeat staleness (not raw
+                # wall-clock guesses) decides: a hung-but-alive rank
+                # recovers like a preemption.
+                stalled = self._check_workload_telemetry(
+                    handle, cluster_job_id)
+                if stalled:
+                    handle, cluster_job_id = \
+                        self._recover_from_stall(stalled)
+                    if handle is None:
+                        return False
                 continue
 
             # Probe budget spent (or cluster gone from cloud): the

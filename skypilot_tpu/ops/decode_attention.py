@@ -43,7 +43,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_shard_map = jax.shard_map
+from skypilot_tpu.ops.jax_compat import shard_map as _shard_map
 
 from skypilot_tpu.ops.flash_attention import _env_block
 

@@ -1,0 +1,33 @@
+"""Version shims for jax APIs the kernels use.
+
+The image's jax has drifted across deployments (0.4.x containers vs
+0.6+ dev boxes); the two spellings that actually bite the ops modules:
+
+  * ``jax.shard_map`` (>=0.6) vs ``jax.experimental.shard_map`` (0.4/0.5);
+  * its ``check_vma=`` kwarg (>=0.6) vs ``check_rep=`` (0.4/0.5).
+
+One shim here so every kernel imports the same resolved callable — the
+old spelling silently disappearing at import time previously took 14
+test modules (and the driver's dryrun) dark with collection errors.
+"""
+from __future__ import annotations
+
+import jax
+
+try:
+    shard_map = jax.shard_map              # jax >= 0.6
+except AttributeError:                     # jax 0.4.x / 0.5.x
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+    def shard_map(*args, **kwargs):
+        if 'check_vma' in kwargs:
+            kwargs['check_rep'] = kwargs.pop('check_vma')
+        return _old_shard_map(*args, **kwargs)
+
+try:
+    axis_size = jax.lax.axis_size          # jax >= 0.6
+except AttributeError:                     # jax 0.4.x / 0.5.x
+
+    def axis_size(axis_name):
+        """Size of a mapped mesh axis, from inside shard_map."""
+        return jax.lax.psum(1, axis_name)

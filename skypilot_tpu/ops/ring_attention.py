@@ -29,6 +29,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from skypilot_tpu.ops import jax_compat
+from skypilot_tpu.ops.jax_compat import shard_map as _shard_map
+
 from skypilot_tpu.ops.attention import _repeat_kv
 
 _NEG_INF = -1e30  # finite: keeps online-softmax free of NaN on masked rows
@@ -102,7 +105,7 @@ def ring_attention_local(q: jax.Array,
     the causal FLOPs the previous revision spent exp()-ing fully
     masked logits.
     """
-    size = jax.lax.axis_size(axis_name)
+    size = jax_compat.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     groups = q.shape[2] // k.shape[2]
     k = _repeat_kv(k, groups)
@@ -165,7 +168,7 @@ def ulysses_attention_local(q: jax.Array,
     swaps back. Head counts must be divisible by the axis size; GQA K/V
     are repeated up to full heads first when they are not.
     """
-    size = jax.lax.axis_size(axis_name)
+    size = jax_compat.axis_size(axis_name)
     h, h_kv = q.shape[2], k.shape[2]
     if h % size:
         raise ValueError(f'n_heads ({h}) must be divisible by the sequence '
@@ -188,7 +191,7 @@ def ulysses_attention_local(q: jax.Array,
 
 def _sharded(fn, mesh: Mesh, seq_axis: str, causal: bool):
     qspec = P(('data', 'fsdp'), seq_axis, 'tensor', None)
-    return jax.shard_map(
+    return _shard_map(
         functools.partial(fn, axis_name=seq_axis, causal=causal),
         mesh=mesh,
         in_specs=(qspec, qspec, qspec),

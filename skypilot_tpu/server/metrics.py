@@ -19,9 +19,16 @@ plus everything the control plane records into the generic registry
   * xsky_fanout_ranks_total{phase} / xsky_fanout_stragglers_total{phase}
   * xsky_fanout_rank_duration_seconds{phase}    (histogram)
 
-and two gauges computed at scrape time from the state DB:
+plus the workload-telemetry series:
+  * xsky_workload_step_seconds                  (histogram, pull-fed)
+  * xsky_workload_rank_stalls_total{verdict}    (hung/dead transitions)
+
+and gauges computed at scrape time from the state DB:
   * xsky_lease_expires_in_seconds{scope}  (negative ⇒ expired holder)
   * xsky_leases_live
+  * xsky_workload_last_heartbeat_age_seconds{cluster,rank}
+  * xsky_goodput_ratio{cluster}  (productive step time / wall time,
+    recovery-journal + lease history aware)
 """
 from __future__ import annotations
 
@@ -133,12 +140,78 @@ def _render_lease_gauges() -> List[str]:
     return lines
 
 
+def _render_workload_gauges() -> List[str]:
+    """Workload-telemetry health computed at scrape time from the
+    newest per-rank samples: heartbeat age per rank (a climbing gauge
+    means the rank — or the puller — stopped) and per-cluster goodput
+    (productive step time over wall time, the arxiv 2502.06982 metric,
+    using the recovery journal + lease history for lost time). Never
+    raises; an unreadable state DB costs the gauges, not the scrape."""
+    lines: List[str] = []
+    try:
+        import time as time_lib
+
+        from skypilot_tpu import state
+        from skypilot_tpu.agent import telemetry
+        # Only LIVE clusters: torn-down workloads' rows linger in the
+        # telemetry table (pruned lazily by size, not liveness) and
+        # would otherwise export climbing heartbeat ages — and grow
+        # label cardinality — forever.
+        live = {r['name'] for r in state.get_clusters()}
+        rows = [r for r in state.get_workload_telemetry()
+                if r['cluster'] in live]
+        if not rows:
+            return []
+        now = time_lib.time()
+        lines.append('# HELP xsky_workload_last_heartbeat_age_seconds '
+                     'Seconds since the rank last heartbeat (sampled '
+                     'at the newest telemetry pull).')
+        lines.append('# TYPE xsky_workload_last_heartbeat_age_seconds '
+                     'gauge')
+        gangs: Dict[Tuple, Dict[int, Dict]] = {}
+        for row in rows:
+            # Keyed (and labeled) per cluster AND job: a cluster that
+            # ran several jobs has latest rows for each — collapsing
+            # to {cluster,rank} would emit duplicate series and poison
+            # the whole scrape.
+            gangs.setdefault((row['cluster'], row['job_id']),
+                             {})[row['rank']] = row
+            lines.append(
+                'xsky_workload_last_heartbeat_age_seconds{cluster="'
+                f'{_escape_label(row["cluster"])}",job='
+                f'"{row["job_id"]}",rank="{row["rank"]}"}} '
+                f'{now - (row["hb_ts"] or 0):.3f}')
+        # Goodput per cluster, from its NEWEST gang's samples.
+        newest: Dict[str, Tuple] = {}
+        for (cluster, job_id), ranks in gangs.items():
+            ts = max((r['ts'] or 0) for r in ranks.values())
+            if cluster not in newest or ts > newest[cluster][0]:
+                newest[cluster] = (ts, ranks)
+        goodput_lines = []
+        for cluster, (_, ranks) in sorted(newest.items()):
+            g = telemetry.goodput_for_cluster(cluster, ranks, now=now)
+            if g.get('goodput') is not None:
+                goodput_lines.append(
+                    'xsky_goodput_ratio{cluster="'
+                    f'{_escape_label(cluster)}"}} '
+                    f'{g["goodput"]:.4f}')
+        if goodput_lines:
+            lines.append('# HELP xsky_goodput_ratio Productive step '
+                         'time over wall time (recovery time counts '
+                         'against it).')
+            lines.append('# TYPE xsky_goodput_ratio gauge')
+            lines.extend(goodput_lines)
+    except Exception:  # pylint: disable=broad-except
+        return []
+    return lines
+
+
 def render() -> str:
     """Text exposition format (version 0.0.4): the server's own
     HTTP/verb series, then the generic control-plane registry, then
-    the scrape-time lease gauges."""
+    the scrape-time lease + workload gauges."""
     tail = registry.render_registry() + '\n'.join(
-        _render_lease_gauges())
+        _render_lease_gauges() + _render_workload_gauges())
     with _lock:
         lines = [
             '# HELP xsky_http_requests_total HTTP requests by route/code.',

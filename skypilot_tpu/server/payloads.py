@@ -112,7 +112,8 @@ _VERBS: Dict[str, Callable[[Dict[str, Any]],
     'endpoints': _core_verb('endpoints', 'cluster_name', port=None),
     'cancel': _core_verb('cancel', 'cluster_name', job_ids=None,
                          all_jobs=False),
-    'logs': _core_verb('tail_logs', 'cluster_name', job_id=None),
+    'logs': _core_verb('tail_logs', 'cluster_name', job_id=None,
+                       all_ranks=False),
     'check': _core_verb('check', quiet=True),
     'cost_report': _core_verb('cost_report'),
     'accelerators': _core_verb('list_accelerators', name_filter=None,

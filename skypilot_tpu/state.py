@@ -161,6 +161,24 @@ def _create_tables(conn: sqlite3.Connection) -> None:
         );
         CREATE INDEX IF NOT EXISTS idx_spans_trace
             ON spans (trace_id);
+        CREATE TABLE IF NOT EXISTS workload_telemetry (
+            row_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            ts REAL,
+            cluster TEXT,
+            job_id INTEGER,
+            rank INTEGER,
+            phase TEXT,
+            step INTEGER,
+            step_time_ema_s REAL,
+            tokens_per_sec REAL,
+            host_mem_mb REAL,
+            started_ts REAL,
+            last_progress_ts REAL,
+            hb_ts REAL,
+            verdict TEXT
+        );
+        CREATE INDEX IF NOT EXISTS idx_workload_telemetry_cluster
+            ON workload_telemetry (cluster);
     """)
     # Migration for pre-workspace DBs: clusters gain a workspace column.
     for migration in (
@@ -607,6 +625,113 @@ def get_spans(trace_id: str, limit: int = 5000) -> List[Dict[str, Any]]:
             'end_ts': end_ts,
             'status': status,
             'attrs': parsed,
+        })
+    return out
+
+
+# ---- workload telemetry ----------------------------------------------------
+# Per-rank runtime samples (phase/step/step-time EMA/heartbeat age/stall
+# verdict) pulled from the agent-side spools by the gang backend and the
+# jobs controller (skypilot_tpu/agent/telemetry.py). Bounded like the
+# journal and spans tables; `xsky top`, `xsky status` heartbeat ages and
+# the /metrics workload gauges all read from here.
+
+# Newest rows kept (pruned lazily). One pull writes one row per rank;
+# at the default 10 s pull cadence 20k rows keep hours of history for a
+# 64-rank pod.
+_MAX_WORKLOAD_TELEMETRY = 20000
+_workload_inserts = 0
+
+_WORKLOAD_COLS = ('ts, cluster, job_id, rank, phase, step, '
+                  'step_time_ema_s, tokens_per_sec, host_mem_mb, '
+                  'started_ts, last_progress_ts, hb_ts, verdict')
+
+
+def record_workload_telemetry(cluster: str, job_id: Optional[int],
+                              rows: List[Dict[str, Any]],
+                              ts: Optional[float] = None) -> None:
+    """Persist one pull's per-rank samples in ONE transaction. NEVER
+    raises — telemetry recording rides the jobs controller's monitor
+    loop and the backend's wait loop (same contract and batched-write
+    pattern as record_spans)."""
+    global _workload_inserts
+    if not rows:
+        return
+    ts = ts if ts is not None else time.time()
+    try:
+        conn = _get_conn()
+    except Exception:  # pylint: disable=broad-except
+        return
+    try:
+        with _lock:
+            conn.executemany(
+                f'INSERT INTO workload_telemetry ({_WORKLOAD_COLS}) '
+                'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)',
+                [(ts, cluster, job_id, r.get('rank'), r.get('phase'),
+                  r.get('step'), r.get('step_time_ema_s'),
+                  r.get('tokens_per_sec'), r.get('host_mem_mb'),
+                  r.get('started_ts'), r.get('last_progress_ts'),
+                  r.get('hb_ts'), r.get('verdict'))
+                 for r in rows])
+            # Prune on the FIRST batch too (short-lived CLI writers
+            # never reach an amortized gate — same rationale as spans).
+            _workload_inserts += len(rows)
+            if _workload_inserts == len(rows) or \
+                    _workload_inserts % 256 < len(rows):
+                conn.execute(
+                    'DELETE FROM workload_telemetry WHERE row_id <= '
+                    '(SELECT MAX(row_id) FROM workload_telemetry) - ?',
+                    (_MAX_WORKLOAD_TELEMETRY,))
+            conn.commit()
+    except Exception:  # pylint: disable=broad-except
+        try:
+            conn.rollback()
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+def get_workload_telemetry(cluster: Optional[str] = None,
+                           latest_only: bool = True,
+                           limit: int = 2000) -> List[Dict[str, Any]]:
+    """Telemetry rows, newest-pull-first per rank.
+
+    ``latest_only`` returns ONE row per (cluster, job, rank) — the live
+    view `xsky top` renders; ``latest_only=False`` is the history (a
+    rank's verdict timeline across a recovery)."""
+    conn = _get_conn()
+    conds, args = [], []
+    if cluster is not None:
+        conds.append('cluster = ?')
+        args.append(cluster)
+    query = f'SELECT {_WORKLOAD_COLS} FROM workload_telemetry'
+    if latest_only:
+        query += (' WHERE row_id IN (SELECT MAX(row_id) FROM '
+                  'workload_telemetry GROUP BY cluster, job_id, rank)')
+        if conds:
+            query += ' AND ' + ' AND '.join(conds)
+    elif conds:
+        query += ' WHERE ' + ' AND '.join(conds)
+    query += ' ORDER BY cluster, job_id, rank, row_id DESC LIMIT ?'
+    args.append(int(limit))
+    with _lock:
+        rows = conn.execute(query, args).fetchall()
+    out = []
+    for (ts, cl, job_id, rank, phase, step, step_ema, tps, mem,
+         started_ts, progress_ts, hb_ts, verdict) in rows:
+        out.append({
+            'ts': ts,
+            'cluster': cl,
+            'job_id': job_id,
+            'rank': rank,
+            'phase': phase,
+            'step': step,
+            'step_time_ema_s': step_ema,
+            'tokens_per_sec': tps,
+            'host_mem_mb': mem,
+            'started_ts': started_ts,
+            'last_progress_ts': progress_ts,
+            'hb_ts': hb_ts,
+            'verdict': verdict,
         })
     return out
 

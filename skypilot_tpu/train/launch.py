@@ -103,6 +103,11 @@ def main() -> int:
                         help='comma-separated weight names to adapt')
     args = parser.parse_args()
 
+    from skypilot_tpu.agent import telemetry
+    # Phase `init` BEFORE the distributed barrier: a rank wedged in
+    # jax.distributed bring-up then shows a live heartbeat with stale
+    # progress — the hung-rank signature `xsky top` flags.
+    telemetry.emit(phase=telemetry.PHASE_INIT)
     distributed.initialize()
     import jax  # after distributed init
     import os
@@ -139,6 +144,9 @@ def main() -> int:
     )
     mesh = mesh_lib.build_mesh(
         plan.resolve(jax.device_count()), num_slices=args.num_slices)
+    # Progress tick: the distributed barrier and mesh bring-up are done
+    # (separates an init hang from a slow first-step compile).
+    telemetry.emit(phase=telemetry.PHASE_INIT, step=0)
     trainer = trainer_lib.Trainer(config, mesh=mesh)
 
     manager = None
@@ -315,6 +323,7 @@ def main() -> int:
         manager.save(args.steps, args=ocp.args.StandardSave(state))
         manager.wait_until_finished()
     total = time.perf_counter() - t0
+    telemetry.emit(phase=telemetry.PHASE_IDLE)
     logger.info(f'Done: {args.steps - start_step} steps in {total:.1f}s.')
     return 0
 

@@ -4,7 +4,9 @@ The fake cloud's provision-time ``FailureInjector`` scripts *provisioning*
 failures; this module covers everything after bring-up — SSH transport,
 gang fan-out, the control plane's parallel host fan-out
 (``fanout.worker``, with ``phase``/``rank`` context), status probes,
-serve readiness probes — so the recovery
+serve readiness probes, workload telemetry (``telemetry.stall``
+freezes a rank's emit without killing the process — the hung-rank
+drill) — so the recovery
 machinery (jobs controller, gang retry, serve replica recovery, failover
 engine) can be driven under fault deterministically.
 

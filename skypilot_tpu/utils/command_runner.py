@@ -123,6 +123,15 @@ class CommandRunner:
             return proc.returncode, proc.stdout, proc.stderr
         return proc.returncode
 
+    def remote_runtime_root(self) -> str:
+        """The xsky runtime root on THIS runner's host, as a path the
+        host itself resolves ('~' for SSH homes is fine — both the
+        remote shell and python's expanduser resolve it there). Shared
+        by the wheel bootstrap, the telemetry spool (writer via env,
+        puller via `cat`), and agent paths, so writer and reader can
+        never disagree on the location."""
+        return '~/.xsky'
+
     def run(self,
             cmd: Union[str, List[str]],
             *,
@@ -160,6 +169,11 @@ class LocalProcessCommandRunner(CommandRunner):
         self.host_root = host_root or tempfile.mkdtemp(
             prefix=f'xsky-host-{node_id}-')
         os.makedirs(self.host_root, exist_ok=True)
+
+    def remote_runtime_root(self) -> str:
+        # Local "hosts" simulate their filesystem under host_root; '~'
+        # would collapse every fake host onto the real home dir.
+        return os.path.join(self.host_root, '.xsky')
 
     def _wrap(self, cmd: Union[str, List[str]],
               env: Optional[Dict[str, str]], cwd: Optional[str]) -> str:
@@ -292,6 +306,9 @@ class KubernetesCommandRunner(CommandRunner):
         self.context = context
         self.container = container
 
+    def remote_runtime_root(self) -> str:
+        return '/root/.xsky'  # pods run as root
+
     def kubectl_base(self) -> List[str]:
         """Public kubectl argv prefix (context/namespace)."""
         return self._kubectl_base()
@@ -364,6 +381,9 @@ class DockerCommandRunner(CommandRunner):
     def __init__(self, container: str) -> None:
         super().__init__(container)
         self.container = container
+
+    def remote_runtime_root(self) -> str:
+        return '/root/.xsky'  # containers run as root
 
     def _exec_base(self) -> List[str]:
         return ['docker', 'exec', '-i', self.container]

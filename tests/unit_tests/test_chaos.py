@@ -4,6 +4,7 @@ tier-1 preemption-storm smoke (docs/robustness.md's worked example)."""
 import ast
 import json
 import os
+import re
 import time
 
 import pytest
@@ -507,6 +508,176 @@ class TestLeaseHeartbeatLint:
             '        self._heartbeat()\n'
             '        self.tick()\n')
         assert self._loops_missing_heartbeat(clean, 'run') == []
+
+
+class TestTelemetryStalenessLint:
+    """Every loop that polls rank/job state must consult workload
+    telemetry (heartbeat staleness) — a poll loop that only watches
+    the job status can't tell a hung rank from a slow one and degrades
+    to raw time-based hang guesses. The listed functions are the
+    rank-state poll loops; each loop must contain a call whose name
+    mentions ``telemetry``."""
+
+    REQUIRED = [
+        # jobs controller monitor loop: stall verdicts feed recovery.
+        ('skypilot_tpu/jobs/controller.py', '_run_task'),
+        # backend launch-wait loop: records samples for `xsky top`.
+        ('skypilot_tpu/backends/tpu_gang_backend.py', '_wait_job'),
+    ]
+
+    @staticmethod
+    def _loops_missing_telemetry(tree, func_name):
+        """Line numbers of OUTERMOST while/for loops inside `func_name`
+        whose body never calls a *telemetry* helper; None when the
+        function has no loop at all (stale lint list)."""
+
+        def consults_telemetry(node):
+            for child in ast.walk(node):
+                if not isinstance(child, ast.Call):
+                    continue
+                func = child.func
+                name = func.attr if isinstance(func, ast.Attribute) \
+                    else getattr(func, 'id', '')
+                if 'telemetry' in (name or ''):
+                    return True
+            return False
+
+        def outer_loops(node):
+            loops = []
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.While, ast.For)):
+                    loops.append(child)
+                else:
+                    loops.extend(outer_loops(child))
+            return loops
+
+        found_func = False
+        saw_loop = False
+        offenders = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) and \
+                    node.name == func_name:
+                found_func = True
+                for loop in outer_loops(node):
+                    saw_loop = True
+                    if not consults_telemetry(loop):
+                        offenders.append(loop.lineno)
+        assert found_func, f'lint list is stale: no function {func_name}'
+        return None if not saw_loop else offenders
+
+    def test_rank_state_poll_loops_consult_telemetry(self):
+        repo_root = os.path.join(os.path.dirname(__file__), '..', '..')
+        violations = []
+        for rel, func in self.REQUIRED:
+            path = os.path.join(repo_root, rel)
+            with open(path, encoding='utf-8') as f:
+                tree = ast.parse(f.read(), filename=rel)
+            missing = self._loops_missing_telemetry(tree, func)
+            if missing is None:
+                violations.append(f'{rel}:{func} has no loop (stale '
+                                  'lint list?)')
+            else:
+                violations.extend(f'{rel}:{line} (in {func})'
+                                  for line in missing)
+        assert not violations, (
+            'rank-state poll loop never consults workload telemetry — '
+            'heartbeat staleness, not raw time-based guesses, decides '
+            'whether a rank hung:\n  ' + '\n  '.join(violations))
+
+    def test_lint_catches_a_telemetry_blind_loop(self):
+        blind = ast.parse(
+            'def _run_task(self):\n'
+            '    while True:\n'
+            '        self._job_status()\n')
+        assert self._loops_missing_telemetry(blind, '_run_task') == [2]
+        clean = ast.parse(
+            'def _run_task(self):\n'
+            '    while True:\n'
+            '        self._check_workload_telemetry()\n')
+        assert self._loops_missing_telemetry(clean, '_run_task') == []
+
+
+class TestTelemetryRetentionLint:
+    """Every observability table in state.py must declare a retention
+    bound: these tables take one row per poll/span/event forever, and
+    an unbounded one turns the shared state DB into the outage. A
+    bounded table needs (a) a module-level ``_MAX_*`` constant and (b)
+    a ``DELETE FROM <table>`` prune referencing it."""
+
+    # table → its retention constant. A NEW observability table must be
+    # added here (and the lint below fails if it is created without a
+    # bound).
+    BOUNDED = {
+        'recovery_events': '_MAX_RECOVERY_EVENTS',
+        'spans': '_MAX_SPANS',
+        'workload_telemetry': '_MAX_WORKLOAD_TELEMETRY',
+    }
+    # CREATE TABLE names matching this are observability tables.
+    OBSERVABILITY_RE = re.compile(r'events|spans|telemetry')
+    CREATE_RE = re.compile(r'CREATE TABLE IF NOT EXISTS (\w+)')
+
+    @classmethod
+    def _check_source(cls, source):
+        """Violation strings for a state.py-shaped module source."""
+        violations = []
+        tables = set(cls.CREATE_RE.findall(source))
+        for table in sorted(tables):
+            if not cls.OBSERVABILITY_RE.search(table):
+                continue
+            if table not in cls.BOUNDED:
+                violations.append(
+                    f'table {table} looks like an observability table '
+                    'but declares no retention bound (add it to '
+                    'BOUNDED + a _MAX_* prune)')
+                continue
+            if f'DELETE FROM {table}' not in source:
+                violations.append(
+                    f'table {table} has no DELETE FROM prune')
+        tree = ast.parse(source)
+        constants = {
+            t.id: node.value.value
+            for node in tree.body if isinstance(node, ast.Assign)
+            for t in node.targets if isinstance(t, ast.Name)
+            and isinstance(node.value, ast.Constant)
+        }
+        for table, const in cls.BOUNDED.items():
+            if table not in tables:
+                continue
+            value = constants.get(const)
+            if not isinstance(value, int) or value <= 0:
+                violations.append(
+                    f'{const} (retention bound for {table}) is not a '
+                    'positive module-level int constant')
+        return violations
+
+    def test_state_observability_tables_are_bounded(self):
+        repo_root = os.path.join(os.path.dirname(__file__), '..', '..')
+        path = os.path.join(repo_root, 'skypilot_tpu', 'state.py')
+        with open(path, encoding='utf-8') as f:
+            source = f.read()
+        violations = self._check_source(source)
+        assert not violations, (
+            'unbounded observability table in state.py:\n  ' +
+            '\n  '.join(violations))
+
+    def test_lint_catches_an_unbounded_table(self):
+        unbounded = (
+            'CREATE = """CREATE TABLE IF NOT EXISTS foo_telemetry '
+            '(x INT);"""\n')
+        assert any('foo_telemetry' in v
+                   for v in self._check_source(unbounded))
+        bounded = (
+            '_MAX_SPANS = 100\n'
+            'CREATE = """CREATE TABLE IF NOT EXISTS spans (x INT);"""\n'
+            'PRUNE = "DELETE FROM spans WHERE 1"\n')
+        assert self._check_source(bounded) == []
+        bad_const = (
+            '_MAX_SPANS = None\n'
+            'CREATE = """CREATE TABLE IF NOT EXISTS spans (x INT);"""\n'
+            'PRUNE = "DELETE FROM spans WHERE 1"\n')
+        assert any('_MAX_SPANS' in v
+                   for v in self._check_source(bad_const))
 
 
 class TestSpanCoverageLint:

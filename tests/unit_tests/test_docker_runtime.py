@@ -66,7 +66,15 @@ class TestJobRunnerContainerSpec:
 
     def test_host_execution_unchanged_without_container(self):
         spec = {'setup': 's', 'run': 'r', 'cwd': 'w'}
-        assert job_runner._resolve_commands(spec, [{}]) == ('s', 'r', 'w')
+        setup_cmd, run_cmd, cwd = job_runner._resolve_commands(
+            spec, [{}])
+        # No docker wrap: setup/cwd pass through; the run command only
+        # gains the telemetry-spool clear (stale-sample guard on
+        # reused hosts) ahead of the user's command.
+        assert (setup_cmd, cwd) == ('s', 'w')
+        assert run_cmd.endswith('; r')
+        assert 'rm -f "${XSKY_TELEMETRY_DIR' in run_cmd
+        assert 'docker' not in run_cmd
 
 
 class TestCloudImageGuards:

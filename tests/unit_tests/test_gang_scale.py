@@ -125,7 +125,7 @@ class TestGangScale:
         content = gang_log.read_text()
         assert 'truncated' in content
         for i in range(n):
-            assert f'[host-{i}] done-{i}' in content
+            assert f'[rank {i}] done-{i}' in content
         # Bounded: total ≤ n * cap + slack.
         assert gang_log.stat().st_size < n * 64 * 1024 + 16 * 1024
 

@@ -1,0 +1,597 @@
+"""Workload-telemetry tests: spool round-trip + heartbeat thread, EMA
+math, stall/dead verdicts, bounded-table retention, goodput against a
+synthetic recovery journal, the `telemetry.stall` chaos point, the
+`xsky top` / `xsky status` / `/metrics` surfaces, and the tier-1
+fake-cloud smoke where a chaos-stalled rank is detected and triggers a
+journalled, trace-linked recovery."""
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from skypilot_tpu.agent import telemetry
+from skypilot_tpu.utils import chaos
+from skypilot_tpu.utils import metrics as metrics_lib
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), '..', '..'))
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry(monkeypatch):
+    monkeypatch.delenv(telemetry.ENV_DIR, raising=False)
+    telemetry.reset_for_test()
+    chaos.clear()
+    yield
+    telemetry.reset_for_test()
+    chaos.clear()
+
+
+@pytest.fixture
+def spool(monkeypatch, tmp_path):
+    d = tmp_path / 'spool'
+    monkeypatch.setenv(telemetry.ENV_DIR, str(d))
+    monkeypatch.setenv(telemetry.ENV_RANK, '0')
+    # Interval 0: every emit writes, so reads see the sample
+    # immediately (production default is 2 s, interval-driven — the
+    # <2% gate in tools/bench_telemetry.py depends on that).
+    monkeypatch.setenv(telemetry.ENV_INTERVAL, '0')
+    return d
+
+
+@pytest.fixture
+def tmp_state(monkeypatch, tmp_path):
+    from skypilot_tpu import state
+    monkeypatch.setenv('XSKY_STATE_DB', str(tmp_path / 'state.db'))
+    state.reset_for_test()
+    yield state
+    state.reset_for_test()
+
+
+class TestSpool:
+
+    def test_emit_round_trip(self, spool):
+        telemetry.emit(phase=telemetry.PHASE_INIT)
+        telemetry.emit(phase=telemetry.PHASE_STEP, step=1,
+                       step_time_s=0.1, tokens_per_sec=100.0)
+        samples = telemetry.read_spool(str(spool))
+        assert set(samples) == {0}
+        s = samples[0]
+        assert s['phase'] == 'step'
+        assert s['step'] == 1
+        assert s['pid'] == os.getpid()
+        assert s['step_time_ema_s'] == pytest.approx(0.1)
+        assert s['tokens_per_sec'] == pytest.approx(100.0)
+        assert s['hb_ts'] >= s['started_ts']
+        assert s['last_progress_ts'] > 0
+
+    def test_emit_without_spool_dir_is_noop(self, tmp_path):
+        assert telemetry.ENV_DIR not in os.environ
+        telemetry.emit(phase='step', step=1)
+        assert telemetry.read_spool(str(tmp_path)) == {}
+
+    def test_emit_never_raises(self, monkeypatch, tmp_path):
+        # Spool dir path collides with an existing FILE: every write
+        # fails — emit must swallow it (it sits on the step loop).
+        blocker = tmp_path / 'blocker'
+        blocker.write_text('x')
+        monkeypatch.setenv(telemetry.ENV_DIR, str(blocker / 'sub'))
+        telemetry.emit(phase='step', step=1)   # must not raise
+
+    def test_ema_step_time(self, spool):
+        telemetry.emit(step=1, step_time_s=1.0)
+        telemetry.emit(step=2, step_time_s=2.0)
+        s = telemetry.read_spool(str(spool))[0]
+        expected = telemetry.ema(1.0, 2.0)
+        assert s['step_time_ema_s'] == pytest.approx(expected)
+
+    def test_heartbeat_thread_beats_without_progress(
+            self, spool, monkeypatch):
+        monkeypatch.setenv(telemetry.ENV_INTERVAL, '0.05')
+        telemetry.emit(phase='step', step=5)
+        first = telemetry.read_spool(str(spool))[0]
+        time.sleep(0.3)
+        later = telemetry.read_spool(str(spool))[0]
+        # The heartbeat advanced on its own thread...
+        assert later['hb_ts'] > first['hb_ts']
+        # ...while progress stayed frozen (no new emit).
+        assert later['step'] == 5
+        assert later['last_progress_ts'] == \
+            pytest.approx(first['last_progress_ts'])
+
+    def test_chaos_stall_freezes_progress_not_heartbeat(
+            self, spool, monkeypatch):
+        monkeypatch.setenv(telemetry.ENV_INTERVAL, '0.05')
+        chaos.load_plan({'points': {
+            'telemetry.stall': {'match': {'rank': 0},
+                                'skip_first': 1}}})
+        telemetry.emit(phase='step', step=1)
+        telemetry.emit(phase='step', step=2)   # frozen by chaos
+        s = telemetry.read_spool(str(spool))[0]
+        assert s['step'] == 1
+        assert chaos.hits('telemetry.stall') == 2
+        time.sleep(0.15)
+        later = telemetry.read_spool(str(spool))[0]
+        assert later['step'] == 1               # still frozen
+        assert later['hb_ts'] > s['hb_ts']      # still alive
+
+    def test_tokens_increments_become_a_rate(self, spool, monkeypatch):
+        monkeypatch.setenv(telemetry.ENV_INTERVAL, '0.05')
+        telemetry.emit(phase='step', step=1, tokens=50)
+        time.sleep(0.1)
+        telemetry.emit(phase='step', step=2, tokens=50)
+        s = telemetry.read_spool(str(spool))[0]
+        assert s['tokens_per_sec'] is not None
+        assert s['tokens_per_sec'] > 0
+
+
+class TestVerdicts:
+
+    def _sample(self, now, hb_age=0.0, progress_age=0.0, phase='step'):
+        return {'hb_ts': now - hb_age,
+                'last_progress_ts': now - progress_age,
+                'started_ts': now - 100,
+                'phase': phase}
+
+    def test_ema_seed_and_decay(self):
+        assert telemetry.ema(None, 3.0) == 3.0
+        assert telemetry.ema(1.0, 2.0) == pytest.approx(
+            telemetry.EMA_ALPHA * 2.0 + (1 - telemetry.EMA_ALPHA) * 1.0)
+
+    def test_ok_hung_dead(self):
+        now = time.time()
+        ok = self._sample(now)
+        hung = self._sample(now, hb_age=1.0, progress_age=500.0)
+        dead = self._sample(now, hb_age=500.0)
+        assert telemetry.verdict(ok, now) == 'ok'
+        assert telemetry.verdict(hung, now) == 'hung'
+        assert telemetry.verdict(dead, now) == 'dead'
+        assert telemetry.verdict(None, now) == 'dead'
+        # dead outranks hung: a stale heartbeat implies stale progress.
+        both = self._sample(now, hb_age=500.0, progress_age=500.0)
+        assert telemetry.verdict(both, now) == 'dead'
+
+    def test_thresholds_from_env(self, monkeypatch):
+        now = time.time()
+        s = self._sample(now, hb_age=1.0, progress_age=3.0)
+        assert telemetry.verdict(s, now) == 'ok'
+        monkeypatch.setenv(telemetry.ENV_PROGRESS_STALE, '1.5')
+        assert telemetry.verdict(s, now) == 'hung'
+        monkeypatch.setenv(telemetry.ENV_HB_STALE, '0.5')
+        assert telemetry.verdict(s, now) == 'dead'
+
+    def test_progress_staleness_is_clock_skew_free(self):
+        """Hung detection compares last_progress_ts against the rank's
+        OWN heartbeat timestamp (same host clock): a rank whose clock
+        is far behind the control plane's must not read as hung."""
+        now = time.time()
+        skewed = {'hb_ts': now - 25, 'last_progress_ts': now - 26,
+                  'started_ts': now - 100, 'phase': 'step'}
+        # 25 s of skew on both fields: progress is 1 s behind the
+        # heartbeat — healthy (hb itself stays within hb_stale).
+        assert telemetry.verdict(skewed, now) == 'ok'
+
+    def test_idle_phase_is_exempt_from_hung(self):
+        """A declared-idle rank (serving replica with no traffic) is
+        not a hang, no matter how stale its progress."""
+        now = time.time()
+        idle = self._sample(now, hb_age=1.0, progress_age=10_000,
+                            phase='idle')
+        assert telemetry.verdict(idle, now) == 'ok'
+        # ...but a dead idle rank is still dead.
+        gone = self._sample(now, hb_age=10_000, phase='idle')
+        assert telemetry.verdict(gone, now) == 'dead'
+
+    def test_stalled_filters_ok_ranks(self):
+        now = time.time()
+        samples = {0: self._sample(now),
+                   1: self._sample(now, hb_age=1.0, progress_age=900.0)}
+        assert telemetry.stalled(samples, now) == {1: 'hung'}
+
+    def test_rank_skew_and_stragglers(self):
+        samples = {r: {'step': 10 + r, 'step_time_ema_s': 0.1}
+                   for r in range(4)}
+        samples[3]['step'] = 4
+        samples[3]['step_time_ema_s'] = 1.0
+        assert telemetry.rank_skew(samples) == 8
+        assert telemetry.stragglers(samples) == {3}
+        # <3 reporting ranks: no meaningful median, no stragglers.
+        assert telemetry.stragglers({0: samples[0], 3: samples[3]}) \
+            == set()
+        assert telemetry.rank_skew({0: {'step': None}}) is None
+
+
+class TestGoodput:
+
+    def test_productive_over_wall(self):
+        now = time.time()
+        samples = {0: {'step': 100, 'step_time_ema_s': 0.5,
+                       'started_ts': now - 100}}
+        g = telemetry.goodput(samples, now=now)
+        assert g['productive_s'] == pytest.approx(50.0)
+        assert g['wall_s'] == pytest.approx(100.0, abs=1.0)
+        assert g['goodput'] == pytest.approx(0.5, abs=0.02)
+
+    def test_recovery_time_counts_against_goodput(self):
+        now = time.time()
+        samples = {0: {'step': 100, 'step_time_ema_s': 0.5,
+                       'started_ts': now - 50}}
+        g = telemetry.goodput(samples, recovery_s=50.0, now=now)
+        assert g['wall_s'] == pytest.approx(100.0, abs=1.0)
+        assert g['goodput'] == pytest.approx(0.5, abs=0.02)
+        assert g['recovery_s'] == 50.0
+
+    def test_no_samples_means_no_ratio(self):
+        g = telemetry.goodput({}, now=time.time())
+        assert g['goodput'] is None
+        assert g['productive_s'] == 0.0
+
+    def test_synthetic_journal_extends_wall(self, tmp_state):
+        """goodput_for_cluster folds the recovery journal's measured
+        latencies into wall time: a job that lost 60 s to recoveries
+        gets charged for them."""
+        now = time.time()
+        tmp_state.record_recovery_event('job.recovered', scope='job/7',
+                                        latency_s=40.0)
+        tmp_state.record_recovery_event('job.restarted', scope='job/7',
+                                        latency_s=20.0)
+        tmp_state.record_recovery_event('job.preempted', scope='job/7')
+        samples = {0: {'step': 100, 'step_time_ema_s': 0.4,
+                       'started_ts': now - 40}}
+        g = telemetry.goodput_for_cluster('xsky-jobs-7', samples,
+                                          now=now)
+        # productive 40s over (40s current incarnation + 60s recovery).
+        assert g['recovery_s'] == pytest.approx(60.0)
+        assert g['wall_s'] == pytest.approx(100.0, abs=1.0)
+        assert g['goodput'] == pytest.approx(0.4, abs=0.02)
+        # Unmanaged cluster names skip the journal entirely.
+        g2 = telemetry.goodput_for_cluster('my-train', samples, now=now)
+        assert g2['recovery_s'] == 0.0
+
+    def test_lease_history_supplies_wall(self, tmp_state):
+        """With a live lease (PR 2), wall time is the lease age — it
+        survives relaunches, unlike the current incarnation's
+        started_ts."""
+        tmp_state.heartbeat_lease('job/9', owner='test', ttl_s=3600)
+        now = time.time() + 200
+        samples = {0: {'step': 100, 'step_time_ema_s': 1.0,
+                       'started_ts': now - 10}}
+        g = telemetry.goodput_for_cluster('xsky-jobs-9', samples,
+                                          now=now)
+        assert g['wall_s'] == pytest.approx(200.0, abs=2.0)
+        assert g['goodput'] == pytest.approx(0.5, abs=0.02)
+
+
+class TestStateTable:
+
+    def _rows(self, n_ranks, step=1, verdict='ok'):
+        return [{'rank': r, 'phase': 'step', 'step': step,
+                 'step_time_ema_s': 0.1, 'tokens_per_sec': 10.0,
+                 'host_mem_mb': 100.0, 'started_ts': 1.0,
+                 'last_progress_ts': 2.0, 'hb_ts': 3.0,
+                 'verdict': verdict} for r in range(n_ranks)]
+
+    def test_round_trip_and_latest_only(self, tmp_state):
+        tmp_state.record_workload_telemetry('c1', 1, self._rows(2),
+                                            ts=100.0)
+        tmp_state.record_workload_telemetry('c1', 1,
+                                            self._rows(2, step=5),
+                                            ts=200.0)
+        tmp_state.record_workload_telemetry('c2', 1, self._rows(1),
+                                            ts=150.0)
+        latest = tmp_state.get_workload_telemetry()
+        assert len(latest) == 3
+        c1 = [r for r in latest if r['cluster'] == 'c1']
+        assert all(r['ts'] == 200.0 and r['step'] == 5 for r in c1)
+        only_c1 = tmp_state.get_workload_telemetry(cluster='c1')
+        assert {r['rank'] for r in only_c1} == {0, 1}
+        history = tmp_state.get_workload_telemetry(cluster='c1',
+                                                   latest_only=False)
+        assert len(history) == 4
+
+    def test_retention_bound(self, tmp_state, monkeypatch):
+        monkeypatch.setattr(tmp_state, '_MAX_WORKLOAD_TELEMETRY', 10)
+        monkeypatch.setattr(tmp_state, '_workload_inserts', 0)
+        tmp_state.record_workload_telemetry('c1', 1, self._rows(40))
+        rows = tmp_state.get_workload_telemetry(latest_only=False,
+                                                limit=1000)
+        assert len(rows) == 10
+        # Newest rows survive the prune.
+        assert {r['rank'] for r in rows} == set(range(30, 40))
+
+    def test_record_never_raises(self, tmp_state, monkeypatch):
+        def _boom():
+            raise RuntimeError('db down')
+
+        monkeypatch.setattr(tmp_state, '_get_conn', _boom)
+        tmp_state.record_workload_telemetry('c1', 1, self._rows(1))
+        telemetry.record_samples('c1', 1, {0: {'hb_ts': time.time()}})
+
+
+class TestRecordSamplesMetrics:
+
+    def test_stall_counter_counts_transitions(self, tmp_state):
+        metrics_lib.reset_for_test()
+        now = time.time()
+        hung = {0: {'hb_ts': now, 'last_progress_ts': now - 10_000,
+                    'started_ts': now - 10_000}}
+        verdicts = telemetry.record_samples('c1', 1, hung, now=now)
+        assert verdicts == {0: 'hung'}
+        telemetry.record_samples('c1', 1, hung, now=now)   # same state
+        text = metrics_lib.render_registry()
+        assert ('xsky_workload_rank_stalls_total{verdict="hung"} 1'
+                in text)
+        rows = tmp_state.get_workload_telemetry(cluster='c1')
+        assert rows and rows[0]['verdict'] == 'hung'
+
+    def test_step_histogram_on_progress(self, tmp_state):
+        metrics_lib.reset_for_test()
+        now = time.time()
+        ok = {0: {'hb_ts': now, 'last_progress_ts': now,
+                  'started_ts': now - 10, 'step': 3,
+                  'step_time_ema_s': 0.2}}
+        telemetry.record_samples('c1', 1, ok, now=now)
+        text = metrics_lib.render_registry()
+        assert 'xsky_workload_step_seconds_count 1' in text
+        # Same step again: no new observation.
+        telemetry.record_samples('c1', 1, ok, now=now)
+        assert 'xsky_workload_step_seconds_count 1' in \
+            metrics_lib.render_registry()
+
+    def test_server_metrics_workload_gauges(self, tmp_state):
+        from skypilot_tpu.server import metrics as server_metrics
+        tmp_state.add_or_update_cluster('gauge-c', None)
+        now = time.time()
+        sample = {0: {'hb_ts': now - 2, 'last_progress_ts': now - 2,
+                      'started_ts': now - 100, 'step': 50,
+                      'step_time_ema_s': 1.0}}
+        telemetry.record_samples('gauge-c', 1, sample, now=now)
+        # A second job on the same cluster: per-(cluster,job,rank)
+        # series, no duplicate sample lines.
+        telemetry.record_samples('gauge-c', 2, sample, now=now + 1)
+        text = server_metrics.render()
+        assert ('xsky_workload_last_heartbeat_age_seconds{'
+                'cluster="gauge-c",job="1",rank="0"}') in text
+        assert ('xsky_workload_last_heartbeat_age_seconds{'
+                'cluster="gauge-c",job="2",rank="0"}') in text
+        # Goodput stays one series per cluster (newest job's samples).
+        assert text.count('xsky_goodput_ratio{cluster="gauge-c"}') == 1
+
+    def test_gauges_skip_torn_down_clusters(self, tmp_state):
+        """Telemetry rows outlive their cluster (size-pruned, not
+        liveness-pruned): /metrics must not export gauges — or grow
+        label cardinality — for clusters that no longer exist."""
+        from skypilot_tpu.server import metrics as server_metrics
+        now = time.time()
+        sample = {0: {'hb_ts': now, 'last_progress_ts': now,
+                      'started_ts': now - 10}}
+        telemetry.record_samples('ghost-c', 1, sample, now=now)
+        text = server_metrics.render()
+        assert 'ghost-c' not in text
+
+
+class TestCliSurfaces:
+
+    def _seed(self, tmp_state, verdict='ok'):
+        now = time.time()
+        sample = {r: {'hb_ts': now - 3, 'last_progress_ts': now - 4,
+                      'started_ts': now - 60, 'step': 7 + r,
+                      'step_time_ema_s': 0.25, 'tokens_per_sec': 1000.0,
+                      'host_mem_mb': 512.0, 'phase': 'step'}
+                  for r in range(2)}
+        if verdict == 'hung':
+            sample[0]['last_progress_ts'] = now - 10_000
+        telemetry.record_samples('top-c', 3, sample, now=now)
+
+    def test_top_json_and_table(self, tmp_state):
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        self._seed(tmp_state, verdict='hung')
+        runner = CliRunner()
+        result = runner.invoke(cli_mod.cli, ['top', '--json'])
+        assert result.exit_code == 0, result.output
+        rows = [json.loads(line) for line in result.output.splitlines()
+                if line.startswith('{')]
+        assert len(rows) == 2
+        by_rank = {r['rank']: r for r in rows}
+        assert by_rank[0]['verdict'] == 'hung'
+        assert by_rank[1]['verdict'] == 'ok'
+        assert by_rank[1]['step'] == 8
+        assert 'goodput' in by_rank[0]
+        table = runner.invoke(cli_mod.cli, ['top'])
+        assert table.exit_code == 0, table.output
+        assert 'VERDICT' in table.output
+        assert 'hung' in table.output
+        assert 'skew=' in table.output
+        filtered = runner.invoke(cli_mod.cli, ['top', 'no-such'])
+        assert 'No workload telemetry' in filtered.output
+
+    def test_status_shows_heartbeat_age(self, tmp_state):
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        tmp_state.add_or_update_cluster('top-c', None)
+        self._seed(tmp_state)
+        result = CliRunner().invoke(cli_mod.cli, ['status'])
+        assert result.exit_code == 0, result.output
+        assert 'HEARTBEAT' in result.output
+        line = [l for l in result.output.splitlines()
+                if l.startswith('top-c')][0]
+        # Age column shows a small seconds value, not '-'.
+        assert line.rstrip()[-1] == 's'
+
+    def test_job_cli_gang_tail_tags_ranks(self, monkeypatch, tmp_path,
+                                          capsys):
+        from skypilot_tpu.agent import job_cli
+        root = tmp_path / 'root'
+        log_dir = root / 'logs' / 'job-1'
+        log_dir.mkdir(parents=True)
+        (log_dir / 'host-0.log').write_text('alpha\n')
+        (log_dir / 'host-1.log').write_text('beta\n')
+        monkeypatch.setenv('XSKY_CLUSTER_ROOT', str(root))
+        assert job_cli.main(['tail', '1', 'gang']) == 0
+        out = capsys.readouterr().out
+        assert '[rank 0] alpha' in out
+        assert '[rank 1] beta' in out
+        # Default tail stays the rank-0 run.log view.
+        (log_dir / 'run.log').write_text('zeroth\n')
+        assert job_cli.main(['tail', '1']) == 0
+        assert capsys.readouterr().out == 'zeroth\n'
+
+
+class TestStallRecoverySmoke:
+    """Tier-1 acceptance: a fake-cloud managed job whose rank 0 is
+    chaos-stalled (`telemetry.stall` freezes its emit; the heartbeat
+    thread keeps beating) is flagged `hung` within a poll interval,
+    surfaced via `xsky top --json` and `/metrics`, and triggers a
+    journalled, trace-linked recovery after which the job succeeds."""
+
+    def test_chaos_stalled_rank_recovers_end_to_end(
+            self, fake_cluster_env, monkeypatch, tmp_path):
+        del fake_cluster_env
+        import threading
+
+        from click.testing import CliRunner
+
+        from skypilot_tpu import Resources, Task
+        from skypilot_tpu import state as state_lib
+        from skypilot_tpu.client import cli as cli_mod
+        from skypilot_tpu.jobs import controller as controller_lib
+        from skypilot_tpu.jobs import scheduler as jobs_scheduler
+        from skypilot_tpu.jobs import state as jobs_state
+        from skypilot_tpu.server import metrics as server_metrics
+        from skypilot_tpu.utils import tracing
+
+        metrics_lib.reset_for_test()
+        monkeypatch.setenv('XSKY_JOBS_DB',
+                           str(tmp_path / 'managed_jobs.db'))
+        monkeypatch.setenv('XSKY_JOBS_LOG_DIR', str(tmp_path / 'jlogs'))
+        monkeypatch.setattr(controller_lib, 'POLL_INTERVAL_S', 0.2)
+        # Fast telemetry: spool writes + heartbeats every 0.1 s, pulls
+        # every 0.3 s, hung after 0.8 s without progress. The heartbeat
+        # threshold stays high — the drill is a HUNG rank, not a dead
+        # one.
+        monkeypatch.setenv(telemetry.ENV_INTERVAL, '0.1')
+        monkeypatch.setenv(telemetry.ENV_PULL_INTERVAL, '0.3')
+        monkeypatch.setenv(telemetry.ENV_PROGRESS_STALE, '0.8')
+        monkeypatch.setenv(telemetry.ENV_HB_STALE, '30')
+
+        # Workload: the first incarnation steps until chaos freezes its
+        # emit (skip_first=3 ⇒ frozen from the 4th emit); the relaunch
+        # (marker present) does 3 un-frozen emits and exits 0.
+        marker = tmp_path / 'first-incarnation'
+        script = tmp_path / 'workload.py'
+        script.write_text(f'''
+import os, sys, time
+sys.path.insert(0, {json.dumps(REPO_ROOT)})
+from skypilot_tpu.agent import telemetry
+relaunch = os.path.exists({json.dumps(str(marker))})
+open({json.dumps(str(marker))}, 'w').close()
+steps = 3 if relaunch else 80
+for i in range(steps):
+    telemetry.emit(phase='step', step=i, step_time_s=0.05)
+    time.sleep(0.1)
+''')
+        plan_file = tmp_path / 'stall-plan.json'
+        plan_file.write_text(json.dumps({'points': {
+            'telemetry.stall': {'match': {'rank': 0},
+                                'skip_first': 3}}}))
+        # Env var (not load_plan): the workload process on the fake
+        # host must see the plan too.
+        monkeypatch.setenv('XSKY_CHAOS_PLAN', str(plan_file))
+
+        task = Task('stall', run=f'{sys.executable} {script}')
+        task.set_resources(Resources(accelerators='tpu-v5e-8',
+                                     use_spot=True))
+        job_id = jobs_state.add_job('stall',
+                                    Task.chain_to_config([task]))
+        jobs_state.set_status(job_id,
+                              jobs_state.ManagedJobStatus.SUBMITTED)
+        jobs_state.set_schedule_state(job_id,
+                                      jobs_state.ScheduleState.LAUNCHING)
+        jobs_state.set_controller_pid(job_id, os.getpid())
+        cluster = f'xsky-jobs-{job_id}'
+
+        def run_controller():
+            try:
+                controller_lib.JobsController(job_id).run()
+            finally:
+                jobs_scheduler.job_done(job_id)
+
+        thread = threading.Thread(target=run_controller, daemon=True)
+        thread.start()
+        try:
+            # The stalled rank surfaces in `xsky top --json` (verdict
+            # from the controller's pull) within ~a poll interval of
+            # going stale.
+            runner = CliRunner()
+            hung_row = None
+            saw_hb_gauge = False
+            deadline = time.time() + 60
+            while hung_row is None and time.time() < deadline:
+                result = runner.invoke(cli_mod.cli, ['top', '--json'])
+                for line in result.output.splitlines():
+                    if not line.startswith('{'):
+                        continue
+                    row = json.loads(line)
+                    if row['cluster'] == cluster and \
+                            row['verdict'] == 'hung':
+                        hung_row = row
+                # Scrape-time gauges exist while the cluster is live
+                # (they are filtered out after teardown).
+                if not saw_hb_gauge:
+                    saw_hb_gauge = (
+                        'xsky_workload_last_heartbeat_age_seconds{'
+                        f'cluster="{cluster}"'
+                        in server_metrics.render())
+                time.sleep(0.05)
+            assert hung_row is not None, \
+                'stalled rank never surfaced in xsky top --json'
+            assert hung_row['rank'] == 0
+            assert saw_hb_gauge, \
+                'heartbeat-age gauge never appeared on /metrics'
+        finally:
+            thread.join(timeout=120)
+        assert not thread.is_alive(), 'controller wedged'
+
+        # The job recovered from the stall and finished.
+        record = jobs_state.get_job(job_id)
+        assert record['status'] == \
+            jobs_state.ManagedJobStatus.SUCCEEDED, record
+        assert record['recovery_count'] >= 1
+
+        # Journalled + trace-linked: the stall event carries the
+        # jobs.stall_recover trace, whose tree holds the recovery.
+        events = state_lib.get_recovery_events(scope=f'job/{job_id}')
+        types = [e['event_type'] for e in events]
+        assert 'job.rank_stall' in types
+        stall_event = events[types.index('job.rank_stall')]
+        assert stall_event['cause'].startswith('rank 0:')
+        assert stall_event['detail']['ranks'] == {'0': 'hung'}
+        assert stall_event['trace_id'], 'stall event not trace-linked'
+        assert 'job.recovered' in types
+        recovered = events[types.index('job.recovered')]
+        assert recovered['cause'] == 'relaunched after rank stall'
+        assert recovered['latency_s'] and recovered['latency_s'] > 0
+        tracing.flush()
+        span_names = {s['name']
+                      for s in state_lib.get_spans(
+                          stall_event['trace_id'])}
+        assert 'jobs.stall_recover' in span_names
+        assert 'jobs.recover' in span_names
+
+        # /metrics: the registry series survive the run (the
+        # scrape-time gauges were asserted live, above — they
+        # correctly disappear with the torn-down cluster).
+        text = server_metrics.render()
+        assert 'xsky_workload_rank_stalls_total{verdict="hung"}' in text
+        assert 'xsky_workload_step_seconds_count' in text
+
+        # Workload chaos fired in the workload process, journalled
+        # cross-process through the shared state DB.
+        injected = {r['scope'] for r in state_lib.get_recovery_events(
+            event_type='chaos.injected')}
+        assert 'chaos/telemetry.stall' in injected
