@@ -228,12 +228,19 @@ def _cluster_heartbeats() -> dict:
 @cli.command()
 @click.argument('clusters', nargs=-1)
 @click.option('--refresh', '-r', is_flag=True, default=False)
-def status(clusters, refresh):
+@click.option('--limit', '-n', type=int, default=None,
+              help='Page size (newest launches first; server-side — '
+                   'a 5k-cluster fleet is not shipped to render 20 '
+                   'rows).')
+@click.option('--offset', type=int, default=0,
+              help='Rows to skip before the page (use with --limit).')
+def status(clusters, refresh, limit, offset):
     """Show clusters."""
     import time as time_lib
 
     from skypilot_tpu.client import sdk
-    records = sdk.status(list(clusters) or None, refresh=refresh)
+    records = sdk.status(list(clusters) or None, refresh=refresh,
+                         limit=limit, offset=offset)
     if not records:
         click.echo('No existing clusters.')
         return

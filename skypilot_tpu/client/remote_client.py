@@ -152,9 +152,11 @@ class RemoteClient:
         result = self._call('exec', body)
         return result['job_id'], _HandleProxy(result['cluster_name'])
 
-    def status(self, cluster_names=None, refresh=False):
+    def status(self, cluster_names=None, refresh=False, limit=None,
+               offset=0):
         return self._call('status', {'cluster_names': cluster_names,
-                                     'refresh': refresh})
+                                     'refresh': refresh,
+                                     'limit': limit, 'offset': offset})
 
     def start(self, cluster_name, idle_minutes_to_autostop=None,
               down=False):

@@ -81,9 +81,14 @@ def _local_or_remote(name: str, *args, **kwargs):
 
 
 def status(cluster_names: Optional[List[str]] = None,
-           refresh: bool = False) -> List[Dict[str, Any]]:
+           refresh: bool = False,
+           limit: Optional[int] = None,
+           offset: int = 0) -> List[Dict[str, Any]]:
+    """limit/offset page the fleet listing server-side (stable order:
+    newest launch first, then name) — at 5k clusters the full listing
+    is a debugging tool, not a default."""
     return _local_or_remote('status', cluster_names=cluster_names,
-                            refresh=refresh)
+                            refresh=refresh, limit=limit, offset=offset)
 
 
 def start(cluster_name: str,

@@ -91,15 +91,26 @@ def refresh_cluster_status(cluster_name: str) -> Optional[Dict[str, Any]]:
 
 def status(cluster_names: Optional[List[str]] = None,
            refresh: bool = False,
-           workspace: Optional[str] = None) -> List[Dict[str, Any]]:
+           workspace: Optional[str] = None,
+           limit: Optional[int] = None,
+           offset: int = 0) -> List[Dict[str, Any]]:
+    """Cluster records, paginated.
+
+    Name/workspace filters and limit/offset push down into SQL
+    (state.get_clusters): a point `status CLUSTER` or a dashboard page
+    of 100 must not scan and unpickle a 5k-cluster fleet. Page
+    stability comes from the state layer's deterministic ordering
+    (launched_at DESC, then name).
+    """
     if workspace is None:
         # Honor a pinned workspace (XSKY_WORKSPACE); with no pin, show
         # everything — the admin-friendly default.
         import os
         workspace = os.environ.get('XSKY_WORKSPACE') or None
-    records = state.get_clusters(workspace=workspace)
-    if cluster_names:
-        records = [r for r in records if r['name'] in cluster_names]
+    records = state.get_clusters(workspace=workspace,
+                                 names=list(cluster_names)
+                                 if cluster_names else None,
+                                 limit=limit, offset=offset)
     if refresh:
         # Each refresh is a cloud API round trip (plus an autostop
         # probe against the head host): fan the clusters out instead

@@ -69,11 +69,16 @@ def wait_for_terminal(job_id: int, timeout_s: float = 600.0
                        f'after {timeout_s}s')
 
 
-def queue() -> List[Dict[str, Any]]:
+def queue(limit: Optional[int] = None,
+          offset: int = 0) -> List[Dict[str, Any]]:
     if _remote_mode():
         from skypilot_tpu.jobs import remote as jobs_remote
-        return jobs_remote.queue()
-    rows = jobs_state.get_jobs()
+        from skypilot_tpu.utils import db_utils
+        # The remote-controller wire protocol predates pagination:
+        # page here, with the same clamping as the SQL path, so
+        # callers get one contract either way.
+        return db_utils.page_rows(jobs_remote.queue(), limit, offset)
+    rows = jobs_state.get_jobs(limit=limit, offset=offset)
     return [{
         'job_id': r['job_id'],
         'name': r['name'],

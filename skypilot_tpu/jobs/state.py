@@ -327,11 +327,16 @@ def get_job(job_id: int) -> Optional[Dict[str, Any]]:
     return _to_dict(row) if row else None
 
 
-def get_jobs() -> List[Dict[str, Any]]:
+def get_jobs(limit: Optional[int] = None,
+             offset: int = 0) -> List[Dict[str, Any]]:
+    """Managed jobs, newest first; limit/offset page the queue the
+    same way state.get_clusters pages `status`."""
+    from skypilot_tpu.utils import db_utils
     with _lock:
         conn = _db()
         rows = conn.execute(
-            'SELECT * FROM managed_jobs ORDER BY job_id DESC').fetchall()
+            'SELECT * FROM managed_jobs ORDER BY job_id DESC' +
+            db_utils.page_sql(limit, offset)).fetchall()
         conn.close()
     return [_to_dict(r) for r in rows]
 

@@ -210,14 +210,17 @@ def _terminal_job_clusters() -> List:
     record is terminal or missing."""
     from skypilot_tpu.jobs import state as jobs_state
     out = []
-    for record in global_state.get_clusters():
-        match = _JOBS_CLUSTER_RE.match(record['name'])
+    # Names-only projection: the tick runs forever in the background
+    # and must not unpickle a 5k-cluster fleet's handles to regex a
+    # few names.
+    for name in global_state.get_cluster_names():
+        match = _JOBS_CLUSTER_RE.match(name)
         if not match:
             continue
         job_id = int(match.group(1))
         job = jobs_state.get_job(job_id)
         if job is None or job['status'].is_terminal():
-            out.append((record['name'], job_id))
+            out.append((name, job_id))
     return out
 
 
@@ -248,13 +251,14 @@ def reconcile_serve() -> List[Dict[str, Any]]:
         _count_repair(repairs, 'service_respawn', f'service/{name}',
                       'controller process died')
     services = {record['name'] for record in serve_state.get_services()}
-    for record in global_state.get_clusters():
-        match = _SERVE_CLUSTER_RE.match(record['name'])
+    # Names-only projection (see _terminal_job_clusters).
+    for name in global_state.get_cluster_names():
+        match = _SERVE_CLUSTER_RE.match(name)
         if not match or match.group(1) in services:
             continue
-        if _teardown_cluster(record['name']):
+        if _teardown_cluster(name):
             _repair(repairs, 'orphan_teardown',
-                    f'cluster/{record["name"]}',
+                    f'cluster/{name}',
                     'service record no longer exists',
                     {'service': match.group(1)})
     # Drop service leases with no backing record (clean `serve down`

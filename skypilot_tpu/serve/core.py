@@ -299,14 +299,19 @@ def update(task: task_lib.Task, service_name: str,
     return new_version
 
 
-def status(service_names: Optional[List[str]] = None
-           ) -> List[Dict[str, Any]]:
+def status(service_names: Optional[List[str]] = None,
+           limit: Optional[int] = None,
+           offset: int = 0) -> List[Dict[str, Any]]:
     if _remote_mode():
         from skypilot_tpu.serve import remote as serve_remote
-        return serve_remote.status(service_names)
-    records = serve_state.get_services()
-    if service_names:
-        records = [r for r in records if r['name'] in service_names]
+        from skypilot_tpu.utils import db_utils
+        # Remote-controller wire protocol predates pagination: page
+        # here, with the same clamping as the SQL path, so callers
+        # get one contract either way.
+        return db_utils.page_rows(serve_remote.status(service_names),
+                                  limit, offset)
+    records = serve_state.get_services(names=service_names,
+                                       limit=limit, offset=offset)
     out = []
     for r in records:
         replicas = serve_state.get_replicas(r['name'])

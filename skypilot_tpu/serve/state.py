@@ -274,10 +274,26 @@ def get_service(name: str) -> Optional[Dict[str, Any]]:
     return _service_dict(row) if row else None
 
 
-def get_services() -> List[Dict[str, Any]]:
+def get_services(names: Optional[List[str]] = None,
+                 limit: Optional[int] = None,
+                 offset: int = 0) -> List[Dict[str, Any]]:
+    """Service records, stable name order; the name filter pushes into
+    SQL so a point `serve status NAME` never scans the fleet."""
+    from skypilot_tpu.utils import db_utils
+    if names and len(names) > db_utils.MAX_NAME_PUSHDOWN:
+        # Same host-parameter-cap fallback as state.get_clusters.
+        name_set = set(names)
+        return db_utils.page_rows(
+            [s for s in get_services() if s['name'] in name_set],
+            limit, offset)
+    query, args = 'SELECT * FROM services', []
+    if names:
+        query += f" WHERE name IN ({','.join('?' * len(names))})"
+        args += list(names)
+    query += ' ORDER BY name' + db_utils.page_sql(limit, offset)
     with _lock:
         conn = _db()
-        rows = conn.execute('SELECT * FROM services').fetchall()
+        rows = conn.execute(query, args).fetchall()
         conn.close()
     return [_service_dict(r) for r in rows]
 

@@ -41,7 +41,12 @@ def _submit_verb(verb: str, body: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def _get_request(params: Dict[str, str]) -> Tuple[int, Dict[str, Any]]:
-    record = requests_db.get(params.get('request_id', ''))
+    # Status-only fast path first: polling is the chattiest verb on the
+    # wire (every SDK call polls until terminal), and while a request
+    # is PENDING/RUNNING the body/result deserialization that
+    # requests_db.get() pays buys the poller nothing. Only a terminal
+    # row that actually carries a result/error takes the full read.
+    record = requests_db.get_status(params.get('request_id', ''))
     if record is None:
         return 404, {'error': 'request not found'}
     payload: Dict[str, Any] = {
@@ -52,10 +57,18 @@ def _get_request(params: Dict[str, str]) -> Tuple[int, Dict[str, Any]]:
         # `xsky trace` while the request is still running.
         'trace_id': record.get('trace_id'),
     }
-    if record['status'] == requests_db.RequestStatus.SUCCEEDED:
-        payload['result'] = payloads.jsonify(record['result'])
-    elif record['status'] == requests_db.RequestStatus.FAILED:
-        payload['error'] = record['error']
+    if record['status'] in (requests_db.RequestStatus.SUCCEEDED,
+                            requests_db.RequestStatus.FAILED):
+        full = requests_db.get(record['request_id'])
+        if full is None:
+            # Retention GC raced the two reads and reclaimed the row:
+            # answer like any other missing request, never a
+            # SUCCEEDED payload with a silently-null result.
+            return 404, {'error': 'request not found'}
+        if record['status'] == requests_db.RequestStatus.SUCCEEDED:
+            payload['result'] = payloads.jsonify(full['result'])
+        else:
+            payload['error'] = full['error']
     if params.get('include_log') == '1':
         payload['log'] = requests_db.read_log(record['request_id'])
     return 200, payload
@@ -146,6 +159,21 @@ def _cancel_request(body: Dict[str, Any]) -> Dict[str, Any]:
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = 'xsky-api'
+    # Keep-alive (measured by tools/bench_controlplane.py): the default
+    # HTTP/1.0 closes the connection after every response, so each poll
+    # paid a fresh TCP connect + handler-thread spawn. Every response
+    # path sets Content-Length, which HTTP/1.1 persistence requires.
+    protocol_version = 'HTTP/1.1'
+    # Without TCP_NODELAY the headers-then-body write pattern trips
+    # Nagle against delayed ACKs: ~40 ms added to EVERY round trip on
+    # loopback (bench measured poll p50 at 50 ms; ~2 ms after).
+    disable_nagle_algorithm = True
+    # Keep-alive must not let idle/half-open peers pin handler threads
+    # forever (ThreadingHTTPServer = one thread per connection; the
+    # old HTTP/1.0 close-per-response bounded thread lifetime). A
+    # timed-out read surfaces as close_connection, ending the thread.
+    # CONNECT tunnels idle in select(), which this does not interrupt.
+    timeout = 120
 
     def log_message(self, fmt, *args):  # quiet default access log
         logger.debug('%s - %s' % (self.address_string(), fmt % args))
@@ -169,7 +197,24 @@ class _Handler(BaseHTTPRequestHandler):
         except json.JSONDecodeError:
             return {}
 
+    def _discard_body(self) -> None:
+        """Keep-alive hygiene for routes that ignore request bodies:
+        unread body bytes would be parsed as the NEXT request on this
+        persistent connection (a GET with a Content-Length body is
+        nonstandard but legal). Chunked bodies can't be skipped by
+        length, so those connections close after the response."""
+        if self.headers.get('Transfer-Encoding'):
+            self.close_connection = True
+            return
+        length = int(self.headers.get('Content-Length') or 0)
+        while length > 0:
+            chunk = self.rfile.read(min(length, 65536))
+            if not chunk:
+                break
+            length -= len(chunk)
+
     def do_GET(self) -> None:  # noqa: N802
+        self._discard_body()
         parsed = urllib.parse.urlparse(self.path)
         params = dict(urllib.parse.parse_qsl(parsed.query))
         if parsed.path == '/health':
@@ -218,10 +263,15 @@ class _Handler(BaseHTTPRequestHandler):
                 limit = int(params.get('limit', '100'))
             except (TypeError, ValueError):
                 limit = 100
+            try:
+                offset = max(0, int(params.get('offset', '0')))
+            except (TypeError, ValueError):
+                offset = 0
             # Clamp: SQLite treats LIMIT -1 as unlimited.
             limit = max(1, min(limit, 1000))
             self._send(200, {'requests':
-                             requests_db.list_requests(limit=limit)})
+                             requests_db.list_requests(limit=limit,
+                                                       offset=offset)})
         elif parsed.path == '/api/request_log':
             # Incremental captured-output read for the dashboard's
             # request drill-down (live while the request runs).
@@ -230,7 +280,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(401, {'error': 'authentication required'})
                 return
             request_id = params.get('request_id', '')
-            record = requests_db.get(request_id)
+            # Status-only read: this route tails the log FILE — the
+            # row's body/result never leave the DB.
+            record = requests_db.get_status(request_id)
             if record is None:
                 self._send(404, {'error': f'no request {request_id}'})
                 return
@@ -415,6 +467,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self) -> None:  # noqa: N802
         parsed = urllib.parse.urlparse(self.path)
+        if self.headers.get('Transfer-Encoding'):
+            # Chunked bodies are not parsed here — rejecting
+            # explicitly beats silently running the verb on an empty
+            # body. Close afterwards: under HTTP/1.1 keep-alive the
+            # unread chunk data would be parsed as the NEXT request
+            # on this connection.
+            self.close_connection = True
+            self._send(411, {'error': 'chunked request bodies are not '
+                                      'supported; send Content-Length'})
+            return
         body = self._read_body()
         if parsed.path == '/api/requests/cancel':
             if not self._authenticated():

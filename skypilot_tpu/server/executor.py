@@ -251,7 +251,9 @@ def _watchdog_heartbeat_tick(rdb) -> None:
                     for rid, e in _long_running.items()
                     if not e['released']}
     for rid, started in snapshot.items():
-        record = rdb.get(rid)
+        # Status-only read: the watchdog sweeps EVERY long-running row
+        # each tick — deserializing bodies/results here was pure waste.
+        record = rdb.get_status(rid)
         if record is None or record['status'].is_terminal():
             # Client cancelled (or row vanished): the thread may
             # hang forever — reclaim its admission slot now, and
@@ -319,7 +321,9 @@ def _run_request(request_id: str, func: Callable[..., Any],
                  trace_id: Optional[str] = None) -> None:
     from skypilot_tpu import state as global_state
     from skypilot_tpu.server import metrics
-    record = requests_db.get(request_id)
+    # Status-only read: func/kwargs arrive resolved; the worker needs
+    # the verb name + liveness, never the persisted body or result.
+    record = requests_db.get_status(request_id)
     if record is None or record['status'].is_terminal():
         # Cancelled before start: drop the acceptance-time tracking or
         # the watchdog would heartbeat this dead request's lease (and

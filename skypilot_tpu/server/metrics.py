@@ -156,8 +156,9 @@ def _render_workload_gauges() -> List[str]:
         # Only LIVE clusters: torn-down workloads' rows linger in the
         # telemetry table (pruned lazily by size, not liveness) and
         # would otherwise export climbing heartbeat ages — and grow
-        # label cardinality — forever.
-        live = {r['name'] for r in state.get_clusters()}
+        # label cardinality — forever. Names-only projection: a
+        # /metrics scrape must not unpickle the fleet's handles.
+        live = set(state.get_cluster_names())
         rows = [r for r in state.get_workload_telemetry()
                 if r['cluster'] in live]
         if not rows:

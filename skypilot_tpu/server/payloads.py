@@ -100,7 +100,7 @@ _VERBS: Dict[str, Callable[[Dict[str, Any]],
     'launch': _launch,
     'exec': _exec,
     'status': _core_verb('status', cluster_names=None, refresh=False,
-                         workspace=None),
+                         workspace=None, limit=None, offset=0),
     'start': _core_verb('start', 'cluster_name',
                         idle_minutes_to_autostop=None, down=False),
     'stop': _core_verb('stop', 'cluster_name'),
@@ -148,10 +148,12 @@ def _jobs_launch(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
     return run, {'name': body.get('name')}
 
 
-def _jobs_verb(fn_name: str, *fields):
+def _jobs_verb(fn_name: str, *fields, **defaults):
     def resolver(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
         from skypilot_tpu.jobs import core as jobs_core
         kwargs = {f: _require(body, f) for f in fields}
+        for key, default in defaults.items():
+            kwargs[key] = body.get(key, default)
         return getattr(jobs_core, fn_name), kwargs
     return resolver
 
@@ -205,7 +207,7 @@ _WORKSPACES = 'skypilot_tpu.workspaces.core'
 
 _VERBS.update({
     'jobs.launch': _jobs_launch,
-    'jobs.queue': _jobs_verb('queue'),
+    'jobs.queue': _jobs_verb('queue', limit=None, offset=0),
     'jobs.cancel': _jobs_verb('cancel', 'job_id'),
     'jobs.logs': _jobs_verb('tail_logs', 'job_id'),
     'jobs.watch_logs': lambda body: (
@@ -217,7 +219,9 @@ _VERBS.update({
     'serve.update': _serve_update,
     'serve.status': lambda body: (
         __import__('skypilot_tpu.serve.core', fromlist=['status']).status,
-        {'service_names': body.get('service_names')}),
+        {'service_names': body.get('service_names'),
+         'limit': body.get('limit'),
+         'offset': body.get('offset', 0)}),
     'serve.down': _serve_verb('down', 'service_name'),
     'serve.logs': _serve_verb('tail_logs', 'service_name', 'replica_id',
                               job_id=None),

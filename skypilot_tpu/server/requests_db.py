@@ -15,9 +15,18 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional
 
+# Same writer/reader split as skypilot_tpu/state.py: one write
+# connection under _lock; reads go to per-thread WAL connections
+# (db_utils.WalReadPool — the one shared implementation) so a fleet
+# of pollers never queues behind a finish() commit. The
+# XSKY_STATE_READ_POOL / XSKY_STATE_READ_WORKERS knobs are shared
+# with state.py (one config surface, measured by
+# tools/bench_controlplane.py).
 _lock = threading.RLock()
 _conn: Optional[sqlite3.Connection] = None
 _conn_path: Optional[str] = None
+
+_reader = None
 
 
 class RequestStatus(enum.Enum):
@@ -64,6 +73,9 @@ def _get_conn() -> sqlite3.Connection:
             os.makedirs(os.path.dirname(path), exist_ok=True)
             _conn = sqlite3.connect(path, check_same_thread=False)
             _conn.execute('PRAGMA journal_mode=WAL')
+            from skypilot_tpu.utils import db_utils
+            _conn.execute(
+                f'PRAGMA synchronous={db_utils.sqlite_synchronous()}')
             _conn.execute("""
                 CREATE TABLE IF NOT EXISTS requests (
                     request_id TEXT PRIMARY KEY,
@@ -85,9 +97,47 @@ def _get_conn() -> sqlite3.Connection:
                     'ALTER TABLE requests ADD COLUMN trace_id TEXT')
             except sqlite3.OperationalError:
                 pass  # column already exists
+            # list_inflight / fail_stale_inflight filter on status and
+            # gc_finished range-scans finished_at under a status filter
+            # — both were full table scans before this index.
+            _conn.execute(
+                'CREATE INDEX IF NOT EXISTS idx_requests_status_finished'
+                ' ON requests (status, finished_at)')
+            # list_requests orders newest-first; without this the sort
+            # re-scans every row per listing page.
+            _conn.execute(
+                'CREATE INDEX IF NOT EXISTS idx_requests_created '
+                'ON requests (created_at)')
             _conn.commit()
             _conn_path = path
         return _conn
+
+
+def _ensure_writer() -> None:
+    if _conn is None or _conn_path != _db_path():
+        _get_conn()   # create the DB + table (once, under _lock)
+
+
+def _get_reader():
+    global _reader
+    if _reader is None:
+        from skypilot_tpu.utils import db_utils
+        # Double-checked under _lock (see state._get_reader).
+        with _lock:
+            if _reader is None:
+                _reader = db_utils.StateReader(_db_path, _ensure_writer,
+                                               _get_conn, _lock)
+    return _reader
+
+
+def _read(sql: str, args=()):
+    """One SELECT + fetchall off the write lock (pool on, the
+    default); under it on the shared connection otherwise."""
+    return _get_reader().fetchall(sql, args)
+
+
+def _read_one(sql: str, args=()):
+    return _get_reader().fetchone(sql, args)
 
 
 def reset_for_test() -> None:
@@ -97,6 +147,8 @@ def reset_for_test() -> None:
             _conn.close()
         _conn = None
         _conn_path = None
+        if _reader is not None:
+            _reader.invalidate()   # lazily drop per-thread read conns
 
 
 def create(name: str, user: str, body: Dict[str, Any],
@@ -115,11 +167,8 @@ def create(name: str, user: str, body: Dict[str, Any],
 
 def get_trace_id(request_id: str) -> Optional[str]:
     """The trace minted for this request at acceptance, or None."""
-    conn = _get_conn()
-    with _lock:
-        row = conn.execute(
-            'SELECT trace_id FROM requests WHERE request_id=?',
-            (request_id,)).fetchone()
+    row = _read_one('SELECT trace_id FROM requests WHERE request_id=?',
+                    (request_id,))
     return row[0] if row else None
 
 
@@ -160,14 +209,39 @@ def finish(request_id: str, result: Any = None,
         conn.commit()
 
 
+def get_status(request_id: str) -> Optional[Dict[str, Any]]:
+    """The poll fast path: status + identity WITHOUT body/result/error.
+
+    ``get()`` json-parses the body and unpickles the result on every
+    call — for a client polling a RUNNING launch (and the watchdog
+    sweeping every in-flight row each tick) that deserialization buys
+    nothing. This query reads only the cheap TEXT/REAL columns; callers
+    upgrade to :func:`get` once the row is terminal and the
+    result/error is actually needed.
+    """
+    row = _read_one(
+        'SELECT request_id, name, user, status, created_at, '
+        'finished_at, trace_id FROM requests WHERE request_id=?',
+        (request_id,))
+    if row is None:
+        return None
+    return {
+        'request_id': row[0],
+        'name': row[1],
+        'user': row[2],
+        'status': RequestStatus(row[3]),
+        'created_at': row[4],
+        'finished_at': row[5],
+        'trace_id': row[6],
+    }
+
+
 def get(request_id: str) -> Optional[Dict[str, Any]]:
-    conn = _get_conn()
-    with _lock:
-        row = conn.execute(
-            'SELECT request_id, name, user, status, body, result, error, '
-            'created_at, finished_at, trace_id FROM requests '
-            'WHERE request_id=?',
-            (request_id,)).fetchone()
+    row = _read_one(
+        'SELECT request_id, name, user, status, body, result, error, '
+        'created_at, finished_at, trace_id FROM requests '
+        'WHERE request_id=?',
+        (request_id,))
     if row is None:
         return None
     return {
@@ -184,13 +258,15 @@ def get(request_id: str) -> Optional[Dict[str, Any]]:
     }
 
 
-def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
-    conn = _get_conn()
-    with _lock:
-        rows = conn.execute(
-            'SELECT request_id, name, user, status, created_at, '
-            'finished_at FROM requests ORDER BY created_at DESC LIMIT ?',
-            (limit,)).fetchall()
+def list_requests(limit: int = 100,
+                  offset: int = 0) -> List[Dict[str, Any]]:
+    """Newest requests first (request_id breaks created_at ties so
+    pages are stable); served by the created_at index."""
+    rows = _read(
+        'SELECT request_id, name, user, status, created_at, '
+        'finished_at FROM requests '
+        'ORDER BY created_at DESC, request_id LIMIT ? OFFSET ?',
+        (int(limit), max(int(offset), 0)))
     return [{
         'request_id': r[0], 'name': r[1], 'user': r[2], 'status': r[3],
         'created_at': r[4], 'finished_at': r[5],
@@ -202,6 +278,8 @@ def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
 # enough that a busy API server's DB and request_logs/ stay bounded.
 _RETENTION_HOURS_ENV = 'XSKY_REQUEST_RETENTION_HOURS'
 _DEFAULT_RETENTION_HOURS = 72.0
+# Rows reclaimed per GC sweep (bounds one sweep's unlink + delete work).
+_GC_BATCH = 5000
 
 
 def gc_finished(now: Optional[float] = None) -> int:
@@ -222,13 +300,16 @@ def gc_finished(now: Optional[float] = None) -> int:
         return 0
     cutoff = (now if now is not None else time.time()) - hours * 3600
     terminal = tuple(s.value for s in RequestStatus if s.is_terminal())
-    conn = _get_conn()
-    with _lock:
-        rows = conn.execute(
-            'SELECT request_id FROM requests WHERE finished_at IS NOT '
-            'NULL AND finished_at < ? AND status IN '
-            f"({','.join('?' * len(terminal))})",
-            (cutoff, *terminal)).fetchall()
+    # Batched sweep (served by the (status, finished_at) index): one
+    # opportunistic call deletes at most _GC_BATCH rows + log files so
+    # a huge backlog cannot charge an unbounded sweep to the short
+    # pool; the next sweep continues where this one stopped.
+    rows = _read(
+        'SELECT request_id FROM requests WHERE finished_at IS NOT '
+        'NULL AND finished_at < ? AND status IN '
+        f"({','.join('?' * len(terminal))}) "
+        'ORDER BY finished_at LIMIT ?',
+        (cutoff, *terminal, _GC_BATCH))
     ids = [r[0] for r in rows]
     if not ids:
         return 0
@@ -240,6 +321,7 @@ def gc_finished(now: Optional[float] = None) -> int:
             os.remove(log_path(request_id))
         except OSError:
             pass
+    conn = _get_conn()
     with _lock:
         conn.executemany('DELETE FROM requests WHERE request_id=?',
                          [(i,) for i in ids])
@@ -249,13 +331,14 @@ def gc_finished(now: Optional[float] = None) -> int:
 
 def list_inflight() -> List[Dict[str, Any]]:
     """PENDING/RUNNING rows with the fields reconciliation needs."""
-    conn = _get_conn()
-    with _lock:
-        rows = conn.execute(
-            'SELECT request_id, name, user, status, body, created_at '
-            'FROM requests WHERE status IN (?, ?) ORDER BY created_at',
-            (RequestStatus.PENDING.value,
-             RequestStatus.RUNNING.value)).fetchall()
+    # full-scan ok: bounded by the executor's admission capacity (the
+    # reconciler must see EVERY stranded row); the status filter is
+    # served by the (status, finished_at) index.
+    rows = _read(
+        'SELECT request_id, name, user, status, body, created_at '
+        'FROM requests WHERE status IN (?, ?) ORDER BY created_at',
+        (RequestStatus.PENDING.value,
+         RequestStatus.RUNNING.value))
     return [{
         'request_id': r[0], 'name': r[1], 'user': r[2],
         'status': RequestStatus(r[3]), 'body': json.loads(r[4] or '{}'),

@@ -7,6 +7,7 @@ XSKY_STATE_DB for tests).
 """
 from __future__ import annotations
 
+import atexit
 import enum
 import json
 import os
@@ -14,11 +15,26 @@ import pickle
 import sqlite3
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterable, List, Optional
 
+# Writer discipline: ONE write connection for the whole process, every
+# write serialized under _lock. Reads do NOT take this lock — each
+# reader thread gets its own WAL connection (see _read_conn), so a 5k-
+# cluster `status` storm never queues behind a journal commit. The
+# process-wide-writer + per-thread-reader split is exactly sqlite WAL's
+# concurrency model (readers never block the writer, nor vice versa).
 _lock = threading.RLock()
 _conn: Optional[sqlite3.Connection] = None
 _conn_path: Optional[str] = None
+
+# Per-thread READ connections behind a bounded read gate — the shared
+# db_utils.WalReadPool (requests_db runs the same machinery). sqlite
+# only; postgres keeps its own facade. The pool's `ensure` creates the
+# DB + tables once through the writer; steady-state reads never touch
+# `_lock`, so a wedged or slow writer cannot freeze reads. Gate width:
+# db_utils.read_gate_width (XSKY_STATE_READ_WORKERS, default 1 — see
+# the GIL-convoy measurement there).
+
 
 
 class ClusterStatus(enum.Enum):
@@ -65,6 +81,55 @@ def _get_conn() -> sqlite3.Connection:
             _create_tables(_conn)
             _conn_path = key
         return _conn
+
+
+def _ensure_writer() -> None:
+    """Create the DB + tables exactly once (the pool's first read on
+    each thread calls this; it short-circuits without `_lock` when the
+    writer connection already matches the current path)."""
+    if _conn is None or _conn_path != _db_path():
+        _get_conn()
+
+
+_reader = None
+
+
+def _get_reader():
+    global _reader
+    if _reader is None:
+        from skypilot_tpu.utils import db_utils
+        # Double-checked under _lock: racing first reads must not
+        # build two pools (steady-state reads never take the lock).
+        with _lock:
+            if _reader is None:
+                _reader = db_utils.StateReader(_db_path, _ensure_writer,
+                                               _get_conn, _lock,
+                                               postgres_aware=True)
+    return _reader
+
+
+def _read(sql: str, args: Iterable[Any] = ()) -> List[Any]:
+    """Run one SELECT and fetchall, off the write lock.
+
+    sqlite + pool enabled (the default): this thread's own WAL reader
+    under the read gate — never blocks on `_lock`, a writer's open
+    transaction, or its fsync. Postgres (the facade serializes
+    internally) and ``XSKY_STATE_READ_POOL=0`` fall back to the shared
+    writer connection under `_lock` (the pre-refactor behavior).
+    """
+    return _get_reader().fetchall(sql, args)
+
+
+def _read_one(sql: str, args: Iterable[Any] = ()) -> Optional[Any]:
+    """fetchone twin of :func:`_read` (point reads)."""
+    return _get_reader().fetchone(sql, args)
+
+
+def _page_sql(limit: Optional[int], offset: Optional[int] = 0) -> str:
+    """The LIMIT/OFFSET tail every listing query carries — see
+    db_utils.page_sql, the one definition of the clamping contract."""
+    from skypilot_tpu.utils import db_utils
+    return db_utils.page_sql(limit, offset)
 
 
 def _create_tables(conn: sqlite3.Connection) -> None:
@@ -179,6 +244,12 @@ def _create_tables(conn: sqlite3.Connection) -> None:
         );
         CREATE INDEX IF NOT EXISTS idx_workload_telemetry_cluster
             ON workload_telemetry (cluster);
+        CREATE INDEX IF NOT EXISTS idx_clusters_status
+            ON clusters (status);
+        CREATE INDEX IF NOT EXISTS idx_recovery_events_ts
+            ON recovery_events (ts);
+        CREATE INDEX IF NOT EXISTS idx_cluster_history_torn_down
+            ON cluster_history (torn_down_at);
     """)
     # Migration for pre-workspace DBs: clusters gain a workspace column.
     for migration in (
@@ -194,6 +265,11 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             conn.execute(migration)
         except sqlite3.OperationalError:
             pass  # column already exists
+    # After the migrations: this index is on a migrated column, so it
+    # cannot live in the CREATE TABLE block above (fresh DBs would not
+    # have the column yet when the executescript runs).
+    conn.execute('CREATE INDEX IF NOT EXISTS idx_clusters_workspace '
+                 'ON clusters (workspace)')
     conn.execute("INSERT OR IGNORE INTO workspaces (name, created_at) "
                  "VALUES ('default', strftime('%s','now'))")
     conn.commit()
@@ -201,11 +277,20 @@ def _create_tables(conn: sqlite3.Connection) -> None:
 
 def reset_for_test() -> None:
     global _conn, _conn_path
+    # DROP buffered journal appends, never flush them: the caller is
+    # repointing XSKY_STATE_DB, and a flush here would write the OLD
+    # DB's buffered events into whatever path is now current.
+    with _journal_buf_lock:
+        del _journal_buf[:]
     with _lock:
         if _conn is not None:
             _conn.close()
         _conn = None
         _conn_path = None
+        # Invalidate every thread's cached read connection lazily (the
+        # next read on each thread reopens against the current path).
+        if _reader is not None:
+            _reader.invalidate()
 
 
 # ---- clusters -------------------------------------------------------------
@@ -339,13 +424,15 @@ def remove_cluster(cluster_name: str, terminate: bool) -> None:
         conn.commit()
 
 
-def get_cluster_history() -> List[Dict[str, Any]]:
-    conn = _get_conn()
-    with _lock:
-        rows = conn.execute(
-            'SELECT name, launched_at, torn_down_at, duration_s, handle, '
-            'workspace FROM cluster_history '
-            'ORDER BY torn_down_at DESC').fetchall()
+def get_cluster_history(limit: Optional[int] = None,
+                        offset: int = 0) -> List[Dict[str, Any]]:
+    """Torn-down clusters, newest teardown first. Paginated: row_id
+    breaks torn-down-at ties so pages never overlap or skip."""
+    rows = _read(
+        'SELECT name, launched_at, torn_down_at, duration_s, handle, '
+        'workspace FROM cluster_history '
+        'ORDER BY torn_down_at DESC, row_id DESC' +
+        _page_sql(limit, offset))
     out = []
     for name, launched_at, torn_down_at, duration_s, handle, ws in rows:
         out.append({
@@ -388,26 +475,70 @@ def _row_to_record(row) -> Dict[str, Any]:
 
 def get_cluster_from_name(
         cluster_name: str) -> Optional[Dict[str, Any]]:
-    conn = _get_conn()
-    with _lock:
-        row = conn.execute(
-            f'SELECT {_CLUSTER_COLS} FROM clusters WHERE name=?',
-            (cluster_name,)).fetchone()
+    row = _read_one(
+        f'SELECT {_CLUSTER_COLS} FROM clusters WHERE name=?',
+        (cluster_name,))
     return _row_to_record(row) if row else None
 
 
-def get_clusters(workspace: Optional[str] = None) -> List[Dict[str, Any]]:
-    conn = _get_conn()
-    with _lock:
-        if workspace is None:
-            rows = conn.execute(
-                f'SELECT {_CLUSTER_COLS} FROM clusters '
-                'ORDER BY launched_at DESC').fetchall()
-        else:
-            rows = conn.execute(
-                f'SELECT {_CLUSTER_COLS} FROM clusters WHERE workspace=? '
-                'ORDER BY launched_at DESC', (workspace,)).fetchall()
-    return [_row_to_record(r) for r in rows]
+def get_clusters(workspace: Optional[str] = None,
+                 names: Optional[List[str]] = None,
+                 limit: Optional[int] = None,
+                 offset: int = 0) -> List[Dict[str, Any]]:
+    """Cluster records, newest launch first (name breaks ties so pages
+    are stable). `names` pushes the filter into SQL — a point `status
+    CLUSTER` must not scan and unpickle a 5k-row fleet — and
+    limit/offset page the listing the same way on every layer above
+    (core.status → the `status` verb → sdk/cli)."""
+    from skypilot_tpu.utils import db_utils
+    if names is not None and not names:
+        return []
+    if names is not None and len(names) > db_utils.MAX_NAME_PUSHDOWN:
+        # Older sqlite builds cap host parameters at 999; a huge name
+        # list falls back to the pre-pushdown Python-side filter
+        # (applied BEFORE limit/offset so pages stay correct).
+        name_set = set(names)
+        records = [r for r in get_clusters(workspace=workspace)
+                   if r['name'] in name_set]
+        return db_utils.page_rows(records, limit, offset)
+    conds, args = [], []
+    if workspace is not None:
+        conds.append('workspace=?')
+        args.append(workspace)
+    if names is not None:
+        conds.append(f"name IN ({','.join('?' * len(names))})")
+        args += list(names)
+    query = f'SELECT {_CLUSTER_COLS} FROM clusters'
+    if conds:
+        query += ' WHERE ' + ' AND '.join(conds)
+    query += ' ORDER BY launched_at DESC, name' + _page_sql(limit, offset)
+    return [_row_to_record(r) for r in _read(query, args)]
+
+
+def count_clusters(workspace: Optional[str] = None) -> int:
+    """Fleet size without touching a single handle blob (pagination
+    UIs and the bench's seed verification)."""
+    if workspace is None:
+        row = _read_one('SELECT COUNT(*) FROM clusters')
+    else:
+        row = _read_one('SELECT COUNT(*) FROM clusters WHERE workspace=?',
+                        (workspace,))
+    return int(row[0]) if row else 0
+
+
+def get_cluster_names(status: Optional[ClusterStatus] = None,
+                      limit: Optional[int] = None) -> List[str]:
+    """Projection for callers that only need names (the `/metrics`
+    live-cluster filter, reapers): no handle unpickling, served by the
+    clusters(status) index instead of a full row scan."""
+    if status is None:
+        rows = _read('SELECT name FROM clusters ORDER BY name' +
+                     _page_sql(limit))
+    else:
+        rows = _read(
+            'SELECT name FROM clusters WHERE status=? ORDER BY name' +
+            _page_sql(limit), (status.value,))
+    return [r[0] for r in rows]
 
 
 def get_handle_from_cluster_name(cluster_name: str) -> Optional[Any]:
@@ -435,50 +566,85 @@ _MAX_RECOVERY_EVENTS = 20000
 # can't gate it — psycopg2 reports 0 for ordinary-table inserts.
 _recovery_event_inserts = 0
 
+# Write coalescing (XSKY_JOURNAL_FLUSH_S > 0): journal appends buffer
+# in-process and land in ONE transaction per window — the same batching
+# record_spans/heartbeat_leases already do — so a chaos storm or a
+# reconcile sweep costs one fsync per tick, not one per event. Default
+# 0 keeps per-event commits (maximum durability; the journal is crash
+# forensics). get_recovery_events flushes first, so in-process
+# read-your-writes always holds; cross-process readers lag ≤ window.
+_JOURNAL_FLUSH_ENV = 'XSKY_JOURNAL_FLUSH_S'
+_JOURNAL_BUF_MAX = 64
+_journal_buf: List[tuple] = []
+_journal_buf_lock = threading.Lock()
+_journal_buf_oldest = 0.0
+_journal_atexit_registered = False
+_journal_flusher_started = False
 
-def record_recovery_event(event_type: str,
-                          scope: str,
-                          cause: Optional[str] = None,
-                          latency_s: Optional[float] = None,
-                          detail: Optional[Dict[str, Any]] = None,
-                          trace_id: Optional[str] = None) -> None:
-    """Append one journal row. NEVER raises: the journal is
-    observability — a recovery path must not die because the state DB
-    hiccuped while it was busy recovering.
 
-    scope is a '/'-separated path (``job/3``, ``cluster/my-train``,
-    ``service/svc/replica/2``, ``chaos/<point>``) so callers can filter
-    by prefix. The active trace id (if any) is recorded automatically
-    so `xsky events` rows cross-link to `xsky trace`.
-    """
+def _ensure_journal_flusher(window: float) -> None:
+    """Background flusher (started lazily with the first buffered
+    append): without it the LAST event before an idle period would
+    stay invisible to cross-process readers (`xsky events` in another
+    process) until the next append — the timer bounds that lag to
+    ~window as documented. Daemon thread; clean exits still flush via
+    atexit. A SIGKILL can lose up to one window of buffered rows —
+    the documented coalescing trade; run with the default
+    XSKY_JOURNAL_FLUSH_S=0 (commit per event) where that matters."""
+    global _journal_flusher_started
+    if _journal_flusher_started:
+        return
+    _journal_flusher_started = True
+
+    def loop():
+        from skypilot_tpu.utils import resilience
+        while True:
+            resilience.sleep(max(window, 0.1))
+            try:
+                with _journal_buf_lock:
+                    due = (_journal_buf and
+                           time.time() - _journal_buf_oldest
+                           >= _journal_flush_window_s())
+                if due:
+                    _flush_journal_buffer()
+            except Exception:  # pylint: disable=broad-except
+                pass  # never-raise discipline, like every journal path
+
+    threading.Thread(target=loop, name='xsky-journal-flush',
+                     daemon=True).start()
+
+
+def _journal_flush_window_s() -> float:
+    try:
+        return float(os.environ.get(_JOURNAL_FLUSH_ENV, '0'))
+    except ValueError:
+        return 0.0
+
+
+def _write_journal_rows(rows: List[tuple]) -> None:
+    """Persist journal rows in one transaction. NEVER raises (same
+    contract as record_spans: observability must not kill recovery)."""
     global _recovery_event_inserts
-    if trace_id is None:
-        try:
-            from skypilot_tpu.utils import tracing
-            trace_id = tracing.current_trace_id()
-        except Exception:  # pylint: disable=broad-except
-            trace_id = None
+    if not rows:
+        return
     try:
         conn = _get_conn()
     except Exception:  # pylint: disable=broad-except
         return
     try:
         with _lock:
-            conn.execute(
+            conn.executemany(
                 'INSERT INTO recovery_events '
                 '(ts, event_type, scope, cause, latency_s, detail, '
-                'trace_id) VALUES (?, ?, ?, ?, ?, ?, ?)',
-                (time.time(), event_type, scope, cause, latency_s,
-                 json.dumps(detail) if detail is not None else None,
-                 trace_id))
+                'trace_id) VALUES (?, ?, ?, ?, ?, ?, ?)', rows)
             # Retention: a days-long capacity drought writes one row per
             # failed attempt — keep the newest window, same rationale as
             # the failover-history cap. Prune on the FIRST insert too:
             # most writers (CLI, per-job controllers) are short-lived
             # processes that would never reach the amortized gate.
-            _recovery_event_inserts += 1
-            if _recovery_event_inserts == 1 or \
-                    _recovery_event_inserts % 256 == 0:
+            _recovery_event_inserts += len(rows)
+            if _recovery_event_inserts == len(rows) or \
+                    _recovery_event_inserts % 256 < len(rows):
                 conn.execute(
                     'DELETE FROM recovery_events WHERE event_id <= '
                     '(SELECT MAX(event_id) FROM recovery_events) - ?',
@@ -494,16 +660,78 @@ def record_recovery_event(event_type: str,
             pass
 
 
+def _flush_journal_buffer() -> None:
+    """Drain buffered journal appends to the DB. Never raises."""
+    with _journal_buf_lock:
+        rows = list(_journal_buf)
+        del _journal_buf[:]
+    _write_journal_rows(rows)
+
+
+def record_recovery_event(event_type: str,
+                          scope: str,
+                          cause: Optional[str] = None,
+                          latency_s: Optional[float] = None,
+                          detail: Optional[Dict[str, Any]] = None,
+                          trace_id: Optional[str] = None) -> None:
+    """Append one journal row. NEVER raises: the journal is
+    observability — a recovery path must not die because the state DB
+    hiccuped while it was busy recovering.
+
+    scope is a '/'-separated path (``job/3``, ``cluster/my-train``,
+    ``service/svc/replica/2``, ``chaos/<point>``) so callers can filter
+    by prefix. The active trace id (if any) is recorded automatically
+    so `xsky events` rows cross-link to `xsky trace`.
+
+    With ``XSKY_JOURNAL_FLUSH_S`` set, appends coalesce in-process and
+    commit once per window/64 rows (see _write_journal_rows) — the
+    high-QPS API-server setting, where per-event fsyncs were measured
+    contending with every other state write.
+    """
+    global _journal_buf_oldest, _journal_atexit_registered
+    if trace_id is None:
+        try:
+            from skypilot_tpu.utils import tracing
+            trace_id = tracing.current_trace_id()
+        except Exception:  # pylint: disable=broad-except
+            trace_id = None
+    now = time.time()
+    row = (now, event_type, scope, cause, latency_s,
+           json.dumps(detail) if detail is not None else None, trace_id)
+    window = _journal_flush_window_s()
+    if window <= 0:
+        _write_journal_rows([row])
+        return
+    flush = False
+    with _journal_buf_lock:
+        if not _journal_buf:
+            _journal_buf_oldest = now
+        _journal_buf.append(row)
+        if not _journal_atexit_registered:
+            # Short-lived writers (CLI, controllers) must not lose
+            # their tail on clean exit.
+            atexit.register(_flush_journal_buffer)
+            _journal_atexit_registered = True
+        if (len(_journal_buf) >= _JOURNAL_BUF_MAX
+                or now - _journal_buf_oldest >= window):
+            flush = True
+    _ensure_journal_flusher(window)
+    if flush:
+        _flush_journal_buffer()
+
+
 def get_recovery_events(scope: Optional[str] = None,
                         event_type: Optional[str] = None,
                         limit: int = 200,
-                        since: Optional[float] = None
+                        since: Optional[float] = None,
+                        offset: int = 0
                         ) -> List[Dict[str, Any]]:
-    """Newest `limit` events, oldest-first (a readable timeline).
-    `scope` matches exactly or as a path prefix; `since` is a unix
-    timestamp lower bound (``xsky events --since``), so scripts can
-    join the journal with traces over a window."""
-    conn = _get_conn()
+    """Newest `limit` events (after skipping `offset` newer ones),
+    oldest-first (a readable timeline). `scope` matches exactly or as
+    a path prefix; `since` is a unix timestamp lower bound (``xsky
+    events --since``), so scripts can join the journal with traces
+    over a window."""
+    _flush_journal_buffer()   # coalesced appends: read-your-writes
     conds, args = [], []
     if scope is not None:
         # Escape LIKE metacharacters: a cluster named my_train must not
@@ -522,10 +750,8 @@ def get_recovery_events(scope: Optional[str] = None,
              'trace_id FROM recovery_events')
     if conds:
         query += ' WHERE ' + ' AND '.join(conds)
-    query += ' ORDER BY event_id DESC LIMIT ?'
-    args.append(int(limit))
-    with _lock:
-        rows = conn.execute(query, args).fetchall()
+    query += ' ORDER BY event_id DESC' + _page_sql(int(limit), offset)
+    rows = _read(query, args)
     out = []
     for ts, etype, escope, cause, latency, detail, trace_id in \
             reversed(rows):
@@ -601,15 +827,15 @@ def record_spans(rows: List[Dict[str, Any]]) -> None:
             pass
 
 
-def get_spans(trace_id: str, limit: int = 5000) -> List[Dict[str, Any]]:
-    """Every finished span of one trace, ordered by start time."""
-    conn = _get_conn()
-    with _lock:
-        rows = conn.execute(
-            'SELECT trace_id, span_id, parent_span_id, name, start_ts, '
-            'end_ts, status, attrs FROM spans WHERE trace_id=? '
-            'ORDER BY start_ts, row_id LIMIT ?',
-            (trace_id, int(limit))).fetchall()
+def get_spans(trace_id: str, limit: int = 5000,
+              offset: int = 0) -> List[Dict[str, Any]]:
+    """Finished spans of one trace, ordered by start time (row_id
+    breaks ties, so limit/offset pages are stable)."""
+    rows = _read(
+        'SELECT trace_id, span_id, parent_span_id, name, start_ts, '
+        'end_ts, status, attrs FROM spans WHERE trace_id=? '
+        'ORDER BY start_ts, row_id' + _page_sql(int(limit), offset),
+        (trace_id,))
     out = []
     for tid, sid, parent, name, start_ts, end_ts, status, attrs in rows:
         try:
@@ -692,13 +918,13 @@ def record_workload_telemetry(cluster: str, job_id: Optional[int],
 
 def get_workload_telemetry(cluster: Optional[str] = None,
                            latest_only: bool = True,
-                           limit: int = 2000) -> List[Dict[str, Any]]:
+                           limit: int = 2000,
+                           offset: int = 0) -> List[Dict[str, Any]]:
     """Telemetry rows, newest-pull-first per rank.
 
     ``latest_only`` returns ONE row per (cluster, job, rank) — the live
     view `xsky top` renders; ``latest_only=False`` is the history (a
     rank's verdict timeline across a recovery)."""
-    conn = _get_conn()
     conds, args = [], []
     if cluster is not None:
         conds.append('cluster = ?')
@@ -711,10 +937,9 @@ def get_workload_telemetry(cluster: Optional[str] = None,
             query += ' AND ' + ' AND '.join(conds)
     elif conds:
         query += ' WHERE ' + ' AND '.join(conds)
-    query += ' ORDER BY cluster, job_id, rank, row_id DESC LIMIT ?'
-    args.append(int(limit))
-    with _lock:
-        rows = conn.execute(query, args).fetchall()
+    query += (' ORDER BY cluster, job_id, rank, row_id DESC' +
+              _page_sql(int(limit), offset))
+    rows = _read(query, args)
     out = []
     for (ts, cl, job_id, rank, phase, step, step_ema, tps, mem,
          started_ts, progress_ts, hb_ts, verdict) in rows:
@@ -744,13 +969,11 @@ def find_trace_ids(needle: str, limit: int = 5) -> List[str]:
     escaped = (needle.replace('\\', '\\\\').replace('%', '\\%')
                .replace('_', '\\_'))
     pattern = f'%{escaped}%'
-    conn = _get_conn()
-    with _lock:
-        rows = conn.execute(
-            'SELECT trace_id, MAX(row_id) AS newest FROM spans '
-            "WHERE attrs LIKE ? ESCAPE '\\' OR name LIKE ? ESCAPE '\\' "
-            'GROUP BY trace_id ORDER BY newest DESC LIMIT ?',
-            (pattern, pattern, int(limit))).fetchall()
+    rows = _read(
+        'SELECT trace_id, MAX(row_id) AS newest FROM spans '
+        "WHERE attrs LIKE ? ESCAPE '\\' OR name LIKE ? ESCAPE '\\' "
+        'GROUP BY trace_id ORDER BY newest DESC LIMIT ?',
+        (pattern, pattern, int(limit)))
     return [r[0] for r in rows]
 
 
@@ -848,21 +1071,18 @@ def _lease_dict(row) -> Dict[str, Any]:
 
 
 def get_lease(scope: str) -> Optional[Dict[str, Any]]:
-    conn = _get_conn()
-    with _lock:
-        row = conn.execute(
-            'SELECT scope, owner, pid, started_at, expires_at '
-            'FROM liveness_leases WHERE scope=?', (scope,)).fetchone()
+    row = _read_one(
+        'SELECT scope, owner, pid, started_at, expires_at '
+        'FROM liveness_leases WHERE scope=?', (scope,))
     return _lease_dict(row) if row else None
 
 
 def list_leases(prefix: Optional[str] = None) -> List[Dict[str, Any]]:
     """All lease rows, optionally filtered by scope path prefix."""
-    conn = _get_conn()
-    with _lock:
-        rows = conn.execute(
-            'SELECT scope, owner, pid, started_at, expires_at '
-            'FROM liveness_leases ORDER BY scope').fetchall()
+    # full-scan ok: one row per live actor (controllers + in-flight
+    # requests), bounded by the executor's admission slots.
+    rows = _read('SELECT scope, owner, pid, started_at, expires_at '
+                 'FROM liveness_leases ORDER BY scope')
     leases = [_lease_dict(r) for r in rows]
     if prefix is not None:
         prefix = prefix.rstrip('/') + '/'
@@ -913,9 +1133,9 @@ def remove_storage(storage_name: str) -> None:
 
 
 def get_storage() -> List[Dict[str, Any]]:
-    conn = _get_conn()
-    with _lock:
-        rows = conn.execute('SELECT * FROM storage').fetchall()
+    # full-scan ok: storage mounts are per-task artifacts, a handful
+    # of rows even on busy deployments.
+    rows = _read('SELECT * FROM storage')
     return [{
         'name': r[0],
         'launched_at': r[1],
@@ -945,9 +1165,8 @@ def set_enabled_clouds(clouds: List[str]) -> None:
 
 
 def get_enabled_clouds() -> List[str]:
-    conn = _get_conn()
-    with _lock:
-        rows = conn.execute('SELECT cloud FROM enabled_clouds').fetchall()
+    # full-scan ok: one row per enabled cloud (single digits).
+    rows = _read('SELECT cloud FROM enabled_clouds')
     return [r[0] for r in rows]
 
 
@@ -969,11 +1188,9 @@ def add_user(name: str, password_hash: str, salt: str,
 
 
 def get_user(name: str) -> Optional[Dict[str, Any]]:
-    conn = _get_conn()
-    with _lock:
-        row = conn.execute(
-            'SELECT name, password_hash, salt, role, created_at '
-            'FROM users WHERE name=?', (name,)).fetchone()
+    row = _read_one(
+        'SELECT name, password_hash, salt, role, created_at '
+        'FROM users WHERE name=?', (name,))
     if row is None:
         return None
     return {'name': row[0], 'password_hash': row[1], 'salt': row[2],
@@ -981,11 +1198,9 @@ def get_user(name: str) -> Optional[Dict[str, Any]]:
 
 
 def list_users() -> List[Dict[str, Any]]:
-    conn = _get_conn()
-    with _lock:
-        rows = conn.execute(
-            'SELECT name, role, created_at FROM users '
-            'ORDER BY name').fetchall()
+    # full-scan ok: admin roster listing, rows are human accounts.
+    rows = _read('SELECT name, role, created_at FROM users '
+                 'ORDER BY name')
     return [{'name': r[0], 'role': r[1], 'created_at': r[2]} for r in rows]
 
 
@@ -1040,17 +1255,14 @@ def get_api_token(token_hash: str) -> Optional[Dict[str, Any]]:
 
 def list_api_tokens(user_name: Optional[str] = None
                     ) -> List[Dict[str, Any]]:
-    conn = _get_conn()
-    with _lock:
-        if user_name is None:
-            rows = conn.execute(
-                'SELECT user_name, label, created_at, last_used_at '
-                'FROM api_tokens ORDER BY user_name, label').fetchall()
-        else:
-            rows = conn.execute(
-                'SELECT user_name, label, created_at, last_used_at '
-                'FROM api_tokens WHERE user_name=? ORDER BY label',
-                (user_name,)).fetchall()
+    # full-scan ok: a few labeled tokens per human account.
+    if user_name is None:
+        rows = _read('SELECT user_name, label, created_at, last_used_at '
+                     'FROM api_tokens ORDER BY user_name, label')
+    else:
+        rows = _read('SELECT user_name, label, created_at, last_used_at '
+                     'FROM api_tokens WHERE user_name=? ORDER BY label',
+                     (user_name,))
     return [{'user_name': r[0], 'label': r[1], 'created_at': r[2],
              'last_used_at': r[3]} for r in rows]
 
@@ -1087,10 +1299,8 @@ def add_workspace(name: str) -> None:
 
 
 def list_workspaces() -> List[str]:
-    conn = _get_conn()
-    with _lock:
-        rows = conn.execute(
-            'SELECT name FROM workspaces ORDER BY name').fetchall()
+    # full-scan ok: workspaces are org-level groupings, tens of rows.
+    rows = _read('SELECT name FROM workspaces ORDER BY name')
     return [r[0] for r in rows]
 
 
@@ -1127,20 +1337,17 @@ def remove_workspace_member(workspace: str, user_name: str) -> bool:
 
 
 def list_workspace_members(workspace: str) -> List[str]:
-    conn = _get_conn()
-    with _lock:
-        rows = conn.execute(
-            'SELECT user_name FROM workspace_members WHERE workspace=? '
-            'ORDER BY user_name', (workspace,)).fetchall()
+    # full-scan ok: per-workspace roster, rows are human members.
+    rows = _read(
+        'SELECT user_name FROM workspace_members WHERE workspace=? '
+        'ORDER BY user_name', (workspace,))
     return [r[0] for r in rows]
 
 
 def is_workspace_member(workspace: str, user_name: str) -> bool:
-    conn = _get_conn()
-    with _lock:
-        row = conn.execute(
-            'SELECT 1 FROM workspace_members WHERE workspace=? AND '
-            'user_name=?', (workspace, user_name)).fetchone()
+    row = _read_one(
+        'SELECT 1 FROM workspace_members WHERE workspace=? AND '
+        'user_name=?', (workspace, user_name))
     return row is not None
 
 
@@ -1155,9 +1362,6 @@ def set_workspace_config(workspace: str, config_json: str) -> None:
 
 
 def get_workspace_config(workspace: str) -> Optional[str]:
-    conn = _get_conn()
-    with _lock:
-        row = conn.execute(
-            'SELECT config_json FROM workspace_configs WHERE '
-            'workspace=?', (workspace,)).fetchone()
+    row = _read_one('SELECT config_json FROM workspace_configs WHERE '
+                    'workspace=?', (workspace,))
     return row[0] if row else None

@@ -836,6 +836,119 @@ class TestSpanCoverageLint:
         assert self._uncovered_retry_loops(clean) == []
 
 
+class TestListingLimitLint:
+    """Every listing function (``.fetchall()`` over a SELECT) in the
+    shared state modules must page — carry a ``LIMIT`` in its SQL — or
+    declare why a full scan is safe with a ``# full-scan ok:`` comment
+    naming the bound. The state DB serves a 5k-cluster fleet at QPS:
+    an unpaged listing added casually is the next `status` full-scan
+    regression (see docs/performance.md, control-plane scale)."""
+
+    MODULES = [
+        'skypilot_tpu/state.py',
+        'skypilot_tpu/server/requests_db.py',
+    ]
+    EXEMPT_MARK = '# full-scan ok'
+
+    # Calls that mark a function as a multi-row listing: a direct
+    # cursor fetchall, or the state modules' _read()/fetchall facade
+    # (every listing in state.py/requests_db.py routes through it —
+    # a fetchall-only lint would inspect zero functions there).
+    LISTING_CALLS = {'fetchall', '_read'}
+
+    @classmethod
+    def _unpaged_listing_functions(cls, source):
+        """(name, lineno) of module-level functions that run a
+        multi-row SELECT with no LIMIT and no declared full-scan
+        exemption."""
+        tree = ast.parse(source)
+        lines = source.splitlines()
+        offenders = []
+        for node in tree.body:
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if node.name in cls.LISTING_CALLS:
+                continue   # the facade's own definition
+            is_listing = False
+            sql_chunks = []
+            for child in ast.walk(node):
+                if isinstance(child, ast.Call):
+                    func = child.func
+                    name = func.attr if isinstance(func, ast.Attribute) \
+                        else getattr(func, 'id', '')
+                    if name in cls.LISTING_CALLS:
+                        is_listing = True
+                if isinstance(child, ast.Constant) and \
+                        isinstance(child.value, str):
+                    sql_chunks.append(child.value)
+            sql = ' '.join(sql_chunks)
+            # Both tokens: a docstring mentioning SELECT (the _read
+            # helper's contract) is not a query.
+            if not is_listing or 'SELECT' not in sql \
+                    or 'FROM' not in sql:
+                continue
+            # _page_sql() appends the LIMIT clause at runtime; its
+            # presence in the function body counts as paged.
+            calls_page_sql = any(
+                isinstance(child, ast.Call) and (
+                    getattr(child.func, 'id', '') == '_page_sql' or
+                    getattr(child.func, 'attr', '') == '_page_sql')
+                for child in ast.walk(node))
+            body_src = '\n'.join(
+                lines[node.lineno - 1:node.end_lineno])
+            if ('LIMIT' in sql or calls_page_sql or
+                    cls.EXEMPT_MARK in body_src):
+                continue
+            offenders.append((node.name, node.lineno))
+        return offenders
+
+    def test_state_listing_functions_are_paged_or_exempt(self):
+        repo_root = os.path.join(os.path.dirname(__file__), '..', '..')
+        violations = []
+        for rel in self.MODULES:
+            with open(os.path.join(repo_root, rel),
+                      encoding='utf-8') as f:
+                source = f.read()
+            violations.extend(
+                f'{rel}:{line} ({name})'
+                for name, line in
+                self._unpaged_listing_functions(source))
+        assert not violations, (
+            'SELECT listing without a LIMIT (or a `# full-scan ok:` '
+            'exemption naming the bound) — unpaged listings are how '
+            'status full-scans come back:\n  ' + '\n  '.join(violations))
+
+    def test_lint_catches_an_unpaged_listing(self):
+        bad = ('def list_things(conn):\n'
+               "    return conn.execute('SELECT x FROM t').fetchall()\n")
+        assert self._unpaged_listing_functions(bad) == \
+            [('list_things', 1)]
+        # The facade form the state modules actually use is covered
+        # too (a fetchall-only lint would miss every one of them).
+        bad_facade = ('def list_things():\n'
+                      "    return _read('SELECT x FROM t')\n")
+        assert self._unpaged_listing_functions(bad_facade) == \
+            [('list_things', 1)]
+        paged = ('def list_things(conn):\n'
+                 "    return conn.execute('SELECT x FROM t LIMIT 5')"
+                 '.fetchall()\n')
+        assert self._unpaged_listing_functions(paged) == []
+        helper = ('def list_things(conn):\n'
+                  "    q = 'SELECT x FROM t' + _page_sql(None)\n"
+                  '    return conn.execute(q).fetchall()\n')
+        assert self._unpaged_listing_functions(helper) == []
+        exempt = ('def list_things(conn):\n'
+                  '    # full-scan ok: one row per enabled cloud.\n'
+                  "    return conn.execute('SELECT x FROM t')"
+                  '.fetchall()\n')
+        assert self._unpaged_listing_functions(exempt) == []
+        point = ('def get_thing(conn):\n'
+                 "    return conn.execute('SELECT x FROM t')"
+                 '.fetchone()\n')
+        assert self._unpaged_listing_functions(point) == []
+
+
 class TestChaosSmoke:
     """The acceptance scenario, deterministic and hermetic (tier-1):
     a seeded plan injects (a) an rc-255 SSH drop on a gang host during
