@@ -552,6 +552,32 @@ def _telemetry_tail(env: dict) -> Optional[dict]:
     except Exception:  # pylint: disable=broad-except
         return None
     now = time.time()
+
+    def _profile_tail(s: dict) -> Optional[dict]:
+        """Latest device-profile summary riding the spool sample
+        (skypilot_tpu/agent/profiler.py): the step-anatomy digest that
+        turns a bare backend_init/run timeout into a diagnosis —
+        was the child recompiling forever, host-dispatch-bound, or
+        out of HBM when it hung?"""
+        prof = s.get('profile')
+        if not isinstance(prof, dict):
+            return None
+        from skypilot_tpu.agent import profiler
+        peak = profiler.hbm_watermark(prof)
+        return {
+            'dispatch_gap_ratio': prof.get('dispatch_gap_ratio'),
+            'dispatch_gap_ema_s': prof.get('dispatch_gap_ema_s'),
+            'device_ema_s': prof.get('device_ema_s'),
+            'compiles': prof.get('compiles_total'),
+            'compile_seconds': prof.get('compile_seconds_total'),
+            'compiles_after_warmup': prof.get('compiles_after_warmup'),
+            'hbm_peak_gib': (round(peak / (1 << 30), 3)
+                             if peak else None),
+            'hbm_limit_gib': (round(prof['hbm_bytes_limit'] / (1 << 30),
+                                    3)
+                              if prof.get('hbm_bytes_limit') else None),
+        }
+
     return {
         str(rank): {
             'phase': s.get('phase'),
@@ -559,6 +585,7 @@ def _telemetry_tail(env: dict) -> Optional[dict]:
             'hb_age_s': round(now - (s.get('hb_ts') or 0), 1),
             'progress_age_s': round(
                 now - (s.get('last_progress_ts') or 0), 1),
+            'profile': _profile_tail(s),
         } for rank, s in sorted(samples.items())
     } or None
 

@@ -563,6 +563,16 @@ def record_samples(cluster: str, job_id: Optional[int],
             _last_seen[key] = (result[rank], s.get('step'))
     except Exception:  # pylint: disable=broad-except
         pass
+    try:
+        # Device-profile summaries ride the same spool samples (the
+        # `profile` key); one pull feeds both planes. Ranks without a
+        # profiler are simply absent from the profiles table.
+        from skypilot_tpu.agent import profiler
+        from skypilot_tpu.utils import tracing
+        with tracing.span('profiler.pull', cluster=cluster, job=job_id):
+            profiler.record_profiles(cluster, job_id, samples, now=now)
+    except Exception:  # pylint: disable=broad-except
+        pass
     return result
 
 

@@ -206,8 +206,9 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
         del handle  # the runner class encodes the provider layout
         return runner.remote_runtime_root()
 
-    def _head_python(self, handle: ClusterHandle) -> str:
-        """Python invocation for agent/job commands on the head host.
+    def _python_for(self, handle: ClusterHandle,
+                    runner: runner_lib.CommandRunner) -> str:
+        """Python invocation for agent commands on one host.
 
         Resolved remotely at run time: clusters launched before the
         bootstrap era have no venv yet, so fall back to the host python
@@ -215,9 +216,12 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
         """
         if not self._bootstraps(handle):
             return 'python'  # repo on PYTHONPATH (see _agent_env)
-        root = self._host_runtime_root(handle, handle.head_runner())
+        root = self._host_runtime_root(handle, runner)
         venv_py = f'{root}/venv/bin/python'
         return f'$([ -x {venv_py} ] && echo {venv_py} || echo python)'
+
+    def _head_python(self, handle: ClusterHandle) -> str:
+        return self._python_for(handle, handle.head_runner())
 
     def _agent_env(self, handle: ClusterHandle) -> Dict[str, str]:
         env = {'XSKY_CLUSTER_ROOT': handle.head_runtime_root}
@@ -636,6 +640,57 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
         except exceptions.MultiHostError:
             pass
         return samples
+
+    def capture_device_profile(self, handle: ClusterHandle,
+                               job_id: Optional[int] = None,
+                               duration_s: float = 1.0
+                               ) -> Dict[int, Dict[str, Any]]:
+        """Run one on-demand deep device capture on EVERY host in one
+        fan-out: {rank: capture summary}. Artifacts (jax.profiler
+        trace, capture.json) stay on each host under
+        ``<runtime_root>/profiles/``; the one-line JSON summary each
+        agent prints comes back. A partial fan-out failure costs the
+        missing ranks, not the capture.
+        """
+        from skypilot_tpu.agent import profiler as profiler_lib
+        runners = handle.get_command_runners()
+        results: Dict[int, Dict[str, Any]] = {}
+        env = self._agent_env(handle)
+        # The fake-profiler seam must reach the remote agent process:
+        # the control plane's seam env rides along explicitly (SSH
+        # hosts don't inherit our environment).
+        for key, value in os.environ.items():
+            if key.startswith('XSKY_PROFILER_'):
+                env[key] = value
+
+        def _capture(pair):
+            rank, runner = pair
+            root = runner.remote_runtime_root()
+            out_dir = (f'{root}/profiles/job-{job_id or 0}/'
+                       f'rank-{rank}-{int(time.time())}')
+            cmd = (f'{self._python_for(handle, runner)} -m '
+                   f'skypilot_tpu.agent.profiler capture '
+                   f'--out {out_dir} --duration {duration_s}')
+            rc, out, _ = runner.run(cmd, env=env, require_outputs=True)
+            if rc != 0 or not out.strip():
+                return
+            try:
+                summary = json.loads(out.strip().splitlines()[-1])
+            except ValueError:
+                return
+            if isinstance(summary, dict):
+                summary['rank'] = rank
+                results[rank] = profiler_lib.capture_summary_row(summary)
+
+        try:
+            with tracing.span('backend.profile_capture',
+                              cluster=handle.cluster_name, job=job_id):
+                parallelism.run_in_parallel(
+                    _capture, list(enumerate(runners)),
+                    phase='profile_capture', what='profile capture')
+        except exceptions.MultiHostError:
+            pass
+        return results
 
     def _maybe_pull_telemetry(self, handle: ClusterHandle, job_id: int,
                               pull_state: Dict[str, float]) -> None:

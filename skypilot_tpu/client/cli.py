@@ -640,10 +640,14 @@ def trace_cmd(target, as_json, limit):
 
 def _top_rows(cluster: Optional[str]) -> List[dict]:
     """Latest per-rank telemetry rows annotated with ages + straggler
-    flags (shared by the table and --json renderers)."""
+    flags + the rank's step-anatomy profile block (shared by the table
+    and --json renderers)."""
     from skypilot_tpu import state as state_lib
     from skypilot_tpu.agent import telemetry
     rows = state_lib.get_workload_telemetry(cluster=cluster)
+    profs = {(p['cluster'], p['job_id'], p['rank']): p
+             for p in state_lib.get_profiles(cluster=cluster,
+                                             kind='summary')}
     by_cluster: dict = {}
     for row in rows:
         by_cluster.setdefault((row['cluster'], row['job_id']),
@@ -655,6 +659,7 @@ def _top_rows(cluster: Optional[str]) -> List[dict]:
         goodput = telemetry.goodput_for_cluster(cl, ranks)
         for rank, row in sorted(ranks.items()):
             pulled = row['ts'] or 0
+            prof = profs.get((cl, job_id, rank))
             out.append(dict(
                 row,
                 # Ages at PULL time: the spool truth when last read
@@ -664,7 +669,11 @@ def _top_rows(cluster: Optional[str]) -> List[dict]:
                     pulled - (row['last_progress_ts'] or 0), 1),
                 straggler=rank in lagging,
                 rank_skew=skew,
-                goodput=goodput.get('goodput')))
+                goodput=goodput.get('goodput'),
+                dispatch_gap_ratio=(prof or {}).get(
+                    'dispatch_gap_ratio'),
+                # Full step-anatomy block for --json consumers.
+                profile=prof))
     return out
 
 
@@ -690,6 +699,8 @@ def top(cluster, watch, interval, as_json):
     """
     import time as time_lib
 
+    from skypilot_tpu.agent import profiler as profiler_lib
+
     def render_once():
         rows = _top_rows(cluster)
         if as_json:
@@ -701,11 +712,11 @@ def top(cluster, watch, interval, as_json):
                        + (f' for {cluster!r}.' if cluster else '.'))
             return
         now = time_lib.time()
-        fmt = ('{:<20} {:>4} {:>5} {:<6} {:>8} {:>10} {:>9} {:>7} '
-               '{:>8} {:<7}')
+        fmt = ('{:<20} {:>4} {:>5} {:<6} {:>8} {:>10} {:>9} {:>9} '
+               '{:>7} {:>8} {:<7}')
         click.echo(fmt.format('CLUSTER', 'JOB', 'RANK', 'PHASE',
-                              'STEP', 'STEP_TIME', 'TOK/S', 'MEM_MB',
-                              'HB_AGE', 'VERDICT'))
+                              'STEP', 'STEP_TIME', 'TOK/S', 'DISPATCH%',
+                              'MEM_MB', 'HB_AGE', 'VERDICT'))
         for row in rows:
             step_time = (f'{row["step_time_ema_s"]:.3f}s'
                          if row['step_time_ema_s'] else '-')
@@ -713,15 +724,18 @@ def top(cluster, watch, interval, as_json):
                 step_time += '~'
             tps = (f'{row["tokens_per_sec"]:,.0f}'
                    if row['tokens_per_sec'] else '-')
+            disp = (f'{row["dispatch_gap_ratio"]:.0%}'
+                    if row.get('dispatch_gap_ratio') is not None
+                    else '-')
             mem = (f'{row["host_mem_mb"]:.0f}'
                    if row['host_mem_mb'] else '-')
             click.echo(fmt.format(
                 row['cluster'][:20], str(row['job_id'] or '-'),
                 row['rank'], (row['phase'] or '-')[:6],
                 str(row['step'] if row['step'] is not None else '-'),
-                step_time, tps, mem, _age_str(row['hb_age_s']),
+                step_time, tps, disp, mem, _age_str(row['hb_age_s']),
                 row['verdict'] or '-'))
-        # Per-gang summary: skew + goodput + data freshness.
+        # Per-gang summary: skew + goodput + HBM + data freshness.
         gangs = sorted({(r['cluster'], r['job_id']) for r in rows},
                        key=str)
         for key in gangs:
@@ -731,10 +745,15 @@ def top(cluster, watch, interval, as_json):
             stalls = sum(1 for r in group if r['verdict'] != 'ok')
             goodput = (f'{first["goodput"]:.1%}'
                        if first.get('goodput') is not None else '-')
+            peaks = [profiler_lib.hbm_watermark(r.get('profile') or {})
+                     for r in group]
+            peaks = [p for p in peaks if p]
+            hbm = (f'{max(peaks) / (1 << 30):.1f}GiB'
+                   if peaks else '-')
             click.echo(
                 f'  {first["cluster"]} job {first["job_id"]}: '
                 f'{len(group)} rank(s), skew={first["rank_skew"]}, '
-                f'goodput={goodput}, stalled={stalls}, '
+                f'goodput={goodput}, hbm={hbm}, stalled={stalls}, '
                 f'pulled {_age_str(now - (first["ts"] or 0))} ago')
 
     if not watch:
@@ -747,6 +766,136 @@ def top(cluster, watch, interval, as_json):
             time_lib.sleep(max(interval, 0.2))
     except KeyboardInterrupt:
         pass
+
+
+def _profile_digest(group: List[dict]) -> str:
+    """One gang's cross-rank step-anatomy digest: dispatch skew,
+    slowest rank, verdict roll-up."""
+    ratios = {r['rank']: r['dispatch_gap_ratio'] for r in group
+              if r.get('dispatch_gap_ratio') is not None}
+    devices = {r['rank']: r['device_ema_s'] for r in group
+               if r.get('device_ema_s') is not None}
+    parts = [f'{len(group)} rank(s)']
+    if ratios:
+        skew = max(ratios.values()) - min(ratios.values())
+        parts.append(f'dispatch skew={skew:.0%}')
+    if devices:
+        slowest = max(devices, key=devices.get)
+        parts.append(f'slowest rank {slowest}: '
+                     f'{devices[slowest] * 1000:.1f}ms device')
+    verdicts = sorted({v for r in group for v in (r['verdicts'] or [])})
+    parts.append('verdicts=' + (','.join(verdicts) if verdicts
+                                else 'none'))
+    return ', '.join(parts)
+
+
+@cli.command(name='profile')
+@click.argument('cluster', required=False)
+@click.option('--job', type=int, default=None,
+              help='Only this job id.')
+@click.option('--rank', type=int, default=None,
+              help='Only this rank.')
+@click.option('--capture', is_flag=True, default=False,
+              help='Trigger an on-demand deep device capture on every '
+                   'host first (dispatch RTT, device step time, '
+                   'compile probe, HBM; jax.profiler trace left on '
+                   'each host).')
+@click.option('--duration', type=float, default=1.0,
+              help='Capture budget per host (seconds), with '
+                   '--capture.')
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='One JSON object per profile row (joinable with '
+                   '`xsky top --json` / `xsky events --json`).')
+def profile(cluster, job, rank, capture, duration, as_json):
+    """Per-rank device step anatomy: dispatch gap vs device compute,
+    compile count/seconds, HBM watermarks, and the derived verdicts.
+
+    Rows come from the profiles table (each rank's always-on sampler
+    spools a summary next to its telemetry sample; the control plane
+    pulls both together). Verdicts: `host-bound` — the host dispatch
+    gap dominates device compute (the per-token-dispatch serving
+    case); `recompile-storm` — XLA compiles still firing after warmup
+    (a shape leak); `hbm-pressure` — peak bytes-in-use near the device
+    limit; `stale` — the summary lags the rank's own heartbeat.
+    """
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu.agent import profiler as profiler_lib
+    if capture:
+        if not cluster:
+            raise click.UsageError('--capture needs a CLUSTER.')
+        from skypilot_tpu.client import sdk
+        summaries = sdk.profile_capture(cluster, job_id=job,
+                                        duration_s=duration)
+        if not as_json:
+            click.echo(f'Captured {len(summaries)} rank(s).')
+    rows = state_lib.get_profiles(cluster=cluster, job_id=job)
+    if rank is not None:
+        rows = [r for r in rows if r['rank'] == rank]
+    if as_json:
+        for row in rows:
+            click.echo(json.dumps(row, default=str))
+        return
+    summaries = [r for r in rows if r['kind'] == 'summary']
+    captures = [r for r in rows if r['kind'] == 'capture']
+    if not rows:
+        click.echo('No profile data recorded'
+                   + (f' for {cluster!r}.' if cluster else '.'))
+        return
+    if summaries:
+        fmt = ('{:<20} {:>4} {:>5} {:>7} {:>9} {:>9} {:>6} {:>8} '
+               '{:>9} {:>8}  {}')
+        click.echo(fmt.format('CLUSTER', 'JOB', 'RANK', 'SAMPLED',
+                              'DISPATCH', 'DEVICE', 'DISP%',
+                              'COMPILES', 'COMPILE_S', 'HBM_GIB',
+                              'VERDICTS'))
+        for row in summaries:
+            gap = (f'{row["dispatch_gap_ema_s"] * 1000:.1f}ms'
+                   if row['dispatch_gap_ema_s'] is not None else '-')
+            dev = (f'{row["device_ema_s"] * 1000:.1f}ms'
+                   if row['device_ema_s'] is not None else '-')
+            ratio = (f'{row["dispatch_gap_ratio"]:.0%}'
+                     if row['dispatch_gap_ratio'] is not None else '-')
+            peak = profiler_lib.hbm_watermark(row)
+            hbm = f'{peak / (1 << 30):.2f}' if peak else '-'
+            click.echo(fmt.format(
+                row['cluster'][:20], str(row['job_id'] or '-'),
+                row['rank'],
+                str(row['steps_sampled']
+                    if row['steps_sampled'] is not None else '-'),
+                gap, dev, ratio,
+                str(row['compiles_total']
+                    if row['compiles_total'] is not None else '-'),
+                (f'{row["compile_seconds_total"]:.2f}'
+                 if row['compile_seconds_total'] is not None else '-'),
+                hbm, ','.join(row['verdicts'] or []) or '-'))
+        # Per-gang digest: the cross-rank view (which rank gates the
+        # gang, how skewed the anatomy is, what the verdicts agree on).
+        gangs = sorted({(r['cluster'], r['job_id'])
+                        for r in summaries}, key=str)
+        for key in gangs:
+            group = [r for r in summaries
+                     if (r['cluster'], r['job_id']) == key]
+            click.echo(f'  {key[0]} job {key[1]}: '
+                       f'{_profile_digest(group)}')
+    if captures:
+        click.echo('')
+        click.echo('Deep captures (latest per rank; artifacts stay on '
+                   'each host):')
+        cfmt = '  {:<20} {:>4} {:>5} {:>9} {:>10} {:>10}  {}'
+        click.echo(cfmt.format('CLUSTER', 'JOB', 'RANK', 'RTT',
+                               'DEVICE', 'COMPILE', 'OUT'))
+        for row in captures:
+            detail = row['detail'] or {}
+            rtt = detail.get('dispatch_rtt_ms')
+            mm = detail.get('device_matmul_ms')
+            click.echo(cfmt.format(
+                row['cluster'][:20], str(row['job_id'] or '-'),
+                row['rank'],
+                f'{rtt:.1f}ms' if rtt is not None else '-',
+                f'{mm:.1f}ms' if mm is not None else '-',
+                (f'{row["compile_seconds_total"]:.2f}s'
+                 if row['compile_seconds_total'] is not None else '-'),
+                detail.get('out_dir') or '-'))
 
 
 @cli.command()

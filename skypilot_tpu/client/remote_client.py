@@ -202,6 +202,16 @@ class RemoteClient:
                                    'job_id': job_id,
                                    'all_ranks': all_ranks})
 
+    def profile_capture(self, cluster_name, job_id=None,
+                        duration_s=1.0):
+        out = self._call('profile.capture',
+                         {'cluster_name': cluster_name,
+                          'job_id': job_id,
+                          'duration_s': duration_s})
+        # JSON object keys arrive as strings; the SDK contract is
+        # int ranks.
+        return {int(k): v for k, v in (out or {}).items()}
+
     def check(self, quiet=False):
         return self._call('check', {})
 

@@ -122,6 +122,15 @@ def cluster_hosts(cluster_name: str) -> List[Dict[str, Any]]:
     return _local_or_remote('cluster_hosts', cluster_name)
 
 
+def profile_capture(cluster_name: str, job_id: Optional[int] = None,
+                    duration_s: float = 1.0) -> Dict[int, Dict[str, Any]]:
+    """On-demand deep device capture on every host (dispatch RTT,
+    device step time, compile probe, HBM stats + a jax.profiler trace
+    left on each host): {rank: summary}, recorded for `xsky profile`."""
+    return _local_or_remote('profile_capture', cluster_name,
+                            job_id=job_id, duration_s=duration_s)
+
+
 def endpoints(cluster_name: str,
               port: Optional[int] = None) -> Dict[int, str]:
     """port → URL for the cluster's opened ports."""

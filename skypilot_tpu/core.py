@@ -285,6 +285,25 @@ def tail_logs(cluster_name: str, job_id: Optional[int] = None,
                                 all_ranks=all_ranks)
 
 
+def profile_capture(cluster_name: str, job_id: Optional[int] = None,
+                    duration_s: float = 1.0) -> Dict[int, Dict[str, Any]]:
+    """On-demand deep device capture on every host of a cluster (one
+    runner fan-out): {rank: capture summary}. Artifacts (jax.profiler
+    trace dirs) stay on the hosts; the summaries are recorded into the
+    bounded profiles table (kind='capture') so `xsky profile` shows
+    them next to the always-on step-anatomy rows."""
+    from skypilot_tpu.agent import profiler
+    from skypilot_tpu.utils import tracing
+    record = _get_handle(cluster_name)
+    with tracing.span('profile.capture', cluster=cluster_name,
+                      job=job_id):
+        summaries = _backend().capture_device_profile(
+            record['handle'], job_id=job_id, duration_s=duration_s)
+        profiler.record_profiles(cluster_name, job_id, summaries,
+                                 kind='capture')
+    return summaries
+
+
 def watch_job_log(cluster_name: str, job_id: int,
                   offset: int = 0) -> Dict[str, Any]:
     """One incremental poll of a cluster job's run.log → {status,

@@ -191,6 +191,11 @@ class InferenceEngine:
                  params: llama.Params,
                  mesh: Optional[mesh_lib.Mesh] = None) -> None:
         from skypilot_tpu import models
+        from skypilot_tpu.agent import profiler
+        # Serving processes count XLA compiles from engine construction
+        # on: the recompile-storm verdict needs every decode-variant
+        # compile attributed, not just post-warmup stragglers.
+        profiler.ensure_compile_listener()
         self._model_lib = models.module_for(config.model)
         # Any family exposing prefill_hidden/decode_forward/lm_logits
         # plugs into the slot engine — all five in-tree families

@@ -23,12 +23,18 @@ plus the workload-telemetry series:
   * xsky_workload_step_seconds                  (histogram, pull-fed)
   * xsky_workload_rank_stalls_total{verdict}    (hung/dead transitions)
 
+plus the device-profiling series (pull-fed deltas):
+  * xsky_compiles_total / xsky_compile_seconds_total
+
 and gauges computed at scrape time from the state DB:
   * xsky_lease_expires_in_seconds{scope}  (negative ⇒ expired holder)
   * xsky_leases_live
   * xsky_workload_last_heartbeat_age_seconds{cluster,rank}
   * xsky_goodput_ratio{cluster}  (productive step time / wall time,
     recovery-journal + lease history aware)
+  * xsky_dispatch_gap_ratio{cluster,job,rank}  (host dispatch share of
+    step time — >0.5 means the step loop is host-bound)
+  * xsky_hbm_bytes_in_use{cluster,job,rank}
 """
 from __future__ import annotations
 
@@ -207,12 +213,59 @@ def _render_workload_gauges() -> List[str]:
     return lines
 
 
+def _render_profile_gauges() -> List[str]:
+    """Device-profiling health computed at scrape time from the newest
+    per-rank profile summaries: dispatch-gap ratio (host share of step
+    time — the host-bound signal) and HBM bytes in use. Same live-
+    cluster filter and {cluster,job,rank} labeling as the workload
+    gauges (torn-down workloads must not grow cardinality forever).
+    Never raises; an unreadable state DB costs the gauges, not the
+    scrape."""
+    lines: List[str] = []
+    try:
+        from skypilot_tpu import state
+        live = set(state.get_cluster_names())
+        rows = [r for r in state.get_profiles(kind='summary')
+                if r['cluster'] in live]
+        if not rows:
+            return []
+        ratio_lines, hbm_lines = [], []
+        for row in rows:
+            labels = ('cluster="'
+                      f'{_escape_label(row["cluster"])}",job='
+                      f'"{row["job_id"]}",rank="{row["rank"]}"')
+            if row.get('dispatch_gap_ratio') is not None:
+                ratio_lines.append(
+                    f'xsky_dispatch_gap_ratio{{{labels}}} '
+                    f'{row["dispatch_gap_ratio"]:.4f}')
+            if row.get('hbm_bytes_in_use') is not None:
+                hbm_lines.append(
+                    f'xsky_hbm_bytes_in_use{{{labels}}} '
+                    f'{row["hbm_bytes_in_use"]}')
+        if ratio_lines:
+            lines.append('# HELP xsky_dispatch_gap_ratio Host dispatch '
+                         'gap share of step time (sampled anatomy; '
+                         '>0.5 means host-bound).')
+            lines.append('# TYPE xsky_dispatch_gap_ratio gauge')
+            lines.extend(ratio_lines)
+        if hbm_lines:
+            lines.append('# HELP xsky_hbm_bytes_in_use Device HBM '
+                         'bytes in use (sampled at the newest profile '
+                         'pull).')
+            lines.append('# TYPE xsky_hbm_bytes_in_use gauge')
+            lines.extend(hbm_lines)
+    except Exception:  # pylint: disable=broad-except
+        return []
+    return lines
+
+
 def render() -> str:
     """Text exposition format (version 0.0.4): the server's own
     HTTP/verb series, then the generic control-plane registry, then
-    the scrape-time lease + workload gauges."""
+    the scrape-time lease + workload + profile gauges."""
     tail = registry.render_registry() + '\n'.join(
-        _render_lease_gauges() + _render_workload_gauges())
+        _render_lease_gauges() + _render_workload_gauges() +
+        _render_profile_gauges())
     with _lock:
         lines = [
             '# HELP xsky_http_requests_total HTTP requests by route/code.',

@@ -109,6 +109,8 @@ _VERBS: Dict[str, Callable[[Dict[str, Any]],
                            down_on_idle=False),
     'queue': _core_verb('queue', 'cluster_name'),
     'cluster_hosts': _core_verb('cluster_hosts', 'cluster_name'),
+    'profile.capture': _core_verb('profile_capture', 'cluster_name',
+                                  job_id=None, duration_s=1.0),
     'endpoints': _core_verb('endpoints', 'cluster_name', port=None),
     'cancel': _core_verb('cancel', 'cluster_name', job_ids=None,
                          all_jobs=False),

@@ -244,6 +244,31 @@ def _create_tables(conn: sqlite3.Connection) -> None:
         );
         CREATE INDEX IF NOT EXISTS idx_workload_telemetry_cluster
             ON workload_telemetry (cluster);
+        CREATE TABLE IF NOT EXISTS profiles (
+            row_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            ts REAL,
+            cluster TEXT,
+            job_id INTEGER,
+            rank INTEGER,
+            kind TEXT,
+            steps INTEGER,
+            steps_sampled INTEGER,
+            dispatch_gap_ema_s REAL,
+            device_ema_s REAL,
+            dispatch_gap_ratio REAL,
+            compiles_total INTEGER,
+            compile_seconds_total REAL,
+            compiles_after_warmup INTEGER,
+            hbm_bytes_in_use INTEGER,
+            hbm_bytes_limit INTEGER,
+            hbm_peak_bytes INTEGER,
+            verdicts TEXT,
+            detail TEXT
+        );
+        CREATE INDEX IF NOT EXISTS idx_profiles_cluster
+            ON profiles (cluster);
+        CREATE INDEX IF NOT EXISTS idx_profiles_latest
+            ON profiles (cluster, job_id, rank, kind, row_id);
         CREATE INDEX IF NOT EXISTS idx_clusters_status
             ON clusters (status);
         CREATE INDEX IF NOT EXISTS idx_recovery_events_ts
@@ -957,6 +982,143 @@ def get_workload_telemetry(cluster: Optional[str] = None,
             'last_progress_ts': progress_ts,
             'hb_ts': hb_ts,
             'verdict': verdict,
+        })
+    return out
+
+
+# ---- device profiles -------------------------------------------------------
+
+# Per-rank step-anatomy summaries + deep-capture digests pulled by the
+# control plane (skypilot_tpu/agent/profiler.py). Bounded like every
+# observability table; `xsky profile`, `xsky top` DISPATCH%/HBM and the
+# /metrics profile gauges all read from here.
+
+# Newest rows kept (pruned lazily). Summaries ride the telemetry pull
+# (one row per rank per pull), captures are on-demand — 20k rows keep
+# hours of history for a 64-rank pod at the default pull cadence.
+_MAX_PROFILES = 20000
+_profile_inserts = 0
+
+_PROFILE_COLS = ('ts, cluster, job_id, rank, kind, steps, '
+                 'steps_sampled, dispatch_gap_ema_s, device_ema_s, '
+                 'dispatch_gap_ratio, compiles_total, '
+                 'compile_seconds_total, compiles_after_warmup, '
+                 'hbm_bytes_in_use, hbm_bytes_limit, hbm_peak_bytes, '
+                 'verdicts, detail')
+
+
+def record_profiles(cluster: str, job_id: Optional[int],
+                    rows: List[Dict[str, Any]],
+                    ts: Optional[float] = None) -> None:
+    """Persist one pull's per-rank profile rows in ONE transaction.
+    NEVER raises — profile recording rides the telemetry pull on the
+    jobs controller's monitor loop and the backend's wait loop (same
+    contract as record_workload_telemetry)."""
+    global _profile_inserts
+    if not rows:
+        return
+    ts = ts if ts is not None else time.time()
+    try:
+        conn = _get_conn()
+    except Exception:  # pylint: disable=broad-except
+        return
+    try:
+        with _lock:
+            conn.executemany(
+                f'INSERT INTO profiles ({_PROFILE_COLS}) VALUES '
+                '(?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)',
+                [(ts, cluster, job_id, r.get('rank'),
+                  r.get('kind', 'summary'), r.get('steps'),
+                  r.get('steps_sampled'), r.get('dispatch_gap_ema_s'),
+                  r.get('device_ema_s'), r.get('dispatch_gap_ratio'),
+                  r.get('compiles_total'), r.get('compile_seconds_total'),
+                  r.get('compiles_after_warmup'),
+                  r.get('hbm_bytes_in_use'), r.get('hbm_bytes_limit'),
+                  r.get('hbm_peak_bytes'),
+                  json.dumps(r.get('verdicts') or []),
+                  (json.dumps(r['detail'], default=str)
+                   if r.get('detail') else None))
+                 for r in rows])
+            # Prune on the FIRST batch too (short-lived CLI writers
+            # never reach an amortized gate — same rationale as spans).
+            _profile_inserts += len(rows)
+            if _profile_inserts == len(rows) or \
+                    _profile_inserts % 256 < len(rows):
+                conn.execute(
+                    'DELETE FROM profiles WHERE row_id <= '
+                    '(SELECT MAX(row_id) FROM profiles) - ?',
+                    (_MAX_PROFILES,))
+            conn.commit()
+    except Exception:  # pylint: disable=broad-except
+        try:
+            conn.rollback()
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+def get_profiles(cluster: Optional[str] = None,
+                 job_id: Optional[int] = None,
+                 kind: Optional[str] = None,
+                 latest_only: bool = True,
+                 limit: int = 2000,
+                 offset: int = 0) -> List[Dict[str, Any]]:
+    """Profile rows, newest-pull-first per rank.
+
+    ``latest_only`` returns ONE row per (cluster, job, rank, kind) —
+    the live view `xsky profile` renders; ``latest_only=False`` is the
+    history (a rank's anatomy across a run)."""
+    conds, args = [], []
+    if cluster is not None:
+        conds.append('cluster = ?')
+        args.append(cluster)
+    if job_id is not None:
+        conds.append('job_id = ?')
+        args.append(job_id)
+    if kind is not None:
+        conds.append('kind = ?')
+        args.append(kind)
+    query = f'SELECT {_PROFILE_COLS} FROM profiles'
+    if latest_only:
+        query += (' WHERE row_id IN (SELECT MAX(row_id) FROM profiles '
+                  'GROUP BY cluster, job_id, rank, kind)')
+        if conds:
+            query += ' AND ' + ' AND '.join(conds)
+    elif conds:
+        query += ' WHERE ' + ' AND '.join(conds)
+    query += (' ORDER BY cluster, job_id, rank, row_id DESC' +
+              _page_sql(int(limit), offset))
+    rows = _read(query, args)
+    out = []
+    for (ts, cl, jid, rank, row_kind, steps, sampled, gap, dev, ratio,
+         compiles, compile_s, after_warmup, in_use, hbm_limit, peak,
+         verdicts, detail) in rows:
+        try:
+            verdicts = json.loads(verdicts) if verdicts else []
+        except ValueError:
+            verdicts = []
+        try:
+            detail = json.loads(detail) if detail else None
+        except ValueError:
+            detail = None
+        out.append({
+            'ts': ts,
+            'cluster': cl,
+            'job_id': jid,
+            'rank': rank,
+            'kind': row_kind,
+            'steps': steps,
+            'steps_sampled': sampled,
+            'dispatch_gap_ema_s': gap,
+            'device_ema_s': dev,
+            'dispatch_gap_ratio': ratio,
+            'compiles_total': compiles,
+            'compile_seconds_total': compile_s,
+            'compiles_after_warmup': after_warmup,
+            'hbm_bytes_in_use': in_use,
+            'hbm_bytes_limit': hbm_limit,
+            'hbm_peak_bytes': peak,
+            'verdicts': verdicts,
+            'detail': detail,
         })
     return out
 

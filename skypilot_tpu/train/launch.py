@@ -103,11 +103,16 @@ def main() -> int:
                         help='comma-separated weight names to adapt')
     args = parser.parse_args()
 
+    from skypilot_tpu.agent import profiler
     from skypilot_tpu.agent import telemetry
     # Phase `init` BEFORE the distributed barrier: a rank wedged in
     # jax.distributed bring-up then shows a live heartbeat with stale
     # progress — the hung-rank signature `xsky top` flags.
     telemetry.emit(phase=telemetry.PHASE_INIT)
+    # Compile listener BEFORE any jit: the first-step compile is
+    # usually the biggest one a run ever does — it must land in the
+    # per-rank profile summary's count/seconds.
+    profiler.ensure_compile_listener()
     distributed.initialize()
     import jax  # after distributed init
     import os

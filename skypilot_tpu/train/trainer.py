@@ -18,6 +18,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec
 
 from skypilot_tpu import models
+from skypilot_tpu.agent import profiler
 from skypilot_tpu.agent import telemetry
 from skypilot_tpu.models import llama
 from skypilot_tpu.parallel import mesh as mesh_lib
@@ -131,6 +132,10 @@ class Trainer:
         # so the gap tracks true step time once the pipeline fills).
         self._host_step = 0
         self._last_step_t: Optional[float] = None
+        # Step-anatomy profiling: compile events feed the per-rank
+        # profile summary from here on (count + seconds, recompile-storm
+        # detection); step() brackets sampled steps with a probe.
+        profiler.ensure_compile_listener()
 
     @property
     def batch_sharding(self) -> NamedSharding:
@@ -325,7 +330,14 @@ class Trainer:
         return self._compiled_step
 
     def step(self, state, batch):
+        # Every Nth step is anatomy-sampled: the probe splits host
+        # dispatch gap from device compute (one block_until_ready on
+        # the sampled step only — tools/bench_profile.py gates the
+        # blended cost <2% of step time).
+        probe = profiler.step_probe()
         out = self.compile_step()(state, batch)
+        if probe is not None:
+            probe.done(out)
         self._note_step()
         return out
 

@@ -612,9 +612,10 @@ class TestTelemetryRetentionLint:
         'recovery_events': '_MAX_RECOVERY_EVENTS',
         'spans': '_MAX_SPANS',
         'workload_telemetry': '_MAX_WORKLOAD_TELEMETRY',
+        'profiles': '_MAX_PROFILES',
     }
     # CREATE TABLE names matching this are observability tables.
-    OBSERVABILITY_RE = re.compile(r'events|spans|telemetry')
+    OBSERVABILITY_RE = re.compile(r'events|spans|telemetry|profiles')
     CREATE_RE = re.compile(r'CREATE TABLE IF NOT EXISTS (\w+)')
 
     @classmethod
@@ -667,6 +668,12 @@ class TestTelemetryRetentionLint:
             '(x INT);"""\n')
         assert any('foo_telemetry' in v
                    for v in self._check_source(unbounded))
+        # Profile tables are observability tables too.
+        unbounded_profiles = (
+            'CREATE = """CREATE TABLE IF NOT EXISTS gpu_profiles '
+            '(x INT);"""\n')
+        assert any('gpu_profiles' in v
+                   for v in self._check_source(unbounded_profiles))
         bounded = (
             '_MAX_SPANS = 100\n'
             'CREATE = """CREATE TABLE IF NOT EXISTS spans (x INT);"""\n'
@@ -834,6 +841,87 @@ class TestSpanCoverageLint:
             '        for _ in range(3):\n'
             '            self._try_resources(r)\n')
         assert self._uncovered_retry_loops(clean) == []
+
+
+class TestProfilerSpanLint:
+    """Every profiler capture/pull site must run under a tracing span:
+    a deep capture fans out a device probe to every host (expensive,
+    operator-triggered — it must land on the trace), and profile
+    recording rides the telemetry pull whose latency `xsky trace`
+    attributes. Calls to the profiler-plane entry points
+    (``capture_device_profile``, ``record_profiles``) anywhere in the
+    tree must be lexically inside a ``with tracing.span(...)`` block,
+    same contract as the fan-out span lint."""
+
+    SKIPPED_FILES = {
+        # The plane's own definition site (record_profiles delegates
+        # to state.record_profiles internally; callers hold the span).
+        'skypilot_tpu/agent/profiler.py',
+    }
+    PROFILER_SITES = {'capture_device_profile', 'record_profiles'}
+
+    @classmethod
+    def _uncovered_profiler_calls(cls, tree):
+        """Line numbers of profiler capture/pull calls NOT lexically
+        inside a span-With (function boundaries reset coverage, same
+        as the fan-out lint)."""
+        is_span_with = TestSpanCoverageLint._is_span_with
+        offenders = []
+
+        def walk(node, covered):
+            for child in ast.iter_child_nodes(node):
+                child_covered = covered
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    child_covered = False
+                elif is_span_with(child):
+                    child_covered = True
+                if (isinstance(child, ast.Call) and
+                        isinstance(child.func, ast.Attribute) and
+                        child.func.attr in cls.PROFILER_SITES and
+                        not covered):
+                    offenders.append(child.lineno)
+                walk(child, child_covered)
+
+        walk(tree, False)
+        return offenders
+
+    def test_every_profiler_site_runs_under_a_span(self):
+        repo_root = os.path.join(os.path.dirname(__file__), '..', '..')
+        pkg_root = os.path.join(repo_root, 'skypilot_tpu')
+        violations = []
+        for dirpath, _, filenames in os.walk(pkg_root):
+            for fname in sorted(filenames):
+                if not fname.endswith('.py'):
+                    continue
+                path = os.path.join(dirpath, fname)
+                rel = os.path.relpath(path, repo_root)
+                if rel in self.SKIPPED_FILES:
+                    continue
+                with open(path, encoding='utf-8') as f:
+                    tree = ast.parse(f.read(), filename=rel)
+                violations.extend(
+                    f'{rel}:{line}'
+                    for line in self._uncovered_profiler_calls(tree))
+        assert not violations, (
+            'profiler capture/pull site outside a tracing span — wrap '
+            'it in `with tracing.span(...)` so the capture/pull lands '
+            'on the trace:\n  ' + '\n  '.join(violations))
+
+    def test_lint_catches_an_uncovered_profiler_site(self):
+        bad = ast.parse(
+            'def cap(backend, handle):\n'
+            '    backend.capture_device_profile(handle)\n')
+        assert self._uncovered_profiler_calls(bad) == [2]
+        bad_pull = ast.parse(
+            'def pull(cluster, samples):\n'
+            '    profiler.record_profiles(cluster, 1, samples)\n')
+        assert self._uncovered_profiler_calls(bad_pull) == [2]
+        clean = ast.parse(
+            'def cap(backend, handle):\n'
+            '    with tracing.span("profile.capture"):\n'
+            '        backend.capture_device_profile(handle)\n')
+        assert self._uncovered_profiler_calls(clean) == []
 
 
 class TestListingLimitLint:

@@ -1,0 +1,590 @@
+"""Device-profiling-plane tests: step-anatomy sampler math, compile
+accounting, verdict thresholds, clock-skew-free staleness, truncated-
+summary tolerance, the bounded profiles table, the `xsky profile` /
+`xsky top` / `/metrics` surfaces, the bench_profile overhead gate, and
+the tier-1 fake-cloud smoke where a chaos-injected dispatch stall
+surfaces as a host-bound verdict end-to-end (spool → pull → table →
+CLI → metrics) plus a fan-out deep capture."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from skypilot_tpu.agent import profiler
+from skypilot_tpu.agent import telemetry
+from skypilot_tpu.utils import chaos
+from skypilot_tpu.utils import metrics as metrics_lib
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), '..', '..'))
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiler(monkeypatch):
+    for env in (profiler.ENV_ENABLED, profiler.ENV_SAMPLE_EVERY,
+                profiler.ENV_FAKE, profiler.ENV_FAKE_DISPATCH,
+                profiler.ENV_FAKE_DEVICE, profiler.ENV_WARMUP_STEPS,
+                telemetry.ENV_DIR):
+        monkeypatch.delenv(env, raising=False)
+    profiler.reset_for_test()
+    telemetry.reset_for_test()
+    chaos.clear()
+    yield
+    profiler.reset_for_test()
+    telemetry.reset_for_test()
+    chaos.clear()
+
+
+@pytest.fixture
+def spool(monkeypatch, tmp_path):
+    d = tmp_path / 'spool'
+    monkeypatch.setenv(telemetry.ENV_DIR, str(d))
+    monkeypatch.setenv(telemetry.ENV_RANK, '0')
+    monkeypatch.setenv(telemetry.ENV_INTERVAL, '0')
+    return d
+
+
+@pytest.fixture
+def fake(monkeypatch):
+    monkeypatch.setenv(profiler.ENV_FAKE, '1')
+    monkeypatch.setenv(profiler.ENV_SAMPLE_EVERY, '1')
+
+
+@pytest.fixture
+def tmp_state(monkeypatch, tmp_path):
+    from skypilot_tpu import state
+    monkeypatch.setenv('XSKY_STATE_DB', str(tmp_path / 'state.db'))
+    state.reset_for_test()
+    yield state
+    state.reset_for_test()
+
+
+class TestStepProbe:
+
+    def test_sampling_cadence(self, monkeypatch):
+        monkeypatch.setenv(profiler.ENV_SAMPLE_EVERY, '4')
+        probes = [profiler.step_probe() for _ in range(8)]
+        # Steps 4 and 8 sampled (1-based step counting).
+        assert [p is not None for p in probes] == \
+            [False, False, False, True, False, False, False, True]
+
+    def test_disabled_returns_none(self, monkeypatch):
+        monkeypatch.setenv(profiler.ENV_ENABLED, '0')
+        assert profiler.step_probe() is None
+
+    def test_fake_anatomy_rides_the_spool(self, spool, fake,
+                                          monkeypatch):
+        monkeypatch.setenv(profiler.ENV_FAKE_DISPATCH, '0.113')
+        monkeypatch.setenv(profiler.ENV_FAKE_DEVICE, '0.003')
+        for _ in range(4):
+            probe = profiler.step_probe()
+            assert probe is not None
+            probe.done()
+        sample = telemetry.read_spool(str(spool))[0]
+        prof = sample['profile']
+        assert prof['steps_sampled'] == 4
+        assert prof['dispatch_gap_ema_s'] == pytest.approx(0.113)
+        assert prof['device_ema_s'] == pytest.approx(0.003)
+        assert prof['dispatch_gap_ratio'] == pytest.approx(
+            0.113 / 0.116)
+        assert prof['hbm_bytes_in_use'] > 0
+        assert prof['hbm_bytes_limit'] > prof['hbm_bytes_in_use']
+
+    def test_real_mode_block_on_garbage_never_raises(self, monkeypatch):
+        monkeypatch.setenv(profiler.ENV_SAMPLE_EVERY, '1')
+        probe = profiler.step_probe()
+        assert probe is not None
+        probe.done(out=object())   # not a pytree of arrays: swallowed
+
+    def test_ema_decay(self, fake, monkeypatch):
+        monkeypatch.setenv(profiler.ENV_FAKE_DEVICE, '0.004')
+        monkeypatch.setenv(profiler.ENV_FAKE_DISPATCH, '0.001')
+        probe = profiler.step_probe()
+        probe.done()
+        monkeypatch.setenv(profiler.ENV_FAKE_DISPATCH, '0.002')
+        probe = profiler.step_probe()
+        probe.done()
+        snap = profiler._get_anatomy().snapshot()  # pylint: disable=protected-access
+        assert snap['dispatch_gap_ema_s'] == pytest.approx(
+            telemetry.ema(0.001, 0.002))
+
+    def test_chaos_dispatch_stall_inflates_gap(self, fake, monkeypatch):
+        monkeypatch.setenv('XSKY_HOST_RANK', '0')
+        chaos.load_plan({'points': {
+            'profiler.dispatch_stall': {'match': {'rank': 0},
+                                        'gap_s': 0.5}}})
+        probe = profiler.step_probe()
+        probe.done()
+        snap = profiler._get_anatomy().snapshot()  # pylint: disable=protected-access
+        # Default fake gap is 1 ms; the fired rule adds its gap_s.
+        assert snap['dispatch_gap_ema_s'] == pytest.approx(0.501)
+        assert snap['dispatch_gap_ratio'] > 0.9
+        assert chaos.hits('profiler.dispatch_stall') == 1
+        # A non-matching rank is untouched.
+        monkeypatch.setenv('XSKY_HOST_RANK', '1')
+        probe = profiler.step_probe()
+        probe.done()
+        snap = profiler._get_anatomy().snapshot()  # pylint: disable=protected-access
+        assert snap['dispatch_gap_ema_s'] < 0.5
+
+
+class TestCompileAccounting:
+
+    def test_warmup_split(self, monkeypatch):
+        monkeypatch.setenv(profiler.ENV_WARMUP_STEPS, '2')
+        monkeypatch.setenv(profiler.ENV_SAMPLE_EVERY, '1000')
+        profiler.record_compile(1.5)            # steps_seen == 0: warmup
+        for _ in range(3):
+            profiler.step_probe()
+        profiler.record_compile(0.5)            # steps_seen == 3 > 2
+        snap = profiler._get_anatomy().snapshot()  # pylint: disable=protected-access
+        assert snap['compiles_total'] == 2
+        assert snap['compile_seconds_total'] == pytest.approx(2.0)
+        assert snap['compiles_after_warmup'] == 1
+
+    def test_real_listener_counts_a_jit_compile(self):
+        import jax
+        import jax.numpy as jnp
+        profiler.ensure_compile_listener()
+        before = profiler._get_anatomy().snapshot()  # pylint: disable=protected-access
+        # A shape no other test jits.
+        out = jax.jit(lambda x: x * 3 + 1)(jnp.zeros((7, 13)))
+        jax.block_until_ready(out)
+        after = profiler._get_anatomy().snapshot()  # pylint: disable=protected-access
+        assert after['compiles_total'] > before['compiles_total']
+        assert after['compile_seconds_total'] > \
+            before['compile_seconds_total']
+
+
+class TestVerdicts:
+
+    def _prof(self, **kw):
+        base = {'ts': time.time(), 'steps_seen': 100,
+                'steps_sampled': 10, 'dispatch_gap_ema_s': 0.01,
+                'device_ema_s': 0.09, 'dispatch_gap_ratio': 0.1,
+                'compiles_total': 2, 'compile_seconds_total': 1.0,
+                'compiles_after_warmup': 0,
+                'hbm_bytes_in_use': 2 << 30,
+                'hbm_bytes_limit': 16 << 30,
+                'hbm_peak_bytes': 2 << 30}
+        base.update(kw)
+        return base
+
+    def test_healthy_profile_has_no_verdicts(self):
+        assert profiler.verdicts_for(self._prof()) == []
+
+    def test_host_bound(self):
+        prof = self._prof(dispatch_gap_ema_s=0.113, device_ema_s=0.003,
+                          dispatch_gap_ratio=None)
+        assert profiler.verdicts_for(prof) == ['host-bound']
+        # Below MIN_SAMPLED_STEPS the anatomy is noise, not a verdict.
+        prof['steps_sampled'] = profiler.MIN_SAMPLED_STEPS - 1
+        assert profiler.verdicts_for(prof) == []
+
+    def test_host_bound_threshold_from_env(self, monkeypatch):
+        prof = self._prof(dispatch_gap_ratio=0.4)
+        assert profiler.verdicts_for(prof) == []
+        monkeypatch.setenv(profiler.ENV_HOSTBOUND_RATIO, '0.3')
+        assert profiler.verdicts_for(prof) == ['host-bound']
+
+    def test_recompile_storm(self, monkeypatch):
+        prof = self._prof(compiles_after_warmup=3)
+        assert profiler.verdicts_for(prof) == ['recompile-storm']
+        monkeypatch.setenv(profiler.ENV_RECOMPILE_N, '10')
+        assert profiler.verdicts_for(prof) == []
+
+    def test_hbm_pressure(self):
+        prof = self._prof(hbm_peak_bytes=15 << 30)
+        assert profiler.verdicts_for(prof) == ['hbm-pressure']
+        # Falls back to bytes_in_use when no peak was recorded.
+        prof = self._prof(hbm_peak_bytes=None,
+                          hbm_bytes_in_use=15 << 30)
+        assert profiler.verdicts_for(prof) == ['hbm-pressure']
+
+    def test_truncated_summary_tolerated(self):
+        # Missing fields: no verdict can fire, nothing raises.
+        assert profiler.verdicts_for({}) == []
+        # Torn fields (strings where numbers belong): never a raise.
+        assert profiler.verdicts_for(
+            {'steps_sampled': 'garbage'}) == []
+        verdicts = profiler.verdicts_for(
+            self._prof(hbm_bytes_limit='oops',
+                       dispatch_gap_ratio=0.9))
+        assert 'host-bound' in verdicts
+
+    def test_staleness_is_clock_skew_free(self):
+        """Summary freshness compares profile.ts against the rank's
+        OWN hb_ts (same host clock): a rank whose clock is far behind
+        the control plane must not read stale."""
+        now = time.time()
+        skewed_sample = {'hb_ts': now - 3600}          # clock 1h behind
+        fresh_prof = {'ts': now - 3601}                # 1 s before hb
+        assert not profiler.summary_is_stale(skewed_sample, fresh_prof)
+        stale_prof = {'ts': now - 3600 - 10_000}
+        assert profiler.summary_is_stale(skewed_sample, stale_prof)
+        # Missing timestamps: never stale (and never a raise).
+        assert not profiler.summary_is_stale({}, {})
+
+    def test_record_profiles_marks_stale(self, tmp_state):
+        now = time.time()
+        sample = {'hb_ts': now,
+                  'profile': self._prof(ts=now - 10_000,
+                                        dispatch_gap_ratio=0.99)}
+        result = profiler.record_profiles('c1', 1, {0: sample}, now=now)
+        assert result == {0: ['stale']}
+        rows = tmp_state.get_profiles(cluster='c1')
+        assert rows[0]['verdicts'] == ['stale']
+
+
+class TestRecordProfiles:
+
+    def _sample(self, ratio=0.2, compiles=2, seconds=1.0):
+        now = time.time()
+        return {'hb_ts': now,
+                'profile': {'ts': now, 'steps_seen': 60,
+                            'steps_sampled': 6,
+                            'dispatch_gap_ema_s': 0.01,
+                            'device_ema_s': 0.04,
+                            'dispatch_gap_ratio': ratio,
+                            'compiles_total': compiles,
+                            'compile_seconds_total': seconds,
+                            'compiles_after_warmup': 0,
+                            'hbm_bytes_in_use': 1 << 30,
+                            'hbm_bytes_limit': 16 << 30,
+                            'hbm_peak_bytes': 1 << 30}}
+
+    def test_round_trip_and_latest_only(self, tmp_state):
+        profiler.record_profiles('c1', 1,
+                                 {0: self._sample(), 1: self._sample()})
+        profiler.record_profiles('c1', 1, {0: self._sample(ratio=0.8)})
+        latest = tmp_state.get_profiles(cluster='c1')
+        assert len(latest) == 2
+        by_rank = {r['rank']: r for r in latest}
+        assert by_rank[0]['dispatch_gap_ratio'] == pytest.approx(0.8)
+        assert by_rank[1]['dispatch_gap_ratio'] == pytest.approx(0.2)
+        history = tmp_state.get_profiles(cluster='c1',
+                                         latest_only=False)
+        assert len(history) == 3
+
+    def test_ranks_without_profile_are_skipped(self, tmp_state):
+        samples = {0: self._sample(),
+                   1: {'hb_ts': time.time()},               # no profiler
+                   2: {'hb_ts': time.time(),
+                       'profile': 'torn-not-a-dict'},
+                   3: 'not-even-a-dict'}
+        result = profiler.record_profiles('c1', 1, samples)
+        assert set(result) == {0}
+        assert {r['rank'] for r in tmp_state.get_profiles('c1')} == {0}
+
+    def test_capture_kind_records_detail(self, tmp_state):
+        cap = profiler.capture_summary_row(
+            {'rank': 0, 'fake': True, 'dispatch_rtt_ms': 113.0,
+             'device_matmul_ms': 3.0, 'probe_compile_s': 0.05,
+             'dispatch_probes': 16, 'out_dir': '/tmp/x',
+             'bytes_in_use': 1 << 30, 'trace_files': ['capture.json']})
+        result = profiler.record_profiles('c1', 1, {0: cap},
+                                          kind='capture')
+        # RTT >> matmul: the capture itself diagnoses host-bound.
+        assert result == {0: ['host-bound']}
+        rows = tmp_state.get_profiles(cluster='c1', kind='capture')
+        assert rows[0]['detail']['dispatch_rtt_ms'] == 113.0
+        assert rows[0]['detail']['out_dir'] == '/tmp/x'
+        assert tmp_state.get_profiles(cluster='c1',
+                                      kind='summary') == []
+
+    def test_retention_bound(self, tmp_state, monkeypatch):
+        monkeypatch.setattr(tmp_state, '_MAX_PROFILES', 10)
+        monkeypatch.setattr(tmp_state, '_profile_inserts', 0)
+        profiler.record_profiles(
+            'c1', 1, {r: self._sample() for r in range(40)})
+        rows = tmp_state.get_profiles(latest_only=False, limit=1000)
+        assert len(rows) == 10
+        assert {r['rank'] for r in rows} == set(range(30, 40))
+
+    def test_never_raises_on_db_failure(self, tmp_state, monkeypatch):
+        def _boom():
+            raise RuntimeError('db down')
+
+        monkeypatch.setattr(tmp_state, '_get_conn', _boom)
+        profiler.record_profiles('c1', 1, {0: self._sample()})
+
+    def test_compile_counters_count_deltas(self, tmp_state):
+        metrics_lib.reset_for_test()
+        profiler.record_profiles('c1', 1,
+                                 {0: self._sample(compiles=3,
+                                                  seconds=2.0)})
+        profiler.record_profiles('c1', 1,
+                                 {0: self._sample(compiles=5,
+                                                  seconds=2.5)})
+        # Same snapshot again: no new compiles, no double count.
+        profiler.record_profiles('c1', 1,
+                                 {0: self._sample(compiles=5,
+                                                  seconds=2.5)})
+        text = metrics_lib.render_registry()
+        assert 'xsky_compiles_total 5' in text
+        assert 'xsky_compile_seconds_total 2.5' in text
+        # Capture rows never feed the counters: their compile_seconds
+        # is one probe's fresh measurement, not a cumulative total the
+        # delta math could difference.
+        cap = profiler.capture_summary_row(
+            {'rank': 0, 'probe_compile_s': 9.0, 'dispatch_probes': 4})
+        profiler.record_profiles('c1', 1, {0: cap}, kind='capture')
+        text = metrics_lib.render_registry()
+        assert 'xsky_compiles_total 5' in text
+        assert 'xsky_compile_seconds_total 2.5' in text
+
+    def test_latest_only_query_uses_composite_index(self, tmp_state):
+        profiler.record_profiles('c1', 1, {0: self._sample()})
+        import sqlite3
+        conn = sqlite3.connect(os.environ['XSKY_STATE_DB'])
+        plan = ' '.join(
+            row[3] for row in conn.execute(
+                'EXPLAIN QUERY PLAN SELECT MAX(row_id) FROM profiles '
+                'GROUP BY cluster, job_id, rank, kind'))
+        conn.close()
+        assert 'idx_profiles_latest' in plan, plan
+
+
+class TestMetricsSurface:
+
+    def _record(self, cluster, ratio=0.9):
+        now = time.time()
+        sample = {'hb_ts': now,
+                  'profile': {'ts': now, 'steps_sampled': 5,
+                              'dispatch_gap_ema_s': 0.09,
+                              'device_ema_s': 0.01,
+                              'dispatch_gap_ratio': ratio,
+                              'hbm_bytes_in_use': 3 << 30,
+                              'hbm_bytes_limit': 16 << 30}}
+        profiler.record_profiles(cluster, 1, {0: sample}, now=now)
+
+    def test_profile_gauges_for_live_clusters(self, tmp_state):
+        from skypilot_tpu.server import metrics as server_metrics
+        tmp_state.add_or_update_cluster('live-c', None)
+        self._record('live-c')
+        text = server_metrics.render()
+        assert ('xsky_dispatch_gap_ratio{cluster="live-c",job="1",'
+                'rank="0"} 0.9000') in text
+        assert ('xsky_hbm_bytes_in_use{cluster="live-c",job="1",'
+                'rank="0"} ' + str(3 << 30)) in text
+
+    def test_gauges_skip_torn_down_clusters(self, tmp_state):
+        from skypilot_tpu.server import metrics as server_metrics
+        self._record('ghost-c')
+        assert 'ghost-c' not in server_metrics.render()
+
+
+class TestCliSurfaces:
+
+    def _seed(self, ratio=0.97):
+        now = time.time()
+        samples = {}
+        for r in range(2):
+            samples[r] = {
+                'hb_ts': now, 'last_progress_ts': now,
+                'started_ts': now - 60, 'step': 5, 'phase': 'step',
+                'step_time_ema_s': 0.2, 'tokens_per_sec': 100.0,
+                'host_mem_mb': 400.0,
+                'profile': {'ts': now, 'steps_seen': 40,
+                            'steps_sampled': 4,
+                            'dispatch_gap_ema_s': 0.1,
+                            'device_ema_s': 0.003,
+                            'dispatch_gap_ratio': (ratio if r == 0
+                                                   else 0.2),
+                            'compiles_total': 3,
+                            'compile_seconds_total': 1.5,
+                            'compiles_after_warmup': 0,
+                            'hbm_bytes_in_use': 2 << 30,
+                            'hbm_bytes_limit': 16 << 30,
+                            'hbm_peak_bytes': 3 << 30}}
+        telemetry.record_samples('prof-c', 2, samples, now=now)
+
+    def test_profile_table_and_json(self, tmp_state):
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        self._seed()
+        runner = CliRunner()
+        result = runner.invoke(cli_mod.cli, ['profile'])
+        assert result.exit_code == 0, result.output
+        assert 'DISPATCH' in result.output
+        assert 'host-bound' in result.output
+        assert 'dispatch skew=' in result.output
+        as_json = runner.invoke(cli_mod.cli, ['profile', '--json'])
+        assert as_json.exit_code == 0, as_json.output
+        rows = [json.loads(l) for l in as_json.output.splitlines()
+                if l.startswith('{')]
+        assert len(rows) == 2
+        by_rank = {r['rank']: r for r in rows}
+        assert by_rank[0]['verdicts'] == ['host-bound']
+        assert by_rank[1]['verdicts'] == []
+        # Filters: --rank and an unknown cluster.
+        only0 = runner.invoke(cli_mod.cli,
+                              ['profile', 'prof-c', '--rank', '0'])
+        assert only0.exit_code == 0
+        empty = runner.invoke(cli_mod.cli, ['profile', 'no-such'])
+        assert 'No profile data' in empty.output
+
+    def test_top_gains_dispatch_and_hbm(self, tmp_state):
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        self._seed()
+        runner = CliRunner()
+        table = runner.invoke(cli_mod.cli, ['top'])
+        assert table.exit_code == 0, table.output
+        assert 'DISPATCH%' in table.output
+        assert '97%' in table.output
+        assert 'hbm=3.0GiB' in table.output
+        as_json = runner.invoke(cli_mod.cli, ['top', '--json'])
+        rows = [json.loads(l) for l in as_json.output.splitlines()
+                if l.startswith('{')]
+        by_rank = {r['rank']: r for r in rows}
+        # The full step-anatomy block rides each --json row.
+        assert by_rank[0]['profile']['compiles_total'] == 3
+        assert by_rank[0]['dispatch_gap_ratio'] == pytest.approx(0.97)
+
+
+class TestBenchProfileGate:
+    """Tier-1 overhead gate: the always-on sampler must cost <2% of a
+    fast step, proven by tools/bench_profile.py --smoke in a clean
+    subprocess (same pattern as the bench_controlplane smoke gate)."""
+
+    def test_bench_profile_smoke_gate(self):
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, 'tools', 'bench_profile.py'),
+             '--smoke'],
+            capture_output=True, text=True, timeout=300, check=False)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert result['pass'] is True
+        assert result['overhead_pct'] < result['max_overhead_pct']
+        # The sampled path actually exercised the spool emit.
+        assert result['spool_profile_sampled'] is not None
+
+
+class TestProfilePlaneSmoke:
+    """Tier-1 acceptance: a fake-cloud 4-host gang whose rank 0 gets a
+    chaos-injected dispatch stall and whose rank 1 recompiles past
+    warmup reports per-rank dispatch-gap/device/compile/HBM anatomy
+    with the correct host-bound and recompile-storm verdicts through
+    `xsky profile --json`, exposes the gauges on /metrics (live
+    clusters only), and serves a fan-out deep capture."""
+
+    def test_fake_gang_anatomy_verdicts_capture_metrics(
+            self, fake_cluster_env, monkeypatch, tmp_path):
+        del fake_cluster_env
+        from click.testing import CliRunner
+
+        from skypilot_tpu import Resources, Task, core, execution
+        from skypilot_tpu import state as state_lib
+        from skypilot_tpu.client import cli as cli_mod
+        from skypilot_tpu.server import metrics as server_metrics
+
+        # Fast telemetry + fake profiler seam for every process (the
+        # fake hosts are local subprocesses inheriting this env).
+        monkeypatch.setenv(telemetry.ENV_INTERVAL, '0.1')
+        monkeypatch.setenv(telemetry.ENV_PULL_INTERVAL, '0.3')
+        monkeypatch.setenv(profiler.ENV_FAKE, '1')
+        monkeypatch.setenv(profiler.ENV_SAMPLE_EVERY, '1')
+        monkeypatch.setenv('XSKY_CHAOS_PLAN', json.dumps({'points': {
+            'profiler.dispatch_stall': {'match': {'rank': 0},
+                                        'gap_s': 0.5}}}))
+
+        script = tmp_path / 'workload.py'
+        script.write_text(f'''
+import os, sys, time
+sys.path.insert(0, {json.dumps(REPO_ROOT)})
+from skypilot_tpu.agent import profiler, telemetry
+rank = int(os.environ.get('XSKY_HOST_RANK', '0'))
+profiler.record_compile(0.2)        # warmup compile (before any step)
+for i in range(20):
+    probe = profiler.step_probe()
+    if rank == 1 and i > 10:
+        profiler.record_compile(0.05)    # the recompile storm
+    if probe is not None:
+        probe.done()
+    telemetry.emit(phase='step', step=i, step_time_s=0.05)
+    time.sleep(0.12)
+''')
+        cluster = 'profile-smoke'
+        task = Task('profile-smoke',
+                    run=f'{sys.executable} {script}')
+        # tpu-v5e-32 = 4 fake hosts: multi-rank anatomy without the
+        # wall-clock of a 16-host gang in tier-1.
+        task.set_resources(Resources(accelerators='tpu-v5e-32'))
+        job_id, handle = execution.launch(task, cluster_name=cluster)
+        try:
+            # Deterministic final pull: the wait loop's rate-limited
+            # in-run pulls can predate the last steps under suite
+            # load; the host spools hold the final truth and outlive
+            # the job.
+            from skypilot_tpu.backends import tpu_gang_backend
+            backend = tpu_gang_backend.TpuGangBackend()
+            samples = backend.get_workload_telemetry(handle, job_id)
+            assert set(samples) == {0, 1, 2, 3}, samples
+            telemetry.record_samples(cluster, job_id, samples)
+
+            rows = state_lib.get_profiles(cluster=cluster,
+                                          kind='summary')
+            assert {r['rank'] for r in rows} == {0, 1, 2, 3}, rows
+            by_rank = {r['rank']: r for r in rows}
+            # Rank 0: the injected stall dominates ⇒ host-bound.
+            assert by_rank[0]['verdicts'] == ['host-bound']
+            assert by_rank[0]['dispatch_gap_ratio'] > 0.9
+            # Rank 1: compiles kept firing past warmup.
+            assert by_rank[1]['verdicts'] == ['recompile-storm']
+            assert by_rank[1]['compiles_after_warmup'] >= 3
+            # Ranks 2/3: healthy anatomy, no verdicts.
+            for rank in (2, 3):
+                assert by_rank[rank]['verdicts'] == []
+                assert by_rank[rank]['dispatch_gap_ratio'] < 0.5
+                assert by_rank[rank]['hbm_bytes_in_use'] > 0
+                assert by_rank[rank]['compiles_total'] == 1
+
+            # The CLI reads the same truth.
+            runner = CliRunner()
+            result = runner.invoke(cli_mod.cli,
+                                   ['profile', cluster, '--json'])
+            assert result.exit_code == 0, result.output
+            cli_rows = [json.loads(l)
+                        for l in result.output.splitlines()
+                        if l.startswith('{')]
+            cli_by_rank = {r['rank']: r for r in cli_rows}
+            assert cli_by_rank[0]['verdicts'] == ['host-bound']
+            assert cli_by_rank[1]['verdicts'] == ['recompile-storm']
+
+            # /metrics: gauges present while the cluster lives.
+            text = server_metrics.render()
+            assert (f'xsky_dispatch_gap_ratio{{cluster="{cluster}"'
+                    in text)
+            assert (f'xsky_hbm_bytes_in_use{{cluster="{cluster}"'
+                    in text)
+            assert 'xsky_compiles_total' in text
+
+            # Fan-out deep capture over the same 4 hosts (fake seam).
+            summaries = core.profile_capture(cluster, duration_s=0.2)
+            assert set(summaries) == {0, 1, 2, 3}
+            assert all(s['fake'] for s in summaries.values())
+            caps = state_lib.get_profiles(cluster=cluster,
+                                          kind='capture')
+            assert {r['rank'] for r in caps} == {0, 1, 2, 3}
+            assert all(r['detail']['out_dir'] for r in caps)
+
+            # The workload-side chaos fire journalled cross-process.
+            injected = {r['scope']
+                        for r in state_lib.get_recovery_events(
+                            event_type='chaos.injected')}
+            assert 'chaos/profiler.dispatch_stall' in injected
+        finally:
+            core.down(cluster)
+        # Torn down ⇒ the scrape-time gauges disappear (live filter);
+        # the profile rows themselves remain for post-mortems.
+        text = server_metrics.render()
+        assert f'xsky_dispatch_gap_ratio{{cluster="{cluster}"' \
+            not in text
+        assert state_lib.get_profiles(cluster=cluster)
