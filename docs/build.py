@@ -36,6 +36,8 @@ NAV = [
     ('clouds.md', 'Clouds'),
     ('server.md', 'API server'),
     ('performance.md', 'Performance'),
+    ('static-analysis.md', 'Static analysis'),
+    ('reference/environment.md', 'Env variables'),
 ]
 
 _TEMPLATE = """<!DOCTYPE html>
@@ -70,25 +72,39 @@ _TEMPLATE = """<!DOCTYPE html>
 
 
 def _nav_html(active: str) -> str:
+    # Nav links are relative to the ACTIVE page's directory (pages may
+    # live in subdirectories, e.g. reference/environment.md).
+    depth = active.count('/')
+    prefix = '../' * depth
     items = []
     for fname, title in NAV:
-        href = fname.replace('.md', '.html')
+        href = prefix + fname.replace('.md', '.html')
         cls = ' class="active"' if fname == active else ''
         items.append(f'<a href="{href}"{cls}>{title}</a>')
     return '\n'.join(items)
 
 
+def _tracked_pages() -> set:
+    """Every .md under docs/ (subdirectories included, build output
+    excluded), as posix-relative names."""
+    return {
+        f.relative_to(DOCS).as_posix()
+        for f in DOCS.rglob('*.md')
+        if '_build' not in f.relative_to(DOCS).parts
+    }
+
+
 def _check_links() -> list:
     """Every relative intra-docs link must point at a real page."""
     errors = []
-    pages = {f.name for f in DOCS.glob('*.md')}
+    pages = _tracked_pages()
     nav_pages = {fname for fname, _ in NAV}
     for missing in nav_pages - pages:
         errors.append(f'NAV lists missing page: {missing}')
     for stray in pages - nav_pages:
         errors.append(f'page not in NAV (add to docs/build.py): {stray}')
     link_re = re.compile(r'\[[^\]]*\]\(([^)#\s]+)(#[^)\s]*)?\)')
-    for page in sorted(DOCS.glob('*.md')):
+    for page in sorted(DOCS / p for p in pages):
         for match in link_re.finditer(page.read_text(encoding='utf-8')):
             target = match.group(1)
             if target.startswith(('http://', 'https://', 'mailto:')):
@@ -111,8 +127,9 @@ def build(out_dir: pathlib.Path) -> None:
             text, extensions=['fenced_code', 'tables'])
         html = _TEMPLATE.format(title=title, nav=_nav_html(fname),
                                 body=body)
-        (out_dir / fname.replace('.md', '.html')).write_text(
-            html, encoding='utf-8')
+        out_path = out_dir / fname.replace('.md', '.html')
+        out_path.parent.mkdir(parents=True, exist_ok=True)
+        out_path.write_text(html, encoding='utf-8')
     print(f'built {len(NAV)} pages → {out_dir}')
 
 

@@ -41,6 +41,9 @@ def cluster_root() -> str:
 def _db(root: Optional[str] = None) -> sqlite3.Connection:
     root = root or cluster_root()
     os.makedirs(root, exist_ok=True)
+    # xskylint: disable=db-discipline -- agent-side per-cluster jobs.db:
+    # lives on the cluster host, never behind the control plane's WAL
+    # pool or postgres routing, and needs the bespoke WAL-retry below.
     conn = sqlite3.connect(os.path.join(root, 'jobs.db'), timeout=30,
                            check_same_thread=False)
     # Converting a FRESH db to WAL needs a moment of exclusive access;

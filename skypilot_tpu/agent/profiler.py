@@ -378,12 +378,13 @@ def ensure_compile_listener() -> None:
     global _listener_installed
     if _listener_installed:
         return
-    if fake_mode():
-        # Do NOT latch the flag: a process that leaves fake mode (test
-        # harness) must still be able to install the real listener.
-        return
-    _listener_installed = True
     try:
+        if fake_mode():
+            # Do NOT latch the flag: a process that leaves fake mode
+            # (test harness) must still be able to install the real
+            # listener.
+            return
+        _listener_installed = True
         from jax import monitoring
 
         def _on_event(event: str, duration: float, **kwargs: Any) -> None:
@@ -477,10 +478,10 @@ def record_profiles(cluster: str, job_id: Optional[int],
     a torn one, are skipped). ``kind='capture'``: ``samples`` are the
     per-rank deep-capture summaries themselves.
     """
-    now = now if now is not None else time.time()
     result: Dict[int, List[str]] = {}
     rows = []
     try:
+        now = now if now is not None else time.time()
         for rank, sample in sorted(samples.items()):
             if not isinstance(sample, dict):
                 continue

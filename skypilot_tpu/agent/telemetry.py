@@ -486,26 +486,32 @@ def goodput_for_cluster(cluster: str,
     survives controller renewals, so wall spans relaunches) and the
     recovery journal's measured recovery latencies (PR 1). Never
     raises; falls back to sample-derived wall."""
-    now = now if now is not None else time.time()
-    recovery_s = 0.0
-    wall_s = None
-    scope = _job_scope_for_cluster(cluster)
-    if scope is not None:
-        try:
-            from skypilot_tpu import state
-            for event in state.get_recovery_events(scope=scope,
-                                                   limit=1000):
-                if event['event_type'] in ('job.recovered',
-                                           'job.restarted') and \
-                        event['latency_s']:
-                    recovery_s += event['latency_s']
-            lease = state.get_lease(scope)
-            if lease is not None and lease.get('started_at'):
-                wall_s = now - lease['started_at'] - recovery_s
-        except Exception:  # pylint: disable=broad-except
-            pass
-    return goodput(samples, recovery_s=recovery_s, wall_s=wall_s,
-                   now=now)
+    try:
+        now = now if now is not None else time.time()
+        recovery_s = 0.0
+        wall_s = None
+        scope = _job_scope_for_cluster(cluster)
+        if scope is not None:
+            try:
+                from skypilot_tpu import state
+                for event in state.get_recovery_events(scope=scope,
+                                                       limit=1000):
+                    if event['event_type'] in ('job.recovered',
+                                               'job.restarted') and \
+                            event['latency_s']:
+                        recovery_s += event['latency_s']
+                lease = state.get_lease(scope)
+                if lease is not None and lease.get('started_at'):
+                    wall_s = now - lease['started_at'] - recovery_s
+            except Exception:  # pylint: disable=broad-except
+                pass
+        return goodput(samples, recovery_s=recovery_s, wall_s=wall_s,
+                       now=now)
+    except Exception:  # pylint: disable=broad-except
+        # Shape-compatible empty answer (scrape/CLI callers read the
+        # keys): goodput is observability, never an outage.
+        return {'goodput': None, 'productive_s': 0.0, 'wall_s': 0.0,
+                'recovery_s': 0.0}
 
 
 # ---- control-plane recording ----------------------------------------------
@@ -521,8 +527,12 @@ def record_samples(cluster: str, job_id: Optional[int],
     """Persist pulled samples to the bounded ``workload_telemetry``
     table and feed the metrics registry. Returns the per-rank verdicts
     so callers (jobs controller) can react. NEVER raises."""
-    now = now if now is not None else time.time()
-    result = verdicts(samples, now)
+    result: Dict[int, str] = {}
+    try:
+        now = now if now is not None else time.time()
+        result = verdicts(samples, now)
+    except Exception:  # pylint: disable=broad-except
+        return result
     try:
         from skypilot_tpu import state
         rows = []

@@ -1069,6 +1069,56 @@ def ssh_down(infra, yes):
 
 
 @cli.command()
+@click.argument('paths', nargs=-1)
+@click.option('--root', 'root_dir', default=None,
+              help='Repo root holding tools/xskylint (default: '
+                   'auto-detected from the working directory).')
+@click.option('--rule', 'rules', multiple=True,
+              help='Run only this rule id (repeatable).')
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='Machine-readable findings.')
+@click.option('--list-rules', 'list_rules', is_flag=True, default=False,
+              help='Print the rule catalog and exit.')
+def lint(paths, root_dir, rules, as_json, list_rules):
+    """Static analysis over the tree (tools/xskylint).
+
+    Parses each file once and runs every registered rule over the
+    shared AST: concurrency contracts (raw sleeps, sequential runner
+    loops, thread/process hygiene), observability contracts (span
+    coverage, retention bounds, never-raise recording paths, lease
+    heartbeats), state-DB discipline (SELECT paging, connection
+    routing), the env-var registry, and chaos coverage. Exits 1 on
+    any unsuppressed finding. Suppress with
+    `# xskylint: disable=<rule> -- <reason>` (reason mandatory); rule
+    catalog in docs/static-analysis.md.
+    """
+    root = os.path.abspath(root_dir) if root_dir else None
+    if root is None:
+        probe = os.getcwd()
+        while True:
+            if os.path.isdir(os.path.join(probe, 'tools', 'xskylint')):
+                root = probe
+                break
+            parent = os.path.dirname(probe)
+            if parent == probe:
+                raise click.ClickException(
+                    'no tools/xskylint found here or above — run from '
+                    'a repo checkout or pass --root.')
+            probe = parent
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from tools.xskylint import engine as lint_engine
+    argv = list(paths) + ['--root', root]
+    for rule in rules:
+        argv += ['--rule', rule]
+    if as_json:
+        argv.append('--json')
+    if list_rules:
+        argv.append('--list-rules')
+    sys.exit(lint_engine.main(argv))
+
+
+@cli.command()
 @click.argument('cluster')
 @click.argument('job_id', type=int, required=False)
 @click.option('--sync-down', is_flag=True, default=False,

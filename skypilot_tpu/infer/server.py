@@ -51,7 +51,8 @@ class ServingLoop:
         self.orch = orch
         self._wake = threading.Event()
         self._lock = threading.Lock()
-        threading.Thread(target=self._loop, daemon=True).start()
+        threading.Thread(target=self._loop, name='xsky-infer-loop',
+                         daemon=True).start()
 
     def submit(self, request: orch_lib.Request) -> orch_lib.Request:
         """Enqueue without blocking (streaming handlers poll the

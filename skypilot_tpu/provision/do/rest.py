@@ -17,6 +17,7 @@ import urllib.request
 from typing import Any, Dict, Optional
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.utils import chaos
 from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import resilience
 
@@ -90,6 +91,9 @@ class Transport:
         data = json.dumps(body).encode() if body is not None else None
 
         def attempt() -> Dict[str, Any]:
+            # Per-attempt chaos point: fault plans simulate rate
+            # limits/outages without a real DO account.
+            chaos.inject('do.api', method=method, path=path)
             req = urllib.request.Request(
                 url, data=data, method=method,
                 headers={'Authorization': f'Bearer {self._token}',

@@ -142,6 +142,10 @@ def _kill_host_processes(host_root: str) -> None:
         if not os.path.exists(db):
             continue
         try:
+            # xskylint: disable=db-discipline -- read-only peek into an
+            # AGENT host's jobs.db (to kill leaked workload pids), not
+            # a control-plane state DB; the WAL pool has no business
+            # here.
             conn = sqlite3.connect(db, timeout=5)
             rows = conn.execute(
                 'SELECT pid FROM jobs WHERE pid IS NOT NULL').fetchall()

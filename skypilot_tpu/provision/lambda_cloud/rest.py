@@ -15,6 +15,7 @@ import urllib.request
 from typing import Any, Dict, Optional
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.utils import chaos
 from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import resilience
 
@@ -89,6 +90,9 @@ class Transport:
         data = json.dumps(body).encode() if body is not None else None
 
         def attempt() -> Dict[str, Any]:
+            # Per-attempt chaos point: fault plans simulate rate
+            # limits/outages without a real Lambda account.
+            chaos.inject('lambda.api', method=method, path=path)
             req = urllib.request.Request(
                 url, data=data, method=method,
                 headers={'Authorization': f'Bearer {self._key}',

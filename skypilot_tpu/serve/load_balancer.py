@@ -172,7 +172,8 @@ class SkyServeLoadBalancer:
                       keyfile: Optional[str] = None) -> int:
         server = self.make_server(host, port, certfile=certfile,
                                   keyfile=keyfile)
-        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread = threading.Thread(target=server.serve_forever,
+                                  name='xsky-serve-lb', daemon=True)
         thread.start()
         return server.server_address[1]
 

@@ -671,7 +671,8 @@ def run_in_thread(host: str = '127.0.0.1',
                   port: int = 0) -> Tuple[ThreadingHTTPServer, int]:
     """Start in a daemon thread (tests + `xsky api start` child)."""
     server = make_server(host, port)
-    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread = threading.Thread(target=server.serve_forever,
+                              name='xsky-api-server', daemon=True)
     thread.start()
     return server, server.server_address[1]
 

@@ -71,6 +71,11 @@ def _get_conn() -> sqlite3.Connection:
     with _lock:
         if _conn is None or _conn_path != path:
             os.makedirs(os.path.dirname(path), exist_ok=True)
+            # xskylint: disable=db-discipline -- the requests DB is
+            # per-API-server-LOCAL by design (each replica owns its
+            # in-flight queue; leases arbitrate cross-replica work),
+            # so it must not pick up db_utils.connect's XSKY_DB_URL
+            # postgres routing; reads still go through StateReader.
             _conn = sqlite3.connect(path, check_same_thread=False)
             _conn.execute('PRAGMA journal_mode=WAL')
             from skypilot_tpu.utils import db_utils

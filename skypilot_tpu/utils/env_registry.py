@@ -1,0 +1,330 @@
+"""The single registry of every ``XSKY_*`` environment variable.
+
+Contract (enforced by the ``env-registry`` xskylint rule): any
+``XSKY_*`` name the tree mentions as a string literal must be declared
+here with its effective default and a one-line doc, and
+``docs/reference/environment.md`` must exactly match
+:func:`render_markdown` — regenerate it with::
+
+    python -m skypilot_tpu.utils.env_registry > docs/reference/environment.md
+
+Why a registry instead of grepping: at introduction, 100 distinct
+``XSKY_*`` reads existed in the tree and only 45 appeared anywhere in
+docs/ — unenforced config surface rots fastest. Keeping the table as
+data (not prose) makes the docs generable and the drift checkable.
+
+This module is DEPENDENCY-FREE by design: the lint engine executes it
+standalone (no package import), so it must never import anything from
+``skypilot_tpu``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+UNSET = None   # rendered as "(unset)"
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvVar:
+    name: str
+    default: Optional[str]   # effective default, as the user would set it
+    doc: str                 # one line; starts capitalized, no period needed
+
+
+_VARS = [
+    # ---- client / API server ----------------------------------------------
+    EnvVar('XSKY_API_SERVER', UNSET,
+           'API-server URL for remote mode (overrides config '
+           'api_server.endpoint; unset = local execution)'),
+    EnvVar('XSKY_API_TOKEN', UNSET,
+           'Bearer token sent by the remote client when the server '
+           'requires auth'),
+    EnvVar('XSKY_AUTH', '',
+           'Auth token the TPU tunnel proxy forwards to the API server'),
+    EnvVar('XSKY_REQUIRE_AUTH', '0',
+           'Set to 1 to make the API server reject unauthenticated '
+           'requests'),
+    EnvVar('XSKY_TUNNEL_ALLOW_ANY', '0',
+           'Set to 1 to let the tunnel endpoint accept any client '
+           '(dev only)'),
+    EnvVar('XSKY_CONFIG', '~/.xsky/config.yaml',
+           'Path of the user config file'),
+    EnvVar('XSKY_SERVER_CONFIG', '/etc/xsky/config.yaml',
+           'Path of the API-server config file'),
+    EnvVar('XSKY_WORKSPACE', 'default',
+           'Active workspace name (multi-tenant cluster namespace)'),
+    EnvVar('XSKY_LONG_WORKERS', '8',
+           'Concurrent long-request workers in the API-server executor'),
+    EnvVar('XSKY_LONG_REQUEST_TIMEOUT_S', '0',
+           'Hard timeout for long requests (0 disables)'),
+    EnvVar('XSKY_WATCHDOG_INTERVAL_S', '2',
+           'Executor watchdog tick: in-flight request lease renewal '
+           'cadence'),
+    EnvVar('XSKY_SERVER_DB', '~/.xsky/server/requests.db',
+           'Path of the API-server requests database'),
+    EnvVar('XSKY_REQUEST_RETENTION_HOURS', '72',
+           'Finished requests older than this are garbage-collected '
+           '(<=0 disables)'),
+    EnvVar('XSKY_REQUEST_RECONCILE_GRACE_S', '5',
+           'Reconciler grace before a leaseless in-flight request '
+           'counts as stranded'),
+    EnvVar('XSKY_RECONCILE_INTERVAL_S', '60',
+           'Background reconciler tick interval'),
+    # ---- OAuth / users -----------------------------------------------------
+    EnvVar('XSKY_OAUTH_ISSUER', '',
+           'OIDC issuer URL; empty disables OAuth login'),
+    EnvVar('XSKY_OAUTH_CLIENT_ID', '',
+           'OAuth client id for the authorization-code flow'),
+    EnvVar('XSKY_OAUTH_CLIENT_SECRET', UNSET,
+           'OAuth client secret (confidential clients)'),
+    EnvVar('XSKY_OAUTH_SCOPE', 'openid profile email',
+           'Scopes requested during OAuth login'),
+    EnvVar('XSKY_OAUTH_USERINFO_TTL_S', '300',
+           'How long validated userinfo responses are cached'),
+    EnvVar('XSKY_USER_HASH', UNSET,
+           'Force the local user hash (multi-user test isolation)'),
+    # ---- state layer -------------------------------------------------------
+    EnvVar('XSKY_STATE_DB', '~/.xsky/state.db',
+           'Path of the shared control-plane state database'),
+    EnvVar('XSKY_DB_URL', UNSET,
+           'postgres:// URL routing the state layer to postgres '
+           '(multi-replica API servers); unset = sqlite'),
+    EnvVar('XSKY_SQLITE_SYNC', 'NORMAL',
+           'PRAGMA synchronous for WAL connections (FULL restores '
+           'per-commit fsync, ~29 ms each on overlayfs)'),
+    EnvVar('XSKY_STATE_READ_POOL', '1',
+           'Per-thread WAL read pool for state reads; 0 restores '
+           'reads-under-the-write-lock (bench comparisons)'),
+    EnvVar('XSKY_STATE_READ_WORKERS', '1',
+           'Width of the read gate: concurrent row-materializing '
+           'readers (raise on hosts with real core counts)'),
+    EnvVar('XSKY_JOURNAL_FLUSH_S', '0',
+           'Journal write-coalescing window; 0 commits per event'),
+    EnvVar('XSKY_LEASE_TTL_S', '60',
+           'Liveness-lease TTL: a holder silent this long counts as '
+           'dead to the reconciler'),
+    # ---- resilience / chaos / tracing / metrics ---------------------------
+    EnvVar('XSKY_CHAOS_PLAN', UNSET,
+           'Fault-injection plan: inline JSON or a path to one '
+           '(unset = chaos disabled, zero overhead)'),
+    EnvVar('XSKY_TRACING', '1',
+           'Set to 0 to disable request-scoped tracing (span() '
+           'returns a no-op singleton)'),
+    EnvVar('XSKY_TRACE_CONTEXT', UNSET,
+           'Internal: <trace_id>:<span_id> handoff to controller/'
+           'worker subprocesses (set by env_for_child)'),
+    EnvVar('XSKY_TIMELINE_FILE', UNSET,
+           'Path enabling the Chrome-trace timeline recorder'),
+    EnvVar('XSKY_DEBUG', '0',
+           'Set to 1 for debug-level logging'),
+    EnvVar('XSKY_MINIMIZE_LOGGING', '0',
+           'Set to 1 to reduce CLI log output to warnings'),
+    EnvVar('XSKY_DISABLE_USAGE_COLLECTION', '0',
+           'Set to 1 to disable anonymous usage reporting'),
+    EnvVar('XSKY_USAGE_ENDPOINT', UNSET,
+           'Override the usage-reporting endpoint'),
+    # ---- catalog -----------------------------------------------------------
+    EnvVar('XSKY_CATALOG_URL_BASE', UNSET,
+           'Base URL of a hosted catalog; set to enable hosted-'
+           'catalog refresh'),
+    EnvVar('XSKY_CATALOG_CACHE_DIR', '~/.xsky/catalogs',
+           'Local cache directory for hosted catalogs'),
+    EnvVar('XSKY_CATALOG_REFRESH_HOURS', '7',
+           'Re-download a hosted catalog after this age'),
+    EnvVar('XSKY_CATALOG_SCHEMA_VERSION', 'v1',
+           'Pinnable hosted-catalog schema directory'),
+    # ---- clouds / provisioning --------------------------------------------
+    EnvVar('XSKY_ENABLE_FAKE_CLOUD', '0',
+           'Set to 1 to enable the fake cloud (tests, benches, '
+           'chaos drills)'),
+    EnvVar('XSKY_FAKE_CLOUD_DIR', '~/.xsky/fake_cloud',
+           'Backing directory of fake-cloud instance state'),
+    EnvVar('XSKY_ENABLE_DOCKER_CLOUD', '0',
+           'Set to 1 to enable the local-docker cloud'),
+    EnvVar('XSKY_SSH_NODE_POOLS', '~/.xsky/ssh_node_pools.yaml',
+           'Path of the ssh-cloud node-pool inventory'),
+    EnvVar('XSKY_SSH_ALLOCATIONS', '~/.xsky/ssh_allocations.json',
+           'Path of the ssh-cloud allocation ledger'),
+    EnvVar('XSKY_STORE_TRANSPORT', UNSET,
+           "Set to 'cli' to force CLI-based object-store transfers "
+           'over the REST client'),
+    EnvVar('XSKY_LOCAL_STORE_DIR', '~/.xsky/local_store',
+           'Backing directory of the local object store'),
+    EnvVar('XSKY_WHEEL_DIR', '~/.xsky/wheels',
+           'Cache directory for the bootstrap wheel synced to '
+           'cluster hosts'),
+    EnvVar('XSKY_BOOTSTRAP_LOCAL', '0',
+           'Set to 1 to build the bootstrap wheel from the local '
+           'tree instead of the cache'),
+    # ---- backend / gang execution -----------------------------------------
+    EnvVar('XSKY_CLUSTER_ROOT', '~/.xsky',
+           'Agent-side runtime root on cluster hosts (jobs.db, logs, '
+           'spools live under it)'),
+    EnvVar('XSKY_FANOUT_WORKERS', '16',
+           'Thread-pool width of per-host fan-out '
+           '(parallelism.run_in_parallel)'),
+    EnvVar('XSKY_NODE_IPS', UNSET,
+           'Set by the gang launcher: newline-separated node IPs of '
+           'the slice'),
+    EnvVar('XSKY_NODE_RANK', UNSET,
+           'Set by the gang launcher: this host\'s node rank'),
+    EnvVar('XSKY_NUM_NODES', UNSET,
+           'Set by the gang launcher: node count of the slice'),
+    EnvVar('XSKY_NUM_HOSTS', '1',
+           'Host count the workload process sees (multi-host '
+           'detection in parallel/distributed.py)'),
+    EnvVar('XSKY_HOST_RANK', '0',
+           'Set by the gang launcher: this host\'s rank; keys the '
+           'telemetry spool'),
+    EnvVar('XSKY_COORDINATOR_ADDRESS', UNSET,
+           'Set by the gang launcher: jax.distributed coordinator '
+           'host:port'),
+    EnvVar('XSKY_JOB_ID', UNSET,
+           'Set by the job runner: the cluster job id of the '
+           'workload process'),
+    EnvVar('XSKY_AGENT_NO_SELF_TEARDOWN', UNSET,
+           'Set to any value to disable agent-side idle '
+           'self-teardown'),
+    # ---- managed jobs ------------------------------------------------------
+    EnvVar('XSKY_JOBS_DB', '~/.xsky/managed_jobs.db',
+           'Path of the managed-jobs database'),
+    EnvVar('XSKY_JOBS_LOG_DIR', '~/.xsky/jobs_logs',
+           'Directory of managed-job controller logs'),
+    EnvVar('XSKY_JOBS_POLL_INTERVAL', '2.0',
+           'Jobs-controller status-probe interval'),
+    EnvVar('XSKY_JOBS_MAX_LAUNCHING', 'min(8, cpus)',
+           'Concurrent managed-job launches (default derives from '
+           'host cpu count)'),
+    EnvVar('XSKY_JOBS_MAX_PARALLEL', 'mem-derived',
+           'Alive managed-job controllers (default derives from '
+           'host memory)'),
+    EnvVar('XSKY_JOBS_MAX_CONTROLLER_RESPAWNS', '3',
+           'Dead-controller respawn budget before a job is failed'),
+    EnvVar('XSKY_JOBS_CONTROLLER_REMOTE', UNSET,
+           'Run the managed-jobs controller on a controller cluster '
+           '(set by the relay; empty string = forced local)'),
+    # ---- serve -------------------------------------------------------------
+    EnvVar('XSKY_SERVE_DB', '~/.xsky/serve.db',
+           'Path of the serve-plane database'),
+    EnvVar('XSKY_SERVE_LOG_DIR', '~/.xsky/serve',
+           'Directory of serve controller/replica logs'),
+    EnvVar('XSKY_SERVE_INTERVAL', '2.0',
+           'Serve-controller tick interval (probe + autoscale)'),
+    EnvVar('XSKY_SERVE_PROBE_RETRIES', '1',
+           'Transient readiness-probe failures absorbed before '
+           'NOT_READY'),
+    EnvVar('XSKY_SERVE_PROBE_TIMEOUT', '5',
+           'Readiness-probe HTTP timeout'),
+    EnvVar('XSKY_SERVE_MAX_CONTROLLER_RESPAWNS', '3',
+           'Dead-serve-controller respawn budget before FAILED'),
+    EnvVar('XSKY_SERVE_CONTROLLER_REMOTE', UNSET,
+           'Run the serve controller on a controller cluster (set by '
+           'the relay; empty string = forced local)'),
+    # ---- workload telemetry ------------------------------------------------
+    EnvVar('XSKY_TELEMETRY', '1',
+           'Set to 0 to disable workload telemetry emission entirely'),
+    EnvVar('XSKY_TELEMETRY_DIR', UNSET,
+           'Telemetry spool directory (set by the gang launcher; '
+           'unset = emit() is a no-op)'),
+    EnvVar('XSKY_TELEMETRY_INTERVAL_S', '2',
+           'Spool write interval (never per step: per-step writes '
+           'measured 8x loop cost)'),
+    EnvVar('XSKY_TELEMETRY_HB_STALE_S', '30',
+           'Heartbeat staleness after which a rank is DEAD'),
+    EnvVar('XSKY_TELEMETRY_PROGRESS_STALE_S', '300',
+           'Progress staleness after which a live-heartbeat rank is '
+           'HUNG'),
+    EnvVar('XSKY_TELEMETRY_PULL_INTERVAL_S', '10',
+           'Control-plane spool-pull rate limit'),
+    # ---- device profiling --------------------------------------------------
+    EnvVar('XSKY_PROFILE', '1',
+           'Set to 0 to disable the always-on step-anatomy sampler'),
+    EnvVar('XSKY_PROFILE_SAMPLE_EVERY', '16',
+           'Sample every Nth step with a block_until_ready probe'),
+    EnvVar('XSKY_PROFILE_WARMUP_STEPS', '8',
+           'Compiles within the first N steps are warmup, not a '
+           'recompile storm'),
+    EnvVar('XSKY_PROFILE_STALE_S', '600',
+           'Profile summary lagging its rank\'s heartbeat by this '
+           'much is verdicted stale'),
+    EnvVar('XSKY_PROFILE_HOSTBOUND_RATIO', '0.5',
+           'dispatch/(dispatch+device) above this ⇒ host-bound '
+           'verdict'),
+    EnvVar('XSKY_PROFILE_RECOMPILE_N', '3',
+           'Post-warmup compiles at or above this ⇒ recompile-storm '
+           'verdict'),
+    EnvVar('XSKY_PROFILE_HBM_PRESSURE', '0.92',
+           'HBM peak/limit at or above this ⇒ hbm-pressure verdict'),
+    EnvVar('XSKY_PROFILER_FAKE', '0',
+           'Set to 1 for the fake profiler seam (no jax import; '
+           'fake-cloud drills)'),
+    EnvVar('XSKY_PROFILER_FAKE_DISPATCH_S', '0.001',
+           'Fake profiler: synthetic per-step host dispatch gap'),
+    EnvVar('XSKY_PROFILER_FAKE_DEVICE_S', '0.004',
+           'Fake profiler: synthetic per-step device time'),
+    EnvVar('XSKY_PROFILER_FAKE_HBM_USE', '2147483648',
+           'Fake profiler: synthetic HBM bytes in use (2 GiB)'),
+    EnvVar('XSKY_PROFILER_FAKE_HBM_LIMIT', '17179869184',
+           'Fake profiler: synthetic HBM byte limit (16 GiB)'),
+    # ---- compute path ------------------------------------------------------
+    EnvVar('XSKY_DECODE_ATTN', UNSET,
+           "Set to 'xla' to route decode attention through XLA "
+           'instead of the Pallas kernel'),
+    EnvVar('XSKY_DECODE_BLOCK_KV', '256',
+           'KV block size of the Pallas decode-attention kernel'),
+    EnvVar('XSKY_FLASH_BLOCK_Q', '512',
+           'Q block size of the Pallas flash-attention kernel'),
+    EnvVar('XSKY_FLASH_BLOCK_KV', '512',
+           'KV block size of the Pallas flash-attention kernel'),
+    EnvVar('XSKY_NATIVE_CACHE', '~/.xsky/native',
+           'Cache directory of the native data-loader extension'),
+]
+
+REGISTRY: Dict[str, EnvVar] = {v.name: v for v in _VARS}
+assert len(REGISTRY) == len(_VARS), 'duplicate env var declaration'
+
+
+def declared_names() -> set:
+    return set(REGISTRY)
+
+
+def render_markdown() -> str:
+    """docs/reference/environment.md, exactly. The env-registry lint
+    diffs the committed file against this rendering."""
+    lines = [
+        '# Environment variables',
+        '',
+        '<!-- GENERATED FILE — do not edit by hand. Regenerate with:',
+        '     python -m skypilot_tpu.utils.env_registry '
+        '> docs/reference/environment.md -->',
+        '',
+        'Every `XSKY_*` variable the tree reads, generated from',
+        '`skypilot_tpu/utils/env_registry.py` (the authoritative',
+        'registry — the `env-registry` lint in',
+        '[static analysis](../static-analysis.md) rejects reads of',
+        'undeclared variables and a stale copy of this page).',
+        '',
+        '| Variable | Default | What it does |',
+        '|---|---|---|',
+    ]
+    for name in sorted(REGISTRY):
+        var = REGISTRY[name]
+        if var.default is None:
+            default = '(unset)'
+        elif var.default == '':
+            default = '(empty)'
+        else:
+            default = f'`{var.default}`'
+        lines.append(f'| `{name}` | {default} | {var.doc} |')
+    lines.append('')
+    return '\n'.join(lines)
+
+
+def main() -> int:
+    print(render_markdown(), end='')
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

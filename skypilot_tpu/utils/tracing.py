@@ -86,22 +86,38 @@ def current_trace_id() -> Optional[str]:
 
 def env_for_child(env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
     """A copy of `env` (default os.environ) carrying the current trace
-    context, for detached controller/worker subprocesses."""
-    out = dict(env if env is not None else os.environ)
-    ctx = capture()
-    if ctx is not None and enabled():
-        out[ENV_TRACE_CONTEXT] = f'{ctx[0]}:{ctx[1]}'
-    else:
-        out.pop(ENV_TRACE_CONTEXT, None)
-    return out
+    context, for detached controller/worker subprocesses. Never raises
+    — it sits on the controller-spawn path, and tracing must not take
+    down a spawn it merely annotates."""
+    out = None
+    try:
+        out = dict(env if env is not None else os.environ)
+        ctx = capture()
+        if ctx is not None and enabled():
+            out[ENV_TRACE_CONTEXT] = f'{ctx[0]}:{ctx[1]}'
+        else:
+            out.pop(ENV_TRACE_CONTEXT, None)
+        return out
+    except Exception:  # pylint: disable=broad-except
+        # `out` already holds the plain copy unless dict() itself
+        # rejected the input — the handler must stay provably
+        # non-raising (the never-raise rule checks it), so no calls
+        # here.
+        if out is None:
+            out = {}
+        return out
 
 
 def annotate_append(key: str, value: Any) -> None:
     """Append `value` to a list-valued attribute of the current span
-    (used by chaos to record every fault injected under the span)."""
-    cur = _ctx.get()
-    if isinstance(cur, Span):
-        cur.attrs.setdefault(key, []).append(value)
+    (used by chaos to record every fault injected under the span).
+    Never raises."""
+    try:
+        cur = _ctx.get()
+        if isinstance(cur, Span):
+            cur.attrs.setdefault(key, []).append(value)
+    except Exception:  # pylint: disable=broad-except
+        pass
 
 
 class _NoopSpan:
@@ -246,11 +262,14 @@ def flush() -> None:
     """Drain the span buffer to the state DB. Never raises. Called at
     root-span exit / process exit; tests call it before reading
     spans of still-open traces."""
-    with _buffer_lock:
-        rows = list(_buffer)
-        _buffer.clear()
-    if rows:
-        _write(rows)
+    try:
+        with _buffer_lock:
+            rows = list(_buffer)
+            _buffer.clear()
+        if rows:
+            _write(rows)
+    except Exception:  # pylint: disable=broad-except
+        pass
 
 
 def _write(rows: List[Dict[str, Any]]) -> None:
@@ -279,20 +298,26 @@ def span(name: str, parent: Optional[Tuple[str, str]] = None,
     request boundary. `parent` overrides the ambient context (thread
     fan-out: pass the :func:`capture` of the spawning thread).
     """
-    if not enabled():
+    try:
+        if not enabled():
+            return NOOP_SPAN
+        if parent is not None:
+            # Explicit parent (thread fan-out): the spawning thread's
+            # span owns the buffer flush.
+            return Span(name, parent[0], parent[1], attrs)
+        # No in-process parent Span ⇒ this span is the top of THIS
+        # process's contribution (a fresh root, or env-inherited
+        # trace): its exit flushes the buffer.
+        top = not isinstance(_ctx.get(), Span)
+        ctx = capture()
+        if ctx is None:
+            return Span(name, new_trace_id(), None, attrs,
+                        process_top=top)
+        return Span(name, ctx[0], ctx[1], attrs, process_top=top)
+    except Exception:  # pylint: disable=broad-except
+        # Tracing must never take down the path it measures: a failed
+        # span open degrades to not recording this operation.
         return NOOP_SPAN
-    if parent is not None:
-        # Explicit parent (thread fan-out): the spawning thread's span
-        # owns the buffer flush.
-        return Span(name, parent[0], parent[1], attrs)
-    # No in-process parent Span ⇒ this span is the top of THIS
-    # process's contribution (a fresh root, or env-inherited trace):
-    # its exit flushes the buffer.
-    top = not isinstance(_ctx.get(), Span)
-    ctx = capture()
-    if ctx is None:
-        return Span(name, new_trace_id(), None, attrs, process_top=top)
-    return Span(name, ctx[0], ctx[1], attrs, process_top=top)
 
 
 def request_span(trace_id: Optional[str], name: str, **attrs: Any) -> Any:
@@ -300,8 +325,11 @@ def request_span(trace_id: Optional[str], name: str, **attrs: Any) -> Any:
     trace_id was minted at acceptance so the id is known before the
     work runs. Falls back to :func:`span` semantics when tracing is
     disabled or no id was minted."""
-    if not enabled():
+    try:
+        if not enabled():
+            return NOOP_SPAN
+        if trace_id is None:
+            return span(name, **attrs)
+        return Span(name, trace_id, None, attrs, process_top=True)
+    except Exception:  # pylint: disable=broad-except
         return NOOP_SPAN
-    if trace_id is None:
-        return span(name, **attrs)
-    return Span(name, trace_id, None, attrs, process_top=True)

@@ -1,0 +1,522 @@
+"""tools/xskylint: engine mechanics (parse-once, suppression syntax,
+JSON), a positive/negative synthetic fixture pair for EVERY registered
+rule (a self-check fails if a rule ships without one), and the tier-1
+gate that runs the full engine over the real tree and asserts zero
+unsuppressed findings."""
+import ast
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), '..', '..'))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.xskylint import all_rules  # noqa: E402
+from tools.xskylint import engine  # noqa: E402
+
+# ---- fixtures: one (bad, clean) tree per rule ------------------------------
+# Each is {repo-relative path: source}; paths matter — rules scope by
+# file (e.g. no-raw-sleep only watches the instrumented modules).
+
+_MINI_ENV_REGISTRY = '''\
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvVar:
+    name: str
+    default: object
+    doc: str
+
+
+REGISTRY = {{
+{entries}
+}}
+
+
+def render_markdown():
+    return 'unused in fixtures'
+'''
+
+
+def _registry(*names):
+    entries = '\n'.join(
+        f"    '{n}': EnvVar('{n}', '1', 'A test variable.'),"
+        for n in names)
+    return _MINI_ENV_REGISTRY.format(entries=entries)
+
+
+FIXTURES = {
+    'no-raw-sleep': (
+        {'skypilot_tpu/jobs/controller.py':
+            'import time\n'
+            'def poll():\n'
+            '    while True:\n'
+            '        time.sleep(1)\n'},
+        {'skypilot_tpu/jobs/controller.py':
+            'from skypilot_tpu.utils import resilience\n'
+            'def poll():\n'
+            '    while True:\n'
+            '        resilience.sleep(1)\n'},
+    ),
+    'no-sequential-runner-loop': (
+        {'skypilot_tpu/backends/setup.py':
+            'def setup(runners):\n'
+            '    for rank, runner in enumerate(runners):\n'
+            '        runner.run("true")\n'},
+        {'skypilot_tpu/backends/setup.py':
+            'def setup(runners):\n'
+            '    def _one(pair):\n'
+            '        rank, runner = pair\n'
+            '        runner.run("true")\n'
+            '    run_in_parallel(_one, list(enumerate(runners)))\n'},
+    ),
+    'thread-hygiene': (
+        {'skypilot_tpu/jobs/spawn.py':
+            'import subprocess\n'
+            'import threading\n'
+            'def go(f):\n'
+            '    threading.Thread(target=f, daemon=True).start()\n'
+            'def launch(cmd):\n'
+            '    return subprocess.Popen(cmd)\n'},
+        {'skypilot_tpu/jobs/spawn.py':
+            'import subprocess\n'
+            'import threading\n'
+            'def go(f):\n'
+            '    threading.Thread(target=f, name="xsky-go",\n'
+            '                     daemon=True).start()\n'
+            'def launch(cmd, job_id):\n'
+            '    proc = subprocess.Popen(cmd)\n'
+            '    set_controller_pid(job_id, proc.pid)\n'
+            '    return proc\n'},
+    ),
+    'span-fanout': (
+        {'skypilot_tpu/backends/fan.py':
+            'def setup(runners):\n'
+            '    parallelism.run_in_parallel(f, runners)\n'},
+        {'skypilot_tpu/backends/fan.py':
+            'def setup(runners):\n'
+            '    with tracing.span("setup"):\n'
+            '        parallelism.run_in_parallel(f, runners)\n'},
+    ),
+    'span-failover': (
+        {'skypilot_tpu/backends/failover.py':
+            'def provision(self):\n'
+            '    for _ in range(3):\n'
+            '        self._try_resources(r)\n'},
+        {'skypilot_tpu/backends/failover.py':
+            'def provision(self):\n'
+            '    with tracing.span("failover.provision"):\n'
+            '        for _ in range(3):\n'
+            '            self._try_resources(r)\n'},
+    ),
+    'span-profiler': (
+        {'skypilot_tpu/core.py':
+            'def cap(backend, handle):\n'
+            '    backend.capture_device_profile(handle)\n'},
+        {'skypilot_tpu/core.py':
+            'def cap(backend, handle):\n'
+            '    with tracing.span("profile.capture"):\n'
+            '        backend.capture_device_profile(handle)\n'},
+    ),
+    'retention-bound': (
+        {'skypilot_tpu/state.py':
+            'CREATE = """CREATE TABLE IF NOT EXISTS foo_telemetry '
+            '(x INT);"""\n'},
+        {'skypilot_tpu/state.py':
+            '_MAX_SPANS = 100\n'
+            'CREATE = """CREATE TABLE IF NOT EXISTS spans (x INT);"""\n'
+            'PRUNE = "DELETE FROM spans WHERE 1"\n'},
+    ),
+    'lease-heartbeat': (
+        {'skypilot_tpu/jobs/scheduler.py':
+            'def acquire_launch_slot(job_id):\n'
+            '    while True:\n'
+            '        tick()\n'},
+        {'skypilot_tpu/jobs/scheduler.py':
+            'def acquire_launch_slot(job_id):\n'
+            '    while True:\n'
+            '        lease_heartbeat(job_id)\n'
+            '        tick()\n'},
+    ),
+    'telemetry-poll': (
+        {'skypilot_tpu/backends/tpu_gang_backend.py':
+            'def _wait_job(self):\n'
+            '    while True:\n'
+            '        self._job_status()\n'},
+        {'skypilot_tpu/backends/tpu_gang_backend.py':
+            'def _wait_job(self):\n'
+            '    while True:\n'
+            '        self._pull_workload_telemetry()\n'},
+    ),
+    'never-raise': (
+        {'skypilot_tpu/utils/metrics.py':
+            'def inc_counter(name, help_text, value=1.0, **labels):\n'
+            '    try:\n'
+            '        _bump(name, value, labels)\n'
+            '    except Exception:\n'
+            '        pass\n'
+            'def observe(name, help_text, value, **labels):\n'
+            '    _record(name, value, labels)\n'},
+        {'skypilot_tpu/utils/metrics.py':
+            'def inc_counter(name, help_text, value=1.0, **labels):\n'
+            '    try:\n'
+            '        _bump(name, value, labels)\n'
+            '    except Exception:\n'
+            '        pass\n'
+            'def observe(name, help_text, value, **labels):\n'
+            '    try:\n'
+            '        _record(name, value, labels)\n'
+            '    except Exception:\n'
+            '        pass\n'},
+    ),
+    'select-limit': (
+        {'skypilot_tpu/state.py':
+            'def list_things():\n'
+            "    return _read('SELECT x FROM t')\n"},
+        {'skypilot_tpu/state.py':
+            'def list_paged():\n'
+            "    return _read('SELECT x FROM t LIMIT 5')\n"
+            'def list_helper(limit):\n'
+            "    q = 'SELECT x FROM t' + _page_sql(limit)\n"
+            '    return _read(q)\n'
+            'def list_exempt():\n'
+            '    # full-scan ok: one row per enabled cloud.\n'
+            "    return _read('SELECT x FROM t')\n"
+            'def get_thing(conn):\n'
+            "    return conn.execute('SELECT x FROM t').fetchone()\n"},
+    ),
+    'db-discipline': (
+        {'skypilot_tpu/jobs/state.py':
+            'import sqlite3\n'
+            'def _db(path):\n'
+            '    return sqlite3.connect(path)\n'},
+        {'skypilot_tpu/jobs/state.py':
+            'from skypilot_tpu.utils import db_utils\n'
+            'def _db(path):\n'
+            '    return db_utils.connect(path)\n'},
+    ),
+    'env-registry': (
+        {'skypilot_tpu/utils/env_registry.py': _registry('XSKY_KNOWN'),
+         'skypilot_tpu/conf.py':
+            'import os\n'
+            "A = os.environ.get('XSKY_KNOWN', '1')\n"
+            "B = os.environ.get('XSKY_MYSTERY')\n"},
+        {'skypilot_tpu/utils/env_registry.py':
+            _registry('XSKY_KNOWN', 'XSKY_MYSTERY'),
+         'skypilot_tpu/conf.py':
+            'import os\n'
+            "A = os.environ.get('XSKY_KNOWN', '1')\n"
+            "B = os.environ.get('XSKY_MYSTERY')\n"},
+    ),
+    'chaos-coverage': (
+        {'skypilot_tpu/provision/probe.py':
+            'def call(self):\n'
+            '    def attempt():\n'
+            '        return do_request()\n'
+            '    return resilience.retry_transient(attempt)\n'},
+        {'skypilot_tpu/provision/probe.py':
+            'def call(self):\n'
+            '    def attempt():\n'
+            "        chaos.inject('probe.api')\n"
+            '        return do_request()\n'
+            '    return resilience.retry_transient(attempt)\n'},
+    ),
+}
+
+
+def _write_tree(root, files):
+    for rel, source in files.items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, 'w', encoding='utf-8') as f:
+            f.write(source)
+
+
+def _run(root, rule_id=None, **kwargs):
+    rule_ids = [rule_id] if rule_id else None
+    return engine.lint_paths(str(root), ['.'], rule_ids=rule_ids,
+                             **kwargs)
+
+
+class TestRuleFixtures:
+    """Every registered rule catches its synthetic violation and stays
+    quiet on the clean twin."""
+
+    def test_every_rule_has_a_fixture_pair(self):
+        registered = {r.id for r in all_rules()}
+        assert registered == set(FIXTURES), (
+            'rules without fixtures (add a (bad, clean) pair to '
+            f'FIXTURES): {sorted(registered ^ set(FIXTURES))}')
+
+    @pytest.mark.parametrize('rule_id', sorted(FIXTURES))
+    def test_rule_catches_its_violation(self, rule_id, tmp_path):
+        bad, _ = FIXTURES[rule_id]
+        _write_tree(tmp_path, bad)
+        result = _run(tmp_path, rule_id)
+        assert [f for f in result.unsuppressed if f.rule == rule_id], \
+            f'{rule_id} missed its synthetic violation'
+
+    @pytest.mark.parametrize('rule_id', sorted(FIXTURES))
+    def test_rule_passes_the_clean_twin(self, rule_id, tmp_path):
+        _, clean = FIXTURES[rule_id]
+        _write_tree(tmp_path, clean)
+        result = _run(tmp_path, rule_id)
+        assert not result.unsuppressed, [
+            f.render() for f in result.unsuppressed]
+
+
+class TestEngine:
+
+    def test_parses_each_file_exactly_once(self, tmp_path):
+        """The acceptance criterion: ALL rules share one parse per
+        file (the scattered legacy lints each re-parsed the tree)."""
+        files = {
+            'skypilot_tpu/a.py': 'x = 1\n',
+            'skypilot_tpu/backends/b.py': 'def f():\n    pass\n',
+            'skypilot_tpu/utils/env_registry.py': _registry('XSKY_A'),
+        }
+        _write_tree(tmp_path, files)
+        calls = []
+
+        def counting_parse(source, filename='<unknown>', **kw):
+            calls.append(filename)
+            return ast.parse(source, filename=filename, **kw)
+
+        result = _run(tmp_path, rule_id=None, parse=counting_parse)
+        assert result.files_scanned == len(files)
+        assert sorted(calls) == sorted(files), (
+            'ast.parse must run exactly once per file for ALL rules '
+            f'combined; saw {calls}')
+
+    def test_suppression_same_line_and_comment_block(self, tmp_path):
+        src = (
+            'import threading\n'
+            'def a(f):\n'
+            '    threading.Thread(target=f).start()'
+            '  # xskylint: disable=thread-hygiene -- fixture thread\n'
+            'def b(f):\n'
+            '    # A longer explanation of why this one is exempt.\n'
+            '    # xskylint: disable=thread-hygiene -- fixture thread\n'
+            '    # (directive sits inside the comment block above).\n'
+            '    threading.Thread(target=f).start()\n')
+        _write_tree(tmp_path, {'skypilot_tpu/t.py': src})
+        result = _run(tmp_path, 'thread-hygiene')
+        assert not result.unsuppressed, [
+            f.render() for f in result.unsuppressed]
+        assert sum(f.suppressed for f in result.findings) == 2
+        assert all(f.reason == 'fixture thread'
+                   for f in result.findings if f.suppressed)
+
+    def test_suppression_without_reason_is_a_finding(self, tmp_path):
+        src = ('import threading\n'
+               'def a(f):\n'
+               '    threading.Thread(target=f).start()'
+               '  # xskylint: disable=thread-hygiene\n')
+        _write_tree(tmp_path, {'skypilot_tpu/t.py': src})
+        result = _run(tmp_path, 'thread-hygiene')
+        rules = {f.rule for f in result.unsuppressed}
+        # The reasonless directive suppresses nothing AND is itself
+        # flagged.
+        assert rules == {engine.SUPPRESSION_RULE, 'thread-hygiene'}
+
+    def test_suppression_of_unknown_rule_is_a_finding(self, tmp_path):
+        src = ('x = 1  # xskylint: disable=no-such-rule -- oops\n')
+        _write_tree(tmp_path, {'skypilot_tpu/t.py': src})
+        result = _run(tmp_path)
+        assert [f for f in result.unsuppressed
+                if f.rule == engine.SUPPRESSION_RULE and
+                'no-such-rule' in f.message]
+
+    def test_suppressing_a_different_rule_does_not_mask(self, tmp_path):
+        src = ('import threading\n'
+               'def a(f):\n'
+               '    threading.Thread(target=f).start()'
+               '  # xskylint: disable=select-limit -- wrong rule\n')
+        _write_tree(tmp_path, {'skypilot_tpu/t.py': src})
+        result = _run(tmp_path, 'thread-hygiene')
+        assert [f for f in result.unsuppressed
+                if f.rule == 'thread-hygiene']
+
+    def test_finalize_phase_findings_honor_suppressions(self, tmp_path):
+        """env-registry reports from finalize(); its findings must
+        still be suppressible at the use site like any other rule's."""
+        files = {
+            'skypilot_tpu/utils/env_registry.py': _registry('XSKY_A'),
+            'skypilot_tpu/conf.py':
+                'import os\n'
+                '# xskylint: disable=env-registry -- fixture-only var\n'
+                "B = os.environ.get('XSKY_MYSTERY')\n",
+        }
+        _write_tree(tmp_path, files)
+        result = _run(tmp_path, 'env-registry')
+        assert not result.unsuppressed, [
+            f.render() for f in result.unsuppressed]
+        assert any(f.suppressed and f.rule == 'env-registry'
+                   for f in result.findings)
+
+    def test_nonexistent_path_is_an_error_not_a_green_run(self,
+                                                          tmp_path):
+        """A typo'd path must not report '0 files, 0 findings'."""
+        with pytest.raises(FileNotFoundError):
+            engine.lint_paths(str(tmp_path), ['no_such_dir'])
+        proc = subprocess.run(
+            [sys.executable, '-m', 'tools.xskylint', 'no_such_dir'],
+            cwd=REPO, capture_output=True, text=True, check=False)
+        assert proc.returncode == 2
+        assert 'no_such_dir' in proc.stderr
+
+    def test_never_raise_rejects_risky_else_and_finally(self, tmp_path):
+        """else:/finally: bodies run outside the handlers' protection
+        — raising code there must not pass the never-raise check."""
+        src = (
+            'def inc_counter(name, help_text, value=1.0, **labels):\n'
+            '    try:\n'
+            '        pass\n'
+            '    except Exception:\n'
+            '        pass\n'
+            '    else:\n'
+            '        do_risky_thing()\n'
+            'def observe(name, help_text, value, **labels):\n'
+            '    try:\n'
+            '        pass\n'
+            '    except Exception:\n'
+            '        pass\n'
+            '    finally:\n'
+            '        do_risky_thing()\n')
+        _write_tree(tmp_path, {'skypilot_tpu/utils/metrics.py': src})
+        result = _run(tmp_path, 'never-raise')
+        assert len([f for f in result.unsuppressed
+                    if f.rule == 'never-raise']) == 2
+
+    def test_never_raise_rejects_risky_handler_body(self, tmp_path):
+        """The except body is the fallback path — an exception thrown
+        FROM it escapes, so calls there fail the check (the exact hole
+        env_for_child's original dict(env) fallback fell through)."""
+        src = (
+            'def inc_counter(name, help_text, value=1.0, **labels):\n'
+            '    try:\n'
+            '        _bump(name, value, labels)\n'
+            '    except Exception:\n'
+            '        return dict(labels)\n'
+            'def observe(name, help_text, value, **labels):\n'
+            '    try:\n'
+            '        _record(name, value, labels)\n'
+            '    except Exception:\n'
+            '        pass\n')
+        _write_tree(tmp_path, {'skypilot_tpu/utils/metrics.py': src})
+        result = _run(tmp_path, 'never-raise')
+        findings = [f for f in result.unsuppressed
+                    if f.rule == 'never-raise']
+        assert len(findings) == 1
+        assert 'inc_counter' in findings[0].message
+
+    def test_env_for_child_never_raises_on_malformed_env(self):
+        """Live form of the review repro: a non-dict env argument must
+        not escape the never-raise guard."""
+        from skypilot_tpu.utils import tracing
+        out = tracing.env_for_child('PATH=1')   # dict() rejects this
+        assert out == {}
+        assert isinstance(tracing.env_for_child(), dict)
+
+    def test_chaos_coverage_accepts_transitive_inject(self, tmp_path):
+        """A failover loop is covered when its attempt helper reaches
+        chaos.inject through same-file calls — the points live INSIDE
+        the helpers' failure handling on purpose (an inject lexically
+        in the loop body would abort the whole walk)."""
+        src = (
+            'def provision(self):\n'
+            '    for _ in range(3):\n'
+            '        self._try_resources(r)\n'
+            'def _try_resources(self, r):\n'
+            '    for zone in zones:\n'
+            '        self._try_zone(r, zone)\n'
+            'def _try_zone(self, r, zone):\n'
+            "    chaos.inject('failover.wait_instances')\n")
+        _write_tree(tmp_path, {'skypilot_tpu/backends/failover.py': src})
+        result = _run(tmp_path, 'chaos-coverage')
+        assert not result.unsuppressed, [
+            f.render() for f in result.unsuppressed]
+
+    def test_syntax_error_is_reported_not_raised(self, tmp_path):
+        _write_tree(tmp_path, {'skypilot_tpu/broken.py': 'def f(:\n'})
+        result = _run(tmp_path)
+        assert [f for f in result.unsuppressed
+                if f.rule == engine.PARSE_RULE]
+
+    def test_unknown_rule_id_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            engine.lint_paths(str(tmp_path), ['.'],
+                              rule_ids=['no-such-rule'])
+
+    def test_json_round_trip(self, tmp_path):
+        bad, _ = FIXTURES['span-fanout']
+        _write_tree(tmp_path, bad)
+        result = _run(tmp_path, 'span-fanout')
+        payload = json.loads(json.dumps(result.to_json()))
+        assert payload['unsuppressed_count'] == 1
+        (finding,) = payload['findings']
+        assert finding['rule'] == 'span-fanout'
+        assert finding['path'] == 'skypilot_tpu/backends/fan.py'
+        assert finding['line'] == 2
+        assert not finding['suppressed']
+
+
+class TestTier1Gate:
+    """`xsky lint` as a pytest gate: the real tree must be clean."""
+
+    def test_repo_is_lint_clean(self):
+        result = engine.lint_paths(REPO, ['skypilot_tpu', 'tools'])
+        assert not result.unsuppressed, (
+            'xskylint findings in the tree (fix them or suppress '
+            'with `# xskylint: disable=<rule> -- <reason>`):\n  ' +
+            '\n  '.join(f.render() for f in result.unsuppressed))
+        # The three genuine exemptions (agent-local DBs, the
+        # replica-local requests DB) stay suppressed WITH reasons.
+        assert all(f.reason for f in result.findings if f.suppressed)
+
+    def test_cli_json_gate(self):
+        """The subprocess entry point: exit 0 on the clean tree and
+        parseable --json (the dashboard contract)."""
+        proc = subprocess.run(
+            [sys.executable, '-m', 'tools.xskylint', 'skypilot_tpu',
+             'tools', '--json'],
+            cwd=REPO, capture_output=True, text=True, check=False)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        payload = json.loads(proc.stdout)
+        assert payload['unsuppressed_count'] == 0
+        assert payload['files_scanned'] > 200
+        assert set(payload['rules']) == {r.id for r in all_rules()}
+
+    def test_env_docs_regenerate_and_diff(self):
+        """docs/reference/environment.md is byte-identical to the
+        registry rendering (the env-registry rule's staleness check,
+        asserted directly so a drift names THIS test)."""
+        from skypilot_tpu.utils import env_registry
+        with open(os.path.join(REPO, 'docs', 'reference',
+                               'environment.md'),
+                  encoding='utf-8') as f:
+            committed = f.read()
+        assert committed == env_registry.render_markdown(), (
+            'docs/reference/environment.md is stale — regenerate with '
+            '`python -m skypilot_tpu.utils.env_registry > '
+            'docs/reference/environment.md`')
+
+    def test_env_registry_covers_every_read(self):
+        """Direct form of the env-registry contract (the lint gate
+        covers it too; this failure message is more specific)."""
+        from skypilot_tpu.utils import env_registry
+        result = engine.lint_paths(REPO, ['skypilot_tpu'],
+                                   rule_ids=['env-registry'])
+        assert not result.unsuppressed, [
+            f.render() for f in result.unsuppressed]
+        # And the registry itself is well-formed: every entry
+        # documents a name that matches its key.
+        for name, var in env_registry.REGISTRY.items():
+            assert name == var.name
+            assert var.doc.strip()

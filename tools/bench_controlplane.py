@@ -326,8 +326,9 @@ def _saturate(port: int, verb: str, op_factory, duration_s: float,
             with lock:
                 latencies.append(time.monotonic() - t0)
 
-    threads = [threading.Thread(target=worker, daemon=True)
-               for _ in range(workers)]
+    threads = [threading.Thread(target=worker, daemon=True,
+                                name=f'bench-closed-{i}')
+               for i in range(workers)]
     t_start = time.monotonic()
     for t in threads:
         t.start()
@@ -386,9 +387,11 @@ def _open_loop(port: int, op_factory, mix: dict, total_qps: float,
             with lock:
                 results[verb].append(time.monotonic() - scheduled)
 
-    threads = [threading.Thread(target=worker, daemon=True)
-               for _ in range(workers)]
-    sched = threading.Thread(target=scheduler, daemon=True)
+    threads = [threading.Thread(target=worker, daemon=True,
+                                name=f'bench-open-{i}')
+               for i in range(workers)]
+    sched = threading.Thread(target=scheduler, daemon=True,
+                             name='bench-open-sched')
     for t in threads:
         t.start()
     t_start = time.monotonic()

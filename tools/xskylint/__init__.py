@@ -1,0 +1,26 @@
+"""xskylint: the control plane's static-analysis engine.
+
+One ``ast.parse`` per file; every rule runs as a visitor over the
+shared tree. The rules encode the distributed-systems contracts the
+orchestrator survives by — gang-shaped fan-out, lease heartbeats,
+bounded observability tables, never-raise recording paths, the env-var
+registry, WAL-pool DB discipline — so every future PR is checked
+against them mechanically instead of by reviewer memory.
+
+Entry points::
+
+    python -m tools.xskylint [paths...] [--json]
+    xsky lint [paths...] [--json]
+
+Suppression syntax (reason mandatory)::
+
+    offending_line()   # xskylint: disable=<rule-id> -- <why exempt>
+
+See docs/static-analysis.md for the rule catalog.
+"""
+from tools.xskylint.engine import (Finding, LintEngine, Rule, lint_paths,
+                                   main)
+from tools.xskylint.rules import all_rules
+
+__all__ = ['Finding', 'LintEngine', 'Rule', 'all_rules', 'lint_paths',
+           'main']

@@ -1,0 +1,7 @@
+"""``python -m tools.xskylint`` — see engine.main for flags."""
+import sys
+
+from tools.xskylint.engine import main
+
+if __name__ == '__main__':
+    sys.exit(main())

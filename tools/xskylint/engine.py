@@ -1,0 +1,460 @@
+"""The xskylint engine: parse once, run every rule over the shared AST.
+
+Replaces the eight ad-hoc AST lints that grew inside
+``tests/unit_tests/test_chaos.py`` (each re-parsing and re-walking the
+tree with its own skip-list and exemption syntax) with one framework:
+
+  * **One parse per file.** ``ast.parse`` runs exactly once per
+    scanned file; rules receive the shared tree. An engine unit test
+    counts the calls, so the single-pass property is load-bearing, not
+    aspirational.
+  * **One shared walk.** The engine performs a single recursive walk
+    maintaining the lexical state the legacy lints each recomputed —
+    enclosing function, loop membership, ``with tracing.span(...)``
+    coverage — and hands every node to every interested rule. Rules
+    needing whole-function analysis (heartbeat loops, SELECT paging)
+    do it from ``end_file`` on the same tree; nothing re-parses.
+  * **One suppression syntax.** ``# xskylint: disable=<rule> -- <reason>``
+    on the offending line or the line above. The reason is mandatory:
+    a directive without one is itself a finding, as is a directive
+    naming an unknown rule (a typo'd id would otherwise silently
+    suppress nothing). Legacy markers keep working through
+    :data:`LEGACY_MARKERS` so historical exemptions did not need a
+    flag-day rewrite.
+
+Rules live in ``tools/xskylint/rules/``; docs/static-analysis.md is
+the catalog and how-to-add-a-rule guide.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import os
+import re
+import sys
+from typing import Any, Callable, Dict, Iterable, List, Optional, Set
+
+# Pre-engine exemption comments that must keep working (the legacy
+# lints shipped them and the tree uses them): marker substring → the
+# rule id it suppresses. Rules consult this via
+# :func:`legacy_markers_for`; the marker's own comment carries the
+# reason (e.g. ``# full-scan ok: one row per enabled cloud``), which
+# is why no ``--`` reason is re-required.
+LEGACY_MARKERS: Dict[str, str] = {
+    '# full-scan ok': 'select-limit',
+}
+
+# Engine-minted finding ids (not registered rules; not suppressible —
+# fixing the directive is the only way out).
+SUPPRESSION_RULE = 'suppression-syntax'
+PARSE_RULE = 'parse-error'
+
+_SUPPRESS_RE = re.compile(
+    r'#\s*xskylint:\s*disable=([A-Za-z0-9_,\-]+)'
+    r'(?:\s+--\s*(\S.*))?')
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation (or suppressed would-be violation)."""
+    rule: str
+    path: str          # repo-relative, posix separators
+    line: int
+    message: str
+    suppressed: bool = False
+    reason: Optional[str] = None   # the suppression's mandatory reason
+
+    def render(self) -> str:
+        tail = f' (suppressed: {self.reason})' if self.suppressed else ''
+        return f'{self.path}:{self.line}: [{self.rule}] ' \
+               f'{self.message}{tail}'
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class WalkState:
+    """Lexical state the shared walk maintains for every node.
+
+    ``in_loop`` deliberately survives function boundaries (a helper
+    defined inside a retry loop still runs per iteration) — the
+    semantics the legacy no-raw-sleep lint shipped with.
+    ``span_covered`` resets at function boundaries: a span enclosing
+    only the *definition* of a nested function does not cover calls
+    inside it (it runs when called, not where defined).
+    """
+    func: Optional[str] = None      # innermost enclosing function name
+    in_loop: bool = False
+    span_covered: bool = False
+
+
+def is_span_with(node: ast.AST) -> bool:
+    """A ``with`` whose context expression is a ``*span*(...)`` call —
+    the tracing-coverage contract shared by three rules."""
+    if not isinstance(node, ast.With):
+        return False
+    for item in node.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Call):
+            func = expr.func
+            name = func.attr if isinstance(func, ast.Attribute) \
+                else getattr(func, 'id', '')
+            if 'span' in (name or ''):
+                return True
+    return False
+
+
+def call_name(node: ast.AST) -> str:
+    """The called name of a Call node ('' for non-calls / exotic
+    callees): ``foo()`` → 'foo', ``mod.foo()`` → 'foo'."""
+    if not isinstance(node, ast.Call):
+        return ''
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return getattr(func, 'id', '') or ''
+
+
+class FileContext:
+    """Everything a rule may need about one scanned file. ``tree`` is
+    the single shared parse."""
+
+    def __init__(self, rel_path: str, source: str,
+                 tree: ast.Module) -> None:
+        self.rel_path = rel_path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.findings: List[Finding] = []
+
+    def report(self, rule_id: str, line: int, message: str) -> None:
+        self.findings.append(
+            Finding(rule=rule_id, path=self.rel_path, line=line,
+                    message=message))
+
+    def function_source(self, node: ast.AST) -> str:
+        """The raw source lines of a def (legacy marker scans)."""
+        return '\n'.join(
+            self.lines[node.lineno - 1:node.end_lineno])
+
+
+class Rule:
+    """Base class. Subclasses set ``id`` + ``rationale`` and override
+    any of the hooks; all receive the shared tree, never re-parse.
+
+    Hooks:
+      * ``applies_to(rel_path)`` — file scope (path filters belong
+        here, not inside visit logic).
+      * ``begin_file(ctx)`` / ``end_file(ctx)`` — whole-file analyses
+        over ``ctx.tree``.
+      * ``visit(node, state, ctx)`` — called for every AST node during
+        the shared walk with the lexical :class:`WalkState`.
+      * ``finalize(run)`` — cross-file checks after every file ran.
+    """
+
+    id: str = ''
+    rationale: str = ''
+
+    def applies_to(self, rel_path: str) -> bool:
+        del rel_path
+        return True
+
+    def begin_file(self, ctx: FileContext) -> None:
+        pass
+
+    def visit(self, node: ast.AST, state: WalkState,
+              ctx: FileContext) -> None:
+        pass
+
+    def end_file(self, ctx: FileContext) -> None:
+        pass
+
+    def finalize(self, run: 'RunContext') -> None:
+        pass
+
+
+class RunContext:
+    """Cross-file state handed to ``finalize``."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        self.scanned: Set[str] = set()
+        self.findings: List[Finding] = []
+
+    def report(self, rule_id: str, path: str, line: int,
+               message: str) -> None:
+        self.findings.append(
+            Finding(rule=rule_id, path=path, line=line, message=message))
+
+
+def legacy_markers_for(rule_id: str) -> List[str]:
+    return [marker for marker, rid in LEGACY_MARKERS.items()
+            if rid == rule_id]
+
+
+class _Suppressions:
+    """Per-file ``# xskylint: disable=`` directives. A finding at line
+    N is suppressed by a directive naming its rule on line N itself or
+    anywhere in the contiguous comment block immediately above it
+    (multi-line reasons are normal; the directive leads the block)."""
+
+    def __init__(self, ctx: FileContext, known_rules: Set[str]) -> None:
+        self._lines = ctx.lines
+        # line → (rule ids, reason)
+        self.by_line: Dict[int, Any] = {}
+        self.syntax_findings: List[Finding] = []
+        for lineno, text in enumerate(ctx.lines, 1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(',') if r.strip()}
+            reason = (m.group(2) or '').strip()
+            if not reason:
+                self.syntax_findings.append(Finding(
+                    rule=SUPPRESSION_RULE, path=ctx.rel_path, line=lineno,
+                    message='suppression without a reason — write '
+                            '`# xskylint: disable=<rule> -- <why>`'))
+                continue
+            unknown = rules - known_rules
+            for rid in sorted(unknown):
+                self.syntax_findings.append(Finding(
+                    rule=SUPPRESSION_RULE, path=ctx.rel_path, line=lineno,
+                    message=f'suppression names unknown rule '
+                            f'{rid!r} (typo? it would suppress '
+                            'nothing)'))
+            self.by_line[lineno] = (rules - unknown, reason)
+
+    def match(self, finding: Finding) -> Optional[str]:
+        """The suppression reason covering `finding`, or None."""
+        entry = self.by_line.get(finding.line)
+        if entry and finding.rule in entry[0]:
+            return entry[1]
+        lineno = finding.line - 1
+        while 1 <= lineno <= len(self._lines) and \
+                self._lines[lineno - 1].strip().startswith('#'):
+            entry = self.by_line.get(lineno)
+            if entry and finding.rule in entry[0]:
+                return entry[1]
+            lineno -= 1
+        return None
+
+
+class LintEngine:
+    """Run a rule set over a tree of Python files, parsing each once."""
+
+    def __init__(self, root: str, rules: List[Rule],
+                 parse: Callable[..., ast.Module] = ast.parse) -> None:
+        self.root = os.path.abspath(root)
+        self.rules = rules
+        self.rule_ids = {r.id for r in rules}
+        # Directive validation is against every REGISTERED rule, not
+        # just the active subset — a single-rule run must not flag
+        # other rules' suppressions as typos.
+        from tools.xskylint.rules import all_rules
+        self.known_rule_ids = self.rule_ids | {
+            r.id for r in all_rules()}
+        # Injectable for the parse-once engine test.
+        self._parse = parse
+
+    # -- file discovery ------------------------------------------------------
+
+    def iter_files(self, paths: Iterable[str]) -> List[str]:
+        """Repo-relative posix paths of every .py under `paths`
+        (files or directories, relative to root), sorted."""
+        out: Set[str] = set()
+        for p in paths:
+            abs_p = p if os.path.isabs(p) else os.path.join(self.root, p)
+            if os.path.isfile(abs_p):
+                out.add(self._rel(abs_p))
+                continue
+            if not os.path.isdir(abs_p):
+                # A typo'd path must not green-light as '0 files, 0
+                # findings' in CI.
+                raise FileNotFoundError(
+                    f'lint path does not exist: {p} '
+                    f'(resolved {abs_p})')
+            for dirpath, dirnames, filenames in os.walk(abs_p):
+                dirnames[:] = [d for d in dirnames
+                               if not d.startswith('.')
+                               and d != '__pycache__']
+                for fname in filenames:
+                    if fname.endswith('.py'):
+                        out.add(self._rel(os.path.join(dirpath, fname)))
+        return sorted(out)
+
+    def _rel(self, abs_path: str) -> str:
+        return os.path.relpath(abs_path, self.root).replace(os.sep, '/')
+
+    # -- the shared walk -----------------------------------------------------
+
+    def _walk(self, node: ast.AST, state: WalkState,
+              active: List[Rule], ctx: FileContext) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # in_loop survives function boundaries by design (a
+                # helper defined inside a retry loop runs per
+                # iteration — legacy no-raw-sleep semantics).
+                child_state = WalkState(
+                    func=child.name,
+                    in_loop=state.in_loop,
+                    span_covered=False)
+            else:
+                child_state = WalkState(
+                    func=state.func,
+                    in_loop=state.in_loop or isinstance(
+                        child, (ast.While, ast.For, ast.AsyncFor)),
+                    span_covered=state.span_covered
+                    or is_span_with(child))
+            for rule in active:
+                rule.visit(child, child_state, ctx)
+            self._walk(child, child_state, active, ctx)
+
+    # -- running -------------------------------------------------------------
+
+    def run(self, paths: Iterable[str]) -> 'RunResult':
+        run_ctx = RunContext(self.root)
+        findings: List[Finding] = []
+        suppressions: Dict[str, _Suppressions] = {}
+        files = self.iter_files(paths)
+        for rel in files:
+            abs_path = os.path.join(self.root, rel)
+            try:
+                with open(abs_path, encoding='utf-8') as f:
+                    source = f.read()
+                tree = self._parse(source, filename=rel)
+            except (OSError, SyntaxError, ValueError) as e:
+                findings.append(Finding(
+                    rule=PARSE_RULE, path=rel, line=getattr(
+                        e, 'lineno', 1) or 1,
+                    message=f'cannot parse: {e}'))
+                continue
+            run_ctx.scanned.add(rel)
+            ctx = FileContext(rel, source, tree)
+            active = [r for r in self.rules if r.applies_to(rel)]
+            if active:
+                for rule in active:
+                    rule.begin_file(ctx)
+                self._walk(tree, WalkState(), active, ctx)
+                for rule in active:
+                    rule.end_file(ctx)
+            sup = _Suppressions(ctx, self.known_rule_ids)
+            suppressions[rel] = sup
+            findings.extend(sup.syntax_findings)
+            for finding in ctx.findings:
+                reason = sup.match(finding)
+                if reason is not None:
+                    finding.suppressed = True
+                    finding.reason = reason
+                findings.append(finding)
+        for rule in self.rules:
+            rule.finalize(run_ctx)
+        for finding in run_ctx.findings:
+            # finalize()-phase findings land on scanned files too
+            # (e.g. env-registry's per-use reports) — the suppression
+            # contract must hold for them as well.
+            sup = suppressions.get(finding.path)
+            if sup is not None:
+                reason = sup.match(finding)
+                if reason is not None:
+                    finding.suppressed = True
+                    finding.reason = reason
+            findings.append(finding)
+        findings.sort(key=lambda f: (f.path, f.line, f.rule))
+        return RunResult(root=self.root, files_scanned=len(files),
+                         rule_ids=sorted(self.rule_ids),
+                         findings=findings)
+
+
+@dataclasses.dataclass
+class RunResult:
+    root: str
+    files_scanned: int
+    rule_ids: List[str]
+    findings: List[Finding]
+
+    @property
+    def unsuppressed(self) -> List[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            'root': self.root,
+            'files_scanned': self.files_scanned,
+            'rules': self.rule_ids,
+            'findings': [f.to_json() for f in self.findings],
+            'suppressed_count': sum(f.suppressed for f in self.findings),
+            'unsuppressed_count': len(self.unsuppressed),
+        }
+
+
+def lint_paths(root: str, paths: Iterable[str],
+               rule_ids: Optional[Iterable[str]] = None,
+               parse: Callable[..., ast.Module] = ast.parse) -> RunResult:
+    """Convenience wrapper: run (a subset of) the registered rules
+    over `paths` under `root`. The API tests and the migrated
+    test_chaos.py wrappers call."""
+    from tools.xskylint.rules import all_rules
+    rules = all_rules()
+    if rule_ids is not None:
+        wanted = set(rule_ids)
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            raise ValueError(f'unknown rule id(s): {sorted(unknown)}')
+        rules = [r for r in rules if r.id in wanted]
+    return LintEngine(root, rules, parse=parse).run(paths)
+
+
+def _default_root() -> str:
+    """The repo root: cwd when it holds the tree, else up from here."""
+    cwd = os.getcwd()
+    if os.path.isdir(os.path.join(cwd, 'skypilot_tpu')):
+        return cwd
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog='xskylint',
+        description='Single-pass static analysis for the xsky tree.')
+    parser.add_argument('paths', nargs='*',
+                        default=['skypilot_tpu', 'tools'],
+                        help='files or directories relative to --root '
+                             '(default: skypilot_tpu tools)')
+    parser.add_argument('--root', default=None,
+                        help='repo root (default: auto-detected)')
+    parser.add_argument('--rule', action='append', dest='rules',
+                        help='run only this rule id (repeatable)')
+    parser.add_argument('--json', action='store_true', dest='as_json',
+                        help='machine-readable output')
+    parser.add_argument('--list-rules', action='store_true',
+                        help='print the rule catalog and exit')
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        from tools.xskylint.rules import all_rules
+        for rule in all_rules():
+            print(f'{rule.id}: {rule.rationale}')
+        return 0
+
+    root = os.path.abspath(args.root) if args.root else _default_root()
+    try:
+        result = lint_paths(root, args.paths, rule_ids=args.rules)
+    except (ValueError, FileNotFoundError) as e:
+        print(f'xskylint: {e}', file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps(result.to_json(), indent=2))
+    else:
+        for finding in result.findings:
+            if not finding.suppressed:
+                print(finding.render())
+        n = len(result.unsuppressed)
+        suppressed = sum(f.suppressed for f in result.findings)
+        print(f'xskylint: {result.files_scanned} files, '
+              f'{n} finding(s), {suppressed} suppressed')
+    return 1 if result.unsuppressed else 0

@@ -1,0 +1,27 @@
+"""Rule registry: ``all_rules()`` returns a fresh instance of every
+registered rule (rules hold per-run state, so instances are never
+shared between runs).
+
+Adding a rule: subclass :class:`tools.xskylint.engine.Rule` in the
+topical module, append the class to that module's ``RULES``, give it a
+positive + negative fixture in tests/unit_tests/test_xskylint.py (a
+self-check fails the suite if you forget), and document it in
+docs/static-analysis.md.
+"""
+from typing import List
+
+from tools.xskylint import engine
+from tools.xskylint.rules import concurrency
+from tools.xskylint.rules import contracts
+from tools.xskylint.rules import observability
+from tools.xskylint.rules import statedb
+
+_RULE_CLASSES = (concurrency.RULES + observability.RULES +
+                 statedb.RULES + contracts.RULES)
+
+
+def all_rules() -> List[engine.Rule]:
+    rules = [cls() for cls in _RULE_CLASSES]
+    ids = [r.id for r in rules]
+    assert len(ids) == len(set(ids)), f'duplicate rule ids: {ids}'
+    return rules

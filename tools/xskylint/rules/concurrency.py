@@ -1,0 +1,211 @@
+"""Concurrency-contract rules: sleep discipline, host fan-out shape,
+thread/process hygiene.
+
+Migrated from tests/unit_tests/test_chaos.py (TestNoRawSleepLint,
+TestNoSequentialRunnerLoopLint) plus the new thread-hygiene rule; the
+detection logic is the legacy lints', re-expressed over the engine's
+shared walk.
+"""
+from __future__ import annotations
+
+import ast
+
+from tools.xskylint import engine
+
+
+class NoRawSleepRule(engine.Rule):
+    """No instrumented module may call ``time.sleep`` inside a loop:
+    retry/poll cadence must go through the resilience helpers
+    (resilience.sleep / Deadline.sleep / Backoff) so it stays
+    deadline-bounded and jittered."""
+
+    id = 'no-raw-sleep'
+    rationale = ('raw time.sleep in a retry/poll loop dodges deadlines '
+                 'and jitter — use resilience.sleep/Deadline/Backoff')
+
+    INSTRUMENTED = frozenset({
+        'skypilot_tpu/utils/command_runner.py',
+        'skypilot_tpu/agent/gang.py',
+        'skypilot_tpu/backends/failover.py',
+        'skypilot_tpu/jobs/controller.py',
+        'skypilot_tpu/serve/replica_managers.py',
+        'skypilot_tpu/provision/do/rest.py',
+        'skypilot_tpu/provision/lambda_cloud/rest.py',
+        'skypilot_tpu/utils/parallelism.py',
+        'skypilot_tpu/utils/resilience.py',
+    })
+    # resilience.py IS the choke point: its module-level sleep()
+    # wrapper is the one allowed raw-sleep call site.
+    ALLOWED = frozenset({('skypilot_tpu/utils/resilience.py', 'sleep')})
+
+    def applies_to(self, rel_path: str) -> bool:
+        return rel_path in self.INSTRUMENTED
+
+    def visit(self, node: ast.AST, state: engine.WalkState,
+              ctx: engine.FileContext) -> None:
+        if not (state.in_loop and isinstance(node, ast.Call) and
+                isinstance(node.func, ast.Attribute) and
+                node.func.attr == 'sleep' and
+                isinstance(node.func.value, ast.Name) and
+                node.func.value.id == 'time'):
+            return
+        if (ctx.rel_path, state.func) in self.ALLOWED:
+            return
+        ctx.report(self.id, node.lineno,
+                   f'raw time.sleep in a retry/poll loop (in '
+                   f'{state.func}) — use resilience.sleep/Deadline/'
+                   'Backoff instead')
+
+
+class NoSequentialRunnerLoopRule(engine.Rule):
+    """Control-plane code must not fan per-host work out with a
+    sequential ``for ... in ...runners...`` loop — every such loop is
+    O(num_hosts) launch latency at pod scale. Host fan-out goes
+    through ``parallelism.run_in_parallel``."""
+
+    id = 'no-sequential-runner-loop'
+    rationale = ('a sequential per-host runner loop is O(hosts) launch '
+                 'latency — fan out via parallelism.run_in_parallel')
+
+    SCANNED_PREFIXES = ('skypilot_tpu/backends/', 'skypilot_tpu/serve/')
+    RUNNER_OPS = frozenset({'run', 'rsync', 'run_async'})
+
+    def applies_to(self, rel_path: str) -> bool:
+        return rel_path.startswith(self.SCANNED_PREFIXES)
+
+    def visit(self, node: ast.AST, state: engine.WalkState,
+              ctx: engine.FileContext) -> None:
+        if not isinstance(node, (ast.For, ast.AsyncFor)):
+            return
+        iter_names = set()
+        for sub in ast.walk(node.iter):
+            if isinstance(sub, ast.Name):
+                iter_names.add(sub.id)
+            elif isinstance(sub, ast.Attribute):
+                iter_names.add(sub.attr)
+        if not any('runners' in name.lower() for name in iter_names):
+            return
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if (isinstance(sub, ast.Call) and
+                        isinstance(sub.func, ast.Attribute) and
+                        sub.func.attr in self.RUNNER_OPS and
+                        isinstance(sub.func.value, ast.Name) and
+                        'runner' in sub.func.value.id.lower()):
+                    ctx.report(
+                        self.id, sub.lineno,
+                        f'sequential per-host runner loop '
+                        f'(runner.{sub.func.attr}) — use '
+                        'parallelism.run_in_parallel for host fan-out')
+
+
+class ThreadHygieneRule(engine.Rule):
+    """Every ``threading.Thread`` must pass ``name=`` and ``daemon=``
+    explicitly, and every ``subprocess.Popen`` in the controller
+    planes must be registered for reaping.
+
+    An anonymous thread is undebuggable in a py-spy dump of a wedged
+    controller, and an implicit ``daemon`` inherits the spawner's —
+    a non-daemon poll loop pins process exit forever. A ``Popen``
+    nobody records (``ACTIVE_PROCS``, a ``set_*_pid`` state row, or a
+    reaper ``register``) becomes the leaked orphan ``xsky reap`` exists
+    to hunt."""
+
+    id = 'thread-hygiene'
+    rationale = ('threads need explicit name= and daemon=; controller '
+                 'Popens must be registered for reaping')
+
+    # Popen registration is required in the planes the reconciler and
+    # reaper supervise.
+    POPEN_PREFIXES = ('skypilot_tpu/backends/', 'skypilot_tpu/jobs/',
+                      'skypilot_tpu/serve/')
+    # A call whose name matches one of these registers the child with
+    # the control plane (pid row the reconciler reaps by, ACTIVE_PROCS
+    # list the gang launcher drains, or an explicit reaper hook).
+    _REGISTER_TOKENS = ('register', '_pid')
+
+    def applies_to(self, rel_path: str) -> bool:
+        return rel_path.startswith(('skypilot_tpu/', 'tools/'))
+
+    def visit(self, node: ast.AST, state: engine.WalkState,
+              ctx: engine.FileContext) -> None:
+        if not isinstance(node, ast.Call):
+            return
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) \
+            else getattr(func, 'id', '')
+        if name != 'Thread':
+            return
+        kwargs = {kw.arg for kw in node.keywords}
+        missing = [f'{k}=' for k in ('name', 'daemon')
+                   if k not in kwargs]
+        if missing:
+            ctx.report(self.id, node.lineno,
+                       f'threading.Thread without explicit '
+                       f'{" and ".join(missing)} — anonymous/'
+                       'implicit-daemon threads are undebuggable in a '
+                       'wedged controller')
+
+    def end_file(self, ctx: engine.FileContext) -> None:
+        if not ctx.rel_path.startswith(self.POPEN_PREFIXES):
+            return
+        for fn_node, calls in _calls_by_innermost_function(
+                ctx.tree, self._is_popen):
+            scope = fn_node if fn_node is not None else ctx.tree
+            if self._registers(scope):
+                continue
+            for call in calls:
+                where = fn_node.name if fn_node is not None \
+                    else 'module level'
+                ctx.report(
+                    self.id, call.lineno,
+                    f'subprocess.Popen in {where} is never registered '
+                    '— record its pid (set_*_pid / ACTIVE_PROCS / '
+                    'reaper register) or it leaks past crashes')
+
+    @staticmethod
+    def _is_popen(node: ast.Call) -> bool:
+        func = node.func
+        return (isinstance(func, ast.Attribute) and
+                func.attr == 'Popen') or \
+            getattr(func, 'id', '') == 'Popen'
+
+    @classmethod
+    def _registers(cls, scope: ast.AST) -> bool:
+        for sub in ast.walk(scope):
+            if isinstance(sub, ast.Name) and sub.id == 'ACTIVE_PROCS':
+                return True
+            if isinstance(sub, ast.Attribute) and \
+                    sub.attr == 'ACTIVE_PROCS':
+                return True
+            name = engine.call_name(sub)
+            if name and any(tok in name for tok in cls._REGISTER_TOKENS):
+                return True
+        return False
+
+
+def _calls_by_innermost_function(tree, predicate):
+    """[(function node or None, [matching Call nodes])] grouping each
+    matching call under its innermost enclosing def (None ⇒ module
+    level). Shared by the hygiene and chaos-coverage rules."""
+    groups = {}
+    order = []
+
+    def walk(node, cur_func):
+        for child in ast.iter_child_nodes(node):
+            nxt = child if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                else cur_func
+            if isinstance(child, ast.Call) and predicate(child):
+                key = id(cur_func)
+                if key not in groups:
+                    groups[key] = (cur_func, [])
+                    order.append(key)
+                groups[key][1].append(child)
+            walk(child, nxt)
+
+    walk(tree, None)
+    return [groups[k] for k in order]
+
+
+RULES = [NoRawSleepRule, NoSequentialRunnerLoopRule, ThreadHygieneRule]

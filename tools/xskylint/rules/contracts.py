@@ -1,0 +1,237 @@
+"""Configuration/chaos contract rules.
+
+env-registry: every ``XSKY_*`` environment variable the tree reads
+must be declared in ``skypilot_tpu/utils/env_registry.py`` (name,
+default, one-line doc) — the generated docs table is diffed against
+``docs/reference/environment.md`` so the reference can't rot.
+
+chaos-coverage: every transient-retry site carries a chaos point, so
+the fault-injection plans in docs/robustness.md can actually reach it.
+"""
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+import re
+import sys
+from typing import Dict, List, Tuple
+
+from tools.xskylint import engine
+from tools.xskylint.rules.concurrency import _calls_by_innermost_function
+
+# A full env-var name: XSKY_ followed by A-Z/0-9 segments, not ending
+# in '_' (trailing-underscore literals are prefix scans, e.g. the
+# XSKY_PROFILER_* env forwarding in the gang backend).
+_ENV_NAME_RE = re.compile(r'XSKY_[A-Z0-9]+(?:_[A-Z0-9]+)*')
+
+REGISTRY_REL_PATH = 'skypilot_tpu/utils/env_registry.py'
+DOCS_REL_PATH = 'docs/reference/environment.md'
+
+
+def load_registry_module(root: str):
+    """The env_registry module, executed standalone (it is
+    dependency-free by contract; no package import, no ast.parse — the
+    engine's parse-once property stays intact). None when the file
+    does not exist (synthetic fixture trees)."""
+    path = os.path.join(root, REGISTRY_REL_PATH)
+    if not os.path.exists(path):
+        return None
+    spec = importlib.util.spec_from_file_location('_xsky_env_registry',
+                                                  path)
+    module = importlib.util.module_from_spec(spec)
+    # dataclasses (used by the registry) resolves the defining module
+    # through sys.modules during class creation.
+    sys.modules[spec.name] = module
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.modules.pop(spec.name, None)
+    return module
+
+
+class EnvRegistryRule(engine.Rule):
+    """Every ``XSKY_*`` name the tree mentions as a string literal
+    must be declared in env_registry.py with a default and a one-line
+    doc, and docs/reference/environment.md must match the registry's
+    rendered table (regenerate with
+    ``python -m skypilot_tpu.utils.env_registry``).
+
+    Measured drift at rule introduction: 100 distinct ``XSKY_*`` reads
+    in the tree, 45 mentioned anywhere in docs/."""
+
+    id = 'env-registry'
+    rationale = ('every XSKY_* env var must be declared (default + '
+                 'doc) in utils/env_registry.py; the docs table is '
+                 'generated from it')
+
+    def __init__(self) -> None:
+        # name → [(rel_path, line), ...] across the whole run.
+        self._uses: Dict[str, List[Tuple[str, int]]] = {}
+
+    def applies_to(self, rel_path: str) -> bool:
+        return rel_path.startswith('skypilot_tpu/') and \
+            rel_path != REGISTRY_REL_PATH
+
+    def visit(self, node: ast.AST, state: engine.WalkState,
+              ctx: engine.FileContext) -> None:
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                _ENV_NAME_RE.fullmatch(node.value):
+            self._uses.setdefault(node.value, []).append(
+                (ctx.rel_path, node.lineno))
+
+    def finalize(self, run: engine.RunContext) -> None:
+        module = load_registry_module(run.root)
+        registry = dict(module.REGISTRY) if module is not None else None
+        if registry is None:
+            if self._uses:
+                # No registry in this tree at all: report each name
+                # once, at its first use.
+                for name, sites in sorted(self._uses.items()):
+                    path, line = sites[0]
+                    run.report(self.id, path, line,
+                               f'{name} is read but '
+                               f'{REGISTRY_REL_PATH} does not exist')
+            return
+        for name, sites in sorted(self._uses.items()):
+            if name in registry:
+                continue
+            path, line = sites[0]
+            run.report(
+                self.id, path, line,
+                f'{name} is read but not declared in '
+                f'{REGISTRY_REL_PATH} — add an EnvVar(name, default, '
+                'doc) entry and regenerate the docs table')
+        for name, var in sorted(registry.items()):
+            if not getattr(var, 'doc', '').strip():
+                run.report(self.id, REGISTRY_REL_PATH, 1,
+                           f'registry entry {name} has an empty doc '
+                           'line')
+        self._check_docs(run, module)
+
+    def _check_docs(self, run: engine.RunContext, module) -> None:
+        """Regenerate-and-diff: the committed docs table must equal
+        the registry's rendering. Skipped when the tree has no docs/
+        dir (synthetic fixture trees)."""
+        if not os.path.isdir(os.path.join(run.root, 'docs')):
+            return
+        docs_path = os.path.join(run.root, DOCS_REL_PATH)
+        expected = module.render_markdown()
+        if not os.path.exists(docs_path):
+            run.report(self.id, DOCS_REL_PATH, 1,
+                       'missing — generate it with `python -m '
+                       'skypilot_tpu.utils.env_registry > '
+                       f'{DOCS_REL_PATH}`')
+            return
+        with open(docs_path, encoding='utf-8') as f:
+            actual = f.read()
+        if actual != expected:
+            run.report(self.id, DOCS_REL_PATH, 1,
+                       'is stale: it no longer matches the registry '
+                       'rendering — regenerate with `python -m '
+                       'skypilot_tpu.utils.env_registry > '
+                       f'{DOCS_REL_PATH}`')
+
+
+class ChaosCoverageRule(engine.Rule):
+    """Every transient-retry site must contain a chaos point: (a) the
+    innermost function around a ``retry_transient(...)`` call must
+    (somewhere in its subtree, the retried callable included) call
+    ``chaos.inject``; (b) every failover retry loop (driving
+    ``_try_resources``/``_try_zone``) must carry one in its body.
+    A retry path without a chaos point cannot be exercised by a fault
+    plan — its recovery behavior is untested by construction, which is
+    exactly how recovery invariants rot into downtime."""
+
+    id = 'chaos-coverage'
+    rationale = ('a retry path without a chaos point cannot be driven '
+                 'by a fault plan — its recovery is untestable')
+
+    SKIPPED_FILES = frozenset({
+        # The retry primitive's and the chaos layer's own definitions.
+        'skypilot_tpu/utils/resilience.py',
+        'skypilot_tpu/utils/chaos.py',
+    })
+    RETRY_CALLEES = frozenset({'_try_resources', '_try_zone'})
+
+    def applies_to(self, rel_path: str) -> bool:
+        return rel_path.startswith('skypilot_tpu/') and \
+            rel_path not in self.SKIPPED_FILES
+
+    def end_file(self, ctx: engine.FileContext) -> None:
+        for fn_node, calls in _calls_by_innermost_function(
+                ctx.tree, self._is_retry_transient):
+            scope = fn_node if fn_node is not None else ctx.tree
+            if self._has_inject(scope):
+                continue
+            where = fn_node.name if fn_node is not None \
+                else 'module level'
+            for call in calls:
+                ctx.report(
+                    self.id, call.lineno,
+                    f'retry_transient in {where} has no chaos.inject '
+                    'point — add one inside the retried callable so '
+                    'fault plans can exercise this retry path')
+        # A loop is covered by an inject in its own body OR by calling
+        # a same-file function that (transitively, within this file)
+        # reaches one. The transitive case matters because the points
+        # deliberately live INSIDE the attempt helpers' failure
+        # handling — an inject lexically in the loop body would raise
+        # PAST the handling and abort the whole walk instead of
+        # failing one attempt.
+        injecting_funcs = self._transitively_injecting(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            called = {engine.call_name(sub) for sub in ast.walk(node)}
+            if not called & self.RETRY_CALLEES:
+                continue
+            if self._has_inject(node) or called & injecting_funcs:
+                continue
+            ctx.report(
+                self.id, node.lineno,
+                'failover retry loop has no chaos.inject point (in '
+                'its body or an attempt helper it calls) — fault '
+                'plans cannot preempt an attempt here')
+
+    @staticmethod
+    def _is_retry_transient(node: ast.Call) -> bool:
+        return engine.call_name(node) == 'retry_transient'
+
+    @classmethod
+    def _transitively_injecting(cls, tree: ast.Module) -> set:
+        """Names of functions in this file whose call graph (within
+        the file) reaches a ``chaos.inject``."""
+        funcs = {
+            node.name: node for node in ast.walk(tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        injecting = {name for name, node in funcs.items()
+                     if cls._has_inject(node)}
+        changed = True
+        while changed:
+            changed = False
+            for name, node in funcs.items():
+                if name in injecting:
+                    continue
+                called = {engine.call_name(sub)
+                          for sub in ast.walk(node)}
+                if called & injecting:
+                    injecting.add(name)
+                    changed = True
+        return injecting
+
+    @staticmethod
+    def _has_inject(scope: ast.AST) -> bool:
+        for sub in ast.walk(scope):
+            if (isinstance(sub, ast.Call) and
+                    isinstance(sub.func, ast.Attribute) and
+                    sub.func.attr == 'inject' and
+                    isinstance(sub.func.value, ast.Name) and
+                    sub.func.value.id == 'chaos'):
+                return True
+        return False
+
+
+RULES = [EnvRegistryRule, ChaosCoverageRule]

@@ -1,0 +1,422 @@
+"""Observability-contract rules: span coverage, retention bounds,
+heartbeat/telemetry-consulting loops, and the never-raise discipline
+of the recording planes.
+
+The first six are the legacy test_chaos.py lints
+(TestSpanCoverageLint, TestProfilerSpanLint, TestTelemetryRetentionLint,
+TestLeaseHeartbeatLint, TestTelemetryStalenessLint) re-expressed over
+the shared walk; never-raise is new — it checks the contract PRs 4/5/7
+promised in docstrings but nothing enforced.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from tools.xskylint import engine
+
+
+class SpanFanoutRule(engine.Rule):
+    """Every ``parallelism.run_in_parallel`` call site must execute
+    under an active tracing span — an untraced fan-out is invisible to
+    ``xsky trace`` and the ``/metrics`` phase histograms. Coverage
+    resets at function boundaries (a span enclosing only a nested
+    function's *definition* covers nothing)."""
+
+    id = 'span-fanout'
+    rationale = ('run_in_parallel outside `with tracing.span(...)` — '
+                 'untraced fan-outs are invisible to xsky trace')
+
+    SKIPPED_FILES = frozenset({
+        # The primitive's own definition site (it opens the
+        # fanout.<phase> span internally).
+        'skypilot_tpu/utils/parallelism.py',
+    })
+
+    def applies_to(self, rel_path: str) -> bool:
+        return rel_path.startswith('skypilot_tpu/') and \
+            rel_path not in self.SKIPPED_FILES
+
+    def visit(self, node: ast.AST, state: engine.WalkState,
+              ctx: engine.FileContext) -> None:
+        if (isinstance(node, ast.Call) and
+                isinstance(node.func, ast.Attribute) and
+                node.func.attr == 'run_in_parallel' and
+                not state.span_covered):
+            ctx.report(self.id, node.lineno,
+                       'run_in_parallel call site outside a tracing '
+                       'span — wrap it in `with tracing.span(...)` so '
+                       'the fan-out lands on the trace')
+
+
+class SpanFailoverRule(engine.Rule):
+    """Every failover retry loop (a loop driving ``_try_resources`` /
+    ``_try_zone``) must run under a span so failed attempts land on
+    the trace."""
+
+    id = 'span-failover'
+    rationale = ('failover retry loop outside a tracing span — failed '
+                 'attempts must land on the trace')
+
+    RETRY_CALLEES = frozenset({'_try_resources', '_try_zone'})
+
+    def applies_to(self, rel_path: str) -> bool:
+        return rel_path.startswith('skypilot_tpu/')
+
+    def visit(self, node: ast.AST, state: engine.WalkState,
+              ctx: engine.FileContext) -> None:
+        if not isinstance(node, (ast.For, ast.While)) or \
+                state.span_covered:
+            return
+        # state.span_covered is the state AT the loop; a span opened
+        # inside the loop body does not cover the loop itself.
+        for sub in ast.walk(node):
+            if engine.call_name(sub) in self.RETRY_CALLEES:
+                ctx.report(self.id, node.lineno,
+                           'failover retry loop outside a tracing span '
+                           '— failed attempts must land on the trace')
+                return
+
+
+class SpanProfilerRule(engine.Rule):
+    """Every profiler capture/pull site (``capture_device_profile``,
+    ``record_profiles``) must run under a tracing span: a deep capture
+    fans a device probe out to every host, and profile recording rides
+    the telemetry pull whose latency ``xsky trace`` attributes."""
+
+    id = 'span-profiler'
+    rationale = ('profiler capture/pull site outside a tracing span — '
+                 'the capture/pull must land on the trace')
+
+    SKIPPED_FILES = frozenset({
+        # The plane's own definition site (record_profiles delegates
+        # to state.record_profiles internally; callers hold the span).
+        'skypilot_tpu/agent/profiler.py',
+    })
+    PROFILER_SITES = frozenset({'capture_device_profile',
+                                'record_profiles'})
+
+    def applies_to(self, rel_path: str) -> bool:
+        return rel_path.startswith('skypilot_tpu/') and \
+            rel_path not in self.SKIPPED_FILES
+
+    def visit(self, node: ast.AST, state: engine.WalkState,
+              ctx: engine.FileContext) -> None:
+        if (isinstance(node, ast.Call) and
+                isinstance(node.func, ast.Attribute) and
+                node.func.attr in self.PROFILER_SITES and
+                not state.span_covered):
+            ctx.report(self.id, node.lineno,
+                       f'{node.func.attr} call site outside a tracing '
+                       'span — wrap it in `with tracing.span(...)`')
+
+
+class RetentionBoundRule(engine.Rule):
+    """Every observability table in state.py must declare a retention
+    bound: these tables take one row per poll/span/event forever, and
+    an unbounded one turns the shared state DB into the outage. A
+    bounded table needs (a) a module-level ``_MAX_*`` constant and (b)
+    a ``DELETE FROM <table>`` prune referencing it."""
+
+    id = 'retention-bound'
+    rationale = ('observability tables grow per poll/span/event — each '
+                 'needs a _MAX_* bound and a DELETE FROM prune')
+
+    # table → its retention constant. A NEW observability table must
+    # be added here (the rule fails if one is created without a bound).
+    BOUNDED = {
+        'recovery_events': '_MAX_RECOVERY_EVENTS',
+        'spans': '_MAX_SPANS',
+        'workload_telemetry': '_MAX_WORKLOAD_TELEMETRY',
+        'profiles': '_MAX_PROFILES',
+    }
+    # CREATE TABLE names matching this are observability tables.
+    OBSERVABILITY_RE = re.compile(r'events|spans|telemetry|profiles')
+    CREATE_RE = re.compile(r'CREATE TABLE IF NOT EXISTS (\w+)')
+
+    def applies_to(self, rel_path: str) -> bool:
+        return rel_path == 'skypilot_tpu/state.py'
+
+    def end_file(self, ctx: engine.FileContext) -> None:
+        source = ctx.source
+        tables = set(self.CREATE_RE.findall(source))
+        for table in sorted(tables):
+            if not self.OBSERVABILITY_RE.search(table):
+                continue
+            if table not in self.BOUNDED:
+                ctx.report(
+                    self.id, 1,
+                    f'table {table} looks like an observability table '
+                    'but declares no retention bound (add it to '
+                    'RetentionBoundRule.BOUNDED + a _MAX_* prune)')
+                continue
+            if f'DELETE FROM {table}' not in source:
+                ctx.report(self.id, 1,
+                           f'table {table} has no DELETE FROM prune')
+        constants = {
+            t.id: node.value.value
+            for node in ctx.tree.body if isinstance(node, ast.Assign)
+            for t in node.targets if isinstance(t, ast.Name)
+            and isinstance(node.value, ast.Constant)
+        }
+        for table, const in self.BOUNDED.items():
+            if table not in tables:
+                continue
+            value = constants.get(const)
+            if not isinstance(value, int) or value <= 0:
+                ctx.report(
+                    self.id, 1,
+                    f'{const} (retention bound for {table}) is not a '
+                    'positive module-level int constant')
+
+
+class _RequiredLoopCallRule(engine.Rule):
+    """Shared shape of lease-heartbeat and telemetry-poll: named
+    functions whose OUTERMOST loops must each contain a call whose
+    name mentions a token. A listed function with no loop at all is a
+    stale-contract finding."""
+
+    REQUIRED: Tuple[Tuple[str, str], ...] = ()
+    TOKEN = ''
+
+    def applies_to(self, rel_path: str) -> bool:
+        return any(rel == rel_path for rel, _ in self.REQUIRED)
+
+    def end_file(self, ctx: engine.FileContext) -> None:
+        for rel, func_name in self.REQUIRED:
+            if rel != ctx.rel_path:
+                continue
+            # Aggregate across same-named functions (methods named
+            # e.g. `run` may appear in several classes): the contract
+            # is stale only when NO definition carries a loop —
+            # exactly the legacy lint's semantics.
+            found = False
+            saw_loop = False
+            offenders: List[ast.AST] = []
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) and \
+                        node.name == func_name:
+                    found = True
+                    for loop in self._outer_loops(node):
+                        saw_loop = True
+                        if not self._contains_token_call(loop):
+                            offenders.append(loop)
+            if not found:
+                ctx.report(self.id, 1,
+                           f'rule contract is stale: no function '
+                           f'{func_name} in {rel}')
+            elif not saw_loop:
+                ctx.report(self.id, 1,
+                           f'{func_name} has no loop — the rule '
+                           'contract list is stale')
+            else:
+                for loop in offenders:
+                    ctx.report(self.id, loop.lineno,
+                               self._message(func_name))
+
+    @classmethod
+    def _outer_loops(cls, node: ast.AST) -> List[ast.AST]:
+        loops: List[ast.AST] = []
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.While, ast.For)):
+                loops.append(child)   # nested loops ride along
+            else:
+                loops.extend(cls._outer_loops(child))
+        return loops
+
+    @classmethod
+    def _contains_token_call(cls, node: ast.AST) -> bool:
+        for child in ast.walk(node):
+            if cls.TOKEN in engine.call_name(child):
+                return True
+        return False
+
+    def _message(self, func_name: str) -> str:
+        raise NotImplementedError
+
+
+class LeaseHeartbeatRule(_RequiredLoopCallRule):
+    """Every lease-holding module's long-lived loop must renew its
+    liveness lease: a loop that spins without heartbeating looks dead
+    to the reconciler after one TTL and gets its scope 'repaired' out
+    from under it."""
+
+    id = 'lease-heartbeat'
+    rationale = ('a lease-holding loop that never heartbeats looks '
+                 'dead to the reconciler after one TTL')
+
+    REQUIRED = (
+        # jobs controller: monitor loop (scope job/<id>)
+        ('skypilot_tpu/jobs/controller.py', '_run_task'),
+        # controller queued for a launch slot still holds its lease
+        ('skypilot_tpu/jobs/scheduler.py', 'acquire_launch_slot'),
+        # serve controller: autoscaler tick loop (scope service/<name>)
+        ('skypilot_tpu/serve/controller.py', 'run'),
+        # API-server watchdog renews every in-flight request lease
+        ('skypilot_tpu/server/executor.py', '_watchdog'),
+    )
+    TOKEN = 'heartbeat'
+
+    def _message(self, func_name: str) -> str:
+        return (f'long-lived loop in {func_name} never calls a '
+                'heartbeat helper — the reconciler will declare it '
+                'dead after one TTL')
+
+
+class TelemetryPollRule(_RequiredLoopCallRule):
+    """Every loop that polls rank/job state must consult workload
+    telemetry (heartbeat staleness) — a poll loop that only watches
+    job status can't tell a hung rank from a slow one and degrades to
+    raw time-based hang guesses."""
+
+    id = 'telemetry-poll'
+    rationale = ('rank-state poll loops must consult workload '
+                 'telemetry, not raw time-based hang guesses')
+
+    REQUIRED = (
+        # jobs controller monitor loop: stall verdicts feed recovery.
+        ('skypilot_tpu/jobs/controller.py', '_run_task'),
+        # backend launch-wait loop: records samples for `xsky top`.
+        ('skypilot_tpu/backends/tpu_gang_backend.py', '_wait_job'),
+    )
+    TOKEN = 'telemetry'
+
+    def _message(self, func_name: str) -> str:
+        return (f'rank-state poll loop in {func_name} never consults '
+                'workload telemetry — heartbeat staleness, not raw '
+                'time, decides whether a rank hung')
+
+
+class NeverRaiseRule(engine.Rule):
+    """The observability planes' recording entry points sit on launch
+    and recovery hot paths and promise (in their docstrings) to NEVER
+    raise; this rule makes the promise checkable.
+
+    The contract: after the docstring, every top-level statement of a
+    listed function must be provably non-raising — a ``try`` whose
+    handler catches broad ``Exception`` (and never re-``raise``\\ s), a
+    constant/name assignment or return, a guard ``if`` over names, or
+    ``global``/``pass``. Anything else (a bare call, a ``with``, an
+    unguarded expression) is a statement that can take the hot path
+    down and is flagged."""
+
+    id = 'never-raise'
+    rationale = ('observability recording entry points must not let '
+                 'any exception escape onto the hot path they measure')
+
+    # module → the recording entry points bound by the contract.
+    REQUIRED: Dict[str, Tuple[str, ...]] = {
+        'skypilot_tpu/utils/tracing.py': (
+            'span', 'request_span', 'flush', 'annotate_append',
+            'env_for_child'),
+        'skypilot_tpu/utils/metrics.py': ('inc_counter', 'observe'),
+        'skypilot_tpu/agent/telemetry.py': (
+            'emit', 'record_samples', 'goodput_for_cluster'),
+        'skypilot_tpu/agent/profiler.py': (
+            'step_probe', 'record_compile', 'ensure_compile_listener',
+            'record_profiles'),
+    }
+
+    def applies_to(self, rel_path: str) -> bool:
+        return rel_path in self.REQUIRED
+
+    def end_file(self, ctx: engine.FileContext) -> None:
+        wanted = set(self.REQUIRED[ctx.rel_path])
+        seen = set()
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) and \
+                    node.name in wanted:
+                seen.add(node.name)
+                bad = self._nonconforming_statements(node)
+                for stmt in bad:
+                    ctx.report(
+                        self.id, stmt.lineno,
+                        f'{node.name} promises never-raise but this '
+                        'statement is outside a broad try/except — '
+                        'an exception here escapes onto the hot path')
+        for missing in sorted(wanted - seen):
+            ctx.report(self.id, 1,
+                       f'never-raise contract lists {missing} but '
+                       f'{ctx.rel_path} defines no such module-level '
+                       'function (stale contract?)')
+
+    # -- conformance ---------------------------------------------------------
+
+    @classmethod
+    def _nonconforming_statements(cls, fn: ast.AST) -> List[ast.stmt]:
+        body = list(fn.body)
+        if body and isinstance(body[0], ast.Expr) and \
+                isinstance(body[0].value, ast.Constant) and \
+                isinstance(body[0].value.value, str):
+            body = body[1:]   # docstring
+        return [stmt for stmt in body if not cls._statement_safe(stmt)]
+
+    @classmethod
+    def _statement_safe(cls, stmt: ast.stmt) -> bool:
+        if isinstance(stmt, (ast.Global, ast.Nonlocal, ast.Pass)):
+            return True
+        if isinstance(stmt, ast.Try):
+            return cls._is_broad_try(stmt)
+        if isinstance(stmt, ast.Return):
+            return stmt.value is None or cls._expr_safe(stmt.value)
+        if isinstance(stmt, ast.Assign):
+            return cls._expr_safe(stmt.value)
+        if isinstance(stmt, ast.AnnAssign):
+            return stmt.value is None or cls._expr_safe(stmt.value)
+        if isinstance(stmt, ast.If):
+            return (cls._expr_safe(stmt.test) and
+                    all(cls._statement_safe(s) for s in stmt.body) and
+                    all(cls._statement_safe(s) for s in stmt.orelse))
+        return False
+
+    @classmethod
+    def _expr_safe(cls, expr: Optional[ast.expr]) -> bool:
+        """Expressions that cannot raise: constants, bare names, and
+        containers/unary-ops/compares over them. Calls and attribute
+        access are NOT safe."""
+        if expr is None or isinstance(expr, (ast.Constant, ast.Name)):
+            return True
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            return all(cls._expr_safe(e) for e in expr.elts)
+        if isinstance(expr, ast.Dict):
+            return all(cls._expr_safe(e) for e in expr.keys if e) and \
+                all(cls._expr_safe(e) for e in expr.values)
+        if isinstance(expr, ast.UnaryOp):
+            return cls._expr_safe(expr.operand)
+        if isinstance(expr, ast.Compare):
+            return cls._expr_safe(expr.left) and \
+                all(cls._expr_safe(e) for e in expr.comparators)
+        if isinstance(expr, ast.BoolOp):
+            return all(cls._expr_safe(e) for e in expr.values)
+        return False
+
+    @classmethod
+    def _is_broad_try(cls, stmt: ast.Try) -> bool:
+        broad = False
+        for handler in stmt.handlers:
+            if handler.type is None or (
+                    isinstance(handler.type, ast.Name) and
+                    handler.type.id in ('Exception', 'BaseException')):
+                broad = True
+            for sub in ast.walk(handler):
+                if isinstance(sub, ast.Raise):
+                    return False
+            # The handler body is the fallback path — an exception
+            # thrown FROM it escapes, so it must itself be provably
+            # non-raising (constant returns, guarded names; no calls).
+            if not all(cls._statement_safe(s) for s in handler.body):
+                return False
+        # else:/finally: bodies run OUTSIDE the handlers' protection —
+        # they must themselves be provably non-raising.
+        for extra in (stmt.orelse, stmt.finalbody):
+            if not all(cls._statement_safe(s) for s in extra):
+                return False
+        return broad
+
+
+RULES = [SpanFanoutRule, SpanFailoverRule, SpanProfilerRule,
+         RetentionBoundRule, LeaseHeartbeatRule, TelemetryPollRule,
+         NeverRaiseRule]

@@ -1,0 +1,117 @@
+"""State-DB discipline rules: listing pagination and connection
+routing.
+
+select-limit is the legacy test_chaos.py TestListingLimitLint (its
+``# full-scan ok:`` exemption comments keep working via the engine's
+LEGACY_MARKERS compatibility map); db-discipline is new — it pins the
+PR 6 WAL-pool refactor so fresh code can't quietly reopen raw sqlite
+connections outside ``utils/db_utils.py``.
+"""
+from __future__ import annotations
+
+import ast
+
+from tools.xskylint import engine
+
+
+class SelectLimitRule(engine.Rule):
+    """Every listing function (``.fetchall()``/``_read()`` over a
+    SELECT) in the shared state modules must page — carry a ``LIMIT``
+    in its SQL or build it with ``page_sql`` — or declare why a full
+    scan is safe with a ``# full-scan ok:`` comment naming the bound.
+    The state DB serves a 5k-cluster fleet at QPS: an unpaged listing
+    added casually is the next `status` full-scan regression."""
+
+    id = 'select-limit'
+    rationale = ('unpaged SELECT listings are how status full-scans '
+                 'come back at fleet scale')
+
+    MODULES = frozenset({
+        'skypilot_tpu/state.py',
+        'skypilot_tpu/server/requests_db.py',
+    })
+    # Calls that mark a function as a multi-row listing: a direct
+    # cursor fetchall, or the state modules' _read()/fetchall facade.
+    LISTING_CALLS = frozenset({'fetchall', '_read'})
+
+    def applies_to(self, rel_path: str) -> bool:
+        return rel_path in self.MODULES
+
+    def end_file(self, ctx: engine.FileContext) -> None:
+        markers = engine.legacy_markers_for(self.id)
+        for node in ctx.tree.body:
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if node.name in self.LISTING_CALLS:
+                continue   # the facade's own definition
+            is_listing = False
+            calls_page_sql = False
+            sql_chunks = []
+            for child in ast.walk(node):
+                name = engine.call_name(child)
+                if name in self.LISTING_CALLS:
+                    is_listing = True
+                if name == '_page_sql' or name == 'page_sql':
+                    # page_sql appends the LIMIT clause at runtime.
+                    calls_page_sql = True
+                if isinstance(child, ast.Constant) and \
+                        isinstance(child.value, str):
+                    sql_chunks.append(child.value)
+            sql = ' '.join(sql_chunks)
+            # Both tokens: a docstring mentioning SELECT (the _read
+            # helper's contract) is not a query.
+            if not is_listing or 'SELECT' not in sql \
+                    or 'FROM' not in sql:
+                continue
+            body_src = ctx.function_source(node)
+            if ('LIMIT' in sql or calls_page_sql or
+                    any(m in body_src for m in markers)):
+                continue
+            ctx.report(
+                self.id, node.lineno,
+                f'{node.name} runs a SELECT listing without a LIMIT '
+                '(or a `# full-scan ok:` exemption naming the bound) '
+                '— unpaged listings are how status full-scans come '
+                'back')
+
+
+class DbDisciplineRule(engine.Rule):
+    """All control-plane DB access routes through ``utils/db_utils``:
+    ``db_utils.connect`` for writers (WAL + synchronous pragma +
+    postgres awareness in one place), ``StateReader``/``WalReadPool``
+    for reads, ``page_sql`` for listings. A raw ``sqlite3.connect`` or
+    cursor elsewhere silently bypasses the PR 6 read pool and the
+    fsync policy, and is invisible to the pagination lint's facade
+    detection."""
+
+    id = 'db-discipline'
+    rationale = ('raw sqlite3.connect / .cursor() outside db_utils '
+                 'bypasses the WAL read pool and fsync policy')
+
+    def applies_to(self, rel_path: str) -> bool:
+        return rel_path.startswith('skypilot_tpu/') and \
+            rel_path != 'skypilot_tpu/utils/db_utils.py'
+
+    def visit(self, node: ast.AST, state: engine.WalkState,
+              ctx: engine.FileContext) -> None:
+        if not isinstance(node, ast.Call):
+            return
+        func = node.func
+        if isinstance(func, ast.Attribute) and \
+                func.attr == 'connect' and \
+                isinstance(func.value, ast.Name) and \
+                func.value.id == 'sqlite3':
+            ctx.report(self.id, node.lineno,
+                       'raw sqlite3.connect outside utils/db_utils — '
+                       'open state DBs via db_utils.connect (WAL + '
+                       'synchronous policy + postgres routing live '
+                       'there)')
+        elif isinstance(func, ast.Attribute) and func.attr == 'cursor':
+            ctx.report(self.id, node.lineno,
+                       'raw .cursor() outside utils/db_utils — state '
+                       'modules execute on the connection facade so '
+                       'reads stay routable through the WAL pool')
+
+
+RULES = [SelectLimitRule, DbDisciplineRule]
