@@ -898,6 +898,158 @@ def profile(cluster, job, rank, capture, duration, as_json):
                 detail.get('out_dir') or '-'))
 
 
+def _fmt_ms(value) -> str:
+    return f'{value:.0f}ms' if value is not None else '-'
+
+
+def _fmt_burn(value) -> str:
+    if value is None:
+        return '-'
+    if value == 'inf' or value == float('inf'):
+        return 'inf'
+    return f'{value:.2f}'
+
+
+def _slo_service_report(service: str) -> Optional[dict]:
+    """One service's SLO view: objectives vs actuals, per-window/
+    per-objective burns, verdict, per-replica digests. None when the
+    service is unknown."""
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu.serve import service_spec as spec_lib
+    from skypilot_tpu.serve import state as serve_state
+    record = serve_state.get_service(service)
+    if record is None:
+        return None
+    slo_config = (record.get('task_config') or {}).get(
+        'service', {}).get('slo')
+    try:
+        slo_spec = spec_lib.SLOSpec.from_config(slo_config)
+    except ValueError:
+        slo_spec = None
+    rows = state_lib.get_serve_slo(service=service)
+    service_rows = [r for r in rows if r['kind'] == 'service']
+    latest = service_rows[0] if service_rows else None
+    # Only replicas from the newest evaluation (same ts as its
+    # service row): a drained replica's last digest stays latest for
+    # its id and must not render next to the live fleet.
+    replica_rows = sorted(
+        (r for r in rows if r['kind'] == 'replica' and
+         latest is not None and r['ts'] == latest['ts']),
+        key=lambda r: r['replica_id'] or 0)
+    return {
+        'service': service,
+        'status': record['status'].value,
+        'slo': slo_spec.to_config() if slo_spec else None,
+        'actual': ({k: latest.get(k) for k in
+                    ('ttft_p50_ms', 'ttft_p99_ms', 'tpot_p50_ms',
+                     'e2e_p50_ms', 'e2e_p99_ms', 'requests_total',
+                     'errors_total', 'queue_depth', 'tokens_per_sec',
+                     'inflight', 'ts')}
+                   if latest else None),
+        'burns': latest.get('burns') if latest else None,
+        'verdict': latest.get('verdict') if latest else None,
+        'detail': latest.get('detail') if latest else None,
+        'replicas': replica_rows,
+    }
+
+
+@cli.command(name='slo')
+@click.argument('service', required=False)
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='One JSON object per service (joinable with '
+                   '`xsky events --json` on the breach events).')
+def slo_cmd(service, as_json):
+    """Serving SLO health: declared objectives vs observed latency,
+    multi-window error-budget burn rates, and the breach verdict.
+
+    Rows come from the serve_slo table, written by each service
+    controller's SLO monitor (replica /metrics scrapes + the load
+    balancer's per-request records). A `breach` verdict means every
+    burn window is spending its error budget faster than it accrues
+    — the same evaluation that journals `serve.slo_breach` (see
+    `xsky events --type serve.slo_breach`).
+    """
+    from skypilot_tpu.serve import state as serve_state
+    names = [service] if service else \
+        [s['name'] for s in serve_state.get_services()]
+    reports = []
+    for name in names:
+        report = _slo_service_report(name)
+        if report is None:
+            raise click.UsageError(f'Service {name!r} not found.')
+        reports.append(report)
+    if as_json:
+        for report in reports:
+            click.echo(json.dumps(report, default=str))
+        return
+    if not reports:
+        click.echo('No services.')
+        return
+    for report in reports:
+        objectives = report['slo'] or {}
+        actual = report['actual'] or {}
+        click.echo(f"Service {report['service']} "
+                   f"({report['status']}): "
+                   f"verdict={report['verdict'] or 'no data yet'}")
+        if not objectives:
+            click.echo('  (no slo: declared; latency digest only)')
+        fmt = '  {:<16} {:>12} {:>12}'
+        click.echo(fmt.format('OBJECTIVE', 'TARGET', 'OBSERVED'))
+        rows = [
+            ('ttft_p99_ms', objectives.get('ttft_p99_ms'),
+             actual.get('ttft_p99_ms')),
+            ('tpot_p50_ms', objectives.get('tpot_p50_ms'),
+             actual.get('tpot_p50_ms')),
+        ]
+        for name, target, observed in rows:
+            click.echo(fmt.format(
+                name,
+                _fmt_ms(target), _fmt_ms(observed)))
+        reqs = actual.get('requests_total')
+        errs = actual.get('errors_total')
+        observed_avail = '-'
+        if reqs:
+            observed_avail = f'{1.0 - (errs or 0) / reqs:.4f}'
+        click.echo(fmt.format(
+            'availability',
+            (f"{objectives['availability']:.4f}"
+             if objectives.get('availability') is not None else '-'),
+            observed_avail))
+        if report['burns']:
+            bfmt = '  {:<16}' + ' {:>12}' * len(report['burns'])
+            windows = sorted(report['burns'],
+                             key=lambda w: float(w))
+            click.echo(bfmt.format(
+                'BURN RATE', *[f'{w}s window' for w in windows]))
+            names_seen = sorted({obj for w in windows
+                                 for obj in report['burns'][w]})
+            for obj in names_seen:
+                click.echo(bfmt.format(
+                    obj, *[_fmt_burn(report['burns'][w].get(obj))
+                           for w in windows]))
+        if report['replicas']:
+            rfmt = ('  {:<8} {:<22} {:>10} {:>10} {:>10} {:>8} '
+                    '{:>7} {:>8}')
+            click.echo(rfmt.format(
+                'REPLICA', 'ENDPOINT', 'TTFT_P50', 'TTFT_P99',
+                'TPOT_P50', 'QUEUE', 'REQS', 'ERRORS'))
+            for row in report['replicas']:
+                click.echo(rfmt.format(
+                    str(row['replica_id']),
+                    (row['endpoint'] or '-')[:22],
+                    _fmt_ms(row.get('ttft_p50_ms')),
+                    _fmt_ms(row.get('ttft_p99_ms')),
+                    _fmt_ms(row.get('tpot_p50_ms')),
+                    (f"{row['queue_depth']:.0f}"
+                     if row.get('queue_depth') is not None else '-'),
+                    str(row.get('requests_total')
+                        if row.get('requests_total') is not None
+                        else '-'),
+                    str(row.get('errors_total')
+                        if row.get('errors_total') is not None
+                        else '-')))
+
+
 @cli.command()
 @click.option('--fix', is_flag=True, default=False,
               help='Run the reconciler: repair every unhealthy scope '
@@ -1489,10 +1641,36 @@ def serve_update(service_name, entrypoint, mode, yes):
 
 @serve.command(name='status')
 @click.argument('service_names', nargs=-1)
-def serve_status(service_names):
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='One JSON object per service (the full record, '
+                   'replicas included).')
+def serve_status(service_names, as_json):
+    """Service fleet health, latency and SLO burn at a glance
+    (`xsky slo SERVICE` has the full per-replica/per-window view)."""
     from skypilot_tpu.client import sdk
-    for record in sdk.serve_status(list(service_names) or None):
-        click.echo(json.dumps(record, default=str))
+    records = sdk.serve_status(list(service_names) or None)
+    if as_json:
+        for record in records:
+            click.echo(json.dumps(record, default=str))
+        return
+    fmt = ('{:<16} {:<12} {:>3} {:>8} {:>9} {:>9} {:>6} '
+           '{:<8}  {}')
+    click.echo(fmt.format('NAME', 'STATUS', 'VER', 'REPLICAS',
+                          'QPS', 'TTFT_P99', 'BURN', 'SLO',
+                          'ENDPOINT'))
+    for r in records:
+        slo_info = r.get('slo') or {}
+        ready = len([rep for rep in r.get('replicas', ())
+                     if rep['status'] == 'READY'])
+        qps = r.get('qps')
+        click.echo(fmt.format(
+            r['name'][:16], r['status'], str(r.get('version') or 1),
+            f"{ready}/{len(r.get('replicas', ()))}",
+            f'{qps:.2f}' if qps is not None else '-',
+            _fmt_ms(slo_info.get('ttft_p99_ms')),
+            _fmt_burn(slo_info.get('burn_rate')),
+            slo_info.get('verdict') or '-',
+            r['endpoint']))
 
 
 @serve.command(name='logs')

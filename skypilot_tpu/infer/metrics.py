@@ -10,9 +10,16 @@ Exposed at GET /metrics on every replica:
   * xsky_serve_requests_total{endpoint,outcome}
   * xsky_serve_prompt_tokens_total / xsky_serve_generated_tokens_total
   * xsky_serve_ttft_seconds          (histogram)
+  * xsky_serve_tpot_seconds          (histogram, inter-token latency)
   * xsky_serve_e2e_latency_seconds   (histogram)
   * xsky_serve_active_slots / xsky_serve_free_slots /
     xsky_serve_queue_depth           (gauges, read live)
+
+The serve controller's SLO monitor (serve/slo.py) scrapes this text
+each tick: TTFT/TPOT/e2e feed the per-replica latency digests in
+`xsky slo`, and TPOT is the replica-side signal behind the
+``slo.tpot_p50_ms`` objective (the LB can time bytes but cannot count
+tokens).
 """
 from __future__ import annotations
 
@@ -20,40 +27,22 @@ import threading
 from typing import Dict, Optional, Tuple
 
 from skypilot_tpu.agent import telemetry
+# The SLO plane owns the one cumulative-bucket histogram whose render
+# its scrape parser round-trips (serve/slo.py); a second copy here
+# would have to stay render-compatible by hand.
+from skypilot_tpu.serve.slo import Histogram as _Histogram
 
 _TTFT_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
                  float('inf'))
+# Inter-token latency: decode steps are milliseconds on-device but
+# 100ms+ when host dispatch dominates (BENCH_LOCAL_r03_serve) — the
+# buckets must resolve both regimes.
+_TPOT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                 0.5, 1.0, float('inf'))
 _E2E_BUCKETS = (0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0,
                 float('inf'))
 
 
-def _fmt_le(le: float) -> str:
-    return '+Inf' if le == float('inf') else f'{le:g}'
-
-
-class _Histogram:
-
-    def __init__(self, buckets) -> None:
-        self.les = buckets
-        self.counts = [0] * len(buckets)
-        self.total = 0.0
-        self.n = 0
-
-    def observe(self, value: float) -> None:
-        for i, le in enumerate(self.les):
-            if value <= le:
-                self.counts[i] += 1
-        self.total += value
-        self.n += 1
-
-    def render(self, name: str) -> list:
-        lines = [f'# TYPE {name} histogram']
-        for i, le in enumerate(self.les):
-            lines.append(f'{name}_bucket{{le="{_fmt_le(le)}"}} '
-                         f'{self.counts[i]}')
-        lines.append(f'{name}_sum {self.total:.6f}')
-        lines.append(f'{name}_count {self.n}')
-        return lines
 
 
 class ServeMetrics:
@@ -65,11 +54,13 @@ class ServeMetrics:
         self._prompt_tokens = 0
         self._generated_tokens = 0
         self._ttft = _Histogram(_TTFT_BUCKETS)
+        self._tpot = _Histogram(_TPOT_BUCKETS)
         self._e2e = _Histogram(_E2E_BUCKETS)
 
     def observe(self, endpoint: str, outcome: str, prompt_tokens: int,
                 generated_tokens: int, ttft_s: Optional[float],
-                e2e_s: Optional[float]) -> None:
+                e2e_s: Optional[float],
+                tpot_s: Optional[float] = None) -> None:
         with self._lock:
             key = (endpoint, outcome)
             self._requests[key] = self._requests.get(key, 0) + 1
@@ -77,6 +68,8 @@ class ServeMetrics:
             self._generated_tokens += generated_tokens
             if ttft_s is not None:
                 self._ttft.observe(ttft_s)
+            if tpot_s is not None:
+                self._tpot.observe(tpot_s)
             if e2e_s is not None:
                 self._e2e.observe(e2e_s)
             n_requests = sum(self._requests.values())
@@ -111,8 +104,18 @@ class ServeMetrics:
         e2e = None
         if request.finished_at is not None:
             e2e = request.finished_at - request.submitted_at
+        # TPOT (inter-token latency): decode wall time over the tokens
+        # it emitted AFTER the first (the first token is prefill and
+        # belongs to TTFT). One token has no inter-token gap.
+        tpot = None
+        n_out = len(request.output_tokens)
+        if request.first_token_at is not None and \
+                request.finished_at is not None and n_out > 1:
+            tpot = max(0.0, request.finished_at -
+                       request.first_token_at) / (n_out - 1)
         self.observe(endpoint, outcome, len(request.prompt_tokens),
-                     len(request.output_tokens), ttft, e2e)
+                     len(request.output_tokens), ttft, e2e,
+                     tpot_s=tpot)
 
     def render(self, orch=None) -> str:
         with self._lock:
@@ -129,6 +132,7 @@ class ServeMetrics:
                 f'{self._generated_tokens}',
             ]
             lines += self._ttft.render('xsky_serve_ttft_seconds')
+            lines += self._tpot.render('xsky_serve_tpot_seconds')
             lines += self._e2e.render('xsky_serve_e2e_latency_seconds')
         if orch is not None:
             active = len(orch._slot_req)

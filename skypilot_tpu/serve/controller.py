@@ -18,6 +18,7 @@ from skypilot_tpu.serve import load_balancer as lb_lib
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
 from skypilot_tpu.serve import replica_managers
 from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.serve import slo as slo_lib
 from skypilot_tpu.serve import state as serve_state
 
 logger = sky_logging.init_logger(__name__)
@@ -48,6 +49,14 @@ class SkyServeController:
                 self.spec.load_balancing_policy),
             on_request=lambda: self.autoscaler
             .collect_request_information(1, 0.0))
+        # SLO plane: every scrape interval the monitor pulls replica
+        # /metrics, folds in the LB's request records, and persists
+        # burn rates + latency digests into the serve_slo table.
+        self.slo_monitor = slo_lib.SLOMonitor(
+            service_name, self.spec.slo,
+            record_source=self.load_balancer.request_log.records,
+            inflight_source=self.load_balancer.replica_stats
+            .inflight_by_replica)
         self._stop = threading.Event()
         self._respawn_budget_cleared = False
 
@@ -108,9 +117,13 @@ class SkyServeController:
             new_policy = wanted()
             new_policy.set_ready_replicas(
                 self.replica_manager.ready_endpoints())
+            # Keep the rolling-stats handoff across the swap (a
+            # telemetry-routing policy reads .stats).
+            new_policy.stats = self.load_balancer.replica_stats
             self.load_balancer.policy = new_policy
         self.replica_manager.apply_update(task_config, self.spec,
                                           self.version)
+        self.slo_monitor.update_slo(self.spec.slo)
         logger.info(f'Service {self.service_name}: rolling update to '
                     f'v{self.version}.')
 
@@ -161,6 +174,10 @@ class SkyServeController:
             manager.serving_endpoints(self.update_mode,
                                       decision.target_num_replicas))
         manager.reconcile_versions(decision.target_num_replicas)
+        # SLO evaluation rides the tick but rate-limits itself to the
+        # scrape interval; never raises (the scale loop must survive
+        # a torn scrape or a locked state DB).
+        self.slo_monitor.maybe_tick(manager.replicas())
         if ready > 0:
             serve_state.set_service_status(
                 self.service_name, serve_state.ServiceStatus.READY)

@@ -299,6 +299,36 @@ def update(task: task_lib.Task, service_name: str,
     return new_version
 
 
+def slo_summary(service_name: str) -> Optional[Dict[str, Any]]:
+    """The newest service-level SLO evaluation for one service →
+    {ttft_p99_ms, burn (worst, short window first), verdict} or None
+    when the SLO monitor has not written yet. Never raises — status
+    must render even when the state DB is unreadable."""
+    try:
+        from skypilot_tpu import state as global_state
+        rows = global_state.get_serve_slo(service=service_name,
+                                          kind='service')
+        if not rows:
+            return None
+        row = rows[0]
+        worst = None
+        for per in (row.get('burns') or {}).values():
+            for burn in per.values():
+                if burn == 'inf':
+                    burn = float('inf')
+                if burn is not None and (worst is None or
+                                         burn > worst):
+                    worst = burn
+        return {
+            'ttft_p99_ms': row.get('ttft_p99_ms'),
+            'tpot_p50_ms': row.get('tpot_p50_ms'),
+            'burn_rate': worst,
+            'verdict': row.get('verdict'),
+        }
+    except Exception:  # pylint: disable=broad-except
+        return None
+
+
 def status(service_names: Optional[List[str]] = None,
            limit: Optional[int] = None,
            offset: int = 0) -> List[Dict[str, Any]]:
@@ -327,6 +357,9 @@ def status(service_names: Optional[List[str]] = None,
             'workspace': r.get('workspace'),
             'qps': r.get('qps'),
             'target_replicas': r.get('target_replicas'),
+            # Latency/burn columns (the SLO monitor's newest verdict;
+            # None until its first evaluation lands).
+            'slo': slo_summary(r['name']),
             'replicas': [{
                 'replica_id': rep['replica_id'],
                 'status': rep['status'].value,

@@ -2,24 +2,168 @@
 sky/serve/load_balancer.py:23), stdlib-only like the API server.
 
 Counts requests for the autoscaler (shared via a callback), retries the
-next replica on connection failure.
+next replica on connection failure, and — the serving SLO plane's
+ground truth — keeps a per-request lifecycle record for every request
+it relays: arrival timestamp, replica chosen, retries, upstream
+connect time, TTFT observed at the relay (first body chunk), streamed
+bytes/chunks, end-to-end latency and outcome (including mid-relay
+truncation). Records land in a bounded in-memory ring
+(``XSKY_LB_RING_SIZE``) surfaced at the LB's own ``GET /metrics``
+(Prometheus text) and ``GET /lb/requests`` (JSON debug dump), and feed
+the per-replica rolling stats in ``load_balancing_policies.py`` and
+the burn-rate evaluation in ``serve/slo.py``. ``XSKY_LB_RECORDS=0``
+disables record-keeping (the bench_serve_slo overhead baseline).
 """
 from __future__ import annotations
 
+import collections
+import json
+import os
 import threading
+import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, List, Optional, Tuple
+from typing import (Any, Callable, Dict, List, Optional, Tuple)
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.serve import slo as slo_lib
+from skypilot_tpu.utils import chaos
 
 logger = sky_logging.init_logger(__name__)
 
 _HOP_HEADERS = {'connection', 'keep-alive', 'transfer-encoding',
                 'upgrade', 'proxy-authenticate', 'te', 'trailers',
                 'host', 'content-length'}
+
+# Request-record ring size. At 100 QPS 2048 records hold ~20 s — the
+# short burn window should be covered, so size the ring to
+# (expected QPS x longest burn window) in production.
+_RING_ENV = 'XSKY_LB_RING_SIZE'
+_RECORDS_ENV = 'XSKY_LB_RECORDS'
+
+_TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                 5.0, 10.0, float('inf'))
+_E2E_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                60.0, 300.0, float('inf'))
+
+
+class RequestLog:
+    """Bounded ring of finished request records + aggregate counters
+    and TTFT/e2e histograms. Thread-safe; every mutator is a handful
+    of dict/deque ops so record-keeping stays off the relay's critical
+    path (gated <2% added p50 by tools/bench_serve_slo.py)."""
+
+    def __init__(self, maxlen: Optional[int] = None) -> None:
+        if maxlen is None:
+            try:
+                maxlen = int(os.environ.get(_RING_ENV, '2048'))
+            except ValueError:
+                # A typo'd observability knob must not take down the
+                # data path it observes (same posture as
+                # slo.parse_windows).
+                maxlen = 2048
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(
+            maxlen=max(1, maxlen))
+        self.outcomes: Dict[str, int] = {}
+        self.retries_total = 0
+        self._ttft = slo_lib.Histogram(_TTFT_BUCKETS)
+        self._e2e = slo_lib.Histogram(_E2E_BUCKETS)
+
+    def start(self, method: str, path: str) -> Dict[str, Any]:
+        return {
+            'ts': time.time(),          # wall arrival (burn windows)
+            't0': time.monotonic(),     # latency base
+            'method': method,
+            'path': path,
+            'replica': None,
+            'retries': 0,
+            'connect_s': None,
+            'ttft_s': None,
+            'e2e_s': None,
+            'bytes': 0,
+            'chunks': 0,
+            'status': None,
+            'outcome': None,
+        }
+
+    def mark_first_chunk(self, rec: Dict[str, Any]) -> None:
+        if rec['ttft_s'] is None:
+            rec['ttft_s'] = time.monotonic() - rec['t0']
+
+    def finish(self, rec: Dict[str, Any],
+               outcome: Optional[str] = None) -> Dict[str, Any]:
+        """Seal the record (idempotent on outcome precedence: an
+        outcome already set by the proxy loop — no_replica,
+        unreachable, error — wins over the handler's default)."""
+        if rec.get('outcome') is None:
+            rec['outcome'] = outcome or 'ok'
+        rec['e2e_s'] = time.monotonic() - rec['t0']
+        with self._lock:
+            self._ring.append(rec)
+            key = rec['outcome']
+            self.outcomes[key] = self.outcomes.get(key, 0) + 1
+            self.retries_total += rec.get('retries') or 0
+            if rec['ttft_s'] is not None:
+                self._ttft.observe(rec['ttft_s'])
+            if rec['e2e_s'] is not None:
+                self._e2e.observe(rec['e2e_s'])
+        return rec
+
+    def records(self, limit: Optional[int] = None
+                ) -> List[Dict[str, Any]]:
+        """Newest-first copies (JSON-safe: the monotonic base is
+        dropped)."""
+        with self._lock:
+            rows = list(self._ring)
+        rows.reverse()
+        if limit is not None:
+            rows = rows[:max(0, int(limit))]
+        return [{k: v for k, v in r.items() if k != 't0'}
+                for r in rows]
+
+    def render_metrics(self,
+                       tracker: Optional[
+                           lb_policies.ReplicaStatsTracker] = None
+                       ) -> str:
+        """The LB's own Prometheus exposition: request outcomes,
+        retries, relay-observed TTFT/e2e histograms, and per-replica
+        rolling gauges from the stats tracker."""
+        with self._lock:
+            lines = ['# TYPE xsky_lb_requests_total counter']
+            for outcome, n in sorted(self.outcomes.items()):
+                lines.append(
+                    f'xsky_lb_requests_total{{outcome="{outcome}"}} '
+                    f'{n}')
+            lines += [
+                '# TYPE xsky_lb_retries_total counter',
+                f'xsky_lb_retries_total {self.retries_total}',
+            ]
+            lines += self._ttft.render('xsky_lb_ttft_seconds')
+            lines += self._e2e.render('xsky_lb_e2e_seconds')
+        if tracker is not None:
+            snap = tracker.snapshot()
+            gauges = (
+                ('xsky_lb_replica_inflight', 'inflight', 1.0),
+                ('xsky_lb_replica_ttft_p99_seconds', 'ttft_p99_ms',
+                 1e-3),
+                ('xsky_lb_replica_error_rate', 'error_rate', 1.0),
+            )
+            for metric, key, scale in gauges:
+                series = []
+                for replica, stats in snap.items():
+                    value = stats.get(key)
+                    if value is None:
+                        continue
+                    series.append(
+                        f'{metric}{{replica="{replica}"}} '
+                        f'{value * scale:.6f}')
+                if series:
+                    lines.append(f'# TYPE {metric} gauge')
+                    lines.extend(series)
+        return '\n'.join(lines) + '\n'
 
 
 class SkyServeLoadBalancer:
@@ -30,11 +174,27 @@ class SkyServeLoadBalancer:
         self.policy = policy or lb_policies.RoundRobinPolicy()
         self.on_request = on_request or (lambda: None)
         self._server: Optional[ThreadingHTTPServer] = None
+        self.records_enabled = \
+            os.environ.get(_RECORDS_ENV, '1') != '0'
+        self.request_log = RequestLog()
+        self.replica_stats = lb_policies.ReplicaStatsTracker()
+        # Routing-signal handoff: policies read rolling stats from
+        # their .stats attribute (see load_balancing_policies.py).
+        self.policy.stats = self.replica_stats
 
     def set_ready_replicas(self, endpoints: List[str]) -> None:
         self.policy.set_ready_replicas(endpoints)
+        if self.records_enabled:
+            self.replica_stats.prune(endpoints)
 
-    def _proxy(self, method: str, path: str, body: bytes, headers
+    def _observe(self, replica: str, ok: bool,
+                 ttft_s: Optional[float] = None,
+                 e2e_s: Optional[float] = None) -> None:
+        if self.records_enabled:
+            self.replica_stats.observe(replica, ok, ttft_s, e2e_s)
+
+    def _proxy(self, method: str, path: str, body: bytes, headers,
+               rec: Optional[Dict[str, Any]] = None
                ) -> Tuple[int, object, List[Tuple[str, str]],
                           Callable[[], None]]:
         """Returns (status, payload, headers, finish). `payload` is
@@ -49,10 +209,16 @@ class SkyServeLoadBalancer:
         max_tries = 3
         while tried < max_tries:
             tried += 1
+            if rec is not None:
+                rec['retries'] = tried - 1
             replica = self.policy.select_replica()
             if replica is None:
+                if rec is not None:
+                    rec['outcome'] = 'no_replica'
                 return (503, b'{"error": "no ready replicas"}', [],
                         lambda: None)
+            if rec is not None:
+                rec['replica'] = replica
             url = f'http://{replica}{path}'
             req = urllib.request.Request(url, data=body or None,
                                          method=method)
@@ -60,13 +226,31 @@ class SkyServeLoadBalancer:
                 if k.lower() not in _HOP_HEADERS:
                     req.add_header(k, v)
             try:
+                # Chaos drill: `lb.proxy` slows or fails the upstream
+                # leg of one request — a latency rule here is how the
+                # bench proves a slow replica becomes a burn breach.
+                chaos.inject('lb.proxy', replica=replica, path=path)
                 resp = urllib.request.urlopen(req, timeout=120)
             except urllib.error.HTTPError as e:
                 self.policy.request_done(replica)
+                ok = e.code < 500
+                if rec is not None:
+                    rec['status'] = e.code
+                    rec['connect_s'] = time.monotonic() - rec['t0']
+                    rec['outcome'] = 'ok' if ok else 'error'
+                    rec['observed'] = True
+                self._observe(replica, ok)
                 return e.code, e.read(), [], lambda: None
-            except (urllib.error.URLError, OSError, TimeoutError):
+            except (urllib.error.URLError, OSError, TimeoutError,
+                    chaos.ChaosError):
                 self.policy.request_done(replica)
+                self._observe(replica, False)
                 continue  # replica unreachable: try another
+            if rec is not None:
+                rec['status'] = resp.status
+                rec['connect_s'] = time.monotonic() - rec['t0']
+            if self.records_enabled:
+                self.replica_stats.request_started(replica)
             out_headers = [(k, v) for k, v in resp.headers.items()
                            if k.lower() not in _HOP_HEADERS]
             # Forward upstream framing: with a Content-Length the
@@ -77,16 +261,40 @@ class SkyServeLoadBalancer:
             if upstream_cl is not None:
                 out_headers.append(('Content-Length', upstream_cl))
             done = threading.Event()
+            lb = self
 
             def finish(replica=replica, resp=resp, done=done):
                 if not done.is_set():  # idempotent
                     done.set()
                     resp.close()
-                    self.policy.request_done(replica)
+                    lb.policy.request_done(replica)
+                    if lb.records_enabled:
+                        lb.replica_stats.request_finished(replica)
 
             return resp.status, resp, out_headers, finish
+        if rec is not None:
+            rec['outcome'] = 'unreachable'
         return (502, b'{"error": "all replicas unreachable"}', [],
                 lambda: None)
+
+    def finish_record(self, rec: Optional[Dict[str, Any]],
+                      outcome: Optional[str] = None) -> None:
+        """Seal one lifecycle record and fold it into the per-replica
+        rolling stats (errors AND latency — a truncated stream counts
+        against the replica that truncated it)."""
+        if rec is None:
+            return
+        rec = self.request_log.finish(rec, outcome)
+        replica = rec.get('replica')
+        if replica is not None and rec.get('status') is not None and \
+                not rec.pop('observed', False):
+            # Attempt-level results (HTTPError/unreachable) were
+            # already observed in _proxy (the 'observed' flag); this
+            # is the relay-level outcome for streamed bodies.
+            if rec['outcome'] in ('ok', 'truncated', 'client_gone'):
+                self._observe(replica,
+                              rec['outcome'] != 'truncated',
+                              rec.get('ttft_s'), rec.get('e2e_s'))
 
     def make_server(self, host: str = '0.0.0.0',
                     port: int = 0,
@@ -97,14 +305,52 @@ class SkyServeLoadBalancer:
 
         class _Handler(BaseHTTPRequestHandler):
 
+            # A half-open client must not pin a relay thread forever
+            # (same hardening as the API server's _Handler, PR 6).
+            timeout = 120
+
             def log_message(self, *args):
                 pass
 
+            def _send_local(self, code: int, body: bytes,
+                            content_type: str) -> None:
+                self.send_response(code)
+                self.send_header('Content-Type', content_type)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _handle_local(self) -> bool:
+                """The LB's own observability endpoints; everything
+                else proxies to a replica."""
+                if self.path == '/metrics':
+                    body = lb.request_log.render_metrics(
+                        lb.replica_stats).encode()
+                    self._send_local(
+                        200, body, 'text/plain; version=0.0.4')
+                    return True
+                if self.path.startswith('/lb/requests'):
+                    body = json.dumps(
+                        lb.request_log.records(limit=200),
+                        default=str).encode()
+                    self._send_local(200, body, 'application/json')
+                    return True
+                if self.path.startswith('/lb/'):
+                    self._send_local(404, b'{"error": "unknown"}',
+                                     'application/json')
+                    return True
+                return False
+
             def _handle(self, method: str):
+                if method == 'GET' and self._handle_local():
+                    return
                 length = int(self.headers.get('Content-Length') or 0)
                 body = self.rfile.read(length) if length else b''
+                rec = (lb.request_log.start(method, self.path)
+                       if lb.records_enabled else None)
                 status, payload, out_headers, finish = lb._proxy(
-                    method, self.path, body, self.headers)
+                    method, self.path, body, self.headers, rec)
+                outcome = None
                 try:
                     self.send_response(status)
                     for k, v in out_headers:
@@ -130,19 +376,27 @@ class SkyServeLoadBalancer:
                             # connection so the client sees truncation
                             # rather than a silent clean EOF... which
                             # HTTP/1.0 read-until-close can't express;
+                            # count it (xsky_lb_requests_total{outcome=
+                            # "truncated"} + replica error stats) and
                             # log it so the operator can.
                             logger.warning(
                                 'upstream replica failed mid-relay on '
                                 f'{self.path}')
+                            outcome = 'truncated'
                             break
                         if not chunk:
                             break
+                        if rec is not None:
+                            lb.request_log.mark_first_chunk(rec)
+                            rec['bytes'] += len(chunk)
+                            rec['chunks'] += 1
                         self.wfile.write(chunk)
                         self.wfile.flush()
                 except (BrokenPipeError, ConnectionResetError):
-                    pass  # client went away mid-relay
+                    outcome = 'client_gone'  # client went away
                 finally:
                     finish()
+                    lb.finish_record(rec, outcome)
 
             def do_GET(self):  # noqa: N802
                 self._handle('GET')

@@ -1,12 +1,114 @@
-"""LB policies (twin of sky/serve/load_balancing_policies.py)."""
+"""LB policies + per-replica rolling stats (twin of
+sky/serve/load_balancing_policies.py).
+
+:class:`ReplicaStatsTracker` lives here (not in the load balancer) on
+purpose: rolling TTFT/error/inflight per replica is routing signal —
+the telemetry-routing policy of ROADMAP "Production serve data plane"
+will read it from ``self.stats`` to pick replicas, the way LeastLoad
+reads its in-flight counts today.
+"""
 from __future__ import annotations
 
 import collections
 import threading
-from typing import Dict, List, Optional
+import time
+from typing import Any, Dict, List, Optional
+
+# Rolling-window samples kept per replica (latency percentiles and
+# error rate are computed over these, newest-N not wall-clock — a
+# traffic lull must not empty the window).
+_STATS_WINDOW = 512
+
+
+class ReplicaStats:
+    """One replica's rolling view: in-flight count plus a bounded
+    deque of (ts, ok, ttft_s, e2e_s) outcomes."""
+
+    def __init__(self, window: int = _STATS_WINDOW) -> None:
+        self.inflight = 0
+        self.requests = 0
+        self.errors = 0
+        self.samples: collections.deque = collections.deque(
+            maxlen=window)
+
+    def snapshot(self) -> Dict[str, Any]:
+        from skypilot_tpu.serve import slo as slo_lib
+        ttfts = sorted(s[2] for s in self.samples if s[2] is not None)
+        e2es = sorted(s[3] for s in self.samples if s[3] is not None)
+        recent = list(self.samples)
+        errors_recent = len([s for s in recent if not s[1]])
+        return {
+            'inflight': self.inflight,
+            'requests_total': self.requests,
+            'errors_total': self.errors,
+            'error_rate': (errors_recent / len(recent)
+                           if recent else None),
+            'ttft_p50_ms': slo_lib.pctl_ms(ttfts, 0.50),
+            'ttft_p99_ms': slo_lib.pctl_ms(ttfts, 0.99),
+            'e2e_p50_ms': slo_lib.pctl_ms(e2es, 0.50),
+            'e2e_p99_ms': slo_lib.pctl_ms(e2es, 0.99),
+        }
+
+
+class ReplicaStatsTracker:
+    """Thread-safe per-replica rolling stats, fed by the load
+    balancer's request records and pruned with the ready set."""
+
+    def __init__(self, window: int = _STATS_WINDOW) -> None:
+        self._lock = threading.Lock()
+        self._window = window
+        self._stats: Dict[str, ReplicaStats] = {}
+
+    def _get(self, replica: str) -> ReplicaStats:
+        stats = self._stats.get(replica)
+        if stats is None:
+            stats = self._stats[replica] = ReplicaStats(self._window)
+        return stats
+
+    def request_started(self, replica: str) -> None:
+        with self._lock:
+            self._get(replica).inflight += 1
+
+    def request_finished(self, replica: str) -> None:
+        with self._lock:
+            stats = self._stats.get(replica)
+            if stats is not None and stats.inflight > 0:
+                stats.inflight -= 1
+
+    def observe(self, replica: str, ok: bool,
+                ttft_s: Optional[float] = None,
+                e2e_s: Optional[float] = None) -> None:
+        with self._lock:
+            stats = self._get(replica)
+            stats.requests += 1
+            if not ok:
+                stats.errors += 1
+            stats.samples.append((time.time(), ok, ttft_s, e2e_s))
+
+    def prune(self, live_replicas: List[str]) -> None:
+        """Drop replicas no longer in the ready set (a drained
+        replica's stats must not linger as routing signal)."""
+        live = set(live_replicas)
+        with self._lock:
+            for gone in set(self._stats) - live:
+                del self._stats[gone]
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {replica: stats.snapshot()
+                    for replica, stats in sorted(self._stats.items())}
+
+    def inflight_by_replica(self) -> Dict[str, int]:
+        with self._lock:
+            return {replica: stats.inflight
+                    for replica, stats in self._stats.items()}
 
 
 class LoadBalancingPolicy:
+
+    # Rolling per-replica stats, attached by the load balancer; a
+    # telemetry-routing policy reads this in select_replica.
+    stats: Optional[ReplicaStatsTracker] = None
 
     def set_ready_replicas(self, replicas: List[str]) -> None:
         raise NotImplementedError

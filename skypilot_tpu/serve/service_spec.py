@@ -4,6 +4,64 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 
+class SLOSpec:
+    """The service's declared objectives (``slo:`` subsection)::
+
+        slo:
+          ttft_p99_ms: 500      # p99 time-to-first-token at the LB
+          availability: 0.999   # non-error fraction of requests
+          tpot_p50_ms: 40       # median inter-token latency (replica)
+
+    All fields optional; burn rates are computed per declared objective
+    (serve/slo.py). The error budget falls out of each objective: a
+    p99 target concedes 1% of requests, a p50 target 50%, and
+    availability concedes ``1 - availability``.
+    """
+
+    FIELDS = ('ttft_p99_ms', 'availability', 'tpot_p50_ms')
+
+    def __init__(self, ttft_p99_ms: Optional[float] = None,
+                 availability: Optional[float] = None,
+                 tpot_p50_ms: Optional[float] = None) -> None:
+        if ttft_p99_ms is not None and ttft_p99_ms <= 0:
+            raise ValueError('slo.ttft_p99_ms must be > 0')
+        if tpot_p50_ms is not None and tpot_p50_ms <= 0:
+            raise ValueError('slo.tpot_p50_ms must be > 0')
+        if availability is not None and not 0.0 < availability <= 1.0:
+            raise ValueError(
+                'slo.availability must be in (0, 1] (a fraction, '
+                'not a percentage)')
+        if ttft_p99_ms is None and availability is None and \
+                tpot_p50_ms is None:
+            raise ValueError(
+                'slo: declares no objective; expected at least one of '
+                f'{list(self.FIELDS)}')
+        self.ttft_p99_ms = \
+            float(ttft_p99_ms) if ttft_p99_ms is not None else None
+        self.availability = \
+            float(availability) if availability is not None else None
+        self.tpot_p50_ms = \
+            float(tpot_p50_ms) if tpot_p50_ms is not None else None
+
+    @classmethod
+    def from_config(cls, config: Optional[Dict[str, Any]]
+                    ) -> Optional['SLOSpec']:
+        if not config:
+            return None
+        config = dict(config)
+        kwargs = {field: config.pop(field, None)
+                  for field in cls.FIELDS}
+        if config:
+            raise ValueError(
+                f'Unknown slo fields: {sorted(config)}; expected a '
+                f'subset of {list(cls.FIELDS)}.')
+        return cls(**kwargs)
+
+    def to_config(self) -> Dict[str, Any]:
+        return {field: getattr(self, field) for field in self.FIELDS
+                if getattr(self, field) is not None}
+
+
 class SkyServiceSpec:
 
     def __init__(self,
@@ -20,7 +78,8 @@ class SkyServiceSpec:
                  dynamic_ondemand_fallback: bool = False,
                  load_balancing_policy: str = 'round_robin',
                  tls_certfile: Optional[str] = None,
-                 tls_keyfile: Optional[str] = None) -> None:
+                 tls_keyfile: Optional[str] = None,
+                 slo: Optional[SLOSpec] = None) -> None:
         if bool(tls_certfile) != bool(tls_keyfile):
             raise ValueError(
                 'tls requires BOTH certfile and keyfile')
@@ -60,6 +119,9 @@ class SkyServiceSpec:
         # service-spec `tls:` section → HTTPS endpoint).
         self.tls_certfile = tls_certfile
         self.tls_keyfile = tls_keyfile
+        # Declared objectives; None = no burn-rate evaluation (the SLO
+        # monitor still records latency digests for `xsky slo`).
+        self.slo = slo
 
     @property
     def tls_enabled(self) -> bool:
@@ -86,6 +148,7 @@ class SkyServiceSpec:
         port = config.pop('port', None)
         lb_policy = config.pop('load_balancing_policy', 'round_robin')
         tls = config.pop('tls', None) or {}
+        slo = SLOSpec.from_config(config.pop('slo', None))
         unknown = set(config)
         if unknown:
             raise ValueError(f'Unknown service fields: {sorted(unknown)}')
@@ -111,6 +174,7 @@ class SkyServiceSpec:
             load_balancing_policy=lb_policy,
             tls_certfile=tls.get('certfile'),
             tls_keyfile=tls.get('keyfile'),
+            slo=slo,
         )
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -145,4 +209,6 @@ class SkyServiceSpec:
         if self.tls_enabled:
             config['tls'] = {'certfile': self.tls_certfile,
                              'keyfile': self.tls_keyfile}
+        if self.slo is not None:
+            config['slo'] = self.slo.to_config()
         return config

@@ -1,0 +1,710 @@
+"""Serving SLO plane: burn-rate math, replica /metrics scraping, and
+the controller-tick monitor that persists both into the `serve_slo`
+table.
+
+The measurement substrate for SLO-driven serving (ROADMAP "Production
+serve data plane"): objectives are declared in the service spec
+(``slo: {ttft_p99_ms, availability, tpot_p50_ms}``,
+:class:`~skypilot_tpu.serve.service_spec.SLOSpec`), observed at two
+places —
+
+  * the load balancer's per-request lifecycle records (user-facing
+    TTFT/e2e/outcome, ``serve/load_balancer.py``), which feed the
+    availability and TTFT objectives over multiple burn windows;
+  * each ready replica's Prometheus ``/metrics`` text (the histograms
+    ``infer/metrics.py`` already renders), scraped per controller tick
+    for per-replica latency digests and the TPOT objective —
+
+and folded into *burn rates*: observed bad fraction over the error
+budget, per window (SRE error-budget methodology; burn >= 1 means the
+budget is being spent exactly as fast as it accrues, >> 1 means an
+incident). A breach (every window over threshold) is journalled as
+``serve.slo_breach`` and surfaced via `xsky slo`, `xsky serve status`
+and the control-plane ``/metrics`` gauges.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import time
+import urllib.request
+from typing import (Any, Callable, Dict, List, Optional, Sequence,
+                    Tuple)
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+# Multi-window burn evaluation (short window catches fast burn, long
+# window keeps the alert from flapping on one bad minute). Seconds,
+# comma-separated.
+ENV_BURN_WINDOWS = 'XSKY_SLO_BURN_WINDOWS'
+DEFAULT_BURN_WINDOWS = '300,3600'
+# Breach when EVERY window with data burns at or above this rate.
+ENV_BURN_THRESHOLD = 'XSKY_SLO_BURN_THRESHOLD'
+# Replica /metrics scrape cadence (the controller tick runs more often;
+# scraping every tick would hammer replicas for no signal).
+ENV_SCRAPE_INTERVAL = 'XSKY_SLO_SCRAPE_INTERVAL_S'
+ENV_SCRAPE_TIMEOUT = 'XSKY_SLO_SCRAPE_TIMEOUT'
+
+
+def burn_windows() -> List[float]:
+    return parse_windows(
+        os.environ.get(ENV_BURN_WINDOWS, DEFAULT_BURN_WINDOWS))
+
+
+def parse_windows(value: str) -> List[float]:
+    """'300,3600' → [300.0, 3600.0]; unparseable entries dropped, an
+    empty/garbage value falls back to the default (a typo'd knob must
+    not disable burn evaluation)."""
+    out = []
+    for part in str(value).split(','):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            w = float(part)
+        except ValueError:
+            continue
+        if w > 0:
+            out.append(w)
+    if not out:
+        out = [float(p) for p in DEFAULT_BURN_WINDOWS.split(',')]
+    return sorted(out)
+
+
+def burn_threshold() -> float:
+    try:
+        return float(os.environ.get(ENV_BURN_THRESHOLD, '1.0'))
+    except ValueError:
+        return 1.0
+
+
+# ---- histogram --------------------------------------------------------------
+
+
+def fmt_le(le: float) -> str:
+    return '+Inf' if le == float('inf') else f'{le:g}'
+
+
+class Histogram:
+    """Cumulative-bucket histogram rendering the Prometheus text
+    format; the LB-side twin of infer/metrics._Histogram (kept public
+    here so the SLO plane owns one copy of the bucket math)."""
+
+    def __init__(self, buckets: Sequence[float]) -> None:
+        self.les = tuple(buckets)
+        self.counts = [0] * len(self.les)
+        self.total = 0.0
+        self.n = 0
+
+    def observe(self, value: float) -> None:
+        for i, le in enumerate(self.les):
+            if value <= le:
+                self.counts[i] += 1
+        self.total += value
+        self.n += 1
+
+    def render(self, name: str) -> List[str]:
+        lines = [f'# TYPE {name} histogram']
+        for i, le in enumerate(self.les):
+            lines.append(
+                f'{name}_bucket{{le="{fmt_le(le)}"}} {self.counts[i]}')
+        lines.append(f'{name}_sum {self.total:.6f}')
+        lines.append(f'{name}_count {self.n}')
+        return lines
+
+
+# ---- prometheus text parsing ------------------------------------------------
+
+Sample = Tuple[Dict[str, str], float]
+
+
+def parse_prometheus_text(text: str) -> Dict[str, List[Sample]]:
+    """Parse exposition-format text → {metric name: [(labels, value)]}.
+
+    Handles exactly the subset our replicas render (``# TYPE``/``HELP``
+    comments, ``name value`` and ``name{k="v",...} value`` lines);
+    malformed lines are skipped, never fatal — a half-written scrape
+    must not take the controller tick down."""
+    out: Dict[str, List[Sample]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith('#'):
+            continue
+        try:
+            name, labels, value = _parse_sample_line(line)
+        except ValueError:
+            continue
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+def _parse_sample_line(line: str) -> Tuple[str, Dict[str, str], float]:
+    labels: Dict[str, str] = {}
+    if '{' in line:
+        name, rest = line.split('{', 1)
+        label_text, _, value_text = rest.rpartition('}')
+        for pair in _split_labels(label_text):
+            if '=' not in pair:
+                continue
+            k, v = pair.split('=', 1)
+            labels[k.strip()] = _unescape_label(v.strip().strip('"'))
+    else:
+        name, _, value_text = line.partition(' ')
+    return name.strip(), labels, float(value_text.strip())
+
+
+def _split_labels(text: str) -> List[str]:
+    """Split 'a="x",b="y,z"' on commas outside quotes."""
+    parts, cur, in_quotes, escaped = [], [], False, False
+    for ch in text:
+        if escaped:
+            cur.append(ch)
+            escaped = False
+            continue
+        if ch == '\\':
+            cur.append(ch)
+            escaped = True
+            continue
+        if ch == '"':
+            in_quotes = not in_quotes
+        if ch == ',' and not in_quotes:
+            parts.append(''.join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        parts.append(''.join(cur))
+    return parts
+
+
+def _unescape_label(value: str) -> str:
+    return (value.replace('\\\\', '\\').replace('\\"', '"')
+            .replace('\\n', '\n'))
+
+
+def _parse_le(value: str) -> float:
+    return float('inf') if value in ('+Inf', 'inf') else float(value)
+
+
+Buckets = List[Tuple[float, float]]  # (le, cumulative count), sorted
+
+
+def histogram_buckets(samples: Dict[str, List[Sample]],
+                      name: str) -> Optional[Dict[str, Any]]:
+    """Reassemble one histogram from parsed samples →
+    {'buckets': [(le, cum_count)...], 'sum': float, 'count': int},
+    or None when the metric is absent."""
+    bucket_samples = samples.get(f'{name}_bucket')
+    if not bucket_samples:
+        return None
+    buckets = []
+    for labels, value in bucket_samples:
+        if 'le' not in labels:
+            continue
+        try:
+            buckets.append((_parse_le(labels['le']), value))
+        except ValueError:
+            continue
+    if not buckets:
+        return None
+    buckets.sort(key=lambda b: b[0])
+    total = sum(v for _, v in samples.get(f'{name}_sum', ())) or 0.0
+    count = sum(v for _, v in samples.get(f'{name}_count', ())) or 0
+    return {'buckets': buckets, 'sum': total, 'count': int(count)}
+
+
+def quantile_from_buckets(buckets: Buckets,
+                          q: float) -> Optional[float]:
+    """Estimate the q-quantile from cumulative buckets (linear
+    interpolation inside the landing bucket, the promql
+    histogram_quantile estimator). None on an empty histogram; the
+    +Inf bucket clamps to the last finite boundary."""
+    if not buckets:
+        return None
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_le, prev_count = 0.0, 0.0
+    for le, count in buckets:
+        if count >= rank:
+            if le == float('inf'):
+                return prev_le if prev_le > 0 else None
+            if count == prev_count:
+                return le
+            frac = (rank - prev_count) / (count - prev_count)
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_count = le, count
+    return prev_le if prev_le > 0 else None
+
+
+def frac_over(buckets: Buckets, threshold: float) -> Optional[float]:
+    """Fraction of observations above `threshold`, using the smallest
+    bucket boundary >= threshold (conservative: observations between
+    the threshold and that boundary count as under)."""
+    if not buckets:
+        return None
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    for le, count in buckets:
+        if le >= threshold:
+            return (total - count) / total
+    return 0.0
+
+
+def delta_buckets(old: Optional[Buckets],
+                  new: Buckets) -> Buckets:
+    """new - old per bucket boundary (windowed view of a cumulative
+    histogram). A replica restart (counts went backwards) returns
+    `new` whole — its histogram restarted from zero."""
+    if not old:
+        return list(new)
+    old_map = dict(old)
+    out = []
+    for le, count in new:
+        prev = old_map.get(le, 0.0)
+        if count < prev:
+            return list(new)
+        out.append((le, count - prev))
+    return out
+
+
+def merge_buckets(histograms: List[Buckets]) -> Buckets:
+    """Sum several cumulative-bucket histograms boundary-wise (the
+    fleet view of per-replica histograms). Boundaries are unioned; a
+    histogram missing a boundary contributes its nearest lower cum
+    count there (conservative, and exact when fleets share buckets —
+    ours always do)."""
+    merged: Dict[float, float] = {}
+    for buckets in histograms:
+        for le, count in buckets:
+            merged[le] = merged.get(le, 0.0) + count
+    return sorted(merged.items())
+
+
+def pctl_ms(sorted_values: List[float], q: float) -> Optional[float]:
+    """Index-based q-quantile of SORTED second-valued samples, in ms
+    (the one copy — ReplicaStats.snapshot and the service row share
+    it). None on empty."""
+    if not sorted_values:
+        return None
+    idx = min(len(sorted_values) - 1, int(q * len(sorted_values)))
+    return sorted_values[idx] * 1000.0
+
+
+# ---- burn rate --------------------------------------------------------------
+
+
+def burn_rate(bad: float, total: float,
+              budget: float) -> Optional[float]:
+    """Observed bad fraction over the error budget.
+
+    None with no observations (an empty window says nothing). A zero
+    budget (availability: 1.0) burns infinitely on the first bad
+    request and 0 otherwise — the only consistent reading of "no
+    errors allowed"."""
+    if total <= 0:
+        return None
+    frac = bad / total
+    if budget <= 0:
+        return 0.0 if frac == 0 else float('inf')
+    return frac / budget
+
+
+# Outcomes that spend the availability error budget. client_gone is the
+# client's own disconnect and spends nothing; no_replica/unreachable
+# ARE unavailability even though no replica ever saw the request.
+BAD_OUTCOMES = frozenset(
+    {'error', 'unreachable', 'no_replica', 'truncated'})
+
+
+def burns_from_records(records: List[Dict[str, Any]],
+                       slo,
+                       now: Optional[float] = None,
+                       windows: Optional[List[float]] = None,
+                       ) -> Dict[str, Dict[str, Optional[float]]]:
+    """Burn rates per window from LB request records →
+    {window("300"): {objective: burn|None}}.
+
+    availability counts BAD_OUTCOMES over all non-client-cancelled
+    requests; ttft_p99_ms counts records whose relay-observed TTFT
+    exceeded the target over all records that measured one (budget:
+    the 1% a p99 objective concedes)."""
+    now = time.time() if now is None else now
+    windows = windows if windows is not None else burn_windows()
+    out: Dict[str, Dict[str, Optional[float]]] = {}
+    for window in windows:
+        sel = [r for r in records
+               if (r.get('ts') or 0) >= now - window and
+               r.get('outcome') != 'client_gone']
+        per: Dict[str, Optional[float]] = {}
+        if slo is not None and slo.availability is not None:
+            bad = len([r for r in sel
+                       if r.get('outcome') in BAD_OUTCOMES])
+            per['availability'] = burn_rate(
+                bad, len(sel), 1.0 - slo.availability)
+        if slo is not None and slo.ttft_p99_ms is not None:
+            lat = [r['ttft_s'] for r in sel
+                   if r.get('ttft_s') is not None]
+            viol = len([t for t in lat
+                        if t * 1000.0 > slo.ttft_p99_ms])
+            per['ttft_p99_ms'] = burn_rate(viol, len(lat), 0.01)
+        out[f'{window:g}'] = per
+    return out
+
+
+def verdict_from_burns(burns: Dict[str, Dict[str, Optional[float]]],
+                       threshold: Optional[float] = None
+                       ) -> Tuple[str, List[str]]:
+    """('ok'|'breach'|'no_data', [breached objective names]).
+
+    An objective breaches when EVERY window that has data for it burns
+    at or above the threshold (the multi-window AND: fast burn alone
+    flaps, slow burn alone pages a day late)."""
+    threshold = burn_threshold() if threshold is None else threshold
+    objectives: Dict[str, List[float]] = {}
+    for per in burns.values():
+        for name, burn in per.items():
+            if burn is not None:
+                objectives.setdefault(name, []).append(burn)
+    if not objectives:
+        return 'no_data', []
+    breached = sorted(
+        name for name, values in objectives.items()
+        if values and all(b >= threshold for b in values))
+    return ('breach' if breached else 'ok'), breached
+
+
+# ---- replica scraping -------------------------------------------------------
+
+
+def scrape_replica_metrics(endpoint: str,
+                           timeout: Optional[float] = None
+                           ) -> Dict[str, List[Sample]]:
+    """GET http://<endpoint>/metrics and parse it. Raises on transport
+    errors — the caller decides whether a dead scrape is a verdict."""
+    if timeout is None:
+        timeout = float(os.environ.get(ENV_SCRAPE_TIMEOUT, '5'))
+    with urllib.request.urlopen(f'http://{endpoint}/metrics',
+                                timeout=timeout) as resp:
+        return parse_prometheus_text(
+            resp.read().decode('utf-8', errors='replace'))
+
+
+def replica_digest(samples: Dict[str, List[Sample]]
+                   ) -> Dict[str, Any]:
+    """Per-replica latency digest from one parsed scrape: TTFT/TPOT/
+    e2e percentiles (ms), queue depth, request/error totals, generated
+    tokens (cumulative — the monitor turns them into a rate)."""
+    digest: Dict[str, Any] = {}
+
+    def pct(name: str, q: float) -> Optional[float]:
+        hist = histogram_buckets(samples, name)
+        if hist is None:
+            return None
+        value = quantile_from_buckets(hist['buckets'], q)
+        return None if value is None else value * 1000.0
+
+    digest['ttft_p50_ms'] = pct('xsky_serve_ttft_seconds', 0.50)
+    digest['ttft_p99_ms'] = pct('xsky_serve_ttft_seconds', 0.99)
+    digest['tpot_p50_ms'] = pct('xsky_serve_tpot_seconds', 0.50)
+    digest['e2e_p50_ms'] = pct('xsky_serve_e2e_latency_seconds', 0.50)
+    digest['e2e_p99_ms'] = pct('xsky_serve_e2e_latency_seconds', 0.99)
+    queue = samples.get('xsky_serve_queue_depth')
+    digest['queue_depth'] = queue[0][1] if queue else None
+    requests = samples.get('xsky_serve_requests_total', [])
+    digest['requests_total'] = int(sum(v for _, v in requests))
+    digest['errors_total'] = int(sum(
+        v for labels, v in requests
+        if labels.get('outcome') not in ('ok', 'cancelled')))
+    tokens = samples.get('xsky_serve_generated_tokens_total')
+    digest['generated_tokens'] = int(tokens[0][1]) if tokens else None
+    tpot = histogram_buckets(samples, 'xsky_serve_tpot_seconds')
+    digest['tpot_buckets'] = tpot['buckets'] if tpot else None
+    return digest
+
+
+# ---- monitor ----------------------------------------------------------------
+
+
+class SLOMonitor:
+    """Rides the serve controller tick: every scrape interval it pulls
+    each ready replica's /metrics, folds in the LB's request records,
+    computes multi-window burn rates against the service's SLO, writes
+    the lot into the global `serve_slo` table, and journals
+    ``serve.slo_breach`` / ``serve.slo_recovered`` on verdict
+    transitions (trace-linked via the surrounding span)."""
+
+    def __init__(self, service_name: str, slo,
+                 record_source: Optional[
+                     Callable[[], List[Dict[str, Any]]]] = None,
+                 inflight_source: Optional[
+                     Callable[[], Dict[str, int]]] = None) -> None:
+        self.service_name = service_name
+        self.slo = slo
+        self._record_source = record_source or (lambda: [])
+        self._inflight_source = inflight_source or (lambda: {})
+        self._last_eval = 0.0
+        self._breached: Optional[bool] = None
+        # Cumulative-scrape memory for windowed deltas: per replica id,
+        # bounded deques of (ts, tpot buckets) + (ts, generated tokens).
+        self._tpot_prev: Dict[int, collections.deque] = {}
+        self._tokens_prev: Dict[int, Tuple[float, int]] = {}
+
+    def update_slo(self, slo) -> None:
+        self.slo = slo
+
+    @property
+    def interval_s(self) -> float:
+        try:
+            return float(os.environ.get(ENV_SCRAPE_INTERVAL, '15'))
+        except ValueError:
+            return 15.0
+
+    def maybe_tick(self, replicas: List[Dict[str, Any]],
+                   now: Optional[float] = None
+                   ) -> Optional[Dict[str, Any]]:
+        """Run one evaluation if the scrape interval elapsed. Never
+        raises — SLO observation must not take the controller's scale
+        loop down with it."""
+        now = time.time() if now is None else now
+        if now - self._last_eval < self.interval_s:
+            return None
+        self._last_eval = now
+        try:
+            return self._evaluate(replicas, now)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'SLO tick failed: {e}')
+            return None
+
+    # -- one evaluation ------------------------------------------------------
+
+    def _evaluate(self, replicas: List[Dict[str, Any]],
+                  now: float) -> Dict[str, Any]:
+        from skypilot_tpu import state as global_state
+        from skypilot_tpu.serve import state as serve_state
+        from skypilot_tpu.utils import tracing
+        # The span covers the scrape fan-out AND the record write, so
+        # a slow replica scrape is attributable in `xsky trace` and
+        # the journalled breach cross-links to this trace.
+        with tracing.span('serve.slo_tick', service=self.service_name):
+            windows = burn_windows()
+            rows: List[Dict[str, Any]] = []
+            inflight = self._inflight_source() or {}
+            tpot_deltas: List[Buckets] = []
+            ready = [
+                r for r in replicas
+                if r.get('endpoint') and
+                r.get('status') == serve_state.ReplicaStatus.READY]
+            # Scrape-snapshot caches are keyed by replica id; replica
+            # churn (spot preemption mints fresh ids forever) must not
+            # leak an hour of bucket history per dead id.
+            live_ids = {r['replica_id'] for r in ready}
+            for cache in (self._tpot_prev, self._tokens_prev):
+                for rid in list(cache):
+                    if rid not in live_ids:
+                        del cache[rid]
+            if ready:
+                # Parallel scrape fan-out: N hung replicas must cost
+                # ONE scrape timeout of controller tick, not N (the
+                # scale loop rides this thread). _scrape_one never
+                # raises (a dead scrape is a verdict, not an error).
+                from skypilot_tpu.utils import parallelism
+                results = parallelism.run_in_parallel(
+                    lambda r: self._scrape_one(r, now, windows,
+                                               inflight, tpot_deltas),
+                    ready, phase='slo_scrape',
+                    what='replica SLO scrape')
+                rows.extend(r for r in results if r is not None)
+            tpot_delta = merge_buckets(tpot_deltas)
+            service_row = self._service_row(rows, tpot_delta, now,
+                                            windows, inflight)
+            rows.append(service_row)
+            global_state.record_serve_slo(self.service_name, rows,
+                                          ts=now)
+            self._journal_transition(service_row, global_state)
+            return service_row
+
+    def _scrape_one(self, replica: Dict[str, Any], now: float,
+                    windows: List[float],
+                    inflight: Dict[str, int],
+                    tpot_deltas: List[Buckets]
+                    ) -> Optional[Dict[str, Any]]:
+        replica_id = replica['replica_id']
+        endpoint = replica['endpoint']
+        from skypilot_tpu.utils import tracing
+        try:
+            with tracing.span('serve.slo_scrape',
+                              service=self.service_name,
+                              replica=replica_id):
+                samples = scrape_replica_metrics(endpoint)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'replica {replica_id} scrape failed: {e}')
+            return {'kind': 'replica', 'replica_id': replica_id,
+                    'endpoint': endpoint, 'verdict': 'scrape_failed'}
+        digest = replica_digest(samples)
+        tpot_buckets = digest.pop('tpot_buckets', None)
+        if tpot_buckets:
+            window_start = self._tpot_window_snapshot(
+                replica_id, now, max(windows), tpot_buckets)
+            tpot_deltas.append(
+                delta_buckets(window_start, tpot_buckets))
+        tokens = digest.pop('generated_tokens', None)
+        digest['tokens_per_sec'] = self._tokens_rate(
+            replica_id, now, tokens)
+        digest['kind'] = 'replica'
+        digest['replica_id'] = replica_id
+        digest['endpoint'] = endpoint
+        digest['inflight'] = inflight.get(endpoint)
+        digest['verdict'] = 'ok'
+        return digest
+
+    def _tpot_window_snapshot(self, replica_id: int, now: float,
+                              max_window: float,
+                              buckets: Buckets) -> Optional[Buckets]:
+        """Record this scrape's cumulative TPOT buckets and return the
+        snapshot closest to (now - max_window) so the caller can delta
+        against it. Deque is time-bounded by the longest window."""
+        history = self._tpot_prev.setdefault(
+            replica_id, collections.deque())
+        history.append((now, [tuple(b) for b in buckets]))
+        while history and history[0][0] < now - max_window - 1.0:
+            history.popleft()
+        return history[0][1] if len(history) > 1 else None
+
+    def _tokens_rate(self, replica_id: int, now: float,
+                     tokens: Optional[int]) -> Optional[float]:
+        if tokens is None:
+            return None
+        prev = self._tokens_prev.get(replica_id)
+        self._tokens_prev[replica_id] = (now, tokens)
+        if prev is None or now <= prev[0] or tokens < prev[1]:
+            return None
+        return (tokens - prev[1]) / (now - prev[0])
+
+    def _service_row(self, replica_rows: List[Dict[str, Any]],
+                     tpot_delta: Buckets, now: float,
+                     windows: List[float],
+                     inflight: Dict[str, int]) -> Dict[str, Any]:
+        records = [r for r in self._record_source()
+                   if (r.get('ts') or 0) >= now - max(windows)]
+        burns = burns_from_records(records, self.slo, now=now,
+                                   windows=windows)
+        self._fold_tpot_burn(burns, tpot_delta)
+        verdict, breached = ('no_slo', []) if self.slo is None \
+            else verdict_from_burns(burns)
+        # Same population the availability burn sees (client_gone
+        # spends no budget): requests/errors here must reproduce the
+        # burn's observed availability, or `xsky slo` prints an
+        # objective 'met' next to a breaching burn.
+        short = [r for r in records
+                 if (r.get('ts') or 0) >= now - windows[0] and
+                 r.get('outcome') != 'client_gone']
+        lat = sorted(r['ttft_s'] for r in short
+                     if r.get('ttft_s') is not None)
+        e2e = sorted(r['e2e_s'] for r in short
+                     if r.get('e2e_s') is not None)
+        bad = len([r for r in short
+                   if r.get('outcome') in BAD_OUTCOMES])
+        tokens = [r['tokens_per_sec'] for r in replica_rows
+                  if r.get('tokens_per_sec') is not None]
+        queue = [r['queue_depth'] for r in replica_rows
+                 if r.get('queue_depth') is not None]
+        tpot_p50 = quantile_from_buckets(tpot_delta, 0.50) \
+            if tpot_delta else None
+        return {
+            'kind': 'service',
+            'replica_id': None,
+            'endpoint': None,
+            'ttft_p50_ms': pctl_ms(lat, 0.50),
+            'ttft_p99_ms': pctl_ms(lat, 0.99),
+            'tpot_p50_ms': (tpot_p50 * 1000.0
+                            if tpot_p50 is not None else None),
+            'e2e_p50_ms': pctl_ms(e2e, 0.50),
+            'e2e_p99_ms': pctl_ms(e2e, 0.99),
+            'queue_depth': sum(queue) if queue else None,
+            'tokens_per_sec': sum(tokens) if tokens else None,
+            'requests_total': len(short),
+            'errors_total': bad,
+            'inflight': sum(inflight.values()) if inflight else None,
+            'burns': burns,
+            'verdict': verdict,
+            'detail': {'breached_objectives': breached,
+                       'windows': [f'{w:g}' for w in windows],
+                       'threshold': burn_threshold(),
+                       'slo': self.slo.to_config()
+                       if self.slo is not None else None},
+        }
+
+    def _fold_tpot_burn(
+            self, burns: Dict[str, Dict[str, Optional[float]]],
+            tpot_delta: Buckets) -> None:
+        """TPOT burn from the merged windowed replica histograms: the
+        scrape cadence bounds the delta's resolution, so every window
+        shares the max-window delta (documented approximation — the
+        LB cannot see tokens, only replicas can)."""
+        if self.slo is None or self.slo.tpot_p50_ms is None:
+            return
+        if not tpot_delta:
+            for per in burns.values():
+                per['tpot_p50_ms'] = None
+            return
+        frac = frac_over(tpot_delta, self.slo.tpot_p50_ms / 1000.0)
+        total = tpot_delta[-1][1]
+        burn = None
+        if frac is not None and total > 0:
+            burn = burn_rate(frac * total, total, 0.5)
+        for per in burns.values():
+            per['tpot_p50_ms'] = burn
+
+    def _journal_transition(self, service_row: Dict[str, Any],
+                            global_state) -> None:
+        verdict = service_row.get('verdict')
+        if verdict not in ('ok', 'breach'):
+            # no_slo / no_data: the incident can no longer be
+            # confirmed either way. Close an open breach (the journal
+            # must not show one forever after the SLO is removed or
+            # traffic stops) and reset, so a later re-breach journals
+            # a fresh event instead of riding the stale True.
+            if self._breached is True:
+                global_state.record_recovery_event(
+                    'serve.slo_recovered',
+                    scope=f'service/{self.service_name}',
+                    cause=f'evaluation became {verdict}')
+            self._breached = None
+            return
+        breached_now = verdict == 'breach'
+        was = self._breached
+        self._breached = breached_now
+        if breached_now and was is not True:
+            detail = dict(service_row.get('detail') or {})
+            detail['burns'] = json_safe_burns(
+                service_row.get('burns') or {})
+            global_state.record_recovery_event(
+                'serve.slo_breach',
+                scope=f'service/{self.service_name}',
+                cause=('objectives over budget: ' + ', '.join(
+                    detail.get('breached_objectives') or [])),
+                detail=detail)
+        elif not breached_now and was is True:
+            global_state.record_recovery_event(
+                'serve.slo_recovered',
+                scope=f'service/{self.service_name}',
+                cause='burn rate back under threshold')
+
+
+def json_safe_burns(burns: Optional[
+        Dict[str, Dict[str, Optional[float]]]]
+        ) -> Dict[str, Dict[str, Any]]:
+    """inf is not JSON (json.dumps emits `Infinity`, which stdlib
+    accepts but nothing else does); stringify zero-budget burns."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for window, per in (burns or {}).items():
+        out[window] = {
+            k: ('inf' if v == float('inf') else v)
+            for k, v in per.items()
+        }
+    return out

@@ -35,6 +35,9 @@ and gauges computed at scrape time from the state DB:
   * xsky_dispatch_gap_ratio{cluster,job,rank}  (host dispatch share of
     step time — >0.5 means the step loop is host-bound)
   * xsky_hbm_bytes_in_use{cluster,job,rank}
+  * xsky_serve_slo_burn_rate{service,window}  (worst objective's burn;
+    >= 1 spends the error budget faster than it accrues)
+  * xsky_serve_replica_ttft_p99_seconds{service,replica}
 """
 from __future__ import annotations
 
@@ -259,13 +262,81 @@ def _render_profile_gauges() -> List[str]:
     return lines
 
 
+def _render_serve_slo_gauges() -> List[str]:
+    """Serving-SLO health computed at scrape time from the newest
+    per-service SLO evaluations: per-window burn rate (the WORST
+    declared objective's — the one an alert should page on; per-
+    objective burns stay in `xsky slo --json`) and per-replica p99
+    TTFT from the replica scrape digests. Filtered to LIVE services
+    (rows of a torn-down service linger in the bounded table and must
+    not grow label cardinality forever). Never raises; an unreadable
+    DB costs the gauges, not the scrape."""
+    lines: List[str] = []
+    try:
+        from skypilot_tpu import state
+        from skypilot_tpu.serve import state as serve_state
+        live = {s['name'] for s in serve_state.get_services()}
+        rows = [r for r in state.get_serve_slo()
+                if r['service'] in live]
+        if not rows:
+            return []
+        # Replica rows export only from each service's NEWEST
+        # evaluation (same ts as its service row): a scaled-down or
+        # recovered-away replica's last digest stays latest for its id
+        # forever and would otherwise grow stale label cardinality.
+        eval_ts = {r['service']: r['ts'] for r in rows
+                   if r['kind'] == 'service'}
+        burn_lines, ttft_lines = [], []
+        for row in rows:
+            if row['kind'] == 'replica' and \
+                    row['ts'] != eval_ts.get(row['service']):
+                continue
+            service = _escape_label(row['service'])
+            if row['kind'] == 'service' and row.get('burns'):
+                for window, per in sorted(row['burns'].items()):
+                    burns = [
+                        float('inf') if b == 'inf' else b
+                        for b in per.values() if b is not None]
+                    if not burns:
+                        continue
+                    worst = max(burns)
+                    value = ('+Inf' if worst == float('inf')
+                             else f'{worst:.4f}')
+                    burn_lines.append(
+                        f'xsky_serve_slo_burn_rate{{service='
+                        f'"{service}",window="{window}"}} {value}')
+            elif row['kind'] == 'replica' and \
+                    row.get('ttft_p99_ms') is not None:
+                ttft_lines.append(
+                    'xsky_serve_replica_ttft_p99_seconds{service='
+                    f'"{service}",replica="{row["replica_id"]}"}} '
+                    f'{row["ttft_p99_ms"] / 1000.0:.6f}')
+        if burn_lines:
+            lines.append('# HELP xsky_serve_slo_burn_rate Error-'
+                         'budget burn rate per window (worst '
+                         'declared objective; >=1 means the budget '
+                         'is being spent faster than it accrues).')
+            lines.append('# TYPE xsky_serve_slo_burn_rate gauge')
+            lines.extend(burn_lines)
+        if ttft_lines:
+            lines.append('# HELP xsky_serve_replica_ttft_p99_seconds '
+                         'Per-replica p99 TTFT from the newest '
+                         '/metrics scrape.')
+            lines.append('# TYPE xsky_serve_replica_ttft_p99_seconds '
+                         'gauge')
+            lines.extend(ttft_lines)
+    except Exception:  # pylint: disable=broad-except
+        return []
+    return lines
+
+
 def render() -> str:
     """Text exposition format (version 0.0.4): the server's own
     HTTP/verb series, then the generic control-plane registry, then
-    the scrape-time lease + workload + profile gauges."""
+    the scrape-time lease + workload + profile + serve-SLO gauges."""
     tail = registry.render_registry() + '\n'.join(
         _render_lease_gauges() + _render_workload_gauges() +
-        _render_profile_gauges())
+        _render_profile_gauges() + _render_serve_slo_gauges())
     with _lock:
         lines = [
             '# HELP xsky_http_requests_total HTTP requests by route/code.',

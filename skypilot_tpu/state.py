@@ -269,6 +269,31 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             ON profiles (cluster);
         CREATE INDEX IF NOT EXISTS idx_profiles_latest
             ON profiles (cluster, job_id, rank, kind, row_id);
+        CREATE TABLE IF NOT EXISTS serve_slo (
+            row_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            ts REAL,
+            service TEXT,
+            kind TEXT,
+            replica_id INTEGER,
+            endpoint TEXT,
+            ttft_p50_ms REAL,
+            ttft_p99_ms REAL,
+            tpot_p50_ms REAL,
+            e2e_p50_ms REAL,
+            e2e_p99_ms REAL,
+            queue_depth REAL,
+            tokens_per_sec REAL,
+            requests_total INTEGER,
+            errors_total INTEGER,
+            inflight INTEGER,
+            burns TEXT,
+            verdict TEXT,
+            detail TEXT
+        );
+        CREATE INDEX IF NOT EXISTS idx_serve_slo_service
+            ON serve_slo (service);
+        CREATE INDEX IF NOT EXISTS idx_serve_slo_latest
+            ON serve_slo (service, kind, replica_id, row_id);
         CREATE INDEX IF NOT EXISTS idx_clusters_status
             ON clusters (status);
         CREATE INDEX IF NOT EXISTS idx_recovery_events_ts
@@ -1118,6 +1143,141 @@ def get_profiles(cluster: Optional[str] = None,
             'hbm_bytes_limit': hbm_limit,
             'hbm_peak_bytes': peak,
             'verdicts': verdicts,
+            'detail': detail,
+        })
+    return out
+
+
+# ---- serving SLO ------------------------------------------------------------
+
+# Per-tick SLO evaluations written by each serve controller's SLO
+# monitor (serve/slo.py): kind='replica' rows carry one replica's
+# scraped latency digest, kind='service' rows carry the LB-observed
+# fleet digest + multi-window burn rates + verdict. `xsky slo`,
+# `xsky serve status` and the /metrics burn gauges read from here.
+
+# Newest rows kept (pruned lazily). One evaluation writes
+# replicas+1 rows; at the default 15 s scrape cadence 20k rows keep
+# ~10 hours for a 10-replica service.
+_MAX_SERVE_SLO = 20000
+_serve_slo_inserts = 0
+
+_SERVE_SLO_COLS = ('ts, service, kind, replica_id, endpoint, '
+                   'ttft_p50_ms, ttft_p99_ms, tpot_p50_ms, '
+                   'e2e_p50_ms, e2e_p99_ms, queue_depth, '
+                   'tokens_per_sec, requests_total, errors_total, '
+                   'inflight, burns, verdict, detail')
+
+
+def record_serve_slo(service: str, rows: List[Dict[str, Any]],
+                     ts: Optional[float] = None) -> None:
+    """Persist one SLO evaluation's rows in ONE transaction. NEVER
+    raises — SLO recording rides the serve controller's tick loop
+    (same contract and batched-write pattern as
+    record_workload_telemetry)."""
+    global _serve_slo_inserts
+    if not rows:
+        return
+    ts = ts if ts is not None else time.time()
+    try:
+        from skypilot_tpu.serve import slo as slo_lib
+        conn = _get_conn()
+        values = [(r.get('ts', ts), service, r.get('kind', 'replica'),
+                   r.get('replica_id'), r.get('endpoint'),
+                   r.get('ttft_p50_ms'), r.get('ttft_p99_ms'),
+                   r.get('tpot_p50_ms'), r.get('e2e_p50_ms'),
+                   r.get('e2e_p99_ms'), r.get('queue_depth'),
+                   r.get('tokens_per_sec'), r.get('requests_total'),
+                   r.get('errors_total'), r.get('inflight'),
+                   (json.dumps(slo_lib.json_safe_burns(r['burns']))
+                    if r.get('burns') else None),
+                   r.get('verdict'),
+                   (json.dumps(r['detail'], default=str)
+                    if r.get('detail') else None))
+                  for r in rows]
+    except Exception:  # pylint: disable=broad-except
+        return
+    try:
+        with _lock:
+            conn.executemany(
+                f'INSERT INTO serve_slo ({_SERVE_SLO_COLS}) VALUES '
+                '(?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, '
+                '?, ?)', values)
+            # Prune on the FIRST batch too (short-lived CLI writers
+            # never reach an amortized gate — same rationale as spans).
+            _serve_slo_inserts += len(rows)
+            if _serve_slo_inserts == len(rows) or \
+                    _serve_slo_inserts % 256 < len(rows):
+                conn.execute(
+                    'DELETE FROM serve_slo WHERE row_id <= '
+                    '(SELECT MAX(row_id) FROM serve_slo) - ?',
+                    (_MAX_SERVE_SLO,))
+            conn.commit()
+    except Exception:  # pylint: disable=broad-except
+        try:
+            conn.rollback()
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+def get_serve_slo(service: Optional[str] = None,
+                  kind: Optional[str] = None,
+                  latest_only: bool = True,
+                  limit: int = 2000,
+                  offset: int = 0) -> List[Dict[str, Any]]:
+    """SLO rows, newest-evaluation-first.
+
+    ``latest_only`` returns ONE row per (service, kind, replica_id) —
+    the live view `xsky slo` renders; ``latest_only=False`` is the
+    history (burn-rate trend across an incident)."""
+    conds, args = [], []
+    if service is not None:
+        conds.append('service = ?')
+        args.append(service)
+    if kind is not None:
+        conds.append('kind = ?')
+        args.append(kind)
+    query = f'SELECT {_SERVE_SLO_COLS} FROM serve_slo'
+    if latest_only:
+        query += (' WHERE row_id IN (SELECT MAX(row_id) FROM '
+                  'serve_slo GROUP BY service, kind, replica_id)')
+        if conds:
+            query += ' AND ' + ' AND '.join(conds)
+    elif conds:
+        query += ' WHERE ' + ' AND '.join(conds)
+    query += (' ORDER BY service, kind, replica_id, row_id DESC' +
+              _page_sql(int(limit), offset))
+    rows = _read(query, args)
+    out = []
+    for (ts, svc, row_kind, replica_id, endpoint, ttft50, ttft99,
+         tpot50, e2e50, e2e99, queue, tps, reqs, errs, inflight,
+         burns, verdict, detail) in rows:
+        try:
+            burns = json.loads(burns) if burns else None
+        except ValueError:
+            burns = None
+        try:
+            detail = json.loads(detail) if detail else None
+        except ValueError:
+            detail = None
+        out.append({
+            'ts': ts,
+            'service': svc,
+            'kind': row_kind,
+            'replica_id': replica_id,
+            'endpoint': endpoint,
+            'ttft_p50_ms': ttft50,
+            'ttft_p99_ms': ttft99,
+            'tpot_p50_ms': tpot50,
+            'e2e_p50_ms': e2e50,
+            'e2e_p99_ms': e2e99,
+            'queue_depth': queue,
+            'tokens_per_sec': tps,
+            'requests_total': reqs,
+            'errors_total': errs,
+            'inflight': inflight,
+            'burns': burns,
+            'verdict': verdict,
             'detail': detail,
         })
     return out

@@ -221,6 +221,24 @@ _VARS = [
     EnvVar('XSKY_SERVE_CONTROLLER_REMOTE', UNSET,
            'Run the serve controller on a controller cluster (set by '
            'the relay; empty string = forced local)'),
+    # ---- serving SLO plane -------------------------------------------------
+    EnvVar('XSKY_LB_RECORDS', '1',
+           'Per-request lifecycle records at the load balancer; 0 '
+           'disables record-keeping (bench baseline, no SLO signal)'),
+    EnvVar('XSKY_LB_RING_SIZE', '2048',
+           'LB request-record ring capacity; size to expected QPS x '
+           'longest burn window'),
+    EnvVar('XSKY_SLO_SCRAPE_INTERVAL_S', '15',
+           'SLO monitor cadence: replica /metrics scrape + burn-rate '
+           'evaluation per service'),
+    EnvVar('XSKY_SLO_SCRAPE_TIMEOUT', '5',
+           'Replica /metrics scrape HTTP timeout'),
+    EnvVar('XSKY_SLO_BURN_WINDOWS', '300,3600',
+           'Burn-rate windows in seconds, comma-separated (breach '
+           'requires every window over threshold)'),
+    EnvVar('XSKY_SLO_BURN_THRESHOLD', '1.0',
+           'Burn rate at/above which an objective breaches (1.0 = '
+           'budget spent exactly as fast as it accrues)'),
     # ---- workload telemetry ------------------------------------------------
     EnvVar('XSKY_TELEMETRY', '1',
            'Set to 0 to disable workload telemetry emission entirely'),

@@ -168,6 +168,17 @@ _SERVICE_SCHEMA: Dict[str, Any] = {
                 'keyfile': _STR,
             },
         },
+        # Serving SLO objectives (service_spec.py SLOSpec; burn-rate
+        # evaluation in serve/slo.py).
+        'slo': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'ttft_p99_ms': _NUM,
+                'availability': _NUM,
+                'tpot_p50_ms': _NUM,
+            },
+        },
     },
 }
 

@@ -1,0 +1,994 @@
+"""Serving SLO plane tests: burn-rate math (synthetic windows, zero
+budgets), LB request-record ring + truncation outcomes, Prometheus
+scrape-parser round-trip against real ServeMetrics.render() output,
+serve_slo table retention/pagination, the SLO monitor's record +
+breach-journal transitions, the `xsky slo` / `xsky serve status` /
+`/metrics` surfaces, the tier-1 fake-cloud smoke where a chaos-slowed
+replica trips `serve.slo_breach`, and the bench_serve_slo --smoke
+subprocess gate."""
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from skypilot_tpu.infer import metrics as infer_metrics
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.serve import slo as slo_lib
+from skypilot_tpu.serve.service_spec import SkyServiceSpec, SLOSpec
+from skypilot_tpu.utils import chaos
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), '..', '..'))
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+@pytest.fixture
+def tmp_state(monkeypatch, tmp_path):
+    from skypilot_tpu import state
+    monkeypatch.setenv('XSKY_STATE_DB', str(tmp_path / 'state.db'))
+    state.reset_for_test()
+    yield state
+    state.reset_for_test()
+
+
+@pytest.fixture
+def tmp_serve_db(monkeypatch, tmp_path):
+    monkeypatch.setenv('XSKY_SERVE_DB', str(tmp_path / 'serve.db'))
+    yield
+
+
+def _upstream(handler_cls) -> ThreadingHTTPServer:
+    server = ThreadingHTTPServer(('127.0.0.1', 0), handler_cls)
+    threading.Thread(target=server.serve_forever,
+                     name='xsky-test-upstream', daemon=True).start()
+    return server
+
+
+class _EchoUpstream(BaseHTTPRequestHandler):
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):  # noqa: N802
+        body = b'hello'
+        self.send_response(200)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+# ---- burn-rate math --------------------------------------------------------
+
+
+class TestBurnMath:
+
+    def test_burn_rate_basics(self):
+        # 2% bad against a 1% budget burns at 2x.
+        assert slo_lib.burn_rate(2, 100, 0.01) == pytest.approx(2.0)
+        assert slo_lib.burn_rate(0, 100, 0.01) == 0.0
+
+    def test_empty_window_is_no_data_not_zero(self):
+        assert slo_lib.burn_rate(0, 0, 0.01) is None
+
+    def test_zero_budget(self):
+        # availability: 1.0 — no errors allowed: any bad request
+        # burns infinitely, none burns zero.
+        assert slo_lib.burn_rate(1, 10, 0.0) == float('inf')
+        assert slo_lib.burn_rate(0, 10, 0.0) == 0.0
+
+    def test_windows_parse(self):
+        assert slo_lib.parse_windows('300,3600') == [300.0, 3600.0]
+        assert slo_lib.parse_windows('60, 5') == [5.0, 60.0]
+        # Garbage falls back to the default, never disables burns.
+        assert slo_lib.parse_windows('nope') == [300.0, 3600.0]
+        assert slo_lib.parse_windows('') == [300.0, 3600.0]
+
+    def _records(self, now, n, ttft_s, outcome='ok', age=1.0):
+        return [{'ts': now - age, 'outcome': outcome, 'ttft_s': ttft_s}
+                for _ in range(n)]
+
+    def test_ttft_burn_from_records(self):
+        now = time.time()
+        slo = SLOSpec(ttft_p99_ms=100)
+        fast = self._records(now, 99, 0.05)
+        slow = self._records(now, 1, 0.5)
+        burns = slo_lib.burns_from_records(fast + slow, slo, now=now,
+                                           windows=[300])
+        # 1% violations / 1% budget = burn 1.0.
+        assert burns['300']['ttft_p99_ms'] == pytest.approx(1.0)
+
+    def test_availability_burn_counts_bad_outcomes(self):
+        now = time.time()
+        slo = SLOSpec(availability=0.9)
+        recs = (self._records(now, 8, 0.01) +
+                self._records(now, 1, None, outcome='truncated') +
+                self._records(now, 1, None, outcome='error') +
+                # client_gone is the client's fault: excluded.
+                self._records(now, 5, None, outcome='client_gone'))
+        burns = slo_lib.burns_from_records(recs, slo, now=now,
+                                           windows=[300])
+        assert burns['300']['availability'] == pytest.approx(2.0)
+
+    def test_window_selects_by_arrival_ts(self):
+        now = time.time()
+        slo = SLOSpec(ttft_p99_ms=100)
+        old_slow = self._records(now, 50, 0.5, age=200.0)
+        new_fast = self._records(now, 50, 0.01, age=1.0)
+        burns = slo_lib.burns_from_records(old_slow + new_fast, slo,
+                                           now=now, windows=[60, 300])
+        assert burns['60']['ttft_p99_ms'] == 0.0
+        assert burns['300']['ttft_p99_ms'] == pytest.approx(50.0)
+
+    def test_verdict_needs_every_window_burning(self):
+        threshold = 1.0
+        both = {'300': {'ttft_p99_ms': 5.0},
+                '3600': {'ttft_p99_ms': 2.0}}
+        verdict, breached = slo_lib.verdict_from_burns(both, threshold)
+        assert verdict == 'breach' and breached == ['ttft_p99_ms']
+        # Long window calm ⇒ one bad minute does not page.
+        one = {'300': {'ttft_p99_ms': 5.0},
+               '3600': {'ttft_p99_ms': 0.2}}
+        assert slo_lib.verdict_from_burns(one, threshold)[0] == 'ok'
+
+    def test_verdict_ignores_dataless_windows(self):
+        burns = {'300': {'availability': 3.0},
+                 '3600': {'availability': None}}
+        assert slo_lib.verdict_from_burns(burns, 1.0)[0] == 'breach'
+        empty = {'300': {}, '3600': {}}
+        assert slo_lib.verdict_from_burns(empty, 1.0)[0] == 'no_data'
+
+    def test_inf_burn_breaches_and_serializes(self):
+        burns = {'300': {'availability': float('inf')}}
+        assert slo_lib.verdict_from_burns(burns, 1.0)[0] == 'breach'
+        safe = slo_lib.json_safe_burns(burns)
+        assert json.loads(json.dumps(safe)) == {
+            '300': {'availability': 'inf'}}
+
+
+class TestSLOSpecValidation:
+
+    def test_round_trip_through_service_spec(self):
+        spec = SkyServiceSpec.from_yaml_config({
+            'readiness_probe': '/',
+            'slo': {'ttft_p99_ms': 500, 'availability': 0.999,
+                    'tpot_p50_ms': 40}})
+        config = spec.to_yaml_config()
+        assert config['slo'] == {'ttft_p99_ms': 500.0,
+                                 'availability': 0.999,
+                                 'tpot_p50_ms': 40.0}
+        again = SkyServiceSpec.from_yaml_config(config)
+        assert again.slo.ttft_p99_ms == 500.0
+
+    def test_no_slo_section_is_none(self):
+        spec = SkyServiceSpec.from_yaml_config({})
+        assert spec.slo is None
+        assert 'slo' not in spec.to_yaml_config()
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SLOSpec.from_config({'availability': 1.5})
+        with pytest.raises(ValueError):
+            SLOSpec.from_config({'ttft_p99_ms': -1})
+        with pytest.raises(ValueError):
+            SLOSpec.from_config({'unknown_objective': 1})
+        with pytest.raises(ValueError):
+            SLOSpec()  # no objective at all
+        assert SLOSpec.from_config(None) is None
+        assert SLOSpec.from_config({}) is None
+
+
+# ---- prometheus parser round trip ------------------------------------------
+
+
+class TestScrapeParser:
+
+    def _rendered(self):
+        metrics = infer_metrics.ServeMetrics()
+        for i in range(100):
+            metrics.observe('/generate', 'ok', 10, 20,
+                            ttft_s=0.01 * (i + 1), e2e_s=0.5,
+                            tpot_s=0.004)
+        metrics.observe('/generate', 'error', 5, 0, None, None)
+        metrics.observe('/generate', 'cancelled', 5, 0, None, None)
+        return metrics.render()
+
+    def test_round_trip_against_real_render(self):
+        samples = slo_lib.parse_prometheus_text(self._rendered())
+        digest = slo_lib.replica_digest(samples)
+        assert digest['requests_total'] == 102
+        # cancelled is the client's own disconnect, not an error.
+        assert digest['errors_total'] == 1
+        assert digest['generated_tokens'] == 2000
+        # 100 observations spread 10ms..1000ms: p50 lands mid-range,
+        # p99 near the top (bucket interpolation, not exact).
+        assert 300 < digest['ttft_p50_ms'] < 700
+        assert digest['ttft_p99_ms'] > 900
+        assert 2 < digest['tpot_p50_ms'] < 6
+        assert digest['tpot_buckets']
+
+    def test_parser_skips_garbage_lines(self):
+        text = ('# HELP x y\nxsky_ok 1\nnot a metric line at all\n'
+                'xsky_bad{le="oops"} notafloat\n')
+        samples = slo_lib.parse_prometheus_text(text)
+        assert samples['xsky_ok'] == [({}, 1.0)]
+        assert 'xsky_bad' not in samples
+
+    def test_label_values_with_commas_and_quotes(self):
+        text = ('m{endpoint="/v1,x",outcome="a\\"b"} 3\n')
+        samples = slo_lib.parse_prometheus_text(text)
+        labels, value = samples['m'][0]
+        assert labels == {'endpoint': '/v1,x', 'outcome': 'a"b'}
+        assert value == 3.0
+
+    def test_quantile_interpolation(self):
+        buckets = [(0.1, 50.0), (0.2, 100.0), (float('inf'), 100.0)]
+        q50 = slo_lib.quantile_from_buckets(buckets, 0.5)
+        assert q50 == pytest.approx(0.1)
+        q75 = slo_lib.quantile_from_buckets(buckets, 0.75)
+        assert 0.1 < q75 < 0.2
+        assert slo_lib.quantile_from_buckets([], 0.5) is None
+
+    def test_frac_over_and_delta(self):
+        buckets = [(0.1, 80.0), (0.5, 100.0), (float('inf'), 100.0)]
+        assert slo_lib.frac_over(buckets, 0.1) == pytest.approx(0.2)
+        # Conservative: a threshold between boundaries counts only
+        # observations above the NEXT boundary as violations.
+        assert slo_lib.frac_over(buckets, 0.01) == pytest.approx(0.2)
+        assert slo_lib.frac_over(buckets, 0.6) == pytest.approx(0.0)
+        old = [(0.1, 40.0), (0.5, 50.0), (float('inf'), 50.0)]
+        delta = slo_lib.delta_buckets(old, buckets)
+        assert delta == [(0.1, 40.0), (0.5, 50.0), (float('inf'),
+                                                    50.0)]
+        # Counts went backwards ⇒ replica restarted: take new whole.
+        restarted = [(0.1, 5.0), (0.5, 6.0), (float('inf'), 6.0)]
+        assert slo_lib.delta_buckets(buckets, restarted) == restarted
+
+    def test_tpot_histogram_derived_from_request_fields(self):
+        metrics = infer_metrics.ServeMetrics()
+
+        class Req:
+            prompt_tokens = [1] * 8
+            output_tokens = [1] * 11
+            submitted_at = 100.0
+            first_token_at = 100.5
+            finished_at = 100.6
+            error = None
+
+        metrics.observe_request('/generate', Req())
+        samples = slo_lib.parse_prometheus_text(metrics.render())
+        hist = slo_lib.histogram_buckets(samples,
+                                         'xsky_serve_tpot_seconds')
+        assert hist['count'] == 1
+        # (100.6 - 100.5) / (11 - 1) = 10ms per token.
+        assert hist['sum'] == pytest.approx(0.01, abs=1e-6)
+        # Single-token outputs have no inter-token gap: no sample.
+        class OneTok(Req):
+            output_tokens = [1]
+
+        metrics.observe_request('/generate', OneTok())
+        samples = slo_lib.parse_prometheus_text(metrics.render())
+        hist = slo_lib.histogram_buckets(samples,
+                                         'xsky_serve_tpot_seconds')
+        assert hist['count'] == 1
+
+
+# ---- LB records ------------------------------------------------------------
+
+
+class TestLbRecords:
+
+    def test_lifecycle_record_fields(self):
+        server = _upstream(_EchoUpstream)
+        lb = lb_lib.SkyServeLoadBalancer()
+        lb.set_ready_replicas(
+            [f'127.0.0.1:{server.server_address[1]}'])
+        port = lb.run_in_thread()
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/gen') as resp:
+            assert resp.read() == b'hello'
+        lb.shutdown()
+        server.shutdown()
+        (rec,) = lb.request_log.records()
+        assert rec['outcome'] == 'ok'
+        assert rec['status'] == 200
+        assert rec['replica'].startswith('127.0.0.1:')
+        assert rec['retries'] == 0
+        assert rec['bytes'] == 5 and rec['chunks'] >= 1
+        assert 0 < rec['connect_s'] <= rec['ttft_s'] <= rec['e2e_s']
+        # Rolling stats picked it up.
+        snap = lb.replica_stats.snapshot()[rec['replica']]
+        assert snap['requests_total'] == 1
+        assert snap['error_rate'] == 0.0
+        assert snap['ttft_p99_ms'] > 0
+
+    def test_ring_is_bounded(self, monkeypatch):
+        monkeypatch.setenv('XSKY_LB_RING_SIZE', '4')
+        server = _upstream(_EchoUpstream)
+        lb = lb_lib.SkyServeLoadBalancer()
+        lb.set_ready_replicas(
+            [f'127.0.0.1:{server.server_address[1]}'])
+        port = lb.run_in_thread()
+        for _ in range(10):
+            urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/gen').read()
+        lb.shutdown()
+        server.shutdown()
+        assert len(lb.request_log.records()) == 4
+        # ...but aggregate counters keep the full history.
+        assert lb.request_log.outcomes['ok'] == 10
+
+    def test_no_replica_outcome(self):
+        lb = lb_lib.SkyServeLoadBalancer()
+        port = lb.run_in_thread()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f'http://127.0.0.1:{port}/gen')
+        assert err.value.code == 503
+        lb.shutdown()
+        (rec,) = lb.request_log.records()
+        assert rec['outcome'] == 'no_replica'
+        assert rec['replica'] is None
+
+    def test_truncation_increments_error_counters(self):
+        """A replica dying mid-stream (RST after a partial body) must
+        land as outcome=truncated in the ring, the LB /metrics
+        counters AND the replica's rolling error rate — not only a
+        log line (the PR 6-era behavior)."""
+
+        class Truncating(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):  # noqa: N802
+                self.send_response(200)
+                self.send_header('Content-Length', '1000000')
+                self.end_headers()
+                self.wfile.write(b'partial')
+                self.wfile.flush()
+                # RST (not FIN): SO_LINGER zero-timeout close — the
+                # relay's read1 raises ConnectionResetError, the
+                # deterministic mid-body death.
+                self.connection.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_LINGER,
+                    struct.pack('ii', 1, 0))
+                self.connection.close()
+
+        server = _upstream(Truncating)
+        replica = f'127.0.0.1:{server.server_address[1]}'
+        lb = lb_lib.SkyServeLoadBalancer()
+        lb.set_ready_replicas([replica])
+        port = lb.run_in_thread()
+        import http.client
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/gen', timeout=10) as resp:
+            # The forwarded Content-Length lets the CLIENT see the
+            # truncation too (IncompleteRead), not a clean EOF.
+            try:
+                body = resp.read()
+            except http.client.IncompleteRead as e:
+                body = e.partial
+        assert body == b'partial'
+        lb.shutdown()
+        server.shutdown()
+        (rec,) = lb.request_log.records()
+        assert rec['outcome'] == 'truncated'
+        assert lb.request_log.outcomes == {'truncated': 1}
+        assert ('xsky_lb_requests_total{outcome="truncated"} 1'
+                in lb.request_log.render_metrics(lb.replica_stats))
+        assert lb.replica_stats.snapshot()[replica]['error_rate'] \
+            == 1.0
+
+    def test_garbage_ring_size_env_does_not_kill_lb(self,
+                                                    monkeypatch):
+        monkeypatch.setenv('XSKY_LB_RING_SIZE', '2k')
+        lb = lb_lib.SkyServeLoadBalancer()   # no raise
+        assert lb.request_log._ring.maxlen == 2048
+
+    def test_records_disabled_by_env(self, monkeypatch):
+        monkeypatch.setenv('XSKY_LB_RECORDS', '0')
+        server = _upstream(_EchoUpstream)
+        lb = lb_lib.SkyServeLoadBalancer()
+        assert not lb.records_enabled
+        lb.set_ready_replicas(
+            [f'127.0.0.1:{server.server_address[1]}'])
+        port = lb.run_in_thread()
+        urllib.request.urlopen(f'http://127.0.0.1:{port}/gen').read()
+        lb.shutdown()
+        server.shutdown()
+        assert lb.request_log.records() == []
+        assert lb.replica_stats.snapshot() == {}
+
+    def test_lb_local_endpoints(self):
+        server = _upstream(_EchoUpstream)
+        lb = lb_lib.SkyServeLoadBalancer()
+        lb.set_ready_replicas(
+            [f'127.0.0.1:{server.server_address[1]}'])
+        port = lb.run_in_thread()
+        for _ in range(3):
+            urllib.request.urlopen(f'http://127.0.0.1:{port}/x').read()
+        metrics_text = urllib.request.urlopen(
+            f'http://127.0.0.1:{port}/metrics').read().decode()
+        assert 'xsky_lb_requests_total{outcome="ok"} 3' in metrics_text
+        assert 'xsky_lb_ttft_seconds_bucket' in metrics_text
+        assert 'xsky_lb_replica_ttft_p99_seconds' in metrics_text
+        rows = json.loads(urllib.request.urlopen(
+            f'http://127.0.0.1:{port}/lb/requests').read())
+        assert len(rows) == 3
+        assert all(r['outcome'] == 'ok' for r in rows)
+        # /metrics and /lb/* are the LB's own; they never reach (or
+        # count as) replica traffic.
+        assert lb.request_log.outcomes == {'ok': 3}
+        lb.shutdown()
+        server.shutdown()
+
+    def test_handler_has_socket_timeout(self):
+        """A half-open client must not pin a relay thread forever —
+        the handler needs the same timeout hardening the API server
+        got in PR 6."""
+        lb = lb_lib.SkyServeLoadBalancer()
+        server = lb.make_server('127.0.0.1', 0)
+        assert server.RequestHandlerClass.timeout == 120
+        server.server_close()
+
+
+class TestReplicaStatsTracker:
+
+    def test_rolling_stats_and_prune(self):
+        tracker = lb_policies.ReplicaStatsTracker()
+        tracker.request_started('a:1')
+        assert tracker.inflight_by_replica() == {'a:1': 1}
+        for i in range(10):
+            tracker.observe('a:1', ok=True, ttft_s=0.01 * (i + 1),
+                            e2e_s=0.1)
+        tracker.observe('a:1', ok=False)
+        tracker.request_finished('a:1')
+        snap = tracker.snapshot()['a:1']
+        assert snap['inflight'] == 0
+        assert snap['requests_total'] == 11
+        assert snap['errors_total'] == 1
+        assert snap['error_rate'] == pytest.approx(1 / 11)
+        assert snap['ttft_p50_ms'] == pytest.approx(60.0, rel=0.2)
+        tracker.prune(['b:2'])
+        assert tracker.snapshot() == {}
+
+    def test_policies_expose_stats_attachment(self):
+        lb = lb_lib.SkyServeLoadBalancer(
+            policy=lb_policies.make_policy('least_load'))
+        assert lb.policy.stats is lb.replica_stats
+
+
+# ---- serve_slo table -------------------------------------------------------
+
+
+def _service_row(verdict='ok', burns=None):
+    return {'kind': 'service', 'replica_id': None,
+            'ttft_p99_ms': 40.0, 'requests_total': 10,
+            'errors_total': 0, 'burns': burns or
+            {'300': {'ttft_p99_ms': 0.1}}, 'verdict': verdict}
+
+
+def _replica_row(replica_id, ttft_p99=42.0):
+    return {'kind': 'replica', 'replica_id': replica_id,
+            'endpoint': f'127.0.0.1:{9000 + replica_id}',
+            'ttft_p50_ms': 10.0, 'ttft_p99_ms': ttft_p99,
+            'requests_total': 5, 'errors_total': 0, 'verdict': 'ok'}
+
+
+class TestServeSloTable:
+
+    def test_round_trip_and_latest_only(self, tmp_state):
+        tmp_state.record_serve_slo(
+            'svc', [_replica_row(1), _service_row()])
+        tmp_state.record_serve_slo(
+            'svc', [_replica_row(1, ttft_p99=99.0),
+                    _service_row(verdict='breach')])
+        latest = tmp_state.get_serve_slo(service='svc')
+        assert len(latest) == 2
+        by_kind = {r['kind']: r for r in latest}
+        assert by_kind['replica']['ttft_p99_ms'] == 99.0
+        assert by_kind['service']['verdict'] == 'breach'
+        assert by_kind['service']['burns']['300']['ttft_p99_ms'] \
+            == 0.1
+        history = tmp_state.get_serve_slo(service='svc',
+                                          latest_only=False)
+        assert len(history) == 4
+
+    def test_kind_filter_and_pagination(self, tmp_state):
+        for i in range(5):
+            tmp_state.record_serve_slo(
+                'svc', [_replica_row(1), _service_row()])
+        service_rows = tmp_state.get_serve_slo(
+            service='svc', kind='service', latest_only=False)
+        assert len(service_rows) == 5
+        page = tmp_state.get_serve_slo(service='svc', kind='service',
+                                       latest_only=False, limit=2,
+                                       offset=1)
+        assert len(page) == 2
+        assert page[0]['ts'] == service_rows[1]['ts']
+
+    def test_retention_bound(self, tmp_state, monkeypatch):
+        monkeypatch.setattr(tmp_state, '_MAX_SERVE_SLO', 10)
+        monkeypatch.setattr(tmp_state, '_serve_slo_inserts', 0)
+        # One 30-row batch: the prune runs on the FIRST batch too
+        # (short-lived writers never reach an amortized gate).
+        tmp_state.record_serve_slo(
+            'svc', [_replica_row(i) for i in range(30)])
+        rows = tmp_state.get_serve_slo(service='svc',
+                                       latest_only=False, limit=1000)
+        assert len(rows) == 10
+        # Newest rows survive the prune.
+        assert {r['replica_id'] for r in rows} == set(range(20, 30))
+
+    def test_record_never_raises(self, tmp_state, monkeypatch):
+        monkeypatch.setenv('XSKY_STATE_DB',
+                           '/nonexistent/dir/state.db')
+        tmp_state.reset_for_test()
+        tmp_state.record_serve_slo('svc', [_service_row()])  # no raise
+
+
+# ---- monitor ---------------------------------------------------------------
+
+
+class _MetricsReplica(BaseHTTPRequestHandler):
+    metrics: infer_metrics.ServeMetrics = None
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):  # noqa: N802
+        body = self.metrics.render().encode()
+        self.send_response(200)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class TestSLOMonitor:
+
+    def _ready_replica(self, server, replica_id=1):
+        from skypilot_tpu.serve import state as serve_state
+        return {'replica_id': replica_id,
+                'endpoint': f'127.0.0.1:{server.server_address[1]}',
+                'status': serve_state.ReplicaStatus.READY}
+
+    def test_tick_records_rows_and_journals_transitions(
+            self, tmp_state, monkeypatch):
+        monkeypatch.setenv(slo_lib.ENV_BURN_WINDOWS, '60,300')
+        monkeypatch.setenv(slo_lib.ENV_SCRAPE_INTERVAL, '0')
+        metrics = infer_metrics.ServeMetrics()
+        for _ in range(10):
+            metrics.observe('/gen', 'ok', 8, 16, ttft_s=0.01,
+                            e2e_s=0.1, tpot_s=0.004)
+
+        class Replica(_MetricsReplica):
+            pass
+
+        Replica.metrics = metrics
+        server = _upstream(Replica)
+        now = time.time()
+        records = [{'ts': now - 1, 'outcome': 'ok', 'ttft_s': 0.5,
+                    'e2e_s': 0.6} for _ in range(20)]
+        monitor = slo_lib.SLOMonitor(
+            'svc', SLOSpec(ttft_p99_ms=100, availability=0.99),
+            record_source=lambda: records,
+            inflight_source=lambda: {'r1': 2})
+        result = monitor.maybe_tick([self._ready_replica(server)],
+                                    now=now)
+        assert result is not None
+        # Every record violates the 100ms target → burn 100x on every
+        # window → breach, journalled once with the burns attached.
+        assert result['verdict'] == 'breach'
+        events = tmp_state.get_recovery_events(
+            event_type='serve.slo_breach')
+        assert len(events) == 1
+        assert events[0]['scope'] == 'service/svc'
+        assert 'ttft_p99_ms' in events[0]['detail'][
+            'breached_objectives']
+        rows = tmp_state.get_serve_slo(service='svc')
+        kinds = {r['kind'] for r in rows}
+        assert kinds == {'replica', 'service'}
+        replica_row = [r for r in rows if r['kind'] == 'replica'][0]
+        assert replica_row['ttft_p50_ms'] == pytest.approx(10.0,
+                                                           rel=0.5)
+        # Still breaching: no second breach event (transition, not
+        # level, journals).
+        monitor.maybe_tick([self._ready_replica(server)], now=now + 1)
+        assert len(tmp_state.get_recovery_events(
+            event_type='serve.slo_breach')) == 1
+        # Recovery: fast records → ok verdict → recovered journalled.
+        records[:] = [{'ts': now + 1.5, 'outcome': 'ok',
+                       'ttft_s': 0.01, 'e2e_s': 0.02}
+                      for _ in range(50)]
+        result = monitor.maybe_tick([self._ready_replica(server)],
+                                    now=now + 2)
+        assert result['verdict'] == 'ok'
+        assert len(tmp_state.get_recovery_events(
+            event_type='serve.slo_recovered')) == 1
+        server.shutdown()
+
+    def test_dead_replica_scrape_failed_row(self, tmp_state,
+                                            monkeypatch):
+        from skypilot_tpu.serve import state as serve_state
+        monkeypatch.setenv(slo_lib.ENV_SCRAPE_INTERVAL, '0')
+        monkeypatch.setenv(slo_lib.ENV_SCRAPE_TIMEOUT, '0.2')
+        monitor = slo_lib.SLOMonitor('svc', None)
+        with socket.socket() as sock:
+            sock.bind(('127.0.0.1', 0))
+            dead = f'127.0.0.1:{sock.getsockname()[1]}'
+        monitor.maybe_tick([{
+            'replica_id': 7, 'endpoint': dead,
+            'status': serve_state.ReplicaStatus.READY}])
+        rows = tmp_state.get_serve_slo(service='svc', kind='replica')
+        assert rows and rows[0]['verdict'] == 'scrape_failed'
+        service = tmp_state.get_serve_slo(service='svc',
+                                          kind='service')
+        assert service[0]['verdict'] == 'no_slo'
+
+    def test_breach_state_resets_through_no_data(self, tmp_state,
+                                                 monkeypatch):
+        """breach → no_data (traffic stopped / SLO removed) must close
+        the incident (journal recovered) and re-journal a later
+        re-breach instead of riding the stale True."""
+        monkeypatch.setenv(slo_lib.ENV_SCRAPE_INTERVAL, '0')
+        monkeypatch.setenv(slo_lib.ENV_BURN_WINDOWS, '60')
+        now = time.time()
+        records = [{'ts': now - 1, 'outcome': 'ok', 'ttft_s': 0.5}
+                   for _ in range(20)]
+        monitor = slo_lib.SLOMonitor(
+            'svc', SLOSpec(ttft_p99_ms=100),
+            record_source=lambda: list(records))
+        assert monitor.maybe_tick([], now=now)['verdict'] == 'breach'
+        records.clear()   # traffic stops: every window dataless
+        assert monitor.maybe_tick([], now=now + 1)['verdict'] \
+            == 'no_data'
+        assert len(tmp_state.get_recovery_events(
+            event_type='serve.slo_recovered')) == 1
+        records.extend({'ts': now + 1.5, 'outcome': 'ok',
+                        'ttft_s': 0.5} for _ in range(20))
+        assert monitor.maybe_tick([], now=now + 2)['verdict'] \
+            == 'breach'
+        assert len(tmp_state.get_recovery_events(
+            event_type='serve.slo_breach')) == 2
+
+    def test_client_gone_excluded_from_service_counts(
+            self, tmp_state, monkeypatch):
+        """The service row's requests/errors must reproduce the burn's
+        population (client_gone spends no budget) — otherwise the CLI
+        prints an objective 'met' beside a breaching burn."""
+        monkeypatch.setenv(slo_lib.ENV_SCRAPE_INTERVAL, '0')
+        monkeypatch.setenv(slo_lib.ENV_BURN_WINDOWS, '60')
+        now = time.time()
+        records = ([{'ts': now - 1, 'outcome': 'client_gone'}] * 50 +
+                   [{'ts': now - 1, 'outcome': 'ok',
+                     'ttft_s': 0.01}] * 45 +
+                   [{'ts': now - 1, 'outcome': 'error'}] * 5)
+        monitor = slo_lib.SLOMonitor(
+            'svc', SLOSpec(availability=0.93),
+            record_source=lambda: records)
+        row = monitor.maybe_tick([], now=now)
+        assert row['requests_total'] == 50
+        assert row['errors_total'] == 5
+        # observed availability 45/50 = 0.90 < 0.93 target, and the
+        # burn agrees: 0.10 / 0.07 ≈ 1.43 ⇒ breach. Consistent.
+        assert row['burns']['60']['availability'] == \
+            pytest.approx(1.43, rel=0.01)
+        assert row['verdict'] == 'breach'
+
+    def test_snapshot_caches_pruned_with_replica_churn(
+            self, tmp_state, monkeypatch):
+        monkeypatch.setenv(slo_lib.ENV_SCRAPE_INTERVAL, '0')
+        monitor = slo_lib.SLOMonitor('svc', None)
+        monitor._tpot_prev[99] = 'stale'
+        monitor._tokens_prev[99] = (0.0, 1)
+        monitor.maybe_tick([])   # 99 is not in the ready set
+        assert 99 not in monitor._tpot_prev
+        assert 99 not in monitor._tokens_prev
+
+    def test_interval_rate_limits(self, tmp_state, monkeypatch):
+        monkeypatch.setenv(slo_lib.ENV_SCRAPE_INTERVAL, '3600')
+        monitor = slo_lib.SLOMonitor('svc', None)
+        assert monitor.maybe_tick([]) is not None
+        assert monitor.maybe_tick([]) is None   # inside the interval
+
+    def test_tick_never_raises(self, monkeypatch):
+        monkeypatch.setenv(slo_lib.ENV_SCRAPE_INTERVAL, '0')
+        monkeypatch.setenv('XSKY_STATE_DB', '/nonexistent/state.db')
+        monitor = slo_lib.SLOMonitor('svc', None)
+        monitor.maybe_tick([])  # unreadable DB: logged, not raised
+
+
+# ---- surfaces --------------------------------------------------------------
+
+
+class TestSurfaces:
+
+    def _seed(self, tmp_state, tmp_serve_db):
+        from skypilot_tpu.serve import state as serve_state
+        serve_state.add_service(
+            'svc', {'service': {'slo': {'ttft_p99_ms': 100,
+                                        'availability': 0.99}}},
+            12345)
+        tmp_state.record_serve_slo('svc', [
+            _replica_row(1),
+            {**_service_row(verdict='breach',
+                            burns={'300': {'ttft_p99_ms': 4.0},
+                                   '3600': {'ttft_p99_ms': 2.0}}),
+             'detail': {'breached_objectives': ['ttft_p99_ms']}},
+        ])
+
+    def test_cli_slo_table_and_json(self, tmp_state, tmp_serve_db):
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        self._seed(tmp_state, tmp_serve_db)
+        result = CliRunner().invoke(cli_mod.cli, ['slo'])
+        assert result.exit_code == 0, result.output
+        assert 'verdict=breach' in result.output
+        assert 'ttft_p99_ms' in result.output
+        assert 'BURN RATE' in result.output
+        assert 'REPLICA' in result.output
+        result = CliRunner().invoke(cli_mod.cli,
+                                    ['slo', 'svc', '--json'])
+        assert result.exit_code == 0, result.output
+        report = json.loads(result.output.strip())
+        assert report['verdict'] == 'breach'
+        assert report['slo'] == {'ttft_p99_ms': 100.0,
+                                 'availability': 0.99}
+        assert report['burns']['300']['ttft_p99_ms'] == 4.0
+        assert report['replicas'][0]['replica_id'] == 1
+        result = CliRunner().invoke(cli_mod.cli, ['slo', 'missing'])
+        assert result.exit_code != 0
+
+    def test_serve_status_burn_columns(self, tmp_state, tmp_serve_db):
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        self._seed(tmp_state, tmp_serve_db)
+        result = CliRunner().invoke(cli_mod.cli, ['serve', 'status'])
+        assert result.exit_code == 0, result.output
+        assert 'TTFT_P99' in result.output and 'BURN' in result.output
+        line = [ln for ln in result.output.splitlines()
+                if ln.startswith('svc')][0]
+        assert '40ms' in line and '4.00' in line and 'breach' in line
+        result = CliRunner().invoke(cli_mod.cli,
+                                    ['serve', 'status', '--json'])
+        record = json.loads(result.output.strip())
+        assert record['slo']['verdict'] == 'breach'
+        assert record['slo']['burn_rate'] == 4.0
+
+    def test_metrics_gauges_live_service_filtered(self, tmp_state,
+                                                  tmp_serve_db):
+        from skypilot_tpu.serve import state as serve_state
+        from skypilot_tpu.server import metrics as server_metrics
+        self._seed(tmp_state, tmp_serve_db)
+        text = server_metrics.render()
+        assert ('xsky_serve_slo_burn_rate{service="svc",'
+                'window="300"} 4.0000') in text
+        assert ('xsky_serve_replica_ttft_p99_seconds{service="svc",'
+                'replica="1"} 0.042000') in text
+        # Torn-down service: rows linger in the bounded table but the
+        # gauges must stop exporting (cardinality hygiene).
+        serve_state.remove_service('svc')
+        text = server_metrics.render()
+        assert 'xsky_serve_slo_burn_rate' not in text
+
+    def test_drained_replicas_drop_from_gauges_and_cli(
+            self, tmp_state, tmp_serve_db):
+        """A replica that left the fleet (scale-down, recovery under a
+        new id) keeps its last digest as the latest row for its id —
+        gauges and the `xsky slo` replica table must show only the
+        NEWEST evaluation's replicas."""
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        from skypilot_tpu.server import metrics as server_metrics
+        self._seed(tmp_state, tmp_serve_db)
+        # Second evaluation: replica 1 is gone, replica 2 serves.
+        time.sleep(0.01)
+        tmp_state.record_serve_slo('svc', [
+            _replica_row(2, ttft_p99=55.0), _service_row()])
+        text = server_metrics.render()
+        assert 'replica="2"} 0.055000' in text
+        assert 'replica="1"' not in text
+        result = CliRunner().invoke(cli_mod.cli,
+                                    ['slo', 'svc', '--json'])
+        report = json.loads(result.output.strip())
+        assert [r['replica_id'] for r in report['replicas']] == [2]
+
+
+# ---- tier-1 fake-cloud smoke ----------------------------------------------
+
+
+REPLICA_SCRIPT = '''
+import http.server, os, sys, time, urllib.parse
+sys.path.insert(0, {repo_root!r})
+from skypilot_tpu.infer import metrics as metrics_lib
+metrics = metrics_lib.ServeMetrics()
+
+class H(http.server.BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+    def do_GET(self):
+        if self.path == '/metrics':
+            body = metrics.render().encode()
+        else:
+            body = b'ok'
+            metrics.observe('/gen', 'ok', 8, 16, ttft_s=0.005,
+                            e2e_s=0.01, tpot_s=0.004)
+        self.send_response(200)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+http.server.ThreadingHTTPServer(
+    ('127.0.0.1', int(os.environ['PORT'])), H).serve_forever()
+'''
+
+
+class TestServeSloSmoke:
+    """Tier-1 acceptance: a fake-cloud service with a declared
+    `slo:` whose LB upstream leg is chaos-slowed past the TTFT target
+    trips a journalled, trace-linked `serve.slo_breach`, visible in
+    `xsky slo --json` and as a nonzero burn gauge on /metrics —
+    agent → LB → controller → state → CLI, end to end."""
+
+    def test_chaos_slowed_replica_breaches_end_to_end(
+            self, fake_cluster_env, monkeypatch, tmp_path):
+        del fake_cluster_env
+        import textwrap
+
+        import yaml
+
+        from click.testing import CliRunner
+
+        from skypilot_tpu import state as state_lib
+        from skypilot_tpu import task as task_lib
+        from skypilot_tpu.client import cli as cli_mod
+        from skypilot_tpu.serve import controller as controller_lib
+        from skypilot_tpu.serve import core as serve_core
+        from skypilot_tpu.serve import state as serve_state
+        from skypilot_tpu.server import metrics as server_metrics
+
+        monkeypatch.setenv('XSKY_SERVE_DB',
+                           str(tmp_path / 'serve.db'))
+        monkeypatch.setenv('XSKY_SERVE_LOG_DIR',
+                           str(tmp_path / 'serve_logs'))
+        monkeypatch.setenv('XSKY_SERVE_INTERVAL', '0.5')
+        monkeypatch.setenv(slo_lib.ENV_SCRAPE_INTERVAL, '1')
+        monkeypatch.setenv(slo_lib.ENV_BURN_WINDOWS, '5,30')
+
+        script = tmp_path / 'replica.py'
+        script.write_text(REPLICA_SCRIPT.format(repo_root=REPO_ROOT))
+        config = yaml.safe_load(textwrap.dedent(f'''\
+            name: slosvc
+            resources:
+              accelerators: tpu-v5e-8
+            service:
+              readiness_probe: /
+              replica_policy:
+                min_replicas: 1
+              slo:
+                ttft_p99_ms: 100
+                availability: 0.99
+            run: |
+              python {script}
+        '''))
+        task = task_lib.Task.from_yaml_config(config)
+
+        # The chaos-slowed replica: every proxied request's upstream
+        # leg eats 300ms against a 100ms p99 target.
+        chaos.load_plan(
+            {'points': {'lb.proxy': {'latency_s': 0.3}}})
+
+        with socket.socket() as s:
+            s.bind(('127.0.0.1', 0))
+            lb_port = s.getsockname()[1]
+        serve_state.add_service('slosvc', task.to_yaml_config(),
+                                lb_port)
+        controller = controller_lib.SkyServeController('slosvc')
+        thread = threading.Thread(
+            target=controller.run,
+            name='xsky-test-serve-controller', daemon=True)
+        thread.start()
+        try:
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                record = serve_state.get_service('slosvc')
+                if record['status'] == \
+                        serve_state.ServiceStatus.READY:
+                    break
+                assert record['status'] != \
+                    serve_state.ServiceStatus.FAILED, \
+                    serve_core.controller_logs('slosvc')
+                time.sleep(0.3)
+            else:
+                pytest.fail('service never became READY')
+
+            # Traffic through the chaos-slowed relay.
+            for _ in range(30):
+                urllib.request.urlopen(
+                    f'http://127.0.0.1:{lb_port}/gen',
+                    timeout=30).read()
+
+            breach = None
+            deadline = time.time() + 45
+            while breach is None and time.time() < deadline:
+                events = state_lib.get_recovery_events(
+                    event_type='serve.slo_breach')
+                breach = events[-1] if events else None
+                time.sleep(0.3)
+            assert breach is not None, \
+                'serve.slo_breach never journalled'
+            assert breach['scope'] == 'service/slosvc'
+            assert breach['trace_id'], \
+                'breach event not trace-linked'
+            assert 'ttft_p99_ms' in \
+                breach['detail']['breached_objectives']
+
+            # The burn gauge is live on control-plane /metrics.
+            text = server_metrics.render()
+            burn_lines = [
+                ln for ln in text.splitlines()
+                if ln.startswith('xsky_serve_slo_burn_rate{')]
+            assert burn_lines, text[-2000:]
+            assert any(float(ln.rsplit(' ', 1)[1]) > 0
+                       for ln in burn_lines
+                       if not ln.endswith('+Inf'))
+
+            # And the breach is visible in `xsky slo --json`.
+            result = CliRunner().invoke(
+                cli_mod.cli, ['slo', 'slosvc', '--json'])
+            assert result.exit_code == 0, result.output
+            report = json.loads(
+                result.output.strip().splitlines()[0])
+            assert report['verdict'] == 'breach'
+            assert report['slo']['ttft_p99_ms'] == 100.0
+            assert report['replicas'], \
+                'replica scrape digests missing'
+        finally:
+            controller.stop()
+            thread.join(timeout=60)
+            chaos.clear()
+            try:
+                serve_core.down('slosvc')
+            except Exception:  # pylint: disable=broad-except
+                pass
+        assert not thread.is_alive(), 'controller wedged'
+
+
+class TestBenchServeSloGate:
+    """The serve-SLO plane ships with its bench green: record-keeping
+    under the 2% p50 gate and the chaos-breach drill passing, proven
+    by tools/bench_serve_slo.py --smoke in a clean subprocess (same
+    tier-1 wiring as bench_profile)."""
+
+    def test_bench_serve_slo_smoke_gate(self):
+        env = dict(os.environ)
+        env.pop('XSKY_CHAOS_PLAN', None)
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, 'tools', 'bench_serve_slo.py'),
+             '--smoke'],
+            capture_output=True, text=True, timeout=420, env=env,
+            cwd=REPO_ROOT, check=False)
+        assert proc.returncode == 0, \
+            f'stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-4000:]}'
+        payload = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert payload['pass'] is True
+        assert payload['overhead']['added_p50_pct'] < \
+            payload['overhead']['max_added_pct']
+        assert payload['breach']['journalled_breach'] is True
+        assert payload['breach']['cli_verdict'] == 'breach'

@@ -1,0 +1,403 @@
+#!/usr/bin/env python3
+"""Serving SLO plane benchmark: LB record-keeping overhead gate +
+end-to-end burn-rate breach drill (the PR's two gates).
+
+**Phase A — record-keeping overhead (<2% added p50 proxy latency).**
+The load balancer's per-request lifecycle records sit on the relay's
+critical path; their cost must be invisible next to one real upstream
+round trip. A closed-loop client drives the LB fronting a synthetic
+replica (~4 ms of service time), best-of-3 p50 with records OFF
+(``XSKY_LB_RECORDS=0``, the pre-PR relay) vs ON::
+
+    added_pct = (p50_on - p50_off) / p50_off * 100
+    gate: added_pct < --max-added-pct   (default 2%)
+
+**Phase B — breach drill (chaos-slowed replica → journalled breach).**
+The full fake-cloud serve stack: a service with a declared
+``slo: {ttft_p99_ms, availability}`` comes up through the ordinary
+launch path, an **open-loop** load generator (fixed arrival rate from
+an absolute schedule — queueing delay counts, the coordinated-omission
+guard; heavy-tail Pareto prompt/output lengths) drives the LB while a
+``lb.proxy`` chaos rule injects latency on the upstream leg — the
+slow-replica stand-in. The run exits 0 only if, end to end:
+
+  * a ``serve.slo_breach`` recovery event lands in the journal,
+  * ``xsky_serve_slo_burn_rate`` renders nonzero on control-plane
+    ``/metrics``,
+  * the breach is visible in ``xsky slo <service> --json``.
+
+Prints ONE JSON line; exit 1 on any gate failure. ``--smoke`` is the
+tier-1 subprocess gate (reduced counts, same gates).
+
+Usage:
+    python tools/bench_serve_slo.py [--smoke] [--max-added-pct 2.0]
+                                    [--skip-breach | --skip-overhead]
+"""
+import argparse
+import json
+import os
+import random
+import shutil
+import statistics
+import sys
+import tempfile
+import textwrap
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+# Synthetic replica service time for phase A: the least favorable
+# realistic floor (a fast cached generation step) — production
+# requests are 100 ms+, making the relative overhead smaller.
+_UPSTREAM_SLEEP_S = 0.004
+
+
+class _Upstream(BaseHTTPRequestHandler):
+    _BODY = b'{"text": "x"}'
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):  # noqa: N802
+        time.sleep(_UPSTREAM_SLEEP_S)
+        self.send_response(200)
+        self.send_header('Content-Length', str(len(self._BODY)))
+        self.end_headers()
+        self.wfile.write(self._BODY)
+
+
+def _one_request(port: int) -> float:
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(
+            f'http://127.0.0.1:{port}/gen', timeout=30) as resp:
+        resp.read()
+    return time.perf_counter() - t0
+
+
+def bench_overhead(args) -> dict:
+    """Interleaved A/B: one LB with records OFF, one ON, requests
+    alternating between them in a single loop — scheduler/thermal
+    drift lands on both sides equally, so the p50 delta isolates the
+    record-keeping cost instead of whichever side ran second."""
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    n = 150 if args.smoke else 500
+    server = ThreadingHTTPServer(('127.0.0.1', 0), _Upstream)
+    threading.Thread(target=server.serve_forever,
+                     name='xsky-bench-upstream', daemon=True).start()
+    upstream = f'127.0.0.1:{server.server_address[1]}'
+
+    os.environ['XSKY_LB_RECORDS'] = '0'
+    lb_off = lb_lib.SkyServeLoadBalancer()
+    os.environ['XSKY_LB_RECORDS'] = '1'
+    lb_on = lb_lib.SkyServeLoadBalancer()
+    os.environ.pop('XSKY_LB_RECORDS', None)
+    assert not lb_off.records_enabled and lb_on.records_enabled
+    for lb in (lb_off, lb_on):
+        lb.set_ready_replicas([upstream])
+    port_off = lb_off.run_in_thread()
+    port_on = lb_on.run_in_thread()
+
+    for _ in range(20):   # warm both paths
+        _one_request(port_off)
+        _one_request(port_on)
+
+    # Paired samples, alternating order within each pair: the added
+    # p50 is the MEDIAN OF PAIRED DIFFERENCES — per-request scheduler
+    # jitter (±ms on a loaded box, 100x the record cost) cancels
+    # within a pair instead of landing on whichever side ran when the
+    # box hiccuped. Best-of-3 blocks on top (same pattern as
+    # bench_fanout --trace-overhead): noise only ever inflates the
+    # estimate, so the min block is the honest one.
+    def _block() -> dict:
+        lat_off, lat_on, diffs = [], [], []
+        for i in range(n):
+            if i % 2 == 0:
+                off = _one_request(port_off)
+                on = _one_request(port_on)
+            else:
+                on = _one_request(port_on)
+                off = _one_request(port_off)
+            lat_off.append(off)
+            lat_on.append(on)
+            diffs.append(on - off)
+        p50_off = statistics.median(lat_off)
+        added_p50 = statistics.median(diffs)
+        return {
+            'p50_off_ms': round(p50_off * 1000, 4),
+            'p50_on_ms': round(statistics.median(lat_on) * 1000, 4),
+            'added_p50_ms': round(added_p50 * 1000, 4),
+            'added_p50_pct': round(added_p50 / p50_off * 100.0, 3),
+        }
+
+    blocks = [_block() for _ in range(3)]
+    lb_off.shutdown()
+    lb_on.shutdown()
+    server.shutdown()
+
+    best = min(blocks, key=lambda b: b['added_p50_pct'])
+    return {
+        'requests_per_side_per_block': n,
+        'blocks': blocks,
+        **best,
+        'max_added_pct': args.max_added_pct,
+        'pass': best['added_p50_pct'] < args.max_added_pct,
+    }
+
+
+# ---- phase B: fake-cloud breach drill --------------------------------------
+
+_REPLICA_SCRIPT = textwrap.dedent('''\
+    import http.server, os, sys, time, urllib.parse
+    sys.path.insert(0, {repo_root!r})
+    from skypilot_tpu.infer import metrics as metrics_lib
+    metrics = metrics_lib.ServeMetrics()
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+        def do_GET(self):
+            if self.path == '/metrics':
+                body = metrics.render().encode()
+            else:
+                q = urllib.parse.urlparse(self.path).query
+                params = dict(urllib.parse.parse_qsl(q))
+                gen = int(params.get('g', 16))
+                body = b'x' * min(65536, gen * 4)
+                metrics.observe('/gen', 'ok',
+                                int(params.get('p', 32)), gen,
+                                ttft_s=0.005,
+                                e2e_s=0.005 + gen * 2e-4,
+                                tpot_s=0.004)
+            self.send_response(200)
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    http.server.ThreadingHTTPServer(
+        ('127.0.0.1', int(os.environ['PORT'])), H).serve_forever()
+''')
+
+_SERVICE_YAML = textwrap.dedent('''\
+    name: slobench
+    resources:
+      accelerators: tpu-v5e-8
+    service:
+      readiness_probe: /
+      replica_policy:
+        min_replicas: 1
+      slo:
+        ttft_p99_ms: {ttft_p99_ms}
+        availability: 0.99
+    run: |
+      python {script}
+''')
+
+
+def _open_loop(lb_port: int, rate_qps: float, duration_s: float,
+               rng: random.Random) -> dict:
+    """Open-loop generator: arrivals on an absolute schedule; latency
+    counts from the SCHEDULED arrival (a stalled relay accrues queueing
+    delay instead of silently slowing the offered load)."""
+    n = int(rate_qps * duration_s)
+    t_start = time.perf_counter() + 0.1
+    schedule = [t_start + i / rate_qps for i in range(n)]
+    latencies = []
+    errors = [0]
+    lock = threading.Lock()
+
+    def fire(at: float) -> None:
+        # Heavy-tail lengths (Pareto alpha=1.5: mostly small, a fat
+        # tail of long generations).
+        gen = int(min(2000, rng.paretovariate(1.5) * 16))
+        prompt = int(min(4000, rng.paretovariate(1.2) * 64))
+        try:
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{lb_port}/gen?p={prompt}'
+                    f'&g={gen}', timeout=30) as resp:
+                resp.read()
+            lat = time.perf_counter() - at
+            with lock:
+                latencies.append(lat)
+        except Exception:  # pylint: disable=broad-except
+            with lock:
+                errors[0] += 1
+
+    threads = []
+    for at in schedule:
+        delay = at - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        thread = threading.Thread(target=fire, args=(at,),
+                                  name='xsky-bench-loadgen',
+                                  daemon=True)
+        thread.start()
+        threads.append(thread)
+    for thread in threads:
+        thread.join(timeout=60)
+    latencies.sort()
+
+    def pctl(q: float):
+        if not latencies:
+            return None
+        return round(
+            latencies[min(len(latencies) - 1,
+                          int(q * len(latencies)))] * 1000, 2)
+
+    return {'offered': n, 'completed': len(latencies),
+            'errors': errors[0], 'p50_ms': pctl(0.5),
+            'p99_ms': pctl(0.99)}
+
+
+def bench_breach(args) -> dict:
+    scratch = tempfile.mkdtemp(prefix='xsky-bench-slo-')
+    os.environ['XSKY_STATE_DB'] = os.path.join(scratch, 'state.db')
+    os.environ['XSKY_SERVE_DB'] = os.path.join(scratch, 'serve.db')
+    os.environ['XSKY_FAKE_CLOUD_DIR'] = os.path.join(scratch, 'fake')
+    os.environ['XSKY_SERVE_LOG_DIR'] = os.path.join(scratch, 'logs')
+    os.environ['XSKY_ENABLE_FAKE_CLOUD'] = '1'
+    os.environ['XSKY_SERVE_INTERVAL'] = '0.5'
+    os.environ['XSKY_SLO_SCRAPE_INTERVAL_S'] = '1'
+    os.environ['XSKY_SLO_BURN_WINDOWS'] = '5,30'
+
+    from click.testing import CliRunner
+
+    from skypilot_tpu import check as check_lib
+    from skypilot_tpu import state
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.client import cli as cli_mod
+    from skypilot_tpu.serve import controller as controller_lib
+    from skypilot_tpu.serve import core as serve_core
+    from skypilot_tpu.serve import state as serve_state
+    from skypilot_tpu.server import metrics as server_metrics
+    from skypilot_tpu.utils import chaos
+
+    check_lib.set_enabled_clouds_for_test(['fake'])
+    state.reset_for_test()
+
+    ttft_target_ms = 100.0
+    # The chaos-slowed replica: every upstream leg of the relay eats
+    # 250 ms, pushing relay-observed TTFT far past the 100 ms target
+    # → burn = 1.0 / 0.01 = 100x on every window.
+    chaos.load_plan({'points': {'lb.proxy': {'latency_s': 0.25}}})
+
+    script = os.path.join(scratch, 'replica.py')
+    with open(script, 'w', encoding='utf-8') as f:
+        f.write(_REPLICA_SCRIPT.format(repo_root=_REPO_ROOT))
+    import io
+
+    import yaml
+    config = yaml.safe_load(io.StringIO(_SERVICE_YAML.format(
+        ttft_p99_ms=ttft_target_ms, script=script)))
+    task = task_lib.Task.from_yaml_config(config)
+
+    name = 'slobench'
+    import socket
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        lb_port = s.getsockname()[1]
+    serve_state.add_service(name, task.to_yaml_config(), lb_port)
+    controller = controller_lib.SkyServeController(name)
+    thread = threading.Thread(target=controller.run,
+                              name='xsky-bench-serve-controller',
+                              daemon=True)
+    thread.start()
+
+    result: dict = {'service': name}
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            record = serve_state.get_service(name)
+            if record['status'] == serve_state.ServiceStatus.READY:
+                break
+            if record['status'] == serve_state.ServiceStatus.FAILED:
+                result['error'] = 'service FAILED during bring-up'
+                result['pass'] = False
+                return result
+            time.sleep(0.3)
+        else:
+            result['error'] = 'service never became READY'
+            result['pass'] = False
+            return result
+
+        rate = 15.0 if args.smoke else 40.0
+        duration = 6.0 if args.smoke else 15.0
+        rng = random.Random(7)
+        result['loadgen'] = _open_loop(lb_port, rate, duration, rng)
+
+        # The breach must surface end to end: journal, /metrics, CLI.
+        breach_deadline = time.time() + 45
+        events = []
+        while time.time() < breach_deadline:
+            events = state.get_recovery_events(
+                event_type='serve.slo_breach')
+            if events:
+                break
+            time.sleep(0.5)
+        result['journalled_breach'] = bool(events)
+        result['breach_trace_linked'] = bool(
+            events and events[-1].get('trace_id'))
+
+        metrics_text = server_metrics.render()
+        burn_value = None
+        for line in metrics_text.splitlines():
+            if line.startswith('xsky_serve_slo_burn_rate{'):
+                raw = line.rsplit(' ', 1)[1]
+                value = float('inf') if raw == '+Inf' else float(raw)
+                if burn_value is None or value > burn_value:
+                    burn_value = value
+        result['burn_gauge'] = ('inf' if burn_value == float('inf')
+                                else burn_value)
+
+        cli = CliRunner().invoke(cli_mod.cli, ['slo', name, '--json'])
+        cli_verdict = None
+        if cli.exit_code == 0 and cli.output.strip():
+            cli_verdict = json.loads(
+                cli.output.strip().splitlines()[0]).get('verdict')
+        result['cli_verdict'] = cli_verdict
+
+        result['pass'] = (
+            result['journalled_breach'] and
+            burn_value is not None and burn_value > 0 and
+            cli_verdict == 'breach')
+        return result
+    finally:
+        controller.stop()
+        thread.join(timeout=30)
+        chaos.clear()
+        try:
+            serve_core.down(name)
+        except Exception:  # pylint: disable=broad-except
+            pass
+        check_lib.set_enabled_clouds_for_test(None)
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--smoke', action='store_true',
+                        help='Reduced counts for the tier-1 '
+                             'subprocess gate (same gates).')
+    parser.add_argument('--max-added-pct', type=float, default=2.0)
+    parser.add_argument('--skip-overhead', action='store_true')
+    parser.add_argument('--skip-breach', action='store_true')
+    args = parser.parse_args()
+
+    out = {'metric': 'serve_slo_plane', 'smoke': args.smoke}
+    ok = True
+    if not args.skip_overhead:
+        out['overhead'] = bench_overhead(args)
+        ok = ok and out['overhead']['pass']
+    if not args.skip_breach:
+        out['breach'] = bench_breach(args)
+        ok = ok and out['breach']['pass']
+    out['pass'] = ok
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

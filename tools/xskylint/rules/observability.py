@@ -81,13 +81,17 @@ class SpanFailoverRule(engine.Rule):
 
 class SpanProfilerRule(engine.Rule):
     """Every profiler capture/pull site (``capture_device_profile``,
-    ``record_profiles``) must run under a tracing span: a deep capture
-    fans a device probe out to every host, and profile recording rides
-    the telemetry pull whose latency ``xsky trace`` attributes."""
+    ``record_profiles``) and serving-SLO scrape/record site
+    (``scrape_replica_metrics``, ``record_serve_slo``) must run under
+    a tracing span: a deep capture fans a device probe out to every
+    host, profile recording rides the telemetry pull whose latency
+    ``xsky trace`` attributes, and an SLO scrape is an HTTP round
+    trip to every ready replica whose slowness must be attributable
+    (and whose journalled breach must cross-link to a trace)."""
 
     id = 'span-profiler'
-    rationale = ('profiler capture/pull site outside a tracing span — '
-                 'the capture/pull must land on the trace')
+    rationale = ('profiler/SLO capture, scrape or record site outside '
+                 'a tracing span — the pull must land on the trace')
 
     SKIPPED_FILES = frozenset({
         # The plane's own definition site (record_profiles delegates
@@ -95,7 +99,9 @@ class SpanProfilerRule(engine.Rule):
         'skypilot_tpu/agent/profiler.py',
     })
     PROFILER_SITES = frozenset({'capture_device_profile',
-                                'record_profiles'})
+                                'record_profiles',
+                                'scrape_replica_metrics',
+                                'record_serve_slo'})
 
     def applies_to(self, rel_path: str) -> bool:
         return rel_path.startswith('skypilot_tpu/') and \
@@ -130,9 +136,11 @@ class RetentionBoundRule(engine.Rule):
         'spans': '_MAX_SPANS',
         'workload_telemetry': '_MAX_WORKLOAD_TELEMETRY',
         'profiles': '_MAX_PROFILES',
+        'serve_slo': '_MAX_SERVE_SLO',
     }
     # CREATE TABLE names matching this are observability tables.
-    OBSERVABILITY_RE = re.compile(r'events|spans|telemetry|profiles')
+    OBSERVABILITY_RE = re.compile(
+        r'events|spans|telemetry|profiles|slo')
     CREATE_RE = re.compile(r'CREATE TABLE IF NOT EXISTS (\w+)')
 
     def applies_to(self, rel_path: str) -> bool:
