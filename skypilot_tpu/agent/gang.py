@@ -45,13 +45,23 @@ class HostSpec:
 
 def build_host_envs(
         cluster_info: provision_common.ClusterInfo,
-        job_envs: Optional[Dict[str, str]] = None) -> List[Dict[str, str]]:
+        job_envs: Optional[Dict[str, str]] = None,
+        exclude_hosts: Optional[Sequence[int]] = None
+        ) -> List[Dict[str, str]]:
     """Per-host environment for gang launch, in rank order.
 
     Derives node ranks, host ranks, and the JAX/libtpu coordinator wiring
-    from the host inventory alone.
+    from the host inventory alone. ``exclude_hosts`` (elastic shrink:
+    positions in the sorted-host order) drops those hosts and renumbers
+    ranks contiguously over the survivors — the gang comes up as a
+    smaller world (new coordinator = surviving host 0), which is
+    exactly the reconfiguration ``jax.distributed`` needs to remesh
+    over the surviving ranks.
     """
     hosts = cluster_info.sorted_instances()
+    if exclude_hosts:
+        dropped = set(int(r) for r in exclude_hosts)
+        hosts = [h for i, h in enumerate(hosts) if i not in dropped]
     num_hosts = len(hosts)
 
     # Logical nodes (for XSKY_NODE_RANK): group by node_index tag.
@@ -92,8 +102,12 @@ def build_host_envs(
         })
         if h.slice_id is not None:
             peers = slice_hosts[h.slice_id]
+            # Worker id = position among SURVIVING slice peers, not the
+            # provision-time host_index: after an elastic shrink the
+            # hostnames list below only names survivors, and libtpu
+            # requires worker ids to index into it contiguously.
             env.update({
-                'TPU_WORKER_ID': str(h.host_index),
+                'TPU_WORKER_ID': str(peers.index(h)),
                 'TPU_WORKER_HOSTNAMES': ','.join(
                     p.internal_ip for p in peers),
             })

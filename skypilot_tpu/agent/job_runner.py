@@ -80,10 +80,17 @@ def run_job(job_id: int, root: str = None) -> int:
     spec = job['spec']
     info = _load_cluster_info(root)
     runners = _build_runners(info)
+    # Elastic shrink: the spec may exclude dead/hung hosts — the gang
+    # launches over the survivors only, ranks renumbered contiguously
+    # (runner order matches build_host_envs' sorted-host order).
+    exclude = set(int(r) for r in spec.get('exclude_hosts') or ())
+    if exclude:
+        runners = [r for i, r in enumerate(runners) if i not in exclude]
     log_dir = job_lib.log_dir_for(job_id, root)
 
     try:
-        host_envs = gang.build_host_envs(info, spec.get('envs') or {})
+        host_envs = gang.build_host_envs(info, spec.get('envs') or {},
+                                         exclude_hosts=exclude)
         for rank, env in enumerate(host_envs):
             env['XSKY_JOB_ID'] = str(job_id)
             # Per-rank telemetry spool on the rank's OWN host: the

@@ -305,9 +305,15 @@ def emit(phase: Optional[str] = None, step: Optional[int] = None,
         try:
             from skypilot_tpu.utils import chaos
             # A fired rule freezes this rank's PROGRESS (the heartbeat
-            # thread keeps beating): the hung-rank drill.
+            # thread keeps beating): the hung-rank drill. The elastic
+            # generation rides the context so a chaos plan can stall
+            # one incarnation without re-stalling the shrunk/regrown
+            # gang (match: {"rank": N, "generation": "0"}).
             if chaos.inject('telemetry.stall',
-                            rank=emitter.rank) is not None:
+                            rank=emitter.rank,
+                            generation=os.environ.get(
+                                'XSKY_ELASTIC_GENERATION', '0')
+                            ) is not None:
                 return
         except Exception:  # pylint: disable=broad-except
             # Even a rule configured with `error` must only freeze the

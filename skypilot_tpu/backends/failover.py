@@ -118,19 +118,40 @@ class RetryingProvisioner:
 
     # ---- internals ----
 
-    def _record_failure(self, e: Exception, block_scope: str) -> None:
-        """Bounded history append + one journal row per failed attempt."""
+    def _record_failure(self, e: Exception, block_scope: str,
+                        resources: Optional[
+                            resources_lib.Resources] = None,
+                        region: Optional[str] = None,
+                        zone: Optional[str] = None) -> None:
+        """Bounded history append + one journal row per failed attempt.
+
+        The row carries structured ``(cloud, region, zone, sku)`` keys
+        (not just prose) so the fleet placement scorer
+        (jobs/fleet.pressure_map) can count the failure against where
+        it happened; scorer reads stay backfill-tolerant, so rows that
+        predate the keys simply score nothing.
+        """
         if self._first_failure_ts is None:
             self._first_failure_ts = time.time()
         self.total_failures += 1
         self.failover_history.append(e)
         if len(self.failover_history) > _MAX_FAILOVER_HISTORY:
             del self.failover_history[:-_MAX_FAILOVER_HISTORY]
+        keys = {}
+        if resources is not None:
+            from skypilot_tpu.jobs import fleet
+            keys = {k: v for k, v in fleet.placement_key(
+                resources).items() if v}
+            if region is not None:
+                keys['region'] = region
+            if zone is not None:
+                keys['zone'] = zone
         state_lib.record_recovery_event(
             'failover.blocked',
             scope=f'cluster/{self._cluster_name}',
             cause=type(e).__name__,
-            detail={'block_scope': block_scope, 'error': str(e)[:500]})
+            detail={'block_scope': block_scope, 'error': str(e)[:500],
+                    **keys})
         metrics.inc_counter('xsky_failover_attempts_total',
                             'Failed provisioning attempts by cause.',
                             1.0, cause=type(e).__name__)
@@ -266,24 +287,32 @@ class RetryingProvisioner:
                 return ProvisionResult(concrete, record, info,
                                        self._num_nodes)
             except exceptions.InvalidRequestError as e:
-                self._record_failure(e, block_scope='none (no failover)')
+                self._record_failure(e, block_scope='none (no failover)',
+                                     resources=resources,
+                                     region=region, zone=zone)
                 raise exceptions.ResourcesUnavailableError(
                     f'Invalid request for {resources}: {e}',
                     no_failover=True,
                     failover_history=self.failover_history) from e
             except (exceptions.CapacityError,
                     exceptions.QueuedResourceTimeoutError) as e:
-                self._record_failure(e, block_scope=f'zone:{zone}')
+                self._record_failure(e, block_scope=f'zone:{zone}',
+                                     resources=resources,
+                                     region=region, zone=zone)
                 logger.info(f'  Capacity error in {zone}: {e}')
                 sp.set(outcome=type(e).__name__)
                 self._block(resources, zone=zone, region=None)
             except exceptions.QuotaExceededError as e:
-                self._record_failure(e, block_scope=f'region:{region}')
+                self._record_failure(e, block_scope=f'region:{region}',
+                                     resources=resources,
+                                     region=region, zone=zone)
                 logger.info(f'  Quota exceeded in {region}: {e}')
                 sp.set(outcome=type(e).__name__)
                 self._block(resources, zone=None, region=region)
             except exceptions.PermissionError_ as e:
-                self._record_failure(e, block_scope=f'cloud:{cloud}')
+                self._record_failure(e, block_scope=f'cloud:{cloud}',
+                                     resources=resources,
+                                     region=region, zone=zone)
                 logger.info(f'  Permission error on {cloud}: {e}')
                 sp.set(outcome=type(e).__name__)
                 self._block(resources, zone=None, region=None,
@@ -291,7 +320,9 @@ class RetryingProvisioner:
             except exceptions.ProvisionError as e:
                 # Unclassified provisioning failure: treat as
                 # capacity-scoped.
-                self._record_failure(e, block_scope=f'zone:{zone}')
+                self._record_failure(e, block_scope=f'zone:{zone}',
+                                     resources=resources,
+                                     region=region, zone=zone)
                 sp.set(outcome=type(e).__name__)
                 self._block(resources, zone=zone, region=None)
             return None

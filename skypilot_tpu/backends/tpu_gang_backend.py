@@ -492,12 +492,10 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                 _setup, list(enumerate(runners)),
                 phase='setup', what='task setup')
 
-    def execute(self, handle: ClusterHandle, task: 'task_lib.Task',
-                detach_run: bool = False,
-                dryrun: bool = False,
-                stream_logs: bool = True) -> Optional[int]:
-        if dryrun:
-            return None
+    def _job_spec(self, handle: ClusterHandle, task: 'task_lib.Task'
+                  ) -> Dict[str, Any]:
+        """The agent-side job spec for one task (shared by execute and
+        the elastic resubmit path)."""
         run_cmd = task.run
         if callable(run_cmd):
             # Command generators get (node_rank, node_ips); materialize
@@ -506,7 +504,7 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             cmds = {r: run_cmd(r, ips) for r in range(task.num_nodes)}
             run_cmd = _dispatch_script(cmds)
         from skypilot_tpu.utils import docker_utils
-        spec = {
+        return {
             'run': run_cmd,
             'envs': task.envs_and_secrets,
             'num_nodes': task.num_nodes,
@@ -518,12 +516,53 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                                  if self._docker_image(handle) is not None
                                  else None),
         }
+
+    def execute(self, handle: ClusterHandle, task: 'task_lib.Task',
+                detach_run: bool = False,
+                dryrun: bool = False,
+                stream_logs: bool = True) -> Optional[int]:
+        if dryrun:
+            return None
+        spec = self._job_spec(handle, task)
         with tracing.span('backend.submit',
                           cluster=handle.cluster_name):
             job_id = self._submit_job(handle, task.name, spec)
         state.update_last_use(handle.cluster_name)
         if not detach_run:
             self._wait_job(handle, job_id, stream_logs=stream_logs)
+        return job_id
+
+    def resubmit_gang(self, handle: ClusterHandle, task: 'task_lib.Task',
+                      excluded_ranks: Optional[List[int]] = None,
+                      cancel_job_id: Optional[int] = None,
+                      extra_env: Optional[Dict[str, str]] = None) -> int:
+        """Elastic shrink / grow-back: cancel the running cluster job
+        and resubmit the task's run over the cluster's hosts MINUS
+        ``excluded_ranks`` (empty = the full gang again). No
+        reprovisioning — the cluster stays up; the agent-side gang
+        launcher renumbers ranks contiguously over the survivors, so
+        the workload's ``jax.distributed`` world comes up at the new
+        size. Returns the new cluster job id.
+        """
+        if callable(task.run):
+            # Per-node command generators bake the original node ranks
+            # into a dispatch script; renumbered survivors would run
+            # the wrong commands. Callers fall back to full relaunch.
+            raise exceptions.NotSupportedError(
+                'elastic resubmit requires a string run command')
+        spec = self._job_spec(handle, task)
+        excluded = sorted(set(int(r) for r in (excluded_ranks or ())))
+        if excluded:
+            spec['exclude_hosts'] = excluded
+        if extra_env:
+            spec['envs'] = {**(spec.get('envs') or {}), **extra_env}
+        with tracing.span('backend.resubmit',
+                          cluster=handle.cluster_name,
+                          excluded=','.join(str(r) for r in excluded)):
+            if cancel_job_id is not None:
+                self.cancel_jobs(handle, [cancel_job_id])
+            job_id = self._submit_job(handle, task.name, spec)
+        state.update_last_use(handle.cluster_name)
         return job_id
 
     def _submit_job(self, handle: ClusterHandle, name: Optional[str],
@@ -614,22 +653,33 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
         fan-out: {rank: sample}. Ranks with no spool yet (job not
         started, pre-telemetry workload) are simply absent; a partial
         fan-out failure costs the missing ranks, not the pull.
+
+        Each host is read by GLOB, not by its fan-out index: after an
+        elastic shrink the gang renumbers ranks contiguously over the
+        surviving hosts, so host i may hold any rank's spool — the
+        sample's own ``rank`` field keys the result.
         """
         from skypilot_tpu.agent import telemetry
         runners = handle.get_command_runners()
         samples: Dict[int, Dict[str, Any]] = {}
 
         def _pull(pair):
-            rank, runner = pair
-            path = telemetry.spool_path(runner.remote_runtime_root(),
-                                        job_id, rank)
-            rc, out, _ = runner.run(f'cat {path} 2>/dev/null',
-                                    require_outputs=True)
-            if rc == 0 and out.strip():
-                sample = telemetry.parse_sample(
-                    out.strip().splitlines()[-1])
-                if sample is not None:
-                    samples[rank] = sample
+            _, runner = pair
+            spool = telemetry.spool_dir(runner.remote_runtime_root(),
+                                        job_id)
+            # One-line JSON per file, no trailing newline — printf
+            # separates them so concatenated spools stay parseable.
+            rc, out, _ = runner.run(
+                f'for f in {spool}/rank-*.json; do '
+                'cat "$f" 2>/dev/null; printf "\\n"; done',
+                require_outputs=True)
+            if rc != 0 or not out.strip():
+                return
+            for line in out.strip().splitlines():
+                sample = telemetry.parse_sample(line.strip())
+                if sample is not None and \
+                        isinstance(sample.get('rank'), int):
+                    samples[sample['rank']] = sample
 
         try:
             with tracing.span('backend.pull_telemetry',

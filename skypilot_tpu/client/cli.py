@@ -459,6 +459,99 @@ def events(scope, event_type, limit, since, as_json):
                               (r.get('trace_id') or '-')[:16]))
 
 
+@cli.command(name='fleet')
+@click.option('--decisions', '-n', 'decision_limit', type=int,
+              default=10,
+              help='Recent fleet decisions to show (admissions, '
+                   'elastic shrinks/grow-backs).')
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='One JSON object (queue, shares, pressure, '
+                   'decisions).')
+def fleet_cmd(decision_limit, as_json):
+    """Fleet scheduler state: fair-share queue, placement pressure,
+    recent decisions.
+
+    The queue section shows the scheduler's schedule-state depths and
+    each workspace's fair-share position (weight from
+    XSKY_FLEET_SHARES, running = controllers holding capacity, waiting
+    = queued). The pressure section is the shared placement scorer's
+    current view — recency-decayed preemption/capacity pressure per
+    journalled (cloud, region, zone, sku); entries at or above the
+    block threshold are avoided by job launches, serve spot placement,
+    and elastic grow-back probes alike. Decisions come from the
+    bounded fleet_decisions table.
+    """
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu.jobs import fleet as fleet_lib
+    from skypilot_tpu.jobs import state as jobs_state
+    counts = {s.value.lower(): n for s, n in
+              jobs_state.schedule_state_counts().items()}
+    shares = fleet_lib.workspace_shares()
+    running = jobs_state.active_counts_by_workspace()
+    waiting_rows = jobs_state.get_waiting_jobs()
+    waiting: dict = {}
+    for row in waiting_rows:
+        waiting[row['workspace']] = waiting.get(row['workspace'], 0) + 1
+    workspaces = sorted(set(shares) | set(running) | set(waiting))
+    pressure = fleet_lib.pressure_map()
+    hot = [{**keys, 'pressure': round(pressure.at(**keys), 4)}
+           for keys in pressure.keys_over(0.0)[:20]]
+    decisions = state_lib.get_fleet_decisions(limit=decision_limit)
+    if as_json:
+        click.echo(json.dumps({
+            'queue': counts,
+            'workspaces': [{
+                'workspace': ws,
+                'weight': shares.get(ws, 1.0),
+                'running': running.get(ws, 0),
+                'waiting': waiting.get(ws, 0),
+            } for ws in workspaces],
+            'pressure': hot,
+            'block_threshold': fleet_lib.block_threshold(),
+            'decisions': decisions,
+        }, default=str))
+        return
+    click.echo('Queue: ' + '  '.join(
+        f'{name}={counts.get(name, 0)}'
+        for name in ('waiting', 'launching', 'alive', 'done')))
+    if workspaces:
+        fmt = '{:<16} {:>7} {:>8} {:>8}'
+        click.echo(fmt.format('WORKSPACE', 'WEIGHT', 'RUNNING',
+                              'WAITING'))
+        for ws in workspaces:
+            click.echo(fmt.format(ws[:16], f'{shares.get(ws, 1.0):g}',
+                                  running.get(ws, 0),
+                                  waiting.get(ws, 0)))
+    if hot:
+        click.echo(f'\nPlacement pressure (decayed; blocked at '
+                   f'>= {fleet_lib.block_threshold():g}):')
+        fmt = '{:<10} {:<14} {:<18} {:<14} {:>9}'
+        click.echo(fmt.format('CLOUD', 'REGION', 'ZONE', 'SKU',
+                              'PRESSURE'))
+        for row in hot:
+            click.echo(fmt.format(
+                (row.get('cloud') or '-')[:10],
+                (row.get('region') or '-')[:14],
+                (row.get('zone') or '-')[:18],
+                (row.get('sku') or '-')[:14],
+                f"{row['pressure']:.3f}"))
+    if decisions:
+        import datetime
+        click.echo('\nRecent decisions:')
+        fmt = '{:<19} {:<7} {:<6} {:<12} {:<18} {:>7}'
+        click.echo(fmt.format('TIME', 'KIND', 'JOB', 'WORKSPACE',
+                              'ZONE', 'SCORE'))
+        for d in decisions:
+            ts = datetime.datetime.fromtimestamp(
+                d['ts']).strftime('%Y-%m-%d %H:%M:%S')
+            click.echo(fmt.format(
+                ts, (d['kind'] or '-')[:7],
+                str(d['job_id']) if d['job_id'] is not None else '-',
+                (d['workspace'] or '-')[:12],
+                (d['zone'] or '-')[:18],
+                f"{d['score']:.2f}" if d['score'] is not None else '-'))
+
+
 def _trace_children(spans):
     """span_id → [child spans] (children ordered by start time), plus
     the roots/orphans list. An orphan (parent recorded but missing —
@@ -1456,9 +1549,13 @@ def jobs():
 @jobs.command(name='launch')
 @click.argument('entrypoint')
 @_apply(_task_options)
+@click.option('--priority', type=int, default=0,
+              help='Fleet-scheduler admission priority (higher '
+                   'schedules first; weighted fair-share across '
+                   'workspaces and queue-age aging apply on top).')
 @click.option('--yes', '-y', is_flag=True, default=False)
 def jobs_launch(entrypoint, envs, env_file, secrets, name, num_nodes,
-                accelerators, cloud, use_spot, yes):
+                accelerators, cloud, use_spot, priority, yes):
     """Launch a managed job (controller recovers preemptions).
 
     A `---`-separated multi-document YAML is a PIPELINE: tasks run as
@@ -1479,7 +1576,8 @@ def jobs_launch(entrypoint, envs, env_file, secrets, name, num_nodes,
                     'Resource flags (--num-nodes/--accelerators/'
                     '--cloud/--use-spot) are not supported for '
                     'pipelines; set resources per task in the YAML.')
-            job_id = sdk.jobs_launch(tasks, name=name or chain_name)
+            job_id = sdk.jobs_launch(tasks, name=name or chain_name,
+                                     priority=priority)
             click.echo(f'Managed pipeline {job_id} submitted '
                        f'({len(tasks)} tasks).')
             return
@@ -1492,7 +1590,7 @@ def jobs_launch(entrypoint, envs, env_file, secrets, name, num_nodes,
         t = _load_task(entrypoint, envs, secrets, name, num_nodes,
                        accelerators, cloud, use_spot,
                        env_file=env_file)
-    job_id = sdk.jobs_launch(t)
+    job_id = sdk.jobs_launch(t, priority=priority)
     click.echo(f'Managed job {job_id} submitted.')
 
 
@@ -1529,13 +1627,20 @@ def jobs_dashboard():
 
 @jobs.command(name='queue')
 def jobs_queue():
+    """The managed-job queue: status plus the fleet scheduler's view
+    (PRIO = admission priority, SCHED = schedule state, GANG =
+    survivors/full while elastically shrunk)."""
     from skypilot_tpu.client import sdk
     rows = sdk.jobs_queue()
-    fmt = '{:<6} {:<16} {:<7} {:<14} {:<8}'
-    click.echo(fmt.format('ID', 'NAME', 'TASK', 'STATUS', 'RECOVERIES'))
+    fmt = '{:<6} {:<16} {:<7} {:<14} {:>5} {:<10} {:<6} {:<8}'
+    click.echo(fmt.format('ID', 'NAME', 'TASK', 'STATUS', 'PRIO',
+                          'SCHED', 'GANG', 'RECOVERIES'))
     for r in rows:
         click.echo(fmt.format(r['job_id'], str(r['name'])[:16],
                               r.get('task') or '-', r['status'],
+                              r.get('priority') or 0,
+                              (r.get('schedule_state') or '-')[:10],
+                              r.get('gang') or '-',
                               r.get('recovery_count', 0)))
 
 

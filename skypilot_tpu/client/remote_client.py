@@ -232,11 +232,12 @@ class RemoteClient:
 
     # ---- managed jobs ----
 
-    def jobs_launch(self, task, name=None):
+    def jobs_launch(self, task, name=None, priority=0):
         from skypilot_tpu import task as task_lib
         result = self._call(
             'jobs.launch',
-            {'task': task_lib.Task.chain_to_config(task), 'name': name})
+            {'task': task_lib.Task.chain_to_config(task), 'name': name,
+             'priority': int(priority)})
         return result['job_id']
 
     def jobs_queue(self):

@@ -264,13 +264,15 @@ def storage_delete(storage_name: str) -> None:
 # ---- managed jobs ----------------------------------------------------------
 
 
-def jobs_launch(task, name: Optional[str] = None) -> int:
-    """task: one Task, or a sequence of Tasks (pipeline chain)."""
+def jobs_launch(task, name: Optional[str] = None,
+                priority: int = 0) -> int:
+    """task: one Task, or a sequence of Tasks (pipeline chain).
+    ``priority``: fleet-scheduler admission priority (higher first)."""
     remote = _remote()
     if remote is not None:
-        return remote.jobs_launch(task, name=name)
+        return remote.jobs_launch(task, name=name, priority=priority)
     from skypilot_tpu.jobs import core as jobs_core
-    return jobs_core.launch(task, name=name)
+    return jobs_core.launch(task, name=name, priority=priority)
 
 
 def jobs_queue() -> List[Dict[str, Any]]:

@@ -12,7 +12,7 @@ from __future__ import annotations
 import os
 import sys
 import time
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
@@ -20,6 +20,7 @@ from skypilot_tpu import state as global_state
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.agent import job_lib as cluster_job_lib
 from skypilot_tpu.agent import telemetry
+from skypilot_tpu.jobs import fleet
 from skypilot_tpu.jobs import recovery as recovery_lib
 from skypilot_tpu.jobs import scheduler
 from skypilot_tpu.jobs import state as jobs_state
@@ -59,6 +60,13 @@ class JobsController:
         # Workload-telemetry pull schedule (rate-limited: one host
         # fan-out per pull interval inside the monitor loop).
         self._telemetry_next = 0.0
+        # Elastic gang state (fleet.ElasticGang): restored across
+        # controller respawns via the job record's gang_detail, reset
+        # whenever a launch rebuilds the full gang. The generation
+        # counter rides every (re)submit as XSKY_ELASTIC_GENERATION so
+        # workloads and chaos plans can key on the incarnation.
+        self._elastic = fleet.ElasticGang.from_detail(
+            record.get('gang_detail'), full_hosts=1)
 
     def _heartbeat(self) -> None:
         """Renew this job's liveness lease (reconciler crash-safety:
@@ -177,6 +185,175 @@ class JobsController:
                     self.job_id, jobs_state.ManagedJobStatus.RUNNING)
         return handle, cluster_job_id
 
+    # ---- elastic gang (fleet.py policy, journalled side effects) ----
+
+    @staticmethod
+    def _gang_size(handle: Any) -> int:
+        try:
+            return max(1, handle.cluster_info.num_instances)
+        except Exception:  # pylint: disable=broad-except
+            return 1
+
+    def _persist_gang_state(self) -> None:
+        """Gang state survives controller respawns via the job record
+        (never raises: bookkeeping must not kill the monitor loop)."""
+        try:
+            jobs_state.set_gang_state(self.job_id, self._elastic.state,
+                                      self._elastic.to_detail())
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+    def _placement_key(self) -> Dict[str, Any]:
+        """Structured (cloud, region, zone, sku) of the task's last
+        successful placement — journal detail for the fleet scorer."""
+        launched = self.strategy.last_launched
+        if launched is None:
+            return {}
+        return {k: v for k, v in
+                fleet.placement_key(launched).items() if v}
+
+    def _original_ranks(self, stalled: Dict[int, str]) -> Dict[int, str]:
+        """Telemetry ranks are contiguous over the CURRENT gang; map
+        them back to original host indices of the full gang (what
+        exclude_hosts and the elastic state speak)."""
+        survivors = [i for i in range(self._elastic.full_hosts)
+                     if i not in self._elastic.excluded]
+        return {survivors[r]: v for r, v in stalled.items()
+                if 0 <= r < len(survivors)}
+
+    def _try_shrink(self, handle: Any, cluster_job_id: int,
+                    stalled: Dict[int, str]) -> Optional[int]:
+        """Checkpoint-free elastic shrink: cancel the cluster job and
+        resubmit over the surviving hosts (the cluster itself is
+        healthy — no teardown, no reprovision). Returns the new cluster
+        job id, or None when shrinking is impossible/failed (caller
+        falls back to the full-relaunch recovery). Journalled as
+        ``job.rank_stall`` → ``job.gang_shrunk``, trace-linked under
+        the ``jobs.shrink_gang`` span."""
+        original = self._original_ranks(stalled)
+        if not self._elastic.can_shrink(original):
+            return None
+        cause = ', '.join(f'rank {r}: {v}'
+                          for r, v in sorted(original.items()))
+        stall_at = time.time()
+        target = sorted(self._elastic.excluded | set(original))
+        try:
+            # Drill point: an `error` rule here forces the
+            # full-relaunch fallback; `latency_s` models a slow cancel.
+            chaos.inject('fleet.shrink', job_id=self.job_id)
+            with tracing.span('jobs.shrink_gang', job=self.job_id,
+                              cluster=self.cluster_name,
+                              ranks=','.join(str(r)
+                                             for r in sorted(original))):
+                jobs_state.set_status(
+                    self.job_id, jobs_state.ManagedJobStatus.RECOVERING)
+                new_job_id = self.strategy.backend.resubmit_gang(
+                    handle, self.task, excluded_ranks=target,
+                    cancel_job_id=cluster_job_id,
+                    extra_env={'XSKY_ELASTIC_GENERATION':
+                               str(self._elastic.generation + 1)})
+                # Journal only once the resubmit stuck: a failed shrink
+                # falls back to _recover_from_stall, which writes its
+                # own rank_stall/recovered pair (no double counting).
+                global_state.record_recovery_event(
+                    'job.rank_stall', scope=f'job/{self.job_id}',
+                    cause=cause,
+                    detail={'cluster': self.cluster_name,
+                            'ranks': {str(r): v
+                                      for r, v in original.items()}})
+                jobs_state.bump_recovery_count(self.job_id)
+                self._elastic.shrink(original)
+                jobs_state.set_cluster_job_id(self.job_id, new_job_id)
+                self._persist_gang_state()
+                key = self._placement_key()
+                global_state.record_recovery_event(
+                    'job.gang_shrunk', scope=f'job/{self.job_id}',
+                    cause=cause, latency_s=time.time() - stall_at,
+                    detail={'cluster': self.cluster_name,
+                            'excluded': target,
+                            'survivors': self._elastic.survivors,
+                            **key})
+                fleet.record_decision(
+                    'shrink', job_id=self.job_id,
+                    cluster=self.cluster_name, key=key,
+                    detail={'excluded': target,
+                            'survivors': self._elastic.survivors})
+                jobs_state.set_status(
+                    self.job_id, jobs_state.ManagedJobStatus.RUNNING)
+            logger.info(
+                f'Elastic shrink of {self.cluster_name}: excluded '
+                f'{target}, {self._elastic.survivors}/'
+                f'{self._elastic.full_hosts} ranks continue.')
+            return new_job_id
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Elastic shrink failed ({e}); falling '
+                           'back to full relaunch.')
+            return None
+
+    def _maybe_grow_back(self, handle: Any,
+                         cluster_job_id: int) -> Optional[int]:
+        """Grow-back probe: once the shrink has aged past the probe
+        window AND the placement scorer says pressure on this placement
+        decayed (capacity returned), resubmit over the FULL gang.
+        Returns the new cluster job id, or None (not due / deferred /
+        failed — deferral re-arms the probe one window out)."""
+        if not self._elastic.growback_due():
+            return None
+        key = self._placement_key()
+        # ONE pressure-map build per probe: the logged/recorded score
+        # must be the exact value the gate compared (two builds at
+        # different instants could disagree and confuse post-incident
+        # analysis), and each build reads the journal.
+        score = 0.0
+        try:
+            score = fleet.pressure_map().at(**key) if key else 0.0
+        except Exception:  # pylint: disable=broad-except
+            pass
+        if score >= fleet.block_threshold():
+            logger.info(
+                f'Grow-back of {self.cluster_name} deferred: placement '
+                f'pressure {score:.3f} still above threshold.')
+            self._elastic.defer_growback()
+            self._persist_gang_state()
+            return None
+        shrunk_at = self._elastic.shrunk_at or time.time()
+        try:
+            # Drill point: an `error` rule defers the grow-back (the
+            # shrunk gang keeps running — regrow failure is never an
+            # outage).
+            chaos.inject('fleet.grow_back', job_id=self.job_id)
+            with tracing.span('jobs.grow_gang', job=self.job_id,
+                              cluster=self.cluster_name):
+                new_job_id = self.strategy.backend.resubmit_gang(
+                    handle, self.task, excluded_ranks=[],
+                    cancel_job_id=cluster_job_id,
+                    extra_env={'XSKY_ELASTIC_GENERATION':
+                               str(self._elastic.generation + 1)})
+                self._elastic.regrow()
+                jobs_state.set_cluster_job_id(self.job_id, new_job_id)
+                self._persist_gang_state()
+                global_state.record_recovery_event(
+                    'job.gang_regrown', scope=f'job/{self.job_id}',
+                    cause='placement pressure decayed',
+                    latency_s=time.time() - shrunk_at,
+                    detail={'cluster': self.cluster_name,
+                            'hosts': self._elastic.full_hosts,
+                            'score': score, **key})
+                fleet.record_decision(
+                    'grow', job_id=self.job_id,
+                    cluster=self.cluster_name, key=key, score=score,
+                    detail={'hosts': self._elastic.full_hosts})
+            logger.info(f'Elastic grow-back of {self.cluster_name}: '
+                        f'full gang of {self._elastic.full_hosts} '
+                        'restored.')
+            return new_job_id
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Grow-back failed ({e}); staying shrunk '
+                           'one more window.')
+            self._elastic.defer_growback()
+            self._persist_gang_state()
+            return None
+
     # ---- main loop ----
 
     def run(self) -> None:
@@ -228,6 +405,8 @@ class JobsController:
             # The launch span parents under the jobs.launch request's
             # trace (handed over via XSKY_TRACE_CONTEXT at controller
             # spawn); a respawned controller roots a fresh trace.
+            self.task.update_envs({'XSKY_ELASTIC_GENERATION':
+                                   str(self._elastic.generation)})
             with tracing.span('jobs.launch_task', job=self.job_id,
                               cluster=self.cluster_name):
                 handle, cluster_job_id = self.strategy.launch()
@@ -247,6 +426,11 @@ class JobsController:
         # to stop crash loops, not to cap how many server restarts a
         # long-lived job may outlive.
         jobs_state.reset_controller_respawns(self.job_id)
+        # The launch brought up the FULL gang: reset the elastic state
+        # to its real size (the generation survives — it counts
+        # incarnations, not shrinks).
+        self._elastic.reset(full_hosts=self._gang_size(handle))
+        self._persist_gang_state()
 
         while True:
             resilience.sleep(POLL_INTERVAL_S)
@@ -263,9 +447,27 @@ class JobsController:
                 if status == cluster_job_lib.JobStatus.SUCCEEDED:
                     return True
                 if status == cluster_job_lib.JobStatus.CANCELLED:
-                    jobs_state.set_status(
-                        self.job_id, jobs_state.ManagedJobStatus.CANCELLED)
-                    return False
+                    record = jobs_state.get_job(self.job_id)
+                    if record is None or record['status'].is_terminal():
+                        # Sanctioned cancel (`xsky jobs cancel` set the
+                        # managed status before signalling us).
+                        jobs_state.set_status(
+                            self.job_id,
+                            jobs_state.ManagedJobStatus.CANCELLED)
+                        return False
+                    # Out-of-band cancel the user never asked for: an
+                    # elastic resubmit that cancelled the old cluster
+                    # job and then failed to submit its replacement
+                    # (or a direct agent-side kill). The workload is
+                    # dead and this controller's whole purpose is to
+                    # keep it running — recover like a preemption
+                    # instead of reporting a user cancel.
+                    handle, cluster_job_id = self._recover_lost(
+                        'cluster job cancelled out-of-band (failed '
+                        'elastic resubmit or agent-side kill)')
+                    if handle is None:
+                        return False
+                    continue
                 # User-code failure (not preemption): restart budget.
                 if self.strategy.should_restart_on_failure():
                     logger.info(f'Job failed ({status}); restarting '
@@ -289,40 +491,70 @@ class JobsController:
                 # Cluster job alive per the head's queue — but is the
                 # WORKLOAD advancing? Heartbeat staleness (not raw
                 # wall-clock guesses) decides: a hung-but-alive rank
-                # recovers like a preemption.
+                # first tries a checkpoint-free elastic SHRINK (cancel
+                # + resubmit over the surviving hosts — the cluster is
+                # healthy, only the rank is not), and only when
+                # shrinking is impossible recovers like a preemption.
                 stalled = self._check_workload_telemetry(
                     handle, cluster_job_id)
                 if stalled:
+                    shrunk_job = self._try_shrink(handle,
+                                                  cluster_job_id,
+                                                  stalled)
+                    if shrunk_job is not None:
+                        cluster_job_id = shrunk_job
+                        continue
                     handle, cluster_job_id = \
                         self._recover_from_stall(stalled)
                     if handle is None:
                         return False
+                    continue
+                # Shrunk gang + placement pressure decayed (capacity
+                # returned): grow back to the full gang.
+                regrown_job = self._maybe_grow_back(handle,
+                                                    cluster_job_id)
+                if regrown_job is not None:
+                    cluster_job_id = regrown_job
                 continue
 
             # Probe budget spent (or cluster gone from cloud): the
             # cluster is lost — preemption or infra failure.
-            logger.info(f'Cluster {self.cluster_name} lost; '
-                        'recovering...')
-            lost_at = time.time()
-            global_state.record_recovery_event(
-                'job.preempted', scope=f'job/{self.job_id}',
-                cause='cluster lost (probe budget spent or gone '
-                      'from cloud)',
-                detail={'cluster': self.cluster_name,
-                        'task': getattr(self.task, 'name', None) or ''})
-            jobs_state.set_status(
-                self.job_id, jobs_state.ManagedJobStatus.RECOVERING)
-            jobs_state.bump_recovery_count(self.job_id)
-            handle, cluster_job_id = self._recover()
+            handle, cluster_job_id = self._recover_lost(
+                'cluster lost (probe budget spent or gone from cloud)')
             if handle is None:
                 return False
-            global_state.record_recovery_event(
-                'job.recovered', scope=f'job/{self.job_id}',
-                cause='relaunched after cluster loss',
-                latency_s=time.time() - lost_at,
-                detail={'cluster': self.cluster_name})
-            jobs_state.set_status(
-                self.job_id, jobs_state.ManagedJobStatus.RUNNING)
+
+    def _recover_lost(self, cause: str):
+        """Journalled full-relaunch recovery for a lost workload
+        (preempted cluster, or a cluster job cancelled out-of-band).
+        Returns (handle, cluster_job_id), or (None, None) when the
+        relaunch failed terminally (status already set). The journal
+        row carries structured (cloud, region, zone, sku) keys so the
+        fleet placement scorer counts the loss against where it
+        happened."""
+        logger.info(f'Cluster {self.cluster_name}: {cause}; '
+                    'recovering...')
+        lost_at = time.time()
+        global_state.record_recovery_event(
+            'job.preempted', scope=f'job/{self.job_id}',
+            cause=cause,
+            detail={'cluster': self.cluster_name,
+                    'task': getattr(self.task, 'name', None) or '',
+                    **self._placement_key()})
+        jobs_state.set_status(
+            self.job_id, jobs_state.ManagedJobStatus.RECOVERING)
+        jobs_state.bump_recovery_count(self.job_id)
+        handle, cluster_job_id = self._recover()
+        if handle is None:
+            return None, None
+        global_state.record_recovery_event(
+            'job.recovered', scope=f'job/{self.job_id}',
+            cause='relaunched after cluster loss',
+            latency_s=time.time() - lost_at,
+            detail={'cluster': self.cluster_name})
+        jobs_state.set_status(
+            self.job_id, jobs_state.ManagedJobStatus.RUNNING)
+        return handle, cluster_job_id
 
     def _recover(self):
         # Relaunches queue behind fresh launches (preemption storms must
@@ -330,6 +562,11 @@ class JobsController:
         scheduler.acquire_launch_slot(self.job_id)
         try:
             record = jobs_state.get_job(self.job_id)
+            # A fresh incarnation: chaos plans and workloads keyed on
+            # the generation must see the relaunch as a new one.
+            self._elastic.generation += 1
+            self.task.update_envs({'XSKY_ELASTIC_GENERATION':
+                                   str(self._elastic.generation)})
             with tracing.span(
                     'jobs.recover', job=self.job_id,
                     cluster=self.cluster_name,
@@ -340,6 +577,10 @@ class JobsController:
             # The relaunched task runs under a NEW cluster job id (and
             # possibly a new cluster); keep the live-tail pointer fresh.
             jobs_state.set_cluster_job_id(self.job_id, cluster_job_id)
+            # Full relaunch rebuilt the whole gang: elastic state back
+            # to FULL at the (possibly new) size.
+            self._elastic.reset(full_hosts=self._gang_size(handle))
+            self._persist_gang_state()
             return handle, cluster_job_id
         except exceptions.ResourcesUnavailableError as e:
             jobs_state.set_status(

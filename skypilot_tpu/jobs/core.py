@@ -31,17 +31,21 @@ def _remote_mode() -> bool:
 
 
 def launch(task, name: Optional[str] = None,
-           wait: bool = False, timeout_s: float = 600.0) -> int:
+           wait: bool = False, timeout_s: float = 600.0,
+           priority: int = 0) -> int:
     """Submit a managed job; returns the managed job id.
 
     `task` is one Task, or a SEQUENCE of Tasks — a pipeline the
     controller runs as a sequential chain, each task on its own
-    cluster with its own recovery budget.
+    cluster with its own recovery budget. ``priority``: fleet-scheduler
+    admission priority (higher first; weighted fair-share across
+    workspaces and starvation aging apply on top — see jobs/fleet.py).
     """
     if _remote_mode():
         from skypilot_tpu.jobs import remote as jobs_remote
         return jobs_remote.launch(task, name=name, wait=wait,
-                                  timeout_s=timeout_s)
+                                  timeout_s=timeout_s,
+                                  priority=priority)
     tasks = list(task) if isinstance(task, (list, tuple)) else [task]
     config = task_lib.Task.chain_to_config(tasks)
     # Record the submitting workspace: jobs.cancel/jobs.logs authz
@@ -49,7 +53,8 @@ def launch(task, name: Optional[str] = None,
     # _target_workspace).
     from skypilot_tpu.workspaces import context as ws_context
     job_id = jobs_state.add_job(name or tasks[0].name, config,
-                                workspace=ws_context.get_active())
+                                workspace=ws_context.get_active(),
+                                priority=priority)
     jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.SUBMITTED)
     jobs_scheduler.submit_job(job_id)
     if wait:
@@ -89,10 +94,26 @@ def queue(limit: Optional[int] = None,
         'failure_reason': r['failure_reason'],
         'submitted_at': r['submitted_at'],
         'ended_at': r['ended_at'],
+        # Fleet scheduler: admission priority + elastic gang state
+        # ("3/4" while shrunk — survivors over full gang size).
+        'priority': r.get('priority', 0),
+        'gang': _gang_summary(r),
         # Pipelines: which chain link is running (1-based).
         'task': (f"{min(r['current_task'] + 1, r['num_tasks'])}"
                  f"/{r['num_tasks']}" if r['num_tasks'] > 1 else None),
     } for r in rows]
+
+
+def _gang_summary(record: Dict[str, Any]) -> Optional[str]:
+    """'survivors/full' while elastically shrunk, else None."""
+    detail = record.get('gang_detail') or {}
+    if record.get('gang_status') != 'SHRUNK':
+        return None
+    full = detail.get('full_hosts') or 0
+    excluded = len(detail.get('excluded') or ())
+    if not full:
+        return 'SHRUNK'
+    return f'{full - excluded}/{full}'
 
 
 def cancel(job_id: int) -> None:

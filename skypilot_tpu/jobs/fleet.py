@@ -1,0 +1,547 @@
+"""Goodput-aware fleet scheduler: fair-share admission, placement
+scoring, elastic gang policy.
+
+Replaces the FIFO slot-grab in ``jobs/scheduler.py`` with three
+cooperating pieces, each consumed by an existing plane:
+
+  * **Fair-share admission** — :func:`claim_next_waiting` picks the
+    next WAITING managed job by weighted fair share across workspaces
+    (``XSKY_FLEET_SHARES``), priority within a workspace, and
+    starvation aging (queue wait converts to priority at
+    ``XSKY_FLEET_AGING_S`` seconds per point, so any job eventually
+    outranks any fixed backlog — the starvation bound is
+    ``(score_gap) * aging_s`` seconds of waiting). The scheduler's
+    schedule loop calls this instead of the oldest-job claim.
+
+  * **Placement scoring** — the recovery journal already records every
+    provisioning failure and preemption; with PR 10 those rows carry
+    structured ``(cloud, region, zone, sku)`` keys. :func:`pressure_map`
+    folds them into a recency-decayed pressure score per placement key
+    (half-life ``XSKY_FLEET_DECAY_S``), consumed by three callers
+    through this one scorer: the jobs launch path
+    (:func:`placement_blocks` pre-seeds the failover blocklist), serve's
+    ``spot_placer`` (:func:`zone_pressures` scores candidate zones), and
+    the elastic grow-back probe (the controller regrows only once the
+    gang placement's pressure decays below :func:`block_threshold` —
+    "capacity returned").
+
+  * **Elastic gang policy** — :class:`ElasticGang` is the shrink /
+    grow-back state machine the jobs controller drives when telemetry
+    flags a dead/hung rank on a spot gang: shrink to the surviving
+    ranks first (cancel + resubmit over fewer hosts, no reprovision),
+    schedule a grow-back probe, and fall back to today's full relaunch
+    only when shrinking is impossible (head rank lost, survivor floor,
+    elastic disabled). Journalled as ``job.gang_shrunk`` /
+    ``job.gang_regrown``; every transition also lands in the bounded
+    ``fleet_decisions`` state table (`xsky fleet`).
+
+Grounding: the ML Productivity Goodput paper (PAPERS.md) for what to
+optimize — productive time over wall time, which full relaunches burn
+and shrinks preserve — and the Podracer paper for the elastic-gang
+shape (keep surviving ranks productive, re-admit capacity when it
+returns). ``tools/bench_fleet.py`` gates the claim under a chaos
+preemption storm.
+
+Never-raise discipline on every read consumed from scheduler/controller
+hot paths: a torn journal row or an unreadable state DB costs the
+advice, never the schedule.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+# Journal event types that count as placement pressure on their
+# structured (cloud, region, zone, sku) keys.
+PRESSURE_EVENT_TYPES = (
+    'failover.blocked',      # per failed provisioning attempt
+    'job.preempted',         # managed-job task cluster lost
+    'job.gang_shrunk',       # a rank died/hung on this placement
+    'replica.preempted',     # serve spot replica lost
+)
+
+# Placement-key fields, in display order.
+KEY_FIELDS = ('cloud', 'region', 'zone', 'sku')
+
+_DEFAULT_AGING_S = 300.0
+_DEFAULT_SHARE_PENALTY = 1.0
+_DEFAULT_DECAY_S = 1800.0
+_DEFAULT_BLOCK_THRESHOLD = 1.0
+_DEFAULT_GROWBACK_S = 60.0
+_DEFAULT_MIN_SURVIVORS = 0.5
+# Newest journal rows consulted per scoring pass (the journal itself is
+# bounded; this just caps one pass's parse work).
+_PRESSURE_EVENT_LIMIT = 1000
+# Blocklist entries placement advice may pre-seed (the failover engine
+# clears pre-seeded blocks between retry-until-up sweeps, so advice is
+# soft by construction — but one pass must not blanket the catalog).
+_MAX_PLACEMENT_BLOCKS = 4
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def elastic_enabled() -> bool:
+    return os.environ.get('XSKY_FLEET_ELASTIC', '1') != '0'
+
+
+def aging_s() -> float:
+    return max(1e-6, _env_float('XSKY_FLEET_AGING_S', _DEFAULT_AGING_S))
+
+
+def share_penalty() -> float:
+    return _env_float('XSKY_FLEET_SHARE_PENALTY', _DEFAULT_SHARE_PENALTY)
+
+
+def decay_s() -> float:
+    return max(1e-6, _env_float('XSKY_FLEET_DECAY_S', _DEFAULT_DECAY_S))
+
+
+def block_threshold() -> float:
+    return _env_float('XSKY_FLEET_BLOCK_THRESHOLD',
+                      _DEFAULT_BLOCK_THRESHOLD)
+
+
+def growback_s() -> float:
+    return _env_float('XSKY_FLEET_GROWBACK_S', _DEFAULT_GROWBACK_S)
+
+
+def min_survivors_fraction() -> float:
+    return min(1.0, max(0.0, _env_float('XSKY_FLEET_MIN_SURVIVORS',
+                                        _DEFAULT_MIN_SURVIVORS)))
+
+
+def workspace_shares() -> Dict[str, float]:
+    """``XSKY_FLEET_SHARES='prod=4,research=2'`` → weights (default 1).
+    Malformed entries are skipped, not fatal (scheduler hot path)."""
+    raw = os.environ.get('XSKY_FLEET_SHARES', '')
+    shares: Dict[str, float] = {}
+    for part in raw.split(','):
+        if '=' not in part:
+            continue
+        name, _, value = part.partition('=')
+        try:
+            weight = float(value)
+        except ValueError:
+            continue
+        if name.strip() and weight > 0:
+            shares[name.strip()] = weight
+    return shares
+
+
+# ---- fair-share admission ---------------------------------------------------
+
+
+def job_score(priority: float, wait_s: float, running: int,
+              weight: float) -> float:
+    """Admission score of one workspace's head job.
+
+    ``priority + wait/aging`` (starvation aging: every ``aging_s``
+    seconds of queueing is worth one priority point, so no finite
+    priority/share gap can starve a job forever) minus the workspace's
+    fair-share usage ``running/weight`` scaled by
+    ``XSKY_FLEET_SHARE_PENALTY`` (an underserved workspace's head wins
+    against an equally-urgent head from a busy one).
+    """
+    aged = priority + max(0.0, wait_s) / aging_s()
+    usage = running / max(weight, 1e-6)
+    return aged - share_penalty() * usage
+
+
+def pick_next(waiting: Sequence[Dict[str, Any]],
+              running_counts: Dict[str, int],
+              now: Optional[float] = None) -> Optional[int]:
+    """The job_id to admit next, or None.
+
+    ``waiting`` rows carry job_id/workspace/priority/submitted_at
+    (any order); per workspace only the head — highest AGED priority
+    (priority + wait/aging_s, so queue age eventually outranks any
+    fixed priority WITHIN a workspace too), then oldest — competes,
+    then heads are scored by :func:`job_score`. Deterministic: ties
+    break toward the lower job_id.
+    """
+    now = now if now is not None else time.time()
+
+    def aged(row: Dict[str, Any]) -> float:
+        wait = max(0.0, now - (row.get('submitted_at') or now))
+        return (row.get('priority') or 0) + wait / aging_s()
+
+    heads: Dict[str, Dict[str, Any]] = {}
+    for row in waiting:
+        ws = row.get('workspace') or 'default'
+        head = heads.get(ws)
+        key = (-aged(row), row['job_id'])
+        if head is None or key < (-aged(head), head['job_id']):
+            heads[ws] = row
+    if not heads:
+        return None
+    shares = workspace_shares()
+    best, best_key = None, None
+    for ws, head in heads.items():
+        score = job_score(
+            head.get('priority') or 0,
+            now - (head.get('submitted_at') or now),
+            running_counts.get(ws, 0),
+            shares.get(ws, 1.0))
+        key = (-score, head['job_id'])
+        if best_key is None or key < best_key:
+            best, best_key = head, key
+    return best['job_id'] if best else None
+
+
+def claim_next_waiting() -> Optional[int]:
+    """Fair-share replacement for the FIFO claim: pick by
+    :func:`pick_next` over the WAITING queue, claim atomically
+    (WAITING→LAUNCHING), journal the admission into ``fleet_decisions``.
+    Caller holds the scheduler lock (same contract as the old claim).
+    """
+    from skypilot_tpu.jobs import state as jobs_state
+    waiting = jobs_state.get_waiting_jobs()
+    if not waiting:
+        return None
+    running = jobs_state.active_counts_by_workspace()
+    by_id = {row['job_id']: row for row in waiting}
+    # The conditional claim can race a concurrent cancel; walk the
+    # ranking until one sticks.
+    while by_id:
+        job_id = pick_next(list(by_id.values()), running)
+        if job_id is None:
+            return None
+        row = by_id.pop(job_id)
+        if jobs_state.claim_job(job_id):
+            ws = row.get('workspace') or 'default'
+            record_decision(
+                'admit', job_id=job_id, workspace=ws,
+                score=job_score(
+                    row.get('priority') or 0,
+                    time.time() - (row.get('submitted_at')
+                                   or time.time()),
+                    running.get(ws, 0),
+                    workspace_shares().get(ws, 1.0)),
+                detail={'priority': row.get('priority') or 0,
+                        'waiting': len(by_id) + 1})
+            return job_id
+    return None
+
+
+# ---- placement scoring ------------------------------------------------------
+
+
+class PressureMap:
+    """Recency-decayed placement pressure from journalled outcomes.
+
+    Each event contributes ``0.5 ** (age / decay_s)`` at whatever key
+    fields its detail carries. Backfill-tolerant: rows that predate the
+    structured keys (or carry only some fields) count toward exactly
+    the fields they do carry — a query matches an event when every
+    field present in BOTH agrees and at least one queried field is
+    defined on the event.
+    """
+
+    def __init__(self, events: List[Any], now: Optional[float] = None,
+                 half_life_s: Optional[float] = None) -> None:
+        now = now if now is not None else time.time()
+        half_life = half_life_s if half_life_s is not None else decay_s()
+        # Aggregate by key TUPLE: a storm writes thousands of rows over
+        # a handful of distinct placements, and at()/keys_over iterate
+        # the entries — summing identical-key weights up front makes
+        # each query O(distinct keys), not O(journal rows).
+        summed: Dict[tuple, float] = {}
+        by_tuple: Dict[tuple, Dict[str, str]] = {}
+        for event in events:
+            detail = event.get('detail') or {}
+            keys = {f: detail.get(f) for f in KEY_FIELDS
+                    if detail.get(f)}
+            if not keys:
+                continue   # pre-structured-keys row: nothing to score
+            age = max(0.0, now - (event.get('ts') or now))
+            key_tuple = tuple(keys.get(f) for f in KEY_FIELDS)
+            summed[key_tuple] = summed.get(key_tuple, 0.0) + \
+                0.5 ** (age / half_life)
+            by_tuple.setdefault(key_tuple, keys)
+        self.entries: List[Any] = [
+            (summed[t], by_tuple[t]) for t in summed]
+
+    def at(self, **query: Optional[str]) -> float:
+        query = {k: v for k, v in query.items() if v}
+        if not query:
+            return 0.0
+        total = 0.0
+        for weight, keys in self.entries:
+            shared = set(query) & set(keys)
+            if not shared:
+                continue
+            if all(keys[f] == query[f] for f in shared):
+                total += weight
+        return total
+
+    def keys_over(self, threshold: float) -> List[Dict[str, str]]:
+        """Distinct full key-dicts whose own pressure ≥ threshold,
+        hottest first."""
+        seen: Dict[tuple, Dict[str, str]] = {}
+        for _, keys in self.entries:
+            seen.setdefault(
+                tuple(keys.get(f) for f in KEY_FIELDS), keys)
+        scored = [(self.at(**keys), keys) for keys in seen.values()]
+        scored = [(p, k) for p, k in scored if p >= threshold]
+        scored.sort(key=lambda pair: (-pair[0],
+                                      json.dumps(pair[1], sort_keys=True)))
+        return [k for _, k in scored]
+
+
+def pressure_map(now: Optional[float] = None) -> PressureMap:
+    """The shared scorer's current view, from the recovery journal.
+    Never raises — an unreadable DB scores everything zero."""
+    events: List[Any] = []
+    try:
+        from skypilot_tpu import state
+        for event_type in PRESSURE_EVENT_TYPES:
+            events.extend(state.get_recovery_events(
+                event_type=event_type, limit=_PRESSURE_EVENT_LIMIT))
+    except Exception:  # pylint: disable=broad-except
+        events = []
+    try:
+        return PressureMap(events, now=now)
+    except Exception:  # pylint: disable=broad-except
+        return PressureMap([], now=now)
+
+
+def zone_pressures(zones: Iterable[str],
+                   now: Optional[float] = None) -> Dict[str, float]:
+    """Decayed pressure per zone — the shared-scorer entry point for
+    serve's spot placer, which picks RANDOMLY among the coldest zones
+    (deterministic best-first would herd every replica into one zone
+    on ties and recreate the correlated-failure mode zone spreading
+    exists to avoid). Never raises; unreadable journal scores zero."""
+    zones = sorted(set(zones))
+    try:
+        pressure = pressure_map(now=now)
+        return {z: pressure.at(zone=z) for z in zones}
+    except Exception:  # pylint: disable=broad-except
+        return {z: 0.0 for z in zones}
+
+
+def sku_of(resources: Any) -> Optional[str]:
+    """Canonical SKU string of a Resources (accelerator name, else
+    instance type) — the ``sku`` field of every structured outcome."""
+    try:
+        acc = resources.accelerators
+        if acc:
+            return next(iter(acc))
+        return resources.instance_type
+    except Exception:  # pylint: disable=broad-except
+        return None
+
+
+def placement_key(resources: Any) -> Dict[str, Optional[str]]:
+    """Structured ``(cloud, region, zone, sku)`` of launched/attempted
+    resources, for journal detail rows and scorer queries."""
+    try:
+        return {
+            'cloud': getattr(resources, 'cloud_name', None),
+            'region': getattr(resources, 'region', None),
+            'zone': getattr(resources, 'zone', None),
+            'sku': sku_of(resources),
+        }
+    except Exception:  # pylint: disable=broad-except
+        return {}
+
+
+def placement_blocks(task: Any) -> List[Any]:
+    """Pre-seeded failover blocklist from placement pressure: zones
+    whose decayed score crossed ``XSKY_FLEET_BLOCK_THRESHOLD``, scoped
+    to the spot provisioning model (a spot preemption says nothing
+    about on-demand) and capped — the failover engine clears pre-seeded
+    blocks between retry-until-up sweeps, so this advice can delay a
+    launch by at most one sweep. Only for tasks that use spot. Never
+    raises; empty advice on any failure."""
+    try:
+        if not any(r.use_spot for r in task.resources):
+            return []
+        from skypilot_tpu import resources as resources_lib
+        hot = pressure_map().keys_over(block_threshold())
+        blocks = []
+        for keys in hot:
+            if not keys.get('zone'):
+                continue   # never block broader than a zone from advice
+            # Zone-only scope (the spot-placer pattern): naming the
+            # cloud would make Resources validate the zone against its
+            # catalog, which pre-dated/foreign journal rows can fail.
+            blocks.append(resources_lib.Resources(
+                zone=keys['zone'],
+                accelerator_args={'provisioning_model': 'spot'}))
+            if len(blocks) >= _MAX_PLACEMENT_BLOCKS:
+                break
+        return blocks
+    except Exception:  # pylint: disable=broad-except
+        return []
+
+
+# ---- fleet decisions --------------------------------------------------------
+
+
+def record_decision(kind: str,
+                    job_id: Optional[int] = None,
+                    workspace: Optional[str] = None,
+                    cluster: Optional[str] = None,
+                    key: Optional[Dict[str, Optional[str]]] = None,
+                    score: Optional[float] = None,
+                    detail: Optional[Dict[str, Any]] = None) -> None:
+    """Append one row to the bounded ``fleet_decisions`` table. NEVER
+    raises (rides the scheduler/controller hot paths)."""
+    try:
+        from skypilot_tpu import state
+        state.record_fleet_decisions([{
+            'kind': kind,
+            'job_id': job_id,
+            'workspace': workspace,
+            'cluster': cluster,
+            'cloud': (key or {}).get('cloud'),
+            'region': (key or {}).get('region'),
+            'zone': (key or {}).get('zone'),
+            'sku': (key or {}).get('sku'),
+            'score': score,
+            'detail': detail,
+        }])
+    except Exception:  # pylint: disable=broad-except
+        pass
+
+
+# ---- elastic gang state machine ---------------------------------------------
+
+
+STATE_FULL = 'FULL'
+STATE_SHRUNK = 'SHRUNK'
+
+
+class ElasticGang:
+    """Shrink / grow-back state of one managed job's gang.
+
+    Pure policy — the controller owns the side effects (cancel,
+    resubmit, journal). Survives controller respawns via
+    ``to_detail()``/``from_detail()`` round-tripped through the job
+    record's ``gang_detail`` column.
+    """
+
+    def __init__(self, full_hosts: int,
+                 excluded: Optional[Iterable[int]] = None,
+                 shrunk_at: Optional[float] = None,
+                 generation: int = 0,
+                 next_probe_at: Optional[float] = None) -> None:
+        self.full_hosts = max(1, int(full_hosts))
+        self.excluded: Set[int] = set(int(r) for r in (excluded or ()))
+        self.shrunk_at = shrunk_at
+        self.generation = int(generation)
+        # Deferred probes re-arm here; shrunk_at stays the TRUE shrink
+        # time so the regrow journal latency measures the whole shrunk
+        # period.
+        self.next_probe_at = next_probe_at
+
+    # -- state --
+
+    @property
+    def shrunk(self) -> bool:
+        return bool(self.excluded)
+
+    @property
+    def state(self) -> str:
+        return STATE_SHRUNK if self.shrunk else STATE_FULL
+
+    @property
+    def survivors(self) -> int:
+        return self.full_hosts - len(self.excluded)
+
+    def survivor_floor(self) -> int:
+        """Smallest gang worth running shrunk: the configured fraction
+        of the full gang, at least one rank."""
+        import math
+        return max(1, math.ceil(self.full_hosts *
+                                min_survivors_fraction()))
+
+    # -- transitions --
+
+    def can_shrink(self, stalled_ranks: Iterable[int]) -> bool:
+        """Shrinkable: elastic on, multi-host gang, the head rank (the
+        agent/job-queue host) survives, and the surviving count stays
+        at or above the floor. Stalled ranks are ORIGINAL host indices
+        relative to the full gang (already-excluded ranks re-reported
+        by a stale pull don't shrink twice)."""
+        stalled = set(int(r) for r in stalled_ranks) - self.excluded
+        if not elastic_enabled() or self.full_hosts <= 1 or not stalled:
+            return False
+        if 0 in stalled:
+            return False
+        return self.survivors - len(stalled) >= self.survivor_floor()
+
+    def shrink(self, stalled_ranks: Iterable[int],
+               now: Optional[float] = None) -> Set[int]:
+        """Apply a shrink; returns the full excluded set (for the
+        resubmit's ``exclude_hosts``)."""
+        now = now if now is not None else time.time()
+        self.excluded |= set(int(r) for r in stalled_ranks)
+        if self.shrunk_at is None:
+            self.shrunk_at = now
+        self.next_probe_at = now + growback_s()
+        self.generation += 1
+        return set(self.excluded)
+
+    def growback_due(self, now: Optional[float] = None) -> bool:
+        """Time to probe for grow-back? (The caller still gates on
+        :func:`capacity_ok`.)"""
+        if not self.shrunk or self.shrunk_at is None:
+            return False
+        now = now if now is not None else time.time()
+        return now >= (self.next_probe_at
+                       if self.next_probe_at is not None
+                       else self.shrunk_at + growback_s())
+
+    def defer_growback(self, now: Optional[float] = None) -> None:
+        """Capacity not back yet: re-arm the probe one window out
+        (shrunk_at is untouched — it dates the whole shrunk period)."""
+        now = now if now is not None else time.time()
+        self.next_probe_at = now + growback_s()
+
+    def regrow(self) -> None:
+        self.excluded.clear()
+        self.shrunk_at = None
+        self.next_probe_at = None
+        self.generation += 1
+
+    def reset(self, full_hosts: Optional[int] = None) -> None:
+        """A full relaunch (preemption fallback) rebuilt the gang."""
+        if full_hosts is not None:
+            self.full_hosts = max(1, int(full_hosts))
+        self.excluded.clear()
+        self.shrunk_at = None
+        self.next_probe_at = None
+
+    # -- persistence --
+
+    def to_detail(self) -> Dict[str, Any]:
+        return {
+            'full_hosts': self.full_hosts,
+            'excluded': sorted(self.excluded),
+            'shrunk_at': self.shrunk_at,
+            'generation': self.generation,
+            'next_probe_at': self.next_probe_at,
+        }
+
+    @classmethod
+    def from_detail(cls, detail: Optional[Dict[str, Any]],
+                    full_hosts: int) -> 'ElasticGang':
+        detail = detail or {}
+        return cls(full_hosts=detail.get('full_hosts') or full_hosts,
+                   excluded=detail.get('excluded') or (),
+                   shrunk_at=detail.get('shrunk_at'),
+                   generation=detail.get('generation') or 0,
+                   next_probe_at=detail.get('next_probe_at'))

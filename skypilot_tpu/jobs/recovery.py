@@ -59,12 +59,21 @@ class StrategyExecutor:
     def launch(self, retry_until_up: bool = True,
                blocked: Optional[List[resources_lib.Resources]] = None
                ) -> Any:
-        """Provision the task cluster + submit the job. Returns handle."""
+        """Provision the task cluster + submit the job. Returns handle.
+
+        The fleet placement scorer pre-seeds the failover blocklist
+        with zones whose journalled preemption/capacity pressure is
+        still hot (spot-scoped, capped, cleared between retry-until-up
+        sweeps — advice, not policy), so a recovering gang stops
+        re-rolling the dice on a zone that just preempted it.
+        """
         from skypilot_tpu import execution
+        from skypilot_tpu.jobs import fleet
+        blocked = list(blocked or []) + fleet.placement_blocks(self.task)
         job_id, handle = execution.launch(
             self.task, cluster_name=self.cluster_name,
             retry_until_up=retry_until_up, detach_run=True,
-            blocked_resources=blocked)
+            blocked_resources=blocked or None)
         if handle is not None:
             self.last_launched = handle.launched_resources
         return handle, job_id

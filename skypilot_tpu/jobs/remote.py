@@ -41,7 +41,8 @@ ensure_controller_cluster = _relay.ensure_controller_cluster
 
 
 def launch(task, name: Optional[str] = None,
-           wait: bool = False, timeout_s: float = 600.0) -> int:
+           wait: bool = False, timeout_s: float = 600.0,
+           priority: int = 0) -> int:
     config = task_lib.Task.chain_to_config(task)
     with tempfile.NamedTemporaryFile(
             'w', suffix='.yaml', prefix='xsky-mjob-',
@@ -49,8 +50,9 @@ def launch(task, name: Optional[str] = None,
         f.write(json.dumps(config))
         local_path = f.name
     try:
-        reply = _relay.call('submit',
-                            *(['--name', name] if name else []),
+        flags = (['--name', name] if name else []) + \
+            (['--priority', str(int(priority))] if priority else [])
+        reply = _relay.call('submit', *flags,
                             payload_file=local_path, provision=True)
     finally:
         os.unlink(local_path)

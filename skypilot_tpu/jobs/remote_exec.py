@@ -29,15 +29,19 @@ def main(argv) -> int:
     verb, args = argv[0], argv[1:]
     if verb == 'submit':
         name = None
-        if args and args[0] == '--name':
-            name, args = args[1], args[2:]
+        priority = 0
+        while args and args[0] in ('--name', '--priority'):
+            if args[0] == '--name':
+                name, args = args[1], args[2:]
+            else:
+                priority, args = int(args[1]), args[2:]
         with open(args[0], encoding='utf-8') as f:
             config = json.load(f)
         if isinstance(config, list):   # pipeline: chain of tasks
             task = [task_lib.Task.from_yaml_config(c) for c in config]
         else:
             task = task_lib.Task.from_yaml_config(config)
-        job_id = jobs_core.launch(task, name=name)
+        job_id = jobs_core.launch(task, name=name, priority=priority)
         _print({'job_id': job_id})
     elif verb == 'get':
         row = jobs_state.get_job(int(args[0]))

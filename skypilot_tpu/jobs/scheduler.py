@@ -31,8 +31,11 @@ import filelock
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu import state as global_state
+from skypilot_tpu.jobs import fleet
 from skypilot_tpu.jobs import state as jobs_state
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import resilience
+from skypilot_tpu.utils import tracing
 
 logger = sky_logging.init_logger(__name__)
 
@@ -209,7 +212,10 @@ def maybe_schedule_next_jobs() -> Dict[str, List]:
                     break
                 if launching + alive >= max_alive():
                     break
-                job_id = jobs_state.claim_next_waiting()
+                # Fair-share admission (jobs/fleet.py): weighted shares
+                # across workspaces + priority + starvation aging pick
+                # the claim, not submission order.
+                job_id = fleet.claim_next_waiting()
                 if job_id is None:
                     break
                 logger.info(f'Scheduling managed job {job_id} '
@@ -251,29 +257,43 @@ def acquire_launch_slot(job_id: int,
     in sky/jobs/scheduler.py).
     """
     deadline = (time.time() + timeout_s) if timeout_s else None
-    while True:
-        # A controller can queue here for a long time during a
-        # preemption storm; keep its liveness lease fresh or the
-        # reconciler would report a healthy-but-waiting controller
-        # as expired.
-        global_state.heartbeat_lease(f'job/{job_id}',
-                                     owner='jobs-controller')
-        acquired = False
-        with _lock():
-            reconciled = _reconcile_dead_controllers()
-            counts = jobs_state.schedule_state_counts()
-            if counts.get(jobs_state.ScheduleState.LAUNCHING,
-                          0) < max_launching():
-                jobs_state.set_schedule_state(
-                    job_id, jobs_state.ScheduleState.LAUNCHING)
-                acquired = True
-        _reap_clusters(reconciled['orphaned'])
-        if acquired:
-            return
-        if deadline and time.time() > deadline:
-            raise TimeoutError(
-                f'No launch slot for job {job_id} after {timeout_s}s')
-        time.sleep(poll_interval_s)
+    wait_start = time.time()
+    # Jittered backoff instead of the old fixed-interval filelock poll:
+    # a preemption storm parks every recovering controller here, and N
+    # controllers hammering the scheduler lock in lockstep each 0.5 s
+    # starved the one holding it. Caps at 8x the base interval.
+    backoff = common_utils.Backoff(initial=poll_interval_s, factor=1.5,
+                                   cap=poll_interval_s * 8, jitter=0.2)
+    polls = 0
+    with tracing.span('fleet.queue_wait', job=job_id) as sp:
+        while True:
+            # A controller can queue here for a long time during a
+            # preemption storm; keep its liveness lease fresh or the
+            # reconciler would report a healthy-but-waiting controller
+            # as expired.
+            global_state.heartbeat_lease(f'job/{job_id}',
+                                         owner='jobs-controller')
+            acquired = False
+            with _lock():
+                reconciled = _reconcile_dead_controllers()
+                counts = jobs_state.schedule_state_counts()
+                if counts.get(jobs_state.ScheduleState.LAUNCHING,
+                              0) < max_launching():
+                    jobs_state.set_schedule_state(
+                        job_id, jobs_state.ScheduleState.LAUNCHING)
+                    acquired = True
+            _reap_clusters(reconciled['orphaned'])
+            if acquired:
+                sp.set(polls=polls,
+                       waited_s=round(time.time() - wait_start, 3))
+                return
+            polls += 1
+            if deadline and time.time() > deadline:
+                sp.set(polls=polls, outcome='timeout')
+                raise TimeoutError(
+                    f'No launch slot for job {job_id} after '
+                    f'{timeout_s}s')
+            resilience.sleep(backoff.current_backoff())
 
 
 def job_done(job_id: int) -> None:

@@ -67,7 +67,7 @@ def _db() -> sqlite3.Connection:
     try:
         # Probe the NEWEST column so a pre-migration DB falls through
         # to the DDL below (an older probe column would skip it).
-        conn.execute('SELECT workspace FROM managed_jobs '
+        conn.execute('SELECT gang_detail FROM managed_jobs '
                      'LIMIT 1').fetchall()
         return conn
     except Exception:  # pylint: disable=broad-except
@@ -112,6 +112,14 @@ def _db() -> sqlite3.Connection:
             # The task's job id ON its task cluster (strategy.launch
             # return): live log tail polls that cluster job directly.
             "ALTER TABLE managed_jobs ADD COLUMN cluster_job_id INTEGER",
+            # Fleet scheduler (jobs/fleet.py): admission priority
+            # (higher schedules first, fair-share and aging applied on
+            # top) and the elastic gang's shrink state (survives
+            # controller respawns).
+            "ALTER TABLE managed_jobs ADD COLUMN priority INTEGER "
+            "DEFAULT 0",
+            "ALTER TABLE managed_jobs ADD COLUMN gang_status TEXT",
+            "ALTER TABLE managed_jobs ADD COLUMN gang_detail TEXT",
     ):
         try:
             conn.execute(migration)
@@ -130,11 +138,13 @@ def _db() -> sqlite3.Connection:
 
 
 def add_job(name: Optional[str], task_config: Any,
-            workspace: Optional[str] = None) -> int:
+            workspace: Optional[str] = None,
+            priority: int = 0) -> int:
     """task_config: one task's config dict, or a LIST of config dicts
     for a pipeline (chain of tasks run sequentially, each on its own
     cluster — twin of the reference's chain-DAG managed jobs,
-    sky/jobs/controller.py:68)."""
+    sky/jobs/controller.py:68). ``priority``: fleet-scheduler admission
+    priority (higher first; fair-share + aging applied on top)."""
     from skypilot_tpu.utils import db_utils
     num_tasks = (len(task_config)
                  if isinstance(task_config, list) else 1)
@@ -144,20 +154,20 @@ def add_job(name: Optional[str], task_config: Any,
             # psycopg2 cursors have no meaningful lastrowid.
             cur = conn.execute(
                 'INSERT INTO managed_jobs (name, task_config, status, '
-                'submitted_at, num_tasks, workspace) '
-                'VALUES (?, ?, ?, ?, ?, ?) RETURNING job_id',
+                'submitted_at, num_tasks, workspace, priority) '
+                'VALUES (?, ?, ?, ?, ?, ?, ?) RETURNING job_id',
                 (name, json.dumps(task_config),
                  ManagedJobStatus.PENDING.value, time.time(), num_tasks,
-                 workspace))
+                 workspace, int(priority)))
             job_id = cur.fetchone()[0]
         else:
             cur = conn.execute(
                 'INSERT INTO managed_jobs (name, task_config, status, '
-                'submitted_at, num_tasks, workspace) '
-                'VALUES (?, ?, ?, ?, ?, ?)',
+                'submitted_at, num_tasks, workspace, priority) '
+                'VALUES (?, ?, ?, ?, ?, ?, ?)',
                 (name, json.dumps(task_config),
                  ManagedJobStatus.PENDING.value, time.time(), num_tasks,
-                 workspace))
+                 workspace, int(priority)))
             job_id = cur.lastrowid
         conn.commit()
         conn.close()
@@ -215,23 +225,101 @@ def schedule_state_counts() -> Dict[ScheduleState, int]:
     return {ScheduleState(s or 'INACTIVE'): n for s, n in rows}
 
 
-def claim_next_waiting() -> Optional[int]:
-    """Atomically move the oldest WAITING job to LAUNCHING."""
+# (The legacy FIFO claim lived here; admission now goes through
+# fleet.claim_next_waiting — fair-share pick + :func:`claim_job` —
+# so a second claim path can't bypass the shares or the
+# fleet_decisions journal.)
+
+# Queued jobs one admission pass considers (thousands — the fleet
+# scheduler's design point; deeper backlogs age into this window as
+# the front drains, so nothing starves, it just waits its turn).
+_WAITING_SCAN_LIMIT = 10000
+
+
+def get_waiting_jobs() -> List[Dict[str, Any]]:
+    """WAITING queue projection for fair-share admission: job_id,
+    workspace, priority, submitted_at — no task-config parse, bounded
+    scan. The window is ordered by AGED priority (priority +
+    wait/aging_s, the same aging fleet.pick_next applies), not raw
+    priority: under a backlog deeper than the window, a low-priority
+    job's aged score grows without bound, so it always climbs INTO the
+    window eventually — raw-priority ordering would starve it outside
+    the window forever."""
+    from skypilot_tpu.jobs import fleet
+    now = time.time()
+    with _lock:
+        conn = _db()
+        rows = conn.execute(
+            'SELECT job_id, workspace, priority, submitted_at '
+            'FROM managed_jobs WHERE schedule_state=? '
+            'ORDER BY COALESCE(priority, 0) + '
+            '(? - COALESCE(submitted_at, ?)) / ? DESC, job_id LIMIT ?',
+            (ScheduleState.WAITING.value, now, now, fleet.aging_s(),
+             _WAITING_SCAN_LIMIT)).fetchall()
+        conn.close()
+    return [{'job_id': r[0], 'workspace': r[1] or 'default',
+             'priority': r[2] or 0, 'submitted_at': r[3]}
+            for r in rows]
+
+
+def active_counts_by_workspace() -> Dict[str, int]:
+    """Workspace → controllers holding capacity (LAUNCHING + ALIVE);
+    the fair-share usage side of the admission score."""
+    with _lock:
+        conn = _db()
+        rows = conn.execute(
+            'SELECT workspace, COUNT(*) FROM managed_jobs '
+            'WHERE schedule_state IN (?, ?) GROUP BY workspace',
+            (ScheduleState.LAUNCHING.value,
+             ScheduleState.ALIVE.value)).fetchall()
+        conn.close()
+    return {(ws or 'default'): n for ws, n in rows}
+
+
+def claim_job(job_id: int) -> bool:
+    """Conditionally claim ONE job (WAITING→LAUNCHING). False when a
+    concurrent cancel/claim got there first."""
+    with _lock:
+        conn = _db()
+        cur = conn.execute(
+            'UPDATE managed_jobs SET schedule_state=? '
+            'WHERE job_id=? AND schedule_state=?',
+            (ScheduleState.LAUNCHING.value, job_id,
+             ScheduleState.WAITING.value))
+        conn.commit()
+        conn.close()
+    return cur.rowcount > 0
+
+
+def count_shrunk_jobs() -> int:
+    """Live elastically-shrunk gangs, as a COUNT projection (the
+    /metrics scrape must not fetch and JSON-parse full job rows on
+    every tick — same rationale as state.count_clusters)."""
+    terminal = [s.value for s in ManagedJobStatus if s.is_terminal()]
     with _lock:
         conn = _db()
         row = conn.execute(
-            'SELECT job_id FROM managed_jobs WHERE schedule_state=? '
-            'ORDER BY job_id LIMIT 1',
-            (ScheduleState.WAITING.value,)).fetchone()
-        if row is None:
-            conn.close()
-            return None
+            'SELECT COUNT(*) FROM managed_jobs WHERE gang_status=? '
+            f"AND status NOT IN ({','.join('?' * len(terminal))})",
+            ['SHRUNK'] + terminal).fetchone()
+        conn.close()
+    return int(row[0]) if row else 0
+
+
+def set_gang_state(job_id: int, gang_status: Optional[str],
+                   gang_detail: Optional[Dict[str, Any]]) -> None:
+    """Persist the elastic gang's shrink state (jobs/fleet.ElasticGang
+    to_detail round-trip) so a respawned controller resumes it."""
+    with _lock:
+        conn = _db()
         conn.execute(
-            'UPDATE managed_jobs SET schedule_state=? WHERE job_id=?',
-            (ScheduleState.LAUNCHING.value, row[0]))
+            'UPDATE managed_jobs SET gang_status=?, gang_detail=? '
+            'WHERE job_id=?',
+            (gang_status,
+             json.dumps(gang_detail) if gang_detail is not None else None,
+             job_id))
         conn.commit()
         conn.close()
-        return row[0]
 
 
 def set_cluster_name(job_id: int, cluster_name: str) -> None:
@@ -345,10 +433,15 @@ def _to_dict(row) -> Dict[str, Any]:
     (job_id, name, task_config, status, cluster_name, recovery_count,
      failure_reason, controller_pid, submitted_at, started_at,
      ended_at, schedule_state, current_task, num_tasks,
-     controller_respawns, workspace, cluster_job_id) = row
+     controller_respawns, workspace, cluster_job_id, priority,
+     gang_status, gang_detail) = row
     parsed = json.loads(task_config or '{}')
     # Pipelines store a LIST of task configs; single jobs a dict.
     configs = parsed if isinstance(parsed, list) else [parsed]
+    try:
+        gang_detail = json.loads(gang_detail) if gang_detail else None
+    except ValueError:
+        gang_detail = None
     return {
         'schedule_state': ScheduleState(schedule_state or 'INACTIVE'),
         'job_id': job_id,
@@ -365,6 +458,9 @@ def _to_dict(row) -> Dict[str, Any]:
         'controller_pid': controller_pid,
         'controller_respawns': controller_respawns or 0,
         'workspace': workspace,
+        'priority': priority or 0,
+        'gang_status': gang_status,
+        'gang_detail': gang_detail,
         'submitted_at': submitted_at,
         'started_at': started_at,
         'ended_at': ended_at,

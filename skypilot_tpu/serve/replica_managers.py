@@ -71,6 +71,11 @@ class ReplicaManager:
         from skypilot_tpu.serve import spot_placer as spot_placer_lib
         self.spot_placer = spot_placer_lib.DynamicFallbackSpotPlacer([])
         self._replica_zone: Dict[int, str] = {}
+        # Structured (cloud, region, zone, sku) of each replica's
+        # launched placement, captured at launch success — preemption
+        # journal rows carry it so the shared fleet placement scorer
+        # (jobs/fleet.py) counts serve preemptions too.
+        self._replica_placement: Dict[int, Dict[str, Any]] = {}
         # Preemption-detection timestamps: journal recovery latency when
         # the replacement launches.
         self._preempted_at: Dict[int, float] = {}
@@ -242,6 +247,10 @@ class ReplicaManager:
             if zone:
                 self._replica_zone[replica_id] = zone
                 self.spot_placer.handle_active(zone)
+            from skypilot_tpu.jobs import fleet
+            self._replica_placement[replica_id] = {
+                k: v for k, v in fleet.placement_key(
+                    handle.launched_resources).items() if v}
             self.launch_failures = 0
             if not any(r['replica_id'] == replica_id
                        for r in self.replicas()):
@@ -305,13 +314,17 @@ class ReplicaManager:
                 if zone:
                     self.spot_placer.handle_preemption(zone)
                 self._preempted_at[r['replica_id']] = time.time()
+                # Structured placement keys ride the row so the fleet
+                # scorer counts this preemption against its zone/SKU.
                 global_state.record_recovery_event(
                     'replica.preempted',
                     scope=(f'service/{self.service_name}/replica/'
                            f'{r["replica_id"]}'),
                     cause='cluster gone from cloud',
                     detail={'cluster': r['cluster_name'],
-                            'zone': zone or ''})
+                            'zone': zone or '',
+                            **self._replica_placement.get(
+                                r['replica_id'], {})})
                 serve_state.upsert_replica(
                     self.service_name, r['replica_id'],
                     r['cluster_name'],
@@ -394,6 +407,9 @@ class ReplicaManager:
             for rid in list(self._preempted_at):
                 if rid not in live_ids:
                     del self._preempted_at[rid]
+            for rid in list(self._replica_placement):
+                if rid not in live_ids:
+                    del self._replica_placement[rid]
             for r in live:
                 if r['status'] == serve_state.ReplicaStatus.PREEMPTED:
                     from skypilot_tpu.utils import tracing

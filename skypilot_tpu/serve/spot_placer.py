@@ -4,11 +4,24 @@ Twin of sky/serve/spot_placer.py:170 (SpotPlacer,
 DynamicFallbackSpotPlacer:254): zones where a spot replica was preempted
 move to the 'preemptive' set and are avoided until every zone is
 preemptive (then the sets reset — better to try somewhere than nowhere).
+
+PR 10: zone SELECTION goes through the fleet placement scorer
+(``skypilot_tpu/jobs/fleet.zone_pressures``) — the same journal-backed,
+recency-decayed pressure score that places job gangs — instead of a
+random pick. The in-memory active/preemptive sets keep the
+process-local fallback semantics (reset when everything is preemptive,
+on-demand fallback), while the scorer adds what the sets cannot see:
+preemptions observed by OTHER controllers/processes against the same
+zones (journalled as ``replica.preempted`` / ``job.preempted`` /
+``failover.blocked`` with structured keys), decayed by recency. A zone
+preempted an hour ago outranks one preempted a minute ago.
 """
 from __future__ import annotations
 
 import random
 from typing import List, Optional, Set
+
+from skypilot_tpu.jobs import fleet
 
 
 class SpotPlacer:
@@ -22,7 +35,16 @@ class SpotPlacer:
             self._reset()
         if not self.active_zones:
             return None
-        return random.choice(sorted(self.active_zones))
+        # Shared scorer: zones with journalled preemption/capacity
+        # pressure are avoided; among the COLDEST zones the pick stays
+        # random — a deterministic best-first would herd every replica
+        # into one zone on ties (fresh journal = all ties) and
+        # concentrate exactly the correlated-preemption risk zone
+        # spreading exists to avoid.
+        pressures = fleet.zone_pressures(self.active_zones)
+        coldest = min(pressures.values())
+        return random.choice(sorted(
+            z for z, p in pressures.items() if p <= coldest))
 
     def handle_preemption(self, zone: str) -> None:
         self.active_zones.discard(zone)

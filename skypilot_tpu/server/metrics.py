@@ -38,6 +38,8 @@ and gauges computed at scrape time from the state DB:
   * xsky_serve_slo_burn_rate{service,window}  (worst objective's burn;
     >= 1 spends the error budget faster than it accrues)
   * xsky_serve_replica_ttft_p99_seconds{service,replica}
+  * xsky_fleet_queue_depth{state}  (managed-job admission queue)
+  * xsky_fleet_gangs_shrunk  (jobs running elastically shrunk)
 """
 from __future__ import annotations
 
@@ -330,13 +332,49 @@ def _render_serve_slo_gauges() -> List[str]:
     return lines
 
 
+def _render_fleet_gauges() -> List[str]:
+    """Fleet-scheduler health computed at scrape time: managed-job
+    queue depth per schedule state (a climbing `waiting` with idle
+    `launching` means admission is stuck) and the count of elastically
+    SHRUNK gangs (non-zero = jobs running on survivors, waiting for
+    capacity to grow back). Bounded cardinality by construction (four
+    schedule states, one scalar). Never raises; an unreadable jobs DB
+    costs the gauges, not the scrape."""
+    lines: List[str] = []
+    try:
+        from skypilot_tpu.jobs import state as jobs_state
+        counts = jobs_state.schedule_state_counts()
+        lines.append('# HELP xsky_fleet_queue_depth Managed jobs per '
+                     'schedule state (fleet scheduler admission '
+                     'queue).')
+        lines.append('# TYPE xsky_fleet_queue_depth gauge')
+        for state_enum in jobs_state.ScheduleState:
+            if state_enum == jobs_state.ScheduleState.INACTIVE:
+                continue
+            lines.append(
+                'xsky_fleet_queue_depth{state="'
+                f'{state_enum.value.lower()}"}} '
+                f'{counts.get(state_enum, 0)}')
+        shrunk = jobs_state.count_shrunk_jobs()
+        lines.append('# HELP xsky_fleet_gangs_shrunk Managed jobs '
+                     'currently running elastically shrunk (waiting '
+                     'for grow-back).')
+        lines.append('# TYPE xsky_fleet_gangs_shrunk gauge')
+        lines.append(f'xsky_fleet_gangs_shrunk {shrunk}')
+    except Exception:  # pylint: disable=broad-except
+        return []
+    return lines
+
+
 def render() -> str:
     """Text exposition format (version 0.0.4): the server's own
     HTTP/verb series, then the generic control-plane registry, then
-    the scrape-time lease + workload + profile + serve-SLO gauges."""
+    the scrape-time lease + workload + profile + serve-SLO + fleet
+    gauges."""
     tail = registry.render_registry() + '\n'.join(
         _render_lease_gauges() + _render_workload_gauges() +
-        _render_profile_gauges() + _render_serve_slo_gauges())
+        _render_profile_gauges() + _render_serve_slo_gauges() +
+        _render_fleet_gauges())
     with _lock:
         lines = [
             '# HELP xsky_http_requests_total HTTP requests by route/code.',

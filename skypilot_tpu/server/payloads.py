@@ -147,7 +147,11 @@ def _jobs_launch(body: Dict[str, Any]) -> Tuple[Callable, Dict[str, Any]]:
         return {'job_id': _in_workspace(workspace, jobs_core.launch,
                                         task, **kwargs)}
 
-    return run, {'name': body.get('name')}
+    try:
+        priority = int(body.get('priority') or 0)
+    except (TypeError, ValueError) as e:
+        raise BadRequest(f'invalid priority: {e}') from e
+    return run, {'name': body.get('name'), 'priority': priority}
 
 
 def _jobs_verb(fn_name: str, *fields, **defaults):

@@ -294,6 +294,24 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             ON serve_slo (service);
         CREATE INDEX IF NOT EXISTS idx_serve_slo_latest
             ON serve_slo (service, kind, replica_id, row_id);
+        CREATE TABLE IF NOT EXISTS fleet_decisions (
+            row_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            ts REAL,
+            kind TEXT,
+            job_id INTEGER,
+            workspace TEXT,
+            cluster TEXT,
+            cloud TEXT,
+            region TEXT,
+            zone TEXT,
+            sku TEXT,
+            score REAL,
+            detail TEXT
+        );
+        CREATE INDEX IF NOT EXISTS idx_fleet_decisions_job
+            ON fleet_decisions (job_id);
+        CREATE INDEX IF NOT EXISTS idx_fleet_decisions_kind
+            ON fleet_decisions (kind, row_id);
         CREATE INDEX IF NOT EXISTS idx_clusters_status
             ON clusters (status);
         CREATE INDEX IF NOT EXISTS idx_recovery_events_ts
@@ -1279,6 +1297,107 @@ def get_serve_slo(service: Optional[str] = None,
             'burns': burns,
             'verdict': verdict,
             'detail': detail,
+        })
+    return out
+
+
+# ---- fleet decisions --------------------------------------------------------
+
+# Scheduling/placement decisions of the fleet scheduler
+# (skypilot_tpu/jobs/fleet.py): admissions (fair-share claim), elastic
+# gang shrinks/grow-backs, placement advice. Bounded like every
+# observability table; `xsky fleet` and tools/bench_fleet.py read it.
+
+# Newest rows kept (pruned lazily). One admission per scheduled job
+# plus a handful of elastic transitions per incident — 20k rows keep
+# days of a busy fleet inspectable.
+_MAX_FLEET_DECISIONS = 20000
+_fleet_decision_inserts = 0
+
+_FLEET_DECISION_COLS = ('ts, kind, job_id, workspace, cluster, cloud, '
+                        'region, zone, sku, score, detail')
+
+
+def record_fleet_decisions(rows: List[Dict[str, Any]],
+                           ts: Optional[float] = None) -> None:
+    """Persist fleet-scheduler decisions in ONE transaction. NEVER
+    raises — decisions are recorded from the scheduler's claim path and
+    the jobs controller's recovery paths (same contract and
+    batched-write pattern as record_workload_telemetry)."""
+    global _fleet_decision_inserts
+    if not rows:
+        return
+    ts = ts if ts is not None else time.time()
+    try:
+        conn = _get_conn()
+    except Exception:  # pylint: disable=broad-except
+        return
+    try:
+        with _lock:
+            conn.executemany(
+                f'INSERT INTO fleet_decisions ({_FLEET_DECISION_COLS}) '
+                'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)',
+                [(r.get('ts', ts), r.get('kind'), r.get('job_id'),
+                  r.get('workspace'), r.get('cluster'), r.get('cloud'),
+                  r.get('region'), r.get('zone'), r.get('sku'),
+                  r.get('score'),
+                  (json.dumps(r['detail'], default=str)
+                   if r.get('detail') else None))
+                 for r in rows])
+            # Prune on the FIRST batch too (short-lived CLI writers
+            # never reach an amortized gate — same rationale as spans).
+            _fleet_decision_inserts += len(rows)
+            if _fleet_decision_inserts == len(rows) or \
+                    _fleet_decision_inserts % 256 < len(rows):
+                conn.execute(
+                    'DELETE FROM fleet_decisions WHERE row_id <= '
+                    '(SELECT MAX(row_id) FROM fleet_decisions) - ?',
+                    (_MAX_FLEET_DECISIONS,))
+            conn.commit()
+    except Exception:  # pylint: disable=broad-except
+        try:
+            conn.rollback()
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+def get_fleet_decisions(kind: Optional[str] = None,
+                        job_id: Optional[int] = None,
+                        limit: int = 200,
+                        offset: int = 0) -> List[Dict[str, Any]]:
+    """Fleet-scheduler decisions, newest first (`xsky fleet`,
+    bench_fleet assertions)."""
+    conds, args = [], []
+    if kind is not None:
+        conds.append('kind = ?')
+        args.append(kind)
+    if job_id is not None:
+        conds.append('job_id = ?')
+        args.append(job_id)
+    query = f'SELECT {_FLEET_DECISION_COLS} FROM fleet_decisions'
+    if conds:
+        query += ' WHERE ' + ' AND '.join(conds)
+    query += ' ORDER BY row_id DESC' + _page_sql(int(limit), offset)
+    rows = _read(query, args)
+    out = []
+    for (ts, row_kind, jid, workspace, cluster, cloud, region, zone,
+         sku, score, detail) in rows:
+        try:
+            parsed = json.loads(detail) if detail else None
+        except ValueError:
+            parsed = None
+        out.append({
+            'ts': ts,
+            'kind': row_kind,
+            'job_id': jid,
+            'workspace': workspace,
+            'cluster': cluster,
+            'cloud': cloud,
+            'region': region,
+            'zone': zone,
+            'sku': sku,
+            'score': score,
+            'detail': parsed,
         })
     return out
 

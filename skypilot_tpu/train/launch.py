@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 import time
 
 from skypilot_tpu import models
@@ -22,6 +23,44 @@ from skypilot_tpu.parallel import distributed
 from skypilot_tpu.parallel import mesh as mesh_lib
 
 logger = sky_logging.init_logger(__name__)
+
+
+def elastic_generation() -> int:
+    """The gang incarnation this process runs in (0 = first launch).
+    Set by the jobs controller on every elastic shrink/grow-back and
+    relaunch resubmit."""
+    try:
+        return int(os.environ.get('XSKY_ELASTIC_GENERATION', '0') or 0)
+    except ValueError:
+        return 0
+
+
+def per_host_batch(global_batch: int, num_hosts: int) -> int:
+    """Per-host batch rows for this gang size.
+
+    Normally ``global_batch`` must divide evenly. Under an elastic
+    shrink the controller relaunches the SAME run command over fewer
+    hosts (Podracer-style: keep the survivors productive rather than
+    idle the gang), so a batch sized for the full gang may not divide —
+    inside an elastic incarnation (``XSKY_ELASTIC_GENERATION`` set) the
+    per-host batch rounds DOWN (effective global batch shrinks by the
+    remainder; logged, never silent) instead of refusing to remesh.
+    """
+    if num_hosts <= 0:
+        raise ValueError(f'num_hosts must be positive, got {num_hosts}')
+    if global_batch % num_hosts == 0:
+        return global_batch // num_hosts
+    if elastic_generation() > 0:
+        per_host = max(1, global_batch // num_hosts)
+        logger.warning(
+            f'Elastic remesh: global batch {global_batch} does not '
+            f'divide across {num_hosts} surviving hosts; running '
+            f'{per_host}/host (effective global batch '
+            f'{per_host * num_hosts}).')
+        return per_host
+    raise ValueError(
+        f'global batch {global_batch} not divisible by {num_hosts} '
+        'hosts.')
 
 
 def parse_mesh(spec: str) -> mesh_lib.MeshPlan:
@@ -108,14 +147,16 @@ def main() -> int:
     # Phase `init` BEFORE the distributed barrier: a rank wedged in
     # jax.distributed bring-up then shows a live heartbeat with stale
     # progress — the hung-rank signature `xsky top` flags.
-    telemetry.emit(phase=telemetry.PHASE_INIT)
+    telemetry.emit(phase=telemetry.PHASE_INIT,
+                   gang_size=int(os.environ.get('XSKY_NUM_HOSTS', '1')
+                                 or 1),
+                   elastic_generation=elastic_generation())
     # Compile listener BEFORE any jit: the first-step compile is
     # usually the biggest one a run ever does — it must land in the
     # per-rank profile summary's count/seconds.
     profiler.ensure_compile_listener()
     distributed.initialize()
     import jax  # after distributed init
-    import os
     if os.environ.get('JAX_PLATFORMS'):
         # Force-registered accelerator plugins (axon sitecustomize)
         # override the env var; the config knob wins (same pattern as
@@ -211,14 +252,13 @@ def main() -> int:
         from skypilot_tpu.train import data as data_lib
         paths = data_lib.expand_data_arg(args.data)
         num_hosts = jax.process_count()
-        if args.global_batch_size % num_hosts:
-            raise ValueError(
-                f'global batch {args.global_batch_size} not divisible '
-                f'by {num_hosts} hosts.')
         # Each host loads only its shard of the global batch; the
-        # host-strided epoch permutation keeps samples disjoint.
+        # host-strided epoch permutation keeps samples disjoint. Under
+        # an elastic shrink the per-host batch rounds down instead of
+        # refusing the smaller world (see per_host_batch).
         loader = data_lib.make_loader(
-            paths, batch=args.global_batch_size // num_hosts,
+            paths, batch=per_host_batch(args.global_batch_size,
+                                        num_hosts),
             seq=args.seq_len,
             seed=args.seed, workers=args.data_workers,
             host_rank=jax.process_index(),
@@ -228,13 +268,12 @@ def main() -> int:
             f'of seq {args.seq_len} ({type(loader).__name__}).')
         feed = data_lib.batches(loader, vocab_size=model.vocab_size)
 
-    if args.eval_data and args.global_batch_size % jax.process_count():
+    if args.eval_data:
         # Fail at launch, not hundreds of steps in when the first eval
         # fires (the --data path has the same guard; synthetic-train +
-        # --eval-data runs would otherwise skip it).
-        raise ValueError(
-            f'global batch {args.global_batch_size} not divisible by '
-            f'{jax.process_count()} hosts (required for --eval-data).')
+        # --eval-data runs would otherwise skip it). Elastic
+        # incarnations round down instead of failing the remesh.
+        per_host_batch(args.global_batch_size, jax.process_count())
 
     def run_eval(state) -> float:
         """Mean loss over the leading eval batches (fresh loader each
@@ -243,7 +282,8 @@ def main() -> int:
         paths = data_lib.expand_data_arg(args.eval_data)
         num_hosts = jax.process_count()
         loader = data_lib.make_loader(
-            paths, batch=args.global_batch_size // num_hosts,
+            paths, batch=per_host_batch(args.global_batch_size,
+                                        num_hosts),
             seq=args.seq_len, seed=args.seed, workers=1,
             host_rank=jax.process_index(), num_hosts=num_hosts,
             flavor=args.data_loader)

@@ -204,6 +204,33 @@ _VARS = [
     EnvVar('XSKY_JOBS_CONTROLLER_REMOTE', UNSET,
            'Run the managed-jobs controller on a controller cluster '
            '(set by the relay; empty string = forced local)'),
+    # ---- fleet scheduler / elastic gangs -----------------------------------
+    EnvVar('XSKY_FLEET_ELASTIC', '1',
+           'Set to 0 to disable elastic gang shrink/grow-back (every '
+           'lost rank then costs a full relaunch)'),
+    EnvVar('XSKY_FLEET_SHARES', UNSET,
+           "Weighted fair shares per workspace ('prod=4,research=2'; "
+           'unlisted workspaces weigh 1)'),
+    EnvVar('XSKY_FLEET_AGING_S', '300',
+           'Starvation aging: seconds of queue wait worth one '
+           'admission-priority point'),
+    EnvVar('XSKY_FLEET_SHARE_PENALTY', '1.0',
+           'Admission-score penalty per running-job-over-weight of '
+           'the workspace (fair-share strength)'),
+    EnvVar('XSKY_FLEET_DECAY_S', '1800',
+           'Placement-pressure half-life: journalled preemptions/'
+           'capacity errors decay by half each window'),
+    EnvVar('XSKY_FLEET_BLOCK_THRESHOLD', '1.0',
+           'Decayed pressure at/above which a placement is avoided '
+           '(launch blocklist, spot placer, grow-back gate)'),
+    EnvVar('XSKY_FLEET_GROWBACK_S', '60',
+           'Seconds a shrunk gang waits before each grow-back probe'),
+    EnvVar('XSKY_FLEET_MIN_SURVIVORS', '0.5',
+           'Smallest surviving fraction of the full gang worth '
+           'running shrunk (below it: full relaunch)'),
+    EnvVar('XSKY_ELASTIC_GENERATION', UNSET,
+           'Set by the jobs controller on every gang (re)submit: the '
+           'incarnation counter workloads and chaos plans key on'),
     # ---- serve -------------------------------------------------------------
     EnvVar('XSKY_SERVE_DB', '~/.xsky/serve.db',
            'Path of the serve-plane database'),

@@ -1,0 +1,549 @@
+"""Fleet scheduler tests: fair-share admission (weights, aging,
+starvation bound), placement-score decay + backfill tolerance, the
+elastic shrink/grow-back state machine, the shared-scorer consumers
+(spot placer ranking, launch blocklist), the bounded fleet_decisions
+table, gang-exclude renumbering, CLI surfaces, and the tier-1
+`tools/bench_fleet.py --smoke` subprocess gate (chaos preemption storm:
+elastic recovery must beat the full-relaunch baseline on goodput, with
+journalled, trace-linked gang_shrunk → gang_regrown)."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from skypilot_tpu.jobs import fleet
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), '..', '..'))
+
+
+@pytest.fixture
+def tmp_state(monkeypatch, tmp_path):
+    from skypilot_tpu import state
+    monkeypatch.setenv('XSKY_STATE_DB', str(tmp_path / 'state.db'))
+    state.reset_for_test()
+    yield state
+    state.reset_for_test()
+
+
+@pytest.fixture
+def tmp_jobs(monkeypatch, tmp_path):
+    from skypilot_tpu.jobs import state as jobs_state
+    monkeypatch.setenv('XSKY_JOBS_DB', str(tmp_path / 'jobs.db'))
+    yield jobs_state
+
+
+# ---- fair-share admission ---------------------------------------------------
+
+
+class TestFairShare:
+
+    def _row(self, job_id, workspace='default', priority=0, age_s=0.0,
+             now=1000.0):
+        return {'job_id': job_id, 'workspace': workspace,
+                'priority': priority, 'submitted_at': now - age_s}
+
+    def test_underserved_workspace_wins(self, monkeypatch):
+        monkeypatch.delenv('XSKY_FLEET_SHARES', raising=False)
+        waiting = [self._row(1, 'busy'), self._row(2, 'idle')]
+        picked = fleet.pick_next(waiting, {'busy': 3, 'idle': 0},
+                                 now=1000.0)
+        assert picked == 2
+
+    def test_weights_shift_the_share(self, monkeypatch):
+        # busy runs 4, idle runs 1 — but busy's weight is 8, so its
+        # usage 4/8 is BELOW idle's 1/1: busy's head wins.
+        monkeypatch.setenv('XSKY_FLEET_SHARES', 'busy=8')
+        waiting = [self._row(1, 'busy'), self._row(2, 'idle')]
+        picked = fleet.pick_next(waiting, {'busy': 4, 'idle': 1},
+                                 now=1000.0)
+        assert picked == 1
+
+    def test_priority_wins_within_workspace(self):
+        waiting = [self._row(1, priority=0), self._row(2, priority=5)]
+        assert fleet.pick_next(waiting, {}, now=1000.0) == 2
+
+    def test_fifo_tiebreak(self):
+        waiting = [self._row(2), self._row(1)]
+        assert fleet.pick_next(waiting, {}, now=1000.0) == 1
+
+    def test_aging_overcomes_priority_within_workspace(
+            self, monkeypatch):
+        """The starvation bound: a prio-0 job waiting longer than
+        (prio gap) x XSKY_FLEET_AGING_S outranks a fresh high-prio
+        head of its own workspace."""
+        monkeypatch.setenv('XSKY_FLEET_AGING_S', '10')
+        old_low = self._row(1, priority=0, age_s=51.0)   # aged +5.1
+        fresh_high = self._row(2, priority=5, age_s=0.0)
+        assert fleet.pick_next([old_low, fresh_high], {},
+                               now=1000.0) == 1
+        # One second under the bound: priority still wins.
+        young_low = self._row(1, priority=0, age_s=49.0)
+        assert fleet.pick_next([young_low, fresh_high], {},
+                               now=1000.0) == 2
+
+    def test_aging_overcomes_share_penalty_across_workspaces(
+            self, monkeypatch):
+        monkeypatch.setenv('XSKY_FLEET_AGING_S', '10')
+        monkeypatch.setenv('XSKY_FLEET_SHARE_PENALTY', '1.0')
+        # busy's head has waited: aged score 0 + 31/10 - 3 > idle's 0.
+        waiting = [self._row(1, 'busy', age_s=31.0),
+                   self._row(2, 'idle')]
+        assert fleet.pick_next(waiting, {'busy': 3}, now=1000.0) == 1
+
+    def test_shares_env_parsing(self, monkeypatch):
+        monkeypatch.setenv('XSKY_FLEET_SHARES',
+                           'prod=4, research=2,bad,junk=x,zero=0')
+        assert fleet.workspace_shares() == {'prod': 4.0,
+                                            'research': 2.0}
+
+    def test_claim_next_waiting_claims_and_records(
+            self, tmp_state, tmp_jobs, monkeypatch):
+        monkeypatch.delenv('XSKY_FLEET_SHARES', raising=False)
+        jobs_state = tmp_jobs
+        a = jobs_state.add_job('a', {}, workspace='busy')
+        b = jobs_state.add_job('b', {}, workspace='idle')
+        for jid in (a, b):
+            jobs_state.set_schedule_state(
+                jid, jobs_state.ScheduleState.WAITING)
+        # busy already holds capacity.
+        c = jobs_state.add_job('c', {}, workspace='busy')
+        jobs_state.set_schedule_state(c,
+                                      jobs_state.ScheduleState.ALIVE)
+        picked = fleet.claim_next_waiting()
+        assert picked == b
+        record = jobs_state.get_job(b)
+        assert record['schedule_state'] is \
+            jobs_state.ScheduleState.LAUNCHING
+        decisions = tmp_state.get_fleet_decisions(kind='admit')
+        assert decisions and decisions[0]['job_id'] == b
+        assert decisions[0]['workspace'] == 'idle'
+        assert decisions[0]['score'] is not None
+        # Next claim takes the remaining head.
+        assert fleet.claim_next_waiting() == a
+        assert fleet.claim_next_waiting() is None
+
+    def test_scheduler_uses_fair_share(self, tmp_state, tmp_jobs,
+                                       monkeypatch):
+        """maybe_schedule_next_jobs spawns the fair-share pick, not
+        the FIFO head."""
+        from skypilot_tpu.jobs import scheduler
+        jobs_state = tmp_jobs
+        spawned = []
+        monkeypatch.setattr(scheduler, '_spawn_controller',
+                            spawned.append)
+        monkeypatch.setenv('XSKY_JOBS_MAX_LAUNCHING', '1')
+        busy = jobs_state.add_job('busy-job', {}, workspace='busy')
+        idle = jobs_state.add_job('idle-job', {}, workspace='idle')
+        running = jobs_state.add_job('running', {}, workspace='busy')
+        jobs_state.set_schedule_state(
+            running, jobs_state.ScheduleState.ALIVE)
+        jobs_state.set_controller_pid(running, os.getpid())
+        for jid in (busy, idle):
+            jobs_state.set_schedule_state(
+                jid, jobs_state.ScheduleState.WAITING)
+        scheduler.maybe_schedule_next_jobs()
+        assert spawned == [idle]
+
+
+# ---- placement scoring ------------------------------------------------------
+
+
+class TestPlacementScore:
+
+    def _event(self, age_s, now=1000.0, **keys):
+        return {'ts': now - age_s, 'event_type': 'job.preempted',
+                'detail': keys or None}
+
+    def test_decay_halves_per_window(self):
+        now = 1000.0
+        pm = fleet.PressureMap(
+            [self._event(0, zone='z1'), self._event(60, zone='z1')],
+            now=now, half_life_s=60.0)
+        assert pm.at(zone='z1') == pytest.approx(1.5)
+        assert pm.at(zone='z2') == 0.0
+
+    def test_backfill_tolerant(self):
+        """Rows that predate structured keys (no detail / prose-only
+        detail / partial keys) score only what they carry."""
+        now = 1000.0
+        events = [
+            {'ts': now, 'event_type': 'job.preempted', 'detail': None},
+            {'ts': now, 'event_type': 'job.preempted',
+             'detail': {'cluster': 'c1'}},               # prose-only
+            {'ts': now, 'event_type': 'failover.blocked',
+             'detail': {'zone': 'z1'}},                  # partial
+            {'ts': now, 'event_type': 'failover.blocked',
+             'detail': {'cloud': 'fake', 'region': 'r1', 'zone': 'z1',
+                        'sku': 'tpu-v5e-32'}},
+        ]
+        pm = fleet.PressureMap(events, now=now, half_life_s=60.0)
+        assert pm.at(zone='z1') == pytest.approx(2.0)
+        assert pm.at(cloud='fake') == pytest.approx(1.0)
+        # Querying a field the partial event doesn't define must not
+        # drop the fully-keyed match.
+        assert pm.at(zone='z1', sku='tpu-v5e-32') == pytest.approx(2.0)
+
+    def test_zone_pressures_scores_hot_zone(self, tmp_state):
+        tmp_state.record_recovery_event(
+            'replica.preempted', scope='service/s/replica/1',
+            detail={'zone': 'z-hot', 'cloud': 'fake'})
+        pressures = fleet.zone_pressures(['z-hot', 'z-cold'])
+        assert pressures['z-hot'] > pressures['z-cold'] == 0.0
+
+    def test_zone_pressures_never_raises_without_db(self, monkeypatch,
+                                                    tmp_path):
+        from skypilot_tpu import state
+        monkeypatch.setenv('XSKY_STATE_DB',
+                           str(tmp_path / 'nested' / 'state.db'))
+        state.reset_for_test()
+        try:
+            assert fleet.zone_pressures(['b', 'a']) == \
+                {'a': 0.0, 'b': 0.0}
+        finally:
+            state.reset_for_test()
+
+    def test_spot_placer_uses_shared_scorer(self, tmp_state):
+        from skypilot_tpu.serve import spot_placer as placer_lib
+        tmp_state.record_recovery_event(
+            'job.preempted', scope='job/1',
+            detail={'zone': 'z1', 'cloud': 'fake'})
+        placer = placer_lib.SpotPlacer(['z1', 'z2'])
+        assert placer.select_zone() == 'z2'
+        # The in-memory preemptive set still applies on top.
+        placer.handle_preemption('z2')
+        assert placer.select_zone() == 'z1'
+
+    def test_placement_blocks_spot_scoped_and_capped(
+            self, tmp_state, monkeypatch):
+        from skypilot_tpu import Resources, Task
+        monkeypatch.setenv('XSKY_FLEET_BLOCK_THRESHOLD', '0.5')
+        for i in range(6):
+            tmp_state.record_recovery_event(
+                'job.preempted', scope='job/1',
+                detail={'cloud': 'fake', 'zone': f'z{i}',
+                        'sku': 'tpu-v5e-32'})
+        spot = Task('t', run='true')
+        spot.set_resources(Resources(accelerators='tpu-v5e-32',
+                                     use_spot=True))
+        blocks = fleet.placement_blocks(spot)
+        assert blocks and len(blocks) <= 4
+        for b in blocks:
+            assert b.zone is not None
+            assert (b.accelerator_args or {}).get(
+                'provisioning_model') == 'spot'
+        ondemand = Task('t', run='true')
+        ondemand.set_resources(Resources(accelerators='tpu-v5e-32'))
+        assert fleet.placement_blocks(ondemand) == []
+
+    def test_capacity_ok_after_decay(self, monkeypatch):
+        monkeypatch.setenv('XSKY_FLEET_BLOCK_THRESHOLD', '0.6')
+        now = 1000.0
+        event = {'ts': now - 30, 'event_type': 'job.gang_shrunk',
+                 'detail': {'zone': 'z1'}}
+        hot = fleet.PressureMap([event], now=now, half_life_s=60.0)
+        cold = fleet.PressureMap([event], now=now + 60,
+                                 half_life_s=60.0)
+        assert hot.at(zone='z1') >= 0.6
+        assert cold.at(zone='z1') < 0.6
+
+    def test_sku_of(self):
+        from skypilot_tpu import Resources
+        assert fleet.sku_of(
+            Resources(accelerators='tpu-v5e-32')) == 'tpu-v5e-32'
+        assert fleet.sku_of(Resources()) is None
+
+
+# ---- elastic gang state machine ---------------------------------------------
+
+
+class TestElasticGang:
+
+    def test_can_shrink_gates(self, monkeypatch):
+        gang = fleet.ElasticGang(full_hosts=4)
+        assert gang.can_shrink([2])
+        assert not gang.can_shrink([0])       # head rank must survive
+        assert not gang.can_shrink([])
+        # Floor: 4 hosts at 0.5 ⇒ at least 2 survivors.
+        assert not gang.can_shrink([1, 2, 3])
+        assert gang.can_shrink([1, 2])
+        monkeypatch.setenv('XSKY_FLEET_ELASTIC', '0')
+        assert not gang.can_shrink([2])
+        monkeypatch.delenv('XSKY_FLEET_ELASTIC')
+        assert not fleet.ElasticGang(full_hosts=1).can_shrink([0])
+
+    def test_shrink_growback_regrow_cycle(self, monkeypatch):
+        monkeypatch.setenv('XSKY_FLEET_GROWBACK_S', '10')
+        gang = fleet.ElasticGang(full_hosts=4)
+        excluded = gang.shrink([2], now=100.0)
+        assert excluded == {2}
+        assert gang.state == fleet.STATE_SHRUNK
+        assert gang.survivors == 3
+        assert gang.generation == 1
+        assert not gang.growback_due(now=105.0)
+        assert gang.growback_due(now=110.0)
+        # Deferral re-arms the probe but keeps the true shrink time.
+        gang.defer_growback(now=110.0)
+        assert not gang.growback_due(now=115.0)
+        assert gang.growback_due(now=120.0)
+        assert gang.shrunk_at == 100.0
+        gang.regrow()
+        assert gang.state == fleet.STATE_FULL
+        assert gang.generation == 2
+        assert not gang.growback_due(now=1000.0)
+
+    def test_repeated_shrink_respects_floor(self):
+        gang = fleet.ElasticGang(full_hosts=4)
+        gang.shrink([3], now=100.0)
+        # Another rank dies while shrunk: 2 survivors = floor, ok...
+        assert gang.can_shrink([2])
+        gang.shrink([2], now=101.0)
+        # ...but a third would go below it.
+        assert not gang.can_shrink([1])
+        # Re-reported already-excluded ranks never shrink twice.
+        assert not gang.can_shrink([2, 3])
+
+    def test_detail_round_trip(self):
+        gang = fleet.ElasticGang(full_hosts=4)
+        gang.shrink([1, 3], now=42.0)
+        restored = fleet.ElasticGang.from_detail(
+            json.loads(json.dumps(gang.to_detail())), full_hosts=4)
+        assert restored.excluded == {1, 3}
+        assert restored.shrunk_at == 42.0
+        assert restored.generation == 1
+        assert restored.full_hosts == 4
+        assert restored.next_probe_at == gang.next_probe_at
+
+    def test_reset_on_full_relaunch(self):
+        gang = fleet.ElasticGang(full_hosts=4)
+        gang.shrink([2])
+        gang.reset(full_hosts=8)
+        assert gang.state == fleet.STATE_FULL
+        assert gang.full_hosts == 8
+        assert gang.excluded == set()
+
+
+class TestGangExclude:
+    """The agent-side half of a shrink: exclude_hosts renumbers ranks
+    contiguously over the survivors (new world size, new coordinator
+    when needed — the jax.distributed remesh contract)."""
+
+    def _cluster(self, n=4):
+        from skypilot_tpu.provision import common as pc
+        instances = {
+            f'h{i}': pc.InstanceInfo(
+                instance_id=f'h{i}', internal_ip=f'10.0.0.{i + 1}',
+                external_ip=None, status='RUNNING',
+                tags={'node_index': '0'}, slice_id='slice-a',
+                host_index=i)
+            for i in range(n)
+        }
+        return pc.ClusterInfo(instances=instances,
+                              head_instance_id='h0',
+                              provider_name='fake')
+
+    def test_exclude_renumbers_contiguously(self):
+        from skypilot_tpu.agent import gang
+        envs = gang.build_host_envs(self._cluster(4),
+                                    exclude_hosts=[2])
+        assert len(envs) == 3
+        assert [e['XSKY_HOST_RANK'] for e in envs] == ['0', '1', '2']
+        for env in envs:
+            assert env['XSKY_NUM_HOSTS'] == '3'
+        # Survivors are hosts 0, 1, 3; the ex-host-3 is now rank 2.
+        assert envs[2]['TPU_WORKER_HOSTNAMES'].count('10.0.0.3') == 0
+        # TPU worker ids must index contiguously into the survivor-only
+        # hostnames list — not keep the provision-time host_index
+        # (ex-host-3 would claim id 3 against a 3-entry list and wedge
+        # libtpu bring-up on the shrunk incarnation).
+        assert [e['TPU_WORKER_ID'] for e in envs] == ['0', '1', '2']
+        for env in envs:
+            assert len(env['TPU_WORKER_HOSTNAMES'].split(',')) == 3
+
+    def test_exclude_empty_is_identity(self):
+        from skypilot_tpu.agent import gang
+        full = gang.build_host_envs(self._cluster(2))
+        again = gang.build_host_envs(self._cluster(2),
+                                     exclude_hosts=[])
+        assert full == again
+
+
+# ---- fleet_decisions table --------------------------------------------------
+
+
+class TestFleetDecisions:
+
+    def test_round_trip_and_filters(self, tmp_state):
+        tmp_state.record_fleet_decisions([
+            {'kind': 'admit', 'job_id': 1, 'workspace': 'w',
+             'score': 1.5, 'detail': {'priority': 2}},
+            {'kind': 'shrink', 'job_id': 1, 'cluster': 'c',
+             'zone': 'z1', 'sku': 'tpu-v5e-32'},
+        ])
+        rows = tmp_state.get_fleet_decisions()
+        assert [r['kind'] for r in rows] == ['shrink', 'admit']
+        assert rows[1]['detail'] == {'priority': 2}
+        assert tmp_state.get_fleet_decisions(kind='admit')[0][
+            'score'] == 1.5
+        assert tmp_state.get_fleet_decisions(job_id=1, limit=1,
+                                             offset=1)[0][
+            'kind'] == 'admit'
+
+    def test_retention_prune(self, tmp_state, monkeypatch):
+        monkeypatch.setattr(tmp_state, '_MAX_FLEET_DECISIONS', 5)
+        # Fresh process-equivalent: the first-batch prune keys on the
+        # process-local insert counter.
+        monkeypatch.setattr(tmp_state, '_fleet_decision_inserts', 0)
+        # One batch (prune runs on the FIRST batch, like every bounded
+        # table — short-lived CLI writers never reach an amortized
+        # gate): only the newest 5 survive.
+        tmp_state.record_fleet_decisions(
+            [{'kind': f'k{i}'} for i in range(12)])
+        rows = tmp_state.get_fleet_decisions(limit=100)
+        assert len(rows) == 5
+        assert rows[0]['kind'] == 'k11'
+        assert rows[-1]['kind'] == 'k7'
+
+    def test_never_raises(self, tmp_state, monkeypatch):
+        monkeypatch.setattr(tmp_state, '_get_conn',
+                            lambda: (_ for _ in ()).throw(
+                                RuntimeError('db down')))
+        tmp_state.record_fleet_decisions([{'kind': 'admit'}])
+        fleet.record_decision('admit', job_id=1)
+
+
+# ---- CLI surfaces -----------------------------------------------------------
+
+
+class TestCLI:
+
+    def test_fleet_command_json(self, tmp_state, tmp_jobs):
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        jid = tmp_jobs.add_job('q', {}, workspace='w', priority=3)
+        tmp_jobs.set_schedule_state(
+            jid, tmp_jobs.ScheduleState.WAITING)
+        tmp_state.record_fleet_decisions(
+            [{'kind': 'shrink', 'job_id': jid, 'zone': 'z1',
+              'score': 0.9}])
+        tmp_state.record_recovery_event(
+            'job.preempted', scope=f'job/{jid}',
+            detail={'zone': 'z1', 'cloud': 'fake'})
+        result = CliRunner().invoke(cli_mod.cli, ['fleet', '--json'])
+        assert result.exit_code == 0, result.output
+        payload = json.loads(result.output)
+        assert payload['queue'].get('waiting') == 1
+        assert any(w['workspace'] == 'w' and w['waiting'] == 1
+                   for w in payload['workspaces'])
+        assert any(p.get('zone') == 'z1' for p in payload['pressure'])
+        assert payload['decisions'][0]['kind'] == 'shrink'
+
+    def test_jobs_queue_columns(self, tmp_state, tmp_jobs):
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        jid = tmp_jobs.add_job('shrunky', {}, priority=7)
+        tmp_jobs.set_status(jid, tmp_jobs.ManagedJobStatus.RUNNING)
+        tmp_jobs.set_gang_state(jid, 'SHRUNK',
+                                {'full_hosts': 4, 'excluded': [2]})
+        result = CliRunner().invoke(cli_mod.cli, ['jobs', 'queue'])
+        assert result.exit_code == 0, result.output
+        assert 'PRIO' in result.output and 'GANG' in result.output
+        row = next(l for l in result.output.splitlines()
+                   if 'shrunky' in l)
+        assert ' 7 ' in row
+        assert '3/4' in row
+
+    def test_metrics_fleet_gauges(self, tmp_state, tmp_jobs):
+        from skypilot_tpu.server import metrics as server_metrics
+        jid = tmp_jobs.add_job('g', {})
+        tmp_jobs.set_schedule_state(jid,
+                                    tmp_jobs.ScheduleState.WAITING)
+        tmp_jobs.set_status(jid, tmp_jobs.ManagedJobStatus.RUNNING)
+        tmp_jobs.set_gang_state(jid, 'SHRUNK', {'full_hosts': 2,
+                                                'excluded': [1]})
+        text = server_metrics.render()
+        assert 'xsky_fleet_queue_depth{state="waiting"} 1' in text
+        assert 'xsky_fleet_gangs_shrunk 1' in text
+
+
+# ---- priority plumbing ------------------------------------------------------
+
+
+class TestPriorityPlumbing:
+
+    def test_add_job_persists_priority(self, tmp_jobs):
+        jid = tmp_jobs.add_job('p', {}, priority=9)
+        assert tmp_jobs.get_job(jid)['priority'] == 9
+        assert tmp_jobs.get_waiting_jobs() == []   # not WAITING yet
+        tmp_jobs.set_schedule_state(jid,
+                                    tmp_jobs.ScheduleState.WAITING)
+        rows = tmp_jobs.get_waiting_jobs()
+        assert rows[0]['priority'] == 9
+
+    def test_jobs_launch_payload_accepts_priority(self):
+        from skypilot_tpu.server import payloads
+        run, kwargs = payloads._VERBS['jobs.launch'](  # pylint: disable=protected-access
+            {'task': {'name': 't', 'run': 'true'}, 'name': 't',
+             'priority': 4})
+        del run
+        assert kwargs['priority'] == 4
+
+
+# ---- elastic batch accommodation (train/launch.py) --------------------------
+
+
+class TestElasticBatch:
+
+    def test_divisible_unchanged(self, monkeypatch):
+        from skypilot_tpu.train import launch as train_launch
+        monkeypatch.delenv('XSKY_ELASTIC_GENERATION', raising=False)
+        assert train_launch.per_host_batch(8, 4) == 2
+
+    def test_non_divisible_raises_outside_elastic(self, monkeypatch):
+        from skypilot_tpu.train import launch as train_launch
+        monkeypatch.delenv('XSKY_ELASTIC_GENERATION', raising=False)
+        with pytest.raises(ValueError):
+            train_launch.per_host_batch(8, 3)
+
+    def test_elastic_rounds_down(self, monkeypatch):
+        from skypilot_tpu.train import launch as train_launch
+        monkeypatch.setenv('XSKY_ELASTIC_GENERATION', '1')
+        assert train_launch.per_host_batch(8, 3) == 2
+
+
+# ---- tier-1 acceptance: the chaos preemption storm gate ---------------------
+
+
+class TestBenchFleetSmoke:
+    """Tier-1 acceptance (ISSUE 10): under the same chaos preemption
+    storm (stalled rank + provisioning capacity drought) on the fake
+    cloud, elastic fleet recovery must achieve strictly higher goodput
+    than the forced full-relaunch baseline, with journalled,
+    trace-linked job.gang_shrunk → job.gang_regrown transitions and a
+    scored grow-back decision."""
+
+    def test_bench_fleet_smoke_gate(self):
+        env = dict(os.environ)
+        env['JAX_PLATFORMS'] = 'cpu'
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, 'tools', 'bench_fleet.py'),
+             '--smoke'],
+            capture_output=True, text=True, timeout=420, env=env,
+            check=False)
+        line = next((l for l in proc.stdout.splitlines()
+                     if l.startswith('{')), '{}')
+        result = json.loads(line)
+        assert proc.returncode == 0, \
+            f'bench_fleet gate failed:\n{proc.stdout}\n{proc.stderr}'
+        assert result['pass'] is True
+        assert all(result['gates'].values()), result['gates']
+        assert result['elastic']['goodput'] > \
+            result['baseline']['goodput']
+        assert result['shrink_latency_s'] > 0
+        assert result['regrow_after_s'] > 0

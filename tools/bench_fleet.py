@@ -1,0 +1,336 @@
+#!/usr/bin/env python3
+"""Chaos preemption storm: elastic fleet recovery vs full relaunch.
+
+The PR 10 acceptance gate. Runs the SAME storm twice on the fake
+cloud — a 4-host spot gang whose rank 2 is chaos-stalled in its first
+incarnation (``telemetry.stall`` keyed on the elastic generation), with
+``CapacityError`` injected into every post-launch provisioning attempt
+(the drought that makes relaunching expensive) — in two isolated arm
+subprocesses:
+
+  * **elastic** (``XSKY_FLEET_ELASTIC=1``): the jobs controller cancels
+    the cluster job and resubmits over the 3 surviving hosts (no
+    teardown, no provisioning — the capacity storm never fires), then
+    grows back to the full gang once the journalled placement pressure
+    decays below the block threshold.
+  * **baseline** (``XSKY_FLEET_ELASTIC=0``): today's path — teardown,
+    reprovision (eating the injected capacity errors), resubmit; zero
+    ranks productive throughout.
+
+The workload is LONG-RUNNING (a training job does not finish inside a
+recovery incident); each arm measures a fixed WINDOW of wall time,
+then releases the gang via a stop marker and computes **chip-weighted
+goodput** from the workload-telemetry table: per-rank productive step
+time (final ``step × step_time_ema`` of each incarnation, incarnations
+split by the sample's own ``started_ts``) summed over incarnations,
+divided by ``full_gang × window``. Gates:
+
+  * goodput(elastic) strictly > goodput(baseline);
+  * the elastic arm's journal holds ``job.gang_shrunk`` AND
+    ``job.gang_regrown``, both trace-linked (non-null trace_id);
+  * the grow decision in ``fleet_decisions`` carries the decayed
+    placement score that admitted it.
+
+Prints ONE JSON line; exit 1 on any gate failure. ``--smoke`` (short
+window) is the tier-1 gate run by tests/unit_tests/test_fleet.py.
+
+Usage:
+    python tools/bench_fleet.py [--smoke] [--window S] [--step-s S]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+_HOSTS = 4          # tpu-v5e-32 on the fake catalog = 4 hosts
+_VICTIM_RANK = 2    # never the head (rank 0 cannot shrink away)
+
+
+def _workload_script(path: str, marker: str, step_s: float) -> None:
+    """The gang workload: an effectively-endless telemetry-emitting
+    step loop (every incarnation restarts from step 0 — checkpoint-free,
+    exactly the work a relaunch loses and a shrink preserves). Exits
+    cleanly once the bench's stop marker appears (fake-cloud hosts
+    share the local filesystem), so the measurement window — not the
+    workload length — bounds the run."""
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(f'''
+import os, sys, time
+sys.path.insert(0, {json.dumps(_REPO_ROOT)})
+from skypilot_tpu.agent import telemetry
+for i in range(1000000):
+    if os.path.exists({json.dumps(marker)}):
+        break
+    telemetry.emit(phase='step', step=i, step_time_s={step_s})
+    time.sleep({step_s})
+telemetry.emit(phase='idle')
+''')
+
+
+def _chaos_plan(path: str) -> None:
+    """One plan for BOTH arms (fairness): stall rank 2's emit in
+    generation 0 only, and fail provisioning attempts after the initial
+    launch with CapacityError (6 attempts, 1.5 s each — a capacity
+    drought; the failover engine walks the whole spot zone ladder and
+    into on-demand before an attempt lands. This is the storm the
+    baseline's relaunch must provision through; the elastic arm never
+    reprovisions — shrink and grow-back resubmit over the healthy
+    cluster — so the same rules simply never fire there)."""
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump({'points': {
+            'telemetry.stall': {
+                'match': {'rank': _VICTIM_RANK, 'generation': '0'},
+                'skip_first': 3,
+            },
+            'failover.wait_instances': {
+                'skip_first': 1,   # the arm's initial launch succeeds
+                'first_n': 6,
+                'error': 'CapacityError',
+                'latency_s': 1.5,
+            },
+        }}, f)
+
+
+# ---- one arm (runs in its own subprocess with isolated state) --------------
+
+
+def _productive_rank_seconds(state_lib, cluster: str) -> float:
+    """Σ over (rank, incarnation) of final step × step-time EMA.
+
+    Incarnations are split by the sample's own ``started_ts`` (process
+    start), NOT by cluster job id — a relaunched cluster's job ids
+    restart at 1 and would merge incarnations.
+    """
+    rows = state_lib.get_workload_telemetry(cluster=cluster,
+                                            latest_only=False,
+                                            limit=20000)
+    best = {}
+    for r in rows:
+        if r.get('step') is None or not r.get('step_time_ema_s'):
+            continue
+        key = (r['rank'], round(r.get('started_ts') or 0.0, 1))
+        value = r['step'] * r['step_time_ema_s']
+        if value > best.get(key, 0.0):
+            best[key] = value
+    return sum(best.values())
+
+
+def run_arm(arm: str, window_s: float, step_s: float,
+            out_path: str) -> int:
+    from skypilot_tpu import Resources, Task
+    from skypilot_tpu import check as check_lib
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu.jobs import controller as controller_lib
+    from skypilot_tpu.jobs import scheduler as jobs_scheduler
+    from skypilot_tpu.jobs import state as jobs_state
+
+    check_lib.set_enabled_clouds_for_test(['fake'])
+    scratch = tempfile.mkdtemp(prefix='xsky-fleet-')
+    workload = os.path.join(scratch, 'workload.py')
+    marker = os.path.join(scratch, 'stop-marker')
+    _workload_script(workload, marker, step_s)
+
+    task = Task('fleet-storm', run=f'{sys.executable} {workload}')
+    task.set_resources(Resources(accelerators=f'tpu-v5e-{_HOSTS * 8}',
+                                 use_spot=True))
+    job_id = jobs_state.add_job('fleet-storm',
+                                Task.chain_to_config([task]))
+    jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.SUBMITTED)
+    jobs_state.set_schedule_state(job_id,
+                                  jobs_state.ScheduleState.LAUNCHING)
+    jobs_state.set_controller_pid(job_id, os.getpid())
+    cluster = f'xsky-jobs-{job_id}'
+
+    def run_controller():
+        try:
+            controller_lib.JobsController(job_id).run()
+        finally:
+            jobs_scheduler.job_done(job_id)
+
+    thread = threading.Thread(target=run_controller, daemon=True,
+                              name='xsky-fleet-bench-controller')
+    # The window opens when the first rank reports a step (launch
+    # overhead is identical across arms and not what the gate
+    # measures), bounded by a bring-up timeout.
+    thread.start()
+    bringup_deadline = time.time() + 120
+    window_start = None
+    while time.time() < bringup_deadline and window_start is None:
+        if _productive_rank_seconds(state_lib, cluster) > 0:
+            window_start = time.time()
+            break
+        time.sleep(0.2)
+    if window_start is not None:
+        while time.time() - window_start < window_s and \
+                thread.is_alive():
+            time.sleep(0.2)
+    # Measure AT the window edge, then release the gang.
+    productive = _productive_rank_seconds(state_lib, cluster)
+    goodput = (productive / (_HOSTS * window_s)
+               if window_start is not None else 0.0)
+    with open(marker, 'w', encoding='utf-8') as f:
+        f.write('stop')
+    thread.join(timeout=120)
+    wedged = thread.is_alive()
+
+    record = jobs_state.get_job(job_id) or {}
+    status = record.get('status')
+    events = state_lib.get_recovery_events(scope=f'job/{job_id}',
+                                           limit=200)
+    grow_decisions = state_lib.get_fleet_decisions(kind='grow',
+                                                   job_id=job_id)
+    result = {
+        'arm': arm,
+        'status': getattr(status, 'value', str(status)),
+        'wedged': wedged,
+        'window_s': window_s,
+        'window_opened': window_start is not None,
+        'productive_rank_s': round(productive, 2),
+        'goodput': round(goodput, 4),
+        'recovery_count': record.get('recovery_count') or 0,
+        'events': [{'type': e['event_type'],
+                    'latency_s': e['latency_s'],
+                    'trace_id': e['trace_id'],
+                    'detail': e['detail']} for e in events],
+        'grow_decisions': grow_decisions,
+    }
+    with open(out_path, 'w', encoding='utf-8') as f:
+        json.dump(result, f)
+    ok = (not wedged and
+          status == jobs_state.ManagedJobStatus.SUCCEEDED)
+    return 0 if ok else 1
+
+
+# ---- orchestration ---------------------------------------------------------
+
+
+def _arm_env(arm: str, base_dir: str, plan: str) -> dict:
+    env = dict(os.environ)
+    env.update({
+        'XSKY_ENABLE_FAKE_CLOUD': '1',
+        'XSKY_FAKE_CLOUD_DIR': os.path.join(base_dir, 'fake_cloud'),
+        'XSKY_STATE_DB': os.path.join(base_dir, 'state.db'),
+        'XSKY_JOBS_DB': os.path.join(base_dir, 'jobs.db'),
+        'XSKY_JOBS_LOG_DIR': os.path.join(base_dir, 'jobs_logs'),
+        'XSKY_CHAOS_PLAN': plan,
+        'JAX_PLATFORMS': 'cpu',
+        # Fast detection: spool writes every 0.1 s, pulls every 0.4 s,
+        # a rank is HUNG after 1 s without progress (hb threshold stays
+        # high — the drill is a hung rank, not a dead one).
+        'XSKY_TELEMETRY_INTERVAL_S': '0.1',
+        'XSKY_TELEMETRY_PULL_INTERVAL_S': '0.4',
+        'XSKY_TELEMETRY_PROGRESS_STALE_S': '1.0',
+        'XSKY_TELEMETRY_HB_STALE_S': '30',
+        'XSKY_JOBS_POLL_INTERVAL': '0.3',
+        # Fleet: probe grow-back every second; the shrink's own
+        # journalled pressure (weight 1.0) gates it until one ~6 s
+        # half-life decays it under the 0.5 threshold — "capacity
+        # returned", scored, not timed — so the shrunk gang runs long
+        # enough to amortize the resubmit it paid.
+        'XSKY_FLEET_GROWBACK_S': '1.0',
+        'XSKY_FLEET_DECAY_S': '6.0',
+        'XSKY_FLEET_BLOCK_THRESHOLD': '0.5',
+        'XSKY_FLEET_MIN_SURVIVORS': '0.5',
+        'XSKY_FLEET_ELASTIC': '1' if arm == 'elastic' else '0',
+    })
+    return env
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--smoke', action='store_true',
+                        help='Short window (the tier-1 gate).')
+    parser.add_argument('--window', type=float, default=None,
+                        help='Measurement window per arm, seconds.')
+    parser.add_argument('--step-s', type=float, default=0.1)
+    parser.add_argument('--run-arm', default=None,
+                        help='(internal) run one arm in this process')
+    parser.add_argument('--out', default=None,
+                        help='(internal) arm result JSON path')
+    args = parser.parse_args()
+    window = args.window if args.window is not None else (
+        18.0 if args.smoke else 40.0)
+
+    if args.run_arm:
+        return run_arm(args.run_arm, window, args.step_s, args.out)
+
+    results = {}
+    arm_rcs = {}
+    with tempfile.TemporaryDirectory(prefix='xsky-bench-fleet-') as tmp:
+        plan = os.path.join(tmp, 'storm.json')
+        _chaos_plan(plan)
+        for arm in ('elastic', 'baseline'):
+            base = os.path.join(tmp, arm)
+            os.makedirs(base, exist_ok=True)
+            out = os.path.join(base, 'result.json')
+            argv = [sys.executable, os.path.abspath(__file__),
+                    '--run-arm', arm, '--window', str(window),
+                    '--step-s', str(args.step_s), '--out', out]
+            proc = subprocess.run(argv, env=_arm_env(arm, base, plan),
+                                  capture_output=True, text=True,
+                                  timeout=420, check=False)
+            arm_rcs[arm] = proc.returncode
+            try:
+                with open(out, encoding='utf-8') as f:
+                    results[arm] = json.load(f)
+            except (OSError, ValueError):
+                results[arm] = {'arm': arm, 'goodput': 0.0,
+                                'events': [],
+                                'error': (proc.stderr or '')[-2000:]}
+
+    elastic, baseline = results['elastic'], results['baseline']
+    etypes = {e['type']: e for e in elastic.get('events', ())}
+    shrunk = etypes.get('job.gang_shrunk')
+    regrown = etypes.get('job.gang_regrown')
+    gates = {
+        'arms_succeeded': arm_rcs == {'elastic': 0, 'baseline': 0},
+        'goodput_elastic_gt_baseline':
+            elastic.get('goodput', 0) > baseline.get('goodput', 0),
+        'gang_shrunk_journalled': shrunk is not None,
+        'gang_regrown_journalled': regrown is not None,
+        'shrink_trace_linked': bool(shrunk and shrunk.get('trace_id')),
+        'regrow_trace_linked': bool(regrown and
+                                    regrown.get('trace_id')),
+        'grow_decision_scored': any(
+            d.get('score') is not None
+            for d in elastic.get('grow_decisions', ())),
+        'baseline_relaunched': any(
+            e['type'] == 'job.recovered'
+            for e in baseline.get('events', ())),
+    }
+    ok = all(gates.values())
+    print(json.dumps({
+        'metric': 'fleet_elastic_vs_relaunch_goodput',
+        'window_s': window,
+        'hosts': _HOSTS,
+        'elastic': {k: elastic.get(k) for k in
+                    ('status', 'productive_rank_s',
+                     'goodput', 'recovery_count')},
+        'baseline': {k: baseline.get(k) for k in
+                     ('status', 'productive_rank_s',
+                      'goodput', 'recovery_count')},
+        'goodput_delta': round(
+            elastic.get('goodput', 0) - baseline.get('goodput', 0), 4),
+        'shrink_latency_s': shrunk and shrunk.get('latency_s'),
+        'regrow_after_s': regrown and regrown.get('latency_s'),
+        'gates': gates,
+        'pass': ok,
+    }))
+    if not ok:
+        for arm in ('elastic', 'baseline'):
+            print(json.dumps({'arm_debug': results[arm]}),
+                  file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -154,6 +154,12 @@ class ChaosCoverageRule(engine.Rule):
         'skypilot_tpu/utils/chaos.py',
     })
     RETRY_CALLEES = frozenset({'_try_resources', '_try_zone'})
+    # Elastic gang recovery paths (jobs/controller.py): shrink and
+    # grow-back each have a fallback arm (full relaunch / stay shrunk)
+    # that only a fault plan can force — so each body must carry its
+    # own chaos point (fleet.shrink / fleet.grow_back) or the retry
+    # path is untestable by construction.
+    ELASTIC_FUNCS = frozenset({'_try_shrink', '_maybe_grow_back'})
 
     def applies_to(self, rel_path: str) -> bool:
         return rel_path.startswith('skypilot_tpu/') and \
@@ -194,6 +200,22 @@ class ChaosCoverageRule(engine.Rule):
                 'failover retry loop has no chaos.inject point (in '
                 'its body or an attempt helper it calls) — fault '
                 'plans cannot preempt an attempt here')
+        # Elastic shrink/grow-back retry paths: the named functions
+        # must contain a chaos point so fault plans can force their
+        # fallback arms.
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if node.name not in self.ELASTIC_FUNCS:
+                continue
+            if self._has_inject(node):
+                continue
+            ctx.report(
+                self.id, node.lineno,
+                f'elastic recovery path {node.name} has no '
+                'chaos.inject point — fault plans cannot force its '
+                'fallback arm')
 
     @staticmethod
     def _is_retry_transient(node: ast.Call) -> bool:

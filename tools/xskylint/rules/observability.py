@@ -137,10 +137,11 @@ class RetentionBoundRule(engine.Rule):
         'workload_telemetry': '_MAX_WORKLOAD_TELEMETRY',
         'profiles': '_MAX_PROFILES',
         'serve_slo': '_MAX_SERVE_SLO',
+        'fleet_decisions': '_MAX_FLEET_DECISIONS',
     }
     # CREATE TABLE names matching this are observability tables.
     OBSERVABILITY_RE = re.compile(
-        r'events|spans|telemetry|profiles|slo')
+        r'events|spans|telemetry|profiles|slo|decisions')
     CREATE_RE = re.compile(r'CREATE TABLE IF NOT EXISTS (\w+)')
 
     def applies_to(self, rel_path: str) -> bool:
