@@ -1,0 +1,713 @@
+"""Goodput attribution ledger: every wall-clock second, by cause.
+
+`telemetry.goodput_for_cluster` answers *how much* of a job's wall time
+was productive; this module answers *where the rest went*. The fold
+attributes every second of a job's lifetime to exactly one category —
+
+  ===================  =======================================================
+  category             meaning
+  ===================  =======================================================
+  ``queue_wait``       admission queue (the ``fleet.queue_wait`` span)
+  ``provision``        cloud provisioning incl. failover retries
+  ``setup_bootstrap``  mounts, runtime bootstrap, setup, workdir/file sync
+  ``init_barrier``     ranks up but pre-first-step (jax.distributed, compile)
+  ``productive``       steps that advanced NEW work
+  ``stalled``          a rank flagged hung/dead by the telemetry verdicts
+  ``restart_replay``   productive time RE-DONE below the prior incarnation's
+                       max committed step (the no-checkpoint tax)
+  ``shrunk_capacity``  chips missing while a gang runs elastically shrunk
+  ``recovery``         journalled recovery work not covered by a finer span
+  ``idle``             declared no-work (drained replica, finished run)
+  ``unattributed``     no plane left evidence (the honesty bucket)
+  ===================  =======================================================
+
+— chip-weighted across **elastic incarnations** (arxiv 2502.06982's
+fleet decomposition): an incarnation running m of N ranks contributes
+m/N of each second to its per-rank categories and the missing
+(N−m)/N to ``shrunk_capacity`` (inside a journalled shrink window)
+or to the control-plane attribution.
+
+The fold is a NEVER-RAISE pure read over data the planes already
+record — nothing new is measured:
+
+  * liveness leases (PR 2)       → the job's wall-clock origin;
+  * telemetry history (PR 5/10)  → per-rank pull rows split into
+    incarnations by each sample's own ``started_ts``
+    (:func:`telemetry.split_incarnations` — the same split
+    ``tools/bench_fleet.py`` uses, so bench and runtime agree);
+  * recovery journal (PR 1/10)   → recovery windows, elastic
+    shrink/regrow windows with their excluded-rank fractions;
+  * trace spans (PR 4)           → queue-wait/provision/bootstrap
+    windows for the seconds no rank was alive to report.
+
+``restart_replay`` is computed from the workload-declared
+``resume_step`` (emitted at init; absent ⇒ the incarnation restarted
+from step 0): steps executed at-or-below the prior incarnations' max
+committed step are re-bought work. With no checkpointing every
+relaunch rebuys all prior progress — the number the async-checkpoint
+arc must drive down.
+
+Rolled-up ledgers persist into the bounded ``goodput_ledger`` state
+table (one ``kind='job'`` roll-up + one ``kind='incarnation'`` row per
+incarnation per fold) from the jobs controller's monitor loop, rate
+limited by ``XSKY_GOODPUT_RECORD_INTERVAL_S``. Surfaces: ``xsky
+goodput``, the ``xsky top`` summary line, and the
+``xsky_goodput_loss_seconds_total{cluster,cause}`` scrape counters.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+QUEUE_WAIT = 'queue_wait'
+PROVISION = 'provision'
+SETUP_BOOTSTRAP = 'setup_bootstrap'
+INIT_BARRIER = 'init_barrier'
+PRODUCTIVE = 'productive'
+STALLED = 'stalled'
+RESTART_REPLAY = 'restart_replay'
+SHRUNK_CAPACITY = 'shrunk_capacity'
+RECOVERY = 'recovery'
+IDLE = 'idle'
+UNATTRIBUTED = 'unattributed'
+
+CATEGORIES = (QUEUE_WAIT, PROVISION, SETUP_BOOTSTRAP, INIT_BARRIER,
+              PRODUCTIVE, STALLED, RESTART_REPLAY, SHRUNK_CAPACITY,
+              RECOVERY, IDLE, UNATTRIBUTED)
+# Loss = everything that was neither new work nor declared no-work.
+LOSS_CATEGORIES = tuple(c for c in CATEGORIES
+                        if c not in (PRODUCTIVE, IDLE))
+
+ENV_RECORD_INTERVAL = 'XSKY_GOODPUT_RECORD_INTERVAL_S'
+ENV_HISTORY_ROWS = 'XSKY_GOODPUT_HISTORY_ROWS'
+
+# Controller-side fold cadence. The fold reads (not scans) four bounded
+# tables; at the default 30 s it amortizes to well under 2 % of a 2 s
+# controller tick (gated by `tools/bench_fleet.py --decompose`).
+_DEFAULT_RECORD_INTERVAL_S = 30.0
+# Telemetry-history rows one fold consumes (the table's own retention
+# bound; a fold can never see more anyway).
+_DEFAULT_HISTORY_ROWS = 20000
+
+# Span name → category for the seconds no rank was alive to report.
+# Priority is the tuple order below: a queue-wait second inside a
+# recovery window is queue wait, not generic recovery.
+_SPAN_CATEGORIES: Dict[str, str] = {
+    'fleet.queue_wait': QUEUE_WAIT,
+    'backend.provision': PROVISION,
+    'failover.provision': PROVISION,
+    'backend.mount': SETUP_BOOTSTRAP,
+    'backend.bootstrap': SETUP_BOOTSTRAP,
+    'backend.docker_init': SETUP_BOOTSTRAP,
+    'backend.setup': SETUP_BOOTSTRAP,
+    'backend.sync_workdir': SETUP_BOOTSTRAP,
+    'backend.file_mounts': SETUP_BOOTSTRAP,
+    'backend.storage_mount': SETUP_BOOTSTRAP,
+    'backend.submit': SETUP_BOOTSTRAP,
+    'backend.resubmit': RECOVERY,
+    'jobs.stall_recover': RECOVERY,
+    'jobs.shrink_gang': RECOVERY,
+    'jobs.grow_gang': RECOVERY,
+    'jobs.recover': RECOVERY,
+}
+_SPAN_PRIORITY = (QUEUE_WAIT, PROVISION, SETUP_BOOTSTRAP, RECOVERY)
+
+# Journal events that CLOSE a shrink window (capacity restored or the
+# whole gang relaunched).
+_SHRINK_CLOSERS = ('job.gang_regrown', 'job.recovered', 'job.restarted')
+# Journal events whose latency_s measures a recovery window ending at
+# the event's own timestamp.
+_RECOVERY_EVENTS = ('job.recovered', 'job.restarted', 'job.gang_shrunk',
+                    'job.gang_regrown')
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def record_interval_s() -> float:
+    return _env_float(ENV_RECORD_INTERVAL, _DEFAULT_RECORD_INTERVAL_S)
+
+
+def history_rows() -> int:
+    return int(_env_float(ENV_HISTORY_ROWS, _DEFAULT_HISTORY_ROWS))
+
+
+def _job_id_for_cluster(cluster: str) -> Optional[int]:
+    prefix = 'xsky-jobs-'
+    if cluster.startswith(prefix) and cluster[len(prefix):].isdigit():
+        return int(cluster[len(prefix):])
+    return None
+
+
+def empty_ledger(cluster: str) -> Dict[str, Any]:
+    """Shape-compatible empty answer (CLI/scrape callers read the
+    keys): attribution is observability, never an outage."""
+    return {
+        'cluster': cluster,
+        'job_id': None,
+        'window': None,
+        'wall_s': 0.0,
+        'full_ranks': 0,
+        'incarnations': [],
+        'totals': {c: 0.0 for c in CATEGORIES},
+        'productive_s': 0.0,
+        'loss_s': 0.0,
+        'loss_by_cause': {},
+        'goodput': None,
+        'attributed_s': 0.0,
+    }
+
+
+# ---- interval helpers -------------------------------------------------------
+
+
+def _overlap(a0: float, a1: float, b0: float, b1: float) -> float:
+    return max(0.0, min(a1, b1) - max(a0, b0))
+
+
+def _covering(intervals: List[Tuple[float, float]], t: float) -> bool:
+    return any(lo <= t < hi for lo, hi in intervals)
+
+
+# ---- the fold ---------------------------------------------------------------
+
+
+class _Fold:
+    """One ledger computation. Split out of :func:`build_ledger` so the
+    never-raise wrapper stays trivially checkable."""
+
+    def __init__(self, cluster: str, now: float,
+                 window: Optional[Tuple[float, float]]) -> None:
+        self.cluster = cluster
+        self.now = now
+        self.explicit_window = window
+        self.job_id = _job_id_for_cluster(cluster)
+        self.scope = (f'job/{self.job_id}'
+                      if self.job_id is not None else None)
+
+    # -- data pulls (each degrades to empty: a missing plane costs its
+    # -- categories, never the fold) --
+
+    def _telemetry_rows(self) -> List[Dict[str, Any]]:
+        try:
+            from skypilot_tpu import state
+            return state.get_workload_telemetry(
+                cluster=self.cluster, latest_only=False,
+                limit=history_rows())
+        except Exception:  # pylint: disable=broad-except
+            return []
+
+    def _journal(self) -> List[Dict[str, Any]]:
+        if self.scope is None:
+            return []
+        try:
+            from skypilot_tpu import state
+            return state.get_recovery_events(scope=self.scope,
+                                             limit=1000)
+        except Exception:  # pylint: disable=broad-except
+            return []
+
+    def _lease_started(self) -> Optional[float]:
+        if self.scope is None:
+            return None
+        try:
+            from skypilot_tpu import state
+            lease = state.get_lease(self.scope)
+            if lease is not None:
+                return lease.get('started_at')
+        except Exception:  # pylint: disable=broad-except
+            pass
+        return None
+
+    def _spans(self, since: float) -> Dict[str, List[Tuple[float, float]]]:
+        """Category → control-plane windows, for this cluster/job
+        only. In-process spans are flushed first so a fold right after
+        the activity it attributes sees it."""
+        out: Dict[str, List[Tuple[float, float]]] = {}
+        try:
+            from skypilot_tpu import state
+            from skypilot_tpu.utils import tracing
+            tracing.flush()
+            rows = state.get_spans_by_name(
+                list(_SPAN_CATEGORIES), since=since, limit=4000)
+        except Exception:  # pylint: disable=broad-except
+            return out
+        for row in rows:
+            attrs = row.get('attrs') or {}
+            if not (attrs.get('cluster') == self.cluster or
+                    (self.job_id is not None and
+                     attrs.get('job') == self.job_id)):
+                continue
+            start, end = row.get('start_ts'), row.get('end_ts')
+            if start is None or end is None or end <= start:
+                continue
+            category = _SPAN_CATEGORIES[row['name']]
+            out.setdefault(category, []).append((start, end))
+        return out
+
+    # -- window bookkeeping --
+
+    def _shrink_windows(self, events, wall_end: float
+                        ) -> List[Tuple[float, float, float]]:
+        """[(start, end, missing_fraction)] from the elastic journal.
+        Fractions are backfill-tolerant: a shrink row without
+        excluded/survivors detail scores nothing."""
+        windows = []
+        open_at: Optional[float] = None
+        frac = 0.0
+        for event in events:
+            if event['event_type'] == 'job.gang_shrunk':
+                detail = event.get('detail') or {}
+                excluded = detail.get('excluded') or []
+                survivors = detail.get('survivors')
+                total = (len(excluded) + survivors
+                         if survivors is not None else 0)
+                open_at = event['ts']
+                frac = len(excluded) / total if total else 0.0
+            elif event['event_type'] in _SHRINK_CLOSERS and \
+                    open_at is not None:
+                if frac > 0:
+                    windows.append((open_at, event['ts'], frac))
+                open_at = None
+        if open_at is not None and frac > 0:
+            windows.append((open_at, wall_end, frac))
+        return windows
+
+    def _recovery_windows(self, events) -> List[Tuple[float, float]]:
+        return [(e['ts'] - e['latency_s'], e['ts']) for e in events
+                if e['event_type'] in _RECOVERY_EVENTS
+                and e.get('latency_s')]
+
+    # -- per-rank attribution (L1) --
+
+    @staticmethod
+    def _resume_step(rank_rows: List[Dict[str, Any]],
+                     first_incarnation: bool) -> int:
+        """The incarnation's declared resume point. Absent ⇒ restarted
+        from 0 — exactly the no-checkpoint case restart_replay must
+        charge for (the first incarnation has nothing to replay)."""
+        del first_incarnation
+        for row in rank_rows:
+            if row.get('resume_step') is not None:
+                return int(row['resume_step'])
+        return 0
+
+    def _walk_rank(self, rank_rows, inc_seconds, w0, w1, prior_max,
+                   resume, weight):
+        """Attribute one rank-incarnation's pull-to-pull windows.
+        Returns (coverage interval or None, max step seen,
+        replayed steps)."""
+        max_step = None
+        replayed = 0
+        cover_lo = cover_hi = None
+        prev_row = None
+        prev_step: Optional[int] = None
+        for row in rank_rows:
+            t1 = row.get('ts')
+            started = row.get('started_ts')
+            if t1 is None:
+                continue
+            t0 = (prev_row['ts'] if prev_row is not None
+                  else (started if started is not None else t1))
+            if row.get('step') is not None:
+                step = int(row['step'])
+                max_step = step if max_step is None else max(max_step,
+                                                             step)
+            dt = _overlap(t0, t1, w0, w1)
+            if dt > 0:
+                cover_lo = min(t for t in (cover_lo, max(t0, w0))
+                               if t is not None)
+                cover_hi = max(t for t in (cover_hi, min(t1, w1))
+                               if t is not None)
+                category, frac_replay, steps_replayed = \
+                    self._categorize(prev_step, row, prior_max, resume)
+                if category == PRODUCTIVE and frac_replay > 0:
+                    inc_seconds[RESTART_REPLAY] += \
+                        dt * frac_replay * weight
+                    inc_seconds[PRODUCTIVE] += \
+                        dt * (1.0 - frac_replay) * weight
+                    replayed += steps_replayed
+                else:
+                    inc_seconds[category] += dt * weight
+            if row.get('step') is not None:
+                prev_step = int(row['step'])
+            prev_row = row
+        if cover_lo is None or cover_hi is None or cover_hi <= cover_lo:
+            return None, max_step, replayed
+        return (cover_lo, cover_hi), max_step, replayed
+
+    @staticmethod
+    def _categorize(prev_step, row, prior_max, resume):
+        """One pull-to-pull window's category for one rank: rank-local
+        evidence (verdict, phase, step progress) — a stall inside a
+        provision window is still a stall, the rank outranks the
+        control plane for the seconds it covers."""
+        if (row.get('verdict') or 'ok') != 'ok':
+            return STALLED, 0.0, 0
+        phase = row.get('phase')
+        if phase == 'idle':
+            return IDLE, 0.0, 0
+        if phase == 'init' or row.get('step') is None:
+            return INIT_BARRIER, 0.0, 0
+        step = int(row['step'])
+        base = prev_step if prev_step is not None else int(resume)
+        advanced = step - base
+        if advanced <= 0:
+            # Stepping, verdict ok, no visible advance: a step longer
+            # than the pull window — productive, not a stall (the
+            # verdicts own stall calls).
+            return PRODUCTIVE, 0.0, 0
+        if prior_max is None:
+            return PRODUCTIVE, 0.0, 0
+        replay_steps = max(0, min(step, int(prior_max)) - base)
+        return PRODUCTIVE, min(1.0, replay_steps / advanced), \
+            replay_steps
+
+    # -- the ledger --
+
+    def run(self) -> Dict[str, Any]:
+        from skypilot_tpu.agent import telemetry
+        rows = self._telemetry_rows()
+        incarnations = telemetry.split_incarnations(rows)
+        events = self._journal()
+        lease_started = self._lease_started()
+
+        if self.explicit_window is not None:
+            w0, w1 = self.explicit_window
+        else:
+            starts = [lease_started] + \
+                [inc['start_ts'] for inc in incarnations]
+            starts = [s for s in starts if s]
+            if not starts:
+                return empty_ledger(self.cluster)
+            w0 = min(starts)
+            w1 = self.now if self._cluster_live() else max(
+                [w0] + [inc['end_ts'] for inc in incarnations
+                        if inc.get('end_ts')])
+        if w1 <= w0:
+            return empty_ledger(self.cluster)
+
+        full_ranks = max(
+            [len(inc['ranks']) for inc in incarnations] +
+            [self._journal_full_ranks(events)] + [1])
+        spans = self._spans(w0 - 60.0)
+        shrink_windows = self._shrink_windows(events, w1)
+        recovery_windows = self._recovery_windows(events)
+
+        weight = 1.0 / full_ranks
+        inc_records: List[Dict[str, Any]] = []
+        coverage: List[Tuple[float, float, int]] = []  # (lo, hi, inc#)
+        prior_max: Optional[int] = None
+        for index, inc in enumerate(incarnations):
+            seconds = {c: 0.0 for c in CATEGORIES}
+            inc_max: Optional[int] = None
+            inc_replayed = 0
+            inc_resume: Optional[int] = None
+            for _, rank_rows in sorted(inc['ranks'].items()):
+                resume = self._resume_step(rank_rows, index == 0)
+                inc_resume = (resume if inc_resume is None
+                              else min(inc_resume, resume))
+                cover, max_step, replayed = self._walk_rank(
+                    rank_rows, seconds, w0, w1, prior_max, resume,
+                    weight)
+                if cover is not None:
+                    coverage.append((cover[0], cover[1], index))
+                if max_step is not None:
+                    inc_max = (max_step if inc_max is None
+                               else max(inc_max, max_step))
+                inc_replayed += replayed
+            inc_records.append({
+                'incarnation': index,
+                'start_ts': inc['start_ts'],
+                'end_ts': inc['end_ts'],
+                'ranks': len(inc['ranks']),
+                'resume_step': inc_resume or 0,
+                'max_step': inc_max,
+                'replayed_steps': inc_replayed,
+                'seconds': seconds,
+            })
+            if inc_max is not None:
+                prior_max = (inc_max if prior_max is None
+                             else max(prior_max, inc_max))
+
+        self._attribute_uncovered(w0, w1, full_ranks, coverage, spans,
+                                  shrink_windows, recovery_windows,
+                                  inc_records)
+
+        totals = {c: 0.0 for c in CATEGORIES}
+        for record in inc_records:
+            for cat, value in record['seconds'].items():
+                totals[cat] += value
+            record['seconds'] = {k: round(v, 3)
+                                 for k, v in record['seconds'].items()}
+        wall = w1 - w0
+        productive = totals[PRODUCTIVE]
+        loss = sum(totals[c] for c in LOSS_CATEGORIES)
+        return {
+            'cluster': self.cluster,
+            'job_id': self.job_id,
+            'window': [w0, w1],
+            'wall_s': round(wall, 3),
+            'full_ranks': full_ranks,
+            'incarnations': inc_records,
+            'totals': {k: round(v, 3) for k, v in totals.items()},
+            'productive_s': round(productive, 3),
+            'loss_s': round(loss, 3),
+            'loss_by_cause': {c: round(totals[c], 3)
+                              for c in LOSS_CATEGORIES
+                              if totals[c] > 0},
+            'goodput': (round(min(1.0, productive / wall), 4)
+                        if wall > 0 else None),
+            'attributed_s': round(sum(totals.values()), 3),
+        }
+
+    def _cluster_live(self) -> bool:
+        try:
+            from skypilot_tpu import state
+            return state.get_cluster_from_name(self.cluster) is not None
+        except Exception:  # pylint: disable=broad-except
+            return False
+
+    @staticmethod
+    def _journal_full_ranks(events) -> int:
+        """Full gang size as the shrink journal knew it (evidence even
+        when the shrunk incarnation's telemetry is all we have)."""
+        best = 0
+        for event in events:
+            detail = event.get('detail') or {}
+            if event['event_type'] == 'job.gang_shrunk':
+                survivors = detail.get('survivors')
+                excluded = detail.get('excluded') or []
+                if survivors is not None:
+                    best = max(best, survivors + len(excluded))
+            elif event['event_type'] == 'job.gang_regrown':
+                if detail.get('hosts'):
+                    best = max(best, int(detail['hosts']))
+        return best
+
+    def _attribute_uncovered(self, w0, w1, full_ranks, coverage, spans,
+                             shrink_windows, recovery_windows,
+                             inc_records) -> None:
+        """L2: the chip-fraction no rank covered, swept over elementary
+        intervals and attributed from control-plane evidence. Each
+        uncovered second goes to exactly one cause: shrink windows take
+        their missing fraction first, then the finest covering span
+        (queue wait > provision > setup > recovery), then a journalled
+        recovery window, then ``unattributed``. Every gap is charged to
+        the FOLLOWING incarnation (the cost of bringing it up)."""
+        edges = {w0, w1}
+        for lo, hi, _ in coverage:
+            edges.update((max(w0, lo), min(w1, hi)))
+        for windows in spans.values():
+            for lo, hi in windows:
+                edges.update((max(w0, min(lo, w1)), max(w0, min(hi, w1))))
+        for lo, hi, _ in shrink_windows:
+            edges.update((max(w0, min(lo, w1)), max(w0, min(hi, w1))))
+        for lo, hi in recovery_windows:
+            edges.update((max(w0, min(lo, w1)), max(w0, min(hi, w1))))
+        ordered = sorted(edges)
+        inc_starts = [(rec['start_ts'], rec['incarnation'])
+                      for rec in inc_records]
+        if not inc_records:
+            inc_records.append({
+                'incarnation': 0, 'start_ts': w0, 'end_ts': w1,
+                'ranks': 0, 'resume_step': 0, 'max_step': None,
+                'replayed_steps': 0,
+                'seconds': {c: 0.0 for c in CATEGORIES}})
+            inc_starts = [(w0, 0)]
+        for a, b in zip(ordered, ordered[1:]):
+            length = b - a
+            if length <= 0:
+                continue
+            mid = (a + b) / 2.0
+            covered = sum(1 for lo, hi, _ in coverage if lo <= mid < hi)
+            remaining = max(0.0, 1.0 - min(covered, full_ranks)
+                            / full_ranks)
+            if remaining <= 0:
+                continue
+            target = inc_records[self._incarnation_for(inc_starts, mid)]
+            seconds = target['seconds']
+            for lo, hi, frac in shrink_windows:
+                if lo <= mid < hi:
+                    take = min(remaining, frac)
+                    seconds[SHRUNK_CAPACITY] += take * length
+                    remaining -= take
+                    break
+            if remaining <= 0:
+                continue
+            for category in _SPAN_PRIORITY:
+                if _covering(spans.get(category, ()), mid):
+                    seconds[category] += remaining * length
+                    remaining = 0.0
+                    break
+            if remaining <= 0:
+                continue
+            if _covering(recovery_windows, mid):
+                seconds[RECOVERY] += remaining * length
+            else:
+                seconds[UNATTRIBUTED] += remaining * length
+
+    @staticmethod
+    def _incarnation_for(inc_starts, t: float) -> int:
+        """A gap belongs to the incarnation it paid to bring up: the
+        first one starting after t (or the last one)."""
+        for start, index in inc_starts:
+            if start > t:
+                return index
+        return inc_starts[-1][1]
+
+
+# ---- public API -------------------------------------------------------------
+
+
+def build_ledger(cluster: str, now: Optional[float] = None,
+                 window: Optional[Tuple[float, float]] = None
+                 ) -> Dict[str, Any]:
+    """Fold the attribution ledger for one cluster. NEVER raises —
+    a broken plane costs its categories (they land in
+    ``unattributed``), a broken fold returns the empty ledger.
+
+    ``window`` restricts attribution to an explicit [start, end]
+    (``tools/bench_fleet.py --decompose`` measures exactly its
+    goodput window); default spans lease start → now (live) or the
+    last recorded evidence (torn down).
+    """
+    fallback: Dict[str, Any] = {}
+    try:
+        fallback = empty_ledger(cluster)
+        now = now if now is not None else time.time()
+        return _Fold(cluster, now, window).run()
+    except Exception:  # pylint: disable=broad-except
+        return fallback
+
+
+def record_ledger(cluster: str, job_id: Optional[int] = None,
+                  now: Optional[float] = None) -> Dict[str, Any]:
+    """Fold + persist the rolled-up ledger into the bounded
+    ``goodput_ledger`` table (one ``kind='job'`` roll-up + one
+    ``kind='incarnation'`` row per incarnation). NEVER raises — rides
+    the jobs controller's monitor loop. Returns the ledger."""
+    fallback: Dict[str, Any] = {}
+    try:
+        fallback = empty_ledger(cluster)
+        return _record_ledger(cluster, job_id=job_id, now=now)
+    except Exception:  # pylint: disable=broad-except
+        return fallback
+
+
+def _record_ledger(cluster: str, job_id: Optional[int],
+                   now: Optional[float]) -> Dict[str, Any]:
+    from skypilot_tpu import state
+    now = now if now is not None else time.time()
+    ledger = build_ledger(cluster, now=now)
+    if not ledger['incarnations'] and ledger['wall_s'] <= 0:
+        return ledger
+    owner = job_id if job_id is not None else ledger.get('job_id')
+    window = ledger.get('window') or [None, None]
+    rows = [{
+        'kind': 'job',
+        'incarnation': None,
+        'start_ts': window[0],
+        'end_ts': window[1],
+        'ranks': ledger['full_ranks'],
+        'full_ranks': ledger['full_ranks'],
+        'resume_step': None,
+        'max_step': max((r['max_step'] for r in ledger['incarnations']
+                         if r['max_step'] is not None), default=None),
+        'replayed_steps': sum(r['replayed_steps']
+                              for r in ledger['incarnations']),
+        'wall_s': ledger['wall_s'],
+        'productive_s': ledger['productive_s'],
+        'loss_s': ledger['loss_s'],
+        'goodput': ledger['goodput'],
+        'seconds': ledger['totals'],
+        'detail': {'incarnations': len(ledger['incarnations'])},
+    }]
+    for record in ledger['incarnations']:
+        seconds = record['seconds']
+        productive = seconds.get(PRODUCTIVE, 0.0)
+        inc_wall = sum(seconds.values())
+        rows.append({
+            'kind': 'incarnation',
+            'incarnation': record['incarnation'],
+            'start_ts': record['start_ts'],
+            'end_ts': record['end_ts'],
+            'ranks': record['ranks'],
+            'full_ranks': ledger['full_ranks'],
+            'resume_step': record['resume_step'],
+            'max_step': record['max_step'],
+            'replayed_steps': record['replayed_steps'],
+            'wall_s': round(inc_wall, 3),
+            'productive_s': round(productive, 3),
+            'loss_s': round(sum(seconds.get(c, 0.0)
+                                for c in LOSS_CATEGORIES), 3),
+            'goodput': (round(min(1.0, productive / inc_wall), 4)
+                        if inc_wall > 0 else None),
+            'seconds': seconds,
+            'detail': None,
+        })
+    state.record_goodput_ledger(cluster, owner, rows, ts=now)
+    return ledger
+
+
+def fleet_report(limit: int = 1000) -> Dict[str, Any]:
+    """Fleet roll-up of the latest persisted per-job ledgers: loss by
+    cause across every LIVE cluster (the same liveness filter the
+    scrape gauges apply). NEVER raises — shape-compatible empty report
+    on any failure."""
+    try:
+        return _fleet_report(limit)
+    except Exception:  # pylint: disable=broad-except
+        return {'clusters': [], 'totals': {}, 'loss_by_cause': {},
+                'wall_s': 0.0, 'productive_s': 0.0, 'goodput': None}
+
+
+def _fleet_report(limit: int) -> Dict[str, Any]:
+    from skypilot_tpu import state
+    clusters: List[Dict[str, Any]] = []
+    totals = {c: 0.0 for c in CATEGORIES}
+    live = set(state.get_cluster_names())
+    rows = [r for r in state.get_goodput_ledger(kind='job',
+                                                limit=limit)
+            if r['cluster'] in live]
+    for row in rows:
+        seconds = row.get('seconds') or {}
+        for cat, value in seconds.items():
+            if cat in totals and value:
+                totals[cat] += value
+        clusters.append(row)
+    wall = sum(totals.values())
+    productive = totals[PRODUCTIVE]
+    return {
+        'clusters': clusters,
+        'totals': {k: round(v, 3) for k, v in totals.items()},
+        'loss_by_cause': {c: round(totals[c], 3)
+                          for c in LOSS_CATEGORIES if totals[c] > 0},
+        'wall_s': round(wall, 3),
+        'productive_s': round(productive, 3),
+        'goodput': round(productive / wall, 4) if wall > 0 else None,
+    }
+
+
+def loss_summary(seconds: Dict[str, Any], top: int = 2) -> str:
+    """Compact top-loss-causes digest for one ledger's seconds map
+    (the `xsky top` summary line): 'replay 31%/provision 12%'."""
+    try:
+        total = sum(float(seconds.get(c) or 0.0) for c in CATEGORIES)
+        if total <= 0:
+            return '-'
+        short = {RESTART_REPLAY: 'replay', SETUP_BOOTSTRAP: 'setup',
+                 SHRUNK_CAPACITY: 'shrunk', INIT_BARRIER: 'init',
+                 QUEUE_WAIT: 'queue', UNATTRIBUTED: 'unattr'}
+        losses = sorted(((float(seconds.get(c) or 0.0), c)
+                         for c in LOSS_CATEGORIES), reverse=True)
+        parts = [f'{short.get(c, c)} {v / total:.0%}'
+                 for v, c in losses[:top] if v > 0]
+        return '/'.join(parts) if parts else '-'
+    except Exception:  # pylint: disable=broad-except
+        return '-'

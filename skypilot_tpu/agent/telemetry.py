@@ -435,6 +435,65 @@ def stragglers(samples: Dict[int, Dict[str, Any]],
     return {rank for rank, d in durs.items() if d > factor * median}
 
 
+# ---- incarnation splitting -------------------------------------------------
+
+ENV_INCARNATION_GAP = 'XSKY_GOODPUT_INCARNATION_GAP_S'
+# Rank processes of ONE incarnation start within a fan-out of each
+# other; a relaunch/shrink resubmit restarts them several seconds
+# later at minimum (stall detection + resubmit).
+_DEFAULT_INCARNATION_GAP_S = 2.0
+
+
+def incarnation_gap_s() -> float:
+    return _env_float(ENV_INCARNATION_GAP, _DEFAULT_INCARNATION_GAP_S)
+
+
+def split_incarnations(rows, gap_s: Optional[float] = None):
+    """Group telemetry HISTORY rows (``get_workload_telemetry(...,
+    latest_only=False)``) into elastic incarnations by each sample's
+    own ``started_ts`` (process start) — NOT by cluster job id, which
+    restarts at 1 after a relaunch and would merge incarnations. This
+    is the split ``tools/bench_fleet.py`` introduced for chip-weighted
+    goodput, promoted here so bench and runtime agree.
+
+    A new incarnation opens when a rank label REAPPEARS with a fresh
+    ``started_ts`` (the same rank cannot start twice in one
+    incarnation — elastic resubmits renumber survivors contiguously)
+    or when start times jump by more than ``gap_s``.
+
+    Returns incarnations ascending by start:
+    ``[{'start_ts', 'end_ts', 'ranks': {rank: [rows asc by ts]}}]``.
+    """
+    gap_s = gap_s if gap_s is not None else incarnation_gap_s()
+    # (rank, rounded started_ts) → that rank-incarnation's rows.
+    rank_incs: Dict[Any, List[Dict[str, Any]]] = {}
+    for row in rows:
+        if row.get('rank') is None:
+            continue
+        started = round(row.get('started_ts') or 0.0, 1)
+        rank_incs.setdefault((row['rank'], started), []).append(row)
+    ordered = sorted(rank_incs.items(), key=lambda kv: (kv[0][1],
+                                                        kv[0][0]))
+    incarnations = []
+    current = None
+    for (rank, started), inc_rows in ordered:
+        if current is None or rank in current['ranks'] or \
+                started - current['_last_start'] > gap_s:
+            current = {'start_ts': started, 'ranks': {},
+                       '_last_start': started}
+            incarnations.append(current)
+        current['ranks'][rank] = sorted(
+            inc_rows, key=lambda r: (r.get('ts') or 0.0))
+        current['_last_start'] = max(current['_last_start'], started)
+        current['start_ts'] = min(current['start_ts'], started)
+    for inc in incarnations:
+        inc.pop('_last_start', None)
+        inc['end_ts'] = max((r.get('ts') or inc['start_ts'])
+                            for rows_ in inc['ranks'].values()
+                            for r in rows_)
+    return incarnations
+
+
 # ---- goodput ---------------------------------------------------------------
 
 
@@ -500,12 +559,12 @@ def goodput_for_cluster(cluster: str,
         if scope is not None:
             try:
                 from skypilot_tpu import state
-                for event in state.get_recovery_events(scope=scope,
-                                                       limit=1000):
-                    if event['event_type'] in ('job.recovered',
-                                               'job.restarted') and \
-                            event['latency_s']:
-                        recovery_s += event['latency_s']
+                # ONE SQL aggregate: the previous Python sum over
+                # get_recovery_events(limit=1000) silently undercounted
+                # any job with >1000 journal rows.
+                recovery_s = state.sum_recovery_latency(
+                    scope, event_types=('job.recovered',
+                                        'job.restarted'))
                 lease = state.get_lease(scope)
                 if lease is not None and lease.get('started_at'):
                     wall_s = now - lease['started_at'] - recovery_s
@@ -554,6 +613,7 @@ def record_samples(cluster: str, job_id: Optional[int],
                 'last_progress_ts': s.get('last_progress_ts'),
                 'hb_ts': s.get('hb_ts'),
                 'verdict': result[rank],
+                'resume_step': s.get('resume_step'),
             })
         state.record_workload_telemetry(cluster, job_id, rows, ts=now)
     except Exception:  # pylint: disable=broad-except

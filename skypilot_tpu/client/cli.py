@@ -736,6 +736,7 @@ def _top_rows(cluster: Optional[str]) -> List[dict]:
     flags + the rank's step-anatomy profile block (shared by the table
     and --json renderers)."""
     from skypilot_tpu import state as state_lib
+    from skypilot_tpu.agent import goodput as goodput_lib
     from skypilot_tpu.agent import telemetry
     rows = state_lib.get_workload_telemetry(cluster=cluster)
     profs = {(p['cluster'], p['job_id'], p['rank']): p
@@ -750,6 +751,13 @@ def _top_rows(cluster: Optional[str]) -> List[dict]:
         lagging = telemetry.stragglers(ranks)
         skew = telemetry.rank_skew(ranks)
         goodput = telemetry.goodput_for_cluster(cl, ranks)
+        # Decomposed loss digest from the newest persisted ledger
+        # roll-up (written by the jobs controller's monitor loop):
+        # WHERE the non-productive time went, next to the ratio.
+        ledger_rows = state_lib.get_goodput_ledger(cluster=cl,
+                                                   kind='job', limit=1)
+        loss = (goodput_lib.loss_summary(ledger_rows[0]['seconds'])
+                if ledger_rows else '-')
         for rank, row in sorted(ranks.items()):
             pulled = row['ts'] or 0
             prof = profs.get((cl, job_id, rank))
@@ -763,6 +771,7 @@ def _top_rows(cluster: Optional[str]) -> List[dict]:
                 straggler=rank in lagging,
                 rank_skew=skew,
                 goodput=goodput.get('goodput'),
+                goodput_loss=loss,
                 dispatch_gap_ratio=(prof or {}).get(
                     'dispatch_gap_ratio'),
                 # Full step-anatomy block for --json consumers.
@@ -846,7 +855,9 @@ def top(cluster, watch, interval, as_json):
             click.echo(
                 f'  {first["cluster"]} job {first["job_id"]}: '
                 f'{len(group)} rank(s), skew={first["rank_skew"]}, '
-                f'goodput={goodput}, hbm={hbm}, stalled={stalls}, '
+                f'goodput={goodput}, '
+                f'loss={first.get("goodput_loss") or "-"}, '
+                f'hbm={hbm}, stalled={stalls}, '
                 f'pulled {_age_str(now - (first["ts"] or 0))} ago')
 
     if not watch:
@@ -859,6 +870,162 @@ def top(cluster, watch, interval, as_json):
             time_lib.sleep(max(interval, 0.2))
     except KeyboardInterrupt:
         pass
+
+
+# Waterfall glyph per attribution category (`xsky goodput`): one
+# character of bar per share of wall time.
+_GOODPUT_GLYPHS = (
+    ('productive', '#'), ('restart_replay', 'R'),
+    ('shrunk_capacity', 'c'), ('stalled', 'x'), ('queue_wait', 'q'),
+    ('provision', 'p'), ('setup_bootstrap', 'b'), ('init_barrier', 'i'),
+    ('recovery', 'r'), ('idle', '.'), ('unattributed', '?'),
+)
+
+
+def _goodput_bar(seconds: dict, total: float, width: int = 44) -> str:
+    """Stacked category bar: glyphs proportional to each category's
+    share of `total`, largest-remainder rounded so the bar length is
+    stable."""
+    if total <= 0:
+        return ''
+    shares = [(glyph, (seconds.get(cat) or 0.0) / total * width)
+              for cat, glyph in _GOODPUT_GLYPHS]
+    cells = [(glyph, int(share)) for glyph, share in shares]
+    rest = sorted(((share - int(share), i)
+                   for i, (_, share) in enumerate(shares)),
+                  reverse=True)
+    short = width - sum(n for _, n in cells)
+    for _, i in rest[:max(0, short)]:
+        cells[i] = (cells[i][0], cells[i][1] + 1)
+    return ''.join(glyph * n for glyph, n in cells)
+
+
+def _render_goodput_ledger(ledger: dict) -> None:
+    from skypilot_tpu.agent import goodput as goodput_lib
+    wall = ledger.get('wall_s') or 0.0
+    ratio = ledger.get('goodput')
+    click.echo(
+        f'GOODPUT {ledger["cluster"]} — wall {wall:.1f}s, '
+        f'{ledger.get("full_ranks") or 0} rank(s), '
+        f'{len(ledger.get("incarnations") or ())} incarnation(s), '
+        f'goodput=' + (f'{ratio:.1%}' if ratio is not None else '-'))
+    legend = ' '.join(f'{glyph}={cat}'
+                      for cat, glyph in _GOODPUT_GLYPHS)
+    click.echo(f'({legend})')
+    incs = ledger.get('incarnations') or []
+    if incs:
+        fmt = '{:>4} {:>5} {:>11} {:>7} {:>8} {:>8} {:>8}  {}'
+        click.echo(fmt.format('INC', 'RANKS', 'WINDOW', 'RESUME',
+                              'MAXSTEP', 'REPLAYED', 'GOODPUT',
+                              'WATERFALL'))
+        w0 = (ledger.get('window') or [0])[0] or 0
+        for inc in incs:
+            seconds = inc.get('seconds') or {}
+            inc_total = sum(seconds.values())
+            productive = seconds.get('productive', 0.0)
+            ratio = (f'{productive / inc_total:.0%}'
+                     if inc_total > 0 else '-')
+            start = (inc.get('start_ts') or w0) - w0
+            end_ts = inc.get('end_ts')
+            window = (f'{start:.0f}-{end_ts - w0:.0f}s'
+                      if end_ts else f'{start:.0f}s-')
+            click.echo(fmt.format(
+                inc['incarnation'], inc.get('ranks') or 0, window,
+                inc.get('resume_step')
+                if inc.get('resume_step') is not None else '-',
+                inc.get('max_step')
+                if inc.get('max_step') is not None else '-',
+                inc.get('replayed_steps') or 0, ratio,
+                _goodput_bar(seconds, inc_total)))
+    totals = ledger.get('totals') or {}
+    attributed = sum(totals.values())
+    if attributed > 0:
+        click.echo('')
+        fmt = '  {:<16} {:>10} {:>7}  {}'
+        click.echo(fmt.format('CAUSE', 'SECONDS', 'SHARE', ''))
+        for cat in goodput_lib.CATEGORIES:
+            value = totals.get(cat) or 0.0
+            if value <= 0:
+                continue
+            share = value / attributed
+            click.echo(fmt.format(cat, f'{value:.1f}',
+                                  f'{share:.1%}',
+                                  '#' * max(1, int(share * 30))))
+
+
+@cli.command(name='goodput')
+@click.argument('cluster', required=False)
+@click.option('--fleet', 'fleet_view', is_flag=True, default=False,
+              help='Fleet rollup of the latest persisted per-job '
+                   'ledgers (loss-by-cause across live clusters).')
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='One JSON object (the ledger, or the fleet '
+                   'report).')
+def goodput_cmd(cluster, fleet_view, as_json):
+    """Goodput attribution ledger: every wall-clock second, by cause.
+
+    With CLUSTER: a live fold over the planes' history — per-rank
+    telemetry split into elastic incarnations, the recovery journal's
+    shrink/recovery windows, and the launch-path trace spans —
+    rendered as a per-incarnation waterfall. `restart_replay` is
+    productive time re-done below the prior incarnation's max
+    committed step (the no-checkpoint tax); `shrunk_capacity` is the
+    chip-fraction missing while a gang runs elastically shrunk;
+    `unattributed` means no plane left evidence.
+
+    Without CLUSTER (or with --fleet): loss-by-cause rolled up across
+    every live cluster's newest persisted ledger — the fleet number
+    the ML-productivity-goodput decomposition optimizes.
+    """
+    from skypilot_tpu.agent import goodput as goodput_lib
+    from skypilot_tpu.client import sdk
+    report = sdk.goodput_report(cluster, fleet=fleet_view)
+    if as_json:
+        click.echo(json.dumps(report.get('ledger') or
+                              report.get('report') or {},
+                              default=str))
+        return
+    if report.get('kind') == 'cluster':
+        ledger = report.get('ledger') or {}
+        if not ledger.get('wall_s'):
+            click.echo(f'No goodput evidence for {cluster!r} yet '
+                       '(no telemetry, lease, or ledger rows).')
+            return
+        _render_goodput_ledger(ledger)
+        return
+    fleet_report = report.get('report') or {}
+    clusters = fleet_report.get('clusters') or []
+    if not clusters:
+        click.echo('No persisted goodput ledgers for live clusters.')
+        return
+    wall = fleet_report.get('wall_s') or 0.0
+    ratio = fleet_report.get('goodput')
+    click.echo(f'FLEET GOODPUT — {len(clusters)} job(s), '
+               f'{wall:.1f} attributed rank-seconds, goodput=' +
+               (f'{ratio:.1%}' if ratio is not None else '-'))
+    loss = fleet_report.get('loss_by_cause') or {}
+    total_loss = sum(loss.values())
+    if total_loss > 0:
+        fmt = '  {:<16} {:>10} {:>7}  {}'
+        click.echo(fmt.format('LOSS CAUSE', 'SECONDS', 'SHARE', ''))
+        for cat, value in sorted(loss.items(), key=lambda kv: -kv[1]):
+            share = value / total_loss
+            click.echo(fmt.format(cat, f'{value:.1f}',
+                                  f'{share:.1%}',
+                                  '#' * max(1, int(share * 30))))
+    fmt = '{:<24} {:>8} {:>9} {:>9} {:>9}  {}'
+    click.echo(fmt.format('CLUSTER', 'GOODPUT', 'WALL', 'PRODUCTIVE',
+                          'REPLAYED', 'TOP LOSSES'))
+    for row in clusters:
+        ratio = row.get('goodput')
+        click.echo(fmt.format(
+            row['cluster'][:24],
+            f'{ratio:.1%}' if ratio is not None else '-',
+            f'{row.get("wall_s") or 0:.0f}s',
+            f'{row.get("productive_s") or 0:.0f}s',
+            row.get('replayed_steps')
+            if row.get('replayed_steps') is not None else '-',
+            goodput_lib.loss_summary(row.get('seconds') or {})))
 
 
 def _profile_digest(group: List[dict]) -> str:

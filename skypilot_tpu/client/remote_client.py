@@ -202,6 +202,12 @@ class RemoteClient:
                                    'job_id': job_id,
                                    'all_ranks': all_ranks})
 
+    def goodput_report(self, cluster_name=None, fleet=False,
+                       limit=1000):
+        return self._call('goodput.report',
+                          {'cluster_name': cluster_name,
+                           'fleet': fleet, 'limit': limit})
+
     def profile_capture(self, cluster_name, job_id=None,
                         duration_s=1.0):
         out = self._call('profile.capture',

@@ -131,6 +131,16 @@ def profile_capture(cluster_name: str, job_id: Optional[int] = None,
                             job_id=job_id, duration_s=duration_s)
 
 
+def goodput_report(cluster_name: Optional[str] = None,
+                   fleet: bool = False,
+                   limit: int = 1000) -> Dict[str, Any]:
+    """Goodput attribution: a live per-incarnation ledger for one
+    cluster (every wall-clock second decomposed by cause), or the
+    fleet rollup of the latest persisted ledgers."""
+    return _local_or_remote('goodput_report', cluster_name,
+                            fleet=fleet, limit=limit)
+
+
 def endpoints(cluster_name: str,
               port: Optional[int] = None) -> Dict[int, str]:
     """port → URL for the cluster's opened ports."""

@@ -304,6 +304,29 @@ def profile_capture(cluster_name: str, job_id: Optional[int] = None,
     return summaries
 
 
+def goodput_report(cluster_name: Optional[str] = None,
+                   fleet: bool = False,
+                   limit: int = 1000) -> Dict[str, Any]:
+    """Goodput attribution report (`xsky goodput`).
+
+    With a cluster name: a LIVE fold of that cluster's attribution
+    ledger — every second of the job's lifetime decomposed by cause,
+    chip-weighted across elastic incarnations. Without one (or with
+    ``fleet=True``): the fleet rollup of the latest persisted per-job
+    ledgers (loss-by-cause across live clusters). Both are pure reads
+    over the bounded observability tables — no handle needed, so the
+    report survives the cluster it describes."""
+    from skypilot_tpu.agent import goodput
+    from skypilot_tpu.utils import tracing
+    if fleet or cluster_name is None:
+        with tracing.span('goodput.report', fleet=True):
+            report = goodput.fleet_report(limit=limit)
+        return {'kind': 'fleet', 'report': report}
+    with tracing.span('goodput.report', cluster=cluster_name):
+        ledger = goodput.build_ledger(cluster_name)
+    return {'kind': 'cluster', 'ledger': ledger}
+
+
 def watch_job_log(cluster_name: str, job_id: int,
                   offset: int = 0) -> Dict[str, Any]:
     """One incremental poll of a cluster job's run.log → {status,

@@ -18,6 +18,7 @@ from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
 from skypilot_tpu import state as global_state
 from skypilot_tpu import task as task_lib
+from skypilot_tpu.agent import goodput as goodput_lib
 from skypilot_tpu.agent import job_lib as cluster_job_lib
 from skypilot_tpu.agent import telemetry
 from skypilot_tpu.jobs import fleet
@@ -60,6 +61,9 @@ class JobsController:
         # Workload-telemetry pull schedule (rate-limited: one host
         # fan-out per pull interval inside the monitor loop).
         self._telemetry_next = 0.0
+        # Goodput-ledger fold schedule (rate-limited: one never-raise
+        # fold + batched write per XSKY_GOODPUT_RECORD_INTERVAL_S).
+        self._goodput_next = 0.0
         # Elastic gang state (fleet.ElasticGang): restored across
         # controller respawns via the job record's gang_detail, reset
         # whenever a launch rebuilds the full gang. The generation
@@ -151,6 +155,23 @@ class JobsController:
                                            cluster_job_id, samples)
         return {rank: v for rank, v in results.items()
                 if v != telemetry.VERDICT_OK}
+
+    def _maybe_record_goodput(self) -> None:
+        """Fold + persist the goodput attribution ledger (rate-limited,
+        never-raise): every second of this job's lifetime lands in one
+        of the ledger's causes, decomposing the goodput gauge into the
+        numbers the checkpoint arc must drive down (restart_replay) or
+        the fleet scheduler already bounds (shrunk_capacity). Rides the
+        monitor loop right after a telemetry pull so the fold sees the
+        freshest rank evidence."""
+        now = time.time()
+        if now < self._goodput_next:
+            return
+        self._goodput_next = now + goodput_lib.record_interval_s()
+        with tracing.span('goodput.record', job=self.job_id,
+                          cluster=self.cluster_name):
+            goodput_lib.record_ledger(self.cluster_name,
+                                      job_id=self.job_id, now=now)
 
     def _recover_from_stall(self, stalled: Dict[int, str]):
         """Hung/dead ranks take the SAME recovery path as a preemption,
@@ -435,6 +456,7 @@ class JobsController:
         while True:
             resilience.sleep(POLL_INTERVAL_S)
             self._heartbeat()
+            self._maybe_record_goodput()
             # Crash drill: a {"signal": "SIGKILL"} rule here IS the
             # kill -9 of a live controller; keyed on the respawn
             # generation so the reconciler-respawned controller

@@ -35,6 +35,9 @@ and gauges computed at scrape time from the state DB:
   * xsky_dispatch_gap_ratio{cluster,job,rank}  (host dispatch share of
     step time — >0.5 means the step loop is host-bound)
   * xsky_hbm_bytes_in_use{cluster,job,rank}
+  * xsky_goodput_loss_seconds_total{cluster,cause}  (the goodput
+    ledger's decomposition of non-productive wall time, from each
+    live cluster's newest persisted roll-up)
   * xsky_serve_slo_burn_rate{service,window}  (worst objective's burn;
     >= 1 spends the error budget faster than it accrues)
   * xsky_serve_replica_ttft_p99_seconds{service,replica}
@@ -56,6 +59,13 @@ _BUCKETS = (0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, float('inf'))
 _verb_duration_buckets: Dict[str, List[int]] = {}
 _verb_duration_sum: Dict[str, float] = {}
 _verb_duration_count: Dict[str, int] = {}
+
+# Per-cluster monotone floor of the goodput-loss counters: series
+# origin (job_id, window start) -> per-cause high-water seconds.
+# Bounded by live clusters x the fixed cause enum; pruned with
+# liveness at each scrape.
+_goodput_floor_lock = threading.Lock()
+_goodput_floors: Dict[str, Tuple[tuple, Dict[str, float]]] = {}
 
 
 # Known routes; anything else buckets under '<other>' so scanners can't
@@ -264,6 +274,70 @@ def _render_profile_gauges() -> List[str]:
     return lines
 
 
+def _render_goodput_counters() -> List[str]:
+    """Goodput-loss decomposition computed at scrape time from each
+    LIVE cluster's newest persisted ledger roll-up (kind='job', written
+    by the jobs controller's monitor loop): seconds of wall time lost
+    per cause, chip-weighted. Exposed as a counter: a fold re-derives
+    the job's whole lifetime, but successive folds can RECLASSIFY
+    seconds between causes (a late span flush converts unattributed
+    into provision), so each series is clamped to its in-process
+    high-water mark while the lifetime origin (job, window start) is
+    unchanged — a new lifetime resets the floor, an ordinary counter
+    reset Prometheus absorbs. Same live-cluster filter as the workload
+    gauges (bounded cardinality: causes are a fixed enum). Never
+    raises; an unreadable state DB costs the counters, not the
+    scrape."""
+    lines: List[str] = []
+    try:
+        from skypilot_tpu import state
+        from skypilot_tpu.agent import goodput
+        live = set(state.get_cluster_names())
+        rows = [r for r in state.get_goodput_ledger(kind='job')
+                if r['cluster'] in live]
+        # ONE row per cluster — the newest fold — so label sets are
+        # unique even when a cluster carried several job ids.
+        newest: Dict[str, Dict] = {}
+        for row in rows:
+            cur = newest.get(row['cluster'])
+            if cur is None or (row.get('ts') or 0) > (cur.get('ts')
+                                                     or 0):
+                newest[row['cluster']] = row
+        loss_lines = []
+        with _goodput_floor_lock:
+            for c in list(_goodput_floors):
+                if c not in live:
+                    del _goodput_floors[c]
+            for cluster, row in sorted(newest.items()):
+                seconds = row.get('seconds') or {}
+                origin = (row.get('job_id'), row.get('start_ts'))
+                prev_origin, floors = _goodput_floors.get(
+                    cluster, (None, {}))
+                if prev_origin != origin:
+                    floors = {}
+                for cause in goodput.LOSS_CATEGORIES:
+                    value = max(float(seconds.get(cause) or 0.0),
+                                floors.get(cause, 0.0))
+                    if value <= 0:
+                        continue
+                    floors[cause] = value
+                    loss_lines.append(
+                        'xsky_goodput_loss_seconds_total{cluster="'
+                        f'{_escape_label(cluster)}",cause='
+                        f'"{cause}"}} {value:.3f}')
+                _goodput_floors[cluster] = (origin, floors)
+        if loss_lines:
+            lines.append('# HELP xsky_goodput_loss_seconds_total '
+                         'Wall-clock seconds lost per cause '
+                         '(chip-weighted goodput attribution ledger).')
+            lines.append('# TYPE xsky_goodput_loss_seconds_total '
+                         'counter')
+            lines.extend(loss_lines)
+    except Exception:  # pylint: disable=broad-except
+        return []
+    return lines
+
+
 def _render_serve_slo_gauges() -> List[str]:
     """Serving-SLO health computed at scrape time from the newest
     per-service SLO evaluations: per-window burn rate (the WORST
@@ -373,8 +447,8 @@ def render() -> str:
     gauges."""
     tail = registry.render_registry() + '\n'.join(
         _render_lease_gauges() + _render_workload_gauges() +
-        _render_profile_gauges() + _render_serve_slo_gauges() +
-        _render_fleet_gauges())
+        _render_profile_gauges() + _render_goodput_counters() +
+        _render_serve_slo_gauges() + _render_fleet_gauges())
     with _lock:
         lines = [
             '# HELP xsky_http_requests_total HTTP requests by route/code.',

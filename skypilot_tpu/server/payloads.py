@@ -111,6 +111,8 @@ _VERBS: Dict[str, Callable[[Dict[str, Any]],
     'cluster_hosts': _core_verb('cluster_hosts', 'cluster_name'),
     'profile.capture': _core_verb('profile_capture', 'cluster_name',
                                   job_id=None, duration_s=1.0),
+    'goodput.report': _core_verb('goodput_report', cluster_name=None,
+                                 fleet=False, limit=1000),
     'endpoints': _core_verb('endpoints', 'cluster_name', port=None),
     'cancel': _core_verb('cancel', 'cluster_name', job_ids=None,
                          all_jobs=False),

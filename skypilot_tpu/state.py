@@ -294,6 +294,34 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             ON serve_slo (service);
         CREATE INDEX IF NOT EXISTS idx_serve_slo_latest
             ON serve_slo (service, kind, replica_id, row_id);
+        CREATE TABLE IF NOT EXISTS goodput_ledger (
+            row_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            ts REAL,
+            cluster TEXT,
+            job_id INTEGER,
+            kind TEXT,
+            incarnation INTEGER,
+            start_ts REAL,
+            end_ts REAL,
+            ranks INTEGER,
+            full_ranks INTEGER,
+            resume_step INTEGER,
+            max_step INTEGER,
+            replayed_steps INTEGER,
+            wall_s REAL,
+            productive_s REAL,
+            loss_s REAL,
+            goodput REAL,
+            seconds TEXT,
+            detail TEXT
+        );
+        CREATE INDEX IF NOT EXISTS idx_goodput_ledger_cluster
+            ON goodput_ledger (cluster);
+        CREATE INDEX IF NOT EXISTS idx_goodput_ledger_latest
+            ON goodput_ledger (cluster, job_id, kind, incarnation,
+                               row_id);
+        CREATE INDEX IF NOT EXISTS idx_spans_name
+            ON spans (name, row_id);
         CREATE TABLE IF NOT EXISTS fleet_decisions (
             row_id INTEGER PRIMARY KEY AUTOINCREMENT,
             ts REAL,
@@ -328,7 +356,11 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             "ALTER TABLE clusters ADD COLUMN usage_intervals TEXT",
             # Journal rows record the trace they happened under, so
             # `xsky events` and `xsky trace` cross-link.
-            "ALTER TABLE recovery_events ADD COLUMN trace_id TEXT"):
+            "ALTER TABLE recovery_events ADD COLUMN trace_id TEXT",
+            # Workload-declared resume point (checkpoint restore): the
+            # goodput ledger computes restart_replay against it.
+            "ALTER TABLE workload_telemetry ADD COLUMN resume_step "
+            "INTEGER"):
         try:
             conn.execute(migration)
         except sqlite3.OperationalError:
@@ -839,6 +871,31 @@ def get_recovery_events(scope: Optional[str] = None,
     return out
 
 
+def sum_recovery_latency(scope: str,
+                         event_types: Iterable[str] = (
+                             'job.recovered', 'job.restarted')
+                         ) -> float:
+    """Total journalled recovery latency for a scope, as ONE SQL
+    aggregate. Replaces the Python-side sum over
+    ``get_recovery_events(limit=1000)`` that silently undercounted any
+    job with more than 1000 journal rows (telemetry.goodput_for_cluster
+    was the offender). `scope` matches exactly or as a path prefix,
+    like :func:`get_recovery_events`."""
+    _flush_journal_buffer()   # coalesced appends: read-your-writes
+    types = list(event_types)
+    if not types:
+        return 0.0
+    prefix = (scope.rstrip('/').replace('\\', '\\\\')
+              .replace('%', '\\%').replace('_', '\\_'))
+    placeholders = ','.join('?' * len(types))
+    row = _read_one(
+        'SELECT COALESCE(SUM(latency_s), 0) FROM recovery_events '
+        "WHERE (scope = ? OR scope LIKE ? ESCAPE '\\') "
+        f'AND event_type IN ({placeholders}) AND latency_s IS NOT NULL',
+        [scope, prefix + '/%'] + types)
+    return float(row[0]) if row else 0.0
+
+
 # ---- trace spans -----------------------------------------------------------
 # Finished spans from utils/tracing: one row per span with parent/child
 # links, persisted with the journal's never-raise discipline and the
@@ -895,15 +952,7 @@ def record_spans(rows: List[Dict[str, Any]]) -> None:
             pass
 
 
-def get_spans(trace_id: str, limit: int = 5000,
-              offset: int = 0) -> List[Dict[str, Any]]:
-    """Finished spans of one trace, ordered by start time (row_id
-    breaks ties, so limit/offset pages are stable)."""
-    rows = _read(
-        'SELECT trace_id, span_id, parent_span_id, name, start_ts, '
-        'end_ts, status, attrs FROM spans WHERE trace_id=? '
-        'ORDER BY start_ts, row_id' + _page_sql(int(limit), offset),
-        (trace_id,))
+def _span_dicts(rows) -> List[Dict[str, Any]]:
     out = []
     for tid, sid, parent, name, start_ts, end_ts, status, attrs in rows:
         try:
@@ -923,6 +972,40 @@ def get_spans(trace_id: str, limit: int = 5000,
     return out
 
 
+def get_spans(trace_id: str, limit: int = 5000,
+              offset: int = 0) -> List[Dict[str, Any]]:
+    """Finished spans of one trace, ordered by start time (row_id
+    breaks ties, so limit/offset pages are stable)."""
+    return _span_dicts(_read(
+        'SELECT trace_id, span_id, parent_span_id, name, start_ts, '
+        'end_ts, status, attrs FROM spans WHERE trace_id=? '
+        'ORDER BY start_ts, row_id' + _page_sql(int(limit), offset),
+        (trace_id,)))
+
+
+def get_spans_by_name(names: List[str],
+                      since: Optional[float] = None,
+                      limit: int = 2000,
+                      offset: int = 0) -> List[Dict[str, Any]]:
+    """Finished spans matching any of `names`, newest first — the
+    goodput ledger's control-plane windows (queue wait, provisioning,
+    bootstrap, recovery), cross-trace. Served by the spans(name)
+    index; callers filter on attrs (cluster/job) in Python since attrs
+    are opaque JSON."""
+    if not names:
+        return []
+    conds = [f"name IN ({','.join('?' * len(names))})"]
+    args: List[Any] = list(names)
+    if since is not None:
+        conds.append('start_ts >= ?')
+        args.append(float(since))
+    return _span_dicts(_read(
+        'SELECT trace_id, span_id, parent_span_id, name, start_ts, '
+        'end_ts, status, attrs FROM spans WHERE ' +
+        ' AND '.join(conds) + ' ORDER BY row_id DESC' +
+        _page_sql(int(limit), offset), args))
+
+
 # ---- workload telemetry ----------------------------------------------------
 # Per-rank runtime samples (phase/step/step-time EMA/heartbeat age/stall
 # verdict) pulled from the agent-side spools by the gang backend and the
@@ -938,7 +1021,8 @@ _workload_inserts = 0
 
 _WORKLOAD_COLS = ('ts, cluster, job_id, rank, phase, step, '
                   'step_time_ema_s, tokens_per_sec, host_mem_mb, '
-                  'started_ts, last_progress_ts, hb_ts, verdict')
+                  'started_ts, last_progress_ts, hb_ts, verdict, '
+                  'resume_step')
 
 
 def record_workload_telemetry(cluster: str, job_id: Optional[int],
@@ -960,12 +1044,13 @@ def record_workload_telemetry(cluster: str, job_id: Optional[int],
         with _lock:
             conn.executemany(
                 f'INSERT INTO workload_telemetry ({_WORKLOAD_COLS}) '
-                'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)',
+                'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)',
                 [(ts, cluster, job_id, r.get('rank'), r.get('phase'),
                   r.get('step'), r.get('step_time_ema_s'),
                   r.get('tokens_per_sec'), r.get('host_mem_mb'),
                   r.get('started_ts'), r.get('last_progress_ts'),
-                  r.get('hb_ts'), r.get('verdict'))
+                  r.get('hb_ts'), r.get('verdict'),
+                  r.get('resume_step'))
                  for r in rows])
             # Prune on the FIRST batch too (short-lived CLI writers
             # never reach an amortized gate — same rationale as spans).
@@ -1010,7 +1095,7 @@ def get_workload_telemetry(cluster: Optional[str] = None,
     rows = _read(query, args)
     out = []
     for (ts, cl, job_id, rank, phase, step, step_ema, tps, mem,
-         started_ts, progress_ts, hb_ts, verdict) in rows:
+         started_ts, progress_ts, hb_ts, verdict, resume_step) in rows:
         out.append({
             'ts': ts,
             'cluster': cl,
@@ -1025,6 +1110,7 @@ def get_workload_telemetry(cluster: Optional[str] = None,
             'last_progress_ts': progress_ts,
             'hb_ts': hb_ts,
             'verdict': verdict,
+            'resume_step': resume_step,
         })
     return out
 
@@ -1161,6 +1247,146 @@ def get_profiles(cluster: Optional[str] = None,
             'hbm_bytes_limit': hbm_limit,
             'hbm_peak_bytes': peak,
             'verdicts': verdicts,
+            'detail': detail,
+        })
+    return out
+
+
+# ---- goodput ledger ---------------------------------------------------------
+
+# Rolled-up goodput attribution ledgers written by the jobs
+# controller's monitor loop (skypilot_tpu/agent/goodput.py): one
+# kind='job' roll-up + one kind='incarnation' row per elastic
+# incarnation per fold. Bounded like every observability table;
+# `xsky goodput --fleet`, the `xsky top` summary line and the
+# /metrics loss counters read from here.
+
+# Newest rows kept (pruned lazily). One fold writes incarnations+1
+# rows at the default 30 s cadence — 20k rows keep days of a busy
+# fleet's decomposition inspectable.
+_MAX_GOODPUT_LEDGER = 20000
+_goodput_ledger_inserts = 0
+
+_GOODPUT_LEDGER_COLS = ('ts, cluster, job_id, kind, incarnation, '
+                        'start_ts, end_ts, ranks, full_ranks, '
+                        'resume_step, max_step, replayed_steps, '
+                        'wall_s, productive_s, loss_s, goodput, '
+                        'seconds, detail')
+
+
+def record_goodput_ledger(cluster: str, job_id: Optional[int],
+                          rows: List[Dict[str, Any]],
+                          ts: Optional[float] = None) -> None:
+    """Persist one fold's ledger rows in ONE transaction. NEVER
+    raises — ledger recording rides the jobs controller's monitor loop
+    (same contract and batched-write pattern as
+    record_workload_telemetry)."""
+    global _goodput_ledger_inserts
+    if not rows:
+        return
+    ts = ts if ts is not None else time.time()
+    try:
+        conn = _get_conn()
+    except Exception:  # pylint: disable=broad-except
+        return
+    try:
+        with _lock:
+            conn.executemany(
+                f'INSERT INTO goodput_ledger ({_GOODPUT_LEDGER_COLS}) '
+                'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, '
+                '?, ?, ?)',
+                [(ts, cluster, job_id, r.get('kind', 'job'),
+                  r.get('incarnation'), r.get('start_ts'),
+                  r.get('end_ts'), r.get('ranks'), r.get('full_ranks'),
+                  r.get('resume_step'), r.get('max_step'),
+                  r.get('replayed_steps'), r.get('wall_s'),
+                  r.get('productive_s'), r.get('loss_s'),
+                  r.get('goodput'),
+                  json.dumps(r.get('seconds') or {}),
+                  (json.dumps(r['detail'], default=str)
+                   if r.get('detail') else None))
+                 for r in rows])
+            # Prune on the FIRST batch too (short-lived CLI writers
+            # never reach an amortized gate — same rationale as spans).
+            _goodput_ledger_inserts += len(rows)
+            if _goodput_ledger_inserts == len(rows) or \
+                    _goodput_ledger_inserts % 256 < len(rows):
+                conn.execute(
+                    'DELETE FROM goodput_ledger WHERE row_id <= '
+                    '(SELECT MAX(row_id) FROM goodput_ledger) - ?',
+                    (_MAX_GOODPUT_LEDGER,))
+            conn.commit()
+    except Exception:  # pylint: disable=broad-except
+        try:
+            conn.rollback()
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+def get_goodput_ledger(cluster: Optional[str] = None,
+                       job_id: Optional[int] = None,
+                       kind: Optional[str] = None,
+                       latest_only: bool = True,
+                       limit: int = 2000,
+                       offset: int = 0) -> List[Dict[str, Any]]:
+    """Ledger rows, newest-fold-first.
+
+    ``latest_only`` returns ONE row per (cluster, job, kind,
+    incarnation) — the live view `xsky goodput` renders;
+    ``latest_only=False`` is the history (a job's decomposition trend
+    across an incident)."""
+    conds, args = [], []
+    if cluster is not None:
+        conds.append('cluster = ?')
+        args.append(cluster)
+    if job_id is not None:
+        conds.append('job_id = ?')
+        args.append(job_id)
+    if kind is not None:
+        conds.append('kind = ?')
+        args.append(kind)
+    query = f'SELECT {_GOODPUT_LEDGER_COLS} FROM goodput_ledger'
+    if latest_only:
+        query += (' WHERE row_id IN (SELECT MAX(row_id) FROM '
+                  'goodput_ledger GROUP BY cluster, job_id, kind, '
+                  'incarnation)')
+        if conds:
+            query += ' AND ' + ' AND '.join(conds)
+    elif conds:
+        query += ' WHERE ' + ' AND '.join(conds)
+    query += (' ORDER BY cluster, job_id, kind, incarnation, '
+              'row_id DESC' + _page_sql(int(limit), offset))
+    rows = _read(query, args)
+    out = []
+    for (ts, cl, jid, row_kind, incarnation, start_ts, end_ts, ranks,
+         full_ranks, resume_step, max_step, replayed, wall_s,
+         productive_s, loss_s, goodput, seconds, detail) in rows:
+        try:
+            seconds = json.loads(seconds) if seconds else {}
+        except ValueError:
+            seconds = {}
+        try:
+            detail = json.loads(detail) if detail else None
+        except ValueError:
+            detail = None
+        out.append({
+            'ts': ts,
+            'cluster': cl,
+            'job_id': jid,
+            'kind': row_kind,
+            'incarnation': incarnation,
+            'start_ts': start_ts,
+            'end_ts': end_ts,
+            'ranks': ranks,
+            'full_ranks': full_ranks,
+            'resume_step': resume_step,
+            'max_step': max_step,
+            'replayed_steps': replayed,
+            'wall_s': wall_s,
+            'productive_s': productive_s,
+            'loss_s': loss_s,
+            'goodput': goodput,
+            'seconds': seconds,
             'detail': detail,
         })
     return out

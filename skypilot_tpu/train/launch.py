@@ -247,6 +247,14 @@ def main() -> int:
                 restored, dtypes, shardings)
             logger.info(f'Initialized {key} from {args.init_params}.')
 
+    # Declare the resume point BEFORE the first step: the goodput
+    # ledger charges steps at-or-below the prior incarnation's max
+    # committed step to `restart_replay` — work re-bought because
+    # nothing was checkpointed. A checkpoint restore raises
+    # resume_step and shrinks that bucket; no checkpoint ⇒ 0 and every
+    # relaunch visibly rebuys all prior progress.
+    telemetry.emit(phase=telemetry.PHASE_INIT, resume_step=start_step)
+
     feed = None
     if args.data:
         from skypilot_tpu.train import data as data_lib

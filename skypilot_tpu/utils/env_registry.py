@@ -282,6 +282,16 @@ _VARS = [
            'HUNG'),
     EnvVar('XSKY_TELEMETRY_PULL_INTERVAL_S', '10',
            'Control-plane spool-pull rate limit'),
+    # ---- goodput attribution ledger ---------------------------------------
+    EnvVar('XSKY_GOODPUT_RECORD_INTERVAL_S', '30',
+           'Jobs-controller cadence for folding + persisting the '
+           'goodput attribution ledger'),
+    EnvVar('XSKY_GOODPUT_HISTORY_ROWS', '20000',
+           'Telemetry-history rows one ledger fold consumes (the '
+           'table retention bound)'),
+    EnvVar('XSKY_GOODPUT_INCARNATION_GAP_S', '2',
+           'started_ts jump that splits telemetry history into '
+           'elastic incarnations'),
     # ---- device profiling --------------------------------------------------
     EnvVar('XSKY_PROFILE', '1',
            'Set to 0 to disable the always-on step-anatomy sampler'),

@@ -67,6 +67,9 @@ def _workload_script(path: str, marker: str, step_s: float) -> None:
 import os, sys, time
 sys.path.insert(0, {json.dumps(_REPO_ROOT)})
 from skypilot_tpu.agent import telemetry
+# resume_step=0 declared at init: checkpoint-free, so the goodput
+# ledger charges every re-run step to restart_replay.
+telemetry.emit(phase='init', resume_step=0)
 for i in range(1000000):
     if os.path.exists({json.dumps(marker)}):
         break
@@ -76,7 +79,7 @@ telemetry.emit(phase='idle')
 ''')
 
 
-def _chaos_plan(path: str) -> None:
+def _chaos_plan(path: str, decompose: bool = False) -> None:
     """One plan for BOTH arms (fairness): stall rank 2's emit in
     generation 0 only, and fail provisioning attempts after the initial
     launch with CapacityError (6 attempts, 1.5 s each — a capacity
@@ -84,18 +87,24 @@ def _chaos_plan(path: str) -> None:
     into on-demand before an attempt lands. This is the storm the
     baseline's relaunch must provision through; the elastic arm never
     reprovisions — shrink and grow-back resubmit over the healthy
-    cluster — so the same rules simply never fire there)."""
+    cluster — so the same rules simply never fire there).
+
+    ``decompose`` reshapes the same storm for the attribution gate:
+    the stall fires LATE (the gang banks real progress first, so a
+    checkpoint-free restart visibly rebuys it — restart_replay must
+    dominate the relaunch arm's loss) and the drought is short (the
+    gate proves WHERE the time went, not that relaunches are slow)."""
     with open(path, 'w', encoding='utf-8') as f:
         json.dump({'points': {
             'telemetry.stall': {
                 'match': {'rank': _VICTIM_RANK, 'generation': '0'},
-                'skip_first': 3,
+                'skip_first': 80 if decompose else 3,
             },
             'failover.wait_instances': {
                 'skip_first': 1,   # the arm's initial launch succeeds
-                'first_n': 6,
+                'first_n': 2 if decompose else 6,
                 'error': 'CapacityError',
-                'latency_s': 1.5,
+                'latency_s': 0.5 if decompose else 1.5,
             },
         }}, f)
 
@@ -106,26 +115,72 @@ def _chaos_plan(path: str) -> None:
 def _productive_rank_seconds(state_lib, cluster: str) -> float:
     """Σ over (rank, incarnation) of final step × step-time EMA.
 
-    Incarnations are split by the sample's own ``started_ts`` (process
-    start), NOT by cluster job id — a relaunched cluster's job ids
-    restart at 1 and would merge incarnations.
+    Incarnations come from ``telemetry.split_incarnations`` — the
+    started_ts split this bench introduced, now promoted into
+    telemetry proper (the goodput ledger folds with the SAME split,
+    so bench and runtime agree by construction), NOT cluster job id,
+    which restarts at 1 after a relaunch and would merge incarnations.
     """
+    from skypilot_tpu.agent import telemetry
     rows = state_lib.get_workload_telemetry(cluster=cluster,
                                             latest_only=False,
                                             limit=20000)
-    best = {}
-    for r in rows:
-        if r.get('step') is None or not r.get('step_time_ema_s'):
-            continue
-        key = (r['rank'], round(r.get('started_ts') or 0.0, 1))
-        value = r['step'] * r['step_time_ema_s']
-        if value > best.get(key, 0.0):
-            best[key] = value
-    return sum(best.values())
+    total = 0.0
+    for inc in telemetry.split_incarnations(rows):
+        for rank_rows in inc['ranks'].values():
+            total += max((r['step'] * r['step_time_ema_s']
+                          for r in rank_rows
+                          if r.get('step') is not None and
+                          r.get('step_time_ema_s')), default=0.0)
+    return total
+
+
+def _decompose_arm(state_lib, cluster: str, window_start: float,
+                   window_s: float) -> dict:
+    """Arm-side attribution: fold the goodput ledger over EXACTLY the
+    goodput window the arm measured (same data, same split — the gate
+    compares the decomposition against the ratio), plus the fold/record
+    overhead the controller tick pays (best-of-5 fold + one persisted
+    record, amortized over the record interval — the bench_telemetry
+    overhead-gate pattern)."""
+    from skypilot_tpu.agent import goodput as goodput_lib
+    window = (window_start, window_start + window_s)
+    ledger = goodput_lib.build_ledger(cluster, window=window)
+    fold_times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        goodput_lib.build_ledger(cluster, window=window)
+        fold_times.append(time.perf_counter() - t0)
+    # Read BEFORE the overhead-timing record below writes its own
+    # kind='job' row: the gate must prove the CONTROLLER's monitor
+    # loop persisted during the run, not this bench process.
+    persisted = state_lib.get_goodput_ledger(cluster=cluster,
+                                             kind='job', limit=1)
+    t0 = time.perf_counter()
+    goodput_lib.record_ledger(cluster)
+    record_s = time.perf_counter() - t0
+    fold_s = min(fold_times)
+    tick_s = float(os.environ.get('XSKY_JOBS_POLL_INTERVAL', '2.0'))
+    interval_s = goodput_lib.record_interval_s()
+    # One fold+record per record interval, amortized per controller
+    # tick: the share of each tick the ledger costs.
+    amortized = (fold_s + record_s) * tick_s / max(interval_s, 1e-9)
+    return {
+        'ledger': ledger,
+        'fold': {
+            'fold_s': round(fold_s, 6),
+            'record_s': round(record_s, 6),
+            'tick_s': tick_s,
+            'record_interval_s': interval_s,
+            'amortized_per_tick': round(amortized, 6),
+            'overhead_ratio': round(amortized / tick_s, 6),
+        },
+        'controller_recorded': bool(persisted),
+    }
 
 
 def run_arm(arm: str, window_s: float, step_s: float,
-            out_path: str) -> int:
+            out_path: str, decompose: bool = False) -> int:
     from skypilot_tpu import Resources, Task
     from skypilot_tpu import check as check_lib
     from skypilot_tpu import state as state_lib
@@ -203,6 +258,9 @@ def run_arm(arm: str, window_s: float, step_s: float,
                     'detail': e['detail']} for e in events],
         'grow_decisions': grow_decisions,
     }
+    if decompose and window_start is not None:
+        result.update(_decompose_arm(state_lib, cluster, window_start,
+                                     window_s))
     with open(out_path, 'w', encoding='utf-8') as f:
         json.dump(result, f)
     ok = (not wedged and
@@ -213,7 +271,8 @@ def run_arm(arm: str, window_s: float, step_s: float,
 # ---- orchestration ---------------------------------------------------------
 
 
-def _arm_env(arm: str, base_dir: str, plan: str) -> dict:
+def _arm_env(arm: str, base_dir: str, plan: str,
+             decompose: bool = False) -> dict:
     env = dict(os.environ)
     env.update({
         'XSKY_ENABLE_FAKE_CLOUD': '1',
@@ -242,13 +301,118 @@ def _arm_env(arm: str, base_dir: str, plan: str) -> dict:
         'XSKY_FLEET_MIN_SURVIVORS': '0.5',
         'XSKY_FLEET_ELASTIC': '1' if arm == 'elastic' else '0',
     })
+    if decompose:
+        env.update({
+            # The attribution gate measures a SHRUNK steady state: a
+            # grow-back mid-window would resubmit the full gang and
+            # restart from step 0 again, drowning shrunk_capacity in a
+            # second helping of restart_replay. Pressure decays far
+            # outside the window, so the elastic arm stays shrunk.
+            'XSKY_FLEET_DECAY_S': '600',
+            # The controller folds + persists the ledger during the
+            # run (the gate asserts a persisted roll-up exists).
+            'XSKY_GOODPUT_RECORD_INTERVAL_S': '2.0',
+        })
     return env
+
+
+def _loss_shares(ledger: dict) -> dict:
+    """Each loss cause's share of the arm's total loss."""
+    totals = (ledger or {}).get('totals') or {}
+    loss_causes = [c for c in totals
+                   if c not in ('productive', 'idle')]
+    loss = sum(totals.get(c) or 0.0 for c in loss_causes)
+    if loss <= 0:
+        return {}
+    return {c: (totals.get(c) or 0.0) / loss for c in loss_causes}
+
+
+def _decompose_gates(results: dict, arm_rcs: dict,
+                     window: float) -> int:
+    """The attribution gates: the ledger must explain the storm, not
+    just survive it. Categories sum to measured wall within ±2% for
+    both arms; the relaunch arm's loss is dominated (>=50%) by
+    restart_replay — a checkpoint-free relaunch rebuys all banked
+    progress; the elastic arm shifts that loss toward shrunk_capacity
+    (it keeps the survivors' progress and pays a missing-chip fraction
+    instead); fold + record overhead stays under 2% of a controller
+    tick, amortized over the record interval."""
+    elastic, baseline = results['elastic'], results['baseline']
+    summaries = {}
+    gates = {'arms_succeeded':
+             arm_rcs == {'elastic': 0, 'baseline': 0}}
+    for arm, result in results.items():
+        ledger = result.get('ledger') or {}
+        wall = ledger.get('wall_s') or 0.0
+        attributed = ledger.get('attributed_s') or 0.0
+        fold = result.get('fold') or {}
+        shares = _loss_shares(ledger)
+        summaries[arm] = {
+            'goodput': ledger.get('goodput'),
+            'wall_s': wall,
+            'attributed_s': attributed,
+            'sum_error': (round(abs(attributed - wall) / wall, 4)
+                          if wall > 0 else None),
+            'incarnations': len(ledger.get('incarnations') or ()),
+            'replayed_steps': sum(
+                r.get('replayed_steps') or 0
+                for r in ledger.get('incarnations') or ()),
+            'loss_shares': {k: round(v, 4)
+                            for k, v in sorted(shares.items())
+                            if v > 0},
+            'fold_overhead_ratio': fold.get('overhead_ratio'),
+        }
+        gates[f'{arm}_sums_to_wall'] = (
+            wall > 0 and abs(attributed - wall) / wall <= 0.02)
+        gates[f'{arm}_fold_overhead_under_2pct'] = (
+            fold.get('overhead_ratio') is not None and
+            fold['overhead_ratio'] < 0.02)
+    baseline_shares = _loss_shares(baseline.get('ledger') or {})
+    elastic_shares = _loss_shares(elastic.get('ledger') or {})
+    gates['baseline_loss_mostly_restart_replay'] = (
+        baseline_shares.get('restart_replay', 0.0) >= 0.5)
+    gates['elastic_loss_shifts_to_shrunk_capacity'] = (
+        elastic_shares.get('shrunk_capacity', 0.0) > 0.05 and
+        elastic_shares.get('restart_replay', 1.0) <
+        baseline_shares.get('restart_replay', 0.0))
+    gates['elastic_shrunk_journalled'] = any(
+        e['type'] == 'job.gang_shrunk'
+        for e in elastic.get('events', ()))
+    gates['baseline_relaunched'] = any(
+        e['type'] == 'job.recovered'
+        for e in baseline.get('events', ()))
+    gates['controller_recorded_ledger'] = bool(
+        elastic.get('controller_recorded') and
+        baseline.get('controller_recorded'))
+    ok = all(gates.values())
+    print(json.dumps({
+        'metric': 'fleet_goodput_attribution_decompose',
+        'window_s': window,
+        'hosts': _HOSTS,
+        'elastic': summaries.get('elastic'),
+        'baseline': summaries.get('baseline'),
+        'gates': gates,
+        'pass': ok,
+    }))
+    if not ok:
+        for arm in ('elastic', 'baseline'):
+            print(json.dumps({'arm_debug': results[arm]},
+                             default=str), file=sys.stderr)
+    return 0 if ok else 1
 
 
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--smoke', action='store_true',
                         help='Short window (the tier-1 gate).')
+    parser.add_argument('--decompose', action='store_true',
+                        help='Goodput-attribution gate: fold the '
+                             'ledger over each arm\'s exact goodput '
+                             'window and assert the decomposition '
+                             '(categories sum to wall, restart_replay '
+                             'dominates the relaunch arm, the elastic '
+                             'arm\'s loss shifts to shrunk_capacity, '
+                             'fold overhead <2% of a controller tick).')
     parser.add_argument('--window', type=float, default=None,
                         help='Measurement window per arm, seconds.')
     parser.add_argument('--step-s', type=float, default=0.1)
@@ -257,17 +421,25 @@ def main() -> int:
     parser.add_argument('--out', default=None,
                         help='(internal) arm result JSON path')
     args = parser.parse_args()
-    window = args.window if args.window is not None else (
-        18.0 if args.smoke else 40.0)
+    if args.window is not None:
+        window = args.window
+    elif args.decompose:
+        # The attribution storm banks ~8 s of progress before the
+        # stall so the restart visibly rebuys it; the window must
+        # cover stall + recovery + the full replay.
+        window = 30.0 if args.smoke else 45.0
+    else:
+        window = 18.0 if args.smoke else 40.0
 
     if args.run_arm:
-        return run_arm(args.run_arm, window, args.step_s, args.out)
+        return run_arm(args.run_arm, window, args.step_s, args.out,
+                       decompose=args.decompose)
 
     results = {}
     arm_rcs = {}
     with tempfile.TemporaryDirectory(prefix='xsky-bench-fleet-') as tmp:
         plan = os.path.join(tmp, 'storm.json')
-        _chaos_plan(plan)
+        _chaos_plan(plan, decompose=args.decompose)
         for arm in ('elastic', 'baseline'):
             base = os.path.join(tmp, arm)
             os.makedirs(base, exist_ok=True)
@@ -275,7 +447,12 @@ def main() -> int:
             argv = [sys.executable, os.path.abspath(__file__),
                     '--run-arm', arm, '--window', str(window),
                     '--step-s', str(args.step_s), '--out', out]
-            proc = subprocess.run(argv, env=_arm_env(arm, base, plan),
+            if args.decompose:
+                argv.append('--decompose')
+            proc = subprocess.run(argv,
+                                  env=_arm_env(
+                                      arm, base, plan,
+                                      decompose=args.decompose),
                                   capture_output=True, text=True,
                                   timeout=420, check=False)
             arm_rcs[arm] = proc.returncode
@@ -286,6 +463,9 @@ def main() -> int:
                 results[arm] = {'arm': arm, 'goodput': 0.0,
                                 'events': [],
                                 'error': (proc.stderr or '')[-2000:]}
+
+    if args.decompose:
+        return _decompose_gates(results, arm_rcs, window)
 
     elastic, baseline = results['elastic'], results['baseline']
     etypes = {e['type']: e for e in elastic.get('events', ())}

@@ -94,14 +94,22 @@ class SpanProfilerRule(engine.Rule):
                  'a tracing span — the pull must land on the trace')
 
     SKIPPED_FILES = frozenset({
-        # The plane's own definition site (record_profiles delegates
-        # to state.record_profiles internally; callers hold the span).
+        # The planes' own definition sites (record_profiles delegates
+        # to state.record_profiles internally, record_ledger wraps
+        # build_ledger; callers hold the span).
         'skypilot_tpu/agent/profiler.py',
+        'skypilot_tpu/agent/goodput.py',
     })
     PROFILER_SITES = frozenset({'capture_device_profile',
                                 'record_profiles',
                                 'scrape_replica_metrics',
-                                'record_serve_slo'})
+                                'record_serve_slo',
+                                # goodput-ledger fold/record sites:
+                                # the fold reads four bounded tables
+                                # on the controller tick whose cost
+                                # xsky trace must attribute.
+                                'build_ledger',
+                                'record_ledger'})
 
     def applies_to(self, rel_path: str) -> bool:
         return rel_path.startswith('skypilot_tpu/') and \
@@ -138,10 +146,11 @@ class RetentionBoundRule(engine.Rule):
         'profiles': '_MAX_PROFILES',
         'serve_slo': '_MAX_SERVE_SLO',
         'fleet_decisions': '_MAX_FLEET_DECISIONS',
+        'goodput_ledger': '_MAX_GOODPUT_LEDGER',
     }
     # CREATE TABLE names matching this are observability tables.
     OBSERVABILITY_RE = re.compile(
-        r'events|spans|telemetry|profiles|slo|decisions')
+        r'events|spans|telemetry|profiles|slo|decisions|ledger')
     CREATE_RE = re.compile(r'CREATE TABLE IF NOT EXISTS (\w+)')
 
     def applies_to(self, rel_path: str) -> bool:
@@ -326,6 +335,9 @@ class NeverRaiseRule(engine.Rule):
         'skypilot_tpu/agent/profiler.py': (
             'step_probe', 'record_compile', 'ensure_compile_listener',
             'record_profiles'),
+        'skypilot_tpu/agent/goodput.py': (
+            'build_ledger', 'record_ledger', 'fleet_report',
+            'loss_summary'),
     }
 
     def applies_to(self, rel_path: str) -> bool:
