@@ -38,6 +38,7 @@ NAV = [
     ('performance.md', 'Performance'),
     ('static-analysis.md', 'Static analysis'),
     ('reference/environment.md', 'Env variables'),
+    ('reference/observability-names.md', 'Observability names'),
 ]
 
 _TEMPLATE = """<!DOCTYPE html>

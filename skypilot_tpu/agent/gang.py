@@ -157,6 +157,11 @@ START_RETRY_WINDOW_S = 10.0
 # child runs in its own session (so ITS grandchildren die with it), which
 # means a signal to the job_runner's process group does NOT reach them —
 # kill_active() is how a SIGTERM'd runner takes its gang down with it.
+# xskylint: disable=lock-discipline -- kill_active runs inside signal
+# handlers, where acquiring a lock the interrupted main thread may hold
+# deadlocks the runner at the exact moment it must die; every mutation
+# is a single GIL-atomic list op (append/remove/clear) and iteration
+# snapshots via list(ACTIVE_PROCS) first.
 ACTIVE_PROCS: List[subprocess.Popen] = []
 
 

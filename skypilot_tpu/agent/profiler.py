@@ -462,7 +462,12 @@ def summary_is_stale(sample: Dict[str, Any],
 
 # (cluster, job_id, rank) → (compiles_total, compile_seconds_total) at
 # the previous pull: the registry counters count deltas, not snapshots.
+# Mutated by every puller thread (jobs controller monitor loop,
+# _wait_job) — the lock makes each delta+floor update atomic so two
+# concurrent pulls can neither double-count a delta nor corrupt the
+# floor (lock-discipline).
 _last_compiles: Dict[Any, Any] = {}
+_last_compiles_lock = threading.Lock()
 
 
 def record_profiles(cluster: str, job_id: Optional[int],
@@ -480,6 +485,7 @@ def record_profiles(cluster: str, job_id: Optional[int],
     """
     result: Dict[int, List[str]] = {}
     rows = []
+    incarnations: Dict[int, Any] = {}
     try:
         now = now if now is not None else time.time()
         for rank, sample in sorted(samples.items()):
@@ -489,6 +495,7 @@ def record_profiles(cluster: str, job_id: Optional[int],
                 prof = sample.get('profile')
                 if not isinstance(prof, dict):
                     continue
+                incarnations[rank] = sample.get('started_ts')
                 stale = summary_is_stale(sample, prof)
             else:
                 prof = sample
@@ -539,9 +546,30 @@ def record_profiles(cluster: str, job_id: Optional[int],
             seconds = row.get('compile_seconds_total')
             if total is None and seconds is None:
                 continue
-            prev_total, prev_seconds = _last_compiles.get(key, (0, 0.0))
-            d_total = max(0, int(total or 0) - prev_total)
-            d_seconds = max(0.0, float(seconds or 0.0) - prev_seconds)
+            gen = incarnations.get(row['rank'])
+            with _last_compiles_lock:
+                prev_gen, prev_total, prev_seconds = _last_compiles.get(
+                    key, (None, 0, 0.0))
+                if gen is not None and prev_gen is not None \
+                        and gen != prev_gen:
+                    if gen < prev_gen:
+                        # Out-of-order pull from an older workload
+                        # incarnation: its totals are stale, skip.
+                        continue
+                    # New incarnation (relaunch/resubmit): its counters
+                    # restarted at zero, so the floor must too.
+                    prev_total, prev_seconds = 0, 0.0
+                d_total = max(0, int(total or 0) - prev_total)
+                d_seconds = max(0.0,
+                                float(seconds or 0.0) - prev_seconds)
+                # Within one incarnation keep the floor monotone: a
+                # puller committing an older snapshot after a newer one
+                # must not lower it, or the next pull re-counts the
+                # difference.
+                _last_compiles[key] = (
+                    gen if gen is not None else prev_gen,
+                    max(prev_total, int(total or 0)),
+                    max(prev_seconds, float(seconds or 0.0)))
             if d_total:
                 metrics.inc_counter(
                     'xsky_compiles_total',
@@ -552,7 +580,6 @@ def record_profiles(cluster: str, job_id: Optional[int],
                     'xsky_compile_seconds_total',
                     'Seconds spent in XLA backend compiles.',
                     d_seconds)
-            _last_compiles[key] = (int(total or 0), float(seconds or 0.0))
     except Exception:  # pylint: disable=broad-except
         pass
     return result
@@ -689,7 +716,8 @@ def reset_for_test() -> None:
     with _anatomy_lock:
         _anatomy = None
     _cfg, _cfg_key = None, None
-    _last_compiles.clear()
+    with _last_compiles_lock:
+        _last_compiles.clear()
 
 
 # ---- CLI (`python -m skypilot_tpu.agent.profiler capture ...`) -------------

@@ -583,7 +583,10 @@ def goodput_for_cluster(cluster: str,
 
 # (cluster, job_id, rank) → (verdict, step) at the previous pull:
 # transition tracking so stall counters count events, not polls.
+# Mutated by every puller thread (jobs controller monitor loop,
+# _wait_job) — writes go under the lock (lock-discipline).
 _last_seen: Dict[Any, Any] = {}
+_last_seen_lock = threading.Lock()
 
 
 def record_samples(cluster: str, job_id: Optional[int],
@@ -622,7 +625,12 @@ def record_samples(cluster: str, job_id: Optional[int],
         from skypilot_tpu.utils import metrics
         for rank, s in samples.items():
             key = (cluster, job_id, rank)
-            prev = _last_seen.get(key)
+            # Read and write atomically: the stall counter fires on the
+            # OK->stalled *transition*, so two concurrent pullers must
+            # not both observe the pre-transition value.
+            with _last_seen_lock:
+                prev = _last_seen.get(key)
+                _last_seen[key] = (result[rank], s.get('step'))
             if result[rank] != VERDICT_OK and \
                     (prev is None or prev[0] == VERDICT_OK):
                 metrics.inc_counter(
@@ -636,7 +644,6 @@ def record_samples(cluster: str, job_id: Optional[int],
                     'Per-rank training/serving step time '
                     '(EMA sampled at pull).',
                     s['step_time_ema_s'])
-            _last_seen[key] = (result[rank], s.get('step'))
     except Exception:  # pylint: disable=broad-except
         pass
     try:
@@ -659,4 +666,5 @@ def reset_for_test() -> None:
             _emitter.stop()
         _emitter = None
         _emitter_key = None
-    _last_seen.clear()
+    with _last_seen_lock:
+        _last_seen.clear()

@@ -1488,19 +1488,33 @@ def ssh_down(infra, yes):
 @click.option('--rule', 'rules', multiple=True,
               help='Run only this rule id (repeatable).')
 @click.option('--json', 'as_json', is_flag=True, default=False,
-              help='Machine-readable findings.')
+              help='Machine-readable findings (schema-versioned, '
+                   'absolute paths included).')
+@click.option('--changed', 'changed', is_flag=True, default=False,
+              help='Per-file rules only on files differing from the '
+                   'merge-base; whole-program rules still see the '
+                   'full tree.')
+@click.option('--base', 'base', default=None,
+              help='Merge-base ref for --changed (default: '
+                   'origin/main).')
+@click.option('--stats', 'stats', is_flag=True, default=False,
+              help='Per-rule finding + suppression counts with '
+                   'reasons (suppression-debt report).')
 @click.option('--list-rules', 'list_rules', is_flag=True, default=False,
               help='Print the rule catalog and exit.')
-def lint(paths, root_dir, rules, as_json, list_rules):
+def lint(paths, root_dir, rules, as_json, changed, base, stats,
+         list_rules):
     """Static analysis over the tree (tools/xskylint).
 
-    Parses each file once and runs every registered rule over the
-    shared AST: concurrency contracts (raw sleeps, sequential runner
-    loops, thread/process hygiene), observability contracts (span
-    coverage, retention bounds, never-raise recording paths, lease
-    heartbeats), state-DB discipline (SELECT paging, connection
-    routing), the env-var registry, and chaos coverage. Exits 1 on
-    any unsuppressed finding. Suppress with
+    Parses each file once, builds a whole-program index over the
+    shared ASTs, and runs every registered rule: concurrency contracts
+    (raw sleeps, sequential runner loops, thread/process hygiene),
+    observability contracts (span coverage, retention bounds,
+    never-raise recording paths, lease heartbeats), state-DB
+    discipline (SELECT paging, connection routing), the env-var and
+    observability-name registries, chaos coverage, and the cross-file
+    contracts (verb wiring, lock discipline, schema consistency).
+    Exits 1 on any unsuppressed finding. Suppress with
     `# xskylint: disable=<rule> -- <reason>` (reason mandatory); rule
     catalog in docs/static-analysis.md.
     """
@@ -1525,6 +1539,12 @@ def lint(paths, root_dir, rules, as_json, list_rules):
         argv += ['--rule', rule]
     if as_json:
         argv.append('--json')
+    if changed:
+        argv.append('--changed')
+    if base:
+        argv += ['--base', base]
+    if stats:
+        argv.append('--stats')
     if list_rules:
         argv.append('--list-rules')
     sys.exit(lint_engine.main(argv))

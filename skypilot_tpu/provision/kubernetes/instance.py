@@ -25,6 +25,7 @@ TPU-first design:
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -51,23 +52,36 @@ _transport_factory = rest.KubeTransport
 # plugin — a poll loop (dashboard, autostop) must not pay that (or
 # leak temp files) on every lifecycle op.
 _transport_cache: Dict[Optional[str], Any] = {}
+# Concurrent lifecycle ops (status refresh fan-out, autostop ticks)
+# race the cache fill; the lock guards the cache dict only — the
+# expensive transport build happens OUTSIDE it, so one unreachable
+# cluster's exec credential plugin cannot wedge every other context's
+# poll. Losers of a duplicate build race just drop their transport.
+_transport_lock = threading.Lock()
 
 
 def set_transport_factory(factory) -> None:
     global _transport_factory
-    _transport_factory = factory
-    _transport_cache.clear()
+    with _transport_lock:
+        _transport_factory = factory
+        _transport_cache.clear()
 
 
 def _client(context: Optional[str], namespace: str) -> rest.KubeClient:
     try:
-        cached = _transport_cache.get(context)
+        factory = _transport_factory
+        with _transport_lock:
+            cached = _transport_cache.get(context)
         # Entries pin the factory that built them, so swapping the
-        # factory (tests monkeypatch it directly) never serves a stale
-        # transport.
-        if cached is None or cached[0] is not _transport_factory:
-            cached = (_transport_factory, _transport_factory(context))
-            _transport_cache[context] = cached
+        # factory (tests monkeypatch it directly) never serves a
+        # stale transport.
+        if cached is None or cached[0] is not factory:
+            built = (factory, factory(context))
+            with _transport_lock:
+                cached = _transport_cache.get(context)
+                if cached is None or cached[0] is not factory:
+                    _transport_cache[context] = built
+                    cached = built
         return rest.KubeClient(cached[1], namespace)
     except ValueError as e:
         raise exceptions.ProvisionError(str(e)) from e

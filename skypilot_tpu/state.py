@@ -226,6 +226,8 @@ def _create_tables(conn: sqlite3.Connection) -> None:
         );
         CREATE INDEX IF NOT EXISTS idx_spans_trace
             ON spans (trace_id);
+        CREATE INDEX IF NOT EXISTS idx_spans_trace_ts
+            ON spans (trace_id, start_ts);
         CREATE TABLE IF NOT EXISTS workload_telemetry (
             row_id INTEGER PRIMARY KEY AUTOINCREMENT,
             ts REAL,
@@ -342,6 +344,8 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             ON fleet_decisions (kind, row_id);
         CREATE INDEX IF NOT EXISTS idx_clusters_status
             ON clusters (status);
+        CREATE INDEX IF NOT EXISTS idx_clusters_launched
+            ON clusters (launched_at);
         CREATE INDEX IF NOT EXISTS idx_recovery_events_ts
             ON recovery_events (ts);
         CREATE INDEX IF NOT EXISTS idx_cluster_history_torn_down

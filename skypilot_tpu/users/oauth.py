@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 import urllib.error
 import urllib.parse
@@ -179,6 +180,11 @@ def refresh_access_token(refresh_token: str,
 #: revocation can shrink XSKY_OAUTH_USERINFO_TTL_S at the cost of more
 #: IdP round trips (0 disables caching entirely).
 _USERINFO_CACHE: Dict[str, Any] = {}
+# Every authenticated request on every handler thread hits the cache;
+# the prune loop in _cache_put iterates it, so an unguarded concurrent
+# insert is a `dict changed size during iteration` 500
+# (lock-discipline).
+_userinfo_lock = threading.Lock()
 _NEGATIVE_TTL_S = 30.0
 _CACHE_MAX_ENTRIES = 4096
 
@@ -191,14 +197,15 @@ def _cache_put(token: str, entry) -> None:
     """Insert with expiry pruning + a hard size cap — random-token
     spray must not grow server RSS without bound."""
     now = time.monotonic()
-    if len(_USERINFO_CACHE) >= _CACHE_MAX_ENTRIES:
-        for key in [k for k, (_, exp) in _USERINFO_CACHE.items()
-                    if exp < now]:
-            _USERINFO_CACHE.pop(key, None)
-    while len(_USERINFO_CACHE) >= _CACHE_MAX_ENTRIES:
-        # Still full after pruning: evict oldest-inserted.
-        _USERINFO_CACHE.pop(next(iter(_USERINFO_CACHE)), None)
-    _USERINFO_CACHE[token] = entry
+    with _userinfo_lock:
+        if len(_USERINFO_CACHE) >= _CACHE_MAX_ENTRIES:
+            for key in [k for k, (_, exp) in _USERINFO_CACHE.items()
+                        if exp < now]:
+                _USERINFO_CACHE.pop(key, None)
+        while len(_USERINFO_CACHE) >= _CACHE_MAX_ENTRIES:
+            # Still full after pruning: evict oldest-inserted.
+            _USERINFO_CACHE.pop(next(iter(_USERINFO_CACHE)), None)
+        _USERINFO_CACHE[token] = entry
 
 
 def validate_access_token(token: str,
@@ -240,4 +247,5 @@ def validate_access_token(token: str,
 
 
 def clear_userinfo_cache() -> None:
-    _USERINFO_CACHE.clear()
+    with _userinfo_lock:
+        _USERINFO_CACHE.clear()

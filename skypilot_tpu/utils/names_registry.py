@@ -1,0 +1,376 @@
+"""The single registry of every observability name the tree mints.
+
+Four kinds of name, one table each:
+
+  * ``metric``  — Prometheus names (``xsky_*``) minted at
+    ``metrics.inc_counter``/``metrics.observe`` call sites or rendered
+    directly by a scrape endpoint (``server/metrics.py``, the serve LB,
+    the replica-side ``ServeMetrics``).
+  * ``span``    — ``tracing.span(...)``/``request_span(...)`` names.
+  * ``chaos``   — ``chaos.inject(...)`` fault-injection points.
+  * ``journal`` — ``record_recovery_event(...)`` event types.
+
+Contract (enforced by the ``name-registry`` xskylint rule): any name
+the tree mints as a string literal at one of those call sites must be
+declared here with a one-line doc, and
+``docs/reference/observability-names.md`` must exactly match
+:func:`render_markdown` — regenerate it with::
+
+    python -m skypilot_tpu.utils.names_registry \
+        > docs/reference/observability-names.md
+
+Why a registry instead of prose: every plane so far (tracing, chaos,
+telemetry, SLO, fleet, goodput) minted its names in docstrings and
+docs tables by hand, and the goodput/SLO referee numbers are only
+trustworthy if a dashboard query, a fault plan, and a journal fold all
+spell a name the same way. The env-var registry proved the
+registry + generated-docs + lint triangle catches exactly this drift.
+
+This module is DEPENDENCY-FREE by design: the lint engine executes it
+standalone (no package import), so it must never import anything from
+``skypilot_tpu``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+KINDS = ('metric', 'span', 'chaos', 'journal')
+
+_KIND_TITLES = {
+    'metric': 'Metrics',
+    'span': 'Trace spans',
+    'chaos': 'Chaos points',
+    'journal': 'Recovery-journal event types',
+}
+
+_KIND_BLURBS = {
+    'metric': ('Prometheus names scraped from the control-plane '
+               '`/metrics`, the serve load balancer, or a replica\'s '
+               'serving endpoint.'),
+    'span': ('Span names recorded to the `spans` table and rendered '
+             'by `xsky trace`.'),
+    'chaos': ('Fault-injection points a `XSKY_CHAOS_PLAN` rule can '
+              'target.'),
+    'journal': ('`event_type` values in the recovery journal '
+                '(`xsky events`), folded by the goodput ledger.'),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ObsName:
+    kind: str     # one of KINDS
+    name: str
+    doc: str      # one line; starts capitalized, no period needed
+
+
+_NAMES = [
+    # ---- metrics: counter/histogram call sites -----------------------------
+    ObsName('metric', 'xsky_chaos_fires_total',
+            'Chaos-point firings, labeled by point'),
+    ObsName('metric', 'xsky_compiles_total',
+            'XLA backend compiles counted by the duration listener '
+            '(pull-fed delta)'),
+    ObsName('metric', 'xsky_compile_seconds_total',
+            'Seconds spent in XLA compilation (pull-fed delta)'),
+    ObsName('metric', 'xsky_failover_attempts_total',
+            'Provision failover attempts, labeled by typed cause'),
+    ObsName('metric', 'xsky_fanout_ranks_total',
+            'Ranks driven by run_in_parallel fan-outs, by phase'),
+    ObsName('metric', 'xsky_fanout_stragglers_total',
+            'Fan-out ranks slower than 1.5x the phase median, by phase'),
+    ObsName('metric', 'xsky_fanout_rank_duration_seconds',
+            'Per-rank duration histogram of host fan-out phases'),
+    ObsName('metric', 'xsky_phase_duration_seconds',
+            'Span-fed phase duration histogram {phase,status}'),
+    ObsName('metric', 'xsky_reconciler_repairs_total',
+            'Reconciler repair actions, labeled by action'),
+    ObsName('metric', 'xsky_workload_rank_stalls_total',
+            'Hung/dead rank verdict transitions, labeled by verdict'),
+    ObsName('metric', 'xsky_workload_step_seconds',
+            'Pull-fed workload step-time histogram'),
+    # ---- metrics: scrape-time gauges (server/metrics.py renders these) -----
+    ObsName('metric', 'xsky_http_requests_total',
+            'API-server HTTP requests {path,code}'),
+    ObsName('metric', 'xsky_requests_total',
+            'Executor verb dispatches {verb,status}'),
+    ObsName('metric', 'xsky_request_duration_seconds',
+            'Executor verb duration histogram {verb}'),
+    ObsName('metric', 'xsky_lease_expires_in_seconds',
+            'Per-lease seconds until expiry {scope} (negative = '
+            'expired holder)'),
+    ObsName('metric', 'xsky_leases_live',
+            'Leases with a live, unexpired heartbeat'),
+    ObsName('metric', 'xsky_workload_last_heartbeat_age_seconds',
+            'Rank telemetry heartbeat age {cluster,job,rank}'),
+    ObsName('metric', 'xsky_goodput_ratio',
+            'Productive step time / wall time {cluster}'),
+    ObsName('metric', 'xsky_goodput_loss_seconds_total',
+            'Goodput-ledger loss seconds by cause {cluster,cause} '
+            '(monotone per lifetime)'),
+    ObsName('metric', 'xsky_dispatch_gap_ratio',
+            'Host dispatch share of step time {cluster,job,rank}'),
+    ObsName('metric', 'xsky_hbm_bytes_in_use',
+            'Device HBM bytes in use {cluster,job,rank}'),
+    ObsName('metric', 'xsky_serve_slo_burn_rate',
+            'Worst-objective error-budget burn {service,window}'),
+    ObsName('metric', 'xsky_serve_replica_ttft_p99_seconds',
+            'Per-replica p99 TTFT from the newest SLO evaluation '
+            '{service,replica}'),
+    ObsName('metric', 'xsky_fleet_queue_depth',
+            'Managed-job admission queue depth {state}'),
+    ObsName('metric', 'xsky_fleet_gangs_shrunk',
+            'Jobs currently running elastically shrunk'),
+    # ---- metrics: serve LB scrape (serve/load_balancer.py) -----------------
+    ObsName('metric', 'xsky_lb_requests_total',
+            'LB-relayed requests, labeled by outcome'),
+    ObsName('metric', 'xsky_lb_retries_total',
+            'LB relay retries across replicas'),
+    ObsName('metric', 'xsky_lb_ttft_seconds',
+            'Time-to-first-token histogram measured at the relay'),
+    ObsName('metric', 'xsky_lb_e2e_seconds',
+            'End-to-end request latency histogram at the relay'),
+    ObsName('metric', 'xsky_lb_replica_inflight',
+            'In-flight relayed requests per replica {replica}'),
+    ObsName('metric', 'xsky_lb_replica_ttft_p99_seconds',
+            'Rolling per-replica p99 TTFT at the relay {replica}'),
+    ObsName('metric', 'xsky_lb_replica_error_rate',
+            'Rolling per-replica error fraction at the relay {replica}'),
+    # ---- metrics: replica-side serving endpoint (infer/metrics.py) ---------
+    ObsName('metric', 'xsky_serve_requests_total',
+            'Replica-served requests, labeled by outcome'),
+    ObsName('metric', 'xsky_serve_ttft_seconds',
+            'Replica-side time-to-first-token histogram'),
+    ObsName('metric', 'xsky_serve_tpot_seconds',
+            'Replica-side time-per-output-token histogram '
+            '(single-token outputs excluded)'),
+    ObsName('metric', 'xsky_serve_e2e_latency_seconds',
+            'Replica-side end-to-end latency histogram'),
+    ObsName('metric', 'xsky_serve_queue_depth',
+            'Replica admission queue depth'),
+    ObsName('metric', 'xsky_serve_active_slots',
+            'Decode slots currently generating'),
+    ObsName('metric', 'xsky_serve_free_slots',
+            'Decode slots free for admission'),
+    ObsName('metric', 'xsky_serve_generated_tokens_total',
+            'Output tokens generated by the replica'),
+    ObsName('metric', 'xsky_serve_prompt_tokens_total',
+            'Prompt tokens ingested by the replica'),
+    ObsName('metric', 'xsky_serve_prefix_cache_entries',
+            'Live prefix-cache entries'),
+    ObsName('metric', 'xsky_serve_prefix_cache_hits_total',
+            'Prefix-cache hits'),
+    ObsName('metric', 'xsky_serve_prefix_cache_misses_total',
+            'Prefix-cache misses'),
+    ObsName('metric', 'xsky_serve_prefix_cache_tokens_reused_total',
+            'Prompt tokens served from the prefix cache'),
+    ObsName('metric', 'xsky_serve_spec_rounds_total',
+            'Speculative-decoding verify rounds'),
+    ObsName('metric', 'xsky_serve_spec_proposed_total',
+            'Draft tokens proposed by speculative decoding'),
+    ObsName('metric', 'xsky_serve_spec_accepted_total',
+            'Draft tokens accepted by speculative decoding'),
+    # ---- spans -------------------------------------------------------------
+    ObsName('span', 'launch',
+            'Root of a cluster launch (execution.launch)'),
+    ObsName('span', 'exec',
+            'Root of a cluster exec (execution.exec)'),
+    ObsName('span', 'status_refresh',
+            'Multi-cluster status(refresh=True) fan-out'),
+    ObsName('span', 'backend.provision',
+            'Provider provision phase of a launch'),
+    ObsName('span', 'backend.mount',
+            'Runtime-mount phase of host setup'),
+    ObsName('span', 'backend.bootstrap',
+            'Wheel/runtime bootstrap on every host'),
+    ObsName('span', 'backend.docker_init',
+            'Container initialization on every host'),
+    ObsName('span', 'backend.setup',
+            'User setup commands across the gang'),
+    ObsName('span', 'backend.sync_workdir',
+            'Workdir rsync fan-out'),
+    ObsName('span', 'backend.file_mounts',
+            'File-mount sync fan-out'),
+    ObsName('span', 'backend.storage_mount',
+            'Storage mounting across hosts'),
+    ObsName('span', 'backend.sync_down_logs',
+            'Per-job-dir log sync-down fan-out'),
+    ObsName('span', 'backend.submit',
+            'Gang job submission'),
+    ObsName('span', 'backend.resubmit',
+            'Elastic gang resubmission over surviving hosts'),
+    ObsName('span', 'backend.cancel_jobs',
+            'Job cancellation fan-out'),
+    ObsName('span', 'backend.pull_telemetry',
+            'Workload telemetry spool pull across hosts'),
+    ObsName('span', 'backend.profile_capture',
+            'Deep device-profile capture fan-out'),
+    ObsName('span', 'failover.provision',
+            'Whole provision retry loop (all SKUs)'),
+    ObsName('span', 'failover.sku',
+            'One SKU\'s zone sweep inside failover'),
+    ObsName('span', 'failover.attempt',
+            'One provision attempt with typed outcome attrs'),
+    ObsName('span', 'jobs.launch_task',
+            'Managed-job task launch under the controller'),
+    ObsName('span', 'jobs.recover',
+            'Managed-job recovery after preemption/failure'),
+    ObsName('span', 'jobs.stall_recover',
+            'Recovery forced by a hung/dead telemetry verdict'),
+    ObsName('span', 'jobs.shrink_gang',
+            'Checkpoint-free elastic shrink onto survivors'),
+    ObsName('span', 'jobs.grow_gang',
+            'Elastic grow-back to the full gang size'),
+    ObsName('span', 'fleet.queue_wait',
+            'Launch-slot wait under the fleet scheduler'),
+    ObsName('span', 'goodput.record',
+            'Controller-side goodput ledger fold + persist'),
+    ObsName('span', 'goodput.report',
+            'goodput.report verb: ledger read for the CLI'),
+    ObsName('span', 'profile.capture',
+            'profile.capture verb: on-demand device capture'),
+    ObsName('span', 'profiler.pull',
+            'Profile-block extraction during a telemetry pull'),
+    ObsName('span', 'serve.recover_replica',
+            'Serve replica relaunch after a probe failure'),
+    ObsName('span', 'serve.slo_tick',
+            'One SLO monitor tick over all services'),
+    ObsName('span', 'serve.slo_scrape',
+            'Replica /metrics scrape fan-out inside a tick'),
+    # ---- chaos points ------------------------------------------------------
+    ObsName('chaos', 'do.api',
+            'DigitalOcean REST attempt (inside retry_transient)'),
+    ObsName('chaos', 'lambda.api',
+            'Lambda Cloud REST attempt (inside retry_transient)'),
+    ObsName('chaos', 'failover.get_cluster_info',
+            'Post-provision cluster-info fetch'),
+    ObsName('chaos', 'failover.wait_instances',
+            'Provision wait-for-instances phase'),
+    ObsName('chaos', 'fake.preempt',
+            'Fake-cloud spot preemption of a live cluster'),
+    ObsName('chaos', 'fanout.worker',
+            'One rank of a host fan-out, keyed on phase/rank'),
+    ObsName('chaos', 'fleet.shrink',
+            'Force/deny the elastic shrink arm'),
+    ObsName('chaos', 'fleet.grow_back',
+            'Force/deny the elastic grow-back arm'),
+    ObsName('chaos', 'gang.host_start',
+            'Per-host gang process start'),
+    ObsName('chaos', 'gang.mid_run_exit',
+            'Kill a gang rank mid-run'),
+    ObsName('chaos', 'jobs.controller_kill',
+            'Kill a jobs controller, keyed on respawn generation'),
+    ObsName('chaos', 'jobs.status_probe',
+            'Jobs controller cluster-status probe'),
+    ObsName('chaos', 'lb.proxy',
+            'Slow/fail the LB upstream relay leg'),
+    ObsName('chaos', 'profiler.dispatch_stall',
+            'Inflate a sampled host dispatch gap'),
+    ObsName('chaos', 'serve.probe',
+            'Serve controller replica readiness probe'),
+    ObsName('chaos', 'telemetry.stall',
+            'Freeze telemetry progress (heartbeat keeps beating)'),
+    # ---- journal event types ----------------------------------------------
+    ObsName('journal', 'chaos.injected',
+            'A chaos rule fired (latency rules journal measured '
+            'sleep)'),
+    ObsName('journal', 'failover.blocked',
+            'Provision attempt failed, with (cloud,region,zone,sku) '
+            'detail'),
+    ObsName('journal', 'failover.recovered',
+            'Provisioning succeeded after prior blocked attempts'),
+    ObsName('journal', 'job.preempted',
+            'Managed job lost its cluster to preemption'),
+    ObsName('journal', 'job.restarted',
+            'Managed job relaunched from scratch'),
+    ObsName('journal', 'job.recovered',
+            'Managed job back to RUNNING after recovery'),
+    ObsName('journal', 'job.rank_stall',
+            'Telemetry verdicted a rank hung/dead'),
+    ObsName('journal', 'job.gang_shrunk',
+            'Elastic shrink onto survivors, with chip fractions'),
+    ObsName('journal', 'job.gang_regrown',
+            'Elastic grow-back to full size (latency spans the '
+            'whole shrunk period)'),
+    ObsName('journal', 'replica.preempted',
+            'Serve replica lost its cluster, placement detail '
+            'attached'),
+    ObsName('journal', 'replica.relaunched',
+            'Serve replica relaunched by the controller'),
+    ObsName('journal', 'reconcile.controller_respawn',
+            'Reconciler respawned a dead jobs controller'),
+    ObsName('journal', 'reconcile.service_respawn',
+            'Reconciler re-execed a dead serve controller'),
+    ObsName('journal', 'reconcile.replica_teardown',
+            'Reconciler tore down a replica of a dead service'),
+    ObsName('journal', 'reconcile.orphan_teardown',
+            'Reconciler tore down an orphaned controller cluster'),
+    ObsName('journal', 'reconcile.respawn_budget_exhausted',
+            'Reconciler hit the bounded-respawn budget'),
+    ObsName('journal', 'serve.slo_breach',
+            'Multi-window burn crossed threshold, burns attached'),
+    ObsName('journal', 'serve.slo_recovered',
+            'A breached SLO objective returned under threshold'),
+]
+
+REGISTRY: Dict[Tuple[str, str], ObsName] = {
+    (n.kind, n.name): n for n in _NAMES}
+assert len(REGISTRY) == len(_NAMES), 'duplicate observability name'
+assert all(n.kind in KINDS for n in _NAMES), 'unknown name kind'
+
+
+def declared_names(kind: str) -> set:
+    return {n.name for n in _NAMES if n.kind == kind}
+
+
+def render_markdown() -> str:
+    """docs/reference/observability-names.md, exactly. The
+    name-registry lint diffs the committed file against this
+    rendering."""
+    lines = [
+        '# Observability names',
+        '',
+        '<!-- GENERATED FILE — do not edit by hand. Regenerate with:',
+        '     python -m skypilot_tpu.utils.names_registry '
+        '> docs/reference/observability-names.md -->',
+        '',
+        'Every metric, trace-span, chaos-point, and journal-event name',
+        'the tree mints, generated from',
+        '`skypilot_tpu/utils/names_registry.py` (the authoritative',
+        'registry — the `name-registry` lint in',
+        '[static analysis](../static-analysis.md) rejects unregistered',
+        'names at their mint sites and a stale copy of this page).',
+    ]
+    for kind in KINDS:
+        lines += [
+            '',
+            f'## {_KIND_TITLES[kind]}',
+            '',
+            _KIND_BLURBS[kind],
+            '',
+            '| Name | What it records |',
+            '|---|---|',
+        ]
+        for name in sorted(declared_names(kind)):
+            lines.append(f'| `{name}` | {REGISTRY[(kind, name)].doc} |')
+    lines += [
+        '',
+        '## Dynamic names',
+        '',
+        'A few families are minted with runtime parts and are not',
+        'individually registered: `request.<verb>` (the root span of',
+        'every API request), `fanout.<phase>` (per-phase fan-out spans,',
+        'per-rank children, and matching timeline events), and the',
+        'per-window burn labels on `xsky_serve_slo_burn_rate`.',
+        '',
+    ]
+    return '\n'.join(lines)
+
+
+def main() -> int:
+    print(render_markdown(), end='')
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

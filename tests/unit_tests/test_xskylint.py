@@ -51,6 +51,47 @@ def _registry(*names):
     return _MINI_ENV_REGISTRY.format(entries=entries)
 
 
+_MINI_NAMES_REGISTRY = '''\
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ObsName:
+    kind: str
+    name: str
+    doc: str
+
+
+REGISTRY = {{
+{entries}
+}}
+
+
+def declared_names(kind):
+    return {{name for (k, name) in REGISTRY if k == kind}}
+
+
+def render_markdown():
+    return 'unused in fixtures'
+'''
+
+
+def _names_registry(*pairs):
+    entries = '\n'.join(
+        f"    ('{k}', '{n}'): ObsName('{k}', '{n}', 'A test name.'),"
+        for k, n in pairs)
+    return _MINI_NAMES_REGISTRY.format(entries=entries)
+
+
+# Shared by the verb-wiring fixture pair: the factory shape the index
+# parses (mirrors payloads._core_verb).
+_VERB_FACTORY = (
+    'def _core_verb(fn_name, *fields, **defaults):\n'
+    '    def resolver(body):\n'
+    '        return fn_name, {}\n'
+    '    return resolver\n')
+
+
 FIXTURES = {
     'no-raw-sleep': (
         {'skypilot_tpu/jobs/controller.py':
@@ -213,6 +254,108 @@ FIXTURES = {
             'import os\n'
             "A = os.environ.get('XSKY_KNOWN', '1')\n"
             "B = os.environ.get('XSKY_MYSTERY')\n"},
+    ),
+    'verb-wiring': (
+        {'skypilot_tpu/server/payloads.py':
+            _VERB_FACTORY +
+            "_VERBS = {'status': _core_verb('status', 'cluster'),\n"
+            "          'ghost': _core_verb('no_such_fn')}\n",
+         'skypilot_tpu/core.py':
+            'def status(cluster_names=None):\n'
+            '    return []\n',
+         'skypilot_tpu/client/remote_client.py':
+            'class Client:\n'
+            '    def _call(self, verb, body):\n'
+            '        return verb, body\n'
+            '    def status(self):\n'
+            "        return self._call('status', {})\n"
+            '    def stop(self):\n'
+            "        return self._call('stop', {})\n",
+         'skypilot_tpu/client/sdk.py':
+            'def status(remote):\n'
+            '    return remote.status()\n'},
+        {'skypilot_tpu/server/payloads.py':
+            _VERB_FACTORY +
+            "_VERBS = {'status': _core_verb('status',\n"
+            "                               'cluster_names')}\n",
+         'skypilot_tpu/core.py':
+            'def status(cluster_names=None):\n'
+            '    return []\n',
+         'skypilot_tpu/client/remote_client.py':
+            'class Client:\n'
+            '    def _call(self, verb, body):\n'
+            '        return verb, body\n'
+            '    def status(self):\n'
+            "        return self._call('status', {})\n",
+         'skypilot_tpu/client/sdk.py':
+            'def status(remote):\n'
+            '    return remote.status()\n'},
+    ),
+    'name-registry': (
+        {'skypilot_tpu/utils/names_registry.py':
+            _names_registry(('chaos', 'known.point')),
+         'skypilot_tpu/m.py':
+            'from skypilot_tpu.utils import chaos\n'
+            'def f():\n'
+            "    chaos.inject('mystery.point')\n"},
+        {'skypilot_tpu/utils/names_registry.py':
+            _names_registry(('chaos', 'known.point'),
+                            ('chaos', 'mystery.point')),
+         'skypilot_tpu/m.py':
+            'from skypilot_tpu.utils import chaos\n'
+            'def f():\n'
+            "    chaos.inject('mystery.point')\n"},
+    ),
+    'lock-discipline': (
+        {'skypilot_tpu/reg.py':
+            '_CACHE = {}\n'
+            'def put(k, v):\n'
+            '    _CACHE[k] = v\n'
+            'def clear():\n'
+            '    _CACHE.clear()\n'},
+        {'skypilot_tpu/reg.py':
+            'import threading\n'
+            '_LOCK = threading.Lock()\n'
+            '_CACHE = {}\n'
+            '# single-writer ok: only the controller tick writes.\n'
+            '_SINGLE = {}\n'
+            'def put(k, v):\n'
+            '    with _LOCK:\n'
+            '        _CACHE[k] = v\n'
+            'def clear():\n'
+            '    with _LOCK:\n'
+            '        _CACHE.clear()\n'
+            'def tick(k):\n'
+            '    _SINGLE[k] = 1\n'
+            'def tock(k):\n'
+            '    _SINGLE.pop(k, None)\n'},
+    ),
+    'schema-consistency': (
+        {'skypilot_tpu/state.py':
+            'SCHEMA = """CREATE TABLE IF NOT EXISTS widgets (\n'
+            '    row_id INTEGER PRIMARY KEY,\n'
+            '    name TEXT\n'
+            ');"""\n'
+            'def add(conn, name):\n'
+            "    conn.execute('INSERT INTO widgets (name, color) '\n"
+            "                 'VALUES (?, ?)', (name, 1))\n"
+            'def list_widgets(limit, offset):\n'
+            "    return ('SELECT name FROM widgets ORDER BY name'\n"
+            '            + page_sql(limit, offset))\n'},
+        {'skypilot_tpu/state.py':
+            'SCHEMA = """CREATE TABLE IF NOT EXISTS widgets (\n'
+            '    row_id INTEGER PRIMARY KEY,\n'
+            '    name TEXT,\n'
+            '    color TEXT\n'
+            ');\n'
+            'CREATE INDEX IF NOT EXISTS idx_widgets_name\n'
+            '    ON widgets (name);"""\n'
+            'def add(conn, name):\n'
+            "    conn.execute('INSERT INTO widgets (name, color) '\n"
+            "                 'VALUES (?, ?)', (name, 1))\n"
+            'def list_widgets(limit, offset):\n'
+            "    return ('SELECT name FROM widgets ORDER BY name'\n"
+            '            + page_sql(limit, offset))\n'},
     ),
     'chaos-coverage': (
         {'skypilot_tpu/provision/probe.py':
@@ -467,6 +610,241 @@ class TestEngine:
         assert not finding['suppressed']
 
 
+def _build_index(paths=('skypilot_tpu',)):
+    """The pass-1 index over the real tree (test-only re-parse; the
+    engine itself reuses its shared trees)."""
+    from tools.xskylint import index as index_mod
+    idx = index_mod.ProjectIndex(REPO)
+    for rel in engine.LintEngine(REPO, []).iter_files(paths):
+        with open(os.path.join(REPO, rel), encoding='utf-8') as f:
+            src = f.read()
+        idx.add_file(rel, ast.parse(src), src)
+    return idx
+
+
+class TestProjectIndex:
+    """Pass-1 harvesting proven against the real tree: the verb map
+    matches payloads, schemas include migration-added columns, and
+    the observability-name harvest sees every plane."""
+
+    @pytest.fixture(scope='class')
+    def idx(self):
+        return _build_index()
+
+    def test_verb_map_matches_payloads(self, idx):
+        from skypilot_tpu.server import payloads
+        assert set(idx.verbs) == set(payloads._VERBS)
+        status = idx.verbs['status']
+        assert status.targets == [('skypilot_tpu.core', 'status')]
+        assert 'cluster_names' in status.fields
+        assert idx.verbs['launch'].custom    # hand-written resolver
+        assert ('skypilot_tpu.execution', 'launch') in \
+            idx.verbs['launch'].targets
+
+    def test_every_verb_posted_and_sdk_reachable(self, idx):
+        from tools.xskylint import index as index_mod
+        for verb in idx.verbs:
+            assert verb in idx.posts, f'{verb} never posted'
+            assert idx.sdk_reaches(verb), f'{verb} unreachable from sdk'
+        assert idx.posted_from('status',
+                               index_mod.REMOTE_CLIENT_PATH)
+
+    def test_schema_harvest_includes_migrations(self, idx):
+        clusters = idx.schemas[('skypilot_tpu/state.py', 'clusters')]
+        assert 'launched_at' in clusters.columns
+        # ALTER TABLE migration column:
+        assert 'workspace' in clusters.columns
+        assert clusters.indexes['idx_clusters_launched'] == \
+            ('launched_at',)
+        # The (table, 'col TYPE') tuple-loop migration pattern:
+        services = idx.schemas[('skypilot_tpu/serve/state.py',
+                                'services')]
+        assert 'qps' in services.columns
+
+    def test_name_harvest_sees_every_plane(self, idx):
+        assert 'xsky_chaos_fires_total' in idx.names['metric']
+        assert 'backend.provision' in idx.names['span']
+        assert 'fake.preempt' in idx.names['chaos']
+        assert 'job.preempted' in idx.names['journal']
+        # Sites are (path, line) pairs pointing into the tree.
+        path, line = idx.names['chaos']['fake.preempt'][0]
+        assert path.startswith('skypilot_tpu/') and line > 0
+
+    def test_container_harvest_tracks_guards(self, idx):
+        mod = idx.modules['skypilot_tpu/utils/metrics.py']
+        counters = mod.containers['_counters']
+        assert len(counters.mutating_functions()) >= 2
+        assert not counters.unguarded()   # every site under _lock
+        assert '_lock' in mod.locks
+
+
+class TestCrossfilePass:
+
+    def test_second_pass_keeps_the_parse_counter(self, tmp_path):
+        """The whole-program index is built from the SAME shared
+        trees: a tree exercising every harvest (payloads, schema,
+        names, containers) still parses each file exactly once with
+        all rules (both passes) active."""
+        files = {}
+        for rule_id in ('verb-wiring', 'name-registry',
+                        'lock-discipline', 'schema-consistency'):
+            files.update(FIXTURES[rule_id][1])   # the clean twins
+        _write_tree(tmp_path, files)
+        calls = []
+
+        def counting_parse(source, filename='<unknown>', **kw):
+            calls.append(filename)
+            return ast.parse(source, filename=filename, **kw)
+
+        result = _run(tmp_path, rule_id=None, parse=counting_parse)
+        assert result.files_scanned == len(files)
+        assert sorted(calls) == sorted(files), (
+            'the cross-file pass must reuse the shared trees, never '
+            f're-parse; saw {calls}')
+
+    def test_focus_limits_per_file_rules_not_crossfile(self, tmp_path):
+        """--changed semantics: per-file rules run only on the focus
+        set, but whole-program rules still see (and report on) the
+        full tree."""
+        files = dict(FIXTURES['lock-discipline'][0])   # reg.py bad
+        files['skypilot_tpu/a.py'] = (
+            'import threading\n'
+            'def go(f):\n'
+            '    threading.Thread(target=f).start()\n')
+        files['skypilot_tpu/b.py'] = (
+            'import threading\n'
+            'def go(f):\n'
+            '    threading.Thread(target=f).start()\n')
+        _write_tree(tmp_path, files)
+        result = engine.lint_paths(str(tmp_path), ['.'],
+                                   focus={'skypilot_tpu/b.py'})
+        by_rule = {}
+        for f in result.unsuppressed:
+            by_rule.setdefault(f.rule, set()).add(f.path)
+        # thread-hygiene (per-file) fired only on the focus file...
+        assert by_rule.get('thread-hygiene') == {'skypilot_tpu/b.py'}
+        # ...while lock-discipline (whole-program) still reported the
+        # unfocused reg.py.
+        assert by_rule.get('lock-discipline') == {'skypilot_tpu/reg.py'}
+
+    def test_disjoint_focus_skips_everything(self, tmp_path):
+        # The changed file exists but is outside the linted tree: no
+        # per-file rules, no index rebuild, no findings.
+        _write_tree(tmp_path, FIXTURES['lock-discipline'][0])
+        _write_tree(tmp_path, {'other/zzz.py': 'X = 1\n'})
+        result = engine.lint_paths(str(tmp_path), ['skypilot_tpu'],
+                                   focus={'other/zzz.py'})
+        assert result.files_scanned == 0
+        assert not result.findings
+
+    def test_deleted_focus_file_still_runs_crossfile_pass(self, tmp_path):
+        # A focus path absent from disk is a deletion — deleting an
+        # indexed file can move the cross-file verdict, so the
+        # whole-program pass must run even though no surviving file
+        # changed. The fixture's unguarded singleton proves it ran.
+        _write_tree(tmp_path, FIXTURES['lock-discipline'][0])
+        result = engine.lint_paths(str(tmp_path), ['.'],
+                                   focus={'skypilot_tpu/deleted.py'})
+        assert result.files_scanned > 0
+        assert any(f.rule == 'lock-discipline' for f in result.findings)
+
+    def test_changed_files_consults_git(self, tmp_path):
+        """`xsky lint --changed` file discovery on a throwaway repo:
+        committed-and-modified plus untracked .py files are in, the
+        untouched one is out."""
+        import subprocess
+
+        def git(*args):
+            return subprocess.run(
+                ['git', '-C', str(tmp_path)] + list(args),
+                capture_output=True, text=True, check=False)
+
+        if git('init').returncode != 0:
+            pytest.skip('git unavailable')
+        git('config', 'user.email', 't@t')
+        git('config', 'user.name', 't')
+        _write_tree(tmp_path, {'a.py': 'x = 1\n', 'b.py': 'y = 1\n'})
+        git('add', '.')
+        assert git('commit', '-m', 'seed').returncode == 0
+        _write_tree(tmp_path, {'a.py': 'x = 2\n',
+                               'new.py': 'z = 1\n'})
+        changed = engine.changed_files(str(tmp_path), base='HEAD')
+        assert changed == {'a.py', 'new.py'}
+
+    def test_changed_files_reanchors_subdir_root(self, tmp_path):
+        """git diff prints toplevel-relative paths; with --root a
+        subdirectory of the checkout they must come back root-relative
+        (and changes outside the root must drop out), or focus never
+        matches and --changed silently lints nothing."""
+        import subprocess
+
+        def git(*args):
+            return subprocess.run(
+                ['git', '-C', str(tmp_path)] + list(args),
+                capture_output=True, text=True, check=False)
+
+        if git('init').returncode != 0:
+            pytest.skip('git unavailable')
+        git('config', 'user.email', 't@t')
+        git('config', 'user.name', 't')
+        _write_tree(tmp_path, {'sub/a.py': 'x = 1\n',
+                               'other.py': 'y = 1\n'})
+        git('add', '.')
+        assert git('commit', '-m', 'seed').returncode == 0
+        _write_tree(tmp_path, {'sub/a.py': 'x = 2\n',
+                               'other.py': 'y = 2\n'})
+        changed = engine.changed_files(str(tmp_path / 'sub'),
+                                       base='HEAD')
+        assert changed == {'a.py'}
+
+    def test_index_skipped_without_crossfile_rules(self, tmp_path):
+        # A per-file-rule-only run must not pay the whole-program
+        # harvesting pass: no active rule declares needs_index, so
+        # run.index stays None.
+        _write_tree(tmp_path, FIXTURES['lock-discipline'][0])
+        from tools.xskylint.rules import all_rules
+        rules = [r for r in all_rules() if r.id == 'thread-hygiene']
+        eng = engine.LintEngine(str(tmp_path), rules)
+        captured = {}
+        orig = rules[0].finalize
+
+        def spy(run):
+            captured['index'] = run.index
+            return orig(run)
+
+        rules[0].finalize = spy
+        eng.run(['.'])
+        assert captured['index'] is None
+
+    def test_stats_counts_findings_and_suppressions(self, tmp_path):
+        _write_tree(tmp_path, {'skypilot_tpu/t.py': (
+            'import threading\n'
+            'def a(f):\n'
+            '    threading.Thread(target=f).start()\n'
+            'def b(f):\n'
+            '    # xskylint: disable=thread-hygiene -- fixture\n'
+            '    threading.Thread(target=f).start()\n')})
+        result = _run(tmp_path, 'thread-hygiene')
+        stats = result.stats()
+        row = stats['thread-hygiene']
+        assert row['findings'] == 1
+        assert row['suppressed'] == 1
+        assert row['reasons'] == ['skypilot_tpu/t.py:6: fixture']
+
+    def test_json_v2_schema(self, tmp_path):
+        """The CI contract: schema version + absolute paths so the
+        static-analysis job and future tooling parse stably."""
+        bad, _ = FIXTURES['span-fanout']
+        _write_tree(tmp_path, bad)
+        payload = json.loads(json.dumps(
+            _run(tmp_path, 'span-fanout').to_json()))
+        assert payload['version'] == 2
+        assert 'stats' in payload
+        (finding,) = payload['findings']
+        assert os.path.isabs(finding['abs_path'])
+        assert finding['abs_path'].endswith(finding['path'])
+
+
 class TestTier1Gate:
     """`xsky lint` as a pytest gate: the real tree must be clean."""
 
@@ -520,3 +898,31 @@ class TestTier1Gate:
         for name, var in env_registry.REGISTRY.items():
             assert name == var.name
             assert var.doc.strip()
+
+    def test_names_docs_regenerate_and_diff(self):
+        """docs/reference/observability-names.md is byte-identical to
+        the names-registry rendering (the name-registry rule's
+        staleness check, asserted directly so a drift names THIS
+        test)."""
+        from skypilot_tpu.utils import names_registry
+        with open(os.path.join(REPO, 'docs', 'reference',
+                               'observability-names.md'),
+                  encoding='utf-8') as f:
+            committed = f.read()
+        assert committed == names_registry.render_markdown(), (
+            'docs/reference/observability-names.md is stale — '
+            'regenerate with `python -m '
+            'skypilot_tpu.utils.names_registry > '
+            'docs/reference/observability-names.md`')
+
+    def test_names_registry_covers_every_mint(self):
+        """Direct form of the name-registry contract (the lint gate
+        covers it too; this failure message is more specific)."""
+        from skypilot_tpu.utils import names_registry
+        result = engine.lint_paths(REPO, ['skypilot_tpu'],
+                                   rule_ids=['name-registry'])
+        assert not result.unsuppressed, [
+            f.render() for f in result.unsuppressed]
+        for (kind, name), obs in names_registry.REGISTRY.items():
+            assert (kind, name) == (obs.kind, obs.name)
+            assert obs.doc.strip()

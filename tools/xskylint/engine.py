@@ -44,6 +44,10 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Set
 # is why no ``--`` reason is re-required.
 LEGACY_MARKERS: Dict[str, str] = {
     '# full-scan ok': 'select-limit',
+    # Registered single-writer exemption of the lock-discipline rule
+    # (consumed during index construction, listed here so the marker
+    # is discoverable alongside the other exemption comments).
+    '# single-writer ok': 'lock-discipline',
 }
 
 # Engine-minted finding ids (not registered rules; not suppressible —
@@ -153,10 +157,15 @@ class Rule:
       * ``visit(node, state, ctx)`` — called for every AST node during
         the shared walk with the lexical :class:`WalkState`.
       * ``finalize(run)`` — cross-file checks after every file ran.
+
+    Rules that read ``run.index`` from ``finalize`` must set
+    ``needs_index = True``: the engine only pays the whole-program
+    harvesting pass when an active rule declares it.
     """
 
     id: str = ''
     rationale: str = ''
+    needs_index: bool = False
 
     def applies_to(self, rel_path: str) -> bool:
         del rel_path
@@ -177,12 +186,15 @@ class Rule:
 
 
 class RunContext:
-    """Cross-file state handed to ``finalize``."""
+    """Cross-file state handed to ``finalize``. ``index`` is the
+    whole-program :class:`tools.xskylint.index.ProjectIndex` built
+    during pass 1 over the same shared trees (never re-parsed)."""
 
     def __init__(self, root: str) -> None:
         self.root = root
         self.scanned: Set[str] = set()
         self.findings: List[Finding] = []
+        self.index = None
 
     def report(self, rule_id: str, path: str, line: int,
                message: str) -> None:
@@ -314,11 +326,35 @@ class LintEngine:
 
     # -- running -------------------------------------------------------------
 
-    def run(self, paths: Iterable[str]) -> 'RunResult':
+    def run(self, paths: Iterable[str],
+            focus: Optional[Set[str]] = None) -> 'RunResult':
+        """Lint `paths`. With `focus` (the --changed contract), only
+        files in the set get the per-file rule hooks; every file is
+        still parsed ONCE into the whole-program index and its
+        suppressions honored, so cross-file rules see the full
+        program."""
         run_ctx = RunContext(self.root)
+        build_index = any(r.needs_index for r in self.rules)
+        if build_index:
+            from tools.xskylint import index as index_mod
+            run_ctx.index = index_mod.ProjectIndex(self.root)
         findings: List[Finding] = []
         suppressions: Dict[str, _Suppressions] = {}
         files = self.iter_files(paths)
+        if focus is not None and not focus.intersection(files):
+            # A changed file absent from the tree is a *deletion* — it
+            # may have been part of the whole-program index, so the
+            # cross-file verdict can move (a payloads verb now targets
+            # a module that no longer exists). Fall through to the full
+            # index pass; per-file rules still skip every file.
+            if all(os.path.exists(os.path.join(self.root, rel))
+                   for rel in focus):
+                # Nothing in the linted tree changed and nothing was
+                # deleted: no per-file rules to run and no reason to
+                # rebuild the whole-program index.
+                return RunResult(root=self.root, files_scanned=0,
+                                 rule_ids=sorted(self.rule_ids),
+                                 findings=[])
         for rel in files:
             abs_path = os.path.join(self.root, rel)
             try:
@@ -332,8 +368,12 @@ class LintEngine:
                     message=f'cannot parse: {e}'))
                 continue
             run_ctx.scanned.add(rel)
+            if build_index:
+                run_ctx.index.add_file(rel, tree, source)
             ctx = FileContext(rel, source, tree)
             active = [r for r in self.rules if r.applies_to(rel)]
+            if focus is not None and rel not in focus:
+                active = []
             if active:
                 for rule in active:
                     rule.begin_file(ctx)
@@ -379,20 +419,45 @@ class RunResult:
     def unsuppressed(self) -> List[Finding]:
         return [f for f in self.findings if not f.suppressed]
 
+    def stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-rule finding/suppression counts with the suppression
+        reasons — `xsky lint --stats` renders this so suppression debt
+        is visible instead of silently accumulating."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for f in self.findings:
+            row = out.setdefault(
+                f.rule, {'findings': 0, 'suppressed': 0, 'reasons': []})
+            if f.suppressed:
+                row['suppressed'] += 1
+                row['reasons'].append(
+                    f'{f.path}:{f.line}: {f.reason}')
+            else:
+                row['findings'] += 1
+        return out
+
     def to_json(self) -> Dict[str, Any]:
+        # `version` is the output-schema version: bump it when a field
+        # changes meaning so the CI job and downstream tooling can
+        # parse the payload stably. v2 added version/abs_path/stats.
         return {
+            'version': 2,
             'root': self.root,
             'files_scanned': self.files_scanned,
             'rules': self.rule_ids,
-            'findings': [f.to_json() for f in self.findings],
+            'findings': [
+                {**f.to_json(),
+                 'abs_path': os.path.join(self.root, f.path)}
+                for f in self.findings],
             'suppressed_count': sum(f.suppressed for f in self.findings),
             'unsuppressed_count': len(self.unsuppressed),
+            'stats': self.stats(),
         }
 
 
 def lint_paths(root: str, paths: Iterable[str],
                rule_ids: Optional[Iterable[str]] = None,
-               parse: Callable[..., ast.Module] = ast.parse) -> RunResult:
+               parse: Callable[..., ast.Module] = ast.parse,
+               focus: Optional[Set[str]] = None) -> RunResult:
     """Convenience wrapper: run (a subset of) the registered rules
     over `paths` under `root`. The API tests and the migrated
     test_chaos.py wrappers call."""
@@ -404,7 +469,66 @@ def lint_paths(root: str, paths: Iterable[str],
         if unknown:
             raise ValueError(f'unknown rule id(s): {sorted(unknown)}')
         rules = [r for r in rules if r.id in wanted]
-    return LintEngine(root, rules, parse=parse).run(paths)
+    return LintEngine(root, rules, parse=parse).run(paths, focus=focus)
+
+
+def changed_files(root: str,
+                  base: Optional[str] = None) -> Optional[Set[str]]:
+    """Repo-relative .py files differing from the merge-base (plus
+    untracked ones) — the --changed focus set. None when git is
+    unavailable or errors (callers fall back to a full lint rather
+    than green-lighting blind)."""
+    import subprocess
+
+    def git(*args: str) -> Optional[str]:
+        try:
+            proc = subprocess.run(
+                ['git', '-C', root] + list(args), capture_output=True,
+                text=True, timeout=30, check=False)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        return proc.stdout if proc.returncode == 0 else None
+
+    if base is None:
+        for candidate in ('origin/main', 'origin/master', 'main',
+                          'master'):
+            out = git('merge-base', 'HEAD', candidate)
+            if out and out.strip():
+                base = out.strip()
+                break
+        else:
+            base = 'HEAD'
+    else:
+        # An explicit --base is a merge-base *ref*, same as the
+        # default candidates: diff against merge-base(HEAD, ref), not
+        # the ref tip, or files changed on an advanced upstream would
+        # count as "changed" here. Fall back to the raw ref when
+        # merge-base fails (detached SHAs outside the history).
+        out = git('merge-base', 'HEAD', base)
+        if out and out.strip():
+            base = out.strip()
+    diff = git('diff', '--name-only', base)
+    if diff is None:
+        return None
+    diff_names = [n.strip().replace(os.sep, '/')
+                  for n in diff.splitlines() if n.strip()]
+    # `git diff --name-only` prints toplevel-relative paths; the
+    # engine matches root-relative ones. Re-anchor when --root is a
+    # subdirectory of the checkout (changes outside it drop out — they
+    # are outside the linted tree by definition). `ls-files` below is
+    # already cwd-relative thanks to -C root, so it needs no fixup.
+    top = git('rev-parse', '--show-toplevel')
+    if top and top.strip():
+        rel = os.path.relpath(os.path.abspath(root),
+                              top.strip()).replace(os.sep, '/')
+        if rel not in ('.', ''):
+            prefix = rel + '/'
+            diff_names = [n[len(prefix):] for n in diff_names
+                          if n.startswith(prefix)]
+    untracked = git('ls-files', '--others', '--exclude-standard')
+    names = diff_names + [n.strip().replace(os.sep, '/')
+                          for n in (untracked or '').splitlines()]
+    return {n for n in names if n.endswith('.py')}
 
 
 def _default_root() -> str:
@@ -429,7 +553,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument('--rule', action='append', dest='rules',
                         help='run only this rule id (repeatable)')
     parser.add_argument('--json', action='store_true', dest='as_json',
-                        help='machine-readable output')
+                        help='machine-readable output (schema-'
+                             'versioned, absolute paths included)')
+    parser.add_argument('--changed', action='store_true',
+                        help='per-file rules only on files differing '
+                             'from the merge-base; whole-program '
+                             'rules still see the full tree')
+    parser.add_argument('--base', default=None,
+                        help='merge-base ref for --changed (default: '
+                             'merge-base with origin/main)')
+    parser.add_argument('--stats', action='store_true', dest='stats',
+                        help='per-rule finding + suppression counts '
+                             '(with reasons)')
     parser.add_argument('--list-rules', action='store_true',
                         help='print the rule catalog and exit')
     args = parser.parse_args(argv)
@@ -441,8 +576,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     root = os.path.abspath(args.root) if args.root else _default_root()
+    focus = None
+    if args.changed:
+        focus = changed_files(root, args.base)
+        if focus is None:
+            # git unavailable: a blind green run would defeat the CI
+            # gate — fall back to the full lint and say so.
+            print('xskylint: --changed could not consult git; '
+                  'linting everything', file=sys.stderr)
+        elif not focus:
+            print('xskylint: no changed python files')
+            return 0
     try:
-        result = lint_paths(root, args.paths, rule_ids=args.rules)
+        result = lint_paths(root, args.paths, rule_ids=args.rules,
+                            focus=focus)
     except (ValueError, FileNotFoundError) as e:
         print(f'xskylint: {e}', file=sys.stderr)
         return 2
@@ -453,8 +600,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         for finding in result.findings:
             if not finding.suppressed:
                 print(finding.render())
+        if args.stats:
+            _print_stats(result)
         n = len(result.unsuppressed)
         suppressed = sum(f.suppressed for f in result.findings)
         print(f'xskylint: {result.files_scanned} files, '
               f'{n} finding(s), {suppressed} suppressed')
     return 1 if result.unsuppressed else 0
+
+
+def _print_stats(result: 'RunResult') -> None:
+    stats = result.stats()
+    if not stats:
+        print('xskylint: no findings, no active suppressions')
+        return
+    width = max(len(r) for r in stats)
+    print(f'{"rule".ljust(width)}  findings  suppressed')
+    for rule in sorted(stats):
+        row = stats[rule]
+        print(f'{rule.ljust(width)}  '
+              f'{str(row["findings"]).rjust(8)}  '
+              f'{str(row["suppressed"]).rjust(10)}')
+        for reason in row['reasons']:
+            print(f'{" " * width}    - {reason}')

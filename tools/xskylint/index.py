@@ -1,0 +1,767 @@
+"""Pass 1 of the whole-program analyzer: the project index.
+
+The engine hands every scanned file's shared AST (ONE ``ast.parse``
+per file — the index never re-parses) to :class:`ProjectIndex`, which
+accumulates the cross-file facts pass-2 rules (``rules/crossfile.py``)
+run over:
+
+  * **Module symbol table** — module-level function defs with their
+    parameter lists (required vs defaulted, ``**kwargs``), so the
+    verb-wiring rule can check payload resolvers against real
+    signatures without importing anything.
+  * **The payloads verb map** — ``skypilot_tpu/server/payloads.py``'s
+    ``_VERBS`` dict parsed structurally: every verb with its target
+    module/function and the body fields the resolver forwards.
+    ``_core_verb``/``_jobs_verb``/``_serve_verb``/``_module_verb``
+    factories, ``__import__(...).fn`` lambdas and hand-written
+    resolver functions are all understood.
+  * **Client verb posts** — which verbs ``client/remote_client.py``
+    and ``client/sdk.py`` put on the wire (first argument of
+    ``_call``/``_submit``), grouped by posting method, plus the
+    method/verb names ``sdk.py`` references — the reachability half
+    of verb-wiring.
+  * **SQL schemas** — every ``CREATE TABLE`` column list and
+    ``CREATE INDEX`` in the state modules, for schema-consistency.
+  * **Observability names** — ``xsky_*`` metric names at
+    ``inc_counter``/``observe``/``gauge`` call sites,
+    ``tracing.span(...)``/``request_span(...)`` names,
+    ``chaos.inject(...)`` points and ``record_recovery_event(...)``
+    journal kinds, for the name-registry rule.
+  * **Module-level mutable containers** — every module-level
+    dict/list/set/deque with its per-function mutation sites and
+    whether each site is lexically under a ``with <module lock>:``,
+    for lock-discipline.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+PAYLOADS_PATH = 'skypilot_tpu/server/payloads.py'
+REMOTE_CLIENT_PATH = 'skypilot_tpu/client/remote_client.py'
+SDK_PATH = 'skypilot_tpu/client/sdk.py'
+
+# The payloads verb-factory helpers and the engine module each binds to.
+_VERB_FACTORIES = {
+    '_core_verb': 'skypilot_tpu.core',
+    '_jobs_verb': 'skypilot_tpu.jobs.core',
+    '_serve_verb': 'skypilot_tpu.serve.core',
+}
+
+_CREATE_TABLE_RE = re.compile(
+    r'CREATE TABLE (?:IF NOT EXISTS )?(\w+)\s*\(')
+_CREATE_INDEX_RE = re.compile(
+    r'CREATE (?:UNIQUE )?INDEX (?:IF NOT EXISTS )?(\w+)\s+ON\s+(\w+)'
+    r'\s*\(([^)]*)\)')
+_ALTER_ADD_RE = re.compile(
+    r'ALTER TABLE (\w+)\s+ADD COLUMN (?:IF NOT EXISTS )?(\w+)')
+_SQL_CONSTRAINT_KEYWORDS = frozenset({
+    'PRIMARY', 'UNIQUE', 'FOREIGN', 'CHECK', 'CONSTRAINT'})
+
+# Container constructors recognized as module-level mutable singletons.
+_CONTAINER_CTORS = frozenset({
+    'dict', 'list', 'set', 'deque', 'defaultdict', 'OrderedDict'})
+# Method calls that mutate a container in place.
+_MUTATORS = frozenset({
+    'append', 'appendleft', 'extend', 'extendleft', 'add', 'update',
+    'insert', 'setdefault', 'pop', 'popitem', 'popleft', 'remove',
+    'discard', 'clear'})
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One module-level def: enough signature to type-check a verb."""
+    name: str
+    lineno: int
+    params: Tuple[str, ...]        # positional + keyword-only names
+    required: Tuple[str, ...]      # params with no default
+    has_kwargs: bool = False
+    has_varargs: bool = False
+
+    def accepts(self, field: str) -> bool:
+        return self.has_kwargs or field in self.params
+
+
+@dataclasses.dataclass
+class VerbEntry:
+    """One payloads verb: where it resolves and what it forwards."""
+    verb: str
+    lineno: int
+    # (dotted module, function) candidates the resolver may dispatch
+    # to; factory-made verbs have exactly one, hand-written resolvers
+    # may have several (each harvested `<imported alias>.<attr>`).
+    targets: List[Tuple[str, str]] = dataclasses.field(
+        default_factory=list)
+    fields: Tuple[str, ...] = ()      # body fields forwarded as kwargs
+    custom: bool = False              # hand-written resolver: existence
+                                      # is checkable, exact kwargs not
+
+
+@dataclasses.dataclass
+class TableSchema:
+    table: str
+    rel_path: str
+    lineno: int
+    columns: Tuple[str, ...]
+    primary_key: Optional[str] = None
+    # index name → indexed column names, in declaration order.
+    indexes: Dict[str, Tuple[str, ...]] = dataclasses.field(
+        default_factory=dict)
+
+
+@dataclasses.dataclass
+class PagedRead:
+    """One function whose SQL is paged through ``page_sql``."""
+    func: str
+    lineno: int          # the page_sql call site
+    sql: str             # every string constant in the function, joined
+
+
+@dataclasses.dataclass
+class MutationSite:
+    func: str           # innermost enclosing function ('<module>' else)
+    lineno: int
+    guarded: bool       # lexically inside `with <module-level lock>:`
+
+
+@dataclasses.dataclass
+class GlobalContainer:
+    name: str
+    rel_path: str
+    lineno: int
+    kind: str                       # 'dict' | 'list' | 'set' | 'deque'
+    # `# single-writer ok: <why>` on the definition line or the
+    # contiguous comment block above it — the registered exemption
+    # syntax of the lock-discipline rule.
+    exempt: bool = False
+    mutations: List[MutationSite] = dataclasses.field(
+        default_factory=list)
+
+    def mutating_functions(self) -> Set[str]:
+        return {m.func for m in self.mutations if m.func != '<module>'}
+
+    def unguarded(self) -> List[MutationSite]:
+        return [m for m in self.mutations
+                if not m.guarded and m.func != '<module>']
+
+
+class ModuleIndex:
+    def __init__(self, rel_path: str) -> None:
+        self.rel_path = rel_path
+        self.functions: Dict[str, FunctionInfo] = {}
+        # Every module-level bound name (functions, classes, assigns,
+        # imports) — existence checks for custom-resolver targets that
+        # may dispatch to classes or re-exported names.
+        self.symbols: Set[str] = set()
+        self.containers: Dict[str, GlobalContainer] = {}
+        self.locks: Set[str] = set()
+        # Schema-bearing modules only: SQL string constants and
+        # page_sql-paged reads, for schema-consistency.
+        self.sql_constants: List[Tuple[int, str]] = []
+        self.paged_reads: List[PagedRead] = []
+
+
+class ProjectIndex:
+    """Whole-program facts accumulated over the engine's shared ASTs."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        self.modules: Dict[str, ModuleIndex] = {}
+        self.verbs: Dict[str, VerbEntry] = {}
+        # verb → [(rel_path, lineno)] of _call/_submit posts, per file.
+        self.posts: Dict[str, Dict[str, List[int]]] = {}
+        # remote_client method name → verbs its body posts.
+        self.client_methods: Dict[str, Set[str]] = {}
+        # Names sdk.py references: attributes accessed on anything plus
+        # string constants (covers `remote.status(...)` AND the
+        # `getattr(remote, 'users_list')` / `_local_or_remote('status')`
+        # indirection patterns).
+        self.sdk_references: Set[str] = set()
+        # (rel_path, table) → schema.
+        self.schemas: Dict[Tuple[str, str], TableSchema] = {}
+        # kind ('metric'|'span'|'chaos'|'journal') → name →
+        # [(rel_path, lineno)].
+        self.names: Dict[str, Dict[str, List[Tuple[str, int]]]] = {
+            'metric': {}, 'span': {}, 'chaos': {}, 'journal': {}}
+
+    # -- construction (called by the engine, one shared tree per file) --
+
+    def add_file(self, rel_path: str, tree: ast.Module,
+                 source: str) -> None:
+        mod = ModuleIndex(rel_path)
+        self.modules[rel_path] = mod
+        self._harvest_symbols(mod, tree)
+        self._harvest_containers(mod, tree, source.splitlines())
+        self._harvest_names(rel_path, tree)
+        if 'CREATE TABLE' in source:
+            self._harvest_schemas(rel_path, tree, source)
+            self._harvest_sql(mod, tree)
+        if rel_path == PAYLOADS_PATH:
+            self._harvest_verbs(tree)
+        if rel_path in (REMOTE_CLIENT_PATH, SDK_PATH):
+            self._harvest_posts(rel_path, tree)
+        if rel_path == SDK_PATH:
+            self._harvest_sdk_references(tree)
+
+    # -- module symbol table -------------------------------------------------
+
+    def _harvest_symbols(self, mod: ModuleIndex,
+                         tree: ast.Module) -> None:
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mod.functions[node.name] = self._function_info(node)
+                mod.symbols.add(node.name)
+            elif isinstance(node, ast.ClassDef):
+                mod.symbols.add(node.name)
+            elif isinstance(node, ast.Assign):
+                mod.symbols.update(
+                    t.id for t in node.targets if isinstance(t, ast.Name))
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name):
+                mod.symbols.add(node.target.id)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                mod.symbols.update(
+                    (a.asname or a.name).split('.')[0]
+                    for a in node.names)
+
+    @staticmethod
+    def _function_info(node: ast.AST) -> FunctionInfo:
+        args = node.args
+        params = [a.arg for a in args.posonlyargs + args.args
+                  + args.kwonlyargs]
+        n_pos = len(args.posonlyargs) + len(args.args)
+        required = params[:n_pos - len(args.defaults)] if n_pos else []
+        required += [
+            a.arg for a, d in zip(args.kwonlyargs, args.kw_defaults)
+            if d is None]
+        return FunctionInfo(
+            name=node.name, lineno=node.lineno, params=tuple(params),
+            required=tuple(r for r in required if r not in
+                           ('self', 'cls')),
+            has_kwargs=args.kwarg is not None,
+            has_varargs=args.vararg is not None)
+
+    def module_functions(self, dotted: str
+                         ) -> Optional[Dict[str, FunctionInfo]]:
+        """Symbol table of a dotted module, or None when the module is
+        outside the scanned set."""
+        base = dotted.replace('.', '/')
+        for rel in (f'{base}.py', f'{base}/__init__.py'):
+            if rel in self.modules:
+                return self.modules[rel].functions
+        return None
+
+    def module_symbols(self, dotted: str) -> Optional[Set[str]]:
+        """Every module-level bound name of a dotted module (functions,
+        classes, assigns, imports), or None when unscanned."""
+        base = dotted.replace('.', '/')
+        for rel in (f'{base}.py', f'{base}/__init__.py'):
+            if rel in self.modules:
+                return self.modules[rel].symbols
+        return None
+
+    # -- payloads verb map ---------------------------------------------------
+
+    def _harvest_verbs(self, tree: ast.Module) -> None:
+        consts: Dict[str, str] = {}
+        resolver_defs: Dict[str, ast.AST] = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        consts[t.id] = node.value.value
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                resolver_defs[node.name] = node
+        for node in ast.walk(tree):
+            mapping = None
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Dict) and any(
+                        isinstance(t, ast.Name) and t.id == '_VERBS'
+                        for t in node.targets):
+                mapping = node.value
+            elif (isinstance(node, ast.AnnAssign) and
+                  isinstance(node.value, ast.Dict) and
+                  isinstance(node.target, ast.Name) and
+                  node.target.id == '_VERBS'):
+                # The initial `_VERBS: Dict[...] = {...}` is annotated.
+                mapping = node.value
+            elif (isinstance(node, ast.Call) and
+                  isinstance(node.func, ast.Attribute) and
+                  node.func.attr == 'update' and
+                  isinstance(node.func.value, ast.Name) and
+                  node.func.value.id == '_VERBS' and node.args and
+                  isinstance(node.args[0], ast.Dict)):
+                mapping = node.args[0]
+            if mapping is None:
+                continue
+            for key, value in zip(mapping.keys, mapping.values):
+                if not (isinstance(key, ast.Constant) and
+                        isinstance(key.value, str)):
+                    continue
+                entry = self._verb_entry(key.value, key.lineno, value,
+                                         consts, resolver_defs)
+                if entry is not None:
+                    self.verbs[entry.verb] = entry
+
+    def _verb_entry(self, verb: str, lineno: int, value: ast.AST,
+                    consts: Dict[str, str],
+                    resolver_defs: Dict[str, ast.AST]
+                    ) -> Optional[VerbEntry]:
+        entry = VerbEntry(verb=verb, lineno=lineno)
+        if isinstance(value, ast.Call):
+            factory = value.func.id if isinstance(value.func, ast.Name) \
+                else ''
+            module = _VERB_FACTORIES.get(factory)
+            if factory == '_module_verb' and value.args:
+                module = self._str_or_const(value.args[0], consts)
+                args = value.args[1:]
+            else:
+                args = list(value.args)
+            if module is None:
+                entry.custom = True
+                return entry
+            fn = self._str_or_const(args[0], consts) if args else None
+            if fn is None:
+                entry.custom = True
+                return entry
+            fields = [self._str_or_const(a, consts) for a in args[1:]]
+            fields += [kw.arg for kw in value.keywords if kw.arg]
+            entry.targets = [(module, fn)]
+            entry.fields = tuple(f for f in fields if f)
+            return entry
+        if isinstance(value, ast.Lambda):
+            target = self._import_target(value.body)
+            if target is not None:
+                entry.targets = [target]
+                entry.fields = tuple(self._lambda_fields(value.body))
+            else:
+                entry.custom = True
+            return entry
+        if isinstance(value, ast.Name):
+            fn_def = resolver_defs.get(value.id)
+            entry.custom = True
+            if fn_def is not None:
+                entry.targets = self._resolver_targets(fn_def)
+            return entry
+        return entry   # exotic value: existence unverifiable, custom
+
+    @staticmethod
+    def _str_or_const(node: ast.AST,
+                      consts: Dict[str, str]) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            return consts.get(node.id)
+        return None
+
+    @staticmethod
+    def _import_target(body: ast.AST) -> Optional[Tuple[str, str]]:
+        """``__import__('mod', fromlist=[...]).fn`` inside a lambda."""
+        for sub in ast.walk(body):
+            if (isinstance(sub, ast.Attribute) and
+                    isinstance(sub.value, ast.Call) and
+                    isinstance(sub.value.func, ast.Name) and
+                    sub.value.func.id == '__import__' and
+                    sub.value.args and
+                    isinstance(sub.value.args[0], ast.Constant)):
+                return (sub.value.args[0].value, sub.attr)
+        return None
+
+    @staticmethod
+    def _lambda_fields(body: ast.AST) -> List[str]:
+        """Keys of the kwargs dict literal a lambda resolver returns."""
+        if isinstance(body, ast.Tuple) and len(body.elts) == 2 and \
+                isinstance(body.elts[1], ast.Dict):
+            return [k.value for k in body.elts[1].keys
+                    if isinstance(k, ast.Constant) and
+                    isinstance(k.value, str)]
+        return []
+
+    @staticmethod
+    def _resolver_targets(fn_def: ast.AST) -> List[Tuple[str, str]]:
+        """``<imported alias>.<attr>`` uses inside a hand-written
+        resolver, resolved through its own ImportFrom statements —
+        e.g. ``from skypilot_tpu import execution`` + a later
+        ``execution.launch`` yields ('skypilot_tpu.execution',
+        'launch')."""
+        aliases: Dict[str, str] = {}
+        for sub in ast.walk(fn_def):
+            if isinstance(sub, ast.ImportFrom) and sub.module:
+                for alias in sub.names:
+                    bound = alias.asname or alias.name
+                    aliases[bound] = f'{sub.module}.{alias.name}'
+        targets = []
+        for sub in ast.walk(fn_def):
+            if isinstance(sub, ast.Attribute) and \
+                    isinstance(sub.value, ast.Name) and \
+                    sub.value.id in aliases:
+                targets.append((aliases[sub.value.id], sub.attr))
+        return targets
+
+    # -- client verb posts ---------------------------------------------------
+
+    def _harvest_posts(self, rel_path: str, tree: ast.Module) -> None:
+        def walk(node: ast.AST, func: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                nxt = child.name if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    else func
+                if isinstance(child, ast.Call):
+                    callee = child.func.attr if isinstance(
+                        child.func, ast.Attribute) else getattr(
+                            child.func, 'id', '')
+                    if callee in ('_call', '_submit') and child.args \
+                            and isinstance(child.args[0], ast.Constant) \
+                            and isinstance(child.args[0].value, str):
+                        verb = child.args[0].value
+                        self.posts.setdefault(verb, {}).setdefault(
+                            rel_path, []).append(child.lineno)
+                        if rel_path == REMOTE_CLIENT_PATH and nxt:
+                            self.client_methods.setdefault(
+                                nxt, set()).add(verb)
+                walk(child, nxt)
+
+        walk(tree, None)
+
+    def _harvest_sdk_references(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute):
+                self.sdk_references.add(node.attr)
+            elif isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str):
+                self.sdk_references.add(node.value)
+
+    def posted_from(self, verb: str, rel_path: str) -> bool:
+        return rel_path in self.posts.get(verb, {})
+
+    def sdk_reaches(self, verb: str) -> bool:
+        """The verb is posted from sdk.py directly, or some
+        remote_client method that posts it is referenced by sdk.py."""
+        if self.posted_from(verb, SDK_PATH):
+            return True
+        return any(method in self.sdk_references
+                   for method, verbs in self.client_methods.items()
+                   if verb in verbs)
+
+    # -- SQL schemas ---------------------------------------------------------
+
+    def _harvest_schemas(self, rel_path: str, tree: ast.Module,
+                         source: str) -> None:
+        # Work over the file's string constants (schemas are string
+        # literals by construction); line numbers come from the nodes.
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Constant) and
+                    isinstance(node.value, str)):
+                continue
+            text = node.value
+            for m in _CREATE_TABLE_RE.finditer(text):
+                table = m.group(1)
+                cols, pk = self._parse_columns(text, m.end() - 1)
+                self.schemas[(rel_path, table)] = TableSchema(
+                    table=table, rel_path=rel_path, lineno=node.lineno,
+                    columns=tuple(cols), primary_key=pk)
+            for m in _CREATE_INDEX_RE.finditer(text):
+                name, table, collist = m.groups()
+                cols = tuple(
+                    c.strip().split()[0] for c in collist.split(',')
+                    if c.strip())
+                schema = self.schemas.get((rel_path, table))
+                if schema is not None:
+                    schema.indexes[name] = cols
+        # Migration-added columns are part of the effective schema:
+        # literal `ALTER TABLE t ADD COLUMN c` statements, and the
+        # `(table, 'col TYPE')` tuples serve/state.py feeds its
+        # dynamic alter loop.
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str):
+                for m in _ALTER_ADD_RE.finditer(node.value):
+                    self._add_column(rel_path, m.group(1), m.group(2))
+            elif isinstance(node, ast.Tuple) and \
+                    len(node.elts) == 2 and all(
+                        isinstance(e, ast.Constant) and
+                        isinstance(e.value, str) for e in node.elts):
+                table, coldef = (e.value for e in node.elts)
+                if (rel_path, table) in self.schemas and \
+                        coldef.split():
+                    self._add_column(rel_path, table,
+                                     coldef.split()[0])
+        del source   # kept in the signature for symmetry/debugging
+
+    def _add_column(self, rel_path: str, table: str,
+                    column: str) -> None:
+        schema = self.schemas.get((rel_path, table))
+        if schema is not None and column not in schema.columns:
+            schema.columns = schema.columns + (column,)
+
+    @staticmethod
+    def _parse_columns(text: str, open_paren: int
+                       ) -> Tuple[List[str], Optional[str]]:
+        """Column names (and the PRIMARY KEY column) of the
+        parenthesized body starting at `open_paren`."""
+        depth = 0
+        end = open_paren
+        for i in range(open_paren, len(text)):
+            if text[i] == '(':
+                depth += 1
+            elif text[i] == ')':
+                depth -= 1
+                if depth == 0:
+                    end = i
+                    break
+        body = text[open_paren + 1:end]
+        parts, buf, depth = [], [], 0
+        for ch in body:
+            if ch == '(':
+                depth += 1
+            elif ch == ')':
+                depth -= 1
+            if ch == ',' and depth == 0:
+                parts.append(''.join(buf))
+                buf = []
+            else:
+                buf.append(ch)
+        if buf:
+            parts.append(''.join(buf))
+        columns, pk = [], None
+        for part in parts:
+            tokens = part.split()
+            if not tokens:
+                continue
+            first = tokens[0]
+            if first.upper() in _SQL_CONSTRAINT_KEYWORDS:
+                # Table-level `PRIMARY KEY (a, b)` names its columns.
+                if first.upper() == 'PRIMARY' and '(' in part:
+                    inner = part[part.index('(') + 1:part.rindex(')')]
+                    cols = [c.strip() for c in inner.split(',')]
+                    if cols and pk is None:
+                        pk = cols[0]
+                continue
+            columns.append(first)
+            if 'PRIMARY KEY' in part.upper():
+                pk = first
+        return columns, pk
+
+    # -- observability names -------------------------------------------------
+
+    def _harvest_names(self, rel_path: str, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            callee = func.attr if isinstance(func, ast.Attribute) \
+                else getattr(func, 'id', '')
+            name = None
+            kind = None
+            if callee in ('inc_counter', 'observe', 'gauge'):
+                name = self._const_arg(node, 0)
+                kind = 'metric'
+                if name is not None and not name.startswith('xsky_'):
+                    name = None   # histogram .observe(value) etc.
+            elif callee == 'span':
+                name, kind = self._const_arg(node, 0), 'span'
+            elif callee == 'request_span':
+                name, kind = self._const_arg(node, 1), 'span'
+            elif callee == 'inject' and \
+                    isinstance(func, ast.Attribute) and \
+                    isinstance(func.value, ast.Name) and \
+                    func.value.id == 'chaos':
+                name, kind = self._const_arg(node, 0), 'chaos'
+            elif callee == 'record_recovery_event':
+                name = self._const_arg(node, 0)
+                if name is None:
+                    for kw in node.keywords:
+                        if kw.arg == 'event_type' and \
+                                isinstance(kw.value, ast.Constant) and \
+                                isinstance(kw.value.value, str):
+                            name = kw.value.value
+                kind = 'journal'
+            if name is not None and kind is not None:
+                self.names[kind].setdefault(name, []).append(
+                    (rel_path, node.lineno))
+
+    @staticmethod
+    def _const_arg(node: ast.Call, i: int) -> Optional[str]:
+        if len(node.args) > i and \
+                isinstance(node.args[i], ast.Constant) and \
+                isinstance(node.args[i].value, str):
+            return node.args[i].value
+        return None
+
+    # -- SQL constants + paged reads (schema-bearing modules) ----------------
+
+    _SQL_VERBS = ('SELECT', 'INSERT', 'UPDATE ', 'DELETE FROM')
+
+    def _harvest_sql(self, mod: ModuleIndex, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    any(v in node.value for v in self._SQL_VERBS):
+                mod.sql_constants.append((node.lineno, node.value))
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            page_call = None
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    callee = sub.func.attr if isinstance(
+                        sub.func, ast.Attribute) else getattr(
+                            sub.func, 'id', '')
+                    if callee in ('page_sql', '_page_sql'):
+                        page_call = sub
+                        break
+            if page_call is None:
+                continue
+            texts = [c.value for c in ast.walk(node)
+                     if isinstance(c, ast.Constant) and
+                     isinstance(c.value, str)]
+            mod.paged_reads.append(PagedRead(
+                func=node.name, lineno=page_call.lineno,
+                sql=' '.join(texts)))
+
+    # -- module-level mutable containers -------------------------------------
+
+    def _harvest_containers(self, mod: ModuleIndex, tree: ast.Module,
+                            lines: List[str]) -> None:
+        def single_writer_marked(lineno: int) -> bool:
+            if lineno <= len(lines) and \
+                    '# single-writer ok' in lines[lineno - 1]:
+                return True
+            i = lineno - 1
+            while 1 <= i <= len(lines) and \
+                    lines[i - 1].strip().startswith('#'):
+                if '# single-writer ok' in lines[i - 1]:
+                    return True
+                i -= 1
+            return False
+
+        for node in tree.body:
+            targets: List[ast.expr] = []
+            value: Optional[ast.expr] = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and \
+                    node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None:
+                continue
+            kind = self._container_kind(value)
+            lock = self._is_lock_ctor(value)
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if lock:
+                    mod.locks.add(t.id)
+                elif kind is not None:
+                    mod.containers[t.id] = GlobalContainer(
+                        name=t.id, rel_path=mod.rel_path,
+                        lineno=node.lineno, kind=kind,
+                        exempt=single_writer_marked(node.lineno))
+        if mod.containers:
+            self._harvest_mutations(mod, tree)
+
+    @staticmethod
+    def _container_kind(value: ast.expr) -> Optional[str]:
+        if isinstance(value, ast.List):
+            return 'list'
+        if isinstance(value, ast.Dict):
+            return 'dict'
+        if isinstance(value, ast.Set):
+            return 'set'
+        if isinstance(value, ast.Call):
+            func = value.func
+            name = func.attr if isinstance(func, ast.Attribute) \
+                else getattr(func, 'id', '')
+            if name in _CONTAINER_CTORS:
+                return 'deque' if name == 'deque' else name
+        return None
+
+    @staticmethod
+    def _is_lock_ctor(value: ast.expr) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        func = value.func
+        name = func.attr if isinstance(func, ast.Attribute) \
+            else getattr(func, 'id', '')
+        return name in ('Lock', 'RLock')
+
+    def _harvest_mutations(self, mod: ModuleIndex,
+                           tree: ast.Module) -> None:
+        containers = mod.containers
+        locks = mod.locks
+
+        def lock_held(node: ast.With) -> bool:
+            for item in node.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Name) and expr.id in locks:
+                    return True
+                if isinstance(expr, ast.Attribute) and \
+                        expr.attr in locks:
+                    return True
+            return False
+
+        def record(name: str, lineno: int, func: str,
+                   guarded: bool) -> None:
+            containers[name].mutations.append(
+                MutationSite(func=func, lineno=lineno, guarded=guarded))
+
+        def walk(node: ast.AST, func: str, guarded: bool,
+                 global_decls: Set[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_func = func
+                child_guarded = guarded
+                child_globals = global_decls
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    child_func = child.name
+                    child_guarded = False   # lock scope is lexical,
+                    # but a nested def runs when *called*, not here.
+                    child_globals = {
+                        n for g in ast.walk(child)
+                        if isinstance(g, ast.Global) for n in g.names}
+                elif isinstance(child, ast.With) and lock_held(child):
+                    child_guarded = True
+                self._visit_mutation(child, child_func, child_guarded,
+                                     child_globals, containers, record)
+                walk(child, child_func, child_guarded, child_globals)
+
+        walk(tree, '<module>', False, set())
+
+    @staticmethod
+    def _visit_mutation(node: ast.AST, func: str, guarded: bool,
+                        global_decls: Set[str], containers,
+                        record) -> None:
+        def target_name(expr: ast.expr) -> Optional[str]:
+            if isinstance(expr, ast.Subscript) and \
+                    isinstance(expr.value, ast.Name):
+                return expr.value.id
+            # Rebinding the global itself (`global X; X = ...`) is a
+            # write too; a bare `X = ...` without the declaration just
+            # shadows locally and is not one.
+            if isinstance(expr, ast.Name) and expr.id in global_decls:
+                return expr.id
+            return None
+
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in containers:
+            record(node.func.value.id, node.lineno, func, guarded)
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                name = target_name(t)
+                if name in containers:
+                    record(name, node.lineno, func, guarded)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                name = target_name(t)
+                if name in containers:
+                    record(name, node.lineno, func, guarded)

@@ -13,11 +13,12 @@ from typing import List
 from tools.xskylint import engine
 from tools.xskylint.rules import concurrency
 from tools.xskylint.rules import contracts
+from tools.xskylint.rules import crossfile
 from tools.xskylint.rules import observability
 from tools.xskylint.rules import statedb
 
 _RULE_CLASSES = (concurrency.RULES + observability.RULES +
-                 statedb.RULES + contracts.RULES)
+                 statedb.RULES + contracts.RULES + crossfile.RULES)
 
 
 def all_rules() -> List[engine.Rule]:

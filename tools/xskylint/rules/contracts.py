@@ -29,25 +29,30 @@ REGISTRY_REL_PATH = 'skypilot_tpu/utils/env_registry.py'
 DOCS_REL_PATH = 'docs/reference/environment.md'
 
 
-def load_registry_module(root: str):
-    """The env_registry module, executed standalone (it is
-    dependency-free by contract; no package import, no ast.parse — the
-    engine's parse-once property stays intact). None when the file
-    does not exist (synthetic fixture trees)."""
-    path = os.path.join(root, REGISTRY_REL_PATH)
+def load_standalone_module(root: str, rel_path: str, name: str):
+    """Execute a dependency-free registry module standalone (no
+    package import, no ast.parse — the engine's parse-once property
+    stays intact). None when the file does not exist (synthetic
+    fixture trees). Shared by the env-registry and name-registry
+    rules."""
+    path = os.path.join(root, rel_path)
     if not os.path.exists(path):
         return None
-    spec = importlib.util.spec_from_file_location('_xsky_env_registry',
-                                                  path)
+    spec = importlib.util.spec_from_file_location(name, path)
     module = importlib.util.module_from_spec(spec)
-    # dataclasses (used by the registry) resolves the defining module
-    # through sys.modules during class creation.
+    # dataclasses (used by the registries) resolves the defining
+    # module through sys.modules during class creation.
     sys.modules[spec.name] = module
     try:
         spec.loader.exec_module(module)
     finally:
         sys.modules.pop(spec.name, None)
     return module
+
+
+def load_registry_module(root: str):
+    return load_standalone_module(root, REGISTRY_REL_PATH,
+                                  '_xsky_env_registry')
 
 
 class EnvRegistryRule(engine.Rule):

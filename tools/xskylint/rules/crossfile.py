@@ -1,0 +1,403 @@
+"""Whole-program rules (pass 2): run over the :class:`ProjectIndex`
+the engine builds from the shared per-file ASTs (pass 1, one
+``ast.parse`` per file — see ``tools/xskylint/index.py``).
+
+verb-wiring: every payloads verb resolves to a real function with a
+compatible signature AND is reachable from the client layer; every
+client-posted verb exists in payloads. The 5-layer threading
+(cli→sdk→remote_client→payloads→core) every plane PR did by hand,
+now mechanically checked.
+
+name-registry: every metric/span/chaos/journal name the tree mints is
+declared in ``skypilot_tpu/utils/names_registry.py`` and the generated
+``docs/reference/observability-names.md`` is current — the env-registry
+triangle (registry + generated docs + lint) applied to observability.
+
+lock-discipline: a module-level mutable container mutated from more
+than one function is either lock-guarded at every mutation site or
+carries a ``# single-writer ok: <why>`` exemption — the static prep
+for the horizontal-control-plane arc ("make every in-memory singleton
+multi-writer-safe").
+
+schema-consistency: column names in SQL literals exist in the
+corresponding ``CREATE TABLE``, and ``page_sql``-paged reads order by
+an indexed column (or the primary key) so paging never degrades into
+a full sort at fleet scale.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional, Set
+
+from tools.xskylint import engine
+from tools.xskylint import index as index_mod
+from tools.xskylint.rules.contracts import load_standalone_module
+
+NAMES_REGISTRY_REL_PATH = 'skypilot_tpu/utils/names_registry.py'
+NAMES_DOCS_REL_PATH = 'docs/reference/observability-names.md'
+
+
+class VerbWiringRule(engine.Rule):
+    """Both directions of the payloads contract: a registered verb
+    must dispatch to an existing function whose signature accepts the
+    forwarded body fields (and whose required params are all
+    forwarded), and must be posted by the client layer with an sdk
+    entry point reaching it; a posted verb string must exist in
+    payloads. An unwired verb fails at runtime on first use — which
+    for rarely-used admin verbs is in an incident, not in CI."""
+
+    id = 'verb-wiring'
+    needs_index = True
+    rationale = ('payloads verbs must resolve to real functions with '
+                 'compatible signatures and be wired through '
+                 'remote_client/sdk; posted verbs must exist in '
+                 'payloads')
+
+    def finalize(self, run: engine.RunContext) -> None:
+        idx = getattr(run, 'index', None)
+        if idx is None or index_mod.PAYLOADS_PATH not in idx.modules:
+            return
+        for verb, entry in sorted(idx.verbs.items()):
+            self._check_targets(run, idx, verb, entry)
+            self._check_reachability(run, idx, verb, entry)
+        for verb in sorted(idx.posts):
+            if verb in idx.verbs:
+                continue
+            for rel, linenos in sorted(idx.posts[verb].items()):
+                run.report(
+                    self.id, rel, linenos[0],
+                    f'posts verb {verb!r} which is not registered in '
+                    f'{index_mod.PAYLOADS_PATH} — the request would '
+                    'be rejected with BadRequest')
+
+    def _check_targets(self, run: engine.RunContext, idx, verb: str,
+                       entry) -> None:
+        for module, fn in entry.targets:
+            symbols = idx.module_symbols(module)
+            if symbols is None:
+                # Module outside the scanned set: only flag when it
+                # does not exist on disk at all (a partial lint run
+                # must not guess about unscanned-but-real modules).
+                base = os.path.join(run.root, module.replace('.', '/'))
+                if not (os.path.exists(base + '.py') or
+                        os.path.isdir(base)):
+                    run.report(
+                        self.id, index_mod.PAYLOADS_PATH, entry.lineno,
+                        f'verb {verb!r} resolves to nonexistent '
+                        f'module {module}')
+                continue
+            if fn not in symbols:
+                run.report(
+                    self.id, index_mod.PAYLOADS_PATH, entry.lineno,
+                    f'verb {verb!r} dispatches to {module}.{fn} '
+                    'which does not exist')
+                continue
+            if entry.custom:
+                continue   # hand-written resolver: kwargs unknowable
+            functions = idx.module_functions(module) or {}
+            info = functions.get(fn)
+            if info is None:
+                continue   # a class or re-export: existence is enough
+            for field in entry.fields:
+                if not info.accepts(field):
+                    run.report(
+                        self.id, index_mod.PAYLOADS_PATH, entry.lineno,
+                        f'verb {verb!r} forwards body field '
+                        f'{field!r} but {module}.{fn} does not accept '
+                        'it')
+            for req in info.required:
+                if req not in entry.fields:
+                    run.report(
+                        self.id, index_mod.PAYLOADS_PATH, entry.lineno,
+                        f'verb {verb!r} never forwards required '
+                        f'parameter {req!r} of {module}.{fn} — the '
+                        'dispatch would raise TypeError')
+
+    def _check_reachability(self, run: engine.RunContext, idx,
+                            verb: str, entry) -> None:
+        client_scanned = any(
+            p in idx.modules for p in (index_mod.REMOTE_CLIENT_PATH,
+                                       index_mod.SDK_PATH))
+        if not client_scanned:
+            return
+        if verb not in idx.posts:
+            run.report(
+                self.id, index_mod.PAYLOADS_PATH, entry.lineno,
+                f'verb {verb!r} is registered but never posted by '
+                'remote_client or sdk — dead wire surface (or a '
+                'half-threaded new verb)')
+            return
+        if index_mod.SDK_PATH in idx.modules and \
+                not idx.sdk_reaches(verb):
+            run.report(
+                self.id, index_mod.PAYLOADS_PATH, entry.lineno,
+                f'verb {verb!r} is posted by remote_client but no '
+                'sdk entry point reaches that method — clients '
+                'cannot call it')
+
+
+class NameRegistryRule(engine.Rule):
+    """Every harvested observability name (metric mint sites,
+    ``tracing.span``/``request_span`` names, ``chaos.inject`` points,
+    ``record_recovery_event`` kinds) must be declared in
+    names_registry.py, and the generated reference page must
+    byte-match ``render_markdown()``. A mislabeled metric or an
+    unregistered journal kind silently corrupts the goodput/SLO
+    numbers later PRs are gated on."""
+
+    id = 'name-registry'
+    needs_index = True
+    rationale = ('every minted metric/span/chaos/journal name must be '
+                 'declared in utils/names_registry.py; the docs table '
+                 'is generated from it')
+
+    def finalize(self, run: engine.RunContext) -> None:
+        idx = getattr(run, 'index', None)
+        if idx is None:
+            return
+        harvested = {
+            kind: {name: sites for name, sites in names.items()
+                   if sites[0][0].startswith('skypilot_tpu/')}
+            for kind, names in idx.names.items()}
+        if not any(harvested.values()):
+            return
+        module = load_standalone_module(
+            run.root, NAMES_REGISTRY_REL_PATH, '_xsky_names_registry')
+        if module is None:
+            for kind, names in sorted(harvested.items()):
+                for name, sites in sorted(names.items()):
+                    path, line = sites[0]
+                    run.report(self.id, path, line,
+                               f'{kind} name {name!r} is minted but '
+                               f'{NAMES_REGISTRY_REL_PATH} does not '
+                               'exist')
+            return
+        for kind, names in sorted(harvested.items()):
+            declared = module.declared_names(kind)
+            for name, sites in sorted(names.items()):
+                if name in declared:
+                    continue
+                path, line = sites[0]
+                run.report(
+                    self.id, path, line,
+                    f'{kind} name {name!r} is minted here but not '
+                    f'declared in {NAMES_REGISTRY_REL_PATH} — add an '
+                    'ObsName entry and regenerate the docs page')
+        for (kind, name), obs in sorted(module.REGISTRY.items()):
+            if not getattr(obs, 'doc', '').strip():
+                run.report(self.id, NAMES_REGISTRY_REL_PATH, 1,
+                           f'registry entry ({kind}, {name}) has an '
+                           'empty doc line')
+        self._check_docs(run, module)
+
+    def _check_docs(self, run: engine.RunContext, module) -> None:
+        if not os.path.isdir(os.path.join(run.root, 'docs')):
+            return   # synthetic fixture trees
+        docs_path = os.path.join(run.root, NAMES_DOCS_REL_PATH)
+        expected = module.render_markdown()
+        regen = ('python -m skypilot_tpu.utils.names_registry > '
+                 f'{NAMES_DOCS_REL_PATH}')
+        if not os.path.exists(docs_path):
+            run.report(self.id, NAMES_DOCS_REL_PATH, 1,
+                       f'missing — generate it with `{regen}`')
+            return
+        with open(docs_path, encoding='utf-8') as f:
+            if f.read() != expected:
+                run.report(self.id, NAMES_DOCS_REL_PATH, 1,
+                           'is stale: it no longer matches the '
+                           f'registry rendering — regenerate with '
+                           f'`{regen}`')
+
+
+class LockDisciplineRule(engine.Rule):
+    """A module-level dict/list/set/deque mutated from more than one
+    function must have every mutation site lexically inside a
+    ``with <lock>:`` over a module-level ``threading.Lock/RLock``, or
+    carry a ``# single-writer ok: <why>`` exemption on its definition.
+    Module-level (import-time) writes don't count — nothing else runs
+    yet. This is the static half of the horizontal-control-plane prep:
+    N API servers mean every surviving singleton is multi-writer."""
+
+    id = 'lock-discipline'
+    needs_index = True
+    rationale = ('module-level mutable containers mutated from '
+                 'several functions need lock-guarded mutation sites '
+                 'or a # single-writer ok: exemption')
+
+    def finalize(self, run: engine.RunContext) -> None:
+        idx = getattr(run, 'index', None)
+        if idx is None:
+            return
+        for rel, mod in sorted(idx.modules.items()):
+            if not rel.startswith('skypilot_tpu/'):
+                continue
+            for name, cont in sorted(mod.containers.items()):
+                if cont.exempt:
+                    continue
+                if len(cont.mutating_functions()) <= 1:
+                    continue   # single writer: safe by construction
+                unguarded = cont.unguarded()
+                if not unguarded:
+                    continue
+                sites = ', '.join(f'{m.func}:{m.lineno}'
+                                  for m in unguarded[:4])
+                more = len(unguarded) - 4
+                if more > 0:
+                    sites += f' (+{more} more)'
+                run.report(
+                    self.id, rel, cont.lineno,
+                    f'module-level {cont.kind} {name!r} is mutated '
+                    f'from {len(cont.mutating_functions())} functions '
+                    f'with unguarded site(s) at {sites} — wrap each '
+                    'mutation in `with <module lock>:` or mark the '
+                    'definition `# single-writer ok: <why>`')
+
+
+# SQL keywords/functions that a naive identifier scan would otherwise
+# mistake for column names.
+_SQL_NOISE = frozenset({
+    'select', 'from', 'where', 'and', 'or', 'not', 'null', 'in', 'is',
+    'like', 'between', 'escape', 'glob', 'order', 'by', 'group',
+    'limit', 'offset', 'desc', 'asc', 'on', 'as', 'set', 'values',
+    'into', 'insert', 'update', 'delete', 'create', 'table', 'index',
+    'if', 'exists', 'primary', 'key', 'unique', 'default', 'replace',
+    'case', 'when', 'then', 'else', 'end', 'join', 'left', 'inner',
+    'outer', 'distinct', 'count', 'max', 'min', 'sum', 'avg',
+    'coalesce', 'length', 'strftime', 'datetime', 'rowid', 'integer',
+    'text', 'real', 'blob',
+})
+
+_INSERT_RE = re.compile(
+    r'INSERT(?:\s+OR\s+\w+)?\s+INTO\s+(\w+)\s*\(([^)]*)\)', re.I)
+_UPDATE_RE = re.compile(
+    r'UPDATE\s+(\w+)\s+SET\s+(.*?)(?:\s+WHERE\b|$)', re.I | re.S)
+_DELETE_RE = re.compile(r'DELETE\s+FROM\s+(\w+)', re.I)
+_FROM_RE = re.compile(r'\bFROM\s+(\w+)', re.I)
+_WHERE_SPLIT_RE = re.compile(r'\bWHERE\b', re.I)
+_COMPARED_COL_RE = re.compile(
+    r'\b([A-Za-z_]\w*)\s*(?:=|!=|<>|>=|<=|>|<)|'
+    r'\b([A-Za-z_]\w*)\s+(?:IN|IS|LIKE|BETWEEN)\b', re.I)
+_ORDER_COL_RE = re.compile(r'ORDER\s+BY\s+([A-Za-z_]\w*)', re.I)
+_SET_LHS_RE = re.compile(r'^\s*([A-Za-z_]\w*)\s*=')
+_ALIAS_RE = re.compile(r'\bAS\s+([A-Za-z_]\w*)', re.I)
+
+
+class SchemaConsistencyRule(engine.Rule):
+    """Within each schema-bearing module (the files that own
+    ``CREATE TABLE`` statements): INSERT column lists, UPDATE SET
+    clauses, WHERE/ORDER BY column references must name real columns
+    of the table, and every ``page_sql``-paged read must order by the
+    primary key or a column some declared index covers — a typo'd
+    column is a runtime OperationalError on a path tests may never
+    drive, and an unindexed paged ORDER BY is a full sort per page at
+    fleet scale."""
+
+    id = 'schema-consistency'
+    needs_index = True
+    rationale = ('SQL literals must reference declared columns, and '
+                 'page_sql-paged reads must order by an indexed '
+                 'column (or the primary key)')
+
+    def finalize(self, run: engine.RunContext) -> None:
+        idx = getattr(run, 'index', None)
+        if idx is None:
+            return
+        for rel, mod in sorted(idx.modules.items()):
+            tables = {t: s for (p, t), s in idx.schemas.items()
+                      if p == rel}
+            if not tables:
+                continue
+            for lineno, text in mod.sql_constants:
+                self._check_constant(run, rel, lineno, text, tables)
+            for pr in mod.paged_reads:
+                self._check_paged_read(run, rel, pr, tables)
+
+    def _check_constant(self, run, rel: str, lineno: int, text: str,
+                        tables) -> None:
+        if 'CREATE TABLE' in text or 'CREATE INDEX' in text:
+            return   # the schema itself
+        for m in _INSERT_RE.finditer(text):
+            schema = tables.get(m.group(1))
+            if schema is None:
+                continue
+            for col in m.group(2).split(','):
+                self._check_col(run, rel, lineno, col.strip(),
+                                schema, 'INSERT list')
+        for m in _UPDATE_RE.finditer(text):
+            schema = tables.get(m.group(1))
+            if schema is None:
+                continue
+            for assign in m.group(2).split(','):
+                lhs = _SET_LHS_RE.match(assign)
+                # Assignments only: a split inside COALESCE(a, b)
+                # yields '=' -less fragments that are not columns.
+                if lhs is not None:
+                    self._check_col(run, rel, lineno, lhs.group(1),
+                                    schema, 'UPDATE SET clause')
+        table = self._single_table(text, tables)
+        if table is None:
+            return
+        schema = tables[table]
+        aliases = {m.group(1) for m in _ALIAS_RE.finditer(text)}
+        parts = _WHERE_SPLIT_RE.split(text)
+        for clause in parts[1:]:
+            for m in _COMPARED_COL_RE.finditer(clause):
+                col = m.group(1) or m.group(2)
+                if col not in aliases:
+                    self._check_col(run, rel, lineno, col, schema,
+                                    'WHERE clause')
+        for m in _ORDER_COL_RE.finditer(text):
+            if m.group(1) not in aliases:
+                self._check_col(run, rel, lineno, m.group(1), schema,
+                                'ORDER BY')
+
+    @staticmethod
+    def _single_table(text: str, tables) -> Optional[str]:
+        """The one known table a statement works over — WHERE/ORDER
+        checks only run when the reference is unambiguous."""
+        named: Set[str] = set()
+        for regex in (_FROM_RE, _DELETE_RE, _UPDATE_RE, _INSERT_RE):
+            named.update(m.group(1) for m in regex.finditer(text))
+        known = {t for t in named if t in tables}
+        return known.pop() if len(known) == 1 else None
+
+    def _check_col(self, run, rel: str, lineno: int, col: str,
+                   schema, where: str) -> None:
+        if not col or not col[0].isalpha():
+            return
+        if col.lower() in _SQL_NOISE or col.isdigit():
+            return
+        if col in schema.columns:
+            return
+        run.report(
+            self.id, rel, lineno,
+            f'{where} references column {col!r} which does not exist '
+            f'in CREATE TABLE {schema.table} '
+            f'({rel}:{schema.lineno})')
+
+    def _check_paged_read(self, run, rel: str, pr, tables) -> None:
+        # First FROM that names a known table — docstring prose like
+        # "read from the clusters table" must not shadow the query.
+        schema = next(
+            (tables[m.group(1)] for m in _FROM_RE.finditer(pr.sql)
+             if m.group(1) in tables), None)
+        if schema is None:
+            return
+        om = _ORDER_COL_RE.search(pr.sql)
+        if om is None:
+            return   # unordered paging is select-limit territory
+        col = om.group(1)
+        if col == schema.primary_key or col.lower() == 'rowid':
+            return
+        if any(col in cols for cols in schema.indexes.values()):
+            return
+        run.report(
+            self.id, rel, pr.lineno,
+            f'page_sql-paged read in {pr.func} orders {schema.table} '
+            f'by {col!r} with no covering index — every page pays a '
+            'full sort; add a CREATE INDEX on it')
+
+
+RULES = [VerbWiringRule, NameRegistryRule, LockDisciplineRule,
+         SchemaConsistencyRule]
