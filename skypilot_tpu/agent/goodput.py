@@ -110,6 +110,10 @@ _SPAN_CATEGORIES: Dict[str, str] = {
     'jobs.shrink_gang': RECOVERY,
     'jobs.grow_gang': RECOVERY,
     'jobs.recover': RECOVERY,
+    # Checkpoint-restore latency (agent/checkpointd.py): the tier walk
+    # a fresh incarnation pays before its first step is recovery work,
+    # not init barrier.
+    'jobs.ckpt_restore': RECOVERY,
 }
 _SPAN_PRIORITY = (QUEUE_WAIT, PROVISION, SETUP_BOOTSTRAP, RECOVERY)
 

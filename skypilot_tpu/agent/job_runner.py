@@ -12,6 +12,7 @@ import json
 import os
 import sys
 
+from skypilot_tpu.agent import checkpointd
 from skypilot_tpu.agent import gang
 from skypilot_tpu.agent import job_lib
 from skypilot_tpu.agent import telemetry
@@ -91,6 +92,7 @@ def run_job(job_id: int, root: str = None) -> int:
     try:
         host_envs = gang.build_host_envs(info, spec.get('envs') or {},
                                          exclude_hosts=exclude)
+        roots = [r.remote_runtime_root() for r in runners]
         for rank, env in enumerate(host_envs):
             env['XSKY_JOB_ID'] = str(job_id)
             # Per-rank telemetry spool on the rank's OWN host: the
@@ -102,6 +104,27 @@ def run_job(job_id: int, root: str = None) -> int:
                 telemetry.ENV_DIR,
                 telemetry.spool_dir(runners[rank].remote_runtime_root(),
                                     job_id))
+            # Checkpoint tiers (agent/checkpointd.py): the rank's own
+            # local tier on its host root — job-id-AGNOSTIC, so a
+            # relaunch/resubmit under a new cluster job id still finds
+            # the previous incarnation's shards — plus the K next
+            # hosts' roots as the peer tier (ring order, DCN
+            # neighbours). Task envs may override for tests.
+            env.setdefault(checkpointd.ENV_DIR,
+                           f'{roots[rank]}/ckpt')
+            # Replica count from the RANK's env (task/controller
+            # knobs land there via the job spec) — this agent
+            # process's own environment does not see them.
+            try:
+                k = int(env.get(checkpointd.ENV_REPLICAS) or
+                        checkpointd.replicas())
+            except ValueError:
+                k = checkpointd.replicas()
+            k = min(max(0, k), len(roots) - 1)
+            if k > 0:
+                env.setdefault(checkpointd.ENV_PEER_DIRS, '\n'.join(
+                    f'{roots[(rank + i) % len(roots)]}/ckpt'
+                    for i in range(1, k + 1)))
 
         setup_cmd, run_spec_cmd, cwd = _resolve_commands(spec, host_envs)
         if setup_cmd:

@@ -617,6 +617,12 @@ def record_samples(cluster: str, job_id: Optional[int],
                 'hb_ts': s.get('hb_ts'),
                 'verdict': result[rank],
                 'resume_step': s.get('resume_step'),
+                # Checkpoint freshness stamped by the checkpointd
+                # worker (agent/checkpointd.py): newest snapshot step
+                # + its wall-clock ts, feeding the scrape-time
+                # xsky_ckpt_freshness_age_seconds gauge.
+                'ckpt_step': s.get('ckpt_step'),
+                'ckpt_ts': s.get('ckpt_ts'),
             })
         state.record_workload_telemetry(cluster, job_id, rows, ts=now)
     except Exception:  # pylint: disable=broad-except

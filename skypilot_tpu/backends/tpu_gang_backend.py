@@ -503,10 +503,19 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             ips = handle.cluster_info.get_feasible_ips(internal=True)
             cmds = {r: run_cmd(r, ips) for r in range(task.num_nodes)}
             run_cmd = _dispatch_script(cmds)
+        from skypilot_tpu.agent import checkpointd
         from skypilot_tpu.utils import docker_utils
+        # Control-plane checkpoint knobs (cadence clamps, MTTF hint,
+        # journal scope, master switch) reach the workload's env; task
+        # envs (the jobs controller threads its own) win. The per-rank
+        # dir/peer wiring stays with the gang launcher.
+        envs = dict(task.envs_and_secrets)
+        for key in checkpointd.FORWARD_ENV:
+            if key in os.environ:
+                envs.setdefault(key, os.environ[key])
         return {
             'run': run_cmd,
-            'envs': task.envs_and_secrets,
+            'envs': envs,
             'num_nodes': task.num_nodes,
             'cwd': self._job_cwd(handle, task),
             # Container runtime: the on-host job runner wraps setup/run

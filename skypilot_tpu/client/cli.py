@@ -763,6 +763,10 @@ def _top_rows(cluster: Optional[str]) -> List[dict]:
             prof = profs.get((cl, job_id, rank))
             out.append(dict(
                 row,
+                # Checkpoint freshness at pull time (None when the
+                # rank never snapshotted): the replay exposure.
+                ckpt_age_s=(round(pulled - row['ckpt_ts'], 1)
+                            if row.get('ckpt_ts') else None),
                 # Ages at PULL time: the spool truth when last read
                 # (age_s says how stale the row itself is).
                 hb_age_s=round(pulled - (row['hb_ts'] or 0), 1),
@@ -852,12 +856,19 @@ def top(cluster, watch, interval, as_json):
             peaks = [p for p in peaks if p]
             hbm = (f'{max(peaks) / (1 << 30):.1f}GiB'
                    if peaks else '-')
+            # Newest snapshot across the gang: step @ age (the gang's
+            # replay exposure on the next failure); '-' = no rank has
+            # checkpointed yet.
+            snaps = [(r['ckpt_step'], r['ckpt_age_s']) for r in group
+                     if r.get('ckpt_step') is not None]
+            ckpt = (f'{max(snaps)[0]}@{_age_str(max(snaps)[1])}'
+                    if snaps else '-')
             click.echo(
                 f'  {first["cluster"]} job {first["job_id"]}: '
                 f'{len(group)} rank(s), skew={first["rank_skew"]}, '
                 f'goodput={goodput}, '
                 f'loss={first.get("goodput_loss") or "-"}, '
-                f'hbm={hbm}, stalled={stalls}, '
+                f'ckpt={ckpt}, hbm={hbm}, stalled={stalls}, '
                 f'pulled {_age_str(now - (first["ts"] or 0))} ago')
 
     if not watch:

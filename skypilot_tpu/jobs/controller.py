@@ -18,6 +18,7 @@ from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
 from skypilot_tpu import state as global_state
 from skypilot_tpu import task as task_lib
+from skypilot_tpu.agent import checkpointd
 from skypilot_tpu.agent import goodput as goodput_lib
 from skypilot_tpu.agent import job_lib as cluster_job_lib
 from skypilot_tpu.agent import telemetry
@@ -173,6 +174,19 @@ class JobsController:
             goodput_lib.record_ledger(self.cluster_name,
                                       job_id=self.job_id, now=now)
 
+    def _ckpt_env(self) -> Dict[str, str]:
+        """Checkpoint-plane env threaded onto every (re)submit: the
+        journal scope restores account under, and the MTTF the cadence
+        controller plans against — derived from THIS job's recovery
+        journal, so a preemption-prone placement checkpoints more
+        often (never raises; no evidence yields the default)."""
+        return {
+            checkpointd.ENV_SCOPE: f'job/{self.job_id}',
+            checkpointd.ENV_MTTF: str(
+                round(checkpointd.derive_mttf(f'job/{self.job_id}'),
+                      1)),
+        }
+
     def _recover_from_stall(self, stalled: Dict[int, str]):
         """Hung/dead ranks take the SAME recovery path as a preemption,
         journalled and trace-linked (`jobs.stall_recover` span →
@@ -272,7 +286,8 @@ class JobsController:
                     handle, self.task, excluded_ranks=target,
                     cancel_job_id=cluster_job_id,
                     extra_env={'XSKY_ELASTIC_GENERATION':
-                               str(self._elastic.generation + 1)})
+                               str(self._elastic.generation + 1),
+                               **self._ckpt_env()})
                 # Journal only once the resubmit stuck: a failed shrink
                 # falls back to _recover_from_stall, which writes its
                 # own rank_stall/recovered pair (no double counting).
@@ -349,7 +364,8 @@ class JobsController:
                     handle, self.task, excluded_ranks=[],
                     cancel_job_id=cluster_job_id,
                     extra_env={'XSKY_ELASTIC_GENERATION':
-                               str(self._elastic.generation + 1)})
+                               str(self._elastic.generation + 1),
+                               **self._ckpt_env()})
                 self._elastic.regrow()
                 jobs_state.set_cluster_job_id(self.job_id, new_job_id)
                 self._persist_gang_state()
@@ -427,7 +443,8 @@ class JobsController:
             # trace (handed over via XSKY_TRACE_CONTEXT at controller
             # spawn); a respawned controller roots a fresh trace.
             self.task.update_envs({'XSKY_ELASTIC_GENERATION':
-                                   str(self._elastic.generation)})
+                                   str(self._elastic.generation),
+                                   **self._ckpt_env()})
             with tracing.span('jobs.launch_task', job=self.job_id,
                               cluster=self.cluster_name):
                 handle, cluster_job_id = self.strategy.launch()
@@ -588,7 +605,8 @@ class JobsController:
             # the generation must see the relaunch as a new one.
             self._elastic.generation += 1
             self.task.update_envs({'XSKY_ELASTIC_GENERATION':
-                                   str(self._elastic.generation)})
+                                   str(self._elastic.generation),
+                                   **self._ckpt_env()})
             with tracing.span(
                     'jobs.recover', job=self.job_id,
                     cluster=self.cluster_name,

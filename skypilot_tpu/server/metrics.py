@@ -38,6 +38,8 @@ and gauges computed at scrape time from the state DB:
   * xsky_goodput_loss_seconds_total{cluster,cause}  (the goodput
     ledger's decomposition of non-productive wall time, from each
     live cluster's newest persisted roll-up)
+  * xsky_ckpt_freshness_age_seconds{cluster,job,rank}  (seconds since
+    the rank's newest checkpoint snapshot — the replay exposure)
   * xsky_serve_slo_burn_rate{service,window}  (worst objective's burn;
     >= 1 spends the error budget faster than it accrues)
   * xsky_serve_replica_ttft_p99_seconds{service,replica}
@@ -191,6 +193,7 @@ def _render_workload_gauges() -> List[str]:
         lines.append('# TYPE xsky_workload_last_heartbeat_age_seconds '
                      'gauge')
         gangs: Dict[Tuple, Dict[int, Dict]] = {}
+        ckpt_lines = []
         for row in rows:
             # Keyed (and labeled) per cluster AND job: a cluster that
             # ran several jobs has latest rows for each — collapsing
@@ -203,6 +206,25 @@ def _render_workload_gauges() -> List[str]:
                 f'{_escape_label(row["cluster"])}",job='
                 f'"{row["job_id"]}",rank="{row["rank"]}"}} '
                 f'{now - (row["hb_ts"] or 0):.3f}')
+            # Checkpoint freshness rides the SAME row pass (one
+            # telemetry read per scrape): seconds since the rank's
+            # newest snapshot (agent/checkpointd.py stamps
+            # ckpt_step/ckpt_ts) — a climbing gauge means the async
+            # writer stopped, i.e. the replay exposure is growing.
+            if row.get('ckpt_ts') is not None:
+                ckpt_lines.append(
+                    'xsky_ckpt_freshness_age_seconds{cluster="'
+                    f'{_escape_label(row["cluster"])}",job='
+                    f'"{row["job_id"]}",rank="{row["rank"]}"}} '
+                    f'{now - row["ckpt_ts"]:.3f}')
+        if ckpt_lines:
+            lines.append('# HELP xsky_ckpt_freshness_age_seconds '
+                         'Seconds since the rank\'s newest checkpoint '
+                         'snapshot (replay exposure on the next '
+                         'failure).')
+            lines.append('# TYPE xsky_ckpt_freshness_age_seconds '
+                         'gauge')
+            lines.extend(ckpt_lines)
         # Goodput per cluster, from its NEWEST gang's samples.
         newest: Dict[str, Tuple] = {}
         for (cluster, job_id), ranks in gangs.items():

@@ -364,7 +364,13 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             # Workload-declared resume point (checkpoint restore): the
             # goodput ledger computes restart_replay against it.
             "ALTER TABLE workload_telemetry ADD COLUMN resume_step "
-            "INTEGER"):
+            "INTEGER",
+            # Checkpoint freshness (agent/checkpointd.py): the rank's
+            # newest snapshot step and its wall-clock timestamp —
+            # /metrics renders the freshness-age gauge from these.
+            "ALTER TABLE workload_telemetry ADD COLUMN ckpt_step "
+            "INTEGER",
+            "ALTER TABLE workload_telemetry ADD COLUMN ckpt_ts REAL"):
         try:
             conn.execute(migration)
         except sqlite3.OperationalError:
@@ -900,6 +906,29 @@ def sum_recovery_latency(scope: str,
     return float(row[0]) if row else 0.0
 
 
+def count_recovery_events(scope: str,
+                          event_types: Iterable[str] = ()) -> int:
+    """Journal rows for a scope filtered by event type, as ONE SQL
+    aggregate with no row limit — the failure census checkpointd's
+    MTTF derivation divides the lease lifetime by (a windowed
+    ``get_recovery_events`` read would count only the newest rows of
+    a journal-heavy job and overestimate MTTF). `scope` matches
+    exactly or as a path prefix, like :func:`get_recovery_events`."""
+    _flush_journal_buffer()   # coalesced appends: read-your-writes
+    types = list(event_types)
+    if not types:
+        return 0
+    prefix = (scope.rstrip('/').replace('\\', '\\\\')
+              .replace('%', '\\%').replace('_', '\\_'))
+    placeholders = ','.join('?' * len(types))
+    row = _read_one(
+        'SELECT COUNT(*) FROM recovery_events '
+        "WHERE (scope = ? OR scope LIKE ? ESCAPE '\\') "
+        f'AND event_type IN ({placeholders})',
+        [scope, prefix + '/%'] + types)
+    return int(row[0]) if row else 0
+
+
 # ---- trace spans -----------------------------------------------------------
 # Finished spans from utils/tracing: one row per span with parent/child
 # links, persisted with the journal's never-raise discipline and the
@@ -1026,7 +1055,7 @@ _workload_inserts = 0
 _WORKLOAD_COLS = ('ts, cluster, job_id, rank, phase, step, '
                   'step_time_ema_s, tokens_per_sec, host_mem_mb, '
                   'started_ts, last_progress_ts, hb_ts, verdict, '
-                  'resume_step')
+                  'resume_step, ckpt_step, ckpt_ts')
 
 
 def record_workload_telemetry(cluster: str, job_id: Optional[int],
@@ -1048,13 +1077,15 @@ def record_workload_telemetry(cluster: str, job_id: Optional[int],
         with _lock:
             conn.executemany(
                 f'INSERT INTO workload_telemetry ({_WORKLOAD_COLS}) '
-                'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)',
+                'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, '
+                '?, ?)',
                 [(ts, cluster, job_id, r.get('rank'), r.get('phase'),
                   r.get('step'), r.get('step_time_ema_s'),
                   r.get('tokens_per_sec'), r.get('host_mem_mb'),
                   r.get('started_ts'), r.get('last_progress_ts'),
                   r.get('hb_ts'), r.get('verdict'),
-                  r.get('resume_step'))
+                  r.get('resume_step'), r.get('ckpt_step'),
+                  r.get('ckpt_ts'))
                  for r in rows])
             # Prune on the FIRST batch too (short-lived CLI writers
             # never reach an amortized gate — same rationale as spans).
@@ -1099,7 +1130,8 @@ def get_workload_telemetry(cluster: Optional[str] = None,
     rows = _read(query, args)
     out = []
     for (ts, cl, job_id, rank, phase, step, step_ema, tps, mem,
-         started_ts, progress_ts, hb_ts, verdict, resume_step) in rows:
+         started_ts, progress_ts, hb_ts, verdict, resume_step,
+         ckpt_step, ckpt_ts) in rows:
         out.append({
             'ts': ts,
             'cluster': cl,
@@ -1115,6 +1147,8 @@ def get_workload_telemetry(cluster: Optional[str] = None,
             'hb_ts': hb_ts,
             'verdict': verdict,
             'resume_step': resume_step,
+            'ckpt_step': ckpt_step,
+            'ckpt_ts': ckpt_ts,
         })
     return out
 

@@ -125,7 +125,13 @@ def main() -> int:
     parser.add_argument('--metrics-file', default=None,
                         help='Append one JSON line per log window '
                              '(step, loss, tok/s, TFLOP/s/chip).')
-    parser.add_argument('--checkpoint-every', type=int, default=500)
+    parser.add_argument('--checkpoint-every', type=int, default=0,
+                        help='Fixed checkpoint cadence in steps; 0 '
+                             '(default) auto-tunes from measured '
+                             'snapshot cost and journal-derived MTTF '
+                             '(agent/checkpointd.py — Young interval, '
+                             'clamped by XSKY_CKPT_{MIN,MAX}_'
+                             'INTERVAL_S)')
     parser.add_argument('--resume', default='none',
                         choices=['none', 'auto'])
     parser.add_argument('--log-every', type=int, default=10)
@@ -195,26 +201,89 @@ def main() -> int:
     telemetry.emit(phase=telemetry.PHASE_INIT, step=0)
     trainer = trainer_lib.Trainer(config, mesh=mesh)
 
+    from skypilot_tpu.agent import checkpointd
+
     manager = None
     start_step = 0
     state = None
+    # The fast tiers (local shard + peer replicas) hold the full host
+    # state only on single-process runs: a multi-host global array is
+    # not fully addressable from one rank, so distributed runs keep
+    # orbax (the storage tier) as the only weight carrier and the fast
+    # tiers are disabled. Orbax remains the storage tier everywhere.
+    single_process = jax.process_count() == 1
+    ckpt = None
+    storage_cadence = checkpointd.Cadence()
     if args.checkpoint_dir:
         import orbax.checkpoint as ocp
         manager = ocp.CheckpointManager(
             args.checkpoint_dir,
             options=ocp.CheckpointManagerOptions(max_to_keep=3))
-        if args.resume == 'auto' and manager.latest_step() is not None:
-            start_step = manager.latest_step()
+
+        def _storage_save(step: int, payload) -> None:
+            # Runs on the xsky-ckptd worker: the host→storage
+            # serialize/write the step path no longer pays. Block on
+            # orbax's own finalize thread HERE (we are already off the
+            # step path) — interleaving a second save before the first
+            # finalizes trips CheckpointManager's single-save assert.
+            if manager.latest_step() == step:
+                return   # the end-of-run force may repeat a step
+            manager.save(step, args=ocp.args.StandardSave(payload))
+            manager.wait_until_finished()
+
+        def _abstract_state():
             # eval_shape gives shapes/dtypes; attach the trainer's
             # shardings so orbax restores directly onto the mesh.
-            abstract = jax.tree.map(
+            return jax.tree.map(
                 lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
                                                    sharding=sh),
                 jax.eval_shape(trainer.init_state),
                 trainer.state_shardings())
-            state = manager.restore(
-                start_step, args=ocp.args.StandardRestore(abstract))
-            logger.info(f'Resumed from checkpoint step {start_step}.')
+
+        def _storage_restore():
+            if manager.latest_step() is None:
+                return None
+            step = manager.latest_step()
+            return step, manager.restore(
+                step, args=ocp.args.StandardRestore(_abstract_state()))
+
+        if single_process:
+            ckpt = checkpointd.Checkpointer.from_env(
+                fallback_dir=os.path.join(args.checkpoint_dir,
+                                          'fast-tier'),
+                storage_save=_storage_save)
+            checkpointd.install(ckpt)
+        if args.resume == 'auto':
+            snap = (checkpointd.restore(
+                        storage_fn=_storage_restore,
+                        storage_step_fn=manager.latest_step)
+                    if single_process and checkpointd.enabled()
+                    else None)
+            if snap is not None and snap.step > 0 and \
+                    snap.tier in (checkpointd.TIER_LOCAL,
+                                  checkpointd.TIER_PEER):
+                # Fast tier: pickled host pytree back onto the mesh.
+                start_step = snap.step
+                state = jax.tree.map(jax.device_put, snap.payload,
+                                     trainer.state_shardings())
+            elif snap is not None and \
+                    snap.tier == checkpointd.TIER_STORAGE:
+                start_step, state = snap.step, snap.payload
+            elif (snap is None or
+                  snap.tier == checkpointd.TIER_COLD) and \
+                    manager.latest_step() is not None:
+                # No fast tier — or the never-raise ladder fell to
+                # cold while orbax still holds a checkpoint (e.g. a
+                # transient storage error it swallowed): restore
+                # directly and fail LOUDLY rather than silently
+                # restarting a resumable job from step 0.
+                start_step = manager.latest_step()
+                state = manager.restore(
+                    start_step,
+                    args=ocp.args.StandardRestore(_abstract_state()))
+            if state is not None:
+                logger.info(
+                    f'Resumed from checkpoint step {start_step}.')
     if state is None:
         state = trainer.init_state()
         if args.init_params:
@@ -368,12 +437,49 @@ def main() -> int:
                     }) + '\n')
             # Eval wall time must not pollute the throughput window.
             window_t0, window_steps = time.perf_counter(), 0
-        if manager is not None and (step + 1) % args.checkpoint_every == 0:
-            import orbax.checkpoint as ocp
-            manager.save(step + 1, args=ocp.args.StandardSave(state))
+        if manager is not None:
+            due_fixed = (args.checkpoint_every > 0 and
+                         (step + 1) % args.checkpoint_every == 0)
+            if ckpt is not None:
+                # Off-step-path snapshot: the loop pays only the
+                # device→host copy (payload_fn); serialize + local
+                # write + peer replicate + the orbax storage save all
+                # ride the xsky-ckptd worker. Fixed --checkpoint-every
+                # forces the cadence; 0 lets it auto-tune.
+                if args.checkpoint_every == 0 or due_fixed:
+                    checkpointd.maybe_checkpoint(
+                        step + 1, lambda: jax.device_get(state),
+                        force=due_fixed)
+            elif due_fixed or (args.checkpoint_every == 0 and
+                               storage_cadence.due()):
+                # No async pipeline — multi-host (each rank holds
+                # only its shards; orbax writes the distributed
+                # checkpoint itself) or the plane disabled via
+                # XSKY_CKPT=0: keep the synchronous orbax save so
+                # periodic checkpointing never silently vanishes; the
+                # Young cadence still auto-tunes the interval.
+                import orbax.checkpoint as ocp
+                t0_save = time.perf_counter()
+                manager.save(step + 1,
+                             args=ocp.args.StandardSave(state))
+                storage_cadence.observe_cost(
+                    time.perf_counter() - t0_save)
+                storage_cadence.arm()
     if manager is not None:
         import orbax.checkpoint as ocp
-        manager.save(args.steps, args=ocp.args.StandardSave(state))
+        # Final checkpoint rides the same pipeline (fast tiers stay
+        # fresh for the next incarnation), then drain the writer so
+        # the direct fallback save never interleaves inside orbax.
+        if ckpt is not None:
+            checkpointd.maybe_checkpoint(
+                args.steps, lambda: jax.device_get(state), force=True)
+        drained = checkpointd.wait_idle(timeout=600)
+        # Only save directly once the worker drained: its in-flight
+        # save otherwise interleaves with ours inside orbax (and the
+        # force-enqueued final snapshot is what it is writing anyway).
+        if drained and (ckpt is None or
+                        ckpt.last_storage_step != args.steps):
+            manager.save(args.steps, args=ocp.args.StandardSave(state))
         manager.wait_until_finished()
     total = time.perf_counter() - t0
     telemetry.emit(phase=telemetry.PHASE_IDLE)

@@ -186,6 +186,32 @@ _VARS = [
     EnvVar('XSKY_AGENT_NO_SELF_TEARDOWN', UNSET,
            'Set to any value to disable agent-side idle '
            'self-teardown'),
+    # ---- async checkpoint plane (agent/checkpointd.py) ---------------------
+    EnvVar('XSKY_CKPT', '1',
+           'Set to 0 to disable the async multi-tier checkpoint '
+           'plane entirely'),
+    EnvVar('XSKY_CKPT_DIR', UNSET,
+           'Local-tier checkpoint directory (set per rank by the '
+           'gang launcher; unset = plane inactive)'),
+    EnvVar('XSKY_CKPT_PEER_DIRS', UNSET,
+           'Newline-separated peer-tier directories (the K next '
+           'hosts\' roots; set by the gang launcher)'),
+    EnvVar('XSKY_CKPT_REPLICAS', '1',
+           'Gang peers each rank replicates its newest shard to'),
+    EnvVar('XSKY_CKPT_MIN_INTERVAL_S', '15',
+           'Floor of the auto-tuned checkpoint cadence'),
+    EnvVar('XSKY_CKPT_MAX_INTERVAL_S', '600',
+           'Ceiling of the auto-tuned checkpoint cadence'),
+    EnvVar('XSKY_CKPT_MTTF_S', UNSET,
+           'MTTF hint the cadence plans against (threaded by the '
+           'jobs controller from the recovery journal; unset = '
+           'pessimistic 1800 s default)'),
+    EnvVar('XSKY_CKPT_SCOPE', UNSET,
+           'Journal scope checkpoint restores account under (the '
+           'jobs controller threads job/<id>)'),
+    EnvVar('XSKY_CKPT_KEEP', '2',
+           'Snapshots kept per checkpoint directory (older copies '
+           'are the torn-write fallback)'),
     # ---- managed jobs ------------------------------------------------------
     EnvVar('XSKY_JOBS_DB', '~/.xsky/managed_jobs.db',
            'Path of the managed-jobs database'),

@@ -68,6 +68,13 @@ _NAMES = [
     # ---- metrics: counter/histogram call sites -----------------------------
     ObsName('metric', 'xsky_chaos_fires_total',
             'Chaos-point firings, labeled by point'),
+    ObsName('metric', 'xsky_ckpt_writes_total',
+            'Checkpoint snapshots written by the async pipeline'),
+    ObsName('metric', 'xsky_ckpt_bytes_total',
+            'Checkpoint shard bytes written by the async pipeline'),
+    ObsName('metric', 'xsky_ckpt_restores_total',
+            'Checkpoint restores, labeled by tier '
+            '(local/peer/storage/cold)'),
     ObsName('metric', 'xsky_compiles_total',
             'XLA backend compiles counted by the duration listener '
             '(pull-fed delta)'),
@@ -112,6 +119,9 @@ _NAMES = [
             'Host dispatch share of step time {cluster,job,rank}'),
     ObsName('metric', 'xsky_hbm_bytes_in_use',
             'Device HBM bytes in use {cluster,job,rank}'),
+    ObsName('metric', 'xsky_ckpt_freshness_age_seconds',
+            'Seconds since the rank\'s newest checkpoint snapshot '
+            '{cluster,job,rank} (replay exposure)'),
     ObsName('metric', 'xsky_serve_slo_burn_rate',
             'Worst-objective error-budget burn {service,window}'),
     ObsName('metric', 'xsky_serve_replica_ttft_p99_seconds',
@@ -211,6 +221,11 @@ _NAMES = [
             'One SKU\'s zone sweep inside failover'),
     ObsName('span', 'failover.attempt',
             'One provision attempt with typed outcome attrs'),
+    ObsName('span', 'ckpt.replicate',
+            'Peer-tier shard replication fan-out of one snapshot'),
+    ObsName('span', 'jobs.ckpt_restore',
+            'Tiered checkpoint restore walk (local/peer/storage/'
+            'cold) at incarnation start'),
     ObsName('span', 'jobs.launch_task',
             'Managed-job task launch under the controller'),
     ObsName('span', 'jobs.recover',
@@ -238,6 +253,12 @@ _NAMES = [
     ObsName('span', 'serve.slo_scrape',
             'Replica /metrics scrape fan-out inside a tick'),
     # ---- chaos points ------------------------------------------------------
+    ObsName('chaos', 'ckpt.write',
+            'Local-tier snapshot write on the checkpointd worker'),
+    ObsName('chaos', 'ckpt.replicate',
+            'One peer copy of a shard, keyed on rank/step/peer'),
+    ObsName('chaos', 'ckpt.restore',
+            'One restore-ladder candidate read, keyed on tier'),
     ObsName('chaos', 'do.api',
             'DigitalOcean REST attempt (inside retry_transient)'),
     ObsName('chaos', 'lambda.api',
@@ -279,6 +300,9 @@ _NAMES = [
             'detail'),
     ObsName('journal', 'failover.recovered',
             'Provisioning succeeded after prior blocked attempts'),
+    ObsName('journal', 'job.ckpt_restored',
+            'An incarnation restored from a checkpoint tier (tier, '
+            'latency, resumed step, replayed-step bound)'),
     ObsName('journal', 'job.preempted',
             'Managed job lost its cluster to preemption'),
     ObsName('journal', 'job.restarted',

@@ -625,3 +625,14 @@ class TestBenchDecomposeGate:
         assert result['pass'] is True
         assert result['gates']['baseline_loss_mostly_restart_replay']
         assert result['gates']['elastic_loss_shifts_to_shrunk_capacity']
+        # PR 13 checkpoint-arm gates ride the same storm: the
+        # checkpointed arm must strictly beat the unchecked elastic
+        # arm on goodput, shrink restart_replay strictly, restore
+        # from a live tier, and cost <2% of step time on the step
+        # path.
+        assert result['gates']['ckpt_goodput_gt_elastic']
+        assert result['gates']['ckpt_replay_share_lt_unchecked']
+        assert result['gates']['ckpt_restored_from_live_tier']
+        assert result['gates']['ckpt_overhead_under_2pct']
+        assert result['ckpt']['sum_error'] is not None
+        assert result['ckpt']['sum_error'] <= 0.02

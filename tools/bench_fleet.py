@@ -31,8 +31,20 @@ divided by ``full_gang × window``. Gates:
   * the grow decision in ``fleet_decisions`` carries the decayed
     placement score that admitted it.
 
+``--decompose`` (the goodput-attribution gate) adds a THIRD arm —
+**ckpt** (``XSKY_CKPT=1``): the same storm and the same elastic
+recovery with the PR 13 async checkpoint plane on, so the goodput
+delta vs the unchecked elastic arm is attributable to checkpointing
+alone. Its gates: goodput strictly above the elastic arm,
+``restart_replay`` share strictly below it, a journalled
+``job.ckpt_restored`` from a live tier (local/peer), measured
+step-path checkpoint overhead <2% of step time, and (full mode)
+absolute goodput >= 0.6.
+
 Prints ONE JSON line; exit 1 on any gate failure. ``--smoke`` (short
-window) is the tier-1 gate run by tests/unit_tests/test_fleet.py.
+window) is the tier-1 gate run by tests/unit_tests/test_fleet.py;
+``--decompose --smoke`` runs in tier-1 via
+tests/unit_tests/test_goodput.py.
 
 Usage:
     python tools/bench_fleet.py [--smoke] [--window S] [--step-s S]
@@ -55,26 +67,58 @@ _HOSTS = 4          # tpu-v5e-32 on the fake catalog = 4 hosts
 _VICTIM_RANK = 2    # never the head (rank 0 cannot shrink away)
 
 
-def _workload_script(path: str, marker: str, step_s: float) -> None:
+def _workload_script(path: str, marker: str, step_s: float,
+                     overhead_prefix: str) -> None:
     """The gang workload: an effectively-endless telemetry-emitting
-    step loop (every incarnation restarts from step 0 — checkpoint-free,
-    exactly the work a relaunch loses and a shrink preserves). Exits
-    cleanly once the bench's stop marker appears (fake-cloud hosts
-    share the local filesystem), so the measurement window — not the
-    workload length — bounds the run."""
+    step loop. With the checkpoint plane off (``XSKY_CKPT=0`` — the
+    elastic and baseline arms) every incarnation restarts from step 0
+    — checkpoint-free, exactly the work a relaunch loses and a shrink
+    preserves. The ckpt arm restores the freshest tier at init (the
+    goodput ledger then shrinks restart_replay against the declared
+    ``resume_step``) and snapshots at the auto-tuned cadence,
+    accounting the step-path cost into a per-rank overhead file the
+    arm's <2%-of-step-time gate reads. Exits cleanly once the bench's
+    stop marker appears (fake-cloud hosts share the local
+    filesystem), so the measurement window — not the workload length —
+    bounds the run."""
     with open(path, 'w', encoding='utf-8') as f:
         f.write(f'''
-import os, sys, time
+import json, os, sys, time
 sys.path.insert(0, {json.dumps(_REPO_ROOT)})
+from skypilot_tpu.agent import checkpointd
 from skypilot_tpu.agent import telemetry
-# resume_step=0 declared at init: checkpoint-free, so the goodput
-# ledger charges every re-run step to restart_replay.
-telemetry.emit(phase='init', resume_step=0)
-for i in range(1000000):
+start = 0
+snap = checkpointd.restore()   # None when the plane is disabled
+if snap is not None:
+    start = snap.step
+# The declared resume point: 0 (checkpoint-free) charges every re-run
+# step to restart_replay; a restored step shrinks the bucket.
+telemetry.emit(phase='init', resume_step=start)
+overhead_s, done = 0.0, 0
+ov_path = ({json.dumps(overhead_prefix)} + '-' +
+           os.environ.get('XSKY_HOST_RANK', '0') + '.json')
+def _flush_overhead():
+    try:
+        with open(ov_path + '.tmp', 'w', encoding='utf-8') as fh:
+            json.dump({{'overhead_s': overhead_s, 'steps': done,
+                       'step_s': {step_s}}}, fh)
+        os.replace(ov_path + '.tmp', ov_path)
+    except OSError:
+        pass
+for i in range(start, 1000000):
     if os.path.exists({json.dumps(marker)}):
         break
     telemetry.emit(phase='step', step=i, step_time_s={step_s})
+    t0 = time.monotonic()
+    checkpointd.maybe_checkpoint(i, lambda: {{'step': i}},
+                                 step_time_s={step_s})
+    overhead_s += time.monotonic() - t0
+    done += 1
+    if done % 25 == 0:
+        _flush_overhead()
     time.sleep({step_s})
+_flush_overhead()
+checkpointd.wait_idle(5.0)
 telemetry.emit(phase='idle')
 ''')
 
@@ -192,7 +236,8 @@ def run_arm(arm: str, window_s: float, step_s: float,
     scratch = tempfile.mkdtemp(prefix='xsky-fleet-')
     workload = os.path.join(scratch, 'workload.py')
     marker = os.path.join(scratch, 'stop-marker')
-    _workload_script(workload, marker, step_s)
+    overhead_prefix = os.path.join(scratch, 'ckpt-overhead')
+    _workload_script(workload, marker, step_s, overhead_prefix)
 
     task = Task('fleet-storm', run=f'{sys.executable} {workload}')
     task.set_resources(Resources(accelerators=f'tpu-v5e-{_HOSTS * 8}',
@@ -261,11 +306,42 @@ def run_arm(arm: str, window_s: float, step_s: float,
     if decompose and window_start is not None:
         result.update(_decompose_arm(state_lib, cluster, window_start,
                                      window_s))
+    if arm == 'ckpt':
+        result['ckpt_overhead'] = _read_ckpt_overhead(overhead_prefix)
     with open(out_path, 'w', encoding='utf-8') as f:
         json.dump(result, f)
     ok = (not wedged and
           status == jobs_state.ManagedJobStatus.SUCCEEDED)
     return 0 if ok else 1
+
+
+def _read_ckpt_overhead(prefix: str) -> dict:
+    """Per-rank checkpoint step-path overhead (written by the
+    workload): worst rank's overhead as a fraction of its productive
+    step time — the bench_telemetry/bench_profile <2% gate pattern."""
+    worst = None
+    ranks = 0
+    directory = os.path.dirname(prefix)
+    base = os.path.basename(prefix)
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        names = []
+    for name in names:
+        if not (name.startswith(f'{base}-') and
+                name.endswith('.json')):
+            continue
+        try:
+            with open(os.path.join(directory, name),
+                      encoding='utf-8') as f:
+                row = json.load(f)
+            ratio = (row['overhead_s'] /
+                     (row['steps'] * row['step_s']))
+        except (OSError, ValueError, KeyError, ZeroDivisionError):
+            continue
+        ranks += 1
+        worst = ratio if worst is None else max(worst, ratio)
+    return {'ratio': worst, 'ranks_reporting': ranks}
 
 
 # ---- orchestration ---------------------------------------------------------
@@ -299,8 +375,24 @@ def _arm_env(arm: str, base_dir: str, plan: str,
         'XSKY_FLEET_DECAY_S': '6.0',
         'XSKY_FLEET_BLOCK_THRESHOLD': '0.5',
         'XSKY_FLEET_MIN_SURVIVORS': '0.5',
-        'XSKY_FLEET_ELASTIC': '1' if arm == 'elastic' else '0',
+        'XSKY_FLEET_ELASTIC': '0' if arm == 'baseline' else '1',
+        # The checkpoint plane is the ONLY difference between the
+        # ckpt and elastic arms: same storm, same elastic recovery,
+        # with/without snapshots — so the goodput delta and the
+        # restart_replay shrink are attributable to checkpointing
+        # alone.
+        'XSKY_CKPT': '1' if arm == 'ckpt' else '0',
     })
+    if arm == 'ckpt':
+        env.update({
+            # Smoke-scale cadence: snapshot every 1-2 s so the stall
+            # at ~8 s of banked progress loses at most one cadence
+            # window to replay. Two peers: a survivor can restore a
+            # dead host's shard.
+            'XSKY_CKPT_MIN_INTERVAL_S': '1.0',
+            'XSKY_CKPT_MAX_INTERVAL_S': '2.0',
+            'XSKY_CKPT_REPLICAS': '2',
+        })
     if decompose:
         env.update({
             # The attribution gate measures a SHRUNK steady state: a
@@ -327,20 +419,52 @@ def _loss_shares(ledger: dict) -> dict:
     return {c: (totals.get(c) or 0.0) / loss for c in loss_causes}
 
 
+# The dominance gates compare shares over the attribution-STRUCTURE
+# buckets only. The wall-clock recovery buckets (stall detection,
+# journalled recovery windows, provisioning, bootstrap, queue) scale
+# with box load — under a loaded CI host they balloon and dilute the
+# replay share, flaking a whole-loss threshold — while what the gates
+# actually prove (replay vs shrunk vs unattributed) is structural.
+# The recovery buckets have their own structural gates (journalled
+# shrink/relaunch events, arms' exit codes).
+_STRUCTURAL_CAUSES = ('restart_replay', 'shrunk_capacity',
+                      'unattributed')
+
+
+def _structural_shares(ledger: dict) -> dict:
+    totals = (ledger or {}).get('totals') or {}
+    loss = sum(totals.get(c) or 0.0 for c in _STRUCTURAL_CAUSES)
+    if loss <= 0:
+        return {}
+    return {c: (totals.get(c) or 0.0) / loss
+            for c in _STRUCTURAL_CAUSES}
+
+
 def _decompose_gates(results: dict, arm_rcs: dict,
-                     window: float) -> int:
+                     window: float, smoke: bool = False) -> int:
     """The attribution gates: the ledger must explain the storm, not
     just survive it. Categories sum to measured wall within ±2% for
-    both arms; the relaunch arm's loss is dominated (>=50%) by
-    restart_replay — a checkpoint-free relaunch rebuys all banked
-    progress; the elastic arm shifts that loss toward shrunk_capacity
-    (it keeps the survivors' progress and pays a missing-chip fraction
-    instead); fold + record overhead stays under 2% of a controller
-    tick, amortized over the record interval."""
+    every arm; the relaunch arm's structural loss (replay vs shrunk
+    vs unattributed — see ``_structural_shares``) is dominated
+    (>=50%) by restart_replay — a checkpoint-free relaunch rebuys all
+    banked progress; the elastic arm shifts that loss toward
+    shrunk_capacity (it keeps the survivors' progress and pays a
+    missing-chip fraction instead); fold + record overhead stays
+    under 2% of a controller tick, amortized over the record
+    interval.
+
+    The PR 13 checkpoint gates ride the same storm: the ckpt arm
+    (elastic + async checkpointing) must strictly beat the unchecked
+    elastic arm's goodput with a strictly smaller restart_replay
+    share, restore from a live tier (local/peer — journalled
+    ``job.ckpt_restored``), and pay <2% of step time on the step path
+    (full mode additionally gates absolute goodput >= 0.6)."""
     elastic, baseline = results['elastic'], results['baseline']
+    ckpt = results.get('ckpt') or {}
     summaries = {}
     gates = {'arms_succeeded':
-             arm_rcs == {'elastic': 0, 'baseline': 0}}
+             all(rc == 0 for rc in arm_rcs.values()) and
+             set(arm_rcs) >= {'ckpt', 'elastic', 'baseline'}}
     for arm, result in results.items():
         ledger = result.get('ledger') or {}
         wall = ledger.get('wall_s') or 0.0
@@ -367,8 +491,9 @@ def _decompose_gates(results: dict, arm_rcs: dict,
         gates[f'{arm}_fold_overhead_under_2pct'] = (
             fold.get('overhead_ratio') is not None and
             fold['overhead_ratio'] < 0.02)
-    baseline_shares = _loss_shares(baseline.get('ledger') or {})
-    elastic_shares = _loss_shares(elastic.get('ledger') or {})
+    baseline_shares = _structural_shares(baseline.get('ledger') or {})
+    elastic_shares = _structural_shares(elastic.get('ledger') or {})
+    ckpt_shares = _structural_shares(ckpt.get('ledger') or {})
     gates['baseline_loss_mostly_restart_replay'] = (
         baseline_shares.get('restart_replay', 0.0) >= 0.5)
     gates['elastic_loss_shifts_to_shrunk_capacity'] = (
@@ -384,18 +509,42 @@ def _decompose_gates(results: dict, arm_rcs: dict,
     gates['controller_recorded_ledger'] = bool(
         elastic.get('controller_recorded') and
         baseline.get('controller_recorded'))
+    # ---- checkpoint-arm gates (PR 13) ----
+    ckpt_goodput = (ckpt.get('ledger') or {}).get('goodput') or 0.0
+    elastic_goodput = (elastic.get('ledger') or {}).get('goodput') \
+        or 0.0
+    gates['ckpt_goodput_gt_elastic'] = ckpt_goodput > elastic_goodput
+    # Replay must strictly shrink against the unchecked (elastic)
+    # arm — same recovery shape, checkpointing is the only delta.
+    gates['ckpt_replay_share_lt_unchecked'] = (
+        elastic_shares.get('restart_replay', 0.0) > 0.0 and
+        ckpt_shares.get('restart_replay', 1.0) <
+        elastic_shares.get('restart_replay', 0.0))
+    gates['ckpt_restored_from_live_tier'] = any(
+        e['type'] == 'job.ckpt_restored' and
+        (e.get('detail') or {}).get('tier') in ('local', 'peer')
+        for e in ckpt.get('events', ()))
+    overhead = (ckpt.get('ckpt_overhead') or {}).get('ratio')
+    gates['ckpt_overhead_under_2pct'] = (overhead is not None and
+                                         overhead < 0.02)
+    if not smoke:
+        # Full-scale target from the ROADMAP arc: 0.225 → >= 0.6.
+        gates['ckpt_goodput_ge_target'] = ckpt_goodput >= 0.6
     ok = all(gates.values())
     print(json.dumps({
         'metric': 'fleet_goodput_attribution_decompose',
         'window_s': window,
         'hosts': _HOSTS,
+        'ckpt': summaries.get('ckpt'),
         'elastic': summaries.get('elastic'),
         'baseline': summaries.get('baseline'),
+        'ckpt_goodput': round(ckpt_goodput, 4),
+        'ckpt_overhead_ratio': overhead,
         'gates': gates,
         'pass': ok,
     }))
     if not ok:
-        for arm in ('elastic', 'baseline'):
+        for arm in sorted(results):
             print(json.dumps({'arm_debug': results[arm]},
                              default=str), file=sys.stderr)
     return 0 if ok else 1
@@ -437,10 +586,16 @@ def main() -> int:
 
     results = {}
     arm_rcs = {}
+    # --decompose adds the PR 13 checkpoint arm: the same storm and
+    # the same elastic recovery, with async checkpointing on — the
+    # goodput delta vs the unchecked elastic arm is the checkpoint
+    # plane's contribution alone.
+    arms = (('ckpt', 'elastic', 'baseline') if args.decompose
+            else ('elastic', 'baseline'))
     with tempfile.TemporaryDirectory(prefix='xsky-bench-fleet-') as tmp:
         plan = os.path.join(tmp, 'storm.json')
         _chaos_plan(plan, decompose=args.decompose)
-        for arm in ('elastic', 'baseline'):
+        for arm in arms:
             base = os.path.join(tmp, arm)
             os.makedirs(base, exist_ok=True)
             out = os.path.join(base, 'result.json')
@@ -465,7 +620,8 @@ def main() -> int:
                                 'error': (proc.stderr or '')[-2000:]}
 
     if args.decompose:
-        return _decompose_gates(results, arm_rcs, window)
+        return _decompose_gates(results, arm_rcs, window,
+                                smoke=args.smoke)
 
     elastic, baseline = results['elastic'], results['baseline']
     etypes = {e['type']: e for e in elastic.get('events', ())}

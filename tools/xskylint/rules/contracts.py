@@ -165,6 +165,11 @@ class ChaosCoverageRule(engine.Rule):
     # own chaos point (fleet.shrink / fleet.grow_back) or the retry
     # path is untestable by construction.
     ELASTIC_FUNCS = frozenset({'_try_shrink', '_maybe_grow_back'})
+    # The checkpoint restore ladder (agent/checkpointd.py): the tier
+    # walk local → peer → storage → cold is itself a retry path whose
+    # fallback arms (corrupt manifest → older copy → next tier) only a
+    # fault plan can force — it must carry the ckpt.restore point.
+    CKPT_FUNCS = frozenset({'_restore_ladder'})
 
     def applies_to(self, rel_path: str) -> bool:
         return rel_path.startswith('skypilot_tpu/') and \
@@ -205,20 +210,20 @@ class ChaosCoverageRule(engine.Rule):
                 'failover retry loop has no chaos.inject point (in '
                 'its body or an attempt helper it calls) — fault '
                 'plans cannot preempt an attempt here')
-        # Elastic shrink/grow-back retry paths: the named functions
-        # must contain a chaos point so fault plans can force their
-        # fallback arms.
+        # Elastic shrink/grow-back and checkpoint-restore retry paths:
+        # the named functions must contain a chaos point so fault
+        # plans can force their fallback arms.
         for node in ast.walk(ctx.tree):
             if not isinstance(node, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):
                 continue
-            if node.name not in self.ELASTIC_FUNCS:
+            if node.name not in self.ELASTIC_FUNCS | self.CKPT_FUNCS:
                 continue
             if self._has_inject(node):
                 continue
             ctx.report(
                 self.id, node.lineno,
-                f'elastic recovery path {node.name} has no '
+                f'recovery retry path {node.name} has no '
                 'chaos.inject point — fault plans cannot force its '
                 'fallback arm')
 

@@ -338,6 +338,9 @@ class NeverRaiseRule(engine.Rule):
         'skypilot_tpu/agent/goodput.py': (
             'build_ledger', 'record_ledger', 'fleet_report',
             'loss_summary'),
+        'skypilot_tpu/agent/checkpointd.py': (
+            'maybe_checkpoint', 'restore', 'wait_idle',
+            'derive_mttf'),
     }
 
     def applies_to(self, rel_path: str) -> bool:
