@@ -1511,20 +1511,34 @@ def ssh_down(infra, yes):
 @click.option('--stats', 'stats', is_flag=True, default=False,
               help='Per-rule finding + suppression counts with '
                    'reasons (suppression-debt report).')
+@click.option('--why', 'why', default=None,
+              metavar='RULE:FILE:LINE',
+              help='Explain one finding: focused re-run printing the '
+                   'shortest entry->violation call chain (lock-order: '
+                   'the cycle\'s edge witnesses).')
+@click.option('--no-cache', 'no_cache', is_flag=True, default=False,
+              help='Disable the mtime+size-keyed AST cache '
+                   '(.xskylint_cache/).')
+@click.option('--check-baseline', 'check_baseline', is_flag=True,
+              default=False,
+              help='Fail when suppression counts exceed the '
+                   'checked-in baseline (debt ratchet).')
 @click.option('--list-rules', 'list_rules', is_flag=True, default=False,
               help='Print the rule catalog and exit.')
-def lint(paths, root_dir, rules, as_json, changed, base, stats,
-         list_rules):
+def lint(paths, root_dir, rules, as_json, changed, base, stats, why,
+         no_cache, check_baseline, list_rules):
     """Static analysis over the tree (tools/xskylint).
 
-    Parses each file once, builds a whole-program index over the
-    shared ASTs, and runs every registered rule: concurrency contracts
-    (raw sleeps, sequential runner loops, thread/process hygiene),
-    observability contracts (span coverage, retention bounds,
-    never-raise recording paths, lease heartbeats), state-DB
-    discipline (SELECT paging, connection routing), the env-var and
-    observability-name registries, chaos coverage, and the cross-file
-    contracts (verb wiring, lock discipline, schema consistency).
+    Parses each file once, builds a whole-program index AND call
+    graph over the shared ASTs, and runs every registered rule:
+    concurrency contracts (raw sleeps, sequential runner loops,
+    thread/process hygiene), observability contracts (span coverage,
+    retention bounds, never-raise recording paths, lease heartbeats),
+    state-DB discipline (SELECT paging, connection routing), the
+    env-var and observability-name registries, chaos coverage, the
+    cross-file contracts (verb wiring, lock discipline, schema
+    consistency), and the interprocedural contracts (hot-path purity,
+    lock-order deadlock detection, transitive never-raise).
     Exits 1 on any unsuppressed finding. Suppress with
     `# xskylint: disable=<rule> -- <reason>` (reason mandatory); rule
     catalog in docs/static-analysis.md.
@@ -1556,6 +1570,12 @@ def lint(paths, root_dir, rules, as_json, changed, base, stats,
         argv += ['--base', base]
     if stats:
         argv.append('--stats')
+    if why:
+        argv += ['--why', why]
+    if no_cache:
+        argv.append('--no-cache')
+    if check_baseline:
+        argv.append('--check-baseline')
     if list_rules:
         argv.append('--list-rules')
     sys.exit(lint_engine.main(argv))

@@ -230,6 +230,8 @@ class SkyServeLoadBalancer:
                 # leg of one request — a latency rule here is how the
                 # bench proves a slow replica becomes a burn breach.
                 chaos.inject('lb.proxy', replica=replica, path=path)
+                # hotpath ok: the upstream leg IS the relayed request
+                # — bounded by the 120 s upstream timeout.
                 resp = urllib.request.urlopen(req, timeout=120)
             except urllib.error.HTTPError as e:
                 self.policy.request_done(replica)

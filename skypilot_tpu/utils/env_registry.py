@@ -124,6 +124,14 @@ _VARS = [
            'Set to 1 to disable anonymous usage reporting'),
     EnvVar('XSKY_USAGE_ENDPOINT', UNSET,
            'Override the usage-reporting endpoint'),
+    # ---- static analysis (xsky lint) ---------------------------------------
+    EnvVar('XSKY_LINT_CACHE', '1',
+           'Set to 0 to disable the mtime+size-keyed AST cache the '
+           'lint CLI keeps under .xskylint_cache/ (same as '
+           '--no-cache)'),
+    EnvVar('XSKY_LINT_CACHE_DIR', UNSET,
+           'Override the AST-cache directory (default: '
+           '<repo root>/.xskylint_cache)'),
     # ---- catalog -----------------------------------------------------------
     EnvVar('XSKY_CATALOG_URL_BASE', UNSET,
            'Base URL of a hosted catalog; set to enable hosted-'

@@ -1,0 +1,770 @@
+"""Pass 3 of the whole-program analyzer: the call graph.
+
+Built from the SAME shared per-file ASTs the engine already parses
+(one ``ast.parse`` per file — the parse-once counter test covers all
+three passes). :func:`harvest_into` runs during ``ProjectIndex.add_file``
+and records one :class:`FunctionNode` per module-level function and per
+method of a top-level class; :class:`CallGraph` resolves their call
+sites into edges lazily when an interprocedural rule asks.
+
+**Resolution (bounded best-effort).** A call site resolves when it is:
+
+  * a bare name bound to a same-module function or class
+    (``_flush()``, ``_Emitter(path)`` → ``_Emitter.__init__``), or a
+    name imported with ``from mod import fn``;
+  * ``self.method()`` / ``cls.method()`` → the same class's method;
+  * ``alias.attr()`` where ``alias`` is an imported module (module- or
+    function-level import) → that module's function or class;
+  * ``obj.method()`` where ``method`` names a method of exactly ONE
+    class in the same module (the local-instance pattern:
+    ``emitter.update`` → ``_Emitter.update``). This deliberately
+    over-approximates — over-approximation is the safe direction for
+    purity/lock analyses;
+  * ``run_in_parallel(fn, ...)`` and ``Thread(target=fn)`` indirection
+    (thread targets are tagged ``spawn`` — the work runs on ANOTHER
+    thread, so hot-path and held-lock propagation skip those edges).
+
+Anything else (attribute chains like ``self.engine.decode_step``,
+calls through locals the heuristics can't type) is an **unknown edge**,
+counted per node and surfaced by ``xsky lint --why`` and the call-graph
+tests — the soundness limit is explicit, not silent.
+
+**Per-node facts** harvested alongside the edges:
+
+  * blocking-primitive call sites (sleep, DB, network, subprocess,
+    non-spool filesystem writes, fan-out, ``.wait()``) with the set of
+    module-level locks lexically held and the ``# hotpath ok: <bound>``
+    exemption state (marker on the site line, the comment block above
+    it, or the enclosing ``def``);
+  * module-lock acquisitions (``with <lock>:``) with the locks already
+    held — the lock-order graph's raw edges;
+  * never-raise facts: the first statement that could raise outside a
+    broad ``try`` (``raise``/``assert``/subscripts/attribute loads) and
+    every call made from an ``except``/``else``/``finally`` arm that
+    escapes the guard — the transitive never-raise rule's inputs.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+HOTPATH_MARKER = '# hotpath ok'
+
+# Receivers recognized as the requests-style HTTP client modules.
+_NETWORK_RECVS = frozenset({'requests', 'httplib', 'httpx'})
+# os functions that write/mutate the filesystem.
+_OS_FS_WRITE = frozenset({
+    'replace', 'rename', 'renames', 'makedirs', 'mkdir', 'remove',
+    'unlink', 'rmdir', 'fsync', 'truncate', 'symlink', 'link'})
+_FILE_WRITE_ATTRS = frozenset({'write_text', 'write_bytes'})
+# open() modes that write.
+_WRITE_MODE_CHARS = ('w', 'a', 'x', '+')
+
+
+@dataclasses.dataclass
+class CallSite:
+    """One call expression, with enough shape to resolve it later."""
+    lineno: int
+    kind: str                  # 'name' | 'self' | 'recv' | 'dynamic'
+    name: str                  # called function/method name
+    recv: str = ''             # receiver name for kind='recv'
+    held: Tuple[str, ...] = ()         # module locks lexically held
+    protected: bool = False    # inside a broad-try body (guarded)
+    in_arm: bool = False       # in an except/else/finally arm that
+                               # escapes the enclosing guard
+    spawn: bool = False        # thread-target indirection: runs on
+                               # another thread, not this call path
+
+
+@dataclasses.dataclass
+class PrimitiveSite:
+    """One blocking-primitive call site."""
+    lineno: int
+    kind: str                  # 'sleep'|'db'|'network'|'subprocess'|
+                               # 'fs-write'|'fanout'|'wait'
+    desc: str                  # e.g. 'time.sleep', 'urlopen'
+    held: Tuple[str, ...] = ()
+    exempt: bool = False       # `# hotpath ok: <bound>` covers it
+
+
+@dataclasses.dataclass
+class LockAcq:
+    """One ``with <module lock>:`` acquisition."""
+    lineno: int
+    lock: str                  # qualified '<rel_path>::<name>'
+    held: Tuple[str, ...] = () # locks already held at this point
+    exempt: bool = False
+
+
+@dataclasses.dataclass
+class FunctionNode:
+    rel_path: str
+    qual: str                  # 'Trainer.step' or 'emit'
+    lineno: int
+    cls: Optional[str]
+    calls: List[CallSite] = dataclasses.field(default_factory=list)
+    primitives: List[PrimitiveSite] = dataclasses.field(
+        default_factory=list)
+    lock_acqs: List[LockAcq] = dataclasses.field(default_factory=list)
+    imports: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # First construct that could raise outside broad-try protection
+    # (None ⇒ lexically no-raise, modulo its calls).
+    risky_line: Optional[int] = None
+    risky_what: str = ''
+    exempt_all: bool = False   # marker on the def line / block above
+
+    @property
+    def name(self) -> str:
+        return self.qual.rsplit('.', 1)[-1]
+
+    def handler_calls(self) -> List[CallSite]:
+        """Calls in except/else/finally arms that escape the guard."""
+        return [c for c in self.calls if c.in_arm and not c.protected]
+
+    def unprotected_calls(self) -> List[CallSite]:
+        return [c for c in self.calls if not c.protected]
+
+    def _note_risky(self, lineno: int, what: str) -> None:
+        if self.risky_line is None:
+            self.risky_line, self.risky_what = lineno, what
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    return handler.type is None or (
+        isinstance(handler.type, ast.Name) and
+        handler.type.id in ('Exception', 'BaseException'))
+
+
+def _try_protects(node: ast.Try) -> bool:
+    """A try protects its body when some handler catches broadly and
+    no handler re-raises."""
+    if not any(_is_broad_handler(h) for h in node.handlers):
+        return False
+    for handler in node.handlers:
+        for sub in ast.walk(handler):
+            if isinstance(sub, ast.Raise):
+                return False
+    return True
+
+
+def _marker_covers(lines: List[str], lineno: int) -> bool:
+    """``# hotpath ok:`` on `lineno` or the contiguous comment block
+    immediately above it."""
+    if 1 <= lineno <= len(lines) and HOTPATH_MARKER in lines[lineno - 1]:
+        return True
+    i = lineno - 1
+    while 1 <= i <= len(lines) and lines[i - 1].strip().startswith('#'):
+        if HOTPATH_MARKER in lines[i - 1]:
+            return True
+        i -= 1
+    return False
+
+
+def _harvest_imports(nodes, out: Dict[str, str]) -> None:
+    for node in nodes:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                bound = alias.asname or alias.name.split('.')[0]
+                out[bound] = alias.name if alias.asname else \
+                    alias.name.split('.')[0]
+        elif isinstance(node, ast.ImportFrom) and node.module and \
+                node.level == 0:
+            for alias in node.names:
+                out[alias.asname or alias.name] = \
+                    f'{node.module}.{alias.name}'
+
+
+class _FunctionHarvester:
+    """Walks ONE function body, folding nested defs in (a closure
+    passed to run_in_parallel / retry_transient belongs to its parent's
+    call path, best-effort) and tracking lexical state: held module
+    locks, broad-try protection, guard-escaping arms."""
+
+    def __init__(self, node: FunctionNode, module_locks: Set[str],
+                 lines: List[str]) -> None:
+        self.node = node
+        self.module_locks = module_locks
+        self.lines = lines
+
+    # -- lexical helpers -----------------------------------------------------
+
+    def _lock_of(self, expr: ast.expr) -> Optional[str]:
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return f'{self.node.rel_path}::{expr.id}'
+        return None
+
+    # -- the walk ------------------------------------------------------------
+
+    def walk_body(self, body: List[ast.stmt], held: Tuple[str, ...],
+                  protected: bool, in_arm: bool) -> None:
+        for stmt in body:
+            self._stmt(stmt, held, protected, in_arm)
+
+    def _stmt(self, stmt: ast.stmt, held: Tuple[str, ...],
+              protected: bool, in_arm: bool) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Nested def: body runs when CALLED — fold its facts into
+            # the parent but reset the lexical state (locks/guards do
+            # not span the call boundary).
+            _harvest_imports(ast.walk(stmt), self.node.imports)
+            self.walk_body(stmt.body, (), False, False)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return   # nested classes: out of the bounded scope
+        if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            _harvest_imports([stmt], self.node.imports)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            acquired = list(held)
+            for item in stmt.items:
+                self._exprs(item.context_expr, tuple(acquired),
+                            protected, in_arm)
+                lock = self._lock_of(item.context_expr)
+                if lock is not None:
+                    self.node.lock_acqs.append(LockAcq(
+                        lineno=stmt.lineno, lock=lock,
+                        held=tuple(acquired),
+                        exempt=_marker_covers(self.lines, stmt.lineno)
+                        or self.node.exempt_all))
+                    acquired.append(lock)
+            self.walk_body(stmt.body, tuple(acquired), protected,
+                           in_arm)
+            return
+        if isinstance(stmt, ast.Try):
+            protects = _try_protects(stmt)
+            self.walk_body(stmt.body, held, protected or protects,
+                           in_arm)
+            for handler in stmt.handlers:
+                # Handler arms escape THIS guard: exceptions raised
+                # here propagate to the caller.
+                self.walk_body(handler.body, held, False, True)
+            self.walk_body(stmt.orelse, held, False, True)
+            self.walk_body(stmt.finalbody, held, False, True)
+            return
+        if isinstance(stmt, ast.Raise):
+            if not protected:
+                self.node._note_risky(stmt.lineno, 'raise')
+            # A raise's exception expression may carry calls.
+            for child in ast.iter_child_nodes(stmt):
+                self._exprs(child, held, protected, in_arm)
+            return
+        if isinstance(stmt, ast.Assert):
+            if not protected:
+                self.node._note_risky(stmt.lineno, 'assert')
+            self._exprs(stmt.test, held, protected, in_arm)
+            if stmt.msg is not None:
+                self._exprs(stmt.msg, held, protected, in_arm)
+            return
+        if isinstance(stmt, ast.Match):
+            # match arms share the lexical state; case bodies are
+            # lists of match_case (not stmt), so the generic fallback
+            # below would skip them SILENTLY — handle explicitly.
+            self._exprs(stmt.subject, held, protected, in_arm)
+            for case in stmt.cases:
+                if case.guard is not None:
+                    self._exprs(case.guard, held, protected, in_arm)
+                self.walk_body(case.body, held, protected, in_arm)
+            return
+        # Generic statements: scan expressions, recurse into nested
+        # statement lists (if/for/while bodies share the lexical
+        # state; a loop does not change guard or lock scope).
+        for field in ('test', 'iter', 'value', 'targets', 'target'):
+            sub = getattr(stmt, field, None)
+            if sub is None:
+                continue
+            for expr in (sub if isinstance(sub, list) else [sub]):
+                if isinstance(expr, ast.expr):
+                    self._exprs(expr, held, protected, in_arm)
+        for field in ('body', 'orelse', 'finalbody'):
+            sub = getattr(stmt, field, None)
+            if isinstance(sub, list) and sub and \
+                    isinstance(sub[0], ast.stmt):
+                self.walk_body(sub, held, protected, in_arm)
+
+    # -- expressions ---------------------------------------------------------
+
+    def _exprs(self, expr: ast.expr, held: Tuple[str, ...],
+               protected: bool, in_arm: bool) -> None:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                self._call(sub, held, protected, in_arm)
+            elif not protected:
+                if isinstance(sub, ast.Subscript):
+                    self.node._note_risky(sub.lineno, 'subscript')
+                elif isinstance(sub, ast.Attribute) and \
+                        not getattr(sub, '_xsky_is_callee', False):
+                    # Attribute loads can raise AttributeError; the
+                    # func of a Call is tagged by _call (ast.walk
+                    # yields the Call before its children) and the
+                    # call itself is handled via resolution instead.
+                    self.node._note_risky(sub.lineno, 'attribute')
+
+    def _call(self, call: ast.Call, held: Tuple[str, ...],
+              protected: bool, in_arm: bool) -> None:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            func._xsky_is_callee = True   # not an AttributeError risk
+            # (the receiver expression below it stays risk-checked.)
+        site = self._site_of(call, held, protected, in_arm)
+        if site is not None:
+            self.node.calls.append(site)
+        prim = self._primitive_of(call)
+        if prim is not None:
+            kind, desc = prim
+            self.node.primitives.append(PrimitiveSite(
+                lineno=call.lineno, kind=kind, desc=desc, held=held,
+                exempt=_marker_covers(self.lines, call.lineno)
+                or self.node.exempt_all))
+        self._indirection(call, held, protected, in_arm)
+
+    def _site_of(self, call: ast.Call, held, protected,
+                 in_arm) -> Optional[CallSite]:
+        func = call.func
+        common = dict(lineno=call.lineno, held=held,
+                      protected=protected, in_arm=in_arm)
+        if isinstance(func, ast.Name):
+            return CallSite(kind='name', name=func.id, **common)
+        if isinstance(func, ast.Attribute):
+            value = func.value
+            if isinstance(value, ast.Name):
+                if value.id in ('self', 'cls'):
+                    return CallSite(kind='self', name=func.attr,
+                                    **common)
+                return CallSite(kind='recv', name=func.attr,
+                                recv=value.id, **common)
+            return CallSite(kind='dynamic', name=func.attr, **common)
+        return None   # exotic callee (call on a call, subscript...)
+
+    def _indirection(self, call: ast.Call, held, protected,
+                     in_arm) -> None:
+        """run_in_parallel(fn, ...) and Thread(target=fn) edges."""
+        func = call.func
+        callee = func.attr if isinstance(func, ast.Attribute) \
+            else getattr(func, 'id', '')
+        target: Optional[ast.expr] = None
+        spawn = False
+        if callee == 'run_in_parallel' and call.args:
+            target = call.args[0]
+        elif callee == 'Thread':
+            for kw in call.keywords:
+                if kw.arg == 'target':
+                    target, spawn = kw.value, True
+        if target is None:
+            return
+        common = dict(lineno=call.lineno, held=held,
+                      protected=protected, in_arm=in_arm, spawn=spawn)
+        if isinstance(target, ast.Name):
+            self.node.calls.append(
+                CallSite(kind='name', name=target.id, **common))
+        elif isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id in ('self', 'cls'):
+            self.node.calls.append(
+                CallSite(kind='self', name=target.attr, **common))
+
+    # -- blocking primitives -------------------------------------------------
+
+    def _primitive_of(self, call: ast.Call
+                      ) -> Optional[Tuple[str, str]]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            if func.id == 'open' and self._open_writes(call):
+                return 'fs-write', 'open(mode=w/a/x/+)'
+            if func.id == 'urlopen':
+                return 'network', 'urlopen'
+            if func.id == 'run_in_parallel':
+                return 'fanout', 'run_in_parallel'
+            if func.id == 'Popen':
+                return 'subprocess', 'Popen'
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        recv = func.value.id if isinstance(func.value, ast.Name) else ''
+        if attr == 'sleep':
+            return 'sleep', f'{recv or "?"}.sleep'
+        if attr == 'wait' and recv != 'self':
+            # Event/Condition/process waits block; `self.<x>.wait()`
+            # chains land here too via recv='' — still blocking.
+            return 'wait', f'{recv or "?"}.wait'
+        if recv == 'subprocess':
+            return 'subprocess', f'subprocess.{attr}'
+        if recv == 'socket' and attr in ('socket', 'create_connection'):
+            return 'network', f'socket.{attr}'
+        if attr == 'urlopen' or recv in _NETWORK_RECVS:
+            return 'network', f'{recv}.{attr}'.strip('.')
+        if attr == 'connect' and recv in ('sqlite3', 'db_utils'):
+            return 'db', f'{recv}.connect'
+        if attr in ('execute', 'executemany', 'executescript',
+                    'commit'):
+            return 'db', f'.{attr}'
+        if recv == 'os' and attr in _OS_FS_WRITE:
+            return 'fs-write', f'os.{attr}'
+        if recv == 'shutil':
+            return 'fs-write', f'shutil.{attr}'
+        if attr in _FILE_WRITE_ATTRS:
+            return 'fs-write', f'.{attr}'
+        if attr == 'run_in_parallel':
+            return 'fanout', 'run_in_parallel'
+        return None
+
+    @staticmethod
+    def _open_writes(call: ast.Call) -> bool:
+        mode = None
+        if len(call.args) > 1 and isinstance(call.args[1], ast.Constant):
+            mode = call.args[1].value
+        for kw in call.keywords:
+            if kw.arg == 'mode' and isinstance(kw.value, ast.Constant):
+                mode = kw.value.value
+        return isinstance(mode, str) and \
+            any(ch in mode for ch in _WRITE_MODE_CHARS)
+
+
+def harvest_into(index, mod, rel_path: str, tree: ast.Module,
+                 lines: List[str]) -> None:
+    """Populate ``index.functions`` and ``mod.import_map`` from one
+    shared tree (called by ``ProjectIndex.add_file`` — never parses)."""
+    _harvest_imports(tree.body, mod.import_map)
+
+    def one(fn: ast.AST, cls: Optional[str]) -> None:
+        qual = f'{cls}.{fn.name}' if cls else fn.name
+        node = FunctionNode(
+            rel_path=rel_path, qual=qual, lineno=fn.lineno, cls=cls,
+            exempt_all=_marker_covers(lines, fn.lineno))
+        index.functions[(rel_path, qual)] = node
+        _FunctionHarvester(node, mod.locks, lines).walk_body(
+            fn.body, (), False, False)
+
+    for top in tree.body:
+        if isinstance(top, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            one(top, None)
+        elif isinstance(top, ast.ClassDef):
+            for sub in top.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    one(sub, top.name)
+
+
+# ---- the graph --------------------------------------------------------------
+
+Key = Tuple[str, str]          # (rel_path, qual)
+
+
+class CallGraph:
+    """Whole-program call graph over a :class:`ProjectIndex`'s
+    harvested :class:`FunctionNode`\\ s. Edge resolution is lazy and
+    memoized; ``unknown`` counts the dynamic call sites per node that
+    no heuristic could resolve (the explicit soundness budget)."""
+
+    def __init__(self, index) -> None:
+        self.index = index
+        self.functions: Dict[Key, FunctionNode] = index.functions
+        self.unknown: Dict[Key, int] = {}
+        self._edges: Dict[Key, List[Tuple[Key, CallSite]]] = {}
+        # (rel_path, method name) → [quals] for the unique-local-method
+        # fallback.
+        self._methods: Dict[Tuple[str, str], List[str]] = {}
+        for (rel, qual) in self.functions:
+            if '.' in qual:
+                cls, meth = qual.split('.', 1)
+                del cls
+                self._methods.setdefault((rel, meth), []).append(qual)
+        self._safe: Optional[Dict[Key, Tuple[bool, Any]]] = None
+        self._below_locks: Optional[Dict[Key, Set[str]]] = None
+        self._below_prims: Optional[Dict[Key, Dict[str, Any]]] = None
+
+    @classmethod
+    def for_index(cls, index) -> 'CallGraph':
+        graph = getattr(index, '_callgraph', None)
+        if graph is None:
+            graph = cls(index)
+            index._callgraph = graph
+        return graph
+
+    # -- resolution ----------------------------------------------------------
+
+    def _module_rel(self, dotted: str) -> Optional[str]:
+        base = dotted.replace('.', '/')
+        for rel in (f'{base}.py', f'{base}/__init__.py'):
+            if rel in self.index.modules:
+                return rel
+        return None
+
+    def _fn_in(self, rel: str, name: str) -> Optional[Key]:
+        if (rel, name) in self.functions:
+            return (rel, name)
+        # Constructing a class resolves to its __init__ (a class with
+        # no __init__ is a resolvable no-op leaf — dropped as external
+        # by the caller).
+        if (rel, f'{name}.__init__') in self.functions:
+            return (rel, f'{name}.__init__')
+        return None
+
+    def resolve(self, key: Key, site: CallSite,
+                strict: bool = False) -> Tuple[str, Optional[Key]]:
+        """('fn', target) | ('external', None) | ('unknown', None).
+
+        ``strict`` disables the unique-local-method heuristic: it
+        over-approximates, which is the SAFE direction for the
+        purity/lock closures (extra edges → extra findings) but
+        unsound as a never-raise PROOF (a guessed-wrong target could
+        certify a raising fallback) — proof consumers resolve
+        strictly and treat the guess as unknown."""
+        rel, _ = key
+        node = self.functions[key]
+        mod = self.index.modules.get(rel)
+        imap = dict(getattr(mod, 'import_map', {}) or {})
+        imap.update(node.imports)
+        if site.kind == 'self':
+            if node.cls is not None:
+                target = self.functions.get(
+                    (rel, f'{node.cls}.{site.name}'))
+                if target is not None:
+                    return 'fn', (rel, f'{node.cls}.{site.name}')
+            return 'unknown', None   # inherited / dynamic attribute
+        if site.kind == 'name':
+            target = self._fn_in(rel, site.name)
+            if target is not None:
+                return 'fn', target
+            dotted = imap.get(site.name)
+            if dotted:
+                parent, _, leaf = dotted.rpartition('.')
+                parent_rel = self._module_rel(parent) if parent else None
+                if parent_rel is not None:
+                    target = self._fn_in(parent_rel, leaf)
+                    if target is not None:
+                        return 'fn', target
+                return 'external', None
+            return 'external', None   # builtin or inherited global
+        if site.kind == 'recv':
+            dotted = imap.get(site.recv)
+            if dotted:
+                target_rel = self._module_rel(dotted)
+                if target_rel is not None:
+                    target = self._fn_in(target_rel, site.name)
+                    if target is not None:
+                        return 'fn', target
+                    return 'unknown', None   # re-export / dynamic
+                return 'external', None      # time.sleep, jax...
+        if strict:
+            return 'unknown', None
+        return self._unique_method(rel, site)
+
+    def _unique_method(self, rel: str,
+                       site: CallSite) -> Tuple[str, Optional[Key]]:
+        quals = self._methods.get((rel, site.name), [])
+        if len(quals) == 1:
+            return 'fn', (rel, quals[0])
+        return 'unknown', None
+
+    def edges(self, key: Key) -> List[Tuple[Key, CallSite]]:
+        cached = self._edges.get(key)
+        if cached is not None:
+            return cached
+        out: List[Tuple[Key, CallSite]] = []
+        unknown = 0
+        for site in self.functions[key].calls:
+            verdict, target = self.resolve(key, site)
+            if verdict == 'fn' and target is not None:
+                out.append((target, site))
+            elif verdict == 'unknown':
+                unknown += 1
+        self._edges[key] = out
+        self.unknown[key] = unknown
+        return out
+
+    # -- closures + chains ---------------------------------------------------
+
+    def closure(self, entries: List[Key],
+                skip_modules: Tuple[str, ...] = (),
+                follow_spawn: bool = False
+                ) -> Dict[Key, Optional[Tuple[Key, CallSite]]]:
+        """BFS from `entries`; returns {node: (parent, via-site)} with
+        None for the entries themselves. BFS ⇒ the recorded parent
+        chain is a shortest entry→node path."""
+        parents: Dict[Key, Optional[Tuple[Key, CallSite]]] = {}
+        queue: List[Key] = []
+        for entry in entries:
+            if entry in self.functions and entry not in parents:
+                parents[entry] = None
+                queue.append(entry)
+        i = 0
+        while i < len(queue):
+            key = queue[i]
+            i += 1
+            for target, site in self.edges(key):
+                if site.spawn and not follow_spawn:
+                    continue
+                if target[0] in skip_modules:
+                    continue
+                if target not in parents:
+                    parents[target] = (key, site)
+                    queue.append(target)
+        return parents
+
+    def chain(self, parents, key: Key) -> List[Tuple[Key, int]]:
+        """[(node, call lineno into the NEXT node)] entry-first; the
+        last element's lineno is 0 (it is the endpoint)."""
+        rev: List[Tuple[Key, int]] = [(key, 0)]
+        cur = key
+        while parents.get(cur) is not None:
+            parent, site = parents[cur]
+            rev.append((parent, site.lineno))
+            cur = parent
+        rev.reverse()
+        return rev
+
+    def render_chain(self, parents, key: Key) -> List[str]:
+        out = []
+        chain = self.chain(parents, key)
+        for i, (node_key, lineno) in enumerate(chain):
+            rel, qual = node_key
+            arrow = '' if i == 0 else '-> '
+            at = f' (calls next at {rel}:{lineno})' if lineno else ''
+            out.append(f'{arrow}{qual} [{rel}:'
+                       f'{self.functions[node_key].lineno}]{at}')
+        return out
+
+    # -- fixpoints -----------------------------------------------------------
+
+    def below_locks(self) -> Dict[Key, Set[str]]:
+        """Locks acquired anywhere in each node's transitive closure
+        (spawn edges excluded — a new thread starts lock-free)."""
+        if self._below_locks is not None:
+            return self._below_locks
+        below = {key: {a.lock for a in node.lock_acqs}
+                 for key, node in self.functions.items()}
+        changed = True
+        while changed:
+            changed = False
+            for key in self.functions:
+                for target, site in self.edges(key):
+                    if site.spawn:
+                        continue
+                    extra = below[target] - below[key]
+                    if extra:
+                        below[key] |= extra
+                        changed = True
+        self._below_locks = below
+        return below
+
+    def below_prims(self
+                    ) -> Dict[Key, Dict[Tuple[str, str],
+                                        Tuple[Key, Any]]]:
+        """(kind, owner module) → one (owner, PrimitiveSite) witness
+        reachable from each node (spawn edges excluded). Keyed per
+        OWNER MODULE, not just kind — the lock-order rule exempts a
+        db primitive in the lock's own module but not a cross-module
+        one, so a same-module witness must never shadow a reachable
+        cross-module violation of the same kind. ``# hotpath ok:``
+        exempt sites are INCLUDED — the marker bounds a site's
+        hot-path cost, not the time a lock stays held over it; each
+        witness carries its PrimitiveSite, so consumers that do want
+        to honor exemptions can filter on ``prim.exempt``."""
+        if self._below_prims is not None:
+            return self._below_prims
+        below: Dict[Key, Dict[Tuple[str, str], Tuple[Key, Any]]] = {}
+        for key, node in self.functions.items():
+            own: Dict[Tuple[str, str], Tuple[Key, Any]] = {}
+            for prim in node.primitives:
+                own.setdefault((prim.kind, key[0]), (key, prim))
+            below[key] = own
+        changed = True
+        while changed:
+            changed = False
+            for key in self.functions:
+                for target, site in self.edges(key):
+                    if site.spawn:
+                        continue
+                    for wkey, witness in below[target].items():
+                        if wkey not in below[key]:
+                            below[key][wkey] = witness
+                            changed = True
+        self._below_prims = below
+        return below
+
+    # -- transitive no-raise -------------------------------------------------
+
+    # External calls accepted inside fallback arms: clock reads cannot
+    # realistically raise and appear throughout the recording planes.
+    NO_RAISE_EXTERNAL = frozenset({
+        'time.time', 'time.monotonic', 'time.perf_counter',
+        'isinstance', 'id', 'bool',
+    })
+
+    def no_raise_safe(self) -> Dict[Key, Tuple[bool, Any]]:
+        """{node: (safe, reason)} — `safe` means the function provably
+        cannot raise: no risky construct outside a broad try, and
+        every unprotected call resolves to a transitively-safe
+        function (or an allowlisted external). reason is
+        ('risky', line, what) or ('call', site, target-or-None)."""
+        if self._safe is not None:
+            return self._safe
+        verdicts: Dict[Key, Tuple[bool, Any]] = {}
+        for key, node in self.functions.items():
+            if node.risky_line is not None:
+                verdicts[key] = (
+                    False, ('risky', node.risky_line, node.risky_what))
+            else:
+                verdicts[key] = (True, None)
+        # Iterate downward: a call to an unsafe/unresolved function
+        # flips the caller unsafe; repeat to fixpoint. Resolution is
+        # STRICT — the unique-method guess must never certify a
+        # proof.
+        changed = True
+        while changed:
+            changed = False
+            for key, node in self.functions.items():
+                if not verdicts[key][0]:
+                    continue
+                for site in node.unprotected_calls():
+                    verdict, target = self.resolve(key, site,
+                                                   strict=True)
+                    if verdict == 'external':
+                        label = f'{site.recv}.{site.name}' if site.recv \
+                            else site.name
+                        if label in self.NO_RAISE_EXTERNAL:
+                            continue
+                        verdicts[key] = (False, ('call', site, None))
+                        changed = True
+                        break
+                    if verdict == 'unknown':
+                        verdicts[key] = (False, ('call', site, None))
+                        changed = True
+                        break
+                    if not verdicts[target][0]:
+                        verdicts[key] = (False, ('call', site, target))
+                        changed = True
+                        break
+        self._safe = verdicts
+        return verdicts
+
+    def explain_unsafe(self, key: Key, limit: int = 8) -> List[str]:
+        """Why `key` is not provably no-raise: the call chain down to
+        the first risky construct."""
+        verdicts = self.no_raise_safe()
+        out: List[str] = []
+        cur: Optional[Key] = key
+        seen = set()
+        while cur is not None and cur not in seen and len(out) < limit:
+            seen.add(cur)
+            safe, reason = verdicts.get(cur, (True, None))
+            if safe or reason is None:
+                break
+            rel, qual = cur
+            if reason[0] == 'risky':
+                out.append(f'{qual} [{rel}:{reason[1]}] has a '
+                           f'{reason[2]} outside any broad try')
+                break
+            site, target = reason[1], reason[2]
+            label = f'{site.recv}.{site.name}' if site.recv \
+                else site.name
+            if target is None:
+                out.append(f'{qual} [{rel}:{site.lineno}] calls '
+                           f'{label} which cannot be resolved/proven')
+                break
+            out.append(f'{qual} [{rel}:{site.lineno}] calls '
+                       f'{target[1]}')
+            cur = target
+        return out

@@ -48,6 +48,11 @@ LEGACY_MARKERS: Dict[str, str] = {
     # (consumed during index construction, listed here so the marker
     # is discoverable alongside the other exemption comments).
     '# single-writer ok': 'lock-discipline',
+    # Hot-path escape hatch of the hot-path-purity rule: an
+    # interval-gated/atomic blocking site (the telemetry spool
+    # pattern) declares its bound after the colon. Consumed during
+    # call-graph harvest (tools/xskylint/callgraph.py).
+    '# hotpath ok': 'hot-path-purity',
 }
 
 # Engine-minted finding ids (not registered rules; not suppressible —
@@ -69,6 +74,10 @@ class Finding:
     message: str
     suppressed: bool = False
     reason: Optional[str] = None   # the suppression's mandatory reason
+    # Interprocedural evidence (the entry→violation call chain, a
+    # lock cycle's edge witnesses): rendered by `xsky lint --why`,
+    # carried through --json.
+    detail: Optional[List[str]] = None
 
     def render(self) -> str:
         tail = f' (suppressed: {self.reason})' if self.suppressed else ''
@@ -166,6 +175,12 @@ class Rule:
     id: str = ''
     rationale: str = ''
     needs_index: bool = False
+    # Rule ids that must run WHENEVER this rule runs: a rule whose
+    # soundness depends on a second rule verifying what it admits
+    # (never-raise admits fallback-arm calls because
+    # never-raise-transitive proves them) declares the dependency so
+    # a --rule subset can't silently drop the verification half.
+    companions: tuple = ()
 
     def applies_to(self, rel_path: str) -> bool:
         del rel_path
@@ -197,9 +212,11 @@ class RunContext:
         self.index = None
 
     def report(self, rule_id: str, path: str, line: int,
-               message: str) -> None:
+               message: str,
+               detail: Optional[List[str]] = None) -> None:
         self.findings.append(
-            Finding(rule=rule_id, path=path, line=line, message=message))
+            Finding(rule=rule_id, path=path, line=line, message=message,
+                    detail=detail))
 
 
 def legacy_markers_for(rule_id: str) -> List[str]:
@@ -254,11 +271,76 @@ class _Suppressions:
         return None
 
 
+class AstCache:
+    """mtime+size+content-hash-keyed pickle cache of parsed trees
+    under ``<root>/.xskylint_cache/`` — the engine's repeated-run
+    accelerator (``--changed`` and pre-commit loops re-run the
+    whole-program index every time; re-parsing ~350 files dominated).
+    The source is already in memory for suppression matching, so the
+    key includes its sha1 alongside (mtime_ns, size) — a same-size
+    edit inside the filesystem's mtime granularity (1 s on several)
+    can never serve a stale tree. A stale, corrupt, or cross-version
+    entry silently degrades to a fresh parse — the cache can never
+    change a verdict, only skip ``ast.parse`` calls (the parse-once
+    counter test asserts hits)."""
+
+    # Bump when the stored payload shape changes.
+    FORMAT = 2
+
+    def __init__(self, cache_dir: str) -> None:
+        self.cache_dir = cache_dir
+        self._stamp = (self.FORMAT, sys.version_info[:2])
+
+    def _entry_path(self, rel_path: str) -> str:
+        import hashlib
+        digest = hashlib.sha1(rel_path.encode('utf-8')).hexdigest()
+        return os.path.join(self.cache_dir, f'{digest}.pkl')
+
+    @staticmethod
+    def _key(rel_path: str, mtime_ns: int, size: int,
+             source: str) -> tuple:
+        import hashlib
+        content = hashlib.sha1(source.encode('utf-8')).hexdigest()
+        return (rel_path, mtime_ns, size, content)
+
+    def get(self, rel_path: str, mtime_ns: int, size: int,
+            source: str) -> Optional[ast.Module]:
+        import pickle
+        try:
+            with open(self._entry_path(rel_path), 'rb') as f:
+                payload = pickle.load(f)
+            if payload.get('stamp') == self._stamp and \
+                    payload.get('key') == self._key(
+                        rel_path, mtime_ns, size, source):
+                return payload['tree']
+        except Exception:  # pylint: disable=broad-except
+            pass   # miss/corrupt/unreadable: reparse
+        return None
+
+    def put(self, rel_path: str, mtime_ns: int, size: int,
+            source: str, tree: ast.Module) -> None:
+        import pickle
+        try:
+            os.makedirs(self.cache_dir, exist_ok=True)
+            path = self._entry_path(rel_path)
+            tmp = f'{path}.tmp.{os.getpid()}'
+            with open(tmp, 'wb') as f:
+                pickle.dump({'stamp': self._stamp,
+                             'key': self._key(rel_path, mtime_ns,
+                                              size, source),
+                             'tree': tree}, f,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, path)
+        except Exception:  # pylint: disable=broad-except
+            pass   # a read-only checkout still lints, uncached
+
+
 class LintEngine:
     """Run a rule set over a tree of Python files, parsing each once."""
 
     def __init__(self, root: str, rules: List[Rule],
-                 parse: Callable[..., ast.Module] = ast.parse) -> None:
+                 parse: Callable[..., ast.Module] = ast.parse,
+                 cache_dir: Optional[str] = None) -> None:
         self.root = os.path.abspath(root)
         self.rules = rules
         self.rule_ids = {r.id for r in rules}
@@ -270,6 +352,7 @@ class LintEngine:
             r.id for r in all_rules()}
         # Injectable for the parse-once engine test.
         self._parse = parse
+        self._cache = AstCache(cache_dir) if cache_dir else None
 
     # -- file discovery ------------------------------------------------------
 
@@ -358,9 +441,18 @@ class LintEngine:
         for rel in files:
             abs_path = os.path.join(self.root, rel)
             try:
+                st = os.stat(abs_path)
                 with open(abs_path, encoding='utf-8') as f:
                     source = f.read()
-                tree = self._parse(source, filename=rel)
+                tree = None
+                if self._cache is not None:
+                    tree = self._cache.get(rel, st.st_mtime_ns,
+                                           st.st_size, source)
+                if tree is None:
+                    tree = self._parse(source, filename=rel)
+                    if self._cache is not None:
+                        self._cache.put(rel, st.st_mtime_ns,
+                                        st.st_size, source, tree)
             except (OSError, SyntaxError, ValueError) as e:
                 findings.append(Finding(
                     rule=PARSE_RULE, path=rel, line=getattr(
@@ -457,10 +549,13 @@ class RunResult:
 def lint_paths(root: str, paths: Iterable[str],
                rule_ids: Optional[Iterable[str]] = None,
                parse: Callable[..., ast.Module] = ast.parse,
-               focus: Optional[Set[str]] = None) -> RunResult:
+               focus: Optional[Set[str]] = None,
+               cache_dir: Optional[str] = None) -> RunResult:
     """Convenience wrapper: run (a subset of) the registered rules
     over `paths` under `root`. The API tests and the migrated
-    test_chaos.py wrappers call."""
+    test_chaos.py wrappers call. ``cache_dir`` enables the
+    mtime+size-keyed AST cache (off by default for API callers; the
+    CLI turns it on)."""
     from tools.xskylint.rules import all_rules
     rules = all_rules()
     if rule_ids is not None:
@@ -468,8 +563,83 @@ def lint_paths(root: str, paths: Iterable[str],
         unknown = wanted - {r.id for r in rules}
         if unknown:
             raise ValueError(f'unknown rule id(s): {sorted(unknown)}')
+        # Companion closure: a rule whose soundness depends on a
+        # verifier rule pulls it in (a `--rule never-raise` run must
+        # not accept arm calls nothing verifies).
+        by_id = {r.id: r for r in rules}
+        queue = list(wanted)
+        while queue:
+            for companion in by_id[queue.pop()].companions:
+                if companion not in wanted:
+                    wanted.add(companion)
+                    queue.append(companion)
         rules = [r for r in rules if r.id in wanted]
-    return LintEngine(root, rules, parse=parse).run(paths, focus=focus)
+    return LintEngine(root, rules, parse=parse,
+                      cache_dir=cache_dir).run(paths, focus=focus)
+
+
+# ---- suppression-debt baseline ---------------------------------------------
+
+BASELINE_REL_PATH = 'tools/xskylint/suppressions_baseline.json'
+
+
+def baseline_counts(result: 'RunResult') -> Dict[str, int]:
+    return {rule: row['suppressed']
+            for rule, row in sorted(result.stats().items())
+            if row['suppressed']}
+
+
+def write_baseline(root: str, result: 'RunResult') -> str:
+    """(Re)generate the checked-in suppression-count baseline."""
+    counts = baseline_counts(result)
+    path = os.path.join(root, BASELINE_REL_PATH)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    payload = {
+        'comment': 'Suppression-debt ratchet: CI fails when a rule\'s '
+                   'suppression count exceeds this baseline. Fix '
+                   'findings in-code; if a suppression is genuinely '
+                   'warranted, update this file IN THE SAME DIFF '
+                   '(python -m tools.xskylint --write-baseline).',
+        'total': sum(counts.values()),
+        'rules': counts,
+    }
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write('\n')
+    return path
+
+
+def check_baseline(root: str, result: 'RunResult'
+                   ) -> "tuple[bool, List[str]]":
+    """The ratchet: growth beyond the checked-in counts fails;
+    shrinkage passes with a nudge to ratchet the baseline down."""
+    path = os.path.join(root, BASELINE_REL_PATH)
+    try:
+        with open(path, encoding='utf-8') as f:
+            baseline = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, [f'suppression baseline unreadable at '
+                       f'{BASELINE_REL_PATH}: {e} — regenerate with '
+                       '--write-baseline']
+    base_rules: Dict[str, int] = baseline.get('rules', {})
+    current = baseline_counts(result)
+    messages: List[str] = []
+    grew = False
+    for rule in sorted(set(current) | set(base_rules)):
+        cur, base = current.get(rule, 0), base_rules.get(rule, 0)
+        if cur > base:
+            grew = True
+            messages.append(
+                f'suppression debt grew for {rule}: {cur} > baseline '
+                f'{base} — fix the finding in-code, or update '
+                f'{BASELINE_REL_PATH} in the same diff with the '
+                'justification')
+        elif cur < base:
+            messages.append(
+                f'note: {rule} suppressions shrank ({cur} < baseline '
+                f'{base}) — ratchet the baseline down with '
+                '--write-baseline')
+    return not grew, messages
 
 
 def changed_files(root: str,
@@ -565,6 +735,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument('--stats', action='store_true', dest='stats',
                         help='per-rule finding + suppression counts '
                              '(with reasons)')
+    parser.add_argument('--why', metavar='RULE:FILE:LINE', default=None,
+                        help='explain one finding: re-run that rule '
+                             'and print the shortest entry->violation '
+                             'call chain (lock-order: the cycle\'s '
+                             'edge witnesses)')
+    parser.add_argument('--no-cache', action='store_true',
+                        help='disable the mtime+size-keyed AST cache '
+                             '(.xskylint_cache/)')
+    parser.add_argument('--check-baseline', action='store_true',
+                        help='fail when per-rule suppression counts '
+                             'exceed the checked-in baseline '
+                             '(suppression-debt ratchet)')
+    parser.add_argument('--write-baseline', action='store_true',
+                        help='regenerate the suppression-count '
+                             'baseline from this run')
     parser.add_argument('--list-rules', action='store_true',
                         help='print the rule catalog and exit')
     args = parser.parse_args(argv)
@@ -576,6 +761,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     root = os.path.abspath(args.root) if args.root else _default_root()
+    cache_dir = None
+    if not args.no_cache and \
+            os.environ.get('XSKY_LINT_CACHE', '1') != '0':
+        cache_dir = os.environ.get(
+            'XSKY_LINT_CACHE_DIR',
+            os.path.join(root, '.xskylint_cache'))
+    if args.why:
+        return _explain_why(root, args.why, cache_dir)
+    if args.write_baseline or args.check_baseline:
+        # The baseline is a FULL-TREE statement: a --changed/--rule/
+        # subtree run undercounts suppressions, which would gut a
+        # written baseline and let growth slip past a check. Refuse
+        # before doing any work.
+        if args.changed or args.rules or \
+                sorted(args.paths) != ['skypilot_tpu', 'tools']:
+            print('xskylint: --write-baseline/--check-baseline need '
+                  'a full default run (no --changed/--rule/path '
+                  'subset) — the baseline counts the whole tree',
+                  file=sys.stderr)
+            return 2
     focus = None
     if args.changed:
         focus = changed_files(root, args.base)
@@ -589,10 +794,23 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 0
     try:
         result = lint_paths(root, args.paths, rule_ids=args.rules,
-                            focus=focus)
+                            focus=focus, cache_dir=cache_dir)
     except (ValueError, FileNotFoundError) as e:
         print(f'xskylint: {e}', file=sys.stderr)
         return 2
+
+    baseline_rc = 0
+    if args.write_baseline:
+        path = write_baseline(root, result)
+        print(f'xskylint: baseline written to {path}',
+              file=sys.stderr)
+    elif args.check_baseline:
+        ok, messages = check_baseline(root, result)
+        # stderr so `--json | tee` output stays parseable.
+        for message in messages:
+            print(f'xskylint: {message}', file=sys.stderr)
+        if not ok:
+            baseline_rc = 1
 
     if args.as_json:
         print(json.dumps(result.to_json(), indent=2))
@@ -606,7 +824,50 @@ def main(argv: Optional[List[str]] = None) -> int:
         suppressed = sum(f.suppressed for f in result.findings)
         print(f'xskylint: {result.files_scanned} files, '
               f'{n} finding(s), {suppressed} suppressed')
-    return 1 if result.unsuppressed else 0
+    return 1 if result.unsuppressed else baseline_rc
+
+
+def _explain_why(root: str, spec: str,
+                 cache_dir: Optional[str]) -> int:
+    """``--why rule:file:line``: focused re-run of ONE rule, printing
+    the finding plus its interprocedural evidence (the shortest
+    entry→violation call chain / the lock cycle's edge witnesses) so
+    builders can act without reading the engine."""
+    try:
+        head, line_s = spec.rsplit(':', 1)
+        rule, path = head.split(':', 1)
+        line = int(line_s)
+    except ValueError:
+        print('xskylint: --why wants RULE:FILE:LINE '
+              '(e.g. hot-path-purity:skypilot_tpu/agent/'
+              'telemetry.py:221)', file=sys.stderr)
+        return 2
+    path = path.replace(os.sep, '/')
+    # The default tree, minus parts a fixture checkout may not have.
+    lint_roots = [p for p in ('skypilot_tpu', 'tools')
+                  if os.path.isdir(os.path.join(root, p))] or ['.']
+    try:
+        result = lint_paths(root, lint_roots,
+                            rule_ids=[rule], cache_dir=cache_dir)
+    except (ValueError, FileNotFoundError) as e:
+        print(f'xskylint: {e}', file=sys.stderr)
+        return 2
+    matches = [f for f in result.findings
+               if f.rule == rule and f.path == path and f.line == line]
+    if not matches:
+        near = [f for f in result.findings
+                if f.rule == rule and f.path == path]
+        print(f'xskylint: no {rule} finding at {path}:{line}'
+              + (f' (rule fires in that file at line(s) '
+                 f'{sorted({f.line for f in near})})' if near else ''),
+              file=sys.stderr)
+        return 1
+    for finding in matches:
+        print(finding.render())
+        for entry in finding.detail or ['(no interprocedural detail '
+                                        'recorded for this rule)']:
+            print(f'    {entry}')
+    return 0
 
 
 def _print_stats(result: 'RunResult') -> None:

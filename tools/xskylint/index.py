@@ -157,6 +157,9 @@ class ModuleIndex:
         self.symbols: Set[str] = set()
         self.containers: Dict[str, GlobalContainer] = {}
         self.locks: Set[str] = set()
+        # alias → dotted name, from top-level Import/ImportFrom — the
+        # call graph's module-attr resolution table.
+        self.import_map: Dict[str, str] = {}
         # Schema-bearing modules only: SQL string constants and
         # page_sql-paged reads, for schema-consistency.
         self.sql_constants: List[Tuple[int, str]] = []
@@ -185,6 +188,11 @@ class ProjectIndex:
         # [(rel_path, lineno)].
         self.names: Dict[str, Dict[str, List[Tuple[str, int]]]] = {
             'metric': {}, 'span': {}, 'chaos': {}, 'journal': {}}
+        # (rel_path, qualified name) → callgraph.FunctionNode: the
+        # pass-3 call-graph harvest (every module-level function and
+        # top-level-class method, with call sites / blocking
+        # primitives / lock acquisitions / never-raise facts).
+        self.functions: Dict[Tuple[str, str], object] = {}
 
     # -- construction (called by the engine, one shared tree per file) --
 
@@ -192,9 +200,14 @@ class ProjectIndex:
                  source: str) -> None:
         mod = ModuleIndex(rel_path)
         self.modules[rel_path] = mod
+        lines = source.splitlines()
         self._harvest_symbols(mod, tree)
-        self._harvest_containers(mod, tree, source.splitlines())
+        self._harvest_containers(mod, tree, lines)
         self._harvest_names(rel_path, tree)
+        # Call-graph harvest rides the same shared tree (pass 3's raw
+        # facts; containers must run first so mod.locks is filled).
+        from tools.xskylint import callgraph
+        callgraph.harvest_into(self, mod, rel_path, tree, lines)
         if 'CREATE TABLE' in source:
             self._harvest_schemas(rel_path, tree, source)
             self._harvest_sql(mod, tree)

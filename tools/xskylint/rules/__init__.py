@@ -14,11 +14,13 @@ from tools.xskylint import engine
 from tools.xskylint.rules import concurrency
 from tools.xskylint.rules import contracts
 from tools.xskylint.rules import crossfile
+from tools.xskylint.rules import interproc
 from tools.xskylint.rules import observability
 from tools.xskylint.rules import statedb
 
 _RULE_CLASSES = (concurrency.RULES + observability.RULES +
-                 statedb.RULES + contracts.RULES + crossfile.RULES)
+                 statedb.RULES + contracts.RULES + crossfile.RULES +
+                 interproc.RULES)
 
 
 def all_rules() -> List[engine.Rule]:

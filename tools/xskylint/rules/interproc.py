@@ -1,0 +1,388 @@
+"""Interprocedural rules (pass 3): run over the whole-program call
+graph built from the pass-1 :class:`ProjectIndex` (see
+``tools/xskylint/callgraph.py`` — same shared ASTs, never re-parsed).
+
+hot-path-purity: the declared hot-path entry points (``Trainer.step``,
+the serving decode tick, the LB relay leg, ``telemetry.emit``,
+``profiler.step_probe``) must not TRANSITIVELY reach a blocking
+primitive — sqlite/db_utils work, subprocess, sockets/HTTP, sleeps,
+non-spool filesystem writes, host fan-out, or acquisition of a
+control-plane lock. BENCH_LOCAL_r03_serve measured 113 ms/step of
+host-side dispatch against ~3 ms of HBM traffic: one stray sleep or
+sqlite commit a call deep below the decode loop is exactly how that
+number grows back. ``# hotpath ok: <bound>`` on the site (or its
+enclosing def) exempts the interval-gated/atomic escapes — the
+telemetry spool pattern — and must name the bound.
+
+lock-order: every ``with <module lock>:`` nesting, propagated through
+the call graph (holding A while calling into code that takes B is an
+A→B edge), folds into one lock-order graph; cycles are potential
+deadlocks reported with each edge's witness site. The same pass flags
+blocking primitives executed while a module lock is held — a sleep or
+network round trip under a control-plane lock turns one slow peer
+into a frozen plane.
+
+never-raise-transitive: the PR 8 never-raise contract checks each
+recording entry point's own try/except lexically; this rule follows
+the calls made FROM the fallback arms (except/else/finally — the code
+that runs when recording already failed) and verifies each resolves
+to a function that provably cannot raise. A fallback that can itself
+throw escapes the guard exactly when the plane is already degraded.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.xskylint import callgraph
+from tools.xskylint import engine
+from tools.xskylint.rules.observability import NeverRaiseRule
+
+# ---- hot-path-purity --------------------------------------------------------
+
+# The declared hot-path entry points: (module rel path, qualified
+# function). A listed module that exists without its entry is a stale
+# contract (same posture as the lease-heartbeat table).
+HOT_PATH_ENTRIES: Tuple[Tuple[str, str], ...] = (
+    ('skypilot_tpu/train/trainer.py', 'Trainer.step'),
+    ('skypilot_tpu/infer/orchestrator.py', 'Orchestrator.step'),
+    ('skypilot_tpu/infer/orchestrator.py', 'Orchestrator._decode_tick'),
+    ('skypilot_tpu/infer/engine.py', 'ChunkedPrefill.step'),
+    ('skypilot_tpu/serve/load_balancer.py',
+     'SkyServeLoadBalancer._proxy'),
+    ('skypilot_tpu/agent/telemetry.py', 'emit'),
+    ('skypilot_tpu/agent/profiler.py', 'step_probe'),
+    ('skypilot_tpu/agent/profiler.py', '_StepProbe.done'),
+)
+
+# Modules the purity walk does not descend into: the chaos layer only
+# acts under an explicitly-configured fault plan (its sleeps ARE the
+# drill), never in production steady state.
+PURITY_SKIP_MODULES: Tuple[str, ...] = ('skypilot_tpu/utils/chaos.py',)
+
+# Module locks whose acquisition on a hot path is itself a finding:
+# the control-plane state/server/jobs planes (a decode tick waiting on
+# the fleet write lock is the 113 ms class of bug).
+CONTROL_PLANE_LOCK_PREFIXES: Tuple[str, ...] = (
+    'skypilot_tpu/state.py', 'skypilot_tpu/utils/db_utils.py',
+    'skypilot_tpu/server/', 'skypilot_tpu/jobs/',
+    'skypilot_tpu/serve/state.py',
+)
+
+
+class HotPathPurityRule(engine.Rule):
+    """No blocking primitive in the transitive closure of a declared
+    hot-path entry point. Findings land at the primitive's own line
+    (where a fix or a ``# hotpath ok: <bound>`` exemption belongs) and
+    carry the full entry→violation call chain."""
+
+    id = 'hot-path-purity'
+    needs_index = True
+    rationale = ('hot-path entry points must not transitively reach '
+                 'blocking work (sleep/DB/network/subprocess/'
+                 'fs-write/fan-out/control-plane locks); exempt '
+                 'bounded escapes with `# hotpath ok: <bound>`')
+
+    def finalize(self, run: engine.RunContext) -> None:
+        idx = getattr(run, 'index', None)
+        if idx is None:
+            return
+        graph = callgraph.CallGraph.for_index(idx)
+        entries: List[callgraph.Key] = []
+        for rel, qual in HOT_PATH_ENTRIES:
+            if (rel, qual) in graph.functions:
+                entries.append((rel, qual))
+            elif rel in idx.modules:
+                run.report(
+                    self.id, rel, 1,
+                    f'hot-path contract is stale: no function '
+                    f'{qual} in {rel} — update HOT_PATH_ENTRIES')
+        if not entries:
+            return
+        parents = graph.closure(list(entries),
+                                skip_modules=PURITY_SKIP_MODULES)
+        reported: Set[Tuple[str, int, str]] = set()
+        for key in parents:
+            node = graph.functions[key]
+            if node.exempt_all:
+                continue
+            chain_text = None
+            for prim in node.primitives:
+                if prim.exempt:
+                    continue
+                dedup = (node.rel_path, prim.lineno, prim.kind)
+                if dedup in reported:
+                    continue
+                reported.add(dedup)
+                if chain_text is None:
+                    chain_text = self._entry_of(graph, parents, key)
+                run.report(
+                    self.id, node.rel_path, prim.lineno,
+                    f'blocking {prim.kind} ({prim.desc}) is reachable '
+                    f'from hot-path entry {chain_text} — move it '
+                    'off-path/behind an interval gate, or mark the '
+                    'bounded escape `# hotpath ok: <bound>`',
+                    detail=graph.render_chain(parents, key) +
+                    [f'-> blocking {prim.kind} {prim.desc} at '
+                     f'{node.rel_path}:{prim.lineno}'])
+            for acq in node.lock_acqs:
+                if acq.exempt or not self._control_plane(acq.lock):
+                    continue
+                dedup = (node.rel_path, acq.lineno, 'cp-lock')
+                if dedup in reported:
+                    continue
+                reported.add(dedup)
+                if chain_text is None:
+                    chain_text = self._entry_of(graph, parents, key)
+                run.report(
+                    self.id, node.rel_path, acq.lineno,
+                    f'control-plane lock {acq.lock} is acquired on '
+                    f'the hot path (entry {chain_text}) — a wedged '
+                    'writer would stall the step/decode loop',
+                    detail=graph.render_chain(parents, key) +
+                    [f'-> acquires {acq.lock} at '
+                     f'{node.rel_path}:{acq.lineno}'])
+
+    @staticmethod
+    def _control_plane(lock: str) -> bool:
+        rel = lock.split('::', 1)[0]
+        return rel.startswith(CONTROL_PLANE_LOCK_PREFIXES)
+
+    @staticmethod
+    def _entry_of(graph, parents, key) -> str:
+        chain = graph.chain(parents, key)
+        entry_key = chain[0][0]
+        hops = len(chain) - 1
+        return f'{entry_key[1]} ({hops} call(s) deep)'
+
+
+# ---- lock-order -------------------------------------------------------------
+
+# Primitive kinds that always count as blocking-under-lock; db and
+# fs-write block too but are the DESIGNED critical section of the
+# state modules' own write locks (WAL commit under `_lock` is the
+# serialization point, routed through the db_utils facade) — they
+# only count when the primitive lives outside BOTH the held lock's
+# module and the shared db_utils facade (i.e. holding module A's lock
+# while doing module B's disk/DB work).
+_ALWAYS_BLOCKING = frozenset({'sleep', 'network', 'subprocess',
+                              'fanout', 'wait'})
+_CROSS_MODULE_BLOCKING = frozenset({'db', 'fs-write'})
+_DB_FACADE = 'skypilot_tpu/utils/db_utils.py'
+
+
+class LockOrderRule(engine.Rule):
+    """Build the module-lock order graph (lexical nesting + held-lock
+    propagation through the call graph), report cycles as potential
+    deadlocks with per-edge witnesses, and flag blocking primitives
+    executed while a module lock is held."""
+
+    id = 'lock-order'
+    needs_index = True
+    rationale = ('inconsistent lock nesting across the call graph is '
+                 'a deadlock; blocking work under a module lock '
+                 'freezes every other acquirer')
+
+    def finalize(self, run: engine.RunContext) -> None:
+        idx = getattr(run, 'index', None)
+        if idx is None:
+            return
+        graph = callgraph.CallGraph.for_index(idx)
+        below_locks = graph.below_locks()
+        below_prims = graph.below_prims()
+        # lock-order edges: (a, b) → first witness
+        edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        for key, node in sorted(graph.functions.items()):
+            if not key[0].startswith('skypilot_tpu/'):
+                continue
+            for acq in node.lock_acqs:
+                for held in acq.held:
+                    if held != acq.lock:
+                        edges.setdefault(
+                            (held, acq.lock),
+                            (node.rel_path, acq.lineno,
+                             f'{node.qual} nests `with` blocks'))
+            for site in node.calls:
+                if site.spawn or not site.held:
+                    continue
+                verdict, target = graph.resolve(key, site)
+                if verdict != 'fn' or target is None:
+                    continue
+                for lock in sorted(below_locks.get(target, ())):
+                    for held in site.held:
+                        if held != lock:
+                            edges.setdefault(
+                                (held, lock),
+                                (node.rel_path, site.lineno,
+                                 f'{node.qual} calls {target[1]} '
+                                 'while holding the lock'))
+        self._report_cycles(run, edges)
+        self._report_blocking(run, graph, below_prims)
+
+    # -- cycles --------------------------------------------------------------
+
+    def _report_cycles(self, run, edges) -> None:
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, []).append(b)
+        seen_cycles: Set[Tuple[str, ...]] = set()
+        for start in sorted(adj):
+            cycle = self._find_cycle(start, adj)
+            if cycle is None:
+                continue
+            canon = self._canonical(cycle)
+            if canon in seen_cycles:
+                continue
+            seen_cycles.add(canon)
+            pairs = list(zip(cycle, cycle[1:] + cycle[:1]))
+            detail = []
+            for a, b in pairs:
+                rel, line, how = edges[(a, b)]
+                detail.append(f'{a} -> {b}: {how} at {rel}:{line}')
+            rel, line, _ = edges[pairs[0]]
+            run.report(
+                self.id, rel, line,
+                'lock-order cycle (potential deadlock): '
+                + ' -> '.join(cycle + [cycle[0]]) +
+                ' — break it by acquiring in one global order or '
+                'narrowing a critical section',
+                detail=detail)
+
+    @staticmethod
+    def _find_cycle(start: str, adj) -> Optional[List[str]]:
+        """A simple cycle through `start`, or None (DFS with path)."""
+        stack = [(start, [start])]
+        visited: Set[str] = set()
+        while stack:
+            node, path = stack.pop()
+            for nxt in adj.get(node, ()):
+                if nxt == start:
+                    return path
+                if nxt in visited or nxt in path:
+                    continue
+                stack.append((nxt, path + [nxt]))
+            visited.add(node)
+        return None
+
+    @staticmethod
+    def _canonical(cycle: List[str]) -> Tuple[str, ...]:
+        i = cycle.index(min(cycle))
+        return tuple(cycle[i:] + cycle[:i])
+
+    # -- blocking while held -------------------------------------------------
+
+    def _report_blocking(self, run, graph, below_prims) -> None:
+        for key, node in sorted(graph.functions.items()):
+            if not key[0].startswith('skypilot_tpu/'):
+                continue
+            for prim in node.primitives:
+                for lock in prim.held:
+                    if self._blocks(prim.kind, lock, node.rel_path):
+                        run.report(
+                            self.id, node.rel_path, prim.lineno,
+                            f'blocking {prim.kind} ({prim.desc}) '
+                            f'while holding {lock} — every other '
+                            'acquirer stalls behind it; move it '
+                            'outside the critical section')
+                        break
+            for site in node.calls:
+                if site.spawn or not site.held:
+                    continue
+                verdict, target = graph.resolve(key, site)
+                if verdict != 'fn' or target is None:
+                    continue
+                for (kind, owner_rel), (owner, prim) in sorted(
+                        below_prims.get(target, {}).items()):
+                    locks = [lk for lk in site.held
+                             if self._blocks(kind, lk, owner_rel)]
+                    if not locks:
+                        continue
+                    run.report(
+                        self.id, node.rel_path, site.lineno,
+                        f'call into {target[1]} while holding '
+                        f'{locks[0]} reaches blocking {kind} '
+                        f'({prim.desc} at {owner[0]}:{prim.lineno}) '
+                        '— move the call outside the critical '
+                        'section',
+                        detail=[f'holding {locks[0]} at '
+                                f'{node.rel_path}:{site.lineno} '
+                                f'({node.qual})',
+                                f'-> {target[1]} reaches {kind} '
+                                f'{prim.desc} at '
+                                f'{owner[0]}:{prim.lineno}'])
+                    break
+
+    @staticmethod
+    def _blocks(kind: str, lock: str, prim_rel: str) -> bool:
+        if kind in _ALWAYS_BLOCKING:
+            return True
+        if kind in _CROSS_MODULE_BLOCKING:
+            return prim_rel not in (lock.split('::', 1)[0], _DB_FACADE)
+        return False
+
+
+# ---- never-raise-transitive -------------------------------------------------
+
+
+class NeverRaiseTransitiveRule(engine.Rule):
+    """Calls made from the fallback arms (except/else/finally) of the
+    never-raise contract functions must resolve to functions the call
+    graph can prove non-raising. Composes with the lexical never-raise
+    rule: that one pins the guard SHAPE (and now admits calls in the
+    arms), this one verifies the calls."""
+
+    id = 'never-raise-transitive'
+    needs_index = True
+    rationale = ('a fallback arm of a never-raise entry point may '
+                 'only call functions that provably cannot raise — '
+                 'anything else escapes the guard exactly when the '
+                 'plane is already degraded')
+
+    def finalize(self, run: engine.RunContext) -> None:
+        idx = getattr(run, 'index', None)
+        if idx is None:
+            return
+        graph = callgraph.CallGraph.for_index(idx)
+        safe = graph.no_raise_safe()
+        for rel, fn_names in sorted(NeverRaiseRule.REQUIRED.items()):
+            if rel not in idx.modules:
+                continue
+            for fn_name in fn_names:
+                key = (rel, fn_name)
+                node = graph.functions.get(key)
+                if node is None:
+                    continue   # the lexical rule reports the staleness
+                for site in node.handler_calls():
+                    self._check_call(run, graph, safe, key, site)
+
+    def _check_call(self, run, graph, safe, key, site) -> None:
+        rel, qual = key
+        label = f'{site.recv}.{site.name}' if site.recv else site.name
+        # strict: the unique-method guess must never certify a proof.
+        verdict, target = graph.resolve(key, site, strict=True)
+        if verdict == 'external':
+            if label in callgraph.CallGraph.NO_RAISE_EXTERNAL:
+                return
+            run.report(
+                self.id, rel, site.lineno,
+                f'fallback arm of never-raise {qual} calls external '
+                f'{label!r} which cannot be proven non-raising — '
+                'inline the fallback value or guard the call')
+            return
+        if verdict == 'unknown' or target is None:
+            run.report(
+                self.id, rel, site.lineno,
+                f'fallback arm of never-raise {qual} calls {label!r} '
+                'which the call graph cannot resolve — an exception '
+                'there escapes the guard')
+            return
+        ok, _ = safe.get(target, (False, None))
+        if not ok:
+            run.report(
+                self.id, rel, site.lineno,
+                f'fallback arm of never-raise {qual} calls '
+                f'{target[1]} which is not provably non-raising — '
+                'an exception there escapes the guard',
+                detail=graph.explain_unsafe(target))
+
+
+RULES = [HotPathPurityRule, LockOrderRule, NeverRaiseTransitiveRule]
