@@ -386,15 +386,19 @@ def hosts(cluster):
 
 def _parse_since(value: Optional[str]) -> Optional[float]:
     """--since accepts a relative window (30s, 15m, 2h, 1d), a unix
-    timestamp, or an ISO date/datetime; returns a unix-ts lower bound."""
+    timestamp, or an ISO date/datetime; returns a unix-ts lower bound.
+    The relative branch rides the ONE shared duration parser
+    (common_utils.parse_duration_s — the same one `xsky metrics
+    --since/--step` uses)."""
     if not value:
         return None
     import time as time_lib
-    units = {'s': 1, 'm': 60, 'h': 3600, 'd': 86400}
+
+    from skypilot_tpu.utils import common_utils
     v = value.strip()
-    if v and v[-1].lower() in units and \
+    if v and v[-1].lower() in common_utils.DURATION_UNITS and \
             v[:-1].replace('.', '', 1).isdigit():
-        return time_lib.time() - float(v[:-1]) * units[v[-1].lower()]
+        return time_lib.time() - common_utils.parse_duration_s(v)
     try:
         return float(v)
     except ValueError:
@@ -407,6 +411,19 @@ def _parse_since(value: Optional[str]) -> Optional[float]:
     raise click.UsageError(
         f'--since {value!r}: expected 30s/15m/2h/1d, a unix '
         'timestamp, or YYYY-MM-DD[THH:MM:SS].')
+
+
+def _parse_step(value: Optional[str]) -> Optional[float]:
+    """--step: a duration ('30s', '1m', '10m', bare seconds) via the
+    shared parser."""
+    if not value:
+        return None
+    from skypilot_tpu.utils import common_utils
+    try:
+        return common_utils.parse_duration_s(value)
+    except ValueError:
+        raise click.UsageError(
+            f'--step {value!r}: expected a duration like 30s/1m/10m.')
 
 
 @cli.command()
@@ -457,6 +474,125 @@ def events(scope, event_type, limit, since, as_json):
         click.echo(fmt.format(ts, r['event_type'][:22], r['scope'][:30],
                               (r['cause'] or '-')[:20], latency,
                               (r.get('trace_id') or '-')[:16]))
+
+
+@cli.group(name='metrics')
+def metrics_group():
+    """Metrics history: recorded time series and trend queries.
+
+    The recorder tick samples every /metrics series (registry counters
+    and histograms plus the scrape-time gauges) into a bounded
+    multi-resolution store: raw points at the record interval, 1m and
+    10m avg/min/max rollups. `list` shows what has been recorded;
+    `query` folds one metric into a bucketed trend (counter-aware
+    rate, windowed histogram quantiles) with a sparkline.
+    """
+
+
+@metrics_group.command(name='list')
+@click.option('--prefix', default=None,
+              help='Only metric names starting with this prefix.')
+@click.option('--since', default=None,
+              help='Only series sampled after this point '
+                   '(30s/15m/2h/1d ago, a unix timestamp, or an ISO '
+                   'date).')
+@click.option('--limit', '-n', type=int, default=100,
+              help='Series to show.')
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='One JSON object per series.')
+def metrics_list_cmd(prefix, since, limit, as_json):
+    """List recorded metric series (name, labels, points, freshness)."""
+    import time as time_lib
+
+    from skypilot_tpu.client import sdk
+    rows = sdk.metrics_list(prefix=prefix, since=_parse_since(since),
+                            limit=limit)
+    if as_json:
+        for r in rows:
+            click.echo(json.dumps(r, default=str))
+        return
+    if not rows:
+        click.echo('No metric points recorded yet (the recorder runs '
+                   'on the API server tick; see xsky metrics query).')
+        return
+    now = time_lib.time()
+    fmt = '{:<44} {:<34} {:<9} {:>7} {:>8}'
+    click.echo(fmt.format('NAME', 'LABELS', 'KIND', 'POINTS', 'AGE'))
+    for r in rows:
+        labels = ','.join(f'{k}={v}' for k, v in
+                          sorted(r['labels'].items()))
+        click.echo(fmt.format(
+            r['name'][:44], (labels or '-')[:34], r['kind'] or '-',
+            r['points'], _age_str(now - (r['newest_ts'] or 0))))
+
+
+@metrics_group.command(name='query')
+@click.argument('name')
+@click.option('--label', 'label_filters', multiple=True,
+              help='Series filter k=v (subset match; repeatable — '
+                   'e.g. --label cluster=train --label rank=0).')
+@click.option('--since', default='1h',
+              help='Window start: 30s/15m/2h/1d ago, a unix '
+                   'timestamp, or an ISO date (default: 1h).')
+@click.option('--until', default=None,
+              help='Window end (same forms; default: now).')
+@click.option('--step', default=None,
+              help='Bucket width (30s/1m/10m or bare seconds; '
+                   'default: the tier\'s native step).')
+@click.option('--agg', default='avg',
+              type=click.Choice(['avg', 'min', 'max', 'sum', 'count',
+                                 'last', 'rate', 'p50', 'p90', 'p95',
+                                 'p99']),
+              help='Bucket aggregation; rate is counter-aware, '
+                   'p* are windowed histogram quantiles.')
+@click.option('--res', default=None,
+              type=click.Choice(['raw', '1m', '10m']),
+              help='Resolution tier (default: finest tier covering '
+                   'the window).')
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='The full query result as one JSON object.')
+def metrics_query_cmd(name, label_filters, since, until, step, agg,
+                      res, as_json):
+    """Trend-query one metric: bucketed values plus a sparkline.
+
+    Examples:
+
+        xsky metrics query xsky_dispatch_gap_ratio --label rank=0
+
+        xsky metrics query xsky_requests_total --agg rate --step 1m
+
+        xsky metrics query xsky_workload_step_seconds --agg p99
+    """
+    from skypilot_tpu.client import sdk
+    from skypilot_tpu.utils import metrics_history
+    labels = _parse_kv(label_filters, '--label')
+    result = sdk.metrics_query(name, labels=labels or None,
+                               since=_parse_since(since),
+                               until=_parse_since(until),
+                               step=_parse_step(step), agg=agg,
+                               res=res)
+    if as_json:
+        click.echo(json.dumps(result, default=str))
+        return
+    points = result.get('points') or []
+    values = [v for _, v in points if v is not None]
+    span = result['until'] - result['since']
+    click.echo(f'{result["name"]} agg={result["agg"]} '
+               f'res={result["res"]} step={result["step"]:g}s '
+               f'window={span:.0f}s '
+               + (f'labels={labels} ' if labels else ''))
+    if not values:
+        click.echo('  (no points in window — is the recorder '
+                   'running? `xsky metrics list` shows coverage)')
+        return
+    spark = metrics_history.sparkline([v for _, v in points],
+                                      width=60)
+    click.echo(f'  {spark}')
+    click.echo(f'  min={min(values):g} avg='
+               f'{sum(values) / len(values):g} max={max(values):g} '
+               f'last={values[-1]:g} '
+               f'({len(values)} points, '
+               f'{len(points) - len(values)} empty buckets)')
 
 
 @cli.command(name='fleet')
@@ -731,7 +867,55 @@ def trace_cmd(target, as_json, limit):
                 ','.join(str(r) for r in lagging) or '-'))
 
 
-def _top_rows(cluster: Optional[str]) -> List[dict]:
+def _trend_spark(name: str, labels: dict, width: int = 12,
+                 window_s: float = 1800.0) -> Optional[str]:
+    """Sparkline of one series' recent history (the --trend columns),
+    or None when nothing was recorded. Local read: trends come from
+    this host's metric_points table, like the rest of the top/slo
+    row data."""
+    import time as time_lib
+
+    from skypilot_tpu.utils import metrics_history
+    from skypilot_tpu.utils import tracing
+    with tracing.span('metrics.query', kind='trend', metric=name):
+        points = metrics_history.series(
+            name, labels=labels, since=time_lib.time() - window_s)
+    values = [v for _, v in points]
+    if not any(v is not None for v in values):
+        return None
+    return metrics_history.sparkline(values, width=width)
+
+
+def _rank_trend_maps(names: List[str], window_s: float = 1800.0
+                     ) -> dict:
+    """ONE metric_points read per metric name →
+    {name: {(cluster, job, rank): sparkline}} — `xsky top --trend`
+    must not rescan the table twice per rank per refresh (a --watch
+    loop over N ranks would pay 2N full window scans every 2 s)."""
+    import time as time_lib
+
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu.utils import metrics_history
+    from skypilot_tpu.utils import tracing
+    out: dict = {}
+    with tracing.span('metrics.query', kind='trend'):
+        for name in names:
+            groups: dict = {}
+            for row in state_lib.get_metric_points(
+                    name=name, res='raw',
+                    since=time_lib.time() - window_s):
+                labels = row['labels']
+                key = (labels.get('cluster'), labels.get('job'),
+                       labels.get('rank'))
+                groups.setdefault(key, []).append(row['value'])
+            out[name] = {key: metrics_history.sparkline(values,
+                                                        width=12)
+                         for key, values in groups.items()}
+    return out
+
+
+def _top_rows(cluster: Optional[str],
+              trend: bool = False) -> List[dict]:
     """Latest per-rank telemetry rows annotated with ages + straggler
     flags + the rank's step-anatomy profile block (shared by the table
     and --json renderers)."""
@@ -742,6 +926,9 @@ def _top_rows(cluster: Optional[str]) -> List[dict]:
     profs = {(p['cluster'], p['job_id'], p['rank']): p
              for p in state_lib.get_profiles(cluster=cluster,
                                              kind='summary')}
+    trend_maps = _rank_trend_maps(
+        ['xsky_dispatch_gap_ratio',
+         'xsky_workload_last_heartbeat_age_seconds']) if trend else {}
     by_cluster: dict = {}
     for row in rows:
         by_cluster.setdefault((row['cluster'], row['job_id']),
@@ -761,6 +948,17 @@ def _top_rows(cluster: Optional[str]) -> List[dict]:
         for rank, row in sorted(ranks.items()):
             pulled = row['ts'] or 0
             prof = profs.get((cl, job_id, rank))
+            spark = None
+            if trend:
+                # Dispatch-gap history is the host-bound trend; ranks
+                # without a profiler fall back to heartbeat-age drift
+                # (the dead-rank signature).
+                key = (cl, str(job_id), str(rank))
+                spark = trend_maps[
+                    'xsky_dispatch_gap_ratio'].get(key) or \
+                    trend_maps[
+                        'xsky_workload_last_heartbeat_age_seconds'
+                    ].get(key)
             out.append(dict(
                 row,
                 # Checkpoint freshness at pull time (None when the
@@ -778,6 +976,7 @@ def _top_rows(cluster: Optional[str]) -> List[dict]:
                 goodput_loss=loss,
                 dispatch_gap_ratio=(prof or {}).get(
                     'dispatch_gap_ratio'),
+                trend=spark,
                 # Full step-anatomy block for --json consumers.
                 profile=prof))
     return out
@@ -789,10 +988,14 @@ def _top_rows(cluster: Optional[str]) -> List[dict]:
               help='Refresh continuously (Ctrl-C to stop).')
 @click.option('--interval', type=float, default=2.0,
               help='Refresh interval with --watch (seconds).')
+@click.option('--trend', 'show_trend', is_flag=True, default=False,
+              help='Add a TREND sparkline per rank from the metrics '
+                   'history plane (dispatch-gap ratio; heartbeat age '
+                   'when no profiler runs).')
 @click.option('--json', 'as_json', is_flag=True, default=False,
               help='One JSON object per rank row (joinable with '
                    '`xsky events --json` / `xsky trace --json`).')
-def top(cluster, watch, interval, as_json):
+def top(cluster, watch, interval, show_trend, as_json):
     """Live per-rank workload view: phase, step, step time, tokens/s,
     heartbeat age, and the stall verdict for every gang rank.
 
@@ -808,7 +1011,7 @@ def top(cluster, watch, interval, as_json):
     from skypilot_tpu.agent import profiler as profiler_lib
 
     def render_once():
-        rows = _top_rows(cluster)
+        rows = _top_rows(cluster, trend=show_trend)
         if as_json:
             for row in rows:
                 click.echo(json.dumps(row, default=str))
@@ -820,9 +1023,14 @@ def top(cluster, watch, interval, as_json):
         now = time_lib.time()
         fmt = ('{:<20} {:>4} {:>5} {:<6} {:>8} {:>10} {:>9} {:>9} '
                '{:>7} {:>8} {:<7}')
-        click.echo(fmt.format('CLUSTER', 'JOB', 'RANK', 'PHASE',
-                              'STEP', 'STEP_TIME', 'TOK/S', 'DISPATCH%',
-                              'MEM_MB', 'HB_AGE', 'VERDICT'))
+        if show_trend:
+            fmt += ' {:<12}'
+        header = ['CLUSTER', 'JOB', 'RANK', 'PHASE', 'STEP',
+                  'STEP_TIME', 'TOK/S', 'DISPATCH%', 'MEM_MB',
+                  'HB_AGE', 'VERDICT']
+        if show_trend:
+            header.append('TREND')
+        click.echo(fmt.format(*header))
         for row in rows:
             step_time = (f'{row["step_time_ema_s"]:.3f}s'
                          if row['step_time_ema_s'] else '-')
@@ -835,12 +1043,15 @@ def top(cluster, watch, interval, as_json):
                     else '-')
             mem = (f'{row["host_mem_mb"]:.0f}'
                    if row['host_mem_mb'] else '-')
-            click.echo(fmt.format(
+            cells = [
                 row['cluster'][:20], str(row['job_id'] or '-'),
                 row['rank'], (row['phase'] or '-')[:6],
                 str(row['step'] if row['step'] is not None else '-'),
                 step_time, tps, disp, mem, _age_str(row['hb_age_s']),
-                row['verdict'] or '-'))
+                row['verdict'] or '-']
+            if show_trend:
+                cells.append(row.get('trend') or '-')
+            click.echo(fmt.format(*cells))
         # Per-gang summary: skew + goodput + HBM + data freshness.
         gangs = sorted({(r['cluster'], r['job_id']) for r in rows},
                        key=str)
@@ -1226,10 +1437,14 @@ def _slo_service_report(service: str) -> Optional[dict]:
 
 @cli.command(name='slo')
 @click.argument('service', required=False)
+@click.option('--trend', 'show_trend', is_flag=True, default=False,
+              help='Add TREND sparklines from the metrics history '
+                   'plane: burn rate per window and per-replica p99 '
+                   'TTFT.')
 @click.option('--json', 'as_json', is_flag=True, default=False,
               help='One JSON object per service (joinable with '
                    '`xsky events --json` on the breach events).')
-def slo_cmd(service, as_json):
+def slo_cmd(service, show_trend, as_json):
     """Serving SLO health: declared objectives vs observed latency,
     multi-window error-budget burn rates, and the breach verdict.
 
@@ -1298,14 +1513,24 @@ def slo_cmd(service, as_json):
                 click.echo(bfmt.format(
                     obj, *[_fmt_burn(report['burns'][w].get(obj))
                            for w in windows]))
+            if show_trend:
+                sparks = [
+                    _trend_spark('xsky_serve_slo_burn_rate',
+                                 {'service': report['service'],
+                                  'window': w}, width=12) or '-'
+                    for w in windows]
+                click.echo(bfmt.format('TREND', *sparks))
         if report['replicas']:
             rfmt = ('  {:<8} {:<22} {:>10} {:>10} {:>10} {:>8} '
                     '{:>7} {:>8}')
-            click.echo(rfmt.format(
-                'REPLICA', 'ENDPOINT', 'TTFT_P50', 'TTFT_P99',
-                'TPOT_P50', 'QUEUE', 'REQS', 'ERRORS'))
+            header = ['REPLICA', 'ENDPOINT', 'TTFT_P50', 'TTFT_P99',
+                      'TPOT_P50', 'QUEUE', 'REQS', 'ERRORS']
+            if show_trend:
+                rfmt += ' {:<12}'
+                header.append('TREND')
+            click.echo(rfmt.format(*header))
             for row in report['replicas']:
-                click.echo(rfmt.format(
+                cells = [
                     str(row['replica_id']),
                     (row['endpoint'] or '-')[:22],
                     _fmt_ms(row.get('ttft_p50_ms')),
@@ -1318,7 +1543,14 @@ def slo_cmd(service, as_json):
                         else '-'),
                     str(row.get('errors_total')
                         if row.get('errors_total') is not None
-                        else '-')))
+                        else '-')]
+                if show_trend:
+                    cells.append(_trend_spark(
+                        'xsky_serve_replica_ttft_p99_seconds',
+                        {'service': report['service'],
+                         'replica': row['replica_id']},
+                        width=12) or '-')
+                click.echo(rfmt.format(*cells))
 
 
 @cli.command()

@@ -208,6 +208,19 @@ class RemoteClient:
                           {'cluster_name': cluster_name,
                            'fleet': fleet, 'limit': limit})
 
+    def metrics_list(self, prefix=None, since=None, limit=200,
+                     offset=0):
+        return self._call('metrics.list',
+                          {'prefix': prefix, 'since': since,
+                           'limit': limit, 'offset': offset})
+
+    def metrics_query(self, name, labels=None, since=None, until=None,
+                      step=None, agg='avg', res=None):
+        return self._call('metrics.query',
+                          {'name': name, 'labels': labels,
+                           'since': since, 'until': until,
+                           'step': step, 'agg': agg, 'res': res})
+
     def profile_capture(self, cluster_name, job_id=None,
                         duration_s=1.0):
         out = self._call('profile.capture',

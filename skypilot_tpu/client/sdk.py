@@ -141,6 +141,31 @@ def goodput_report(cluster_name: Optional[str] = None,
                             fleet=fleet, limit=limit)
 
 
+def metrics_list(prefix: Optional[str] = None,
+                 since: Optional[float] = None,
+                 limit: int = 200,
+                 offset: int = 0) -> List[Dict[str, Any]]:
+    """Recorded metric series (names, label sets, point counts) from
+    the metrics history plane."""
+    return _local_or_remote('metrics_list', prefix=prefix, since=since,
+                            limit=limit, offset=offset)
+
+
+def metrics_query(name: str,
+                  labels: Optional[Dict[str, Any]] = None,
+                  since: Optional[float] = None,
+                  until: Optional[float] = None,
+                  step: Optional[float] = None,
+                  agg: str = 'avg',
+                  res: Optional[str] = None) -> Dict[str, Any]:
+    """Trend query over recorded metric points: bucketed avg/min/max/
+    sum/count/last, counter-aware rate, windowed histogram quantiles
+    (p50/p90/p95/p99)."""
+    return _local_or_remote('metrics_query', name, labels=labels,
+                            since=since, until=until, step=step,
+                            agg=agg, res=res)
+
+
 def endpoints(cluster_name: str,
               port: Optional[int] = None) -> Dict[int, str]:
     """port → URL for the cluster's opened ports."""

@@ -327,6 +327,41 @@ def goodput_report(cluster_name: Optional[str] = None,
     return {'kind': 'cluster', 'ledger': ledger}
 
 
+def metrics_list(prefix: Optional[str] = None,
+                 since: Optional[float] = None,
+                 limit: int = 200,
+                 offset: int = 0) -> List[Dict[str, Any]]:
+    """Recorded metric series (`xsky metrics list`): every distinct
+    (name, label set) the history recorder has sampled, with point
+    counts and freshness. Pure read over the bounded metric_points
+    table — works with no cluster up."""
+    from skypilot_tpu.utils import tracing
+    with tracing.span('metrics.query', kind='list', prefix=prefix):
+        return state.list_metric_series(prefix=prefix, since=since,
+                                        limit=limit, offset=offset)
+
+
+def metrics_query(name: str,
+                  labels: Optional[Dict[str, Any]] = None,
+                  since: Optional[float] = None,
+                  until: Optional[float] = None,
+                  step: Optional[float] = None,
+                  agg: str = 'avg',
+                  res: Optional[str] = None) -> Dict[str, Any]:
+    """Trend query over the metrics history plane (`xsky metrics
+    query`): bucketed aggregation with counter-aware rate() and
+    windowed histogram quantiles — the same metrics_history.series()
+    read API the autoscaler/LB arc consumes, with wire-shaped
+    metadata."""
+    from skypilot_tpu.utils import metrics_history
+    from skypilot_tpu.utils import tracing
+    with tracing.span('metrics.query', kind='query', metric=name,
+                      agg=agg):
+        return metrics_history.query(name, labels=labels, since=since,
+                                     until=until, step=step, agg=agg,
+                                     res=res)
+
+
 def watch_job_log(cluster_name: str, job_id: int,
                   offset: int = 0) -> Dict[str, Any]:
     """One incremental poll of a cluster job's run.log → {status,

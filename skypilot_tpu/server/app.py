@@ -233,7 +233,10 @@ class _Handler(BaseHTTPRequestHandler):
                                       if user else None)})
         elif parsed.path == '/metrics':
             # Prometheus text exposition (twin of sky/server/metrics.py).
-            data = metrics.render().encode()
+            # ?name=<prefix> filters to matching series AND skips the
+            # state-DB gauge recomputation behind everything else —
+            # scrapers sampling one plane don't pay for the fleet sweep.
+            data = metrics.render(params.get('name') or None).encode()
             metrics.observe_http('/metrics', 200)
             self.send_response(200)
             self.send_header('Content-Type',
@@ -655,6 +658,16 @@ def run(host: str = '127.0.0.1', port: int = 46580,
         reconciler.start_background_reconciler()
     except Exception as e:  # pylint: disable=broad-except
         logger.warning(f'Startup reconciliation failed: {e}')
+    # Metrics history recorder: samples the merged /metrics exposition
+    # into the bounded metric_points table on an interval and folds the
+    # journalled anomaly detectors (utils/metrics_history.py) — the
+    # trend substrate `xsky metrics`, `--trend` sparklines and the
+    # autoscaler/LB arc read.
+    try:
+        from skypilot_tpu.utils import metrics_history
+        metrics_history.start_background_recorder()
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning(f'Metrics recorder failed to start: {e}')
     scheme = 'https' if tls_certfile else 'http'
     logger.info(
         f'xsky API server listening on {scheme}://{host}:{bound_port}')

@@ -49,7 +49,7 @@ and gauges computed at scrape time from the state DB:
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from skypilot_tpu.utils import metrics as registry
 
@@ -462,50 +462,135 @@ def _render_fleet_gauges() -> List[str]:
     return lines
 
 
-def render() -> str:
+# Scrape-time gauge sections and the metric names each renders. A
+# `/metrics?name=<prefix>` scrape SKIPS whole sections with no
+# matching name — the point of the filter: an external scraper (or
+# the history recorder sampling a subset) pays only for the gauge
+# recomputation it reads, not the full live-cluster-filtered sweep.
+_GAUGE_SECTIONS = (
+    (_render_lease_gauges,
+     ('xsky_lease_expires_in_seconds', 'xsky_leases_live')),
+    (_render_workload_gauges,
+     ('xsky_workload_last_heartbeat_age_seconds',
+      'xsky_ckpt_freshness_age_seconds', 'xsky_goodput_ratio')),
+    (_render_profile_gauges,
+     ('xsky_dispatch_gap_ratio', 'xsky_hbm_bytes_in_use')),
+    (_render_goodput_counters,
+     ('xsky_goodput_loss_seconds_total',)),
+    (_render_serve_slo_gauges,
+     ('xsky_serve_slo_burn_rate',
+      'xsky_serve_replica_ttft_p99_seconds')),
+    (_render_fleet_gauges,
+     ('xsky_fleet_queue_depth', 'xsky_fleet_gangs_shrunk')),
+)
+
+
+def _section_matches(name_prefix: Optional[str], names) -> bool:
+    return any(registry.name_matches(n, name_prefix) for n in names)
+
+
+def _render_own_lines(name_prefix: Optional[str]) -> List[str]:
+    """The server's own HTTP/verb sections (kept outside the generic
+    registry), prefix-filtered per section."""
+    with _lock:
+        lines: List[str] = []
+        if _section_matches(name_prefix,
+                            ('xsky_http_requests_total',)):
+            lines += [
+                '# HELP xsky_http_requests_total HTTP requests by '
+                'route/code.',
+                '# TYPE xsky_http_requests_total counter',
+            ]
+            for (path, code), n in sorted(_http_requests.items()):
+                lines.append(
+                    'xsky_http_requests_total{path='
+                    f'"{_escape_label(path)}",code="{code}"}} {n}')
+        if _section_matches(name_prefix, ('xsky_requests_total',)):
+            lines += [
+                '# HELP xsky_requests_total Executor requests by '
+                'verb/status.',
+                '# TYPE xsky_requests_total counter',
+            ]
+            for (verb, status), n in sorted(_verb_requests.items()):
+                lines.append(
+                    f'xsky_requests_total{{verb="{_escape_label(verb)}",'
+                    f'status="{status}"}} {n}')
+        if _section_matches(name_prefix,
+                            ('xsky_request_duration_seconds',)):
+            lines += [
+                '# HELP xsky_request_duration_seconds Executor request '
+                'duration.',
+                '# TYPE xsky_request_duration_seconds histogram',
+            ]
+            for verb in sorted(_verb_duration_buckets):
+                for i, le in enumerate(_BUCKETS):
+                    lines.append(
+                        'xsky_request_duration_seconds_bucket{verb='
+                        f'"{verb}",le="{_fmt_le(le)}"}} '
+                        f'{_verb_duration_buckets[verb][i]}')
+                lines.append(
+                    f'xsky_request_duration_seconds_sum{{verb="{verb}"}} '
+                    f'{_verb_duration_sum[verb]:.6f}')
+                lines.append(
+                    'xsky_request_duration_seconds_count{verb='
+                    f'"{verb}"}} {_verb_duration_count[verb]}')
+        return lines
+
+
+def _filter_lines(lines: List[str],
+                  name_prefix: Optional[str]) -> List[str]:
+    """Per-SERIES filtering of already-rendered exposition lines: a
+    section render is skipped wholesale when nothing matches (that's
+    the recomputation win), but a matching section may still carry
+    sibling metrics the caller did not ask for — the contract is
+    'only matching series', so those are dropped here."""
+    if not name_prefix:
+        return lines
+    out = []
+    for line in lines:
+        if line.startswith('# '):
+            parts = line.split(' ', 3)
+            name = parts[2] if len(parts) > 2 else ''
+        else:
+            name = line.split('{', 1)[0].split(' ', 1)[0]
+        if registry.name_matches(name, name_prefix):
+            out.append(line)
+    return out
+
+
+def _render_gauge_lines(name_prefix: Optional[str]) -> List[str]:
+    lines: List[str] = []
+    for render_fn, names in _GAUGE_SECTIONS:
+        if _section_matches(name_prefix, names):
+            lines += _filter_lines(render_fn(), name_prefix)
+    return lines
+
+
+def render_scrape_time(name_prefix: Optional[str] = None) -> str:
+    """Everything on ``/metrics`` EXCEPT the generic registry: the
+    server's own HTTP/verb sections plus the scrape-time gauge
+    sections. The metrics-history recorder samples the registry
+    structurally (``utils.metrics.snapshot``) and parses only this —
+    text-rendering 5k registry series per tick just to reparse them
+    was the recorder's whole cost."""
+    lines = _render_own_lines(name_prefix) + \
+        _render_gauge_lines(name_prefix)
+    return '\n'.join(lines) + ('\n' if lines else '')
+
+
+def render(name_prefix: Optional[str] = None) -> str:
     """Text exposition format (version 0.0.4): the server's own
     HTTP/verb series, then the generic control-plane registry, then
     the scrape-time lease + workload + profile + serve-SLO + fleet
-    gauges."""
-    tail = registry.render_registry() + '\n'.join(
-        _render_lease_gauges() + _render_workload_gauges() +
-        _render_profile_gauges() + _render_goodput_counters() +
-        _render_serve_slo_gauges() + _render_fleet_gauges())
-    with _lock:
-        lines = [
-            '# HELP xsky_http_requests_total HTTP requests by route/code.',
-            '# TYPE xsky_http_requests_total counter',
-        ]
-        for (path, code), n in sorted(_http_requests.items()):
-            lines.append(
-                f'xsky_http_requests_total{{path="{_escape_label(path)}",'
-                f'code="{code}"}} {n}')
-        lines += [
-            '# HELP xsky_requests_total Executor requests by verb/status.',
-            '# TYPE xsky_requests_total counter',
-        ]
-        for (verb, status), n in sorted(_verb_requests.items()):
-            lines.append(
-                f'xsky_requests_total{{verb="{_escape_label(verb)}",'
-                f'status="{status}"}} {n}')
-        lines += [
-            '# HELP xsky_request_duration_seconds Executor request '
-            'duration.',
-            '# TYPE xsky_request_duration_seconds histogram',
-        ]
-        for verb in sorted(_verb_duration_buckets):
-            for i, le in enumerate(_BUCKETS):
-                lines.append(
-                    f'xsky_request_duration_seconds_bucket{{verb="{verb}"'
-                    f',le="{_fmt_le(le)}"}} '
-                    f'{_verb_duration_buckets[verb][i]}')
-            lines.append(
-                f'xsky_request_duration_seconds_sum{{verb="{verb}"}} '
-                f'{_verb_duration_sum[verb]:.6f}')
-            lines.append(
-                f'xsky_request_duration_seconds_count{{verb="{verb}"}} '
-                f'{_verb_duration_count[verb]}')
-        out = '\n'.join(lines) + '\n'
+    gauges. ``name_prefix`` (the ``/metrics?name=`` filter) restricts
+    output to matching series and skips the state-DB reads behind
+    non-matching gauge sections entirely."""
+    out = ''
+    own = _render_own_lines(name_prefix)
+    if own:
+        out += '\n'.join(own) + '\n'
+    tail = registry.render_registry(name_prefix) + \
+        '\n'.join(_render_gauge_lines(name_prefix))
     if tail.strip():
         out += tail if tail.endswith('\n') else tail + '\n'
     return out

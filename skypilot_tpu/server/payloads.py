@@ -113,6 +113,11 @@ _VERBS: Dict[str, Callable[[Dict[str, Any]],
                                   job_id=None, duration_s=1.0),
     'goodput.report': _core_verb('goodput_report', cluster_name=None,
                                  fleet=False, limit=1000),
+    'metrics.list': _core_verb('metrics_list', prefix=None, since=None,
+                               limit=200, offset=0),
+    'metrics.query': _core_verb('metrics_query', 'name', labels=None,
+                                since=None, until=None, step=None,
+                                agg='avg', res=None),
     'endpoints': _core_verb('endpoints', 'cluster_name', port=None),
     'cancel': _core_verb('cancel', 'cluster_name', job_ids=None,
                          all_jobs=False),

@@ -15,7 +15,7 @@ import pickle
 import sqlite3
 import threading
 import time
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 # Writer discipline: ONE write connection for the whole process, every
 # write serialized under _lock. Reads do NOT take this lock — each
@@ -324,6 +324,22 @@ def _create_tables(conn: sqlite3.Connection) -> None:
                                row_id);
         CREATE INDEX IF NOT EXISTS idx_spans_name
             ON spans (name, row_id);
+        CREATE TABLE IF NOT EXISTS metric_points (
+            row_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            ts REAL,
+            res TEXT,
+            name TEXT,
+            labels TEXT,
+            kind TEXT,
+            value REAL,
+            vmin REAL,
+            vmax REAL,
+            count INTEGER
+        );
+        CREATE INDEX IF NOT EXISTS idx_metric_points_series
+            ON metric_points (name, res, ts);
+        CREATE INDEX IF NOT EXISTS idx_metric_points_res_ts
+            ON metric_points (res, ts);
         CREATE TABLE IF NOT EXISTS fleet_decisions (
             row_id INTEGER PRIMARY KEY AUTOINCREMENT,
             ts REAL,
@@ -1662,6 +1678,239 @@ def get_fleet_decisions(kind: Optional[str] = None,
             'sku': sku,
             'score': score,
             'detail': parsed,
+        })
+    return out
+
+
+# ---- metrics history --------------------------------------------------------
+
+# Multi-resolution time series sampled from the metrics plane by the
+# recorder tick (skypilot_tpu/utils/metrics_history.py): raw points at
+# the record interval, rolled up into 1m and 10m avg/min/max rows.
+# Bounded like every observability table — a global row cap here plus
+# per-tier age retention applied by the recorder; `xsky metrics`, the
+# `--trend` sparklines and the anomaly detectors all read from here.
+
+# Newest rows kept (pruned lazily). At 15 s cadence a 100-series
+# deployment writes ~400 raw rows/min; 200k rows keep hours of raw plus
+# days of rollups, and the 5k-series bench cardinality still retains
+# the full raw window the detectors fold over.
+_MAX_METRIC_POINTS = 200000
+_metric_point_inserts = 0
+
+_METRIC_POINT_COLS = 'ts, res, name, labels, kind, value, vmin, vmax, count'
+
+
+def canonical_labels(labels: Optional[Dict[str, Any]]) -> str:
+    """ONE spelling per label set (sorted-key JSON): equality on the
+    labels column is series identity, so every writer and reader must
+    canonicalize the same way."""
+    if not labels:
+        return '{}'
+    return json.dumps({k: str(labels[k]) for k in sorted(labels)},
+                      sort_keys=True, separators=(',', ':'))
+
+
+def record_metric_points(rows: List[Dict[str, Any]],
+                         ts: Optional[float] = None,
+                         retention_s: Optional[Dict[str, float]] = None
+                         ) -> None:
+    """Persist one recorder tick's samples in ONE transaction. NEVER
+    raises — recording rides the API server's background tick (same
+    contract and batched-write pattern as record_workload_telemetry).
+    A torn batch is invisible to readers: WAL readers see either the
+    whole committed transaction or none of it.
+
+    ``retention_s`` maps resolution tier → max age; expired rows of
+    each tier are pruned in the same transaction (amortized), on top
+    of the global ``_MAX_METRIC_POINTS`` row cap."""
+    global _metric_point_inserts
+    if not rows:
+        return
+    ts = ts if ts is not None else time.time()
+    try:
+        conn = _get_conn()
+        values = [(r.get('ts', ts), r.get('res', 'raw'), r.get('name'),
+                   (r['labels'] if isinstance(r.get('labels'), str)
+                    else canonical_labels(r.get('labels'))),
+                   r.get('kind', 'gauge'), r.get('value'),
+                   r.get('vmin', r.get('value')),
+                   r.get('vmax', r.get('value')), r.get('count', 1))
+                  for r in rows]
+    except Exception:  # pylint: disable=broad-except
+        return
+    try:
+        with _lock:
+            conn.executemany(
+                f'INSERT INTO metric_points ({_METRIC_POINT_COLS}) '
+                'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)', values)
+            # Prune on the FIRST batch too (short-lived writers never
+            # reach an amortized gate — same rationale as spans).
+            _metric_point_inserts += len(rows)
+            if _metric_point_inserts == len(rows) or \
+                    _metric_point_inserts % 4096 < len(rows):
+                for res, max_age in (retention_s or {}).items():
+                    conn.execute(
+                        'DELETE FROM metric_points WHERE res=? AND '
+                        'ts < ?', (res, ts - float(max_age)))
+                conn.execute(
+                    'DELETE FROM metric_points WHERE row_id <= '
+                    '(SELECT MAX(row_id) FROM metric_points) - ?',
+                    (_MAX_METRIC_POINTS,))
+            conn.commit()
+    except Exception:  # pylint: disable=broad-except
+        try:
+            conn.rollback()
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+def rollup_metric_points(src_res: str, dst_res: str,
+                         start_ts: float, end_ts: float) -> bool:
+    """Fold one completed window of `src_res` points into ONE `dst_res`
+    row per series, in SQL (5k series must not round-trip through
+    Python on the recorder tick): gauges keep avg/min/max of the
+    window, counters keep the window-end cumulative value (MAX — the
+    downstream rate() handles genuine resets), both keep the folded
+    sample count. The row's ts is the WINDOW START. NEVER raises (same
+    contract as record_metric_points, whose tick this rides); returns
+    False on failure so the recorder can re-claim the window instead
+    of leaving a permanent hole in the rollup tiers."""
+    try:
+        conn = _get_conn()
+    except Exception:  # pylint: disable=broad-except
+        return False
+    try:
+        with _lock:
+            conn.execute(
+                f'INSERT INTO metric_points ({_METRIC_POINT_COLS}) '
+                'SELECT ?, ?, name, labels, kind, '
+                "CASE WHEN kind = 'gauge' THEN AVG(value) "
+                'ELSE MAX(value) END, '
+                'MIN(vmin), MAX(vmax), SUM(count) '
+                'FROM metric_points WHERE res=? AND ts >= ? AND ts < ? '
+                'GROUP BY name, labels, kind',
+                (start_ts, dst_res, src_res, start_ts, end_ts))
+            conn.commit()
+        return True
+    except Exception:  # pylint: disable=broad-except
+        try:
+            conn.rollback()
+        except Exception:  # pylint: disable=broad-except
+            pass
+        return False
+
+
+def metric_ts_range(res: str,
+                    name: Optional[str] = None
+                    ) -> Tuple[Optional[float], Optional[float]]:
+    """(oldest ts, newest ts) of one resolution tier — the recorder's
+    rollup cursor derives its next window from these."""
+    if name is None:
+        row = _read_one('SELECT MIN(ts), MAX(ts) FROM metric_points '
+                        'WHERE res=?', (res,))
+    else:
+        row = _read_one('SELECT MIN(ts), MAX(ts) FROM metric_points '
+                        'WHERE res=? AND name=?', (res, name))
+    return (row[0], row[1]) if row else (None, None)
+
+
+def get_metric_points(name: Optional[str] = None,
+                      labels: Optional[Dict[str, Any]] = None,
+                      res: Optional[str] = None,
+                      since: Optional[float] = None,
+                      until: Optional[float] = None,
+                      limit: int = 20000,
+                      offset: int = 0) -> List[Dict[str, Any]]:
+    """Metric points, oldest-first (the natural series order; ts is
+    indexed per tier so pages stay cheap). `labels` is an exact
+    series match when given (canonicalized here — callers pass plain
+    dicts); subset filtering over several series is the query layer's
+    job (metrics_history.series). Rows whose labels JSON is torn or
+    whose value is non-numeric are SKIPPED, never raised on — a
+    concurrent writer must not be able to poison a query."""
+    conds, args = [], []
+    if name is not None:
+        conds.append('name = ?')
+        args.append(name)
+    if labels is not None:
+        conds.append('labels = ?')
+        args.append(canonical_labels(labels))
+    if res is not None:
+        conds.append('res = ?')
+        args.append(res)
+    if since is not None:
+        conds.append('ts >= ?')
+        args.append(float(since))
+    if until is not None:
+        conds.append('ts < ?')
+        args.append(float(until))
+    query = (f'SELECT {_METRIC_POINT_COLS} FROM metric_points')
+    if conds:
+        query += ' WHERE ' + ' AND '.join(conds)
+    query += ' ORDER BY ts, row_id' + _page_sql(int(limit), offset)
+    out = []
+    for (ts, row_res, row_name, labels_json, kind, value, vmin, vmax,
+         count) in _read(query, args):
+        try:
+            parsed = json.loads(labels_json) if labels_json else {}
+            if not isinstance(parsed, dict):
+                continue
+        except ValueError:
+            continue   # torn writer: skip, never poison the query
+        if value is None or not isinstance(value, (int, float)):
+            continue
+        out.append({
+            'ts': ts,
+            'res': row_res,
+            'name': row_name,
+            'labels': parsed,
+            'kind': kind,
+            'value': float(value),
+            'vmin': vmin,
+            'vmax': vmax,
+            'count': count,
+        })
+    return out
+
+
+def list_metric_series(prefix: Optional[str] = None,
+                       since: Optional[float] = None,
+                       limit: int = 500,
+                       offset: int = 0) -> List[Dict[str, Any]]:
+    """Distinct recorded series (name + label set), with point counts
+    and the newest sample — `xsky metrics list`. Grouped over the raw
+    tier only (every series has raw points; rollups would double-
+    count)."""
+    conds, args = ["res = 'raw'"], []
+    if prefix:
+        escaped = (prefix.replace('\\', '\\\\').replace('%', '\\%')
+                   .replace('_', '\\_'))
+        conds.append("name LIKE ? ESCAPE '\\'")
+        args.append(escaped + '%')
+    if since is not None:
+        conds.append('ts >= ?')
+        args.append(float(since))
+    rows = _read(
+        'SELECT name, labels, kind, COUNT(*), MIN(ts), MAX(ts) '
+        'FROM metric_points WHERE ' + ' AND '.join(conds) +
+        ' GROUP BY name, labels ORDER BY name, labels' +
+        _page_sql(int(limit), offset), args)
+    out = []
+    for name, labels_json, kind, count, oldest, newest in rows:
+        try:
+            parsed = json.loads(labels_json) if labels_json else {}
+            if not isinstance(parsed, dict):
+                continue
+        except ValueError:
+            continue
+        out.append({
+            'name': name,
+            'labels': parsed,
+            'kind': kind,
+            'points': count,
+            'oldest_ts': oldest,
+            'newest_ts': newest,
         })
     return out
 

@@ -14,6 +14,24 @@ from typing import Any, Dict, Optional, Union
 
 _CLUSTER_NAME_RE = re.compile(r'^[a-z]([a-z0-9-]*[a-z0-9])?$')
 
+# Relative-duration suffixes. ONE parser for every surface that takes
+# a human duration (`xsky events --since 5m`, `xsky metrics query
+# --since 1h --step 1m`) — two parsers with different unit tables is
+# exactly the drift the env/names registries exist to prevent.
+DURATION_UNITS = {'s': 1.0, 'm': 60.0, 'h': 3600.0, 'd': 86400.0}
+
+
+def parse_duration_s(value: Union[str, int, float]) -> float:
+    """Duration → seconds: bare numbers are seconds ('90', 90, 1.5),
+    a trailing unit scales ('30s', '15m', '2h', '1d'; case-
+    insensitive). Raises ValueError on anything else."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    v = str(value).strip()
+    if v and v[-1].lower() in DURATION_UNITS:
+        return float(v[:-1]) * DURATION_UNITS[v[-1].lower()]
+    return float(v)
+
 _run_id: Optional[str] = None
 
 

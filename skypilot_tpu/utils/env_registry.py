@@ -116,6 +116,26 @@ _VARS = [
            'worker subprocesses (set by env_for_child)'),
     EnvVar('XSKY_TIMELINE_FILE', UNSET,
            'Path enabling the Chrome-trace timeline recorder'),
+    # ---- metrics history ---------------------------------------------------
+    EnvVar('XSKY_METRICS_RECORD_INTERVAL_S', '15',
+           'Metrics-history recorder tick: how often the /metrics '
+           'exposition is sampled into metric_points'),
+    EnvVar('XSKY_METRICS_RAW_RETENTION_S', '7200',
+           'Raw-tier retention of recorded metric points (one point '
+           'per series per tick)'),
+    EnvVar('XSKY_METRICS_1M_RETENTION_S', '86400',
+           'Retention of the per-minute avg/min/max rollup tier'),
+    EnvVar('XSKY_METRICS_10M_RETENTION_S', '604800',
+           'Retention of the per-10-minute rollup tier'),
+    EnvVar('XSKY_METRICS_MAX_SERIES', '20000',
+           'Cardinality clamp per recorder tick: series beyond this '
+           'are dropped (keep-first, stable name order)'),
+    EnvVar('XSKY_METRICS_ANOMALY_FACTOR', '2',
+           'Step-time-regression detector: recent p50 past this '
+           'multiple of the trailing baseline journals an anomaly'),
+    EnvVar('XSKY_METRICS_ANOMALY_MIN_POINTS', '4',
+           'Recorder samples a detector needs before it may fire '
+           '(and the recent-window width, in samples)'),
     EnvVar('XSKY_DEBUG', '0',
            'Set to 1 for debug-level logging'),
     EnvVar('XSKY_MINIMIZE_LOGGING', '0',

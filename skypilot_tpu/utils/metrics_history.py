@@ -1,0 +1,867 @@
+"""Metrics history plane: embedded multi-resolution time-series store,
+trend queries, and journalled anomaly detectors.
+
+Every observability plane so far records only the *latest* state —
+``/metrics`` is scrape-time, ``xsky top``/``xsky slo``/``xsky goodput``
+render a snapshot. This module retains how the gauges MOVE: a recorder
+tick (``XSKY_METRICS_RECORD_INTERVAL_S``, riding the API server's
+background-tick pattern like the reconciler) samples the whole merged
+``/metrics`` exposition — the generic registry plus the scrape-time
+gauge set (lease ages, heartbeat ages, dispatch-gap ratios, burn
+rates, fleet queue depth, checkpoint freshness, goodput loss
+counters) — into the bounded ``metric_points`` state table with
+multi-resolution downsampling:
+
+  * **raw**  — one point per series per tick, kept
+    ``XSKY_METRICS_RAW_RETENTION_S`` (default 2 h);
+  * **1m**   — per-minute avg/min/max (gauges) or window-end value
+    (counters), kept ``XSKY_METRICS_1M_RETENTION_S`` (default 1 d);
+  * **10m**  — the same fold over 1m rows, kept
+    ``XSKY_METRICS_10M_RETENTION_S`` (default 7 d).
+
+On top of the table:
+
+  * :func:`series` — **the stable read API** for trend consumers (the
+    telemetry-routed LB and burn-rate autoscaler arc reads exactly
+    this): bucketed aggregation with counter-aware ``rate()`` and
+    windowed quantiles over histogram series.
+  * ``xsky metrics list/query`` (cli → sdk → remote_client → payloads
+    → core) and opt-in sparkline TREND columns on ``xsky top --trend``
+    / ``xsky slo --trend``.
+  * :func:`detect_anomalies` — journalled detectors folded on the
+    recorder tick (step-time regression vs trailing baseline,
+    dispatch-gap upward trend, heartbeat-age drift, burn-rate
+    acceleration); state *transitions* land in the recovery journal as
+    ``metrics.anomaly`` / ``metrics.anomaly_cleared``, trace-linked
+    through the ``metrics.record`` span, with a ``metrics.detector``
+    chaos point forcing each arm.
+
+Recording follows the PR 5/9/11 recording-plane contract: batched
+never-raise writes under a span, bounded tables, and torn/concurrent
+reads can never poison a query (readers skip malformed rows).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+# ---- knobs ------------------------------------------------------------------
+
+ENV_INTERVAL = 'XSKY_METRICS_RECORD_INTERVAL_S'
+ENV_RAW_RETENTION = 'XSKY_METRICS_RAW_RETENTION_S'
+ENV_1M_RETENTION = 'XSKY_METRICS_1M_RETENTION_S'
+ENV_10M_RETENTION = 'XSKY_METRICS_10M_RETENTION_S'
+ENV_MAX_SERIES = 'XSKY_METRICS_MAX_SERIES'
+ENV_ANOMALY_FACTOR = 'XSKY_METRICS_ANOMALY_FACTOR'
+ENV_MIN_POINTS = 'XSKY_METRICS_ANOMALY_MIN_POINTS'
+
+_DEFAULT_INTERVAL_S = 15.0
+_DEFAULT_RETENTION = {'raw': 7200.0, '1m': 86400.0, '10m': 604800.0}
+_DEFAULT_MAX_SERIES = 20000
+_DEFAULT_ANOMALY_FACTOR = 2.0
+_DEFAULT_MIN_POINTS = 4
+
+# (source tier, destination tier, window width seconds), in fold order.
+ROLLUPS: Tuple[Tuple[str, str, float], ...] = (
+    ('raw', '1m', 60.0),
+    ('1m', '10m', 600.0),
+)
+RESOLUTIONS = ('raw', '1m', '10m')
+
+ANOMALY_EVENT = 'metrics.anomaly'
+ANOMALY_CLEARED_EVENT = 'metrics.anomaly_cleared'
+DETECTOR_CHAOS_POINT = 'metrics.detector'
+
+DETECTORS = ('step_time_regression', 'dispatch_gap_trend',
+             'heartbeat_age_drift', 'burn_rate_accel')
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def interval_s() -> float:
+    return max(_env_float(ENV_INTERVAL, _DEFAULT_INTERVAL_S), 0.1)
+
+
+def retention_s() -> Dict[str, float]:
+    return {
+        'raw': _env_float(ENV_RAW_RETENTION, _DEFAULT_RETENTION['raw']),
+        '1m': _env_float(ENV_1M_RETENTION, _DEFAULT_RETENTION['1m']),
+        '10m': _env_float(ENV_10M_RETENTION,
+                          _DEFAULT_RETENTION['10m']),
+    }
+
+
+def _max_series() -> int:
+    return max(int(_env_float(ENV_MAX_SERIES, _DEFAULT_MAX_SERIES)), 1)
+
+
+def _anomaly_factor() -> float:
+    return max(_env_float(ENV_ANOMALY_FACTOR, _DEFAULT_ANOMALY_FACTOR),
+               1.01)
+
+
+def _min_points() -> int:
+    return max(int(_env_float(ENV_MIN_POINTS, _DEFAULT_MIN_POINTS)), 2)
+
+
+# Rollup cursor per destination tier (next window start). Recovered
+# from the table's MAX(ts) on first use, so a restarted server never
+# re-folds a window it already wrote.
+_rollup_cursor: Dict[str, float] = {}
+_rollup_lock = threading.Lock()
+
+# Active anomalies: (detector, series ident) -> since ts. In-process
+# like the SLO monitor's breach latches — the recorder runs on one
+# server, and a restart simply re-journals a still-true anomaly.
+_active_anomalies: Dict[Tuple[str, str], float] = {}
+_anomaly_lock = threading.Lock()
+
+_recorder_thread: Optional[threading.Thread] = None
+_recorder_lock = threading.Lock()
+
+
+def reset_for_test() -> None:
+    with _rollup_lock:
+        _rollup_cursor.clear()
+    with _anomaly_lock:
+        _active_anomalies.clear()
+
+
+# ---- sampling ---------------------------------------------------------------
+
+
+# Canonical-labels cache for registry snapshot tuples: series are
+# stable across ticks, so the JSON canonicalization (measured ~60 ms
+# per 15k series) is paid once per series lifetime. Bounded by a
+# clear-on-overflow guard; single-writer (the recorder tick).
+_canon_cache: Dict[Tuple, str] = {}
+_canon_cache_lock = threading.Lock()
+
+
+def _canon_cached(key: Tuple) -> str:
+    from skypilot_tpu import state
+    with _canon_cache_lock:
+        cached = _canon_cache.get(key)
+    if cached is None:
+        cached = state.canonical_labels(dict(key))
+        with _canon_cache_lock:
+            if len(_canon_cache) > 65536:
+                _canon_cache.clear()
+            _canon_cache[key] = cached
+    return cached
+
+
+def sample_points(now: Optional[float] = None,
+                  text: Optional[str] = None) -> List[Dict[str, Any]]:
+    """One sample of the whole metrics plane → point dicts for
+    :func:`record_points`.
+
+    Two sources, matching exactly what a ``/metrics`` scrape sees:
+
+      * the generic registry, sampled STRUCTURALLY
+        (``utils.metrics.snapshot`` — the text render+reparse round
+        trip was the whole recorder cost at 5k series); histograms
+        expand to cumulative ``_bucket``/``_sum``/``_count`` counter
+        series so windowed quantiles fold back out of bucket deltas;
+      * the scrape-time gauge set + server HTTP/verb sections, parsed
+        from ``server/metrics.render_scrape_time`` with ``# TYPE``
+        comments giving each series its kind.
+
+    Cardinality is clamped to ``XSKY_METRICS_MAX_SERIES`` per tick
+    (keep-first, stable name order — a runaway label explosion must
+    not eat the state DB). `text` substitutes the whole exposition in
+    tests (everything then goes through the parse path).
+    """
+    now = now if now is not None else time.time()
+    points: List[Dict[str, Any]] = []
+    registry_points: List[Dict[str, Any]] = []
+    if text is None:
+        from skypilot_tpu.server import metrics as server_metrics
+        from skypilot_tpu.utils import metrics as metrics_lib
+        for name, kind, key, value in metrics_lib.snapshot():
+            registry_points.append(
+                {'ts': now, 'res': 'raw', 'name': name,
+                 'labels': _canon_cached(key), 'kind': kind,
+                 'value': value})
+        text = server_metrics.render_scrape_time()
+    kinds: Dict[str, str] = {}
+    for line in text.splitlines():
+        if line.startswith('# TYPE '):
+            parts = line.split()
+            if len(parts) >= 4:
+                kinds[parts[2]] = parts[3]
+    from skypilot_tpu.serve import slo as slo_lib
+    samples = slo_lib.parse_prometheus_text(text)
+    for name in sorted(samples):
+        kind = kinds.get(name, 'gauge')
+        if kind == 'histogram':
+            # Only _bucket/_sum/_count children carry samples; a bare
+            # histogram name in a sample line would be malformed.
+            continue
+        for suffix in ('_bucket', '_sum', '_count'):
+            if name.endswith(suffix) and \
+                    kinds.get(name[:-len(suffix)]) == 'histogram':
+                kind = 'counter'   # cumulative histogram component
+                break
+        else:
+            if kind not in ('counter', 'gauge'):
+                kind = 'gauge'
+        for labels, value in samples[name]:
+            points.append({'ts': now, 'res': 'raw', 'name': name,
+                           'labels': labels, 'kind': kind,
+                           'value': value})
+    # Clamp order matters: the scrape-time gauge plane (heartbeat
+    # ages, burn rates, dispatch gaps — the detectors' and --trend's
+    # inputs) is bounded by fleet size BY CONSTRUCTION, so it always
+    # survives; the registry (where a label explosion would actually
+    # happen) absorbs the truncation, keep-first in stable name order.
+    limit = _max_series()
+    if len(points) > limit:
+        points = points[:limit]
+    if len(points) < limit:
+        points += registry_points[:limit - len(points)]
+    return points
+
+
+def record_points(points: List[Dict[str, Any]],
+                  ts: Optional[float] = None) -> None:
+    """Persist one tick's samples and advance the downsampling fold.
+    NEVER raises — this rides the API server's background tick (the
+    PR 5/9/11 recording-plane contract); a state-DB hiccup costs the
+    tick, not the server."""
+    try:
+        from skypilot_tpu import state
+        from skypilot_tpu.utils import metrics as metrics_lib
+        now = ts if ts is not None else time.time()
+        state.record_metric_points(points, ts=now,
+                                   retention_s=retention_s())
+        _advance_rollups(now)
+        if points:
+            metrics_lib.inc_counter(
+                'xsky_metrics_points_recorded_total',
+                'Metric points recorded by the history recorder.',
+                float(len(points)))
+    except Exception:  # pylint: disable=broad-except
+        pass
+
+
+def _advance_rollups(now: float) -> None:
+    """Fold every COMPLETED window since the per-tier cursor (bounded
+    per tick so an idle gap can't wedge one tick in catch-up). The
+    cursor lock only CLAIMS windows — it advances the cursors and is
+    released before any DB work, so a slow fold never blocks a
+    concurrent recorder behind a module lock; the claimer folds its
+    windows exclusively."""
+    from skypilot_tpu import state
+    # One claim+fold pass PER LEVEL, in fold order: the 1m rows this
+    # tick writes must be committed before the 10m level derives its
+    # cursor or folds, or a fresh DB would stay one tick behind
+    # forever. Cursor recovery reads (table MIN/MAX) and the folds
+    # themselves happen OUTSIDE the lock — the optimistic `not in`
+    # check may race a concurrent first tick into a redundant read,
+    # but no DB work ever runs under the cursor lock; the lock only
+    # CLAIMS windows (advancing the cursors), so the claimer folds
+    # its windows exclusively and a slow fold never blocks a
+    # concurrent recorder.
+    for src, dst, width in ROLLUPS:
+        recovered: Optional[float] = None
+        if dst not in _rollup_cursor:
+            _, newest_dst = state.metric_ts_range(dst)
+            if newest_dst is not None:
+                recovered = newest_dst + width
+            else:
+                oldest_src, _ = state.metric_ts_range(src)
+                if oldest_src is not None:
+                    recovered = oldest_src // width * width
+        claimed: List[float] = []
+        with _rollup_lock:
+            cursor = _rollup_cursor.get(dst)
+            if cursor is None:
+                cursor = recovered
+                if cursor is None:
+                    continue
+            while cursor + width <= now and len(claimed) < 64:
+                claimed.append(cursor)
+                cursor += width
+            _rollup_cursor[dst] = cursor
+        for start in claimed:
+            if not state.rollup_metric_points(src, dst, start,
+                                              start + width):
+                # A failed fold must be RETRIED, not skipped: roll the
+                # cursor back to this window (min() in case another
+                # claimer already rolled it back further) and stop —
+                # once the src tier's retention prunes the window, a
+                # skipped fold would be a permanent hole in the 1d/7d
+                # tiers. Our remaining claims are exclusively ours and
+                # unfolded, so the re-claim can't double-fold.
+                with _rollup_lock:
+                    _rollup_cursor[dst] = min(
+                        _rollup_cursor.get(dst, start), start)
+                break
+
+
+def record_tick(now: Optional[float] = None) -> Dict[str, Any]:
+    """One recorder tick: sample → record → downsample → detect, all
+    under a ``metrics.record`` span (anomaly journal rows cross-link
+    to it). This is the function the background recorder and the bench
+    drive."""
+    from skypilot_tpu.utils import tracing
+    now = now if now is not None else time.time()
+    with tracing.span('metrics.record') as span:
+        points = sample_points(now=now)
+        record_points(points, ts=now)
+        anomalies = detect_anomalies(now=now)
+        span.set(points=len(points), anomalies=len(anomalies))
+    return {'points': len(points), 'anomalies': anomalies}
+
+
+def start_background_recorder() -> None:
+    """Periodic recorder tick (API-server lifetime; idempotent start —
+    the reconciler's background-tick pattern)."""
+    global _recorder_thread
+    with _recorder_lock:
+        if _recorder_thread is not None and _recorder_thread.is_alive():
+            return
+
+        def _loop() -> None:
+            from skypilot_tpu.utils import resilience
+            while True:
+                resilience.sleep(interval_s())
+                try:
+                    record_tick()
+                except Exception:  # pylint: disable=broad-except
+                    pass   # never-raise discipline: next tick retries
+
+        _recorder_thread = threading.Thread(
+            target=_loop, name='xsky-metrics-recorder', daemon=True)
+        _recorder_thread.start()
+
+
+# ---- trend queries (the stable read API) ------------------------------------
+
+AGGS = ('avg', 'min', 'max', 'sum', 'count', 'last', 'rate',
+        'p50', 'p90', 'p95', 'p99')
+
+_QUANTILES = {'p50': 0.5, 'p90': 0.9, 'p95': 0.95, 'p99': 0.99}
+
+
+def _pick_res(span_s: float) -> str:
+    ret = retention_s()
+    if span_s <= ret['raw']:
+        return 'raw'
+    if span_s <= ret['1m']:
+        return '1m'
+    return '10m'
+
+
+def _native_step(res: str) -> float:
+    return {'raw': interval_s(), '1m': 60.0, '10m': 600.0}[res]
+
+
+def _labels_match(row_labels: Dict[str, str],
+                  wanted: Optional[Dict[str, Any]]) -> bool:
+    if not wanted:
+        return True
+    return all(row_labels.get(k) == str(v) for k, v in wanted.items())
+
+
+def series(name: str,
+           labels: Optional[Dict[str, Any]] = None,
+           since: Optional[float] = None,
+           until: Optional[float] = None,
+           step: Optional[float] = None,
+           agg: str = 'avg',
+           res: Optional[str] = None
+           ) -> List[Tuple[float, Optional[float]]]:
+    """THE stable read API of the metrics history plane (the
+    autoscaler/telemetry-routed-LB arc consumes exactly this; see
+    docs/observability.md "Metrics history & anomaly detection").
+
+    Returns ``[(bucket_start_ts, value-or-None), ...]`` — one bucket
+    per `step` seconds over ``[since, until)`` (defaults: the last
+    hour, bucketed at the chosen tier's native step), empty buckets
+    as ``None`` so consumers see gaps instead of interpolation.
+
+    * `labels` is a SUBSET match (``{'cluster': 'a'}`` folds every
+      rank of cluster ``a`` into the buckets; pass the full label set
+      for one series).
+    * ``agg='rate'`` is counter-aware per-second rate: a value drop is
+      treated as a counter reset (the restart of an incarnation), not
+      a negative rate.
+    * ``agg='p50'|'p90'|'p95'|'p99'`` computes windowed quantiles over
+      a histogram's ``_bucket`` series (cumulative→windowed bucket
+      deltas per step, the promql estimator).
+    * `res` picks the tier explicitly; by default the finest tier
+      whose retention covers `since`.
+
+    NEVER raises: an unreadable DB or malformed arguments return
+    ``[]`` — trend consumers sit on control loops.
+    """
+    try:
+        return _series(name, labels, since, until, step, agg, res)
+    except Exception:  # pylint: disable=broad-except
+        return []
+
+
+def _series(name: str, labels: Optional[Dict[str, Any]],
+            since: Optional[float], until: Optional[float],
+            step: Optional[float], agg: str, res: Optional[str]
+            ) -> List[Tuple[float, Optional[float]]]:
+    now = time.time()
+    until = float(until) if until is not None else now
+    since = float(since) if since is not None else until - 3600.0
+    if until <= since:
+        return []
+    res = res or _pick_res(now - since)
+    step = float(step) if step else _native_step(res)
+    step = max(step, 0.001)
+    if agg in _QUANTILES:
+        return _quantile_series(name, labels, since, until, step,
+                                _QUANTILES[agg], res)
+    if agg == 'rate':
+        return _rate_series(name, labels, since, until, step, res)
+    return _bucketed(name, labels, since, until, step, agg, res)
+
+
+def _fetch(name: str, labels: Optional[Dict[str, Any]], since: float,
+           until: float, res: str) -> List[Dict[str, Any]]:
+    from skypilot_tpu import state
+    # Page through the window: the read API's default row limit would
+    # otherwise silently DROP the newest points of a wide window (a
+    # 5k-series tick is 5k raw rows — four ticks hit a 20k cap), and
+    # the newest points are exactly what detectors and --trend read.
+    # Bounded by the table's own retention cap, so this terminates.
+    page = 20000
+    rows: List[Dict[str, Any]] = []
+    offset = 0
+    while True:
+        batch = state.get_metric_points(name=name, res=res,
+                                        since=since, until=until,
+                                        limit=page, offset=offset)
+        rows.extend(batch)
+        if len(batch) < page:
+            break
+        offset += page
+    if labels:
+        rows = [r for r in rows if _labels_match(r['labels'], labels)]
+    return rows
+
+
+def _bucket_index(ts: float, since: float, step: float) -> int:
+    return int((ts - since) // step)
+
+
+def _bucket_starts(since: float, until: float,
+                   step: float) -> List[float]:
+    n = max(int((until - since + step - 1e-9) // step), 1)
+    return [since + i * step for i in range(n)]
+
+
+def _bucketed(name: str, labels: Optional[Dict[str, Any]],
+              since: float, until: float, step: float, agg: str,
+              res: str) -> List[Tuple[float, Optional[float]]]:
+    if agg not in ('avg', 'min', 'max', 'sum', 'count', 'last'):
+        raise ValueError(f'unknown agg {agg!r} (one of {AGGS})')
+    rows = _fetch(name, labels, since, until, res)
+    starts = _bucket_starts(since, until, step)
+    cells: List[List[Dict[str, Any]]] = [[] for _ in starts]
+    for row in rows:
+        idx = _bucket_index(row['ts'], since, step)
+        if 0 <= idx < len(cells):
+            cells[idx].append(row)
+    out: List[Tuple[float, Optional[float]]] = []
+    for start, cell in zip(starts, cells):
+        if not cell:
+            out.append((start, None))
+            continue
+        values = [r['value'] for r in cell]
+        if agg == 'avg':
+            value: Optional[float] = sum(values) / len(values)
+        elif agg == 'min':
+            value = min((r['vmin'] if r['vmin'] is not None
+                         else r['value']) for r in cell)
+        elif agg == 'max':
+            value = max((r['vmax'] if r['vmax'] is not None
+                         else r['value']) for r in cell)
+        elif agg == 'sum':
+            value = sum(values)
+        elif agg == 'count':
+            value = float(sum(int(r['count'] or 1) for r in cell))
+        else:   # last
+            value = values[-1]
+        out.append((start, value))
+    return out
+
+
+def counter_delta(prev: Optional[float], cur: float) -> float:
+    """Counter-aware increase: a drop means the counter reset (a new
+    incarnation started from zero), so the whole current value is the
+    increase since the reset."""
+    if prev is None or cur < prev:
+        return max(cur, 0.0)
+    return cur - prev
+
+
+def _rate_series(name: str, labels: Optional[Dict[str, Any]],
+                 since: float, until: float, step: float, res: str
+                 ) -> List[Tuple[float, Optional[float]]]:
+    from skypilot_tpu import state
+    # One extra step of lookback supplies each series' baseline value,
+    # so the first requested bucket measures an increase, not the
+    # counter's whole cumulative history.
+    rows = _fetch(name, labels, since - step, until, res)
+    # rate() is per SERIES, summed across matching series — mixing two
+    # ranks' cumulative counters into one delta would see phantom
+    # resets on every interleave.
+    by_series: Dict[str, List[Dict[str, Any]]] = {}
+    for row in rows:
+        by_series.setdefault(
+            state.canonical_labels(row['labels']), []).append(row)
+    starts = _bucket_starts(since, until, step)
+    # Each series' bucket rate is its delta sum over the seconds those
+    # deltas actually COVER (promql semantics) — dividing by the
+    # bucket STEP would inflate the rate whenever samples are spaced
+    # wider than the step (a coarser tier, a missed tick). Covered
+    # time is per SERIES: summing it across series would understate a
+    # multi-series fold (two ranks at 1/s each must read 2/s).
+    totals: List[Optional[float]] = [None] * len(starts)
+    for srows in by_series.values():
+        sdelta = [0.0] * len(starts)
+        scovered = [0.0] * len(starts)
+        prev: Optional[Tuple[float, float]] = None   # (ts, value)
+        for row in srows:   # oldest-first (get_metric_points order)
+            cur = (row['ts'], row['value'])
+            if prev is not None and cur[0] > prev[0]:
+                idx = _bucket_index(cur[0], since, step)
+                if 0 <= idx < len(starts):
+                    sdelta[idx] += counter_delta(prev[1], cur[1])
+                    scovered[idx] += cur[0] - prev[0]
+            prev = cur
+        for i in range(len(starts)):
+            if scovered[i] > 0:
+                totals[i] = ((totals[i] or 0.0) +
+                             sdelta[i] / scovered[i])
+    return list(zip(starts, totals))
+
+
+def _quantile_series(name: str, labels: Optional[Dict[str, Any]],
+                     since: float, until: float, step: float,
+                     q: float, res: str
+                     ) -> List[Tuple[float, Optional[float]]]:
+    from skypilot_tpu import state
+    from skypilot_tpu.serve import slo as slo_lib
+    # Lookback supplies each (series, le) counter's baseline so the
+    # first window measures an increase, not cumulative history.
+    rows = _fetch(f'{name}_bucket', labels, since - step, until, res)
+    starts = _bucket_starts(since, until, step)
+    # Per (series-minus-le, le): walk cumulative values oldest-first,
+    # folding counter-aware increases into the landing window. The
+    # deltas stay cumulative-in-le (cum_t2[le] - cum_t1[le] preserves
+    # the <= le nesting), so each window's deltas ARE its cumulative
+    # histogram — merging across matching series like slo.merge_buckets
+    # gives the fleet quantile for subset-label queries.
+    prev_cum: Dict[Tuple[str, float], float] = {}
+    windows: List[Dict[float, float]] = [{} for _ in starts]
+    for row in rows:
+        le_text = row['labels'].get('le')
+        if le_text is None:
+            continue
+        try:
+            le = (float('inf') if le_text in ('+Inf', 'inf')
+                  else float(le_text))
+        except ValueError:
+            continue
+        rest = {k: v for k, v in row['labels'].items() if k != 'le'}
+        key = (state.canonical_labels(rest), le)
+        cur = row['value']
+        prev = prev_cum.get(key)
+        prev_cum[key] = cur
+        if prev is None:
+            continue   # baseline sample
+        idx = _bucket_index(row['ts'], since, step)
+        if 0 <= idx < len(windows):
+            window = windows[idx]
+            window[le] = window.get(le, 0.0) + counter_delta(prev, cur)
+    out: List[Tuple[float, Optional[float]]] = []
+    for start, window in zip(starts, windows):
+        buckets = sorted(window.items())
+        if not buckets or buckets[-1][1] <= 0:
+            out.append((start, None))
+            continue
+        out.append((start, slo_lib.quantile_from_buckets(buckets, q)))
+    return out
+
+
+def query(name: str,
+          labels: Optional[Dict[str, Any]] = None,
+          since: Optional[float] = None,
+          until: Optional[float] = None,
+          step: Optional[float] = None,
+          agg: str = 'avg',
+          res: Optional[str] = None) -> Dict[str, Any]:
+    """Validating wrapper over :func:`series` for the ``metrics.query``
+    verb — raises ``ValueError`` on a bad agg/res so the API returns a
+    usable error instead of an empty series."""
+    if agg not in AGGS:
+        raise ValueError(f'unknown agg {agg!r} (one of {AGGS})')
+    if res is not None and res not in RESOLUTIONS:
+        raise ValueError(
+            f'unknown resolution {res!r} (one of {RESOLUTIONS})')
+    now = time.time()
+    until_v = float(until) if until is not None else now
+    since_v = float(since) if since is not None else until_v - 3600.0
+    res_v = res or _pick_res(now - since_v)
+    step_v = float(step) if step else _native_step(res_v)
+    points = series(name, labels=labels, since=since_v, until=until_v,
+                    step=step_v, agg=agg, res=res_v)
+    return {
+        'name': name,
+        'labels': labels or {},
+        'since': since_v,
+        'until': until_v,
+        'step': step_v,
+        'agg': agg,
+        'res': res_v,
+        'points': [[ts, value] for ts, value in points],
+    }
+
+
+def sparkline(values: List[Optional[float]], width: int = 16) -> str:
+    """Unicode sparkline over a value list (None = gap, rendered as a
+    space); the shared renderer behind ``xsky metrics query`` and the
+    ``--trend`` columns."""
+    glyphs = '▁▂▃▄▅▆▇█'
+    if not values:
+        return ''
+    if len(values) > width:
+        # Keep the newest `width` buckets: trends read right-to-now.
+        values = values[-width:]
+    present = [v for v in values if v is not None]
+    if not present:
+        return ' ' * len(values)
+    lo, hi = min(present), max(present)
+    span = hi - lo
+    out = []
+    for v in values:
+        if v is None:
+            out.append(' ')
+        elif span <= 0:
+            out.append(glyphs[3])
+        else:
+            out.append(glyphs[min(int((v - lo) / span * 8),
+                                  len(glyphs) - 1)])
+    return ''.join(out)
+
+
+# ---- anomaly detectors ------------------------------------------------------
+
+
+def detect_anomalies(now: Optional[float] = None
+                     ) -> List[Dict[str, Any]]:
+    """Run every detector over the freshly recorded raw tier and
+    journal state TRANSITIONS (``metrics.anomaly`` on entry,
+    ``metrics.anomaly_cleared`` with the anomaly's duration on exit —
+    the SLO monitor's breach/recovered pattern). Returns the list of
+    currently-anomalous findings. NEVER raises — this rides the
+    recorder tick."""
+    try:
+        return _detect_anomalies(now if now is not None
+                                 else time.time())
+    except Exception:  # pylint: disable=broad-except
+        return []
+
+
+def _detect_anomalies(now: float) -> List[Dict[str, Any]]:
+    from skypilot_tpu.utils import chaos
+    findings: List[Dict[str, Any]] = []
+    window = _min_points() * 2 * interval_s()
+    evaluators = {
+        'step_time_regression': _eval_step_time_regression,
+        'dispatch_gap_trend': _eval_dispatch_gap_trend,
+        'heartbeat_age_drift': _eval_heartbeat_age_drift,
+        'burn_rate_accel': _eval_burn_rate_accel,
+    }
+    for detector in DETECTORS:
+        forced = chaos.inject(DETECTOR_CHAOS_POINT, detector=detector)
+        force = (forced or {}).get('force')
+        if force == 'clear':
+            continue   # chaos forces the clear arm: drop all findings
+        if force == 'anomaly':
+            findings.append({
+                'detector': detector, 'ident': 'forced',
+                'name': '(chaos)', 'labels': {'forced': '1'},
+                'value': None, 'baseline': None})
+            continue
+        findings.extend(evaluators[detector](now, now - window))
+    _journal_transitions(findings, now)
+    return findings
+
+
+def _ident(labels: Dict[str, str]) -> str:
+    return ','.join(f'{k}={labels[k]}' for k in sorted(labels)
+                    if k != 'le') or 'all'
+
+
+def _grouped(name: str, since: float
+             ) -> Iterator[Tuple[Dict[str, str],
+                                 List[Tuple[float, float]]]]:
+    """Raw points of one metric grouped per series, oldest-first."""
+    from skypilot_tpu import state
+    rows = state.get_metric_points(name=name, res='raw', since=since)
+    by_series: Dict[str, Tuple[Dict[str, str],
+                               List[Tuple[float, float]]]] = {}
+    for row in rows:
+        key = state.canonical_labels(row['labels'])
+        entry = by_series.setdefault(key, (row['labels'], []))
+        entry[1].append((row['ts'], row['value']))
+    for labels, points in by_series.values():
+        yield labels, points
+
+
+def _finding(detector: str, name: str, labels: Dict[str, str],
+             value: Optional[float], baseline: Optional[float]
+             ) -> Dict[str, Any]:
+    return {'detector': detector, 'ident': _ident(labels),
+            'name': name, 'labels': labels, 'value': value,
+            'baseline': baseline}
+
+
+def _eval_dispatch_gap_trend(now: float, since: float
+                             ) -> List[Dict[str, Any]]:
+    """A rank whose host-dispatch share of step time trends UP is
+    going host-bound: recent average both elevated (>= the host-bound
+    threshold the profiler verdicts use) and clearly above the
+    trailing average."""
+    k = _min_points()
+    out = []
+    for labels, points in _grouped('xsky_dispatch_gap_ratio', since):
+        values = [v for _, v in points]
+        if len(values) < k + 2:
+            continue
+        recent = values[-k:]
+        trail = values[:-k]
+        recent_avg = sum(recent) / len(recent)
+        trail_avg = sum(trail) / len(trail)
+        if recent_avg >= 0.5 and recent_avg - trail_avg >= 0.1:
+            out.append(_finding('dispatch_gap_trend',
+                                'xsky_dispatch_gap_ratio', labels,
+                                recent_avg, trail_avg))
+    return out
+
+
+def _eval_heartbeat_age_drift(now: float, since: float
+                              ) -> List[Dict[str, Any]]:
+    """A heartbeat age that climbs ~1 s/s across consecutive samples
+    means the rank (or its puller) stopped: healthy pulls keep the age
+    near the pull cadence, so sustained drift past 2 intervals with
+    near-wall-clock slope is the dead-rank signature."""
+    k = _min_points()
+    out = []
+    for labels, points in _grouped(
+            'xsky_workload_last_heartbeat_age_seconds', since):
+        if len(points) < k:
+            continue
+        tail = points[-k:]
+        ages = [v for _, v in tail]
+        if any(b <= a for a, b in zip(ages, ages[1:])):
+            continue
+        t_span = tail[-1][0] - tail[0][0]
+        growth = ages[-1] - ages[0]
+        if t_span <= 0:
+            continue
+        if ages[-1] >= 2 * interval_s() and growth >= 0.8 * t_span:
+            out.append(_finding('heartbeat_age_drift',
+                                'xsky_workload_last_heartbeat_age_'
+                                'seconds', labels, ages[-1], ages[0]))
+    return out
+
+
+def _eval_burn_rate_accel(now: float, since: float
+                          ) -> List[Dict[str, Any]]:
+    """An error-budget burn that holds at or accelerates past 1.0 is
+    spending budget faster than it accrues on consecutive recorder
+    samples — the page-worthy version of a single hot scrape."""
+    out = []
+    for labels, points in _grouped('xsky_serve_slo_burn_rate', since):
+        values = [v for _, v in points]
+        if len(values) < 2:
+            continue
+        if values[-1] >= 1.0 and values[-2] >= 1.0 and \
+                values[-1] >= values[-2]:
+            out.append(_finding('burn_rate_accel',
+                                'xsky_serve_slo_burn_rate', labels,
+                                values[-1], values[-2]))
+    return out
+
+
+def _eval_step_time_regression(now: float, since: float
+                               ) -> List[Dict[str, Any]]:
+    """Windowed p50 step time (from the pull-fed
+    ``xsky_workload_step_seconds`` histogram's bucket deltas) against
+    the trailing window: a recent p50 past ``factor ×`` the baseline
+    is a regression — the 'was this degrading before the breach'
+    question, answered from history."""
+    half = _min_points() * interval_s()
+    recent = series('xsky_workload_step_seconds', since=now - half,
+                    until=now, step=half, agg='p50', res='raw')
+    baseline = series('xsky_workload_step_seconds',
+                      since=now - 2 * half, until=now - half,
+                      step=half, agg='p50', res='raw')
+    recent_p50 = recent[-1][1] if recent else None
+    base_p50 = baseline[-1][1] if baseline else None
+    if recent_p50 is None or base_p50 is None or base_p50 <= 0:
+        return []
+    if recent_p50 > _anomaly_factor() * base_p50:
+        return [_finding('step_time_regression',
+                         'xsky_workload_step_seconds', {},
+                         recent_p50, base_p50)]
+    return []
+
+
+def _journal_transitions(findings: List[Dict[str, Any]],
+                         now: float) -> None:
+    """Journal entry/exit transitions against the in-process active
+    set; the recorder tick's span makes every row trace-linked."""
+    from skypilot_tpu import state
+    from skypilot_tpu.utils import metrics as metrics_lib
+    current = {(f['detector'], f['ident']): f for f in findings}
+    with _anomaly_lock:
+        started = [key for key in current if key not in
+                   _active_anomalies]
+        cleared = [(key, since) for key, since in
+                   _active_anomalies.items() if key not in current]
+        for key in started:
+            _active_anomalies[key] = now
+        for key, _ in cleared:
+            del _active_anomalies[key]
+    for detector, ident in started:
+        finding = current[(detector, ident)]
+        state.record_recovery_event(
+            ANOMALY_EVENT, scope=f'metrics/{detector}/{ident}',
+            cause=detector,
+            detail={'name': finding['name'],
+                    'labels': finding['labels'],
+                    'value': finding['value'],
+                    'baseline': finding['baseline']})
+        metrics_lib.inc_counter(
+            'xsky_metrics_anomalies_total',
+            'Anomaly-detector entry transitions, by detector.',
+            1.0, detector=detector)
+    for (detector, ident), since in cleared:
+        state.record_recovery_event(
+            ANOMALY_CLEARED_EVENT,
+            scope=f'metrics/{detector}/{ident}', cause=detector,
+            latency_s=now - since)
+
+
+def active_anomalies() -> Dict[Tuple[str, str], float]:
+    """Snapshot of the active set (tests + `xsky metrics list`)."""
+    with _anomaly_lock:
+        return dict(_active_anomalies)

@@ -96,6 +96,10 @@ _NAMES = [
             'Hung/dead rank verdict transitions, labeled by verdict'),
     ObsName('metric', 'xsky_workload_step_seconds',
             'Pull-fed workload step-time histogram'),
+    ObsName('metric', 'xsky_metrics_points_recorded_total',
+            'Metric points recorded by the history recorder tick'),
+    ObsName('metric', 'xsky_metrics_anomalies_total',
+            'Anomaly-detector entry transitions, labeled by detector'),
     # ---- metrics: scrape-time gauges (server/metrics.py renders these) -----
     ObsName('metric', 'xsky_http_requests_total',
             'API-server HTTP requests {path,code}'),
@@ -242,6 +246,12 @@ _NAMES = [
             'Controller-side goodput ledger fold + persist'),
     ObsName('span', 'goodput.report',
             'goodput.report verb: ledger read for the CLI'),
+    ObsName('span', 'metrics.record',
+            'One metrics-history recorder tick: sample + record + '
+            'downsample + anomaly detection'),
+    ObsName('span', 'metrics.query',
+            'Trend read over metric_points (metrics.list/query '
+            'verbs, --trend sparklines)'),
     ObsName('span', 'profile.capture',
             'profile.capture verb: on-demand device capture'),
     ObsName('span', 'profiler.pull',
@@ -285,6 +295,9 @@ _NAMES = [
             'Jobs controller cluster-status probe'),
     ObsName('chaos', 'lb.proxy',
             'Slow/fail the LB upstream relay leg'),
+    ObsName('chaos', 'metrics.detector',
+            'Force an anomaly-detector arm (rule key `force`: '
+            '`anomaly` or `clear`), keyed on detector'),
     ObsName('chaos', 'profiler.dispatch_stall',
             'Inflate a sampled host dispatch gap'),
     ObsName('chaos', 'serve.probe',
@@ -331,6 +344,12 @@ _NAMES = [
             'Reconciler tore down an orphaned controller cluster'),
     ObsName('journal', 'reconcile.respawn_budget_exhausted',
             'Reconciler hit the bounded-respawn budget'),
+    ObsName('journal', 'metrics.anomaly',
+            'An anomaly detector tripped on recorded trend history '
+            '(detector, series, value vs baseline attached)'),
+    ObsName('journal', 'metrics.anomaly_cleared',
+            'A tripped detector returned to normal (latency = the '
+            'anomaly\'s duration)'),
     ObsName('journal', 'serve.slo_breach',
             'Multi-window burn crossed threshold, burns attached'),
     ObsName('journal', 'serve.slo_recovered',

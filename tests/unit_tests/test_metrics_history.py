@@ -1,0 +1,861 @@
+"""Metrics history plane tests: the bounded multi-resolution
+`metric_points` table (round trip, per-tier retention, torn-row
+immunity, non-vacuous never-raise), downsampling math (gauge
+avg/min/max, counter window-end values, 10m-from-1m folds, cursor
+recovery), the trend query layer (bucketed aggs, counter-aware rate
+across incarnation resets, windowed histogram quantiles, subset label
+folds), the recorder tick over the REAL /metrics surface (snapshot and
+text paths agree; TTFT-p99 and dispatch-gap series — the autoscaler
+arc's inputs — retrievable), the journalled anomaly detectors
+(transitions, chaos-forced arms, trace linkage), the CLI surfaces
+(metrics list/query table+json+sparkline, top/slo --trend, the shared
+duration parser), the `/metrics?name=` filter, the xskylint surface
+over the new table, and the `tools/bench_metrics_history.py --smoke`
+subprocess gate (recorder overhead at cardinality + the fake-cloud
+anomaly drill)."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.request
+
+import pytest
+
+from skypilot_tpu.utils import chaos
+from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import metrics_history
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), '..', '..'))
+
+T0 = 1_700_000_000.0 // 600 * 600   # minute- and 10m-aligned anchor
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    metrics_lib.reset_for_test()
+    metrics_history.reset_for_test()
+    chaos.clear()
+    yield
+    metrics_lib.reset_for_test()
+    metrics_history.reset_for_test()
+    chaos.clear()
+
+
+@pytest.fixture
+def tmp_state(monkeypatch, tmp_path):
+    from skypilot_tpu import state
+    monkeypatch.setenv('XSKY_STATE_DB', str(tmp_path / 'state.db'))
+    state.reset_for_test()
+    yield state
+    state.reset_for_test()
+
+
+@pytest.fixture
+def tmp_serve_db(monkeypatch, tmp_path):
+    monkeypatch.setenv('XSKY_SERVE_DB', str(tmp_path / 'serve.db'))
+    yield
+
+
+def _gauge_points(state, name, values, labels=None, t0=None, dt=15.0,
+                  kind='gauge'):
+    t0 = T0 if t0 is None else t0
+    state.record_metric_points(
+        [{'ts': t0 + i * dt, 'name': name, 'labels': labels or {},
+          'kind': kind, 'value': v} for i, v in enumerate(values)])
+
+
+# ---- state table ------------------------------------------------------------
+
+
+class TestMetricPointsTable:
+
+    def test_round_trip_and_filters(self, tmp_state):
+        tmp_state.record_metric_points([
+            {'ts': T0, 'name': 'm_a', 'labels': {'rank': 0},
+             'kind': 'gauge', 'value': 1.5},
+            {'ts': T0 + 10, 'name': 'm_a', 'labels': {'rank': 1},
+             'kind': 'gauge', 'value': 2.5},
+            {'ts': T0 + 20, 'name': 'm_b', 'labels': {},
+             'kind': 'counter', 'value': 7.0},
+        ])
+        rows = tmp_state.get_metric_points(name='m_a')
+        assert [r['value'] for r in rows] == [1.5, 2.5]   # ts order
+        assert rows[0]['labels'] == {'rank': '0'}
+        only = tmp_state.get_metric_points(name='m_a',
+                                           labels={'rank': 1})
+        assert [r['value'] for r in only] == [2.5]
+        since = tmp_state.get_metric_points(since=T0 + 15)
+        assert [r['name'] for r in since] == ['m_b']
+        listed = tmp_state.list_metric_series()
+        assert {(s['name'], s['points']) for s in listed} == {
+            ('m_a', 1), ('m_a', 1), ('m_b', 1)} or len(listed) == 3
+        prefixed = tmp_state.list_metric_series(prefix='m_a')
+        assert {s['name'] for s in prefixed} == {'m_a'}
+
+    def test_canonical_labels_one_spelling(self, tmp_state):
+        # Insertion order and value types must not mint new series.
+        a = tmp_state.canonical_labels({'b': 1, 'a': 'x'})
+        b = tmp_state.canonical_labels({'a': 'x', 'b': '1'})
+        assert a == b
+
+    def test_per_tier_age_retention_first_batch(self, tmp_state,
+                                                monkeypatch):
+        monkeypatch.setattr(tmp_state, '_metric_point_inserts', 0)
+        now = T0 + 10_000
+        tmp_state.record_metric_points(
+            [{'ts': now - 5000, 'name': 'old', 'labels': {},
+              'kind': 'gauge', 'value': 1.0},
+             {'ts': now - 10, 'name': 'new', 'labels': {},
+              'kind': 'gauge', 'value': 2.0}],
+            ts=now, retention_s={'raw': 600.0})
+        names = {r['name'] for r in tmp_state.get_metric_points()}
+        # FIRST batch pruned the expired raw row already (short-lived
+        # writers never reach an amortized gate).
+        assert names == {'new'}
+
+    def test_global_row_cap(self, tmp_state, monkeypatch):
+        monkeypatch.setattr(tmp_state, '_MAX_METRIC_POINTS', 10)
+        monkeypatch.setattr(tmp_state, '_metric_point_inserts', 0)
+        tmp_state.record_metric_points(
+            [{'ts': T0 + j, 'name': 'cap', 'labels': {'j': j},
+              'kind': 'gauge', 'value': 1.0} for j in range(30)])
+        rows = tmp_state.get_metric_points(name='cap')
+        # First-batch prune enforces the cap; the newest rows survive.
+        assert len(rows) == 10
+        assert rows[-1]['labels'] == {'j': '29'}
+
+    def test_torn_rows_cannot_poison_queries(self, tmp_state):
+        _gauge_points(tmp_state, 'ok_metric', [1.0, 2.0])
+        conn = tmp_state._get_conn()   # pylint: disable=protected-access
+        with tmp_state._lock:          # pylint: disable=protected-access
+            conn.execute(
+                "INSERT INTO metric_points (ts, res, name, labels, "
+                "kind, value, vmin, vmax, count) VALUES "
+                "(?, 'raw', 'ok_metric', '{\"torn', 'gauge', 3.0, "
+                '3.0, 3.0, 1)', (T0 + 30,))
+            conn.execute(
+                "INSERT INTO metric_points (ts, res, name, labels, "
+                "kind, value, vmin, vmax, count) VALUES "
+                "(?, 'raw', 'ok_metric', '{}', 'gauge', NULL, "
+                'NULL, NULL, 1)', (T0 + 45,))
+            conn.commit()
+        rows = tmp_state.get_metric_points(name='ok_metric')
+        assert [r['value'] for r in rows] == [1.0, 2.0]
+        series = metrics_history.series(
+            'ok_metric', since=T0, until=T0 + 60, step=60, agg='avg',
+            res='raw')
+        assert series[0][1] == pytest.approx(1.5)
+
+    def test_record_never_raises_on_db_failure(self, tmp_state,
+                                               monkeypatch, tmp_path):
+        # Non-vacuous: the DB path's parent is a FILE, so every
+        # connect genuinely fails (the PR 11 pattern — a missing
+        # directory would just be created).
+        blocker = tmp_path / 'blocker'
+        blocker.write_text('not a directory')
+        monkeypatch.setenv('XSKY_STATE_DB',
+                           str(blocker / 'no' / 'such' / 'x.db'))
+        tmp_state.reset_for_test()
+        tmp_state.record_metric_points(
+            [{'name': 'x', 'labels': {}, 'kind': 'gauge',
+              'value': 1.0}])
+        metrics_history.record_points(
+            [{'name': 'x', 'labels': {}, 'kind': 'gauge',
+              'value': 1.0}])
+        assert metrics_history.series('x') == []
+        assert metrics_history.detect_anomalies() == []
+
+
+# ---- downsampling -----------------------------------------------------------
+
+
+class TestDownsampling:
+
+    def test_gauge_window_avg_min_max_exact(self, tmp_state):
+        values = [1.0, 5.0, 3.0, 9.0]
+        _gauge_points(tmp_state, 'g', values)
+        metrics_history.record_points([], ts=T0 + 120)
+        rows = tmp_state.get_metric_points(name='g', res='1m')
+        assert len(rows) == 1
+        assert rows[0]['value'] == sum(values) / len(values)
+        assert rows[0]['vmin'] == 1.0 and rows[0]['vmax'] == 9.0
+        assert rows[0]['count'] == 4
+        assert rows[0]['ts'] == T0   # window START, minute aligned
+
+    def test_counter_window_end_value(self, tmp_state):
+        _gauge_points(tmp_state, 'c_total', [10.0, 20.0, 30.0],
+                      kind='counter')
+        metrics_history.record_points([], ts=T0 + 120)
+        rows = tmp_state.get_metric_points(name='c_total', res='1m')
+        assert rows[0]['value'] == 30.0      # window-end cumulative
+        assert rows[0]['vmin'] == 10.0
+
+    def test_10m_folds_from_1m(self, tmp_state):
+        _gauge_points(tmp_state, 'g', [2.0, 4.0], dt=60.0)
+        metrics_history.record_points([], ts=T0 + 1200)
+        one_m = tmp_state.get_metric_points(name='g', res='1m')
+        ten_m = tmp_state.get_metric_points(name='g', res='10m')
+        assert len(one_m) == 2
+        assert len(ten_m) == 1
+        assert ten_m[0]['value'] == 3.0
+        assert ten_m[0]['ts'] % 600 == 0
+
+    def test_cursor_recovery_never_double_folds(self, tmp_state):
+        _gauge_points(tmp_state, 'g', [1.0, 3.0])
+        metrics_history.record_points([], ts=T0 + 120)
+        # A fresh process (cursor state lost) ticks again: the cursor
+        # recovers from the table's MAX(ts) and must not re-fold.
+        metrics_history.reset_for_test()
+        metrics_history.record_points([], ts=T0 + 180)
+        rows = tmp_state.get_metric_points(name='g', res='1m')
+        assert len(rows) == 1
+
+    def test_incomplete_window_not_folded(self, tmp_state):
+        _gauge_points(tmp_state, 'g', [1.0])
+        metrics_history.record_points([], ts=T0 + 30)   # window open
+        assert tmp_state.get_metric_points(name='g', res='1m') == []
+
+
+# ---- query layer ------------------------------------------------------------
+
+
+class TestSeriesQueries:
+
+    def test_bucketed_aggs_and_gaps(self, tmp_state):
+        _gauge_points(tmp_state, 'g', [1.0, 3.0], dt=10.0)
+        _gauge_points(tmp_state, 'g', [7.0], t0=T0 + 90)
+        out = metrics_history.series('g', since=T0, until=T0 + 120,
+                                     step=30, agg='avg', res='raw')
+        assert out[0] == (T0, 2.0)
+        assert out[1][1] is None                  # gap, not interpolation
+        assert out[3][1] == 7.0
+        assert metrics_history.series(
+            'g', since=T0, until=T0 + 30, step=30, agg='max',
+            res='raw')[0][1] == 3.0
+        assert metrics_history.series(
+            'g', since=T0, until=T0 + 30, step=30, agg='count',
+            res='raw')[0][1] == 2.0
+
+    def test_rate_is_counter_aware_across_incarnation_reset(
+            self, tmp_state):
+        # 10 → 20 → 30, then the incarnation restarts the counter at
+        # 5 → 15: the drop must read as a reset (increase 5), never a
+        # negative rate.
+        _gauge_points(tmp_state, 'c_total', [10, 20, 30, 5, 15],
+                      dt=10.0, kind='counter')
+        out = metrics_history.series(
+            'c_total', since=T0, until=T0 + 50, step=10, agg='rate',
+            res='raw')
+        values = [v for _, v in out]
+        assert values[0] is None                  # baseline sample
+        assert values[1] == 1.0 and values[2] == 1.0
+        assert values[3] == 0.5                   # reset: increase=5
+        assert values[4] == 1.0
+        assert all(v is None or v >= 0 for v in values)
+
+    def test_rate_divides_by_covered_time_not_step(self, tmp_state):
+        # Samples spaced 60s apart queried at step=30s: each delta of
+        # 30 covers 60s → 0.5/s in its landing bucket, NOT delta/step
+        # (which would read 1.0/s — the promql covered-time contract).
+        _gauge_points(tmp_state, 'c_total', [0, 30, 60], dt=60.0,
+                      kind='counter')
+        out = metrics_history.series(
+            'c_total', since=T0, until=T0 + 180, step=30, agg='rate',
+            res='raw')
+        populated = [v for _, v in out if v is not None]
+        assert populated == [0.5, 0.5]
+
+    def test_fetch_pages_past_default_row_limit(self, tmp_state):
+        # 25k points of one series: a single-call read would silently
+        # truncate at the 20k default and drop the NEWEST buckets.
+        n = 25000
+        tmp_state.record_metric_points(
+            [{'ts': T0 + i, 'name': 'big', 'labels': {},
+              'kind': 'gauge', 'value': float(i)} for i in range(n)])
+        out = metrics_history.series(
+            'big', since=T0, until=T0 + n, step=float(n), agg='count',
+            res='raw')
+        assert out[0][1] == float(n)
+        last = metrics_history.series(
+            'big', since=T0 + n - 10, until=T0 + n, step=10,
+            agg='max', res='raw')
+        assert last[0][1] == float(n - 1)   # newest points intact
+
+    def test_rate_sums_across_matching_series(self, tmp_state):
+        for rank in (0, 1):
+            _gauge_points(tmp_state, 'c_total', [0, 10, 20],
+                          labels={'rank': rank}, dt=10.0,
+                          kind='counter')
+        out = metrics_history.series(
+            'c_total', since=T0, until=T0 + 30, step=10, agg='rate',
+            res='raw')
+        assert out[1][1] == 2.0   # 1/s per rank, summed
+
+    def test_windowed_quantiles_track_regression(self, tmp_state):
+        for i in range(6):
+            metrics_lib.observe('lat_seconds', 'h',
+                                0.2 if i < 3 else 0.8)
+            metrics_history.record_tick(now=T0 + i * 15)
+        early = metrics_history.series(
+            'lat_seconds', since=T0, until=T0 + 45, step=45,
+            agg='p50', res='raw')
+        late = metrics_history.series(
+            'lat_seconds', since=T0 + 45, until=T0 + 90, step=45,
+            agg='p50', res='raw')
+        assert early[0][1] is not None and late[0][1] is not None
+        assert late[0][1] > early[0][1] * 2
+        assert 0.1 <= early[0][1] <= 0.25
+        assert 0.5 <= late[0][1] <= 1.0
+
+    def test_query_validates_agg_and_res(self, tmp_state):
+        with pytest.raises(ValueError):
+            metrics_history.query('g', agg='p42')
+        with pytest.raises(ValueError):
+            metrics_history.query('g', res='5m')
+        out = metrics_history.query('g', agg='avg', res='raw')
+        assert out['points'] == [] or isinstance(out['points'], list)
+        assert out['res'] == 'raw'
+
+    def test_res_picked_by_window_span(self, tmp_state, monkeypatch):
+        monkeypatch.setenv(metrics_history.ENV_RAW_RETENTION, '100')
+        monkeypatch.setenv(metrics_history.ENV_1M_RETENTION, '1000')
+        now = time.time()
+        assert metrics_history.query(
+            'g', since=now - 50)['res'] == 'raw'
+        assert metrics_history.query(
+            'g', since=now - 500)['res'] == '1m'
+        assert metrics_history.query(
+            'g', since=now - 5000)['res'] == '10m'
+
+    def test_sparkline_shape(self):
+        spark = metrics_history.sparkline([0.0, None, 1.0, 0.5])
+        assert len(spark) == 4
+        assert spark[1] == ' '
+        assert spark[0] == '▁' and spark[2] == '█'
+        assert metrics_history.sparkline([]) == ''
+        assert metrics_history.sparkline([None, None]) == '  '
+
+
+# ---- recorder tick over the real /metrics surface ---------------------------
+
+
+class TestRecorderTick:
+
+    def test_snapshot_and_text_paths_mint_identical_series(
+            self, tmp_state):
+        metrics_lib.inc_counter('xsky_t_total', 'h', 2.0,
+                                cluster='a', rank=3)
+        metrics_lib.observe('xsky_t_seconds', 'h', 0.2)
+        from skypilot_tpu.utils import metrics as m
+        text = ('# TYPE xsky_t_total counter\n'
+                '# TYPE xsky_t_seconds histogram\n'
+                + m.render_registry())
+        structural = metrics_history.sample_points(now=T0)
+        parsed = metrics_history.sample_points(now=T0, text=text)
+        from skypilot_tpu import state
+        as_keys = lambda pts: {        # noqa: E731
+            (p['name'], p['kind'],
+             p['labels'] if isinstance(p['labels'], str)
+             else state.canonical_labels(p['labels']), p['value'])
+            for p in pts if p['name'].startswith('xsky_t_')}
+        # The structural fast path and the text-parse path must mint
+        # IDENTICAL series (name, kind, canonical labels, value) for
+        # the registry — drift here would fork series identity
+        # between a recorder restart and a text-fed test.
+        assert as_keys(structural) == as_keys(parsed)
+        assert as_keys(structural), 'registry series must be sampled'
+
+    def test_acceptance_series_ttft_and_dispatch_gap(
+            self, tmp_state, tmp_serve_db):
+        """The autoscaler/LB arc's two contract series must be
+        retrievable through series() after recording the REAL
+        /metrics render: per-replica TTFT p99 and per-rank dispatch
+        gap."""
+        from skypilot_tpu.serve import state as serve_state
+        tmp_state.add_or_update_cluster('trainc', None, ready=True)
+        tmp_state.record_profiles('trainc', 1, [
+            {'rank': 0, 'kind': 'summary', 'dispatch_gap_ratio': 0.8,
+             'hbm_bytes_in_use': 1 << 30}])
+        serve_state.add_service(
+            'svc', {'service': {'slo': {'ttft_p99_ms': 100}}}, 12345)
+        tmp_state.record_serve_slo('svc', [
+            {'kind': 'replica', 'replica_id': 1,
+             'endpoint': '127.0.0.1:9001', 'ttft_p99_ms': 42.0},
+            {'kind': 'service', 'replica_id': None,
+             'burns': {'300': {'ttft_p99_ms': 2.0}},
+             'verdict': 'breach'},
+        ])
+        now = time.time()
+        metrics_history.record_tick(now=now)
+        ttft = metrics_history.series(
+            'xsky_serve_replica_ttft_p99_seconds',
+            labels={'service': 'svc', 'replica': 1},
+            since=now - 60, until=now + 1)
+        assert any(v == pytest.approx(0.042)
+                   for _, v in ttft if v is not None)
+        gap = metrics_history.series(
+            'xsky_dispatch_gap_ratio',
+            labels={'cluster': 'trainc', 'job': 1, 'rank': 0},
+            since=now - 60, until=now + 1)
+        assert any(v == pytest.approx(0.8)
+                   for _, v in gap if v is not None)
+        burn = metrics_history.series(
+            'xsky_serve_slo_burn_rate',
+            labels={'service': 'svc', 'window': '300'},
+            since=now - 60, until=now + 1)
+        assert any(v == 2.0 for _, v in burn if v is not None)
+
+    def test_cardinality_clamp(self, tmp_state, monkeypatch):
+        monkeypatch.setenv(metrics_history.ENV_MAX_SERIES, '10')
+        for i in range(50):
+            metrics_lib.inc_counter('xsky_card_total', 'h', 1.0,
+                                    i=str(i))
+        points = metrics_history.sample_points(now=T0)
+        assert len(points) == 10
+
+    def test_clamp_preserves_gauge_plane_over_registry(
+            self, tmp_state, monkeypatch):
+        # A registry label explosion must truncate REGISTRY series,
+        # never the bounded-by-construction scrape-time gauges the
+        # detectors read.
+        monkeypatch.setenv(metrics_history.ENV_MAX_SERIES, '20')
+        tmp_state.add_or_update_cluster('trainc', None, ready=True)
+        tmp_state.record_profiles('trainc', 1, [
+            {'rank': 0, 'kind': 'summary',
+             'dispatch_gap_ratio': 0.7}])
+        for i in range(100):
+            metrics_lib.inc_counter('xsky_explosion_total', 'h', 1.0,
+                                    i=str(i))
+        points = metrics_history.sample_points(now=T0)
+        assert len(points) == 20
+        names = {p['name'] for p in points}
+        assert 'xsky_dispatch_gap_ratio' in names
+
+    def test_tick_records_under_span_and_counts(self, tmp_state):
+        metrics_lib.inc_counter('xsky_t_total', 'h', 1.0)
+        out = metrics_history.record_tick(now=time.time())
+        assert out['points'] >= 1
+        spans = tmp_state.get_spans_by_name(['metrics.record'])
+        # Spans flush on root exit; force it.
+        from skypilot_tpu.utils import tracing
+        tracing.flush()
+        spans = tmp_state.get_spans_by_name(['metrics.record'])
+        assert spans, 'recorder tick must land on the trace plane'
+
+
+# ---- anomaly detectors ------------------------------------------------------
+
+
+class TestDetectors:
+
+    def _now(self):
+        return time.time()
+
+    def test_burn_rate_accel_fires_and_clears(self, tmp_state):
+        now = self._now()
+        labels = {'service': 'svc', 'window': '300'}
+        _gauge_points(tmp_state, 'xsky_serve_slo_burn_rate',
+                      [0.2, 1.5, 2.0], labels=labels, t0=now - 30,
+                      dt=15.0)
+        found = metrics_history.detect_anomalies(now=now)
+        assert any(f['detector'] == 'burn_rate_accel' for f in found)
+        events = tmp_state.get_recovery_events(
+            event_type='metrics.anomaly')
+        assert len(events) == 1
+        assert events[0]['cause'] == 'burn_rate_accel'
+        assert events[0]['scope'].startswith(
+            'metrics/burn_rate_accel/')
+        # Second tick, still burning: no duplicate journal row.
+        metrics_history.detect_anomalies(now=now + 1)
+        assert len(tmp_state.get_recovery_events(
+            event_type='metrics.anomaly')) == 1
+        # Burn decays: cleared journalled with the anomaly duration.
+        _gauge_points(tmp_state, 'xsky_serve_slo_burn_rate',
+                      [0.4, 0.2], labels=labels, t0=now + 10, dt=5.0)
+        metrics_history.detect_anomalies(now=now + 20)
+        cleared = tmp_state.get_recovery_events(
+            event_type='metrics.anomaly_cleared')
+        assert len(cleared) == 1
+        assert cleared[0]['latency_s'] == pytest.approx(20, abs=1)
+        assert not metrics_history.active_anomalies()
+
+    def test_heartbeat_age_drift(self, tmp_state):
+        now = self._now()
+        dead = {'cluster': 'c', 'job': '1', 'rank': '0'}
+        live = {'cluster': 'c', 'job': '1', 'rank': '1'}
+        name = 'xsky_workload_last_heartbeat_age_seconds'
+        # Dead rank: age climbs at wall-clock slope; live rank: flat.
+        _gauge_points(tmp_state, name, [15.0, 30.0, 45.0, 60.0],
+                      labels=dead, t0=now - 45, dt=15.0)
+        _gauge_points(tmp_state, name, [2.0, 3.0, 2.0, 3.0],
+                      labels=live, t0=now - 45, dt=15.0)
+        found = metrics_history.detect_anomalies(now=now)
+        drifts = [f for f in found
+                  if f['detector'] == 'heartbeat_age_drift']
+        assert len(drifts) == 1
+        assert drifts[0]['labels']['rank'] == '0'
+
+    def test_dispatch_gap_trend(self, tmp_state):
+        now = self._now()
+        rising = {'cluster': 'c', 'job': '1', 'rank': '0'}
+        steady = {'cluster': 'c', 'job': '1', 'rank': '1'}
+        _gauge_points(tmp_state, 'xsky_dispatch_gap_ratio',
+                      [0.2, 0.25, 0.6, 0.7, 0.8, 0.9],
+                      labels=rising, t0=now - 75, dt=15.0)
+        _gauge_points(tmp_state, 'xsky_dispatch_gap_ratio',
+                      [0.85, 0.9, 0.88, 0.9, 0.87, 0.9],
+                      labels=steady, t0=now - 75, dt=15.0)
+        found = metrics_history.detect_anomalies(now=now)
+        trends = [f for f in found
+                  if f['detector'] == 'dispatch_gap_trend']
+        # Steady-high is the profiler verdict's business, not a TREND
+        # anomaly: only the rising rank fires.
+        assert [f['labels']['rank'] for f in trends] == ['0']
+
+    def test_step_time_regression_vs_trailing_baseline(
+            self, tmp_state, monkeypatch):
+        now = time.time()
+        for i in range(8):
+            metrics_lib.observe('xsky_workload_step_seconds', 'h',
+                                0.1 if i < 4 else 0.9)
+            metrics_history.record_tick(now=now - (7 - i) * 15)
+        found = metrics_history.detect_anomalies(now=now)
+        regressions = [f for f in found
+                       if f['detector'] == 'step_time_regression']
+        assert regressions, found
+        assert regressions[0]['value'] > regressions[0]['baseline']
+        # A huge factor silences it (env-tunable threshold).
+        metrics_history.reset_for_test()
+        monkeypatch.setenv(metrics_history.ENV_ANOMALY_FACTOR, '100')
+        found = metrics_history.detect_anomalies(now=now)
+        assert not [f for f in found
+                    if f['detector'] == 'step_time_regression']
+
+    def test_chaos_forces_each_arm(self, tmp_state):
+        now = self._now()
+        chaos.load_plan({'points': {'metrics.detector': {
+            'force': 'anomaly',
+            'match': {'detector': 'burn_rate_accel'}}}})
+        found = metrics_history.detect_anomalies(now=now)
+        forced = [f for f in found
+                  if f['detector'] == 'burn_rate_accel']
+        assert forced and forced[0]['labels'] == {'forced': '1'}
+        assert tmp_state.get_recovery_events(
+            event_type='metrics.anomaly')
+        # The clear arm: chaos suppresses every finding of the
+        # detector, closing the forced incident.
+        chaos.load_plan({'points': {'metrics.detector': {
+            'force': 'clear',
+            'match': {'detector': 'burn_rate_accel'}}}})
+        metrics_history.detect_anomalies(now=now + 5)
+        assert tmp_state.get_recovery_events(
+            event_type='metrics.anomaly_cleared')
+
+    def test_anomaly_is_trace_linked_through_record_tick(
+            self, tmp_state):
+        now = time.time()
+        labels = {'service': 'svc', 'window': '300'}
+        _gauge_points(tmp_state, 'xsky_serve_slo_burn_rate',
+                      [1.5, 2.0], labels=labels, t0=now - 15,
+                      dt=15.0)
+        metrics_history.record_tick(now=now)
+        events = tmp_state.get_recovery_events(
+            event_type='metrics.anomaly')
+        assert events and events[-1]['trace_id'], \
+            'anomaly must cross-link to the metrics.record span'
+
+
+# ---- CLI surfaces -----------------------------------------------------------
+
+
+class TestCliSurfaces:
+
+    def _seed(self, tmp_state):
+        now = time.time()
+        _gauge_points(tmp_state, 'xsky_demo_ratio',
+                      [0.1, 0.5, 0.9], labels={'rank': '0'},
+                      t0=now - 30, dt=15.0)
+        return now
+
+    def test_metrics_list_table_and_json(self, tmp_state):
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        self._seed(tmp_state)
+        table = CliRunner().invoke(cli_mod.cli, ['metrics', 'list'])
+        assert table.exit_code == 0, table.output
+        assert 'xsky_demo_ratio' in table.output
+        assert 'rank=0' in table.output
+        as_json = CliRunner().invoke(
+            cli_mod.cli, ['metrics', 'list', '--json'])
+        rows = [json.loads(l) for l in as_json.output.splitlines()
+                if l.startswith('{')]
+        assert rows[0]['name'] == 'xsky_demo_ratio'
+        assert rows[0]['points'] == 3
+
+    def test_metrics_query_table_sparkline_and_json(self, tmp_state):
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        self._seed(tmp_state)
+        table = CliRunner().invoke(cli_mod.cli, [
+            'metrics', 'query', 'xsky_demo_ratio', '--since', '5m',
+            '--step', '15s', '--label', 'rank=0'])
+        assert table.exit_code == 0, table.output
+        assert 'agg=avg' in table.output
+        assert any(g in table.output for g in '▁▂▃▄▅▆▇█')
+        assert 'min=0.1' in table.output and 'max=0.9' in table.output
+        as_json = CliRunner().invoke(cli_mod.cli, [
+            'metrics', 'query', 'xsky_demo_ratio', '--since', '5m',
+            '--json'])
+        out = json.loads(as_json.output)
+        assert out['name'] == 'xsky_demo_ratio'
+        assert any(p[1] is not None for p in out['points'])
+
+    def test_metrics_query_rejects_bad_step(self, tmp_state):
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        result = CliRunner().invoke(cli_mod.cli, [
+            'metrics', 'query', 'x', '--step', 'bogus'])
+        assert result.exit_code != 0
+        assert '--step' in result.output
+
+    def test_shared_duration_parser(self):
+        from skypilot_tpu.utils import common_utils
+        assert common_utils.parse_duration_s('90') == 90.0
+        assert common_utils.parse_duration_s('5m') == 300.0
+        assert common_utils.parse_duration_s('2H') == 7200.0
+        assert common_utils.parse_duration_s('1d') == 86400.0
+        assert common_utils.parse_duration_s(1.5) == 1.5
+        with pytest.raises(ValueError):
+            common_utils.parse_duration_s('abc')
+
+    def test_events_since_relative_duration(self, tmp_state):
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        tmp_state.record_recovery_event('demo.old', scope='x/1')
+        conn = tmp_state._get_conn()   # pylint: disable=protected-access
+        with tmp_state._lock:          # pylint: disable=protected-access
+            conn.execute('UPDATE recovery_events SET ts = ts - 3600')
+            conn.commit()
+        tmp_state.record_recovery_event('demo.new', scope='x/2')
+        result = CliRunner().invoke(cli_mod.cli,
+                                    ['events', '--since', '5m'])
+        assert result.exit_code == 0, result.output
+        assert 'demo.new' in result.output
+        assert 'demo.old' not in result.output
+
+    def test_top_trend_column(self, tmp_state):
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        now = time.time()
+        tmp_state.record_workload_telemetry('trainc', 1, [
+            {'rank': 0, 'phase': 'step', 'step': 10,
+             'step_time_ema_s': 0.1, 'hb_ts': now,
+             'last_progress_ts': now, 'started_ts': now - 100}])
+        _gauge_points(tmp_state, 'xsky_dispatch_gap_ratio',
+                      [0.2, 0.5, 0.8],
+                      labels={'cluster': 'trainc', 'job': '1',
+                              'rank': '0'},
+                      t0=now - 30, dt=15.0)
+        plain = CliRunner().invoke(cli_mod.cli, ['top'])
+        assert plain.exit_code == 0, plain.output
+        assert 'TREND' not in plain.output
+        trend = CliRunner().invoke(cli_mod.cli, ['top', '--trend'])
+        assert trend.exit_code == 0, trend.output
+        assert 'TREND' in trend.output
+        assert any(g in trend.output for g in '▁▂▃▄▅▆▇█')
+
+    def test_slo_trend_sparklines(self, tmp_state, tmp_serve_db):
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        from skypilot_tpu.serve import state as serve_state
+        serve_state.add_service(
+            'svc', {'service': {'slo': {'ttft_p99_ms': 100}}}, 12345)
+        tmp_state.record_serve_slo('svc', [
+            {'kind': 'replica', 'replica_id': 1,
+             'endpoint': '127.0.0.1:9001', 'ttft_p99_ms': 42.0},
+            {'kind': 'service', 'replica_id': None,
+             'burns': {'300': {'ttft_p99_ms': 2.0}},
+             'verdict': 'breach'},
+        ])
+        now = time.time()
+        _gauge_points(tmp_state, 'xsky_serve_slo_burn_rate',
+                      [0.5, 1.0, 2.0],
+                      labels={'service': 'svc', 'window': '300'},
+                      t0=now - 30, dt=15.0)
+        _gauge_points(tmp_state,
+                      'xsky_serve_replica_ttft_p99_seconds',
+                      [0.02, 0.04, 0.08],
+                      labels={'service': 'svc', 'replica': '1'},
+                      t0=now - 30, dt=15.0)
+        result = CliRunner().invoke(cli_mod.cli, ['slo', '--trend'])
+        assert result.exit_code == 0, result.output
+        assert 'TREND' in result.output
+        assert any(g in result.output for g in '▁▂▃▄▅▆▇█')
+
+
+# ---- /metrics?name= filter --------------------------------------------------
+
+
+class TestMetricsEndpointFilter:
+
+    def test_render_prefix_filters_sections(self, tmp_state):
+        from skypilot_tpu.server import metrics as server_metrics
+        server_metrics.reset_for_test()
+        server_metrics.observe_http('/health', 200)
+        server_metrics.observe_request('status', 'ok', 0.1)
+        metrics_lib.inc_counter('xsky_chaos_fires_total', 'h', 1.0,
+                                point='x')
+        full = server_metrics.render()
+        assert 'xsky_http_requests_total' in full
+        assert 'xsky_chaos_fires_total' in full
+        filtered = server_metrics.render('xsky_chaos')
+        assert 'xsky_chaos_fires_total' in filtered
+        assert 'xsky_http_requests_total' not in filtered
+        assert 'xsky_requests_total' not in filtered
+        # A histogram child prefix still selects its parent.
+        child = server_metrics.render(
+            'xsky_request_duration_seconds_bucket')
+        assert 'xsky_request_duration_seconds_bucket' in child
+        assert 'xsky_requests_total{' not in child
+
+    def test_filter_is_per_series_within_a_section(self, tmp_state):
+        # The lease SECTION renders two metrics; asking for one must
+        # not emit its sibling ('only matching series', not 'only
+        # matching sections').
+        tmp_state.heartbeat_lease('job/1', 'tester')
+        from skypilot_tpu.server import metrics as server_metrics
+        out = server_metrics.render('xsky_leases_live')
+        assert 'xsky_leases_live' in out
+        assert 'xsky_lease_expires_in_seconds' not in out
+
+    def test_filter_skips_gauge_section_recomputation(
+            self, tmp_state, monkeypatch):
+        from skypilot_tpu.server import metrics as server_metrics
+        calls = []
+        monkeypatch.setattr(
+            server_metrics, '_GAUGE_SECTIONS',
+            ((lambda: calls.append('lease') or [],
+              ('xsky_lease_expires_in_seconds',)),
+             (lambda: calls.append('slo') or [],
+              ('xsky_serve_slo_burn_rate',))))
+        server_metrics.render('xsky_serve_slo')
+        assert calls == ['slo'], \
+            'non-matching gauge sections must not be recomputed'
+
+    def test_http_endpoint_name_param(self, tmp_state, monkeypatch,
+                                      tmp_path):
+        monkeypatch.setenv('XSKY_SERVER_DB',
+                           str(tmp_path / 'requests.db'))
+        from skypilot_tpu.server import app as app_mod
+        from skypilot_tpu.server import requests_db
+        requests_db.reset_for_test()
+        server, port = app_mod.run_in_thread()
+        try:
+            metrics_lib.inc_counter('xsky_chaos_fires_total', 'h',
+                                    1.0, point='y')
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{port}/metrics'
+                    '?name=xsky_chaos', timeout=10) as resp:
+                body = resp.read().decode()
+            assert 'xsky_chaos_fires_total' in body
+            assert 'xsky_http_requests_total' not in body
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{port}/metrics',
+                    timeout=10) as resp:
+                body = resp.read().decode()
+            assert 'xsky_http_requests_total' in body
+        finally:
+            server.shutdown()
+
+
+# ---- lint surface -----------------------------------------------------------
+
+
+class TestLintSurface:
+    """The static-analysis CI job lints state.py automatically; these
+    pin that the retention/never-raise contracts actually grew to
+    cover the new plane (satellite: 'should be automatic — assert it
+    with a test')."""
+
+    def test_retention_rule_covers_metric_points(self):
+        from tools.xskylint.rules import observability as obs_rules
+        rule = obs_rules.RetentionBoundRule
+        assert rule.BOUNDED['metric_points'] == '_MAX_METRIC_POINTS'
+        assert rule.OBSERVABILITY_RE.search('metric_points')
+
+    def test_unbounded_points_table_is_a_finding(self, tmp_path):
+        pkg = tmp_path / 'skypilot_tpu'
+        pkg.mkdir()
+        (pkg / 'state.py').write_text(textwrap.dedent('''\
+            import sqlite3
+
+
+            def create(conn):
+                conn.executescript("""
+                    CREATE TABLE IF NOT EXISTS rogue_points (
+                        row_id INTEGER PRIMARY KEY,
+                        value REAL
+                    );
+                """)
+        '''))
+        from tools.xskylint import engine
+        result = engine.lint_paths(str(tmp_path), ['.'],
+                                   rule_ids=['retention-bound'])
+        findings = [f for f in result.unsuppressed
+                    if f.rule == 'retention-bound']
+        assert findings, ('a new *_points observability table without '
+                          'a bound must fail the lint')
+
+    def test_never_raise_contract_covers_recorder(self):
+        from tools.xskylint.rules import observability as obs_rules
+        entry = obs_rules.NeverRaiseRule.REQUIRED[
+            'skypilot_tpu/utils/metrics_history.py']
+        assert set(entry) == {'record_points', 'detect_anomalies',
+                              'series'}
+
+    def test_span_sites_cover_recorder_entry_points(self):
+        from tools.xskylint.rules import observability as obs_rules
+        sites = obs_rules.SpanProfilerRule.PROFILER_SITES
+        assert {'record_points', 'detect_anomalies',
+                'series'} <= sites
+
+
+# ---- bench gate -------------------------------------------------------------
+
+
+class TestBenchMetricsHistoryGate:
+    """Tier-1 gates: recorder overhead <2% of the record interval at
+    cardinality, exact downsampling arithmetic, and the fake-cloud
+    anomaly drill (an lb.proxy-slowed replica must produce a
+    trace-linked journalled metrics.anomaly visible in `xsky metrics
+    query --json` and clear on recovery) — `tools/
+    bench_metrics_history.py --smoke` in a clean subprocess (the
+    bench_profile/bench_fleet gate pattern)."""
+
+    def test_bench_smoke_gate(self):
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, 'tools',
+                          'bench_metrics_history.py'), '--smoke'],
+            capture_output=True, text=True, timeout=480, check=False)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert result['pass'] is True
+        assert result['overhead']['overhead_pct'] < \
+            result['overhead']['max_overhead_pct']
+        assert all(result['downsampling']['checks'].values())
+        drill = result['drill']
+        assert drill['journalled_anomaly'] is True
+        assert drill['anomaly_trace_linked'] is True
+        assert drill['cli_query_points'] > 0
+        assert drill['cli_query_peak_burn'] >= 1.0
+        assert drill['anomaly_cleared'] is True

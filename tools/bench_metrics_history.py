@@ -1,0 +1,444 @@
+#!/usr/bin/env python3
+"""Metrics-history plane benchmark: recorder overhead gate,
+downsampling correctness, and the end-to-end fake-cloud anomaly drill
+(the PR's three gates).
+
+**Phase A — recorder overhead (<2% of a tick at 5k-series
+cardinality).** The recorder samples the merged ``/metrics``
+exposition into the ``metric_points`` table on every
+``XSKY_METRICS_RECORD_INTERVAL_S`` tick; its cost must be invisible
+next to the tick budget it rides (the bench_telemetry amortization
+pattern). The registry is seeded with 5,000 distinct series and the
+gate is::
+
+    median(record_tick wall) / record_interval * 100 < --max-overhead-pct
+
+**Phase B — downsampling correctness.** A synthetic gauge wave and a
+cumulative counter are recorded at known timestamps; the 1m rollup
+must reproduce exact avg/min/max (gauge) and window-end values
+(counter), and the 10m tier must fold the 1m rows. Exact-arithmetic
+asserts, not tolerances.
+
+**Phase C — fake-cloud anomaly drill.** The full fake-cloud serve
+stack comes up with a declared ``slo:``; an ``lb.proxy`` chaos rule
+slows the upstream relay leg (the chaos-slowed replica), the SLO
+monitor's burn rows surface as ``xsky_serve_slo_burn_rate`` on
+``/metrics``, the recorder tick records them, and the
+``burn_rate_accel`` detector must journal a **trace-linked**
+``metrics.anomaly`` that is visible in
+``xsky metrics query xsky_serve_slo_burn_rate --json`` — then, after
+``chaos.clear()`` and a recovery load phase, ``metrics.anomaly_cleared``
+must land. Exit 0 only if the whole chain holds.
+
+Prints ONE JSON line; exit 1 on any gate failure. ``--smoke`` is the
+tier-1 subprocess gate (reduced counts, same gates).
+
+Usage:
+    python tools/bench_metrics_history.py [--smoke]
+        [--max-overhead-pct 2.0] [--skip-drill | --skip-overhead]
+"""
+import argparse
+import json
+import os
+import random
+import shutil
+import statistics
+import sys
+import tempfile
+import textwrap
+import threading
+import time
+import urllib.request
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+
+def _scratch_db() -> str:
+    scratch = tempfile.mkdtemp(prefix='xsky-bench-metrics-')
+    os.environ['XSKY_STATE_DB'] = os.path.join(scratch, 'state.db')
+    from skypilot_tpu import state
+    state.reset_for_test()
+    from skypilot_tpu.utils import metrics_history
+    metrics_history.reset_for_test()
+    return scratch
+
+
+# ---- phase A: recorder overhead at 5k series --------------------------------
+
+
+def bench_overhead(args) -> dict:
+    from skypilot_tpu.utils import metrics as metrics_lib
+    from skypilot_tpu.utils import metrics_history
+    scratch = _scratch_db()
+    try:
+        metrics_lib.reset_for_test()
+        n_series = 1000 if args.smoke else 5000
+        # 5k series across 50 names x (n/50) label values — the shape
+        # of a real fleet (few names, many label sets), and the worst
+        # case for the per-series insert path.
+        per_name = max(n_series // 50, 1)
+        for i in range(n_series):
+            metrics_lib.inc_counter(
+                f'xsky_bench_metric_{i % 50}_total', 'bench series',
+                float(i), shard=str(i // 50 % per_name),
+                worker=str(i % per_name))
+        interval = metrics_history.interval_s()
+        ticks = 3 if args.smoke else 5
+        t0 = time.time()
+        durations = []
+        for t in range(ticks):
+            start = time.perf_counter()
+            out = metrics_history.record_tick(now=t0 + t * interval)
+            durations.append(time.perf_counter() - start)
+            assert out['points'] >= n_series, out
+        tick_s = statistics.median(durations)
+        overhead_pct = tick_s / interval * 100.0
+        return {
+            'series': n_series,
+            'ticks': ticks,
+            'tick_s_median': round(tick_s, 4),
+            'record_interval_s': interval,
+            'overhead_pct': round(overhead_pct, 3),
+            'max_overhead_pct': args.max_overhead_pct,
+            'pass': overhead_pct < args.max_overhead_pct,
+        }
+    finally:
+        metrics_lib.reset_for_test()
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
+# ---- phase B: downsampling correctness --------------------------------------
+
+
+def bench_downsampling(args) -> dict:
+    del args
+    from skypilot_tpu import state
+    from skypilot_tpu.utils import metrics_history
+    scratch = _scratch_db()
+    try:
+        base = 1_700_000_000.0   # minute-aligned epoch anchor
+        base = base // 600 * 600
+        gauge_values = [1.0, 5.0, 3.0, 9.0]     # one 1m window
+        counter_values = [10.0, 20.0, 30.0, 40.0]
+        for i, (g, c) in enumerate(zip(gauge_values, counter_values)):
+            metrics_history.record_points(
+                [{'name': 'bench_gauge', 'labels': {'k': 'v'},
+                  'kind': 'gauge', 'value': g},
+                 {'name': 'bench_counter', 'labels': {},
+                  'kind': 'counter', 'value': c}],
+                ts=base + i * 15.0)
+        # A tick far in the future forces every completed window to
+        # fold (raw -> 1m -> 10m).
+        metrics_history.record_points([], ts=base + 1e9)
+        metrics_history.record_points(
+            [{'name': 'bench_gauge', 'labels': {'k': 'v'},
+              'kind': 'gauge', 'value': 0.0}], ts=base + 1e9)
+        metrics_history.record_points([], ts=base + 2e9)
+        g1m = state.get_metric_points(name='bench_gauge', res='1m')
+        c1m = state.get_metric_points(name='bench_counter', res='1m')
+        g10m = state.get_metric_points(name='bench_gauge', res='10m')
+        checks = {
+            'gauge_1m_avg': g1m and g1m[0]['value'] == sum(
+                gauge_values) / len(gauge_values),
+            'gauge_1m_min': g1m and g1m[0]['vmin'] == min(gauge_values),
+            'gauge_1m_max': g1m and g1m[0]['vmax'] == max(gauge_values),
+            'gauge_1m_count': g1m and g1m[0]['count'] == len(
+                gauge_values),
+            'counter_1m_window_end': c1m and c1m[0]['value'] == max(
+                counter_values),
+            'rollup_10m_from_1m': bool(g10m) and
+                g10m[0]['value'] == sum(gauge_values) / len(
+                    gauge_values) and
+                g10m[0]['vmin'] == min(gauge_values) and
+                g10m[0]['vmax'] == max(gauge_values),
+            'window_ts_aligned': g1m and g1m[0]['ts'] % 60 == 0,
+        }
+        return {
+            'checks': {k: bool(v) for k, v in checks.items()},
+            'pass': all(checks.values()),
+        }
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
+# ---- phase C: fake-cloud anomaly drill --------------------------------------
+
+_REPLICA_SCRIPT = textwrap.dedent('''\
+    import http.server, os, sys, time, urllib.parse
+    sys.path.insert(0, {repo_root!r})
+    from skypilot_tpu.infer import metrics as metrics_lib
+    metrics = metrics_lib.ServeMetrics()
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+        def do_GET(self):
+            if self.path == '/metrics':
+                body = metrics.render().encode()
+            else:
+                q = urllib.parse.urlparse(self.path).query
+                params = dict(urllib.parse.parse_qsl(q))
+                gen = int(params.get('g', 16))
+                body = b'x' * min(65536, gen * 4)
+                metrics.observe('/gen', 'ok',
+                                int(params.get('p', 32)), gen,
+                                ttft_s=0.005,
+                                e2e_s=0.005 + gen * 2e-4,
+                                tpot_s=0.004)
+            self.send_response(200)
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    http.server.ThreadingHTTPServer(
+        ('127.0.0.1', int(os.environ['PORT'])), H).serve_forever()
+''')
+
+_SERVICE_YAML = textwrap.dedent('''\
+    name: metricsbench
+    resources:
+      accelerators: tpu-v5e-8
+    service:
+      readiness_probe: /
+      replica_policy:
+        min_replicas: 1
+      slo:
+        ttft_p99_ms: {ttft_p99_ms}
+        availability: 0.99
+    run: |
+      python {script}
+''')
+
+
+def _drive_load(lb_port: int, rate_qps: float, duration_s: float,
+                rng: random.Random, on_tick=None) -> dict:
+    """Open-loop load (absolute schedule, the bench_serve_slo
+    pattern), with an optional per-second callback driving the
+    recorder tick while requests are in flight."""
+    n = int(rate_qps * duration_s)
+    t_start = time.perf_counter() + 0.1
+    schedule = [t_start + i / rate_qps for i in range(n)]
+    completed = [0]
+    errors = [0]
+    lock = threading.Lock()
+
+    def fire() -> None:
+        gen = int(min(500, rng.paretovariate(1.5) * 16))
+        try:
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{lb_port}/gen?g={gen}',
+                    timeout=30) as resp:
+                resp.read()
+            with lock:
+                completed[0] += 1
+        except Exception:  # pylint: disable=broad-except
+            with lock:
+                errors[0] += 1
+
+    threads = []
+    last_tick = time.perf_counter()
+    for at in schedule:
+        delay = at - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        thread = threading.Thread(target=fire,
+                                  name='xsky-bench-loadgen',
+                                  daemon=True)
+        thread.start()
+        threads.append(thread)
+        if on_tick is not None and \
+                time.perf_counter() - last_tick >= 1.0:
+            last_tick = time.perf_counter()
+            on_tick()
+    for thread in threads:
+        thread.join(timeout=60)
+    if on_tick is not None:
+        on_tick()
+    return {'offered': n, 'completed': completed[0],
+            'errors': errors[0]}
+
+
+def bench_drill(args) -> dict:
+    scratch = tempfile.mkdtemp(prefix='xsky-bench-metrics-drill-')
+    os.environ['XSKY_STATE_DB'] = os.path.join(scratch, 'state.db')
+    os.environ['XSKY_SERVE_DB'] = os.path.join(scratch, 'serve.db')
+    os.environ['XSKY_FAKE_CLOUD_DIR'] = os.path.join(scratch, 'fake')
+    os.environ['XSKY_SERVE_LOG_DIR'] = os.path.join(scratch, 'logs')
+    os.environ['XSKY_ENABLE_FAKE_CLOUD'] = '1'
+    os.environ['XSKY_SERVE_INTERVAL'] = '0.5'
+    os.environ['XSKY_SLO_SCRAPE_INTERVAL_S'] = '1'
+    # Short burn windows so recovery decays inside the drill; 1 s
+    # recorder cadence so the detector sees consecutive samples fast.
+    os.environ['XSKY_SLO_BURN_WINDOWS'] = '5,10'
+    os.environ['XSKY_METRICS_RECORD_INTERVAL_S'] = '1'
+
+    from click.testing import CliRunner
+
+    from skypilot_tpu import check as check_lib
+    from skypilot_tpu import state
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.client import cli as cli_mod
+    from skypilot_tpu.serve import controller as controller_lib
+    from skypilot_tpu.serve import core as serve_core
+    from skypilot_tpu.serve import state as serve_state
+    from skypilot_tpu.utils import chaos
+    from skypilot_tpu.utils import metrics_history
+
+    check_lib.set_enabled_clouds_for_test(['fake'])
+    state.reset_for_test()
+    metrics_history.reset_for_test()
+
+    ttft_target_ms = 100.0
+    # The chaos-slowed replica: the upstream relay leg eats 250 ms on
+    # every request, pushing relay-observed TTFT far past the 100 ms
+    # target -> burn >> 1 on every window.
+    chaos.load_plan({'points': {'lb.proxy': {'latency_s': 0.25}}})
+
+    script = os.path.join(scratch, 'replica.py')
+    with open(script, 'w', encoding='utf-8') as f:
+        f.write(_REPLICA_SCRIPT.format(repo_root=_REPO_ROOT))
+    import io
+
+    import yaml
+    config = yaml.safe_load(io.StringIO(_SERVICE_YAML.format(
+        ttft_p99_ms=ttft_target_ms, script=script)))
+    task = task_lib.Task.from_yaml_config(config)
+
+    name = 'metricsbench'
+    import socket
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        lb_port = s.getsockname()[1]
+    serve_state.add_service(name, task.to_yaml_config(), lb_port)
+    controller = controller_lib.SkyServeController(name)
+    thread = threading.Thread(target=controller.run,
+                              name='xsky-bench-metrics-controller',
+                              daemon=True)
+    thread.start()
+
+    result: dict = {'service': name}
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            record = serve_state.get_service(name)
+            if record['status'] == serve_state.ServiceStatus.READY:
+                break
+            if record['status'] == serve_state.ServiceStatus.FAILED:
+                result['error'] = 'service FAILED during bring-up'
+                result['pass'] = False
+                return result
+            time.sleep(0.3)
+        else:
+            result['error'] = 'service never became READY'
+            result['pass'] = False
+            return result
+
+        rng = random.Random(11)
+        rate = 10.0 if args.smoke else 25.0
+        breach_s = 6.0 if args.smoke else 15.0
+
+        def tick():
+            metrics_history.record_tick()
+
+        result['breach_load'] = _drive_load(lb_port, rate, breach_s,
+                                            rng, on_tick=tick)
+        # The detector needs two consecutive >=1 burn samples; keep
+        # ticking briefly until the anomaly lands in the journal.
+        anomaly_deadline = time.time() + 30
+        events = []
+        while time.time() < anomaly_deadline:
+            metrics_history.record_tick()
+            events = state.get_recovery_events(
+                event_type=metrics_history.ANOMALY_EVENT)
+            if any(e['cause'] == 'burn_rate_accel' for e in events):
+                break
+            time.sleep(1.0)
+        anomalies = [e for e in events
+                     if e['cause'] == 'burn_rate_accel']
+        result['journalled_anomaly'] = bool(anomalies)
+        result['anomaly_trace_linked'] = bool(
+            anomalies and anomalies[-1].get('trace_id'))
+
+        # The burn series the detector fired on must be queryable end
+        # to end through the CLI.
+        cli = CliRunner().invoke(cli_mod.cli, [
+            'metrics', 'query', 'xsky_serve_slo_burn_rate',
+            '--since', '5m', '--agg', 'max', '--json'])
+        points = []
+        if cli.exit_code == 0 and cli.output.strip():
+            points = [p for p in json.loads(
+                cli.output.strip())['points'] if p[1] is not None]
+        result['cli_query_points'] = len(points)
+        result['cli_query_peak_burn'] = max(
+            (p[1] for p in points), default=None)
+
+        # Recovery: clear the chaos, drive good traffic until the burn
+        # windows decay, and require the cleared transition.
+        chaos.clear()
+        result['recovery_load'] = _drive_load(
+            lb_port, rate, 10.0 if args.smoke else 20.0, rng,
+            on_tick=tick)
+        cleared_deadline = time.time() + 40
+        cleared = []
+        while time.time() < cleared_deadline:
+            metrics_history.record_tick()
+            cleared = state.get_recovery_events(
+                event_type=metrics_history.ANOMALY_CLEARED_EVENT)
+            if any(e['cause'] == 'burn_rate_accel' for e in cleared):
+                break
+            time.sleep(1.0)
+        result['anomaly_cleared'] = any(
+            e['cause'] == 'burn_rate_accel' for e in cleared)
+
+        result['pass'] = (
+            result['journalled_anomaly'] and
+            result['anomaly_trace_linked'] and
+            len(points) > 0 and
+            (result['cli_query_peak_burn'] or 0) >= 1.0 and
+            result['anomaly_cleared'])
+        return result
+    finally:
+        controller.stop()
+        thread.join(timeout=30)
+        chaos.clear()
+        try:
+            serve_core.down(name)
+        except Exception:  # pylint: disable=broad-except
+            pass
+        check_lib.set_enabled_clouds_for_test(None)
+        for key in ('XSKY_SLO_BURN_WINDOWS',
+                    'XSKY_METRICS_RECORD_INTERVAL_S',
+                    'XSKY_SLO_SCRAPE_INTERVAL_S',
+                    'XSKY_SERVE_INTERVAL'):
+            os.environ.pop(key, None)
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--smoke', action='store_true',
+                        help='Reduced counts for the tier-1 '
+                             'subprocess gate (same gates).')
+    parser.add_argument('--max-overhead-pct', type=float, default=2.0)
+    parser.add_argument('--skip-overhead', action='store_true')
+    parser.add_argument('--skip-drill', action='store_true')
+    args = parser.parse_args()
+
+    out = {'metric': 'metrics_history_plane', 'smoke': args.smoke}
+    ok = True
+    if not args.skip_overhead:
+        out['overhead'] = bench_overhead(args)
+        ok = ok and out['overhead']['pass']
+        out['downsampling'] = bench_downsampling(args)
+        ok = ok and out['downsampling']['pass']
+    if not args.skip_drill:
+        out['drill'] = bench_drill(args)
+        ok = ok and out['drill']['pass']
+    out['pass'] = ok
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

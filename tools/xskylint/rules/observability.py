@@ -109,7 +109,17 @@ class SpanProfilerRule(engine.Rule):
                                 # on the controller tick whose cost
                                 # xsky trace must attribute.
                                 'build_ledger',
-                                'record_ledger'})
+                                'record_ledger',
+                                # metrics-history recorder/query
+                                # sites: a tick writes ~every live
+                                # series and a trend query folds the
+                                # table — both must land on the trace
+                                # (metrics_history holds its own
+                                # `metrics.record` span internally;
+                                # external callers hold theirs).
+                                'record_points',
+                                'detect_anomalies',
+                                'series'})
 
     def applies_to(self, rel_path: str) -> bool:
         return rel_path.startswith('skypilot_tpu/') and \
@@ -147,10 +157,11 @@ class RetentionBoundRule(engine.Rule):
         'serve_slo': '_MAX_SERVE_SLO',
         'fleet_decisions': '_MAX_FLEET_DECISIONS',
         'goodput_ledger': '_MAX_GOODPUT_LEDGER',
+        'metric_points': '_MAX_METRIC_POINTS',
     }
     # CREATE TABLE names matching this are observability tables.
     OBSERVABILITY_RE = re.compile(
-        r'events|spans|telemetry|profiles|slo|decisions|ledger')
+        r'events|spans|telemetry|profiles|slo|decisions|ledger|points')
     CREATE_RE = re.compile(r'CREATE TABLE IF NOT EXISTS (\w+)')
 
     def applies_to(self, rel_path: str) -> bool:
@@ -346,6 +357,8 @@ class NeverRaiseRule(engine.Rule):
         'skypilot_tpu/agent/checkpointd.py': (
             'maybe_checkpoint', 'restore', 'wait_idle',
             'derive_mttf'),
+        'skypilot_tpu/utils/metrics_history.py': (
+            'record_points', 'detect_anomalies', 'series'),
     }
 
     def applies_to(self, rel_path: str) -> bool:
