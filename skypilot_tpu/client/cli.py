@@ -12,6 +12,7 @@ import datetime
 import json
 import os
 import sys
+import time
 from typing import List, Optional, Tuple
 
 import click
@@ -1551,6 +1552,63 @@ def slo_cmd(service, show_trend, as_json):
                          'replica': row['replica_id']},
                         width=12) or '-')
                 click.echo(rfmt.format(*cells))
+
+
+@cli.command(name='remediations')
+@click.option('--scope', default=None,
+              help='Filter by scope prefix (e.g. service/my-svc, '
+                   'job/3).')
+@click.option('--detector', default=None,
+              help='Filter by triggering detector (e.g. '
+                   'dispatch_gap_trend, preemption).')
+@click.option('--status', default=None,
+              type=click.Choice(['applied', 'resolved', 'suppressed']),
+              help='Filter by current status.')
+@click.option('--all', 'show_all', is_flag=True, default=False,
+              help='Full history instead of the latest state per '
+                   '(scope, detector, ident, action).')
+@click.option('--limit', default=100, show_default=True,
+              help='Max rows.')
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='One JSON object per row (trace_id joins `xsky '
+                   'trace`).')
+def remediations_cmd(scope, detector, status, show_all, limit,
+                     as_json):
+    """Closed-loop remediations: what the anomaly→remediation engine
+    did and why.
+
+    Each row is one remediation keyed by (scope, detector, ident,
+    action): `applied` while the action holds, `resolved` once the
+    triggering anomaly cleared (with the applied→resolved latency in
+    detail), `suppressed` when a flapping anomaly re-fired inside the
+    cooldown and was deduped. The trace id is shared with the
+    triggering anomaly's journal entry — `xsky trace <trace_id>` walks
+    fault → detection → action → resolution.
+    """
+    from skypilot_tpu import state as state_lib
+    rows = state_lib.get_remediations(
+        scope=scope, detector=detector, status=status,
+        latest_only=not show_all, limit=limit)
+    if as_json:
+        for row in rows:
+            click.echo(json.dumps(row, default=str))
+        return
+    if not rows:
+        click.echo('No remediations.')
+        return
+    now = time.time()
+    fmt = '{:<5} {:<20} {:<20} {:<22} {:<20} {:<10} {:<16}'
+    click.echo(fmt.format('AGE', 'SCOPE', 'DETECTOR', 'IDENT',
+                          'ACTION', 'STATUS', 'TRACE'))
+    for row in rows:
+        click.echo(fmt.format(
+            _age_str(now - row['ts'] if row['ts'] else None),
+            (row['scope'] or '-')[:20],
+            (row['detector'] or '-')[:20],
+            (row['ident'] or '-')[:22],
+            (row['action'] or '-')[:20],
+            row['status'] or '-',
+            row['trace_id'] or '-'))
 
 
 @cli.command()

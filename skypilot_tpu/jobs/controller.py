@@ -28,6 +28,7 @@ from skypilot_tpu.jobs import scheduler
 from skypilot_tpu.jobs import state as jobs_state
 from skypilot_tpu.utils import chaos
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import remediation
 from skypilot_tpu.utils import resilience
 from skypilot_tpu.utils import tracing
 
@@ -72,6 +73,19 @@ class JobsController:
         # workloads and chaos plans can key on the incarnation.
         self._elastic = fleet.ElasticGang.from_detail(
             record.get('gang_detail'), full_hosts=1)
+        # Anomaly→remediation engine, training side: a step-anatomy
+        # anomaly on THIS job's cluster triggers an on-demand deep
+        # device capture so the evidence is on disk while the
+        # regression is live (the serve controller owns the routing
+        # and drain arms).
+        self.remediator = remediation.RemediationEngine(
+            scope=f'job/{self.job_id}')
+        self.remediator.register(
+            'dispatch_gap_trend', 'capture_profile',
+            self._remediate_dispatch_gap_trend)
+        self.remediator.register(
+            'step_time_regression', 'capture_profile',
+            self._remediate_step_time_regression)
 
     def _heartbeat(self) -> None:
         """Renew this job's liveness lease (reconciler crash-safety:
@@ -83,6 +97,53 @@ class JobsController:
         self.task = self.tasks[task_index]
         self.strategy = recovery_lib.StrategyExecutor.make(
             self.task, self.cluster_name)
+
+    # ---- remediation action arms ----
+
+    def _anomaly_is_ours(self, anomaly: Dict[str, Any]) -> bool:
+        """Whether a finding points at THIS job's cluster. A real
+        finding's ident is its metric's canonical label string; a
+        forced (chaos) finding has no labels and every controller may
+        claim it under its own scope."""
+        if anomaly['ident'] == 'forced':
+            return True
+        labels = dict(
+            part.split('=', 1) for part in anomaly['ident'].split(',')
+            if '=' in part)
+        return labels.get('cluster') == self.cluster_name
+
+    def _capture_profile(self, anomaly: Dict[str, Any]
+                         ) -> Optional[Dict[str, Any]]:
+        if not self._anomaly_is_ours(anomaly):
+            return None
+        captured = False
+        try:
+            from skypilot_tpu import core
+            core.profile_capture(self.cluster_name)
+            captured = True
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'profile capture failed: {e}')
+        return {'cluster': self.cluster_name,
+                'profile_captured': captured}
+
+    def _remediate_dispatch_gap_trend(
+            self, anomaly: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Dispatch-gap trend → deep device capture on the affected
+        cluster (host-bound evidence while the trend is live)."""
+        chaos.inject(remediation.APPLY_CHAOS_POINT,
+                     detector=anomaly['detector'],
+                     action='capture_profile')
+        return self._capture_profile(anomaly)
+
+    def _remediate_step_time_regression(
+            self, anomaly: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Step-time regression → deep device capture on the affected
+        cluster (compile storms / slow collectives show in the
+        anatomy)."""
+        chaos.inject(remediation.APPLY_CHAOS_POINT,
+                     detector=anomaly['detector'],
+                     action='capture_profile')
+        return self._capture_profile(anomaly)
 
     # ---- helpers ----
 
@@ -474,6 +535,10 @@ class JobsController:
             resilience.sleep(POLL_INTERVAL_S)
             self._heartbeat()
             self._maybe_record_goodput()
+            # Remediation pass: journalled metric anomalies on this
+            # job's cluster trigger their registered arms. Never
+            # raises.
+            remediation.maybe_tick(self.remediator)
             # Crash drill: a {"signal": "SIGKILL"} rule here IS the
             # kill -9 of a live controller; keyed on the respawn
             # generation so the reconciler-respawned controller

@@ -1,5 +1,12 @@
 """Autoscalers (twin of sky/serve/autoscalers.py: Autoscaler:116,
-RequestRateAutoscaler:441, hysteresis :357)."""
+RequestRateAutoscaler:441, hysteresis :357).
+
+:class:`BurnRateAutoscaler` closes the SLO loop: instead of scaling on
+raw request counts it consumes the SLO monitor's multi-window burn
+rates (serve/slo.py) — scale out when the FAST window says the error
+budget is being spent faster than it accrues, scale in only on a
+sustained budget surplus across EVERY window.
+"""
 from __future__ import annotations
 
 import collections
@@ -7,7 +14,7 @@ import dataclasses
 import math
 import threading
 import time
-from typing import Deque, Optional
+from typing import Any, Deque, Dict, Optional
 
 from skypilot_tpu.serve import service_spec as spec_lib
 
@@ -141,7 +148,129 @@ class RequestRateAutoscaler(Autoscaler):
         return AutoscalerDecision(self.target_num_replicas)
 
 
+class BurnRateAutoscaler(Autoscaler):
+    """Multi-window SLO-burn-driven scaling.
+
+    The controller feeds each SLO evaluation's burns
+    (``collect_burn_info``, shape ``{window: {objective: burn}}``
+    from serve/slo.py). Scale OUT one step when the FAST (shortest)
+    window's worst burn reaches UPSCALE_BURN — the budget is being
+    spent faster than it accrues and waiting for the slow window to
+    agree just spends more of it. Scale IN one step only when EVERY
+    window's worst burn has stayed at or under DOWNSCALE_SURPLUS for
+    the spec's downscale delay — a sustained budget surplus, so a
+    momentary lull can't shed the capacity a breach just proved
+    necessary.
+
+    Every decision — including a scale-out SUPPRESSED by the cooldown
+    — is journalled as a scored fleet decision
+    (``fleet_decisions`` kind ``serve.burn_scale``, score = the burn
+    that drove it), so an incident review can see what the autoscaler
+    saw and why it held.
+
+    ``request_fastpath`` is the remediation engine's hook (burn-rate
+    acceleration anomaly): the next evaluation bypasses the upscale
+    cooldown once.
+    """
+
+    UPSCALE_BURN = 1.0
+    DOWNSCALE_SURPLUS = 0.5
+    UPSCALE_COOLDOWN_S = 30.0
+
+    def __init__(self, spec: spec_lib.SkyServiceSpec) -> None:
+        super().__init__(spec)
+        # Set by the controller (specs don't know their service name);
+        # journalled decisions carry it as the cluster column.
+        self.service_name: Optional[str] = None
+        self._burns: Optional[Dict[str, Dict[str, Any]]] = None
+        self._last_upscale = 0.0
+        self._surplus_since: Optional[float] = None
+        self._fastpath = False
+
+    def collect_burn_info(self, burns: Optional[
+            Dict[str, Dict[str, Any]]]) -> None:
+        if burns:
+            self._burns = burns
+
+    def request_fastpath(self) -> None:
+        self._fastpath = True
+
+    @staticmethod
+    def _worst(per_objective: Dict[str, Any]) -> Optional[float]:
+        burns = [float(b) for b in per_objective.values()
+                 if b is not None]
+        return max(burns) if burns else None
+
+    def _digest(self) -> 'tuple[Optional[float], Optional[float]]':
+        """(fast-window worst burn, worst burn across ALL windows).
+        None when no burn data exists yet (no declared objective got
+        enough traffic)."""
+        if not self._burns:
+            return None, None
+        try:
+            by_window = sorted(self._burns.items(),
+                               key=lambda kv: float(kv[0]))
+        except ValueError:
+            return None, None
+        worsts = [self._worst(per) for _, per in by_window]
+        known = [w for w in worsts if w is not None]
+        return worsts[0], (max(known) if known else None)
+
+    def _journal(self, decision: str, score: Optional[float],
+                 detail: Dict[str, Any]) -> None:
+        from skypilot_tpu.jobs import fleet
+        fleet.record_decision(
+            kind='serve.burn_scale', cluster=self.service_name,
+            score=score,
+            detail={'decision': decision,
+                    'target': self.target_num_replicas, **detail})
+
+    def evaluate(self, num_ready_replicas: int) -> AutoscalerDecision:
+        spec = self.spec
+        now = time.time()
+        fast_burn, worst_burn = self._digest()
+        max_replicas = spec.max_replicas or self.target_num_replicas
+        fastpath, self._fastpath = self._fastpath, False
+        if fast_burn is not None and fast_burn >= self.UPSCALE_BURN:
+            self._surplus_since = None
+            if self.target_num_replicas >= max_replicas:
+                pass   # pinned at max: nothing to journal every tick
+            elif fastpath or \
+                    now - self._last_upscale >= self.UPSCALE_COOLDOWN_S:
+                self.target_num_replicas += 1
+                self._last_upscale = now
+                self._journal('scale_out', fast_burn,
+                              {'fast_burn': fast_burn,
+                               'fastpath': fastpath})
+            else:
+                # The cooldown held a wanted scale-out: journal it
+                # scored, so the suppression is reviewable.
+                self._journal(
+                    'cooldown_hold', fast_burn,
+                    {'fast_burn': fast_burn,
+                     'cooldown_remaining_s': round(
+                         self.UPSCALE_COOLDOWN_S -
+                         (now - self._last_upscale), 3)})
+        elif worst_burn is not None and \
+                worst_burn <= self.DOWNSCALE_SURPLUS:
+            if self.target_num_replicas <= spec.min_replicas:
+                self._surplus_since = None
+            elif self._surplus_since is None:
+                self._surplus_since = now
+            elif now - self._surplus_since >= \
+                    spec.downscale_delay_seconds:
+                self.target_num_replicas -= 1
+                self._surplus_since = None
+                self._journal('scale_in', worst_burn,
+                              {'worst_burn': worst_burn})
+        else:
+            self._surplus_since = None
+        return AutoscalerDecision(self.target_num_replicas)
+
+
 def make_autoscaler(spec: spec_lib.SkyServiceSpec) -> Autoscaler:
+    if spec.autoscaler == 'burn_rate':
+        return BurnRateAutoscaler(spec)
     if spec.autoscaling_enabled:
         return RequestRateAutoscaler(spec)
     return FixedReplicaAutoscaler(spec)

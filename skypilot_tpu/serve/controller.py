@@ -3,6 +3,14 @@
 Twin of sky/serve/service.py:155 (_start forks controller + LB) and
 sky/serve/controller.py:36 (autoscaler loop :65). Run as
 ``python -m skypilot_tpu.serve.controller <service_name>``.
+
+The tick also hosts the serving side of the anomaly→remediation
+engine (utils/remediation.py): journalled metric anomalies bind to
+graded actions — dispatch-gap trend deprioritizes the replica in
+routing and captures a device profile, heartbeat-age drift starts a
+pre-emptive graceful drain (the scale loop launches the replacement),
+burn-rate acceleration fast-paths the burn autoscaler past its
+cooldown.
 """
 from __future__ import annotations
 
@@ -10,6 +18,7 @@ import os
 import sys
 import threading
 import time
+from typing import Any, Dict, Optional, Tuple
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu import state as global_state
@@ -20,6 +29,8 @@ from skypilot_tpu.serve import replica_managers
 from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.serve import slo as slo_lib
 from skypilot_tpu.serve import state as serve_state
+from skypilot_tpu.utils import chaos
+from skypilot_tpu.utils import remediation
 
 logger = sky_logging.init_logger(__name__)
 
@@ -57,8 +68,32 @@ class SkyServeController:
             record_source=self.load_balancer.request_log.records,
             inflight_source=self.load_balancer.replica_stats
             .inflight_by_replica)
+        self._wire_autoscaler()
+        # Anomaly→remediation engine: detector → graded action. Each
+        # arm is a named method carrying a `remediation.apply` chaos
+        # point (chaos-coverage lint), idempotent and flap-suppressed
+        # by the engine itself.
+        self.remediator = remediation.RemediationEngine(
+            scope=f'service/{service_name}')
+        self.remediator.register(
+            'dispatch_gap_trend', 'deprioritize_replica',
+            self._remediate_dispatch_gap_trend,
+            resolver=self._undeprioritize)
+        self.remediator.register(
+            'heartbeat_age_drift', 'drain_replica',
+            self._remediate_heartbeat_age_drift)
+        self.remediator.register(
+            'burn_rate_accel', 'autoscale_fastpath',
+            self._remediate_burn_rate_accel)
         self._stop = threading.Event()
         self._respawn_budget_cleared = False
+
+    def _wire_autoscaler(self) -> None:
+        # Burn autoscalers journal scored decisions under the service
+        # name; specs don't know it, so the controller injects it.
+        if isinstance(self.autoscaler,
+                      autoscalers_lib.BurnRateAutoscaler):
+            self.autoscaler.service_name = self.service_name
 
     def run(self) -> None:
         lb_port = serve_state.get_service(self.service_name)['lb_port']
@@ -107,6 +142,7 @@ class SkyServeController:
         new_autoscaler = autoscalers_lib.make_autoscaler(self.spec)
         new_autoscaler.inherit_state(self.autoscaler)
         self.autoscaler = new_autoscaler
+        self._wire_autoscaler()
         # The update may change the LB policy. Swap only on an actual
         # change — rebuilding needlessly would zero LeastLoad's
         # in-flight counters mid-traffic. Seed the new policy with the
@@ -126,6 +162,121 @@ class SkyServeController:
         self.slo_monitor.update_slo(self.spec.slo)
         logger.info(f'Service {self.service_name}: rolling update to '
                     f'v{self.version}.')
+
+    def _resolve_replica(self, anomaly: Dict[str, Any]
+                         ) -> Tuple[Optional[Dict[str, Any]],
+                                    Optional[str]]:
+        """(replica record, endpoint) an anomaly points at.
+
+        A real finding's ident is its metric's canonical label string
+        (``cluster=...,rank=...``) — match on the cluster label. A
+        forced (chaos-injected) finding carries no labels, so fall back
+        to the worst replica the routing telemetry can name: highest
+        rolling p99 TTFT, ties to highest error rate.
+        """
+        replicas = [r for r in self.replica_manager.replicas()
+                    if r['status'] == serve_state.ReplicaStatus.READY
+                    and not r['draining']]
+        labels = dict(
+            part.split('=', 1) for part in anomaly['ident'].split(',')
+            if '=' in part)
+        cluster = labels.get('cluster')
+        if cluster is not None:
+            for r in replicas:
+                if r['cluster_name'] == cluster:
+                    return r, r['endpoint']
+        snap = self.load_balancer.replica_stats.snapshot()
+        scored = [
+            (s['ttft_p99_ms'], s.get('error_rate') or 0.0, endpoint)
+            for endpoint, s in snap.items()
+            if s.get('ttft_p99_ms') is not None]
+        for _, _, endpoint in sorted(scored, reverse=True):
+            for r in replicas:
+                if r['endpoint'] == endpoint:
+                    return r, endpoint
+        return None, None
+
+    def _remediate_dispatch_gap_trend(
+            self, anomaly: Dict[str, Any]
+    ) -> Optional[Dict[str, Any]]:
+        """Dispatch-gap trend → capture a device profile on the
+        replica's cluster + deprioritize it in routing (weight capped
+        at the policy floor until the anomaly clears)."""
+        chaos.inject(remediation.APPLY_CHAOS_POINT,
+                     detector=anomaly['detector'],
+                     action='deprioritize_replica')
+        record, endpoint = self._resolve_replica(anomaly)
+        if endpoint is None:
+            return None   # nothing serving to act on; retry next tick
+        policy = self.load_balancer.policy
+        if hasattr(policy, 'deprioritize'):
+            # Cap at the cooldown so a dead engine can't pin the
+            # weight down forever; the resolver lifts it sooner.
+            policy.deprioritize(endpoint,
+                                duration_s=self.remediator.cooldown)
+        detail: Dict[str, Any] = {'endpoint': endpoint}
+        profile_captured = False
+        if record is not None:
+            detail['replica_id'] = record['replica_id']
+            detail['cluster'] = record['cluster_name']
+            try:
+                from skypilot_tpu import core
+                core.profile_capture(record['cluster_name'])
+                profile_captured = True
+            except Exception as e:  # pylint: disable=broad-except
+                logger.debug(f'profile capture failed: {e}')
+        detail['profile_captured'] = profile_captured
+        return detail
+
+    def _undeprioritize(self, meta: Dict[str, Any]) -> None:
+        """Resolver: restore the replica's routing share when the
+        dispatch-gap anomaly clears."""
+        endpoint = (meta.get('detail') or {}).get('endpoint')
+        policy = self.load_balancer.policy
+        if endpoint and hasattr(policy, 'undeprioritize'):
+            policy.undeprioritize(endpoint)
+
+    def _remediate_heartbeat_age_drift(
+            self, anomaly: Dict[str, Any]
+    ) -> Optional[Dict[str, Any]]:
+        """Heartbeat-age drift → pre-emptive graceful drain: the
+        replica stops admitting, finishes inflight under the deadline,
+        and the scale loop launches its replacement (draining replicas
+        don't count toward the target)."""
+        chaos.inject(remediation.APPLY_CHAOS_POINT,
+                     detector=anomaly['detector'],
+                     action='drain_replica')
+        record, endpoint = self._resolve_replica(anomaly)
+        if record is None:
+            return None
+        healthy = [r for r in self.replica_manager.replicas()
+                   if r['status'] == serve_state.ReplicaStatus.READY
+                   and not r['draining']]
+        if len(healthy) <= 1:
+            # Never drain the fleet dark on a telemetry hunch; wait
+            # for the replacement capacity a scale-out brings.
+            return None
+        drained = self.replica_manager.drain_replica(
+            record['replica_id'], reason='heartbeat_age_drift',
+            detector=anomaly['detector'], ident=anomaly['ident'])
+        if not drained:
+            return None
+        return {'replica_id': record['replica_id'],
+                'cluster': record['cluster_name'],
+                'endpoint': endpoint}
+
+    def _remediate_burn_rate_accel(
+            self, anomaly: Dict[str, Any]
+    ) -> Optional[Dict[str, Any]]:
+        """Burn-rate acceleration → let the burn autoscaler's next
+        evaluation bypass its upscale cooldown once."""
+        chaos.inject(remediation.APPLY_CHAOS_POINT,
+                     detector=anomaly['detector'],
+                     action='autoscale_fastpath')
+        if not hasattr(self.autoscaler, 'request_fastpath'):
+            return None   # not a burn autoscaler: nothing to fast-path
+        self.autoscaler.request_fastpath()
+        return {'target_before': self.autoscaler.target_num_replicas}
 
     def _apply_scale(self, target: int) -> None:
         """Scale the fleet to `target`, splitting spot vs on-demand when
@@ -172,12 +323,24 @@ class SkyServeController:
         # the whole window.
         self.load_balancer.set_ready_replicas(
             manager.serving_endpoints(self.update_mode,
-                                      decision.target_num_replicas))
+                                      decision.target_num_replicas),
+            draining=manager.draining_endpoints())
         manager.reconcile_versions(decision.target_num_replicas)
+        # Finish graceful drains whose inflight emptied (or whose
+        # deadline passed) — the LB's own counters say when.
+        manager.tick_drains(
+            self.load_balancer.replica_stats.inflight_by_replica())
         # SLO evaluation rides the tick but rate-limits itself to the
         # scrape interval; never raises (the scale loop must survive
-        # a torn scrape or a locked state DB).
-        self.slo_monitor.maybe_tick(manager.replicas())
+        # a torn scrape or a locked state DB). Each evaluation's burn
+        # rates feed the burn autoscaler's next decision.
+        service_row = self.slo_monitor.maybe_tick(manager.replicas())
+        if service_row and hasattr(self.autoscaler,
+                                   'collect_burn_info'):
+            self.autoscaler.collect_burn_info(service_row.get('burns'))
+        # Remediation engine pass: bind journalled anomalies to the
+        # graded actions registered above. Never raises.
+        remediation.maybe_tick(self.remediator)
         if ready > 0:
             serve_state.set_service_status(
                 self.service_name, serve_state.ServiceStatus.READY)

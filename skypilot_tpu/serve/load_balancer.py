@@ -43,6 +43,11 @@ _HOP_HEADERS = {'connection', 'keep-alive', 'transfer-encoding',
 _RING_ENV = 'XSKY_LB_RING_SIZE'
 _RECORDS_ENV = 'XSKY_LB_RECORDS'
 
+# Retry-After hint on a 503 answered because the only routable
+# capacity is draining: drains finish within the drain deadline, but
+# the NEXT controller tick usually restores a serving replica sooner.
+_DRAIN_RETRY_AFTER_S = os.environ.get('XSKY_LB_RETRY_AFTER_S', '2')
+
 _TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
                  5.0, 10.0, float('inf'))
 _E2E_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
@@ -181,11 +186,52 @@ class SkyServeLoadBalancer:
         # Routing-signal handoff: policies read rolling stats from
         # their .stats attribute (see load_balancing_policies.py).
         self.policy.stats = self.replica_stats
+        # Endpoints mid-drain: never relayed to (503 + Retry-After),
+        # re-read on every proxy attempt so a drain starting during a
+        # retry loop cannot route back to the draining target.
+        self._draining: frozenset = frozenset()
 
-    def set_ready_replicas(self, endpoints: List[str]) -> None:
+    def set_ready_replicas(self, endpoints: List[str],
+                           draining: Optional[List[str]] = None
+                           ) -> None:
+        self._draining = frozenset(draining or ())
         self.policy.set_ready_replicas(endpoints)
-        if self.records_enabled:
-            self.replica_stats.prune(endpoints)
+        # Prune stats for replicas that left the READY set — ALWAYS,
+        # not only when record-keeping is on: stale replica ids
+        # otherwise accumulate across recoveries and skew any policy
+        # that iterates all tracked replicas. Draining replicas keep
+        # their windows (inflight requests are still finishing and
+        # tick_drains reads their in-flight counts) until they leave
+        # the draining set too.
+        self.replica_stats.prune(
+            list(endpoints) + list(self._draining))
+
+    def _select_serving_replica(self) -> Tuple[Optional[str], bool]:
+        """Pick a replica, refusing draining targets. The draining set
+        is re-read per call (and the policy's pick re-resolved), so a
+        drain that lands mid-retry cannot route back to the draining
+        replica. Returns (replica, only_draining_capacity)."""
+        refused = []
+        try:
+            draining = self._draining
+            for _ in range(len(draining) + 1):
+                replica = self.policy.select_replica()
+                if replica is None:
+                    return None, bool(draining)
+                if replica not in draining:
+                    return replica, False
+                # The policy's ready set is a tick behind the drain:
+                # re-resolve against the fresh set. The refused pick's
+                # in-flight accounting is HELD until the loop ends, so
+                # a load-aware policy resolves to a different replica
+                # instead of re-picking this one (equal loads tie
+                # toward the same min).
+                refused.append(replica)
+                draining = self._draining
+            return None, True
+        finally:
+            for replica in refused:
+                self.policy.request_done(replica)
 
     def _observe(self, replica: str, ok: bool,
                  ttft_s: Optional[float] = None,
@@ -211,8 +257,19 @@ class SkyServeLoadBalancer:
             tried += 1
             if rec is not None:
                 rec['retries'] = tried - 1
-            replica = self.policy.select_replica()
+            replica, only_draining = self._select_serving_replica()
             if replica is None:
+                if only_draining:
+                    # Capacity exists but every routable replica is
+                    # draining: shed with an explicit retry hint
+                    # instead of relaying to a replica that stopped
+                    # admitting.
+                    if rec is not None:
+                        rec['outcome'] = 'draining'
+                    return (503,
+                            b'{"error": "all replicas draining"}',
+                            [('Retry-After', _DRAIN_RETRY_AFTER_S)],
+                            lambda: None)
                 if rec is not None:
                     rec['outcome'] = 'no_replica'
                 return (503, b'{"error": "no ready replicas"}', [],

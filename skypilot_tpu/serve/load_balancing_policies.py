@@ -3,13 +3,13 @@ sky/serve/load_balancing_policies.py).
 
 :class:`ReplicaStatsTracker` lives here (not in the load balancer) on
 purpose: rolling TTFT/error/inflight per replica is routing signal —
-the telemetry-routing policy of ROADMAP "Production serve data plane"
-will read it from ``self.stats`` to pick replicas, the way LeastLoad
-reads its in-flight counts today.
+:class:`TelemetryRoutedPolicy` reads it from ``self.stats`` to weight
+replicas, the way LeastLoad reads its in-flight counts.
 """
 from __future__ import annotations
 
 import collections
+import random
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -170,9 +170,147 @@ class LeastLoadPolicy(LoadBalancingPolicy):
                 self._load[replica] -= 1
 
 
+class TelemetryRoutedPolicy(LoadBalancingPolicy):
+    """Weighted-random routing on live per-replica telemetry.
+
+    Every replica carries a routing weight in [FLOOR, 1.0]. A periodic
+    reweight (at most every REWEIGHT_INTERVAL_S) folds the stats
+    tracker's rolling signals into a target weight — p99 TTFT relative
+    to the fleet median, in-flight depth relative to the least-loaded
+    replica, and recent error rate — and the applied weight moves
+    toward the target by exponential smoothing (ALPHA). That smoothing
+    IS the hysteresis: one slow sample cannot swing routing, and a
+    recovered replica earns its share back over a few reweights
+    instead of instantly.
+
+    The FLOOR is the never-starve guarantee: a down-weighted replica
+    keeps receiving a trickle of traffic, so its rolling window keeps
+    refreshing and can prove recovery — a zero weight would freeze its
+    stats at their worst and deprioritize it forever.
+
+    ``deprioritize`` is the remediation engine's routing hook: it caps
+    the replica's weight at the FLOOR until the given expiry (or until
+    ``undeprioritize``), independent of what the telemetry says.
+    """
+
+    REWEIGHT_INTERVAL_S = 1.0
+    ALPHA = 0.3
+    FLOOR = 0.05
+
+    def __init__(self) -> None:
+        self._replicas: List[str] = []
+        self._weights: Dict[str, float] = {}
+        self._load: Dict[str, int] = collections.defaultdict(int)
+        self._deprioritized: Dict[str, float] = {}   # replica → until
+        self._last_reweight = 0.0
+        self._lock = threading.Lock()
+
+    def set_ready_replicas(self, replicas: List[str]) -> None:
+        with self._lock:
+            self._replicas = list(replicas)
+            live = set(replicas)
+            for gone in set(self._weights) - live:
+                del self._weights[gone]
+            for gone in set(self._load) - live:
+                del self._load[gone]
+            for gone in set(self._deprioritized) - live:
+                del self._deprioritized[gone]
+            for replica in replicas:
+                # A new replica starts at full share: no telemetry
+                # means no evidence against it.
+                self._weights.setdefault(replica, 1.0)
+
+    def deprioritize(self, replica: str,
+                     duration_s: float = 120.0) -> None:
+        with self._lock:
+            self._deprioritized[replica] = time.time() + duration_s
+
+    def undeprioritize(self, replica: str) -> None:
+        with self._lock:
+            self._deprioritized.pop(replica, None)
+
+    def weights(self) -> Dict[str, float]:
+        """Effective weights (tests + LB /metrics introspection)."""
+        with self._lock:
+            now = time.time()
+            return {r: self._effective_weight(r, now)
+                    for r in self._replicas}
+
+    def _effective_weight(self, replica: str, now: float) -> float:
+        weight = max(self.FLOOR,
+                     min(1.0, self._weights.get(replica, 1.0)))
+        until = self._deprioritized.get(replica)
+        if until is not None and now < until:
+            return self.FLOOR
+        return weight
+
+    def _target_weight(self, replica: str,
+                       snap: Dict[str, Dict[str, Any]],
+                       median_p99: Optional[float],
+                       min_load: int) -> float:
+        stats = snap.get(replica)
+        weight = 1.0
+        if stats is not None:
+            p99 = stats.get('ttft_p99_ms')
+            if p99 and median_p99:
+                # Slower than the fleet median → proportionally less
+                # traffic (a 2x-median replica gets half a share).
+                weight *= min(1.0, median_p99 / p99)
+            error_rate = stats.get('error_rate')
+            if error_rate:
+                weight *= max(0.0, 1.0 - 2.0 * error_rate)
+        # In-flight depth relative to the least-loaded replica: the
+        # policy's own counters, so the signal survives with LB
+        # record-keeping disabled.
+        weight *= (1.0 + min_load) / (1.0 + self._load[replica])
+        return max(self.FLOOR, min(1.0, weight))
+
+    def _maybe_reweight(self, now: float) -> None:
+        if now - self._last_reweight < self.REWEIGHT_INTERVAL_S:
+            return
+        self._last_reweight = now
+        snap = self.stats.snapshot() if self.stats is not None else {}
+        p99s = sorted(
+            s['ttft_p99_ms'] for s in snap.values()
+            if s.get('ttft_p99_ms') is not None)
+        median_p99 = p99s[len(p99s) // 2] if p99s else None
+        min_load = min(
+            (self._load[r] for r in self._replicas), default=0)
+        for replica in self._replicas:
+            target = self._target_weight(replica, snap, median_p99,
+                                         min_load)
+            old = self._weights.get(replica, 1.0)
+            self._weights[replica] = \
+                old + self.ALPHA * (target - old)
+
+    def select_replica(self) -> Optional[str]:
+        with self._lock:
+            if not self._replicas:
+                return None
+            now = time.time()
+            self._maybe_reweight(now)
+            weights = [self._effective_weight(r, now)
+                       for r in self._replicas]
+            point = random.random() * sum(weights)
+            choice = self._replicas[-1]
+            for replica, weight in zip(self._replicas, weights):
+                point -= weight
+                if point <= 0:
+                    choice = replica
+                    break
+            self._load[choice] += 1
+            return choice
+
+    def request_done(self, replica: str) -> None:
+        with self._lock:
+            if self._load.get(replica, 0) > 0:
+                self._load[replica] -= 1
+
+
 POLICIES = {
     'round_robin': RoundRobinPolicy,
     'least_load': LeastLoadPolicy,
+    'telemetry_routed': TelemetryRoutedPolicy,
 }
 
 

@@ -33,6 +33,16 @@ _PROBE_ATTEMPTS = 1 + max(
     0, int(os.environ.get('XSKY_SERVE_PROBE_RETRIES', '1')))
 _PROBE_TIMEOUT_S = float(os.environ.get('XSKY_SERVE_PROBE_TIMEOUT', '5'))
 
+# Graceful drain: a draining replica stops admitting new requests (the
+# LB answers 503+Retry-After) and keeps serving inflight ones until
+# they finish or this deadline passes, then terminates.
+_DRAIN_DEADLINE_S = float(os.environ.get('XSKY_DRAIN_DEADLINE_S', '30'))
+# When a spot replica's preemption is journalled, one READY spot peer
+# sharing its placement (same zone about to be reclaimed) is drained
+# pre-emptively instead of waiting for the hard kill. 0 disables.
+_DRAIN_ON_PREEMPTION = os.environ.get(
+    'XSKY_DRAIN_ON_PREEMPTION', '1') != '0'
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -79,6 +89,20 @@ class ReplicaManager:
         # Preemption-detection timestamps: journal recovery latency when
         # the replacement launches.
         self._preempted_at: Dict[int, float] = {}
+        # Graceful drains in flight: replica_id → {'since', 'deadline',
+        # 'reason', 'detector', 'ident', 'trace_id'}. Drain flags
+        # survive a controller restart via the replicas.draining
+        # column; the in-memory meta re-anchors the deadline at adopt
+        # time (a restarted controller re-grants the full deadline —
+        # cheaper than persisting start timestamps for a rare path).
+        self._draining: Dict[int, Dict[str, Any]] = {}
+        for r in existing:
+            if r.get('draining'):
+                self._draining[r['replica_id']] = {
+                    'since': time.time(),
+                    'deadline': _DRAIN_DEADLINE_S,
+                    'reason': 'adopted at controller restart',
+                    'detector': None, 'ident': None, 'trace_id': None}
 
     # ---- scaling ----
 
@@ -98,11 +122,13 @@ class ReplicaManager:
         return not r['status'].is_terminal()
 
     def active_count(self, version: Optional[int] = None,
-                     spot: Optional[bool] = None) -> int:
+                     spot: Optional[bool] = None,
+                     include_draining: bool = True) -> int:
         return len([
             r for r in self.replicas() if self._is_active(r) and
             (version is None or r['version'] == version) and
-            (spot is None or r['spot'] == spot)
+            (spot is None or r['spot'] == spot) and
+            (include_draining or not r['draining'])
         ])
 
     def ready_spot_count(self) -> int:
@@ -136,7 +162,11 @@ class ReplicaManager:
                 self._scale_kind(target_ondemand, spot=False)
 
     def _scale_kind(self, target: int, spot: Optional[bool]) -> None:
-        current = self.active_count(version=self.version, spot=spot)
+        # Draining replicas are already on the way out: they don't
+        # count toward target (the replacement launches while the
+        # drain finishes) and are never scale-down candidates.
+        current = self.active_count(version=self.version, spot=spot,
+                                    include_draining=False)
         for _ in range(max(0, target - current)):
             self._start_replica(spot=spot is not False)
         if current > target:
@@ -145,6 +175,7 @@ class ReplicaManager:
                 [r for r in self.replicas()
                  if r['version'] == self.version and r['status'] not in
                  (serve_state.ReplicaStatus.SHUTTING_DOWN,) and
+                 not r['draining'] and
                  (spot is None or r['spot'] == spot)],
                 key=lambda r: (
                     r['status'] == serve_state.ReplicaStatus.READY,
@@ -298,6 +329,95 @@ class ReplicaManager:
         for r in self.replicas():
             self.terminate_replica(r['replica_id'])
 
+    # ---- graceful drain ----
+
+    def drain_replica(self, replica_id: int, reason: str = '',
+                      detector: Optional[str] = None,
+                      ident: Optional[str] = None,
+                      trace_id: Optional[str] = None,
+                      deadline_s: Optional[float] = None) -> bool:
+        """Start a graceful drain: stop admitting (the replica leaves
+        serving_endpoints and the LB answers 503+Retry-After for it),
+        finish inflight requests under the deadline, then terminate
+        (tick_drains). Idempotent: returns False if the replica is
+        already draining, terminal, or unknown."""
+        record = next((r for r in self.replicas()
+                       if r['replica_id'] == replica_id), None)
+        if record is None or record['status'].is_terminal() or \
+                record['draining'] or replica_id in self._draining:
+            return False
+        serve_state.set_replica_draining(self.service_name, replica_id,
+                                         True)
+        self._draining[replica_id] = {
+            'since': time.time(),
+            'deadline': (deadline_s if deadline_s is not None
+                         else _DRAIN_DEADLINE_S),
+            'reason': reason, 'detector': detector, 'ident': ident,
+            'trace_id': trace_id}
+        logger.info(f'Replica {replica_id} draining: {reason}')
+        return True
+
+    def draining_endpoints(self) -> List[str]:
+        """Endpoints mid-drain (the LB's 503+Retry-After set)."""
+        return [r['endpoint'] for r in self.replicas()
+                if r['draining'] and r['endpoint']]
+
+    def tick_drains(self, inflight_by_endpoint: Dict[str, int],
+                    now: Optional[float] = None) -> None:
+        """Finish drains whose inflight hit zero or whose deadline
+        passed; journal `replica.drained` with the drain latency."""
+        now = now if now is not None else time.time()
+        by_id = {r['replica_id']: r for r in self.replicas()}
+        for rid in list(self._draining):
+            meta = self._draining[rid]
+            record = by_id.get(rid)
+            if record is None or record['status'].is_terminal():
+                # Left by another path (preempted mid-drain, hard
+                # scale-down): nothing left to terminate gracefully.
+                del self._draining[rid]
+                continue
+            inflight = inflight_by_endpoint.get(
+                record['endpoint'] or '', 0)
+            expired = now - meta['since'] >= meta['deadline']
+            if inflight > 0 and not expired:
+                continue
+            global_state.record_recovery_event(
+                'replica.drained',
+                scope=(f'service/{self.service_name}/replica/{rid}'),
+                cause=meta['reason'] or 'drain',
+                latency_s=now - meta['since'],
+                detail={'expired': expired, 'inflight': inflight},
+                trace_id=meta['trace_id'])
+            del self._draining[rid]
+            self.terminate_replica(rid)
+
+    def _drain_preempted_peer(self, preempted_id: int,
+                              placement: Dict[str, Any]) -> None:
+        """Journalled preemption → pre-emptive peer drain: one READY
+        spot peer sharing the reclaimed placement drains gracefully
+        (and gets replaced) instead of waiting for its own hard kill.
+        Capped at one peer per preemption and only while another
+        non-draining READY replica remains, so a one-zone fleet can
+        never drain itself dark."""
+        if not _DRAIN_ON_PREEMPTION or not placement:
+            return
+        ready = [r for r in self.replicas()
+                 if r['status'] == serve_state.ReplicaStatus.READY and
+                 not r['draining']]
+        peers = [r for r in ready
+                 if r['spot'] and r['replica_id'] != preempted_id and
+                 self._replica_placement.get(
+                     r['replica_id']) == placement]
+        if not peers or len(ready) - 1 < 1:
+            return
+        peer = peers[0]
+        self.drain_replica(
+            peer['replica_id'],
+            reason=(f'placement shared with preempted replica '
+                    f'{preempted_id}'),
+            detector='preemption',
+            ident=f'replica/{peer["replica_id"]}')
+
     # ---- probing ----
 
     def probe_all(self) -> int:
@@ -329,6 +449,22 @@ class ReplicaManager:
                     self.service_name, r['replica_id'],
                     r['cluster_name'],
                     serve_state.ReplicaStatus.PREEMPTED)
+                # A journalled preemption opens a remediation (the
+                # recovery is the action; recover_preempted resolves
+                # it) and may pre-emptively drain one placement peer.
+                from skypilot_tpu.utils import remediation
+                remediation.record_applied(
+                    scope=f'service/{self.service_name}',
+                    detector='preemption',
+                    ident=f'replica/{r["replica_id"]}',
+                    action='recover_replica',
+                    anomaly_scope=(f'service/{self.service_name}/'
+                                   f'replica/{r["replica_id"]}'),
+                    detail={'cluster': r['cluster_name'],
+                            'zone': zone or ''})
+                self._drain_preempted_peer(
+                    r['replica_id'],
+                    self._replica_placement.get(r['replica_id'], {}))
                 continue
             if r['endpoint'] and self._probe(r['endpoint']):
                 serve_state.upsert_replica(self.service_name,
@@ -373,7 +509,7 @@ class ReplicaManager:
     def ready_endpoints(self) -> List[str]:
         return [r['endpoint'] for r in self.replicas()
                 if r['status'] == serve_state.ReplicaStatus.READY and
-                r['endpoint']]
+                r['endpoint'] and not r['draining']]
 
     def serving_endpoints(self, mode: str = 'rolling',
                           target: int = 1) -> List[str]:
@@ -384,12 +520,15 @@ class ReplicaManager:
         the OLD fleet until >= target new-version replicas are READY,
         then cuts over to the new fleet in one step (the old fleet is
         drained by reconcile_versions right after).
+
+        Draining replicas are excluded in both modes: a drain means
+        'stop admitting' the moment it starts.
         """
         if mode != 'blue_green':
             return self.ready_endpoints()
         ready = [r for r in self.replicas()
                  if r['status'] == serve_state.ReplicaStatus.READY and
-                 r['endpoint']]
+                 r['endpoint'] and not r['draining']]
         old_ready = [r for r in ready if r['version'] < self.version]
         new_ready = [r for r in ready if r['version'] == self.version]
         if old_ready and len(new_ready) < max(1, target):
@@ -410,6 +549,9 @@ class ReplicaManager:
             for rid in list(self._replica_placement):
                 if rid not in live_ids:
                     del self._replica_placement[rid]
+            for rid in list(self._draining):
+                if rid not in live_ids:
+                    del self._draining[rid]
             for r in live:
                 if r['status'] == serve_state.ReplicaStatus.PREEMPTED:
                     from skypilot_tpu.utils import tracing
@@ -428,4 +570,11 @@ class ReplicaManager:
                         cause='preemption',
                         latency_s=(time.time() - preempted_at
                                    if preempted_at is not None else None),
+                        detail={'replacement_replica': new_id})
+                    from skypilot_tpu.utils import remediation
+                    remediation.record_resolved(
+                        scope=f'service/{self.service_name}',
+                        detector='preemption',
+                        ident=f'replica/{r["replica_id"]}',
+                        action='recover_replica',
                         detail={'replacement_replica': new_id})

@@ -79,7 +79,8 @@ class SkyServiceSpec:
                  load_balancing_policy: str = 'round_robin',
                  tls_certfile: Optional[str] = None,
                  tls_keyfile: Optional[str] = None,
-                 slo: Optional[SLOSpec] = None) -> None:
+                 slo: Optional[SLOSpec] = None,
+                 autoscaler: Optional[str] = None) -> None:
         if bool(tls_certfile) != bool(tls_keyfile):
             raise ValueError(
                 'tls requires BOTH certfile and keyfile')
@@ -89,6 +90,19 @@ class SkyServiceSpec:
             raise ValueError(
                 'autoscaling (target_qps_per_replica) requires '
                 'max_replicas')
+        if autoscaler is not None and autoscaler not in (
+                'request_rate', 'burn_rate'):
+            raise ValueError(
+                f'Unknown autoscaler {autoscaler!r}; expected '
+                "'request_rate' or 'burn_rate'.")
+        if autoscaler == 'burn_rate':
+            if slo is None:
+                raise ValueError(
+                    'autoscaler: burn_rate requires an slo: section '
+                    '(burn rates are computed per declared objective)')
+            if max_replicas is None:
+                raise ValueError(
+                    'autoscaler: burn_rate requires max_replicas')
         if base_ondemand_fallback_replicas < 0:
             raise ValueError(
                 'base_ondemand_fallback_replicas must be >= 0')
@@ -122,6 +136,10 @@ class SkyServiceSpec:
         # Declared objectives; None = no burn-rate evaluation (the SLO
         # monitor still records latency digests for `xsky slo`).
         self.slo = slo
+        # Which autoscaler drives target_replicas: None picks by knobs
+        # (target_qps_per_replica → request_rate, else fixed);
+        # 'burn_rate' scales on the SLO monitor's multi-window burn.
+        self.autoscaler = autoscaler
 
     @property
     def tls_enabled(self) -> bool:
@@ -175,6 +193,7 @@ class SkyServiceSpec:
             tls_certfile=tls.get('certfile'),
             tls_keyfile=tls.get('keyfile'),
             slo=slo,
+            autoscaler=policy.get('autoscaler'),
         )
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -195,6 +214,10 @@ class SkyServiceSpec:
             policy['upscale_delay_seconds'] = self.upscale_delay_seconds
             policy['downscale_delay_seconds'] = \
                 self.downscale_delay_seconds
+        if self.autoscaler is not None:
+            policy['autoscaler'] = self.autoscaler
+            policy.setdefault('downscale_delay_seconds',
+                              self.downscale_delay_seconds)
         if self.use_ondemand_fallback:
             policy['use_ondemand_fallback'] = True
         if self.base_ondemand_fallback_replicas:

@@ -317,8 +317,11 @@ def burn_rate(bad: float, total: float,
 # Outcomes that spend the availability error budget. client_gone is the
 # client's own disconnect and spends nothing; no_replica/unreachable
 # ARE unavailability even though no replica ever saw the request.
+# 'draining' (503 + Retry-After while every routable replica drains)
+# is deliberate load-shedding, but the client still got a 503 — it
+# spends budget so a drain storm cannot hide from the SLO.
 BAD_OUTCOMES = frozenset(
-    {'error', 'unreachable', 'no_replica', 'truncated'})
+    {'error', 'unreachable', 'no_replica', 'truncated', 'draining'})
 
 
 def burns_from_records(records: List[Dict[str, Any]],

@@ -98,7 +98,12 @@ def _db() -> sqlite3.Connection:
                           # controller that crashes on its own bug
                           # must not be re-execed every tick forever.
                           ('services',
-                           'controller_respawns INTEGER DEFAULT 0')):
+                           'controller_respawns INTEGER DEFAULT 0'),
+                          # Graceful drain: a draining replica stops
+                          # admitting (LB answers 503+Retry-After for
+                          # it) but keeps serving inflight requests
+                          # until the drain deadline, then terminates.
+                          ('replicas', 'draining INTEGER DEFAULT 0')):
         try:
             conn.execute(f'ALTER TABLE {table} ADD COLUMN {column}')
         except Exception:  # pylint: disable=broad-except
@@ -354,6 +359,20 @@ def upsert_replica(service_name: str, replica_id: int, cluster_name: str,
         conn.close()
 
 
+def set_replica_draining(service_name: str, replica_id: int,
+                         draining: bool = True) -> None:
+    """Flip the replica's drain flag (graceful drain: stop admitting,
+    finish inflight, then terminate). The controller's serving set and
+    the LB's draining set both derive from this column."""
+    with _lock:
+        conn = _db()
+        conn.execute(
+            'UPDATE replicas SET draining=? WHERE service_name=? AND '
+            'replica_id=?', (int(draining), service_name, replica_id))
+        conn.commit()
+        conn.close()
+
+
 def remove_replica(service_name: str, replica_id: int) -> None:
     with _lock:
         conn = _db()
@@ -381,4 +400,6 @@ def get_replicas(service_name: str) -> List[Dict[str, Any]]:
         'version': r[6] or 1,
         'spot': bool(r[7]) if len(r) > 7 and r[7] is not None else True,
         'job_id': r[8] if len(r) > 8 else None,
+        'draining': bool(r[9]) if len(r) > 9 and r[9] is not None
+                    else False,
     } for r in rows]

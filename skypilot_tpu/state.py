@@ -296,6 +296,23 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             ON serve_slo (service);
         CREATE INDEX IF NOT EXISTS idx_serve_slo_latest
             ON serve_slo (service, kind, replica_id, row_id);
+        CREATE TABLE IF NOT EXISTS remediations (
+            row_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            ts REAL,
+            scope TEXT,
+            detector TEXT,
+            ident TEXT,
+            action TEXT,
+            status TEXT,
+            anomaly_scope TEXT,
+            trace_id TEXT,
+            applied_ts REAL,
+            detail TEXT
+        );
+        CREATE INDEX IF NOT EXISTS idx_remediations_scope
+            ON remediations (scope);
+        CREATE INDEX IF NOT EXISTS idx_remediations_latest
+            ON remediations (scope, detector, ident, action, row_id);
         CREATE TABLE IF NOT EXISTS goodput_ledger (
             row_id INTEGER PRIMARY KEY AUTOINCREMENT,
             ts REAL,
@@ -1576,6 +1593,122 @@ def get_serve_slo(service: Optional[str] = None,
             'inflight': inflight,
             'burns': burns,
             'verdict': verdict,
+            'detail': detail,
+        })
+    return out
+
+
+# ---- remediations -----------------------------------------------------------
+
+# Lifecycle rows of the anomaly→remediation engine
+# (skypilot_tpu/utils/remediation.py): one row per state transition of
+# a remediation — 'applied' when an action fires, 'resolved' when the
+# triggering anomaly clears, 'suppressed' when flap suppression dedupes
+# a re-fire inside the cooldown. `xsky remediations` and
+# tools/bench_closedloop.py read from here; the journal carries the
+# trace-linked `remediation.applied`/`remediation.resolved` twins.
+
+# Newest rows kept (pruned lazily). Remediations are rare by design
+# (flap-suppressed, idempotent) — 20k rows keep months of incidents.
+_MAX_REMEDIATIONS = 20000
+_remediation_inserts = 0
+
+_REMEDIATION_COLS = ('ts, scope, detector, ident, action, status, '
+                     'anomaly_scope, trace_id, applied_ts, detail')
+
+
+def record_remediations(rows: List[Dict[str, Any]],
+                        ts: Optional[float] = None) -> None:
+    """Persist remediation transitions in ONE transaction. NEVER
+    raises — the engine rides the serve/jobs controller tick loops
+    (same contract and batched-write pattern as record_serve_slo)."""
+    global _remediation_inserts
+    if not rows:
+        return
+    ts = ts if ts is not None else time.time()
+    try:
+        conn = _get_conn()
+        values = [(r.get('ts', ts), r.get('scope'), r.get('detector'),
+                   r.get('ident'), r.get('action'), r.get('status'),
+                   r.get('anomaly_scope'), r.get('trace_id'),
+                   r.get('applied_ts'),
+                   (json.dumps(r['detail'], default=str)
+                    if r.get('detail') else None))
+                  for r in rows]
+    except Exception:  # pylint: disable=broad-except
+        return
+    try:
+        with _lock:
+            conn.executemany(
+                f'INSERT INTO remediations ({_REMEDIATION_COLS}) '
+                'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)', values)
+            # Prune on the FIRST batch too (short-lived CLI writers
+            # never reach an amortized gate — same rationale as spans).
+            _remediation_inserts += len(rows)
+            if _remediation_inserts == len(rows) or \
+                    _remediation_inserts % 256 < len(rows):
+                conn.execute(
+                    'DELETE FROM remediations WHERE row_id <= '
+                    '(SELECT MAX(row_id) FROM remediations) - ?',
+                    (_MAX_REMEDIATIONS,))
+            conn.commit()
+    except Exception:  # pylint: disable=broad-except
+        try:
+            conn.rollback()
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+def get_remediations(scope: Optional[str] = None,
+                     detector: Optional[str] = None,
+                     status: Optional[str] = None,
+                     latest_only: bool = True,
+                     limit: int = 500,
+                     offset: int = 0) -> List[Dict[str, Any]]:
+    """Remediation rows, newest-first.
+
+    ``latest_only`` returns ONE row per (scope, detector, ident,
+    action) — the current lifecycle state of each distinct remediation
+    (`xsky remediations` renders this); ``latest_only=False`` is the
+    full transition history (`--all`)."""
+    conds, args = [], []
+    if scope is not None:
+        conds.append('scope = ?')
+        args.append(scope)
+    if detector is not None:
+        conds.append('detector = ?')
+        args.append(detector)
+    if status is not None:
+        conds.append('status = ?')
+        args.append(status)
+    query = f'SELECT {_REMEDIATION_COLS} FROM remediations'
+    if latest_only:
+        query += (' WHERE row_id IN (SELECT MAX(row_id) FROM '
+                  'remediations GROUP BY scope, detector, ident, '
+                  'action)')
+        if conds:
+            query += ' AND ' + ' AND '.join(conds)
+    elif conds:
+        query += ' WHERE ' + ' AND '.join(conds)
+    query += ' ORDER BY row_id DESC' + _page_sql(int(limit), offset)
+    rows = _read(query, args)
+    out = []
+    for (ts, row_scope, row_detector, ident, action, row_status,
+         anomaly_scope, trace_id, applied_ts, detail) in rows:
+        try:
+            detail = json.loads(detail) if detail else None
+        except ValueError:
+            detail = None
+        out.append({
+            'ts': ts,
+            'scope': row_scope,
+            'detector': row_detector,
+            'ident': ident,
+            'action': action,
+            'status': row_status,
+            'anomaly_scope': anomaly_scope,
+            'trace_id': trace_id,
+            'applied_ts': applied_ts,
             'detail': detail,
         })
     return out

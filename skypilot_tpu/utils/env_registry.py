@@ -320,6 +320,22 @@ _VARS = [
     EnvVar('XSKY_SLO_BURN_THRESHOLD', '1.0',
            'Burn rate at/above which an objective breaches (1.0 = '
            'budget spent exactly as fast as it accrues)'),
+    # ---- closed-loop serving control ---------------------------------------
+    EnvVar('XSKY_REMEDIATION_ENABLED', '1',
+           'Set to 0 to disable the anomaly→remediation engine '
+           '(detectors still journal; no actions fire)'),
+    EnvVar('XSKY_REMEDIATION_COOLDOWN_S', '120',
+           'Flap-suppression window: an anomaly re-firing within this '
+           'of its last applied action is deduped, not re-actioned'),
+    EnvVar('XSKY_DRAIN_DEADLINE_S', '30',
+           'Graceful replica drain deadline: inflight requests get '
+           'this long to finish before forced termination'),
+    EnvVar('XSKY_DRAIN_ON_PREEMPTION', '1',
+           'Set to 0 to disable the pre-emptive peer drain when a '
+           'spot preemption reclaims a shared placement'),
+    EnvVar('XSKY_LB_RETRY_AFTER_S', '2',
+           'Retry-After hint on the 503 shed when every routable '
+           'replica is draining'),
     # ---- workload telemetry ------------------------------------------------
     EnvVar('XSKY_TELEMETRY', '1',
            'Set to 0 to disable workload telemetry emission entirely'),

@@ -100,6 +100,9 @@ _NAMES = [
             'Metric points recorded by the history recorder tick'),
     ObsName('metric', 'xsky_metrics_anomalies_total',
             'Anomaly-detector entry transitions, labeled by detector'),
+    ObsName('metric', 'xsky_remediations_total',
+            'Remediation-engine transitions '
+            '{detector,action,status}'),
     # ---- metrics: scrape-time gauges (server/metrics.py renders these) -----
     ObsName('metric', 'xsky_http_requests_total',
             'API-server HTTP requests {path,code}'),
@@ -300,6 +303,9 @@ _NAMES = [
             '`anomaly` or `clear`), keyed on detector'),
     ObsName('chaos', 'profiler.dispatch_stall',
             'Inflate a sampled host dispatch gap'),
+    ObsName('chaos', 'remediation.apply',
+            'Fail a remediation action arm before it acts, keyed on '
+            'detector/action'),
     ObsName('chaos', 'serve.probe',
             'Serve controller replica readiness probe'),
     ObsName('chaos', 'telemetry.stall',
@@ -334,6 +340,18 @@ _NAMES = [
             'attached'),
     ObsName('journal', 'replica.relaunched',
             'Serve replica relaunched by the controller'),
+    ObsName('journal', 'replica.drained',
+            'Graceful drain finished (inflight hit zero or deadline '
+            'expired), latency = the drain duration'),
+    ObsName('journal', 'remediation.applied',
+            'Remediation engine applied an action for an active '
+            'anomaly, trace-linked to it'),
+    ObsName('journal', 'remediation.resolved',
+            'The triggering anomaly cleared; latency = '
+            'applied→resolved, same trace as the applied twin'),
+    ObsName('journal', 'remediation.suppressed',
+            'Flap suppression deduped a re-fire inside the cooldown '
+            '(one entry per flap)'),
     ObsName('journal', 'reconcile.controller_respawn',
             'Reconciler respawned a dead jobs controller'),
     ObsName('journal', 'reconcile.service_respawn',

@@ -1,0 +1,321 @@
+"""Anomaly→remediation engine: journalled anomalies become actions.
+
+PR 15's metrics-history detectors end in a journal entry
+(``metrics.anomaly``) — this module is the control side. A
+:class:`RemediationEngine` rides a controller tick (serve AND jobs),
+consumes the in-process active-anomaly set plus its journal rows, and
+binds each detector to a graded action registered by the hosting
+controller (dispatch-gap trend → capture a device profile +
+deprioritize the replica in routing; heartbeat-age drift → pre-emptive
+graceful drain + replacement; burn-rate acceleration → autoscaler
+fast-path).
+
+Contracts:
+
+- **Idempotent**: an anomaly that stays active applies its action
+  once; the key stays "active" until the anomaly clears.
+- **Flap-suppressed**: an anomaly that fires again within
+  ``XSKY_REMEDIATION_COOLDOWN_S`` of its last application is deduped —
+  the suppression itself is recorded (a ``suppressed`` row +
+  ``remediation.suppressed`` journal entry), so the flap is reviewable
+  instead of silently re-actioned.
+- **Trace-linked**: every ``remediation.applied`` /
+  ``remediation.resolved`` journal twin and state row carries the
+  triggering anomaly's trace id (or a fresh one when the anomaly
+  carried none), so ``xsky trace`` walks fault → detection → action →
+  resolution.
+- **Chaos-coverable**: every registered action arm must contain a
+  ``chaos.inject('remediation.apply', ...)`` point (enforced by the
+  chaos-coverage lint rule), so fault plans can fail any action.
+
+State lands in the bounded ``remediations`` table
+(:func:`skypilot_tpu.state.record_remediations`), surfaced by
+``xsky remediations [--json]`` and the ``xsky_remediations_total``
+counter on ``/metrics``.
+
+The module-level entry points (``maybe_tick``, ``record_applied``,
+``record_resolved``) NEVER raise — they ride controller tick loops
+(never-raise lint contract).
+"""
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+APPLY_CHAOS_POINT = 'remediation.apply'
+APPLIED_EVENT = 'remediation.applied'
+RESOLVED_EVENT = 'remediation.resolved'
+SUPPRESSED_EVENT = 'remediation.suppressed'
+
+_COOLDOWN_ENV = 'XSKY_REMEDIATION_COOLDOWN_S'
+_ENABLED_ENV = 'XSKY_REMEDIATION_ENABLED'
+
+# (detector, ident) anomaly key.
+_Key = Tuple[str, str]
+# An action handler receives the anomaly dict ({'detector', 'ident',
+# 'since'}) and returns a detail dict on success, or None when the
+# action is not applicable yet (retried next tick, nothing recorded).
+Handler = Callable[[Dict[str, Any]], Optional[Dict[str, Any]]]
+# An optional resolver undoes the action's standing effect (e.g.
+# un-deprioritize the replica) when the anomaly clears.
+Resolver = Callable[[Dict[str, Any]], None]
+
+
+def cooldown_s() -> float:
+    try:
+        return float(os.environ.get(_COOLDOWN_ENV, '120'))
+    except ValueError:
+        return 120.0
+
+
+def enabled() -> bool:
+    return os.environ.get(_ENABLED_ENV, '1') != '0'
+
+
+def _inc(detector: str, action: str, status: str) -> None:
+    from skypilot_tpu.utils import metrics as metrics_lib
+    metrics_lib.inc_counter(
+        'xsky_remediations_total',
+        'Remediation transitions by detector/action/status.',
+        1.0, detector=detector, action=action, status=status)
+
+
+def _anomaly_trace_id(anomaly_scope: Optional[str]) -> Optional[str]:
+    """The triggering anomaly's journal trace id (newest event on its
+    scope), so the remediation twin joins the same trace."""
+    if not anomaly_scope:
+        return None
+    from skypilot_tpu import state
+    events = state.get_recovery_events(scope=anomaly_scope, limit=1)
+    return events[-1].get('trace_id') if events else None
+
+
+def record_applied(scope: str, detector: str, ident: str, action: str,
+                   anomaly_scope: Optional[str] = None,
+                   trace_id: Optional[str] = None,
+                   detail: Optional[Dict[str, Any]] = None
+                   ) -> Optional[str]:
+    """Record one remediation application (state row + trace-linked
+    journal entry + counter). Returns the linking trace id. NEVER
+    raises — callers are controller tick loops and recovery paths."""
+    try:
+        return _record_applied(scope, detector, ident, action,
+                               anomaly_scope, trace_id, detail)
+    except Exception:  # pylint: disable=broad-except
+        return trace_id
+
+
+def _record_applied(scope: str, detector: str, ident: str, action: str,
+                    anomaly_scope: Optional[str],
+                    trace_id: Optional[str],
+                    detail: Optional[Dict[str, Any]]
+                    ) -> Optional[str]:
+    from skypilot_tpu import state
+    if trace_id is None:
+        trace_id = _anomaly_trace_id(anomaly_scope)
+    if trace_id is None:
+        # The anomaly was journalled outside any trace: mint the
+        # link here so applied/resolved still share one id.
+        trace_id = uuid.uuid4().hex[:16]
+    now = time.time()
+    state.record_remediations([{
+        'scope': scope, 'detector': detector, 'ident': ident,
+        'action': action, 'status': 'applied',
+        'anomaly_scope': anomaly_scope, 'trace_id': trace_id,
+        'applied_ts': now, 'detail': detail,
+    }], ts=now)
+    state.record_recovery_event(
+        APPLIED_EVENT,
+        scope=f'{scope}/remediation/{detector}/{ident}',
+        cause=action,
+        detail={'action': action, 'anomaly_scope': anomaly_scope,
+                **(detail or {})},
+        trace_id=trace_id)
+    _inc(detector, action, 'applied')
+    return trace_id
+
+
+def record_resolved(scope: str, detector: str, ident: str, action: str,
+                    detail: Optional[Dict[str, Any]] = None) -> None:
+    """Close the remediation opened by :func:`record_applied` for the
+    same key: a `resolved` state row plus a journal entry carrying the
+    SAME trace id and the applied→resolved latency. Idempotent (a key
+    whose newest row is not 'applied' is left alone). NEVER raises."""
+    try:
+        _record_resolved(scope, detector, ident, action, detail)
+    except Exception:  # pylint: disable=broad-except
+        pass
+
+
+def _record_resolved(scope: str, detector: str, ident: str,
+                     action: str,
+                     detail: Optional[Dict[str, Any]]) -> None:
+    from skypilot_tpu import state
+    rows = [r for r in state.get_remediations(
+                scope=scope, detector=detector, latest_only=True)
+            if r['ident'] == ident and r['action'] == action]
+    if not rows or rows[0]['status'] != 'applied':
+        return
+    opened = rows[0]
+    now = time.time()
+    state.record_remediations([{
+        'scope': scope, 'detector': detector, 'ident': ident,
+        'action': action, 'status': 'resolved',
+        'anomaly_scope': opened['anomaly_scope'],
+        'trace_id': opened['trace_id'],
+        'applied_ts': opened['applied_ts'], 'detail': detail,
+    }], ts=now)
+    state.record_recovery_event(
+        RESOLVED_EVENT,
+        scope=f'{scope}/remediation/{detector}/{ident}',
+        cause=action,
+        latency_s=(now - opened['applied_ts']
+                   if opened['applied_ts'] else None),
+        detail={'action': action,
+                'anomaly_scope': opened['anomaly_scope'],
+                **(detail or {})},
+        trace_id=opened['trace_id'])
+    _inc(detector, action, 'resolved')
+
+
+class RemediationEngine:
+    """Per-controller engine instance: the hosting controller
+    registers (action_name, handler[, resolver]) per detector and
+    calls :func:`maybe_tick` from its tick loop."""
+
+    def __init__(self, scope: str,
+                 cooldown: Optional[float] = None) -> None:
+        self.scope = scope
+        self._cooldown = cooldown
+        # detector → (action name, handler, resolver or None)
+        self._actions: Dict[
+            str, Tuple[str, Handler, Optional[Resolver]]] = {}
+        # Applied, unresolved remediations: key → meta.
+        self._active: Dict[_Key, Dict[str, Any]] = {}
+        # Flap-suppression memory: key → last application ts. Survives
+        # resolution on purpose — fire/clear/fire inside the cooldown
+        # is exactly the flap being suppressed.
+        self._last_applied: Dict[_Key, float] = {}
+        # Keys whose suppression was already journalled (one dedupe
+        # entry per flap, not one per tick).
+        self._suppressed: set = set()
+
+    @property
+    def cooldown(self) -> float:
+        return self._cooldown if self._cooldown is not None \
+            else cooldown_s()
+
+    def register(self, detector: str, action: str, handler: Handler,
+                 resolver: Optional[Resolver] = None) -> None:
+        self._actions[detector] = (action, handler, resolver)
+
+    def active(self) -> Dict[_Key, Dict[str, Any]]:
+        return dict(self._active)
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """One engine pass (raising variant; maybe_tick wraps it)."""
+        if not enabled():
+            return
+        from skypilot_tpu.utils import metrics_history
+        now = now if now is not None else time.time()
+        anomalies = metrics_history.active_anomalies()
+        for (detector, ident), since in sorted(anomalies.items()):
+            if detector not in self._actions:
+                continue
+            key = (detector, ident)
+            if key in self._active:
+                continue   # idempotent: already applied, unresolved
+            last = self._last_applied.get(key)
+            if last is not None and now - last < self.cooldown:
+                self._suppress(key, now, last)
+                continue
+            self._apply(key, since, now)
+        for key in [k for k in self._active if k not in anomalies]:
+            self._resolve(key, now)
+        self._suppressed &= set(anomalies)
+
+    def _apply(self, key: _Key, since: float, now: float) -> None:
+        detector, ident = key
+        action, handler, _ = self._actions[detector]
+        anomaly = {'detector': detector, 'ident': ident,
+                   'since': since}
+        try:
+            detail = handler(anomaly)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(
+                f'remediation {action} for {detector}/{ident} '
+                f'failed: {e}')
+            return
+        if detail is None:
+            return   # not applicable yet; retried next tick
+        anomaly_scope = f'metrics/{detector}/{ident}'
+        trace_id = record_applied(
+            self.scope, detector, ident, action,
+            anomaly_scope=anomaly_scope, detail=detail)
+        self._active[key] = {'applied_ts': now, 'action': action,
+                             'trace_id': trace_id, 'detail': detail}
+        self._last_applied[key] = now
+        self._suppressed.discard(key)
+
+    def _suppress(self, key: _Key, now: float, last: float) -> None:
+        if key in self._suppressed:
+            return   # one dedupe record per flap
+        self._suppressed.add(key)
+        detector, ident = key
+        action, _, _ = self._actions[detector]
+        try:
+            from skypilot_tpu import state
+            anomaly_scope = f'metrics/{detector}/{ident}'
+            trace_id = _anomaly_trace_id(anomaly_scope) or \
+                uuid.uuid4().hex[:16]
+            detail = {'cooldown_s': self.cooldown,
+                      'last_applied_s_ago': round(now - last, 3)}
+            state.record_remediations([{
+                'scope': self.scope, 'detector': detector,
+                'ident': ident, 'action': action,
+                'status': 'suppressed',
+                'anomaly_scope': anomaly_scope, 'trace_id': trace_id,
+                'applied_ts': last, 'detail': detail,
+            }], ts=now)
+            state.record_recovery_event(
+                SUPPRESSED_EVENT,
+                scope=f'{self.scope}/remediation/{detector}/{ident}',
+                cause=action, detail=detail, trace_id=trace_id)
+            _inc(detector, action, 'suppressed')
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'suppression record failed: {e}')
+
+    def _resolve(self, key: _Key, now: float) -> None:
+        detector, ident = key
+        meta = self._active.pop(key)
+        _, _, resolver = self._actions[detector]
+        if resolver is not None:
+            try:
+                resolver(meta)
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(
+                    f'remediation resolver for {detector}/{ident} '
+                    f'failed: {e}')
+        record_resolved(self.scope, detector, ident, meta['action'],
+                        detail={'anomaly_duration_s': round(
+                            now - meta['applied_ts'], 3)})
+
+
+def maybe_tick(engine: RemediationEngine,
+               now: Optional[float] = None) -> None:
+    """Run one engine pass. NEVER raises — this rides the serve/jobs
+    controller tick loops, which must keep scaling/recovering even
+    when the remediation plane is sick. (Handler/resolver failures
+    are logged inside the tick; a failure of the pass itself is
+    swallowed silently — the fallback arm must be provably
+    non-raising, so it cannot log.)"""
+    try:
+        engine.tick(now)
+    except Exception:  # pylint: disable=broad-except
+        pass

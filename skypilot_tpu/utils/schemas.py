@@ -132,6 +132,9 @@ _REPLICA_POLICY_SCHEMA: Dict[str, Any] = {
         'use_ondemand_fallback': _BOOL,
         'base_ondemand_fallback_replicas': _INT,
         'dynamic_ondemand_fallback': _BOOL,
+        # Which autoscaler drives the target (service_spec.py):
+        # burn_rate scales on SLO burn instead of raw QPS.
+        'autoscaler': {'enum': ['request_rate', 'burn_rate']},
     },
 }
 
@@ -158,7 +161,8 @@ _SERVICE_SCHEMA: Dict[str, Any] = {
         # (not imported here: schemas must stay dependency-free of the
         # serve package; test_serve pins the two lists together).
         'load_balancing_policy': {
-            'enum': ['round_robin', 'least_load']},
+            'enum': ['round_robin', 'least_load',
+                     'telemetry_routed']},
         # TLS termination at the load balancer (service_spec.py tls).
         'tls': {
             'type': 'object',

@@ -1,0 +1,719 @@
+"""Closed-loop serving control tests: the anomaly→remediation engine
+(idempotence, flap suppression, trace-linked applied/resolved twins),
+the bounded remediations table, the telemetry-routed LB policy
+(never-starve floor, deprioritize hook, stats prune), the burn-rate
+autoscaler's journalled decisions + fastpath, graceful replica drains
+(stop admitting → finish inflight → terminate), the LB's
+503+Retry-After shed for draining-only capacity, and the
+bench_closedloop --smoke subprocess gate."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from skypilot_tpu.serve import autoscalers as autoscalers_lib
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.serve.service_spec import SkyServiceSpec, SLOSpec
+from skypilot_tpu.utils import chaos
+from skypilot_tpu.utils import metrics_history
+from skypilot_tpu.utils import remediation
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), '..', '..'))
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+@pytest.fixture
+def tmp_state(monkeypatch, tmp_path):
+    from skypilot_tpu import state
+    monkeypatch.setenv('XSKY_STATE_DB', str(tmp_path / 'state.db'))
+    state.reset_for_test()
+    yield state
+    state.reset_for_test()
+
+
+@pytest.fixture
+def tmp_serve_db(monkeypatch, tmp_path):
+    monkeypatch.setenv('XSKY_SERVE_DB', str(tmp_path / 'serve.db'))
+    yield
+
+
+@pytest.fixture
+def anomalies(monkeypatch):
+    """A mutable dict standing in for metrics_history's active set."""
+    current = {}
+    monkeypatch.setattr(metrics_history, 'active_anomalies',
+                        lambda: dict(current))
+    return current
+
+
+# ---- remediation engine ----------------------------------------------------
+
+
+class TestRemediationEngine:
+
+    def _engine(self, cooldown=60.0, detail=None):
+        calls = []
+
+        def handler(anomaly):
+            calls.append(anomaly)
+            return dict(detail) if detail is not None else {'ok': True}
+
+        engine = remediation.RemediationEngine('service/t',
+                                               cooldown=cooldown)
+        engine.register('det', 'act', handler)
+        return engine, calls
+
+    def test_apply_once_while_active(self, tmp_state, anomalies):
+        engine, calls = self._engine()
+        anomalies[('det', 'all')] = 100.0
+        engine.tick(now=100.0)
+        engine.tick(now=101.0)
+        engine.tick(now=102.0)
+        assert len(calls) == 1, 'active anomaly must apply exactly once'
+        assert calls[0] == {'detector': 'det', 'ident': 'all',
+                            'since': 100.0}
+        assert ('det', 'all') in engine.active()
+        rows = tmp_state.get_remediations(scope='service/t',
+                                          latest_only=False)
+        assert [r['status'] for r in rows] == ['applied']
+
+    def test_resolve_shares_trace_and_calls_resolver(
+            self, tmp_state, anomalies):
+        resolved = []
+        engine = remediation.RemediationEngine('service/t', cooldown=60)
+        engine.register('det', 'act', lambda a: {'ok': True},
+                        resolver=resolved.append)
+        anomalies[('det', 'all')] = 100.0
+        engine.tick(now=100.0)
+        del anomalies[('det', 'all')]
+        engine.tick(now=107.5)
+        assert len(resolved) == 1 and resolved[0]['action'] == 'act'
+        assert engine.active() == {}
+        rows = tmp_state.get_remediations(scope='service/t',
+                                          latest_only=False)
+        by_status = {r['status']: r for r in rows}
+        assert set(by_status) == {'applied', 'resolved'}
+        assert by_status['applied']['trace_id'] == \
+            by_status['resolved']['trace_id']
+        assert by_status['resolved']['detail'][
+            'anomaly_duration_s'] == pytest.approx(7.5)
+        # Journal twins share the trace; resolved carries latency.
+        events = tmp_state.get_recovery_events(
+            scope='service/t/remediation/det/all')
+        kinds = {e['event_type']: e for e in events}
+        assert set(kinds) == {remediation.APPLIED_EVENT,
+                              remediation.RESOLVED_EVENT}
+        assert kinds[remediation.RESOLVED_EVENT]['trace_id'] == \
+            kinds[remediation.APPLIED_EVENT]['trace_id']
+        assert kinds[remediation.RESOLVED_EVENT]['latency_s'] is not None
+
+    def test_handler_none_is_retried_not_recorded(
+            self, tmp_state, anomalies):
+        calls = []
+
+        def handler(anomaly):
+            calls.append(anomaly)
+            return None   # not applicable yet
+
+        engine = remediation.RemediationEngine('service/t', cooldown=60)
+        engine.register('det', 'act', handler)
+        anomalies[('det', 'all')] = 100.0
+        engine.tick(now=100.0)
+        engine.tick(now=101.0)
+        assert len(calls) == 2, 'inapplicable action retries every tick'
+        assert engine.active() == {}
+        assert tmp_state.get_remediations(scope='service/t',
+                                          latest_only=False) == []
+
+    def test_disabled_via_env(self, tmp_state, anomalies, monkeypatch):
+        monkeypatch.setenv('XSKY_REMEDIATION_ENABLED', '0')
+        engine, calls = self._engine()
+        anomalies[('det', 'all')] = 100.0
+        engine.tick(now=100.0)
+        assert calls == [] and engine.active() == {}
+
+    def test_handler_exception_contained(self, tmp_state, anomalies):
+        engine = remediation.RemediationEngine('service/t', cooldown=60)
+        engine.register('det', 'act',
+                        lambda a: (_ for _ in ()).throw(RuntimeError()))
+        anomalies[('det', 'all')] = 100.0
+        remediation.maybe_tick(engine, now=100.0)   # must not raise
+        assert engine.active() == {}
+
+    def test_unregistered_detector_ignored(self, tmp_state, anomalies):
+        engine, calls = self._engine()
+        anomalies[('other', 'all')] = 100.0
+        engine.tick(now=100.0)
+        assert calls == []
+
+    def test_flap_fires_clears_refires_applies_once_and_journals_dedupe(
+            self, tmp_state, anomalies):
+        """The flap-suppression satellite contract: an anomaly that
+        fires, clears, and fires again within the cooldown applies its
+        action exactly ONCE; the dedupe itself is recorded (one
+        'suppressed' row + one remediation.suppressed journal entry,
+        not one per tick)."""
+        engine, calls = self._engine(cooldown=60.0)
+        key = ('det', 'all')
+        anomalies[key] = 100.0
+        engine.tick(now=100.0)          # fire → applied
+        del anomalies[key]
+        engine.tick(now=110.0)          # clear → resolved
+        anomalies[key] = 120.0
+        engine.tick(now=120.0)          # re-fire inside cooldown
+        engine.tick(now=125.0)          # still flapping: no dup record
+        assert len(calls) == 1, \
+            'flap inside cooldown must not re-apply the action'
+        rows = tmp_state.get_remediations(scope='service/t',
+                                          latest_only=False)
+        statuses = sorted(r['status'] for r in rows)
+        assert statuses == ['applied', 'resolved', 'suppressed']
+        suppressed = [r for r in rows if r['status'] == 'suppressed'][0]
+        assert suppressed['detail']['cooldown_s'] == 60.0
+        assert suppressed['applied_ts'] == 100.0
+        events = tmp_state.get_recovery_events(
+            scope='service/t/remediation/det/all',
+            event_type=remediation.SUPPRESSED_EVENT)
+        assert len(events) == 1, 'one dedupe journal entry per flap'
+        # latest_only view shows the suppression as the current state.
+        latest = tmp_state.get_remediations(scope='service/t')
+        assert len(latest) == 1 and latest[0]['status'] == 'suppressed'
+
+    def test_cooldown_expiry_reapplies(self, tmp_state, anomalies):
+        engine, calls = self._engine(cooldown=60.0)
+        key = ('det', 'all')
+        anomalies[key] = 100.0
+        engine.tick(now=100.0)
+        del anomalies[key]
+        engine.tick(now=110.0)
+        anomalies[key] = 120.0
+        engine.tick(now=120.0)          # suppressed
+        engine.tick(now=161.0)          # cooldown expired, still firing
+        assert len(calls) == 2, \
+            'a persistent anomaly re-applies once the cooldown expires'
+        assert key in engine.active()
+
+    def test_cooldown_falls_back_to_env(self, monkeypatch):
+        engine = remediation.RemediationEngine('service/t')
+        monkeypatch.setenv('XSKY_REMEDIATION_COOLDOWN_S', '7.5')
+        assert engine.cooldown == 7.5
+        monkeypatch.setenv('XSKY_REMEDIATION_COOLDOWN_S', 'garbage')
+        assert engine.cooldown == 120.0
+        assert remediation.RemediationEngine('x', cooldown=3).cooldown \
+            == 3
+
+
+class TestRecordEntryPoints:
+
+    def test_applied_inherits_anomaly_trace(self, tmp_state):
+        tmp_state.record_recovery_event(
+            'metrics.anomaly', scope='metrics/det/c=1', cause='det',
+            trace_id='feedbeefdeadc0de')
+        trace = remediation.record_applied(
+            'service/t', 'det', 'c=1', 'act',
+            anomaly_scope='metrics/det/c=1', detail={'k': 'v'})
+        assert trace == 'feedbeefdeadc0de'
+        row = tmp_state.get_remediations(scope='service/t')[0]
+        assert row['trace_id'] == 'feedbeefdeadc0de'
+        assert row['detail'] == {'k': 'v'}
+
+    def test_applied_mints_trace_when_anomaly_has_none(self, tmp_state):
+        trace = remediation.record_applied('service/t', 'det', 'all',
+                                           'act')
+        assert trace and len(trace) == 16
+        row = tmp_state.get_remediations(scope='service/t')[0]
+        assert row['trace_id'] == trace
+
+    def test_resolved_is_idempotent(self, tmp_state):
+        remediation.record_applied('service/t', 'det', 'all', 'act')
+        remediation.record_resolved('service/t', 'det', 'all', 'act')
+        remediation.record_resolved('service/t', 'det', 'all', 'act')
+        rows = tmp_state.get_remediations(scope='service/t',
+                                          latest_only=False)
+        assert [r['status'] for r in rows] == ['resolved', 'applied']
+
+    def test_resolved_without_applied_is_noop(self, tmp_state):
+        remediation.record_resolved('service/t', 'det', 'all', 'act')
+        assert tmp_state.get_remediations(scope='service/t',
+                                          latest_only=False) == []
+
+    def test_never_raise_on_db_failure(self, tmp_state, monkeypatch):
+        # Both entry points must swallow state-plane failures — they
+        # ride controller tick loops (never-raise lint contract).
+        def boom(*args, **kwargs):
+            raise RuntimeError('db down')
+
+        monkeypatch.setattr(tmp_state, 'record_remediations', boom)
+        monkeypatch.setattr(tmp_state, 'get_remediations', boom)
+        remediation.record_applied('s', 'd', 'i', 'a')
+        remediation.record_resolved('s', 'd', 'i', 'a')
+
+
+# ---- remediations table ----------------------------------------------------
+
+
+class TestRemediationsTable:
+
+    def _rows(self, n, **overrides):
+        base = {'scope': 'service/t', 'detector': 'det',
+                'ident': 'all', 'action': 'act', 'status': 'applied',
+                'anomaly_scope': None, 'trace_id': 'tt',
+                'applied_ts': 1.0, 'detail': None}
+        return [{**base, **overrides, 'ident': f'i{i}'}
+                for i in range(n)]
+
+    def test_retention_bound(self, tmp_state, monkeypatch):
+        monkeypatch.setattr(tmp_state, '_MAX_REMEDIATIONS', 10)
+        tmp_state.record_remediations(self._rows(300))
+        rows = tmp_state.get_remediations(latest_only=False, limit=1000)
+        assert len(rows) <= 10
+        # Newest rows survive the prune.
+        assert rows[0]['ident'] == 'i299'
+
+    def test_latest_only_groups_by_lifecycle_key(self, tmp_state):
+        remediation.record_applied('service/t', 'det', 'all', 'act')
+        remediation.record_resolved('service/t', 'det', 'all', 'act')
+        remediation.record_applied('service/t', 'det', 'other', 'act')
+        latest = tmp_state.get_remediations(scope='service/t')
+        assert {(r['ident'], r['status']) for r in latest} == \
+            {('all', 'resolved'), ('other', 'applied')}
+        full = tmp_state.get_remediations(scope='service/t',
+                                          latest_only=False)
+        assert len(full) == 3
+
+    def test_filters(self, tmp_state):
+        remediation.record_applied('service/a', 'd1', 'all', 'act')
+        remediation.record_applied('service/a/b', 'd2', 'all', 'act')
+        # Scope filtering is EXACT — 'service/a' must not leak rows
+        # from 'service/a/b' (two services sharing a prefix).
+        assert [r['detector'] for r in
+                tmp_state.get_remediations(scope='service/a')] == ['d1']
+        assert [r['scope'] for r in
+                tmp_state.get_remediations(detector='d2')] == \
+            ['service/a/b']
+        assert tmp_state.get_remediations(status='resolved') == []
+
+
+# ---- telemetry-routed LB policy --------------------------------------------
+
+
+class TestTelemetryRoutedPolicy:
+
+    def test_deprioritize_caps_at_floor_until_undone(self):
+        policy = lb_policies.TelemetryRoutedPolicy()
+        policy.set_ready_replicas(['a', 'b'])
+        assert policy.weights() == {'a': 1.0, 'b': 1.0}
+        policy.deprioritize('a', duration_s=300.0)
+        assert policy.weights()['a'] == policy.FLOOR
+        assert policy.weights()['b'] == 1.0
+        policy.undeprioritize('a')
+        assert policy.weights()['a'] == 1.0
+
+    def test_deprioritize_expires(self):
+        policy = lb_policies.TelemetryRoutedPolicy()
+        policy.set_ready_replicas(['a'])
+        policy.deprioritize('a', duration_s=-1.0)   # already expired
+        assert policy.weights()['a'] == 1.0
+
+    def test_floor_never_starves(self):
+        policy = lb_policies.TelemetryRoutedPolicy()
+        policy.set_ready_replicas(['a', 'b'])
+        policy.deprioritize('a', duration_s=300.0)
+        picks = {'a': 0, 'b': 0}
+        for _ in range(2000):
+            choice = policy.select_replica()
+            picks[choice] += 1
+            policy.request_done(choice)
+        # The floor keeps a trickle flowing to the deprioritized
+        # replica — enough to refresh its window, far below parity.
+        assert picks['a'] > 0, 'FLOOR must never fully starve'
+        assert picks['a'] < picks['b'] / 2
+
+    def test_ema_downweights_slow_replica(self):
+        policy = lb_policies.TelemetryRoutedPolicy()
+        policy.REWEIGHT_INTERVAL_S = 0.0   # reweight every select
+        tracker = lb_policies.ReplicaStatsTracker()
+        policy.stats = tracker
+        # Three replicas: the fleet median p99 is a FAST one, so the
+        # slow outlier earns a proportionally smaller share.
+        policy.set_ready_replicas(['slow', 'fast1', 'fast2'])
+        for _ in range(20):
+            tracker.observe('slow', True, ttft_s=0.5, e2e_s=0.6)
+            tracker.observe('fast1', True, ttft_s=0.01, e2e_s=0.02)
+            tracker.observe('fast2', True, ttft_s=0.01, e2e_s=0.02)
+        first = None
+        for _ in range(30):
+            choice = policy.select_replica()
+            policy.request_done(choice)
+            weights = policy.weights()
+            if first is None:
+                first = weights['slow']
+        assert weights['slow'] < weights['fast1']
+        # Hysteresis: one reweight moved the weight PART way (EMA),
+        # later reweights kept walking it toward the target.
+        assert policy.FLOOR < first < 1.0
+        assert weights['slow'] < first
+
+    def test_set_ready_replicas_prunes_routing_state(self):
+        policy = lb_policies.TelemetryRoutedPolicy()
+        policy.set_ready_replicas(['a', 'b'])
+        policy.deprioritize('b')
+        policy.set_ready_replicas(['a'])
+        assert set(policy.weights()) == {'a'}
+        assert 'b' not in policy._deprioritized
+
+    def test_stats_prune_on_ready_set(self):
+        tracker = lb_policies.ReplicaStatsTracker()
+        for replica in ('a', 'b', 'c'):
+            tracker.observe(replica, True, ttft_s=0.01)
+        tracker.prune(['a'])
+        assert set(tracker.snapshot()) == {'a'}
+
+    def test_lb_prunes_stats_but_keeps_draining_windows(self):
+        lb = lb_lib.SkyServeLoadBalancer(
+            policy=lb_policies.RoundRobinPolicy())
+        for replica in ('a', 'b', 'gone'):
+            lb.replica_stats.observe(replica, True, ttft_s=0.01)
+            lb.replica_stats.request_started(replica)
+        lb.set_ready_replicas(['a'], draining=['b'])
+        snap = set(lb.replica_stats.snapshot())
+        # 'gone' left entirely; 'b' is draining — its in-flight window
+        # must survive (tick_drains reads it) until it leaves the
+        # draining set too.
+        assert snap == {'a', 'b'}
+        lb.set_ready_replicas(['a'], draining=[])
+        assert set(lb.replica_stats.snapshot()) == {'a'}
+
+
+# ---- burn-rate autoscaler --------------------------------------------------
+
+
+def _burn_spec(min_replicas=1, max_replicas=3, **kw):
+    return SkyServiceSpec(min_replicas=min_replicas,
+                          max_replicas=max_replicas,
+                          slo=SLOSpec(availability=0.99),
+                          autoscaler='burn_rate', **kw)
+
+
+class TestBurnRateAutoscaler:
+
+    def _scaler(self, **kw):
+        scaler = autoscalers_lib.BurnRateAutoscaler(_burn_spec(**kw))
+        scaler.service_name = 'svc'
+        return scaler
+
+    def _decisions(self, state):
+        return [d['detail']['decision'] for d in
+                state.get_fleet_decisions(kind='serve.burn_scale')]
+
+    def test_fast_burn_scales_out_one_step(self, tmp_state):
+        scaler = self._scaler()
+        scaler.collect_burn_info({'5': {'availability': 2.0},
+                                  '30': {'availability': 0.2}})
+        assert scaler.evaluate(1).target_num_replicas == 2
+        decisions = tmp_state.get_fleet_decisions(
+            kind='serve.burn_scale')
+        assert decisions[0]['detail']['decision'] == 'scale_out'
+        assert decisions[0]['score'] == pytest.approx(2.0)
+
+    def test_cooldown_holds_and_is_journalled(self, tmp_state):
+        scaler = self._scaler()
+        scaler.collect_burn_info({'5': {'availability': 2.0},
+                                  '30': {'availability': 0.2}})
+        scaler.evaluate(1)
+        assert scaler.evaluate(2).target_num_replicas == 2, \
+            'second breach inside the cooldown must hold'
+        assert self._decisions(tmp_state) == ['cooldown_hold',
+                                              'scale_out']
+
+    def test_fastpath_bypasses_cooldown_once(self, tmp_state):
+        scaler = self._scaler()
+        scaler.collect_burn_info({'5': {'availability': 2.0},
+                                  '30': {'availability': 0.2}})
+        scaler.evaluate(1)
+        scaler.request_fastpath()
+        assert scaler.evaluate(2).target_num_replicas == 3
+        decisions = tmp_state.get_fleet_decisions(
+            kind='serve.burn_scale')
+        assert decisions[0]['detail']['decision'] == 'scale_out'
+        assert decisions[0]['detail']['fastpath'] is True
+        # The bypass is one-shot — pinned at max now, but the flag
+        # must not linger either.
+        assert scaler._fastpath is False
+
+    def test_pinned_at_max_holds_quietly(self, tmp_state):
+        scaler = self._scaler(max_replicas=1)
+        scaler.collect_burn_info({'5': {'availability': 5.0}})
+        assert scaler.evaluate(1).target_num_replicas == 1
+        assert self._decisions(tmp_state) == []
+
+    def test_sustained_surplus_scales_in(self, tmp_state):
+        scaler = self._scaler(downscale_delay_seconds=0.0)
+        scaler.target_num_replicas = 3
+        surplus = {'5': {'availability': 0.1},
+                   '30': {'availability': 0.2}}
+        scaler.collect_burn_info(surplus)
+        assert scaler.evaluate(3).target_num_replicas == 3, \
+            'first surplus observation only starts the clock'
+        assert scaler.evaluate(3).target_num_replicas == 2
+        assert self._decisions(tmp_state)[0] == 'scale_in'
+
+    def test_surplus_must_hold_across_every_window(self, tmp_state):
+        scaler = self._scaler(downscale_delay_seconds=0.0)
+        scaler.target_num_replicas = 3
+        # Fast window calm but the slow window still burning: no shed.
+        scaler.collect_burn_info({'5': {'availability': 0.1},
+                                  '30': {'availability': 0.9}})
+        scaler.evaluate(3)
+        assert scaler.evaluate(3).target_num_replicas == 3
+
+    def test_never_below_min(self, tmp_state):
+        scaler = self._scaler(downscale_delay_seconds=0.0)
+        scaler.collect_burn_info({'5': {'availability': 0.0}})
+        scaler.evaluate(1)
+        assert scaler.evaluate(1).target_num_replicas == 1
+
+    def test_no_burn_data_holds(self, tmp_state):
+        scaler = self._scaler()
+        assert scaler.evaluate(1).target_num_replicas == 1
+
+    def test_make_autoscaler_selection(self):
+        assert isinstance(autoscalers_lib.make_autoscaler(_burn_spec()),
+                          autoscalers_lib.BurnRateAutoscaler)
+        qps = SkyServiceSpec(target_qps_per_replica=1.0, max_replicas=2)
+        assert isinstance(autoscalers_lib.make_autoscaler(qps),
+                          autoscalers_lib.RequestRateAutoscaler)
+        fixed = SkyServiceSpec()
+        assert isinstance(autoscalers_lib.make_autoscaler(fixed),
+                          autoscalers_lib.FixedReplicaAutoscaler)
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match='slo'):
+            SkyServiceSpec(autoscaler='burn_rate', max_replicas=2)
+        with pytest.raises(ValueError, match='max_replicas'):
+            SkyServiceSpec(autoscaler='burn_rate',
+                           slo=SLOSpec(availability=0.99))
+        with pytest.raises(ValueError, match='Unknown autoscaler'):
+            SkyServiceSpec(autoscaler='nope')
+
+    def test_yaml_and_schema_round_trip(self):
+        from skypilot_tpu import task as task_lib
+        config = {
+            'name': 'svc',
+            'run': 'echo hi',
+            'service': {
+                'readiness_probe': '/',
+                'load_balancing_policy': 'telemetry_routed',
+                'replica_policy': {
+                    'min_replicas': 1,
+                    'max_replicas': 2,
+                    'autoscaler': 'burn_rate',
+                },
+                'slo': {'availability': 0.99},
+            },
+        }
+        task = task_lib.Task.from_yaml_config(config)
+        spec = task.service
+        assert spec.autoscaler == 'burn_rate'
+        assert spec.load_balancing_policy == 'telemetry_routed'
+        rebuilt = SkyServiceSpec.from_yaml_config(
+            spec.to_yaml_config())
+        assert rebuilt.autoscaler == 'burn_rate'
+
+
+# ---- graceful replica drain ------------------------------------------------
+
+
+def _drain_manager(name='dr1'):
+    from skypilot_tpu.serve import replica_managers
+    from skypilot_tpu.serve import state as serve_state
+    spec = SkyServiceSpec(min_replicas=2, max_replicas=4)
+    config = {'run': 'echo hi'}
+    serve_state.add_service(name, config, 0)
+    mgr = replica_managers.ReplicaManager(name, config, spec)
+    for rid in (1, 2):
+        serve_state.upsert_replica(
+            name, rid, f'{name}-rep{rid}',
+            serve_state.ReplicaStatus.READY,
+            endpoint=f'127.0.0.1:{1000 + rid}')
+    return mgr, serve_state
+
+
+class TestGracefulDrain:
+
+    def test_drain_stops_admitting_and_is_idempotent(
+            self, tmp_state, tmp_serve_db):
+        mgr, serve_state = _drain_manager()
+        assert sorted(mgr.ready_endpoints()) == ['127.0.0.1:1001',
+                                                 '127.0.0.1:1002']
+        assert mgr.drain_replica(1, reason='test',
+                                 trace_id='abc123') is True
+        assert mgr.drain_replica(1) is False, 'already draining'
+        assert mgr.drain_replica(99) is False, 'unknown replica'
+        # The column round-trips: the LB's draining set and the
+        # serving set both derive from it.
+        rows = {r['replica_id']: r
+                for r in serve_state.get_replicas('dr1')}
+        assert rows[1]['draining'] is True
+        assert mgr.ready_endpoints() == ['127.0.0.1:1002']
+        assert mgr.serving_endpoints() == ['127.0.0.1:1002']
+        assert mgr.draining_endpoints() == ['127.0.0.1:1001']
+
+    def test_drain_finishes_when_inflight_zero(self, tmp_state,
+                                               tmp_serve_db):
+        mgr, serve_state = _drain_manager()
+        mgr.drain_replica(1, reason='heartbeat_age_drift',
+                          detector='heartbeat_age_drift',
+                          ident='cluster=c1', trace_id='abc123')
+        mgr.tick_drains({'127.0.0.1:1001': 2}, now=time.time())
+        assert any(r['replica_id'] == 1
+                   for r in serve_state.get_replicas('dr1')), \
+            'inflight requests must finish before termination'
+        mgr.tick_drains({'127.0.0.1:1001': 0}, now=time.time())
+        assert all(r['replica_id'] != 1
+                   for r in serve_state.get_replicas('dr1'))
+        events = tmp_state.get_recovery_events(
+            scope='service/dr1/replica/1',
+            event_type='replica.drained')
+        assert len(events) == 1
+        assert events[0]['trace_id'] == 'abc123'
+        assert events[0]['detail']['expired'] is False
+        assert events[0]['latency_s'] is not None
+
+    def test_drain_deadline_forces_termination(self, tmp_state,
+                                               tmp_serve_db):
+        mgr, serve_state = _drain_manager()
+        mgr.drain_replica(2, reason='stuck', deadline_s=0.0)
+        mgr.tick_drains({'127.0.0.1:1002': 5}, now=time.time() + 1)
+        assert all(r['replica_id'] != 2
+                   for r in serve_state.get_replicas('dr1'))
+        events = tmp_state.get_recovery_events(
+            scope='service/dr1/replica/2',
+            event_type='replica.drained')
+        assert events[0]['detail']['expired'] is True
+
+    def test_drain_adopted_across_controller_restart(
+            self, tmp_state, tmp_serve_db):
+        from skypilot_tpu.serve import replica_managers
+        mgr, serve_state = _drain_manager()
+        mgr.drain_replica(1, reason='pre-restart')
+        mgr2 = replica_managers.ReplicaManager(
+            'dr1', {'run': 'echo hi'}, mgr.spec)
+        assert mgr2.draining_endpoints() == ['127.0.0.1:1001']
+        mgr2.tick_drains({'127.0.0.1:1001': 0}, now=time.time())
+        assert all(r['replica_id'] != 1
+                   for r in serve_state.get_replicas('dr1'))
+
+    def test_replica_gone_mid_drain_is_dropped(self, tmp_state,
+                                               tmp_serve_db):
+        mgr, serve_state = _drain_manager()
+        mgr.drain_replica(1, reason='test')
+        serve_state.remove_replica('dr1', 1)
+        mgr.tick_drains({}, now=time.time())
+        assert 1 not in mgr._draining
+        assert tmp_state.get_recovery_events(
+            scope='service/dr1/replica/1',
+            event_type='replica.drained') == []
+
+
+# ---- LB shed for draining capacity -----------------------------------------
+
+
+class TestLBDrainingShed:
+
+    def test_all_draining_returns_503_with_retry_after(self):
+        lb = lb_lib.SkyServeLoadBalancer(
+            policy=lb_policies.RoundRobinPolicy())
+        lb.set_ready_replicas(['127.0.0.1:9'],
+                              draining=['127.0.0.1:9'])
+        status, body, headers, finish = lb._proxy('GET', '/', b'', {})
+        finish()
+        assert status == 503
+        assert b'draining' in body
+        assert dict(headers).get('Retry-After') == '2'
+
+    def test_no_replicas_503_has_no_retry_hint(self):
+        lb = lb_lib.SkyServeLoadBalancer(
+            policy=lb_policies.RoundRobinPolicy())
+        lb.set_ready_replicas([])
+        status, body, headers, _ = lb._proxy('GET', '/', b'', {})
+        assert status == 503
+        assert b'no ready replicas' in body
+        assert headers == []
+
+    def test_selection_skips_draining_and_rereleases_pick(self):
+        lb = lb_lib.SkyServeLoadBalancer(
+            policy=lb_policies.LeastLoadPolicy())
+        lb.set_ready_replicas(['a', 'b'], draining=['a'])
+        # LeastLoad picks 'a' first (equal load, min() is stable); the
+        # selector must hold that refused pick's load while it
+        # re-resolves — releasing it early would tie min() right back
+        # to 'a' — then land on 'b' and release 'a'.
+        replica, only_draining = lb._select_serving_replica()
+        assert replica == 'b' and only_draining is False
+        assert lb.policy._load['a'] == 0, \
+            'refused pick must release its in-flight accounting'
+        assert lb.policy._load['b'] == 1
+
+    def test_drain_landing_mid_retry_rereads_set(self):
+        lb = lb_lib.SkyServeLoadBalancer(
+            policy=lb_policies.RoundRobinPolicy())
+        lb.set_ready_replicas(['b', 'a'], draining=['b'])
+        orig_select = lb.policy.select_replica
+
+        def flipping_select():
+            choice = orig_select()
+            if choice == 'b':
+                # The controller drains 'a' while the LB is busy
+                # re-resolving away from 'b': the selector re-reads
+                # the draining set after every refused pick, so 'a'
+                # must be refused too.
+                lb._draining = frozenset(['a', 'b'])
+            return choice
+
+        lb.policy.select_replica = flipping_select
+        replica, only_draining = lb._select_serving_replica()
+        assert replica is None and only_draining is True, \
+            'a drain landing mid-retry must not route to the target'
+
+
+# ---- bench gate ------------------------------------------------------------
+
+
+class TestBenchClosedloopGate:
+    """The closed-loop plane ships with its chaos drill green: the
+    controlled arm holds p99 TTFT through slowdown + preemption +
+    traffic spike, and every injected fault yields a trace-linked
+    remediation that resolves — proven by
+    tools/bench_closedloop.py --smoke in a clean subprocess (same
+    tier-1 wiring as bench_serve_slo)."""
+
+    def test_bench_closedloop_smoke_gate(self):
+        env = dict(os.environ)
+        env.pop('XSKY_CHAOS_PLAN', None)
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, 'tools', 'bench_closedloop.py'),
+             '--smoke'],
+            capture_output=True, text=True, timeout=540, env=env,
+            cwd=REPO_ROOT, check=False)
+        assert proc.returncode == 0, \
+            f'stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-4000:]}'
+        payload = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert payload['pass'] is True
+        assert payload['p99_held']['pass'] is True
+        assert payload['p99_held']['controlled_ms'] < \
+            payload['p99_held']['baseline_ms']
+        assert payload['fault_remediations']['pass'] is True
+        assert payload['cli']['pass'] is True

@@ -1,0 +1,485 @@
+#!/usr/bin/env python3
+"""Closed-loop serving control referee: chaos load drill, controlled
+vs no-control baseline.
+
+Two arms of the SAME fake-cloud serve stack run the SAME fault
+schedule under an **open-loop** load generator (absolute arrival
+schedule — queueing delay counts; Pareto prompt/output lengths):
+
+  * fault 1 — ``lb.proxy`` latency pinned to one replica (the slow
+    replica);
+  * fault 2 — forced metric anomalies (``metrics.detector`` chaos:
+    dispatch-gap trend, burn-rate acceleration, then heartbeat-age
+    drift);
+  * fault 3 — spot preemption of a healthy replica (``fake.preempt``);
+  * fault 4 — a 2x traffic spike for the rest of the drill.
+
+The **baseline** arm is the no-control stack: round-robin routing,
+fixed replicas, remediation engine disabled. The **controlled** arm is
+the closed loop: ``telemetry_routed`` routing, ``burn_rate``
+autoscaling, and the anomaly→remediation engine riding the controller
+tick (deprioritize / graceful drain / autoscaler fast-path).
+
+Exit 0 only if, end to end:
+
+  * the controlled arm's steady-state p99 TTFT (final load block,
+    spike rate, after remediation) beats the baseline's — the SLO held
+    because the loop closed;
+  * EVERY injected fault detector (dispatch_gap_trend,
+    burn_rate_accel, heartbeat_age_drift, preemption) produced a
+    remediation that was applied AND resolved, the applied/resolved
+    pair sharing one non-null trace id with the triggering anomaly;
+  * the remediations are visible via ``xsky remediations --json``.
+
+Prints ONE JSON line; exit 1 on any gate failure. ``--smoke`` is the
+tier-1 subprocess gate (reduced counts, same gates).
+
+Usage:
+    python tools/bench_closedloop.py [--smoke]
+"""
+import argparse
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import textwrap
+import threading
+import time
+import urllib.request
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+_FAULT_DETECTORS = ('dispatch_gap_trend', 'burn_rate_accel',
+                    'heartbeat_age_drift', 'preemption')
+
+# The slow replica's injected upstream latency: far past the 100 ms
+# TTFT target, so routing around it is visible in p99.
+_SLOW_S = 0.25
+
+_REPLICA_SCRIPT = textwrap.dedent('''\
+    import http.server, os, sys, time, urllib.parse
+    sys.path.insert(0, {repo_root!r})
+    from skypilot_tpu.infer import metrics as metrics_lib
+    metrics = metrics_lib.ServeMetrics()
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+        def do_GET(self):
+            if self.path == '/metrics':
+                body = metrics.render().encode()
+            else:
+                q = urllib.parse.urlparse(self.path).query
+                params = dict(urllib.parse.parse_qsl(q))
+                gen = int(params.get('g', 16))
+                body = b'x' * min(65536, gen * 4)
+                metrics.observe('/gen', 'ok',
+                                int(params.get('p', 32)), gen,
+                                ttft_s=0.005,
+                                e2e_s=0.005 + gen * 2e-4,
+                                tpot_s=0.004)
+            self.send_response(200)
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    http.server.ThreadingHTTPServer(
+        ('127.0.0.1', int(os.environ['PORT'])), H).serve_forever()
+''')
+
+_BASELINE_YAML = textwrap.dedent('''\
+    name: {name}
+    resources:
+      accelerators: tpu-v5e-8
+      use_spot: true
+    service:
+      readiness_probe: /
+      replica_policy:
+        min_replicas: 2
+    run: |
+      python {script}
+''')
+
+_CONTROLLED_YAML = textwrap.dedent('''\
+    name: {name}
+    resources:
+      accelerators: tpu-v5e-8
+      use_spot: true
+    service:
+      readiness_probe: /
+      load_balancing_policy: telemetry_routed
+      replica_policy:
+        min_replicas: 2
+        max_replicas: 4
+        autoscaler: burn_rate
+      slo:
+        ttft_p99_ms: 100
+        availability: 0.99
+    run: |
+      python {script}
+''')
+
+
+def _open_loop(lb_port: int, rate_qps: float, duration_s: float,
+               rng: random.Random) -> dict:
+    """Open-loop block: arrivals on an absolute schedule, latency
+    measured from the SCHEDULED arrival (coordinated-omission guard);
+    heavy-tail Pareto prompt/output lengths."""
+    n = int(rate_qps * duration_s)
+    t_start = time.perf_counter() + 0.1
+    schedule = [t_start + i / rate_qps for i in range(n)]
+    latencies = []
+    errors = [0]
+    lock = threading.Lock()
+
+    def fire(at: float) -> None:
+        gen = int(min(2000, rng.paretovariate(1.5) * 16))
+        prompt = int(min(4000, rng.paretovariate(1.2) * 64))
+        try:
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{lb_port}/gen?p={prompt}'
+                    f'&g={gen}', timeout=30) as resp:
+                resp.read()
+            lat = time.perf_counter() - at
+            with lock:
+                latencies.append(lat)
+        except Exception:  # pylint: disable=broad-except
+            with lock:
+                errors[0] += 1
+
+    threads = []
+    for at in schedule:
+        delay = at - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        thread = threading.Thread(target=fire, args=(at,),
+                                  name='xsky-bench-loadgen',
+                                  daemon=True)
+        thread.start()
+        threads.append(thread)
+    for thread in threads:
+        thread.join(timeout=60)
+    latencies.sort()
+
+    def pctl(q: float):
+        if not latencies:
+            return None
+        return round(
+            latencies[min(len(latencies) - 1,
+                          int(q * len(latencies)))] * 1000, 2)
+
+    return {'offered': n, 'completed': len(latencies),
+            'errors': errors[0], 'p50_ms': pctl(0.5),
+            'p99_ms': pctl(0.99)}
+
+
+def _slow_rule(endpoint: str) -> dict:
+    return {'match': {'replica': endpoint}, 'latency_s': _SLOW_S}
+
+
+def _force_rules(detectors) -> list:
+    return [{'match': {'detector': d}, 'force': 'anomaly'}
+            for d in detectors]
+
+
+class _Arm:
+    """One service (controlled or baseline) through the fault
+    schedule. Shares the process-wide state DBs — rows are scoped by
+    service name."""
+
+    def __init__(self, name: str, yaml_tpl: str, script: str,
+                 args) -> None:
+        self.name = name
+        self.scope = f'service/{name}'
+        self.args = args
+        import io
+
+        import yaml
+
+        from skypilot_tpu import task as task_lib
+        from skypilot_tpu.serve import state as serve_state
+        config = yaml.safe_load(io.StringIO(yaml_tpl.format(
+            name=name, script=script)))
+        self.task = task_lib.Task.from_yaml_config(config)
+        import socket
+        with socket.socket() as s:
+            s.bind(('127.0.0.1', 0))
+            self.lb_port = s.getsockname()[1]
+        serve_state.add_service(name, self.task.to_yaml_config(),
+                                self.lb_port)
+        from skypilot_tpu.serve import controller as controller_lib
+        self.controller = controller_lib.SkyServeController(name)
+        self.thread = threading.Thread(
+            target=self.controller.run,
+            name=f'xsky-bench-controller-{name}', daemon=True)
+
+    def start_and_wait_ready(self, min_replicas: int = 2) -> bool:
+        from skypilot_tpu.serve import state as serve_state
+        self.thread.start()
+        deadline = time.time() + 150
+        while time.time() < deadline:
+            record = serve_state.get_service(self.name)
+            if record['status'] == serve_state.ServiceStatus.FAILED:
+                return False
+            ready = self.controller.replica_manager.ready_endpoints()
+            if len(ready) >= min_replicas:
+                return True
+            time.sleep(0.3)
+        return False
+
+    def replica_map(self) -> dict:
+        """replica_id → (cluster_name, endpoint) for READY replicas."""
+        from skypilot_tpu.serve import state as serve_state
+        return {r['replica_id']: (r['cluster_name'], r['endpoint'])
+                for r in serve_state.get_replicas(self.name)
+                if r['status'] == serve_state.ReplicaStatus.READY}
+
+    def stop(self) -> None:
+        from skypilot_tpu.serve import core as serve_core
+        self.controller.stop()
+        self.thread.join(timeout=30)
+        try:
+            serve_core.down(self.name)
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+def _wait(predicate, deadline_s: float, interval: float = 0.3) -> bool:
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _applied(scope: str, detector: str) -> bool:
+    from skypilot_tpu import state
+    return any(r['status'] in ('applied', 'resolved')
+               for r in state.get_remediations(
+                   scope=scope, detector=detector, latest_only=False))
+
+
+def _pair_trace(scope: str, detector: str):
+    """The (applied, resolved) journal/state pair's shared trace id,
+    or None if the pair is incomplete or trace-broken."""
+    from skypilot_tpu import state
+    rows = state.get_remediations(scope=scope, detector=detector,
+                                  latest_only=False)
+    applied = [r for r in rows if r['status'] == 'applied']
+    resolved = [r for r in rows if r['status'] == 'resolved']
+    if not applied or not resolved:
+        return None
+    trace = resolved[0]['trace_id']
+    if not trace or not any(r['trace_id'] == trace for r in applied):
+        return None
+    # The journal twin must carry the SAME trace id on both events.
+    kinds = {e['event_type'] for e in state.get_recovery_events(
+        scope=f'{scope}/remediation/{detector}', limit=200)
+        if e.get('trace_id') == trace}
+    if not {'remediation.applied', 'remediation.resolved'} <= kinds:
+        return None
+    return trace
+
+
+def _run_arm(arm: '_Arm', controlled: bool, args) -> dict:
+    from skypilot_tpu import state
+    from skypilot_tpu.utils import chaos
+    from skypilot_tpu.utils import metrics_history
+
+    result: dict = {'service': arm.name, 'controlled': controlled}
+    os.environ['XSKY_REMEDIATION_ENABLED'] = '1' if controlled else '0'
+
+    detect_stop = threading.Event()
+
+    def detect_loop() -> None:
+        # The metrics recorder's detector pass, at drill cadence.
+        while not detect_stop.is_set():
+            metrics_history.detect_anomalies()
+            detect_stop.wait(0.3)
+
+    detector_thread = threading.Thread(
+        target=detect_loop, name='xsky-bench-detect', daemon=True)
+    if controlled:
+        detector_thread.start()
+
+    try:
+        if not arm.start_and_wait_ready():
+            result['error'] = 'service never reached 2 READY replicas'
+            result['pass'] = False
+            return result
+        replicas = arm.replica_map()
+        rids = sorted(replicas)
+        slow_ep = replicas[rids[0]][1]
+        preempt_cluster = replicas[rids[1]][0]
+        result['slow_replica'] = slow_ep
+        result['preempted_cluster'] = preempt_cluster
+
+        rate = 10.0 if args.smoke else 20.0
+        dur = 5.0 if args.smoke else 8.0
+        rng = random.Random(11)
+
+        # Phase 1: slow replica + (controlled) forced dispatch-gap and
+        # burn-accel anomalies, under normal load.
+        plan = {'points': {'lb.proxy': _slow_rule(slow_ep)}}
+        if controlled:
+            plan['points']['metrics.detector'] = _force_rules(
+                ['dispatch_gap_trend', 'burn_rate_accel'])
+        chaos.load_plan(plan)
+        block1 = threading.Thread(
+            target=lambda: result.update(block1=_open_loop(
+                arm.lb_port, rate, dur, rng)),
+            name='xsky-bench-block1', daemon=True)
+        block1.start()
+        if controlled:
+            result['phase1_applied'] = _wait(
+                lambda: _applied(arm.scope, 'dispatch_gap_trend') and
+                _applied(arm.scope, 'burn_rate_accel'), 30)
+        block1.join(timeout=120)
+
+        # Phase 2: spot preemption of a healthy replica + (controlled)
+        # forced heartbeat drift, under the 2x traffic spike. Loading
+        # the new plan stops forcing phase 1's anomalies — they clear,
+        # and the engine resolves them.
+        plan = {'points': {
+            'lb.proxy': _slow_rule(slow_ep),
+            'fake.preempt': {'match': {'cluster_name': preempt_cluster},
+                             'first_n': 1},
+        }}
+        if controlled:
+            plan['points']['metrics.detector'] = _force_rules(
+                ['heartbeat_age_drift'])
+        chaos.load_plan(plan)
+        block2 = threading.Thread(
+            target=lambda: result.update(block2=_open_loop(
+                arm.lb_port, rate * 2, dur, rng)),
+            name='xsky-bench-block2', daemon=True)
+        block2.start()
+        result['preemption_applied'] = _wait(
+            lambda: _applied(arm.scope, 'preemption'), 40)
+        if controlled:
+            result['phase2_applied'] = _wait(
+                lambda: _applied(arm.scope, 'heartbeat_age_drift'), 30)
+        block2.join(timeout=120)
+
+        # Phase 3: stop forcing anomalies (they clear → resolutions),
+        # keep the slow rule (its replica was drained in the
+        # controlled arm; the baseline still routes to it), wait for
+        # the fleet to re-stabilize, then measure the steady-state
+        # block at spike rate — the held-p99 gate.
+        chaos.load_plan({'points': {'lb.proxy': _slow_rule(slow_ep)}})
+        if controlled:
+            result['drained_slow'] = _wait(
+                lambda: slow_ep not in
+                arm.controller.replica_manager.ready_endpoints(), 30)
+            result['all_resolved'] = _wait(
+                lambda: all(_pair_trace(arm.scope, d) is not None
+                            for d in _FAULT_DETECTORS), 45)
+        result['refleet'] = _wait(
+            lambda: len(arm.controller.replica_manager
+                        .ready_endpoints()) >= 2, 60)
+        result['block3'] = _open_loop(arm.lb_port, rate * 2,
+                                      dur + 1.0, rng)
+        if controlled:
+            result['remediations'] = {
+                d: _pair_trace(arm.scope, d) for d in _FAULT_DETECTORS}
+        return result
+    finally:
+        detect_stop.set()
+        if controlled:
+            detector_thread.join(timeout=5)
+        chaos.clear()
+        # Flush forced-anomaly state so the next arm starts clean.
+        metrics_history.detect_anomalies()
+        arm.stop()
+
+
+def bench(args) -> dict:
+    scratch = tempfile.mkdtemp(prefix='xsky-bench-closedloop-')
+    os.environ['XSKY_STATE_DB'] = os.path.join(scratch, 'state.db')
+    os.environ['XSKY_SERVE_DB'] = os.path.join(scratch, 'serve.db')
+    os.environ['XSKY_FAKE_CLOUD_DIR'] = os.path.join(scratch, 'fake')
+    os.environ['XSKY_SERVE_LOG_DIR'] = os.path.join(scratch, 'logs')
+    os.environ['XSKY_ENABLE_FAKE_CLOUD'] = '1'
+    os.environ['XSKY_SERVE_INTERVAL'] = '0.25'
+    os.environ['XSKY_SLO_SCRAPE_INTERVAL_S'] = '1'
+    os.environ['XSKY_SLO_BURN_WINDOWS'] = '5,30'
+    os.environ['XSKY_DRAIN_DEADLINE_S'] = '5'
+    # Keep the preemption arms symmetric between the two services:
+    # peer drain is covered by unit tests, not this referee.
+    os.environ['XSKY_DRAIN_ON_PREEMPTION'] = '0'
+    # Each fault applies exactly once per arm here; a long cooldown
+    # keeps re-fires out of the drill's bookkeeping.
+    os.environ['XSKY_REMEDIATION_COOLDOWN_S'] = '300'
+
+    from click.testing import CliRunner
+
+    from skypilot_tpu import check as check_lib
+    from skypilot_tpu import state
+    from skypilot_tpu.client import cli as cli_mod
+
+    check_lib.set_enabled_clouds_for_test(['fake'])
+    state.reset_for_test()
+
+    script = os.path.join(scratch, 'replica.py')
+    with open(script, 'w', encoding='utf-8') as f:
+        f.write(_REPLICA_SCRIPT.format(repo_root=_REPO_ROOT))
+
+    result: dict = {}
+    try:
+        baseline_arm = _Arm('clbase', _BASELINE_YAML, script, args)
+        result['baseline'] = _run_arm(baseline_arm, False, args)
+        controlled_arm = _Arm('clctl', _CONTROLLED_YAML, script, args)
+        result['controlled'] = _run_arm(controlled_arm, True, args)
+
+        base3 = (result['baseline'].get('block3') or {})
+        ctl3 = (result['controlled'].get('block3') or {})
+        base_p99 = base3.get('p99_ms')
+        ctl_p99 = ctl3.get('p99_ms')
+        held = (base_p99 is not None and ctl_p99 is not None and
+                ctl_p99 < base_p99)
+        result['p99_held'] = {'baseline_ms': base_p99,
+                              'controlled_ms': ctl_p99, 'pass': held}
+
+        pairs = result['controlled'].get('remediations') or {}
+        traced = {d: bool(pairs.get(d)) for d in _FAULT_DETECTORS}
+        result['fault_remediations'] = {**traced,
+                                        'pass': all(traced.values())}
+
+        cli = CliRunner().invoke(
+            cli_mod.cli,
+            ['remediations', '--scope', 'service/clctl', '--json'])
+        cli_rows = [json.loads(line) for line in
+                    cli.output.strip().splitlines()] \
+            if cli.exit_code == 0 and cli.output.strip() else []
+        cli_detectors = {r['detector'] for r in cli_rows}
+        result['cli'] = {
+            'rows': len(cli_rows),
+            'pass': set(_FAULT_DETECTORS) <= cli_detectors,
+        }
+
+        result['pass'] = (held and result['fault_remediations']['pass']
+                          and result['cli']['pass'])
+        return result
+    finally:
+        check_lib.set_enabled_clouds_for_test(None)
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--smoke', action='store_true',
+                        help='Reduced counts for the tier-1 '
+                             'subprocess gate (same gates).')
+    args = parser.parse_args()
+    out = {'metric': 'closedloop_control', 'smoke': args.smoke}
+    out.update(bench(args))
+    print(json.dumps(out))
+    return 0 if out.get('pass') else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

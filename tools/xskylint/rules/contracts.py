@@ -170,6 +170,17 @@ class ChaosCoverageRule(engine.Rule):
     # fallback arms (corrupt manifest → older copy → next tier) only a
     # fault plan can force — it must carry the ckpt.restore point.
     CKPT_FUNCS = frozenset({'_restore_ladder'})
+    # Remediation action arms (serve/jobs controllers): every
+    # registered anomaly→action handler must carry the
+    # remediation.apply point so fault plans can fail any action
+    # (failed-action behavior — retry next tick — is itself a
+    # recovery path only a plan can force).
+    REMEDIATION_FUNCS = frozenset({
+        '_remediate_dispatch_gap_trend',
+        '_remediate_heartbeat_age_drift',
+        '_remediate_burn_rate_accel',
+        '_remediate_step_time_regression',
+    })
 
     def applies_to(self, rel_path: str) -> bool:
         return rel_path.startswith('skypilot_tpu/') and \
@@ -217,7 +228,8 @@ class ChaosCoverageRule(engine.Rule):
             if not isinstance(node, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):
                 continue
-            if node.name not in self.ELASTIC_FUNCS | self.CKPT_FUNCS:
+            if node.name not in (self.ELASTIC_FUNCS | self.CKPT_FUNCS |
+                                 self.REMEDIATION_FUNCS):
                 continue
             if self._has_inject(node):
                 continue

@@ -158,10 +158,12 @@ class RetentionBoundRule(engine.Rule):
         'fleet_decisions': '_MAX_FLEET_DECISIONS',
         'goodput_ledger': '_MAX_GOODPUT_LEDGER',
         'metric_points': '_MAX_METRIC_POINTS',
+        'remediations': '_MAX_REMEDIATIONS',
     }
     # CREATE TABLE names matching this are observability tables.
     OBSERVABILITY_RE = re.compile(
-        r'events|spans|telemetry|profiles|slo|decisions|ledger|points')
+        r'events|spans|telemetry|profiles|slo|decisions|ledger|points'
+        r'|remediations')
     CREATE_RE = re.compile(r'CREATE TABLE IF NOT EXISTS (\w+)')
 
     def applies_to(self, rel_path: str) -> bool:
@@ -359,6 +361,8 @@ class NeverRaiseRule(engine.Rule):
             'derive_mttf'),
         'skypilot_tpu/utils/metrics_history.py': (
             'record_points', 'detect_anomalies', 'series'),
+        'skypilot_tpu/utils/remediation.py': (
+            'maybe_tick', 'record_applied', 'record_resolved'),
     }
 
     def applies_to(self, rel_path: str) -> bool:
