@@ -456,6 +456,17 @@ class _Fold:
             'cluster': self.cluster,
             'job_id': self.job_id,
             'window': [w0, w1],
+            # Stable incarnation origin of this job's run, for keying
+            # monotone counters across CONTROL-PLANE churn: w0 derives
+            # from the job lease's started_at, which a lease takeover
+            # (server death → reconciler respawn) resets, while the
+            # first incarnation's telemetry start survives — a scraper
+            # keying its high-water floors on origin_ts keeps loss
+            # counters monotone through a takeover. Falls back to w0
+            # with no telemetry yet; drifts only when history
+            # retention prunes the first incarnation.
+            'origin_ts': (incarnations[0]['start_ts']
+                          if incarnations else w0),
             'wall_s': round(wall, 3),
             'full_ranks': full_ranks,
             'incarnations': inc_records,
@@ -631,7 +642,11 @@ def _record_ledger(cluster: str, job_id: Optional[int],
         'loss_s': ledger['loss_s'],
         'goodput': ledger['goodput'],
         'seconds': ledger['totals'],
-        'detail': {'incarnations': len(ledger['incarnations'])},
+        'detail': {'incarnations': len(ledger['incarnations']),
+                   # Scrapers key goodput floors on this (see
+                   # origin_ts in build_ledger): start_ts moves on a
+                   # lease takeover, origin_ts does not.
+                   'origin_ts': ledger.get('origin_ts')},
     }]
     for record in ledger['incarnations']:
         seconds = record['seconds']

@@ -1620,11 +1620,16 @@ def doctor(fix):
     """Control-plane crash-safety health: liveness leases + ownership.
 
     Reports every liveness lease (who holds it, whether its pid is
-    alive, when it expires), in-flight API requests stranded by a dead
-    server, non-terminal jobs/services whose controller process is
-    gone, and task clusters whose owning record is already terminal.
-    With --fix, runs the reconciler on the spot and prints each repair
-    (every repair also lands in `xsky events` as a reconcile.* row).
+    alive, when it expires), the multi-server ownership map (which
+    live server the rendezvous hash assigns each controller scope to,
+    who holds the recorder lease, leases within a third of their TTL
+    of expiry), in-flight API requests stranded by a dead server,
+    non-terminal jobs/services whose controller process is gone, and
+    task clusters whose owning record is already terminal. With
+    --fix, runs the reconciler on the spot — the same claim-arbitrated
+    takeover path a server's own reconcile pass uses — and prints each
+    repair (every repair also lands in `xsky events` as a reconcile.*
+    row).
     """
     import datetime as datetime_lib
 
@@ -1645,6 +1650,36 @@ def doctor(fix):
                 expires, 'live' if l['live'] else 'STALE'))
     else:
         click.echo('  (none — no long-lived actors running)')
+    own = report.get('ownership') or {}
+    servers = own.get('servers') or []
+    if servers:
+        click.echo(f'Server ownership ({len(servers)} live '
+                   f'server{"s" if len(servers) != 1 else ""}):')
+        assignments = own.get('assignments') or {}
+        by_server: dict = {}
+        for scope, owner in sorted(assignments.items()):
+            by_server.setdefault(owner, []).append(scope)
+        for sid in servers:
+            scopes = by_server.get(sid, [])
+            suffix = ', '.join(scopes) if scopes else '(no controllers)'
+            click.echo(f'  {sid}: {suffix}')
+        recorder = own.get('recorder')
+        if recorder:
+            state_str = ('live' if own.get('recorder_live')
+                         else 'STALE — next hold_recorder_lease() '
+                              'takes over')
+            click.echo(f"  recorder lease: {recorder['owner']} "
+                       f"(pid {recorder['pid']}, {state_str})")
+        else:
+            click.echo('  recorder lease: unheld')
+        expiring = own.get('expiring') or []
+        if expiring:
+            click.echo(f'  Leases nearing expiry ({len(expiring)}) — '
+                       'renewal overdue, takeover imminent unless the '
+                       'holder heartbeats:')
+            for l in expiring:
+                click.echo(f"    {l['scope']} ({l['owner']}, "
+                           f"{l['expires_in_s']:.0f}s left)")
     if report['suspect_leases']:
         click.echo(f"Suspect holders ({len(report['suspect_leases'])}) "
                    '— lease expired but pid alive (wedged, or blocked '

@@ -34,6 +34,7 @@ from skypilot_tpu import state as global_state
 from skypilot_tpu.jobs import fleet
 from skypilot_tpu.jobs import state as jobs_state
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import ownership
 from skypilot_tpu.utils import resilience
 from skypilot_tpu.utils import tracing
 
@@ -127,6 +128,17 @@ def _reconcile_dead_controllers() -> Dict[str, List]:
             continue
         job_id = row['job_id']
         if not row['status'].is_terminal():
+            if not ownership.owns(f'job/{job_id}'):
+                # Multi-server sharding: a peer server owns this
+                # controller's takeover; leave the whole repair
+                # (respawn AND slot release) to its reconcile tick.
+                continue
+            if not ownership.claim_repair(f'job/{job_id}',
+                                          'controller process died'):
+                # Racing takeover already claimed by a peer (the yield
+                # is journalled); respawning here too would mint the
+                # duplicate controller the claim exists to prevent.
+                continue
             respawns = jobs_state.bump_controller_respawns(job_id)
             if respawns <= max_controller_respawns():
                 logger.warning(
@@ -217,6 +229,16 @@ def maybe_schedule_next_jobs() -> Dict[str, List]:
                 # the claim, not submission order.
                 job_id = fleet.claim_next_waiting()
                 if job_id is None:
+                    break
+                if not ownership.owns(f'job/{job_id}'):
+                    # The shard map assigns this controller to a peer
+                    # server: hand the claim back and stop this pass —
+                    # the owner spawns it on its next schedule kick
+                    # (bounded by its reconcile interval). Breaking,
+                    # not continuing: claim_next_waiting would hand the
+                    # same job straight back and spin this loop.
+                    jobs_state.set_schedule_state(
+                        job_id, jobs_state.ScheduleState.WAITING)
                     break
                 logger.info(f'Scheduling managed job {job_id} '
                             f'(launching={launching + 1}, '

@@ -36,6 +36,7 @@ from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu import state as global_state
+from skypilot_tpu.utils import ownership
 
 logger = sky_logging.init_logger(__name__)
 
@@ -125,6 +126,16 @@ def reconcile_requests(requeue: bool = True,
         scope = f'request/{row["request_id"]}'
         lease = global_state.get_lease(scope)
         if global_state.lease_is_live(lease):
+            continue
+        if not ownership.owns(scope):
+            # Sharded repair: another live server owns this request's
+            # takeover; it will repair within its own tick.
+            continue
+        if not ownership.claim_repair(
+                scope, 'request orphaned by server death'):
+            # A racing peer claimed this exact repair first (the yield
+            # is journalled by claim_repair) — requeueing again here
+            # would be the double-execution the claim exists to stop.
             continue
         if lease is not None:
             # Drop the dead owner's lease first: the requeue below
@@ -292,19 +303,32 @@ def _reconcile_job_leases() -> None:
 def reconcile(requeue_requests: bool = True) -> List[Dict[str, Any]]:
     """One full pass over every scope; returns the repairs performed
     (empty when the control plane is healthy — the idempotence
-    contract: a second pass right after a first returns [])."""
+    contract: a second pass right after a first returns []).
+
+    The pass runs under a ``reconcile.pass`` span: with no ambient
+    trace it roots a fresh one, so every takeover journal row a repair
+    writes (``reconcile.controller_respawn``, ``reconcile.
+    takeover_yield``, …) carries a trace id that ``xsky trace``
+    resolves — the chaos drill's proof that a takeover is attributable
+    end to end, not just counted.
+    """
     repairs: List[Dict[str, Any]] = []
-    for step in (lambda: reconcile_requests(requeue=requeue_requests),
-                 reconcile_jobs, reconcile_serve):
+    from skypilot_tpu.utils import tracing
+    with tracing.span('reconcile.pass',
+                      server=ownership.server_id()) as sp:
+        for step in (lambda: reconcile_requests(
+                         requeue=requeue_requests),
+                     reconcile_jobs, reconcile_serve):
+            try:
+                repairs.extend(step())
+            except Exception as e:  # pylint: disable=broad-except
+                # One broken scope must not mask repairs in the others.
+                logger.warning(f'Reconcile step {step} failed: {e}')
         try:
-            repairs.extend(step())
+            _reconcile_job_leases()
         except Exception as e:  # pylint: disable=broad-except
-            # One broken scope must not mask repairs in the others.
-            logger.warning(f'Reconcile step {step} failed: {e}')
-    try:
-        _reconcile_job_leases()
-    except Exception as e:  # pylint: disable=broad-except
-        logger.warning(f'Lease hygiene failed: {e}')
+            logger.warning(f'Lease hygiene failed: {e}')
+        sp.set(repairs=len(repairs))
     return repairs
 
 
@@ -386,7 +410,14 @@ def health_report() -> Dict[str, Any]:
     orphan_clusters = [
         {'cluster': name, 'job_id': job_id}
         for name, job_id in _terminal_job_clusters()]
+    try:
+        ownership_view = ownership.ownership_report()
+    except Exception:  # pylint: disable=broad-except
+        ownership_view = {'server_id': None, 'servers': [],
+                          'assignments': {}, 'recorder': None,
+                          'recorder_live': False, 'expiring': []}
     return {
+        'ownership': ownership_view,
         'leases': leases,
         'suspect_leases': suspect_leases,
         'stranded_requests': stranded_requests,

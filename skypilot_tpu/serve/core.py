@@ -160,6 +160,7 @@ def recover_controllers() -> List[str]:
 
 
 def _recover_controllers_locked(global_state, common_utils):
+    from skypilot_tpu.utils import ownership
     recovered = []
     dead_replicas = []
     for record in serve_state.get_services():
@@ -175,6 +176,16 @@ def _recover_controllers_locked(global_state, common_utils):
             # that window into a duplicate spawn.
             continue
         name = record['name']
+        if not ownership.owns(f'service/{name}'):
+            # Multi-server sharding: this service's takeover belongs
+            # to a peer server's reconcile tick.
+            continue
+        if not ownership.claim_repair(f'service/{name}',
+                                      'controller process died'):
+            # A racing peer claimed this respawn first (yield
+            # journalled); re-execing here too would duplicate the
+            # controller.
+            continue
         respawns = serve_state.bump_controller_respawns(name)
         if respawns > max_controller_respawns():
             logger.warning(

@@ -638,6 +638,18 @@ def run(host: str = '127.0.0.1', port: int = 46580,
         raise SystemExit(0)
 
     signal.signal(signal.SIGTERM, _on_term)
+    # Horizontal control plane (PR 17): register this process in the
+    # live server set BEFORE the startup reconcile, so the pass below
+    # already shards by the membership view that includes us. From
+    # here on, controller respawns / the recorder role / repair
+    # takeovers are arbitrated by leases across every server sharing
+    # this state DB (utils/ownership.py).
+    try:
+        from skypilot_tpu.utils import ownership
+        sid = ownership.start_server_lease()
+        logger.info(f'Registered server lease server/{sid}')
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning(f'Server lease registration failed: {e}')
     # Startup reconciliation (HA, VERDICT r3 #9): jobs/serve/request
     # state lives in sqlite under ~/.xsky (the helm chart's PVC) — a
     # kill -9 of the previous server strands RUNNING requests, WAITING
@@ -674,6 +686,13 @@ def run(host: str = '127.0.0.1', port: int = 46580,
     try:
         server.serve_forever()
     finally:
+        try:
+            from skypilot_tpu.utils import ownership
+            # Clean exits hand shards back immediately; a SIGKILL
+            # skips this and peers re-own within one lease TTL.
+            ownership.stop_server_lease()
+        except Exception:  # pylint: disable=broad-except
+            pass
         try:
             os.remove(pid_file())
         except OSError:

@@ -117,6 +117,10 @@ _synchronous = False
 # liveness lease, so the reconciler can tell "queued behind a busy
 # pool" from "stranded by a dead server" — only the latter is repaired.
 _inflight_lock = threading.Lock()
+# single-writer ok: holds only THIS server's accepted request ids, so
+# N servers heartbeat N disjoint partitions of request/* leases; each
+# lease carries this process's pid, which is exactly what the
+# reconciler's takeover path arbitrates on.
 _inflight_ids: set = set()
 
 

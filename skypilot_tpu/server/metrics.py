@@ -304,8 +304,9 @@ def _render_goodput_counters() -> List[str]:
     the job's whole lifetime, but successive folds can RECLASSIFY
     seconds between causes (a late span flush converts unattributed
     into provision), so each series is clamped to its in-process
-    high-water mark while the lifetime origin (job, window start) is
-    unchanged — a new lifetime resets the floor, an ordinary counter
+    high-water mark while the lifetime origin (job, first-incarnation
+    origin_ts) is unchanged — a new lifetime resets the floor, an
+    ordinary counter
     reset Prometheus absorbs. Same live-cluster filter as the workload
     gauges (bounded cardinality: causes are a fixed enum). Never
     raises; an unreadable state DB costs the counters, not the
@@ -332,7 +333,17 @@ def _render_goodput_counters() -> List[str]:
                     del _goodput_floors[c]
             for cluster, row in sorted(newest.items()):
                 seconds = row.get('seconds') or {}
-                origin = (row.get('job_id'), row.get('start_ts'))
+                # Lifetime identity prefers the ledger's incarnation
+                # origin (detail.origin_ts): start_ts derives from the
+                # job lease's started_at, which a multi-server lease
+                # takeover resets — keying on it would zero the floors
+                # mid-lifetime and break the monotone-counter contract
+                # through a takeover. Older rows without the detail
+                # fall back to start_ts (pre-origin_ts writers).
+                detail = row.get('detail') or {}
+                origin = (row.get('job_id'),
+                          detail.get('origin_ts') or
+                          row.get('start_ts'))
                 prev_origin, floors = _goodput_floors.get(
                     cluster, (None, {}))
                 if prev_origin != origin:

@@ -65,56 +65,142 @@ def read_log(request_id: str, max_bytes: int = 1 << 20) -> str:
         return f.read().decode('utf-8', errors='replace')
 
 
+def _lock_retry_deadline_s() -> float:
+    """Total time one write spends waiting out a peer's sqlite lock."""
+    try:
+        return float(os.environ.get('XSKY_DB_LOCK_RETRY_S', 5.0))
+    except ValueError:
+        return 5.0
+
+
+def _retry_locked(fn, conn: Optional[sqlite3.Connection] = None):
+    """Run a write, absorbing transient ``database is locked`` /
+    ``database is busy`` OperationalErrors with jittered backoff.
+
+    N API-server processes share one requests DB in multi-server mode
+    (tools/bench_controlplane.py --multi-server), so the one-writer-
+    per-process assumption no longer holds: the WAL conversion in
+    :func:`_get_conn` and every enqueue/commit can lose a race for the
+    sqlite write lock. Before this helper that surfaced as a raw
+    OperationalError in the CLIENT's lap (a 500 on `xsky launch`).
+    Bounded: a few attempts under ``XSKY_DB_LOCK_RETRY_S`` total — a
+    wedged peer (not a transient race) still raises, and the original
+    OperationalError is re-raised so callers' except clauses are
+    unchanged. Jitter matters here: the losing writers are
+    synchronized by construction (they all just lost the same lock).
+    Pass ``conn`` so a transaction left half-open by a failed commit is
+    rolled back before the next attempt re-runs the statements.
+
+    The module writer lock is taken PER ATTEMPT, inside this helper:
+    backing off while holding ``_lock`` would stall every other writer
+    thread in this process for the whole cross-process wait.
+    """
+    from skypilot_tpu.utils import chaos
+    from skypilot_tpu.utils import common_utils
+    from skypilot_tpu.utils import resilience
+
+    def _attempt():
+        # Outside the writer lock: injection may journal to the state
+        # DB, and a fault plan targeting this point wants to starve
+        # the WRITE, not wedge every writer thread.
+        chaos.inject('requests_db.write')
+        with _lock:
+            try:
+                return fn()
+            except sqlite3.OperationalError as e:
+                msg = str(e).lower()
+                if 'locked' in msg or 'busy' in msg:
+                    if conn is not None:
+                        try:
+                            conn.rollback()
+                        except sqlite3.Error:
+                            pass
+                    raise resilience.TransientError(str(e)) from e
+                raise
+
+    try:
+        return resilience.retry_transient(
+            _attempt,
+            max_attempts=8,
+            backoff=common_utils.Backoff(initial=0.02, factor=2.0,
+                                         cap=0.5, jitter=0.5),
+            deadline=resilience.Deadline(_lock_retry_deadline_s()))
+    except resilience.TransientError as e:
+        raise e.__cause__  # the original sqlite3.OperationalError
+
+
 def _get_conn() -> sqlite3.Connection:
     global _conn, _conn_path
     path = _db_path()
     with _lock:
+        if _conn is not None and _conn_path == path:
+            return _conn
+    # Built OUTSIDE the writer lock: schema init retries the WAL
+    # conversion with backoff (_retry_locked takes the lock around
+    # each attempt), and holding _lock across that wait would block
+    # every writer thread behind one slow peer process. Losing a
+    # same-process build race is handled below.
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    # xskylint: disable=db-discipline -- the requests DB is
+    # per-API-server-LOCAL by design (each replica owns its
+    # in-flight queue; leases arbitrate cross-replica work),
+    # so it must not pick up db_utils.connect's XSKY_DB_URL
+    # postgres routing; reads still go through StateReader.
+    conn = sqlite3.connect(path, check_same_thread=False)
+
+    def _init_schema() -> None:
+        # WAL conversion takes the db lock exclusively — with
+        # N server processes opening the same DB at startup
+        # this is the most contended statement in the module,
+        # so the whole init runs under _retry_locked.
+        conn.execute('PRAGMA journal_mode=WAL')
+        from skypilot_tpu.utils import db_utils
+        conn.execute(
+            f'PRAGMA synchronous={db_utils.sqlite_synchronous()}')
+        conn.execute("""
+            CREATE TABLE IF NOT EXISTS requests (
+                request_id TEXT PRIMARY KEY,
+                name TEXT,
+                user TEXT,
+                status TEXT,
+                body TEXT,
+                result BLOB,
+                error TEXT,
+                created_at REAL,
+                finished_at REAL
+            )""")
+        try:
+            # The request-scoped trace id, minted at
+            # acceptance: `xsky trace <request-id>` resolves
+            # through this column while the request is still
+            # in flight (its root span is only written at
+            # completion).
+            conn.execute(
+                'ALTER TABLE requests ADD COLUMN trace_id TEXT')
+        except sqlite3.OperationalError as e:
+            if 'duplicate column' not in str(e).lower():
+                raise  # 'database is locked' must reach retry
+        # list_inflight / fail_stale_inflight filter on status
+        # and gc_finished range-scans finished_at under a
+        # status filter — both were full table scans before
+        # this index.
+        conn.execute(
+            'CREATE INDEX IF NOT EXISTS '
+            'idx_requests_status_finished'
+            ' ON requests (status, finished_at)')
+        # list_requests orders newest-first; without this the
+        # sort re-scans every row per listing page.
+        conn.execute(
+            'CREATE INDEX IF NOT EXISTS idx_requests_created '
+            'ON requests (created_at)')
+        conn.commit()
+
+    _retry_locked(_init_schema, conn)
+    with _lock:
         if _conn is None or _conn_path != path:
-            os.makedirs(os.path.dirname(path), exist_ok=True)
-            # xskylint: disable=db-discipline -- the requests DB is
-            # per-API-server-LOCAL by design (each replica owns its
-            # in-flight queue; leases arbitrate cross-replica work),
-            # so it must not pick up db_utils.connect's XSKY_DB_URL
-            # postgres routing; reads still go through StateReader.
-            _conn = sqlite3.connect(path, check_same_thread=False)
-            _conn.execute('PRAGMA journal_mode=WAL')
-            from skypilot_tpu.utils import db_utils
-            _conn.execute(
-                f'PRAGMA synchronous={db_utils.sqlite_synchronous()}')
-            _conn.execute("""
-                CREATE TABLE IF NOT EXISTS requests (
-                    request_id TEXT PRIMARY KEY,
-                    name TEXT,
-                    user TEXT,
-                    status TEXT,
-                    body TEXT,
-                    result BLOB,
-                    error TEXT,
-                    created_at REAL,
-                    finished_at REAL
-                )""")
-            try:
-                # The request-scoped trace id, minted at acceptance:
-                # `xsky trace <request-id>` resolves through this
-                # column while the request is still in flight (its
-                # root span is only written at completion).
-                _conn.execute(
-                    'ALTER TABLE requests ADD COLUMN trace_id TEXT')
-            except sqlite3.OperationalError:
-                pass  # column already exists
-            # list_inflight / fail_stale_inflight filter on status and
-            # gc_finished range-scans finished_at under a status filter
-            # — both were full table scans before this index.
-            _conn.execute(
-                'CREATE INDEX IF NOT EXISTS idx_requests_status_finished'
-                ' ON requests (status, finished_at)')
-            # list_requests orders newest-first; without this the sort
-            # re-scans every row per listing page.
-            _conn.execute(
-                'CREATE INDEX IF NOT EXISTS idx_requests_created '
-                'ON requests (created_at)')
-            _conn.commit()
-            _conn_path = path
+            _conn, _conn_path = conn, path
+        elif conn is not _conn:
+            conn.close()   # lost a same-process build race
         return _conn
 
 
@@ -160,13 +246,16 @@ def create(name: str, user: str, body: Dict[str, Any],
            trace_id: Optional[str] = None) -> str:
     request_id = uuid.uuid4().hex
     conn = _get_conn()
-    with _lock:
+
+    def _enqueue() -> None:
         conn.execute(
             'INSERT INTO requests (request_id, name, user, status, body, '
             'created_at, trace_id) VALUES (?, ?, ?, ?, ?, ?, ?)',
             (request_id, name, user, RequestStatus.PENDING.value,
              json.dumps(body, default=str), time.time(), trace_id))
         conn.commit()
+
+    _retry_locked(_enqueue, conn)
     return request_id
 
 
@@ -182,25 +271,32 @@ def set_trace_id(request_id: str, trace_id: Optional[str]) -> None:
     crash: the fresh run's story must be the one the request id
     resolves to, not the dead server's)."""
     conn = _get_conn()
-    with _lock:
+
+    def _write() -> None:
         conn.execute('UPDATE requests SET trace_id=? WHERE request_id=?',
                      (trace_id, request_id))
         conn.commit()
 
+    _retry_locked(_write, conn)
+
 
 def set_status(request_id: str, status: RequestStatus) -> None:
     conn = _get_conn()
-    with _lock:
+
+    def _write() -> None:
         conn.execute('UPDATE requests SET status=? WHERE request_id=?',
                      (status.value, request_id))
         conn.commit()
+
+    _retry_locked(_write, conn)
 
 
 def finish(request_id: str, result: Any = None,
            error: Optional[Dict[str, Any]] = None) -> None:
     conn = _get_conn()
     status = RequestStatus.FAILED if error else RequestStatus.SUCCEEDED
-    with _lock:
+
+    def _write() -> None:
         # Guard: a concurrent cancel must not be overwritten (the work
         # may have completed anyway, but CANCELLED is the user-visible
         # truth about what they asked for).
@@ -212,6 +308,8 @@ def finish(request_id: str, result: Any = None,
              json.dumps(error) if error else None, time.time(),
              request_id))
         conn.commit()
+
+    _retry_locked(_write, conn)
 
 
 def get_status(request_id: str) -> Optional[Dict[str, Any]]:
@@ -327,10 +425,13 @@ def gc_finished(now: Optional[float] = None) -> int:
         except OSError:
             pass
     conn = _get_conn()
-    with _lock:
+
+    def _write() -> None:
         conn.executemany('DELETE FROM requests WHERE request_id=?',
                          [(i,) for i in ids])
         conn.commit()
+
+    _retry_locked(_write, conn)
     return len(ids)
 
 
@@ -356,7 +457,8 @@ def fail_request(request_id: str, message: str,
     """Fail-abort one in-flight row with an explicit reason (terminal
     rows are left alone — repairs must be idempotent)."""
     conn = _get_conn()
-    with _lock:
+
+    def _write() -> int:
         cur = conn.execute(
             "UPDATE requests SET status='FAILED', finished_at=?, "
             'error=? WHERE request_id=? AND status IN (?, ?)',
@@ -365,7 +467,9 @@ def fail_request(request_id: str, message: str,
              request_id, RequestStatus.PENDING.value,
              RequestStatus.RUNNING.value))
         conn.commit()
-        return cur.rowcount == 1
+        return cur.rowcount
+
+    return _retry_locked(_write, conn) == 1
 
 
 def fail_stale_inflight() -> int:
@@ -391,10 +495,13 @@ def fail_stale_inflight() -> int:
 
 def mark_cancelled(request_id: str) -> bool:
     conn = _get_conn()
-    with _lock:
+
+    def _write() -> int:
         cur = conn.execute(
             "UPDATE requests SET status='CANCELLED', finished_at=? "
             "WHERE request_id=? AND status IN ('PENDING', 'RUNNING')",
             (time.time(), request_id))
         conn.commit()
-        return cur.rowcount == 1
+        return cur.rowcount
+
+    return _retry_locked(_write, conn) == 1

@@ -829,7 +829,13 @@ def record_recovery_event(event_type: str,
     With ``XSKY_JOURNAL_FLUSH_S`` set, appends coalesce in-process and
     commit once per window/64 rows (see _write_journal_rows) — the
     high-QPS API-server setting, where per-event fsyncs were measured
-    contending with every other state write.
+    contending with every other state write. ``reconcile.*`` rows are
+    exempt from coalescing: takeover/repair events arbitrate ownership
+    BETWEEN server processes, and a sibling server deciding whether a
+    dead peer's work was already re-owned reads the shared table — its
+    read cannot flush this process's buffer, so the read-your-writes
+    guarantee for those rows is moved to write time (flush-on-write;
+    in-process readers keep the buffered path's flush-on-read).
     """
     global _journal_buf_oldest, _journal_atexit_registered
     if trace_id is None:
@@ -842,7 +848,12 @@ def record_recovery_event(event_type: str,
     row = (now, event_type, scope, cause, latency_s,
            json.dumps(detail) if detail is not None else None, trace_id)
     window = _journal_flush_window_s()
-    if window <= 0:
+    if window <= 0 or event_type.startswith('reconcile.'):
+        if event_type.startswith('reconcile.'):
+            # Ordering: older buffered rows land before this one, or a
+            # cross-process reader would see the repair precede its
+            # cause.
+            _flush_journal_buffer()
         _write_journal_rows([row])
         return
     flush = False
@@ -2131,6 +2142,85 @@ def heartbeat_leases(scopes: List[str], owner: str,
             conn.rollback()
         except Exception:  # pylint: disable=broad-except
             pass
+
+
+def try_acquire_lease(scope: str, owner: str,
+                      pid: Optional[int] = None,
+                      ttl_s: Optional[float] = None) -> bool:
+    """Atomically acquire (or renew our own) lease for `scope`; returns
+    whether WE hold it afterwards. This is the multi-server arbitration
+    primitive: unlike :func:`heartbeat_lease` (which unconditionally
+    overwrites — correct for a scope with exactly one writer), the
+    UPSERT here only fires when the existing row is expired or already
+    ours, so two servers racing a takeover converge to one owner and
+    the loser learns it lost (and can journal a yield).
+
+    A row whose holder pid is dead but whose TTL has not run out is
+    also claimable — via a compare-and-delete of the exact observed row
+    followed by one retry — matching :func:`lease_is_live`'s "dead pid
+    fails the lease early" semantics. Never raises; a state-DB error
+    reports ``False`` (claim nothing on uncertainty).
+    """
+    pid = pid if pid is not None else os.getpid()
+    ttl = ttl_s if ttl_s is not None else lease_ttl_s()
+    for _ in range(2):
+        now = time.time()
+        try:
+            conn = _get_conn()
+        except Exception:  # pylint: disable=broad-except
+            return False
+        try:
+            with _lock:
+                cur = conn.execute(
+                    'INSERT INTO liveness_leases '
+                    '(scope, owner, pid, started_at, expires_at) '
+                    'VALUES (?, ?, ?, ?, ?) '
+                    'ON CONFLICT(scope) DO UPDATE SET '
+                    'owner=excluded.owner, pid=excluded.pid, '
+                    # started_at survives same-holder renewal (doctor's
+                    # "held since"); a takeover starts a fresh epoch.
+                    'started_at=CASE WHEN liveness_leases.owner = '
+                    'excluded.owner AND liveness_leases.pid = '
+                    'excluded.pid THEN liveness_leases.started_at '
+                    'ELSE excluded.started_at END, '
+                    'expires_at=excluded.expires_at '
+                    'WHERE liveness_leases.expires_at <= ? '
+                    'OR (liveness_leases.owner = excluded.owner '
+                    'AND liveness_leases.pid = excluded.pid)',
+                    (scope, owner, pid, now, now + ttl, now))
+                won = cur.rowcount == 1
+                conn.commit()
+        except Exception:  # pylint: disable=broad-except
+            try:
+                conn.rollback()
+            except Exception:  # pylint: disable=broad-except
+                pass
+            return False
+        if won:
+            return True
+        holder = get_lease(scope)
+        if holder is None:
+            continue   # released between UPSERT and read: retry once
+        if lease_is_live(holder, now):
+            return False
+        # Unexpired row with a dead holder: compare-and-delete exactly
+        # what we observed (a concurrent claimant's fresh row differs
+        # in expires_at and survives), then retry the conditional
+        # UPSERT — never an unconditional overwrite.
+        try:
+            with _lock:
+                conn.execute(
+                    'DELETE FROM liveness_leases WHERE scope=? '
+                    'AND owner=? AND expires_at=?',
+                    (scope, holder['owner'], holder['expires_at']))
+                conn.commit()
+        except Exception:  # pylint: disable=broad-except
+            try:
+                conn.rollback()
+            except Exception:  # pylint: disable=broad-except
+                pass
+            return False
+    return False
 
 
 def release_lease(scope: str) -> None:

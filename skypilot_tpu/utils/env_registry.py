@@ -104,6 +104,12 @@ _VARS = [
     EnvVar('XSKY_LEASE_TTL_S', '60',
            'Liveness-lease TTL: a holder silent this long counts as '
            'dead to the reconciler'),
+    EnvVar('XSKY_SERVER_ID', UNSET,
+           'Stable identity of this API-server process in the '
+           'ownership hash ring (unset = host:pid)'),
+    EnvVar('XSKY_DB_LOCK_RETRY_S', '5.0',
+           "Total backoff budget absorbing 'database is locked' "
+           'races on the shared requests DB (multi-server mode)'),
     # ---- resilience / chaos / tracing / metrics ---------------------------
     EnvVar('XSKY_CHAOS_PLAN', UNSET,
            'Fault-injection plan: inline JSON or a path to one '

@@ -114,12 +114,19 @@ def _min_points() -> int:
 # Rollup cursor per destination tier (next window start). Recovered
 # from the table's MAX(ts) on first use, so a restarted server never
 # re-folds a window it already wrote.
+# single-writer ok: the role/recorder lease election
+# (hold_recorder_lease) admits exactly one server's ticks to
+# _advance_rollups; a successor's empty cursor re-derives from the
+# tier's MAX(ts), which is what makes failover fold-once.
 _rollup_cursor: Dict[str, float] = {}
 _rollup_lock = threading.Lock()
 
 # Active anomalies: (detector, series ident) -> since ts. In-process
 # like the SLO monitor's breach latches — the recorder runs on one
 # server, and a restart simply re-journals a still-true anomaly.
+# single-writer ok: detectors run inside the lease-elected recorder
+# tick only, so exactly one server journals transitions; a takeover
+# re-arms from live data and re-journals anything still true.
 _active_anomalies: Dict[Tuple[str, str], float] = {}
 _anomaly_lock = threading.Lock()
 
@@ -322,9 +329,34 @@ def record_tick(now: Optional[float] = None) -> Dict[str, Any]:
     return {'points': len(points), 'anomalies': anomalies}
 
 
+def hold_recorder_lease() -> bool:
+    """Lease-elect THE recorder across API servers sharing one state
+    DB: True ⇒ this process holds ``role/recorder`` for at least one
+    TTL and should run this tick; False ⇒ a live peer is the recorder
+    — running anyway would double-sample every series and double-fold
+    rollup windows (``state.rollup_metric_points`` has no idempotence
+    guard BY DESIGN; election is the guard). The TTL is stretched to
+    2x the tick interval when the interval is tuned above the lease
+    TTL, so the elected holder can never lose the role between its own
+    ticks. Single-process deployments and tests see no contention and
+    always win. A takeover after the elected recorder dies is
+    journalled (``reconcile.role_takeover``) by the ownership layer;
+    the successor's first ``_advance_rollups`` recovers each rollup
+    cursor from the tier's ``MAX(ts)``, which is what makes failover
+    fold-once."""
+    from skypilot_tpu import state
+    from skypilot_tpu.utils import ownership
+    ttl = max(state.lease_ttl_s(), 2.0 * interval_s())
+    return ownership.hold_role(ownership.RECORDER_ROLE_SCOPE,
+                               ttl_s=ttl)
+
+
 def start_background_recorder() -> None:
     """Periodic recorder tick (API-server lifetime; idempotent start —
-    the reconciler's background-tick pattern)."""
+    the reconciler's background-tick pattern). Every server runs the
+    loop; the ``role/recorder`` lease elects which one's ticks do
+    work, and a standby promotes itself within one TTL of the elected
+    recorder dying."""
     global _recorder_thread
     with _recorder_lock:
         if _recorder_thread is not None and _recorder_thread.is_alive():
@@ -335,7 +367,8 @@ def start_background_recorder() -> None:
             while True:
                 resilience.sleep(interval_s())
                 try:
-                    record_tick()
+                    if hold_recorder_lease():
+                        record_tick()
                 except Exception:  # pylint: disable=broad-except
                     pass   # never-raise discipline: next tick retries
 

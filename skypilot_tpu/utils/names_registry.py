@@ -265,6 +265,9 @@ _NAMES = [
             'One SLO monitor tick over all services'),
     ObsName('span', 'serve.slo_scrape',
             'Replica /metrics scrape fan-out inside a tick'),
+    ObsName('span', 'reconcile.pass',
+            'One whole reconcile pass; roots the trace every '
+            'reconcile.* takeover journal row links to'),
     # ---- chaos points ------------------------------------------------------
     ObsName('chaos', 'ckpt.write',
             'Local-tier snapshot write on the checkpointd worker'),
@@ -306,6 +309,9 @@ _NAMES = [
     ObsName('chaos', 'remediation.apply',
             'Fail a remediation action arm before it acts, keyed on '
             'detector/action'),
+    ObsName('chaos', 'requests_db.write',
+            'Fault one attempt of a request-table write (exercises '
+            'the cross-server database-is-locked retry)'),
     ObsName('chaos', 'serve.probe',
             'Serve controller replica readiness probe'),
     ObsName('chaos', 'telemetry.stall',
@@ -362,6 +368,12 @@ _NAMES = [
             'Reconciler tore down an orphaned controller cluster'),
     ObsName('journal', 'reconcile.respawn_budget_exhausted',
             'Reconciler hit the bounded-respawn budget'),
+    ObsName('journal', 'reconcile.takeover_yield',
+            'A server lost the repair claim for a scope to a racing '
+            'peer and yielded (winner/loser attached)'),
+    ObsName('journal', 'reconcile.role_takeover',
+            'A lease-elected role (recorder) changed holders; '
+            'from/to/from_pid attached'),
     ObsName('journal', 'metrics.anomaly',
             'An anomaly detector tripped on recorded trend history '
             '(detector, series, value vs baseline attached)'),

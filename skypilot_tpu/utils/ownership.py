@@ -1,0 +1,267 @@
+"""Lease-sharded multi-server ownership of control-plane singletons.
+
+Every background actor built since PR 6 — the reconciler's repair
+sweep, the jobs/serve controller respawn paths, the PR 15 metrics
+recorder + rollup cursor — silently assumed exactly one API server
+process. This module makes N stateless servers on one shared state DB
+(sqlite locally, postgres via ``utils/db_utils.py``'s ``XSKY_DB_URL``
+translation) divide that work safely:
+
+  * **Registration.** Each server heartbeats a ``server/<id>``
+    liveness lease (:func:`start_server_lease`); the live set of those
+    leases IS the membership view. No new table, no gossip — the PR 2
+    lease machinery arbitrates, and a SIGKILLed server simply stops
+    renewing and drops out within one TTL.
+  * **Sharding.** :func:`owner_for` deterministically maps any scope
+    (``job/3``, ``service/svc``, ``role/recorder``) onto the live
+    server set with rendezvous (highest-random-weight) hashing: every
+    server computes the same answer from the same lease table, and a
+    membership change remaps only the dead server's scopes instead of
+    reshuffling everything (the property plain ``hash % N`` lacks).
+  * **Claims.** Sharding divides steady-state work; it cannot make a
+    *takeover* race-free (two servers can both observe a peer die
+    before either repairs). :func:`claim_repair` arbitrates the final
+    step with an atomic conditional lease (``state.try_acquire_lease``)
+    so exactly one server executes a given repair per claim TTL; the
+    loser journals a ``reconcile.takeover_yield`` naming the winner.
+  * **Degenerate mode.** With no registered servers (unit tests, a
+    bare CLI, single-process deployments) every ``owns()`` answer is
+    True and claims always succeed — all multi-server machinery
+    becomes a no-op, which is what keeps the pre-PR-17 test suite
+    meaningful unchanged.
+
+Non-server processes (``xsky doctor --fix``, standalone reconcilers)
+never register, so they bypass sharding and may trigger any takeover
+on demand; the claim layer still makes the repair race-safe against
+whatever servers are running.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import socket
+import threading
+from typing import List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu import state as global_state
+
+logger = sky_logging.init_logger(__name__)
+
+SERVER_LEASE_PREFIX = 'server'
+RECORDER_ROLE_SCOPE = 'role/recorder'
+
+_id_lock = threading.Lock()
+# Process-wide server identity; stable for the process lifetime once
+# minted. Read-only after first compute (single assignment under
+# _id_lock), so every thread sees one consistent identity.
+# single-writer ok: assigned once under _id_lock, then immutable.
+_server_id: Optional[str] = None
+_registered = False
+_heartbeat_thread: Optional[threading.Thread] = None
+
+
+def server_id() -> str:
+    """This process's stable server identity: ``XSKY_SERVER_ID`` when
+    set (the bench names its subprocesses ``s0``/``s1``/…), else
+    ``<host>:<pid>`` — unique per server process on a shared DB."""
+    global _server_id
+    with _id_lock:
+        if _server_id is None:
+            _server_id = os.environ.get('XSKY_SERVER_ID') or \
+                f'{socket.gethostname()}:{os.getpid()}'
+        return _server_id
+
+
+def heartbeat_interval_s() -> float:
+    """Server-lease renewal cadence: a third of the TTL, floored so a
+    tiny test TTL cannot busy-spin the heartbeat thread."""
+    return max(global_state.lease_ttl_s() / 3.0, 0.05)
+
+
+def start_server_lease() -> str:
+    """Register this process as an API server: write the
+    ``server/<id>`` lease now and keep renewing it from a daemon
+    thread. Idempotent; returns the server id. After this call,
+    :func:`owns` answers according to the shard map instead of
+    degenerate-True."""
+    global _registered, _heartbeat_thread
+    sid = server_id()
+    scope = f'{SERVER_LEASE_PREFIX}/{sid}'
+    global_state.heartbeat_lease(scope, owner=sid)
+    with _id_lock:
+        _registered = True
+        if _heartbeat_thread is not None and _heartbeat_thread.is_alive():
+            return sid
+
+        def _loop() -> None:
+            from skypilot_tpu.utils import resilience
+            while True:
+                resilience.sleep(heartbeat_interval_s())
+                try:
+                    global_state.heartbeat_lease(scope, owner=sid)
+                except Exception as e:  # pylint: disable=broad-except
+                    # Never die: a missed renewal costs at most one
+                    # TTL of shard ownership, not the server.
+                    logger.warning(f'Server lease renewal failed: {e}')
+
+        _heartbeat_thread = threading.Thread(
+            target=_loop, name='xsky-server-lease', daemon=True)
+        _heartbeat_thread.start()
+    return sid
+
+
+def stop_server_lease() -> None:
+    """Release the server lease on clean shutdown (the heartbeat
+    daemon dies with the process): peers re-own our scopes immediately
+    instead of waiting out the TTL."""
+    global _registered
+    with _id_lock:
+        _registered = False
+    global_state.release_lease(f'{SERVER_LEASE_PREFIX}/{server_id()}')
+
+
+def is_registered() -> bool:
+    with _id_lock:
+        return _registered
+
+
+def reset_for_test() -> None:
+    """Forget the process identity/registration (the heartbeat thread,
+    if any, keeps renewing the OLD scope until process exit — tests
+    that registered should use a throwaway state DB)."""
+    global _server_id, _registered
+    with _id_lock:
+        _server_id = None
+        _registered = False
+
+
+def live_server_ids(now: Optional[float] = None) -> List[str]:
+    """Ids of every server whose ``server/<id>`` lease is live — the
+    membership view every sharding decision derives from."""
+    out = []
+    for lease in global_state.list_leases(prefix=SERVER_LEASE_PREFIX):
+        if global_state.lease_is_live(lease, now):
+            out.append(lease['scope'].split('/', 1)[1])
+    return sorted(out)
+
+
+def owner_for(scope: str,
+              servers: Optional[List[str]] = None) -> Optional[str]:
+    """The server that owns `scope` under rendezvous hashing over the
+    live server set (None with no live servers). Deterministic: every
+    process computes the same owner from the same lease table."""
+    if servers is None:
+        servers = live_server_ids()
+    if not servers:
+        return None
+    return max(servers, key=lambda sid: hashlib.sha1(
+        f'{sid}|{scope}'.encode('utf-8')).digest())
+
+
+def owns(scope: str) -> bool:
+    """Should THIS process act on `scope`?
+
+    Degenerate cases answer True: an unregistered process (CLI
+    ``doctor --fix``, unit tests, single-process mode) is outside the
+    shard map and may act on anything — the claim layer, not sharding,
+    is what makes the action race-safe. A registered server answers
+    from the shard map, counting itself live even if its own lease row
+    lags a renewal (it KNOWS it is alive; excluding itself could
+    orphan a scope for a TTL).
+    """
+    if not is_registered():
+        return True
+    servers = live_server_ids()
+    sid = server_id()
+    if sid not in servers:
+        servers = sorted(servers + [sid])
+    return owner_for(scope, servers) == sid
+
+
+def claim_repair(scope: str, cause: str,
+                 ttl_s: Optional[float] = None) -> bool:
+    """Arbitrate one repair/takeover of `scope`: True means this
+    process won the ``claim/<scope>`` lease and must execute the
+    repair; False means a racing peer won inside the claim TTL — the
+    repair already happened (or is happening), and the loss is
+    journalled as a ``reconcile.takeover_yield`` naming the winner, so
+    a chaos drill can prove both racers observed the death yet the
+    scope converged to one owner."""
+    sid = server_id()
+    claim_scope = f'claim/{scope}'
+    if global_state.try_acquire_lease(claim_scope, owner=sid,
+                                      ttl_s=ttl_s):
+        return True
+    holder = global_state.get_lease(claim_scope)
+    winner = holder['owner'] if holder else 'unknown'
+    if winner != sid:
+        global_state.record_recovery_event(
+            'reconcile.takeover_yield', scope=scope, cause=cause,
+            detail={'winner': winner, 'loser': sid})
+    return False
+
+
+def release_claim(scope: str) -> None:
+    """Drop a repair claim early (the repair turned out to be a no-op,
+    e.g. the record went terminal between observation and claim) so a
+    genuine later repair does not wait out the claim TTL."""
+    global_state.release_lease(f'claim/{scope}')
+
+
+def hold_role(role_scope: str, ttl_s: Optional[float] = None) -> bool:
+    """Acquire-or-renew a lease-elected singleton role (the metrics
+    recorder). True ⇒ this process is the elected holder for one TTL
+    and should do the role's work this tick; False ⇒ another live
+    holder exists — skip. A change of holder (takeover after the
+    previous elect died) is journalled ``reconcile.role_takeover``
+    with the previous holder attached, trace-linked like every
+    reconcile row."""
+    sid = server_id()
+    prev = global_state.get_lease(role_scope)
+    won = global_state.try_acquire_lease(role_scope, owner=sid,
+                                         ttl_s=ttl_s)
+    if won and prev is not None and prev['owner'] != sid:
+        # The recorder loop calls this OUTSIDE any ambient span, so
+        # root a trace here — the takeover row must resolve through
+        # `xsky trace` like every other reconcile.* row.
+        from skypilot_tpu.utils import tracing
+        with tracing.span('reconcile.pass', server=sid,
+                          role=role_scope):
+            global_state.record_recovery_event(
+                'reconcile.role_takeover', scope=role_scope,
+                cause='previous holder stopped renewing',
+                detail={'from': prev['owner'], 'to': sid,
+                        'from_pid': prev['pid']})
+    return won
+
+
+def ownership_report() -> dict:
+    """Doctor's view of the horizontal control plane: the live server
+    set, who owns each controller scope / the recorder role, and
+    role/claim leases nearing expiry."""
+    import time
+    now = time.time()
+    servers = live_server_ids(now)
+    assignments = {}
+    for lease in global_state.list_leases():
+        scope = lease['scope']
+        if scope.startswith(('job/', 'service/')):
+            assignments[scope] = owner_for(scope, servers) \
+                if servers else lease['owner']
+    recorder = global_state.get_lease(RECORDER_ROLE_SCOPE)
+    expiring = []
+    for lease in global_state.list_leases():
+        if not lease['scope'].startswith(('server/', 'role/', 'claim/')):
+            continue
+        expires_in = (lease['expires_at'] or 0) - now
+        if expires_in <= global_state.lease_ttl_s() / 3.0:
+            expiring.append({**lease, 'expires_in_s': expires_in})
+    return {
+        'server_id': server_id() if is_registered() else None,
+        'servers': servers,
+        'assignments': assignments,
+        'recorder': recorder,
+        'recorder_live': global_state.lease_is_live(recorder, now),
+        'expiring': expiring,
+    }

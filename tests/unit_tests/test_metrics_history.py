@@ -446,6 +446,74 @@ class TestRecorderTick:
         assert spans, 'recorder tick must land on the trace plane'
 
 
+# ---- recorder failover ------------------------------------------------------
+
+
+class TestRecorderFailover:
+    """Lease-elected recorder dies mid-tick (SIGKILL: lease row live,
+    pid dead): the successor must win ``hold_recorder_lease()``
+    immediately, journal a trace-linked takeover, and resume each
+    rollup cursor from the tier's MAX(ts) — fold-once through the
+    failover, because ``rollup_metric_points`` itself has no
+    idempotence guard BY DESIGN (election is the guard)."""
+
+    @staticmethod
+    def _dead_pid():
+        proc = subprocess.Popen(['true'])
+        proc.wait()
+        return proc.pid
+
+    def test_successor_resumes_cursor_without_double_fold(
+            self, tmp_state):
+        from skypilot_tpu.utils import ownership
+
+        ownership.reset_for_test()
+        # Three completed 1m windows of raw data...
+        _gauge_points(tmp_state, 'g', [1.0, 3.0, 5.0, 7.0, 9.0, 11.0],
+                      dt=30.0)
+        # ...of which the victim recorder folded exactly the first
+        # before dying (now=T0+60: only the T0 window is complete).
+        metrics_history.record_points([], ts=T0 + 60)
+        assert len(tmp_state.get_metric_points(name='g',
+                                               res='1m')) == 1
+        # The SIGKILL shape: role lease TTL still far in the future,
+        # holder pid dead. No release, no cleanup.
+        tmp_state.heartbeat_lease(ownership.RECORDER_ROLE_SCOPE,
+                                  owner='victim-server',
+                                  pid=self._dead_pid(), ttl_s=3600)
+
+        # Successor = a fresh process: in-memory rollup cursors gone.
+        metrics_history.reset_for_test()
+        # Election does NOT wait out the TTL — the dead pid is
+        # observable and the role flips on the first attempt.
+        assert metrics_history.hold_recorder_lease()
+        role = tmp_state.get_lease(ownership.RECORDER_ROLE_SCOPE)
+        assert role['owner'] == ownership.server_id()
+        takeovers = tmp_state.get_recovery_events(
+            event_type='reconcile.role_takeover')
+        assert len(takeovers) == 1
+        assert takeovers[0]['detail']['from'] == 'victim-server'
+        assert takeovers[0]['trace_id'], \
+            'takeover row must resolve through `xsky trace`'
+
+        # The successor's first tick folds the REMAINING two windows:
+        # cursor recovered from the 1m tier's MAX(ts), so the window
+        # the victim already folded is not re-folded.
+        metrics_history.record_points([], ts=T0 + 240)
+        rows = tmp_state.get_metric_points(name='g', res='1m')
+        assert len(rows) == 3
+        assert len({r['ts'] for r in rows}) == 3, \
+            'a 1m window was folded twice across the failover'
+        assert [r['value'] for r in sorted(rows,
+                                           key=lambda r: r['ts'])] == \
+            [2.0, 6.0, 10.0]
+        # Re-election by the SAME holder is a renewal, not another
+        # takeover — no second journal row.
+        assert metrics_history.hold_recorder_lease()
+        assert len(tmp_state.get_recovery_events(
+            event_type='reconcile.role_takeover')) == 1
+
+
 # ---- anomaly detectors ------------------------------------------------------
 
 

@@ -164,9 +164,14 @@ class TestRunInParallel:
     def test_chaos_point_fails_individual_rank(self):
         """A chaos rule matched on (phase, rank) fails exactly that
         rank mid-fan-out; every rank traverses the point."""
+        # latency_s keeps rank 2's failure from landing before the
+        # last worker has dequeued: an instant raise may gang-cancel a
+        # still-queued rank (legal per the abort contract), and this
+        # test asserts point coverage, not cancellation timing.
         chaos.load_plan({'points': {'fanout.worker': {
             'match': {'phase': 'unitboot', 'rank': 2},
-            'first_n': 1, 'error': 'ConnectionError'}}})
+            'first_n': 1, 'latency_s': 0.05,
+            'error': 'ConnectionError'}}})
         probe = _ConcurrencyProbe(delay=0.1)
         with pytest.raises(exceptions.MultiHostError) as ei:
             parallelism.run_in_parallel(probe, [0, 1, 2, 3],

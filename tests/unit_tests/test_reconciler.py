@@ -393,3 +393,126 @@ class TestCrashSmoke:
         assert reconciler.reconcile(requeue_requests=False) == []
         report = reconciler.health_report()
         assert report['healthy'], report
+
+
+class TestOwnershipTakeover:
+    """Multi-server arbitration: ``try_acquire_lease`` + the
+    ownership claim layer must converge racing takeovers of the same
+    dead server's scopes to exactly ONE owner — one respawn, one
+    journal row, the loser yielding."""
+
+    @staticmethod
+    def _dead_pid():
+        """A pid guaranteed dead: a child we already reaped."""
+        import subprocess
+        proc = subprocess.Popen(['true'])
+        proc.wait()
+        return proc.pid
+
+    def test_try_acquire_semantics(self, lease_env):
+        # Fresh scope: first caller wins.
+        assert state_lib.try_acquire_lease('job/9', owner='s0')
+        first = state_lib.get_lease('job/9')
+        # Same holder re-acquiring is a renewal: still True, expiry
+        # pushed, started_at preserved (doctor's uptime anchor).
+        time.sleep(0.01)
+        assert state_lib.try_acquire_lease('job/9', owner='s0',
+                                           ttl_s=120)
+        renewed = state_lib.get_lease('job/9')
+        assert renewed['started_at'] == first['started_at']
+        assert renewed['expires_at'] > first['expires_at']
+        # A DIFFERENT server against a live holder loses, and the
+        # row is untouched.
+        assert not state_lib.try_acquire_lease('job/9', owner='s1')
+        assert state_lib.get_lease('job/9')['owner'] == 's0'
+        # Holder pid dead but TTL unexpired: claimable immediately
+        # (the SIGKILL drill's path — waiting out the TTL would
+        # orphan every scope for a minute).
+        state_lib.heartbeat_lease('job/10', owner='victim',
+                                  pid=self._dead_pid(), ttl_s=3600)
+        assert state_lib.try_acquire_lease('job/10', owner='s1')
+        assert state_lib.get_lease('job/10')['owner'] == 's1'
+
+    def test_racing_acquires_converge_to_one_owner(self, lease_env):
+        """N threads race the same scope; exactly one must win — the
+        conditional-UPSERT arbitration the claim layer rests on."""
+        import threading
+        wins = []
+        barrier = threading.Barrier(4)
+
+        def racer(sid):
+            barrier.wait()
+            if state_lib.try_acquire_lease('claim/job/7', owner=sid):
+                wins.append(sid)
+
+        threads = [threading.Thread(target=racer, args=(f's{i}',))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(wins) == 1, wins
+        assert state_lib.get_lease('claim/job/7')['owner'] == wins[0]
+
+    def test_racing_ticks_respawn_controller_once(
+            self, control_plane_env):
+        """Two reconciler ticks racing the same dead controller: the
+        tick that loses the repair claim journals a yield and touches
+        NOTHING (no respawn, no slot release); the winner respawns
+        exactly once; a third tick is a no-op."""
+        from skypilot_tpu.jobs import scheduler
+        from skypilot_tpu.jobs import state as jobs_state
+        from skypilot_tpu.utils import ownership
+
+        ownership.reset_for_test()
+        job_id = jobs_state.add_job('ghost', {'name': 'ghost'})
+        jobs_state.set_status(job_id,
+                              jobs_state.ManagedJobStatus.RUNNING)
+        jobs_state.set_schedule_state(job_id,
+                                      jobs_state.ScheduleState.ALIVE)
+        jobs_state.set_controller_pid(job_id, self._dead_pid())
+        scope = f'job/{job_id}'
+
+        # A racing peer server (live pid, different identity) already
+        # claimed this takeover: our tick must yield, not respawn.
+        assert state_lib.try_acquire_lease(f'claim/{scope}',
+                                           owner='peer-server')
+        summary = scheduler._reconcile_dead_controllers()
+        assert summary['respawned'] == []
+        record = jobs_state.get_job(job_id)
+        assert record['schedule_state'] is jobs_state.ScheduleState.ALIVE
+        assert record['controller_respawns'] == 0
+        yields = state_lib.get_recovery_events(
+            scope=scope, event_type='reconcile.takeover_yield')
+        assert len(yields) == 1
+        assert yields[0]['detail']['winner'] == 'peer-server'
+        respawns = state_lib.get_recovery_events(
+            scope=scope, event_type='reconcile.controller_respawn')
+        assert respawns == []
+
+        # Peer died before repairing (its claim expires / pid dies is
+        # equivalent — release models the claim lapsing): the next
+        # tick wins the claim and respawns exactly once.
+        state_lib.release_lease(f'claim/{scope}')
+        summary = scheduler._reconcile_dead_controllers()
+        assert summary['respawned'] == [job_id]
+        record = jobs_state.get_job(job_id)
+        assert record['schedule_state'] is \
+            jobs_state.ScheduleState.WAITING
+        respawns = state_lib.get_recovery_events(
+            scope=scope, event_type='reconcile.controller_respawn')
+        assert len(respawns) == 1
+        # Convergence: the claim lease names the winner (this
+        # process), so any further racer loses until the TTL lapses.
+        claim = state_lib.get_lease(f'claim/{scope}')
+        assert claim is not None
+        assert claim['owner'] == ownership.server_id()
+
+        # Idempotence: the repaired job is WAITING, outside the
+        # dead-controller filter — another tick changes nothing and
+        # journals nothing new.
+        summary = scheduler._reconcile_dead_controllers()
+        assert summary['respawned'] == []
+        respawns = state_lib.get_recovery_events(
+            scope=scope, event_type='reconcile.controller_respawn')
+        assert len(respawns) == 1

@@ -330,6 +330,26 @@ FIXTURES = {
             'def tock(k):\n'
             '    _SINGLE.pop(k, None)\n'},
     ),
+    'server-singleton': (
+        {'skypilot_tpu/server/reg.py':
+            '_PENDING = {}\n'
+            'def flush(state):\n'
+            '    for key, rows in _PENDING.items():\n'
+            '        state.record_rows(key, rows)\n'},
+        {'skypilot_tpu/server/reg.py':
+            'from skypilot_tpu.utils import ownership\n'
+            '# single-writer ok: flushed only by the elected '
+            'recorder tick.\n'
+            '_PENDING = {}\n'
+            '_CURSOR = {}\n'
+            'def flush(state):\n'
+            '    for key, rows in _PENDING.items():\n'
+            '        state.record_rows(key, rows)\n'
+            'def fold(state):\n'
+            "    if not ownership.owns('role/recorder'):\n"
+            '        return\n'
+            "    _CURSOR['x'] = state.record_rows('x', [])\n"},
+    ),
     'schema-consistency': (
         {'skypilot_tpu/state.py':
             'SCHEMA = """CREATE TABLE IF NOT EXISTS widgets (\n'

@@ -122,6 +122,16 @@ class _Server:
         except subprocess.TimeoutExpired:
             self._proc.kill()
 
+    def kill(self) -> None:
+        """SIGKILL — the chaos drill's server death. No lease release,
+        no pidfile cleanup, no drain: exactly what a node loss looks
+        like to the peers sharing the state DB."""
+        self._proc.kill()
+        self._proc.wait(timeout=10)
+
+    def alive(self) -> bool:
+        return self._proc.poll() is None
+
 
 def _seed(clusters: int) -> dict:
     """Register the fleet + observability rows at realistic ratios.
@@ -304,15 +314,19 @@ def _percentiles(samples: list) -> dict:
             'mean_ms': round(statistics.fmean(ordered) * 1000, 2)}
 
 
-def _saturate(port: int, verb: str, op_factory, duration_s: float,
+def _saturate(ports, verb: str, op_factory, duration_s: float,
               workers: int) -> dict:
     """Closed loop: `workers` threads drive `verb` back-to-back for
-    `duration_s`; QPS = completions / wall-clock."""
+    `duration_s`; QPS = completions / wall-clock. `ports` may be one
+    port or a list — workers are assigned round-robin across the list
+    (the multi-server mode's client-side load balancing)."""
+    if isinstance(ports, int):
+        ports = [ports]
     latencies, errors = [], []
     lock = threading.Lock()
     stop_at = time.monotonic() + duration_s
 
-    def worker():
+    def worker(port):
         client = _Client(port)
         ops = op_factory(client)
         while time.monotonic() < stop_at:
@@ -326,8 +340,9 @@ def _saturate(port: int, verb: str, op_factory, duration_s: float,
             with lock:
                 latencies.append(time.monotonic() - t0)
 
-    threads = [threading.Thread(target=worker, daemon=True,
-                                name=f'bench-closed-{i}')
+    threads = [threading.Thread(target=worker,
+                                args=(ports[i % len(ports)],),
+                                daemon=True, name=f'bench-closed-{i}')
                for i in range(workers)]
     t_start = time.monotonic()
     for t in threads:
@@ -411,6 +426,358 @@ def _open_loop(port: int, op_factory, mix: dict, total_qps: float,
     }
 
 
+# ---- multi-server mode (horizontal control plane) --------------------------
+
+_GOODPUT_SEED_CLUSTER = 'bench-c00000'
+
+
+def _seed_rollup_backlog() -> int:
+    """Backdated raw metric points (~40 min, 15 s apart): the elected
+    recorder's first ``_advance_rollups`` folds dozens of completed
+    1m/10m windows from them, so the drill's fold-once check has real
+    buckets to find duplicates in instead of passing vacuously on an
+    empty table."""
+    from skypilot_tpu import state
+    now = time.time()
+    rows = []
+    for name in ('xsky_bench_seed_a', 'xsky_bench_seed_b'):
+        t = now - 2400.0
+        i = 0
+        while t < now:
+            rows.append({'ts': t, 'res': 'raw', 'name': name,
+                         'labels': {'src': 'bench'}, 'kind': 'gauge',
+                         'value': float(i % 17)})
+            t += 15.0
+            i += 1
+    state.record_metric_points(rows, ts=now)
+    return len(rows)
+
+
+def _seed_goodput(seconds: float, start_ts: float, origin_ts: float,
+                  ts: float) -> None:
+    """One goodput ledger fold for the seeded cluster. The drill
+    writes a second fold with a DIFFERENT start_ts (what a lease
+    takeover does to the lease-derived window start) but the SAME
+    detail.origin_ts and a LOWER loss value — the /metrics floor must
+    hold, which is exactly the keyed-by-incarnation-origin fix."""
+    from skypilot_tpu import state
+    state.record_goodput_ledger(_GOODPUT_SEED_CLUSTER, 7, [{
+        'kind': 'job', 'incarnation': 0, 'start_ts': start_ts,
+        'end_ts': None, 'ranks': 4, 'full_ranks': 4,
+        'wall_s': 1000.0, 'productive_s': 1000.0 - seconds,
+        'loss_s': seconds, 'goodput': 1.0 - seconds / 1000.0,
+        'seconds': {'provision': seconds},
+        'detail': {'incarnations': 1, 'origin_ts': origin_ts},
+    }], ts=ts)
+
+
+def _scrape_goodput(port: int) -> dict:
+    """(cluster, cause) -> value from one server's /metrics scrape."""
+    import re
+    conn = http.client.HTTPConnection('127.0.0.1', port, timeout=30)
+    try:
+        conn.request('GET',
+                     '/metrics?name=xsky_goodput_loss_seconds_total')
+        text = conn.getresponse().read().decode()
+    finally:
+        conn.close()
+    pat = re.compile(r'xsky_goodput_loss_seconds_total\{'
+                     r'cluster="([^"]*)",cause="([^"]*)"\}'
+                     r' ([0-9.eE+-]+)')
+    return {(m.group(1), m.group(2)): float(m.group(3))
+            for m in pat.finditer(text)}
+
+
+def _warm_server(port: int) -> list:
+    warm = _Client(port)
+    targets = []
+    for _ in range(2):
+        payload = warm.run_to_completion('jobs.queue', {'limit': 1})
+        targets.append(payload['request_id'])
+    warm.run_to_completion('status', {'limit': 1})
+    return targets
+
+
+def _run_multi_server(args) -> dict:
+    """N API servers, one shared DB pair: scaling + server-kill drill.
+
+    Phase 1 measures status-QPS saturation against ONE server, phase 2
+    against ``--servers`` of them behind round-robin workers (the
+    scaling claim). Phase 3 is the chaos drill: with request load
+    flowing to every server, SIGKILL the one holding the recorder role
+    and verify from the shared DB that (a) every acknowledged request
+    id reaches a terminal status (none lost) and none is requeued
+    twice (none executed twice), (b) the orphaned requests and the
+    recorder role are re-owned within ONE lease TTL with trace-linked
+    ``reconcile.*`` journal rows, (c) the rollup tiers contain zero
+    double-folded buckets, and (d) the goodput loss counter stays
+    monotone through an origin-preserving ledger takeover.
+    """
+    from skypilot_tpu import state
+    from skypilot_tpu.server import requests_db
+
+    n = max(int(args.servers), 3)
+    ttl = 10.0
+    # Shared by the servers (inherited env) AND this process's own
+    # reads: membership/claims only converge when every process
+    # agrees on the TTL. Tight reconcile/recorder cadences keep the
+    # drill inside seconds instead of production minutes.
+    os.environ['XSKY_LEASE_TTL_S'] = str(ttl)
+    os.environ['XSKY_RECONCILE_INTERVAL_S'] = '1'
+    os.environ['XSKY_METRICS_RECORD_INTERVAL_S'] = '0.5'
+
+    result = {'servers': n, 'lease_ttl_s': ttl, 'failures': []}
+    fail = result['failures'].append
+
+    seeded_raw = _seed_rollup_backlog()
+    t_origin = time.time() - 600.0
+    _seed_goodput(100.0, start_ts=t_origin, origin_ts=t_origin,
+                  ts=time.time() - 5.0)
+
+    # Phase 1: one-server baseline.
+    base = _Server({'XSKY_SERVER_ID': 'w0', 'XSKY_STATE_READ_POOL': '1'})
+    try:
+        targets = _warm_server(base.port)
+
+        def factory(client, _targets=targets):
+            return _make_ops(client, args.page, legacy=False,
+                             poll_targets=_targets)
+
+        one = _saturate(base.port, 'status', factory, args.duration,
+                        args.workers)
+    finally:
+        base.stop()
+    result['one_server'] = one
+
+    # Phase 2 + 3 run against the same N-server fleet.
+    servers = {}
+    try:
+        for i in range(n):
+            servers[f's{i}'] = _Server({'XSKY_SERVER_ID': f's{i}',
+                                        'XSKY_STATE_READ_POOL': '1'})
+        ports = [s.port for s in servers.values()]
+        targets = _warm_server(ports[0])
+
+        def factory_n(client, _targets=targets):
+            return _make_ops(client, args.page, legacy=False,
+                             poll_targets=_targets)
+
+        multi = _saturate(ports, 'status', factory_n, args.duration,
+                          args.workers)
+        result['n_servers'] = multi
+        scale = (multi['qps'] / one['qps'] if one['qps']
+                 else float('inf'))
+        result['status_qps_scale'] = round(scale, 2)
+
+        # The victim is whichever server won the recorder election —
+        # killing it forces BOTH takeover paths (requests + role).
+        recorder_sid = None
+        wait_until = time.monotonic() + 30
+        while time.monotonic() < wait_until:
+            lease = state.get_lease('role/recorder')
+            if lease and lease['owner'] in servers and \
+                    state.lease_is_live(lease):
+                recorder_sid = lease['owner']
+                break
+            time.sleep(0.25)
+        if recorder_sid is None:
+            fail('no server won the recorder election within 30 s')
+            recorder_sid = sorted(servers)[-1]
+        victim_sid = recorder_sid
+        victim = servers[victim_sid]
+        survivor_port = next(s.port for sid, s in servers.items()
+                             if sid != victim_sid)
+        result['victim'] = victim_sid
+
+        goodput_key = (_GOODPUT_SEED_CLUSTER, 'provision')
+        goodput_before = _scrape_goodput(survivor_port).get(goodput_key)
+        if goodput_before is None:
+            fail('seeded goodput series missing from /metrics')
+
+        # Drill load: submit-only round-robin workers on every server
+        # (completion is audited from the shared DB afterwards, which
+        # is the request-id accounting).
+        acked, acked_lock = [], threading.Lock()
+        stop_evt = threading.Event()
+
+        def submitter(port):
+            client = _Client(port)
+            while not stop_evt.is_set():
+                try:
+                    rid = client.submit('jobs.queue', {'limit': 5})
+                except Exception:  # pylint: disable=broad-except
+                    # Dead server (mid-drill) or transient drop: the
+                    # submit was never acknowledged, so it is outside
+                    # the accounting by definition.
+                    time.sleep(0.2)
+                    continue
+                with acked_lock:
+                    acked.append(rid)
+                time.sleep(0.01)
+
+        subs = [threading.Thread(target=submitter, args=(p,),
+                                 daemon=True, name=f'bench-drill-{i}')
+                for i, p in enumerate(ports)]
+        for t in subs:
+            t.start()
+        time.sleep(1.0)
+
+        # Burst slow full-listing requests at the victim so a real
+        # backlog (PENDING + RUNNING rows) is in flight at the kill.
+        burst_ids = []
+        try:
+            burst = _Client(victim.port)
+            for _ in range(40):
+                burst_ids.append(burst.submit('status', {}))
+        except Exception:  # pylint: disable=broad-except
+            pass
+        with acked_lock:
+            acked.extend(burst_ids)
+        victim.kill()
+        t_kill = time.time()
+        result['burst_acked'] = len(burst_ids)
+
+        time.sleep(2.0)   # load keeps flowing through the kill
+        stop_evt.set()
+        for t in subs:
+            t.join(timeout=15)
+
+        # (b) recorder role re-owned within one TTL.
+        reown_s = None
+        while time.time() < t_kill + ttl:
+            lease = state.get_lease('role/recorder')
+            if lease and lease['owner'] != victim_sid and \
+                    state.lease_is_live(lease):
+                reown_s = time.time() - t_kill
+                break
+            time.sleep(0.2)
+        result['recorder_reown_s'] = (round(reown_s, 2)
+                                      if reown_s is not None else None)
+        if reown_s is None:
+            fail(f'recorder role not re-owned within one lease TTL '
+                 f'({ttl:.0f} s)')
+
+        # (a) zero lost: every acknowledged id reaches terminal.
+        unique_acked = sorted(set(acked))
+        result['acked_requests'] = len(unique_acked)
+        pending = set(unique_acked)
+        vanished = set()
+        wait_until = time.monotonic() + ttl + 30
+        while pending and time.monotonic() < wait_until:
+            settled = set()
+            for rid in pending:
+                rec = requests_db.get_status(rid)
+                if rec is None:
+                    vanished.add(rid)
+                    settled.add(rid)
+                elif rec['status'].is_terminal():
+                    settled.add(rid)
+            pending -= settled
+            if pending:
+                time.sleep(0.3)
+        result['requests_lost'] = len(vanished) + len(pending)
+        if vanished:
+            fail(f'{len(vanished)} acknowledged request ids vanished '
+                 'from the requests table')
+        if pending:
+            fail(f'{len(pending)} acknowledged requests never reached '
+                 'a terminal status')
+
+        # Journal audit: repairs exist, landed inside one TTL, are
+        # trace-linked, and no request was requeued twice.
+        events = state.get_recovery_events(since=t_kill - 0.5,
+                                           limit=100000)
+        requeues = [r for r in events
+                    if r['event_type'] == 'reconcile.request_requeued']
+        aborts = [r for r in events
+                  if r['event_type'] == 'reconcile.request_aborted']
+        takeovers = [r for r in events
+                     if r['event_type'] == 'reconcile.role_takeover'
+                     and (r.get('detail') or {}).get('from') ==
+                     victim_sid]
+        yields = [r for r in events
+                  if r['event_type'] == 'reconcile.takeover_yield']
+        result['repairs'] = {
+            'requests_requeued': len(requeues),
+            'requests_aborted': len(aborts),
+            'role_takeovers': len(takeovers),
+            'claim_yields': len(yields),
+        }
+        if not requeues and not aborts:
+            fail('the kill orphaned no requests — the drill proved '
+                 'nothing (raise the burst size)')
+        if not takeovers:
+            fail('no reconcile.role_takeover journal row names the '
+                 'victim as the previous recorder')
+        late = [r for r in requeues + aborts + takeovers
+                if r['ts'] > t_kill + ttl]
+        if late:
+            fail(f'{len(late)} takeover repairs landed after one '
+                 'lease TTL')
+        unlinked = [r for r in requeues + aborts + takeovers
+                    if not r.get('trace_id')]
+        if unlinked:
+            fail(f'{len(unlinked)} takeover journal rows are not '
+                 'trace-linked')
+        requeued_scopes = [r['scope'] for r in requeues]
+        dup_requeues = sorted({s for s in requeued_scopes
+                               if requeued_scopes.count(s) > 1})
+        if dup_requeues:
+            fail('requests requeued more than once (double '
+                 f'execution): {dup_requeues[:5]}')
+
+        # (c) rollup fold-once: no duplicate 1m/10m buckets, and the
+        # check is non-vacuous (the backdated seed folded).
+        time.sleep(1.5)   # successor's next tick folds the tail
+        import sqlite3
+        conn = sqlite3.connect(os.environ['XSKY_STATE_DB'])
+        try:
+            rows_1m = conn.execute(
+                "SELECT COUNT(*) FROM metric_points WHERE res='1m'"
+            ).fetchone()[0]
+            dup_buckets = conn.execute(
+                'SELECT COUNT(*) FROM (SELECT res, name, labels, ts '
+                "FROM metric_points WHERE res IN ('1m', '10m') "
+                'GROUP BY res, name, labels, ts '
+                'HAVING COUNT(*) > 1)').fetchone()[0]
+        finally:
+            conn.close()
+        result['rollup'] = {'rows_1m': rows_1m,
+                            'duplicate_buckets': dup_buckets,
+                            'seeded_raw': seeded_raw}
+        if rows_1m == 0:
+            fail('no 1m rollup rows folded — fold-once check vacuous')
+        if dup_buckets:
+            fail(f'{dup_buckets} double-folded rollup buckets')
+
+        # (d) goodput floors stay monotone across a takeover: newer
+        # fold, same origin_ts, RESET start_ts, lower loss value.
+        _seed_goodput(40.0, start_ts=time.time(), origin_ts=t_origin,
+                      ts=time.time())
+        goodput_after = _scrape_goodput(survivor_port).get(goodput_key)
+        result['goodput_loss'] = {'before': goodput_before,
+                                  'after': goodput_after}
+        if goodput_before is not None and (
+                goodput_after is None or
+                goodput_after < goodput_before - 1e-6):
+            fail('goodput loss counter regressed across takeover: '
+                 f'{goodput_before} -> {goodput_after}')
+    finally:
+        for s in servers.values():
+            if s.alive():
+                s.stop()
+
+    result['min_status_scale'] = args.min_status_scale
+    if not args.smoke and scale < args.min_status_scale:
+        # Like the ≥5x read-pool speedup, near-linear scaling is a
+        # big-fleet statement — smoke boxes (2 cores, shared) report
+        # the number but only the full run gates on it.
+        fail(f'status QPS scaled {scale:.2f}x from 1 to {n} servers '
+             f'(gate: >= {args.min_status_scale}x)')
+    result['pass'] = not result['failures']
+    return result
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--clusters', type=int, default=5000)
@@ -442,6 +809,16 @@ def main() -> int:
                              '(smoke is gate-only by default: the '
                              'compare costs two extra server spawns '
                              'and its speedup is a 5k-fleet number)')
+    parser.add_argument('--multi-server', action='store_true',
+                        help='horizontal mode: N server processes on '
+                             'one shared DB — scaling measurement plus '
+                             'the SIGKILL server-kill chaos drill')
+    parser.add_argument('--servers', type=int, default=3,
+                        help='server process count in --multi-server '
+                             '(min 3: the drill needs survivors)')
+    parser.add_argument('--min-status-scale', type=float, default=2.0,
+                        help='required status-QPS scaling from 1 to N '
+                             'servers (full --multi-server runs only)')
     parser.add_argument('--json-out', default=None)
     args = parser.parse_args()
 
@@ -461,6 +838,30 @@ def main() -> int:
 
     scratch = tempfile.mkdtemp(prefix='xsky-bench-controlplane-')
     _setup_env(scratch)
+
+    if args.multi_server:
+        if not args.smoke and args.clusters == 5000:
+            args.clusters = 10000   # the acceptance fleet size
+        t0 = time.monotonic()
+        seeded = _seed(args.clusters)
+        seed_s = time.monotonic() - t0
+        multi = _run_multi_server(args)
+        record = {
+            'metric': 'controlplane_multiserver',
+            'clusters': args.clusters,
+            'smoke': bool(args.smoke),
+            'seeded': seeded,
+            'seed_s': round(seed_s, 2),
+            'workers': args.workers,
+            'multi_server': multi,
+            'pass': multi['pass'],
+        }
+        line = json.dumps(record)
+        print(line)
+        if args.json_out:
+            with open(args.json_out, 'w', encoding='utf-8') as f:
+                f.write(line + '\n')
+        return 0 if multi['pass'] else 1
 
     t0 = time.monotonic()
     seeded = _seed(args.clusters)

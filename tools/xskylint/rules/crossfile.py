@@ -26,6 +26,7 @@ a full sort at fleet scale.
 """
 from __future__ import annotations
 
+import ast
 import os
 import re
 from typing import Optional, Set
@@ -254,6 +255,137 @@ class LockDisciplineRule(engine.Rule):
                     'definition `# single-writer ok: <why>`')
 
 
+# Write surfaces that persist rows other processes read back. A
+# module-level container flowing into one of these is cross-server
+# state, not a process-local cache.
+_PERSIST_CALLS = frozenset({
+    'rollup_metric_points', 'heartbeat_lease', 'heartbeat_leases',
+    'executemany',
+})
+# References that prove the containing module routes its persisted
+# writes through lease arbitration (the ownership layer or the
+# conditional-lease primitive underneath it).
+_LEASE_REFS = frozenset({
+    'ownership', 'hold_role', 'hold_recorder_lease',
+    'try_acquire_lease', 'claim_repair', 'owns', 'owner_for',
+})
+
+
+class ServerSingletonRule(engine.Rule):
+    """Horizontal-control-plane twin of lock-discipline: in the
+    multi-writer modules (``server/``, the metrics recorder, the agent
+    goodput fold) a module-level mutable container whose contents feed
+    PERSISTED rows is per-process state writing to a shared DB — with
+    N API servers that is N independent copies all writing, unless the
+    write path is lease-arbitrated. Such a container must either be
+    referenced alongside the ownership/lease layer somewhere in the
+    module (the election IS the guard) or carry a registered
+    ``# single-writer ok: <why>`` reason. Locks don't help here:
+    a ``threading.Lock`` serializes one process's threads, not two
+    servers' writes."""
+
+    id = 'server-singleton'
+    rationale = ('module-level mutable state feeding persisted rows '
+                 'in multi-server modules must be lease-guarded or '
+                 'carry a # single-writer ok: reason — a per-process '
+                 'threading.Lock cannot arbitrate N servers')
+
+    _SCOPED_FILES = ('skypilot_tpu/utils/metrics_history.py',
+                     'skypilot_tpu/agent/goodput.py')
+
+    def applies_to(self, rel_path: str) -> bool:
+        return (rel_path.startswith('skypilot_tpu/server/') or
+                rel_path in self._SCOPED_FILES)
+
+    def end_file(self, ctx: engine.FileContext) -> None:
+        containers = self._module_containers(ctx)
+        if not containers:
+            return
+        # Per-function facts: which containers it touches, whether it
+        # reaches a persist-write, whether it references the lease
+        # layer. Method defs count too — a class wrapping module state
+        # does not change who owns the rows.
+        feeding: dict = {}
+        guarded: set = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            touched = set()
+            persists = False
+            leased = False
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name):
+                    if sub.id in containers:
+                        touched.add(sub.id)
+                    if sub.id in _LEASE_REFS:
+                        leased = True
+                elif isinstance(sub, ast.Attribute):
+                    if sub.attr in _LEASE_REFS:
+                        leased = True
+                elif isinstance(sub, ast.Call):
+                    name = engine.call_name(sub)
+                    if name in _PERSIST_CALLS or \
+                            name.startswith('record_'):
+                        persists = True
+                    if name in _LEASE_REFS:
+                        leased = True
+            if leased:
+                guarded.update(touched)
+            if persists:
+                for cname in touched:
+                    feeding.setdefault(cname, node.name)
+        for cname, func in sorted(feeding.items()):
+            if cname in guarded:
+                continue
+            lineno, exempt = containers[cname]
+            if exempt:
+                continue
+            ctx.report(
+                self.id, lineno,
+                f'module-level container {cname!r} feeds persisted '
+                f'rows (via {func}) but no function referencing it '
+                'touches the ownership/lease layer — with N API '
+                'servers every process writes its own copy; gate the '
+                'write path on the lease election or mark the '
+                'definition `# single-writer ok: <why>`')
+
+    @staticmethod
+    def _module_containers(ctx: engine.FileContext) -> dict:
+        """name -> (lineno, exempt) for top-level mutable containers,
+        using the same shapes and ``# single-writer ok`` marker scan
+        as the whole-program index."""
+        def marked(lineno: int) -> bool:
+            lines = ctx.lines
+            if lineno <= len(lines) and \
+                    '# single-writer ok' in lines[lineno - 1]:
+                return True
+            i = lineno - 1
+            while 1 <= i <= len(lines) and \
+                    lines[i - 1].strip().startswith('#'):
+                if '# single-writer ok' in lines[i - 1]:
+                    return True
+                i -= 1
+            return False
+
+        out: dict = {}
+        for node in ctx.tree.body:
+            targets, value = [], None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and \
+                    node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None:
+                continue
+            if index_mod.ProjectIndex._container_kind(value) is None:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = (node.lineno, marked(node.lineno))
+        return out
+
+
 # SQL keywords/functions that a naive identifier scan would otherwise
 # mistake for column names.
 _SQL_NOISE = frozenset({
@@ -400,4 +532,4 @@ class SchemaConsistencyRule(engine.Rule):
 
 
 RULES = [VerbWiringRule, NameRegistryRule, LockDisciplineRule,
-         SchemaConsistencyRule]
+         ServerSingletonRule, SchemaConsistencyRule]
