@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
+from skypilot_tpu.infer import paged_kv
 from skypilot_tpu.infer import sampling
 from skypilot_tpu.models import llama
 from skypilot_tpu.parallel import mesh as mesh_lib
@@ -63,6 +64,24 @@ class EngineConfig:
     # pow2-padded wave can still overshoot small waves — disable to
     # force per-prompt admission.
     batched_admission: bool = True
+    # > 0 switches the KV cache to the paged layout (vLLM-style): the
+    # cache becomes a shared page arena [L, num_pages, page_size, ...]
+    # and each slot owns a block table mapping its logical KV blocks to
+    # physical pages. Admission is then gated by free-page headroom for
+    # each request's ACTUAL budget (prompt + max_new_tokens) rather
+    # than by worst-case max_target_len reservation — see
+    # infer/paged_kv.py for the allocator and sentinel semantics.
+    # Must divide max_target_len and every prefill bucket. Families
+    # must provide a paged_decode_forward hook (llama + deepseek).
+    kv_page_size: int = 0
+    # Pages in the arena; 0 sizes it to the dense cache's footprint
+    # (max_slots * max_target_len / kv_page_size) — same HBM, but
+    # admission can oversubscribe slots whose budgets are short.
+    kv_num_pages: int = 0
+
+    @property
+    def paged(self) -> bool:
+        return self.kv_page_size > 0
 
     @property
     def max_prompt_len(self) -> int:
@@ -219,7 +238,44 @@ class InferenceEngine:
         self.mesh = mesh
         self._key = jax.random.PRNGKey(0)
         c = config.model
-        if hasattr(self._model_lib, 'kv_cache_shapes'):
+        self._page_alloc: Optional[paged_kv.PageAllocator] = None
+        if config.paged:
+            page = config.kv_page_size
+            if config.kv_dtype == jnp.int8:
+                raise NotImplementedError(
+                    'int8 KV is not supported with the paged cache.')
+            if mesh is not None:
+                raise NotImplementedError(
+                    'mesh sharding is not supported with the paged '
+                    'cache (the page arena has no slot axis to shard).')
+            if getattr(c, 'sliding_window', None) is not None:
+                raise NotImplementedError(
+                    'sliding_window is not supported with the paged '
+                    'cache.')
+            if not hasattr(self._model_lib, 'paged_decode_forward'):
+                raise NotImplementedError(
+                    f'{self._model_lib.__name__} has no '
+                    'paged_decode_forward hook; use the dense cache.')
+            bad = [n for n in (config.max_target_len,
+                               *config.prefill_buckets) if n % page]
+            if bad:
+                raise ValueError(
+                    f'kv_page_size {page} must divide max_target_len '
+                    f'and every prefill bucket; offending sizes: {bad}')
+            blocks_per_slot = config.max_target_len // page
+            num_pages = (config.kv_num_pages or
+                         config.max_slots * blocks_per_slot)
+            self._page_alloc = paged_kv.PageAllocator(
+                num_pages, page, blocks_per_slot)
+            if hasattr(self._model_lib, 'paged_kv_cache_shapes'):
+                self._k_shape, self._v_shape = (
+                    self._model_lib.paged_kv_cache_shapes(
+                        c, num_pages, page))
+            else:
+                self._k_shape = self._v_shape = (
+                    c.n_layers, num_pages, page,
+                    c.n_kv_heads, c.head_dim)
+        elif hasattr(self._model_lib, 'kv_cache_shapes'):
             # Families with a non-[KVH, HD] cache layout (MLA's
             # compressed latent) declare their own shapes.
             self._k_shape, self._v_shape = self._model_lib.kv_cache_shapes(
@@ -291,7 +347,53 @@ class InferenceEngine:
             'counts': jnp.zeros((cfg.max_slots,
                                  cfg.model.vocab_size), jnp.uint8),
         }
+        if self._page_alloc is not None:
+            pa = self._page_alloc
+            # All-sentinel tables: every unadmitted slot's writes drop.
+            state['block_tables'] = jnp.full(
+                (cfg.max_slots, pa.blocks_per_slot), pa.sentinel,
+                jnp.int32)
         return state
+
+    @property
+    def kv_page_stats(self) -> Optional[Dict[str, int]]:
+        """Free/total pages for the serving gauges; None when dense."""
+        pa = self._page_alloc
+        if pa is None:
+            return None
+        return {'total': pa.num_pages, 'free': pa.free_pages,
+                'page_size': pa.page_size}
+
+    # ---- paged-KV admission ----
+
+    def reserve_kv(self, slot: int, prompt_len: int,
+                   max_new: int) -> bool:
+        """Reserve KV capacity for a request's full budget before
+        admission. Dense engines always admit (the slot IS the
+        reservation); paged engines take pages for prompt + max_new up
+        front so the fused decode loop can never outrun its pages —
+        False means "no headroom now", and the caller defers."""
+        if self._page_alloc is None:
+            return True
+        return self._page_alloc.allocate(slot, prompt_len + max_new)
+
+    def release_kv(self, slot: int) -> None:
+        """Host-side page release for claimed-but-never-finished paths
+        (admission failure, cancellation). Finish paths go through
+        release_slot, which also sentinels the device table row."""
+        if self._page_alloc is not None:
+            self._page_alloc.release(slot)
+
+    def kv_admissible(self, prompt_len: int, max_new: int) -> bool:
+        """Whether a request's KV budget could EVER fit — checked at
+        submit so a too-big request is rejected up front instead of
+        parking in the deferred queue forever and deadlocking drain.
+        (Bounded by per-slot table rows as well as total pages.)"""
+        pa = self._page_alloc
+        if pa is None:
+            return True
+        need = pa.pages_for(prompt_len + max_new)
+        return need <= min(pa.num_pages, pa.blocks_per_slot)
 
     # ---- prefill ----
 
@@ -369,6 +471,40 @@ class InferenceEngine:
                            .at[slots, first_tokens].set(1))
         return state
 
+    @functools.partial(jax.jit, static_argnums=(0,),
+                       donate_argnums=(1,))
+    def _insert_batch_paged(self, state, kv, first_tokens, true_lens,
+                            slots, tables):
+        """Paged twin of _insert_batch: the prefill prefix reshapes
+        into page-sized blocks and scatters through each row's block
+        table in one dispatch. `tables` [B, blocks_per_slot] carries
+        sentinel entries beyond each row's reservation (and everywhere
+        for pad rows), so blocks past the reservation — prefill-bucket
+        padding, never-live rows — are DROPPED by the out-of-bounds
+        scatter; real prompt rows always land (the reservation covers
+        prompt + max_new by construction)."""
+        cfg = self.config
+        page = cfg.kv_page_size
+        k = kv['k'][:, :, :cfg.max_target_len]
+        v = kv['v'][:, :, :cfg.max_target_len]
+        length, b = k.shape[2], k.shape[1]
+        nblk = length // page
+        kb = k.reshape(k.shape[0], b, nblk, page,
+                       *k.shape[3:]).astype(state['kv_k'].dtype)
+        vb = v.reshape(v.shape[0], b, nblk, page,
+                       *v.shape[3:]).astype(state['kv_v'].dtype)
+        dest = tables[:, :nblk]                      # [B, nblk]
+        state['kv_k'] = state['kv_k'].at[:, dest].set(kb)
+        state['kv_v'] = state['kv_v'].at[:, dest].set(vb)
+        state['block_tables'] = state['block_tables'].at[slots].set(
+            tables)
+        state['lengths'] = state['lengths'].at[slots].set(true_lens)
+        state['tokens'] = state['tokens'].at[slots].set(first_tokens)
+        state['active'] = state['active'].at[slots].set(True)
+        state['counts'] = (state['counts'].at[slots].set(0)
+                           .at[slots, first_tokens].set(1))
+        return state
+
     @property
     def supports_batched_prefill(self) -> bool:
         """Batched admission rides the plain bucket path; the prefix
@@ -421,9 +557,19 @@ class InferenceEngine:
             jnp.asarray(top_ks) if (top_ks[:n] > 0).any() else None,
             jnp.asarray(top_ps) if (top_ps[:n] < 1.0).any() else None,
             key)
-        state = self._insert_batch(state, kv, first_tokens,
-                                   jnp.asarray(true_lens),
-                                   jnp.asarray(slot_arr))
+        if self._page_alloc is not None:
+            tables = np.full(
+                (padded_n, self._page_alloc.blocks_per_slot),
+                self._page_alloc.sentinel, np.int32)
+            for i in range(n):
+                tables[i] = self._page_alloc.table_row(slots[i])
+            state = self._insert_batch_paged(
+                state, kv, first_tokens, jnp.asarray(true_lens),
+                jnp.asarray(slot_arr), jnp.asarray(tables))
+        else:
+            state = self._insert_batch(state, kv, first_tokens,
+                                       jnp.asarray(true_lens),
+                                       jnp.asarray(slot_arr))
         host_tokens = [int(t) for t in
                        np.asarray(jax.device_get(first_tokens))[:n]]
         return state, host_tokens
@@ -578,7 +724,14 @@ class InferenceEngine:
     def insert(self, state, kv, first_token, true_len: int, slot: int):
         """Write one prefill prefix into decode slot `slot` — the B=1
         case of _insert_batch (one insert body owns the pad/crop/
-        scatter/counts logic and the cache representation)."""
+        scatter/counts logic and the cache representation). Paged
+        engines require a prior reserve_kv(slot, ...)."""
+        if self._page_alloc is not None:
+            tables = self._page_alloc.table_row(slot)[None]
+            return self._insert_batch_paged(
+                state, kv, jnp.asarray(first_token).reshape(1),
+                jnp.asarray([true_len], jnp.int32),
+                jnp.asarray([slot], jnp.int32), jnp.asarray(tables))
         return self._insert_batch(
             state, kv, jnp.asarray(first_token).reshape(1),
             jnp.asarray([true_len], jnp.int32),
@@ -587,6 +740,13 @@ class InferenceEngine:
     def release_slot(self, state, slot: int):
         state = dict(state)
         state['active'] = state['active'].at[slot].set(False)
+        if self._page_alloc is not None:
+            # Free the pages AND sentinel the device table row: a
+            # released slot still ticking inside a fused batch must
+            # never write into a page re-issued to a new request.
+            self._page_alloc.release(slot)
+            state['block_tables'] = state['block_tables'].at[slot].set(
+                self._page_alloc.sentinel)
         return state
 
     # ---- decode ----
@@ -604,9 +764,20 @@ class InferenceEngine:
         path)."""
         c = self.config.model
         kv = {'k': state['kv_k'], 'v': state['kv_v']}
-        logits, new_kv = self._model_lib.decode_forward(
-            c, params, state['tokens'], state['lengths'], kv,
-            mesh=self.mesh)
+        # Inactive slots write at the out-of-range position
+        # max_target_len: the cache scatter DROPS the update (both the
+        # dense per-slot row and the paged sentinel route), so a slot
+        # that finished mid-fused-batch never writes post-EOS KV.
+        write_pos = jnp.where(state['active'], state['lengths'],
+                              self.config.max_target_len)
+        if self._page_alloc is not None:
+            logits, new_kv = self._model_lib.paged_decode_forward(
+                c, params, state['tokens'], write_pos, kv,
+                state['block_tables'], mesh=self.mesh)
+        else:
+            logits, new_kv = self._model_lib.decode_forward(
+                c, params, state['tokens'], write_pos, kv,
+                mesh=self.mesh)
         counts = state['counts']
         if penalties is not None:
             presence, frequency = penalties
@@ -634,7 +805,7 @@ class InferenceEngine:
             jnp.minimum(state['lengths'] + 1,
                         self.config.max_target_len),
             state['lengths'])
-        state = {
+        new_state = {
             'kv_k': new_kv['k'], 'kv_v': new_kv['v'],
             'lengths': new_lengths,
             'tokens': jnp.where(state['active'], next_tokens,
@@ -642,7 +813,9 @@ class InferenceEngine:
             'active': state['active'],
             'counts': counts,
         }
-        return state, (next_tokens, lp)
+        if 'block_tables' in state:
+            new_state['block_tables'] = state['block_tables']
+        return new_state, (next_tokens, lp)
 
     @functools.partial(jax.jit, static_argnums=(0, 7),
                        donate_argnums=(2,))
@@ -674,11 +847,77 @@ class InferenceEngine:
 
         return jax.lax.scan(body, state, jax.random.split(key, n))
 
+    @functools.partial(jax.jit, static_argnums=(0, 6, 10),
+                       donate_argnums=(2, 9))
+    def _decode_steps_masked(self, params, state, temperatures, top_k,
+                             top_p, n: int, keys, eos_ids, remaining,
+                             logprobs_k: int = 0, penalties=None):
+        """n fused decode steps with DEVICE-SIDE finish detection.
+
+        The host twin (_decode_steps) leaves finish detection to the
+        host: a slot hitting EOS mid-batch burns up to n-1 garbage
+        steps and the host re-scans every slot per emitted row. Here
+        the per-slot finish rules ride the scan carry instead:
+
+          * eos_ids [slots] int32 (< 0 = no EOS for that slot): a slot
+            sampling its EOS is deactivated IN-LOOP — the EOS step's
+            row comes back with valid=False (EOS tokens are never
+            emitted) and later steps neither sample for the slot nor
+            write its KV (inactive slots scatter out of range).
+          * remaining [slots] int32 token budget: decremented per kept
+            token; a slot reaching zero keeps that final token
+            (valid=True) and deactivates after it.
+
+        keys [n, 2]: pre-split step keys (the orchestrator amortizes
+        jax.random.split over many ticks). Returns
+        (state, remaining, (tokens [n, slots], valid [n, slots], lp)):
+        `valid` is the commit mask — the host applies one device_get to
+        the whole tuple and never re-derives finish conditions.
+        """
+        del n  # static: len(keys) fixes the scan length
+
+        def body(carry, step_key):
+            state, remaining = carry
+            prev_active = state['active']
+            state, (next_tokens, lp) = self._decode_step_impl(
+                params, state, temperatures, top_k, top_p, step_key,
+                logprobs_k, penalties)
+            hit_eos = (prev_active & (eos_ids >= 0)
+                       & (next_tokens == eos_ids))
+            keep = prev_active & ~hit_eos
+            rem = remaining - keep.astype(jnp.int32)
+            exhausted = keep & (rem <= 0)
+            state = dict(state)
+            state['active'] = keep & ~exhausted
+            return (state, rem), (next_tokens, keep, lp)
+
+        (state, remaining), ys = jax.lax.scan(
+            body, (state, remaining), keys)
+        return state, remaining, ys
+
+    def decode_steps_masked(self, state, n: int, temperatures, top_k,
+                            top_p, eos_ids, remaining, keys,
+                            logprobs_k: int = 0, penalties=None):
+        """Public fused-masked decode. Unlike decode_steps this does
+        NOT re-normalize the sampling arrays: the orchestrator's fast
+        tick keeps them device-resident and updates them only on
+        admit/release — re-deriving the None-folding here would force
+        a host transfer per tick."""
+        state, remaining, (tokens, valid, lp) = self._decode_steps_masked(
+            self.params, state, temperatures, top_k, top_p, n, keys,
+            eos_ids, remaining, logprobs_k, penalties)
+        return state, remaining, tokens, valid, lp
+
     # ---- speculative verification ----
 
     @property
     def supports_verify(self) -> bool:
-        return hasattr(self._model_lib, 'verify_forward')
+        """Paged engines opt out: verify_forward's multi-token scatter
+        writes [B, S] positions straight into per-slot rows, which the
+        page arena does not expose (speculation falls back to plain
+        decode)."""
+        return (hasattr(self._model_lib, 'verify_forward')
+                and self._page_alloc is None)
 
     @functools.partial(jax.jit, static_argnums=(0,),
                        donate_argnums=(2,))

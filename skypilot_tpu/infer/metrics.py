@@ -14,6 +14,11 @@ Exposed at GET /metrics on every replica:
   * xsky_serve_e2e_latency_seconds   (histogram)
   * xsky_serve_active_slots / xsky_serve_free_slots /
     xsky_serve_queue_depth           (gauges, read live)
+  * xsky_serve_kv_pages_total / xsky_serve_kv_pages_free
+    (gauges, paged-KV engines only)
+  * xsky_serve_wasted_decode_steps_total  (counter: fused decode rows
+    burned after a slot finished — legacy tick only, the masked fast
+    tick holds it at 0)
 
 The serve controller's SLO monitor (serve/slo.py) scrapes this text
 each tick: TTFT/TPOT/e2e feed the per-replica latency digests in
@@ -145,6 +150,21 @@ class ServeMetrics:
                 '# TYPE xsky_serve_queue_depth gauge',
                 f'xsky_serve_queue_depth {orch._pending.qsize()}',
             ]
+            wasted = getattr(orch, 'wasted_decode_steps', None)
+            if wasted is not None:
+                lines += [
+                    '# TYPE xsky_serve_wasted_decode_steps_total '
+                    'counter',
+                    f'xsky_serve_wasted_decode_steps_total {wasted}',
+                ]
+            pages = getattr(orch.engine, 'kv_page_stats', None)
+            if pages is not None:
+                lines += [
+                    '# TYPE xsky_serve_kv_pages_total gauge',
+                    f"xsky_serve_kv_pages_total {pages['total']}",
+                    '# TYPE xsky_serve_kv_pages_free gauge',
+                    f"xsky_serve_kv_pages_free {pages['free']}",
+                ]
             accept = getattr(orch, 'accept_stats', None)
             if accept is not None:
                 lines += [

@@ -8,10 +8,11 @@ bookkeeping (EOS, budgets, queues) lives host-side.
 from __future__ import annotations
 
 import dataclasses
+import os
 import queue
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -96,6 +97,34 @@ class Orchestrator:
         self.interleave_prefill = True
         self.prefill_chunks_per_tick = 1
         self._partials: Dict[int, Any] = {}
+        # Requests that passed validation but found no KV-page headroom
+        # (paged engines): retried ahead of the queue next tick.
+        self._deferred: List[Request] = []
+        # Fused decode with DEVICE-SIDE finish masking (the serving
+        # fast path): finished slots stop sampling and writing KV
+        # in-loop, the host commits from one device_get per tick, and
+        # the per-slot sampling params live on device, rebuilt only
+        # when occupancy changes. '0' falls back to the legacy
+        # host-per-row tick (the paired-difference bench's baseline
+        # arm).
+        self._fast_tick = (
+            os.environ.get('XSKY_DECODE_FAST_TICK', '1') != '0')
+        self._params_dirty = True
+        self._d_temps = None
+        self._d_topk = None
+        self._d_topp = None
+        self._d_pen = None
+        self._d_eos = None
+        self._d_remaining = None
+        self._lp_k = 0
+        # Pre-split step keys, refilled every _KEY_POOL_TICKS ticks:
+        # one jax.random.split per pool instead of per tick.
+        self._key_pool: List[Any] = []
+        self._key_pool_n = 0
+        # Decode steps a slot burned after finishing mid-fused-batch
+        # (legacy tick only; the masked loop stops the slot in-loop, so
+        # its arm contributes zero by construction).
+        self.wasted_decode_steps = 0
 
     # ---- submission ----
 
@@ -139,7 +168,42 @@ class Orchestrator:
         if budget > self.engine.config.max_target_len:
             request.max_new_tokens = (self.engine.config.max_target_len -
                                       prompt_len)
+        if not self.engine.kv_admissible(prompt_len,
+                                         request.max_new_tokens):
+            # Paged engine whose whole arena cannot hold this budget:
+            # deferring would deadlock the drain loop, so reject.
+            request.error = (
+                f'Request KV budget {prompt_len + request.max_new_tokens}'
+                f' tokens exceeds the paged-cache capacity.')
+            request.done = True
+            request.finished_at = time.perf_counter()
+            logger.warning(f'Rejected request {request.request_id}: '
+                           f'{request.error}')
+            return False
         return True
+
+    def _take_request(self) -> Optional[Request]:
+        """Next admission candidate: headroom-deferred requests retry
+        ahead of the queue (FIFO within each)."""
+        if self._deferred:
+            return self._deferred.pop(0)
+        try:
+            return self._pending.get_nowait()
+        except queue.Empty:
+            return None
+
+    def _reserve_or_defer(self, request: Request, slot: int) -> bool:
+        """Reserve KV capacity for the request's full budget against
+        the claimed slot. On a paged engine with no page headroom the
+        slot goes back, the request parks in the deferred list, and
+        the caller stops admitting this tick (headroom only appears
+        when a running stream finishes)."""
+        if self.engine.reserve_kv(slot, len(request.prompt_tokens),
+                                  request.max_new_tokens):
+            return True
+        self._free_slots.append(slot)
+        self._deferred.append(request)
+        return False
 
     def _admit_claimed(self, request: Request, slot: int) -> None:
         """Single-request admission into an already-claimed slot."""
@@ -171,13 +235,15 @@ class Orchestrator:
         """Prefill + insert one pending request into a free slot."""
         if not self._free_slots:
             return False
-        try:
-            request = self._pending.get_nowait()
-        except queue.Empty:
+        request = self._take_request()
+        if request is None:
             return False
         if not self._validate_admit(request):
             return True
-        self._admit_claimed(request, self._free_slots.pop())
+        slot = self._free_slots.pop()
+        if not self._reserve_or_defer(request, slot):
+            return False   # no KV headroom: stop admitting this tick
+        self._admit_claimed(request, slot)
         return True
 
     #: Subclasses with per-request admission hooks (speculation mirrors
@@ -203,18 +269,20 @@ class Orchestrator:
             return
         batch: List = []       # (request, claimed slot)
         while self._free_slots:
-            try:
-                request = self._pending.get_nowait()
-            except queue.Empty:
+            request = self._take_request()
+            if request is None:
                 break
             if not self._validate_admit(request):
                 continue
+            slot = self._free_slots.pop()
+            if not self._reserve_or_defer(request, slot):
+                break      # no KV headroom: stop admitting this tick
             if (not request.logprobs
                     and len(request.prompt_tokens)
                     <= self.engine.config.max_prompt_len):
-                batch.append((request, self._free_slots.pop()))
+                batch.append((request, slot))
             else:
-                self._admit_claimed(request, self._free_slots.pop())
+                self._admit_claimed(request, slot)
         groups: Dict[int, List] = {}
         for request, slot in batch:
             bucket = self.engine.bucket_for(len(request.prompt_tokens))
@@ -228,8 +296,25 @@ class Orchestrator:
                 temperature=r.temperature, top_k=r.top_k,
                 top_p=r.top_p)) for r, _ in group]
             slots = [s for _, s in group]
-            self.state, first_tokens = self.engine.prefill_insert_batch(
-                self.state, args, slots)
+            try:
+                self.state, first_tokens = \
+                    self.engine.prefill_insert_batch(self.state, args,
+                                                     slots)
+            except Exception as e:  # pylint: disable=broad-except
+                # Fail the group, fail_all-style, and RESTORE its
+                # claimed slots + KV reservations — before this guard a
+                # raising batched prefill leaked every popped slot in
+                # the group, permanently shrinking the pool.
+                logger.exception(
+                    f'Batched prefill failed for {len(group)} '
+                    f'requests: {e}')
+                for request, slot in group:
+                    request.error = f'Prefill failed: {e}'
+                    request.done = True
+                    request.finished_at = time.perf_counter()
+                    self.engine.release_kv(slot)
+                    self._free_slots.append(slot)
+                continue
             for (request, slot), token in zip(group, first_tokens):
                 self._post_insert(slot, request, token)
 
@@ -250,6 +335,7 @@ class Orchestrator:
         request.output_tokens.append(int(first_token))
         request.first_token_at = time.perf_counter()
         self._slot_req[slot] = request
+        self._params_dirty = True
         self._maybe_finish(slot, int(first_token))
 
     def _advance_partials(self) -> None:
@@ -265,6 +351,9 @@ class Orchestrator:
             request, cp = self._partials[slot]
             if request.cancel_requested:
                 del self._partials[slot]
+                # The claimed slot's KV reservation goes back too — the
+                # slot never reached release_slot (nothing inserted).
+                self.engine.release_kv(slot)
                 self._free_slots.append(slot)
                 request.done = True
                 request.finished_at = time.perf_counter()
@@ -303,6 +392,7 @@ class Orchestrator:
             self.state = self.engine.release_slot(self.state, slot)
             del self._slot_req[slot]
             self._free_slots.append(slot)
+            self._params_dirty = True
 
     def step(self) -> None:
         """One scheduler tick: admit while possible (batching same-
@@ -315,7 +405,131 @@ class Orchestrator:
     def _decode_tick(self) -> None:
         """The decode half of a tick — subclasses' mixed-batch
         fallbacks call this directly so admission and the partials
-        budget run exactly once per tick."""
+        budget run exactly once per tick. Dispatches to the fused
+        masked fast path unless XSKY_DECODE_FAST_TICK=0 pins the
+        legacy host-per-row tick."""
+        if self._fast_tick:
+            self._decode_tick_fast()
+        else:
+            self._decode_tick_legacy()
+
+    # ---- fast tick: device-resident params + device-side finish ----
+
+    _KEY_POOL_TICKS = 16
+
+    def _rebuild_device_params(self) -> None:
+        """Push the per-slot sampling/finish params to device — ONLY
+        when occupancy changed (admit/release), not per tick. The
+        legacy tick rebuilt five [max_slots] numpy arrays and re-made
+        the None-folding decision every tick; steady-state fast ticks
+        reuse these arrays untouched."""
+        slots = self.engine.config.max_slots
+        temps = np.zeros((slots,), np.float32)
+        top_k = np.zeros((slots,), np.int32)
+        top_p = np.ones((slots,), np.float32)
+        pres = np.zeros((slots,), np.float32)
+        freq = np.zeros((slots,), np.float32)
+        eos = np.full((slots,), -1, np.int32)
+        remaining = np.zeros((slots,), np.int32)
+        need_lp = False
+        for slot, r in self._slot_req.items():
+            temps[slot] = r.temperature
+            top_k[slot] = r.top_k
+            top_p[slot] = r.top_p
+            pres[slot] = r.presence_penalty
+            freq[slot] = r.frequency_penalty
+            if r.eos_token_id is not None:
+                eos[slot] = r.eos_token_id
+            remaining[slot] = max(
+                r.max_new_tokens - len(r.output_tokens), 0)
+            need_lp = need_lp or bool(r.logprobs)
+        self._d_temps = jnp.asarray(temps)
+        # None-folding (a cheaper compiled variant with the [slots,
+        # vocab] sorts dead-coded out) decided host-side on the dirty
+        # tick, not re-derived from device values every tick.
+        self._d_topk = jnp.asarray(top_k) if (top_k > 0).any() else None
+        self._d_topp = (jnp.asarray(top_p) if (top_p < 1.0).any()
+                        else None)
+        self._d_pen = ((jnp.asarray(pres), jnp.asarray(freq))
+                       if (pres.any() or freq.any()) else None)
+        self._d_eos = jnp.asarray(eos)
+        self._d_remaining = jnp.asarray(remaining)
+        self._lp_k = LOGPROBS_K if need_lp else 0
+        self._params_dirty = False
+
+    def _next_keys(self, n: int):
+        """One [n]-key batch from the pool (refilled every
+        _KEY_POOL_TICKS ticks — amortizes jax.random.split, which is
+        itself a device dispatch, off the per-tick path)."""
+        if not self._key_pool or self._key_pool_n != n:
+            self._key, sub = jax.random.split(self._key)
+            flat = jax.random.split(sub, n * self._KEY_POOL_TICKS)
+            self._key_pool = [flat[i * n:(i + 1) * n]
+                              for i in range(self._KEY_POOL_TICKS)]
+            self._key_pool_n = n
+        return self._key_pool.pop()
+
+    def _decode_tick_fast(self) -> None:
+        """Fused masked decode tick.
+
+        One engine dispatch runs decode_steps steps with per-slot
+        EOS/budget finish masking ON DEVICE; one device_get brings back
+        (tokens, valid[, logprobs]) and the host commits only rows the
+        mask kept — no per-row re-scan of all slots, no per-tick param
+        rebuild, no post-EOS garbage steps for finished slots.
+        """
+        if not self._slot_req:
+            return
+        if self._params_dirty:
+            self._rebuild_device_params()
+        n = self.decode_steps
+        keys = self._next_keys(n)
+        probe = profiler.step_probe()
+        out = self.engine.decode_steps_masked(
+            self.state, n, self._d_temps, self._d_topk, self._d_topp,
+            self._d_eos, self._d_remaining, keys,
+            logprobs_k=self._lp_k, penalties=self._d_pen)
+        if probe is not None:
+            probe.dispatched()
+        self.state, self._d_remaining, tokens, valid, lp = out
+        if self._lp_k:
+            tokens_np, valid_np, lp_np = jax.device_get(
+                (tokens, valid, lp))
+        else:
+            tokens_np, valid_np = jax.device_get((tokens, valid))
+            lp_np = None
+        if probe is not None:
+            probe.done()
+        now = time.perf_counter()
+        for slot in list(self._slot_req):
+            request = self._slot_req[slot]
+            vm = valid_np[:, slot]
+            for i in range(n):
+                if not vm[i]:
+                    break
+                request.output_tokens.append(int(tokens_np[i, slot]))
+                if self._lp_k and request.logprobs:
+                    self._record_logprobs(
+                        request,
+                        (lp_np[0][i], lp_np[1][i], lp_np[2][i]), slot)
+            # An invalid row means the device deactivated the slot
+            # (EOS — its token was never emitted, so there is nothing
+            # to pop — or budget exhaustion after the last kept row).
+            finished = (
+                not vm.all()
+                or len(request.output_tokens) >= request.max_new_tokens
+                or request.cancel_requested)
+            if finished:
+                request.done = True
+                request.finished_at = now
+                self.state = self.engine.release_slot(self.state, slot)
+                del self._slot_req[slot]
+                self._free_slots.append(slot)
+                self._params_dirty = True
+
+    # ---- legacy tick: host-side finish scan (bench baseline arm) ----
+
+    def _decode_tick_legacy(self) -> None:
         if not self._slot_req:
             return
         slots = self.engine.config.max_slots
@@ -371,6 +585,11 @@ class Orchestrator:
                     self._record_logprobs(
                         request, (lp[0][i], lp[1][i], lp[2][i]), slot)
                 self._maybe_finish(slot, int(row[slot]))
+                if slot not in self._slot_req:
+                    # The fused dispatch already sampled rows i+1..n-1
+                    # for this slot; the fast tick's device mask makes
+                    # these structurally zero.
+                    self.wasted_decode_steps += len(batches) - 1 - i
 
     def _verify_round(self, active_before, proposals) -> None:
         """One greedy verify pass over [slots, γ] proposals: append the
@@ -402,7 +621,13 @@ class Orchestrator:
             request.error = error
             request.done = True
             request.finished_at = time.perf_counter()
+            self.engine.release_kv(slot)
             self._free_slots.append(slot)
+        for request in self._deferred:
+            request.error = error
+            request.done = True
+            request.finished_at = time.perf_counter()
+        self._deferred.clear()
         for slot in list(self._slot_req):
             request = self._slot_req.pop(slot)
             request.error = error
@@ -421,14 +646,16 @@ class Orchestrator:
 
     def run_until_drained(self, max_steps: int = 100_000) -> None:
         steps = 0
-        while (self._slot_req or self._partials
+        while (self._slot_req or self._partials or self._deferred
                or not self._pending.empty()) and steps < max_steps:
             self.step()
             steps += 1
-        if self._slot_req or self._partials or not self._pending.empty():
+        if (self._slot_req or self._partials or self._deferred
+                or not self._pending.empty()):
             logger.warning(f'run_until_drained hit max_steps={max_steps} '
                            f'with {len(self._slot_req)} active, '
-                           f'{len(self._partials)} mid-prefill and '
+                           f'{len(self._partials)} mid-prefill, '
+                           f'{len(self._deferred)} deferred and '
                            f'~{self._pending.qsize()} pending requests.')
             self.fail_all(f'Truncated at max_steps={max_steps}.')
 

@@ -465,6 +465,19 @@ def main() -> int:
                              'on compute-bound deployments where '
                              'prefill FLOPs dominate and pow2 wave '
                              'padding wastes forward work.')
+    parser.add_argument('--kv-page-size', type=int, default=0,
+                        help='Paged KV cache: tokens per page (must '
+                             'divide max-target-len and every prefill '
+                             'bucket; llama/deepseek families only). '
+                             'Admission is then gated by free-page '
+                             'headroom for each request\'s actual '
+                             'prompt+max_new budget instead of a '
+                             'worst-case slot reservation. '
+                             '0 (default) keeps the dense slot cache')
+    parser.add_argument('--kv-num-pages', type=int, default=0,
+                        help='Pages in the paged-KV arena. 0 sizes it '
+                             'to the dense cache footprint '
+                             '(max_slots * max_target_len / page)')
     parser.add_argument('--prefix-cache', type=int, default=0,
                         help='Prefix-cache entries (device-resident KV '
                              'reuse for shared prompt prefixes; entry '
@@ -499,7 +512,9 @@ def main() -> int:
         weight_dtype={'int8': jnp.int8, 'int4': 'int4',
                       'bf16': jnp.bfloat16}[args.weight_dtype],
         prefix_cache_entries=prefix_entries,
-        batched_admission=not args.no_batched_admission)
+        batched_admission=not args.no_batched_admission,
+        kv_page_size=args.kv_page_size,
+        kv_num_pages=args.kv_num_pages)
     mesh = None
     if args.mesh:
         from skypilot_tpu.train.launch import parse_mesh

@@ -167,6 +167,17 @@ def kv_cache_shapes(config: DeepSeekConfig, max_slots: int,
              c.qk_rope_head_dim))
 
 
+def paged_kv_cache_shapes(config: DeepSeekConfig, num_pages: int,
+                          page_size: int
+                          ) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """Engine hook for the paged cache: same compressed-latent layout as
+    kv_cache_shapes, but over a shared page arena [L, P, page, 1, ·]
+    instead of per-slot dense rows."""
+    c = config
+    return ((c.n_layers, num_pages, page_size, 1, c.kv_lora_rank),
+            (c.n_layers, num_pages, page_size, 1, c.qk_rope_head_dim))
+
+
 def _attn_axes(config: DeepSeekConfig) -> Params:
     axes: Params = {
         'w_dkv': ('layers', 'embed', None),
@@ -318,11 +329,16 @@ def _mla_qkv(c: DeepSeekConfig, h: jax.Array, lp: Params,
 def _mla_attention(c: DeepSeekConfig, mesh, x: jax.Array, lp: Params,
                    positions: jax.Array, kv_cache=None,
                    cache_positions: Optional[jax.Array] = None,
-                   return_kv: bool = False):
+                   return_kv: bool = False,
+                   block_tables: Optional[jax.Array] = None):
     """MLA block attention. Returns (attn_out [B,S,D], new_kv).
 
     Without kv_cache: expanded form (training/prefill); with kv_cache
-    ([B,K,1,r_kv], [B,K,1,dr] slot caches): absorbed decode step."""
+    ([B,K,1,r_kv], [B,K,1,dr] slot caches): absorbed decode step. With
+    block_tables [B, nblk] the caches are paged arenas
+    ([P,page,1,r_kv], [P,page,1,dr]): writes route through the table
+    (a position past the table or a sentinel entry resolves to the
+    dropped page index P) and reads go through the paged kernel."""
     b, s, _ = x.shape
     h = c.n_heads
     dn, dr, dv = c.qk_nope_head_dim, c.qk_rope_head_dim, c.v_head_dim
@@ -340,11 +356,31 @@ def _mla_attention(c: DeepSeekConfig, mesh, x: jax.Array, lp: Params,
     if kv_cache is not None:
         # ---- absorbed decode over the compressed cache ----
         ck, cv = kv_cache                      # [B,K,1,r], [B,K,1,dr]
-        slots = jnp.arange(b)
-        ck = ck.at[slots, cache_positions, 0].set(
-            c_kv[:, 0].astype(ck.dtype))
-        cv = cv.at[slots, cache_positions, 0].set(
-            k_rope[:, 0, 0].astype(cv.dtype))
+        pos = cache_positions.astype(jnp.int32)
+        if block_tables is not None:
+            if mesh is not None:
+                raise NotImplementedError(
+                    'mesh sharding is not supported with the paged '
+                    'KV cache')
+            num_pages, page = ck.shape[0], ck.shape[1]
+            nblk = block_tables.shape[1]
+            blk = pos // page
+            page_idx = jnp.where(
+                blk < nblk,
+                jnp.take_along_axis(block_tables,
+                                    jnp.minimum(blk, nblk - 1)[:, None],
+                                    axis=1)[:, 0],
+                num_pages)
+            ck = ck.at[page_idx, pos % page, 0].set(
+                c_kv[:, 0].astype(ck.dtype))
+            cv = cv.at[page_idx, pos % page, 0].set(
+                k_rope[:, 0, 0].astype(cv.dtype))
+        else:
+            slots = jnp.arange(b)
+            ck = ck.at[slots, pos, 0].set(
+                c_kv[:, 0].astype(ck.dtype))
+            cv = cv.at[slots, pos, 0].set(
+                k_rope[:, 0, 0].astype(cv.dtype))
         w_ukv = lp['w_ukv'].reshape(c.kv_lora_rank, h, dn + dv)
         w_uk, w_uv = w_ukv[..., :dn], w_ukv[..., dn:]
         q_eff = jnp.einsum('bhd,rhd->bhr',
@@ -352,26 +388,43 @@ def _mla_attention(c: DeepSeekConfig, mesh, x: jax.Array, lp: Params,
                            w_uk.astype(jnp.float32))
         scale = (dn + dr) ** -0.5
         max_len = ck.shape[1]
-        if (mesh is None and
+        use_pallas = os.environ.get('XSKY_DECODE_ATTN') != 'xla'
+        if block_tables is not None and use_pallas:
+            o_c = mla_decode_ops.paged_mla_decode_attention(
+                q_eff, q_rope[:, 0].astype(jnp.float32),
+                ck[:, :, 0], cv[:, :, 0], lengths=pos + 1,
+                block_tables=block_tables, scale=scale)
+        elif (block_tables is None and mesh is None and
                 max_len % min(mla_decode_ops.DEFAULT_BLOCK_KV,
-                              max_len) == 0 and
-                os.environ.get('XSKY_DECODE_ATTN') != 'xla'):
+                              max_len) == 0 and use_pallas):
             # Length-bounded Pallas kernel: each slot reads only its
             # live cache blocks (the compressed cache is the whole HBM
             # cost of MLA decode).
             o_c = mla_decode_ops.mla_decode_attention(
                 q_eff, q_rope[:, 0].astype(jnp.float32),
                 ck[:, :, 0], cv[:, :, 0],
-                lengths=cache_positions + 1, scale=scale)
+                lengths=pos + 1, scale=scale)
         else:
-            latents = ck[:, :, 0].astype(jnp.float32)    # [B,K,r]
-            ropes = cv[:, :, 0].astype(jnp.float32)      # [B,K,dr]
+            if block_tables is not None:
+                # Gather each slot's pages into a dense [B, K] view for
+                # the XLA reference (sentinel entries clamp to a live
+                # page; the position bound below masks them).
+                safe = jnp.clip(block_tables, 0, num_pages - 1)
+                latents = ck[safe][:, :, :, 0].reshape(
+                    b, nblk * page, -1).astype(jnp.float32)
+                ropes = cv[safe][:, :, :, 0].reshape(
+                    b, nblk * page, -1).astype(jnp.float32)
+                kv_len = nblk * page
+            else:
+                latents = ck[:, :, 0].astype(jnp.float32)    # [B,K,r]
+                ropes = cv[:, :, 0].astype(jnp.float32)      # [B,K,dr]
+                kv_len = max_len
             scores = (jnp.einsum('bhr,btr->bht', q_eff, latents) +
                       jnp.einsum('bhd,btd->bht',
                                  q_rope[:, 0].astype(jnp.float32),
                                  ropes)) * scale
-            valid = (jnp.arange(max_len)[None, None, :] <=
-                     cache_positions[:, None, None])
+            valid = (jnp.arange(kv_len)[None, None, :] <=
+                     pos[:, None, None])
             scores = jnp.where(valid, scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1)
             o_c = jnp.einsum('bht,btr->bhr', probs, latents)
@@ -417,12 +470,14 @@ def _dense_mlp(c: DeepSeekConfig, mesh, h: jax.Array, lp: Params,
 def _layer(c: DeepSeekConfig, mesh, x: jax.Array, lp: Params,
            positions: jax.Array, is_moe: bool,
            token_mask: Optional[jax.Array] = None,
-           kv_cache=None, cache_positions=None, return_kv: bool = False):
+           kv_cache=None, cache_positions=None, return_kv: bool = False,
+           block_tables: Optional[jax.Array] = None):
     """One block → (x, aux, new_kv). Dense layers report aux = 0."""
     attn, new_kv = _mla_attention(c, mesh, x, lp, positions,
                                   kv_cache=kv_cache,
                                   cache_positions=cache_positions,
-                                  return_kv=return_kv)
+                                  return_kv=return_kv,
+                                  block_tables=block_tables)
     x = x + attn
 
     def shard(arr, axes):
@@ -565,6 +620,49 @@ def decode_forward(c: DeepSeekConfig, params: Params,
             x, _, new_cache = _layer(c, mesh, x, lp, pos, is_moe,
                                      kv_cache=(layer_ck, layer_cv),
                                      cache_positions=positions)
+            return x, {'k': new_cache[0], 'v': new_cache[1]}
+        return layer_fn
+
+    new_groups = []
+    if k:
+        x, new = jax.lax.scan(group_fn(False), x,
+                              (params['dense_layers'], ck[:k], cv[:k]))
+        new_groups.append(new)
+    x, new = jax.lax.scan(group_fn(True), x,
+                          (params['moe_layers'], ck[k:], cv[k:]))
+    new_groups.append(new)
+    x = llama._rms_norm(x, params['final_norm'], c.norm_eps)
+    new_kv = {
+        'k': jnp.concatenate([g['k'] for g in new_groups], axis=0),
+        'v': jnp.concatenate([g['v'] for g in new_groups], axis=0),
+    }
+    return lm_logits(c, params, x)[:, 0], new_kv
+
+
+def paged_decode_forward(c: DeepSeekConfig, params: Params,
+                         last_tokens: jax.Array, positions: jax.Array,
+                         kv, block_tables: jax.Array,
+                         mesh: Optional[mesh_lib.Mesh] = None):
+    """decode_forward over the paged compressed cache.
+
+    kv {'k','v': [L, P, page, 1, ·]} page arenas; block_tables
+    [B, nblk] is layer-invariant (closed over by the scan bodies)."""
+    if mesh is not None:
+        raise NotImplementedError(
+            'mesh sharding is not supported with the paged KV cache')
+    x = qops.embed_rows(params['embed'],
+                        last_tokens[:, None]).astype(c.dtype)
+    pos = positions[:, None]
+    ck, cv = kv['k'], kv['v']
+    k = c.first_k_dense
+
+    def group_fn(is_moe):
+        def layer_fn(x, scanned):
+            lp, layer_ck, layer_cv = scanned
+            x, _, new_cache = _layer(c, None, x, lp, pos, is_moe,
+                                     kv_cache=(layer_ck, layer_cv),
+                                     cache_positions=positions,
+                                     block_tables=block_tables)
             return x, {'k': new_cache[0], 'v': new_cache[1]}
         return layer_fn
 

@@ -538,13 +538,80 @@ def slot_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
     return attn, new_cache
 
 
+def paged_cache_attend(q: jax.Array, k: jax.Array, v: jax.Array,
+                       kv_cache, cache_positions: jax.Array,
+                       block_tables: jax.Array, window=None,
+                       logit_softcap=None, scale=None):
+    """Paged-cache twin of the decode branch of slot_cache_attend.
+
+    kv_cache: (k_pages, v_pages), each [P, page, KVH, HD] shared page
+    arenas; block_tables [B, nblk] maps each slot's logical KV blocks
+    to physical pages (sentinel == P beyond the reservation);
+    cache_positions [B] is the write position per slot — the engine
+    points finished/inactive slots past the table (positions >=
+    nblk*page), which resolves to the sentinel page here so their
+    writes are DROPPED by JAX scatter semantics. s must be 1 (paged
+    serving is decode-only; prefill inserts go through the engine's
+    reshape-scatter path). Returns (attn, (new_k_pages, new_v_pages)).
+    """
+    b, s = q.shape[0], q.shape[1]
+    if s != 1:
+        raise NotImplementedError('paged_cache_attend is single-token')
+    if window is not None:
+        raise NotImplementedError(
+            'sliding_window is not supported with the paged KV cache')
+    ck, cv = kv_cache
+    if isinstance(ck, (tuple, list)):
+        raise NotImplementedError(
+            'int8 KV is not supported with the paged KV cache')
+    num_pages, page = ck.shape[0], ck.shape[1]
+    nblk = block_tables.shape[1]
+    pos = cache_positions.astype(jnp.int32)
+    blk = pos // page
+    off = pos % page
+    # Route the write through the block table; a position past the
+    # table (inactive slot) or a sentinel table entry both resolve to
+    # page index P, whose scatter is dropped.
+    page_idx = jnp.where(
+        blk < nblk,
+        jnp.take_along_axis(block_tables,
+                            jnp.minimum(blk, nblk - 1)[:, None],
+                            axis=1)[:, 0],
+        num_pages)
+    ck = ck.at[page_idx, off].set(k[:, 0].astype(ck.dtype))
+    cv = cv.at[page_idx, off].set(v[:, 0].astype(cv.dtype))
+    new_cache = (ck, cv)
+
+    if os.environ.get('XSKY_DECODE_ATTN') != 'xla':
+        attn = decode_ops.paged_decode_attention(
+            q, ck, cv, lengths=pos + 1, block_tables=block_tables,
+            logit_softcap=logit_softcap, scale=scale)
+        return attn, new_cache
+
+    # XLA reference path: gather each slot's pages into a dense [B, K]
+    # view and reuse the masked-attention reference. Sentinel entries
+    # clamp to an arbitrary live page; the q_pos bound masks them
+    # (every sentinel block sits past the slot's reservation, hence
+    # past its length).
+    safe = jnp.clip(block_tables, 0, num_pages - 1)
+    k_full = ck[safe].reshape(b, nblk * page, *ck.shape[2:])
+    v_full = cv[safe].reshape(b, nblk * page, *cv.shape[2:])
+    kv_pos = jnp.arange(nblk * page)[None, None, :]
+    valid = kv_pos <= pos[:, None, None]
+    attn = attention_ops.xla_attention_with_mask(
+        q, k_full, v_full, valid[:, None],
+        logit_softcap=logit_softcap, scale=scale)
+    return attn, new_cache
+
+
 def _layer(config: LlamaConfig, mesh: Optional[mesh_lib.Mesh],
            x: jax.Array, layer_params: Params, positions: jax.Array,
            kv_cache: Optional[Tuple[jax.Array, jax.Array]] = None,
            cache_index: Optional[jax.Array] = None,
            cache_positions: Optional[jax.Array] = None,
            return_kv: bool = False,
-           segment_ids: Optional[jax.Array] = None):
+           segment_ids: Optional[jax.Array] = None,
+           block_tables: Optional[jax.Array] = None):
     """One transformer block. Returns (x, new_kv_cache).
 
     Decode: with kv_cache set, the new K/V (s==1) is written either at a
@@ -572,7 +639,11 @@ def _layer(config: LlamaConfig, mesh: Optional[mesh_lib.Mesh],
     q = _rope(q, positions, c.rope_theta, c.rope_scaling)
     k = _rope(k, positions, c.rope_theta, c.rope_scaling)
 
-    if kv_cache is not None:
+    if kv_cache is not None and block_tables is not None:
+        attn, new_cache = paged_cache_attend(
+            q, k, v, kv_cache, cache_positions=cache_positions,
+            block_tables=block_tables, window=c.sliding_window)
+    elif kv_cache is not None:
         attn, new_cache = slot_cache_attend(
             q, k, v, kv_cache, cache_index=cache_index,
             cache_positions=cache_positions, window=c.sliding_window,
@@ -714,6 +785,47 @@ def decode_forward(config: LlamaConfig,
                               kv_cache=(ck, cv),
                               cache_index=None,
                               cache_positions=positions)
+        return x, {'k': new_cache[0], 'v': new_cache[1]}
+
+    x, new_kv = jax.lax.scan(layer_fn, x, (params['layers'],
+                                           kv['k'], kv['v']))
+    x = _rms_norm(x, params['final_norm'], c.norm_eps)
+    logits = qops.matmul(x, params['lm_head'],
+                         preferred_element_type=jnp.float32)
+    return logits[:, 0], new_kv
+
+
+def paged_decode_forward(config: LlamaConfig,
+                         params: Params,
+                         last_tokens: jax.Array,
+                         positions: jax.Array,
+                         kv: Dict[str, jax.Array],
+                         block_tables: jax.Array,
+                         mesh: Optional[mesh_lib.Mesh] = None
+                         ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """decode_forward over the paged cache.
+
+    kv {'k','v': [L, P, page, KVH, HD]} page arenas; block_tables
+    [B, nblk] physical page per logical block, shared by every layer
+    (loop-invariant — closed over by the scan body, not threaded).
+    positions [B] is each slot's write position; the engine points
+    inactive slots past the table so their KV writes drop on-device.
+    """
+    if mesh is not None:
+        raise NotImplementedError(
+            'mesh sharding is not supported with the paged KV cache')
+    c = config
+    x = qops.embed_rows(params['embed'],
+                        last_tokens[:, None]).astype(c.dtype)  # [B,1,D]
+    pos = positions[:, None]                                    # [B,1]
+
+    def layer_fn(x, scanned):
+        lp, ck, cv = scanned
+        x, new_cache = _layer(c, None, x, lp, pos,
+                              kv_cache=(ck, cv),
+                              cache_index=None,
+                              cache_positions=positions,
+                              block_tables=block_tables)
         return x, {'k': new_cache[0], 'v': new_cache[1]}
 
     x, new_kv = jax.lax.scan(layer_fn, x, (params['layers'],

@@ -140,6 +140,98 @@ def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, k_scale_ref,
         o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
 
 
+def _paged_adapter(lengths_ref, tables_ref, *refs, **kwargs):
+    """Kernel shim for the paged call: the block table rides scalar
+    prefetch solely for the BlockSpec index maps — the kernel body is
+    the dense one (positions are LOGICAL block offsets either way)."""
+    del tables_ref
+    _decode_kernel(lengths_ref, *refs, **kwargs)
+
+
+def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
+                           v_pages: jax.Array, lengths: jax.Array,
+                           block_tables: jax.Array,
+                           logit_softcap: Optional[float] = None,
+                           scale: Optional[float] = None) -> jax.Array:
+    """Single-token decode attention over the PAGED slot cache.
+
+    q: [B, 1, H, D]; k_pages/v_pages: [P, page_size, Hkv, D] shared
+    page arenas; block_tables: [B, nblk] physical page per logical KV
+    block (entries >= P mean "unallocated" and are clamped — the
+    lengths bound keeps live slots from ever reading one); lengths [B].
+    Returns [B, 1, H, D].
+
+    Same kernel body as the dense path: grid (slots, logical blocks),
+    lengths scalar-prefetched so past-the-end blocks clamp to the last
+    live block (Mosaic elides the repeated DMA). The only paged delta
+    is the K/V index map, which routes each logical block through the
+    block table to its physical page — paging costs no extra HBM
+    traffic at all.
+    """
+    if isinstance(k_pages, (tuple, list)):
+        raise NotImplementedError(
+            'int8 KV is not supported for the paged cache (use the '
+            'dense slot cache for a quantized cache).')
+    b, h, d = q.shape[0], q.shape[2], q.shape[3]
+    num_pages, page, h_kv = (k_pages.shape[0], k_pages.shape[1],
+                             k_pages.shape[2])
+    nblk = block_tables.shape[1]
+    groups = h // h_kv
+    max_len = nblk * page
+    lengths = jnp.minimum(lengths.astype(jnp.int32), max_len)
+    # Clamp once up front: every kernel-side use of a table entry must
+    # be a valid page index (sentinel rows belong to slots whose
+    # lengths bound already excludes them — the clamp only keeps their
+    # prefetched DMAs in range).
+    tables = jnp.clip(block_tables, 0, num_pages - 1).astype(jnp.int32)
+
+    # Dummy scale operands: one kernel signature with the dense path.
+    k_scale = jnp.ones((1, 1, 1, 1), jnp.float32)
+    v_scale = k_scale
+    qg = q.reshape(b, h_kv, groups, d)
+
+    def q_map(bi, ki, lens, tbl):
+        del ki, lens, tbl
+        return (bi, 0, 0, 0)
+
+    def kv_map(bi, ki, lens, tbl):
+        blk = jnp.minimum(ki, _last_block(lens[bi], page))
+        return (tbl[bi, blk], 0, 0, 0)
+
+    def scale_map(bi, ki, lens, tbl):
+        del bi, ki, lens, tbl
+        return (0, 0, 0, 0)
+
+    kernel = functools.partial(
+        _paged_adapter, scale=d ** -0.5 if scale is None else scale,
+        block_kv=page, window=None, quantized=False, h_kv=h_kv,
+        logit_softcap=logit_softcap)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nblk),
+        in_specs=[
+            pl.BlockSpec((1, h_kv, groups, d), q_map),
+            pl.BlockSpec((1, page, h_kv, d), kv_map),
+            pl.BlockSpec((1, page, h_kv, d), kv_map),
+            pl.BlockSpec((1, 1, 1, 1), scale_map),
+            pl.BlockSpec((1, 1, 1, 1), scale_map),
+        ],
+        out_specs=pl.BlockSpec((1, h_kv, groups, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((h_kv, groups, d), jnp.float32),
+            pltpu.VMEM((h_kv, groups, _LANES), jnp.float32),
+            pltpu.VMEM((h_kv, groups, _LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h_kv, groups, d), q.dtype),
+        interpret=_should_interpret(),
+    )(lengths, tables, qg, k_pages, v_pages, k_scale, v_scale)
+    return out.reshape(b, 1, h, d)
+
+
 def shardable_on(mesh, b: int, h_kv: int) -> bool:
     """Whether the kernel can run one shard-local instance per device
     under the engine's serving layout (slots on data/fsdp, KV heads on

@@ -144,3 +144,67 @@ def mla_decode_attention(q_eff: jax.Array, q_rope: jax.Array,
         out_shape=jax.ShapeDtypeStruct((b, h, r), jnp.float32),
         interpret=_should_interpret(),
     )(lengths, q_eff, q_rope, ckv_cache, krope_cache)
+
+
+def _paged_mla_adapter(lengths_ref, tables_ref, *refs, **kwargs):
+    """The block table rides scalar prefetch for the index maps only —
+    the kernel body is the dense MLA one (positions are LOGICAL block
+    offsets either way)."""
+    del tables_ref
+    _mla_decode_kernel(lengths_ref, *refs, **kwargs)
+
+
+def paged_mla_decode_attention(q_eff: jax.Array, q_rope: jax.Array,
+                               ckv_pages: jax.Array,
+                               krope_pages: jax.Array,
+                               lengths: jax.Array,
+                               block_tables: jax.Array,
+                               scale: float) -> jax.Array:
+    """Absorbed-MLA decode over the PAGED compressed cache.
+
+    ckv_pages: [P, page_size, r]; krope_pages: [P, page_size, dr]
+    shared page arenas; block_tables: [B, nblk] physical page per
+    logical KV block (entries >= P are unallocated sentinels, clamped
+    here — live slots' lengths bound never reaches one). Same kernel
+    body as the dense path; the only paged delta is the K/V index map
+    routing logical blocks through the block table.
+    """
+    b, h, r = q_eff.shape
+    dr = q_rope.shape[-1]
+    num_pages, page = ckv_pages.shape[0], ckv_pages.shape[1]
+    nblk = block_tables.shape[1]
+    lengths = jnp.minimum(lengths.astype(jnp.int32), nblk * page)
+    tables = jnp.clip(block_tables, 0, num_pages - 1).astype(jnp.int32)
+
+    def q_map(bi, ki, lens, tbl):
+        del ki, lens, tbl
+        return (bi, 0, 0)
+
+    def kv_map(bi, ki, lens, tbl):
+        blk = jnp.minimum(ki, _last_block(lens[bi], page))
+        return (tbl[bi, blk], 0, 0)
+
+    kernel = functools.partial(_paged_mla_adapter, scale=scale,
+                               block_kv=page)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nblk),
+        in_specs=[
+            pl.BlockSpec((1, h, r), q_map),
+            pl.BlockSpec((1, h, dr), q_map),
+            pl.BlockSpec((1, page, r), kv_map),
+            pl.BlockSpec((1, page, dr), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, h, r), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((h, r), jnp.float32),
+            pltpu.VMEM((h, _LANES), jnp.float32),
+            pltpu.VMEM((h, _LANES), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, r), jnp.float32),
+        interpret=_should_interpret(),
+    )(lengths, tables, q_eff, q_rope, ckv_pages, krope_pages)

@@ -404,6 +404,10 @@ _VARS = [
            'instead of the Pallas kernel'),
     EnvVar('XSKY_DECODE_BLOCK_KV', '256',
            'KV block size of the Pallas decode-attention kernel'),
+    EnvVar('XSKY_DECODE_FAST_TICK', '1',
+           "Set to '0' to pin the legacy decode tick (host-side "
+           'finish scan, per-tick sampling-param rebuild) instead of '
+           'the fused masked fast path'),
     EnvVar('XSKY_FLASH_BLOCK_Q', '512',
            'Q block size of the Pallas flash-attention kernel'),
     EnvVar('XSKY_FLASH_BLOCK_KV', '512',

@@ -181,6 +181,16 @@ _NAMES = [
             'Prefix-cache misses'),
     ObsName('metric', 'xsky_serve_prefix_cache_tokens_reused_total',
             'Prompt tokens served from the prefix cache'),
+    ObsName('metric', 'xsky_serve_kv_pages_total',
+            'Paged-KV arena size in pages (0 series absent = dense)'),
+    ObsName('metric', 'xsky_serve_kv_pages_free',
+            'Paged-KV pages free for admission'),
+    ObsName('metric', 'xsky_serve_wasted_decode_steps_total',
+            'Fused decode rows burned after a slot finished '
+            '(legacy tick only; the masked fast tick contributes 0)'),
+    ObsName('metric', 'xsky_bench_decode_tick_cost_us',
+            'Decode-tick host cost per token measured by '
+            'tools/bench_decode.py, labeled by tick arm'),
     ObsName('metric', 'xsky_serve_spec_rounds_total',
             'Speculative-decoding verify rounds'),
     ObsName('metric', 'xsky_serve_spec_proposed_total',

@@ -238,3 +238,39 @@ class TestDeepSeekSharded:
         sharded = deepseek.loss_fn(tiny, params, tokens, targets,
                                    mesh=mesh)
         np.testing.assert_allclose(float(ref), float(sharded), rtol=2e-3)
+
+
+class TestDeepSeekPagedKv:
+    """The paged compressed-latent cache (shared page arenas for c_kv
+    and k_rope) must be bit-identical to the dense per-slot layout."""
+
+    def test_paged_decode_matches_dense(self, tiny):
+        from skypilot_tpu.infer import engine as engine_lib
+        from skypilot_tpu.infer import orchestrator as orch_lib
+        c = dataclasses.replace(tiny,
+                                capacity_factor=float(tiny.n_experts))
+        params = deepseek.init(c, jax.random.PRNGKey(0))
+        # Prompts straddle the page_size=8 boundary and generations
+        # cross into later pages mid-decode.
+        prompts = [[5, 17, 3, 99, 42, 6, 7],
+                   [1, 2, 3, 4, 5, 6, 7, 8, 9]]
+        n_new = 10
+
+        def run(page_size):
+            config = engine_lib.EngineConfig(
+                model=c, max_slots=2, max_target_len=32,
+                prefill_buckets=(16,), kv_page_size=page_size)
+            engine = engine_lib.InferenceEngine(config, params)
+            orch = orch_lib.Orchestrator(engine, decode_steps=4)
+            return orch.generate(prompts, max_new_tokens=n_new), engine
+
+        dense_out, _ = run(0)
+        paged_out, engine = run(8)
+        assert paged_out == dense_out
+        assert all(len(o) == n_new for o in dense_out)
+        state = engine.init_decode_state()
+        # Paged compressed layout: [L, pages, page, 1, rank/rope].
+        assert state['kv_k'].shape[2] == 8
+        assert state['kv_k'].shape[-1] == c.kv_lora_rank
+        assert state['kv_v'].shape[-1] == c.qk_rope_head_dim
+        assert 'block_tables' in state

@@ -989,3 +989,235 @@ class TestBatchedAdmission:
         single = orch_lib.Orchestrator(engine2)
         single._batched_admit = False
         assert out == single.generate(prompts, max_new_tokens=n_new)
+
+
+# ---- paged KV cache + fused masked decode (the serving fast path) ----
+
+
+def _paged_engine(**over):
+    kw = dict(model=llama.LLAMA_TINY, max_slots=4, max_target_len=64,
+              prefill_buckets=(16, 32), kv_page_size=8)
+    kw.update(over)
+    params = llama.init(llama.LLAMA_TINY, jax.random.PRNGKey(0))
+    return engine_lib.InferenceEngine(engine_lib.EngineConfig(**kw),
+                                      params)
+
+
+class TestPagedKvParity:
+    """The paged engine must be bit-identical to the dense slot cache:
+    same model, same params, same sampling keys — only the KV layout
+    (shared page arena + block tables) differs."""
+
+    # Prompt lengths straddle the page_size=8 boundary (7/8/9) and
+    # max_new pushes totals across 2-3 pages, so block-table lookups
+    # cross physical page boundaries mid-decode.
+    PROMPTS = [[5, 17, 3, 99, 42, 6, 7], [1, 2, 3, 4, 5, 6, 7, 8],
+               [7, 8, 9, 10, 11, 12, 13, 14, 15]]
+
+    def test_greedy_matches_dense(self, tiny_engine):
+        n_new = 14
+        dense = orch_lib.Orchestrator(tiny_engine, decode_steps=4)
+        expected = dense.generate(self.PROMPTS, max_new_tokens=n_new)
+        paged = orch_lib.Orchestrator(_paged_engine(), decode_steps=4)
+        assert paged.generate(self.PROMPTS,
+                              max_new_tokens=n_new) == expected
+
+    def test_sampled_matches_dense(self):
+        def run(eng):
+            orch = orch_lib.Orchestrator(eng, seed=3, decode_steps=4)
+            reqs = [orch.submit(orch_lib.Request(
+                prompt_tokens=list(p), max_new_tokens=12,
+                temperature=1.1, top_k=8, top_p=0.9))
+                for p in self.PROMPTS]
+            orch.run_until_drained()
+            return [r.output_tokens for r in reqs]
+
+        config = engine_lib.EngineConfig(
+            model=llama.LLAMA_TINY, max_slots=4, max_target_len=64,
+            prefill_buckets=(16, 32))
+        params = llama.init(llama.LLAMA_TINY, jax.random.PRNGKey(0))
+        dense_out = run(engine_lib.InferenceEngine(config, params))
+        assert run(_paged_engine()) == dense_out
+        assert all(len(o) == 12 for o in dense_out)
+
+    def test_logprobs_match_dense(self, tiny_engine):
+        def run(eng):
+            orch = orch_lib.Orchestrator(eng, decode_steps=4)
+            reqs = [orch.submit(orch_lib.Request(
+                prompt_tokens=list(p), max_new_tokens=9, logprobs=3))
+                for p in self.PROMPTS[:2]]
+            orch.run_until_drained()
+            return reqs
+
+        for a, b in zip(run(tiny_engine), run(_paged_engine())):
+            assert a.output_tokens == b.output_tokens
+            assert np.allclose(a.token_logprobs, b.token_logprobs,
+                               atol=1e-5)
+            assert [sorted(d) for d in a.top_logprobs] == \
+                   [sorted(d) for d in b.top_logprobs]
+
+    def test_legacy_tick_on_paged_engine(self, tiny_engine,
+                                         monkeypatch):
+        """XSKY_DECODE_FAST_TICK=0 (the bench's baseline arm) must
+        produce the same tokens on the paged engine: released slots'
+        garbage fused rows land on sentinel pages, not live ones."""
+        n_new = 10
+        expected = orch_lib.Orchestrator(
+            tiny_engine, decode_steps=4).generate(
+                self.PROMPTS, max_new_tokens=n_new)
+        monkeypatch.setenv('XSKY_DECODE_FAST_TICK', '0')
+        legacy = orch_lib.Orchestrator(_paged_engine(), decode_steps=4)
+        assert legacy.generate(self.PROMPTS,
+                               max_new_tokens=n_new) == expected
+        assert legacy.wasted_decode_steps > 0
+
+    def test_slot_churn_reuses_pages(self, tiny_engine):
+        """More requests than slots with mixed budgets: released pages
+        get re-issued to later admissions and every stream still
+        matches the dense engine."""
+        prompts = self.PROMPTS * 3
+        n_new = 11
+        expected = orch_lib.Orchestrator(
+            tiny_engine, decode_steps=4).generate(
+                prompts, max_new_tokens=n_new)
+        eng = _paged_engine()
+        orch = orch_lib.Orchestrator(eng, decode_steps=4)
+        assert orch.generate(prompts, max_new_tokens=n_new) == expected
+        stats = eng.kv_page_stats
+        assert stats['free'] == stats['total']
+
+
+class TestPagedAdmission:
+
+    def test_headroom_deferral_then_completion(self, tiny_engine):
+        """An arena too small for all requests at once defers the
+        overflow (no admission failure) and drains once streams
+        finish; outputs still match the dense engine."""
+        prompts = TestPagedKvParity.PROMPTS * 2
+        n_new = 12
+        expected = orch_lib.Orchestrator(
+            tiny_engine, decode_steps=4).generate(
+                prompts, max_new_tokens=n_new)
+        # 6 pages of 8 = 48 tokens: fits ~2 concurrent budgets, not 6.
+        eng = _paged_engine(kv_num_pages=6)
+        orch = orch_lib.Orchestrator(eng, decode_steps=4)
+        out = orch.generate(prompts, max_new_tokens=n_new)
+        assert out == expected
+        assert not orch._deferred
+        stats = eng.kv_page_stats
+        assert stats['free'] == stats['total'] == 6
+
+    def test_never_fitting_budget_rejected(self):
+        eng = _paged_engine(kv_num_pages=4)
+        orch = orch_lib.Orchestrator(eng)
+        req = orch.submit(orch_lib.Request(
+            prompt_tokens=[1] * 10, max_new_tokens=50))
+        orch.run_until_drained(max_steps=20)
+        assert req.done and req.error is not None
+        assert 'KV budget' in req.error
+
+    def test_paged_config_validation(self):
+        params = llama.init(llama.LLAMA_TINY, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match='must divide'):
+            engine_lib.InferenceEngine(engine_lib.EngineConfig(
+                model=llama.LLAMA_TINY, max_slots=2, max_target_len=64,
+                prefill_buckets=(12,), kv_page_size=8), params)
+        with pytest.raises(NotImplementedError, match='int8'):
+            engine_lib.InferenceEngine(engine_lib.EngineConfig(
+                model=llama.LLAMA_TINY, max_slots=2, max_target_len=64,
+                prefill_buckets=(16,), kv_page_size=8,
+                kv_dtype=jnp.int8), params)
+
+    def test_paged_engine_blocks_speculation(self):
+        assert not _paged_engine().supports_verify
+
+
+class TestDeviceFinishMasking:
+    """decode_steps_masked: finished slots stop sampling AND stop
+    writing KV in-loop, on device."""
+
+    def _insert_one(self, eng, prompt, max_new):
+        state = eng.init_decode_state()
+        assert eng.reserve_kv(0, len(prompt), max_new)
+        first, kv, true_len = eng.prefill_any(prompt)
+        return eng.insert(state, kv, first, true_len, 0), int(first)
+
+    def _masked(self, eng, state, n, eos_id, remaining):
+        slots = eng.config.max_slots
+        eos = np.full((slots,), -1, np.int32)
+        eos[0] = eos_id
+        rem = np.full((slots,), 0, np.int32)
+        rem[0] = remaining
+        keys = jax.random.split(jax.random.PRNGKey(0), n)
+        return eng.decode_steps_masked(
+            state, n, jnp.zeros((slots,), jnp.float32), None, None,
+            jnp.asarray(eos), jnp.asarray(rem), keys)
+
+    def test_eos_row_invalidated_and_not_emitted(self):
+        prompt = [5, 17, 3, 99, 42]
+        eng = _paged_engine()
+        state, _ = self._insert_one(eng, prompt, 32)
+        state, _, toks, valid, _ = self._masked(eng, state, 6, -1, 32)
+        stream = np.asarray(toks)[:, 0].tolist()
+        eng2 = _paged_engine()
+        state2, _ = self._insert_one(eng2, prompt, 32)
+        state2, _, toks2, valid2, _ = self._masked(
+            eng2, state2, 6, stream[2], 32)
+        valid2 = np.asarray(valid2)[:, 0]
+        assert np.asarray(toks2)[:, 0].tolist()[:3] == stream[:3]
+        # Rows 0-1 kept; row 2 IS the EOS token → invalid (EOS never
+        # emitted); rows 3+ masked out on device.
+        assert valid2.tolist() == [True, True, False, False, False,
+                                   False]
+        assert not np.asarray(state2['active'])[0]
+
+    def test_budget_exhaust_keeps_final_token(self):
+        eng = _paged_engine()
+        state, _ = self._insert_one(eng, [5, 17, 3], 3)
+        state, rem, _, valid, _ = self._masked(eng, state, 6, -1, 3)
+        valid = np.asarray(valid)[:, 0]
+        # remaining=3: rows 0-2 valid (the exhausting token IS kept),
+        # rows 3+ masked.
+        assert valid.tolist() == [True, True, True, False, False,
+                                  False]
+        assert int(np.asarray(rem)[0]) == 0
+
+    def test_no_kv_writes_after_finish(self):
+        """After a slot deactivates, further fused steps must leave
+        the ENTIRE page arena untouched: the finished slot's write
+        position parks on the sentinel page and idle slots' tables are
+        all-sentinel, so every scatter drops."""
+        eng = _paged_engine()
+        state, _ = self._insert_one(eng, [5, 17, 3, 99, 42], 4)
+        state, _, _, valid, _ = self._masked(eng, state, 6, -1, 4)
+        assert not np.asarray(state['active'])[0]
+        k_before = np.asarray(jax.device_get(state['kv_k']))
+        v_before = np.asarray(jax.device_get(state['kv_v']))
+        lengths_before = int(np.asarray(state['lengths'])[0])
+        state, _, _, valid2, _ = self._masked(eng, state, 6, -1, 0)
+        assert not np.asarray(valid2).any()
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(state['kv_k'])), k_before)
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(state['kv_v'])), v_before)
+        assert int(np.asarray(state['lengths'])[0]) == lengths_before
+
+    def test_fast_tick_zero_wasted_steps(self, tiny_engine):
+        """Orchestrator-level: a request EOS-ing mid-fused-batch burns
+        zero post-finish rows on the fast tick."""
+        prompt = [5, 17, 3, 99, 42]
+        base = orch_lib.Orchestrator(tiny_engine, decode_steps=4)
+        full = base.generate([prompt], max_new_tokens=12)[0]
+        # First mid-stream token with no earlier occurrence — an EOS
+        # id recurring earlier would (correctly) stop the stream there.
+        cut = next(i for i in range(4, len(full) - 1)
+                   if full[i] not in full[:i])
+        eos = full[cut]
+        orch = orch_lib.Orchestrator(_paged_engine(), decode_steps=4)
+        req = orch.submit(orch_lib.Request(
+            prompt_tokens=prompt, max_new_tokens=12,
+            eos_token_id=eos))
+        orch.run_until_drained()
+        assert req.output_tokens == full[:cut]
+        assert eos not in req.output_tokens
+        assert orch.wasted_decode_steps == 0

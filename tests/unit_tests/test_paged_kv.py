@@ -1,0 +1,124 @@
+"""Paged-KV page allocator + decode-tick bench gate (host-only tier).
+
+Model-level paged/dense parity and device-side finish masking live in
+test_inference.py (slow tier — jit compiles); this file covers the
+pure-host allocator invariants the serving fast path leans on, and
+runs the decode-tick host-cost bench as a subprocess acceptance gate.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from skypilot_tpu.infer import paged_kv
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+class TestPageAllocator:
+
+    def test_allocate_reserves_ceil_div_pages(self):
+        pa = paged_kv.PageAllocator(num_pages=8, page_size=16,
+                                    blocks_per_slot=8)
+        assert pa.pages_for(1) == 1
+        assert pa.pages_for(16) == 1
+        assert pa.pages_for(17) == 2
+        assert pa.allocate(0, 33)          # 3 pages
+        assert pa.free_pages == 5
+        assert pa.used_pages == 3
+
+    def test_table_row_pages_then_sentinel(self):
+        pa = paged_kv.PageAllocator(num_pages=4, page_size=8,
+                                    blocks_per_slot=3)
+        assert pa.allocate(1, 12)          # 2 pages
+        row = pa.table_row(1)
+        assert row.shape == (3,) and row.dtype == np.int32
+        assert all(0 <= p < 4 for p in row[:2])
+        assert row[2] == pa.sentinel == 4
+        # Unallocated slots get all-sentinel rows.
+        assert list(pa.table_row(0)) == [4, 4, 4]
+
+    def test_exhaustion_fails_without_state_change(self):
+        pa = paged_kv.PageAllocator(num_pages=4, page_size=8,
+                                    blocks_per_slot=4)
+        assert pa.allocate(0, 24)          # 3 of 4 pages
+        free_before = pa.free_pages
+        assert not pa.allocate(1, 16)      # needs 2, only 1 left
+        assert pa.free_pages == free_before
+        assert list(pa.table_row(1)) == [pa.sentinel] * 4
+
+    def test_double_allocate_same_slot_raises(self):
+        pa = paged_kv.PageAllocator(num_pages=4, page_size=8,
+                                    blocks_per_slot=4)
+        assert pa.allocate(0, 8)
+        with pytest.raises(ValueError):
+            pa.allocate(0, 8)
+
+    def test_release_returns_pages_and_is_idempotent(self):
+        pa = paged_kv.PageAllocator(num_pages=4, page_size=8,
+                                    blocks_per_slot=4)
+        assert pa.allocate(0, 32)
+        assert pa.free_pages == 0
+        pa.release(0)
+        assert pa.free_pages == 4
+        pa.release(0)                      # second release: no-op
+        assert pa.free_pages == 4
+
+    def test_released_pages_reused_lifo(self):
+        pa = paged_kv.PageAllocator(num_pages=4, page_size=8,
+                                    blocks_per_slot=4)
+        assert pa.allocate(0, 16)
+        first = list(pa.table_row(0)[:2])
+        pa.release(0)
+        assert pa.allocate(1, 16)
+        # LIFO free list: the just-released pages come back first —
+        # the warmest HBM pages get reused.
+        assert list(pa.table_row(1)[:2]) == first
+
+    def test_can_admit_tracks_headroom(self):
+        pa = paged_kv.PageAllocator(num_pages=4, page_size=8,
+                                    blocks_per_slot=4)
+        assert pa.can_admit(32)
+        assert pa.allocate(0, 24)
+        assert pa.can_admit(8)
+        assert not pa.can_admit(16)
+
+    def test_release_all(self):
+        pa = paged_kv.PageAllocator(num_pages=6, page_size=8,
+                                    blocks_per_slot=3)
+        assert pa.allocate(0, 20) and pa.allocate(1, 8)
+        pa.release_all()
+        assert pa.free_pages == 6
+        assert list(pa.table_row(0)) == [pa.sentinel] * 3
+
+    def test_distinct_slots_get_distinct_pages(self):
+        pa = paged_kv.PageAllocator(num_pages=8, page_size=8,
+                                    blocks_per_slot=4)
+        assert pa.allocate(0, 32) and pa.allocate(1, 32)
+        p0 = set(pa.table_row(0).tolist())
+        p1 = set(pa.table_row(1).tolist())
+        assert not (p0 & p1)
+
+
+def test_bench_decode_smoke_gate():
+    """tools/bench_decode.py --smoke must pass its own acceptance
+    gate: fused masked tick >= 1.5x cheaper per token than the legacy
+    tick, identical outputs, zero wasted fused decode rows."""
+    bench = os.path.join(_REPO_ROOT, 'tools', 'bench_decode.py')
+    proc = subprocess.run(
+        [sys.executable, bench, '--smoke'],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, 'JAX_PLATFORMS': 'cpu'})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result['pass'] is True
+    assert result['identical_outputs'] is True
+    assert result['fast_wasted_steps'] == 0
+    assert result['legacy_wasted_steps'] > 0
+    assert result['speedup'] >= result['threshold']

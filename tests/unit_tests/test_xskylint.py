@@ -1052,10 +1052,11 @@ class TestCallGraph:
 
     def test_unknown_edges_are_counted_not_silent(self, graph):
         """Dynamic calls the heuristics cannot resolve are an explicit
-        per-node budget (the decode tick dispatches through
-        self.engine.* handles)."""
+        per-node budget (the fast decode tick dispatches through
+        self.engine.* handles; _decode_tick itself is now a pure
+        fast/legacy dispatcher with fully-resolvable edges)."""
         key = ('skypilot_tpu/infer/orchestrator.py',
-               'Orchestrator._decode_tick')
+               'Orchestrator._decode_tick_fast')
         graph.edges(key)   # populate the counter
         assert graph.unknown[key] > 0
 

@@ -46,7 +46,10 @@ HOT_PATH_ENTRIES: Tuple[Tuple[str, str], ...] = (
     ('skypilot_tpu/train/trainer.py', 'Trainer.step'),
     ('skypilot_tpu/infer/orchestrator.py', 'Orchestrator.step'),
     ('skypilot_tpu/infer/orchestrator.py', 'Orchestrator._decode_tick'),
+    ('skypilot_tpu/infer/orchestrator.py',
+     'Orchestrator._decode_tick_fast'),
     ('skypilot_tpu/infer/engine.py', 'ChunkedPrefill.step'),
+    ('skypilot_tpu/infer/paged_kv.py', 'PageAllocator.allocate'),
     ('skypilot_tpu/serve/load_balancer.py',
      'SkyServeLoadBalancer._proxy'),
     ('skypilot_tpu/agent/telemetry.py', 'emit'),
