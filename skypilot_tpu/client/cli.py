@@ -2390,6 +2390,103 @@ def serve_history(service_name, limit):
             else '-'))
 
 
+# Cross-hop waterfall order: LB-side phases first, then the replica
+# anatomy taxonomy (infer/anatomy.py PHASES — repeated here because
+# the CLI must not import the jax-loading infer package).
+_TRACE_PHASE_ORDER = ('lb_queue', 'relay_connect', 'replica_queue',
+                      'admit_deferred', 'prefill', 'decode',
+                      'sampling_commit', 'finish')
+
+
+@serve.command(name='trace')
+@click.argument('service_name')
+@click.option('--request', 'request_id', default=None,
+              help='One request: the LB-minted request id or the '
+                   'exemplar trace id a serve.slo_breach journal row '
+                   'names.')
+@click.option('--slowest', type=int, default=5,
+              help='Show the N slowest persisted exemplars.')
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='One JSON object per exemplar (full phase map).')
+def serve_trace(service_name, request_id, slowest, as_json):
+    """Per-request latency anatomy: where one slow request's time went,
+    LB relay to decode tick.
+
+    Reads the bounded slow-request exemplar table the SLO monitor
+    persists each evaluation (LB lifecycle record joined with the
+    replica-side anatomy by request id). `serve.slo_breach` journal
+    rows carry `exemplar_trace_ids` that resolve here via --request.
+    """
+    from skypilot_tpu import state as state_lib
+    limit = max(1, slowest)
+    if request_id:
+        rows = state_lib.get_serve_slo_exemplars(
+            service=service_name, request_id=request_id, limit=limit)
+        if not rows:
+            # Breach journal rows name trace ids, not request ids —
+            # accept either spelling.
+            rows = state_lib.get_serve_slo_exemplars(
+                service=service_name, trace_id=request_id,
+                limit=limit)
+    else:
+        rows = state_lib.get_serve_slo_exemplars(
+            service=service_name, limit=200)
+        rows.sort(key=lambda r: r.get('e2e_s') or 0.0, reverse=True)
+        rows = rows[:limit]
+    if as_json:
+        for row in rows:
+            click.echo(json.dumps(row, default=str))
+        return
+    if not rows:
+        click.echo('No trace exemplars persisted for '
+                   f'{service_name!r} yet (the SLO monitor writes '
+                   'them each scrape tick).')
+        return
+    import datetime
+    for row in rows:
+        when = datetime.datetime.fromtimestamp(
+            row['ts']).strftime('%m-%d %H:%M:%S') \
+            if row.get('ts') else '-'
+        e2e = row.get('e2e_s')
+        ttft = row.get('ttft_s')
+        click.echo(
+            f"request {row.get('request_id')}  "
+            f"trace {row.get('trace_id')}  {when}")
+        line = (f"  {row.get('path') or '-'}  "
+                f"outcome={row.get('outcome') or '-'}")
+        if e2e is not None:
+            line += f'  e2e={e2e * 1e3:.0f}ms'
+        if ttft is not None:
+            line += f'  ttft={ttft * 1e3:.0f}ms'
+        click.echo(line)
+        phases = row.get('phases') or {}
+        detail = row.get('detail') or {}
+        if detail.get('anatomy') == 'missing':
+            click.echo('  (replica anatomy missing — LB-side phases '
+                       'only)')
+        ordered = [p for p in _TRACE_PHASE_ORDER if p in phases]
+        ordered += sorted(p for p in phases
+                          if p not in _TRACE_PHASE_ORDER)
+        total = e2e or sum(phases.values()) or 1.0
+        for phase in ordered:
+            seconds = float(phases[phase] or 0.0)
+            bar = '#' * min(40, int(round(40 * seconds / total))) \
+                if total > 0 else ''
+            click.echo(f'  {phase:<16} {seconds * 1e3:>9.1f}ms  '
+                       f'{bar}')
+        extras = []
+        if detail.get('kv_headroom_at_admit') is not None:
+            extras.append('kv_headroom_at_admit='
+                          f"{detail['kv_headroom_at_admit']:.2f}")
+        if detail.get('retries'):
+            extras.append(f"retries={detail['retries']}")
+        if detail.get('replica_id') is not None:
+            extras.append(f"replica={detail['replica_id']}")
+        if extras:
+            click.echo('  ' + '  '.join(extras))
+        click.echo('')
+
+
 @cli.group()
 def api():
     """API server management."""

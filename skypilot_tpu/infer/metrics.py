@@ -19,6 +19,16 @@ Exposed at GET /metrics on every replica:
   * xsky_serve_wasted_decode_steps_total  (counter: fused decode rows
     burned after a slot finished — legacy tick only, the masked fast
     tick holds it at 0)
+  * xsky_serve_phase_seconds{phase=...}   (histogram per anatomy
+    phase — replica_queue/admit_deferred/prefill/decode/
+    sampling_commit/finish, fed by infer/anatomy.py seals)
+  * xsky_serve_kv_headroom_at_admit       (gauge: free/total KV pages
+    seen by the most recent successful admission)
+  * xsky_serve_deferred_wait_seconds      (gauge: how long the oldest
+    currently-deferred request has been parked for KV headroom)
+  * xsky_serve_deadline_rejects_total     (counter: requests rejected
+    at admit because the relayed SLO deadline could not cover the
+    estimated prefill+decode budget)
 
 The serve controller's SLO monitor (serve/slo.py) scrapes this text
 each tick: TTFT/TPOT/e2e feed the per-replica latency digests in
@@ -29,6 +39,7 @@ tokens).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 from skypilot_tpu.agent import telemetry
@@ -36,6 +47,7 @@ from skypilot_tpu.agent import telemetry
 # its scrape parser round-trips (serve/slo.py); a second copy here
 # would have to stay render-compatible by hand.
 from skypilot_tpu.serve.slo import Histogram as _Histogram
+from skypilot_tpu.serve.slo import fmt_le as _fmt_le
 
 _TTFT_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
                  float('inf'))
@@ -46,6 +58,11 @@ _TPOT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                  0.5, 1.0, float('inf'))
 _E2E_BUCKETS = (0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0,
                 float('inf'))
+# Anatomy phases span sub-ms (sampling_commit) to tens of seconds
+# (decode totals, deferred waits) — one shared bucket ladder must
+# resolve both ends.
+_PHASE_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                  5.0, 10.0, 30.0, float('inf'))
 
 
 
@@ -61,6 +78,7 @@ class ServeMetrics:
         self._ttft = _Histogram(_TTFT_BUCKETS)
         self._tpot = _Histogram(_TPOT_BUCKETS)
         self._e2e = _Histogram(_E2E_BUCKETS)
+        self._phase: Dict[str, _Histogram] = {}
 
     def observe(self, endpoint: str, outcome: str, prompt_tokens: int,
                 generated_tokens: int, ttft_s: Optional[float],
@@ -84,6 +102,18 @@ class ServeMetrics:
         # shows up hung in `xsky top`, same as a stalled train step.
         telemetry.emit(phase=telemetry.PHASE_STEP, step=n_requests,
                        tokens=generated_tokens)
+
+    def observe_phases(self, phases: Dict[str, float]) -> None:
+        """Fold one sealed anatomy record's phase breakdown into the
+        per-phase histograms (called off the tick path, by the handler
+        thread that sealed the record)."""
+        with self._lock:
+            for phase, seconds in phases.items():
+                hist = self._phase.get(phase)
+                if hist is None:
+                    hist = self._phase[phase] = _Histogram(
+                        _PHASE_BUCKETS)
+                hist.observe(seconds)
 
     def observe_choice_tokens(self, request) -> None:
         """Token accounting for an n>1 sibling choice: its prompt AND
@@ -139,6 +169,23 @@ class ServeMetrics:
             lines += self._ttft.render('xsky_serve_ttft_seconds')
             lines += self._tpot.render('xsky_serve_tpot_seconds')
             lines += self._e2e.render('xsky_serve_e2e_latency_seconds')
+            if self._phase:
+                # Labelled histogram family: slo.Histogram.render is
+                # label-free, so the {phase=...} series are laid out
+                # by hand — same bucket/sum/count shape the scrape
+                # parser round-trips.
+                name = 'xsky_serve_phase_seconds'
+                lines.append(f'# TYPE {name} histogram')
+                for phase in sorted(self._phase):
+                    hist = self._phase[phase]
+                    for i, le in enumerate(hist.les):
+                        lines.append(
+                            f'{name}_bucket{{phase="{phase}",'
+                            f'le="{_fmt_le(le)}"}} {hist.counts[i]}')
+                    lines.append(f'{name}_sum{{phase="{phase}"}} '
+                                 f'{hist.total:.6f}')
+                    lines.append(f'{name}_count{{phase="{phase}"}} '
+                                 f'{hist.n}')
         if orch is not None:
             active = len(orch._slot_req)
             free = len(orch._free_slots)
@@ -150,6 +197,28 @@ class ServeMetrics:
                 '# TYPE xsky_serve_queue_depth gauge',
                 f'xsky_serve_queue_depth {orch._pending.qsize()}',
             ]
+            headroom = getattr(orch, 'last_admit_kv_headroom', None)
+            if headroom is not None:
+                lines += [
+                    '# TYPE xsky_serve_kv_headroom_at_admit gauge',
+                    f'xsky_serve_kv_headroom_at_admit {headroom:.4f}',
+                ]
+            deferred = list(getattr(orch, '_deferred', None) or [])
+            waits = [time.perf_counter() - r.deferred_at
+                     for r in deferred
+                     if getattr(r, 'deferred_at', None) is not None]
+            if waits:
+                lines += [
+                    '# TYPE xsky_serve_deferred_wait_seconds gauge',
+                    f'xsky_serve_deferred_wait_seconds '
+                    f'{max(waits):.4f}',
+                ]
+            rejects = getattr(orch, 'deadline_rejects', None)
+            if rejects is not None:
+                lines += [
+                    '# TYPE xsky_serve_deadline_rejects_total counter',
+                    f'xsky_serve_deadline_rejects_total {rejects}',
+                ]
             wasted = getattr(orch, 'wasted_decode_steps', None)
             if wasted is not None:
                 lines += [

@@ -22,6 +22,7 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu.agent import profiler
 from skypilot_tpu.infer import engine as engine_lib
 from skypilot_tpu.infer import sampling as sampling_lib
+from skypilot_tpu.utils import chaos
 
 logger = sky_logging.init_logger(__name__)
 
@@ -64,6 +65,22 @@ class Request:
     submitted_at: float = 0.0
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
+    # Cross-hop trace context, set by the serving handler from the
+    # X-Xsky-* relay headers before submit (None for direct callers):
+    trace_id: Optional[str] = None
+    client_request_id: Optional[str] = None
+    # Absolute perf_counter deadline (submitted_at + the remaining
+    # budget the relay's deadline header carried). None = no deadline.
+    deadline_at: Optional[float] = None
+    # Anatomy phase accumulators (seconds), maintained by the
+    # orchestrator as pure float adds and sealed into an AnatomyLog
+    # record by the handler once the request finishes:
+    taken_at: Optional[float] = None
+    deferred_at: Optional[float] = None
+    deferred_wait: float = 0.0
+    decode_s: float = 0.0
+    commit_s: float = 0.0
+    kv_headroom_at_admit: Optional[float] = None
 
 
 class Orchestrator:
@@ -125,6 +142,22 @@ class Orchestrator:
         # (legacy tick only; the masked loop stops the slot in-loop, so
         # its arm contributes zero by construction).
         self.wasted_decode_steps = 0
+        # Per-request anatomy: when on, ticks amortize ONE timestamp
+        # pair per fused batch into the resident requests' decode /
+        # commit accumulators (pure float adds — the hot-path-purity
+        # closure stays clean). XSKY_ANATOMY=0 is the bench_decode
+        # paired-difference baseline arm.
+        self._anatomy = os.environ.get('XSKY_ANATOMY', '1') != '0'
+        # KV free-page fraction observed at the last successful admit
+        # (paged engines; the xsky_serve_kv_headroom_at_admit gauge).
+        self.last_admit_kv_headroom: Optional[float] = None
+        # Deadline admission: requests rejected because their remaining
+        # deadline could not cover the estimated prefill+decode budget.
+        self.deadline_rejects = 0
+        # EWMA budget estimators feeding the deadline gate (seconds);
+        # None until the first completed prefill / decode tick.
+        self._ewma_prefill_s: Optional[float] = None
+        self._ewma_decode_per_token_s: Optional[float] = None
 
     # ---- submission ----
 
@@ -182,15 +215,64 @@ class Orchestrator:
             return False
         return True
 
+    def _estimated_budget_s(self, request: Request) -> Optional[float]:
+        """EWMA estimate of the request's remaining serving cost:
+        one prefill plus max_new_tokens decode steps. None until any
+        request has completed a prefill or a decode tick has run."""
+        p = self._ewma_prefill_s
+        d = self._ewma_decode_per_token_s
+        if p is None and d is None:
+            return None
+        est = p or 0.0
+        if d is not None:
+            est += d * request.max_new_tokens
+        return est
+
+    def _deadline_reject(self, request: Request, now: float) -> bool:
+        """Deadline admission gate (pure host float math): a request
+        whose remaining deadline cannot cover the reserved
+        prefill+decode budget is finished here instead of parking
+        forever. With no EWMA sample yet only an already-expired
+        deadline rejects. The handler thread journals the trace-linked
+        ``serve.deadline_reject`` — no DB write on the tick path."""
+        if request.deadline_at is None:
+            return False
+        remaining = request.deadline_at - now
+        budget = self._estimated_budget_s(request) or 0.0
+        if remaining > budget:
+            return False
+        request.error = (
+            f'deadline exceeded at admit: {remaining * 1e3:.0f} ms '
+            f'remaining < {budget * 1e3:.0f} ms estimated '
+            f'prefill+decode budget')
+        request.done = True
+        request.finished_at = now
+        self.deadline_rejects += 1
+        return True
+
     def _take_request(self) -> Optional[Request]:
         """Next admission candidate: headroom-deferred requests retry
-        ahead of the queue (FIFO within each)."""
-        if self._deferred:
-            return self._deferred.pop(0)
-        try:
-            return self._pending.get_nowait()
-        except queue.Empty:
-            return None
+        ahead of the queue (FIFO within each). Expired-deadline
+        candidates are rejected here — admission time, off the decode
+        commit loop."""
+        now = time.perf_counter()
+        while self._deferred:
+            request = self._deferred.pop(0)
+            if request.deferred_at is not None:
+                request.deferred_wait += now - request.deferred_at
+                request.deferred_at = None
+            if self._deadline_reject(request, now):
+                continue
+            return request
+        while True:
+            try:
+                request = self._pending.get_nowait()
+            except queue.Empty:
+                return None
+            if request.taken_at is None:
+                request.taken_at = now
+            if not self._deadline_reject(request, now):
+                return request
 
     def _reserve_or_defer(self, request: Request, slot: int) -> bool:
         """Reserve KV capacity for the request's full budget against
@@ -200,8 +282,16 @@ class Orchestrator:
         when a running stream finishes)."""
         if self.engine.reserve_kv(slot, len(request.prompt_tokens),
                                   request.max_new_tokens):
+            if self._anatomy:
+                pages = getattr(self.engine, 'kv_page_stats', None)
+                if pages and pages.get('total'):
+                    headroom = pages['free'] / pages['total']
+                    request.kv_headroom_at_admit = headroom
+                    self.last_admit_kv_headroom = headroom
             return True
         self._free_slots.append(slot)
+        if request.deferred_at is None:
+            request.deferred_at = time.perf_counter()
         self._deferred.append(request)
         return False
 
@@ -334,6 +424,14 @@ class Orchestrator:
         (shared by single and batched admission)."""
         request.output_tokens.append(int(first_token))
         request.first_token_at = time.perf_counter()
+        if request.taken_at is not None:
+            # Prefill EWMA sample for the deadline admission gate
+            # (take → first token, minus any headroom-deferred wait).
+            sample = max(0.0, request.first_token_at -
+                         request.taken_at - request.deferred_wait)
+            prev = self._ewma_prefill_s
+            self._ewma_prefill_s = (sample if prev is None
+                                    else 0.8 * prev + 0.2 * sample)
         self._slot_req[slot] = request
         self._params_dirty = True
         self._maybe_finish(slot, int(first_token))
@@ -408,10 +506,32 @@ class Orchestrator:
         budget run exactly once per tick. Dispatches to the fused
         masked fast path unless XSKY_DECODE_FAST_TICK=0 pins the
         legacy host-per-row tick."""
+        # Chaos drill: `infer.decode_stall` slows one decode tick — a
+        # latency rule here is how the anatomy drill proves a slow
+        # DECODE (not queueing) shows up as the dominant waterfall
+        # phase behind an SLO breach. The chaos module is purity-skip
+        # listed: it only acts under an explicit fault plan.
+        chaos.inject('infer.decode_stall')
         if self._fast_tick:
             self._decode_tick_fast()
         else:
             self._decode_tick_legacy()
+
+    def _attribute_tick(self, residents: List[Request], decode_share: float,
+                        commit_share: float, tokens: int) -> None:
+        """Fold one fused batch's decode/commit wall time into the
+        resident requests' anatomy accumulators — batch-amortized
+        (one timestamp pair per tick, never per token) and pure float
+        adds, so the hot-path-purity closure stays clean. Also feeds
+        the per-token decode EWMA behind the deadline admission gate."""
+        for request in residents:
+            request.decode_s += decode_share
+            request.commit_s += commit_share
+        if tokens > 0:
+            sample = (decode_share + commit_share) / tokens
+            prev = self._ewma_decode_per_token_s
+            self._ewma_decode_per_token_s = (
+                sample if prev is None else 0.8 * prev + 0.2 * sample)
 
     # ---- fast tick: device-resident params + device-side finish ----
 
@@ -480,6 +600,9 @@ class Orchestrator:
         """
         if not self._slot_req:
             return
+        anatomy = self._anatomy
+        t_tick = time.perf_counter() if anatomy else 0.0
+        residents = list(self._slot_req.values()) if anatomy else None
         if self._params_dirty:
             self._rebuild_device_params()
         n = self.decode_steps
@@ -501,9 +624,11 @@ class Orchestrator:
         if probe is not None:
             probe.done()
         now = time.perf_counter()
+        committed = 0
         for slot in list(self._slot_req):
             request = self._slot_req[slot]
             vm = valid_np[:, slot]
+            emitted_before = len(request.output_tokens)
             for i in range(n):
                 if not vm[i]:
                     break
@@ -512,6 +637,7 @@ class Orchestrator:
                     self._record_logprobs(
                         request,
                         (lp_np[0][i], lp_np[1][i], lp_np[2][i]), slot)
+            committed += len(request.output_tokens) - emitted_before
             # An invalid row means the device deactivated the slot
             # (EOS — its token was never emitted, so there is nothing
             # to pop — or budget exhaustion after the last kept row).
@@ -526,12 +652,24 @@ class Orchestrator:
                 del self._slot_req[slot]
                 self._free_slots.append(slot)
                 self._params_dirty = True
+        if anatomy:
+            # One timestamp pair for the WHOLE fused batch: dispatch +
+            # device wait before `now`, host commit after it. The
+            # token count rides the commit loop's length bookkeeping —
+            # a ufunc reduction over the valid mask here costs more
+            # than the rest of the recorder combined.
+            self._attribute_tick(residents, max(0.0, now - t_tick),
+                                 max(0.0, time.perf_counter() - now),
+                                 committed)
 
     # ---- legacy tick: host-side finish scan (bench baseline arm) ----
 
     def _decode_tick_legacy(self) -> None:
         if not self._slot_req:
             return
+        anatomy = self._anatomy
+        t_tick = time.perf_counter() if anatomy else 0.0
+        residents = list(self._slot_req.values()) if anatomy else None
         slots = self.engine.config.max_slots
         temps = np.zeros((slots,), np.float32)
         top_k = np.zeros((slots,), np.int32)
@@ -577,10 +715,13 @@ class Orchestrator:
                        for a in out[2]) if k else None
         if probe is not None:
             probe.done()
+        t_commit = time.perf_counter() if anatomy else 0.0
+        committed = 0
         for i, row in enumerate(batches):
             for slot in list(self._slot_req):
                 request = self._slot_req[slot]
                 request.output_tokens.append(int(row[slot]))
+                committed += 1
                 if request.logprobs and lp is not None:
                     self._record_logprobs(
                         request, (lp[0][i], lp[1][i], lp[2][i]), slot)
@@ -590,6 +731,12 @@ class Orchestrator:
                     # for this slot; the fast tick's device mask makes
                     # these structurally zero.
                     self.wasted_decode_steps += len(batches) - 1 - i
+        if anatomy:
+            self._attribute_tick(residents,
+                                 max(0.0, t_commit - t_tick),
+                                 max(0.0,
+                                     time.perf_counter() - t_commit),
+                                 committed)
 
     def _verify_round(self, active_before, proposals) -> None:
         """One greedy verify pass over [slots, γ] proposals: append the

@@ -132,14 +132,57 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
     admissions get 429 instead of unbounded queueing (an overloaded
     replica should fail fast so the serve LB retries a healthier one).
     """
+    from skypilot_tpu.infer import anatomy as anatomy_lib
     from skypilot_tpu.infer import metrics as metrics_lib
     from skypilot_tpu.infer import openai_api
+    from skypilot_tpu.utils import tracing
     if metrics is None:
         metrics = metrics_lib.ServeMetrics()
+    anatomy_log = anatomy_lib.get_log()
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):
             logger.debug(fmt % args)
+
+        def _attach_trace(self, request):
+            """Adopt the LB relay's cross-hop context (trace id, LB
+            request id, remaining deadline) onto the orchestrator
+            Request BEFORE submit — the deadline admission gate and
+            the anatomy join key both read it from there. Direct
+            (relay-less) callers simply carry no headers."""
+            trace_id, req_id, deadline_s = tracing.extract_headers(
+                self.headers)
+            request.trace_id = trace_id
+            request.client_request_id = req_id
+            if deadline_s is not None:
+                request.deadline_at = time.perf_counter() + deadline_s
+            return request
+
+        def _seal(self, request, outcome):
+            """Fold the finished request into the anatomy ring and
+            journal a trace-linked deadline rejection — handler
+            thread, off the tick path. Never lets observability take
+            down the response path."""
+            try:
+                if anatomy_lib.enabled():
+                    rec = anatomy_log.seal(request, outcome=outcome)
+                    if rec is not None:
+                        metrics.observe_phases(rec['phases'])
+                if request.error and \
+                        request.error.startswith('deadline exceeded'):
+                    from skypilot_tpu import state as state_lib
+                    state_lib.record_recovery_event(
+                        'serve.deadline_reject',
+                        scope=f'replica/{model_id}',
+                        cause=request.error,
+                        detail={
+                            'request_id': (request.client_request_id
+                                           or request.request_id),
+                            'max_new_tokens': request.max_new_tokens,
+                        },
+                        trace_id=request.trace_id)
+            except Exception:  # pylint: disable=broad-except
+                logger.debug('anatomy seal failed', exc_info=True)
 
         def _json(self, code, payload):
             data = json.dumps(payload).encode()
@@ -165,6 +208,20 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
                 self.send_header('Content-Length', str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+            elif self.path.startswith('/anatomy'):
+                # Replica-side anatomy records, newest-first
+                # (?limit=&request_id=) — the SLO monitor fetches
+                # these each scrape to join with the LB lifecycle
+                # ring into cross-hop waterfalls.
+                from urllib.parse import parse_qs, urlparse
+                q = parse_qs(urlparse(self.path).query)
+                try:
+                    limit = int(q.get('limit', ['200'])[0])
+                except ValueError:
+                    limit = 200
+                req_id = (q.get('request_id', [None])[0]) or None
+                self._json(200, anatomy_log.records(
+                    limit=limit, request_id=req_id))
             else:
                 self._json(404, {'error': 'not found'})
 
@@ -211,9 +268,12 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
                 temperature=float(body.get('temperature', 0.0)),
                 top_k=int(body.get('top_k', 0)),
                 top_p=float(body.get('top_p', 1.0)))
+            self._attach_trace(request)
             t0 = time.perf_counter()
             loop.submit_and_wait(request)
             metrics.observe_request('/generate', request)
+            self._seal(request,
+                       outcome='error' if request.error else 'ok')
             if request.error:
                 self._json(400, {'error': request.error})
                 return
@@ -243,6 +303,7 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
                 return
             endpoint = ('/v1/chat/completions' if chat
                         else '/v1/completions')
+            self._attach_trace(request)
             if meta.stream:
                 outcome = 'cancelled'
                 try:
@@ -250,6 +311,7 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
                 finally:
                     metrics.observe_request(endpoint, request,
                                             outcome=outcome)
+                    self._seal(request, outcome=outcome)
                 return
             siblings = [openai_api.clone_request(request)
                         for _ in range(meta.n - 1)]
@@ -287,10 +349,13 @@ def build_handler(loop: ServingLoop, config: engine_lib.EngineConfig,
                     sib.error = sib.error or 'server timeout'
                     sib.cancel_requested = True
             metrics.observe_request(endpoint, request)
+            self._seal(request,
+                       outcome='error' if request.error else 'ok')
             for sib in siblings:
                 # Token counters must see every choice's generation
                 # (but one HTTP request stays ONE request in the
-                # count/latency series).
+                # count/latency series) — and, like the counters, one
+                # HTTP request seals ONE anatomy record.
                 metrics.observe_choice_tokens(sib)
             failed = request.error or next(
                 (s.error for s in siblings if s.error), None)

@@ -60,6 +60,12 @@ class SkyServeController:
                 self.spec.load_balancing_policy),
             on_request=lambda: self.autoscaler
             .collect_request_information(1, 0.0))
+        # Per-request deadline (slo.deadline_ms): the LB relays each
+        # request's remaining budget downstream so the orchestrator's
+        # admit gate can shed work that can no longer finish in time.
+        self.load_balancer.deadline_ms = (
+            self.spec.slo.deadline_ms
+            if self.spec.slo is not None else None)
         # SLO plane: every scrape interval the monitor pulls replica
         # /metrics, folds in the LB's request records, and persists
         # burn rates + latency digests into the serve_slo table.
@@ -160,6 +166,9 @@ class SkyServeController:
         self.replica_manager.apply_update(task_config, self.spec,
                                           self.version)
         self.slo_monitor.update_slo(self.spec.slo)
+        self.load_balancer.deadline_ms = (
+            self.spec.slo.deadline_ms
+            if self.spec.slo is not None else None)
         logger.info(f'Service {self.service_name}: rolling update to '
                     f'v{self.version}.')
 

@@ -26,10 +26,13 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import (Any, Callable, Dict, List, Optional, Tuple)
 
+import uuid
+
 from skypilot_tpu import sky_logging
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
 from skypilot_tpu.serve import slo as slo_lib
 from skypilot_tpu.utils import chaos
+from skypilot_tpu.utils import tracing
 
 logger = sky_logging.init_logger(__name__)
 
@@ -83,9 +86,17 @@ class RequestLog:
             't0': time.monotonic(),     # latency base
             'method': method,
             'path': path,
+            # Cross-hop identity: minted ONCE per client request, so
+            # every retried upstream leg relays the same ids and the
+            # replica-side anatomy joins back to this record.
+            'request_id': uuid.uuid4().hex[:12],
+            'trace_id': tracing.new_trace_id(),
             'replica': None,
             'retries': 0,
             'connect_s': None,
+            # Arrival → start of the WINNING relay leg (retry/backoff
+            # time spent at the LB): the waterfall's lb_queue phase.
+            'relay_start_s': None,
             'ttft_s': None,
             'e2e_s': None,
             'bytes': 0,
@@ -117,13 +128,16 @@ class RequestLog:
                 self._e2e.observe(rec['e2e_s'])
         return rec
 
-    def records(self, limit: Optional[int] = None
-                ) -> List[Dict[str, Any]]:
+    def records(self, limit: Optional[int] = None,
+                offset: int = 0) -> List[Dict[str, Any]]:
         """Newest-first copies (JSON-safe: the monotonic base is
-        dropped)."""
+        dropped). `offset` skips that many newest records first —
+        the `/lb/requests` paging contract."""
         with self._lock:
             rows = list(self._ring)
         rows.reverse()
+        if offset:
+            rows = rows[max(0, int(offset)):]
         if limit is not None:
             rows = rows[:max(0, int(limit))]
         return [{k: v for k, v in r.items() if k != 't0'}
@@ -190,6 +204,12 @@ class SkyServeLoadBalancer:
         # re-read on every proxy attempt so a drain starting during a
         # retry loop cannot route back to the draining target.
         self._draining: frozenset = frozenset()
+        # Per-request end-to-end deadline (SLOSpec.deadline_ms,
+        # threaded in by the serve controller): relayed as a
+        # remaining-budget header so the replica's admission gate can
+        # reject requests whose deadline cannot cover the estimated
+        # prefill+decode budget instead of parking them. None = off.
+        self.deadline_ms: Optional[float] = None
 
     def set_ready_replicas(self, endpoints: List[str],
                            draining: Optional[List[str]] = None
@@ -282,6 +302,24 @@ class SkyServeLoadBalancer:
             for k, v in headers.items():
                 if k.lower() not in _HOP_HEADERS:
                     req.add_header(k, v)
+            if rec is not None:
+                # Cross-hop context on EVERY attempt: retried legs
+                # carry the SAME trace/request ids (the record is
+                # per client request), while the deadline header is
+                # re-measured per leg so retries shrink the budget
+                # the replica's admission gate sees.
+                trace_headers: Dict[str, str] = {}
+                remaining_s = None
+                if self.deadline_ms is not None:
+                    remaining_s = (self.deadline_ms / 1e3 -
+                                   (time.monotonic() - rec['t0']))
+                tracing.inject_headers(
+                    trace_headers, trace_id=rec['trace_id'],
+                    request_id=rec['request_id'],
+                    deadline_s=remaining_s)
+                for k, v in trace_headers.items():
+                    req.add_header(k, v)
+                rec['relay_start_s'] = time.monotonic() - rec['t0']
             try:
                 # Chaos drill: `lb.proxy` slows or fails the upstream
                 # leg of one request — a latency rule here is how the
@@ -389,8 +427,19 @@ class SkyServeLoadBalancer:
                         200, body, 'text/plain; version=0.0.4')
                     return True
                 if self.path.startswith('/lb/requests'):
+                    # Paged debug dump (?limit=&offset=, newest-first):
+                    # serializing the whole ring in one response at
+                    # production ring sizes is a multi-MB JSON body.
+                    from urllib.parse import parse_qs, urlparse
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        limit = int(q.get('limit', ['200'])[0])
+                        offset = int(q.get('offset', ['0'])[0])
+                    except ValueError:
+                        limit, offset = 200, 0
                     body = json.dumps(
-                        lb.request_log.records(limit=200),
+                        lb.request_log.records(limit=limit,
+                                               offset=offset),
                         default=str).encode()
                     self._send_local(200, body, 'application/json')
                     return True

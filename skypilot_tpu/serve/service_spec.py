@@ -11,28 +11,40 @@ class SLOSpec:
           ttft_p99_ms: 500      # p99 time-to-first-token at the LB
           availability: 0.999   # non-error fraction of requests
           tpot_p50_ms: 40       # median inter-token latency (replica)
+          deadline_ms: 30000    # per-request end-to-end deadline
 
     All fields optional; burn rates are computed per declared objective
     (serve/slo.py). The error budget falls out of each objective: a
     p99 target concedes 1% of requests, a p50 target 50%, and
     availability concedes ``1 - availability``.
+
+    ``deadline_ms`` is not a burn objective: the LB relays each
+    request's remaining budget to the replica
+    (``X-Xsky-Deadline-S``), and the orchestrator rejects a deferred
+    request at admit when that budget can no longer cover its
+    estimated prefill+decode cost — shedding doomed work instead of
+    finishing it late (journalled as ``serve.deadline_reject``).
     """
 
-    FIELDS = ('ttft_p99_ms', 'availability', 'tpot_p50_ms')
+    FIELDS = ('ttft_p99_ms', 'availability', 'tpot_p50_ms',
+              'deadline_ms')
 
     def __init__(self, ttft_p99_ms: Optional[float] = None,
                  availability: Optional[float] = None,
-                 tpot_p50_ms: Optional[float] = None) -> None:
+                 tpot_p50_ms: Optional[float] = None,
+                 deadline_ms: Optional[float] = None) -> None:
         if ttft_p99_ms is not None and ttft_p99_ms <= 0:
             raise ValueError('slo.ttft_p99_ms must be > 0')
         if tpot_p50_ms is not None and tpot_p50_ms <= 0:
             raise ValueError('slo.tpot_p50_ms must be > 0')
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise ValueError('slo.deadline_ms must be > 0')
         if availability is not None and not 0.0 < availability <= 1.0:
             raise ValueError(
                 'slo.availability must be in (0, 1] (a fraction, '
                 'not a percentage)')
         if ttft_p99_ms is None and availability is None and \
-                tpot_p50_ms is None:
+                tpot_p50_ms is None and deadline_ms is None:
             raise ValueError(
                 'slo: declares no objective; expected at least one of '
                 f'{list(self.FIELDS)}')
@@ -42,6 +54,8 @@ class SLOSpec:
             float(availability) if availability is not None else None
         self.tpot_p50_ms = \
             float(tpot_p50_ms) if tpot_p50_ms is not None else None
+        self.deadline_ms = \
+            float(deadline_ms) if deadline_ms is not None else None
 
     @classmethod
     def from_config(cls, config: Optional[Dict[str, Any]]

@@ -25,6 +25,7 @@ and the control-plane ``/metrics`` gauges.
 from __future__ import annotations
 
 import collections
+import json
 import os
 import time
 import urllib.request
@@ -46,6 +47,17 @@ ENV_BURN_THRESHOLD = 'XSKY_SLO_BURN_THRESHOLD'
 # scraping every tick would hammer replicas for no signal).
 ENV_SCRAPE_INTERVAL = 'XSKY_SLO_SCRAPE_INTERVAL_S'
 ENV_SCRAPE_TIMEOUT = 'XSKY_SLO_SCRAPE_TIMEOUT'
+# Slow-request exemplars persisted per evaluation (0 disables). The
+# table itself is retention-bounded in state.py; this only caps how
+# many NEW waterfalls one tick may add.
+ENV_EXEMPLAR_TOP_K = 'XSKY_SLO_EXEMPLAR_TOP_K'
+
+
+def exemplar_top_k() -> int:
+    try:
+        return int(os.environ.get(ENV_EXEMPLAR_TOP_K, '8'))
+    except ValueError:
+        return 8
 
 
 def burn_windows() -> List[float]:
@@ -397,6 +409,25 @@ def scrape_replica_metrics(endpoint: str,
             resp.read().decode('utf-8', errors='replace'))
 
 
+def fetch_replica_anatomy(endpoint: str,
+                          timeout: Optional[float] = None,
+                          limit: int = 256
+                          ) -> List[Dict[str, Any]]:
+    """GET http://<endpoint>/anatomy — the replica-side per-request
+    phase records (infer/anatomy.py ring) the exemplar join matches
+    against LB request ids. Raises on transport errors; callers treat
+    a dead fetch as 'no anatomy this tick', not a verdict (a replica
+    that can't narrate its latency is still serving)."""
+    if timeout is None:
+        timeout = float(os.environ.get(ENV_SCRAPE_TIMEOUT, '5'))
+    with urllib.request.urlopen(
+            f'http://{endpoint}/anatomy?limit={int(limit)}',
+            timeout=timeout) as resp:
+        rows = json.loads(resp.read().decode('utf-8',
+                                             errors='replace'))
+    return rows if isinstance(rows, list) else []
+
+
 def replica_digest(samples: Dict[str, List[Sample]]
                    ) -> Dict[str, Any]:
     """Per-replica latency digest from one parsed scrape: TTFT/TPOT/
@@ -456,6 +487,11 @@ class SLOMonitor:
         # bounded deques of (ts, tpot buckets) + (ts, generated tokens).
         self._tpot_prev: Dict[int, collections.deque] = {}
         self._tokens_prev: Dict[int, Tuple[float, int]] = {}
+        # Request ids already persisted as exemplars: a slow request
+        # stays inside the burn window for an hour — it must not be
+        # re-written every scrape tick.
+        self._exemplar_seen: collections.deque = collections.deque(
+            maxlen=512)
 
     def update_slo(self, slo) -> None:
         self.slo = slo
@@ -498,6 +534,10 @@ class SLOMonitor:
             rows: List[Dict[str, Any]] = []
             inflight = self._inflight_source() or {}
             tpot_deltas: List[Buckets] = []
+            # request_id → replica anatomy record, filled by the
+            # scrape fan-out (dict.setdefault is atomic; same shared-
+            # accumulator posture as tpot_deltas).
+            anatomies: Dict[str, Dict[str, Any]] = {}
             ready = [
                 r for r in replicas
                 if r.get('endpoint') and
@@ -518,7 +558,8 @@ class SLOMonitor:
                 from skypilot_tpu.utils import parallelism
                 results = parallelism.run_in_parallel(
                     lambda r: self._scrape_one(r, now, windows,
-                                               inflight, tpot_deltas),
+                                               inflight, tpot_deltas,
+                                               anatomies),
                     ready, phase='slo_scrape',
                     what='replica SLO scrape')
                 rows.extend(r for r in results if r is not None)
@@ -528,13 +569,18 @@ class SLOMonitor:
             rows.append(service_row)
             global_state.record_serve_slo(self.service_name, rows,
                                           ts=now)
+            exemplars = self._build_exemplars(anatomies, now, windows)
+            if exemplars:
+                global_state.record_serve_slo_exemplars(
+                    self.service_name, exemplars, ts=now)
             self._journal_transition(service_row, global_state)
             return service_row
 
     def _scrape_one(self, replica: Dict[str, Any], now: float,
                     windows: List[float],
                     inflight: Dict[str, int],
-                    tpot_deltas: List[Buckets]
+                    tpot_deltas: List[Buckets],
+                    anatomies: Dict[str, Dict[str, Any]]
                     ) -> Optional[Dict[str, Any]]:
         replica_id = replica['replica_id']
         endpoint = replica['endpoint']
@@ -544,6 +590,18 @@ class SLOMonitor:
                               service=self.service_name,
                               replica=replica_id):
                 samples = scrape_replica_metrics(endpoint)
+                # Anatomy fetch failures downgrade to 'no waterfall
+                # this tick', never to scrape_failed — the metrics
+                # scrape above is the replica's health verdict.
+                try:
+                    for rec in fetch_replica_anatomy(endpoint):
+                        rid = rec.get('request_id')
+                        if rid:
+                            rec['replica_id'] = replica_id
+                            anatomies.setdefault(rid, rec)
+                except Exception as e:  # pylint: disable=broad-except
+                    logger.debug(f'replica {replica_id} anatomy '
+                                 f'fetch failed: {e}')
         except Exception as e:  # pylint: disable=broad-except
             logger.debug(f'replica {replica_id} scrape failed: {e}')
             return {'kind': 'replica', 'replica_id': replica_id,
@@ -663,6 +721,77 @@ class SLOMonitor:
         for per in burns.values():
             per['tpot_p50_ms'] = burn
 
+    def _build_exemplars(self, anatomies: Dict[str, Dict[str, Any]],
+                         now: float, windows: List[float]
+                         ) -> List[Dict[str, Any]]:
+        """Top-K slowest finished requests of the window, each joined
+        with its replica-side anatomy by the LB-minted request id into
+        one cross-hop waterfall:
+
+          lb_queue       arrival → start of the winning relay leg
+          relay_connect  client e2e − lb_queue − replica-side total
+                         (connect + wire transfer on that leg)
+          <replica phases from infer/anatomy.py>
+
+        so the persisted phases sum to the client-observed e2e and a
+        breach exemplar answers 'queue, relay, or decode?'."""
+        k = exemplar_top_k()
+        if k <= 0:
+            return []
+        records = [r for r in self._record_source()
+                   if (r.get('ts') or 0) >= now - max(windows) and
+                   r.get('e2e_s') is not None and
+                   r.get('request_id') is not None]
+        records.sort(key=lambda r: r['e2e_s'], reverse=True)
+        out: List[Dict[str, Any]] = []
+        for rec in records:
+            if len(out) >= k:
+                break
+            rid = rec['request_id']
+            if rid in self._exemplar_seen:
+                continue
+            lb_queue = rec.get('relay_start_s')
+            phases: Dict[str, float] = {}
+            if lb_queue is not None:
+                phases['lb_queue'] = max(0.0, lb_queue)
+            detail: Dict[str, Any] = {
+                'retries': rec.get('retries'),
+                'status': rec.get('status'),
+            }
+            anatomy = anatomies.get(rid)
+            if anatomy is not None:
+                replica_phases = {
+                    str(p): max(0.0, float(v or 0.0))
+                    for p, v in (anatomy.get('phases') or {}).items()}
+                phases['relay_connect'] = max(
+                    0.0, rec['e2e_s'] - (lb_queue or 0.0) -
+                    sum(replica_phases.values()))
+                phases.update(replica_phases)
+                detail['replica_id'] = anatomy.get('replica_id')
+                detail['kv_headroom_at_admit'] = anatomy.get(
+                    'kv_headroom_at_admit')
+                detail['output_tokens'] = anatomy.get('output_tokens')
+                detail['replica_outcome'] = anatomy.get('outcome')
+            else:
+                # Replica restarted / ring rolled over / anatomy
+                # disabled: the LB half still names queue vs relay.
+                detail['anatomy'] = 'missing'
+            self._exemplar_seen.append(rid)
+            out.append({
+                'ts': rec.get('ts'),
+                'request_id': rid,
+                'trace_id': rec.get('trace_id'),
+                'replica': (None if rec.get('replica') is None
+                            else str(rec['replica'])),
+                'path': rec.get('path'),
+                'outcome': rec.get('outcome'),
+                'e2e_s': rec.get('e2e_s'),
+                'ttft_s': rec.get('ttft_s'),
+                'phases': phases,
+                'detail': detail,
+            })
+        return out
+
     def _journal_transition(self, service_row: Dict[str, Any],
                             global_state) -> None:
         verdict = service_row.get('verdict')
@@ -686,6 +815,18 @@ class SLOMonitor:
             detail = dict(service_row.get('detail') or {})
             detail['burns'] = json_safe_burns(
                 service_row.get('burns') or {})
+            # Breach → exemplar flow: the newest persisted slow-request
+            # waterfalls ARE the incident's worked examples. Attach
+            # their trace ids so `xsky serve trace <svc> --request ID`
+            # resolves straight from the journal row.
+            try:
+                detail['exemplar_trace_ids'] = [
+                    e['trace_id'] for e in
+                    global_state.get_serve_slo_exemplars(
+                        service=self.service_name, limit=5)
+                    if e.get('trace_id')]
+            except Exception:  # pylint: disable=broad-except
+                pass
             global_state.record_recovery_event(
                 'serve.slo_breach',
                 scope=f'service/{self.service_name}',

@@ -296,6 +296,24 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             ON serve_slo (service);
         CREATE INDEX IF NOT EXISTS idx_serve_slo_latest
             ON serve_slo (service, kind, replica_id, row_id);
+        CREATE TABLE IF NOT EXISTS serve_slo_exemplars (
+            row_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            ts REAL,
+            service TEXT,
+            request_id TEXT,
+            trace_id TEXT,
+            replica TEXT,
+            path TEXT,
+            outcome TEXT,
+            e2e_s REAL,
+            ttft_s REAL,
+            phases TEXT,
+            detail TEXT
+        );
+        CREATE INDEX IF NOT EXISTS idx_serve_slo_exemplars_service
+            ON serve_slo_exemplars (service, row_id);
+        CREATE INDEX IF NOT EXISTS idx_serve_slo_exemplars_trace
+            ON serve_slo_exemplars (trace_id);
         CREATE TABLE IF NOT EXISTS remediations (
             row_id INTEGER PRIMARY KEY AUTOINCREMENT,
             ts REAL,
@@ -1604,6 +1622,119 @@ def get_serve_slo(service: Optional[str] = None,
             'inflight': inflight,
             'burns': burns,
             'verdict': verdict,
+            'detail': detail,
+        })
+    return out
+
+
+# ---- serve SLO exemplars ----------------------------------------------------
+
+# Top-K slow-request waterfalls persisted by the SLO monitor each
+# evaluation (serve/slo.py): one row per exemplar request, its LB
+# lifecycle record joined with the replica-side anatomy by request id.
+# `xsky serve trace` reads these; `serve.slo_breach` journal rows
+# carry their trace ids so every breach links to the concrete
+# requests that burned the budget.
+
+# Newest rows kept (pruned lazily, serve_slo pattern). At K=8
+# exemplars per 15 s evaluation 4k rows keep ~2 hours of incidents.
+_MAX_SERVE_SLO_EXEMPLARS = 4000
+_serve_slo_exemplar_inserts = 0
+
+_SERVE_SLO_EXEMPLAR_COLS = ('ts, service, request_id, trace_id, '
+                            'replica, path, outcome, e2e_s, ttft_s, '
+                            'phases, detail')
+
+
+def record_serve_slo_exemplars(service: str,
+                               rows: List[Dict[str, Any]],
+                               ts: Optional[float] = None) -> None:
+    """Persist one evaluation's slow-request exemplars in ONE
+    transaction. NEVER raises — same controller-tick contract and
+    batched-write pattern as record_serve_slo."""
+    global _serve_slo_exemplar_inserts
+    if not rows:
+        return
+    ts = ts if ts is not None else time.time()
+    try:
+        conn = _get_conn()
+        values = [(r.get('ts', ts), service, r.get('request_id'),
+                   r.get('trace_id'), r.get('replica'), r.get('path'),
+                   r.get('outcome'), r.get('e2e_s'), r.get('ttft_s'),
+                   (json.dumps(r['phases'], default=str)
+                    if r.get('phases') else None),
+                   (json.dumps(r['detail'], default=str)
+                    if r.get('detail') else None))
+                  for r in rows]
+    except Exception:  # pylint: disable=broad-except
+        return
+    try:
+        with _lock:
+            conn.executemany(
+                f'INSERT INTO serve_slo_exemplars '
+                f'({_SERVE_SLO_EXEMPLAR_COLS}) VALUES '
+                '(?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)', values)
+            # Prune on the FIRST batch too (serve_slo rationale).
+            _serve_slo_exemplar_inserts += len(rows)
+            if _serve_slo_exemplar_inserts == len(rows) or \
+                    _serve_slo_exemplar_inserts % 256 < len(rows):
+                conn.execute(
+                    'DELETE FROM serve_slo_exemplars WHERE row_id <= '
+                    '(SELECT MAX(row_id) FROM serve_slo_exemplars) '
+                    '- ?', (_MAX_SERVE_SLO_EXEMPLARS,))
+            conn.commit()
+    except Exception:  # pylint: disable=broad-except
+        try:
+            conn.rollback()
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+def get_serve_slo_exemplars(service: Optional[str] = None,
+                            trace_id: Optional[str] = None,
+                            request_id: Optional[str] = None,
+                            limit: int = 100,
+                            offset: int = 0) -> List[Dict[str, Any]]:
+    """Exemplar waterfalls, newest-first (the `xsky serve trace`
+    read path: by service for --slowest, by trace/request id to
+    resolve a breach's exemplar link)."""
+    conds, args = [], []
+    if service is not None:
+        conds.append('service = ?')
+        args.append(service)
+    if trace_id is not None:
+        conds.append('trace_id = ?')
+        args.append(trace_id)
+    if request_id is not None:
+        conds.append('request_id = ?')
+        args.append(request_id)
+    query = (f'SELECT {_SERVE_SLO_EXEMPLAR_COLS} FROM '
+             'serve_slo_exemplars')
+    if conds:
+        query += ' WHERE ' + ' AND '.join(conds)
+    query += ' ORDER BY row_id DESC' + _page_sql(int(limit), offset)
+    out = []
+    for (row_ts, svc, request_id_, trace_id_, replica, path, outcome,
+         e2e_s, ttft_s, phases, detail) in _read(query, args):
+        try:
+            phases = json.loads(phases) if phases else None
+        except ValueError:
+            phases = None
+        try:
+            detail = json.loads(detail) if detail else None
+        except ValueError:
+            detail = None
+        out.append({
+            'ts': row_ts,
+            'service': svc,
+            'request_id': request_id_,
+            'trace_id': trace_id_,
+            'replica': replica,
+            'path': path,
+            'outcome': outcome,
+            'e2e_s': e2e_s,
+            'ttft_s': ttft_s,
+            'phases': phases,
             'detail': detail,
         })
     return out

@@ -326,6 +326,16 @@ _VARS = [
     EnvVar('XSKY_SLO_BURN_THRESHOLD', '1.0',
            'Burn rate at/above which an objective breaches (1.0 = '
            'budget spent exactly as fast as it accrues)'),
+    EnvVar('XSKY_SLO_EXEMPLAR_TOP_K', '8',
+           'Slow-request waterfall exemplars persisted per SLO '
+           'evaluation (0 disables the exemplar table writes)'),
+    EnvVar('XSKY_ANATOMY', '1',
+           'Per-request anatomy recorder on replicas (phase '
+           'accumulators + sealed ring records); 0 disables — the '
+           'bench_decode overhead rung\'s baseline arm'),
+    EnvVar('XSKY_ANATOMY_RING_SIZE', '2048',
+           'Replica anatomy-record ring capacity; size to expected '
+           'per-replica QPS x scrape interval'),
     # ---- closed-loop serving control ---------------------------------------
     EnvVar('XSKY_REMEDIATION_ENABLED', '1',
            'Set to 0 to disable the anomaly→remediation engine '

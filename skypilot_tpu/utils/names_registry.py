@@ -197,6 +197,19 @@ _NAMES = [
             'Draft tokens proposed by speculative decoding'),
     ObsName('metric', 'xsky_serve_spec_accepted_total',
             'Draft tokens accepted by speculative decoding'),
+    ObsName('metric', 'xsky_serve_phase_seconds',
+            'Per-request latency anatomy histogram, labeled by phase '
+            '(replica_queue/admit_deferred/prefill/decode/'
+            'sampling_commit/finish)'),
+    ObsName('metric', 'xsky_serve_kv_headroom_at_admit',
+            'Free/total KV-page fraction seen by the most recent '
+            'successful admission'),
+    ObsName('metric', 'xsky_serve_deferred_wait_seconds',
+            'Age of the oldest request parked in the deferred '
+            'admission queue waiting for KV headroom'),
+    ObsName('metric', 'xsky_serve_deadline_rejects_total',
+            'Requests shed at admit because the relayed SLO deadline '
+            'could not cover the estimated prefill+decode budget'),
     # ---- spans -------------------------------------------------------------
     ObsName('span', 'launch',
             'Root of a cluster launch (execution.launch)'),
@@ -305,6 +318,9 @@ _NAMES = [
             'Per-host gang process start'),
     ObsName('chaos', 'gang.mid_run_exit',
             'Kill a gang rank mid-run'),
+    ObsName('chaos', 'infer.decode_stall',
+            'Stall one orchestrator decode tick (drives a decode-'
+            'attributed SLO breach in the anatomy drill)'),
     ObsName('chaos', 'jobs.controller_kill',
             'Kill a jobs controller, keyed on respawn generation'),
     ObsName('chaos', 'jobs.status_probe',
@@ -390,8 +406,14 @@ _NAMES = [
     ObsName('journal', 'metrics.anomaly_cleared',
             'A tripped detector returned to normal (latency = the '
             'anomaly\'s duration)'),
+    ObsName('journal', 'serve.deadline_reject',
+            'A request was shed at replica admission: its relayed '
+            'deadline could not cover the estimated prefill+decode '
+            'budget (trace-linked to the request)'),
     ObsName('journal', 'serve.slo_breach',
-            'Multi-window burn crossed threshold, burns attached'),
+            'Multi-window burn crossed threshold, burns attached '
+            '(exemplar_trace_ids name slow-request waterfalls '
+            'readable via `xsky serve trace`)'),
     ObsName('journal', 'serve.slo_recovered',
             'A breached SLO objective returned under threshold'),
 ]

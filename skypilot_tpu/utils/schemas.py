@@ -181,6 +181,7 @@ _SERVICE_SCHEMA: Dict[str, Any] = {
                 'ttft_p99_ms': _NUM,
                 'availability': _NUM,
                 'tpot_p50_ms': _NUM,
+                'deadline_ms': _NUM,
             },
         },
     },

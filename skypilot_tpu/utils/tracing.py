@@ -43,6 +43,16 @@ from typing import Any, Dict, List, Optional, Tuple
 ENV_TRACE_CONTEXT = 'XSKY_TRACE_CONTEXT'   # "<trace_id>:<span_id>"
 ENV_TRACING = 'XSKY_TRACING'               # "0" disables
 
+# Cross-hop HTTP propagation (the serve LB→replica relay leg): the LB
+# injects these on every upstream attempt (so retried legs stay under
+# the SAME ids) and the replica handler extracts them onto the
+# orchestrator Request — the join key of the request-anatomy waterfall.
+HEADER_TRACE_ID = 'X-Xsky-Trace-Id'
+HEADER_REQUEST_ID = 'X-Xsky-Request-Id'
+# Remaining end-to-end budget in SECONDS at injection time (not an
+# absolute wall deadline: the hop's clocks need not agree).
+HEADER_DEADLINE_S = 'X-Xsky-Deadline-S'
+
 # Holds the active Span object (this thread opened it) or a
 # (trace_id, span_id) tuple (context re-attached from another thread /
 # process, where the parent Span object is not ours to annotate).
@@ -105,6 +115,49 @@ def env_for_child(env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
         # here.
         if out is None:
             out = {}
+        return out
+
+
+def inject_headers(headers: Dict[str, str],
+                   trace_id: Optional[str] = None,
+                   request_id: Optional[Any] = None,
+                   deadline_s: Optional[float] = None
+                   ) -> Dict[str, str]:
+    """Fold the trace context into an outbound header dict (the serve
+    LB's upstream relay leg). `trace_id` defaults to the ambient
+    context; `deadline_s` is the REMAINING budget, re-measured by the
+    caller per attempt so retries shrink it. Mutates and returns
+    `headers`. Never raises — it sits on the relay hot path, and a
+    malformed id must not turn into a 502."""
+    try:
+        if trace_id is None:
+            trace_id = current_trace_id()
+        if trace_id:
+            headers[HEADER_TRACE_ID] = str(trace_id)
+        if request_id is not None:
+            headers[HEADER_REQUEST_ID] = str(request_id)
+        if deadline_s is not None:
+            headers[HEADER_DEADLINE_S] = f'{float(deadline_s):.3f}'
+        return headers
+    except Exception:  # pylint: disable=broad-except
+        return headers
+
+
+def extract_headers(headers: Any
+                    ) -> Tuple[Optional[str], Optional[str],
+                               Optional[float]]:
+    """(trace_id, request_id, deadline_s) from an inbound request's
+    headers (an ``http.server`` message object or a plain dict).
+    Missing or malformed values degrade to None — the replica must
+    serve untraced requests exactly as before. Never raises."""
+    out = (None, None, None)
+    try:
+        trace_id = headers.get(HEADER_TRACE_ID) or None
+        request_id = headers.get(HEADER_REQUEST_ID) or None
+        raw = headers.get(HEADER_DEADLINE_S)
+        deadline_s = float(raw) if raw else None
+        return (trace_id, request_id, deadline_s)
+    except Exception:  # pylint: disable=broad-except
         return out
 
 

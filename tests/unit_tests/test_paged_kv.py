@@ -109,7 +109,9 @@ class TestPageAllocator:
 def test_bench_decode_smoke_gate():
     """tools/bench_decode.py --smoke must pass its own acceptance
     gate: fused masked tick >= 1.5x cheaper per token than the legacy
-    tick, identical outputs, zero wasted fused decode rows."""
+    tick, identical outputs, zero wasted fused decode rows — and the
+    anatomy recorder rung must stay under its per-token overhead
+    gate with byte-identical outputs."""
     bench = os.path.join(_REPO_ROOT, 'tools', 'bench_decode.py')
     proc = subprocess.run(
         [sys.executable, bench, '--smoke'],
@@ -122,3 +124,5 @@ def test_bench_decode_smoke_gate():
     assert result['fast_wasted_steps'] == 0
     assert result['legacy_wasted_steps'] > 0
     assert result['speedup'] >= result['threshold']
+    assert result['anatomy_pass'] is True
+    assert result['anatomy_identical_outputs'] is True

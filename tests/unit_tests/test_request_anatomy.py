@@ -1,0 +1,1079 @@
+"""Request-scoped data-plane tracing tests: cross-hop trace headers
+(inject/extract round-trip, survival across LB retries and update-mode
+policy swaps), the replica-side anatomy recorder (seal math, ring
+bounds, env gating), per-phase metrics rendering, the deadline
+admission gate, the slow-request exemplar table + the SLO monitor's
+cross-hop waterfall join, `/lb/requests` paging, the `xsky serve
+trace` surface, and the tier-1 fake-cloud drill where a chaos-stalled
+decode becomes a breach whose exemplar waterfall blames decode."""
+import json
+import os
+import queue
+import socket
+import struct
+import threading
+import time
+import types
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from skypilot_tpu.infer import anatomy as anatomy_lib
+from skypilot_tpu.infer import metrics as infer_metrics
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.serve import slo as slo_lib
+from skypilot_tpu.serve.service_spec import SkyServiceSpec, SLOSpec
+from skypilot_tpu.utils import chaos
+from skypilot_tpu.utils import tracing
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), '..', '..'))
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos_and_anatomy():
+    chaos.clear()
+    anatomy_lib.reset_for_test()
+    yield
+    chaos.clear()
+    anatomy_lib.reset_for_test()
+
+
+@pytest.fixture
+def tmp_state(monkeypatch, tmp_path):
+    from skypilot_tpu import state
+    monkeypatch.setenv('XSKY_STATE_DB', str(tmp_path / 'state.db'))
+    state.reset_for_test()
+    yield state
+    state.reset_for_test()
+
+
+@pytest.fixture
+def tmp_serve_db(monkeypatch, tmp_path):
+    monkeypatch.setenv('XSKY_SERVE_DB', str(tmp_path / 'serve.db'))
+    yield
+
+
+def _upstream(handler_cls) -> ThreadingHTTPServer:
+    server = ThreadingHTTPServer(('127.0.0.1', 0), handler_cls)
+    threading.Thread(target=server.serve_forever,
+                     name='xsky-test-upstream', daemon=True).start()
+    return server
+
+
+class _EchoUpstream(BaseHTTPRequestHandler):
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):  # noqa: N802
+        body = b'hello'
+        self.send_response(200)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+# ---- trace headers ----------------------------------------------------------
+
+
+class TestTraceHeaders:
+
+    def test_inject_extract_round_trip(self):
+        headers = {}
+        tracing.inject_headers(headers, trace_id='t1',
+                               request_id='r1', deadline_s=2.5)
+        assert headers == {'X-Xsky-Trace-Id': 't1',
+                           'X-Xsky-Request-Id': 'r1',
+                           'X-Xsky-Deadline-S': '2.500'}
+        assert tracing.extract_headers(headers) == ('t1', 'r1', 2.5)
+
+    def test_absent_context_degrades_to_none(self):
+        # A direct (relay-less) caller carries no headers: the replica
+        # must serve it untraced, not crash.
+        assert tracing.extract_headers({}) == (None, None, None)
+        headers = {}
+        tracing.inject_headers(headers)  # no ambient trace either
+        assert 'X-Xsky-Request-Id' not in headers
+
+    def test_malformed_deadline_never_raises(self):
+        trace_id, req_id, deadline = tracing.extract_headers(
+            {'X-Xsky-Trace-Id': 'abc',
+             'X-Xsky-Deadline-S': 'not-a-float'})
+        assert (trace_id, req_id, deadline) == (None, None, None)
+        # Non-dict garbage degrades the same way.
+        assert tracing.extract_headers(None) == (None, None, None)
+        # inject on an unwritable target is swallowed too.
+        tracing.inject_headers(None, trace_id='t')  # no raise
+
+    def test_negative_remaining_budget_still_relays(self):
+        # The LB re-measures the budget per leg; a retried leg may see
+        # a negative remainder. It must still reach the replica (whose
+        # admission gate then rejects) — inject must not drop it.
+        headers = {}
+        tracing.inject_headers(headers, trace_id='t',
+                               request_id='r', deadline_s=-0.25)
+        assert tracing.extract_headers(headers)[2] == -0.25
+
+
+# ---- anatomy recorder -------------------------------------------------------
+
+
+def _finished_request(**overrides):
+    base = time.perf_counter() - 1.0
+    request = types.SimpleNamespace(
+        submitted_at=base,
+        taken_at=base + 0.1,          # replica_queue = 0.1
+        deferred_wait=0.05,           # admit_deferred = 0.05
+        first_token_at=base + 0.35,   # prefill = 0.35-0.1-0.05 = 0.2
+        decode_s=0.4,
+        commit_s=0.05,
+        finished_at=base + 1.0,       # finish = 1.0 - 0.9 = 0.1
+        kv_headroom_at_admit=0.75,
+        prompt_tokens=[1, 2, 3],
+        output_tokens=[4] * 16,
+        request_id=7,
+        client_request_id='lb-abc',
+        trace_id='trace-abc')
+    for key, value in overrides.items():
+        setattr(request, key, value)
+    return request
+
+
+class TestAnatomyLog:
+
+    def test_seal_phases_sum_to_total(self):
+        log = anatomy_lib.AnatomyLog()
+        rec = log.seal(_finished_request())
+        phases = rec['phases']
+        assert set(phases) == set(anatomy_lib.PHASES)
+        assert phases['replica_queue'] == pytest.approx(0.1)
+        assert phases['admit_deferred'] == pytest.approx(0.05)
+        assert phases['prefill'] == pytest.approx(0.2)
+        assert phases['decode'] == pytest.approx(0.4)
+        assert phases['sampling_commit'] == pytest.approx(0.05)
+        # The unattributed remainder closes the books exactly.
+        assert sum(phases.values()) == pytest.approx(rec['total_s'])
+        assert rec['request_id'] == 'lb-abc'
+        assert rec['trace_id'] == 'trace-abc'
+        assert rec['kv_headroom_at_admit'] == 0.75
+        assert rec['output_tokens'] == 16
+
+    def test_seal_without_timestamps_returns_none(self):
+        log = anatomy_lib.AnatomyLog()
+        assert log.seal(_finished_request(submitted_at=0.0)) is None
+        assert log.seal(_finished_request(finished_at=None)) is None
+        assert log.records() == []
+
+    def test_untaken_request_is_all_queue(self):
+        # Rejected before any admission attempt (e.g. deadline gate on
+        # a queued request): the whole lifetime is replica_queue.
+        rec = anatomy_lib.AnatomyLog().seal(_finished_request(
+            taken_at=None, first_token_at=None, deferred_wait=0.0,
+            decode_s=0.0, commit_s=0.0))
+        assert rec['phases']['replica_queue'] == pytest.approx(
+            rec['total_s'])
+        assert rec['phases']['prefill'] == 0.0
+
+    def test_ring_bounded_by_env(self, monkeypatch):
+        monkeypatch.setenv(anatomy_lib.ENV_RING, '3')
+        log = anatomy_lib.AnatomyLog()
+        for i in range(10):
+            log.seal(_finished_request(client_request_id=f'r{i}'))
+        records = log.records()
+        assert len(records) == 3
+        # Newest-first.
+        assert [r['request_id'] for r in records] == ['r9', 'r8', 'r7']
+
+    def test_garbage_ring_env_defaults(self, monkeypatch):
+        monkeypatch.setenv(anatomy_lib.ENV_RING, '2k')
+        assert anatomy_lib.AnatomyLog()._ring.maxlen == 2048
+
+    def test_records_filter_and_limit(self):
+        log = anatomy_lib.AnatomyLog()
+        for i in range(5):
+            log.seal(_finished_request(client_request_id=f'r{i}'))
+        assert len(log.records(limit=2)) == 2
+        (rec,) = log.records(request_id='r3')
+        assert rec['request_id'] == 'r3'
+        assert log.records(request_id='nope') == []
+
+    def test_numeric_id_fallback_for_direct_callers(self):
+        rec = anatomy_lib.AnatomyLog().seal(
+            _finished_request(client_request_id=None, request_id=42))
+        assert rec['request_id'] == '42'
+
+    def test_enabled_env_gate(self, monkeypatch):
+        assert anatomy_lib.enabled()
+        monkeypatch.setenv(anatomy_lib.ENV_ANATOMY, '0')
+        assert not anatomy_lib.enabled()
+
+    def test_get_log_reads_env_at_first_use(self, monkeypatch):
+        monkeypatch.setenv(anatomy_lib.ENV_RING, '5')
+        anatomy_lib.reset_for_test()
+        log = anatomy_lib.get_log()
+        assert log._ring.maxlen == 5
+        assert anatomy_lib.get_log() is log
+
+
+# ---- per-phase metrics ------------------------------------------------------
+
+
+class TestPhaseMetrics:
+
+    def test_labeled_phase_histograms_round_trip(self):
+        metrics = infer_metrics.ServeMetrics()
+        for _ in range(3):
+            metrics.observe_phases({'decode': 0.4, 'prefill': 0.02})
+        text = metrics.render()
+        assert ('xsky_serve_phase_seconds_bucket{phase="decode",'
+                'le="0.5"} 3') in text
+        assert 'xsky_serve_phase_seconds_count{phase="decode"} 3' \
+            in text
+        assert 'xsky_serve_phase_seconds_sum{phase="prefill"} ' \
+            '0.060000' in text
+        # The scrape parser the SLO monitor uses reads it back.
+        samples = slo_lib.parse_prometheus_text(text)
+        buckets = [
+            (labels, v) for labels, v in
+            samples['xsky_serve_phase_seconds_bucket']
+            if labels.get('phase') == 'decode']
+        assert buckets and all(
+            v == 3.0 for labels, v in buckets
+            if labels['le'] in ('1.0', '+Inf'))
+
+    def test_no_phases_no_series(self):
+        assert 'xsky_serve_phase_seconds' not in \
+            infer_metrics.ServeMetrics().render()
+
+    def test_admission_gauges_from_orchestrator(self):
+        orch = types.SimpleNamespace(
+            _slot_req={}, _free_slots=[], _pending=queue.Queue(),
+            engine=types.SimpleNamespace(prefix_cache_stats=None),
+            last_admit_kv_headroom=0.25,
+            _deferred=[types.SimpleNamespace(
+                deferred_at=time.perf_counter() - 0.5)],
+            deadline_rejects=3,
+            wasted_decode_steps=0)
+        text = infer_metrics.ServeMetrics().render(orch=orch)
+        assert 'xsky_serve_kv_headroom_at_admit 0.2500' in text
+        assert 'xsky_serve_deadline_rejects_total 3' in text
+        wait = [ln for ln in text.splitlines()
+                if ln.startswith('xsky_serve_deferred_wait_seconds ')]
+        assert wait and float(wait[0].split()[1]) >= 0.5
+
+    def test_gauges_absent_without_signal(self):
+        orch = types.SimpleNamespace(
+            _slot_req={}, _free_slots=[], _pending=queue.Queue(),
+            engine=types.SimpleNamespace(prefix_cache_stats=None),
+            last_admit_kv_headroom=None, _deferred=[],
+            deadline_rejects=0, wasted_decode_steps=0)
+        text = infer_metrics.ServeMetrics().render(orch=orch)
+        assert 'xsky_serve_kv_headroom_at_admit' not in text
+        assert 'xsky_serve_deferred_wait_seconds' not in text
+        # The rejects counter always exports (a zero IS the signal).
+        assert 'xsky_serve_deadline_rejects_total 0' in text
+
+
+# ---- deadline admission -----------------------------------------------------
+
+
+class _StubEngine:
+    """Attribute-surface stub: enough for admission-path unit tests
+    (no device, no jit)."""
+    max_admit_len = 64
+
+    def __init__(self):
+        self.config = types.SimpleNamespace(max_slots=2,
+                                            max_target_len=128)
+
+    def init_decode_state(self):
+        return None
+
+    def kv_admissible(self, prompt_len, max_new):
+        return True
+
+    def reserve_kv(self, slot, prompt_len, max_new):
+        return True
+
+
+class TestDeadlineAdmission:
+
+    def _orch(self):
+        from skypilot_tpu.infer import orchestrator as orch_lib
+        return orch_lib.Orchestrator(_StubEngine()), orch_lib
+
+    def test_expired_deadline_rejected_at_take(self):
+        orch, orch_lib = self._orch()
+        request = orch_lib.Request(prompt_tokens=[1, 2],
+                                   max_new_tokens=4)
+        request.deadline_at = time.perf_counter() - 0.5
+        orch.submit(request)
+        assert orch._take_request() is None
+        assert orch.deadline_rejects == 1
+        assert request.done
+        assert request.error.startswith('deadline exceeded')
+
+    def test_no_deadline_never_rejected(self):
+        orch, orch_lib = self._orch()
+        orch._ewma_prefill_s = 10.0   # absurd budget, no deadline
+        request = orch.submit(orch_lib.Request(prompt_tokens=[1],
+                                               max_new_tokens=4))
+        assert orch._take_request() is request
+        assert orch.deadline_rejects == 0
+
+    def test_budget_estimate_gates_admission(self):
+        orch, orch_lib = self._orch()
+        orch._ewma_prefill_s = 0.05
+        orch._ewma_decode_per_token_s = 0.01
+        # 100 tokens → ~1.05s reserved budget.
+        tight = orch_lib.Request(prompt_tokens=[1],
+                                 max_new_tokens=100)
+        tight.deadline_at = time.perf_counter() + 0.5
+        orch.submit(tight)
+        assert orch._take_request() is None
+        assert tight.error and 'estimated' in tight.error
+        roomy = orch_lib.Request(prompt_tokens=[1],
+                                 max_new_tokens=100)
+        roomy.deadline_at = time.perf_counter() + 5.0
+        orch.submit(roomy)
+        assert orch._take_request() is roomy
+
+    def test_deferred_request_rechecked_on_retry(self):
+        # A KV-deferred request re-enters admission ahead of the
+        # queue; its deadline is re-checked there, and the wait it
+        # accrued lands in the admit_deferred accumulator.
+        orch, orch_lib = self._orch()
+        request = orch_lib.Request(prompt_tokens=[1],
+                                   max_new_tokens=4)
+        request.deadline_at = time.perf_counter() - 0.1
+        request.deferred_at = time.perf_counter() - 0.2
+        orch._deferred.append(request)
+        assert orch._take_request() is None
+        assert orch.deadline_rejects == 1
+        assert request.deferred_wait >= 0.2
+
+    def test_slospec_deadline_ms_validation_and_round_trip(self):
+        with pytest.raises(ValueError, match='deadline_ms'):
+            SLOSpec(deadline_ms=0)
+        # A deadline alone is a valid SLO section.
+        assert SLOSpec(deadline_ms=30000).deadline_ms == 30000.0
+        spec = SkyServiceSpec.from_yaml_config({
+            'readiness_probe': '/',
+            'slo': {'ttft_p99_ms': 500, 'deadline_ms': 30000}})
+        config = spec.to_yaml_config()
+        assert config['slo']['deadline_ms'] == 30000.0
+        again = SkyServiceSpec.from_yaml_config(config)
+        assert again.slo.deadline_ms == 30000.0
+        # The task-YAML schema must accept it too — the spec layer
+        # round-tripping is not enough for a user-authored task file.
+        from skypilot_tpu.utils import schemas
+        schemas.validate_task_config({
+            'name': 'svc', 'run': 'python serve.py',
+            'service': config})
+
+
+# ---- serving-handler trace adoption -----------------------------------------
+
+
+class _SyncLoop:
+    """ServingLoop stand-in: completes every request synchronously so
+    the handler's trace-adoption + seal path runs without a device."""
+
+    class orch:  # noqa: N801 — minimal attribute surface
+        _pending = queue.Queue()
+        _slot_req: dict = {}
+        _free_slots: list = []
+
+        class engine:  # noqa: N801
+            prefix_cache_stats = None
+
+        @staticmethod
+        def _admit_limit():
+            return 63
+
+    def submit_and_wait(self, request):
+        now = time.perf_counter()
+        request.submitted_at = now - 0.2
+        request.taken_at = now - 0.19
+        request.first_token_at = now - 0.15
+        request.decode_s = 0.12
+        request.commit_s = 0.01
+        if request.deadline_at is not None and \
+                request.deadline_at < now:
+            request.error = ('deadline exceeded at admit: -100 ms '
+                             'remaining < 50 ms estimated '
+                             'prefill+decode budget')
+        else:
+            request.output_tokens.extend([1, 2, 3])
+        request.done = True
+        request.finished_at = now
+
+
+@pytest.fixture
+def handler_server(tmp_state):
+    from skypilot_tpu.infer import engine as engine_lib
+    from skypilot_tpu.infer import server as server_lib
+    from skypilot_tpu.models import llama
+    anatomy_lib.reset_for_test()
+    handler_cls = server_lib.build_handler(
+        _SyncLoop(), engine_lib.EngineConfig(model=llama.LLAMA_TINY),
+        model_id='anatomy-test')
+    httpd = ThreadingHTTPServer(('127.0.0.1', 0), handler_cls)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f'http://127.0.0.1:{httpd.server_address[1]}'
+    httpd.shutdown()
+
+
+def _post_json(url, path, body, headers=None):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={'Content-Type': 'application/json',
+                 **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestHandlerTraceAdoption:
+
+    def test_relay_headers_adopted_and_sealed(self, handler_server):
+        relay_headers = {}
+        tracing.inject_headers(relay_headers, trace_id='tr-1',
+                               request_id='rq-1', deadline_s=60.0)
+        status, _ = _post_json(handler_server, '/generate',
+                               {'prompt_tokens': [1, 2, 3],
+                                'max_new_tokens': 4},
+                               headers=relay_headers)
+        assert status == 200
+        rows = json.loads(urllib.request.urlopen(
+            f'{handler_server}/anatomy?request_id=rq-1').read())
+        assert len(rows) == 1
+        assert rows[0]['trace_id'] == 'tr-1'
+        assert rows[0]['outcome'] == 'ok'
+        assert rows[0]['phases']['decode'] == pytest.approx(0.12)
+        assert sum(rows[0]['phases'].values()) == pytest.approx(
+            rows[0]['total_s'])
+
+    def test_anatomy_endpoint_pages(self, handler_server):
+        for i in range(4):
+            _post_json(handler_server, '/generate',
+                       {'prompt_tokens': [1], 'max_new_tokens': 1},
+                       headers={'X-Xsky-Request-Id': f'pg-{i}'})
+        rows = json.loads(urllib.request.urlopen(
+            f'{handler_server}/anatomy?limit=2').read())
+        assert [r['request_id'] for r in rows] == ['pg-3', 'pg-2']
+
+    def test_deadline_reject_journalled_with_trace(
+            self, handler_server, tmp_state):
+        relay_headers = {}
+        tracing.inject_headers(relay_headers, trace_id='tr-dead',
+                               request_id='rq-dead',
+                               deadline_s=-1.0)
+        status, payload = _post_json(
+            handler_server, '/generate',
+            {'prompt_tokens': [1, 2], 'max_new_tokens': 4},
+            headers=relay_headers)
+        assert status == 400
+        assert 'deadline exceeded' in payload['error']
+        events = tmp_state.get_recovery_events(
+            event_type='serve.deadline_reject')
+        assert len(events) == 1
+        assert events[0]['trace_id'] == 'tr-dead'
+        assert events[0]['detail']['request_id'] == 'rq-dead'
+
+    def test_anatomy_disabled_skips_seal(self, handler_server,
+                                         monkeypatch):
+        monkeypatch.setenv(anatomy_lib.ENV_ANATOMY, '0')
+        status, _ = _post_json(handler_server, '/generate',
+                               {'prompt_tokens': [1],
+                                'max_new_tokens': 1},
+                               headers={'X-Xsky-Request-Id': 'off-1'})
+        assert status == 200
+        rows = json.loads(urllib.request.urlopen(
+            f'{handler_server}/anatomy?request_id=off-1').read())
+        assert rows == []
+
+
+# ---- LB: paging, retry survival ---------------------------------------------
+
+
+class TestLbPagingAndRetries:
+
+    def test_lb_requests_paging(self):
+        server = _upstream(_EchoUpstream)
+        lb = lb_lib.SkyServeLoadBalancer()
+        lb.set_ready_replicas(
+            [f'127.0.0.1:{server.server_address[1]}'])
+        port = lb.run_in_thread()
+        for _ in range(6):
+            urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/gen').read()
+        page = json.loads(urllib.request.urlopen(
+            f'http://127.0.0.1:{port}/lb/requests?limit=2&offset=1'
+        ).read())
+        # Garbage paging params degrade to defaults, not a 500.
+        garbage = json.loads(urllib.request.urlopen(
+            f'http://127.0.0.1:{port}/lb/requests?limit=zzz&offset=-'
+        ).read())
+        lb.shutdown()
+        server.shutdown()
+        assert len(page) == 2
+        everything = lb.request_log.records()
+        assert [r['request_id'] for r in page] == \
+            [r['request_id'] for r in everything[1:3]]
+        # Records are JSON-safe and carry the cross-hop identity.
+        assert 't0' not in page[0]
+        assert page[0]['trace_id'] and page[0]['request_id']
+        assert page[0]['relay_start_s'] is not None
+        assert len(garbage) >= 6
+
+    def test_retried_legs_same_ids_shrinking_deadline(self):
+
+        class FlakyOnce(BaseHTTPRequestHandler):
+            seen: list = []
+            failed = [False]
+
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):  # noqa: N802
+                type(self).seen.append(dict(self.headers))
+                if not type(self).failed[0]:
+                    type(self).failed[0] = True
+                    # RST before any response bytes: the relay's
+                    # urlopen raises an OSError and retries the leg.
+                    self.connection.setsockopt(
+                        socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack('ii', 1, 0))
+                    self.connection.close()
+                    return
+                body = b'ok'
+                self.send_response(200)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        server = _upstream(FlakyOnce)
+        lb = lb_lib.SkyServeLoadBalancer()
+        lb.deadline_ms = 500.0
+        lb.set_ready_replicas(
+            [f'127.0.0.1:{server.server_address[1]}'])
+        port = lb.run_in_thread()
+        assert urllib.request.urlopen(
+            f'http://127.0.0.1:{port}/gen', timeout=30).read() == \
+            b'ok'
+        lb.shutdown()
+        server.shutdown()
+        (rec,) = lb.request_log.records()
+        assert rec['outcome'] == 'ok'
+        assert rec['retries'] == 1
+        legs = [tracing.extract_headers(h) for h in FlakyOnce.seen]
+        assert len(legs) == 2
+        # Both legs carry the SAME minted identity...
+        assert legs[0][0] == legs[1][0] == rec['trace_id']
+        assert legs[0][1] == legs[1][1] == rec['request_id']
+        # ...while the deadline budget is re-measured per leg, so the
+        # retry's remaining budget can only shrink.
+        assert legs[0][2] is not None and legs[1][2] is not None
+        assert legs[1][2] <= legs[0][2] <= 0.5
+
+
+# ---- update-mode policy swap ------------------------------------------------
+
+
+class _HeaderCapture(BaseHTTPRequestHandler):
+    seen: list = []
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):  # noqa: N802
+        type(self).seen.append(dict(self.headers))
+        body = b'ok'
+        self.send_response(200)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class TestPolicySwapSurvival:
+
+    def test_trace_context_and_stats_survive_policy_swap(
+            self, tmp_state, tmp_serve_db):
+        from skypilot_tpu.serve import controller as controller_lib
+        from skypilot_tpu.serve import state as serve_state
+
+        def config(policy, deadline_ms):
+            return {'service': {
+                'readiness_probe': '/',
+                'load_balancing_policy': policy,
+                'slo': {'ttft_p99_ms': 100,
+                        'deadline_ms': deadline_ms}}}
+
+        _HeaderCapture.seen = []
+        upstream = _upstream(_HeaderCapture)
+        endpoint = f'127.0.0.1:{upstream.server_address[1]}'
+        serve_state.add_service('swpsvc',
+                                config('round_robin', 1000), 0)
+        controller = controller_lib.SkyServeController('swpsvc')
+        lb = controller.load_balancer
+        assert isinstance(lb.policy, lb_policies.RoundRobinPolicy)
+        assert lb.deadline_ms == 1000.0
+        lb.set_ready_replicas([endpoint])
+        port = lb.run_in_thread()
+        try:
+            urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/gen', timeout=30).read()
+            stats_before = lb.replica_stats
+            request_log_before = lb.request_log
+            first_record = lb.request_log.records()[0]
+
+            serve_state.bump_service_version(
+                'swpsvc', config('least_load', 2000))
+            controller._maybe_adopt_new_version()
+
+            # The policy swapped, but the rolling stats tracker and
+            # the lifecycle ring are the SAME objects — history (and
+            # every persisted trace id) survives the update.
+            assert isinstance(lb.policy, lb_policies.LeastLoadPolicy)
+            assert lb.policy.stats is stats_before
+            assert lb.replica_stats is stats_before
+            assert lb.request_log is request_log_before
+            assert lb.request_log.records()[0]['trace_id'] == \
+                first_record['trace_id']
+            # ...and the new deadline is threaded into the relay.
+            assert lb.deadline_ms == 2000.0
+            lb.set_ready_replicas([endpoint])
+            urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/gen', timeout=30).read()
+        finally:
+            lb.shutdown()
+            upstream.shutdown()
+            controller.replica_manager._pool.shutdown(wait=False)
+
+        legs = [tracing.extract_headers(h)
+                for h in _HeaderCapture.seen]
+        assert len(legs) == 2
+        # Every leg (before AND after the swap) carried trace context;
+        # the deadline header tracks the adopted spec.
+        assert all(t and r for t, r, _ in legs)
+        assert 0 < legs[0][2] <= 1.0
+        assert 1.0 < legs[1][2] <= 2.0
+        # Rolling stats accumulated across the swap.
+        snap = lb.replica_stats.snapshot()[endpoint]
+        assert snap['requests_total'] == 2
+        # Distinct client requests mint distinct ids.
+        assert legs[0][1] != legs[1][1]
+
+
+# ---- exemplar table ---------------------------------------------------------
+
+
+def _exemplar_row(i=0, **overrides):
+    row = {
+        'ts': time.time(),
+        'request_id': f'req-{i}',
+        'trace_id': f'tr-{i}',
+        'replica': '3',
+        'path': '/v1/completions',
+        'outcome': 'ok',
+        'e2e_s': 1.5,
+        'ttft_s': 0.4,
+        'phases': {'lb_queue': 0.1, 'relay_connect': 0.2,
+                   'decode': 1.2},
+        'detail': {'retries': 0, 'replica_id': 3},
+    }
+    row.update(overrides)
+    return row
+
+
+class TestExemplarTable:
+
+    def test_round_trip_and_filters(self, tmp_state):
+        tmp_state.record_serve_slo_exemplars(
+            'svc', [_exemplar_row(0), _exemplar_row(1)])
+        rows = tmp_state.get_serve_slo_exemplars(service='svc')
+        assert len(rows) == 2
+        assert rows[0]['request_id'] == 'req-1'   # newest-first
+        assert rows[0]['phases']['decode'] == 1.2
+        assert rows[0]['detail']['replica_id'] == 3
+        (by_trace,) = tmp_state.get_serve_slo_exemplars(
+            trace_id='tr-0')
+        assert by_trace['request_id'] == 'req-0'
+        (by_req,) = tmp_state.get_serve_slo_exemplars(
+            request_id='req-1')
+        assert by_req['trace_id'] == 'tr-1'
+        assert tmp_state.get_serve_slo_exemplars(
+            service='ghost') == []
+
+    def test_retention_bound(self, tmp_state, monkeypatch):
+        monkeypatch.setattr(tmp_state, '_MAX_SERVE_SLO_EXEMPLARS', 10)
+        monkeypatch.setattr(tmp_state, '_serve_slo_exemplar_inserts',
+                            0)
+        tmp_state.record_serve_slo_exemplars(
+            'svc', [_exemplar_row(i) for i in range(30)])
+        rows = tmp_state.get_serve_slo_exemplars(service='svc',
+                                                 limit=1000)
+        assert len(rows) == 10
+        assert {r['request_id'] for r in rows} == \
+            {f'req-{i}' for i in range(20, 30)}
+
+    def test_record_never_raises(self, tmp_state, monkeypatch):
+        monkeypatch.setenv('XSKY_STATE_DB',
+                           '/nonexistent/dir/state.db')
+        tmp_state.reset_for_test()
+        tmp_state.record_serve_slo_exemplars(
+            'svc', [_exemplar_row()])  # no raise
+
+
+# ---- cross-hop waterfall join -----------------------------------------------
+
+
+def _lb_record(rid='r1', now=None, **overrides):
+    now = time.time() if now is None else now
+    rec = {'ts': now - 1, 'request_id': rid, 'trace_id': f'tr-{rid}',
+           'replica': 'a:1', 'path': '/gen', 'outcome': 'ok',
+           'e2e_s': 1.0, 'ttft_s': 0.5, 'relay_start_s': 0.2,
+           'retries': 0, 'status': 200}
+    rec.update(overrides)
+    return rec
+
+
+def _anatomy(rid='r1', **overrides):
+    rec = {'request_id': rid, 'replica_id': 3, 'outcome': 'ok',
+           'output_tokens': 16, 'kv_headroom_at_admit': 0.8,
+           'phases': {'replica_queue': 0.05, 'admit_deferred': 0.0,
+                      'prefill': 0.1, 'decode': 0.5,
+                      'sampling_commit': 0.02, 'finish': 0.03}}
+    rec.update(overrides)
+    return rec
+
+
+class TestExemplarJoin:
+
+    def test_joined_phases_sum_to_client_e2e(self):
+        now = time.time()
+        records = [_lb_record(now=now)]
+        monitor = slo_lib.SLOMonitor('svc', None,
+                                     record_source=lambda: records)
+        (ex,) = monitor._build_exemplars({'r1': _anatomy()}, now,
+                                         [60.0])
+        phases = ex['phases']
+        assert phases['lb_queue'] == pytest.approx(0.2)
+        # relay_connect is the remainder: e2e − lb_queue − replica.
+        assert phases['relay_connect'] == pytest.approx(0.1)
+        assert sum(phases.values()) == pytest.approx(ex['e2e_s'])
+        assert ex['detail']['replica_id'] == 3
+        assert ex['detail']['kv_headroom_at_admit'] == 0.8
+        assert ex['trace_id'] == 'tr-r1'
+
+    def test_relay_remainder_clamped_nonnegative(self):
+        # Clock skew / replica phases exceeding the LB-observed e2e
+        # must clamp, not go negative in a persisted waterfall.
+        now = time.time()
+        records = [_lb_record(now=now, e2e_s=0.3)]
+        monitor = slo_lib.SLOMonitor('svc', None,
+                                     record_source=lambda: records)
+        (ex,) = monitor._build_exemplars({'r1': _anatomy()}, now,
+                                         [60.0])
+        assert ex['phases']['relay_connect'] == 0.0
+
+    def test_missing_anatomy_keeps_lb_half(self):
+        now = time.time()
+        records = [_lb_record(now=now)]
+        monitor = slo_lib.SLOMonitor('svc', None,
+                                     record_source=lambda: records)
+        (ex,) = monitor._build_exemplars({}, now, [60.0])
+        assert ex['detail']['anatomy'] == 'missing'
+        assert ex['phases'] == {'lb_queue': pytest.approx(0.2)}
+
+    def test_dedup_across_ticks_and_top_k(self, monkeypatch):
+        monkeypatch.setenv(slo_lib.ENV_EXEMPLAR_TOP_K, '2')
+        now = time.time()
+        records = [_lb_record(f'r{i}', now=now, e2e_s=1.0 + i)
+                   for i in range(5)]
+        monitor = slo_lib.SLOMonitor('svc', None,
+                                     record_source=lambda: records)
+        first = monitor._build_exemplars({}, now, [60.0])
+        # Top-K slowest win.
+        assert [e['request_id'] for e in first] == ['r4', 'r3']
+        # The same slow requests stay in the burn window for the next
+        # tick — they must not be re-persisted.
+        second = monitor._build_exemplars({}, now, [60.0])
+        assert [e['request_id'] for e in second] == ['r2', 'r1']
+
+    def test_unfinished_and_stale_records_skipped(self):
+        now = time.time()
+        records = [_lb_record('live', now=now),
+                   _lb_record('open', now=now, e2e_s=None),
+                   _lb_record('old', now=now, ts=now - 7200)]
+        monitor = slo_lib.SLOMonitor('svc', None,
+                                     record_source=lambda: records)
+        out = monitor._build_exemplars({}, now, [60.0])
+        assert [e['request_id'] for e in out] == ['live']
+
+    def test_breach_attaches_exemplar_trace_ids(self, tmp_state,
+                                                monkeypatch):
+        monkeypatch.setenv(slo_lib.ENV_SCRAPE_INTERVAL, '0')
+        monkeypatch.setenv(slo_lib.ENV_BURN_WINDOWS, '60')
+        now = time.time()
+        records = [_lb_record(f'r{i}', now=now, ttft_s=0.5)
+                   for i in range(20)]
+        monitor = slo_lib.SLOMonitor(
+            'svc', SLOSpec(ttft_p99_ms=100),
+            record_source=lambda: records)
+        result = monitor.maybe_tick([], now=now)
+        assert result['verdict'] == 'breach'
+        (breach,) = tmp_state.get_recovery_events(
+            event_type='serve.slo_breach')
+        linked = breach['detail']['exemplar_trace_ids']
+        assert linked, 'breach carries no exemplar trace ids'
+        # Every linked id resolves in the persisted exemplar table —
+        # the `xsky serve trace --request` contract.
+        for trace_id in linked:
+            assert tmp_state.get_serve_slo_exemplars(
+                service='svc', trace_id=trace_id)
+
+
+# ---- `xsky serve trace` surface ---------------------------------------------
+
+
+class TestServeTraceCli:
+
+    def _seed(self, tmp_state):
+        tmp_state.record_serve_slo_exemplars('svc', [
+            _exemplar_row(0, e2e_s=0.9),
+            _exemplar_row(1, e2e_s=2.0, phases={
+                'lb_queue': 0.05, 'relay_connect': 0.05,
+                'replica_queue': 0.1, 'decode': 1.8},
+                detail={'retries': 2, 'replica_id': 7,
+                        'kv_headroom_at_admit': 0.42}),
+        ])
+
+    def test_text_waterfall(self, tmp_state):
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        self._seed(tmp_state)
+        result = CliRunner().invoke(
+            cli_mod.cli, ['serve', 'trace', 'svc', '--slowest', '1'])
+        assert result.exit_code == 0, result.output
+        # Slowest-first: the decode-heavy request leads.
+        assert 'request req-1' in result.output
+        assert 'request req-0' not in result.output
+        assert 'e2e=2000ms' in result.output
+        decode_line = [ln for ln in result.output.splitlines()
+                       if ln.strip().startswith('decode')][0]
+        assert '1800.0ms' in decode_line
+        assert '#' * 30 in decode_line   # decode dominates the bar
+        assert 'kv_headroom_at_admit=0.42' in result.output
+        assert 'retries=2' in result.output
+
+    def test_request_lookup_accepts_trace_id(self, tmp_state):
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        self._seed(tmp_state)
+        for ident in ('req-0', 'tr-0'):
+            result = CliRunner().invoke(
+                cli_mod.cli,
+                ['serve', 'trace', 'svc', '--request', ident,
+                 '--json'])
+            assert result.exit_code == 0, result.output
+            (row,) = [json.loads(ln) for ln in
+                      result.output.strip().splitlines()]
+            assert row['request_id'] == 'req-0'
+            assert row['phases']['decode'] == 1.2
+
+    def test_empty_service_message(self, tmp_state):
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        result = CliRunner().invoke(cli_mod.cli,
+                                    ['serve', 'trace', 'ghost'])
+        assert result.exit_code == 0
+        assert 'No trace exemplars' in result.output
+
+
+# ---- tier-1 fake-cloud anatomy drill ----------------------------------------
+
+
+DRILL_REPLICA_SCRIPT = '''
+import http.server, json, os, sys, time, types
+sys.path.insert(0, {repo_root!r})
+from skypilot_tpu.infer import anatomy as anatomy_lib
+from skypilot_tpu.infer import metrics as metrics_lib
+from skypilot_tpu.utils import chaos, tracing
+
+# Chaos plan local to the replica process: every decode tick stalls —
+# the latency the anatomy drill must attribute to decode, not queue.
+chaos.load_plan(
+    {{'points': {{'infer.decode_stall': {{'latency_s': 0.3}}}}}})
+metrics = metrics_lib.ServeMetrics()
+anatomy_log = anatomy_lib.get_log()
+
+
+class H(http.server.BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        if self.path == '/metrics':
+            body = metrics.render().encode()
+        elif self.path.startswith('/anatomy'):
+            body = json.dumps(
+                anatomy_log.records(limit=200)).encode()
+        else:
+            trace_id, req_id, _ = tracing.extract_headers(
+                self.headers)
+            sub = time.perf_counter()
+            chaos.inject('infer.decode_stall')
+            end = time.perf_counter()
+            if req_id:   # relayed traffic only; probes stay unsealed
+                anatomy_log.seal(types.SimpleNamespace(
+                    submitted_at=sub, taken_at=sub + 1e-4,
+                    deferred_wait=0.0,
+                    first_token_at=sub + 2e-4, finished_at=end,
+                    decode_s=end - sub - 3e-4, commit_s=1e-4,
+                    kv_headroom_at_admit=0.9,
+                    prompt_tokens=[1, 2, 3],
+                    output_tokens=[4] * 16, request_id=0,
+                    client_request_id=req_id, trace_id=trace_id))
+            metrics.observe('/gen', 'ok', 3, 16, ttft_s=end - sub,
+                            e2e_s=end - sub, tpot_s=(end - sub) / 16)
+            body = b'ok'
+        self.send_response(200)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+http.server.ThreadingHTTPServer(
+    ('127.0.0.1', int(os.environ['PORT'])), H).serve_forever()
+'''
+
+
+class TestAnatomyDrill:
+    """Tier-1 acceptance: a chaos-stalled decode tick
+    (`infer.decode_stall`) trips a `serve.slo_breach` whose
+    `exemplar_trace_ids` resolve via `xsky serve trace --json` to a
+    cross-hop waterfall that attributes the latency to decode — not
+    to the LB or replica queues."""
+
+    def test_decode_stall_breach_resolves_to_decode_waterfall(
+            self, fake_cluster_env, monkeypatch, tmp_path):
+        del fake_cluster_env
+        import textwrap
+
+        import yaml
+
+        from click.testing import CliRunner
+
+        from skypilot_tpu import state as state_lib
+        from skypilot_tpu import task as task_lib
+        from skypilot_tpu.client import cli as cli_mod
+        from skypilot_tpu.serve import controller as controller_lib
+        from skypilot_tpu.serve import core as serve_core
+        from skypilot_tpu.serve import state as serve_state
+
+        monkeypatch.setenv('XSKY_SERVE_DB',
+                           str(tmp_path / 'serve.db'))
+        monkeypatch.setenv('XSKY_SERVE_LOG_DIR',
+                           str(tmp_path / 'serve_logs'))
+        monkeypatch.setenv('XSKY_SERVE_INTERVAL', '0.5')
+        monkeypatch.setenv(slo_lib.ENV_SCRAPE_INTERVAL, '1')
+        monkeypatch.setenv(slo_lib.ENV_BURN_WINDOWS, '5,30')
+
+        script = tmp_path / 'replica.py'
+        script.write_text(
+            DRILL_REPLICA_SCRIPT.format(repo_root=REPO_ROOT))
+        config = yaml.safe_load(textwrap.dedent(f'''\
+            name: anatsvc
+            resources:
+              accelerators: tpu-v5e-8
+            service:
+              readiness_probe: /
+              replica_policy:
+                min_replicas: 1
+              slo:
+                ttft_p99_ms: 100
+                availability: 0.99
+            run: |
+              python {script}
+        '''))
+        task = task_lib.Task.from_yaml_config(config)
+        with socket.socket() as s:
+            s.bind(('127.0.0.1', 0))
+            lb_port = s.getsockname()[1]
+        serve_state.add_service('anatsvc', task.to_yaml_config(),
+                                lb_port)
+        controller = controller_lib.SkyServeController('anatsvc')
+        thread = threading.Thread(
+            target=controller.run,
+            name='xsky-test-anatomy-controller', daemon=True)
+        thread.start()
+        try:
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                record = serve_state.get_service('anatsvc')
+                if record['status'] == \
+                        serve_state.ServiceStatus.READY:
+                    break
+                assert record['status'] != \
+                    serve_state.ServiceStatus.FAILED, \
+                    serve_core.controller_logs('anatsvc')
+                time.sleep(0.3)
+            else:
+                pytest.fail('service never became READY')
+
+            # Traffic whose decode tick the chaos plan stalls 300ms
+            # against a 100ms TTFT target.
+            for _ in range(15):
+                urllib.request.urlopen(
+                    f'http://127.0.0.1:{lb_port}/gen',
+                    timeout=30).read()
+
+            breach = None
+            deadline = time.time() + 45
+            while breach is None and time.time() < deadline:
+                events = state_lib.get_recovery_events(
+                    event_type='serve.slo_breach')
+                breach = events[-1] if events else None
+                time.sleep(0.3)
+            assert breach is not None, \
+                'serve.slo_breach never journalled'
+            linked = breach['detail'].get('exemplar_trace_ids')
+            assert linked, 'breach carries no exemplar trace ids'
+
+            # The journalled trace id resolves to a full cross-hop
+            # waterfall through the CLI.
+            result = CliRunner().invoke(
+                cli_mod.cli, ['serve', 'trace', 'anatsvc',
+                              '--request', linked[0], '--json'])
+            assert result.exit_code == 0, result.output
+            rows = [json.loads(ln) for ln in
+                    result.output.strip().splitlines()]
+            assert rows, 'exemplar trace id resolved to nothing'
+            phases = rows[0]['phases']
+            # The waterfall blames the stalled decode tick, not the
+            # queues on either side of the hop.
+            assert phases.get('decode', 0.0) > 0.2
+            assert phases['decode'] > 0.5 * rows[0]['e2e_s']
+            assert phases['decode'] > (
+                phases.get('lb_queue', 0.0) +
+                phases.get('replica_queue', 0.0) +
+                phases.get('admit_deferred', 0.0))
+            assert rows[0]['detail']['replica_id'] is not None
+        finally:
+            controller.stop()
+            thread.join(timeout=60)
+            chaos.clear()
+            try:
+                serve_core.down('anatsvc')
+            except Exception:  # pylint: disable=broad-except
+                pass
+        assert not thread.is_alive(), 'controller wedged'

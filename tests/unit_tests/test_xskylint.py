@@ -477,6 +477,28 @@ FIXTURES = {
             'def _record(name, value, labels):\n'
             '    pass\n'},
     ),
+    'cross-hop-context': (
+        {'skypilot_tpu/serve/load_balancer.py':
+            'def _proxy(self, replica, path):\n'
+            '    headers = {}\n'
+            '    return relay(replica, path, headers)\n',
+         'skypilot_tpu/infer/server.py':
+            'def _attach_trace(request, headers):\n'
+            '    request.trace_id = None\n'},
+        {'skypilot_tpu/serve/load_balancer.py':
+            'from skypilot_tpu.utils import tracing\n'
+            'def _proxy(self, replica, path):\n'
+            '    headers = {}\n'
+            '    tracing.inject_headers(headers, trace_id="t",\n'
+            '                           request_id="r")\n'
+            '    return relay(replica, path, headers)\n',
+         'skypilot_tpu/infer/server.py':
+            'from skypilot_tpu.utils import tracing\n'
+            'def _attach_trace(request, headers):\n'
+            '    trace_id, request_id, deadline_s = \\\n'
+            '        tracing.extract_headers(headers)\n'
+            '    request.trace_id = trace_id\n'},
+    ),
 }
 
 

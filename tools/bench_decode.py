@@ -24,6 +24,11 @@ commit identical tokens, that the fused arm wastes ZERO post-finish
 decode rows, and that the legacy arm (finishing mid-fused-batch)
 wastes some.
 
+A second paired-difference rung re-runs the fast arm with the
+per-request anatomy recorder (`XSKY_ANATOMY`) on vs off and gates the
+recorder's added tick cost under --anatomy-threshold % (default 2%) —
+the observability plane must not tax the path it observes.
+
 Each arm's per-token cost also lands in the metrics-history plane as
 `xsky_bench_decode_tick_cost_us{arm=...}` so repeated runs against the
 same XSKY_STATE_DB build a before/after trend readable via
@@ -37,8 +42,10 @@ Usage:
                                  [--smoke]
 """
 import argparse
+import gc
 import json
 import os
+import statistics
 import sys
 import tempfile
 import time
@@ -226,9 +233,17 @@ def _run_arm(fast: bool, args) -> dict:
         temperature=0.8, top_k=40, top_p=0.95,
         presence_penalty=0.1, frequency_penalty=0.1))
         for i in range(args.requests)]
-    t0 = time.perf_counter()
-    orch.run_until_drained(max_steps=200_000)
-    elapsed = time.perf_counter() - t0
+    # GC quiescence: a collection landing inside one arm's drain is
+    # the dominant noise source at the few-us-per-token scale the
+    # paired rungs resolve.
+    gc.collect()
+    gc.disable()
+    try:
+        t0 = time.perf_counter()
+        orch.run_until_drained(max_steps=200_000)
+        elapsed = time.perf_counter() - t0
+    finally:
+        gc.enable()
     bad = [r.error for r in reqs if r.error]
     assert not bad, bad
     tokens = sum(len(r.output_tokens) for r in reqs)
@@ -262,6 +277,10 @@ def main() -> int:
     parser.add_argument('--repeats', type=int, default=3)
     parser.add_argument('--threshold', type=float, default=1.5,
                         help='minimum legacy/fast host-cost ratio')
+    parser.add_argument('--anatomy-threshold', type=float, default=2.0,
+                        help='max %% tick cost the anatomy recorder '
+                             'may add on the fast path (paired '
+                             'on/off difference)')
     parser.add_argument('--smoke', action='store_true',
                         help='small workload for the tier-1 gate')
     args = parser.parse_args()
@@ -295,10 +314,65 @@ def main() -> int:
     fast_us = min(fast_runs) * 1e6
     speedup = legacy_us / fast_us
     trend = _record_trend(fast_us, legacy_us)
+
+    # Anatomy-recorder overhead rung (paired difference): the SAME
+    # fast-tick workload with the per-request phase accumulators on
+    # vs off. The recorder amortizes ONE timestamp pair per fused
+    # batch plus per-resident float adds — the gate holds that under
+    # --anatomy-threshold % of tick cost, with a 0.5 us/token
+    # absolute floor. The floor matters because the fake engine's
+    # tick is far cheaper than a real model's: the recorder's fixed
+    # ~5 us/tick amortizes to a visible fraction here but to noise on
+    # a real tick, while the regression this rung exists to catch —
+    # per-TOKEN timestamping creeping into the commit loop — costs
+    # >= 1 us/token and clears the floor regardless. ABBA order
+    # alternation keeps warmup drift off either arm, and longer
+    # decode budgets stretch each timed drain past the timer-noise
+    # floor.
+    anat_args = argparse.Namespace(**vars(args))
+    anat_args.max_new = args.max_new * 4
+    anat_on_runs, anat_off_runs = [], []
+    anat_on = anat_off = None
+    # More pairs than the speedup rung: the effect under test is an
+    # order of magnitude smaller, and each drain is ~100 ms — seven
+    # pairs buy a stable min/median for ~1 s of wall clock.
+    for i in range(max(7, args.repeats)):
+        order = (True, False) if i % 2 == 0 else (False, True)
+        for on in order:
+            os.environ['XSKY_ANATOMY'] = '1' if on else '0'
+            res = _run_arm(True, anat_args)
+            if on:
+                anat_on = res
+                anat_on_runs.append(res['elapsed_s'] / res['tokens'])
+            else:
+                anat_off = res
+                anat_off_runs.append(
+                    res['elapsed_s'] / res['tokens'])
+    os.environ.pop('XSKY_ANATOMY', None)
+    anat_off_us = statistics.median(anat_off_runs) * 1e6
+    anat_on_us = statistics.median(anat_on_runs) * 1e6
+    # Two upper-biased estimators of the added cost: the median of
+    # back-to-back pair differences (shared thermal/frequency state
+    # resolves a ~1% effect) and min-vs-min (each arm's quietest run;
+    # scheduler noise is strictly additive). A noise burst inflates
+    # either one, but rarely both the same way — the smaller is the
+    # tighter bound on the true recorder cost.
+    paired_us = statistics.median(
+        (on_r - off_r) * 1e6
+        for on_r, off_r in zip(anat_on_runs, anat_off_runs))
+    best_us = (min(anat_on_runs) - min(anat_off_runs)) * 1e6
+    anat_added_us = max(0.0, min(paired_us, best_us))
+    anat_pct = anat_added_us / anat_off_us * 100.0
+    anatomy_ok = (anat_pct < args.anatomy_threshold
+                  or anat_added_us < 0.5)
+    anatomy_same = anat_on['outputs'] == anat_off['outputs']
+
     ok = (speedup >= args.threshold
           and same_outputs
           and fast['wasted'] == 0
-          and legacy['wasted'] > 0)
+          and legacy['wasted'] > 0
+          and anatomy_ok
+          and anatomy_same)
     print(json.dumps({
         'metric': 'decode_tick_host_cost',
         'decode_steps': args.decode_steps,
@@ -315,6 +389,13 @@ def main() -> int:
         'legacy_wasted_steps': legacy['wasted'],
         'trend_points': trend,
         'threshold': args.threshold,
+        'anatomy_on_us_per_token': round(anat_on_us, 2),
+        'anatomy_off_us_per_token': round(anat_off_us, 2),
+        'anatomy_added_us_per_token': round(anat_added_us, 3),
+        'anatomy_overhead_pct': round(anat_pct, 2),
+        'anatomy_threshold_pct': args.anatomy_threshold,
+        'anatomy_identical_outputs': anatomy_same,
+        'anatomy_pass': anatomy_ok,
         'pass': ok,
     }))
     return 0 if ok else 1

@@ -52,6 +52,10 @@ HOT_PATH_ENTRIES: Tuple[Tuple[str, str], ...] = (
     ('skypilot_tpu/infer/paged_kv.py', 'PageAllocator.allocate'),
     ('skypilot_tpu/serve/load_balancer.py',
      'SkyServeLoadBalancer._proxy'),
+    # The anatomy recorder's append site: sealing runs on handler
+    # threads, but it must stay lock-cheap (one ring append) — a
+    # blocking seal would serialize response completion.
+    ('skypilot_tpu/infer/anatomy.py', 'AnatomyLog.seal'),
     ('skypilot_tpu/agent/telemetry.py', 'emit'),
     ('skypilot_tpu/agent/profiler.py', 'step_probe'),
     ('skypilot_tpu/agent/profiler.py', '_StepProbe.done'),

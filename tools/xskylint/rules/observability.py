@@ -104,6 +104,12 @@ class SpanProfilerRule(engine.Rule):
                                 'record_profiles',
                                 'scrape_replica_metrics',
                                 'record_serve_slo',
+                                # exemplar-waterfall sites: the
+                                # anatomy fetch rides the replica
+                                # scrape span, the persisted join
+                                # rides the slo_tick span.
+                                'fetch_replica_anatomy',
+                                'record_serve_slo_exemplars',
                                 # goodput-ledger fold/record sites:
                                 # the fold reads four bounded tables
                                 # on the controller tick whose cost
@@ -136,6 +142,43 @@ class SpanProfilerRule(engine.Rule):
                        'span — wrap it in `with tracing.span(...)`')
 
 
+class CrossHopContextRule(engine.Rule):
+    """The cross-hop trace context must stay wired: the LB relay
+    injects the trace headers (``tracing.inject_headers`` in
+    ``_proxy``) and the replica server extracts them
+    (``tracing.extract_headers``). If either site disappears, every
+    downstream join — anatomy-by-request-id, breach exemplars,
+    deadline admission — silently degrades to 'anatomy missing';
+    this rule turns that silent regression into a lint failure."""
+
+    id = 'cross-hop-context'
+    rationale = ('LB→replica trace header inject/extract sites are '
+                 'the joints of the cross-hop waterfall — removing '
+                 'one silently severs request joins')
+
+    # module → the tracing.* header helper it must call.
+    REQUIRED: Dict[str, str] = {
+        'skypilot_tpu/serve/load_balancer.py': 'inject_headers',
+        'skypilot_tpu/infer/server.py': 'extract_headers',
+    }
+
+    def applies_to(self, rel_path: str) -> bool:
+        return rel_path in self.REQUIRED
+
+    def end_file(self, ctx: engine.FileContext) -> None:
+        wanted = self.REQUIRED[ctx.rel_path]
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute) and
+                    node.func.attr == wanted and
+                    isinstance(node.func.value, ast.Name) and
+                    node.func.value.id == 'tracing'):
+                return
+        ctx.report(self.id, 1,
+                   f'no tracing.{wanted} call site — the cross-hop '
+                   'trace context is severed on this hop')
+
+
 class RetentionBoundRule(engine.Rule):
     """Every observability table in state.py must declare a retention
     bound: these tables take one row per poll/span/event forever, and
@@ -159,6 +202,7 @@ class RetentionBoundRule(engine.Rule):
         'goodput_ledger': '_MAX_GOODPUT_LEDGER',
         'metric_points': '_MAX_METRIC_POINTS',
         'remediations': '_MAX_REMEDIATIONS',
+        'serve_slo_exemplars': '_MAX_SERVE_SLO_EXEMPLARS',
     }
     # CREATE TABLE names matching this are observability tables.
     OBSERVABILITY_RE = re.compile(
@@ -346,7 +390,7 @@ class NeverRaiseRule(engine.Rule):
     REQUIRED: Dict[str, Tuple[str, ...]] = {
         'skypilot_tpu/utils/tracing.py': (
             'span', 'request_span', 'flush', 'annotate_append',
-            'env_for_child'),
+            'env_for_child', 'inject_headers', 'extract_headers'),
         'skypilot_tpu/utils/metrics.py': ('inc_counter', 'observe'),
         'skypilot_tpu/agent/telemetry.py': (
             'emit', 'record_samples', 'goodput_for_cluster'),
@@ -507,5 +551,5 @@ class NeverRaiseRule(engine.Rule):
 
 
 RULES = [SpanFanoutRule, SpanFailoverRule, SpanProfilerRule,
-         RetentionBoundRule, LeaseHeartbeatRule, TelemetryPollRule,
-         NeverRaiseRule]
+         CrossHopContextRule, RetentionBoundRule, LeaseHeartbeatRule,
+         TelemetryPollRule, NeverRaiseRule]
