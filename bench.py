@@ -578,7 +578,47 @@ def _telemetry_tail(env: dict) -> Optional[dict]:
                               if prof.get('hbm_bytes_limit') else None),
         }
 
-    return {
+    def _flightrec_tail(s: dict) -> Optional[dict]:
+        """Last K sealed flight-recorder steps riding the spool sample
+        (skypilot_tpu/agent/flight_recorder.py): the per-step phase
+        anatomy of the final steps before the hang — was the child
+        data-starved, host-bound, or mid device compute when it
+        wedged?"""
+        fr = s.get('flightrec')
+        if not isinstance(fr, dict):
+            return None
+        tail = [r for r in (fr.get('tail') or []) if isinstance(r, dict)]
+        return {
+            'seq': fr.get('seq'),
+            'last_step': tail[-1].get('step') if tail else None,
+            'tail': tail[-4:],
+        }
+
+    def _flightrec_dumps() -> Optional[list]:
+        """Black-box dump files the child sealed on its way down
+        (crash/SIGTERM/stall-verdict arms) — headline fields only; the
+        full ring stays on disk at the listed path."""
+        directory = env.get('XSKY_FLIGHTREC_DIR')
+        if not directory or not os.path.isdir(directory):
+            return None
+        out = []
+        for name in sorted(os.listdir(directory)):
+            if not name.endswith('.json'):
+                continue
+            path = os.path.join(directory, name)
+            try:
+                with open(path, encoding='utf-8') as f:
+                    blob = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            out.append({'path': path,
+                        'reason': blob.get('reason'),
+                        'rank': blob.get('rank'),
+                        'last_step': blob.get('last_step'),
+                        'records': len(blob.get('records') or ())})
+        return out or None
+
+    ranks = {
         str(rank): {
             'phase': s.get('phase'),
             'step': s.get('step'),
@@ -586,8 +626,13 @@ def _telemetry_tail(env: dict) -> Optional[dict]:
             'progress_age_s': round(
                 now - (s.get('last_progress_ts') or 0), 1),
             'profile': _profile_tail(s),
+            'flightrec': _flightrec_tail(s),
         } for rank, s in sorted(samples.items())
-    } or None
+    }
+    dumps = _flightrec_dumps()
+    if dumps:
+        ranks['flightrec_dumps'] = dumps
+    return ranks or None
 
 
 def _clear_telemetry_spool(env: dict) -> None:
@@ -602,6 +647,14 @@ def _clear_telemetry_spool(env: dict) -> None:
                 os.remove(os.path.join(spool, name))
             except OSError:
                 pass
+    dumps = env.get('XSKY_FLIGHTREC_DIR')
+    if dumps and os.path.isdir(dumps):
+        for name in os.listdir(dumps):
+            if name.endswith('.json'):
+                try:
+                    os.remove(os.path.join(dumps, name))
+                except OSError:
+                    pass
 
 
 def _attempt_child(argv, env, init_timeout: float, run_timeout: float,
@@ -726,6 +779,13 @@ def _supervise(argv) -> int:
         _own_spool = tempfile.mkdtemp(prefix='xsky-bench-telemetry-')
         base_env['XSKY_TELEMETRY_DIR'] = _own_spool
     base_env.setdefault('XSKY_TELEMETRY_INTERVAL_S', '1')
+    # Child-side flight-recorder black box (agent/flight_recorder.py):
+    # crash/SIGTERM/stall dumps land next to the spool so the failure
+    # JSON can list them (and the spool cleanup sweeps them too).
+    base_env.setdefault(
+        'XSKY_FLIGHTREC_DIR',
+        os.path.join(base_env['XSKY_TELEMETRY_DIR'], 'flightrec'))
+    base_env.setdefault('XSKY_FLIGHTREC_PUSH_INTERVAL_S', '1')
 
     def _cleanup_spool() -> None:
         if _own_spool is not None:

@@ -305,7 +305,12 @@ class Checkpointer:
         t0 = time.monotonic()
         payload = payload_fn()   # the device→host copy — the ONLY
         #                          cost the step path pays
-        self.cadence.observe_cost(time.monotonic() - t0)
+        copy_s = time.monotonic() - t0
+        self.cadence.observe_cost(copy_s)
+        # The same copy is the step's `ckpt_copy` phase in the flight
+        # recorder's seal (agent/flight_recorder.py).
+        from skypilot_tpu.agent import flight_recorder
+        flight_recorder.mark('ckpt_copy', copy_s)
         self.cadence.arm(time.monotonic())
         with self._cv:
             if self._stopped:

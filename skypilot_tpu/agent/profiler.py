@@ -297,12 +297,17 @@ class _StepProbe:
         callers with the step output in hand just call ``done(out)``."""
         self._t1 = time.perf_counter()
 
-    def done(self, out: Any = None) -> None:
+    def done(self, out: Any = None) -> Optional[tuple]:
         """Finish the probe. NEVER raises — it sits on the step loop.
 
         ``out`` (the step's output pytree) is block_until_ready'd to
         time device compute; with ``dispatched()`` already called and
         no ``out``, device time is the wall since the dispatch mark.
+
+        Returns the measured ``(dispatch_gap_s, device_s)`` pair (None
+        on failure) so the flight recorder's step seal shares THIS
+        probe's timestamps — one device sync per sampled step, never a
+        second ``block_until_ready`` for the recorder.
         """
         try:
             t1 = self._t1 if self._t1 is not None else time.perf_counter()
@@ -334,8 +339,9 @@ class _StepProbe:
             except Exception:  # pylint: disable=broad-except
                 pass
             self._anatomy.observe_step(gap, device)
+            return (gap, device)
         except Exception:  # pylint: disable=broad-except
-            pass
+            return None
 
 
 def step_probe() -> Optional[_StepProbe]:

@@ -245,6 +245,15 @@ class _Emitter:
                 with self._lock:
                     self.sample['hb_ts'] = time.time()
                     self._write_locked(time.time())
+                    progress_ts = self.sample.get('last_progress_ts')
+                # The heartbeat thread is exactly the thread still
+                # alive when the workload wedges: once this rank's OWN
+                # progress goes stall-verdict stale, seal the flight
+                # recorder's black box (latched once per episode).
+                age = time.time() - (progress_ts or 0)
+                if progress_ts and age > progress_stale_s():
+                    from skypilot_tpu.agent import flight_recorder
+                    flight_recorder.note_stall(age)
             except Exception:  # pylint: disable=broad-except
                 pass
 
@@ -663,6 +672,18 @@ def record_samples(cluster: str, job_id: Optional[int],
         from skypilot_tpu.utils import tracing
         with tracing.span('profiler.pull', cluster=cluster, job=job_id):
             profiler.record_profiles(cluster, job_id, samples, now=now)
+    except Exception:  # pylint: disable=broad-except
+        pass
+    try:
+        # Flight-recorder step-record tails ride the same samples too
+        # (the `flightrec` key): new records land in the bounded
+        # train_anatomy table + the train-phase/skew histograms.
+        from skypilot_tpu.agent import flight_recorder
+        from skypilot_tpu.utils import tracing
+        with tracing.span('flightrec.pull', cluster=cluster,
+                          job=job_id):
+            flight_recorder.record_train_anatomy(cluster, job_id,
+                                                 samples, now=now)
     except Exception:  # pylint: disable=broad-except
         pass
     return result

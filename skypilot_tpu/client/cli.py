@@ -927,6 +927,27 @@ def _top_rows(cluster: Optional[str],
     profs = {(p['cluster'], p['job_id'], p['rank']): p
              for p in state_lib.get_profiles(cluster=cluster,
                                              kind='summary')}
+    # Flight-recorder anatomy (newest-first): per-rank data-wait share
+    # plus the gang's cross-rank step skew for the DATA%/SKEW columns.
+    anat_by_gang: dict = {}
+    share_by_rank: dict = {}
+    try:
+        for arow in state_lib.get_train_anatomy(cluster=cluster,
+                                                limit=512):
+            anat_by_gang.setdefault(
+                (arow['cluster'], arow['job_id']), []).append(arow)
+            key = (arow['cluster'], arow['job_id'], arow['rank'])
+            bucket = share_by_rank.setdefault(key, [])
+            if len(bucket) < 32:
+                bucket.append(arow)
+        for key, recs in share_by_rank.items():
+            wall = sum(r.get('wall_s') or 0.0 for r in recs)
+            data = sum((r.get('phases') or {}).get('data_wait', 0.0)
+                       for r in recs)
+            share_by_rank[key] = (min(1.0, data / wall)
+                                  if wall > 0 else None)
+    except Exception:  # pylint: disable=broad-except
+        anat_by_gang, share_by_rank = {}, {}
     trend_maps = _rank_trend_maps(
         ['xsky_dispatch_gap_ratio',
          'xsky_workload_last_heartbeat_age_seconds']) if trend else {}
@@ -946,6 +967,16 @@ def _top_rows(cluster: Optional[str],
                                                    kind='job', limit=1)
         loss = (goodput_lib.loss_summary(ledger_rows[0]['seconds'])
                 if ledger_rows else '-')
+        anat_skew = None
+        anat = anat_by_gang.get((cl, job_id))
+        if anat:
+            try:
+                from skypilot_tpu.agent import flight_recorder
+                digest = flight_recorder.waterfall_digest(
+                    flight_recorder.gang_waterfall(anat))
+                anat_skew = digest.get('mean_skew_s')
+            except Exception:  # pylint: disable=broad-except
+                anat_skew = None
         for rank, row in sorted(ranks.items()):
             pulled = row['ts'] or 0
             prof = profs.get((cl, job_id, rank))
@@ -977,6 +1008,11 @@ def _top_rows(cluster: Optional[str],
                 goodput_loss=loss,
                 dispatch_gap_ratio=(prof or {}).get(
                     'dispatch_gap_ratio'),
+                # Flight-recorder anatomy: input-pipeline share of
+                # recent step wall (data starvation) + the gang's mean
+                # cross-rank compute skew.
+                data_share=share_by_rank.get((cl, job_id, rank)),
+                anatomy_skew_s=anat_skew,
                 trend=spark,
                 # Full step-anatomy block for --json consumers.
                 profile=prof))
@@ -1023,12 +1059,12 @@ def top(cluster, watch, interval, show_trend, as_json):
             return
         now = time_lib.time()
         fmt = ('{:<20} {:>4} {:>5} {:<6} {:>8} {:>10} {:>9} {:>9} '
-               '{:>7} {:>8} {:<7}')
+               '{:>5} {:>8} {:>7} {:>8} {:<7}')
         if show_trend:
             fmt += ' {:<12}'
         header = ['CLUSTER', 'JOB', 'RANK', 'PHASE', 'STEP',
-                  'STEP_TIME', 'TOK/S', 'DISPATCH%', 'MEM_MB',
-                  'HB_AGE', 'VERDICT']
+                  'STEP_TIME', 'TOK/S', 'DISPATCH%', 'DATA%',
+                  'SKEW', 'MEM_MB', 'HB_AGE', 'VERDICT']
         if show_trend:
             header.append('TREND')
         click.echo(fmt.format(*header))
@@ -1042,13 +1078,19 @@ def top(cluster, watch, interval, show_trend, as_json):
             disp = (f'{row["dispatch_gap_ratio"]:.0%}'
                     if row.get('dispatch_gap_ratio') is not None
                     else '-')
+            data = (f'{row["data_share"]:.0%}'
+                    if row.get('data_share') is not None else '-')
+            skew_s = (f'{row["anatomy_skew_s"] * 1e3:.1f}ms'
+                      if row.get('anatomy_skew_s') is not None
+                      else '-')
             mem = (f'{row["host_mem_mb"]:.0f}'
                    if row['host_mem_mb'] else '-')
             cells = [
                 row['cluster'][:20], str(row['job_id'] or '-'),
                 row['rank'], (row['phase'] or '-')[:6],
                 str(row['step'] if row['step'] is not None else '-'),
-                step_time, tps, disp, mem, _age_str(row['hb_age_s']),
+                step_time, tps, disp, data, skew_s, mem,
+                _age_str(row['hb_age_s']),
                 row['verdict'] or '-']
             if show_trend:
                 cells.append(row.get('trend') or '-')
@@ -2484,6 +2526,117 @@ def serve_trace(service_name, request_id, slowest, as_json):
             extras.append(f"replica={detail['replica_id']}")
         if extras:
             click.echo('  ' + '  '.join(extras))
+        click.echo('')
+
+
+@cli.group()
+def train():
+    """Training observability: flight-recorder step anatomy."""
+
+
+# Waterfall glyph per step phase (`xsky train trace`): one character of
+# bar per share of the rank's step wall time. Order matches
+# agent/flight_recorder.PHASES (repeated here so the CLI needs no
+# agent import just to render).
+_TRAIN_PHASE_GLYPHS = (
+    ('data_wait', 'd'), ('h2d', 'h'), ('dispatch', '>'),
+    ('device_compute', '#'), ('ckpt_copy', 'c'), ('other', '.'),
+)
+
+
+def _train_phase_bar(phases: dict, total: float,
+                     width: int = 40) -> str:
+    """Stacked per-phase bar, largest-remainder rounded so the bar
+    length is stable (the goodput waterfall's rounding)."""
+    if total <= 0:
+        return ''
+    shares = [(glyph, (phases.get(p) or 0.0) / total * width)
+              for p, glyph in _TRAIN_PHASE_GLYPHS]
+    cells = [(glyph, int(share)) for glyph, share in shares]
+    rest = sorted(((share - int(share), i)
+                   for i, (_, share) in enumerate(shares)),
+                  reverse=True)
+    short = width - sum(n for _, n in cells)
+    for _, i in rest[:max(0, short)]:
+        cells[i] = (cells[i][0], cells[i][1] + 1)
+    return ''.join(glyph * n for glyph, n in cells)
+
+
+@train.command(name='trace')
+@click.argument('cluster')
+@click.option('--job', 'job_id', type=int, default=None,
+              help='Restrict to one managed job id.')
+@click.option('--step', 'step', type=int, default=None,
+              help='One step: the cross-rank waterfall for step N.')
+@click.option('--slowest', type=int, default=5,
+              help='Show the N slowest gang steps on record.')
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='One JSON object per gang step (full per-rank '
+                   'phase maps), then a {"digest": ...} summary row.')
+def train_trace(cluster, job_id, step, slowest, as_json):
+    """Cross-rank training step anatomy: where each rank's step time
+    went and who held the gang back.
+
+    Reads the bounded train_anatomy table (flight-recorder rings ride
+    the telemetry spool pull) and joins records by step index. The
+    slowest rank's compute IS the others' barrier wait: per step the
+    skew, the straggler rank, and each rank's implied wait are derived
+    from the join, and a stacked phase bar shows each rank's own
+    decomposition (d=data_wait h=h2d >=dispatch #=device_compute
+    c=ckpt_copy .=other).
+    """
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu.agent import flight_recorder
+    rows = state_lib.get_train_anatomy(cluster=cluster, job_id=job_id,
+                                       limit=2000)
+    waterfalls = flight_recorder.gang_waterfall(rows)
+    if step is not None:
+        waterfalls = [w for w in waterfalls if w['step'] == step]
+    else:
+        waterfalls = sorted(waterfalls,
+                            key=lambda w: w.get('gang_wall_s') or 0.0,
+                            reverse=True)[:max(1, slowest)]
+    digest = flight_recorder.waterfall_digest(waterfalls)
+    if as_json:
+        for entry in waterfalls:
+            click.echo(json.dumps(entry, default=str))
+        click.echo(json.dumps({'digest': digest}, default=str))
+        return
+    if not waterfalls:
+        click.echo(f'No step anatomy recorded for {cluster!r}'
+                   + (f' job {job_id}' if job_id is not None else '')
+                   + ' yet (rings ride the telemetry pull).')
+        return
+    click.echo(
+        f'TRAIN TRACE {cluster} — {digest["steps"]} step(s), '
+        f'mean skew {digest["mean_skew_s"] * 1e3:.1f}ms, '
+        f'data share {digest["data_share"]:.0%}, top straggler '
+        + (f'rank {digest["top_straggler"]}'
+           if digest.get('top_straggler') is not None else '-'))
+    legend = ' '.join(f'{glyph}={p}'
+                      for p, glyph in _TRAIN_PHASE_GLYPHS)
+    click.echo(f'({legend})')
+    for entry in waterfalls:
+        straggler = entry.get('straggler_rank')
+        click.echo(
+            f"step {entry['step']}  "
+            f"gang {entry['gang_wall_s'] * 1e3:>8.1f}ms  "
+            f"skew {entry['skew_s'] * 1e3:>7.1f}ms  "
+            f"data {entry['data_share']:.0%}  "
+            + (f'straggler rank {straggler}'
+               if straggler is not None else ''))
+        waits = entry.get('barrier_wait_s') or {}
+        for rank in sorted(entry['ranks']):
+            rec = entry['ranks'][rank]
+            wall = rec.get('wall_s') or 0.0
+            wait = waits.get(rank)
+            mark = '~' if rank == straggler else ' '
+            line = (f'  rank {rank:>3}{mark} '
+                    f'{wall * 1e3:>8.1f}ms  '
+                    f'{_train_phase_bar(rec.get("phases") or {}, wall)}')
+            if wait:
+                line += f'  +wait {wait * 1e3:.1f}ms'
+            click.echo(line)
         click.echo('')
 
 

@@ -86,6 +86,9 @@ class JobsController:
         self.remediator.register(
             'step_time_regression', 'capture_profile',
             self._remediate_step_time_regression)
+        self.remediator.register(
+            'data_starved', 'capture_flightrec',
+            self._remediate_data_starved)
 
     def _heartbeat(self) -> None:
         """Renew this job's liveness lease (reconciler crash-safety:
@@ -144,6 +147,29 @@ class JobsController:
                      detector=anomaly['detector'],
                      action='capture_profile')
         return self._capture_profile(anomaly)
+
+    def _remediate_data_starved(
+            self, anomaly: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Data starvation → snapshot the flight-recorder anatomy for
+        the affected cluster while the starvation is live: the gang
+        waterfall digest (skew, straggler counts, data share) is the
+        evidence a postmortem needs, and it journals with the
+        remediation row."""
+        chaos.inject(remediation.APPLY_CHAOS_POINT,
+                     detector=anomaly['detector'],
+                     action='capture_flightrec')
+        if not self._anomaly_is_ours(anomaly):
+            return None
+        digest = None
+        try:
+            from skypilot_tpu.agent import flight_recorder
+            rows = global_state.get_train_anatomy(
+                cluster=self.cluster_name, limit=256)
+            digest = flight_recorder.waterfall_digest(
+                flight_recorder.gang_waterfall(rows))
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'flightrec digest failed: {e}')
+        return {'cluster': self.cluster_name, 'anatomy': digest}
 
     # ---- helpers ----
 

@@ -40,6 +40,9 @@ and gauges computed at scrape time from the state DB:
     live cluster's newest persisted roll-up)
   * xsky_ckpt_freshness_age_seconds{cluster,job,rank}  (seconds since
     the rank's newest checkpoint snapshot — the replay exposure)
+  * xsky_train_data_share{cluster,job,rank}  (input-pipeline share of
+    recent step wall time from the flight-recorder anatomy — the
+    data-starvation signal the history plane's detector watches)
   * xsky_serve_slo_burn_rate{service,window}  (worst objective's burn;
     >= 1 spends the error budget faster than it accrues)
   * xsky_serve_replica_ttft_p99_seconds{service,replica}
@@ -296,6 +299,54 @@ def _render_profile_gauges() -> List[str]:
     return lines
 
 
+def _render_train_gauges() -> List[str]:
+    """Training-anatomy health computed at scrape time from each live
+    cluster's newest flight-recorder rows: per-rank data-wait share of
+    step wall time (the data-starvation signal; the history plane's
+    ``data_starved`` detector watches this series). Averaged over the
+    rank's recent records so one slow batch doesn't flap the gauge.
+    Same live-cluster filter and {cluster,job,rank} labeling as the
+    profile gauges. Never raises; an unreadable state DB costs the
+    gauge, not the scrape."""
+    lines: List[str] = []
+    try:
+        from skypilot_tpu import state
+        live = set(state.get_cluster_names())
+        rows = [r for r in state.get_train_anatomy(limit=512)
+                if r['cluster'] in live]
+        if not rows:
+            return []
+        # Newest-first rows: take each rank's most recent records only.
+        per_rank: Dict[Tuple[str, int, int], List[Dict]] = {}
+        for row in rows:
+            key = (row['cluster'], row['job_id'], row['rank'])
+            bucket = per_rank.setdefault(key, [])
+            if len(bucket) < 32:
+                bucket.append(row)
+        share_lines = []
+        for (cluster, job_id, rank), recs in sorted(per_rank.items()):
+            wall = sum(r.get('wall_s') or 0.0 for r in recs)
+            if wall <= 0:
+                continue
+            data = sum((r.get('phases') or {}).get('data_wait', 0.0)
+                       for r in recs)
+            labels = ('cluster="'
+                      f'{_escape_label(cluster)}",job='
+                      f'"{job_id}",rank="{rank}"')
+            share_lines.append(
+                f'xsky_train_data_share{{{labels}}} '
+                f'{min(1.0, data / wall):.4f}')
+        if share_lines:
+            lines.append('# HELP xsky_train_data_share Input-pipeline '
+                         '(data_wait) share of recent step wall time '
+                         'per rank, from flight-recorder anatomy.')
+            lines.append('# TYPE xsky_train_data_share gauge')
+            lines.extend(share_lines)
+    except Exception:  # pylint: disable=broad-except
+        return []
+    return lines
+
+
 def _render_goodput_counters() -> List[str]:
     """Goodput-loss decomposition computed at scrape time from each
     LIVE cluster's newest persisted ledger roll-up (kind='job', written
@@ -486,6 +537,8 @@ _GAUGE_SECTIONS = (
       'xsky_ckpt_freshness_age_seconds', 'xsky_goodput_ratio')),
     (_render_profile_gauges,
      ('xsky_dispatch_gap_ratio', 'xsky_hbm_bytes_in_use')),
+    (_render_train_gauges,
+     ('xsky_train_data_share',)),
     (_render_goodput_counters,
      ('xsky_goodput_loss_seconds_total',)),
     (_render_serve_slo_gauges,

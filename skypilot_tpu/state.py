@@ -271,6 +271,22 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             ON profiles (cluster);
         CREATE INDEX IF NOT EXISTS idx_profiles_latest
             ON profiles (cluster, job_id, rank, kind, row_id);
+        CREATE TABLE IF NOT EXISTS train_anatomy (
+            row_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            ts REAL,
+            cluster TEXT,
+            job_id INTEGER,
+            rank INTEGER,
+            started_ts REAL,
+            step INTEGER,
+            wall_s REAL,
+            phases TEXT,
+            detail TEXT
+        );
+        CREATE INDEX IF NOT EXISTS idx_train_anatomy_cluster
+            ON train_anatomy (cluster, row_id);
+        CREATE INDEX IF NOT EXISTS idx_train_anatomy_step
+            ON train_anatomy (cluster, job_id, step);
         CREATE TABLE IF NOT EXISTS serve_slo (
             row_id INTEGER PRIMARY KEY AUTOINCREMENT,
             ts REAL,
@@ -1734,6 +1750,116 @@ def get_serve_slo_exemplars(service: Optional[str] = None,
             'outcome': outcome,
             'e2e_s': e2e_s,
             'ttft_s': ttft_s,
+            'phases': phases,
+            'detail': detail,
+        })
+    return out
+
+
+# ---- train anatomy (flight-recorder step records) ---------------------------
+
+# Per-rank sealed step records pulled off the telemetry spool's
+# `flightrec` ride-along (agent/flight_recorder.py): one row per
+# (rank, step), its phases summing exactly to the step wall. `xsky
+# train trace` joins rows across ranks into gang step waterfalls; the
+# data-starved detector trends the data_wait share.
+
+# Newest rows kept (pruned lazily, serve_slo_exemplars pattern). At an
+# 8-record tail per rank per pull, 8k rows keep the last ~1k gang
+# steps of an 8-rank job.
+_MAX_TRAIN_ANATOMY = 8000
+_train_anatomy_inserts = 0
+
+_TRAIN_ANATOMY_COLS = ('ts, cluster, job_id, rank, started_ts, step, '
+                       'wall_s, phases, detail')
+
+
+def record_train_anatomy(cluster: str, job_id: Any,
+                         rows: List[Dict[str, Any]],
+                         ts: Optional[float] = None) -> None:
+    """Persist one pull's new flight-recorder step records in ONE
+    transaction. NEVER raises — same pull-path contract and
+    batched-write pattern as record_workload_telemetry."""
+    global _train_anatomy_inserts
+    if not rows:
+        return
+    ts = ts if ts is not None else time.time()
+    try:
+        conn = _get_conn()
+        values = [(r.get('ts', ts), cluster, job_id, r.get('rank'),
+                   r.get('started_ts'), r.get('step'), r.get('wall_s'),
+                   (json.dumps(r['phases'], default=str)
+                    if r.get('phases') else None),
+                   (json.dumps(r['detail'], default=str)
+                    if r.get('detail') else None))
+                  for r in rows]
+    except Exception:  # pylint: disable=broad-except
+        return
+    try:
+        with _lock:
+            conn.executemany(
+                f'INSERT INTO train_anatomy ({_TRAIN_ANATOMY_COLS}) '
+                'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)', values)
+            # Prune on the FIRST batch too (serve_slo rationale).
+            _train_anatomy_inserts += len(rows)
+            if _train_anatomy_inserts == len(rows) or \
+                    _train_anatomy_inserts % 256 < len(rows):
+                conn.execute(
+                    'DELETE FROM train_anatomy WHERE row_id <= '
+                    '(SELECT MAX(row_id) FROM train_anatomy) - ?',
+                    (_MAX_TRAIN_ANATOMY,))
+            conn.commit()
+    except Exception:  # pylint: disable=broad-except
+        try:
+            conn.rollback()
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+def get_train_anatomy(cluster: Optional[str] = None,
+                      job_id: Optional[int] = None,
+                      rank: Optional[int] = None,
+                      step: Optional[int] = None,
+                      limit: int = 500,
+                      offset: int = 0) -> List[Dict[str, Any]]:
+    """Flight-recorder step records, newest-first (the `xsky train
+    trace` / `xsky top` read path; `gang_waterfall` joins them)."""
+    conds, args = [], []
+    if cluster is not None:
+        conds.append('cluster = ?')
+        args.append(cluster)
+    if job_id is not None:
+        conds.append('job_id = ?')
+        args.append(job_id)
+    if rank is not None:
+        conds.append('rank = ?')
+        args.append(rank)
+    if step is not None:
+        conds.append('step = ?')
+        args.append(step)
+    query = f'SELECT {_TRAIN_ANATOMY_COLS} FROM train_anatomy'
+    if conds:
+        query += ' WHERE ' + ' AND '.join(conds)
+    query += ' ORDER BY row_id DESC' + _page_sql(int(limit), offset)
+    out = []
+    for (row_ts, cl, job, rank_, started_ts, step_, wall_s, phases,
+         detail) in _read(query, args):
+        try:
+            phases = json.loads(phases) if phases else None
+        except ValueError:
+            phases = None
+        try:
+            detail = json.loads(detail) if detail else None
+        except ValueError:
+            detail = None
+        out.append({
+            'ts': row_ts,
+            'cluster': cl,
+            'job_id': job,
+            'rank': rank_,
+            'started_ts': started_ts,
+            'step': step_,
+            'wall_s': wall_s,
             'phases': phases,
             'detail': detail,
         })

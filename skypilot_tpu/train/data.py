@@ -229,14 +229,27 @@ def make_loader(paths: Sequence[str], batch: int, seq: int,
 
 def batches(loader, vocab_size: Optional[int] = None
             ) -> Iterator[Dict[str, np.ndarray]]:
-    """Loader rows → trainer feed dicts (tokens + shifted targets)."""
-    for rows in loader:
-        if vocab_size is not None:
-            # Clamp on the uint32 rows: tokens >= 2^31 would wrap
-            # negative after astype and slip past a later clamp.
-            rows = np.minimum(rows, np.uint32(vocab_size - 1))
-        tokens = rows[:, :-1].astype(np.int32)
-        targets = rows[:, 1:].astype(np.int32)
+    """Loader rows → trainer feed dicts (tokens + shifted targets).
+
+    The hand-off is the step loop's ``data_wait`` phase: the flight
+    recorder brackets the blocking ``next()`` plus the clamp/shift prep
+    (the whole host input-pipeline cost the device sits idle behind).
+    The ``train.data_stall`` chaos point fires inside the bracket.
+    """
+    from skypilot_tpu.agent import flight_recorder
+    it = iter(loader)
+    while True:
+        with flight_recorder.phase('data_wait'):
+            try:
+                rows = next(it)
+            except StopIteration:
+                return
+            if vocab_size is not None:
+                # Clamp on the uint32 rows: tokens >= 2^31 would wrap
+                # negative after astype and slip past a later clamp.
+                rows = np.minimum(rows, np.uint32(vocab_size - 1))
+            tokens = rows[:, :-1].astype(np.int32)
+            targets = rows[:, 1:].astype(np.int32)
         yield {'tokens': tokens, 'targets': targets}
 
 

@@ -148,8 +148,13 @@ def main() -> int:
                         help='comma-separated weight names to adapt')
     args = parser.parse_args()
 
+    from skypilot_tpu.agent import flight_recorder
     from skypilot_tpu.agent import profiler
     from skypilot_tpu.agent import telemetry
+    # Black-box dumps BEFORE anything can fail: a fatal exception or a
+    # SIGTERM/preemption from here on seals the flight-recorder ring
+    # to $XSKY_FLIGHTREC_DIR for post-mortem step anatomy.
+    flight_recorder.install_crash_dumps()
     # Phase `init` BEFORE the distributed barrier: a rank wedged in
     # jax.distributed bring-up then shows a live heartbeat with stale
     # progress — the hung-rank signature `xsky top` flags.
@@ -386,17 +391,25 @@ def main() -> int:
     t0 = time.perf_counter()
     window_t0, window_steps = t0, 0
     for step in range(start_step, args.steps):
+        # Flight-recorder step record: data_wait brackets the feed
+        # hand-off (inside data_lib.batches), h2d the host→device
+        # transfer, dispatch/device ride trainer.step's probe marks,
+        # ckpt_copy the checkpointd snapshot below; the end-of-body
+        # seal makes the phases sum exactly to this iteration's wall.
+        flight_recorder.begin_step(step)
         if feed is not None:
             host_batch = next(feed)
             # One transfer: numpy straight onto the sharded layout
             # (process-local rows on multi-host meshes).
-            batch = {
-                k: jax.make_array_from_process_local_data(
-                    trainer.batch_sharding, v)
-                for k, v in host_batch.items()
-            }
+            with flight_recorder.phase('h2d'):
+                batch = {
+                    k: jax.make_array_from_process_local_data(
+                        trainer.batch_sharding, v)
+                    for k, v in host_batch.items()
+                }
         else:
-            batch = trainer.synthetic_batch(step)
+            with flight_recorder.phase('h2d'):
+                batch = trainer.synthetic_batch(step)
         state, metrics = trainer.step(state, batch)
         window_steps += 1
         if (step + 1) % args.log_every == 0:
@@ -465,6 +478,7 @@ def main() -> int:
                 storage_cadence.observe_cost(
                     time.perf_counter() - t0_save)
                 storage_cadence.arm()
+        flight_recorder.record_step(step)
     if manager is not None:
         import orbax.checkpoint as ocp
         # Final checkpoint rides the same pipeline (fast tiers stay

@@ -18,6 +18,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec
 
 from skypilot_tpu import models
+from skypilot_tpu.agent import flight_recorder
 from skypilot_tpu.agent import profiler
 from skypilot_tpu.agent import telemetry
 from skypilot_tpu.models import llama
@@ -333,11 +334,21 @@ class Trainer:
         # Every Nth step is anatomy-sampled: the probe splits host
         # dispatch gap from device compute (one block_until_ready on
         # the sampled step only — tools/bench_profile.py gates the
-        # blended cost <2% of step time).
+        # blended cost <2% of step time). The flight recorder gets
+        # dispatch/device marks EVERY step: the sampled step reuses
+        # the probe's own timestamp pair (no second device sync —
+        # tools/bench_flightrec.py asserts exactly one), unsampled
+        # steps record the cheap dispatch wall only.
         probe = profiler.step_probe()
+        t0 = time.perf_counter()
         out = self.compile_step()(state, batch)
-        if probe is not None:
-            probe.done(out)
+        dispatch_s = time.perf_counter() - t0
+        marks = probe.done(out) if probe is not None else None
+        if marks is not None:
+            flight_recorder.mark_compute(marks[0], marks[1],
+                                         synced=True)
+        else:
+            flight_recorder.mark_compute(dispatch_s)
         self._note_step()
         return out
 

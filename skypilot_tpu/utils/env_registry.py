@@ -368,6 +368,21 @@ _VARS = [
            'HUNG'),
     EnvVar('XSKY_TELEMETRY_PULL_INTERVAL_S', '10',
            'Control-plane spool-pull rate limit'),
+    # ---- training flight recorder ------------------------------------------
+    EnvVar('XSKY_FLIGHTREC', '1',
+           'Set to 0 to disable the training flight recorder (per-step '
+           'anatomy ring + black-box dumps)'),
+    EnvVar('XSKY_FLIGHTREC_RING_SIZE', '512',
+           'Sealed step records kept in the per-rank ring'),
+    EnvVar('XSKY_FLIGHTREC_DIR', UNSET,
+           'Black-box dump directory (crash/SIGTERM/stall-verdict '
+           'arms; unset = no dumps)'),
+    EnvVar('XSKY_FLIGHTREC_TAIL', '8',
+           'Newest sealed records riding each telemetry sample as its '
+           'flightrec key'),
+    EnvVar('XSKY_FLIGHTREC_PUSH_INTERVAL_S', '2',
+           'Minimum interval between flightrec ride-along pushes onto '
+           'the telemetry sample'),
     # ---- goodput attribution ledger ---------------------------------------
     EnvVar('XSKY_GOODPUT_RECORD_INTERVAL_S', '30',
            'Jobs-controller cadence for folding + persisting the '

@@ -75,7 +75,7 @@ ANOMALY_CLEARED_EVENT = 'metrics.anomaly_cleared'
 DETECTOR_CHAOS_POINT = 'metrics.detector'
 
 DETECTORS = ('step_time_regression', 'dispatch_gap_trend',
-             'heartbeat_age_drift', 'burn_rate_accel')
+             'heartbeat_age_drift', 'burn_rate_accel', 'data_starved')
 
 
 def _env_float(name: str, default: float) -> float:
@@ -720,6 +720,7 @@ def _detect_anomalies(now: float) -> List[Dict[str, Any]]:
         'dispatch_gap_trend': _eval_dispatch_gap_trend,
         'heartbeat_age_drift': _eval_heartbeat_age_drift,
         'burn_rate_accel': _eval_burn_rate_accel,
+        'data_starved': _eval_data_starved,
     }
     for detector in DETECTORS:
         forced = chaos.inject(DETECTOR_CHAOS_POINT, detector=detector)
@@ -785,6 +786,30 @@ def _eval_dispatch_gap_trend(now: float, since: float
         if recent_avg >= 0.5 and recent_avg - trail_avg >= 0.1:
             out.append(_finding('dispatch_gap_trend',
                                 'xsky_dispatch_gap_ratio', labels,
+                                recent_avg, trail_avg))
+    return out
+
+
+def _eval_data_starved(now: float, since: float
+                       ) -> List[Dict[str, Any]]:
+    """A rank whose input-pipeline (data_wait) share of step wall time
+    is both elevated and rising is data-starved: the device idles
+    behind the host loader. Same recent-vs-trail shape as the
+    dispatch-gap trend, over the flight-recorder's
+    ``xsky_train_data_share`` gauge."""
+    k = _min_points()
+    out = []
+    for labels, points in _grouped('xsky_train_data_share', since):
+        values = [v for _, v in points]
+        if len(values) < k + 2:
+            continue
+        recent = values[-k:]
+        trail = values[:-k]
+        recent_avg = sum(recent) / len(recent)
+        trail_avg = sum(trail) / len(trail)
+        if recent_avg >= 0.4 and recent_avg - trail_avg >= 0.1:
+            out.append(_finding('data_starved',
+                                'xsky_train_data_share', labels,
                                 recent_avg, trail_avg))
     return out
 

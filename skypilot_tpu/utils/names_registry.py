@@ -96,6 +96,12 @@ _NAMES = [
             'Hung/dead rank verdict transitions, labeled by verdict'),
     ObsName('metric', 'xsky_workload_step_seconds',
             'Pull-fed workload step-time histogram'),
+    ObsName('metric', 'xsky_train_phase_seconds',
+            'Flight-recorder per-step phase seconds histogram '
+            '{phase,cluster}'),
+    ObsName('metric', 'xsky_train_step_skew_seconds',
+            'Cross-rank per-step compute skew histogram from the gang '
+            'waterfall join {cluster}'),
     ObsName('metric', 'xsky_metrics_points_recorded_total',
             'Metric points recorded by the history recorder tick'),
     ObsName('metric', 'xsky_metrics_anomalies_total',
@@ -126,6 +132,9 @@ _NAMES = [
             'Host dispatch share of step time {cluster,job,rank}'),
     ObsName('metric', 'xsky_hbm_bytes_in_use',
             'Device HBM bytes in use {cluster,job,rank}'),
+    ObsName('metric', 'xsky_train_data_share',
+            'Input-pipeline share of recent step wall time '
+            '{cluster,job,rank} (the data-starvation signal)'),
     ObsName('metric', 'xsky_ckpt_freshness_age_seconds',
             'Seconds since the rank\'s newest checkpoint snapshot '
             '{cluster,job,rank} (replay exposure)'),
@@ -282,6 +291,9 @@ _NAMES = [
             'profile.capture verb: on-demand device capture'),
     ObsName('span', 'profiler.pull',
             'Profile-block extraction during a telemetry pull'),
+    ObsName('span', 'flightrec.pull',
+            'Flight-recorder anatomy extraction during a telemetry '
+            'pull'),
     ObsName('span', 'serve.recover_replica',
             'Serve replica relaunch after a probe failure'),
     ObsName('span', 'serve.slo_tick',
@@ -342,6 +354,13 @@ _NAMES = [
             'Serve controller replica readiness probe'),
     ObsName('chaos', 'telemetry.stall',
             'Freeze telemetry progress (heartbeat keeps beating)'),
+    ObsName('chaos', 'train.data_stall',
+            'Sleep inside the data_wait bracket (rule key `stall_s`) '
+            '— measured, and attributed, as real data wait'),
+    ObsName('chaos', 'train.straggler_rank',
+            'Slow one rank\'s step compute (rule key `extra_s`), '
+            'keyed on rank/step — drives the gang-waterfall '
+            'straggler attribution drill'),
     # ---- journal event types ----------------------------------------------
     ObsName('journal', 'chaos.injected',
             'A chaos rule fired (latency rules journal measured '

@@ -1,0 +1,826 @@
+"""Flight-recorder tests: ring bounds + exact seal math, the phase
+brackets, black-box dump arms (exception/SIGTERM subprocess drill,
+stall-verdict latch), the cross-rank gang waterfall join (straggler /
+barrier-wait math, missing ranks, elastic renumbering), the bounded
+train_anatomy table + pull dedup, the `xsky train trace` / `xsky top` /
+`/metrics` surfaces, the data-starved detector + controller remediation
+binding, the bench_flightrec overhead gate, bench.py's failure-JSON
+black-box surfacing, and the tier-1 fake-cloud drill where chaos-
+injected data stalls and stragglers resolve to the correct phase
+attribution end-to-end."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from skypilot_tpu.agent import flight_recorder
+from skypilot_tpu.agent import telemetry
+from skypilot_tpu.utils import chaos
+from skypilot_tpu.utils import metrics as metrics_lib
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), '..', '..'))
+
+
+@pytest.fixture(autouse=True)
+def _clean_flightrec(monkeypatch):
+    for env in (flight_recorder.ENV_ENABLED,
+                flight_recorder.ENV_RING_SIZE, flight_recorder.ENV_DIR,
+                flight_recorder.ENV_TAIL,
+                flight_recorder.ENV_PUSH_INTERVAL, telemetry.ENV_DIR,
+                'XSKY_HOST_RANK'):
+        monkeypatch.delenv(env, raising=False)
+    flight_recorder.reset_for_test()
+    telemetry.reset_for_test()
+    metrics_lib.reset_for_test()
+    chaos.clear()
+    yield
+    flight_recorder.reset_for_test()
+    telemetry.reset_for_test()
+    chaos.clear()
+
+
+@pytest.fixture
+def tmp_state(monkeypatch, tmp_path):
+    from skypilot_tpu import state
+    monkeypatch.setenv('XSKY_STATE_DB', str(tmp_path / 'state.db'))
+    state.reset_for_test()
+    yield state
+    state.reset_for_test()
+
+
+@pytest.fixture
+def dumps_dir(monkeypatch, tmp_path):
+    d = tmp_path / 'flightrec'
+    monkeypatch.setenv(flight_recorder.ENV_DIR, str(d))
+    return d
+
+
+def _seal_steps(n, start=0):
+    for i in range(start, start + n):
+        flight_recorder.begin_step(i)
+        flight_recorder.mark('data_wait', 0.001)
+        flight_recorder.record_step()
+
+
+# ---- ring + seal math -------------------------------------------------------
+
+
+class TestRing:
+
+    def test_ring_bounded_by_env(self, monkeypatch):
+        monkeypatch.setenv(flight_recorder.ENV_RING_SIZE, '4')
+        _seal_steps(10)
+        rec = flight_recorder.get_recorder()
+        rows = rec.records()
+        assert len(rows) == 4
+        # Newest-first read side; the oldest six fell off the ring.
+        assert [r['step'] for r in rows] == [9, 8, 7, 6]
+        assert rec._seq == 10
+
+    def test_seal_phases_sum_exactly_to_wall(self):
+        flight_recorder.begin_step(1)
+        flight_recorder.mark('data_wait', 0.0103)
+        flight_recorder.mark('h2d', 0.0007)
+        flight_recorder.mark_compute(0.0011, 0.0502, synced=True)
+        rec = flight_recorder.get_recorder()
+        record = rec.seal(wall_s=0.1)
+        # The acceptance contract: EXACT equality, not approx — the
+        # stored wall is re-derived with the reader's accumulation
+        # order so `sum(phases) == wall_s` at 0.0 error.
+        assert sum(record['phases'].values()) == record['wall_s']
+        assert record['phases']['other'] == pytest.approx(
+            0.1 - 0.0103 - 0.0007 - 0.0011 - 0.0502)
+        assert record['synced'] is True
+        assert record['step'] == 1
+
+    def test_seal_overattributed_wall_clamps_other_to_zero(self):
+        flight_recorder.begin_step(2)
+        flight_recorder.mark('data_wait', 0.2)
+        rec = flight_recorder.get_recorder()
+        record = rec.seal(wall_s=0.05)
+        assert record['phases']['other'] == 0.0
+        # Still exact: the wall becomes the attributed sum.
+        assert sum(record['phases'].values()) == record['wall_s']
+
+    def test_measured_wall_sums_exactly_too(self):
+        flight_recorder.begin_step(3)
+        with flight_recorder.phase('data_wait'):
+            time.sleep(0.02)
+        flight_recorder.mark_compute(0.001)
+        flight_recorder.record_step()
+        record = flight_recorder.get_recorder().records()[0]
+        assert sum(record['phases'].values()) == record['wall_s']
+        assert record['phases']['data_wait'] >= 0.02
+        assert record['wall_s'] >= record['phases']['data_wait']
+
+    def test_begin_step_drops_unsealed_predecessor(self):
+        flight_recorder.begin_step(1)
+        flight_recorder.mark('data_wait', 5.0)
+        flight_recorder.begin_step(2)        # step 1 never sealed
+        flight_recorder.record_step()
+        rows = flight_recorder.get_recorder().records()
+        assert [r['step'] for r in rows] == [2]
+        assert rows[0]['phases']['data_wait'] == 0.0
+
+    def test_tail_oldest_first_and_env_len(self, monkeypatch):
+        monkeypatch.setenv(flight_recorder.ENV_TAIL, '3')
+        _seal_steps(5)
+        tail = flight_recorder.get_recorder().tail()
+        assert [r['step'] for r in tail] == [2, 3, 4]
+
+    def test_disabled_is_dict_lookup_noop(self, monkeypatch):
+        monkeypatch.setenv(flight_recorder.ENV_ENABLED, '0')
+        assert flight_recorder.get_recorder() is None
+        # Every entry point is a no-op, never a raise.
+        flight_recorder.begin_step(1)
+        with flight_recorder.phase('data_wait'):
+            pass
+        flight_recorder.mark('h2d', 0.1)
+        flight_recorder.mark_compute(0.1, 0.2)
+        flight_recorder.record_step()
+        assert flight_recorder.seal_dump('exception') is None
+
+    def test_never_raises_on_garbage(self):
+        # float('nan-ish') inputs must cost the record, not the step.
+        flight_recorder.begin_step(1)
+        flight_recorder.mark('data_wait', 'not-a-number')
+        flight_recorder.record_step(phases={'h2d': 'also-bad'})
+        flight_recorder.record_step(step='bogus')
+        # The recorder survives and keeps sealing.
+        _seal_steps(1, start=9)
+        steps = [r['step']
+                 for r in flight_recorder.get_recorder().records()]
+        assert 9 in steps
+
+    def test_rank_from_host_rank_env(self, monkeypatch):
+        monkeypatch.setenv('XSKY_HOST_RANK', '3')
+        assert flight_recorder.get_recorder().rank == 3
+
+    def test_ride_along_lands_on_spool_sample(self, monkeypatch,
+                                              tmp_path):
+        monkeypatch.setenv(telemetry.ENV_DIR, str(tmp_path / 'spool'))
+        monkeypatch.setenv(telemetry.ENV_RANK, '0')
+        monkeypatch.setenv(telemetry.ENV_INTERVAL, '0')
+        monkeypatch.setenv(flight_recorder.ENV_PUSH_INTERVAL, '0')
+        _seal_steps(3)
+        sample = telemetry.read_spool(str(tmp_path / 'spool'))[0]
+        fr = sample['flightrec']
+        assert fr['seq'] == 3
+        assert [r['step'] for r in fr['tail']] == [0, 1, 2]
+
+
+# ---- black-box dump arms ----------------------------------------------------
+
+
+class TestBlackBoxDumps:
+
+    def test_dump_writes_readable_blackbox(self, dumps_dir):
+        _seal_steps(2)
+        path = flight_recorder.seal_dump('exception',
+                                         detail={'error': 'boom'})
+        assert path and os.path.exists(path)
+        blob = json.loads(open(path, encoding='utf-8').read())
+        assert blob['reason'] == 'exception'
+        assert blob['sealed'] is True
+        assert blob['rank'] == 0
+        assert blob['last_step'] == 1
+        assert blob['detail'] == {'error': 'boom'}
+        assert len(blob['records']) == 2
+        for r in blob['records']:
+            assert sum(r['phases'].values()) == r['wall_s']
+
+    def test_dump_without_dir_returns_none(self):
+        _seal_steps(1)
+        assert flight_recorder.seal_dump('exception') is None
+
+    def test_stall_verdict_latches_once_per_episode(self, dumps_dir):
+        _seal_steps(2)
+        flight_recorder.note_stall(5.0)
+        flight_recorder.note_stall(6.0)     # latched: no second dump
+        files = sorted(os.listdir(dumps_dir))
+        assert len(files) == 1
+        blob = json.loads(
+            open(dumps_dir / files[0], encoding='utf-8').read())
+        assert blob['reason'] == 'stall_verdict'
+        assert blob['detail']['progress_age_s'] == 5.0
+        # A sealed step re-arms the latch: next episode dumps again.
+        _seal_steps(1, start=2)
+        flight_recorder.note_stall(7.0)
+        assert len(os.listdir(dumps_dir)) == 2
+
+    @pytest.mark.parametrize('mode,reason', [
+        ('exception', 'exception'), ('sigterm', 'sigterm')])
+    def test_crash_arms_dump_in_subprocess(self, tmp_path, mode,
+                                           reason):
+        # install_crash_dumps rewires sys.excepthook and the SIGTERM
+        # disposition process-wide, so both arms drill in a child: the
+        # fatal path must leave a readable black box on its way down.
+        d = tmp_path / 'bb'
+        script = tmp_path / 'crash.py'
+        script.write_text(f'''
+import os, signal, sys, time
+sys.path.insert(0, {json.dumps(REPO_ROOT)})
+from skypilot_tpu.agent import flight_recorder
+flight_recorder.install_crash_dumps()
+flight_recorder.begin_step(7)
+flight_recorder.mark('data_wait', 0.01)
+flight_recorder.record_step()
+if sys.argv[1] == 'exception':
+    raise RuntimeError('boom')
+os.kill(os.getpid(), signal.SIGTERM)
+time.sleep(10)
+''')
+        env = dict(os.environ,
+                   XSKY_FLIGHTREC_DIR=str(d),
+                   XSKY_FLIGHTREC='1')
+        proc = subprocess.run([sys.executable, str(script), mode],
+                              env=env, capture_output=True, text=True,
+                              timeout=120, check=False)
+        assert proc.returncode != 0
+        if mode == 'sigterm':
+            assert proc.returncode == -signal.SIGTERM, proc.stderr
+        files = [f for f in os.listdir(d) if f.endswith('.json')]
+        assert len(files) == 1, (proc.stdout, proc.stderr)
+        blob = json.loads(open(d / files[0], encoding='utf-8').read())
+        assert blob['reason'] == reason
+        assert blob['last_step'] == 7
+        assert blob['records'][0]['phases']['data_wait'] >= 0.01
+        if mode == 'exception':
+            assert 'boom' in blob['detail']['error']
+
+
+# ---- cross-rank join --------------------------------------------------------
+
+
+def _row(rank, step, device=0.01, data=0.001, wall=None, started=100.0,
+         dispatch=0.001):
+    phases = {'data_wait': data, 'h2d': 0.001, 'dispatch': dispatch,
+              'device_compute': device, 'ckpt_copy': 0.0, 'other': 0.0}
+    return {'rank': rank, 'step': step, 'started_ts': started,
+            'wall_s': wall if wall is not None
+            else sum(phases.values()),
+            'phases': phases}
+
+
+class TestGangWaterfall:
+
+    def test_straggler_and_barrier_wait_math(self):
+        rows = [_row(0, 5, device=0.10), _row(1, 5, device=0.04)]
+        (entry,) = flight_recorder.gang_waterfall(rows)
+        assert entry['step'] == 5
+        assert entry['straggler_rank'] == 0
+        assert entry['skew_s'] == pytest.approx(0.06)
+        # The straggler waits on nobody; the fast rank's implied
+        # barrier wait is the straggler's compute minus its own.
+        assert entry['barrier_wait_s'][0] == 0.0
+        assert entry['barrier_wait_s'][1] == pytest.approx(0.06)
+        assert entry['gang_wall_s'] == pytest.approx(
+            max(r['wall_s'] for r in rows))
+
+    def test_data_share_per_rank_and_max(self):
+        rows = [_row(0, 1, data=0.08, device=0.01),
+                _row(1, 1, data=0.002, device=0.01)]
+        (entry,) = flight_recorder.gang_waterfall(rows)
+        share0 = 0.08 / rows[0]['wall_s']
+        assert entry['data_share_by_rank'][0] == pytest.approx(share0)
+        assert entry['data_share'] == pytest.approx(share0)
+
+    def test_missing_rank_tolerated(self):
+        rows = [_row(0, 1), _row(1, 1), _row(0, 2)]
+        steps = flight_recorder.gang_waterfall(rows)
+        assert [w['step'] for w in steps] == [1, 2]
+        assert set(steps[0]['ranks']) == {0, 1}
+        assert set(steps[1]['ranks']) == {0}
+
+    def test_elastic_renumbering_newest_incarnation_wins(self):
+        rows = [_row(0, 1, started=100.0), _row(0, 2, started=100.0),
+                _row(0, 3, started=200.0),   # relaunched rank 0
+                _row(1, 3, started=100.0)]
+        steps = flight_recorder.gang_waterfall(rows)
+        # The prior life's steps 1/2 never join against the relaunch.
+        assert [w['step'] for w in steps] == [3]
+        assert set(steps[0]['ranks']) == {0, 1}
+
+    def test_compute_falls_back_to_dispatch_when_unsynced(self):
+        rows = [_row(0, 1, device=0.0, dispatch=0.09),
+                _row(1, 1, device=0.0, dispatch=0.02)]
+        (entry,) = flight_recorder.gang_waterfall(rows)
+        assert entry['straggler_rank'] == 0
+        assert entry['skew_s'] == pytest.approx(0.07)
+
+    def test_digest_and_empty(self):
+        assert flight_recorder.waterfall_digest([]) == {'steps': 0}
+        rows = [_row(0, s, device=0.10) for s in (1, 2, 3)] + \
+               [_row(1, s, device=0.04) for s in (1, 2, 3)]
+        digest = flight_recorder.waterfall_digest(
+            flight_recorder.gang_waterfall(rows))
+        assert digest['steps'] == 3
+        assert digest['top_straggler'] == 0
+        assert digest['straggler_counts'] == {0: 3}
+        assert digest['mean_skew_s'] == pytest.approx(0.06)
+        assert digest['max_skew_s'] == pytest.approx(0.06)
+
+
+# ---- bounded table + pull dedup ---------------------------------------------
+
+
+def _pull_samples(now, steps, data=0.002, started=100.0, num_ranks=2):
+    samples = {}
+    for rank in range(num_ranks):
+        tail = []
+        for step in steps:
+            phases = {'data_wait': data if rank == 0 else 0.002,
+                      'h2d': 0.001, 'dispatch': 0.001,
+                      'device_compute': 0.05 if rank == 1 else 0.01,
+                      'ckpt_copy': 0.0, 'other': 0.0}
+            tail.append({'step': step, 'ts': now,
+                         'wall_s': sum(phases.values()),
+                         'phases': phases, 'synced': True})
+        samples[rank] = {'rank': rank, 'hb_ts': now,
+                         'last_progress_ts': now, 'started_ts': started,
+                         'phase': 'step', 'step': max(steps),
+                         'step_time_ema_s': 0.1,
+                         'tokens_per_sec': 10.0,
+                         'flightrec': {'seq': len(tail), 'tail': tail}}
+    return samples
+
+
+class TestAnatomyTable:
+
+    def test_roundtrip_and_filters(self, tmp_state):
+        now = time.time()
+        flight_recorder.record_train_anatomy(
+            'c1', 1, _pull_samples(now, [1, 2]), now=now)
+        rows = tmp_state.get_train_anatomy(cluster='c1')
+        assert len(rows) == 4
+        assert {r['rank'] for r in rows} == {0, 1}
+        only = tmp_state.get_train_anatomy(cluster='c1', rank=1,
+                                           step=2)
+        assert len(only) == 1
+        assert only[0]['phases']['device_compute'] == 0.05
+        assert only[0]['detail']['synced'] is True
+        assert tmp_state.get_train_anatomy(cluster='ghost') == []
+
+    def test_retention_bound_first_batch(self, tmp_state, monkeypatch):
+        monkeypatch.setattr(tmp_state, '_MAX_TRAIN_ANATOMY', 20)
+        monkeypatch.setattr(tmp_state, '_train_anatomy_inserts', 0)
+        rows = [dict(_row(0, s), ts=time.time()) for s in range(30)]
+        tmp_state.record_train_anatomy('c1', 1, rows)
+        kept = tmp_state.get_train_anatomy(cluster='c1', limit=500)
+        assert len(kept) == 20
+        # Newest rows survive the prune.
+        assert kept[0]['step'] == 29
+
+    def test_record_never_raises_on_db_failure(self, tmp_state,
+                                               monkeypatch):
+        def _boom():
+            raise RuntimeError('db gone')
+        monkeypatch.setattr(tmp_state, '_get_conn', _boom)
+        tmp_state.record_train_anatomy('c1', 1, [_row(0, 1)])
+
+    def test_pull_dedup_and_fresh_incarnation_cursor(self, tmp_state):
+        now = time.time()
+        samples = _pull_samples(now, [1, 2], num_ranks=1)
+        flight_recorder.record_train_anatomy('c1', 1, samples, now=now)
+        assert len(tmp_state.get_train_anatomy(cluster='c1')) == 2
+        # The same spool tail re-ships on every pull: no re-inserts.
+        flight_recorder.record_train_anatomy('c1', 1, samples, now=now)
+        assert len(tmp_state.get_train_anatomy(cluster='c1')) == 2
+        # Only the NEW step past the cursor lands.
+        flight_recorder.record_train_anatomy(
+            'c1', 1, _pull_samples(now, [1, 2, 3], num_ranks=1),
+            now=now)
+        assert len(tmp_state.get_train_anatomy(cluster='c1')) == 3
+        # An elastic relaunch reusing rank 0 (new started_ts) starts a
+        # fresh cursor: its step 1 is a different step 1.
+        flight_recorder.record_train_anatomy(
+            'c1', 1, _pull_samples(now, [1], started=200.0,
+                                   num_ranks=1), now=now)
+        assert len(tmp_state.get_train_anatomy(cluster='c1')) == 4
+
+    def test_pull_feeds_phase_and_skew_histograms(self, tmp_state):
+        now = time.time()
+        flight_recorder.record_train_anatomy(
+            'c1', 1, _pull_samples(now, [1, 2]), now=now)
+        text = metrics_lib.render_registry()
+        assert 'xsky_train_phase_seconds' in text
+        assert 'phase="data_wait"' in text
+        # Two ranks joined per step ⇒ the skew histogram observed.
+        assert 'xsky_train_step_skew_seconds' in text
+
+    def test_pull_never_raises_on_torn_samples(self, tmp_state):
+        flight_recorder.record_train_anatomy('c1', 1, {
+            0: 'not-a-dict',
+            1: {'rank': 1, 'flightrec': 'torn'},
+            2: {'rank': 2, 'flightrec': {'tail': [
+                'torn', {'step': 'NaNish'}, {'step': 3}]}},
+        })
+        assert tmp_state.get_train_anatomy(cluster='c1') == []
+
+
+# ---- surfaces: /metrics, xsky top, xsky train trace -------------------------
+
+
+class TestMetricsSurface:
+
+    def test_data_share_gauge_for_live_clusters(self, tmp_state):
+        from skypilot_tpu.server import metrics as server_metrics
+        tmp_state.add_or_update_cluster('live-c', None)
+        now = time.time()
+        telemetry.record_samples('live-c', 1,
+                                 _pull_samples(now, [1, 2, 3],
+                                               data=0.08), now=now)
+        text = server_metrics.render()
+        # rank 0: 0.08 data of 0.092 wall per step ⇒ 0.8696.
+        assert ('xsky_train_data_share{cluster="live-c",job="1",'
+                'rank="0"} 0.8696') in text
+        assert ('xsky_train_data_share{cluster="live-c",job="1",'
+                'rank="1"}') in text
+
+    def test_gauge_skips_torn_down_clusters(self, tmp_state):
+        from skypilot_tpu.server import metrics as server_metrics
+        now = time.time()
+        telemetry.record_samples('ghost-c', 1,
+                                 _pull_samples(now, [1]), now=now)
+        assert 'xsky_train_data_share{cluster="ghost-c"' \
+            not in server_metrics.render()
+
+
+class TestCliSurfaces:
+
+    def _seed(self, cluster='anat-c'):
+        now = time.time()
+        telemetry.record_samples(
+            cluster, 1, _pull_samples(now, [1, 2, 3], data=0.08),
+            now=now)
+
+    def test_train_trace_table(self, tmp_state):
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        self._seed()
+        result = CliRunner().invoke(cli_mod.cli,
+                                    ['train', 'trace', 'anat-c'])
+        assert result.exit_code == 0, result.output
+        assert 'TRAIN TRACE anat-c' in result.output
+        assert '3 step(s)' in result.output
+        # rank 1's 0.05 device vs rank 0's 0.01 ⇒ straggler rank 1,
+        # and the fast rank carries the implied barrier wait.
+        assert 'straggler rank 1' in result.output
+        assert 'top straggler rank 1' in result.output
+        assert '+wait 40.0ms' in result.output
+        assert 'd=data_wait' in result.output
+
+    def test_train_trace_json_and_step_filter(self, tmp_state):
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        self._seed()
+        result = CliRunner().invoke(
+            cli_mod.cli, ['train', 'trace', 'anat-c', '--json'])
+        assert result.exit_code == 0, result.output
+        lines = [json.loads(l) for l in result.output.splitlines()
+                 if l.startswith('{')]
+        entries = [l for l in lines if 'digest' not in l]
+        digest = [l for l in lines if 'digest' in l][0]['digest']
+        assert len(entries) == 3
+        e = entries[0]
+        # json round-trip stringifies the int rank keys.
+        assert set(e['ranks']) == {'0', '1'}
+        assert e['straggler_rank'] == 1
+        assert e['barrier_wait_s']['0'] == pytest.approx(0.04)
+        assert e['data_share'] == pytest.approx(0.08 / 0.092,
+                                                abs=1e-3)
+        assert digest['steps'] == 3
+        assert digest['top_straggler'] == 1
+        only = CliRunner().invoke(
+            cli_mod.cli,
+            ['train', 'trace', 'anat-c', '--step', '2', '--json'])
+        steps = [json.loads(l)['step']
+                 for l in only.output.splitlines()
+                 if l.startswith('{') and 'digest' not in l]
+        assert steps == [2]
+
+    def test_train_trace_empty_cluster_message(self, tmp_state):
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        result = CliRunner().invoke(cli_mod.cli,
+                                    ['train', 'trace', 'no-such'])
+        assert result.exit_code == 0
+        assert 'No step anatomy recorded' in result.output
+
+    def test_top_gains_data_and_skew_columns(self, tmp_state):
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client import cli as cli_mod
+        self._seed()
+        runner = CliRunner()
+        table = runner.invoke(cli_mod.cli, ['top'])
+        assert table.exit_code == 0, table.output
+        assert 'DATA%' in table.output
+        assert 'SKEW' in table.output
+        assert '87%' in table.output          # rank 0's data share
+        assert '40.0ms' in table.output       # gang mean compute skew
+        as_json = runner.invoke(cli_mod.cli, ['top', '--json'])
+        rows = [json.loads(l) for l in as_json.output.splitlines()
+                if l.startswith('{')]
+        by_rank = {r['rank']: r for r in rows}
+        assert by_rank[0]['data_share'] == pytest.approx(0.08 / 0.092,
+                                                         abs=1e-3)
+        assert by_rank[1]['data_share'] == pytest.approx(
+            0.002 / 0.054, abs=1e-3)
+        assert by_rank[0]['anatomy_skew_s'] == pytest.approx(0.04)
+
+
+# ---- data-starved detector + remediation binding ----------------------------
+
+
+class TestDataStarvedDetector:
+
+    def _points(self, state, values, t0, labels=None, dt=10.0):
+        labels = labels or {'cluster': 'c', 'job': '1', 'rank': '0'}
+        state.record_metric_points(
+            [{'ts': t0 + i * dt, 'name': 'xsky_train_data_share',
+              'labels': labels, 'kind': 'gauge', 'value': v}
+             for i, v in enumerate(values)])
+
+    def test_elevated_rising_share_fires_and_journals(self, tmp_state):
+        from skypilot_tpu.utils import metrics_history
+        metrics_history.reset_for_test()
+        now = time.time()
+        self._points(tmp_state,
+                     [0.05, 0.06, 0.05, 0.05, 0.65, 0.7, 0.68, 0.72],
+                     t0=now - 75)
+        found = metrics_history.detect_anomalies(now=now)
+        starved = [f for f in found if f['detector'] == 'data_starved']
+        assert len(starved) == 1
+        assert starved[0]['labels']['rank'] == '0'
+        assert starved[0]['value'] > starved[0]['baseline']
+        events = tmp_state.get_recovery_events(
+            event_type='metrics.anomaly')
+        assert any(e['cause'] == 'data_starved' and
+                   e['scope'].startswith('metrics/data_starved/')
+                   for e in events)
+
+    def test_steady_low_share_stays_quiet(self, tmp_state):
+        from skypilot_tpu.utils import metrics_history
+        metrics_history.reset_for_test()
+        now = time.time()
+        # Rising but never elevated: a 0.2 share is a healthy input
+        # pipeline, not starvation.
+        self._points(tmp_state,
+                     [0.05, 0.05, 0.05, 0.05, 0.2, 0.2, 0.2, 0.2],
+                     t0=now - 75)
+        found = metrics_history.detect_anomalies(now=now)
+        assert not [f for f in found
+                    if f['detector'] == 'data_starved']
+
+    def test_controller_remediation_snapshots_digest(self, tmp_state):
+        from skypilot_tpu.jobs import controller as controller_lib
+        now = time.time()
+        flight_recorder.record_train_anatomy(
+            'xsky-jobs-7', 7, _pull_samples(now, [1, 2]), now=now)
+        ctl = object.__new__(controller_lib.JobsController)
+        ctl.cluster_name = 'xsky-jobs-7'
+        anomaly = {'detector': 'data_starved',
+                   'ident': 'cluster=xsky-jobs-7,job=7,rank=0',
+                   'labels': {'cluster': 'xsky-jobs-7'}}
+        out = ctl._remediate_data_starved(anomaly)
+        assert out['cluster'] == 'xsky-jobs-7'
+        assert out['anatomy']['steps'] == 2
+        assert out['anatomy']['top_straggler'] == 1
+        # Another controller's cluster: not ours, no action detail.
+        other = dict(anomaly, ident='cluster=elsewhere,job=9,rank=0')
+        assert ctl._remediate_data_starved(other) is None
+
+
+# ---- bench gates ------------------------------------------------------------
+
+
+class TestBenchFlightrecGate:
+    """Tier-1 overhead gate: the recorder must cost <2% of a 4 ms
+    step AND the sampled step's block_until_ready pair must be shared
+    (exactly one device sync) between the profiler probe and the seal,
+    proven by tools/bench_flightrec.py --smoke in a clean subprocess."""
+
+    def test_bench_flightrec_smoke_gate(self):
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, 'tools', 'bench_flightrec.py'),
+             '--smoke'],
+            capture_output=True, text=True, timeout=300, check=False)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert result['pass'] is True
+        assert result['overhead_pct'] < result['max_overhead_pct']
+        # Satellite contract: ONE block_until_ready on a sampled step,
+        # and the sealed record rode that same timestamp pair.
+        assert result['single_sync']['device_syncs'] == 1
+        assert result['single_sync']['sealed_synced'] is True
+        assert result['single_sync']['ok'] is True
+
+
+class TestBenchFailureJson:
+    """bench.py's failure JSON gains the per-rank flight-recorder tail
+    + any black-box dump reasons: a chaos-killed rank must leave a
+    readable post-mortem in the supervisor's stall/failure output."""
+
+    def _bench(self):
+        if REPO_ROOT not in sys.path:
+            sys.path.insert(0, REPO_ROOT)
+        import bench
+        return bench
+
+    def test_stall_path_surfaces_tail_and_dumps(self, monkeypatch,
+                                                tmp_path):
+        bench = self._bench()
+        spool = tmp_path / 'spool'
+        spool.mkdir()
+        dumps = tmp_path / 'spool' / 'flightrec'
+        now = time.time()
+        # The dump is written by the REAL dump arm, not hand-crafted.
+        monkeypatch.setenv(flight_recorder.ENV_DIR, str(dumps))
+        _seal_steps(6)
+        flight_recorder.seal_dump('sigterm')
+        # A spool sample whose flightrec key carries the ring tail.
+        tail = flight_recorder.get_recorder().tail(5)
+        (spool / 'rank-0.json').write_text(json.dumps({
+            'rank': 0, 'hb_ts': now, 'last_progress_ts': now - 30,
+            'started_ts': now - 60, 'phase': 'step', 'step': 5,
+            'flightrec': {'seq': 6, 'tail': tail}}))
+        env = {'XSKY_TELEMETRY_DIR': str(spool),
+               'XSKY_FLIGHTREC_DIR': str(dumps)}
+        ranks = bench._telemetry_tail(env)
+        fr = ranks['0']['flightrec']
+        assert fr['last_step'] == 5
+        assert fr['seq'] == 6
+        assert len(fr['tail']) == 4           # headline tail is capped
+        assert all(sum(r['phases'].values()) == r['wall_s']
+                   for r in fr['tail'])
+        (dump,) = ranks['flightrec_dumps']
+        assert dump['reason'] == 'sigterm'
+        assert dump['rank'] == 0
+        assert dump['last_step'] == 5
+        assert dump['records'] == 6
+        assert os.path.exists(dump['path'])
+
+    def test_no_flightrec_keys_tolerated(self, tmp_path):
+        bench = self._bench()
+        spool = tmp_path / 'spool'
+        spool.mkdir()
+        (spool / 'rank-0.json').write_text(json.dumps(
+            {'rank': 0, 'hb_ts': time.time(), 'phase': 'step'}))
+        ranks = bench._telemetry_tail({
+            'XSKY_TELEMETRY_DIR': str(spool)})
+        assert ranks['0']['flightrec'] is None
+        assert 'flightrec_dumps' not in ranks
+
+
+# ---- tier-1 fake-cloud drill ------------------------------------------------
+
+
+class TestFlightRecorderDrill:
+    """Tier-1 acceptance: a fake-cloud 2-host gang where chaos injects
+    a data stall on rank 0 (`train.data_stall` inside the data_wait
+    bracket) and a straggler on rank 1 (`train.straggler_rank` inside
+    mark_compute). Each injected cause must resolve to the CORRECT
+    attribution end-to-end: rank 0's steps dominated by data_wait with
+    the data-starved detector journalling off the scrape-time gauge,
+    rank 1 flagged straggler with rank 0 carrying the implied barrier
+    wait in `xsky train trace --json`."""
+
+    def test_chaos_attribution_end_to_end(self, fake_cluster_env,
+                                          monkeypatch, tmp_path):
+        del fake_cluster_env
+        from click.testing import CliRunner
+
+        from skypilot_tpu import Resources, Task, core, execution
+        from skypilot_tpu import state as state_lib
+        from skypilot_tpu.client import cli as cli_mod
+        from skypilot_tpu.server import metrics as server_metrics
+        from skypilot_tpu.utils import metrics_history
+
+        metrics_lib.reset_for_test()
+        metrics_history.reset_for_test()
+        monkeypatch.setenv(telemetry.ENV_INTERVAL, '0.1')
+        monkeypatch.setenv(telemetry.ENV_PULL_INTERVAL, '0.3')
+        monkeypatch.setenv(flight_recorder.ENV_PUSH_INTERVAL, '0')
+        monkeypatch.setenv('XSKY_CHAOS_PLAN', json.dumps({'points': {
+            'train.data_stall': {'match': {'rank': 0},
+                                 'stall_s': 0.2},
+            'train.straggler_rank': {'match': {'rank': 1},
+                                     'extra_s': 0.15}}}))
+
+        script = tmp_path / 'workload.py'
+        script.write_text(f'''
+import os, sys, time
+sys.path.insert(0, {json.dumps(REPO_ROOT)})
+from skypilot_tpu.agent import flight_recorder, telemetry
+for i in range(10):
+    flight_recorder.begin_step(i)
+    with flight_recorder.phase('data_wait'):
+        pass                      # chaos stalls rank 0 in here
+    flight_recorder.mark_compute(0.001, 0.005, synced=True)
+    flight_recorder.record_step()
+    telemetry.emit(phase='step', step=i, step_time_s=0.05)
+    time.sleep(0.05)
+''')
+        cluster = 'flightrec-drill'
+        task = Task('flightrec-drill',
+                    run=f'{sys.executable} {script}')
+        # tpu-v5e-32 = 4 fake hosts (profile-smoke sizing): ranks 2/3
+        # stay healthy so the straggler verdict has a real contrast.
+        task.set_resources(Resources(accelerators='tpu-v5e-32'))
+        job_id, handle = execution.launch(task, cluster_name=cluster)
+        try:
+            # Deterministic final pull (profile-smoke rationale): the
+            # host spools hold the final truth and outlive the job.
+            from skypilot_tpu.backends import tpu_gang_backend
+            backend = tpu_gang_backend.TpuGangBackend()
+            samples = backend.get_workload_telemetry(handle, job_id)
+            assert set(samples) == {0, 1, 2, 3}, samples
+            telemetry.record_samples(cluster, job_id, samples)
+
+            # The joined waterfall attributes each injected cause.
+            result = CliRunner().invoke(
+                cli_mod.cli, ['train', 'trace', cluster, '--json'])
+            assert result.exit_code == 0, result.output
+            lines = [json.loads(l)
+                     for l in result.output.splitlines()
+                     if l.startswith('{')]
+            digest = [l for l in lines if 'digest' in l][0]['digest']
+            joined = [l for l in lines if 'digest' not in l
+                      and {'0', '1'} <= set(l['ranks'])]
+            assert joined, lines
+            for entry in joined:
+                # Rank 1's chaos sleep lands in device compute ⇒ it is
+                # the straggler; rank 0 carries the implied wait.
+                assert entry['straggler_rank'] == 1
+                assert entry['skew_s'] > 0.05
+                assert entry['barrier_wait_s']['0'] > 0.05
+                assert entry['barrier_wait_s']['1'] == 0.0
+                # Rank 0's chaos stall lands in data_wait ⇒ its share
+                # of the step wall dominates.
+                assert entry['data_share_by_rank']['0'] > 0.5
+                assert entry['data_share_by_rank']['1'] < 0.3
+                ranks = entry['ranks']
+                assert ranks['0']['phases']['data_wait'] >= 0.2
+                assert ranks['1']['phases']['device_compute'] >= 0.15
+            assert digest['top_straggler'] == 1
+            assert digest['data_share'] > 0.4
+
+            # `xsky top` reads the same truth into DATA%/SKEW.
+            as_json = CliRunner().invoke(cli_mod.cli,
+                                         ['top', '--json'])
+            rows = [json.loads(l)
+                    for l in as_json.output.splitlines()
+                    if l.startswith('{')]
+            by_rank = {r['rank']: r for r in rows
+                       if r['cluster'] == cluster}
+            assert by_rank[0]['data_share'] > 0.5
+            assert by_rank[0]['anatomy_skew_s'] > 0.05
+
+            # /metrics while the cluster lives: the scrape-time gauge
+            # + the registry histograms minted on pull.
+            text = server_metrics.render()
+            assert (f'xsky_train_data_share{{cluster="{cluster}"'
+                    in text)
+            assert 'xsky_train_phase_seconds' in text
+            assert 'xsky_train_step_skew_seconds' in text
+
+            # The data-starved detector journals off that gauge: a
+            # low trail then the (real, scraped) starved window.
+            now = time.time()
+            state_lib.record_metric_points(
+                [{'ts': now - 115 + i * 10,
+                  'name': 'xsky_train_data_share',
+                  'labels': {'cluster': cluster,
+                             'job': str(job_id), 'rank': '0'},
+                  'kind': 'gauge', 'value': 0.05} for i in range(4)])
+            for offset in (45, 30, 15, 0):
+                metrics_history.record_tick(now=now - offset)
+            events = state_lib.get_recovery_events(
+                event_type='metrics.anomaly')
+            assert any(e['cause'] == 'data_starved' and
+                       e['scope'].startswith('metrics/data_starved/')
+                       for e in events), events
+
+            # Workload-side chaos journalled cross-process.
+            injected = {r['scope']
+                        for r in state_lib.get_recovery_events(
+                            event_type='chaos.injected')}
+            assert 'chaos/train.data_stall' in injected
+            assert 'chaos/train.straggler_rank' in injected
+        finally:
+            core.down(cluster)
+        # Torn down ⇒ the scrape-time gauge disappears; the anatomy
+        # rows remain for post-mortems.
+        assert f'xsky_train_data_share{{cluster="{cluster}"' \
+            not in server_metrics.render()
+        assert state_lib.get_train_anatomy(cluster=cluster)

@@ -1,0 +1,191 @@
+#!/usr/bin/env python3
+"""Flight-recorder step-overhead micro-benchmark (the PR's <2% gate).
+
+The recorder sits on the training step loop itself — ``begin_step``,
+the ``data_wait``/``h2d`` phase brackets, ``mark_compute``, and the
+``record_step`` seal all run EVERY step — so its cost must be
+invisible next to real step work. This tool measures:
+
+  * **per-step recorder cost**, enabled (full cycle: begin, two phase
+    brackets, a compute mark, seal into the ring) and disabled
+    (``XSKY_FLIGHTREC=0`` — the cached-key early return every call
+    pays) — a tight loop around the recorder cycle alone, which is
+    stable to well under a microsecond;
+  * **step work time** — a synthetic CPU step (~4 ms, a FAST real
+    step; production steps are 100 ms+), median-of-N because a python
+    work loop jitters ±50% under scheduler noise;
+  * a **paired-difference** reference: interleaved (work + recorder)
+    vs (work alone) pairs, median of per-pair differences — reported,
+    not gated (scheduler noise on a 4 ms work loop swamps a
+    microsecond effect; same reasoning as ``bench_telemetry.py``);
+
+and gates ``enabled_us / step_us < --max-overhead-pct`` (default 2%).
+It also ASSERTS the satellite-4 contract: on a profiler-sampled step
+the recorder reuses the probe's own ``(gap, device)`` pair, so exactly
+ONE ``jax.block_until_ready`` happens per sampled step — verified with
+a counting fake ``jax`` module injected into ``sys.modules`` (no real
+jax import). Prints ONE JSON line; exit 1 on gate failure.
+
+Usage:
+    python tools/bench_flightrec.py [--calls 50000] [--pairs 100]
+                                    [--max-overhead-pct 2.0] [--smoke]
+"""
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+import types
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+# Synthetic step work: ~4 ms of pure-python arithmetic — the least
+# favorable realistic step size (small models on big chips).
+_WORK_ITERS = 40000
+
+
+def _step_work() -> int:
+    x = 0
+    for i in range(_WORK_ITERS):
+        x += i * i
+    return x
+
+
+def _recorder_cycle(flight_recorder, step: int) -> None:
+    """One step's full recorder traffic (the launch.py loop shape)."""
+    flight_recorder.begin_step(step)
+    with flight_recorder.phase('data_wait'):
+        pass
+    with flight_recorder.phase('h2d'):
+        pass
+    flight_recorder.mark_compute(0.003)
+    flight_recorder.record_step(step)
+
+
+def _cycle_us_per_call(flight_recorder, calls: int) -> float:
+    _recorder_cycle(flight_recorder, 0)   # warm: recorder construction
+    t0 = time.perf_counter()
+    for step in range(calls):
+        _recorder_cycle(flight_recorder, step)
+    return (time.perf_counter() - t0) / calls * 1e6
+
+
+def _assert_single_sync(flight_recorder) -> dict:
+    """Satellite contract: a profiler-sampled step costs exactly ONE
+    device sync, shared between the probe and the recorder's seal."""
+    calls = {'n': 0}
+
+    def _block(out):
+        calls['n'] += 1
+        return out
+
+    saved = sys.modules.get('jax')
+    sys.modules['jax'] = types.SimpleNamespace(block_until_ready=_block)
+    saved_every = os.environ.get('XSKY_PROFILE_SAMPLE_EVERY')
+    os.environ['XSKY_PROFILE_SAMPLE_EVERY'] = '1'
+    try:
+        from skypilot_tpu.agent import profiler
+        flight_recorder.reset_for_test()
+        flight_recorder.begin_step(0)
+        probe = profiler.step_probe()
+        marks = probe.done(object()) if probe is not None else None
+        if marks is not None:
+            flight_recorder.mark_compute(marks[0], marks[1],
+                                         synced=True)
+        flight_recorder.record_step(0)
+        rec = flight_recorder.get_recorder()
+        sealed = rec.records(limit=1) if rec is not None else []
+    finally:
+        if saved is None:
+            sys.modules.pop('jax', None)
+        else:
+            sys.modules['jax'] = saved
+        if saved_every is None:
+            os.environ.pop('XSKY_PROFILE_SAMPLE_EVERY', None)
+        else:
+            os.environ['XSKY_PROFILE_SAMPLE_EVERY'] = saved_every
+    return {
+        'probe_sampled': marks is not None,
+        'device_syncs': calls['n'],
+        'sealed_synced': bool(sealed and sealed[0].get('synced')),
+        'ok': marks is not None and calls['n'] == 1 and
+              bool(sealed and sealed[0].get('synced')),
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--calls', type=int, default=50000,
+                        help='recorder cycles per per-call measurement')
+    parser.add_argument('--pairs', type=int, default=100,
+                        help='paired (work+recorder)/(work) samples')
+    parser.add_argument('--max-overhead-pct', type=float, default=2.0)
+    parser.add_argument('--smoke', action='store_true',
+                        help='reduced iteration counts (the tier-1 '
+                             'subprocess gate)')
+    args = parser.parse_args()
+    if args.smoke:
+        args.calls = min(args.calls, 5000)
+        args.pairs = min(args.pairs, 20)
+
+    from skypilot_tpu.agent import flight_recorder
+
+    # Per-step recorder cost: disabled early-return, then enabled.
+    os.environ[flight_recorder.ENV_ENABLED] = '0'
+    flight_recorder.reset_for_test()
+    disabled_us = _cycle_us_per_call(flight_recorder, args.calls)
+    os.environ[flight_recorder.ENV_ENABLED] = '1'
+    flight_recorder.reset_for_test()
+    enabled_us = _cycle_us_per_call(flight_recorder, args.calls)
+
+    # Step work: median of N (jitters far more than the recorder does).
+    work_times = []
+    for _ in range(50 if not args.smoke else 20):
+        t0 = time.perf_counter()
+        _step_work()
+        work_times.append(time.perf_counter() - t0)
+    step_us = statistics.median(work_times) * 1e6
+
+    # Paired-difference reference: per-pair (work + recorder) minus
+    # (work alone), back-to-back so scheduler drift hits both arms.
+    diffs = []
+    for step in range(args.pairs):
+        t0 = time.perf_counter()
+        _step_work()
+        _recorder_cycle(flight_recorder, step)
+        t1 = time.perf_counter()
+        _step_work()
+        t2 = time.perf_counter()
+        diffs.append((t1 - t0) - (t2 - t1))
+    paired_us = statistics.median(diffs) * 1e6
+
+    sync = _assert_single_sync(flight_recorder)
+
+    rec = flight_recorder.get_recorder()
+    ring_len = len(rec.records()) if rec is not None else 0
+    flight_recorder.reset_for_test()
+
+    overhead_pct = enabled_us / step_us * 100.0
+    ok = overhead_pct < args.max_overhead_pct and sync['ok']
+    print(json.dumps({
+        'metric': 'flightrec_step_overhead',
+        'cycle_enabled_us': round(enabled_us, 2),
+        'cycle_disabled_us': round(disabled_us, 2),
+        'step_work_us_median': round(step_us, 1),
+        'overhead_pct': round(overhead_pct, 3),
+        'disabled_overhead_pct': round(disabled_us / step_us * 100.0,
+                                       3),
+        'paired_diff_us_median': round(paired_us, 2),
+        'ring_records': ring_len,
+        'single_sync': sync,
+        'max_overhead_pct': args.max_overhead_pct,
+        'smoke': args.smoke,
+        'pass': ok,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -99,6 +99,10 @@ class SpanProfilerRule(engine.Rule):
         # build_ledger; callers hold the span).
         'skypilot_tpu/agent/profiler.py',
         'skypilot_tpu/agent/goodput.py',
+        # flight_recorder.record_train_anatomy delegates to
+        # state.record_train_anatomy internally; callers hold the
+        # flightrec.pull span.
+        'skypilot_tpu/agent/flight_recorder.py',
     })
     PROFILER_SITES = frozenset({'capture_device_profile',
                                 'record_profiles',
@@ -125,7 +129,12 @@ class SpanProfilerRule(engine.Rule):
                                 # external callers hold theirs).
                                 'record_points',
                                 'detect_anomalies',
-                                'series'})
+                                'series',
+                                # flight-recorder pull site: the
+                                # anatomy extraction rides the same
+                                # telemetry pull whose latency xsky
+                                # trace attributes.
+                                'record_train_anatomy'})
 
     def applies_to(self, rel_path: str) -> bool:
         return rel_path.startswith('skypilot_tpu/') and \
@@ -203,11 +212,12 @@ class RetentionBoundRule(engine.Rule):
         'metric_points': '_MAX_METRIC_POINTS',
         'remediations': '_MAX_REMEDIATIONS',
         'serve_slo_exemplars': '_MAX_SERVE_SLO_EXEMPLARS',
+        'train_anatomy': '_MAX_TRAIN_ANATOMY',
     }
     # CREATE TABLE names matching this are observability tables.
     OBSERVABILITY_RE = re.compile(
         r'events|spans|telemetry|profiles|slo|decisions|ledger|points'
-        r'|remediations')
+        r'|remediations|anatomy')
     CREATE_RE = re.compile(r'CREATE TABLE IF NOT EXISTS (\w+)')
 
     def applies_to(self, rel_path: str) -> bool:
@@ -407,6 +417,8 @@ class NeverRaiseRule(engine.Rule):
             'record_points', 'detect_anomalies', 'series'),
         'skypilot_tpu/utils/remediation.py': (
             'maybe_tick', 'record_applied', 'record_resolved'),
+        'skypilot_tpu/agent/flight_recorder.py': (
+            'record_step', 'seal_dump', 'record_train_anatomy'),
     }
 
     def applies_to(self, rel_path: str) -> bool:
